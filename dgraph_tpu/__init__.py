@@ -1,0 +1,30 @@
+"""dgraph_tpu — a TPU-native distributed graph database framework.
+
+A ground-up re-design of the capabilities of Dgraph (reference:
+/root/reference, Go, v1.1.x) for TPU hardware:
+
+- The query-execution data plane — posting-list decode (ref codec/codec.go),
+  sorted-UID set algebra (ref algo/uidlist.go), multi-hop expansion
+  (ref query/query.go ProcessGraph), BFS/recurse (ref query/recurse.go),
+  shortest paths (ref query/shortest.go) and order-by/top-k
+  (ref worker/sort.go) — runs as batched jit/vmap XLA kernels over padded
+  sorted-UID tensors resident in HBM.
+- The control plane — GraphQL± parsing, schema, MVCC transactions, UID/ts
+  leases, replication — stays host-side, mirroring the reference's
+  edgraph/gql/schema/posting/zero layering but with level-batched device
+  calls instead of goroutine fan-out.
+
+Package layout:
+  ops/       device kernels: uidvec set algebra, delta codec, adjacency
+             expansion, top-k, BFS/SSSP
+  models/    data model: schema, scalar types, tokenizers, posting lists
+  storage/   host-side MVCC key-value store, WAL, rollups
+  gql/       GraphQL± lexer/parser -> AST
+  query/     planner (SubGraph-equivalent), executor, JSON encoding
+  engine/    single-process engine (Alpha-equivalent) + txn oracle
+  cluster/   coordinator (Zero-equivalent), membership, distribution
+  parallel/  device mesh, shardings, cross-shard collectives
+  utils/     key codec, config, metrics
+"""
+
+__version__ = "0.1.0"

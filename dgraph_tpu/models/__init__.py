@@ -1,0 +1,1 @@
+"""Data model: scalar types, schema state, tokenizers, posting lists."""

@@ -1,0 +1,218 @@
+"""Index tokenizers.
+
+Re-provides the reference's tokenizer registry (tok/tok.go:56 Tokenizer
+interface, tok/tok.go:84-101 built-in registry): term, exact, hash,
+trigram, fulltext, int, float, bool, datetime buckets (year/month/day/hour),
+geo.  Each token is prefixed with a one-byte identifier so tokens of
+different tokenizers for the same predicate never collide and sortable
+tokenizers keep byte order (ref tok/tok.go identifier scheme).
+
+TPU angle: tokenizers run host-side at mutation/ingest time; what reaches
+the device are the *posting UID vectors per token* and, for sortable
+indexes (int/float/datetime/exact), a parallel sorted array of int64 token
+keys so inequality lookups (le/lt/ge/gt/between) become one searchsorted
+over the token-key vector (ref worker/tokens.go:113 getInequalityTokens
+walks Badger in order instead).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import re
+import unicodedata
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+from dgraph_tpu.models.types import (
+    TypeID, Val, convert, sort_key, value_fingerprint,
+)
+
+
+@dataclass(frozen=True)
+class TokenizerSpec:
+    name: str
+    ident: int          # one-byte namespace prefix
+    for_type: TypeID    # schema type this tokenizer applies to
+    sortable: bool      # supports inequality via ordered token keys
+    lossy: bool         # token does not uniquely identify the value
+    fn: Callable[[Val], list]
+
+
+def _fold(s: str) -> str:
+    """Unicode-fold + lowercase, the reference's bleve normalize chain
+    (tok/bleve.go) reduced to NFKD-strip-marks + casefold."""
+    nfkd = unicodedata.normalize("NFKD", s)
+    stripped = "".join(c for c in nfkd if not unicodedata.combining(c))
+    return stripped.casefold()
+
+
+_TERM_SPLIT = re.compile(r"[^\w]+", re.UNICODE)
+
+# A small multi-language stopword set for fulltext (the reference pulls
+# bleve's per-language lists; we keep English + common Romance/Germanic
+# function words host-side).
+_STOPWORDS = frozenset(
+    "a an and are as at be but by for if in into is it no not of on or such "
+    "that the their then there these they this to was will with".split())
+
+
+def _porter_lite(w: str) -> str:
+    """Tiny suffix-stripping stemmer standing in for bleve's snowball
+    stemmers (tok/langbase.go). Deliberately conservative."""
+    for suf in ("ational", "iveness", "fulness", "ousness", "ization",
+                "ations", "ingly", "ement", "ments", "ition",
+                "ness", "ible", "able", "ment", "ions",
+                "ies", "ied", "ing", "ely", "es", "ed", "ly", "s"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            w = w[: -len(suf)]
+            if suf == "ies" or suf == "ied":
+                w += "i"
+            break
+    return w
+
+
+def term_tokens(v: Val) -> list[str]:
+    """Ref: tok.TermTokenizer — fold + split on non-word."""
+    return sorted({t for t in _TERM_SPLIT.split(_fold(str(v.value))) if t})
+
+
+def fulltext_tokens(v: Val) -> list[str]:
+    """Ref: tok.FullTextTokenizer — fold, stopword-filter, stem."""
+    toks = {_porter_lite(t)
+            for t in _TERM_SPLIT.split(_fold(str(v.value)))
+            if t and t not in _STOPWORDS}
+    return sorted(t for t in toks if t)
+
+
+def exact_tokens(v: Val) -> list[str]:
+    return [str(v.value)]
+
+
+def hash_tokens(v: Val) -> list[int]:
+    return [value_fingerprint(convert(v, TypeID.STRING))]
+
+
+def trigram_tokens(v: Val) -> list[str]:
+    """Ref: tok.TrigramTokenizer (regexp index, worker/trigram.go)."""
+    s = str(v.value)
+    return sorted({s[i : i + 3] for i in range(len(s) - 2)})
+
+
+def int_tokens(v: Val) -> list[int]:
+    return [int(convert(v, TypeID.INT).value)]
+
+
+def float_tokens(v: Val) -> list[int]:
+    # Sortable int64 key so inequality works over one searchsorted.
+    return [sort_key(convert(v, TypeID.FLOAT))]
+
+
+def bool_tokens(v: Val) -> list[int]:
+    return [1 if convert(v, TypeID.BOOL).value else 0]
+
+
+def _dt_of(v: Val) -> _dt.datetime:
+    return convert(v, TypeID.DATETIME).value
+
+
+def year_tokens(v: Val) -> list[int]:
+    return [_dt_of(v).year]
+
+
+def month_tokens(v: Val) -> list[int]:
+    d = _dt_of(v)
+    return [d.year * 100 + d.month]
+
+
+def day_tokens(v: Val) -> list[int]:
+    d = _dt_of(v)
+    return [(d.year * 100 + d.month) * 100 + d.day]
+
+
+def hour_tokens(v: Val) -> list[int]:
+    d = _dt_of(v)
+    return [((d.year * 100 + d.month) * 100 + d.day) * 100 + d.hour]
+
+
+def geo_tokens(v: Val) -> list[str]:
+    """Geo cell covering.  The reference uses S2 cells at levels 5-16
+    (types/s2index.go).  We grid lon/lat into multi-resolution square cells
+    (levels 5..12, powers of two per degree) — same near/within semantics,
+    library-free."""
+    import json as _json
+
+    g = v.value if isinstance(v.value, dict) else _json.loads(str(v.value))
+    pts: list[tuple[float, float]] = []
+
+    def collect(coords):
+        if isinstance(coords[0], (int, float)):
+            pts.append((float(coords[0]), float(coords[1])))
+        else:
+            for c in coords:
+                collect(c)
+
+    collect(g["coordinates"])
+    toks = set()
+    for level in range(5, 13):
+        cells_per_deg = 2.0 ** (level - 8)  # level 8 = 1 cell/degree
+        for lon, lat in pts:
+            cx = int((lon + 180.0) * cells_per_deg)
+            cy = int((lat + 90.0) * cells_per_deg)
+            toks.add(f"{level}/{cx}/{cy}")
+    return sorted(toks)
+
+
+_REGISTRY: dict[str, TokenizerSpec] = {}
+
+
+def _register(name, ident, for_type, sortable, lossy, fn):
+    _REGISTRY[name] = TokenizerSpec(name, ident, for_type, sortable, lossy, fn)
+
+
+_register("term", 0x1, TypeID.STRING, False, True, term_tokens)
+_register("exact", 0x2, TypeID.STRING, True, False, exact_tokens)
+_register("fulltext", 0x3, TypeID.STRING, False, True, fulltext_tokens)
+_register("hash", 0x4, TypeID.STRING, False, True, hash_tokens)
+_register("trigram", 0x5, TypeID.STRING, False, True, trigram_tokens)
+_register("int", 0x6, TypeID.INT, True, False, int_tokens)
+_register("float", 0x7, TypeID.FLOAT, True, True, float_tokens)
+_register("bool", 0x8, TypeID.BOOL, True, False, bool_tokens)
+_register("datetime", 0x9, TypeID.DATETIME, True, True, year_tokens)
+_register("year", 0x9, TypeID.DATETIME, True, True, year_tokens)
+_register("month", 0xA, TypeID.DATETIME, True, True, month_tokens)
+_register("day", 0xB, TypeID.DATETIME, True, True, day_tokens)
+_register("hour", 0xC, TypeID.DATETIME, True, True, hour_tokens)
+_register("geo", 0xD, TypeID.GEO, False, True, geo_tokens)
+
+
+def get_tokenizer(name: str) -> TokenizerSpec:
+    spec = _REGISTRY.get(name)
+    if spec is None:
+        raise ValueError(f"Undefined tokenizer {name!r}")
+    return spec
+
+
+def get_tokenizers(names: Iterable[str]) -> list[TokenizerSpec]:
+    return [get_tokenizer(n) for n in names]
+
+
+def default_tokenizer_for(tid: TypeID) -> TokenizerSpec | None:
+    """Tokenizer implied by `@index` with no args / inequality support.
+    Ref: tok.GetTokenizer defaults per type (tok/tok.go)."""
+    return {
+        TypeID.INT: _REGISTRY["int"],
+        TypeID.FLOAT: _REGISTRY["float"],
+        TypeID.BOOL: _REGISTRY["bool"],
+        TypeID.DATETIME: _REGISTRY["datetime"],
+        TypeID.GEO: _REGISTRY["geo"],
+        TypeID.STRING: None,  # string requires an explicit tokenizer choice
+        TypeID.DEFAULT: None,
+    }.get(tid)
+
+
+def tokens_for(v: Val, spec: TokenizerSpec) -> list:
+    """Tokens for value under tokenizer, converted to the tokenizer's
+    input type first (ref posting/index.go:83 addIndexMutations does
+    types.Convert before tokenizing)."""
+    converted = convert(v, spec.for_type)
+    return spec.fn(converted)

@@ -1,0 +1,23 @@
+"""Device kernels: the hot data plane of the framework.
+
+Everything here operates on *padded sorted UID vectors* (see uidvec) and is
+jit/vmap-friendly: static shapes, masked ops, no data-dependent Python
+control flow.
+"""
+
+from dgraph_tpu.ops.uidvec import (
+    SENTINEL,
+    UID_DTYPE,
+    from_numpy,
+    to_numpy,
+    pad_to,
+    count,
+    compact,
+    intersect,
+    union,
+    difference,
+    member_mask,
+    merge_many,
+    intersect_many,
+    first_k,
+)

@@ -1,0 +1,133 @@
+"""UID block codec — TPU re-design of the reference's group-varint delta
+codec (codec/codec.go:43-274 Encoder/Decoder, SSE decode via go-groupvarint).
+
+The reference compresses sorted uint64 UID lists as blocks of <=BlockSize
+deltas group-varint-encoded against a per-block Base, with the invariant
+that all UIDs in a block share their 32 MSBs (codec/codec.go:43).
+
+Bit-twiddling varints are hostile to the MXU/VPU, so the TPU layout is:
+
+  UidPack32:
+    bases  : [num_blocks]            uint32  first UID of each block
+    deltas : [num_blocks, block_sz]  uint16  successive differences,
+                                             0 in padding slots
+    counts : [num_blocks]            int32   valid deltas per block (incl.
+                                             the implicit base element)
+
+  decode  = bases[:, None] + cumsum(deltas, axis=1)   (associative scan,
+            one VPU pass — the reference's per-integer branchy decode loop
+            at codec/codec.go:128 becomes a single fused cumsum)
+
+Deltas that overflow uint16 force a new block, mirroring how the reference
+starts a new block on a 32-MSB change.  Typical graph posting lists are
+locally dense (the reference claims ~13% of raw size, codec/codec.go:281);
+uint16 deltas + uint32 bases give 2 bytes/UID asymptotically vs 8 raw.
+
+Encode runs on host (numpy) at rollup time — it is ingest-path, not
+query-path.  Decode is the jit-side kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.ops.uidvec import SENTINEL, compact
+
+BLOCK_SIZE = 256  # multiple of the 128-lane VPU; ref uses 256 (wire.go)
+_MAX_DELTA = np.uint32(0xFFFF)
+
+
+@dataclass
+class UidPack32:
+    """Host-side handle; arrays may be numpy or jax."""
+
+    bases: jax.Array   # [B] uint32
+    deltas: jax.Array  # [B, BLOCK_SIZE-1] uint16
+    counts: jax.Array  # [B] int32, 1..BLOCK_SIZE
+    n: int             # total number of UIDs
+
+    def device(self) -> "UidPack32":
+        return UidPack32(
+            jnp.asarray(self.bases), jnp.asarray(self.deltas),
+            jnp.asarray(self.counts), self.n,
+        )
+
+    @property
+    def nbytes(self) -> int:
+        return (np.asarray(self.bases).nbytes
+                + np.asarray(self.deltas).nbytes
+                + np.asarray(self.counts).nbytes)
+
+
+def encode(uids: np.ndarray) -> UidPack32:
+    """Sorted uint32 UIDs -> UidPack32. Host-side, vectorized numpy.
+
+    Ref: codec.Encode (codec/codec.go:283) + Encoder.packBlock.
+    Block boundaries: every BLOCK_SIZE elements, plus wherever a delta
+    exceeds uint16 (analogue of the reference's 32-MSB boundary rule).
+    """
+    uids = np.asarray(uids, dtype=np.uint32)
+    n = len(uids)
+    if n == 0:
+        return UidPack32(
+            np.zeros(0, np.uint32),
+            np.zeros((0, BLOCK_SIZE - 1), np.uint16),
+            np.zeros(0, np.int32), 0)
+
+    deltas = np.diff(uids.astype(np.uint64)).astype(np.uint32)
+    # A block starts at 0, after every big delta, and at BLOCK_SIZE fill.
+    big = np.flatnonzero(deltas > _MAX_DELTA) + 1
+    starts = [0]
+    next_forced = iter(big.tolist() + [n])
+    forced = next(next_forced)
+    i = 0
+    while i < n:
+        end = min(i + BLOCK_SIZE, n)
+        while forced <= i:
+            forced = next(next_forced)
+        if forced < end:
+            end = forced
+        i = end
+        if i < n:
+            starts.append(i)
+    starts_arr = np.asarray(starts, dtype=np.int64)
+    ends = np.append(starts_arr[1:], n)
+    nb = len(starts_arr)
+
+    bases = uids[starts_arr]
+    counts = (ends - starts_arr).astype(np.int32)
+    dmat = np.zeros((nb, BLOCK_SIZE - 1), dtype=np.uint16)
+    for bi in range(nb):
+        s, e = starts_arr[bi], ends[bi]
+        if e - s > 1:
+            dmat[bi, : e - s - 1] = deltas[s : e - 1].astype(np.uint16)
+    return UidPack32(bases, dmat, counts, n)
+
+
+def decode_padded(pack: UidPack32, size: int) -> jax.Array:
+    """UidPack32 -> padded sorted UID vector of static length `size`.
+
+    Ref: codec.Decode / Decoder.unpackBlock (codec/codec.go:319,128).
+    One cumsum over the delta matrix; padding slots become SENTINEL via the
+    per-block count mask, then one sort re-establishes the invariant.
+    """
+    bases = jnp.asarray(pack.bases, dtype=jnp.uint32)
+    deltas = jnp.asarray(pack.deltas, dtype=jnp.uint32)
+    counts = jnp.asarray(pack.counts, dtype=jnp.int32)
+    if bases.shape[0] == 0:
+        return jnp.full((size,), SENTINEL, dtype=jnp.uint32)
+    # [B, BLOCK_SIZE]: base, base+d0, base+d0+d1, ...
+    csum = jnp.cumsum(deltas, axis=1, dtype=jnp.uint32)
+    vals = jnp.concatenate([bases[:, None], bases[:, None] + csum], axis=1)
+    lane = jnp.arange(vals.shape[1], dtype=jnp.int32)[None, :]
+    vals = jnp.where(lane < counts[:, None], vals, SENTINEL)
+    flat = compact(vals.reshape(-1))
+    if flat.shape[0] >= size:
+        return flat[:size]
+    return jnp.concatenate(
+        [flat, jnp.full((size - flat.shape[0],), SENTINEL, dtype=jnp.uint32)])

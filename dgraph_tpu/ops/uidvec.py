@@ -1,0 +1,146 @@
+"""Sorted-UID vector kernels — the TPU equivalent of the reference's
+``algo/uidlist.go`` (IntersectWith/IntersectSorted/MergeSorted/Difference,
+ref algo/uidlist.go:137,287,354,322) and the decode side of
+``codec/codec.go``.
+
+Representation
+--------------
+A UID set lives on device as a 1-D ``uint32`` array of static length in
+which valid UIDs are sorted ascending and all padding slots hold
+``SENTINEL`` (0xFFFFFFFF).  Because the sentinel is the maximum value, the
+*whole* array is sorted — every kernel below exploits that invariant:
+
+  * membership is one vectorized binary search (``searchsorted``),
+  * compaction after masking is one ``sort``,
+  * k-way merge is concat + sort + adjacent-unique (no heap — the
+    reference's uint64Heap at algo/heap.go:39 becomes a single XLA sort,
+    which maps onto the TPU's sorting networks instead of branchy
+    pointer-chasing).
+
+UID width: the reference uses uint64 UIDs. On TPU, 64-bit integer ops are
+emulated, so the device plane works in uint32 with a per-tablet 32-bit base
+(the reference's own UidPack blocks guarantee a shared high word — the
+"32 MSB block boundary" rule at codec/codec.go:43-109 — so this matches its
+design, not just its behavior).  The host layer (storage/) owns full-width
+UIDs and rebases before upload.  0xFFFFFFFF is reserved as padding and may
+not be a live UID low-word.
+
+All functions are pure and shape-polymorphic only in the Python sense: each
+distinct input length compiles once.  Callers should bucket lengths to
+powers of two (see pad_to) to bound recompiles.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+UID_DTYPE = jnp.uint32
+SENTINEL = np.uint32(0xFFFFFFFF)
+
+
+def _ceil_pow2(n: int) -> int:
+    if n <= 1:
+        return 1
+    return 1 << (int(n - 1).bit_length())
+
+
+def pad_to(n: int, minimum: int = 8) -> int:
+    """Bucketed padded length for a set of n UIDs: next power of two,
+    floored at `minimum`. Bounds the number of distinct compiled shapes."""
+    return max(minimum, _ceil_pow2(n))
+
+
+def from_numpy(uids: np.ndarray, size: int | None = None) -> jax.Array:
+    """Host sorted uint32 UIDs -> padded device vector."""
+    uids = np.asarray(uids, dtype=np.uint32)
+    if size is None:
+        size = pad_to(len(uids))
+    if len(uids) > size:
+        raise ValueError(f"{len(uids)} uids exceed padded size {size}")
+    out = np.full(size, SENTINEL, dtype=np.uint32)
+    out[: len(uids)] = uids
+    return jnp.asarray(out)
+
+
+def to_numpy(vec: jax.Array) -> np.ndarray:
+    """Padded device vector -> compact host numpy array (drops padding)."""
+    arr = np.asarray(vec)
+    return arr[arr != SENTINEL]
+
+
+def count(a: jax.Array) -> jax.Array:
+    """Number of valid UIDs. Ref: codec.ExactLen (codec/codec.go:334)."""
+    return jnp.sum(a != SENTINEL, dtype=jnp.int32)
+
+
+def compact(a: jax.Array) -> jax.Array:
+    """Re-establish the sorted/padded invariant after masking: one sort."""
+    return jnp.sort(a)
+
+
+def member_mask(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Boolean mask over `a`: a[i] valid and present in `b`.
+
+    Vectorized binary search replaces the reference's per-pair
+    lin/jump/bin strategy switch (algo/uidlist.go:151-159): on TPU the
+    branch-free searchsorted wins at every size ratio.
+    """
+    idx = jnp.searchsorted(b, a)
+    idx = jnp.clip(idx, 0, b.shape[0] - 1)
+    hit = b[idx] == a
+    return hit & (a != SENTINEL)
+
+
+def intersect(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Sorted-set intersection. Ref algo.IntersectWith (algo/uidlist.go:137).
+
+    Result has a's static length.
+    """
+    keep = member_mask(a, b)
+    return compact(jnp.where(keep, a, SENTINEL))
+
+
+def difference(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a \\ b. Ref algo.Difference (algo/uidlist.go:322)."""
+    drop = member_mask(a, b)
+    return compact(jnp.where(drop, SENTINEL, a))
+
+
+def union(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Sorted-set union with dedup. Ref algo.MergeSorted
+    (algo/uidlist.go:354). Result length = |a|+|b| (static)."""
+    return merge_many(jnp.concatenate([a, b]).reshape(1, -1))
+
+
+def merge_many(mat: jax.Array) -> jax.Array:
+    """K-way merge + dedup of k padded rows -> one padded vector of length
+    k*n.  Ref algo.MergeSorted's uint64Heap loop (algo/uidlist.go:354,
+    algo/heap.go:39) re-designed as sort + adjacent-unique."""
+    flat = jnp.sort(mat.reshape(-1))
+    prev = jnp.concatenate([jnp.full((1,), SENTINEL, dtype=flat.dtype), flat[:-1]])
+    first_occurrence = flat != prev
+    return compact(jnp.where(first_occurrence, flat, SENTINEL))
+
+
+def intersect_many(mat: jax.Array) -> jax.Array:
+    """Intersection of k padded rows (k static).  Ref algo.IntersectSorted
+    (algo/uidlist.go:287), which intersects smallest-first; on device we
+    fold pairwise — each fold is one searchsorted+sort, and XLA fuses the
+    masking."""
+    k = mat.shape[0]
+    acc = mat[0]
+    for i in range(1, k):
+        acc = intersect(acc, mat[i])
+    return acc
+
+
+def first_k(a: jax.Array, k: int, offset: int = 0) -> jax.Array:
+    """Pagination: first k valid UIDs after `offset`. Ref algo.IndexOf-based
+    windowing used by query pagination (query/query.go:2231).  The input is
+    compact-sorted so this is a lax.dynamic_slice in disguise; with static
+    offset it is a plain slice."""
+    sl = jax.lax.dynamic_slice_in_dim(a, offset, min(k, a.shape[0]))
+    return sl

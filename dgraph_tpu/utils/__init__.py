@@ -1,0 +1,1 @@
+"""Utilities: key codec, config, metrics, tracing."""

@@ -1,0 +1,43 @@
+"""Test config: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the reference's approach of testing multi-node topologies on one
+machine (docker-compose, SURVEY §4.5) — here the "cluster" is 8 virtual XLA
+CPU devices, so sharding/collective code paths compile and run in CI
+without TPU hardware.
+"""
+
+import os
+import sys
+
+# Hard override: the ambient environment pins JAX_PLATFORMS=axon (the real
+# TPU tunnel); tests must run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# This box has one CPU core and slow XLA compiles; a persistent compile
+# cache makes repeat test runs cheap.
+import jax  # noqa: E402
+
+# The ambient axon TPU plugin (registered by sitecustomize) gets initialized
+# by jax's backends() even under JAX_PLATFORMS=cpu, and blocks tests whenever
+# the single-chip tunnel is busy/wedged. Tests are CPU-only by design —
+# deregister the factory outright.
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+_xb._backend_factories.pop("tpu", None)
+
+# sitecustomize imports jax before this conftest runs, so the ambient
+# JAX_PLATFORMS=axon is already latched into jax.config — override it here.
+jax.config.update("jax_platforms", "cpu")
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(_REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)

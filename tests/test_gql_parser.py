@@ -1,0 +1,192 @@
+"""Parser tests — table-driven, modeled on the reference's
+gql/parser_test.go suite (5k lines of cases; we start with the core)."""
+
+import pytest
+
+from dgraph_tpu.gql import GQLError, parse
+from dgraph_tpu.gql.ast import UID_VAR, VALUE_VAR
+
+
+def test_simple_block():
+    r = parse('{ me(func: eq(name, "Alice")) { name age } }')
+    assert len(r.queries) == 1
+    q = r.queries[0]
+    assert q.alias == "me"
+    assert q.func.name == "eq"
+    assert q.func.attr == "name"
+    assert q.func.args[0].value == "Alice"
+    assert [c.attr for c in q.children] == ["name", "age"]
+
+
+def test_uid_root_and_pagination():
+    r = parse("{ q(func: uid(0x1, 0x2, 5), first: 10, offset: 3, after: 0x1) { uid } }")
+    q = r.queries[0]
+    assert q.uids == [1, 2, 5]
+    assert q.first == 10 and q.offset == 3 and q.after == 1
+    assert q.children[0].attr == "uid"
+
+
+def test_filter_precedence():
+    r = parse("""{
+      q(func: has(name)) @filter(eq(a, 1) OR eq(b, 2) AND NOT eq(c, 3)) { name }
+    }""")
+    f = r.queries[0].filter
+    assert f.op == "or"
+    assert f.children[0].func.attr == "a"
+    and_node = f.children[1]
+    assert and_node.op == "and"
+    assert and_node.children[0].func.attr == "b"
+    assert and_node.children[1].op == "not"
+
+
+def test_nested_with_args_order_lang():
+    r = parse("""{
+      q(func: anyofterms(name, "hello world"), orderasc: age) {
+        friend (first: 5, orderdesc: name) @filter(gt(age, 18)) {
+          name@en:fr
+        }
+      }
+    }""")
+    q = r.queries[0]
+    assert q.order[0].attr == "age" and not q.order[0].desc
+    fr = q.children[0]
+    assert fr.attr == "friend" and fr.first == 5
+    assert fr.order[0].attr == "name" and fr.order[0].desc
+    assert fr.children[0].langs == ["en", "fr"]
+
+
+def test_alias_count_and_agg():
+    r = parse("""{
+      q(func: has(friend)) {
+        total: count(friend)
+        c: count(uid)
+        x as age
+        mx: max(val(x))
+      }
+    }""")
+    ch = r.queries[0].children
+    assert ch[0].alias == "total" and ch[0].is_count and ch[0].attr == "friend"
+    assert ch[1].attr == "uid" and ch[1].is_count
+    assert ch[2].var == "x" and ch[2].attr == "age"
+    assert ch[3].agg_func == "max" and ch[3].needs_var[0].name == "x"
+
+
+def test_var_blocks_and_uid_var():
+    r = parse("""{
+      A as var(func: eq(name, "x")) { fr as friend }
+      q(func: uid(A)) @filter(uid(fr)) { name }
+    }""")
+    a, q = r.queries
+    assert a.var == "A"
+    assert a.children[0].var == "fr"
+    assert q.needs_var[0].name == "A" and q.needs_var[0].typ == UID_VAR
+    assert q.filter.func.needs_var[0].name == "fr"
+
+
+def test_value_var_in_func():
+    r = parse("""{
+      v as var(func: has(age)) { a as age }
+      q(func: ge(val(a), 18)) { uid }
+    }""")
+    q = r.queries[1]
+    assert q.func.is_value_var
+    assert q.func.needs_var[0] .typ == VALUE_VAR
+    assert len(r.queries[0].children) == 1
+    assert v_used(r.queries[0])
+
+
+def v_used(q):
+    return q.var == "v"
+
+
+def test_graphql_vars():
+    r = parse(
+        "query test($name: string, $lim: int = 2) "
+        "{ q(func: eq(name, $name), first: $lim) { name } }",
+        variables={"name": "Bob"})
+    q = r.queries[0]
+    assert q.func.args[0].value == "Bob"
+    assert q.first == 2
+
+
+def test_fragments():
+    r = parse("""
+      { q(func: has(name)) { ...common friend { ...common } } }
+      fragment common { name age }
+    """)
+    q = r.queries[0]
+    assert [c.attr for c in q.children] == ["name", "age", "friend"]
+    assert [c.attr for c in q.children[2].children] == ["name", "age"]
+
+
+def test_recurse_cascade_normalize():
+    r = parse("""{
+      q(func: uid(0x1)) @recurse(depth: 5, loop: true) @normalize {
+        name friend
+      }
+    }""")
+    q = r.queries[0]
+    assert q.recurse.depth == 5 and q.recurse.allow_loop
+    assert q.normalize
+
+
+def test_shortest_block():
+    r = parse("""{
+      path as shortest(from: 0x1, to: 0x31, numpaths: 2) { friend }
+      q(func: uid(path)) { name }
+    }""")
+    p = r.queries[0]
+    assert p.attr == "shortest" and p.var == "path"
+    assert p.shortest.from_.uids == [1]
+    assert p.shortest.to.uids == [0x31]
+    assert p.shortest.numpaths == 2
+
+
+def test_groupby():
+    r = parse("""{
+      q(func: uid(0x1)) {
+        friend @groupby(age) { count(uid) }
+      }
+    }""")
+    fr = r.queries[0].children[0]
+    assert fr.is_groupby and fr.groupby[0].attr == "age"
+
+
+def test_expand_all():
+    r = parse("{ q(func: uid(0x1)) { expand(_all_) { uid } } }")
+    assert r.queries[0].children[0].expand == "_all_"
+
+
+def test_facets():
+    r = parse("""{
+      q(func: uid(1)) {
+        friend @facets(close) @facets(eq(close, true)) { name @facets }
+      }
+    }""")
+    fr = r.queries[0].children[0]
+    assert fr.facets.keys == [("close", "close")]
+    assert fr.facets_filter.func.name == "eq"
+    assert fr.children[0].facets.all_keys
+
+
+def test_math_block():
+    r = parse("""{
+      q(func: uid(1)) {
+        a as age
+        combined: math(a * 2 + 1)
+      }
+    }""")
+    m = r.queries[0].children[1].math
+    assert m.fn == "+"
+    assert m.children[0].fn == "*"
+
+
+def test_errors():
+    with pytest.raises(GQLError):
+        parse("{ q(func: eq(name, $x)) { name } }")  # undefined var
+    with pytest.raises(GQLError):
+        parse("{ q(func: unknownarg: 3) { x } }")
+    with pytest.raises(GQLError):
+        parse("{ q(func: has(name)) @filter( { x } }")
+    with pytest.raises(GQLError):
+        parse("{ ...missing }")

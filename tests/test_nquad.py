@@ -1,0 +1,69 @@
+"""RDF / JSON mutation parsing tests. Ref: chunker/rdf_parser_test.go,
+chunker/json_parser_test.go."""
+
+from dgraph_tpu.gql import parse_rdf, parse_json_mutation
+from dgraph_tpu.models.types import TypeID
+
+
+def test_rdf_basic():
+    nqs = parse_rdf("""
+      <0x1> <name> "Alice" .
+      _:b <age> "23"^^<xs:int> .
+      <0x1> <friend> _:b .
+      # comment line
+      <0x1> <desc> "say \\"hi\\"" .
+    """)
+    assert len(nqs) == 4
+    assert nqs[0].subject == "0x1" and nqs[0].object_value.value == "Alice"
+    assert nqs[1].object_value.tid == TypeID.INT
+    assert nqs[1].object_value.value == 23
+    assert nqs[2].object_id == "_:b"
+    assert nqs[3].object_value.value == 'say "hi"'
+
+
+def test_rdf_lang_and_star():
+    nqs = parse_rdf("""
+      <0x1> <name> "Alicia"@es .
+      <0x1> <name> * .
+    """)
+    assert nqs[0].lang == "es"
+    assert nqs[1].star
+
+
+def test_rdf_facets():
+    nqs = parse_rdf('<0x1> <friend> <0x2> (close=true, since=2006) .')
+    assert nqs[0].facets["close"].value is True
+    assert nqs[0].facets["since"].value == 2006
+
+
+def test_json_mutation():
+    nqs = parse_json_mutation({
+        "uid": "0x1",
+        "name": "Alice",
+        "name@en": "Alice",
+        "age": 23,
+        "friend": [{"uid": "0x2", "name": "Bob"}, {"name": "Carol"}],
+    })
+    by_pred = {}
+    for nq in nqs:
+        by_pred.setdefault(nq.predicate, []).append(nq)
+    assert by_pred["age"][0].object_value.tid == TypeID.INT
+    assert len(by_pred["friend"]) == 2
+    assert by_pred["friend"][0].object_id == "0x2"
+    assert by_pred["friend"][1].object_id.startswith("_:")
+    assert any(nq.lang == "en" for nq in by_pred["name"])
+    # nested node's own value emitted
+    assert any(nq.subject == "0x2" and nq.predicate == "name" for nq in nqs)
+
+
+def test_json_facets_and_delete():
+    nqs = parse_json_mutation({
+        "uid": "0x1",
+        "friend": {"uid": "0x2"},
+        "friend|close": True,
+    })
+    fr = [nq for nq in nqs if nq.predicate == "friend"][0]
+    assert fr.facets["close"].value is True
+
+    dels = parse_json_mutation({"uid": "0x1", "name": None}, delete=True)
+    assert dels[0].star

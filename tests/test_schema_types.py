@@ -1,0 +1,120 @@
+"""Schema parser + type conversion + tokenizer tests.
+Ref: schema/parse_test.go, types/conversion_test.go, tok/tok_test.go."""
+
+import datetime
+
+import pytest
+
+from dgraph_tpu.models.schema import SchemaState, parse_schema
+from dgraph_tpu.models.tokenizer import get_tokenizer, tokens_for
+from dgraph_tpu.models.types import TypeID, Val, convert, sort_key
+
+
+def test_schema_basic():
+    preds, types = parse_schema("""
+      # people
+      name: string @index(term, exact) @lang .
+      age: int @index(int) .
+      friend: [uid] @reverse @count .
+      score: float .
+      active: bool @index(bool) .
+      birth: datetime @index(year) .
+      loc: geo @index(geo) .
+      <with-dash>: string .
+    """)
+    bypred = {p.predicate: p for p in preds}
+    assert bypred["name"].tokenizers == ["term", "exact"]
+    assert bypred["name"].lang
+    assert bypred["friend"].list_ and bypred["friend"].reverse
+    assert bypred["friend"].count
+    assert bypred["age"].value_type == TypeID.INT
+    assert "with-dash" in bypred
+    assert not types
+
+
+def test_schema_typedef():
+    preds, types = parse_schema("""
+      name: string .
+      type Person { name friend }
+    """)
+    assert types[0].name == "Person"
+    assert types[0].fields == ["name", "friend"]
+
+
+def test_schema_errors():
+    with pytest.raises(ValueError):
+        parse_schema("name: string @index .")  # string needs tokenizer args
+    with pytest.raises(ValueError):
+        parse_schema("name: string @reverse .")  # reverse is uid-only
+    with pytest.raises(ValueError):
+        parse_schema("name: nosuchtype .")
+    with pytest.raises(ValueError):
+        parse_schema("age: int @index(term) .")  # tokenizer/type mismatch
+
+
+def test_schema_state_accessors():
+    st = SchemaState()
+    st.apply_text("name: string @index(exact) .\nfriend: [uid] @reverse .")
+    assert st.is_indexed("name")
+    assert st.is_reversed("friend")
+    assert st.is_list("friend")
+    assert not st.is_indexed("friend")
+    assert st.has("dgraph.type")  # initial schema present
+
+
+def test_conversions():
+    assert convert(Val(TypeID.STRING, "42"), TypeID.INT).value == 42
+    assert convert(Val(TypeID.INT, 3), TypeID.FLOAT).value == 3.0
+    assert convert(Val(TypeID.FLOAT, 2.7), TypeID.INT).value == 2
+    assert convert(Val(TypeID.STRING, "true"), TypeID.BOOL).value is True
+    d = convert(Val(TypeID.STRING, "2006-01-02T15:04:05"), TypeID.DATETIME)
+    assert d.value.year == 2006
+    with pytest.raises(ValueError):
+        convert(Val(TypeID.BOOL, True), TypeID.DATETIME)
+
+
+def test_sort_keys_monotone():
+    vals = [-3.5, -1.0, 0.0, 0.5, 2.25, 1e300]
+    keys = [sort_key(Val(TypeID.FLOAT, v)) for v in vals]
+    assert keys == sorted(keys)
+    svals = ["", "a", "ab", "b", "ba"]
+    skeys = [sort_key(Val(TypeID.STRING, s)) for s in svals]
+    assert skeys == sorted(skeys)
+    for k in keys + skeys:
+        assert -(1 << 63) <= k < (1 << 63)
+
+
+def test_term_tokenizer():
+    t = get_tokenizer("term")
+    toks = tokens_for(Val(TypeID.STRING, "Héllo, the World! hello"), t)
+    assert toks == ["hello", "the", "world"]
+
+
+def test_fulltext_tokenizer():
+    t = get_tokenizer("fulltext")
+    toks = tokens_for(Val(TypeID.STRING, "The runner was running races"), t)
+    assert "the" not in toks and "was" not in toks
+    assert any(x.startswith("runn") or x == "run" for x in toks)
+
+
+def test_trigram_tokenizer():
+    t = get_tokenizer("trigram")
+    assert tokens_for(Val(TypeID.STRING, "abcd"), t) == ["abc", "bcd"]
+
+
+def test_datetime_bucket_tokenizers():
+    v = Val(TypeID.DATETIME, datetime.datetime(2020, 3, 14, 15, 9))
+    assert tokens_for(v, get_tokenizer("year")) == [2020]
+    assert tokens_for(v, get_tokenizer("month")) == [202003]
+    assert tokens_for(v, get_tokenizer("day")) == [20200314]
+    assert tokens_for(v, get_tokenizer("hour")) == [2020031415]
+
+
+def test_int_tokenizer_converts():
+    assert tokens_for(Val(TypeID.STRING, "7"), get_tokenizer("int")) == [7]
+
+
+def test_geo_tokenizer_point():
+    v = Val(TypeID.GEO, {"type": "Point", "coordinates": [-122.4, 37.7]})
+    toks = tokens_for(v, get_tokenizer("geo"))
+    assert toks and all("/" in t for t in toks)
