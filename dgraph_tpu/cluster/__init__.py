@@ -1,0 +1,5 @@
+"""Cluster control plane: coordinator (Zero-equivalent), membership,
+replication. Round 1 ships the in-process coordinator; the gRPC/DCN
+service wrapping and Raft replication layer over it."""
+
+from dgraph_tpu.cluster.coordinator import Coordinator, TxnAborted

@@ -1,0 +1,136 @@
+"""Coordinator: timestamps, UID leases, transaction oracle, tablet map.
+
+Re-provides Dgraph Zero's core services (dgraph/cmd/zero/):
+  - monotonically increasing timestamps     (zero/assign.go:64 lease)
+  - UID block leases                        (zero/assign.go:158 AssignUids)
+  - commit/abort with conflict detection    (zero/oracle.go:326 commit,
+                                             oracle.go:76 hasConflict)
+  - tablet -> group ownership               (zero/zero.go:564 ShouldServe)
+
+Design difference from the reference: Zero is a separate Raft-replicated
+process streaming OracleDeltas to every Alpha group
+(zero/oracle.go:432). Here the coordinator is a small passive object the
+engine calls synchronously; the cluster layer wraps it in a DCN service
+and Raft once multi-host lands. The conflict-detection semantics are
+identical: a txn T aborts iff some key it wrote was committed by another
+txn with commitTs > T.startTs.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+class TxnAborted(Exception):
+    """Transaction aborted due to conflict (ref x.ErrConflict /
+    pb.TxnContext.Aborted)."""
+
+
+@dataclass
+class TxnState:
+    start_ts: int
+    conflict_keys: set = field(default_factory=set)
+    committed: bool = False
+    aborted: bool = False
+
+
+class Coordinator:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ts = 0              # last issued timestamp
+        self._next_uid = 1
+        # conflict window: key fingerprint -> last commit_ts
+        self._commits: dict[int, int] = {}
+        self._active: dict[int, TxnState] = {}
+        self._min_active: int = 0
+        # tablet map: predicate -> group id (single group 1 in round 1)
+        self.tablets: dict[str, int] = {}
+        self.groups: set[int] = {1}
+
+    # -- timestamps (ref zero/assign.go:64) --
+
+    def next_ts(self) -> int:
+        with self._lock:
+            self._ts += 1
+            return self._ts
+
+    def max_assigned(self) -> int:
+        return self._ts
+
+    # -- uid leases (ref zero/assign.go:158) --
+
+    def assign_uids(self, n: int) -> tuple[int, int]:
+        """Lease [first, last] inclusive."""
+        with self._lock:
+            first = self._next_uid
+            self._next_uid += n
+            return first, self._next_uid - 1
+
+    def bump_uids(self, to: int):
+        with self._lock:
+            self._next_uid = max(self._next_uid, to + 1)
+
+    # -- transactions (ref zero/oracle.go) --
+
+    def begin(self) -> TxnState:
+        with self._lock:
+            self._ts += 1
+            st = TxnState(start_ts=self._ts)
+            self._active[st.start_ts] = st
+            return st
+
+    def commit(self, txn: TxnState, conflict_keys: set) -> int:
+        """Conflict-check and commit; returns commit_ts.
+        Raises TxnAborted on conflict (ref zero/oracle.go:326 s.commit)."""
+        with self._lock:
+            st = self._active.get(txn.start_ts)
+            if st is None or st.aborted:
+                raise TxnAborted(f"txn {txn.start_ts} not active")
+            for key in conflict_keys:
+                last = self._commits.get(key, 0)
+                if last > txn.start_ts:
+                    st.aborted = True
+                    del self._active[txn.start_ts]
+                    raise TxnAborted(
+                        f"conflict on key {key:#x}: committed at {last} > "
+                        f"start {txn.start_ts}")
+            self._ts += 1
+            commit_ts = self._ts
+            for key in conflict_keys:
+                self._commits[key] = commit_ts
+            st.committed = True
+            del self._active[txn.start_ts]
+            return commit_ts
+
+    def abort(self, txn: TxnState):
+        with self._lock:
+            st = self._active.pop(txn.start_ts, None)
+            if st:
+                st.aborted = True
+
+    def min_active_ts(self) -> int:
+        """Rollup watermark: everything <= this is safe to fold
+        (ref worker/draft.go:1206 calculateSnapshot picking a ReadTs
+        below all pending txns)."""
+        with self._lock:
+            if self._active:
+                return min(self._active) - 1
+            return self._ts
+
+    def gc_conflicts(self):
+        """Drop conflict entries older than every active txn."""
+        with self._lock:
+            floor = min(self._active) if self._active else self._ts
+            self._commits = {k: v for k, v in self._commits.items()
+                             if v >= floor}
+
+    # -- tablet ownership (ref zero/zero.go:564 ShouldServe) --
+
+    def should_serve(self, pred: str, group: int = 1) -> int:
+        with self._lock:
+            gid = self.tablets.get(pred)
+            if gid is None:
+                gid = group
+                self.tablets[pred] = gid
+            return gid

@@ -1,0 +1,8 @@
+"""Single-process engine: the Alpha-equivalent.
+
+Ties together schema, tablets, the coordinator, the WAL and the query
+executor behind the reference's api.Dgraph surface (edgraph/server.go):
+Alter / Mutate / Query / CommitOrAbort.
+"""
+
+from dgraph_tpu.engine.db import GraphDB, Txn

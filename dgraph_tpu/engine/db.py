@@ -1,0 +1,399 @@
+"""GraphDB: the single-process engine (Alpha-equivalent).
+
+API surface mirrors the reference's api.Dgraph service as implemented by
+edgraph/server.go: Alter (server.go:76), Query/Mutate via doQuery
+(server.go:634-731, :220 doMutate), CommitOrAbort (server.go:920) — as
+Python methods instead of gRPC handlers (the serving layer wraps this).
+
+Mutation semantics ported from behavior (not structure):
+  - blank nodes get fresh leased uids (query/mutation.go:114 AssignUids)
+  - edges route to per-predicate tablets (worker/mutation.go:472
+    populateMutationMap)
+  - conflict keys fingerprint (pred, src uid) — or (pred, index token)
+    for @upsert predicates (posting/index.go:305 addMutationHelper)
+  - commit assigns commit_ts at the coordinator, then the apply loop
+    stamps tablet deltas (worker/draft.go:435 processApplyCh ordering)
+  - an overwrite of a single-valued indexed predicate emits index deletes
+    for the old value's tokens (posting/index.go:83 addIndexMutations)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from dgraph_tpu.cluster.coordinator import Coordinator, TxnAborted
+from dgraph_tpu.gql import parse as gql_parse
+from dgraph_tpu.gql.nquad import NQuad, parse_json_mutation, parse_rdf
+from dgraph_tpu.models.schema import (
+    PredicateSchema, SchemaState, TypeDef,
+)
+from dgraph_tpu.models.types import TypeID, Val, convert
+from dgraph_tpu.storage.tablet import EdgeOp, Posting, Tablet
+from dgraph_tpu.storage.wal import Wal
+
+
+def _fp(*parts) -> int:
+    h = hashlib.blake2b(digest_size=8)
+    for p in parts:
+        if isinstance(p, bytes):
+            h.update(p)
+        else:
+            h.update(str(p).encode())
+        h.update(b"\x1f")
+    return int.from_bytes(h.digest(), "big")
+
+
+@dataclass
+class Txn:
+    """Client-side transaction handle. Ref: dgo txn / pb.TxnContext."""
+
+    start_ts: int
+    _state: Any = None
+    staged: list[tuple[str, EdgeOp]] = field(default_factory=list)
+    conflict_keys: set = field(default_factory=set)
+    uid_map: dict[str, int] = field(default_factory=dict)  # blank -> uid
+    done: bool = False
+
+
+@dataclass
+class Latency:
+    """Per-phase latency returned with every response
+    (ref api.Latency, edgraph/server.go:717)."""
+
+    parsing_ns: int = 0
+    processing_ns: int = 0
+    encoding_ns: int = 0
+    assign_ts_ns: int = 0
+
+    def as_dict(self):
+        return {"parsing_ns": self.parsing_ns,
+                "processing_ns": self.processing_ns,
+                "encoding_ns": self.encoding_ns,
+                "assign_timestamp_ns": self.assign_ts_ns}
+
+
+class GraphDB:
+    def __init__(self, wal_path: str | None = None,
+                 prefer_device: bool = True,
+                 device_min_edges: int = 1024):
+        self.schema = SchemaState()
+        self.coordinator = Coordinator()
+        self.tablets: dict[str, Tablet] = {}
+        self.prefer_device = prefer_device
+        self.device_min_edges = device_min_edges
+        self.wal = Wal(wal_path) if wal_path else None
+        if self.wal:
+            self._replay()
+
+    # ------------------------------------------------------------------
+    # Alter (ref edgraph/server.go:76)
+    # ------------------------------------------------------------------
+
+    def alter(self, schema_text: str = "", drop_all: bool = False,
+              drop_attr: str = ""):
+        if drop_all:
+            self.tablets.clear()
+            self.schema = SchemaState()
+            if self.wal:
+                self.wal.truncate()
+                self.wal.append(("drop_all",))
+            return
+        if drop_attr:
+            self.tablets.pop(drop_attr, None)
+            self.schema.delete_predicate(drop_attr)
+            if self.wal:
+                self.wal.append(("drop_attr", drop_attr))
+            return
+        preds, types = self.schema.apply_text(schema_text)
+        for ps in preds:
+            t = self.tablets.get(ps.predicate)
+            if t is not None:
+                old = t.schema
+                t.schema = ps
+                # index/reverse definition changed -> rebuild
+                # (ref posting/index.go:601 IndexRebuild.Run)
+                t.rollup(self.coordinator.min_active_ts())
+                if (old.indexed, tuple(old.tokenizers)) != \
+                        (ps.indexed, tuple(ps.tokenizers)):
+                    t.rebuild_index()
+                if old.reverse != ps.reverse:
+                    t.rebuild_reverse()
+        if self.wal:
+            self.wal.append(("alter", schema_text))
+
+    # ------------------------------------------------------------------
+    # Transactions
+    # ------------------------------------------------------------------
+
+    def new_txn(self) -> Txn:
+        st = self.coordinator.begin()
+        return Txn(start_ts=st.start_ts, _state=st)
+
+    def mutate(self, txn: Optional[Txn] = None, *,
+               set_nquads: str = "", del_nquads: str = "",
+               set_json: Any = None, delete_json: Any = None,
+               commit_now: bool = False) -> dict:
+        """Stage (and optionally commit) a mutation.
+        Returns {"uids": {...}} like the reference's api.Assigned."""
+        own = txn is None
+        if txn is None:
+            txn = self.new_txn()
+        nqs: list[tuple[NQuad, bool]] = []
+        if set_nquads:
+            nqs += [(n, False) for n in parse_rdf(set_nquads)]
+        if set_json is not None:
+            nqs += [(n, False) for n in parse_json_mutation(set_json)]
+        if del_nquads:
+            nqs += [(n, True) for n in parse_rdf(del_nquads)]
+        if delete_json is not None:
+            nqs += [(n, True)
+                    for n in parse_json_mutation(delete_json, delete=True)]
+        self._stage(txn, nqs)
+        if commit_now or own:
+            self.commit(txn)
+        return {"uids": {k[2:]: hex(v) for k, v in txn.uid_map.items()
+                         if k.startswith("_:")}}
+
+    def _resolve_uid(self, txn: Txn, ref: str) -> int:
+        if ref.startswith("_:"):
+            uid = txn.uid_map.get(ref)
+            if uid is None:
+                uid, _ = self.coordinator.assign_uids(1)
+                txn.uid_map[ref] = uid
+            return uid
+        try:
+            uid = int(ref, 0)
+        except ValueError as e:
+            raise ValueError(
+                f"subject/object must be a uid (0x..), blank node (_:x) "
+                f"or integer, got {ref!r}") from e
+        if uid == 0:
+            raise ValueError("uid 0 is not allowed")
+        self.coordinator.bump_uids(uid)
+        return uid
+
+    def _stage(self, txn: Txn, nqs: list[tuple[NQuad, bool]]):
+        if txn.done:
+            raise TxnAborted("transaction already finished")
+        for nq, is_del in nqs:
+            pred = nq.predicate
+            src = self._resolve_uid(txn, nq.subject)
+            tab = self._tablet_for(pred, nq)
+            if nq.star:
+                if not is_del:
+                    raise ValueError("* object only allowed in delete")
+                op = EdgeOp("del_all", src)
+            elif nq.object_id:
+                if tab.schema.value_type != TypeID.UID:
+                    raise ValueError(
+                        f"predicate {pred!r} is not a uid predicate")
+                dst = self._resolve_uid(txn, nq.object_id)
+                op = EdgeOp("del" if is_del else "set", src, dst=dst,
+                            facets=nq.facets)
+            else:
+                val = nq.object_value
+                if tab.schema.value_type not in (TypeID.DEFAULT,):
+                    val = convert(val, tab.schema.value_type)
+                op = EdgeOp("del" if is_del else "set", src,
+                            posting=Posting(val, nq.lang, nq.facets))
+            txn.staged.append((pred, op))
+            txn.conflict_keys.add(self._conflict_key(tab, op))
+
+    def _conflict_key(self, tab: Tablet, op: EdgeOp) -> int:
+        """Ref posting/index.go:305 addMutationHelper conflict keys:
+        default (pred, src); @upsert indexed preds conflict on
+        (pred, token) so concurrent same-value inserts collide;
+        @noconflict opts out."""
+        if tab.schema.noconflict:
+            return _fp(tab.pred, "noconflict")
+        if tab.schema.upsert and op.posting is not None:
+            toks = tab._tokens(op.posting)
+            if toks:
+                return _fp(tab.pred, toks[0])
+        return _fp(tab.pred, op.src)
+
+    def _tablet_for(self, pred: str, nq: NQuad | None = None) -> Tablet:
+        tab = self.tablets.get(pred)
+        if tab is None:
+            ps = self.schema.get(pred)
+            if ps is None:
+                # mutations define schema on the fly (ref
+                # worker/mutation.go runSchemaMutation for new preds)
+                tid = TypeID.UID if (nq is not None and nq.object_id) \
+                    else (nq.object_value.tid if nq and nq.object_value
+                          else TypeID.DEFAULT)
+                if tid not in (TypeID.UID,):
+                    tid = {TypeID.INT: TypeID.INT,
+                           TypeID.FLOAT: TypeID.FLOAT,
+                           TypeID.BOOL: TypeID.BOOL,
+                           TypeID.DATETIME: TypeID.DATETIME,
+                           TypeID.GEO: TypeID.GEO,
+                           }.get(tid, TypeID.DEFAULT)
+                ps = PredicateSchema(pred, value_type=tid)
+                self.schema.set_predicate(ps)
+            self.coordinator.should_serve(pred)
+            tab = Tablet(pred, ps)
+            self.tablets[pred] = tab
+        return tab
+
+    def commit(self, txn: Txn) -> int:
+        if txn.done:
+            raise TxnAborted("transaction already finished")
+        commit_ts = self.coordinator.commit(txn._state, txn.conflict_keys)
+        txn.done = True
+        expanded = self._expand_ops(commit_ts, txn.staged)
+        for pred, ops in expanded.items():
+            self._tablet_for(pred).apply(commit_ts, ops)
+        if self.wal:
+            # log the *expanded* ops (incl. synthesized old-token deletes)
+            # plus the schema of every touched predicate, so replay is
+            # self-contained even for schema created on the fly
+            schemas = {p: self.schema.get_or_default(p).describe()
+                       for p in expanded}
+            self.wal.append(("commit", commit_ts,
+                             [(p, op) for p, ops in expanded.items()
+                              for op in ops], schemas))
+        return commit_ts
+
+    def discard(self, txn: Txn):
+        if not txn.done:
+            self.coordinator.abort(txn._state)
+            txn.done = True
+
+    def _expand_ops(self, commit_ts: int, staged: list[tuple[str, EdgeOp]]
+                    ) -> dict[str, list[EdgeOp]]:
+        """The apply-loop expansion (ref worker/draft.go:435 processApplyCh
+        → runMutation): single-value overwrites become del(old)+set(new)
+        so index overlays stay exact. Tracks values written earlier in the
+        *same* transaction so a double-set deletes the intermediate
+        value's tokens too."""
+        by_pred: dict[str, list[EdgeOp]] = {}
+        for pred, op in staged:
+            by_pred.setdefault(pred, []).append(op)
+        out: dict[str, list[EdgeOp]] = {}
+        for pred, ops in by_pred.items():
+            tab = self._tablet_for(pred)
+            expanded: list[EdgeOp] = []
+            pending: dict[tuple[int, str], Posting] = {}  # (src, lang)
+            wiped: set[int] = set()
+            for op in ops:
+                if (op.op == "set" and op.posting is not None
+                        and not tab.schema.list_):
+                    key = (op.src, op.posting.lang)
+                    if key in pending:
+                        old = [pending[key]]
+                    elif op.src in wiped:
+                        old = []
+                    else:
+                        old = [p for p in
+                               tab.get_postings(op.src, commit_ts - 1)
+                               if p.lang == op.posting.lang]
+                    for p in old:
+                        expanded.append(EdgeOp("del", op.src, posting=p))
+                    pending[key] = op.posting
+                elif op.op == "del_all":
+                    wiped.add(op.src)
+                    pending = {k: v for k, v in pending.items()
+                               if k[0] != op.src}
+                expanded.append(op)
+            out[pred] = expanded
+        return out
+
+    def _replay(self):
+        max_ts = 0
+        for rec in self.wal.replay():
+            kind = rec[0]
+            if kind == "alter":
+                preds, types = self.schema.apply_text(rec[1])
+                for ps in preds:
+                    t = self.tablets.get(ps.predicate)
+                    if t:
+                        t.schema = ps
+                        t.rebuild_index()
+                        t.rebuild_reverse()
+            elif kind == "drop_all":
+                self.tablets.clear()
+                self.schema = SchemaState()
+            elif kind == "drop_attr":
+                self.tablets.pop(rec[1], None)
+                self.schema.delete_predicate(rec[1])
+            elif kind == "commit":
+                _, commit_ts, staged, schemas = rec
+                # restore on-the-fly schema before creating tablets
+                for pred, desc in schemas.items():
+                    if not self.schema.has(pred):
+                        self.schema.apply_text(desc)
+                for pred, op in staged:
+                    self._tablet_for(pred)
+                max_ts = max(max_ts, commit_ts)
+                by_pred: dict[str, list[EdgeOp]] = {}
+                for pred, op in staged:
+                    by_pred.setdefault(pred, []).append(op)
+                for pred, ops in by_pred.items():
+                    # ops were expanded before logging: apply verbatim
+                    self.tablets[pred].apply(commit_ts, ops)
+                uids = [op.src for _, op in staged] + \
+                       [op.dst for _, op in staged if op.dst]
+                if uids:
+                    self.coordinator.bump_uids(max(uids))
+        if max_ts:
+            # fast-forward the ts counter past everything in the log
+            while self.coordinator.max_assigned() < max_ts:
+                self.coordinator.next_ts()
+
+    # ------------------------------------------------------------------
+    # Query (ref edgraph/server.go:634 Query -> query.Process)
+    # ------------------------------------------------------------------
+
+    def query(self, q: str, variables: dict | None = None,
+              txn: Optional[Txn] = None, best_effort: bool = True) -> dict:
+        from dgraph_tpu.query.executor import Executor
+
+        lat = Latency()
+        t0 = time.perf_counter_ns()
+        parsed = gql_parse(q, variables)
+        lat.parsing_ns = time.perf_counter_ns() - t0
+
+        t0 = time.perf_counter_ns()
+        if txn is not None:
+            read_ts = txn.start_ts
+        elif best_effort:
+            read_ts = self.coordinator.max_assigned()
+        else:
+            read_ts = self.coordinator.next_ts()
+        lat.assign_ts_ns = time.perf_counter_ns() - t0
+
+        t0 = time.perf_counter_ns()
+        ex = Executor(self, read_ts)
+        data = ex.run(parsed)
+        lat.processing_ns = time.perf_counter_ns() - t0
+        return {"data": data, "extensions": {"latency": lat.as_dict()}}
+
+    # -- maintenance --
+
+    def rollup_all(self):
+        wm = self.coordinator.min_active_ts()
+        for tab in self.tablets.values():
+            if tab.dirty():
+                tab.rollup(wm)
+
+    def state(self) -> dict:
+        """Cluster/engine introspection (ref /state handler,
+        edgraph/server.go:602)."""
+        return {
+            "maxAssigned": self.coordinator.max_assigned(),
+            "groups": {str(g): {
+                "tablets": {p: {"predicate": p,
+                                "edges": self.tablets[p].count_edges()
+                                if hasattr(self.tablets[p], 'count_edges')
+                                else None}
+                            for p, gg in self.coordinator.tablets.items()
+                            if gg == g and p in self.tablets}}
+                for g in self.coordinator.groups},
+            "schema": self.schema.describe_all(),
+        }

@@ -1,0 +1,100 @@
+"""Device snapshot management: host tablets -> resident HBM tiles.
+
+The policy mirrors the reference's MVCC read path split (posting/list.go
+immutable layer vs mutation layer): the *rolled-up* committed state lives
+on device; while a tablet has live deltas (posting/mvcc.go mutation
+layers) reads stay on the host overlay. Once rollup folds the overlay
+(watermark = min active ts, ref worker/draft.go:1206), the tablet is
+re-packed and uploaded lazily on first use.
+
+Device tiles are uint32 (rebased): the engine checks the tablet's max
+uid; >32-bit graphs fall back to host until uid-range partitioning
+(parallel/) is wired in — the reference's own UidPack blocks make the
+same 32-bit-low-word assumption per block (codec/codec.go:43).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+from dgraph_tpu.ops.graph import (
+    DeviceAdjacency, build_adjacency, build_values, expand, max_expansion,
+)
+from dgraph_tpu.ops.uidvec import SENTINEL, pad_to, to_numpy
+
+_MAX_U32 = 0xFFFFFFFE  # SENTINEL reserved
+
+
+def device_adjacency(db, tab, read_ts: int) -> Optional[DeviceAdjacency]:
+    if tab.schema.value_type.name != "UID":
+        return None
+    if tab.dirty():
+        wm = db.coordinator.min_active_ts()
+        if wm >= tab.max_commit_ts:
+            tab.rollup(wm)
+        if tab.dirty():
+            return None  # live overlay -> host path
+    if read_ts < tab.base_ts:
+        return None  # snapshot is newer than this read
+    adj = getattr(tab, "_device_adj", None)
+    if adj is not None and tab._device_ts == tab.base_ts:
+        return adj
+    n_edges = sum(len(v) for v in tab.edges.values())
+    if n_edges < db.device_min_edges:
+        return None
+    edges32 = {}
+    for src, dst in tab.edges.items():
+        if src > _MAX_U32 or (len(dst) and int(dst[-1]) > _MAX_U32):
+            return None
+        edges32[int(src)] = dst.astype(np.uint32)
+    adj = build_adjacency(edges32)
+    tab._device_adj = adj
+    tab._device_ts = tab.base_ts
+    tab._expander_cache = {}
+    return adj
+
+
+def device_values(db, tab, read_ts: int):
+    """Sortable value view for order-by / inequality offload."""
+    if tab.dirty() or read_ts < tab.base_ts:
+        return None
+    dv = getattr(tab, "_device_values", None)
+    if dv is not None and getattr(tab, "_device_values_ts", -1) == tab.base_ts:
+        return dv
+    pairs = tab.sort_key_pairs()
+    if len(pairs) < db.device_min_edges:
+        return None
+    if pairs and max(pairs) > _MAX_U32:
+        return None
+    dv = build_values(pairs)
+    tab._device_values = dv
+    tab._device_values_ts = tab.base_ts
+    return dv
+
+
+def expand_np(adj: DeviceAdjacency, src_u64: np.ndarray) -> np.ndarray:
+    """Host frontier -> device expand -> host result.
+
+    The jitted expander is cached per (frontier bucket size) on the
+    adjacency object, so repeated traversal levels reuse compiled code.
+    """
+    # uids beyond uint32 cannot exist in a <=32-bit tablet: drop them
+    # instead of letting astype(uint32) alias them onto real low uids
+    src_u64 = src_u64[src_u64 <= _MAX_U32]
+    f_pad = pad_to(len(src_u64))
+    cache = getattr(adj, "_expander_cache", None)
+    if cache is None:
+        cache = adj._expander_cache = {}
+    fn = cache.get(f_pad)
+    if fn is None:
+        out_size = max_expansion(adj, f_pad)
+        fn = jax.jit(lambda fr: expand(adj, fr, out_size))
+        cache[f_pad] = fn
+    fr = np.full(f_pad, SENTINEL, np.uint32)
+    fr[: len(src_u64)] = src_u64.astype(np.uint32)
+    res = fn(jax.numpy.asarray(fr))
+    return to_numpy(res).astype(np.uint64)

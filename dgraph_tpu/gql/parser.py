@@ -630,12 +630,17 @@ def _parse_selection(cur: Cursor, gvars: dict) -> GraphQuery:
 
 
 def _parse_langs(cur: Cursor) -> list[str]:
-    langs = [cur.expect("name", "language").val]
-    while cur.accept("colon"):
-        langs.append(cur.expect("name", "language").val)
-    # `name@.` — any language fallback — lexes name then dot
-    while cur.accept("dot"):
+    # `name@en:fr`, `name@.` (any-language fallback), `name@en:.`
+    langs = []
+    if cur.accept("dot"):
         langs.append(".")
+    else:
+        langs.append(cur.expect("name", "language").val)
+    while cur.accept("colon"):
+        if cur.accept("dot"):
+            langs.append(".")
+        else:
+            langs.append(cur.expect("name", "language").val)
     return langs
 
 

@@ -1,0 +1,239 @@
+"""Device-resident adjacency + expansion kernels.
+
+This is the TPU re-design of the reference's posting-list fan-out hot loop
+(worker/task.go:581 handleUidPostings: per-UID goroutines doing Badger
+reads + codec decode + per-list intersect). Here a whole predicate
+("tablet") lives in HBM as degree-bucketed padded neighbor matrices, and
+one jitted call expands an entire frontier level:
+
+    rows    = searchsorted(bucket.src, frontier)        (vectorized lookup)
+    cand    = bucket.neighbors[rows]                    (one batched gather)
+    next    = sort+unique(concat over buckets)          (merge)
+
+Degree bucketing bounds padding waste: a src uid lands in the bucket whose
+width is the next power of two >= its degree, so padding is < 2x and each
+bucket's gather is a dense [F, D] tile — MXU/VPU-friendly, no ragged
+shapes inside jit.  The reference's analogue of "one list too big for a
+node" (multi-part posting lists, posting/list.go:1149) maps to splitting a
+bucket row across the mesh's uid axis — see parallel/.
+
+Value postings (for order-by and inequality) live as two aligned sorted
+views so both directions are one searchsorted: by-uid (gather a
+candidate's sort key) and by-key (range select for le/ge/between).
+Ref: worker/sort.go:177 sortWithIndex + worker/tokens.go:113
+getInequalityTokens, re-designed as array kernels instead of index-bucket
+walks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.ops.uidvec import SENTINEL, compact, member_mask, pad_to
+
+INT64_MAX = np.int64(2**63 - 1)
+
+
+@dataclass
+class AdjBucket:
+    """One degree class of a predicate's adjacency."""
+
+    src: jax.Array        # [M] uint32 sorted, SENTINEL padded
+    neighbors: jax.Array  # [M, D] uint32, SENTINEL padded
+    degree: int           # D
+
+
+@dataclass
+class DeviceAdjacency:
+    """A predicate's full edge set on device.
+
+    src_uids/degrees give O(log N) per-frontier-element count lookup
+    (ref worker/task.go handleHasFunction + count index reads).
+    """
+
+    src_uids: jax.Array   # [N] uint32 sorted, SENTINEL padded
+    degrees: jax.Array    # [N] int32 aligned to src_uids
+    buckets: list[AdjBucket] = field(default_factory=list)
+    n_edges: int = 0
+
+    @property
+    def shape_sig(self):
+        return (self.src_uids.shape[0],
+                tuple((b.src.shape[0], b.degree) for b in self.buckets))
+
+
+def build_adjacency(edges: dict[int, np.ndarray],
+                    min_degree_bucket: int = 8) -> DeviceAdjacency:
+    """Host: {src_uid -> sorted dst uint32 array} -> DeviceAdjacency.
+
+    Runs at rollup time (the analogue of posting.List.Rollup,
+    posting/list.go:708): the committed state is re-packed into dense
+    device tiles.
+    """
+    srcs = np.fromiter(edges.keys(), dtype=np.uint32, count=len(edges))
+    order = np.argsort(srcs, kind="stable")
+    srcs = srcs[order]
+    degs = np.fromiter((len(edges[int(s)]) for s in srcs), dtype=np.int32,
+                       count=len(srcs))
+
+    n_pad = pad_to(len(srcs))
+    src_pad = np.full(n_pad, SENTINEL, np.uint32)
+    src_pad[: len(srcs)] = srcs
+    deg_pad = np.zeros(n_pad, np.int32)
+    deg_pad[: len(srcs)] = degs
+
+    buckets: list[AdjBucket] = []
+    n_edges = int(degs.sum())
+    if len(srcs):
+        caps = np.maximum(min_degree_bucket,
+                          2 ** np.ceil(np.log2(np.maximum(degs, 1))).astype(np.int64))
+        for cap in sorted(set(caps.tolist())):
+            sel = srcs[caps == cap]
+            m_pad = pad_to(len(sel))
+            bsrc = np.full(m_pad, SENTINEL, np.uint32)
+            bsrc[: len(sel)] = sel
+            nb = np.full((m_pad, int(cap)), SENTINEL, np.uint32)
+            for i, s in enumerate(sel):
+                dst = edges[int(s)]
+                nb[i, : len(dst)] = dst
+            buckets.append(AdjBucket(jnp.asarray(bsrc), jnp.asarray(nb),
+                                     int(cap)))
+    return DeviceAdjacency(jnp.asarray(src_pad), jnp.asarray(deg_pad),
+                           buckets, n_edges)
+
+
+def _bucket_candidates(frontier: jax.Array, b: AdjBucket) -> jax.Array:
+    """Flat (unsorted, SENTINEL-masked) neighbor candidates of `frontier`
+    rows present in bucket `b`: one searchsorted + one gather."""
+    idx = jnp.clip(jnp.searchsorted(b.src, frontier), 0, b.src.shape[0] - 1)
+    hit = (b.src[idx] == frontier) & (frontier != SENTINEL)
+    cand = b.neighbors[idx]                     # [F, D]
+    cand = jnp.where(hit[:, None], cand, SENTINEL)
+    return cand.reshape(-1)
+
+
+def expand(adj: DeviceAdjacency, frontier: jax.Array,
+           out_size: int) -> jax.Array:
+    """One BFS level: union of all neighbors of `frontier`.
+
+    Result is a padded sorted UID vector of static length `out_size`
+    (truncates if the true union exceeds it — caller sizes via
+    `max_expansion`). Replaces the reference's per-uid goroutine loop +
+    MergeSorted heap (worker/task.go:581, algo/uidlist.go:354) with one
+    gather + one sort.
+    """
+    parts = [_bucket_candidates(frontier, b) for b in adj.buckets]
+    if not parts:
+        return jnp.full((out_size,), SENTINEL, dtype=jnp.uint32)
+    flat = jnp.sort(jnp.concatenate(parts))
+    prev = jnp.concatenate(
+        [jnp.full((1,), SENTINEL, dtype=flat.dtype), flat[:-1]])
+    uniq = jnp.where(flat != prev, flat, SENTINEL)
+    uniq = compact(uniq)
+    if uniq.shape[0] >= out_size:
+        return uniq[:out_size]
+    return jnp.concatenate(
+        [uniq, jnp.full((out_size - uniq.shape[0],), SENTINEL,
+                        dtype=jnp.uint32)])
+
+
+def max_expansion(adj: DeviceAdjacency, frontier_size: int) -> int:
+    """Static bound on expand() output size for a frontier of F uids."""
+    total = sum(min(b.src.shape[0], frontier_size) * b.degree
+                for b in adj.buckets)
+    return max(8, min(total, pad_to(adj.n_edges)))
+
+
+def count_gather(adj: DeviceAdjacency, uids: jax.Array) -> jax.Array:
+    """Per-uid out-degree (0 for uids without the predicate).
+    Ref: count-index reads (posting/index.go:284 updateCount)."""
+    idx = jnp.clip(jnp.searchsorted(adj.src_uids, uids), 0,
+                   adj.src_uids.shape[0] - 1)
+    hit = (adj.src_uids[idx] == uids) & (uids != SENTINEL)
+    return jnp.where(hit, adj.degrees[idx], 0)
+
+
+def has_uids(adj: DeviceAdjacency) -> jax.Array:
+    """All uids carrying this predicate — the has() root function
+    (ref worker/task.go:2075 handleHasFunction)."""
+    return adj.src_uids
+
+
+# -- value postings ----------------------------------------------------------
+
+
+@dataclass
+class DeviceValues:
+    """Scalar predicate's sortable view: aligned (uid -> key) plus the
+    key-sorted permutation for range scans."""
+
+    uids: jax.Array          # [N] uint32 sorted, SENTINEL padded
+    keys: jax.Array          # [N] int64, aligned to uids (pad = INT64_MAX)
+    keys_sorted: jax.Array   # [N] int64 sorted
+    uids_by_key: jax.Array   # [N] uint32 aligned to keys_sorted
+
+
+def build_values(pairs: dict[int, int]) -> DeviceValues:
+    """Host: {uid -> int64 sort key} -> DeviceValues."""
+    n = len(pairs)
+    n_pad = pad_to(n)
+    uids = np.full(n_pad, SENTINEL, np.uint32)
+    keys = np.full(n_pad, INT64_MAX, np.int64)
+    if n:
+        u = np.fromiter(pairs.keys(), dtype=np.uint32, count=n)
+        k = np.fromiter(pairs.values(), dtype=np.int64, count=n)
+        order = np.argsort(u, kind="stable")
+        uids[:n] = u[order]
+        keys[:n] = k[order]
+    by_key = np.lexsort((uids, keys))
+    return DeviceValues(jnp.asarray(uids), jnp.asarray(keys),
+                        jnp.asarray(keys[by_key]),
+                        jnp.asarray(uids[by_key]))
+
+
+def key_gather(dv: DeviceValues, uids: jax.Array,
+               missing: int = int(INT64_MAX)) -> jax.Array:
+    """Sort keys for candidate uids; `missing` for absent ones."""
+    idx = jnp.clip(jnp.searchsorted(dv.uids, uids), 0, dv.uids.shape[0] - 1)
+    hit = (dv.uids[idx] == uids) & (uids != SENTINEL)
+    return jnp.where(hit, dv.keys[idx], jnp.int64(missing))
+
+
+def range_select(dv: DeviceValues, lo, hi,
+                 lo_open: bool = False, hi_open: bool = False) -> jax.Array:
+    """UIDs whose key is in [lo, hi] (open per flags) — le/lt/ge/gt/between
+    root functions in one searchsorted + mask + sort.
+    Ref: worker/tokens.go:113 getInequalityTokens bucket walk."""
+    lo = jnp.int64(lo)
+    hi = jnp.int64(hi)
+    ks = dv.keys_sorted
+    in_range = (ks > lo if lo_open else ks >= lo) & \
+               (ks < hi if hi_open else ks <= hi)
+    valid = dv.uids_by_key != SENTINEL
+    return compact(jnp.where(in_range & valid, dv.uids_by_key, SENTINEL))
+
+
+@partial(jax.jit, static_argnames=("k", "desc"))
+def order_topk(dv_uids, dv_keys, cand: jax.Array, k: int,
+               desc: bool = False):
+    """First-k of `cand` ordered by value key (uid tiebreak), returning
+    (uids, valid_count). Keys come from key_gather'd arrays.
+
+    Ref: worker/sort.go:412 processSort — the index-bucket walk +
+    intersect per bucket becomes gather + one argsort; lax.sort's
+    multi-operand form gives the stable uid tiebreak.
+    """
+    idx = jnp.clip(jnp.searchsorted(dv_uids, cand), 0, dv_uids.shape[0] - 1)
+    hit = (dv_uids[idx] == cand) & (cand != SENTINEL)
+    keys = jnp.where(hit, dv_keys[idx], INT64_MAX)
+    if desc:
+        keys = jnp.where(hit, -keys, INT64_MAX)
+    # sort (key, uid) pairs; absent uids (INT64_MAX) sink to the end
+    skeys, suids = jax.lax.sort((keys, cand), num_keys=2)
+    return suids[:k], jnp.minimum(jnp.sum(hit), k)

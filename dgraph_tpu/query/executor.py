@@ -1,0 +1,1287 @@
+"""Query executor.
+
+Semantic port of the reference's query engine (query/query.go):
+  - block scheduling with variable dataflow   (query.go:2537 ProcessQuery)
+  - per-node execution                        (query.go:1902 ProcessGraph)
+  - filter algebra                            (query.go:2078 and/or/not)
+  - order + pagination                        (query.go:2231)
+  - recurse                                   (query/recurse.go)
+  - shortest paths                            (query/shortest.go)
+  - aggregation/math/groupby                  (query/aggregator.go, math.go,
+                                               groupby.go)
+
+TPU-first structural change: the reference launches one goroutine per
+child/filter and merges with heaps; here each traversal level is ONE
+batched call — device kernels (ops/graph.py) over resident tablet tiles
+when the tablet is clean, numpy overlay reads when MVCC deltas are live.
+Both paths share the same set-algebra semantics and are property-tested
+against each other.
+"""
+
+from __future__ import annotations
+
+import re as _re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from dgraph_tpu.gql.ast import (
+    FilterTree, Function, GraphQuery, ParsedResult, UID_VAR, VALUE_VAR,
+)
+from dgraph_tpu.gql.lexer import GQLError
+from dgraph_tpu.models.schema import PREDICATE_TYPE
+from dgraph_tpu.models.tokenizer import get_tokenizer, tokens_for
+from dgraph_tpu.models.types import (
+    TypeID, Val, convert, sort_key, to_json_value, type_name,
+)
+from dgraph_tpu.storage.tablet import Tablet
+from dgraph_tpu.utils.keys import token_bytes
+
+_EMPTY = np.empty(0, dtype=np.uint64)
+
+_INEQ = {"le", "lt", "ge", "gt", "between"}
+_TERM_FUNCS = {"anyofterms", "allofterms", "anyoftext", "alloftext"}
+
+
+def _np_sorted(uids) -> np.ndarray:
+    a = np.asarray(sorted(set(int(u) for u in uids)), dtype=np.uint64)
+    return a
+
+
+def _intersect(a, b):
+    return np.intersect1d(a, b, assume_unique=True)
+
+
+def _union(a, b):
+    return np.union1d(a, b)
+
+
+def _difference(a, b):
+    return np.setdiff1d(a, b, assume_unique=True)
+
+
+@dataclass
+class ExecNode:
+    """Runtime state for one query node (the reference's SubGraph,
+    query/query.go:222)."""
+
+    gq: GraphQuery
+    tablet: Optional[Tablet] = None
+    reverse: bool = False
+    src: np.ndarray = field(default_factory=lambda: _EMPTY)
+    dest: np.ndarray = field(default_factory=lambda: _EMPTY)
+    values: dict[int, list] = field(default_factory=dict)  # uid->Postings
+    counts: dict[int, int] = field(default_factory=dict)
+    children: list["ExecNode"] = field(default_factory=list)
+    # recurse support: per-level (parent -> [children]) maps
+    recurse_levels: list[dict[int, np.ndarray]] = field(default_factory=list)
+    path_nodes: list[list[int]] = field(default_factory=list)  # shortest
+
+
+class Executor:
+    def __init__(self, db, read_ts: int):
+        self.db = db
+        self.read_ts = read_ts
+        self.uid_vars: dict[str, np.ndarray] = {}
+        self.value_vars: dict[str, dict[int, Val]] = {}
+
+    # ------------------------------------------------------------------
+    # block scheduling (ref query.go:2596 dependency loop)
+    # ------------------------------------------------------------------
+
+    def run(self, parsed: ParsedResult) -> dict[str, Any]:
+        blocks = list(parsed.queries)
+        done: list[tuple[GraphQuery, ExecNode]] = []
+        pending = blocks
+        for _ in range(len(blocks) + 1):
+            if not pending:
+                break
+            still = []
+            for gq in pending:
+                if self._vars_ready(gq):
+                    done.append((gq, self._run_block(gq)))
+                else:
+                    still.append(gq)
+            if len(still) == len(pending):
+                missing = sorted({vc.name for gq in still
+                                  for vc in self._all_needs(gq)
+                                  if not self._var_defined(vc.name)})
+                raise GQLError(
+                    f"circular or undefined variable dependency: {missing}")
+            pending = still
+        out: dict[str, Any] = {}
+        for gq, node in done:
+            if gq.alias in ("var", "shortest") and gq.attr != "shortest":
+                continue
+            if gq.attr == "shortest":
+                out["_path_"] = self._emit_paths(node)
+                continue
+            out[gq.alias] = self._emit_block(node)
+        return out
+
+    def _all_needs(self, gq: GraphQuery):
+        yield from gq.needs_var
+        if gq.func:
+            yield from gq.func.needs_var
+        if gq.filter:
+            yield from self._filter_needs(gq.filter)
+        for c in gq.children:
+            yield from self._all_needs(c)
+
+    def _filter_needs(self, ft: FilterTree):
+        if ft.func:
+            yield from ft.func.needs_var
+        for c in ft.children:
+            yield from self._filter_needs(c)
+
+    def _var_defined(self, name: str) -> bool:
+        return name in self.uid_vars or name in self.value_vars
+
+    def _vars_ready(self, gq: GraphQuery) -> bool:
+        return all(self._var_defined(vc.name) for vc in self._all_needs(gq))
+
+    # ------------------------------------------------------------------
+    # one block
+    # ------------------------------------------------------------------
+
+    def _run_block(self, gq: GraphQuery) -> ExecNode:
+        node = ExecNode(gq)
+        if gq.attr == "shortest":
+            self._run_shortest(node)
+            return node
+        root = self._root_uids(gq)
+        if gq.filter is not None:
+            root = self._eval_filter(gq.filter, root)
+        root = self._order_paginate(gq, root)
+        node.dest = root
+        if gq.var:
+            self.uid_vars[gq.var] = root
+        if gq.recurse is not None:
+            self._run_recurse(node)
+        else:
+            self._expand_children(node, gq.children, root)
+        return node
+
+    def _root_uids(self, gq: GraphQuery) -> np.ndarray:
+        uids = _EMPTY
+        if gq.uids:
+            uids = _union(uids, _np_sorted(gq.uids))
+        for vc in gq.needs_var:
+            if vc.typ != VALUE_VAR and vc.name in self.uid_vars:
+                uids = _union(uids, self.uid_vars[vc.name])
+        if gq.func is not None and gq.func.name != "uid":
+            uids = _union(uids, self._eval_func(gq.func, None))
+        return uids
+
+    # ------------------------------------------------------------------
+    # root/filter functions (ref worker/task.go:1558 parseSrcFn +
+    # processTask dispatch)
+    # ------------------------------------------------------------------
+
+    def _tablet(self, attr: str) -> Optional[Tablet]:
+        return self.db.tablets.get(attr)
+
+    def _eval_func(self, fn: Function, candidates: Optional[np.ndarray]
+                   ) -> np.ndarray:
+        name = fn.name
+        if name == "uid":
+            uids = _np_sorted(fn.uids)
+            for vc in fn.needs_var:
+                if vc.name in self.uid_vars:
+                    uids = _union(uids, self.uid_vars[vc.name])
+            return uids if candidates is None \
+                else _intersect(candidates, uids)
+        if name == "type":
+            return self._eval_eq_tokens(
+                self._tablet(PREDICATE_TYPE),
+                [Val(TypeID.STRING, fn.args[0].value)], candidates)
+        if name == "has":
+            tab = self._tablet(fn.attr)
+            if tab is None:
+                return _EMPTY
+            alluids = tab.src_uids(self.read_ts)
+            return alluids if candidates is None \
+                else _intersect(candidates, alluids)
+        if fn.is_count:
+            return self._eval_count_fn(fn, candidates)
+        if fn.is_value_var or fn.is_len_var:
+            return self._eval_var_fn(fn, candidates)
+        if name == "eq":
+            tab = self._tablet(fn.attr)
+            if fn.needs_var and not fn.is_value_var:
+                # eq(pred, val(v)): each uid compares against ITS OWN
+                # val(v) (ref query.go valueVarAggregation semantics)
+                return self._eval_eq_own_val(tab, fn, candidates)
+            vals = [Val(TypeID.DEFAULT, a.value) for a in fn.args]
+            return self._eval_eq_tokens(tab, vals, candidates)
+        if name in _INEQ:
+            return self._eval_ineq(fn, candidates)
+        if name in _TERM_FUNCS:
+            return self._eval_terms(fn, candidates)
+        if name == "regexp":
+            return self._eval_regexp(fn, candidates)
+        if name == "match":
+            return self._eval_match(fn, candidates)
+        if name == "uid_in":
+            return self._eval_uid_in(fn, candidates)
+        raise GQLError(f"function {name!r} not supported")
+
+    def _eval_eq_tokens(self, tab: Optional[Tablet], vals: list[Val],
+                        candidates) -> np.ndarray:
+        if tab is None:
+            return _EMPTY
+        out = _EMPTY
+        # pick a non-lossy tokenizer if indexed (ref worker/task.go
+        # pickTokenizer); else scan candidates' values
+        spec = None
+        for tname in tab.schema.tokenizers:
+            s = get_tokenizer(tname)
+            if not s.lossy:
+                spec = s
+                break
+        if spec is None and tab.schema.indexed:
+            spec = get_tokenizer(tab.schema.tokenizers[0])
+        if spec is not None:
+            for v in vals:
+                try:
+                    toks = tokens_for(v, spec)
+                except (ValueError, TypeError):
+                    continue
+                for t in toks:
+                    got = tab.index_uids(token_bytes(spec.ident, t),
+                                         self.read_ts)
+                    out = _union(out, got)
+            if spec.lossy:
+                out = self._verify_eq(tab, out, vals)
+            return out if candidates is None else _intersect(candidates, out)
+        # unindexed: value scan over candidates (filter context) or all
+        scan = candidates if candidates is not None \
+            else tab.src_uids(self.read_ts)
+        keep = [u for u in scan.tolist()
+                if self._value_matches_eq(tab, u, vals)]
+        return np.asarray(keep, dtype=np.uint64)
+
+    def _eval_eq_own_val(self, tab, fn: Function, candidates) -> np.ndarray:
+        if tab is None:
+            return _EMPTY
+        vmap = {}
+        for vc in fn.needs_var:
+            vmap.update(self.value_vars.get(vc.name, {}))
+        scan = candidates if candidates is not None \
+            else _np_sorted(vmap.keys())
+        keep = [u for u in scan.tolist()
+                if u in vmap and self._value_matches_eq(tab, u, [vmap[u]])]
+        return np.asarray(keep, dtype=np.uint64)
+
+    def _verify_eq(self, tab, uids, vals) -> np.ndarray:
+        keep = [u for u in uids.tolist()
+                if self._value_matches_eq(tab, u, vals)]
+        return np.asarray(keep, dtype=np.uint64)
+
+    def _value_matches_eq(self, tab: Tablet, uid: int,
+                          vals: list[Val]) -> bool:
+        for p in tab.get_postings(uid, self.read_ts):
+            for v in vals:
+                try:
+                    want = convert(v, self._cmp_type(tab, p))
+                    have = convert(p.value, self._cmp_type(tab, p))
+                except ValueError:
+                    continue
+                if have.value == want.value:
+                    return True
+        return False
+
+    @staticmethod
+    def _cmp_type(tab: Tablet, p) -> TypeID:
+        t = tab.schema.value_type
+        if t == TypeID.DEFAULT:
+            t = p.value.tid if p.value.tid != TypeID.DEFAULT else TypeID.STRING
+        return t
+
+    def _eval_ineq(self, fn: Function, candidates) -> np.ndarray:
+        tab = self._tablet(fn.attr)
+        if tab is None:
+            return _EMPTY
+        tid = tab.schema.value_type
+        if tid == TypeID.DEFAULT:
+            tid = TypeID.STRING
+        if fn.is_value_var:
+            return self._eval_var_fn(fn, candidates)
+        try:
+            if fn.name == "between":
+                lo = sort_key(convert(Val(TypeID.DEFAULT, fn.args[0].value), tid))
+                hi = sort_key(convert(Val(TypeID.DEFAULT, fn.args[1].value), tid))
+                lo_open = hi_open = False
+            else:
+                bound = sort_key(
+                    convert(Val(TypeID.DEFAULT, fn.args[0].value), tid))
+                lo, hi = -(1 << 63), (1 << 63) - 1
+                lo_open = hi_open = False
+                if fn.name == "le":
+                    hi = bound
+                elif fn.name == "lt":
+                    hi, hi_open = bound, True
+                elif fn.name == "ge":
+                    lo = bound
+                else:
+                    lo, lo_open = bound, True
+        except ValueError as e:
+            raise GQLError(f"bad {fn.name} argument for {fn.attr}: {e}")
+        # strings compare beyond the 8-byte key prefix: exact host compare
+        if tid in (TypeID.STRING, TypeID.DEFAULT):
+            return self._ineq_scan_strings(tab, fn, candidates)
+        pairs = self._sortkeys_for(tab)
+        if not pairs:
+            return _EMPTY
+        uids = np.fromiter(pairs.keys(), dtype=np.uint64, count=len(pairs))
+        keys = np.fromiter(pairs.values(), dtype=np.int64, count=len(pairs))
+        m = (keys > lo if lo_open else keys >= lo) & \
+            (keys < hi if hi_open else keys <= hi)
+        out = np.sort(uids[m])
+        return out if candidates is None else _intersect(candidates, out)
+
+    def _ineq_scan_strings(self, tab, fn, candidates) -> np.ndarray:
+        want = str(fn.args[0].value)
+        hi2 = str(fn.args[1].value) if fn.name == "between" else None
+        op = fn.name
+        keep = []
+        scan = candidates if candidates is not None \
+            else tab.src_uids(self.read_ts)
+        for u in scan.tolist():
+            for p in tab.get_postings(u, self.read_ts):
+                s = str(p.value.value)
+                ok = ((op == "le" and s <= want) or (op == "lt" and s < want)
+                      or (op == "ge" and s >= want) or (op == "gt" and s > want)
+                      or (op == "between" and want <= s <= hi2))
+                if ok:
+                    keep.append(u)
+                    break
+        return np.asarray(keep, dtype=np.uint64)
+
+    def _sortkeys_for(self, tab: Tablet) -> dict[int, int]:
+        out = {}
+        if tab.dirty():
+            for u in tab.src_uids(self.read_ts).tolist():
+                for p in tab.get_postings(u, self.read_ts):
+                    if p.lang:
+                        continue
+                    try:
+                        out[u] = sort_key(convert(
+                            p.value, tab.schema.value_type
+                            if tab.schema.value_type != TypeID.DEFAULT
+                            else p.value.tid))
+                    except ValueError:
+                        pass
+                    break
+            return out
+        return tab.sort_key_pairs()
+
+    def _eval_terms(self, fn: Function, candidates) -> np.ndarray:
+        tab = self._tablet(fn.attr)
+        if tab is None:
+            return _EMPTY
+        toker = "fulltext" if fn.name in ("anyoftext", "alloftext") else "term"
+        spec = get_tokenizer(toker)
+        text = " ".join(a.value for a in fn.args)
+        toks = tokens_for(Val(TypeID.STRING, text), spec)
+        if not toks:
+            return _EMPTY
+        sets = [tab.index_uids(token_bytes(spec.ident, t), self.read_ts)
+                for t in toks]
+        if fn.name.startswith("all"):
+            out = sets[0]
+            for s in sets[1:]:
+                out = _intersect(out, s)
+        else:
+            out = _EMPTY
+            for s in sets:
+                out = _union(out, s)
+        return out if candidates is None else _intersect(candidates, out)
+
+    def _eval_regexp(self, fn: Function, candidates) -> np.ndarray:
+        """Trigram-index prefilter + host regex verify
+        (ref worker/trigram.go:35 + task.go:1001)."""
+        tab = self._tablet(fn.attr)
+        if tab is None:
+            return _EMPTY
+        pattern = fn.args[0].value
+        flags = _re.IGNORECASE if (len(fn.args) > 1
+                                   and "i" in fn.args[1].value) else 0
+        rx = _re.compile(pattern, flags)
+        spec = get_tokenizer("trigram")
+        indexed = tab.schema.indexed and "trigram" in tab.schema.tokenizers
+        if indexed and candidates is None:
+            # required trigrams from literal fragments of the pattern
+            lits = [m for m in _re.findall(r"[\w ]{3,}", pattern)]
+            cand = None
+            for lit in lits:
+                for t in tokens_for(Val(TypeID.STRING, lit), spec):
+                    got = tab.index_uids(token_bytes(spec.ident, t),
+                                         self.read_ts)
+                    cand = got if cand is None else _intersect(cand, got)
+            scan = cand if cand is not None else tab.src_uids(self.read_ts)
+        else:
+            scan = candidates if candidates is not None \
+                else tab.src_uids(self.read_ts)
+        keep = []
+        for u in scan.tolist():
+            for p in tab.get_postings(u, self.read_ts):
+                if rx.search(str(p.value.value)):
+                    keep.append(u)
+                    break
+        return np.asarray(keep, dtype=np.uint64)
+
+    def _eval_match(self, fn: Function, candidates) -> np.ndarray:
+        """Fuzzy match by Levenshtein distance
+        (ref worker/match.go, default max distance 8)."""
+        tab = self._tablet(fn.attr)
+        if tab is None:
+            return _EMPTY
+        want = fn.args[0].value
+        maxd = int(fn.args[1].value) if len(fn.args) > 1 else 8
+        scan = candidates if candidates is not None \
+            else tab.src_uids(self.read_ts)
+        keep = []
+        for u in scan.tolist():
+            for p in tab.get_postings(u, self.read_ts):
+                if _levenshtein(str(p.value.value).lower(), want.lower(),
+                                maxd) <= maxd:
+                    keep.append(u)
+                    break
+        return np.asarray(keep, dtype=np.uint64)
+
+    def _eval_uid_in(self, fn: Function, candidates) -> np.ndarray:
+        tab = self._tablet(fn.attr)
+        if tab is None:
+            return _EMPTY
+        targets = set(fn.uids)
+        for vc in fn.needs_var:
+            targets.update(self.uid_vars.get(vc.name, _EMPTY).tolist())
+        scan = candidates if candidates is not None \
+            else tab.src_uids(self.read_ts)
+        keep = [u for u in scan.tolist()
+                if targets & set(tab.get_dst_uids(u, self.read_ts).tolist())]
+        return np.asarray(keep, dtype=np.uint64)
+
+    def _eval_count_fn(self, fn: Function, candidates) -> np.ndarray:
+        """gt(count(friend), 2) etc (ref task.go:1111 handleCompare +
+        count index)."""
+        tab = self._tablet(fn.attr)
+        if tab is None:
+            return _EMPTY if fn.name not in ("eq", "le", "lt") \
+                else self._count_zero_case(fn, candidates)
+        want = int(fn.args[0].value)
+        scan = candidates if candidates is not None else _union(
+            tab.src_uids(self.read_ts), _EMPTY)
+        keep = []
+        for u in scan.tolist():
+            c = tab.count_of(u, self.read_ts)
+            if _cmp(fn.name, c, want):
+                keep.append(u)
+        return np.asarray(keep, dtype=np.uint64)
+
+    def _count_zero_case(self, fn, candidates):
+        if candidates is not None and _cmp(fn.name, 0, int(fn.args[0].value)):
+            return candidates
+        return _EMPTY
+
+    def _eval_var_fn(self, fn: Function, candidates) -> np.ndarray:
+        """eq/ineq over val(v) or len(v) (ref query.go shortest var
+        filtering + parser IsValueVar)."""
+        if fn.is_len_var:
+            vc = fn.needs_var[0]
+            n = len(self.uid_vars.get(vc.name, _EMPTY))
+            if vc.name in self.value_vars:
+                n = len(self.value_vars[vc.name])
+            ok = _cmp(fn.name, n, int(fn.args[0].value))
+            if candidates is None:
+                return _EMPTY
+            return candidates if ok else _EMPTY
+        vc = fn.needs_var[0]
+        vmap = self.value_vars.get(vc.name, {})
+        want_raw = fn.args[0].value if fn.args else None
+        keep = []
+        scan = candidates if candidates is not None \
+            else _np_sorted(vmap.keys())
+        for u in scan.tolist():
+            v = vmap.get(u)
+            if v is None:
+                continue
+            try:
+                want = convert(Val(TypeID.DEFAULT, want_raw), v.tid).value
+            except ValueError:
+                continue
+            if _cmp(fn.name, v.value, want):
+                keep.append(u)
+        return np.asarray(keep, dtype=np.uint64)
+
+    # ------------------------------------------------------------------
+    # filters (ref query.go:2078)
+    # ------------------------------------------------------------------
+
+    def _eval_filter(self, ft: FilterTree, candidates: np.ndarray
+                     ) -> np.ndarray:
+        if ft.func is not None:
+            return self._eval_func(ft.func, candidates)
+        if ft.op == "and":
+            out = candidates
+            for c in ft.children:
+                out = self._eval_filter(c, out)
+            return out
+        if ft.op == "or":
+            out = _EMPTY
+            for c in ft.children:
+                out = _union(out, self._eval_filter(c, candidates))
+            return out
+        if ft.op == "not":
+            sub = self._eval_filter(ft.children[0], candidates)
+            return _difference(candidates, sub)
+        raise GQLError(f"bad filter node {ft.op!r}")
+
+    # ------------------------------------------------------------------
+    # traversal (ref query.go:1902 ProcessGraph)
+    # ------------------------------------------------------------------
+
+    def _expand_children(self, parent: ExecNode, children: list[GraphQuery],
+                         src: np.ndarray):
+        children = self._expand_expand(children, src)
+        for cgq in children:
+            node = self._process_child(cgq, src)
+            parent.children.append(node)
+
+    def _expand_expand(self, children: list[GraphQuery],
+                       src: np.ndarray) -> list[GraphQuery]:
+        """expand(_all_) / expand(Type) (ref query.go:1812
+        expandSubgraph)."""
+        out = []
+        for c in children:
+            if not c.expand:
+                out.append(c)
+                continue
+            preds: list[str] = []
+            if c.expand == "_all_":
+                type_tab = self._tablet(PREDICATE_TYPE)
+                tnames = set()
+                if type_tab is not None:
+                    for u in src.tolist():
+                        for p in type_tab.get_postings(u, self.read_ts):
+                            tnames.add(str(p.value.value))
+                for tn in sorted(tnames):
+                    td = self.db.schema.get_type(tn)
+                    if td:
+                        preds.extend(td.fields)
+                if not tnames:  # no type system in play: expand schema
+                    preds = [p for p in self.db.schema.predicates()
+                             if not p.startswith("dgraph.")]
+            else:
+                td = self.db.schema.get_type(c.expand)
+                if td:
+                    preds = td.fields
+            seen = set()
+            for pname in preds:
+                if pname in seen:
+                    continue
+                seen.add(pname)
+                sub = GraphQuery(attr=pname, children=list(c.children),
+                                 filter=c.filter)
+                out.append(sub)
+        return out
+
+    def _process_child(self, gq: GraphQuery, src: np.ndarray) -> ExecNode:
+        node = ExecNode(gq, src=src)
+        attr = gq.attr
+        if attr == "uid" and not gq.is_count:
+            # bare `uid` / `x as uid`: binds/emits the enclosing uid set
+            if gq.var:
+                self.uid_vars[gq.var] = src
+            return node
+        if gq.is_internal or attr == "math" or gq.agg_func \
+                or attr.startswith("val(") or attr.startswith("fragment/"):
+            self._process_internal(node)
+            return node
+        node.reverse = attr.startswith("~")
+        if node.reverse:
+            attr = attr[1:]
+        tab = self._tablet(attr)
+        node.tablet = tab
+        if tab is None:
+            if gq.var:
+                self.uid_vars[gq.var] = _EMPTY
+            return node
+        if node.reverse and not tab.schema.reverse:
+            raise GQLError(
+                f"reverse edges are not defined for predicate {attr!r} "
+                f"(add @reverse to the schema)")
+        if tab.schema.value_type == TypeID.UID and not node.reverse or \
+                (node.reverse and tab.schema.reverse):
+            dest = self._expand_level(tab, src, node.reverse)
+            if gq.filter is not None:
+                dest = self._eval_filter(gq.filter, dest)
+            node.dest = dest
+            if gq.var:
+                self.uid_vars[gq.var] = dest
+            if gq.is_count:
+                for u in src.tolist():
+                    node.counts[u] = self._child_count(tab, u, node.reverse)
+            elif gq.is_groupby:
+                pass  # grouped at emit time
+            else:
+                self._expand_children(node, gq.children, dest)
+        else:
+            # scalar predicate: fetch values for src uids
+            for u in src.tolist():
+                ps = tab.get_postings(u, self.read_ts)
+                if ps:
+                    node.values[u] = ps
+            if gq.is_count:
+                for u in src.tolist():
+                    node.counts[u] = len(node.values.get(u, ()))
+            if gq.var:
+                vmap = {}
+                for u, ps in node.values.items():
+                    sel = self._select_posting(ps, gq.langs)
+                    if sel is not None:
+                        vmap[u] = self._typed(tab, sel)
+                self.value_vars[gq.var] = vmap
+        return node
+
+    def _child_count(self, tab: Tablet, uid: int, reverse: bool) -> int:
+        if reverse:
+            return len(tab.get_reverse_uids(uid, self.read_ts))
+        return tab.count_of(uid, self.read_ts)
+
+    def _typed(self, tab: Tablet, p) -> Val:
+        t = tab.schema.value_type
+        if t == TypeID.DEFAULT:
+            return p.value
+        try:
+            return convert(p.value, t)
+        except ValueError:
+            return p.value
+
+    def _select_posting(self, ps, langs: list[str]):
+        """Language preference list (ref types/valForLang semantics):
+        first matching lang wins; '.' means any; no langs -> untagged
+        first, else any."""
+        if langs:
+            for lg in langs:
+                if lg == ".":
+                    return ps[0]
+                for p in ps:
+                    if p.lang == lg:
+                        return p
+            return None
+        for p in ps:
+            if not p.lang:
+                return p
+        return None
+
+    # -- the hot loop: one level of expansion --
+
+    def _expand_level(self, tab: Tablet, src: np.ndarray,
+                      reverse: bool) -> np.ndarray:
+        dev = None
+        if self.db.prefer_device and not reverse:
+            dev = self._device_expand(tab, src)
+        if dev is not None:
+            return dev
+        out = _EMPTY
+        getter = tab.get_reverse_uids if reverse else tab.get_dst_uids
+        parts = [getter(int(u), self.read_ts) for u in src.tolist()]
+        parts = [p for p in parts if len(p)]
+        if parts:
+            out = np.unique(np.concatenate(parts))
+        return out
+
+    def _device_expand(self, tab: Tablet, src: np.ndarray
+                       ) -> Optional[np.ndarray]:
+        from dgraph_tpu.engine.device_cache import device_adjacency, expand_np
+
+        adj = device_adjacency(self.db, tab, self.read_ts)
+        if adj is None or len(src) == 0:
+            return None
+        return expand_np(adj, src)
+
+    # ------------------------------------------------------------------
+    # internal nodes: uid/count(uid)/val()/aggregations/math
+    # ------------------------------------------------------------------
+
+    def _process_internal(self, node: ExecNode):
+        gq = node.gq
+        if gq.agg_func:
+            vc = gq.needs_var[0]
+            vmap = self.value_vars.get(vc.name, {})
+            src = node.src
+            vals = [vmap[u] for u in src.tolist() if u in vmap] \
+                if len(src) else list(vmap.values())
+            node.values[0] = [Agg(gq.agg_func, _aggregate(gq.agg_func, vals))]
+        elif gq.math is not None:
+            vmap = _eval_math(gq.math, self.value_vars)
+            if gq.var:
+                self.value_vars[gq.var] = vmap
+            node.values = {u: [Agg("math", v)] for u, v in vmap.items()}
+        elif gq.attr.startswith("val("):
+            vc = gq.needs_var[0]
+            vmap = self.value_vars.get(vc.name, {})
+            node.values = {u: [Agg("val", v)] for u, v in vmap.items()}
+
+    # ------------------------------------------------------------------
+    # order + pagination (ref query.go:2231 applyOrderAndPagination)
+    # ------------------------------------------------------------------
+
+    def _order_paginate(self, gq: GraphQuery, uids: np.ndarray
+                        ) -> np.ndarray:
+        if gq.order:
+            uids = self._apply_order(gq.order, uids)
+        if gq.after:
+            if gq.order:
+                pos = np.nonzero(uids == gq.after)[0]
+                uids = uids[int(pos[0]) + 1:] if len(pos) else uids
+            else:
+                uids = uids[uids > gq.after]
+        off = gq.offset or 0
+        if off:
+            uids = uids[off:]
+        if gq.first is not None:
+            if gq.first >= 0:
+                uids = uids[: gq.first]
+            else:
+                uids = uids[gq.first:]
+        return uids
+
+    def _apply_order(self, orders, uids: np.ndarray) -> np.ndarray:
+        """Multi-key value sort; stable, missing-value uids last
+        (ref types/sort.go:118 + worker/sort.go)."""
+        keyrows = []
+        for o in orders:
+            vmap = self._order_keys(o.attr, o.lang, uids)
+            col = np.asarray(
+                [vmap.get(int(u), (1, 0))[0] for u in uids], dtype=np.int64)
+            sub = np.asarray(
+                [vmap.get(int(u), (1, 0))[1] for u in uids], dtype=np.int64)
+            if o.desc:
+                sub = -sub
+            keyrows.append((col, sub))
+        # lexsort: last key is primary
+        cols = []
+        for col, sub in reversed(keyrows):
+            cols.append(sub)
+            cols.append(col)  # missing flag dominates its key
+        cols.insert(0, uids)  # final tiebreak: uid asc
+        order = np.lexsort(tuple(cols))
+        return uids[order]
+
+    def _order_keys(self, attr: str, lang: str, uids) -> dict:
+        """uid -> (missing_flag, int64 key)."""
+        out = {}
+        if attr.startswith("val("):
+            vmap = self.value_vars.get(attr[4:-1], {})
+            for u in uids.tolist():
+                v = vmap.get(u)
+                if v is not None:
+                    try:
+                        out[u] = (0, sort_key(v))
+                    except ValueError:
+                        pass
+            return out
+        tab = self._tablet(attr)
+        if tab is None:
+            return out
+        for u in uids.tolist():
+            ps = tab.get_postings(u, self.read_ts)
+            sel = self._select_posting(ps, [lang] if lang else [])
+            if sel is not None:
+                try:
+                    out[u] = (0, sort_key(self._typed(tab, sel)))
+                except ValueError:
+                    pass
+        return out
+
+    # ------------------------------------------------------------------
+    # recurse (ref query/recurse.go:29)
+    # ------------------------------------------------------------------
+
+    def _run_recurse(self, node: ExecNode):
+        gq = node.gq
+        depth = gq.recurse.depth or 64
+        allow_loop = gq.recurse.allow_loop
+        preds = [c for c in gq.children if not c.is_internal]
+        frontier = node.dest
+        visited = frontier.copy()
+        for _ in range(depth):
+            if not len(frontier):
+                break
+            level: dict[str, dict[int, np.ndarray]] = {}
+            nxt = _EMPTY
+            for cgq in preds:
+                attr = cgq.attr
+                rev = attr.startswith("~")
+                tab = self._tablet(attr[1:] if rev else attr)
+                if tab is None or tab.schema.value_type != TypeID.UID:
+                    continue
+                if rev and not tab.schema.reverse:
+                    raise GQLError(
+                        f"reverse edges are not defined for predicate "
+                        f"{attr[1:]!r} (add @reverse to the schema)")
+                per_parent: dict[int, np.ndarray] = {}
+                for u in frontier.tolist():
+                    dst = (tab.get_reverse_uids(u, self.read_ts) if rev
+                           else tab.get_dst_uids(u, self.read_ts))
+                    if cgq.filter is not None and len(dst):
+                        dst = self._eval_filter(cgq.filter, dst)
+                    if len(dst):
+                        per_parent[u] = dst
+                        nxt = _union(nxt, dst)
+                level[attr] = per_parent
+            node.recurse_levels.append(level)
+            if not allow_loop:
+                nxt = _difference(nxt, visited)
+                visited = _union(visited, nxt)
+            frontier = nxt
+        node.recurse_frontiers = None  # levels carry everything
+
+    # ------------------------------------------------------------------
+    # shortest path (ref query/shortest.go:451 Dijkstra / :287 k-paths)
+    # ------------------------------------------------------------------
+
+    def _run_shortest(self, node: ExecNode):
+        gq = node.gq
+        sa = gq.shortest
+        if sa is None or sa.from_ is None or sa.to is None:
+            raise GQLError("shortest requires from: and to:")
+        src = self._fn_single_uid(sa.from_)
+        dst = self._fn_single_uid(sa.to)
+        preds = [c.attr for c in gq.children if not c.is_internal]
+        maxdepth = sa.depth or 64
+        # unweighted BFS with parent pointers; k-paths via repeated
+        # shortest with edge exclusion (round-1: hop-count weights)
+        parent: dict[int, tuple[int, str]] = {src: (0, "")}
+        frontier = [src]
+        found = src == dst
+        for _ in range(maxdepth):
+            if found or not frontier:
+                break
+            nxt = []
+            for u in frontier:
+                for pname in preds:
+                    rev = pname.startswith("~")
+                    tab = self._tablet(pname[1:] if rev else pname)
+                    if tab is None:
+                        continue
+                    if rev and not tab.schema.reverse:
+                        raise GQLError(
+                            f"reverse edges are not defined for predicate "
+                            f"{pname[1:]!r} (add @reverse to the schema)")
+                    dsts = (tab.get_reverse_uids(u, self.read_ts) if rev
+                            else tab.get_dst_uids(u, self.read_ts))
+                    for d in dsts.tolist():
+                        if d not in parent:
+                            parent[d] = (u, pname)
+                            nxt.append(d)
+                            if d == dst:
+                                found = True
+            frontier = nxt
+        if found:
+            path = [dst]
+            while path[-1] != src:
+                path.append(parent[path[-1]][0])
+            path.reverse()
+            node.path_nodes = [path]
+            if gq.var:
+                self.uid_vars[gq.var] = _np_sorted(path)
+        else:
+            node.path_nodes = []
+            if gq.var:
+                self.uid_vars[gq.var] = _EMPTY
+
+    def _fn_single_uid(self, fn: Function) -> int:
+        if fn.uids:
+            return fn.uids[0]
+        for vc in fn.needs_var:
+            arr = self.uid_vars.get(vc.name, _EMPTY)
+            if len(arr):
+                return int(arr[0])
+        raise GQLError("shortest from/to resolved to no uid")
+
+    # ------------------------------------------------------------------
+    # output (ref query/outputnode.go:653 preTraverse)
+    # ------------------------------------------------------------------
+
+    def _emit_block(self, node: ExecNode) -> list:
+        gq = node.gq
+        if gq.recurse is not None:
+            return [self._emit_recurse_node(node, int(u), 0)
+                    for u in node.dest.tolist()]
+        out = []
+        # count(uid) at block level: one summed object
+        # (ref outputnode.go uid count emission)
+        for ch in node.children:
+            if ch.gq.attr == "uid" and ch.gq.is_count:
+                out.append({ch.gq.alias or "count": len(node.dest)})
+        for u in node.dest.tolist():
+            obj = self._emit_uid(node, int(u))
+            if obj:  # empty objects are dropped (ref outputnode.go)
+                out.append(obj)
+        # block-level aggregations over vars (empty-src internal children)
+        for ch in node.children:
+            if ch.gq.agg_func and 0 in ch.values:
+                agg = ch.values[0][0]
+                if agg.value is not None:
+                    name = ch.gq.alias or ch.gq.attr
+                    out.append({name: to_json_value(agg.value)})
+        if gq.normalize:
+            out = [self._normalize(o) for o in out if o]
+            out = [o for o in out if o]
+        return out
+
+    def _emit_uid(self, node: ExecNode, uid: int) -> Optional[dict]:
+        obj: dict[str, Any] = {}
+        gq = node.gq
+        children = node.children
+        if not children:
+            obj["uid"] = hex(uid)
+            return obj
+        for ch in children:
+            cgq = ch.gq
+            name = cgq.alias or cgq.attr
+            if cgq.langs and not cgq.alias:
+                name = f"{cgq.attr}@{':'.join(cgq.langs)}"
+            if cgq.attr == "uid":
+                if cgq.is_count:
+                    continue  # count(uid) handled at parent level
+                obj["uid"] = hex(uid)
+                continue
+            if cgq.agg_func:
+                continue  # block-level
+            if cgq.attr == "math" or cgq.attr.startswith("val("):
+                vs = ch.values.get(uid)
+                if vs:
+                    obj[name] = to_json_value(vs[0].value)
+                continue
+            if ch.tablet is None:
+                continue
+            if cgq.is_count:
+                cname = cgq.alias or f"count({cgq.attr})"
+                obj[cname] = ch.counts.get(uid, 0)
+                continue
+            tab = ch.tablet
+            if tab.schema.value_type == TypeID.UID and not ch.reverse \
+                    or (ch.reverse and tab.schema.reverse):
+                dsts = (tab.get_reverse_uids(uid, self.read_ts) if ch.reverse
+                        else tab.get_dst_uids(uid, self.read_ts))
+                dsts = _intersect(dsts, ch.dest) if len(ch.dest) else \
+                    (dsts if not ch.gq.filter else _EMPTY)
+                if cgq.is_groupby:
+                    obj[name] = self._emit_groupby(ch, dsts)
+                    continue
+                dsts = self._order_paginate(cgq, dsts)
+                counts = [c for c in cgq.children
+                          if c.attr == "uid" and c.is_count]
+                if counts:
+                    obj[name] = [{counts[0].alias or "count": len(dsts)}]
+                    continue
+                items = []
+                for d in dsts.tolist():
+                    sub = self._emit_uid(ch, int(d))
+                    if sub is None:
+                        continue
+                    if cgq.facets is not None:
+                        fc = tab.get_facets(uid, int(d), self.read_ts)
+                        self._attach_facets(sub, cgq.facets, fc, name)
+                    if sub:
+                        items.append(sub)
+                if items:
+                    obj[name] = items
+                elif gq.cascade or cgq.cascade:
+                    return None
+            else:
+                ps = ch.values.get(uid)
+                if ps:
+                    v = self._emit_value(ch, ps)
+                    if v is not None:
+                        obj[name] = v
+                        if cgq.facets is not None:
+                            pass  # value facets round 2
+                        continue
+                if gq.cascade or cgq.cascade:
+                    return None
+        if node.gq.cascade:
+            want = [c for c in children
+                    if c.tablet is not None and not c.gq.is_count]
+            for c in want:
+                nm = c.gq.alias or c.gq.attr
+                if nm not in obj:
+                    return None
+        return obj
+
+    def _emit_value(self, ch: ExecNode, ps) -> Any:
+        cgq = ch.gq
+        tab = ch.tablet
+        if tab.schema.list_:
+            vals = [to_json_value(self._typed(tab, p)) for p in ps
+                    if not p.lang]
+            return vals or None
+        if cgq.langs:
+            sel = self._select_posting(ps, cgq.langs)
+            return to_json_value(self._typed(tab, sel)) if sel else None
+        sel = self._select_posting(ps, [])
+        return to_json_value(self._typed(tab, sel)) if sel else None
+
+    def _attach_facets(self, item: dict, fp, facets: dict, edge: str):
+        if not facets:
+            return
+        sel = facets if fp.all_keys else {
+            k: facets[k] for k, _ in fp.keys if k in facets}
+        names = {k: k for k in sel}
+        if not fp.all_keys:
+            names.update({k: a for k, a in fp.keys})
+        for k, v in sel.items():
+            item[f"{edge}|{names.get(k, k)}"] = to_json_value(v)
+
+    def _emit_groupby(self, ch: ExecNode, dsts: np.ndarray) -> dict:
+        """@groupby(attr) { count(uid) } (ref query/groupby.go:371)."""
+        gattr = ch.gq.groupby[0].attr
+        tab = self._tablet(gattr)
+        groups: dict[Any, int] = {}
+        for d in dsts.tolist():
+            if tab is None:
+                continue
+            if tab.schema.value_type == TypeID.UID:
+                for t in tab.get_dst_uids(d, self.read_ts).tolist():
+                    groups[hex(t)] = groups.get(hex(t), 0) + 1
+            else:
+                ps = tab.get_postings(d, self.read_ts)
+                sel = self._select_posting(ps, [])
+                if sel is not None:
+                    key = to_json_value(self._typed(tab, sel))
+                    groups[key] = groups.get(key, 0) + 1
+        return {"@groupby": [
+            {gattr: k, "count": c} for k, c in sorted(
+                groups.items(), key=lambda kv: str(kv[0]))]}
+
+    def _emit_recurse_node(self, node: ExecNode, uid: int, level: int
+                           ) -> dict:
+        obj: dict[str, Any] = {"uid": hex(uid)}
+        # value/scalar children at every level
+        for cgq in node.gq.children:
+            tab = self._tablet(cgq.attr.lstrip("~"))
+            if tab is None:
+                continue
+            name = cgq.alias or cgq.attr
+            if tab.schema.value_type != TypeID.UID:
+                ps = tab.get_postings(uid, self.read_ts)
+                sel = self._select_posting(ps, cgq.langs)
+                if sel is not None:
+                    obj[name] = to_json_value(self._typed(tab, sel))
+        if level < len(node.recurse_levels):
+            lv = node.recurse_levels[level]
+            for cgq in node.gq.children:
+                attr = cgq.attr
+                per_parent = lv.get(attr)
+                if not per_parent or uid not in per_parent:
+                    continue
+                name = cgq.alias or attr
+                kids = [self._emit_recurse_node(node, int(d), level + 1)
+                        for d in self._order_paginate(
+                            cgq, per_parent[uid]).tolist()]
+                if kids:
+                    obj[name] = kids
+        return obj
+
+    def _emit_paths(self, node: ExecNode) -> list:
+        out = []
+        for path in node.path_nodes:
+            cur = None
+            for uid in reversed(path):
+                entry = {"uid": hex(uid)}
+                if cur is not None:
+                    entry["_next_"] = cur  # chain; flattened below
+                cur = entry
+            # Dgraph emits a nested path via the traversed predicates; we
+            # emit the uid chain (same information, simpler shape)
+            out.append({"path": [{"uid": hex(u)} for u in path]})
+        return out
+
+    def _normalize(self, obj: dict) -> dict:
+        """@normalize: keep aliased leaves, flatten nesting
+        (ref outputnode.go normalize)."""
+        flat: dict[str, Any] = {}
+
+        def walk(o):
+            for k, v in o.items():
+                if isinstance(v, list) and v and isinstance(v[0], dict):
+                    for item in v:
+                        walk(item)
+                elif isinstance(v, dict):
+                    walk(v)
+                elif k != "uid":
+                    flat[k] = v
+
+        walk(obj)
+        return flat
+
+
+class Agg:
+    __slots__ = ("kind", "value")
+
+    def __init__(self, kind, value):
+        self.kind = kind
+        self.value = value
+
+
+def _cmp(op: str, a, b) -> bool:
+    if op in ("eq",):
+        return a == b
+    if op == "le":
+        return a <= b
+    if op == "lt":
+        return a < b
+    if op == "ge":
+        return a >= b
+    if op == "gt":
+        return a > b
+    raise GQLError(f"bad comparison {op}")
+
+
+def _aggregate(fn: str, vals: list[Val]) -> Optional[Val]:
+    nums = []
+    for v in vals:
+        if v.tid in (TypeID.INT, TypeID.FLOAT):
+            nums.append(v.value)
+        elif v.tid == TypeID.DATETIME:
+            nums.append(v)
+    if not vals:
+        return None
+    if fn in ("min", "max"):
+        try:
+            pick = (min if fn == "min" else max)(
+                vals, key=lambda v: sort_key(v))
+            return pick
+        except ValueError:
+            return None
+    if not nums:
+        return None
+    plain = [n for n in nums if not isinstance(n, Val)]
+    if not plain:
+        return None
+    if fn == "sum":
+        s = sum(plain)
+        return Val(TypeID.INT if isinstance(s, int) else TypeID.FLOAT, s)
+    if fn == "avg":
+        return Val(TypeID.FLOAT, sum(plain) / len(plain))
+    return None
+
+
+def _eval_math(tree, value_vars) -> dict[int, Val]:
+    """Per-uid math over value vars (ref query/math.go:213 processBinary).
+    Round-1 subset: +,-,*,/,%, comparison ops, unary funcs, min/max/cond.
+    """
+    import math as _m
+
+    def eval_node(t) -> dict[int, float] | float:
+        if t.const is not None:
+            return float(t.const)
+        if t.var:
+            vmap = value_vars.get(t.var, {})
+            return {u: float(v.value) for u, v in vmap.items()
+                    if v.tid in (TypeID.INT, TypeID.FLOAT, TypeID.BOOL)}
+        args = [eval_node(c) for c in t.children]
+        uids = set()
+        for a in args:
+            if isinstance(a, dict):
+                uids |= set(a)
+        if not uids:  # all-constant expression
+            vals = [a for a in args]
+            return _apply_math(t.fn, vals, _m)
+        out = {}
+        for u in uids:
+            vals = [a[u] if isinstance(a, dict) else a for a in args
+                    if not isinstance(a, dict) or u in a]
+            if len(vals) != len(args):
+                continue
+            try:
+                out[u] = _apply_math(t.fn, vals, _m)
+            except (ZeroDivisionError, ValueError):
+                continue
+        return out
+
+    res = eval_node(tree)
+    if not isinstance(res, dict):
+        return {}
+    out = {}
+    for u, x in res.items():
+        if isinstance(x, bool):
+            out[u] = Val(TypeID.BOOL, x)
+        elif isinstance(x, float) and x.is_integer() and abs(x) < 2**53:
+            out[u] = Val(TypeID.INT, int(x))
+        else:
+            out[u] = Val(TypeID.FLOAT, x)
+    return out
+
+
+def _apply_math(fn: str, v: list, _m):
+    if fn == "+":
+        return v[0] + v[1]
+    if fn == "-":
+        return v[0] - v[1] if len(v) == 2 else -v[0]
+    if fn == "*":
+        return v[0] * v[1]
+    if fn == "/":
+        return v[0] / v[1]
+    if fn == "%":
+        return v[0] % v[1]
+    if fn == "<":
+        return v[0] < v[1]
+    if fn == ">":
+        return v[0] > v[1]
+    if fn == "<=":
+        return v[0] <= v[1]
+    if fn == ">=":
+        return v[0] >= v[1]
+    if fn == "==":
+        return v[0] == v[1]
+    if fn == "!=":
+        return v[0] != v[1]
+    if fn == "min":
+        return min(v)
+    if fn == "max":
+        return max(v)
+    if fn == "exp":
+        return _m.exp(v[0])
+    if fn == "ln":
+        return _m.log(v[0])
+    if fn == "sqrt":
+        return _m.sqrt(v[0])
+    if fn == "floor":
+        return float(_m.floor(v[0]))
+    if fn == "ceil":
+        return float(_m.ceil(v[0]))
+    if fn == "pow":
+        return v[0] ** v[1]
+    if fn == "logbase":
+        return _m.log(v[0], v[1])
+    if fn == "sigmoid":
+        return 1.0 / (1.0 + _m.exp(-v[0]))
+    if fn == "cond":
+        return v[1] if v[0] else v[2]
+    raise GQLError(f"math op {fn!r} not supported")
+
+
+def _levenshtein(a: str, b: str, cap: int) -> int:
+    """Banded edit distance (ref worker/match.go levenshtein)."""
+    if abs(len(a) - len(b)) > cap:
+        return cap + 1
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        lo = cap + 1
+        for j, cb in enumerate(b, 1):
+            c = min(prev[j] + 1, cur[j - 1] + 1,
+                    prev[j - 1] + (ca != cb))
+            cur.append(c)
+            lo = min(lo, c)
+        if lo > cap:
+            return cap + 1
+        prev = cur
+    return prev[-1]

@@ -1,0 +1,369 @@
+"""Per-predicate MVCC tablet.
+
+Equivalent of the reference's posting-list layer for one predicate
+(posting/list.go List + posting/index.go index/reverse/count upkeep), with
+the storage model inverted for TPU residency:
+
+  reference: Badger key per (pred, uid), immutable pack + per-txn deltas,
+             iterator merges layers at read time (posting/list.go:559)
+  here:      one Tablet per pred = base state (numpy dicts, rolled up at
+             base_ts) + commit-ts-stamped delta overlay; reads at read_ts
+             overlay deltas in (base_ts, read_ts]; rollup folds the
+             overlay forward and re-packs device tiles (ops/graph.py)
+
+Indexes (token->uids), reverse edges and counts are maintained
+transactionally inside the same commit apply, mirroring
+posting.AddMutationWithIndex (posting/index.go:377): an overwrite of a
+single-valued indexed predicate first emits deletes for the old value's
+tokens.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+import numpy as np
+
+from dgraph_tpu.models.schema import PredicateSchema
+from dgraph_tpu.models.tokenizer import get_tokenizer, tokens_for
+from dgraph_tpu.models.types import (
+    TypeID, Val, convert, sort_key, value_fingerprint,
+)
+from dgraph_tpu.utils.keys import token_bytes
+
+_EMPTY = np.empty(0, dtype=np.uint64)
+
+
+@dataclass
+class Posting:
+    """One value posting. Ref pb.Posting (value side)."""
+
+    value: Val
+    lang: str = ""
+    facets: dict = field(default_factory=dict)
+
+
+@dataclass
+class EdgeOp:
+    """One committed operation inside a tablet. op: 'set' | 'del' |
+    'del_all' (S P * wildcard)."""
+
+    op: str
+    src: int
+    dst: int = 0                       # uid objects
+    posting: Optional[Posting] = None  # value objects
+    facets: dict = field(default_factory=dict)
+
+
+def _ins(arr: np.ndarray, uid: int) -> np.ndarray:
+    i = np.searchsorted(arr, uid)
+    if i < len(arr) and arr[i] == uid:
+        return arr
+    return np.insert(arr, i, uid)
+
+
+def _rm(arr: np.ndarray, uid: int) -> np.ndarray:
+    i = np.searchsorted(arr, uid)
+    if i < len(arr) and arr[i] == uid:
+        return np.delete(arr, i)
+    return arr
+
+
+class Tablet:
+    def __init__(self, pred: str, schema: PredicateSchema):
+        self.pred = pred
+        self.schema = schema
+        self.base_ts = 0
+        # base state (committed, <= base_ts)
+        self.edges: dict[int, np.ndarray] = {}        # src -> sorted dst u64
+        self.reverse: dict[int, np.ndarray] = {}      # dst -> sorted src u64
+        self.values: dict[int, list[Posting]] = {}    # src -> postings
+        self.index: dict[bytes, np.ndarray] = {}      # token -> sorted uids
+        self.edge_facets: dict[tuple[int, int], dict] = {}
+        # delta overlay: ts-ascending op lists
+        self.deltas: list[tuple[int, list[EdgeOp]]] = []
+        self.max_commit_ts = 0
+        # device snapshot cache (built lazily; see engine)
+        self._device_adj = None
+        self._device_values = None
+        self._device_ts = -1
+
+    # -- schema helpers --
+    @property
+    def is_uid(self) -> bool:
+        return self.schema.value_type == TypeID.UID
+
+    def _converted(self, p: Posting) -> Val:
+        want = self.schema.value_type
+        if want in (TypeID.DEFAULT,):
+            return p.value
+        return convert(p.value, want)
+
+    def _tokens(self, p: Posting) -> list[bytes]:
+        out = []
+        for tname in self.schema.tokenizers:
+            spec = get_tokenizer(tname)
+            for t in tokens_for(p.value, spec):
+                out.append(token_bytes(spec.ident, t))
+        return out
+
+    # -- commit application (engine's apply loop calls this) --
+
+    def apply(self, commit_ts: int, ops: list[EdgeOp]):
+        """Append a committed delta. Ops are expanded with the implicit
+        index/reverse maintenance (old-value token deletes etc.) at apply
+        time so the overlay is self-contained for reads."""
+        assert commit_ts > self.max_commit_ts or not self.deltas, \
+            "commits must apply in ts order"
+        self.deltas.append((commit_ts, ops))
+        self.max_commit_ts = max(self.max_commit_ts, commit_ts)
+
+    # -- reads (read_ts snapshot) --
+
+    def _overlay(self, read_ts: int):
+        for ts, ops in self.deltas:
+            if ts > read_ts:
+                break
+            yield from ops
+
+    def _overlay_ts(self, read_ts: int):
+        for ts, ops in self.deltas:
+            if ts > read_ts:
+                break
+            for op in ops:
+                yield ts, op
+
+    def get_dst_uids(self, src: int, read_ts: int) -> np.ndarray:
+        out = self.edges.get(src, _EMPTY)
+        dirty = False
+        for op in self._overlay(read_ts):
+            if op.src != src:
+                continue
+            if not dirty:
+                out = out.copy()
+                dirty = True
+            if op.op == "set":
+                out = _ins(out, op.dst)
+            elif op.op == "del":
+                out = _rm(out, op.dst)
+            elif op.op == "del_all":
+                out = _EMPTY
+        return out
+
+    def get_reverse_uids(self, dst: int, read_ts: int) -> np.ndarray:
+        out = self.reverse.get(dst, _EMPTY)
+        for ts, op in self._overlay_ts(read_ts):
+            if op.op == "set" and op.dst == dst:
+                out = _ins(out, op.src)
+            elif op.op == "del" and op.dst == dst:
+                out = _rm(out, op.src)
+            elif op.op == "del_all":
+                # wildcard covers edges added earlier in the overlay too:
+                # reconstruct src's out-edges just before this delete
+                if dst in self.get_dst_uids(op.src, ts - 1):
+                    out = _rm(out, op.src)
+        return out
+
+    def get_postings(self, src: int, read_ts: int) -> list[Posting]:
+        out = list(self.values.get(src, ()))
+        for op in self._overlay(read_ts):
+            if op.src != src:
+                continue
+            if op.op == "del_all":
+                out = []
+            elif op.op == "set":
+                out = self._merge_posting(out, op.posting)
+            elif op.op == "del":
+                fp = value_fingerprint(op.posting.value) if op.posting else None
+                out = [p for p in out
+                       if not (p.lang == (op.posting.lang if op.posting else "")
+                               and (fp is None
+                                    or value_fingerprint(p.value) == fp))]
+        return out
+
+    def _merge_posting(self, cur: list[Posting], p: Posting) -> list[Posting]:
+        if self.schema.list_:
+            fp = value_fingerprint(p.value)
+            rest = [q for q in cur if value_fingerprint(q.value) != fp]
+            return rest + [p]
+        # single-valued: one posting per lang (ref posting lang handling)
+        rest = [q for q in cur if q.lang != p.lang]
+        return rest + [p]
+
+    def index_uids(self, token: bytes, read_ts: int) -> np.ndarray:
+        out = self.index.get(token, _EMPTY)
+        dirty = False
+        for ts, op in self._overlay_ts(read_ts):
+            toks: Iterable[bytes] = ()
+            if op.op in ("set", "del") and op.posting is not None \
+                    and self.schema.indexed:
+                toks = self._tokens(op.posting)
+            elif op.op == "del_all" and self.schema.indexed:
+                # wildcard delete: drop src from every token of every
+                # posting live just before this delete (incl. postings
+                # added earlier in the overlay)
+                for p in self.get_postings(op.src, ts - 1):
+                    for tk in self._tokens(p):
+                        if tk == token:
+                            if not dirty:
+                                out = out.copy(); dirty = True
+                            out = _rm(out, op.src)
+                continue
+            if token in toks:
+                if not dirty:
+                    out = out.copy(); dirty = True
+                if op.op == "set":
+                    out = _ins(out, op.src)
+                else:
+                    out = _rm(out, op.src)
+            # an overwrite (set on single-valued pred) removes the uid
+            # from tokens of the *old* value: handled by explicit del ops
+            # emitted at commit build time (engine mutation path).
+        return out
+
+    def get_postings_at_base(self, src: int) -> list[Posting]:
+        return list(self.values.get(src, ()))
+
+    def src_uids(self, read_ts: int) -> np.ndarray:
+        """All uids with >=1 posting — has() root. Ref
+        worker/task.go:2075."""
+        base = set(self.edges) if self.is_uid else set(self.values)
+        for op in self._overlay(read_ts):
+            if op.op == "set":
+                base.add(op.src)
+            elif op.op == "del_all":
+                base.discard(op.src)
+            elif op.op == "del":
+                pass  # conservative: cheap check below
+        out = np.fromiter(base, dtype=np.uint64, count=len(base))
+        out.sort()
+        if self.deltas:
+            # exact: drop uids whose postings are now empty
+            keep = [u for u in out.tolist()
+                    if (len(self.get_dst_uids(u, read_ts)) if self.is_uid
+                        else len(self.get_postings(u, read_ts)))]
+            out = np.asarray(keep, dtype=np.uint64)
+        return out
+
+    def count_of(self, src: int, read_ts: int) -> int:
+        if self.is_uid:
+            return len(self.get_dst_uids(src, read_ts))
+        return len(self.get_postings(src, read_ts))
+
+    def get_facets(self, src: int, dst: int, read_ts: int) -> dict:
+        out = self.edge_facets.get((src, dst), {})
+        for op in self._overlay(read_ts):
+            if op.op == "set" and op.src == src and op.dst == dst and op.facets:
+                out = op.facets
+        return out
+
+    # -- rollup (ref posting/list.go:708 Rollup + worker/draft.go:407) --
+
+    def dirty(self) -> bool:
+        return bool(self.deltas)
+
+    def rollup(self, watermark: int):
+        """Fold deltas with ts <= watermark into base state."""
+        keep: list[tuple[int, list[EdgeOp]]] = []
+        folded = False
+        for ts, ops in self.deltas:
+            if ts > watermark:
+                keep.append((ts, ops))
+                continue
+            folded = True
+            for op in ops:
+                self._fold(op)
+            self.base_ts = max(self.base_ts, ts)
+        self.deltas = keep
+        if folded:
+            self._device_ts = -1  # invalidate device snapshot
+
+    def _fold(self, op: EdgeOp):
+        src = op.src
+        if op.op == "del_all":
+            if self.is_uid:
+                for dst in self.edges.pop(src, _EMPTY):
+                    self.reverse[int(dst)] = _rm(
+                        self.reverse.get(int(dst), _EMPTY), src)
+                    self.edge_facets.pop((src, int(dst)), None)
+            else:
+                for p in self.values.pop(src, []):
+                    if self.schema.indexed:
+                        for tk in self._tokens(p):
+                            self.index[tk] = _rm(
+                                self.index.get(tk, _EMPTY), src)
+            return
+        if self.is_uid:
+            if op.op == "set":
+                self.edges[src] = _ins(self.edges.get(src, _EMPTY), op.dst)
+                if self.schema.reverse:
+                    self.reverse[op.dst] = _ins(
+                        self.reverse.get(op.dst, _EMPTY), src)
+                if op.facets:
+                    self.edge_facets[(src, op.dst)] = op.facets
+            else:
+                self.edges[src] = _rm(self.edges.get(src, _EMPTY), op.dst)
+                if not len(self.edges[src]):
+                    del self.edges[src]
+                if self.schema.reverse:
+                    self.reverse[op.dst] = _rm(
+                        self.reverse.get(op.dst, _EMPTY), src)
+                self.edge_facets.pop((src, op.dst), None)
+            return
+        # value posting
+        if op.op == "set":
+            self.values[src] = self._merge_posting(
+                self.values.get(src, []), op.posting)
+            if self.schema.indexed:
+                for tk in self._tokens(op.posting):
+                    self.index[tk] = _ins(self.index.get(tk, _EMPTY), src)
+        else:
+            before = self.values.get(src, [])
+            after = [p for p in before
+                     if not (p.lang == op.posting.lang
+                             and value_fingerprint(p.value)
+                             == value_fingerprint(op.posting.value))]
+            self.values[src] = after
+            if not after:
+                del self.values[src]
+            if self.schema.indexed:
+                for tk in self._tokens(op.posting):
+                    self.index[tk] = _rm(self.index.get(tk, _EMPTY), src)
+
+    # -- index (re)build: Alter adding @index to live data
+    #    (ref posting/index.go:496 rebuilder) --
+
+    def rebuild_index(self):
+        self.index = {}
+        if not self.schema.indexed:
+            return
+        for src, plist in self.values.items():
+            for p in plist:
+                for tk in self._tokens(p):
+                    self.index[tk] = _ins(self.index.get(tk, _EMPTY), src)
+
+    def rebuild_reverse(self):
+        self.reverse = {}
+        if not (self.is_uid and self.schema.reverse):
+            return
+        for src, dsts in self.edges.items():
+            for dst in dsts:
+                self.reverse[int(dst)] = _ins(
+                    self.reverse.get(int(dst), _EMPTY), src)
+
+    # -- sortable keys for device values --
+
+    def sort_key_pairs(self) -> dict[int, int]:
+        """uid -> int64 sort key of its (first, no-lang) value."""
+        out = {}
+        for src, plist in self.values.items():
+            for p in plist:
+                if p.lang:
+                    continue
+                try:
+                    out[src] = sort_key(self._converted(p))
+                except ValueError:
+                    pass
+                break
+        return out

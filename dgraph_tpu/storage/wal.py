@@ -1,0 +1,65 @@
+"""Write-ahead log: durability for committed transactions.
+
+The reference persists every mutation through Badger's value log +
+Raft WAL (raftwal/storage.go over Badger). Round-1 equivalent: an
+append-only record log with length-prefixed pickled commit records and
+an fsync policy; the engine replays it at open. Raft replication plugs
+in above this (cluster/), snapshotting truncates it (ref
+worker/draft.go:1206 calculateSnapshot).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+from typing import Any, Iterator
+
+_MAGIC = b"DGTWAL1\x00"
+
+
+class Wal:
+    def __init__(self, path: str, sync: bool = False):
+        self.path = path
+        self.sync = sync
+        exists = os.path.exists(path)
+        self._f = open(path, "ab+")
+        if not exists or self._f.tell() == 0:
+            self._f.write(_MAGIC)
+            self._f.flush()
+
+    def append(self, record: Any):
+        blob = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
+        self._f.write(struct.pack("<I", len(blob)))
+        self._f.write(blob)
+        self._f.flush()
+        if self.sync:
+            os.fsync(self._f.fileno())
+
+    def replay(self) -> Iterator[Any]:
+        with open(self.path, "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise IOError(f"bad WAL magic in {self.path}")
+            while True:
+                hdr = f.read(4)
+                if len(hdr) < 4:
+                    break
+                (n,) = struct.unpack("<I", hdr)
+                blob = f.read(n)
+                if len(blob) < n:
+                    break  # torn tail write: ignore, next append overwrites
+                yield pickle.loads(blob)
+
+    def truncate(self):
+        """Reset after a snapshot has captured state (ref raft WAL
+        truncation below snapshot index, raftwal/storage.go:594)."""
+        self._f.close()
+        self._f = open(self.path, "wb")
+        self._f.write(_MAGIC)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f = open(self.path, "ab+")
+
+    def close(self):
+        self._f.close()
