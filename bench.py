@@ -1,0 +1,160 @@
+"""Benchmark: 3-hop BFS traversal over a synthetic social graph.
+
+This measures the north-star data plane (BASELINE.md): multi-hop
+frontier expansion — posting-list decode + merge + dedup — which in the
+reference is worker/task.go:581's per-uid loop + algo.MergeSorted heaps
+under query/recurse.go. The 21-million-RDF movie dataset is not
+fetchable in this environment (zero egress), so the graph is a
+synthetic scale-free graph of comparable shape (power-law out-degrees,
+~10 avg degree).
+
+Baseline: the same traversal in single-core vectorized NumPy over CSR —
+a faithful (and generous: NumPy's C loops beat Go's heap merges) stand-in
+for the reference's CPU path, which cannot be built here (Go module
+downloads need network).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+vs_baseline = baseline_p50 / our_p50  (>1 means faster than baseline).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_NODES = int(os.environ.get("BENCH_NODES", 300_000))
+N_EDGES = int(os.environ.get("BENCH_EDGES", 3_000_000))
+SEEDS = 256
+DEPTH = 3
+RUNS = 15
+BASE_RUNS = 5
+
+
+def make_graph(n_nodes: int, n_edges: int, seed: int = 0):
+    """Scale-free-ish: Zipf-weighted destinations, uniform sources."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(1, n_nodes + 1, n_edges, dtype=np.uint64)
+    # zipf over node ids truncated to range (heavy head like a movie graph)
+    dst = (rng.zipf(1.3, n_edges) % n_nodes + 1).astype(np.uint64)
+    mask = src != dst
+    src, dst = src[mask], dst[mask]
+    pairs = np.unique(np.stack([src, dst], axis=1), axis=0)
+    src, dst = pairs[:, 0], pairs[:, 1]
+    order = np.lexsort((dst, src))
+    src, dst = src[order], dst[order]
+    # CSR
+    uniq_src, starts = np.unique(src, return_index=True)
+    indptr = np.append(starts, len(src))
+    return uniq_src, indptr, dst
+
+
+def csr_to_dict(uniq_src, indptr, dst):
+    return {int(u): dst[indptr[i]: indptr[i + 1]].astype(np.uint32)
+            for i, u in enumerate(uniq_src)}
+
+
+def numpy_bfs(uniq_src, indptr, dst, seeds, depth):
+    """Single-core CPU baseline: vectorized CSR frontier expansion."""
+    visited = seeds.copy()
+    frontier = seeds
+    for _ in range(depth):
+        idx = np.searchsorted(uniq_src, frontier)
+        idx = np.clip(idx, 0, len(uniq_src) - 1)
+        hit = uniq_src[idx] == frontier
+        rows = idx[hit]
+        if not len(rows):
+            frontier = np.empty(0, np.uint64)
+            break
+        parts = [dst[indptr[r]: indptr[r + 1]] for r in rows]
+        nxt = np.unique(np.concatenate(parts))
+        nxt = np.setdiff1d(nxt, visited, assume_unique=True)
+        visited = np.union1d(visited, nxt)
+        frontier = nxt
+    return len(frontier)
+
+
+def main():
+    t0 = time.time()
+    uniq_src, indptr, dst = make_graph(N_NODES, N_EDGES)
+    n_edges = len(dst)
+    sys.stderr.write(f"graph: {len(uniq_src)} srcs, {n_edges} edges "
+                     f"({time.time()-t0:.1f}s)\n")
+
+    rng = np.random.default_rng(1)
+    seed_sets = [np.sort(rng.choice(uniq_src, SEEDS, replace=False))
+                 for _ in range(max(RUNS, BASE_RUNS))]
+
+    # ---- CPU baseline ----
+    base_times = []
+    base_counts = []
+    for i in range(BASE_RUNS):
+        t = time.perf_counter()
+        c = numpy_bfs(uniq_src, indptr, dst, seed_sets[i], DEPTH)
+        base_times.append(time.perf_counter() - t)
+        base_counts.append(c)
+    base_p50 = float(np.median(base_times)) * 1e3
+    sys.stderr.write(f"numpy baseline p50 {base_p50:.1f} ms "
+                     f"counts {base_counts}\n")
+
+    # ---- device path ----
+    import jax
+    # sitecustomize pre-imports jax, so the env var alone doesn't take
+    # effect; honor an explicit JAX_PLATFORMS via config (lets CI force
+    # cpu while the driver's TPU run uses the default backend).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        if os.environ["JAX_PLATFORMS"] == "cpu":
+            from jax._src import xla_bridge as _xb
+            _xb._backend_factories.pop("axon", None)
+            _xb._backend_factories.pop("tpu", None)
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(
+                          os.path.abspath(__file__)), ".jax_cache"))
+    sys.stderr.write(f"jax devices: {jax.devices()}\n")
+
+    from dgraph_tpu.ops.graph import build_adjacency
+    from dgraph_tpu.ops.traverse import make_bfs
+    from dgraph_tpu.ops.uidvec import from_numpy, pad_to
+
+    t0 = time.time()
+    edges = csr_to_dict(uniq_src, indptr, dst)
+    adj = build_adjacency(edges)
+    sys.stderr.write(f"device adjacency built ({time.time()-t0:.1f}s), "
+                     f"buckets={[(b.src.shape[0], b.degree) for b in adj.buckets]}\n")
+
+    seed_size = pad_to(SEEDS)
+    fn = make_bfs(adj, seed_size, DEPTH)
+
+    def run(i):
+        seeds32 = seed_sets[i % len(seed_sets)].astype(np.uint32)
+        levels = fn(from_numpy(seeds32, seed_size))
+        jax.block_until_ready(levels)
+        return int(np.sum(np.asarray(levels[-1]) != 0xFFFFFFFF))
+
+    t0 = time.time()
+    c0 = run(0)  # compile
+    sys.stderr.write(f"compile+first run {time.time()-t0:.1f}s "
+                     f"count {c0} (baseline count {base_counts[0]})\n")
+    if c0 != base_counts[0]:
+        sys.stderr.write("WARNING: device/baseline count mismatch!\n")
+
+    times = []
+    for i in range(RUNS):
+        t = time.perf_counter()
+        run(i)
+        times.append(time.perf_counter() - t)
+    p50 = float(np.median(times)) * 1e3
+
+    print(json.dumps({
+        "metric": f"bfs{DEPTH}_p50_latency_{n_edges//1_000_000}Medges",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(base_p50 / p50, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -374,6 +374,43 @@ class GraphDB:
         lat.processing_ns = time.perf_counter_ns() - t0
         return {"data": data, "extensions": {"latency": lat.as_dict()}}
 
+    # ------------------------------------------------------------------
+    # Bulk traversal API: the device-first equivalent of @recurse for
+    # analytical workloads (ref query/recurse.go semantics, level sets
+    # instead of nested JSON).
+    # ------------------------------------------------------------------
+
+    def bfs(self, pred: str, seeds, depth: int,
+            dedup: bool = True) -> list[np.ndarray]:
+        """Per-level frontier uid arrays reachable from `seeds` via
+        `pred`, device-accelerated when the tablet is clean."""
+        from dgraph_tpu.engine.device_cache import _MAX_U32, device_adjacency
+        from dgraph_tpu.ops.traverse import bfs_reach
+
+        seeds = np.asarray(sorted(set(int(s) for s in seeds)),
+                           dtype=np.uint64)
+        tab = self.tablets.get(pred)
+        if tab is None:
+            return [np.empty(0, np.uint64) for _ in range(depth)]
+        read_ts = self.coordinator.max_assigned()
+        adj = device_adjacency(self, tab, read_ts) if self.prefer_device \
+            else None
+        if adj is not None:
+            lv32 = bfs_reach(adj, seeds[seeds <= _MAX_U32], depth, dedup)
+            return [lv.astype(np.uint64) for lv in lv32]
+        # host fallback: same semantics over the MVCC overlay
+        levels = []
+        visited = seeds
+        frontier = seeds
+        for _ in range(depth):
+            nxt = tab.expand_frontier(frontier, read_ts)
+            if dedup:
+                nxt = np.setdiff1d(nxt, visited, assume_unique=True)
+                visited = np.union1d(visited, nxt)
+            levels.append(nxt)
+            frontier = nxt
+        return levels
+
     # -- maintenance --
 
     def rollup_all(self):

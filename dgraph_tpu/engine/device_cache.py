@@ -83,8 +83,10 @@ def expand_np(adj: DeviceAdjacency, src_u64: np.ndarray) -> np.ndarray:
     adjacency object, so repeated traversal levels reuse compiled code.
     """
     # uids beyond uint32 cannot exist in a <=32-bit tablet: drop them
-    # instead of letting astype(uint32) alias them onto real low uids
-    src_u64 = src_u64[src_u64 <= _MAX_U32]
+    # instead of letting astype(uint32) alias them onto real low uids.
+    # Sort: the kernels' membership tests binary-search INTO the
+    # frontier, and callers (e.g. order-by results) may pass any order.
+    src_u64 = np.sort(src_u64[src_u64 <= _MAX_U32])
     f_pad = pad_to(len(src_u64))
     cache = getattr(adj, "_expander_cache", None)
     if cache is None:

@@ -61,6 +61,7 @@ class DeviceAdjacency:
     degrees: jax.Array    # [N] int32 aligned to src_uids
     buckets: list[AdjBucket] = field(default_factory=list)
     n_edges: int = 0
+    n_dst: int = 0        # distinct destination uids (bounds any union)
 
     @property
     def shape_sig(self):
@@ -104,23 +105,46 @@ def build_adjacency(edges: dict[int, np.ndarray],
                 nb[i, : len(dst)] = dst
             buckets.append(AdjBucket(jnp.asarray(bsrc), jnp.asarray(nb),
                                      int(cap)))
+    n_dst = 0
+    if edges:
+        n_dst = len(np.unique(np.concatenate(
+            [np.asarray(v) for v in edges.values()])))
     return DeviceAdjacency(jnp.asarray(src_pad), jnp.asarray(deg_pad),
-                           buckets, n_edges)
+                           buckets, n_edges, n_dst)
 
 
 def _bucket_candidates(frontier: jax.Array, b: AdjBucket) -> jax.Array:
     """Flat (unsorted, SENTINEL-masked) neighbor candidates of `frontier`
-    rows present in bucket `b`: one searchsorted + one gather."""
-    idx = jnp.clip(jnp.searchsorted(b.src, frontier), 0, b.src.shape[0] - 1)
-    hit = (b.src[idx] == frontier) & (frontier != SENTINEL)
-    cand = b.neighbors[idx]                     # [F, D]
-    cand = jnp.where(hit[:, None], cand, SENTINEL)
+    rows present in bucket `b`.
+
+    Two duals of the same lookup, chosen at trace time by static shape:
+      frontier smaller than bucket  -> gather rows for each frontier uid
+                                       ([F, D] work)
+      bucket smaller than frontier  -> mask bucket rows that appear in
+                                       the frontier ([M, D] work)
+    Work per hop is thus bounded by min(F, M) * D per bucket — a large
+    frontier can never blow past the bucket's own edge count.
+    """
+    F = frontier.shape[0]
+    M = b.src.shape[0]
+    if F <= M:
+        idx = jnp.clip(jnp.searchsorted(b.src, frontier), 0, M - 1)
+        hit = (b.src[idx] == frontier) & (frontier != SENTINEL)
+        cand = b.neighbors[idx]                 # [F, D]
+        cand = jnp.where(hit[:, None], cand, SENTINEL)
+    else:
+        hit = member_mask(b.src, frontier)      # [M]
+        cand = jnp.where(hit[:, None], b.neighbors, SENTINEL)
     return cand.reshape(-1)
 
 
 def expand(adj: DeviceAdjacency, frontier: jax.Array,
            out_size: int) -> jax.Array:
     """One BFS level: union of all neighbors of `frontier`.
+
+    `frontier` MUST be sorted (SENTINEL-padded): the bucket membership
+    test binary-searches into it when the frontier is larger than the
+    bucket. Host entry points (device_cache.expand_np, bfs_reach) sort.
 
     Result is a padded sorted UID vector of static length `out_size`
     (truncates if the true union exceeds it — caller sizes via
@@ -144,10 +168,13 @@ def expand(adj: DeviceAdjacency, frontier: jax.Array,
 
 
 def max_expansion(adj: DeviceAdjacency, frontier_size: int) -> int:
-    """Static bound on expand() output size for a frontier of F uids."""
+    """Static bound on expand() output size for a frontier of F uids:
+    the union can never exceed the distinct-destination count, nor the
+    per-bucket work bound."""
     total = sum(min(b.src.shape[0], frontier_size) * b.degree
                 for b in adj.buckets)
-    return max(8, min(total, pad_to(adj.n_edges)))
+    cap = pad_to(adj.n_dst or adj.n_edges)
+    return max(8, min(total, cap))
 
 
 def count_gather(adj: DeviceAdjacency, uids: jax.Array) -> jax.Array:

@@ -1,0 +1,120 @@
+"""Multi-hop traversal kernels: BFS frontiers and SSSP relaxation.
+
+TPU re-design of the reference's graph algorithms:
+  - query/recurse.go:29   per-level goroutine fan-out over posting lists
+  - query/shortest.go:451 route()/Dijkstra with a priority queue
+  - query/shortest.go:287 k-shortest paths
+
+Both become dense frontier algebra over the resident adjacency tiles
+(ops/graph.py): BFS is `depth` rounds of expand + difference-vs-visited;
+SSSP is Bellman-Ford-style relaxation — per round, every bucket does one
+gather of source distances, one vectorized add of edge weight, and one
+scatter-min onto the distance vector.  No queues, no per-node control
+flow; compiled once per (adjacency shape, seed bucket, depth).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.ops.graph import DeviceAdjacency, expand, max_expansion
+from dgraph_tpu.ops.uidvec import SENTINEL, compact, member_mask, pad_to
+
+INT32_INF = np.int32(2**31 - 1)
+
+
+def make_bfs(adj: DeviceAdjacency, seed_size: int, depth: int,
+             dedup: bool = True) -> Callable:
+    """Compile a BFS: seeds [seed_size] -> tuple of per-level frontiers.
+
+    Level sizes are static, derived from max_expansion per level and
+    capped by the distinct-node bound, so the whole unrolled traversal
+    is one XLA program. With dedup=False this matches @recurse's
+    loop:true mode (ref gql RecurseArgs.AllowLoop).
+    """
+    sizes = [seed_size]
+    for _ in range(depth):
+        sizes.append(max_expansion(adj, sizes[-1]))
+
+    def bfs(seeds: jax.Array):
+        levels = []
+        frontier = seeds
+        visited = seeds
+        for d in range(depth):
+            nxt = expand(adj, frontier, sizes[d + 1])
+            if dedup:
+                keep = ~member_mask(nxt, visited)
+                nxt = compact(jnp.where(keep, nxt, SENTINEL))
+                visited = compact(
+                    jnp.concatenate([visited, nxt]))[: visited.shape[0]
+                                                     + nxt.shape[0]]
+            levels.append(nxt)
+            frontier = nxt
+        return tuple(levels)
+
+    return jax.jit(bfs)
+
+
+def bfs_reach(adj: DeviceAdjacency, seeds_np: np.ndarray, depth: int,
+              dedup: bool = True) -> list[np.ndarray]:
+    """Host wrapper: run BFS, return per-level frontier uid arrays."""
+    from dgraph_tpu.ops.uidvec import from_numpy, to_numpy
+
+    seeds_np = np.sort(np.asarray(seeds_np, dtype=np.uint32))
+    seed_size = pad_to(len(seeds_np))
+    fn = make_bfs(adj, seed_size, depth, dedup)
+    levels = fn(from_numpy(seeds_np, seed_size))
+    return [to_numpy(lv) for lv in levels]
+
+
+# ---------------------------------------------------------------------------
+# SSSP: hop-count (or uniform-weight) distances via frontier relaxation
+# ---------------------------------------------------------------------------
+
+
+def make_sssp(adj: DeviceAdjacency, max_iters: int) -> Callable:
+    """Compile single-source (or multi-source) shortest hop-count
+    distances over this adjacency.
+
+    Returns fn(seed_mask_uids [S]) -> (node_uids [N], dist [N] int32)
+    where node_uids is the adjacency's source vector augmented with
+    nothing — distances are tracked for *source* slots; destinations
+    that are never sources still get found through the frontier value
+    but their final distance comes from the frontier levels.
+
+    Implementation: dist over the adjacency's src slot space; per
+    round, for each bucket gather dist of its rows, add 1, scatter-min
+    into the slots of the neighbor uids (searchsorted into src_uids).
+    Neighbors that are not sources are leaves: they cannot relax
+    further, so BFS levels (bfs_reach) cover them; route reconstruction
+    happens host-side from the level sets (ref query/shortest.go route).
+    """
+    src = adj.src_uids
+    n = src.shape[0]
+
+    def sssp(seeds: jax.Array):
+        seeded = member_mask(src, seeds)
+        dist = jnp.where(seeded, jnp.int32(0), INT32_INF)
+        for _ in range(max_iters):
+            for b in adj.buckets:
+                rows = jnp.clip(jnp.searchsorted(src, b.src), 0, n - 1)
+                ok = (src[rows] == b.src) & (b.src != SENTINEL)
+                d_here = jnp.where(ok, dist[rows], INT32_INF)  # [M]
+                cand = jnp.where(
+                    (d_here < INT32_INF)[:, None]
+                    & (b.neighbors != SENTINEL),
+                    d_here[:, None] + 1, INT32_INF)            # [M, D]
+                tgt = jnp.clip(jnp.searchsorted(src, b.neighbors.reshape(-1)),
+                               0, n - 1)
+                tgt_ok = src[tgt] == b.neighbors.reshape(-1)
+                tgt = jnp.where(tgt_ok, tgt, n - 1)
+                upd = jnp.where(tgt_ok, cand.reshape(-1), INT32_INF)
+                dist = dist.at[tgt].min(upd)
+        return src, dist
+
+    return jax.jit(sssp)

@@ -1,0 +1,22 @@
+"""Distribution: device mesh, sharded tablets, cross-shard collectives.
+
+Parallelism mapping (SURVEY §2b): the reference scales by
+  - predicate sharding ("tablets" moved between groups by Zero,
+    dgraph/cmd/zero/tablet.go)          -> mesh axis "tablet"
+  - multi-part posting lists (one huge edge list split across nodes,
+    posting/list.go:1149)               -> mesh axis "uid" (uid-range
+                                           shards of one predicate's
+                                           adjacency; the sequence-
+                                           parallel analogue)
+  - scatter-gather query fan-out
+    (query/query.go:2017 goroutines)    -> mesh axis "data" (query/seed
+                                           batch)
+Cross-shard exchange that the reference does with gRPC streams
+(worker/predicate_move.go, conn/) rides ICI collectives here:
+all_gather for frontier union, psum for counts.
+"""
+
+from dgraph_tpu.parallel.mesh import make_mesh
+from dgraph_tpu.parallel.dist_graph import (
+    ShardedAdjacency, build_sharded_adjacency, make_sharded_bfs,
+)

@@ -1,0 +1,33 @@
+"""Device mesh construction."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: int | None = None,
+              axes: tuple[str, ...] = ("data", "tablet", "uid")) -> Mesh:
+    """Factor the available devices into a mesh over `axes`.
+
+    Axis meaning (see package docstring): data = query batch, tablet =
+    predicate shards, uid = uid-range shards of one predicate. Axes are
+    sized by repeatedly splitting the device count by its largest
+    power-of-two factor, rightmost (uid — most bandwidth-hungry, rides
+    the fastest ICI dimension) first.
+    """
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    sizes = [1] * len(axes)
+    i = len(axes) - 1
+    while n % 2 == 0 and n > 1:
+        sizes[i] *= 2
+        n //= 2
+        i = (i - 1) % len(axes)
+    sizes[-1] *= n  # odd remainder onto the uid axis
+    arr = np.asarray(devs).reshape(sizes)
+    return Mesh(arr, axes)

@@ -686,13 +686,7 @@ class Executor:
             dev = self._device_expand(tab, src)
         if dev is not None:
             return dev
-        out = _EMPTY
-        getter = tab.get_reverse_uids if reverse else tab.get_dst_uids
-        parts = [getter(int(u), self.read_ts) for u in src.tolist()]
-        parts = [p for p in parts if len(p)]
-        if parts:
-            out = np.unique(np.concatenate(parts))
-        return out
+        return tab.expand_frontier(src, self.read_ts, reverse)
 
     def _device_expand(self, tab: Tablet, src: np.ndarray
                        ) -> Optional[np.ndarray]:

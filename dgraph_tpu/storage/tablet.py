@@ -246,6 +246,18 @@ class Tablet:
             out = np.asarray(keep, dtype=np.uint64)
         return out
 
+    def expand_frontier(self, frontier: np.ndarray, read_ts: int,
+                        reverse: bool = False) -> np.ndarray:
+        """Union of destination uids over a frontier — the single host
+        implementation of one BFS level (device analogue:
+        ops/graph.expand). Both the executor and GraphDB.bfs use this."""
+        getter = self.get_reverse_uids if reverse else self.get_dst_uids
+        parts = [getter(int(u), read_ts) for u in frontier.tolist()]
+        parts = [p for p in parts if len(p)]
+        if not parts:
+            return _EMPTY.copy()
+        return np.unique(np.concatenate(parts))
+
     def count_of(self, src: int, read_ts: int) -> int:
         if self.is_uid:
             return len(self.get_dst_uids(src, read_ts))

@@ -1,0 +1,124 @@
+"""BFS / SSSP kernel tests vs NumPy oracles, incl. the GraphDB.bfs
+device/host parity (ref query/recurse_test.go, query/shortest_test.go)."""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.engine import GraphDB
+from dgraph_tpu.ops.graph import build_adjacency
+from dgraph_tpu.ops.traverse import bfs_reach, make_sssp
+from dgraph_tpu.ops.uidvec import from_numpy, pad_to
+
+
+def random_graph(n=60, avg_deg=3, seed=0):
+    rng = np.random.default_rng(seed)
+    edges = {}
+    for u in range(1, n + 1):
+        k = rng.integers(1, avg_deg * 2)
+        dst = np.unique(rng.integers(1, n + 1, k)).astype(np.uint32)
+        dst = dst[dst != u]
+        if len(dst):
+            edges[u] = dst
+    return edges
+
+
+def np_bfs(edges, seeds, depth, dedup=True):
+    levels = []
+    visited = set(seeds)
+    frontier = list(seeds)
+    for _ in range(depth):
+        nxt = set()
+        for u in frontier:
+            for d in edges.get(u, []):
+                nxt.add(int(d))
+        if dedup:
+            nxt -= visited
+            visited |= nxt
+        levels.append(np.asarray(sorted(nxt), dtype=np.uint64))
+        frontier = sorted(nxt)
+    return levels
+
+
+def test_bfs_oracle():
+    edges = random_graph()
+    adj = build_adjacency(edges)
+    seeds = np.asarray([1, 2, 3], dtype=np.uint32)
+    got = bfs_reach(adj, seeds, 3)
+    want = np_bfs(edges, [1, 2, 3], 3)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g.astype(np.uint64), w)
+
+
+def test_bfs_no_dedup():
+    edges = {1: np.array([2], np.uint32), 2: np.array([1], np.uint32)}
+    adj = build_adjacency(edges)
+    got = bfs_reach(adj, np.asarray([1], np.uint32), 3, dedup=False)
+    assert [g.tolist() for g in got] == [[2], [1], [2]]
+
+
+def test_sssp_oracle():
+    edges = random_graph(40, seed=7)
+    adj = build_adjacency(edges)
+    fn = make_sssp(adj, max_iters=6)
+    seeds = from_numpy(np.asarray([1], np.uint32), 8)
+    src, dist = fn(seeds)
+    src = np.asarray(src)
+    dist = np.asarray(dist)
+    # oracle: hop distances via numpy BFS
+    want = {1: 0}
+    frontier = [1]
+    for d in range(1, 7):
+        nxt = []
+        for u in frontier:
+            for t in edges.get(u, []):
+                if int(t) not in want:
+                    want[int(t)] = d
+                    nxt.append(int(t))
+        frontier = nxt
+    for i, u in enumerate(src.tolist()):
+        if u == 0xFFFFFFFF:
+            continue
+        if u in want:
+            assert dist[i] == want[u], f"uid {u}"
+        else:
+            assert dist[i] == 2**31 - 1
+
+
+def test_unsorted_frontier_regression():
+    """Regression: expand's F>M membership branch binary-searches INTO
+    the frontier; host wrappers must sort caller-provided orderings."""
+    from dgraph_tpu.engine.device_cache import expand_np
+
+    # one big-degree bucket with few rows (M small), frontier bigger (F>M)
+    edges = {5: np.arange(100, 140, dtype=np.uint32),
+             9: np.arange(200, 240, dtype=np.uint32)}
+    adj = build_adjacency(edges)
+    frontier = np.asarray([9, 1, 5, 7, 3, 8, 2, 6, 4, 11, 12, 13, 14, 15,
+                           16, 17, 18], dtype=np.uint64)  # unsorted, F>M
+    got = expand_np(adj, frontier)
+    want = np.union1d(edges[5], edges[9]).astype(np.uint64)
+    np.testing.assert_array_equal(got, want)
+
+    got_bfs = bfs_reach(adj, frontier[: 3].astype(np.uint32), 1)[0]
+    np.testing.assert_array_equal(np.sort(got_bfs),
+                                  np.union1d(edges[5], edges[9]))
+
+
+def test_graphdb_bfs_parity():
+    lines = []
+    edges = random_graph(50, seed=3)
+    for u, dsts in edges.items():
+        for d in dsts:
+            lines.append(f"<{hex(u)}> <link> <{hex(int(d))}> .")
+    host = GraphDB(prefer_device=False)
+    host.alter("link: [uid] .")
+    host.mutate(set_nquads="\n".join(lines))
+    dev = GraphDB(prefer_device=True, device_min_edges=1)
+    dev.alter("link: [uid] .")
+    dev.mutate(set_nquads="\n".join(lines))
+    for dedup in (True, False):
+        a = host.bfs("link", [1, 5], 3, dedup=dedup)
+        b = dev.bfs("link", [1, 5], 3, dedup=dedup)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+    assert dev.tablets["link"]._device_adj is not None
