@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import hashlib
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as _dc_replace
 from typing import Any, Optional
 
 import numpy as np
@@ -58,6 +58,18 @@ class Txn:
     conflict_keys: set = field(default_factory=set)
     uid_map: dict[str, int] = field(default_factory=dict)  # blank -> uid
     done: bool = False
+
+
+@dataclass
+class Mutation:
+    """One mutation of a (possibly conditional upsert) request.
+    Ref api.Mutation: SetNquads/DelNquads/SetJson/DeleteJson/Cond."""
+
+    set_nquads: str = ""
+    del_nquads: str = ""
+    set_json: Any = None
+    delete_json: Any = None
+    cond: str = ""
 
 
 @dataclass
@@ -137,27 +149,148 @@ class GraphDB:
     def mutate(self, txn: Optional[Txn] = None, *,
                set_nquads: str = "", del_nquads: str = "",
                set_json: Any = None, delete_json: Any = None,
+               query: str = "", variables: dict | None = None,
+               mutations: Optional[list[Mutation]] = None,
+               cond: str = "",
                commit_now: bool = False) -> dict:
-        """Stage (and optionally commit) a mutation.
-        Returns {"uids": {...}} like the reference's api.Assigned."""
+        """Stage (and optionally commit) a mutation — optionally an upsert
+        block: `query` runs first at the txn's startTs and its uid/value
+        variables substitute into uid(v)/val(v) references in the
+        mutations; each mutation's @if `cond` gates it on len(v) checks
+        (ref edgraph/server.go:220 doMutate, :327 buildUpsertQuery,
+        :503-511 updateUIDInMutations/updateValInMutations).
+
+        Returns {"uids": {...}, "queries": {...}} like api.Response."""
         own = txn is None
         if txn is None:
             txn = self.new_txn()
-        nqs: list[tuple[NQuad, bool]] = []
-        if set_nquads:
-            nqs += [(n, False) for n in parse_rdf(set_nquads)]
-        if set_json is not None:
-            nqs += [(n, False) for n in parse_json_mutation(set_json)]
-        if del_nquads:
-            nqs += [(n, True) for n in parse_rdf(del_nquads)]
-        if delete_json is not None:
-            nqs += [(n, True)
-                    for n in parse_json_mutation(delete_json, delete=True)]
-        self._stage(txn, nqs)
+        legacy = set_nquads or del_nquads or set_json is not None \
+            or delete_json is not None
+        if cond and mutations and not legacy:
+            raise ValueError(
+                "cond applies to the set_/del_ args; with mutations=[...] "
+                "put the cond inside each Mutation")
+        muts = list(mutations) if mutations else []
+        if legacy:
+            muts.append(Mutation(set_nquads=set_nquads,
+                                 del_nquads=del_nquads,
+                                 set_json=set_json,
+                                 delete_json=delete_json, cond=cond))
+
+        try:
+            queries_json: dict = {}
+            ex = None
+            if query:
+                from dgraph_tpu.query.executor import Executor
+
+                parsed = gql_parse(query, variables)
+                ex = Executor(self, txn.start_ts)
+                queries_json = ex.run(parsed)
+
+            applied = False
+            for mut in muts:
+                if not self._cond_holds(mut.cond, ex):
+                    continue
+                nqs: list[tuple[NQuad, bool]] = []
+                if mut.set_nquads:
+                    nqs += [(n, False) for n in parse_rdf(mut.set_nquads)]
+                if mut.set_json is not None:
+                    nqs += [(n, False)
+                            for n in parse_json_mutation(mut.set_json)]
+                if mut.del_nquads:
+                    nqs += [(n, True) for n in parse_rdf(mut.del_nquads)]
+                if mut.delete_json is not None:
+                    nqs += [(n, True) for n in
+                            parse_json_mutation(mut.delete_json, delete=True)]
+                if ex is not None:
+                    nqs = self._substitute_vars(nqs, ex)
+                self._stage(txn, nqs)
+                applied = True
+        except Exception:
+            if own:
+                self.discard(txn)  # don't leak the ts in the oracle
+            raise
         if commit_now or own:
-            self.commit(txn)
-        return {"uids": {k[2:]: hex(v) for k, v in txn.uid_map.items()
-                         if k.startswith("_:")}}
+            if applied or not query:
+                self.commit(txn)
+            else:
+                self.discard(txn)  # all conds failed: nothing to commit
+        out = {"uids": {k[2:]: hex(v) for k, v in txn.uid_map.items()
+                        if k.startswith("_:")}}
+        if query:
+            out["queries"] = queries_json
+        return out
+
+    def _cond_holds(self, cond: str, ex) -> bool:
+        """Evaluate an @if condition over the upsert query's variables.
+        The reference restricts conds to boolean combinations of
+        eq/le/lt/ge/gt over len(v) (edgraph/server.go checkIfDeletingAcl →
+        gql cond validation)."""
+        from dgraph_tpu.gql.parser import parse_cond
+
+        ft = parse_cond(cond)
+        if ft is None:
+            return True
+        if ex is None:
+            raise ValueError("@if condition requires an upsert query block")
+        return self._eval_cond_tree(ft, ex)
+
+    def _eval_cond_tree(self, ft, ex) -> bool:
+        if ft.op == "and":
+            return all(self._eval_cond_tree(c, ex) for c in ft.children)
+        if ft.op == "or":
+            return any(self._eval_cond_tree(c, ex) for c in ft.children)
+        if ft.op == "not":
+            return not self._eval_cond_tree(ft.children[0], ex)
+        fn = ft.func
+        if fn is None or not fn.is_len_var or not fn.needs_var:
+            raise ValueError(
+                "@if supports eq/le/lt/ge/gt over len(v) expressions")
+        name = fn.needs_var[0].name
+        if name in ex.uid_vars:
+            n = len(ex.uid_vars[name])
+        elif name in ex.value_vars:
+            n = len(ex.value_vars[name])
+        else:
+            n = 0
+        want = int(fn.args[0].value)
+        return {"eq": n == want, "le": n <= want, "lt": n < want,
+                "ge": n >= want, "gt": n > want}[fn.name]
+
+    @staticmethod
+    def _uid_ref_var(ref: str) -> Optional[str]:
+        if ref.startswith("uid(") and ref.endswith(")"):
+            return ref[4:-1]
+        return None
+
+    def _substitute_vars(self, nqs: list[tuple[NQuad, bool]], ex
+                         ) -> list[tuple[NQuad, bool]]:
+        """Expand uid(v)/val(v) references against the upsert query's
+        variables. uid(v) fans out (cross product when both subject and
+        object are vars); an empty var drops the nquad; val(v) resolves
+        per concrete subject uid (ref edgraph/server.go:503
+        updateValInMutations, :511 updateUIDInMutations)."""
+        out: list[tuple[NQuad, bool]] = []
+        for nq, is_del in nqs:
+            svar = self._uid_ref_var(nq.subject)
+            subjects = [hex(int(u)) for u in ex.uid_vars.get(svar, [])] \
+                if svar else [nq.subject]
+            ovar = self._uid_ref_var(nq.object_id) if nq.object_id else None
+            objects = [hex(int(u)) for u in ex.uid_vars.get(ovar, [])] \
+                if ovar else [nq.object_id]
+            for s in subjects:
+                for o in objects:
+                    sub = _dc_replace(nq, subject=s, object_id=o)
+                    if nq.val_var:
+                        vmap = ex.value_vars.get(nq.val_var, {})
+                        v = vmap.get(int(s, 0)) if not s.startswith("_:") \
+                            else None
+                        if v is None:
+                            continue
+                        sub.object_value = v
+                        sub.val_var = ""
+                    out.append((sub, is_del))
+        return out
 
     def _resolve_uid(self, txn: Txn, ref: str) -> int:
         if ref.startswith("_:"):
@@ -180,6 +313,7 @@ class GraphDB:
     def _stage(self, txn: Txn, nqs: list[tuple[NQuad, bool]]):
         if txn.done:
             raise TxnAborted("transaction already finished")
+        nqs = self._expand_star_pred(txn, nqs)
         for nq, is_del in nqs:
             pred = nq.predicate
             src = self._resolve_uid(txn, nq.subject)
@@ -203,6 +337,28 @@ class GraphDB:
                             posting=Posting(val, nq.lang, nq.facets))
             txn.staged.append((pred, op))
             txn.conflict_keys.add(self._conflict_key(tab, op))
+
+    def _expand_star_pred(self, txn: Txn, nqs):
+        """`S * *` deletes every predicate S carries (ref
+        query/mutation.go:54 expandEdges on x.Star predicate). Expansion
+        reads the txn's own snapshot (start_ts) plus edges staged earlier
+        in this txn — the reference reads through the LocalCache."""
+        out = []
+        for nq, is_del in nqs:
+            if nq.predicate != "*":
+                out.append((nq, is_del))
+                continue
+            if not (is_del and nq.star):
+                raise ValueError(
+                    "'*' predicate is only allowed in a `S * *` delete")
+            src = self._resolve_uid(txn, nq.subject)
+            preds = {p for p, tab in self.tablets.items()
+                     if tab.count_of(src, txn.start_ts)}
+            preds.update(p for p, op in txn.staged
+                         if op.src == src and op.op == "set")
+            for pname in sorted(preds):
+                out.append((_dc_replace(nq, predicate=pname), is_del))
+        return out
 
     def _conflict_key(self, tab: Tablet, op: EdgeOp) -> int:
         """Ref posting/index.go:305 addMutationHelper conflict keys:

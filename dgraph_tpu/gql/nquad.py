@@ -21,13 +21,14 @@ from dgraph_tpu.models.types import TypeID, Val
 class NQuad:
     """One parsed triple. Ref pb.NQuad / api.NQuad."""
 
-    subject: str              # uid literal "0x1", blank "_:x", or xid
+    subject: str              # uid literal "0x1", blank "_:x", xid, or "uid(v)"
     predicate: str
-    object_id: str = ""       # set for uid objects
+    object_id: str = ""       # set for uid objects (may be "uid(v)")
     object_value: Val | None = None
     lang: str = ""
     facets: dict[str, Val] = field(default_factory=dict)
     star: bool = False        # object was *  (delete-all)
+    val_var: str = ""         # object was val(v) — upsert value substitution
 
 
 _XS_TYPES = {
@@ -70,6 +71,7 @@ _TERM = re.compile(
     | (?P<star>\*)
     | (?P<literal>"(?:\\.|[^"\\])*")
         (?:@(?P<lang>[\w\-]+)|\^\^<(?P<dtype>[^>]+)>)?
+    | (?P<func>(?:uid|val)\(\s*[\w.\-]+\s*\))
     | (?P<word>[\w.\-~/]+)
     )""",
     re.VERBOSE,
@@ -96,6 +98,16 @@ def parse_rdf(text: str) -> list[NQuad]:
     return out
 
 
+def _norm_func(raw: str, lineno: int, subject: bool) -> str:
+    """Normalize `uid( v )`/`val( v )` upsert references to `uid(v)` form
+    (ref chunker/rdf_parser.go uid/val function terms)."""
+    kind = raw[:3]
+    inner = raw[4:-1].strip()
+    if subject and kind == "val":
+        raise GQLError(f"rdf line {lineno}: val() not allowed as subject")
+    return f"{kind}({inner})"
+
+
 def _take(line: str, lineno: int):
     m = _TERM.match(line)
     if not m:
@@ -109,13 +121,19 @@ def _parse_one(line: str, lineno: int) -> tuple[NQuad, str]:
         subject = m.group("iri")[1:-1]
     elif m.group("blank"):
         subject = m.group("blank")
+    elif m.group("func"):
+        subject = _norm_func(m.group("func"), lineno, subject=True)
     elif m.group("word"):
         subject = m.group("word")
     else:
         raise GQLError(f"rdf line {lineno}: bad subject")
 
     m, rest = _take(rest, lineno)
-    pred = (m.group("iri") or "")[1:-1] if m.group("iri") else m.group("word")
+    if m.group("star"):
+        pred = "*"  # S * * — delete every predicate of S (expanded later)
+    else:
+        pred = (m.group("iri") or "")[1:-1] if m.group("iri") \
+            else m.group("word")
     if not pred:
         raise GQLError(f"rdf line {lineno}: bad predicate")
 
@@ -138,6 +156,12 @@ def _parse_one(line: str, lineno: int) -> tuple[NQuad, str]:
         nq.object_id = m.group("iri")[1:-1]
     elif m.group("blank"):
         nq.object_id = m.group("blank")
+    elif m.group("func"):
+        f = _norm_func(m.group("func"), lineno, subject=False)
+        if f.startswith("val("):
+            nq.val_var = f[4:-1]
+        else:
+            nq.object_id = f
     elif m.group("word"):
         nq.object_id = m.group("word")
 

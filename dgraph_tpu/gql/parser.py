@@ -335,6 +335,23 @@ def _parse_filter(cur: Cursor, gvars: dict) -> FilterTree:
     return tree
 
 
+def parse_cond(text: str) -> FilterTree | None:
+    """Parse an upsert conditional mutation's `@if(...)` expression
+    (ref gql.ParseMutation conditional handling, gql/parser_mutation.go:26
+    + edgraph/server.go:220 doMutate cond evaluation)."""
+    text = (text or "").strip()
+    if not text:
+        return None
+    if text.startswith("@if"):
+        text = text[3:].lstrip()
+    cur = Cursor(tokenize(text))
+    tree = _parse_filter(cur, {})
+    t = cur.peek()
+    if t.kind != "eof":
+        raise GQLError(f"line {t.line}: trailing input in @if condition")
+    return tree
+
+
 def _parse_filter_or(cur: Cursor, gvars: dict) -> FilterTree:
     left = _parse_filter_and(cur, gvars)
     children = [left]

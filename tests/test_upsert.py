@@ -1,0 +1,171 @@
+"""Upsert blocks + conditional mutations.
+
+Behavior model: the reference's upsert suite
+(dgraph/cmd/alpha/upsert_test.go) — query block feeds uid(v)/val(v)
+substitution into mutations, @if gates on len(v).
+"""
+
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB, Mutation
+
+
+@pytest.fixture
+def db():
+    d = GraphDB(prefer_device=False)
+    d.alter("email: string @index(exact) @upsert .\n"
+            "name: string @index(term) .\n"
+            "age: int .\n"
+            "friend: [uid] .")
+    return d
+
+
+def _uids(db, q):
+    data = db.query(q)["data"]
+    (block,) = data.values()
+    return [o["uid"] for o in block]
+
+
+def test_insert_if_absent(db):
+    up = {
+        "query": '{ q(func: eq(email, "a@x.io")) { v as uid } }',
+        "cond": "@if(eq(len(v), 0))",
+        "set_nquads": '_:u <email> "a@x.io" .\n_:u <name> "Alice" .',
+    }
+    r1 = db.mutate(query=up["query"], cond=up["cond"],
+                   set_nquads=up["set_nquads"], commit_now=True)
+    assert len(r1["uids"]) == 1
+    # second run: v is non-empty -> cond fails -> no new node
+    r2 = db.mutate(query=up["query"], cond=up["cond"],
+                   set_nquads=up["set_nquads"], commit_now=True)
+    assert r2["uids"] == {}
+    assert len(_uids(db, '{ q(func: eq(email, "a@x.io")) { uid } }')) == 1
+
+
+def test_uid_subst_subject(db):
+    db.mutate(set_nquads='_:a <email> "b@x.io" .', commit_now=True)
+    db.mutate(
+        query='{ q(func: eq(email, "b@x.io")) { v as uid } }',
+        set_nquads='uid(v) <name> "Bob" .', commit_now=True)
+    data = db.query('{ q(func: eq(email, "b@x.io")) { name } }')["data"]
+    assert data["q"] == [{"name": "Bob"}]
+
+
+def test_uid_subst_cross_product(db):
+    db.mutate(set_nquads='_:a <name> "L1" .\n_:b <name> "L1" .\n'
+                         '_:c <name> "R1" .', commit_now=True)
+    db.mutate(
+        query='{ l(func: eq(name, "L1")) { l as uid } '
+              '  r(func: eq(name, "R1")) { r as uid } }',
+        set_nquads='uid(l) <friend> uid(r) .', commit_now=True)
+    data = db.query(
+        '{ q(func: eq(name, "L1")) { friend { name } } }')["data"]
+    assert data["q"] == [{"friend": [{"name": "R1"}]}] * 2
+
+
+def test_empty_var_drops_nquad(db):
+    r = db.mutate(
+        query='{ q(func: eq(email, "nobody@x.io")) { v as uid } }',
+        set_nquads='uid(v) <name> "Ghost" .', commit_now=True)
+    assert r["uids"] == {}
+    assert _uids(db, '{ q(func: eq(name, "Ghost")) { uid } }') == []
+
+
+def test_val_subst(db):
+    db.mutate(set_nquads='_:a <name> "Carl" .\n_:a <age> "33"^^<xs:int> .',
+              commit_now=True)
+    # copy age into a new predicate per-uid
+    db.alter("age_copy: int .")
+    db.mutate(
+        query='{ q(func: eq(name, "Carl")) { v as uid a as age } }',
+        set_nquads='uid(v) <age_copy> val(a) .', commit_now=True)
+    data = db.query('{ q(func: eq(name, "Carl")) { age_copy } }')["data"]
+    assert data["q"] == [{"age_copy": 33}]
+
+
+def test_delete_via_uid_var(db):
+    db.mutate(set_nquads='_:a <email> "z@x.io" .\n_:a <name> "Zed" .',
+              commit_now=True)
+    db.mutate(
+        query='{ q(func: eq(email, "z@x.io")) { v as uid } }',
+        del_nquads='uid(v) * * .', commit_now=True)
+    assert _uids(db, '{ q(func: eq(email, "z@x.io")) { uid } }') == []
+
+
+def test_multi_mutation_conds(db):
+    db.mutate(set_nquads='_:a <email> "m@x.io" .', commit_now=True)
+    r = db.mutate(
+        query='{ q(func: eq(email, "m@x.io")) { v as uid } }',
+        mutations=[
+            Mutation(cond="@if(eq(len(v), 0))",
+                     set_nquads='_:n <email> "m@x.io" .'),
+            Mutation(cond="@if(gt(len(v), 0))",
+                     set_nquads='uid(v) <name> "Existing" .'),
+        ], commit_now=True)
+    assert r["uids"] == {}
+    data = db.query('{ q(func: eq(email, "m@x.io")) { name } }')["data"]
+    assert data["q"] == [{"name": "Existing"}]
+
+
+def test_cond_bool_algebra(db):
+    db.mutate(set_nquads='_:a <name> "X" .', commit_now=True)
+    db.mutate(
+        query='{ a(func: eq(name, "X")) { v as uid } '
+              '  b(func: eq(name, "Y")) { w as uid } }',
+        cond="@if(gt(len(v), 0) AND eq(len(w), 0))",
+        set_nquads='uid(v) <name> "X2" .', commit_now=True)
+    assert len(_uids(db, '{ q(func: eq(name, "X2")) { uid } }')) == 1
+
+
+def test_queries_returned(db):
+    db.mutate(set_nquads='_:a <name> "Qr" .', commit_now=True)
+    r = db.mutate(
+        query='{ q(func: eq(name, "Qr")) { name } }',
+        set_nquads='_:b <name> "other" .', commit_now=True)
+    assert r["queries"]["q"] == [{"name": "Qr"}]
+
+
+def test_star_delete_sees_staged_edges(db):
+    t = db.new_txn()
+    db.mutate(t, set_nquads='<0x9> <name> "staged" .')
+    db.mutate(t, del_nquads='<0x9> * * .')
+    db.commit(t)
+    assert _uids(db, '{ q(func: eq(name, "staged")) { uid } }') == []
+
+
+def test_star_delete_snapshot_isolated(db):
+    db.mutate(set_nquads='<0x8> <name> "base" .', commit_now=True)
+    t = db.new_txn()
+    # a concurrent commit outside t's snapshot must not be touched/conflict
+    db.mutate(set_nquads='<0x8> <age> "9"^^<xs:int> .', commit_now=True)
+    db.mutate(t, del_nquads='<0x8> * * .')
+    db.commit(t)  # must not abort
+    data = db.query('{ q(func: uid(0x8)) { age } }')["data"]
+    assert data["q"] == [{"age": 9}]
+
+
+def test_cond_with_mutations_list_rejected(db):
+    with pytest.raises(ValueError):
+        db.mutate(query='{ q(func: has(name)) { v as uid } }',
+                  cond="@if(eq(len(v), 0))",
+                  mutations=[Mutation(set_nquads='_:n <name> "x" .')],
+                  commit_now=True)
+
+
+def test_failed_parse_does_not_leak_txn(db):
+    before = db.coordinator.min_active_ts()
+    for _ in range(3):
+        with pytest.raises(Exception):
+            db.mutate(query="{ bad syntax", set_nquads='_:a <name> "x" .',
+                      commit_now=True)
+    db.mutate(set_nquads='_:a <name> "ok" .', commit_now=True)
+    assert db.coordinator.min_active_ts() > before
+
+
+def test_json_uid_ref(db):
+    db.mutate(set_nquads='_:a <email> "j@x.io" .', commit_now=True)
+    db.mutate(
+        query='{ q(func: eq(email, "j@x.io")) { v as uid } }',
+        set_json={"uid": "uid(v)", "name": "Json"}, commit_now=True)
+    data = db.query('{ q(func: eq(email, "j@x.io")) { name } }')["data"]
+    assert data["q"] == [{"name": "Json"}]
