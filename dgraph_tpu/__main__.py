@@ -1,0 +1,3 @@
+from dgraph_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,144 @@
+"""Command-line interface.
+
+Mirrors the reference's cobra command tree (dgraph/main.go:29,
+dgraph/cmd/root.go:75-78): `alpha` serves the engine, plus the smaller
+operational tools. Flags can also come from DGRAPH_TPU_<CMD>_<FLAG>
+environment variables, like the reference's DGRAPH_ALPHA_* viper prefixes
+(dgraph/cmd/root.go:104-143).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+__version__ = "0.1.0"
+
+
+def _env_default(cmd: str, flag: str, default):
+    v = os.environ.get(f"DGRAPH_TPU_{cmd.upper()}_{flag.upper()}")
+    if v is None:
+        return default
+    if isinstance(default, bool):
+        return v.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(v)
+    return v
+
+
+def cmd_alpha(args) -> int:
+    from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu.server.http import serve
+
+    db = GraphDB(wal_path=args.wal or None,
+                 prefer_device=not args.no_device)
+    print(f"dgraph-tpu alpha listening on http://{args.host}:{args.port}",
+          file=sys.stderr)
+    serve(db, host=args.host, port=args.port, block=True)
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(f"dgraph-tpu {__version__}")
+    import jax
+
+    print(f"jax {jax.__version__}; backend devices: "
+          f"{[str(d) for d in jax.devices()]}")
+    return 0
+
+
+def cmd_increment(args) -> int:
+    """Txn smoke-test canary: read-increment-write a counter N times,
+    read and write inside ONE transaction so concurrent canaries
+    conflict-abort instead of losing updates
+    (ref dgraph/cmd/counter/increment.go:109)."""
+    import urllib.error
+    import urllib.request
+
+    base = f"http://{args.addr}"
+
+    def post(path, data, ctype):
+        req = urllib.request.Request(
+            base + path, data.encode(), {"Content-Type": ctype})
+        return json.loads(urllib.request.urlopen(req).read())
+
+    done = 0
+    while done < args.num:
+        # the query's read ts names the txn; mutate+commit attach to it
+        r = post("/query", '{ q(func: has(counter.val)) { uid counter.val } }',
+                 "application/dql")
+        ts = r["extensions"]["txn"]["start_ts"]
+        rows = r["data"]["q"]
+        if rows:
+            uid, val = rows[0]["uid"], rows[0]["counter.val"] + 1
+            sub = f"<{uid}>"
+        else:
+            sub, val = "_:c", 1
+        try:
+            post(f"/mutate?startTs={ts}",
+                 f'{sub} <counter.val> "{val}"^^<xs:int> .',
+                 "application/rdf")
+            post(f"/commit?startTs={ts}", "", "application/json")
+        except urllib.error.HTTPError as e:
+            if e.code == 409:  # conflict: retry the whole read-modify-write
+                continue
+            raise
+        done += 1
+        print(f"counter.val = {val}")
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """Offline store inspector over a WAL file
+    (ref dgraph/cmd/debug/run.go)."""
+    from dgraph_tpu.engine.db import GraphDB
+
+    db = GraphDB(wal_path=args.wal)
+    db.rollup_all()  # fold replayed deltas so counts reflect the store
+    st = db.state()
+    if args.what == "state":
+        print(json.dumps(st, indent=2, default=str))
+    elif args.what == "schema":
+        print(db.schema.describe_all())
+    elif args.what == "histogram":
+        for pred, tab in sorted(db.tablets.items()):
+            n = sum(len(v) for v in tab.edges.values()) + \
+                sum(len(v) for v in tab.values.values())
+            print(f"{pred}\t{n}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="dgraph-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    a = sub.add_parser("alpha", help="serve the engine over HTTP")
+    a.add_argument("--host", default=_env_default("alpha", "host", "0.0.0.0"))
+    a.add_argument("--port", type=int,
+                   default=_env_default("alpha", "port", 8080))
+    a.add_argument("--wal", default=_env_default("alpha", "wal", ""))
+    a.add_argument("--no-device", action="store_true",
+                   default=_env_default("alpha", "no_device", False))
+    a.set_defaults(fn=cmd_alpha)
+
+    v = sub.add_parser("version", help="print version info")
+    v.set_defaults(fn=cmd_version)
+
+    c = sub.add_parser("increment", help="txn canary: increment a counter")
+    c.add_argument("--addr", default="127.0.0.1:8080")
+    c.add_argument("--num", type=int, default=1)
+    c.set_defaults(fn=cmd_increment)
+
+    d = sub.add_parser("debug", help="offline store inspector")
+    d.add_argument("--wal", required=True)
+    d.add_argument("what", choices=["state", "schema", "histogram"])
+    d.set_defaults(fn=cmd_debug)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,19 @@ class Coordinator:
             self._active[st.start_ts] = st
             return st
 
+    def begin_at(self, start_ts: int) -> TxnState:
+        """Register a txn at a previously issued read timestamp — the
+        stateless-HTTP flow where a query hands out startTs and a later
+        /mutate attaches to it (ref posting.Oracle RegisterStartTs)."""
+        with self._lock:
+            if start_ts <= 0 or start_ts > self._ts:
+                raise ValueError(f"unknown startTs {start_ts}")
+            if start_ts in self._active:
+                raise ValueError(f"startTs {start_ts} already in use")
+            st = TxnState(start_ts=start_ts)
+            self._active[start_ts] = st
+            return st
+
     def commit(self, txn: TxnState, conflict_keys: set) -> int:
         """Conflict-check and commit; returns commit_ts.
         Raises TxnAborted on conflict (ref zero/oracle.go:326 s.commit)."""

@@ -35,6 +35,7 @@ from dgraph_tpu.models.schema import (
 from dgraph_tpu.models.types import TypeID, Val, convert
 from dgraph_tpu.storage.tablet import EdgeOp, Posting, Tablet
 from dgraph_tpu.storage.wal import Wal
+from dgraph_tpu.utils import metrics
 
 
 def _fp(*parts) -> int:
@@ -146,6 +147,12 @@ class GraphDB:
         st = self.coordinator.begin()
         return Txn(start_ts=st.start_ts, _state=st)
 
+    def new_txn_at(self, start_ts: int) -> Txn:
+        """Attach a txn to a read timestamp a query already handed out
+        (stateless HTTP flow; ref posting.Oracle RegisterStartTs)."""
+        st = self.coordinator.begin_at(start_ts)
+        return Txn(start_ts=st.start_ts, _state=st)
+
     def mutate(self, txn: Optional[Txn] = None, *,
                set_nquads: str = "", del_nquads: str = "",
                set_json: Any = None, delete_json: Any = None,
@@ -161,15 +168,15 @@ class GraphDB:
         :503-511 updateUIDInMutations/updateValInMutations).
 
         Returns {"uids": {...}, "queries": {...}} like api.Response."""
-        own = txn is None
-        if txn is None:
-            txn = self.new_txn()
         legacy = set_nquads or del_nquads or set_json is not None \
             or delete_json is not None
         if cond and mutations and not legacy:
             raise ValueError(
                 "cond applies to the set_/del_ args; with mutations=[...] "
                 "put the cond inside each Mutation")
+        own = txn is None
+        if txn is None:
+            txn = self.new_txn()
         muts = list(mutations) if mutations else []
         if legacy:
             muts.append(Mutation(set_nquads=set_nquads,
@@ -313,52 +320,54 @@ class GraphDB:
     def _stage(self, txn: Txn, nqs: list[tuple[NQuad, bool]]):
         if txn.done:
             raise TxnAborted("transaction already finished")
-        nqs = self._expand_star_pred(txn, nqs)
         for nq, is_del in nqs:
-            pred = nq.predicate
-            src = self._resolve_uid(txn, nq.subject)
-            tab = self._tablet_for(pred, nq)
-            if nq.star:
-                if not is_del:
-                    raise ValueError("* object only allowed in delete")
-                op = EdgeOp("del_all", src)
-            elif nq.object_id:
-                if tab.schema.value_type != TypeID.UID:
-                    raise ValueError(
-                        f"predicate {pred!r} is not a uid predicate")
-                dst = self._resolve_uid(txn, nq.object_id)
-                op = EdgeOp("del" if is_del else "set", src, dst=dst,
-                            facets=nq.facets)
+            if nq.predicate == "*":
+                # expand incrementally so sets earlier in this same batch
+                # are covered by the wildcard too
+                for enq, edel in self._expand_star_pred(txn, nq, is_del):
+                    self._stage_one(txn, enq, edel)
             else:
-                val = nq.object_value
-                if tab.schema.value_type not in (TypeID.DEFAULT,):
-                    val = convert(val, tab.schema.value_type)
-                op = EdgeOp("del" if is_del else "set", src,
-                            posting=Posting(val, nq.lang, nq.facets))
-            txn.staged.append((pred, op))
-            txn.conflict_keys.add(self._conflict_key(tab, op))
+                self._stage_one(txn, nq, is_del)
 
-    def _expand_star_pred(self, txn: Txn, nqs):
+    def _stage_one(self, txn: Txn, nq: NQuad, is_del: bool):
+        pred = nq.predicate
+        src = self._resolve_uid(txn, nq.subject)
+        tab = self._tablet_for(pred, nq)
+        if nq.star:
+            if not is_del:
+                raise ValueError("* object only allowed in delete")
+            op = EdgeOp("del_all", src)
+        elif nq.object_id:
+            if tab.schema.value_type != TypeID.UID:
+                raise ValueError(
+                    f"predicate {pred!r} is not a uid predicate")
+            dst = self._resolve_uid(txn, nq.object_id)
+            op = EdgeOp("del" if is_del else "set", src, dst=dst,
+                        facets=nq.facets)
+        else:
+            val = nq.object_value
+            if tab.schema.value_type not in (TypeID.DEFAULT,):
+                val = convert(val, tab.schema.value_type)
+            op = EdgeOp("del" if is_del else "set", src,
+                        posting=Posting(val, nq.lang, nq.facets))
+        txn.staged.append((pred, op))
+        txn.conflict_keys.add(self._conflict_key(tab, op))
+
+    def _expand_star_pred(self, txn: Txn, nq: NQuad, is_del: bool):
         """`S * *` deletes every predicate S carries (ref
         query/mutation.go:54 expandEdges on x.Star predicate). Expansion
-        reads the txn's own snapshot (start_ts) plus edges staged earlier
-        in this txn — the reference reads through the LocalCache."""
-        out = []
-        for nq, is_del in nqs:
-            if nq.predicate != "*":
-                out.append((nq, is_del))
-                continue
-            if not (is_del and nq.star):
-                raise ValueError(
-                    "'*' predicate is only allowed in a `S * *` delete")
-            src = self._resolve_uid(txn, nq.subject)
-            preds = {p for p, tab in self.tablets.items()
-                     if tab.count_of(src, txn.start_ts)}
-            preds.update(p for p, op in txn.staged
-                         if op.src == src and op.op == "set")
-            for pname in sorted(preds):
-                out.append((_dc_replace(nq, predicate=pname), is_del))
-        return out
+        reads the txn's own snapshot (start_ts) plus everything staged so
+        far in this txn — the reference reads through the LocalCache."""
+        if not (is_del and nq.star):
+            raise ValueError(
+                "'*' predicate is only allowed in a `S * *` delete")
+        src = self._resolve_uid(txn, nq.subject)
+        preds = {p for p, tab in self.tablets.items()
+                 if tab.count_of(src, txn.start_ts)}
+        preds.update(p for p, op in txn.staged
+                     if op.src == src and op.op == "set")
+        return [(_dc_replace(nq, predicate=p), is_del)
+                for p in sorted(preds)]
 
     def _conflict_key(self, tab: Tablet, op: EdgeOp) -> int:
         """Ref posting/index.go:305 addMutationHelper conflict keys:
@@ -400,7 +409,14 @@ class GraphDB:
     def commit(self, txn: Txn) -> int:
         if txn.done:
             raise TxnAborted("transaction already finished")
-        commit_ts = self.coordinator.commit(txn._state, txn.conflict_keys)
+        try:
+            commit_ts = self.coordinator.commit(txn._state, txn.conflict_keys)
+        except TxnAborted:
+            txn.done = True
+            metrics.inc_counter("dgraph_txn_aborts_total")
+            raise
+        metrics.inc_counter("dgraph_num_mutations_total")
+        metrics.inc_counter("dgraph_num_edges_total", len(txn.staged))
         txn.done = True
         expanded = self._expand_ops(commit_ts, txn.staged)
         for pred, ops in expanded.items():
@@ -528,7 +544,12 @@ class GraphDB:
         ex = Executor(self, read_ts)
         data = ex.run(parsed)
         lat.processing_ns = time.perf_counter_ns() - t0
-        return {"data": data, "extensions": {"latency": lat.as_dict()}}
+        metrics.inc_counter("dgraph_num_queries_total")
+        metrics.observe("dgraph_query_latency_ms",
+                        (lat.parsing_ns + lat.processing_ns) / 1e6)
+        return {"data": data,
+                "extensions": {"latency": lat.as_dict(),
+                               "txn": {"start_ts": read_ts}}}
 
     # ------------------------------------------------------------------
     # Bulk traversal API: the device-first equivalent of @recurse for

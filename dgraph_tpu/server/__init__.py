@@ -1,0 +1,9 @@
+"""Serving layer: the Alpha-equivalent HTTP API surface.
+
+Ref: dgraph/cmd/alpha/run.go:415-436 (HTTP handlers) and
+dgraph/cmd/alpha/http.go (queryHandler/mutationHandler/commitHandler).
+"""
+
+from dgraph_tpu.server.http import AlphaServer, serve
+
+__all__ = ["AlphaServer", "serve"]

@@ -1,0 +1,389 @@
+"""HTTP API server — the Alpha's public surface.
+
+Endpoint map mirrors the reference (dgraph/cmd/alpha/run.go:415-436):
+
+    POST /query     GraphQL± query; body is DQL text or JSON
+                    {"query": ..., "variables": {...}}
+                    (ref dgraph/cmd/alpha/http.go:162 queryHandler)
+    POST /mutate    RDF or JSON mutation; ?commitNow=true commits
+                    immediately, otherwise the response's
+                    extensions.txn.start_ts names the open txn
+                    (ref http.go:298 mutationHandler)
+    POST /commit    ?startTs=N finishes a txn; ?abort=true discards
+                    (ref http.go:446 commitHandler)
+    POST /alter     schema text, or JSON {"drop_all": true} /
+                    {"drop_attr": "name"} (ref http.go:528 alterHandler)
+    GET  /health    liveness probe (ref x/health.go)
+    GET  /state     cluster/engine introspection (ref edgraph/server.go:602)
+    GET  /admin/schema        current schema text
+    POST /admin/schema        same as /alter with schema text
+    GET  /debug/prometheus_metrics   metrics text format (x/metrics.go)
+
+Transactions over HTTP are keyed by startTs exactly like the reference's
+stateless protocol: /mutate without commitNow returns start_ts, the
+client replays it to /mutate (more writes) or /commit.
+
+Concurrency: a ThreadingHTTPServer front end with a single engine lock —
+the data plane batches work into device calls, so the lock guards only
+host-side bookkeeping (the reference's fine-grained goroutine model is a
+non-goal for the in-process engine).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import parse_qs, urlparse
+
+from dgraph_tpu.cluster.coordinator import TxnAborted
+from dgraph_tpu.engine.db import GraphDB, Mutation, Txn
+
+# startTs -> open server-side txn (the reference keeps this state in the
+# client + oracle; our engine txns are server objects, so the server maps)
+_MAX_OPEN_TXNS = 4096
+
+
+class AlphaServer:
+    """Engine + txn table behind the HTTP front end."""
+
+    def __init__(self, db: Optional[GraphDB] = None,
+                 txn_ttl_s: float = 300.0):
+        self.db = db or GraphDB()
+        self.lock = threading.RLock()
+        self.txns: dict[int, Txn] = {}
+        self._touched: dict[int, float] = {}
+        self.txn_ttl_s = txn_ttl_s
+        self.started_at = time.time()
+
+    def _evict_idle(self):
+        """Abort txns idle past the TTL (ref --abort_older_than,
+        worker/draft.go:1166 abortOldTransactions)."""
+        now = time.time()
+        for ts, t in list(self._touched.items()):
+            if now - t > self.txn_ttl_s:
+                txn = self.txns.pop(ts, None)
+                self._touched.pop(ts, None)
+                if txn is not None:
+                    self.db.discard(txn)
+
+    # -- request handlers (transport-independent) --
+
+    def handle_query(self, body: dict | str, params: dict) -> dict:
+        if isinstance(body, dict):
+            q = body.get("query", "")
+            variables = body.get("variables")
+        else:
+            q, variables = body, None
+        ro_txn = None
+        start_ts = int(params.get("startTs", 0))
+        with self.lock:
+            if start_ts:
+                ro_txn = self.txns.get(start_ts)
+            be = params.get("be", "false") == "true"
+            return self.db.query(q, variables, txn=ro_txn, best_effort=be
+                                 if ro_txn is None else False)
+
+    def handle_mutate(self, body: bytes, content_type: str,
+                      params: dict) -> dict:
+        commit_now = params.get("commitNow", "false") == "true"
+        start_ts = int(params.get("startTs", 0))
+        mut, query, variables = _parse_mutation_body(body, content_type)
+        with self.lock:
+            self._evict_idle()
+            created = False
+            if start_ts:
+                txn = self.txns.get(start_ts)
+                if txn is None:
+                    # attach to a ts a previous /query handed out
+                    txn = self.db.new_txn_at(start_ts)
+                    created = True
+            else:
+                txn = self.db.new_txn()
+                created = True
+            try:
+                out = self.db.mutate(txn, mutations=[mut], query=query,
+                                     variables=variables,
+                                     commit_now=commit_now)
+            except Exception:
+                # a failed mutation aborts the whole txn (fail fast; the
+                # reference marks the txn context aborted)
+                self.txns.pop(txn.start_ts, None)
+                self._touched.pop(txn.start_ts, None)
+                self.db.discard(txn)
+                raise
+            ext_txn = {"start_ts": txn.start_ts}
+            if commit_now:
+                self.txns.pop(txn.start_ts, None)
+                self._touched.pop(txn.start_ts, None)
+                if not txn.done:  # all conds failed, discard like mutate()
+                    self.db.discard(txn)
+            else:
+                if created and len(self.txns) >= _MAX_OPEN_TXNS:
+                    self.db.discard(txn)
+                    raise RuntimeError("too many open transactions")
+                self.txns[txn.start_ts] = txn
+                self._touched[txn.start_ts] = time.time()
+            out.setdefault("extensions", {})["txn"] = ext_txn
+            return out
+
+    def handle_commit(self, params: dict) -> dict:
+        start_ts = int(params.get("startTs", 0))
+        abort = params.get("abort", "false") == "true"
+        with self.lock:
+            txn = self.txns.pop(start_ts, None)
+            self._touched.pop(start_ts, None)
+            if txn is None:
+                raise KeyError(f"no open transaction at startTs={start_ts}")
+            if abort:
+                self.db.discard(txn)
+                return {"code": "Success", "message": "Done",
+                        "extensions": {"txn": {"start_ts": start_ts,
+                                               "aborted": True}}}
+            commit_ts = self.db.commit(txn)
+            return {"code": "Success", "message": "Done",
+                    "extensions": {"txn": {"start_ts": start_ts,
+                                           "commit_ts": commit_ts}}}
+
+    def handle_alter(self, body: bytes) -> dict:
+        text = body.decode()
+        drop_all = False
+        drop_attr = ""
+        schema = text
+        try:
+            j = json.loads(text)
+            if isinstance(j, dict):
+                drop_all = bool(j.get("drop_all"))
+                drop_attr = j.get("drop_attr", "")
+                schema = j.get("schema", "")
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            pass
+        with self.lock:
+            self.db.alter(schema_text=schema, drop_all=drop_all,
+                          drop_attr=drop_attr)
+        return {"code": "Success", "message": "Done"}
+
+    def handle_state(self) -> dict:
+        with self.lock:
+            return self.db.state()
+
+    def handle_health(self) -> dict:
+        return {"status": "healthy",
+                "uptime_s": round(time.time() - self.started_at, 3),
+                "openTxns": len(self.txns)}
+
+    def handle_get_schema(self) -> dict:
+        with self.lock:
+            return {"schema": self.db.schema.describe_all()}
+
+
+def _parse_mutation_body(body: bytes, content_type: str
+                         ) -> tuple[Mutation, str, dict | None]:
+    """Body formats (ref http.go:298 mutationHandler):
+    application/rdf: raw N-Quads in {set {...} delete {...}} or plain sets;
+    application/json: {"set": [...], "delete": [...], "query": "...",
+    "cond": "..."} upsert envelope."""
+    if "json" in content_type:
+        j = json.loads(body.decode())
+        mut = Mutation(cond=j.get("cond", ""))
+        if "set" in j:
+            mut.set_json = j["set"]
+        if "delete" in j:
+            mut.delete_json = j["delete"]
+        if "setNquads" in j:
+            mut.set_nquads = j["setNquads"]
+        if "delNquads" in j:
+            mut.del_nquads = j["delNquads"]
+        return mut, j.get("query", ""), j.get("variables")
+    text = body.decode()
+    set_part, del_part, query, cond = _split_rdf_blocks(text)
+    return Mutation(set_nquads=set_part, del_nquads=del_part, cond=cond), \
+        query, None
+
+
+def _split_rdf_blocks(text: str) -> tuple[str, str, str, str]:
+    """Parse the RDF mutation envelope:
+    `upsert { query {...} mutation [@if(...)] { set {...} delete {...} } }`
+    or bare `{ set {...} delete {...} }` or raw triples."""
+    s = text.strip()
+    if not s.startswith(("upsert", "{")):
+        return s, "", "", ""  # raw triples = set
+    query = ""
+    cond = ""
+    body = s
+    if s.startswith("upsert"):
+        inner = _brace_body(s[len("upsert"):].lstrip())
+        qpos = inner.find("query")
+        mpos = inner.find("mutation")
+        if qpos >= 0:
+            qbody = _brace_body(inner[qpos + len("query"):].lstrip())
+            query = "{" + qbody + "}"
+        if mpos < 0:
+            raise ValueError("upsert block without mutation")
+        after = inner[mpos + len("mutation"):].lstrip()
+        if after.startswith("@if"):
+            depth = 0
+            for i, ch in enumerate(after):
+                if ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                    if depth == 0:
+                        cond = after[: i + 1]
+                        after = after[i + 1:].lstrip()
+                        break
+        body = "{" + _brace_body(after) + "}"
+    inner = _brace_body(body)
+    parts = _scan_set_delete(inner)
+    if parts is None:  # bare triples inside outer braces = set block
+        return inner, "", query, cond
+    return parts[0], parts[1], query, cond
+
+
+def _scan_set_delete(inner: str) -> Optional[tuple[str, str]]:
+    """Scan `set { ... } delete { ... }` sections; None if the content is
+    bare triples instead."""
+    set_part: list[str] = []
+    del_part: list[str] = []
+    i = 0
+    n = len(inner)
+    while True:
+        while i < n and inner[i].isspace():
+            i += 1
+        if i >= n:
+            break
+        for kw, sink in (("set", set_part), ("delete", del_part)):
+            if inner.startswith(kw, i) and \
+                    inner[i + len(kw):].lstrip().startswith("{"):
+                j = inner.index("{", i + len(kw))
+                blk = _brace_body(inner[j:])
+                sink.append(blk)
+                i = j + len(blk) + 2
+                break
+        else:
+            return None
+    return "\n".join(set_part), "\n".join(del_part)
+
+
+def _brace_body(s: str) -> str:
+    """Content of the first balanced {...} (quote-aware)."""
+    if not s.startswith("{"):
+        raise ValueError(f"expected '{{' at {s[:20]!r}")
+    depth = 0
+    in_str = False
+    esc = False
+    for i, ch in enumerate(s):
+        if in_str:
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = False
+            continue
+        if ch == '"':
+            in_str = True
+        elif ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                return s[1:i]
+    raise ValueError("unbalanced braces")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dgraph-tpu/0.1"
+    alpha: AlphaServer  # set by serve()
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _send(self, code: int, obj: Any):
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, msg: str, code: int = 400):
+        self._send(code, {"errors": [{"message": msg,
+                                      "extensions": {"code": "Error"}}]})
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b""
+
+    def do_GET(self):
+        path = urlparse(self.path).path
+        try:
+            if path == "/health":
+                self._send(200, self.alpha.handle_health())
+            elif path == "/state":
+                self._send(200, self.alpha.handle_state())
+            elif path == "/admin/schema":
+                self._send(200, {"data": self.alpha.handle_get_schema()})
+            elif path == "/debug/prometheus_metrics":
+                from dgraph_tpu.utils.metrics import render_prometheus
+
+                text = render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
+            else:
+                self._error(f"no handler for GET {path}", 404)
+        except Exception as e:  # noqa: BLE001 — surface as API error
+            traceback.print_exc()
+            self._error(str(e), 500)
+
+    def do_POST(self):
+        u = urlparse(self.path)
+        path = u.path
+        params = {k: v[-1] for k, v in parse_qs(u.query).items()}
+        ctype = self.headers.get("Content-Type", "")
+        try:
+            body = self._body()
+            if path == "/query":
+                if "json" in ctype:
+                    payload: Any = json.loads(body.decode())
+                else:
+                    payload = body.decode()
+                self._send(200, self.alpha.handle_query(payload, params))
+            elif path == "/mutate":
+                self._send(200, self.alpha.handle_mutate(body, ctype, params))
+            elif path == "/commit":
+                self._send(200, self.alpha.handle_commit(params))
+            elif path in ("/alter", "/admin/schema"):
+                self._send(200, self.alpha.handle_alter(body))
+            else:
+                self._error(f"no handler for POST {path}", 404)
+        except TxnAborted as e:
+            self._error(f"Transaction has been aborted. Please retry: {e}",
+                        409)
+        except (ValueError, KeyError) as e:
+            self._error(str(e), 400)
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc()
+            self._error(str(e), 500)
+
+
+def serve(db: Optional[GraphDB] = None, host: str = "127.0.0.1",
+          port: int = 8080, block: bool = True
+          ) -> tuple[ThreadingHTTPServer, AlphaServer]:
+    """Start the Alpha HTTP server. With block=False, runs in a daemon
+    thread and returns (httpd, alpha) for tests/embedding."""
+    alpha = AlphaServer(db)
+    handler = type("BoundHandler", (_Handler,), {"alpha": alpha})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    if block:
+        httpd.serve_forever()
+    else:
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+    return httpd, alpha

@@ -131,8 +131,49 @@ class Tablet:
         for ts, ops in self.deltas:
             if ts > read_ts:
                 break
-            for op in ops:
-                yield ts, op
+            for i, op in enumerate(ops):
+                yield ts, i, op
+
+    def _postings_before(self, src: int, ts: int, idx: int) -> list[Posting]:
+        """Value postings of `src` just before op position (ts, idx) —
+        used by wildcard deletes to find the tokens they must drop,
+        including postings set earlier in the SAME commit."""
+        out = list(self.values.get(src, ()))
+        for dts, i, op in self._overlay_ts(ts):
+            if dts == ts and i >= idx:
+                break
+            if op.src != src:
+                continue
+            if op.op == "del_all":
+                out = []
+            elif op.op == "set":
+                out = self._merge_posting(out, op.posting)
+            elif op.op == "del" and op.posting is not None:
+                fp = value_fingerprint(op.posting.value)
+                out = [p for p in out
+                       if not (p.lang == op.posting.lang
+                               and value_fingerprint(p.value) == fp)]
+        return out
+
+    def _dsts_before(self, src: int, ts: int, idx: int) -> np.ndarray:
+        """Destination uids of `src` just before op position (ts, idx)."""
+        out = self.edges.get(src, _EMPTY)
+        dirty = False
+        for dts, i, op in self._overlay_ts(ts):
+            if dts == ts and i >= idx:
+                break
+            if op.src != src:
+                continue
+            if not dirty:
+                out = out.copy()
+                dirty = True
+            if op.op == "set":
+                out = _ins(out, op.dst)
+            elif op.op == "del":
+                out = _rm(out, op.dst)
+            elif op.op == "del_all":
+                out = _EMPTY
+        return out
 
     def get_dst_uids(self, src: int, read_ts: int) -> np.ndarray:
         out = self.edges.get(src, _EMPTY)
@@ -153,7 +194,7 @@ class Tablet:
 
     def get_reverse_uids(self, dst: int, read_ts: int) -> np.ndarray:
         out = self.reverse.get(dst, _EMPTY)
-        for ts, op in self._overlay_ts(read_ts):
+        for ts, i, op in self._overlay_ts(read_ts):
             if op.op == "set" and op.dst == dst:
                 out = _ins(out, op.src)
             elif op.op == "del" and op.dst == dst:
@@ -161,7 +202,7 @@ class Tablet:
             elif op.op == "del_all":
                 # wildcard covers edges added earlier in the overlay too:
                 # reconstruct src's out-edges just before this delete
-                if dst in self.get_dst_uids(op.src, ts - 1):
+                if dst in self._dsts_before(op.src, ts, i):
                     out = _rm(out, op.src)
         return out
 
@@ -194,7 +235,7 @@ class Tablet:
     def index_uids(self, token: bytes, read_ts: int) -> np.ndarray:
         out = self.index.get(token, _EMPTY)
         dirty = False
-        for ts, op in self._overlay_ts(read_ts):
+        for ts, i, op in self._overlay_ts(read_ts):
             toks: Iterable[bytes] = ()
             if op.op in ("set", "del") and op.posting is not None \
                     and self.schema.indexed:
@@ -202,8 +243,8 @@ class Tablet:
             elif op.op == "del_all" and self.schema.indexed:
                 # wildcard delete: drop src from every token of every
                 # posting live just before this delete (incl. postings
-                # added earlier in the overlay)
-                for p in self.get_postings(op.src, ts - 1):
+                # added earlier in the overlay — even in the same commit)
+                for p in self._postings_before(op.src, ts, i):
                     for tk in self._tokens(p):
                         if tk == token:
                             if not dirty:

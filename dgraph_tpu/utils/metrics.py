@@ -1,0 +1,109 @@
+"""Metrics registry: counters, gauges, histograms + Prometheus text render.
+
+Re-provides the reference's OpenCensus stat surface (x/metrics.go:40-100 —
+num_queries_total, num_mutations_total, num_edges_total, latency, pending
+work, memory gauges) with a dependency-free registry; the HTTP server
+exposes it at /debug/prometheus_metrics like the reference's bridged
+Prometheus exporter (x/metrics.go:258 RegisterExporters).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+
+_LOCK = threading.Lock()
+_COUNTERS: dict[tuple[str, tuple], float] = {}
+_GAUGES: dict[tuple[str, tuple], float] = {}
+_HISTOGRAMS: dict[tuple[str, tuple], list[int]] = {}
+_HISTO_SUM: dict[tuple[str, tuple], float] = {}
+
+# latency buckets in ms (ref x/metrics.go defaultLatencyMsDistribution)
+BUCKETS = [0.1, 0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
+           5000, 10000]
+
+
+def _key(name: str, labels: dict | None) -> tuple[str, tuple]:
+    return name, tuple(sorted((labels or {}).items()))
+
+
+def inc_counter(name: str, value: float = 1, labels: dict | None = None):
+    k = _key(name, labels)
+    with _LOCK:
+        _COUNTERS[k] = _COUNTERS.get(k, 0) + value
+
+
+def set_gauge(name: str, value: float, labels: dict | None = None):
+    with _LOCK:
+        _GAUGES[_key(name, labels)] = value
+
+
+def observe(name: str, value_ms: float, labels: dict | None = None):
+    k = _key(name, labels)
+    with _LOCK:
+        h = _HISTOGRAMS.get(k)
+        if h is None:
+            h = [0] * (len(BUCKETS) + 1)
+            _HISTOGRAMS[k] = h
+        h[bisect_right(BUCKETS, value_ms)] += 1
+        _HISTO_SUM[k] = _HISTO_SUM.get(k, 0) + value_ms
+
+
+def reset():
+    with _LOCK:
+        _COUNTERS.clear()
+        _GAUGES.clear()
+        _HISTOGRAMS.clear()
+        _HISTO_SUM.clear()
+
+
+def snapshot() -> dict:
+    with _LOCK:
+        return {
+            "counters": {_fmt_key(k): v for k, v in _COUNTERS.items()},
+            "gauges": {_fmt_key(k): v for k, v in _GAUGES.items()},
+        }
+
+
+def _fmt_key(k: tuple[str, tuple]) -> str:
+    name, labels = k
+    if not labels:
+        return name
+    inner = ",".join(f'{lk}="{lv}"' for lk, lv in labels)
+    return f"{name}{{{inner}}}"
+
+
+def render_prometheus() -> str:
+    """Prometheus text exposition format 0.0.4."""
+    lines: list[str] = []
+    typed: set[str] = set()  # one TYPE line per metric name
+
+    def _type_line(name: str, kind: str):
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+
+    with _LOCK:
+        for k, v in sorted(_COUNTERS.items()):
+            _type_line(k[0], "counter")
+            lines.append(f"{_fmt_key(k)} {v}")
+        for k, v in sorted(_GAUGES.items()):
+            _type_line(k[0], "gauge")
+            lines.append(f"{_fmt_key(k)} {v}")
+        for k, h in sorted(_HISTOGRAMS.items()):
+            name, labels = k
+            _type_line(name, "histogram")
+            cum = 0
+            for i, b in enumerate(BUCKETS):
+                cum += h[i]
+                lb = dict(labels)
+                lb["le"] = str(b)
+                lines.append(f"{_fmt_key((name + '_bucket', tuple(sorted(lb.items()))))} {cum}")
+            cum += h[-1]
+            lb = dict(labels)
+            lb["le"] = "+Inf"
+            lines.append(f"{_fmt_key((name + '_bucket', tuple(sorted(lb.items()))))} {cum}")
+            lines.append(f"{_fmt_key((name + '_count', labels))} {cum}")
+            lines.append(f"{_fmt_key((name + '_sum', labels))} "
+                         f"{_HISTO_SUM.get(k, 0)}")
+    return "\n".join(lines) + "\n"

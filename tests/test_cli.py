@@ -1,0 +1,35 @@
+"""CLI command tests (in-process, no subprocess to avoid jax re-import)."""
+
+import pytest
+
+from dgraph_tpu.cli import main
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.server.http import serve
+
+
+@pytest.fixture(scope="module")
+def server():
+    db = GraphDB(prefer_device=False)
+    httpd, alpha = serve(db, host="127.0.0.1", port=0, block=False)
+    yield f"127.0.0.1:{httpd.server_address[1]}", alpha
+    httpd.shutdown()
+
+
+def test_increment(server, capsys):
+    addr, alpha = server
+    assert main(["increment", "--addr", addr, "--num", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "counter.val = 3" in out
+    # server-side state agrees
+    q = alpha.db.query("{ q(func: has(counter.val)) { counter.val } }")
+    assert q["data"]["q"] == [{"counter.val": 3}]
+
+
+def test_debug_inspector(tmp_path, capsys):
+    wal = str(tmp_path / "w.log")
+    db = GraphDB(wal_path=wal, prefer_device=False)
+    db.alter("dname: string @index(exact) .")
+    db.mutate(set_nquads='_:a <dname> "D" .', commit_now=True)
+    assert main(["debug", "--wal", wal, "histogram"]) == 0
+    out = capsys.readouterr().out
+    assert "dname\t1" in out

@@ -1,0 +1,193 @@
+"""HTTP API surface — black-box tests over a live server.
+
+Model: the reference's HTTP API suite (dgraph/cmd/alpha/run_test.go)
+which drives /alter /mutate /query /commit with raw bodies.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.server.http import serve
+
+
+@pytest.fixture(scope="module")
+def server():
+    db = GraphDB(prefer_device=False)
+    httpd, alpha = serve(db, host="127.0.0.1", port=0, block=False)
+    port = httpd.server_address[1]
+    yield f"http://127.0.0.1:{port}", alpha
+    httpd.shutdown()
+
+
+def _post(base, path, body, ctype="application/dql"):
+    if isinstance(body, (dict, list)):
+        body = json.dumps(body)
+        ctype = "application/json"
+    req = urllib.request.Request(base + path, body.encode(),
+                                 {"Content-Type": ctype})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path) as r:
+        ct = r.headers.get("Content-Type", "")
+        data = r.read()
+        return json.loads(data) if "json" in ct else data.decode()
+
+
+def test_alter_and_schema(server):
+    base, _ = server
+    r = _post(base, "/alter", "hname: string @index(exact) .\nhage: int .")
+    assert r["code"] == "Success"
+    sch = _get(base, "/admin/schema")
+    assert "hname" in sch["data"]["schema"]
+
+
+def test_mutate_commit_now_and_query(server):
+    base, _ = server
+    r = _post(base, "/mutate?commitNow=true",
+              '_:a <hname> "Hank" .\n_:a <hage> "40"^^<xs:int> .',
+              "application/rdf")
+    assert len(r["uids"]) == 1
+    q = _post(base, "/query", '{ q(func: eq(hname, "Hank")) { hname hage } }')
+    assert q["data"]["q"] == [{"hname": "Hank", "hage": 40}]
+
+
+def test_mutate_json_body(server):
+    base, _ = server
+    r = _post(base, "/mutate?commitNow=true",
+              {"set": [{"hname": "JsonGuy", "hage": 7}]})
+    assert len(r["uids"]) == 1
+    q = _post(base, "/query",
+              {"query": '{ q(func: eq(hname, "JsonGuy")) { hage } }'})
+    assert q["data"]["q"] == [{"hage": 7}]
+
+
+def test_txn_over_http(server):
+    base, _ = server
+    r = _post(base, "/mutate", '_:t <hname> "TxnGuy" .', "application/rdf")
+    ts = r["extensions"]["txn"]["start_ts"]
+    # not yet visible
+    q = _post(base, "/query", '{ q(func: eq(hname, "TxnGuy")) { uid } }')
+    assert q["data"]["q"] == []
+    c = _post(base, f"/commit?startTs={ts}", "")
+    assert c["extensions"]["txn"]["commit_ts"] > ts
+    q = _post(base, "/query", '{ q(func: eq(hname, "TxnGuy")) { hname } }')
+    assert q["data"]["q"] == [{"hname": "TxnGuy"}]
+
+
+def test_txn_abort_over_http(server):
+    base, _ = server
+    r = _post(base, "/mutate", '_:t <hname> "AbortGuy" .', "application/rdf")
+    ts = r["extensions"]["txn"]["start_ts"]
+    c = _post(base, f"/commit?startTs={ts}&abort=true", "")
+    assert c["extensions"]["txn"]["aborted"] is True
+    q = _post(base, "/query", '{ q(func: eq(hname, "AbortGuy")) { uid } }')
+    assert q["data"]["q"] == []
+
+
+def test_rdf_set_delete_envelope(server):
+    base, _ = server
+    _post(base, "/mutate?commitNow=true",
+          '{ set { _:x <hname> "EnvGuy" . } }', "application/rdf")
+    q = _post(base, "/query", '{ q(func: eq(hname, "EnvGuy")) { uid } }')
+    (row,) = q["data"]["q"]
+    _post(base, "/mutate?commitNow=true",
+          '{ delete { <%s> <hname> * . } }' % row["uid"], "application/rdf")
+    q = _post(base, "/query", '{ q(func: eq(hname, "EnvGuy")) { uid } }')
+    assert q["data"]["q"] == []
+
+
+def test_upsert_envelope(server):
+    base, _ = server
+    body = '''upsert {
+      query { q(func: eq(hname, "UpGuy")) { v as uid } }
+      mutation @if(eq(len(v), 0)) {
+        set { _:u <hname> "UpGuy" . }
+      }
+    }'''
+    r1 = _post(base, "/mutate?commitNow=true", body, "application/rdf")
+    r2 = _post(base, "/mutate?commitNow=true", body, "application/rdf")
+    assert len(r1["uids"]) == 1 and r2["uids"] == {}
+    q = _post(base, "/query", '{ q(func: eq(hname, "UpGuy")) { uid } }')
+    assert len(q["data"]["q"]) == 1
+
+
+def test_json_upsert_envelope(server):
+    base, _ = server
+    body = {"query": '{ q(func: eq(hname, "JUp")) { v as uid } }',
+            "cond": "@if(eq(len(v), 0))",
+            "set": [{"hname": "JUp"}]}
+    r1 = _post(base, "/mutate?commitNow=true", body)
+    r2 = _post(base, "/mutate?commitNow=true", body)
+    assert len(r1["uids"]) == 1 and r2["uids"] == {}
+
+
+def test_health_state_metrics(server):
+    base, _ = server
+    h = _get(base, "/health")
+    assert h["status"] == "healthy"
+    st = _get(base, "/state")
+    assert "maxAssigned" in st
+    m = _get(base, "/debug/prometheus_metrics")
+    assert "dgraph_num_queries_total" in m
+
+
+def test_error_shape(server):
+    base, _ = server
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base, "/query", "{ bad syntax")
+    body = json.loads(ei.value.read())
+    assert body["errors"][0]["message"]
+
+
+def test_query_ts_attach_and_conflict(server):
+    base, _ = server
+    _post(base, "/mutate?commitNow=true", '_:c <hage> "1"^^<xs:int> .',
+          "application/rdf")
+    q = _post(base, "/query", '{ q(func: eq(hage, 1)) { uid hage } }')
+    ts = q["extensions"]["txn"]["start_ts"]
+    uid = q["data"]["q"][0]["uid"]
+    # attach a mutation to the query's ts (stateless txn flow)
+    _post(base, f"/mutate?startTs={ts}",
+          f'<{uid}> <hage> "2"^^<xs:int> .', "application/rdf")
+    # concurrent writer commits the same key first
+    _post(base, "/mutate?commitNow=true",
+          f'<{uid}> <hage> "9"^^<xs:int> .', "application/rdf")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base, f"/commit?startTs={ts}", "")
+    assert ei.value.code == 409
+
+
+def test_failed_mutation_aborts_txn_no_leak(server):
+    base, alpha = server
+    active_before = len(alpha.db.coordinator._active)
+    with pytest.raises(urllib.error.HTTPError):
+        _post(base, "/mutate", "<0x1> <hname> .", "application/rdf")  # bad rdf
+    assert len(alpha.db.coordinator._active) == active_before
+    assert alpha.txns == {} or all(
+        ts in alpha._touched for ts in alpha.txns)
+
+
+def test_set_and_star_delete_same_envelope(server):
+    base, _ = server
+    _post(base, "/mutate?commitNow=true",
+          '{ set { <0x77> <hname> "Gone" . } delete { <0x77> * * . } }',
+          "application/rdf")
+    q = _post(base, "/query", '{ q(func: eq(hname, "Gone")) { uid } }')
+    assert q["data"]["q"] == []
+
+
+def test_drop_attr(server):
+    base, _ = server
+    _post(base, "/alter", "dropme: string @index(exact) .")
+    _post(base, "/mutate?commitNow=true", '_:d <dropme> "x" .',
+          "application/rdf")
+    r = _post(base, "/alter", {"drop_attr": "dropme"})
+    assert r["code"] == "Success"
+    q = _post(base, "/query", '{ q(func: has(dropme)) { uid } }')
+    assert q["data"]["q"] == []
