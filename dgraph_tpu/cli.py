@@ -32,8 +32,15 @@ def cmd_alpha(args) -> int:
     from dgraph_tpu.engine.db import GraphDB
     from dgraph_tpu.server.http import serve
 
-    db = GraphDB(wal_path=args.wal or None,
-                 prefer_device=not args.no_device)
+    if args.snapshot:
+        from dgraph_tpu.storage.snapshot import load_snapshot
+
+        db = load_snapshot(args.snapshot,
+                           GraphDB(wal_path=args.wal or None,
+                                   prefer_device=not args.no_device))
+    else:
+        db = GraphDB(wal_path=args.wal or None,
+                     prefer_device=not args.no_device)
     print(f"dgraph-tpu alpha listening on http://{args.host}:{args.port}",
           file=sys.stderr)
     serve(db, host=args.host, port=args.port, block=True)
@@ -90,6 +97,76 @@ def cmd_increment(args) -> int:
     return 0
 
 
+def cmd_bulk(args) -> int:
+    """Offline bulk loader (ref dgraph/cmd/bulk/run.go:106)."""
+    import time
+
+    from dgraph_tpu.ingest.bulk import bulk_load
+
+    schema = open(args.schema).read() if args.schema else ""
+    t0 = time.time()
+    db = bulk_load(args.files, schema=schema)
+    dt = time.time() - t0
+    n = sum(sum(len(v) for v in t.edges.values()) +
+            sum(len(v) for v in t.values.values())
+            for t in db.tablets.values())
+    print(f"loaded {n} edges across {len(db.tablets)} predicates "
+          f"in {dt:.2f}s ({n / max(dt, 1e-9):.0f} edges/s)")
+    if args.out:
+        from dgraph_tpu.storage.snapshot import save_snapshot
+
+        save_snapshot(db, args.out)
+        print(f"snapshot written to {args.out}")
+    else:
+        print("warning: no --out given; load was a dry run "
+              "(nothing persisted)", file=sys.stderr)
+    return 0
+
+
+def cmd_live(args) -> int:
+    """Online live loader (ref dgraph/cmd/live/run.go:238)."""
+    from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu.ingest.live import live_load
+
+    schema = open(args.schema).read() if args.schema else ""
+    if not args.wal:
+        print("warning: no --wal given; loaded data dies with the process",
+              file=sys.stderr)
+    db = GraphDB(wal_path=args.wal or None)
+    stats = live_load(db, args.files, schema=schema,
+                      batch_size=args.batch, concurrency=args.conc)
+    print(json.dumps(stats))
+    return 0
+
+
+def cmd_export(args) -> int:
+    """Full-store export (ref worker/export.go:376)."""
+    from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu.ingest.export import (
+        export_json, export_rdf, export_schema,
+    )
+
+    if args.snapshot:
+        from dgraph_tpu.storage.snapshot import load_snapshot
+
+        db = load_snapshot(args.snapshot)
+    elif args.wal:
+        db = GraphDB(wal_path=args.wal)
+    else:
+        print("export: need --wal or --snapshot", file=sys.stderr)
+        return 2
+    with open(args.out, "w") as f:
+        if args.format == "rdf":
+            for line in export_rdf(db):
+                f.write(line + "\n")
+        else:
+            json.dump(export_json(db), f)
+    with open(args.out + ".schema", "w") as f:
+        f.write(export_schema(db))
+    print(f"exported to {args.out} (+.schema)")
+    return 0
+
+
 def cmd_debug(args) -> int:
     """Offline store inspector over a WAL file
     (ref dgraph/cmd/debug/run.go)."""
@@ -119,6 +196,7 @@ def main(argv=None) -> int:
     a.add_argument("--port", type=int,
                    default=_env_default("alpha", "port", 8080))
     a.add_argument("--wal", default=_env_default("alpha", "wal", ""))
+    a.add_argument("--snapshot", default=_env_default("alpha", "snapshot", ""))
     a.add_argument("--no-device", action="store_true",
                    default=_env_default("alpha", "no_device", False))
     a.set_defaults(fn=cmd_alpha)
@@ -130,6 +208,28 @@ def main(argv=None) -> int:
     c.add_argument("--addr", default="127.0.0.1:8080")
     c.add_argument("--num", type=int, default=1)
     c.set_defaults(fn=cmd_increment)
+
+    b = sub.add_parser("bulk", help="offline bulk loader")
+    b.add_argument("files", nargs="+")
+    b.add_argument("--schema", default="")
+    b.add_argument("--out", default="",
+                   help="snapshot file to write (the bulk output)")
+    b.set_defaults(fn=cmd_bulk)
+
+    lv = sub.add_parser("live", help="online live loader")
+    lv.add_argument("files", nargs="+")
+    lv.add_argument("--schema", default="")
+    lv.add_argument("--wal", default="")
+    lv.add_argument("--batch", type=int, default=1000)
+    lv.add_argument("--conc", type=int, default=4)
+    lv.set_defaults(fn=cmd_live)
+
+    e = sub.add_parser("export", help="export store to RDF/JSON")
+    e.add_argument("--wal", default="")
+    e.add_argument("--snapshot", default="")
+    e.add_argument("--out", required=True)
+    e.add_argument("--format", choices=["rdf", "json"], default="rdf")
+    e.set_defaults(fn=cmd_export)
 
     d = sub.add_parser("debug", help="offline store inspector")
     d.add_argument("--wal", required=True)

@@ -1,0 +1,200 @@
+"""Offline bulk loader: map → shuffle → reduce into tablet base state.
+
+Re-provides dgraph/cmd/bulk/ semantics with a TPU-first reduce:
+
+  reference: mappers emit sorted pb.MapEntry runs per predicate-shard
+             (mapper.go:137), reducers k-way-heap-merge them
+             (reduce.go:290 postingHeap) into posting lists written as
+             Badger SSTs at a fixed writeTs.
+  here:      mappers emit flat (src, dst) uid arrays + value posting
+             lists per predicate; the reduce is ONE vectorized
+             lexsort + boundary-diff per predicate (the device-friendly
+             "segmented sort + unique" replacing the heap merge), then
+             tablets are constructed directly in base state and the
+             index/reverse maps are (re)built.
+
+Everything lands at a single fixed write_ts, exactly like the
+reference's fixed writeTs (bulk/loader.go getWriteTimestamp).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.gql.nquad import NQuad
+from dgraph_tpu.ingest.chunker import chunk_file
+from dgraph_tpu.ingest.xidmap import XidMap
+from dgraph_tpu.models.schema import PredicateSchema
+from dgraph_tpu.models.types import TypeID, convert
+from dgraph_tpu.storage.tablet import Posting, Tablet
+
+_SPILL_EDGES = 2_000_000  # mapper buffer flush threshold
+
+
+class _MapShard:
+    """Per-predicate mapper accumulator with disk spill."""
+
+    def __init__(self, tmpdir: str, pred: str):
+        self.pred = pred
+        self.tmpdir = tmpdir
+        self.src: list[int] = []
+        self.dst: list[int] = []
+        self.vals: list[tuple[int, Posting]] = []
+        self.facets: list[tuple[int, int, dict]] = []
+        self.runs: list[str] = []
+
+    def spill(self):
+        if not (self.src or self.vals):
+            return
+        path = os.path.join(
+            self.tmpdir, f"map-{len(self.runs)}-{abs(hash(self.pred))}.run")
+        with open(path, "wb") as f:
+            pickle.dump((np.asarray(self.src, np.uint64),
+                         np.asarray(self.dst, np.uint64),
+                         self.vals, self.facets), f)
+        self.runs.append(path)
+        self.src, self.dst, self.vals, self.facets = [], [], [], []
+
+    def load_all(self):
+        """Concatenated (src, dst, vals, facets) over all runs + buffer."""
+        srcs = [np.asarray(self.src, np.uint64)]
+        dsts = [np.asarray(self.dst, np.uint64)]
+        vals = list(self.vals)
+        facets = list(self.facets)
+        for path in self.runs:
+            with open(path, "rb") as f:
+                s, d, v, fc = pickle.load(f)
+            srcs.append(s)
+            dsts.append(d)
+            vals.extend(v)
+            facets.extend(fc)
+        return np.concatenate(srcs), np.concatenate(dsts), vals, facets
+
+
+def bulk_load(paths: Iterable[str] = (), *,
+              nquads: Optional[Iterator[list[NQuad]]] = None,
+              schema: str = "", db: Optional[GraphDB] = None,
+              tmpdir: str | None = None) -> GraphDB:
+    """Build a GraphDB offline from RDF/JSON files and/or NQuad batches.
+    Ref: dgraph/cmd/bulk/run.go:106 + loader.go mapStage/reduceStage."""
+    db = db or GraphDB()
+    if schema:
+        db.alter(schema)
+    own_tmp = tmpdir is None
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="dg-bulk-")
+    xidmap = XidMap(db.coordinator)
+    shards: dict[str, _MapShard] = {}
+    pending_edges = 0
+
+    def shard(pred: str) -> _MapShard:
+        s = shards.get(pred)
+        if s is None:
+            s = _MapShard(tmpdir, pred)
+            shards[pred] = s
+        return s
+
+    def batches():
+        for p in paths:
+            yield from chunk_file(p)
+        if nquads is not None:
+            yield from nquads
+
+    # -- map stage (ref bulk/mapper.go:207 processNQuad) --
+    for batch in batches():
+        for nq in batch:
+            src = _resolve(xidmap, nq.subject)
+            s = shard(nq.predicate)
+            if nq.object_id:
+                s.src.append(src)
+                s.dst.append(_resolve(xidmap, nq.object_id))
+                if nq.facets:
+                    s.facets.append((src, s.dst[-1], nq.facets))
+            elif nq.object_value is not None:
+                s.vals.append((src, Posting(nq.object_value, nq.lang,
+                                            nq.facets)))
+            pending_edges += 1
+        if pending_edges >= _SPILL_EDGES:
+            for s in shards.values():
+                s.spill()
+            pending_edges = 0
+
+    # -- reduce stage (ref bulk/reduce.go:50) --
+    write_ts = db.coordinator.next_ts()
+    for pred, s in shards.items():
+        srcs, dsts, vals, facets = s.load_all()
+        tab = _tablet_for_bulk(db, pred, srcs, vals)
+        if len(srcs):
+            # segmented sort + unique: one lexsort replaces the k-way heap
+            order = np.lexsort((dsts, srcs))
+            srcs, dsts = srcs[order], dsts[order]
+            keep = np.ones(len(srcs), bool)
+            keep[1:] = (srcs[1:] != srcs[:-1]) | (dsts[1:] != dsts[:-1])
+            srcs, dsts = srcs[keep], dsts[keep]
+            bounds = np.nonzero(np.r_[True, srcs[1:] != srcs[:-1]])[0]
+            ends = np.r_[bounds[1:], len(srcs)]
+            for b, e in zip(bounds.tolist(), ends.tolist()):
+                src = int(srcs[b])
+                old = tab.edges.get(src)
+                tab.edges[src] = dsts[b:e].copy() if old is None \
+                    else np.union1d(old, dsts[b:e])
+            for fsrc, fdst, fc in facets:
+                tab.edge_facets[(fsrc, fdst)] = fc
+        for src, posting in vals:
+            if tab.schema.value_type not in (TypeID.DEFAULT,):
+                posting = Posting(
+                    convert(posting.value, tab.schema.value_type),
+                    posting.lang, posting.facets)
+            tab.values[src] = tab._merge_posting(
+                tab.values.get(src, []), posting)
+        tab.base_ts = write_ts
+        tab.rebuild_index()
+        tab.rebuild_reverse()
+        db.coordinator.should_serve(pred)
+    if own_tmp:
+        for s in shards.values():
+            for r in s.runs:
+                os.unlink(r)
+        try:
+            os.rmdir(tmpdir)
+        except OSError:
+            pass
+    return db
+
+
+def _resolve(xidmap: XidMap, ref: str) -> int:
+    if ref.startswith("_:"):
+        return xidmap.assign(ref)
+    try:
+        uid = int(ref, 0)
+    except ValueError:
+        return xidmap.assign(ref)  # external xid
+    xidmap.coordinator.bump_uids(uid)
+    return uid
+
+
+def _tablet_for_bulk(db: GraphDB, pred: str, srcs, vals) -> Tablet:
+    tab = db.tablets.get(pred)
+    if tab is not None:
+        return tab
+    ps = db.schema.get(pred)
+    if ps is None:
+        if len(srcs) and not vals:
+            tid = TypeID.UID
+        elif vals:
+            tid = vals[0][1].value.tid
+            if tid not in (TypeID.INT, TypeID.FLOAT, TypeID.BOOL,
+                           TypeID.DATETIME, TypeID.GEO):
+                tid = TypeID.DEFAULT
+        else:
+            tid = TypeID.DEFAULT
+        ps = PredicateSchema(pred, value_type=tid)
+        db.schema.set_predicate(ps)
+    tab = Tablet(pred, ps)
+    db.tablets[pred] = tab
+    return tab

@@ -1,0 +1,72 @@
+"""Store snapshots: serialize a GraphDB's rolled-up state to one file.
+
+The analogue of the reference bulk loader's output (a ready Badger p/
+directory, bulk/reduce.go writing SSTs) and the base artifact for
+backup/restore (ee/backup/). Format: a pickle of schema text + per-
+tablet base arrays + coordinator counters, gzip-compressed. Backups
+(backup.py) layer manifest chains and incremental deltas on top.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+
+SNAPSHOT_MAGIC = b"DGTPU-SNAP-1"
+
+
+def save_snapshot(db, path: str):
+    """Write the rolled-up store. Pending deltas are folded first so the
+    snapshot is a pure base state at a single ts."""
+    db.rollup_all()
+    tablets = {}
+    for pred, tab in db.tablets.items():
+        tablets[pred] = {
+            "edges": tab.edges,
+            "reverse": tab.reverse,
+            "values": tab.values,
+            "index": tab.index,
+            "edge_facets": tab.edge_facets,
+            "base_ts": tab.base_ts,
+        }
+    payload = {
+        "schema": db.schema.describe_all(),
+        "tablets": tablets,
+        "max_ts": db.coordinator.max_assigned(),
+        "next_uid": db.coordinator._next_uid,
+    }
+    tmp = path + ".tmp"
+    with gzip.open(tmp, "wb") as f:
+        f.write(SNAPSHOT_MAGIC)
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+
+
+def load_snapshot(path: str, db=None):
+    """Restore a GraphDB from a snapshot file (fresh one by default)."""
+    from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu.storage.tablet import Tablet
+
+    with gzip.open(path, "rb") as f:
+        magic = f.read(len(SNAPSHOT_MAGIC))
+        if magic != SNAPSHOT_MAGIC:
+            raise ValueError(f"{path!r} is not a dgraph-tpu snapshot")
+        payload = pickle.load(f)
+    db = db or GraphDB()
+    db.alter(payload["schema"])
+    for pred, st in payload["tablets"].items():
+        ps = db.schema.get_or_default(pred)
+        tab = Tablet(pred, ps)
+        tab.edges = st["edges"]
+        tab.reverse = st["reverse"]
+        tab.values = st["values"]
+        tab.index = st["index"]
+        tab.edge_facets = st["edge_facets"]
+        tab.base_ts = st["base_ts"]
+        db.tablets[pred] = tab
+        db.coordinator.should_serve(pred)
+    while db.coordinator.max_assigned() < payload["max_ts"]:
+        db.coordinator.next_ts()
+    db.coordinator.bump_uids(payload["next_uid"] - 1)
+    return db
