@@ -115,24 +115,27 @@ def main():
                           os.path.abspath(__file__)), ".jax_cache"))
     sys.stderr.write(f"jax devices: {jax.devices()}\n")
 
-    from dgraph_tpu.ops.graph import build_adjacency
-    from dgraph_tpu.ops.traverse import make_bfs
-    from dgraph_tpu.ops.uidvec import from_numpy, pad_to
+    import jax.numpy as jnp
+
+    from dgraph_tpu.ops.bitgraph import build_bitadjacency, make_bfs_bits, \
+        uids_to_bits
 
     t0 = time.time()
     edges = csr_to_dict(uniq_src, indptr, dst)
-    adj = build_adjacency(edges)
-    sys.stderr.write(f"device adjacency built ({time.time()-t0:.1f}s), "
-                     f"buckets={[(b.src.shape[0], b.degree) for b in adj.buckets]}\n")
+    badj = build_bitadjacency(edges)
+    sys.stderr.write(
+        f"device adjacency built ({time.time()-t0:.1f}s), "
+        f"slots={badj.n_slots} "
+        f"buckets={[(b.in_nb.shape[0], b.degree) for b in badj.buckets]}\n")
 
-    seed_size = pad_to(SEEDS)
-    fn = make_bfs(adj, seed_size, DEPTH)
+    fn = make_bfs_bits(badj, DEPTH)
+    seed_bits = [jax.device_put(jnp.asarray(
+        uids_to_bits(badj, s.astype(np.uint32)))) for s in seed_sets]
 
     def run(i):
-        seeds32 = seed_sets[i % len(seed_sets)].astype(np.uint32)
-        levels = fn(from_numpy(seeds32, seed_size))
+        levels = fn(seed_bits[i % len(seed_bits)])
         jax.block_until_ready(levels)
-        return int(np.sum(np.asarray(levels[-1]) != 0xFFFFFFFF))
+        return int(np.asarray(jnp.sum(levels[-1])))
 
     t0 = time.time()
     c0 = run(0)  # compile

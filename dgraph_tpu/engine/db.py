@@ -561,8 +561,9 @@ class GraphDB:
             dedup: bool = True) -> list[np.ndarray]:
         """Per-level frontier uid arrays reachable from `seeds` via
         `pred`, device-accelerated when the tablet is clean."""
-        from dgraph_tpu.engine.device_cache import _MAX_U32, device_adjacency
-        from dgraph_tpu.ops.traverse import bfs_reach
+        from dgraph_tpu.engine.device_cache import _MAX_U32, \
+            device_bitadjacency
+        from dgraph_tpu.ops.bitgraph import bfs_bits_reach
 
         seeds = np.asarray(sorted(set(int(s) for s in seeds)),
                            dtype=np.uint64)
@@ -570,10 +571,12 @@ class GraphDB:
         if tab is None:
             return [np.empty(0, np.uint64) for _ in range(depth)]
         read_ts = self.coordinator.max_assigned()
-        adj = device_adjacency(self, tab, read_ts) if self.prefer_device \
-            else None
-        if adj is not None:
-            lv32 = bfs_reach(adj, seeds[seeds <= _MAX_U32], depth, dedup)
+        badj = device_bitadjacency(self, tab, read_ts) \
+            if self.prefer_device else None
+        if badj is not None:
+            lv32 = bfs_bits_reach(
+                badj, seeds[seeds <= _MAX_U32].astype(np.uint32), depth,
+                dedup)
             return [lv.astype(np.uint64) for lv in lv32]
         # host fallback: same semantics over the MVCC overlay
         levels = []

@@ -58,6 +58,38 @@ def device_adjacency(db, tab, read_ts: int) -> Optional[DeviceAdjacency]:
     return adj
 
 
+def device_bitadjacency(db, tab, read_ts: int):
+    """Bitmap reverse adjacency (ops/bitgraph) for analytical BFS/SSSP.
+    Same residency policy as device_adjacency: clean rolled-up tablets
+    only; cached per base_ts."""
+    if tab.schema.value_type.name != "UID":
+        return None
+    if tab.dirty():
+        wm = db.coordinator.min_active_ts()
+        if wm >= tab.max_commit_ts:
+            tab.rollup(wm)
+        if tab.dirty():
+            return None
+    if read_ts < tab.base_ts:
+        return None
+    badj = getattr(tab, "_device_badj", None)
+    if badj is not None and getattr(tab, "_device_badj_ts", -1) == tab.base_ts:
+        return badj
+    n_edges = sum(len(v) for v in tab.edges.values())
+    if n_edges < db.device_min_edges:
+        return None
+    edges32 = {}
+    for src, dst in tab.edges.items():
+        if src > _MAX_U32 or (len(dst) and int(dst[-1]) > _MAX_U32):
+            return None
+        edges32[int(src)] = dst.astype(np.uint32)
+    from dgraph_tpu.ops.bitgraph import build_bitadjacency
+    badj = build_bitadjacency(edges32)
+    tab._device_badj = badj
+    tab._device_badj_ts = tab.base_ts
+    return badj
+
+
 def device_values(db, tab, read_ts: int):
     """Sortable value view for order-by / inequality offload."""
     if tab.dirty() or read_ts < tab.base_ts:

@@ -1263,7 +1263,12 @@ def _apply_math(fn: str, v: list, _m):
 
 
 def _levenshtein(a: str, b: str, cap: int) -> int:
-    """Banded edit distance (ref worker/match.go levenshtein)."""
+    """Banded edit distance (ref worker/match.go levenshtein).
+    Dispatches to the native C++ kernel (native/native.cc
+    dgt_levenshtein) when built."""
+    from dgraph_tpu import native
+    if native.available():
+        return native.levenshtein(a, b, cap)
     if abs(len(a) - len(b)) > cap:
         return cap + 1
     prev = list(range(len(b) + 1))

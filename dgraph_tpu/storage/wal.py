@@ -1,11 +1,13 @@
 """Write-ahead log: durability for committed transactions.
 
-The reference persists every mutation through Badger's value log +
-Raft WAL (raftwal/storage.go over Badger). Round-1 equivalent: an
-append-only record log with length-prefixed pickled commit records and
-an fsync policy; the engine replays it at open. Raft replication plugs
-in above this (cluster/), snapshotting truncates it (ref
-worker/draft.go:1206 calculateSnapshot).
+The reference persists every mutation through Badger's value log + Raft
+WAL (raftwal/storage.go over Badger). Here the framing, CRC validation,
+torn-tail truncation, and fsync policy live in the native C++ runtime
+(native/native.cc dgt_wal_*, bound via dgraph_tpu.native.NativeWal);
+records are pickled engine commit tuples. A pure-Python framer backs it
+up when the native library cannot be built. Raft replication plugs in
+above this (cluster/), snapshotting truncates it (ref worker/draft.go:1206
+calculateSnapshot).
 """
 
 from __future__ import annotations
@@ -15,10 +17,18 @@ import pickle
 import struct
 from typing import Any, Iterator
 
-_MAGIC = b"DGTWAL1\x00"
+from dgraph_tpu import native
+
+# Same on-disk format as native/native.cc (kWalMagic / frame =
+# u32 len | u32 crc32 | payload): the two backends are interchangeable
+# on the same file, so a store created with the native lib still opens
+# if the toolchain later disappears, and vice versa.
+_MAGIC = b"DGTWAL2\x00"
 
 
-class Wal:
+class _PyWal:
+    """Fallback framer, wire-compatible with dgt_wal_*."""
+
     def __init__(self, path: str, sync: bool = False):
         self.path = path
         self.sync = sync
@@ -28,32 +38,43 @@ class Wal:
             self._f.write(_MAGIC)
             self._f.flush()
 
-    def append(self, record: Any):
-        blob = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
-        self._f.write(struct.pack("<I", len(blob)))
+    def append(self, blob: bytes):
+        import zlib
+        self._f.write(struct.pack("<II", len(blob),
+                                  zlib.crc32(blob) & 0xFFFFFFFF))
         self._f.write(blob)
         self._f.flush()
         if self.sync:
             os.fsync(self._f.fileno())
 
-    def replay(self) -> Iterator[Any]:
+    def replay(self):
+        import zlib
+        records = []
         with open(self.path, "rb") as f:
             magic = f.read(len(_MAGIC))
             if magic != _MAGIC:
                 raise IOError(f"bad WAL magic in {self.path}")
+            good = f.tell()
             while True:
-                hdr = f.read(4)
-                if len(hdr) < 4:
+                hdr = f.read(8)
+                if len(hdr) < 8:
                     break
-                (n,) = struct.unpack("<I", hdr)
+                n, crc = struct.unpack("<II", hdr)
                 blob = f.read(n)
-                if len(blob) < n:
-                    break  # torn tail write: ignore, next append overwrites
-                yield pickle.loads(blob)
+                if len(blob) < n or (zlib.crc32(blob) & 0xFFFFFFFF) != crc:
+                    break  # torn/corrupt tail
+                records.append(blob)
+                good = f.tell()
+        self._f.flush()
+        size = os.path.getsize(self.path)
+        if good < size:
+            self._f.close()
+            with open(self.path, "rb+") as f:
+                f.truncate(good)
+            self._f = open(self.path, "ab+")
+        return records
 
     def truncate(self):
-        """Reset after a snapshot has captured state (ref raft WAL
-        truncation below snapshot index, raftwal/storage.go:594)."""
         self._f.close()
         self._f = open(self.path, "wb")
         self._f.write(_MAGIC)
@@ -61,5 +82,43 @@ class Wal:
         os.fsync(self._f.fileno())
         self._f = open(self.path, "ab+")
 
+    def flush(self):
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
     def close(self):
         self._f.close()
+
+
+class Wal:
+    """Record log for engine commits; native-backed when available."""
+
+    def __init__(self, path: str, sync: bool = False):
+        self.path = path
+        self.sync = sync
+        if native.available():
+            self._w = native.NativeWal(path, sync)
+            self.native = True
+        else:
+            self._w = _PyWal(path, sync)
+            self.native = False
+
+    def append(self, record: Any):
+        self._w.append(pickle.dumps(record,
+                                    protocol=pickle.HIGHEST_PROTOCOL))
+
+    def replay(self) -> Iterator[Any]:
+        for blob in self._w.replay():
+            yield pickle.loads(blob)
+
+    def truncate(self):
+        """Reset after a snapshot has captured state (ref raft WAL
+        truncation below snapshot index, raftwal/storage.go:594)."""
+        self._w.truncate()
+
+    def flush(self):
+        if hasattr(self._w, "flush"):
+            self._w.flush()
+
+    def close(self):
+        self._w.close()

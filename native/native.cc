@@ -1,0 +1,591 @@
+// dgraph_tpu native runtime kernels (C ABI, loaded via ctypes).
+//
+// TPU-native equivalents of the reference's host-side "native" components
+// (SURVEY.md §2a): the storage engine under posting lists and the Raft WAL
+// (Badger in the reference: posting/mvcc.go, raftwal/storage.go), the
+// group-varint UID block codec (codec/codec.go + go-groupvarint SSE), and
+// the bounded Levenshtein used by match() (worker/match.go).
+//
+// Design notes:
+//  - The KV store is an ordered std::map guarded by a mutex with an
+//    append-only CRC-framed WAL and point-in-time snapshot files; recovery
+//    = load snapshot + replay WAL, truncating a torn tail (the same
+//    crash-consistency contract Badger gives the reference).
+//  - All functions are C ABI; buffers are caller- or callee-owned as
+//    documented per function. Errors return negative codes.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#if defined(_WIN32)
+#error "posix only"
+#endif
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+// ---------------------------------------------------------------- crc32
+uint32_t crc_table[256];
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      crc_table[i] = c;
+    }
+  }
+} crc_init;
+
+uint32_t crc32(const uint8_t* p, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------- wal
+constexpr char kWalMagic[8] = {'D', 'G', 'T', 'W', 'A', 'L', '2', 0};
+
+struct Wal {
+  int fd = -1;
+  bool sync = false;
+  std::mutex mu;
+  std::string path;
+};
+
+// Record frame: u32 len | u32 crc32(payload) | payload.
+int wal_append_locked(Wal* w, const uint8_t* buf, uint32_t len) {
+  uint8_t hdr[8];
+  uint32_t crc = crc32(buf, len);
+  memcpy(hdr, &len, 4);
+  memcpy(hdr + 4, &crc, 4);
+  if (write(w->fd, hdr, 8) != 8) return -1;
+  ssize_t n = write(w->fd, buf, len);
+  if (n != (ssize_t)len) return -1;
+  if (w->sync && fsync(w->fd) != 0) return -1;
+  return 0;
+}
+
+// ---------------------------------------------------------------- kv
+struct Kv {
+  std::map<std::string, std::string> m;
+  Wal wal;
+  std::string dir;
+  std::mutex mu;
+  uint64_t wal_records = 0;
+};
+
+struct KvIter {
+  Kv* kv;
+  std::vector<std::pair<std::string, std::string>> items;  // stable snapshot
+  size_t pos = 0;
+};
+
+constexpr char kSnapMagic[8] = {'D', 'G', 'T', 'S', 'N', 'P', '2', 0};
+
+// WAL payload: op(1) | klen(u32) | key | vlen(u32) | value   op: 0=put 1=del
+void kv_apply(Kv* kv, const uint8_t* p, uint32_t len) {
+  if (len < 5) return;
+  uint8_t op = p[0];
+  uint32_t klen;
+  memcpy(&klen, p + 1, 4);
+  if (5 + klen > len) return;
+  std::string key((const char*)p + 5, klen);
+  if (op == 1) {
+    kv->m.erase(key);
+    return;
+  }
+  if (5 + klen + 4 > len) return;
+  uint32_t vlen;
+  memcpy(&vlen, p + 5 + klen, 4);
+  if (9 + klen + vlen > len) return;
+  kv->m[std::move(key)] =
+      std::string((const char*)p + 9 + klen, vlen);
+}
+
+int wal_open_file(Wal* w, const std::string& path, int sync) {
+  w->path = path;
+  w->sync = sync != 0;
+  w->fd = open(path.c_str(), O_RDWR | O_CREAT, 0644);
+  if (w->fd < 0) return -1;
+  struct stat st;
+  if (fstat(w->fd, &st) != 0) return -1;
+  if (st.st_size == 0) {
+    if (write(w->fd, kWalMagic, 8) != 8) return -1;
+  }
+  lseek(w->fd, 0, SEEK_END);
+  return 0;
+}
+
+// Replay WAL into kv; truncates a torn/corrupt tail.
+int kv_replay(Kv* kv) {
+  int fd = kv->wal.fd;
+  off_t size = lseek(fd, 0, SEEK_END);
+  if (size < 8) return -1;
+  std::vector<uint8_t> data(size);
+  if (pread(fd, data.data(), size, 0) != size) return -1;
+  if (memcmp(data.data(), kWalMagic, 8) != 0) return -2;
+  size_t off = 8;
+  size_t good = off;
+  std::vector<uint8_t> payload;
+  while (off + 8 <= (size_t)size) {
+    uint32_t len, crc;
+    memcpy(&len, &data[off], 4);
+    memcpy(&crc, &data[off + 4], 4);
+    if (off + 8 + len > (size_t)size) break;
+    if (crc32(&data[off + 8], len) != crc) break;
+    kv_apply(kv, &data[off + 8], len);
+    off += 8 + len;
+    good = off;
+    kv->wal_records++;
+  }
+  if (good < (size_t)size) {
+    if (ftruncate(fd, good) != 0) return -1;
+  }
+  lseek(fd, 0, SEEK_END);
+  return 0;
+}
+
+// Snapshot format: magic | count(u64) | repeat{klen u32, key, vlen u32, val}
+// | crc32 of everything after magic.
+int kv_write_snapshot(Kv* kv, const std::string& path) {
+  std::string tmp = path + ".tmp";
+  std::vector<uint8_t> body;
+  uint64_t count = kv->m.size();
+  auto put_raw = [&](const void* p, size_t n) {
+    const uint8_t* b = (const uint8_t*)p;
+    body.insert(body.end(), b, b + n);
+  };
+  put_raw(&count, 8);
+  for (auto& it : kv->m) {
+    uint32_t klen = it.first.size(), vlen = it.second.size();
+    put_raw(&klen, 4);
+    put_raw(it.first.data(), klen);
+    put_raw(&vlen, 4);
+    put_raw(it.second.data(), vlen);
+  }
+  uint32_t crc = crc32(body.data(), body.size());
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  bool ok = write(fd, kSnapMagic, 8) == 8 &&
+            write(fd, body.data(), body.size()) == (ssize_t)body.size() &&
+            write(fd, &crc, 4) == 4 && fsync(fd) == 0;
+  close(fd);
+  if (!ok) return -1;
+  if (rename(tmp.c_str(), path.c_str()) != 0) return -1;
+  return 0;
+}
+
+int kv_load_snapshot(Kv* kv, const std::string& path) {
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) return 1;  // no snapshot: fine
+  off_t size = lseek(fd, 0, SEEK_END);
+  std::vector<uint8_t> data(size);
+  bool ok = pread(fd, data.data(), size, 0) == size;
+  close(fd);
+  if (!ok || size < 20 || memcmp(data.data(), kSnapMagic, 8) != 0)
+    return -2;
+  uint32_t crc;
+  memcpy(&crc, &data[size - 4], 4);
+  if (crc32(&data[8], size - 12) != crc) return -2;
+  uint64_t count;
+  memcpy(&count, &data[8], 8);
+  size_t off = 16;
+  for (uint64_t i = 0; i < count; i++) {
+    if (off + 4 > (size_t)size - 4) return -2;
+    uint32_t klen;
+    memcpy(&klen, &data[off], 4);
+    off += 4;
+    std::string key((const char*)&data[off], klen);
+    off += klen;
+    uint32_t vlen;
+    memcpy(&vlen, &data[off], 4);
+    off += 4;
+    kv->m[std::move(key)] = std::string((const char*)&data[off], vlen);
+    off += vlen;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- kv ABI
+
+// Opens (or creates) a store in `dir`: loads dir/SNAPSHOT then replays
+// dir/WAL. Returns handle or null.
+void* dgt_kv_open(const char* dir, int sync) {
+  Kv* kv = new Kv();
+  kv->dir = dir;
+  mkdir(dir, 0755);
+  kv_load_snapshot(kv, kv->dir + "/SNAPSHOT");
+  if (wal_open_file(&kv->wal, kv->dir + "/WAL", sync) != 0) {
+    delete kv;
+    return nullptr;
+  }
+  if (kv_replay(kv) < 0) {
+    close(kv->wal.fd);
+    delete kv;
+    return nullptr;
+  }
+  return kv;
+}
+
+int dgt_kv_put(void* h, const uint8_t* key, uint32_t klen,
+               const uint8_t* val, uint32_t vlen) {
+  Kv* kv = (Kv*)h;
+  std::lock_guard<std::mutex> lk(kv->mu);
+  std::vector<uint8_t> rec(9 + klen + vlen);
+  rec[0] = 0;
+  memcpy(&rec[1], &klen, 4);
+  memcpy(&rec[5], key, klen);
+  memcpy(&rec[5 + klen], &vlen, 4);
+  memcpy(&rec[9 + klen], val, vlen);
+  if (wal_append_locked(&kv->wal, rec.data(), rec.size()) != 0) return -1;
+  kv->wal_records++;
+  kv->m[std::string((const char*)key, klen)] =
+      std::string((const char*)val, vlen);
+  return 0;
+}
+
+int dgt_kv_del(void* h, const uint8_t* key, uint32_t klen) {
+  Kv* kv = (Kv*)h;
+  std::lock_guard<std::mutex> lk(kv->mu);
+  std::vector<uint8_t> rec(5 + klen);
+  rec[0] = 1;
+  memcpy(&rec[1], &klen, 4);
+  memcpy(&rec[5], key, klen);
+  if (wal_append_locked(&kv->wal, rec.data(), rec.size()) != 0) return -1;
+  kv->wal_records++;
+  kv->m.erase(std::string((const char*)key, klen));
+  return 0;
+}
+
+// Returns value length, or -1 if absent. If out != null, copies up to cap.
+int64_t dgt_kv_get(void* h, const uint8_t* key, uint32_t klen,
+                   uint8_t* out, uint64_t cap) {
+  Kv* kv = (Kv*)h;
+  std::lock_guard<std::mutex> lk(kv->mu);
+  auto it = kv->m.find(std::string((const char*)key, klen));
+  if (it == kv->m.end()) return -1;
+  if (out) {
+    uint64_t n = it->second.size() < cap ? it->second.size() : cap;
+    memcpy(out, it->second.data(), n);
+  }
+  return (int64_t)it->second.size();
+}
+
+uint64_t dgt_kv_count(void* h) {
+  Kv* kv = (Kv*)h;
+  std::lock_guard<std::mutex> lk(kv->mu);
+  return kv->m.size();
+}
+
+// fsync the WAL (used when sync=0 for batched durability points).
+int dgt_kv_flush(void* h) {
+  Kv* kv = (Kv*)h;
+  std::lock_guard<std::mutex> lk(kv->mu);
+  return fsync(kv->wal.fd) == 0 ? 0 : -1;
+}
+
+// Writes SNAPSHOT atomically and truncates the WAL.
+int dgt_kv_snapshot(void* h) {
+  Kv* kv = (Kv*)h;
+  std::lock_guard<std::mutex> lk(kv->mu);
+  if (kv_write_snapshot(kv, kv->dir + "/SNAPSHOT") != 0) return -1;
+  if (ftruncate(kv->wal.fd, 0) != 0) return -1;
+  lseek(kv->wal.fd, 0, SEEK_SET);
+  if (write(kv->wal.fd, kWalMagic, 8) != 8) return -1;
+  kv->wal_records = 0;
+  return 0;
+}
+
+void dgt_kv_close(void* h) {
+  Kv* kv = (Kv*)h;
+  close(kv->wal.fd);
+  delete kv;
+}
+
+// Prefix iterator over a stable snapshot of the keyspace.
+void* dgt_kv_iter(void* h, const uint8_t* prefix, uint32_t plen) {
+  Kv* kv = (Kv*)h;
+  KvIter* it = new KvIter();
+  it->kv = kv;
+  std::string pfx((const char*)prefix, plen);
+  std::lock_guard<std::mutex> lk(kv->mu);
+  for (auto i = kv->m.lower_bound(pfx); i != kv->m.end(); ++i) {
+    if (i->first.compare(0, pfx.size(), pfx) != 0) break;
+    it->items.push_back(*i);
+  }
+  return it;
+}
+
+// Advances; returns 0 and fills lengths, or -1 at end. Two-call pattern:
+// first with null bufs to get sizes, then with bufs (same position until
+// dgt_kv_iter_advance).
+int dgt_kv_iter_next(void* hi, uint8_t* kout, uint64_t kcap, uint64_t* klen,
+                     uint8_t* vout, uint64_t vcap, uint64_t* vlen) {
+  KvIter* it = (KvIter*)hi;
+  if (it->pos >= it->items.size()) return -1;
+  auto& kvp = it->items[it->pos];
+  *klen = kvp.first.size();
+  *vlen = kvp.second.size();
+  if (kout) {
+    memcpy(kout, kvp.first.data(),
+           kvp.first.size() < kcap ? kvp.first.size() : kcap);
+    memcpy(vout, kvp.second.data(),
+           kvp.second.size() < vcap ? kvp.second.size() : vcap);
+    it->pos++;
+  }
+  return 0;
+}
+
+void dgt_kv_iter_close(void* hi) { delete (KvIter*)hi; }
+
+// ---------------------------------------------------------------- wal ABI
+// Standalone WAL (no in-memory map) for the transaction/Raft logs.
+
+void* dgt_wal_open(const char* path, int sync) {
+  Wal* w = new Wal();
+  if (wal_open_file(w, path, sync) != 0) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int dgt_wal_append(void* h, const uint8_t* buf, uint64_t len) {
+  Wal* w = (Wal*)h;
+  if (len > 0xFFFFFFFFull) return -2;  // frame length is u32
+  std::lock_guard<std::mutex> lk(w->mu);
+  return wal_append_locked(w, buf, (uint32_t)len);
+}
+
+int dgt_wal_flush(void* h) {
+  Wal* w = (Wal*)h;
+  std::lock_guard<std::mutex> lk(w->mu);
+  return fsync(w->fd) == 0 ? 0 : -1;
+}
+
+// Reads all valid records; returns a malloc'd buffer of concatenated
+// [u64 len | payload] entries, sets *total and *count. Truncates torn
+// tail. Caller frees via dgt_free.
+uint8_t* dgt_wal_replay(void* h, uint64_t* total, uint64_t* count) {
+  Wal* w = (Wal*)h;
+  std::lock_guard<std::mutex> lk(w->mu);
+  *total = 0;
+  *count = 0;
+  off_t size = lseek(w->fd, 0, SEEK_END);
+  if (size < 8) return nullptr;
+  std::vector<uint8_t> data(size);
+  if (pread(w->fd, data.data(), size, 0) != size) return nullptr;
+  if (memcmp(data.data(), kWalMagic, 8) != 0) return nullptr;
+  std::vector<uint8_t> out;
+  size_t off = 8, good = 8;
+  while (off + 8 <= (size_t)size) {
+    uint32_t len, crc;
+    memcpy(&len, &data[off], 4);
+    memcpy(&crc, &data[off + 4], 4);
+    if (off + 8 + len > (size_t)size) break;
+    if (crc32(&data[off + 8], len) != crc) break;
+    uint64_t len64 = len;
+    out.insert(out.end(), (uint8_t*)&len64, (uint8_t*)&len64 + 8);
+    out.insert(out.end(), &data[off + 8], &data[off + 8 + len]);
+    off += 8 + len;
+    good = off;
+    (*count)++;
+  }
+  if (good < (size_t)size) {
+    if (ftruncate(w->fd, good) != 0) return nullptr;
+  }
+  lseek(w->fd, 0, SEEK_END);
+  *total = out.size();
+  uint8_t* buf = (uint8_t*)malloc(out.size() ? out.size() : 1);
+  memcpy(buf, out.data(), out.size());
+  return buf;
+}
+
+// Truncates the log to empty (post-snapshot).
+int dgt_wal_truncate(void* h) {
+  Wal* w = (Wal*)h;
+  std::lock_guard<std::mutex> lk(w->mu);
+  if (ftruncate(w->fd, 0) != 0) return -1;
+  lseek(w->fd, 0, SEEK_SET);
+  if (write(w->fd, kWalMagic, 8) != 8) return -1;
+  if (w->sync && fsync(w->fd) != 0) return -1;
+  return 0;
+}
+
+void dgt_wal_close(void* h) {
+  Wal* w = (Wal*)h;
+  close(w->fd);
+  delete w;
+}
+
+void dgt_free(void* p) { free(p); }
+
+// ------------------------------------------------------------- codec ABI
+// Group-varint delta codec for sorted u64 UID lists. Layout per block of
+// up to 4 deltas: 1 tag byte (2 bits per delta = byte width 1/2/4/8 - 1
+// encoded as 0..3 meaning 1,2,4,8 bytes) followed by the delta bytes.
+// Stream: u64 count | u64 first | blocks of deltas. This is our own
+// wire design in the spirit of codec/codec.go; decode is branch-light.
+
+static inline int width_code(uint64_t v) {
+  if (v < (1ull << 8)) return 0;
+  if (v < (1ull << 16)) return 1;
+  if (v < (1ull << 32)) return 2;
+  return 3;
+}
+static const int kWidth[4] = {1, 2, 4, 8};
+
+// Encodes n sorted uids. out must have capacity >= 16 + n*9. Returns
+// bytes written, or -1.
+int64_t dgt_gv_encode(const uint64_t* uids, uint64_t n, uint8_t* out) {
+  uint8_t* p = out;
+  memcpy(p, &n, 8);
+  p += 8;
+  if (n == 0) return p - out;
+  memcpy(p, &uids[0], 8);
+  p += 8;
+  uint64_t i = 1;
+  while (i < n) {
+    uint64_t cnt = (n - i) < 4 ? (n - i) : 4;
+    uint8_t* tag = p++;
+    *tag = 0;
+    for (uint64_t j = 0; j < cnt; j++) {
+      uint64_t d = uids[i + j] - uids[i + j - 1];
+      int wc = width_code(d);
+      *tag |= (uint8_t)(wc << (2 * j));
+      memcpy(p, &d, kWidth[wc]);
+      p += kWidth[wc];
+    }
+    // unused slots in the last tag keep width code 0 and no bytes
+    i += cnt;
+  }
+  return p - out;
+}
+
+// Decodes into out (capacity from the stream's count, read via
+// dgt_gv_count). Returns number of uids, or -1 on malformed input.
+int64_t dgt_gv_decode(const uint8_t* buf, uint64_t len, uint64_t* out) {
+  if (len < 8) return -1;
+  uint64_t n;
+  memcpy(&n, buf, 8);
+  if (n == 0) return 0;
+  if (len < 16) return -1;
+  uint64_t prev;
+  memcpy(&prev, buf + 8, 8);
+  out[0] = prev;
+  const uint8_t* p = buf + 16;
+  const uint8_t* end = buf + len;
+  uint64_t i = 1;
+  while (i < n) {
+    if (p >= end) return -1;
+    uint8_t tag = *p++;
+    uint64_t cnt = (n - i) < 4 ? (n - i) : 4;
+    for (uint64_t j = 0; j < cnt; j++) {
+      int w = kWidth[(tag >> (2 * j)) & 3];
+      if (p + w > end) return -1;
+      uint64_t d = 0;
+      memcpy(&d, p, w);
+      p += w;
+      prev += d;
+      out[i++] = prev;
+    }
+  }
+  return (int64_t)n;
+}
+
+uint64_t dgt_gv_count(const uint8_t* buf, uint64_t len) {
+  if (len < 8) return 0;
+  uint64_t n;
+  memcpy(&n, buf, 8);
+  return n;
+}
+
+// ------------------------------------------------------------- match ABI
+
+// UTF-8 -> code points (invalid bytes pass through as raw values), so the
+// distance is measured in characters like the reference's []rune
+// conversion (worker/match.go) and the Python fallback.
+static void utf8_decode(const uint8_t* s, uint32_t n,
+                        std::vector<uint32_t>* out) {
+  uint32_t i = 0;
+  while (i < n) {
+    uint8_t c = s[i];
+    uint32_t cp = c;
+    uint32_t extra = 0;
+    if ((c & 0xE0) == 0xC0) {
+      cp = c & 0x1F;
+      extra = 1;
+    } else if ((c & 0xF0) == 0xE0) {
+      cp = c & 0x0F;
+      extra = 2;
+    } else if ((c & 0xF8) == 0xF0) {
+      cp = c & 0x07;
+      extra = 3;
+    }
+    if (i + extra >= n && extra) {  // truncated sequence: raw byte
+      out->push_back(c);
+      i++;
+      continue;
+    }
+    bool ok = true;
+    for (uint32_t k = 1; k <= extra; k++) {
+      if ((s[i + k] & 0xC0) != 0x80) {
+        ok = false;
+        break;
+      }
+      cp = (cp << 6) | (s[i + k] & 0x3F);
+    }
+    if (!ok) {
+      out->push_back(c);
+      i++;
+    } else {
+      out->push_back(cp);
+      i += extra + 1;
+    }
+  }
+}
+
+// Bounded Levenshtein distance over code points (ref worker/match.go);
+// returns the distance, or max_d + 1 if it exceeds max_d.
+int32_t dgt_levenshtein(const uint8_t* ab, uint32_t lab, const uint8_t* bb,
+                        uint32_t lbb, int32_t max_d) {
+  std::vector<uint32_t> av, bv;
+  utf8_decode(ab, lab, &av);
+  utf8_decode(bb, lbb, &bv);
+  const std::vector<uint32_t>* a = &av;
+  const std::vector<uint32_t>* b = &bv;
+  if (a->size() > b->size()) std::swap(a, b);
+  uint32_t la = a->size(), lb = b->size();
+  if ((int32_t)(lb - la) > max_d) return max_d + 1;
+  std::vector<int32_t> prev(la + 1), cur(la + 1);
+  for (uint32_t i = 0; i <= la; i++) prev[i] = i;
+  for (uint32_t j = 1; j <= lb; j++) {
+    cur[0] = j;
+    int32_t row_min = cur[0];
+    for (uint32_t i = 1; i <= la; i++) {
+      int32_t cost = (*a)[i - 1] == (*b)[j - 1] ? 0 : 1;
+      int32_t v = prev[i - 1] + cost;
+      if (prev[i] + 1 < v) v = prev[i] + 1;
+      if (cur[i - 1] + 1 < v) v = cur[i - 1] + 1;
+      cur[i] = v;
+      if (v < row_min) row_min = v;
+    }
+    if (row_min > max_d) return max_d + 1;
+    std::swap(prev, cur);
+  }
+  return prev[la] <= max_d ? prev[la] : max_d + 1;
+}
+
+}  // extern "C"

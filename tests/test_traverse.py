@@ -121,4 +121,4 @@ def test_graphdb_bfs_parity():
         b = dev.bfs("link", [1, 5], 3, dedup=dedup)
         for x, y in zip(a, b):
             np.testing.assert_array_equal(x, y)
-    assert dev.tablets["link"]._device_adj is not None
+    assert dev.tablets["link"]._device_badj is not None
