@@ -100,6 +100,9 @@ class GraphDB:
         self.prefer_device = prefer_device
         self.device_min_edges = device_min_edges
         self.wal = Wal(wal_path) if wal_path else None
+        # optional record sink: Raft replication taps the same durable
+        # record stream the WAL gets (cluster/replica.py)
+        self.on_record = None
         if self.wal:
             self._replay()
 
@@ -114,13 +117,12 @@ class GraphDB:
             self.schema = SchemaState()
             if self.wal:
                 self.wal.truncate()
-                self.wal.append(("drop_all",))
+            self._log_record(("drop_all",))
             return
         if drop_attr:
             self.tablets.pop(drop_attr, None)
             self.schema.delete_predicate(drop_attr)
-            if self.wal:
-                self.wal.append(("drop_attr", drop_attr))
+            self._log_record(("drop_attr", drop_attr))
             return
         preds, types = self.schema.apply_text(schema_text)
         for ps in preds:
@@ -136,8 +138,7 @@ class GraphDB:
                     t.rebuild_index()
                 if old.reverse != ps.reverse:
                     t.rebuild_reverse()
-        if self.wal:
-            self.wal.append(("alter", schema_text))
+        self._log_record(("alter", schema_text))
 
     # ------------------------------------------------------------------
     # Transactions
@@ -421,15 +422,15 @@ class GraphDB:
         expanded = self._expand_ops(commit_ts, txn.staged)
         for pred, ops in expanded.items():
             self._tablet_for(pred).apply(commit_ts, ops)
-        if self.wal:
+        if self.wal or self.on_record:
             # log the *expanded* ops (incl. synthesized old-token deletes)
             # plus the schema of every touched predicate, so replay is
             # self-contained even for schema created on the fly
             schemas = {p: self.schema.get_or_default(p).describe()
                        for p in expanded}
-            self.wal.append(("commit", commit_ts,
-                             [(p, op) for p, ops in expanded.items()
-                              for op in ops], schemas))
+            self._log_record(("commit", commit_ts,
+                              [(p, op) for p, ops in expanded.items()
+                               for op in ops], schemas))
         return commit_ts
 
     def discard(self, txn: Txn):
@@ -476,47 +477,65 @@ class GraphDB:
             out[pred] = expanded
         return out
 
+    def _log_record(self, rec):
+        if self.wal:
+            self.wal.append(rec)
+        if self.on_record:
+            self.on_record(rec)
+
+    def apply_record(self, rec) -> int:
+        """Applies one durable mutation record (WAL replay and the Raft
+        apply loop share this path — ref worker/draft.go:435
+        processApplyCh/applyCommitted). Returns the commit ts the record
+        carried, 0 for schema ops."""
+        kind = rec[0]
+        if kind == "alter":
+            preds, types = self.schema.apply_text(rec[1])
+            for ps in preds:
+                t = self.tablets.get(ps.predicate)
+                if t:
+                    t.schema = ps
+                    t.rebuild_index()
+                    t.rebuild_reverse()
+            return 0
+        if kind == "drop_all":
+            self.tablets.clear()
+            self.schema = SchemaState()
+            return 0
+        if kind == "drop_attr":
+            self.tablets.pop(rec[1], None)
+            self.schema.delete_predicate(rec[1])
+            return 0
+        if kind == "commit":
+            _, commit_ts, staged, schemas = rec
+            # restore on-the-fly schema before creating tablets
+            for pred, desc in schemas.items():
+                if not self.schema.has(pred):
+                    self.schema.apply_text(desc)
+            by_pred: dict[str, list[EdgeOp]] = {}
+            for pred, op in staged:
+                by_pred.setdefault(pred, []).append(op)
+            for pred, ops in by_pred.items():
+                # ops were expanded before logging: apply verbatim
+                self._tablet_for(pred).apply(commit_ts, ops)
+            uids = [op.src for _, op in staged] + \
+                   [op.dst for _, op in staged if op.dst]
+            if uids:
+                self.coordinator.bump_uids(max(uids))
+            return commit_ts
+        raise ValueError(f"unknown record kind {kind!r}")
+
+    def fast_forward_ts(self, max_ts: int):
+        """Advance the ts counter past replayed/replicated commits."""
+        while self.coordinator.max_assigned() < max_ts:
+            self.coordinator.next_ts()
+
     def _replay(self):
         max_ts = 0
         for rec in self.wal.replay():
-            kind = rec[0]
-            if kind == "alter":
-                preds, types = self.schema.apply_text(rec[1])
-                for ps in preds:
-                    t = self.tablets.get(ps.predicate)
-                    if t:
-                        t.schema = ps
-                        t.rebuild_index()
-                        t.rebuild_reverse()
-            elif kind == "drop_all":
-                self.tablets.clear()
-                self.schema = SchemaState()
-            elif kind == "drop_attr":
-                self.tablets.pop(rec[1], None)
-                self.schema.delete_predicate(rec[1])
-            elif kind == "commit":
-                _, commit_ts, staged, schemas = rec
-                # restore on-the-fly schema before creating tablets
-                for pred, desc in schemas.items():
-                    if not self.schema.has(pred):
-                        self.schema.apply_text(desc)
-                for pred, op in staged:
-                    self._tablet_for(pred)
-                max_ts = max(max_ts, commit_ts)
-                by_pred: dict[str, list[EdgeOp]] = {}
-                for pred, op in staged:
-                    by_pred.setdefault(pred, []).append(op)
-                for pred, ops in by_pred.items():
-                    # ops were expanded before logging: apply verbatim
-                    self.tablets[pred].apply(commit_ts, ops)
-                uids = [op.src for _, op in staged] + \
-                       [op.dst for _, op in staged if op.dst]
-                if uids:
-                    self.coordinator.bump_uids(max(uids))
+            max_ts = max(max_ts, self.apply_record(rec))
         if max_ts:
-            # fast-forward the ts counter past everything in the log
-            while self.coordinator.max_assigned() < max_ts:
-                self.coordinator.next_ts()
+            self.fast_forward_ts(max_ts)
 
     # ------------------------------------------------------------------
     # Query (ref edgraph/server.go:634 Query -> query.Process)

@@ -1,0 +1,61 @@
+"""Pure-Python stand-in for native.NativeKV (same API) used only when
+the C++ runtime can't be built: dict + WAL-file persistence via the
+wire-compatible _PyWal framer."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+
+class PyKV:
+    def __init__(self, directory: str, sync: bool = False):
+        from dgraph_tpu.storage.wal import _PyWal
+        os.makedirs(directory, exist_ok=True)
+        self._dir = directory
+        self._m: dict[bytes, bytes] = {}
+        snap = os.path.join(directory, "SNAPSHOT.py")
+        if os.path.exists(snap):
+            with open(snap, "rb") as f:
+                self._m = pickle.load(f)
+        self._wal = _PyWal(os.path.join(directory, "WAL"), sync)
+        for blob in self._wal.replay():
+            op, k, v = pickle.loads(blob)
+            if op == 0:
+                self._m[k] = v
+            else:
+                self._m.pop(k, None)
+
+    def put(self, key: bytes, val: bytes):
+        self._wal.append(pickle.dumps((0, key, val)))
+        self._m[key] = val
+
+    def delete(self, key: bytes):
+        self._wal.append(pickle.dumps((1, key, None)))
+        self._m.pop(key, None)
+
+    def get(self, key: bytes):
+        return self._m.get(key)
+
+    def __len__(self):
+        return len(self._m)
+
+    def scan(self, prefix: bytes = b""):
+        for k in sorted(self._m):
+            if k.startswith(prefix):
+                yield k, self._m[k]
+
+    def flush(self):
+        self._wal.flush()
+
+    def snapshot(self):
+        tmp = os.path.join(self._dir, "SNAPSHOT.py.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(self._m, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self._dir, "SNAPSHOT.py"))
+        self._wal.truncate()
+
+    def close(self):
+        self._wal.close()

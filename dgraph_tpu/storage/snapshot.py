@@ -1,10 +1,11 @@
-"""Store snapshots: serialize a GraphDB's rolled-up state to one file.
+"""Store snapshots: serialize a GraphDB's rolled-up state.
 
 The analogue of the reference bulk loader's output (a ready Badger p/
 directory, bulk/reduce.go writing SSTs) and the base artifact for
-backup/restore (ee/backup/). Format: a pickle of schema text + per-
-tablet base arrays + coordinator counters, gzip-compressed. Backups
-(backup.py) layer manifest chains and incremental deltas on top.
+backup/restore (ee/backup/) and Raft InstallSnapshot payloads
+(worker/snapshot.go doStreamSnapshot/populateSnapshot). Format: a
+pickle of schema text + per-tablet base arrays + coordinator counters;
+the file form is gzip-compressed with a magic header.
 """
 
 from __future__ import annotations
@@ -16,9 +17,9 @@ import pickle
 SNAPSHOT_MAGIC = b"DGTPU-SNAP-1"
 
 
-def save_snapshot(db, path: str):
-    """Write the rolled-up store. Pending deltas are folded first so the
-    snapshot is a pure base state at a single ts."""
+def dump_state(db) -> dict:
+    """GraphDB -> one picklable state payload at a single ts. Pending
+    deltas are folded first so the payload is pure base state."""
     db.rollup_all()
     tablets = {}
     for pred, tab in db.tablets.items():
@@ -30,29 +31,19 @@ def save_snapshot(db, path: str):
             "edge_facets": tab.edge_facets,
             "base_ts": tab.base_ts,
         }
-    payload = {
+    return {
         "schema": db.schema.describe_all(),
         "tablets": tablets,
         "max_ts": db.coordinator.max_assigned(),
         "next_uid": db.coordinator._next_uid,
     }
-    tmp = path + ".tmp"
-    with gzip.open(tmp, "wb") as f:
-        f.write(SNAPSHOT_MAGIC)
-        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-    os.replace(tmp, path)
 
 
-def load_snapshot(path: str, db=None):
-    """Restore a GraphDB from a snapshot file (fresh one by default)."""
+def restore_state(payload: dict, db=None):
+    """State payload -> GraphDB (fresh one by default)."""
     from dgraph_tpu.engine.db import GraphDB
     from dgraph_tpu.storage.tablet import Tablet
 
-    with gzip.open(path, "rb") as f:
-        magic = f.read(len(SNAPSHOT_MAGIC))
-        if magic != SNAPSHOT_MAGIC:
-            raise ValueError(f"{path!r} is not a dgraph-tpu snapshot")
-        payload = pickle.load(f)
     db = db or GraphDB()
     db.alter(payload["schema"])
     for pred, st in payload["tablets"].items():
@@ -70,3 +61,23 @@ def load_snapshot(path: str, db=None):
         db.coordinator.next_ts()
     db.coordinator.bump_uids(payload["next_uid"] - 1)
     return db
+
+
+def save_snapshot(db, path: str):
+    """Write the rolled-up store to one file."""
+    payload = dump_state(db)
+    tmp = path + ".tmp"
+    with gzip.open(tmp, "wb") as f:
+        f.write(SNAPSHOT_MAGIC)
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+
+
+def load_snapshot(path: str, db=None):
+    """Restore a GraphDB from a snapshot file (fresh one by default)."""
+    with gzip.open(path, "rb") as f:
+        magic = f.read(len(SNAPSHOT_MAGIC))
+        if magic != SNAPSHOT_MAGIC:
+            raise ValueError(f"{path!r} is not a dgraph-tpu snapshot")
+        payload = pickle.load(f)
+    return restore_state(payload, db)
