@@ -32,18 +32,90 @@ def cmd_alpha(args) -> int:
     from dgraph_tpu.engine.db import GraphDB
     from dgraph_tpu.server.http import serve
 
+    enc_key = _enc_key(args)
     if args.snapshot:
         from dgraph_tpu.storage.snapshot import load_snapshot
 
         db = load_snapshot(args.snapshot,
                            GraphDB(wal_path=args.wal or None,
-                                   prefer_device=not args.no_device))
+                                   prefer_device=not args.no_device,
+                                   enc_key=enc_key))
     else:
         db = GraphDB(wal_path=args.wal or None,
-                     prefer_device=not args.no_device)
-    print(f"dgraph-tpu alpha listening on http://{args.host}:{args.port}",
-          file=sys.stderr)
-    serve(db, host=args.host, port=args.port, block=True)
+                     prefer_device=not args.no_device, enc_key=enc_key)
+    secret = None
+    if args.acl_secret_file:
+        with open(args.acl_secret_file, "rb") as f:
+            secret = f.read().strip()
+    print(f"dgraph-tpu alpha listening on http://{args.host}:{args.port}"
+          + (" (ACL on)" if secret else ""), file=sys.stderr)
+    serve(db, host=args.host, port=args.port, block=True,
+          acl_secret=secret)
+    return 0
+
+
+def _enc_key(args):
+    if getattr(args, "encryption_key_file", ""):
+        from dgraph_tpu.storage.enc import load_key
+        return load_key(args.encryption_key_file)
+    return None
+
+
+def cmd_backup(args) -> int:
+    """Binary backup with incremental manifest chain
+    (ref `dgraph backup` -> ee/backup/backup.go)."""
+    from dgraph_tpu.engine.db import GraphDB
+
+    db = GraphDB(wal_path=args.wal or None, prefer_device=False,
+                 enc_key=_enc_key(args))
+    from dgraph_tpu.storage.backup import backup
+
+    entry = backup(db, args.destination, force_full=args.full,
+                   key=_enc_key(args))
+    print(json.dumps(entry, indent=2))
+    return 0
+
+
+def cmd_restore(args) -> int:
+    """Restore a backup chain into a fresh store
+    (ref `dgraph restore` -> ee/backup/restore.go)."""
+    from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu.storage.backup import restore
+
+    db = GraphDB(wal_path=args.wal or None, prefer_device=False,
+                 enc_key=_enc_key(args))
+    restore(args.location, db=db, key=_enc_key(args))
+    if args.snapshot_out:
+        from dgraph_tpu.storage.snapshot import save_snapshot
+        save_snapshot(db, args.snapshot_out)
+    print(f"restored {len(db.tablets)} predicates, "
+          f"max_ts={db.coordinator.max_assigned()}", file=sys.stderr)
+    return 0
+
+
+def cmd_acl(args) -> int:
+    """ACL admin against a store directory (ref `dgraph acl` subcommands,
+    ee/acl/acl.go: useradd/userdel/groupadd/groupdel/usermod/chmod/info)."""
+    from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu.server.acl import AclManager
+
+    db = GraphDB(wal_path=args.wal or None, prefer_device=False)
+    mgr = AclManager(db, secret=b"cli")
+    op = args.acl_op
+    if op == "useradd":
+        mgr.add_user(args.user, args.password)
+    elif op == "userdel":
+        mgr.delete_principal(args.user)
+    elif op == "groupadd":
+        mgr.add_group(args.group)
+    elif op == "groupdel":
+        mgr.delete_principal(args.group)
+    elif op == "usermod":
+        mgr.set_groups(args.user, [g for g in args.groups.split(",") if g])
+    elif op == "chmod":
+        mgr.chmod(args.group, args.pred, args.perm)
+    elif op == "info":
+        print(json.dumps(mgr.info(), indent=2))
     return 0
 
 
@@ -199,7 +271,45 @@ def main(argv=None) -> int:
     a.add_argument("--snapshot", default=_env_default("alpha", "snapshot", ""))
     a.add_argument("--no-device", action="store_true",
                    default=_env_default("alpha", "no_device", False))
+    a.add_argument("--acl_secret_file",
+                   default=_env_default("alpha", "acl_secret_file", ""),
+                   help="enables ACL; file holds the HMAC jwt secret")
+    a.add_argument("--encryption_key_file",
+                   default=_env_default("alpha", "encryption_key_file", ""),
+                   help="AES key file: encrypts WAL records at rest")
     a.set_defaults(fn=cmd_alpha)
+
+    acl = sub.add_parser("acl", help="ACL admin on a store directory")
+    acl.add_argument("acl_op", choices=["useradd", "userdel", "groupadd",
+                                        "groupdel", "usermod", "chmod",
+                                        "info"])
+    acl.add_argument("--wal", default="", help="store WAL path")
+    acl.add_argument("-a", "--user", default="")
+    acl.add_argument("-g", "--group", default="")
+    acl.add_argument("-p", "--password", default="")
+    acl.add_argument("-l", "--groups", default="",
+                     help="comma-separated groups for usermod")
+    acl.add_argument("--pred", default="", help="predicate for chmod")
+    acl.add_argument("-m", "--perm", type=int, default=0,
+                     help="perm bits for chmod: Read=4 Write=2 Modify=1")
+    acl.set_defaults(fn=cmd_acl)
+
+    bk = sub.add_parser("backup", help="binary backup (manifest chain)")
+    bk.add_argument("--wal", default="", help="store WAL path")
+    bk.add_argument("destination", help="backup dir or file:// URI")
+    bk.add_argument("--full", action="store_true",
+                    help="force a full backup instead of incremental")
+    bk.add_argument("--encryption_key_file", default="")
+    bk.set_defaults(fn=cmd_backup)
+
+    rs = sub.add_parser("restore", help="restore a backup chain")
+    rs.add_argument("location", help="backup dir or file:// URI")
+    rs.add_argument("--wal", default="",
+                    help="WAL path for the restored store")
+    rs.add_argument("--snapshot_out", default="",
+                    help="also write a snapshot file")
+    rs.add_argument("--encryption_key_file", default="")
+    rs.set_defaults(fn=cmd_restore)
 
     v = sub.add_parser("version", help="print version info")
     v.set_defaults(fn=cmd_version)

@@ -93,13 +93,15 @@ class Latency:
 class GraphDB:
     def __init__(self, wal_path: str | None = None,
                  prefer_device: bool = True,
-                 device_min_edges: int = 1024):
+                 device_min_edges: int = 1024,
+                 enc_key: bytes | None = None):
         self.schema = SchemaState()
         self.coordinator = Coordinator()
         self.tablets: dict[str, Tablet] = {}
         self.prefer_device = prefer_device
         self.device_min_edges = device_min_edges
-        self.wal = Wal(wal_path) if wal_path else None
+        self.enc_key = enc_key
+        self.wal = Wal(wal_path, key=enc_key) if wal_path else None
         # optional record sink: Raft replication taps the same durable
         # record stream the WAL gets (cluster/replica.py)
         self.on_record = None

@@ -48,6 +48,42 @@ _NAME_TO_TYPE = {
 _TYPE_TO_NAME = {v: k for k, v in _NAME_TO_TYPE.items()}
 
 
+_SCRYPT_N, _SCRYPT_R, _SCRYPT_P = 2 ** 12, 8, 1
+
+
+def hash_password(plain: str) -> str:
+    """Salted scrypt hash, applied at ingest like the reference's bcrypt
+    conversion (types/password.go Encrypt). Already-hashed values pass
+    through so replay/restore stays idempotent."""
+    import base64
+    import os as _os
+    if plain.startswith("scrypt$"):
+        return plain
+    salt = _os.urandom(16)
+    h = hashlib.scrypt(plain.encode(), salt=salt, n=_SCRYPT_N,
+                       r=_SCRYPT_R, p=_SCRYPT_P)
+    return "scrypt$%s$%s" % (base64.b64encode(salt).decode(),
+                             base64.b64encode(h).decode())
+
+
+def verify_password(plain: str, stored: str) -> bool:
+    """Constant-time check against a stored hash (types/password.go
+    VerifyPassword / checkpwd query function)."""
+    import base64
+    import hmac as _hmac
+    try:
+        scheme, salt_b64, h_b64 = stored.split("$")
+        if scheme != "scrypt":
+            return False
+        salt = base64.b64decode(salt_b64)
+        want = base64.b64decode(h_b64)
+    except (ValueError, TypeError):
+        return False
+    got = hashlib.scrypt(plain.encode(), salt=salt, n=_SCRYPT_N,
+                         r=_SCRYPT_R, p=_SCRYPT_P)
+    return _hmac.compare_digest(got, want)
+
+
 def type_from_name(name: str) -> TypeID:
     t = _NAME_TO_TYPE.get(name)
     if t is None:
@@ -139,7 +175,7 @@ def convert(v: Val, to: TypeID) -> Val:
             if v.tid == TypeID.FLOAT:
                 return Val(to, _dt.datetime.fromtimestamp(float(val), _dt.timezone.utc))
         if to == TypeID.PASSWORD and v.tid in (TypeID.STRING, TypeID.DEFAULT):
-            return Val(to, str(val))
+            return Val(to, hash_password(str(val)))
         if to == TypeID.BINARY:
             return Val(to, _to_string(v).encode())
         if to == TypeID.GEO and v.tid in (TypeID.STRING, TypeID.DEFAULT):

@@ -225,7 +225,25 @@ class Executor:
             return self._eval_match(fn, candidates)
         if name == "uid_in":
             return self._eval_uid_in(fn, candidates)
+        if name == "checkpwd":
+            return self._eval_checkpwd(fn, candidates)
         raise GQLError(f"function {name!r} not supported")
+
+    def _eval_checkpwd(self, fn: Function, candidates) -> np.ndarray:
+        """UIDs whose stored password hash verifies against the given
+        plaintext (ref worker/task.go handleCheckPassword +
+        types/password.go VerifyPassword)."""
+        from dgraph_tpu.models.types import verify_password
+        tab = self._tablet(fn.attr)
+        if tab is None or not fn.args:
+            return _EMPTY
+        plain = str(fn.args[0].value)
+        scan = candidates if candidates is not None \
+            else tab.src_uids(self.read_ts)
+        keep = [u for u in scan.tolist()
+                if any(verify_password(plain, str(p.value.value))
+                       for p in tab.get_postings(u, self.read_ts))]
+        return np.asarray(keep, dtype=np.uint64)
 
     def _eval_eq_tokens(self, tab: Optional[Tablet], vals: list[Val],
                         candidates) -> np.ndarray:

@@ -41,6 +41,7 @@ from urllib.parse import parse_qs, urlparse
 
 from dgraph_tpu.cluster.coordinator import TxnAborted
 from dgraph_tpu.engine.db import GraphDB, Mutation, Txn
+from dgraph_tpu.server.acl import AclError
 
 # startTs -> open server-side txn (the reference keeps this state in the
 # client + oracle; our engine txns are server objects, so the server maps)
@@ -51,13 +52,30 @@ class AlphaServer:
     """Engine + txn table behind the HTTP front end."""
 
     def __init__(self, db: Optional[GraphDB] = None,
-                 txn_ttl_s: float = 300.0):
+                 txn_ttl_s: float = 300.0,
+                 acl_secret: Optional[bytes] = None):
         self.db = db or GraphDB()
         self.lock = threading.RLock()
         self.txns: dict[int, Txn] = {}
         self._touched: dict[int, float] = {}
         self.txn_ttl_s = txn_ttl_s
         self.started_at = time.time()
+        # ACL enforcement turns on when a secret is configured
+        # (ref --acl_secret_file, dgraph/cmd/alpha/run.go flags)
+        self.acl = None
+        if acl_secret is not None:
+            from dgraph_tpu.server.acl import AclManager
+            with self.lock:
+                self.acl = AclManager(self.db, acl_secret)
+
+    def handle_login(self, body: dict) -> dict:
+        if self.acl is None:
+            raise ValueError("ACL is not enabled on this server")
+        with self.lock:
+            return {"data": self.acl.login(
+                userid=body.get("userid", ""),
+                password=body.get("password", ""),
+                refresh_token=body.get("refresh_token", ""))}
 
     def _evict_idle(self):
         """Abort txns idle past the TTL (ref --abort_older_than,
@@ -72,12 +90,19 @@ class AlphaServer:
 
     # -- request handlers (transport-independent) --
 
-    def handle_query(self, body: dict | str, params: dict) -> dict:
+    def handle_query(self, body: dict | str, params: dict,
+                     token: str = "") -> dict:
         if isinstance(body, dict):
             q = body.get("query", "")
             variables = body.get("variables")
         else:
             q, variables = body, None
+        if self.acl is not None:
+            from dgraph_tpu.gql import parse as gql_parse
+            from dgraph_tpu.server.acl import query_predicates
+            with self.lock:
+                self.acl.authorize_query(
+                    token, query_predicates(gql_parse(q, variables)))
         ro_txn = None
         start_ts = int(params.get("startTs", 0))
         with self.lock:
@@ -88,10 +113,23 @@ class AlphaServer:
                                  if ro_txn is None else False)
 
     def handle_mutate(self, body: bytes, content_type: str,
-                      params: dict) -> dict:
+                      params: dict, token: str = "") -> dict:
         commit_now = params.get("commitNow", "false") == "true"
         start_ts = int(params.get("startTs", 0))
         mut, query, variables = _parse_mutation_body(body, content_type)
+        if self.acl is not None:
+            from dgraph_tpu.gql import parse as gql_parse
+            from dgraph_tpu.server.acl import (
+                nquad_predicates, query_predicates,
+            )
+            preds = nquad_predicates(mut.set_nquads, mut.del_nquads,
+                                     mut.set_json, mut.delete_json)
+            with self.lock:
+                self.acl.authorize_mutation(token, preds)
+                if query:
+                    self.acl.authorize_query(
+                        token,
+                        query_predicates(gql_parse(query, variables)))
         with self.lock:
             self._evict_idle()
             created = False
@@ -148,7 +186,7 @@ class AlphaServer:
                     "extensions": {"txn": {"start_ts": start_ts,
                                            "commit_ts": commit_ts}}}
 
-    def handle_alter(self, body: bytes) -> dict:
+    def handle_alter(self, body: bytes, token: str = "") -> dict:
         text = body.decode()
         drop_all = False
         drop_attr = ""
@@ -161,12 +199,22 @@ class AlphaServer:
                 schema = j.get("schema", "")
         except (json.JSONDecodeError, UnicodeDecodeError):
             pass
+        if self.acl is not None:
+            from dgraph_tpu.server.acl import schema_predicates
+            preds = [drop_attr] if drop_attr else (
+                schema_predicates(schema) if schema else [])
+            with self.lock:
+                self.acl.authorize_alter(token, preds,
+                                         drop=drop_all or bool(drop_attr))
         with self.lock:
             self.db.alter(schema_text=schema, drop_all=drop_all,
                           drop_attr=drop_attr)
         return {"code": "Success", "message": "Done"}
 
-    def handle_state(self) -> dict:
+    def handle_state(self, token: str = "") -> dict:
+        if self.acl is not None:
+            with self.lock:
+                self.acl.authorize(token)  # any valid login may inspect
         with self.lock:
             return self.db.state()
 
@@ -175,7 +223,14 @@ class AlphaServer:
                 "uptime_s": round(time.time() - self.started_at, 3),
                 "openTxns": len(self.txns)}
 
-    def handle_get_schema(self) -> dict:
+    def handle_get_schema(self, token: str = "") -> dict:
+        if self.acl is not None:
+            from dgraph_tpu.server.acl import GUARDIANS
+            with self.lock:
+                claims = self.acl.authorize(token)
+                if GUARDIANS not in claims.get("groups", []):
+                    raise AclError("/admin/schema needs guardian "
+                                   "membership")
         with self.lock:
             return {"schema": self.db.schema.describe_all()}
 
@@ -320,13 +375,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         path = urlparse(self.path).path
+        token = self.headers.get("X-Dgraph-AccessToken", "")
         try:
             if path == "/health":
                 self._send(200, self.alpha.handle_health())
             elif path == "/state":
-                self._send(200, self.alpha.handle_state())
+                self._send(200, self.alpha.handle_state(token))
             elif path == "/admin/schema":
-                self._send(200, {"data": self.alpha.handle_get_schema()})
+                self._send(200,
+                           {"data": self.alpha.handle_get_schema(token)})
             elif path == "/debug/prometheus_metrics":
                 from dgraph_tpu.utils.metrics import render_prometheus
 
@@ -338,6 +395,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(text)
             else:
                 self._error(f"no handler for GET {path}", 404)
+        except AclError as e:
+            self._error(str(e), 401)
         except Exception as e:  # noqa: BLE001 — surface as API error
             traceback.print_exc()
             self._error(str(e), 500)
@@ -347,6 +406,7 @@ class _Handler(BaseHTTPRequestHandler):
         path = u.path
         params = {k: v[-1] for k, v in parse_qs(u.query).items()}
         ctype = self.headers.get("Content-Type", "")
+        token = self.headers.get("X-Dgraph-AccessToken", "")
         try:
             body = self._body()
             if path == "/query":
@@ -354,18 +414,25 @@ class _Handler(BaseHTTPRequestHandler):
                     payload: Any = json.loads(body.decode())
                 else:
                     payload = body.decode()
-                self._send(200, self.alpha.handle_query(payload, params))
+                self._send(200, self.alpha.handle_query(payload, params,
+                                                        token))
             elif path == "/mutate":
-                self._send(200, self.alpha.handle_mutate(body, ctype, params))
+                self._send(200, self.alpha.handle_mutate(body, ctype,
+                                                         params, token))
             elif path == "/commit":
                 self._send(200, self.alpha.handle_commit(params))
             elif path in ("/alter", "/admin/schema"):
-                self._send(200, self.alpha.handle_alter(body))
+                self._send(200, self.alpha.handle_alter(body, token))
+            elif path == "/login":
+                self._send(200, self.alpha.handle_login(
+                    json.loads(body.decode()) if body else {}))
             else:
                 self._error(f"no handler for POST {path}", 404)
         except TxnAborted as e:
             self._error(f"Transaction has been aborted. Please retry: {e}",
                         409)
+        except AclError as e:
+            self._error(str(e), 401)
         except (ValueError, KeyError) as e:
             self._error(str(e), 400)
         except Exception as e:  # noqa: BLE001
@@ -374,11 +441,12 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def serve(db: Optional[GraphDB] = None, host: str = "127.0.0.1",
-          port: int = 8080, block: bool = True
+          port: int = 8080, block: bool = True,
+          acl_secret: Optional[bytes] = None
           ) -> tuple[ThreadingHTTPServer, AlphaServer]:
     """Start the Alpha HTTP server. With block=False, runs in a daemon
     thread and returns (httpd, alpha) for tests/embedding."""
-    alpha = AlphaServer(db)
+    alpha = AlphaServer(db, acl_secret=acl_secret)
     handler = type("BoundHandler", (_Handler,), {"alpha": alpha})
     httpd = ThreadingHTTPServer((host, port), handler)
     if block:

@@ -91,11 +91,15 @@ class _PyWal:
 
 
 class Wal:
-    """Record log for engine commits; native-backed when available."""
+    """Record log for engine commits; native-backed when available.
+    With `key`, every record blob is AES-GCM sealed before framing
+    (encryption at rest, storage/enc.py; ref ee/enc)."""
 
-    def __init__(self, path: str, sync: bool = False):
+    def __init__(self, path: str, sync: bool = False,
+                 key: bytes | None = None):
         self.path = path
         self.sync = sync
+        self.key = key
         if native.available():
             self._w = native.NativeWal(path, sync)
             self.native = True
@@ -104,12 +108,14 @@ class Wal:
             self.native = False
 
     def append(self, record: Any):
-        self._w.append(pickle.dumps(record,
-                                    protocol=pickle.HIGHEST_PROTOCOL))
+        from dgraph_tpu.storage.enc import encrypt_blob
+        blob = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
+        self._w.append(encrypt_blob(blob, self.key))
 
     def replay(self) -> Iterator[Any]:
+        from dgraph_tpu.storage.enc import decrypt_blob
         for blob in self._w.replay():
-            yield pickle.loads(blob)
+            yield pickle.loads(decrypt_blob(blob, self.key))
 
     def truncate(self):
         """Reset after a snapshot has captured state (ref raft WAL

@@ -1,0 +1,139 @@
+"""ACL: login JWTs, graph-stored principals, per-predicate enforcement
+(ref edgraph/access_ee.go, ee/acl/acl.go, ee/acl/acl_test.go patterns)."""
+
+import json
+import time
+
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.server.acl import (
+    AclError, AclManager, GROOT, GUARDIANS, READ, WRITE, MODIFY,
+    jwt_decode, jwt_encode, nquad_predicates, query_predicates,
+    schema_predicates,
+)
+
+SECRET = b"0123456789abcdef0123456789abcdef"
+
+
+@pytest.fixture
+def mgr():
+    db = GraphDB(prefer_device=False)
+    m = AclManager(db, SECRET, cache_ttl=0.0)
+    db.alter("name: string @index(exact) .\nage: int .")
+    return m
+
+
+def test_jwt_roundtrip_and_tamper():
+    tok = jwt_encode({"userid": "u", "exp": time.time() + 60}, SECRET)
+    assert jwt_decode(tok, SECRET)["userid"] == "u"
+    with pytest.raises(AclError):
+        jwt_decode(tok + "x", SECRET)
+    with pytest.raises(AclError):
+        jwt_decode(tok, b"wrong-secret")
+    expired = jwt_encode({"userid": "u", "exp": time.time() - 1}, SECRET)
+    with pytest.raises(AclError):
+        jwt_decode(expired, SECRET)
+
+
+def test_groot_bootstrap_and_login(mgr):
+    toks = mgr.login(GROOT, "password")
+    claims = jwt_decode(toks["accessJwt"], SECRET)
+    assert claims["userid"] == GROOT
+    assert GUARDIANS in claims["groups"]
+    with pytest.raises(AclError):
+        mgr.login(GROOT, "wrong")
+    # refresh flow
+    toks2 = mgr.login(refresh_token=toks["refreshJwt"])
+    assert jwt_decode(toks2["accessJwt"], SECRET)["userid"] == GROOT
+
+
+def test_guardian_bypasses_everything(mgr):
+    tok = mgr.login(GROOT, "password")["accessJwt"]
+    mgr.authorize_query(tok, ["name", "age", "whatever"])
+    mgr.authorize_mutation(tok, ["name"])
+    mgr.authorize_alter(tok, ["name"], drop=True)
+
+
+def test_user_needs_explicit_perms(mgr):
+    mgr.add_user("alice", "secret123")
+    mgr.add_group("dev")
+    mgr.set_groups("alice", ["dev"])
+    tok = mgr.login("alice", "secret123")["accessJwt"]
+    with pytest.raises(AclError):
+        mgr.authorize_query(tok, ["name"])
+    mgr.chmod("dev", "name", READ)
+    mgr.authorize_query(tok, ["name"])          # read ok now
+    with pytest.raises(AclError):
+        mgr.authorize_mutation(tok, ["name"])   # no write bit
+    mgr.chmod("dev", "name", READ | WRITE)
+    mgr.authorize_mutation(tok, ["name"])
+    with pytest.raises(AclError):
+        mgr.authorize_alter(tok, ["name"])      # no modify bit
+    mgr.chmod("dev", "name", READ | WRITE | MODIFY)
+    mgr.authorize_alter(tok, ["name"])
+    with pytest.raises(AclError):
+        mgr.authorize_alter(tok, [], drop=True)  # drops are guardian-only
+
+
+def test_reserved_predicates_guardian_only(mgr):
+    mgr.add_user("bob", "hunter22")
+    tok = mgr.login("bob", "hunter22")["accessJwt"]
+    with pytest.raises(AclError):
+        mgr.authorize_query(tok, ["dgraph.password"])
+
+
+def test_predicate_walkers():
+    from dgraph_tpu.gql import parse
+    parsed = parse('{ q(func: eq(name, "x")) @filter(gt(age, 3)) '
+                   '{ name friend (orderasc: city) { age } } }')
+    assert query_predicates(parsed) == ["age", "city", "friend", "name"]
+    assert nquad_predicates('_:a <name> "x" .\n_:a <age> "4" .') == \
+        ["age", "name"]
+    assert schema_predicates("name: string @index(term) .\nage: int .") \
+        == ["age", "name"]
+
+
+def test_http_acl_flow():
+    from dgraph_tpu.server.http import AlphaServer
+    alpha = AlphaServer(GraphDB(prefer_device=False), acl_secret=SECRET)
+    login = alpha.handle_login({"userid": GROOT, "password": "password"})
+    tok = login["data"]["accessJwt"]
+    alpha.handle_alter(b"name: string @index(exact) .", token=tok)
+    alpha.handle_mutate(b'{ set { _:a <name> "Zed" . } }',
+                        "application/rdf", {"commitNow": "true"},
+                        token=tok)
+    out = alpha.handle_query('{ q(func: eq(name, "Zed")) { name } }', {},
+                             token=tok)
+    assert out["data"]["q"][0]["name"] == "Zed"
+    # anonymous requests bounce
+    with pytest.raises(AclError):
+        alpha.handle_query("{ q(func: has(name)) { name } }", {})
+    # non-guardian user without grants bounces, then passes after chmod
+    alpha.acl.add_user("eve", "pw12345")
+    etok = alpha.acl.login("eve", "pw12345")["accessJwt"]
+    with pytest.raises(AclError):
+        alpha.handle_query('{ q(func: has(name)) { name } }', {},
+                           token=etok)
+    alpha.acl.add_group("readers")
+    alpha.acl.set_groups("eve", ["readers"])
+    alpha.acl.chmod("readers", "name", READ)
+    etok = alpha.acl.login("eve", "pw12345")["accessJwt"]
+    out = alpha.handle_query('{ q(func: has(name)) { name } }', {},
+                             token=etok)
+    assert out["data"]["q"][0]["name"] == "Zed"
+
+
+def test_checkpwd_function():
+    db = GraphDB(prefer_device=False)
+    db.alter("pass: password .\nname: string @index(exact) .")
+    db.mutate(set_nquads='_:u <name> "u1" .\n_:u <pass> "topsecret" .')
+    r = db.query('{ q(func: eq(name, "u1")) '
+                 '@filter(checkpwd(pass, "topsecret")) { name } }')
+    assert r["data"]["q"]
+    r = db.query('{ q(func: eq(name, "u1")) '
+                 '@filter(checkpwd(pass, "nope")) { name } }')
+    assert not r["data"]["q"]
+    # stored value is a hash, not the plaintext
+    r = db.query('{ q(func: eq(name, "u1")) { pass } }')
+    assert "topsecret" not in json.dumps(r["data"])
