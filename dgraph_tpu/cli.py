@@ -99,7 +99,14 @@ def cmd_acl(args) -> int:
     from dgraph_tpu.engine.db import GraphDB
     from dgraph_tpu.server.acl import AclManager
 
-    db = GraphDB(wal_path=args.wal or None, prefer_device=False)
+    if not args.wal:
+        # without a WAL every change silently dies with the process
+        # (advisor finding) — refuse rather than print a false success
+        print("acl: --wal is required (changes must persist)",
+              file=sys.stderr)
+        return 2
+    db = GraphDB(wal_path=args.wal, prefer_device=False,
+                 enc_key=_enc_key(args))
     mgr = AclManager(db, secret=b"cli")
     op = args.acl_op
     if op == "useradd":
@@ -284,6 +291,7 @@ def main(argv=None) -> int:
                                         "groupdel", "usermod", "chmod",
                                         "info"])
     acl.add_argument("--wal", default="", help="store WAL path")
+    acl.add_argument("--encryption_key_file", default="")
     acl.add_argument("-a", "--user", default="")
     acl.add_argument("-g", "--group", default="")
     acl.add_argument("-p", "--password", default="")

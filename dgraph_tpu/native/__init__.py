@@ -201,6 +201,8 @@ class NativeWal:
         self._lib = lib
         self._h = lib.dgt_wal_open(path.encode(), 1 if sync else 0)
         if not self._h:
+            from dgraph_tpu.storage.wal import raise_if_legacy_wal
+            raise_if_legacy_wal(path)
             raise OSError(f"cannot open wal at {path}")
 
     def append(self, payload: bytes):

@@ -253,7 +253,16 @@ def make_sssp_bits(badj: BitAdjacency, max_iters: int,
                 d = de[b.in_nb]                          # [M, D]
                 w = b.weights if (weighted and b.weights is not None) \
                     else jnp.int32(1)
-                cand = jnp.where(d < INT32_INF, d + w, INT32_INF)
+                # d + w can exceed int32 (long weighted paths) and must
+                # saturate at INT32_INF, not wrap to a bogus negative
+                # distance (advisor finding). int64 is unavailable
+                # without jax_enable_x64, so test overflow before
+                # adding: safe iff w <= INT32_INF - d (both sides
+                # in-range int32 since 0 <= d < INT32_INF).
+                w_arr = jnp.broadcast_to(jnp.asarray(w, jnp.int32),
+                                         d.shape)
+                safe = (d < INT32_INF) & (w_arr <= INT32_INF - d)
+                cand = jnp.where(safe, d + w_arr, INT32_INF)
                 parts.append(jnp.min(cand, axis=1))
             if parts:
                 cand = jnp.concatenate(parts)

@@ -275,18 +275,23 @@ _:u <dgraph.user.group> _:g .
             raise AclError("not an access jwt")
         return claims
 
-    def authorize_query(self, token: str, predicates: list[str]):
+    def authorize_query(self, token: str, predicates: list[str],
+                        claims: dict | None = None):
         """Every queried predicate needs Read (ref access_ee.go
-        authorizeQuery)."""
-        claims = self.authorize(token)
+        authorizeQuery). Pass pre-decoded `claims` to skip a redundant
+        JWT verification."""
+        if claims is None:
+            claims = self.authorize(token)
         for p in predicates:
             base = p[1:] if p.startswith("~") else p
             if not self._allowed(claims, base, READ):
                 raise AclError(
                     f"unauthorized to query predicate {base!r}")
 
-    def authorize_mutation(self, token: str, predicates: list[str]):
-        claims = self.authorize(token)
+    def authorize_mutation(self, token: str, predicates: list[str],
+                           claims: dict | None = None):
+        if claims is None:
+            claims = self.authorize(token)
         for p in predicates:
             if not self._allowed(claims, p, WRITE):
                 raise AclError(

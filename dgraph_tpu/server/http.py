@@ -58,6 +58,9 @@ class AlphaServer:
         self.lock = threading.RLock()
         self.txns: dict[int, Txn] = {}
         self._touched: dict[int, float] = {}
+        # startTs -> userid that opened the txn (ACL mode only): /commit
+        # must not let one login commit/abort another login's txn
+        self._txn_owner: dict[int, str] = {}
         self.txn_ttl_s = txn_ttl_s
         self.started_at = time.time()
         # ACL enforcement turns on when a secret is configured
@@ -85,8 +88,23 @@ class AlphaServer:
             if now - t > self.txn_ttl_s:
                 txn = self.txns.pop(ts, None)
                 self._touched.pop(ts, None)
+                self._txn_owner.pop(ts, None)
                 if txn is not None:
                     self.db.discard(txn)
+
+    def _check_txn_owner(self, start_ts: int, claims: dict | None):
+        """ACL mode: only the login that opened a txn (or a guardian)
+        may touch it by startTs — they are guessable sequential ints
+        (advisor finding; ref access_ee.go). Caller holds the lock."""
+        if self.acl is None or claims is None:
+            return
+        from dgraph_tpu.server.acl import GUARDIANS, AclError
+        owner = self._txn_owner.get(start_ts)
+        if (owner is not None
+                and claims.get("userid", "") != owner
+                and GUARDIANS not in claims.get("groups", [])):
+            raise AclError(
+                f"txn at startTs={start_ts} belongs to another user")
 
     # -- request handlers (transport-independent) --
 
@@ -97,16 +115,20 @@ class AlphaServer:
             variables = body.get("variables")
         else:
             q, variables = body, None
+        claims = None
         if self.acl is not None:
             from dgraph_tpu.gql import parse as gql_parse
             from dgraph_tpu.server.acl import query_predicates
             with self.lock:
+                claims = self.acl.authorize(token)
                 self.acl.authorize_query(
-                    token, query_predicates(gql_parse(q, variables)))
+                    token, query_predicates(gql_parse(q, variables)),
+                    claims=claims)
         ro_txn = None
         start_ts = int(params.get("startTs", 0))
         with self.lock:
             if start_ts:
+                self._check_txn_owner(start_ts, claims)
                 ro_txn = self.txns.get(start_ts)
             be = params.get("be", "false") == "true"
             return self.db.query(q, variables, txn=ro_txn, best_effort=be
@@ -117,6 +139,7 @@ class AlphaServer:
         commit_now = params.get("commitNow", "false") == "true"
         start_ts = int(params.get("startTs", 0))
         mut, query, variables = _parse_mutation_body(body, content_type)
+        owner = None
         if self.acl is not None:
             from dgraph_tpu.gql import parse as gql_parse
             from dgraph_tpu.server.acl import (
@@ -125,11 +148,19 @@ class AlphaServer:
             preds = nquad_predicates(mut.set_nquads, mut.del_nquads,
                                      mut.set_json, mut.delete_json)
             with self.lock:
-                self.acl.authorize_mutation(token, preds)
+                claims = self.acl.authorize(token)
+                owner = claims.get("userid", "")
+                self.acl.authorize_mutation(token, preds, claims=claims)
                 if query:
                     self.acl.authorize_query(
                         token,
-                        query_predicates(gql_parse(query, variables)))
+                        query_predicates(gql_parse(query, variables)),
+                        claims=claims)
+                if start_ts:
+                    # attaching to an existing txn by startTs needs the
+                    # same ownership check as /commit — startTs values
+                    # are guessable sequential ints
+                    self._check_txn_owner(start_ts, claims)
         with self.lock:
             self._evict_idle()
             created = False
@@ -151,12 +182,14 @@ class AlphaServer:
                 # reference marks the txn context aborted)
                 self.txns.pop(txn.start_ts, None)
                 self._touched.pop(txn.start_ts, None)
+                self._txn_owner.pop(txn.start_ts, None)
                 self.db.discard(txn)
                 raise
             ext_txn = {"start_ts": txn.start_ts}
             if commit_now:
                 self.txns.pop(txn.start_ts, None)
                 self._touched.pop(txn.start_ts, None)
+                self._txn_owner.pop(txn.start_ts, None)
                 if not txn.done:  # all conds failed, discard like mutate()
                     self.db.discard(txn)
             else:
@@ -165,15 +198,20 @@ class AlphaServer:
                     raise RuntimeError("too many open transactions")
                 self.txns[txn.start_ts] = txn
                 self._touched[txn.start_ts] = time.time()
+                if self.acl is not None and owner is not None:
+                    self._txn_owner.setdefault(txn.start_ts, owner)
             out.setdefault("extensions", {})["txn"] = ext_txn
             return out
 
-    def handle_commit(self, params: dict) -> dict:
+    def handle_commit(self, params: dict, token: str = "") -> dict:
         start_ts = int(params.get("startTs", 0))
         abort = params.get("abort", "false") == "true"
         with self.lock:
+            if self.acl is not None:
+                self._check_txn_owner(start_ts, self.acl.authorize(token))
             txn = self.txns.pop(start_ts, None)
             self._touched.pop(start_ts, None)
+            self._txn_owner.pop(start_ts, None)
             if txn is None:
                 raise KeyError(f"no open transaction at startTs={start_ts}")
             if abort:
@@ -420,7 +458,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, self.alpha.handle_mutate(body, ctype,
                                                          params, token))
             elif path == "/commit":
-                self._send(200, self.alpha.handle_commit(params))
+                self._send(200, self.alpha.handle_commit(params, token))
             elif path in ("/alter", "/admin/schema"):
                 self._send(200, self.alpha.handle_alter(body, token))
             elif path == "/login":

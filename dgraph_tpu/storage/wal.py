@@ -24,6 +24,23 @@ from dgraph_tpu import native
 # on the same file, so a store created with the native lib still opens
 # if the toolchain later disappears, and vice versa.
 _MAGIC = b"DGTWAL2\x00"
+_LEGACY_MAGIC = b"DGTWAL1\x00"
+
+
+def raise_if_legacy_wal(path: str) -> None:
+    """Pre-CRC DGTWAL1 files must fail with a recovery path, not a bare
+    'bad magic' / bricked store (advisor finding). Shared by both WAL
+    backends so the format knowledge lives in one place."""
+    try:
+        with open(path, "rb") as f:
+            legacy = f.read(len(_LEGACY_MAGIC)) == _LEGACY_MAGIC
+    except OSError:
+        return
+    if legacy:
+        raise IOError(
+            f"{path} uses the legacy DGTWAL1 format; export/snapshot "
+            "it with a pre-DGTWAL2 build, then restore into a fresh "
+            "store")
 
 
 class _PyWal:
@@ -52,6 +69,8 @@ class _PyWal:
         records = []
         with open(self.path, "rb") as f:
             magic = f.read(len(_MAGIC))
+            if magic == _LEGACY_MAGIC:
+                raise_if_legacy_wal(self.path)
             if magic != _MAGIC:
                 raise IOError(f"bad WAL magic in {self.path}")
             good = f.tell()

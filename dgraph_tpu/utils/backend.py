@@ -1,0 +1,87 @@
+"""JAX backend selection helpers.
+
+The ambient environment pre-imports jax via sitecustomize and pins
+JAX_PLATFORMS=axon (a single-chip TPU tunnel). That has two consequences
+for any code that wants the virtual-CPU path (tests, the multichip
+dryrun, CI):
+
+1. Setting the JAX_PLATFORMS env var after interpreter start does
+   nothing — jax.config latched the ambient value at import time. The
+   config must be updated in-process.
+2. Even under jax_platforms=cpu, jax's backends() still *initializes*
+   every registered plugin factory, and the axon factory blocks forever
+   whenever the TPU tunnel is busy or wedged (root cause of the round-1
+   MULTICHIP rc=124 hang at parallel/mesh.py jax.devices()). The
+   factories must be deregistered outright.
+
+force_cpu_backend() performs both steps plus the virtual device-count
+flag, and is safe to call multiple times. It must run BEFORE the first
+backend initialization (first jax.devices()/jit execution); calling it
+after is a no-op for the already-initialized process and raises only if
+strict=True.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _backends_initialized() -> bool:
+    try:
+        from jax._src import xla_bridge as _xb
+        return bool(_xb._backends)
+    except Exception:
+        return False
+
+
+def force_cpu_backend(n_devices: int | None = None,
+                      strict: bool = False) -> None:
+    """Pin this process to the XLA CPU backend with `n_devices` virtual
+    devices. Must be called before jax initializes any backend."""
+    if _backends_initialized():
+        if strict:
+            raise RuntimeError(
+                "force_cpu_backend called after jax backend init; "
+                "the platform can no longer be changed")
+        return
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{n_devices}").strip()
+
+    import jax
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    _xb._backend_factories.pop("tpu", None)
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices is not None:
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except Exception:
+            pass  # older jax: XLA_FLAGS above covers it
+
+
+def probe_backend(retries: int = 3, backoff_s: float = 5.0):
+    """Initialize the default backend with retry/backoff.
+
+    Returns the device list on success; raises the last error after
+    exhausting retries. Used by bench.py so a transiently-wedged TPU
+    tunnel doesn't waste the whole benchmark run (round-1 BENCH rc=1).
+    """
+    import time
+
+    import jax
+
+    last = None
+    for attempt in range(retries):
+        try:
+            return jax.devices()
+        except Exception as e:  # backend init failure is runtime-typed
+            last = e
+            if attempt < retries - 1:
+                time.sleep(backoff_s * (2 ** attempt))
+    raise last

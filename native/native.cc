@@ -198,16 +198,22 @@ int kv_load_snapshot(Kv* kv, const std::string& path) {
   uint64_t count;
   memcpy(&count, &data[8], 8);
   size_t off = 16;
+  // every read below must stay inside [16, size-4); a CRC collision or
+  // crafted file must not cause an out-of-bounds read
+  const size_t end = (size_t)size - 4;
   for (uint64_t i = 0; i < count; i++) {
-    if (off + 4 > (size_t)size - 4) return -2;
+    if (off + 4 > end) return -2;
     uint32_t klen;
     memcpy(&klen, &data[off], 4);
     off += 4;
+    if (klen > end - off) return -2;
     std::string key((const char*)&data[off], klen);
     off += klen;
+    if (off + 4 > end) return -2;
     uint32_t vlen;
     memcpy(&vlen, &data[off], 4);
     off += 4;
+    if (vlen > end - off) return -2;
     kv->m[std::move(key)] = std::string((const char*)&data[off], vlen);
     off += vlen;
   }

@@ -137,3 +137,50 @@ def test_checkpwd_function():
     # stored value is a hash, not the plaintext
     r = db.query('{ q(func: eq(name, "u1")) { pass } }')
     assert "topsecret" not in json.dumps(r["data"])
+
+
+def test_http_commit_requires_token_and_ownership():
+    """/commit under ACL: anonymous and cross-user completion bounce
+    (advisor finding: guessable startTs let anyone commit/abort)."""
+    from dgraph_tpu.server.http import AlphaServer
+    alpha = AlphaServer(GraphDB(prefer_device=False), acl_secret=SECRET)
+    gtok = alpha.handle_login(
+        {"userid": GROOT, "password": "password"})["data"]["accessJwt"]
+    alpha.handle_alter(b"name: string @index(exact) .", token=gtok)
+    alpha.acl.add_user("alice", "pw12345")
+    alpha.acl.add_user("bob", "pw12345")
+    alpha.acl.add_group("writers")
+    alpha.acl.set_groups("alice", ["writers"])
+    alpha.acl.set_groups("bob", ["writers"])
+    alpha.acl.chmod("writers", "name", READ | WRITE)
+    atok = alpha.acl.login("alice", "pw12345")["accessJwt"]
+    btok = alpha.acl.login("bob", "pw12345")["accessJwt"]
+
+    out = alpha.handle_mutate(b'{ set { _:a <name> "Al" . } }',
+                              "application/rdf", {}, token=atok)
+    ts = out["extensions"]["txn"]["start_ts"]
+    # anonymous /commit bounces
+    with pytest.raises(AclError):
+        alpha.handle_commit({"startTs": str(ts)})
+    # another user cannot attach a mutation or query to alice's txn
+    with pytest.raises(AclError):
+        alpha.handle_mutate(b'{ set { _:x <name> "Evil" . } }',
+                            "application/rdf", {"startTs": str(ts)},
+                            token=btok)
+    with pytest.raises(AclError):
+        alpha.handle_query('{ q(func: has(name)) { name } }',
+                           {"startTs": str(ts)}, token=btok)
+    # another authenticated user cannot abort alice's txn
+    with pytest.raises(AclError):
+        alpha.handle_commit({"startTs": str(ts), "abort": "true"},
+                            token=btok)
+    # the txn is still open and alice can commit it
+    res = alpha.handle_commit({"startTs": str(ts)}, token=atok)
+    assert "commit_ts" in res["extensions"]["txn"]
+    # guardians may complete anyone's txn
+    out = alpha.handle_mutate(b'{ set { _:b <name> "Al2" . } }',
+                              "application/rdf", {}, token=atok)
+    ts2 = out["extensions"]["txn"]["start_ts"]
+    res = alpha.handle_commit({"startTs": str(ts2), "abort": "true"},
+                              token=gtok)
+    assert res["extensions"]["txn"]["aborted"] is True

@@ -114,3 +114,22 @@ def test_empty():
     levels = bfs_bits_reach(badj, np.asarray([1], np.uint32), 2)
     assert all(len(lv) == 0 for lv in levels)
     assert sssp_dist(badj, np.asarray([1], np.uint32), 2) == {}
+
+
+def test_sssp_weighted_no_int32_overflow():
+    """d + w near INT32_MAX must saturate, not wrap negative and
+    propagate bogus shortest distances (advisor finding)."""
+    big = 1_000_000_000
+    edges = {1: np.asarray([2], np.uint32),
+             2: np.asarray([3], np.uint32),
+             3: np.asarray([4], np.uint32)}
+    weights = {1: np.asarray([big], np.int32),
+               2: np.asarray([big], np.int32),
+               3: np.asarray([big], np.int32)}
+    badj = build_bitadjacency(edges, weights=weights)
+    got = sssp_dist(badj, np.asarray([1], np.uint32), 6, weighted=True)
+    # 3e9 > INT32_MAX: node 4 must be absent (saturated to
+    # "unreachable"), and nothing may go negative via wraparound
+    assert all(v >= 0 for v in got.values())
+    assert got[2] == big and got[3] == 2 * big
+    assert 4 not in got

@@ -33,3 +33,21 @@ def test_debug_inspector(tmp_path, capsys):
     assert main(["debug", "--wal", wal, "histogram"]) == 0
     out = capsys.readouterr().out
     assert "dname\t1" in out
+
+
+def test_acl_cli_requires_wal(tmp_path, capsys):
+    """`acl` without --wal must refuse instead of silently discarding
+    changes in an in-memory store (advisor finding)."""
+    from dgraph_tpu.cli import main
+    rc = main(["acl", "useradd", "-a", "u1", "-p", "pw12345",
+               "--wal", ""])
+    assert rc == 2
+    wal = str(tmp_path / "acl.wal")
+    rc = main(["acl", "useradd", "-a", "u1", "-p", "pw12345",
+               "--wal", wal])
+    assert rc == 0
+    # the user survives a reopen
+    from dgraph_tpu.engine.db import GraphDB
+    db = GraphDB(wal_path=wal, prefer_device=False)
+    res = db.query('{ q(func: eq(dgraph.xid, "u1")) { dgraph.xid } }')
+    assert res["data"]["q"]
