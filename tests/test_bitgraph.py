@@ -133,3 +133,42 @@ def test_sssp_weighted_no_int32_overflow():
     assert all(v >= 0 for v in got.values())
     assert got[2] == big and got[3] == 2 * big
     assert 4 not in got
+
+
+def test_batched_bfs_matches_single():
+    """32*W-query packed BFS must agree with the single-query kernel
+    per query and per level."""
+    from dgraph_tpu.ops.bitgraph import bfs_bits_reach_batched
+    rng = np.random.default_rng(7)
+    edges = {}
+    for u in range(1, 400):
+        dst = np.unique(rng.integers(1, 400, rng.integers(1, 40)))
+        dst = dst[dst != u].astype(np.uint32)
+        if len(dst):
+            edges[u] = dst
+    badj = build_bitadjacency(edges)
+    seeds = [np.sort(rng.choice(np.arange(1, 400, dtype=np.uint32),
+                                3, replace=False)) for _ in range(40)]
+    got = bfs_bits_reach_batched(badj, seeds, depth=3)
+    for q in range(40):
+        want = bfs_bits_reach(badj, seeds[q], 3)
+        for lvl in range(3):
+            assert np.array_equal(got[q][lvl], want[lvl]), (q, lvl)
+
+
+def test_batched_counts_on_device():
+    from dgraph_tpu.ops.bitgraph import (
+        make_bfs_bits_batched, make_frontier_counts_batched,
+        uids_to_bits_batched,
+    )
+    import jax.numpy as jnp
+    edges = {1: np.asarray([2, 3], np.uint32),
+             2: np.asarray([4], np.uint32)}
+    badj = build_bitadjacency(edges)
+    seeds = [np.asarray([1], np.uint32), np.asarray([2], np.uint32),
+             np.asarray([9], np.uint32)]  # uid 9 unknown -> empty
+    packed = uids_to_bits_batched(badj, seeds)
+    fn = make_bfs_bits_batched(badj, depth=1)
+    (lvl1,) = fn(jnp.asarray(packed))
+    counts = make_frontier_counts_batched(3)(lvl1)
+    assert counts.tolist() == [2, 1, 0]
