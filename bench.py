@@ -134,7 +134,7 @@ def main():
     # goroutine in the reference ----
     base_times = []
     base_counts = []
-    for i in range(BASE_RUNS):
+    for i in range(min(BASE_RUNS, batch)):
         t = time.perf_counter()
         c = numpy_bfs(uniq_src, indptr, dst,
                       seed_sets[i].astype(np.uint64), DEPTH)
@@ -188,8 +188,9 @@ def main():
     # parity: device query i == CPU baseline query i (final-level count).
     # queries 0-3 live in word 0 — slice on device so only ~1 MiB ships
     # to host, not the full bitmap
-    got = bits_to_uids_batched(badj, np.asarray(last[:, :1]), 4)
-    for i in range(4):
+    n_par = min(4, batch)
+    got = bits_to_uids_batched(badj, np.asarray(last[:, :1]), n_par)
+    for i in range(n_par):
         if len(got[i]) != base_counts[i]:
             sys.stderr.write(f"WARNING: query {i} device count "
                              f"{len(got[i])} != cpu {base_counts[i]}\n")

@@ -30,27 +30,17 @@ _MAX_U32 = 0xFFFFFFFE  # SENTINEL reserved
 
 
 def device_adjacency(db, tab, read_ts: int) -> Optional[DeviceAdjacency]:
-    if tab.schema.value_type.name != "UID":
+    if not _clean_resident(db, tab, read_ts):
         return None
-    if tab.dirty():
-        wm = db.coordinator.min_active_ts()
-        if wm >= tab.max_commit_ts:
-            tab.rollup(wm)
-        if tab.dirty():
-            return None  # live overlay -> host path
-    if read_ts < tab.base_ts:
-        return None  # snapshot is newer than this read
     adj = getattr(tab, "_device_adj", None)
     if adj is not None and tab._device_ts == tab.base_ts:
         return adj
     n_edges = sum(len(v) for v in tab.edges.values())
     if n_edges < db.device_min_edges:
         return None
-    edges32 = {}
-    for src, dst in tab.edges.items():
-        if src > _MAX_U32 or (len(dst) and int(dst[-1]) > _MAX_U32):
-            return None
-        edges32[int(src)] = dst.astype(np.uint32)
+    edges32 = _edges32(tab.edges)
+    if edges32 is None:
+        return None
     adj = build_adjacency(edges32)
     tab._device_adj = adj
     tab._device_ts = tab.base_ts
@@ -58,41 +48,100 @@ def device_adjacency(db, tab, read_ts: int) -> Optional[DeviceAdjacency]:
     return adj
 
 
-def device_bitadjacency(db, tab, read_ts: int):
-    """Bitmap reverse adjacency (ops/bitgraph) for analytical BFS/SSSP.
-    Same residency policy as device_adjacency: clean rolled-up tablets
-    only; cached per base_ts."""
-    if tab.schema.value_type.name != "UID":
-        return None
+def _clean_resident(db, tab, read_ts: int, want_uid: bool = True) -> bool:
+    """Shared residency policy: rolled-up committed state only."""
+    if (tab.schema.value_type.name == "UID") != want_uid:
+        return False
     if tab.dirty():
         wm = db.coordinator.min_active_ts()
         if wm >= tab.max_commit_ts:
             tab.rollup(wm)
         if tab.dirty():
+            return False  # live overlay -> host path
+    return read_ts >= tab.base_ts
+
+
+def _edges32(edge_dict) -> Optional[dict]:
+    edges32 = {}
+    for src, dst in edge_dict.items():
+        if src > _MAX_U32 or (len(dst) and int(dst[-1]) > _MAX_U32):
             return None
-    if read_ts < tab.base_ts:
+        edges32[int(src)] = dst.astype(np.uint32)
+    return edges32
+
+
+def _transposed_edges(tab) -> dict:
+    """{dst -> sorted src} for a tablet, regardless of @reverse (the
+    schema directive gates *queryable* reverse edges; SSSP path
+    reconstruction needs the transpose either way)."""
+    if tab.schema.reverse and tab.reverse:
+        return tab.reverse
+    srcs = []
+    dsts = []
+    for s, dl in tab.edges.items():
+        srcs.append(np.full(len(dl), s, np.uint64))
+        dsts.append(dl)
+    if not srcs:
+        return {}
+    src_all = np.concatenate(srcs)
+    dst_all = np.concatenate(dsts)
+    order = np.argsort(dst_all, kind="stable")
+    src_all, dst_all = src_all[order], dst_all[order]
+    uniq, starts = np.unique(dst_all, return_index=True)
+    bounds = np.append(starts, len(dst_all))
+    return {int(d): np.sort(src_all[bounds[i]: bounds[i + 1]])
+            for i, d in enumerate(uniq)}
+
+
+def device_radjacency(db, tab, read_ts: int) -> Optional[DeviceAdjacency]:
+    """Reverse-direction expansion tiles (~pred traversal): a
+    DeviceAdjacency over the tablet's reverse map. Requires @reverse
+    (the executor rejects ~pred queries otherwise)."""
+    if not tab.schema.reverse or not _clean_resident(db, tab, read_ts):
         return None
-    badj = getattr(tab, "_device_badj", None)
-    if badj is not None and getattr(tab, "_device_badj_ts", -1) == tab.base_ts:
+    adj = getattr(tab, "_device_radj", None)
+    if adj is not None and getattr(tab, "_device_radj_ts", -1) == tab.base_ts:
+        return adj
+    n_edges = sum(len(v) for v in tab.reverse.values())
+    if n_edges < db.device_min_edges:
+        return None
+    edges32 = _edges32(tab.reverse)
+    if edges32 is None:
+        return None
+    adj = build_adjacency(edges32)
+    tab._device_radj = adj
+    tab._device_radj_ts = tab.base_ts
+    return adj
+
+
+def device_bitadjacency(db, tab, read_ts: int, transpose: bool = False):
+    """Bitmap adjacency (ops/bitgraph) for analytical BFS/SSSP.
+    Same residency policy as device_adjacency: clean rolled-up tablets
+    only; cached per base_ts. With transpose=True the expansion walks
+    edges dst->src (used for distance-to-target in shortest paths)."""
+    if not _clean_resident(db, tab, read_ts):
+        return None
+    attr = "_device_badj_t" if transpose else "_device_badj"
+    badj = getattr(tab, attr, None)
+    if badj is not None and getattr(tab, attr + "_ts", -1) == tab.base_ts:
         return badj
     n_edges = sum(len(v) for v in tab.edges.values())
     if n_edges < db.device_min_edges:
         return None
-    edges32 = {}
-    for src, dst in tab.edges.items():
-        if src > _MAX_U32 or (len(dst) and int(dst[-1]) > _MAX_U32):
-            return None
-        edges32[int(src)] = dst.astype(np.uint32)
+    edges32 = _edges32(_transposed_edges(tab) if transpose else tab.edges)
+    if edges32 is None:
+        return None
     from dgraph_tpu.ops.bitgraph import build_bitadjacency
     badj = build_bitadjacency(edges32)
-    tab._device_badj = badj
-    tab._device_badj_ts = tab.base_ts
+    setattr(tab, attr, badj)
+    setattr(tab, attr + "_ts", tab.base_ts)
     return badj
 
 
 def device_values(db, tab, read_ts: int):
-    """Sortable value view for order-by / inequality offload."""
-    if tab.dirty() or read_ts < tab.base_ts:
+    """Sortable value view for order-by / inequality offload (scalar
+    tablets; same rollup-then-check policy as the adjacency tiles)."""
+    if not _clean_resident(db, tab, read_ts, want_uid=False):
         return None
     dv = getattr(tab, "_device_values", None)
     if dv is not None and getattr(tab, "_device_values_ts", -1) == tab.base_ts:

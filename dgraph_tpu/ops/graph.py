@@ -195,15 +195,24 @@ def has_uids(adj: DeviceAdjacency) -> jax.Array:
 # -- value postings ----------------------------------------------------------
 
 
+# int64 is unavailable on device without jax_enable_x64 (jnp silently
+# downcasts to int32), so the device never sees raw sort keys: it holds
+# order-preserving int32 RANKS into the host-side sorted unique-key
+# table. Ordering and range selection are exact; raw-key bounds resolve
+# to rank bounds with one host searchsorted.
+RANK_MISSING = np.int32(2**31 - 1)
+
+
 @dataclass
 class DeviceValues:
-    """Scalar predicate's sortable view: aligned (uid -> key) plus the
-    key-sorted permutation for range scans."""
+    """Scalar predicate's sortable view: aligned (uid -> key rank) plus
+    the rank-sorted permutation for range scans."""
 
     uids: jax.Array          # [N] uint32 sorted, SENTINEL padded
-    keys: jax.Array          # [N] int64, aligned to uids (pad = INT64_MAX)
-    keys_sorted: jax.Array   # [N] int64 sorted
-    uids_by_key: jax.Array   # [N] uint32 aligned to keys_sorted
+    ranks: jax.Array         # [N] int32 aligned (pad = RANK_MISSING)
+    ranks_sorted: jax.Array  # [N] int32 sorted
+    uids_by_key: jax.Array   # [N] uint32 aligned to ranks_sorted
+    host_keys: np.ndarray    # [U] int64 sorted unique raw keys (host)
 
 
 def build_values(pairs: dict[int, int]) -> DeviceValues:
@@ -211,46 +220,50 @@ def build_values(pairs: dict[int, int]) -> DeviceValues:
     n = len(pairs)
     n_pad = pad_to(n)
     uids = np.full(n_pad, SENTINEL, np.uint32)
-    keys = np.full(n_pad, INT64_MAX, np.int64)
+    ranks = np.full(n_pad, RANK_MISSING, np.int32)
+    host_keys = np.empty(0, np.int64)
     if n:
         u = np.fromiter(pairs.keys(), dtype=np.uint32, count=n)
         k = np.fromiter(pairs.values(), dtype=np.int64, count=n)
         order = np.argsort(u, kind="stable")
+        host_keys, inv = np.unique(k, return_inverse=True)
         uids[:n] = u[order]
-        keys[:n] = k[order]
-    by_key = np.lexsort((uids, keys))
-    return DeviceValues(jnp.asarray(uids), jnp.asarray(keys),
-                        jnp.asarray(keys[by_key]),
-                        jnp.asarray(uids[by_key]))
+        ranks[:n] = inv[order].astype(np.int32)
+    by_key = np.lexsort((uids, ranks))
+    return DeviceValues(jnp.asarray(uids), jnp.asarray(ranks),
+                        jnp.asarray(ranks[by_key]),
+                        jnp.asarray(uids[by_key]), host_keys)
 
 
 def key_gather(dv: DeviceValues, uids: jax.Array,
-               missing: int = int(INT64_MAX)) -> jax.Array:
-    """Sort keys for candidate uids; `missing` for absent ones."""
+               missing: int = int(RANK_MISSING)) -> jax.Array:
+    """Sort-key ranks for candidate uids; `missing` for absent ones."""
     idx = jnp.clip(jnp.searchsorted(dv.uids, uids), 0, dv.uids.shape[0] - 1)
     hit = (dv.uids[idx] == uids) & (uids != SENTINEL)
-    return jnp.where(hit, dv.keys[idx], jnp.int64(missing))
+    return jnp.where(hit, dv.ranks[idx], jnp.int32(missing))
 
 
 def range_select(dv: DeviceValues, lo, hi,
                  lo_open: bool = False, hi_open: bool = False) -> jax.Array:
-    """UIDs whose key is in [lo, hi] (open per flags) — le/lt/ge/gt/between
-    root functions in one searchsorted + mask + sort.
+    """UIDs whose raw key is in [lo, hi] (open per flags) — le/lt/ge/gt/
+    between root functions in one mask + compact. Raw int64 bounds
+    become rank bounds on host.
     Ref: worker/tokens.go:113 getInequalityTokens bucket walk."""
-    lo = jnp.int64(lo)
-    hi = jnp.int64(hi)
-    ks = dv.keys_sorted
-    in_range = (ks > lo if lo_open else ks >= lo) & \
-               (ks < hi if hi_open else ks <= hi)
+    lo_rank = np.searchsorted(dv.host_keys, np.int64(lo),
+                              side="right" if lo_open else "left")
+    hi_rank = np.searchsorted(dv.host_keys, np.int64(hi),
+                              side="left" if hi_open else "right")
+    rs = dv.ranks_sorted
+    in_range = (rs >= jnp.int32(lo_rank)) & (rs < jnp.int32(hi_rank))
     valid = dv.uids_by_key != SENTINEL
     return compact(jnp.where(in_range & valid, dv.uids_by_key, SENTINEL))
 
 
 @partial(jax.jit, static_argnames=("k", "desc"))
-def order_topk(dv_uids, dv_keys, cand: jax.Array, k: int,
+def order_topk(dv_uids, dv_ranks, cand: jax.Array, k: int,
                desc: bool = False):
-    """First-k of `cand` ordered by value key (uid tiebreak), returning
-    (uids, valid_count). Keys come from key_gather'd arrays.
+    """First-k of `cand` ordered by value rank (uid tiebreak), returning
+    (uids, valid_count). Ranks come from a DeviceValues view.
 
     Ref: worker/sort.go:412 processSort — the index-bucket walk +
     intersect per bucket becomes gather + one argsort; lax.sort's
@@ -258,9 +271,9 @@ def order_topk(dv_uids, dv_keys, cand: jax.Array, k: int,
     """
     idx = jnp.clip(jnp.searchsorted(dv_uids, cand), 0, dv_uids.shape[0] - 1)
     hit = (dv_uids[idx] == cand) & (cand != SENTINEL)
-    keys = jnp.where(hit, dv_keys[idx], INT64_MAX)
+    ranks = jnp.where(hit, dv_ranks[idx], RANK_MISSING)
     if desc:
-        keys = jnp.where(hit, -keys, INT64_MAX)
-    # sort (key, uid) pairs; absent uids (INT64_MAX) sink to the end
-    skeys, suids = jax.lax.sort((keys, cand), num_keys=2)
+        ranks = jnp.where(hit, -ranks, RANK_MISSING)
+    # sort (rank, uid) pairs; absent uids (RANK_MISSING) sink to the end
+    sranks, suids = jax.lax.sort((ranks, cand), num_keys=2)
     return suids[:k], jnp.minimum(jnp.sum(hit), k)

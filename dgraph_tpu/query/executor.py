@@ -37,6 +37,7 @@ from dgraph_tpu.models.types import (
 )
 from dgraph_tpu.storage.tablet import Tablet
 from dgraph_tpu.utils.keys import token_bytes
+from dgraph_tpu.utils.metrics import inc_counter
 
 _EMPTY = np.empty(0, dtype=np.uint64)
 
@@ -349,6 +350,11 @@ class Executor:
         # strings compare beyond the 8-byte key prefix: exact host compare
         if tid in (TypeID.STRING, TypeID.DEFAULT):
             return self._ineq_scan_strings(tab, fn, candidates)
+        if self.db.prefer_device:
+            dev = self._device_range(tab, lo, hi, lo_open, hi_open)
+            if dev is not None:
+                return dev if candidates is None \
+                    else _intersect(candidates, dev)
         pairs = self._sortkeys_for(tab)
         if not pairs:
             return _EMPTY
@@ -358,6 +364,21 @@ class Executor:
             (keys < hi if hi_open else keys <= hi)
         out = np.sort(uids[m])
         return out if candidates is None else _intersect(candidates, out)
+
+    def _device_range(self, tab, lo, hi, lo_open, hi_open
+                      ) -> Optional[np.ndarray]:
+        """le/lt/ge/gt/between root scan as one device mask + compact
+        (ops/graph.range_select; ref worker/tokens.go:113)."""
+        from dgraph_tpu.engine.device_cache import device_values
+        from dgraph_tpu.ops.graph import range_select
+        from dgraph_tpu.ops.uidvec import to_numpy
+
+        dv = device_values(self.db, tab, self.read_ts)
+        if dv is None:
+            return None
+        inc_counter("query_device_range_total")
+        return to_numpy(range_select(dv, lo, hi, lo_open, hi_open)
+                        ).astype(np.uint64)
 
     def _ineq_scan_strings(self, tab, fn, candidates) -> np.ndarray:
         want = str(fn.args[0].value)
@@ -700,19 +721,24 @@ class Executor:
     def _expand_level(self, tab: Tablet, src: np.ndarray,
                       reverse: bool) -> np.ndarray:
         dev = None
-        if self.db.prefer_device and not reverse:
-            dev = self._device_expand(tab, src)
+        if self.db.prefer_device:
+            dev = self._device_expand(tab, src, reverse)
         if dev is not None:
             return dev
         return tab.expand_frontier(src, self.read_ts, reverse)
 
-    def _device_expand(self, tab: Tablet, src: np.ndarray
-                       ) -> Optional[np.ndarray]:
-        from dgraph_tpu.engine.device_cache import device_adjacency, expand_np
+    def _device_expand(self, tab: Tablet, src: np.ndarray,
+                       reverse: bool = False) -> Optional[np.ndarray]:
+        from dgraph_tpu.engine.device_cache import (
+            device_adjacency, device_radjacency, expand_np,
+        )
 
-        adj = device_adjacency(self.db, tab, self.read_ts)
+        adj = (device_radjacency if reverse else device_adjacency)(
+            self.db, tab, self.read_ts)
         if adj is None or len(src) == 0:
             return None
+        inc_counter("query_device_expand_total",
+                    labels={"dir": "rev" if reverse else "fwd"})
         return expand_np(adj, src)
 
     # ------------------------------------------------------------------
@@ -800,6 +826,10 @@ class Executor:
         tab = self._tablet(attr)
         if tab is None:
             return out
+        if self.db.prefer_device and not lang and len(uids) >= 64:
+            dev = self._device_order_keys(tab, uids)
+            if dev is not None:
+                return dev
         for u in uids.tolist():
             ps = tab.get_postings(u, self.read_ts)
             sel = self._select_posting(ps, [lang] if lang else [])
@@ -808,6 +838,29 @@ class Executor:
                     out[u] = (0, sort_key(self._typed(tab, sel)))
                 except ValueError:
                     pass
+        return out
+
+    def _device_order_keys(self, tab: Tablet, uids) -> Optional[dict]:
+        """Sort keys for a uid batch in ONE device gather instead of a
+        get_postings loop (SURVEY §2a item 4; ref worker/sort.go:177).
+        Parity: device_values indexes each uid's first untagged posting,
+        exactly what _select_posting(ps, []) picks on the host path."""
+        from dgraph_tpu.engine.device_cache import device_values
+        from dgraph_tpu.ops.graph import RANK_MISSING, key_gather
+
+        dv = device_values(self.db, tab, self.read_ts)
+        if dv is None:
+            return None
+        import jax.numpy as jnp
+        u32 = uids[uids <= 0xFFFFFFFE].astype(np.uint32)
+        if not len(u32):
+            return {}
+        inc_counter("query_device_orderkeys_total")
+        ranks = np.asarray(key_gather(dv, jnp.asarray(u32)))
+        out = {}
+        for u, r in zip(u32.tolist(), ranks.tolist()):
+            if r != RANK_MISSING:
+                out[u] = (0, int(r))
         return out
 
     # ------------------------------------------------------------------
@@ -836,16 +889,37 @@ class Executor:
                     raise GQLError(
                         f"reverse edges are not defined for predicate "
                         f"{attr[1:]!r} (add @reverse to the schema)")
+                # filtered recurse: ONE batched expansion per level
+                # (device-capable) and one filter evaluation on the
+                # level's union instead of once per parent (ref
+                # recurse.go:29 — its per-level subgraph exec batches
+                # over SrcUIDs the same way). Unfiltered recurse skips
+                # the union pass: per-parent edge lists are needed for
+                # the nested output regardless, and their concat IS the
+                # union.
+                union = None
+                if cgq.filter is not None:
+                    union = self._expand_level(tab, frontier, rev)
+                    if len(union):
+                        union = self._eval_filter(cgq.filter, union)
+                    if not len(union):
+                        level[attr] = {}
+                        continue
                 per_parent: dict[int, np.ndarray] = {}
+                parts = []
                 for u in frontier.tolist():
                     dst = (tab.get_reverse_uids(u, self.read_ts) if rev
                            else tab.get_dst_uids(u, self.read_ts))
-                    if cgq.filter is not None and len(dst):
-                        dst = self._eval_filter(cgq.filter, dst)
+                    if union is not None:
+                        dst = _intersect(dst, union)
                     if len(dst):
                         per_parent[u] = dst
-                        nxt = _union(nxt, dst)
+                        parts.append(dst)
                 level[attr] = per_parent
+                if union is not None:
+                    nxt = _union(nxt, union)
+                elif parts:
+                    nxt = _union(nxt, np.unique(np.concatenate(parts)))
             node.recurse_levels.append(level)
             if not allow_loop:
                 nxt = _difference(nxt, visited)
@@ -866,6 +940,14 @@ class Executor:
         dst = self._fn_single_uid(sa.to)
         preds = [c.attr for c in gq.children if not c.is_internal]
         maxdepth = sa.depth or 64
+        if self.db.prefer_device and len(preds) == 1:
+            path = self._device_shortest(preds[0], src, dst, maxdepth)
+            if path is not None:
+                node.path_nodes = [path] if path else []
+                if gq.var:
+                    self.uid_vars[gq.var] = (_np_sorted(path) if path
+                                             else _EMPTY)
+                return
         # unweighted BFS with parent pointers; k-paths via repeated
         # shortest with edge exclusion (round-1: hop-count weights)
         parent: dict[int, tuple[int, str]] = {src: (0, "")}
@@ -906,6 +988,61 @@ class Executor:
             node.path_nodes = []
             if gq.var:
                 self.uid_vars[gq.var] = _EMPTY
+
+    def _device_shortest(self, pred: str, src: int, dst: int,
+                         maxdepth: int) -> Optional[list[int]]:
+        """Hop-count shortest path via the device SSSP kernel.
+
+        Distances-to-target come from one dense Bellman-Ford over the
+        traversal graph's transpose (ops/bitgraph.make_sssp_bits, the
+        TPU translation of query/shortest.go:451's priority queue);
+        the path itself is reconstructed on host by walking forward
+        from `src`, at each hop picking the smallest-uid neighbor one
+        step closer. Returns None when the tablet isn't device-resident
+        (caller falls back to host BFS), [] when unreachable."""
+        from dgraph_tpu.engine.device_cache import device_bitadjacency
+
+        rev = pred.startswith("~")
+        tab = self._tablet(pred[1:] if rev else pred)
+        if tab is None or tab.schema.value_type != TypeID.UID:
+            return None
+        if rev and not tab.schema.reverse:
+            raise GQLError(
+                f"reverse edges are not defined for predicate "
+                f"{pred[1:]!r} (add @reverse to the schema)")
+        if src > 0xFFFFFFFE or dst > 0xFFFFFFFE:
+            return None
+        # walking ~pred backwards follows pred forwards, so the
+        # distance-to-target pass uses the untransposed adjacency
+        badj_t = device_bitadjacency(self.db, tab, self.read_ts,
+                                     transpose=not rev)
+        if badj_t is None:
+            return None
+        from dgraph_tpu.ops.bitgraph import sssp_dist
+        inc_counter("query_device_sssp_total")
+        if src == dst:
+            return [src]
+        dist_to = sssp_dist(badj_t, np.asarray([dst], np.uint32),
+                            max_iters=maxdepth)
+        d0 = dist_to.get(src)
+        if d0 is None or d0 > maxdepth:
+            return []
+        path = [src]
+        u = src
+        while u != dst:
+            want = dist_to[u] - 1
+            nbrs = (tab.get_reverse_uids(u, self.read_ts) if rev
+                    else tab.get_dst_uids(u, self.read_ts))
+            nxt = None
+            for v in nbrs.tolist():
+                if dist_to.get(int(v)) == want:
+                    nxt = int(v)
+                    break
+            if nxt is None:  # overlay changed under us — fall back
+                return None
+            path.append(nxt)
+            u = nxt
+        return path
 
     def _fn_single_uid(self, fn: Function) -> int:
         if fn.uids:
