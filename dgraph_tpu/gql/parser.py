@@ -251,7 +251,12 @@ def _parse_function(cur: Cursor, gvars: dict) -> Function:
     cur.accept("comma")
     while not cur.accept("rparen"):
         t = cur.next()
-        if t.kind == "lbracket":
+        if t.kind == "lbracket" and fname in (
+                "near", "within", "contains", "intersects"):
+            # geo coordinate literal: keep the (possibly nested) list
+            # structure as one argument (ref gql/parser.go parseGeoArgs)
+            fn.args.append(Arg(_parse_coord_list(cur)))
+        elif t.kind == "lbracket":
             while not cur.accept("rbracket"):
                 inner = cur.next()
                 if inner.kind == "dollar":
@@ -301,6 +306,22 @@ def _parse_function(cur: Cursor, gvars: dict) -> Function:
             raise GQLError(f"line {t.line}: bad function argument {t.val!r}")
         cur.accept("comma")
     return fn
+
+
+def _parse_coord_list(cur: Cursor) -> list:
+    """After an opening '[': numbers / nested lists until ']'."""
+    out: list = []
+    while not cur.accept("rbracket"):
+        t = cur.next()
+        if t.kind == "lbracket":
+            out.append(_parse_coord_list(cur))
+        elif t.kind == "number":
+            out.append(float(t.val))
+        else:
+            raise GQLError(
+                f"line {t.line}: bad coordinate literal {t.val!r}")
+        cur.accept("comma")
+    return out
 
 
 def _relex_regex(cur: Cursor) -> tuple[str, str]:

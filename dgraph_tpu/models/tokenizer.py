@@ -136,30 +136,13 @@ def hour_tokens(v: Val) -> list[int]:
 
 def geo_tokens(v: Val) -> list[str]:
     """Geo cell covering.  The reference uses S2 cells at levels 5-16
-    (types/s2index.go).  We grid lon/lat into multi-resolution square cells
-    (levels 5..12, powers of two per degree) — same near/within semantics,
-    library-free."""
-    import json as _json
+    (types/s2index.go).  We grid lon/lat into multi-resolution square
+    cells (models/geo.py, levels 5..12) — the geometry's bbox cover at
+    every level where it stays small, so contains/within/intersects
+    prefilters find polygons by interior cells, not just vertices."""
+    from dgraph_tpu.models.geo import cover_tokens, parse_geom
 
-    g = v.value if isinstance(v.value, dict) else _json.loads(str(v.value))
-    pts: list[tuple[float, float]] = []
-
-    def collect(coords):
-        if isinstance(coords[0], (int, float)):
-            pts.append((float(coords[0]), float(coords[1])))
-        else:
-            for c in coords:
-                collect(c)
-
-    collect(g["coordinates"])
-    toks = set()
-    for level in range(5, 13):
-        cells_per_deg = 2.0 ** (level - 8)  # level 8 = 1 cell/degree
-        for lon, lat in pts:
-            cx = int((lon + 180.0) * cells_per_deg)
-            cy = int((lat + 90.0) * cells_per_deg)
-            toks.add(f"{level}/{cx}/{cy}")
-    return sorted(toks)
+    return cover_tokens(parse_geom(v.value))
 
 
 _REGISTRY: dict[str, TokenizerSpec] = {}

@@ -78,6 +78,7 @@ class ExecNode:
     # recurse support: per-level (parent -> [children]) maps
     recurse_levels: list[dict[int, np.ndarray]] = field(default_factory=list)
     path_nodes: list[list[int]] = field(default_factory=list)  # shortest
+    path_weights: list[float] = field(default_factory=list)
 
 
 class Executor:
@@ -228,7 +229,99 @@ class Executor:
             return self._eval_uid_in(fn, candidates)
         if name == "checkpwd":
             return self._eval_checkpwd(fn, candidates)
+        if name in ("near", "within", "contains", "intersects"):
+            return self._eval_geo(fn, candidates)
         raise GQLError(f"function {name!r} not supported")
+
+    def _eval_geo(self, fn: Function, candidates) -> np.ndarray:
+        """near/within/contains/intersects: geo-cell index prefilter +
+        exact host verify (ref types/geofilter.go:65,222 +
+        worker/task.go:1330 filterGeoFunction; s2index.go covers become
+        the lon/lat grid in models/geo.py)."""
+        from dgraph_tpu.models import geo as G
+
+        tab = self._tablet(fn.attr)
+        if tab is None:
+            return _EMPTY
+        if tab.schema.value_type != TypeID.GEO:
+            raise GQLError(
+                f"{fn.name} requires a geo predicate, "
+                f"{fn.attr!r} is {tab.schema.value_type.name.lower()}")
+        try:
+            qgeom, dist = self._geo_args(fn)
+        except (ValueError, KeyError, IndexError, TypeError) as e:
+            raise GQLError(f"bad {fn.name} argument: {e}")
+
+        # index prefilter: cells covering the query region, coarse->fine
+        if fn.name == "near":
+            bbox = G.expand_bbox_m(tuple(qgeom["coordinates"]), dist)
+        else:
+            bbox = G._bbox(qgeom)
+        spec = get_tokenizer("geo")
+        indexed = tab.schema.indexed and "geo" in tab.schema.tokenizers
+        if indexed:
+            scan = _EMPTY
+            for t in G.query_tokens(bbox):
+                scan = _union(scan, tab.index_uids(
+                    token_bytes(spec.ident, t), self.read_ts))
+            if candidates is not None:
+                scan = _intersect(candidates, scan)
+        elif candidates is not None:
+            scan = candidates
+        else:
+            raise GQLError(
+                f"{fn.name} requires @index(geo) on {fn.attr!r} at the "
+                "query root")
+
+        keep = []
+        for u in scan.tolist():
+            for p in tab.get_postings(u, self.read_ts):
+                try:
+                    g = G.parse_geom(self._typed(tab, p).value)
+                except ValueError:
+                    continue
+                if self._geo_match(fn.name, g, qgeom, dist):
+                    keep.append(u)
+                    break
+        return np.asarray(keep, dtype=np.uint64)
+
+    @staticmethod
+    def _geo_args(fn: Function):
+        """Parse [lon, lat] / polygon literal (+ distance for near)."""
+        import json as _json
+
+        from dgraph_tpu.models.geo import GeoError, parse_geom
+        raw = fn.args[0].value
+        obj = _json.loads(raw) if isinstance(raw, str) else raw
+        if isinstance(obj, list):
+            if obj and isinstance(obj[0], (int, float)):
+                obj = {"type": "Point", "coordinates": obj}
+            elif obj and isinstance(obj[0][0], (int, float)):
+                obj = {"type": "Polygon", "coordinates": [obj]}
+            else:
+                obj = {"type": "Polygon", "coordinates": obj}
+        qgeom = parse_geom(obj)
+        dist = 0.0
+        if fn.name == "near":
+            if len(fn.args) < 2:
+                raise GeoError("near needs a distance in meters")
+            dist = float(fn.args[1].value)
+            if qgeom["type"] != "Point":
+                raise GeoError("near expects a point")
+        return qgeom, dist
+
+    @staticmethod
+    def _geo_match(name: str, g: dict, q: dict, dist: float) -> bool:
+        from dgraph_tpu.models import geo as G
+        if name == "near":
+            return G.min_distance_m(g, tuple(q["coordinates"])) <= dist
+        if name == "within":
+            return G.geom_within(g, q)
+        if name == "contains":
+            if q["type"] == "Point":
+                return G.geom_contains_point(g, tuple(q["coordinates"]))
+            return G.geom_within(q, g)
+        return G.geom_intersects(g, q)
 
     def _eval_checkpwd(self, fn: Function, candidates) -> np.ndarray:
         """UIDs whose stored password hash verifies against the given
@@ -654,10 +747,25 @@ class Executor:
                 f"(add @reverse to the schema)")
         if tab.schema.value_type == TypeID.UID and not node.reverse or \
                 (node.reverse and tab.schema.reverse):
-            dest = self._expand_level(tab, src, node.reverse)
+            if gq.facets_filter is not None:
+                # @facets(eq(k, v)) drops EDGES, so the union must be
+                # built per-parent (ref worker/task.go:1806
+                # applyFacetsTree — the reference also walks edge-wise)
+                parts = []
+                for u in src.tolist():
+                    dsts = self._edge_dsts_facet_filtered(
+                        tab, int(u), node.reverse, gq.facets_filter)
+                    if len(dsts):
+                        parts.append(dsts)
+                dest = np.unique(np.concatenate(parts)) if parts \
+                    else _EMPTY.copy()
+            else:
+                dest = self._expand_level(tab, src, node.reverse)
             if gq.filter is not None:
                 dest = self._eval_filter(gq.filter, dest)
             node.dest = dest
+            if gq.facet_var:
+                self._bind_facet_vars(tab, src, node.reverse, gq)
             if gq.var:
                 self.uid_vars[gq.var] = dest
             if gq.is_count:
@@ -683,7 +791,96 @@ class Executor:
                     if sel is not None:
                         vmap[u] = self._typed(tab, sel)
                 self.value_vars[gq.var] = vmap
+            if gq.facet_var:
+                for key, varname in gq.facet_var.items():
+                    vmap = {}
+                    for u, ps in node.values.items():
+                        sel = self._select_posting(ps, gq.langs)
+                        if sel is not None and key in sel.facets:
+                            vmap[u] = sel.facets[key]
+                    self.value_vars[varname] = vmap
         return node
+
+    # -- facets (ref worker/task.go:1806 applyFacetsTree,
+    #    types/facets/utils.go:129) --
+
+    def _edge_dsts_facet_filtered(self, tab: Tablet, u: int,
+                                  reverse: bool, ft) -> np.ndarray:
+        dsts = (tab.get_reverse_uids(u, self.read_ts) if reverse
+                else tab.get_dst_uids(u, self.read_ts))
+        if not len(dsts):
+            return dsts
+        keep = []
+        for d in dsts.tolist():
+            fsrc, fdst = (int(d), u) if reverse else (u, int(d))
+            if self._eval_facet_tree(
+                    ft, tab.get_facets(fsrc, fdst, self.read_ts)):
+                keep.append(d)
+        return np.asarray(keep, dtype=np.uint64)
+
+    def _eval_facet_tree(self, ft: FilterTree, facets: dict) -> bool:
+        """Boolean facet filter over one edge's facet map."""
+        if ft.func is not None:
+            fn = ft.func
+            fv = facets.get(fn.attr)
+            if fv is None:
+                return False
+            if fn.name in ("allofterms", "anyofterms"):
+                have = set(str(fv.value).lower().split())
+                want = set(" ".join(str(a.value)
+                                    for a in fn.args).lower().split())
+                return want <= have if fn.name == "allofterms" \
+                    else bool(want & have)
+            want_raw = fn.args[0].value if fn.args else None
+            try:
+                want = convert(Val(TypeID.DEFAULT, want_raw), fv.tid).value
+            except ValueError:
+                return False
+            try:
+                return _cmp(fn.name, fv.value, want)
+            except TypeError:
+                return False
+        if ft.op == "and":
+            return all(self._eval_facet_tree(c, facets)
+                       for c in ft.children)
+        if ft.op == "or":
+            return any(self._eval_facet_tree(c, facets)
+                       for c in ft.children)
+        if ft.op == "not":
+            return not self._eval_facet_tree(ft.children[0], facets)
+        raise GQLError(f"bad facet filter node {ft.op!r}")
+
+    def _bind_facet_vars(self, tab: Tablet, src: np.ndarray,
+                         reverse: bool, gq: GraphQuery):
+        """@facets(v as key): dst uid -> facet value; numeric values
+        sum over multiple in-edges (ref query.go valueVarAggregation
+        over facet vars)."""
+        for key, varname in gq.facet_var.items():
+            vmap: dict[int, Val] = {}
+            for u in src.tolist():
+                if gq.facets_filter is not None:
+                    # the block's facet filter drops edges before any
+                    # var binding sees them
+                    dsts = self._edge_dsts_facet_filtered(
+                        tab, int(u), reverse, gq.facets_filter)
+                else:
+                    dsts = (tab.get_reverse_uids(u, self.read_ts)
+                            if reverse
+                            else tab.get_dst_uids(u, self.read_ts))
+                for d in dsts.tolist():
+                    fsrc, fdst = (int(d), u) if reverse else (u, int(d))
+                    fv = tab.get_facets(fsrc, fdst, self.read_ts).get(key)
+                    if fv is None:
+                        continue
+                    prev = vmap.get(int(d))
+                    if prev is not None and isinstance(
+                            fv.value, (int, float)) and isinstance(
+                            prev.value, (int, float)) and not isinstance(
+                            fv.value, bool):
+                        vmap[int(d)] = Val(fv.tid, prev.value + fv.value)
+                    else:
+                        vmap[int(d)] = fv
+            self.value_vars[varname] = vmap
 
     def _child_count(self, tab: Tablet, uid: int, reverse: bool) -> int:
         if reverse:
@@ -932,61 +1129,186 @@ class Executor:
     # ------------------------------------------------------------------
 
     def _run_shortest(self, node: ExecNode):
+        """shortest(from, to, numpaths, depth, minweight, maxweight)
+        with optional @facets(<key>) edge weights on the predicate
+        children. Ref: query/shortest.go:451 route() (Dijkstra),
+        :287 runKShortestPaths, gql/parser.go:2501 args."""
         gq = node.gq
         sa = gq.shortest
         if sa is None or sa.from_ is None or sa.to is None:
             raise GQLError("shortest requires from: and to:")
         src = self._fn_single_uid(sa.from_)
         dst = self._fn_single_uid(sa.to)
-        preds = [c.attr for c in gq.children if not c.is_internal]
+        pred_specs = self._shortest_preds(gq)
         maxdepth = sa.depth or 64
-        if self.db.prefer_device and len(preds) == 1:
-            path = self._device_shortest(preds[0], src, dst, maxdepth)
+        weighted = any(w for _, _, _, w in pred_specs)
+        simple = (sa.numpaths <= 1 and not weighted
+                  and sa.minweight == float("-inf")
+                  and sa.maxweight == float("inf"))
+        if self.db.prefer_device and simple and len(pred_specs) == 1:
+            path = self._device_shortest(pred_specs[0][0], src, dst,
+                                         maxdepth)
             if path is not None:
-                node.path_nodes = [path] if path else []
-                if gq.var:
-                    self.uid_vars[gq.var] = (_np_sorted(path) if path
-                                             else _EMPTY)
+                # [] is the unreachable sentinel, None means not
+                # device-resident (fall through to host)
+                self._finish_shortest(
+                    node,
+                    [(path, float(len(path) - 1))] if path else [])
                 return
-        # unweighted BFS with parent pointers; k-paths via repeated
-        # shortest with edge exclusion (round-1: hop-count weights)
-        parent: dict[int, tuple[int, str]] = {src: (0, "")}
-        frontier = [src]
-        found = src == dst
-        for _ in range(maxdepth):
-            if found or not frontier:
-                break
-            nxt = []
-            for u in frontier:
-                for pname in preds:
-                    rev = pname.startswith("~")
-                    tab = self._tablet(pname[1:] if rev else pname)
-                    if tab is None:
+        paths = self._k_shortest(pred_specs, src, dst, maxdepth,
+                                 max(1, sa.numpaths),
+                                 sa.minweight, sa.maxweight)
+        self._finish_shortest(node, paths)
+
+    def _shortest_preds(self, gq) -> list[tuple]:
+        """[(attr, tablet, reverse, weight_facet_key)] for the block's
+        predicate children."""
+        out = []
+        for c in gq.children:
+            if c.is_internal:
+                continue
+            pname = c.attr
+            rev = pname.startswith("~")
+            tab = self._tablet(pname[1:] if rev else pname)
+            if tab is None:
+                continue
+            if rev and not tab.schema.reverse:
+                raise GQLError(
+                    f"reverse edges are not defined for predicate "
+                    f"{pname[1:]!r} (add @reverse to the schema)")
+            wkey = ""
+            if c.facets is not None and c.facets.keys:
+                wkey = c.facets.keys[0][0]
+            out.append((pname, tab, rev, wkey))
+        return out
+
+    def _shortest_neighbors(self, pred_specs, u: int
+                            ) -> list[tuple[int, float]]:
+        """(neighbor, edge weight) pairs; facet weight when requested,
+        else 1 per hop (ref shortest.go expandOut)."""
+        out = []
+        for pname, tab, rev, wkey in pred_specs:
+            dsts = (tab.get_reverse_uids(u, self.read_ts) if rev
+                    else tab.get_dst_uids(u, self.read_ts))
+            for d in dsts.tolist():
+                w = 1.0
+                if wkey:
+                    # facets live on the forward edge
+                    fsrc, fdst = (d, u) if rev else (u, d)
+                    fv = tab.get_facets(fsrc, fdst, self.read_ts).get(wkey)
+                    if fv is not None:
+                        try:
+                            w = float(fv.value)
+                        except (TypeError, ValueError):
+                            w = 1.0
+                out.append((int(d), w))
+        return out
+
+    def _k_shortest(self, pred_specs, src: int, dst: int, maxdepth: int,
+                    k: int, minw: float = float("-inf"),
+                    maxw: float = float("inf")
+                    ) -> list[tuple[list[int], float]]:
+        """Yen's algorithm over hop-labeled Dijkstra: loopless shortest
+        paths in nondecreasing weight until k of them fall inside the
+        [minweight, maxweight] window (ref shortest.go:287
+        runKShortestPaths — the weight bounds are search constraints,
+        not a post-filter)."""
+        import heapq
+
+        nbr_memo: dict[int, list[tuple[int, float]]] = {}
+
+        def neighbors(u: int):
+            out = nbr_memo.get(u)
+            if out is None:
+                out = nbr_memo[u] = self._shortest_neighbors(
+                    pred_specs, u)
+            return out
+
+        def dijkstra(banned_edges, banned_nodes, start, depth_budget):
+            # labels are (node, hops): a cheap-but-deep route must not
+            # shadow a shallower one that still has hop budget left
+            dist = {(start, 0): 0.0}
+            prev: dict[tuple[int, int], tuple[int, int]] = {}
+            pq = [(0.0, 0, start)]
+            best_dst = None
+            while pq:
+                d, hops, u = heapq.heappop(pq)
+                if u == dst:
+                    best_dst = (u, hops)
+                    break
+                if d > dist.get((u, hops), float("inf")) \
+                        or hops >= depth_budget:
+                    continue
+                for v, w in neighbors(u):
+                    if v in banned_nodes or (u, v) in banned_edges:
                         continue
-                    if rev and not tab.schema.reverse:
-                        raise GQLError(
-                            f"reverse edges are not defined for predicate "
-                            f"{pname[1:]!r} (add @reverse to the schema)")
-                    dsts = (tab.get_reverse_uids(u, self.read_ts) if rev
-                            else tab.get_dst_uids(u, self.read_ts))
-                    for d in dsts.tolist():
-                        if d not in parent:
-                            parent[d] = (u, pname)
-                            nxt.append(d)
-                            if d == dst:
-                                found = True
-            frontier = nxt
-        if found:
-            path = [dst]
-            while path[-1] != src:
-                path.append(parent[path[-1]][0])
+                    nd = d + w
+                    if nd < dist.get((v, hops + 1), float("inf")):
+                        dist[(v, hops + 1)] = nd
+                        prev[(v, hops + 1)] = (u, hops)
+                        heapq.heappush(pq, (nd, hops + 1, v))
+            if best_dst is None:
+                return None
+            path = [best_dst[0]]
+            label = best_dst
+            while label[0] != start or label[1] != 0:
+                label = prev[label]
+                path.append(label[0])
             path.reverse()
-            node.path_nodes = [path]
-            if gq.var:
-                self.uid_vars[gq.var] = _np_sorted(path)
-        else:
-            node.path_nodes = []
-            if gq.var:
+            return path, dist[best_dst]
+
+        def in_window(w):
+            return minw <= w <= maxw
+
+        if src == dst:
+            return [([src], 0.0)] if in_window(0.0) else []
+        first = dijkstra(set(), set(), src, maxdepth)
+        if first is None:
+            return []
+        found = [first]
+        cand: list[tuple[float, list[int]]] = []
+        seen = {tuple(first[0])}
+        max_rounds = max(64, 8 * k)  # window search safety valve
+        while sum(1 for _, w in found if in_window(w)) < k \
+                and len(found) < max_rounds:
+            base_path, base_w = found[-1]
+            # prefix weights of the base path, one edge-lookup pass
+            prefix_w = [0.0]
+            for a, b in zip(base_path, base_path[1:]):
+                ws = [w for v, w in neighbors(a) if v == b]
+                prefix_w.append(prefix_w[-1] + (min(ws) if ws else 1.0))
+            for i in range(len(base_path) - 1):
+                spur = base_path[i]
+                root = base_path[: i + 1]
+                banned_edges = {(p[i], p[i + 1]) for p, _ in found
+                                if len(p) > i + 1 and p[: i + 1] == root}
+                banned_nodes = set(root[:-1])
+                rest = dijkstra(banned_edges, banned_nodes, spur,
+                                maxdepth - i)
+                if rest is None:
+                    continue
+                total = root[:-1] + rest[0]
+                key = tuple(total)
+                if key not in seen:
+                    seen.add(key)
+                    heapq.heappush(cand, (prefix_w[i] + rest[1], total))
+            if not cand:
+                break
+            w, p = heapq.heappop(cand)
+            if w > maxw:
+                break  # nondecreasing weights: nothing ahead can fit
+            found.append((p, w))
+        return [(p, w) for p, w in found if in_window(w)][:k]
+
+    def _finish_shortest(self, node: ExecNode, paths):
+        node.path_nodes = [p for p, _ in paths]
+        node.path_weights = [w for _, w in paths]
+        gq = node.gq
+        if gq.var:
+            # the uid var holds the FIRST (best) path, ref shortest.go
+            if paths:
+                self.uid_vars[gq.var] = _np_sorted(paths[0][0])
+            else:
                 self.uid_vars[gq.var] = _EMPTY
 
     def _device_shortest(self, pred: str, src: int, dst: int,
@@ -1069,7 +1391,10 @@ class Executor:
             if ch.gq.attr == "uid" and ch.gq.is_count:
                 out.append({ch.gq.alias or "count": len(node.dest)})
         for u in node.dest.tolist():
-            obj = self._emit_uid(node, int(u))
+            # @ignorereflex: track the result path so children never
+            # re-emit an ancestor (ref query.go:164 removeCycles)
+            path = frozenset({int(u)}) if gq.ignore_reflex else None
+            obj = self._emit_uid(node, int(u), path)
             if obj:  # empty objects are dropped (ref outputnode.go)
                 out.append(obj)
         # block-level aggregations over vars (empty-src internal children)
@@ -1084,7 +1409,8 @@ class Executor:
             out = [o for o in out if o]
         return out
 
-    def _emit_uid(self, node: ExecNode, uid: int) -> Optional[dict]:
+    def _emit_uid(self, node: ExecNode, uid: int,
+                  path: Optional[frozenset] = None) -> Optional[dict]:
         obj: dict[str, Any] = {}
         gq = node.gq
         children = node.children
@@ -1117,14 +1443,27 @@ class Executor:
             tab = ch.tablet
             if tab.schema.value_type == TypeID.UID and not ch.reverse \
                     or (ch.reverse and tab.schema.reverse):
-                dsts = (tab.get_reverse_uids(uid, self.read_ts) if ch.reverse
-                        else tab.get_dst_uids(uid, self.read_ts))
+                if cgq.facets_filter is not None:
+                    dsts = self._edge_dsts_facet_filtered(
+                        tab, uid, ch.reverse, cgq.facets_filter)
+                else:
+                    dsts = (tab.get_reverse_uids(uid, self.read_ts)
+                            if ch.reverse
+                            else tab.get_dst_uids(uid, self.read_ts))
                 dsts = _intersect(dsts, ch.dest) if len(ch.dest) else \
                     (dsts if not ch.gq.filter else _EMPTY)
+                if path is not None and len(dsts):
+                    dsts = _difference(dsts, _np_sorted(path))
                 if cgq.is_groupby:
                     obj[name] = self._emit_groupby(ch, dsts)
                     continue
-                dsts = self._order_paginate(cgq, dsts)
+                facet_orders = [o for o in cgq.order
+                                if o.attr.startswith("facet:")]
+                if facet_orders:
+                    dsts = self._order_paginate_facets(
+                        cgq, tab, uid, ch.reverse, dsts, facet_orders)
+                else:
+                    dsts = self._order_paginate(cgq, dsts)
                 counts = [c for c in cgq.children
                           if c.attr == "uid" and c.is_count]
                 if counts:
@@ -1132,11 +1471,15 @@ class Executor:
                     continue
                 items = []
                 for d in dsts.tolist():
-                    sub = self._emit_uid(ch, int(d))
+                    sub = self._emit_uid(
+                        ch, int(d),
+                        path | {int(d)} if path is not None else None)
                     if sub is None:
                         continue
                     if cgq.facets is not None:
-                        fc = tab.get_facets(uid, int(d), self.read_ts)
+                        fsrc, fdst = (int(d), uid) if ch.reverse \
+                            else (uid, int(d))
+                        fc = tab.get_facets(fsrc, fdst, self.read_ts)
                         self._attach_facets(sub, cgq.facets, fc, name)
                     if sub:
                         items.append(sub)
@@ -1151,7 +1494,7 @@ class Executor:
                     if v is not None:
                         obj[name] = v
                         if cgq.facets is not None:
-                            pass  # value facets round 2
+                            self._attach_value_facets(obj, ch, ps, name)
                         continue
                 if gq.cascade or cgq.cascade:
                     return None
@@ -1176,6 +1519,61 @@ class Executor:
             return to_json_value(self._typed(tab, sel)) if sel else None
         sel = self._select_posting(ps, [])
         return to_json_value(self._typed(tab, sel)) if sel else None
+
+    def _order_paginate_facets(self, gq: GraphQuery, tab: Tablet,
+                               parent: int, reverse: bool,
+                               dsts: np.ndarray, orders) -> np.ndarray:
+        """@facets(orderasc: k): sort a parent's edge list by facet
+        value, missing-facet edges last (ref query.go sortWithFacet)."""
+        def keys_for(d):
+            row = []
+            for o in orders:
+                key = o.attr[len("facet:"):]
+                fsrc, fdst = (int(d), parent) if reverse \
+                    else (parent, int(d))
+                fv = tab.get_facets(fsrc, fdst, self.read_ts).get(key)
+                if fv is None:
+                    row.append((1, 0))
+                else:
+                    try:
+                        k = sort_key(fv)
+                    except ValueError:
+                        k = 0
+                    row.append((0, -k if o.desc else k))
+            row.append((0, int(d)))
+            return tuple(row)
+
+        ordered = np.asarray(sorted(dsts.tolist(), key=keys_for),
+                             dtype=np.uint64)
+        # pagination still applies after the facet sort
+        stripped = GraphQuery(attr=gq.attr, first=gq.first,
+                              offset=gq.offset, after=gq.after)
+        return self._order_paginate(stripped, ordered)
+
+    def _attach_value_facets(self, obj: dict, ch: ExecNode, ps,
+                             name: str):
+        """name|key facets of value postings; list predicates emit a
+        position-indexed map (ref outputnode.go facetsNode handling)."""
+        cgq = ch.gq
+        fp = cgq.facets
+        tab = ch.tablet
+        if tab.schema.list_:
+            plist = [p for p in ps if not p.lang]
+            by_key: dict[str, dict[str, Any]] = {}
+            for i, p in enumerate(plist):
+                sel = p.facets if fp.all_keys else {
+                    k: p.facets[k] for k, _ in fp.keys if k in p.facets}
+                for k, v in sel.items():
+                    by_key.setdefault(k, {})[str(i)] = to_json_value(v)
+            names = {k: k for k in by_key}
+            if not fp.all_keys:
+                names.update({k: a for k, a in fp.keys})
+            for k, m in by_key.items():
+                obj[f"{name}|{names.get(k, k)}"] = m
+            return
+        sel = self._select_posting(ps, cgq.langs)
+        if sel is not None and sel.facets:
+            self._attach_facets(obj, fp, sel.facets, name)
 
     def _attach_facets(self, item: dict, fp, facets: dict, edge: str):
         if not facets:
@@ -1240,16 +1638,15 @@ class Executor:
 
     def _emit_paths(self, node: ExecNode) -> list:
         out = []
-        for path in node.path_nodes:
-            cur = None
-            for uid in reversed(path):
-                entry = {"uid": hex(uid)}
-                if cur is not None:
-                    entry["_next_"] = cur  # chain; flattened below
-                cur = entry
+        weights = node.path_weights or [None] * len(node.path_nodes)
+        for path, w in zip(node.path_nodes, weights):
             # Dgraph emits a nested path via the traversed predicates; we
-            # emit the uid chain (same information, simpler shape)
-            out.append({"path": [{"uid": hex(u)} for u in path]})
+            # emit the uid chain (same information, simpler shape) plus
+            # the route weight (ref shortest.go pathInfo.totalWeight)
+            entry = {"path": [{"uid": hex(u)} for u in path]}
+            if w is not None:
+                entry["_weight_"] = w
+            out.append(entry)
         return out
 
     def _normalize(self, obj: dict) -> dict:

@@ -1,0 +1,124 @@
+"""Facets completion: edge-facet filters, value facets, facet ordering,
+facet vars, @ignorereflex.
+
+Ref: worker/task.go:1806 applyFacetsTree, types/facets/utils.go:129,
+query/query.go:164 (removeCycles for @ignorereflex).
+"""
+
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+
+
+@pytest.fixture(scope="module")
+def db():
+    db = GraphDB(prefer_device=False)
+    db.alter("friend: [uid] @reverse .\nname: string @index(exact) .\n"
+             "nick: string .\nhobbies: [string] .")
+    db.mutate(set_nquads="""
+<1> <name> "alice" .
+<2> <name> "bob" .
+<3> <name> "carol" .
+<4> <name> "dave" .
+<1> <friend> <2> (close=true, since=2015, weight=3) .
+<1> <friend> <3> (close=false, since=2019, weight=1) .
+<1> <friend> <4> (since=2017, weight=2) .
+<2> <friend> <1> (close=true, since=2015) .
+<1> <nick> "al" (kind="short") .
+<1> <hobbies> "chess" (rank=2) .
+<1> <hobbies> "go" (rank=1) .
+""")
+    return db
+
+
+def _q(db, q):
+    return db.query(q)["data"]["q"]
+
+
+def test_facet_filter_eq(db):
+    out = _q(db, '{ q(func: eq(name, "alice")) { name '
+                 'friend @facets(eq(close, true)) { name } } }')
+    assert [f["name"] for f in out[0]["friend"]] == ["bob"]
+
+
+def test_facet_filter_ineq_and_bool_ops(db):
+    out = _q(db, '{ q(func: eq(name, "alice")) { name '
+                 'friend @facets(ge(since, 2017)) { name } } }')
+    assert sorted(f["name"] for f in out[0]["friend"]) == \
+        ["carol", "dave"]
+    out = _q(db, '{ q(func: eq(name, "alice")) { name '
+                 'friend @facets(NOT ge(since, 2017)) { name } } }')
+    assert [f["name"] for f in out[0]["friend"]] == ["bob"]
+    # missing facet never matches (dave has no `close`)
+    out = _q(db, '{ q(func: eq(name, "alice")) { name '
+                 'friend @facets(eq(close, false)) { name } } }')
+    assert [f["name"] for f in out[0]["friend"]] == ["carol"]
+
+
+def test_facet_filter_affects_uid_var(db):
+    # edges dropped by the facet filter must not leak into vars
+    out = db.query('{ var(func: eq(name, "alice")) '
+                   '{ v as friend @facets(eq(close, true)) } '
+                   '  q(func: uid(v)) { name } }')
+    assert [x["name"] for x in out["data"]["q"]] == ["bob"]
+
+
+def test_facet_ordering(db):
+    out = _q(db, '{ q(func: eq(name, "alice")) { name '
+                 'friend @facets(orderasc: weight) { name } } }')
+    assert [f["name"] for f in out[0]["friend"]] == \
+        ["carol", "dave", "bob"]
+    assert [f["friend|weight"] for f in out[0]["friend"]] == [1, 2, 3]
+    out = _q(db, '{ q(func: eq(name, "alice")) { name '
+                 'friend @facets(orderdesc: since) { name } } }')
+    assert [f["name"] for f in out[0]["friend"]] == \
+        ["carol", "dave", "bob"]
+
+
+def test_value_facets(db):
+    out = _q(db, '{ q(func: eq(name, "alice")) '
+                 '{ name nick @facets(kind) } }')
+    assert out[0]["nick"] == "al"
+    assert out[0]["nick|kind"] == "short"
+
+
+def test_value_facets_list_indexed_map(db):
+    out = _q(db, '{ q(func: eq(name, "alice")) '
+                 '{ name hobbies @facets } }')
+    row = out[0]
+    ranks = row["hobbies|rank"]
+    # position-indexed map aligned to the emitted list
+    assert {row["hobbies"][int(i)]: v for i, v in ranks.items()} == \
+        {"chess": 2, "go": 1}
+
+
+def test_facet_var_in_math(db):
+    out = db.query('{ var(func: eq(name, "alice")) '
+                   '{ friend @facets(w as weight) } '
+                   '  q(func: uid(2, 3, 4), orderasc: val(w)) '
+                   '{ name val(w) } }')
+    rows = out["data"]["q"]
+    assert [r["name"] for r in rows] == ["carol", "dave", "bob"]
+    assert [r["val(w)"] for r in rows] == [1, 2, 3]
+
+
+def test_ignorereflex(db):
+    q = '{ q(func: eq(name, "alice")) @ignorereflex '
+    q += '{ name friend { name friend { name } } } }'
+    out = _q(db, q)
+    bob = next(f for f in out[0]["friend"] if f["name"] == "bob")
+    # without @ignorereflex bob's friends include alice; with it, not
+    assert "friend" not in bob or all(
+        g["name"] != "alice" for g in bob["friend"])
+
+
+def test_facet_var_respects_facet_filter(db):
+    """@facets filter + facet var on one block: the var must only see
+    surviving edges (advisor finding)."""
+    out = db.query('{ var(func: eq(name, "alice")) '
+                   '{ friend @facets(eq(close, true)) @facets(w as weight) } '
+                   '  q(func: uid(2, 3), orderasc: name) { name val(w) } }')
+    rows = out["data"]["q"]
+    by_name = {r["name"]: r.get("val(w)") for r in rows}
+    assert by_name.get("bob") == 3       # close=true edge kept
+    assert by_name.get("carol") is None  # close=false edge dropped

@@ -1,0 +1,146 @@
+"""Geo query functions: near/within/contains/intersects.
+
+Model: the reference's geo filter semantics (types/geofilter.go:65,222,
+worker/task.go:1330 filterGeoFunction) with the s2 cover replaced by the
+lon/lat grid in models/geo.py.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.gql.lexer import GQLError
+
+
+def _geojson(obj) -> str:
+    return json.dumps(obj).replace('"', '\\"')
+
+
+@pytest.fixture(scope="module")
+def db():
+    db = GraphDB(prefer_device=False)
+    db.alter("loc: geo @index(geo) .\nname: string @index(exact) .\n"
+             "noidx: geo .")
+    pt = lambda lon, lat: {"type": "Point", "coordinates": [lon, lat]}
+    poly = lambda rings: {"type": "Polygon", "coordinates": rings}
+    rows = {
+        1: ("ferry", pt(-122.393, 37.795)),
+        2: ("ggpark", poly([[[-122.51, 37.765], [-122.45, 37.765],
+                             [-122.45, 37.775], [-122.51, 37.775],
+                             [-122.51, 37.765]]])),
+        3: ("la", pt(-118.24, 34.05)),
+        4: ("donut", poly([[[-121.0, 36.0], [-120.0, 36.0],
+                            [-120.0, 37.0], [-121.0, 37.0],
+                            [-121.0, 36.0]],
+                           [[-120.7, 36.3], [-120.3, 36.3],
+                            [-120.3, 36.7], [-120.7, 36.7],
+                            [-120.7, 36.3]]])),
+    }
+    quads = []
+    for uid, (name, g) in rows.items():
+        quads.append(f'<{uid}> <name> "{name}" .')
+        quads.append(f'<{uid}> <loc> "{_geojson(g)}"^^<geo:geojson> .')
+    db.mutate(set_nquads="\n".join(quads))
+    return db
+
+
+def _names(db, q):
+    return sorted(x["name"] for x in db.query(q)["data"]["q"])
+
+
+def test_near_point(db):
+    assert _names(db, '{ q(func: near(loc, [-122.39, 37.79], 2000)) '
+                      '{ name } }') == ["ferry"]
+    # big radius reaches the park polygon too
+    assert _names(db, '{ q(func: near(loc, [-122.39, 37.79], 20000)) '
+                      '{ name } }') == ["ferry", "ggpark"]
+
+
+def test_within_polygon(db):
+    assert _names(db, '{ q(func: within(loc, [[-122.6,37.7],'
+                      '[-122.3,37.7],[-122.3,37.9],[-122.6,37.9]])) '
+                      '{ name } }') == ["ferry", "ggpark"]
+    # polygon straddling the query boundary is NOT within
+    assert _names(db, '{ q(func: within(loc, [[-122.48,37.7],'
+                      '[-122.3,37.7],[-122.3,37.9],[-122.48,37.9]])) '
+                      '{ name } }') == ["ferry"]
+
+
+def test_contains_point_and_hole(db):
+    assert _names(db, '{ q(func: contains(loc, [-122.48, 37.77])) '
+                      '{ name } }') == ["ggpark"]
+    # inside the donut ring
+    assert _names(db, '{ q(func: contains(loc, [-120.1, 36.1])) '
+                      '{ name } }') == ["donut"]
+    # inside the hole -> nothing contains it
+    assert _names(db, '{ q(func: contains(loc, [-120.5, 36.5])) '
+                      '{ name } }') == []
+
+
+def test_intersects_edge_crossing(db):
+    # region crossing the park's east edge; no park vertex inside it
+    assert _names(db, '{ q(func: intersects(loc, [[-122.46,37.768],'
+                      '[-122.40,37.768],[-122.40,37.772],'
+                      '[-122.46,37.772]])) { name } }') == ["ggpark"]
+
+
+def test_geo_as_filter(db):
+    out = db.query('{ q(func: has(name)) @filter(near(loc, '
+                   '[-118.24, 34.05], 1000)) { name } }')
+    assert [x["name"] for x in out["data"]["q"]] == ["la"]
+
+
+def test_geo_json_mutation_roundtrip():
+    db = GraphDB(prefer_device=False)
+    db.alter("loc: geo @index(geo) .\nname: string .")
+    db.mutate(set_json=[{"name": "museum",
+                         "loc": {"type": "Point",
+                                 "coordinates": [2.337, 48.861]}}])
+    out = db.query('{ q(func: near(loc, [2.34, 48.86], 5000)) '
+                   '{ name loc } }')
+    assert out["data"]["q"][0]["name"] == "museum"
+    assert out["data"]["q"][0]["loc"]["type"] == "Point"
+
+
+def test_geo_requires_index_at_root(db):
+    db.mutate(set_nquads=
+              '<9> <noidx> "{\\"type\\":\\"Point\\",'
+              '\\"coordinates\\":[0,0]}"^^<geo:geojson> .')
+    with pytest.raises(GQLError, match="@index"):
+        db.query('{ q(func: near(noidx, [0, 0], 10)) { name } }')
+
+
+def test_geo_wrong_type_rejected(db):
+    with pytest.raises(GQLError, match="geo predicate"):
+        db.query('{ q(func: near(name, [0, 0], 10)) { name } }')
+
+
+def test_geometry_primitives():
+    from dgraph_tpu.models import geo as G
+    sf = (-122.42, 37.77)
+    la = (-118.24, 34.05)
+    d = G.haversine_m(sf, la)
+    assert 540_000 < d < 570_000  # ~559 km
+    sq = {"type": "Polygon",
+          "coordinates": [[[0, 0], [2, 0], [2, 2], [0, 2], [0, 0]]]}
+    assert G.geom_contains_point(sq, (1, 1))
+    assert not G.geom_contains_point(sq, (3, 1))
+    assert G.geom_contains_point(sq, (0, 1))  # boundary counts
+    inner = {"type": "Polygon",
+             "coordinates": [[[0.5, 0.5], [1.5, 0.5], [1.5, 1.5],
+                              [0.5, 1.5], [0.5, 0.5]]]}
+    assert G.geom_within(inner, sq)
+    assert not G.geom_within(sq, inner)
+    assert G.geom_intersects(inner, sq)
+    far = {"type": "Polygon",
+           "coordinates": [[[5, 5], [6, 5], [6, 6], [5, 6], [5, 5]]]}
+    assert not G.geom_intersects(far, sq)
+
+
+def test_huge_radius_still_finds_matches(db):
+    """A query bbox larger than any fine-level cover must fall back to
+    the coarse always-indexed levels (advisor finding)."""
+    assert "la" in _names(db, '{ q(func: near(loc, [-120, 36], '
+                              '5000000)) { name } }')

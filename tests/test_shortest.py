@@ -1,0 +1,128 @@
+"""Shortest-path parity: numpaths, facet weights, min/maxweight, depth.
+
+Ref: query/shortest.go:287 (runKShortestPaths), :451 (Dijkstra route),
+gql/parser.go:2501 (args).
+"""
+
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+
+
+def _paths(db, q):
+    out = db.query(q)["data"].get("_path_", [])
+    return [([int(e["uid"], 16) for e in p["path"]], p.get("_weight_"))
+            for p in out]
+
+
+@pytest.fixture(scope="module")
+def db():
+    db = GraphDB(prefer_device=False)
+    db.alter("road: [uid] @reverse .\nname: string .")
+    #   1 -(2)-> 2 -(2)-> 4
+    #   1 -(1)-> 3 -(1)-> 4        cheap route
+    #   1 -(9)-> 4                 direct but expensive
+    #   4 -(1)-> 5
+    edges = [(1, 2, 2), (2, 4, 2), (1, 3, 1), (3, 4, 1), (1, 4, 9),
+             (4, 5, 1)]
+    quads = []
+    for s, d, w in edges:
+        quads.append(f'<{s}> <road> <{d}> (weight={w}) .')
+    for u in range(1, 6):
+        quads.append(f'<{u}> <name> "n{u}" .')
+    db.mutate(set_nquads="\n".join(quads))
+    return db
+
+
+def test_unweighted_single_path(db):
+    got = _paths(db, '{ p as shortest(from: 1, to: 4) { road } '
+                     '  p2(func: uid(p)) { name } }')
+    assert len(got) == 1
+    assert got[0][0] == [1, 4]          # 1 hop beats 2 hops
+    assert got[0][1] == 1.0
+
+
+def test_weighted_dijkstra_picks_cheap_route(db):
+    got = _paths(db, '{ p as shortest(from: 1, to: 4) '
+                     '{ road @facets(weight) } p2(func: uid(p)) { name } }')
+    assert got[0][0] == [1, 3, 4]       # weight 2 beats 4 and 9
+    assert got[0][1] == 2.0
+
+
+def test_numpaths_orders_by_weight(db):
+    got = _paths(db, '{ p as shortest(from: 1, to: 4, numpaths: 3) '
+                     '{ road @facets(weight) } p2(func: uid(p)) { name } }')
+    assert [p for p, _ in got] == [[1, 3, 4], [1, 2, 4], [1, 4]]
+    assert [w for _, w in got] == [2.0, 4.0, 9.0]
+
+
+def test_minweight_maxweight_window(db):
+    got = _paths(db, '{ p as shortest(from: 1, to: 4, numpaths: 3, '
+                     'minweight: 3, maxweight: 5) '
+                     '{ road @facets(weight) } p2(func: uid(p)) { name } }')
+    assert [p for p, _ in got] == [[1, 2, 4]]
+
+
+def test_depth_cap(db):
+    # only the direct (expensive) edge fits in 1 hop
+    got = _paths(db, '{ p as shortest(from: 1, to: 4, depth: 1) '
+                     '{ road @facets(weight) } p2(func: uid(p)) { name } }')
+    assert got and got[0][0] == [1, 4]
+
+
+def test_reverse_pred_shortest(db):
+    got = _paths(db, '{ p as shortest(from: 5, to: 1) { ~road } '
+                     '  p2(func: uid(p)) { name } }')
+    assert got[0][0] == [5, 4, 1]
+
+
+def test_unreachable(db):
+    got = _paths(db, '{ p as shortest(from: 5, to: 3) { road } '
+                     '  p2(func: uid(p)) { name } }')
+    assert got == []
+
+
+def test_numpaths_exhausts_gracefully(db):
+    # only 3 loopless routes exist; asking for 5 returns all 3
+    got = _paths(db, '{ p as shortest(from: 1, to: 4, numpaths: 5) '
+                     '{ road @facets(weight) } p2(func: uid(p)) { name } }')
+    assert len(got) == 3
+
+
+def test_minweight_beyond_first_k_paths(db):
+    """Weight bounds are search constraints: numpaths:1 minweight:5
+    must keep searching past the cheap routes (advisor finding)."""
+    got = _paths(db, '{ p as shortest(from: 1, to: 4, numpaths: 1, '
+                     'minweight: 5) { road @facets(weight) } '
+                     'p2(func: uid(p)) { name } }')
+    assert got == [([1, 4], 9.0)]
+
+
+def test_depth_cap_cheap_deep_does_not_shadow(db):
+    """A cheaper-but-deeper label must not block a shallower route
+    (advisor finding: hop-labeled Dijkstra)."""
+    db2 = GraphDB(prefer_device=False)
+    db2.alter("r: [uid] .")
+    db2.mutate(set_nquads="""
+<10> <r> <11> (weight=1) .
+<11> <r> <12> (weight=1) .
+<10> <r> <12> (weight=9) .
+<12> <r> <13> (weight=1) .
+""")
+    got = _paths(db2, '{ p as shortest(from: 10, to: 13, depth: 2) '
+                      '{ r @facets(weight) } p2(func: uid(p)) { uid } }')
+    assert got and got[0][0] == [10, 12, 13]
+
+
+def test_device_unreachable_emits_no_path():
+    """Device SSSP unreachable sentinel must not surface as an empty
+    path entry (advisor finding)."""
+    import numpy as np
+    db3 = GraphDB(prefer_device=True, device_min_edges=1)
+    db3.alter("r: [uid] .")
+    quads = [f"<{u}> <r> <{u+1}> ." for u in range(1, 40)]
+    quads.append("<100> <r> <101> .")
+    db3.mutate(set_nquads="\n".join(quads))
+    out = db3.query('{ p as shortest(from: 1, to: 100) { r } '
+                    'p2(func: uid(p)) { uid } }')
+    assert out["data"].get("_path_", []) == []
