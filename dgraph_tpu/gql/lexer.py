@@ -101,8 +101,9 @@ def _unquote(raw: str, line: int) -> str:
 
 
 class Cursor:
-    def __init__(self, toks: list[Token]):
+    def __init__(self, toks: list[Token], src: str = ""):
         self.toks = toks
+        self.src = src
         self.i = 0
 
     def peek(self, ahead: int = 0) -> Token:

@@ -34,7 +34,7 @@ _BOOL_OPS = {"and", "or", "not"}
 def parse(text: str, variables: dict | None = None) -> ParsedResult:
     """Parse a full query document.  `variables` supplies values for
     GraphQL `$vars` (ref gql.Request.Variables)."""
-    cur = Cursor(tokenize(text))
+    cur = Cursor(tokenize(text), src=text)
     vars_decl: dict[str, str | None] = {}
     res = ParsedResult()
     fragments: dict[str, GraphQuery] = {}
@@ -192,7 +192,8 @@ def _pred_with_lang_str(cur: Cursor) -> tuple[str, str]:
         return f"val({v})", ""
     lang = ""
     if cur.accept("at"):
-        lang = cur.expect("name", "language").val
+        lang = "." if cur.accept("dot") \
+            else cur.expect("name", "language").val
     return t.val, lang
 
 
@@ -246,7 +247,9 @@ def _parse_function(cur: Cursor, gvars: dict) -> Function:
     else:
         fn.attr = cur.expect("name", "attribute").val
         if cur.accept("at"):
-            fn.lang = cur.expect("name", "language").val
+            # `pred@en` or `pred@.` (any language)
+            fn.lang = "." if cur.accept("dot") \
+                else cur.expect("name", "language").val
 
     cur.accept("comma")
     while not cur.accept("rparen"):
@@ -325,24 +328,29 @@ def _parse_coord_list(cur: Cursor) -> list:
 
 
 def _relex_regex(cur: Cursor) -> tuple[str, str]:
-    """Reconstruct /regex/flags from raw text between tokens."""
+    """Reconstruct /regex/flags from raw source between tokens.
+
+    The pattern must be sliced from the ORIGINAL source — joining token
+    vals would drop whitespace inside the literal (`/Frozen King/` must
+    keep its space)."""
     toks = cur.toks
-    # find the matching '/' op token scanning forward
-    start_tok = toks[cur.i]
-    depth_src = start_tok.pos
+    # the opening '/' op was already consumed by the caller; the pattern
+    # starts right after it (leading whitespace is part of the pattern)
+    open_slash = toks[cur.i - 1]
     # walk raw token list until an op '/' token
     j = cur.i
     while j < len(toks) and not (toks[j].kind == "op" and toks[j].val == "/"):
         j += 1
     if j >= len(toks):
         raise GQLError("unterminated regex literal")
-    # raw pattern spans from start of current token to start of closing '/'
-    pat = "".join(t.val for t in toks[cur.i : j])
+    if cur.src:
+        pat = cur.src[open_slash.pos + 1 : toks[j].pos]
+    else:  # no source available (shouldn't happen for query docs)
+        pat = "".join(t.val for t in toks[cur.i : j])
     cur.i = j + 1
     flags = ""
     if cur.peek().kind == "name" and cur.peek().val in ("i",):
         flags = cur.next().val
-    _ = depth_src
     return pat, flags
 
 
@@ -365,7 +373,7 @@ def parse_cond(text: str) -> FilterTree | None:
         return None
     if text.startswith("@if"):
         text = text[3:].lstrip()
-    cur = Cursor(tokenize(text))
+    cur = Cursor(tokenize(text), src=text)
     tree = _parse_filter(cur, {})
     t = cur.peek()
     if t.kind != "eof":

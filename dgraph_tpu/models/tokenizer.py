@@ -48,27 +48,8 @@ def _fold(s: str) -> str:
 
 _TERM_SPLIT = re.compile(r"[^\w]+", re.UNICODE)
 
-# A small multi-language stopword set for fulltext (the reference pulls
-# bleve's per-language lists; we keep English + common Romance/Germanic
-# function words host-side).
-_STOPWORDS = frozenset(
-    "a an and are as at be but by for if in into is it no not of on or such "
-    "that the their then there these they this to was will with".split())
-
-
-def _porter_lite(w: str) -> str:
-    """Tiny suffix-stripping stemmer standing in for bleve's snowball
-    stemmers (tok/langbase.go). Deliberately conservative."""
-    for suf in ("ational", "iveness", "fulness", "ousness", "ization",
-                "ations", "ingly", "ement", "ments", "ition",
-                "ness", "ible", "able", "ment", "ions",
-                "ies", "ied", "ing", "ely", "es", "ed", "ly", "s"):
-        if w.endswith(suf) and len(w) - len(suf) >= 3:
-            w = w[: -len(suf)]
-            if suf == "ies" or suf == "ied":
-                w += "i"
-            break
-    return w
+from dgraph_tpu.models.stemmer import stem as _stem
+from dgraph_tpu.models.stemmer import stopwords as _stopwords
 
 
 def term_tokens(v: Val) -> list[str]:
@@ -76,11 +57,17 @@ def term_tokens(v: Val) -> list[str]:
     return sorted({t for t in _TERM_SPLIT.split(_fold(str(v.value))) if t})
 
 
-def fulltext_tokens(v: Val) -> list[str]:
-    """Ref: tok.FullTextTokenizer — fold, stopword-filter, stem."""
-    toks = {_porter_lite(t)
+def fulltext_tokens(v: Val, lang: str = "") -> list[str]:
+    """Ref: tok.FullTextTokenizer — fold, per-language stopword filter,
+    per-language stem (tok/bleve.go analyzers, tok/langbase.go). The
+    value's @lang tag selects the analyzer at index time; fn.lang
+    (`alloftext(pred@de, ...)`) selects it at query time. Tokens share
+    one namespace like the reference (same Identifier byte for every
+    language)."""
+    stops = _stopwords(lang)
+    toks = {_stem(t, lang)
             for t in _TERM_SPLIT.split(_fold(str(v.value)))
-            if t and t not in _STOPWORDS}
+            if t and t not in stops}
     return sorted(t for t in toks if t)
 
 
@@ -193,9 +180,13 @@ def default_tokenizer_for(tid: TypeID) -> TokenizerSpec | None:
     }.get(tid)
 
 
-def tokens_for(v: Val, spec: TokenizerSpec) -> list:
+def tokens_for(v: Val, spec: TokenizerSpec, lang: str = "") -> list:
     """Tokens for value under tokenizer, converted to the tokenizer's
     input type first (ref posting/index.go:83 addIndexMutations does
-    types.Convert before tokenizing)."""
+    types.Convert before tokenizing). `lang` selects the analyzer for
+    language-aware tokenizers (fulltext only, like the reference's
+    GetTokenizerForLang)."""
     converted = convert(v, spec.for_type)
+    if spec.name == "fulltext":
+        return spec.fn(converted, lang)
     return spec.fn(converted)

@@ -41,6 +41,18 @@ from dgraph_tpu.utils.metrics import inc_counter
 
 _EMPTY = np.empty(0, dtype=np.uint64)
 
+
+def _probe_langs(spec, lang: str) -> list[str]:
+    """Analyzer languages to probe for an index lookup. Only fulltext is
+    language-aware; `@.` (any language) probes every analyzer since the
+    matching value may have been indexed under any of them."""
+    if spec.name != "fulltext":
+        return [""]
+    if lang == ".":
+        from dgraph_tpu.models.stemmer import STEMMERS
+        return list(STEMMERS)
+    return [lang]
+
 _INEQ = {"le", "lt", "ge", "gt", "between"}
 _TERM_FUNCS = {"anyofterms", "allofterms", "anyoftext", "alloftext"}
 
@@ -169,9 +181,18 @@ class Executor:
         uids = _EMPTY
         if gq.uids:
             uids = _union(uids, _np_sorted(gq.uids))
+        func_args = {vc.name for vc in gq.func.needs_var} \
+            if gq.func is not None else set()
         for vc in gq.needs_var:
             if vc.typ != VALUE_VAR and vc.name in self.uid_vars:
                 uids = _union(uids, self.uid_vars[vc.name])
+            elif vc.name in func_args and gq.func.name == "uid" \
+                    and vc.name in self.value_vars \
+                    and vc.name not in self.uid_vars:
+                # uid(valueVar) roots at the uids the var is defined on
+                # (ref query/query.go UidsFromVar)
+                uids = _union(
+                    uids, _np_sorted(self.value_vars[vc.name].keys()))
         if gq.func is not None and gq.func.name != "uid":
             uids = _union(uids, self._eval_func(gq.func, None))
         return uids
@@ -192,6 +213,11 @@ class Executor:
             for vc in fn.needs_var:
                 if vc.name in self.uid_vars:
                     uids = _union(uids, self.uid_vars[vc.name])
+                elif vc.name in self.value_vars:
+                    # uid(valueVar): the uids the var is defined on
+                    # (ref query/query.go UidsFromVar / outputnode uses)
+                    uids = _union(
+                        uids, _np_sorted(self.value_vars[vc.name].keys()))
             return uids if candidates is None \
                 else _intersect(candidates, uids)
         if name == "type":
@@ -216,7 +242,8 @@ class Executor:
                 # val(v) (ref query.go valueVarAggregation semantics)
                 return self._eval_eq_own_val(tab, fn, candidates)
             vals = [Val(TypeID.DEFAULT, a.value) for a in fn.args]
-            return self._eval_eq_tokens(tab, vals, candidates)
+            return self._eval_eq_tokens(tab, vals, candidates,
+                                        fn.lang or "")
         if name in _INEQ:
             return self._eval_ineq(fn, candidates)
         if name in _TERM_FUNCS:
@@ -340,7 +367,7 @@ class Executor:
         return np.asarray(keep, dtype=np.uint64)
 
     def _eval_eq_tokens(self, tab: Optional[Tablet], vals: list[Val],
-                        candidates) -> np.ndarray:
+                        candidates, lang: str = "") -> np.ndarray:
         if tab is None:
             return _EMPTY
         out = _EMPTY
@@ -355,15 +382,20 @@ class Executor:
         if spec is None and tab.schema.indexed:
             spec = get_tokenizer(tab.schema.tokenizers[0])
         if spec is not None:
+            # the query value must be analyzed the same way the indexed
+            # values were: `eq(pred@de, ...)` uses the German analyzer;
+            # `@.` (any language) probes every analyzer's buckets
+            langs = _probe_langs(spec, lang)
             for v in vals:
-                try:
-                    toks = tokens_for(v, spec)
-                except (ValueError, TypeError):
-                    continue
-                for t in toks:
-                    got = tab.index_uids(token_bytes(spec.ident, t),
-                                         self.read_ts)
-                    out = _union(out, got)
+                for lg in langs:
+                    try:
+                        toks = tokens_for(v, spec, lg)
+                    except (ValueError, TypeError):
+                        continue
+                    for t in toks:
+                        got = tab.index_uids(token_bytes(spec.ident, t),
+                                             self.read_ts)
+                        out = _union(out, got)
             if spec.lossy:
                 out = self._verify_eq(tab, out, vals)
             return out if candidates is None else _intersect(candidates, out)
@@ -516,19 +548,25 @@ class Executor:
         toker = "fulltext" if fn.name in ("anyoftext", "alloftext") else "term"
         spec = get_tokenizer(toker)
         text = " ".join(a.value for a in fn.args)
-        toks = tokens_for(Val(TypeID.STRING, text), spec)
-        if not toks:
-            return _EMPTY
-        sets = [tab.index_uids(token_bytes(spec.ident, t), self.read_ts)
-                for t in toks]
-        if fn.name.startswith("all"):
-            out = sets[0]
-            for s in sets[1:]:
-                out = _intersect(out, s)
-        else:
-            out = _EMPTY
-            for s in sets:
-                out = _union(out, s)
+        # `pred@.` (any language): a value matches if it satisfies the
+        # all/any condition under at least one language's analyzer —
+        # per-analyzer evaluation, then union
+        out = _EMPTY
+        for lg in _probe_langs(spec, fn.lang or ""):
+            toks = tokens_for(Val(TypeID.STRING, text), spec, lg)
+            if not toks:
+                continue
+            sets = [tab.index_uids(token_bytes(spec.ident, t), self.read_ts)
+                    for t in toks]
+            if fn.name.startswith("all"):
+                got = sets[0]
+                for s in sets[1:]:
+                    got = _intersect(got, s)
+            else:
+                got = _EMPTY
+                for s in sets:
+                    got = _union(got, s)
+            out = _union(out, got)
         return out if candidates is None else _intersect(candidates, out)
 
     def _eval_regexp(self, fn: Function, candidates) -> np.ndarray:
@@ -584,16 +622,27 @@ class Executor:
         return np.asarray(keep, dtype=np.uint64)
 
     def _eval_uid_in(self, fn: Function, candidates) -> np.ndarray:
-        tab = self._tablet(fn.attr)
+        """uid_in(pred, uids) — also over reverse edges: uid_in(~pred, X)
+        keeps uids that X points at via pred (ref worker/task.go
+        handleUidPostings UidInFn; reverse attrs resolve like any
+        predicate)."""
+        rev = fn.attr.startswith("~")
+        tab = self._tablet(fn.attr[1:] if rev else fn.attr)
         if tab is None:
             return _EMPTY
+        if rev and not tab.schema.reverse:
+            raise GQLError(
+                f"uid_in: no reverse index on {fn.attr[1:]!r} "
+                f"(add @reverse to the schema)")
         targets = set(fn.uids)
         for vc in fn.needs_var:
             targets.update(self.uid_vars.get(vc.name, _EMPTY).tolist())
-        scan = candidates if candidates is not None \
-            else tab.src_uids(self.read_ts)
+        getter = tab.get_reverse_uids if rev else tab.get_dst_uids
+        scan = candidates if candidates is not None else (
+            tab.dst_uids(self.read_ts) if rev
+            else tab.src_uids(self.read_ts))
         keep = [u for u in scan.tolist()
-                if targets & set(tab.get_dst_uids(u, self.read_ts).tolist())]
+                if targets & set(getter(u, self.read_ts).tolist())]
         return np.asarray(keep, dtype=np.uint64)
 
     def _eval_count_fn(self, fn: Function, candidates) -> np.ndarray:
@@ -747,16 +796,26 @@ class Executor:
                 f"(add @reverse to the schema)")
         if tab.schema.value_type == TypeID.UID and not node.reverse or \
                 (node.reverse and tab.schema.reverse):
-            if gq.facets_filter is not None:
-                # @facets(eq(k, v)) drops EDGES, so the union must be
-                # built per-parent (ref worker/task.go:1806
-                # applyFacetsTree — the reference also walks edge-wise)
-                parts = []
+            # one per-parent edge pass serves both the dest union and
+            # every facet-var binding (avoids re-walking high-fanout
+            # edge lists once per facet key)
+            edge_dsts: dict[int, np.ndarray] | None = None
+            if gq.facets_filter is not None or gq.facet_var:
+                edge_dsts = {}
                 for u in src.tolist():
-                    dsts = self._edge_dsts_facet_filtered(
-                        tab, int(u), node.reverse, gq.facets_filter)
-                    if len(dsts):
-                        parts.append(dsts)
+                    if gq.facets_filter is not None:
+                        # @facets(eq(k, v)) drops EDGES, so the union
+                        # must be built per-parent (ref worker/
+                        # task.go:1806 applyFacetsTree, also edge-wise)
+                        dsts = self._edge_dsts_facet_filtered(
+                            tab, int(u), node.reverse, gq.facets_filter)
+                    else:
+                        dsts = (tab.get_reverse_uids(u, self.read_ts)
+                                if node.reverse
+                                else tab.get_dst_uids(u, self.read_ts))
+                    edge_dsts[int(u)] = dsts
+            if gq.facets_filter is not None:
+                parts = [d for d in edge_dsts.values() if len(d)]
                 dest = np.unique(np.concatenate(parts)) if parts \
                     else _EMPTY.copy()
             else:
@@ -765,7 +824,8 @@ class Executor:
                 dest = self._eval_filter(gq.filter, dest)
             node.dest = dest
             if gq.facet_var:
-                self._bind_facet_vars(tab, src, node.reverse, gq)
+                self._bind_facet_vars(tab, src, node.reverse, gq,
+                                      edge_dsts)
             if gq.var:
                 self.uid_vars[gq.var] = dest
             if gq.is_count:
@@ -851,27 +911,23 @@ class Executor:
         raise GQLError(f"bad facet filter node {ft.op!r}")
 
     def _bind_facet_vars(self, tab: Tablet, src: np.ndarray,
-                         reverse: bool, gq: GraphQuery):
+                         reverse: bool, gq: GraphQuery,
+                         edge_dsts: dict[int, np.ndarray]):
         """@facets(v as key): dst uid -> facet value; numeric values
         sum over multiple in-edges (ref query.go valueVarAggregation
-        over facet vars)."""
-        for key, varname in gq.facet_var.items():
-            vmap: dict[int, Val] = {}
-            for u in src.tolist():
-                if gq.facets_filter is not None:
-                    # the block's facet filter drops edges before any
-                    # var binding sees them
-                    dsts = self._edge_dsts_facet_filtered(
-                        tab, int(u), reverse, gq.facets_filter)
-                else:
-                    dsts = (tab.get_reverse_uids(u, self.read_ts)
-                            if reverse
-                            else tab.get_dst_uids(u, self.read_ts))
-                for d in dsts.tolist():
-                    fsrc, fdst = (int(d), u) if reverse else (u, int(d))
-                    fv = tab.get_facets(fsrc, fdst, self.read_ts).get(key)
+        over facet vars). `edge_dsts` is the (already facet-filtered)
+        per-parent edge map built by _process_child — one edge pass
+        binds every key."""
+        vmaps: dict[str, dict[int, Val]] = {k: {} for k in gq.facet_var}
+        for u in src.tolist():
+            for d in edge_dsts.get(int(u), _EMPTY).tolist():
+                fsrc, fdst = (int(d), u) if reverse else (u, int(d))
+                facets = tab.get_facets(fsrc, fdst, self.read_ts)
+                for key in gq.facet_var:
+                    fv = facets.get(key)
                     if fv is None:
                         continue
+                    vmap = vmaps[key]
                     prev = vmap.get(int(d))
                     if prev is not None and isinstance(
                             fv.value, (int, float)) and isinstance(
@@ -880,7 +936,8 @@ class Executor:
                         vmap[int(d)] = Val(fv.tid, prev.value + fv.value)
                     else:
                         vmap[int(d)] = fv
-            self.value_vars[varname] = vmap
+        for key, varname in gq.facet_var.items():
+            self.value_vars[varname] = vmaps[key]
 
     def _child_count(self, tab: Tablet, uid: int, reverse: bool) -> int:
         if reverse:

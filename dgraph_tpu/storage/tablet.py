@@ -104,7 +104,7 @@ class Tablet:
         out = []
         for tname in self.schema.tokenizers:
             spec = get_tokenizer(tname)
-            for t in tokens_for(p.value, spec):
+            for t in tokens_for(p.value, spec, p.lang):
                 out.append(token_bytes(spec.ident, t))
         return out
 
@@ -284,6 +284,21 @@ class Tablet:
             keep = [u for u in out.tolist()
                     if (len(self.get_dst_uids(u, read_ts)) if self.is_uid
                         else len(self.get_postings(u, read_ts)))]
+            out = np.asarray(keep, dtype=np.uint64)
+        return out
+
+    def dst_uids(self, read_ts: int) -> np.ndarray:
+        """All uids appearing as an edge destination — the reverse-side
+        analogue of src_uids (root scans over `~pred`)."""
+        base = set(self.reverse)
+        for op in self._overlay(read_ts):
+            if op.op == "set" and self.is_uid:
+                base.add(op.dst)
+        out = np.fromiter(base, dtype=np.uint64, count=len(base))
+        out.sort()
+        if self.deltas:
+            keep = [u for u in out.tolist()
+                    if len(self.get_reverse_uids(u, read_ts))]
             out = np.asarray(keep, dtype=np.uint64)
         return out
 

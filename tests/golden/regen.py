@@ -1,0 +1,38 @@
+"""Regenerate the committed golden outputs.
+
+    python -m tests.golden.regen            # all queries
+    python -m tests.golden.regen q016 q031  # by prefix
+
+Only run this when an output change is INTENDED — the diff against the
+old goldens is the review surface, exactly like the reference's
+21million suite (systest/21million/queries/).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import tests.conftest  # noqa: F401,E402  (forces the CPU mesh env)
+from tests.golden import runner  # noqa: E402
+
+
+def main(argv: list[str]) -> int:
+    prefixes = tuple(argv) or ("",)
+    os.makedirs(runner.EXPECTED_DIR, exist_ok=True)
+    for name in runner.query_names():
+        if not name.startswith(prefixes):
+            continue
+        out = runner.run_query(name)
+        path = os.path.join(runner.EXPECTED_DIR, name + ".json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=False)
+            f.write("\n")
+        print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,54 @@
+"""Golden conformance harness: load the movie dataset once, run query
+files, JSON-diff against committed goldens.
+
+Mirrors the reference's acceptance suite (systest/21million/
+test-21million.sh, queries/query-0??) at ~1/200 scale: each query in
+`queries/*.gql` has a committed expected output in `expected/*.json`;
+any drift in the query surface fails the diff.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+QUERY_DIR = os.path.join(_DIR, "queries")
+EXPECTED_DIR = os.path.join(_DIR, "expected")
+
+_lock = threading.Lock()
+_db = None
+
+
+def get_db():
+    """Singleton GraphDB loaded with the deterministic movie graph."""
+    global _db
+    with _lock:
+        if _db is None:
+            from dgraph_tpu.engine.db import GraphDB
+
+            from .dataset import generate
+
+            schema, quads = generate()
+            db = GraphDB()
+            db.alter(schema_text=schema)
+            db.mutate(set_nquads="\n".join(quads))
+            _db = db
+    return _db
+
+
+def query_names() -> list[str]:
+    return sorted(f[:-4] for f in os.listdir(QUERY_DIR)
+                  if f.endswith(".gql"))
+
+
+def run_query(name: str) -> dict:
+    with open(os.path.join(QUERY_DIR, name + ".gql")) as f:
+        q = f.read()
+    return get_db().query(q)["data"]
+
+
+def load_expected(name: str) -> dict:
+    with open(os.path.join(EXPECTED_DIR, name + ".json")) as f:
+        return json.load(f)

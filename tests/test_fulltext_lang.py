@@ -1,0 +1,178 @@
+"""Multi-language fulltext: per-language stemmers + stopwords, @lang
+analyzer selection at index and query time.
+
+Ref: tok/bleve.go:22 (per-language analyzers), tok/langbase.go
+(LangBase tag mapping), posting/index.go addIndexMutations (value lang
+selects the tokenizer at index time).
+"""
+
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.models.stemmer import lang_base, porter_en, stem
+
+
+# ---------------------------------------------------------------------------
+# Porter (English)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("word,want", [
+    ("caresses", "caress"),
+    ("ponies", "poni"),
+    ("ties", "ti"),
+    ("caress", "caress"),
+    ("cats", "cat"),
+    ("feed", "feed"),
+    ("agreed", "agre"),
+    ("plastered", "plaster"),
+    ("motoring", "motor"),
+    ("sing", "sing"),
+    ("conflated", "conflat"),
+    ("troubling", "troubl"),
+    ("sized", "size"),
+    ("hopping", "hop"),
+    ("falling", "fall"),
+    ("hissing", "hiss"),
+    ("failing", "fail"),
+    ("happy", "happi"),
+    ("relational", "relat"),
+    ("conditional", "condit"),
+    ("vietnamization", "vietnam"),
+    ("predication", "predic"),
+    ("operator", "oper"),
+    ("feudalism", "feudal"),
+    ("decisiveness", "decis"),
+    ("hopefulness", "hope"),
+    ("formaliti", "formal"),
+    ("triplicate", "triplic"),
+    ("formative", "form"),
+    ("formalize", "formal"),
+    ("electrical", "electr"),
+    ("hopeful", "hope"),
+    ("goodness", "good"),
+    ("revival", "reviv"),
+    ("allowance", "allow"),
+    ("inference", "infer"),
+    ("airliner", "airlin"),
+    ("adjustable", "adjust"),
+    ("defensible", "defens"),
+    ("irritant", "irrit"),
+    ("replacement", "replac"),
+    ("adjustment", "adjust"),
+    ("dependent", "depend"),
+    ("adoption", "adopt"),
+    ("communism", "commun"),
+    ("activate", "activ"),
+    ("angulariti", "angular"),
+    ("homologous", "homolog"),
+    ("effective", "effect"),
+    ("bowdlerize", "bowdler"),
+    ("probate", "probat"),
+    ("rate", "rate"),
+    ("cease", "ceas"),
+    ("controll", "control"),
+    ("roll", "roll"),
+])
+def test_porter_vocabulary(word, want):
+    assert porter_en(word) == want
+
+
+def test_porter_consistency_plural_singular():
+    # the round-1 porter-lite stemmed "tales"->"tal" but "tale"->"tale",
+    # so plural queries could never match singular documents
+    assert porter_en("tales") == porter_en("tale")
+    assert porter_en("queens") == porter_en("queen")
+    assert porter_en("empires") == porter_en("empire")
+
+
+def test_lang_base_mapping():
+    assert lang_base("de") == "de"
+    assert lang_base("de-DE") == "de"
+    assert lang_base("pt_BR") == "pt"
+    assert lang_base("") == "en"
+    assert lang_base("xx") == "en"   # unknown -> default analyzer
+    assert lang_base(".") == "en"
+
+
+def test_light_stemmers_join_inflections():
+    assert stem("hauser", "de") == stem("haus", "de")
+    assert stem("maisons", "fr") == stem("maison", "fr")
+    assert stem("libros", "es") == stem("libro", "es")
+    assert stem("gatti", "it") == stem("gatto", "it")
+    assert stem("livros", "pt") == stem("livro", "pt")
+    assert stem("boeken", "nl") == stem("boek", "nl")
+
+
+# ---------------------------------------------------------------------------
+# Engine end-to-end: @lang postings select the analyzer
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def db():
+    db = GraphDB(prefer_device=False)
+    db.alter("bio: string @index(fulltext) @lang .")
+    db.mutate(set_nquads="""
+<1> <bio> "the tales of burning empires" .
+<2> <bio> "die Geschichten der brennenden Reiche"@de .
+<3> <bio> "les histoires des empires"@fr .
+<4> <bio> "uma historia dos livros"@pt .
+""")
+    return db
+
+
+def _uids(db, q):
+    return sorted(x.get("uid") for x in db.query(q)["data"]["q"])
+
+
+def test_english_stemming_end_to_end(db):
+    out = _uids(db, '{ q(func: alloftext(bio, "tale of empire")) '
+                    '{ uid } }')
+    assert out == ["0x1"]
+
+
+def test_german_analyzer(db):
+    # "Geschichte" stems to the same bucket as "Geschichten" under de
+    out = _uids(db, '{ q(func: alloftext(bio@de, "Geschichte Reich")) '
+                    '{ uid } }')
+    assert out == ["0x2"]
+
+
+def test_french_analyzer(db):
+    out = _uids(db, '{ q(func: alloftext(bio@fr, "histoire empire")) '
+                    '{ uid } }')
+    assert out == ["0x3"]
+
+
+def test_portuguese_analyzer(db):
+    out = _uids(db, '{ q(func: alloftext(bio@pt, "historias livro")) '
+                    '{ uid } }')
+    assert out == ["0x4"]
+
+
+def test_stopwords_ignored(db):
+    # pure-stopword queries match nothing rather than everything
+    out = _uids(db, '{ q(func: alloftext(bio, "the of")) { uid } }')
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# Review regressions: lang-aware eq, any-language (@.) probes
+# ---------------------------------------------------------------------------
+
+
+def test_eq_uses_lang_analyzer(db):
+    # eq's lossy-index prefilter must analyze the query value with the
+    # SAME analyzer the value was indexed under
+    out = _uids(db, '{ q(func: eq(bio@de, '
+                    '"die Geschichten der brennenden Reiche")) { uid } }')
+    assert out == ["0x2"]
+
+
+def test_any_language_alloftext(db):
+    # @. probes every analyzer's buckets
+    out = _uids(db, '{ q(func: alloftext(bio@., "empire")) { uid } }')
+    assert "0x1" in out and "0x3" in out
+    out = _uids(db, '{ q(func: alloftext(bio@., "Geschichte")) { uid } }')
+    assert out == ["0x2"]

@@ -1,0 +1,40 @@
+"""Golden-query conformance suite.
+
+The reference's acceptance bar is a set of canned queries JSON-diffed
+against committed golden outputs over the 21million movie graph
+(systest/21million/test-21million.sh, queries/query-0??). This is the
+same suite at ~1/200 scale: a deterministic movie-shaped dataset
+(tests/golden/dataset.py), 35 queries spanning the whole query surface
+(tests/golden/queries/*.gql), and committed goldens
+(tests/golden/expected/*.json). ANY drift in query output — ordering,
+facet shape, pagination, stemming — fails here.
+
+To intentionally change an output: `python -m tests.golden.regen` and
+review the diff.
+"""
+
+import json
+
+import pytest
+
+from tests.golden import runner
+
+
+@pytest.mark.parametrize("name", runner.query_names())
+def test_golden(name):
+    got = runner.run_query(name)
+    want = runner.load_expected(name)
+    assert got == want, (
+        f"{name} drifted from its golden output.\n"
+        f"got:  {json.dumps(got)[:2000]}\n"
+        f"want: {json.dumps(want)[:2000]}\n"
+        "If the change is intended: python -m tests.golden.regen "
+        f"{name.split('_')[0]}"
+    )
+
+
+def test_every_query_has_a_golden():
+    names = runner.query_names()
+    assert len(names) >= 35
+    for n in names:
+        runner.load_expected(n)  # raises if missing

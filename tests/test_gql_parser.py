@@ -190,3 +190,13 @@ def test_errors():
         parse("{ q(func: has(name)) @filter( { x } }")
     with pytest.raises(GQLError):
         parse("{ ...missing }")
+
+
+def test_regex_literal_preserves_whitespace():
+    # review regression: '/Frozen King/' must keep its interior space,
+    # '/ King/' its leading space
+    from dgraph_tpu.gql.parser import parse
+    p = parse('{ q(func: regexp(name, /Frozen King/)) { name } }')
+    assert p.queries[0].func.args[0].value == "Frozen King"
+    p = parse('{ q(func: regexp(name, / King/)) { name } }')
+    assert p.queries[0].func.args[0].value == " King"
