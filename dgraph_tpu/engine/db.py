@@ -94,12 +94,18 @@ class GraphDB:
     def __init__(self, wal_path: str | None = None,
                  prefer_device: bool = True,
                  device_min_edges: int = 1024,
+                 device_hbm_budget: int = 2 << 30,
                  enc_key: bytes | None = None):
+        from dgraph_tpu.engine.device_cache import DeviceCacheLRU
+
         self.schema = SchemaState()
         self.coordinator = Coordinator()
         self.tablets: dict[str, Tablet] = {}
         self.prefer_device = prefer_device
         self.device_min_edges = device_min_edges
+        # HBM residency budget for device tiles (ref posting/lists.go
+        # LRU bound on cached posting lists)
+        self.device_cache = DeviceCacheLRU(device_hbm_budget)
         self.enc_key = enc_key
         self.wal = Wal(wal_path, key=enc_key) if wal_path else None
         # optional record sink: Raft replication taps the same durable
@@ -115,6 +121,8 @@ class GraphDB:
     def alter(self, schema_text: str = "", drop_all: bool = False,
               drop_attr: str = ""):
         if drop_all:
+            for tab in self.tablets.values():
+                self.device_cache.drop_tablet(tab)
             self.tablets.clear()
             self.schema = SchemaState()
             if self.wal:
@@ -122,7 +130,9 @@ class GraphDB:
             self._log_record(("drop_all",))
             return
         if drop_attr:
-            self.tablets.pop(drop_attr, None)
+            dropped = self.tablets.pop(drop_attr, None)
+            if dropped is not None:
+                self.device_cache.drop_tablet(dropped)
             self.schema.delete_predicate(drop_attr)
             self._log_record(("drop_attr", drop_attr))
             return
@@ -634,4 +644,5 @@ class GraphDB:
                             if gg == g and p in self.tablets}}
                 for g in self.coordinator.groups},
             "schema": self.schema.describe_all(),
+            "deviceCache": self.device_cache.stats(),
         }

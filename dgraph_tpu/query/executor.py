@@ -42,6 +42,22 @@ from dgraph_tpu.utils.metrics import inc_counter
 _EMPTY = np.empty(0, dtype=np.uint64)
 
 
+def _lang_matches(posting_lang: str, query_lang: str) -> bool:
+    """eq(pred@de, v) compares only the @de posting; eq(pred, v) only
+    the untagged one; @. compares any (ref types/facets + worker
+    valueForLang semantics: an explicit tag selects that tag, no tag
+    selects the untagged value)."""
+    if query_lang == ".":
+        return True
+    if not query_lang:
+        return posting_lang == ""
+
+    def base(t):
+        return t.split("-")[0].split("_")[0].casefold()
+
+    return bool(posting_lang) and base(posting_lang) == base(query_lang)
+
+
 def _probe_langs(spec, lang: str) -> list[str]:
     """Analyzer languages to probe for an index lookup. Only fulltext is
     language-aware; `@.` (any language) probes every analyzer since the
@@ -397,13 +413,13 @@ class Executor:
                                              self.read_ts)
                         out = _union(out, got)
             if spec.lossy:
-                out = self._verify_eq(tab, out, vals)
+                out = self._verify_eq(tab, out, vals, lang)
             return out if candidates is None else _intersect(candidates, out)
         # unindexed: value scan over candidates (filter context) or all
         scan = candidates if candidates is not None \
             else tab.src_uids(self.read_ts)
         keep = [u for u in scan.tolist()
-                if self._value_matches_eq(tab, u, vals)]
+                if self._value_matches_eq(tab, u, vals, lang)]
         return np.asarray(keep, dtype=np.uint64)
 
     def _eval_eq_own_val(self, tab, fn: Function, candidates) -> np.ndarray:
@@ -418,14 +434,16 @@ class Executor:
                 if u in vmap and self._value_matches_eq(tab, u, [vmap[u]])]
         return np.asarray(keep, dtype=np.uint64)
 
-    def _verify_eq(self, tab, uids, vals) -> np.ndarray:
+    def _verify_eq(self, tab, uids, vals, lang: str = "") -> np.ndarray:
         keep = [u for u in uids.tolist()
-                if self._value_matches_eq(tab, u, vals)]
+                if self._value_matches_eq(tab, u, vals, lang)]
         return np.asarray(keep, dtype=np.uint64)
 
     def _value_matches_eq(self, tab: Tablet, uid: int,
-                          vals: list[Val]) -> bool:
+                          vals: list[Val], lang: str = "") -> bool:
         for p in tab.get_postings(uid, self.read_ts):
+            if not _lang_matches(p.lang, lang):
+                continue
             for v in vals:
                 try:
                     want = convert(v, self._cmp_type(tab, p))

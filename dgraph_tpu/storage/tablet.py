@@ -84,10 +84,11 @@ class Tablet:
         # delta overlay: ts-ascending op lists
         self.deltas: list[tuple[int, list[EdgeOp]]] = []
         self.max_commit_ts = 0
-        # device snapshot cache (built lazily; see engine)
+        # device snapshot cache (built lazily; see engine/device_cache —
+        # residency is budgeted by the engine's DeviceCacheLRU)
         self._device_adj = None
         self._device_values = None
-        self._device_ts = -1
+        self._device_adj_ts = -1
 
     # -- schema helpers --
     @property
@@ -345,7 +346,7 @@ class Tablet:
             self.base_ts = max(self.base_ts, ts)
         self.deltas = keep
         if folded:
-            self._device_ts = -1  # invalidate device snapshot
+            self._device_adj_ts = -1  # invalidate device snapshot
 
     def _fold(self, op: EdgeOp):
         src = op.src

@@ -60,7 +60,7 @@ def generate() -> tuple[str, list[str]]:
     out: list[str] = []
 
     def add(s, p, o, facets=""):
-        out.append(f"<{s:#x}> <{p}> {o} {facets}.".replace(" .", " ."))
+        out.append(f"<{s:#x}> <{p}> {o} {facets}.")
 
     def name_of(kind, i, rng):
         w = _WORDS[int(rng.integers(len(_WORDS)))]

@@ -1,0 +1,138 @@
+"""HBM residency budget: LRU eviction of device tiles.
+
+Ref: posting/lists.go:156 — the reference bounds posting-list memory
+with an LRU; here the unit is a whole device tile and the budget is
+HBM bytes (engine/device_cache.DeviceCacheLRU).
+"""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.engine.device_cache import device_adjacency
+
+
+def _mkdb(budget, npreds=6, fanout=40, nsrc=40):
+    """Several uid predicates, each big enough for a device tile."""
+    db = GraphDB(device_min_edges=1, device_hbm_budget=budget)
+    db.alter("\n".join(f"p{i}: [uid] ." for i in range(npreds)))
+    lines = []
+    for i in range(npreds):
+        for s in range(1, nsrc + 1):
+            for d in range(fanout):
+                lines.append(f"<{s:#x}> <p{i}> <{0x1000 + (s * 7 + d) % 997:#x}> .")
+    db.mutate(set_nquads="\n".join(lines))
+    db.rollup_all()
+    return db
+
+
+def _build_all(db, npreds):
+    sizes = []
+    for i in range(npreds):
+        tab = db.tablets[f"p{i}"]
+        adj = device_adjacency(db, tab, read_ts=db.coordinator.max_assigned())
+        assert adj is not None
+        key = (id(tab), "_device_adj")
+        sizes.append(db.device_cache._entries[key][2]
+                     if key in db.device_cache._entries else 0)
+    return sizes
+
+
+def test_within_budget_no_eviction():
+    db = _mkdb(budget=1 << 30)
+    _build_all(db, 6)
+    assert db.device_cache.evictions == 0
+    assert len(db.device_cache._entries) == 6
+    assert db.device_cache.bytes <= 1 << 30
+
+
+def test_over_budget_evicts_lru():
+    probe = _mkdb(budget=1 << 30)
+    tile = _build_all(probe, 6)[0]
+    assert tile > 0
+    # budget fits ~3 tiles; building 6 must evict the oldest
+    db = _mkdb(budget=tile * 3 + tile // 2)
+    _build_all(db, 6)
+    assert db.device_cache.evictions >= 3
+    assert db.device_cache.bytes <= db.device_cache.budget
+    # evicted tablets lost their tile refs; newest survivors keep them
+    assert db.tablets["p0"]._device_adj is None
+    assert db.tablets["p5"]._device_adj is not None
+    # stats surface through /state
+    st = db.state()["deviceCache"]
+    assert st["evictions"] == db.device_cache.evictions
+    assert st["bytes"] == db.device_cache.bytes
+
+
+def test_touch_protects_recently_used():
+    probe = _mkdb(budget=1 << 30)
+    tile = _build_all(probe, 6)[0]
+    db = _mkdb(budget=tile * 3 + tile // 2)
+    ts = db.coordinator.max_assigned()
+    _build_all(db, 5)  # p0 was evicted or at LRU head
+    # touch p2 (a survivor), then build p5: p2 must outlive others
+    assert device_adjacency(db, db.tablets["p2"], ts) is not None
+    assert device_adjacency(db, db.tablets["p5"], ts) is not None
+    assert db.tablets["p2"]._device_adj is not None
+
+
+def test_rebuild_after_eviction_is_transparent():
+    probe = _mkdb(budget=1 << 30)
+    tile = _build_all(probe, 6)[0]
+    db = _mkdb(budget=tile * 2 + tile // 2)
+    ts = db.coordinator.max_assigned()
+    _build_all(db, 6)
+    assert db.tablets["p0"]._device_adj is None
+    # re-requesting an evicted tile rebuilds it (and evicts another)
+    adj = device_adjacency(db, db.tablets["p0"], ts)
+    assert adj is not None
+    assert db.tablets["p0"]._device_adj is adj
+
+
+def test_oversized_tile_admitted_alone():
+    probe = _mkdb(budget=1 << 30, npreds=1)
+    tile = _build_all(probe, 1)[0]
+    db = _mkdb(budget=tile // 2, npreds=2)
+    ts = db.coordinator.max_assigned()
+    # a tile larger than the budget still runs on device
+    assert device_adjacency(db, db.tablets["p0"], ts) is not None
+    # but is evicted the moment something else is admitted
+    assert device_adjacency(db, db.tablets["p1"], ts) is not None
+    assert db.tablets["p0"]._device_adj is None
+
+
+def test_drop_all_clears_cache():
+    db = _mkdb(budget=1 << 30)
+    _build_all(db, 6)
+    assert db.device_cache.bytes > 0
+    db.alter(drop_all=True)
+    assert db.device_cache.bytes == 0
+    assert len(db.device_cache._entries) == 0
+
+
+def test_dead_tablet_entries_pruned():
+    # tablets replaced behind the cache's back (restore/snapshot/bulk
+    # paths never call drop_tablet) must not pin budget via the cache
+    db = _mkdb(budget=1 << 30, npreds=2)
+    _build_all(db, 2)
+    before = db.device_cache.bytes
+    assert before > 0
+    db.tablets.clear()  # simulate a wholesale replacement
+    import gc
+    gc.collect()
+    assert db.device_cache.stats()["bytes"] < before
+    assert db.device_cache.stats()["tiles"] == 0
+
+
+def test_eviction_clears_expander_cache():
+    from dgraph_tpu.engine.device_cache import expand_np
+    import numpy as np
+    probe = _mkdb(budget=1 << 30)
+    tile = _build_all(probe, 6)[0]
+    db = _mkdb(budget=tile + tile // 2, npreds=2)
+    ts = db.coordinator.max_assigned()
+    adj0 = device_adjacency(db, db.tablets["p0"], ts)
+    expand_np(adj0, np.array([1], dtype=np.uint64))  # populate expanders
+    assert adj0._expander_cache
+    device_adjacency(db, db.tablets["p1"], ts)  # evicts p0's tile
+    assert not adj0._expander_cache  # cycle broken on eviction

@@ -176,3 +176,21 @@ def test_any_language_alloftext(db):
     assert "0x1" in out and "0x3" in out
     out = _uids(db, '{ q(func: alloftext(bio@., "Geschichte")) { uid } }')
     assert out == ["0x2"]
+
+
+def test_eq_lang_verification_strict(db):
+    # a same-stem collision across languages must not leak through:
+    # eq(pred@de, v) compares only the @de posting (ref worker
+    # valueForLang semantics)
+    db2 = GraphDB(prefer_device=False)
+    db2.alter("w: string @index(fulltext) @lang .")
+    db2.mutate(set_nquads='<1> <w> "apple" .\n<1> <w> "apfel"@de .')
+    out = db2.query('{ q(func: eq(w@de, "apple")) { uid } }')["data"]["q"]
+    assert out == []
+    out = db2.query('{ q(func: eq(w@de, "apfel")) { uid } }')["data"]["q"]
+    assert [x["uid"] for x in out] == ["0x1"]
+    # untagged eq sees only the untagged posting
+    out = db2.query('{ q(func: eq(w, "apfel")) { uid } }')["data"]["q"]
+    assert out == []
+    out = db2.query('{ q(func: eq(w@., "apfel")) { uid } }')["data"]["q"]
+    assert [x["uid"] for x in out] == ["0x1"]
