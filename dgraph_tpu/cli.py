@@ -54,6 +54,41 @@ def cmd_alpha(args) -> int:
     return 0
 
 
+def _parse_peers(spec: str) -> dict[int, tuple[str, int]]:
+    """'1=127.0.0.1:7101,2=127.0.0.1:7102' -> {1: (host, port), ...}"""
+    out: dict[int, tuple[str, int]] = {}
+    for part in spec.split(","):
+        nid, addr = part.split("=", 1)
+        host, port = addr.rsplit(":", 1)
+        out[int(nid)] = (host, int(port))
+    return out
+
+
+def cmd_node(args) -> int:
+    """A Raft replica process: alpha (replicated GraphDB group member)
+    or zero (replicated coordinator quorum member). Ref: dgraph alpha
+    --raft / dgraph zero (worker/draft.go, dgraph/cmd/zero/zero.go)."""
+    from dgraph_tpu.cluster.service import AlphaServer, ZeroServer
+
+    peers = _parse_peers(args.raft_peers)
+    chost, cport = args.client_addr.rsplit(":", 1)
+    storage = None
+    if args.wal:
+        from dgraph_tpu.cluster.raft import DiskStorage
+        storage = DiskStorage(args.wal, sync=args.sync)
+    kw = dict(storage=storage, tick_s=args.tick_ms / 1000.0,
+              election_ticks=args.election_ticks)
+    if args.kind == "alpha":
+        srv = AlphaServer(args.id, peers, (chost, int(cport)), **kw)
+    else:
+        srv = ZeroServer(args.id, peers, (chost, int(cport)), **kw)
+    print(f"dgraph-tpu {args.kind} node {args.id}: raft "
+          f"{peers[args.id]}, client {srv.client_addr}", file=sys.stderr,
+          flush=True)
+    srv.serve_forever()
+    return 0
+
+
 def _enc_key(args):
     if getattr(args, "encryption_key_file", ""):
         from dgraph_tpu.storage.enc import load_key
@@ -353,6 +388,18 @@ def main(argv=None) -> int:
     d.add_argument("--wal", required=True)
     d.add_argument("what", choices=["state", "schema", "histogram"])
     d.set_defaults(fn=cmd_debug)
+
+    n = sub.add_parser("node", help="raft replica (alpha group / zero)")
+    n.add_argument("--kind", choices=["alpha", "zero"], default="alpha")
+    n.add_argument("--id", type=int, required=True)
+    n.add_argument("--raft-peers", required=True,
+                   help="id=host:port,... for every group member")
+    n.add_argument("--client-addr", required=True, help="host:port")
+    n.add_argument("--wal", default="", help="raft storage directory")
+    n.add_argument("--sync", action="store_true")
+    n.add_argument("--tick-ms", type=int, default=50)
+    n.add_argument("--election-ticks", type=int, default=10)
+    n.set_defaults(fn=cmd_node)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,146 @@
+"""Cluster client: leader-following RPC over the wire protocol.
+
+The reference's clients (dgo) dial any Alpha and gRPC routes writes to
+the group leader internally; our server instead answers
+{"ok": False, "leader": id} and the client re-dials — same effect, one
+hop visible. Retries cover elections in progress and nodes that just
+died (conn/pool.go reconnect behavior).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any, Optional
+
+from dgraph_tpu import wire
+
+
+class ClusterClient:
+    """Talks to an Alpha group or a Zero quorum (same protocol)."""
+
+    def __init__(self, addrs: dict[int, tuple[str, int]],
+                 timeout: float = 10.0):
+        self.addrs = dict(addrs)
+        self.timeout = timeout
+        self._conns: dict[int, socket.socket] = {}
+        self._preferred: Optional[int] = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ plumbing
+
+    def _conn(self, node: int) -> Optional[socket.socket]:
+        sock = self._conns.get(node)
+        if sock is not None:
+            return sock
+        try:
+            sock = socket.create_connection(self.addrs[node], timeout=2.0)
+            sock.settimeout(self.timeout)
+        except OSError:
+            return None
+        self._conns[node] = sock
+        return sock
+
+    def _drop(self, node: int):
+        sock = self._conns.pop(node, None)
+        if sock is not None:
+            sock.close()
+
+    def _rpc_once(self, node: int, req: dict) -> Optional[dict]:
+        sock = self._conn(node)
+        if sock is None:
+            return None
+        try:
+            wire.write_frame(sock, wire.dumps(req))
+            return wire.loads(wire.read_frame(sock))
+        except (OSError, EOFError, wire.WireError):
+            self._drop(node)
+            return None
+
+    def request(self, req: dict, deadline_s: Optional[float] = None) -> dict:
+        """Route to the leader, following hints and retrying through
+        elections until the deadline."""
+        deadline = time.monotonic() + (deadline_s or self.timeout)
+        with self._lock:
+            last_err = "unreachable"
+            while time.monotonic() < deadline:
+                order = [n for n in
+                         ([self._preferred] + sorted(self.addrs))
+                         if n is not None]
+                seen = set()
+                for node in order:
+                    if node in seen or node not in self.addrs:
+                        continue
+                    seen.add(node)
+                    resp = self._rpc_once(node, req)
+                    if resp is None:
+                        continue
+                    if resp.get("ok"):
+                        self._preferred = node
+                        return resp
+                    if resp.get("error") == "not leader":
+                        hint = resp.get("leader")
+                        if hint is not None and hint != node \
+                                and hint in self.addrs:
+                            self._preferred = hint
+                            hinted = self._rpc_once(hint, req)
+                            if hinted is not None and hinted.get("ok"):
+                                return hinted
+                        continue
+                    return resp  # real application error: surface it
+                last_err = "no leader reachable"
+                time.sleep(0.1)
+            return {"ok": False, "error": last_err}
+
+    def close(self):
+        with self._lock:
+            for sock in self._conns.values():
+                sock.close()
+            self._conns.clear()
+
+    # ------------------------------------------------------- alpha surface
+
+    def query(self, q: str, variables: Optional[dict] = None) -> dict:
+        return self._unwrap(self.request(
+            {"op": "query", "q": q, "vars": variables}))
+
+    def mutate(self, **kw) -> dict:
+        return self._unwrap(self.request({"op": "mutate", "kw": kw}))
+
+    def alter(self, schema_text: str = "", **kw) -> dict:
+        kw["schema_text"] = schema_text
+        return self._unwrap(self.request({"op": "alter", "kw": kw}))
+
+    def status(self, node: Optional[int] = None) -> dict:
+        if node is not None:
+            with self._lock:
+                resp = self._rpc_once(node, {"op": "status"})
+            if resp is None:
+                raise ConnectionError(f"node {node} unreachable")
+            return resp["result"]
+        return self._unwrap(self.request({"op": "status"}))
+
+    # -------------------------------------------------------- zero surface
+
+    def assign_ts(self, n: int = 1) -> int:
+        return self._unwrap(self.request(
+            {"op": "assign_ts", "args": (n,)}))
+
+    def assign_uids(self, n: int) -> int:
+        return self._unwrap(self.request(
+            {"op": "assign_uids", "args": (n,)}))
+
+    def commit(self, start_ts: int, keys: list[int]) -> int:
+        return self._unwrap(self.request(
+            {"op": "commit", "args": (start_ts, list(keys))}))
+
+    def tablet(self, pred: str, group: int) -> int:
+        return self._unwrap(self.request(
+            {"op": "tablet", "args": (pred, group)}))
+
+    @staticmethod
+    def _unwrap(resp: dict) -> Any:
+        if not resp.get("ok"):
+            raise RuntimeError(resp.get("error", "rpc failed"))
+        return resp["result"]

@@ -26,6 +26,22 @@ from __future__ import annotations
 
 import pickle
 import random
+
+from dgraph_tpu.wire import WIRE_VERSION
+from dgraph_tpu.wire import dumps as wire_dumps
+from dgraph_tpu.wire import loads as wire_loads
+
+
+def _wire_load(blob: bytes):
+    """Wire-encoded (version-tagged) with a pickle fallback for
+    stores written before the wire format existed (PROTO opcode
+    0x80)."""
+    if blob[:1] == bytes([WIRE_VERSION]):
+        return wire_loads(blob)
+    if blob[:1] == b"\x80":
+        return pickle.loads(blob)
+    raise IOError("unrecognized raft storage encoding")
+
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -129,20 +145,20 @@ class DiskStorage(MemoryStorage):
             self._kv = PyKV(directory, sync)
         hs = self._kv.get(b"hs")
         if hs is not None:
-            self.term, self.voted_for = pickle.loads(hs)
+            self.term, self.voted_for = _wire_load(hs)
         sn = self._kv.get(b"snap")
         if sn is not None:
             self.snap_index, self.snap_term, self.snap_data = \
-                pickle.loads(sn)
+                _wire_load(sn)
         for k, v in self._kv.scan(b"e/"):
-            e = pickle.loads(v)
+            e = _wire_load(v)
             if e.index > self.snap_index:
                 self.entries.append(e)
         self.entries.sort(key=lambda e: e.index)
 
     def save_hardstate(self, term, voted_for):
         super().save_hardstate(term, voted_for)
-        self._kv.put(b"hs", pickle.dumps((term, voted_for)))
+        self._kv.put(b"hs", wire_dumps((term, voted_for)))
 
     def append(self, entries):
         if not entries:
@@ -151,7 +167,7 @@ class DiskStorage(MemoryStorage):
             else self.snap_index
         super().append(entries)
         for e in entries:
-            self._kv.put(b"e/%016x" % e.index, pickle.dumps(e))
+            self._kv.put(b"e/%016x" % e.index, wire_dumps(e))
         # conflict truncation shrank the log: stale persisted entries
         # above the new tail must go too, or a restart resurrects a
         # deposed leader's discarded suffix
@@ -162,7 +178,7 @@ class DiskStorage(MemoryStorage):
         # persist the snapshot record FIRST: a crash between the two
         # steps must never leave neither entries nor snapshot (recovery
         # skips log keys <= snap_index anyway)
-        self._kv.put(b"snap", pickle.dumps((index, term, data)))
+        self._kv.put(b"snap", wire_dumps((index, term, data)))
         # then drop log keys below it, like raftwal truncation
         # (raftwal/storage.go:594 CreateSnapshot)
         for k, _ in list(self._kv.scan(b"e/")):

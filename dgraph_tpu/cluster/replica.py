@@ -31,8 +31,9 @@ transport swaps in at the Msg layer without touching this file.
 from __future__ import annotations
 
 import itertools
-import pickle
 from typing import Any, Optional
+
+from dgraph_tpu import wire
 
 from dgraph_tpu.cluster.harness import SimCluster
 from dgraph_tpu.engine.db import GraphDB
@@ -80,7 +81,7 @@ class ReplicatedGroup:
         """InstallSnapshot: rebuild the replica's engine from the
         serialized state (ref worker/snapshot.go populateSnapshot)."""
         self._events[node_id] = [("snap", snap)]
-        self.dbs[node_id] = restore_state(pickle.loads(snap),
+        self.dbs[node_id] = restore_state(wire.loads_compat(snap),
                                           GraphDB(**self._db_kw))
 
     def _rebuild(self, node_id: int):
@@ -93,7 +94,7 @@ class ReplicatedGroup:
         db = GraphDB(**self._db_kw)
         for kind, payload in self._events[node_id]:
             if kind == "snap":
-                db = restore_state(pickle.loads(payload), db)
+                db = restore_state(wire.loads_compat(payload), db)
             else:
                 ts = db.apply_record(payload)
                 if ts:
@@ -168,8 +169,7 @@ class ReplicatedGroup:
         """Compact the Raft log into an engine snapshot on `node`
         (default: leader). Ref worker/draft.go:1206 calculateSnapshot."""
         node = node if node is not None else self.leader_id()
-        snap = pickle.dumps(dump_state(self.dbs[node]),
-                            protocol=pickle.HIGHEST_PROTOCOL)
+        snap = wire.dumps(dump_state(self.dbs[node]))
         self.cluster.nodes[node].take_snapshot(snap)
 
     # ---------------------------------------------------------- failures

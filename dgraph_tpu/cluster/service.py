@@ -1,0 +1,390 @@
+"""Networked Raft services: Alpha groups and the Zero quorum as real
+processes on real sockets.
+
+The reference runs every shard as a Raft group inside an Alpha process
+(worker/draft.go Run loop pumping etcd raft Ready) and the cluster
+coordinator as its own Raft quorum inside Zero (dgraph/cmd/zero/
+raft.go:619, zero.go:410). This module is that tier: `RaftServer` owns
+a RaftNode, a TcpTransport (cluster/transport.py), a wall-clock tick
+loop and a client RPC listener; `AlphaServer` replicates a GraphDB
+through it (leader executes, expanded records replicate — the
+worker/mutation.go:537 MutateOverNetwork shape), `ZeroServer`
+replicates the coordinator state machine (ts/uid leases + conflict
+oracle — zero/assign.go, zero/oracle.go).
+
+Client protocol: wire-framed request/response dicts. Writes must land
+on the leader; a follower answers {"ok": False, "leader": id} and the
+client re-dials (ref conn/pool.go + dgo's leader routing).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Optional
+
+from dgraph_tpu import wire
+from dgraph_tpu.cluster.raft import LEADER, RaftNode
+from dgraph_tpu.cluster.transport import TcpTransport
+
+import socket
+
+
+class RaftServer:
+    """A Raft replica process: tick thread + transport + client RPC.
+
+    Subclasses define the replicated state machine:
+      - sm_apply(origin, payload) -> Any   (every committed entry)
+      - sm_snapshot() -> Any / sm_restore(Any)
+      - handle_request(req) -> dict        (client RPC dispatch)
+    """
+
+    def __init__(self, node_id: int,
+                 raft_peers: dict[int, tuple[str, int]],
+                 client_addr: tuple[str, int],
+                 storage=None, tick_s: float = 0.05,
+                 election_ticks: int = 10,
+                 snapshot_every: int = 2048):
+        self.id = node_id
+        self.node = RaftNode(node_id, list(raft_peers), storage=storage,
+                             election_ticks=election_ticks)
+        self.lock = threading.RLock()
+        self.applied_cv = threading.Condition(self.lock)
+        self.tick_s = tick_s
+        self.snapshot_every = snapshot_every
+        self._applied_since_snap = 0
+        self._mark_seq = itertools.count(1)
+        self._acked: dict[tuple, Any] = {}
+        self.epoch = int(time.time() * 1000) % (1 << 40)
+        self._stop = threading.Event()
+        self.transport = TcpTransport(node_id, raft_peers, self._on_msg)
+
+        self._client_listener = socket.socket(
+            socket.AF_INET, socket.SOCK_STREAM)
+        self._client_listener.setsockopt(
+            socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._client_listener.bind(client_addr)
+        self._client_listener.listen(64)
+        self.client_addr = self._client_listener.getsockname()
+
+        self._threads = [
+            threading.Thread(target=self._tick_loop, daemon=True,
+                             name=f"raft-tick-{node_id}"),
+            threading.Thread(target=self._client_accept_loop, daemon=True,
+                             name=f"client-accept-{node_id}"),
+        ]
+
+        # restore-from-disk snapshot surfaces on the first ready();
+        # only then open the floodgates (transport.start) so no inbound
+        # message races construction
+        with self.lock:
+            out = self._drain_ready()
+        self.transport.start()
+        self._send_all(out)
+        for t in self._threads:
+            t.start()
+
+    # ----------------------------------------------------------- raft side
+
+    def _on_msg(self, msg):
+        with self.lock:
+            if self._stop.is_set():
+                return
+            self.node.step(msg)
+            out = self._drain_ready()
+        self._send_all(out)
+
+    def _tick_loop(self):
+        while not self._stop.wait(self.tick_s):
+            with self.lock:
+                self.node.tick()
+                out = self._drain_ready()
+            self._send_all(out)
+
+    def _drain_ready(self) -> list:
+        """Apply committed state under the lock; RETURN outbound msgs.
+        Sends happen outside the lock — a TCP dial to a dead peer can
+        block ~1s, and stalling ticks that long would trip healthy
+        followers' election timers."""
+        r = self.node.ready()
+        if r.snapshot is not None:
+            self.sm_restore(r.snapshot[2])
+            self._acked.clear()
+        for e in r.committed:
+            if e.data is None:
+                continue
+            mark, origin, payload = e.data
+            result = self.sm_apply(origin, payload)
+            self._acked[mark] = result
+            self._applied_since_snap += 1
+            self.applied_cv.notify_all()
+        if self._applied_since_snap >= self.snapshot_every:
+            self._applied_since_snap = 0
+            self.node.take_snapshot(self.sm_snapshot())
+        return r.msgs
+
+    def _send_all(self, msgs: list):
+        for m in msgs:
+            self.transport.send(m)
+
+    def propose_and_wait(self, payload: Any,
+                         timeout: float = 5.0) -> tuple[bool, Any]:
+        """Propose on this node (must be leader); wait until the entry
+        applies locally. -> (committed, apply result)."""
+        mark = (self.id, self.epoch, next(self._mark_seq))
+        with self.lock:
+            if not self.node.propose((mark, (self.id, self.epoch),
+                                      payload)):
+                return False, None
+            out = self._drain_ready()
+        self._send_all(out)
+        with self.lock:
+            deadline = time.monotonic() + timeout
+            while mark not in self._acked:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stop.is_set():
+                    return False, None
+                self.applied_cv.wait(remaining)
+            return True, self._acked[mark]
+
+    def is_leader(self) -> bool:
+        with self.lock:
+            return self.node.role == LEADER
+
+    def leader_hint(self) -> Optional[int]:
+        with self.lock:
+            return self.node.leader_id
+
+    # --------------------------------------------------------- client side
+
+    def _client_accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._client_listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._client_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _client_loop(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                req = wire.loads(wire.read_frame(conn))
+                try:
+                    resp = self.handle_request(req)
+                except NotLeader as e:
+                    resp = {"ok": False, "error": "not leader",
+                            "leader": e.leader}
+                except Exception as e:  # surface, don't kill the conn
+                    resp = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"}
+                wire.write_frame(conn, wire.dumps(resp))
+        except (EOFError, OSError, wire.WireError):
+            pass
+        finally:
+            conn.close()
+
+    # ----------------------------------------------------------- lifecycle
+
+    def close(self):
+        self._stop.set()
+        self.transport.close()
+        try:
+            self._client_listener.close()
+        except OSError:
+            pass
+        with self.lock:
+            self.applied_cv.notify_all()
+
+    def serve_forever(self):
+        try:
+            while not self._stop.wait(0.5):
+                pass
+        except KeyboardInterrupt:
+            self.close()
+
+    # ----------------------------------------------- state machine (abstract)
+
+    def sm_apply(self, origin, payload) -> Any:
+        raise NotImplementedError
+
+    def sm_snapshot(self) -> Any:
+        raise NotImplementedError
+
+    def sm_restore(self, snap: Any) -> None:
+        raise NotImplementedError
+
+    def handle_request(self, req: dict) -> dict:
+        raise NotImplementedError
+
+
+class NotLeader(Exception):
+    def __init__(self, leader: Optional[int]):
+        super().__init__("not leader")
+        self.leader = leader
+
+
+class AlphaServer(RaftServer):
+    """A replicated GraphDB group member (the worker/draft.go role).
+
+    Writes execute on the leader's engine — allocating ts/uids and
+    producing expanded commit records via the on_record sink — then each
+    record replicates through Raft; followers apply it verbatim
+    (worker/mutation.go expand-then-propose shape). If quorum is lost
+    mid-write the leader rebuilds its engine from the committed event
+    stream so it never serves un-replicated state.
+    """
+
+    def __init__(self, node_id: int, raft_peers, client_addr,
+                 storage=None, db_kw: Optional[dict] = None, **kw):
+        from dgraph_tpu.engine.db import GraphDB
+
+        self._db_kw = dict(db_kw or {})
+        self._db_kw.setdefault("prefer_device", False)
+        self.db = GraphDB(**self._db_kw)
+        # committed event stream: authoritative rebuild source
+        self._events: list[tuple] = []
+        # serializes execute+propose so the log's record order matches
+        # the leader engine's execution order (followers must apply
+        # deltas in commit-ts order)
+        self._write_lock = threading.Lock()
+        super().__init__(node_id, raft_peers, client_addr,
+                         storage=storage, **kw)
+
+    # -------------------------------------------------------- state machine
+
+    def sm_apply(self, origin, rec) -> int:
+        self._events.append(("rec", rec))
+        if origin == (self.id, self.epoch):
+            return 0  # leader pre-applied while executing the txn
+        ts = self.db.apply_record(rec)
+        if ts:
+            self.db.fast_forward_ts(ts)
+        return 0
+
+    def sm_snapshot(self):
+        from dgraph_tpu.storage.snapshot import dump_state
+        snap = wire.dumps(dump_state(self.db))
+        self._events = [("snap", snap)]
+        return snap
+
+    def sm_restore(self, snap: bytes):
+        from dgraph_tpu.engine.db import GraphDB
+        from dgraph_tpu.storage.snapshot import restore_state
+        self._events = [("snap", snap)]
+        self.db = restore_state(wire.loads_compat(snap), GraphDB(**self._db_kw))
+
+    def _rebuild_from_events(self):
+        """Quorum lost mid-write: discard un-replicated local state
+        (the deposed-leader-drops-uncommitted-tail analogue)."""
+        from dgraph_tpu.engine.db import GraphDB
+        from dgraph_tpu.storage.snapshot import restore_state
+        self.epoch += 1  # own-origin records must re-apply from now on
+        db = GraphDB(**self._db_kw)
+        for kind, payload in self._events:
+            if kind == "snap":
+                db = restore_state(wire.loads_compat(payload), db)
+            else:
+                ts = db.apply_record(payload)
+                if ts:
+                    db.fast_forward_ts(ts)
+        self.db = db
+
+    # --------------------------------------------------------------- writes
+
+    def _replicate_write(self, fn) -> Any:
+        with self._write_lock:
+            with self.lock:
+                if self.node.role != LEADER:
+                    raise NotLeader(self.node.leader_id)
+                captured: list = []
+                prev = self.db.on_record
+                self.db.on_record = captured.append
+                try:
+                    result = fn(self.db)
+                finally:
+                    self.db.on_record = prev
+            for rec in captured:
+                ok, _ = self.propose_and_wait(rec)
+                if not ok:
+                    with self.lock:
+                        self._rebuild_from_events()
+                    raise RuntimeError(
+                        "write not replicated (no quorum)")
+            return result
+
+    # ----------------------------------------------------------------- RPC
+
+    def handle_request(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "query":
+            # any replica serves snapshot reads (edgraph/server.go:760
+            # best-effort queries); under the lock because the apply /
+            # restore threads mutate and rebind self.db
+            with self.lock:
+                out = self.db.query(req["q"], variables=req.get("vars"))
+            return {"ok": True, "result": out}
+        if op == "mutate":
+            out = self._replicate_write(
+                lambda db: db.mutate(commit_now=True, **req["kw"]))
+            return {"ok": True, "result": out}
+        if op == "alter":
+            self._replicate_write(lambda db: db.alter(**req["kw"]))
+            return {"ok": True, "result": {}}
+        if op == "status":
+            with self.lock:
+                return {"ok": True, "result": {
+                    "id": self.id, "role": self.node.role,
+                    "leader": self.node.leader_id,
+                    "term": self.node.term,
+                    "applied": self.node.applied_index,
+                    "max_ts": self.db.coordinator.max_assigned()}}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+class ZeroServer(RaftServer):
+    """The replicated coordinator quorum (dgraph/cmd/zero).
+
+    Unlike the Alpha group, commands execute AT APPLY TIME on every
+    replica — the state machine is deterministic, so each member
+    computes identical results and the proposer reads its local apply
+    result (zero/raft.go:619 applyProposal over the oracle/leases).
+    """
+
+    def __init__(self, node_id: int, raft_peers, client_addr,
+                 storage=None, **kw):
+        from dgraph_tpu.cluster.zero import ZeroState
+        self.state = ZeroState()
+        super().__init__(node_id, raft_peers, client_addr,
+                         storage=storage, **kw)
+
+    def sm_apply(self, origin, cmd) -> Any:
+        return self.state.apply(cmd)
+
+    def sm_snapshot(self):
+        return self.state.snapshot()
+
+    def sm_restore(self, snap):
+        from dgraph_tpu.cluster.zero import ZeroState
+        self.state = ZeroState.from_snapshot(snap)
+
+    def handle_request(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "status":
+            with self.lock:
+                return {"ok": True, "result": {
+                    "id": self.id, "role": self.node.role,
+                    "leader": self.node.leader_id,
+                    "max_ts": self.state.max_ts,
+                    "next_uid": self.state.next_uid}}
+        if op in ("assign_ts", "assign_uids", "commit", "tablet"):
+            with self.lock:
+                if self.node.role != LEADER:
+                    raise NotLeader(self.node.leader_id)
+            ok, result = self.propose_and_wait(
+                (op, req.get("args", ())))
+            if not ok:
+                return {"ok": False, "error": "no quorum"}
+            return {"ok": True, "result": result}
+        return {"ok": False, "error": f"unknown op {op!r}"}

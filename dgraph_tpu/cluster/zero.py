@@ -1,0 +1,75 @@
+"""Zero state machine: replicated timestamps, uid leases, conflict
+oracle and tablet map.
+
+The reference's Zero keeps this state behind its own Raft quorum
+(dgraph/cmd/zero/raft.go:619 applyProposal, assign.go:64 lease blocks,
+oracle.go commit decisions, tablet.go:62 tablet claims). ZeroState is
+that state machine extracted: every command is deterministic, so each
+quorum member applies it identically and the proposer reads its local
+apply result — no leader-only state.
+
+Commands (payload = (op, args)):
+  ("assign_ts",  (n,))                -> first ts of a block of n
+  ("assign_uids",(n,))                -> first uid of a lease of n
+  ("commit",     (start_ts, keys))    -> commit_ts, or 0 = conflict abort
+  ("tablet",     (pred, group))       -> owning group id (first claim wins)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class ZeroState:
+    def __init__(self):
+        self.max_ts = 0
+        self.next_uid = 1
+        # conflict window: key fingerprint -> last commit_ts
+        # (zero/oracle.go commits map)
+        self.commits: dict[int, int] = {}
+        self.tablets: dict[str, int] = {}
+
+    # ------------------------------------------------------------- apply
+
+    def apply(self, cmd: tuple) -> Any:
+        op, args = cmd
+        if op == "assign_ts":
+            (n,) = args
+            first = self.max_ts + 1
+            self.max_ts += int(n)
+            return first
+        if op == "assign_uids":
+            (n,) = args
+            first = self.next_uid
+            self.next_uid += int(n)
+            return first
+        if op == "commit":
+            start_ts, keys = args
+            for k in keys:
+                if self.commits.get(int(k), 0) > start_ts:
+                    return 0  # write-write conflict: abort
+            self.max_ts += 1
+            commit_ts = self.max_ts
+            for k in keys:
+                self.commits[int(k)] = commit_ts
+            return commit_ts
+        if op == "tablet":
+            pred, group = args
+            return self.tablets.setdefault(pred, int(group))
+        raise ValueError(f"unknown zero command {op!r}")
+
+    # --------------------------------------------------------- snapshots
+
+    def snapshot(self) -> dict:
+        return {"max_ts": self.max_ts, "next_uid": self.next_uid,
+                "commits": dict(self.commits),
+                "tablets": dict(self.tablets)}
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "ZeroState":
+        st = cls()
+        st.max_ts = snap["max_ts"]
+        st.next_uid = snap["next_uid"]
+        st.commits = dict(snap["commits"])
+        st.tablets = dict(snap["tablets"])
+        return st

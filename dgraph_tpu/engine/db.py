@@ -96,7 +96,7 @@ class GraphDB:
                  device_min_edges: int = 1024,
                  device_hbm_budget: int = 2 << 30,
                  enc_key: bytes | None = None):
-        from dgraph_tpu.engine.device_cache import DeviceCacheLRU
+        from dgraph_tpu.engine.tile_cache import DeviceCacheLRU
 
         self.schema = SchemaState()
         self.coordinator = Coordinator()

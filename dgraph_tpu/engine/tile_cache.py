@@ -1,0 +1,125 @@
+"""HBM residency budget for per-tablet device tiles (LRU).
+
+Separated from engine/device_cache.py so the engine can be constructed
+without importing jax/XLA at all — node-server processes that run with
+prefer_device=False (cluster replicas, CLI tools) must not pay the XLA
+startup cost. Device byte accounting therefore duck-types on `.nbytes`
+instead of isinstance(jax.Array).
+
+Ref: posting/lists.go:156 — the reference bounds posting-list memory
+with an LRU; here the unit of residency is a whole tile and the budget
+is HBM bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import weakref as _weakref
+from collections import OrderedDict
+
+import numpy as np
+
+from dgraph_tpu.utils.metrics import inc_counter, set_gauge
+
+
+def _hbm_bytes(obj) -> int:
+    """Device bytes held by a tile structure: every device array
+    reachable through dataclass fields. Host numpy side-tables don't
+    count against the HBM budget; anything else exposing .nbytes is a
+    device buffer (jax.Array, without importing jax here)."""
+    if isinstance(obj, np.ndarray):
+        return 0
+    if hasattr(obj, "nbytes") and not dataclasses.is_dataclass(obj):
+        return int(obj.nbytes)
+    if isinstance(obj, (list, tuple)):
+        return sum(_hbm_bytes(x) for x in obj)
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return sum(_hbm_bytes(getattr(obj, f.name))
+                   for f in dataclasses.fields(obj))
+    return 0
+
+
+class DeviceCacheLRU:
+    """HBM residency budget for per-tablet device tiles.
+
+    Inserting past the budget evicts the least-recently-used tiles —
+    eviction drops the tablet's attribute refs so XLA frees the buffers
+    once in-flight work releases them (no hard .delete(): a kernel may
+    still hold the tile this step).
+
+    A tile larger than the whole budget is still admitted alone (the
+    query would otherwise never run on device); it is evicted as soon
+    as anything else is admitted.
+    """
+
+    def __init__(self, budget_bytes: int):
+        self.budget = int(budget_bytes)
+        # (tablet id, attr) -> (weakref(tablet), attr, nbytes);
+        # insertion order is recency order (move_to_end on touch).
+        # Weak refs: tablets can also disappear through WAL replay,
+        # restore, snapshot install or bulk merge (paths that never call
+        # drop_tablet) — dead entries are pruned lazily so their bytes
+        # never pin the budget.
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self.bytes = 0
+        self.evictions = 0
+
+    def touch(self, tab, attr: str):
+        key = (id(tab), attr)
+        if key in self._entries:
+            self._entries.move_to_end(key)
+
+    def put(self, tab, attr: str, obj) -> None:
+        self._prune_dead()
+        key = (id(tab), attr)
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self.bytes -= old[2]
+        nbytes = _hbm_bytes(obj)
+        self._entries[key] = (_weakref.ref(tab), attr, nbytes)
+        self.bytes += nbytes
+        while self.bytes > self.budget and len(self._entries) > 1:
+            self._evict_lru()
+        self._set_gauges()
+
+    def _prune_dead(self):
+        dead = [k for k, (ref, _, _) in self._entries.items()
+                if ref() is None]
+        for k in dead:
+            self.bytes -= self._entries.pop(k)[2]
+
+    def _evict_lru(self):
+        _, (ref, attr, nbytes) = self._entries.popitem(last=False)
+        self.bytes -= nbytes
+        self.evictions += 1
+        inc_counter("device_cache_evictions")
+        tab = ref()
+        if tab is None:
+            return
+        obj = getattr(tab, attr, None)
+        if obj is not None:
+            # jitted expanders close over the adjacency (a ref cycle);
+            # clear them so the HBM buffers free without waiting for a
+            # cyclic-GC pass
+            cache = getattr(obj, "_expander_cache", None)
+            if cache:
+                cache.clear()
+            setattr(tab, attr, None)
+            setattr(tab, attr + "_ts", -1)
+
+    def drop_tablet(self, tab):
+        """Forget every tile of a tablet (explicit drop paths; implicit
+        removals are covered by the weak refs)."""
+        for key in [k for k in self._entries if k[0] == id(tab)]:
+            _, _, nbytes = self._entries.pop(key)
+            self.bytes -= nbytes
+        self._set_gauges()
+
+    def _set_gauges(self):
+        set_gauge("device_cache_bytes", self.bytes)
+        set_gauge("device_cache_tiles", len(self._entries))
+
+    def stats(self) -> dict:
+        self._prune_dead()
+        return {"bytes": self.bytes, "tiles": len(self._entries),
+                "budget": self.budget, "evictions": self.evictions}

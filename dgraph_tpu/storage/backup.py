@@ -9,7 +9,7 @@ Our unit of incremental change is the tablet: a backup serializes every
 tablet whose max_commit_ts (or base_ts, post-rollup) moved past the
 chain's last read_ts, plus the schema and coordinator watermarks.
 Restore folds the chain newest-wins per tablet. Artifacts are
-gzip-pickles, optionally sealed with AES-GCM (storage/enc.py).
+gzip-compressed wire payloads, optionally sealed with AES-GCM (storage/enc.py).
 
 URI handlers: file paths and file:// work everywhere; s3://, minio://
 raise a clear error in this build (no object-store egress) while
@@ -21,7 +21,6 @@ from __future__ import annotations
 import gzip
 import json
 import os
-import pickle
 import time
 from typing import Optional
 from urllib.parse import urlparse
@@ -89,8 +88,8 @@ def backup(db, dest: str, force_full: bool = False,
     dropped = sorted(chain_preds - set(db.tablets))
 
     name = f"backup-{since}-{read_ts}.gz"
-    blob = gzip.compress(pickle.dumps(payload,
-                                      protocol=pickle.HIGHEST_PROTOCOL))
+    from dgraph_tpu import wire
+    blob = gzip.compress(wire.dumps(payload))
     with open(os.path.join(dirpath, name), "wb") as f:
         f.write(encrypt_blob(blob, key))
     entry = {"type": "full" if since == 0 else "incremental",
@@ -123,7 +122,8 @@ def restore(dest: str, db=None, key: Optional[bytes] = None):
     for entry in chain:
         with open(os.path.join(dirpath, entry["file"]), "rb") as f:
             raw = f.read()
-        payload = pickle.loads(gzip.decompress(decrypt_blob(raw, key)))
+        from dgraph_tpu.storage.snapshot import _load_payload
+        payload = _load_payload(gzip.decompress(decrypt_blob(raw, key)))
         db.alter(payload["schema"])
         for pred, st in payload["tablets"].items():
             ps = db.schema.get_or_default(pred)

@@ -14,6 +14,15 @@ import gzip
 import os
 import pickle
 
+
+def _load_payload(blob: bytes):
+    """Wire-encoded (version byte 0x01) with pickle fallback for files
+    written before the wire format existed (PROTO opcode 0x80)."""
+    from dgraph_tpu import wire
+    if blob[:1] == bytes([wire.WIRE_VERSION]):
+        return wire.loads(blob)
+    return pickle.loads(blob)
+
 SNAPSHOT_MAGIC = b"DGTPU-SNAP-1"
 
 
@@ -67,9 +76,10 @@ def save_snapshot(db, path: str):
     """Write the rolled-up store to one file."""
     payload = dump_state(db)
     tmp = path + ".tmp"
+    from dgraph_tpu import wire
     with gzip.open(tmp, "wb") as f:
         f.write(SNAPSHOT_MAGIC)
-        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.write(wire.dumps(payload))
     os.replace(tmp, path)
 
 
@@ -79,5 +89,5 @@ def load_snapshot(path: str, db=None):
         magic = f.read(len(SNAPSHOT_MAGIC))
         if magic != SNAPSHOT_MAGIC:
             raise ValueError(f"{path!r} is not a dgraph-tpu snapshot")
-        payload = pickle.load(f)
+        payload = _load_payload(f.read())
     return restore_state(payload, db)

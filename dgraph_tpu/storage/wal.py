@@ -109,6 +109,19 @@ class _PyWal:
         self._f.close()
 
 
+def _decode_record(blob: bytes) -> Any:
+    """Records are wire-encoded (dgraph_tpu.wire, version-tagged first
+    byte); stores written before the wire format used pickle, whose
+    payloads start with the PROTO opcode 0x80 — replay those too so an
+    upgrade never bricks a WAL."""
+    from dgraph_tpu.wire import WIRE_VERSION, loads
+    if blob[:1] == bytes([WIRE_VERSION]):
+        return loads(blob)
+    if blob[:1] == b"\x80":
+        return pickle.loads(blob)
+    raise IOError("unrecognized WAL record encoding")
+
+
 class Wal:
     """Record log for engine commits; native-backed when available.
     With `key`, every record blob is AES-GCM sealed before framing
@@ -128,13 +141,13 @@ class Wal:
 
     def append(self, record: Any):
         from dgraph_tpu.storage.enc import encrypt_blob
-        blob = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
-        self._w.append(encrypt_blob(blob, self.key))
+        from dgraph_tpu.wire import dumps
+        self._w.append(encrypt_blob(dumps(record), self.key))
 
     def replay(self) -> Iterator[Any]:
         from dgraph_tpu.storage.enc import decrypt_blob
         for blob in self._w.replay():
-            yield pickle.loads(decrypt_blob(blob, self.key))
+            yield _decode_record(decrypt_blob(blob, self.key))
 
     def truncate(self):
         """Reset after a snapshot has captured state (ref raft WAL
