@@ -95,6 +95,7 @@ class GraphDB:
                  prefer_device: bool = True,
                  device_min_edges: int = 1024,
                  device_hbm_budget: int = 2 << 30,
+                 mesh=None, shard_min_edges: int = 1 << 18,
                  enc_key: bytes | None = None):
         from dgraph_tpu.engine.tile_cache import DeviceCacheLRU
 
@@ -103,6 +104,12 @@ class GraphDB:
         self.tablets: dict[str, Tablet] = {}
         self.prefer_device = prefer_device
         self.device_min_edges = device_min_edges
+        # uid-range sharding across a jax.sharding.Mesh (`uid` axis):
+        # predicates above shard_min_edges expand via shard_map over the
+        # mesh instead of a single chip (ref posting/list.go:1149
+        # multi-part posting lists; SURVEY §5.7)
+        self.mesh = mesh
+        self.shard_min_edges = shard_min_edges
         # HBM residency budget for device tiles (ref posting/lists.go
         # LRU bound on cached posting lists)
         self.device_cache = DeviceCacheLRU(device_hbm_budget)

@@ -144,6 +144,51 @@ def device_bitadjacency(db, tab, read_ts: int, transpose: bool = False):
     return badj
 
 
+def device_sharded_adjacency(db, tab, read_ts: int,
+                             reverse: bool = False):
+    """UID-range-sharded adjacency over the engine's device mesh — the
+    multi-part posting list tier (posting/list.go:1149 splitUpList):
+    predicates above db.shard_min_edges get range-partitioned across
+    the mesh's `uid` axis and expanded with one shard_map+all_gather
+    per level (parallel/dist_graph).
+
+    Residency rules match the single-chip tiles; requires db.mesh with
+    a >1-sized `uid` axis."""
+    mesh = getattr(db, "mesh", None)
+    if mesh is None or "uid" not in mesh.axis_names \
+            or mesh.shape["uid"] < 2:
+        return None
+    if reverse and not tab.schema.reverse:
+        return None
+    if not _clean_resident(db, tab, read_ts):
+        return None
+    attr = "_device_sadj_r" if reverse else "_device_sadj"
+    sadj = getattr(tab, attr, None)
+    if sadj is not None and getattr(tab, attr + "_ts", -1) == tab.base_ts:
+        db.device_cache.touch(tab, attr)
+        return sadj
+    # memoize the below-threshold verdict per base_ts: without it,
+    # every expansion level on a mesh-enabled db would re-walk the
+    # whole edge map just to fall through to the single-chip tier
+    if getattr(tab, attr + "_small_ts", -1) == tab.base_ts:
+        return None
+    edge_map = tab.reverse if reverse else tab.edges
+    n_edges = sum(len(v) for v in edge_map.values())
+    if n_edges < db.shard_min_edges:
+        setattr(tab, attr + "_small_ts", tab.base_ts)
+        return None
+    edges32 = _edges32(edge_map)
+    if edges32 is None:
+        return None
+    from dgraph_tpu.parallel.dist_graph import build_sharded_adjacency
+    sadj = build_sharded_adjacency(
+        edges32, n_shards=mesh.shape["uid"]).put(mesh)
+    setattr(tab, attr, sadj)
+    setattr(tab, attr + "_ts", tab.base_ts)
+    db.device_cache.put(tab, attr, sadj)
+    return sadj
+
+
 def device_values(db, tab, read_ts: int):
     """Sortable value view for order-by / inequality offload (scalar
     tablets; same rollup-then-check policy as the adjacency tiles)."""

@@ -27,7 +27,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from dgraph_tpu.ops.uidvec import SENTINEL, compact, member_mask, pad_to
+from dgraph_tpu.ops.uidvec import (
+    SENTINEL, compact, member_mask, pad_to, to_numpy,
+)
+
+MAX_U32 = SENTINEL - 1  # largest real uid a 32-bit tile can hold
 
 
 @dataclass
@@ -102,6 +106,82 @@ def _local_candidates(frontier, src_l, nb_l):
     return cand.reshape(-1)
 
 
+def _expand_level_body(n_buckets: int, frontier, bucket_arrays,
+                       uid_axis: str, out_size: int):
+    """The shared SPMD body of one expansion level (used by both the
+    single-level expander and the multi-level BFS): local candidates
+    per shard -> all_gather over the uid axis -> sorted unique,
+    padded/truncated to out_size (valid count is bounded by n_dst, so
+    truncation at out_size >= pad_to(n_dst) never drops uids)."""
+    parts = []
+    for bi in range(n_buckets):
+        src_l = bucket_arrays[2 * bi][0]      # [M] local shard
+        nb_l = bucket_arrays[2 * bi + 1][0]   # [M, D]
+        parts.append(_local_candidates(frontier, src_l, nb_l))
+    local = compact(jnp.concatenate(parts)) if parts else \
+        jnp.full((8,), SENTINEL, jnp.uint32)
+    gathered = jax.lax.all_gather(local, uid_axis).reshape(-1)
+    flat = jnp.sort(gathered)
+    prev = jnp.concatenate(
+        [jnp.full((1,), SENTINEL, flat.dtype), flat[:-1]])
+    uniq = compact(jnp.where(flat != prev, flat, SENTINEL))
+    if uniq.shape[0] >= out_size:
+        return uniq[:out_size]
+    return jnp.concatenate([uniq, jnp.full(
+        (out_size - uniq.shape[0],), SENTINEL, jnp.uint32)])
+
+
+def make_sharded_expand(mesh: Mesh, sadj: ShardedAdjacency,
+                        out_size: int, uid_axis: str = "uid"):
+    """Compile ONE expansion level over the uid-sharded adjacency —
+    the executor's per-level device call when a predicate is too big
+    for a single chip (multi-part posting list read,
+    posting/list.go:1149, as one shard_map + all_gather).
+
+    fn(frontier uint32 replicated) -> [out_size] uint32 (sorted unique
+    destinations, SENTINEL padded). jit re-specializes per frontier
+    shape; callers cache the returned fn per padded frontier size.
+    """
+    in_specs = [P()]
+    for _ in sadj.buckets:
+        in_specs.extend([P(uid_axis), P(uid_axis)])
+
+    def step(frontier, *bucket_arrays):
+        return _expand_level_body(len(sadj.buckets), frontier,
+                                  bucket_arrays, uid_axis, out_size)
+
+    smapped = shard_map(step, mesh=mesh, in_specs=tuple(in_specs),
+                        out_specs=P(), check_vma=False)
+
+    def fn(frontier):
+        args = []
+        for b in sadj.buckets:
+            args.extend([b.src, b.neighbors])
+        return smapped(frontier, *args)
+
+    return jax.jit(fn)
+
+
+def expand_sharded_np(mesh: Mesh, sadj: ShardedAdjacency,
+                      src_u64: np.ndarray) -> np.ndarray:
+    """Host frontier -> sharded device expand -> host result; jitted
+    expanders cached per frontier bucket size on the adjacency (the
+    expand_np contract, device tier instead of single chip)."""
+    src_u64 = np.sort(src_u64[src_u64 <= MAX_U32])
+    f_pad = pad_to(len(src_u64))
+    out_size = pad_to(max(sadj.n_dst, 1))
+    cache = getattr(sadj, "_expander_cache", None)
+    if cache is None:
+        cache = sadj._expander_cache = {}
+    fn = cache.get(f_pad)
+    if fn is None:
+        fn = make_sharded_expand(mesh, sadj, out_size)
+        cache[f_pad] = fn
+    fr = np.full(f_pad, SENTINEL, np.uint32)
+    fr[: len(src_u64)] = src_u64.astype(np.uint32)
+    return to_numpy(fn(jnp.asarray(fr))).astype(np.uint64)
+
+
 def make_sharded_bfs(mesh: Mesh, sadj: ShardedAdjacency, seed_size: int,
                      depth: int, level_size: int,
                      uid_axis: str = "uid"):
@@ -123,21 +203,8 @@ def make_sharded_bfs(mesh: Mesh, sadj: ShardedAdjacency, seed_size: int,
         frontier = seeds
         visited = seeds
         for _ in range(depth):
-            parts = []
-            for bi in range(len(sadj.buckets)):
-                src_l = bucket_arrays[2 * bi][0]      # [M] local shard
-                nb_l = bucket_arrays[2 * bi + 1][0]   # [M, D]
-                parts.append(_local_candidates(frontier, src_l, nb_l))
-            local = compact(jnp.concatenate(parts)) if parts else \
-                jnp.full((8,), SENTINEL, jnp.uint32)
-            gathered = jax.lax.all_gather(local, uid_axis).reshape(-1)
-            flat = jnp.sort(gathered)
-            prev = jnp.concatenate(
-                [jnp.full((1,), SENTINEL, flat.dtype), flat[:-1]])
-            nxt = compact(jnp.where(flat != prev, flat, SENTINEL))
-            nxt = nxt[:level_size] if nxt.shape[0] >= level_size else \
-                jnp.concatenate([nxt, jnp.full(
-                    (level_size - nxt.shape[0],), SENTINEL, jnp.uint32)])
+            nxt = _expand_level_body(len(sadj.buckets), frontier,
+                                     bucket_arrays, uid_axis, level_size)
             keep = ~member_mask(nxt, visited)
             nxt = compact(jnp.where(keep, nxt, SENTINEL))
             visited = compact(jnp.concatenate([visited, nxt]))

@@ -1002,12 +1002,26 @@ class Executor:
     def _device_expand(self, tab: Tablet, src: np.ndarray,
                        reverse: bool = False) -> Optional[np.ndarray]:
         from dgraph_tpu.engine.device_cache import (
-            device_adjacency, device_radjacency, expand_np,
+            device_adjacency, device_radjacency,
+            device_sharded_adjacency, expand_np,
         )
 
+        if len(src) == 0:
+            return None
+        if self.db.mesh is not None:
+            # uid-range-sharded tier first: a predicate too big for one
+            # chip expands via shard_map over the mesh (SURVEY §5.7)
+            sadj = device_sharded_adjacency(self.db, tab, self.read_ts,
+                                            reverse)
+            if sadj is not None:
+                from dgraph_tpu.parallel.dist_graph import \
+                    expand_sharded_np
+                inc_counter("query_sharded_expand_total",
+                            labels={"dir": "rev" if reverse else "fwd"})
+                return expand_sharded_np(self.db.mesh, sadj, src)
         adj = (device_radjacency if reverse else device_adjacency)(
             self.db, tab, self.read_ts)
-        if adj is None or len(src) == 0:
+        if adj is None:
             return None
         inc_counter("query_device_expand_total",
                     labels={"dir": "rev" if reverse else "fwd"})
