@@ -101,9 +101,70 @@ class ClusterClient:
 
     # ------------------------------------------------------- alpha surface
 
-    def query(self, q: str, variables: Optional[dict] = None) -> dict:
-        return self._unwrap(self.request(
-            {"op": "query", "q": q, "vars": variables}))
+    def query(self, q: str, variables: Optional[dict] = None,
+              hedge_s: Optional[float] = None) -> dict:
+        """Snapshot read from any replica. With hedge_s set, a backup
+        request fires at a second replica if the first hasn't answered
+        within the delay and the first response wins — the reference's
+        processWithBackupRequest (worker/task.go:66) tail-latency
+        defense."""
+        req = {"op": "query", "q": q, "vars": variables}
+        if hedge_s is not None and len(self.addrs) > 1:
+            return self._unwrap(self._hedged(req, hedge_s))
+        return self._unwrap(self.request(req))
+
+    def _hedged(self, req: dict, hedge_s: float) -> dict:
+        """Fire at the preferred replica; after hedge_s with no answer,
+        race a second replica on a FRESH connection (the pooled conns
+        stay owned by the main path). First non-error response wins."""
+        import queue
+
+        with self._lock:
+            first = self._preferred or sorted(self.addrs)[0]
+        others = [n for n in sorted(self.addrs) if n != first]
+        results: queue.Queue = queue.Queue()
+
+        def attempt(node):
+            try:
+                sock = socket.create_connection(self.addrs[node],
+                                                timeout=2.0)
+                sock.settimeout(self.timeout)
+                try:
+                    wire.write_frame(sock, wire.dumps(req))
+                    results.put(wire.loads(wire.read_frame(sock)))
+                finally:
+                    sock.close()
+            except (OSError, EOFError, wire.WireError):
+                results.put(None)
+
+        threads = [threading.Thread(target=attempt, args=(first,),
+                                    daemon=True)]
+        threads[0].start()
+        failures = 0
+        try:
+            got = results.get(timeout=hedge_s)
+            if got is not None:
+                return got  # ok or a real application error: surface it
+            failures += 1   # connection-level failure
+        except queue.Empty:
+            pass
+        # primary is slow/dead: hedge to a backup replica
+        threads.append(threading.Thread(target=attempt, args=(others[0],),
+                                        daemon=True))
+        threads[1].start()
+        deadline = time.monotonic() + self.timeout
+        while time.monotonic() < deadline and failures < len(threads):
+            try:
+                got = results.get(timeout=max(
+                    0.01, deadline - time.monotonic()))
+            except queue.Empty:
+                break
+            if got is not None:
+                return got
+            failures += 1
+        # both raced attempts failed to CONNECT: fall back to the
+        # routed retry path
+        return self.request(req)
 
     def mutate(self, **kw) -> dict:
         return self._unwrap(self.request({"op": "mutate", "kw": kw}))

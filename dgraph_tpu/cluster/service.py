@@ -27,6 +27,7 @@ from typing import Any, Optional
 from dgraph_tpu import wire
 from dgraph_tpu.cluster.raft import LEADER, RaftNode
 from dgraph_tpu.cluster.transport import TcpTransport
+from dgraph_tpu.utils.logger import log
 
 import socket
 
@@ -108,7 +109,14 @@ class RaftServer:
         block ~1s, and stalling ticks that long would trip healthy
         followers' election timers."""
         r = self.node.ready()
+        if r.soft_state != getattr(self, "_soft_state", None):
+            self._soft_state = r.soft_state
+            log.info("raft_soft_state", node=self.id,
+                     role=r.soft_state[0], leader=r.soft_state[1],
+                     term=self.node.term)
         if r.snapshot is not None:
+            log.info("raft_snapshot_restore", node=self.id,
+                     index=r.snapshot[0])
             self.sm_restore(r.snapshot[2])
             self._acked.clear()
         for e in r.committed:

@@ -51,13 +51,21 @@ def device_adjacency(db, tab, read_ts: int) -> Optional[DeviceAdjacency]:
 
 
 def _clean_resident(db, tab, read_ts: int, want_uid: bool = True) -> bool:
-    """Shared residency policy: rolled-up committed state only."""
+    """Shared residency policy: rolled-up committed state only.
+
+    Rollup folds the delta overlay into the base arrays — a WRITE. In
+    single-threaded embedded use it may run lazily right here, but a
+    server running queries concurrently (read lock shared) must set
+    db.rollup_in_read = False and fold from its write path instead
+    (server/http.py janitor), or concurrent readers would see torn
+    tablets."""
     if (tab.schema.value_type.name == "UID") != want_uid:
         return False
     if tab.dirty():
-        wm = db.coordinator.min_active_ts()
-        if wm >= tab.max_commit_ts:
-            tab.rollup(wm)
+        if getattr(db, "rollup_in_read", True):
+            wm = db.coordinator.min_active_ts()
+            if wm >= tab.max_commit_ts:
+                tab.rollup(wm)
         if tab.dirty():
             return False  # live overlay -> host path
     return read_ts >= tab.base_ts

@@ -14,6 +14,7 @@ is HBM bytes.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import weakref as _weakref
 from collections import OrderedDict
 
@@ -63,23 +64,28 @@ class DeviceCacheLRU:
         self._entries: OrderedDict[tuple, tuple] = OrderedDict()
         self.bytes = 0
         self.evictions = 0
+        # concurrent readers build/touch tiles (server read path runs
+        # queries in parallel under an RW lock)
+        self._lock = threading.Lock()
 
     def touch(self, tab, attr: str):
         key = (id(tab), attr)
-        if key in self._entries:
-            self._entries.move_to_end(key)
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
 
     def put(self, tab, attr: str, obj) -> None:
-        self._prune_dead()
-        key = (id(tab), attr)
-        old = self._entries.pop(key, None)
-        if old is not None:
-            self.bytes -= old[2]
-        nbytes = _hbm_bytes(obj)
-        self._entries[key] = (_weakref.ref(tab), attr, nbytes)
-        self.bytes += nbytes
-        while self.bytes > self.budget and len(self._entries) > 1:
-            self._evict_lru()
+        with self._lock:
+            self._prune_dead()
+            key = (id(tab), attr)
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes -= old[2]
+            nbytes = _hbm_bytes(obj)
+            self._entries[key] = (_weakref.ref(tab), attr, nbytes)
+            self.bytes += nbytes
+            while self.bytes > self.budget and len(self._entries) > 1:
+                self._evict_lru()
         self._set_gauges()
 
     def _prune_dead(self):
@@ -110,9 +116,10 @@ class DeviceCacheLRU:
     def drop_tablet(self, tab):
         """Forget every tile of a tablet (explicit drop paths; implicit
         removals are covered by the weak refs)."""
-        for key in [k for k in self._entries if k[0] == id(tab)]:
-            _, _, nbytes = self._entries.pop(key)
-            self.bytes -= nbytes
+        with self._lock:
+            for key in [k for k in self._entries if k[0] == id(tab)]:
+                _, _, nbytes = self._entries.pop(key)
+                self.bytes -= nbytes
         self._set_gauges()
 
     def _set_gauges(self):
@@ -120,6 +127,7 @@ class DeviceCacheLRU:
         set_gauge("device_cache_tiles", len(self._entries))
 
     def stats(self) -> dict:
-        self._prune_dead()
-        return {"bytes": self.bytes, "tiles": len(self._entries),
-                "budget": self.budget, "evictions": self.evictions}
+        with self._lock:
+            self._prune_dead()
+            return {"bytes": self.bytes, "tiles": len(self._entries),
+                    "budget": self.budget, "evictions": self.evictions}

@@ -112,8 +112,12 @@ class Cursor:
 
     def next(self) -> Token:
         t = self.peek()
-        if t.kind != "eof":
-            self.i += 1
+        if t.kind == "eof":
+            # consuming past the end must error, not return eof forever:
+            # `while not accept(...)` loops would otherwise spin on
+            # truncated input (found by the fuzz suite)
+            raise GQLError(f"line {t.line}: unexpected end of input")
+        self.i += 1
         return t
 
     def accept(self, kind: str, val: str | None = None) -> Token | None:

@@ -276,6 +276,20 @@ def _map_to_nquads(obj: dict, out: list[NQuad], counter: list,
                 child = _map_to_nquads(item, out, counter, delete)
                 out.append(NQuad(subject=uid, predicate=pred,
                                  object_id=child, facets=dict(facets)))
+            elif isinstance(item, str) and item.startswith("val(") \
+                    and item.endswith(")"):
+                # upsert value substitution in JSON bodies —
+                # {"bal": "val(n)"} behaves like `<s> <bal> val(n) .`
+                # (ref edgraph/server.go:503 updateValInMutations works
+                # on both body formats)
+                out.append(NQuad(subject=uid, predicate=pred,
+                                 val_var=item[4:-1], lang=lang,
+                                 facets=dict(facets)))
+            elif isinstance(item, str) and item.startswith("uid(") \
+                    and item.endswith(")"):
+                # {"friend": "uid(v)"} links to every uid in v
+                out.append(NQuad(subject=uid, predicate=pred,
+                                 object_id=item, facets=dict(facets)))
             else:
                 out.append(NQuad(subject=uid, predicate=pred,
                                  object_value=_json_val(item), lang=lang,

@@ -30,6 +30,16 @@ _DIRECTIVES = {"filter", "facets", "cascade", "normalize", "ignorereflex",
                "recurse", "groupby"}
 _BOOL_OPS = {"and", "or", "not"}
 
+def _to_int(raw: str, line: int = 0) -> int:
+    """Numeric literal -> int with a clean GQLError on junk the lexer
+    let through (e.g. '020000': base-0 rejects leading zeros — found by
+    the fuzz suite, ref gql/parser_fuzz.go contract)."""
+    try:
+        return int(raw, 0)
+    except ValueError as e:
+        raise GQLError(f"line {line}: bad integer literal {raw!r}") from e
+
+
 
 def parse(text: str, variables: dict | None = None) -> ParsedResult:
     """Parse a full query document.  `variables` supplies values for
@@ -159,7 +169,7 @@ def _parse_root_args(cur: Cursor, gq: GraphQuery, gvars: dict):
 
 def _set_pagination(gq: GraphQuery, key: str, raw: str):
     try:
-        v = int(raw, 0)
+        v = _to_int(raw)
     except ValueError as e:
         raise GQLError(f"{key} must be an integer, got {raw!r}") from e
     if key == "first":
@@ -210,7 +220,7 @@ def _parse_function(cur: Cursor, gvars: dict) -> Function:
         while not cur.accept("rparen"):
             t = cur.next()
             if t.kind in ("hex", "number"):
-                fn.uids.append(int(t.val, 0))
+                fn.uids.append(_to_int(t.val, t.line))
             elif t.kind == "name":
                 fn.needs_var.append(VarContext(t.val, UID_VAR))
             else:
@@ -290,13 +300,13 @@ def _parse_function(cur: Cursor, gvars: dict) -> Function:
             while not cur.accept("rparen"):
                 u = cur.next()
                 if u.kind in ("hex", "number"):
-                    fn.uids.append(int(u.val, 0))
+                    fn.uids.append(_to_int(u.val, u.line))
                 else:
                     fn.needs_var.append(VarContext(u.val, UID_VAR))
                 cur.accept("comma")
         elif t.kind in ("string", "number", "hex", "name"):
             if fname in ("uid_in",) and t.kind in ("hex", "number"):
-                fn.uids.append(int(t.val, 0))
+                fn.uids.append(_to_int(t.val, t.line))
             else:
                 fn.args.append(Arg(t.val))
         elif t.kind == "op" and t.val == "/":
@@ -447,7 +457,7 @@ def _parse_directive(cur: Cursor, gq: GraphQuery, gvars: dict):
                 cur.expect("colon")
                 val = _scalar_str(cur, gvars)
                 if key == "depth":
-                    ra.depth = int(val, 0)
+                    ra.depth = _to_int(val)
                 elif key == "loop":
                     ra.allow_loop = val.lower() == "true"
                 else:
@@ -526,7 +536,7 @@ def _parse_shortest_args(cur: Cursor, gvars: dict) -> ShortestArgs:
             fn = Function(name="uid")
             if t.kind in ("hex", "number"):
                 cur.next()
-                fn.uids.append(int(t.val, 0))
+                fn.uids.append(_to_int(t.val, t.line))
             elif t.kind == "name" and t.val == "uid":
                 fn = _parse_function(cur, gvars)
             else:
@@ -536,9 +546,9 @@ def _parse_shortest_args(cur: Cursor, gvars: dict) -> ShortestArgs:
             else:
                 sa.to = fn
         elif key == "numpaths":
-            sa.numpaths = int(_scalar_str(cur, gvars), 0)
+            sa.numpaths = _to_int(_scalar_str(cur, gvars))
         elif key == "depth":
-            sa.depth = int(_scalar_str(cur, gvars), 0)
+            sa.depth = _to_int(_scalar_str(cur, gvars))
         elif key == "minweight":
             sa.minweight = float(_scalar_str(cur, gvars))
         elif key == "maxweight":
@@ -622,7 +632,7 @@ def _parse_selection(cur: Cursor, gvars: dict) -> GraphQuery:
         while not cur.accept("rparen"):
             u = cur.next()
             if u.kind in ("hex", "number"):
-                gq.uids.append(int(u.val, 0))
+                gq.uids.append(_to_int(u.val, u.line))
             else:
                 gq.needs_var.append(VarContext(u.val, UID_VAR))
             cur.accept("comma")

@@ -23,10 +23,15 @@ Transactions over HTTP are keyed by startTs exactly like the reference's
 stateless protocol: /mutate without commitNow returns start_ts, the
 client replays it to /mutate (more writes) or /commit.
 
-Concurrency: a ThreadingHTTPServer front end with a single engine lock —
-the data plane batches work into device calls, so the lock guards only
-host-side bookkeeping (the reference's fine-grained goroutine model is a
-non-goal for the in-process engine).
+Concurrency: a ThreadingHTTPServer front end over a reader-writer
+lock — queries (MVCC snapshot reads) share the read side, mutations /
+commits / alters take the write side, so a slow analytical query no
+longer serializes the whole server (the reference gets the same shape
+from goroutines + per-list RWMutex, posting/list.go). A small `meta`
+mutex guards the txn table and ACL cache; lock order is rw -> meta,
+never the reverse. Rollup (folds MVCC overlays — a write) is kept OFF
+the read path (db.rollup_in_read=False) and runs throttled from the
+write path instead.
 """
 
 from __future__ import annotations
@@ -42,6 +47,7 @@ from urllib.parse import parse_qs, urlparse
 from dgraph_tpu.cluster.coordinator import TxnAborted
 from dgraph_tpu.engine.db import GraphDB, Mutation, Txn
 from dgraph_tpu.server.acl import AclError
+from dgraph_tpu.utils.logger import log
 
 # startTs -> open server-side txn (the reference keeps this state in the
 # client + oracle; our engine txns are server objects, so the server maps)
@@ -55,7 +61,16 @@ class AlphaServer:
                  txn_ttl_s: float = 300.0,
                  acl_secret: Optional[bytes] = None):
         self.db = db or GraphDB()
-        self.lock = threading.RLock()
+        from dgraph_tpu.utils.rwlock import RWLock
+        self.rw = RWLock()
+        self.meta = threading.RLock()
+        # concurrent readers must not trigger rollup (it rewrites the
+        # tablet base arrays); the write path folds instead
+        self.db.rollup_in_read = False
+        self._commits_since_rollup = 0
+        # draining: reject writes, keep serving reads (ref x/health.go
+        # drainingMode + /admin/draining handler, alpha/admin.go)
+        self.draining = False
         self.txns: dict[int, Txn] = {}
         self._touched: dict[int, float] = {}
         # startTs -> userid that opened the txn (ACL mode only): /commit
@@ -68,13 +83,12 @@ class AlphaServer:
         self.acl = None
         if acl_secret is not None:
             from dgraph_tpu.server.acl import AclManager
-            with self.lock:
-                self.acl = AclManager(self.db, acl_secret)
+            self.acl = AclManager(self.db, acl_secret)
 
     def handle_login(self, body: dict) -> dict:
         if self.acl is None:
             raise ValueError("ACL is not enabled on this server")
-        with self.lock:
+        with self.meta:
             return {"data": self.acl.login(
                 userid=body.get("userid", ""),
                 password=body.get("password", ""),
@@ -106,6 +120,15 @@ class AlphaServer:
             raise AclError(
                 f"txn at startTs={start_ts} belongs to another user")
 
+    def _maybe_rollup(self, every: int = 16):
+        """Throttled overlay fold, called from the write path (caller
+        holds the write lock). Replaces lazy rollup-in-read, which is
+        unsafe once queries run concurrently."""
+        self._commits_since_rollup += 1
+        if self._commits_since_rollup >= every:
+            self._commits_since_rollup = 0
+            self.db.rollup_all()
+
     # -- request handlers (transport-independent) --
 
     def handle_query(self, body: dict | str, params: dict,
@@ -119,23 +142,28 @@ class AlphaServer:
         if self.acl is not None:
             from dgraph_tpu.gql import parse as gql_parse
             from dgraph_tpu.server.acl import query_predicates
-            with self.lock:
+            with self.meta:
                 claims = self.acl.authorize(token)
                 self.acl.authorize_query(
                     token, query_predicates(gql_parse(q, variables)),
                     claims=claims)
         ro_txn = None
         start_ts = int(params.get("startTs", 0))
-        with self.lock:
+        with self.meta:
             if start_ts:
                 self._check_txn_owner(start_ts, claims)
                 ro_txn = self.txns.get(start_ts)
-            be = params.get("be", "false") == "true"
+        be = params.get("be", "false") == "true"
+        with self.rw.read:
             return self.db.query(q, variables, txn=ro_txn, best_effort=be
                                  if ro_txn is None else False)
 
     def handle_mutate(self, body: bytes, content_type: str,
                       params: dict, token: str = "") -> dict:
+        if self.draining:
+            raise RuntimeError(
+                "the server is in draining mode; write operations are "
+                "rejected")
         commit_now = params.get("commitNow", "false") == "true"
         start_ts = int(params.get("startTs", 0))
         mut, query, variables = _parse_mutation_body(body, content_type)
@@ -147,7 +175,7 @@ class AlphaServer:
             )
             preds = nquad_predicates(mut.set_nquads, mut.del_nquads,
                                      mut.set_json, mut.delete_json)
-            with self.lock:
+            with self.meta:
                 claims = self.acl.authorize(token)
                 owner = claims.get("userid", "")
                 self.acl.authorize_mutation(token, preds, claims=claims)
@@ -161,18 +189,19 @@ class AlphaServer:
                     # same ownership check as /commit — startTs values
                     # are guessable sequential ints
                     self._check_txn_owner(start_ts, claims)
-        with self.lock:
-            self._evict_idle()
-            created = False
-            if start_ts:
-                txn = self.txns.get(start_ts)
-                if txn is None:
-                    # attach to a ts a previous /query handed out
-                    txn = self.db.new_txn_at(start_ts)
+        with self.rw.write:
+            with self.meta:
+                self._evict_idle()
+                created = False
+                if start_ts:
+                    txn = self.txns.get(start_ts)
+                    if txn is None:
+                        # attach to a ts a previous /query handed out
+                        txn = self.db.new_txn_at(start_ts)
+                        created = True
+                else:
+                    txn = self.db.new_txn()
                     created = True
-            else:
-                txn = self.db.new_txn()
-                created = True
             try:
                 out = self.db.mutate(txn, mutations=[mut], query=query,
                                      variables=variables,
@@ -180,38 +209,44 @@ class AlphaServer:
             except Exception:
                 # a failed mutation aborts the whole txn (fail fast; the
                 # reference marks the txn context aborted)
-                self.txns.pop(txn.start_ts, None)
-                self._touched.pop(txn.start_ts, None)
-                self._txn_owner.pop(txn.start_ts, None)
+                with self.meta:
+                    self.txns.pop(txn.start_ts, None)
+                    self._touched.pop(txn.start_ts, None)
+                    self._txn_owner.pop(txn.start_ts, None)
                 self.db.discard(txn)
                 raise
             ext_txn = {"start_ts": txn.start_ts}
+            with self.meta:
+                if commit_now:
+                    self.txns.pop(txn.start_ts, None)
+                    self._touched.pop(txn.start_ts, None)
+                    self._txn_owner.pop(txn.start_ts, None)
+                    if not txn.done:  # all conds failed: discard
+                        self.db.discard(txn)
+                else:
+                    if created and len(self.txns) >= _MAX_OPEN_TXNS:
+                        self.db.discard(txn)
+                        raise RuntimeError("too many open transactions")
+                    self.txns[txn.start_ts] = txn
+                    self._touched[txn.start_ts] = time.time()
+                    if self.acl is not None and owner is not None:
+                        self._txn_owner.setdefault(txn.start_ts, owner)
             if commit_now:
-                self.txns.pop(txn.start_ts, None)
-                self._touched.pop(txn.start_ts, None)
-                self._txn_owner.pop(txn.start_ts, None)
-                if not txn.done:  # all conds failed, discard like mutate()
-                    self.db.discard(txn)
-            else:
-                if created and len(self.txns) >= _MAX_OPEN_TXNS:
-                    self.db.discard(txn)
-                    raise RuntimeError("too many open transactions")
-                self.txns[txn.start_ts] = txn
-                self._touched[txn.start_ts] = time.time()
-                if self.acl is not None and owner is not None:
-                    self._txn_owner.setdefault(txn.start_ts, owner)
+                self._maybe_rollup()
             out.setdefault("extensions", {})["txn"] = ext_txn
             return out
 
     def handle_commit(self, params: dict, token: str = "") -> dict:
         start_ts = int(params.get("startTs", 0))
         abort = params.get("abort", "false") == "true"
-        with self.lock:
-            if self.acl is not None:
-                self._check_txn_owner(start_ts, self.acl.authorize(token))
-            txn = self.txns.pop(start_ts, None)
-            self._touched.pop(start_ts, None)
-            self._txn_owner.pop(start_ts, None)
+        with self.rw.write:
+            with self.meta:
+                if self.acl is not None:
+                    self._check_txn_owner(start_ts,
+                                          self.acl.authorize(token))
+                txn = self.txns.pop(start_ts, None)
+                self._touched.pop(start_ts, None)
+                self._txn_owner.pop(start_ts, None)
             if txn is None:
                 raise KeyError(f"no open transaction at startTs={start_ts}")
             if abort:
@@ -220,11 +255,16 @@ class AlphaServer:
                         "extensions": {"txn": {"start_ts": start_ts,
                                                "aborted": True}}}
             commit_ts = self.db.commit(txn)
+            self._maybe_rollup()
             return {"code": "Success", "message": "Done",
                     "extensions": {"txn": {"start_ts": start_ts,
                                            "commit_ts": commit_ts}}}
 
     def handle_alter(self, body: bytes, token: str = "") -> dict:
+        if self.draining:
+            raise RuntimeError(
+                "the server is in draining mode; write operations are "
+                "rejected")
         text = body.decode()
         drop_all = False
         drop_attr = ""
@@ -241,35 +281,50 @@ class AlphaServer:
             from dgraph_tpu.server.acl import schema_predicates
             preds = [drop_attr] if drop_attr else (
                 schema_predicates(schema) if schema else [])
-            with self.lock:
+            with self.meta:
                 self.acl.authorize_alter(token, preds,
                                          drop=drop_all or bool(drop_attr))
-        with self.lock:
+        with self.rw.write:
             self.db.alter(schema_text=schema, drop_all=drop_all,
                           drop_attr=drop_attr)
         return {"code": "Success", "message": "Done"}
 
     def handle_state(self, token: str = "") -> dict:
         if self.acl is not None:
-            with self.lock:
+            with self.meta:
                 self.acl.authorize(token)  # any valid login may inspect
-        with self.lock:
+        with self.rw.read:
             return self.db.state()
 
     def handle_health(self) -> dict:
-        return {"status": "healthy",
+        return {"status": "draining" if self.draining else "healthy",
                 "uptime_s": round(time.time() - self.started_at, 3),
                 "openTxns": len(self.txns)}
+
+    def handle_draining(self, enable: bool, token: str = "") -> dict:
+        """Toggle draining (guardians only under ACL) — ref
+        alpha/admin.go drainingHandler."""
+        if self.acl is not None:
+            from dgraph_tpu.server.acl import GUARDIANS
+            with self.meta:
+                claims = self.acl.authorize(token)
+                if GUARDIANS not in claims.get("groups", []):
+                    raise AclError(
+                        "/admin/draining needs guardian membership")
+        self.draining = enable
+        log.info("draining", enable=enable)
+        return {"code": "Success",
+                "message": f"draining mode is now {enable}"}
 
     def handle_get_schema(self, token: str = "") -> dict:
         if self.acl is not None:
             from dgraph_tpu.server.acl import GUARDIANS
-            with self.lock:
+            with self.meta:
                 claims = self.acl.authorize(token)
                 if GUARDIANS not in claims.get("groups", []):
                     raise AclError("/admin/schema needs guardian "
                                    "membership")
-        with self.lock:
+        with self.rw.read:
             return {"schema": self.db.schema.describe_all()}
 
 
@@ -436,7 +491,8 @@ class _Handler(BaseHTTPRequestHandler):
         except AclError as e:
             self._error(str(e), 401)
         except Exception as e:  # noqa: BLE001 — surface as API error
-            traceback.print_exc()
+            log.error("http_internal_error", path=path, error=str(e),
+                      trace=traceback.format_exc()[-800:])
             self._error(str(e), 500)
 
     def do_POST(self):
@@ -461,6 +517,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, self.alpha.handle_commit(params, token))
             elif path in ("/alter", "/admin/schema"):
                 self._send(200, self.alpha.handle_alter(body, token))
+            elif path == "/admin/draining":
+                enable = params.get("enable", "true") == "true"
+                self._send(200, self.alpha.handle_draining(enable, token))
             elif path == "/login":
                 self._send(200, self.alpha.handle_login(
                     json.loads(body.decode()) if body else {}))
@@ -474,7 +533,8 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError) as e:
             self._error(str(e), 400)
         except Exception as e:  # noqa: BLE001
-            traceback.print_exc()
+            log.error("http_internal_error", path=path, error=str(e),
+                      trace=traceback.format_exc()[-800:])
             self._error(str(e), 500)
 
 

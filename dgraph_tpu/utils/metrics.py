@@ -73,8 +73,24 @@ def _fmt_key(k: tuple[str, tuple]) -> str:
     return f"{name}{{{inner}}}"
 
 
+def collect_memory_gauges():
+    """Process memory gauges (ref x/metrics.go MemoryInUse/MemoryProc:
+    the reference samples Go runtime + proc stats into gauges). Reads
+    /proc/self/statm — free on Linux; silently skipped elsewhere."""
+    try:
+        with open("/proc/self/statm") as f:
+            parts = f.read().split()
+        import os
+        page = os.sysconf("SC_PAGE_SIZE")
+        set_gauge("memory_proc_bytes", int(parts[0]) * page)   # vsize
+        set_gauge("memory_inuse_bytes", int(parts[1]) * page)  # rss
+    except (OSError, ValueError, IndexError):
+        pass
+
+
 def render_prometheus() -> str:
     """Prometheus text exposition format 0.0.4."""
+    collect_memory_gauges()
     lines: list[str] = []
     typed: set[str] = set()  # one TYPE line per metric name
 

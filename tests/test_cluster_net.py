@@ -265,3 +265,42 @@ def test_killed_node_rejoins_and_catches_up(alpha):
     else:
         raise AssertionError("restarted node never caught up")
     assert len(c.alive()) == 3
+
+
+def test_hedged_reads(alpha):
+    """processWithBackupRequest analogue: a hedged query succeeds even
+    when the preferred replica is gone, without waiting for the routed
+    retry loop (worker/task.go:66)."""
+    c, client = alpha
+    alive = c.alive()
+    assert len(alive) >= 2
+    # normal hedged read works
+    got = client.query("{ q(func: has(bal)) { bal } }", hedge_s=0.05)
+    assert len(got["data"]["q"]) == N_ACCOUNTS
+    # point the preference at a dead port: the hedge must recover
+    dead_port = _free_ports(1)[0]
+    hedged = ClusterClient(
+        {**{i: c.client_addrs[i] for i in alive},
+         99: ("127.0.0.1", dead_port)}, timeout=20.0)
+    try:
+        hedged._preferred = 99
+        t0 = time.monotonic()
+        got = hedged.query("{ q(func: has(bal)) { bal } }", hedge_s=0.1)
+        took = time.monotonic() - t0
+        assert len(got["data"]["q"]) == N_ACCOUNTS
+        assert took < 10, f"hedge did not short-circuit ({took:.1f}s)"
+    finally:
+        hedged.close()
+
+
+def test_hedged_application_error_surfaces_fast(alpha):
+    """A parse error from the primary must surface immediately, not
+    stall out the hedge deadline or re-execute three times."""
+    c, client = alpha
+    t0 = time.monotonic()
+    try:
+        client.query("{ bad syntax", hedge_s=0.05)
+        raise AssertionError("expected a parse error")
+    except RuntimeError:
+        pass
+    assert time.monotonic() - t0 < 5

@@ -1,0 +1,130 @@
+"""Parser robustness fuzzing.
+
+The reference ships go-fuzz harnesses for the query language
+(gql/parser_fuzz.go:40 Fuzz) whose contract is: arbitrary bytes must
+produce a parse result or a clean error — never a crash. Same contract
+here: every input must either parse or raise GQLError; any other
+exception is a bug. Deterministic seeds keep CI reproducible.
+"""
+
+import os
+import random
+
+import pytest
+
+from dgraph_tpu.gql.lexer import GQLError
+from dgraph_tpu.gql.parser import parse
+from dgraph_tpu.gql.nquad import parse_json_mutation, parse_rdf
+
+_GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "golden", "queries")
+
+
+def _corpus() -> list[str]:
+    out = []
+    for f in sorted(os.listdir(_GOLDEN_DIR)):
+        if f.endswith(".gql"):
+            with open(os.path.join(_GOLDEN_DIR, f)) as fh:
+                out.append(fh.read())
+    return out
+
+
+_MUTATIONS = "{}()[]@:,.\"'\\/~*$#< >"
+
+
+def _mutate(rng: random.Random, s: str) -> str:
+    ops = rng.randrange(1, 5)
+    chars = list(s)
+    for _ in range(ops):
+        kind = rng.randrange(4)
+        if not chars:
+            break
+        i = rng.randrange(len(chars))
+        if kind == 0:
+            del chars[i]
+        elif kind == 1:
+            chars.insert(i, rng.choice(_MUTATIONS))
+        elif kind == 2:
+            chars[i] = rng.choice(_MUTATIONS)
+        else:  # splice a random slice elsewhere
+            j = rng.randrange(len(chars))
+            i, j = min(i, j), max(i, j)
+            seg = chars[i:j][: 20]
+            k = rng.randrange(len(chars))
+            chars[k:k] = seg
+    return "".join(chars)
+
+
+def test_fuzz_query_parser_never_crashes():
+    rng = random.Random(0xD6)
+    corpus = _corpus()
+    assert corpus
+    crashes = []
+    for trial in range(1500):
+        src = _mutate(rng, rng.choice(corpus))
+        try:
+            parse(src)
+        except GQLError:
+            pass
+        except RecursionError:
+            pass  # deeply nested braces; a clean failure, not a crash
+        except Exception as e:  # noqa: BLE001
+            crashes.append((type(e).__name__, str(e)[:80], src[:120]))
+    assert not crashes, crashes[:5]
+
+
+def test_fuzz_random_garbage():
+    rng = random.Random(7)
+    crashes = []
+    for _ in range(800):
+        n = rng.randrange(0, 60)
+        src = "".join(rng.choice(_MUTATIONS + "abcdefXYZ018\n\t")
+                      for _ in range(n))
+        try:
+            parse(src)
+        except (GQLError, RecursionError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            crashes.append((type(e).__name__, str(e)[:80], src[:80]))
+    assert not crashes, crashes[:5]
+
+
+def test_fuzz_rdf_parser():
+    rng = random.Random(3)
+    seeds = ['<0x1> <name> "alice"@en .',
+             '_:a <friend> <0x2> (weight=3, since=2015) .',
+             '<0x1> <loc> "{\\"type\\":\\"Point\\",\\"coordinates\\":'
+             '[1.0, 2.0]}"^^<geo:geojson> .',
+             'uid(v) <bal> val(n) .',
+             '<0x1> <name> * .']
+    crashes = []
+    for _ in range(1200):
+        src = _mutate(rng, rng.choice(seeds))
+        try:
+            parse_rdf(src)
+        except (GQLError, ValueError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            crashes.append((type(e).__name__, str(e)[:80], src[:80]))
+    assert not crashes, crashes[:5]
+
+
+def test_fuzz_json_mutation_parser():
+    rng = random.Random(5)
+    seeds = ['{"uid": "0x1", "name": "a", "friend": {"uid": "0x2"}}',
+             '[{"name": "x", "bal": 3, "e|f": 1}]',
+             '{"set": [{"uid": "uid(v)", "bal": "val(n)"}]}']
+    crashes = []
+    for _ in range(800):
+        src = _mutate(rng, rng.choice(seeds))
+        try:
+            parse_json_mutation(src)
+        except (GQLError, ValueError, KeyError, TypeError) as e:
+            # json decode errors and type mismatches are clean rejects
+            if isinstance(e, TypeError) and "unhashable" not in str(e) \
+                    and "not iterable" not in str(e) \
+                    and "string indices" not in str(e):
+                crashes.append(("TypeError", str(e)[:80], src[:80]))
+        except Exception as e:  # noqa: BLE001
+            crashes.append((type(e).__name__, str(e)[:80], src[:80]))
+    assert not crashes, crashes[:5]
