@@ -86,15 +86,18 @@ def _unescape(s: str) -> str:
 
 
 def parse_rdf(text: str) -> list[NQuad]:
-    """Parse newline-separated N-Quad statements.
-    Ref: chunker.ParseRDFs / parseNQuad (chunker/rdf_parser.go:58)."""
+    """Parse N-Quad statements — '.'-terminated, possibly several per
+    line (the grammar's terminator is the dot, not the newline).
+    Ref: chunker.ParseRDFs / parseNQuad (chunker/rdf_parser.go:58).
+    Trailing junk after a statement is an error, never silently
+    dropped."""
     out: list[NQuad] = []
     for lineno, line in enumerate(text.splitlines(), 1):
         line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        nq, rest = _parse_one(line, lineno)
-        out.append(nq)
+        while line and not line.startswith("#"):
+            nq, rest = _parse_one(line, lineno)
+            out.append(nq)
+            line = rest.strip()
     return out
 
 
@@ -165,6 +168,14 @@ def _parse_one(line: str, lineno: int) -> tuple[NQuad, str]:
     elif m.group("word"):
         nq.object_id = m.group("word")
 
+    # optional graph-label term (standard N-Quads 4th term; the
+    # reference parses and discards it, chunker/rdf_parser.go label)
+    rest = rest.strip()
+    if rest.startswith("<"):
+        m2 = _TERM.match(rest)
+        if m2 and m2.group("iri"):
+            rest = rest[m2.end():]
+
     # optional facets: ( key = value , ... )
     rest = rest.strip()
     if rest.startswith("("):
@@ -176,9 +187,14 @@ def _parse_one(line: str, lineno: int) -> tuple[NQuad, str]:
             nq.facets[k.strip()] = _facet_val(v.strip())
         rest = rest[end + 1:]
     rest = rest.strip()
-    if rest.startswith("."):
-        rest = rest[1:]
-    return nq, rest
+    if not rest.startswith("."):
+        # '.' is the statement terminator — and with several statements
+        # per line, the load-bearing separator; a missing dot must
+        # error, not silently accept a truncated statement
+        raise GQLError(
+            f"rdf line {lineno}: statement not '.'-terminated at "
+            f"{rest[:30]!r}")
+    return nq, rest[1:]
 
 
 def _facet_val(raw: str) -> Val:

@@ -850,7 +850,10 @@ class Executor:
                 for u in src.tolist():
                     node.counts[u] = self._child_count(tab, u, node.reverse)
             elif gq.is_groupby:
-                pass  # grouped at emit time
+                # emission groups per parent; var assignment aggregates
+                # over the whole block's edge set now so later blocks
+                # can consume it
+                self._bind_groupby_vars(gq, dest)
             else:
                 self._expand_children(node, gq.children, dest)
         else:
@@ -1675,26 +1678,110 @@ class Executor:
         for k, v in sel.items():
             item[f"{edge}|{names.get(k, k)}"] = to_json_value(v)
 
-    def _emit_groupby(self, ch: ExecNode, dsts: np.ndarray) -> dict:
-        """@groupby(attr) { count(uid) } (ref query/groupby.go:371)."""
-        gattr = ch.gq.groupby[0].attr
-        tab = self._tablet(gattr)
-        groups: dict[Any, int] = {}
+    def _groupby_groups(self, gq: GraphQuery, dsts: np.ndarray
+                        ) -> dict[tuple, list[int]]:
+        """Group member uids by the tuple of their @groupby attr values
+        (ref query/groupby.go:371 processGroupBy). Multi-valued attrs
+        fan a member into every combination; members missing any
+        grouped attr are dropped (the reference's dedupMap only sees
+        uids that produced a value for each predicate)."""
+        from itertools import product
+
+        groups: dict[tuple, list[int]] = {}
         for d in dsts.tolist():
-            if tab is None:
+            per_attr: list[list] = []
+            for ga in gq.groupby:
+                tab = self._tablet(ga.attr)
+                vals: list = []
+                if tab is not None:
+                    if tab.schema.value_type == TypeID.UID:
+                        vals = [hex(t) for t in tab.get_dst_uids(
+                            d, self.read_ts).tolist()]
+                    else:
+                        # list-valued scalars fan into every value's
+                        # group; ga.lang selects that language's
+                        # postings, default the untagged ones
+                        ps = tab.get_postings(d, self.read_ts)
+                        want = ga.lang or ""
+                        seen = set()
+                        for p in ps:
+                            if p.lang != want:
+                                continue
+                            v = to_json_value(self._typed(tab, p))
+                            k = v if isinstance(v, (str, int, float,
+                                                    bool)) else str(v)
+                            if k not in seen:
+                                seen.add(k)
+                                vals.append(v)
+                if not vals:
+                    per_attr = []
+                    break
+                per_attr.append(vals)
+            if not per_attr:
                 continue
-            if tab.schema.value_type == TypeID.UID:
-                for t in tab.get_dst_uids(d, self.read_ts).tolist():
-                    groups[hex(t)] = groups.get(hex(t), 0) + 1
-            else:
-                ps = tab.get_postings(d, self.read_ts)
-                sel = self._select_posting(ps, [])
-                if sel is not None:
-                    key = to_json_value(self._typed(tab, sel))
-                    groups[key] = groups.get(key, 0) + 1
+            for combo in product(*per_attr):
+                groups.setdefault(tuple(combo), []).append(int(d))
+        return groups
+
+    def _groupby_entry(self, gq: GraphQuery, key: tuple,
+                       members: list[int]) -> dict:
+        """One output group: keys + count(uid) + aggregations over
+        value vars (ref groupby.go aggregateGroup)."""
+        ent: dict[str, Any] = {}
+        for ga, kv in zip(gq.groupby, key):
+            ent[ga.alias or ga.attr] = kv
+        for cgq in gq.children:
+            if cgq.attr == "uid" and cgq.is_count:
+                ent[cgq.alias or "count"] = len(members)
+            elif cgq.agg_func and cgq.needs_var:
+                vmap = self.value_vars.get(cgq.needs_var[0].name, {})
+                vals = [vmap[u] for u in members if u in vmap]
+                agg = _aggregate(cgq.agg_func, vals)
+                if agg is not None:
+                    name = cgq.alias or \
+                        f"{cgq.agg_func}(val({cgq.needs_var[0].name}))"
+                    ent[name] = to_json_value(agg)
+        return ent
+
+    def _emit_groupby(self, ch: ExecNode, dsts: np.ndarray) -> dict:
+        """@groupby(attrs...) { count(uid) aggs... }
+        (ref query/groupby.go:371)."""
+        groups = self._groupby_groups(ch.gq, dsts)
         return {"@groupby": [
-            {gattr: k, "count": c} for k, c in sorted(
-                groups.items(), key=lambda kv: str(kv[0]))]}
+            self._groupby_entry(ch.gq, key, members)
+            for key, members in sorted(groups.items(),
+                                       key=lambda kv: str(kv[0]))]}
+
+    def _bind_groupby_vars(self, gq: GraphQuery, dest: np.ndarray):
+        """`a as count(uid)` / `m as max(val(x))` inside a groupby block
+        binds a value var keyed by the group's uid — only legal when
+        grouping by exactly one uid predicate (ref groupby.go:118
+        "can only use UID predicate with groupby" for var assignment).
+        Aggregated across every parent's edge set (dest union), like
+        the reference's var groupby over the whole block."""
+        var_children = [c for c in gq.children if c.var]
+        if not var_children:
+            return
+        tab0 = self._tablet(gq.groupby[0].attr) if gq.groupby else None
+        if len(gq.groupby) != 1 or tab0 is None or \
+                tab0.schema.value_type != TypeID.UID:
+            raise GQLError(
+                "assigning a groupby result to a variable needs exactly "
+                "one uid predicate in @groupby")
+        groups = self._groupby_groups(gq, dest)
+        for cgq in var_children:
+            vmap: dict[int, Val] = {}
+            for key, members in groups.items():
+                guid = int(key[0], 0)
+                if cgq.attr == "uid" and cgq.is_count:
+                    vmap[guid] = Val(TypeID.INT, len(members))
+                elif cgq.agg_func and cgq.needs_var:
+                    src = self.value_vars.get(cgq.needs_var[0].name, {})
+                    vals = [src[u] for u in members if u in src]
+                    agg = _aggregate(cgq.agg_func, vals)
+                    if agg is not None:
+                        vmap[guid] = agg
+            self.value_vars[cgq.var] = vmap
 
     def _emit_recurse_node(self, node: ExecNode, uid: int, level: int
                            ) -> dict:

@@ -1,0 +1,143 @@
+"""groupby parity: multiple attrs, aggregations over groups, and
+`a as count(uid)` var binding (ref query/groupby.go:371 processGroupBy,
+:118 var assignment rules).
+"""
+
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.gql.lexer import GQLError
+
+
+@pytest.fixture(scope="module")
+def db():
+    db = GraphDB(prefer_device=False)
+    db.alter("""
+name: string @index(exact) .
+age: int .
+school: [uid] .
+friend: [uid] .
+score: int .
+""")
+    db.mutate(set_nquads="""
+<100> <name> "s1" .
+<101> <name> "s2" .
+<1> <name> "alice" .
+<1> <age> "20" .
+<1> <school> <100> .
+<2> <name> "bob" .
+<2> <age> "20" .
+<2> <school> <100> .
+<3> <name> "carol" .
+<3> <age> "25" .
+<3> <school> <101> .
+<4> <name> "dave" .
+<4> <age> "20" .
+<4> <school> <101> .
+<5> <name> "eve" .
+<10> <friend> <1> .
+<10> <friend> <2> .
+<10> <friend> <3> .
+<10> <friend> <4> .
+<10> <friend> <5> .
+<1> <score> "7" .
+<2> <score> "3" .
+<3> <score> "10" .
+<4> <score> "5" .
+""")
+    return db
+
+
+def _groups(db, q):
+    return db.query(q)["data"]["q"][0]["friend"]["@groupby"]
+
+
+def test_single_attr_count(db):
+    out = _groups(db, '{ q(func: uid(10)) { friend @groupby(age) '
+                      '{ count(uid) } } }')
+    assert out == [{"age": 20, "count": 3}, {"age": 25, "count": 1}]
+
+
+def test_multiple_attrs(db):
+    out = _groups(db, '{ q(func: uid(10)) { friend '
+                      '@groupby(age, school) { count(uid) } } }')
+    # (20, s1)=2, (20, s2)=1, (25, s2)=1; eve (no age) dropped
+    assert {(g["age"], g["school"], g["count"]) for g in out} == {
+        (20, "0x64", 2), (20, "0x65", 1), (25, "0x65", 1)}
+
+
+def test_aggregation_over_groups(db):
+    out = db.query('''{
+      var(func: uid(1, 2, 3, 4)) { s as score }
+      q(func: uid(10)) { friend @groupby(age)
+        { count(uid) max(val(s)) sum(val(s)) } }
+    }''')["data"]["q"][0]["friend"]["@groupby"]
+    by_age = {g["age"]: g for g in out}
+    assert by_age[20]["max(val(s))"] == 7
+    assert by_age[20]["sum(val(s))"] == 15   # 7 + 3 + 5
+    assert by_age[25]["sum(val(s))"] == 10
+
+
+def test_groupby_var_binding_count(db):
+    # a as count(uid) binds school uid -> member count; consumable by a
+    # later block ordered by val(a)
+    out = db.query('''{
+      var(func: uid(10)) { friend @groupby(school) { a as count(uid) } }
+      q(func: uid(a), orderdesc: val(a)) { name total: val(a) }
+    }''')["data"]["q"]
+    assert out == [{"name": "s1", "total": 2}, {"name": "s2", "total": 2}] \
+        or {(r["name"], r["total"]) for r in out} == {("s1", 2), ("s2", 2)}
+
+
+def test_groupby_var_binding_agg(db):
+    out = db.query('''{
+      var(func: uid(1, 2, 3, 4)) { s as score }
+      var(func: uid(10)) { friend @groupby(school)
+        { m as max(val(s)) } }
+      q(func: uid(m), orderdesc: val(m)) { name best: val(m) }
+    }''')["data"]["q"]
+    assert out == [{"name": "s2", "best": 10}, {"name": "s1", "best": 7}]
+
+
+def test_groupby_var_needs_single_uid_attr(db):
+    with pytest.raises(GQLError):
+        db.query('{ var(func: uid(10)) { friend @groupby(age) '
+                 '{ a as count(uid) } } q(func: uid(a)) { name } }')
+
+
+def test_groupby_alias(db):
+    out = _groups(db, '{ q(func: uid(10)) { friend '
+                      '@groupby(years: age) { n: count(uid) } } }')
+    assert out == [{"years": 20, "n": 3}, {"years": 25, "n": 1}]
+
+
+def test_groupby_list_valued_scalar_fans_out():
+    db = GraphDB(prefer_device=False)
+    db.alter("tag: [string] .\nitem: [uid] .")
+    db.mutate(set_nquads="""
+<1> <tag> "a" .
+<1> <tag> "b" .
+<2> <tag> "a" .
+<9> <item> <1> .
+<9> <item> <2> .
+""")
+    out = db.query('{ q(func: uid(9)) { item @groupby(tag) '
+                   '{ count(uid) } } }')["data"]["q"][0]["item"]["@groupby"]
+    assert {(g["tag"], g["count"]) for g in out} == {("a", 2), ("b", 1)}
+
+
+def test_groupby_lang_selector():
+    db = GraphDB(prefer_device=False)
+    db.alter("label: string @lang .\nitem: [uid] .")
+    db.mutate(set_nquads="""
+<1> <label> "rot"@de .
+<2> <label> "rot"@de .
+<3> <label> "blau"@de .
+<9> <item> <1> .
+<9> <item> <2> .
+<9> <item> <3> .
+""")
+    out = db.query('{ q(func: uid(9)) { item @groupby(label@de) '
+                   '{ count(uid) } } }')["data"]["q"][0]["item"]["@groupby"]
+    assert {(g["label"], g["count"]) for g in out} == \
+        {("rot", 2), ("blau", 1)}

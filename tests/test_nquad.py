@@ -67,3 +67,31 @@ def test_json_facets_and_delete():
 
     dels = parse_json_mutation({"uid": "0x1", "name": None}, delete=True)
     assert dels[0].star
+
+
+def test_multiple_statements_per_line():
+    # the grammar's terminator is '.', not newline — round-1 silently
+    # dropped everything after the first statement on a line
+    nqs = parse_rdf('<1> <name> "a" .  <1> <age> "20" . <2> <name> "b" .')
+    assert [(n.subject, n.predicate) for n in nqs] == \
+        [("1", "name"), ("1", "age"), ("2", "name")]
+
+
+def test_trailing_junk_rejected():
+    import pytest
+    from dgraph_tpu.gql.lexer import GQLError
+    with pytest.raises(GQLError):
+        parse_rdf('<1> <name> "a" . junk')
+
+
+def test_graph_label_term_accepted():
+    # standard N-Quads 4th term: parsed and discarded like the reference
+    nqs = parse_rdf('<1> <name> "a" <http://graph> .')
+    assert len(nqs) == 1 and nqs[0].predicate == "name"
+
+
+def test_missing_terminator_rejected():
+    import pytest
+    from dgraph_tpu.gql.lexer import GQLError
+    with pytest.raises(GQLError):
+        parse_rdf('<1> <name> "a"')
