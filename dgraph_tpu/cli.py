@@ -79,7 +79,9 @@ def cmd_node(args) -> int:
     kw = dict(storage=storage, tick_s=args.tick_ms / 1000.0,
               election_ticks=args.election_ticks)
     if args.kind == "alpha":
-        srv = AlphaServer(args.id, peers, (chost, int(cport)), **kw)
+        zero_addrs = _parse_peers(args.zero) if args.zero else None
+        srv = AlphaServer(args.id, peers, (chost, int(cport)),
+                          group=args.group, zero_addrs=zero_addrs, **kw)
     else:
         srv = ZeroServer(args.id, peers, (chost, int(cport)), **kw)
     print(f"dgraph-tpu {args.kind} node {args.id}: raft "
@@ -395,6 +397,12 @@ def main(argv=None) -> int:
     n.add_argument("--raft-peers", required=True,
                    help="id=host:port,... for every group member")
     n.add_argument("--client-addr", required=True, help="host:port")
+    n.add_argument("--group", type=int, default=1,
+                   help="alpha group id (predicate shard)")
+    n.add_argument("--zero", default="",
+                   help="zero quorum client addrs (id=host:port,...) — "
+                        "enables multi-group mode: tablet ownership "
+                        "checks + zero-leased uid blocks")
     n.add_argument("--wal", default="", help="raft storage directory")
     n.add_argument("--sync", action="store_true")
     n.add_argument("--tick-ms", type=int, default=50)

@@ -60,9 +60,23 @@ class Coordinator:
 
     # -- uid leases (ref zero/assign.go:158) --
 
+    # when set, uid blocks come from the cluster's Zero quorum instead
+    # of the local counter, so every group allocates from ONE disjoint
+    # space (without this, two groups both start at uid 1 and a tablet
+    # move would merge unrelated entities). fn(n) -> first uid.
+    uid_lease_fn = None
+    UID_LEASE_BLOCK = 10_000
+
     def assign_uids(self, n: int) -> tuple[int, int]:
         """Lease [first, last] inclusive."""
         with self._lock:
+            if self.uid_lease_fn is not None:
+                end = getattr(self, "_lease_end", 0)
+                if self._next_uid + n - 1 > end:
+                    block = max(n, self.UID_LEASE_BLOCK)
+                    first = self.uid_lease_fn(block)
+                    self._next_uid = first
+                    self._lease_end = first + block - 1
             first = self._next_uid
             self._next_uid += n
             return first, self._next_uid - 1

@@ -245,12 +245,24 @@ class AlphaServer(RaftServer):
     """
 
     def __init__(self, node_id: int, raft_peers, client_addr,
-                 storage=None, db_kw: Optional[dict] = None, **kw):
+                 storage=None, db_kw: Optional[dict] = None,
+                 group: int = 1,
+                 zero_addrs: Optional[dict] = None, **kw):
         from dgraph_tpu.engine.db import GraphDB
 
+        self.group = group
         self._db_kw = dict(db_kw or {})
         self._db_kw.setdefault("prefer_device", False)
         self.db = GraphDB(**self._db_kw)
+        # multi-group mode: a Zero quorum owns the tablet map and the
+        # uid space; this alpha claims tablets, checks ownership before
+        # every write, and leases uid blocks (ref worker/groups.go
+        # BelongsTo + zero/assign.go lease blocks)
+        self.zero = None
+        if zero_addrs:
+            from dgraph_tpu.cluster.client import ClusterClient
+            self.zero = ClusterClient(zero_addrs, timeout=10.0)
+            self.db.coordinator.uid_lease_fn = self.zero.assign_uids
         # committed event stream: authoritative rebuild source
         self._events: list[tuple] = []
         # serializes execute+propose so the log's record order matches
@@ -281,7 +293,10 @@ class AlphaServer(RaftServer):
         from dgraph_tpu.engine.db import GraphDB
         from dgraph_tpu.storage.snapshot import restore_state
         self._events = [("snap", snap)]
-        self.db = restore_state(wire.loads_compat(snap), GraphDB(**self._db_kw))
+        db = restore_state(wire.loads_compat(snap),
+                           GraphDB(**self._db_kw))
+        db.coordinator.uid_lease_fn = self.db.coordinator.uid_lease_fn
+        self.db = db
 
     def _rebuild_from_events(self):
         """Quorum lost mid-write: discard un-replicated local state
@@ -290,19 +305,55 @@ class AlphaServer(RaftServer):
         from dgraph_tpu.storage.snapshot import restore_state
         self.epoch += 1  # own-origin records must re-apply from now on
         db = GraphDB(**self._db_kw)
+        db.coordinator.uid_lease_fn = self.db.coordinator.uid_lease_fn
         for kind, payload in self._events:
             if kind == "snap":
                 db = restore_state(wire.loads_compat(payload), db)
             else:
-                ts = db.apply_record(payload)
+                # apply a COPY: the rebuilt engine's tablets must not
+                # alias the event-stream payloads (rollup mutates
+                # tablet state in place)
+                ts = db.apply_record(wire.loads(wire.dumps(payload)))
                 if ts:
                     db.fast_forward_ts(ts)
         self.db = db
 
     # --------------------------------------------------------------- writes
 
-    def _replicate_write(self, fn) -> Any:
+    def _check_ownership(self, preds):
+        """Multi-group mode: every touched predicate must be served by
+        THIS group per Zero's map; unclaimed predicates are claimed,
+        mid-move tablets reject writes (ref zero.go ShouldServe +
+        oracle's tablet checks at commit). Caller holds _write_lock, so
+        a concurrent export (which also takes it) serializes against
+        in-flight writes."""
+        if self.zero is None:
+            return
+        tmap = self.zero.request({"op": "tablet_map"})
+        if not tmap.get("ok"):
+            raise RuntimeError("zero unreachable; cannot verify "
+                               "tablet ownership")
+        tablets = tmap["result"]["tablets"]
+        moving = tmap["result"]["moving"]
+        for p in preds:
+            if p == "*" or p.startswith("dgraph."):
+                continue
+            if p in moving:
+                raise RuntimeError(
+                    f"tablet {p!r} is being moved; retry shortly")
+            owner = tablets.get(p)
+            if owner is None:
+                got = self.zero.tablet(p, self.group)
+                if got != self.group:
+                    raise RuntimeError(
+                        f"tablet {p!r} belongs to group {got}")
+            elif owner != self.group:
+                raise RuntimeError(
+                    f"tablet {p!r} belongs to group {owner}")
+
+    def _replicate_write(self, fn, preds=()) -> Any:
         with self._write_lock:
+            self._check_ownership(preds)
             with self.lock:
                 if self.node.role != LEADER:
                     raise NotLeader(self.node.leader_id)
@@ -322,6 +373,33 @@ class AlphaServer(RaftServer):
                         "write not replicated (no quorum)")
             return result
 
+    @staticmethod
+    def _mutation_preds(kw: dict) -> set:
+        from dgraph_tpu.server.acl import nquad_predicates
+        preds = set(nquad_predicates(
+            kw.get("set_nquads", ""), kw.get("del_nquads", ""),
+            kw.get("set_json"), kw.get("delete_json")))
+        return {p.lstrip("~") for p in preds}
+
+    def _replicate_record(self, rec) -> None:
+        """Apply a pre-built engine record on the leader and replicate
+        it (tablet import/drop — records that don't come from a txn
+        sink). The leader applies a deep COPY: the log/_events keep the
+        original payload, and later in-place tablet mutations (rollup
+        folds) must never rewrite replicated history."""
+        with self._write_lock:
+            with self.lock:
+                if self.node.role != LEADER:
+                    raise NotLeader(self.node.leader_id)
+                ts = self.db.apply_record(wire.loads(wire.dumps(rec)))
+                if ts:
+                    self.db.fast_forward_ts(ts)
+            ok, _ = self.propose_and_wait(rec)
+            if not ok:
+                with self.lock:
+                    self._rebuild_from_events()
+                raise RuntimeError("record not replicated (no quorum)")
+
     # ----------------------------------------------------------------- RPC
 
     def handle_request(self, req: dict) -> dict:
@@ -334,8 +412,12 @@ class AlphaServer(RaftServer):
                 out = self.db.query(req["q"], variables=req.get("vars"))
             return {"ok": True, "result": out}
         if op == "mutate":
+            kw = dict(req["kw"])
+            kw.pop("commit_now", None)  # the RPC always commits
+            preds = self._mutation_preds(kw) if self.zero else ()
             out = self._replicate_write(
-                lambda db: db.mutate(commit_now=True, **req["kw"]))
+                lambda db: db.mutate(commit_now=True, **kw),
+                preds=preds)
             return {"ok": True, "result": out}
         if op == "alter":
             self._replicate_write(lambda db: db.alter(**req["kw"]))
@@ -343,11 +425,38 @@ class AlphaServer(RaftServer):
         if op == "status":
             with self.lock:
                 return {"ok": True, "result": {
-                    "id": self.id, "role": self.node.role,
+                    "id": self.id, "group": self.group,
+                    "role": self.node.role,
                     "leader": self.node.leader_id,
                     "term": self.node.term,
                     "applied": self.node.applied_index,
+                    "tablets": sorted(self.db.tablets),
                     "max_ts": self.db.coordinator.max_assigned()}}
+        if op == "export_tablet":
+            # tablet move, source side (worker/predicate_move.go:81).
+            # _write_lock serializes against in-flight writes: anything
+            # committed before the export is in the blob; anything
+            # after re-checks Zero's map and sees the moving mark.
+            with self._write_lock:
+                with self.lock:
+                    if self.node.role != LEADER:
+                        raise NotLeader(self.node.leader_id)
+                    pred = req["pred"]
+                    if pred not in self.db.tablets:
+                        return {"ok": False, "error":
+                                f"tablet {pred!r} not served here"}
+                    blob = wire.dumps(self.db.export_tablet(pred))
+            return {"ok": True, "result": blob}
+        if op == "import_tablet":
+            # destination side: replicate the whole tablet as one
+            # record so every group replica installs it
+            payload = wire.loads(req["blob"])
+            self._replicate_record(
+                ("import_tablet", req["pred"], payload))
+            return {"ok": True, "result": {}}
+        if op == "drop_tablet":
+            self._replicate_record(("drop_attr", req["pred"]))
+            return {"ok": True, "result": {}}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
 
@@ -386,7 +495,20 @@ class ZeroServer(RaftServer):
                     "leader": self.node.leader_id,
                     "max_ts": self.state.max_ts,
                     "next_uid": self.state.next_uid}}
-        if op in ("assign_ts", "assign_uids", "commit", "tablet"):
+        if op == "tablet_map":
+            # routing table read (ref zero.go:410 /state) — leader-only
+            # so a lagging follower can never serve a stale map that
+            # routes writes to a tablet's old owner after a move
+            with self.lock:
+                if self.node.role != LEADER:
+                    raise NotLeader(self.node.leader_id)
+                return {"ok": True, "result": {
+                    "tablets": dict(self.state.tablets),
+                    "moving": dict(self.state.moving),
+                    "sizes": dict(self.state.sizes)}}
+        if op in ("assign_ts", "assign_uids", "commit", "tablet",
+                  "tablet_move_start", "tablet_move_done",
+                  "tablet_move_abort", "tablet_size"):
             with self.lock:
                 if self.node.role != LEADER:
                     raise NotLeader(self.node.leader_id)

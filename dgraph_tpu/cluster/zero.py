@@ -13,6 +13,13 @@ Commands (payload = (op, args)):
   ("assign_uids",(n,))                -> first uid of a lease of n
   ("commit",     (start_ts, keys))    -> commit_ts, or 0 = conflict abort
   ("tablet",     (pred, group))       -> owning group id (first claim wins)
+  ("tablet_move_start", (pred, dst))  -> True once the tablet is marked
+                                         read-only for the move
+  ("tablet_move_done", (pred, dst))   -> flips ownership + clears the
+                                         moving mark (zero/tablet.go:62)
+  ("tablet_size", (pred, bytes))      -> records a size report (the
+                                         rebalancer's input,
+                                         zero/tablet.go:180)
 """
 
 from __future__ import annotations
@@ -28,6 +35,8 @@ class ZeroState:
         # (zero/oracle.go commits map)
         self.commits: dict[int, int] = {}
         self.tablets: dict[str, int] = {}
+        self.moving: dict[str, int] = {}   # pred -> destination group
+        self.sizes: dict[str, int] = {}    # pred -> reported bytes
 
     # ------------------------------------------------------------- apply
 
@@ -56,6 +65,30 @@ class ZeroState:
         if op == "tablet":
             pred, group = args
             return self.tablets.setdefault(pred, int(group))
+        if op == "tablet_move_start":
+            pred, dst = args
+            if pred not in self.tablets or \
+                    self.tablets[pred] == int(dst) or pred in self.moving:
+                return False
+            self.moving[pred] = int(dst)
+            return True
+        if op == "tablet_move_done":
+            pred, dst = args
+            if self.moving.get(pred) != int(dst):
+                return False
+            self.tablets[pred] = int(dst)
+            del self.moving[pred]
+            return True
+        if op == "tablet_move_abort":
+            pred, dst = args
+            if self.moving.get(pred) != int(dst):
+                return False
+            del self.moving[pred]  # ownership unchanged, writes resume
+            return True
+        if op == "tablet_size":
+            pred, nbytes = args
+            self.sizes[pred] = int(nbytes)
+            return True
         raise ValueError(f"unknown zero command {op!r}")
 
     # --------------------------------------------------------- snapshots
@@ -63,7 +96,9 @@ class ZeroState:
     def snapshot(self) -> dict:
         return {"max_ts": self.max_ts, "next_uid": self.next_uid,
                 "commits": dict(self.commits),
-                "tablets": dict(self.tablets)}
+                "tablets": dict(self.tablets),
+                "moving": dict(self.moving),
+                "sizes": dict(self.sizes)}
 
     @classmethod
     def from_snapshot(cls, snap: dict) -> "ZeroState":
@@ -72,4 +107,6 @@ class ZeroState:
         st.next_uid = snap["next_uid"]
         st.commits = dict(snap["commits"])
         st.tablets = dict(snap["tablets"])
+        st.moving = dict(snap.get("moving", {}))
+        st.sizes = dict(snap.get("sizes", {}))
         return st

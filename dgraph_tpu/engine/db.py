@@ -522,9 +522,29 @@ class GraphDB:
             self.schema = SchemaState()
             return 0
         if kind == "drop_attr":
-            self.tablets.pop(rec[1], None)
+            dropped = self.tablets.pop(rec[1], None)
+            if dropped is not None:
+                self.device_cache.drop_tablet(dropped)
             self.schema.delete_predicate(rec[1])
             return 0
+        if kind == "import_tablet":
+            # predicate move landing on the destination group
+            # (ref worker/predicate_move.go:178 ReceivePredicate);
+            # the whole tablet arrives as one replicated record so
+            # every group replica installs identical state
+            _, pred, payload = rec
+            from dgraph_tpu.storage.snapshot import restore_tablet
+            if not self.schema.has(pred):
+                self.schema.apply_text(payload["schema"])
+            tab = restore_tablet(pred, self.schema.get_or_default(pred),
+                                 payload["tablet"])
+            old = self.tablets.get(pred)
+            if old is not None:
+                self.device_cache.drop_tablet(old)
+            self.tablets[pred] = tab
+            self.coordinator.should_serve(pred)
+            self.coordinator.bump_uids(payload.get("max_uid", 0))
+            return payload.get("max_ts", 0)
         if kind == "commit":
             _, commit_ts, staged, schemas = rec
             # restore on-the-fly schema before creating tablets
@@ -630,6 +650,29 @@ class GraphDB:
         return levels
 
     # -- maintenance --
+
+    def export_tablet(self, pred: str) -> dict:
+        """One predicate's full state for a tablet move
+        (ref worker/predicate_move.go:81 movePredicateHelper streams
+        the posting lists; here the rolled-up base ships as one wire
+        payload). Refuses to export while committed deltas cannot fold
+        (an open txn pins the watermark) — shipping only the base would
+        silently drop them once the source drops the tablet."""
+        from dgraph_tpu.storage.snapshot import dump_tablet
+        tab = self.tablets[pred]
+        if tab.dirty():
+            tab.rollup(self.coordinator.min_active_ts())
+        if tab.dirty():
+            raise RuntimeError(
+                f"tablet {pred!r} still has unfolded deltas (an open "
+                "transaction pins the rollup watermark); retry when "
+                "transactions drain")
+        return {
+            "schema": tab.schema.describe(),
+            "tablet": dump_tablet(tab),
+            "max_ts": self.coordinator.max_assigned(),
+            "max_uid": self.coordinator._next_uid - 1,
+        }
 
     def rollup_all(self):
         wm = self.coordinator.min_active_ts()

@@ -26,20 +26,38 @@ def _load_payload(blob: bytes):
 SNAPSHOT_MAGIC = b"DGTPU-SNAP-1"
 
 
+def dump_tablet(tab) -> dict:
+    """One tablet's base state — the single wire shape shared by
+    snapshots, backups and tablet moves. Add new Tablet fields HERE."""
+    return {
+        "edges": tab.edges,
+        "reverse": tab.reverse,
+        "values": tab.values,
+        "index": tab.index,
+        "edge_facets": tab.edge_facets,
+        "base_ts": tab.base_ts,
+    }
+
+
+def restore_tablet(pred: str, schema, st: dict):
+    """Inverse of dump_tablet -> a fresh Tablet."""
+    from dgraph_tpu.storage.tablet import Tablet
+    tab = Tablet(pred, schema)
+    tab.edges = st["edges"]
+    tab.reverse = st["reverse"]
+    tab.values = st["values"]
+    tab.index = st["index"]
+    tab.edge_facets = st["edge_facets"]
+    tab.base_ts = st["base_ts"]
+    return tab
+
+
 def dump_state(db) -> dict:
     """GraphDB -> one picklable state payload at a single ts. Pending
     deltas are folded first so the payload is pure base state."""
     db.rollup_all()
-    tablets = {}
-    for pred, tab in db.tablets.items():
-        tablets[pred] = {
-            "edges": tab.edges,
-            "reverse": tab.reverse,
-            "values": tab.values,
-            "index": tab.index,
-            "edge_facets": tab.edge_facets,
-            "base_ts": tab.base_ts,
-        }
+    tablets = {pred: dump_tablet(tab)
+               for pred, tab in db.tablets.items()}
     return {
         "schema": db.schema.describe_all(),
         "tablets": tablets,
@@ -51,20 +69,12 @@ def dump_state(db) -> dict:
 def restore_state(payload: dict, db=None):
     """State payload -> GraphDB (fresh one by default)."""
     from dgraph_tpu.engine.db import GraphDB
-    from dgraph_tpu.storage.tablet import Tablet
 
     db = db or GraphDB()
     db.alter(payload["schema"])
     for pred, st in payload["tablets"].items():
         ps = db.schema.get_or_default(pred)
-        tab = Tablet(pred, ps)
-        tab.edges = st["edges"]
-        tab.reverse = st["reverse"]
-        tab.values = st["values"]
-        tab.index = st["index"]
-        tab.edge_facets = st["edge_facets"]
-        tab.base_ts = st["base_ts"]
-        db.tablets[pred] = tab
+        db.tablets[pred] = restore_tablet(pred, ps, st)
         db.coordinator.should_serve(pred)
     while db.coordinator.max_assigned() < payload["max_ts"]:
         db.coordinator.next_ts()
