@@ -49,8 +49,13 @@ def cmd_alpha(args) -> int:
             secret = f.read().strip()
     print(f"dgraph-tpu alpha listening on http://{args.host}:{args.port}"
           + (" (ACL on)" if secret else ""), file=sys.stderr)
+    tls_ctx = None
+    if args.tls_dir:
+        from dgraph_tpu.server.tls import server_context
+        tls_ctx = server_context(args.tls_dir,
+                                 require_client_cert=args.tls_mtls)
     serve(db, host=args.host, port=args.port, block=True,
-          acl_secret=secret)
+          acl_secret=secret, tls_context=tls_ctx)
     return 0
 
 
@@ -303,6 +308,92 @@ def cmd_debug(args) -> int:
     return 0
 
 
+def cmd_cert(args) -> int:
+    """TLS certificate management (ref `dgraph cert`, dgraph/cmd/cert/)."""
+    from dgraph_tpu.server import tls as tlsmod
+
+    if args.cert_op == "ls":
+        print(json.dumps(tlsmod.describe(args.dir), indent=2))
+        return 0
+    import os as _os
+    if not _os.path.exists(_os.path.join(args.dir, "ca.crt")):
+        tlsmod.create_ca(args.dir, days=args.duration)
+        print(f"created CA in {args.dir}", file=sys.stderr)
+    if args.cert_op in ("node", "create"):
+        hosts = tuple(h for h in args.nodes.split(",") if h)
+        crt, key = tlsmod.create_pair(args.dir, "node", hosts=hosts,
+                                      days=args.duration)
+        print(f"node pair: {crt}, {key}", file=sys.stderr)
+    if args.client:
+        crt, key = tlsmod.create_pair(args.dir, "client", args.client,
+                                      days=args.duration)
+        print(f"client pair: {crt}, {key}", file=sys.stderr)
+    return 0
+
+
+def cmd_conv(args) -> int:
+    """GeoJSON -> RDF (ref `dgraph conv`, dgraph/cmd/conv/)."""
+    from dgraph_tpu.ingest.convert import convert_geojson
+
+    with open(args.geo) as fin, open(args.out, "w") as fout:
+        stats = convert_geojson(fin, fout, geopred=args.geopred)
+    print(json.dumps(stats))
+    return 0
+
+
+def cmd_migrate(args) -> int:
+    """SQL -> RDF + schema (ref `dgraph migrate`, dgraph/cmd/migrate/;
+    sqlite is the SQL source here — the table/row/foreign-key mapping
+    matches the reference's MySQL walker)."""
+    from dgraph_tpu.ingest.convert import migrate_sqlite
+
+    with open(args.output_data, "w") as rdf, \
+            open(args.output_schema, "w") as sch:
+        stats = migrate_sqlite(args.db, rdf, sch,
+                               separator=args.separator)
+    print(json.dumps(stats))
+    return 0
+
+
+def cmd_debuginfo(args) -> int:
+    """Collect a diagnostics archive (ref `dgraph debuginfo`,
+    dgraph/cmd/debuginfo: pprof + state; here: /health /state /metrics
+    + thread stacks + env)."""
+    import faulthandler
+    import io
+    import platform
+    import tarfile
+    import time as _time
+    import urllib.request
+
+    files: dict[str, bytes] = {}
+    if args.alpha:
+        base = f"http://{args.alpha}"
+        for path in ("/health", "/state", "/debug/prometheus_metrics"):
+            try:
+                files[path.strip("/").replace("/", "_")] = \
+                    urllib.request.urlopen(base + path, timeout=5).read()
+            except Exception as e:  # noqa: BLE001 — capture what we can
+                files[path.strip("/").replace("/", "_") + ".error"] = \
+                    str(e).encode()
+    import tempfile
+    with tempfile.TemporaryFile(mode="w+") as tf:
+        faulthandler.dump_traceback(file=tf)
+        tf.seek(0)
+        files["threads.txt"] = tf.read().encode()
+    files["platform.txt"] = "\n".join([
+        platform.platform(), platform.python_version(),
+        f"argv={sys.argv}"]).encode()
+    out = args.archive or f"debuginfo-{int(_time.time())}.tar.gz"
+    with tarfile.open(out, "w:gz") as tar:
+        for name, data in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    print(out)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="dgraph-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -321,6 +412,10 @@ def main(argv=None) -> int:
     a.add_argument("--encryption_key_file",
                    default=_env_default("alpha", "encryption_key_file", ""),
                    help="AES key file: encrypts WAL records at rest")
+    a.add_argument("--tls-dir", default="",
+                   help="serve HTTPS from this cert dir (see `cert`)")
+    a.add_argument("--tls-mtls", action="store_true",
+                   help="require client certificates (mTLS)")
     a.set_defaults(fn=cmd_alpha)
 
     acl = sub.add_parser("acl", help="ACL admin on a store directory")
@@ -408,6 +503,35 @@ def main(argv=None) -> int:
     n.add_argument("--tick-ms", type=int, default=50)
     n.add_argument("--election-ticks", type=int, default=10)
     n.set_defaults(fn=cmd_node)
+
+    ct = sub.add_parser("cert", help="TLS certificate management")
+    ct.add_argument("cert_op", choices=["create", "node", "ls"],
+                    nargs="?", default="create")
+    ct.add_argument("--dir", default="tls")
+    ct.add_argument("--nodes", default="localhost,127.0.0.1",
+                    help="node cert SAN hosts, comma separated")
+    ct.add_argument("--client", default="", help="issue a client pair")
+    ct.add_argument("--duration", type=int, default=730, help="days")
+    ct.set_defaults(fn=cmd_cert)
+
+    cv = sub.add_parser("conv", help="GeoJSON -> RDF converter")
+    cv.add_argument("--geo", required=True)
+    cv.add_argument("--out", default="output.rdf")
+    cv.add_argument("--geopred", default="loc")
+    cv.set_defaults(fn=cmd_conv)
+
+    mg = sub.add_parser("migrate", help="SQL (sqlite) -> RDF + schema")
+    mg.add_argument("--db", required=True, help="sqlite database file")
+    mg.add_argument("--output-data", default="sql.rdf")
+    mg.add_argument("--output-schema", default="schema.txt")
+    mg.add_argument("--separator", default=".")
+    mg.set_defaults(fn=cmd_migrate)
+
+    di = sub.add_parser("debuginfo", help="collect diagnostics archive")
+    di.add_argument("--alpha", default="",
+                    help="alpha host:port to scrape state/metrics from")
+    di.add_argument("--archive", default="")
+    di.set_defaults(fn=cmd_debuginfo)
 
     args = p.parse_args(argv)
     return args.fn(args)

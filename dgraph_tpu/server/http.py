@@ -540,13 +540,24 @@ class _Handler(BaseHTTPRequestHandler):
 
 def serve(db: Optional[GraphDB] = None, host: str = "127.0.0.1",
           port: int = 8080, block: bool = True,
-          acl_secret: Optional[bytes] = None
+          acl_secret: Optional[bytes] = None,
+          tls_context=None
           ) -> tuple[ThreadingHTTPServer, AlphaServer]:
     """Start the Alpha HTTP server. With block=False, runs in a daemon
-    thread and returns (httpd, alpha) for tests/embedding."""
+    thread and returns (httpd, alpha) for tests/embedding. Pass an
+    ssl.SSLContext (server/tls.py server_context) to serve HTTPS/mTLS
+    like the reference's --tls options (x/tls_helper.go)."""
     alpha = AlphaServer(db, acl_secret=acl_secret)
     handler = type("BoundHandler", (_Handler,), {"alpha": alpha})
     httpd = ThreadingHTTPServer((host, port), handler)
+    if tls_context is not None:
+        # defer the handshake to the per-request handler thread: with
+        # the default handshake-on-accept, one client that connects and
+        # never sends a ClientHello would block the single accept loop
+        # for everyone
+        httpd.socket = tls_context.wrap_socket(
+            httpd.socket, server_side=True,
+            do_handshake_on_connect=False)
     if block:
         httpd.serve_forever()
     else:
