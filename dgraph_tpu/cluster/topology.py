@@ -11,17 +11,29 @@ predicates on first write (least-loaded group), refuses writes to
 tablets mid-move, and orchestrates live tablet moves
 (export -> import -> flip -> drop).
 
-Round-2 scope note: a single request's predicates must resolve to ONE
-group (cross-group joins — the reference's scatter-gather across
-groups — stay on the roadmap; the storage/move/routing substrate here
-is what they build on).
+Cross-group contract: a document whose top-level blocks touch
+different groups scatters block-wise and gathers (each block's result
+comes from its owning group). A SINGLE block spanning groups, or
+variables flowing between blocks on different groups, reject — those
+would need cross-group joins, which the predicate-sharded store does
+not do (mutations likewise must resolve to one group).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 from dgraph_tpu.cluster.client import ClusterClient
+
+
+class SpanGroupsError(RuntimeError):
+    """A request's predicates resolve to more than one group."""
+
+    def __init__(self, preds, owners):
+        super().__init__(
+            f"predicates {sorted(preds)} span groups {sorted(owners)}")
+        self.preds = preds
+        self.owners = owners
 
 
 class RoutedCluster:
@@ -57,11 +69,13 @@ class RoutedCluster:
                 parse(kw["query"], kw.get("variables"))))
         return {p.lstrip("~") for p in preds if p != "*"}
 
-    def _group_for(self, preds: set[str], claim: bool) -> int:
+    def _group_for(self, preds: set[str], claim: bool,
+                   tmap: Optional[dict] = None) -> int:
         """Resolve the single group serving `preds`; with claim=True,
         unowned predicates are claimed for the chosen group (ref
         zero.go ShouldServe: first writer claims the tablet)."""
-        tmap = self.tablet_map()
+        if tmap is None:
+            tmap = self.tablet_map()
         moving = tmap["moving"]
         for p in preds:
             if p in moving:
@@ -70,10 +84,7 @@ class RoutedCluster:
         owners = {tmap["tablets"][p] for p in preds
                   if p in tmap["tablets"]}
         if len(owners) > 1:
-            raise RuntimeError(
-                f"predicates {sorted(preds)} span groups "
-                f"{sorted(owners)}; cross-group requests are not "
-                "supported yet")
+            raise SpanGroupsError(preds, owners)
         unowned = [p for p in preds if p not in tmap["tablets"]]
         if owners:
             gid = owners.pop()
@@ -111,9 +122,126 @@ class RoutedCluster:
         return self.groups[gid].mutate(**kw)
 
     def query(self, q: str, variables: Optional[dict] = None) -> dict:
-        preds = self._preds_of_query(q, variables)
-        gid = self._group_for(preds, claim=False)
+        """Route to the owning group; when a document's top-level
+        blocks touch DIFFERENT groups, scatter block-wise and gather
+        (the reference fans per-attr tasks to group leaders,
+        worker/task.go:131; block-level is the coarser granularity the
+        predicate-sharded store supports without cross-group joins —
+        blocks connected by variables must stay within one group)."""
+        from dgraph_tpu.gql import parse
+        from dgraph_tpu.server.acl import query_predicates
+
+        parsed = parse(q, variables)
+        preds = {p.lstrip("~") for p in query_predicates(parsed)}
+        tmap = self.tablet_map()
+        try:
+            gid = self._group_for(preds, claim=False, tmap=tmap)
+        except SpanGroupsError:
+            # one map drives both the span decision and the per-block
+            # assignment — no second fetch, no TOCTOU between them
+            return self._scatter_query(q, variables, parsed,
+                                       tmap["tablets"])
         return self.groups[gid].query(q, variables)
+
+    def _scatter_query(self, q: str, variables: Optional[dict],
+                       parsed, tmap: dict) -> dict:
+        from dgraph_tpu.server.acl import block_predicates
+
+        # assign each top-level block to its owning group; blocks
+        # sharing variables must land on ONE group (a var defined in
+        # group A cannot feed a block served by group B)
+        var_home: dict[str, int] = {}
+        assign: list[tuple[int, Any]] = []
+        for gq in parsed.queries:
+            bpreds = {p.lstrip("~") for p in block_predicates(gq)}
+            owners = {tmap[p] for p in bpreds if p in tmap}
+            if len(owners) > 1:
+                raise RuntimeError(
+                    f"block {gq.alias!r} touches predicates from "
+                    f"groups {sorted(owners)}; move the tablets "
+                    "together to join them")
+            gid = owners.pop() if owners else min(self.groups)
+            for vc in self._block_var_uses(gq):
+                home = var_home.get(vc)
+                if home is not None and home != gid:
+                    raise RuntimeError(
+                        f"variable {vc!r} crosses groups {home} and "
+                        f"{gid}; cross-group variables are not "
+                        "supported — move the tablets together")
+                var_home[vc] = gid
+            assign.append((gid, gq))
+
+        # the full document runs on every involved group (var chains
+        # assigned to that group resolve completely there); each
+        # block's RESULT is taken from its owning group only
+        merged: dict = {"data": {}, "extensions": {"scatter": []}}
+        for gid in sorted({g for g, _ in assign}):
+            out = self.groups[gid].query(q, variables)
+            data = out.get("data", {})
+            # response shape must not depend on tablet placement:
+            # carry extensions like the single-group path does
+            for k, v in out.get("extensions", {}).items():
+                merged["extensions"].setdefault(k, v)
+            merged["extensions"]["scatter"].append(gid)
+            for g, gq in assign:
+                if g != gid or gq.alias == "var":
+                    continue
+                key = gq.alias
+                if key in data:
+                    merged["data"][key] = data[key]
+                if gq.attr == "shortest" and "_path_" in data:
+                    merged["data"]["_path_"] = data["_path_"]
+        return merged
+
+    @staticmethod
+    def _block_var_uses(gq) -> set[str]:
+        """Every variable a block defines or consumes — including
+        filter trees, shortest from/to, expand(var), math trees and
+        facet vars; missing any of these would let a cross-group var
+        slip past the guard and silently resolve empty."""
+        names = set()
+
+        def walk_filter(ft):
+            if ft is None:
+                return
+            if ft.func is not None:
+                for vc in ft.func.needs_var:
+                    names.add(vc.name)
+            for c in ft.children:
+                walk_filter(c)
+
+        def walk_math(mt):
+            if mt is None:
+                return
+            if mt.var:
+                names.add(mt.var)
+            for c in mt.children:
+                walk_math(c)
+
+        def walk(g):
+            if g.var:
+                names.add(g.var)
+            for vc in g.needs_var:
+                names.add(vc.name)
+            if g.func:
+                for vc in g.func.needs_var:
+                    names.add(vc.name)
+            walk_filter(g.filter)
+            if g.shortest is not None:
+                for fn in (g.shortest.from_, g.shortest.to):
+                    if fn is not None:
+                        for vc in fn.needs_var:
+                            names.add(vc.name)
+            if getattr(g, "expand", ""):
+                names.add(g.expand)  # may be a var (or _all_/a type)
+            walk_math(getattr(g, "math", None))
+            for v in getattr(g, "facet_var", {}).values():
+                names.add(v)
+            for c in g.children:
+                walk(c)
+
+        walk(gq)
+        return names
 
     # --------------------------------------------------------- tablet move
 

@@ -101,10 +101,9 @@ def jwt_decode(token: str, secret: bytes) -> dict:
 # ------------------------------------------------------- predicate walks
 
 
-def query_predicates(parsed) -> list[str]:
-    """All predicates a parsed query touches (blocks, children, funcs,
-    filters, order) — the reference's parsePredsFromQuery
-    (access_ee.go:670 area)."""
+def block_predicates(gq) -> set[str]:
+    """Predicates ONE query block touches (its func, filters, order,
+    groupby and children, recursively)."""
     preds: set[str] = set()
 
     def walk_filter(ft):
@@ -115,23 +114,32 @@ def query_predicates(parsed) -> list[str]:
         for ch in ft.children:
             walk_filter(ch)
 
-    def walk(gq):
-        if gq.attr and not gq.is_internal:
-            preds.add(gq.attr)
-        if gq.func is not None and gq.func.attr:
-            preds.add(gq.func.attr)
-        walk_filter(gq.filter)
-        for o in gq.order:
-            preds.add(o.attr)
-        for g in gq.groupby:
+    def walk(g):
+        if g.attr and not g.is_internal:
             preds.add(g.attr)
-        for ch in gq.children:
+        if g.func is not None and g.func.attr:
+            preds.add(g.func.attr)
+        walk_filter(g.filter)
+        for o in g.order:
+            preds.add(o.attr)
+        for gb in g.groupby:
+            preds.add(gb.attr)
+        for ch in g.children:
             walk(ch)
 
-    for gq in parsed.queries:
-        walk(gq)
+    walk(gq)
     preds.discard("uid")
-    return sorted(p for p in preds if p)
+    return {p for p in preds if p}
+
+
+def query_predicates(parsed) -> list[str]:
+    """All predicates a parsed query touches (blocks, children, funcs,
+    filters, order) — the reference's parsePredsFromQuery
+    (access_ee.go:670 area)."""
+    preds: set[str] = set()
+    for gq in parsed.queries:
+        preds |= block_predicates(gq)
+    return sorted(preds)
 
 
 def nquad_predicates(set_nq: str = "", del_nq: str = "",

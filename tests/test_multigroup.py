@@ -106,10 +106,15 @@ def test_queries_route_to_owning_group(cluster):
     assert out["data"]["q"] == [{"p2": "y"}]
 
 
-def test_cross_group_request_rejected(cluster):
+def test_cross_group_blocks_scatter(cluster):
+    # independent blocks on different groups now scatter-gather
+    # (block-level federation); a SINGLE block spanning groups still
+    # rejects — that would need a cross-group join
     rc = cluster
-    with pytest.raises(RuntimeError, match="span groups"):
-        rc.query('{ a(func: has(p1)) { p1 } b(func: has(p2)) { p2 } }')
+    out = rc.query('{ a(func: has(p1)) { p1 } b(func: has(p2)) { p2 } }')
+    assert out["data"]["a"] and out["data"]["b"]
+    with pytest.raises(RuntimeError, match="touches predicates from"):
+        rc.query('{ a(func: has(p1)) @filter(has(p2)) { p1 } }')
 
 
 def test_live_tablet_move(cluster):
@@ -183,3 +188,65 @@ def test_export_refuses_unfolded_deltas():
         db.export_tablet("e")
     db.discard(pin)
     assert db.export_tablet("e")["tablet"]["base_ts"] > 0
+
+
+def test_cross_group_scatter_gather(cluster):
+    """Independent blocks touching different groups scatter per group
+    and the results merge — only var-connected blocks must colocate
+    (ref worker/task.go:131 per-attr routing, block granularity)."""
+    rc = cluster
+    rc.mutate(set_nquads='_:x <p1> "scatter1" .')
+    rc.mutate(set_nquads='_:y <p3> <0x1> .')
+    m = rc.tablet_map()["tablets"]
+    if m.get("p3") == m["p1"]:
+        # claim a new pred on the other group by writing through it
+        other = 2 if m["p1"] == 1 else 1
+        rc.groups[other].mutate(set_nquads='_:z <p9> "other-side" .')
+        assert rc.tablet_map()["tablets"]["p9"] != m["p1"]
+        out = rc.query('{ a(func: eq(p1, "scatter1")) { p1 } '
+                       '  b(func: eq(p9, "other-side")) { p9 } }')
+    else:
+        out = rc.query('{ a(func: eq(p1, "scatter1")) { p1 } '
+                       '  b(func: has(p3)) { uid } }')
+    assert out["data"]["a"] == [{"p1": "scatter1"}]
+    assert len(out["data"]["b"]) >= 1
+
+
+def test_cross_group_variable_rejected(cluster):
+    rc = cluster
+    m = rc.tablet_map()["tablets"]
+    g_p1 = m["p1"]
+    other_pred = next((p for p, g in m.items()
+                       if g != g_p1 and p.startswith("p")), None)
+    assert other_pred is not None
+    with pytest.raises(RuntimeError, match="crosses groups"):
+        rc.query('{ v as var(func: has(p1)) '
+                 '  q(func: uid(v)) @filter(has(%s)) { uid } }'
+                 % other_pred)
+
+
+def test_cross_group_filter_variable_rejected(cluster):
+    """Review regression: a var consumed inside a FILTER tree (not a
+    root func) must also trip the cross-group guard, not silently
+    resolve empty."""
+    rc = cluster
+    m = rc.tablet_map()["tablets"]
+    g_p1 = m["p1"]
+    other_pred = next((p for p, g in m.items()
+                       if g != g_p1 and p.startswith("p")), None)
+    assert other_pred is not None
+    with pytest.raises(RuntimeError, match="crosses groups"):
+        rc.query('{ v as var(func: has(p1)) '
+                 '  q(func: has(%s)) @filter(uid(v)) { uid } }'
+                 % other_pred)
+
+
+def test_scatter_keeps_extensions(cluster):
+    rc = cluster
+    m = rc.tablet_map()["tablets"]
+    g_p1 = m["p1"]
+    other_pred = next((p for p, g in m.items()
+                       if g != g_p1 and p.startswith("p")), None)
+    out = rc.query('{ a(func: has(p1)) { p1 } b(func: has(%s)) '
+                   '{ uid } }' % other_pred)
+    assert "extensions" in out and len(out["extensions"]["scatter"]) == 2
