@@ -54,8 +54,28 @@ def cmd_alpha(args) -> int:
         from dgraph_tpu.server.tls import server_context
         tls_ctx = server_context(args.tls_dir,
                                  require_client_cert=args.tls_mtls)
-    serve(db, host=args.host, port=args.port, block=True,
-          acl_secret=secret, tls_context=tls_ctx)
+    httpd, alpha = serve(db, host=args.host, port=args.port, block=False,
+                         acl_secret=secret, tls_context=tls_ctx)
+    grpc_srv = None
+    if args.grpc_port:
+        from dgraph_tpu.server.grpc_api import serve_grpc
+        # the gRPC listener inherits the SAME TLS posture as HTTP —
+        # --tls-dir must never leave a cleartext side door open
+        grpc_srv, gport = serve_grpc(
+            alpha, host=args.host, port=args.grpc_port,
+            tls_dir=args.tls_dir, require_client_cert=args.tls_mtls)
+        print(f"dgraph-tpu alpha gRPC on {args.host}:{gport}"
+              + (" (TLS)" if args.tls_dir else ""), file=sys.stderr)
+    try:
+        import time as _time
+        while True:  # interruptible on every platform
+            _time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        if grpc_srv is not None:
+            grpc_srv.stop(grace=2).wait()
     return 0
 
 
@@ -394,6 +414,58 @@ def cmd_debuginfo(args) -> int:
     return 0
 
 
+def cmd_compose(args) -> int:
+    """Generate a cluster topology launcher (ref compose/compose.go:
+    the reference emits docker-compose.yml for N zeros x G groups x R
+    replicas; here the artifact is a runnable shell script plus a JSON
+    topology map for RoutedCluster)."""
+    zeros = args.num_zeros
+    groups = args.num_groups
+    replicas = args.num_replicas
+    port = args.base_port
+    lines = ["#!/bin/sh", "# generated by dgraph-tpu compose",
+             "set -e", 'mkdir -p "$(dirname "$0")/wal"', ""]
+    topo: dict = {"zero": {}, "groups": {}}
+
+    def alloc():
+        nonlocal port
+        port += 1
+        return port
+
+    zraft = {i: f"127.0.0.1:{alloc()}" for i in range(1, zeros + 1)}
+    zpeers = ",".join(f"{i}={a}" for i, a in zraft.items())
+    for i in range(1, zeros + 1):
+        caddr = f"127.0.0.1:{alloc()}"
+        topo["zero"][i] = caddr
+        lines.append(
+            f"python -m dgraph_tpu node --kind zero --id {i} "
+            f"--raft-peers {zpeers} --client-addr {caddr} "
+            f'--wal "$(dirname "$0")/wal/zero{i}" &')
+    zero_clients = ",".join(f"{i}={a}" for i, a in topo["zero"].items())
+    for g in range(1, groups + 1):
+        graft = {i: f"127.0.0.1:{alloc()}"
+                 for i in range(1, replicas + 1)}
+        gpeers = ",".join(f"{i}={a}" for i, a in graft.items())
+        topo["groups"][g] = {}
+        for i in range(1, replicas + 1):
+            caddr = f"127.0.0.1:{alloc()}"
+            topo["groups"][g][i] = caddr
+            lines.append(
+                f"python -m dgraph_tpu node --kind alpha --id {i} "
+                f"--group {g} --raft-peers {gpeers} "
+                f"--client-addr {caddr} --zero {zero_clients} "
+                f'--wal "$(dirname "$0")/wal/g{g}n{i}" &')
+    lines += ["", "wait"]
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.chmod(args.out, 0o755)
+    with open(args.out + ".topology.json", "w") as f:
+        json.dump(topo, f, indent=2)
+    print(f"wrote {args.out} and {args.out}.topology.json "
+          f"({zeros} zeros, {groups} groups x {replicas} replicas)")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="dgraph-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -412,6 +484,9 @@ def main(argv=None) -> int:
     a.add_argument("--encryption_key_file",
                    default=_env_default("alpha", "encryption_key_file", ""),
                    help="AES key file: encrypts WAL records at rest")
+    a.add_argument("--grpc-port", type=int, default=0,
+                   help="also serve the gRPC API on this port (ref "
+                        "dgraph alpha's 9080)")
     a.add_argument("--tls-dir", default="",
                    help="serve HTTPS from this cert dir (see `cert`)")
     a.add_argument("--tls-mtls", action="store_true",
@@ -532,6 +607,14 @@ def main(argv=None) -> int:
                     help="alpha host:port to scrape state/metrics from")
     di.add_argument("--archive", default="")
     di.set_defaults(fn=cmd_debuginfo)
+
+    co = sub.add_parser("compose", help="generate a cluster launcher")
+    co.add_argument("--num-zeros", type=int, default=3)
+    co.add_argument("--num-groups", type=int, default=2)
+    co.add_argument("--num-replicas", type=int, default=3)
+    co.add_argument("--base-port", type=int, default=7000)
+    co.add_argument("--out", default="cluster.sh")
+    co.set_defaults(fn=cmd_compose)
 
     args = p.parse_args(argv)
     return args.fn(args)

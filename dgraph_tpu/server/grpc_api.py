@@ -1,0 +1,185 @@
+"""gRPC API surface for the Alpha.
+
+The reference's primary client protocol is gRPC (api.Dgraph service:
+Login/Query/Mutate/Alter/CommitOrAbort/CheckVersion —
+dgraph/cmd/alpha/run.go:362 serveGRPC, protos/api). Same service shape
+here over grpc's generic handlers: method names match the reference,
+message bodies are wire-format dicts (dgraph_tpu/wire) instead of
+protobuf — the framework's one stable encoding everywhere. Status
+codes map like the reference: ABORTED for txn conflicts,
+PERMISSION_DENIED for ACL, INVALID_ARGUMENT for bad requests.
+
+Serving and the HTTP front end share AlphaServer's
+transport-independent handlers, so every feature (ACL, txns by
+startTs, draining, upserts) behaves identically on both transports.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from dgraph_tpu import wire
+from dgraph_tpu.cluster.coordinator import TxnAborted
+from dgraph_tpu.server.acl import AclError
+from dgraph_tpu.server.http import AlphaServer
+
+_SERVICE = "dgraph.tpu.Alpha"
+
+
+def _wrap(fn):
+    def method(request, context):
+        try:
+            return fn(request or {})
+        except TxnAborted as e:
+            context.abort(grpc.StatusCode.ABORTED,
+                          f"Transaction has been aborted. "
+                          f"Please retry: {e}")
+        except AclError as e:
+            context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
+        except (ValueError, KeyError) as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+
+    return method
+
+
+def _handlers(alpha: AlphaServer) -> dict:
+    def login(req):
+        return alpha.handle_login(req.get("body", {}))
+
+    def query(req):
+        return alpha.handle_query(req.get("q", ""),
+                                  req.get("params", {}),
+                                  req.get("token", ""))
+
+    def mutate(req):
+        return alpha.handle_mutate(req.get("body", b""),
+                                   req.get("content_type",
+                                           "application/rdf"),
+                                   req.get("params", {}),
+                                   req.get("token", ""))
+
+    def alter(req):
+        return alpha.handle_alter(req.get("body", b""),
+                                  req.get("token", ""))
+
+    def commit(req):
+        return alpha.handle_commit(req.get("params", {}),
+                                   req.get("token", ""))
+
+    def check_version(req):
+        from dgraph_tpu.cli import __version__
+        return {"tag": f"dgraph-tpu-{__version__}"}
+
+    return {"Login": login, "Query": query, "Mutate": mutate,
+            "Alter": alter, "CommitOrAbort": commit,
+            "CheckVersion": check_version}
+
+
+def serve_grpc(alpha: AlphaServer, host: str = "127.0.0.1",
+               port: int = 9080, max_workers: int = 16,
+               tls_dir: str = "", require_client_cert: bool = False
+               ) -> tuple[grpc.Server, int]:
+    """Start the gRPC front end; -> (server, bound port). With
+    tls_dir, serves over TLS from the same cert dir as the HTTP front
+    end (x/tls_helper.go applies one TLS config to both listeners);
+    require_client_cert turns on mTLS."""
+    import os
+
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers))
+    rpcs = {
+        name: grpc.unary_unary_rpc_method_handler(
+            _wrap(fn), request_deserializer=wire.loads,
+            response_serializer=wire.dumps)
+        for name, fn in _handlers(alpha).items()
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_SERVICE, rpcs),))
+    addr = f"{host}:{port}"
+    if tls_dir:
+        with open(os.path.join(tls_dir, "node.key"), "rb") as f:
+            key = f.read()
+        with open(os.path.join(tls_dir, "node.crt"), "rb") as f:
+            crt = f.read()
+        root = None
+        if require_client_cert:
+            with open(os.path.join(tls_dir, "ca.crt"), "rb") as f:
+                root = f.read()
+        creds = grpc.ssl_server_credentials(
+            [(key, crt)], root_certificates=root,
+            require_client_auth=require_client_cert)
+        bound = server.add_secure_port(addr, creds)
+    else:
+        bound = server.add_insecure_port(addr)
+    if bound == 0:
+        raise OSError(f"gRPC could not bind {addr}")
+    server.start()
+    return server, bound
+
+
+class GrpcClient:
+    """The dgo-shaped client: Login/Query/Mutate/Alter/CommitOrAbort
+    over the gRPC channel."""
+
+    def __init__(self, addr: str, token: str = ""):
+        self.channel = grpc.insecure_channel(addr)
+        self.token = token
+        self._stubs = {
+            name: self.channel.unary_unary(
+                f"/{_SERVICE}/{name}", request_serializer=wire.dumps,
+                response_deserializer=wire.loads)
+            for name in ("Login", "Query", "Mutate", "Alter",
+                         "CommitOrAbort", "CheckVersion")
+        }
+
+    def login(self, userid: str, password: str) -> dict:
+        out = self._stubs["Login"](
+            {"body": {"userid": userid, "password": password}})
+        self.token = out["data"]["accessJWT"]
+        return out
+
+    def query(self, q: str, variables: Optional[dict] = None,
+              start_ts: int = 0, best_effort: bool = False) -> dict:
+        params = {}
+        if start_ts:
+            params["startTs"] = str(start_ts)
+        if best_effort:
+            params["be"] = "true"
+        # handle_query accepts either DQL text or the JSON envelope
+        payload = {"query": q, "variables": variables} if variables else q
+        return self._stubs["Query"](
+            {"q": payload, "params": params, "token": self.token})
+
+    def mutate(self, body: bytes | str,
+               content_type: str = "application/rdf",
+               commit_now: bool = True, start_ts: int = 0) -> dict:
+        params = {"commitNow": "true" if commit_now else "false"}
+        if start_ts:
+            params["startTs"] = str(start_ts)
+        if isinstance(body, str):
+            body = body.encode()
+        return self._stubs["Mutate"](
+            {"body": body, "content_type": content_type,
+             "params": params, "token": self.token})
+
+    def alter(self, schema_text: str) -> dict:
+        return self._stubs["Alter"](
+            {"body": schema_text.encode(), "token": self.token})
+
+    def commit(self, start_ts: int, abort: bool = False) -> dict:
+        return self._stubs["CommitOrAbort"](
+            {"params": {"startTs": str(start_ts),
+                        "abort": "true" if abort else "false"},
+             "token": self.token})
+
+    def check_version(self) -> dict:
+        return self._stubs["CheckVersion"]({})
+
+    def close(self):
+        self.channel.close()

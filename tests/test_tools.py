@@ -210,3 +210,18 @@ def test_cert_ls_missing_dir(tmp_path):
         assert cli_main(["cert", "ls", "--dir",
                          str(tmp_path / "nope")]) == 0
     assert json.loads(out.getvalue()) == []
+
+
+def test_compose_generates_runnable_topology(tmp_path):
+    """Ref compose/compose.go: emit the N-node launcher + topology map."""
+    out = str(tmp_path / "cluster.sh")
+    assert cli_main(["compose", "--num-zeros", "1", "--num-groups", "2",
+                     "--num-replicas", "1", "--base-port", "7400",
+                     "--out", out]) == 0
+    script = open(out).read()
+    assert script.count("--kind zero") == 1
+    assert script.count("--kind alpha") == 2
+    assert "--zero 1=127.0.0.1:" in script
+    topo = json.load(open(out + ".topology.json"))
+    assert set(topo["groups"].keys()) == {"1", "2"}
+    assert os.access(out, os.X_OK)
