@@ -325,6 +325,39 @@ def cmd_debug(args) -> int:
             n = sum(len(v) for v in tab.edges.values()) + \
                 sum(len(v) for v in tab.values.values())
             print(f"{pred}\t{n}")
+    elif args.what == "posting":
+        # posting inspector (ref dgraph/cmd/debug/run.go lookup mode:
+        # dump one uid's postings + the index tokens covering them)
+        from dgraph_tpu.models.tokenizer import get_tokenizer, tokens_for
+        if not args.pred or not args.uid:
+            print("debug posting needs --pred and --uid",
+                  file=sys.stderr)
+            return 2
+        tab = db.tablets.get(args.pred)
+        if tab is None:
+            print(f"no tablet {args.pred!r}", file=sys.stderr)
+            return 1
+        uid = int(args.uid, 0)
+        ts = db.coordinator.max_assigned()
+        out: dict = {"pred": args.pred, "uid": hex(uid)}
+        dsts = tab.get_dst_uids(uid, ts)
+        if len(dsts):
+            out["edges"] = [hex(int(d)) for d in dsts.tolist()]
+        rev = tab.get_reverse_uids(uid, ts)
+        if len(rev):
+            out["reverse"] = [hex(int(s)) for s in rev.tolist()]
+        ps = tab.get_postings(uid, ts)
+        if ps:
+            out["postings"] = [
+                {"value": str(p.value.value), "type": p.value.tid.name,
+                 "lang": p.lang,
+                 "facets": {k: str(v.value)
+                            for k, v in p.facets.items()},
+                 "tokens": [str(t) for tname in tab.schema.tokenizers
+                            for t in tokens_for(
+                                p.value, get_tokenizer(tname), p.lang)]}
+                for p in ps]
+        print(json.dumps(out, indent=2, default=str))
     return 0
 
 
@@ -558,7 +591,10 @@ def main(argv=None) -> int:
 
     d = sub.add_parser("debug", help="offline store inspector")
     d.add_argument("--wal", required=True)
-    d.add_argument("what", choices=["state", "schema", "histogram"])
+    d.add_argument("what",
+                   choices=["state", "schema", "histogram", "posting"])
+    d.add_argument("--pred", default="")
+    d.add_argument("--uid", default="")
     d.set_defaults(fn=cmd_debug)
 
     n = sub.add_parser("node", help="raft replica (alpha group / zero)")

@@ -36,6 +36,7 @@ from dgraph_tpu.models.types import TypeID, Val, convert
 from dgraph_tpu.storage.tablet import EdgeOp, Posting, Tablet
 from dgraph_tpu.storage.wal import Wal
 from dgraph_tpu.utils import metrics
+from dgraph_tpu.utils.tracing import span as _span
 
 
 def _fp(*parts) -> int:
@@ -427,6 +428,11 @@ class GraphDB:
         return tab
 
     def commit(self, txn: Txn) -> int:
+        with _span("commit", start_ts=txn.start_ts,
+                   edges=len(txn.staged)):
+            return self._commit_inner(txn)
+
+    def _commit_inner(self, txn: Txn) -> int:
         if txn.done:
             raise TxnAborted("transaction already finished")
         try:
@@ -585,23 +591,28 @@ class GraphDB:
         from dgraph_tpu.query.executor import Executor
 
         lat = Latency()
-        t0 = time.perf_counter_ns()
-        parsed = gql_parse(q, variables)
-        lat.parsing_ns = time.perf_counter_ns() - t0
+        with _span("query") as sp:
+            t0 = time.perf_counter_ns()
+            parsed = gql_parse(q, variables)
+            lat.parsing_ns = time.perf_counter_ns() - t0
 
-        t0 = time.perf_counter_ns()
-        if txn is not None:
-            read_ts = txn.start_ts
-        elif best_effort:
-            read_ts = self.coordinator.max_assigned()
-        else:
-            read_ts = self.coordinator.next_ts()
-        lat.assign_ts_ns = time.perf_counter_ns() - t0
+            t0 = time.perf_counter_ns()
+            if txn is not None:
+                read_ts = txn.start_ts
+            elif best_effort:
+                read_ts = self.coordinator.max_assigned()
+            else:
+                read_ts = self.coordinator.next_ts()
+            lat.assign_ts_ns = time.perf_counter_ns() - t0
 
-        t0 = time.perf_counter_ns()
-        ex = Executor(self, read_ts)
-        data = ex.run(parsed)
-        lat.processing_ns = time.perf_counter_ns() - t0
+            t0 = time.perf_counter_ns()
+            ex = Executor(self, read_ts)
+            data = ex.run(parsed)
+            lat.processing_ns = time.perf_counter_ns() - t0
+            sp["read_ts"] = read_ts
+            sp["blocks"] = len(parsed.queries)
+            sp["parse_us"] = lat.parsing_ns // 1000
+            sp["process_us"] = lat.processing_ns // 1000
         metrics.inc_counter("dgraph_num_queries_total")
         metrics.observe("dgraph_query_latency_ms",
                         (lat.parsing_ns + lat.processing_ns) / 1e6)

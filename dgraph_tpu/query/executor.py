@@ -38,6 +38,7 @@ from dgraph_tpu.models.types import (
 from dgraph_tpu.storage.tablet import Tablet
 from dgraph_tpu.utils.keys import token_bytes
 from dgraph_tpu.utils.metrics import inc_counter
+from dgraph_tpu.utils.tracing import span as _span
 
 _EMPTY = np.empty(0, dtype=np.uint64)
 
@@ -176,6 +177,10 @@ class Executor:
     # ------------------------------------------------------------------
 
     def _run_block(self, gq: GraphQuery) -> ExecNode:
+        with _span("block", alias=gq.alias or gq.attr):
+            return self._run_block_inner(gq)
+
+    def _run_block_inner(self, gq: GraphQuery) -> ExecNode:
         node = ExecNode(gq)
         if gq.attr == "shortest":
             self._run_shortest(node)

@@ -296,6 +296,15 @@ class AlphaServer:
         with self.rw.read:
             return self.db.state()
 
+    def handle_traces(self, token: str = "") -> dict:
+        """Recent spans as a Chrome trace (load in chrome://tracing).
+        ACL-gated like /state: span args carry query shapes."""
+        if self.acl is not None:
+            with self.meta:
+                self.acl.authorize(token)
+        from dgraph_tpu.utils.tracing import export_chrome_trace
+        return {"traceEvents": export_chrome_trace()}
+
     def handle_health(self) -> dict:
         return {"status": "draining" if self.draining else "healthy",
                 "uptime_s": round(time.time() - self.started_at, 3),
@@ -477,6 +486,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/admin/schema":
                 self._send(200,
                            {"data": self.alpha.handle_get_schema(token)})
+            elif path == "/debug/traces":
+                self._send(200, self.alpha.handle_traces(token))
             elif path == "/debug/prometheus_metrics":
                 from dgraph_tpu.utils.metrics import render_prometheus
 

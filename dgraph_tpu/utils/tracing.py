@@ -1,0 +1,74 @@
+"""Tracing: query spans + device profiler hooks.
+
+The reference instruments requests with OpenCensus spans
+(x/metrics.go + go.opencensus.io trace throughout edgraph/worker) and
+exposes pprof profiles. Here:
+
+- `span(name, **attrs)` records wall-time spans into a bounded
+  in-process ring; `export_chrome_trace()` renders them in the Chrome
+  trace-event format (load in chrome://tracing or Perfetto).
+- `profile_device(dir)` wraps jax.profiler.trace: a TensorBoard-
+  loadable device profile of everything jitted inside the block — the
+  TPU analogue of the reference's pprof CPU profiles.
+
+Spans are cheap (two clock reads + a deque append under GIL) and on by
+default; the ring bounds memory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Any, Iterator
+
+_MAX_SPANS = 4096
+_spans: deque = deque(maxlen=_MAX_SPANS)
+_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: Any) -> Iterator[dict]:
+    """Record one wall-time span; yields the attr dict so callers can
+    attach results (e.g. result counts) before the span closes."""
+    rec = {"name": name, "ts_us": time.time() * 1e6,
+           "tid": threading.get_ident(), "args": dict(attrs)}
+    t0 = time.perf_counter_ns()
+    try:
+        yield rec["args"]
+    finally:
+        rec["dur_us"] = (time.perf_counter_ns() - t0) / 1e3
+        with _lock:
+            _spans.append(rec)
+
+
+def recent_spans(limit: int = 200) -> list[dict]:
+    with _lock:
+        return list(_spans)[-limit:]
+
+
+def clear() -> None:
+    with _lock:
+        _spans.clear()
+
+
+def export_chrome_trace() -> list[dict]:
+    """Chrome trace-event JSON ('X' complete events): load the result
+    of /debug/traces straight into chrome://tracing / Perfetto."""
+    with _lock:
+        spans = list(_spans)
+    return [{"name": s["name"], "ph": "X", "ts": s["ts_us"],
+             "dur": s["dur_us"], "pid": 1, "tid": s["tid"],
+             "args": s["args"]} for s in spans]
+
+
+@contextlib.contextmanager
+def profile_device(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler device trace (XLA compilation + kernel
+    timeline) for everything run inside the block. View with
+    TensorBoard's profile plugin pointed at log_dir."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

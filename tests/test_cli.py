@@ -51,3 +51,25 @@ def test_acl_cli_requires_wal(tmp_path, capsys):
     db = GraphDB(wal_path=wal, prefer_device=False)
     res = db.query('{ q(func: eq(dgraph.xid, "u1")) { dgraph.xid } }')
     assert res["data"]["q"]
+
+
+def test_debug_posting_inspector(tmp_path, capsys):
+    """Row-28 posting inspector (ref dgraph/cmd/debug lookup mode)."""
+    import json as _json
+    from dgraph_tpu.cli import main as cli_main
+    from dgraph_tpu.engine.db import GraphDB
+    wal = str(tmp_path / "wal.log")
+    db = GraphDB(wal_path=wal, prefer_device=False)
+    db.alter("name: string @index(term) .\nfriend: [uid] @reverse .")
+    db.mutate(set_nquads='<1> <name> "ada lovelace" .\n'
+                         '<1> <friend> <2> (since=2015) .')
+    db.wal.close()
+    assert cli_main(["debug", "--wal", wal, "posting",
+                     "--pred", "name", "--uid", "0x1"]) == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert out["postings"][0]["value"] == "ada lovelace"
+    assert "ada" in out["postings"][0]["tokens"]
+    assert cli_main(["debug", "--wal", wal, "posting",
+                     "--pred", "friend", "--uid", "0x1"]) == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert out["edges"] == ["0x2"]

@@ -1,0 +1,73 @@
+"""Tracing: spans on the query/commit paths, Chrome-trace export,
+/debug/traces, and the jax.profiler device-profile hook (§5.1).
+"""
+
+import json
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.utils import tracing
+
+
+def test_spans_record_query_and_commit():
+    tracing.clear()
+    db = GraphDB(prefer_device=False)
+    db.alter("name: string @index(exact) .")
+    db.mutate(set_nquads='<1> <name> "t" .')
+    db.query('{ q(func: eq(name, "t")) { name } }')
+    names = [s["name"] for s in tracing.recent_spans()]
+    assert "commit" in names and "query" in names and "block" in names
+    q = next(s for s in reversed(tracing.recent_spans())
+             if s["name"] == "query")
+    assert q["args"]["blocks"] == 1 and q["dur_us"] > 0
+    assert q["args"]["process_us"] >= 0
+
+
+def test_chrome_trace_export_shape():
+    tracing.clear()
+    with tracing.span("unit", k=1):
+        pass
+    events = tracing.export_chrome_trace()
+    assert events and events[-1]["ph"] == "X"
+    assert events[-1]["name"] == "unit"
+    json.dumps(events)  # serializable as-is
+
+
+def test_debug_traces_endpoint():
+    import urllib.request
+    from dgraph_tpu.server.http import serve
+    tracing.clear()
+    httpd, alpha = serve(block=False, port=0)
+    try:
+        port = httpd.server_address[1]
+        alpha.handle_query("{ q(func: uid(0x1)) { uid } }", {})
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces").read()
+        events = json.loads(body)["traceEvents"]
+        assert any(e["name"] == "query" for e in events)
+    finally:
+        httpd.shutdown()
+
+
+def test_debug_traces_requires_acl_token():
+    import pytest
+    from dgraph_tpu.server.acl import AclError
+    from dgraph_tpu.server.http import AlphaServer
+    srv = AlphaServer(acl_secret=b"s3cret")
+    with pytest.raises(AclError):
+        srv.handle_traces("")  # anonymous: rejected like /state
+
+
+def test_device_profile_smoke(tmp_path):
+    import jax.numpy as jnp
+    with tracing.profile_device(str(tmp_path)):
+        jnp.arange(8).sum().block_until_ready()
+    # a profile dump landed in the log dir
+    assert any(tmp_path.rglob("*"))
+
+
+def test_span_ring_bounded():
+    tracing.clear()
+    for i in range(5000):
+        with tracing.span("x"):
+            pass
+    assert len(tracing.recent_spans(limit=10**6)) <= 4096
