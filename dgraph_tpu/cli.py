@@ -17,15 +17,64 @@ import sys
 __version__ = "0.1.0"
 
 
-def _env_default(cmd: str, flag: str, default):
-    v = os.environ.get(f"DGRAPH_TPU_{cmd.upper()}_{flag.upper()}")
-    if v is None:
-        return default
+def _coerce(v, default):
     if isinstance(default, bool):
-        return v.lower() in ("1", "true", "yes")
-    if isinstance(default, int):
+        return str(v).lower() in ("1", "true", "yes")
+    if isinstance(default, int) and not isinstance(default, bool):
         return int(v)
     return v
+
+
+def _apply_config_layers(sub_choices: dict, argv: list) -> list:
+    """Flag layering, lowest to highest precedence: parser defaults <
+    --config FILE (JSON {subcommand: {flag: value}}) <
+    DGRAPH_TPU_<CMD>_<FLAG> env vars < explicit CLI flags — the
+    reference's viper config/env/flag stack (dgraph/cmd/root.go:104).
+    Mutates the chosen subparser's defaults; returns argv without the
+    --config pair."""
+    argv = list(argv)
+    cfg = {}
+    path = None
+    for i, a in enumerate(argv):
+        if a == "--config":
+            if i + 1 >= len(argv):
+                print("--config needs a file argument", file=sys.stderr)
+                raise SystemExit(2)
+            path = argv[i + 1]
+            del argv[i:i + 2]
+            break
+        if a.startswith("--config="):
+            path = a.split("=", 1)[1]
+            del argv[i]
+            break
+    if path is not None:
+        try:
+            with open(path) as f:
+                cfg = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"--config {path}: {e}", file=sys.stderr)
+            raise SystemExit(2)
+    cmd = next((a for a in argv if not a.startswith("-")), None)
+    sp = sub_choices.get(cmd)
+    if sp is None:
+        return argv
+    layer = {}
+    file_vals = cfg.get(cmd, {})
+    for action in sp._actions:
+        dest = action.dest
+        if dest in ("help",):
+            continue
+        fkey = dest.replace("_", "-")
+        if fkey in file_vals or dest in file_vals:
+            layer[dest] = _coerce(file_vals.get(fkey,
+                                                file_vals.get(dest)),
+                                  action.default)
+        env = os.environ.get(f"DGRAPH_TPU_{cmd.upper()}_{dest.upper()}")
+        if env is not None:
+            layer[dest] = _coerce(env, action.default)
+    if layer:
+        sp.set_defaults(**layer)
+    return argv
 
 
 def cmd_alpha(args) -> int:
@@ -504,18 +553,18 @@ def main(argv=None) -> int:
     sub = p.add_subparsers(dest="cmd", required=True)
 
     a = sub.add_parser("alpha", help="serve the engine over HTTP")
-    a.add_argument("--host", default=_env_default("alpha", "host", "0.0.0.0"))
+    a.add_argument("--host", default="0.0.0.0")
     a.add_argument("--port", type=int,
-                   default=_env_default("alpha", "port", 8080))
-    a.add_argument("--wal", default=_env_default("alpha", "wal", ""))
-    a.add_argument("--snapshot", default=_env_default("alpha", "snapshot", ""))
+                   default=8080)
+    a.add_argument("--wal", default="")
+    a.add_argument("--snapshot", default="")
     a.add_argument("--no-device", action="store_true",
-                   default=_env_default("alpha", "no_device", False))
+                   default=False)
     a.add_argument("--acl_secret_file",
-                   default=_env_default("alpha", "acl_secret_file", ""),
+                   default="",
                    help="enables ACL; file holds the HMAC jwt secret")
     a.add_argument("--encryption_key_file",
-                   default=_env_default("alpha", "encryption_key_file", ""),
+                   default="",
                    help="AES key file: encrypts WAL records at rest")
     a.add_argument("--grpc-port", type=int, default=0,
                    help="also serve the gRPC API on this port (ref "
@@ -652,6 +701,8 @@ def main(argv=None) -> int:
     co.add_argument("--out", default="cluster.sh")
     co.set_defaults(fn=cmd_compose)
 
+    argv = _apply_config_layers(sub.choices,
+                                argv if argv is not None else sys.argv[1:])
     args = p.parse_args(argv)
     return args.fn(args)
 

@@ -415,7 +415,11 @@ uint8_t* dgt_wal_replay(void* h, uint64_t* total, uint64_t* count) {
   lseek(w->fd, 0, SEEK_END);
   *total = out.size();
   uint8_t* buf = (uint8_t*)malloc(out.size() ? out.size() : 1);
-  memcpy(buf, out.data(), out.size());
+  if (!out.empty()) {
+    // empty replay: out.data() may be null — memcpy(_, null, 0) is UB
+    // even for zero bytes (caught by the UBSan harness)
+    memcpy(buf, out.data(), out.size());
+  }
   return buf;
 }
 

@@ -73,3 +73,50 @@ def test_debug_posting_inspector(tmp_path, capsys):
                      "--pred", "friend", "--uid", "0x1"]) == 0
     out = _json.loads(capsys.readouterr().out)
     assert out["edges"] == ["0x2"]
+
+
+def test_config_file_env_flag_layering(tmp_path, monkeypatch, capsys):
+    """viper-style layering: defaults < --config file < env < CLI flag
+    (ref dgraph/cmd/root.go:104)."""
+    import json as _json
+    from dgraph_tpu.cli import main as cli_main
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(_json.dumps({
+        "compose": {"num-zeros": 5, "num-groups": 4,
+                    "base-port": 7800,
+                    "out": str(tmp_path / "a.sh")}}))
+    # file layer applies
+    assert cli_main(["--config", str(cfg), "compose"]) == 0
+    assert "5 zeros, 4 groups" in capsys.readouterr().out
+    # env overrides file
+    monkeypatch.setenv("DGRAPH_TPU_COMPOSE_NUM_ZEROS", "2")
+    assert cli_main(["--config", str(cfg), "compose"]) == 0
+    assert "2 zeros, 4 groups" in capsys.readouterr().out
+    # explicit flag overrides both
+    assert cli_main(["--config", str(cfg), "compose",
+                     "--num-zeros", "1"]) == 0
+    assert "1 zeros, 4 groups" in capsys.readouterr().out
+
+
+def test_config_flag_error_handling(tmp_path, capsys):
+    import pytest
+    from dgraph_tpu.cli import main as cli_main
+    # --config= form works
+    import json as _json
+    cfg = tmp_path / "c.json"
+    cfg.write_text(_json.dumps({"compose": {
+        "num-zeros": 2, "out": str(tmp_path / "x.sh")}}))
+    assert cli_main([f"--config={cfg}", "compose"]) == 0
+    assert "2 zeros" in capsys.readouterr().out
+    # dangling --config and missing/garbage files are usage errors
+    with pytest.raises(SystemExit) as e:
+        cli_main(["compose", "--config"])
+    assert e.value.code == 2
+    with pytest.raises(SystemExit) as e:
+        cli_main(["--config", str(tmp_path / "nope.json"), "compose"])
+    assert e.value.code == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    with pytest.raises(SystemExit) as e:
+        cli_main(["--config", str(bad), "compose"])
+    assert e.value.code == 2
