@@ -58,20 +58,44 @@ def _apply_config_layers(sub_choices: dict, argv: list) -> list:
     sp = sub_choices.get(cmd)
     if sp is None:
         return argv
-    layer = {}
     file_vals = cfg.get(cmd, {})
+    if not isinstance(file_vals, dict):
+        print(f"--config: section {cmd!r} must be an object",
+              file=sys.stderr)
+        raise SystemExit(2)
+
+    def usage_err(dest, raw, why):
+        print(f"config/env value for --{dest.replace('_', '-')}: "
+              f"{raw!r} {why}", file=sys.stderr)
+        raise SystemExit(2)
+
+    layer = {}
     for action in sp._actions:
         dest = action.dest
         if dest in ("help",):
             continue
         fkey = dest.replace("_", "-")
+        raw = None
         if fkey in file_vals or dest in file_vals:
-            layer[dest] = _coerce(file_vals.get(fkey,
-                                                file_vals.get(dest)),
-                                  action.default)
+            raw = file_vals.get(fkey, file_vals.get(dest))
         env = os.environ.get(f"DGRAPH_TPU_{cmd.upper()}_{dest.upper()}")
         if env is not None:
-            layer[dest] = _coerce(env, action.default)
+            raw = env
+        if raw is None:
+            continue
+        try:
+            # run the action's own converter when it has one, else
+            # coerce toward the default's type — and honor `choices`,
+            # which argparse only checks for CLI-supplied values
+            val = action.type(raw) if callable(action.type)                 else _coerce(raw, action.default)
+        except (TypeError, ValueError) as e:
+            usage_err(dest, raw, f"is invalid ({e})")
+        if action.choices is not None and val not in action.choices:
+            usage_err(dest, raw,
+                      f"not one of {sorted(action.choices)}")
+        layer[dest] = val
+        # a layered value SATISFIES a required flag (viper semantics)
+        action.required = False
     if layer:
         sp.set_defaults(**layer)
     return argv

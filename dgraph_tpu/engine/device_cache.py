@@ -30,8 +30,15 @@ from dgraph_tpu.ops.uidvec import SENTINEL, pad_to, to_numpy
 _MAX_U32 = 0xFFFFFFFE  # SENTINEL reserved
 
 
-def device_adjacency(db, tab, read_ts: int) -> Optional[DeviceAdjacency]:
-    if not _clean_resident(db, tab, read_ts):
+def device_adjacency(db, tab, read_ts: int,
+                     allow_dirty: bool = False
+                     ) -> Optional[DeviceAdjacency]:
+    """allow_dirty=True returns the tile built from the BASE arrays
+    even while an overlay exists — callers doing overlay-on-device
+    reads (executor._device_expand) answer overlay-touched rows on the
+    host and use the tile only for untouched rows. Everyone else gets
+    the strict clean-only contract."""
+    if not _clean_resident(db, tab, read_ts, allow_dirty=allow_dirty):
         return None
     adj = getattr(tab, "_device_adj", None)
     if adj is not None and tab._device_adj_ts == tab.base_ts:
@@ -50,7 +57,8 @@ def device_adjacency(db, tab, read_ts: int) -> Optional[DeviceAdjacency]:
     return adj
 
 
-def _clean_resident(db, tab, read_ts: int, want_uid: bool = True) -> bool:
+def _clean_resident(db, tab, read_ts: int, want_uid: bool = True,
+                    allow_dirty: bool = False) -> bool:
     """Shared residency policy: rolled-up committed state only.
 
     Rollup folds the delta overlay into the base arrays — a WRITE. In
@@ -66,7 +74,7 @@ def _clean_resident(db, tab, read_ts: int, want_uid: bool = True) -> bool:
             wm = db.coordinator.min_active_ts()
             if wm >= tab.max_commit_ts:
                 tab.rollup(wm)
-        if tab.dirty():
+        if tab.dirty() and not allow_dirty:
             return False  # live overlay -> host path
     return read_ts >= tab.base_ts
 
@@ -103,11 +111,15 @@ def _transposed_edges(tab) -> dict:
             for i, d in enumerate(uniq)}
 
 
-def device_radjacency(db, tab, read_ts: int) -> Optional[DeviceAdjacency]:
+def device_radjacency(db, tab, read_ts: int,
+                      allow_dirty: bool = False
+                      ) -> Optional[DeviceAdjacency]:
     """Reverse-direction expansion tiles (~pred traversal): a
     DeviceAdjacency over the tablet's reverse map. Requires @reverse
-    (the executor rejects ~pred queries otherwise)."""
-    if not tab.schema.reverse or not _clean_resident(db, tab, read_ts):
+    (the executor rejects ~pred queries otherwise). allow_dirty as in
+    device_adjacency."""
+    if not tab.schema.reverse or not _clean_resident(
+            db, tab, read_ts, allow_dirty=allow_dirty):
         return None
     adj = getattr(tab, "_device_radj", None)
     if adj is not None and getattr(tab, "_device_radj_ts", -1) == tab.base_ts:

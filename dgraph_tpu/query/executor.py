@@ -1028,9 +1028,31 @@ class Executor:
                             labels={"dir": "rev" if reverse else "fwd"})
                 return expand_sharded_np(self.db.mesh, sadj, src)
         adj = (device_radjacency if reverse else device_adjacency)(
-            self.db, tab, self.read_ts)
+            self.db, tab, self.read_ts, allow_dirty=True)
         if adj is None:
             return None
+        if tab.dirty():
+            # overlay-on-device (ref posting/mvcc.go immutable+mutable
+            # layer split): the tile answers rows the overlay never
+            # touched; overlay-touched frontier uids take the exact
+            # host MVCC path, results union
+            touched = tab.overlay_srcs(self.read_ts, reverse=reverse)
+            if touched:
+                mask = np.isin(src, np.fromiter(
+                    touched, dtype=np.uint64, count=len(touched)))
+                clean, dirty = src[~mask], src[mask]
+                parts = []
+                if len(clean):
+                    parts.append(expand_np(adj, clean))
+                if len(dirty):
+                    parts.append(tab.expand_frontier(
+                        dirty, self.read_ts, reverse))
+                inc_counter("query_device_overlay_expand_total",
+                            labels={"dir": "rev" if reverse else "fwd"})
+                if not parts:
+                    return _EMPTY.copy()
+                return np.unique(np.concatenate(parts)) \
+                    if len(parts) > 1 else parts[0]
         inc_counter("query_device_expand_total",
                     labels={"dir": "rev" if reverse else "fwd"})
         return expand_np(adj, src)

@@ -332,6 +332,32 @@ class Tablet:
     def dirty(self) -> bool:
         return bool(self.deltas)
 
+    def overlay_srcs(self, read_ts: int, reverse: bool = False
+                     ) -> set[int]:
+        """Uids whose out-edges (in-edges with reverse=True) are
+        touched by overlay ops visible at read_ts — the exactness
+        boundary for overlay-on-device reads: rows NOT in this set are
+        identical in the base arrays, so a device tile built at
+        base_ts answers them exactly; touched rows take the host MVCC
+        path (ref posting/mvcc.go: immutable layer + mutable layer
+        split, read through both)."""
+        out: set[int] = set()
+        for op in self._overlay(read_ts):
+            if op.op == "del_all":
+                # wildcard wipes src's row AND removes src from every
+                # dst's reverse row — which dsts is row-dependent, so
+                # conservatively all of src's base+overlay targets
+                out.add(op.src)
+                if reverse:
+                    out.update(self.base_dsts_of(op.src))
+            else:
+                out.add(op.dst if reverse else op.src)
+        return out
+
+    def base_dsts_of(self, src: int) -> list[int]:
+        arr = self.edges.get(src)
+        return arr.tolist() if arr is not None else []
+
     def rollup(self, watermark: int):
         """Fold deltas with ts <= watermark into base state."""
         keep: list[tuple[int, list[EdgeOp]]] = []

@@ -120,3 +120,39 @@ def test_config_flag_error_handling(tmp_path, capsys):
     with pytest.raises(SystemExit) as e:
         cli_main(["--config", str(bad), "compose"])
     assert e.value.code == 2
+
+
+def test_config_validation_and_required_satisfaction(tmp_path, capsys):
+    import json as _json
+    import pytest
+    from dgraph_tpu.cli import main as cli_main
+    # invalid int in config -> usage error, not a traceback
+    bad = tmp_path / "bad.json"
+    bad.write_text(_json.dumps({"compose": {"num-zeros": "abc"}}))
+    with pytest.raises(SystemExit) as e:
+        cli_main(["--config", str(bad), "compose"])
+    assert e.value.code == 2
+    # non-dict section -> usage error
+    nd = tmp_path / "nd.json"
+    nd.write_text(_json.dumps({"compose": 5}))
+    with pytest.raises(SystemExit) as e:
+        cli_main(["--config", str(nd), "compose"])
+    assert e.value.code == 2
+    # choices enforced for config-supplied values
+    ch = tmp_path / "ch.json"
+    ch.write_text(_json.dumps({"debug": {"what": "nonsense",
+                                          "wal": "x"}}))
+    with pytest.raises(SystemExit) as e:
+        cli_main(["--config", str(ch), "debug"])
+    assert e.value.code == 2
+    # a config value satisfies a REQUIRED flag
+    cap = capsys.readouterr()  # drain
+    wal = tmp_path / "w.log"
+    from dgraph_tpu.engine.db import GraphDB
+    db = GraphDB(wal_path=str(wal), prefer_device=False)
+    db.alter("n: int .")
+    db.wal.close()
+    ok = tmp_path / "ok.json"
+    ok.write_text(_json.dumps({"debug": {"wal": str(wal),
+                                          "what": "schema"}}))
+    assert cli_main(["--config", str(ok), "debug"]) == 0

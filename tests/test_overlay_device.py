@@ -1,0 +1,109 @@
+"""Overlay-on-device reads: a live delta overlay no longer disables
+the device tier — the tile (built from the base arrays) answers
+frontier uids the overlay never touched, overlay-touched uids take the
+exact host MVCC path, and results union (VERDICT weak #5; ref
+posting/mvcc.go immutable layer + mutation layer split).
+"""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.utils import metrics
+
+
+def _base_db(**kw):
+    db = GraphDB(device_min_edges=1, **kw)
+    # server mode: reads must not fold the overlay (http.py contract) —
+    # exactly the situation overlay-on-device exists for
+    db.rollup_in_read = False
+    db.alter("e: [uid] @reverse .\nname: string @index(exact) .")
+    lines = []
+    for s in range(1, 40):
+        for d in range(5):
+            lines.append(f"<{s:#x}> <e> <{0x100 + (s + d) % 60:#x}> .")
+    db.mutate(set_nquads="\n".join(lines))
+    db.rollup_all()
+    return db
+
+
+def _counter(name, d):
+    return metrics.snapshot()["counters"].get(
+        f'{name}{{dir="{d}"}}', 0)
+
+
+def test_device_serves_through_live_overlay():
+    db = _base_db()
+    # force the base tile to exist
+    db.query("{ q(func: uid(0x1)) { e { uid } } }")
+    # live overlay: touch SOME srcs, leave others clean
+    db.mutate(set_nquads="<0x1> <e> <0x900> .")
+    db.mutate(del_nquads="<0x2> <e> <0x103> .")
+    assert db.tablets["e"].dirty()
+
+    host = GraphDB(prefer_device=False)
+    host.alter("e: [uid] @reverse .")
+    lines = []
+    for s in range(1, 40):
+        for d in range(5):
+            lines.append(f"<{s:#x}> <e> <{0x100 + (s + d) % 60:#x}> .")
+    host.mutate(set_nquads="\n".join(lines))
+    host.mutate(set_nquads="<0x1> <e> <0x900> .")
+    host.mutate(del_nquads="<0x2> <e> <0x103> .")
+
+    before = _counter("query_device_overlay_expand_total", "fwd")
+    q = "{ q(func: uid(0x1, 0x2, 0x5, 0x6)) { e { uid } } }"
+    got = db.query(q)["data"]
+    after = _counter("query_device_overlay_expand_total", "fwd")
+    assert got == host.query(q)["data"]
+    assert after > before, "overlay-on-device path was not taken"
+
+
+def test_overlay_reverse_expansion_parity():
+    db = _base_db()
+    db.query("{ q(func: uid(0x101)) { ~e { uid } } }")  # build rtile
+    db.mutate(set_nquads="<0x30> <e> <0x101> .")
+    db.mutate(del_nquads="<0x1> <e> <0x101> .")
+    assert db.tablets["e"].dirty()
+
+    host = GraphDB(prefer_device=False)
+    host.alter("e: [uid] @reverse .")
+    lines = []
+    for s in range(1, 40):
+        for d in range(5):
+            lines.append(f"<{s:#x}> <e> <{0x100 + (s + d) % 60:#x}> .")
+    host.mutate(set_nquads="\n".join(lines))
+    host.mutate(set_nquads="<0x30> <e> <0x101> .")
+    host.mutate(del_nquads="<0x1> <e> <0x101> .")
+
+    q = "{ q(func: uid(0x101, 0x102)) { ~e { uid } } }"
+    assert db.query(q)["data"] == host.query(q)["data"]
+
+
+def test_wildcard_delete_under_overlay_parity():
+    db = _base_db()
+    db.query("{ q(func: uid(0x1)) { e { uid } } }")
+    db.mutate(del_nquads="<0x3> <e> * .")
+    assert db.tablets["e"].dirty()
+    got = db.query("{ q(func: uid(0x3, 0x4)) { e { uid } } }")["data"]
+    host_dsts = {hex(0x100 + (4 + d) % 60) for d in range(5)}
+    rows = got["q"]
+    # 0x3 is fully wiped -> it emits no fields and drops from output
+    assert len(rows) == 1
+    assert {x["uid"] for x in rows[0]["e"]} == host_dsts
+
+
+def test_recurse_through_dirty_tablet_matches_host():
+    db = _base_db()
+    db.query("{ q(func: uid(0x1)) { e { uid } } }")
+    db.mutate(set_nquads="<0x105> <e> <0x1> .")  # cycle via overlay
+    host = GraphDB(prefer_device=False)
+    host.alter("e: [uid] @reverse .")
+    lines = []
+    for s in range(1, 40):
+        for d in range(5):
+            lines.append(f"<{s:#x}> <e> <{0x100 + (s + d) % 60:#x}> .")
+    host.mutate(set_nquads="\n".join(lines))
+    host.mutate(set_nquads="<0x105> <e> <0x1> .")
+    q = "{ q(func: uid(0x1)) @recurse(depth: 3) { uid e } }"
+    assert db.query(q)["data"] == host.query(q)["data"]
