@@ -310,17 +310,34 @@ def bits_to_uids_batched(badj: BitAdjacency, packed: np.ndarray,
 
 
 def make_bfs_bits_batched(badj: BitAdjacency, depth: int,
-                          dedup: bool = True) -> Callable:
+                          dedup: bool = True,
+                          use_pallas: bool | None = None,
+                          pallas_interpret: bool | None = None
+                          ) -> Callable:
     """Compile multi-query BFS: packed uint32[N+1, W] seed frontier ->
     tuple of per-level packed frontiers (same shape).
 
     One device call runs 32*W independent traversals. Per-edge work is
-    one row-gather + OR, done as D separate [M, W] gathers so no
-    [M, D, W] intermediate is materialized."""
+    one row-gather + OR — under XLA as D separate [M, W] gathers (no
+    [M, D, W] intermediate), or with use_pallas as the scalar-prefetch
+    Pallas kernel (ops/pallas_kernels.bucket_or_pallas) that DMAs each
+    needed frontier row HBM->VMEM directly. use_pallas=None auto-picks
+    pallas on the TPU backend; callers should warm up the returned fn
+    once and fall back (see bench.py) since pallas compilation is the
+    newer path."""
     ncov = badj.n_covered
     n = badj.n_slots
+    # explicit opt-in (None -> XLA): callers that enable pallas own the
+    # warmup + fallback (bench.py does); silently auto-enabling would
+    # put an unproven compile path under every existing caller
+    if use_pallas is None:
+        use_pallas = False
 
     def bucket_or(f, b):
+        if use_pallas and f.shape[1] % 128 == 0:
+            from dgraph_tpu.ops.pallas_kernels import bucket_or_pallas
+            return bucket_or_pallas(f, b.in_nb,
+                                    interpret=pallas_interpret)
         # OR of gathered frontier rows over the degree axis, in chunks
         # of <=8 so no [M, D, W] intermediate is materialized and the
         # unroll stays bounded for the huge-degree hub buckets

@@ -1,0 +1,70 @@
+"""Pallas TPU kernels for the traversal hot path.
+
+The batched BFS level (ops/bitgraph.make_bfs_bits_batched) is a
+row-gather + OR-reduce: for every adjacency row r with in-neighbors
+nb[r, 0..D), OR the frontier bitmap rows f[nb[r, d]] together. Under
+XLA this is D separate gathers; the Pallas version maps it onto the
+TPU memory system directly with the scalar-prefetch pattern
+(pallas_guide: PrefetchScalarGridSpec): the in-neighbor indices are
+prefetched to SMEM, the BlockSpec index_map uses them to DMA exactly
+the frontier row each grid step needs HBM->VMEM, and the kernel is a
+single VPU OR into the output row accumulated across the degree axis
+(TPU grids execute sequentially, so revisiting the same output block
+accumulates).
+
+Interpret mode runs the same kernel on CPU for CI parity; real
+compilation happens on TPU. Callers must pad the word axis W to a
+multiple of 128 (lane width).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def bucket_or_pallas(f: jax.Array, in_nb: jax.Array,
+                     interpret: bool | None = None) -> jax.Array:
+    """OR of gathered frontier rows: f uint32[N+1, W], in_nb
+    int32[M, D] -> uint32[M, W] where out[m] = OR_d f[in_nb[m, d]].
+    Rows that pad with the dummy slot index N contribute zeros exactly
+    like the XLA path (f's last row is the always-empty dummy)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m, d = in_nb.shape
+    w = f.shape[1]
+    if w % 128 != 0:
+        raise ValueError(f"W={w} must be a multiple of 128 lanes")
+
+    def kernel(idx_ref, f_row, out_ref):
+        del idx_ref  # consumed by the index_map, not the body
+        step = pl.program_id(1)
+
+        @pl.when(step == 0)
+        def _init():
+            out_ref[...] = f_row[...]
+
+        @pl.when(step != 0)
+        def _acc():
+            out_ref[...] = out_ref[...] | f_row[...]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m, d),
+        in_specs=[
+            pl.BlockSpec((1, w), lambda i, j, idx: (idx[i, j], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, w), lambda i, j, idx: (i, 0)),
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m, w), jnp.uint32),
+        # CPU CI simulates the TPU kernel (pltpu.InterpretParams);
+        # on real TPU this compiles through Mosaic
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(in_nb, f)
+
+
+

@@ -24,6 +24,13 @@ sys.path.insert(0, _REPO)
 # cache makes repeat test runs cheap.
 import jax  # noqa: E402
 
+# Pallas (via checkify) registers per-platform lowerings at import
+# time against the CURRENT platform registry; import it while "tpu" is
+# still a known platform, or interpret-mode kernels can't even import
+# after the factories are popped below.
+from jax.experimental import pallas as _pl  # noqa: E402,F401
+from jax.experimental.pallas import tpu as _pltpu  # noqa: E402,F401
+
 # The ambient axon TPU plugin (registered by sitecustomize) gets initialized
 # by jax's backends() even under JAX_PLATFORMS=cpu, and blocks tests whenever
 # the single-chip tunnel is busy/wedged. Tests are CPU-only by design —
