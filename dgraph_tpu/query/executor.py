@@ -71,6 +71,15 @@ def _probe_langs(spec, lang: str) -> list[str]:
     return [lang]
 
 _INEQ = {"le", "lt", "ge", "gt", "between"}
+
+# vectorized comparators for numpy count columns
+_CMP_VEC = {
+    "eq": lambda a, b: a == b,
+    "le": lambda a, b: a <= b,
+    "lt": lambda a, b: a < b,
+    "ge": lambda a, b: a >= b,
+    "gt": lambda a, b: a > b,
+}
 _TERM_FUNCS = {"anyofterms", "allofterms", "anyoftext", "alloftext"}
 
 
@@ -670,20 +679,54 @@ class Executor:
 
     def _eval_count_fn(self, fn: Function, candidates) -> np.ndarray:
         """gt(count(friend), 2) etc (ref task.go:1111 handleCompare +
-        count index)."""
+        count index). Vectorized over the base count table; only
+        overlay-touched uids fall back to per-uid MVCC counting."""
         tab = self._tablet(fn.attr)
         if tab is None:
             return _EMPTY if fn.name not in ("eq", "le", "lt") \
                 else self._count_zero_case(fn, candidates)
         want = int(fn.args[0].value)
-        scan = candidates if candidates is not None else _union(
-            tab.src_uids(self.read_ts), _EMPTY)
-        keep = []
-        for u in scan.tolist():
-            c = tab.count_of(u, self.read_ts)
-            if _cmp(fn.name, c, want):
-                keep.append(u)
-        return np.asarray(keep, dtype=np.uint64)
+        cmp_name = fn.name
+        if fn.name == "between":
+            # between(count(p), lo, hi): vector range mask; the scalar
+            # fallback closes over the same bounds
+            lo, hi = want, int(fn.args[1].value)
+            vec = lambda a, b: (a >= lo) & (a <= hi)  # noqa: E731
+        elif fn.name in _CMP_VEC:
+            vec = _CMP_VEC[fn.name]
+        else:
+            raise GQLError(f"bad count comparison {fn.name}")
+        scan = candidates if candidates is not None else \
+            tab.src_uids(self.read_ts)
+        if not len(scan):
+            return _EMPTY
+        touched = tab.overlay_srcs(self.read_ts) if tab.dirty() \
+            else set()
+        srcs, counts = tab.count_table()
+        if touched:
+            tarr = np.fromiter(touched, np.uint64, len(touched))
+            dirty_mask = np.isin(scan, tarr)
+            clean = scan[~dirty_mask]
+            dirty = scan[dirty_mask]
+        else:
+            clean, dirty = scan, scan[:0]
+        # clean uids: one searchsorted lookup + one vector compare
+        if len(srcs):
+            idx = np.clip(np.searchsorted(srcs, clean), 0, len(srcs) - 1)
+            hit = srcs[idx] == clean
+            cnts = np.where(hit, counts[idx], 0)
+        else:
+            cnts = np.zeros(len(clean), np.int64)
+        ok = vec(cnts, want)
+        keep = [clean[ok]]
+        # overlay-touched uids: exact per-uid MVCC count
+        keep.append(np.asarray(
+            [u for u in dirty.tolist()
+             if vec(tab.count_of(u, self.read_ts), want)],
+            dtype=np.uint64))
+        out = np.concatenate(keep)
+        out.sort()
+        return out
 
     def _count_zero_case(self, fn, candidates):
         if candidates is not None and _cmp(fn.name, 0, int(fn.args[0].value)):
@@ -1880,17 +1923,12 @@ class Agg:
 
 
 def _cmp(op: str, a, b) -> bool:
-    if op in ("eq",):
-        return a == b
-    if op == "le":
-        return a <= b
-    if op == "lt":
-        return a < b
-    if op == "ge":
-        return a >= b
-    if op == "gt":
-        return a > b
-    raise GQLError(f"bad comparison {op}")
+    # one comparator table for scalar and vector paths (_CMP_VEC) —
+    # they had drifted once already (review finding)
+    fn = _CMP_VEC.get(op)
+    if fn is None:
+        raise GQLError(f"bad comparison {op}")
+    return fn(a, b)
 
 
 def _aggregate(fn: str, vals: list[Val]) -> Optional[Val]:

@@ -320,6 +320,25 @@ class Tablet:
             return len(self.get_dst_uids(src, read_ts))
         return len(self.get_postings(src, read_ts))
 
+    def count_table(self) -> tuple[np.ndarray, np.ndarray]:
+        """Vectorized fan-out counts over the BASE state: (sorted src
+        uint64 array, aligned int64 counts) — the count-index column
+        the reference maintains per @count predicate (posting/index.go
+        count keys), recomputed per base_ts instead of per mutation.
+        Overlay-touched uids must be answered via count_of; callers
+        partition with overlay_srcs()."""
+        cached = getattr(self, "_count_table", None)
+        if cached is not None and cached[0] == self.base_ts:
+            return cached[1], cached[2]
+        store = self.edges if self.is_uid else self.values
+        srcs = np.fromiter(store.keys(), np.uint64, len(store))
+        order = np.argsort(srcs)
+        srcs = srcs[order]
+        counts = np.fromiter((len(store[int(s)]) for s in srcs),
+                             np.int64, len(srcs))
+        self._count_table = (self.base_ts, srcs, counts)
+        return srcs, counts
+
     def get_facets(self, src: int, dst: int, read_ts: int) -> dict:
         out = self.edge_facets.get((src, dst), {})
         for op in self._overlay(read_ts):

@@ -465,3 +465,23 @@ def test_facets_not_attached_to_prior_sibling():
     fr = r["q"][0]["friend"]
     assert len(fr) == 1
     assert fr[0]["friend|weight"] == 1
+
+
+def test_count_between_filter():
+    # review regression: between(count(p), lo, hi) must work (it
+    # previously raised) — both at root and under a live overlay
+    d = GraphDB(prefer_device=False)
+    d.alter("f: [uid] .")
+    lines = []
+    for s in range(1, 8):
+        for k in range(s):  # uid s has s edges
+            lines.append(f"<{s:#x}> <f> <{0x50 + k:#x}> .")
+    d.mutate(set_nquads="\n".join(lines))
+    out = d.query("{ q(func: between(count(f), 3, 5)) { uid } }")
+    assert [r["uid"] for r in out["data"]["q"]] == ["0x3", "0x4", "0x5"]
+    d.rollup_all()
+    d.rollup_in_read = False
+    d.mutate(set_nquads="<0x2> <f> <0x90> .\n<0x2> <f> <0x91> .")
+    out = d.query("{ q(func: between(count(f), 3, 5)) { uid } }")
+    assert [r["uid"] for r in out["data"]["q"]] == \
+        ["0x2", "0x3", "0x4", "0x5"]
