@@ -338,11 +338,20 @@ def cmd_bulk(args) -> int:
 
 
 def cmd_live(args) -> int:
-    """Online live loader (ref dgraph/cmd/live/run.go:238)."""
+    """Online live loader (ref dgraph/cmd/live/run.go:238). With
+    --alpha, streams into a RUNNING server over HTTP (the reference's
+    defining mode); otherwise loads an embedded store."""
+    schema = open(args.schema).read() if args.schema else ""
+    if args.alpha:
+        from dgraph_tpu.ingest.live import remote_live_load
+        stats = remote_live_load(args.alpha, args.files, schema=schema,
+                                 batch_size=args.batch,
+                                 concurrency=args.conc)
+        print(json.dumps(stats))
+        return 0
     from dgraph_tpu.engine.db import GraphDB
     from dgraph_tpu.ingest.live import live_load
 
-    schema = open(args.schema).read() if args.schema else ""
     if not args.wal:
         print("warning: no --wal given; loaded data dies with the process",
               file=sys.stderr)
@@ -651,6 +660,9 @@ def main(argv=None) -> int:
     lv.add_argument("files", nargs="+")
     lv.add_argument("--schema", default="")
     lv.add_argument("--wal", default="")
+    lv.add_argument("--alpha", default="",
+                    help="host:port of a running alpha: stream over "
+                         "HTTP instead of loading an embedded store")
     lv.add_argument("--batch", type=int, default=1000)
     lv.add_argument("--conc", type=int, default=4)
     lv.set_defaults(fn=cmd_live)

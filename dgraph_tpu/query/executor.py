@@ -683,7 +683,9 @@ class Executor:
         overlay-touched uids fall back to per-uid MVCC counting."""
         tab = self._tablet(fn.attr)
         if tab is None:
-            return _EMPTY if fn.name not in ("eq", "le", "lt") \
+            # only comparisons satisfiable by count==0 can match
+            return _EMPTY if fn.name not in ("eq", "le", "lt",
+                                             "between") \
                 else self._count_zero_case(fn, candidates)
         want = int(fn.args[0].value)
         cmp_name = fn.name
@@ -729,7 +731,12 @@ class Executor:
         return out
 
     def _count_zero_case(self, fn, candidates):
-        if candidates is not None and _cmp(fn.name, 0, int(fn.args[0].value)):
+        if candidates is None:
+            return _EMPTY
+        if fn.name == "between":
+            lo, hi = int(fn.args[0].value), int(fn.args[1].value)
+            return candidates if lo <= 0 <= hi else _EMPTY
+        if _cmp(fn.name, 0, int(fn.args[0].value)):
             return candidates
         return _EMPTY
 

@@ -305,6 +305,16 @@ class AlphaServer:
         from dgraph_tpu.utils.tracing import export_chrome_trace
         return {"traceEvents": export_chrome_trace()}
 
+    def handle_assign(self, params: dict) -> dict:
+        """Lease a uid block (ref zero.go /assign?what=uids): clients
+        like the live loader pre-allocate so blank nodes render as
+        concrete uids and batches stay fully concurrent."""
+        num = int(params.get("num", 1))
+        if not 0 < num <= 1_000_000:
+            raise ValueError("num must be in [1, 1000000]")
+        first, last = self.db.coordinator.assign_uids(num)
+        return {"startId": str(first), "endId": str(last)}
+
     def handle_health(self) -> dict:
         return {"status": "draining" if self.draining else "healthy",
                 "uptime_s": round(time.time() - self.started_at, 3),
@@ -528,6 +538,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, self.alpha.handle_commit(params, token))
             elif path in ("/alter", "/admin/schema"):
                 self._send(200, self.alpha.handle_alter(body, token))
+            elif path == "/assign":
+                self._send(200, self.alpha.handle_assign(params))
             elif path == "/admin/draining":
                 enable = params.get("enable", "true") == "true"
                 self._send(200, self.alpha.handle_draining(enable, token))

@@ -485,3 +485,17 @@ def test_count_between_filter():
     out = d.query("{ q(func: between(count(f), 3, 5)) { uid } }")
     assert [r["uid"] for r in out["data"]["q"]] == \
         ["0x2", "0x3", "0x4", "0x5"]
+
+
+def test_count_between_missing_tablet_zero_case():
+    # review regression: between(count(missing), 0, N) matches every
+    # candidate (their count is 0, inside the range)
+    d = GraphDB(prefer_device=False)
+    d.alter("name: string @index(exact) .")
+    d.mutate(set_nquads='<1> <name> "a" .\n<2> <name> "b" .')
+    out = d.query('{ q(func: has(name)) '
+                  '@filter(between(count(nope), 0, 5)) { uid } }')
+    assert [r["uid"] for r in out["data"]["q"]] == ["0x1", "0x2"]
+    out = d.query('{ q(func: has(name)) '
+                  '@filter(between(count(nope), 1, 5)) { uid } }')
+    assert out["data"]["q"] == []
