@@ -346,7 +346,8 @@ def cmd_live(args) -> int:
         from dgraph_tpu.ingest.live import remote_live_load
         stats = remote_live_load(args.alpha, args.files, schema=schema,
                                  batch_size=args.batch,
-                                 concurrency=args.conc)
+                                 concurrency=args.conc,
+                                 token=args.token)
         print(json.dumps(stats))
         return 0
     from dgraph_tpu.engine.db import GraphDB
@@ -663,6 +664,9 @@ def main(argv=None) -> int:
     lv.add_argument("--alpha", default="",
                     help="host:port of a running alpha: stream over "
                          "HTTP instead of loading an embedded store")
+    lv.add_argument("--token", default="",
+                    help="access JWT for ACL-protected alphas "
+                         "(ref dgraph live --creds)")
     lv.add_argument("--batch", type=int, default=1000)
     lv.add_argument("--conc", type=int, default=4)
     lv.set_defaults(fn=cmd_live)

@@ -145,7 +145,7 @@ def _render_nquad(nq: NQuad, xids: dict) -> str:
     from dgraph_tpu.ingest.export import _facet_str, _rdf_value
 
     def term(t: str) -> str:
-        if t.startswith("_:"):
+        if t.startswith("_:") or not _is_uid_lit(t):
             return f"<{xids[t]:#x}>"
         return f"<{t}>"
 
@@ -192,7 +192,8 @@ class _UidLease:
 def remote_live_load(addr: str, paths: Iterable[str] = (), *,
                      schema: str = "", batch_size: int = DEFAULT_BATCH,
                      concurrency: int = DEFAULT_CONCURRENCY,
-                     max_retries: int = 50) -> dict:
+                     max_retries: int = 50, token: str = "",
+                     timeout_s: float = 120.0) -> dict:
     """Stream files into a RUNNING alpha over HTTP — the reference live
     loader's defining mode (dgraph live --alpha, live/run.go:238):
     chunked parse, concurrent batches, abort (409) retry, and uid
@@ -209,9 +210,13 @@ def remote_live_load(addr: str, paths: Iterable[str] = (), *,
 
     def post(path: str, data: bytes,
              ctype: str = "application/rdf") -> dict:
-        req = urllib.request.Request(
-            base + path, data=data, headers={"Content-Type": ctype})
-        return _json.loads(urllib.request.urlopen(req).read())
+        headers = {"Content-Type": ctype}
+        if token:
+            headers["X-Dgraph-AccessToken"] = token
+        req = urllib.request.Request(base + path, data=data,
+                                     headers=headers)
+        return _json.loads(urllib.request.urlopen(
+            req, timeout=timeout_s).read())
 
     if schema:
         post("/alter", schema.encode())
@@ -223,7 +228,7 @@ def remote_live_load(addr: str, paths: Iterable[str] = (), *,
     def send(nqs: list[NQuad]):
         needed = {t for nq in nqs
                   for t in (nq.subject, nq.object_id or "")
-                  if t.startswith("_:")}
+                  if t and (t.startswith("_:") or not _is_uid_lit(t))}
         xids = lease.resolve(needed)
         body = "\n".join(_render_nquad(nq, xids) for nq in nqs)
         for attempt in range(max_retries):

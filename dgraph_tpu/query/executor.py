@@ -683,10 +683,9 @@ class Executor:
         overlay-touched uids fall back to per-uid MVCC counting."""
         tab = self._tablet(fn.attr)
         if tab is None:
-            # only comparisons satisfiable by count==0 can match
-            return _EMPTY if fn.name not in ("eq", "le", "lt",
-                                             "between") \
-                else self._count_zero_case(fn, candidates)
+            # every candidate has count 0: let the zero-case decide
+            # whether 0 satisfies the comparison (ge(count(x), 0) does)
+            return self._count_zero_case(fn, candidates)
         want = int(fn.args[0].value)
         cmp_name = fn.name
         if fn.name == "between":

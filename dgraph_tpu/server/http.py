@@ -305,15 +305,77 @@ class AlphaServer:
         from dgraph_tpu.utils.tracing import export_chrome_trace
         return {"traceEvents": export_chrome_trace()}
 
-    def handle_assign(self, params: dict) -> dict:
+    def handle_assign(self, params: dict, token: str = "") -> dict:
         """Lease a uid block (ref zero.go /assign?what=uids): clients
         like the live loader pre-allocate so blank nodes render as
-        concrete uids and batches stay fully concurrent."""
+        concrete uids and batches stay fully concurrent. Any valid
+        login may lease (it is a write-path primitive)."""
+        if self.acl is not None:
+            with self.meta:
+                self.acl.authorize(token)
         num = int(params.get("num", 1))
         if not 0 < num <= 1_000_000:
             raise ValueError("num must be in [1, 1000000]")
         first, last = self.db.coordinator.assign_uids(num)
         return {"startId": str(first), "endId": str(last)}
+
+    def _require_guardian(self, token: str, what: str):
+        if self.acl is not None:
+            from dgraph_tpu.server.acl import GUARDIANS
+            with self.meta:
+                claims = self.acl.authorize(token)
+                if GUARDIANS not in claims.get("groups", []):
+                    raise AclError(f"{what} needs guardian membership")
+
+    def handle_export(self, params: dict, token: str = "") -> dict:
+        """Server-side export to a directory on the ALPHA's filesystem
+        (ref /admin { export(...) }, worker/export.go:376). Guardians
+        only under ACL."""
+        import os
+        self._require_guardian(token, "/admin/export")
+        fmt = params.get("format", "rdf")
+        if fmt not in ("rdf", "json"):
+            raise ValueError(f"format must be rdf or json, not {fmt!r}")
+        dest = params.get("destination", "export")
+        from dgraph_tpu.ingest.export import (
+            export_json, export_rdf, export_schema,
+        )
+        with self.rw.read:
+            os.makedirs(dest, exist_ok=True)
+            spath = os.path.join(dest, "g01.schema")
+            with open(spath, "w") as f:
+                f.write(export_schema(self.db))
+            if fmt == "rdf":
+                dpath = os.path.join(dest, "g01.rdf")
+                with open(dpath, "w") as f:
+                    for line in export_rdf(self.db):
+                        f.write(line + "\n")
+            else:
+                dpath = os.path.join(dest, "g01.json")
+                with open(dpath, "w") as f:
+                    json.dump(export_json(self.db), f)
+        return {"code": "Success",
+                "message": "Export completed.",
+                "files": [dpath, spath]}
+
+    def handle_backup(self, params: dict, token: str = "") -> dict:
+        """Server-side incremental backup (ref /admin { backup(...) },
+        ee/backup). Guardians only under ACL; the manifest chain lives
+        at the destination like the offline CLI's."""
+        self._require_guardian(token, "/admin/backup")
+        dest = params.get("destination", "")
+        if not dest:
+            raise ValueError("destination is required")
+        force_full = params.get("forceFull", "false") == "true"
+        from dgraph_tpu.storage.backup import backup as do_backup
+        with self.rw.write:
+            # the rollup (a write) is quick; the expensive serialization
+            # below runs under the READ lock so queries keep flowing
+            self.db.rollup_all()
+        with self.rw.read:
+            entry = do_backup(self.db, dest, force_full=force_full)
+        return {"code": "Success", "message": "Backup completed.",
+                "entry": entry}
 
     def handle_health(self) -> dict:
         return {"status": "draining" if self.draining else "healthy",
@@ -323,26 +385,14 @@ class AlphaServer:
     def handle_draining(self, enable: bool, token: str = "") -> dict:
         """Toggle draining (guardians only under ACL) — ref
         alpha/admin.go drainingHandler."""
-        if self.acl is not None:
-            from dgraph_tpu.server.acl import GUARDIANS
-            with self.meta:
-                claims = self.acl.authorize(token)
-                if GUARDIANS not in claims.get("groups", []):
-                    raise AclError(
-                        "/admin/draining needs guardian membership")
+        self._require_guardian(token, "/admin/draining")
         self.draining = enable
         log.info("draining", enable=enable)
         return {"code": "Success",
                 "message": f"draining mode is now {enable}"}
 
     def handle_get_schema(self, token: str = "") -> dict:
-        if self.acl is not None:
-            from dgraph_tpu.server.acl import GUARDIANS
-            with self.meta:
-                claims = self.acl.authorize(token)
-                if GUARDIANS not in claims.get("groups", []):
-                    raise AclError("/admin/schema needs guardian "
-                                   "membership")
+        self._require_guardian(token, "/admin/schema")
         with self.rw.read:
             return {"schema": self.db.schema.describe_all()}
 
@@ -539,7 +589,11 @@ class _Handler(BaseHTTPRequestHandler):
             elif path in ("/alter", "/admin/schema"):
                 self._send(200, self.alpha.handle_alter(body, token))
             elif path == "/assign":
-                self._send(200, self.alpha.handle_assign(params))
+                self._send(200, self.alpha.handle_assign(params, token))
+            elif path == "/admin/export":
+                self._send(200, self.alpha.handle_export(params, token))
+            elif path == "/admin/backup":
+                self._send(200, self.alpha.handle_backup(params, token))
             elif path == "/admin/draining":
                 enable = params.get("enable", "true") == "true"
                 self._send(200, self.alpha.handle_draining(enable, token))

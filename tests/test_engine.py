@@ -499,3 +499,16 @@ def test_count_between_missing_tablet_zero_case():
     out = d.query('{ q(func: has(name)) '
                   '@filter(between(count(nope), 1, 5)) { uid } }')
     assert out["data"]["q"] == []
+
+
+def test_count_zero_case_all_ops():
+    d = GraphDB(prefer_device=False)
+    d.alter("name: string @index(exact) .")
+    d.mutate(set_nquads='<1> <name> "a" .')
+    def q(flt):
+        out = d.query('{ q(func: has(name)) @filter(%s) { uid } }' % flt)
+        return [r["uid"] for r in out["data"]["q"]]
+    assert q("ge(count(nope), 0)") == ["0x1"]
+    assert q("le(count(nope), 0)") == ["0x1"]
+    assert q("gt(count(nope), 0)") == []
+    assert q("eq(count(nope), 0)") == ["0x1"]

@@ -262,3 +262,26 @@ def test_remote_live_load_datetime_facet(tmp_path):
         assert "2020-01-01" in str(edge["knows|since"])
     finally:
         httpd.shutdown()
+
+
+def test_remote_live_load_xid_subjects(tmp_path):
+    """review regression: non-uid-literal xids (not just _: blanks)
+    resolve consistently in --alpha mode, matching the local loader."""
+    from dgraph_tpu.ingest.live import remote_live_load
+    from dgraph_tpu.server.http import serve
+    rdf = tmp_path / "x.rdf"
+    rdf.write_text('<alice> <name> "Alice" .\n'
+                   '<bob> <name> "Bob" .\n'
+                   '<alice> <knows> <bob> .\n')
+    httpd, alpha = serve(block=False, port=0)
+    try:
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        stats = remote_live_load(
+            addr, [str(rdf)],
+            schema="name: string @index(exact) .\nknows: [uid] .")
+        assert stats["nquads"] == 3
+        out = alpha.db.query('{ q(func: eq(name, "Alice")) '
+                             '{ knows { name } } }')
+        assert out["data"]["q"][0]["knows"] == [{"name": "Bob"}]
+    finally:
+        httpd.shutdown()
