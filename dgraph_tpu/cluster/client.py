@@ -102,13 +102,21 @@ class ClusterClient:
     # ------------------------------------------------------- alpha surface
 
     def query(self, q: str, variables: Optional[dict] = None,
-              hedge_s: Optional[float] = None) -> dict:
+              hedge_s: Optional[float] = None,
+              read_ts: Optional[int] = None) -> dict:
         """Snapshot read from any replica. With hedge_s set, a backup
         request fires at a second replica if the first hasn't answered
         within the delay and the first response wins — the reference's
         processWithBackupRequest (worker/task.go:66) tail-latency
         defense."""
         req = {"op": "query", "q": q, "vars": variables}
+        if read_ts is not None:
+            req["read_ts"] = read_ts
+            if hedge_s is not None:
+                # pinned reads are leader-only; the hedge path fires at
+                # arbitrary replicas with no leader rerouting
+                raise ValueError(
+                    "read_ts and hedge_s cannot be combined")
         if hedge_s is not None and len(self.addrs) > 1:
             return self._unwrap(self._hedged(req, hedge_s))
         return self._unwrap(self.request(req))

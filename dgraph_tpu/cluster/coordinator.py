@@ -50,13 +50,34 @@ class Coordinator:
 
     # -- timestamps (ref zero/assign.go:64) --
 
+    # when set, timestamps come from the cluster's Zero quorum (one
+    # allocation RPC each, like the reference's zero AssignTimestampIds)
+    # so every group's ts live in ONE global order and cross-group
+    # snapshot reads are comparable. fn(n) -> first ts of a block of n.
+    ts_source_fn = None
+
+    def _alloc_ts(self) -> int:
+        if self.ts_source_fn is not None:
+            ts = self.ts_source_fn(1)
+            self._ts = max(self._ts, ts)
+            return ts
+        self._ts += 1
+        return self._ts
+
     def next_ts(self) -> int:
         with self._lock:
-            self._ts += 1
-            return self._ts
+            return self._alloc_ts()
 
     def max_assigned(self) -> int:
         return self._ts
+
+    def observe_ts(self, ts: int):
+        """Advance the local high-water mark past a ts somebody else
+        allocated (replay/replication) WITHOUT allocating — with a zero
+        ts source, allocation is an RPC and must never run in a
+        catch-up loop."""
+        with self._lock:
+            self._ts = max(self._ts, ts)
 
     # -- uid leases (ref zero/assign.go:158) --
 
@@ -89,8 +110,7 @@ class Coordinator:
 
     def begin(self) -> TxnState:
         with self._lock:
-            self._ts += 1
-            st = TxnState(start_ts=self._ts)
+            st = TxnState(start_ts=self._alloc_ts())
             self._active[st.start_ts] = st
             return st
 
@@ -122,8 +142,7 @@ class Coordinator:
                     raise TxnAborted(
                         f"conflict on key {key:#x}: committed at {last} > "
                         f"start {txn.start_ts}")
-            self._ts += 1
-            commit_ts = self._ts
+            commit_ts = self._alloc_ts()
             for key in conflict_keys:
                 self._commits[key] = commit_ts
             st.committed = True

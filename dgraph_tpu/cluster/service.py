@@ -263,6 +263,18 @@ class AlphaServer(RaftServer):
             from dgraph_tpu.cluster.client import ClusterClient
             self.zero = ClusterClient(zero_addrs, timeout=10.0)
             self.db.coordinator.uid_lease_fn = self.zero.assign_uids
+            # one GLOBAL timestamp order across every group (ref zero
+            # AssignTimestampIds): cross-group snapshot reads become
+            # comparable, at one zero RPC per allocation. The ts client
+            # gets a deadline WELL below the election timeout: ts
+            # allocation happens under the raft lock, and a stalled
+            # zero must fail the write fast, not stall heartbeats until
+            # our followers depose us.
+            ts_budget = max(0.05,
+                            kw.get("tick_s", 0.05) *
+                            kw.get("election_ticks", 10) / 3)
+            self._zero_ts = ClusterClient(zero_addrs, timeout=ts_budget)
+            self.db.coordinator.ts_source_fn = self._zero_ts.assign_ts
         # committed event stream: authoritative rebuild source
         self._events: list[tuple] = []
         # serializes execute+propose so the log's record order matches
@@ -275,6 +287,8 @@ class AlphaServer(RaftServer):
     # -------------------------------------------------------- state machine
 
     def sm_apply(self, origin, rec) -> int:
+        if rec == ("noop",):
+            return 0  # read-barrier marker, no state change
         self._events.append(("rec", rec))
         if origin == (self.id, self.epoch):
             return 0  # leader pre-applied while executing the txn
@@ -296,6 +310,7 @@ class AlphaServer(RaftServer):
         db = restore_state(wire.loads_compat(snap),
                            GraphDB(**self._db_kw))
         db.coordinator.uid_lease_fn = self.db.coordinator.uid_lease_fn
+        db.coordinator.ts_source_fn = self.db.coordinator.ts_source_fn
         self.db = db
 
     def _rebuild_from_events(self):
@@ -306,6 +321,7 @@ class AlphaServer(RaftServer):
         self.epoch += 1  # own-origin records must re-apply from now on
         db = GraphDB(**self._db_kw)
         db.coordinator.uid_lease_fn = self.db.coordinator.uid_lease_fn
+        db.coordinator.ts_source_fn = self.db.coordinator.ts_source_fn
         for kind, payload in self._events:
             if kind == "snap":
                 db = restore_state(wire.loads_compat(payload), db)
@@ -317,6 +333,26 @@ class AlphaServer(RaftServer):
                 if ts:
                     db.fast_forward_ts(ts)
         self.db = db
+
+    def _read_barrier(self):
+        """Linearizable-read barrier for pinned reads (raft §8): a
+        freshly elected leader may hold committed-but-unapplied entries
+        from the previous term, and cannot even KNOW the old commit
+        index until an entry of its own term commits. Committing one
+        no-op round-trip guarantees everything acknowledged before this
+        read is applied here."""
+        with self.lock:
+            if self.node.role != LEADER:
+                raise NotLeader(self.node.leader_id)
+            caught_up = (self.node.applied_index ==
+                         self.node.commit_index and
+                         self.node._term_at(self.node.commit_index)
+                         == self.node.term)
+        if caught_up:
+            return
+        ok, _ = self.propose_and_wait(("noop",))
+        if not ok:
+            raise RuntimeError("read barrier failed (no quorum)")
 
     # --------------------------------------------------------------- writes
 
@@ -405,11 +441,21 @@ class AlphaServer(RaftServer):
     def handle_request(self, req: dict) -> dict:
         op = req.get("op")
         if op == "query":
-            # any replica serves snapshot reads (edgraph/server.go:760
-            # best-effort queries); under the lock because the apply /
-            # restore threads mutate and rebind self.db
+            # any replica serves best-effort snapshot reads
+            # (edgraph/server.go:760); under the lock because the
+            # apply/restore threads mutate and rebind self.db.
+            # read_ts (a zero-issued GLOBAL timestamp) pins the MVCC
+            # snapshot for cross-group scatter reads — leader-only,
+            # since the leader applies its commits synchronously so a
+            # read at T sees exactly the commits with ts <= T.
+            read_ts = int(req.get("read_ts", 0)) or None
+            if read_ts is not None:
+                self._read_barrier()
             with self.lock:
-                out = self.db.query(req["q"], variables=req.get("vars"))
+                if read_ts is not None and self.node.role != LEADER:
+                    raise NotLeader(self.node.leader_id)
+                out = self.db.query(req["q"], variables=req.get("vars"),
+                                    read_ts=read_ts)
             return {"ok": True, "result": out}
         if op == "mutate":
             kw = dict(req["kw"])

@@ -171,12 +171,19 @@ class RoutedCluster:
                 var_home[vc] = gid
             assign.append((gid, gq))
 
+        # one zero-issued GLOBAL timestamp pins every group's MVCC
+        # snapshot: the scatter reads a single consistent cut of the
+        # cluster (groups share zero's ts order, so "commits <= T"
+        # means the same instant everywhere)
+        read_ts = self.zero.assign_ts(1)
         # the full document runs on every involved group (var chains
         # assigned to that group resolve completely there); each
         # block's RESULT is taken from its owning group only
-        merged: dict = {"data": {}, "extensions": {"scatter": []}}
+        merged: dict = {"data": {},
+                        "extensions": {"scatter": [],
+                                       "read_ts": read_ts}}
         for gid in sorted({g for g, _ in assign}):
-            out = self.groups[gid].query(q, variables)
+            out = self.groups[gid].query(q, variables, read_ts=read_ts)
             data = out.get("data", {})
             # response shape must not depend on tablet placement:
             # carry extensions like the single-group path does

@@ -572,8 +572,7 @@ class GraphDB:
 
     def fast_forward_ts(self, max_ts: int):
         """Advance the ts counter past replayed/replicated commits."""
-        while self.coordinator.max_assigned() < max_ts:
-            self.coordinator.next_ts()
+        self.coordinator.observe_ts(max_ts)
 
     def _replay(self):
         max_ts = 0
@@ -587,7 +586,11 @@ class GraphDB:
     # ------------------------------------------------------------------
 
     def query(self, q: str, variables: dict | None = None,
-              txn: Optional[Txn] = None, best_effort: bool = True) -> dict:
+              txn: Optional[Txn] = None, best_effort: bool = True,
+              read_ts: Optional[int] = None) -> dict:
+        """`read_ts` pins the MVCC snapshot to an externally issued
+        timestamp (a zero-global ts for cross-group reads); otherwise
+        best_effort reads at max_assigned and strict reads allocate."""
         from dgraph_tpu.query.executor import Executor
 
         lat = Latency()
@@ -597,7 +600,9 @@ class GraphDB:
             lat.parsing_ns = time.perf_counter_ns() - t0
 
             t0 = time.perf_counter_ns()
-            if txn is not None:
+            if read_ts is not None:
+                pass  # pinned snapshot
+            elif txn is not None:
                 read_ts = txn.start_ts
             elif best_effort:
                 read_ts = self.coordinator.max_assigned()

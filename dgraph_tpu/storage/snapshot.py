@@ -76,8 +76,7 @@ def restore_state(payload: dict, db=None):
         ps = db.schema.get_or_default(pred)
         db.tablets[pred] = restore_tablet(pred, ps, st)
         db.coordinator.should_serve(pred)
-    while db.coordinator.max_assigned() < payload["max_ts"]:
-        db.coordinator.next_ts()
+    db.coordinator.observe_ts(payload["max_ts"])
     db.coordinator.bump_uids(payload["next_uid"] - 1)
     return db
 

@@ -250,3 +250,58 @@ def test_scatter_keeps_extensions(cluster):
     out = rc.query('{ a(func: has(p1)) { p1 } b(func: has(%s)) '
                    '{ uid } }' % other_pred)
     assert "extensions" in out and len(out["extensions"]["scatter"]) == 2
+
+
+def test_global_snapshot_scatter_read(cluster):
+    """Cross-group scatter reads pin ONE zero-issued timestamp: a
+    write committed AFTER the snapshot ts was taken is invisible even
+    if it lands before the second group is read (ref zero
+    AssignTimestampIds + oracle read-ts semantics)."""
+    rc = cluster
+    rc.alter("ga: string @index(exact) .\ngb: string @index(exact) .")
+    rc.mutate(set_nquads='_:a <ga> "snap-a" .')
+    # force gb onto the OTHER group
+    m = rc.tablet_map()["tablets"]
+    other = 2 if m["ga"] == 1 else 1
+    rc.groups[other].mutate(set_nquads='_:b <gb> "snap-b" .')
+    m = rc.tablet_map()["tablets"]
+    assert m["ga"] != m["gb"]
+
+    out = rc.query('{ a(func: eq(ga, "snap-a")) { ga } '
+                   '  b(func: eq(gb, "snap-b")) { gb } }')
+    snap_ts = out["extensions"]["read_ts"]
+    assert out["data"]["a"] and out["data"]["b"]
+
+    # a LATER commit gets a ts > snap_ts (global order across groups)
+    rc.mutate(set_nquads='_:c <ga> "after-snap" .')
+    out2 = rc.query('{ a(func: has(ga)) { ga } b(func: has(gb)) { gb } }')
+    assert out2["extensions"]["read_ts"] > snap_ts
+    names = {r["ga"] for r in out2["data"]["a"]}
+    assert "after-snap" in names
+    # re-reading AT the old snapshot excludes the later commit
+    old = rc.groups[m["ga"]].query('{ a(func: has(ga)) { ga } }',
+                                   read_ts=snap_ts)
+    names_old = {r["ga"] for r in old["data"]["a"]}
+    assert "after-snap" not in names_old and "snap-a" in names_old
+
+
+def test_groups_share_zero_ts_order(cluster):
+    """Both groups allocate timestamps from zero: their commit ts
+    never collide and strictly interleave in one global order."""
+    rc = cluster
+    m = rc.tablet_map()["tablets"]
+    g1 = rc.groups[m["ga"]]
+    g2 = rc.groups[m["gb"]]
+    ts = []
+    for i in range(3):
+        r1 = g1.query('{ q(func: has(ga)) { count(uid) } }')
+        g1.mutate(set_nquads=f'_:x <ga> "o{i}" .')
+        g2.mutate(set_nquads=f'_:y <gb> "o{i}" .')
+        s1 = g1.status()
+        s2 = g2.status()
+        ts.append((s1["max_ts"], s2["max_ts"]))
+    # high-water marks advance through one shared STRICTLY increasing
+    # sequence: local per-group counters would repeat values across
+    # groups (e.g. both at 3, 6, 9) and fail both conditions
+    flat = [t for pair in ts for t in pair]
+    assert sorted(flat) == flat and len(set(flat)) == len(flat), flat
