@@ -35,7 +35,11 @@ class ClusterClient:
         if sock is not None:
             return sock
         try:
-            sock = socket.create_connection(self.addrs[node], timeout=2.0)
+            # connect budget never exceeds the client's deadline: a
+            # SYN-blackholed peer must not eat a 2s connect timeout on
+            # a 150ms-budget timestamp client (raft lock is held)
+            sock = socket.create_connection(
+                self.addrs[node], timeout=min(2.0, self.timeout))
             sock.settimeout(self.timeout)
         except OSError:
             return None
@@ -176,6 +180,20 @@ class ClusterClient:
 
     def mutate(self, **kw) -> dict:
         return self._unwrap(self.request({"op": "mutate", "kw": kw}))
+
+    # dgo-style interactive txns: the group leader stages; commit
+    # replicates (a leader change aborts open txns — retry)
+    def txn_mutate(self, start_ts: int = 0, **kw) -> dict:
+        kw["commit_now"] = False
+        if start_ts:
+            kw["start_ts"] = start_ts
+        return self._unwrap(self.request({"op": "mutate", "kw": kw}))
+
+    def txn_commit(self, start_ts: int, abort: bool = False) -> dict:
+        return self._unwrap(self.request(
+            {"op": "commit",
+             "params": {"startTs": str(start_ts),
+                        "abort": "true" if abort else "false"}}))
 
     def alter(self, schema_text: str = "", **kw) -> dict:
         kw["schema_text"] = schema_text

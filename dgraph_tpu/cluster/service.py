@@ -254,6 +254,11 @@ class AlphaServer(RaftServer):
         self._db_kw = dict(db_kw or {})
         self._db_kw.setdefault("prefer_device", False)
         self.db = GraphDB(**self._db_kw)
+        # open interactive txns (dgo flow): leader-local by design —
+        # the reference's txns are likewise coordinated with the group
+        # leader and die on leader change (clients retry)
+        self._txns: dict[int, Any] = {}
+        self._txn_touched: dict[int, float] = {}
         # multi-group mode: a Zero quorum owns the tablet map and the
         # uid space; this alpha claims tablets, checks ownership before
         # every write, and leases uid blocks (ref worker/groups.go
@@ -334,6 +339,17 @@ class AlphaServer(RaftServer):
                     db.fast_forward_ts(ts)
         self.db = db
 
+    def _evict_idle_txns(self, ttl_s: float = 300.0):
+        """Abort open txns idle past the TTL (ref --abort_older_than).
+        Caller holds self.lock."""
+        now = time.time()
+        for ts, t in list(self._txn_touched.items()):
+            if now - t > ttl_s:
+                txn = self._txns.pop(ts, None)
+                self._txn_touched.pop(ts, None)
+                if txn is not None:
+                    self.db.discard(txn)
+
     def _read_barrier(self):
         """Linearizable-read barrier for pinned reads (raft §8): a
         freshly elected leader may hold committed-but-unapplied entries
@@ -344,12 +360,10 @@ class AlphaServer(RaftServer):
         with self.lock:
             if self.node.role != LEADER:
                 raise NotLeader(self.node.leader_id)
-            caught_up = (self.node.applied_index ==
-                         self.node.commit_index and
-                         self.node._term_at(self.node.commit_index)
-                         == self.node.term)
-        if caught_up:
-            return
+        # ALWAYS a quorum round-trip: a partitioned ex-leader that
+        # still believes it leads cannot commit this no-op, so it
+        # fails here instead of serving a stale snapshot (read-index
+        # semantics; a local caught-up check is not enough)
         ok, _ = self.propose_and_wait(("noop",))
         if not ok:
             raise RuntimeError("read barrier failed (no quorum)")
@@ -387,27 +401,34 @@ class AlphaServer(RaftServer):
                 raise RuntimeError(
                     f"tablet {p!r} belongs to group {owner}")
 
+    def _capture_and_replicate(self, fn) -> Any:
+        """Run `fn(db)` on the leader with the record sink attached,
+        then replicate every captured record; quorum loss rolls the
+        engine back from the committed event stream. Caller holds
+        _write_lock."""
+        with self.lock:
+            if self.node.role != LEADER:
+                raise NotLeader(self.node.leader_id)
+            captured: list = []
+            prev = self.db.on_record
+            self.db.on_record = captured.append
+            try:
+                result = fn(self.db)
+            finally:
+                self.db.on_record = prev
+        for rec in captured:
+            ok, _ = self.propose_and_wait(rec)
+            if not ok:
+                with self.lock:
+                    self._rebuild_from_events()
+                raise RuntimeError(
+                    "write not replicated (no quorum)")
+        return result
+
     def _replicate_write(self, fn, preds=()) -> Any:
         with self._write_lock:
             self._check_ownership(preds)
-            with self.lock:
-                if self.node.role != LEADER:
-                    raise NotLeader(self.node.leader_id)
-                captured: list = []
-                prev = self.db.on_record
-                self.db.on_record = captured.append
-                try:
-                    result = fn(self.db)
-                finally:
-                    self.db.on_record = prev
-            for rec in captured:
-                ok, _ = self.propose_and_wait(rec)
-                if not ok:
-                    with self.lock:
-                        self._rebuild_from_events()
-                    raise RuntimeError(
-                        "write not replicated (no quorum)")
-            return result
+            return self._capture_and_replicate(fn)
 
     @staticmethod
     def _mutation_preds(kw: dict) -> set:
@@ -450,21 +471,108 @@ class AlphaServer(RaftServer):
             # read at T sees exactly the commits with ts <= T.
             read_ts = int(req.get("read_ts", 0)) or None
             if read_ts is not None:
-                self._read_barrier()
+                # pinned read: hold the write lock so no commit is
+                # mid-flight (applied locally, not yet quorum-acked —
+                # reading that state would be a dirty read if the
+                # replication later fails and rolls back), and pay the
+                # quorum barrier — a deposed leader cannot commit the
+                # no-op, so it can never serve a stale pinned snapshot
+                with self._write_lock:
+                    self._read_barrier()
+                    with self.lock:
+                        if self.node.role != LEADER:
+                            raise NotLeader(self.node.leader_id)
+                        out = self.db.query(
+                            req["q"], variables=req.get("vars"),
+                            read_ts=read_ts)
+                return {"ok": True, "result": out}
             with self.lock:
-                if read_ts is not None and self.node.role != LEADER:
-                    raise NotLeader(self.node.leader_id)
-                out = self.db.query(req["q"], variables=req.get("vars"),
-                                    read_ts=read_ts)
+                out = self.db.query(req["q"], variables=req.get("vars"))
             return {"ok": True, "result": out}
         if op == "mutate":
             kw = dict(req["kw"])
-            kw.pop("commit_now", None)  # the RPC always commits
+            commit_now = kw.pop("commit_now", True)
+            start_ts = kw.pop("start_ts", 0)
             preds = self._mutation_preds(kw) if self.zero else ()
-            out = self._replicate_write(
-                lambda db: db.mutate(commit_now=True, **kw),
-                preds=preds)
+            if commit_now and not start_ts:
+                out = self._replicate_write(
+                    lambda db: db.mutate(commit_now=True, **kw),
+                    preds=preds)
+                return {"ok": True, "result": out}
+            # interactive txn flow: stage on the leader engine; records
+            # replicate at commit time
+            with self._write_lock:
+                self._check_ownership(preds)
+                with self.lock:
+                    if self.node.role != LEADER:
+                        raise NotLeader(self.node.leader_id)
+                    self._evict_idle_txns()
+                    if start_ts:
+                        txn = self._txns.get(start_ts)
+                        if txn is None:
+                            raise KeyError(
+                                f"no open txn at startTs={start_ts} "
+                                "(leader changed?)")
+                    else:
+                        txn = self.db.new_txn()
+                    try:
+                        out = self.db.mutate(txn, commit_now=False,
+                                             **kw)
+                    except Exception:
+                        # never leak start_ts in the oracle: a pinned
+                        # _active entry would freeze the rollup
+                        # watermark forever
+                        self._txns.pop(txn.start_ts, None)
+                        self._txn_touched.pop(txn.start_ts, None)
+                        self.db.discard(txn)
+                        raise
+                    self._txns[txn.start_ts] = txn
+                    self._txn_touched[txn.start_ts] = time.time()
+                    out.setdefault("extensions", {})["txn"] = {
+                        "start_ts": txn.start_ts}
+            if commit_now:
+                return self.handle_request(
+                    {"op": "commit",
+                     "params": {"startTs": str(txn.start_ts)}})
             return {"ok": True, "result": out}
+        if op == "commit":
+            params = req.get("params", {})
+            start_ts = int(params.get("startTs", 0))
+            abort = params.get("abort", "false") == "true"
+            with self._write_lock:
+                with self.lock:
+                    if self.node.role != LEADER:
+                        raise NotLeader(self.node.leader_id)
+                    txn = self._txns.pop(start_ts, None)
+                    self._txn_touched.pop(start_ts, None)
+                if txn is None:
+                    raise KeyError(
+                        f"no open txn at startTs={start_ts}")
+                if abort:
+                    with self.lock:
+                        self.db.discard(txn)
+                    return {"ok": True, "result": {
+                        "extensions": {"txn": {"start_ts": start_ts,
+                                               "aborted": True}}}}
+                # a tablet may have MOVED since the stage: committing
+                # here would write to a group that no longer owns it
+                self._check_ownership(
+                    {pred for pred, _ in txn.staged})
+
+                def do_commit(db):
+                    try:
+                        return db.commit(txn)
+                    except Exception:
+                        # commit failure (conflict abort, zero ts RPC
+                        # down) must release start_ts in the oracle
+                        if not txn.done:
+                            db.discard(txn)
+                        raise
+
+                commit_ts = self._capture_and_replicate(do_commit)
+            return {"ok": True, "result": {
+                "extensions": {"txn": {"start_ts": start_ts,
+                                       "commit_ts": commit_ts}}}}
         if op == "alter":
             self._replicate_write(lambda db: db.alter(**req["kw"]))
             return {"ok": True, "result": {}}
