@@ -24,6 +24,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Max int32 scalar-prefetch elements one kernel instance can hold in
+# SMEM (v5e: 2^17 passes, 2^18 fails the Mosaic compile). Buckets whose
+# flattened in-neighbor table exceeds this are split across calls.
+SMEM_IDX_CAPACITY = 1 << 17
+
 
 def bucket_or_pallas(f: jax.Array, in_nb: jax.Array,
                      interpret: bool | None = None) -> jax.Array:
@@ -50,21 +55,56 @@ def bucket_or_pallas(f: jax.Array, in_nb: jax.Array,
         def _acc():
             out_ref[...] = out_ref[...] | f_row[...]
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(m, d),
-        in_specs=[
-            pl.BlockSpec((1, w), lambda i, j, idx: (idx[i, j], 0)),
-        ],
-        out_specs=pl.BlockSpec((1, w), lambda i, j, idx: (i, 0)),
-    )
-    return pl.pallas_call(
-        kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((m, w), jnp.uint32),
-        # CPU CI simulates the TPU kernel (pltpu.InterpretParams);
-        # on real TPU this compiles through Mosaic
-        interpret=pltpu.InterpretParams() if interpret else False,
-    )(in_nb, f)
+    # Mosaic requires a block's last-two dims to be (8k, 128k)-divisible
+    # OR equal to the array's own trailing dims; a (1, W) block over a
+    # 2-D [N, W] array violates the sublane rule. Lift to [N, 1, W] so
+    # the (1, 1, W) block's trailing dims exactly match the array.
+    f3 = f[:, None, :]
+
+    def one_call(nb_chunk: jax.Array) -> jax.Array:
+        cm, cd = nb_chunk.shape
+        # the prefetched index vector lives in SMEM: it must be FLAT
+        # (2-D scalar arrays fail Mosaic above ~1k rows) and within
+        # capacity (2^17 int32 ≈ 512 KiB, measured on v5e — larger
+        # buckets are chunked below)
+        flat_idx = nb_chunk.reshape(-1)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(cm, cd),
+            in_specs=[
+                pl.BlockSpec((1, 1, w),
+                             lambda i, j, idx: (idx[i * cd + j], 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, w), lambda i, j, idx: (i, 0, 0)),
+        )
+        out = pl.pallas_call(
+            kernel, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((cm, 1, w), jnp.uint32),
+            # CPU CI simulates the TPU kernel (pltpu.InterpretParams);
+            # on real TPU this compiles through Mosaic
+            interpret=pltpu.InterpretParams() if interpret else False,
+        )(flat_idx, f3)
+        return out[:, 0, :]
+
+    def dispatch(nb: jax.Array) -> jax.Array:
+        cm, cd = nb.shape
+        if cm * cd <= SMEM_IDX_CAPACITY:
+            return one_call(nb)
+        if cd > SMEM_IDX_CAPACITY:
+            # mega-hub rows: one row's in-neighbors alone overflow
+            # SMEM — split the degree axis and OR the partial
+            # expansions (OR is associative, padding rows stay
+            # all-zero through every part)
+            acc = None
+            for s in range(0, cd, SMEM_IDX_CAPACITY):
+                p = dispatch(nb[:, s:s + SMEM_IDX_CAPACITY])
+                acc = p if acc is None else acc | p
+            return acc
+        rows_per = max(1, SMEM_IDX_CAPACITY // cd)
+        return jnp.concatenate([one_call(nb[s:s + rows_per])
+                                for s in range(0, cm, rows_per)])
+
+    return dispatch(in_nb)
 
 
 

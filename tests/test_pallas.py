@@ -49,6 +49,29 @@ def test_pallas_level_matches_xla(depth):
         assert np.array_equal(a, b)
 
 
+def test_pallas_chunked_dispatch_parity(monkeypatch):
+    """Buckets whose flattened in-neighbor table exceeds the SMEM
+    scalar-prefetch capacity are split — across rows for wide buckets,
+    across the degree axis for mega-hub rows. Shrink the capacity so
+    both split paths run (and nest) in interpret mode."""
+    import jax.numpy as jnp
+
+    from dgraph_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "SMEM_IDX_CAPACITY", 64)
+    rng = np.random.default_rng(7)
+    f = rng.integers(0, 2**31, (40, 128), dtype=np.uint32)
+    f[-1] = 0  # dummy slot row
+    for m, d in [(50, 3),    # row split: m*d > cap, d < cap
+                 (2, 100),   # degree split: d > cap
+                 (3, 130)]:  # degree split then row split inside
+        nb = rng.integers(0, 40, (m, d)).astype(np.int32)
+        got = pk.bucket_or_pallas(jnp.asarray(f), jnp.asarray(nb),
+                                  interpret=True)
+        want = np.bitwise_or.reduce(f[nb], axis=1)
+        assert np.array_equal(np.asarray(got), want), (m, d)
+
+
 def test_pallas_rejects_unaligned_w():
     from dgraph_tpu.ops.pallas_kernels import bucket_or_pallas
     import jax.numpy as jnp
