@@ -149,6 +149,18 @@ class Coordinator:
             del self._active[txn.start_ts]
             return commit_ts
 
+    def register_commit(self, conflict_keys: set, commit_ts: int):
+        """Mirror an externally decided commit into the conflict window
+        (ref posting/oracle.go:207 ProcessDelta: every alpha replays
+        Zero's commit decisions into its local oracle). Used by the
+        Raft apply path so a deposed-then-re-elected leader's conflict
+        checks see writes that committed through another leader."""
+        with self._lock:
+            self._ts = max(self._ts, commit_ts)
+            for key in conflict_keys:
+                if commit_ts > self._commits.get(key, 0):
+                    self._commits[key] = commit_ts
+
     def abort(self, txn: TxnState):
         with self._lock:
             st = self._active.pop(txn.start_ts, None)

@@ -317,6 +317,7 @@ class AlphaServer(RaftServer):
         db.coordinator.uid_lease_fn = self.db.coordinator.uid_lease_fn
         db.coordinator.ts_source_fn = self.db.coordinator.ts_source_fn
         self.db = db
+        self._drop_stale_txns()
 
     def _rebuild_from_events(self):
         """Quorum lost mid-write: discard un-replicated local state
@@ -338,6 +339,17 @@ class AlphaServer(RaftServer):
                 if ts:
                     db.fast_forward_ts(ts)
         self.db = db
+        self._drop_stale_txns()
+
+    def _drop_stale_txns(self):
+        """The engine object was just replaced (rebuild/snapshot
+        restore): open txn handles alias the OLD engine and oracle —
+        committing one against the new engine would stage against a
+        dead coordinator. Drop them all; clients see 'no open txn' and
+        retry, exactly the leader-change contract. Caller holds
+        self.lock."""
+        self._txns.clear()
+        self._txn_touched.clear()
 
     def _evict_idle_txns(self, ttl_s: float = 300.0):
         """Abort open txns idle past the TTL (ref --abort_older_than).
@@ -471,14 +483,20 @@ class AlphaServer(RaftServer):
             # read at T sees exactly the commits with ts <= T.
             read_ts = int(req.get("read_ts", 0)) or None
             if read_ts is not None:
-                # pinned read: hold the write lock so no commit is
-                # mid-flight (applied locally, not yet quorum-acked —
-                # reading that state would be a dirty read if the
-                # replication later fails and rolls back), and pay the
-                # quorum barrier — a deposed leader cannot commit the
-                # no-op, so it can never serve a stale pinned snapshot
+                # pinned read: pay the quorum barrier FIRST — a deposed
+                # leader cannot commit the no-op, so it can never serve
+                # a stale pinned snapshot. The barrier runs OUTSIDE
+                # _write_lock (it is a full network round-trip; holding
+                # the lock across it would serialize every write behind
+                # each pinned read). Then take _write_lock only around
+                # the local query so no commit is mid-flight (applied
+                # locally, not yet quorum-acked — reading that state
+                # would be a dirty read if replication later rolls
+                # back). A write that sneaks in between barrier and
+                # lock is fully replicated by the time we read — still
+                # a consistent snapshot at read_ts.
+                self._read_barrier()
                 with self._write_lock:
-                    self._read_barrier()
                     with self.lock:
                         if self.node.role != LEADER:
                             raise NotLeader(self.node.leader_id)
@@ -531,9 +549,17 @@ class AlphaServer(RaftServer):
                     out.setdefault("extensions", {})["txn"] = {
                         "start_ts": txn.start_ts}
             if commit_now:
-                return self.handle_request(
+                resp = self.handle_request(
                     {"op": "commit",
                      "params": {"startTs": str(txn.start_ts)}})
+                if not resp.get("ok"):
+                    return resp
+                # keep the stage's payload (uids map for blank nodes,
+                # like a dgo CommitNow mutation) and graft the commit
+                # extensions onto it
+                out.setdefault("extensions", {}).update(
+                    resp["result"].get("extensions", {}))
+                return {"ok": True, "result": out}
             return {"ok": True, "result": out}
         if op == "commit":
             params = req.get("params", {})
@@ -543,21 +569,28 @@ class AlphaServer(RaftServer):
                 with self.lock:
                     if self.node.role != LEADER:
                         raise NotLeader(self.node.leader_id)
-                    txn = self._txns.pop(start_ts, None)
-                    self._txn_touched.pop(start_ts, None)
+                    txn = self._txns.get(start_ts)
                 if txn is None:
                     raise KeyError(
                         f"no open txn at startTs={start_ts}")
                 if abort:
                     with self.lock:
+                        self._txns.pop(start_ts, None)
+                        self._txn_touched.pop(start_ts, None)
                         self.db.discard(txn)
                     return {"ok": True, "result": {
                         "extensions": {"txn": {"start_ts": start_ts,
                                                "aborted": True}}}}
                 # a tablet may have MOVED since the stage: committing
-                # here would write to a group that no longer owns it
+                # here would write to a group that no longer owns it.
+                # Checked BEFORE removing the handle — on failure the
+                # txn stays open (and its oracle entry alive) so the
+                # advertised retry actually works
                 self._check_ownership(
                     {pred for pred, _ in txn.staged})
+                with self.lock:
+                    self._txns.pop(start_ts, None)
+                    self._txn_touched.pop(start_ts, None)
 
                 def do_commit(db):
                     try:

@@ -560,9 +560,17 @@ class GraphDB:
             by_pred: dict[str, list[EdgeOp]] = {}
             for pred, op in staged:
                 by_pred.setdefault(pred, []).append(op)
+            conflict_keys: set = set()
             for pred, ops in by_pred.items():
                 # ops were expanded before logging: apply verbatim
-                self._tablet_for(pred).apply(commit_ts, ops)
+                tab = self._tablet_for(pred)
+                tab.apply(commit_ts, ops)
+                for op in ops:
+                    conflict_keys.add(self._conflict_key(tab, op))
+            # mirror the commit into the local oracle's conflict window
+            # (ref posting/oracle.go ProcessDelta): a replica that later
+            # becomes leader must abort open txns that raced this write
+            self.coordinator.register_commit(conflict_keys, commit_ts)
             uids = [op.src for _, op in staged] + \
                    [op.dst for _, op in staged if op.dst]
             if uids:

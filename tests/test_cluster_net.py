@@ -354,3 +354,19 @@ def test_failed_txn_stage_releases_oracle(alpha):
     client.mutate(set_nquads='_:w <tk> "post-fail" .')
     got = client.query('{ q(func: eq(tk, "post-fail")) { tk } }')
     assert got["data"]["q"] == [{"tk": "post-fail"}]
+
+
+def test_commit_now_with_open_txn_returns_uids(alpha):
+    """Review regression: finishing an open txn with a CommitNow
+    mutation must return the blank-node uid map from that final stage
+    (like dgo), not just the commit extensions."""
+    c, client = alpha
+    client.alter("tk: string @index(exact) .")
+    out = client.txn_mutate(set_nquads='_:a <tk> "cn-1" .')
+    ts = out["extensions"]["txn"]["start_ts"]
+    fin = client.mutate(start_ts=ts, commit_now=True,
+                        set_nquads='_:b <tk> "cn-2" .')
+    assert fin["uids"], "blank-node map lost on CommitNow finish"
+    assert fin["extensions"]["txn"]["commit_ts"] > ts
+    got = client.query('{ q(func: eq(tk, "cn-2")) { tk } }')
+    assert got["data"]["q"] == [{"tk": "cn-2"}]

@@ -512,3 +512,41 @@ def test_count_zero_case_all_ops():
     assert q("le(count(nope), 0)") == ["0x1"]
     assert q("gt(count(nope), 0)") == []
     assert q("eq(count(nope), 0)") == ["0x1"]
+
+
+def test_applied_commit_record_feeds_conflict_window():
+    """Review regression: a commit record applied through the Raft
+    path (apply_record) must land in the local oracle's conflict
+    window, so a replica that later becomes leader aborts open txns
+    that raced the replicated write (ref posting/oracle.go:207
+    ProcessDelta mirroring Zero's commit decisions)."""
+    import pytest
+
+    from dgraph_tpu.cluster.coordinator import TxnAborted
+    from dgraph_tpu.engine.db import GraphDB
+
+    db1, db2 = GraphDB(), GraphDB()
+    recs = []
+    db2.on_record = recs.append
+    for db in (db1, db2):
+        db.alter("bal: int .")
+    db2.mutate(set_nquads='<0x1> <bal> "100" .')
+    for r in recs:
+        db1.fast_forward_ts(db1.apply_record(r))
+    recs.clear()
+
+    # open a local txn touching (bal, 0x1), then apply a FOREIGN
+    # commit record for the same key with a later commit_ts (what a
+    # follower sees when another leader's write replicates in)
+    txn = db1.new_txn()
+    db1.mutate(txn, commit_now=False, set_nquads='<0x1> <bal> "50" .')
+    db2.mutate(set_nquads='<0x1> <bal> "70" .')
+    kind, _cts, staged, schemas = recs[0]
+    foreign = (kind, txn.start_ts + 5, staged, schemas)
+    db1.fast_forward_ts(db1.apply_record(foreign))
+
+    with pytest.raises(TxnAborted):
+        db1.commit(txn)
+    # the racing write won (no lost update)
+    out = db1.query('{ q(func: uid(0x1)) { bal } }')
+    assert out["data"]["q"] == [{"bal": 70}]
