@@ -1977,8 +1977,14 @@ def _eval_math(tree, value_vars) -> dict[int, Val]:
             return float(t.const)
         if t.var:
             vmap = value_vars.get(t.var, {})
-            return {u: float(v.value) for u, v in vmap.items()
-                    if v.tid in (TypeID.INT, TypeID.FLOAT, TypeID.BOOL)}
+            # datetimes flow as epoch-seconds floats so since() and
+            # date comparisons work (ref aggregator.go applySince
+            # converts datetime -> float seconds)
+            return {u: (v.value.timestamp()
+                        if v.tid == TypeID.DATETIME else float(v.value))
+                    for u, v in vmap.items()
+                    if v.tid in (TypeID.INT, TypeID.FLOAT, TypeID.BOOL,
+                                 TypeID.DATETIME)}
         args = [eval_node(c) for c in t.children]
         uids = set()
         for a in args:
@@ -2058,6 +2064,11 @@ def _apply_math(fn: str, v: list, _m):
         return 1.0 / (1.0 + _m.exp(-v[0]))
     if fn == "cond":
         return v[1] if v[0] else v[2]
+    if fn == "since":
+        # ref query/aggregator.go:353 applySince: seconds elapsed since
+        # the datetime (datetimes reach math as epoch-seconds floats)
+        import time as _time
+        return _time.time() - v[0]
     raise GQLError(f"math op {fn!r} not supported")
 
 

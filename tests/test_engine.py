@@ -202,6 +202,26 @@ def test_math(db):
     assert r["q"] == [{"d": 34}]
 
 
+def test_math_since(db):
+    """since(): seconds elapsed since a datetime (ref
+    query/aggregator.go:353 applySince); datetimes flow into math
+    trees as epoch-seconds so comparisons work too."""
+    db2 = GraphDB(prefer_device=False)
+    db2.alter("joined: datetime .")
+    db2.mutate(set_nquads='<0x1> <joined> "2020-01-01T00:00:00Z" .')
+    r = data(db2.query('''{
+      q(func: uid(0x1)) {
+        j as joined
+        secs: math(since(j))
+        old: math(since(j) > 86400)
+      }
+    }'''))
+    row = r["q"][0]
+    # 2020-01-01 is > 6 years before the build's clock, < 100 years
+    assert 6 * 365 * 86400 < row["secs"] < 100 * 365 * 86400
+    assert row["old"] is True
+
+
 def test_lang(db):
     r = data(db.query('{ q(func: uid(0x1)) { name@pl name@en:. } }'))
     assert r["q"][0]["name@pl"] == "Michona"
