@@ -105,6 +105,7 @@ def cmd_alpha(args) -> int:
     from dgraph_tpu.engine.db import GraphDB
     from dgraph_tpu.server.http import serve
 
+    _load_custom_toks(args)
     enc_key = _enc_key(args)
     if args.snapshot:
         from dgraph_tpu.storage.snapshot import load_snapshot
@@ -194,6 +195,15 @@ def _enc_key(args):
         from dgraph_tpu.storage.enc import load_key
         return load_key(args.encryption_key_file)
     return None
+
+
+def _load_custom_toks(args):
+    paths = getattr(args, "custom_tokenizers", "")
+    if paths:
+        from dgraph_tpu.models.tokenizer import load_custom_tokenizers
+        for spec in load_custom_tokenizers(paths.split(",")):
+            print(f"loaded custom tokenizer {spec.name!r} "
+                  f"(id {spec.ident:#x})", file=sys.stderr)
 
 
 def cmd_backup(args) -> int:
@@ -317,6 +327,7 @@ def cmd_bulk(args) -> int:
 
     from dgraph_tpu.ingest.bulk import bulk_load
 
+    _load_custom_toks(args)
     schema = open(args.schema).read() if args.schema else ""
     t0 = time.time()
     db = bulk_load(args.files, schema=schema)
@@ -607,6 +618,10 @@ def main(argv=None) -> int:
                    help="serve HTTPS from this cert dir (see `cert`)")
     a.add_argument("--tls-mtls", action="store_true",
                    help="require client certificates (mTLS)")
+    a.add_argument("--custom_tokenizers", default="",
+                   help="comma-separated Python plugin files, each "
+                        "exporting tokenizer() (ref tok/tok.go:116 "
+                        "LoadCustomTokenizer)")
     a.set_defaults(fn=cmd_alpha)
 
     acl = sub.add_parser("acl", help="ACL admin on a store directory")
@@ -655,6 +670,9 @@ def main(argv=None) -> int:
     b.add_argument("--schema", default="")
     b.add_argument("--out", default="",
                    help="snapshot file to write (the bulk output)")
+    b.add_argument("--custom_tokenizers", default="",
+                   help="comma-separated Python plugin files, each "
+                        "exporting tokenizer()")
     b.set_defaults(fn=cmd_bulk)
 
     lv = sub.add_parser("live", help="online live loader")

@@ -24,6 +24,7 @@ _ROOT_FUNCS = {
     "eq", "le", "lt", "ge", "gt", "between", "has", "uid", "uid_in",
     "anyofterms", "allofterms", "anyoftext", "alloftext", "regexp",
     "match", "near", "within", "contains", "intersects", "type",
+    "anyof", "allof",
 }
 _AGG_FUNCS = {"min", "max", "sum", "avg"}
 _DIRECTIVES = {"filter", "facets", "cascade", "normalize", "ignorereflex",

@@ -155,6 +155,59 @@ _register("hour", 0xC, TypeID.DATETIME, True, True, hour_tokens)
 _register("geo", 0xD, TypeID.GEO, False, True, geo_tokens)
 
 
+# Identifier bytes >= 0x80 are reserved for custom tokenizers (ref
+# tok/tok.go IdentCustom); built-ins stay below.
+IDENT_CUSTOM = 0x80
+
+
+def load_custom_tokenizer(path: str) -> TokenizerSpec:
+    """Load and register a custom tokenizer plugin.
+
+    Ref tok/tok.go:116 LoadCustomTokenizer: the reference opens a Go
+    plugin .so exporting `Tokenizer() interface{}`; the TPU build loads
+    a Python module file exporting `tokenizer()` returning an object
+    with attributes `name` (str), `for_type` (schema type name, e.g.
+    "string"/"int"), `identifier` (int >= 0x80), and a method
+    `tokens(value) -> list[str]` — the PluginTokenizer contract
+    (tok/tok.go:398). Custom tokenizers are never sortable and always
+    lossy, like the reference's CustomTokenizer wrapper hard-codes."""
+    import importlib.util
+    import os
+
+    from dgraph_tpu.models.types import type_from_name
+
+    modname = ("dgt_customtok_"
+               + os.path.splitext(os.path.basename(path))[0])
+    spec = importlib.util.spec_from_file_location(modname, path)
+    if spec is None or spec.loader is None:
+        raise ValueError(f"cannot load custom tokenizer from {path!r}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    plug = mod.tokenizer()
+    ident = int(plug.identifier)
+    if not (IDENT_CUSTOM <= ident <= 0xFF):
+        raise ValueError(
+            f"custom tokenizer identifier byte must be >= "
+            f"{IDENT_CUSTOM:#x}, but was {ident:#x}")
+    name = str(plug.name)
+    prev = _REGISTRY.get(name)
+    if prev is not None and prev.ident < IDENT_CUSTOM:
+        raise ValueError(
+            f"custom tokenizer may not shadow built-in {name!r}")
+
+    def fn(v: Val, _plug=plug) -> list:
+        return [str(t) for t in _plug.tokens(v.value)]
+
+    ts = TokenizerSpec(name, ident, type_from_name(str(plug.for_type)),
+                       False, True, fn)
+    _REGISTRY[name] = ts
+    return ts
+
+
+def load_custom_tokenizers(paths: Iterable[str]) -> list[TokenizerSpec]:
+    return [load_custom_tokenizer(p) for p in paths if p]
+
+
 def get_tokenizer(name: str) -> TokenizerSpec:
     spec = _REGISTRY.get(name)
     if spec is None:

@@ -278,6 +278,8 @@ class Executor:
             return self._eval_ineq(fn, candidates)
         if name in _TERM_FUNCS:
             return self._eval_terms(fn, candidates)
+        if name in ("anyof", "allof"):
+            return self._eval_anyof(fn, candidates)
         if name == "regexp":
             return self._eval_regexp(fn, candidates)
         if name == "match":
@@ -600,6 +602,41 @@ class Executor:
                     got = _union(got, s)
             out = _union(out, got)
         return out if candidates is None else _intersect(candidates, out)
+
+    def _eval_anyof(self, fn: Function, candidates) -> np.ndarray:
+        """anyof/allof(pred, tokenizer, v...): generic token match with
+        an explicitly named (usually custom plugin) tokenizer — the
+        custom-tokenizer query surface (ref worker/task.go:260 anyof/
+        allof cases; systest/plugin_test.go usage)."""
+        tab = self._tablet(fn.attr)
+        if tab is None:
+            return _EMPTY
+        if len(fn.args) < 2:
+            raise GQLError(
+                f"{fn.name} requires a tokenizer name and a value")
+        tokname = str(fn.args[0].value)
+        spec = get_tokenizer(tokname)
+        if tokname not in (tab.schema.tokenizers or []):
+            raise GQLError(
+                f"attribute {fn.attr!r} is not indexed with "
+                f"tokenizer {tokname!r}")
+        toks: list = []
+        for a in fn.args[1:]:
+            toks.extend(tokens_for(
+                Val(TypeID.STRING, str(a.value)), spec))
+        if not toks:
+            return _EMPTY
+        sets = [tab.index_uids(token_bytes(spec.ident, t), self.read_ts)
+                for t in toks]
+        if fn.name == "allof":
+            got = sets[0]
+            for s in sets[1:]:
+                got = _intersect(got, s)
+        else:
+            got = _EMPTY
+            for s in sets:
+                got = _union(got, s)
+        return got if candidates is None else _intersect(candidates, got)
 
     def _eval_regexp(self, fn: Function, candidates) -> np.ndarray:
         """Trigram-index prefilter + host regex verify

@@ -1,0 +1,21 @@
+"""Example custom tokenizer plugin: anagram.
+
+The TPU-build analogue of the reference's Go plugin
+(systest/_customtok/anagram/main.go): a module exporting `tokenizer()`
+returning an object with name / for_type / identifier / tokens().
+Values that are anagrams of each other share one token (their sorted
+characters), so `anyof(pred, anagram, "nat")` finds "tan".
+"""
+
+
+class AnagramTokenizer:
+    name = "anagram"
+    for_type = "string"
+    identifier = 0xFC
+
+    def tokens(self, value):
+        return ["".join(sorted(str(value)))]
+
+
+def tokenizer():
+    return AnagramTokenizer()

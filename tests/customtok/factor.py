@@ -1,0 +1,27 @@
+"""Example custom tokenizer plugin: prime factors of an int predicate
+(ref systest/_customtok/factor/main.go). `anyof(num, factor, 15)`
+matches every number sharing a prime factor with 15.
+"""
+
+
+class FactorTokenizer:
+    name = "factor"
+    for_type = "int"
+    identifier = 0xFD
+
+    def tokens(self, value):
+        n = int(value)
+        out, p = [], 2
+        while p * p <= n:
+            if n % p == 0:
+                out.append(str(p))
+                while n % p == 0:
+                    n //= p
+            p += 1
+        if n > 1:
+            out.append(str(n))
+        return out
+
+
+def tokenizer():
+    return FactorTokenizer()

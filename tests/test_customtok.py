@@ -1,0 +1,117 @@
+"""Custom tokenizer plugins (ref tok/tok.go:116 LoadCustomTokenizer +
+systest/plugin_test.go): load a Python plugin module, index a predicate
+with it, and query through anyof/allof(pred, tokenizer, values...).
+"""
+
+import os
+
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.models import tokenizer as tok
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _plugins():
+    specs = tok.load_custom_tokenizers([
+        os.path.join(_HERE, "customtok", "anagram.py"),
+        os.path.join(_HERE, "customtok", "factor.py"),
+    ])
+    yield specs
+    for s in specs:
+        tok._REGISTRY.pop(s.name, None)
+
+
+def _data(resp):
+    return resp["data"]
+
+
+def test_plugin_registration(_plugins):
+    spec = tok.get_tokenizer("anagram")
+    assert spec.ident == 0xFC and not spec.sortable and spec.lossy
+
+
+def test_anagram_string_index():
+    db = GraphDB(prefer_device=False)
+    db.alter("term: string @index(anagram) .")
+    db.mutate(set_nquads="\n".join([
+        '<0x1> <term> "airmen" .',
+        '<0x2> <term> "marine" .',
+        '<0x3> <term> "remain" .',
+        '<0x4> <term> "tan" .',
+    ]))
+    r = _data(db.query(
+        '{ q(func: anyof(term, anagram, "airmen")) { term } }'))
+    assert sorted(x["term"] for x in r["q"]) == \
+        ["airmen", "marine", "remain"]
+    r = _data(db.query(
+        '{ q(func: anyof(term, anagram, "nat")) { term } }'))
+    assert [x["term"] for x in r["q"]] == ["tan"]
+
+
+def test_factor_int_index_any_and_all():
+    db = GraphDB(prefer_device=False)
+    db.alter("num: int @index(factor) .")
+    db.mutate(set_nquads="\n".join(
+        f'<{u:#x}> <num> "{n}" .'
+        for u, n in [(1, 15), (2, 10), (3, 7), (4, 21), (5, 8)]))
+    # anyof: shares at least one prime factor with 15 (3 or 5)
+    r = _data(db.query('{ q(func: anyof(num, factor, 15)) { num } }'))
+    assert sorted(x["num"] for x in r["q"]) == [10, 15, 21]
+    # allof: every prime factor of 15 present (3 AND 5)
+    r = _data(db.query('{ q(func: allof(num, factor, 15)) { num } }'))
+    assert sorted(x["num"] for x in r["q"]) == [15]
+
+
+def test_anyof_as_filter():
+    db = GraphDB(prefer_device=False)
+    db.alter("t: string @index(anagram) .\nflag: bool .")
+    db.mutate(set_nquads="\n".join([
+        '<0x1> <t> "abc" .', '<0x1> <flag> "true" .',
+        '<0x2> <t> "cab" .',
+    ]))
+    r = _data(db.query(
+        '{ q(func: has(t)) @filter(anyof(t, anagram, "bca") AND '
+        'eq(flag, true)) { t } }'))
+    assert [x["t"] for x in r["q"]] == ["abc"]
+
+
+def test_unindexed_tokenizer_rejected():
+    db = GraphDB(prefer_device=False)
+    db.alter("plain: string @index(term) .")
+    db.mutate(set_nquads='<0x1> <plain> "x" .')
+    from dgraph_tpu.gql.lexer import GQLError
+    with pytest.raises(GQLError, match="not indexed with"):
+        db.query('{ q(func: anyof(plain, anagram, "x")) { plain } }')
+
+
+def test_bad_identifier_rejected(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text(
+        "class T:\n"
+        "    name = 'bad'\n"
+        "    for_type = 'string'\n"
+        "    identifier = 0x10\n"  # below the custom range
+        "    def tokens(self, v):\n"
+        "        return [str(v)]\n"
+        "def tokenizer():\n"
+        "    return T()\n")
+    with pytest.raises(ValueError, match="identifier byte"):
+        tok.load_custom_tokenizer(str(p))
+
+
+def test_shadowing_builtin_rejected(tmp_path):
+    p = tmp_path / "shadow.py"
+    p.write_text(
+        "class T:\n"
+        "    name = 'term'\n"
+        "    for_type = 'string'\n"
+        "    identifier = 0xFE\n"
+        "    def tokens(self, v):\n"
+        "        return [str(v)]\n"
+        "def tokenizer():\n"
+        "    return T()\n")
+    with pytest.raises(ValueError, match="shadow"):
+        tok.load_custom_tokenizer(str(p))
