@@ -11,42 +11,27 @@ chain's last read_ts, plus the schema and coordinator watermarks.
 Restore folds the chain newest-wins per tablet. Artifacts are
 gzip-compressed wire payloads, optionally sealed with AES-GCM (storage/enc.py).
 
-URI handlers: file paths and file:// work everywhere; s3://, minio://
-raise a clear error in this build (no object-store egress) while
-keeping the reference's URI-dispatch shape.
+URI handlers (storage/uri.py, ref ee/backup/handler.go): file paths
+and file:// everywhere; s3://bucket/prefix and minio://host:port/bucket
+speak the S3 REST protocol with SigV4 from env credentials.
 """
 
 from __future__ import annotations
 
 import gzip
 import json
-import os
 import time
 from typing import Optional
-from urllib.parse import urlparse
 
 from dgraph_tpu.storage.enc import decrypt_blob, encrypt_blob
+from dgraph_tpu.storage.uri import new_uri_handler
 
 MANIFEST = "manifest.json"
 
 
-def _handler_dir(dest: str) -> str:
-    u = urlparse(dest)
-    if u.scheme in ("", "file"):
-        return u.path or dest
-    if u.scheme in ("s3", "minio"):
-        raise NotImplementedError(
-            f"{u.scheme}:// backup handler needs object-store access "
-            "(ref ee/backup/s3_handler.go); use a file path or file:// URI")
-    raise ValueError(f"unknown backup URI scheme {u.scheme!r}")
-
-
 def read_manifests(dest: str) -> list[dict]:
-    path = os.path.join(_handler_dir(dest), MANIFEST)
-    if not os.path.exists(path):
-        return []
-    with open(path) as f:
-        return json.load(f)
+    raw = new_uri_handler(dest).get(MANIFEST)
+    return json.loads(raw) if raw else []
 
 
 def backup(db, dest: str, force_full: bool = False,
@@ -54,9 +39,8 @@ def backup(db, dest: str, force_full: bool = False,
     """Write a full or incremental backup; returns its manifest entry.
     Incremental = tablets whose state moved past the chain's last
     read_ts (ref backup.go Request.since logic)."""
-    dirpath = _handler_dir(dest)
-    os.makedirs(dirpath, exist_ok=True)
-    chain = read_manifests(dest)
+    handler = new_uri_handler(dest)
+    chain = json.loads(handler.get(MANIFEST) or "[]")
     since = 0 if (force_full or not chain) else chain[-1]["read_ts"]
 
     db.rollup_all()
@@ -90,8 +74,7 @@ def backup(db, dest: str, force_full: bool = False,
     name = f"backup-{since}-{read_ts}.gz"
     from dgraph_tpu import wire
     blob = gzip.compress(wire.dumps(payload))
-    with open(os.path.join(dirpath, name), "wb") as f:
-        f.write(encrypt_blob(blob, key))
+    handler.put(name, encrypt_blob(blob, key))
     entry = {"type": "full" if since == 0 else "incremental",
              "since_ts": since, "read_ts": read_ts, "file": name,
              "encrypted": key is not None,
@@ -99,10 +82,7 @@ def backup(db, dest: str, force_full: bool = False,
              "predicates": sorted(tablets),
              "dropped": dropped}
     chain.append(entry)
-    tmp = os.path.join(dirpath, MANIFEST + ".tmp")
-    with open(tmp, "w") as f:
-        json.dump(chain, f, indent=2)
-    os.replace(tmp, os.path.join(dirpath, MANIFEST))
+    handler.put(MANIFEST, json.dumps(chain, indent=2).encode())
     return entry
 
 
@@ -112,16 +92,18 @@ def restore(dest: str, db=None, key: Optional[bytes] = None):
     from dgraph_tpu.engine.db import GraphDB
     from dgraph_tpu.storage.tablet import Tablet
 
-    chain = read_manifests(dest)
+    handler = new_uri_handler(dest)
+    chain = json.loads(handler.get(MANIFEST) or "[]")
     if not chain:
         raise FileNotFoundError(f"no backup manifest under {dest!r}")
-    dirpath = _handler_dir(dest)
     db = db or GraphDB()
     max_ts = 0
     next_uid = 1
     for entry in chain:
-        with open(os.path.join(dirpath, entry["file"]), "rb") as f:
-            raw = f.read()
+        raw = handler.get(entry["file"])
+        if raw is None:
+            raise FileNotFoundError(
+                f"backup artifact {entry['file']!r} missing from chain")
         from dgraph_tpu.storage.snapshot import _load_payload
         payload = _load_payload(gzip.decompress(decrypt_blob(raw, key)))
         db.alter(payload["schema"])
