@@ -593,6 +593,50 @@ def cmd_compose(args) -> int:
     return 0
 
 
+def cmd_rebalance(args) -> int:
+    """Tablet rebalancing (ref zero/tablet.go:62 rebalanceTablets; the
+    reference runs it inside zero every --rebalance_interval 8m). Takes
+    the compose topology map, moves one tablet heaviest->lightest per
+    tick until converged; --once for a single pass."""
+    import time as _time
+
+    from dgraph_tpu.cluster.client import ClusterClient
+    from dgraph_tpu.cluster.topology import Rebalancer, RoutedCluster
+
+    with open(args.topology) as f:
+        topo = json.load(f)
+
+    def addrs(d: dict) -> dict:
+        out = {}
+        for i, a in d.items():
+            host, port = a.rsplit(":", 1)
+            out[int(i)] = (host, int(port))
+        return out
+
+    zero = ClusterClient(addrs(topo["zero"]), timeout=30.0)
+    groups = {int(g): ClusterClient(addrs(members), timeout=30.0)
+              for g, members in topo["groups"].items()}
+    rc = RoutedCluster(zero, groups)
+    reb = Rebalancer(rc, interval_s=args.interval,
+                     threshold=args.threshold)
+    try:
+        while True:
+            move = reb.tick()
+            if move:
+                pred, src, dst = move
+                print(f"moved tablet {pred!r}: group {src} -> {dst}")
+            elif args.once:
+                print("balanced")
+            if args.once and move is None:
+                return 0
+            if not args.once and move is None:
+                _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        rc.close()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="dgraph-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -758,6 +802,19 @@ def main(argv=None) -> int:
     co.add_argument("--base-port", type=int, default=7000)
     co.add_argument("--out", default="cluster.sh")
     co.set_defaults(fn=cmd_compose)
+
+    rb = sub.add_parser("rebalance",
+                        help="tablet rebalancer (zero/tablet.go:62)")
+    rb.add_argument("topology",
+                    help="topology.json from `compose`")
+    rb.add_argument("--interval", type=float, default=480.0,
+                    help="seconds between passes (ref "
+                         "--rebalance_interval 8m)")
+    rb.add_argument("--threshold", type=int, default=2,
+                    help="min load spread before moving a tablet")
+    rb.add_argument("--once", action="store_true",
+                    help="run until balanced, then exit")
+    rb.set_defaults(fn=cmd_rebalance)
 
     argv = _apply_config_layers(sub.choices,
                                 argv if argv is not None else sys.argv[1:])

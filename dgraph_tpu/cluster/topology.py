@@ -316,3 +316,75 @@ class RoutedCluster:
         self.zero.close()
         for c in self.groups.values():
             c.close()
+
+
+class Rebalancer:
+    """Periodic tablet rebalancing (ref zero/tablet.go:62
+    rebalanceTablets, default every 8 minutes): each tick compares
+    group loads and live-moves ONE tablet from the heaviest group to
+    the least loaded, converging the cluster a step at a time exactly
+    like the reference (chooseTablet moves one predicate per cycle so
+    a bad heuristic can never thrash the whole keyspace at once).
+
+    Load = tablet count by default; pass size_fn(pred) for a
+    byte-weighted choice (the reference weighs by tablet space from
+    membership reports)."""
+
+    def __init__(self, cluster: RoutedCluster,
+                 interval_s: float = 480.0, threshold: int = 2,
+                 size_fn=None):
+        import threading
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.threshold = threshold
+        self.size_fn = size_fn or (lambda pred: 1)
+        self.moves: list[tuple[str, int, int]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[Any] = None
+
+    def tick(self) -> Optional[tuple[str, int, int]]:
+        """One rebalance pass; returns the move made, if any."""
+        tmap = self.cluster.tablet_map()
+        by_group: dict[int, list[str]] = {
+            g: [] for g in self.cluster.groups}
+        for pred, gid in tmap["tablets"].items():
+            if pred in tmap["moving"] or pred.startswith("dgraph."):
+                continue
+            by_group.setdefault(gid, []).append(pred)
+        load = {g: sum(self.size_fn(p) for p in ps)
+                for g, ps in by_group.items()}
+        heavy = max(sorted(load), key=lambda g: load[g])
+        light = min(sorted(load), key=lambda g: load[g])
+        if load[heavy] - load[light] < self.threshold \
+                or not by_group[heavy]:
+            return None
+        # smallest tablet that still helps — moving the biggest could
+        # overshoot and invert the imbalance (ref chooseTablet walks
+        # candidates until the move improves the spread)
+        for pred in sorted(by_group[heavy],
+                           key=lambda p: (self.size_fn(p), p)):
+            sz = self.size_fn(pred)
+            if load[heavy] - sz >= load[light]:
+                self.cluster.move_tablet(pred, light)
+                move = (pred, heavy, light)
+                self.moves.append(move)
+                return move
+        return None
+
+    def start(self):
+        import threading
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — keep rebalancing
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
