@@ -37,6 +37,11 @@ import numpy as np
 
 N_NODES = int(os.environ.get("BENCH_NODES", 300_000))
 N_EDGES = int(os.environ.get("BENCH_EDGES", 3_000_000))
+# Throughput scales with batch (bigger batch = more bytes per gathered
+# frontier row at the same DMA-issue cost: 65536 measured 117.6k QPS =
+# 36.7x vs 93k/30x at 32768 on v5e) but XLA compile time balloons
+# (241s vs 25s cold), so the default stays at the robust point; raise
+# BENCH_BATCH when the compile cache is warm.
 BATCH = int(os.environ.get("BENCH_BATCH", 32768))  # concurrent queries
 SEEDS = 8                                          # seed uids per query
 DEPTH = 3

@@ -408,6 +408,40 @@ def cmd_debug(args) -> int:
     from dgraph_tpu.engine.db import GraphDB
 
     db = GraphDB(wal_path=args.wal)
+    if args.what == "jepsen":
+        # bank-invariant checker (ref dgraph/cmd/debug/run.go:323
+        # --jepsen seekTotal): deltas stay UNFOLDED so every commit in
+        # the WAL is a readable MVCC snapshot; the balance total must
+        # be identical at each one
+        pred = args.pred or "bal"
+        tab = db.tablets.get(pred)
+        if tab is None:
+            print(f"no tablet {pred!r}", file=sys.stderr)
+            return 1
+        tss = sorted({ts for ts, _ in tab.deltas})
+        if tab.base_ts:
+            tss.insert(0, tab.base_ts)
+        report: dict = {"pred": pred, "snapshots": len(tss),
+                        "violations": []}
+        want = None
+        for ts in tss:
+            total = 0
+            for uid in tab.src_uids(ts).tolist():
+                ps = tab.get_postings(int(uid), ts)
+                if ps:
+                    try:
+                        total += int(ps[0].value.value)
+                    except (TypeError, ValueError):
+                        pass
+            if want is None:
+                want = total
+            elif total != want:
+                report["violations"].append(
+                    {"ts": ts, "total": total, "expected": want})
+        report["ok"] = not report["violations"]
+        report["total"] = want
+        print(json.dumps(report, indent=2))
+        return 0 if report["ok"] else 1
     db.rollup_all()  # fold replayed deltas so counts reflect the store
     st = db.state()
     if args.what == "state":
@@ -743,7 +777,8 @@ def main(argv=None) -> int:
     d = sub.add_parser("debug", help="offline store inspector")
     d.add_argument("--wal", required=True)
     d.add_argument("what",
-                   choices=["state", "schema", "histogram", "posting"])
+                   choices=["state", "schema", "histogram", "posting",
+                            "jepsen"])
     d.add_argument("--pred", default="")
     d.add_argument("--uid", default="")
     d.set_defaults(fn=cmd_debug)

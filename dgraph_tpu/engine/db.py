@@ -578,6 +578,14 @@ class GraphDB:
             return commit_ts
         raise ValueError(f"unknown record kind {kind!r}")
 
+    def close(self):
+        """Flush and close the WAL (the reference's alpha shutdown
+        closes its Badger stores); the engine object stays queryable
+        in memory but stops persisting."""
+        if self.wal:
+            self.wal.close()
+            self.wal = None
+
     def fast_forward_ts(self, max_ts: int):
         """Advance the ts counter past replayed/replicated commits."""
         self.coordinator.observe_ts(max_ts)

@@ -225,3 +225,44 @@ def test_compose_generates_runnable_topology(tmp_path):
     topo = json.load(open(out + ".topology.json"))
     assert set(topo["groups"].keys()) == {"1", "2"}
     assert os.access(out, os.X_OK)
+
+
+def test_debug_jepsen_bank_checker(tmp_path):
+    """Offline bank-invariant checker (ref dgraph/cmd/debug/run.go:323
+    --jepsen): every commit in the WAL must conserve the balance total;
+    an unbalanced write is reported with its ts."""
+    import contextlib
+    import io
+
+    from dgraph_tpu.engine.db import GraphDB
+
+    wal = str(tmp_path / "bank-wal")
+    db = GraphDB(wal_path=wal, prefer_device=False)
+    db.alter("bal: int .")
+    db.mutate(set_nquads='<0x1> <bal> "50" .\n<0x2> <bal> "50" .')
+    # balanced transfers: total stays 100 at every commit
+    for amt, a, b in [(10, 1, 2), (25, 2, 1)]:
+        q = ('{ a as var(func: uid(%#x)) { ab as bal na as math(ab - %d) }'
+             '  b as var(func: uid(%#x)) { bb as bal nb as math(bb + %d) } }'
+             % (a, amt, b, amt))
+        db.mutate(query=q,
+                  set_nquads='uid(a) <bal> val(na) .\n'
+                             'uid(b) <bal> val(nb) .')
+    db.close()
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli_main(["debug", "--wal", wal, "jepsen", "--pred", "bal"])
+    rep = json.loads(out.getvalue())
+    assert rc == 0 and rep["ok"] and rep["total"] == 100
+    assert rep["snapshots"] >= 3
+
+    # an unbalanced write (money created) must be flagged
+    db = GraphDB(wal_path=wal, prefer_device=False)
+    db.mutate(set_nquads='<0x1> <bal> "999" .')
+    db.close()
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli_main(["debug", "--wal", wal, "jepsen", "--pred", "bal"])
+    rep = json.loads(out.getvalue())
+    assert rc == 1 and not rep["ok"] and rep["violations"]
