@@ -107,18 +107,26 @@ def bulk_load(paths: Iterable[str] = (), *,
 
     # -- map stage (ref bulk/mapper.go:207 processNQuad) --
     for batch in batches():
+        batch_max = 0  # one bump_uids per batch, not per term (lock)
         for nq in batch:
             src = _resolve(xidmap, nq.subject)
+            if src > batch_max:
+                batch_max = src
             s = shard(nq.predicate)
             if nq.object_id:
+                dst = _resolve(xidmap, nq.object_id)
+                if dst > batch_max:
+                    batch_max = dst
                 s.src.append(src)
-                s.dst.append(_resolve(xidmap, nq.object_id))
+                s.dst.append(dst)
                 if nq.facets:
-                    s.facets.append((src, s.dst[-1], nq.facets))
+                    s.facets.append((src, dst, nq.facets))
             elif nq.object_value is not None:
                 s.vals.append((src, Posting(nq.object_value, nq.lang,
                                             nq.facets)))
             pending_edges += 1
+        if batch_max:
+            xidmap.coordinator.bump_uids(batch_max)
         if pending_edges >= _SPILL_EDGES:
             for s in shards.values():
                 s.spill()
@@ -168,14 +176,14 @@ def bulk_load(paths: Iterable[str] = (), *,
 
 
 def _resolve(xidmap: XidMap, ref: str) -> int:
+    """Explicit uids are NOT bumped here — the map loop tracks the
+    batch max and bumps the lease counter once per batch."""
     if ref.startswith("_:"):
         return xidmap.assign(ref)
     try:
-        uid = int(ref, 0)
+        return int(ref, 0)
     except ValueError:
         return xidmap.assign(ref)  # external xid
-    xidmap.coordinator.bump_uids(uid)
-    return uid
 
 
 def _tablet_for_bulk(db: GraphDB, pred: str, srcs, vals) -> Tablet:

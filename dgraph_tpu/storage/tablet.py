@@ -449,22 +449,38 @@ class Tablet:
     #    (ref posting/index.go:496 rebuilder) --
 
     def rebuild_index(self):
+        # batch build: collect per token, ONE sort+unique per posting
+        # list at the end — per-element sorted np.insert is O(n^2) and
+        # dominated bulk-load profiles
         self.index = {}
         if not self.schema.indexed:
             return
+        acc: dict[bytes, list[int]] = {}
         for src, plist in self.values.items():
             for p in plist:
                 for tk in self._tokens(p):
-                    self.index[tk] = _ins(self.index.get(tk, _EMPTY), src)
+                    acc.setdefault(tk, []).append(src)
+        self.index = {tk: np.unique(np.asarray(srcs, np.uint64))
+                      for tk, srcs in acc.items()}
 
     def rebuild_reverse(self):
         self.reverse = {}
         if not (self.is_uid and self.schema.reverse):
             return
-        for src, dsts in self.edges.items():
-            for dst in dsts:
-                self.reverse[int(dst)] = _ins(
-                    self.reverse.get(int(dst), _EMPTY), src)
+        if self.edges:
+            # one flat (dst, src) sort instead of per-edge inserts
+            srcs = np.concatenate([
+                np.full(len(d), s, np.uint64)
+                for s, d in self.edges.items()])
+            dsts = np.concatenate(
+                [d.astype(np.uint64) for d in self.edges.values()])
+            order = np.lexsort((srcs, dsts))
+            srcs, dsts = srcs[order], dsts[order]
+            uniq, starts = np.unique(dsts, return_index=True)
+            bounds = np.append(starts, len(srcs))
+            self.reverse = {
+                int(u): np.unique(srcs[bounds[i]:bounds[i + 1]])
+                for i, u in enumerate(uniq)}
 
     # -- sortable keys for device values --
 
