@@ -655,16 +655,28 @@ def cmd_rebalance(args) -> int:
                      threshold=args.threshold)
     try:
         while True:
-            move = reb.tick()
+            try:
+                move = reb.tick()
+            except Exception as e:  # noqa: BLE001 — daemon keeps going
+                if args.once:
+                    raise
+                # transient (zero election, concurrent operator move):
+                # log and retry next interval, like the in-zero loop
+                print(f"rebalance pass failed: {e}", file=sys.stderr)
+                move = None
             if move:
                 pred, src, dst = move
                 print(f"moved tablet {pred!r}: group {src} -> {dst}")
             elif args.once:
                 print("balanced")
-            if args.once and move is None:
-                return 0
-            if not args.once and move is None:
-                _time.sleep(args.interval)
+            if args.once:
+                if move is None:
+                    return 0
+                continue  # --once converges without pacing
+            # daemon mode paces ONE move per interval so the cluster
+            # absorbs each export/import before the next (the
+            # reference's rebalance_interval exists for exactly this)
+            _time.sleep(args.interval)
     except KeyboardInterrupt:
         return 0
     finally:

@@ -358,13 +358,16 @@ class Rebalancer:
         if load[heavy] - load[light] < self.threshold \
                 or not by_group[heavy]:
             return None
-        # smallest tablet that still helps — moving the biggest could
-        # overshoot and invert the imbalance (ref chooseTablet walks
+        # smallest tablet that still helps — the move must STRICTLY
+        # shrink the pair's spread, else a big tablet just mirrors the
+        # imbalance and the next tick moves it straight back, an
+        # export/import oscillation forever (ref chooseTablet walks
         # candidates until the move improves the spread)
+        spread = load[heavy] - load[light]
         for pred in sorted(by_group[heavy],
                            key=lambda p: (self.size_fn(p), p)):
             sz = self.size_fn(pred)
-            if load[heavy] - sz >= load[light]:
+            if abs((load[heavy] - sz) - (load[light] + sz)) < spread:
                 self.cluster.move_tablet(pred, light)
                 move = (pred, heavy, light)
                 self.moves.append(move)

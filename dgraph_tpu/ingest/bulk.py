@@ -106,17 +106,27 @@ def bulk_load(paths: Iterable[str] = (), *,
             yield from nquads
 
     # -- map stage (ref bulk/mapper.go:207 processNQuad) --
+    # explicit-uid high-water mark: the coordinator must know the max
+    # BEFORE any later blank-node lease is cut (a deferred end-of-batch
+    # bump would let a lease block collide with an explicit uid seen
+    # earlier in the same batch — review finding), but most statements
+    # don't raise the max, so the lock is taken only on a new high
+    bumped = 0
+
+    def resolve(ref: str) -> int:
+        nonlocal bumped
+        uid = _resolve(xidmap, ref)
+        if uid > bumped:
+            xidmap.coordinator.bump_uids(uid)
+            bumped = uid
+        return uid
+
     for batch in batches():
-        batch_max = 0  # one bump_uids per batch, not per term (lock)
         for nq in batch:
-            src = _resolve(xidmap, nq.subject)
-            if src > batch_max:
-                batch_max = src
+            src = resolve(nq.subject)
             s = shard(nq.predicate)
             if nq.object_id:
-                dst = _resolve(xidmap, nq.object_id)
-                if dst > batch_max:
-                    batch_max = dst
+                dst = resolve(nq.object_id)
                 s.src.append(src)
                 s.dst.append(dst)
                 if nq.facets:
@@ -125,8 +135,6 @@ def bulk_load(paths: Iterable[str] = (), *,
                 s.vals.append((src, Posting(nq.object_value, nq.lang,
                                             nq.facets)))
             pending_edges += 1
-        if batch_max:
-            xidmap.coordinator.bump_uids(batch_max)
         if pending_edges >= _SPILL_EDGES:
             for s in shards.values():
                 s.spill()

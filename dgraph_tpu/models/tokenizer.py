@@ -194,6 +194,14 @@ def load_custom_tokenizer(path: str) -> TokenizerSpec:
     if prev is not None and prev.ident < IDENT_CUSTOM:
         raise ValueError(
             f"custom tokenizer may not shadow built-in {name!r}")
+    # identifier bytes namespace the index keys: two tokenizers on one
+    # ident would silently share posting lists (the reference's
+    # registerTokenizer asserts uniqueness)
+    for other in _REGISTRY.values():
+        if other.ident == ident and other.name != name:
+            raise ValueError(
+                f"identifier {ident:#x} already used by tokenizer "
+                f"{other.name!r}")
 
     def fn(v: Val, _plug=plug) -> list:
         return [str(t) for t in _plug.tokens(v.value)]

@@ -29,9 +29,13 @@ from dgraph_tpu.storage.uri import new_uri_handler
 MANIFEST = "manifest.json"
 
 
-def read_manifests(dest: str) -> list[dict]:
-    raw = new_uri_handler(dest).get(MANIFEST)
+def _read_chain(handler) -> list[dict]:
+    raw = handler.get(MANIFEST)
     return json.loads(raw) if raw else []
+
+
+def read_manifests(dest: str) -> list[dict]:
+    return _read_chain(new_uri_handler(dest))
 
 
 def backup(db, dest: str, force_full: bool = False,
@@ -40,7 +44,7 @@ def backup(db, dest: str, force_full: bool = False,
     Incremental = tablets whose state moved past the chain's last
     read_ts (ref backup.go Request.since logic)."""
     handler = new_uri_handler(dest)
-    chain = json.loads(handler.get(MANIFEST) or "[]")
+    chain = _read_chain(handler)
     since = 0 if (force_full or not chain) else chain[-1]["read_ts"]
 
     db.rollup_all()
@@ -93,7 +97,7 @@ def restore(dest: str, db=None, key: Optional[bytes] = None):
     from dgraph_tpu.storage.tablet import Tablet
 
     handler = new_uri_handler(dest)
-    chain = json.loads(handler.get(MANIFEST) or "[]")
+    chain = _read_chain(handler)
     if not chain:
         raise FileNotFoundError(f"no backup manifest under {dest!r}")
     db = db or GraphDB()
