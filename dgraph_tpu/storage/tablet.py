@@ -84,6 +84,13 @@ class Tablet:
         # delta overlay: ts-ascending op lists
         self.deltas: list[tuple[int, list[EdgeOp]]] = []
         self.max_commit_ts = 0
+        # per-uid overlay index (lazily built, extended on apply,
+        # dropped on rollup): without it every per-uid read scans the
+        # WHOLE visible overlay — O(total ops) per get_postings call,
+        # which dominated profiles on bulk-mutated, un-rolled stores
+        self._ov_by_src: dict[int, list] | None = None
+        self._ov_by_dst: dict[int, list] | None = None
+        self._ov_della: list | None = None
         # device snapshot cache (built lazily; see engine/device_cache —
         # residency is budgeted by the engine's DeviceCacheLRU)
         self._device_adj = None
@@ -119,6 +126,40 @@ class Tablet:
             "commits must apply in ts order"
         self.deltas.append((commit_ts, ops))
         self.max_commit_ts = max(self.max_commit_ts, commit_ts)
+        if self._ov_by_src is not None:
+            self._ov_extend(commit_ts, ops)
+
+    # -- overlay index upkeep --
+
+    def _ov_extend(self, ts: int, ops: list[EdgeOp]):
+        for idx, op in enumerate(ops):
+            entry = (ts, idx, op)
+            self._ov_by_src.setdefault(op.src, []).append(entry)
+            if op.op == "del_all":
+                self._ov_della.append(entry)
+            elif op.dst:
+                self._ov_by_dst.setdefault(op.dst, []).append(entry)
+
+    def _ov_index(self):
+        if self._ov_by_src is None:
+            self._ov_by_src = {}
+            self._ov_by_dst = {}
+            self._ov_della = []
+            for ts, ops in self.deltas:
+                self._ov_extend(ts, ops)
+
+    def _ov_drop(self):
+        self._ov_by_src = None
+        self._ov_by_dst = None
+        self._ov_della = None
+
+    def _src_overlay(self, src: int, read_ts: int):
+        """This src's overlay ops visible at read_ts, in commit order."""
+        self._ov_index()
+        for ts, _, op in self._ov_by_src.get(src, ()):
+            if ts > read_ts:
+                break
+            yield op
 
     # -- reads (read_ts snapshot) --
 
@@ -179,9 +220,7 @@ class Tablet:
     def get_dst_uids(self, src: int, read_ts: int) -> np.ndarray:
         out = self.edges.get(src, _EMPTY)
         dirty = False
-        for op in self._overlay(read_ts):
-            if op.src != src:
-                continue
+        for op in self._src_overlay(src, read_ts):
             if not dirty:
                 out = out.copy()
                 dirty = True
@@ -195,7 +234,16 @@ class Tablet:
 
     def get_reverse_uids(self, dst: int, read_ts: int) -> np.ndarray:
         out = self.reverse.get(dst, _EMPTY)
-        for ts, i, op in self._overlay_ts(read_ts):
+        self._ov_index()
+        # merge this dst's set/del ops with every del_all, in commit
+        # order ((ts, idx) is the global op order)
+        entries = self._ov_by_dst.get(dst, [])
+        if self._ov_della:
+            entries = sorted(entries + self._ov_della,
+                             key=lambda e: (e[0], e[1]))
+        for ts, i, op in entries:
+            if ts > read_ts:
+                break
             if op.op == "set" and op.dst == dst:
                 out = _ins(out, op.src)
             elif op.op == "del" and op.dst == dst:
@@ -209,9 +257,7 @@ class Tablet:
 
     def get_postings(self, src: int, read_ts: int) -> list[Posting]:
         out = list(self.values.get(src, ()))
-        for op in self._overlay(read_ts):
-            if op.src != src:
-                continue
+        for op in self._src_overlay(src, read_ts):
             if op.op == "del_all":
                 out = []
             elif op.op == "set":
@@ -341,8 +387,8 @@ class Tablet:
 
     def get_facets(self, src: int, dst: int, read_ts: int) -> dict:
         out = self.edge_facets.get((src, dst), {})
-        for op in self._overlay(read_ts):
-            if op.op == "set" and op.src == src and op.dst == dst and op.facets:
+        for op in self._src_overlay(src, read_ts):
+            if op.op == "set" and op.dst == dst and op.facets:
                 out = op.facets
         return out
 
@@ -392,6 +438,7 @@ class Tablet:
         self.deltas = keep
         if folded:
             self._device_adj_ts = -1  # invalidate device snapshot
+            self._ov_drop()           # overlay index keys shifted
 
     def _fold(self, op: EdgeOp):
         src = op.src
