@@ -89,6 +89,22 @@ def _np_sorted(uids) -> np.ndarray:
 
 
 def _intersect(a, b):
+    # inputs are sorted unique uid vectors (the repo-wide invariant).
+    # Emit loops intersect a tiny per-uid dst list against a large
+    # DestUIDs thousands of times per query; intersect1d re-sorts the
+    # concatenation every call, so use searchsorted membership when
+    # the sizes are lopsided (the reference picks lin/jump/bin search
+    # by the same ratio heuristic, algo/uidlist.go:151)
+    la, lb = len(a), len(b)
+    if la == 0 or lb == 0:
+        return _EMPTY
+    if la > lb:
+        a, b = b, a
+        la, lb = lb, la
+    if lb >= 16 * la:
+        idx = np.searchsorted(b, a)
+        np.minimum(idx, lb - 1, out=idx)
+        return a[b[idx] == a]
     return np.intersect1d(a, b, assume_unique=True)
 
 
