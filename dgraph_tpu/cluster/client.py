@@ -20,12 +20,19 @@ from dgraph_tpu import wire
 class ClusterClient:
     """Talks to an Alpha group or a Zero quorum (same protocol)."""
 
+    # seconds a node stays demoted after a connection-level failure —
+    # the client-side analogue of the reference's heartbeat health
+    # gating (conn/pool.go:227 MonitorHealth marks pools unhealthy;
+    # processWithBackupRequest avoids sick replicas)
+    UNHEALTHY_S = 1.0
+
     def __init__(self, addrs: dict[int, tuple[str, int]],
                  timeout: float = 10.0):
         self.addrs = dict(addrs)
         self.timeout = timeout
         self._conns: dict[int, socket.socket] = {}
         self._preferred: Optional[int] = None
+        self._down: dict[int, float] = {}  # node -> demoted-until
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------ plumbing
@@ -54,12 +61,16 @@ class ClusterClient:
     def _rpc_once(self, node: int, req: dict) -> Optional[dict]:
         sock = self._conn(node)
         if sock is None:
+            self._down[node] = time.monotonic() + self.UNHEALTHY_S
             return None
         try:
             wire.write_frame(sock, wire.dumps(req))
-            return wire.loads(wire.read_frame(sock))
+            resp = wire.loads(wire.read_frame(sock))
+            self._down.pop(node, None)
+            return resp
         except (OSError, EOFError, wire.WireError):
             self._drop(node)
+            self._down[node] = time.monotonic() + self.UNHEALTHY_S
             return None
 
     def request(self, req: dict, deadline_s: Optional[float] = None) -> dict:
@@ -72,6 +83,11 @@ class ClusterClient:
                 order = [n for n in
                          ([self._preferred] + sorted(self.addrs))
                          if n is not None]
+                # recently failed nodes go LAST, not skipped — if every
+                # replica is demoted they are all still tried
+                now = time.monotonic()
+                order = sorted(order,
+                               key=lambda n: self._down.get(n, 0) > now)
                 seen = set()
                 for node in order:
                     if node in seen or node not in self.addrs:
@@ -132,8 +148,15 @@ class ClusterClient:
         import queue
 
         with self._lock:
-            first = self._preferred or sorted(self.addrs)[0]
+            now = time.monotonic()
+            healthy = [n for n in sorted(self.addrs)
+                       if self._down.get(n, 0) <= now]
+            pool = healthy or sorted(self.addrs)
+            first = self._preferred if self._preferred in pool \
+                else pool[0]
         others = [n for n in sorted(self.addrs) if n != first]
+        others = sorted(others,
+                        key=lambda n: self._down.get(n, 0) > now)
         results: queue.Queue = queue.Queue()
 
         def attempt(node):

@@ -370,3 +370,25 @@ def test_commit_now_with_open_txn_returns_uids(alpha):
     assert fin["extensions"]["txn"]["commit_ts"] > ts
     got = client.query('{ q(func: eq(tk, "cn-2")) { tk } }')
     assert got["data"]["q"] == [{"tk": "cn-2"}]
+
+
+def test_client_demotes_failed_nodes(alpha):
+    """Connection-level failures demote a node for UNHEALTHY_S so
+    retries and hedges prefer live replicas (the reference's pool
+    health gating, conn/pool.go:227)."""
+    c, client = alpha
+    dead_port = _free_ports(1)[0]
+    live = {i: c.client_addrs[i] for i in c.alive()}
+    cl = ClusterClient({0: ("127.0.0.1", dead_port), **live},
+                       timeout=10.0)
+    try:
+        st = cl.status()
+        assert st["role"] in ("leader", "follower")
+        assert 0 in cl._down, "dead node not demoted"
+        for i in c.alive():
+            assert i not in cl._down
+        # still correct when every node is demoted: all are retried
+        cl._down = {n: time.monotonic() + 1.0 for n in cl.addrs}
+        assert cl.status()["role"] in ("leader", "follower")
+    finally:
+        cl.close()
