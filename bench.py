@@ -41,7 +41,11 @@ N_EDGES = int(os.environ.get("BENCH_EDGES", 3_000_000))
 # frontier row at the same DMA-issue cost: 65536 measured 117.6k QPS =
 # 36.7x vs 93k/30x at 32768 on v5e) but XLA compile time balloons
 # (241s vs 25s cold), so the default stays at the robust point; raise
-# BENCH_BATCH when the compile cache is warm.
+# BENCH_BATCH when the compile cache is warm. At the 21M-edge
+# reference scale (BENCH_NODES=2M BENCH_EDGES=21M BENCH_BATCH=8192)
+# one v5e chip measures 9.4k QPS = 3.4x — HBM-capacity-bound (the
+# frontier bitmap alone is 2GB); that regime is what the mesh-sharded
+# uid-axis path (parallel/dist_graph.py) exists for.
 BATCH = int(os.environ.get("BENCH_BATCH", 32768))  # concurrent queries
 SEEDS = 8                                          # seed uids per query
 DEPTH = 3

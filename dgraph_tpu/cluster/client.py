@@ -222,6 +222,30 @@ class ClusterClient:
         kw["schema_text"] = schema_text
         return self._unwrap(self.request({"op": "alter", "kw": kw}))
 
+    def members(self) -> dict:
+        return self._unwrap(self.request({"op": "members"}))
+
+    def conf_change(self, action: str, node: int,
+                    addr: Optional[tuple[str, int]] = None) -> dict:
+        """Add/remove a raft group member (ref conn/raft_server.go
+        JoinCluster; zero /removeNode). After an add, call add_node()
+        so this client can reach the new member too."""
+        req = {"op": "conf_change", "action": action, "node": node}
+        if addr is not None:
+            req["addr"] = tuple(addr)
+        return self._unwrap(self.request(req))
+
+    def add_node(self, node: int, addr: tuple[str, int]):
+        with self._lock:
+            self.addrs[node] = tuple(addr)
+
+    def remove_node(self, node: int):
+        with self._lock:
+            self.addrs.pop(node, None)
+            self._drop(node)
+            if self._preferred == node:
+                self._preferred = None
+
     def status(self, node: Optional[int] = None) -> dict:
         if node is not None:
             with self._lock:

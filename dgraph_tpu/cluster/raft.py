@@ -124,6 +124,14 @@ class MemoryStorage:
         self.snap_data = data
         self.entries = [e for e in self.entries if e.index > index]
 
+    def save_members(self, members: dict):
+        """Persist the conf-changed membership map so a restart keeps
+        it instead of reverting to the CLI's --raft-peers (volatile
+        storage: no-op)."""
+
+    def load_members(self) -> Optional[dict]:
+        return None
+
     def flush(self):
         pass
 
@@ -188,6 +196,13 @@ class DiskStorage(MemoryStorage):
         if hasattr(self._kv, "snapshot"):
             self._kv.snapshot()
 
+    def save_members(self, members: dict):
+        self._kv.put(b"members", wire_dumps(members))
+
+    def load_members(self) -> Optional[dict]:
+        raw = self._kv.get(b"members")
+        return _wire_load(raw) if raw is not None else None
+
     def flush(self):
         if hasattr(self._kv, "flush"):
             self._kv.flush()
@@ -220,6 +235,8 @@ class RaftNode:
 
         self.role = FOLLOWER
         self.leader_id: Optional[int] = None
+        self.removed = False  # this node was conf-removed: stop
+        #                       campaigning/heartbeating, serve reads only
         self.commit_index = self.snap_index
         self.applied_index = self.snap_index
         self.votes: set[int] = set()
@@ -261,6 +278,8 @@ class RaftNode:
     # ------------------------------------------------------------- driving
 
     def tick(self):
+        if self.removed:
+            return
         self.elapsed += 1
         if self.role == LEADER:
             if self.elapsed >= self.heartbeat_ticks:
@@ -323,6 +342,36 @@ class RaftNode:
         self.log = [e for e in self.log if e.index > index]
         self.snap_index = index
         self.snap_term = term
+
+    # -------------------------------------------------- membership changes
+    # Applied at COMMIT time, one change in flight at a time (the etcd
+    # model; ref conn.Node conf changes + zero/raft.go member proposals).
+
+    def add_peer(self, p: int):
+        if p == self.id or p in self.peers:
+            return
+        self.peers.append(p)
+        if self.role == LEADER:
+            self.next_index[p] = self.last_index() + 1
+            self.match_index[p] = 0
+            self._send_append(p)
+
+    def remove_peer(self, p: int):
+        if p == self.id:
+            # self-removal: step down and go quiet; the rest of the
+            # cluster stops heartbeating us (ref /removeNode semantics)
+            self.removed = True
+            if self.role == LEADER:
+                self.role = FOLLOWER
+                self.leader_id = None
+            return
+        if p in self.peers:
+            self.peers.remove(p)
+        self.next_index.pop(p, None)
+        self.match_index.pop(p, None)
+        self.votes.discard(p)
+        if self.role == LEADER:
+            self._advance_commit()  # the quorum just shrank
 
     # ------------------------------------------------------------ internal
 

@@ -48,7 +48,17 @@ class RaftServer:
                  election_ticks: int = 10,
                  snapshot_every: int = 2048):
         self.id = node_id
-        self.node = RaftNode(node_id, list(raft_peers), storage=storage,
+        # conf-changed membership persisted in raft storage wins over
+        # the CLI's --raft-peers on restart (ref zero/raft.go member
+        # state living in Zero's raft group)
+        saved = storage.load_members() if storage is not None else None
+        self.members: dict[int, tuple[str, int]] = \
+            {int(k): tuple(v) for k, v in saved.items()} if saved \
+            else dict(raft_peers)
+        if node_id not in self.members and node_id in raft_peers:
+            self.members[node_id] = raft_peers[node_id]
+        self.node = RaftNode(node_id, list(self.members),
+                             storage=storage,
                              election_ticks=election_ticks)
         self.lock = threading.RLock()
         self.applied_cv = threading.Condition(self.lock)
@@ -59,7 +69,11 @@ class RaftServer:
         self._acked: dict[tuple, Any] = {}
         self.epoch = int(time.time() * 1000) % (1 << 40)
         self._stop = threading.Event()
-        self.transport = TcpTransport(node_id, raft_peers, self._on_msg)
+        transport_peers = dict(self.members)
+        if node_id in raft_peers:  # own listen addr always from CLI
+            transport_peers[node_id] = raft_peers[node_id]
+        self.transport = TcpTransport(node_id, transport_peers,
+                                      self._on_msg)
 
         self._client_listener = socket.socket(
             socket.AF_INET, socket.SOCK_STREAM)
@@ -92,6 +106,11 @@ class RaftServer:
         with self.lock:
             if self._stop.is_set():
                 return
+            if msg.frm != self.id and msg.frm not in self.members:
+                # a conf-removed node must not disturb the cluster
+                # (its election timeouts would otherwise inflate terms
+                # forever — the reference drops non-member raft traffic)
+                return
             self.node.step(msg)
             out = self._drain_ready()
         self._send_all(out)
@@ -117,20 +136,123 @@ class RaftServer:
         if r.snapshot is not None:
             log.info("raft_snapshot_restore", node=self.id,
                      index=r.snapshot[0])
-            self.sm_restore(r.snapshot[2])
+            data = r.snapshot[2]
+            if isinstance(data, dict) and "__members__" in data:
+                # snapshots carry membership so a late joiner that
+                # never saw the conf entries still learns the cluster
+                self._install_members(data["__members__"])
+                data = data["app"]
+            self.sm_restore(data)
             self._acked.clear()
         for e in r.committed:
             if e.data is None:
                 continue
             mark, origin, payload = e.data
-            result = self.sm_apply(origin, payload)
+            if isinstance(payload, tuple) and payload \
+                    and payload[0] == "__conf__":
+                result = self._apply_conf(*payload[1:])
+            else:
+                result = self.sm_apply(origin, payload)
             self._acked[mark] = result
             self._applied_since_snap += 1
             self.applied_cv.notify_all()
         if self._applied_since_snap >= self.snapshot_every:
             self._applied_since_snap = 0
-            self.node.take_snapshot(self.sm_snapshot())
+            self.node.take_snapshot({"__members__": dict(self.members),
+                                     "app": self.sm_snapshot()})
         return r.msgs
+
+    # ------------------------------------------------------- membership
+    # Single-change-at-a-time conf changes applied at commit (the etcd
+    # model; ref conn/raft_server.go JoinCluster + zero /removeNode).
+
+    def _install_members(self, members: dict):
+        members = {int(k): tuple(v) for k, v in members.items()}
+        for nid in list(self.transport.peers):
+            if nid not in members and nid != self.id:
+                self.transport.peers.pop(nid, None)
+        for nid, addr in members.items():
+            if nid != self.id:
+                self.transport.peers[nid] = addr
+        self.members = members
+        for nid in list(self.node.peers):
+            if nid not in members:
+                self.node.remove_peer(nid)
+        for nid in members:
+            if nid != self.id:
+                self.node.add_peer(nid)
+        if self.id not in members:
+            self.node.remove_peer(self.id)
+        if self.node.storage is not None:
+            self.node.storage.save_members(self.members)
+
+    def _apply_conf(self, action: str, nid: int, addr=None) -> bool:
+        nid = int(nid)
+        if action == "add":
+            if addr is None:
+                return False
+            self.members[nid] = tuple(addr)
+            if nid != self.id:
+                self.transport.peers[nid] = tuple(addr)
+                self.node.add_peer(nid)
+        elif action == "remove":
+            self.members.pop(nid, None)
+            self.transport.peers.pop(nid, None)
+            self.node.remove_peer(nid)
+        else:
+            return False
+        log.info("raft_conf_change", node=self.id, action=action,
+                 member=nid, members=sorted(self.members))
+        if self.node.storage is not None:
+            self.node.storage.save_members(self.members)
+        return True
+
+    def _conf_in_flight(self) -> bool:
+        """One membership change at a time (raft §4.1 single-server
+        rule): reject a new one while any conf entry is unapplied."""
+        for e in self.node.log:
+            if e.index <= self.node.applied_index or e.data is None:
+                continue
+            payload = e.data[2]
+            if isinstance(payload, tuple) and payload \
+                    and payload[0] == "__conf__":
+                return True
+        return False
+
+    def handle_conf_request(self, req: dict) -> dict:
+        """Shared cluster ops every RaftServer kind answers; returns
+        None for ops the subclass should handle."""
+        op = req.get("op")
+        if op == "members":
+            with self.lock:
+                return {"ok": True, "result": {
+                    "members": {str(k): list(v)
+                                for k, v in self.members.items()},
+                    "removed": self.node.removed}}
+        if op == "conf_change":
+            action = req.get("action")
+            nid = int(req.get("node", 0))
+            addr = req.get("addr")
+            if action not in ("add", "remove") or not nid:
+                return {"ok": False, "error": "bad conf_change"}
+            if action == "add" and not addr:
+                return {"ok": False, "error": "add needs addr"}
+            with self.lock:
+                if self.node.role != LEADER:
+                    raise NotLeader(self.node.leader_id)
+                if self._conf_in_flight():
+                    return {"ok": False, "error":
+                            "another membership change is in flight"}
+            ok, result = self.propose_and_wait(
+                ("__conf__", action, nid,
+                 tuple(addr) if addr else None))
+            if not ok or not result:
+                return {"ok": False,
+                        "error": "conf change not committed"}
+            return {"ok": True, "result": {
+                "members": {str(k): list(v)
+                            for k, v in self.members.items()}}}
+        return None
 
     def _send_all(self, msgs: list):
         for m in msgs:
@@ -472,6 +594,9 @@ class AlphaServer(RaftServer):
     # ----------------------------------------------------------------- RPC
 
     def handle_request(self, req: dict) -> dict:
+        conf = self.handle_conf_request(req)
+        if conf is not None:
+            return conf
         op = req.get("op")
         if op == "query":
             # any replica serves best-effort snapshot reads
@@ -674,6 +799,9 @@ class ZeroServer(RaftServer):
         self.state = ZeroState.from_snapshot(snap)
 
     def handle_request(self, req: dict) -> dict:
+        conf = self.handle_conf_request(req)
+        if conf is not None:
+            return conf
         op = req.get("op")
         if op == "status":
             with self.lock:

@@ -1,0 +1,203 @@
+"""Dynamic Raft membership: live add/remove of cluster nodes.
+
+Ref conn/raft_server.go JoinCluster (a new peer joins a running
+group), zero's /removeNode (ConfChange removal), and etcd-style
+apply-at-commit single-change-at-a-time semantics. Real OS processes
+over TCP, like the other cluster suites.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dgraph_tpu.cluster.client import ClusterClient
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn(node_id, peers_spec, client_addr, wal=""):
+    cmd = [sys.executable, "-m", "dgraph_tpu", "node",
+           "--kind", "alpha", "--id", str(node_id),
+           "--raft-peers", peers_spec,
+           "--client-addr", client_addr,
+           "--tick-ms", "30", "--election-ticks", "8"]
+    if wal:
+        cmd += ["--wal", wal]
+    return subprocess.Popen(
+        cmd, env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO),
+        cwd=_REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def _wait_leader(client, deadline_s=30.0):
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        for node in list(client.addrs):
+            try:
+                st = client.status(node)
+            except (ConnectionError, RuntimeError, KeyError):
+                continue
+            if st.get("role") == "leader":
+                return st["id"]
+        time.sleep(0.2)
+    raise AssertionError("no leader within deadline")
+
+
+@pytest.fixture()
+def cluster2(tmp_path):
+    """Two-node group, ports reserved for a future third member."""
+    ports = _free_ports(6)
+    raft = {1: ports[0], 2: ports[1], 3: ports[2]}
+    caddr = {1: ports[3], 2: ports[4], 3: ports[5]}
+    peers12 = f"1=127.0.0.1:{raft[1]},2=127.0.0.1:{raft[2]}"
+    procs = {
+        i: _spawn(i, peers12, f"127.0.0.1:{caddr[i]}",
+                  wal=str(tmp_path / f"n{i}")) for i in (1, 2)}
+    client = ClusterClient(
+        {i: ("127.0.0.1", caddr[i]) for i in (1, 2)}, timeout=30.0)
+    try:
+        _wait_leader(client)
+        yield procs, client, raft, caddr, tmp_path
+    finally:
+        client.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs.values():
+            p.wait()
+
+
+def test_add_remove_member_live(cluster2):
+    procs, client, raft, caddr, tmp = cluster2
+    client.alter("mk: string @index(exact) .")
+    client.mutate(set_nquads='_:a <mk> "before-join" .')
+
+    # start node 3 knowing the full membership; it idles as a
+    # follower until the leader learns of it through the conf change
+    peers_all = ",".join(f"{i}=127.0.0.1:{raft[i]}" for i in (1, 2, 3))
+    procs[3] = _spawn(3, peers_all, f"127.0.0.1:{caddr[3]}",
+                      wal=str(tmp / "n3"))
+    time.sleep(0.5)
+    out = client.conf_change("add", 3, ("127.0.0.1", raft[3]))
+    assert set(out["members"]) == {"1", "2", "3"}
+    client.add_node(3, ("127.0.0.1", caddr[3]))
+
+    # the new member catches up (snapshot or log) and serves reads
+    end = time.monotonic() + 20
+    got = None
+    while time.monotonic() < end:
+        got = client._rpc_once(3, {
+            "op": "query", "q": '{ q(func: eq(mk, "before-join")) '
+                                '{ mk } }', "vars": None})
+        if got and got.get("ok") and got["result"]["data"]["q"]:
+            break
+        time.sleep(0.2)
+    assert got and got["result"]["data"]["q"] == [{"mk": "before-join"}]
+
+    # 3-node quorum: survives killing one member
+    leader = _wait_leader(client)
+    victim = next(i for i in (1, 2) if i != leader) \
+        if leader == 3 else leader
+    procs[victim].send_signal(signal.SIGKILL)
+    procs[victim].wait()
+    client.remove_node(victim)
+    _wait_leader(client)
+    client.mutate(set_nquads='_:b <mk> "after-kill" .')
+    got = client.query('{ q(func: eq(mk, "after-kill")) { mk } }')
+    assert got["data"]["q"] == [{"mk": "after-kill"}]
+
+    # conf-remove the dead node: membership shrinks to the live pair
+    out = client.conf_change("remove", victim)
+    assert str(victim) not in out["members"]
+    m = client.members()
+    assert set(m["members"]) == {"1", "2", "3"} - {str(victim)}
+    client.mutate(set_nquads='_:c <mk> "after-remove" .')
+    got = client.query('{ q(func: eq(mk, "after-remove")) { mk } }')
+    assert got["data"]["q"] == [{"mk": "after-remove"}]
+
+
+def test_removed_node_goes_quiet(cluster2):
+    procs, client, raft, caddr, tmp = cluster2
+    client.alter("rq: string .")
+    client.mutate(set_nquads='_:a <rq> "x" .')
+    out = client.conf_change("remove", 2)
+    assert set(out["members"]) == {"1"}
+    # the removed node steps down and reports itself removed
+    end = time.monotonic() + 10
+    removed = False
+    cl2 = ClusterClient({2: ("127.0.0.1", caddr[2])}, timeout=5.0)
+    try:
+        while time.monotonic() < end:
+            try:
+                m = cl2.members()
+            except RuntimeError:
+                time.sleep(0.2)
+                continue
+            if m.get("removed"):
+                removed = True
+                break
+            time.sleep(0.2)
+    finally:
+        cl2.close()
+    assert removed, "removed node still thinks it is a member"
+    # the surviving single-node group keeps committing writes
+    client.remove_node(2)
+    client.mutate(set_nquads='_:b <rq> "y" .')
+
+
+def _wait_members(client, want: set, deadline_s: float = 20.0):
+    """Removal of the LEADER commits on the leaving node first; the
+    survivors apply it after electing a successor — poll until the
+    view converges (same eventual semantics as the reference)."""
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        try:
+            m = client.members()
+        except RuntimeError:
+            time.sleep(0.2)
+            continue
+        if set(m["members"]) == want:
+            return m
+        time.sleep(0.2)
+    raise AssertionError(f"members never became {want}")
+
+
+def test_membership_survives_restart(cluster2):
+    procs, client, raft, caddr, tmp = cluster2
+    client.conf_change("remove", 2)
+    client.remove_node(2)
+    _wait_members(client, {"1"})
+    # restart node 1: persisted membership (not --raft-peers) wins
+    procs[1].send_signal(signal.SIGTERM)
+    procs[1].wait()
+    peers12 = f"1=127.0.0.1:{raft[1]},2=127.0.0.1:{raft[2]}"
+    procs[1] = _spawn(1, peers12, f"127.0.0.1:{caddr[1]}",
+                      wal=str(tmp / "n1"))
+    _wait_leader(client)
+    m = _wait_members(client, {"1"})
+    assert set(m["members"]) == {"1"}, \
+        "restart reverted membership to --raft-peers"
+
+
+def test_conf_change_rejects_concurrent(cluster2):
+    procs, client, raft, caddr, tmp = cluster2
+    with pytest.raises(RuntimeError, match="bad conf_change"):
+        client.conf_change("promote", 9)
+    with pytest.raises(RuntimeError, match="needs addr"):
+        client.conf_change("add", 9)
