@@ -180,7 +180,8 @@ def cmd_node(args) -> int:
     if args.kind == "alpha":
         zero_addrs = _parse_peers(args.zero) if args.zero else None
         srv = AlphaServer(args.id, peers, (chost, int(cport)),
-                          group=args.group, zero_addrs=zero_addrs, **kw)
+                          group=args.group, replicas=args.replicas,
+                          zero_addrs=zero_addrs, **kw)
     else:
         srv = ZeroServer(args.id, peers, (chost, int(cport)), **kw)
     print(f"dgraph-tpu {args.kind} node {args.id}: raft "
@@ -802,7 +803,12 @@ def main(argv=None) -> int:
                    help="id=host:port,... for every group member")
     n.add_argument("--client-addr", required=True, help="host:port")
     n.add_argument("--group", type=int, default=1,
-                   help="alpha group id (predicate shard)")
+                   help="alpha group id (predicate shard); 0 = let "
+                        "zero assign the least-replicated group and "
+                        "raft-join it live (ref zero.go:410 Connect)")
+    n.add_argument("--replicas", type=int, default=1,
+                   help="replica target per group for --group 0 "
+                        "placement (ref zero --replicas)")
     n.add_argument("--zero", default="",
                    help="zero quorum client addrs (id=host:port,...) — "
                         "enables multi-group mode: tablet ownership "

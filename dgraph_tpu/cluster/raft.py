@@ -473,6 +473,11 @@ class RaftNode:
         self.role = FOLLOWER
         self.leader_id = m.frm
         self.elapsed = 0
+        # a quiet joiner (started with removed=True while waiting for
+        # its conf-change) wakes on the first append from the leader —
+        # that message proves it is now a member. Genuinely removed
+        # nodes never receive appends (they left every member's peers).
+        self.removed = False
         local_prev_term = self._term_at(m.prev_index)
         if m.prev_index > self.last_index() or (
                 local_prev_term is not None

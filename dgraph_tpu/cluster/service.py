@@ -368,9 +368,41 @@ class AlphaServer(RaftServer):
 
     def __init__(self, node_id: int, raft_peers, client_addr,
                  storage=None, db_kw: Optional[dict] = None,
-                 group: int = 1,
+                 group: int = 1, replicas: int = 1,
                  zero_addrs: Optional[dict] = None, **kw):
         from dgraph_tpu.engine.db import GraphDB
+
+        # group=0 + a zero quorum = elastic join (ref zero/zero.go:410
+        # Connect): zero assigns this node to the least-replicated
+        # group (or founds a new one), hands back the group's members,
+        # and the node raft-joins them live
+        self._join_members: dict = {}
+        if group == 0:
+            if not zero_addrs:
+                raise ValueError("--group 0 (auto) needs --zero")
+            from dgraph_tpu.cluster.client import ClusterClient
+            probe = ClusterClient(zero_addrs, timeout=30.0)
+            try:
+                my_raft = tuple(raft_peers[node_id])
+                got = probe.request({
+                    "op": "connect",
+                    "args": (f"{my_raft[0]}:{my_raft[1]}", 0, my_raft,
+                             tuple(client_addr), int(replicas))},
+                    deadline_s=60.0)
+                if not got.get("ok"):
+                    raise RuntimeError(
+                        f"zero connect failed: {got.get('error')}")
+                asg = got["result"]
+            finally:
+                probe.close()
+            group = asg["group"]
+            node_id = asg["id"]
+            raft_peers = {int(i): tuple(m["raft"])
+                          for i, m in asg["members"].items()}
+            raft_peers[node_id] = my_raft
+            self._join_members = {
+                int(i): tuple(m["client"])
+                for i, m in asg["members"].items() if int(i) != node_id}
 
         self.group = group
         self._db_kw = dict(db_kw or {})
@@ -410,6 +442,57 @@ class AlphaServer(RaftServer):
         self._write_lock = threading.Lock()
         super().__init__(node_id, raft_peers, client_addr,
                          storage=storage, **kw)
+        if self._join_members:
+            # stay quiet (no campaigning) until the group leader adds
+            # us via conf change and its first append arrives — an
+            # eager candidate here would inflate terms it can't win
+            with self.lock:
+                self.node.removed = True
+            threading.Thread(target=self._join_group, daemon=True,
+                             name=f"join-g{self.group}-{self.id}").start()
+        elif self.zero is not None:
+            # explicit group: register with zero in the background so
+            # its membership registry (connect decisions, /state)
+            # knows this member too
+            threading.Thread(target=self._register_with_zero,
+                             daemon=True,
+                             name=f"register-{self.id}").start()
+
+    def _join_group(self):
+        """Ask the group's current members to conf-change us in (ref
+        conn/raft_server.go JoinCluster), retrying through elections."""
+        from dgraph_tpu.cluster.client import ClusterClient
+        cl = ClusterClient(self._join_members, timeout=30.0)
+        try:
+            my_raft = self.transport.peers.get(self.id) or \
+                self.transport.addr
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline and not self._stop.is_set():
+                with self.lock:
+                    if not self.node.removed:
+                        return  # the leader reached us: we're in
+                try:
+                    cl.conf_change("add", self.id, tuple(my_raft))
+                    return
+                except RuntimeError as e:
+                    if "in flight" not in str(e):
+                        log.warning("join_retry", node=self.id,
+                                    error=str(e))
+                time.sleep(0.5)
+        finally:
+            cl.close()
+
+    def _register_with_zero(self):
+        my_raft = self.transport.peers.get(self.id) or self.transport.addr
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not self._stop.is_set():
+            got = self.zero.request({
+                "op": "connect",
+                "args": (f"{my_raft[0]}:{my_raft[1]}", self.group,
+                         tuple(my_raft), tuple(self.client_addr), 1)})
+            if got.get("ok"):
+                return
+            time.sleep(1.0)
 
     # -------------------------------------------------------- state machine
 
@@ -821,9 +904,16 @@ class ZeroServer(RaftServer):
                     "tablets": dict(self.state.tablets),
                     "moving": dict(self.state.moving),
                     "sizes": dict(self.state.sizes)}}
+        if op == "cluster_state":
+            # membership introspection (ref zero /state)
+            with self.lock:
+                return {"ok": True, "result": {
+                    "alphas": {k: dict(v)
+                               for k, v in self.state.alphas.items()},
+                    "tablets": dict(self.state.tablets)}}
         if op in ("assign_ts", "assign_uids", "commit", "tablet",
                   "tablet_move_start", "tablet_move_done",
-                  "tablet_move_abort", "tablet_size"):
+                  "tablet_move_abort", "tablet_size", "connect"):
             with self.lock:
                 if self.node.role != LEADER:
                     raise NotLeader(self.node.leader_id)

@@ -20,6 +20,13 @@ Commands (payload = (op, args)):
   ("tablet_size", (pred, bytes))      -> records a size report (the
                                          rebalancer's input,
                                          zero/tablet.go:180)
+  ("connect", (key, want_group, raft_addr, client_addr, replicas))
+                                      -> group assignment for a
+                                         (re)connecting alpha: joins
+                                         the least-replicated group
+                                         under the replica target, or
+                                         founds a new one
+                                         (zero/zero.go:410 Connect)
 """
 
 from __future__ import annotations
@@ -37,6 +44,9 @@ class ZeroState:
         self.tablets: dict[str, int] = {}
         self.moving: dict[str, int] = {}   # pred -> destination group
         self.sizes: dict[str, int] = {}    # pred -> reported bytes
+        # alpha registry: key (raft "host:port") -> member record
+        # (zero/zero.go membership state)
+        self.alphas: dict[str, dict] = {}
 
     # ------------------------------------------------------------- apply
 
@@ -89,6 +99,37 @@ class ZeroState:
             pred, nbytes = args
             self.sizes[pred] = int(nbytes)
             return True
+        if op == "connect":
+            key, want_group, raft_addr, client_addr, replicas = args
+            prev = self.alphas.get(key)
+            if prev is not None:
+                # idempotent reconnect (restart): same assignment back
+                gid = prev["group"]
+            else:
+                counts: dict[int, int] = {}
+                for rec in self.alphas.values():
+                    counts[rec["group"]] = counts.get(rec["group"], 0) + 1
+                gid = int(want_group)
+                if gid <= 0:
+                    # least-replicated group under the target, else a
+                    # fresh group (zero.go:410-560 replica-count join)
+                    under = [(n, g) for g, n in sorted(counts.items())
+                             if n < int(replicas)]
+                    gid = min(under)[1] if under else \
+                        (max(counts) + 1 if counts else 1)
+                used = {rec["id"] for rec in self.alphas.values()
+                        if rec["group"] == gid}
+                nid = max(used, default=0) + 1
+                self.alphas[key] = {
+                    "group": gid, "id": nid,
+                    "raft": tuple(raft_addr),
+                    "client": tuple(client_addr)}
+            members = {rec["id"]: {"raft": rec["raft"],
+                                   "client": rec["client"]}
+                       for rec in self.alphas.values()
+                       if rec["group"] == gid}
+            return {"group": gid, "id": self.alphas[key]["id"],
+                    "members": members}
         raise ValueError(f"unknown zero command {op!r}")
 
     # --------------------------------------------------------- snapshots
@@ -98,7 +139,8 @@ class ZeroState:
                 "commits": dict(self.commits),
                 "tablets": dict(self.tablets),
                 "moving": dict(self.moving),
-                "sizes": dict(self.sizes)}
+                "sizes": dict(self.sizes),
+                "alphas": {k: dict(v) for k, v in self.alphas.items()}}
 
     @classmethod
     def from_snapshot(cls, snap: dict) -> "ZeroState":
@@ -109,4 +151,6 @@ class ZeroState:
         st.tablets = dict(snap["tablets"])
         st.moving = dict(snap.get("moving", {}))
         st.sizes = dict(snap.get("sizes", {}))
+        st.alphas = {k: dict(v)
+                     for k, v in snap.get("alphas", {}).items()}
         return st

@@ -32,12 +32,13 @@ def _free_ports(n):
     return ports
 
 
-def _spawn(node_id, peers_spec, client_addr, wal=""):
+def _spawn(node_id, peers_spec, client_addr, wal="", kind="alpha",
+           extra=()):
     cmd = [sys.executable, "-m", "dgraph_tpu", "node",
-           "--kind", "alpha", "--id", str(node_id),
+           "--kind", kind, "--id", str(node_id),
            "--raft-peers", peers_spec,
            "--client-addr", client_addr,
-           "--tick-ms", "30", "--election-ticks", "8"]
+           "--tick-ms", "30", "--election-ticks", "8", *extra]
     if wal:
         cmd += ["--wal", wal]
     return subprocess.Popen(
@@ -201,3 +202,71 @@ def test_conf_change_rejects_concurrent(cluster2):
         client.conf_change("promote", 9)
     with pytest.raises(RuntimeError, match="needs addr"):
         client.conf_change("add", 9)
+
+
+def test_elastic_join_via_zero():
+    """--group 0: zero assigns the least-replicated group (founding a
+    new one past the replica target) and the node raft-joins it live
+    (ref zero/zero.go:410 Connect + conn JoinCluster)."""
+    ports = _free_ports(8)
+    procs = []
+    clients = []
+    try:
+        procs.append(_spawn(1, f"1=127.0.0.1:{ports[0]}",
+                            f"127.0.0.1:{ports[1]}", kind="zero"))
+        zero_spec = f"1=127.0.0.1:{ports[1]}"
+        zc = ClusterClient({1: ("127.0.0.1", ports[1])}, timeout=30.0)
+        clients.append(zc)
+        _wait_leader(zc)
+
+        auto = ["--group", "0", "--replicas", "2", "--zero", zero_spec]
+        procs.append(_spawn(1, f"1=127.0.0.1:{ports[2]}",
+                            f"127.0.0.1:{ports[3]}", extra=auto))
+        c1 = ClusterClient({1: ("127.0.0.1", ports[3])}, timeout=30.0)
+        clients.append(c1)
+        _wait_leader(c1)
+        assert c1.status(1)["group"] == 1
+        c1.alter("ej: string @index(exact) .")
+        c1.mutate(set_nquads='_:a <ej> "joined-data" .')
+
+        # second auto node: same group (replicas=2), provisional CLI
+        # id 9 gets reassigned by zero, raft-joins node 1 live
+        procs.append(_spawn(9, f"9=127.0.0.1:{ports[4]}",
+                            f"127.0.0.1:{ports[5]}", extra=auto))
+        c2 = ClusterClient({2: ("127.0.0.1", ports[5])}, timeout=30.0)
+        clients.append(c2)
+        end = time.monotonic() + 30
+        ok = False
+        while time.monotonic() < end:
+            got = c2._rpc_once(2, {
+                "op": "query",
+                "q": '{ q(func: eq(ej, "joined-data")) { ej } }',
+                "vars": None})
+            if got and got.get("ok") and got["result"]["data"]["q"]:
+                ok = True
+                break
+            time.sleep(0.3)
+        assert ok, "joined replica never caught up"
+        st = c2.status(2)
+        assert st["group"] == 1 and st["id"] == 2
+
+        # third auto node: group 1 is at its replica target ->
+        # founds group 2
+        procs.append(_spawn(7, f"7=127.0.0.1:{ports[6]}",
+                            f"127.0.0.1:{ports[7]}", extra=auto))
+        c3 = ClusterClient({1: ("127.0.0.1", ports[7])}, timeout=30.0)
+        clients.append(c3)
+        _wait_leader(c3)
+        assert c3.status(1)["group"] == 2
+
+        state = zc.request({"op": "cluster_state"})["result"]
+        groups = sorted(rec["group"] for rec in state["alphas"].values())
+        assert groups == [1, 1, 2], state["alphas"]
+    finally:
+        for cl in clients:
+            cl.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait()
