@@ -56,6 +56,7 @@ APPEND_REQ = "append_req"
 APPEND_RESP = "append_resp"
 SNAP_REQ = "snap_req"
 SNAP_RESP = "snap_resp"
+GOODBYE = "goodbye"  # "you were conf-removed" notice to a non-member
 
 
 @dataclass
@@ -437,7 +438,10 @@ class RaftNode:
     def _on_vote_resp(self, m: Msg):
         if self.role != CANDIDATE or m.term < self.term:
             return
-        if m.granted:
+        # only votes from the CURRENT configuration count toward the
+        # quorum — a stale ex-member's grant must never let two
+        # candidates both reach "majority" in one term
+        if m.granted and (m.frm in self.peers or m.frm == self.id):
             self.votes.add(m.frm)
             if len(self.votes) * 2 > len(self.peers) + 1:
                 self._become_leader()

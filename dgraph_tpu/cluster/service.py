@@ -25,7 +25,9 @@ import time
 from typing import Any, Optional
 
 from dgraph_tpu import wire
-from dgraph_tpu.cluster.raft import LEADER, RaftNode
+from dgraph_tpu.cluster.raft import (
+    FOLLOWER, GOODBYE, LEADER, Msg, RaftNode, VOTE_REQ,
+)
 from dgraph_tpu.cluster.transport import TcpTransport
 from dgraph_tpu.utils.logger import log
 
@@ -52,10 +54,18 @@ class RaftServer:
         # the CLI's --raft-peers on restart (ref zero/raft.go member
         # state living in Zero's raft group)
         saved = storage.load_members() if storage is not None else None
-        self.members: dict[int, tuple[str, int]] = \
-            {int(k): tuple(v) for k, v in saved.items()} if saved \
-            else dict(raft_peers)
-        if node_id not in self.members and node_id in raft_peers:
+        self._removed_ids: set[int] = set()
+        if saved and isinstance(saved, dict) and "members" in saved:
+            self.members = {int(k): tuple(v)
+                            for k, v in saved["members"].items()}
+            self._removed_ids = {int(x)
+                                 for x in saved.get("removed", ())}
+        elif saved:
+            self.members = {int(k): tuple(v) for k, v in saved.items()}
+        else:
+            self.members = dict(raft_peers)
+        if node_id not in self.members and node_id in raft_peers \
+                and node_id not in self._removed_ids:
             self.members[node_id] = raft_peers[node_id]
         self.node = RaftNode(node_id, list(self.members),
                              storage=storage,
@@ -103,16 +113,36 @@ class RaftServer:
     # ----------------------------------------------------------- raft side
 
     def _on_msg(self, msg):
+        goodbye = None
         with self.lock:
             if self._stop.is_set():
                 return
-            if msg.frm != self.id and msg.frm not in self.members:
-                # a conf-removed node must not disturb the cluster
-                # (its election timeouts would otherwise inflate terms
-                # forever — the reference drops non-member raft traffic)
+            if msg.type == GOODBYE:
+                # a member told us we were conf-removed (backstop for
+                # a lost farewell append): go quiet
+                if not self.node.removed:
+                    log.info("raft_removed_notice", node=self.id,
+                             frm=msg.frm)
+                self.node.removed = True
+                self.node.role = FOLLOWER
+                self.node.leader_id = None
                 return
-            self.node.step(msg)
+            if msg.frm in self._removed_ids:
+                # TOMBSTONED ex-members must not disturb the cluster
+                # (their election timeouts would otherwise inflate
+                # terms forever); tell them why so they go quiet.
+                # Unknown ids are NOT dropped — an in-progress joiner
+                # whose conf-add this node hasn't applied yet may need
+                # to campaign to heal a leader loss (vote quorum stays
+                # safe: only conf members' votes count).
+                if msg.type == VOTE_REQ:
+                    goodbye = Msg(GOODBYE, self.id, msg.frm,
+                                  self.node.term)
+            else:
+                self.node.step(msg)
             out = self._drain_ready()
+        if goodbye is not None:
+            out.append(goodbye)
         self._send_all(out)
 
     def _tick_loop(self):
@@ -140,7 +170,8 @@ class RaftServer:
             if isinstance(data, dict) and "__members__" in data:
                 # snapshots carry membership so a late joiner that
                 # never saw the conf entries still learns the cluster
-                self._install_members(data["__members__"])
+                self._install_members(data["__members__"],
+                                      data.get("__removed__", ()))
                 data = data["app"]
             self.sm_restore(data)
             self._acked.clear()
@@ -158,23 +189,23 @@ class RaftServer:
             self.applied_cv.notify_all()
         if self._applied_since_snap >= self.snapshot_every:
             self._applied_since_snap = 0
-            self.node.take_snapshot({"__members__": dict(self.members),
-                                     "app": self.sm_snapshot()})
+            self.node.take_snapshot(
+                {"__members__": dict(self.members),
+                 "__removed__": sorted(self._removed_ids),
+                 "app": self.sm_snapshot()})
         return r.msgs
 
     # ------------------------------------------------------- membership
     # Single-change-at-a-time conf changes applied at commit (the etcd
     # model; ref conn/raft_server.go JoinCluster + zero /removeNode).
 
-    def _install_members(self, members: dict):
+    def _install_members(self, members: dict, removed=()):
         members = {int(k): tuple(v) for k, v in members.items()}
-        for nid in list(self.transport.peers):
-            if nid not in members and nid != self.id:
-                self.transport.peers.pop(nid, None)
         for nid, addr in members.items():
             if nid != self.id:
                 self.transport.peers[nid] = addr
         self.members = members
+        self._removed_ids = {int(x) for x in removed}
         for nid in list(self.node.peers):
             if nid not in members:
                 self.node.remove_peer(nid)
@@ -183,8 +214,7 @@ class RaftServer:
                 self.node.add_peer(nid)
         if self.id not in members:
             self.node.remove_peer(self.id)
-        if self.node.storage is not None:
-            self.node.storage.save_members(self.members)
+        self._save_members()
 
     def _apply_conf(self, action: str, nid: int, addr=None) -> bool:
         nid = int(nid)
@@ -197,15 +227,34 @@ class RaftServer:
                 self.node.add_peer(nid)
         elif action == "remove":
             self.members.pop(nid, None)
-            self.transport.peers.pop(nid, None)
+            if nid != self.id and self.node.role == LEADER \
+                    and nid in self.node.peers:
+                # farewell append BEFORE forgetting the peer: it
+                # carries the commit index covering this removal, so
+                # the leaving node applies it, learns it was removed,
+                # and goes quiet instead of campaigning forever
+                # (review finding: the commit otherwise never reaches
+                # it). The transport keeps its address so the queued
+                # message can still be delivered; a lost farewell is
+                # backstopped by GOODBYE notices.
+                self.node._send_append(nid)
             self.node.remove_peer(nid)
         else:
             return False
+        if action == "add":
+            self._removed_ids.discard(nid)
+        else:
+            self._removed_ids.add(nid)
         log.info("raft_conf_change", node=self.id, action=action,
                  member=nid, members=sorted(self.members))
-        if self.node.storage is not None:
-            self.node.storage.save_members(self.members)
+        self._save_members()
         return True
+
+    def _save_members(self):
+        if self.node.storage is not None:
+            self.node.storage.save_members(
+                {"members": dict(self.members),
+                 "removed": sorted(self._removed_ids)})
 
     def _conf_in_flight(self) -> bool:
         """One membership change at a time (raft §4.1 single-server
@@ -237,16 +286,24 @@ class RaftServer:
                 return {"ok": False, "error": "bad conf_change"}
             if action == "add" and not addr:
                 return {"ok": False, "error": "add needs addr"}
-            with self.lock:
+            def gate():
+                # checked under the SAME lock as the propose: two
+                # racing conf_change RPCs must not both slip past the
+                # single-change-in-flight rule (review finding)
                 if self.node.role != LEADER:
                     raise NotLeader(self.node.leader_id)
                 if self._conf_in_flight():
-                    return {"ok": False, "error":
-                            "another membership change is in flight"}
+                    return "another membership change is in flight"
+                return None
+
             ok, result = self.propose_and_wait(
                 ("__conf__", action, nid,
-                 tuple(addr) if addr else None))
-            if not ok or not result:
+                 tuple(addr) if addr else None), gate=gate)
+            if not ok:
+                return {"ok": False, "error":
+                        result if isinstance(result, str)
+                        else "conf change not committed"}
+            if not result:
                 return {"ok": False,
                         "error": "conf change not committed"}
             return {"ok": True, "result": {
@@ -259,11 +316,19 @@ class RaftServer:
             self.transport.send(m)
 
     def propose_and_wait(self, payload: Any,
-                         timeout: float = 5.0) -> tuple[bool, Any]:
+                         timeout: float = 5.0,
+                         gate=None) -> tuple[bool, Any]:
         """Propose on this node (must be leader); wait until the entry
-        applies locally. -> (committed, apply result)."""
+        applies locally. -> (committed, apply result). `gate`, when
+        given, runs under the SAME lock as the propose and aborts it
+        by returning an error string — check-then-propose sequences
+        (the one-conf-change-in-flight rule) need that atomicity."""
         mark = (self.id, self.epoch, next(self._mark_seq))
         with self.lock:
+            if gate is not None:
+                err = gate()
+                if err:
+                    return False, err
             if not self.node.propose((mark, (self.id, self.epoch),
                                       payload)):
                 return False, None
@@ -386,8 +451,9 @@ class AlphaServer(RaftServer):
                 my_raft = tuple(raft_peers[node_id])
                 got = probe.request({
                     "op": "connect",
-                    "args": (f"{my_raft[0]}:{my_raft[1]}", 0, my_raft,
-                             tuple(client_addr), int(replicas))},
+                    "args": (f"{my_raft[0]}:{my_raft[1]}", 0, 0,
+                             my_raft, tuple(client_addr),
+                             int(replicas))},
                     deadline_s=60.0)
                 if not got.get("ok"):
                     raise RuntimeError(
@@ -489,7 +555,8 @@ class AlphaServer(RaftServer):
             got = self.zero.request({
                 "op": "connect",
                 "args": (f"{my_raft[0]}:{my_raft[1]}", self.group,
-                         tuple(my_raft), tuple(self.client_addr), 1)})
+                         self.id, tuple(my_raft),
+                         tuple(self.client_addr), 1)})
             if got.get("ok"):
                 return
             time.sleep(1.0)

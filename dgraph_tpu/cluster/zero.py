@@ -100,11 +100,15 @@ class ZeroState:
             self.sizes[pred] = int(nbytes)
             return True
         if op == "connect":
-            key, want_group, raft_addr, client_addr, replicas = args
+            key, want_group, want_id, raft_addr, client_addr, \
+                replicas = args
             prev = self.alphas.get(key)
             if prev is not None:
-                # idempotent reconnect (restart): same assignment back
+                # idempotent reconnect (restart at the same addr):
+                # same assignment back, addresses refreshed from args
                 gid = prev["group"]
+                prev["raft"] = tuple(raft_addr)
+                prev["client"] = tuple(client_addr)
             else:
                 counts: dict[int, int] = {}
                 for rec in self.alphas.values():
@@ -117,9 +121,20 @@ class ZeroState:
                              if n < int(replicas)]
                     gid = min(under)[1] if under else \
                         (max(counts) + 1 if counts else 1)
-                used = {rec["id"] for rec in self.alphas.values()
-                        if rec["group"] == gid}
-                nid = max(used, default=0) + 1
+                if int(want_id) > 0:
+                    # explicit-group member registering its REAL raft
+                    # id: a record in this group with the same id but
+                    # a different key is a ghost of this node's
+                    # previous incarnation (restarted on new ports) —
+                    # replace it, never invent a new id
+                    nid = int(want_id)
+                    for k, rec in list(self.alphas.items()):
+                        if rec["group"] == gid and rec["id"] == nid:
+                            del self.alphas[k]
+                else:
+                    used = {rec["id"] for rec in self.alphas.values()
+                            if rec["group"] == gid}
+                    nid = max(used, default=0) + 1
                 self.alphas[key] = {
                     "group": gid, "id": nid,
                     "raft": tuple(raft_addr),

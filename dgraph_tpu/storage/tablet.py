@@ -236,11 +236,13 @@ class Tablet:
         out = self.reverse.get(dst, _EMPTY)
         self._ov_index()
         # merge this dst's set/del ops with every del_all, in commit
-        # order ((ts, idx) is the global op order)
+        # order — both lists are already (ts, idx)-sorted, so a linear
+        # two-pointer merge beats re-sorting per frontier uid
         entries = self._ov_by_dst.get(dst, [])
         if self._ov_della:
-            entries = sorted(entries + self._ov_della,
-                             key=lambda e: (e[0], e[1]))
+            import heapq
+            entries = heapq.merge(entries, self._ov_della,
+                                  key=lambda e: (e[0], e[1]))
         for ts, i, op in entries:
             if ts > read_ts:
                 break

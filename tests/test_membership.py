@@ -270,3 +270,36 @@ def test_elastic_join_via_zero():
                 p.kill()
         for p in procs:
             p.wait()
+
+
+def test_removed_follower_goes_quiet(cluster2):
+    """Review regression: removing a FOLLOWER must still reach it —
+    the leader sends a farewell append carrying the removal's commit
+    index before forgetting the peer (and GOODBYE notices backstop a
+    lost farewell), so the ex-member stops campaigning instead of
+    becoming a term-inflating zombie."""
+    procs, client, raft, caddr, tmp = cluster2
+    leader = _wait_leader(client)
+    follower = 1 if leader == 2 else 2
+    client.conf_change("remove", follower)
+    cl = ClusterClient({follower: ("127.0.0.1", caddr[follower])},
+                       timeout=5.0)
+    try:
+        end = time.monotonic() + 15
+        quiet = False
+        while time.monotonic() < end:
+            try:
+                m = cl.members()
+            except RuntimeError:
+                time.sleep(0.2)
+                continue
+            if m.get("removed"):
+                quiet = True
+                break
+            time.sleep(0.2)
+        assert quiet, "removed follower kept campaigning"
+    finally:
+        cl.close()
+    # the survivor keeps serving writes
+    client.remove_node(follower)
+    client.mutate(set_nquads='_:z <fq> "w" .')
