@@ -1,0 +1,124 @@
+"""Microbench: UID-set intersect bandwidth (BASELINE.json's second
+metric, "UID-intersect GB/s").
+
+Mirrors the reference's harness shape (algo/uidlist_test.go:290
+BenchmarkListIntersect*: two sorted lists, size ratio + overlap sweep)
+on the device kernels (ops/uidvec.intersect — vectorized searchsorted
+membership). The CPU baseline is np.intersect1d on the same data.
+
+The driver-facing benchmark stays bench.py (one JSON line); this is
+the supplementary micro harness. Prints one JSON line per config and a
+summary line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+RUNS = 9
+
+
+def make_pair(n_a: int, ratio: int, overlap: float, seed: int = 0):
+    """Two sorted unique uint32 lists; |b| = n_a * ratio; ~overlap of
+    a's elements also appear in b (the reference's sweep axes)."""
+    rng = np.random.default_rng(seed)
+    n_b = n_a * ratio
+    space = np.uint32(4_000_000_000)
+    b = np.unique(rng.integers(0, space, n_b, dtype=np.uint32))
+    take = rng.random(len(b)) < (overlap * n_a / max(len(b), 1))
+    shared = b[take][:n_a]
+    fresh = np.unique(rng.integers(0, space, n_a, dtype=np.uint32))
+    a = np.unique(np.concatenate([shared, fresh]))[:n_a]
+    return a, b
+
+
+def main():
+    from dgraph_tpu.utils.backend import force_cpu_backend, probe_backend
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        force_cpu_backend()
+    else:
+        try:
+            probe_backend(retries=3, backoff_s=5.0)
+        except Exception:
+            force_cpu_backend()
+    import jax
+    import jax.numpy as jnp
+
+    from dgraph_tpu.ops.uidvec import from_numpy, intersect, to_numpy
+
+    platform = jax.devices()[0].platform
+    results = []
+    # K pairs per device call (vmap) — the engine's usage shape: one
+    # batched call per query level, not one dispatch per pair (a lone
+    # small kernel only measures tunnel round-trip latency)
+    for n_a, ratio, overlap, k in [(1_000_000, 1, 0.3, 8),
+                                   (65_536, 8, 0.1, 128),
+                                   (16_384, 1, 0.3, 1024)]:
+        pairs = [make_pair(n_a, ratio, overlap, seed=s)
+                 for s in range(k)]
+        sz_a = max(len(a) for a, _ in pairs)
+        sz_b = max(len(b) for _, b in pairs)
+        da = jax.device_put(jnp.stack(
+            [from_numpy(a, size=1 << (sz_a - 1).bit_length())
+             for a, _ in pairs]))
+        db = jax.device_put(jnp.stack(
+            [from_numpy(b, size=1 << (sz_b - 1).bit_length())
+             for _, b in pairs]))
+
+        t = time.perf_counter()
+        want = [np.intersect1d(a, b, assume_unique=True)
+                for a, b in pairs]
+        cpu_s = time.perf_counter() - t
+
+        fn = jax.jit(jax.vmap(intersect))
+        out = np.asarray(fn(da, db))
+        for i in range(k):
+            assert np.array_equal(to_numpy(out[i]), want[i]), i
+        # block_until_ready is unreliable over the remote-TPU tunnel
+        # (returns before completion); a digest readback forces true
+        # completion, and the measured empty-readback floor is
+        # subtracted so only device time counts
+        digest = jax.jit(
+            lambda x, y: jnp.sum(jax.vmap(intersect)(x, y),
+                                 dtype=jnp.uint32))
+        floor_fn = jax.jit(lambda x: jnp.sum(x[:1, :8],
+                                             dtype=jnp.uint32))
+        np.asarray(digest(da, db))
+        np.asarray(floor_fn(da))
+        times, floors = [], []
+        for _ in range(RUNS):
+            t = time.perf_counter()
+            np.asarray(floor_fn(da))
+            floors.append(time.perf_counter() - t)
+            t = time.perf_counter()
+            np.asarray(digest(da, db))
+            times.append(time.perf_counter() - t)
+        dev_s = max(1e-6, float(np.median(times)) -
+                    float(np.median(floors)))
+        nbytes = (da.size + db.size) * 4
+        rec = {"config": f"a={n_a} ratio={ratio} "
+                         f"overlap={overlap} pairs={k}",
+               "platform": platform,
+               "device_gbps": round(nbytes / dev_s / 1e9, 2),
+               "cpu_gbps": round(nbytes / cpu_s / 1e9, 2),
+               "speedup": round(cpu_s / dev_s, 2)}
+        results.append(rec)
+        print(json.dumps(rec))
+    best = max(r["device_gbps"] for r in results)
+    print(json.dumps({"metric": "uid_intersect_gbps", "value": best,
+                      "unit": "GB/s", "platform": platform}))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as exc:  # structured failure, never a bare crash
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({"metric": "uid_intersect_gbps", "value": None,
+                          "error": f"{type(exc).__name__}: {exc}"}))
+        sys.exit(0)

@@ -35,7 +35,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from dgraph_tpu.ops.uidvec import SENTINEL, compact, member_mask, pad_to
+from dgraph_tpu.ops.uidvec import (
+    SENTINEL, compact, lookup_idx, member_mask, pad_to,
+)
 
 INT64_MAX = np.int64(2**63 - 1)
 
@@ -128,7 +130,7 @@ def _bucket_candidates(frontier: jax.Array, b: AdjBucket) -> jax.Array:
     F = frontier.shape[0]
     M = b.src.shape[0]
     if F <= M:
-        idx = jnp.clip(jnp.searchsorted(b.src, frontier), 0, M - 1)
+        idx = jnp.clip(lookup_idx(b.src, frontier), 0, M - 1)
         hit = (b.src[idx] == frontier) & (frontier != SENTINEL)
         cand = b.neighbors[idx]                 # [F, D]
         cand = jnp.where(hit[:, None], cand, SENTINEL)
@@ -180,7 +182,7 @@ def max_expansion(adj: DeviceAdjacency, frontier_size: int) -> int:
 def count_gather(adj: DeviceAdjacency, uids: jax.Array) -> jax.Array:
     """Per-uid out-degree (0 for uids without the predicate).
     Ref: count-index reads (posting/index.go:284 updateCount)."""
-    idx = jnp.clip(jnp.searchsorted(adj.src_uids, uids), 0,
+    idx = jnp.clip(lookup_idx(adj.src_uids, uids), 0,
                    adj.src_uids.shape[0] - 1)
     hit = (adj.src_uids[idx] == uids) & (uids != SENTINEL)
     return jnp.where(hit, adj.degrees[idx], 0)
@@ -238,7 +240,7 @@ def build_values(pairs: dict[int, int]) -> DeviceValues:
 def key_gather(dv: DeviceValues, uids: jax.Array,
                missing: int = int(RANK_MISSING)) -> jax.Array:
     """Sort-key ranks for candidate uids; `missing` for absent ones."""
-    idx = jnp.clip(jnp.searchsorted(dv.uids, uids), 0, dv.uids.shape[0] - 1)
+    idx = jnp.clip(lookup_idx(dv.uids, uids), 0, dv.uids.shape[0] - 1)
     hit = (dv.uids[idx] == uids) & (uids != SENTINEL)
     return jnp.where(hit, dv.ranks[idx], jnp.int32(missing))
 
@@ -269,7 +271,7 @@ def order_topk(dv_uids, dv_ranks, cand: jax.Array, k: int,
     intersect per bucket becomes gather + one argsort; lax.sort's
     multi-operand form gives the stable uid tiebreak.
     """
-    idx = jnp.clip(jnp.searchsorted(dv_uids, cand), 0, dv_uids.shape[0] - 1)
+    idx = jnp.clip(lookup_idx(dv_uids, cand), 0, dv_uids.shape[0] - 1)
     hit = (dv_uids[idx] == cand) & (cand != SENTINEL)
     ranks = jnp.where(hit, dv_ranks[idx], RANK_MISSING)
     if desc:

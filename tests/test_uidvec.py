@@ -122,3 +122,22 @@ def test_sentinel_padding_is_inert():
     np.testing.assert_array_equal(to_numpy(union(a, b)), [1, 2])
     assert to_numpy(difference(a, b)).size == 0
     assert int(count(a)) == 0
+
+
+def test_sorted_lookup_matches_searchsorted():
+    """Co-sort lookup (TPU-friendly) must return exactly
+    np.searchsorted left-insertion indices for sorted queries,
+    including duplicates between query and table, sentinels, and
+    empty-overlap cases."""
+    import numpy as np
+
+    from dgraph_tpu.ops.uidvec import from_numpy, sorted_lookup
+
+    rng = np.random.default_rng(11)
+    for na, nb in [(8, 8), (64, 1024), (1024, 64), (500, 500)]:
+        a = np.unique(rng.integers(0, 5000, na).astype(np.uint32))
+        b = np.unique(rng.integers(0, 5000, nb).astype(np.uint32))
+        da, db = from_numpy(a), from_numpy(b)
+        got = np.asarray(sorted_lookup(db, da))
+        want = np.searchsorted(np.asarray(db), np.asarray(da))
+        assert np.array_equal(got, want), (na, nb)
