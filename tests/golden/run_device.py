@@ -1,0 +1,41 @@
+"""Run the golden conformance suite on the ambient (real) accelerator
+with the device tier active — CI runs the same suite CPU-only, so this
+is the hardware acceptance pass: every query must produce output
+byte-identical to the committed goldens while the device kernels serve
+the expansions/range-scans/order-keys.
+
+    python -m tests.golden.run_device
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    import jax
+
+    from dgraph_tpu.utils.metrics import snapshot
+    from tests.golden import runner
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    names = runner.query_names()
+    bad = []
+    for n in names:
+        got = runner.run_query(n)
+        if got != runner.load_expected(n):
+            bad.append(n)
+    counters = {k: v for k, v in snapshot()["counters"].items()
+                if "device" in k}
+    print(json.dumps({
+        "metric": "golden_device_conformance",
+        "queries": len(names),
+        "drifted": bad,
+        "ok": not bad,
+        "device_counters": counters,
+        "platform": jax.devices()[0].platform,
+    }))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
