@@ -26,6 +26,11 @@ BENCH_BATCH bit-packed queries (the TPU replacement for the reference's
 one-goroutine-per-request parallelism). vs_baseline =
 device_QPS / baseline_QPS where the baseline runs the same queries one
 at a time on the CPU (>1 means higher throughput than baseline).
+
+Timing is CONSERVATIVE on the remote-TPU tunnel: each timed batch
+blocks on a scalar digest, which costs one tunnel round-trip
+(~120ms measured) on top of device compute — the reported QPS is an
+end-to-end number; device-only throughput is higher.
 """
 
 import json
