@@ -18,5 +18,6 @@ all_gather for frontier union, psum for counts.
 
 from dgraph_tpu.parallel.mesh import make_mesh
 from dgraph_tpu.parallel.dist_graph import (
-    ShardedAdjacency, build_sharded_adjacency, make_sharded_bfs,
+    RingAdjacency, ShardedAdjacency, build_ring_adjacency,
+    build_sharded_adjacency, make_ring_bfs, make_sharded_bfs,
 )

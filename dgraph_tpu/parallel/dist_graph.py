@@ -13,6 +13,19 @@ shards), and one `shard_map` step does
 
 which is exactly the reference's ReceivePredicate-style shard exchange
 (worker/predicate_move.go streams) collapsed into one collective.
+
+Two exchange strategies, mirroring the two long-context layouts:
+
+  all_gather (make_sharded_bfs)  — frontier REPLICATED; each shard
+      masks its local rows, one all_gather merges. Simple, but every
+      device holds the full frontier (the "full attention matrix"
+      analogue).
+  ring (make_ring_bfs)           — frontier SHARDED by uid range;
+      each step local candidates are routed to their dst-range home
+      shard by rotating send blocks around the ICI ring (ppermute),
+      accumulating with local dedup. Peak memory per device stays
+      O(local block) — the ring-attention layout applied to frontier
+      exchange.
 """
 
 from __future__ import annotations
@@ -180,6 +193,154 @@ def expand_sharded_np(mesh: Mesh, sadj: ShardedAdjacency,
     fr = np.full(f_pad, SENTINEL, np.uint32)
     fr[: len(src_u64)] = src_u64.astype(np.uint32)
     return to_numpy(fn(jnp.asarray(fr))).astype(np.uint64)
+
+
+@dataclass
+class RingAdjacency:
+    """Uniform-uid-range sharding for the ring exchange: device i holds
+    the adjacency rows whose SRC uid falls in range i, and owns frontier
+    uids in the same range — src and dst use ONE partition of the uid
+    space so a candidate's home shard is computable on device
+    (dst * n_shards // space)."""
+    n_shards: int
+    space: int                     # uid space size (ranges = space/n)
+    buckets: list[ShardedBucket] = field(default_factory=list)
+    n_edges: int = 0
+    n_dst: int = 0
+
+    def put(self, mesh: Mesh, uid_axis: str = "uid") -> "RingAdjacency":
+        out = RingAdjacency(self.n_shards, self.space, [],
+                            self.n_edges, self.n_dst)
+        for b in self.buckets:
+            spec = NamedSharding(mesh, P(uid_axis))
+            out.buckets.append(ShardedBucket(
+                jax.device_put(b.src, spec),
+                jax.device_put(b.neighbors, spec), b.degree))
+        return out
+
+
+def build_ring_adjacency(edges: dict[int, np.ndarray],
+                         n_shards: int,
+                         min_degree_bucket: int = 8) -> RingAdjacency:
+    """Host: partition srcs into UNIFORM uid ranges (value-based, not
+    mass-balanced — the ring needs dst->shard computable on device)."""
+    all_uids = list(edges.keys())
+    for v in edges.values():
+        all_uids.append(int(v.max()) if len(v) else 0)
+    space = max(all_uids) + 1 if all_uids else 1
+    per = -(-space // n_shards)  # ceil
+    shard_of = lambda u: min(int(u) // per, n_shards - 1)  # noqa: E731
+
+    caps = sorted({max(min_degree_bucket,
+                       1 << int(np.ceil(np.log2(max(len(d), 1)))))
+                   for d in edges.values()}) if edges else []
+    buckets = []
+    total = sum(len(v) for v in edges.values())
+    for cap in caps:
+        rows_per_shard: list[list[int]] = [[] for _ in range(n_shards)]
+        for s, d in edges.items():
+            c = max(min_degree_bucket,
+                    1 << int(np.ceil(np.log2(max(len(d), 1)))))
+            if c == cap:
+                rows_per_shard[shard_of(s)].append(int(s))
+        m = pad_to(max((len(r) for r in rows_per_shard), default=1))
+        src_arr = np.full((n_shards, m), SENTINEL, np.uint32)
+        nb_arr = np.full((n_shards, m, cap), SENTINEL, np.uint32)
+        for si, sel in enumerate(rows_per_shard):
+            for ri, s in enumerate(sorted(sel)):
+                dst = edges[s]
+                src_arr[si, ri] = s
+                nb_arr[si, ri, : len(dst)] = dst.astype(np.uint32)
+        buckets.append(ShardedBucket(jnp.asarray(src_arr),
+                                     jnp.asarray(nb_arr), cap))
+    n_dst = len(np.unique(np.concatenate(
+        [np.asarray(v) for v in edges.values()]))) if edges else 0
+    return RingAdjacency(n_shards, space, buckets, total, n_dst)
+
+
+def make_ring_bfs(mesh: Mesh, radj: RingAdjacency, seed_size: int,
+                  depth: int, block_size: int,
+                  uid_axis: str = "uid"):
+    """Compile a depth-`depth` ring-exchange BFS.
+
+    fn(seeds [n_shards, seed_size] SHARDED by uid axis, each row the
+    seeds falling in that shard's range) ->
+      (levels tuple of [n_shards, block_size] sharded, total int32).
+
+    Per level, per ring step k: every device masks its local
+    candidates for target shard (self+k) mod n, compacts them into one
+    send block, and `ppermute`s it one hop — after n steps every
+    candidate reached its dst-range home, where it merged (sorted
+    dedup) into the local next-frontier block. No device ever holds
+    the whole frontier: memory is O(block) — the ring-attention
+    schedule applied to frontier exchange (SURVEY §5.7's long-context
+    mapping)."""
+    n = mesh.shape[uid_axis]
+    per = -(-radj.space // n)
+
+    in_specs = [P(uid_axis)]
+    for _ in radj.buckets:
+        in_specs.extend([P(uid_axis), P(uid_axis)])
+
+    def merge_into(acc, blk):
+        flat = jnp.sort(jnp.concatenate([acc, blk]))
+        prev = jnp.concatenate(
+            [jnp.full((1,), SENTINEL, flat.dtype), flat[:-1]])
+        return compact(jnp.where(flat != prev, flat, SENTINEL))[
+            : acc.shape[0]]
+
+    def step(seeds, *bucket_arrays):
+        me = jax.lax.axis_index(uid_axis)
+        frontier = seeds[0]            # local block
+        visited = jnp.concatenate([
+            frontier,
+            jnp.full((block_size - frontier.shape[0],), SENTINEL,
+                     jnp.uint32)]) if frontier.shape[0] < block_size \
+            else frontier[:block_size]
+        levels = []
+        for _ in range(depth):
+            parts = []
+            for bi in range(len(radj.buckets)):
+                src_l = bucket_arrays[2 * bi][0]
+                nb_l = bucket_arrays[2 * bi + 1][0]
+                parts.append(_local_candidates(frontier, src_l, nb_l))
+            cand = compact(jnp.concatenate(parts)) if parts else \
+                jnp.full((8,), SENTINEL, jnp.uint32)
+            home = jnp.minimum(cand // jnp.uint32(per),
+                               jnp.uint32(n - 1))
+            acc = jnp.full((block_size,), SENTINEL, jnp.uint32)
+            for k in range(n):
+                target = (me + k) % n
+                blk = compact(jnp.where(
+                    (home == target) & (cand != SENTINEL),
+                    cand, SENTINEL))
+                if k:
+                    # rotate k hops so the block lands on its target
+                    blk = jax.lax.ppermute(
+                        blk, uid_axis,
+                        [(j, (j + k) % n) for j in range(n)])
+                acc = merge_into(acc, blk)
+            new = compact(jnp.where(member_mask(acc, visited),
+                                    SENTINEL, acc))
+            visited = merge_into(visited, new)
+            levels.append(new[None, :])
+            frontier = new
+        local_count = jnp.sum(frontier != SENTINEL, dtype=jnp.int32)
+        total = jax.lax.psum(local_count, uid_axis)
+        return tuple(levels), total
+
+    smapped = shard_map(
+        step, mesh=mesh, in_specs=tuple(in_specs),
+        out_specs=(tuple(P(uid_axis) for _ in range(depth)), P()),
+        check_vma=False)
+
+    def fn(seeds):
+        args = []
+        for b in radj.buckets:
+            args.extend([b.src, b.neighbors])
+        return smapped(seeds, *args)
+
+    return jax.jit(fn)
 
 
 def make_sharded_bfs(mesh: Mesh, sadj: ShardedAdjacency, seed_size: int,
