@@ -516,6 +516,9 @@ class AlphaServer(RaftServer):
                 self.node.removed = True
             threading.Thread(target=self._join_group, daemon=True,
                              name=f"join-g{self.group}-{self.id}").start()
+            threading.Thread(target=self._report_sizes_loop,
+                             daemon=True,
+                             name=f"sizes-{self.id}").start()
         elif self.zero is not None:
             # explicit group: register with zero in the background so
             # its membership registry (connect decisions, /state)
@@ -558,8 +561,27 @@ class AlphaServer(RaftServer):
                          self.id, tuple(my_raft),
                          tuple(self.client_addr), 1)})
             if got.get("ok"):
-                return
+                break
             time.sleep(1.0)
+        self._report_sizes_loop()
+
+    def _report_sizes_loop(self, interval_s: float = 30.0):
+        """Leader-only periodic tablet-size reports to zero — the
+        rebalancer's byte weights (ref zero/tablet.go:180 sizes from
+        membership updates)."""
+        while not self._stop.wait(interval_s):
+            with self.lock:
+                if self.node.role != LEADER:
+                    continue
+                sizes = {pred: tab.approx_bytes()
+                         for pred, tab in self.db.tablets.items()
+                         if not pred.startswith("dgraph.")}
+            for pred, nbytes in sizes.items():
+                try:
+                    self.zero.request({"op": "tablet_size",
+                                       "args": (pred, nbytes)})
+                except Exception:  # noqa: BLE001 — best-effort report
+                    break
 
     # -------------------------------------------------------- state machine
 

@@ -332,12 +332,17 @@ class Rebalancer:
 
     def __init__(self, cluster: RoutedCluster,
                  interval_s: float = 480.0, threshold: int = 2,
-                 size_fn=None):
+                 size_fn=None, use_reported: bool = None):
         import threading
         self.cluster = cluster
         self.interval_s = interval_s
         self.threshold = threshold
         self.size_fn = size_fn or (lambda pred: 1)
+        # honor the alphas' byte reports only when the caller's
+        # threshold is byte-scale (mixing byte weights with a
+        # tablet-count threshold would move on a 2-byte spread)
+        self.use_reported = (threshold > 4096) \
+            if use_reported is None else use_reported
         self.moves: list[tuple[str, int, int]] = []
         self._stop = threading.Event()
         self._thread: Optional[Any] = None
@@ -351,7 +356,16 @@ class Rebalancer:
             if pred in tmap["moving"] or pred.startswith("dgraph."):
                 continue
             by_group.setdefault(gid, []).append(pred)
-        load = {g: sum(self.size_fn(p) for p in ps)
+        # byte weights from the alphas' periodic size reports when
+        # zero has them (ref zero/tablet.go:180); explicit size_fn or
+        # count otherwise
+        reported = tmap.get("sizes", {}) if self.use_reported else {}
+
+        def weigh(pred: str) -> int:
+            got = reported.get(pred)
+            return int(got) if got else self.size_fn(pred)
+
+        load = {g: sum(weigh(p) for p in ps)
                 for g, ps in by_group.items()}
         heavy = max(sorted(load), key=lambda g: load[g])
         light = min(sorted(load), key=lambda g: load[g])
@@ -365,8 +379,8 @@ class Rebalancer:
         # candidates until the move improves the spread)
         spread = load[heavy] - load[light]
         for pred in sorted(by_group[heavy],
-                           key=lambda p: (self.size_fn(p), p)):
-            sz = self.size_fn(pred)
+                           key=lambda p: (weigh(p), p)):
+            sz = weigh(pred)
             if abs((load[heavy] - sz) - (load[light] + sz)) < spread:
                 self.cluster.move_tablet(pred, light)
                 move = (pred, heavy, light)

@@ -399,6 +399,24 @@ class Tablet:
     def dirty(self) -> bool:
         return bool(self.deltas)
 
+    def approx_bytes(self) -> int:
+        """Rough resident size — the tablet-space report zero's
+        rebalancer weighs moves by (ref zero/tablet.go:180 tablet
+        sizes from membership updates)."""
+        n = 0
+        for arr in self.edges.values():
+            n += arr.nbytes
+        for arr in self.reverse.values():
+            n += arr.nbytes
+        for arr in self.index.values():
+            n += arr.nbytes
+        for plist in self.values.values():
+            for p in plist:
+                v = p.value.value
+                n += 16 + (len(v) if isinstance(v, (str, bytes)) else 8)
+        n += 64 * sum(len(ops) for _, ops in self.deltas)
+        return n
+
     def overlay_srcs(self, read_ts: int, reverse: bool = False
                      ) -> set[int]:
         """Uids whose out-edges (in-edges with reverse=True) are
