@@ -573,15 +573,27 @@ class AlphaServer(RaftServer):
             with self.lock:
                 if self.node.role != LEADER:
                     continue
-                sizes = {pred: tab.approx_bytes()
-                         for pred, tab in self.db.tablets.items()
-                         if not pred.startswith("dgraph.")}
-            for pred, nbytes in sizes.items():
+                # snapshot refs ONLY under the raft lock —
+                # approx_bytes walks every posting list (O(store)) and
+                # holding the lock that long would stall heartbeats
+                # into an election (see the ts_budget note above)
+                tabs = [(pred, tab)
+                        for pred, tab in self.db.tablets.items()
+                        if not pred.startswith("dgraph.")]
+            sizes = {}
+            for pred, tab in tabs:
                 try:
-                    self.zero.request({"op": "tablet_size",
-                                       "args": (pred, nbytes)})
-                except Exception:  # noqa: BLE001 — best-effort report
-                    break
+                    sizes[pred] = tab.approx_bytes()
+                except RuntimeError:
+                    continue  # mutated mid-scan; next cycle gets it
+            if not sizes:
+                continue
+            try:
+                # ONE batched request, not one RPC per tablet
+                self.zero.request({"op": "tablet_sizes",
+                                   "args": (sizes,)})
+            except Exception:  # noqa: BLE001 — best-effort report
+                pass
 
     # -------------------------------------------------------- state machine
 
@@ -1002,7 +1014,8 @@ class ZeroServer(RaftServer):
                     "tablets": dict(self.state.tablets)}}
         if op in ("assign_ts", "assign_uids", "commit", "tablet",
                   "tablet_move_start", "tablet_move_done",
-                  "tablet_move_abort", "tablet_size", "connect"):
+                  "tablet_move_abort", "tablet_size", "tablet_sizes",
+                  "connect"):
             with self.lock:
                 if self.node.role != LEADER:
                     raise NotLeader(self.node.leader_id)

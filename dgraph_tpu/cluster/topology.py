@@ -363,7 +363,8 @@ class Rebalancer:
 
         def weigh(pred: str) -> int:
             got = reported.get(pred)
-            return int(got) if got else self.size_fn(pred)
+            # 0 is a legitimate report (emptied tablet), not "missing"
+            return int(got) if got is not None else self.size_fn(pred)
 
         load = {g: sum(weigh(p) for p in ps)
                 for g, ps in by_group.items()}

@@ -99,6 +99,11 @@ class ZeroState:
             pred, nbytes = args
             self.sizes[pred] = int(nbytes)
             return True
+        if op == "tablet_sizes":
+            (batch,) = args
+            for pred, nbytes in batch.items():
+                self.sizes[pred] = int(nbytes)
+            return True
         if op == "connect":
             key, want_group, want_id, raft_addr, client_addr, \
                 replicas = args

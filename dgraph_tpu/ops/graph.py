@@ -180,7 +180,8 @@ def max_expansion(adj: DeviceAdjacency, frontier_size: int) -> int:
 
 
 def count_gather(adj: DeviceAdjacency, uids: jax.Array) -> jax.Array:
-    """Per-uid out-degree (0 for uids without the predicate).
+    """Per-uid out-degree (0 for uids without the predicate); `uids`
+    must be sorted (lookup_idx precondition).
     Ref: count-index reads (posting/index.go:284 updateCount)."""
     idx = jnp.clip(lookup_idx(adj.src_uids, uids), 0,
                    adj.src_uids.shape[0] - 1)
@@ -239,7 +240,8 @@ def build_values(pairs: dict[int, int]) -> DeviceValues:
 
 def key_gather(dv: DeviceValues, uids: jax.Array,
                missing: int = int(RANK_MISSING)) -> jax.Array:
-    """Sort-key ranks for candidate uids; `missing` for absent ones."""
+    """Sort-key ranks for candidate uids; `missing` for absent ones.
+    `uids` must be sorted (lookup_idx precondition)."""
     idx = jnp.clip(lookup_idx(dv.uids, uids), 0, dv.uids.shape[0] - 1)
     hit = (dv.uids[idx] == uids) & (uids != SENTINEL)
     return jnp.where(hit, dv.ranks[idx], jnp.int32(missing))
@@ -269,7 +271,8 @@ def order_topk(dv_uids, dv_ranks, cand: jax.Array, k: int,
 
     Ref: worker/sort.go:412 processSort — the index-bucket walk +
     intersect per bucket becomes gather + one argsort; lax.sort's
-    multi-operand form gives the stable uid tiebreak.
+    multi-operand form gives the stable uid tiebreak. `cand` must be
+    a sorted padded uid vector (lookup_idx precondition).
     """
     idx = jnp.clip(lookup_idx(dv_uids, cand), 0, dv_uids.shape[0] - 1)
     hit = (dv_uids[idx] == cand) & (cand != SENTINEL)

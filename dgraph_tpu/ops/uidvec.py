@@ -145,8 +145,15 @@ _LOOKUP_COSORT_MIN = 4096
 
 
 def lookup_idx(table: jax.Array, q: jax.Array) -> jax.Array:
-    """searchsorted(table, q) for SORTED q, picking the implementation
-    by static query size."""
+    """searchsorted(table, q), picking the implementation by static
+    query size.
+
+    PRECONDITION (unlike jnp.searchsorted): `q` must be sorted
+    ascending — the repo-wide padded-sorted-uid-vector invariant. The
+    co-sort path computes each query's table rank as (position in the
+    co-sorted concat) - (its own q-rank), which underflows to garbage
+    for out-of-order queries. Callers passing value-ordered or
+    otherwise unsorted vectors must sort first."""
     if q.shape[0] >= _LOOKUP_COSORT_MIN:
         return sorted_lookup(table, q)
     return jnp.searchsorted(table, q)

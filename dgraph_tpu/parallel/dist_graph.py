@@ -72,6 +72,37 @@ class ShardedAdjacency:
         return out
 
 
+def _degree_cap(n_edges: int, min_degree_bucket: int) -> int:
+    return max(min_degree_bucket,
+               1 << int(np.ceil(np.log2(max(n_edges, 1)))))
+
+
+def _bucketize(edges: dict[int, np.ndarray], n_shards: int, shard_of,
+               min_degree_bucket: int) -> list[ShardedBucket]:
+    """Shared degree-cap bucketization for both sharding layouts: rows
+    assigned to shards by `shard_of(src)`, shapes equalized across
+    shards per cap."""
+    caps = sorted({_degree_cap(len(d), min_degree_bucket)
+                   for d in edges.values()}) if edges else []
+    buckets = []
+    for cap in caps:
+        rows_per_shard: list[list[int]] = [[] for _ in range(n_shards)]
+        for s, d in edges.items():
+            if _degree_cap(len(d), min_degree_bucket) == cap:
+                rows_per_shard[shard_of(int(s))].append(int(s))
+        m = pad_to(max((len(r) for r in rows_per_shard), default=1))
+        src_arr = np.full((n_shards, m), SENTINEL, np.uint32)
+        nb_arr = np.full((n_shards, m, cap), SENTINEL, np.uint32)
+        for si, sel in enumerate(rows_per_shard):
+            for ri, s in enumerate(sorted(sel)):
+                dst = edges[s]
+                src_arr[si, ri] = s
+                nb_arr[si, ri, : len(dst)] = dst.astype(np.uint32)
+        buckets.append(ShardedBucket(jnp.asarray(src_arr),
+                                     jnp.asarray(nb_arr), cap))
+    return buckets
+
+
 def build_sharded_adjacency(edges: dict[int, np.ndarray],
                             n_shards: int,
                             min_degree_bucket: int = 8) -> ShardedAdjacency:
@@ -85,28 +116,17 @@ def build_sharded_adjacency(edges: dict[int, np.ndarray],
     # contiguous ranges with ~equal edge mass (ref tablet move picks
     # heaviest->lightest, zero/tablet.go:180 — here we just balance)
     bounds = np.searchsorted(cum, np.linspace(0, total, n_shards + 1)[1:-1])
-    shard_srcs = np.split(srcs, bounds)
+    shard_starts = [ss[0] if len(ss) else None
+                    for ss in np.split(srcs, bounds)]
 
-    caps = sorted({max(min_degree_bucket, 1 << int(np.ceil(np.log2(max(d, 1)))))
-                   for d in degs.tolist()}) if len(degs) else []
-    buckets = []
-    for cap in caps:
-        rows_per_shard = []
-        for ss in shard_srcs:
-            sel = [int(s) for s in ss
-                   if max(min_degree_bucket,
-                          1 << int(np.ceil(np.log2(max(len(edges[int(s)]), 1))))) == cap]
-            rows_per_shard.append(sel)
-        m = pad_to(max((len(r) for r in rows_per_shard), default=1))
-        src_arr = np.full((n_shards, m), SENTINEL, np.uint32)
-        nb_arr = np.full((n_shards, m, cap), SENTINEL, np.uint32)
-        for si, sel in enumerate(rows_per_shard):
-            for ri, s in enumerate(sel):
-                dst = edges[s]
-                src_arr[si, ri] = s
-                nb_arr[si, ri, : len(dst)] = dst.astype(np.uint32)
-        buckets.append(ShardedBucket(jnp.asarray(src_arr),
-                                     jnp.asarray(nb_arr), cap))
+    def shard_of(s: int) -> int:
+        si = 0
+        for i, start in enumerate(shard_starts):
+            if start is not None and s >= start:
+                si = i
+        return si
+
+    buckets = _bucketize(edges, n_shards, shard_of, min_degree_bucket)
     n_dst = len(np.unique(np.concatenate(
         [np.asarray(v) for v in edges.values()]))) if edges else 0
     return ShardedAdjacency(n_shards, buckets, total, n_dst)
@@ -229,30 +249,12 @@ def build_ring_adjacency(edges: dict[int, np.ndarray],
         all_uids.append(int(v.max()) if len(v) else 0)
     space = max(all_uids) + 1 if all_uids else 1
     per = -(-space // n_shards)  # ceil
-    shard_of = lambda u: min(int(u) // per, n_shards - 1)  # noqa: E731
 
-    caps = sorted({max(min_degree_bucket,
-                       1 << int(np.ceil(np.log2(max(len(d), 1)))))
-                   for d in edges.values()}) if edges else []
-    buckets = []
+    def shard_of(u: int) -> int:
+        return min(int(u) // per, n_shards - 1)
+
+    buckets = _bucketize(edges, n_shards, shard_of, min_degree_bucket)
     total = sum(len(v) for v in edges.values())
-    for cap in caps:
-        rows_per_shard: list[list[int]] = [[] for _ in range(n_shards)]
-        for s, d in edges.items():
-            c = max(min_degree_bucket,
-                    1 << int(np.ceil(np.log2(max(len(d), 1)))))
-            if c == cap:
-                rows_per_shard[shard_of(s)].append(int(s))
-        m = pad_to(max((len(r) for r in rows_per_shard), default=1))
-        src_arr = np.full((n_shards, m), SENTINEL, np.uint32)
-        nb_arr = np.full((n_shards, m, cap), SENTINEL, np.uint32)
-        for si, sel in enumerate(rows_per_shard):
-            for ri, s in enumerate(sorted(sel)):
-                dst = edges[s]
-                src_arr[si, ri] = s
-                nb_arr[si, ri, : len(dst)] = dst.astype(np.uint32)
-        buckets.append(ShardedBucket(jnp.asarray(src_arr),
-                                     jnp.asarray(nb_arr), cap))
     n_dst = len(np.unique(np.concatenate(
         [np.asarray(v) for v in edges.values()]))) if edges else 0
     return RingAdjacency(n_shards, space, buckets, total, n_dst)
@@ -260,7 +262,7 @@ def build_ring_adjacency(edges: dict[int, np.ndarray],
 
 def make_ring_bfs(mesh: Mesh, radj: RingAdjacency, seed_size: int,
                   depth: int, block_size: int,
-                  uid_axis: str = "uid"):
+                  uid_axis: str = "uid", check_block: bool = True):
     """Compile a depth-`depth` ring-exchange BFS.
 
     fn(seeds [n_shards, seed_size] SHARDED by uid axis, each row the
@@ -274,7 +276,19 @@ def make_ring_bfs(mesh: Mesh, radj: RingAdjacency, seed_size: int,
     dedup) into the local next-frontier block. No device ever holds
     the whole frontier: memory is O(block) — the ring-attention
     schedule applied to frontier exchange (SURVEY §5.7's long-context
-    mapping)."""
+    mapping).
+
+    `block_size` caps each shard's frontier/visited vectors; merges
+    truncate at it, so it must bound the per-shard reachable set or
+    uids would silently drop. n_dst (distinct destinations anywhere)
+    + the seed block is always safe and is enforced here — callers
+    with a tighter per-shard bound can pass check_block=False."""
+    if check_block and block_size < pad_to(radj.n_dst + seed_size):
+        raise ValueError(
+            f"block_size {block_size} can overflow: a shard's "
+            f"reachable set is only bounded by n_dst + seeds = "
+            f"{radj.n_dst + seed_size} (pad to "
+            f"{pad_to(radj.n_dst + seed_size)})")
     n = mesh.shape[uid_axis]
     per = -(-radj.space // n)
 
