@@ -101,7 +101,8 @@ _FAST = re.compile(
 
 
 def _fast_nquad(m) -> NQuad:
-    nq = NQuad(subject=m.group("si") or m.group("sb"),
+    si = m.group("si")
+    nq = NQuad(subject=si if si is not None else m.group("sb"),
                predicate=m.group("pi") or m.group("pw"))
     lit = m.group("lit")
     if lit is not None:
@@ -117,7 +118,8 @@ def _fast_nquad(m) -> NQuad:
             nq.object_value = Val(TypeID.DEFAULT, lit)
         nq.lang = m.group("lang") or ""
     else:
-        nq.object_id = m.group("oi") or m.group("ob")
+        oi = m.group("oi")
+        nq.object_id = oi if oi is not None else m.group("ob")
     return nq
 
 

@@ -128,3 +128,33 @@ def test_fuzz_json_mutation_parser():
         except Exception as e:  # noqa: BLE001
             crashes.append((type(e).__name__, str(e)[:80], src[:80]))
     assert not crashes, crashes[:5]
+
+
+def test_rdf_fast_path_equivalence():
+    """The one-regex RDF fast path must produce EXACTLY what the full
+    grammar produces for every statement shape it accepts — and must
+    decline (falling back) rather than mis-parse everything else.
+    Structured random generation over subjects/predicates/objects/
+    langs/dtypes/escapes."""
+    rng = random.Random(99)
+    from dgraph_tpu.gql.nquad import _FAST, _fast_nquad, _parse_one
+
+    subjects = ["<0x1>", "<node-a>", "_:blank1", "<>"]
+    preds = ["<follows>", "<name.x>", "name", "<p/q#r>"]
+    objects = ['"plain"', '"with \\"escape\\""', '"tab\\there"',
+               '"v"@en', '"v"@zh-Hans', '"33"^^<xs:int>',
+               '"3.5"^^<http://www.w3.org/2001/XMLSchema#float>',
+               "<0x2>", "_:b2", '""']
+    for _ in range(3000):
+        s = rng.choice(subjects)
+        p = rng.choice(preds)
+        o = rng.choice(objects)
+        pad = " " * rng.randrange(3)
+        line = f"{s} {p}{pad} {o} ."
+        m = _FAST.match(line)
+        want, rest = _parse_one(line, 1)
+        assert rest.strip() == ""
+        if m is None:
+            continue  # fast path declined: fallback covers it
+        got = _fast_nquad(m)
+        assert got == want, (line, got, want)
