@@ -1,0 +1,189 @@
+"""Chaos: concurrent bank workload under a nemesis sequence.
+
+The reference's Jepsen driver runs workloads x nemeses (bank +
+partition-ring / kill-alpha / move-tablet, contrib/jepsen/main.go);
+this is that matrix in-tree: transfers keep flowing while a tablet
+moves between groups, a member joins the bank group live, and the
+bank group's leader is SIGKILLed. The invariant — total balance
+conserved at every snapshot — must hold through all of it.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dgraph_tpu.cluster.client import ClusterClient
+from dgraph_tpu.cluster.topology import RoutedCluster
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_ACCOUNTS = 4
+OPENING = 100
+
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn(kind, node_id, peers_spec, client_addr, group=1, zero=""):
+    cmd = [sys.executable, "-m", "dgraph_tpu", "node", "--kind", kind,
+           "--id", str(node_id), "--raft-peers", peers_spec,
+           "--client-addr", client_addr, "--group", str(group),
+           "--tick-ms", "30", "--election-ticks", "8"]
+    if zero:
+        cmd += ["--zero", zero]
+    return subprocess.Popen(
+        cmd, env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO),
+        cwd=_REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def _wait_role(client, want="leader", deadline_s=30.0):
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        for node in list(client.addrs):
+            try:
+                if client.status(node).get("role") == want:
+                    return client.status(node)["id"]
+            except (ConnectionError, RuntimeError, KeyError):
+                pass
+        time.sleep(0.2)
+    raise AssertionError(f"no {want} within deadline")
+
+
+def test_bank_survives_move_join_and_leader_kill():
+    ports = _free_ports(10)
+    procs = {}
+    clients = []
+    try:
+        zero_spec = f"1=127.0.0.1:{ports[1]}"
+        procs["z1"] = _spawn("zero", 1, f"1=127.0.0.1:{ports[0]}",
+                             f"127.0.0.1:{ports[1]}")
+        # bank group (1): two replicas; noise group (2): one
+        g1_peers = f"1=127.0.0.1:{ports[2]},2=127.0.0.1:{ports[3]}"
+        procs["a1"] = _spawn("alpha", 1, g1_peers,
+                             f"127.0.0.1:{ports[4]}", 1, zero_spec)
+        procs["a2"] = _spawn("alpha", 2, g1_peers,
+                             f"127.0.0.1:{ports[5]}", 1, zero_spec)
+        procs["b1"] = _spawn("alpha", 1, f"1=127.0.0.1:{ports[6]}",
+                             f"127.0.0.1:{ports[7]}", 2, zero_spec)
+
+        zc = ClusterClient({1: ("127.0.0.1", ports[1])}, timeout=30.0)
+        g1 = ClusterClient({1: ("127.0.0.1", ports[4]),
+                            2: ("127.0.0.1", ports[5])}, timeout=30.0)
+        g2 = ClusterClient({1: ("127.0.0.1", ports[7])}, timeout=30.0)
+        clients += [zc, g1, g2]
+        rc = RoutedCluster(zc, {1: g1, 2: g2})
+        _wait_role(zc)
+        _wait_role(g1)
+        _wait_role(g2)
+
+        rc.alter("bal: int .\nnoise: string @index(exact) .")
+        # bank on group 1, noise on group 2
+        zc.tablet("bal", 1)
+        zc.tablet("noise", 2)
+        uids = []
+        for i in range(N_ACCOUNTS):
+            out = g1.mutate(set_nquads=f'_:a <bal> "{OPENING}" .')
+            uids.append(list(out["uids"].values())[0])
+        rc.mutate(set_nquads='_:n <noise> "x0" .')
+
+        stop = threading.Event()
+        errors: list[str] = []
+        transfers = {"n": 0}
+
+        def transfer_loop(seed):
+            import random
+            rng = random.Random(seed)
+            while not stop.is_set():
+                a, b = rng.sample(uids, 2)
+                amt = rng.randrange(1, 10)
+                q = ('{ a as var(func: uid(%s)) { ab as bal '
+                     'na as math(ab - %d) } '
+                     'b as var(func: uid(%s)) { bb as bal '
+                     'nb as math(bb + %d) } }' % (a, amt, b, amt))
+                try:
+                    g1.mutate(query=q,
+                              set_nquads='uid(a) <bal> val(na) .\n'
+                                         'uid(b) <bal> val(nb) .')
+                    transfers["n"] += 1
+                except RuntimeError:
+                    pass  # abort/election: the workload retries forever
+
+        def reader_loop():
+            while not stop.is_set():
+                try:
+                    got = g1.query('{ q(func: has(bal)) { bal } }')
+                    rows = got["data"]["q"]
+                    if len(rows) == N_ACCOUNTS:
+                        total = sum(r["bal"] for r in rows)
+                        if total != N_ACCOUNTS * OPENING:
+                            errors.append(f"invariant broken: {total}")
+                            return
+                except RuntimeError:
+                    pass
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=transfer_loop, args=(s,),
+                                    daemon=True) for s in (1, 2)]
+        threads.append(threading.Thread(target=reader_loop, daemon=True))
+        for t in threads:
+            t.start()
+
+        # nemesis 1: live tablet move g2 -> g1 while the bank runs
+        time.sleep(1.0)
+        rc.move_tablet("noise", 1)
+        assert rc.tablet_map()["tablets"]["noise"] == 1
+
+        # nemesis 2: a third member joins the bank group live
+        g1_peers3 = g1_peers + f",3=127.0.0.1:{ports[8]}"
+        procs["a3"] = _spawn("alpha", 3, g1_peers3,
+                             f"127.0.0.1:{ports[9]}", 1, zero_spec)
+        time.sleep(0.5)
+        g1.conf_change("add", 3, ("127.0.0.1", ports[8]))
+        g1.add_node(3, ("127.0.0.1", ports[9]))
+
+        # nemesis 3: SIGKILL the bank leader; the 2 survivors recover
+        time.sleep(1.0)
+        leader = _wait_role(g1)
+        victim = {1: "a1", 2: "a2", 3: "a3"}[leader]
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait()
+        g1.remove_node(leader)
+        _wait_role(g1)
+
+        # let the workload run through the recovered topology
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        assert not errors, errors
+        assert transfers["n"] > 20, "workload starved"
+        got = g1.query('{ q(func: has(bal)) { bal } }')
+        total = sum(r["bal"] for r in got["data"]["q"])
+        assert total == N_ACCOUNTS * OPENING
+        # the moved tablet still serves from its new home
+        got = rc.query('{ q(func: eq(noise, "x0")) { noise } }')
+        assert got["data"]["q"] == [{"noise": "x0"}]
+    finally:
+        for cl in clients:
+            cl.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs.values():
+            p.wait()
