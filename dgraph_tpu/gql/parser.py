@@ -655,9 +655,11 @@ def _parse_selection(cur: Cursor, gvars: dict) -> GraphQuery:
         cur.expect("rparen")
     else:
         gq.attr = name
-        if (cur.peek().kind == "at"
-                and cur.peek(1).kind == "name"
-                and cur.peek(1).val.lower() not in _DIRECTIVES):
+        if cur.peek().kind == "at" and (
+                cur.peek(1).kind == "dot"
+                or cur.peek(1).val == "*"
+                or (cur.peek(1).kind == "name"
+                    and cur.peek(1).val.lower() not in _DIRECTIVES)):
             cur.next()
             gq.langs = _parse_langs(cur)
 
@@ -687,10 +689,14 @@ def _parse_selection(cur: Cursor, gvars: dict) -> GraphQuery:
 
 
 def _parse_langs(cur: Cursor) -> list[str]:
-    # `name@en:fr`, `name@.` (any-language fallback), `name@en:.`
+    # `name@en:fr`, `name@.` (any-language fallback), `name@en:.`,
+    # `name@*` (every language as its own output key)
     langs = []
     if cur.accept("dot"):
         langs.append(".")
+    elif cur.peek().val == "*":
+        cur.next()
+        return ["*"]
     else:
         langs.append(cur.expect("name", "language").val)
     while cur.accept("colon"):

@@ -194,8 +194,23 @@ class Executor:
     def _var_defined(self, name: str) -> bool:
         return name in self.uid_vars or name in self.value_vars
 
+    def _provides(self, gq: GraphQuery):
+        """Vars a block's own subtree binds (uid vars, value vars,
+        facet vars): consumers INSIDE the block must not make the
+        scheduler wait for another block to provide them (ref
+        query0_test.go level-based facet var tests: `path @facets(L1
+        as weight) sumw: sum(val(L1))` in one block)."""
+        if gq.var:
+            yield gq.var
+        for varname in gq.facet_var.values():
+            yield varname
+        for c in gq.children:
+            yield from self._provides(c)
+
     def _vars_ready(self, gq: GraphQuery) -> bool:
-        return all(self._var_defined(vc.name) for vc in self._all_needs(gq))
+        own = set(self._provides(gq))
+        return all(self._var_defined(vc.name) or vc.name in own
+                   for vc in self._all_needs(gq))
 
     # ------------------------------------------------------------------
     # one block
@@ -206,6 +221,7 @@ class Executor:
             return self._run_block_inner(gq)
 
     def _run_block_inner(self, gq: GraphQuery) -> ExecNode:
+        self._block_vars = set(self._provides(gq))
         node = ExecNode(gq)
         if gq.attr == "shortest":
             self._run_shortest(node)
@@ -444,7 +460,13 @@ class Executor:
                         got = tab.index_uids(token_bytes(spec.ident, t),
                                              self.read_ts)
                         out = _union(out, got)
-            if spec.lossy:
+            if spec.lossy or tab.schema.lang:
+                # @lang predicates share index buckets across language
+                # tags (the token carries no lang), so the index hit
+                # must be verified against the posting the query's
+                # lang selector actually addresses: eq(name, "") must
+                # not match a value that is empty only in @hi (ref
+                # query0_test.go TestQueryEmptyDefaultNames)
                 out = self._verify_eq(tab, out, vals, lang)
             return out if candidates is None else _intersect(candidates, out)
         # unindexed: value scan over candidates (filter context) or all
@@ -1171,6 +1193,15 @@ class Executor:
             src = node.src
             vals = [vmap[u] for u in src.tolist() if u in vmap] \
                 if len(src) else list(vmap.values())
+            if not vals and vmap and \
+                    vc.name in getattr(self, "_block_vars", ()):
+                # the var was bound by a SIBLING subtree in this block
+                # (facet var / deeper-level value var), so it is keyed
+                # by descendant uids, not by this level's src —
+                # aggregate the whole map, dgraph's flat-variable
+                # semantics (ref query0_test.go
+                # TestLevelBasedFacetVarAggSum)
+                vals = list(vmap.values())
             node.values[0] = [Agg(gq.agg_func, _aggregate(gq.agg_func, vals))]
         elif gq.math is not None:
             vmap = _eval_math(gq.math, self.value_vars)
@@ -1618,13 +1649,16 @@ class Executor:
             obj = self._emit_uid(node, int(u), path)
             if obj:  # empty objects are dropped (ref outputnode.go)
                 out.append(obj)
-        # block-level aggregations over vars (empty-src internal children)
-        for ch in node.children:
-            if ch.gq.agg_func and 0 in ch.values:
-                agg = ch.values[0][0]
-                if agg.value is not None:
-                    name = ch.gq.alias or ch.gq.attr
-                    out.append({name: to_json_value(agg.value)})
+        # row-less blocks (q() { min(val(a)) }) emit aggregations as
+        # standalone objects; blocks WITH rows attach them per row in
+        # _emit_uid (ref preTraverse)
+        if not len(node.dest):
+            for ch in node.children:
+                if ch.gq.agg_func and 0 in ch.values:
+                    agg = ch.values[0][0]
+                    if agg.value is not None:
+                        name = ch.gq.alias or ch.gq.attr
+                        out.append({name: to_json_value(agg.value)})
         if gq.normalize:
             out = [self._normalize(o) for o in out if o]
             out = [o for o in out if o]
@@ -1649,7 +1683,16 @@ class Executor:
                 obj["uid"] = hex(uid)
                 continue
             if cgq.agg_func:
-                continue  # block-level
+                # aggregations attach INSIDE each parent row (ref
+                # outputnode.go preTraverse: the agg subgraph hangs
+                # under its parent node — TestLevelBasedFacetVarAggSum
+                # shape); row-less blocks emit them standalone in
+                # _emit_block instead
+                if 0 in ch.values:
+                    agg = ch.values[0][0]
+                    if agg.value is not None:
+                        obj[name] = to_json_value(agg.value)
+                continue
             if cgq.attr == "math" or cgq.attr.startswith("val("):
                 vs = ch.values.get(uid)
                 if vs:
@@ -1710,7 +1753,20 @@ class Executor:
                     return None
             else:
                 ps = ch.values.get(uid)
-                if ps:
+                if ps and cgq.langs == ["*"]:
+                    # name@* : every language as its own key, the
+                    # untagged value under the bare attr (ref
+                    # query0_test.go TestQueryAllLanguages)
+                    emitted = False
+                    for p in ps:
+                        key = f"{cgq.attr}@{p.lang}" if p.lang \
+                            else cgq.attr
+                        obj[cgq.alias or key] = to_json_value(
+                            self._typed(ch.tablet, p))
+                        emitted = True
+                    if emitted:
+                        continue
+                elif ps:
                     v = self._emit_value(ch, ps)
                     if v is not None:
                         obj[name] = v

@@ -570,3 +570,59 @@ def test_applied_commit_record_feeds_conflict_window():
     # the racing write won (no lost update)
     out = db1.query('{ q(func: uid(0x1)) { bal } }')
     assert out["data"]["q"] == [{"bal": 70}]
+
+
+def test_lang_eq_selects_the_addressed_posting():
+    """Ref query0_test.go TestQueryEmptyDefaultNames /
+    NamesThatAreEmptyInLanguage: eq(name, v) addresses ONLY the
+    untagged posting, eq(name@hi, v) only the @hi posting — lang
+    variants share index buckets, so hits must verify against the
+    selected posting."""
+    db2 = GraphDB(prefer_device=False)
+    db2.alter("name: string @index(exact) @lang .")
+    db2.mutate(set_nquads="\n".join([
+        '<0x1> <name> "" .',
+        '<0x2> <name> "" .', '<0x2> <name> "Amit"@en .',
+        '<0x2> <name> "अमित"@hi .',
+        '<0x3> <name> "Andrew"@en .', '<0x3> <name> ""@hi .']))
+    r = data(db2.query('{ q(func: eq(name, "")) { uid } }'))
+    assert [x["uid"] for x in r["q"]] == ["0x1", "0x2"]
+    r = data(db2.query('{ q(func: eq(name@hi, "")) { name@en } }'))
+    assert r["q"] == [{"name@en": "Andrew"}]
+    r = data(db2.query('{ q(func: eq(name@hi, "अमित")) { name@en } }'))
+    assert r["q"] == [{"name@en": "Amit"}]
+
+
+def test_lang_star_expands_all_languages():
+    """name@* emits every language as its own key plus the untagged
+    value (ref query0_test.go TestQueryAllLanguages)."""
+    db2 = GraphDB(prefer_device=False)
+    db2.alter("name: string @index(exact) @lang .")
+    db2.mutate(set_nquads="\n".join([
+        '<0x2> <name> "" .', '<0x2> <name> "Amit"@en .',
+        '<0x2> <name> "अमित"@hi .']))
+    r = data(db2.query('{ q(func: uid(0x2)) { name@* } }'))
+    assert r["q"] == [{"name": "", "name@en": "Amit",
+                       "name@hi": "अमित"}]
+
+
+def test_facet_var_sibling_aggregation():
+    """Level-based facet var consumed by a sibling aggregation in the
+    SAME block, attached inside the parent row (ref query0_test.go
+    TestLevelBasedFacetVarAggSum)."""
+    db2 = GraphDB(prefer_device=False)
+    db2.alter("path: [uid] .\nname: string .")
+    db2.mutate(set_nquads="\n".join([
+        '<0x10> <path> <0x11> (weight=0.1) .',
+        '<0x10> <path> <0x12> (weight=0.7) .',
+        '<0x11> <name> "John" .', '<0x12> <name> "Matt" .']))
+    r = data(db2.query('''{
+      friend(func: uid(0x10)) {
+        path @facets(L1 as weight)
+        sumw: sum(val(L1))
+      }
+    }'''))
+    assert len(r["friend"]) == 1
+    row = r["friend"][0]
+    assert abs(row["sumw"] - 0.8) < 1e-9
+    assert len(row["path"]) == 2
