@@ -129,8 +129,10 @@ class ExecNode:
     values: dict[int, list] = field(default_factory=dict)  # uid->Postings
     counts: dict[int, int] = field(default_factory=dict)
     children: list["ExecNode"] = field(default_factory=list)
-    # recurse support: per-level (parent -> [children]) maps
+    # recurse support: per-level (parent -> [children]) maps, and the
+    # per-level resolved child list (expand() re-resolves per level)
     recurse_levels: list[dict[int, np.ndarray]] = field(default_factory=list)
+    recurse_preds: list[list] = field(default_factory=list)
     path_nodes: list[list[int]] = field(default_factory=list)  # shortest
     path_weights: list[float] = field(default_factory=list)
 
@@ -1320,12 +1322,21 @@ class Executor:
         gq = node.gq
         depth = gq.recurse.depth or 64
         allow_loop = gq.recurse.allow_loop
-        preds = [c for c in gq.children if not c.is_internal]
         frontier = node.dest
         visited = frontier.copy()
+        # uid vars bound inside @recurse accumulate every uid reached
+        # via that predicate across ALL levels (ref query3_test.go
+        # TestRecurseVariable)
+        var_accum: dict[str, np.ndarray] = {}
         for _ in range(depth):
             if not len(frontier):
                 break
+            # expand(_all_)/expand(Type) re-resolves per level against
+            # the CURRENT frontier's types (ref TestRecurseExpand)
+            preds = [c for c in
+                     self._expand_expand(gq.children, frontier)
+                     if not c.is_internal]
+            node.recurse_preds.append(preds)
             level: dict[str, dict[int, np.ndarray]] = {}
             nxt = _EMPTY
             for cgq in preds:
@@ -1365,15 +1376,20 @@ class Executor:
                         per_parent[u] = dst
                         parts.append(dst)
                 level[attr] = per_parent
-                if union is not None:
-                    nxt = _union(nxt, union)
-                elif parts:
-                    nxt = _union(nxt, np.unique(np.concatenate(parts)))
+                reached = union if union is not None else (
+                    np.unique(np.concatenate(parts)) if parts else _EMPTY)
+                if cgq.var and len(reached):
+                    var_accum[cgq.var] = _union(
+                        var_accum.get(cgq.var, _EMPTY), reached)
+                if len(reached):
+                    nxt = _union(nxt, reached)
             node.recurse_levels.append(level)
             if not allow_loop:
                 nxt = _difference(nxt, visited)
                 visited = _union(visited, nxt)
             frontier = nxt
+        for name, uids in var_accum.items():
+            self.uid_vars[name] = uids
         node.recurse_frontiers = None  # levels carry everything
 
     # ------------------------------------------------------------------
@@ -1971,8 +1987,15 @@ class Executor:
     def _emit_recurse_node(self, node: ExecNode, uid: int, level: int
                            ) -> dict:
         obj: dict[str, Any] = {"uid": hex(uid)}
+        # per-level resolved children (expand() differs by level); the
+        # deepest nodes reuse the last level's resolution for scalars
+        if node.recurse_preds:
+            children = node.recurse_preds[
+                min(level, len(node.recurse_preds) - 1)]
+        else:
+            children = node.gq.children
         # value/scalar children at every level
-        for cgq in node.gq.children:
+        for cgq in children:
             tab = self._tablet(cgq.attr.lstrip("~"))
             if tab is None:
                 continue
@@ -1984,7 +2007,7 @@ class Executor:
                     obj[name] = to_json_value(self._typed(tab, sel))
         if level < len(node.recurse_levels):
             lv = node.recurse_levels[level]
-            for cgq in node.gq.children:
+            for cgq in children:
                 attr = cgq.attr
                 per_parent = lv.get(attr)
                 if not per_parent or uid not in per_parent:

@@ -122,3 +122,33 @@ def test_graphdb_bfs_parity():
         for x, y in zip(a, b):
             np.testing.assert_array_equal(x, y)
     assert dev.tablets["link"]._device_badj is not None
+
+
+def test_recurse_variable_and_expand():
+    """Ref query3_test.go TestRecurseVariable (vars bound inside
+    @recurse accumulate every uid reached via that predicate) and
+    TestRecurseExpand (expand(_all_) re-resolves per level)."""
+    from dgraph_tpu.engine.db import GraphDB
+
+    db = GraphDB(prefer_device=False)
+    db.alter("follow: [uid] @reverse .\nname: string @index(exact) .\n"
+             "type Node { name follow }")
+    db.mutate(set_nquads="\n".join([
+        "<0x1> <follow> <0x2> .", "<0x2> <follow> <0x3> .",
+        "<0x3> <follow> <0x1> .", "<0x3> <follow> <0x4> .",
+        '<0x1> <name> "a" .', '<0x2> <name> "b" .',
+        '<0x3> <name> "c" .', '<0x4> <name> "d" .',
+        '<0x1> <dgraph.type> "Node" .', '<0x2> <dgraph.type> "Node" .',
+        '<0x3> <dgraph.type> "Node" .', '<0x4> <dgraph.type> "Node" .',
+    ]))
+    r = db.query('''{
+      var(func: uid(0x1)) @recurse(depth: 2) { f as follow }
+      q(func: uid(f)) { name }
+    }''')["data"]
+    assert sorted(x["name"] for x in r["q"]) == ["b", "c"]
+
+    r = db.query('{ q(func: uid(0x1)) @recurse(depth: 3) '
+                 '{ expand(_all_) } }')["data"]
+    assert r["q"][0]["name"] == "a"
+    assert r["q"][0]["follow"][0]["name"] == "b"
+    assert r["q"][0]["follow"][0]["follow"][0]["name"] == "c"
