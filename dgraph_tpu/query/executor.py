@@ -1676,7 +1676,8 @@ class Executor:
                         name = ch.gq.alias or ch.gq.attr
                         out.append({name: to_json_value(agg.value)})
         if gq.normalize:
-            out = [self._normalize(o) for o in out if o]
+            out = [row for o in out if o
+                   for row in self._normalize(o)]
             out = [o for o in out if o]
         return out
 
@@ -2033,23 +2034,29 @@ class Executor:
             out.append(entry)
         return out
 
-    def _normalize(self, obj: dict) -> dict:
-        """@normalize: keep aliased leaves, flatten nesting
-        (ref outputnode.go normalize)."""
-        flat: dict[str, Any] = {}
-
-        def walk(o):
-            for k, v in o.items():
-                if isinstance(v, list) and v and isinstance(v[0], dict):
-                    for item in v:
-                        walk(item)
-                elif isinstance(v, dict):
-                    walk(v)
-                elif k != "uid":
-                    flat[k] = v
-
-        walk(obj)
-        return flat
+    def _normalize(self, obj: dict) -> list[dict]:
+        """@normalize: flatten nesting into one row per LEAF PATH —
+        the cartesian merge of each child list's flattened rows with
+        the parent's scalars (ref outputnode.go:325 normalize's
+        parentSlice x childSlice merge). A parent with two friends
+        yields two flat rows, never one merged-overwritten object."""
+        rows: list[dict] = [{k: v for k, v in obj.items()
+                             if k != "uid" and not isinstance(v, dict)
+                             and not (isinstance(v, list) and v
+                                      and isinstance(v[0], dict))}]
+        for k, v in obj.items():
+            if isinstance(v, dict):
+                groups = [self._normalize(v)]
+            elif isinstance(v, list) and v and isinstance(v[0], dict):
+                groups = [[r for item in v
+                           for r in self._normalize(item)]]
+            else:
+                continue
+            for child_rows in groups:
+                if child_rows:
+                    rows = [{**r, **c} for r in rows
+                            for c in child_rows]
+        return rows
 
 
 class Agg:
