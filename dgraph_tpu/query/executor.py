@@ -237,6 +237,8 @@ class Executor:
             self.uid_vars[gq.var] = root
         if gq.recurse is not None:
             self._run_recurse(node)
+        elif gq.is_groupby:
+            self._bind_groupby_vars(gq, root)
         else:
             self._expand_children(node, gq.children, root)
         return node
@@ -1652,6 +1654,12 @@ class Executor:
         if gq.recurse is not None:
             return [self._emit_recurse_node(node, int(u), 0)
                     for u in node.dest.tolist()]
+        if gq.is_groupby:
+            # root-level @groupby groups the block's matched uids (ref
+            # query0_test.go TestGroupByRoot:
+            # {"me":[{"@groupby":[...]}]})
+            fake = ExecNode(gq)
+            return [self._emit_groupby(fake, node.dest)]
         out = []
         # count(uid) at block level: one summed object
         # (ref outputnode.go uid count emission)

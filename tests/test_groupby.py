@@ -141,3 +141,20 @@ def test_groupby_lang_selector():
                    '{ count(uid) } } }')["data"]["q"][0]["item"]["@groupby"]
     assert {(g["label"], g["count"]) for g in out} == \
         {("rot", 2), ("blau", 1)}
+
+
+def test_groupby_at_root():
+    """Ref query0_test.go TestGroupByRoot: @groupby on the root block
+    groups the matched uids."""
+    from dgraph_tpu.engine.db import GraphDB
+
+    db = GraphDB(prefer_device=False)
+    db.alter("name: string @index(exact) .\nage: int @index(int) .")
+    db.mutate(set_nquads="\n".join([
+        '<0x1> <name> "a" .', '<0x1> <age> "38" .',
+        '<0x2> <name> "b" .', '<0x2> <age> "15" .',
+        '<0x3> <name> "c" .', '<0x3> <age> "15" .']))
+    r = db.query(
+        '{ me(func: has(name)) @groupby(age) { count(uid) } }')["data"]
+    assert r["me"] == [{"@groupby": [{"age": 15, "count": 2},
+                                     {"age": 38, "count": 1}]}]
