@@ -878,9 +878,29 @@ class Executor:
     def _expand_children(self, parent: ExecNode, children: list[GraphQuery],
                          src: np.ndarray):
         children = self._expand_expand(children, src)
-        for cgq in children:
-            node = self._process_child(cgq, src)
-            parent.children.append(node)
+        # dependency-ordered processing: a child consuming a var that a
+        # SIBLING subtree binds (facet var, deeper value var) must run
+        # after that sibling regardless of listing order — emission
+        # keeps the listed order. Unresolvable needs fall back to the
+        # listed order (outer blocks / genuinely-undefined vars).
+        nodes: dict[int, ExecNode] = {}
+        pending = list(enumerate(children))
+        while pending:
+            progressed = False
+            for i, cgq in list(pending):
+                unmet = [vc.name for vc in self._all_needs(cgq)
+                         if not self._var_defined(vc.name)
+                         and vc.name in getattr(self, "_block_vars", ())]
+                if not unmet:
+                    pending.remove((i, cgq))
+                    nodes[i] = self._process_child(cgq, src)
+                    progressed = True
+            if not progressed:
+                for i, cgq in pending:
+                    nodes[i] = self._process_child(cgq, src)
+                break
+        for i in range(len(children)):
+            parent.children.append(nodes[i])
 
     def _expand_expand(self, children: list[GraphQuery],
                        src: np.ndarray) -> list[GraphQuery]:
@@ -1115,6 +1135,11 @@ class Executor:
             for lg in langs:
                 if lg == ".":
                     return ps[0]
+                if lg == "*":
+                    # multi-key expansion happens in the emit paths;
+                    # single-posting consumers (var binding, sort
+                    # keys) fall back to any-language
+                    return ps[0]
                 for p in ps:
                     if p.lang == lg:
                         return p
@@ -1195,17 +1220,18 @@ class Executor:
             vc = gq.needs_var[0]
             vmap = self.value_vars.get(vc.name, {})
             src = node.src
-            vals = [vmap[u] for u in src.tolist() if u in vmap] \
-                if len(src) else list(vmap.values())
-            if not vals and vmap and \
-                    vc.name in getattr(self, "_block_vars", ()):
-                # the var was bound by a SIBLING subtree in this block
-                # (facet var / deeper-level value var), so it is keyed
-                # by descendant uids, not by this level's src —
-                # aggregate the whole map, dgraph's flat-variable
-                # semantics (ref query0_test.go
-                # TestLevelBasedFacetVarAggSum)
+            if vc.name in getattr(self, "_block_vars", ()):
+                # bound by this block's own subtree (facet var, deeper
+                # value var, same-level scalar var): the map's domain
+                # is already scoped by where it was bound — aggregate
+                # it whole, dgraph's flat-variable semantics (ref
+                # TestLevelBasedFacetVarAggSum; a same-level var's
+                # keys equal this level's src so whole == restricted)
                 vals = list(vmap.values())
+            else:
+                # outer-block var: restrict to this level's uids
+                vals = [vmap[u] for u in src.tolist() if u in vmap] \
+                    if len(src) else list(vmap.values())
             node.values[0] = [Agg(gq.agg_func, _aggregate(gq.agg_func, vals))]
         elif gq.math is not None:
             vmap = _eval_math(gq.math, self.value_vars)
@@ -1328,8 +1354,12 @@ class Executor:
         visited = frontier.copy()
         # uid vars bound inside @recurse accumulate every uid reached
         # via that predicate across ALL levels (ref query3_test.go
-        # TestRecurseVariable)
-        var_accum: dict[str, np.ndarray] = {}
+        # TestRecurseVariable); seeded empty so a recursion that
+        # reaches nothing still DEFINES the var (a consumer block must
+        # get [], not an undefined-variable error)
+        var_accum: dict[str, np.ndarray] = {
+            c.var: _EMPTY for c in gq.children
+            if not c.is_internal and c.var}
         for _ in range(depth):
             if not len(frontier):
                 break
@@ -1786,7 +1816,9 @@ class Executor:
                     for p in ps:
                         key = f"{cgq.attr}@{p.lang}" if p.lang \
                             else cgq.attr
-                        obj[cgq.alias or key] = to_json_value(
+                        # canonical per-language keys; an alias can't
+                        # name several keys, so it is ignored here
+                        obj[key] = to_json_value(
                             self._typed(ch.tablet, p))
                         emitted = True
                     if emitted:
@@ -2011,6 +2043,12 @@ class Executor:
             name = cgq.alias or cgq.attr
             if tab.schema.value_type != TypeID.UID:
                 ps = tab.get_postings(uid, self.read_ts)
+                if cgq.langs == ["*"]:
+                    for p in ps:
+                        key = f"{cgq.attr}@{p.lang}" if p.lang \
+                            else cgq.attr
+                        obj[key] = to_json_value(self._typed(tab, p))
+                    continue
                 sel = self._select_posting(ps, cgq.langs)
                 if sel is not None:
                     obj[name] = to_json_value(self._typed(tab, sel))
@@ -2054,16 +2092,14 @@ class Executor:
                                       and isinstance(v[0], dict))}]
         for k, v in obj.items():
             if isinstance(v, dict):
-                groups = [self._normalize(v)]
+                child_rows = self._normalize(v)
             elif isinstance(v, list) and v and isinstance(v[0], dict):
-                groups = [[r for item in v
-                           for r in self._normalize(item)]]
+                child_rows = [r for item in v
+                              for r in self._normalize(item)]
             else:
                 continue
-            for child_rows in groups:
-                if child_rows:
-                    rows = [{**r, **c} for r in rows
-                            for c in child_rows]
+            if child_rows:
+                rows = [{**r, **c} for r in rows for c in child_rows]
         return rows
 
 
