@@ -759,7 +759,33 @@ class Executor:
     def _eval_count_fn(self, fn: Function, candidates) -> np.ndarray:
         """gt(count(friend), 2) etc (ref task.go:1111 handleCompare +
         count index). Vectorized over the base count table; only
-        overlay-touched uids fall back to per-uid MVCC counting."""
+        overlay-touched uids fall back to per-uid MVCC counting.
+        count(~pred) counts incoming edges (ref query2_test.go
+        TestCountReverseFunc; needs @reverse)."""
+        if fn.attr.startswith("~"):
+            tab = self._tablet(fn.attr[1:])
+            if tab is None:
+                return self._count_zero_case(fn, candidates)
+            if not tab.schema.reverse:
+                raise GQLError(
+                    f"count(~{fn.attr[1:]}) needs @reverse on "
+                    f"{fn.attr[1:]!r}")
+            scan = candidates if candidates is not None else \
+                tab.dst_uids(self.read_ts)
+
+            def ok(n: int) -> bool:
+                if fn.name == "between":
+                    return int(fn.args[0].value) <= n <= \
+                        int(fn.args[1].value)
+                return _cmp(fn.name, n, int(fn.args[0].value))
+
+            keep = np.asarray(
+                [u for u in scan.tolist()
+                 if ok(len(tab.get_reverse_uids(int(u),
+                                                self.read_ts)))],
+                dtype=np.uint64)
+            keep.sort()
+            return keep
         tab = self._tablet(fn.attr)
         if tab is None:
             # every candidate has count 0: let the zero-case decide

@@ -626,3 +626,24 @@ def test_facet_var_sibling_aggregation():
     row = r["friend"][0]
     assert abs(row["sumw"] - 0.8) < 1e-9
     assert len(row["path"]) == 2
+
+
+def test_count_reverse_filter():
+    """count(~pred) counts incoming edges in root funcs and filters
+    (ref query2_test.go TestCountReverseFunc)."""
+    db2 = GraphDB(prefer_device=False)
+    db2.alter("name: string @index(exact) .\nfriend: [uid] @reverse @count .")
+    db2.mutate(set_nquads="\n".join([
+        '<0x1> <name> "M" .', '<0x17> <name> "Rick" .',
+        '<0x18> <name> "Glenn" .',
+        "<0x1> <friend> <0x17> .", "<0x1> <friend> <0x18> .",
+        "<0x18> <friend> <0x1> .",
+    ]))
+    r = data(db2.query('{ q(func: ge(count(~friend), 1)) { name } }'))
+    assert sorted(x["name"] for x in r["q"]) == ["Glenn", "M", "Rick"]
+    r = data(db2.query(
+        '{ q(func: has(name)) @filter(ge(count(~friend), 2)) { name } }'))
+    assert r["q"] == []
+    r = data(db2.query(
+        '{ q(func: eq(count(~friend), 1)) { name } }'))
+    assert sorted(x["name"] for x in r["q"]) == ["Glenn", "M", "Rick"]
