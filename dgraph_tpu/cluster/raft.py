@@ -24,23 +24,10 @@ raft.go MsgSnap path).
 
 from __future__ import annotations
 
-import pickle
 import random
 
-from dgraph_tpu.wire import WIRE_VERSION
 from dgraph_tpu.wire import dumps as wire_dumps
-from dgraph_tpu.wire import loads as wire_loads
-
-
-def _wire_load(blob: bytes):
-    """Wire-encoded (version-tagged) with a pickle fallback for
-    stores written before the wire format existed (PROTO opcode
-    0x80)."""
-    if blob[:1] == bytes([WIRE_VERSION]):
-        return wire_loads(blob)
-    if blob[:1] == b"\x80":
-        return pickle.loads(blob)
-    raise IOError("unrecognized raft storage encoding")
+from dgraph_tpu.wire import loads_compat as _wire_load
 
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional

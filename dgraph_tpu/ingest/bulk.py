@@ -20,7 +20,6 @@ reference's fixed writeTs (bulk/loader.go getWriteTimestamp).
 from __future__ import annotations
 
 import os
-import pickle
 import tempfile
 from typing import Iterable, Iterator, Optional
 
@@ -33,6 +32,8 @@ from dgraph_tpu.ingest.xidmap import XidMap
 from dgraph_tpu.models.schema import PredicateSchema
 from dgraph_tpu.models.types import TypeID, convert
 from dgraph_tpu.storage.tablet import Posting, Tablet
+from dgraph_tpu.wire import dumps as wire_dumps
+from dgraph_tpu.wire import loads as wire_loads
 
 _SPILL_EDGES = 2_000_000  # mapper buffer flush threshold
 
@@ -55,9 +56,9 @@ class _MapShard:
         path = os.path.join(
             self.tmpdir, f"map-{len(self.runs)}-{abs(hash(self.pred))}.run")
         with open(path, "wb") as f:
-            pickle.dump((np.asarray(self.src, np.uint64),
-                         np.asarray(self.dst, np.uint64),
-                         self.vals, self.facets), f)
+            f.write(wire_dumps((np.asarray(self.src, np.uint64),
+                                np.asarray(self.dst, np.uint64),
+                                self.vals, self.facets)))
         self.runs.append(path)
         self.src, self.dst, self.vals, self.facets = [], [], [], []
 
@@ -69,7 +70,7 @@ class _MapShard:
         facets = list(self.facets)
         for path in self.runs:
             with open(path, "rb") as f:
-                s, d, v, fc = pickle.load(f)
+                s, d, v, fc = wire_loads(f.read())
             srcs.append(s)
             dsts.append(d)
             vals.extend(v)

@@ -1,11 +1,16 @@
 """Pure-Python stand-in for native.NativeKV (same API) used only when
 the C++ runtime can't be built: dict + WAL-file persistence via the
-wire-compatible _PyWal framer."""
+wire-compatible _PyWal framer. Records and snapshots are wire-encoded
+(dgraph_tpu.wire) so a store written by this fallback stays readable by
+any build; pre-wire pickle payloads are replayed once via
+wire.loads_compat (the migration shim, tested in test_wire.py)."""
 
 from __future__ import annotations
 
 import os
-import pickle
+
+from dgraph_tpu.wire import dumps as wire_dumps
+from dgraph_tpu.wire import loads_compat as wire_loads_compat
 
 
 class PyKV:
@@ -17,21 +22,21 @@ class PyKV:
         snap = os.path.join(directory, "SNAPSHOT.py")
         if os.path.exists(snap):
             with open(snap, "rb") as f:
-                self._m = pickle.load(f)
+                self._m = wire_loads_compat(f.read())
         self._wal = _PyWal(os.path.join(directory, "WAL"), sync)
         for blob in self._wal.replay():
-            op, k, v = pickle.loads(blob)
+            op, k, v = wire_loads_compat(blob)
             if op == 0:
                 self._m[k] = v
             else:
                 self._m.pop(k, None)
 
     def put(self, key: bytes, val: bytes):
-        self._wal.append(pickle.dumps((0, key, val)))
+        self._wal.append(wire_dumps((0, key, val)))
         self._m[key] = val
 
     def delete(self, key: bytes):
-        self._wal.append(pickle.dumps((1, key, None)))
+        self._wal.append(wire_dumps((1, key, None)))
         self._m.pop(key, None)
 
     def get(self, key: bytes):
@@ -51,7 +56,7 @@ class PyKV:
     def snapshot(self):
         tmp = os.path.join(self._dir, "SNAPSHOT.py.tmp")
         with open(tmp, "wb") as f:
-            pickle.dump(self._m, f)
+            f.write(wire_dumps(self._m))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self._dir, "SNAPSHOT.py"))

@@ -4,24 +4,23 @@ The analogue of the reference bulk loader's output (a ready Badger p/
 directory, bulk/reduce.go writing SSTs) and the base artifact for
 backup/restore (ee/backup/) and Raft InstallSnapshot payloads
 (worker/snapshot.go doStreamSnapshot/populateSnapshot). Format: a
-pickle of schema text + per-tablet base arrays + coordinator counters;
-the file form is gzip-compressed with a magic header.
+wire-encoded payload of schema text + per-tablet base arrays +
+coordinator counters; the file form is gzip-compressed with a magic
+header.
 """
 
 from __future__ import annotations
 
 import gzip
 import os
-import pickle
 
 
 def _load_payload(blob: bytes):
-    """Wire-encoded (version byte 0x01) with pickle fallback for files
-    written before the wire format existed (PROTO opcode 0x80)."""
+    """Wire-encoded (version byte 0x01); files written before the wire
+    format existed fall back to wire.loads_compat, the one migration
+    shim."""
     from dgraph_tpu import wire
-    if blob[:1] == bytes([wire.WIRE_VERSION]):
-        return wire.loads(blob)
-    return pickle.loads(blob)
+    return wire.loads_compat(blob)
 
 SNAPSHOT_MAGIC = b"DGTPU-SNAP-1"
 

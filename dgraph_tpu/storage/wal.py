@@ -4,7 +4,7 @@ The reference persists every mutation through Badger's value log + Raft
 WAL (raftwal/storage.go over Badger). Here the framing, CRC validation,
 torn-tail truncation, and fsync policy live in the native C++ runtime
 (native/native.cc dgt_wal_*, bound via dgraph_tpu.native.NativeWal);
-records are pickled engine commit tuples. A pure-Python framer backs it
+records are wire-encoded engine commit tuples. A pure-Python framer backs it
 up when the native library cannot be built. Raft replication plugs in
 above this (cluster/), snapshotting truncates it (ref worker/draft.go:1206
 calculateSnapshot).
@@ -13,7 +13,6 @@ calculateSnapshot).
 from __future__ import annotations
 
 import os
-import pickle
 import struct
 from typing import Any, Iterator
 
@@ -111,15 +110,11 @@ class _PyWal:
 
 def _decode_record(blob: bytes) -> Any:
     """Records are wire-encoded (dgraph_tpu.wire, version-tagged first
-    byte); stores written before the wire format used pickle, whose
-    payloads start with the PROTO opcode 0x80 — replay those too so an
+    byte); stores written before the wire format existed used pickle —
+    wire.loads_compat (the one migration shim) replays those too so an
     upgrade never bricks a WAL."""
-    from dgraph_tpu.wire import WIRE_VERSION, loads
-    if blob[:1] == bytes([WIRE_VERSION]):
-        return loads(blob)
-    if blob[:1] == b"\x80":
-        return pickle.loads(blob)
-    raise IOError("unrecognized WAL record encoding")
+    from dgraph_tpu.wire import loads_compat
+    return loads_compat(blob)
 
 
 class Wal:

@@ -203,3 +203,32 @@ def test_sanitizer_harness_builds_and_passes(tmp_path):
     assert build.returncode == 0, build.stdout + build.stderr
     assert "all ok" in build.stdout
     assert "runtime error" not in build.stdout + build.stderr
+
+
+def test_pykv_replays_prewire_pickle_store(tmp_path):
+    """A PyKV store written before the wire migration (pickle WAL
+    records + pickle snapshot) still opens and replays; new writes are
+    wire-encoded from then on."""
+    import pickle
+
+    from dgraph_tpu.storage.kvfallback import PyKV
+    from dgraph_tpu.storage.wal import _PyWal
+
+    d = tmp_path / "kv"
+    d.mkdir()
+    (d / "SNAPSHOT.py").write_bytes(pickle.dumps({b"old": b"snap"}))
+    w = _PyWal(str(d / "WAL"))
+    w.append(pickle.dumps((0, b"k1", b"v1")))
+    w.append(pickle.dumps((1, b"old", None)))
+    w.close()
+
+    kv = PyKV(str(d))
+    assert kv.get(b"k1") == b"v1"
+    assert kv.get(b"old") is None
+    kv.put(b"k2", b"v2")
+    kv.snapshot()
+    kv.close()
+
+    kv2 = PyKV(str(d))
+    assert kv2.get(b"k1") == b"v1" and kv2.get(b"k2") == b"v2"
+    kv2.close()
