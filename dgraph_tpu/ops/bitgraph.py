@@ -57,6 +57,10 @@ class RevBucket:
     weights: Optional[jax.Array]     # [M, D] int32 or None
     degree: int
     offset: int
+    # host copy of in_nb, kept so build_core_adjacency can re-derive
+    # (dst, src) pairs without a device->host transfer (the tunnel
+    # makes that expensive); None for buckets built before this field
+    in_nb_host: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -165,7 +169,8 @@ def build_bitadjacency(edges: dict[int, np.ndarray],
             warr = np.zeros((m, c), np.int32)
             warr[dst_slot[sel] - offset, pos[sel]] = w_all[sel]
             wb = jnp.asarray(warr)
-        buckets.append(RevBucket(jnp.asarray(nb), wb, c, offset))
+        buckets.append(RevBucket(jnp.asarray(nb), wb, c, offset,
+                                 in_nb_host=nb))
         offset += m
 
     order = np.argsort(slot_uids, kind="stable")
@@ -285,17 +290,25 @@ def uids_to_bits_batched(badj: BitAdjacency,
     out = np.zeros((badj.n_slots + 1, W), np.uint32)
     if badj.n_slots == 0 or B == 0:
         return out
-    # one vectorized pass over all (query, uid) pairs
-    lens = np.fromiter((len(s) for s in seed_lists), np.int64, B)
-    if lens.sum() == 0:
-        return out
-    u = np.concatenate([np.asarray(s, np.uint32) for s in seed_lists])
-    q = np.repeat(np.arange(B, dtype=np.int64), lens)
-    slots, hit = _uid_slots(badj, u)
-    q = q[hit]
+    q, slots = _flat_query_slots(badj, seed_lists)
     np.bitwise_or.at(out, (slots, q // 32),
                      (np.uint32(1) << (q % 32).astype(np.uint32)))
     return out
+
+
+def _flat_query_slots(badj: BitAdjacency, seed_lists: list[np.ndarray]
+                      ) -> tuple[np.ndarray, np.ndarray]:
+    """One vectorized pass over all (query, uid) pairs -> aligned
+    (query index, slot) arrays with unknown uids dropped. Shared by the
+    bitmap and seed-slot packers."""
+    B = len(seed_lists)
+    lens = np.fromiter((len(s) for s in seed_lists), np.int64, B)
+    if lens.sum() == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int32)
+    u = np.concatenate([np.asarray(s, np.uint32) for s in seed_lists])
+    q = np.repeat(np.arange(B, dtype=np.int64), lens)
+    slots, hit = _uid_slots(badj, u)
+    return q[hit], slots
 
 
 def bits_to_uids_batched(badj: BitAdjacency, packed: np.ndarray,
@@ -307,6 +320,21 @@ def bits_to_uids_batched(badj: BitAdjacency, packed: np.ndarray,
         bits = (packed[:, q // 32] >> np.uint32(q % 32)) & np.uint32(1)
         out.append(np.sort(badj.slot_uids[bits.astype(bool)]))
     return out
+
+
+def _gather_or(f: jax.Array, in_nb: jax.Array, degree: int) -> jax.Array:
+    """OR of gathered frontier rows over the degree axis, in chunks of
+    <=8 so no [M, D, W] intermediate is materialized and the unroll
+    stays bounded for the huge-degree hub buckets."""
+    Dc = next(c for c in (8, 6, 4, 3, 2, 1) if degree % c == 0)
+    M = in_nb.shape[0]
+    nb = in_nb.reshape(M * (degree // Dc), Dc)
+    acc = f[nb[:, 0]]
+    for d in range(1, Dc):
+        acc = acc | f[nb[:, d]]
+    if degree > Dc:
+        acc = jnp.bitwise_or.reduce(acc.reshape(M, degree // Dc, -1), axis=1)
+    return acc
 
 
 def make_bfs_bits_batched(badj: BitAdjacency, depth: int,
@@ -338,19 +366,7 @@ def make_bfs_bits_batched(badj: BitAdjacency, depth: int,
             from dgraph_tpu.ops.pallas_kernels import bucket_or_pallas
             return bucket_or_pallas(f, b.in_nb,
                                     interpret=pallas_interpret)
-        # OR of gathered frontier rows over the degree axis, in chunks
-        # of <=8 so no [M, D, W] intermediate is materialized and the
-        # unroll stays bounded for the huge-degree hub buckets
-        Dc = next(c for c in (8, 6, 4, 3, 2, 1) if b.degree % c == 0)
-        M = b.in_nb.shape[0]
-        nb = b.in_nb.reshape(M * (b.degree // Dc), Dc)
-        acc = f[nb[:, 0]]
-        for d in range(1, Dc):
-            acc = acc | f[nb[:, d]]
-        if b.degree > Dc:
-            acc = jnp.bitwise_or.reduce(
-                acc.reshape(M, b.degree // Dc, -1), axis=1)
-        return acc
+        return _gather_or(f, b.in_nb, b.degree)
 
     def level(f):
         parts = [bucket_or(f, b) for b in badj.buckets]
@@ -398,6 +414,174 @@ def make_frontier_counts_batched(n_queries: int) -> Callable:
         return stacked.reshape(-1)[:n_queries]
 
     return counts
+
+
+# -- core-space digest kernels -----------------------------------------------
+#
+# At reference scale (21M edges over 2M nodes) the bitmap memory
+# [N+1, W] caps the query batch — and QPS is proportional to W because
+# the gather unit is descriptor-bound (row width is nearly free). Two
+# structural facts about any graph break that cap:
+#   1. only slots with in-degree > 0 can appear in levels >= 1, and
+#      those slots are a PREFIX of slot space by construction
+#      (n_covered) — measured 27% of slots on the zipf bench graph;
+#   2. only edges whose SOURCE is itself covered can contribute to
+#      levels >= 2 — 27% of edges on the same graph.
+# So level 1 runs once over the full adjacency into core space
+# [n_covered+1, W], and deeper levels run entirely in core space with a
+# re-bucketed core adjacency: ~3.7x less bitmap HBM and ~3.7x fewer
+# gather descriptors per deep level, which buys back the batch width.
+
+
+@dataclass
+class CoreAdjacency:
+    """Reverse adjacency restricted to covered->covered edges, in its
+    own ROW space.
+
+    Every covered slot owns exactly one row (slots with no covered
+    in-neighbor sit in the cap-1 bucket gathering only the dummy), rows
+    grouped by core-degree class — so the per-bucket concat order IS
+    the core frontier layout and deep levels need no permutation.
+    in_nb entries are ROW POSITIONS of source slots (dummy = n_core);
+    `row_slots[r]` is the covered slot living in row r, used once at
+    the level-1 boundary to permute slot-ordered bitmaps into row
+    order."""
+
+    buckets: list[RevBucket]
+    row_slots: jax.Array             # [n_core] int32
+    n_core: int
+
+
+def build_core_adjacency(badj: BitAdjacency) -> CoreAdjacency:
+    """Derive the covered->covered re-bucketed adjacency from the full
+    buckets' host copies (no device transfer)."""
+    ncov = badj.n_covered
+    if ncov == 0 or not badj.buckets:
+        return CoreAdjacency([], jnp.zeros((0,), jnp.int32), ncov)
+    dsts, srcs = [], []
+    for b in badj.buckets:
+        nb = b.in_nb_host if b.in_nb_host is not None \
+            else np.asarray(b.in_nb)
+        rr, cc = np.nonzero(nb < ncov)       # covered sources only
+        dsts.append((rr + b.offset).astype(np.int64))
+        srcs.append(nb[rr, cc])
+    dst = np.concatenate(dsts)
+    src = np.concatenate(srcs)
+    indeg = np.bincount(dst, minlength=ncov)
+    # every covered slot gets a row; 0-degree rows take cap 1 (one
+    # dummy gather each — cheap, and it keeps row space == covered set)
+    cap_all = _LADDER[np.searchsorted(_LADDER, np.maximum(indeg, 1))]
+    order = np.lexsort((np.arange(ncov), cap_all))
+    row_slots = order.astype(np.int32)       # row -> slot
+    caps_o = cap_all[order]
+    pos_of = np.empty(ncov, np.int64)        # slot -> row
+    pos_of[order] = np.arange(ncov)
+    rp = pos_of[dst]
+    eorder = np.argsort(rp, kind="stable")
+    rp, srco = rp[eorder], pos_of[src[eorder]]   # sources in ROW space
+    starts = np.zeros(ncov + 1, np.int64)
+    np.cumsum(np.bincount(rp, minlength=ncov), out=starts[1:])
+    posin = np.arange(len(srco), dtype=np.int64) - starts[rp]
+    buckets: list[RevBucket] = []
+    offset = 0
+    for c in np.unique(caps_o):
+        c = int(c)
+        m = int(np.sum(caps_o == c))
+        nb = np.full((m, c), ncov, np.int32)
+        sel = (rp >= offset) & (rp < offset + m)
+        nb[rp[sel] - offset, posin[sel]] = srco[sel]
+        # no in_nb_host: nothing re-derives edges from a CoreAdjacency,
+        # so pinning the host copy would only hold memory
+        buckets.append(RevBucket(jnp.asarray(nb), None, c, offset))
+        offset += m
+    return CoreAdjacency(buckets, jnp.asarray(row_slots), ncov)
+
+
+def uid_lists_to_seed_slots(badj: BitAdjacency,
+                            seed_lists: list[np.ndarray],
+                            n_seeds: int | None = None) -> np.ndarray:
+    """[B seed uid arrays] -> int32[B, S] slot matrix for the digest
+    kernel; unknown uids and padding map to the dummy slot n_slots.
+    Deduplicates (query, slot) pairs so the kernel's scatter-ADD packing
+    is an exact OR. A query with more than S distinct known seeds is an
+    error — silent truncation would answer a different query."""
+    B = len(seed_lists)
+    S = n_seeds if n_seeds is not None else \
+        max((len(s) for s in seed_lists), default=1)
+    out = np.full((B, max(S, 1)), badj.n_slots, np.int32)
+    if badj.n_slots == 0 or B == 0:
+        return out
+    q, slots = _flat_query_slots(badj, seed_lists)
+    if not len(q):
+        return out
+    pairs = np.unique((q << 32) | slots.astype(np.int64))
+    q, slots = pairs >> 32, pairs & 0xFFFFFFFF
+    starts = np.zeros(B + 1, np.int64)
+    np.cumsum(np.bincount(q, minlength=B), out=starts[1:])
+    pos = np.arange(len(q), dtype=np.int64) - starts[q]
+    if pos.max(initial=-1) >= out.shape[1]:
+        over = int(q[pos >= out.shape[1]][0])
+        raise ValueError(
+            f"query {over} has {int((q == over).sum())} distinct seeds "
+            f"> n_seeds={out.shape[1]}")
+    out[q, pos] = slots.astype(np.int32)
+    return out
+
+
+def make_bfs_digest_batched(badj: BitAdjacency, core: CoreAdjacency,
+                            depth: int, n_queries: int,
+                            n_seeds: int) -> Callable:
+    """Compile the serving-shape BFS: int32[B, S] seed slots ->
+    (uint32[depth] per-level popcount checksums,
+     uint32[n_core+1, 1] final level's first word column).
+
+    The packed frontier is built ON DEVICE (scatter-add of one bit per
+    (query, seed)) so only the [B, S] slot matrix crosses the host link
+    per batch — never an [N, W] bitmap. Level 1 gathers the full
+    adjacency once; every deeper level runs in core slot space. Only
+    frontier+visited (+ the level's reach) are live — no per-level
+    bitmap pile-up, which is what held BENCH_BATCH at 8192 on a 16GB
+    chip (ref regime: worker/task.go:581 fan-out at systest/21million
+    scale). The first-word column ships ~n_core*4 bytes so the caller
+    can parity-check queries 0..31 via make_frontier_counts_batched
+    without pulling a full bitmap."""
+    N, ncov = badj.n_slots, badj.n_covered
+    W = (n_queries + 31) // 32
+
+    def digest(seed_slots: jax.Array):
+        q = jnp.arange(n_queries, dtype=jnp.uint32)
+        bit = jnp.uint32(1) << (q % jnp.uint32(32))
+        word = (q // jnp.uint32(32)).astype(jnp.int32)
+        f = jnp.zeros((N + 1, W), jnp.uint32)
+        f = f.at[seed_slots.reshape(-1),
+                 jnp.repeat(word, n_seeds)].add(jnp.repeat(bit, n_seeds))
+        f = f.at[N].set(jnp.uint32(0))   # dummy slot absorbs padding
+        zrow = jnp.zeros((1, W), jnp.uint32)
+        if badj.buckets:
+            parts = [_gather_or(f, b.in_nb, b.degree)
+                     for b in badj.buckets]
+            reach1 = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        else:
+            reach1 = jnp.zeros((ncov, W), jnp.uint32)
+        seeds_core = f[:ncov]
+        new = reach1 & ~seeds_core
+        sums = [jnp.sum(jax.lax.population_count(new), dtype=jnp.uint32)]
+        # one boundary permutation into core ROW space; every deeper
+        # level's bucket-concat then IS the next frontier layout
+        vis_s = seeds_core | new
+        frontier = jnp.concatenate([new[core.row_slots], zrow])
+        visited = jnp.concatenate([vis_s[core.row_slots], zrow])
+        for _ in range(depth - 1):
+            parts = [_gather_or(frontier, b.in_nb, b.degree)
+                     for b in core.buckets]
+            reach = jnp.concatenate(parts + [zrow])
+            frontier = reach & ~visited
+            visited = visited | frontier
+            sums.append(jnp.sum(jax.lax.population_count(frontier),
+                                dtype=jnp.uint32))
+        return jnp.stack(sums), frontier[:, :1]
+
+    return jax.jit(digest)
 
 
 def bfs_bits_reach_batched(badj: BitAdjacency,

@@ -203,9 +203,14 @@ def intersect_many(mat: jax.Array) -> jax.Array:
 
 
 def first_k(a: jax.Array, k: int, offset: int = 0) -> jax.Array:
-    """Pagination: first k valid UIDs after `offset`. Ref algo.IndexOf-based
-    windowing used by query pagination (query/query.go:2231).  The input is
-    compact-sorted so this is a lax.dynamic_slice in disguise; with static
-    offset it is a plain slice."""
-    sl = jax.lax.dynamic_slice_in_dim(a, offset, min(k, a.shape[0]))
-    return sl
+    """Pagination: the k-wide window after `offset` of a compact-sorted
+    vector, SENTINEL-padded when the window runs off the end — never
+    clamped backwards (lax.dynamic_slice clamps its start, which would
+    duplicate the previous page's uids on the final page). Ref
+    algo.IndexOf-based windowing in query pagination (query.go:2231)."""
+    take = max(0, min(k, a.shape[0] - offset))
+    pad = jnp.full((k - take,), SENTINEL, a.dtype)
+    if not take:
+        return pad
+    sl = jax.lax.slice_in_dim(a, offset, offset + take)
+    return jnp.concatenate([sl, pad]) if k > take else sl

@@ -28,7 +28,9 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from dgraph_tpu.ops.uidvec import SENTINEL, compact, member_mask, pad_to
+from dgraph_tpu.ops.uidvec import (
+    SENTINEL, compact, first_k, member_mask, pad_to,
+)
 from dgraph_tpu.parallel.dist_graph import ShardedAdjacency, \
     build_sharded_adjacency
 
@@ -100,7 +102,7 @@ def _expand_local(frontier, srcs_l, nbs_l, level_cap):
 
 
 def make_dist_query_step(mesh: Mesh, stack: TabletStack, batch: int,
-                         seed_size: int):
+                         seed_size: int, page: tuple[int, int] | None = None):
     """Compile the canonical distributed query step.
 
     fn(seeds [batch, seed_size]) -> counts [batch] int32 where
@@ -109,6 +111,13 @@ def make_dist_query_step(mesh: Mesh, stack: TabletStack, batch: int,
     friends").  With tablet axis size t, each shard expands through its
     local predicates and the all_gather unions them — any t divides
     the predicate work.
+
+    With page=(offset, k) the step ALSO returns the paginated uid page
+    [batch, k] of each query's result (uidvec.first_k on device — the
+    reference's applyOrderAndPagination window, query/query.go:2231,
+    applied before anything ships to the host), so a "first: k,
+    offset: o" query transfers k uids per query instead of the whole
+    compact result vector.
     """
     t_size = mesh.shape["tablet"]
     assert stack.n_tablets % t_size == 0 or stack.n_tablets <= t_size, \
@@ -135,12 +144,16 @@ def make_dist_query_step(mesh: Mesh, stack: TabletStack, batch: int,
             direct = _expand_local(seed_row, my_srcs, my_nbs, level_cap)
             both = compact(jnp.where(member_mask(hop2, direct), hop2,
                                      SENTINEL))
-            return jnp.sum(both != SENTINEL, dtype=jnp.int32)
+            n = jnp.sum(both != SENTINEL, dtype=jnp.int32)
+            if page is None:
+                return n
+            return n, first_k(both, page[1], page[0])
 
         return jax.vmap(one_query)(seeds)
 
+    out_specs = P("data") if page is None else (P("data"), P("data"))
     smapped = shard_map(step, mesh=mesh, in_specs=tuple(in_specs),
-                        out_specs=P("data"), check_vma=False)
+                        out_specs=out_specs, check_vma=False)
 
     def fn(seeds):
         args = []

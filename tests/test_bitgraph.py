@@ -172,3 +172,65 @@ def test_batched_counts_on_device():
     (lvl1,) = fn(jnp.asarray(packed))
     counts = make_frontier_counts_batched(3)(lvl1)
     assert counts.tolist() == [2, 1, 0]
+
+
+def test_digest_matches_levels_kernel():
+    """The core-space digest program (on-device seed packing, level 1
+    over the full adjacency, deeper levels in covered-slot space) must
+    produce exactly the per-level new-node counts of the reference
+    batched kernel, and its final first-word column must feed
+    make_frontier_counts_batched for per-query parity."""
+    import jax.numpy as jnp
+
+    from dgraph_tpu.ops.bitgraph import (
+        bfs_bits_reach_batched, build_core_adjacency,
+        make_bfs_digest_batched, make_frontier_counts_batched,
+        uid_lists_to_seed_slots,
+    )
+
+    rng = np.random.default_rng(11)
+    edges = random_edges(n_nodes=600, n_edges=5000, seed=11)
+    badj = build_bitadjacency(edges)
+    core = build_core_adjacency(badj)
+    assert core.n_core == badj.n_covered
+    B, S, depth = 50, 4, 3
+    all_uids = np.arange(1, 601, dtype=np.uint32)
+    seeds = [np.sort(rng.choice(all_uids, S, replace=False))
+             for _ in range(B)]
+    seeds[7] = np.asarray([9999], np.uint32)      # unknown uid -> empty
+    seeds[8] = np.empty(0, np.uint32)             # empty seed set
+
+    want = bfs_bits_reach_batched(badj, seeds, depth)
+    slot_mat = uid_lists_to_seed_slots(badj, seeds, S)
+    fn = make_bfs_digest_batched(badj, core, depth, B, S)
+    sums, col0 = fn(jnp.asarray(slot_mat))
+
+    for lvl in range(depth):
+        assert int(sums[lvl]) == sum(len(want[q][lvl]) for q in range(B))
+    counts = make_frontier_counts_batched(32)(col0)
+    for q in range(32):
+        assert int(counts[q]) == len(want[q][depth - 1]), q
+
+
+def test_digest_depth1_and_empty_graph():
+    import jax.numpy as jnp
+
+    from dgraph_tpu.ops.bitgraph import (
+        build_core_adjacency, make_bfs_digest_batched,
+        uid_lists_to_seed_slots,
+    )
+
+    edges = {1: np.asarray([2, 3], np.uint32)}
+    badj = build_bitadjacency(edges)
+    core = build_core_adjacency(badj)
+    seeds = [np.asarray([1], np.uint32)]
+    fn = make_bfs_digest_batched(badj, core, 1, 1, 1)
+    sums, _ = fn(jnp.asarray(uid_lists_to_seed_slots(badj, seeds, 1)))
+    assert sums.tolist() == [2]
+
+    ebadj = build_bitadjacency({})
+    ecore = build_core_adjacency(ebadj)
+    fn = make_bfs_digest_batched(ebadj, ecore, 2, 1, 1)
+    sums, _ = fn(jnp.asarray(
+        uid_lists_to_seed_slots(ebadj, seeds, 1)))
+    assert sums.tolist() == [0, 0]
