@@ -127,6 +127,14 @@ class Coordinator:
             self._active[start_ts] = st
             return st
 
+    # when set, commit decisions come from the cluster's Zero quorum
+    # (fn(start_ts, sorted_keys) -> commit_ts, 0 = conflict abort) so
+    # EVERY group's transactions share one global conflict oracle —
+    # exactly the reference, where all commits flow through Zero
+    # (zero/oracle.go:326). The decision is mirrored into the local
+    # window so replica-side checks stay consistent.
+    commit_source_fn = None
+
     def commit(self, txn: TxnState, conflict_keys: set) -> int:
         """Conflict-check and commit; returns commit_ts.
         Raises TxnAborted on conflict (ref zero/oracle.go:326 s.commit)."""
@@ -134,6 +142,21 @@ class Coordinator:
             st = self._active.get(txn.start_ts)
             if st is None or st.aborted:
                 raise TxnAborted(f"txn {txn.start_ts} not active")
+            if self.commit_source_fn is not None:
+                commit_ts = self.commit_source_fn(
+                    txn.start_ts, sorted(int(k) for k in conflict_keys))
+                del self._active[txn.start_ts]
+                if not commit_ts:
+                    st.aborted = True
+                    raise TxnAborted(
+                        f"zero oracle aborted txn {txn.start_ts} "
+                        "(write-write conflict)")
+                self._ts = max(self._ts, commit_ts)
+                for key in conflict_keys:
+                    if commit_ts > self._commits.get(key, 0):
+                        self._commits[key] = commit_ts
+                st.committed = True
+                return commit_ts
             for key in conflict_keys:
                 last = self._commits.get(key, 0)
                 if last > txn.start_ts:

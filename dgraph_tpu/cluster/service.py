@@ -479,6 +479,9 @@ class AlphaServer(RaftServer):
         # leader and die on leader change (clients retry)
         self._txns: dict[int, Any] = {}
         self._txn_touched: dict[int, float] = {}
+        # stage time of replicated cross-group fragments, for TTL-based
+        # reconciliation against zero's decision registry
+        self._xstage_touched: dict[int, float] = {}
         # multi-group mode: a Zero quorum owns the tablet map and the
         # uid space; this alpha claims tablets, checks ownership before
         # every write, and leases uid blocks (ref worker/groups.go
@@ -500,6 +503,11 @@ class AlphaServer(RaftServer):
                             kw.get("election_ticks", 10) / 3)
             self._zero_ts = ClusterClient(zero_addrs, timeout=ts_budget)
             self.db.coordinator.ts_source_fn = self._zero_ts.assign_ts
+            # ALL commit decisions flow through zero's oracle in
+            # multi-group mode — one global conflict window, so
+            # single-group and cross-group transactions see each
+            # other (ref zero/oracle.go:326: every commit is zero's)
+            self.db.coordinator.commit_source_fn = self._zero_ts.commit
         # committed event stream: authoritative rebuild source
         self._events: list[tuple] = []
         # serializes execute+propose so the log's record order matches
@@ -668,6 +676,60 @@ class AlphaServer(RaftServer):
                 if txn is not None:
                     self.db.discard(txn)
 
+    def _reconcile_pending(self, upto_ts: int | None = None,
+                           evict_older_s: float | None = None):
+        """Resolve replicated cross-group stages against zero's
+        decision registry (ref posting/oracle.go ProcessDelta: alphas
+        learn commit decisions they missed). With upto_ts, every
+        DECIDED txn whose commit could be <= upto_ts must be applied
+        before a pinned read at upto_ts (undecided txns are safe: zero
+        would assign them a commit_ts issued after upto_ts). With
+        evict_older_s, undecided stages older than the TTL are aborted
+        THROUGH zero (abort_txn records the decision, so a slow
+        coordinator can't later commit what we evicted)."""
+        if self.zero is None:
+            return
+        now = time.time()
+        with self.lock:
+            pend = [ts for ts in self.db.pending_txns
+                    if upto_ts is None or ts < upto_ts]
+            # a stage inherited via raft replay/snapshot (the staging
+            # leader died) starts its TTL clock at first sight here
+            ages = {st: now - self._xstage_touched.setdefault(st, now)
+                    for st in pend}
+        for st in pend:
+            if upto_ts is None and evict_older_s is not None \
+                    and ages[st] <= evict_older_s:
+                continue  # young and nobody is waiting: no zero RPC
+            try:
+                got = self.zero.request({"op": "txn_status",
+                                         "args": (st,)})
+                if not got.get("ok"):
+                    continue
+                status = got["result"]
+                if not status["decided"]:
+                    if evict_older_s is None or \
+                            ages[st] <= evict_older_s:
+                        continue
+                    if st < status.get("floor", 0):
+                        # zero trimmed this ts range: the decision is
+                        # unknowable, and recording an abort could
+                        # contradict a commit another group applied.
+                        # Keep the stage pending (operator-visible)
+                        # rather than guess.
+                        continue
+                    final = self.zero.request(
+                        {"op": "abort_txn", "args": (st,)})
+                    if not final.get("ok"):
+                        continue
+                    status = {"commit_ts": final["result"]}
+                self._replicate_record(
+                    ("xfinalize", st, status["commit_ts"]))
+                with self.lock:
+                    self._xstage_touched.pop(st, None)
+            except Exception:  # noqa: BLE001 — next pass retries
+                continue
+
     def _read_barrier(self):
         """Linearizable-read barrier for pinned reads (raft §8): a
         freshly elected leader may hold committed-but-unapplied entries
@@ -775,6 +837,56 @@ class AlphaServer(RaftServer):
                     self._rebuild_from_events()
                 raise RuntimeError("record not replicated (no quorum)")
 
+    def _run_task(self, req: dict, read_ts: int):
+        """Dispatch one federated task kind against the local tablet.
+        Caller holds _write_lock + lock with leadership verified."""
+        kind = req["kind"]
+        if kind == "schema_state":
+            return self.db.schema.describe_all()
+        tab = self.db.tablets.get(req["pred"])
+        if tab is None:
+            return None
+        uids = req.get("uids")
+        rev = bool(req.get("reverse"))
+        if kind == "edges":
+            get = tab.get_reverse_uids if rev else tab.get_dst_uids
+            return [get(int(u), read_ts) for u in uids.tolist()]
+        if kind == "postings":
+            return [tab.get_postings(int(u), read_ts)
+                    for u in uids.tolist()]
+        if kind == "expand":
+            return tab.expand_frontier(uids, read_ts, rev)
+        if kind == "src_uids":
+            return tab.src_uids(read_ts)
+        if kind == "dst_uids":
+            return tab.dst_uids(read_ts)
+        if kind == "index":
+            return [tab.index_uids(bytes(t), read_ts)
+                    for t in req["tokens"]]
+        if kind == "counts":
+            if rev:
+                return [len(tab.get_reverse_uids(int(u), read_ts))
+                        for u in uids.tolist()]
+            return [tab.count_of(int(u), read_ts)
+                    for u in uids.tolist()]
+        if kind == "count_table":
+            # the proxy's dirty() is False (the overlay never leaves
+            # this group), so this table must be MVCC-exact at read_ts
+            # — not the base-only fast table the local path splits
+            # against its own overlay
+            import numpy as _np
+            srcs = tab.src_uids(read_ts)
+            cnts = _np.asarray(
+                [tab.count_of(int(u), read_ts) for u in srcs.tolist()],
+                _np.int64)
+            return (srcs, cnts)
+        if kind == "facets":
+            return [tab.get_facets(int(s), int(d), read_ts)
+                    for s, d in req["pairs"]]
+        if kind == "sort_key_pairs":
+            return tab.sort_key_pairs()
+        raise ValueError(f"unknown task kind {kind!r}")
+
     # ----------------------------------------------------------------- RPC
 
     def handle_request(self, req: dict) -> dict:
@@ -805,6 +917,11 @@ class AlphaServer(RaftServer):
                 # lock is fully replicated by the time we read — still
                 # a consistent snapshot at read_ts.
                 self._read_barrier()
+                # AFTER the barrier (so a just-elected leader has
+                # applied its inherited log first): decided-but-
+                # unapplied cross-group commits <= read_ts must land
+                # before this snapshot is served
+                self._reconcile_pending(upto_ts=read_ts)
                 with self._write_lock:
                     with self.lock:
                         if self.node.role != LEADER:
@@ -915,6 +1032,61 @@ class AlphaServer(RaftServer):
             return {"ok": True, "result": {
                 "extensions": {"txn": {"start_ts": start_ts,
                                        "commit_ts": commit_ts}}}}
+        if op == "task":
+            # one attr-level task of a federated query (ref
+            # worker/task.go:131 ProcessTaskOverNetwork landing on the
+            # serving group): leader-only snapshot read at a global
+            # read_ts. The first task of a query pays the quorum read
+            # barrier; every task reconciles decided cross-group
+            # commits <= read_ts first.
+            read_ts = int(req.get("read_ts", 0))
+            # EVERY task pays the quorum barrier: the client's leader
+            # can change mid-query, and a once-per-query (or cached
+            # per-term) barrier would let a fresh or partitioned
+            # ex-leader serve committed-but-unapplied state. Barrier
+            # first, then reconcile decided cross-group commits.
+            self._read_barrier()
+            self._reconcile_pending(upto_ts=read_ts)
+            with self._write_lock:
+                with self.lock:
+                    if self.node.role != LEADER:
+                        raise NotLeader(self.node.leader_id)
+                    return {"ok": True,
+                            "result": self._run_task(req, read_ts)}
+        if op == "xstage":
+            # one group's fragment of a cross-group transaction,
+            # replicated at stage time so the 2PC stage survives
+            # leader changes (ref worker/mutation.go:432 proposeOrSend)
+            from dgraph_tpu.gql.nquad import nquad_from_wire
+            start_ts = int(req["start_ts"])
+            nqs = [(nquad_from_wire(t), bool(d)) for t, d in req["nqs"]]
+            preds = {nq.predicate for nq, _ in nqs}
+            with self._write_lock:
+                self._check_ownership(preds)
+                with self.lock:
+                    if self.node.role != LEADER:
+                        raise NotLeader(self.node.leader_id)
+                    staged, keys, schemas = self.db.xstage_ops(
+                        start_ts, nqs)
+            self._replicate_record(
+                ("xstage", start_ts, staged, schemas,
+                 sorted(int(k) for k in keys)))
+            self._xstage_touched[start_ts] = time.time()
+            # stale stages (coordinator died) reconcile via zero's
+            # decision registry on the same TTL as idle txns
+            self._reconcile_pending(evict_older_s=300.0)
+            return {"ok": True,
+                    "result": {"keys": sorted(int(k) for k in keys)}}
+        if op == "xfinalize":
+            start_ts = int(req["start_ts"])
+            commit_ts = int(req["commit_ts"])
+            with self.lock:
+                known = start_ts in self.db.pending_txns
+            if known:
+                self._replicate_record(
+                    ("xfinalize", start_ts, commit_ts))
+                self._xstage_touched.pop(start_ts, None)
+            return {"ok": True, "result": {"applied": known}}
         if op == "alter":
             self._replicate_write(lambda db: db.alter(**req["kw"]))
             return {"ok": True, "result": {}}
@@ -1012,7 +1184,8 @@ class ZeroServer(RaftServer):
                     "alphas": {k: dict(v)
                                for k, v in self.state.alphas.items()},
                     "tablets": dict(self.state.tablets)}}
-        if op in ("assign_ts", "assign_uids", "commit", "tablet",
+        if op in ("assign_ts", "assign_uids", "commit", "txn_status",
+                  "abort_txn", "tablet",
                   "tablet_move_start", "tablet_move_done",
                   "tablet_move_abort", "tablet_size", "tablet_sizes",
                   "connect"):

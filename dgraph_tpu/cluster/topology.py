@@ -11,12 +11,17 @@ predicates on first write (least-loaded group), refuses writes to
 tablets mid-move, and orchestrates live tablet moves
 (export -> import -> flip -> drop).
 
-Cross-group contract: a document whose top-level blocks touch
-different groups scatters block-wise and gathers (each block's result
-comes from its owning group). A SINGLE block spanning groups, or
-variables flowing between blocks on different groups, reject — those
-would need cross-group joins, which the predicate-sharded store does
-not do (mutations likewise must resolve to one group).
+Cross-group contract (all three tiers, fastest first):
+  1. every predicate on one group -> route the whole request there;
+  2. top-level blocks on different groups -> scatter block-wise at one
+     global read_ts and gather;
+  3. a single block spanning groups, or variables crossing groups ->
+     FEDERATED execution (cluster/federated.py): the unchanged query
+     executor runs here with per-attr task RPCs to each owning group
+     (ref worker/task.go:131 ProcessTaskOverNetwork).
+Mutations spanning groups run as one atomic transaction: per-group
+replicated stages + a single commit decision recorded in the Zero
+oracle (2PC; ref worker/mutation.go:472, zero/oracle.go:326).
 """
 
 from __future__ import annotations
@@ -27,13 +32,20 @@ from dgraph_tpu.cluster.client import ClusterClient
 
 
 class SpanGroupsError(RuntimeError):
-    """A request's predicates resolve to more than one group."""
+    """A request's predicates resolve to more than one group — the
+    signal (internal to this module) that the cross-group path must
+    run: block-wise scatter, federated execution, or a 2PC mutation."""
 
     def __init__(self, preds, owners):
         super().__init__(
             f"predicates {sorted(preds)} span groups {sorted(owners)}")
         self.preds = preds
         self.owners = owners
+
+
+class _NeedsFederation(Exception):
+    """Block-wise scatter can't serve this query (a single block spans
+    groups, or a variable crosses groups): run it federated."""
 
 
 class RoutedCluster:
@@ -118,8 +130,133 @@ class RoutedCluster:
             self.groups[gid].alter(schema_text, **kw)
 
     def mutate(self, **kw) -> dict:
-        gid = self._group_for(self._preds_of_mutation(kw), claim=True)
+        try:
+            gid = self._group_for(self._preds_of_mutation(kw),
+                                  claim=True)
+        except SpanGroupsError:
+            return self._mutate_multigroup(kw)
         return self.groups[gid].mutate(**kw)
+
+    def _mutate_multigroup(self, kw: dict) -> dict:
+        """One mutation split across groups, committed atomically
+        through Zero's oracle (2PC with Zero as transaction manager —
+        ref worker/mutation.go:472 populateMutationMap fanning
+        per-group fragments, zero/oracle.go:326 the single commit
+        decision):
+
+          1. blanks resolve to zero-leased uids BEFORE the split, so
+             every group names the same entities
+          2. each owning group replicates an xstage fragment at one
+             global start_ts and reports its conflict keys
+          3. zero's oracle decides (commit_ts or conflict abort) and
+             RECORDS the decision — a participant that misses the
+             finalize recovers it from zero (txn_status)
+          4. xfinalize applies each fragment at commit_ts
+        """
+        from dgraph_tpu.gql.nquad import (
+            nquad_to_wire, parse_json_mutation, parse_rdf,
+        )
+
+        if kw.get("query") or kw.get("mutations") or kw.get("cond"):
+            raise RuntimeError(
+                "a cross-group upsert/conditional mutation is not "
+                "supported; split it, or move the tablets together")
+        # caller-pinned start_ts: the read-modify-write flow reads its
+        # snapshot AT the txn's start_ts (pinned queries), so any
+        # commit that lands between read and commit conflicts properly
+        pinned_start = int(kw.get("start_ts", 0) or 0)
+        nqs = []
+        if kw.get("set_nquads"):
+            nqs += [(n, False) for n in parse_rdf(kw["set_nquads"])]
+        if kw.get("set_json") is not None:
+            nqs += [(n, False)
+                    for n in parse_json_mutation(kw["set_json"])]
+        if kw.get("del_nquads"):
+            nqs += [(n, True) for n in parse_rdf(kw["del_nquads"])]
+        if kw.get("delete_json") is not None:
+            nqs += [(n, True) for n in parse_json_mutation(
+                kw["delete_json"], delete=True)]
+        if any(nq.predicate == "*" for nq, _ in nqs):
+            raise RuntimeError(
+                "S * * wildcard deletes cannot span groups; delete "
+                "per predicate or move the tablets together")
+
+        # blanks -> one zero lease, substituted before the split
+        blanks: dict[str, int] = {}
+        for nq, _ in nqs:
+            for ref in (nq.subject, nq.object_id):
+                if ref and ref.startswith("_:"):
+                    blanks.setdefault(ref, 0)
+        if blanks:
+            first = self.zero.assign_uids(len(blanks))
+            for i, k in enumerate(sorted(blanks)):
+                blanks[k] = first + i
+
+        tmap = self.tablet_map()
+        by_group: dict[int, list] = {}
+        for nq, is_del in nqs:
+            if nq.subject in blanks or nq.object_id in blanks:
+                from dataclasses import replace as _rp
+                nq = _rp(nq,
+                         subject=hex(blanks[nq.subject])
+                         if nq.subject in blanks else nq.subject,
+                         object_id=hex(blanks[nq.object_id])
+                         if nq.object_id in blanks else nq.object_id)
+            gid = tmap["tablets"].get(nq.predicate)
+            if gid is None:
+                gid = self._group_for({nq.predicate}, claim=True,
+                                      tmap=tmap)
+                tmap["tablets"][nq.predicate] = gid
+            by_group.setdefault(gid, []).append(
+                (nquad_to_wire(nq), is_del))
+
+        start_ts = pinned_start or self.zero.assign_ts(1)
+        keys: set[int] = set()
+        staged: list[int] = []
+        try:
+            for gid in sorted(by_group):
+                res = self.groups[gid]._unwrap(self.groups[gid].request(
+                    {"op": "xstage", "start_ts": start_ts,
+                     "nqs": by_group[gid]}))
+                staged.append(gid)
+                keys.update(res["keys"])
+        except Exception:
+            # stage failed somewhere: record the abort at zero FIRST
+            # (so nothing can commit this ts later), then best-effort
+            # clear the fragments that did stage
+            try:
+                self.zero.request({"op": "abort_txn",
+                                   "args": (start_ts,)})
+            except Exception:  # noqa: BLE001
+                pass
+            self._xabort(staged, start_ts)
+            raise
+        commit_ts = self.zero.commit(start_ts, sorted(keys))
+        if not commit_ts:
+            self._xabort(staged, start_ts)
+            raise RuntimeError(
+                f"transaction aborted: write-write conflict at "
+                f"startTs {start_ts}")
+        for gid in staged:
+            try:
+                self.groups[gid].request(
+                    {"op": "xfinalize", "start_ts": start_ts,
+                     "commit_ts": commit_ts})
+            except Exception:  # noqa: BLE001 — the decision is
+                pass  # recorded; the group reconciles from zero
+        return {"uids": {k[2:]: hex(v) for k, v in blanks.items()},
+                "extensions": {"txn": {"start_ts": start_ts,
+                                       "commit_ts": commit_ts,
+                                       "groups": staged}}}
+
+    def _xabort(self, gids, start_ts: int):
+        for gid in gids:
+            try:
+                self.groups[gid].request(
+                    {"op": "xfinalize", "start_ts": start_ts,
+                     "commit_ts": 0})
+            except Exception:  # noqa: BLE001 — reconciliation covers it
+                pass
 
     def query(self, q: str, variables: Optional[dict] = None) -> dict:
         """Route to the owning group; when a document's top-level
@@ -139,9 +276,38 @@ class RoutedCluster:
         except SpanGroupsError:
             # one map drives both the span decision and the per-block
             # assignment — no second fetch, no TOCTOU between them
-            return self._scatter_query(q, variables, parsed,
-                                       tmap["tablets"])
+            try:
+                return self._scatter_query(q, variables, parsed,
+                                           tmap["tablets"])
+            except _NeedsFederation:
+                # a single block spans groups / a var crosses groups:
+                # run the full executor here with per-attr task RPCs
+                # to each owning group (ref worker/task.go:131)
+                return self._federated_query(q, variables,
+                                             tmap["tablets"])
         return self.groups[gid].query(q, variables)
+
+    def _federated_query(self, q: str, variables: Optional[dict],
+                         tmap: dict) -> dict:
+        from dgraph_tpu.cluster.federated import FederatedDB
+
+        read_ts = self.zero.assign_ts(1)
+        fdb = FederatedDB(self.groups, tmap, "", read_ts)
+        # schema from every group: on-the-fly predicates exist only on
+        # their owning group, so no single group has the whole picture
+        for gid in sorted(self.groups):
+            try:
+                text = fdb._task(gid, {"op": "task",
+                                       "kind": "schema_state",
+                                       "read_ts": read_ts})
+                if text:
+                    fdb.schema.apply_text(text)
+            except RuntimeError:
+                continue  # group down: its tablets will error if used
+        out = fdb.query(q, variables)
+        out.setdefault("extensions", {})["federated"] = True
+        out["extensions"]["read_ts"] = read_ts
+        return out
 
     def _scatter_query(self, q: str, variables: Optional[dict],
                        parsed, tmap: dict) -> dict:
@@ -156,18 +322,12 @@ class RoutedCluster:
             bpreds = {p.lstrip("~") for p in block_predicates(gq)}
             owners = {tmap[p] for p in bpreds if p in tmap}
             if len(owners) > 1:
-                raise RuntimeError(
-                    f"block {gq.alias!r} touches predicates from "
-                    f"groups {sorted(owners)}; move the tablets "
-                    "together to join them")
+                raise _NeedsFederation(gq.alias)
             gid = owners.pop() if owners else min(self.groups)
             for vc in self._block_var_uses(gq):
                 home = var_home.get(vc)
                 if home is not None and home != gid:
-                    raise RuntimeError(
-                        f"variable {vc!r} crosses groups {home} and "
-                        f"{gid}; cross-group variables are not "
-                        "supported — move the tablets together")
+                    raise _NeedsFederation(vc)
                 var_home[vc] = gid
             assign.append((gid, gq))
 

@@ -12,6 +12,17 @@ Commands (payload = (op, args)):
   ("assign_ts",  (n,))                -> first ts of a block of n
   ("assign_uids",(n,))                -> first uid of a lease of n
   ("commit",     (start_ts, keys))    -> commit_ts, or 0 = conflict abort
+                                         (idempotent: a decided txn
+                                         returns its recorded outcome)
+  ("txn_status", (start_ts,))         -> {"decided": bool, "commit_ts": n}
+                                         — 2PC participants recover a
+                                         crashed coordinator's decision
+                                         (ref zero/oracle.go delta
+                                         stream to alphas)
+  ("abort_txn",  (start_ts,))         -> final decision for start_ts:
+                                         records an abort unless the
+                                         txn already committed (safe
+                                         eviction of stale stages)
   ("tablet",     (pred, group))       -> owning group id (first claim wins)
   ("tablet_move_start", (pred, dst))  -> True once the tablet is marked
                                          read-only for the move
@@ -41,6 +52,14 @@ class ZeroState:
         # conflict window: key fingerprint -> last commit_ts
         # (zero/oracle.go commits map)
         self.commits: dict[int, int] = {}
+        # decided transactions: start_ts -> commit_ts (0 = aborted).
+        # The 2PC decision record: participants and retrying
+        # coordinators read the outcome here instead of re-deciding.
+        # decided_floor marks the trim horizon — status of anything
+        # below it is unknowable (participants keep such stages
+        # pending rather than guess)
+        self.decided: dict[int, int] = {}
+        self.decided_floor = 0
         self.tablets: dict[str, int] = {}
         self.moving: dict[str, int] = {}   # pred -> destination group
         self.sizes: dict[str, int] = {}    # pred -> reported bytes
@@ -64,14 +83,31 @@ class ZeroState:
             return first
         if op == "commit":
             start_ts, keys = args
+            start_ts = int(start_ts)
+            if start_ts in self.decided:  # retry of a decided txn
+                return self.decided[start_ts]
             for k in keys:
                 if self.commits.get(int(k), 0) > start_ts:
+                    self.decided[start_ts] = 0
                     return 0  # write-write conflict: abort
             self.max_ts += 1
             commit_ts = self.max_ts
             for k in keys:
                 self.commits[int(k)] = commit_ts
+            self.decided[start_ts] = commit_ts
+            self._trim_decided()
             return commit_ts
+        if op == "txn_status":
+            (start_ts,) = args
+            got = self.decided.get(int(start_ts))
+            return {"decided": got is not None,
+                    "commit_ts": got or 0,
+                    # participants must treat ts below the trim floor
+                    # as unknowable, never as implicitly aborted
+                    "floor": self.decided_floor}
+        if op == "abort_txn":
+            (start_ts,) = args
+            return self.decided.setdefault(int(start_ts), 0)
         if op == "tablet":
             pred, group = args
             return self.tablets.setdefault(pred, int(group))
@@ -152,11 +188,27 @@ class ZeroState:
                     "members": members}
         raise ValueError(f"unknown zero command {op!r}")
 
+    def _trim_decided(self):
+        """Bound the decision registry: deterministic trim (applied
+        identically on every quorum member) keeping a generous window
+        behind max_ts. The 10M-ts window dwarfs the participants' 300s
+        stage TTL unless zero sustains >33k ts-ops/s while a
+        participant stays partitioned the whole time; even then the
+        recorded floor makes participants keep (not mis-abort) stages
+        whose decision was trimmed."""
+        if len(self.decided) > 131072:
+            floor = self.max_ts - 10_000_000
+            self.decided = {ts: c for ts, c in self.decided.items()
+                            if ts >= floor}
+            self.decided_floor = max(self.decided_floor, floor)
+
     # --------------------------------------------------------- snapshots
 
     def snapshot(self) -> dict:
         return {"max_ts": self.max_ts, "next_uid": self.next_uid,
                 "commits": dict(self.commits),
+                "decided": dict(self.decided),
+                "decided_floor": self.decided_floor,
                 "tablets": dict(self.tablets),
                 "moving": dict(self.moving),
                 "sizes": dict(self.sizes),
@@ -168,6 +220,8 @@ class ZeroState:
         st.max_ts = snap["max_ts"]
         st.next_uid = snap["next_uid"]
         st.commits = dict(snap["commits"])
+        st.decided = dict(snap.get("decided", {}))
+        st.decided_floor = snap.get("decided_floor", 0)
         st.tablets = dict(snap["tablets"])
         st.moving = dict(snap.get("moving", {}))
         st.sizes = dict(snap.get("sizes", {}))

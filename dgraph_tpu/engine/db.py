@@ -115,6 +115,12 @@ class GraphDB:
         # LRU bound on cached posting lists)
         self.device_cache = DeviceCacheLRU(device_hbm_budget)
         self.enc_key = enc_key
+        # cross-group 2PC participants: start_ts -> (staged ops, keys).
+        # Replicated via ("xstage", ...) records so the stage survives
+        # leader changes; resolved by ("xfinalize", start_ts, commit_ts)
+        # once Zero's oracle decides (ref worker/mutation.go:432
+        # proposeOrSend + zero/oracle.go commit decisions)
+        self.pending_txns: dict[int, tuple[list, list]] = {}
         self.wal = Wal(wal_path, key=enc_key) if wal_path else None
         # optional record sink: Raft replication taps the same durable
         # record stream the WAL gets (cluster/replica.py)
@@ -427,6 +433,31 @@ class GraphDB:
             self.tablets[pred] = tab
         return tab
 
+    def xstage_ops(self, start_ts: int, nqs) -> tuple[list, set, dict]:
+        """Build one group's fragment of a cross-group transaction at an
+        externally issued global start_ts WITHOUT applying anything:
+        returns (staged (pred, EdgeOp) list, conflict keys, touched
+        schemas). Blank nodes must already be resolved to real uids by
+        the coordinator — per-group blank allocation would tear one
+        entity across uid spaces. Ref worker/mutation.go:472
+        populateMutationMap building per-group fragments."""
+        for nq, _ in nqs:
+            if nq.subject.startswith("_:") or \
+                    (nq.object_id or "").startswith("_:"):
+                raise ValueError(
+                    "cross-group stage requires pre-resolved uids "
+                    f"(got blank node in {nq.subject!r} "
+                    f"{nq.predicate!r} {nq.object_id!r})")
+        self.coordinator.observe_ts(start_ts)
+        txn = self.new_txn_at(start_ts)
+        try:
+            self._stage(txn, nqs)
+            schemas = {p: self.schema.get_or_default(p).describe()
+                       for p in {pred for pred, _ in txn.staged}}
+            return list(txn.staged), set(txn.conflict_keys), schemas
+        finally:
+            self.discard(txn)
+
     def commit(self, txn: Txn) -> int:
         with _span("commit", start_ts=txn.start_ts,
                    edges=len(txn.staged)):
@@ -562,21 +593,56 @@ class GraphDB:
                 by_pred.setdefault(pred, []).append(op)
             conflict_keys: set = set()
             for pred, ops in by_pred.items():
-                # ops were expanded before logging: apply verbatim
-                tab = self._tablet_for(pred)
-                tab.apply(commit_ts, ops)
                 for op in ops:
-                    conflict_keys.add(self._conflict_key(tab, op))
-            # mirror the commit into the local oracle's conflict window
-            # (ref posting/oracle.go ProcessDelta): a replica that later
-            # becomes leader must abort open txns that raced this write
-            self.coordinator.register_commit(conflict_keys, commit_ts)
-            uids = [op.src for _, op in staged] + \
-                   [op.dst for _, op in staged if op.dst]
-            if uids:
-                self.coordinator.bump_uids(max(uids))
+                    conflict_keys.add(
+                        self._conflict_key(self._tablet_for(pred), op))
+            # ops were expanded before logging: apply verbatim (the
+            # leader already counted this commit's metrics at commit
+            # time, so replay must not)
+            self._apply_decided(commit_ts, by_pred, conflict_keys,
+                                staged, count_metrics=False)
             return commit_ts
+        if kind == "xstage":
+            # one group's fragment of a cross-group txn: hold it
+            # pending until the Zero oracle's decision arrives as an
+            # xfinalize record (ref worker/mutation.go staged proposals)
+            _, start_ts, staged, schemas, keys = rec
+            for pred, desc in schemas.items():
+                if not self.schema.has(pred):
+                    self.schema.apply_text(desc)
+            self.pending_txns[int(start_ts)] = (list(staged), list(keys))
+            return int(start_ts)
+        if kind == "xfinalize":
+            _, start_ts, commit_ts = rec
+            pend = self.pending_txns.pop(int(start_ts), None)
+            if pend is None or not commit_ts:
+                return int(commit_ts) if commit_ts else 0
+            staged, keys = pend
+            self._apply_decided(commit_ts,
+                                self._expand_ops(commit_ts, staged),
+                                {int(k) for k in keys}, staged)
+            return int(commit_ts)
         raise ValueError(f"unknown record kind {kind!r}")
+
+    def _apply_decided(self, commit_ts: int,
+                       by_pred: dict[str, list[EdgeOp]],
+                       conflict_keys: set, staged: list,
+                       count_metrics: bool = True) -> None:
+        """Shared tail of applying a decided commit (single-group
+        replayed record or cross-group finalize): tablet apply, oracle
+        conflict-window mirror (ref posting/oracle.go ProcessDelta — a
+        replica that later becomes leader must abort open txns that
+        raced this write), uid high-water mark, metrics."""
+        for pred, ops in by_pred.items():
+            self._tablet_for(pred).apply(commit_ts, ops)
+        self.coordinator.register_commit(conflict_keys, commit_ts)
+        uids = [op.src for _, op in staged] + \
+               [op.dst for _, op in staged if op.dst]
+        if uids:
+            self.coordinator.bump_uids(max(uids))
+        if count_metrics:
+            metrics.inc_counter("dgraph_num_mutations_total")
+            metrics.inc_counter("dgraph_num_edges_total", len(staged))
 
     def close(self):
         """Flush and close the WAL (the reference's alpha shutdown

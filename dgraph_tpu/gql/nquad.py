@@ -359,3 +359,19 @@ def _map_to_nquads(obj: dict, out: list[NQuad], counter: list,
 
 def _is_geojson(d: dict) -> bool:
     return "type" in d and "coordinates" in d
+
+
+def nquad_to_wire(nq: NQuad) -> tuple:
+    """NQuad -> wire-encodable tuple, for shipping parsed (already
+    uid-resolved) triples between cluster processes — a text
+    round-trip would re-risk escaping/precision; this keeps Vals
+    typed (wire T_VAL). Inverse: nquad_from_wire."""
+    return (nq.subject, nq.predicate, nq.object_id, nq.object_value,
+            nq.lang, dict(nq.facets), nq.star, nq.val_var)
+
+
+def nquad_from_wire(t) -> NQuad:
+    s, p, oid, oval, lang, facets, star, val_var = t
+    return NQuad(subject=s, predicate=p, object_id=oid,
+                 object_value=oval, lang=lang, facets=dict(facets),
+                 star=bool(star), val_var=val_var)

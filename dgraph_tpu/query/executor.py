@@ -993,6 +993,11 @@ class Executor:
                 f"(add @reverse to the schema)")
         if tab.schema.value_type == TypeID.UID and not node.reverse or \
                 (node.reverse and tab.schema.reverse):
+            if hasattr(tab, "prefetch_edges"):
+                # federated tablet: one batched task RPC warms every
+                # per-parent edge read this block (and its emission)
+                # will do (ref worker/task.go per-attr task batching)
+                tab.prefetch_edges(src, node.reverse)
             # one per-parent edge pass serves both the dest union and
             # every facet-var binding (avoids re-walking high-fanout
             # edge lists once per facet key)
@@ -1037,6 +1042,8 @@ class Executor:
                 self._expand_children(node, gq.children, dest)
         else:
             # scalar predicate: fetch values for src uids
+            if hasattr(tab, "prefetch_postings"):
+                tab.prefetch_postings(src)
             for u in src.tolist():
                 ps = tab.get_postings(u, self.read_ts)
                 if ps:
@@ -1335,6 +1342,8 @@ class Executor:
             dev = self._device_order_keys(tab, uids)
             if dev is not None:
                 return dev
+        if hasattr(tab, "prefetch_postings"):
+            tab.prefetch_postings(uids)
         for u in uids.tolist():
             ps = tab.get_postings(u, self.read_ts)
             sel = self._select_posting(ps, [lang] if lang else [])

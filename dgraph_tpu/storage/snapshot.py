@@ -62,6 +62,12 @@ def dump_state(db) -> dict:
         "tablets": tablets,
         "max_ts": db.coordinator.max_assigned(),
         "next_uid": db.coordinator._next_uid,
+        # replicated-but-undecided cross-group stages: a member
+        # installing this snapshot must still be able to apply the
+        # xfinalize records that follow it in the log
+        "pending_txns": {ts: (list(ops), list(keys))
+                         for ts, (ops, keys)
+                         in db.pending_txns.items()},
     }
 
 
@@ -77,6 +83,9 @@ def restore_state(payload: dict, db=None):
         db.coordinator.should_serve(pred)
     db.coordinator.observe_ts(payload["max_ts"])
     db.coordinator.bump_uids(payload["next_uid"] - 1)
+    db.pending_txns = {int(ts): (list(ops), list(keys))
+                       for ts, (ops, keys)
+                       in payload.get("pending_txns", {}).items()}
     return db
 
 

@@ -187,3 +187,163 @@ def test_bank_survives_move_join_and_leader_kill():
                 p.kill()
         for p in procs.values():
             p.wait()
+
+
+def test_bank_split_across_groups_survives_move_and_leader_kill():
+    """The bank's balance predicates live on DIFFERENT groups: every
+    transfer is a cross-group transaction (xstage on both groups ->
+    zero oracle decision -> xfinalize; ref worker/mutation.go:472 +
+    zero/oracle.go:326). The conserved-total invariant must hold at
+    every globally pinned snapshot through a tablet move and a
+    SIGKILLed group leader — partial application of a decided txn, a
+    lost fragment, or a stale-snapshot read would all break it."""
+    ports = _free_ports(14)
+    procs = {}
+    clients = []
+    try:
+        zero_spec = f"1=127.0.0.1:{ports[1]}"
+        procs["z1"] = _spawn("zero", 1, f"1=127.0.0.1:{ports[0]}",
+                             f"127.0.0.1:{ports[1]}")
+        # bank group 1: THREE replicas (it loses its leader and must
+        # keep a quorum); bank group 2: two replicas
+        g1_peers = (f"1=127.0.0.1:{ports[2]},2=127.0.0.1:{ports[3]},"
+                    f"3=127.0.0.1:{ports[10]}")
+        procs["a1"] = _spawn("alpha", 1, g1_peers,
+                             f"127.0.0.1:{ports[4]}", 1, zero_spec)
+        procs["a2"] = _spawn("alpha", 2, g1_peers,
+                             f"127.0.0.1:{ports[5]}", 1, zero_spec)
+        procs["a3"] = _spawn("alpha", 3, g1_peers,
+                             f"127.0.0.1:{ports[11]}", 1, zero_spec)
+        g2_peers = f"1=127.0.0.1:{ports[6]},2=127.0.0.1:{ports[7]}"
+        procs["b1"] = _spawn("alpha", 1, g2_peers,
+                             f"127.0.0.1:{ports[8]}", 2, zero_spec)
+        procs["b2"] = _spawn("alpha", 2, g2_peers,
+                             f"127.0.0.1:{ports[9]}", 2, zero_spec)
+
+        zc = ClusterClient({1: ("127.0.0.1", ports[1])}, timeout=30.0)
+        g1 = ClusterClient({1: ("127.0.0.1", ports[4]),
+                            2: ("127.0.0.1", ports[5]),
+                            3: ("127.0.0.1", ports[11])}, timeout=30.0)
+        g2 = ClusterClient({1: ("127.0.0.1", ports[8]),
+                            2: ("127.0.0.1", ports[9])}, timeout=30.0)
+        clients += [zc, g1, g2]
+        rc = RoutedCluster(zc, {1: g1, 2: g2})
+        for cl in (zc, g1, g2):
+            _wait_role(cl)
+
+        rc.alter("bal_l: int .\nbal_r: int .\nnoise2: string .")
+        zc.tablet("bal_l", 1)
+        zc.tablet("bal_r", 2)
+        zc.tablet("noise2", 2)
+        uids = []
+        for i in range(N_ACCOUNTS):
+            out = g1.mutate(set_nquads=f'_:a <bal_l> "{OPENING}" .')
+            u = list(out["uids"].values())[0]
+            g2.mutate(set_nquads=f'<{u}> <bal_r> "{OPENING}" .')
+            uids.append(u)
+        rc.mutate(set_nquads='_:n <noise2> "y0" .')
+        grand_total = N_ACCOUNTS * OPENING * 2
+
+        stop = threading.Event()
+        errors: list[str] = []
+        transfers = {"n": 0}
+
+        def read_bal(cl, uid, pred, ts):
+            got = cl._unwrap(cl.request(
+                {"op": "query", "read_ts": ts,
+                 "q": '{ q(func: uid(%s)) { %s } }' % (uid, pred)}))
+            rows = got["data"]["q"]
+            return rows[0][pred] if rows else None
+
+        def transfer_loop(seed):
+            import random
+            rng = random.Random(seed)
+            while not stop.is_set():
+                a, b = rng.sample(uids, 2)
+                amt = rng.randrange(1, 10)
+                try:
+                    # snapshot-isolated cross-group RMW: read at the
+                    # txn's own start_ts, write through 2PC at it
+                    start_ts = zc.assign_ts(1)
+                    x = read_bal(g1, a, "bal_l", start_ts)
+                    y = read_bal(g2, b, "bal_r", start_ts)
+                    if x is None or y is None:
+                        continue
+                    rc.mutate(start_ts=start_ts,
+                              set_nquads=(
+                                  f'<{a}> <bal_l> "{x - amt}" .\n'
+                                  f'<{b}> <bal_r> "{y + amt}" .'))
+                    transfers["n"] += 1
+                except RuntimeError:
+                    pass  # conflict abort / election: retry forever
+
+        def reader_loop():
+            while not stop.is_set():
+                try:
+                    ts = zc.assign_ts(1)
+                    got_l = g1._unwrap(g1.request(
+                        {"op": "query", "read_ts": ts,
+                         "q": '{ q(func: has(bal_l)) { bal_l } }'}))
+                    got_r = g2._unwrap(g2.request(
+                        {"op": "query", "read_ts": ts,
+                         "q": '{ q(func: has(bal_r)) { bal_r } }'}))
+                    rl = got_l["data"]["q"]
+                    rr = got_r["data"]["q"]
+                    if len(rl) == N_ACCOUNTS and len(rr) == N_ACCOUNTS:
+                        total = sum(r["bal_l"] for r in rl) + \
+                            sum(r["bal_r"] for r in rr)
+                        if total != grand_total:
+                            errors.append(
+                                f"invariant broken at ts {ts}: {total}")
+                            return
+                except RuntimeError:
+                    pass
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=transfer_loop, args=(s,),
+                                    daemon=True) for s in (11, 12)]
+        threads.append(threading.Thread(target=reader_loop, daemon=True))
+        for t in threads:
+            t.start()
+
+        # nemesis 1: move the noise tablet g2 -> g1 while transfers run
+        time.sleep(1.0)
+        rc.move_tablet("noise2", 1)
+        assert rc.tablet_map()["tablets"]["noise2"] == 1
+
+        # nemesis 2: SIGKILL group 1's leader mid-flow — in-flight
+        # xstage/xfinalize fragments must recover via the replicated
+        # stage + zero's decision registry on the new leader
+        time.sleep(1.0)
+        leader = _wait_role(g1)
+        victim = {1: "a1", 2: "a2", 3: "a3"}[leader]
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait()
+        g1.remove_node(leader)
+        _wait_role(g1)
+
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        assert not errors, errors
+        assert transfers["n"] > 10, "workload starved"
+        ts = zc.assign_ts(1)
+        got_l = g1._unwrap(g1.request(
+            {"op": "query", "read_ts": ts,
+             "q": '{ q(func: has(bal_l)) { bal_l } }'}))
+        got_r = g2._unwrap(g2.request(
+            {"op": "query", "read_ts": ts,
+             "q": '{ q(func: has(bal_r)) { bal_r } }'}))
+        total = sum(r["bal_l"] for r in got_l["data"]["q"]) + \
+            sum(r["bal_r"] for r in got_r["data"]["q"])
+        assert total == grand_total
+    finally:
+        for cl in clients:
+            cl.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs.values():
+            p.wait()

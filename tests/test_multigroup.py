@@ -107,14 +107,16 @@ def test_queries_route_to_owning_group(cluster):
 
 
 def test_cross_group_blocks_scatter(cluster):
-    # independent blocks on different groups now scatter-gather
-    # (block-level federation); a SINGLE block spanning groups still
-    # rejects — that would need a cross-group join
+    # independent blocks on different groups scatter-gather per block;
+    # a SINGLE block spanning groups falls through to the federated
+    # executor (per-attr task routing) instead of rejecting
     rc = cluster
     out = rc.query('{ a(func: has(p1)) { p1 } b(func: has(p2)) { p2 } }')
     assert out["data"]["a"] and out["data"]["b"]
-    with pytest.raises(RuntimeError, match="touches predicates from"):
-        rc.query('{ a(func: has(p1)) @filter(has(p2)) { p1 } }')
+    assert "federated" not in out["extensions"]  # block-wise is enough
+    out = rc.query('{ a(func: has(p1)) @filter(has(p2)) { p1 } }')
+    assert out["extensions"].get("federated")
+    assert out["data"]["a"] == []  # no entity carries both predicates
 
 
 def test_live_tablet_move(cluster):
@@ -212,33 +214,38 @@ def test_cross_group_scatter_gather(cluster):
     assert len(out["data"]["b"]) >= 1
 
 
-def test_cross_group_variable_rejected(cluster):
+def test_cross_group_variable_federates(cluster):
+    """A var defined on one group and consumed by a block on another
+    routes to the federated executor (it used to reject) and answers
+    with the single-engine semantics: p1-uids that also carry the
+    other group's predicate (none here)."""
     rc = cluster
     m = rc.tablet_map()["tablets"]
     g_p1 = m["p1"]
     other_pred = next((p for p, g in m.items()
                        if g != g_p1 and p.startswith("p")), None)
     assert other_pred is not None
-    with pytest.raises(RuntimeError, match="crosses groups"):
-        rc.query('{ v as var(func: has(p1)) '
-                 '  q(func: uid(v)) @filter(has(%s)) { uid } }'
-                 % other_pred)
+    out = rc.query('{ v as var(func: has(p1)) '
+                   '  q(func: uid(v)) @filter(has(%s)) { uid } }'
+                   % other_pred)
+    assert out["extensions"].get("federated")
+    assert out["data"]["q"] == []
 
 
-def test_cross_group_filter_variable_rejected(cluster):
-    """Review regression: a var consumed inside a FILTER tree (not a
-    root func) must also trip the cross-group guard, not silently
-    resolve empty."""
+def test_cross_group_filter_variable_federates(cluster):
+    """A var consumed inside a FILTER tree (not a root func) must also
+    take the federated path, not silently resolve empty on one group."""
     rc = cluster
     m = rc.tablet_map()["tablets"]
     g_p1 = m["p1"]
     other_pred = next((p for p, g in m.items()
                        if g != g_p1 and p.startswith("p")), None)
     assert other_pred is not None
-    with pytest.raises(RuntimeError, match="crosses groups"):
-        rc.query('{ v as var(func: has(p1)) '
-                 '  q(func: has(%s)) @filter(uid(v)) { uid } }'
-                 % other_pred)
+    out = rc.query('{ v as var(func: has(p1)) '
+                   '  q(func: has(%s)) @filter(uid(v)) { uid } }'
+                   % other_pred)
+    assert out["extensions"].get("federated")
+    assert out["data"]["q"] == []
 
 
 def test_scatter_keeps_extensions(cluster):
@@ -410,3 +417,144 @@ def test_rebalancer_uses_reported_byte_sizes(cluster):
     # move strictly shrank the byte spread)
     sizes = rc.tablet_map()["sizes"]
     assert sizes.get(pred, 0) > 0
+
+
+def test_multigroup_mutation_atomic_commit(cluster):
+    """One mutation whose predicates live on different groups commits
+    atomically through zero's oracle (ref worker/mutation.go:472
+    populateMutationMap + zero/oracle.go:326): blanks resolve to ONE
+    zero-leased uid everywhere, both fragments land at the same
+    commit_ts, and a scatter read at a later global ts sees both."""
+    cluster.groups[1].mutate(set_nquads='_:a <mg_left> "seed1" .')
+    cluster.groups[2].mutate(set_nquads='_:b <mg_right> "seed2" .')
+    tmap = cluster.tablet_map()["tablets"]
+    assert tmap["mg_left"] != tmap["mg_right"]
+
+    out = cluster.mutate(set_nquads='_:p <mg_left> "croix" .\n'
+                                    '_:p <mg_right> "droite" .')
+    txn = out["extensions"]["txn"]
+    assert txn["commit_ts"] > txn["start_ts"]
+    assert sorted(txn["groups"]) == sorted(
+        {tmap["mg_left"], tmap["mg_right"]})
+    uid = out["uids"]["p"]
+
+    got = cluster.query(
+        '{ l(func: has(mg_left)) { uid mg_left } '
+        '  r(func: has(mg_right)) { uid mg_right } }')
+    ls = {d["uid"]: d["mg_left"] for d in got["data"]["l"]}
+    rs = {d["uid"]: d["mg_right"] for d in got["data"]["r"]}
+    assert ls.get(uid) == "croix" and rs.get(uid) == "droite"
+
+
+def test_multigroup_mutation_conflict_aborts_everywhere(cluster):
+    """Two racing cross-group transactions on the same subject: the
+    second to reach zero's oracle aborts, and NEITHER of its fragments
+    becomes visible (atomicity under conflict)."""
+    cluster.mutate(set_nquads='<0x9001> <mg_left> "base" .\n'
+                              '<0x9001> <mg_right> "base" .')
+
+    # simulate an interleaved race: stage txn A, then commit txn B on
+    # the same keys, then try to commit A — A must lose
+    from dgraph_tpu.gql.nquad import nquad_to_wire, parse_rdf
+    tmap = cluster.tablet_map()["tablets"]
+    gl, gr = tmap["mg_left"], tmap["mg_right"]
+    start_a = cluster.zero.assign_ts(1)
+    keys_a = []
+    for gid, text in ((gl, '<0x9001> <mg_left> "A" .'),
+                      (gr, '<0x9001> <mg_right> "A" .')):
+        nqs = [(nquad_to_wire(n), False) for n in parse_rdf(text)]
+        res = cluster.groups[gid]._unwrap(cluster.groups[gid].request(
+            {"op": "xstage", "start_ts": start_a, "nqs": nqs}))
+        keys_a.extend(res["keys"])
+    cluster.mutate(set_nquads='<0x9001> <mg_left> "B" .\n'
+                              '<0x9001> <mg_right> "B" .')
+    commit_a = cluster.zero.commit(start_a, sorted(set(keys_a)))
+    assert commit_a == 0  # conflict: B committed after A's start
+    cluster._xabort([gl, gr], start_a)
+
+    got = cluster.query(
+        '{ l(func: uid(0x9001)) { mg_left } '
+        '  r(func: uid(0x9001)) { mg_right } }')
+    assert got["data"]["l"] == [{"mg_left": "B"}]
+    assert got["data"]["r"] == [{"mg_right": "B"}]
+
+
+def test_multigroup_stage_survives_decision_recovery(cluster):
+    """A participant that never hears the finalize (coordinator died
+    after zero recorded the commit) applies it when reconciliation
+    asks zero for the decision — here triggered by a pinned read."""
+    from dgraph_tpu.gql.nquad import nquad_to_wire, parse_rdf
+    tmap = cluster.tablet_map()["tablets"]
+    gl, gr = tmap["mg_left"], tmap["mg_right"]
+    start = cluster.zero.assign_ts(1)
+    keys = []
+    for gid, text in ((gl, '<0x9002> <mg_left> "ghost" .'),
+                      (gr, '<0x9002> <mg_right> "ghost" .')):
+        nqs = [(nquad_to_wire(n), False) for n in parse_rdf(text)]
+        res = cluster.groups[gid]._unwrap(cluster.groups[gid].request(
+            {"op": "xstage", "start_ts": start, "nqs": nqs}))
+        keys.extend(res["keys"])
+    commit_ts = cluster.zero.commit(start, sorted(set(keys)))
+    assert commit_ts > 0
+    # coordinator "dies" here: no xfinalize is sent. A later pinned
+    # read above commit_ts must still see the committed data.
+    read_ts = cluster.zero.assign_ts(1)
+    got = cluster.groups[gl]._unwrap(cluster.groups[gl].request(
+        {"op": "query", "q": '{ x(func: uid(0x9002)) { mg_left } }',
+         "read_ts": read_ts}))
+    assert got["data"]["x"] == [{"mg_left": "ghost"}]
+    got = cluster.groups[gr]._unwrap(cluster.groups[gr].request(
+        {"op": "query", "q": '{ x(func: uid(0x9002)) { mg_right } }',
+         "read_ts": read_ts}))
+    assert got["data"]["x"] == [{"mg_right": "ghost"}]
+
+
+def test_federated_single_block_spans_groups(cluster):
+    """A single query block whose predicates live on DIFFERENT groups
+    executes federated: the unchanged executor runs at the coordinator
+    with per-attr task RPCs to each owning group (ref worker/task.go:131
+    ProcessTaskOverNetwork -> groups.go:378 BelongsTo)."""
+    cluster.groups[1].mutate(
+        set_nquads='<0x9101> <fg_edge> <0x9102> .\n'
+                   '<0x9101> <fg_edge> <0x9103> .')
+    cluster.groups[2].mutate(
+        set_nquads='<0x9101> <fg_name> "root" .\n'
+                   '<0x9102> <fg_name> "kid2" .\n'
+                   '<0x9103> <fg_name> "kid3" .')
+    tmap = cluster.tablet_map()["tablets"]
+    assert tmap["fg_edge"] != tmap["fg_name"]
+
+    got = cluster.query(
+        '{ q(func: uid(0x9101)) { fg_name fg_edge { fg_name } } }')
+    assert got["extensions"].get("federated")
+    assert got["data"]["q"] == [{
+        "fg_name": "root",
+        "fg_edge": [{"fg_name": "kid2"}, {"fg_name": "kid3"}]}]
+
+
+def test_federated_var_crosses_groups(cluster):
+    """A uid variable defined in a block on one group feeds a block on
+    another group (the reference ships SrcUIDs in the task message;
+    here the var simply lives in the one coordinating executor)."""
+    got = cluster.query(
+        '{ v as var(func: has(fg_edge)) '
+        '  q(func: uid(v)) { fg_name } }')
+    assert got["extensions"].get("federated")
+    assert got["data"]["q"] == [{"fg_name": "root"}]
+
+
+def test_federated_filter_and_count(cluster):
+    """Cross-group filter + count inside one block: count(fg_edge) is
+    served by fg_edge's group while the block's values come from
+    fg_name's group."""
+    cluster.groups[1].mutate(
+        set_nquads='<0x9101> <fg_edge> <0x9102> .\n'
+                   '<0x9101> <fg_edge> <0x9103> .')
+    cluster.groups[2].mutate(
+        set_nquads='<0x9101> <fg_name> "root" .')
+    got = cluster.query(
+        '{ q(func: has(fg_name)) '
+        '    @filter(gt(count(fg_edge), 1)) '
+        '  { fg_name c: count(fg_edge) } }')
+    assert got["extensions"].get("federated")
+    assert got["data"]["q"] == [{"fg_name": "root", "c": 2}]
