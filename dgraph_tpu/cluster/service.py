@@ -482,6 +482,12 @@ class AlphaServer(RaftServer):
         # stage time of replicated cross-group fragments, for TTL-based
         # reconciliation against zero's decision registry
         self._xstage_touched: dict[int, float] = {}
+        # negative txn_status cache: start_ts -> highest read_ts the
+        # txn was verified UNDECIDED for. A txn undecided at check time
+        # can only commit with a commit_ts issued after the check, so
+        # any read_ts obtained before it stays clean — pinned reads and
+        # federated tasks skip the zero RPC below that watermark.
+        self._xstatus_clean: dict[int, int] = {}
         # multi-group mode: a Zero quorum owns the tablet map and the
         # uid space; this alpha claims tablets, checks ownership before
         # every write, and leases uid blocks (ref worker/groups.go
@@ -697,10 +703,15 @@ class AlphaServer(RaftServer):
             # leader died) starts its TTL clock at first sight here
             ages = {st: now - self._xstage_touched.setdefault(st, now)
                     for st in pend}
+            if not self.db.pending_txns:
+                self._xstatus_clean.clear()
         for st in pend:
             if upto_ts is None and evict_older_s is not None \
                     and ages[st] <= evict_older_s:
                 continue  # young and nobody is waiting: no zero RPC
+            if upto_ts is not None \
+                    and self._xstatus_clean.get(st, 0) >= upto_ts:
+                continue  # verified undecided for this snapshot already
             try:
                 got = self.zero.request({"op": "txn_status",
                                          "args": (st,)})
@@ -708,6 +719,9 @@ class AlphaServer(RaftServer):
                     continue
                 status = got["result"]
                 if not status["decided"]:
+                    if upto_ts is not None:
+                        self._xstatus_clean[st] = max(
+                            self._xstatus_clean.get(st, 0), upto_ts)
                     if evict_older_s is None or \
                             ages[st] <= evict_older_s:
                         continue
@@ -727,6 +741,7 @@ class AlphaServer(RaftServer):
                     ("xfinalize", st, status["commit_ts"]))
                 with self.lock:
                     self._xstage_touched.pop(st, None)
+                    self._xstatus_clean.pop(st, None)
             except Exception:  # noqa: BLE001 — next pass retries
                 continue
 
@@ -1086,6 +1101,7 @@ class AlphaServer(RaftServer):
                 self._replicate_record(
                     ("xfinalize", start_ts, commit_ts))
                 self._xstage_touched.pop(start_ts, None)
+                self._xstatus_clean.pop(start_ts, None)
             return {"ok": True, "result": {"applied": known}}
         if op == "alter":
             self._replicate_write(lambda db: db.alter(**req["kw"]))

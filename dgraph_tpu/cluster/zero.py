@@ -198,9 +198,15 @@ class ZeroState:
         whose decision was trimmed."""
         if len(self.decided) > 131072:
             floor = self.max_ts - 10_000_000
+            if floor <= self.decided_floor:
+                # nothing below the window yet: skip the rebuild — an
+                # unconditional one here would make every commit O(all
+                # retained decisions). Growth stays bounded by ts
+                # volume (one decision consumes >= 1 ts).
+                return
             self.decided = {ts: c for ts, c in self.decided.items()
                             if ts >= floor}
-            self.decided_floor = max(self.decided_floor, floor)
+            self.decided_floor = floor
 
     # --------------------------------------------------------- snapshots
 

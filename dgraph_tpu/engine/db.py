@@ -694,18 +694,70 @@ class GraphDB:
 
             t0 = time.perf_counter_ns()
             ex = Executor(self, read_ts)
-            data = ex.run(parsed)
+            done = ex.execute(parsed)
             lat.processing_ns = time.perf_counter_ns() - t0
+            t0 = time.perf_counter_ns()
+            data = ex.emit(done)
+            lat.encoding_ns = time.perf_counter_ns() - t0
             sp["read_ts"] = read_ts
             sp["blocks"] = len(parsed.queries)
             sp["parse_us"] = lat.parsing_ns // 1000
             sp["process_us"] = lat.processing_ns // 1000
         metrics.inc_counter("dgraph_num_queries_total")
         metrics.observe("dgraph_query_latency_ms",
-                        (lat.parsing_ns + lat.processing_ns) / 1e6)
+                        (lat.parsing_ns + lat.processing_ns
+                         + lat.encoding_ns) / 1e6)
         return {"data": data,
                 "extensions": {"latency": lat.as_dict(),
                                "txn": {"start_ts": read_ts}}}
+
+    def query_json(self, q: str, variables: dict | None = None,
+                   txn: Optional[Txn] = None, best_effort: bool = True,
+                   read_ts: Optional[int] = None) -> str:
+        """query() with the serialized-response fast path: the full
+        {"data": ..., "extensions": ...} body as ONE JSON string, with
+        flat uid+scalar blocks encoded by the native columnar row
+        serializer instead of per-uid dict building + json.dumps
+        (ref query/outputnode.go fastJsonNode — a documented reference
+        hot loop). The serving layers (HTTP/gRPC) call this; library
+        users who want Python objects keep query()."""
+        import json as _json
+
+        from dgraph_tpu.query.executor import Executor
+
+        lat = Latency()
+        with _span("query") as sp:
+            t0 = time.perf_counter_ns()
+            parsed = gql_parse(q, variables)
+            lat.parsing_ns = time.perf_counter_ns() - t0
+
+            t0 = time.perf_counter_ns()
+            if read_ts is not None:
+                pass  # pinned snapshot
+            elif txn is not None:
+                read_ts = txn.start_ts
+            elif best_effort:
+                read_ts = self.coordinator.max_assigned()
+            else:
+                read_ts = self.coordinator.next_ts()
+            lat.assign_ts_ns = time.perf_counter_ns() - t0
+
+            t0 = time.perf_counter_ns()
+            ex = Executor(self, read_ts)
+            done = ex.execute(parsed)
+            lat.processing_ns = time.perf_counter_ns() - t0
+            t0 = time.perf_counter_ns()
+            data_json = ex.emit_json(done)
+            lat.encoding_ns = time.perf_counter_ns() - t0
+            sp["read_ts"] = read_ts
+            sp["encode_us"] = lat.encoding_ns // 1000
+        metrics.inc_counter("dgraph_num_queries_total")
+        metrics.observe("dgraph_query_latency_ms",
+                        (lat.parsing_ns + lat.processing_ns
+                         + lat.encoding_ns) / 1e6)
+        ext = _json.dumps({"latency": lat.as_dict(),
+                           "txn": {"start_ts": read_ts}})
+        return '{"data":' + data_json + ',"extensions":' + ext + "}"
 
     # ------------------------------------------------------------------
     # Bulk traversal API: the device-first equivalent of @recurse for
@@ -765,6 +817,16 @@ class GraphDB:
                 f"tablet {pred!r} still has unfolded deltas (an open "
                 "transaction pins the rollup watermark); retry when "
                 "transactions drain")
+        for start_ts, (staged, _keys) in self.pending_txns.items():
+            if any(p == pred for p, _ in staged):
+                # a cross-group 2PC fragment touches this tablet: the
+                # export would ship state WITHOUT it, and its later
+                # finalize would land on a tablet no reader routes to —
+                # a committed write silently lost. The move retries
+                # once the transaction resolves.
+                raise RuntimeError(
+                    f"tablet {pred!r} has a pending cross-group stage "
+                    f"(startTs {start_ts}); retry when it resolves")
         return {
             "schema": tab.schema.describe(),
             "tablet": dump_tablet(tab),

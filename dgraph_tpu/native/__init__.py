@@ -100,6 +100,16 @@ def _load():
         lib.dgt_levenshtein.restype = ctypes.c_int32
         lib.dgt_levenshtein.argtypes = [u8p, ctypes.c_uint32, u8p,
                                         ctypes.c_uint32, ctypes.c_int32]
+        lib.dgt_json_rows.restype = ctypes.c_int
+        lib.dgt_json_rows.argtypes = [
+            ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64)]
         _lib = lib
         return _lib
 
@@ -276,3 +286,61 @@ def levenshtein(a: str, b: str, max_d: int) -> int:
     bb = b.encode("utf-8", "surrogatepass")
     return lib.dgt_levenshtein(_buf(ab), len(ab), _buf(bb), len(bb),
                                max_d)
+
+
+# column type tags for json_rows (mirror native.cc dgt_json_rows)
+JCOL_INT = 0
+JCOL_FLOAT = 1
+JCOL_BOOL = 2
+JCOL_STR = 3
+JCOL_UID = 4
+
+
+def json_rows(n_rows: int, cols) -> "bytes | None":
+    """Serialize typed columns into a JSON array of row objects — the
+    query-result fast path (ref query/outputnode.go fastJsonNode, a
+    documented reference hot loop). `cols` is a list of
+    (name: str, type: JCOL_*, data: np.ndarray, offsets: np.ndarray
+    | None, present: np.ndarray(uint8) | None). Returns the serialized
+    bytes, or None when the native runtime is unavailable (callers
+    fall back to dict + json.dumps)."""
+    lib = _load()
+    if lib is None:
+        return None
+    import numpy as np
+    n_cols = len(cols)
+    names = (ctypes.c_char_p * n_cols)()
+    types = (ctypes.c_int32 * n_cols)()
+    data = (ctypes.c_void_p * n_cols)()
+    offsets = (ctypes.POINTER(ctypes.c_int64) * n_cols)()
+    present = (ctypes.POINTER(ctypes.c_uint8) * n_cols)()
+    keep = []  # hold refs so buffers outlive the call
+    for i, (name, t, d, off, pres) in enumerate(cols):
+        nb = name.encode("utf-8")
+        keep.append(nb)
+        names[i] = nb
+        types[i] = t
+        d = np.ascontiguousarray(d)
+        keep.append(d)
+        data[i] = d.ctypes.data_as(ctypes.c_void_p)
+        if off is not None:
+            off = np.ascontiguousarray(off, dtype=np.int64)
+            keep.append(off)
+            offsets[i] = off.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int64))
+        if pres is not None:
+            pres = np.ascontiguousarray(pres, dtype=np.uint8)
+            keep.append(pres)
+            present[i] = pres.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint8))
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_uint64()
+    rc = lib.dgt_json_rows(n_rows, n_cols, names, types, data, offsets,
+                           present, ctypes.byref(out),
+                           ctypes.byref(out_len))
+    if rc != 0:
+        return None
+    try:
+        return ctypes.string_at(out, out_len.value)
+    finally:
+        lib.dgt_free(out)

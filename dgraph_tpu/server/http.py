@@ -131,8 +131,10 @@ class AlphaServer:
 
     # -- request handlers (transport-independent) --
 
-    def handle_query(self, body: dict | str, params: dict,
-                     token: str = "") -> dict:
+    def _query_prologue(self, body: dict | str, params: dict,
+                        token: str):
+        """Shared /query front matter: body shapes, ACL authorization,
+        read-only txn attachment."""
         if isinstance(body, dict):
             q = body.get("query", "")
             variables = body.get("variables")
@@ -154,9 +156,28 @@ class AlphaServer:
                 self._check_txn_owner(start_ts, claims)
                 ro_txn = self.txns.get(start_ts)
         be = params.get("be", "false") == "true"
+        return q, variables, ro_txn, (be if ro_txn is None else False)
+
+    def handle_query(self, body: dict | str, params: dict,
+                     token: str = "") -> dict:
+        q, variables, ro_txn, be = self._query_prologue(
+            body, params, token)
         with self.rw.read:
-            return self.db.query(q, variables, txn=ro_txn, best_effort=be
-                                 if ro_txn is None else False)
+            return self.db.query(q, variables, txn=ro_txn,
+                                 best_effort=be)
+
+    def handle_query_json(self, body: dict | str, params: dict,
+                          token: str = "") -> str:
+        """handle_query returning the serialized response body — flat
+        blocks take the native columnar emitter (db.query_json), so
+        the HTTP layer never re-serializes what the engine already
+        encoded (ref query/outputnode.go fastJsonNode feeding the
+        response writer directly)."""
+        q, variables, ro_txn, be = self._query_prologue(
+            body, params, token)
+        with self.rw.read:
+            return self.db.query_json(q, variables, txn=ro_txn,
+                                      best_effort=be)
 
     def handle_mutate(self, body: bytes, content_type: str,
                       params: dict, token: str = "") -> dict:
@@ -520,7 +541,9 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _send(self, code: int, obj: Any):
-        data = json.dumps(obj).encode()
+        self._send_raw(code, json.dumps(obj).encode())
+
+    def _send_raw(self, code: int, data: bytes):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
@@ -579,8 +602,8 @@ class _Handler(BaseHTTPRequestHandler):
                     payload: Any = json.loads(body.decode())
                 else:
                     payload = body.decode()
-                self._send(200, self.alpha.handle_query(payload, params,
-                                                        token))
+                self._send_raw(200, self.alpha.handle_query_json(
+                    payload, params, token).encode())
             elif path == "/mutate":
                 self._send(200, self.alpha.handle_mutate(body, ctype,
                                                          params, token))

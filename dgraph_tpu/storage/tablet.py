@@ -394,6 +394,81 @@ class Tablet:
                 out = op.facets
         return out
 
+    def value_columns(self, read_ts: int):
+        """Columnar view of a CLEAN single-valued scalar tablet for the
+        JSON fast path (ref query/outputnode.go fastJsonNode feeding
+        valToBytes): (srcs sorted u64, tid, data, enc) where data is a
+        typed numpy array aligned to srcs for INT/FLOAT/BOOL and None
+        for strings, and enc is the per-src utf-8-encoded payload list
+        for STRING/DEFAULT/DATETIME. Rows without an untagged posting
+        are simply absent from srcs. Returns None when the tablet is
+        dirty at read_ts, historical (read_ts < base_ts), list-typed,
+        value-type-mixed, or schema-converted — those keep the exact
+        per-posting path. Cached per base_ts, like the device tiles."""
+        if self.dirty() or read_ts < self.base_ts or self.schema.list_:
+            return None
+        # cache key includes the schema OBJECT: alter() rebinds
+        # tab.schema, and a type change must invalidate the typed view
+        key = (self.base_ts, id(self.schema))
+        cached = getattr(self, "_val_cols", None)
+        if cached is not None and self._val_cols_key == key:
+            return cached or None
+        cols = self._build_value_columns()
+        self._val_cols = cols if cols is not None else False
+        self._val_cols_key = key
+        return cols
+
+    def _build_value_columns(self):
+        from dgraph_tpu.models.types import TypeID
+        stype = self.schema.value_type
+        srcs: list[int] = []
+        vals: list = []
+        tid = None
+        for u, ps in self.values.items():
+            sel = None
+            for p in ps:
+                if not p.lang:
+                    sel = p
+                    break
+            if sel is None:
+                continue
+            v = sel.value
+            if tid is None:
+                tid = v.tid
+            elif v.tid is not tid:
+                return None  # mixed types: exact path only
+            srcs.append(u)
+            vals.append(v.value)
+        if tid is None:
+            return None
+        if stype != TypeID.DEFAULT and tid != stype:
+            # stored tid predates a schema change; reads convert per
+            # cell, which the columnar view would skip
+            return None
+        order = np.argsort(np.asarray(srcs, np.uint64))
+        srcs_a = np.asarray(srcs, np.uint64)[order]
+        try:
+            if tid == TypeID.INT:
+                data = np.asarray(vals, np.int64)[order]
+                return (srcs_a, tid, data, None)
+            if tid == TypeID.FLOAT:
+                data = np.asarray(vals, np.float64)[order]
+                return (srcs_a, tid, data, None)
+            if tid == TypeID.BOOL:
+                data = np.asarray(
+                    [1 if v else 0 for v in vals], np.uint8)[order]
+                return (srcs_a, tid, data, None)
+            if tid == TypeID.DATETIME:
+                enc = [vals[j].isoformat().encode("utf-8")
+                       for j in order.tolist()]
+                return (srcs_a, tid, None, enc)
+            if tid in (TypeID.STRING, TypeID.DEFAULT):
+                enc = [vals[j].encode("utf-8") for j in order.tolist()]
+                return (srcs_a, tid, None, enc)
+        except (TypeError, ValueError, AttributeError, OverflowError):
+            return None
+        return None
+
     # -- rollup (ref posting/list.go:708 Rollup + worker/draft.go:407) --
 
     def dirty(self) -> bool:

@@ -16,6 +16,7 @@
 
 #include <cstdint>
 #include <cstdio>
+#include <charconv>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -596,6 +597,218 @@ int32_t dgt_levenshtein(const uint8_t* ab, uint32_t lab, const uint8_t* bb,
     std::swap(prev, cur);
   }
   return prev[la] <= max_d ? prev[la] : max_d + 1;
+}
+
+}  // extern "C"
+
+// -------------------------------------------------------- JSON emitter
+// Columnar row serializer for the query result fast path — the role of
+// the reference's fastJsonNode encoder (query/outputnode.go), which its
+// own benchmarks rank a top-5 hot loop (query/benchmark/
+// synthetic_results.txt ToJson 235-460 ms/op). The executor hands over
+// typed columns; this writes the JSON array of row objects in one C
+// pass. Output formatting matches Python json.dumps defaults exactly
+// (ensure_ascii escaping, shortest-roundtrip doubles, lone-key
+// omission for absent cells) so the fast path is byte-identical to the
+// dict path.
+
+namespace {
+
+struct JBuf {
+  uint8_t* p = nullptr;
+  uint64_t len = 0, cap = 0;
+  bool oom = false;
+  void reserve(uint64_t extra) {
+    if (len + extra <= cap) return;
+    uint64_t want = cap ? cap * 2 : 4096;
+    while (want < len + extra) want *= 2;
+    uint8_t* np2 = (uint8_t*)realloc(p, want);
+    if (!np2) { oom = true; return; }
+    p = np2;
+    cap = want;
+  }
+  void put(const char* s, uint64_t n) {
+    reserve(n);
+    if (oom) return;
+    memcpy(p + len, s, n);
+    len += n;
+  }
+  void putc(char c) {
+    reserve(1);
+    if (oom) return;
+    p[len++] = c;
+  }
+};
+
+// json.dumps default escaping: ", \, control chars, and every
+// non-ASCII codepoint as \uXXXX (surrogate pairs above the BMP).
+void jesc(JBuf& b, const uint8_t* s, int64_t n) {
+  static const char* hex = "0123456789abcdef";
+  char u[16];
+  int64_t i = 0;
+  while (i < n) {
+    uint8_t c = s[i];
+    if (c == '"' || c == '\\') {
+      b.putc('\\');
+      b.putc((char)c);
+      i++;
+    } else if (c == '\n') { b.put("\\n", 2); i++; }
+    else if (c == '\t') { b.put("\\t", 2); i++; }
+    else if (c == '\r') { b.put("\\r", 2); i++; }
+    else if (c == '\b') { b.put("\\b", 2); i++; }
+    else if (c == '\f') { b.put("\\f", 2); i++; }
+    else if (c < 0x20) {
+      snprintf(u, sizeof u, "\\u%04x", c);
+      b.put(u, 6);
+      i++;
+    } else if (c < 0x80) {
+      b.putc((char)c);
+      i++;
+    } else {
+      // decode one UTF-8 codepoint (input comes from Python str
+      // .encode(), so it is valid UTF-8)
+      uint32_t cp = 0;
+      int extra = 0;
+      if ((c & 0xE0) == 0xC0) { cp = c & 0x1F; extra = 1; }
+      else if ((c & 0xF0) == 0xE0) { cp = c & 0x0F; extra = 2; }
+      else { cp = c & 0x07; extra = 3; }
+      if (i + extra >= n) break;  // truncated tail: stop cleanly
+      for (int k = 1; k <= extra; k++) cp = (cp << 6) | (s[i + k] & 0x3F);
+      i += extra + 1;
+      if (cp >= 0x10000) {
+        uint32_t v = cp - 0x10000;
+        snprintf(u, sizeof u, "\\u%04x\\u%04x",
+                 (unsigned)(0xD800 + (v >> 10)),
+                 (unsigned)(0xDC00 + (v & 0x3FF)));
+        b.put(u, 12);
+      } else {
+        u[0] = '\\'; u[1] = 'u';
+        u[2] = hex[(cp >> 12) & 0xF]; u[3] = hex[(cp >> 8) & 0xF];
+        u[4] = hex[(cp >> 4) & 0xF]; u[5] = hex[cp & 0xF];
+        b.put(u, 6);
+      }
+    }
+  }
+}
+
+// shortest round-trip double, matching repr(float) / json.dumps:
+// std::to_chars (ryu) finds the shortest digit count, then one
+// %.*g snprintf renders it with Python's exact formatting rules
+// (fixed/scientific switch, 2-digit signed exponent)
+void jdouble(JBuf& b, double v) {
+  char tmp[40];
+  if (v != v) { b.put("NaN", 3); return; }           // json.dumps default
+  if (v > 1.7976931348623157e308) { b.put("Infinity", 8); return; }
+  if (v < -1.7976931348623157e308) { b.put("-Infinity", 9); return; }
+  char tc[32];
+  auto res = std::to_chars(tc, tc + sizeof tc, v);
+  // digits + decimal exponent of the shortest representation,
+  // independent of the fixed/scientific form to_chars picked
+  int sig = 0, exp10 = 0, int_digits = 0, lead_zeros = 0, trail0 = 0;
+  bool nonzero = false, saw_point = false, has_e = false;
+  const char* q = tc;
+  if (*q == '-') q++;
+  for (; q < res.ptr; q++) {
+    if (*q == '.') { saw_point = true; continue; }
+    if (*q == 'e' || *q == 'E') { has_e = true; exp10 = atoi(q + 1); break; }
+    if (*q >= '1' && *q <= '9') nonzero = true;
+    if (nonzero) { sig++; trail0 = (*q == '0') ? trail0 + 1 : 0; }
+    else if (saw_point) lead_zeros++;
+    if (!saw_point && nonzero) int_digits++;
+  }
+  sig -= trail0;  // fixed-form trailing zeros are not significant
+  if (sig < 1) { sig = 1; nonzero = true; int_digits = 1; }
+  if (has_e) exp10 += int_digits - 1;
+  else if (saw_point && int_digits == 0) exp10 = -lead_zeros - 1;
+  else exp10 = (int_digits ? int_digits : 1) - 1;
+  // CPython float repr: fixed form iff -4 <= exp10 < 16
+  if (exp10 >= -4 && exp10 < 16)
+    snprintf(tmp, sizeof tmp, "%.*g", sig > exp10 ? sig : exp10 + 1, v);
+  else
+    snprintf(tmp, sizeof tmp, "%.*e", sig - 1, v);
+  // Python prints doubles with an exponent as 1e+20 -> "1e+20";
+  // %g matches. Integral floats print as "1.0" in Python, %g gives
+  // "1": append ".0" when no '.', 'e' or inf/nan marker present.
+  bool plain = true;
+  for (char* q = tmp; *q; q++)
+    if (*q == '.' || *q == 'e' || *q == 'E' || *q == 'n' || *q == 'f')
+      plain = false;
+  b.put(tmp, strlen(tmp));
+  if (plain) b.put(".0", 2);
+}
+
+}  // namespace
+
+extern "C" {
+
+// types: 0=int64, 1=double, 2=bool(u8), 3=utf8 string (data + offsets
+// [n_rows+1]), 4=uid(u64 -> "0x.."). present: per-column u8 mask or
+// NULL (all present). Rows where nothing is present emit nothing (the
+// executor drops empty objects, ref outputnode.go). Returns 0 and a
+// malloc'd buffer in *out (caller frees with dgt_free), -1 on OOM.
+int dgt_json_rows(int64_t n_rows, int32_t n_cols,
+                  const char* const* names, const int32_t* types,
+                  const void* const* data,
+                  const int64_t* const* offsets,
+                  const uint8_t* const* present,
+                  uint8_t** out, uint64_t* out_len) {
+  JBuf b;
+  char tmp[40];
+  b.putc('[');
+  bool first_row = true;
+  for (int64_t r = 0; r < n_rows; r++) {
+    bool any = false;
+    for (int32_t c = 0; c < n_cols && !any; c++)
+      any = !present[c] || present[c][r];
+    if (!any) continue;
+    if (!first_row) b.putc(',');
+    first_row = false;
+    b.putc('{');
+    bool first_col = true;
+    for (int32_t c = 0; c < n_cols; c++) {
+      if (present[c] && !present[c][r]) continue;
+      if (!first_col) b.putc(',');
+      first_col = false;
+      b.putc('"');
+      b.put(names[c], strlen(names[c]));
+      b.put("\":", 2);
+      switch (types[c]) {
+        case 0:
+          snprintf(tmp, sizeof tmp, "%lld",
+                   (long long)((const int64_t*)data[c])[r]);
+          b.put(tmp, strlen(tmp));
+          break;
+        case 1:
+          jdouble(b, ((const double*)data[c])[r]);
+          break;
+        case 2:
+          if (((const uint8_t*)data[c])[r]) b.put("true", 4);
+          else b.put("false", 5);
+          break;
+        case 3: {
+          const int64_t* off = offsets[c];
+          b.putc('"');
+          jesc(b, (const uint8_t*)data[c] + off[r], off[r + 1] - off[r]);
+          b.putc('"');
+          break;
+        }
+        case 4:
+          snprintf(tmp, sizeof tmp, "\"0x%llx\"",
+                   (unsigned long long)((const uint64_t*)data[c])[r]);
+          b.put(tmp, strlen(tmp));
+          break;
+        default:
+          free(b.p);
+          return -2;
+      }
+    }
+    b.putc('}');
+  }
+  b.putc(']');
+  if (b.oom) { free(b.p); return -1; }
+  *out = b.p;
+  *out_len = b.len;
+  return 0;
 }
 
 }  // extern "C"

@@ -47,17 +47,29 @@ _NOUNS = ["city", "river", "mountain", "dream", "night", "day", "war",
           "queen", "shadow", "star", "heart", "world", "game"]
 
 
-def _uid(kind: str, i: int) -> int:
+def _uid(kind: str, i: int, scale: int = 1) -> int:
+    # bases scale with the dataset so ranges never collide: the gap
+    # between adjacent bases is >= 0x10000*scale while the largest
+    # entity count grows as ~6600*scale (perfs)
     base = {"director": 0x10000, "film": 0x20000, "actor": 0x40000,
             "character": 0x50000, "genre": 0x60000, "country": 0x70000,
             "perf": 0x80000}[kind]
-    return base + i
+    return base * scale + i
 
 
-def generate() -> tuple[str, list[str]]:
-    """-> (schema, nquad lines)"""
+def generate(scale: int = 1) -> tuple[str, list[str]]:
+    """-> (schema, nquad lines).
+
+    scale=1 is the golden-suite dataset (bit-identical across
+    versions: committed expected outputs embed its uids). scale=200
+    reproduces the reference's 21million acceptance regime
+    (systest/21million/test-21million.sh) — same shape, ~21M RDF."""
     rng = np.random.default_rng(21_000_000)
     out: list[str] = []
+    n_directors = N_DIRECTORS * scale
+    n_films = N_FILMS * scale
+    n_actors = N_ACTORS * scale
+    n_characters = N_CHARACTERS * scale
 
     def add(s, p, o, facets=""):
         out.append(f"<{s:#x}> <{p}> {o} {facets}.")
@@ -68,26 +80,26 @@ def generate() -> tuple[str, list[str]]:
         return f"{w.title()} {n.title()} {kind.title()} {i}"
 
     for i in range(N_GENRES):
-        add(_uid("genre", i), "name", f'"{GENRES[i]}"')
+        add(_uid("genre", i, scale), "name", f'"{GENRES[i]}"')
     for i in range(N_COUNTRIES):
-        add(_uid("country", i), "name", f'"Country {i:02d}"')
+        add(_uid("country", i, scale), "name", f'"Country {i:02d}"')
         lon = round(-180 + 360 * (i / N_COUNTRIES), 3)
         lat = round(-60 + 120 * ((i * 7 % N_COUNTRIES) / N_COUNTRIES), 3)
-        add(_uid("country", i), "loc",
+        add(_uid("country", i, scale), "loc",
             f'"{{\\"type\\":\\"Point\\",\\"coordinates\\":[{lon},{lat}]}}"'
             f"^^<geo:geojson>")
-    for i in range(N_DIRECTORS):
-        add(_uid("director", i), "name",
+    for i in range(n_directors):
+        add(_uid("director", i, scale), "name",
             f'"{name_of("director", i, rng)}"')
-    for i in range(N_ACTORS):
-        add(_uid("actor", i), "name", f'"{name_of("actor", i, rng)}"')
-    for i in range(N_CHARACTERS):
-        add(_uid("character", i), "name",
+    for i in range(n_actors):
+        add(_uid("actor", i, scale), "name", f'"{name_of("actor", i, rng)}"')
+    for i in range(n_characters):
+        add(_uid("character", i, scale), "name",
             f'"{name_of("role", i, rng)}"')
 
     perf_counter = 0
-    for i in range(N_FILMS):
-        f = _uid("film", i)
+    for i in range(n_films):
+        f = _uid("film", i, scale)
         add(f, "name", f'"{name_of("film", i, rng)}"')
         if i % 3 == 0:
             add(f, "name", f'"Film {i} auf Deutsch"@de')
@@ -101,19 +113,20 @@ def generate() -> tuple[str, list[str]]:
         add(f, "tagline",
             f'"a {_WORDS[i % len(_WORDS)]} tale of '
             f'{_NOUNS[i % len(_NOUNS)]} and {_NOUNS[(i*3+1) % len(_NOUNS)]}"')
-        d = int(rng.integers(N_DIRECTORS))
-        add(_uid("director", d), "director.film", f"<{f:#x}>")
+        d = int(rng.integers(n_directors))
+        add(_uid("director", d, scale), "director.film", f"<{f:#x}>")
         for g in np.unique(rng.integers(0, N_GENRES, 1 + i % 3)):
-            add(f, "genre", f"<{_uid('genre', int(g)):#x}>")
+            add(f, "genre", f"<{_uid('genre', int(g), scale):#x}>")
         add(f, "country",
-            f"<{_uid('country', int(rng.integers(N_COUNTRIES))):#x}>")
+            f"<{_uid('country', int(rng.integers(N_COUNTRIES)), scale):#x}>")
         for _ in range(2 + int(rng.integers(4))):
-            p = _uid("perf", perf_counter)
+            p = _uid("perf", perf_counter, scale)
             perf_counter += 1
-            a = int(rng.integers(N_ACTORS))
-            c = int(rng.integers(N_CHARACTERS))
+            a = int(rng.integers(n_actors))
+            c = int(rng.integers(n_characters))
             add(f, "starring", f"<{p:#x}>",
                 f"(billing={1 + perf_counter % 9}) ")
-            add(p, "performance.actor", f"<{_uid('actor', a):#x}>")
-            add(p, "performance.character", f"<{_uid('character', c):#x}>")
+            add(p, "performance.actor", f"<{_uid('actor', a, scale):#x}>")
+            add(p, "performance.character",
+                f"<{_uid('character', c, scale):#x}>")
     return SCHEMA, out

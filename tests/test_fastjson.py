@@ -1,0 +1,102 @@
+"""Serialized-response fast path: query_json must be byte-compatible
+with json.dumps over query()'s dicts, with flat uid+scalar blocks
+served by the native columnar emitter (ref query/outputnode.go
+fastJsonNode; SURVEY §3.2 hot-loop rank 5)."""
+
+import json
+
+import pytest
+
+from dgraph_tpu import native
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.utils.metrics import snapshot
+
+
+@pytest.fixture(scope="module")
+def db():
+    d = GraphDB(prefer_device=False)
+    d.alter("""
+        name: string @index(exact) @lang .
+        age: int @index(int) .
+        score: float .
+        active: bool .
+        joined: datetime .
+        friend: [uid] @reverse .
+        nick: [string] .
+    """)
+    quads = []
+    for i in range(1, 41):
+        quads.append(f'<{i:#x}> <name> "pörson {i}\\"x\\u00e9" .')
+        quads.append(f'<{i:#x}> <age> "{20 + i}" .')
+        quads.append(f'<{i:#x}> <score> "{i / 8}" .')
+        quads.append(f'<{i:#x}> <active> "{"true" if i % 2 else "false"}" .')
+        quads.append(f'<{i:#x}> <joined> "20{i % 10}0-01-0{1 + i % 9}" .')
+        if i > 1:
+            quads.append(f'<{i:#x}> <friend> <{i - 1:#x}> .')
+    quads.append('<0x1> <name> "der erste"@de .')
+    quads.append('<0x5> <nick> "a" .\n<0x5> <nick> "b" .')
+    d.mutate(set_nquads="\n".join(quads))
+    return d
+
+
+FLAT_Q = '{ q(func: has(age), orderasc: age) { uid name age score active joined } }'
+
+
+def _count():
+    return snapshot()["counters"].get("query_flat_json_total", 0)
+
+
+def test_flat_block_uses_native_emitter_and_matches(db):
+    before = _count()
+    s = db.query_json(FLAT_Q)
+    if native.available():
+        assert _count() == before + 1
+    body = json.loads(s)
+    assert body["data"] == db.query(FLAT_Q)["data"]
+    assert body["extensions"]["latency"]["encoding_ns"] > 0
+    # byte-level: data payload is exactly compact json.dumps
+    want = json.dumps(db.query(FLAT_Q)["data"], separators=(",", ":"))
+    assert s.startswith('{"data":' + want)
+
+
+@pytest.mark.parametrize("q", [
+    '{ q(func: has(name)) { name friend { name age } } }',   # nested
+    '{ q(func: has(nick)) { nick } }',                       # list pred
+    '{ q(func: uid(0x1)) { name@de } }',                     # langs
+    '{ q(func: has(age)) @normalize { n: name } }',          # normalize
+    '{ q(func: has(age), first: 3) { c: count(friend) } }',  # counts
+    '{ v as var(func: has(age)) q(func: uid(v)) '
+    '{ x: math(1 + 1) } }',                                  # math child
+])
+def test_general_blocks_fall_back_and_match(db, q):
+    got = json.loads(db.query_json(q))["data"]
+    assert got == db.query(q)["data"], q
+
+
+def test_encoding_latency_measured_in_query_too(db):
+    out = db.query(FLAT_Q)
+    assert out["extensions"]["latency"]["encoding_ns"] > 0
+
+
+def test_value_columns_invalidated_by_alter():
+    """An alter that retypes a predicate must invalidate the columnar
+    JSON cache — the fast path would otherwise keep serving the old
+    typed view (review finding)."""
+    d = GraphDB(prefer_device=False)
+    d.alter("v: int .")
+    d.mutate(set_nquads='<0x1> <v> "7" .')
+    d.rollup_all()
+    first = json.loads(d.query_json('{ q(func: uid(0x1)) { v } }'))
+    assert first["data"]["q"] == [{"v": 7}]
+    d.alter("v: string .")
+    after = json.loads(d.query_json('{ q(func: uid(0x1)) { v } }'))
+    assert after["data"] == d.query('{ q(func: uid(0x1)) { v } }')["data"]
+
+
+def test_flat_path_rejects_unescapable_alias():
+    d = GraphDB(prefer_device=False)
+    d.alter("v: int .")
+    d.mutate(set_nquads='<0x1> <v> "7" .')
+    d.rollup_all()
+    q = '{ q(func: uid(0x1)) { zürich: v } }'
+    assert json.loads(d.query_json(q))["data"] == d.query(q)["data"]
