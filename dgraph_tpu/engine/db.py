@@ -673,6 +673,20 @@ class GraphDB:
         """`read_ts` pins the MVCC snapshot to an externally issued
         timestamp (a zero-global ts for cross-group reads); otherwise
         best_effort reads at max_assigned and strict reads allocate."""
+        ex, done, lat, read_ts = self._query_run(
+            q, variables, txn, best_effort, read_ts)
+        t0 = time.perf_counter_ns()
+        data = ex.emit(done)
+        lat.encoding_ns = time.perf_counter_ns() - t0
+        self._query_metrics(lat)
+        return {"data": data,
+                "extensions": {"latency": lat.as_dict(),
+                               "txn": {"start_ts": read_ts}}}
+
+    def _query_run(self, q, variables, txn, best_effort, read_ts):
+        """Shared query front half: parse, read-ts resolution,
+        execution — everything up to (but excluding) emission, which
+        query() and query_json() do differently."""
         from dgraph_tpu.query.executor import Executor
 
         lat = Latency()
@@ -696,20 +710,17 @@ class GraphDB:
             ex = Executor(self, read_ts)
             done = ex.execute(parsed)
             lat.processing_ns = time.perf_counter_ns() - t0
-            t0 = time.perf_counter_ns()
-            data = ex.emit(done)
-            lat.encoding_ns = time.perf_counter_ns() - t0
             sp["read_ts"] = read_ts
             sp["blocks"] = len(parsed.queries)
             sp["parse_us"] = lat.parsing_ns // 1000
             sp["process_us"] = lat.processing_ns // 1000
+        return ex, done, lat, read_ts
+
+    def _query_metrics(self, lat: Latency):
         metrics.inc_counter("dgraph_num_queries_total")
         metrics.observe("dgraph_query_latency_ms",
                         (lat.parsing_ns + lat.processing_ns
                          + lat.encoding_ns) / 1e6)
-        return {"data": data,
-                "extensions": {"latency": lat.as_dict(),
-                               "txn": {"start_ts": read_ts}}}
 
     def query_json(self, q: str, variables: dict | None = None,
                    txn: Optional[Txn] = None, best_effort: bool = True,
@@ -723,38 +734,12 @@ class GraphDB:
         users who want Python objects keep query()."""
         import json as _json
 
-        from dgraph_tpu.query.executor import Executor
-
-        lat = Latency()
-        with _span("query") as sp:
-            t0 = time.perf_counter_ns()
-            parsed = gql_parse(q, variables)
-            lat.parsing_ns = time.perf_counter_ns() - t0
-
-            t0 = time.perf_counter_ns()
-            if read_ts is not None:
-                pass  # pinned snapshot
-            elif txn is not None:
-                read_ts = txn.start_ts
-            elif best_effort:
-                read_ts = self.coordinator.max_assigned()
-            else:
-                read_ts = self.coordinator.next_ts()
-            lat.assign_ts_ns = time.perf_counter_ns() - t0
-
-            t0 = time.perf_counter_ns()
-            ex = Executor(self, read_ts)
-            done = ex.execute(parsed)
-            lat.processing_ns = time.perf_counter_ns() - t0
-            t0 = time.perf_counter_ns()
-            data_json = ex.emit_json(done)
-            lat.encoding_ns = time.perf_counter_ns() - t0
-            sp["read_ts"] = read_ts
-            sp["encode_us"] = lat.encoding_ns // 1000
-        metrics.inc_counter("dgraph_num_queries_total")
-        metrics.observe("dgraph_query_latency_ms",
-                        (lat.parsing_ns + lat.processing_ns
-                         + lat.encoding_ns) / 1e6)
+        ex, done, lat, read_ts = self._query_run(
+            q, variables, txn, best_effort, read_ts)
+        t0 = time.perf_counter_ns()
+        data_json = ex.emit_json(done)
+        lat.encoding_ns = time.perf_counter_ns() - t0
+        self._query_metrics(lat)
         ext = _json.dumps({"latency": lat.as_dict(),
                            "txn": {"start_ts": read_ts}})
         return '{"data":' + data_json + ',"extensions":' + ext + "}"

@@ -209,24 +209,27 @@ def device_sharded_adjacency(db, tab, read_ts: int,
     return sadj
 
 
-def device_values(db, tab, read_ts: int):
+def device_values(db, tab, read_ts: int, lang: str = ""):
     """Sortable value view for order-by / inequality offload (scalar
-    tablets; same rollup-then-check policy as the adjacency tiles)."""
+    tablets; same rollup-then-check policy as the adjacency tiles).
+    `lang` selects language-tagged order keys (ref worker/sort.go
+    multiSort with langs) — each language gets its own cached tile."""
     if not _clean_resident(db, tab, read_ts, want_uid=False):
         return None
-    dv = getattr(tab, "_device_values", None)
-    if dv is not None and getattr(tab, "_device_values_ts", -1) == tab.base_ts:
-        db.device_cache.touch(tab, "_device_values")
+    attr = "_device_values" if not lang else f"_device_values@{lang}"
+    dv = getattr(tab, attr, None)
+    if dv is not None and getattr(tab, attr + "_ts", -1) == tab.base_ts:
+        db.device_cache.touch(tab, attr)
         return dv
-    pairs = tab.sort_key_pairs()
+    pairs = tab.sort_key_pairs(lang)
     if len(pairs) < db.device_min_edges:
         return None
     if pairs and max(pairs) > _MAX_U32:
         return None
     dv = build_values(pairs)
-    tab._device_values = dv
-    tab._device_values_ts = tab.base_ts
-    db.device_cache.put(tab, "_device_values", dv)
+    setattr(tab, attr, dv)
+    setattr(tab, attr + "_ts", tab.base_ts)
+    db.device_cache.put(tab, attr, dv)
     return dv
 
 

@@ -263,6 +263,29 @@ def range_select(dv: DeviceValues, lo, hi,
     return compact(jnp.where(in_range & valid, dv.uids_by_key, SENTINEL))
 
 
+@partial(jax.jit, static_argnames=("descs",))
+def multisort(cand: jax.Array, dv_uids: tuple, dv_ranks: tuple,
+              descs: tuple) -> jax.Array:
+    """Stable multi-key order-by fully on device: gather each order
+    attr's rank column for the (sorted, SENTINEL-padded) candidates,
+    then ONE lax.sort with the columns as leading keys and the uid
+    vector as the final tiebreak — the reference's multiSort
+    (worker/sort.go:300) without its per-attr re-sort passes. Missing
+    values keep RANK_MISSING so they sink last under asc AND desc
+    (the host path's missing-flag-dominates rule); SENTINEL padding
+    sinks below real uids via the uid operand."""
+    cols = []
+    for du, dr, desc in zip(dv_uids, dv_ranks, descs):
+        idx = jnp.clip(lookup_idx(du, cand), 0, du.shape[0] - 1)
+        hit = (du[idx] == cand) & (cand != SENTINEL)
+        ranks = jnp.where(hit, dr[idx], RANK_MISSING)
+        if desc:
+            ranks = jnp.where(hit, -ranks, RANK_MISSING)
+        cols.append(ranks)
+    out = jax.lax.sort(tuple(cols) + (cand,), num_keys=len(cols) + 1)
+    return out[-1]
+
+
 @partial(jax.jit, static_argnames=("k", "desc"))
 def order_topk(dv_uids, dv_ranks, cand: jax.Array, k: int,
                desc: bool = False):

@@ -99,6 +99,9 @@ def _flat_column(ex, ch, name: str, ulist: list, n: int):
     colview = ch.tablet.value_columns(ex.read_ts) \
         if hasattr(ch.tablet, "value_columns") else None
     if colview is not None:
+        # budget the host-side column copy alongside the device tiles
+        # (string payloads double resident memory on big tablets)
+        ex.db.device_cache.put(ch.tablet, "_val_cols", colview)
         col = _flat_column_vectorized(ex, ch, name, colview, n)
         if col is not None:
             return col
@@ -159,7 +162,10 @@ def _flat_column(ex, ch, name: str, ulist: list, n: int):
                        for v in sels]
             else:
                 enc = [v.value.encode("utf-8") for v in sels]
-        except AttributeError:  # non-str payload in a DEFAULT column
+        except (AttributeError, ValueError):
+            # non-str payload in a DEFAULT column, or a lone-surrogate
+            # string utf-8 refuses (UnicodeEncodeError is a
+            # ValueError): keep the exact dict path
             return None
         lens = np.zeros(n, np.int64)
         lens[idxs] = [len(e) for e in enc]
@@ -1523,6 +1529,10 @@ class Executor:
     def _apply_order(self, orders, uids: np.ndarray) -> np.ndarray:
         """Multi-key value sort; stable, missing-value uids last
         (ref types/sort.go:118 + worker/sort.go)."""
+        if self.db.prefer_device and len(uids) >= 8:
+            dev = self._device_apply_order(orders, uids)
+            if dev is not None:
+                return dev
         keyrows = []
         for o in orders:
             vmap = self._order_keys(o.attr, o.lang, uids)
@@ -1542,6 +1552,41 @@ class Executor:
         order = np.lexsort(tuple(cols))
         return uids[order]
 
+    def _device_apply_order(self, orders, uids: np.ndarray
+                            ) -> Optional[np.ndarray]:
+        """Whole multi-key (and lang-tagged) order-by on device: one
+        multisort call over per-attr DeviceValues rank columns (ref
+        worker/sort.go:300 multiSort). Falls back to the host lexsort
+        whenever any order key has no device view (val() orders,
+        dirty/small tablets, >32-bit uids)."""
+        from dgraph_tpu.engine.device_cache import device_values
+        from dgraph_tpu.ops.graph import multisort
+        from dgraph_tpu.ops.uidvec import SENTINEL, pad_to, to_numpy
+
+        if np.any(uids > 0xFFFFFFFE):
+            return None
+        dvs = []
+        for o in orders:
+            if o.attr.startswith("val(") or o.attr.startswith("facet:"):
+                return None
+            tab = self._tablet(o.attr)
+            if tab is None or not hasattr(tab, "sort_key_pairs"):
+                return None
+            dv = device_values(self.db, tab, self.read_ts, o.lang)
+            if dv is None:
+                return None
+            dvs.append(dv)
+        import jax.numpy as jnp
+        cand = np.full(pad_to(len(uids)), SENTINEL, np.uint32)
+        cand[: len(uids)] = np.sort(uids).astype(np.uint32)
+        inc_counter("query_device_multisort_total")
+        out = multisort(jnp.asarray(cand),
+                        tuple(dv.uids for dv in dvs),
+                        tuple(dv.ranks for dv in dvs),
+                        tuple(bool(o.desc) for o in orders))
+        res = to_numpy(out)
+        return res[: len(uids)].astype(np.uint64)
+
     def _order_keys(self, attr: str, lang: str, uids) -> dict:
         """uid -> (missing_flag, int64 key)."""
         out = {}
@@ -1558,8 +1603,8 @@ class Executor:
         tab = self._tablet(attr)
         if tab is None:
             return out
-        if self.db.prefer_device and not lang and len(uids) >= 64:
-            dev = self._device_order_keys(tab, uids)
+        if self.db.prefer_device and len(uids) >= 8:
+            dev = self._device_order_keys(tab, uids, lang)
             if dev is not None:
                 return dev
         if hasattr(tab, "prefetch_postings"):
@@ -1574,15 +1619,20 @@ class Executor:
                     pass
         return out
 
-    def _device_order_keys(self, tab: Tablet, uids) -> Optional[dict]:
+    def _device_order_keys(self, tab: Tablet, uids,
+                           lang: str = "") -> Optional[dict]:
         """Sort keys for a uid batch in ONE device gather instead of a
         get_postings loop (SURVEY §2a item 4; ref worker/sort.go:177).
-        Parity: device_values indexes each uid's first untagged posting,
-        exactly what _select_posting(ps, []) picks on the host path."""
+        Parity: device_values indexes each uid's first posting in
+        `lang` ("" = untagged), exactly what _select_posting picks on
+        the host path. The gather input is pow2-padded so repeated
+        sorts share compiled code instead of one XLA program per
+        candidate count."""
         from dgraph_tpu.engine.device_cache import device_values
         from dgraph_tpu.ops.graph import RANK_MISSING, key_gather
+        from dgraph_tpu.ops.uidvec import SENTINEL, pad_to
 
-        dv = device_values(self.db, tab, self.read_ts)
+        dv = device_values(self.db, tab, self.read_ts, lang)
         if dv is None:
             return None
         import jax.numpy as jnp
@@ -1590,9 +1640,12 @@ class Executor:
         if not len(u32):
             return {}
         inc_counter("query_device_orderkeys_total")
-        ranks = np.asarray(key_gather(dv, jnp.asarray(u32)))
+        cand = np.full(pad_to(len(u32)), SENTINEL, np.uint32)
+        cand[: len(u32)] = np.sort(u32)
+        ranks = np.asarray(key_gather(dv, jnp.asarray(cand)))
         out = {}
-        for u, r in zip(u32.tolist(), ranks.tolist()):
+        for u, r in zip(cand[: len(u32)].tolist(),
+                        ranks[: len(u32)].tolist()):
             if r != RANK_MISSING:
                 out[u] = (0, int(r))
         return out

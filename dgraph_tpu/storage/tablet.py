@@ -35,6 +35,27 @@ from dgraph_tpu.utils.keys import token_bytes
 _EMPTY = np.empty(0, dtype=np.uint64)
 
 
+class ValueColumns:
+    """Columnar view of a scalar tablet's untagged values (the JSON
+    fast path's input). Iterable as (srcs, tid, data, enc) and exposes
+    .nbytes so DeviceCacheLRU can budget/evict it like a device tile —
+    string payload copies are NOT free host memory."""
+
+    __slots__ = ("srcs", "tid", "data", "enc", "nbytes")
+
+    def __init__(self, srcs, tid, data, enc):
+        self.srcs = srcs
+        self.tid = tid
+        self.data = data
+        self.enc = enc
+        self.nbytes = int(srcs.nbytes) \
+            + (int(data.nbytes) if data is not None else 0) \
+            + (sum(len(e) + 49 for e in enc) if enc else 0)
+
+    def __iter__(self):
+        return iter((self.srcs, self.tid, self.data, self.enc))
+
+
 @dataclass
 class Posting:
     """One value posting. Ref pb.Posting (value side)."""
@@ -407,15 +428,20 @@ class Tablet:
         per-posting path. Cached per base_ts, like the device tiles."""
         if self.dirty() or read_ts < self.base_ts or self.schema.list_:
             return None
-        # cache key includes the schema OBJECT: alter() rebinds
-        # tab.schema, and a type change must invalidate the typed view
-        key = (self.base_ts, id(self.schema))
+        # validity = same base AND the same schema OBJECT (held by
+        # reference, so a recycled id() can never false-validate):
+        # alter() rebinds tab.schema, and a type change must
+        # invalidate the typed view
         cached = getattr(self, "_val_cols", None)
-        if cached is not None and self._val_cols_key == key:
+        if cached is not None \
+                and getattr(self, "_val_cols_ts", -1) == self.base_ts \
+                and getattr(self, "_val_cols_schema", None) \
+                is self.schema:
             return cached or None
         cols = self._build_value_columns()
         self._val_cols = cols if cols is not None else False
-        self._val_cols_key = key
+        self._val_cols_ts = self.base_ts
+        self._val_cols_schema = self.schema
         return cols
 
     def _build_value_columns(self):
@@ -450,22 +476,24 @@ class Tablet:
         try:
             if tid == TypeID.INT:
                 data = np.asarray(vals, np.int64)[order]
-                return (srcs_a, tid, data, None)
+                return ValueColumns(srcs_a, tid, data, None)
             if tid == TypeID.FLOAT:
                 data = np.asarray(vals, np.float64)[order]
-                return (srcs_a, tid, data, None)
+                return ValueColumns(srcs_a, tid, data, None)
             if tid == TypeID.BOOL:
                 data = np.asarray(
                     [1 if v else 0 for v in vals], np.uint8)[order]
-                return (srcs_a, tid, data, None)
+                return ValueColumns(srcs_a, tid, data, None)
             if tid == TypeID.DATETIME:
                 enc = [vals[j].isoformat().encode("utf-8")
                        for j in order.tolist()]
-                return (srcs_a, tid, None, enc)
+                return ValueColumns(srcs_a, tid, None, enc)
             if tid in (TypeID.STRING, TypeID.DEFAULT):
                 enc = [vals[j].encode("utf-8") for j in order.tolist()]
-                return (srcs_a, tid, None, enc)
+                return ValueColumns(srcs_a, tid, None, enc)
         except (TypeError, ValueError, AttributeError, OverflowError):
+            # ValueError covers UnicodeEncodeError: a lone-surrogate
+            # payload keeps the exact dict path on BOTH emitters
             return None
         return None
 
@@ -626,12 +654,15 @@ class Tablet:
 
     # -- sortable keys for device values --
 
-    def sort_key_pairs(self) -> dict[int, int]:
-        """uid -> int64 sort key of its (first, no-lang) value."""
+    def sort_key_pairs(self, lang: str = "") -> dict[int, int]:
+        """uid -> int64 sort key of its first value in `lang` ("" =
+        first untagged; a concrete tag selects that language only,
+        matching the executor's _select_posting([lang]) — ref
+        types/valForLang)."""
         out = {}
         for src, plist in self.values.items():
             for p in plist:
-                if p.lang:
+                if p.lang != lang:
                     continue
                 try:
                     out[src] = sort_key(self._converted(p))

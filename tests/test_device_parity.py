@@ -60,3 +60,44 @@ def test_device_path_actually_used(dbs):
     tab = dev_db.tablets["out"]
     assert getattr(tab, "_device_adj", None) is not None, \
         "device adjacency was never built — parity test ran host-only"
+
+
+def test_device_multisort_matches_host_and_counts():
+    """Multi-key and lang-tagged order-by take the device multisort
+    path (ref worker/sort.go:300 multiSort) and must order exactly
+    like the host lexsort — stability, uid tiebreak, missing-last,
+    desc included."""
+    from dgraph_tpu.utils.metrics import snapshot
+
+    def build(prefer_device):
+        db = GraphDB(prefer_device=prefer_device, device_min_edges=1)
+        db.alter("nm: string @index(exact) @lang .\n"
+                 "grp: int .\nrank: float .")
+        rng = np.random.default_rng(7)
+        lines = []
+        for i in range(1, 61):
+            if i % 7:  # some uids miss nm entirely (missing-last rule)
+                lines.append(f'<{hex(i)}> <nm> "w{int(rng.integers(5))}" .')
+            if i % 5:
+                lines.append(f'<{hex(i)}> <nm> "de{i % 4}"@de .')
+            lines.append(f'<{hex(i)}> <grp> "{int(rng.integers(4))}" .')
+            lines.append(f'<{hex(i)}> <rank> "{float(rng.random()):.3f}" .')
+        db.mutate(set_nquads="\n".join(lines))
+        db.rollup_all()
+        return db
+
+    host, dev = build(False), build(True)
+    queries = [
+        '{ q(func: has(grp), orderasc: grp, orderdesc: rank) '
+        '{ uid grp rank } }',
+        '{ q(func: has(grp), orderasc: nm, orderasc: grp) { uid } }',
+        '{ q(func: has(grp), orderdesc: nm@de) { uid } }',
+        '{ q(func: has(grp), orderasc: nm@de, orderdesc: grp, '
+        'first: 17) { uid } }',
+    ]
+    before = snapshot()["counters"].get(
+        "query_device_multisort_total", 0)
+    for q in queries:
+        assert dev.query(q)["data"] == host.query(q)["data"], q
+    got = snapshot()["counters"].get("query_device_multisort_total", 0)
+    assert got >= before + len(queries)
