@@ -675,9 +675,11 @@ class GraphDB:
         best_effort reads at max_assigned and strict reads allocate."""
         ex, done, lat, read_ts = self._query_run(
             q, variables, txn, best_effort, read_ts)
-        t0 = time.perf_counter_ns()
-        data = ex.emit(done)
-        lat.encoding_ns = time.perf_counter_ns() - t0
+        with _span("encode") as sp:
+            t0 = time.perf_counter_ns()
+            data = ex.emit(done)
+            lat.encoding_ns = time.perf_counter_ns() - t0
+            sp["encode_us"] = lat.encoding_ns // 1000
         self._query_metrics(lat)
         return {"data": data,
                 "extensions": {"latency": lat.as_dict(),
@@ -736,9 +738,11 @@ class GraphDB:
 
         ex, done, lat, read_ts = self._query_run(
             q, variables, txn, best_effort, read_ts)
-        t0 = time.perf_counter_ns()
-        data_json = ex.emit_json(done)
-        lat.encoding_ns = time.perf_counter_ns() - t0
+        with _span("encode") as sp:
+            t0 = time.perf_counter_ns()
+            data_json = ex.emit_json(done)
+            lat.encoding_ns = time.perf_counter_ns() - t0
+            sp["encode_us"] = lat.encoding_ns // 1000
         self._query_metrics(lat)
         ext = _json.dumps({"latency": lat.as_dict(),
                            "txn": {"start_ts": read_ts}})

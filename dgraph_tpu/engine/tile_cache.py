@@ -68,11 +68,15 @@ class DeviceCacheLRU:
         # queries in parallel under an RW lock)
         self._lock = threading.Lock()
 
-    def touch(self, tab, attr: str):
+    def touch(self, tab, attr: str) -> bool:
+        """Mark MRU; returns whether the entry is tracked (callers use
+        this to put only on first sight)."""
         key = (id(tab), attr)
         with self._lock:
             if key in self._entries:
                 self._entries.move_to_end(key)
+                return True
+            return False
 
     def put(self, tab, attr: str, obj) -> None:
         with self._lock:

@@ -36,18 +36,41 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    """A prebuilt .so older than the source misses newer symbols and
+    would crash symbol binding below — rebuild instead of loading it."""
+    src = os.path.join(_REPO, "native", "native.cc")
+    try:
+        return os.path.getmtime(_SO) < os.path.getmtime(src)
+    except OSError:
+        return False
+
+
 def _load():
     global _lib, _tried
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) and not _build():
-            return None
+        if (not os.path.exists(_SO) or _stale()) and not _build():
+            if not os.path.exists(_SO):
+                return None
         try:
             lib = ctypes.CDLL(_SO)
         except OSError:
             return None
+        try:
+            _bind(lib)
+        except AttributeError:
+            # missing symbol despite the staleness check (e.g. a
+            # hand-copied .so): degrade to the pure-Python fallbacks
+            # instead of poisoning every import
+            return None
+        _lib = lib
+        return _lib
+
+
+def _bind(lib):
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u64p = ctypes.POINTER(ctypes.c_uint64)
         lib.dgt_kv_open.restype = ctypes.c_void_p
@@ -100,6 +123,10 @@ def _load():
         lib.dgt_levenshtein.restype = ctypes.c_int32
         lib.dgt_levenshtein.argtypes = [u8p, ctypes.c_uint32, u8p,
                                         ctypes.c_uint32, ctypes.c_int32]
+        lib.dgt_match_mask.restype = ctypes.c_int
+        lib.dgt_match_mask.argtypes = [
+            u8p, ctypes.c_uint32, ctypes.c_int32, u8p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, u8p]
         lib.dgt_json_rows.restype = ctypes.c_int
         lib.dgt_json_rows.argtypes = [
             ctypes.c_int64, ctypes.c_int32,
@@ -110,8 +137,6 @@ def _load():
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
             ctypes.POINTER(ctypes.c_uint64)]
-        _lib = lib
-        return _lib
 
 
 def available() -> bool:
@@ -344,3 +369,24 @@ def json_rows(n_rows: int, cols) -> "bytes | None":
         return ctypes.string_at(out, out_len.value)
     finally:
         lib.dgt_free(out)
+
+
+def match_mask(term_lower: bytes, max_d: int, blob, offsets) -> "object":
+    """Batched fuzzy-match verify: uint8 mask per value (1 = within
+    max_d of the pre-lowercased term, 0 = no, 2 = non-ASCII value the
+    caller must re-verify with Python lowercasing). None when the
+    native runtime is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    import numpy as np
+    blob = np.ascontiguousarray(blob, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = len(offsets) - 1
+    out = np.zeros(max(n, 1), np.uint8)
+    lib.dgt_match_mask(
+        _buf(term_lower), len(term_lower), max_d,
+        blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out[:n]

@@ -39,18 +39,32 @@ class ValueColumns:
     """Columnar view of a scalar tablet's untagged values (the JSON
     fast path's input). Iterable as (srcs, tid, data, enc) and exposes
     .nbytes so DeviceCacheLRU can budget/evict it like a device tile —
-    string payload copies are NOT free host memory."""
+    string payload copies are NOT free host memory.
 
-    __slots__ = ("srcs", "tid", "data", "enc", "nbytes")
+    For string tablets, `extra_srcs`/`extra_enc` carry every
+    LANG-TAGGED payload (absent from the untagged column) so batch
+    scans like match() cover the full posting surface without a
+    per-uid host pass; extra_ok=False marks a tablet whose tagged
+    values defied encoding — batch consumers must fall back."""
 
-    def __init__(self, srcs, tid, data, enc):
+    __slots__ = ("srcs", "tid", "data", "enc", "nbytes",
+                 "extra_srcs", "extra_enc", "extra_ok")
+
+    def __init__(self, srcs, tid, data, enc,
+                 extra_srcs=None, extra_enc=None, extra_ok=True):
         self.srcs = srcs
         self.tid = tid
         self.data = data
         self.enc = enc
+        self.extra_srcs = extra_srcs if extra_srcs is not None \
+            else np.empty(0, np.uint64)
+        self.extra_enc = extra_enc or []
+        self.extra_ok = extra_ok
         self.nbytes = int(srcs.nbytes) \
             + (int(data.nbytes) if data is not None else 0) \
-            + (sum(len(e) + 49 for e in enc) if enc else 0)
+            + (sum(len(e) + 49 for e in enc) if enc else 0) \
+            + int(self.extra_srcs.nbytes) \
+            + sum(len(e) + 49 for e in self.extra_enc)
 
     def __iter__(self):
         return iter((self.srcs, self.tid, self.data, self.enc))
@@ -490,7 +504,21 @@ class Tablet:
                 return ValueColumns(srcs_a, tid, None, enc)
             if tid in (TypeID.STRING, TypeID.DEFAULT):
                 enc = [vals[j].encode("utf-8") for j in order.tolist()]
-                return ValueColumns(srcs_a, tid, None, enc)
+                ex_srcs, ex_enc, ex_ok = [], [], True
+                for u, ps in self.values.items():
+                    for p in ps:
+                        if not p.lang:
+                            continue
+                        try:
+                            ex_enc.append(
+                                p.value.value.encode("utf-8"))
+                            ex_srcs.append(u)
+                        except (AttributeError, ValueError):
+                            ex_ok = False
+                return ValueColumns(
+                    srcs_a, tid, None, enc,
+                    extra_srcs=np.asarray(ex_srcs, np.uint64),
+                    extra_enc=ex_enc, extra_ok=ex_ok)
         except (TypeError, ValueError, AttributeError, OverflowError):
             # ValueError covers UnicodeEncodeError: a lone-surrogate
             # payload keeps the exact dict path on BOTH emitters

@@ -601,6 +601,24 @@ int32_t dgt_levenshtein(const uint8_t* ab, uint32_t lab, const uint8_t* bb,
 
 }  // extern "C"
 
+// Batched fuzzy-match verify (ref worker/match.go matchFuzzy over the
+// trigram candidates): one call scores every candidate value against
+// the term, CASE-SENSITIVE over code points exactly like the
+// reference's levenshteinDistance (match.go:35 — no lowering).
+extern "C" int dgt_match_mask(
+    const uint8_t* term, uint32_t term_len, int32_t max_d,
+    const uint8_t* blob, const int64_t* offsets,
+    int64_t n, uint8_t* out_mask) {
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* v = blob + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    int32_t d = dgt_levenshtein(v, (uint32_t)len, term, term_len,
+                                max_d);
+    out_mask[i] = d <= max_d ? 1 : 0;
+  }
+  return 0;
+}
+
 // -------------------------------------------------------- JSON emitter
 // Columnar row serializer for the query result fast path — the role of
 // the reference's fastJsonNode encoder (query/outputnode.go), which its

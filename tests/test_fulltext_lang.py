@@ -194,3 +194,33 @@ def test_eq_lang_verification_strict(db):
     assert out == []
     out = db2.query('{ q(func: eq(w@., "apfel")) { uid } }')["data"]["q"]
     assert [x["uid"] for x in out] == ["0x1"]
+
+
+def test_match_is_case_sensitive_and_covers_tagged_values():
+    """match() is case-sensitive over code points, exactly the
+    reference's levenshteinDistance (worker/match.go:35 — no
+    lowering), and the batched trigram path must still see
+    lang-tagged postings (they live outside the untagged column)."""
+    from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu import native
+
+    db = GraphDB(prefer_device=False)
+    db.alter("mname: string @index(trigram) @lang .")
+    db.mutate(set_nquads='\n'.join(
+        ['<0x1> <mname> "Hello World" .',
+         '<0x2> <mname> "HELLO WORLD" .',
+         '<0x3> <mname> "zzz" .',
+         '<0x3> <mname> "Hello Wxrld"@de .',
+         '<0x4> <mname> "hello world" .']))
+    db.rollup_all()
+    q = '{ q(func: match(mname, "Hello World", 2)) { uid } }'
+    got = {r["uid"] for r in db.query(q)["data"]["q"]}
+    # 0x1 exact; 0x3 via its @de value (distance 1); 0x4 within 2
+    # after case-sensitive comparison? "hello" vs "Hello" = 1 edit,
+    # "world" vs "World" = 1 edit -> distance 2, included;
+    # 0x2 differs in 8 positions -> excluded
+    assert got == {"0x1", "0x3", "0x4"}, got
+    if native.available():
+        from dgraph_tpu.utils.metrics import snapshot
+        assert snapshot()["counters"].get(
+            "query_match_batch_total", 0) >= 1
