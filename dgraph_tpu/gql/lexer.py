@@ -52,6 +52,16 @@ def tokenize(text: str) -> list[Token]:
     line = 1
     n = len(text)
     while pos < n:
+        # contextual regex literal, the reference's lexer-state trick
+        # (lex/lexer.go regexp state): a '/' opening a function
+        # argument (right after '(' or ',') starts /pattern/flags —
+        # scanned manually so ^ $ \d \/ # and friends all pass
+        # through; '/' anywhere else stays the division operator
+        if text[pos] == "/" and toks and \
+                toks[-1].kind in ("lparen", "comma"):
+            tok, pos = _scan_regex(text, pos, line)
+            toks.append(tok)
+            continue
         m = _MASTER.match(text, pos)
         if m is None:
             raise GQLError(
@@ -70,6 +80,34 @@ def tokenize(text: str) -> list[Token]:
         toks.append(Token(kind, val, m.start(), line))
     toks.append(Token("eof", "", n, line))
     return toks
+
+
+def _scan_regex(text: str, pos: int, line: int) -> tuple[Token, int]:
+    """Scan /pattern/flags starting at the opening slash. The pattern
+    body keeps its backslashes verbatim (the regex engine interprets
+    them; \\/ escapes the delimiter, like the reference)."""
+    i = pos + 1
+    n = len(text)
+    while i < n:
+        c = text[i]
+        if c == "\\" and i + 1 < n:
+            i += 2
+            continue
+        if c == "/":
+            break
+        if c == "\n":
+            raise GQLError(
+                f"line {line}: newline inside regex literal")
+        i += 1
+    else:
+        raise GQLError(f"line {line}: unterminated regex literal")
+    body = text[pos + 1 : i]
+    i += 1
+    flags = ""
+    while i < n and text[i].isalpha():
+        flags += text[i]
+        i += 1
+    return Token("regex", body + "\x00" + flags, pos, line), i
 
 
 _ESCAPES = {

@@ -310,9 +310,9 @@ def _parse_function(cur: Cursor, gvars: dict) -> Function:
                 fn.uids.append(_to_int(t.val, t.line))
             else:
                 fn.args.append(Arg(t.val))
-        elif t.kind == "op" and t.val == "/":
-            # regexp(/pattern/flags) — re-lex as a regex literal
-            pat, flags = _relex_regex(cur)
+        elif t.kind == "regex":
+            # /pattern/flags scanned contextually by the lexer
+            pat, _, flags = t.val.partition("\x00")
             fn.args.append(Arg(pat))
             if flags:
                 fn.args.append(Arg(flags))
@@ -336,33 +336,6 @@ def _parse_coord_list(cur: Cursor) -> list:
                 f"line {t.line}: bad coordinate literal {t.val!r}")
         cur.accept("comma")
     return out
-
-
-def _relex_regex(cur: Cursor) -> tuple[str, str]:
-    """Reconstruct /regex/flags from raw source between tokens.
-
-    The pattern must be sliced from the ORIGINAL source — joining token
-    vals would drop whitespace inside the literal (`/Frozen King/` must
-    keep its space)."""
-    toks = cur.toks
-    # the opening '/' op was already consumed by the caller; the pattern
-    # starts right after it (leading whitespace is part of the pattern)
-    open_slash = toks[cur.i - 1]
-    # walk raw token list until an op '/' token
-    j = cur.i
-    while j < len(toks) and not (toks[j].kind == "op" and toks[j].val == "/"):
-        j += 1
-    if j >= len(toks):
-        raise GQLError("unterminated regex literal")
-    if cur.src:
-        pat = cur.src[open_slash.pos + 1 : toks[j].pos]
-    else:  # no source available (shouldn't happen for query docs)
-        pat = "".join(t.val for t in toks[cur.i : j])
-    cur.i = j + 1
-    flags = ""
-    if cur.peek().kind == "name" and cur.peek().val in ("i",):
-        flags = cur.next().val
-    return pat, flags
 
 
 # -- filters -----------------------------------------------------------------

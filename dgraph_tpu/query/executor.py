@@ -515,10 +515,22 @@ class Executor:
                 self._tablet(PREDICATE_TYPE),
                 [Val(TypeID.STRING, fn.args[0].value)], candidates)
         if name == "has":
-            tab = self._tablet(fn.attr)
-            if tab is None:
-                return _EMPTY
-            alluids = tab.src_uids(self.read_ts)
+            if fn.attr.startswith("~"):
+                # has(~pred): uids with at least one INCOMING edge
+                # (ref worker/task.go reverse attr handling)
+                tab = self._tablet(fn.attr[1:])
+                if tab is None:
+                    return _EMPTY
+                if not tab.schema.reverse:
+                    raise GQLError(
+                        f"has(~{fn.attr[1:]}) needs @reverse on "
+                        f"{fn.attr[1:]!r}")
+                alluids = tab.dst_uids(self.read_ts)
+            else:
+                tab = self._tablet(fn.attr)
+                if tab is None:
+                    return _EMPTY
+                alluids = tab.src_uids(self.read_ts)
             return alluids if candidates is None \
                 else _intersect(candidates, alluids)
         if fn.is_count:

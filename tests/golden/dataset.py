@@ -13,6 +13,7 @@ import numpy as np
 
 SCHEMA = """
 name: string @index(term, exact, trigram) @lang .
+aka: [string] @index(term) .
 initial_release_date: datetime @index(year) .
 rating: float @index(float) .
 runtime: int @index(int) .
@@ -129,4 +130,14 @@ def generate(scale: int = 1) -> tuple[str, list[str]]:
             add(p, "performance.actor", f"<{_uid('actor', a, scale):#x}>")
             add(p, "performance.character",
                 f"<{_uid('character', c, scale):#x}>")
+    # list-valued scalar predicate WITH per-value facets (appended
+    # after every earlier rng draw, so the existing goldens' dataset
+    # prefix stays bit-identical; ref query0_test.go facets on
+    # scalar-list predicates)
+    for i in range(0, n_films, 5):
+        f = _uid("film", i, scale)
+        add(f, "aka", f'"Working Title {i}"',
+            f"(kind=\"working\", year={1940 + i % 60}) ")
+        add(f, "aka", f'"{_NOUNS[i % len(_NOUNS)].title()} Reborn {i}"',
+            "(kind=\"festival\") ")
     return SCHEMA, out
