@@ -1144,6 +1144,12 @@ class AlphaServer(RaftServer):
         return {"ok": False, "error": f"unknown op {op!r}"}
 
 
+class _MoveDataError(RuntimeError):
+    """A tablet move's export/import was REJECTED by a group (vs a
+    transient infra error): these count toward the pre-flip abort
+    threshold."""
+
+
 class ZeroServer(RaftServer):
     """The replicated coordinator quorum (dgraph/cmd/zero).
 
@@ -1159,6 +1165,120 @@ class ZeroServer(RaftServer):
         self.state = ZeroState()
         super().__init__(node_id, raft_peers, client_addr,
                          storage=storage, **kw)
+        # leader-only tablet-move driver: executes the ledger's moves
+        # (export -> import -> flip -> drop), each phase transition
+        # raft-persisted so a NEW leader resumes mid-flight moves
+        # (ref zero/tablet.go:62 movetablet run by zero's leader)
+        self._move_attempts: dict[str, int] = {}
+        threading.Thread(target=self._move_driver_loop, daemon=True,
+                         name=f"zero-moves-{node_id}").start()
+
+    def _group_client(self, gid: int):
+        """ClusterClient to an alpha group from the membership
+        registry (alphas register their client addrs on connect)."""
+        from dgraph_tpu.cluster.client import ClusterClient
+        with self.lock:
+            addrs = {rec["id"]: tuple(rec["client"])
+                     for rec in self.state.alphas.values()
+                     if rec["group"] == gid}
+        return ClusterClient(addrs, timeout=30.0) if addrs else None
+
+    def _move_driver_loop(self, tick_s: float = 0.5):
+        while not self._stop.wait(tick_s):
+            with self.lock:
+                if self.node.role != LEADER:
+                    continue
+                pending = {p: dict(m)
+                           for p, m in self.state.move_queue.items()}
+            # counters for moves no longer in the ledger (finished or
+            # externally aborted) must not doom a future retry
+            for p in list(self._move_attempts):
+                if p not in pending:
+                    self._move_attempts.pop(p, None)
+            for pred, mv in pending.items():
+                try:
+                    self._drive_move(pred, mv)
+                except _MoveDataError as e:
+                    # the data phase itself failed (export/import
+                    # rejected): count toward the abort threshold —
+                    # transient infra errors (registry warm-up, group
+                    # elections) retry forever instead
+                    log.warning("move_data_retry", pred=pred,
+                                error=str(e)[:200])
+                    n = self._move_attempts.get(pred, 0) + 1
+                    self._move_attempts[pred] = n
+                    if n > 20 and mv["phase"] == "start":
+                        self._abort_move(pred, mv)
+                except Exception as e:  # noqa: BLE001 — retry next tick
+                    log.warning("move_drive_retry", pred=pred,
+                                error=str(e)[:200])
+                    # post-flip we NEVER abort: the destination owns
+                    # the data; keep retrying the source drop forever
+
+    def _abort_move(self, pred: str, mv: dict):
+        """Pre-flip abort: route stays with the source; the imported
+        copy on the destination (replicated by import_tablet) must be
+        dropped or it lives on as a stale orphan."""
+        dst_cl = self._group_client(mv["dst"])
+        if dst_cl is not None:
+            try:
+                dst_cl.request({"op": "drop_tablet", "pred": pred})
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            finally:
+                dst_cl.close()
+        self.propose_and_wait(("tablet_move_abort", (pred, mv["dst"])))
+        self._move_attempts.pop(pred, None)
+
+    def _drive_move(self, pred: str, mv: dict):
+        dst = mv["dst"]
+        src = mv.get("src")
+        if mv["phase"] == "start":
+            if src is None or src == dst:
+                self._abort_move(pred, mv)
+                return
+            src_cl = self._group_client(src)
+            dst_cl = self._group_client(dst)
+            if src_cl is None or dst_cl is None:
+                raise RuntimeError(
+                    f"groups {src}->{dst} not in the registry yet")
+            try:
+                blob = src_cl._unwrap(src_cl.request(
+                    {"op": "export_tablet", "pred": pred}))
+                dst_cl._unwrap(dst_cl.request(
+                    {"op": "import_tablet", "pred": pred,
+                     "blob": blob}))
+            except RuntimeError as e:
+                raise _MoveDataError(str(e)) from e
+            finally:
+                src_cl.close()
+                dst_cl.close()
+            ok, flipped = self.propose_and_wait(
+                ("tablet_move_done", (pred, dst)))
+            if not ok or not flipped:
+                raise RuntimeError("ownership flip not committed")
+            mv["phase"] = "flipped"
+        if mv["phase"] == "flipped":
+            # the new owner serves; drop the SOURCE copy — the group
+            # recorded in the ledger, NOT the tablet map (which
+            # already points at dst post-flip). Idempotent: a
+            # re-elected leader may re-issue it.
+            if src is not None and src != dst:
+                src_cl = self._group_client(src)
+                if src_cl is None:
+                    raise RuntimeError(f"group {src} unreachable")
+                try:
+                    resp = src_cl.request(
+                        {"op": "drop_tablet", "pred": pred})
+                    if not resp.get("ok") and "not served" not in str(
+                            resp.get("error", "")):
+                        raise RuntimeError(
+                            f"source drop failed: {resp.get('error')}")
+                finally:
+                    src_cl.close()
+            self.propose_and_wait(("move_finish", (pred,)))
+            self._move_attempts.pop(pred, None)
+            log.info("move_complete", pred=pred, dst=dst)
 
     def sm_apply(self, origin, cmd) -> Any:
         return self.state.apply(cmd)
@@ -1203,7 +1323,8 @@ class ZeroServer(RaftServer):
         if op in ("assign_ts", "assign_uids", "commit", "txn_status",
                   "abort_txn", "tablet",
                   "tablet_move_start", "tablet_move_done",
-                  "tablet_move_abort", "tablet_size", "tablet_sizes",
+                  "tablet_move_abort", "move_request",
+                  "tablet_size", "tablet_sizes",
                   "connect"):
             with self.lock:
                 if self.node.role != LEADER:

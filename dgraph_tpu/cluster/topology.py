@@ -412,58 +412,47 @@ class RoutedCluster:
 
     # --------------------------------------------------------- tablet move
 
-    def move_tablet(self, pred: str, dst_group: int) -> None:
-        """Live predicate move (ref zero/tablet.go:62 movetablet +
-        worker/predicate_move.go):
+    def move_tablet(self, pred: str, dst_group: int,
+                    timeout_s: float = 60.0) -> None:
+        """Live predicate move, OWNED by the Zero quorum (ref
+        zero/tablet.go:62 movetablet + worker/predicate_move.go): this
+        client only files the request and waits. Zero's leader drives
+        export -> import -> ownership flip -> source drop, persisting
+        each phase through its Raft group, so the move completes (or
+        aborts cleanly, pre-flip) even if THIS process — or the Zero
+        leader itself — dies mid-move. Concurrent movers serialize at
+        the ledger: the second request returns 'already moving'."""
+        import time as _time
 
-          1. zero marks the tablet read-only for the move
-          2. source group leader exports the rolled-up tablet
-          3. destination group imports it (replicated to its members)
-          4. zero flips ownership
-          5. source group drops its copy
-        """
         tmap = self.tablet_map()
         src = tmap["tablets"].get(pred)
         if src is None:
             raise RuntimeError(f"tablet {pred!r} is not served anywhere")
         if src == dst_group:
             return
-        resp = self.zero.request({"op": "tablet_move_start",
+        resp = self.zero.request({"op": "move_request",
                                   "args": (pred, dst_group)})
         if not resp.get("ok") or not resp.get("result"):
             raise RuntimeError(
                 f"tablet {pred!r} move refused: "
                 f"{resp.get('error', 'already moving?')}")
-        try:
-            blob = self.groups[src]._unwrap(self.groups[src].request(
-                {"op": "export_tablet", "pred": pred}))
-            self.groups[dst_group]._unwrap(
-                self.groups[dst_group].request(
-                    {"op": "import_tablet", "pred": pred, "blob": blob}))
-        except Exception:
-            # clear the moving mark without flipping ownership —
-            # writes resume against the source copy (if this also
-            # fails, abort_move() is the operator escape hatch)
-            self.abort_move(pred, dst_group)
-            raise
-        resp = self.zero.request({"op": "tablet_move_done",
-                                  "args": (pred, dst_group)})
-        if not resp.get("ok") or not resp.get("result"):
-            # the flip did NOT commit: Zero still routes to the source,
-            # so the source copy must survive — only the moving mark
-            # needs clearing (the destination's orphan copy is dropped
-            # best-effort)
-            self.abort_move(pred, dst_group)
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
             try:
-                self.groups[dst_group].request(
-                    {"op": "drop_tablet", "pred": pred})
-            except Exception:  # noqa: BLE001 — orphan copy is harmless
-                pass
-            raise RuntimeError(
-                f"tablet {pred!r} ownership flip failed: "
-                f"{resp.get('error', 'zero rejected the move')}")
-        self.groups[src]._unwrap(self.groups[src].request(
-            {"op": "drop_tablet", "pred": pred}))
+                tmap = self.tablet_map()
+            except RuntimeError:
+                _time.sleep(0.3)  # zero election in progress
+                continue
+            if pred not in tmap["moving"]:
+                if tmap["tablets"].get(pred) == dst_group:
+                    return
+                raise RuntimeError(
+                    f"tablet {pred!r} move aborted by zero "
+                    f"(owner is group {tmap['tablets'].get(pred)})")
+            _time.sleep(0.2)
+        raise TimeoutError(
+            f"tablet {pred!r} move still in flight after {timeout_s}s "
+            "(zero keeps driving it; check tablet_map later)")
 
     def abort_move(self, pred: str, dst_group: int) -> bool:
         """Clear a stuck moving mark without flipping ownership — the

@@ -62,6 +62,12 @@ class ZeroState:
         self.decided_floor = 0
         self.tablets: dict[str, int] = {}
         self.moving: dict[str, int] = {}   # pred -> destination group
+        # zero-owned move ledger (ref zero/tablet.go:62 movetablet —
+        # the LEADER drives moves; the replicated phase lets a new
+        # leader resume or roll back an in-flight move):
+        #   pred -> {"dst": group, "src": group,
+        #            "phase": "start" | "flipped"}
+        self.move_queue: dict[str, dict] = {}
         self.sizes: dict[str, int] = {}    # pred -> reported bytes
         # alpha registry: key (raft "host:port") -> member record
         # (zero/zero.go membership state)
@@ -118,18 +124,43 @@ class ZeroState:
                 return False
             self.moving[pred] = int(dst)
             return True
+        if op == "move_request":
+            # zero-owned move: marks read-only AND enqueues the move
+            # for the leader's driver thread (serialized: one ledger
+            # entry per pred; concurrent movers get False back)
+            pred, dst = args
+            if pred not in self.tablets or \
+                    self.tablets[pred] == int(dst) or pred in self.moving:
+                return False
+            self.moving[pred] = int(dst)
+            # src is captured HERE: after the flip the tablet map
+            # points at dst, and the driver still owes the drop on the
+            # ORIGINAL owner (a resumed leader must not lose it)
+            self.move_queue[pred] = {"dst": int(dst), "phase": "start",
+                                     "src": self.tablets[pred]}
+            return True
         if op == "tablet_move_done":
             pred, dst = args
             if self.moving.get(pred) != int(dst):
                 return False
             self.tablets[pred] = int(dst)
             del self.moving[pred]
+            if pred in self.move_queue:
+                # ownership flipped; the driver still owes the source
+                # drop — keep the ledger entry so a NEW leader redoes
+                # it after a crash (drop is idempotent)
+                self.move_queue[pred]["phase"] = "flipped"
             return True
         if op == "tablet_move_abort":
             pred, dst = args
             if self.moving.get(pred) != int(dst):
                 return False
             del self.moving[pred]  # ownership unchanged, writes resume
+            self.move_queue.pop(pred, None)
+            return True
+        if op == "move_finish":
+            (pred,) = args
+            self.move_queue.pop(pred, None)
             return True
         if op == "tablet_size":
             pred, nbytes = args
@@ -217,6 +248,8 @@ class ZeroState:
                 "decided_floor": self.decided_floor,
                 "tablets": dict(self.tablets),
                 "moving": dict(self.moving),
+                "move_queue": {k: dict(v)
+                               for k, v in self.move_queue.items()},
                 "sizes": dict(self.sizes),
                 "alphas": {k: dict(v) for k, v in self.alphas.items()}}
 
@@ -230,6 +263,8 @@ class ZeroState:
         st.decided_floor = snap.get("decided_floor", 0)
         st.tablets = dict(snap["tablets"])
         st.moving = dict(snap.get("moving", {}))
+        st.move_queue = {k: dict(v) for k, v
+                         in snap.get("move_queue", {}).items()}
         st.sizes = dict(snap.get("sizes", {}))
         st.alphas = {k: dict(v)
                      for k, v in snap.get("alphas", {}).items()}

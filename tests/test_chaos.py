@@ -347,3 +347,92 @@ def test_bank_split_across_groups_survives_move_and_leader_kill():
                 p.kill()
         for p in procs.values():
             p.wait()
+
+
+def test_zero_leader_killed_mid_move_completes_on_new_leader():
+    """The Zero quorum OWNS tablet moves (ref zero/tablet.go:62): the
+    move request lands in the replicated ledger, the leader's driver
+    executes phases, and each transition is raft-persisted. SIGKILL
+    the Zero leader right after filing the move: the NEW leader's
+    driver must finish (or cleanly abort) it — no stuck moving mark,
+    no lost data, never a half-moved tablet."""
+    ports = _free_ports(12)
+    procs = {}
+    clients = []
+    try:
+        z_peers = (f"1=127.0.0.1:{ports[0]},2=127.0.0.1:{ports[1]},"
+                   f"3=127.0.0.1:{ports[2]}")
+        for zid, cp in ((1, ports[3]), (2, ports[4]), (3, ports[5])):
+            procs[f"z{zid}"] = _spawn("zero", zid, z_peers,
+                                      f"127.0.0.1:{cp}")
+        zero_spec = (f"1=127.0.0.1:{ports[3]},2=127.0.0.1:{ports[4]},"
+                     f"3=127.0.0.1:{ports[5]}")
+        procs["a1"] = _spawn("alpha", 1, f"1=127.0.0.1:{ports[6]}",
+                             f"127.0.0.1:{ports[7]}", 1, zero_spec)
+        procs["b1"] = _spawn("alpha", 1, f"1=127.0.0.1:{ports[8]}",
+                             f"127.0.0.1:{ports[9]}", 2, zero_spec)
+
+        zc = ClusterClient({1: ("127.0.0.1", ports[3]),
+                            2: ("127.0.0.1", ports[4]),
+                            3: ("127.0.0.1", ports[5])}, timeout=30.0)
+        g1 = ClusterClient({1: ("127.0.0.1", ports[7])}, timeout=30.0)
+        g2 = ClusterClient({1: ("127.0.0.1", ports[9])}, timeout=30.0)
+        clients += [zc, g1, g2]
+        rc = RoutedCluster(zc, {1: g1, 2: g2})
+        for cl in (zc, g1, g2):
+            _wait_role(cl)
+
+        # a tablet with real content on group 1, registry warm (the
+        # driver resolves groups from zero's alpha registry)
+        g1.mutate(set_nquads="\n".join(
+            f'<{i:#x}> <mv_pred> "value {i}" .' for i in range(1, 301)))
+        end = time.monotonic() + 20
+        while time.monotonic() < end:
+            got = zc.request({"op": "cluster_state"})
+            alphas = got.get("result", {}).get("alphas", {})
+            if {rec["group"] for rec in alphas.values()} >= {1, 2}:
+                break
+            time.sleep(0.3)
+
+        # file the move, then immediately SIGKILL the zero leader
+        resp = zc.request({"op": "move_request",
+                           "args": ("mv_pred", 2)})
+        assert resp.get("ok") and resp["result"], resp
+        leader = _wait_role(zc)
+        victim = f"z{leader}"
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait()
+        zc.remove_node(leader)
+        _wait_role(zc)
+
+        # the new leader's driver must resolve the move
+        end = time.monotonic() + 60
+        final = None
+        while time.monotonic() < end:
+            try:
+                tmap = rc.tablet_map()
+            except RuntimeError:
+                time.sleep(0.3)
+                continue
+            if "mv_pred" not in tmap["moving"]:
+                final = tmap["tablets"].get("mv_pred")
+                break
+            time.sleep(0.3)
+        assert final in (1, 2), "move neither completed nor aborted"
+
+        # wherever it landed, the data serves completely
+        owner = {1: g1, 2: g2}[final]
+        got = owner.query('{ q(func: has(mv_pred)) { mv_pred } }')
+        assert len(got["data"]["q"]) == 300
+        # and the OTHER group no longer claims it after a completed move
+        if final == 2:
+            st = g1.status(1)
+            assert "mv_pred" not in st["tablets"]
+    finally:
+        for cl in clients:
+            cl.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs.values():
+            p.wait()
