@@ -1208,7 +1208,10 @@ class ZeroServer(RaftServer):
                     n = self._move_attempts.get(pred, 0) + 1
                     self._move_attempts[pred] = n
                     if n > 20 and mv["phase"] == "start":
-                        self._abort_move(pred, mv)
+                        try:
+                            self._abort_move(pred, mv)
+                        except Exception:  # noqa: BLE001 — an abort
+                            pass  # hiccup must never kill the driver
                 except Exception as e:  # noqa: BLE001 — retry next tick
                     log.warning("move_drive_retry", pred=pred,
                                 error=str(e)[:200])

@@ -173,6 +173,12 @@ def bulk_load(paths: Iterable[str] = (), *,
         tab.rebuild_index()
         tab.rebuild_reverse()
         db.coordinator.should_serve(pred)
+        if db.tablet_store is not None:
+            # disk-backed load: each predicate offloads to the LSM
+            # store as its reduce finishes, so the dataset never has
+            # to fit in RAM (ref bulk/reduce.go writing SSTs per
+            # predicate shard)
+            db.tablets.offload(pred)
     if own_tmp:
         for s in shards.values():
             for r in s.runs:

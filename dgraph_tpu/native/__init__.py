@@ -85,6 +85,9 @@ def _bind(lib):
                                    u8p, ctypes.c_uint64]
         lib.dgt_kv_count.restype = ctypes.c_uint64
         lib.dgt_kv_count.argtypes = [ctypes.c_void_p]
+        lib.dgt_kv_set_memtable.restype = None
+        lib.dgt_kv_set_memtable.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_uint64]
         lib.dgt_kv_flush.restype = ctypes.c_int
         lib.dgt_kv_flush.argtypes = [ctypes.c_void_p]
         lib.dgt_kv_snapshot.restype = ctypes.c_int
@@ -216,9 +219,16 @@ class NativeKV:
         self._lib.dgt_kv_flush(self._h)
 
     def snapshot(self):
-        """Persist full state, truncate the WAL."""
+        """Durability point: flush the memtable to a run and fully
+        compact the runs into one, truncating the WAL (the LSM's
+        replacement for the old whole-store SNAPSHOT dump)."""
         if self._lib.dgt_kv_snapshot(self._h) != 0:
             raise OSError("kv snapshot failed")
+
+    def set_memtable(self, nbytes: int):
+        """Lower/raise the memtable flush threshold (default 64MB, or
+        DGT_KV_MEMTABLE_BYTES at open)."""
+        self._lib.dgt_kv_set_memtable(self._h, nbytes)
 
     def close(self):
         if self._h:

@@ -26,9 +26,14 @@
 #if defined(_WIN32)
 #error "posix only"
 #endif
+#include <dirent.h>
 #include <fcntl.h>
+#include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
+
+#include <algorithm>
+#include <memory>
 
 namespace {
 
@@ -74,24 +79,467 @@ int wal_append_locked(Wal* w, const uint8_t* buf, uint32_t len) {
   return 0;
 }
 
-// ---------------------------------------------------------------- kv
+// ---------------------------------------------------------------- kv (LSM)
+//
+// The store is log-structured so datasets far beyond RAM load and
+// serve (the Badger role, posting/mvcc.go): writes land in a bounded
+// MEMTABLE (std::map) behind the CRC WAL; when it exceeds its cap it
+// flushes to an immutable SORTED RUN file (mmap'd, sparse-indexed,
+// crc-sealed, tmp+rename atomic) and the WAL truncates. Reads check
+// memtable then runs newest->oldest; deletes are tombstones so newer
+// layers shadow older ones. dgt_kv_snapshot() = flush + full
+// compaction of all runs into one (tombstones dropped). Crash
+// recovery = open runs + replay WAL into the memtable, truncating a
+// torn tail — the same contract as before, now with bounded memory.
+
+constexpr uint32_t kTomb = 0xFFFFFFFFu;
+constexpr char kRunMagic[8] = {'D', 'G', 'T', 'R', 'U', 'N', '1', 0};
+constexpr int kIndexEvery = 64;   // sparse index stride (records)
+
+struct Run {
+  std::string path;
+  int fd = -1;
+  uint8_t* map = (uint8_t*)MAP_FAILED;
+  size_t size = 0;
+  uint64_t recs_end = 0;  // records occupy [8, recs_end)
+  std::vector<std::pair<std::string, uint64_t>> index;  // key -> offset
+  ~Run() {
+    if (map != MAP_FAILED) munmap(map, size);
+    if (fd >= 0) close(fd);
+  }
+};
+using RunPtr = std::shared_ptr<Run>;
+
+struct Entry {
+  bool tomb = false;
+  std::string val;
+};
+
 struct Kv {
-  std::map<std::string, std::string> m;
+  std::map<std::string, Entry> mem;
+  size_t mem_bytes = 0;
+  size_t mem_cap = 64u << 20;
+  std::vector<RunPtr> runs;  // oldest .. newest
+  uint64_t next_run = 0;
   Wal wal;
   std::string dir;
   std::mutex mu;
   uint64_t wal_records = 0;
 };
 
+// one record in a run: klen u32 | vlen u32 (kTomb = tombstone) | key | val
+static bool run_decode_at(const Run& r, uint64_t off, std::string_view* k,
+                          std::string_view* v, bool* tomb,
+                          uint64_t* next_off) {
+  if (off + 8 > r.recs_end) return false;
+  uint32_t klen, vlen;
+  memcpy(&klen, r.map + off, 4);
+  memcpy(&vlen, r.map + off + 4, 4);
+  uint64_t vbytes = vlen == kTomb ? 0 : vlen;
+  if (off + 8 + klen + vbytes > r.recs_end) return false;
+  *k = std::string_view((const char*)r.map + off + 8, klen);
+  *v = std::string_view((const char*)r.map + off + 8 + klen, vbytes);
+  *tomb = vlen == kTomb;
+  *next_off = off + 8 + klen + vbytes;
+  return true;
+}
+
+// file layout: magic(8) | records | index{klen u32, key, off u64}* |
+// footer{recs_end u64, index_count u64, crc u32 over [8, size-20)}
+static RunPtr run_open(const std::string& path) {
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 28) {
+    close(fd);
+    return nullptr;
+  }
+  auto r = std::make_shared<Run>();
+  r->path = path;
+  r->fd = fd;
+  r->size = st.st_size;
+  r->map = (uint8_t*)mmap(nullptr, r->size, PROT_READ, MAP_SHARED, fd, 0);
+  if (r->map == MAP_FAILED) return nullptr;
+  if (memcmp(r->map, kRunMagic, 8) != 0) return nullptr;
+  uint64_t recs_end, icount;
+  uint32_t crc;
+  memcpy(&recs_end, r->map + r->size - 20, 8);
+  memcpy(&icount, r->map + r->size - 12, 8);
+  memcpy(&crc, r->map + r->size - 4, 4);
+  if (recs_end < 8 || recs_end > r->size - 20) return nullptr;
+  if (crc32(r->map + 8, r->size - 28) != crc) return nullptr;
+  uint64_t off = recs_end;
+  r->recs_end = recs_end;
+  const uint64_t limit = r->size - 20;
+  for (uint64_t i = 0; i < icount; i++) {
+    // sequential checks — a single combined expression here can
+    // underflow unsigned and wave a hostile klen through
+    if (off > limit || limit - off < 4) return nullptr;
+    uint32_t klen;
+    memcpy(&klen, r->map + off, 4);
+    off += 4;
+    if (klen > limit - off) return nullptr;
+    std::string key((const char*)r->map + off, klen);
+    off += klen;
+    if (limit - off < 8) return nullptr;
+    uint64_t roff;
+    memcpy(&roff, r->map + off, 8);
+    off += 8;
+    r->index.emplace_back(std::move(key), roff);
+  }
+  return r;
+}
+
+// scan start offset for `key` (or the range start for a prefix scan):
+// greatest index point <= key, else the records start
+static uint64_t run_seek(const Run& r, std::string_view key) {
+  auto it = std::upper_bound(
+      r.index.begin(), r.index.end(), key,
+      [](std::string_view k, const std::pair<std::string, uint64_t>& e) {
+        return k < std::string_view(e.first);
+      });
+  if (it == r.index.begin()) return 8;
+  return std::prev(it)->second;
+}
+
+// point lookup; returns 0 absent, 1 live (fills *out), 2 tombstone
+static int run_get(const Run& r, std::string_view key, std::string_view* out) {
+  uint64_t off = run_seek(r, key);
+  std::string_view k, v;
+  bool tomb;
+  uint64_t next;
+  while (run_decode_at(r, off, &k, &v, &tomb, &next)) {
+    if (k == key) {
+      if (tomb) return 2;
+      *out = v;
+      return 1;
+    }
+    if (k > key) return 0;  // sorted: passed it
+    off = next;
+  }
+  return 0;
+}
+
+// write the memtable (or any sorted (key, Entry) sequence) as a run
+template <typename It>
+static int run_write(const std::string& path, It begin, It end) {
+  std::string tmp = path + ".tmp";
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  std::vector<uint8_t> buf;
+  buf.reserve(1u << 20);
+  auto flush_buf = [&]() -> bool {
+    if (buf.empty()) return true;
+    bool ok = write(fd, buf.data(), buf.size()) == (ssize_t)buf.size();
+    buf.clear();
+    return ok;
+  };
+  auto put_raw = [&](const void* p, size_t n) {
+    const uint8_t* b = (const uint8_t*)p;
+    buf.insert(buf.end(), b, b + n);
+  };
+  uint32_t crc = 0xFFFFFFFFu;
+  auto crc_feed = [&](const uint8_t* p, size_t n) {
+    for (size_t i = 0; i < n; i++)
+      crc = crc_table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  };
+  // incremental crc over everything after the magic
+  put_raw(kRunMagic, 8);
+  bool ok = flush_buf();
+  uint64_t off = 8;
+  std::vector<std::pair<std::string, uint64_t>> index;
+  uint64_t n = 0;
+  for (It it = begin; ok && it != end; ++it, ++n) {
+    const std::string& key = it->first;
+    const Entry& e = it->second;
+    if (n % kIndexEvery == 0) index.emplace_back(key, off);
+    uint32_t klen = key.size();
+    uint32_t vlen = e.tomb ? kTomb : (uint32_t)e.val.size();
+    put_raw(&klen, 4);
+    put_raw(&vlen, 4);
+    put_raw(key.data(), key.size());
+    if (!e.tomb) put_raw(e.val.data(), e.val.size());
+    crc_feed(buf.data(), buf.size());
+    off += buf.size();
+    ok = flush_buf();
+  }
+  uint64_t recs_end = off;
+  for (auto& ip : index) {
+    uint32_t klen = ip.first.size();
+    put_raw(&klen, 4);
+    put_raw(ip.first.data(), klen);
+    put_raw(&ip.second, 8);
+  }
+  crc_feed(buf.data(), buf.size());
+  uint64_t icount = index.size();
+  put_raw(&recs_end, 8);
+  put_raw(&icount, 8);
+  uint32_t final_crc = crc ^ 0xFFFFFFFFu;
+  put_raw(&final_crc, 4);
+  ok = ok && flush_buf() && fsync(fd) == 0;
+  close(fd);
+  if (!ok || rename(tmp.c_str(), path.c_str()) != 0) {
+    unlink(tmp.c_str());
+    return -1;
+  }
+  return 0;
+}
+
+// MANIFEST: newline list of valid run files, replaced atomically.
+// The run set is only authoritative once manifested — a crash between
+// writing a run (or compacting) and the manifest update leaves the
+// previous manifest in force and the orphan file is deleted at the
+// next open. This is what makes compaction's tombstone dropping
+// crash-safe: shadowed old runs can never be resurrected, because the
+// manifest flips from {old runs} to {merged} in one rename.
+static int kv_write_manifest(Kv* kv) {
+  std::string body;
+  for (auto& r : kv->runs) {
+    size_t slash = r->path.find_last_of('/');
+    body += r->path.substr(slash + 1);
+    body += '\n';
+  }
+  std::string tmp = kv->dir + "/MANIFEST.tmp";
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  bool ok = write(fd, body.data(), body.size()) == (ssize_t)body.size() &&
+            fsync(fd) == 0;
+  close(fd);
+  if (!ok) return -1;
+  return rename(tmp.c_str(), (kv->dir + "/MANIFEST").c_str());
+}
+
+// locked: memtable -> new run, manifest it, clear memtable, truncate
+// WAL (run+manifest are durable FIRST, so a crash in between only
+// replays shadowed records)
+static int kv_flush_locked(Kv* kv) {
+  if (kv->mem.empty()) return 0;
+  char name[32];
+  snprintf(name, sizeof name, "run-%08llu.sst",
+           (unsigned long long)kv->next_run);
+  std::string path = kv->dir + "/" + name;
+  if (run_write(path, kv->mem.begin(), kv->mem.end()) != 0) return -1;
+  RunPtr r = run_open(path);
+  if (!r) return -1;
+  kv->next_run++;
+  kv->runs.push_back(std::move(r));
+  if (kv_write_manifest(kv) != 0) return -1;
+  kv->mem.clear();
+  kv->mem_bytes = 0;
+  if (ftruncate(kv->wal.fd, 0) != 0) return -1;
+  lseek(kv->wal.fd, 0, SEEK_SET);
+  if (write(kv->wal.fd, kWalMagic, 8) != 8) return -1;
+  if (fsync(kv->wal.fd) != 0) return -1;
+  kv->wal_records = 0;
+  return 0;
+}
+
+// streaming k-way merge over memtable + runs (newest shadows oldest)
+struct MergeCur {
+  // layer 0 = memtable iterators (highest priority), then runs newest
+  // to oldest
+  std::map<std::string, Entry>::const_iterator mit, mend;
+  bool is_mem = false;
+  RunPtr run;
+  uint64_t off = 0;
+  std::string_view k, v;
+  bool tomb = false;
+  bool done = false;
+
+  void load() {
+    if (is_mem) {
+      if (mit == mend) {
+        done = true;
+        return;
+      }
+      k = mit->first;
+      v = mit->second.val;
+      tomb = mit->second.tomb;
+    } else {
+      uint64_t next;
+      if (!run_decode_at(*run, off, &k, &v, &tomb, &next)) {
+        done = true;
+        return;
+      }
+    }
+  }
+  void advance() {
+    if (is_mem) {
+      ++mit;
+    } else {
+      uint64_t next;
+      std::string_view k2, v2;
+      bool t2;
+      run_decode_at(*run, off, &k2, &v2, &t2, &next);
+      off = next;
+    }
+    load();
+  }
+};
+
+// visible (non-shadowed) records in key order; layers[0] wins ties
+struct MergeView {
+  std::vector<MergeCur> layers;
+
+  void init_all() {
+    for (auto& c : layers) c.load();
+  }
+  // -> false when exhausted
+  bool next(std::string* key, std::string* val, bool* tomb) {
+    for (;;) {
+      int best = -1;
+      for (size_t i = 0; i < layers.size(); i++) {
+        if (layers[i].done) continue;
+        if (best < 0 || layers[i].k < layers[best].k) best = (int)i;
+      }
+      if (best < 0) return false;
+      std::string k(layers[best].k);
+      std::string v(layers[best].v);
+      bool t = layers[best].tomb;
+      for (auto& c : layers) {  // advance every layer sitting on k
+        while (!c.done && c.k == std::string_view(k)) c.advance();
+      }
+      *key = std::move(k);
+      *val = std::move(v);
+      *tomb = t;
+      return true;
+    }
+  }
+};
+
+static MergeView kv_merge_view_locked(Kv* kv) {
+  MergeView mv;
+  MergeCur m;
+  m.is_mem = true;
+  m.mit = kv->mem.begin();
+  m.mend = kv->mem.end();
+  mv.layers.push_back(m);
+  for (auto it = kv->runs.rbegin(); it != kv->runs.rend(); ++it) {
+    MergeCur c;
+    c.run = *it;
+    c.off = 8;
+    mv.layers.push_back(c);
+  }
+  mv.init_all();
+  return mv;
+}
+
+// full compaction: flush memtable, then merge every run into ONE new
+// run with tombstones dropped; old run files unlink afterwards
+static int kv_compact_locked(Kv* kv) {
+  if (kv_flush_locked(kv) != 0) return -1;
+  if (kv->runs.size() <= 1) return 0;
+  // merge through a bounded buffer: chunks stream into the writer via
+  // a temporary std::map-like vector (already sorted by the merge)
+  MergeView mv = kv_merge_view_locked(kv);
+  char name[32];
+  snprintf(name, sizeof name, "run-%08llu.sst",
+           (unsigned long long)kv->next_run);
+  std::string path = kv->dir + "/" + name;
+  // adapter: MergeView as an iterator pair for run_write via a
+  // generator-style vector window is awkward in C++17 templates, so
+  // stream manually with the same format
+  std::string tmp = path + ".tmp";
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  uint32_t crc = 0xFFFFFFFFu;
+  std::vector<uint8_t> buf;
+  auto put_raw = [&](const void* p, size_t n) {
+    const uint8_t* b = (const uint8_t*)p;
+    buf.insert(buf.end(), b, b + n);
+  };
+  auto crc_flush = [&]() -> bool {
+    for (size_t i = 0; i < buf.size(); i++)
+      crc = crc_table[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8);
+    bool ok = buf.empty() ||
+              write(fd, buf.data(), buf.size()) == (ssize_t)buf.size();
+    buf.clear();
+    return ok;
+  };
+  bool ok = write(fd, kRunMagic, 8) == 8;
+  uint64_t off = 8, n = 0;
+  std::vector<std::pair<std::string, uint64_t>> index;
+  std::string k, v;
+  bool tomb;
+  while (ok && mv.next(&k, &v, &tomb)) {
+    if (tomb) continue;  // full compaction: nothing older to shadow
+    if (n % kIndexEvery == 0) index.emplace_back(k, off);
+    uint32_t klen = k.size(), vlen = v.size();
+    put_raw(&klen, 4);
+    put_raw(&vlen, 4);
+    put_raw(k.data(), klen);
+    put_raw(v.data(), vlen);
+    off += 8 + klen + vlen;
+    n++;
+    if (buf.size() > (1u << 20)) ok = crc_flush();
+  }
+  uint64_t recs_end = off;
+  for (auto& ip : index) {
+    uint32_t klen = ip.first.size();
+    put_raw(&klen, 4);
+    put_raw(ip.first.data(), klen);
+    put_raw(&ip.second, 8);
+  }
+  ok = ok && crc_flush();
+  uint64_t icount = index.size();
+  uint32_t final_crc = crc ^ 0xFFFFFFFFu;
+  put_raw(&recs_end, 8);
+  put_raw(&icount, 8);
+  put_raw(&final_crc, 4);
+  ok = ok && (write(fd, buf.data(), buf.size()) == (ssize_t)buf.size()) &&
+       fsync(fd) == 0;
+  buf.clear();
+  close(fd);
+  if (!ok || rename(tmp.c_str(), path.c_str()) != 0) {
+    unlink(tmp.c_str());
+    return -1;
+  }
+  RunPtr merged = run_open(path);
+  if (!merged) return -1;
+  kv->next_run++;
+  std::vector<RunPtr> old;
+  old.swap(kv->runs);
+  kv->runs.push_back(std::move(merged));
+  if (kv_write_manifest(kv) != 0) {  // the atomic old->merged flip
+    kv->runs.swap(old);
+    return -1;
+  }
+  for (auto& r : old) unlink(r->path.c_str());  // open fds keep iterators alive
+  return 0;
+}
+
 struct KvIter {
-  Kv* kv;
-  std::vector<std::pair<std::string, std::string>> items;  // stable snapshot
-  size_t pos = 0;
+  MergeView mv;
+  // memtable slice copied for stability (bounded by the memtable cap);
+  // run layers hold RunPtr refs so compaction can't unmap under us
+  std::map<std::string, Entry> mem_copy;
+  std::string prefix;
+  std::string cur_k, cur_v;
+  bool have = false;
+
+  void step() {
+    std::string k, v;
+    bool tomb;
+    have = false;
+    while (mv.next(&k, &v, &tomb)) {
+      if (k.compare(0, prefix.size(), prefix) != 0) {
+        if (k > prefix) return;  // sorted: past the prefix range
+        continue;
+      }
+      if (tomb) continue;
+      cur_k = std::move(k);
+      cur_v = std::move(v);
+      have = true;
+      return;
+    }
+  }
 };
 
 constexpr char kSnapMagic[8] = {'D', 'G', 'T', 'S', 'N', 'P', '2', 0};
 
 // WAL payload: op(1) | klen(u32) | key | vlen(u32) | value   op: 0=put 1=del
+// Deletes become TOMBSTONES in the memtable — they must shadow older
+// run layers, not just drop the memtable entry.
 void kv_apply(Kv* kv, const uint8_t* p, uint32_t len) {
   if (len < 5) return;
   uint8_t op = p[0];
@@ -100,15 +548,17 @@ void kv_apply(Kv* kv, const uint8_t* p, uint32_t len) {
   if (5 + klen > len) return;
   std::string key((const char*)p + 5, klen);
   if (op == 1) {
-    kv->m.erase(key);
+    kv->mem_bytes += key.size() + 64;
+    kv->mem[std::move(key)] = Entry{true, std::string()};
     return;
   }
   if (5 + klen + 4 > len) return;
   uint32_t vlen;
   memcpy(&vlen, p + 5 + klen, 4);
   if (9 + klen + vlen > len) return;
-  kv->m[std::move(key)] =
-      std::string((const char*)p + 9 + klen, vlen);
+  kv->mem_bytes += key.size() + vlen + 64;
+  kv->mem[std::move(key)] =
+      Entry{false, std::string((const char*)p + 9 + klen, vlen)};
 }
 
 int wal_open_file(Wal* w, const std::string& path, int sync) {
@@ -154,36 +604,6 @@ int kv_replay(Kv* kv) {
   return 0;
 }
 
-// Snapshot format: magic | count(u64) | repeat{klen u32, key, vlen u32, val}
-// | crc32 of everything after magic.
-int kv_write_snapshot(Kv* kv, const std::string& path) {
-  std::string tmp = path + ".tmp";
-  std::vector<uint8_t> body;
-  uint64_t count = kv->m.size();
-  auto put_raw = [&](const void* p, size_t n) {
-    const uint8_t* b = (const uint8_t*)p;
-    body.insert(body.end(), b, b + n);
-  };
-  put_raw(&count, 8);
-  for (auto& it : kv->m) {
-    uint32_t klen = it.first.size(), vlen = it.second.size();
-    put_raw(&klen, 4);
-    put_raw(it.first.data(), klen);
-    put_raw(&vlen, 4);
-    put_raw(it.second.data(), vlen);
-  }
-  uint32_t crc = crc32(body.data(), body.size());
-  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
-  if (fd < 0) return -1;
-  bool ok = write(fd, kSnapMagic, 8) == 8 &&
-            write(fd, body.data(), body.size()) == (ssize_t)body.size() &&
-            write(fd, &crc, 4) == 4 && fsync(fd) == 0;
-  close(fd);
-  if (!ok) return -1;
-  if (rename(tmp.c_str(), path.c_str()) != 0) return -1;
-  return 0;
-}
-
 int kv_load_snapshot(Kv* kv, const std::string& path) {
   int fd = open(path.c_str(), O_RDONLY);
   if (fd < 0) return 1;  // no snapshot: fine
@@ -215,7 +635,9 @@ int kv_load_snapshot(Kv* kv, const std::string& path) {
     memcpy(&vlen, &data[off], 4);
     off += 4;
     if (vlen > end - off) return -2;
-    kv->m[std::move(key)] = std::string((const char*)&data[off], vlen);
+    kv->mem_bytes += key.size() + vlen + 64;
+    kv->mem[std::move(key)] =
+        Entry{false, std::string((const char*)&data[off], vlen)};
     off += vlen;
   }
   return 0;
@@ -233,17 +655,74 @@ void* dgt_kv_open(const char* dir, int sync) {
   Kv* kv = new Kv();
   kv->dir = dir;
   mkdir(dir, 0755);
-  kv_load_snapshot(kv, kv->dir + "/SNAPSHOT");
+  if (const char* cap = getenv("DGT_KV_MEMTABLE_BYTES")) {
+    unsigned long long v = strtoull(cap, nullptr, 10);
+    if (v >= (1u << 16)) kv->mem_cap = v;
+  }
+  // open the immutable runs: the MANIFEST (when present) is the
+  // authoritative set; run files it does not list are crash orphans
+  // (flush or compaction died before the manifest flip) and are
+  // deleted — loading them could resurrect compacted-away deletes
+  {
+    std::vector<std::string> listed;
+    bool have_manifest = false;
+    if (FILE* mf = fopen((kv->dir + "/MANIFEST").c_str(), "r")) {
+      have_manifest = true;
+      char line[64];
+      while (fgets(line, sizeof line, mf)) {
+        std::string n(line);
+        while (!n.empty() && (n.back() == '\n' || n.back() == '\r'))
+          n.pop_back();
+        if (!n.empty()) listed.push_back(n);
+      }
+      fclose(mf);
+    }
+    std::vector<std::string> names;
+    if (DIR* d = opendir(dir)) {
+      while (struct dirent* de = readdir(d)) {
+        std::string n = de->d_name;
+        if (n.size() == 16 && n.compare(0, 4, "run-") == 0 &&
+            n.compare(12, 4, ".sst") == 0)
+          names.push_back(n);
+      }
+      closedir(d);
+    }
+    std::sort(names.begin(), names.end());
+    for (auto& n : names) {
+      uint64_t seq = strtoull(n.c_str() + 4, nullptr, 10);
+      if (seq + 1 > kv->next_run) kv->next_run = seq + 1;
+      bool ok = !have_manifest ||
+                std::find(listed.begin(), listed.end(), n) != listed.end();
+      if (!ok) {
+        unlink((kv->dir + "/" + n).c_str());
+        continue;
+      }
+      RunPtr r = run_open(kv->dir + "/" + n);
+      if (r) kv->runs.push_back(std::move(r));
+    }
+  }
+  // legacy pre-LSM stores: SNAPSHOT loads into the memtable once and
+  // becomes a run at the next flush
+  if (kv_load_snapshot(kv, kv->dir + "/SNAPSHOT") == 0)
+    unlink((kv->dir + "/SNAPSHOT").c_str());
   if (wal_open_file(&kv->wal, kv->dir + "/WAL", sync) != 0) {
     delete kv;
     return nullptr;
   }
   if (kv_replay(kv) < 0) {
     close(kv->wal.fd);
+    kv->wal.fd = -1;
     delete kv;
     return nullptr;
   }
   return kv;
+}
+
+// lower the memtable cap (tests exercise multi-run shapes with it)
+void dgt_kv_set_memtable(void* h, uint64_t bytes) {
+  Kv* kv = (Kv*)h;
+  std::lock_guard<std::mutex> lk(kv->mu);
+  kv->mem_cap = bytes < (1u << 10) ? (1u << 10) : bytes;
 }
 
 int dgt_kv_put(void* h, const uint8_t* key, uint32_t klen,
@@ -258,8 +737,10 @@ int dgt_kv_put(void* h, const uint8_t* key, uint32_t klen,
   memcpy(&rec[9 + klen], val, vlen);
   if (wal_append_locked(&kv->wal, rec.data(), rec.size()) != 0) return -1;
   kv->wal_records++;
-  kv->m[std::string((const char*)key, klen)] =
-      std::string((const char*)val, vlen);
+  kv->mem_bytes += klen + vlen + 64;
+  kv->mem[std::string((const char*)key, klen)] =
+      Entry{false, std::string((const char*)val, vlen)};
+  if (kv->mem_bytes > kv->mem_cap) return kv_flush_locked(kv);
   return 0;
 }
 
@@ -272,7 +753,9 @@ int dgt_kv_del(void* h, const uint8_t* key, uint32_t klen) {
   memcpy(&rec[5], key, klen);
   if (wal_append_locked(&kv->wal, rec.data(), rec.size()) != 0) return -1;
   kv->wal_records++;
-  kv->m.erase(std::string((const char*)key, klen));
+  kv->mem_bytes += klen + 64;
+  kv->mem[std::string((const char*)key, klen)] = Entry{true, std::string()};
+  if (kv->mem_bytes > kv->mem_cap) return kv_flush_locked(kv);
   return 0;
 }
 
@@ -281,19 +764,43 @@ int64_t dgt_kv_get(void* h, const uint8_t* key, uint32_t klen,
                    uint8_t* out, uint64_t cap) {
   Kv* kv = (Kv*)h;
   std::lock_guard<std::mutex> lk(kv->mu);
-  auto it = kv->m.find(std::string((const char*)key, klen));
-  if (it == kv->m.end()) return -1;
-  if (out) {
-    uint64_t n = it->second.size() < cap ? it->second.size() : cap;
-    memcpy(out, it->second.data(), n);
+  std::string k((const char*)key, klen);
+  auto it = kv->mem.find(k);
+  std::string_view val;
+  if (it != kv->mem.end()) {
+    if (it->second.tomb) return -1;
+    val = it->second.val;
+  } else {
+    bool found = false;
+    for (auto r = kv->runs.rbegin(); r != kv->runs.rend(); ++r) {
+      int got = run_get(**r, k, &val);
+      if (got == 2) return -1;  // tombstone shadows older layers
+      if (got == 1) {
+        found = true;
+        break;
+      }
+    }
+    if (!found) return -1;
   }
-  return (int64_t)it->second.size();
+  if (out) {
+    uint64_t n = val.size() < cap ? val.size() : cap;
+    memcpy(out, val.data(), n);
+  }
+  return (int64_t)val.size();
 }
 
+// exact live-key count: one streaming merge pass (an infrequent
+// introspection call; the hot path never needs it)
 uint64_t dgt_kv_count(void* h) {
   Kv* kv = (Kv*)h;
   std::lock_guard<std::mutex> lk(kv->mu);
-  return kv->m.size();
+  MergeView mv = kv_merge_view_locked(kv);
+  uint64_t n = 0;
+  std::string k, v;
+  bool tomb;
+  while (mv.next(&k, &v, &tomb))
+    if (!tomb) n++;
+  return n;
 }
 
 // fsync the WAL (used when sync=0 for batched durability points).
@@ -303,54 +810,67 @@ int dgt_kv_flush(void* h) {
   return fsync(kv->wal.fd) == 0 ? 0 : -1;
 }
 
-// Writes SNAPSHOT atomically and truncates the WAL.
+// Durability point: flush the memtable to a run and fully compact the
+// runs into one (tombstones dropped), truncating the WAL. The LSM's
+// replacement for the old whole-store SNAPSHOT file.
 int dgt_kv_snapshot(void* h) {
   Kv* kv = (Kv*)h;
   std::lock_guard<std::mutex> lk(kv->mu);
-  if (kv_write_snapshot(kv, kv->dir + "/SNAPSHOT") != 0) return -1;
-  if (ftruncate(kv->wal.fd, 0) != 0) return -1;
-  lseek(kv->wal.fd, 0, SEEK_SET);
-  if (write(kv->wal.fd, kWalMagic, 8) != 8) return -1;
-  kv->wal_records = 0;
-  return 0;
+  return kv_compact_locked(kv);
 }
 
 void dgt_kv_close(void* h) {
   Kv* kv = (Kv*)h;
   close(kv->wal.fd);
+  kv->wal.fd = -1;
   delete kv;
 }
 
-// Prefix iterator over a stable snapshot of the keyspace.
+// Prefix iterator: STREAMING k-way merge (memtable slice copied for
+// stability — bounded by the memtable cap — run layers pinned via
+// shared_ptr so compaction can't unmap them mid-scan). Key-ordered,
+// tombstone-shadowed; full-store scans never materialize the keyspace.
 void* dgt_kv_iter(void* h, const uint8_t* prefix, uint32_t plen) {
   Kv* kv = (Kv*)h;
   KvIter* it = new KvIter();
-  it->kv = kv;
-  std::string pfx((const char*)prefix, plen);
+  it->prefix.assign((const char*)prefix, plen);
   std::lock_guard<std::mutex> lk(kv->mu);
-  for (auto i = kv->m.lower_bound(pfx); i != kv->m.end(); ++i) {
-    if (i->first.compare(0, pfx.size(), pfx) != 0) break;
-    it->items.push_back(*i);
+  auto lo = kv->mem.lower_bound(it->prefix);
+  for (auto m = lo; m != kv->mem.end(); ++m) {
+    if (m->first.compare(0, plen, it->prefix) != 0) break;
+    it->mem_copy.emplace(m->first, m->second);
   }
+  MergeCur memc;
+  memc.is_mem = true;
+  memc.mit = it->mem_copy.begin();
+  memc.mend = it->mem_copy.end();
+  it->mv.layers.push_back(memc);
+  for (auto r = kv->runs.rbegin(); r != kv->runs.rend(); ++r) {
+    MergeCur c;
+    c.run = *r;
+    c.off = it->prefix.empty() ? 8 : run_seek(**r, it->prefix);
+    it->mv.layers.push_back(c);
+  }
+  it->mv.init_all();
+  it->step();
   return it;
 }
 
-// Advances; returns 0 and fills lengths, or -1 at end. Two-call pattern:
-// first with null bufs to get sizes, then with bufs (same position until
-// dgt_kv_iter_advance).
+// Two-phase contract (unchanged): a call whose buffers are null
+// reports sizes WITHOUT advancing; a call with buffers copies the
+// record and advances.
 int dgt_kv_iter_next(void* hi, uint8_t* kout, uint64_t kcap, uint64_t* klen,
                      uint8_t* vout, uint64_t vcap, uint64_t* vlen) {
   KvIter* it = (KvIter*)hi;
-  if (it->pos >= it->items.size()) return -1;
-  auto& kvp = it->items[it->pos];
-  *klen = kvp.first.size();
-  *vlen = kvp.second.size();
+  if (!it->have) return -1;
+  *klen = it->cur_k.size();
+  *vlen = it->cur_v.size();
   if (kout) {
-    memcpy(kout, kvp.first.data(),
-           kvp.first.size() < kcap ? kvp.first.size() : kcap);
-    memcpy(vout, kvp.second.data(),
-           kvp.second.size() < vcap ? kvp.second.size() : vcap);
-    it->pos++;
+    memcpy(kout, it->cur_k.data(),
+           it->cur_k.size() < kcap ? it->cur_k.size() : kcap);
+    memcpy(vout, it->cur_v.data(),
+           it->cur_v.size() < vcap ? it->cur_v.size() : vcap);
+    it->step();
   }
   return 0;
 }

@@ -29,6 +29,7 @@ int dgt_kv_flush(void*);
 int dgt_kv_snapshot(void*);
 void dgt_kv_close(void*);
 void* dgt_kv_iter(void*, const uint8_t*, uint32_t);
+void dgt_kv_set_memtable(void*, uint64_t);
 int dgt_kv_iter_next(void*, uint8_t*, uint64_t, uint64_t*, uint8_t*,
                      uint64_t, uint64_t*);
 void dgt_kv_iter_close(void*);
@@ -91,6 +92,51 @@ static void test_kv(const std::string& dir) {
   printf("kv ok (%llu keys)\n", (unsigned long long)n);
 }
 
+// LSM shape under sanitizers: a tiny memtable forces many immutable
+// runs; tombstone shadowing, cross-run scans, full compaction and
+// reopen must all be clean of OOB/UB.
+static void test_kv_lsm(const std::string& dir) {
+  void* kv = dgt_kv_open(dir.c_str(), 0);
+  assert(kv);
+  dgt_kv_set_memtable(kv, 1400);
+  for (int i = 0; i < 400; i++) {
+    char k[32], v[96];
+    snprintf(k, sizeof k, "lsm/%05d", i);
+    snprintf(v, sizeof v, "payload-%d-%d-%d", i, i * 3, i * 11);
+    assert(dgt_kv_put(kv, B(k), strlen(k), B(v), strlen(v)) == 0);
+  }
+  for (int i = 0; i < 400; i += 5) {
+    char k[32];
+    snprintf(k, sizeof k, "lsm/%05d", i);
+    assert(dgt_kv_del(kv, B(k), strlen(k)) == 0);
+  }
+  uint8_t out[160];
+  assert(dgt_kv_get(kv, B("lsm/00001"), 9, out, sizeof out) > 0);
+  assert(dgt_kv_get(kv, B("lsm/00005"), 9, out, sizeof out) < 0);
+  uint64_t live = dgt_kv_count(kv);
+  assert(live == 400 - 80);
+  // iterator pinned across a compaction: shared_ptr keeps old runs
+  // mapped until the cursor drops them
+  void* it = dgt_kv_iter(kv, B("lsm/001"), 7);
+  assert(it);
+  assert(dgt_kv_snapshot(kv) == 0);  // full compaction underneath
+  uint64_t klen, vlen, seen = 0;
+  uint8_t kbuf[64], vbuf[160];
+  while (dgt_kv_iter_next(it, kbuf, sizeof kbuf, &klen, vbuf,
+                          sizeof vbuf, &vlen) == 0)
+    seen++;
+  dgt_kv_iter_close(it);
+  assert(seen == 80);  // lsm/00100..lsm/00199 minus every 5th
+  assert(dgt_kv_count(kv) == live);
+  dgt_kv_close(kv);
+  void* kv2 = dgt_kv_open(dir.c_str(), 0);
+  assert(kv2);
+  assert(dgt_kv_count(kv2) == live);
+  assert(dgt_kv_get(kv2, B("lsm/00399"), 9, out, sizeof out) > 0);
+  dgt_kv_close(kv2);
+  printf("kv lsm ok (%llu live)\n", (unsigned long long)live);
+}
+
 static void test_wal(const std::string& path) {
   void* w = dgt_wal_open(path.c_str(), 0);
   assert(w);
@@ -151,6 +197,7 @@ static void test_match() {
 int main(int argc, char** argv) {
   std::string dir = argc > 1 ? argv[1] : "/tmp/dgt-sanitize";
   test_kv(dir + "/kv");
+  test_kv_lsm(dir + "/kvlsm");
   test_wal(dir + "/test.wal");
   test_codec();
   test_match();

@@ -1,0 +1,171 @@
+"""Disk-backed tablet storage: datasets larger than the resident
+budget bulk-load and serve (the Badger role, posting/mvcc.go:143;
+round-2 VERDICT Missing #4 'a wall at 210M')."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.ingest.bulk import bulk_load
+
+N_PREDS = 24
+ROWS_PER_PRED = 400
+
+
+def _dataset(tmp_path):
+    lines = []
+    for p in range(N_PREDS):
+        for i in range(1, ROWS_PER_PRED + 1):
+            uid = p * 10_000 + i
+            lines.append(
+                f'<{uid:#x}> <pred{p:02d}> "payload {p}/{i} '
+                f'{"x" * 64}" .')
+    path = tmp_path / "data.rdf"
+    path.write_text("\n".join(lines))
+    return str(path)
+
+
+def test_bulk_load_and_serve_beyond_budget(tmp_path):
+    """The dataset is ~24x the tablet budget: bulk load offloads each
+    predicate as it reduces, queries materialize tablets on demand,
+    eviction keeps residency at the budget — and every predicate still
+    answers exactly."""
+    budget = 200_000  # bytes: roughly 3-4 tablets of this shape
+    db = GraphDB(prefer_device=False,
+                 store_dir=str(tmp_path / "store"),
+                 tablet_budget=budget)
+    bulk_load([_dataset(tmp_path)], db=db)
+
+    tm = db.tablets
+    assert len(tm.stored) == N_PREDS
+    total_bytes = 0
+    for p in range(N_PREDS):
+        tab = tm.get(f"pred{p:02d}")
+        total_bytes += tab.approx_bytes()
+    assert total_bytes > 4 * budget, "dataset must dwarf the budget"
+    # after touching every predicate, residency obeys the budget
+    # (plus at most one tablet of slack while it loads)
+    biggest = max(tm._lru.values())
+    assert tm.resident_bytes <= budget + biggest
+    assert tm.peak_resident <= budget + biggest
+    assert tm.evictions >= N_PREDS  # bulk offload + query churn
+
+    # every predicate serves exact answers through the query surface
+    for p in (0, 7, 23):
+        out = db.query(
+            '{ q(func: uid(%s)) { pred%02d } }'
+            % (hex(p * 10_000 + 5), p))
+        assert out["data"]["q"][0][f"pred{p:02d}"].startswith(
+            f"payload {p}/5 ")
+    db.close()
+
+
+def test_store_reopen_serves_without_reload(tmp_path):
+    db = GraphDB(prefer_device=False,
+                 store_dir=str(tmp_path / "store"),
+                 tablet_budget=100_000)
+    db.alter("name: string @index(exact) .\nfriend: [uid] .")
+    db.mutate(set_nquads='<0x1> <name> "ada" .\n<0x1> <friend> <0x2> .\n'
+                         '<0x2> <name> "bob" .')
+    db.rollup_all()
+    db.close()
+
+    db2 = GraphDB(prefer_device=False,
+                  store_dir=str(tmp_path / "store"))
+    assert sorted(db2.tablets.keys()) >= ["friend", "name"]
+    out = db2.query('{ q(func: eq(name, "ada")) { name friend { name } } }')
+    assert out["data"]["q"] == [
+        {"name": "ada", "friend": [{"name": "bob"}]}]
+    db2.close()
+
+
+def test_dirty_tablets_never_evict(tmp_path):
+    db = GraphDB(prefer_device=False,
+                 store_dir=str(tmp_path / "store"),
+                 tablet_budget=1)  # everything over budget
+    db.mutate(set_nquads='<0x1> <hot> "a" .')
+    txn = db.new_txn()  # pins the rollup watermark
+    db.mutate(set_nquads='<0x1> <hot> "b" .')
+    tab = db.tablets.get("hot")
+    assert tab.dirty()
+    db.tablets._maybe_evict()
+    assert "hot" in dict.keys(db.tablets), "dirty tablet was evicted"
+    db.discard(txn)
+    db.close()
+
+
+def test_checkpoint_compacts_store(tmp_path):
+    from dgraph_tpu import native
+    if not native.available():
+        pytest.skip("native lib not built")
+    d = tmp_path / "store"
+    db = GraphDB(prefer_device=False, store_dir=str(d),
+                 tablet_budget=10_000)
+    lines = [f'<{i:#x}> <p{i % 8}> "v{i}" .' for i in range(1, 400)]
+    db.mutate(set_nquads="\n".join(lines))
+    db.rollup_all()
+    db.checkpoint()
+    runs = [f for f in os.listdir(d) if f.endswith(".sst")]
+    assert len(runs) == 1
+    out = db.query('{ q(func: uid(0x7)) { p7 } }')
+    assert out["data"]["q"] == [{"p7": "v7"}]
+    db.close()
+
+
+def test_backup_covers_evicted_predicates(tmp_path):
+    """Whole-store walks (backup here) must include predicates that
+    are offloaded to the store, not just resident ones (review
+    finding: resident-only iteration would silently lose data)."""
+    from dgraph_tpu.storage.backup import backup, restore
+
+    db = GraphDB(prefer_device=False,
+                 store_dir=str(tmp_path / "store"),
+                 tablet_budget=1)  # evict aggressively
+    db.mutate(set_nquads='<0x1> <pa> "A" .\n<0x2> <pb> "B" .')
+    db.rollup_all()
+    for p in ("pa", "pb"):
+        db.tablets.offload(p)
+    assert not dict.keys(db.tablets), "offload left residents"
+    bdir = str(tmp_path / "bk")
+    backup(db, bdir)
+    db.close()
+    db2 = restore(bdir)
+    assert db2.query('{ q(func: uid(0x1)) { pa } }')["data"]["q"] == \
+        [{"pa": "A"}]
+    assert db2.query('{ q(func: uid(0x2)) { pb } }')["data"]["q"] == \
+        [{"pb": "B"}]
+
+
+def test_lsm_compaction_crash_window_no_resurrection(tmp_path):
+    """A crash between the compaction's manifest flip and the old-run
+    unlink (or before the flip) must never resurrect deleted keys
+    (review finding: the merged run drops tombstones)."""
+    import shutil
+
+    from dgraph_tpu import native
+    if not native.available():
+        pytest.skip("native lib not built")
+    d = tmp_path / "kv"
+    kv = native.NativeKV(str(d))
+    kv.set_memtable(1024)
+    kv.put(b"dead", b"x" * 1500)   # forces a flush: run-0 holds it
+    kv.delete(b"dead")             # tombstone in the memtable
+    kv.put(b"live", b"y" * 1500)   # flush: run-1 holds tomb + live
+    # simulate "crash after compaction rename, before unlink": keep a
+    # copy of the pre-compaction runs and restore them afterwards
+    pre = [f for f in os.listdir(d) if f.endswith(".sst")]
+    for f in pre:
+        shutil.copy(str(d / f), str(tmp_path / f))
+    kv.snapshot()                  # compacts; tombstone dropped
+    kv.close()
+    for f in pre:                  # resurrect the orphan files
+        if not (d / f).exists():
+            shutil.copy(str(tmp_path / f), str(d / f))
+    kv2 = native.NativeKV(str(d))  # MANIFEST must ignore + delete them
+    assert kv2.get(b"dead") is None, "deleted key resurrected"
+    assert kv2.get(b"live") == b"y" * 1500
+    kv2.close()
+    left = [f for f in os.listdir(d) if f.endswith(".sst")]
+    assert len(left) == 1, left
