@@ -48,6 +48,15 @@ _FLAT_TYPES = {TypeID.INT, TypeID.FLOAT, TypeID.BOOL, TypeID.STRING,
                TypeID.DEFAULT, TypeID.DATETIME}
 
 
+def _col_positions(srcs: np.ndarray, uids: np.ndarray):
+    """Membership of `uids` in a sorted column: (pos, hit mask)."""
+    pos = np.searchsorted(srcs, uids)
+    pos = np.clip(pos, 0, max(len(srcs) - 1, 0))
+    hit = (srcs[pos] == uids) if len(srcs) else \
+        np.zeros(len(uids), bool)
+    return pos, hit
+
+
 def _flat_column_vectorized(ex, ch, name: str, colview, n: int):
     """Pure-numpy column build over a clean tablet's columnar view —
     no per-row Python at all for numeric columns; strings pay one
@@ -56,10 +65,7 @@ def _flat_column_vectorized(ex, ch, name: str, colview, n: int):
 
     srcs, tid, data, enc = colview
     uids = ex._flat_uids
-    pos = np.searchsorted(srcs, uids)
-    pos = np.clip(pos, 0, max(len(srcs) - 1, 0))
-    hit = (srcs[pos] == uids) if len(srcs) else \
-        np.zeros(n, bool)
+    pos, hit = _col_positions(srcs, uids)
     present = hit.astype(np.uint8)
     if tid == TypeID.INT:
         out = np.zeros(n, np.int64)
@@ -448,6 +454,9 @@ class Executor:
 
     def _run_block_inner(self, gq: GraphQuery) -> ExecNode:
         self._block_vars = set(self._provides(gq))
+        # var-only blocks never reach emission, so their scalar
+        # children may bind vars columnar-fast and skip posting walks
+        self._block_emits = gq.alias != "var"
         node = ExecNode(gq)
         if gq.attr == "shortest":
             self._run_shortest(node)
@@ -941,6 +950,9 @@ class Executor:
         else:
             scan = candidates if candidates is not None \
                 else tab.src_uids(self.read_ts)
+        batched = self._regexp_batch(tab, scan, pattern, flags)
+        if batched is not None:
+            return batched
         keep = []
         for u in scan.tolist():
             for p in tab.get_postings(u, self.read_ts):
@@ -948,6 +960,40 @@ class Executor:
                     keep.append(u)
                     break
         return np.asarray(keep, dtype=np.uint64)
+
+    def _regexp_batch(self, tab, scan, pattern: str,
+                      flags) -> Optional[np.ndarray]:
+        """Regex verify over the clean tablet's pre-encoded column
+        payloads (bytes-level re for ASCII patterns — identical
+        semantics, no get_postings walk per uid). Lang-tagged extras
+        verify in the same pass, so mixed uids match like the host
+        loop."""
+        colview = tab.value_columns(self.read_ts) \
+            if hasattr(tab, "value_columns") else None
+        if colview is None or colview.enc is None \
+                or colview.tid not in (TypeID.STRING, TypeID.DEFAULT) \
+                or not colview.extra_ok or not colview.ascii_only \
+                or any(ord(c) > 127 for c in pattern):
+            return None
+        try:
+            rxb = _re.compile(pattern.encode("ascii"), flags)
+        except _re.error:
+            return None
+        self._budget_colview(tab, colview)
+        srcs, _tid, _data, enc = colview
+        pos, hit = _col_positions(srcs, scan)
+        search = rxb.search
+        keep = [np.asarray(
+            [u for u, j in zip(scan[hit].tolist(), pos[hit].tolist())
+             if search(enc[j])], np.uint64)]
+        if len(colview.extra_srcs):
+            em = np.isin(colview.extra_srcs, scan)
+            keep.append(np.asarray(
+                [u for u, j in zip(colview.extra_srcs[em].tolist(),
+                                   np.nonzero(em)[0].tolist())
+                 if search(colview.extra_enc[j])], np.uint64))
+        inc_counter("query_regexp_batch_total")
+        return np.unique(np.concatenate(keep))
 
     def _eval_match(self, fn: Function, candidates) -> np.ndarray:
         """Fuzzy match: trigram-index candidate narrowing + Levenshtein
@@ -1032,10 +1078,7 @@ class Executor:
                                    offs)
             return None if m is None else cand_srcs[m == 1]
 
-        pos = np.searchsorted(srcs, scan)
-        pos = np.clip(pos, 0, max(len(srcs) - 1, 0))
-        hit = (srcs[pos] == scan) if len(srcs) else \
-            np.zeros(len(scan), bool)
+        pos, hit = _col_positions(srcs, scan)
         got = masked(scan[hit], [enc[j] for j in pos[hit].tolist()])
         if got is None:
             return None
@@ -1362,7 +1405,13 @@ class Executor:
             else:
                 self._expand_children(node, gq.children, dest)
         else:
-            # scalar predicate: fetch values for src uids
+            # scalar predicate: fetch values for src uids. A pure
+            # var-binding block (var(func: ...) { v as pred }) never
+            # emits, so the columnar fast path below can skip this
+            # per-uid posting walk entirely — at the 21M regime this
+            # loop dominates var-heavy aggregation queries (q020)
+            if self._bind_var_columnar(node, gq, tab, src):
+                return node
             if hasattr(tab, "prefetch_postings"):
                 tab.prefetch_postings(src)
             for u in src.tolist():
@@ -1388,6 +1437,48 @@ class Executor:
                             vmap[u] = sel.facets[key]
                     self.value_vars[varname] = vmap
         return node
+
+    def _bind_var_columnar(self, node: ExecNode, gq, tab,
+                           src: np.ndarray) -> bool:
+        """Vectorized value-var binding over the clean tablet's column
+        view: one searchsorted + array gather instead of a per-uid
+        get_postings loop. Only for blocks whose values are consumed
+        EXCLUSIVELY through the var (nothing emits, counts, or reads
+        facets), with untagged single values — everything else keeps
+        the exact posting path."""
+        if not gq.var or gq.langs or gq.is_count or gq.facet_var \
+                or gq.children or gq.facets is not None \
+                or getattr(self, "_block_emits", True):
+            return False
+        colview = tab.value_columns(self.read_ts) \
+            if hasattr(tab, "value_columns") else None
+        if colview is None or len(colview.extra_srcs) \
+                or colview.tid == TypeID.DATETIME:
+            # lang-tagged postings need _select_posting semantics; a
+            # DATETIME column caches ISO strings but the var needs the
+            # datetime value — both keep the per-posting walk
+            return False
+        self._budget_colview(tab, colview)
+        srcs, tid, data, enc = colview
+        pos, hit = _col_positions(srcs, src)
+        sel = pos[hit]
+        uids = src[hit].tolist()
+        inc_counter("query_columnar_var_bind_total")
+        if data is not None:
+            vals = data[sel].tolist()
+            if tid == TypeID.BOOL:
+                # the column stores uint8 0/1; the var (and its JSON)
+                # must carry real booleans
+                self.value_vars[gq.var] = {
+                    u: Val(tid, bool(v)) for u, v in zip(uids, vals)}
+            else:
+                self.value_vars[gq.var] = {
+                    u: Val(tid, v) for u, v in zip(uids, vals)}
+        else:
+            self.value_vars[gq.var] = {
+                u: Val(tid, enc[j].decode("utf-8"))
+                for u, j in zip(uids, sel.tolist())}
+        return True
 
     # -- facets (ref worker/task.go:1806 applyFacetsTree,
     #    types/facets/utils.go:129) --

@@ -48,7 +48,7 @@ class ValueColumns:
     values defied encoding — batch consumers must fall back."""
 
     __slots__ = ("srcs", "tid", "data", "enc", "nbytes",
-                 "extra_srcs", "extra_enc", "extra_ok")
+                 "extra_srcs", "extra_enc", "extra_ok", "_ascii")
 
     def __init__(self, srcs, tid, data, enc,
                  extra_srcs=None, extra_enc=None, extra_ok=True):
@@ -60,11 +60,23 @@ class ValueColumns:
             else np.empty(0, np.uint64)
         self.extra_enc = extra_enc or []
         self.extra_ok = extra_ok
+        self._ascii = None
         self.nbytes = int(srcs.nbytes) \
             + (int(data.nbytes) if data is not None else 0) \
             + (sum(len(e) + 49 for e in enc) if enc else 0) \
             + int(self.extra_srcs.nbytes) \
             + sum(len(e) + 49 for e in self.extra_enc)
+
+    @property
+    def ascii_only(self) -> bool:
+        """Bytes-level regex over the payloads is only str-equivalent
+        when every payload is ASCII ('.' must mean one codepoint).
+        Computed lazily: only the regexp batch reads it, and the scan
+        is O(total payload bytes)."""
+        if self._ascii is None:
+            self._ascii = all(e.isascii() for e in self.enc or []) \
+                and all(e.isascii() for e in self.extra_enc)
+        return self._ascii
 
     def __iter__(self):
         return iter((self.srcs, self.tid, self.data, self.enc))
