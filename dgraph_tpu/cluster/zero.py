@@ -52,6 +52,7 @@ class ZeroState:
         # conflict window: key fingerprint -> last commit_ts
         # (zero/oracle.go commits map)
         self.commits: dict[int, int] = {}
+        self.commits_floor = 0
         # decided transactions: start_ts -> commit_ts (0 = aborted).
         # The 2PC decision record: participants and retrying
         # coordinators read the outcome here instead of re-deciding.
@@ -92,6 +93,14 @@ class ZeroState:
             start_ts = int(start_ts)
             if start_ts in self.decided:  # retry of a decided txn
                 return self.decided[start_ts]
+            if start_ts < self.commits_floor:
+                # the conflict entries this txn would have to check
+                # against may have been trimmed: conservative ABORT
+                # (the reference oracle likewise rejects txns older
+                # than its purge point) — committing could silently
+                # miss a write-write conflict
+                self.decided[start_ts] = 0
+                return 0
             for k in keys:
                 if self.commits.get(int(k), 0) > start_ts:
                     self.decided[start_ts] = 0
@@ -102,6 +111,7 @@ class ZeroState:
                 self.commits[int(k)] = commit_ts
             self.decided[start_ts] = commit_ts
             self._trim_decided()
+            self._trim_commits()
             return commit_ts
         if op == "txn_status":
             (start_ts,) = args
@@ -219,6 +229,23 @@ class ZeroState:
                     "members": members}
         raise ValueError(f"unknown zero command {op!r}")
 
+    def _trim_commits(self):
+        """Bound the conflict window the same way: an entry only
+        matters while a txn with start_ts below its commit_ts can
+        still try to commit, and anything 10M ts behind max_ts is far
+        past every stage TTL. Skipped while nothing is trimmable so
+        commits never pay an O(window) rebuild for free."""
+        if len(self.commits) > 131072:
+            floor = self.max_ts - 10_000_000
+            if floor - self.commits_floor < 1_000_000:
+                # rebuild only when the floor has advanced a real
+                # stride — with >131k live in-window keys an every-
+                # commit rebuild would evict ~nothing at O(window) cost
+                return
+            self.commits = {k: v for k, v in self.commits.items()
+                            if v >= floor}
+            self.commits_floor = floor
+
     def _trim_decided(self):
         """Bound the decision registry: deterministic trim (applied
         identically on every quorum member) keeping a generous window
@@ -229,11 +256,11 @@ class ZeroState:
         whose decision was trimmed."""
         if len(self.decided) > 131072:
             floor = self.max_ts - 10_000_000
-            if floor <= self.decided_floor:
-                # nothing below the window yet: skip the rebuild — an
-                # unconditional one here would make every commit O(all
-                # retained decisions). Growth stays bounded by ts
-                # volume (one decision consumes >= 1 ts).
+            if floor - self.decided_floor < 1_000_000:
+                # rebuild only when the floor has advanced a real
+                # stride — an every-commit rebuild over >131k retained
+                # decisions would evict ~nothing at O(window) cost.
+                # Growth stays bounded by ts volume between strides.
                 return
             self.decided = {ts: c for ts, c in self.decided.items()
                             if ts >= floor}
@@ -246,6 +273,7 @@ class ZeroState:
                 "commits": dict(self.commits),
                 "decided": dict(self.decided),
                 "decided_floor": self.decided_floor,
+                "commits_floor": self.commits_floor,
                 "tablets": dict(self.tablets),
                 "moving": dict(self.moving),
                 "move_queue": {k: dict(v)
@@ -261,6 +289,7 @@ class ZeroState:
         st.commits = dict(snap["commits"])
         st.decided = dict(snap.get("decided", {}))
         st.decided_floor = snap.get("decided_floor", 0)
+        st.commits_floor = snap.get("commits_floor", 0)
         st.tablets = dict(snap["tablets"])
         st.moving = dict(snap.get("moving", {}))
         st.move_queue = {k: dict(v) for k, v
