@@ -35,6 +35,7 @@ from dgraph_tpu.models.tokenizer import get_tokenizer, tokens_for
 from dgraph_tpu.models.types import (
     TypeID, Val, convert, sort_key, to_json_value, type_name,
 )
+from dgraph_tpu.query.retrigram import compile_trigram_query
 from dgraph_tpu.storage.tablet import Tablet
 from dgraph_tpu.utils.keys import token_bytes
 from dgraph_tpu.utils.metrics import inc_counter
@@ -935,17 +936,14 @@ class Executor:
         flags = _re.IGNORECASE if (len(fn.args) > 1
                                    and "i" in fn.args[1].value) else 0
         rx = _re.compile(pattern, flags)
-        spec = get_tokenizer("trigram")
         indexed = tab.schema.indexed and "trigram" in tab.schema.tokenizers
         if indexed and candidates is None:
-            # required trigrams from literal fragments of the pattern
-            lits = [m for m in _re.findall(r"[\w ]{3,}", pattern)]
-            cand = None
-            for lit in lits:
-                for t in tokens_for(Val(TypeID.STRING, lit), spec):
-                    got = tab.index_uids(token_bytes(spec.ident, t),
-                                         self.read_ts)
-                    cand = got if cand is None else _intersect(cand, got)
+            # Compile the regex AST into an AND/OR trigram query — a
+            # necessary condition per alternation branch — and walk the
+            # index with it (ref worker/trigram.go:35 uidsForRegex via
+            # cindex.RegexpQuery).  ALL ⇒ no index help ⇒ full scan.
+            cand = self._trigram_query_uids(
+                tab, compile_trigram_query(pattern, flags))
             scan = cand if cand is not None else tab.src_uids(self.read_ts)
         else:
             scan = candidates if candidates is not None \
@@ -960,6 +958,49 @@ class Executor:
                     keep.append(u)
                     break
         return np.asarray(keep, dtype=np.uint64)
+
+    def _trigram_query_uids(self, tab, q) -> Optional[np.ndarray]:
+        """Evaluate a compiled TriQuery against `tab`'s trigram index.
+        Returns None for an unconstrained (ALL) query — caller scans —
+        so an ALL branch inside an OR correctly un-constrains the whole
+        OR, as in the reference's trigram query algebra."""
+        spec = get_tokenizer("trigram")
+
+        def lookup(t: str) -> np.ndarray:
+            return tab.index_uids(token_bytes(spec.ident, t), self.read_ts)
+
+        def ev(node) -> Optional[np.ndarray]:
+            if node.op == "all":
+                return None
+            if node.op == "none":
+                return _EMPTY
+            if node.op == "and":
+                cur = None
+                for t in node.trigrams:
+                    got = lookup(t)
+                    cur = got if cur is None else _intersect(cur, got)
+                    if cur.size == 0:
+                        return cur
+                for s in node.subs:
+                    got = ev(s)
+                    if got is None:
+                        continue
+                    cur = got if cur is None else _intersect(cur, got)
+                    if cur is not None and cur.size == 0:
+                        return cur
+                return cur
+            # OR
+            cur = _EMPTY
+            for t in node.trigrams:
+                cur = _union(cur, lookup(t))
+            for s in node.subs:
+                got = ev(s)
+                if got is None:
+                    return None
+                cur = _union(cur, got)
+            return cur
+
+        return ev(q)
 
     def _regexp_batch(self, tab, scan, pattern: str,
                       flags) -> Optional[np.ndarray]:

@@ -1,0 +1,207 @@
+"""Regex → trigram query compiler tests (ref worker/trigram.go:35 —
+uidsForRegex compiles an AND/OR trigram query via cindex.RegexpQuery).
+
+The load-bearing invariant is NECESSITY: for every pattern and every
+string, if ``re.search`` matches then the string's trigram set must
+satisfy the compiled query.  A violation means the index prefilter
+drops a true match — the exact wrong-results bug this compiler fixes
+(round-3 verdict: ``/foofoo|barbar/`` returned empty because literal
+fragments were ANDed across alternation branches)."""
+
+import random
+import re
+
+import pytest
+
+from dgraph_tpu.engine import GraphDB
+from dgraph_tpu.query.retrigram import ALL, NONE, compile_trigram_query
+
+
+def trigrams(s: str) -> set:
+    return {s[i:i + 3] for i in range(len(s) - 2)}
+
+
+def satisfies(q, tset) -> bool:
+    if q.op == "all":
+        return True
+    if q.op == "none":
+        return False
+    if q.op == "and":
+        return all(t in tset for t in q.trigrams) and \
+            all(satisfies(s, tset) for s in q.subs)
+    return any(t in tset for t in q.trigrams) or \
+        any(satisfies(s, tset) for s in q.subs)
+
+
+# ---------------------------------------------------------------- shapes
+
+def test_alternation_is_or():
+    q = compile_trigram_query("foofoo|barbar")
+    assert q.op == "or"
+    assert not satisfies(q, trigrams("zzzzzz"))
+    assert satisfies(q, trigrams("xxfoofooxx"))
+    assert satisfies(q, trigrams("barbar"))
+
+
+def test_concat_crosses_alternation():
+    # (foo|bar)baz must produce trigrams spanning the group boundary.
+    q = compile_trigram_query("(foo|bar)baz")
+    assert satisfies(q, trigrams("foobaz"))
+    assert satisfies(q, trigrams("barbaz"))
+    assert not satisfies(q, trigrams("foobar"))   # no obaz/rbaz window
+    assert not satisfies(q, trigrams("bazbaz"))
+
+
+def test_optional_widens():
+    q = compile_trigram_query("colou?r")
+    assert satisfies(q, trigrams("color"))
+    assert satisfies(q, trigrams("colour"))
+    assert not satisfies(q, trigrams("colonnade"))
+
+
+def test_anchors_ignored():
+    q = compile_trigram_query("^abcdef$")
+    assert satisfies(q, trigrams("abcdef"))
+    assert not satisfies(q, trigrams("abcxyz"))
+
+
+def test_unconstrained_patterns_are_all():
+    for pat in (".*", "a|.*", "ab", "[^x]+", r"\w+", "x{0,5}"):
+        assert compile_trigram_query(pat) is ALL, pat
+
+
+def test_star_keeps_neighbors():
+    # "abc.*def": .* is ALL but both literals still constrain via AND.
+    q = compile_trigram_query("abc.*def")
+    assert satisfies(q, trigrams("abcXXdef"))
+    assert not satisfies(q, trigrams("abcXXXXX"))
+    assert not satisfies(q, trigrams("XXXXXdef"))
+
+
+def test_ignorecase_folds():
+    q = compile_trigram_query("FooBar", re.IGNORECASE)
+    assert satisfies(q, trigrams("foobar"))
+    assert satisfies(q, trigrams("FOOBAR"))
+    assert satisfies(q, trigrams("fOoBaR"))
+    assert not satisfies(q, trigrams("zzzzzz"))
+
+
+def test_ignorecase_unicode_extra_cases():
+    # sre's IGNORECASE admits ſ for s, KELVIN SIGN for k, ı for i —
+    # the filter must not be stricter than the verifier (review
+    # finding: /stop/i dropped a value spelled "ſtopx").
+    q = compile_trigram_query("stop", re.IGNORECASE)
+    assert re.search("stop", "ſtopx", re.IGNORECASE)
+    assert satisfies(q, trigrams("ſtopx"))
+    qk = compile_trigram_query("kelvin", re.IGNORECASE)
+    kelvin = "Kelvin"  # KELVIN SIGN K
+    assert re.search("kelvin", kelvin, re.IGNORECASE)
+    assert satisfies(qk, trigrams(kelvin))
+
+
+def test_repeat_counted():
+    q = compile_trigram_query("(ab){3}")
+    assert satisfies(q, trigrams("ababab"))
+    assert not satisfies(q, trigrams("abxbxb"))
+
+
+def test_char_class_product():
+    q = compile_trigram_query("ba[rz]ba[rz]")
+    for s in ("barbar", "barbaz", "bazbar", "bazbaz"):
+        assert satisfies(q, trigrams(s)), s
+    assert not satisfies(q, trigrams("baqbaq"))
+
+
+def test_invalid_pattern_degrades_to_all():
+    assert compile_trigram_query("([unclosed") is ALL
+
+
+# ------------------------------------------------------------- necessity
+
+_ATOMS = ["foo", "bar", "baz", "qu+x", "a[bc]d", "colou?r", "x.z",
+          "(ab|cd)ef", "gh{2,3}i", r"j\w?k", "^lmn", "opq$", "(?i)RST"]
+_STRINGS = ["foofoo", "barbar", "foobaz", "colour", "color", "quuux",
+            "abdacd", "xyzxyz", "abefcdef", "ghhhi", "jk", "jxk",
+            "lmnopq", "rstRST", "", "a", "ab", "the quick brown fox",
+            "FOOBAR", "BaZ colour RST"]
+
+
+def test_necessity_randomized():
+    rng = random.Random(1234)
+    for _ in range(400):
+        n = rng.randint(1, 3)
+        parts = [rng.choice(_ATOMS) for _ in range(n)]
+        join = rng.choice(["", "|", ".*"])
+        pat = join.join(parts)
+        try:
+            rx = re.compile(pat)
+        except re.error:
+            continue
+        q = compile_trigram_query(pat)
+        for s in _STRINGS:
+            if rx.search(s):
+                assert satisfies(q, trigrams(s)), (pat, s, q)
+
+
+# ------------------------------------------------------------ end-to-end
+
+@pytest.fixture(scope="module")
+def tdb():
+    d = GraphDB(prefer_device=False)
+    d.alter("name: string @index(trigram) .")
+    d.mutate(set_nquads="""
+<0x1> <name> "foofoo" .
+<0x2> <name> "barbar" .
+<0x3> <name> "bazbaz" .
+<0x4> <name> "color" .
+<0x5> <name> "colour" .
+<0x6> <name> "foobaz" .
+<0x7> <name> "Grimes" .
+""")
+    return d
+
+
+def q_names(db, pat):
+    r = db.query('{ q(func: regexp(name, %s)) { name } }' % pat)
+    return sorted(x["name"] for x in r["data"]["q"])
+
+
+def test_e2e_alternation(tdb):
+    assert q_names(tdb, "/foofoo|barbar/") == ["barbar", "foofoo"]
+    assert q_names(tdb, "/foo|bar/") == ["barbar", "foobaz", "foofoo"]
+
+
+def test_e2e_group_concat(tdb):
+    assert q_names(tdb, "/(foo|bar)baz/") == ["foobaz"]
+
+
+def test_e2e_optional(tdb):
+    assert q_names(tdb, "/colou?r/") == ["color", "colour"]
+
+
+def test_e2e_anchored(tdb):
+    assert q_names(tdb, "/^foo/") == ["foobaz", "foofoo"]
+    assert q_names(tdb, "/bar$/") == ["barbar"]
+
+
+def test_e2e_ignorecase(tdb):
+    assert q_names(tdb, "/GRIMES/i") == ["Grimes"]
+    assert q_names(tdb, "/(?i)FOOFOO|barbar/") == ["barbar", "foofoo"]
+
+
+def test_e2e_class_and_dot(tdb):
+    assert q_names(tdb, "/ba[rz]ba[rz]/") == ["barbar", "bazbaz"]
+    assert q_names(tdb, "/col.r/") == ["color"]
+
+
+def test_e2e_full_scan_fallback(tdb):
+    assert len(q_names(tdb, "/.*/")) == 7
+    assert q_names(tdb, "/o{2}/") == ["foobaz", "foofoo"]
+
+
+def test_e2e_filter_path_matches_root_path(tdb):
+    # @filter(regexp()) goes down the candidates path — same answers.
+    r = tdb.query('{ q(func: has(name)) '
+                  '@filter(regexp(name, /foo|bar/)) { name } }')
+    assert sorted(x["name"] for x in r["data"]["q"]) == \
+        ["barbar", "foobaz", "foofoo"]
