@@ -35,6 +35,7 @@ from dgraph_tpu.models.tokenizer import get_tokenizer, tokens_for
 from dgraph_tpu.models.types import (
     TypeID, Val, convert, sort_key, to_json_value, type_name,
 )
+from dgraph_tpu.query.colvar import ColVar, make_colvar
 from dgraph_tpu.query.retrigram import compile_trigram_query
 from dgraph_tpu.storage.tablet import Tablet
 from dgraph_tpu.utils.keys import token_bytes
@@ -226,6 +227,14 @@ _TERM_FUNCS = {"anyofterms", "allofterms", "anyoftext", "alloftext"}
 def _np_sorted(uids) -> np.ndarray:
     a = np.asarray(sorted(set(int(u) for u in uids)), dtype=np.uint64)
     return a
+
+
+def _var_domain(vmap) -> np.ndarray:
+    """The sorted uid set a value var is defined on — columnar vars
+    answer from their uid array without materializing Vals."""
+    if isinstance(vmap, ColVar):
+        return vmap.uids
+    return _np_sorted(vmap.keys())
 
 
 def _intersect(a, b):
@@ -491,8 +500,7 @@ class Executor:
                     and vc.name not in self.uid_vars:
                 # uid(valueVar) roots at the uids the var is defined on
                 # (ref query/query.go UidsFromVar)
-                uids = _union(
-                    uids, _np_sorted(self.value_vars[vc.name].keys()))
+                uids = _union(uids, _var_domain(self.value_vars[vc.name]))
         if gq.func is not None and gq.func.name != "uid":
             uids = _union(uids, self._eval_func(gq.func, None))
         return uids
@@ -517,7 +525,7 @@ class Executor:
                     # uid(valueVar): the uids the var is defined on
                     # (ref query/query.go UidsFromVar / outputnode uses)
                     uids = _union(
-                        uids, _np_sorted(self.value_vars[vc.name].keys()))
+                        uids, _var_domain(self.value_vars[vc.name]))
             return uids if candidates is None \
                 else _intersect(candidates, uids)
         if name == "type":
@@ -1264,9 +1272,23 @@ class Executor:
         vc = fn.needs_var[0]
         vmap = self.value_vars.get(vc.name, {})
         want_raw = fn.args[0].value if fn.args else None
+        scan = candidates if candidates is not None else _var_domain(vmap)
+        if isinstance(vmap, ColVar) and not vmap.frac \
+                and fn.name in _CMP_VEC:
+            # columnar filter: one gather + one vector compare (ref
+            # query.go val-var filters; the dict walk remains only for
+            # mixed-typed math results where per-uid tids differ)
+            vtid = TypeID.BOOL if vmap.isbool else vmap.tid
+            try:
+                want = convert(Val(TypeID.DEFAULT, want_raw), vtid).value
+            except ValueError:
+                return _EMPTY
+            uids, vals = vmap.gather(scan)
+            if vtid == TypeID.BOOL:
+                vals, want = vals.astype(bool), bool(want)
+            ok = _CMP_VEC[fn.name](vals, want)
+            return uids[ok]
         keep = []
-        scan = candidates if candidates is not None \
-            else _np_sorted(vmap.keys())
         for u in scan.tolist():
             v = vmap.get(u)
             if v is None:
@@ -1503,22 +1525,18 @@ class Executor:
         srcs, tid, data, enc = colview
         pos, hit = _col_positions(srcs, src)
         sel = pos[hit]
-        uids = src[hit].tolist()
         inc_counter("query_columnar_var_bind_total")
         if data is not None:
-            vals = data[sel].tolist()
-            if tid == TypeID.BOOL:
-                # the column stores uint8 0/1; the var (and its JSON)
-                # must carry real booleans
-                self.value_vars[gq.var] = {
-                    u: Val(tid, bool(v)) for u, v in zip(uids, vals)}
-            else:
-                self.value_vars[gq.var] = {
-                    u: Val(tid, v) for u, v in zip(uids, vals)}
+            # numeric var (data arrays exist only for INT/FLOAT/BOOL):
+            # stays columnar END-TO-END — math, agg, val() filters and
+            # order keys consume the arrays; a dict materializes only
+            # if a legacy consumer asks
+            self.value_vars[gq.var] = make_colvar(src[hit], data[sel],
+                                                  tid)
         else:
             self.value_vars[gq.var] = {
                 u: Val(tid, enc[j].decode("utf-8"))
-                for u, j in zip(uids, sel.tolist())}
+                for u, j in zip(src[hit].tolist(), sel.tolist())}
         return True
 
     # -- facets (ref worker/task.go:1806 applyFacetsTree,
@@ -1706,28 +1724,32 @@ class Executor:
             vc = gq.needs_var[0]
             vmap = self.value_vars.get(vc.name, {})
             src = node.src
-            if vc.name in getattr(self, "_block_vars", ()):
-                # bound by this block's own subtree (facet var, deeper
-                # value var, same-level scalar var): the map's domain
-                # is already scoped by where it was bound — aggregate
-                # it whole, dgraph's flat-variable semantics (ref
-                # TestLevelBasedFacetVarAggSum; a same-level var's
-                # keys equal this level's src so whole == restricted)
-                vals = list(vmap.values())
+            whole = vc.name in getattr(self, "_block_vars", ()) \
+                or not len(src)
+            # bound by this block's own subtree (facet var, deeper
+            # value var, same-level scalar var): the map's domain
+            # is already scoped by where it was bound — aggregate
+            # it whole, dgraph's flat-variable semantics (ref
+            # TestLevelBasedFacetVarAggSum; a same-level var's
+            # keys equal this level's src so whole == restricted);
+            # an outer-block var restricts to this level's uids
+            if isinstance(vmap, ColVar):
+                arr = vmap.vals if whole else vmap.gather(src)[1]
+                agg = _aggregate_col(gq.agg_func, arr, vmap)
             else:
-                # outer-block var: restrict to this level's uids
-                vals = [vmap[u] for u in src.tolist() if u in vmap] \
-                    if len(src) else list(vmap.values())
-            node.values[0] = [Agg(gq.agg_func, _aggregate(gq.agg_func, vals))]
+                vals = list(vmap.values()) if whole \
+                    else [vmap[u] for u in src.tolist() if u in vmap]
+                agg = _aggregate(gq.agg_func, vals)
+            node.values[0] = [Agg(gq.agg_func, agg)]
         elif gq.math is not None:
             vmap = _eval_math(gq.math, self.value_vars)
             if gq.var:
                 self.value_vars[gq.var] = vmap
-            node.values = {u: [Agg("math", v)] for u, v in vmap.items()}
+            node.values = _internal_values(vmap, node.src, "math")
         elif gq.attr.startswith("val("):
             vc = gq.needs_var[0]
             vmap = self.value_vars.get(vc.name, {})
-            node.values = {u: [Agg("val", v)] for u, v in vmap.items()}
+            node.values = _internal_values(vmap, node.src, "val")
 
     # ------------------------------------------------------------------
     # order + pagination (ref query.go:2231 applyOrderAndPagination)
@@ -1819,6 +1841,12 @@ class Executor:
         out = {}
         if attr.startswith("val("):
             vmap = self.value_vars.get(attr[4:-1], {})
+            if isinstance(vmap, ColVar):
+                uarr, varr = vmap.gather(np.asarray(uids, np.uint64))
+                sub = ColVar(uarr, varr, vmap.tid, vmap.frac,
+                             vmap.isbool)
+                return {int(u): (0, int(k)) for u, k in
+                        zip(uarr.tolist(), sub.sort_keys().tolist())}
             for u in uids.tolist():
                 v = vmap.get(u)
                 if v is not None:
@@ -2464,6 +2492,10 @@ class Executor:
         uids that produced a value for each predicate)."""
         from itertools import product
 
+        if len(gq.groupby) == 1:
+            fast = self._groupby_groups_fast(gq.groupby[0], dsts)
+            if fast is not None:
+                return fast
         groups: dict[tuple, list[int]] = {}
         for d in dsts.tolist():
             per_attr: list[list] = []
@@ -2500,6 +2532,67 @@ class Executor:
                 groups.setdefault(tuple(combo), []).append(int(d))
         return groups
 
+    def _groupby_groups_fast(self, ga, dsts: np.ndarray
+                             ) -> Optional[dict[tuple, list[int]]]:
+        """Vectorized single-attr grouping (the reference regime's
+        common shape, ref query/groupby.go:371): gather every member's
+        key through the columnar views, np.unique the keys, and split
+        members by a stable argsort of the inverse — no per-uid
+        posting walks.  Returns None (caller keeps the exact per-uid
+        path) for lang-selected keys, dirty/historical tablets,
+        list-valued or mixed-type columns."""
+        tab = self._tablet(ga.attr)
+        if tab is None or ga.lang:
+            return None
+        if tab.schema.value_type == TypeID.UID:
+            edges = getattr(tab, "edges", None)
+            if not isinstance(edges, dict) or tab.dirty() \
+                    or self.read_ts < tab.base_ts:
+                return None
+            mparts, kparts = [], []
+            for d in dsts.tolist():
+                a = edges.get(int(d))
+                if a is None or not len(a):
+                    continue  # members missing the attr are dropped
+                kparts.append(a)
+                mparts.append(np.full(len(a), d, np.uint64))
+            if not kparts:
+                return {}
+            karr = np.concatenate(kparts)
+            marr = np.concatenate(mparts)
+            uk, inv = np.unique(karr, return_inverse=True)
+            keys = [(hex(int(k)),) for k in uk.tolist()]
+        else:
+            colview = tab.value_columns(self.read_ts) \
+                if hasattr(tab, "value_columns") else None
+            if colview is None:
+                return None
+            self._budget_colview(tab, colview)
+            srcs, tid, data, enc = colview
+            pos, hit = _col_positions(srcs, dsts)
+            marr = dsts[hit]
+            sel = pos[hit]
+            if not len(marr):
+                return {}
+            if data is not None:
+                uk, inv = np.unique(data[sel], return_inverse=True)
+                if tid == TypeID.BOOL:
+                    keys = [(bool(k),) for k in uk.tolist()]
+                else:
+                    keys = [(k,) for k in uk.tolist()]
+            else:
+                karr = np.asarray([enc[j] for j in sel.tolist()],
+                                  dtype=object)
+                uk, inv = np.unique(karr, return_inverse=True)
+                keys = [(k.decode("utf-8"),) for k in uk.tolist()]
+        order = np.argsort(inv, kind="stable")
+        sm = marr[order].tolist()
+        bounds = np.searchsorted(inv[order],
+                                 np.arange(len(keys) + 1)).tolist()
+        inc_counter("query_groupby_fast_total")
+        return {keys[g]: sm[bounds[g]:bounds[g + 1]]
+                for g in range(len(keys))}
+
     def _groupby_entry(self, gq: GraphQuery, key: tuple,
                        members: list[int]) -> dict:
         """One output group: keys + count(uid) + aggregations over
@@ -2512,8 +2605,7 @@ class Executor:
                 ent[cgq.alias or "count"] = len(members)
             elif cgq.agg_func and cgq.needs_var:
                 vmap = self.value_vars.get(cgq.needs_var[0].name, {})
-                vals = [vmap[u] for u in members if u in vmap]
-                agg = _aggregate(cgq.agg_func, vals)
+                agg = _agg_members(cgq.agg_func, vmap, members)
                 if agg is not None:
                     name = cgq.alias or \
                         f"{cgq.agg_func}(val({cgq.needs_var[0].name}))"
@@ -2554,8 +2646,7 @@ class Executor:
                     vmap[guid] = Val(TypeID.INT, len(members))
                 elif cgq.agg_func and cgq.needs_var:
                     src = self.value_vars.get(cgq.needs_var[0].name, {})
-                    vals = [src[u] for u in members if u in src]
-                    agg = _aggregate(cgq.agg_func, vals)
+                    agg = _agg_members(cgq.agg_func, src, members)
                     if agg is not None:
                         vmap[guid] = agg
             self.value_vars[cgq.var] = vmap
@@ -2655,6 +2746,57 @@ def _cmp(op: str, a, b) -> bool:
     return fn(a, b)
 
 
+def _agg_members(fn: str, vmap, members: list[int]) -> Optional[Val]:
+    """Aggregate a value var over one group's member uids — columnar
+    vars use one searchsorted gather in member order (the dict path's
+    iteration order, so float-sum rounding is unchanged)."""
+    if isinstance(vmap, ColVar):
+        m = np.asarray(members, dtype=np.uint64)
+        _u, vals = vmap.gather(m)
+        return _aggregate_col(fn, vals, vmap)
+    vals = [vmap[u] for u in members if u in vmap]
+    return _aggregate(fn, vals)
+
+
+def _internal_values(vmap, src: np.ndarray, kind: str) -> dict:
+    """node.values for a val()/math node.  Emission only ever reads the
+    block's own uids, so a columnar var materializes Vals for src
+    alone — not its whole (possibly 21M-scale) domain."""
+    if isinstance(vmap, ColVar) and src is not None and len(src):
+        uids, vals = vmap.gather(src)
+        return {int(u): [Agg(kind, vmap.to_val(v))]
+                for u, v in zip(uids.tolist(), vals.tolist())}
+    return {u: [Agg(kind, v)] for u, v in vmap.items()}
+
+
+def _aggregate_col(fn: str, arr: np.ndarray, cv: ColVar) -> Optional[Val]:
+    """_aggregate over a gathered ColVar column — no Val materialization.
+    Sum stays a sequential left fold over the python list (ints exact,
+    float rounding identical to the dict path's committed goldens).
+    Math-result vars (frac/isbool) keep per-element typing quirks by
+    falling back to the Val path."""
+    if not len(arr):
+        return None
+    if cv.frac or cv.isbool:
+        return _aggregate(fn, [cv.to_val(x) for x in arr.tolist()])
+    if cv.tid == TypeID.BOOL:
+        if fn == "min":
+            return Val(TypeID.BOOL, bool(arr.min()))
+        if fn == "max":
+            return Val(TypeID.BOOL, bool(arr.max()))
+        return None  # sum/avg over bools: not numeric (dict-path parity)
+    if fn == "min":
+        return cv.to_val(arr[int(np.argmin(arr))])
+    if fn == "max":
+        return cv.to_val(arr[int(np.argmax(arr))])
+    if fn == "sum":
+        s = sum(arr.tolist())
+        return Val(TypeID.INT if isinstance(s, int) else TypeID.FLOAT, s)
+    if fn == "avg":
+        return Val(TypeID.FLOAT, sum(arr.tolist()) / len(arr))
+    return None
+
+
 def _aggregate(fn: str, vals: list[Val]) -> Optional[Val]:
     # uniform numeric fast path: one numpy reduction instead of a
     # per-element sort_key() python loop (q020 at the 21M regime spends
@@ -2712,11 +2854,168 @@ def _aggregate(fn: str, vals: list[Val]) -> Optional[Val]:
     return None
 
 
-def _eval_math(tree, value_vars) -> dict[int, Val]:
-    """Per-uid math over value vars (ref query/math.go:213 processBinary).
-    Round-1 subset: +,-,*,/,%, comparison ops, unary funcs, min/max/cond.
-    """
+class _VecFallback(Exception):
+    """Raised inside _eval_math_vec when a leaf or op needs the dict
+    path (non-columnar var, datetime, exotic result)."""
+
+
+def _eval_math_vec(tree, value_vars):
+    """Columnar _eval_math: every var leaf is a ColVar, every op is a
+    vector op over float64 — the same domain the dict path works in
+    (its leaves go through float()).  N-ary ops align operands by
+    intersecting uid arrays; per-element failure semantics (div by
+    zero, sqrt of negative, log of nonpositive drop the uid) are
+    reproduced with masks or per-element maps.  Returns a ColVar, or
+    None for an all-constant tree (dict-path parity: no per-uid map)."""
     import math as _m
+    import time as _time
+
+    def align(args):
+        """Intersect the uid domains of array args; broadcast consts."""
+        arrs = [a for a in args if not isinstance(a, float)]
+        uids = arrs[0][0]
+        for u, _v in arrs[1:]:
+            uids = _intersect(uids, u)
+        out = []
+        for a in args:
+            if isinstance(a, float):
+                out.append(a)
+            else:
+                pos = np.searchsorted(a[0], uids)
+                out.append(a[1][pos])
+        return uids, out
+
+    def mask(uids, vals, keep):
+        return (uids[keep], [v[keep] if isinstance(v, np.ndarray)
+                             else v for v in vals])
+
+    def map1(fn, uids, x):
+        xs = x.tolist() if isinstance(x, np.ndarray) \
+            else [x] * len(uids)
+        ou, ov = [], []
+        for u, xv in zip(uids.tolist(), xs):
+            try:
+                ov.append(float(fn(xv)))
+            except (ZeroDivisionError, ValueError):
+                continue
+            ou.append(u)
+        return (np.asarray(ou, np.uint64),
+                np.asarray(ov, np.float64))
+
+    def eval_node(t):
+        if t.const is not None:
+            return float(t.const)
+        if t.var:
+            cv = value_vars.get(t.var)
+            if cv is None:
+                return (np.asarray([], np.uint64),
+                        np.asarray([], np.float64))
+            if not isinstance(cv, ColVar):
+                raise _VecFallback
+            return (cv.uids, cv.floats())
+        args = [eval_node(c) for c in t.children]
+        if all(isinstance(a, float) for a in args):
+            raise _VecFallback  # constant subtree feeding per-uid ops:
+            # keep the dict path's scalar folding exactly
+        uids, vs = align(args)
+        fn = t.fn
+        asarr = [np.full(len(uids), v) if isinstance(v, float) else v
+                 for v in vs]
+        if fn == "+":
+            return uids, asarr[0] + asarr[1]
+        if fn == "-":
+            return (uids, asarr[0] - asarr[1]) if len(asarr) == 2 \
+                else (uids, -asarr[0])
+        if fn == "*":
+            return uids, asarr[0] * asarr[1]
+        if fn in ("/", "%"):
+            keep = asarr[1] != 0.0
+            uids, vv = mask(uids, asarr, keep)
+            return uids, (vv[0] / vv[1] if fn == "/"
+                          else np.mod(vv[0], vv[1]))
+        if fn in ("<", ">", "<=", ">=", "==", "!="):
+            r = {"<": np.less, ">": np.greater, "<=": np.less_equal,
+                 ">=": np.greater_equal, "==": np.equal,
+                 "!=": np.not_equal}[fn](asarr[0], asarr[1])
+            return uids, r  # bool array; truthiness matches floats
+        if fn == "min":
+            r = asarr[0]
+            for x in asarr[1:]:
+                r = np.minimum(r, x)
+            return uids, r
+        if fn == "max":
+            r = asarr[0]
+            for x in asarr[1:]:
+                r = np.maximum(r, x)
+            return uids, r
+        if fn == "cond":
+            return uids, np.where(asarr[0] != 0, asarr[1], asarr[2])
+        if fn == "floor":
+            return uids, np.floor(asarr[0])
+        if fn == "ceil":
+            return uids, np.ceil(asarr[0])
+        if fn == "sqrt":
+            keep = asarr[0] >= 0.0
+            uids, vv = mask(uids, asarr, keep)
+            return uids, np.sqrt(vv[0])
+        # transcendental / two-arg host funcs: per-element math.* calls
+        # for bit-parity with the dict path (numpy's vectorized exp/log
+        # can differ in the last ulp)
+        if fn == "exp":
+            return map1(_m.exp, uids, asarr[0])
+        if fn == "ln":
+            return map1(_m.log, uids, asarr[0])
+        if fn == "sigmoid":
+            return map1(lambda x: 1.0 / (1.0 + _m.exp(-x)),
+                        uids, asarr[0])
+        if fn == "since":
+            now = _time.time()
+            return uids, now - asarr[0]
+        if fn in ("pow", "logbase"):
+            xs, ys = asarr[0].tolist(), asarr[1].tolist()
+            ou, ov = [], []
+            op = (lambda x, y: x ** y) if fn == "pow" else _m.log
+            for u, xv, yv in zip(uids.tolist(), xs, ys):
+                try:
+                    # complex pow results raise TypeError at float()
+                    # and must propagate to the dict-path fallback,
+                    # which keeps the uid (historical behavior)
+                    ov.append(float(op(xv, yv)))
+                except (ZeroDivisionError, ValueError):
+                    continue
+                ou.append(u)
+            return (np.asarray(ou, np.uint64),
+                    np.asarray(ov, np.float64))
+        raise _VecFallback  # op the vector path doesn't cover
+
+    res = eval_node(tree)
+    if isinstance(res, float):
+        return None
+    uids, vals = res
+    if vals.dtype == bool:
+        return ColVar(uids, vals.astype(np.uint8), TypeID.FLOAT,
+                      isbool=True)
+    return ColVar(uids, vals.astype(np.float64), TypeID.FLOAT,
+                  frac=True)
+
+
+def _eval_math(tree, value_vars) -> "dict[int, Val] | ColVar":
+    """Per-uid math over value vars (ref query/math.go:213 processBinary).
+    Tries the columnar path first; falls back to the per-uid dict walk
+    when a var isn't columnar or an op needs scalar semantics."""
+    import math as _m
+
+    try:
+        cv = _eval_math_vec(tree, value_vars)
+        if cv is not None:
+            return cv
+        return {}
+    except _VecFallback:
+        pass
+    except (TypeError, OverflowError):
+        # exotic per-element results (complex pow, overflow) — let the
+        # dict path produce its exact historical behavior
+        pass
 
     def eval_node(t) -> dict[int, float] | float:
         if t.const is not None:
