@@ -29,6 +29,7 @@ from typing import Optional
 import numpy as np
 
 from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.models.types import TypeID
 
 _EMPTY = np.empty(0, dtype=np.uint64)
 
@@ -91,6 +92,35 @@ class RemoteTablet:
         for u, ps in zip(miss, got):
             self._postings[u] = list(ps)
 
+    def prefetch_counts(self, uids, reverse: bool = False):
+        """Batch the per-uid fan-out counts for one block level into a
+        single task RPC (ref worker/task.go per-attr task granularity;
+        round-3 verdict: count(pred) over k uids paid k round trips)."""
+        miss = [int(u) for u in np.asarray(uids).tolist()
+                if (int(u), reverse) not in self._counts
+                and not self._count_from_edges(int(u), reverse)]
+        if not miss:
+            return
+        got = self._task("counts", uids=np.asarray(miss, np.uint64),
+                         reverse=reverse)
+        if got is None:
+            got = [0] * len(miss)
+        for u, c in zip(miss, got):
+            self._counts[(u, reverse)] = int(c)
+
+    def prefetch_facets(self, pairs):
+        """Batch facet reads for a level's (src, dst) edge pairs into
+        one task RPC."""
+        miss = [(int(s), int(d)) for s, d in pairs
+                if (int(s), int(d)) not in self._facets]
+        if not miss:
+            return
+        got = self._task("facets", pairs=miss)
+        if got is None:
+            got = [{}] * len(miss)
+        for key, fc in zip(miss, got):
+            self._facets[key] = dict(fc)
+
     # ------------------------------------------------- tablet surface
 
     def get_dst_uids(self, src: int, read_ts: int) -> np.ndarray:
@@ -138,12 +168,25 @@ class RemoteTablet:
                 else _EMPTY.copy()
         return self._index[tok]
 
-    def count_of(self, src: int, read_ts: int) -> int:
-        return self._count(int(src), reverse=False)
+    def count_of(self, src: int, read_ts: int,
+                 reverse: bool = False) -> int:
+        return self._count(int(src), reverse=reverse)
+
+    def _count_from_edges(self, uid: int, reverse: bool) -> bool:
+        """Derive a UID-predicate count from an already-prefetched edge
+        list instead of re-asking the group (the level's edges were
+        shipped for expansion anyway; scalar tablets never enter the
+        edge cache, so a hit here is always count-exact)."""
+        dsts = self._edges.get((uid, reverse))
+        if dsts is None or not self.schema.value_type == TypeID.UID:
+            return False
+        self._counts[(uid, reverse)] = len(dsts)
+        return True
 
     def _count(self, uid: int, reverse: bool) -> int:
         key = (uid, reverse)
-        if key not in self._counts:
+        if key not in self._counts and \
+                not self._count_from_edges(uid, reverse):
             got = self._task("counts",
                              uids=np.asarray([uid], np.uint64),
                              reverse=reverse) or [0]

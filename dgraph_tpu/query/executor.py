@@ -1425,6 +1425,32 @@ class Executor:
                 # per-parent edge read this block (and its emission)
                 # will do (ref worker/task.go per-attr task batching)
                 tab.prefetch_edges(src, node.reverse)
+            if hasattr(tab, "prefetch_facets") and (
+                    gq.facets_filter is not None or gq.facet_var
+                    or (gq.facets is not None and not gq.first
+                        and not gq.offset and not gq.after)
+                    or any(o.attr.startswith("facet:")
+                           for o in (gq.order or ()))):
+                # federated: one facets RPC per (predicate, level) for
+                # the consumers that must see EVERY edge's facets
+                # (filters, facet vars, facet ordering) — edges are
+                # already batch-cached above, so assembling the
+                # level's pairs costs no extra round trips (ref
+                # worker/task.go FacetParams on the per-attr task).
+                # Plain @facets emission prefetches per parent at the
+                # emit site instead, after pagination.
+                pairs = []
+                for u in src.tolist():
+                    dsts = (tab.get_reverse_uids(u, self.read_ts)
+                            if node.reverse
+                            else tab.get_dst_uids(u, self.read_ts))
+                    if node.reverse:
+                        pairs.extend((int(d), int(u))
+                                     for d in dsts.tolist())
+                    else:
+                        pairs.extend((int(u), int(d))
+                                     for d in dsts.tolist())
+                tab.prefetch_facets(pairs)
             # one per-parent edge pass serves both the dest union and
             # every facet-var binding (avoids re-walking high-fanout
             # edge lists once per facet key)
@@ -1458,6 +1484,8 @@ class Executor:
             if gq.var:
                 self.uid_vars[gq.var] = dest
             if gq.is_count:
+                if hasattr(tab, "prefetch_counts"):
+                    tab.prefetch_counts(src, node.reverse)
                 for u in src.tolist():
                     node.counts[u] = self._child_count(tab, u, node.reverse)
             elif gq.is_groupby:
@@ -1618,9 +1646,10 @@ class Executor:
             self.value_vars[varname] = vmaps[key]
 
     def _child_count(self, tab: Tablet, uid: int, reverse: bool) -> int:
-        if reverse:
-            return len(tab.get_reverse_uids(uid, self.read_ts))
-        return tab.count_of(uid, self.read_ts)
+        # count_of serves both directions so a federated proxy answers
+        # from its batch-prefetched count cache instead of shipping
+        # whole reverse edge lists (ref worker/task.go count tasks)
+        return tab.count_of(uid, self.read_ts, reverse=reverse)
 
     def _typed(self, tab: Tablet, p) -> Val:
         t = tab.schema.value_type
@@ -2351,6 +2380,15 @@ class Executor:
                 if counts:
                     obj[name] = [{counts[0].alias or "count": len(dsts)}]
                     continue
+                if cgq.facets is not None \
+                        and hasattr(tab, "prefetch_facets"):
+                    # federated: one facets RPC per parent, over the
+                    # PAGINATED edge list only (the level-wide
+                    # prefetch would ship every edge's facets on
+                    # first: N queries)
+                    tab.prefetch_facets(
+                        [((int(d), uid) if ch.reverse
+                          else (uid, int(d))) for d in dsts.tolist()])
                 items = []
                 for d in dsts.tolist():
                     sub = self._emit_uid(
@@ -2581,10 +2619,18 @@ class Executor:
                 else:
                     keys = [(k,) for k in uk.tolist()]
             else:
-                karr = np.asarray([enc[j] for j in sel.tolist()],
-                                  dtype=object)
-                uk, inv = np.unique(karr, return_inverse=True)
-                keys = [(k.decode("utf-8"),) for k in uk.tolist()]
+                # string/datetime keys: integer-code via one dict pass
+                # (np.unique on object arrays is python-compare
+                # O(n log n) — 1.5s of the 21M q052 profile)
+                table: dict[bytes, int] = {}
+                setd = table.setdefault
+                codes = np.fromiter(
+                    (setd(enc[j], len(table)) for j in sel.tolist()),
+                    np.int64, len(sel))
+                uk, inv = np.unique(codes, return_inverse=True)
+                by_code = list(table.keys())
+                keys = [(by_code[c].decode("utf-8"),)
+                        for c in uk.tolist()]
         order = np.argsort(inv, kind="stable")
         sm = marr[order].tolist()
         bounds = np.searchsorted(inv[order],
@@ -2870,37 +2916,38 @@ def _eval_math_vec(tree, value_vars):
     import math as _m
     import time as _time
 
+    # Array nodes are (uids, float64 vals, isbool).  Bool-ness is a
+    # FLAG, never a dtype: the dict path's python bools act as 0/1
+    # ints inside arithmetic (True+True == 2) but materialize as BOOL
+    # when they survive to the top — numpy bool arrays would instead
+    # do logical arithmetic (True+True == True), so comparisons store
+    # 0.0/1.0 and carry the flag.
+
     def align(args):
         """Intersect the uid domains of array args; broadcast consts."""
         arrs = [a for a in args if not isinstance(a, float)]
         uids = arrs[0][0]
-        for u, _v in arrs[1:]:
-            uids = _intersect(uids, u)
+        for a in arrs[1:]:
+            uids = _intersect(uids, a[0])
         out = []
         for a in args:
             if isinstance(a, float):
-                out.append(a)
+                out.append(np.full(len(uids), a))
             else:
                 pos = np.searchsorted(a[0], uids)
                 out.append(a[1][pos])
         return uids, out
 
-    def mask(uids, vals, keep):
-        return (uids[keep], [v[keep] if isinstance(v, np.ndarray)
-                             else v for v in vals])
-
     def map1(fn, uids, x):
-        xs = x.tolist() if isinstance(x, np.ndarray) \
-            else [x] * len(uids)
         ou, ov = [], []
-        for u, xv in zip(uids.tolist(), xs):
+        for u, xv in zip(uids.tolist(), x.tolist()):
             try:
                 ov.append(float(fn(xv)))
             except (ZeroDivisionError, ValueError):
                 continue
             ou.append(u)
         return (np.asarray(ou, np.uint64),
-                np.asarray(ov, np.float64))
+                np.asarray(ov, np.float64), False)
 
     def eval_node(t):
         if t.const is not None:
@@ -2909,55 +2956,63 @@ def _eval_math_vec(tree, value_vars):
             cv = value_vars.get(t.var)
             if cv is None:
                 return (np.asarray([], np.uint64),
-                        np.asarray([], np.float64))
+                        np.asarray([], np.float64), False)
             if not isinstance(cv, ColVar):
                 raise _VecFallback
-            return (cv.uids, cv.floats())
+            return (cv.uids, cv.floats(), False)
         args = [eval_node(c) for c in t.children]
         if all(isinstance(a, float) for a in args):
             raise _VecFallback  # constant subtree feeding per-uid ops:
             # keep the dict path's scalar folding exactly
-        uids, vs = align(args)
+        flags = [a[2] if not isinstance(a, float) else False
+                 for a in args]
+        uids, asarr = align(args)
         fn = t.fn
-        asarr = [np.full(len(uids), v) if isinstance(v, float) else v
-                 for v in vs]
         if fn == "+":
-            return uids, asarr[0] + asarr[1]
+            return uids, asarr[0] + asarr[1], False
         if fn == "-":
-            return (uids, asarr[0] - asarr[1]) if len(asarr) == 2 \
-                else (uids, -asarr[0])
+            return (uids, asarr[0] - asarr[1], False) \
+                if len(asarr) == 2 else (uids, -asarr[0], False)
         if fn == "*":
-            return uids, asarr[0] * asarr[1]
+            return uids, asarr[0] * asarr[1], False
         if fn in ("/", "%"):
             keep = asarr[1] != 0.0
-            uids, vv = mask(uids, asarr, keep)
-            return uids, (vv[0] / vv[1] if fn == "/"
-                          else np.mod(vv[0], vv[1]))
+            u2, a, b = uids[keep], asarr[0][keep], asarr[1][keep]
+            return u2, (a / b if fn == "/" else np.mod(a, b)), False
         if fn in ("<", ">", "<=", ">=", "==", "!="):
             r = {"<": np.less, ">": np.greater, "<=": np.less_equal,
                  ">=": np.greater_equal, "==": np.equal,
                  "!=": np.not_equal}[fn](asarr[0], asarr[1])
-            return uids, r  # bool array; truthiness matches floats
-        if fn == "min":
-            r = asarr[0]
-            for x in asarr[1:]:
-                r = np.minimum(r, x)
-            return uids, r
-        if fn == "max":
-            r = asarr[0]
-            for x in asarr[1:]:
-                r = np.maximum(r, x)
-            return uids, r
+            return uids, r.astype(np.float64), True
         if fn == "cond":
-            return uids, np.where(asarr[0] != 0, asarr[1], asarr[2])
+            # the result is one of the BRANCHES, so only their flags
+            # matter; mixed bool/number branches would need a
+            # per-element flag — dict path handles those
+            bflags = flags[1:]
+            if any(bflags) and not all(bflags):
+                raise _VecFallback
+            r = np.where(asarr[0] != 0, asarr[1], asarr[2])
+            return uids, r, all(bflags)
+        if fn in ("min", "max"):
+            # python min/max RETURN one operand, so a bool operand can
+            # surface element-wise; only uniform flags are
+            # representable with one flag
+            if any(flags) and not all(flags):
+                raise _VecFallback
+            r = asarr[0]
+            red = np.minimum if fn == "min" else np.maximum
+            for x in asarr[1:]:
+                r = red(r, x)
+            return uids, r, all(flags)
         if fn == "floor":
-            return uids, np.floor(asarr[0])
+            return uids, np.floor(asarr[0]), False
         if fn == "ceil":
-            return uids, np.ceil(asarr[0])
+            return uids, np.ceil(asarr[0]), False
         if fn == "sqrt":
-            keep = asarr[0] >= 0.0
-            uids, vv = mask(uids, asarr, keep)
-            return uids, np.sqrt(vv[0])
+            # math.sqrt raises only for NEGATIVE args; NaN passes
+            # through as NaN and keeps its uid
+            keep = ~(asarr[0] < 0.0)
+            return uids[keep], np.sqrt(asarr[0][keep]), False
         # transcendental / two-arg host funcs: per-element math.* calls
         # for bit-parity with the dict path (numpy's vectorized exp/log
         # can differ in the last ulp)
@@ -2970,7 +3025,7 @@ def _eval_math_vec(tree, value_vars):
                         uids, asarr[0])
         if fn == "since":
             now = _time.time()
-            return uids, now - asarr[0]
+            return uids, now - asarr[0], False
         if fn in ("pow", "logbase"):
             xs, ys = asarr[0].tolist(), asarr[1].tolist()
             ou, ov = [], []
@@ -2985,14 +3040,14 @@ def _eval_math_vec(tree, value_vars):
                     continue
                 ou.append(u)
             return (np.asarray(ou, np.uint64),
-                    np.asarray(ov, np.float64))
+                    np.asarray(ov, np.float64), False)
         raise _VecFallback  # op the vector path doesn't cover
 
     res = eval_node(tree)
     if isinstance(res, float):
         return None
-    uids, vals = res
-    if vals.dtype == bool:
+    uids, vals, isbool = res
+    if isbool:
         return ColVar(uids, vals.astype(np.uint8), TypeID.FLOAT,
                       isbool=True)
     return ColVar(uids, vals.astype(np.float64), TypeID.FLOAT,

@@ -410,7 +410,10 @@ class Tablet:
             return _EMPTY.copy()
         return np.unique(np.concatenate(parts))
 
-    def count_of(self, src: int, read_ts: int) -> int:
+    def count_of(self, src: int, read_ts: int,
+                 reverse: bool = False) -> int:
+        if reverse:
+            return len(self.get_reverse_uids(src, read_ts))
         if self.is_uid:
             return len(self.get_dst_uids(src, read_ts))
         return len(self.get_postings(src, read_ts))
