@@ -38,6 +38,9 @@ from dgraph_tpu.storage.wal import Wal
 from dgraph_tpu.utils import metrics
 from dgraph_tpu.utils.tracing import span as _span
 
+# process-wide measured device dispatch RTT (device_dispatch_seconds)
+_DISPATCH_SECONDS: float | None = None
+
 
 def _fp(*parts) -> int:
     h = hashlib.blake2b(digest_size=8)
@@ -861,6 +864,36 @@ class GraphDB:
             "max_ts": self.coordinator.max_assigned(),
             "max_uid": self.coordinator._next_uid - 1,
         }
+
+    def device_dispatch_seconds(self) -> float:
+        """Measured round-trip of ONE trivial jitted dispatch (lazy,
+        cached per process).  This is the executor's device/host tier
+        constant: sub-millisecond with a locally attached chip, but
+        ~100ms over a tunneled remote TPU — the round-3 verdict's
+        51/74 device losses were exactly this RTT paid on queries
+        whose host cost is microseconds.  Distinct inputs per timing
+        dispatch defeat the remote runtime's (executable, args)
+        memoization."""
+        global _DISPATCH_SECONDS
+        if _DISPATCH_SECONDS is None:
+            try:
+                import time as _time
+
+                import jax
+                import jax.numpy as jnp
+                f = jax.jit(lambda x: x + 1)
+                xs = [jnp.asarray(np.asarray([i], np.int32))
+                      for i in range(4)]
+                np.asarray(f(xs[0]))  # compile outside the timing
+                best = float("inf")
+                for x in xs[1:]:
+                    t0 = _time.perf_counter()
+                    np.asarray(f(x))  # fetch forces the full round trip
+                    best = min(best, _time.perf_counter() - t0)
+                _DISPATCH_SECONDS = best
+            except Exception:
+                _DISPATCH_SECONDS = 0.0
+        return _DISPATCH_SECONDS
 
     def rollup_all(self):
         wm = self.coordinator.min_active_ts()

@@ -224,11 +224,7 @@ def _parse_one(line: str, lineno: int) -> tuple[NQuad, str]:
     rest = rest.strip()
     if rest.startswith("("):
         end = rest.index(")")
-        for part in rest[1:end].split(","):
-            if not part.strip():
-                continue
-            k, _, v = part.partition("=")
-            nq.facets[k.strip()] = _facet_val(v.strip())
+        nq.facets.update(parse_facet_text(rest[1:end]))
         rest = rest[end + 1:]
     rest = rest.strip()
     if not rest.startswith("."):
@@ -239,6 +235,19 @@ def _parse_one(line: str, lineno: int) -> tuple[NQuad, str]:
             f"rdf line {lineno}: statement not '.'-terminated at "
             f"{rest[:30]!r}")
     return nq, rest[1:]
+
+
+def parse_facet_text(inner: str) -> dict[str, Val]:
+    """`key = value, ...` between facet parens → typed facet dict.
+    Shared by the python grammar and the native parser's facet spans
+    (native.cc dgt_rdf_parse returns the span verbatim)."""
+    out: dict[str, Val] = {}
+    for part in inner.split(","):
+        if not part.strip():
+            continue
+        k, _, v = part.partition("=")
+        out[k.strip()] = _facet_val(v.strip())
+    return out
 
 
 def _facet_val(raw: str) -> Val:

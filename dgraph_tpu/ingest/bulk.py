@@ -26,11 +26,13 @@ from typing import Iterable, Iterator, Optional
 import numpy as np
 
 from dgraph_tpu.engine.db import GraphDB
-from dgraph_tpu.gql.nquad import NQuad
-from dgraph_tpu.ingest.chunker import chunk_file
+from dgraph_tpu.gql.nquad import (
+    _XS_TYPES, _coerce, _unescape, NQuad, parse_facet_text, parse_rdf,
+)
+from dgraph_tpu.ingest.chunker import _open, chunk_file, detect_format
 from dgraph_tpu.ingest.xidmap import XidMap
 from dgraph_tpu.models.schema import PredicateSchema
-from dgraph_tpu.models.types import TypeID, convert
+from dgraph_tpu.models.types import TypeID, Val, convert
 from dgraph_tpu.storage.tablet import Posting, Tablet
 from dgraph_tpu.wire import dumps as wire_dumps
 from dgraph_tpu.wire import loads as wire_loads
@@ -39,33 +41,43 @@ _SPILL_EDGES = 2_000_000  # mapper buffer flush threshold
 
 
 class _MapShard:
-    """Per-predicate mapper accumulator with disk spill."""
+    """Per-predicate mapper accumulator with disk spill.  Edge uids
+    arrive either one at a time (python grammar path: `src`/`dst`
+    lists) or as whole per-chunk arrays from the native parser
+    (`src_arrs`/`dst_arrs`) — the reduce concatenates both."""
 
     def __init__(self, tmpdir: str, pred: str):
         self.pred = pred
         self.tmpdir = tmpdir
         self.src: list[int] = []
         self.dst: list[int] = []
+        self.src_arrs: list[np.ndarray] = []
+        self.dst_arrs: list[np.ndarray] = []
         self.vals: list[tuple[int, Posting]] = []
         self.facets: list[tuple[int, int, dict]] = []
         self.runs: list[str] = []
 
+    def _edge_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        srcs = self.src_arrs + [np.asarray(self.src, np.uint64)]
+        dsts = self.dst_arrs + [np.asarray(self.dst, np.uint64)]
+        return np.concatenate(srcs), np.concatenate(dsts)
+
     def spill(self):
-        if not (self.src or self.vals):
+        if not (self.src or self.src_arrs or self.vals):
             return
         path = os.path.join(
             self.tmpdir, f"map-{len(self.runs)}-{abs(hash(self.pred))}.run")
+        srcs, dsts = self._edge_arrays()
         with open(path, "wb") as f:
-            f.write(wire_dumps((np.asarray(self.src, np.uint64),
-                                np.asarray(self.dst, np.uint64),
-                                self.vals, self.facets)))
+            f.write(wire_dumps((srcs, dsts, self.vals, self.facets)))
         self.runs.append(path)
         self.src, self.dst, self.vals, self.facets = [], [], [], []
+        self.src_arrs, self.dst_arrs = [], []
 
     def load_all(self):
         """Concatenated (src, dst, vals, facets) over all runs + buffer."""
-        srcs = [np.asarray(self.src, np.uint64)]
-        dsts = [np.asarray(self.dst, np.uint64)]
+        s0, d0 = self._edge_arrays()
+        srcs, dsts = [s0], [d0]
         vals = list(self.vals)
         facets = list(self.facets)
         for path in self.runs:
@@ -87,6 +99,21 @@ def bulk_load(paths: Iterable[str] = (), *,
     db = db or GraphDB()
     if schema:
         db.alter(schema)
+    # Millions of small Posting/Val objects make cyclic-GC gen2 scans
+    # the dominant nonlinearity at the 21M regime (the object graph
+    # here is acyclic); the reference tunes GC for bulk the same way
+    # (dgraph/main.go GC percent ticker).
+    import gc
+    gc_was = gc.isenabled()
+    gc.disable()
+    try:
+        return _bulk_load_locked(paths, nquads, db, tmpdir)
+    finally:
+        if gc_was:
+            gc.enable()
+
+
+def _bulk_load_locked(paths, nquads, db, tmpdir) -> GraphDB:
     own_tmp = tmpdir is None
     tmpdir = tmpdir or tempfile.mkdtemp(prefix="dg-bulk-")
     xidmap = XidMap(db.coordinator)
@@ -100,12 +127,6 @@ def bulk_load(paths: Iterable[str] = (), *,
             shards[pred] = s
         return s
 
-    def batches():
-        for p in paths:
-            yield from chunk_file(p)
-        if nquads is not None:
-            yield from nquads
-
     # -- map stage (ref bulk/mapper.go:207 processNQuad) --
     # explicit-uid high-water mark: the coordinator must know the max
     # BEFORE any later blank-node lease is cut (a deferred end-of-batch
@@ -115,31 +136,60 @@ def bulk_load(paths: Iterable[str] = (), *,
     bumped = 0
 
     def resolve(ref: str) -> int:
-        nonlocal bumped
         uid = _resolve(xidmap, ref)
+        bump_to(uid)
+        return uid
+
+    def bump_to(uid: int):
+        nonlocal bumped
         if uid > bumped:
             xidmap.coordinator.bump_uids(uid)
             bumped = uid
-        return uid
 
-    for batch in batches():
-        for nq in batch:
-            src = resolve(nq.subject)
-            s = shard(nq.predicate)
-            if nq.object_id:
-                dst = resolve(nq.object_id)
-                s.src.append(src)
-                s.dst.append(dst)
-                if nq.facets:
-                    s.facets.append((src, dst, nq.facets))
-            elif nq.object_value is not None:
-                s.vals.append((src, Posting(nq.object_value, nq.lang,
-                                            nq.facets)))
-            pending_edges += 1
+    def add_nq(nq: NQuad):
+        nonlocal pending_edges
+        src = resolve(nq.subject)
+        s = shard(nq.predicate)
+        if nq.object_id:
+            dst = resolve(nq.object_id)
+            s.src.append(src)
+            s.dst.append(dst)
+            if nq.facets:
+                s.facets.append((src, dst, nq.facets))
+        elif nq.object_value is not None:
+            s.vals.append((src, Posting(nq.object_value, nq.lang,
+                                        nq.facets)))
+        pending_edges += 1
+
+    def maybe_spill():
+        nonlocal pending_edges
         if pending_edges >= _SPILL_EDGES:
             for s in shards.values():
                 s.spill()
             pending_edges = 0
+
+    from dgraph_tpu import native as _native
+    for p in paths:
+        fmt = detect_format(p)
+        if fmt == "rdf" and _native.available():
+            # columnar fast path: the native parser returns whole
+            # uid/literal row arrays per chunk; only lines outside its
+            # grammar go through the python parser (bit-identical —
+            # tested against parse_rdf on the same input)
+            for text in _raw_text_chunks(p):
+                pending_edges += _map_native_chunk(
+                    text, shard, add_nq, bump_to)
+                maybe_spill()
+        else:
+            for batch in chunk_file(p, fmt):
+                for nq in batch:
+                    add_nq(nq)
+                maybe_spill()
+    if nquads is not None:
+        for batch in nquads:
+            for nq in batch:
+                add_nq(nq)
+            maybe_spill()
 
     # -- reduce stage (ref bulk/reduce.go:50) --
     write_ts = db.coordinator.next_ts()
@@ -188,6 +238,97 @@ def bulk_load(paths: Iterable[str] = (), *,
         except OSError:
             pass
     return db
+
+
+_NOID = (1 << 64) - 1  # native parser's "no lang/dtype" sentinel
+
+
+def _raw_text_chunks(path: str, chunk_bytes: int = 8 << 20):
+    """Raw text blocks split at line boundaries (gzip transparent) —
+    the native parser's input unit."""
+    with _open(path) as f:
+        while True:
+            block = f.read(chunk_bytes)
+            if not block:
+                return
+            tail = f.readline()
+            yield block + (tail or "")
+
+
+def _map_native_chunk(text: str, shard, add_nq, bump_to) -> int:
+    """One text chunk through native.rdf_parse: edge rows land as
+    arrays grouped by predicate, literal rows build Postings directly,
+    fallback lines replay through the exact python grammar (ref
+    bulk/mapper.go:207 processNQuad, chunker/rdf_parser.go:58)."""
+    from dgraph_tpu import native
+
+    data = text.encode("utf-8")
+    parsed = native.rdf_parse(data)
+    if parsed is None:
+        for nq in parse_rdf(text):
+            add_nq(nq)
+        return 0
+    e_subj, e_pred, e_dst, e_fs, e_fl = parsed.edges
+    (v_subj, v_pred, v_ls, v_ll, v_flags,
+     v_lang, v_dtype, v_fs, v_fl) = parsed.vals
+    # uid high-water BEFORE any fallback blank-node lease is cut
+    hi = 0
+    if len(e_subj):
+        hi = max(int(e_subj.max()), int(e_dst.max()))
+    if len(v_subj):
+        hi = max(hi, int(v_subj.max()))
+    if hi:
+        bump_to(hi)
+    preds = parsed.preds
+    n = 0
+    if len(e_subj):
+        order = np.argsort(e_pred, kind="stable")
+        ep = e_pred[order]
+        bounds = np.nonzero(np.r_[True, ep[1:] != ep[:-1]])[0]
+        ends = np.r_[bounds[1:], len(ep)]
+        for b, e in zip(bounds.tolist(), ends.tolist()):
+            grp = order[b:e]
+            s = shard(preds[int(ep[b])])
+            s.src_arrs.append(e_subj[grp])
+            s.dst_arrs.append(e_dst[grp])
+        for i in np.nonzero(e_fl)[0].tolist():
+            fc = parse_facet_text(
+                data[int(e_fs[i]):int(e_fs[i] + e_fl[i])].decode())
+            if fc:  # `( )` parses empty; python's `if nq.facets` skips
+                shard(preds[int(e_pred[i])]).facets.append(
+                    (int(e_subj[i]), int(e_dst[i]), fc))
+        n += len(e_subj)
+    if len(v_subj):
+        langs, dtypes = parsed.langs, parsed.dtypes
+        for subj, pid, ls, ll, fl, lg, dt, fs, flen in zip(
+                v_subj.tolist(), v_pred.tolist(), v_ls.tolist(),
+                v_ll.tolist(), v_flags.tolist(), v_lang.tolist(),
+                v_dtype.tolist(), v_fs.tolist(), v_fl.tolist()):
+            sval = data[ls:ls + ll].decode("utf-8")
+            if fl & 1:
+                sval = _unescape(sval)
+            if dt != _NOID:
+                dtype = dtypes[dt]
+                tid = _XS_TYPES.get(
+                    dtype.split("#")[-1] if "#" in dtype else dtype)
+                val = _coerce(sval,
+                              TypeID.STRING if tid is None else tid)
+            else:
+                val = Val(TypeID.DEFAULT, sval)
+            facets = parse_facet_text(
+                data[fs:fs + flen].decode("utf-8")) if flen else {}
+            shard(preds[pid]).vals.append(
+                (subj, Posting(val, langs[lg] if lg != _NOID else "",
+                               facets)))
+        n += len(v_subj)
+    fb_s, fb_l = parsed.fallback
+    if len(fb_s):
+        txt = "\n".join(
+            data[int(a):int(a + b)].decode("utf-8")
+            for a, b in zip(fb_s.tolist(), fb_l.tolist()))
+        for nq in parse_rdf(txt):
+            add_nq(nq)
+    return n
 
 
 def _resolve(xidmap: XidMap, ref: str) -> int:

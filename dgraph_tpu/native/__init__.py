@@ -130,6 +130,20 @@ def _bind(lib):
         lib.dgt_match_mask.argtypes = [
             u8p, ctypes.c_uint32, ctypes.c_int32, u8p,
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, u8p]
+        lib.dgt_tokenize_batch.restype = ctypes.c_int
+        lib.dgt_tokenize_batch.argtypes = [
+            u8p, u64p, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint8, ctypes.c_uint8, ctypes.c_uint8,
+            ctypes.c_uint8,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            u64p,
+            ctypes.POINTER(u64p), u64p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32)), u64p,
+            ctypes.POINTER(u64p)]
+        lib.dgt_rdf_parse.restype = ctypes.c_int
+        lib.dgt_rdf_parse.argtypes = [
+            u8p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)), u64p]
         lib.dgt_json_rows.restype = ctypes.c_int
         lib.dgt_json_rows.argtypes = [
             ctypes.c_int64, ctypes.c_int32,
@@ -400,3 +414,132 @@ def match_mask(term_lower: bytes, max_d: int, blob, offsets) -> "object":
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     return out[:n]
+
+
+# dgt_tokenize_batch mode bits (mirror native.cc)
+TOK_TERM = 1
+TOK_TRIGRAM = 2
+TOK_FULLTEXT_EN = 4
+TOK_EXACT = 8
+
+
+def tokenize_batch(payload, offsets, mode: int, idents) -> "object":
+    """Batched ASCII tokenization for index builds (ref tok/tok.go
+    built-in tokenizers; native.cc dgt_tokenize_batch).  `payload` is
+    the concatenated utf-8 (ASCII-only) values, `offsets` a uint64
+    array of n+1 boundaries, `idents` the (term, trigram, fulltext,
+    exact) identifier bytes.  Returns (tokens: list[bytes] with ident
+    prefixes, groups: list[np.uint32 value-index arrays]); tokens are
+    UNIQUE and each group is ascending, but the token list is NOT
+    globally sorted (short-packed tokens precede long ones — the C
+    sort runs per partition).  None when the native runtime is
+    unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    import numpy as np
+    payload = np.ascontiguousarray(payload, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.uint64)
+    n = len(offsets) - 1
+    u8pp = ctypes.POINTER(ctypes.c_uint8)
+    u64pp = ctypes.POINTER(ctypes.c_uint64)
+    tok_out = u8pp()
+    tok_len = ctypes.c_uint64()
+    tok_offs = u64pp()
+    n_toks = ctypes.c_uint64()
+    val_idx = ctypes.POINTER(ctypes.c_uint32)()
+    n_pairs = ctypes.c_uint64()
+    bounds = u64pp()
+    rc = lib.dgt_tokenize_batch(
+        payload.ctypes.data_as(u8pp),
+        offsets.ctypes.data_as(u64pp),
+        n, mode, idents[0], idents[1], idents[2], idents[3],
+        ctypes.byref(tok_out), ctypes.byref(tok_len),
+        ctypes.byref(tok_offs), ctypes.byref(n_toks),
+        ctypes.byref(val_idx), ctypes.byref(n_pairs),
+        ctypes.byref(bounds))
+    if rc != 0:
+        return None
+    try:
+        nt = n_toks.value
+        npair = n_pairs.value
+        toks_b = ctypes.string_at(tok_out, tok_len.value)
+        offs = np.ctypeslib.as_array(tok_offs, shape=(nt + 1,)).copy()
+        bnds = np.ctypeslib.as_array(bounds, shape=(nt + 1,)).copy()
+        vidx = np.ctypeslib.as_array(
+            val_idx, shape=(max(npair, 1),))[:npair].copy()
+        tokens = [toks_b[offs[i]:offs[i + 1]] for i in range(nt)]
+        groups = [vidx[bnds[i]:bnds[i + 1]] for i in range(nt)]
+        return tokens, groups
+    finally:
+        lib.dgt_free(tok_out)
+        lib.dgt_free(tok_offs)
+        lib.dgt_free(val_idx)
+        lib.dgt_free(bounds)
+
+
+class ParsedRdf:
+    """Columnar result of dgt_rdf_parse (see native.cc blob layout):
+    edge rows, literal rows, interned pred/lang/dtype tables, and the
+    fallback line spans the python grammar must parse."""
+
+    __slots__ = ("edges", "vals", "fallback", "preds", "langs",
+                 "dtypes")
+
+    def __init__(self, edges, vals, fallback, preds, langs, dtypes):
+        self.edges = edges        # (subj, pred_id, dst, fac_start, fac_len)
+        self.vals = vals          # (subj, pred_id, lit_start, lit_len,
+        #                            flags, lang_id, dtype_id,
+        #                            fac_start, fac_len)
+        self.fallback = fallback  # (start, len) line spans
+        self.preds = preds
+        self.langs = langs
+        self.dtypes = dtypes
+
+
+def rdf_parse(text: bytes) -> "ParsedRdf | None":
+    """Parse an N-Quad text chunk natively; None when the runtime is
+    unavailable.  Lines outside the fast grammar come back as spans in
+    .fallback — the caller routes them through gql.nquad.parse_rdf."""
+    lib = _load()
+    if lib is None:
+        return None
+    import numpy as np
+    blob_p = ctypes.POINTER(ctypes.c_uint8)()
+    blob_len = ctypes.c_uint64()
+    buf = np.frombuffer(text, np.uint8)
+    rc = lib.dgt_rdf_parse(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(text),
+        ctypes.byref(blob_p), ctypes.byref(blob_len))
+    if rc != 0:
+        return None
+    try:
+        raw = np.frombuffer(
+            ctypes.string_at(blob_p, blob_len.value), np.uint64)
+        n_e, n_v, n_fb, n_p, n_l, n_d, pb, lb, db = raw[:9].tolist()
+        o = 9
+
+        def take(n):
+            nonlocal o
+            a = raw[o:o + n]
+            o += n
+            return a
+
+        edges = tuple(take(n_e) for _ in range(5))
+        vals = tuple(take(n_v) for _ in range(9))
+        fallback = (take(n_fb), take(n_fb))
+
+        def table(n, nbytes):
+            nonlocal o
+            offs = take(n + 1)
+            bview = raw[o:o + (nbytes + 7) // 8].tobytes()[:nbytes]
+            o += (nbytes + 7) // 8
+            return [bview[offs[i]:offs[i + 1]].decode("utf-8")
+                    for i in range(n)]
+
+        preds = table(n_p, pb)
+        langs = table(n_l, lb)
+        dtypes = table(n_d, db)
+        return ParsedRdf(edges, vals, fallback, preds, langs, dtypes)
+    finally:
+        lib.dgt_free(blob_p)

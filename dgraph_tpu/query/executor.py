@@ -805,16 +805,21 @@ class Executor:
         # strings compare beyond the 8-byte key prefix: exact host compare
         if tid in (TypeID.STRING, TypeID.DEFAULT):
             return self._ineq_scan_strings(tab, fn, candidates)
-        if self.db.prefer_device:
+        if self.db.prefer_device and self._device_worth(
+                len(getattr(tab, "values", ())) * self._HOST_PER_RANGE_VAL):
             dev = self._device_range(tab, lo, hi, lo_open, hi_open)
             if dev is not None:
                 return dev if candidates is None \
                     else _intersect(candidates, dev)
-        pairs = self._sortkeys_for(tab)
-        if not pairs:
+        if tab.dirty() or self.read_ts < tab.base_ts \
+                or not hasattr(tab, "sort_key_arrays"):
+            pairs = self._sortkeys_for(tab)
+            uids = np.fromiter(pairs.keys(), np.uint64, len(pairs))
+            keys = np.fromiter(pairs.values(), np.int64, len(pairs))
+        else:
+            uids, keys = tab.sort_key_arrays()
+        if not len(uids):
             return _EMPTY
-        uids = np.fromiter(pairs.keys(), dtype=np.uint64, count=len(pairs))
-        keys = np.fromiter(pairs.values(), dtype=np.int64, count=len(pairs))
         m = (keys > lo if lo_open else keys >= lo) & \
             (keys < hi if hi_open else keys <= hi)
         out = np.sort(uids[m])
@@ -1693,6 +1698,25 @@ class Executor:
             return dev
         return tab.expand_frontier(src, self.read_ts, reverse)
 
+    # host-side cost constants for the device/host tier choice (coarse
+    # per-element figures for the vectorized numpy paths; the fixed
+    # side of the comparison is the MEASURED dispatch RTT, so only the
+    # order of magnitude matters here)
+    _HOST_PER_FRONTIER_UID = 1.5e-6   # dict lookup + concat per parent
+    _HOST_PER_EDGE = 4e-8             # np.unique share per edge
+    _HOST_PER_ORDER_KEY = 2e-6        # get_postings + sort_key per uid
+    _HOST_PER_RANGE_VAL = 5e-9        # cached-array mask per value
+
+    def _device_worth(self, est_host_seconds: float) -> bool:
+        """Use the device only when the estimated host cost clears the
+        measured dispatch round-trip (ref algo/uidlist.go:151's
+        size-ratio strategy pick, applied to the host/accelerator
+        boundary).  `device_min_edges <= 1` forces the tier — that is
+        the tests' and operators' explicit override."""
+        if self.db.device_min_edges <= 1:
+            return True
+        return est_host_seconds > self.db.device_dispatch_seconds() * 1.25
+
     def _device_expand(self, tab: Tablet, src: np.ndarray,
                        reverse: bool = False) -> Optional[np.ndarray]:
         from dgraph_tpu.engine.device_cache import (
@@ -1704,7 +1728,10 @@ class Executor:
             return None
         if self.db.mesh is not None:
             # uid-range-sharded tier first: a predicate too big for one
-            # chip expands via shard_map over the mesh (SURVEY §5.7)
+            # chip expands via shard_map over the mesh (SURVEY §5.7).
+            # Capacity, not latency: the cost gate below never blocks
+            # this tier — the single-chip/host choice is moot for a
+            # tablet that exceeds one chip.
             sadj = device_sharded_adjacency(self.db, tab, self.read_ts,
                                             reverse)
             if sadj is not None:
@@ -1713,6 +1740,12 @@ class Executor:
                 inc_counter("query_sharded_expand_total",
                             labels={"dir": "rev" if reverse else "fwd"})
                 return expand_sharded_np(self.db.mesh, sadj, src)
+        store = tab.reverse if reverse else tab.edges
+        deg = tab.edge_count(reverse) / max(1, len(store))
+        if not self._device_worth(
+                len(src) * (self._HOST_PER_FRONTIER_UID
+                            + deg * self._HOST_PER_EDGE)):
+            return None
         adj = (device_radjacency if reverse else device_adjacency)(
             self.db, tab, self.read_ts, allow_dirty=True)
         if adj is None:
@@ -1807,7 +1840,9 @@ class Executor:
     def _apply_order(self, orders, uids: np.ndarray) -> np.ndarray:
         """Multi-key value sort; stable, missing-value uids last
         (ref types/sort.go:118 + worker/sort.go)."""
-        if self.db.prefer_device and len(uids) >= 8:
+        if self.db.prefer_device and len(uids) >= 8 \
+                and self._device_worth(
+                    len(uids) * len(orders) * self._HOST_PER_ORDER_KEY):
             dev = self._device_apply_order(orders, uids)
             if dev is not None:
                 return dev
@@ -1887,7 +1922,9 @@ class Executor:
         tab = self._tablet(attr)
         if tab is None:
             return out
-        if self.db.prefer_device and len(uids) >= 8:
+        if self.db.prefer_device and len(uids) >= 8 \
+                and self._device_worth(
+                    len(uids) * self._HOST_PER_ORDER_KEY):
             dev = self._device_order_keys(tab, uids, lang)
             if dev is not None:
                 return dev

@@ -410,6 +410,18 @@ class Tablet:
             return _EMPTY.copy()
         return np.unique(np.concatenate(parts))
 
+    def edge_count(self, reverse: bool = False) -> int:
+        """Total base edges (cached per base_ts): the executor's
+        device/host cost model sizes expansions with it."""
+        cached = getattr(self, "_edge_count_cache", None)
+        if cached is not None and cached[0] == self.base_ts:
+            fwd, rev = cached[1], cached[2]
+        else:
+            fwd = sum(len(v) for v in self.edges.values())
+            rev = sum(len(v) for v in self.reverse.values())
+            self._edge_count_cache = (self.base_ts, fwd, rev)
+        return rev if reverse else fwd
+
     def count_of(self, src: int, read_ts: int,
                  reverse: bool = False) -> int:
         if reverse:
@@ -661,6 +673,9 @@ class Tablet:
     # -- index (re)build: Alter adding @index to live data
     #    (ref posting/index.go:496 rebuilder) --
 
+    # tokenizer names dgt_tokenize_batch covers for ASCII payloads
+    _NATIVE_TOKS = frozenset(("term", "exact", "trigram", "fulltext"))
+
     def rebuild_index(self):
         # batch build: collect per token, ONE sort+unique per posting
         # list at the end — per-element sorted np.insert is O(n^2) and
@@ -668,13 +683,106 @@ class Tablet:
         self.index = {}
         if not self.schema.indexed:
             return
-        acc: dict[bytes, list[int]] = {}
+        # `ready` holds token lists that are already sorted-unique
+        # (single clean native chunk) — the common case; one np.unique
+        # per token across 600k exact/term tokens was half the native
+        # path's wall clock otherwise
+        ready: dict[bytes, np.ndarray] = {}
+        acc: dict[bytes, list[np.ndarray]] = {}
+        rest = self._index_batch_native(ready, acc)
+        pyacc: dict[bytes, list[int]] = {}
+        for src, p in rest:
+            for tk in self._tokens(p):
+                pyacc.setdefault(tk, []).append(src)
+        for tk, srcs in pyacc.items():
+            acc.setdefault(tk, []).append(np.asarray(srcs, np.uint64))
+        for tk, parts in acc.items():
+            prev = ready.pop(tk, None)
+            if prev is not None:
+                parts.append(prev)
+            ready[tk] = np.unique(np.concatenate(parts)) \
+                if len(parts) > 1 else np.unique(parts[0])
+        self.index = ready
+
+    def _index_batch_native(self, ready: dict, acc: dict) -> list:
+        """Tokenize the ASCII string postings through the C++ batch
+        tokenizer (native.cc dgt_tokenize_batch) — the reference maps
+        at 75-80k RDF/s WITH index entries (bulk/mapper.go:272) where
+        the per-value python tokenizer managed ~20k.  Returns the
+        postings the native path cannot serve bit-identically
+        (non-ASCII, non-string-typed, non-English fulltext tags,
+        tokenizers outside the native set); ASCII folding equals the
+        python NFKD+casefold chain, so handled postings produce the
+        same tokens."""
+        from dgraph_tpu import native
+        from dgraph_tpu.models.stemmer import lang_base
+
+        toks = set(self.schema.tokenizers or ())
+        if not toks or not toks <= self._NATIVE_TOKS \
+                or not native.available():
+            return [(src, p) for src, plist in self.values.items()
+                    for p in plist]
+        mode = (native.TOK_TERM if "term" in toks else 0) \
+            | (native.TOK_TRIGRAM if "trigram" in toks else 0) \
+            | (native.TOK_FULLTEXT_EN if "fulltext" in toks else 0) \
+            | (native.TOK_EXACT if "exact" in toks else 0)
+        idents = tuple(get_tokenizer(n).ident
+                       for n in ("term", "trigram", "fulltext", "exact"))
+        need_en = "fulltext" in toks
+        rest: list = []
+        srcs: list[int] = []
+        payloads: list[bytes] = []
+
+        def flush():
+            if not srcs:
+                return
+            payload = b"".join(payloads)
+            offsets = np.zeros(len(payloads) + 1, np.uint64)
+            np.cumsum([len(b) for b in payloads],
+                      out=offsets[1:], dtype=np.uint64)
+            got = native.tokenize_batch(
+                np.frombuffer(payload, np.uint8), offsets, mode, idents)
+            src_arr = np.asarray(srcs, np.uint64)
+            if got is None:
+                rest.extend(
+                    (int(s), p) for s, p in zip(srcs, chunk_postings))
+            else:
+                # within a chunk the groups are ascending value-index;
+                # with strictly increasing srcs the gathered uid lists
+                # are therefore already sorted-unique -> `ready`
+                clean = len(src_arr) < 2 \
+                    or bool(np.all(np.diff(src_arr.view(np.int64)) > 0))
+                for tk, grp in zip(*got):
+                    arr = src_arr[grp]
+                    if clean and tk not in acc and tk not in ready:
+                        ready[tk] = arr
+                        continue
+                    prev = ready.pop(tk, None)
+                    if prev is not None:
+                        acc.setdefault(tk, []).append(prev)
+                    acc.setdefault(tk, []).append(arr)
+            srcs.clear()
+            payloads.clear()
+            chunk_postings.clear()
+
+        chunk_postings: list = []
         for src, plist in self.values.items():
             for p in plist:
-                for tk in self._tokens(p):
-                    acc.setdefault(tk, []).append(src)
-        self.index = {tk: np.unique(np.asarray(srcs, np.uint64))
-                      for tk, srcs in acc.items()}
+                v = p.value
+                s = v.value
+                if v.tid not in (TypeID.STRING, TypeID.DEFAULT) \
+                        or not isinstance(s, str) or not s.isascii() \
+                        or (need_en and p.lang
+                            and lang_base(p.lang) != "en"):
+                    rest.append((src, p))
+                    continue
+                srcs.append(src)
+                payloads.append(s.encode("ascii"))
+                chunk_postings.append(p)
+                if len(srcs) >= 131072:
+                    flush()
+        flush()
+        return rest
 
     def rebuild_reverse(self):
         self.reverse = {}
@@ -696,6 +804,24 @@ class Tablet:
                 for i, u in enumerate(uniq)}
 
     # -- sortable keys for device values --
+
+    def sort_key_arrays(self, lang: str = ""):
+        """(uids u64, int64 keys) of sort_key_pairs as cached arrays —
+        an inequality root at the 21M regime otherwise paid a fresh
+        1M-entry dict build + fromiter on EVERY query (ref
+        worker/tokens.go:113 walks an index that already exists; this
+        is our equivalent persistent structure). Cached per (base_ts,
+        schema object, lang) exactly like value_columns."""
+        cached = getattr(self, "_sk_arrays", None)
+        tag = (self.base_ts, self.schema, lang)
+        if cached is not None and cached[0][0] == self.base_ts \
+                and cached[0][1] is self.schema and cached[0][2] == lang:
+            return cached[1], cached[2]
+        pairs = self.sort_key_pairs(lang)
+        uids = np.fromiter(pairs.keys(), np.uint64, len(pairs))
+        keys = np.fromiter(pairs.values(), np.int64, len(pairs))
+        self._sk_arrays = (tag, uids, keys)
+        return uids, keys
 
     def sort_key_pairs(self, lang: str = "") -> dict[int, int]:
         """uid -> int64 sort key of its first value in `lang` ("" =

@@ -40,6 +40,10 @@ uint8_t* dgt_wal_replay(void*, uint64_t*, uint64_t*);
 int dgt_wal_truncate(void*);
 void dgt_wal_close(void*);
 void dgt_free(void*);
+int dgt_tokenize_batch(const uint8_t*, const uint64_t*, uint32_t,
+                       uint32_t, uint8_t, uint8_t, uint8_t, uint8_t,
+                       uint8_t**, uint64_t*, uint64_t**, uint64_t*,
+                       uint32_t**, uint64_t*, uint64_t**);
 int64_t dgt_gv_encode(const uint64_t*, uint64_t, uint8_t*);
 int64_t dgt_gv_decode(const uint8_t*, uint64_t, uint64_t*);
 uint64_t dgt_gv_count(const uint8_t*, uint64_t);
@@ -194,6 +198,43 @@ static void test_match() {
   printf("match ok\n");
 }
 
+static void test_tokenize() {
+  // mixed lengths: trigram windows, >15-byte exact tokens, empties,
+  // NUL bytes — every output buffer walked end to end under ASan
+  const char* vals[] = {"The Running Foxes", "", "ab",
+                        "an exact value well over fifteen bytes",
+                        "nul\0byte", "x"};
+  size_t lens[] = {17, 0, 2, 38, 8, 1};
+  std::vector<uint8_t> payload;
+  std::vector<uint64_t> offs = {0};
+  for (int i = 0; i < 6; i++) {
+    payload.insert(payload.end(), (const uint8_t*)vals[i],
+                   (const uint8_t*)vals[i] + lens[i]);
+    offs.push_back(payload.size());
+  }
+  uint8_t* tok = nullptr; uint64_t tlen = 0, ntoks = 0, npairs = 0;
+  uint64_t* toffs = nullptr; uint64_t* bounds = nullptr;
+  uint32_t* vidx = nullptr;
+  assert(dgt_tokenize_batch(payload.data(), offs.data(), 6, 15,
+                            1, 5, 8, 2, &tok, &tlen, &toffs, &ntoks,
+                            &vidx, &npairs, &bounds) == 0);
+  assert(ntoks > 0 && npairs >= ntoks);
+  uint64_t seen = 0;
+  for (uint64_t t = 0; t < ntoks; t++) {
+    assert(toffs[t] < toffs[t + 1] && toffs[t + 1] <= tlen);
+    for (uint64_t j = toffs[t]; j < toffs[t + 1]; j++)
+      (void)tok[j];
+    assert(bounds[t] < bounds[t + 1] && bounds[t + 1] <= npairs);
+    for (uint64_t p = bounds[t]; p < bounds[t + 1]; p++) {
+      assert(vidx[p] < 6);
+      seen++;
+    }
+  }
+  assert(seen == npairs);
+  dgt_free(tok); dgt_free(toffs); dgt_free(vidx); dgt_free(bounds);
+  printf("tokenize ok\n");
+}
+
 int main(int argc, char** argv) {
   std::string dir = argc > 1 ? argv[1] : "/tmp/dgt-sanitize";
   test_kv(dir + "/kv");
@@ -201,6 +242,7 @@ int main(int argc, char** argv) {
   test_wal(dir + "/test.wal");
   test_codec();
   test_match();
+  test_tokenize();
   printf("sanitize_test: all ok\n");
   return 0;
 }

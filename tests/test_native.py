@@ -333,3 +333,89 @@ def test_kv_legacy_snapshot_migrates_to_runs(tmp_path):
     kv = native.NativeKV(str(d))
     assert kv.get(b"old2") == b"y"
     kv.close()
+
+
+def test_tokenize_batch_parity():
+    """dgt_tokenize_batch must be BIT-IDENTICAL to the python
+    tokenizers for ASCII payloads (ref tok/tok.go term/exact/trigram/
+    fulltext; the native path serves bulk index builds)."""
+    import random
+
+    import numpy as np
+
+    from dgraph_tpu import native
+    from dgraph_tpu.models.tokenizer import get_tokenizer, tokens_for
+    from dgraph_tpu.models.types import TypeID, Val
+    from dgraph_tpu.utils.keys import token_bytes
+
+    if not native.available():
+        import pytest
+        pytest.skip("native runtime unavailable")
+    rng = random.Random(99)
+    words = ["the", "Running", "quickly", "fox", "Churches",
+             "happiness", "nationalization", "agreed", "plastered",
+             "motoring", "internationalizations", "x1_y2", "ab",
+             "caresses", "ponies", "feed", "sky"]
+    vals = [" ".join(rng.choice(words)
+                     for _ in range(rng.randint(0, 5)))
+            + rng.choice(["", "!", " 42", ",.-"]) for _ in range(300)]
+    vals += ["", "a", "abc", "ALL CAPS", "under_score",
+             "an exact value well over fifteen bytes long",
+             "nul\x00byte", "  padded  "]
+    specs = {n: get_tokenizer(n)
+             for n in ("term", "trigram", "fulltext", "exact")}
+    py: dict = {}
+    for i, s in enumerate(vals):
+        for spec in specs.values():
+            for t in tokens_for(Val(TypeID.STRING, s), spec, ""):
+                py.setdefault(token_bytes(spec.ident, t), set()).add(i)
+    enc = [s.encode() for s in vals]
+    payload = b"".join(enc)
+    offsets = np.zeros(len(vals) + 1, np.uint64)
+    np.cumsum([len(b) for b in enc], out=offsets[1:], dtype=np.uint64)
+    mode = (native.TOK_TERM | native.TOK_TRIGRAM
+            | native.TOK_FULLTEXT_EN | native.TOK_EXACT)
+    got = native.tokenize_batch(
+        np.frombuffer(payload, np.uint8), offsets, mode,
+        tuple(specs[n].ident
+              for n in ("term", "trigram", "fulltext", "exact")))
+    assert got is not None
+    nat = {t: set(g.tolist()) for t, g in zip(*got)}
+    assert nat == py
+
+
+def test_rebuild_index_native_matches_python():
+    """rebuild_index through the native batch path == the per-posting
+    python path, including non-ASCII and lang-tagged fallbacks."""
+    import numpy as np
+
+    import dgraph_tpu.native as native
+    from dgraph_tpu.models.schema import SchemaState
+    from dgraph_tpu.models.types import TypeID, Val
+    from dgraph_tpu.storage.tablet import Posting, Tablet
+
+    if not native.available():
+        import pytest
+        pytest.skip("native runtime unavailable")
+    sch = SchemaState()
+    sch.apply_text(
+        "name: string @index(term, exact, trigram, fulltext) @lang .")
+    tab = Tablet("name", sch.get_or_default("name"))
+    rows = [(1, "The Running Foxes", ""), (2, "Café Münchën", ""),
+            (3, "Deutsche Wörter hier", "de"), (4, "plain words", "de"),
+            (5, "running foxes again", ""), (6, "", ""), (7, "ab", "")]
+    for u, s, lang in rows:
+        tab.values[u] = [Posting(value=Val(TypeID.STRING, s),
+                                 lang=lang)]
+    tab.base_ts = 1
+    tab.rebuild_index()
+    idx_native = {k: v.copy() for k, v in tab.index.items()}
+    orig = native.available
+    native.available = lambda: False
+    try:
+        tab.rebuild_index()
+    finally:
+        native.available = orig
+    assert set(idx_native) == set(tab.index)
+    for k in tab.index:
+        assert np.array_equal(idx_native[k], tab.index[k]), k
