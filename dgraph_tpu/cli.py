@@ -181,7 +181,8 @@ def cmd_node(args) -> int:
         zero_addrs = _parse_peers(args.zero) if args.zero else None
         srv = AlphaServer(args.id, peers, (chost, int(cport)),
                           group=args.group, replicas=args.replicas,
-                          zero_addrs=zero_addrs, **kw)
+                          zero_addrs=zero_addrs,
+                          snapshot=getattr(args, "snapshot", ""), **kw)
     else:
         srv = ZeroServer(args.id, peers, (chost, int(cport)), **kw)
     print(f"dgraph-tpu {args.kind} node {args.id}: raft "
@@ -338,7 +339,16 @@ def cmd_bulk(args) -> int:
             for t in db.tablets.values())
     print(f"loaded {n} edges across {len(db.tablets)} predicates "
           f"in {dt:.2f}s ({n / max(dt, 1e-9):.0f} edges/s)")
-    if args.out:
+    if args.out and args.reduce_shards > 1:
+        from dgraph_tpu.ingest.bulk import bulk_shard_outputs
+
+        manifest = bulk_shard_outputs(db, args.reduce_shards, args.out)
+        for g, ps in sorted(manifest["groups"].items(),
+                            key=lambda kv: int(kv[0])):
+            print(f"group {g}: {len(ps)} tablets -> "
+                  f"{args.out}/g{g}/p.snap")
+        print(f"manifest written to {args.out}/manifest.json")
+    elif args.out:
         from dgraph_tpu.storage.snapshot import save_snapshot
 
         save_snapshot(db, args.out)
@@ -760,7 +770,13 @@ def main(argv=None) -> int:
     b.add_argument("files", nargs="+")
     b.add_argument("--schema", default="")
     b.add_argument("--out", default="",
-                   help="snapshot file to write (the bulk output)")
+                   help="snapshot file to write (the bulk output); "
+                        "with --reduce-shards > 1, a DIRECTORY of "
+                        "per-group snapshots out/g<k>/p.snap")
+    b.add_argument("--reduce-shards", type=int, default=1,
+                   help="shard the output across N future alpha "
+                        "groups (ref dgraph bulk --reduce_shards: "
+                        "one out/<i>/p per group)")
     b.add_argument("--custom_tokenizers", default="",
                    help="comma-separated Python plugin files, each "
                         "exporting tokenizer()")
@@ -813,6 +829,10 @@ def main(argv=None) -> int:
                    help="zero quorum client addrs (id=host:port,...) — "
                         "enables multi-group mode: tablet ownership "
                         "checks + zero-leased uid blocks")
+    n.add_argument("--snapshot", default="",
+                   help="boot the group's engine from a bulk output "
+                        "snapshot (out/g<k>/p.snap); every replica of "
+                        "the group must use the same file")
     n.add_argument("--wal", default="", help="raft storage directory")
     n.add_argument("--sync", action="store_true")
     n.add_argument("--tick-ms", type=int, default=50)

@@ -434,7 +434,8 @@ class AlphaServer(RaftServer):
     def __init__(self, node_id: int, raft_peers, client_addr,
                  storage=None, db_kw: Optional[dict] = None,
                  group: int = 1, replicas: int = 1,
-                 zero_addrs: Optional[dict] = None, **kw):
+                 zero_addrs: Optional[dict] = None,
+                 snapshot: str = "", **kw):
         from dgraph_tpu.engine.db import GraphDB
 
         # group=0 + a zero quorum = elastic join (ref zero/zero.go:410
@@ -474,6 +475,14 @@ class AlphaServer(RaftServer):
         self._db_kw = dict(db_kw or {})
         self._db_kw.setdefault("prefer_device", False)
         self.db = GraphDB(**self._db_kw)
+        # bulk-booted group: seed the engine from a `dgraph_tpu bulk
+        # --reduce-shards` output BEFORE raft starts (ref handing
+        # out/<i>/p to a group's alphas; every replica of the group
+        # must boot from the same snapshot file)
+        self._boot_snapshot = snapshot
+        if snapshot:
+            from dgraph_tpu.storage.snapshot import load_snapshot
+            load_snapshot(snapshot, self.db)
         # open interactive txns (dgo flow): leader-local by design —
         # the reference's txns are likewise coordinated with the group
         # leader and die on leader change (clients retry)
@@ -574,10 +583,43 @@ class AlphaServer(RaftServer):
                 "args": (f"{my_raft[0]}:{my_raft[1]}", self.group,
                          self.id, tuple(my_raft),
                          tuple(self.client_addr), 1)})
-            if got.get("ok"):
+            if got.get("ok") and self._claim_boot_tablets():
                 break
             time.sleep(1.0)
         self._report_sizes_loop()
+
+    def _claim_boot_tablets(self) -> bool:
+        """Bulk-booted state: register every pre-loaded tablet with
+        zero and push the snapshot's ts/uid watermarks so zero never
+        leases below them (ref bulk/loader.go:88 zero-leased uids;
+        zero.go ShouldServe claims).  False keeps the registration
+        loop retrying — a silently missed watermark would let the
+        first post-boot mutation lease uids that collide with bulk
+        entities."""
+        if not self._boot_snapshot:
+            return True
+        try:
+            got = self.zero.request({"op": "bump_maxes", "args": (
+                self.db.coordinator.max_assigned(),
+                self.db.coordinator._next_uid)})
+            if not got.get("ok"):
+                return False
+            for pred in sorted(self.db.tablets):
+                if pred.startswith("dgraph."):
+                    continue
+                got = self.zero.request(
+                    {"op": "tablet", "args": (pred, self.group)})
+                if not got.get("ok"):
+                    return False
+                if got.get("result") != self.group:
+                    log.warning("boot_tablet_conflict", pred=pred,
+                                owner=got.get("result"),
+                                group=self.group)
+            return True
+        except Exception as e:  # noqa: BLE001 — zero unreachable:
+            # retry from the registration loop
+            log.warning("boot_claim_retry", error=str(e))
+            return False
 
     def _report_sizes_loop(self, interval_s: float = 30.0):
         """Leader-only periodic tablet-size reports to zero — the
@@ -1324,7 +1366,7 @@ class ZeroServer(RaftServer):
                                for k, v in self.state.alphas.items()},
                     "tablets": dict(self.state.tablets)}}
         if op in ("assign_ts", "assign_uids", "commit", "txn_status",
-                  "abort_txn", "tablet",
+                  "abort_txn", "tablet", "bump_maxes",
                   "tablet_move_start", "tablet_move_done",
                   "tablet_move_abort", "move_request",
                   "tablet_size", "tablet_sizes",

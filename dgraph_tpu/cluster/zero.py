@@ -127,6 +127,14 @@ class ZeroState:
         if op == "tablet":
             pred, group = args
             return self.tablets.setdefault(pred, int(group))
+        if op == "bump_maxes":
+            # bulk-booted alphas push their snapshot watermarks so
+            # zero never leases a ts/uid below pre-loaded data (ref
+            # bulk/loader.go:88 leasing from zero + zero/assign.go)
+            max_ts, next_uid = args
+            self.max_ts = max(self.max_ts, int(max_ts))
+            self.next_uid = max(self.next_uid, int(next_uid))
+            return {"max_ts": self.max_ts, "next_uid": self.next_uid}
         if op == "tablet_move_start":
             pred, dst = args
             if pred not in self.tablets or \

@@ -811,8 +811,8 @@ class Executor:
             if dev is not None:
                 return dev if candidates is None \
                     else _intersect(candidates, dev)
-        if tab.dirty() or self.read_ts < tab.base_ts \
-                or not hasattr(tab, "sort_key_arrays"):
+        if not hasattr(tab, "sort_key_arrays") or tab.dirty() \
+                or self.read_ts < tab.base_ts:
             pairs = self._sortkeys_for(tab)
             uids = np.fromiter(pairs.keys(), np.uint64, len(pairs))
             keys = np.fromiter(pairs.values(), np.int64, len(pairs))

@@ -751,7 +751,7 @@ class Tablet:
                 # with strictly increasing srcs the gathered uid lists
                 # are therefore already sorted-unique -> `ready`
                 clean = len(src_arr) < 2 \
-                    or bool(np.all(np.diff(src_arr.view(np.int64)) > 0))
+                    or bool(np.all(src_arr[1:] > src_arr[:-1]))
                 for tk, grp in zip(*got):
                     arr = src_arr[grp]
                     if clean and tk not in acc and tk not in ready:
