@@ -167,6 +167,15 @@ def cmd_node(args) -> int:
     """A Raft replica process: alpha (replicated GraphDB group member)
     or zero (replicated coordinator quorum member). Ref: dgraph alpha
     --raft / dgraph zero (worker/draft.go, dgraph/cmd/zero/zero.go)."""
+    if getattr(args, "skew_s", 0.0):
+        # skew-clock nemesis: wall-clock reads in THIS process (TTL
+        # reconciliation, stage ages, logs) are offset; raft ticks use
+        # time.monotonic and are untouched
+        import time as _time
+        _real_time = _time.time
+        _off = args.skew_s
+        _time.time = lambda: _real_time() + _off
+
     from dgraph_tpu.cluster.service import AlphaServer, ZeroServer
 
     peers = _parse_peers(args.raft_peers)
@@ -829,6 +838,13 @@ def main(argv=None) -> int:
                    help="zero quorum client addrs (id=host:port,...) — "
                         "enables multi-group mode: tablet ownership "
                         "checks + zero-leased uid blocks")
+    n.add_argument("--skew-s", type=float, default=0.0,
+                   help="TEST NEMESIS: offset this process's wall "
+                        "clock by SKEW seconds (time.time only) — the "
+                        "Jepsen skew-clock nemesis (ref contrib/"
+                        "jepsen/main.go:31-43); correctness must not "
+                        "depend on wall clocks (the ts oracle is "
+                        "zero-issued and logical)")
     n.add_argument("--snapshot", default="",
                    help="boot the group's engine from a bulk output "
                         "snapshot (out/g<k>/p.snap); every replica of "

@@ -436,3 +436,148 @@ def test_zero_leader_killed_mid_move_completes_on_new_leader():
                 p.kill()
         for p in procs.values():
             p.wait()
+
+
+def test_bank_split_across_groups_survives_clock_skew():
+    """Skew-clock nemesis (ref contrib/jepsen/main.go:31-43): the two
+    bank groups and zero run with wall clocks pulled ±5s apart while
+    cross-group transfers flow. The commit oracle orders by
+    zero-issued LOGICAL timestamps, so the conserved-total invariant
+    at pinned snapshots must be completely indifferent to wall-clock
+    offsets (what skew actually stresses: TTL-based stage
+    reconciliation and decision-registry ages)."""
+    ports = _free_ports(10)
+    procs = {}
+    clients = []
+
+    def _spawn_skew(kind, node_id, peers_spec, client_addr, group=1,
+                    zero="", skew=0.0):
+        cmd = [sys.executable, "-m", "dgraph_tpu", "node",
+               "--kind", kind, "--id", str(node_id),
+               "--raft-peers", peers_spec,
+               "--client-addr", client_addr, "--group", str(group),
+               "--tick-ms", "30", "--election-ticks", "8",
+               "--skew-s", str(skew)]
+        if zero:
+            cmd += ["--zero", zero]
+        return subprocess.Popen(
+            cmd, env=dict(os.environ, JAX_PLATFORMS="cpu",
+                          PYTHONPATH=_REPO),
+            cwd=_REPO, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+    try:
+        zero_spec = f"1=127.0.0.1:{ports[1]}"
+        procs["z1"] = _spawn_skew("zero", 1, f"1=127.0.0.1:{ports[0]}",
+                                  f"127.0.0.1:{ports[1]}", skew=-5.0)
+        g1_peers = f"1=127.0.0.1:{ports[2]}"
+        procs["a1"] = _spawn_skew("alpha", 1, g1_peers,
+                                  f"127.0.0.1:{ports[3]}", 1,
+                                  zero_spec, skew=+5.0)
+        g2_peers = f"1=127.0.0.1:{ports[4]}"
+        procs["b1"] = _spawn_skew("alpha", 1, g2_peers,
+                                  f"127.0.0.1:{ports[5]}", 2,
+                                  zero_spec, skew=-5.0)
+
+        zc = ClusterClient({1: ("127.0.0.1", ports[1])}, timeout=30.0)
+        g1 = ClusterClient({1: ("127.0.0.1", ports[3])}, timeout=30.0)
+        g2 = ClusterClient({1: ("127.0.0.1", ports[5])}, timeout=30.0)
+        clients += [zc, g1, g2]
+        rc = RoutedCluster(zc, {1: g1, 2: g2})
+        for cl in (zc, g1, g2):
+            _wait_role(cl)
+
+        rc.alter("skl: int .\nskr: int .")
+        zc.tablet("skl", 1)
+        zc.tablet("skr", 2)
+        uids = []
+        for i in range(N_ACCOUNTS):
+            out = g1.mutate(set_nquads=f'_:a <skl> "{OPENING}" .')
+            u = list(out["uids"].values())[0]
+            g2.mutate(set_nquads=f'<{u}> <skr> "{OPENING}" .')
+            uids.append(u)
+        grand_total = N_ACCOUNTS * OPENING * 2
+
+        stop = threading.Event()
+        errors: list[str] = []
+        transfers = {"n": 0}
+
+        def read_bal(cl, uid, pred, ts):
+            got = cl._unwrap(cl.request(
+                {"op": "query", "read_ts": ts,
+                 "q": '{ q(func: uid(%s)) { %s } }' % (uid, pred)}))
+            rows = got["data"]["q"]
+            return rows[0][pred] if rows else None
+
+        def transfer_loop(seed):
+            import random
+            rng = random.Random(seed)
+            while not stop.is_set():
+                a, b = rng.sample(uids, 2)
+                amt = rng.randrange(1, 10)
+                try:
+                    start_ts = zc.assign_ts(1)
+                    x = read_bal(g1, a, "skl", start_ts)
+                    y = read_bal(g2, b, "skr", start_ts)
+                    if x is None or y is None:
+                        continue
+                    rc.mutate(start_ts=start_ts,
+                              set_nquads=(f'<{a}> <skl> "{x - amt}" .\n'
+                                          f'<{b}> <skr> "{y + amt}" .'))
+                    transfers["n"] += 1
+                except RuntimeError:
+                    pass
+
+        def reader_loop():
+            while not stop.is_set():
+                try:
+                    ts = zc.assign_ts(1)
+                    got_l = g1._unwrap(g1.request(
+                        {"op": "query", "read_ts": ts,
+                         "q": '{ q(func: has(skl)) { skl } }'}))
+                    got_r = g2._unwrap(g2.request(
+                        {"op": "query", "read_ts": ts,
+                         "q": '{ q(func: has(skr)) { skr } }'}))
+                    rl = got_l["data"]["q"]
+                    rr = got_r["data"]["q"]
+                    if len(rl) == N_ACCOUNTS and len(rr) == N_ACCOUNTS:
+                        total = sum(r["skl"] for r in rl) + \
+                            sum(r["skr"] for r in rr)
+                        if total != grand_total:
+                            errors.append(
+                                f"invariant broken at ts {ts}: {total}")
+                            return
+                except RuntimeError:
+                    pass
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=transfer_loop, args=(s,),
+                                    daemon=True) for s in (21, 22)]
+        threads.append(threading.Thread(target=reader_loop, daemon=True))
+        for t in threads:
+            t.start()
+        time.sleep(4.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        assert not errors, errors
+        assert transfers["n"] > 10, "workload starved under skew"
+        ts = zc.assign_ts(1)
+        got_l = g1._unwrap(g1.request(
+            {"op": "query", "read_ts": ts,
+             "q": '{ q(func: has(skl)) { skl } }'}))
+        got_r = g2._unwrap(g2.request(
+            {"op": "query", "read_ts": ts,
+             "q": '{ q(func: has(skr)) { skr } }'}))
+        total = sum(r["skl"] for r in got_l["data"]["q"]) + \
+            sum(r["skr"] for r in got_r["data"]["q"])
+        assert total == grand_total
+    finally:
+        for cl in clients:
+            cl.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs.values():
+            p.wait()
