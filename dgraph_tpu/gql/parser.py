@@ -75,7 +75,39 @@ def parse(text: str, variables: dict | None = None) -> ParsedResult:
     for q in res.queries:
         _expand_fragments(q, fragments, set())
         _collect_needs(q, res)
+    _check_duplicates(res)
     return res
+
+
+def _check_duplicates(res: ParsedResult):
+    """Reject duplicate emitting-block aliases and vars defined more
+    than once (ref gql/parser.go validate: 'Duplicate aliases not
+    allowed' + 'Variable ... defined multiple times') — accepting them
+    silently drops or shadows one block's results."""
+    names: set[str] = set()
+    seen_vars: set[str] = set()
+
+    def walk(gq):
+        if gq.var:
+            if gq.var in seen_vars:
+                raise GQLError(
+                    f"variable {gq.var!r} is defined multiple times")
+            seen_vars.add(gq.var)
+        for v in (gq.facet_var or {}).values():
+            if v in seen_vars:
+                raise GQLError(
+                    f"variable {v!r} is defined multiple times")
+            seen_vars.add(v)
+        for c in gq.children:
+            walk(c)
+
+    for q in res.queries:
+        nm = q.alias or q.attr
+        if nm and nm not in ("var", "shortest"):
+            if nm in names:
+                raise GQLError(f"duplicate query alias {nm!r}")
+            names.add(nm)
+        walk(q)
 
 
 def _resolve_vars(decl: dict, provided: dict | None) -> dict[str, str]:

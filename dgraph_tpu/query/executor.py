@@ -3124,10 +3124,18 @@ def _eval_math(tree, value_vars) -> "dict[int, Val] | ColVar":
                                  TypeID.DATETIME)}
         args = [eval_node(c) for c in t.children]
         uids = set()
+        has_map = False
         for a in args:
             if isinstance(a, dict):
                 uids |= set(a)
-        if not uids:  # all-constant expression
+                has_map = True
+        if not uids:
+            if has_map:
+                # a var over an EMPTY block is an empty map, not a
+                # constant: the expression has no per-uid rows (the
+                # constant-fold below would multiply a dict)
+                return {}
+            # all-constant expression
             vals = [a for a in args]
             return _apply_math(t.fn, vals, _m)
         out = {}
