@@ -159,19 +159,52 @@ def lookup_idx(table: jax.Array, q: jax.Array) -> jax.Array:
     return jnp.searchsorted(table, q)
 
 
+def _cosort_hits(a: jax.Array, b: jax.Array):
+    """One stable key-sort of concat(a, b) with an origin flag, plus
+    the adjacency hit mask for a-rows (a[i] present in b).  The
+    building block of the FUSED set ops below: because the co-sorted
+    values are already ascending, masking + one single-operand sort
+    re-establishes the padded invariant — no order-restore sort and
+    no separate compact() (the three-sort pipeline this replaces
+    measured ~1.3 GB/s; two sorts with fewer payloads roughly halve
+    the HBM traffic per element)."""
+    n = a.shape[0]
+    c = jnp.concatenate([a, b])
+    flag = jnp.concatenate([
+        jnp.ones(n, jnp.uint32),
+        jnp.zeros(b.shape[0], jnp.uint32)])
+    cs, fs = jax.lax.sort((c, flag), dimension=0, num_keys=1)
+    pad = jnp.full((1,), SENTINEL, dtype=cs.dtype)
+    one = jnp.ones((1,), jnp.uint32)
+    nxt = jnp.concatenate([cs[1:], pad])
+    prv = jnp.concatenate([pad, cs[:-1]])
+    fnx = jnp.concatenate([fs[1:], one])
+    fpv = jnp.concatenate([one, fs[:-1]])
+    hit = (((nxt == cs) & (fnx == 0)) | ((prv == cs) & (fpv == 0))) \
+        & (fs == 1) & (cs != SENTINEL)
+    return cs, fs, hit
+
+
 def intersect(a: jax.Array, b: jax.Array) -> jax.Array:
     """Sorted-set intersection. Ref algo.IntersectWith (algo/uidlist.go:137).
 
-    Result has a's static length.
+    Result has a's static length.  Always the fused co-sort — a
+    binary-search probe of the larger side (the reference's bin pick,
+    algo/uidlist.go:151) was measured 7x SLOWER here: XLA's
+    searchsorted lowers to a sequential scan on TPU at these query
+    sizes (0.09 GB/s vs 0.64 co-sort on the ratio=8 config).
     """
-    keep = member_mask(a, b)
-    return compact(jnp.where(keep, a, SENTINEL))
+    cs, _fs, hit = _cosort_hits(a, b)
+    vals = jnp.where(hit, cs, SENTINEL)
+    return jnp.sort(vals)[: a.shape[0]]
 
 
 def difference(a: jax.Array, b: jax.Array) -> jax.Array:
     """a \\ b. Ref algo.Difference (algo/uidlist.go:322)."""
-    drop = member_mask(a, b)
-    return compact(jnp.where(drop, SENTINEL, a))
+    cs, fs, hit = _cosort_hits(a, b)
+    keep = (fs == 1) & ~hit & (cs != SENTINEL)
+    vals = jnp.where(keep, cs, SENTINEL)
+    return jnp.sort(vals)[: a.shape[0]]
 
 
 def union(a: jax.Array, b: jax.Array) -> jax.Array:
