@@ -1,0 +1,9 @@
+"""Protobuf client API (the reference's primary protocol is gRPC with
+protobuf messages — dgraph/cmd/alpha/run.go:362 api.Dgraph).
+
+`api.proto` is the source of truth; `api_pb2.py` is committed
+generated code (protoc --python_out=. api.proto) so the runtime needs
+no grpcio-tools. Clients in any language generate from api.proto.
+"""
+
+from dgraph_tpu.proto import api_pb2  # noqa: F401

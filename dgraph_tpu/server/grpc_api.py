@@ -29,21 +29,27 @@ from dgraph_tpu.server.http import AlphaServer
 _SERVICE = "dgraph.tpu.Alpha"
 
 
+def _abort_for(context, e):
+    """One exception -> gRPC status table for BOTH services (status
+    codes as the reference maps them: ABORTED for txn conflicts,
+    PERMISSION_DENIED for ACL, INVALID_ARGUMENT for bad requests)."""
+    if isinstance(e, TxnAborted):
+        context.abort(grpc.StatusCode.ABORTED,
+                      f"Transaction has been aborted. Please retry: {e}")
+    if isinstance(e, AclError):
+        context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
+    if isinstance(e, (ValueError, KeyError)):
+        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+    context.abort(grpc.StatusCode.INTERNAL,
+                  f"{type(e).__name__}: {e}")
+
+
 def _wrap(fn):
     def method(request, context):
         try:
             return fn(request or {})
-        except TxnAborted as e:
-            context.abort(grpc.StatusCode.ABORTED,
-                          f"Transaction has been aborted. "
-                          f"Please retry: {e}")
-        except AclError as e:
-            context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
-        except (ValueError, KeyError) as e:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         except Exception as e:  # noqa: BLE001
-            context.abort(grpc.StatusCode.INTERNAL,
-                          f"{type(e).__name__}: {e}")
+            _abort_for(context, e)
 
     return method
 
@@ -81,6 +87,158 @@ def _handlers(alpha: AlphaServer) -> dict:
             "CheckVersion": check_version}
 
 
+_PB_SERVICE = "dgraph_tpu.api.Dgraph"
+
+
+def _pb_wrap(fn):
+    def method(request, context):
+        try:
+            return fn(request, context)
+        except Exception as e:  # noqa: BLE001
+            _abort_for(context, e)
+
+    return method
+
+
+def _strip_dollar(vars_map) -> dict:
+    """Clients send GraphQL vars keyed "$n" (the dgo convention);
+    the engine's variable table is keyed bare."""
+    return {(k[1:] if k.startswith("$") else k): v
+            for k, v in dict(vars_map).items()}
+
+
+def _pb_handlers(alpha: AlphaServer) -> dict:
+    """The protobuf api.Dgraph service (proto/api.proto) — same
+    transport-independent AlphaServer handlers as HTTP and the
+    wire-dict service, protobuf messages on the wire so clients in
+    any language generate from the .proto (ref alpha/run.go:362
+    registering api.Dgraph; edgraph/server.go:634 doQuery)."""
+    import json
+
+    from dgraph_tpu.proto import api_pb2 as pb
+
+    def token_of(req, context):
+        tok = getattr(req, "access_jwt", "")
+        if tok:
+            return tok
+        md = dict(context.invocation_metadata() or ())
+        return md.get("accessjwt", "")
+
+    def _latency(ext: dict) -> "pb.Latency":
+        lat = ext.get("latency") or {}
+        return pb.Latency(
+            parsing_ns=int(lat.get("parsing_ns", 0)),
+            processing_ns=int(lat.get("processing_ns", 0)),
+            encoding_ns=int(lat.get("encoding_ns", 0)),
+            assign_timestamp_ns=int(lat.get("assign_timestamp_ns", 0)))
+
+    def _txn_ctx(ext: dict) -> "pb.TxnContext":
+        txn = ext.get("txn") or {}
+        return pb.TxnContext(
+            start_ts=int(txn.get("start_ts", 0)),
+            commit_ts=int(txn.get("commit_ts", 0)),
+            aborted=bool(txn.get("aborted", False)),
+            preds=[str(p) for p in txn.get("preds", ())])
+
+    def login(req, context):
+        out = alpha.handle_login({
+            "userid": req.userid, "password": req.password,
+            "refresh_token": req.refresh_token})
+        data = out.get("data", {})
+        return pb.Response(
+            access_jwt=data.get("accessJwt", "")
+            or data.get("accessJWT", ""),
+            refresh_jwt=data.get("refreshJwt", "")
+            or data.get("refreshJWT", ""))
+
+    def query(req, context):
+        token = token_of(req, context)
+        params = {}
+        if req.start_ts:
+            params["startTs"] = str(req.start_ts)
+        if req.best_effort:
+            params["be"] = "true"
+        if req.read_only:
+            params["ro"] = "true"
+        if req.mutations:
+            # mutation / upsert request (the reference's do-request
+            # path: mutations ride in the same Request as the query)
+            if len(req.mutations) > 1:
+                raise ValueError(
+                    "one Mutation per Request on this surface")
+            m = req.mutations[0]
+            env: dict = {}
+            if m.set_json:
+                env["set"] = json.loads(m.set_json.decode())
+            if m.delete_json:
+                env["delete"] = json.loads(m.delete_json.decode())
+            if m.set_nquads:
+                env["setNquads"] = m.set_nquads.decode()
+            if m.del_nquads:
+                env["delNquads"] = m.del_nquads.decode()
+            if m.cond:
+                env["cond"] = m.cond
+            if req.query:
+                env["query"] = req.query
+                if req.vars:
+                    env["variables"] = _strip_dollar(req.vars)
+            params["commitNow"] = "true" if req.commit_now else "false"
+            out = alpha.handle_mutate(
+                json.dumps(env).encode(), "application/json",
+                params, token)
+            ext = out.get("extensions", {})
+            data = out.get("data", out)
+            return pb.Response(
+                json=json.dumps(data.get("queries", {}),
+                                separators=(",", ":")).encode(),
+                txn=_txn_ctx(ext), latency=_latency(ext),
+                uids={k: str(v)
+                      for k, v in (data.get("uids") or
+                                   out.get("uids") or {}).items()})
+        payload = {"query": req.query,
+                   "variables": _strip_dollar(req.vars)} \
+            if req.vars else req.query
+        out = alpha.handle_query(payload, params, token)
+        ext = out.get("extensions", {})
+        return pb.Response(
+            json=json.dumps(out.get("data", {}),
+                            separators=(",", ":")).encode(),
+            txn=_txn_ctx(ext), latency=_latency(ext))
+
+    def alter(req, context):
+        token = token_of(req, context)
+        if req.drop_all:
+            body = json.dumps({"drop_all": True}).encode()
+        elif req.drop_attr:
+            body = json.dumps({"drop_attr": req.drop_attr}).encode()
+        elif req.drop_value:
+            raise ValueError(
+                "drop_value is not supported by this server; use "
+                "drop_attr or drop_all")
+        else:
+            body = req.schema.encode()
+        alpha.handle_alter(body, token)
+        return pb.Payload(data=b"Success")
+
+    def commit_or_abort(req, context):
+        token = token_of(req, context)
+        abort = req.aborted or not req.commit
+        out = alpha.handle_commit(
+            {"startTs": str(req.start_ts),
+             "abort": "true" if abort else "false"}, token)
+        return _txn_ctx(out.get("extensions", {}))
+
+    def check_version(req, context):
+        from dgraph_tpu.cli import __version__
+        return pb.Version(tag=f"dgraph-tpu-{__version__}")
+
+    return {"Login": (login, pb.LoginRequest),
+            "Query": (query, pb.Request),
+            "Alter": (alter, pb.Operation),
+            "CommitOrAbort": (commit_or_abort, pb.TxnContext),
+            "CheckVersion": (check_version, pb.Check)}
+
+
 def serve_grpc(alpha: AlphaServer, host: str = "127.0.0.1",
                port: int = 9080, max_workers: int = 16,
                tls_dir: str = "", require_client_cert: bool = False
@@ -101,6 +259,18 @@ def serve_grpc(alpha: AlphaServer, host: str = "127.0.0.1",
     }
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(_SERVICE, rpcs),))
+    # the protobuf api.Dgraph service on the SAME listener: serialized
+    # with the committed generated messages (proto/api.proto), so
+    # generated clients in any language interoperate
+    pb_rpcs = {
+        name: grpc.unary_unary_rpc_method_handler(
+            _pb_wrap(fn),
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda m: m.SerializeToString())
+        for name, (fn, req_cls) in _pb_handlers(alpha).items()
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_PB_SERVICE, pb_rpcs),))
     addr = f"{host}:{port}"
     if tls_dir:
         with open(os.path.join(tls_dir, "node.key"), "rb") as f:

@@ -150,21 +150,30 @@ class AlphaServer:
                     token, query_predicates(gql_parse(q, variables)),
                     claims=claims)
         ro_txn = None
+        pin_ts = None
         start_ts = int(params.get("startTs", 0))
         with self.meta:
             if start_ts:
                 self._check_txn_owner(start_ts, claims)
                 ro_txn = self.txns.get(start_ts)
+                if ro_txn is None:
+                    # read-only snapshot at an explicit ts: no open txn
+                    # exists for pure reads, so pin the MVCC read
+                    # point directly — startTs=T must mean "read at T"
+                    # (ref edgraph/server.go attaching ReadTs), not
+                    # "allocate something newer"
+                    pin_ts = start_ts
         be = params.get("be", "false") == "true"
-        return q, variables, ro_txn, (be if ro_txn is None else False)
+        return q, variables, ro_txn, \
+            (be if ro_txn is None else False), pin_ts
 
     def handle_query(self, body: dict | str, params: dict,
                      token: str = "") -> dict:
-        q, variables, ro_txn, be = self._query_prologue(
+        q, variables, ro_txn, be, pin_ts = self._query_prologue(
             body, params, token)
         with self.rw.read:
             return self.db.query(q, variables, txn=ro_txn,
-                                 best_effort=be)
+                                 best_effort=be, read_ts=pin_ts)
 
     def handle_query_json(self, body: dict | str, params: dict,
                           token: str = "") -> str:
@@ -173,11 +182,11 @@ class AlphaServer:
         the HTTP layer never re-serializes what the engine already
         encoded (ref query/outputnode.go fastJsonNode feeding the
         response writer directly)."""
-        q, variables, ro_txn, be = self._query_prologue(
+        q, variables, ro_txn, be, pin_ts = self._query_prologue(
             body, params, token)
         with self.rw.read:
             return self.db.query_json(q, variables, txn=ro_txn,
-                                      best_effort=be)
+                                      best_effort=be, read_ts=pin_ts)
 
     def handle_mutate(self, body: bytes, content_type: str,
                       params: dict, token: str = "") -> dict:
