@@ -1072,11 +1072,25 @@ class Executor:
                 # short to produce a trigram keep the full scan.
                 toks = tokens_for(Val(TypeID.STRING, want), spec)
                 if toks:
-                    cand = _EMPTY
-                    for t in toks:
-                        cand = _union(cand, tab.index_uids(
-                            token_bytes(spec.ident, t), self.read_ts))
-                    scan = cand
+                    # q-gram COUNT filter: a value within edit
+                    # distance d of the term must share at least
+                    # T - 3d of its T distinct trigrams (each edit
+                    # destroys <= 3 windows) — at 21M this prunes the
+                    # "shares any trigram" union from ~2M candidates
+                    # to thousands. One concat + unique-with-counts
+                    # also replaces T incremental unions.
+                    buckets = [tab.index_uids(
+                        token_bytes(spec.ident, t), self.read_ts)
+                        for t in toks]
+                    buckets = [b for b in buckets if len(b)]
+                    if buckets:
+                        uids, counts = np.unique(
+                            np.concatenate(buckets),
+                            return_counts=True)
+                        need = max(1, len(toks) - 3 * maxd)
+                        scan = uids[counts >= need]
+                    else:
+                        scan = _EMPTY
         if scan is None:
             scan = tab.src_uids(self.read_ts)
         batched = self._match_batch(tab, scan, want, maxd)

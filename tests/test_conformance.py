@@ -380,6 +380,28 @@ def test_uid_in_filter(db):
     assert [x["uid"] for x in r["q"]] == ["0x1", "0x2"]
 
 
+def test_match_count_filter_keeps_distance_boundary(db):
+    """The q-gram count filter (|shared trigrams| >= T - 3d) must
+    never drop a value at EXACTLY the max distance — adversarial
+    spread-out edits destroy the most trigram types."""
+    d2 = GraphDB(prefer_device=False)
+    d2.alter("mname: string @index(trigram) .")
+    base = "abcdefghijklmno"
+    # three spread substitutions: distance exactly 3, each edit kills
+    # 3 distinct trigram windows of the base term
+    edited = "abcXefgYijkZmno"
+    d2.mutate(set_nquads=f'<0x1> <mname> "{base}" .\n'
+                         f'<0x2> <mname> "{edited}" .\n'
+                         f'<0x3> <mname> "totally different" .')
+    r = d2.query('{ q(func: match(mname, "%s", 3), orderasc: uid) '
+                 '{ mname } }' % base)["data"]["q"]
+    assert [x["mname"] for x in r] == [base, edited]
+    # distance 2 budget must exclude the 3-edit value
+    r2 = d2.query('{ q(func: match(mname, "%s", 2)) { mname } }'
+                  % base)["data"]["q"]
+    assert [x["mname"] for x in r2] == [base]
+
+
 def test_expand_all_lists_scalars(db):
     r = q(db, '{ q(func: uid(0x3)) { expand(_all_) } }')
     row = r["q"][0]
