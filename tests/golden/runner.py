@@ -31,7 +31,11 @@ def get_db():
             from .dataset import generate
 
             schema, quads = generate()
-            db = GraphDB()
+            # device_min_edges=1 forces the device tier past the
+            # dispatch cost gate: this suite's job is exercising the
+            # device kernels at golden scale, where the gate would
+            # (correctly) route everything to the host
+            db = GraphDB(device_min_edges=1)
             db.alter(schema_text=schema)
             db.mutate(set_nquads="\n".join(quads))
             _db = db
