@@ -284,6 +284,9 @@ class ExecNode:
     recurse_preds: list[list] = field(default_factory=list)
     path_nodes: list[list[int]] = field(default_factory=list)  # shortest
     path_weights: list[float] = field(default_factory=list)
+    # columnar emission fast path: uid -> ready json value for flat
+    # scalar children (populated instead of `values` when eligible)
+    col_vals: Optional[dict] = None
 
 
 class Executor:
@@ -1180,10 +1183,32 @@ class Executor:
         targets = set(fn.uids)
         for vc in fn.needs_var:
             targets.update(self.uid_vars.get(vc.name, _EMPTY).tolist())
-        getter = tab.get_reverse_uids if rev else tab.get_dst_uids
+        # Flip the iteration: expand from the (few) TARGETS and
+        # intersect with the candidate set instead of walking every
+        # candidate's edge list — uid_in over 960k candidates at 21M
+        # was ~0.8s of per-uid python. uid_in(~p, X) keeps uids X
+        # points at via p (= dst(X)); uid_in(p, X) keeps uids pointing
+        # AT some X (= reverse(X), when @reverse exists).
+        flip = rev or tab.schema.reverse
+        if flip and candidates is not None \
+                and len(targets) > len(candidates):
+            flip = False  # per-candidate walk is the cheaper direction
+        if flip:
+            expand = tab.get_dst_uids if rev else tab.get_reverse_uids
+            parts = [expand(int(t), self.read_ts) for t in targets]
+            parts = [p for p in parts if len(p)]
+            if not parts:
+                return _EMPTY
+            valid = np.unique(np.concatenate(parts))
+            # valid uids have a live edge by construction, so with no
+            # candidate set they ARE the answer — don't materialize
+            # the whole src/dst table just to intersect with a subset
+            return valid if candidates is None \
+                else _intersect(candidates, valid)
         scan = candidates if candidates is not None else (
             tab.dst_uids(self.read_ts) if rev
             else tab.src_uids(self.read_ts))
+        getter = tab.get_reverse_uids if rev else tab.get_dst_uids
         keep = [u for u in scan.tolist()
                 if targets & set(getter(u, self.read_ts).tolist())]
         return np.asarray(keep, dtype=np.uint64)
@@ -1522,6 +1547,13 @@ class Executor:
             # loop dominates var-heavy aggregation queries (q020)
             if self._bind_var_columnar(node, gq, tab, src):
                 return node
+            cv = self._colvals_for_emit(tab, gq, src)
+            if cv is not None:
+                # columnar emission: json-ready values gathered in one
+                # pass — the per-uid get_postings walk below was the
+                # bulk of flat-block emission at 21M (q003)
+                node.col_vals = cv
+                return node
             if hasattr(tab, "prefetch_postings"):
                 tab.prefetch_postings(src)
             for u in src.tolist():
@@ -1547,6 +1579,37 @@ class Executor:
                             vmap[u] = sel.facets[key]
                     self.value_vars[varname] = vmap
         return node
+
+    def _colvals_for_emit(self, tab, gq, src: np.ndarray
+                          ) -> Optional[dict]:
+        """uid -> json-ready value for a FLAT scalar child (no langs,
+        lists, facets, counts or var binding), gathered through the
+        cached column view — replaces the per-uid posting walk both at
+        process time and inside _emit_uid/_emit_value.  None keeps the
+        exact path."""
+        if gq.langs or gq.is_count or gq.var or gq.facet_var \
+                or gq.facets is not None or gq.children \
+                or tab.schema.list_:
+            return None
+        colview = tab.value_columns(self.read_ts) \
+            if hasattr(tab, "value_columns") else None
+        if colview is None:
+            return None
+        self._budget_colview(tab, colview)
+        srcs, tid, data, enc = colview
+        pos, hit = _col_positions(srcs, src)
+        sel = pos[hit]
+        uids = src[hit].tolist()
+        if data is not None:
+            if tid == TypeID.BOOL:
+                vals = [bool(v) for v in data[sel].tolist()]
+            else:
+                vals = data[sel].tolist()
+        else:
+            # STRING/DEFAULT/DATETIME columns carry the exact
+            # to_json_value payload (isoformat for datetimes)
+            vals = [enc[j].decode("utf-8") for j in sel.tolist()]
+        return dict(zip(uids, vals))
 
     def _bind_var_columnar(self, node: ExecNode, gq, tab,
                            src: np.ndarray) -> bool:
@@ -2336,9 +2399,15 @@ class Executor:
         out = []
         # count(uid) at block level: one summed object
         # (ref outputnode.go uid count emission)
+        n_counts = 0
         for ch in node.children:
             if ch.gq.attr == "uid" and ch.gq.is_count:
                 out.append({ch.gq.alias or "count": len(node.dest)})
+                n_counts += 1
+        if n_counts and n_counts == len(node.children):
+            # count-only block: the per-uid walk below would emit (and
+            # drop) an empty object per row — 0.5s of the 21M q009
+            return out
         for u in node.dest.tolist():
             # @ignorereflex: track the result path so children never
             # re-emit an ancestor (ref query.go:164 removeCycles)
@@ -2459,6 +2528,14 @@ class Executor:
                 elif gq.cascade or cgq.cascade:
                     return None
             else:
+                if ch.col_vals is not None:
+                    v = ch.col_vals.get(uid)
+                    if v is not None:
+                        obj[name] = v
+                        continue
+                    if gq.cascade or cgq.cascade:
+                        return None
+                    continue
                 ps = ch.values.get(uid)
                 if ps and cgq.langs == ["*"]:
                     # name@* : every language as its own key, the
