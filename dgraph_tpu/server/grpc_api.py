@@ -162,22 +162,26 @@ def _pb_handlers(alpha: AlphaServer) -> dict:
             params["ro"] = "true"
         if req.mutations:
             # mutation / upsert request (the reference's do-request
-            # path: mutations ride in the same Request as the query)
-            if len(req.mutations) > 1:
-                raise ValueError(
-                    "one Mutation per Request on this surface")
-            m = req.mutations[0]
-            env: dict = {}
-            if m.set_json:
-                env["set"] = json.loads(m.set_json.decode())
-            if m.delete_json:
-                env["delete"] = json.loads(m.delete_json.decode())
-            if m.set_nquads:
-                env["setNquads"] = m.set_nquads.decode()
-            if m.del_nquads:
-                env["delNquads"] = m.del_nquads.decode()
-            if m.cond:
-                env["cond"] = m.cond
+            # path: mutations ride in the same Request as the query;
+            # each is independently @if-gated in ONE transaction)
+            def one(m) -> dict:
+                d: dict = {}
+                if m.set_json:
+                    d["set"] = json.loads(m.set_json.decode())
+                if m.delete_json:
+                    d["delete"] = json.loads(m.delete_json.decode())
+                if m.set_nquads:
+                    d["setNquads"] = m.set_nquads.decode()
+                if m.del_nquads:
+                    d["delNquads"] = m.del_nquads.decode()
+                if m.cond:
+                    d["cond"] = m.cond
+                return d
+
+            if len(req.mutations) == 1:
+                env = one(req.mutations[0])
+            else:
+                env = {"mutations": [one(m) for m in req.mutations]}
             if req.query:
                 env["query"] = req.query
                 if req.vars:

@@ -196,15 +196,18 @@ class AlphaServer:
                 "rejected")
         commit_now = params.get("commitNow", "false") == "true"
         start_ts = int(params.get("startTs", 0))
-        mut, query, variables = _parse_mutation_body(body, content_type)
+        muts, query, variables = _parse_mutation_body(body, content_type)
         owner = None
         if self.acl is not None:
             from dgraph_tpu.gql import parse as gql_parse
             from dgraph_tpu.server.acl import (
                 nquad_predicates, query_predicates,
             )
-            preds = nquad_predicates(mut.set_nquads, mut.del_nquads,
-                                     mut.set_json, mut.delete_json)
+            preds = set()
+            for mut in muts:
+                preds |= set(nquad_predicates(
+                    mut.set_nquads, mut.del_nquads,
+                    mut.set_json, mut.delete_json))
             with self.meta:
                 claims = self.acl.authorize(token)
                 owner = claims.get("userid", "")
@@ -233,7 +236,7 @@ class AlphaServer:
                     txn = self.db.new_txn()
                     created = True
             try:
-                out = self.db.mutate(txn, mutations=[mut], query=query,
+                out = self.db.mutate(txn, mutations=muts, query=query,
                                      variables=variables,
                                      commit_now=commit_now)
             except Exception:
@@ -428,27 +431,38 @@ class AlphaServer:
 
 
 def _parse_mutation_body(body: bytes, content_type: str
-                         ) -> tuple[Mutation, str, dict | None]:
+                         ) -> tuple[list[Mutation], str, dict | None]:
     """Body formats (ref http.go:298 mutationHandler):
-    application/rdf: raw N-Quads in {set {...} delete {...}} or plain sets;
-    application/json: {"set": [...], "delete": [...], "query": "...",
-    "cond": "..."} upsert envelope."""
+    application/rdf: raw N-Quads in {set {...} delete {...}} or plain
+    sets; application/json: {"set": [...], "delete": [...],
+    "query": "...", "cond": "..."} upsert envelope, or
+    {"mutations": [ {...}, ... ], "query": "..."} with SEVERAL
+    independently @if-gated mutations in one transaction (the
+    reference's multi-mutation upsert request shape)."""
     if "json" in content_type:
         j = json.loads(body.decode())
-        mut = Mutation(cond=j.get("cond", ""))
-        if "set" in j:
-            mut.set_json = j["set"]
-        if "delete" in j:
-            mut.delete_json = j["delete"]
-        if "setNquads" in j:
-            mut.set_nquads = j["setNquads"]
-        if "delNquads" in j:
-            mut.del_nquads = j["delNquads"]
-        return mut, j.get("query", ""), j.get("variables")
+
+        def one(m: dict) -> Mutation:
+            mut = Mutation(cond=m.get("cond", ""))
+            if "set" in m:
+                mut.set_json = m["set"]
+            if "delete" in m:
+                mut.delete_json = m["delete"]
+            if "setNquads" in m:
+                mut.set_nquads = m["setNquads"]
+            if "delNquads" in m:
+                mut.del_nquads = m["delNquads"]
+            return mut
+
+        if "mutations" in j:
+            muts = [one(m) for m in j["mutations"]]
+        else:
+            muts = [one(j)]
+        return muts, j.get("query", ""), j.get("variables")
     text = body.decode()
     set_part, del_part, query, cond = _split_rdf_blocks(text)
-    return Mutation(set_nquads=set_part, del_nquads=del_part, cond=cond), \
-        query, None
+    return [Mutation(set_nquads=set_part, del_nquads=del_part,
+                     cond=cond)], query, None
 
 
 def _split_rdf_blocks(text: str) -> tuple[str, str, str, str]:

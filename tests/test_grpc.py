@@ -269,3 +269,26 @@ def test_pb_pinned_readonly_snapshot(pbc):
     fresh = pbc.stubs["Query"](pb.Request(
         query='{ q(func: eq(pname, "pb-snap")) { pbal } }'))
     assert json.loads(fresh.json) == {"q": [{"pbal": 77}]}
+
+
+def test_pb_multi_mutation_upsert(pbc):
+    """Several independently @if-gated mutations in ONE Request/txn
+    (the reference's multi-mutation upsert shape)."""
+    import json
+    pb = pbc.pb
+    pbc.stubs["Query"](pb.Request(
+        mutations=[pb.Mutation(set_nquads=b'_:m <pname> "pb-multi" .')],
+        commit_now=True))
+    pbc.stubs["Query"](pb.Request(
+        query='{ u as var(func: eq(pname, "pb-multi")) '
+              '  g as var(func: eq(pname, "pb-ghost")) }',
+        mutations=[
+            pb.Mutation(set_nquads=b'uid(u) <pbal> "1" .',
+                        cond="@if(gt(len(u), 0))"),
+            pb.Mutation(set_nquads=b'uid(u) <pbal> "2" .',
+                        cond="@if(gt(len(g), 0))"),  # ghost: skipped
+        ],
+        commit_now=True))
+    got = pbc.stubs["Query"](pb.Request(
+        query='{ q(func: eq(pname, "pb-multi")) { pbal } }'))
+    assert json.loads(got.json) == {"q": [{"pbal": 1}]}
