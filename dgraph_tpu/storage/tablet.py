@@ -820,6 +820,11 @@ class Tablet:
         pairs = self.sort_key_pairs(lang)
         uids = np.fromiter(pairs.keys(), np.uint64, len(pairs))
         keys = np.fromiter(pairs.values(), np.int64, len(pairs))
+        # uid-ASCENDING is part of the contract: consumers gather by
+        # np.searchsorted (the values dict iterates in insertion
+        # order, which mutation-built tablets do NOT keep sorted)
+        order = np.argsort(uids, kind="stable")
+        uids, keys = uids[order], keys[order]
         self._sk_arrays = (tag, uids, keys)
         return uids, keys
 

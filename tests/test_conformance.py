@@ -406,3 +406,19 @@ def test_expand_all_lists_scalars(db):
     r = q(db, '{ q(func: uid(0x3)) { expand(_all_) } }')
     row = r["q"][0]
     assert row.get("name") == "Gamma" and row.get("age") == 40
+
+
+def test_order_by_any_language_tag(db):
+    """orderasc: name@. resolves "any language" per uid — the
+    columnar order-key fast path must not exact-match the '.' tag
+    (review finding: all uids went key-missing and kept candidate
+    order)."""
+    d2 = GraphDB(prefer_device=False)
+    d2.alter("lname: string @lang .")
+    d2.mutate(set_nquads='<0x1> <lname> "zz"@fr .\n'
+                         '<0x2> <lname> "aa"@de .\n'
+                         '<0x3> <lname> "mm"@it .')
+    d2.rollup_all()  # clean tablet = fast-path eligible
+    r = d2.query('{ q(func: has(lname), orderasc: lname@.) '
+                 '{ lname@. } }')["data"]["q"]
+    assert [x["lname@."] for x in r] == ["aa", "mm", "zz"], r
