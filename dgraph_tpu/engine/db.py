@@ -720,12 +720,49 @@ class GraphDB:
         with _span("encode") as sp:
             t0 = time.perf_counter_ns()
             data = ex.emit(done)
+            if ex.parsed is not None \
+                    and ex.parsed.schema_request is not None:
+                data["schema"] = self._schema_rows(
+                    ex.parsed.schema_request)
             lat.encoding_ns = time.perf_counter_ns() - t0
             sp["encode_us"] = lat.encoding_ns // 1000
         self._query_metrics(lat)
         return {"data": data,
                 "extensions": {"latency": lat.as_dict(),
                                "txn": {"start_ts": read_ts}}}
+
+    def _schema_rows(self, req: dict) -> list[dict]:
+        """`schema {}` introspection rows, the reference's response
+        shape: one object per predicate with falsy fields omitted and
+        an optional field selection (ref query schema nodes)."""
+        from dgraph_tpu.models.types import type_name
+        want = set(req.get("preds") or ())
+        fields = set(req.get("fields") or ())
+        rows = []
+        for pred in sorted(self.schema.predicates()):
+            if want and pred not in want:
+                continue
+            ps = self.schema.get_or_default(pred)
+            row: dict = {"predicate": pred,
+                         "type": type_name(ps.value_type)}
+            if ps.indexed:
+                row["index"] = True
+                row["tokenizer"] = list(ps.tokenizers)
+            if ps.reverse:
+                row["reverse"] = True
+            if ps.count:
+                row["count"] = True
+            if ps.list_:
+                row["list"] = True
+            if ps.upsert:
+                row["upsert"] = True
+            if ps.lang:
+                row["lang"] = True
+            if fields:
+                row = {k: v for k, v in row.items()
+                       if k == "predicate" or k in fields}
+            rows.append(row)
+        return rows
 
     def _query_run(self, q, variables, txn, best_effort, read_ts):
         """Shared query front half: parse, read-ts resolution,
@@ -783,6 +820,14 @@ class GraphDB:
         with _span("encode") as sp:
             t0 = time.perf_counter_ns()
             data_json = ex.emit_json(done)
+            if ex.parsed is not None \
+                    and ex.parsed.schema_request is not None:
+                rows = _json.dumps(
+                    self._schema_rows(ex.parsed.schema_request),
+                    separators=(",", ":"))
+                data_json = ('{"schema":' + rows + "}"
+                             if data_json == "{}" else
+                             data_json[:-1] + ',"schema":' + rows + "}")
             lat.encoding_ns = time.perf_counter_ns() - t0
             sp["encode_us"] = lat.encoding_ns // 1000
         self._query_metrics(lat)

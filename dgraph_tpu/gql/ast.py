@@ -153,3 +153,8 @@ class ParsedResult:
 
     queries: list[GraphQuery] = field(default_factory=list)
     query_vars: list[str] = field(default_factory=list)
+    # `schema {}` / `schema(pred: [..]) { fields }` introspection block
+    # (ref gql.Parse handling of itemLeftCurl+schema, parser.go:524 →
+    # Result.Schema): None = not requested; {"preds": [...], "fields":
+    # [...]} with empty lists meaning "all"
+    schema_request: Optional[dict] = None

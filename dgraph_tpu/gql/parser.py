@@ -68,6 +68,11 @@ def parse(text: str, variables: dict | None = None) -> ParsedResult:
             fragments[name] = frag
         elif t.kind == "lbrace":
             _parse_block_set(cur, res, _resolve_vars(vars_decl, variables))
+        elif t.kind == "name" and t.val == "schema":
+            # bare `schema {}` / `schema(pred: [..]) { fields }` at the
+            # document top level (ref gql parser's schema handling)
+            cur.next()
+            _parse_schema_block(cur, res)
         else:
             raise GQLError(
                 f"line {t.line}: unexpected {t.val!r} at document top level")
@@ -146,7 +151,48 @@ def _parse_var_decls(cur: Cursor) -> dict[str, str | None]:
 def _parse_block_set(cur: Cursor, res: ParsedResult, gvars: dict):
     cur.expect("lbrace")
     while not cur.accept("rbrace"):
+        t = cur.peek()
+        if t.kind == "name" and t.val == "schema":
+            cur.next()
+            _parse_schema_block(cur, res)
+            continue
         res.queries.append(_parse_block(cur, gvars))
+
+
+def _parse_schema_block(cur: Cursor, res: ParsedResult):
+    """`schema {}` / `schema(pred: [name, age]) { type index tokenizer }`
+    — schema introspection through the query language (ref gql
+    schema-block parsing; query response carries a "schema" array)."""
+    preds: list[str] = []
+    fields: list[str] = []
+    if cur.accept("lparen"):
+        key = cur.expect("name", "schema arg").val
+        if key != "pred":
+            raise GQLError(f"schema block: unknown argument {key!r}")
+        cur.expect("colon")
+        if cur.accept("lbracket"):
+            while not cur.accept("rbracket"):
+                tok = cur.next()
+                if tok.kind not in ("name", "string"):
+                    raise GQLError(
+                        f"line {tok.line}: schema pred list expects "
+                        f"predicate names, got {tok.val!r}")
+                preds.append(tok.val.strip('"'))
+                cur.accept("comma")
+        else:
+            tok = cur.next()
+            if tok.kind not in ("name", "string"):
+                raise GQLError(
+                    f"line {tok.line}: schema pred expects a "
+                    f"predicate name, got {tok.val!r}")
+            preds.append(tok.val.strip('"'))
+        cur.expect("rparen")
+    cur.expect("lbrace")
+    while not cur.accept("rbrace"):
+        fields.append(cur.expect("name", "schema field").val)
+    if res.schema_request is not None:
+        raise GQLError("only one schema block per query")
+    res.schema_request = {"preds": preds, "fields": fields}
 
 
 def _parse_block(cur: Cursor, gvars: dict) -> GraphQuery:

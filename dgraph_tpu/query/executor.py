@@ -293,6 +293,7 @@ class Executor:
     def __init__(self, db, read_ts: int):
         self.db = db
         self.read_ts = read_ts
+        self.parsed: Optional[ParsedResult] = None
         self.uid_vars: dict[str, np.ndarray] = {}
         self.value_vars: dict[str, dict[int, Val]] = {}
 
@@ -309,6 +310,7 @@ class Executor:
         a separate phase so the engine can time it (Latency.encoding_ns
         — the reference ranks ToJson a top-5 hot loop) and pick the
         columnar fast path."""
+        self.parsed = parsed
         blocks = list(parsed.queries)
         done: list[tuple[GraphQuery, ExecNode]] = []
         pending = blocks
@@ -2039,7 +2041,11 @@ class Executor:
             sel = self._select_posting(ps, [lang] if lang else [])
             if sel is not None:
                 try:
-                    out[u] = (0, sort_key(self._typed(tab, sel)))
+                    # strict schema-type conversion, matching
+                    # sort_key_pairs: an unconvertible value has NO
+                    # sort key (missing, sorts last) on every path —
+                    # _typed would silently sort the raw value here
+                    out[u] = (0, sort_key(tab._converted(sel)))
                 except ValueError:
                     pass
         return out

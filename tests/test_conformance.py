@@ -422,3 +422,28 @@ def test_order_by_any_language_tag(db):
     r = d2.query('{ q(func: has(lname), orderasc: lname@.) '
                  '{ lname@. } }')["data"]["q"]
     assert [x["lname@."] for x in r] == ["aa", "mm", "zz"], r
+
+
+def test_schema_query_surface(db):
+    """`schema {}` introspection through the query language (the
+    reference's schema blocks): all predicates, pred selection, field
+    selection, inside-braces form, and the serialized fast path."""
+    import json
+    rows = q(db, "schema {}")["schema"]
+    by_pred = {r["predicate"]: r for r in rows}
+    assert by_pred["name"]["type"] == "string"
+    assert by_pred["name"]["index"] is True
+    assert set(by_pred["name"]["tokenizer"]) == {"term", "exact",
+                                                 "trigram"}
+    assert by_pred["friend"]["reverse"] is True
+    assert by_pred["friend"]["count"] is True
+    assert by_pred["friend"]["list"] is True
+    assert "index" not in by_pred["boss"]
+    sel = q(db, 'schema(pred: [age, rating]) { type index }')["schema"]
+    assert [r["predicate"] for r in sel] == ["age", "rating"]
+    assert all(set(r) <= {"predicate", "type", "index"} for r in sel)
+    assert q(db, "{ schema {} }")["schema"] == rows
+    body = json.loads(db.query_json("schema {}"))
+    assert body["data"]["schema"] == rows
+    with pytest.raises(GQLError):
+        db.query("schema {} schema {}")
