@@ -1783,7 +1783,9 @@ class Executor:
     # order of magnitude matters here)
     _HOST_PER_FRONTIER_UID = 1.5e-6   # dict lookup + concat per parent
     _HOST_PER_EDGE = 4e-8             # np.unique share per edge
-    _HOST_PER_ORDER_KEY = 2e-6        # get_postings + sort_key per uid
+    _HOST_PER_ORDER_KEY = 2e-7        # columnar key gather + lexsort
+    #                                   share per uid (clean tablets
+    #                                   read cached sort-key arrays)
     _HOST_PER_RANGE_VAL = 5e-9        # cached-array mask per value
 
     def _device_worth(self, est_host_seconds: float) -> bool:
