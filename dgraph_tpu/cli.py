@@ -462,7 +462,7 @@ def cmd_debug(args) -> int:
         report["total"] = want
         print(json.dumps(report, indent=2))
         return 0 if report["ok"] else 1
-    db.rollup_all()  # fold replayed deltas so counts reflect the store
+    db.rollup_all(window=0)  # fold replayed deltas so counts reflect the store
     st = db.state()
     if args.what == "state":
         print(json.dumps(st, indent=2, default=str))

@@ -19,12 +19,21 @@ txn with commitTs > T.startTs.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 
 
 class TxnAborted(Exception):
     """Transaction aborted due to conflict (ref x.ErrConflict /
     pb.TxnContext.Aborted)."""
+
+
+class StaleSnapshot(TxnAborted):
+    """A pinned read's timestamp fell below a tablet's rollup
+    watermark: commits newer than the read ts were already folded into
+    base state, so the exact snapshot no longer exists.  Retryable —
+    re-issue the read at a fresh timestamp (subclassing TxnAborted
+    rides the existing retry/ABORTED mappings on every transport)."""
 
 
 @dataclass
@@ -44,6 +53,13 @@ class Coordinator:
         self._commits: dict[int, int] = {}
         self._active: dict[int, TxnState] = {}
         self._min_active: int = 0
+        # pinned snapshot reads: ts -> [refcount, monotonic expiry].
+        # Holds the rollup watermark at/below the ts of any in-flight
+        # read — folding a commit ABOVE a reader's ts fuses it into
+        # base state the reader cannot exclude (the split-bank
+        # invariant broke exactly this way). The TTL reaps pins leaked
+        # by a crashed reader.
+        self._pinned: dict[int, list] = {}
         # tablet map: predicate -> group id (single group 1 in round 1)
         self.tablets: dict[str, int] = {}
         self.groups: set[int] = {1}
@@ -190,14 +206,43 @@ class Coordinator:
             if st:
                 st.aborted = True
 
+    def pin_read(self, ts: int, ttl_s: float = 60.0):
+        """Register an in-flight pinned snapshot read at `ts` (see
+        _pinned). Always pair with unpin_read."""
+        with self._lock:
+            ent = self._pinned.get(ts)
+            exp = time.monotonic() + ttl_s
+            if ent is not None:
+                ent[0] += 1
+                ent[1] = max(ent[1], exp)
+            else:
+                self._pinned[ts] = [1, exp]
+
+    def unpin_read(self, ts: int):
+        with self._lock:
+            ent = self._pinned.get(ts)
+            if ent is not None:
+                ent[0] -= 1
+                if ent[0] <= 0:
+                    del self._pinned[ts]
+
     def min_active_ts(self) -> int:
         """Rollup watermark: everything <= this is safe to fold
         (ref worker/draft.go:1206 calculateSnapshot picking a ReadTs
-        below all pending txns)."""
+        below all pending txns). Pinned snapshot reads hold it too —
+        folding UP TO a pinned ts is safe (the reader sees base +
+        overlay <= its ts), past it is not."""
         with self._lock:
-            if self._active:
-                return min(self._active) - 1
-            return self._ts
+            wm = min(self._active) - 1 if self._active else self._ts
+            if self._pinned:
+                now = time.monotonic()
+                dead = [t for t, ent in self._pinned.items()
+                        if ent[1] < now]
+                for t in dead:
+                    del self._pinned[t]
+                if self._pinned:
+                    wm = min(wm, min(self._pinned))
+            return wm
 
     def gc_conflicts(self):
         """Drop conflict entries older than every active txn."""

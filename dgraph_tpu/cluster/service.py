@@ -474,6 +474,11 @@ class AlphaServer(RaftServer):
         self.group = group
         self._db_kw = dict(db_kw or {})
         self._db_kw.setdefault("prefer_device", False)
+        # zero-issued global read timestamps are in flight here: lag
+        # background folds so pinned readers rarely hit StaleSnapshot
+        # (carried in _db_kw so sm_restore/_rebuild_from_events keep
+        # it when they build a fresh engine)
+        self._db_kw.setdefault("rollup_window", 512)
         self.db = GraphDB(**self._db_kw)
         # bulk-booted group: seed the engine from a `dgraph_tpu bulk
         # --reduce-shards` output BEFORE raft starts (ref handing
@@ -529,6 +534,10 @@ class AlphaServer(RaftServer):
         # the leader engine's execution order (followers must apply
         # deltas in commit-ts order)
         self._write_lock = threading.Lock()
+        # serializes ordered application of decided 2PC finalizes —
+        # two concurrent drains could otherwise interleave commits out
+        # of ts order (see _drain_finalizes)
+        self._finalize_lock = threading.Lock()
         super().__init__(node_id, raft_peers, client_addr,
                          storage=storage, **kw)
         if self._join_members:
@@ -747,6 +756,7 @@ class AlphaServer(RaftServer):
                     for st in pend}
             if not self.db.pending_txns:
                 self._xstatus_clean.clear()
+        decided: list[tuple[int, int]] = []  # (commit_ts, start_ts)
         for st in pend:
             if upto_ts is None and evict_older_s is not None \
                     and ages[st] <= evict_older_s:
@@ -779,13 +789,61 @@ class AlphaServer(RaftServer):
                     if not final.get("ok"):
                         continue
                     status = {"commit_ts": final["result"]}
-                self._replicate_record(
-                    ("xfinalize", st, status["commit_ts"]))
+                decided.append((int(status["commit_ts"]), st))
+            except Exception:  # noqa: BLE001 — next pass retries
+                continue
+        if decided:
+            self._drain_finalizes()
+
+    def _drain_finalizes(self, hint: tuple[int, int] | None = None
+                         ) -> bool:
+        """Apply every DECIDED pending 2PC fragment in COMMIT-TS
+        order, atomically with respect to other drains.
+
+        Racing coordinators' finalize RPCs (or a reconcile racing one)
+        can otherwise deliver commits out of ts order; an out-of-order
+        overlay delta both mis-serializes single-value overwrite
+        expansion and breaks every ts-sorted overlay consumer — the
+        split-bank chaos run lost a committed credit to exactly this
+        (a later-committed transfer's read missed it, then overwrote).
+
+        The status GATHER happens under the same lock as the apply
+        loop: a drain that only knew about a later commit could
+        otherwise apply it while an earlier-decided stage (whose
+        status fetch failed elsewhere) is still pending.  If ANY
+        pending stage's status cannot be fetched, the whole drain
+        aborts — applying around an unknown would gamble on its order.
+        Ordering is sufficient: zero decides serially, so a stage
+        still undecided during the gather will get a commit_ts above
+        everything already decided.  `hint` = (commit_ts, start_ts)
+        already known by the caller (saves one RPC)."""
+        with self._finalize_lock:
+            with self.lock:
+                pend = sorted(self.db.pending_txns)
+            decided: list[tuple[int, int]] = []
+            for st in pend:
+                if hint is not None and st == hint[1]:
+                    decided.append((int(hint[0]), st))
+                    continue
+                try:
+                    got = self.zero.request({"op": "txn_status",
+                                             "args": (st,)})
+                except Exception:  # noqa: BLE001
+                    return False
+                if not got.get("ok"):
+                    return False
+                if got["result"]["decided"]:
+                    decided.append(
+                        (int(got["result"]["commit_ts"]), st))
+            for c, st in sorted(decided):
+                try:
+                    self._replicate_record(("xfinalize", st, c))
+                except Exception:  # noqa: BLE001 — retried next pass
+                    return False
                 with self.lock:
                     self._xstage_touched.pop(st, None)
                     self._xstatus_clean.pop(st, None)
-            except Exception:  # noqa: BLE001 — next pass retries
-                continue
+            return True
 
     def _read_barrier(self):
         """Linearizable-read barrier for pinned reads (raft §8): a
@@ -1140,10 +1198,7 @@ class AlphaServer(RaftServer):
             with self.lock:
                 known = start_ts in self.db.pending_txns
             if known:
-                self._replicate_record(
-                    ("xfinalize", start_ts, commit_ts))
-                self._xstage_touched.pop(start_ts, None)
-                self._xstatus_clean.pop(start_ts, None)
+                self._drain_finalizes(hint=(commit_ts, start_ts))
             return {"ok": True, "result": {"applied": known}}
         if op == "alter":
             self._replicate_write(lambda db: db.alter(**req["kw"]))

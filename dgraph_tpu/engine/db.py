@@ -26,7 +26,9 @@ from typing import Any, Optional
 
 import numpy as np
 
-from dgraph_tpu.cluster.coordinator import Coordinator, TxnAborted
+from dgraph_tpu.cluster.coordinator import (
+    Coordinator, StaleSnapshot, TxnAborted,
+)
 from dgraph_tpu.gql import parse as gql_parse
 from dgraph_tpu.gql.nquad import NQuad, parse_json_mutation, parse_rdf
 from dgraph_tpu.models.schema import (
@@ -102,7 +104,8 @@ class GraphDB:
                  mesh=None, shard_min_edges: int = 1 << 18,
                  enc_key: bytes | None = None,
                  store_dir: str | None = None,
-                 tablet_budget: int = 256 << 20):
+                 tablet_budget: int = 256 << 20,
+                 rollup_window: int = 0):
         from dgraph_tpu.engine.tile_cache import DeviceCacheLRU
 
         self.schema = SchemaState()
@@ -125,6 +128,9 @@ class GraphDB:
                 self, self.tablet_store, tablet_budget)
             for pred in self.tablets.stored:
                 self.coordinator.should_serve(pred)
+            # resume timestamps past the persisted base state (reads
+            # below a reloaded tablet's base_ts are stale snapshots)
+            self.coordinator.observe_ts(self.tablet_store.load_max_ts())
         else:
             self.tablets = {}
         self.prefer_device = prefer_device
@@ -135,6 +141,14 @@ class GraphDB:
         # multi-part posting lists; SURVEY §5.7)
         self.mesh = mesh
         self.shard_min_edges = shard_min_edges
+        # background rollups lag this many LOGICAL ts behind the
+        # newest commit, so pinned snapshot readers (zero-issued
+        # global ts) rarely find their snapshot already folded; a
+        # reader that still does gets a retryable StaleSnapshot, never
+        # silently-newer data. 0 (the embedded default) folds
+        # everything foldable; the cluster AlphaServer raises it —
+        # only there do remotely issued read timestamps roam
+        self.rollup_window = rollup_window
         # HBM residency budget for device tiles (ref posting/lists.go
         # LRU bound on cached posting lists)
         self.device_cache = DeviceCacheLRU(device_hbm_budget)
@@ -717,15 +731,18 @@ class GraphDB:
         best_effort reads at max_assigned and strict reads allocate."""
         ex, done, lat, read_ts = self._query_run(
             q, variables, txn, best_effort, read_ts)
-        with _span("encode") as sp:
-            t0 = time.perf_counter_ns()
-            data = ex.emit(done)
-            if ex.parsed is not None \
-                    and ex.parsed.schema_request is not None:
-                data["schema"] = self._schema_rows(
-                    ex.parsed.schema_request)
-            lat.encoding_ns = time.perf_counter_ns() - t0
-            sp["encode_us"] = lat.encoding_ns // 1000
+        try:
+            with _span("encode") as sp:
+                t0 = time.perf_counter_ns()
+                data = ex.emit(done)
+                if ex.parsed is not None \
+                        and ex.parsed.schema_request is not None:
+                    data["schema"] = self._schema_rows(
+                        ex.parsed.schema_request)
+                lat.encoding_ns = time.perf_counter_ns() - t0
+                sp["encode_us"] = lat.encoding_ns // 1000
+        finally:
+            self.coordinator.unpin_read(read_ts)
         self._query_metrics(lat)
         return {"data": data,
                 "extensions": {"latency": lat.as_dict(),
@@ -787,9 +804,17 @@ class GraphDB:
                 read_ts = self.coordinator.next_ts()
             lat.assign_ts_ns = time.perf_counter_ns() - t0
 
+            # hold the rollup watermark for the query's duration
+            # (execution AND emission — both read tablets at read_ts);
+            # callers unpin in their finally blocks
+            self.coordinator.pin_read(read_ts)
             t0 = time.perf_counter_ns()
-            ex = Executor(self, read_ts)
-            done = ex.execute(parsed)
+            try:
+                ex = Executor(self, read_ts)
+                done = ex.execute(parsed)
+            except BaseException:
+                self.coordinator.unpin_read(read_ts)
+                raise
             lat.processing_ns = time.perf_counter_ns() - t0
             sp["read_ts"] = read_ts
             sp["blocks"] = len(parsed.queries)
@@ -817,19 +842,23 @@ class GraphDB:
 
         ex, done, lat, read_ts = self._query_run(
             q, variables, txn, best_effort, read_ts)
-        with _span("encode") as sp:
-            t0 = time.perf_counter_ns()
-            data_json = ex.emit_json(done)
-            if ex.parsed is not None \
-                    and ex.parsed.schema_request is not None:
-                rows = _json.dumps(
-                    self._schema_rows(ex.parsed.schema_request),
-                    separators=(",", ":"))
-                data_json = ('{"schema":' + rows + "}"
-                             if data_json == "{}" else
-                             data_json[:-1] + ',"schema":' + rows + "}")
-            lat.encoding_ns = time.perf_counter_ns() - t0
-            sp["encode_us"] = lat.encoding_ns // 1000
+        try:
+            with _span("encode") as sp:
+                t0 = time.perf_counter_ns()
+                data_json = ex.emit_json(done)
+                if ex.parsed is not None \
+                        and ex.parsed.schema_request is not None:
+                    rows = _json.dumps(
+                        self._schema_rows(ex.parsed.schema_request),
+                        separators=(",", ":"))
+                    data_json = ('{"schema":' + rows + "}"
+                                 if data_json == "{}" else
+                                 data_json[:-1] + ',"schema":'
+                                 + rows + "}")
+                lat.encoding_ns = time.perf_counter_ns() - t0
+                sp["encode_us"] = lat.encoding_ns // 1000
+        finally:
+            self.coordinator.unpin_read(read_ts)
         self._query_metrics(lat)
         ext = _json.dumps({"latency": lat.as_dict(),
                            "txn": {"start_ts": read_ts}})
@@ -940,8 +969,15 @@ class GraphDB:
                 _DISPATCH_SECONDS = 0.0
         return _DISPATCH_SECONDS
 
-    def rollup_all(self):
-        wm = self.coordinator.min_active_ts()
+    def rollup_all(self, window: Optional[int] = None):
+        """Fold overlays up to the watermark. `window` (default
+        self.rollup_window) keeps the fold that many ts behind the
+        newest commit for in-flight pinned readers; pass 0 to fold
+        everything foldable (export/offload paths need that)."""
+        if window is None:
+            window = self.rollup_window
+        wm = min(self.coordinator.min_active_ts(),
+                 self.coordinator.max_assigned() - window)
         for tab in self.tablets.values():
             if tab.dirty():
                 tab.rollup(wm)

@@ -34,6 +34,7 @@ from dgraph_tpu.utils.metrics import inc_counter
 
 _TAB_PREFIX = b"tab:"
 _SCHEMA_KEY = b"meta:schema"
+_MAXTS_KEY = b"meta:max_ts"
 
 
 class TabletStore:
@@ -76,6 +77,13 @@ class TabletStore:
 
     def save_schema(self, text: str) -> None:
         self.kv.put(_SCHEMA_KEY, text.encode("utf-8"))
+
+    def save_max_ts(self, ts: int) -> None:
+        self.kv.put(_MAXTS_KEY, str(int(ts)).encode())
+
+    def load_max_ts(self) -> int:
+        blob = self.kv.get(_MAXTS_KEY)
+        return int(blob) if blob else 0
 
     def load_schema(self) -> str:
         blob = self.kv.get(_SCHEMA_KEY)
@@ -231,6 +239,11 @@ class TabletMap(dict):
                 or pred not in self.stored:
             self.store.save(tab)
             self._saved_ts[pred] = tab.base_ts
+            # keep meta:max_ts ahead of every persisted base_ts — a
+            # crash before flush_all would otherwise reopen with the
+            # coordinator far below this tablet's base (every read a
+            # StaleSnapshot until the ts catches up)
+            self.store.save_max_ts(self.db.coordinator.max_assigned())
         self.stored.add(pred)
         self.db.device_cache.drop_tablet(tab)
         dict.pop(self, pred, None)
@@ -241,7 +254,11 @@ class TabletMap(dict):
 
     def flush_all(self):
         """Persist every resident tablet (rollup first so overlays
-        fold); used at close/checkpoint."""
+        fold); used at close/checkpoint. Also records the coordinator
+        high-water ts: a REOPENED store must resume timestamps past
+        its persisted base state, or every read allocates a ts below
+        the tablets' base_ts and refuses as a stale snapshot."""
+        self.store.save_max_ts(self.db.coordinator.max_assigned())
         for pred in list(dict.keys(self)):
             tab = dict.get(self, pred)
             if tab is None:

@@ -35,6 +35,7 @@ from dgraph_tpu.models.tokenizer import get_tokenizer, tokens_for
 from dgraph_tpu.models.types import (
     TypeID, Val, convert, sort_key, to_json_value, type_name,
 )
+from dgraph_tpu.cluster.coordinator import StaleSnapshot
 from dgraph_tpu.query.colvar import ColVar, make_colvar
 from dgraph_tpu.query.retrigram import compile_trigram_query
 from dgraph_tpu.storage.tablet import Tablet
@@ -516,7 +517,19 @@ class Executor:
     # ------------------------------------------------------------------
 
     def _tablet(self, attr: str) -> Optional[Tablet]:
-        return self.db.tablets.get(attr)
+        tab = self.db.tablets.get(attr)
+        if tab is not None \
+                and getattr(tab, "base_ts", 0) > self.read_ts:
+            # commits newer than this read's ts were already folded
+            # into base state — the exact snapshot no longer exists.
+            # Refuse (retryable) instead of serving silently-newer
+            # data: the split-bank invariant broke exactly here when a
+            # pinned cross-group read raced the rollup.
+            raise StaleSnapshot(
+                f"read at ts {self.read_ts} is below tablet "
+                f"{attr!r}'s rollup watermark {tab.base_ts}; "
+                f"retry at a fresh timestamp")
+        return tab
 
     def _eval_func(self, fn: Function, candidates: Optional[np.ndarray]
                    ) -> np.ndarray:

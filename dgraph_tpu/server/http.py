@@ -403,8 +403,9 @@ class AlphaServer:
         from dgraph_tpu.storage.backup import backup as do_backup
         with self.rw.write:
             # the rollup (a write) is quick; the expensive serialization
-            # below runs under the READ lock so queries keep flowing
-            self.db.rollup_all()
+            # below runs under the READ lock so queries keep flowing.
+            # window=0: the backup must capture EVERY commit
+            self.db.rollup_all(window=0)
         with self.rw.read:
             entry = do_backup(self.db, dest, force_full=force_full)
         return {"code": "Success", "message": "Backup completed.",

@@ -47,7 +47,7 @@ def backup(db, dest: str, force_full: bool = False,
     chain = _read_chain(handler)
     since = 0 if (force_full or not chain) else chain[-1]["read_ts"]
 
-    db.rollup_all()
+    db.rollup_all(window=0)  # backups must capture every commit
     read_ts = db.coordinator.max_assigned()
     tablets = {}
     for pred, tab in db.tablets.items():

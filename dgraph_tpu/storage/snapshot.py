@@ -26,8 +26,13 @@ SNAPSHOT_MAGIC = b"DGTPU-SNAP-1"
 
 
 def dump_tablet(tab) -> dict:
-    """One tablet's base state — the single wire shape shared by
-    snapshots, backups and tablet moves. Add new Tablet fields HERE."""
+    """One tablet's state — the single wire shape shared by snapshots,
+    backups and tablet moves. Add new Tablet fields HERE.
+
+    Unfolded overlay deltas ARE included: the rollup watermark can be
+    pinned below the newest commits (active txns, pinned snapshot
+    readers), and a payload of base arrays alone would silently drop
+    those committed writes from snapshots/backups."""
     return {
         "edges": tab.edges,
         "reverse": tab.reverse,
@@ -35,6 +40,8 @@ def dump_tablet(tab) -> dict:
         "index": tab.index,
         "edge_facets": tab.edge_facets,
         "base_ts": tab.base_ts,
+        "deltas": tab.deltas,
+        "max_commit_ts": tab.max_commit_ts,
     }
 
 
@@ -48,13 +55,19 @@ def restore_tablet(pred: str, schema, st: dict):
     tab.index = st["index"]
     tab.edge_facets = st["edge_facets"]
     tab.base_ts = st["base_ts"]
+    tab.deltas = list(st.get("deltas", ()))  # absent in old payloads
+    tab.max_commit_ts = int(st.get("max_commit_ts", tab.base_ts))
+    for ts, _ops in tab.deltas:
+        tab.max_commit_ts = max(tab.max_commit_ts, ts)
     return tab
 
 
 def dump_state(db) -> dict:
-    """GraphDB -> one picklable state payload at a single ts. Pending
-    deltas are folded first so the payload is pure base state."""
-    db.rollup_all()
+    """GraphDB -> one picklable state payload at a single ts. Deltas
+    fold first where the watermark allows; whatever must stay unfolded
+    (active txns / pinned readers hold the watermark) ships inside
+    dump_tablet's deltas, so the payload is complete either way."""
+    db.rollup_all(window=0)
     tablets = {pred: dump_tablet(tab)
                for pred, tab in db.tablets.items()}
     return {

@@ -168,9 +168,20 @@ class Tablet:
     def apply(self, commit_ts: int, ops: list[EdgeOp]):
         """Append a committed delta. Ops are expanded with the implicit
         index/reverse maintenance (old-value token deletes etc.) at apply
-        time so the overlay is self-contained for reads."""
-        assert commit_ts > self.max_commit_ts or not self.deltas, \
-            "commits must apply in ts order"
+        time so the overlay is self-contained for reads.
+
+        Commits MUST apply in ts order: overlay consumers early-break
+        on the ts-sorted deltas, and single-value overwrite expansion
+        (del old + set new) is computed against apply-time state.  The
+        service layer guarantees the order by applying decided 2PC
+        finalizes sorted by commit_ts (_apply_finalizes); a violation
+        here must surface as a hard error, never a silent mis-ordered
+        append (a stripped assert once let a racing finalize lose a
+        committed bank credit)."""
+        if self.deltas and commit_ts <= self.max_commit_ts:
+            raise RuntimeError(
+                f"out-of-order commit apply: ts {commit_ts} after "
+                f"{self.max_commit_ts} on tablet {self.pred!r}")
         self.deltas.append((commit_ts, ops))
         self.max_commit_ts = max(self.max_commit_ts, commit_ts)
         if self._ov_by_src is not None:
