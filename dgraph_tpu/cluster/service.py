@@ -734,7 +734,7 @@ class AlphaServer(RaftServer):
                     self.db.discard(txn)
 
     def _reconcile_pending(self, upto_ts: int | None = None,
-                           evict_older_s: float | None = None):
+                           evict_older_s: float | None = None) -> bool:
         """Resolve replicated cross-group stages against zero's
         decision registry (ref posting/oracle.go ProcessDelta: alphas
         learn commit decisions they missed). With upto_ts, every
@@ -743,9 +743,16 @@ class AlphaServer(RaftServer):
         would assign them a commit_ts issued after upto_ts). With
         evict_older_s, undecided stages older than the TTL are aborted
         THROUGH zero (abort_txn records the decision, so a slow
-        coordinator can't later commit what we evicted)."""
+        coordinator can't later commit what we evicted).
+
+        Returns False when some relevant pending could NOT be verified
+        or a decided one could not be applied — pinned readers must
+        then fail closed (retryable) rather than serve a snapshot that
+        may be missing an acknowledged commit (a parked local commit
+        returns success to its client; serving around it would break
+        read-your-writes)."""
         if self.zero is None:
-            return
+            return True
         now = time.time()
         with self.lock:
             pend = [ts for ts in self.db.pending_txns
@@ -757,6 +764,7 @@ class AlphaServer(RaftServer):
             if not self.db.pending_txns:
                 self._xstatus_clean.clear()
         decided: list[tuple[int, int]] = []  # (commit_ts, start_ts)
+        ok = True
         for st in pend:
             if upto_ts is None and evict_older_s is not None \
                     and ages[st] <= evict_older_s:
@@ -768,6 +776,7 @@ class AlphaServer(RaftServer):
                 got = self.zero.request({"op": "txn_status",
                                          "args": (st,)})
                 if not got.get("ok"):
+                    ok = False
                     continue
                 status = got["result"]
                 if not status["decided"]:
@@ -783,22 +792,36 @@ class AlphaServer(RaftServer):
                         # contradict a commit another group applied.
                         # Keep the stage pending (operator-visible)
                         # rather than guess.
+                        ok = False
                         continue
                     final = self.zero.request(
                         {"op": "abort_txn", "args": (st,)})
                     if not final.get("ok"):
+                        ok = False
                         continue
                     status = {"commit_ts": final["result"]}
                 decided.append((int(status["commit_ts"]), st))
             except Exception:  # noqa: BLE001 — next pass retries
+                ok = False
                 continue
-        if decided:
-            self._drain_finalizes()
+        if decided and not self._drain_finalizes():
+            ok = False
+        return ok
 
     def _drain_finalizes(self, hint: tuple[int, int] | None = None
                          ) -> bool:
+        """Public entry: takes the write lock first (global lock order
+        is _write_lock -> _finalize_lock -> lock; the local-commit path
+        drains while already holding _write_lock, so acquiring
+        _finalize_lock before _write_lock anywhere would invert)."""
+        with self._write_lock:
+            return self._drain_finalizes_locked(hint)
+
+    def _drain_finalizes_locked(self, hint: tuple[int, int] | None = None
+                                ) -> bool:
         """Apply every DECIDED pending 2PC fragment in COMMIT-TS
-        order, atomically with respect to other drains.
+        order, atomically with respect to other drains. Caller holds
+        _write_lock.
 
         Racing coordinators' finalize RPCs (or a reconcile racing one)
         can otherwise deliver commits out of ts order; an out-of-order
@@ -837,13 +860,49 @@ class AlphaServer(RaftServer):
                         (int(got["result"]["commit_ts"]), st))
             for c, st in sorted(decided):
                 try:
-                    self._replicate_record(("xfinalize", st, c))
+                    self._replicate_record_locked(("xfinalize", st, c))
                 except Exception:  # noqa: BLE001 — retried next pass
                     return False
                 with self.lock:
                     self._xstage_touched.pop(st, None)
                     self._xstatus_clean.pop(st, None)
             return True
+
+    def _drop_txn_handle(self, txn) -> None:
+        """Forget (and, if still open, abort) a leader-local txn
+        handle — the oracle must never keep a start_ts pinned for a
+        txn its client cannot reach anymore."""
+        with self.lock:
+            self._txns.pop(txn.start_ts, None)
+            self._txn_touched.pop(txn.start_ts, None)
+            if not txn.done:
+                self.db.discard(txn)
+
+    def _drain_before_local_apply(self, commit_ts: int) -> bool:
+        """Between a local commit's ts RESERVATION and its APPLY, land
+        every already-decided pending 2PC fragment (all necessarily
+        below our ts). Caller holds _write_lock.
+
+        Retries patiently on gather failure: zero answered the
+        reservation RPC moments ago, so unreachability here is a
+        transient blip — and applying around an unknown-order pending
+        risks the out-of-order hard error at apply
+        (storage/tablet.py Tablet.apply) when that fragment finalizes.
+        Returns False on sustained failure; the caller must then PARK
+        the reserved commit as a pending fragment instead of applying
+        (applying anyway would deadlock the group: the lower-ts
+        fragment could never apply NOR fold past the local delta)."""
+        with self.lock:
+            if not self.db.pending_txns:
+                return True
+        deadline = time.monotonic() + 30.0
+        while not self._drain_finalizes_locked():
+            if time.monotonic() >= deadline or self._stop.is_set():
+                log.warning("commit_undrained_pendings",
+                            commit_ts=commit_ts)
+                return False
+            time.sleep(0.05)
+        return True
 
     def _read_barrier(self):
         """Linearizable-read barrier for pinned reads (raft §8): a
@@ -940,17 +999,23 @@ class AlphaServer(RaftServer):
         original payload, and later in-place tablet mutations (rollup
         folds) must never rewrite replicated history."""
         with self._write_lock:
+            self._replicate_record_locked(rec)
+
+    def _replicate_record_locked(self, rec) -> None:
+        """_replicate_record body for callers already holding
+        _write_lock (the finalize drain, which also runs from the
+        local-commit path under the commit's own _write_lock)."""
+        with self.lock:
+            if self.node.role != LEADER:
+                raise NotLeader(self.node.leader_id)
+            ts = self.db.apply_record(wire.loads(wire.dumps(rec)))
+            if ts:
+                self.db.fast_forward_ts(ts)
+        ok, _ = self.propose_and_wait(rec)
+        if not ok:
             with self.lock:
-                if self.node.role != LEADER:
-                    raise NotLeader(self.node.leader_id)
-                ts = self.db.apply_record(wire.loads(wire.dumps(rec)))
-                if ts:
-                    self.db.fast_forward_ts(ts)
-            ok, _ = self.propose_and_wait(rec)
-            if not ok:
-                with self.lock:
-                    self._rebuild_from_events()
-                raise RuntimeError("record not replicated (no quorum)")
+                self._rebuild_from_events()
+            raise RuntimeError("record not replicated (no quorum)")
 
     def _run_task(self, req: dict, read_ts: int):
         """Dispatch one federated task kind against the local tablet.
@@ -1035,8 +1100,13 @@ class AlphaServer(RaftServer):
                 # AFTER the barrier (so a just-elected leader has
                 # applied its inherited log first): decided-but-
                 # unapplied cross-group commits <= read_ts must land
-                # before this snapshot is served
-                self._reconcile_pending(upto_ts=read_ts)
+                # before this snapshot is served; fail CLOSED when a
+                # pending cannot be verified (it may hold a commit
+                # already acknowledged to its client)
+                if not self._reconcile_pending(upto_ts=read_ts):
+                    raise RuntimeError(
+                        "cannot verify pending transactions against "
+                        "the decision registry; retry")
                 with self._write_lock:
                     with self.lock:
                         if self.node.role != LEADER:
@@ -1053,34 +1123,68 @@ class AlphaServer(RaftServer):
             commit_now = kw.pop("commit_now", True)
             start_ts = kw.pop("start_ts", 0)
             preds = self._mutation_preds(kw) if self.zero else ()
-            if commit_now and not start_ts:
-                out = self._replicate_write(
-                    lambda db: db.mutate(commit_now=True, **kw),
-                    preds=preds)
-                return {"ok": True, "result": out}
-            # interactive txn flow: stage on the leader engine; records
-            # replicate at commit time
+            # commit-now mutations take the SAME stage-then-commit flow
+            # as interactive txns: the commit handler drains decided
+            # lower-ts 2PC fragments between ts reservation and apply,
+            # so a commit-now write can never overtake a pending
+            # cross-group finalize (ref worker/draft.go:435 — one Raft
+            # log gives the reference this ordering for free)
+            with self.lock:
+                if self.node.role != LEADER:
+                    raise NotLeader(self.node.leader_id)
+                self._evict_idle_txns()
+                if start_ts:
+                    txn = self._txns.get(start_ts)
+                    if txn is None:
+                        raise KeyError(
+                            f"no open txn at startTs={start_ts} "
+                            "(leader changed?)")
+                else:
+                    txn = self.db.new_txn()
+            if kw.get("query") and self.zero is not None:
+                # an upsert stage READS at the txn's start_ts: pay the
+                # same linearizable-read protocol as a pinned query
+                # (barrier: a fresh leader applies inherited xstage
+                # records first; reconcile: decided-but-unapplied
+                # fragments <= start_ts land before the read) — or the
+                # read-modify-write computes against a snapshot missing
+                # a commit it logically follows and overwrites it (the
+                # mixed commit-now/2PC bank run lost exactly such a
+                # credit)
+                try:
+                    self._read_barrier()
+                    if not self._reconcile_pending(
+                            upto_ts=txn.start_ts):
+                        raise RuntimeError(
+                            "cannot verify pending transactions "
+                            "against the decision registry; retry")
+                except Exception:
+                    if not start_ts:
+                        self.db.discard(txn)
+                    raise
             with self._write_lock:
-                self._check_ownership(preds)
+                try:
+                    self._check_ownership(preds)
+                except Exception:
+                    # a txn created HERE must not leak its start_ts in
+                    # the oracle (a pinned _active entry freezes the
+                    # rollup watermark forever); an existing open txn
+                    # stays open — the client may retry after the move
+                    if not start_ts:
+                        with self.lock:
+                            self.db.discard(txn)
+                    raise
                 with self.lock:
                     if self.node.role != LEADER:
+                        if not start_ts:
+                            self.db.discard(txn)
                         raise NotLeader(self.node.leader_id)
-                    self._evict_idle_txns()
-                    if start_ts:
-                        txn = self._txns.get(start_ts)
-                        if txn is None:
-                            raise KeyError(
-                                f"no open txn at startTs={start_ts} "
-                                "(leader changed?)")
-                    else:
-                        txn = self.db.new_txn()
                     try:
                         out = self.db.mutate(txn, commit_now=False,
                                              **kw)
                     except Exception:
-                        # never leak start_ts in the oracle: a pinned
-                        # _active entry would freeze the rollup
-                        # watermark forever
+                        # a failed stage aborts the whole txn (fail
+                        # fast, like the reference's aborted TxnContext)
                         self._txns.pop(txn.start_ts, None)
                         self._txn_touched.pop(txn.start_ts, None)
                         self.db.discard(txn)
@@ -1090,10 +1194,19 @@ class AlphaServer(RaftServer):
                     out.setdefault("extensions", {})["txn"] = {
                         "start_ts": txn.start_ts}
             if commit_now:
-                resp = self.handle_request(
-                    {"op": "commit",
-                     "params": {"startTs": str(txn.start_ts)}})
+                try:
+                    resp = self.handle_request(
+                        {"op": "commit",
+                         "params": {"startTs": str(txn.start_ts)}})
+                except Exception:
+                    self._drop_txn_handle(txn)
+                    raise
                 if not resp.get("ok"):
+                    # the client of a commit-now mutation has no txn
+                    # handle to retry or abort with: a failed nested
+                    # commit must not leave the staged txn registered
+                    # (it would pin the fold watermark until the TTL)
+                    self._drop_txn_handle(txn)
                     return resp
                 # keep the stage's payload (uids map for blank nodes,
                 # like a dgo CommitNow mutation) and graft the commit
@@ -1132,18 +1245,38 @@ class AlphaServer(RaftServer):
                 with self.lock:
                     self._txns.pop(start_ts, None)
                     self._txn_touched.pop(start_ts, None)
-
-                def do_commit(db):
-                    try:
-                        return db.commit(txn)
-                    except Exception:
-                        # commit failure (conflict abort, zero ts RPC
-                        # down) must release start_ts in the oracle
-                        if not txn.done:
-                            db.discard(txn)
-                        raise
-
-                commit_ts = self._capture_and_replicate(do_commit)
+                try:
+                    commit_ts = self.db.commit_reserve(txn)
+                except Exception:
+                    # reservation failure (conflict abort, zero ts RPC
+                    # down) must release start_ts in the oracle
+                    if not txn.done:
+                        self.db.discard(txn)
+                    raise
+                # Every already-DECIDED cross-group fragment carries a
+                # commit ts BELOW ours (zero assigns monotonically and
+                # decides serially), so applying them first reproduces
+                # log order; anything still undecided will land above
+                # ours and may apply later
+                if self._drain_before_local_apply(commit_ts):
+                    commit_ts = self._capture_and_replicate(
+                        lambda db: db.commit_apply(txn, commit_ts))
+                else:
+                    # zero went dark mid-commit with a pending whose
+                    # order is unknowable. The decision IS recorded at
+                    # zero, so park this commit as a pending fragment:
+                    # the reconcile machinery applies everything in ts
+                    # order once zero answers — the same guarantee a
+                    # 2PC participant gives when a finalize delivery
+                    # fails (topology.py relies on it already)
+                    schemas = {
+                        p: self.db.schema.get_or_default(p).describe()
+                        for p in {pred for pred, _ in txn.staged}}
+                    self._replicate_record_locked(
+                        ("xstage", txn.start_ts, list(txn.staged),
+                         schemas,
+                         sorted(int(k) for k in txn.conflict_keys)))
+                    self._xstage_touched[txn.start_ts] = time.time()
             return {"ok": True, "result": {
                 "extensions": {"txn": {"start_ts": start_ts,
                                        "commit_ts": commit_ts}}}}
@@ -1161,7 +1294,10 @@ class AlphaServer(RaftServer):
             # ex-leader serve committed-but-unapplied state. Barrier
             # first, then reconcile decided cross-group commits.
             self._read_barrier()
-            self._reconcile_pending(upto_ts=read_ts)
+            if not self._reconcile_pending(upto_ts=read_ts):
+                raise RuntimeError(
+                    "cannot verify pending transactions against "
+                    "the decision registry; retry")
             with self._write_lock:
                 with self.lock:
                     if self.node.role != LEADER:
@@ -1212,6 +1348,7 @@ class AlphaServer(RaftServer):
                     "term": self.node.term,
                     "applied": self.node.applied_index,
                     "tablets": sorted(self.db.tablets),
+                    "pending": sorted(self.db.pending_txns),
                     "max_ts": self.db.coordinator.max_assigned()}}
         if op == "export_tablet":
             # tablet move, source side (worker/predicate_move.go:81).

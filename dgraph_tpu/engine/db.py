@@ -196,7 +196,7 @@ class GraphDB:
                 t.schema = ps
                 # index/reverse definition changed -> rebuild
                 # (ref posting/index.go:601 IndexRebuild.Run)
-                t.rollup(self.coordinator.min_active_ts())
+                t.rollup(self.fold_watermark())
                 if (old.indexed, tuple(old.tokenizers)) != \
                         (ps.indexed, tuple(ps.tokenizers)):
                     t.rebuild_index()
@@ -499,9 +499,17 @@ class GraphDB:
     def commit(self, txn: Txn) -> int:
         with _span("commit", start_ts=txn.start_ts,
                    edges=len(txn.staged)):
-            return self._commit_inner(txn)
+            commit_ts = self.commit_reserve(txn)
+            return self.commit_apply(txn, commit_ts)
 
-    def _commit_inner(self, txn: Txn) -> int:
+    def commit_reserve(self, txn: Txn) -> int:
+        """Conflict-check the txn at the oracle and obtain its commit
+        ts WITHOUT applying. Split from commit_apply so a clustered
+        server can drain already-decided cross-group fragments (all of
+        which carry a LOWER commit ts — the oracle assigns ts
+        monotonically and decides serially) between reservation and
+        apply, reproducing the reference's single-log apply order
+        (ref worker/draft.go:435 processApplyCh)."""
         if txn.done:
             raise TxnAborted("transaction already finished")
         try:
@@ -513,6 +521,12 @@ class GraphDB:
         metrics.inc_counter("dgraph_num_mutations_total")
         metrics.inc_counter("dgraph_num_edges_total", len(txn.staged))
         txn.done = True
+        return commit_ts
+
+    def commit_apply(self, txn: Txn, commit_ts: int) -> int:
+        """Expand and apply a reserved commit. MUST eventually run
+        after a successful commit_reserve: the oracle has already
+        recorded the decision."""
         expanded = self._expand_ops(commit_ts, txn.staged)
         for pred, ops in expanded.items():
             self._tablet_for(pred).apply(commit_ts, ops)
@@ -916,7 +930,7 @@ class GraphDB:
         from dgraph_tpu.storage.snapshot import dump_tablet
         tab = self.tablets[pred]
         if tab.dirty():
-            tab.rollup(self.coordinator.min_active_ts())
+            tab.rollup(self.fold_watermark())
         if tab.dirty():
             raise RuntimeError(
                 f"tablet {pred!r} still has unfolded deltas (an open "
@@ -969,6 +983,20 @@ class GraphDB:
                 _DISPATCH_SECONDS = 0.0
         return _DISPATCH_SECONDS
 
+    def fold_watermark(self, window: int = 0) -> int:
+        """Highest ts safe to fold into tablet bases. Below every
+        active txn AND below every pending 2PC stage's start_ts: a
+        stage decided at zero (hence no longer "active" there) whose
+        finalize hasn't landed here yet will apply at some
+        commit_ts > its start_ts — folding past that would let the
+        base overtake a commit still in flight."""
+        wm = self.coordinator.min_active_ts()
+        if window:
+            wm = min(wm, self.coordinator.max_assigned() - window)
+        if self.pending_txns:
+            wm = min(wm, min(self.pending_txns) - 1)
+        return wm
+
     def rollup_all(self, window: Optional[int] = None):
         """Fold overlays up to the watermark. `window` (default
         self.rollup_window) keeps the fold that many ts behind the
@@ -976,8 +1004,7 @@ class GraphDB:
         everything foldable (export/offload paths need that)."""
         if window is None:
             window = self.rollup_window
-        wm = min(self.coordinator.min_active_ts(),
-                 self.coordinator.max_assigned() - window)
+        wm = self.fold_watermark(window)
         for tab in self.tablets.values():
             if tab.dirty():
                 tab.rollup(wm)

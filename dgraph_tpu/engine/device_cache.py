@@ -71,7 +71,7 @@ def _clean_resident(db, tab, read_ts: int, want_uid: bool = True,
         return False
     if tab.dirty():
         if getattr(db, "rollup_in_read", True):
-            wm = db.coordinator.min_active_ts()
+            wm = db.fold_watermark()
             if wm >= tab.max_commit_ts:
                 tab.rollup(wm)
         if tab.dirty() and not allow_dirty:

@@ -264,7 +264,7 @@ class TabletMap(dict):
             if tab is None:
                 continue
             if tab.dirty():
-                tab.rollup(self.db.coordinator.min_active_ts())
+                tab.rollup(self.db.fold_watermark())
             if not tab.dirty() and (
                     self._saved_ts.get(pred) != tab.base_ts
                     or pred not in self.stored):

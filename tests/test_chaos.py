@@ -587,3 +587,212 @@ def test_bank_split_across_groups_survives_clock_skew():
                 p.kill()
         for p in procs.values():
             p.wait()
+
+
+def test_bank_mixed_commit_now_and_2pc_transfers():
+    """Mixed traffic on ONE group: single-group commit-now upsert
+    transfers (bal_m <-> bal_m on group 1) interleave with cross-group
+    2PC transfers (bal_m on group 1 <-> bal_n on group 2), plus a
+    leader SIGKILL. The reference cannot misorder these — everything
+    flows through one Raft log (ref worker/draft.go:435
+    processApplyCh); here the commit path must drain decided
+    lower-ts 2PC fragments between ts reservation and apply.
+    Checks: the conserved-total invariant at pinned snapshots, ZERO
+    out-of-order apply errors, and no wedged pending stage once the
+    workload stops."""
+    ports = _free_ports(12)
+    procs = {}
+    clients = []
+    try:
+        zero_spec = f"1=127.0.0.1:{ports[1]}"
+        procs["z1"] = _spawn("zero", 1, f"1=127.0.0.1:{ports[0]}",
+                             f"127.0.0.1:{ports[1]}")
+        # group 1 has THREE replicas: it loses its leader and the two
+        # survivors must still hold a quorum
+        g1_peers = (f"1=127.0.0.1:{ports[2]},2=127.0.0.1:{ports[3]},"
+                    f"3=127.0.0.1:{ports[10]}")
+        procs["a1"] = _spawn("alpha", 1, g1_peers,
+                             f"127.0.0.1:{ports[4]}", 1, zero_spec)
+        procs["a2"] = _spawn("alpha", 2, g1_peers,
+                             f"127.0.0.1:{ports[5]}", 1, zero_spec)
+        procs["a3"] = _spawn("alpha", 3, g1_peers,
+                             f"127.0.0.1:{ports[11]}", 1, zero_spec)
+        procs["b1"] = _spawn("alpha", 1, f"1=127.0.0.1:{ports[6]}",
+                             f"127.0.0.1:{ports[7]}", 2, zero_spec)
+
+        zc = ClusterClient({1: ("127.0.0.1", ports[1])}, timeout=30.0)
+        g1 = ClusterClient({1: ("127.0.0.1", ports[4]),
+                            2: ("127.0.0.1", ports[5]),
+                            3: ("127.0.0.1", ports[11])}, timeout=30.0)
+        g2 = ClusterClient({1: ("127.0.0.1", ports[7])}, timeout=30.0)
+        clients += [zc, g1, g2]
+        rc = RoutedCluster(zc, {1: g1, 2: g2})
+        for cl in (zc, g1, g2):
+            _wait_role(cl)
+
+        rc.alter("bal_m: int .\nbal_n: int .")
+        zc.tablet("bal_m", 1)
+        zc.tablet("bal_n", 2)
+        uids = []
+        for i in range(N_ACCOUNTS):
+            out = g1.mutate(set_nquads=f'_:a <bal_m> "{OPENING}" .')
+            u = list(out["uids"].values())[0]
+            g2.mutate(set_nquads=f'<{u}> <bal_n> "{OPENING}" .')
+            uids.append(u)
+        grand_total = N_ACCOUNTS * OPENING * 2
+
+        stop = threading.Event()
+        errors: list[str] = []
+        fatal: list[str] = []
+        done = {"local": 0, "x": 0}
+
+        def _check_fatal(e):
+            if "out-of-order" in str(e):
+                fatal.append(str(e))
+
+        def local_loop(seed):
+            # commit-now RMW transfers entirely inside group 1
+            import random
+            rng = random.Random(seed)
+            while not stop.is_set():
+                a, b = rng.sample(uids, 2)
+                amt = rng.randrange(1, 10)
+                q = ('{ a as var(func: uid(%s)) { ab as bal_m '
+                     'na as math(ab - %d) } '
+                     'b as var(func: uid(%s)) { bb as bal_m '
+                     'nb as math(bb + %d) } }' % (a, amt, b, amt))
+                try:
+                    g1.mutate(query=q,
+                              set_nquads='uid(a) <bal_m> val(na) .\n'
+                                         'uid(b) <bal_m> val(nb) .')
+                    done["local"] += 1
+                except RuntimeError as e:
+                    _check_fatal(e)
+                # yield the write lock: python locks are unfair, and a
+                # saturating commit-now loop starves the 2PC stages
+                # whose interleaving this test exists to produce
+                time.sleep(0.01)
+
+        def read_bal(cl, uid, pred, ts):
+            got = cl._unwrap(cl.request(
+                {"op": "query", "read_ts": ts,
+                 "q": '{ q(func: uid(%s)) { %s } }' % (uid, pred)}))
+            rows = got["data"]["q"]
+            return rows[0][pred] if rows else None
+
+        def x_loop(seed):
+            # snapshot-isolated cross-group 2PC transfers
+            import random
+            rng = random.Random(seed)
+            while not stop.is_set():
+                a, b = rng.sample(uids, 2)
+                amt = rng.randrange(1, 10)
+                try:
+                    start_ts = zc.assign_ts(1)
+                    x = read_bal(g1, a, "bal_m", start_ts)
+                    y = read_bal(g2, b, "bal_n", start_ts)
+                    if x is None or y is None:
+                        continue
+                    rc.mutate(start_ts=start_ts,
+                              set_nquads=(
+                                  f'<{a}> <bal_m> "{x - amt}" .\n'
+                                  f'<{b}> <bal_n> "{y + amt}" .'))
+                    done["x"] += 1
+                except RuntimeError as e:
+                    _check_fatal(e)
+
+        def reader_loop():
+            while not stop.is_set():
+                try:
+                    ts = zc.assign_ts(1)
+                    got_m = g1._unwrap(g1.request(
+                        {"op": "query", "read_ts": ts,
+                         "q": '{ q(func: has(bal_m)) { bal_m } }'}))
+                    got_n = g2._unwrap(g2.request(
+                        {"op": "query", "read_ts": ts,
+                         "q": '{ q(func: has(bal_n)) { bal_n } }'}))
+                    rm = got_m["data"]["q"]
+                    rn = got_n["data"]["q"]
+                    if len(rm) == N_ACCOUNTS and len(rn) == N_ACCOUNTS:
+                        total = sum(r["bal_m"] for r in rm) + \
+                            sum(r["bal_n"] for r in rn)
+                        if total != grand_total:
+                            errors.append(
+                                f"invariant broken at ts {ts}: {total}")
+                            return
+                except RuntimeError as e:
+                    _check_fatal(e)
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=local_loop, args=(s,),
+                                    daemon=True) for s in (31, 32)]
+        threads += [threading.Thread(target=x_loop, args=(s,),
+                                     daemon=True) for s in (41, 42)]
+        threads.append(threading.Thread(target=reader_loop, daemon=True))
+        for t in threads:
+            t.start()
+
+        # nemesis: SIGKILL group 1's leader mid-flow; stages recover
+        # via the replicated xstage + zero's decision registry
+        deadline = time.time() + 30
+        while time.time() < deadline and not errors and not fatal \
+                and (done["local"] < 10 or done["x"] < 10):
+            time.sleep(0.25)
+        leader = _wait_role(g1)
+        victim = {1: "a1", 2: "a2", 3: "a3"}[leader]
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait()
+        g1.remove_node(leader)
+        _wait_role(g1)
+
+        deadline = time.time() + 20
+        mark_l, mark_x = done["local"], done["x"]
+        while time.time() < deadline and not errors and not fatal \
+                and (done["local"] <= mark_l or done["x"] <= mark_x):
+            time.sleep(0.25)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        assert not fatal, f"out-of-order applies: {fatal}"
+        assert not errors, errors
+        assert done["local"] > 10 and done["x"] > 10, \
+            f"workload starved: {done}"
+
+        # no wedged pending stage: every staged fragment must resolve
+        # (decided ones applied, nothing stuck erroring forever)
+        end = time.monotonic() + 20
+        pend = None
+        while time.monotonic() < end:
+            try:
+                leader = _wait_role(g1)
+                pend = g1.status(leader).get("pending")
+                if not pend:
+                    break
+                # nudge reconciliation: any pinned-read query drains
+                ts = zc.assign_ts(1)
+                g1.request({"op": "query", "read_ts": ts,
+                            "q": '{ q(func: has(bal_m)) { bal_m } }'})
+            except (ConnectionError, RuntimeError, KeyError):
+                pass
+            time.sleep(0.25)
+        assert not pend, f"wedged pending stages: {pend}"
+
+        ts = zc.assign_ts(1)
+        got_m = g1._unwrap(g1.request(
+            {"op": "query", "read_ts": ts,
+             "q": '{ q(func: has(bal_m)) { bal_m } }'}))
+        got_n = g2._unwrap(g2.request(
+            {"op": "query", "read_ts": ts,
+             "q": '{ q(func: has(bal_n)) { bal_n } }'}))
+        total = sum(r["bal_m"] for r in got_m["data"]["q"]) + \
+            sum(r["bal_n"] for r in got_n["data"]["q"])
+        assert total == grand_total
+    finally:
+        for cl in clients:
+            cl.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs.values():
+            p.wait()
