@@ -46,6 +46,10 @@ _NAME_TO_TYPE = {
     "string": TypeID.STRING,
 }
 _TYPE_TO_NAME = {v: k for k, v in _NAME_TO_TYPE.items()}
+# parse-only alias: the reference's schemas spell it `dateTime`
+# (dgo schemas say `dob: dateTime @index(year)`); added after
+# _TYPE_TO_NAME so the emitted canonical name stays "datetime"
+_NAME_TO_TYPE["dateTime"] = TypeID.DATETIME
 
 
 _SCRYPT_N, _SCRYPT_R, _SCRYPT_P = 2 ** 12, 8, 1

@@ -87,7 +87,8 @@ def _handlers(alpha: AlphaServer) -> dict:
             "CheckVersion": check_version}
 
 
-_PB_SERVICE = "dgraph_tpu.api.Dgraph"
+_PB_SERVICE = "api.Dgraph"  # the reference's published service path
+                            # (/api.Dgraph/Query ... — dgo/pydgraph)
 
 
 def _pb_wrap(fn):
@@ -107,20 +108,130 @@ def _strip_dollar(vars_map) -> dict:
             for k, v in dict(vars_map).items()}
 
 
+def _rdf_escape(s: str) -> str:
+    return (s.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n").replace("\t", "\\t")
+            .replace("\r", "\\r"))
+
+
+def _go_time_decode(data: bytes) -> str:
+    """Go time.Time.MarshalBinary -> RFC3339 text. dgo clients build
+    DatetimeVal/DATETIME facets with exactly these bytes (ref
+    types/conversion.go DateTimeID arm); layout: version byte (1|2),
+    int64 BE seconds since year 1, int32 BE nanos, int16 BE zone
+    offset in minutes (-1 = UTC)."""
+    import struct
+    from datetime import datetime, timedelta, timezone
+    if len(data) < 15 or data[0] not in (1, 2):
+        # lenient fallback: some clients send RFC3339 text bytes
+        return data.decode()
+    sec, nsec, off = struct.unpack(">xqih", data[:15])
+    unix = sec - 62135596800  # year 1 -> unix epoch
+    tz = timezone.utc if off in (-1, 0) \
+        else timezone(timedelta(minutes=off))
+    dt = datetime.fromtimestamp(unix, tz) + timedelta(
+        microseconds=nsec // 1000)
+    return dt.isoformat()
+
+
+def _pb_value_literal(v) -> str:
+    """api.Value -> RDF object literal (typed per the oneof arm, the
+    inverse of chunker/rdf_parser.go's typed-literal handling)."""
+    import base64 as _b64
+    which = v.WhichOneof("val")
+    if which is None or which == "default_val":
+        return f'"{_rdf_escape(v.default_val)}"'
+    if which == "str_val":
+        return f'"{_rdf_escape(v.str_val)}"'
+    if which == "int_val":
+        return f'"{v.int_val}"^^<xs:int>'
+    if which == "bool_val":
+        return f'"{"true" if v.bool_val else "false"}"^^<xs:boolean>'
+    if which == "double_val":
+        return f'"{v.double_val!r}"^^<xs:float>'
+    if which == "password_val":
+        return f'"{_rdf_escape(v.password_val)}"^^<xs:password>'
+    if which == "geo_val":
+        return f'"{_rdf_escape(v.geo_val.decode())}"^^<geo:geojson>'
+    if which == "date_val":
+        return (f'"{_rdf_escape(_go_time_decode(v.date_val))}"'
+                '^^<xs:date>')
+    if which == "datetime_val":
+        return (f'"{_rdf_escape(_go_time_decode(v.datetime_val))}"'
+                '^^<xs:dateTime>')
+    if which == "bytes_val":
+        return (f'"{_b64.b64encode(v.bytes_val).decode()}"'
+                '^^<xs:base64Binary>')
+    if which == "uid_val":
+        return f"<{hex(v.uid_val)}>"
+    raise ValueError(f"unsupported Value arm {which!r}")
+
+
+def _pb_facet_literal(f, pb) -> str:
+    """api.Facet value bytes -> facet literal text. dgraph's facet
+    values travel BINARY-encoded (types/conversion.go Marshal to
+    BinaryID: int64/float64 little-endian, bool one byte, datetime
+    Go MarshalBinary); text is accepted too for lenient clients."""
+    import struct
+    raw = bytes(f.value)
+    if f.val_type == pb.Facet.INT:
+        if len(raw) == 8:
+            return str(struct.unpack("<q", raw)[0])
+        return str(int(raw.decode()))
+    if f.val_type == pb.Facet.FLOAT:
+        if len(raw) == 8:
+            return repr(struct.unpack("<d", raw)[0])
+        return raw.decode()
+    if f.val_type == pb.Facet.BOOL:
+        if len(raw) == 1 and raw[0] in (0, 1):
+            return "true" if raw[0] else "false"
+        return "true" if raw.decode().lower() in ("true", "1") \
+            else "false"
+    if f.val_type == pb.Facet.DATETIME:
+        return f'"{_rdf_escape(_go_time_decode(raw))}"'
+    # STRING renders quoted; the parser re-infers
+    return f'"{_rdf_escape(raw.decode())}"'
+
+
+def _pb_nquads_rdf(nqs, pb) -> str:
+    """api.NQuad list -> RDF lines the chunker grammar accepts (the
+    structured-mutation arm of the dgo contract: Mutation.set/del)."""
+    lines = []
+    for nq in nqs:
+        subj = nq.subject if nq.subject.startswith(("_:", "uid(")) \
+            else f"<{nq.subject}>"
+        if nq.object_id:
+            if nq.object_id in ("_STAR_ALL", "*"):
+                obj = "*"
+            elif nq.object_id.startswith(("_:", "uid(")):
+                obj = nq.object_id
+            else:
+                obj = f"<{nq.object_id}>"
+        else:
+            obj = _pb_value_literal(nq.object_value)
+            if nq.lang:
+                obj += f"@{nq.lang}"
+        line = f"{subj} <{nq.predicate}> {obj}"
+        if nq.facets:
+            inner = ", ".join(
+                f"{f.key}={_pb_facet_literal(f, pb)}" for f in nq.facets)
+            line += f" ({inner})"
+        lines.append(line + " .")
+    return "\n".join(lines)
+
+
 def _pb_handlers(alpha: AlphaServer) -> dict:
-    """The protobuf api.Dgraph service (proto/api.proto) — same
-    transport-independent AlphaServer handlers as HTTP and the
-    wire-dict service, protobuf messages on the wire so clients in
-    any language generate from the .proto (ref alpha/run.go:362
-    registering api.Dgraph; edgraph/server.go:634 doQuery)."""
+    """The protobuf api.Dgraph service (proto/api.proto — the dgo/v2
+    public contract, field numbers included) — same transport-
+    independent AlphaServer handlers as HTTP and the wire-dict
+    service, so stock dgo/pydgraph clients work against this server
+    (ref alpha/run.go:362 registering api.Dgraph;
+    edgraph/server.go:634 doQuery)."""
     import json
 
     from dgraph_tpu.proto import api_pb2 as pb
 
     def token_of(req, context):
-        tok = getattr(req, "access_jwt", "")
-        if tok:
-            return tok
         md = dict(context.invocation_metadata() or ())
         return md.get("accessjwt", "")
 
@@ -145,11 +256,15 @@ def _pb_handlers(alpha: AlphaServer) -> dict:
             "userid": req.userid, "password": req.password,
             "refresh_token": req.refresh_token})
         data = out.get("data", {})
-        return pb.Response(
+        # the dgo contract ships the Jwt SERIALIZED inside
+        # Response.json (edgraph/access_ee.go:91 marshals api.Jwt
+        # into resp.Json); dgo/pydgraph parse it from there
+        jwt = pb.Jwt(
             access_jwt=data.get("accessJwt", "")
             or data.get("accessJWT", ""),
             refresh_jwt=data.get("refreshJwt", "")
             or data.get("refreshJWT", ""))
+        return pb.Response(json=jwt.SerializeToString())
 
     def query(req, context):
         token = token_of(req, context)
@@ -170,10 +285,23 @@ def _pb_handlers(alpha: AlphaServer) -> dict:
                     d["set"] = json.loads(m.set_json.decode())
                 if m.delete_json:
                     d["delete"] = json.loads(m.delete_json.decode())
-                if m.set_nquads:
-                    d["setNquads"] = m.set_nquads.decode()
-                if m.del_nquads:
-                    d["delNquads"] = m.del_nquads.decode()
+                set_rdf = m.set_nquads.decode() if m.set_nquads else ""
+                del_rdf = m.del_nquads.decode() if m.del_nquads else ""
+                # structured NQuads (dgo's api.NQuad arm) join the
+                # text arm as RDF lines
+                m_del = getattr(m, "del")  # python keyword
+                if m.set:
+                    set_rdf = "\n".join(
+                        x for x in (set_rdf, _pb_nquads_rdf(m.set, pb))
+                        if x)
+                if m_del:
+                    del_rdf = "\n".join(
+                        x for x in (del_rdf, _pb_nquads_rdf(m_del, pb))
+                        if x)
+                if set_rdf:
+                    d["setNquads"] = set_rdf
+                if del_rdf:
+                    d["delNquads"] = del_rdf
                 if m.cond:
                     d["cond"] = m.cond
                 return d
@@ -186,7 +314,9 @@ def _pb_handlers(alpha: AlphaServer) -> dict:
                 env["query"] = req.query
                 if req.vars:
                     env["variables"] = _strip_dollar(req.vars)
-            params["commitNow"] = "true" if req.commit_now else "false"
+            commit_now = req.commit_now or any(
+                m.commit_now for m in req.mutations)
+            params["commitNow"] = "true" if commit_now else "false"
             out = alpha.handle_mutate(
                 json.dumps(env).encode(), "application/json",
                 params, token)
@@ -211,25 +341,29 @@ def _pb_handlers(alpha: AlphaServer) -> dict:
 
     def alter(req, context):
         token = token_of(req, context)
-        if req.drop_all:
+        if req.drop_all or req.drop_op == pb.Operation.ALL:
             body = json.dumps({"drop_all": True}).encode()
         elif req.drop_attr:
             body = json.dumps({"drop_attr": req.drop_attr}).encode()
-        elif req.drop_value:
+        elif req.drop_op == pb.Operation.ATTR:
+            body = json.dumps({"drop_attr": req.drop_value}).encode()
+        elif req.drop_op != pb.Operation.NONE or req.drop_value:
             raise ValueError(
-                "drop_value is not supported by this server; use "
+                "this drop_op is not supported by this server; use "
                 "drop_attr or drop_all")
         else:
             body = req.schema.encode()
         alpha.handle_alter(body, token)
-        return pb.Payload(data=b"Success")
+        return pb.Payload(Data=b"Success")
 
     def commit_or_abort(req, context):
+        # dgo semantics: CommitOrAbort COMMITS unless the context's
+        # aborted flag is set (txn.Discard sends aborted=true;
+        # edgraph/server.go:920 CommitOrAbort)
         token = token_of(req, context)
-        abort = req.aborted or not req.commit
         out = alpha.handle_commit(
             {"startTs": str(req.start_ts),
-             "abort": "true" if abort else "false"}, token)
+             "abort": "true" if req.aborted else "false"}, token)
         return _txn_ctx(out.get("extensions", {}))
 
     def check_version(req, context):

@@ -123,7 +123,7 @@ class _PbClient:
         from dgraph_tpu.proto import api_pb2 as pb
         self.pb = pb
         self.channel = grpc.insecure_channel(addr)
-        svc = "dgraph_tpu.api.Dgraph"
+        svc = "api.Dgraph"  # the published service path
         out = {"Login": pb.Response, "Query": pb.Response,
                "Alter": pb.Payload, "CommitOrAbort": pb.TxnContext,
                "CheckVersion": pb.Version}
@@ -191,8 +191,8 @@ def test_pb_txn_commit_flow(pbc):
     got = pbc.stubs["Query"](pb.Request(
         query='{ q(func: eq(pname, "pb-txn")) { pname } }'))
     assert json.loads(got.json) == {"q": []}
-    ctx = pbc.stubs["CommitOrAbort"](pb.TxnContext(start_ts=ts,
-                                                   commit=True))
+    # dgo semantics: CommitOrAbort commits unless aborted is set
+    ctx = pbc.stubs["CommitOrAbort"](pb.TxnContext(start_ts=ts))
     assert ctx.commit_ts > 0 and not ctx.aborted
     got = pbc.stubs["Query"](pb.Request(
         query='{ q(func: eq(pname, "pb-txn")) { pname } }'))
@@ -292,3 +292,216 @@ def test_pb_multi_mutation_upsert(pbc):
     got = pbc.stubs["Query"](pb.Request(
         query='{ q(func: eq(pname, "pb-multi")) { pbal } }'))
     assert json.loads(got.json) == {"q": [{"pbal": 1}]}
+
+
+# ------------------------------------------------------- stock-client frames
+
+def _tag(n, wt):
+    return bytes([(n << 3) | wt])
+
+
+def _varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _ld(n, payload: bytes) -> bytes:
+    return _tag(n, 2) + _varint(len(payload)) + payload
+
+
+class _DgoFrames:
+    """Byte-level encoder using the PUBLISHED dgo/v2 api.proto field
+    numbers, written independently of this repo's generated module —
+    these frames are exactly what a stock dgo/pydgraph client puts on
+    the wire (ref go.mod pin dgo/v2 v2.1.1; run.go:362 api.Dgraph)."""
+
+    @staticmethod
+    def request(query=b"", start_ts=0, vars=None, mutations=(),
+                commit_now=False) -> bytes:
+        out = b""
+        if start_ts:
+            out += _tag(1, 0) + _varint(start_ts)      # start_ts = 1
+        if query:
+            out += _ld(4, query)                       # query = 4
+        for k, v in (vars or {}).items():              # vars = 5
+            out += _ld(5, _ld(1, k.encode()) + _ld(2, v.encode()))
+        for m in mutations:                            # mutations = 12
+            out += _ld(12, m)
+        if commit_now:
+            out += _tag(13, 0) + b"\x01"               # commit_now = 13
+        return out
+
+    @staticmethod
+    def mutation(set_nquads=b"", del_nquads=b"", cond=b"") -> bytes:
+        out = b""
+        if set_nquads:
+            out += _ld(3, set_nquads)                  # set_nquads = 3
+        if del_nquads:
+            out += _ld(4, del_nquads)                  # del_nquads = 4
+        if cond:
+            out += _ld(9, cond)                        # cond = 9
+        return out
+
+    @staticmethod
+    def operation(schema=b"") -> bytes:
+        return _ld(1, schema)                          # schema = 1
+
+    @staticmethod
+    def txn_context(start_ts, aborted=False) -> bytes:
+        out = _tag(1, 0) + _varint(start_ts)           # start_ts = 1
+        if aborted:
+            out += _tag(3, 0) + b"\x01"                # aborted = 3
+        return out
+
+    @staticmethod
+    def fields(data: bytes):
+        """Decode one message level -> {field#: [values]}."""
+        out, i = {}, 0
+        while i < len(data):
+            key = data[i]
+            num, wt = key >> 3, key & 7
+            i += 1
+            if wt == 0:
+                v, shift = 0, 0
+                while True:
+                    b = data[i]
+                    i += 1
+                    v |= (b & 0x7F) << shift
+                    shift += 7
+                    if not b & 0x80:
+                        break
+            elif wt == 2:
+                ln, shift = 0, 0
+                while True:
+                    b = data[i]
+                    i += 1
+                    ln |= (b & 0x7F) << shift
+                    shift += 7
+                    if not b & 0x80:
+                        break
+                v = data[i:i + ln]
+                i += ln
+            else:
+                raise AssertionError(f"wire type {wt}")
+            out.setdefault(num, []).append(v)
+        return out
+
+
+def test_stock_dgo_frames_end_to_end():
+    """A stock dgo/pydgraph client session — alter, commit-now
+    mutation, query with vars, interactive txn + CommitOrAbort —
+    hand-encoded with the published field numbers and raw bytes on
+    both directions (identity serializers), so any mismatch with the
+    dgo contract fails loudly."""
+    import json
+    alpha = AlphaServer()
+    server, port = serve_grpc(alpha, port=0)
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    ident = lambda b: b  # noqa: E731
+    call = {
+        name: ch.unary_unary(f"/api.Dgraph/{name}",
+                             request_serializer=ident,
+                             response_deserializer=ident)
+        for name in ("Query", "Alter", "CommitOrAbort", "CheckVersion")
+    }
+    F = _DgoFrames
+    try:
+        call["Alter"](F.operation(
+            b"dgo_name: string @index(exact) .\ndgo_bal: int ."))
+
+        # commit-now mutation; Response.uids is map field 12
+        resp = F.fields(call["Query"](F.request(
+            mutations=[F.mutation(
+                set_nquads=b'_:a <dgo_name> "stock" .\n'
+                           b'_:a <dgo_bal> "3" .')],
+            commit_now=True)))
+        assert 12 in resp, "no uids map in Response (field 12)"
+
+        # query with a GraphQL var; json rides field 1
+        got = F.fields(call["Query"](F.request(
+            query=b'query q($n: string) '
+                  b'{ q(func: eq(dgo_name, $n)) { dgo_bal } }',
+            vars={"$n": "stock"})))
+        assert json.loads(got[1][0]) == {"q": [{"dgo_bal": 3}]}
+
+        # interactive txn: stage, read-own-writes invisible outside,
+        # CommitOrAbort WITHOUT aborted commits (dgo semantics)
+        staged = F.fields(call["Query"](F.request(
+            mutations=[F.mutation(
+                set_nquads=b'_:t <dgo_name> "stock-txn" .')])))
+        txn = F.fields(staged[2][0])        # Response.txn = 2
+        start_ts = txn[1][0]                # TxnContext.start_ts = 1
+        assert start_ts > 0
+        ctx = F.fields(call["CommitOrAbort"](F.txn_context(start_ts)))
+        assert ctx.get(2, [0])[0] > 0       # commit_ts = 2
+        assert not ctx.get(3)               # aborted = 3 unset
+        got = F.fields(call["Query"](F.request(
+            query=b'{ q(func: eq(dgo_name, "stock-txn")) '
+                  b'{ dgo_name } }')))
+        assert json.loads(got[1][0]) == {"q": [{"dgo_name":
+                                                "stock-txn"}]}
+
+        # abort path: aborted=true discards
+        staged = F.fields(call["Query"](F.request(
+            mutations=[F.mutation(
+                set_nquads=b'_:t <dgo_name> "stock-gone" .')])))
+        ts2 = F.fields(staged[2][0])[1][0]
+        call["CommitOrAbort"](F.txn_context(ts2, aborted=True))
+        got = F.fields(call["Query"](F.request(
+            query=b'{ q(func: eq(dgo_name, "stock-gone")) '
+                  b'{ dgo_name } }')))
+        assert json.loads(got[1][0]) == {"q": []}
+
+        v = F.fields(call["CheckVersion"](b""))
+        assert v[1][0].startswith(b"dgraph-tpu-")
+    finally:
+        ch.close()
+        server.stop(0)
+
+
+def test_pb_structured_nquads_with_go_binary_values(pbc):
+    """dgo's structured-mutation arm: api.NQuad values with Go binary
+    encodings — DatetimeVal carries time.Time.MarshalBinary bytes and
+    INT facets carry 8-byte little-endian int64 (ref
+    types/conversion.go Marshal to BinaryID)."""
+    import json
+    import struct
+    pb = pbc.pb
+    # Go time.MarshalBinary for 2020-01-02T03:04:05Z: version byte 1,
+    # int64 BE seconds since year 1, int32 BE nanos, int16 BE -1 (UTC)
+    unix = 1577934245  # 2020-01-02T03:04:05Z
+    gobin = struct.pack(">bqih", 1, unix + 62135596800, 0, -1)
+    m = pb.Mutation()
+    nq = m.set.add()
+    nq.subject = "_:ev"
+    nq.predicate = "pname"
+    nq.object_value.str_val = "pb-binary"
+    nq2 = m.set.add()
+    nq2.subject = "_:ev"
+    nq2.predicate = "pwhen"
+    nq2.object_value.datetime_val = gobin
+    nq3 = m.set.add()
+    nq3.subject = "_:ev"
+    nq3.predicate = "pbal"
+    nq3.object_value.int_val = 11
+    f = nq3.facets.add()
+    f.key = "weight"
+    f.val_type = pb.Facet.INT
+    f.value = struct.pack("<q", 40)
+    pbc.stubs["Alter"](pb.Operation(schema="pwhen: dateTime ."))
+    resp = pbc.stubs["Query"](pb.Request(mutations=[m],
+                                         commit_now=True))
+    assert resp.uids
+    got = pbc.stubs["Query"](pb.Request(
+        query='{ q(func: eq(pname, "pb-binary")) '
+              '{ pname pwhen pbal @facets(weight) } }'))
+    row = json.loads(got.json)["q"][0]
+    assert row["pname"] == "pb-binary"
+    assert row["pwhen"].startswith("2020-01-02T03:04:05")
+    assert row["pbal"] == 11
+    assert row["pbal|weight"] == 40
