@@ -129,7 +129,8 @@ def cmd_alpha(args) -> int:
         tls_ctx = server_context(args.tls_dir,
                                  require_client_cert=args.tls_mtls)
     httpd, alpha = serve(db, host=args.host, port=args.port, block=False,
-                         acl_secret=secret, tls_context=tls_ctx)
+                         acl_secret=secret, tls_context=tls_ctx,
+                         mutations_mode=args.mutations)
     grpc_srv = None
     if args.grpc_port:
         from dgraph_tpu.server.grpc_api import serve_grpc
@@ -715,6 +716,10 @@ def main(argv=None) -> int:
     a.add_argument("--snapshot", default="")
     a.add_argument("--no-device", action="store_true",
                    default=False)
+    a.add_argument("--mutations", default="allow",
+                   choices=["allow", "disallow", "strict"],
+                   help="mutation mode (ref --mutations, "
+                        "alpha/run.go:502)")
     a.add_argument("--acl_secret_file",
                    default="",
                    help="enables ACL; file holds the HMAC jwt secret")

@@ -153,7 +153,7 @@ def nquad_predicates(set_nq: str = "", del_nq: str = "",
                 preds.add(nq.predicate)
     for j, deletion in ((set_json, False), (delete_json, True)):
         if j is not None:
-            for nq in parse_json_mutation(j, deletion=deletion):
+            for nq in parse_json_mutation(j, delete=deletion):
                 preds.add(nq.predicate)
     preds.discard("*")
     return sorted(preds)

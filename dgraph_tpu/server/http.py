@@ -59,7 +59,16 @@ class AlphaServer:
 
     def __init__(self, db: Optional[GraphDB] = None,
                  txn_ttl_s: float = 300.0,
-                 acl_secret: Optional[bytes] = None):
+                 acl_secret: Optional[bytes] = None,
+                 mutations_mode: str = "allow"):
+        if mutations_mode not in ("allow", "disallow", "strict"):
+            raise ValueError(
+                "--mutations argument must be one of allow, disallow, "
+                "or strict")
+        # ref --mutations (alpha/run.go:502): disallow rejects every
+        # mutation and alter; strict rejects mutations naming
+        # predicates with no schema entry (worker/mutation.go:693)
+        self.mutations_mode = mutations_mode
         self.db = db or GraphDB()
         from dgraph_tpu.utils.rwlock import RWLock
         self.rw = RWLock()
@@ -194,9 +203,22 @@ class AlphaServer:
             raise RuntimeError(
                 "the server is in draining mode; write operations are "
                 "rejected")
+        if self.mutations_mode == "disallow":
+            raise ValueError("no mutations allowed")
         commit_now = params.get("commitNow", "false") == "true"
         start_ts = int(params.get("startTs", 0))
         muts, query, variables = _parse_mutation_body(body, content_type)
+        if self.mutations_mode == "strict":
+            from dgraph_tpu.server.acl import nquad_predicates
+            for mut in muts:
+                for pred in nquad_predicates(
+                        mut.set_nquads, mut.del_nquads,
+                        mut.set_json, mut.delete_json):
+                    pred = pred.lstrip("~")
+                    if pred != "*" and not self.db.schema.has(pred):
+                        raise ValueError(
+                            "Schema not defined for predicate: "
+                            f"{pred}.")
         owner = None
         if self.acl is not None:
             from dgraph_tpu.gql import parse as gql_parse
@@ -298,6 +320,10 @@ class AlphaServer:
             raise RuntimeError(
                 "the server is in draining mode; write operations are "
                 "rejected")
+        if self.mutations_mode == "disallow":
+            # the reference gates Alter behind the same check
+            # (edgraph/server.go:99 isMutationAllowed)
+            raise ValueError("no mutations allowed")
         text = body.decode()
         drop_all = False
         drop_attr = ""
@@ -665,13 +691,14 @@ class _Handler(BaseHTTPRequestHandler):
 def serve(db: Optional[GraphDB] = None, host: str = "127.0.0.1",
           port: int = 8080, block: bool = True,
           acl_secret: Optional[bytes] = None,
-          tls_context=None
+          tls_context=None, mutations_mode: str = "allow"
           ) -> tuple[ThreadingHTTPServer, AlphaServer]:
     """Start the Alpha HTTP server. With block=False, runs in a daemon
     thread and returns (httpd, alpha) for tests/embedding. Pass an
     ssl.SSLContext (server/tls.py server_context) to serve HTTPS/mTLS
     like the reference's --tls options (x/tls_helper.go)."""
-    alpha = AlphaServer(db, acl_secret=acl_secret)
+    alpha = AlphaServer(db, acl_secret=acl_secret,
+                        mutations_mode=mutations_mode)
     handler = type("BoundHandler", (_Handler,), {"alpha": alpha})
     httpd = ThreadingHTTPServer((host, port), handler)
     if tls_context is not None:

@@ -224,3 +224,53 @@ def test_admin_export_needs_guardian():
     srv = AlphaServer(acl_secret=b"s")
     with pytest.raises(AclError):
         srv.handle_export({"destination": "/tmp/nope"}, token="")
+
+
+def test_mutation_modes():
+    """--mutations allow|disallow|strict (ref alpha/run.go:502;
+    strict check ref worker/mutation.go:693; disallow also gates
+    Alter, edgraph/server.go:99)."""
+    import pytest
+    from dgraph_tpu.server.http import AlphaServer
+
+    with pytest.raises(ValueError, match="allow, disallow, or strict"):
+        AlphaServer(mutations_mode="nope")
+
+    srv = AlphaServer(mutations_mode="disallow")
+    with pytest.raises(ValueError, match="no mutations allowed"):
+        srv.handle_mutate(b'_:a <name> "x" .', "application/rdf",
+                          {"commitNow": "true"})
+    with pytest.raises(ValueError, match="no mutations allowed"):
+        srv.handle_alter(b"name: string .")
+    # reads still work
+    assert srv.handle_query("{ q(func: has(name)) { name } }", {})
+
+    srv = AlphaServer(mutations_mode="strict")
+    srv.handle_alter(b"known: string @index(exact) .")
+    with pytest.raises(ValueError,
+                       match="Schema not defined for predicate: "
+                             "unknown_pred"):
+        srv.handle_mutate(b'_:a <unknown_pred> "x" .',
+                          "application/rdf", {"commitNow": "true"})
+    # JSON-body mutations go through the same strict gate (this path
+    # once crashed on a wrong parse_json_mutation keyword)
+    with pytest.raises(ValueError,
+                       match="Schema not defined for predicate: "
+                             "unknown_json"):
+        srv.handle_mutate(
+            json.dumps({"set": [{"unknown_json": "x"}]}).encode(),
+            "application/json", {"commitNow": "true"})
+    srv.handle_mutate(
+        json.dumps({"set": [{"known": "viajson"}]}).encode(),
+        "application/json", {"commitNow": "true"})
+    # known predicates pass, including via upsert envelopes
+    srv.handle_mutate(b'_:a <known> "ok" .', "application/rdf",
+                      {"commitNow": "true"})
+    out = srv.handle_query('{ q(func: eq(known, "ok")) { known } }', {})
+    assert out["data"]["q"] == [{"known": "ok"}]
+    # delete of a known pred and wildcard object both pass strict
+    srv.handle_mutate(
+        json.dumps({"delNquads": 'uid(u) <known> * .',
+                    "query": '{ u as var(func: eq(known, "ok")) }'}
+                   ).encode(),
+        "application/json", {"commitNow": "true"})
