@@ -208,28 +208,17 @@ class AlphaServer:
         commit_now = params.get("commitNow", "false") == "true"
         start_ts = int(params.get("startTs", 0))
         muts, query, variables = _parse_mutation_body(body, content_type)
-        if self.mutations_mode == "strict":
-            from dgraph_tpu.server.acl import nquad_predicates
-            for mut in muts:
-                for pred in nquad_predicates(
-                        mut.set_nquads, mut.del_nquads,
-                        mut.set_json, mut.delete_json):
-                    pred = pred.lstrip("~")
-                    if pred != "*" and not self.db.schema.has(pred):
-                        raise ValueError(
-                            "Schema not defined for predicate: "
-                            f"{pred}.")
         owner = None
-        if self.acl is not None:
-            from dgraph_tpu.gql import parse as gql_parse
-            from dgraph_tpu.server.acl import (
-                nquad_predicates, query_predicates,
-            )
-            preds = set()
+        preds: set[str] = set()
+        if self.acl is not None or self.mutations_mode == "strict":
+            from dgraph_tpu.server.acl import nquad_predicates
             for mut in muts:
                 preds |= set(nquad_predicates(
                     mut.set_nquads, mut.del_nquads,
                     mut.set_json, mut.delete_json))
+        if self.acl is not None:
+            from dgraph_tpu.gql import parse as gql_parse
+            from dgraph_tpu.server.acl import query_predicates
             with self.meta:
                 claims = self.acl.authorize(token)
                 owner = claims.get("userid", "")
@@ -245,6 +234,17 @@ class AlphaServer:
                     # are guessable sequential ints
                     self._check_txn_owner(start_ts, claims)
         with self.rw.write:
+            if self.mutations_mode == "strict":
+                # AFTER authorization (an unauthenticated client must
+                # not probe which predicates exist) and UNDER the
+                # write lock (a concurrent drop_attr/drop_all must not
+                # race this check; ref worker/mutation.go:693 checks
+                # in the worker, post-auth)
+                for pred in sorted(preds):
+                    if not self.db.schema.has(pred.lstrip("~")):
+                        raise ValueError(
+                            "Schema not defined for predicate: "
+                            f"{pred.lstrip('~')}.")
             with self.meta:
                 self._evict_idle()
                 created = False

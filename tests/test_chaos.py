@@ -8,6 +8,7 @@ bank group's leader is SIGKILLed. The invariant — total balance
 conserved at every snapshot — must hold through all of it.
 """
 
+import itertools
 import os
 import signal
 import socket
@@ -788,6 +789,289 @@ def test_bank_mixed_commit_now_and_2pc_transfers():
         total = sum(r["bal_m"] for r in got_m["data"]["q"]) + \
             sum(r["bal_n"] for r in got_n["data"]["q"])
         assert total == grand_total
+    finally:
+        for cl in clients:
+            cl.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs.values():
+            p.wait()
+
+
+def test_long_fork_under_move_and_leader_kill():
+    """Long-fork workload (ref contrib/jepsen/main.go:70): writers
+    bump DISTINCT monotone registers split across two groups;
+    readers take globally pinned snapshots of all of them. Under
+    snapshot isolation every snapshot tuple must be totally ordered —
+    two snapshots where one sees x's bump but not y's and the other
+    sees y's but not x's is the long-fork anomaly (PSI's signature
+    write-skew-on-read). Nemeses: tablet move + group-leader kill."""
+    ports = _free_ports(12)
+    procs = {}
+    clients = []
+    try:
+        zero_spec = f"1=127.0.0.1:{ports[1]}"
+        procs["z1"] = _spawn("zero", 1, f"1=127.0.0.1:{ports[0]}",
+                             f"127.0.0.1:{ports[1]}")
+        g1_peers = (f"1=127.0.0.1:{ports[2]},2=127.0.0.1:{ports[3]},"
+                    f"3=127.0.0.1:{ports[10]}")
+        procs["a1"] = _spawn("alpha", 1, g1_peers,
+                             f"127.0.0.1:{ports[4]}", 1, zero_spec)
+        procs["a2"] = _spawn("alpha", 2, g1_peers,
+                             f"127.0.0.1:{ports[5]}", 1, zero_spec)
+        procs["a3"] = _spawn("alpha", 3, g1_peers,
+                             f"127.0.0.1:{ports[11]}", 1, zero_spec)
+        procs["b1"] = _spawn("alpha", 1, f"1=127.0.0.1:{ports[6]}",
+                             f"127.0.0.1:{ports[7]}", 2, zero_spec)
+        zc = ClusterClient({1: ("127.0.0.1", ports[1])}, timeout=30.0)
+        g1 = ClusterClient({1: ("127.0.0.1", ports[4]),
+                            2: ("127.0.0.1", ports[5]),
+                            3: ("127.0.0.1", ports[11])}, timeout=30.0)
+        g2 = ClusterClient({1: ("127.0.0.1", ports[7])}, timeout=30.0)
+        clients += [zc, g1, g2]
+        rc = RoutedCluster(zc, {1: g1, 2: g2})
+        for cl in (zc, g1, g2):
+            _wait_role(cl)
+
+        rc.alter("lf_a: int .\nlf_b: int .\nmovable: string .")
+        zc.tablet("lf_a", 1)
+        zc.tablet("lf_b", 2)
+        zc.tablet("movable", 2)
+        # two registers per group
+        regs = []  # (group_client, pred, uid)
+        for pred, cl in (("lf_a", g1), ("lf_a", g1),
+                         ("lf_b", g2), ("lf_b", g2)):
+            out = cl.mutate(set_nquads=f'_:r <{pred}> "0" .')
+            regs.append((cl, pred, list(out["uids"].values())[0]))
+        rc.mutate(set_nquads='_:m <movable> "m0" .')
+
+        stop = threading.Event()
+        errors: list[str] = []
+        snaps: list[tuple] = []
+        writes = {"n": 0}
+
+        def writer_loop(idx):
+            cl, pred, uid = regs[idx]
+            v = 0
+            while not stop.is_set():
+                v += 1
+                try:
+                    cl.mutate(set_nquads=f'<{uid}> <{pred}> "{v}" .')
+                    writes["n"] += 1
+                except RuntimeError:
+                    v -= 1  # retry the same bump
+                time.sleep(0.002)
+
+        def reader_loop():
+            while not stop.is_set():
+                try:
+                    ts = zc.assign_ts(1)
+                    obs = []
+                    for cl, pred, uid in regs:
+                        got = cl._unwrap(cl.request(
+                            {"op": "query", "read_ts": ts,
+                             "q": '{ q(func: uid(%s)) { %s } }'
+                                  % (uid, pred)}))
+                        rows = got["data"]["q"]
+                        obs.append(rows[0][pred] if rows else 0)
+                    snaps.append(tuple(obs))
+                except RuntimeError:
+                    pass
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=writer_loop, args=(i,),
+                                    daemon=True) for i in range(4)]
+        threads += [threading.Thread(target=reader_loop, daemon=True)
+                    for _ in range(2)]
+        for t in threads:
+            t.start()
+
+        # nemesis 1: move a tablet between the groups mid-flow
+        time.sleep(1.0)
+        rc.move_tablet("movable", 1)
+        # nemesis 2: SIGKILL group 1's leader
+        leader = _wait_role(g1)
+        victim = {1: "a1", 2: "a2", 3: "a3"}[leader]
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait()
+        g1.remove_node(leader)
+        _wait_role(g1)
+
+        deadline = time.time() + 30
+        while time.time() < deadline and len(snaps) < 60:
+            time.sleep(0.25)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        assert writes["n"] > 40, "writers starved"
+        assert len(snaps) >= 20, "readers starved"
+        # checker: monotone registers => snapshots form a total order
+        for i, s in enumerate(snaps):
+            for t2 in snaps[i + 1:]:
+                le = all(a <= b for a, b in zip(s, t2))
+                ge = all(a >= b for a, b in zip(s, t2))
+                if not (le or ge):
+                    errors.append(f"long fork: {s} vs {t2}")
+        assert not errors, errors[:3]
+    finally:
+        for cl in clients:
+            cl.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs.values():
+            p.wait()
+
+
+def test_linearizable_register_under_pause_partition():
+    """Linearizable-register workload (ref contrib/jepsen/main.go:71):
+    unique-valued writes and pinned reads on ONE register while the
+    group leader is SIGSTOPped (a network-indistinguishable partition
+    of the leader) and later killed. Checker: (1) every read at ts T
+    returns the write holding the max commit_ts <= T (snapshot
+    correctness); (2) operations respect real time — if op1 completed
+    before op2 began, op1's ts <= op2's ts (the commit/read ts order
+    is a valid linearization)."""
+    ports = _free_ports(10)
+    procs = {}
+    clients = []
+    try:
+        zero_spec = f"1=127.0.0.1:{ports[1]}"
+        procs["z1"] = _spawn("zero", 1, f"1=127.0.0.1:{ports[0]}",
+                             f"127.0.0.1:{ports[1]}")
+        g1_peers = (f"1=127.0.0.1:{ports[2]},2=127.0.0.1:{ports[3]},"
+                    f"3=127.0.0.1:{ports[8]}")
+        procs["a1"] = _spawn("alpha", 1, g1_peers,
+                             f"127.0.0.1:{ports[4]}", 1, zero_spec)
+        procs["a2"] = _spawn("alpha", 2, g1_peers,
+                             f"127.0.0.1:{ports[5]}", 1, zero_spec)
+        procs["a3"] = _spawn("alpha", 3, g1_peers,
+                             f"127.0.0.1:{ports[9]}", 1, zero_spec)
+        zc = ClusterClient({1: ("127.0.0.1", ports[1])}, timeout=30.0)
+        g1 = ClusterClient({1: ("127.0.0.1", ports[4]),
+                            2: ("127.0.0.1", ports[5]),
+                            3: ("127.0.0.1", ports[9])}, timeout=30.0)
+        clients += [zc, g1]
+        for cl in (zc, g1):
+            _wait_role(cl)
+
+        g1.request({"op": "alter", "kw": {"schema_text": "lin_v: int ."}})
+        out = g1.mutate(set_nquads='_:r <lin_v> "0" .')
+        uid = list(out["uids"].values())[0]
+        first_ts = int(out["extensions"]["txn"]["commit_ts"])
+
+        stop = threading.Event()
+        # ops: ("w", invoke, complete, value, commit_ts)
+        #      ("r", invoke, complete, read_ts, value)
+        ops = []
+        ops_lock = threading.Lock()
+        ops.append(("w", 0.0, 0.0, 0, first_ts))
+        seq = itertools.count(1)
+
+        indeterminate: set[int] = set()
+
+        def writer_loop():
+            while not stop.is_set():
+                v = next(seq)
+                t0 = time.monotonic()
+                try:
+                    out = g1.mutate(
+                        set_nquads=f'<{uid}> <lin_v> "{v}" .')
+                    ts = int(out["extensions"]["txn"]["commit_ts"])
+                    with ops_lock:
+                        ops.append(("w", t0, time.monotonic(), v, ts))
+                except RuntimeError:
+                    # the write may still have committed (ack lost to
+                    # the nemesis): indeterminate, like Jepsen's :info
+                    # ops — a read returning it is legal
+                    with ops_lock:
+                        indeterminate.add(v)
+                time.sleep(0.005)
+
+        def reader_loop():
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    ts = zc.assign_ts(1)
+                    got = g1._unwrap(g1.request(
+                        {"op": "query", "read_ts": ts,
+                         "q": '{ q(func: uid(%s)) { lin_v } }' % uid}))
+                    v = got["data"]["q"][0]["lin_v"]
+                    with ops_lock:
+                        ops.append(("r", t0, time.monotonic(), ts, v))
+                except RuntimeError:
+                    pass
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=writer_loop, daemon=True)
+                   for _ in range(2)]
+        threads += [threading.Thread(target=reader_loop, daemon=True)
+                    for _ in range(2)]
+        for t in threads:
+            t.start()
+
+        # nemesis: SIGSTOP the leader (partition-equivalent: the node
+        # is alive but unreachable); survivors elect; then SIGCONT —
+        # the zombie leader must step down, not serve stale state
+        time.sleep(1.5)
+        leader = _wait_role(g1)
+        victim = {1: "a1", 2: "a2", 3: "a3"}[leader]
+        procs[victim].send_signal(signal.SIGSTOP)
+        time.sleep(3.0)
+        procs[victim].send_signal(signal.SIGCONT)
+        time.sleep(2.0)
+        # then a hard kill of the current leader
+        leader = _wait_role(g1)
+        victim = {1: "a1", 2: "a2", 3: "a3"}[leader]
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait()
+        g1.remove_node(leader)
+        _wait_role(g1)
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        writes = [(o[3], o[4]) for o in ops if o[0] == "w"]
+        reads = [o for o in ops if o[0] == "r"]
+        assert len(writes) > 10 and len(reads) > 10, \
+            f"history too thin: {len(writes)}w/{len(reads)}r"
+        by_ts = sorted(writes, key=lambda w: w[1])
+        # ts values unique across writes (zero's oracle is the point
+        # of serialization)
+        assert len({ts for _, ts in by_ts}) == len(by_ts)
+        # (1) snapshot correctness for every read
+        import bisect
+        wts = [ts for _, ts in by_ts]
+        bad = []
+        for _, _, _, rts, v in reads:
+            if v in indeterminate:
+                continue  # unacked write that did commit: legal
+            i = bisect.bisect_right(wts, rts) - 1
+            want = by_ts[i][0] if i >= 0 else 0
+            if v != want:
+                bad.append((rts, v, want))
+        assert not bad, f"non-linearizable reads: {bad[:3]}"
+        # (2) real-time order: an op invoked after another completed
+        # must carry a >= ts — sweep by invoke time against the max
+        # ts of everything completed before it
+        def ts_of(o):
+            return o[4] if o[0] == "w" else o[3]
+        by_invoke = sorted((o for o in ops if o[1] > 0.0),
+                           key=lambda o: o[1])
+        events = sorted(((o[2], ts_of(o)) for o in ops if o[1] > 0.0))
+        j = 0
+        run_max = 0
+        viol = []
+        for o in by_invoke:
+            while j < len(events) and events[j][0] < o[1]:
+                run_max = max(run_max, events[j][1])
+                j += 1
+            if ts_of(o) < run_max:
+                viol.append((o, run_max))
+        assert not viol, f"real-time violations: {viol[:3]}"
     finally:
         for cl in clients:
             cl.close()
