@@ -64,6 +64,7 @@ class DeviceAdjacency:
     buckets: list[AdjBucket] = field(default_factory=list)
     n_edges: int = 0
     n_dst: int = 0        # distinct destination uids (bounds any union)
+    n_src: int = 0        # real (unpadded) source count
 
     @property
     def shape_sig(self):
@@ -112,7 +113,7 @@ def build_adjacency(edges: dict[int, np.ndarray],
         n_dst = len(np.unique(np.concatenate(
             [np.asarray(v) for v in edges.values()])))
     return DeviceAdjacency(jnp.asarray(src_pad), jnp.asarray(deg_pad),
-                           buckets, n_edges, n_dst)
+                           buckets, n_edges, n_dst, len(srcs))
 
 
 def _bucket_candidates(frontier: jax.Array, b: AdjBucket) -> jax.Array:
@@ -216,6 +217,7 @@ class DeviceValues:
     ranks_sorted: jax.Array  # [N] int32 sorted
     uids_by_key: jax.Array   # [N] uint32 aligned to ranks_sorted
     host_keys: np.ndarray    # [U] int64 sorted unique raw keys (host)
+    n: int = 0               # real (unpadded) uid count
 
 
 def build_values(pairs: dict[int, int]) -> DeviceValues:
@@ -235,7 +237,7 @@ def build_values(pairs: dict[int, int]) -> DeviceValues:
     by_key = np.lexsort((uids, ranks))
     return DeviceValues(jnp.asarray(uids), jnp.asarray(ranks),
                         jnp.asarray(ranks[by_key]),
-                        jnp.asarray(uids[by_key]), host_keys)
+                        jnp.asarray(uids[by_key]), host_keys, n)
 
 
 def key_gather(dv: DeviceValues, uids: jax.Array,
@@ -274,6 +276,16 @@ def multisort(cand: jax.Array, dv_uids: tuple, dv_ranks: tuple,
     values keep RANK_MISSING so they sink last under asc AND desc
     (the host path's missing-flag-dominates rule); SENTINEL padding
     sinks below real uids via the uid operand."""
+    cols = _rank_cols(cand, dv_uids, dv_ranks, descs)
+    out = jax.lax.sort(tuple(cols) + (cand,), num_keys=len(cols) + 1)
+    return out[-1]
+
+
+def _rank_cols(cand: jax.Array, dv_uids: tuple, dv_ranks: tuple,
+               descs: tuple) -> list:
+    """Per-order-attr rank columns aligned with `cand` (missing values
+    keep RANK_MISSING so they sink last under asc AND desc — the host
+    path's missing-flag-dominates rule)."""
     cols = []
     for du, dr, desc in zip(dv_uids, dv_ranks, descs):
         idx = jnp.clip(lookup_idx(du, cand), 0, du.shape[0] - 1)
@@ -282,8 +294,80 @@ def multisort(cand: jax.Array, dv_uids: tuple, dv_ranks: tuple,
         if desc:
             ranks = jnp.where(hit, -ranks, RANK_MISSING)
         cols.append(ranks)
-    out = jax.lax.sort(tuple(cols) + (cand,), num_keys=len(cols) + 1)
-    return out[-1]
+    return cols
+
+
+def _page_slice(suids, after_uid, offset, window: int, limit=None):
+    """Shared paging tail (traced inside the page kernels): after-
+    cursor position -> start -> fixed `window` slice. `limit` treats
+    cursor positions >= limit as absent. The SENTINEL tail keeps
+    dynamic_slice exact for any start <= n_pad (an over-the-end start
+    clamps onto pure padding = empty page); callers bound `offset`
+    (host guard) so start stays far from int32 overflow."""
+    hit_after = suids == after_uid.astype(suids.dtype)
+    pos = jnp.argmax(hit_after)
+    found = jnp.any(hit_after)
+    if limit is not None:
+        found = found & (pos < limit)
+    start = jnp.where(found, pos + 1, 0) + offset.astype(jnp.int32)
+    ext = jnp.concatenate(
+        [suids, jnp.full((window,), SENTINEL, suids.dtype)])
+    return jax.lax.dynamic_slice(ext, (start,), (window,)), start
+
+
+@partial(jax.jit, static_argnames=("descs", "window"))
+def multisort_page(cand: jax.Array, dv_uids: tuple, dv_ranks: tuple,
+                   descs: tuple, window: int, after_uid: jax.Array,
+                   offset: jax.Array):
+    """multisort + after-cursor + offset + first in ONE dispatch,
+    returning only the `window`-sized page instead of the whole sorted
+    vector — at the 21M regime the full vector is ~4MB each way over
+    the device tunnel while the page is a few KB (q006 device path:
+    1.06s -> one RTT). Ref worker/sort.go:177 processSort applying
+    offset+count inside the sort request.
+
+    Returns one packed uint32 array [page..., start]: `start` is the
+    UNCLAMPED index the page begins at in the sorted stream; the host
+    derives the valid length as clip(n_real - start, 0, window). An
+    absent after-cursor skips nothing (the host path's semantics)."""
+    cols = _rank_cols(cand, dv_uids, dv_ranks, descs)
+    suids = jax.lax.sort(tuple(cols) + (cand,),
+                         num_keys=len(cols) + 1)[-1]
+    page, start = _page_slice(suids, after_uid, offset, window)
+    # one packed array = one tunnel fetch: [page..., start]
+    return jnp.concatenate(
+        [page, start[None].astype(jnp.uint32)])
+
+
+@partial(jax.jit, static_argnames=("descs", "window"))
+def count_filter_sort_page(cand: jax.Array, degrees: jax.Array,
+                           lo: jax.Array, hi: jax.Array,
+                           dv_uids: tuple, dv_ranks: tuple,
+                           descs: tuple, window: int,
+                           after_uid: jax.Array, offset: jax.Array):
+    """has(A) root + count(A)-threshold filter + order + paginate in
+    ONE dispatch over the predicate's RESIDENT adjacency (cand =
+    adj.src_uids, degrees aligned): nothing is uploaded and only the
+    page comes back (q010's device path was two full-vector round
+    trips). Filtered-out uids sink below even missing-value uids via
+    a leading exclusion key. Ref worker/task.go:1111 handleCompare
+    over the count index + sort.go:177.
+
+    Returns one packed uint32 array [page..., start, n_kept]."""
+    keep = (degrees >= lo) & (degrees <= hi) & (cand != SENTINEL)
+    excl = jnp.where(keep, jnp.int32(0), jnp.int32(1))
+    cols = _rank_cols(cand, dv_uids, dv_ranks, descs)
+    suids = jax.lax.sort((excl,) + tuple(cols) + (cand,),
+                         num_keys=len(cols) + 2)[-1]
+    n_kept = jnp.sum(keep)
+    # a cursor uid the filter excluded sank past n_kept: treat it as
+    # ABSENT (skip nothing), exactly the host path's absent-uid rule —
+    # matching it in the excluded region would return an empty page
+    page, start = _page_slice(suids, after_uid, offset, window,
+                              limit=n_kept)
+    return jnp.concatenate(
+        [page, start[None].astype(jnp.uint32),
+         n_kept[None].astype(jnp.uint32)])
 
 
 @partial(jax.jit, static_argnames=("k", "desc"))

@@ -477,10 +477,12 @@ class Executor:
         if gq.attr == "shortest":
             self._run_shortest(node)
             return node
-        root = self._root_uids(gq)
-        if gq.filter is not None:
-            root = self._eval_filter(gq.filter, root)
-        root = self._order_paginate(gq, root)
+        root = self._device_root_count_page(gq)
+        if root is None:
+            root = self._root_uids(gq)
+            if gq.filter is not None:
+                root = self._eval_filter(gq.filter, root)
+            root = self._order_paginate(gq, root)
         node.dest = root
         if gq.var:
             self.uid_vars[gq.var] = root
@@ -1479,6 +1481,21 @@ class Executor:
                 f"(add @reverse to the schema)")
         if tab.schema.value_type == TypeID.UID and not node.reverse or \
                 (node.reverse and tab.schema.reverse):
+            if gq.is_count and gq.filter is None and not gq.var \
+                    and gq.facets_filter is None and not gq.facet_var \
+                    and not gq.children \
+                    and not hasattr(tab, "prefetch_edges"):
+                # count-only child on a LOCAL tablet: per-parent
+                # degrees suffice — never materialize (or device-
+                # expand) the destination union (ref worker/task.go
+                # count tasks read the count index, not the posting
+                # lists). Federated proxies keep the edge-prefetch
+                # path: their counts ride the level's batched edge
+                # cache with zero extra RPCs
+                for u in src.tolist():
+                    node.counts[u] = self._child_count(
+                        tab, u, node.reverse)
+                return node
             if hasattr(tab, "prefetch_edges"):
                 # federated tablet: one batched task RPC warms every
                 # per-parent edge read this block (and its emission)
@@ -1914,6 +1931,9 @@ class Executor:
     def _order_paginate(self, gq: GraphQuery, uids: np.ndarray
                         ) -> np.ndarray:
         if gq.order:
+            paged = self._device_order_page(gq, uids)
+            if paged is not None:
+                return paged
             uids = self._apply_order(gq.order, uids)
         if gq.after:
             if gq.order:
@@ -1950,19 +1970,12 @@ class Executor:
         order = np.lexsort(tuple(cols))
         return uids[order]
 
-    def _device_apply_order(self, orders, uids: np.ndarray
-                            ) -> Optional[np.ndarray]:
-        """Whole multi-key (and lang-tagged) order-by on device: one
-        multisort call over per-attr DeviceValues rank columns (ref
-        worker/sort.go:300 multiSort). Falls back to the host lexsort
-        whenever any order key has no device view (val() orders,
-        dirty/small tablets, >32-bit uids)."""
+    def _order_device_views(self, orders) -> Optional[list]:
+        """DeviceValues views for every order key, or None when any
+        key has no device view (val()/facet orders, dirty/small
+        tablets)."""
         from dgraph_tpu.engine.device_cache import device_values
-        from dgraph_tpu.ops.graph import multisort
-        from dgraph_tpu.ops.uidvec import SENTINEL, pad_to, to_numpy
 
-        if np.any(uids > 0xFFFFFFFE):
-            return None
         dvs = []
         for o in orders:
             if o.attr.startswith("val(") or o.attr.startswith("facet:"):
@@ -1974,6 +1987,23 @@ class Executor:
             if dv is None:
                 return None
             dvs.append(dv)
+        return dvs
+
+    def _device_apply_order(self, orders, uids: np.ndarray
+                            ) -> Optional[np.ndarray]:
+        """Whole multi-key (and lang-tagged) order-by on device: one
+        multisort call over per-attr DeviceValues rank columns (ref
+        worker/sort.go:300 multiSort). Falls back to the host lexsort
+        whenever any order key has no device view (val() orders,
+        dirty/small tablets, >32-bit uids)."""
+        from dgraph_tpu.ops.graph import multisort
+        from dgraph_tpu.ops.uidvec import SENTINEL, pad_to, to_numpy
+
+        if np.any(uids > 0xFFFFFFFE):
+            return None
+        dvs = self._order_device_views(orders)
+        if dvs is None:
+            return None
         import jax.numpy as jnp
         cand = np.full(pad_to(len(uids)), SENTINEL, np.uint32)
         cand[: len(uids)] = np.sort(uids).astype(np.uint32)
@@ -1984,6 +2014,181 @@ class Executor:
                         tuple(bool(o.desc) for o in orders))
         res = to_numpy(out)
         return res[: len(uids)].astype(np.uint64)
+
+    _PAGE_MAX_FIRST = 2048
+
+    def _page_window(self, first: int) -> int:
+        w = 8
+        while w < first:
+            w <<= 1
+        return w
+
+    def _device_resident_root(self, gq: GraphQuery, uids: np.ndarray):
+        """The device-resident uid vector of an unfiltered clean
+        has(attr) root, or None. When the root candidate set IS the
+        tablet's own device view, the sort page kernel reads it in
+        place — no 4MB-per-query upload over the tunnel."""
+        from dgraph_tpu.engine.device_cache import (
+            device_adjacency, device_values,
+        )
+
+        fn = gq.func
+        if fn is None or fn.name != "has" or fn.attr.startswith("~") \
+                or gq.filter is not None or gq.uids or gq.needs_var:
+            return None
+        tab = self.db.tablets.get(fn.attr)
+        if tab is None or not hasattr(tab, "schema"):
+            return None
+        if getattr(tab, "is_uid", False):
+            adj = device_adjacency(self.db, tab, self.read_ts)
+            if adj is not None and adj.n_src == len(uids):
+                return adj.src_uids
+            return None
+        dv = device_values(self.db, tab, self.read_ts)
+        if dv is not None and dv.n == len(uids):
+            return dv.uids
+        return None
+
+    def _device_order_page(self, gq: GraphQuery, uids: np.ndarray
+                           ) -> Optional[np.ndarray]:
+        """order + after + offset + first fused into ONE device
+        dispatch returning only the page (ref worker/sort.go:177
+        processSort applies offset+count inside the sort). The full
+        multisort path transfers the whole candidate vector both ways
+        (~8MB at the 21M regime); this moves a few KB."""
+        first = gq.first
+        if first is None or first <= 0 or first > self._PAGE_MAX_FIRST:
+            return None
+        if not 0 <= (gq.offset or 0) <= 2**30 \
+                or (gq.after or 0) > 0xFFFFFFFE:
+            # the kernels compute start in int32: an absurd offset
+            # must take the host path, not wrap the slice start
+            return None
+        if not self.db.prefer_device or len(uids) < 8:
+            return None
+        if not self._device_worth(
+                len(uids) * len(gq.order) * self._HOST_PER_ORDER_KEY):
+            return None
+        if np.any(uids > 0xFFFFFFFE):
+            return None
+        dvs = self._order_device_views(gq.order)
+        if dvs is None:
+            return None
+        from dgraph_tpu.ops.graph import multisort_page
+        from dgraph_tpu.ops.uidvec import SENTINEL, pad_to, to_numpy
+        import jax.numpy as jnp
+
+        cand = self._device_resident_root(gq, uids)
+        if cand is None:
+            buf = np.full(pad_to(len(uids)), SENTINEL, np.uint32)
+            buf[: len(uids)] = np.sort(uids).astype(np.uint32)
+            cand = jnp.asarray(buf)
+        inc_counter("query_device_sort_page_total")
+        out = multisort_page(
+            cand,
+            tuple(dv.uids for dv in dvs),
+            tuple(dv.ranks for dv in dvs),
+            tuple(bool(o.desc) for o in gq.order),
+            self._page_window(first),
+            jnp.uint32(gq.after or 0),
+            jnp.int32(gq.offset or 0))
+        res = to_numpy(out)
+        start = int(np.int32(res[-1]))
+        valid = max(0, min(first, len(uids) - start))
+        return res[:valid].astype(np.uint64)
+
+    @staticmethod
+    def _count_cmp_bounds(fn: Function) -> Optional[tuple[int, int]]:
+        """count-cmp -> inclusive [lo, hi] degree bounds over has()
+        candidates (every candidate has degree >= 1)."""
+        hi_max = 2**31 - 1
+        try:
+            v = int(fn.args[0].value)
+        except (ValueError, IndexError):
+            return None
+        if fn.name == "ge":
+            return max(v, 1), hi_max
+        if fn.name == "gt":
+            return max(v + 1, 1), hi_max
+        if fn.name == "le":
+            return 1, v
+        if fn.name == "lt":
+            return 1, v - 1
+        if fn.name == "eq":
+            return max(v, 1), v
+        if fn.name == "between":
+            try:
+                hi = int(fn.args[1].value)
+            except (ValueError, IndexError):
+                return None
+            return max(v, 1), hi
+        return None
+
+    def _device_root_count_page(self, gq: GraphQuery
+                                ) -> Optional[np.ndarray]:
+        """has(A) root + count(A) filter + order + paginate in ONE
+        dispatch over A's resident adjacency (candidates = its src
+        vector, degrees aligned): nothing uploaded, only the page
+        downloaded (ref worker/task.go:1111 handleCompare over the
+        count index + sort.go:177). Engages only for the exact shape
+        q010 has; anything else falls back to the general path."""
+        ft = gq.filter
+        fn = gq.func
+        if (ft is None or ft.op or ft.children or ft.func is None
+                or fn is None or fn.name != "has"
+                or fn.attr.startswith("~") or gq.uids or gq.needs_var
+                or not gq.order):
+            return None
+        cfn = ft.func
+        if (not cfn.is_count or cfn.attr != fn.attr
+                or cfn.needs_var or cfn.attr.startswith("~")):
+            return None
+        bounds = self._count_cmp_bounds(cfn)
+        if bounds is None:
+            return None
+        first = gq.first
+        if first is None or first <= 0 or first > self._PAGE_MAX_FIRST:
+            return None
+        if not 0 <= (gq.offset or 0) <= 2**30 \
+                or (gq.after or 0) > 0xFFFFFFFE:
+            return None
+        if not self.db.prefer_device:
+            return None
+        tab = self.db.tablets.get(fn.attr)
+        if tab is None or not getattr(tab, "is_uid", False) \
+                or not hasattr(tab, "sort_key_pairs"):
+            return None
+        from dgraph_tpu.engine.device_cache import device_adjacency
+        adj = device_adjacency(self.db, tab, self.read_ts)
+        if adj is None:
+            return None
+        if not self._device_worth(
+                adj.n_src * (len(gq.order) + 1)
+                * self._HOST_PER_ORDER_KEY):
+            return None
+        dvs = self._order_device_views(gq.order)
+        if dvs is None:
+            return None
+        from dgraph_tpu.ops.graph import count_filter_sort_page
+        import jax.numpy as jnp
+        from dgraph_tpu.ops.uidvec import to_numpy
+
+        inc_counter("query_device_count_page_total")
+        out = count_filter_sort_page(
+            adj.src_uids, adj.degrees,
+            jnp.int32(min(bounds[0], 2**31 - 1)),
+            jnp.int32(min(bounds[1], 2**31 - 1)),
+            tuple(dv.uids for dv in dvs),
+            tuple(dv.ranks for dv in dvs),
+            tuple(bool(o.desc) for o in gq.order),
+            self._page_window(first),
+            jnp.uint32(gq.after or 0),
+            jnp.int32(gq.offset or 0))
+        res = to_numpy(out)
+        start = int(np.int32(res[-2]))
+        n_kept = int(res[-1])
+        valid = max(0, min(first, n_kept - start))
+        return res[:valid].astype(np.uint64)
 
     def _order_key_cols(self, o, uids: np.ndarray
                         ) -> tuple[np.ndarray, np.ndarray]:
@@ -2706,10 +2911,9 @@ class Executor:
         uids that produced a value for each predicate)."""
         from itertools import product
 
-        if len(gq.groupby) == 1:
-            fast = self._groupby_groups_fast(gq.groupby[0], dsts)
-            if fast is not None:
-                return fast
+        fast = self._groupby_groups_vec(gq.groupby, dsts)
+        if fast is not None:
+            return fast
         groups: dict[tuple, list[int]] = {}
         for d in dsts.tolist():
             per_attr: list[list] = []
@@ -2746,74 +2950,108 @@ class Executor:
                 groups.setdefault(tuple(combo), []).append(int(d))
         return groups
 
-    def _groupby_groups_fast(self, ga, dsts: np.ndarray
-                             ) -> Optional[dict[tuple, list[int]]]:
-        """Vectorized single-attr grouping (the reference regime's
-        common shape, ref query/groupby.go:371): gather every member's
-        key through the columnar views, np.unique the keys, and split
-        members by a stable argsort of the inverse — no per-uid
-        posting walks.  Returns None (caller keeps the exact per-uid
-        path) for lang-selected keys, dirty/historical tablets,
-        list-valued or mixed-type columns."""
+    def _groupby_attr_codes(self, ga):
+        """One @groupby attr as a vectorized key column:
+        (uids sorted u64, codes int64 aligned, decode) where decode
+        maps a code back to the output key value. uid predicates fan
+        out via their flat edge table (need_pairs marks them); scalar
+        predicates contribute one (uid, code) per valued member.
+        Returns None -> caller keeps the exact per-uid path."""
         tab = self._tablet(ga.attr)
-        if tab is None or ga.lang:
+        if tab is None:
             return None
         if tab.schema.value_type == TypeID.UID:
-            edges = getattr(tab, "edges", None)
-            if not isinstance(edges, dict) or tab.dirty() \
-                    or self.read_ts < tab.base_ts:
+            if ga.lang or not hasattr(tab, "edge_table"):
                 return None
-            mparts, kparts = [], []
-            for d in dsts.tolist():
-                a = edges.get(int(d))
-                if a is None or not len(a):
-                    continue  # members missing the attr are dropped
-                kparts.append(a)
-                mparts.append(np.full(len(a), d, np.uint64))
-            if not kparts:
-                return {}
-            karr = np.concatenate(kparts)
-            marr = np.concatenate(mparts)
-            uk, inv = np.unique(karr, return_inverse=True)
-            keys = [(hex(int(k)),) for k in uk.tolist()]
+            et = tab.edge_table(self.read_ts)
+            if et is None:
+                return None
+            srcs, dsts = et
+            # dst uids ARE the codes — kept uint64 (an int64 cast
+            # would render uids >= 2^63 as negative hex)
+            return srcs, dsts, lambda c: hex(int(c))
+        if ga.lang:
+            col = tab.lang_value_columns(self.read_ts, ga.lang) \
+                if hasattr(tab, "lang_value_columns") else None
         else:
-            colview = tab.value_columns(self.read_ts) \
+            col = tab.value_columns(self.read_ts) \
                 if hasattr(tab, "value_columns") else None
-            if colview is None:
+        if col is None:
+            return None
+        self._budget_colview(tab, col)
+        srcs, tid, data, enc = col
+        if data is not None:
+            if tid == TypeID.BOOL:
+                return srcs, data.astype(np.int64), \
+                    lambda c: bool(c)
+            if tid == TypeID.FLOAT:
+                if np.isnan(data).any():
+                    return None  # nan keys keep dict semantics
+                # float keys: code through the unique table to stay
+                # integral for the lexsort/boundary pass
+                uk = np.unique(data)
+                return srcs, np.searchsorted(uk, data), \
+                    lambda c, _uk=uk: float(_uk[int(c)])
+            return srcs, data.astype(np.int64), lambda c: int(c)
+        got = col.enc_codes()
+        if got is None:
+            return None
+        codes, table = got
+        return srcs, codes, \
+            lambda c, _t=table: _t[int(c)].decode("utf-8")
+
+    def _groupby_groups_vec(self, gattrs, dsts: np.ndarray
+                            ) -> Optional[dict[tuple, list[int]]]:
+        """Vectorized grouping for ANY @groupby attr list (ref
+        query/groupby.go:371 processGroupBy): each attr's keys come
+        from columnar views (cached integer codes for strings, flat
+        edge tables for uid fan-out), members join against them with
+        searchsorted ranges, and the combined key tuples group via one
+        lexsort + boundary scan — no per-uid posting walks. Returns
+        None (exact path) when any attr lacks a clean columnar view."""
+        cols = []
+        for ga in gattrs:
+            got = self._groupby_attr_codes(ga)
+            if got is None:
                 return None
-            self._budget_colview(tab, colview)
-            srcs, tid, data, enc = colview
-            pos, hit = _col_positions(srcs, dsts)
-            marr = dsts[hit]
-            sel = pos[hit]
-            if not len(marr):
+            cols.append(got)
+        rows = np.ascontiguousarray(dsts, dtype=np.uint64)
+        code_cols: list[np.ndarray] = []
+        for (u_sorted, codes, _dec) in cols:
+            starts = np.searchsorted(u_sorted, rows, "left")
+            ends = np.searchsorted(u_sorted, rows, "right")
+            cnt = (ends - starts).astype(np.int64)
+            total = int(cnt.sum())
+            if total == 0:
                 return {}
-            if data is not None:
-                uk, inv = np.unique(data[sel], return_inverse=True)
-                if tid == TypeID.BOOL:
-                    keys = [(bool(k),) for k in uk.tolist()]
-                else:
-                    keys = [(k,) for k in uk.tolist()]
-            else:
-                # string/datetime keys: integer-code via one dict pass
-                # (np.unique on object arrays is python-compare
-                # O(n log n) — 1.5s of the 21M q052 profile)
-                table: dict[bytes, int] = {}
-                setd = table.setdefault
-                codes = np.fromiter(
-                    (setd(enc[j], len(table)) for j in sel.tolist()),
-                    np.int64, len(sel))
-                uk, inv = np.unique(codes, return_inverse=True)
-                by_code = list(table.keys())
-                keys = [(by_code[c].decode("utf-8"),)
-                        for c in uk.tolist()]
-        order = np.argsort(inv, kind="stable")
-        sm = marr[order].tolist()
-        bounds = np.searchsorted(inv[order],
-                                 np.arange(len(keys) + 1)).tolist()
+            rep = np.repeat(np.arange(len(rows)), cnt)
+            # gathered indices = starts[row] + position-within-row
+            base = np.repeat(starts, cnt)
+            csum = np.concatenate(([0], np.cumsum(cnt)[:-1]))
+            inner = np.arange(total) - np.repeat(csum, cnt)
+            code_cols = [c[rep] for c in code_cols]
+            code_cols.append(codes[base + inner])
+            rows = rows[rep]
+        if not len(rows):
+            return {}
+        order = np.lexsort(tuple(reversed(code_cols)))
+        sorted_cols = [c[order] for c in code_cols]
+        rows_s = rows[order]
+        change = np.zeros(len(rows_s), bool)
+        change[0] = True
+        for c in sorted_cols:
+            change[1:] |= c[1:] != c[:-1]
+        bidx = np.nonzero(change)[0]
+        bounds = np.append(bidx, len(rows_s)).tolist()
         inc_counter("query_groupby_fast_total")
-        return {keys[g]: sm[bounds[g]:bounds[g + 1]]
-                for g in range(len(keys))}
+        groups: dict[tuple, list[int]] = {}
+        members = rows_s.tolist()
+        for g in range(len(bidx)):
+            s, e = bounds[g], bounds[g + 1]
+            key = tuple(cols[k][2](sorted_cols[k][s])
+                        for k in range(len(cols)))
+            groups[key] = members[s:e]
+        return groups
 
     def _groupby_entry(self, gq: GraphQuery, key: tuple,
                        members: list[int]) -> dict:

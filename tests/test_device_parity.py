@@ -95,9 +95,88 @@ def test_device_multisort_matches_host_and_counts():
         '{ q(func: has(grp), orderasc: nm@de, orderdesc: grp, '
         'first: 17) { uid } }',
     ]
-    before = snapshot()["counters"].get(
-        "query_device_multisort_total", 0)
+    def sorts():
+        c = snapshot()["counters"]
+        # full multisort or the fused page kernel — `first` queries
+        # take the page path
+        return c.get("query_device_multisort_total", 0) + \
+            c.get("query_device_sort_page_total", 0)
+
+    before = sorts()
     for q in queries:
         assert dev.query(q)["data"] == host.query(q)["data"], q
-    got = snapshot()["counters"].get("query_device_multisort_total", 0)
+    assert sorts() >= before + len(queries)
+
+
+def test_device_sort_page_parity_windows():
+    """The fused multisort_page path (order + after + offset + first
+    in one dispatch) against the host order across window shapes,
+    missing values, descs, and cursors (ref worker/sort.go:177)."""
+    from dgraph_tpu.utils.metrics import snapshot
+
+    def build(prefer_device):
+        db = GraphDB(prefer_device=prefer_device, device_min_edges=1)
+        db.alter("pnm: string .\nprk: int .\npedge: [uid] @count .")
+        rng = np.random.default_rng(11)
+        lines = []
+        for i in range(1, 101):
+            if i % 6:  # some uids miss pnm (missing-last rule)
+                lines.append(f'<{hex(i)}> <pnm> "v{int(rng.integers(9))}" .')
+            lines.append(f'<{hex(i)}> <prk> "{int(rng.integers(50))}" .')
+            for d in range(1 + i % 5):
+                lines.append(f'<{hex(i)}> <pedge> <{hex(200 + d)}> .')
+        db.mutate(set_nquads="\n".join(lines))
+        db.rollup_all()
+        return db
+
+    host, dev = build(False), build(True)
+    queries = [
+        # resident-root shapes (clean has() root, no filter)
+        '{ q(func: has(prk), orderasc: prk, first: 7) { uid prk } }',
+        '{ q(func: has(prk), orderasc: prk, first: 7, offset: 3) '
+        '{ uid } }',
+        '{ q(func: has(pnm), orderasc: pnm, orderdesc: prk, first: 9) '
+        '{ uid pnm } }',
+        '{ q(func: has(prk), orderdesc: prk, first: 5, after: 0x14) '
+        '{ uid } }',
+        # offset past the end -> empty page
+        '{ q(func: has(prk), orderasc: prk, first: 5, offset: 1000) '
+        '{ uid } }',
+        # uploaded-candidate shape (filter breaks residency)
+        '{ q(func: has(prk), orderasc: prk, first: 6) '
+        '@filter(ge(prk, 10)) { uid prk } }',
+    ]
+    before = snapshot()["counters"].get(
+        "query_device_sort_page_total", 0)
+    for q in queries:
+        assert dev.query(q)["data"] == host.query(q)["data"], q
+    got = snapshot()["counters"].get("query_device_sort_page_total", 0)
     assert got >= before + len(queries)
+
+    # near-INT32_MAX offset must not wrap the device slice start into
+    # a bogus first page (review repro; takes the host-path fallback)
+    q = ('{ q(func: has(prk), orderasc: prk, first: 5, after: 0x1, '
+         'offset: 2147483647) { uid } }')
+    assert dev.query(q)["data"] == host.query(q)["data"] == {"q": []}
+
+    # fused has+count+order+page path (q010's shape)
+    cqueries = [
+        '{ q(func: has(pedge), first: 6, orderasc: pnm) '
+        '@filter(ge(count(pedge), 3)) { uid count(pedge) } }',
+        '{ q(func: has(pedge), first: 4, orderdesc: prk) '
+        '@filter(le(count(pedge), 2)) { uid } }',
+        '{ q(func: has(pedge), first: 8, orderasc: prk, offset: 2) '
+        '@filter(eq(count(pedge), 1)) { uid } }',
+        '{ q(func: has(pedge), first: 5, orderasc: pnm) '
+        '@filter(between(count(pedge), 2, 4)) { uid } }',
+        # after-cursor whose degree FAILS the filter: absent-uid rule
+        # (skip nothing), not an empty page (review repro)
+        '{ q(func: has(pedge), first: 6, orderasc: pnm, after: 0x5) '
+        '@filter(ge(count(pedge), 3)) { uid } }',
+    ]
+    before = snapshot()["counters"].get(
+        "query_device_count_page_total", 0)
+    for q in cqueries:
+        assert dev.query(q)["data"] == host.query(q)["data"], q
+    got = snapshot()["counters"].get("query_device_count_page_total", 0)
+    assert got >= before + len(cqueries)

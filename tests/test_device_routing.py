@@ -102,6 +102,7 @@ def test_orderby_uses_device_keys(dbs):
     metrics.reset()
     got = dev.query(q)
     assert _counter("query_device_multisort_total") \
+        + _counter("query_device_sort_page_total") \
         + _counter("query_device_orderkeys_total") > 0, \
         "order-by never reached the device sort path"
     want = host.query(q)
