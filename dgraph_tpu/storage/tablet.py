@@ -48,7 +48,8 @@ class ValueColumns:
     values defied encoding — batch consumers must fall back."""
 
     __slots__ = ("srcs", "tid", "data", "enc", "nbytes",
-                 "extra_srcs", "extra_enc", "extra_ok", "_ascii")
+                 "extra_srcs", "extra_enc", "extra_ok", "_ascii",
+                 "_codes")
 
     def __init__(self, srcs, tid, data, enc,
                  extra_srcs=None, extra_enc=None, extra_ok=True):
@@ -56,6 +57,7 @@ class ValueColumns:
         self.tid = tid
         self.data = data
         self.enc = enc
+        self._codes = None
         self.extra_srcs = extra_srcs if extra_srcs is not None \
             else np.empty(0, np.uint64)
         self.extra_enc = extra_enc or []
@@ -80,6 +82,31 @@ class ValueColumns:
 
     def __iter__(self):
         return iter((self.srcs, self.tid, self.data, self.enc))
+
+    def enc_codes(self):
+        """(codes int64 aligned to srcs, table: code -> bytes) for the
+        string/datetime payload column — np fixed-width-bytes unique
+        (C-order compare) instead of a per-row python dict pass, which
+        was most of the 21M groupby-by-string profile. Cached for the
+        colview's lifetime (per base_ts, like the view itself).
+        Returns None when payloads carry trailing NULs ('S' dtype
+        strips them, so codes would conflate distinct values)."""
+        if self._codes is not None:
+            return self._codes or None
+        if not self.enc:
+            self._codes = (np.empty(0, np.int64), [])
+            return self._codes
+        arr = np.asarray(self.enc, dtype=np.bytes_)
+        uniq, codes = np.unique(arr, return_inverse=True)
+        table = uniq.tolist()  # strips trailing NULs
+        lens = np.fromiter((len(e) for e in self.enc),
+                           np.int64, len(self.enc))
+        tlens = np.asarray([len(t) for t in table], np.int64)
+        if not np.array_equal(tlens[codes], lens):
+            self._codes = False  # NUL-tailed payloads: exact path
+            return None
+        self._codes = (codes.astype(np.int64), table)
+        return self._codes
 
 
 @dataclass
@@ -495,6 +522,79 @@ class Tablet:
         self._val_cols_ts = self.base_ts
         self._val_cols_schema = self.schema
         return cols
+
+    def lang_value_columns(self, read_ts: int, lang: str):
+        """Columnar view of ONE language's postings (first posting per
+        uid tagged `lang`) — the lang-tagged groupby/gather analogue of
+        value_columns. Same clean-tablet contract; cached per
+        (base_ts, lang)."""
+        if self.dirty() or read_ts < self.base_ts or self.schema.list_:
+            return None
+        cache = getattr(self, "_val_cols_lang", None)
+        if cache is None or self._val_cols_lang_ts != self.base_ts \
+                or self._val_cols_lang_schema is not self.schema:
+            cache = {}
+            self._val_cols_lang = cache
+            self._val_cols_lang_ts = self.base_ts
+            self._val_cols_lang_schema = self.schema
+        if lang in cache:
+            return cache[lang] or None
+        from dgraph_tpu.models.types import TypeID
+        srcs: list[int] = []
+        vals: list = []
+        tid = None
+        for u, ps in self.values.items():
+            sel = None
+            for p in ps:
+                if p.lang == lang:
+                    sel = p
+                    break
+            if sel is None:
+                continue
+            v = sel.value
+            if tid is None:
+                tid = v.tid
+            elif v.tid is not tid:
+                cache[lang] = False
+                return None
+            srcs.append(u)
+            vals.append(v.value)
+        out = None
+        if tid in (TypeID.STRING, TypeID.DEFAULT):
+            order = np.argsort(np.asarray(srcs, np.uint64))
+            try:
+                enc = [vals[j].encode("utf-8") for j in order.tolist()]
+                out = ValueColumns(
+                    np.asarray(srcs, np.uint64)[order], tid, None, enc)
+            except (AttributeError, ValueError):
+                out = None
+        cache[lang] = out if out is not None else False
+        return out
+
+    def edge_table(self, read_ts: int):
+        """Flat (src-repeated, dst) uint64 arrays of a CLEAN uid
+        tablet, src-sorted — one vectorized join key for groupby over
+        uid predicates instead of a per-member edges[] walk. Cached
+        per base_ts."""
+        if self.dirty() or read_ts < self.base_ts or not self.is_uid:
+            return None
+        cached = getattr(self, "_edge_table", None)
+        if cached is not None and self._edge_table_ts == self.base_ts:
+            return cached
+        parts_s, parts_d = [], []
+        for u in sorted(self.edges):
+            d = self.edges[u]
+            if not len(d):
+                continue
+            parts_d.append(np.asarray(d, np.uint64))
+            parts_s.append(np.full(len(d), u, np.uint64))
+        if parts_s:
+            table = (np.concatenate(parts_s), np.concatenate(parts_d))
+        else:
+            table = (np.empty(0, np.uint64), np.empty(0, np.uint64))
+        self._edge_table = table
+        self._edge_table_ts = self.base_ts
+        return table
 
     def _build_value_columns(self):
         from dgraph_tpu.models.types import TypeID
