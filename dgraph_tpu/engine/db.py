@@ -464,7 +464,12 @@ class GraphDB:
                            TypeID.DATETIME: TypeID.DATETIME,
                            TypeID.GEO: TypeID.GEO,
                            }.get(tid, TypeID.DEFAULT)
-                ps = PredicateSchema(pred, value_type=tid)
+                # implicit uid predicates default to LIST (the
+                # reference's schemaless edges are [uid]; only an
+                # explicit `p: uid .` is single-valued and emits as
+                # one object — query0_test.go TestGetNonListUidPredicate)
+                ps = PredicateSchema(pred, value_type=tid,
+                                     list_=tid == TypeID.UID)
                 self.schema.set_predicate(ps)
             self.coordinator.should_serve(pred)
             tab = Tablet(pred, ps)

@@ -141,6 +141,8 @@ class GraphQuery:
     is_groupby: bool = False
     math: Optional[MathTree] = None
     agg_func: str = ""                  # min/max/sum/avg at value level
+    agg_pred: str = ""                  # max(name): aggregate a
+                                        # predicate (groupby only)
     facets: Optional[FacetParams] = None
     facets_filter: Optional[FilterTree] = None
     facet_var: dict = field(default_factory=dict)

@@ -271,6 +271,15 @@ def _facet_val(raw: str) -> Val:
         return Val(TypeID.FLOAT, float(raw))
     except ValueError:
         pass
+    try:
+        # unquoted RFC3339 tokens are datetime facets (ref
+        # types/facets/utils.go:129 FacetFor's type sniffing; an
+        # unparseable offset like +30:00 stays a string there too)
+        from dgraph_tpu.models.types import parse_datetime
+
+        return Val(TypeID.DATETIME, parse_datetime(raw))
+    except ValueError:
+        pass
     return Val(TypeID.STRING, raw)
 
 

@@ -649,6 +649,9 @@ def _parse_selection(cur: Cursor, gvars: dict) -> GraphQuery:
     if name == "count" and cur.peek().kind == "lparen":
         cur.next()
         inner = cur.expect("name", "count target")
+        if inner.val == "val":
+            raise GQLError("count(val(...)) is not supported; "
+                           "aggregate through a var block")
         if inner.val == "uid":
             gq.attr = "uid"
             gq.is_count = True
@@ -656,22 +659,54 @@ def _parse_selection(cur: Cursor, gvars: dict) -> GraphQuery:
         else:
             gq.attr = inner.val
             gq.is_count = True
-            if cur.accept("at"):
+            for _ in range(2):  # @lang, then optionally @filter
+                if not cur.accept("at"):
+                    break
+                if cur.peek().kind == "name" \
+                        and cur.peek().val.lower() == "filter":
+                    # count(pred @filter(...)) counts only the edges
+                    # the filter keeps (ref query0_test.go
+                    # TestQueryEmptyRoomsWithTermIndex)
+                    cur.next()
+                    gq.filter = _parse_filter(cur, gvars)
+                    break
                 gq.langs = _parse_langs(cur)
+            if cur.peek().kind == "lparen":
+                # count(pred ... (orderasc: dob)): ordering never
+                # changes a count — parse and discard (ref
+                # query2_test.go TestToFastJSONOrderDescCount)
+                depth = 0
+                while True:
+                    t = cur.next()
+                    if t.kind == "lparen":
+                        depth += 1
+                    elif t.kind == "rparen":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    elif t.kind == "eof":
+                        raise GQLError("unbalanced count() arguments")
         cur.expect("rparen")
     elif name in _AGG_FUNCS and cur.peek().kind == "lparen":
         cur.next()
         gq.agg_func = name
         inner = cur.expect("name", "val")
-        if inner.val != "val":
-            raise GQLError(f"aggregation {name}() needs val(var)")
-        cur.expect("lparen")
-        v = cur.expect("name").val
-        cur.expect("rparen")
-        cur.expect("rparen")
-        gq.attr = f"{name}(val({v}))"
-        gq.needs_var.append(VarContext(v, VALUE_VAR))
-        gq.is_internal = True
+        if inner.val == "val":
+            cur.expect("lparen")
+            v = cur.expect("name").val
+            cur.expect("rparen")
+            cur.expect("rparen")
+            gq.attr = f"{name}(val({v}))"
+            gq.needs_var.append(VarContext(v, VALUE_VAR))
+            gq.is_internal = True
+        else:
+            # max(name) etc: aggregate a PREDICATE's values — only
+            # meaningful inside @groupby (ref query0_test.go
+            # TestGroupByAgg); the executor rejects it elsewhere
+            gq.attr = inner.val
+            gq.agg_pred = inner.val
+            cur.expect("rparen")
+            gq.is_internal = True
     elif name == "val" and cur.peek().kind == "lparen":
         cur.next()
         v = cur.expect("name").val
@@ -698,11 +733,17 @@ def _parse_selection(cur: Cursor, gvars: dict) -> GraphQuery:
         cur.next()
         t = cur.next()
         gq.attr = "expand"
-        gq.expand = t.val  # _all_ | type name | var
+        gq.expand = t.val  # _all_ | type name(s) | var
         if t.kind == "name" and t.val == "val":
             cur.expect("lparen")
             gq.expand = cur.expect("name").val
             cur.expect("rparen")
+        else:
+            # expand(CarModel, Object): union of several types'
+            # fields (ref query4_test.go
+            # TestTypeExpandMultipleExplicitTypes)
+            while cur.accept("comma"):
+                gq.expand += "," + cur.expect("name").val
         cur.expect("rparen")
     else:
         gq.attr = name

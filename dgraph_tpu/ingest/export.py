@@ -28,7 +28,8 @@ _XS = {TypeID.INT: "xs:int", TypeID.FLOAT: "xs:float",
 
 def _rdf_value(v: Val) -> str:
     if v.tid == TypeID.DATETIME:
-        raw = v.value.isoformat()
+        from dgraph_tpu.models.types import iso8601
+        raw = iso8601(v.value)
     elif v.tid == TypeID.GEO:
         raw = json.dumps(v.value)
     elif v.tid == TypeID.BOOL:

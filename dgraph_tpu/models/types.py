@@ -205,11 +205,21 @@ def _to_string(v: Val) -> str:
     return str(v.value)
 
 
+def iso8601(dt) -> str:
+    """RFC3339 text the way the reference emits time.Time (Go
+    MarshalJSON): naive values count as UTC and a zero offset renders
+    as 'Z', never '+00:00'."""
+    s = dt.isoformat()
+    if dt.tzinfo is None:
+        return s + "Z"
+    return s[:-6] + "Z" if s.endswith("+00:00") else s
+
+
 def to_json_value(v: Val) -> Any:
     """Value as it appears in a query JSON response (ref
     query/outputnode.go fastJsonNode valToBytes)."""
     if v.tid == TypeID.DATETIME:
-        return v.value.isoformat()
+        return iso8601(v.value)
     if v.tid in (TypeID.INT, TypeID.FLOAT, TypeID.BOOL, TypeID.GEO):
         return v.value
     if v.tid == TypeID.BINARY:

@@ -130,6 +130,14 @@ def _bind(lib):
         lib.dgt_match_mask.argtypes = [
             u8p, ctypes.c_uint32, ctypes.c_int32, u8p,
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, u8p]
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.dgt_match_mask_idx.restype = ctypes.c_int
+        lib.dgt_match_mask_idx.argtypes = [
+            u8p, ctypes.c_uint32, ctypes.c_int32, u8p,
+            i64p, i64p, ctypes.c_int64, u8p]
+        lib.dgt_merge_count.restype = ctypes.c_int
+        lib.dgt_merge_count.argtypes = [
+            u64p, i64p, ctypes.c_int64, ctypes.c_int64, u64p, i64p]
         lib.dgt_tokenize_batch.restype = ctypes.c_int
         lib.dgt_tokenize_batch.argtypes = [
             u8p, u64p, ctypes.c_uint32, ctypes.c_uint32,
@@ -414,6 +422,57 @@ def match_mask(term_lower: bytes, max_d: int, blob, offsets) -> "object":
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     return out[:n]
+
+
+def match_mask_idx(term_lower: bytes, max_d: int, blob, offsets,
+                   idx) -> "object":
+    """match_mask over SELECTED rows of a cached whole-column payload
+    blob; None when native is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    import numpy as np
+    blob = np.ascontiguousarray(blob, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    n = len(idx)
+    out = np.zeros(max(n, 1), np.uint8)
+    lib.dgt_match_mask_idx(
+        _buf(term_lower), len(term_lower), max_d,
+        blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out[:n]
+
+
+def merge_count(buckets: "list", need: int) -> "object":
+    """uids appearing in >= need of the given SORTED uid buckets, via
+    one k-way linear merge (no concatenate+sort). None when native is
+    unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    import numpy as np
+    offs = np.zeros(len(buckets) + 1, np.int64)
+    np.cumsum([len(b) for b in buckets], out=offs[1:])
+    total = int(offs[-1])
+    if total == 0:
+        return np.empty(0, np.uint64)
+    vals = np.empty(total, np.uint64)
+    for i, b in enumerate(buckets):
+        vals[offs[i]:offs[i + 1]] = b
+    out = np.empty(total, np.uint64)
+    out_n = ctypes.c_int64(0)
+    rc = lib.dgt_merge_count(
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(buckets), need,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.byref(out_n))
+    if rc != 0:
+        return None
+    return out[:out_n.value].copy()
 
 
 # dgt_tokenize_batch mode bits (mirror native.cc)

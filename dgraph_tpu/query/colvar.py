@@ -46,15 +46,21 @@ class ColVar(Mapping):
     isbool: math comparison result — materialize as BOOL
     """
 
-    __slots__ = ("uids", "vals", "tid", "frac", "isbool", "_d")
+    __slots__ = ("uids", "vals", "tid", "frac", "isbool", "objs",
+                 "_d")
 
     def __init__(self, uids: np.ndarray, vals: np.ndarray, tid: TypeID,
-                 frac: bool = False, isbool: bool = False):
+                 frac: bool = False, isbool: bool = False, objs=None):
         self.uids = uids
         self.vals = vals
         self.tid = tid
         self.frac = frac
         self.isbool = isbool
+        # DATETIME vars: vals carry float epoch seconds (the domain
+        # math works in, aggregator.go applySince semantics) while
+        # objs holds the EXACT datetime objects for materialization —
+        # reconstruction from floats would lose precision and tz
+        self.objs = objs
         self._d: Optional[dict] = None
 
     # -- Mapping protocol: cheap paths never materialize ---------------
@@ -115,17 +121,42 @@ class ColVar(Mapping):
 
     def dict(self) -> dict:
         if self._d is None:
-            self._d = {u: self.to_val(v) for u, v in
-                       zip(self.uids.tolist(), self.vals.tolist())}
+            if self.objs is not None:
+                self._d = {u: Val(self.tid, o) for u, o in
+                           zip(self.uids.tolist(), self.objs.tolist())}
+            else:
+                self._d = {u: self.to_val(v) for u, v in
+                           zip(self.uids.tolist(), self.vals.tolist())}
         return self._d
 
     def floats(self) -> np.ndarray:
         """Values as float64 — the domain _eval_math works in."""
         return self.vals.astype(np.float64, copy=False)
 
+    def take(self, uids: np.ndarray) -> "ColVar":
+        """Subset ColVar for a sorted uid array, preserving the exact
+        object column when present."""
+        if not len(uids) or not len(self.uids):
+            return ColVar(uids[:0], self.vals[:0], self.tid, self.frac,
+                          self.isbool,
+                          None if self.objs is None else self.objs[:0])
+        pos = np.searchsorted(self.uids, uids)
+        pos = np.minimum(pos, len(self.uids) - 1)
+        hit = self.uids[pos] == uids
+        sel = pos[hit]
+        return ColVar(uids[hit], self.vals[sel], self.tid, self.frac,
+                      self.isbool,
+                      None if self.objs is None else self.objs[sel])
+
     def sort_keys(self) -> np.ndarray:
         """Order-preserving int64 keys, vectorizing models.types.sort_key
         for the numeric types a ColVar carries."""
+        if self.tid == TypeID.DATETIME and self.objs is not None:
+            from dgraph_tpu.models.types import sort_key
+            return np.fromiter(
+                (sort_key(Val(TypeID.DATETIME, o))
+                 for o in self.objs.tolist()),
+                np.int64, len(self.objs))
         if self.isbool or self.tid == TypeID.BOOL:
             return self.vals.astype(np.int64)
         if self.frac:

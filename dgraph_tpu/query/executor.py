@@ -21,6 +21,7 @@ against each other.
 from __future__ import annotations
 
 import re as _re
+from collections.abc import Mapping
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -165,7 +166,8 @@ def _flat_column(ex, ch, name: str, ulist: list, n: int):
     if tid in (TypeID.STRING, TypeID.DEFAULT, TypeID.DATETIME):
         try:
             if tid == TypeID.DATETIME:
-                enc = [v.value.isoformat().encode("utf-8")
+                from dgraph_tpu.models.types import iso8601
+                enc = [iso8601(v.value).encode("utf-8")
                        for v in sels]
             else:
                 enc = [v.value.encode("utf-8") for v in sels]
@@ -283,6 +285,7 @@ class ExecNode:
     # per-level resolved child list (expand() re-resolves per level)
     recurse_levels: list[dict[int, np.ndarray]] = field(default_factory=list)
     recurse_preds: list[list] = field(default_factory=list)
+    emit_order: Optional[list[int]] = None  # path-var traversal order
     path_nodes: list[list[int]] = field(default_factory=list)  # shortest
     path_weights: list[float] = field(default_factory=list)
     # columnar emission fast path: uid -> ready json value for flat
@@ -297,6 +300,7 @@ class Executor:
         self.parsed: Optional[ParsedResult] = None
         self.uid_vars: dict[str, np.ndarray] = {}
         self.value_vars: dict[str, dict[int, Val]] = {}
+        self._path_var_order: dict[str, list[int]] = {}
 
     # ------------------------------------------------------------------
     # block scheduling (ref query.go:2596 dependency loop)
@@ -339,7 +343,9 @@ class Executor:
             if gq.alias in ("var", "shortest") and gq.attr != "shortest":
                 continue
             if gq.attr == "shortest":
-                out["_path_"] = self._emit_paths(node)
+                paths = self._emit_paths(node)
+                if paths:
+                    out["_path_"] = paths
                 continue
             out[gq.alias] = self._emit_block(node)
         return out
@@ -483,6 +489,17 @@ class Executor:
             if gq.filter is not None:
                 root = self._eval_filter(gq.filter, root)
             root = self._order_paginate(gq, root)
+        if not gq.order and gq.func is not None \
+                and gq.func.name == "uid" and len(gq.func.needs_var) == 1:
+            ordered = self._path_var_order.get(
+                gq.func.needs_var[0].name)
+            if ordered:
+                # PATH vars emit in traversal order (ref query3_test.go
+                # TestShortestPathRev) — but only the EMISSION reorders;
+                # node.dest stays uid-sorted (searchsorted invariant of
+                # every columnar consumer)
+                inset = set(root.tolist())
+                node.emit_order = [u for u in ordered if u in inset]
         node.dest = root
         if gq.var:
             self.uid_vars[gq.var] = root
@@ -728,25 +745,47 @@ class Executor:
             # values were: `eq(pred@de, ...)` uses the German analyzer;
             # `@.` (any language) probes every analyzer's buckets
             langs = _probe_langs(spec, lang)
+            no_tok_vals: list[Val] = []
             for v in vals:
+                v_toks = 0
                 for lg in langs:
                     try:
                         toks = tokens_for(v, spec, lg)
                     except (ValueError, TypeError):
                         continue
+                    v_toks += len(toks)
                     for t in toks:
                         got = tab.index_uids(token_bytes(spec.ident, t),
                                              self.read_ts)
                         out = _union(out, got)
-            if spec.lossy or tab.schema.lang:
-                # @lang predicates share index buckets across language
-                # tags (the token carries no lang), so the index hit
-                # must be verified against the posting the query's
-                # lang selector actually addresses: eq(name, "") must
-                # not match a value that is empty only in @hi (ref
-                # query0_test.go TestQueryEmptyDefaultNames)
-                out = self._verify_eq(tab, out, vals, lang)
-            return out if candidates is None else _intersect(candidates, out)
+                if not v_toks:
+                    # a value no tokenizer emits tokens for (e.g. "")
+                    # is absent from the index — PER VALUE, scan it
+                    # below and union (ref
+                    # TestQueryEmptyRoomsWithTermIndex; eq(room,
+                    # ["", "green"]) must match both)
+                    no_tok_vals.append(v)
+            if len(no_tok_vals) < len(vals):
+                if spec.lossy or tab.schema.lang:
+                    # @lang predicates share index buckets across
+                    # language tags (the token carries no lang), so
+                    # the index hit must be verified against the
+                    # posting the query's lang selector actually
+                    # addresses: eq(name, "") must not match a value
+                    # that is empty only in @hi (ref query0_test.go
+                    # TestQueryEmptyDefaultNames)
+                    out = self._verify_eq(tab, out, vals, lang)
+                if no_tok_vals:
+                    scan = candidates if candidates is not None \
+                        else tab.src_uids(self.read_ts)
+                    extra = np.asarray(
+                        [u for u in scan.tolist()
+                         if self._value_matches_eq(
+                             tab, u, no_tok_vals, lang)], np.uint64)
+                    out = _union(out, extra)
+                return out if candidates is None \
+                    else _intersect(candidates, out)
+            # EVERY value was tokenless: plain scan below
         # unindexed: value scan over candidates (filter context) or all
         scan = candidates if candidates is not None \
             else tab.src_uids(self.read_ts)
@@ -802,6 +841,10 @@ class Executor:
             tid = TypeID.STRING
         if fn.is_value_var:
             return self._eval_var_fn(fn, candidates)
+        if tid == TypeID.BOOL:
+            raise GQLError(
+                f"attribute {fn.attr!r} is not sortable; only eq "
+                "applies to bool values (ref TestBoolIndexgeRoot)")
         try:
             if fn.name == "between":
                 lo = sort_key(convert(Val(TypeID.DEFAULT, fn.args[0].value), tid))
@@ -869,6 +912,12 @@ class Executor:
             else tab.src_uids(self.read_ts)
         for u in scan.tolist():
             for p in tab.get_postings(u, self.read_ts):
+                if not _lang_matches(p.lang, fn.lang or ""):
+                    # lt(name, v) compares the UNTAGGED value only;
+                    # lt(name@de, v) the @de one (ref query0_test.go
+                    # TestQueryNamesBeforeA: a value empty only in
+                    # @hi must not satisfy lt(name, "A"))
+                    continue
                 s = str(p.value.value)
                 ok = ((op == "le" and s <= want) or (op == "lt" and s < want)
                       or (op == "ge" and s >= want) or (op == "gt" and s > want)
@@ -1104,11 +1153,15 @@ class Executor:
                         for t in toks]
                     buckets = [b for b in buckets if len(b)]
                     if buckets:
-                        uids, counts = np.unique(
-                            np.concatenate(buckets),
-                            return_counts=True)
                         need = max(1, len(toks) - 3 * maxd)
-                        scan = uids[counts >= need]
+                        from dgraph_tpu import native as _nat
+                        scan = _nat.merge_count(buckets, need) \
+                            if _nat.available() else None
+                        if scan is None:
+                            uids, counts = np.unique(
+                                np.concatenate(buckets),
+                                return_counts=True)
+                            scan = uids[counts >= need]
                     else:
                         scan = _EMPTY
         if scan is None:
@@ -1167,9 +1220,13 @@ class Executor:
             return None if m is None else cand_srcs[m == 1]
 
         pos, hit = _col_positions(srcs, scan)
-        got = masked(scan[hit], [enc[j] for j in pos[hit].tolist()])
-        if got is None:
+        sel = pos[hit]
+        blob, boffs = colview.payload_blob()
+        m = _native.match_mask_idx(want.encode("utf-8"), maxd,
+                                   blob, boffs, sel)
+        if m is None:
             return None
+        got = scan[hit][m == 1]
         keep = [got]
         if len(colview.extra_srcs):
             # lang-tagged payloads of candidate uids, same batch call
@@ -1335,6 +1392,7 @@ class Executor:
         want_raw = fn.args[0].value if fn.args else None
         scan = candidates if candidates is not None else _var_domain(vmap)
         if isinstance(vmap, ColVar) and not vmap.frac \
+                and vmap.tid != TypeID.DATETIME \
                 and fn.name in _CMP_VEC:
             # columnar filter: one gather + one vector compare (ref
             # query.go val-var filters; the dict walk remains only for
@@ -1398,21 +1456,27 @@ class Executor:
         # keeps the listed order. Unresolvable needs fall back to the
         # listed order (outer blocks / genuinely-undefined vars).
         nodes: dict[int, ExecNode] = {}
-        pending = list(enumerate(children))
-        while pending:
-            progressed = False
-            for i, cgq in list(pending):
-                unmet = [vc.name for vc in self._all_needs(cgq)
-                         if not self._var_defined(vc.name)
-                         and vc.name in getattr(self, "_block_vars", ())]
-                if not unmet:
-                    pending.remove((i, cgq))
-                    nodes[i] = self._process_child(cgq, src)
-                    progressed = True
-            if not progressed:
-                for i, cgq in pending:
-                    nodes[i] = self._process_child(cgq, src)
-                break
+        prev_sib = getattr(self, "_sibling_nodes", None)
+        self._sibling_nodes = nodes
+        try:
+            pending = list(enumerate(children))
+            while pending:
+                progressed = False
+                for i, cgq in list(pending):
+                    unmet = [vc.name for vc in self._all_needs(cgq)
+                             if not self._var_defined(vc.name)
+                             and vc.name
+                             in getattr(self, "_block_vars", ())]
+                    if not unmet:
+                        pending.remove((i, cgq))
+                        nodes[i] = self._process_child(cgq, src)
+                        progressed = True
+                if not progressed:
+                    for i, cgq in pending:
+                        nodes[i] = self._process_child(cgq, src)
+                    break
+        finally:
+            self._sibling_nodes = prev_sib
         for i in range(len(children)):
             parent.children.append(nodes[i])
 
@@ -1441,9 +1505,10 @@ class Executor:
                     preds = [p for p in self.db.schema.predicates()
                              if not p.startswith("dgraph.")]
             else:
-                td = self.db.schema.get_type(c.expand)
-                if td:
-                    preds = td.fields
+                for tname in c.expand.split(","):
+                    td = self.db.schema.get_type(tname)
+                    if td:
+                        preds.extend(td.fields)
             seen = set()
             for pname in preds:
                 if pname in seen:
@@ -1451,6 +1516,21 @@ class Executor:
                 seen.add(pname)
                 sub = GraphQuery(attr=pname, children=list(c.children),
                                  filter=c.filter)
+                tab = self.db.tablets.get(pname)
+                if c.filter is not None and (
+                        tab is None
+                        or tab.schema.value_type != TypeID.UID):
+                    # expand() @filter filters the expanded EDGES'
+                    # targets; scalar predicates have none and drop
+                    # out entirely (ref query4_test.go
+                    # TestTypeFilterAtExpand: only `owner` survives)
+                    continue
+                if tab is not None and tab.schema.lang \
+                        and tab.schema.value_type != TypeID.UID:
+                    # expanded @lang preds emit every language under
+                    # attr@lang keys (ref query4_test.go
+                    # TestTypeExpandLang: model + model@jp)
+                    sub.langs = ["*"]
                 out.append(sub)
         return out
 
@@ -1560,10 +1640,29 @@ class Executor:
             if gq.var:
                 self.uid_vars[gq.var] = dest
             if gq.is_count:
-                if hasattr(tab, "prefetch_counts"):
-                    tab.prefetch_counts(src, node.reverse)
-                for u in src.tolist():
-                    node.counts[u] = self._child_count(tab, u, node.reverse)
+                if gq.filter is not None:
+                    # count(pred @filter(...)): per-parent size of the
+                    # edge list INTERSECTED with the filtered union
+                    # (ref TestQueryEmptyRoomsWithTermIndex)
+                    get = tab.get_reverse_uids if node.reverse \
+                        else tab.get_dst_uids
+                    for u in src.tolist():
+                        node.counts[u] = len(_intersect(
+                            get(u, self.read_ts), dest))
+                else:
+                    if hasattr(tab, "prefetch_counts"):
+                        tab.prefetch_counts(src, node.reverse)
+                    for u in src.tolist():
+                        node.counts[u] = self._child_count(
+                            tab, u, node.reverse)
+                if gq.var:
+                    # `s as count(friend)` binds a per-parent value
+                    # var, zero for parents with no edges (ref
+                    # query0_test.go TestQueryVarValAggOrderDesc: the
+                    # friendless uid still carries count 0)
+                    self.value_vars[gq.var] = {
+                        int(u): Val(TypeID.INT, node.counts.get(u, 0))
+                        for u in src.tolist()}
             elif gq.is_groupby:
                 # emission groups per parent; var assignment aggregates
                 # over the whole block's edge set now so later blocks
@@ -1578,6 +1677,8 @@ class Executor:
             # per-uid posting walk entirely — at the 21M regime this
             # loop dominates var-heavy aggregation queries (q020)
             if self._bind_var_columnar(node, gq, tab, src):
+                return node
+            if self._bind_var_emit_columnar(node, gq, tab, src):
                 return node
             cv = self._colvals_for_emit(tab, gq, src)
             if cv is not None:
@@ -1679,6 +1780,50 @@ class Executor:
             self.value_vars[gq.var] = {
                 u: Val(tid, enc[j].decode("utf-8"))
                 for u, j in zip(src[hit].tolist(), sel.tolist())}
+        return True
+
+    def _bind_var_emit_columnar(self, node: ExecNode, gq, tab,
+                                src: np.ndarray) -> bool:
+        """Emitting block that ALSO binds a var (d as pred): serve the
+        emission from the column view AND bind the var columnarly —
+        datetime vars carry (float epoch seconds, exact objects) so
+        math/since() stays vectorized (ref query/math.go:213,
+        aggregator.go applySince) while materialization stays exact.
+        The q046 shape walked 1M postings per query otherwise."""
+        if not gq.var or gq.langs or gq.is_count or gq.facet_var \
+                or gq.children or gq.facets is not None \
+                or tab.schema.list_:
+            return False
+        colview = tab.value_columns(self.read_ts) \
+            if hasattr(tab, "value_columns") else None
+        if colview is None or len(colview.extra_srcs):
+            return False
+        self._budget_colview(tab, colview)
+        srcs, tid, data, enc = colview
+        pos, hit = _col_positions(srcs, src)
+        sel = pos[hit]
+        bound = src[hit]
+        if data is not None:
+            vmap = make_colvar(bound, data[sel], tid)
+            if vmap is None:
+                return False
+            if tid == TypeID.BOOL:
+                vals = [bool(v) for v in data[sel].tolist()]
+            else:
+                vals = data[sel].tolist()
+        elif tid == TypeID.DATETIME and colview.dt_secs is not None:
+            vmap = ColVar(bound, colview.dt_secs[sel], TypeID.DATETIME,
+                          objs=colview.dt_objs[sel])
+            vals = [enc[j].decode("utf-8") for j in sel.tolist()]
+        elif tid in (TypeID.STRING, TypeID.DEFAULT):
+            vals = [enc[j].decode("utf-8") for j in sel.tolist()]
+            vmap = {u: Val(tid, v)
+                    for u, v in zip(bound.tolist(), vals)}
+        else:
+            return False
+        inc_counter("query_columnar_var_bind_total")
+        self.value_vars[gq.var] = vmap
+        node.col_vals = dict(zip(bound.tolist(), vals))
         return True
 
     # -- facets (ref worker/task.go:1806 applyFacetsTree,
@@ -1894,9 +2039,26 @@ class Executor:
     def _process_internal(self, node: ExecNode):
         gq = node.gq
         if gq.agg_func:
+            if not gq.needs_var:
+                # max(pred): only valid inside @groupby (ref
+                # groupby.go aggregateGroup; elsewhere the reference
+                # rejects it)
+                raise GQLError(
+                    f"aggregation {gq.agg_func}({gq.agg_pred}) is "
+                    "only allowed inside @groupby; use "
+                    f"{gq.agg_func}(val(var)) here")
             vc = gq.needs_var[0]
             vmap = self.value_vars.get(vc.name, {})
             src = node.src
+            if gq.var and len(src) \
+                    and self._agg_per_parent(node, vc.name, vmap):
+                # `n as min(val(x))` with x bound in a SIBLING subtree:
+                # one aggregate PER PARENT over that parent's reachable
+                # x values, bound as a value var (ref query.go
+                # valueVarAggregation — TestQueryVarValAggNestedFunc*
+                # shapes). Bare aggregations keep the whole-block
+                # scalar below.
+                return
             whole = vc.name in getattr(self, "_block_vars", ()) \
                 or not len(src)
             # bound by this block's own subtree (facet var, deeper
@@ -1906,7 +2068,8 @@ class Executor:
             # TestLevelBasedFacetVarAggSum; a same-level var's
             # keys equal this level's src so whole == restricted);
             # an outer-block var restricts to this level's uids
-            if isinstance(vmap, ColVar):
+            if isinstance(vmap, ColVar) \
+                    and vmap.tid != TypeID.DATETIME:
                 arr = vmap.vals if whole else vmap.gather(src)[1]
                 agg = _aggregate_col(gq.agg_func, arr, vmap)
             else:
@@ -1915,7 +2078,7 @@ class Executor:
                 agg = _aggregate(gq.agg_func, vals)
             node.values[0] = [Agg(gq.agg_func, agg)]
         elif gq.math is not None:
-            vmap = _eval_math(gq.math, self.value_vars)
+            vmap = _eval_math(gq.math, self.value_vars, node.src)
             if gq.var:
                 self.value_vars[gq.var] = vmap
             node.values = _internal_values(vmap, node.src, "math")
@@ -1924,6 +2087,73 @@ class Executor:
             vmap = self.value_vars.get(vc.name, {})
             node.values = _internal_values(vmap, node.src, "val")
 
+    def _agg_per_parent(self, node: ExecNode, name: str,
+                        vmap) -> bool:
+        """Level-based aggregation (ref query.go valueVarAggregation):
+        when the aggregated var is bound inside a sibling subtree of
+        the same block, each PARENT uid aggregates over the x values
+        reachable through that sibling's edges. Binds the result var
+        and per-parent node.values; returns False when no sibling
+        chain provides the var (caller keeps whole-block semantics)."""
+        sibs = getattr(self, "_sibling_nodes", None)
+        if not sibs:
+            return False
+        chain = None
+        for e in sibs.values():
+            if e is node:
+                continue
+            if e.gq.var == name:
+                chain = []  # bound on the parent level itself
+                break
+            if e.tablet is not None \
+                    and (e.tablet.schema.value_type == TypeID.UID
+                         or e.reverse):
+                sub = self._chain_to(e, name)
+                if sub is not None:
+                    chain = sub
+                    break
+        if chain is None:
+            return False
+        gq = node.gq
+        out: dict[int, Val] = {}
+        for p in node.src.tolist():
+            frontier = [int(p)]
+            for e in chain:
+                nxt: list[int] = []
+                get = e.tablet.get_reverse_uids if e.reverse \
+                    else e.tablet.get_dst_uids
+                dest = e.dest
+                for u in frontier:
+                    ds = get(u, self.read_ts)
+                    if len(dest):
+                        ds = _intersect(ds, dest)
+                    nxt.extend(int(d) for d in ds.tolist())
+                frontier = sorted(set(nxt))
+            vals = [vmap[u] for u in frontier if u in vmap]
+            agg = _aggregate(gq.agg_func, vals)
+            if agg is not None:
+                out[int(p)] = agg
+                node.values[int(p)] = [Agg(gq.agg_func, agg)]
+        self.value_vars[gq.var] = out
+        return True
+
+    def _chain_to(self, e: ExecNode, name: str):
+        """Edge-node path from sibling `e` down to the subtree level
+        that binds `name` (scalar var or facet var), or None."""
+        if name in e.gq.facet_var.values():
+            return [e]
+        for c in e.children:
+            if c.gq.var == name:
+                return [e]
+        for c in e.children:
+            if c.tablet is not None \
+                    and (c.tablet.schema.value_type == TypeID.UID
+                         or c.reverse):
+                sub = self._chain_to(c, name)
+                if sub is not None:
+                    return [e] + sub
+        return None
+
     # ------------------------------------------------------------------
     # order + pagination (ref query.go:2231 applyOrderAndPagination)
     # ------------------------------------------------------------------
@@ -1931,6 +2161,13 @@ class Executor:
     def _order_paginate(self, gq: GraphQuery, uids: np.ndarray
                         ) -> np.ndarray:
         if gq.order:
+            for o in gq.order:
+                if o.attr.startswith("val("):
+                    # ordering by val(v) keeps ONLY uids v is bound
+                    # for (ref query0_test.go
+                    # TestQueryVarValOrderDescMissing -> empty)
+                    vmap = self.value_vars.get(o.attr[4:-1], {})
+                    uids = _intersect(uids, _var_domain(vmap))
             paged = self._device_order_page(gq, uids)
             if paged is not None:
                 return paged
@@ -2232,11 +2469,10 @@ class Executor:
         if attr.startswith("val("):
             vmap = self.value_vars.get(attr[4:-1], {})
             if isinstance(vmap, ColVar):
-                uarr, varr = vmap.gather(np.asarray(uids, np.uint64))
-                sub = ColVar(uarr, varr, vmap.tid, vmap.frac,
-                             vmap.isbool)
+                sub = vmap.take(np.asarray(uids, np.uint64))
                 return {int(u): (0, int(k)) for u, k in
-                        zip(uarr.tolist(), sub.sort_keys().tolist())}
+                        zip(sub.uids.tolist(),
+                            sub.sort_keys().tolist())}
             for u in uids.tolist():
                 v = vmap.get(u)
                 if v is not None:
@@ -2259,6 +2495,10 @@ class Executor:
         for u in uids.tolist():
             ps = tab.get_postings(u, self.read_ts)
             sel = self._select_posting(ps, [lang] if lang else [])
+            if sel is None and lang and ps:
+                # sorting falls back tag -> untagged -> first (ref
+                # posting.List.ValueFor; TestToFastJSONOrderLang)
+                sel = self._select_posting(ps, []) or ps[0]
             if sel is not None:
                 try:
                     # strict schema-type conversion, matching
@@ -2307,7 +2547,9 @@ class Executor:
 
     def _run_recurse(self, node: ExecNode):
         gq = node.gq
-        depth = gq.recurse.depth or 64
+        # depth counts LEVELS including the root: depth 2 expands one
+        # edge hop (ref query3_test.go TestRecurseQueryLimitDepth1)
+        depth = (gq.recurse.depth or 64) - 1
         allow_loop = gq.recurse.allow_loop
         frontier = node.dest
         visited = frontier.copy()
@@ -2412,12 +2654,13 @@ class Executor:
                 # device-resident (fall through to host)
                 self._finish_shortest(
                     node,
-                    [(path, float(len(path) - 1))] if path else [])
+                    [(path, float(len(path) - 1))] if path else [],
+                    pred_specs)
                 return
         paths = self._k_shortest(pred_specs, src, dst, maxdepth,
                                  max(1, sa.numpaths),
                                  sa.minweight, sa.maxweight)
-        self._finish_shortest(node, paths)
+        self._finish_shortest(node, paths, pred_specs)
 
     def _shortest_preds(self, gq) -> list[tuple]:
         """[(attr, tablet, reverse, weight_facet_key)] for the block's
@@ -2452,14 +2695,18 @@ class Executor:
             for d in dsts.tolist():
                 w = 1.0
                 if wkey:
-                    # facets live on the forward edge
+                    # facets live on the forward edge; an edge MISSING
+                    # the weight facet is unusable in weighted mode
+                    # (ref query3_test.go TestKShortestPathWeighted:
+                    # only the fully-faceted route exists)
                     fsrc, fdst = (d, u) if rev else (u, d)
                     fv = tab.get_facets(fsrc, fdst, self.read_ts).get(wkey)
-                    if fv is not None:
-                        try:
-                            w = float(fv.value)
-                        except (TypeError, ValueError):
-                            w = 1.0
+                    if fv is None:
+                        continue
+                    try:
+                        w = float(fv.value)
+                    except (TypeError, ValueError):
+                        continue
                 out.append((int(d), w))
         return out
 
@@ -2559,14 +2806,18 @@ class Executor:
             found.append((p, w))
         return [(p, w) for p, w in found if in_window(w)][:k]
 
-    def _finish_shortest(self, node: ExecNode, paths):
+    def _finish_shortest(self, node: ExecNode, paths, pred_specs=None):
         node.path_nodes = [p for p, _ in paths]
         node.path_weights = [w for _, w in paths]
+        node.path_specs = pred_specs or []
         gq = node.gq
         if gq.var:
             # the uid var holds the FIRST (best) path, ref shortest.go
             if paths:
                 self.uid_vars[gq.var] = _np_sorted(paths[0][0])
+                # consumers of a PATH var emit in traversal order, not
+                # uid order (ref query3_test.go TestShortestPathRev)
+                self._path_var_order[gq.var] = list(paths[0][0])
             else:
                 self.uid_vars[gq.var] = _EMPTY
 
@@ -2641,8 +2892,9 @@ class Executor:
     def _emit_block(self, node: ExecNode) -> list:
         gq = node.gq
         if gq.recurse is not None:
-            return [self._emit_recurse_node(node, int(u), 0)
-                    for u in node.dest.tolist()]
+            return [r for r in
+                    (self._emit_recurse_node(node, int(u), 0)
+                     for u in node.dest.tolist()) if r]
         if gq.is_groupby:
             # root-level @groupby groups the block's matched uids (ref
             # query0_test.go TestGroupByRoot:
@@ -2661,11 +2913,14 @@ class Executor:
             # count-only block: the per-uid walk below would emit (and
             # drop) an empty object per row — 0.5s of the 21M q009
             return out
-        for u in node.dest.tolist():
+        order = node.emit_order if node.emit_order is not None \
+            else node.dest.tolist()
+        for u in order:
             # @ignorereflex: track the result path so children never
             # re-emit an ancestor (ref query.go:164 removeCycles)
             path = frozenset({int(u)}) if gq.ignore_reflex else None
-            obj = self._emit_uid(node, int(u), path)
+            obj = self._emit_uid(node, int(u), path,
+                                 normalize=gq.normalize)
             if obj:  # empty objects are dropped (ref outputnode.go)
                 out.append(obj)
         # row-less blocks (q() { min(val(a)) }) emit aggregations as
@@ -2685,9 +2940,18 @@ class Executor:
         return out
 
     def _emit_uid(self, node: ExecNode, uid: int,
-                  path: Optional[frozenset] = None) -> Optional[dict]:
+                  path: Optional[frozenset] = None,
+                  cascade: bool = False,
+                  normalize: bool = False) -> Optional[dict]:
         obj: dict[str, Any] = {}
         gq = node.gq
+        # @cascade and @normalize apply to the WHOLE subtree under the
+        # block that declares them (ref query.go applyCascade;
+        # @normalize keeps ONLY aliased attributes —
+        # query2_test.go TestNormalizeDirective drops bare `gender`)
+        cascade = cascade or gq.cascade
+        normalize = normalize or gq.normalize
+        have: set[str] = set()  # names satisfied but normalize-hidden
         children = node.children
         if not children:
             obj["uid"] = hex(uid)
@@ -2695,25 +2959,50 @@ class Executor:
         for ch in children:
             cgq = ch.gq
             name = cgq.alias or cgq.attr
+            if normalize and not cgq.alias and ch.tablet is not None \
+                    and ch.tablet.schema.value_type != TypeID.UID \
+                    and not (cgq.is_count or ch.reverse):
+                # @normalize: bare scalars don't emit — but @cascade's
+                # presence check still counts a value that EXISTS
+                if (ch.col_vals or {}).get(uid) is not None \
+                        or ch.values.get(uid):
+                    have.add(name)
+                continue
+            if normalize and not cgq.alias and cgq.attr == "uid" \
+                    and not cgq.is_count:
+                continue
             if cgq.langs and not cgq.alias:
                 name = f"{cgq.attr}@{':'.join(cgq.langs)}"
             if cgq.attr == "uid":
                 if cgq.is_count:
                     continue  # count(uid) handled at parent level
-                obj["uid"] = hex(uid)
+                obj[cgq.alias or "uid"] = hex(uid)
+                continue
+            if normalize and not cgq.alias \
+                    and (cgq.agg_func or cgq.attr == "math"
+                         or cgq.attr.startswith("val(")
+                         or cgq.is_count):
                 continue
             if cgq.agg_func:
                 # aggregations attach INSIDE each parent row (ref
                 # outputnode.go preTraverse: the agg subgraph hangs
                 # under its parent node — TestLevelBasedFacetVarAggSum
-                # shape); row-less blocks emit them standalone in
-                # _emit_block instead
-                if 0 in ch.values:
-                    agg = ch.values[0][0]
-                    if agg.value is not None:
-                        obj[name] = to_json_value(agg.value)
+                # shape); per-parent (level-based) aggregates emit the
+                # parent's own value under the VAR name; row-less
+                # blocks emit them standalone in _emit_block instead
+                vs = ch.values.get(uid)
+                if vs is not None and cgq.var:
+                    name = cgq.alias or cgq.var
+                if vs is None:
+                    vs = ch.values.get(0)
+                if vs is not None and vs[0].value is not None:
+                    obj[name] = to_json_value(vs[0].value)
                 continue
             if cgq.attr == "math" or cgq.attr.startswith("val("):
+                if cgq.attr == "math" and cgq.var and not cgq.alias:
+                    # `sum as math(...)` emits under "val(sum)" (ref
+                    # TestQueryVarValAggOrderDesc expected shape)
+                    name = f"val({cgq.var})"
                 vs = ch.values.get(uid)
                 if vs:
                     obj[name] = to_json_value(vs[0].value)
@@ -2739,7 +3028,9 @@ class Executor:
                 if path is not None and len(dsts):
                     dsts = _difference(dsts, _np_sorted(path))
                 if cgq.is_groupby:
-                    obj[name] = self._emit_groupby(ch, dsts)
+                    # the reference emits child groupby as a one-
+                    # element array (query0_test.go TestGroupBy shape)
+                    obj[name] = [self._emit_groupby(ch, dsts)]
                     continue
                 facet_orders = [o for o in cgq.order
                                 if o.attr.startswith("facet:")]
@@ -2750,7 +3041,8 @@ class Executor:
                     dsts = self._order_paginate(cgq, dsts)
                 counts = [c for c in cgq.children
                           if c.attr == "uid" and c.is_count]
-                if counts:
+                if counts and all(c.attr == "uid" and c.is_count
+                                  for c in cgq.children):
                     obj[name] = [{counts[0].alias or "count": len(dsts)}]
                     continue
                 if cgq.facets is not None \
@@ -2766,7 +3058,9 @@ class Executor:
                 for d in dsts.tolist():
                     sub = self._emit_uid(
                         ch, int(d),
-                        path | {int(d)} if path is not None else None)
+                        path | {int(d)} if path is not None else None,
+                        cascade or cgq.cascade,
+                        normalize or cgq.normalize)
                     if sub is None:
                         continue
                     if cgq.facets is not None:
@@ -2776,9 +3070,26 @@ class Executor:
                         self._attach_facets(sub, cgq.facets, fc, name)
                     if sub:
                         items.append(sub)
+                if counts and len(dsts):
+                    # count(uid) alongside siblings: the count rides
+                    # as an extra row object even when every sibling
+                    # row came up empty — but an empty EDGE LIST emits
+                    # no key at all (ref query1_test.go
+                    # TestCountAtRoot3: Daryl has count(friend):0 and
+                    # NO friend key)
+                    items.append({counts[0].alias or "count":
+                                  len(dsts)})
                 if items:
-                    obj[name] = items
-                elif gq.cascade or cgq.cascade:
+                    # a non-list uid predicate emits its single target
+                    # as an OBJECT (ref query0_test.go
+                    # TestGetNonListUidPredicate); reverse edges and
+                    # count-carrying lists stay list-shaped
+                    if not tab.schema.list_ and not ch.reverse \
+                            and not counts:
+                        obj[name] = items[0]
+                    else:
+                        obj[name] = items
+                elif cascade or cgq.cascade:
                     return None
             else:
                 if ch.col_vals is not None:
@@ -2786,7 +3097,7 @@ class Executor:
                     if v is not None:
                         obj[name] = v
                         continue
-                    if gq.cascade or cgq.cascade:
+                    if cascade or cgq.cascade:
                         return None
                     continue
                 ps = ch.values.get(uid)
@@ -2812,14 +3123,14 @@ class Executor:
                         if cgq.facets is not None:
                             self._attach_value_facets(obj, ch, ps, name)
                         continue
-                if gq.cascade or cgq.cascade:
+                if cascade or cgq.cascade:
                     return None
-        if node.gq.cascade:
+        if cascade:
             want = [c for c in children
                     if c.tablet is not None and not c.gq.is_count]
             for c in want:
                 nm = c.gq.alias or c.gq.attr
-                if nm not in obj:
+                if nm not in obj and nm not in have:
                     return None
         return obj
 
@@ -3070,7 +3381,27 @@ class Executor:
                     name = cgq.alias or \
                         f"{cgq.agg_func}(val({cgq.needs_var[0].name}))"
                     ent[name] = to_json_value(agg)
+            elif cgq.agg_func and cgq.agg_pred:
+                # max(name): aggregate a PREDICATE over the group's
+                # members (ref query0_test.go TestGroupByAgg)
+                agg = self._agg_pred_members(cgq, members)
+                if agg is not None:
+                    name = cgq.alias or \
+                        f"{cgq.agg_func}({cgq.agg_pred})"
+                    ent[name] = to_json_value(agg)
         return ent
+
+    def _agg_pred_members(self, cgq, members) -> Optional[Val]:
+        tab = self._tablet(cgq.agg_pred)
+        if tab is None:
+            return None
+        vals = []
+        for u in members:
+            ps = tab.get_postings(int(u), self.read_ts)
+            sel = self._select_posting(ps, cgq.langs or [])
+            if sel is not None:
+                vals.append(self._typed(tab, sel))
+        return _aggregate(cgq.agg_func, vals)
 
     def _emit_groupby(self, ch: ExecNode, dsts: np.ndarray) -> dict:
         """@groupby(attrs...) { count(uid) aggs... }
@@ -3109,11 +3440,20 @@ class Executor:
                     agg = _agg_members(cgq.agg_func, src, members)
                     if agg is not None:
                         vmap[guid] = agg
+                elif cgq.agg_func and cgq.agg_pred:
+                    agg = self._agg_pred_members(cgq, members)
+                    if agg is not None:
+                        vmap[guid] = agg
             self.value_vars[cgq.var] = vmap
 
     def _emit_recurse_node(self, node: ExecNode, uid: int, level: int
                            ) -> dict:
-        obj: dict[str, Any] = {"uid": hex(uid)}
+        # uid appears only when the block asks for it (ref
+        # query3_test.go TestRecurseQuery vs TestRecurseQueryLimitDepth2)
+        obj: dict[str, Any] = {}
+        if any(c.attr == "uid" and not c.is_count
+               for c in node.gq.children):
+            obj["uid"] = hex(uid)
         # per-level resolved children (expand() differs by level); the
         # deepest nodes reuse the last level's resolution for scalars
         if node.recurse_preds:
@@ -3146,24 +3486,58 @@ class Executor:
                 if not per_parent or uid not in per_parent:
                     continue
                 name = cgq.alias or attr
-                kids = [self._emit_recurse_node(node, int(d), level + 1)
-                        for d in self._order_paginate(
-                            cgq, per_parent[uid]).tolist()]
+                kids = [k for k in
+                        (self._emit_recurse_node(node, int(d),
+                                                 level + 1)
+                         for d in self._order_paginate(
+                             cgq, per_parent[uid]).tolist())
+                        if k]  # empty nodes drop (TestRecurseQuery:
+                #                the nameless friend never appears)
                 if kids:
                     obj[name] = kids
         return obj
 
     def _emit_paths(self, node: ExecNode) -> list:
+        """_path_ emission: the NESTED chain keyed by each hop's
+        traversed predicate, facet weight as `pred|key` on the hop's
+        child object (ref query/outputnode.go shortest-path subgraph +
+        query3_test.go TestKShortestPathWeighted shape)."""
         out = []
         weights = node.path_weights or [None] * len(node.path_nodes)
+        specs = getattr(node, "path_specs", None) or []
         for path, w in zip(node.path_nodes, weights):
-            # Dgraph emits a nested path via the traversed predicates; we
-            # emit the uid chain (same information, simpler shape) plus
-            # the route weight (ref shortest.go pathInfo.totalWeight)
-            entry = {"path": [{"uid": hex(u)} for u in path]}
+            if not path:
+                continue
+            tree: dict[str, Any] = {"uid": hex(path[0])}
             if w is not None:
-                entry["_weight_"] = w
-            out.append(entry)
+                # the reference renders weights %f-style (6 places), so
+                # an accumulated 0.30000000000000004 reads back as 0.3
+                tree["_weight_"] = float(f"{w:.6f}")
+            cur = tree
+            for u, v in zip(path, path[1:]):
+                hop = None
+                for attr, tab, rev, wkey in specs:
+                    get = tab.get_reverse_uids if rev \
+                        else tab.get_dst_uids
+                    ds = get(int(u), self.read_ts)
+                    if np.any(ds == v):
+                        hop = (attr, tab, rev, wkey)
+                        break
+                child: dict[str, Any] = {"uid": hex(int(v))}
+                if hop is None:
+                    cur["path"] = child
+                else:
+                    attr, tab, rev, wkey = hop
+                    cur[attr] = child
+                    if wkey:
+                        fsrc, fdst = (int(v), int(u)) if rev \
+                            else (int(u), int(v))
+                        fv = tab.get_facets(
+                            fsrc, fdst, self.read_ts).get(wkey)
+                        if fv is not None:
+                            child[f"{attr}|{wkey}"] = to_json_value(fv)
+                cur = child
+            out.append(tree)
         return out
 
     def _normalize(self, obj: dict) -> list[dict]:
@@ -3223,10 +3597,52 @@ def _internal_values(vmap, src: np.ndarray, kind: str) -> dict:
     block's own uids, so a columnar var materializes Vals for src
     alone — not its whole (possibly 21M-scale) domain."""
     if isinstance(vmap, ColVar) and src is not None and len(src):
-        uids, vals = vmap.gather(src)
-        return {int(u): [Agg(kind, vmap.to_val(v))]
-                for u, v in zip(uids.tolist(), vals.tolist())}
+        # materialize per ROW at emission, not per domain here: the
+        # block may paginate 1M var rows down to a handful (q046).
+        # src arrives in EMISSION order (post-sort) — the lazy map's
+        # lookups need an ascending domain
+        return _ColAggVals(vmap.take(np.sort(src)), kind)
     return {u: [Agg(kind, v)] for u, v in vmap.items()}
+
+
+class _ColAggVals(Mapping):
+    """node.values view over a ColVar subset: each emitted row
+    materializes its [Agg(Val)] on demand; exact object columns
+    (datetime vars) bypass the lossy float domain."""
+
+    __slots__ = ("sub", "kind")
+
+    def __init__(self, sub: ColVar, kind: str):
+        self.sub = sub
+        self.kind = kind
+
+    def __len__(self):
+        return len(self.sub.uids)
+
+    def __iter__(self):
+        return iter(self.sub.uids.tolist())
+
+    def __contains__(self, u):
+        arr = self.sub.uids
+        i = int(np.searchsorted(arr, np.uint64(u)))
+        return i < len(arr) and int(arr[i]) == int(u)
+
+    def get(self, u, default=None):
+        arr = self.sub.uids
+        i = int(np.searchsorted(arr, np.uint64(u)))
+        if i >= len(arr) or int(arr[i]) != int(u):
+            return default
+        if self.sub.objs is not None:
+            v = Val(self.sub.tid, self.sub.objs[i])
+        else:
+            v = self.sub.to_val(self.sub.vals[i])
+        return [Agg(self.kind, v)]
+
+    def __getitem__(self, u):
+        got = self.get(u)
+        if got is None:
+            raise KeyError(u)
+        return got
 
 
 def _aggregate_col(fn: str, arr: np.ndarray, cv: ColVar) -> Optional[Val]:
@@ -3338,11 +3754,15 @@ def _eval_math_vec(tree, value_vars):
     # 0.0/1.0 and carry the flag.
 
     def align(args):
-        """Intersect the uid domains of array args; broadcast consts."""
+        """Align array-arg uid domains; broadcast consts. Mismatched
+        domains need the dict path's union-with-zero semantics
+        (ref query/math.go:73) — bail rather than intersect."""
         arrs = [a for a in args if not isinstance(a, float)]
         uids = arrs[0][0]
         for a in arrs[1:]:
-            uids = _intersect(uids, a[0])
+            if len(a[0]) != len(uids) \
+                    or not np.array_equal(a[0], uids):
+                raise _VecFallback
         out = []
         for a in args:
             if isinstance(a, float):
@@ -3468,17 +3888,29 @@ def _eval_math_vec(tree, value_vars):
                   frac=True)
 
 
-def _eval_math(tree, value_vars) -> "dict[int, Val] | ColVar":
+def _eval_math(tree, value_vars, src=None) -> "dict[int, Val] | ColVar":
     """Per-uid math over value vars (ref query/math.go:213 processBinary).
     Tries the columnar path first; falls back to the per-uid dict walk
-    when a var isn't columnar or an op needs scalar semantics."""
+    when a var isn't columnar or an op needs scalar semantics. An
+    ALL-CONSTANT expression broadcasts over the enclosing block's uids
+    (ref query0_test.go TestQueryConstMathVal: `a as math(24/8 * 3)`
+    binds 9 for every root uid)."""
     import math as _m
+
+    def const_map(x):
+        if src is None or not len(src):
+            return {}
+        v = Val(TypeID.INT, int(x)) \
+            if float(x).is_integer() and abs(x) < 2**53 \
+            else Val(TypeID.FLOAT, float(x))
+        return {int(u): v for u in src.tolist()}
 
     try:
         cv = _eval_math_vec(tree, value_vars)
         if cv is not None:
             return cv
-        return {}
+        # None = all-constant tree: fall through so the dict path
+        # folds the scalar and broadcasts it
     except _VecFallback:
         pass
     except (TypeError, OverflowError):
@@ -3500,35 +3932,55 @@ def _eval_math(tree, value_vars) -> "dict[int, Val] | ColVar":
                     if v.tid in (TypeID.INT, TypeID.FLOAT, TypeID.BOOL,
                                  TypeID.DATETIME)}
         args = [eval_node(c) for c in t.children]
-        uids = set()
-        has_map = False
-        for a in args:
-            if isinstance(a, dict):
-                uids |= set(a)
-                has_map = True
-        if not uids:
-            if has_map:
-                # a var over an EMPTY block is an empty map, not a
-                # constant: the expression has no per-uid rows (the
-                # constant-fold below would multiply a dict)
-                return {}
+        fn = t.fn
+        dicts = [a for a in args if isinstance(a, dict)]
+        if not dicts:
             # all-constant expression
-            vals = [a for a in args]
-            return _apply_math(t.fn, vals, _m)
+            return _apply_math(fn, list(args), _m)
         out = {}
+        if fn in ("<", ">", "<=", ">=", "==", "!="):
+            # comparisons iterate the LEFT operand's domain; a uid the
+            # right map misses compares against zero (ref
+            # query/math.go:147 processBinaryBoolean srcMap loop)
+            left, right = args[0], args[1]
+            if not isinstance(left, dict):
+                return {}
+            for u, lv in left.items():
+                rv = right.get(u, 0.0) if isinstance(right, dict) \
+                    else right
+                try:
+                    out[u] = _apply_math(fn, [lv, rv], _m)
+                except (ZeroDivisionError, ValueError):
+                    continue
+            return out
+        if fn == "cond":
+            cond = args[0]
+            if not isinstance(cond, dict):
+                return {}
+            for u, cv in cond.items():
+                branch = args[1] if cv else args[2]
+                out[u] = branch.get(u, 0.0) \
+                    if isinstance(branch, dict) else branch
+            return out
+        # arithmetic / min / max / unary: the UNION of the operand
+        # domains, zero-filling a side that misses the uid (ref
+        # query/math.go:73 processBinary iterating mpr then mpl)
+        uids = set()
+        for a in dicts:
+            uids |= set(a)
         for u in uids:
-            vals = [a[u] if isinstance(a, dict) else a for a in args
-                    if not isinstance(a, dict) or u in a]
-            if len(vals) != len(args):
-                continue
+            vals = [a.get(u, 0.0) if isinstance(a, dict) else a
+                    for a in args]
             try:
-                out[u] = _apply_math(t.fn, vals, _m)
+                out[u] = _apply_math(fn, vals, _m)
             except (ZeroDivisionError, ValueError):
                 continue
         return out
 
     res = eval_node(tree)
     if not isinstance(res, dict):
+        if isinstance(res, (int, float)) and not isinstance(res, bool):
+            return const_map(res)
         return {}
     out = {}
     for u, x in res.items():

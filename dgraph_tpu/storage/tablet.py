@@ -49,7 +49,7 @@ class ValueColumns:
 
     __slots__ = ("srcs", "tid", "data", "enc", "nbytes",
                  "extra_srcs", "extra_enc", "extra_ok", "_ascii",
-                 "_codes")
+                 "_codes", "dt_secs", "dt_objs", "_blob")
 
     def __init__(self, srcs, tid, data, enc,
                  extra_srcs=None, extra_enc=None, extra_ok=True):
@@ -58,6 +58,12 @@ class ValueColumns:
         self.data = data
         self.enc = enc
         self._codes = None
+        self._blob = None
+        # DATETIME tablets also carry the numeric column (float epoch
+        # seconds, the dict math path's float() domain) plus the exact
+        # datetime objects for var materialization
+        self.dt_secs = None
+        self.dt_objs = None
         self.extra_srcs = extra_srcs if extra_srcs is not None \
             else np.empty(0, np.uint64)
         self.extra_enc = extra_enc or []
@@ -82,6 +88,20 @@ class ValueColumns:
 
     def __iter__(self):
         return iter((self.srcs, self.tid, self.data, self.enc))
+
+    def payload_blob(self):
+        """(uint8 blob, int64 offsets) of the payload column, joined
+        ONCE per view lifetime — batch scanners (match, regexp) index
+        into it instead of rebuilding python byte lists per query."""
+        if self._blob is None:
+            offs = np.zeros(len(self.enc or ()) + 1, np.int64)
+            if self.enc:
+                np.cumsum([len(e) for e in self.enc], out=offs[1:])
+                blob = np.frombuffer(b"".join(self.enc), np.uint8)
+            else:
+                blob = np.zeros(1, np.uint8)
+            self._blob = (blob, offs)
+        return self._blob
 
     def enc_codes(self):
         """(codes int64 aligned to srcs, table: code -> bytes) for the
@@ -637,9 +657,18 @@ class Tablet:
                     [1 if v else 0 for v in vals], np.uint8)[order]
                 return ValueColumns(srcs_a, tid, data, None)
             if tid == TypeID.DATETIME:
-                enc = [vals[j].isoformat().encode("utf-8")
+                from dgraph_tpu.models.types import iso8601
+                enc = [iso8601(vals[j]).encode("utf-8")
                        for j in order.tolist()]
-                return ValueColumns(srcs_a, tid, None, enc)
+                vc = ValueColumns(srcs_a, tid, None, enc)
+                vc.dt_secs = np.asarray(
+                    [vals[j].timestamp() for j in order.tolist()],
+                    np.float64)
+                objs = np.empty(len(order), object)
+                for i, j in enumerate(order.tolist()):
+                    objs[i] = vals[j]
+                vc.dt_objs = objs
+                return vc
             if tid in (TypeID.STRING, TypeID.DEFAULT):
                 enc = [vals[j].encode("utf-8") for j in order.tolist()]
                 ex_srcs, ex_enc, ex_ok = [], [], True
@@ -940,18 +969,30 @@ class Tablet:
         return uids, keys
 
     def sort_key_pairs(self, lang: str = "") -> dict[int, int]:
-        """uid -> int64 sort key of its first value in `lang` ("" =
-        first untagged; a concrete tag selects that language only,
-        matching the executor's _select_posting([lang]) — ref
-        types/valForLang)."""
+        """uid -> int64 sort key for ORDERING in `lang`. Unlike
+        filters/emission (strict tag match), sorting falls back:
+        requested tag, else the untagged value, else the first posting
+        (ref posting.List.ValueFor — query1_test.go
+        TestToFastJSONOrderLang sorts alias@en over untagged
+        aliases)."""
         out = {}
         for src, plist in self.values.items():
+            sel = None
             for p in plist:
-                if p.lang != lang:
-                    continue
-                try:
-                    out[src] = sort_key(self._converted(p))
-                except ValueError:
-                    pass
-                break
+                if p.lang == lang:
+                    sel = p
+                    break
+            if sel is None and lang:
+                for p in plist:
+                    if not p.lang:
+                        sel = p
+                        break
+                if sel is None and plist:
+                    sel = plist[0]
+            if sel is None:
+                continue
+            try:
+                out[src] = sort_key(self._converted(sel))
+            except ValueError:
+                pass
         return out

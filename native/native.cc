@@ -1140,6 +1140,92 @@ extern "C" int dgt_match_mask(
   return 0;
 }
 
+// Same verify over SELECTED rows of a cached whole-column payload
+// blob (the executor joins the column's payloads once per base_ts
+// instead of rebuilding a python list per query).
+extern "C" int dgt_match_mask_idx(
+    const uint8_t* term, uint32_t term_len, int32_t max_d,
+    const uint8_t* blob, const int64_t* offsets,
+    const int64_t* idx, int64_t n_idx, uint8_t* out_mask) {
+  for (int64_t i = 0; i < n_idx; i++) {
+    int64_t j = idx[i];
+    const uint8_t* v = blob + offsets[j];
+    int64_t len = offsets[j + 1] - offsets[j];
+    int32_t d = dgt_levenshtein(v, (uint32_t)len, term, term_len,
+                                max_d);
+    out_mask[i] = d <= max_d ? 1 : 0;
+  }
+  return 0;
+}
+
+// K-way merge-count over SORTED uid buckets (the trigram q-gram count
+// filter, ref worker/match.go uidsForMatch's index union): emit every
+// uid appearing in >= need buckets. Replaces concatenate+np.unique —
+// a full 3M-element sort per query at the 21M regime — with one
+// linear merge over the already-sorted index buckets.
+extern "C" int dgt_merge_count(
+    const uint64_t* vals, const int64_t* bucket_offs, int64_t n_buckets,
+    int64_t need, uint64_t* out, int64_t* out_n) {
+  // heap of (current value, bucket index)
+  struct Head { uint64_t v; int64_t b; };
+  Head* heap = (Head*)malloc(sizeof(Head) * (size_t)(n_buckets + 1));
+  if (!heap) return 1;
+  int64_t* pos = (int64_t*)malloc(sizeof(int64_t) * (size_t)n_buckets);
+  if (!pos) { free(heap); return 1; }
+  int64_t hn = 0;
+  for (int64_t b = 0; b < n_buckets; b++) {
+    pos[b] = bucket_offs[b];
+    if (pos[b] < bucket_offs[b + 1]) {
+      // sift up
+      int64_t i = hn++;
+      heap[i].v = vals[pos[b]];
+      heap[i].b = b;
+      while (i > 0) {
+        int64_t p = (i - 1) / 2;
+        if (heap[p].v <= heap[i].v) break;
+        Head t = heap[p]; heap[p] = heap[i]; heap[i] = t;
+        i = p;
+      }
+    }
+  }
+  int64_t m = 0;
+  uint64_t cur = 0;
+  int64_t count = 0;
+  bool have = false;
+  while (hn > 0) {
+    uint64_t v = heap[0].v;
+    int64_t b = heap[0].b;
+    if (!have || v != cur) {
+      if (have && count >= need) out[m++] = cur;
+      cur = v; count = 1; have = true;
+    } else {
+      count++;
+    }
+    // advance bucket b's head
+    pos[b]++;
+    if (pos[b] < bucket_offs[b + 1]) {
+      heap[0].v = vals[pos[b]];
+    } else {
+      heap[0] = heap[--hn];
+    }
+    // sift down
+    int64_t i = 0;
+    while (true) {
+      int64_t l = 2 * i + 1, r = 2 * i + 2, s = i;
+      if (l < hn && heap[l].v < heap[s].v) s = l;
+      if (r < hn && heap[r].v < heap[s].v) s = r;
+      if (s == i) break;
+      Head t = heap[s]; heap[s] = heap[i]; heap[i] = t;
+      i = s;
+    }
+  }
+  if (have && count >= need) out[m++] = cur;
+  *out_n = m;
+  free(pos);
+  free(heap);
+  return 0;
+}
+
 // -------------------------------------------------------- JSON emitter
 // Columnar row serializer for the query result fast path — the role of
 // the reference's fastJsonNode encoder (query/outputnode.go), which its

@@ -97,9 +97,11 @@ def test_math_over_colvars(db):
       }
       q(func: uid(m), orderasc: uid) { v: val(m) }
     }""")["data"]["q"]
-    # uid 0x3 has rating but no runtime: intersection drops it
+    # uid 0x3 has rating but no runtime: the missing operand counts
+    # as ZERO (ref query/math.go:73 processBinary union semantics)
     assert r == [{"v": pytest.approx(29.8)},
-                 {"v": pytest.approx(28.0)}]
+                 {"v": pytest.approx(28.0)},
+                 {"v": pytest.approx(16.0)}]
 
 
 def test_math_missing_var_yields_empty(db):

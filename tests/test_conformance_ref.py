@@ -1,0 +1,463 @@
+"""Black-box conformance cases ported from the reference's acceptance
+suites (query/query0_test.go ... query4_test.go +
+query_facets_test.go), run against the reference's own test graph
+(tests/refgraph.py = query/common_test.go populateCluster).
+
+Each case is (query, expected-data-JSON) straight from the suite it
+cites; any divergence is either a bug here or documented intentional
+behavior. The round-3/4 wrong-results bugs (regexp alternation, MVCC
+ordering) both lived in corners the thinner suite never touched —
+this is the systematic widening the round-4 verdict asked for."""
+
+import json
+
+import pytest
+
+import refgraph
+
+_DB = None
+
+
+def db():
+    global _DB
+    if _DB is None:
+        _DB = refgraph.build_db()
+    return _DB
+
+
+def run(query, variables=None):
+    return db().query(query, variables=variables)["data"]
+
+
+def check(query, expected_json, variables=None):
+    got = run(query, variables)
+    want = json.loads(expected_json)
+    assert got == want, (
+        f"\ngot:  {json.dumps(got, ensure_ascii=False)}"
+        f"\nwant: {json.dumps(want, ensure_ascii=False)}")
+
+
+# Each entry: (case_name, query, expected `data` JSON). Source cited
+# per case as file:TestName.
+CASES = [
+    # ---------------------------------------------------------- query0
+    ("get_uid",  # query0:TestGetUID
+     '{ me(func: uid(0x01)) { name uid gender alive friend { uid name } } }',
+     '{"me":[{"uid":"0x1","alive":true,"friend":[{"uid":"0x17","name":"Rick Grimes"},{"uid":"0x18","name":"Glenn Rhee"},{"uid":"0x19","name":"Daryl Dixon"},{"uid":"0x1f","name":"Andrea"},{"uid":"0x65"}],"gender":"female","name":"Michonne"}]}'),
+    ("empty_default_names",  # query0:TestQueryEmptyDefaultNames
+     '{ people(func: eq(name, "")) { uid name } }',
+     '{"people": [{"uid":"0xdac","name":""}, {"uid":"0xdae","name":""}]}'),
+    ("empty_default_name_with_language",  # query0:TestQueryEmptyDefaultNameWithLanguage
+     '{ people(func: eq(name, "")) { name@ko:en:hi } }',
+     '{"people": [{"name@ko:en:hi":"상현"},{"name@ko:en:hi":"Amit"}]}'),
+    ("names_empty_in_language",  # query0:TestQueryNamesThatAreEmptyInLanguage
+     '{ people(func: eq(name@hi, "")) { name@en } }',
+     '{"people": [{"name@en":"Andrew"}]}'),
+    ("names_in_language",  # query0:TestQueryNamesInLanguage
+     '{ people(func: eq(name@hi, "अमित")) { name@en } }',
+     '{"people": [{"name@en":"Amit"}]}'),
+    ("all_languages",  # query0:TestQueryAllLanguages
+     '{ people(func: eq(name@hi, "अमित")) { name@* } }',
+     '{"people": [{"name@en":"Amit", "name@hi":"अमित", "name":""}]}'),
+    ("names_before_a",  # query0:TestQueryNamesBeforeA
+     '{ people(func: lt(name, "A")) { uid name } }',
+     '{"people": [{"uid":"0xdac", "name":""}, {"uid":"0xdae", "name":""}]}'),
+    ("ge_age",  # query0:TestGeAge
+     '{ senior_citizens(func: ge(age, 75)) { name age } }',
+     '{"senior_citizens": [{"name":"Elizabeth", "age":75}, {"name":"Alice", "age":75}, {"age":75, "name": "Bob"}, {"name":"Alice", "age":75}]}'),
+    ("gt_age",  # query0:TestGtAge
+     '{ senior_citizens(func: gt(age, 75)) { name age } }',
+     '{"senior_citizens":[]}'),
+    ("le_age",  # query0:TestLeAge
+     '{ minors(func: le(age, 15)) { name age } }',
+     '{"minors": [{"name":"Rick Grimes", "age":15}, {"name":"Glenn Rhee", "age":15}]}'),
+    ("lt_age",  # query0:TestLtAge
+     '{ minors(func: lt(age, 15)) { name age } }',
+     '{"minors":[]}'),
+    ("return_uids",  # query0:TestReturnUids
+     '{ me(func: uid(0x1)) { name uid friend { uid name } } }',
+     '{"me":[{"name":"Michonne","uid":"0x1","friend":[{"uid":"0x17","name":"Rick Grimes"},{"uid":"0x18","name":"Glenn Rhee"},{"uid":"0x19","name":"Daryl Dixon"},{"uid":"0x1f","name":"Andrea"},{"uid":"0x65"}]}]}'),
+    ("get_uid_not_in_child",  # query0:TestGetUIDNotInChild
+     '{ me(func: uid(0x01)) { name uid gender alive friend { name } } }',
+     '{"me":[{"uid":"0x1","alive":true,"gender":"female","name":"Michonne", "friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}]}]}'),
+    ("cascade_directive",  # query0:TestCascadeDirective
+     '{ me(func: uid(0x01)) @cascade { name gender friend { name friend { name dob age } } } }',
+     '{"me":[{"friend":[{"friend":[{"age":38,"dob":"1910-01-01T00:00:00Z","name":"Michonne"}],"name":"Rick Grimes"},{"friend":[{"age":15,"dob":"1909-05-05T00:00:00Z","name":"Glenn Rhee"}],"name":"Andrea"}],"gender":"female","name":"Michonne"}]}'),
+    ("count_empty_names",  # query0:TestQueryCountEmptyNames
+     '{ people_empty_name(func: has(name)) @filter(eq(name, "")) { count(uid) } }',
+     '{"people_empty_name":[{"count":2}]}'),
+    ("empty_rooms_with_term_index",  # query0:TestQueryEmptyRoomsWithTermIndex
+     '{ offices(func: has(office)) { count(office.room @filter(eq(room, ""))) } }',
+     '{"offices": [{"count(office.room)":1}]}'),
+    ("count_empty_names_with_lang",  # query0:TestQueryCountEmptyNamesWithLang
+     '{ people_empty_name(func: has(name@hi)) @filter(eq(name@hi, "")) { count(uid) } }',
+     '{"people_empty_name":[{"count":1}]}'),
+    ("stocks_starts_with_a",  # query0:TestStocksStartsWithAInPortfolio
+     '{ portfolio(func: lt(symbol, "B")) { symbol } }',
+     '{"portfolio": [{"symbol":"AAPL"},{"symbol":"AMZN"},{"symbol":"AMD"}]}'),
+    ("friends_between_15_and_19",  # query0:TestFindFriendsWhoAreBetween15And19
+     '{ friends_15_and_19(func: uid(1)) { name friend @filter(ge(age, 15) AND lt(age, 19)) { name age } } }',
+     '{"friends_15_and_19":[{"name":"Michonne","friend":[{"name":"Rick Grimes","age":15},{"name":"Glenn Rhee","age":15},{"name":"Daryl Dixon","age":17}]}]}'),
+    ("get_non_list_uid_predicate",  # query0:TestGetNonListUidPredicate
+     '{ me(func: uid(0x02)) { uid best_friend { uid } } }',
+     '{"me":[{"uid":"0x2","best_friend": {"uid": "0x40"}}]}'),
+    ("non_list_uid_predicate_reverse1",  # query0:TestNonListUidPredicateReverse1
+     '{ me(func: uid(0x40)) { uid ~best_friend { uid } } }',
+     '{"me":[{"uid":"0x40","~best_friend": [{"uid": "0x2"},{"uid": "0x3"},{"uid": "0x4"}]}]}'),
+    ("non_list_uid_predicate_reverse2",  # query0:TestNonListUidPredicateReverse2
+     '{ me(func: uid(0x40)) { uid ~best_friend @facets(since) { uid } } }',
+     '{"me":[{"uid":"0x40","~best_friend": [{"uid": "0x2", "~best_friend|since": "2019-03-28T14:41:57+30:00"},{"uid": "0x3", "~best_friend|since": "2018-03-24T14:41:57+05:30"},{"uid": "0x4", "~best_friend|since": "2019-03-27T00:00:00Z"}]}]}'),
+    # ------------------------------------------------- query0 group-by
+    ("groupby_root",  # query0:TestGroupByRoot
+     '{ me(func: uid(1, 23, 24, 25, 31)) @groupby(age) { count(uid) } }',
+     '{"me":[{"@groupby":[{"age":15,"count":2},{"age":17,"count":1},{"age":19,"count":1},{"age":38,"count":1}]}]}'),
+    ("groupby_root_alias",  # query0:TestGroupByRootAlias
+     '{ me(func: uid(1, 23, 24, 25, 31)) @groupby(age) { Count: count(uid) } }',
+     '{"me":[{"@groupby":[{"age":15,"Count":2},{"age":17,"Count":1},{"age":19,"Count":1},{"age":38,"Count":1}]}]}'),
+    ("groupby",  # query0:TestGroupBy
+     '{ age(func: uid(1)) { friend { age } } me(func: uid(1)) { friend @groupby(age) { count(uid) } name } }',
+     '{"age":[{"friend":[{"age":15},{"age":15},{"age":17},{"age":19}]}],"me":[{"friend":[{"@groupby":[{"age":15,"count":2},{"age":17,"count":1},{"age":19,"count":1}]}],"name":"Michonne"}]}'),
+    ("groupby_countval",  # query0:TestGroupByCountval
+     '{ var(func: uid(1)) { friend @groupby(school) { a as count(uid) } } order(func: uid(a), orderdesc: val(a)) { name val(a) } }',
+     '{"order":[{"name":"School B","val(a)":3},{"name":"School A","val(a)":2}]}'),
+    ("groupby_aggval",  # query0:TestGroupByAggval
+     '{ var(func: uid(1)) { friend @groupby(school) { a as max(name) b as min(name) } } orderMax(func: uid(a), orderdesc: val(a)) { name val(a) } orderMin(func: uid(b), orderdesc: val(b)) { name val(b) } }',
+     '{"orderMax":[{"name":"School B","val(a)":"Rick Grimes"},{"name":"School A","val(a)":"Glenn Rhee"}],"orderMin":[{"name":"School A","val(b)":"Daryl Dixon"},{"name":"School B","val(b)":"Andrea"}]}'),
+    ("groupby_alias",  # query0:TestGroupByAlias
+     '{ me(func: uid(1)) { friend @groupby(school) { MemberCount: count(uid) } } }',
+     '{"me":[{"friend":[{"@groupby":[{"school":"0x1388","MemberCount":2},{"school":"0x1389","MemberCount":3}]}]}]}'),
+    ("groupby_agg",  # query0:TestGroupByAgg
+     '{ me(func: uid(1)) { friend @groupby(age) { max(name) } } }',
+     '{"me":[{"friend":[{"@groupby":[{"age":15,"max(name)":"Rick Grimes"},{"age":17,"max(name)":"Daryl Dixon"},{"age":19,"max(name)":"Andrea"}]}]}]}'),
+    ("groupby_multi",  # query0:TestGroupByMulti
+     '{ me(func: uid(1)) { friend @groupby(friend, name) { count(uid) } } }',
+     '{"me":[{"friend":[{"@groupby":[{"friend":"0x1","name":"Rick Grimes","count":1},{"friend":"0x18","name":"Andrea","count":1}]}]}]}'),
+    # ---------------------------------------------- query0 value vars
+    ("query_const_math_val",  # query0:TestQueryConstMathVal
+     '{ f as var(func: anyofterms(name, "Rick Michonne Andrea")) { a as math(24/8 * 3) } AgeOrder(func: uid(f)) { name val(a) } }',
+     '{"AgeOrder":[{"name":"Michonne","val(a)":9.000000},{"name":"Rick Grimes","val(a)":9.000000},{"name":"Andrea","val(a)":9.000000},{"name":"Andrea With no friends","val(a)":9.000000}]}'),
+    ("var_val_agg_nested_func_const",  # query0:TestQueryVarValAggNestedFuncConst
+     '{ f as var(func: anyofterms(name, "Michonne Andrea Rick")) { a as age friend { x as age } n as min(val(x)) s as max(val(x)) p as math(a + s % n + 10) q as math(a * s * n * -1) } MaxMe(func: uid(f), orderasc: val(p)) { name val(p) val(a) val(n) val(s) } MinMe(func: uid(f), orderasc: val(q)) { name val(q) val(a) val(n) val(s) } }',
+     '{"MaxMe":[{"name":"Rick Grimes","val(a)":15,"val(n)":38,"val(p)":25.000000,"val(s)":38},{"name":"Andrea","val(a)":19,"val(n)":15,"val(p)":29.000000,"val(s)":15},{"name":"Michonne","val(a)":38,"val(n)":15,"val(p)":52.000000,"val(s)":19}],"MinMe":[{"name":"Rick Grimes","val(a)":15,"val(n)":38,"val(q)":-21660.000000,"val(s)":38},{"name":"Michonne","val(a)":38,"val(n)":15,"val(q)":-10830.000000,"val(s)":19},{"name":"Andrea","val(a)":19,"val(n)":15,"val(q)":-4275.000000,"val(s)":15}]}'),
+    ("var_val_agg_nested_func_minmax_vars",  # query0:TestQueryVarValAggNestedFuncMinMaxVars
+     '{ f as var(func: anyofterms(name, "Michonne Andrea Rick")) { a as age friend { x as age } n as min(val(x)) s as max(val(x)) p as math(max(max(a, s), n)) q as math(min(min(a, s), n)) } MaxMe(func: uid(f), orderasc: val(p)) { name val(p) val(a) val(n) val(s) } MinMe(func: uid(f), orderasc: val(q)) { name val(q) val(a) val(n) val(s) } }',
+     '{"MinMe":[{"name":"Michonne","val(a)":38,"val(n)":15,"val(q)":15,"val(s)":19},{"name":"Rick Grimes","val(a)":15,"val(n)":38,"val(q)":15,"val(s)":38},{"name":"Andrea","val(a)":19,"val(n)":15,"val(q)":15,"val(s)":15}],"MaxMe":[{"name":"Andrea","val(a)":19,"val(n)":15,"val(p)":19,"val(s)":15},{"name":"Michonne","val(a)":38,"val(n)":15,"val(p)":38,"val(s)":19},{"name":"Rick Grimes","val(a)":15,"val(n)":38,"val(p)":38,"val(s)":38}]}'),
+    ("var_val_agg_minmax",  # query0:TestQueryVarValAggMinMax
+     '{ f as var(func: anyofterms(name, "Michonne Andrea Rick")) { friend { x as age } n as min(val(x)) s as max(val(x)) sum as math(n + s) } me(func: uid(f), orderdesc: val(sum)) { name val(n) val(s) } }',
+     '{"me":[{"name":"Rick Grimes","val(n)":38,"val(s)":38},{"name":"Michonne","val(n)":15,"val(s)":19},{"name":"Andrea","val(n)":15,"val(s)":15}]}'),
+    ("var_val_agg_order_desc",  # query0:TestQueryVarValAggOrderDesc
+     '{ info(func: uid(1)) { f as friend { n as age s as count(friend) sum as math(n + s) } } me(func: uid(f), orderdesc: val(sum)) { name age count(friend) } }',
+     '{"info":[{"friend":[{"age":15,"count(friend)":1,"val(sum)":16.000000},{"age":15,"count(friend)":0,"val(sum)":15.000000},{"age":17,"count(friend)":0,"val(sum)":17.000000},{"age":19,"count(friend)":1,"val(sum)":20.000000},{"count(friend)":0,"val(sum)":0.000000}]}],"me":[{"age":19,"count(friend)":1,"name":"Andrea"},{"age":17,"count(friend)":0,"name":"Daryl Dixon"},{"age":15,"count(friend)":1,"name":"Rick Grimes"},{"age":15,"count(friend)":0,"name":"Glenn Rhee"},{"count(friend)":0}]}'),
+    ("var_val_order_asc",  # query0:TestQueryVarValOrderAsc
+     '{ var(func: anyofterms(name, "Rick Michonne Andrea")) { n as name } me(func: uid(n), orderasc: val(n)) { name } }',
+     '{"me":[{"name":"Andrea"},{"name":"Andrea With no friends"},{"name":"Michonne"},{"name":"Rick Grimes"}]}'),
+    ("var_val_order_dob",  # query0:TestQueryVarValOrderDob
+     '{ var(func: anyofterms(name, "Rick Michonne Andrea")) { d as dob } me(func: uid(d), orderasc: val(d)) { name dob } }',
+     '{"me":[{"name":"Andrea", "dob":"1901-01-15T00:00:00Z"},{"name":"Michonne", "dob":"1910-01-01T00:00:00Z"},{"name":"Rick Grimes", "dob":"1910-01-02T00:00:00Z"}]}'),
+    ("var_val_order_desc",  # query0:TestQueryVarValOrderDesc
+     '{ var(func: anyofterms(name, "Rick Michonne Andrea")) { n as name } me(func: uid(n), orderdesc: val(n)) { name } }',
+     '{"me":[{"name":"Rick Grimes"},{"name":"Michonne"},{"name":"Andrea With no friends"},{"name":"Andrea"}]}'),
+]
+
+
+@pytest.mark.parametrize("name,query,expected",
+                         CASES, ids=[c[0] for c in CASES])
+def test_ref_conformance(name, query, expected):
+    check(query, expected)
+
+
+# ------------------------------------------------------- query1 batch
+
+CASES1 = [
+    ("order_lang",  # query1:TestToFastJSONOrderLang
+     '{ me(func: uid(0x01)) { friend(first:2, orderdesc: alias@en) { alias } } }',
+     '{"me":[{"friend":[{"alias":"Zambo Alice"},{"alias":"John Oliver"}]}]}'),
+    ("bool_index_eq_root1",  # query1:TestBoolIndexEqRoot1
+     '{ me(func: eq(alive, true)) { name alive } }',
+     '{"me":[{"alive":true,"name":"Michonne"},{"alive":true,"name":"Rick Grimes"}]}'),
+    ("bool_index_eq_root2",  # query1:TestBoolIndexEqRoot2
+     '{ me(func: eq(alive, false)) { name alive } }',
+     '{"me":[{"alive":false,"name":"Daryl Dixon"},{"alive":false,"name":"Andrea"}]}'),
+    ("bool_index_eq_child",  # query1:TestBoolIndexEqChild
+     '{ me(func: eq(alive, true)) { name alive friend @filter(eq(alive, false)) { name alive } } }',
+     '{"me":[{"alive":true,"friend":[{"alive":false,"name":"Daryl Dixon"},{"alive":false,"name":"Andrea"}],"name":"Michonne"},{"alive":true,"name":"Rick Grimes"}]}'),
+    ("string_escape",  # query1:TestStringEscape
+     '{ me(func: uid(2301)) { name } }',
+     '{"me":[{"name":"Alice\\""}]}'),
+    ("count_at_root",  # query1:TestCountAtRoot
+     '{ me(func: gt(count(friend), 0)) { count(uid) } }',
+     '{"me":[{"count": 3}]}'),
+    ("count_at_root2",  # query1:TestCountAtRoot2
+     '{ me(func: anyofterms(name, "Michonne Rick Andrea")) { count(uid) } }',
+     '{"me":[{"count": 4}]}'),
+    ("count_at_root3",  # query1:TestCountAtRoot3
+     '{ me(func:anyofterms(name, "Michonne Rick Daryl")) { name count(uid) count(friend) friend { name count(uid) } } }',
+     '{"me":[{"count":3},{"count(friend)":5,"friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"},{"count":5}],"name":"Michonne"},{"count(friend)":1,"friend":[{"name":"Michonne"},{"count":1}],"name":"Rick Grimes"},{"count(friend)":0,"name":"Daryl Dixon"}]}'),
+    ("count_at_root_with_alias4",  # query1:TestCountAtRootWithAlias4
+     '{ me(func:anyofterms(name, "Michonne Rick Daryl")) @filter(le(count(friend), 2)) { personCount: count(uid) } }',
+     '{"me": [{"personCount": 2}]}'),
+    ("count_at_root5",  # query1:TestCountAtRoot5
+     '{ me(func: uid(1)) { f as friend { name } } MichonneFriends(func: uid(f)) { count(uid) } }',
+     '{"MichonneFriends":[{"count":5}],"me":[{"friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}]}]}'),
+    ("has_func_at_root",  # query1:TestHasFuncAtRoot
+     '{ me(func: has(friend)) { name friend { count(uid) } } }',
+     '{"me":[{"friend":[{"count":5}],"name":"Michonne"},{"friend":[{"count":1}],"name":"Rick Grimes"},{"friend":[{"count":1}],"name":"Andrea"}]}'),
+    ("has_func_at_root_with_after",  # query1:TestHasFuncAtRootWithAfter
+     '{ me(func: has(friend), after: 0x01) { uid name friend { count(uid) } } }',
+     '{"me":[{"friend":[{"count":1}],"name":"Rick Grimes","uid":"0x17"},{"friend":[{"count":1}],"name":"Andrea","uid":"0x1f"}]}'),
+    ("has_func_at_root_filter",  # query1:TestHasFuncAtRootFilter
+     '{ me(func: anyofterms(name, "Michonne Rick Daryl")) @filter(has(friend)) { name friend { count(uid) } } }',
+     '{"me":[{"friend":[{"count":5}],"name":"Michonne"},{"friend":[{"count":1}],"name":"Rick Grimes"}]}'),
+    ("has_func_at_child1",  # query1:TestHasFuncAtChild1
+     '{ me(func: has(school)) { name friend @filter(has(scooter)) { name } } }',
+     '{"me":[{"name":"Michonne"},{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}]}'),
+    ("has_func_at_child2",  # query1:TestHasFuncAtChild2
+     '{ me(func: has(school)) { name friend @filter(has(alias)) { name alias } } }',
+     '{"me":[{"friend":[{"alias":"Zambo Alice","name":"Rick Grimes"},{"alias":"John Alice","name":"Glenn Rhee"},{"alias":"Bob Joe","name":"Daryl Dixon"},{"alias":"Allan Matt","name":"Andrea"},{"alias":"John Oliver"}],"name":"Michonne"},{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"friend":[{"alias":"John Alice","name":"Glenn Rhee"}],"name":"Andrea"}]}'),
+    ("has_func_at_root2",  # query1:TestHasFuncAtRoot2
+     '{ me(func: has(name@en)) { name@en } }',
+     '{"me":[{"name@en":"Alex"},{"name@en":"Amit"},{"name@en":"Andrew"},{"name@en":"European badger"},{"name@en":"Honey badger"},{"name@en":"Honey bee"},{"name@en":"Artem Tkachenko"},{"name@en":"Baz Luhrmann"},{"name@en":"Strictly Ballroom"},{"name@en":"Puccini: La boheme (Sydney Opera)"}, {"name@en":"No. 5 the film"}]}'),
+    ("reverse_negative_first",  # query1:TestToJSONReverseNegativeFirst
+     '{ me(func: allofterms(name, "Andrea")) { name ~friend(first: -1) { name gender } } }',
+     '{"me":[{"name":"Andrea","~friend":[{"gender":"female","name":"Michonne"}]},{"name":"Andrea With no friends"}]}'),
+    ("uid_alias",  # query1:TestUidAlias
+     '{ me(func: uid(0x1)) { id: uid alive friend { uid: uid name } } }',
+     '{"me":[{"alive":true,"friend":[{"name":"Rick Grimes","uid":"0x17"},{"name":"Glenn Rhee","uid":"0x18"},{"name":"Daryl Dixon","uid":"0x19"},{"name":"Andrea","uid":"0x1f"},{"uid":"0x65"}],"id":"0x1"}]}'),
+]
+
+
+@pytest.mark.parametrize("name,query,expected",
+                         CASES1, ids=[c[0] for c in CASES1])
+def test_ref_conformance_q1(name, query, expected):
+    check(query, expected)
+
+
+# ------------------------------------------------------- facets batch
+
+_DBF = None
+
+
+def dbf():
+    global _DBF
+    if _DBF is None:
+        _DBF = refgraph.build_facets_db()
+    return _DBF
+
+
+def checkf(query, expected_json, variables=None):
+    got = dbf().query(query, variables=variables)["data"]
+    want = json.loads(expected_json)
+    assert got == want, (
+        f"\ngot:  {json.dumps(got, ensure_ascii=False)}"
+        f"\nwant: {json.dumps(want, ensure_ascii=False)}")
+
+
+CASESF = [
+    ("facets_var_allofterms",  # facets:TestFacetsVarAllofterms
+     '{ me(func: uid(31)) { name friend @facets(allofterms(games, "football basketball hockey")) { name uid } } }',
+     '{"me":[{"friend":[{"name":"Daryl Dixon","uid":"0x19"}],"name":"Andrea"}]}'),
+    ("facets_with_var_eq",  # facets:TestFacetsWithVarEq
+     'query works($family : bool = true){ me(func: uid(1)) { name friend @facets(eq(family, $family)) { name uid } } }',
+     '{"me":[{"friend":[{"uid":"0x18","name":"Glenn Rhee"},{"uid":"0x19", "name": "Daryl Dixon"}],"name":"Michonne"}]}'),
+    ("facet_with_var_le",  # facets:TestFacetWithVarLe
+     'query works($age : int = 35) { me(func: uid(0x1)) { name friend @facets(le(age, $age)) { name uid } } }',
+     '{"me":[{"friend":[{"uid":"0x65"}],"name":"Michonne"}]}'),
+    ("facet_with_var_gt",  # facets:TestFacetWithVarGt
+     'query works($age : int = "32") { me(func: uid(0x1)) { name friend @facets(gt(age, $age)) { name uid } } }',
+     '{"me":[{"friend":[{"uid":"0x65"}],"name":"Michonne"}]}'),
+    ("retrieve_facets_simple",  # facets:TestRetrieveFacetsSimple
+     '{ me(func: uid(0x1)) { name @facets gender @facets } }',
+     '{"me":[{"name|origin":"french","name|dummy":true,"name":"Michonne","gender":"female"}]}'),
+    ("order_facets",  # facets:TestOrderFacets
+     '{ me(func: uid(1)) { friend @facets(orderasc:since) { name } } }',
+     '{"me":[{"friend":[{"name":"Glenn Rhee","friend|since":"2004-05-02T15:04:05Z"},{"friend|since":"2005-05-02T15:04:05Z"},{"name":"Rick Grimes","friend|since":"2006-01-02T15:04:05Z"},{"name":"Andrea","friend|since":"2006-01-02T15:04:05Z"},{"name":"Daryl Dixon","friend|since":"2007-05-02T15:04:05Z"}]}]}'),
+    ("orderdesc_facets",  # facets:TestOrderdescFacets
+     '{ me(func: uid(1)) { friend @facets(orderdesc:since) { name } } }',
+     '{"me":[{"friend":[{"name":"Daryl Dixon","friend|since":"2007-05-02T15:04:05Z"},{"name":"Rick Grimes","friend|since":"2006-01-02T15:04:05Z"},{"name":"Andrea","friend|since":"2006-01-02T15:04:05Z"},{"friend|since":"2005-05-02T15:04:05Z"},{"name":"Glenn Rhee","friend|since":"2004-05-02T15:04:05Z"}]}]}'),
+    ("retrieve_facets_as_vars",  # facets:TestRetrieveFacetsAsVars
+     '{ var(func: uid(0x1)) { friend @facets(a as since) } me(func: uid( 23)) { name val(a) } }',
+     '{"me":[{"name":"Rick Grimes","val(a)":"2006-01-02T15:04:05Z"}]}'),
+    ("retrieve_facets_uid_values",  # facets:TestRetrieveFacetsUidValues
+     '{ me(func: uid(0x1)) { friend @facets { name @facets } } }',
+     '{"me":[{"friend":[{"name|origin":"french","name|dummy":true,"name":"Rick Grimes","friend|since":"2006-01-02T15:04:05Z"},{"name|origin":"french","name|dummy":true,"name":"Glenn Rhee","friend|close":true,"friend|family":true,"friend|since":"2004-05-02T15:04:05Z","friend|tag":"Domain3"},{"name":"Daryl Dixon","friend|close":false,"friend|family":true,"friend|since":"2007-05-02T15:04:05Z","friend|tag":34},{"name":"Andrea","friend|since":"2006-01-02T15:04:05Z"},{"friend|age":33,"friend|close":true,"friend|family":false,"friend|since":"2005-05-02T15:04:05Z"}]}]}'),
+    ("facets_not_in_query",  # facets:TestFacetsNotInQuery
+     '{ me(func: uid(0x1)) { name gender friend { name gender } } }',
+     '{"me":[{"friend":[{"gender":"male","name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}],"gender":"female","name":"Michonne"}]}'),
+    ("subject_with_no_facets",  # facets:TestSubjectWithNoFacets
+     '{ me(func: uid(0x21)) { name @facets school @facets { name } } }',
+     '{"me":[{"name":"Michale"}]}'),
+    ("fetching_few_facets",  # facets:TestFetchingFewFacets
+     '{ me(func: uid(0x1)) { name friend @facets(close) { name } } }',
+     '{"me":[{"name":"Michonne","friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee","friend|close":true},{"name":"Daryl Dixon","friend|close":false},{"name":"Andrea"},{"friend|close":true}]}]}'),
+    ("fetching_no_facets",  # facets:TestFetchingNoFacets
+     '{ me(func: uid(0x1)) { name friend @facets() { name } } }',
+     '{"me":[{"friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}],"name":"Michonne"}]}'),
+    ("facets_sort_order",  # facets:TestFacetsSortOrder
+     '{ me(func: uid(0x1)) { name friend @facets(family, close) { name } } }',
+     '{"me":[{"name":"Michonne","friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee","friend|close":true,"friend|family":true},{"name":"Daryl Dixon","friend|close":false,"friend|family":true},{"name":"Andrea"},{"friend|close":true,"friend|family":false}]}]}'),
+    ("unknown_facets",  # facets:TestUnknownFacets
+     '{ me(func: uid(0x1)) { name friend @facets(unknownfacets1, unknownfacets2) { name } } }',
+     '{"me":[{"friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}],"name":"Michonne"}]}'),
+    ("facets_filter_simple",  # facets:TestFacetsFilterSimple
+     '{ me(func: uid(0x1)) { name friend @facets(eq(close, true)) { name uid } } }',
+     '{"me":[{"friend":[{"uid":"0x18","name":"Glenn Rhee"},{"uid":"0x65"}],"name":"Michonne"}]}'),
+    ("facets_filter_simple2",  # facets:TestFacetsFilterSimple2
+     '{ me(func: uid(0x1)) { name friend @facets(eq(tag, "Domain3")) { name uid } } }',
+     '{"me":[{"friend":[{"uid":"0x18","name":"Glenn Rhee"}],"name":"Michonne"}]}'),
+    ("facets_filter_simple3",  # facets:TestFacetsFilterSimple3
+     '{ me(func: uid(0x1)) { name friend @facets(eq(tag, "34")) { name uid } } }',
+     '{"me":[{"friend":[{"uid":"0x19","name":"Daryl Dixon"}],"name":"Michonne"}]}'),
+    ("facets_filter_not_and_or_ge",  # facets:TestFacetsFilterNotAndOrgeMutuallyExclusive
+     '{ me(func: uid(0x1)) { name friend @facets(not (eq(close, false) OR eq(family, true) AND ge(since, "2007-01-10"))) { name uid } } }',
+     '{"me":[{"friend":[{"uid":"0x17","name":"Rick Grimes"},{"uid":"0x18","name":"Glenn Rhee"},{"uid":"0x1f","name":"Andrea"},{"uid":"0x65"}],"name":"Michonne"}]}'),
+]
+
+
+@pytest.mark.parametrize("name,query,expected",
+                         CASESF, ids=[c[0] for c in CASESF])
+def test_ref_conformance_facets(name, query, expected):
+    checkf(query, expected)
+
+
+# negative cases the reference REJECTS (query1:TestBoolIndexgeRoot,
+# TestBoolSort, TestFilterNonIndexedPredicateFail theme)
+REJECTS = [
+    '{ me(func: ge(alive, true)) { name } }',
+    '{ me(func: anyofterms(name, "Michonne")) { max(name) } }',
+]
+
+
+@pytest.mark.parametrize("bad", REJECTS)
+def test_ref_rejects(bad):
+    from dgraph_tpu.gql.lexer import GQLError
+    with pytest.raises((GQLError, ValueError)):
+        db().query(bad)
+
+
+# ------------------------------------------- query2/query3 batch
+
+CASES23 = [
+    ("recurse_query",  # query3:TestRecurseQuery
+     '{ me(func: uid(0x01)) @recurse { nonexistent_pred friend name } }',
+     '{"me":[{"name":"Michonne", "friend":[{"name":"Rick Grimes", "friend":[{"name":"Michonne"}]},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea", "friend":[{"name":"Glenn Rhee"}]}]}]}'),
+    ("recurse_query_order",  # query3:TestRecurseQueryOrder
+     '{ me(func: uid(0x01)) @recurse { friend(orderdesc: dob) dob name } }',
+     '{"me":[{"dob":"1910-01-01T00:00:00Z","friend":[{"dob":"1910-01-02T00:00:00Z","friend":[{"dob":"1910-01-01T00:00:00Z","name":"Michonne"}],"name":"Rick Grimes"},{"dob":"1909-05-05T00:00:00Z","name":"Glenn Rhee"},{"dob":"1909-01-10T00:00:00Z","name":"Daryl Dixon"},{"dob":"1901-01-15T00:00:00Z","friend":[{"dob":"1909-05-05T00:00:00Z","name":"Glenn Rhee"}],"name":"Andrea"}],"name":"Michonne"}]}'),
+    ("recurse_query_limit_depth1",  # query3:TestRecurseQueryLimitDepth1
+     '{ me(func: uid(0x01)) @recurse(depth: 2) { friend name } }',
+     '{"me":[{"name":"Michonne", "friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}]}]}'),
+    ("recurse_query_limit_depth2",  # query3:TestRecurseQueryLimitDepth2
+     '{ me(func: uid(0x01)) @recurse(depth: 2) { uid non_existent friend name } }',
+     '{"me":[{"uid":"0x1","friend":[{"uid":"0x17","name":"Rick Grimes"},{"uid":"0x18","name":"Glenn Rhee"},{"uid":"0x19","name":"Daryl Dixon"},{"uid":"0x1f","name":"Andrea"},{"uid":"0x65"}],"name":"Michonne"}]}'),
+    ("recurse_expand",  # query3:TestRecurseExpand
+     '{ me(func: uid(32)) @recurse { expand(_all_) } }',
+     '{"me":[{"school":[{"name":"San Mateo High School","district":[{"name":"San Mateo School District","county":[{"state":[{"name":"California","abbr":"CA"}],"name":"San Mateo County"}]}]}]}]}'),
+    ("shortest_path",  # query3:TestShortestPath
+     '{ A as shortest(from:0x01, to:31) { friend } me(func: uid( A)) { name } }',
+     '{"_path_":[{"uid":"0x1", "_weight_": 1, "friend":{"uid":"0x1f"}}],"me":[{"name":"Michonne"},{"name":"Andrea"}]}'),
+    ("shortest_path_rev",  # query3:TestShortestPathRev
+     '{ A as shortest(from:23, to:1) { friend } me(func: uid( A)) { name } }',
+     '{"_path_":[{"uid":"0x17", "_weight_": 1, "friend":{"uid":"0x1"}}],"me":[{"name":"Rick Grimes"},{"name":"Michonne"}]}'),
+    ("two_shortest_path",  # query3:TestTwoShortestPath
+     '{ A as shortest(from: 1, to:1002, numpaths: 2) { path } me(func: uid( A)) { name } }',
+     '{"_path_":[{"uid":"0x1","_weight_":3,"path":{"uid":"0x1f","path":{"uid":"0x3e8","path":{"uid":"0x3ea"}}}},{"uid":"0x1","_weight_":4,"path":{"uid":"0x1f","path":{"uid":"0x3e8","path":{"uid":"0x3e9","path":{"uid":"0x3ea"}}}}}],"me":[{"name":"Michonne"},{"name":"Andrea"},{"name":"Alice"},{"name":"Matt"}]}'),
+    ("two_shortest_path_max_weight",  # query3:TestTwoShortestPathMaxWeight
+     '{ A as shortest(from: 1, to:1002, numpaths: 2, maxweight:1) { path } me(func: uid( A)) { name } }',
+     '{"me":[]}'),
+    ("two_shortest_path_min_weight",  # query3:TestTwoShortestPathMinWeight
+     '{ A as shortest(from: 1, to:1002, numpaths: 2, minweight:10) { path } me(func: uid( A)) { name } }',
+     '{"me":[]}'),
+    ("k_shortest_path_weighted",  # query3:TestKShortestPathWeighted
+     '{ shortest(from: 1, to:1001, numpaths: 4) { path @facets(weight) } }',
+     '{"_path_":[{"uid":"0x1","_weight_":0.3,"path":{"uid":"0x1f","path":{"uid":"0x3e8","path":{"uid":"0x3e9","path|weight":0.100000},"path|weight":0.100000},"path|weight":0.100000}}]}'),
+    ("shortest_path_nopath",  # query3:TestShortestPath_NoPath
+     '{ A as shortest(from: 101, to:1000) { path follow } me(func: uid(A)) { name } }',
+     '{"me":[]}'),
+    ("count_reverse_func",  # query2:TestCountReverseFunc
+     '{ me(func: ge(count(~friend), 2)) { name count(~friend) } }',
+     '{"me":[{"name":"Glenn Rhee","count(~friend)":2}]}'),
+    ("count_reverse_filter",  # query2:TestCountReverseFilter
+     '{ me(func: anyofterms(name, "Glenn Michonne Rick")) @filter(ge(count(~friend), 2)) { name count(~friend) } }',
+     '{"me":[{"name":"Glenn Rhee","count(~friend)":2}]}'),
+    ("count_reverse",  # query2:TestCountReverse
+     '{ me(func: uid(0x18)) { name count(~friend) } }',
+     '{"me":[{"name":"Glenn Rhee","count(~friend)":2}]}'),
+    ("fastjson_reverse",  # query2:TestToFastJSONReverse
+     '{ me(func: uid(0x18)) { name ~friend { name gender alive } } }',
+     '{"me":[{"name":"Glenn Rhee","~friend":[{"alive":true,"gender":"female","name":"Michonne"},{"alive": false, "name":"Andrea"}]}]}'),
+    ("fastjson_reverse_filter",  # query2:TestToFastJSONReverseFilter
+     '{ me(func: uid(0x18)) { name ~friend @filter(allofterms(name, "Andrea")) { name gender } } }',
+     '{"me":[{"name":"Glenn Rhee","~friend":[{"name":"Andrea"}]}]}'),
+    ("fastjson_order",  # query2:TestToFastJSONOrder
+     '{ me(func: uid(0x01)) { name gender friend(orderasc: dob) { name dob } } }',
+     '{"me":[{"name":"Michonne","gender":"female","friend":[{"name":"Andrea","dob":"1901-01-15T00:00:00Z"},{"name":"Daryl Dixon","dob":"1909-01-10T00:00:00Z"},{"name":"Glenn Rhee","dob":"1909-05-05T00:00:00Z"},{"name":"Rick Grimes","dob":"1910-01-02T00:00:00Z"}]}]}'),
+    ("fastjson_order_desc1",  # query2:TestToFastJSONOrderDesc1
+     '{ me(func: uid(0x01)) { name gender friend(orderdesc: dob) { name dob } } }',
+     '{"me":[{"friend":[{"dob":"1910-01-02T00:00:00Z","name":"Rick Grimes"},{"dob":"1909-05-05T00:00:00Z","name":"Glenn Rhee"},{"dob":"1909-01-10T00:00:00Z","name":"Daryl Dixon"},{"dob":"1901-01-15T00:00:00Z","name":"Andrea"}],"gender":"female","name":"Michonne"}]}'),
+    ("fastjson_order_desc_count",  # query2:TestToFastJSONOrderDescCount
+     '{ me(func: uid(0x01)) { name gender count(friend @filter(anyofterms(name, "Rick")) (orderasc: dob)) } }',
+     '{"me":[{"count(friend)":1,"gender":"female","name":"Michonne"}]}'),
+    ("fastjson_order_offset",  # query2:TestToFastJSONOrderOffset
+     '{ me(func: uid(0x01)) { name gender friend(orderasc: dob, offset: 2) { name } } }',
+     '{"me":[{"friend":[{"name":"Glenn Rhee"},{"name":"Rick Grimes"}],"gender":"female","name":"Michonne"}]}'),
+    ("fastjson_order_offset_count",  # query2:TestToFastJSONOrderOffsetCount
+     '{ me(func: uid(0x01)) { name gender friend(orderasc: dob, offset: 2, first: 1) { name } } }',
+     '{"me":[{"friend":[{"name":"Glenn Rhee"}],"gender":"female","name":"Michonne"}]}'),
+    ("multi_query",  # query2:TestMultiQuery
+     '{ me(func: anyofterms(name, "Michonne")) { name gender } you(func: anyofterms(name, "Andrea")) { name } }',
+     '{"me":[{"gender":"female","name":"Michonne"}], "you":[{"name":"Andrea"},{"name":"Andrea With no friends"}]}'),
+    ("generator",  # query2:TestGenerator
+     '{ me(func:allofterms(name, "Michonne")) { name gender } }',
+     '{"me":[{"gender":"female","name":"Michonne"}]}'),
+]
+
+
+@pytest.mark.parametrize("name,query,expected",
+                         CASES23, ids=[c[0] for c in CASES23])
+def test_ref_conformance_q23(name, query, expected):
+    check(query, expected)
+
+
+# ------------------------------------------- query2/query4 batch 4
+
+CASES4 = [
+    ("normalize_directive",  # query2:TestNormalizeDirective
+     '{ me(func: uid(0x01)) @normalize { mn: name gender friend { n: name d: dob friend { fn : name } } son { sn: name } } }',
+     '{"me":[{"d":"1910-01-02T00:00:00Z","fn":"Michonne","mn":"Michonne","n":"Rick Grimes","sn":"Andre"},{"d":"1910-01-02T00:00:00Z","fn":"Michonne","mn":"Michonne","n":"Rick Grimes","sn":"Helmut"},{"d":"1909-05-05T00:00:00Z","mn":"Michonne","n":"Glenn Rhee","sn":"Andre"},{"d":"1909-05-05T00:00:00Z","mn":"Michonne","n":"Glenn Rhee","sn":"Helmut"},{"d":"1909-01-10T00:00:00Z","mn":"Michonne","n":"Daryl Dixon","sn":"Andre"},{"d":"1909-01-10T00:00:00Z","mn":"Michonne","n":"Daryl Dixon","sn":"Helmut"},{"d":"1901-01-15T00:00:00Z","fn":"Glenn Rhee","mn":"Michonne","n":"Andrea","sn":"Andre"},{"d":"1901-01-15T00:00:00Z","fn":"Glenn Rhee","mn":"Michonne","n":"Andrea","sn":"Helmut"}]}'),
+    ("no_results_filter",  # query4:TestNoResultsFilter
+     '{ q(func: has(nonexistent_pred)) @filter(le(name, "abc")) { uid } }',
+     '{"q": []}'),
+    ("no_results_pagination",  # query4:TestNoResultsPagination
+     '{ q(func: has(nonexistent_pred), first: 50) { uid } }',
+     '{"q": []}'),
+    ("no_results_order",  # query4:TestNoResultsOrder
+     '{ q(func: has(nonexistent_pred), orderasc: name) { uid } }',
+     '{"q": []}'),
+    ("no_results_count",  # query4:TestNoResultsCount
+     '{ q(func: has(nonexistent_pred)) { uid count(friend) } }',
+     '{"q": []}'),
+    ("type_expand_lang",  # query4:TestTypeExpandLang
+     '{ q(func: eq(make, "Toyota")) { expand(_all_) { uid } } }',
+     '{"q":[{"name": "Car", "make":"Toyota","model":"Prius", "model@jp":"プリウス", "year":2009, "owner": [{"uid": "0xcb"}]}]}'),
+    ("type_expand_explicit_type",  # query4:TestTypeExpandExplicitType
+     '{ q(func: eq(make, "Toyota")) { expand(Object) { uid } } }',
+     '{"q":[{"name":"Car", "owner": [{"uid": "0xcb"}]}]}'),
+    ("type_expand_multiple_explicit",  # query4:TestTypeExpandMultipleExplicitTypes
+     '{ q(func: eq(make, "Toyota")) { expand(CarModel, Object) { uid } } }',
+     '{"q":[{"name": "Car", "make":"Toyota","model":"Prius", "model@jp":"プリウス", "year":2009, "owner": [{"uid": "0xcb"}]}]}'),
+    ("type_filter_at_expand",  # query4:TestTypeFilterAtExpand
+     '{ q(func: eq(make, "Toyota")) { expand(_all_) @filter(type(Person)) { owner_name uid } } }',
+     '{"q":[{"owner": [{"owner_name": "Owner of Prius", "uid": "0xcb"}]}]}'),
+    ("type_filter_at_expand_empty",  # query4:TestTypeFilterAtExpandEmptyResults
+     '{ q(func: eq(make, "Toyota")) { expand(_all_) @filter(type(Animal)) { owner_name uid } } }',
+     '{"q":[]}'),
+    ("type_function",  # query2 theme: type() root function
+     '{ q(func: type(Person), orderasc: name) { name } }',
+     '{"q":[{"name":"King Lear"},{"name":"Leonard"},{"name":"Margaret"}]}'),
+]
+
+
+@pytest.mark.parametrize("name,query,expected",
+                         CASES4, ids=[c[0] for c in CASES4])
+def test_ref_conformance_q4(name, query, expected):
+    check(query, expected)

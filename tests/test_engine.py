@@ -270,7 +270,12 @@ def test_shortest(db):
       q(func: uid(path)) { name }
     }'''))
     # 0x17 -> 0x1 -> 0x1f
-    assert [x["uid"] for x in r["_path_"][0]["path"]] == ["0x17", "0x1", "0x1f"]
+    chain, cur = [], r["_path_"][0]
+    while cur is not None:
+        chain.append(cur["uid"])
+        cur = next((v for v in cur.values() if isinstance(v, dict)),
+                   None)
+    assert chain == ["0x17", "0x1", "0x1f"]
     assert {x["name"] for x in r["q"]} == \
         {"Rick Grimes", "Michonne", "Andrea"}
 
@@ -291,7 +296,7 @@ def test_groupby(db):
     r = data(db.query('''{
       q(func: uid(0x1)) { friend @groupby(age) { count(uid) } }
     }'''))
-    groups = r["q"][0]["friend"]["@groupby"]
+    groups = r["q"][0]["friend"][0]["@groupby"]
     bycount = {g["age"]: g["count"] for g in groups}
     assert bycount == {15: 2, 17: 1, 19: 1}
 

@@ -49,7 +49,7 @@ score: int .
 
 
 def _groups(db, q):
-    return db.query(q)["data"]["q"][0]["friend"]["@groupby"]
+    return db.query(q)["data"]["q"][0]["friend"][0]["@groupby"]
 
 
 def test_single_attr_count(db):
@@ -71,7 +71,7 @@ def test_aggregation_over_groups(db):
       var(func: uid(1, 2, 3, 4)) { s as score }
       q(func: uid(10)) { friend @groupby(age)
         { count(uid) max(val(s)) sum(val(s)) } }
-    }''')["data"]["q"][0]["friend"]["@groupby"]
+    }''')["data"]["q"][0]["friend"][0]["@groupby"]
     by_age = {g["age"]: g for g in out}
     assert by_age[20]["max(val(s))"] == 7
     assert by_age[20]["sum(val(s))"] == 15   # 7 + 3 + 5
@@ -122,7 +122,7 @@ def test_groupby_list_valued_scalar_fans_out():
 <9> <item> <2> .
 """)
     out = db.query('{ q(func: uid(9)) { item @groupby(tag) '
-                   '{ count(uid) } } }')["data"]["q"][0]["item"]["@groupby"]
+                   '{ count(uid) } } }')["data"]["q"][0]["item"][0]["@groupby"]
     assert {(g["tag"], g["count"]) for g in out} == {("a", 2), ("b", 1)}
 
 
@@ -138,7 +138,7 @@ def test_groupby_lang_selector():
 <9> <item> <3> .
 """)
     out = db.query('{ q(func: uid(9)) { item @groupby(label@de) '
-                   '{ count(uid) } } }')["data"]["q"][0]["item"]["@groupby"]
+                   '{ count(uid) } } }')["data"]["q"][0]["item"][0]["@groupby"]
     assert {(g["label"], g["count"]) for g in out} == \
         {("rot", 2), ("blau", 1)}
 

@@ -10,9 +10,19 @@ from dgraph_tpu.engine.db import GraphDB
 
 
 def _paths(db, q):
+    """Flatten the reference-shaped nested _path_ chain back to a uid
+    list per path (the emission nests hops under the traversed
+    predicate, ref query3_test.go TestKShortestPathWeighted)."""
     out = db.query(q)["data"].get("_path_", [])
-    return [([int(e["uid"], 16) for e in p["path"]], p.get("_weight_"))
-            for p in out]
+    res = []
+    for p in out:
+        chain, cur = [], p
+        while cur is not None:
+            chain.append(int(cur["uid"], 16))
+            cur = next((v for v in cur.values()
+                        if isinstance(v, dict)), None)
+        res.append((chain, p.get("_weight_")))
+    return res
 
 
 @pytest.fixture(scope="module")

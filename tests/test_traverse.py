@@ -142,7 +142,7 @@ def test_recurse_variable_and_expand():
         '<0x3> <dgraph.type> "Node" .', '<0x4> <dgraph.type> "Node" .',
     ]))
     r = db.query('''{
-      var(func: uid(0x1)) @recurse(depth: 2) { f as follow }
+      var(func: uid(0x1)) @recurse(depth: 3) { f as follow }
       q(func: uid(f)) { name }
     }''')["data"]
     assert sorted(x["name"] for x in r["q"]) == ["b", "c"]
