@@ -117,7 +117,9 @@ def _check_duplicates(res: ParsedResult):
 
 def _resolve_vars(decl: dict, provided: dict | None) -> dict[str, str]:
     out = {}
-    provided = provided or {}
+    # clients pass keys with the dollar sign ("$a": "2" — the
+    # reference's api.Request.Vars convention); decls store bare names
+    provided = {k.lstrip("$"): v for k, v in (provided or {}).items()}
     for name, default in decl.items():
         if name in provided:
             out[name] = str(provided[name])

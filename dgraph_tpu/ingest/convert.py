@@ -151,7 +151,12 @@ def migrate_sqlite(db_path: str, rdf_out: TextIO, schema_out: TextIO,
                         f'{subj} <{pred}> "{_rdf_escape(str(v))}" .\n')
 
     for pred, ptype in sorted(preds.items()):
-        idx = " @index(exact)" if ptype == "string" else ""
+        # every scalar column gets a lookup index: migrated data is
+        # queried by former SQL key columns (root eq/ineq needs an
+        # index, like the reference server)
+        idx = {"string": " @index(exact)", "int": " @index(int)",
+               "float": " @index(float)", "bool": " @index(bool)",
+               "datetime": " @index(datetime)"}.get(ptype, "")
         schema_out.write(f"{pred}: {ptype}{idx} .\n")
     for tname, tpreds in sorted(types.items()):
         schema_out.write(f"type {tname} {{\n")

@@ -216,6 +216,21 @@ def _probe_langs(spec, lang: str) -> list[str]:
 
 _INEQ = {"le", "lt", "ge", "gt", "between"}
 
+
+def _has_sortable_index(schema) -> bool:
+    """Whether a root inequality can walk this predicate's index in
+    value order (ref tok.Tokenizer IsSortable) — read from the
+    tokenizer registry, the one place sortability is defined."""
+    from dgraph_tpu.models.tokenizer import get_tokenizer
+
+    for t in schema.tokenizers:
+        try:
+            if get_tokenizer(t).sortable:
+                return True
+        except KeyError:
+            continue
+    return False
+
 # vectorized comparators for numpy count columns
 _CMP_VEC = {
     "eq": lambda a, b: a == b,
@@ -475,6 +490,7 @@ class Executor:
             return self._run_block_inner(gq)
 
     def _run_block_inner(self, gq: GraphQuery) -> ExecNode:
+        self._block_root = gq
         self._block_vars = set(self._provides(gq))
         # var-only blocks never reach emission, so their scalar
         # children may bind vars columnar-fast and skip posting walks
@@ -553,6 +569,10 @@ class Executor:
     def _eval_func(self, fn: Function, candidates: Optional[np.ndarray]
                    ) -> np.ndarray:
         name = fn.name
+        if fn.attr == "uid" and name != "uid":
+            # `uid` is a result field, never a predicate argument
+            # (ref query1:TestUidAttr: 'Argument cannot be "uid"')
+            raise GQLError('Argument cannot be "uid"')
         if name == "uid":
             uids = _np_sorted(fn.uids)
             for vc in fn.needs_var:
@@ -594,6 +614,13 @@ class Executor:
             return self._eval_var_fn(fn, candidates)
         if name == "eq":
             tab = self._tablet(fn.attr)
+            if candidates is None and tab is not None \
+                    and not tab.schema.indexed:
+                # root eq needs an index to look tokens up in (ref
+                # query1:TestNameNotIndexed; filters compare values
+                # per candidate uid and stay legal without one)
+                raise GQLError(
+                    f"predicate {fn.attr!r} is not indexed")
             if fn.needs_var and not fn.is_value_var:
                 # eq(pred, val(v)): each uid compares against ITS OWN
                 # val(v) (ref query.go valueVarAggregation semantics)
@@ -845,6 +872,21 @@ class Executor:
             raise GQLError(
                 f"attribute {fn.attr!r} is not sortable; only eq "
                 "applies to bool values (ref TestBoolIndexgeRoot)")
+        if fn.name != "between" and len(fn.args) > 1:
+            # inequality against a value list is meaningless (ref
+            # query1:TestMultipleGtError)
+            raise GQLError(
+                f"{fn.name}() expects a single value, "
+                f"got {len(fn.args)}")
+        if candidates is None and not _has_sortable_index(tab.schema):
+            # root inequalities walk an ordered index; hash/term/
+            # trigram and unindexed predicates can't serve one (ref
+            # query1:TestHashTokGeqErr, worker/tokens.go
+            # getInequalityTokens' IsSortable requirement)
+            raise GQLError(
+                f"attribute {fn.attr!r} needs a sortable index "
+                f"(exact/int/float/datetime) to serve {fn.name} "
+                "at the query root")
         try:
             if fn.name == "between":
                 lo = sort_key(convert(Val(TypeID.DEFAULT, fn.args[0].value), tid))
@@ -1246,6 +1288,11 @@ class Executor:
         keeps uids that X points at via pred (ref worker/task.go
         handleUidPostings UidInFn; reverse attrs resolve like any
         predicate)."""
+        if candidates is None:
+            # filter-only, like the reference (query1:
+            # TestUidInFunctionAtRoot rejects it at the root)
+            raise GQLError(
+                "the uid_in function is only valid in @filter")
         rev = fn.attr.startswith("~")
         tab = self._tablet(fn.attr[1:] if rev else fn.attr)
         if tab is None:
@@ -2076,8 +2123,37 @@ class Executor:
                 vals = list(vmap.values()) if whole \
                     else [vmap[u] for u in src.tolist() if u in vmap]
                 agg = _aggregate(gq.agg_func, vals)
+            if agg is None and gq.agg_func == "sum" and not len(src):
+                # sum over an empty var emits 0 in a row-less block
+                # (ref query1:TestAggregateRoot5 "sum(val(m))":0.000000)
+                agg = Val(TypeID.FLOAT, 0.0)
             node.values[0] = [Agg(gq.agg_func, agg)]
+            if gq.var:
+                # `minVal as min(val(a))` in an empty block binds a
+                # GLOBAL var: key 0, matching the reference's
+                # aggregated-var map (query.go empty-block aggregation;
+                # TestAggregateRoot4/TestAggregateEmpty1). An empty
+                # aggregate still DEFINES the var so downstream blocks
+                # schedule (TestAggregateRoot6 expects [], not an
+                # undefined-variable error).
+                self.value_vars[gq.var] = \
+                    {} if agg is None else {0: agg}
         elif gq.math is not None:
+            root = getattr(self, "_block_root", None)
+            if root is not None and root.func is None \
+                    and not root.uids and not root.needs_var:
+                # empty blocks (`me()`) may only do math over
+                # aggregated (global, key-0) vars (ref edgraph:
+                # "Only aggregated variables allowed within empty
+                # block." — query1:TestAggregateRootError)
+                for vn in _math_tree_vars(gq.math):
+                    vmap0 = self.value_vars.get(vn, {})
+                    keys = vmap0.uids if isinstance(vmap0, ColVar) \
+                        else vmap0.keys()
+                    if any(int(k) != 0 for k in keys):
+                        raise GQLError(
+                            "Only aggregated variables allowed "
+                            "within empty block.")
             vmap = _eval_math(gq.math, self.value_vars, node.src)
             if gq.var:
                 self.value_vars[gq.var] = vmap
@@ -2163,11 +2239,35 @@ class Executor:
         if gq.order:
             for o in gq.order:
                 if o.attr.startswith("val("):
+                    vn = o.attr[4:-1]
+                    if vn not in self.value_vars \
+                            and vn not in self.uid_vars:
+                        # bound later in this same block: the
+                        # reference rejects rather than ordering by
+                        # a not-yet-computed var (query1:
+                        # TestUseVariableBeforeDefinitionError)
+                        raise GQLError(
+                            f"Variable: [{vn}] used before "
+                            "definition.")
                     # ordering by val(v) keeps ONLY uids v is bound
                     # for (ref query0_test.go
                     # TestQueryVarValOrderDescMissing -> empty)
-                    vmap = self.value_vars.get(o.attr[4:-1], {})
+                    vmap = self.value_vars.get(vn, {})
                     uids = _intersect(uids, _var_domain(vmap))
+                elif not o.attr.startswith("facet:"):
+                    otab = self._tablet(o.attr.lstrip("~"))
+                    if otab is not None and otab.schema.list_:
+                        # ref query1:TestMultipleValueSortError
+                        raise GQLError(
+                            f"Sorting not supported on attr: "
+                            f"{o.attr} of type: [scalar]")
+                    if otab is not None and \
+                            otab.schema.value_type == TypeID.BOOL:
+                        # ref query1:TestBoolSort (types.Sort has no
+                        # bool ordering)
+                        raise GQLError(
+                            f"Sorting not supported on attr: "
+                            f"{o.attr} of type: bool")
             paged = self._device_order_page(gq, uids)
             if paged is not None:
                 return paged
@@ -2933,6 +3033,18 @@ class Executor:
                     if agg.value is not None:
                         name = ch.gq.alias or ch.gq.attr
                         out.append({name: to_json_value(agg.value)})
+                elif ch.gq.math is not None and 0 in ch.values:
+                    # math over aggregated (global) vars in a row-less
+                    # block (ref query1:TestAggregateRoot4 `Sum:
+                    # math(minVal + maxVal)`); same naming convention
+                    # as the per-row path: `v as math(...)` emits
+                    # under "val(v)"
+                    agg = ch.values[0][0]
+                    if agg.value is not None:
+                        name = ch.gq.alias or (
+                            f"val({ch.gq.var})" if ch.gq.var
+                            else "math")
+                        out.append({name: to_json_value(agg.value)})
         if gq.normalize:
             out = [row for o in out if o
                    for row in self._normalize(o)]
@@ -3329,19 +3441,12 @@ class Executor:
         rows = np.ascontiguousarray(dsts, dtype=np.uint64)
         code_cols: list[np.ndarray] = []
         for (u_sorted, codes, _dec) in cols:
-            starts = np.searchsorted(u_sorted, rows, "left")
-            ends = np.searchsorted(u_sorted, rows, "right")
-            cnt = (ends - starts).astype(np.int64)
-            total = int(cnt.sum())
-            if total == 0:
+            got = _join_codes(u_sorted, codes, rows)
+            if got is None:
                 return {}
-            rep = np.repeat(np.arange(len(rows)), cnt)
-            # gathered indices = starts[row] + position-within-row
-            base = np.repeat(starts, cnt)
-            csum = np.concatenate(([0], np.cumsum(cnt)[:-1]))
-            inner = np.arange(total) - np.repeat(csum, cnt)
+            rep, gathered = got
             code_cols = [c[rep] for c in code_cols]
-            code_cols.append(codes[base + inner])
+            code_cols.append(gathered)
             rows = rows[rep]
         if not len(rows):
             return {}
@@ -3406,11 +3511,46 @@ class Executor:
     def _emit_groupby(self, ch: ExecNode, dsts: np.ndarray) -> dict:
         """@groupby(attrs...) { count(uid) aggs... }
         (ref query/groupby.go:371)."""
+        fast = self._emit_groupby_count_fast(ch.gq, dsts)
+        if fast is not None:
+            return fast
         groups = self._groupby_groups(ch.gq, dsts)
         return {"@groupby": [
             self._groupby_entry(ch.gq, key, members)
             for key, members in sorted(groups.items(),
                                        key=lambda kv: str(kv[0]))]}
+
+    def _emit_groupby_count_fast(self, gq: GraphQuery,
+                                 dsts: np.ndarray) -> Optional[dict]:
+        """Single-attr @groupby whose only child is count(uid): group
+        counts come from one np.unique over the gathered key codes —
+        no member lists, no per-group entry builder. This is the root
+        groupby shape (q052/ref query0:TestGroupByRoot) where the
+        general path's per-group Python dominated at 21M."""
+        if len(gq.groupby) != 1 or len(gq.children) != 1:
+            return None
+        cgq = gq.children[0]
+        if cgq.attr != "uid" or not cgq.is_count or cgq.var:
+            return None
+        got = self._groupby_attr_codes(gq.groupby[0])
+        if got is None:
+            return None
+        u_sorted, codes, dec = got
+        rows = np.ascontiguousarray(dsts, dtype=np.uint64)
+        joined = _join_codes(u_sorted, codes, rows)
+        if joined is None:
+            return {"@groupby": []}
+        uniq, counts = np.unique(joined[1], return_counts=True)
+        inc_counter("query_groupby_fast_total")
+        ga = gq.groupby[0]
+        keyname = ga.alias or ga.attr
+        cname = cgq.alias or "count"
+        ents = [{keyname: dec(c), cname: int(n)}
+                for c, n in zip(uniq.tolist(), counts.tolist())]
+        # identical ordering contract to the general path: sort by the
+        # str() of the 1-key tuple
+        ents.sort(key=lambda e: str((e[keyname],)))
+        return {"@groupby": ents}
 
     def _bind_groupby_vars(self, gq: GraphQuery, dest: np.ndarray):
         """`a as count(uid)` / `m as max(val(x))` inside a groupby block
@@ -3886,6 +4026,35 @@ def _eval_math_vec(tree, value_vars):
                       isbool=True)
     return ColVar(uids, vals.astype(np.float64), TypeID.FLOAT,
                   frac=True)
+
+
+def _join_codes(u_sorted: np.ndarray, codes: np.ndarray,
+                rows: np.ndarray
+                ) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    """Join group members against one key column: for every row uid,
+    gather EVERY aligned code (multi-valued attrs fan out). Returns
+    (rep, gathered) where rep repeats each row index once per matched
+    code and gathered holds the codes; None when nothing matches."""
+    starts = np.searchsorted(u_sorted, rows, "left")
+    ends = np.searchsorted(u_sorted, rows, "right")
+    cnt = (ends - starts).astype(np.int64)
+    total = int(cnt.sum())
+    if total == 0:
+        return None
+    rep = np.repeat(np.arange(len(rows)), cnt)
+    # gathered indices = starts[row] + position-within-row
+    base = np.repeat(starts, cnt)
+    csum = np.concatenate(([0], np.cumsum(cnt)[:-1]))
+    inner = np.arange(total) - np.repeat(csum, cnt)
+    return rep, codes[base + inner]
+
+
+def _math_tree_vars(tree):
+    """Every var name a math tree reads."""
+    if tree.var:
+        yield tree.var
+    for c in tree.children:
+        yield from _math_tree_vars(c)
 
 
 def _eval_math(tree, value_vars, src=None) -> "dict[int, Val] | ColVar":

@@ -461,3 +461,191 @@ CASES4 = [
                          CASES4, ids=[c[0] for c in CASES4])
 def test_ref_conformance_q4(name, query, expected):
     check(query, expected)
+
+
+# ------------------------------------------- query1 batch 5
+# eq-lists, uid()/uid_in(), @ignoreReflex, root aggregation over
+# empty blocks, multi-value lists, multi-key sort — the families the
+# round-4 verdict flagged as under-covered.
+
+CASES5 = [
+    ("order_desc_filter_count",  # query1:TestOrderDescFilterCount
+     '{ me(func: uid(0x01)) { friend(first:2, orderdesc: age) @filter(eq(alias, "Zambo Alice")) { alias } } }',
+     '{"me":[{"friend":[{"alias":"Zambo Alice"}]}]}'),
+    ("hash_tok_eq",  # query1:TestHashTokEq
+     '{ me(func: eq(full_name, "Michonne\'s large name for hashing")) { full_name alive friend { name } } }',
+     '{"me":[{"alive":true,"friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}],"full_name":"Michonne\'s large name for hashing"}]}'),
+    ("multiple_min_max",  # query1:TestMultipleMinMax
+     '{ me(func: uid(0x01)) { friend { x as age n as name } min(val(x)) max(val(x)) min(val(n)) max(val(n)) } }',
+     '{"me":[{"friend":[{"age":15,"name":"Rick Grimes"},{"age":15,"name":"Glenn Rhee"},{"age":17,"name":"Daryl Dixon"},{"age":19,"name":"Andrea"}],"max(val(n))":"Rick Grimes","max(val(x))":19,"min(val(n))":"Andrea","min(val(x))":15}]}'),
+    ("multiple_equality",  # query1:TestMultipleEquality
+     '{ me(func: eq(name, ["Rick Grimes"])) { name friend { name } } }',
+     '{"me":[{"friend":[{"name":"Michonne"}],"name":"Rick Grimes"}]}'),
+    ("multiple_equality2",  # query1:TestMultipleEquality2
+     '{ me(func: eq(name, ["Badger", "Bobby", "Matt"])) { name friend { name } } }',
+     '{"me":[{"name":"Matt"},{"name":"Badger"}]}'),
+    ("multiple_equality3",  # query1:TestMultipleEquality3
+     '{ me(func: eq(dob, ["1910-01-01", "1909-05-05"])) { name friend { name } } }',
+     '{"me":[{"friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}],"name":"Michonne"},{"name":"Glenn Rhee"}]}'),
+    ("multiple_equality4",  # query1:TestMultipleEquality4
+     '{ me(func: eq(dob, ["1910-01-01", "1909-05-05"])) { name friend @filter(eq(name, ["Rick Grimes", "Andrea"])) { name } } }',
+     '{"me":[{"friend":[{"name":"Rick Grimes"},{"name":"Andrea"}],"name":"Michonne"},{"name":"Glenn Rhee"}]}'),
+    ("multiple_equality5",  # query1:TestMultipleEquality5
+     '{ me(func: eq(name@en, ["Honey badger", "Honey bee"])) { name@en } }',
+     '{"me":[{"name@en":"Honey badger"},{"name@en":"Honey bee"}]}'),
+    ("multiple_eq_quote",  # query1:TestMultipleEqQuote
+     '{ me(func: eq(name, ["Alice\\"", "Michonne"])) { name friend { name } } }',
+     '{"me":[{"friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}],"name":"Michonne"},{"name":"Alice\\""}]}'),
+    ("multiple_eq_int",  # query1:TestMultipleEqInt
+     '{ me(func: eq(age, [15, 17, 38])) { name friend { name } } }',
+     '{"me":[{"name":"Michonne","friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}]},{"name":"Rick Grimes","friend":[{"name":"Michonne"}]},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"}]}'),
+    ("uid_function",  # query1:TestUidFunction
+     '{ me(func: uid(23, 1, 24, 25, 31)) { name } }',
+     '{"me":[{"name":"Michonne"},{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}]}'),
+    ("uid_function_in_filter",  # query1:TestUidFunctionInFilter
+     '{ me(func: uid(23, 1, 24, 25, 31))  @filter(uid(1, 24)) { name } }',
+     '{"me":[{"name":"Michonne"},{"name":"Glenn Rhee"}]}'),
+    ("uid_function_in_filter2",  # query1:TestUidFunctionInFilter2
+     '{ me(func: uid(23, 1, 24, 25, 31)) { name friend @filter(uid(23, 1)) { name } } }',
+     '{"me":[{"name":"Michonne","friend":[{"name":"Rick Grimes"}]},{"name":"Rick Grimes","friend":[{"name":"Michonne"}]},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}]}'),
+    ("uid_function_in_filter3",  # query1:TestUidFunctionInFilter3
+     '{ me(func: anyofterms(name, "Michonne Andrea")) @filter(uid(1)) { name } }',
+     '{"me":[{"name":"Michonne"}]}'),
+    ("uid_function_in_filter4",  # query1:TestUidFunctionInFilter4
+     '{ me(func: anyofterms(name, "Michonne Andrea")) @filter(not uid(1, 31)) { name } }',
+     '{"me":[{"name":"Andrea With no friends"}]}'),
+    ("uid_in_function",  # query1:TestUidInFunction
+     '{ me(func: uid(1, 23, 24)) @filter(uid_in(friend, 23)) { name } }',
+     '{"me":[{"name":"Michonne"}]}'),
+    ("uid_in_function1",  # query1:TestUidInFunction1 (case-insensitive UID)
+     '{ me(func: UID(1, 23, 24)) @filter(uid_in(school, 5000)) { name } }',
+     '{"me":[{"name":"Michonne"},{"name":"Glenn Rhee"}]}'),
+    ("uid_in_function2",  # query1:TestUidInFunction2
+     '{ me(func: uid(1, 23, 24)) { friend @filter(uid_in(school, 5000)) { name } } }',
+     '{"me":[{"friend":[{"name":"Glenn Rhee"},{"name":"Daryl Dixon"}]},{"friend":[{"name":"Michonne"}]}]}'),
+    ("reflexive",  # query1:TestReflexive
+     '{ me(func:anyofterms(name, "Michonne Rick Daryl")) @ignoreReflex { name friend { name friend { name } } } }',
+     '{"me":[{"friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"friend":[{"name":"Glenn Rhee"}],"name":"Andrea"}],"name":"Michonne"},{"friend":[{"friend":[{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}],"name":"Michonne"}],"name":"Rick Grimes"},{"name":"Daryl Dixon"}]}'),
+    ("reflexive2",  # query1:TestReflexive2 (directive case-insensitive)
+     '{ me(func:anyofterms(name, "Michonne Rick Daryl")) @IGNOREREFLEX { name friend { name friend { name } } } }',
+     '{"me":[{"friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"friend":[{"name":"Glenn Rhee"}],"name":"Andrea"}],"name":"Michonne"},{"friend":[{"friend":[{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}],"name":"Michonne"}],"name":"Rick Grimes"},{"name":"Daryl Dixon"}]}'),
+    ("reflexive3",  # query1:TestReflexive3 (+ @normalize)
+     '{ me(func:anyofterms(name, "Michonne Rick Daryl")) @IGNOREREFLEX @normalize { Me: name friend { Friend: name friend { Cofriend: name } } } }',
+     '{"me":[{"Friend":"Rick Grimes","Me":"Michonne"},{"Friend":"Glenn Rhee","Me":"Michonne"},{"Friend":"Daryl Dixon","Me":"Michonne"},{"Cofriend":"Glenn Rhee","Friend":"Andrea","Me":"Michonne"},{"Cofriend":"Glenn Rhee","Friend":"Michonne","Me":"Rick Grimes"},{"Cofriend":"Daryl Dixon","Friend":"Michonne","Me":"Rick Grimes"},{"Cofriend":"Andrea","Friend":"Michonne","Me":"Rick Grimes"},{"Me":"Daryl Dixon"}]}'),
+    ("cascade_uid",  # query1:TestCascadeUid
+     '{ me(func: uid(0x01)) @cascade { name gender friend { uid name friend{ name dob age } } } }',
+     '{"me":[{"friend":[{"uid":"0x17","friend":[{"age":38,"dob":"1910-01-01T00:00:00Z","name":"Michonne"}],"name":"Rick Grimes"},{"uid":"0x1f","friend":[{"age":15,"dob":"1909-05-05T00:00:00Z","name":"Glenn Rhee"}],"name":"Andrea"}],"gender":"female","name":"Michonne"}]}'),
+    ("aggregate_root1",  # query1:TestAggregateRoot1
+     '{ var(func: anyofterms(name, "Rick Michonne Andrea")) { a as age } me() { sum(val(a)) } }',
+     '{"me":[{"sum(val(a))":72}]}'),
+    ("aggregate_root2",  # query1:TestAggregateRoot2
+     '{ var(func: anyofterms(name, "Rick Michonne Andrea")) { a as age } me() { avg(val(a)) min(val(a)) max(val(a)) } }',
+     '{"me":[{"avg(val(a))":24.000000},{"min(val(a))":15},{"max(val(a))":38}]}'),
+    ("aggregate_root3",  # query1:TestAggregateRoot3
+     '{ me1(func: anyofterms(name, "Rick Michonne Andrea")) { a as age } me() { sum(val(a)) } }',
+     '{"me1":[{"age":38},{"age":15},{"age":19}],"me":[{"sum(val(a))":72}]}'),
+    ("aggregate_root4",  # query1:TestAggregateRoot4
+     '{ var(func: anyofterms(name, "Rick Michonne Andrea")) { a as age } me() { minVal as min(val(a)) maxVal as max(val(a)) Sum: math(minVal + maxVal) } }',
+     '{"me":[{"min(val(a))":15},{"max(val(a))":38},{"Sum":53.000000}]}'),
+    ("aggregate_root5",  # query1:TestAggregateRoot5 (missing edge sums to 0)
+     '{ var(func: anyofterms(name, "Rick Michonne Andrea")) { m as money } me() { sum(val(m)) } }',
+     '{"me":[{"sum(val(m))":0.000000}]}'),
+    ("aggregate_root6",  # query1:TestAggregateRoot6
+     '{ uids as var(func: anyofterms(name, "Rick Michonne Andrea")) var(func: uid(uids)) @cascade { reason { killed_zombies as math(1) } zombie_count as sum(val(killed_zombies)) } me(func: uid(uids)) { money: val(zombie_count) } }',
+     '{"me":[]}'),
+    ("aggregate_empty1",  # query1:TestAggregateEmpty1
+     '{ var(func: has(number)) { number as number } var() { highest as max(val(number)) } all(func: eq(number, val(highest))) { uid number } }',
+     '{"all":[]}'),
+    ("aggregate_empty2",  # query1:TestAggregateEmpty2
+     '{ var(func: has(number)) { highest_number as number } all(func: eq(number, val(highest_number))) { uid } }',
+     '{"all":[]}'),
+    ("aggregate_empty3",  # query1:TestAggregateEmpty3
+     '{ var(func: has(number)) { highest_number as number } all(func: ge(number, val(highest_number))) { uid } }',
+     '{"all":[]}'),
+    ("filter_lang",  # query1:TestFilterLang
+     '{ me(func: uid(0x1001, 0x1002, 0x1003)) @filter(ge(name@en, "D"))  { name@en } }',
+     '{"me":[{"name@en":"European badger"},{"name@en":"Honey badger"},{"name@en":"Honey bee"}]}'),
+    ("math_ceil1",  # query1:TestMathCeil1 (empty root var chain)
+     '{ me as var(func: eq(name, "XxXUnknownXxX")) var(func: uid(me)) { friend { x as age } x2 as sum(val(x)) c as count(friend) } me(func: uid(me)) { ceilAge: math(ceil(x2/c)) } }',
+     '{"me": []}'),
+    ("math_ceil2",  # query1:TestMathCeil2
+     '{ me as var(func: eq(name, "Michonne")) var(func: uid(me)) { friend { x as age } x2 as sum(val(x)) c as count(friend) } me(func: uid(me)) { ceilAge: math(ceil((1.0*x2)/c)) } }',
+     '{"me":[{"ceilAge":14.000000}]}'),
+    # INTENTIONAL DIVERGENCE (list order): the reference emits
+    # multi-value lists in posting order = farmhash fingerprint order
+    # of the value bytes (posting/index.go fingerprints value postings
+    # — ["1935...","1933..."] for Andrea), which is deterministic but
+    # hash-arbitrary. This build orders list values by VALUE; the set
+    # is identical. Expected JSON below uses value order.
+    ("multiple_value_filter",  # query1:TestMultipleValueFilter
+     '{ me(func: ge(graduation, "1930")) { name graduation } }',
+     '{"me":[{"name":"Michonne","graduation":["1932-01-01T00:00:00Z"]},{"name":"Andrea","graduation":["1933-01-01T00:00:00Z","1935-01-01T00:00:00Z"]}]}'),
+    ("multiple_value_filter2",  # query1:TestMultipleValueFilter2
+     '{ me(func: le(graduation, "1933")) { name graduation } }',
+     '{"me":[{"name":"Michonne","graduation":["1932-01-01T00:00:00Z"]},{"name":"Andrea","graduation":["1933-01-01T00:00:00Z","1935-01-01T00:00:00Z"]}]}'),
+    ("multiple_value_array",  # query1:TestMultipleValueArray
+     '{ me(func: uid(1)) { name graduation } }',
+     '{"me":[{"name":"Michonne","graduation":["1932-01-01T00:00:00Z"]}]}'),
+    ("multiple_value_array2",  # query1:TestMultipleValueArray2 (field order)
+     '{ me(func: uid(1)) { graduation name } }',
+     '{"me":[{"name":"Michonne","graduation":["1932-01-01T00:00:00Z"]}]}'),
+    ("multiple_value_has_and_count",  # query1:TestMultipleValueHasAndCount
+     # list order: value order here, fingerprint order in the
+     # reference — see the divergence note above
+     '{ me(func: has(graduation)) { name count(graduation) graduation } }',
+     '{"me":[{"name":"Michonne","count(graduation)":1,"graduation":["1932-01-01T00:00:00Z"]},{"name":"Andrea","count(graduation)":2,"graduation":["1933-01-01T00:00:00Z","1935-01-01T00:00:00Z"]}]}'),
+    ("near_point_multi_polygon",  # query1:TestNearPointMultiPolygon
+     '{ me(func: near(loc, [1.0, 1.0], 1)) { name } }',
+     '{"me":[{"name":"Rick Grimes"}]}'),
+    ("multi_sort1",  # query1:TestMultiSort1
+     '{ me(func: uid(10005, 10006, 10001, 10002, 10003, 10004, 10007, 10000), orderasc: name, orderasc: age) { name age } }',
+     '{"me":[{"name":"Alice","age":25},{"name":"Alice","age":75},{"name":"Alice","age":75},{"name":"Bob","age":25},{"name":"Bob","age":75},{"name":"Colin","age":25},{"name":"Elizabeth","age":25},{"name":"Elizabeth","age":75}]}'),
+    ("multi_sort2",  # query1:TestMultiSort2
+     '{ me(func: uid(10005, 10006, 10001, 10002, 10003, 10004, 10007, 10000), orderasc: name, orderdesc: age) { name age } }',
+     '{"me":[{"name":"Alice","age":75},{"name":"Alice","age":75},{"name":"Alice","age":25},{"name":"Bob","age":75},{"name":"Bob","age":25},{"name":"Colin","age":25},{"name":"Elizabeth","age":75},{"name":"Elizabeth","age":25}]}'),
+]
+
+
+@pytest.mark.parametrize("name,query,expected",
+                         CASES5, ids=[c[0] for c in CASES5])
+def test_ref_conformance_q1_batch5(name, query, expected):
+    check(query, expected)
+
+
+def test_json_query_variables():  # query1:TestJSONQueryVariables
+    check('query test ($a: int = 1) { me(func: uid(0x01)) { name gender '
+          'friend(first: $a) { name } } }',
+          '{"me":[{"friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"}],'
+          '"gender":"female","name":"Michonne"}]}',
+          variables={"$a": "2"})
+
+
+# negative cases batch 5 (each cited inline)
+REJECTS5 = [
+    # query1:TestBoolSort — order by bool has no sortable index
+    '{ me(func: anyofterms(name, "Michonne Andrea Rick"), orderasc: alive) { name alive } }',
+    # query1:TestHashTokGeqErr — hash index answers eq only
+    '{ me(func: ge(full_name, "Michonne\'s large name for hashing")) { full_name } }',
+    # query1:TestNameNotIndexed
+    '{ me(func: eq(noindex_name, "Michonne\'s name not indexed")) { full_name } }',
+    # query1:TestMultipleGtError — inequality over a value list
+    '{ me(func: gt(name, ["Badger", "Bobby"])) { name } }',
+    # query1:TestUidInFunctionAtRoot — uid_in is filter-only
+    '{ me(func: uid_in(school, 5000)) { name } }',
+    # query1:TestUseVariableBeforeDefinitionError
+    '{ me(func: anyofterms(name, "Michonne Daryl Andrea"), orderasc: val(avgAge)) { name friend { x as age } avgAge as avg(val(x)) } }',
+    # query1:TestAggregateRootError — unaggregated vars in empty block math
+    '{ var(func: anyofterms(name, "Rick Michonne Andrea")) { a as age } var(func: anyofterms(name, "Rick Michonne")) { a2 as age } me() { Sum: math(a + a2) } }',
+    # query1:TestMultipleValueSortError — order by list predicate
+    '{ me(func: anyofterms(name, "Michonne Rick"), orderdesc: graduation) { name graduation } }',
+    # query1:TestUidAttr — "uid" is not a predicate argument
+    '{ q(func:ge(uid, 1)) { uid }}',
+    '{ q(func:has(uid)) { uid }}',
+]
+
+
+@pytest.mark.parametrize("bad", REJECTS5)
+def test_ref_rejects5(bad):
+    from dgraph_tpu.gql.lexer import GQLError
+    with pytest.raises((GQLError, ValueError)):
+        db().query(bad)

@@ -41,7 +41,7 @@ def _get(base, path):
 
 def test_alter_and_schema(server):
     base, _ = server
-    r = _post(base, "/alter", "hname: string @index(exact) .\nhage: int .")
+    r = _post(base, "/alter", "hname: string @index(exact) .\nhage: int @index(int) .")
     assert r["code"] == "Success"
     sch = _get(base, "/admin/schema")
     assert "hname" in sch["data"]["schema"]

@@ -115,7 +115,7 @@ def test_migrate_sqlite(tmp_path):
                      "--output-schema", str(sch)]) == 0
     schema = sch.read_text()
     assert "book.title: string @index(exact) ." in schema
-    assert "book.pages: int ." in schema
+    assert "book.pages: int @index(int) ." in schema
     assert "book.author_id: [uid] @reverse ." in schema
     assert "type book {" in schema
 
