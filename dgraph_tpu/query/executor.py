@@ -2536,6 +2536,16 @@ class Executor:
         (q006 host path: 3.1s -> columnar). Falls back to the exact
         per-uid dict path for val()/facet keys and dirty tablets."""
         attr = o.attr
+        if attr == "uid":
+            # order by uid: the key IS the uid (this keeps q070's
+            # orderasc:uid off the per-uid dict walk). Sign-bit XOR
+            # maps uint64 to int64 order-preservingly so uids >= 2^63
+            # sort correctly; uid 0 never exists, so desc negation
+            # cannot hit INT64_MIN.
+            arr = np.ascontiguousarray(uids, dtype=np.uint64)
+            sub = (arr ^ np.uint64(1 << 63)).view(np.int64)
+            col = np.zeros(len(arr), np.int64)
+            return col, (-sub if o.desc else sub)
         if not attr.startswith(("val(", "facet:")) \
                 and o.lang not in (".", "*"):
             # '.' / '*' tags resolve "any language" via
@@ -2992,9 +3002,13 @@ class Executor:
     def _emit_block(self, node: ExecNode) -> list:
         gq = node.gq
         if gq.recurse is not None:
-            return [r for r in
-                    (self._emit_recurse_node(node, int(u), 0)
-                     for u in node.dest.tolist()) if r]
+            self._recurse_colvals = self._recurse_scalar_cache(node)
+            try:
+                return [r for r in
+                        (self._emit_recurse_node(node, int(u), 0)
+                         for u in node.dest.tolist()) if r]
+            finally:
+                self._recurse_colvals = {}
         if gq.is_groupby:
             # root-level @groupby groups the block's matched uids (ref
             # query0_test.go TestGroupByRoot:
@@ -3420,8 +3434,18 @@ class Executor:
         if got is None:
             return None
         codes, table = got
-        return srcs, codes, \
-            lambda c, _t=table: _t[int(c)].decode("utf-8")
+
+        def dec(c, _t=table):
+            return _t[int(c)].decode("utf-8")
+
+        # count-fast extras: bulk decode (no per-element dispatch)
+        # and, when byte order == output order, permission to skip
+        # the per-group python sort altogether
+        dec.bulk = lambda cs, _t=table: \
+            [_t[c].decode("utf-8") for c in cs]
+        dec.byte_ordered = col.enc_sort_safe() \
+            if hasattr(col, "enc_sort_safe") else False
+        return srcs, codes, dec
 
     def _groupby_groups_vec(self, gattrs, dsts: np.ndarray
                             ) -> Optional[dict[tuple, list[int]]]:
@@ -3545,11 +3569,16 @@ class Executor:
         ga = gq.groupby[0]
         keyname = ga.alias or ga.attr
         cname = cgq.alias or "count"
-        ents = [{keyname: dec(c), cname: int(n)}
-                for c, n in zip(uniq.tolist(), counts.tolist())]
-        # identical ordering contract to the general path: sort by the
-        # str() of the 1-key tuple
-        ents.sort(key=lambda e: str((e[keyname],)))
+        bulk = getattr(dec, "bulk", None)
+        ucodes = uniq.tolist()
+        vals = bulk(ucodes) if bulk else [dec(c) for c in ucodes]
+        ents = [{keyname: v, cname: n}
+                for v, n in zip(vals, counts.tolist())]
+        # identical ordering contract to the general path: sort by
+        # the str() of the 1-key tuple — skipped when np.unique's
+        # byte order already IS that order (safe-ASCII payloads)
+        if not getattr(dec, "byte_ordered", False):
+            ents.sort(key=lambda e: str((e[keyname],)))
         return {"@groupby": ents}
 
     def _bind_groupby_vars(self, gq: GraphQuery, dest: np.ndarray):
@@ -3586,6 +3615,39 @@ class Executor:
                         vmap[guid] = agg
             self.value_vars[cgq.var] = vmap
 
+    def _recurse_scalar_cache(self, node: ExecNode) -> dict:
+        """uid -> json value maps for every flat scalar child of a
+        @recurse block, gathered columnarly over the WHOLE visited uid
+        set once — the per-node get_postings walk dominated the q067
+        profile (one posting fetch per node per scalar pred across
+        ~10k visited nodes). Keys = (attr, langs); ineligible children
+        (lang fans, lists, vars, facets) stay on the exact path."""
+        parts = [node.dest]
+        for lv in node.recurse_levels:
+            for per_parent in lv.values():
+                parts.extend(per_parent.values())
+        parts = [p for p in parts if len(p)]
+        if not parts:
+            return {}
+        allu = np.unique(np.concatenate(parts))
+        cache: dict = {}
+        seen: set = set()
+        levels = node.recurse_preds or [node.gq.children]
+        for preds in levels:
+            for cgq in preds:
+                tab = self._tablet(cgq.attr.lstrip("~"))
+                if tab is None \
+                        or tab.schema.value_type == TypeID.UID:
+                    continue
+                key = (cgq.attr, tuple(cgq.langs or ()))
+                if key in seen:
+                    continue
+                seen.add(key)
+                cm = self._colvals_for_emit(tab, cgq, allu)
+                if cm is not None:
+                    cache[key] = cm
+        return cache
+
     def _emit_recurse_node(self, node: ExecNode, uid: int, level: int
                            ) -> dict:
         # uid appears only when the block asks for it (ref
@@ -3608,6 +3670,13 @@ class Executor:
                 continue
             name = cgq.alias or cgq.attr
             if tab.schema.value_type != TypeID.UID:
+                cm = getattr(self, "_recurse_colvals", {}).get(
+                    (cgq.attr, tuple(cgq.langs or ())))
+                if cm is not None:
+                    v = cm.get(uid)
+                    if v is not None:
+                        obj[name] = v
+                    continue
                 ps = tab.get_postings(uid, self.read_ts)
                 if cgq.langs == ["*"]:
                     for p in ps:

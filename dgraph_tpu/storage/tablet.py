@@ -49,7 +49,8 @@ class ValueColumns:
 
     __slots__ = ("srcs", "tid", "data", "enc", "nbytes",
                  "extra_srcs", "extra_enc", "extra_ok", "_ascii",
-                 "_codes", "dt_secs", "dt_objs", "_blob")
+                 "_codes", "dt_secs", "dt_objs", "_blob",
+                 "_sort_safe")
 
     def __init__(self, srcs, tid, data, enc,
                  extra_srcs=None, extra_enc=None, extra_ok=True):
@@ -59,6 +60,7 @@ class ValueColumns:
         self.enc = enc
         self._codes = None
         self._blob = None
+        self._sort_safe = None
         # DATETIME tablets also carry the numeric column (float epoch
         # seconds, the dict math path's float() domain) plus the exact
         # datetime objects for var materialization
@@ -127,6 +129,26 @@ class ValueColumns:
             return None
         self._codes = (codes.astype(np.int64), table)
         return self._codes
+
+    def enc_sort_safe(self) -> bool:
+        """True when sorting the DECODED payload strings by
+        str((v,)) — the groupby output-ordering contract — equals
+        sorting the raw bytes: every byte printable ASCII with no
+        quote/backslash, so repr() wraps each value identically and
+        UTF-8 byte order is codepoint order. Cached per view."""
+        if self._sort_safe is None:
+            if not self.enc:
+                self._sort_safe = True
+            else:
+                # bytes must be STRICTLY above the closing quote 0x27
+                # that str((v,)) appends: with any byte below it, a
+                # value that extends a shorter prefix ("New York" vs
+                # "New") sorts after the prefix in byte order but
+                # BEFORE it in the quoted contract order
+                b = np.frombuffer(b"".join(self.enc), np.uint8)
+                self._sort_safe = bool(
+                    ((b > 0x27) & (b < 127) & (b != 0x5C)).all())
+        return self._sort_safe
 
 
 @dataclass

@@ -207,3 +207,57 @@ def test_groupby_vec_matches_exact_path():
     finally:
         ex.Executor._groupby_groups_vec = orig
     assert vec == exact
+
+
+def test_count_fast_ordering_matches_general_on_prefix_keys():
+    """Keys where one value is a prefix of another ("New" / "New
+    York"): the str((v,)) ordering contract puts the LONGER one first
+    when its next byte is below the closing quote 0x27 — the
+    count-fast path must not skip the sort there (review round-5)."""
+    import json
+
+    from dgraph_tpu.query import executor as ex
+
+    db = GraphDB(prefer_device=False)
+    db.alter("gtag: string @index(exact) .\nglink: [uid] .")
+    db.mutate(set_nquads="""
+    <0x1> <glink> <0x10> .
+    <0x1> <glink> <0x11> .
+    <0x1> <glink> <0x12> .
+    <0x1> <glink> <0x13> .
+    <0x10> <gtag> "New" .
+    <0x11> <gtag> "New York" .
+    <0x12> <gtag> "ab" .
+    <0x13> <gtag> "ab c" .
+    """)
+    q = '{ q(func: uid(0x1)) { glink @groupby(gtag) { count(uid) } } }'
+    fast = json.dumps(db.query(q)["data"], sort_keys=True)
+    orig = ex.Executor._emit_groupby_count_fast
+    ex.Executor._emit_groupby_count_fast = lambda *a, **k: None
+    try:
+        general = json.dumps(db.query(q)["data"], sort_keys=True)
+    finally:
+        ex.Executor._emit_groupby_count_fast = orig
+    assert fast == general
+    # the contract order itself: "New York" sorts before "New"
+    groups = db.query(q)["data"]["q"][0]["glink"][0]["@groupby"]
+    assert [g["gtag"] for g in groups] == \
+        ["New York", "New", "ab c", "ab"]
+
+
+def test_order_by_uid_desc_with_high_uids():
+    """orderdesc: uid must hold for uids >= 2^63 (sign-bit XOR key
+    mapping; review round-5)."""
+    db = GraphDB(prefer_device=False)
+    db.alter("gnmx: string .")
+    db.mutate(set_nquads="""
+    <0x1> <gnmx> "a" .
+    <0x2> <gnmx> "b" .
+    <0x9000000000000001> <gnmx> "c" .
+    """)
+    got = db.query('{ q(func: has(gnmx), orderdesc: uid) { uid } }')
+    uids = [g["uid"] for g in got["data"]["q"]]
+    assert uids == ["0x9000000000000001", "0x2", "0x1"]
+    got = db.query('{ q(func: has(gnmx), orderasc: uid) { uid } }')
+    assert [g["uid"] for g in got["data"]["q"]] == \
+        ["0x1", "0x2", "0x9000000000000001"]
