@@ -911,7 +911,9 @@ class Executor:
         if tid in (TypeID.STRING, TypeID.DEFAULT):
             return self._ineq_scan_strings(tab, fn, candidates)
         if self.db.prefer_device and self._device_worth(
-                len(getattr(tab, "values", ())) * self._HOST_PER_RANGE_VAL):
+                len(getattr(tab, "values", ()))
+                * self._HOST_PER_RANGE_VAL,
+                device_ratio=self._DEVICE_RATIO_RANGE):
             dev = self._device_range(tab, lo, hi, lo_open, hi_open)
             if dev is not None:
                 return dev if candidates is None \
@@ -2003,22 +2005,42 @@ class Executor:
     # per-element figures for the vectorized numpy paths; the fixed
     # side of the comparison is the MEASURED dispatch RTT, so only the
     # order of magnitude matters here)
-    _HOST_PER_FRONTIER_UID = 1.5e-6   # dict lookup + concat per parent
+    _HOST_PER_FRONTIER_UID = 2e-7     # prefetched posting fetch per
+    #                                   parent (round-5 measured: the
+    #                                   q049/q067 host expansions run
+    #                                   ~7.5x faster than the old
+    #                                   1.5e-6 estimate)
     _HOST_PER_EDGE = 4e-8             # np.unique share per edge
+    # measured device-compute/host-compute ratios per dispatch family
+    # (round-5 21M run; see _device_worth) — re-measure HERE, the call
+    # sites only reference these
+    _DEVICE_RATIO_ORDER = 0.9         # multisort/count-page ~parity
+    _DEVICE_RATIO_RANGE = 0.5         # range-scan mask
+    _DEVICE_RATIO_EXPAND = 0.5        # one-shot expand incl. transfer
     _HOST_PER_ORDER_KEY = 2e-7        # columnar key gather + lexsort
     #                                   share per uid (clean tablets
     #                                   read cached sort-key arrays)
     _HOST_PER_RANGE_VAL = 5e-9        # cached-array mask per value
 
-    def _device_worth(self, est_host_seconds: float) -> bool:
+    def _device_worth(self, est_host_seconds: float,
+                      device_ratio: float = 0.0) -> bool:
         """Use the device only when the estimated host cost clears the
-        measured dispatch round-trip (ref algo/uidlist.go:151's
-        size-ratio strategy pick, applied to the host/accelerator
-        boundary).  `device_min_edges <= 1` forces the tier — that is
-        the tests' and operators' explicit override."""
+        measured dispatch round-trip PLUS the device's own compute
+        (ref algo/uidlist.go:151's size-ratio strategy pick, applied
+        to the host/accelerator boundary). `device_ratio` is the
+        measured device-compute/host-compute ratio for the family:
+        0 models a device that answers instantly (batched traversal —
+        the digest BFS runs 11-14x host), while the round-5 21M run
+        measured ~0.95 for the 1M-row multisort/count-page family
+        (device_ms - RTT ≈ host_ms) — dispatching those buys nothing
+        but the round-trip, so their sites pass ~0.9 and stay host
+        until the host estimate dwarfs the RTT. `device_min_edges
+        <= 1` forces the tier — the tests' and operators' explicit
+        override."""
         if self.db.device_min_edges <= 1:
             return True
-        return est_host_seconds > self.db.device_dispatch_seconds() * 1.25
+        margin = est_host_seconds * (1.0 - device_ratio)
+        return margin > self.db.device_dispatch_seconds() * 1.25
 
     def _device_expand(self, tab: Tablet, src: np.ndarray,
                        reverse: bool = False) -> Optional[np.ndarray]:
@@ -2047,7 +2069,11 @@ class Executor:
         deg = tab.edge_count(reverse) / max(1, len(store))
         if not self._device_worth(
                 len(src) * (self._HOST_PER_FRONTIER_UID
-                            + deg * self._HOST_PER_EDGE)):
+                            + deg * self._HOST_PER_EDGE),
+                # the one-shot expand ships src + result across the
+                # dispatch boundary; round-5 21M run: q049's lone
+                # gated expand paid the RTT for no compute win
+                device_ratio=self._DEVICE_RATIO_EXPAND):
             return None
         adj = (device_radjacency if reverse else device_adjacency)(
             self.db, tab, self.read_ts, allow_dirty=True)
@@ -2254,8 +2280,17 @@ class Executor:
                     # TestQueryVarValOrderDescMissing -> empty)
                     vmap = self.value_vars.get(vn, {})
                     uids = _intersect(uids, _var_domain(vmap))
-                elif not o.attr.startswith("facet:"):
-                    otab = self._tablet(o.attr.lstrip("~"))
+                elif o.attr != "uid" \
+                        and not o.attr.startswith("facet:"):
+                    oattr = o.attr.lstrip("~")
+                    otab = self._tablet(oattr)
+                    if otab is None and not self.db.schema.has(oattr):
+                        # ref query2:TestToFastJSONOrderNameError —
+                        # ordering by a predicate the schema has
+                        # never seen is a typo, not an empty sort
+                        raise GQLError(
+                            f"cannot order by unknown attribute "
+                            f"{oattr!r}")
                     if otab is not None and otab.schema.list_:
                         # ref query1:TestMultipleValueSortError
                         raise GQLError(
@@ -2293,7 +2328,8 @@ class Executor:
         (ref types/sort.go:118 + worker/sort.go)."""
         if self.db.prefer_device and len(uids) >= 8 \
                 and self._device_worth(
-                    len(uids) * len(orders) * self._HOST_PER_ORDER_KEY):
+                    len(uids) * len(orders) * self._HOST_PER_ORDER_KEY,
+                    device_ratio=self._DEVICE_RATIO_ORDER):
             dev = self._device_apply_order(orders, uids)
             if dev is not None:
                 return dev
@@ -2404,7 +2440,8 @@ class Executor:
         if not self.db.prefer_device or len(uids) < 8:
             return None
         if not self._device_worth(
-                len(uids) * len(gq.order) * self._HOST_PER_ORDER_KEY):
+                len(uids) * len(gq.order) * self._HOST_PER_ORDER_KEY,
+                device_ratio=self._DEVICE_RATIO_ORDER):
             return None
         if np.any(uids > 0xFFFFFFFE):
             return None
@@ -2501,7 +2538,8 @@ class Executor:
             return None
         if not self._device_worth(
                 adj.n_src * (len(gq.order) + 1)
-                * self._HOST_PER_ORDER_KEY):
+                * self._HOST_PER_ORDER_KEY,
+                device_ratio=self._DEVICE_RATIO_ORDER):
             return None
         dvs = self._order_device_views(gq.order)
         if dvs is None:
@@ -2596,7 +2634,8 @@ class Executor:
             return out
         if self.db.prefer_device and len(uids) >= 8 \
                 and self._device_worth(
-                    len(uids) * self._HOST_PER_ORDER_KEY):
+                    len(uids) * self._HOST_PER_ORDER_KEY,
+                    device_ratio=self._DEVICE_RATIO_ORDER):
             dev = self._device_order_keys(tab, uids, lang)
             if dev is not None:
                 return dev

@@ -40,13 +40,16 @@ def _free_ports(n):
     return ports
 
 
-def _spawn(kind, node_id, peers_spec, client_addr, group=1, zero=""):
+def _spawn(kind, node_id, peers_spec, client_addr, group=1, zero="",
+           skew=0.0):
     cmd = [sys.executable, "-m", "dgraph_tpu", "node", "--kind", kind,
            "--id", str(node_id), "--raft-peers", peers_spec,
            "--client-addr", client_addr, "--group", str(group),
            "--tick-ms", "30", "--election-ticks", "8"]
     if zero:
         cmd += ["--zero", zero]
+    if skew:
+        cmd += ["--skew-s", str(skew)]
     return subprocess.Popen(
         cmd, env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO),
         cwd=_REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
@@ -590,36 +593,44 @@ def test_bank_split_across_groups_survives_clock_skew():
             p.wait()
 
 
-def test_bank_mixed_commit_now_and_2pc_transfers():
+@pytest.mark.parametrize("skew", [0.0, 5.0],
+                         ids=["no-skew", "clock-skew-5s"])
+def test_bank_mixed_commit_now_and_2pc_transfers(skew):
     """Mixed traffic on ONE group: single-group commit-now upsert
     transfers (bal_m <-> bal_m on group 1) interleave with cross-group
     2PC transfers (bal_m on group 1 <-> bal_n on group 2), plus a
-    leader SIGKILL. The reference cannot misorder these — everything
-    flows through one Raft log (ref worker/draft.go:435
-    processApplyCh); here the commit path must drain decided
-    lower-ts 2PC fragments between ts reservation and apply.
-    Checks: the conserved-total invariant at pinned snapshots, ZERO
-    out-of-order apply errors, and no wedged pending stage once the
-    workload stops."""
+    leader SIGKILL — and, in the second parametrization, ±5s
+    wall-clock offsets across zero and both groups (the reference's
+    Jepsen matrix runs skew-clock against every workload,
+    contrib/jepsen/main.go:31-43). The reference cannot misorder
+    these — everything flows through one Raft log (ref
+    worker/draft.go:435 processApplyCh); here the commit path must
+    drain decided lower-ts 2PC fragments between ts reservation and
+    apply. Checks: the conserved-total invariant at pinned snapshots,
+    ZERO out-of-order apply errors, and no wedged pending stage once
+    the workload stops."""
     ports = _free_ports(12)
     procs = {}
     clients = []
     try:
         zero_spec = f"1=127.0.0.1:{ports[1]}"
         procs["z1"] = _spawn("zero", 1, f"1=127.0.0.1:{ports[0]}",
-                             f"127.0.0.1:{ports[1]}")
+                             f"127.0.0.1:{ports[1]}", skew=-skew)
         # group 1 has THREE replicas: it loses its leader and the two
         # survivors must still hold a quorum
         g1_peers = (f"1=127.0.0.1:{ports[2]},2=127.0.0.1:{ports[3]},"
                     f"3=127.0.0.1:{ports[10]}")
         procs["a1"] = _spawn("alpha", 1, g1_peers,
-                             f"127.0.0.1:{ports[4]}", 1, zero_spec)
+                             f"127.0.0.1:{ports[4]}", 1, zero_spec,
+                             skew=+skew)
         procs["a2"] = _spawn("alpha", 2, g1_peers,
                              f"127.0.0.1:{ports[5]}", 1, zero_spec)
         procs["a3"] = _spawn("alpha", 3, g1_peers,
-                             f"127.0.0.1:{ports[11]}", 1, zero_spec)
+                             f"127.0.0.1:{ports[11]}", 1, zero_spec,
+                             skew=-skew)
         procs["b1"] = _spawn("alpha", 1, f"1=127.0.0.1:{ports[6]}",
-                             f"127.0.0.1:{ports[7]}", 2, zero_spec)
+                             f"127.0.0.1:{ports[7]}", 2, zero_spec,
+                             skew=+skew)
 
         zc = ClusterClient({1: ("127.0.0.1", ports[1])}, timeout=30.0)
         g1 = ClusterClient({1: ("127.0.0.1", ports[4]),

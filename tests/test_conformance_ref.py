@@ -649,3 +649,117 @@ def test_ref_rejects5(bad):
     from dgraph_tpu.gql.lexer import GQLError
     with pytest.raises((GQLError, ValueError)):
         db().query(bad)
+
+
+# ------------------------------------------- query2 batch 6
+# child filters (connectives, ineq, pagination windows), order-by,
+# multi-root var chains — query2's ToFastJSON families.
+
+CASES6 = [
+    ("filter_uid",  # query2:TestToFastJSONFilterUID
+     '{ me(func: uid(0x01)) { name gender friend @filter(anyofterms(name, "Andrea")) { uid } } }',
+     '{"me":[{"name":"Michonne","gender":"female","friend":[{"uid":"0x1f"}]}]}'),
+    ("filter_or_uid",  # query2:TestToFastJSONFilterOrUID
+     '{ me(func: uid(0x01)) { name gender friend @filter(anyofterms(name, "Andrea") or anyofterms(name, "Andrea Rhee")) { uid name } } }',
+     '{"me":[{"name":"Michonne","gender":"female","friend":[{"uid":"0x18","name":"Glenn Rhee"},{"uid":"0x1f","name":"Andrea"}]}]}'),
+    ("filter_or_count",  # query2:TestToFastJSONFilterOrCount
+     '{ me(func: uid(0x01)) { name gender count(friend @filter(anyofterms(name, "Andrea") or anyofterms(name, "Andrea Rhee"))) friend @filter(anyofterms(name, "Andrea")) { name } } }',
+     '{"me":[{"count(friend)":2,"friend": [{"name":"Andrea"}],"gender":"female","name":"Michonne"}]}'),
+    ("filter_or_first",  # query2:TestToFastJSONFilterOrFirst
+     '{ me(func: uid(0x01)) { name gender friend(first:2) @filter(anyofterms(name, "Andrea") or anyofterms(name, "Glenn SomethingElse") or anyofterms(name, "Daryl")) { name } } }',
+     '{"me":[{"friend":[{"name":"Glenn Rhee"},{"name":"Daryl Dixon"}],"gender":"female","name":"Michonne"}]}'),
+    ("filter_or_offset",  # query2:TestToFastJSONFilterOrOffset
+     '{ me(func: uid(0x01)) { name gender friend(offset:1) @filter(anyofterms(name, "Andrea") or anyofterms(name, "Glenn Rhee") or anyofterms(name, "Daryl Dixon")) { name } } }',
+     '{"me":[{"friend":[{"name":"Daryl Dixon"},{"name":"Andrea"}],"gender":"female","name":"Michonne"}]}'),
+    ("filter_ge_name",  # query2:TestToFastJSONFiltergeName
+     '{ me(func: uid(0x01)) { friend @filter(ge(name, "Rick")) { name } } }',
+     '{"me":[{"friend":[{"name":"Rick Grimes"}]}]}'),
+    ("filter_lt_alias",  # query2:TestToFastJSONFilterLtAlias
+     '{ me(func: uid(0x01)) { friend(orderasc: alias) @filter(lt(alias, "Pat")) { alias } } }',
+     '{"me":[{"friend":[{"alias":"Allan Matt"},{"alias":"Bob Joe"},{"alias":"John Alice"},{"alias":"John Oliver"}]}]}'),
+    ("filter_ge_dob",  # query2:TestToFastJSONFilterge1
+     '{ me(func: uid(0x01)) { name gender friend @filter(ge(dob, "1909-05-05")) { name } } }',
+     '{"me":[{"friend":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"}],"gender":"female","name":"Michonne"}]}'),
+    ("filter_gt_dob",  # query2:TestToFastJSONFilterGt
+     '{ me(func: uid(0x01)) { name gender friend @filter(gt(dob, "1909-05-05")) { name } } }',
+     '{"me":[{"friend":[{"name":"Rick Grimes"}],"gender":"female","name":"Michonne"}]}'),
+    ("filter_equal_no_hit",  # query2:TestToFastJSONFilterEqualNoHit
+     '{ me(func: uid(0x01)) { name gender friend @filter(eq(dob, "1909-03-20")) { name } } }',
+     '{"me":[{"gender":"female","name":"Michonne"}]}'),
+    ("filter_equal_name",  # query2:TestToFastJSONFilterEqualName
+     '{ me(func: uid(0x01)) { name gender friend @filter(eq(name, "Daryl Dixon")) { name } } }',
+     '{"me":[{"friend":[{"name":"Daryl Dixon"}], "gender":"female","name":"Michonne"}]}'),
+    ("filter_not1",  # query2:TestToFastJSONFilterNot1
+     '{ me(func: uid(0x01)) { name gender friend @filter(not anyofterms(name, "Andrea rick")) { name } } }',
+     '{"me":[{"gender":"female","name":"Michonne","friend":[{"name":"Glenn Rhee"},{"name":"Daryl Dixon"}]}]}'),
+    ("filter_not2",  # query2:TestToFastJSONFilterNot2
+     '{ me(func: uid(0x01)) { name gender friend @filter(not anyofterms(name, "Andrea") and anyofterms(name, "Glenn Andrea")) { name } } }',
+     '{"me":[{"gender":"female","name":"Michonne","friend":[{"name":"Glenn Rhee"}]}]}'),
+    ("filter_not3",  # query2:TestToFastJSONFilterNot3
+     '{ me(func: uid(0x01)) { name gender friend @filter(not (anyofterms(name, "Andrea") or anyofterms(name, "Glenn Rick Andrea"))) { name } } }',
+     '{"me":[{"gender":"female","name":"Michonne","friend":[{"name":"Daryl Dixon"}]}]}'),
+    ("filter_and",  # query2:TestToFastJSONFilterAnd
+     '{ me(func: uid(0x01)) { name gender friend @filter(anyofterms(name, "Andrea") and anyofterms(name, "SomethingElse Rhee")) { name } } }',
+     '{"me":[{"name":"Michonne","gender":"female"}]}'),
+    ("order_alias_asc",  # query2:TestToFastJSONOrderName
+     '{ me(func: uid(0x01)) { name friend(orderasc: alias) { alias } } }',
+     '{"me":[{"friend":[{"alias":"Allan Matt"},{"alias":"Bob Joe"},{"alias":"John Alice"},{"alias":"John Oliver"},{"alias":"Zambo Alice"}],"name":"Michonne"}]}'),
+    ("order_alias_desc",  # query2:TestToFastJSONOrderNameDesc
+     '{ me(func: uid(0x01)) { name friend(orderdesc: alias) { alias } } }',
+     '{"me":[{"friend":[{"alias":"Zambo Alice"},{"alias":"John Oliver"},{"alias":"John Alice"},{"alias":"Bob Joe"},{"alias":"Allan Matt"}],"name":"Michonne"}]}'),
+    ("first_offset",  # query2:TestToFastJSONFirstOffset
+     '{ me(func: uid(0x01)) { name gender friend(offset:1, first:1) { name } } }',
+     '{"me":[{"friend":[{"name":"Glenn Rhee"}],"gender":"female","name":"Michonne"}]}'),
+    ("first_offset_out_of_bound",  # query2:TestToFastJSONFirstOffsetOutOfBound
+     '{ me(func: uid(0x01)) { name gender friend(offset:100, first:1) { name } } }',
+     '{"me":[{"gender":"female","name":"Michonne"}]}'),
+    ("filter_or_first_negative",  # query2:TestToFastJSONFilterOrFirstNegative
+     '{ me(func: uid(0x01)) { name gender friend(first:-1, offset:0) @filter(anyofterms(name, "Andrea") or anyofterms(name, "Glenn Rhee") or anyofterms(name, "Daryl Dixon")) { name } } }',
+     '{"me":[{"friend":[{"name":"Andrea"}],"gender":"female","name":"Michonne"}]}'),
+    ("order_dedup",  # query2:TestToFastJSONOrderDedup
+     '{ me(func: uid(0x01)) { friend(orderasc: name) { dob name } gender name } }',
+     '{"me":[{"friend":[{"dob":"1901-01-15T00:00:00Z","name":"Andrea"},{"dob":"1909-01-10T00:00:00Z","name":"Daryl Dixon"},{"dob":"1909-05-05T00:00:00Z","name":"Glenn Rhee"},{"dob":"1910-01-02T00:00:00Z","name":"Rick Grimes"}],"gender":"female","name":"Michonne"}]}'),
+    ("multi_root",  # query2:TestGeneratorMultiRoot
+     '{ me(func:anyofterms(name, "Michonne Rick Glenn")) { name } }',
+     '{"me":[{"name":"Michonne"},{"name":"Rick Grimes"},{"name":"Glenn Rhee"}]}'),
+    ("multi_root_orderdesc",  # query2:TestGeneratorMultiRootOrderdesc
+     '{ me(func:anyofterms(name, "Michonne Rick Glenn"), orderdesc: dob) { name } }',
+     '{"me":[{"name":"Rick Grimes"},{"name":"Michonne"},{"name":"Glenn Rhee"}]}'),
+    ("multi_root_order_offset",  # query2:TestGeneratorMultiRootOrderOffset
+     '{ L as var(func:anyofterms(name, "Michonne Rick Glenn")) { name } me(func: uid(L), orderasc: dob, offset:2) { name } }',
+     '{"me":[{"name":"Rick Grimes"}]}'),
+    ("multi_root_var_order_offset",  # query2:TestGeneratorMultiRootVarOrderOffset
+     '{ L as var(func:anyofterms(name, "Michonne Rick Glenn"), orderasc: dob, offset:2) { name } me(func: uid(L)) { name } }',
+     '{"me":[{"name":"Rick Grimes"}]}'),
+    ("multi_root_rootval",  # query2:TestGeneratorMultiRootMultiQueryRootval
+     '{ friend as var(func:anyofterms(name, "Michonne Rick Glenn")) { name } you(func: uid(friend)) { name } }',
+     '{"you":[{"name":"Michonne"},{"name":"Rick Grimes"},{"name":"Glenn Rhee"}]}'),
+    ("root_list",  # query2:TestRootList
+     '{ me(func: uid(1, 23, 24)) { name } }',
+     '{"me":[{"name":"Michonne"},{"name":"Rick Grimes"},{"name":"Glenn Rhee"}]}'),
+    ("root_list1",  # query2:TestRootList1
+     '{ me(func: uid(0x01, 23, 24, 110)) { name } }',
+     '{"me":[{"name":"Michonne"},{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Alice"}]}'),
+]
+
+
+@pytest.mark.parametrize("name,query,expected",
+                         CASES6, ids=[c[0] for c in CASES6])
+def test_ref_conformance_q2_batch6(name, query, expected):
+    check(query, expected)
+
+
+REJECTS6 = [
+    # query2:TestMultiQueryError1 — unbalanced braces
+    '{ me(func:anyofterms(name, "Michonne")) { name gender you(func:anyofterms(name, "Andrea")) { name } }',
+    # query2:TestToFastJSONOrderNameError — order by a pred the block
+    # also filters as a uid list (invalid order target)
+    '{ me(func: uid(0x01)) { name friend(orderasc: nonindexedpred) { name } } }',
+]
+
+
+@pytest.mark.parametrize("bad", REJECTS6)
+def test_ref_rejects6(bad):
+    from dgraph_tpu.gql.lexer import GQLError
+    with pytest.raises((GQLError, ValueError)):
+        db().query(bad)
