@@ -146,6 +146,7 @@ class GraphQuery:
     facets: Optional[FacetParams] = None
     facets_filter: Optional[FilterTree] = None
     facet_var: dict = field(default_factory=dict)
+    checkpwd_pwd: Optional[str] = None  # checkpwd(pred, "plain") field
     is_empty: bool = False              # var-only block with no func
 
 

@@ -689,6 +689,17 @@ def _parse_selection(cur: Cursor, gvars: dict) -> GraphQuery:
                     elif t.kind == "eof":
                         raise GQLError("unbalanced count() arguments")
         cur.expect("rparen")
+    elif name == "checkpwd" and cur.peek().kind == "lparen":
+        # checkpwd(pred, "plain") as a result field emits
+        # `checkpwd(pred): bool` per row (ref query3:TestCheckPassword)
+        cur.next()
+        pred = cur.expect("name", "password predicate").val
+        cur.expect("comma")
+        pwd = cur.expect("string", "password string")
+        cur.expect("rparen")
+        gq.attr = pred
+        gq.checkpwd_pwd = pwd.val
+        gq.is_internal = True
     elif name in _AGG_FUNCS and cur.peek().kind == "lparen":
         cur.next()
         gq.agg_func = name

@@ -525,6 +525,14 @@ class Executor:
             self._bind_groupby_vars(gq, root)
         else:
             self._expand_children(node, gq.children, root)
+            if gq.cascade and self._block_vars:
+                # @cascade constrains the VARS the block binds, not
+                # just its output rows (ref query3:TestUseVarsCascade:
+                # `@cascade { L as friend { friend } }` binds L to
+                # friends that themselves have friends). Var-free
+                # cascade blocks skip this — emission applies their
+                # cascade.
+                self._cascade_rebind_vars(node)
         return node
 
     def _root_uids(self, gq: GraphQuery) -> np.ndarray:
@@ -2123,14 +2131,14 @@ class Executor:
             vc = gq.needs_var[0]
             vmap = self.value_vars.get(vc.name, {})
             src = node.src
-            if gq.var and len(src) \
+            if len(src) \
                     and self._agg_per_parent(node, vc.name, vmap):
-                # `n as min(val(x))` with x bound in a SIBLING subtree:
-                # one aggregate PER PARENT over that parent's reachable
-                # x values, bound as a value var (ref query.go
-                # valueVarAggregation — TestQueryVarValAggNestedFunc*
-                # shapes). Bare aggregations keep the whole-block
-                # scalar below.
+                # `min(val(x))` (bare or `n as ...`) with x bound in a
+                # SIBLING subtree: one aggregate PER PARENT over that
+                # parent's reachable x values (ref query.go
+                # valueVarAggregation — TestQueryVarValAggNestedFunc*,
+                # TestMinMulti, TestMultiLevelAgg shapes). Vars bound
+                # elsewhere keep the whole-block scalar below.
                 return
             whole = vc.name in getattr(self, "_block_vars", ()) \
                 or not len(src)
@@ -2188,6 +2196,19 @@ class Executor:
             vc = gq.needs_var[0]
             vmap = self.value_vars.get(vc.name, {})
             node.values = _internal_values(vmap, node.src, "val")
+        elif gq.checkpwd_pwd is not None:
+            # checkpwd(pred, "plain") per row (ref query3:
+            # TestCheckPassword; worker/task.go handleCheckPassword)
+            from dgraph_tpu.models.types import verify_password
+
+            tab = self._tablet(gq.attr)
+            for u in node.src.tolist():
+                ok = tab is not None and any(
+                    verify_password(gq.checkpwd_pwd,
+                                    str(p.value.value))
+                    for p in tab.get_postings(int(u), self.read_ts))
+                node.values[int(u)] = [
+                    Agg("checkpwd", Val(TypeID.BOOL, ok))]
 
     def _agg_per_parent(self, node: ExecNode, name: str,
                         vmap) -> bool:
@@ -2236,7 +2257,8 @@ class Executor:
             if agg is not None:
                 out[int(p)] = agg
                 node.values[int(p)] = [Agg(gq.agg_func, agg)]
-        self.value_vars[gq.var] = out
+        if gq.var:
+            self.value_vars[gq.var] = out
         return True
 
     def _chain_to(self, e: ExecNode, name: str):
@@ -2769,7 +2791,15 @@ class Executor:
             if not allow_loop:
                 nxt = _difference(nxt, visited)
                 visited = _union(visited, nxt)
+            else:
+                visited = _union(visited, nxt)
             frontier = nxt
+        for cgq in gq.children:
+            if cgq.var and cgq.attr == "uid" and not cgq.is_count:
+                # `a as uid` inside @recurse: every visited uid
+                # (ref query3:TestRecurseVariableUid)
+                var_accum[cgq.var] = _union(
+                    var_accum.get(cgq.var, _EMPTY), visited)
         for name, uids in var_accum.items():
             self.uid_vars[name] = uids
         node.recurse_frontiers = None  # levels carry everything
@@ -3038,6 +3068,100 @@ class Executor:
     # output (ref query/outputnode.go:653 preTraverse)
     # ------------------------------------------------------------------
 
+    def _cascade_rebind_vars(self, node: ExecNode):
+        """Prune every var bound inside a @cascade block the way the
+        reference's applyCascade does BEFORE var population (ref
+        query.go applyCascade; query3:TestUseVarsCascade): two passes —
+        bottom-up per-uid subtree satisfaction (_cascade_keep), then
+        top-down parent reachability, so a uid bound through a parent
+        the cascade dropped (e.g. for a missing sibling scalar) is
+        unbound too."""
+        memo: dict[int, np.ndarray] = {}
+        alive = self._cascade_keep(node, memo)
+        if node.gq.var:
+            self.uid_vars[node.gq.var] = alive
+        self._cascade_descend(node, alive, memo)
+
+    def _cascade_descend(self, node: ExecNode, alive: np.ndarray,
+                         memo: dict):
+        for c in node.children:
+            if c.gq.attr == "uid" and c.gq.var and not c.gq.is_count:
+                # `x as uid` binds the SURVIVING parents
+                self.uid_vars[c.gq.var] = alive
+                continue
+            if c.tablet is None or c.gq.is_count:
+                continue
+            if c.tablet.schema.value_type == TypeID.UID or c.reverse:
+                get = c.tablet.get_reverse_uids if c.reverse \
+                    else c.tablet.get_dst_uids
+                parts = [get(int(p), self.read_ts)
+                         for p in alive.tolist()]
+                parts = [p for p in parts if len(p)]
+                reach = np.unique(np.concatenate(parts)) if parts \
+                    else _EMPTY
+                alive_c = _intersect(
+                    _intersect(reach, c.dest),
+                    self._cascade_keep(c, memo))
+                if c.gq.var:
+                    self.uid_vars[c.gq.var] = alive_c
+                self._cascade_descend(c, alive_c, memo)
+            elif c.gq.var:
+                # scalar value var: restrict its domain to surviving
+                # parents
+                vm = self.value_vars.get(c.gq.var)
+                if isinstance(vm, dict):
+                    keep = set(alive.tolist())
+                    self.value_vars[c.gq.var] = {
+                        u: v for u, v in vm.items() if u in keep}
+                elif isinstance(vm, ColVar):
+                    self.value_vars[c.gq.var] = vm.take(alive)
+
+    def _cascade_keep(self, node: ExecNode, memo: dict) -> np.ndarray:
+        """dest uids satisfying node's OWN subtree constraints,
+        bottom-up (an edge child's targets must themselves satisfy
+        theirs). Parent reachability is _cascade_descend's job."""
+        key = id(node)
+        if key in memo:
+            return memo[key]
+        keep = node.dest
+        for c in node.children:
+            if c.tablet is None or c.gq.is_count or not len(keep):
+                continue
+            if c.tablet.schema.value_type == TypeID.UID or c.reverse:
+                sub = self._cascade_keep(c, memo) if c.children \
+                    else c.dest
+                get = c.tablet.get_reverse_uids if c.reverse \
+                    else c.tablet.get_dst_uids
+                keep = np.asarray(
+                    [u for u in keep.tolist()
+                     if len(_intersect(
+                         get(int(u), self.read_ts), sub))],
+                    dtype=np.uint64)
+            else:
+                keep = np.asarray(
+                    [u for u in keep.tolist()
+                     if self._cascade_scalar_present(c, int(u))],
+                    dtype=np.uint64)
+        memo[key] = keep
+        return keep
+
+    def _cascade_scalar_present(self, c: ExecNode, u: int) -> bool:
+        """Same presence predicate the emission-time cascade applies:
+        col_vals is authoritative when built; otherwise the posting
+        list filtered through the child's language selectors (a var
+        block skips scalar materialization, so fall through to the
+        tablet)."""
+        if c.col_vals is not None:
+            return c.col_vals.get(u) is not None
+        ps = c.values.get(u)
+        if not ps:
+            ps = c.tablet.get_postings(u, self.read_ts)
+        if not ps:
+            return False
+        if c.gq.langs == ["*"]:
+            return True
+        return self._select_posting(ps, c.gq.langs or []) is not None
+
     def _emit_block(self, node: ExecNode) -> list:
         gq = node.gq
         if gq.recurse is not None:
@@ -3172,6 +3296,12 @@ class Executor:
                 if vs:
                     obj[name] = to_json_value(vs[0].value)
                 continue
+            if cgq.checkpwd_pwd is not None:
+                vs = ch.values.get(uid)
+                if vs is not None:
+                    obj[cgq.alias or f"checkpwd({cgq.attr})"] = \
+                        to_json_value(vs[0].value)
+                continue
             if ch.tablet is None:
                 continue
             if cgq.is_count:
@@ -3302,6 +3432,10 @@ class Executor:
     def _emit_value(self, ch: ExecNode, ps) -> Any:
         cgq = ch.gq
         tab = ch.tablet
+        if tab.schema.value_type == TypeID.PASSWORD:
+            # password hashes are never fetchable — only checkpwd()
+            # reads them (ref query3:TestQueryPassword)
+            return None
         if tab.schema.list_:
             vals = [to_json_value(self._typed(tab, p)) for p in ps
                     if not p.lang]

@@ -763,3 +763,110 @@ def test_ref_rejects6(bad):
     from dgraph_tpu.gql.lexer import GQLError
     with pytest.raises((GQLError, ValueError)):
         db().query(bad)
+
+
+# ------------------------------------------- query3 batch 7
+# var chains across blocks, count fields, multi-level aggregation,
+# passwords, recurse vars, shortest-path uid-var roots.
+
+CASES7 = [
+    ("use_vars",  # query3:TestUseVars
+     '{ var(func: uid(0x01)) { L as friend } me(func: uid(L)) { name } }',
+     '{"me":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}]}'),
+    ("use_vars_multi_filter_id",  # query3:TestUseVarsMultiFilterId
+     '{ var(func: uid(0x01)) { L as friend } var(func: uid(31)) { G as friend } friend(func: uid(L)) @filter(uid(G)) { name } }',
+     '{"friend":[{"name":"Glenn Rhee"}]}'),
+    ("use_vars_filter_multi_id",  # query3:TestUseVarsFilterMultiId
+     '{ var(func: uid(0x01)) { L as friend { friend } } var(func: uid(31)) { G as friend } friend(func:anyofterms(name, "Michonne Andrea Glenn")) @filter(uid(G, L)) { name } }',
+     '{"friend":[{"name":"Glenn Rhee"},{"name":"Andrea"}]}'),
+    ("use_vars_cascade",  # query3:TestUseVarsCascade
+     '{ var(func: uid(0x01)) @cascade { L as friend { friend } } me(func: uid(L)) { name } }',
+     '{"me":[{"name":"Rick Grimes"}, {"name":"Andrea"} ]}'),
+    ("get_uid_count",  # query3:TestGetUIDCount
+     '{ me(func: uid(0x01)) { name uid gender alive count(friend) } }',
+     '{"me":[{"uid":"0x1","alive":true,"count(friend)":5,"gender":"female","name":"Michonne"}]}'),
+    ("count_field",  # query3:TestCount
+     '{ me(func: uid(0x01)) { name gender alive count(friend) } }',
+     '{"me":[{"alive":true,"count(friend)":5,"gender":"female","name":"Michonne"}]}'),
+    ("count_alias",  # query3:TestCountAlias
+     '{ me(func: uid(0x01)) { name gender alive friendCount: count(friend) } }',
+     '{"me":[{"alive":true,"friendCount":5,"gender":"female","name":"Michonne"}]}'),
+    ("multi_count_sort",  # query3:TestMultiCountSort
+     '{ f as var(func: anyofterms(name, "michonne rick andrea")) { n as count(friend) } countorder(func: uid(f), orderasc: val(n)) { name count(friend) } }',
+     '{"countorder":[{"count(friend)":0,"name":"Andrea With no friends"},{"count(friend)":1,"name":"Rick Grimes"},{"count(friend)":1,"name":"Andrea"},{"count(friend)":5,"name":"Michonne"}]}'),
+    ("multi_level_agg",  # query3:TestMultiLevelAgg
+     '{ sumorder(func: anyofterms(name, "michonne rick andrea")) { name friend { s as count(friend) } sum(val(s)) } }',
+     '{"sumorder":[{"friend":[{"count(friend)":1},{"count(friend)":0},{"count(friend)":0},{"count(friend)":1},{"count(friend)":0}],"name":"Michonne","sum(val(s))":2},{"friend":[{"count(friend)":5}],"name":"Rick Grimes","sum(val(s))":5},{"friend":[{"count(friend)":0}],"name":"Andrea","sum(val(s))":0},{"name":"Andrea With no friends"}]}'),
+    ("multi_level_agg1",  # query3:TestMultiLevelAgg1
+     '{ var(func: anyofterms(name, "michonne rick andrea")) @filter(gt(count(friend), 0)){ friend { s as count(friend) } ss as sum(val(s)) } sumorder(func: uid(ss), orderasc: val(ss)) { name val(ss) } }',
+     '{"sumorder":[{"name":"Andrea","val(ss)":0},{"name":"Michonne","val(ss)":2},{"name":"Rick Grimes","val(ss)":5}]}'),
+    ("multi_agg_sort",  # query3:TestMultiAggSort
+     '{ f as var(func: anyofterms(name, "michonne rick andrea")) { name friend { x as dob } mindob as min(val(x)) maxdob as max(val(x)) } maxorder(func: uid(f), orderasc: val(maxdob)) { name val(maxdob) } minorder(func: uid(f), orderasc: val(mindob)) { name val(mindob) } }',
+     '{"maxorder":[{"name":"Andrea","val(maxdob)":"1909-05-05T00:00:00Z"},{"name":"Rick Grimes","val(maxdob)":"1910-01-01T00:00:00Z"},{"name":"Michonne","val(maxdob)":"1910-01-02T00:00:00Z"}],"minorder":[{"name":"Michonne","val(mindob)":"1901-01-15T00:00:00Z"},{"name":"Andrea","val(mindob)":"1909-05-05T00:00:00Z"},{"name":"Rick Grimes","val(mindob)":"1910-01-01T00:00:00Z"}]}'),
+    ("min_multi",  # query3:TestMinMulti
+     '{ me(func: anyofterms(name, "michonne rick andrea")) { name friend { x as dob } min(val(x)) max(val(x)) } }',
+     '{"me":[{"friend":[{"dob":"1910-01-02T00:00:00Z"},{"dob":"1909-05-05T00:00:00Z"},{"dob":"1909-01-10T00:00:00Z"},{"dob":"1901-01-15T00:00:00Z"}],"max(val(x))":"1910-01-02T00:00:00Z","min(val(x))":"1901-01-15T00:00:00Z","name":"Michonne"},{"friend":[{"dob":"1910-01-01T00:00:00Z"}],"max(val(x))":"1910-01-01T00:00:00Z","min(val(x))":"1910-01-01T00:00:00Z","name":"Rick Grimes"},{"friend":[{"dob":"1909-05-05T00:00:00Z"}],"max(val(x))":"1909-05-05T00:00:00Z","min(val(x))":"1909-05-05T00:00:00Z","name":"Andrea"},{"name":"Andrea With no friends"}]}'),
+    ("avg_child",  # query3:TestAvg
+     '{ me(func: uid(0x01)) { name gender alive friend { x as shadow_deep } avg(val(x)) } }',
+     '{"me":[{"alive":true,"avg(val(x))":9.000000,"friend":[{"shadow_deep":4},{"shadow_deep":14}],"gender":"female","name":"Michonne"}]}'),
+    ("sum_child",  # query3:TestSum
+     '{ me(func: uid(0x01)) { name gender alive friend { x as shadow_deep } sum(val(x)) } }',
+     '{"me":[{"alive":true,"friend":[{"shadow_deep":4},{"shadow_deep":14}],"gender":"female","name":"Michonne","sum(val(x))":18}]}'),
+    ("query_password_hidden",  # query3:TestQueryPassword
+     '{ me(func: uid(0x01)) { name password } }',
+     '{"me":[{"name":"Michonne"}]}'),
+    ("check_password",  # query3:TestCheckPassword
+     '{ me(func: uid(0x01)) { name checkpwd(password, "123456") } }',
+     '{"me":[{"name":"Michonne","checkpwd(password)":true}]}'),
+    ("check_password_incorrect",  # query3:TestCheckPasswordIncorrect
+     '{ me(func: uid(0x01)) { name checkpwd(password, "654123") } }',
+     '{"me":[{"name":"Michonne","checkpwd(password)":false}]}'),
+    ("recurse_variable",  # query3:TestRecurseVariable
+     '{ var(func: uid(0x01)) @recurse { a as friend } me(func: uid(a)) { name } }',
+     '{"me":[{"name":"Michonne"},{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}]}'),
+    ("recurse_variable_uid",  # query3:TestRecurseVariableUid
+     '{ var(func: uid(0x01)) @recurse { friend a as uid } me(func: uid(a)) { name } }',
+     '{"me":[{"name":"Michonne"},{"name":"Rick Grimes"},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}]}'),
+    ("shortest_path_uid_vars",  # query3:TestShortestPathWithUidVariable
+     '{ a as var(func: uid(0x01)) b as var(func: uid(31)) shortest(from: uid(a), to: uid(b)) { password friend } }',
+     '{"_path_":[{"uid":"0x1", "_weight_": 1, "friend":{"uid":"0x1f"}}]}'),
+]
+
+
+@pytest.mark.parametrize("name,query,expected",
+                         CASES7, ids=[c[0] for c in CASES7])
+def test_ref_conformance_q3_batch7(name, query, expected):
+    check(query, expected)
+
+
+REJECTS7 = [
+    # query3:TestCountError1/2 — count() of a subgraph selection
+    '{ me(func: uid(0x01)) { count(friend { name }) name } }',
+    '{ me(func: uid(0x01)) { count(friend { c { friend } }) name } }',
+]
+
+
+@pytest.mark.parametrize("bad", REJECTS7)
+def test_ref_rejects7(bad):
+    from dgraph_tpu.gql.lexer import GQLError
+    with pytest.raises((GQLError, ValueError)):
+        db().query(bad)
+
+
+def test_cascade_var_pruned_through_dropped_parent():
+    """A uid bound only via a parent the cascade dropped (missing
+    sibling scalar) must not stay bound: Andrea (0x1f) has no gender,
+    so her row dies and Glenn must leave L (review round-5; ref
+    query.go applyCascade before var population)."""
+    check('{ var(func: uid(0x17, 0x1f)) @cascade { gender '
+          'L as friend { name } } me(func: uid(L)) { name } }',
+          '{"me":[{"name":"Michonne"}]}')
+
+
+def test_cascade_var_respects_lang_selector():
+    """The var-pruning cascade must apply the child's language
+    selector like the emission cascade: no friend has name@ru
+    (review round-5)."""
+    check('{ var(func: uid(0x01)) @cascade { L as friend { name@ru } }'
+          ' me(func: uid(L)) { name } }',
+          '{"me":[]}')
