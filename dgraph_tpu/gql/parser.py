@@ -565,13 +565,16 @@ def _parse_facets(cur: Cursor, gq: GraphQuery, gvars: dict):
         elif t.val in ("orderasc", "orderdesc") and cur.peek().kind == "colon":
             cur.next()
             key = cur.expect("name").val
-            fp.keys.append((key, key))
+            # bare selection: alias None (an explicit alias — even one
+            # spelled like its key — emits under the BARE alias; ref
+            # facets:TestFacetsAlias)
+            fp.keys.append((key, None))
             gq.order.append(Order(f"facet:{key}", desc=(t.val == "orderdesc")))
         elif cur.accept("colon"):
             key = cur.expect("name").val
             fp.keys.append((key, t.val))
         else:
-            fp.keys.append((t.val, t.val))
+            fp.keys.append((t.val, None))
         cur.accept("comma")
     gq.facets = fp
 

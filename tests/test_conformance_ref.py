@@ -870,3 +870,107 @@ def test_cascade_var_respects_lang_selector():
     check('{ var(func: uid(0x01)) @cascade { L as friend { name@ru } }'
           ' me(func: uid(L)) { name } }',
           '{"me":[]}')
+
+
+# ------------------------------------------- facets/query4 batch 8
+
+CASESF8 = [
+    ("facets_filter_or",  # facets:TestFacetsFilterOr
+     '{ me(func: uid(0x1)) { name friend @facets(eq(close, true) OR eq(family, true)) { name uid } } }',
+     '{"me":[{"friend":[{"uid":"0x18","name":"Glenn Rhee"},{"uid":"0x19","name":"Daryl Dixon"},{"uid":"0x65"}],"name":"Michonne"}]}'),
+    ("facets_filter_and",  # facets:TestFacetsFilterAnd
+     '{ me(func: uid(0x1)) { name friend @facets(eq(close, true) AND eq(family, false)) { name uid } } }',
+     '{"me":[{"friend":[{"uid":"0x65"}],"name":"Michonne"}]}'),
+    ("facets_filter_le",  # facets:TestFacetsFilterle
+     '{ me(func: uid(0x1)) { name friend @facets(le(age, 35)) { name uid } } }',
+     '{"me":[{"friend":[{"uid":"0x65"}],"name":"Michonne"}]}'),
+    ("facets_filter_ge",  # facets:TestFacetsFilterge
+     '{ me(func: uid(0x1)) { name friend @facets(ge(age, 33)) { name uid } } }',
+     '{"me":[{"friend":[{"uid":"0x65"}],"name":"Michonne"}]}'),
+    ("facets_filter_unknown",  # facets:TestFacetsFilterUnknownFacets
+     '{ me(func: uid(0x1)) { name friend @facets(ge(dob, "2007-01-10")) { name uid } } }',
+     '{"me":[{"name":"Michonne"}]}'),
+    ("facets_filter_unknown_or_known",  # facets:TestFacetsFilterUnknownOrKnown
+     '{ me(func: uid(0x1)) { name friend @facets(ge(dob, "2007-01-10") OR eq(family, true)) { name uid } } }',
+     '{"me":[{"friend":[{"uid":"0x18","name":"Glenn Rhee"},{"uid":"0x19","name":"Daryl Dixon"}],"name":"Michonne"}]}'),
+    ("facets_filter_allofterms",  # facets:TestFacetsFilterallofterms
+     '{ me(func: uid(31)) { name friend @facets(allofterms(games, "football chess tennis")) { name uid } } }',
+     '{"me":[{"friend":[{"name":"Michonne","uid":"0x1"}],"name":"Andrea"}]}'),
+    ("facets_filter_allof_multiple",  # facets:TestFacetsFilterAllofMultiple
+     '{ me(func: uid(31)) { name friend @facets(allofterms(games, "football basketball")) { name uid } } }',
+     '{"me":[{"friend":[{"name":"Michonne","uid":"0x1"}, {"name":"Daryl Dixon","uid":"0x19"}],"name":"Andrea"}]}'),
+    ("facets_filter_anyofterms",  # facets:TestFacetsFilteranyofterms
+     '{ me(func: uid(31)) { name friend @facets(anyofterms(games, "tennis cricket")) { name uid } } }',
+     '{"me":[{"friend":[{"uid":"0x1","name":"Michonne"}],"name":"Andrea"}]}'),
+    ("facets_filter_at_value_basic",  # facets:TestFacetsFilterAtValueBasic
+     '{ me(func: has(name)) { name @facets(eq(origin, "french")) } }',
+     '{"me":[{"name": "Michonne"}, {"name":"Rick Grimes"}, {"name": "Glenn Rhee"}]}'),
+    ("facets_filter_at_value_langs",  # facets:TestFacetsFilterAtValueWithLangs
+     '{ me(func: has(name)) { name@en @facets(eq(origin, "french")) } }',
+     '{"me":[{"name@en": "Michelle"}]}'),
+    ("facet_with_lang",  # facets:TestFacetWithLang
+     '{ me(func: uid(320)) { name@en @facets } }',
+     '{"me":[{"name@en|type":"Test facet with lang","name@en":"Test facet"}]}'),
+    ("facets_alias",  # facets:TestFacetsAlias
+     '{ me(func: uid(0x1)) { name @facets(o: origin) friend @facets(family, tagalias: tag, since) { name @facets(o: origin) } } }',
+     '{"me":[{"o":"french","name":"Michonne","friend":[{"o":"french","name":"Rick Grimes","friend|since":"2006-01-02T15:04:05Z"},{"o":"french","name":"Glenn Rhee","friend|family":true,"friend|since":"2004-05-02T15:04:05Z","tagalias":"Domain3"},{"name":"Daryl Dixon","friend|family":true,"friend|since":"2007-05-02T15:04:05Z","tagalias":34},{"name":"Andrea","friend|since":"2006-01-02T15:04:05Z"},{"friend|family":false,"friend|since":"2005-05-02T15:04:05Z"}]}]}'),
+]
+
+
+@pytest.mark.parametrize("name,query,expected",
+                         CASESF8, ids=[c[0] for c in CASESF8])
+def test_ref_conformance_facets_batch8(name, query, expected):
+    checkf(query, expected)
+
+
+CASES8 = [
+    ("has_first",  # query4:TestHasFirst
+     '{ q(func:has(name),first:5) { name } }',
+     '{"q":[{"name":"Michonne"},{"name":"King Lear"},{"name":"Margaret"},{"name":"Leonard"},{"name":"Garfield"}]}'),
+    ("has_first_offset",  # query4:TestHasFirstOffset
+     '{ q(func:has(name),first:5, offset: 5) { name } }',
+     '{"q":[{"name":"Bear"},{"name":"Nemo"},{"name":"name"},{"name":"Rick Grimes"},{"name":"Glenn Rhee"}]}'),
+    ("has_first_filter",  # query4:TestHasFirstFilter
+     '{ q(func:has(name), first: 1, offset:2)@filter(lt(age, 25)) { name } }',
+     '{"q":[{"name":"Daryl Dixon"}]}'),
+    ("has_filter_order_offset",  # query4:TestHasFilterOrderOffset
+     '{ q(func:has(name), first: 2, offset:2, orderasc: name)@filter(gt(age, 20)) { name } }',
+     '{"q":[{"name":"Alice"},{"name":"Bob"}]}'),
+    ("has_order_asc",  # query4:TestHasOrderAsc
+     '{ q(func:has(name), orderasc: name, first:5) { name } }',
+     '{"q":[{"name":""},{"name":""},{"name":"A"},{"name":"Alex"},{"name":"Alice"}]}'),
+    ("nested_expand_all",  # query4:TestNestedExpandAll
+     '{ q(func: has(node)) { uid expand(_all_) { uid node { uid expand(_all_) } } } }',
+     '{"q":[{"uid":"0x2b5c","name":"expand","node":[{"uid":"0x2b5c","node":[{"uid":"0x2b5c","name":"expand"}]}]}]}'),
+    ("count_uid_with_one_uid",  # query4:TestCountUIDWithOneUID
+     '{ q(func: uid(1)) { count(uid) } }',
+     '{"q":[{"count":1}]}'),
+]
+
+
+@pytest.mark.parametrize("name,query,expected",
+                         CASES8, ids=[c[0] for c in CASES8])
+def test_ref_conformance_q4_batch8(name, query, expected):
+    check(query, expected)
+
+
+def test_facet_alias_same_as_key_emits_bare():
+    """An EXPLICIT alias spelled like its key still emits bare
+    (review round-5: the parser stores bare keys as alias=None so the
+    two are distinguishable)."""
+    checkf('{ me(func: uid(0x1)) { friend @facets(since: since) '
+           '{ name } } }',
+           '{"me":[{"friend":[{"name":"Rick Grimes","since":"2006-01-02T15:04:05Z"},'
+           '{"name":"Glenn Rhee","since":"2004-05-02T15:04:05Z"},'
+           '{"name":"Daryl Dixon","since":"2007-05-02T15:04:05Z"},'
+           '{"name":"Andrea","since":"2006-01-02T15:04:05Z"},'
+           '{"since":"2005-05-02T15:04:05Z"}]}]}')
+
+
+def test_cascade_var_respects_value_facet_filter():
+    """Var-cascade pruning applies the value facets_filter like the
+    emission cascade (review round-5)."""
+    checkf('{ var(func: uid(0x1)) @cascade '
+           '{ L as friend { name @facets(eq(origin, "french")) } } '
+           'me(func: uid(L)) { name } }',
+           '{"me":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"}]}')

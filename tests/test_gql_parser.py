@@ -164,7 +164,7 @@ def test_facets():
       }
     }""")
     fr = r.queries[0].children[0]
-    assert fr.facets.keys == [("close", "close")]
+    assert fr.facets.keys == [("close", None)]  # bare key: alias None
     assert fr.facets_filter.func.name == "eq"
     assert fr.children[0].facets.all_keys
 
