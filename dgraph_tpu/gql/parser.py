@@ -833,9 +833,33 @@ def _parse_math(cur: Cursor) -> MathTree:
 
 
 def _parse_math_expr(cur: Cursor, min_prec: int) -> MathTree:
-    left = _parse_math_atom(cur)
+    return _parse_math_cont(cur, _parse_math_atom(cur), min_prec)
+
+
+def _num_const(raw: str) -> MathTree:
+    # integer literals stay python ints: int math must be exact
+    # beyond 2^53 (ref query4:TestBigMathValue; math.go int64 arm)
+    try:
+        return MathTree(const=int(raw))
+    except ValueError:
+        return MathTree(const=float(raw))
+
+
+def _parse_math_cont(cur: Cursor, left: MathTree,
+                     min_prec: int) -> MathTree:
     while True:
         t = cur.peek()
+        if t.kind == "number" and t.val.startswith("-") \
+                and _MATH_PREC["-"] >= min_prec:
+            # `f-2` lexes the literal as negative; after an operand it
+            # is binary minus whose RHS STARTS with the positive
+            # number — the RHS still binds tighter operators first
+            # (f-2*3 == f-(2*3))
+            cur.next()
+            right = _parse_math_cont(cur, _num_const(t.val[1:]),
+                                     _MATH_PREC["-"] + 1)
+            left = MathTree(fn="-", children=[left, right])
+            continue
         if t.kind == "op" and t.val in _MATH_PREC and _MATH_PREC[t.val] >= min_prec:
             cur.next()
             right = _parse_math_expr(cur, _MATH_PREC[t.val] + 1)
@@ -851,7 +875,7 @@ def _parse_math_atom(cur: Cursor) -> MathTree:
         cur.expect("rparen")
         return e
     if t.kind == "number":
-        return MathTree(const=float(t.val))
+        return _num_const(t.val)
     if t.kind == "name":
         if t.val in _MATH_FUNCS and cur.peek().kind == "lparen":
             cur.next()

@@ -1002,6 +1002,14 @@ class Executor:
         if tab is None:
             return _EMPTY
         toker = "fulltext" if fn.name in ("anyoftext", "alloftext") else "term"
+        if toker not in tab.schema.tokenizers:
+            # the functions read the index buckets; without the
+            # matching tokenizer there is nothing to read (ref query4:
+            # TestDeleteAndReaddIndex "Attribute ... is not indexed
+            # with type fulltext")
+            raise GQLError(
+                f"attribute {fn.attr!r} is not indexed with type "
+                f"{toker} (required by {fn.name})")
         spec = get_tokenizer(toker)
         text = " ".join(a.value for a in fn.args)
         # `pred@.` (any language): a value matches if it satisfies the
@@ -1379,6 +1387,16 @@ class Executor:
             # every candidate has count 0: let the zero-case decide
             # whether 0 satisfies the comparison (ge(count(x), 0) does)
             return self._count_zero_case(fn, candidates)
+        if candidates is None and not tab.schema.count \
+                and tab.schema.value_type != TypeID.UID:
+            # a root count comparison walks the count index; uid
+            # predicates keep their edge lists counted anyway, but a
+            # scalar predicate needs @count (ref query4:
+            # TestDeleteAndReaddCount "Need @count directive in
+            # schema for attr")
+            raise GQLError(
+                f"need @count directive in schema for attribute "
+                f"{fn.attr!r} to serve count comparisons at the root")
         want = int(fn.args[0].value)
         cmp_name = fn.name
         if fn.name == "between":
@@ -4176,6 +4194,53 @@ def _eval_math_vec(tree, value_vars):
     # do logical arithmetic (True+True == True), so comparisons store
     # 0.0/1.0 and carry the flag.
 
+    # float64 is the working domain — bail to the exact dict path
+    # whenever int semantics are observable: int columns beyond 2^53,
+    # or an int/int division/mod (integral + truncating in the
+    # reference's int64 arm, math.go applyArith; float division would
+    # both misdivide and misround)
+    def _int_exactness_check(t) -> tuple[bool, float]:
+        """(is_int, max-abs bound) for subtree t; raises _VecFallback
+        when int RESULTS could leave float64's exact range (not just
+        inputs — f*f of two in-range ints overflows 2^53) or an
+        int/int division needs the exact truncating arm."""
+        if t.const is not None:
+            isint = isinstance(t.const, int)
+            if isint and abs(t.const) >= 2 ** 53:
+                raise _VecFallback
+            return isint, float(abs(t.const))
+        if t.var:
+            cv = value_vars.get(t.var)
+            if isinstance(cv, ColVar) and cv.tid == TypeID.INT:
+                b = float(np.abs(cv.vals).max()) if len(cv.vals) \
+                    else 0.0
+                if b >= 2.0 ** 53:
+                    raise _VecFallback
+                return True, b
+            return False, 0.0
+        subs = [_int_exactness_check(c) for c in t.children]
+        ints = bool(subs) and all(i for i, _ in subs)
+        bounds = [b for _, b in subs]
+        if t.fn in ("/", "%") and ints:
+            raise _VecFallback
+        if not ints:
+            return False, 0.0
+        if t.fn in ("+", "-"):
+            b = sum(bounds)
+        elif t.fn == "*":
+            b = 1.0
+            for x in bounds:
+                b *= max(x, 1.0)
+        elif t.fn in ("min", "max", "cond"):
+            b = max(bounds) if bounds else 0.0
+        else:
+            return False, 0.0
+        if b >= 2.0 ** 53:
+            raise _VecFallback
+        return True, b
+
+    _int_exactness_check(tree)
+
     def align(args):
         """Align array-arg uid domains; broadcast consts. Mismatched
         domains need the dict path's union-with-zero semantics
@@ -4352,9 +4417,12 @@ def _eval_math(tree, value_vars, src=None) -> "dict[int, Val] | ColVar":
     def const_map(x):
         if src is None or not len(src):
             return {}
-        v = Val(TypeID.INT, int(x)) \
-            if float(x).is_integer() and abs(x) < 2**53 \
-            else Val(TypeID.FLOAT, float(x))
+        if isinstance(x, int) and not isinstance(x, bool):
+            v = Val(TypeID.INT, x)  # exact at any magnitude
+        elif float(x).is_integer() and abs(x) < 2**53:
+            v = Val(TypeID.INT, int(x))
+        else:
+            v = Val(TypeID.FLOAT, float(x))
         return {int(u): v for u in src.tolist()}
 
     try:
@@ -4372,14 +4440,22 @@ def _eval_math(tree, value_vars, src=None) -> "dict[int, Val] | ColVar":
 
     def eval_node(t) -> dict[int, float] | float:
         if t.const is not None:
-            return float(t.const)
+            # int literals stay ints (exact arithmetic + the int/int
+            # division arm); everything else is float64
+            return t.const if isinstance(t.const, int) \
+                else float(t.const)
         if t.var:
             vmap = value_vars.get(t.var, {})
             # datetimes flow as epoch-seconds floats so since() and
             # date comparisons work (ref aggregator.go applySince
-            # converts datetime -> float seconds)
+            # converts datetime -> float seconds); INT values stay
+            # python ints — the int/int arithmetic arm must be exact
+            # beyond 2^53 and divide integrally (ref math.go int64
+            # arm; query4:TestBigMathValue/TestFloatConverstion)
             return {u: (v.value.timestamp()
-                        if v.tid == TypeID.DATETIME else float(v.value))
+                        if v.tid == TypeID.DATETIME
+                        else int(v.value) if v.tid == TypeID.INT
+                        else float(v.value))
                     for u, v in vmap.items()
                     if v.tid in (TypeID.INT, TypeID.FLOAT, TypeID.BOOL,
                                  TypeID.DATETIME)}
@@ -4438,6 +4514,9 @@ def _eval_math(tree, value_vars, src=None) -> "dict[int, Val] | ColVar":
     for u, x in res.items():
         if isinstance(x, bool):
             out[u] = Val(TypeID.BOOL, x)
+        elif isinstance(x, int):
+            # exact int arithmetic result (any magnitude)
+            out[u] = Val(TypeID.INT, x)
         elif isinstance(x, float) and x.is_integer() and abs(x) < 2**53:
             out[u] = Val(TypeID.INT, int(x))
         else:
@@ -4445,7 +4524,18 @@ def _eval_math(tree, value_vars, src=None) -> "dict[int, Val] | ColVar":
     return out
 
 
+def _trunc_div(a: int, b: int) -> int:
+    """Go's int64 division truncates toward zero; python's // floors."""
+    q = a // b
+    if q < 0 and q * b != a:
+        q += 1
+    return q
+
+
 def _apply_math(fn: str, v: list, _m):
+    both_int = len(v) == 2 \
+        and isinstance(v[0], int) and not isinstance(v[0], bool) \
+        and isinstance(v[1], int) and not isinstance(v[1], bool)
     if fn == "+":
         return v[0] + v[1]
     if fn == "-":
@@ -4453,8 +4543,14 @@ def _apply_math(fn: str, v: list, _m):
     if fn == "*":
         return v[0] * v[1]
     if fn == "/":
+        if both_int:
+            # int/int divides INTEGRALLY and exactly (ref math.go
+            # applyArith int64 arm; query4:TestBigMathValue)
+            return _trunc_div(v[0], v[1])
         return v[0] / v[1]
     if fn == "%":
+        if both_int:
+            return v[0] - _trunc_div(v[0], v[1]) * v[1]
         return v[0] % v[1]
     if fn == "<":
         return v[0] < v[1]
@@ -4483,7 +4579,13 @@ def _apply_math(fn: str, v: list, _m):
     if fn == "ceil":
         return float(_m.ceil(v[0]))
     if fn == "pow":
-        return v[0] ** v[1]
+        # float domain like the reference's math.Pow — exact bigint
+        # pow would happily materialize petabyte integers; overflow
+        # drops the uid like the other per-element failures
+        try:
+            return float(v[0]) ** float(v[1])
+        except OverflowError:
+            raise ValueError("math: pow overflow")
     if fn == "logbase":
         return _m.log(v[0], v[1])
     if fn == "sigmoid":

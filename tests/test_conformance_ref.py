@@ -974,3 +974,121 @@ def test_cascade_var_respects_value_facet_filter():
            '{ L as friend { name @facets(eq(origin, "french")) } } '
            'me(func: uid(L)) { name } }',
            '{"me":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"}]}')
+
+
+# ---------------------------------------- query4 alter-cycle batch 9
+# index delete/readd/drop cycles and big-int math — fresh db per test
+# (the reference runs these against setSchema + dropPredicate).
+
+def _fresh_db():
+    from dgraph_tpu.engine.db import GraphDB
+
+    fdb = GraphDB(prefer_device=False)
+    fdb.alter(refgraph.SCHEMA)
+    return fdb
+
+
+def test_delete_and_readd_index():  # query4:TestDeleteAndReaddIndex
+    from dgraph_tpu.gql.lexer import GQLError
+    fdb = _fresh_db()
+    fdb.alter("numerology: string @index(exact, term, fulltext) .")
+    fdb.mutate(set_nquads='<0x666> <numerology> "This number is evil" .\n'
+                          '<0x777> <numerology> "This number is good" .')
+    q1 = '{ me(func: anyoftext(numerology, "numbers")) { uid numerology } }'
+    want = {"me": [{"uid": "0x666", "numerology": "This number is evil"},
+                   {"uid": "0x777", "numerology": "This number is good"}]}
+    assert fdb.query(q1)["data"] == want
+    # drop the fulltext index: the query must now error
+    fdb.alter("numerology: string @index(exact, term) .")
+    with pytest.raises((GQLError, ValueError)):
+        fdb.query(q1)
+    # term index still works
+    q2 = '{ me(func: anyofterms(numerology, "number")) { uid numerology } }'
+    assert fdb.query(q2)["data"] == want
+    # re-add and the original query works again (index rebuild)
+    fdb.alter("numerology: string @index(exact, term, fulltext) .")
+    assert fdb.query(q1)["data"] == want
+
+
+def test_delete_and_readd_count():  # query4:TestDeleteAndReaddCount
+    from dgraph_tpu.gql.lexer import GQLError
+    fdb = _fresh_db()
+    fdb.alter("numerology: string @count .")
+    fdb.mutate(set_nquads='<0x666> <numerology> "This number is evil" .\n'
+                          '<0x777> <numerology> "This number is good" .')
+    q1 = '{ me(func: gt(count(numerology), 0)) { uid numerology } }'
+    want = {"me": [{"uid": "0x666", "numerology": "This number is evil"},
+                   {"uid": "0x777", "numerology": "This number is good"}]}
+    assert fdb.query(q1)["data"] == want
+    fdb.alter("numerology: string .")
+    with pytest.raises((GQLError, ValueError)):
+        fdb.query(q1)
+    fdb.alter("numerology: string @count .")
+    assert fdb.query(q1)["data"] == want
+
+
+def test_delete_and_readd_reverse():  # query4:TestDeleteAndReaddReverse
+    from dgraph_tpu.gql.lexer import GQLError
+    fdb = _fresh_db()
+    fdb.alter("child_pred: uid @reverse .")
+    fdb.mutate(set_nquads='<0x666> <child_pred> <0x777> .')
+    q1 = '{ me(func: uid(0x777)) { ~child_pred { uid } } }'
+    want = {"me": [{"~child_pred": [{"uid": "0x666"}]}]}
+    assert fdb.query(q1)["data"] == want
+    fdb.alter("child_pred: uid .")
+    with pytest.raises((GQLError, ValueError)):
+        fdb.query(q1)
+    fdb.alter("child_pred: uid @reverse .")
+    assert fdb.query(q1)["data"] == want
+
+
+def test_drop_predicate():  # query4:TestDropPredicate
+    fdb = _fresh_db()
+    fdb.alter("numerology: string @index(term) .")
+    fdb.mutate(set_nquads='<0x666> <numerology> "This number is evil" .\n'
+                          '<0x777> <numerology> "This number is good" .')
+    q1 = '{ me(func: anyofterms(numerology, "number")) { uid numerology } }'
+    assert len(fdb.query(q1)["data"]["me"]) == 2
+    fdb.alter(drop_attr="numerology")
+    fdb.alter("numerology: string @index(term) .")
+    assert fdb.query(q1)["data"] == {"me": []}
+
+
+def test_big_math_value():  # query4:TestBigMathValue
+    fdb = _fresh_db()
+    fdb.alter("money: int .")
+    fdb.mutate(set_nquads='_:u <money> "48038396025285290" .')
+    got = fdb.query('{ q(func: has(money)) { f as money g: math(f/2) } }')
+    assert got["data"]["q"][0]["g"] == 24019198012642645
+    got = fdb.query('{ q(func: has(money)) { f as money g: math(2+f) } }')
+    assert got["data"]["q"][0]["g"] == 48038396025285292
+    got = fdb.query('{ q(func: has(money)) { f as money g: math(f-2) } }')
+    assert got["data"]["q"][0]["g"] == 48038396025285288
+
+
+def test_float_conversion_int_division():  # query4:TestFloatConverstion
+    # int/int aggregation-only math stays integral: ceil(66/5) -> 13
+    # (floor division then ceil of an int), while 1.0*x promotes
+    check('{ me as var(func: eq(name, "Michonne")) var(func: uid(me)) '
+          '{ friend { x as age } x2 as sum(val(x)) c as count(friend) } '
+          'me(func: uid(me)) { ceilAge: math(ceil(x2/c)) } }',
+          '{"me":[{"ceilAge":13.000000}]}')
+
+
+def test_math_minus_literal_precedence():
+    """f-2*3 must parse as f-(2*3) even though the lexer hands the
+    parser a negative literal (review round-5)."""
+    fdb = _fresh_db()
+    fdb.mutate(set_nquads='<0x9> <age> "10" .')
+    got = fdb.query('{ q(func: uid(0x9)) { f as age g: math(f-2*3) } }')
+    assert got["data"]["q"][0]["g"] == 4
+
+
+def test_math_int_product_exact_on_both_paths():
+    """Products whose RESULT exceeds 2^53 must stay exact whether the
+    var is dict- or column-backed (review round-5)."""
+    fdb = _fresh_db()
+    fdb.alter("mqx: int .")
+    fdb.mutate(set_nquads='<0x9> <mqx> "100000007" .')
+    got = fdb.query('{ q(func: has(mqx)) { f as mqx g: math(f*f) } }')
+    assert got["data"]["q"][0]["g"] == 10000001400000049
