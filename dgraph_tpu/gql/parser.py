@@ -368,7 +368,24 @@ def _parse_function(cur: Cursor, gvars: dict) -> Function:
             name = t.val[1:]
             if name not in gvars:
                 raise GQLError(f"undefined GraphQL variable ${name}")
-            fn.args.append(Arg(gvars[name], is_graphql_var=True))
+            val = gvars[name]
+            if fname == "regexp":
+                # a regexp argument supplied via GraphQL variable
+                # carries the /pattern/flags form (ref query4:
+                # TestRegExpVariableReplacement); require BOTH
+                # slashes like the literal lexer does — "/i" must not
+                # silently become an empty match-everything pattern
+                if len(val) < 2 or not val.startswith("/") \
+                        or "/" not in val[1:]:
+                    raise GQLError(
+                        f"regexp variable ${name} must carry "
+                        f"/pattern/flags, got {val!r}")
+                body, _, flags = val[1:].rpartition("/")
+                fn.args.append(Arg(body))
+                if flags:
+                    fn.args.append(Arg(flags))
+            else:
+                fn.args.append(Arg(val, is_graphql_var=True))
         elif t.kind == "name" and t.val == "val" and cur.peek().kind == "lparen":
             cur.next()
             v = cur.expect("name", "variable").val

@@ -622,11 +622,14 @@ class Executor:
             return self._eval_var_fn(fn, candidates)
         if name == "eq":
             tab = self._tablet(fn.attr)
-            if candidates is None and tab is not None \
-                    and not tab.schema.indexed:
-                # root eq needs an index to look tokens up in (ref
-                # query1:TestNameNotIndexed; filters compare values
-                # per candidate uid and stay legal without one)
+            eqps = tab.schema if tab is not None \
+                else self.db.schema.get(fn.attr)
+            if candidates is None and eqps is not None \
+                    and not eqps.indexed:
+                # root eq needs an index to look tokens up in — a
+                # schema property, data or not (ref query1:
+                # TestNameNotIndexed; filters compare values per
+                # candidate uid and stay legal without one)
                 raise GQLError(
                     f"predicate {fn.attr!r} is not indexed")
             if fn.needs_var and not fn.is_value_var:
@@ -869,6 +872,19 @@ class Executor:
 
     def _eval_ineq(self, fn: Function, candidates) -> np.ndarray:
         tab = self._tablet(fn.attr)
+        ips = tab.schema if tab is not None \
+            else self.db.schema.get(fn.attr)
+        if candidates is None and ips is not None \
+                and not fn.is_value_var \
+                and ips.value_type != TypeID.BOOL \
+                and not _has_sortable_index(ips):
+            # schema-level check so declared-but-empty predicates
+            # error like populated ones (ref worker/tokens.go
+            # IsSortable requirement)
+            raise GQLError(
+                f"attribute {fn.attr!r} needs a sortable index "
+                f"(exact/int/float/datetime) to serve {fn.name} "
+                "at the query root")
         if tab is None:
             return _EMPTY
         tid = tab.schema.value_type
@@ -999,17 +1015,20 @@ class Executor:
 
     def _eval_terms(self, fn: Function, candidates) -> np.ndarray:
         tab = self._tablet(fn.attr)
-        if tab is None:
-            return _EMPTY
         toker = "fulltext" if fn.name in ("anyoftext", "alloftext") else "term"
-        if toker not in tab.schema.tokenizers:
+        ps = tab.schema if tab is not None \
+            else self.db.schema.get(fn.attr)
+        if ps is not None and toker not in ps.tokenizers:
             # the functions read the index buckets; without the
-            # matching tokenizer there is nothing to read (ref query4:
-            # TestDeleteAndReaddIndex "Attribute ... is not indexed
-            # with type fulltext")
+            # matching tokenizer there is nothing to read — a SCHEMA
+            # property, checked whether or not data exists yet (ref
+            # query4:TestDeleteAndReaddIndex "Attribute ... is not
+            # indexed with type fulltext")
             raise GQLError(
                 f"attribute {fn.attr!r} is not indexed with type "
                 f"{toker} (required by {fn.name})")
+        if tab is None:
+            return _EMPTY
         spec = get_tokenizer(toker)
         text = " ".join(a.value for a in fn.args)
         # `pred@.` (any language): a value matches if it satisfies the
@@ -1360,6 +1379,14 @@ class Executor:
         TestCountReverseFunc; needs @reverse)."""
         if fn.attr.startswith("~"):
             tab = self._tablet(fn.attr[1:])
+            rps = tab.schema if tab is not None \
+                else self.db.schema.get(fn.attr[1:])
+            if candidates is None and rps is not None \
+                    and not rps.count:
+                raise GQLError(
+                    f"need @count directive in schema for attribute "
+                    f"{fn.attr[1:]!r} to serve count comparisons at "
+                    "the root")
             if tab is None:
                 return self._count_zero_case(fn, candidates)
             if not tab.schema.reverse:
@@ -1383,20 +1410,21 @@ class Executor:
             keep.sort()
             return keep
         tab = self._tablet(fn.attr)
+        ps = tab.schema if tab is not None \
+            else self.db.schema.get(fn.attr)
+        if candidates is None and ps is not None and not ps.count:
+            # a root count comparison walks the count index: every
+            # predicate — uid ones included — needs @count, and the
+            # requirement is a SCHEMA property independent of whether
+            # data exists yet (ref query4:TestDeleteAndReaddCount
+            # "Need @count directive in schema for attr")
+            raise GQLError(
+                f"need @count directive in schema for attribute "
+                f"{fn.attr!r} to serve count comparisons at the root")
         if tab is None:
             # every candidate has count 0: let the zero-case decide
             # whether 0 satisfies the comparison (ge(count(x), 0) does)
             return self._count_zero_case(fn, candidates)
-        if candidates is None and not tab.schema.count \
-                and tab.schema.value_type != TypeID.UID:
-            # a root count comparison walks the count index; uid
-            # predicates keep their edge lists counted anyway, but a
-            # scalar predicate needs @count (ref query4:
-            # TestDeleteAndReaddCount "Need @count directive in
-            # schema for attr")
-            raise GQLError(
-                f"need @count directive in schema for attribute "
-                f"{fn.attr!r} to serve count comparisons at the root")
         want = int(fn.args[0].value)
         cmp_name = fn.name
         if fn.name == "between":
@@ -3432,7 +3460,11 @@ class Executor:
                         obj[name] = items[0]
                     else:
                         obj[name] = items
-                elif cascade or cgq.cascade:
+                elif cascade:
+                    # only an INHERITED cascade scope drops the
+                    # parent; @cascade declared ON this child governs
+                    # the child's own subtree — the parent just emits
+                    # without the field (ref query4:TestCascadeSubQuery1)
                     return None
             else:
                 if ch.col_vals is not None:
@@ -3440,7 +3472,7 @@ class Executor:
                     if v is not None:
                         obj[name] = v
                         continue
-                    if cascade or cgq.cascade:
+                    if cascade:
                         return None
                     continue
                 ps = ch.values.get(uid)
@@ -3474,7 +3506,7 @@ class Executor:
                         if cgq.facets is not None:
                             self._attach_value_facets(obj, ch, ps, name)
                         continue
-                if cascade or cgq.cascade:
+                if cascade:
                     return None
         if cascade:
             want = [c for c in children
@@ -4219,6 +4251,10 @@ def _eval_math_vec(tree, value_vars):
                 return True, b
             return False, 0.0
         subs = [_int_exactness_check(c) for c in t.children]
+        if t.fn == "cond":
+            # the RESULT is one of the branches — the boolean
+            # condition child never contributes int-ness or bounds
+            subs = subs[1:]
         ints = bool(subs) and all(i for i, _ in subs)
         bounds = [b for _, b in subs]
         if t.fn in ("/", "%") and ints:

@@ -1092,3 +1092,37 @@ def test_math_int_product_exact_on_both_paths():
     fdb.mutate(set_nquads='<0x9> <mqx> "100000007" .')
     got = fdb.query('{ q(func: has(mqx)) { f as mqx g: math(f*f) } }')
     assert got["data"]["q"][0]["g"] == 10000001400000049
+
+
+# ------------------------------------------- query4 batch 10
+# sub-query-level @cascade, regexp via has(), lang-count pagination
+
+CASES10 = [
+    ("cascade_subquery1",  # query4:TestCascadeSubQuery1
+     '{ me(func: uid(0x01)) { name full_name gender friend @cascade { name full_name friend { name full_name dob age } } } }',
+     '{"me":[{"name":"Michonne","full_name":"Michonne\'s large name for hashing","gender":"female"}]}'),
+    ("cascade_subquery2",  # query4:TestCascadeSubQuery2
+     '{ me(func: uid(0x01)) { name full_name gender friend { name full_name friend @cascade { name full_name dob age } } } }',
+     '{"me":[{"name":"Michonne","full_name":"Michonne\'s large name for hashing","gender":"female","friend":[{"name":"Rick Grimes","friend":[{"name":"Michonne","full_name":"Michonne\'s large name for hashing","dob":"1910-01-01T00:00:00Z","age":38}]},{"name":"Glenn Rhee"},{"name":"Daryl Dixon"},{"name":"Andrea"}]}]}'),
+    ("cascade_repeated_multiple_levels",  # query4:TestCascadeRepeatedMultipleLevels
+     '{ me(func: uid(0x01)) { name full_name gender friend @cascade { name full_name friend @cascade { name full_name dob age } } } }',
+     '{"me":[{"name":"Michonne","full_name":"Michonne\'s large name for hashing","gender":"female"}]}'),
+    ("regexp_variable",  # query4:TestRegExpVariable
+     'query { q (func: has(name)) @filter( regexp(name, /King*/) ) { name } }',
+     '{"q":[{"name":"King Lear"}]}'),
+    ("has_count_predicate_with_lang",  # query4:TestHasCountPredicateWithLang
+     '{ q(func:has(name@en), first: 11) { count(uid) } }',
+     '{"q":[{"count":11}]}'),
+]
+
+
+@pytest.mark.parametrize("name,query,expected",
+                         CASES10, ids=[c[0] for c in CASES10])
+def test_ref_conformance_q4_batch10(name, query, expected):
+    check(query, expected)
+
+
+def test_regexp_variable_replacement():  # query4:TestRegExpVariableReplacement
+    check('query all($regexp_query: string = "/King*/" ) '
+          '{ q (func: has(name)) @filter( regexp(name, $regexp_query) ) { name } }',
+          '{"q":[{"name":"King Lear"}]}')

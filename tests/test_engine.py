@@ -496,7 +496,7 @@ def test_count_between_filter():
     # review regression: between(count(p), lo, hi) must work (it
     # previously raised) — both at root and under a live overlay
     d = GraphDB(prefer_device=False)
-    d.alter("f: [uid] .")
+    d.alter("f: [uid] @count .")  # root count comparisons need @count
     lines = []
     for s in range(1, 8):
         for k in range(s):  # uid s has s edges
