@@ -582,6 +582,11 @@ def _parse_facets(cur: Cursor, gq: GraphQuery, gvars: dict):
         elif t.val in ("orderasc", "orderdesc") and cur.peek().kind == "colon":
             cur.next()
             key = cur.expect("name").val
+            if any(not o.attr.startswith("facet:") for o in gq.order):
+                # ordering by a predicate AND a facet together is
+                # ambiguous (ref query0:TestDoubleOrder rejects it)
+                raise GQLError(
+                    "cannot order by both a predicate and a facet")
             # bare selection: alias None (an explicit alias — even one
             # spelled like its key — emits under the BARE alias; ref
             # facets:TestFacetsAlias)

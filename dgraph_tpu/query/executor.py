@@ -362,7 +362,12 @@ class Executor:
                 if paths:
                     out["_path_"] = paths
                 continue
-            out[gq.alias] = self._emit_block(node)
+            val = self._emit_block(node)
+            if gq.is_groupby and not val:
+                # empty root groupby omits its block key entirely
+                # (ref query0:TestGroupByRootEmpty -> data {})
+                continue
+            out[gq.alias] = val
         return out
 
     def emit_json(self, done) -> str:
@@ -384,8 +389,10 @@ class Executor:
                 continue
             fast = self._emit_block_flat_json(node)
             if fast is None:
-                fast = _json.dumps(self._emit_block(node),
-                                   separators=(",", ":"))
+                val = self._emit_block(node)
+                if gq.is_groupby and not val:
+                    continue  # empty root groupby omits its key
+                fast = _json.dumps(val, separators=(",", ":"))
             payloads[gq.alias] = fast
         return "{" + ",".join(
             _json.dumps(k) + ":" + v for k, v in payloads.items()) + "}"
@@ -1754,7 +1761,40 @@ class Executor:
                 self._bind_facet_vars(tab, src, node.reverse, gq,
                                       edge_dsts)
             if gq.var:
-                self.uid_vars[gq.var] = dest
+                if gq.first is not None or gq.offset or gq.after:
+                    # `L as friend(first:2, orderasc: dob)`: the var
+                    # holds the PAGINATED per-parent edge windows, not
+                    # the full expansion (ref query0:
+                    # TestUseVarsMultiOrder). Order alone never
+                    # changes the union — only a cut window does.
+                    parts = []
+                    get = tab.get_reverse_uids if node.reverse \
+                        else tab.get_dst_uids
+                    facet_orders = [o for o in gq.order
+                                    if o.attr.startswith("facet:")]
+                    for u in src.tolist():
+                        # facet-filtered edges were already computed;
+                        # a raw re-read would resurrect excluded edges
+                        dsts = edge_dsts[int(u)] \
+                            if edge_dsts is not None \
+                            else get(u, self.read_ts)
+                        dsts = _intersect(dsts, dest) \
+                            if len(dest) else _EMPTY
+                        if not len(dsts):
+                            continue
+                        if facet_orders:
+                            dsts = self._order_paginate_facets(
+                                gq, tab, int(u), node.reverse, dsts,
+                                facet_orders)
+                        else:
+                            dsts = self._order_paginate(gq, dsts)
+                        if len(dsts):
+                            parts.append(np.asarray(dsts,
+                                                    dtype=np.uint64))
+                    self.uid_vars[gq.var] = np.unique(
+                        np.concatenate(parts)) if parts else _EMPTY
+                else:
+                    self.uid_vars[gq.var] = dest
             if gq.is_count:
                 if gq.filter is not None:
                     # count(pred @filter(...)): per-parent size of the
@@ -3251,9 +3291,15 @@ class Executor:
         if gq.is_groupby:
             # root-level @groupby groups the block's matched uids (ref
             # query0_test.go TestGroupByRoot:
-            # {"me":[{"@groupby":[...]}]})
+            # {"me":[{"@groupby":[...]}]}); ZERO groups omit the
+            # whole block key (TestGroupByRootEmpty -> {})
             fake = ExecNode(gq)
-            return [self._emit_groupby(fake, node.dest)]
+            grp = self._emit_groupby(fake, node.dest)
+            return [grp] if grp.get("@groupby") else []
+        if not node.children:
+            # empty selection: rows emit nothing (ref query0:
+            # TestMultiEmptyBlocks -> "you": [])
+            return []
         out = []
         # count(uid) at block level: one summed object
         # (ref outputnode.go uid count emission)
@@ -3400,8 +3446,11 @@ class Executor:
                     dsts = _difference(dsts, _np_sorted(path))
                 if cgq.is_groupby:
                     # the reference emits child groupby as a one-
-                    # element array (query0_test.go TestGroupBy shape)
-                    obj[name] = [self._emit_groupby(ch, dsts)]
+                    # element array (query0_test.go TestGroupBy shape);
+                    # a repeated attr merges into one key in child
+                    # order (TestGroupBy_RepeatAttr)
+                    _merge_list_key(obj, name,
+                                    [self._emit_groupby(ch, dsts)])
                     continue
                 facet_orders = [o for o in cgq.order
                                 if o.attr.startswith("facet:")]
@@ -3456,10 +3505,10 @@ class Executor:
                     # TestGetNonListUidPredicate); reverse edges and
                     # count-carrying lists stay list-shaped
                     if not tab.schema.list_ and not ch.reverse \
-                            and not counts:
+                            and not counts and name not in obj:
                         obj[name] = items[0]
                     else:
-                        obj[name] = items
+                        _merge_list_key(obj, name, items)
                 elif cascade:
                     # only an INHERITED cascade scope drops the
                     # parent; @cascade declared ON this child governs
@@ -4410,6 +4459,20 @@ def _eval_math_vec(tree, value_vars):
                       isbool=True)
     return ColVar(uids, vals.astype(np.float64), TypeID.FLOAT,
                   frac=True)
+
+
+def _merge_list_key(obj: dict, name: str, items: list):
+    """Repeated child attrs share one output key, merged in child
+    order (ref query0:TestGroupBy_RepeatAttr: a @groupby friend and a
+    plain friend both land under \"friend\"); a prior single-object
+    occupant joins the list rather than being dropped."""
+    prev = obj.get(name)
+    if isinstance(prev, list):
+        obj[name] = prev + items
+    elif name in obj:
+        obj[name] = [prev] + items
+    else:
+        obj[name] = items
 
 
 def _join_codes(u_sorted: np.ndarray, codes: np.ndarray,

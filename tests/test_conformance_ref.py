@@ -1126,3 +1126,97 @@ def test_regexp_variable_replacement():  # query4:TestRegExpVariableReplacement
     check('query all($regexp_query: string = "/King*/" ) '
           '{ q (func: has(name)) @filter( regexp(name, $regexp_query) ) { name } }',
           '{"q":[{"name":"King Lear"}]}')
+
+
+# ------------------------------------------- query0 batch 11
+# var-in-inequality, nested count roots, multi-parent groupby,
+# empty blocks, multi-var cascade
+
+CASES11 = [
+    ("var_in_ineq",  # query0:TestVarInIneq
+     '{ var(func: uid( 1)) { f as friend { a as age } } me(func: uid(f)) @filter(gt(val(a), 18)) { name } }',
+     '{"me":[{"name":"Andrea"}]}'),
+    ("var_in_ineq2",  # query0:TestVarInIneq2
+     '{ var(func: uid(1)) { friend { a as age } } me(func: gt(val(a), 18)) { name } }',
+     '{"me":[{"name":"Andrea"}]}'),
+    ("nested_func_root",  # query0:TestNestedFuncRoot
+     '{ me(func: gt(count(friend), 2)) { name } }',
+     '{"me":[{"name":"Michonne"}]}'),
+    ("nested_func_root2",  # query0:TestNestedFuncRoot2
+     '{ me(func: ge(count(friend), 1)) { name } }',
+     '{"me":[{"name":"Michonne"},{"name":"Rick Grimes"},{"name":"Andrea"}]}'),
+    ("multi_empty_blocks",  # query0:TestMultiEmptyBlocks
+     '{ you(func: uid(0x01)) { } me(func: uid(0x02)) { } }',
+     '{"you": [], "me": []}'),
+    ("use_vars_multi_cascade",  # query0:TestUseVarsMultiCascade
+     '{ var(func: uid(0x01)) @cascade { L as friend { B as friend } } me(func: uid(L, B)) { name } }',
+     '{"me":[{"name":"Michonne"},{"name":"Rick Grimes"},{"name":"Glenn Rhee"}, {"name":"Andrea"}]}'),
+    ("use_vars_multi_order",  # query0:TestUseVarsMultiOrder
+     '{ var(func: uid(0x01)) { L as friend(first:2, orderasc: dob) } var(func: uid(0x01)) { G as friend(first:2, offset:2, orderasc: dob) } friend1(func: uid(L)) { name } friend2(func: uid(G)) { name } }',
+     '{"friend1":[{"name":"Daryl Dixon"}, {"name":"Andrea"}],"friend2":[{"name":"Rick Grimes"},{"name":"Glenn Rhee"}]}'),
+    # INTENTIONAL DIVERGENCE (group order): the reference emits this
+    # CHILD groupby as [17,19,15] while its own ROOT groupby over the
+    # same data emits [15,17,19] (TestGroupByRoot) — an internal
+    # code-path artifact, not a contract. This build orders groups by
+    # key everywhere, deterministically.
+    ("groupby_repeat_attr",  # query0:TestGroupBy_RepeatAttr
+     '{ me(func: uid(1)) { friend @groupby(age) { count(uid) } friend { name age } name } }',
+     '{"me":[{"friend":[{"@groupby":[{"age":15,"count":2},{"age":17,"count":1},{"age":19,"count":1}]},{"age":15,"name":"Rick Grimes"},{"age":15,"name":"Glenn Rhee"},{"age":17,"name":"Daryl Dixon"},{"age":19,"name":"Andrea"}],"name":"Michonne"}]}'),
+    ("groupby_multi_parents",  # query0:TestGroupByMultiParents
+     '{ me(func: uid(1,23,31)) { name friend @groupby(name, age) { count(uid) } } }',
+     '{"me":[{"name":"Michonne","friend":[{"@groupby":[{"name":"Andrea","age":19,"count":1},{"name":"Daryl Dixon","age":17,"count":1},{"name":"Glenn Rhee","age":15,"count":1},{"name":"Rick Grimes","age":15,"count":1}]}]},{"name":"Rick Grimes","friend":[{"@groupby":[{"name":"Michonne","age":38,"count":1}]}]},{"name":"Andrea","friend":[{"@groupby":[{"name":"Glenn Rhee","age":15,"count":1}]}]}]}'),
+    ("groupby_root_empty",  # query0:TestGroupByRootEmpty (missing pred)
+     '{ me(func: uid(1, 23, 24, 25, 31)) @groupby(agent) { count(uid) } }',
+     '{}'),
+]
+
+
+@pytest.mark.parametrize("name,query,expected",
+                         CASES11, ids=[c[0] for c in CASES11])
+def test_ref_conformance_q0_batch11(name, query, expected):
+    check(query, expected)
+
+
+def test_var_in_ineq5_eq_val_equals_uid_form():  # query0:TestVarInIneq5
+    got1 = run('{ var(func: uid(1)) { friend { a as name } } '
+               'me(func: eq(name, val(a))) { name } }')
+    got2 = run('{ var(func: uid(1)) { friend { a as name } } '
+               'me(func: uid(a)) { name: val(a) } }')
+    assert got1 == got2, (got1, got2)
+
+
+REJECTS11 = [
+    # query0:TestDoubleOrder — ordering by both a predicate and a facet
+    '{ me(func: uid(1)) { friend(orderdesc: dob) @facets(orderasc: weight) } }',
+]
+
+
+@pytest.mark.parametrize("bad", REJECTS11)
+def test_ref_rejects11(bad):
+    from dgraph_tpu.gql.lexer import GQLError
+    with pytest.raises((GQLError, ValueError)):
+        db().query(bad)
+
+
+def test_var_window_facet_ordered():
+    """`L as friend (first:1) @facets(orderasc: since)` binds the
+    FACET-ordered window, asc and desc differing (review round-5)."""
+    fdbq = refgraph.build_facets_db()
+    asc = fdbq.query('{ var(func: uid(1)) { L as friend (first:1) '
+                     '@facets(orderasc: since) } '
+                     'me(func: uid(L)) { name } }')["data"]
+    desc = fdbq.query('{ var(func: uid(1)) { L as friend (first:1) '
+                      '@facets(orderdesc: since) } '
+                      'me(func: uid(L)) { name } }')["data"]
+    assert asc == {"me": [{"name": "Glenn Rhee"}]}, asc
+    assert desc == {"me": [{"name": "Daryl Dixon"}]}, desc
+
+
+def test_repeat_nonlist_uid_attr_merges():
+    """A repeated NON-LIST uid predicate keeps both children's output
+    under one key instead of dropping one (review round-5)."""
+    got = run('{ me(func: uid(2)) { best_friend @groupby(uid) '
+              '{ count(uid) } best_friend { uid } } }')
+    bf = got["me"][0]["best_friend"]
+    assert isinstance(bf, list) and len(bf) == 2, bf
+    assert "@groupby" in bf[0] and bf[1] == {"uid": "0x40"}, bf
