@@ -27,6 +27,9 @@ _ROOT_FUNCS = {
     "anyof", "allof",
 }
 _AGG_FUNCS = {"min", "max", "sum", "avg"}
+# every name _parse_function accepts (root funcs + the filter-capable
+# extras; the executor rejects len() outside @filter)
+_QUERY_FUNCS = _ROOT_FUNCS | {"checkpwd", "len"}
 _DIRECTIVES = {"filter", "facets", "cascade", "normalize", "ignorereflex",
                "recurse", "groupby"}
 _BOOL_OPS = {"and", "or", "not"}
@@ -294,6 +297,14 @@ def _pred_with_lang_str(cur: Cursor) -> tuple[str, str]:
 def _parse_function(cur: Cursor, gvars: dict) -> Function:
     name_tok = cur.expect("name", "function name")
     fname = name_tok.val.lower()
+    if fname not in _QUERY_FUNCS:
+        # min/max etc. are not query functions (ref gql
+        # validateFunction: "Function name: min is not valid" —
+        # query0:TestVarInAggError). len() is only legal inside
+        # @filter, which the executor enforces.
+        raise GQLError(
+            f"line {name_tok.line}: function name {fname!r} "
+            "is not valid")
     fn = Function(name=fname)
     cur.expect("lparen")
 

@@ -3448,9 +3448,12 @@ class Executor:
                     # the reference emits child groupby as a one-
                     # element array (query0_test.go TestGroupBy shape);
                     # a repeated attr merges into one key in child
-                    # order (TestGroupBy_RepeatAttr)
-                    _merge_list_key(obj, name,
-                                    [self._emit_groupby(ch, dsts)])
+                    # order (TestGroupBy_RepeatAttr); ZERO groups
+                    # emit nothing so a member-less parent row drops
+                    # (TestGroupByAgeMultiParents skips uids 99999/8)
+                    grp = self._emit_groupby(ch, dsts)
+                    if grp.get("@groupby"):
+                        _merge_list_key(obj, name, [grp])
                     continue
                 facet_orders = [o for o in cgq.order
                                 if o.attr.startswith("facet:")]
