@@ -130,7 +130,8 @@ def cmd_alpha(args) -> int:
                                  require_client_cert=args.tls_mtls)
     httpd, alpha = serve(db, host=args.host, port=args.port, block=False,
                          acl_secret=secret, tls_context=tls_ctx,
-                         mutations_mode=args.mutations)
+                         mutations_mode=args.mutations,
+                         max_pending=args.max_pending)
     grpc_srv = None
     if args.grpc_port:
         from dgraph_tpu.server.grpc_api import serve_grpc
@@ -148,6 +149,10 @@ def cmd_alpha(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        # graceful drain: stop admitting writes, let in-flight
+        # requests finish (bounded), then tear the listeners down
+        alpha.draining = True
+        alpha.wait_idle(timeout_s=10.0)
         httpd.shutdown()
         if grpc_srv is not None:
             grpc_srv.stop(grace=2).wait()
@@ -716,6 +721,11 @@ def main(argv=None) -> int:
     a.add_argument("--snapshot", default="")
     a.add_argument("--no-device", action="store_true",
                    default=False)
+    a.add_argument("--max-pending", type=int, default=0,
+                   help="admission control: max concurrently admitted "
+                        "requests; excess sheds with HTTP 429 "
+                        "(retryable). 0 = unbounded (ref the "
+                        "reference's pending-query throttle)")
     a.add_argument("--mutations", default="allow",
                    choices=["allow", "disallow", "strict"],
                    help="mutation mode (ref --mutations, "

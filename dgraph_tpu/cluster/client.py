@@ -37,7 +37,8 @@ class ClusterClient:
 
     # ------------------------------------------------------------ plumbing
 
-    def _conn(self, node: int) -> Optional[socket.socket]:
+    def _conn(self, node: int,
+              timeout: Optional[float] = None) -> Optional[socket.socket]:
         sock = self._conns.get(node)
         if sock is not None:
             return sock
@@ -45,8 +46,10 @@ class ClusterClient:
             # connect budget never exceeds the client's deadline: a
             # SYN-blackholed peer must not eat a 2s connect timeout on
             # a 150ms-budget timestamp client (raft lock is held)
+            budget = self.timeout if timeout is None \
+                else min(self.timeout, timeout)
             sock = socket.create_connection(
-                self.addrs[node], timeout=min(2.0, self.timeout))
+                self.addrs[node], timeout=min(2.0, budget))
             sock.settimeout(self.timeout)
         except OSError:
             return None
@@ -58,16 +61,35 @@ class ClusterClient:
         if sock is not None:
             sock.close()
 
-    def _rpc_once(self, node: int, req: dict) -> Optional[dict]:
-        sock = self._conn(node)
+    def _rpc_once(self, node: int, req: dict,
+                  timeout: Optional[float] = None) -> Optional[dict]:
+        """One framed RPC. `timeout` caps THIS attempt's socket waits
+        (a caller deadline must bound blocking reads, not just the
+        between-attempts loop check); the pooled socket's default
+        timeout is restored on success, and a timed-out socket is
+        dropped by the except path anyway."""
+        sock = self._conn(node, timeout=timeout)
         if sock is None:
             self._down[node] = time.monotonic() + self.UNHEALTHY_S
             return None
         try:
+            if timeout is not None:
+                sock.settimeout(max(0.001, min(self.timeout, timeout)))
             wire.write_frame(sock, wire.dumps(req))
             resp = wire.loads(wire.read_frame(sock))
+            if timeout is not None:
+                sock.settimeout(self.timeout)
             self._down.pop(node, None)
             return resp
+        except socket.timeout:
+            self._drop(node)
+            if timeout is None or timeout >= self.timeout:
+                # a FULL-budget timeout says the node is sick; one cut
+                # short by the caller's nearly-spent deadline says
+                # nothing — demoting on it would poison the health
+                # cache for every other user of this client
+                self._down[node] = time.monotonic() + self.UNHEALTHY_S
+            return None
         except (OSError, EOFError, wire.WireError):
             self._drop(node)
             self._down[node] = time.monotonic() + self.UNHEALTHY_S
@@ -76,7 +98,20 @@ class ClusterClient:
     def request(self, req: dict, deadline_s: Optional[float] = None) -> dict:
         """Route to the leader, following hints and retrying through
         elections until the deadline."""
-        deadline = time.monotonic() + (deadline_s or self.timeout)
+        # an EXHAUSTED budget (0.0) must fail fast, not silently widen
+        # to the default timeout — 0.0 is falsy but meaningful
+        deadline = time.monotonic() + (
+            self.timeout if deadline_s is None else deadline_s)
+        # with an explicit budget, every attempt's SOCKET waits are
+        # capped by what remains — a peer that accepts then stalls
+        # mid-response must not hold an expired caller for the pooled
+        # default timeout
+        bounded = deadline_s is not None
+
+        def attempt_timeout():
+            return max(0.001, deadline - time.monotonic()) \
+                if bounded else None
+
         with self._lock:
             last_err = "unreachable"
             while time.monotonic() < deadline:
@@ -92,8 +127,11 @@ class ClusterClient:
                 for node in order:
                     if node in seen or node not in self.addrs:
                         continue
+                    if time.monotonic() >= deadline:
+                        break
                     seen.add(node)
-                    resp = self._rpc_once(node, req)
+                    resp = self._rpc_once(node, req,
+                                          timeout=attempt_timeout())
                     if resp is None:
                         continue
                     if resp.get("ok"):
@@ -102,15 +140,19 @@ class ClusterClient:
                     if resp.get("error") == "not leader":
                         hint = resp.get("leader")
                         if hint is not None and hint != node \
-                                and hint in self.addrs:
+                                and hint in self.addrs \
+                                and time.monotonic() < deadline:
                             self._preferred = hint
-                            hinted = self._rpc_once(hint, req)
+                            hinted = self._rpc_once(
+                                hint, req, timeout=attempt_timeout())
                             if hinted is not None and hinted.get("ok"):
                                 return hinted
                         continue
                     return resp  # real application error: surface it
                 last_err = "no leader reachable"
-                time.sleep(0.1)
+                # never sleep past the deadline the caller set
+                time.sleep(min(0.1, max(0.0,
+                                        deadline - time.monotonic())))
             return {"ok": False, "error": last_err}
 
     def close(self):
@@ -123,13 +165,18 @@ class ClusterClient:
 
     def query(self, q: str, variables: Optional[dict] = None,
               hedge_s: Optional[float] = None,
-              read_ts: Optional[int] = None) -> dict:
+              read_ts: Optional[int] = None,
+              deadline_ms: Optional[int] = None) -> dict:
         """Snapshot read from any replica. With hedge_s set, a backup
         request fires at a second replica if the first hasn't answered
         within the delay and the first response wins — the reference's
         processWithBackupRequest (worker/task.go:66) tail-latency
-        defense."""
+        defense. `deadline_ms` rides the wire so the serving node
+        inherits the remaining budget, AND bounds the client-side
+        routed-retry loop to the same clock."""
         req = {"op": "query", "q": q, "vars": variables}
+        if deadline_ms is not None:
+            req["deadline_ms"] = int(deadline_ms)
         if read_ts is not None:
             req["read_ts"] = read_ts
             if hedge_s is not None:
@@ -137,15 +184,22 @@ class ClusterClient:
                 # arbitrary replicas with no leader rerouting
                 raise ValueError(
                     "read_ts and hedge_s cannot be combined")
+        deadline_s = deadline_ms / 1000.0 \
+            if deadline_ms is not None else None
         if hedge_s is not None and len(self.addrs) > 1:
-            return self._unwrap(self._hedged(req, hedge_s))
-        return self._unwrap(self.request(req))
+            return self._unwrap(self._hedged(req, hedge_s, deadline_s))
+        return self._unwrap(self.request(req, deadline_s=deadline_s))
 
-    def _hedged(self, req: dict, hedge_s: float) -> dict:
+    def _hedged(self, req: dict, hedge_s: float,
+                deadline_s: Optional[float] = None) -> dict:
         """Fire at the preferred replica; after hedge_s with no answer,
         race a second replica on a FRESH connection (the pooled conns
-        stay owned by the main path). First non-error response wins."""
+        stay owned by the main path). First non-error response wins.
+        `deadline_s` bounds the WHOLE hedged wait (else self.timeout)."""
         import queue
+
+        budget = self.timeout if deadline_s is None else deadline_s
+        overall = time.monotonic() + budget
 
         with self._lock:
             now = time.monotonic()
@@ -161,9 +215,9 @@ class ClusterClient:
 
         def attempt(node):
             try:
-                sock = socket.create_connection(self.addrs[node],
-                                                timeout=2.0)
-                sock.settimeout(self.timeout)
+                sock = socket.create_connection(
+                    self.addrs[node], timeout=min(2.0, budget))
+                sock.settimeout(budget)
                 try:
                     wire.write_frame(sock, wire.dumps(req))
                     results.put(wire.loads(wire.read_frame(sock)))
@@ -177,29 +231,34 @@ class ClusterClient:
         threads[0].start()
         failures = 0
         try:
-            got = results.get(timeout=hedge_s)
+            got = results.get(timeout=min(hedge_s,
+                                          overall - time.monotonic()))
             if got is not None:
                 return got  # ok or a real application error: surface it
             failures += 1   # connection-level failure
-        except queue.Empty:
-            pass
-        # primary is slow/dead: hedge to a backup replica
-        threads.append(threading.Thread(target=attempt, args=(others[0],),
-                                        daemon=True))
-        threads[1].start()
-        deadline = time.monotonic() + self.timeout
-        while time.monotonic() < deadline and failures < len(threads):
+        except (queue.Empty, ValueError):
+            pass  # ValueError: the budget is already gone
+        # primary is slow/dead: hedge to a backup replica — unless the
+        # budget is spent, in which case a raced connection + query
+        # could never be consumed anyway
+        if time.monotonic() < overall:
+            threads.append(threading.Thread(target=attempt,
+                                            args=(others[0],),
+                                            daemon=True))
+            threads[1].start()
+        while time.monotonic() < overall and failures < len(threads):
             try:
                 got = results.get(timeout=max(
-                    0.01, deadline - time.monotonic()))
+                    0.01, overall - time.monotonic()))
             except queue.Empty:
                 break
             if got is not None:
                 return got
             failures += 1
         # both raced attempts failed to CONNECT: fall back to the
-        # routed retry path
-        return self.request(req)
+        # routed retry path, within whatever budget remains
+        return self.request(req, deadline_s=None if deadline_s is None
+                            else max(0.0, overall - time.monotonic()))
 
     def mutate(self, **kw) -> dict:
         return self._unwrap(self.request({"op": "mutate", "kw": kw}))

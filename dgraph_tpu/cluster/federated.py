@@ -255,10 +255,16 @@ class FederatedDB(GraphDB):
     query planning and per-attr worker tasks."""
 
     def __init__(self, groups: dict[int, object], tmap: dict[str, int],
-                 schema_text: str, read_ts: int):
+                 schema_text: str, read_ts: int, ctx=None):
         super().__init__(prefer_device=False)
         self._groups = groups
         self.read_ts = read_ts
+        # coordinator-side RequestContext: every task RPC checks it
+        # and ships the REMAINING budget as deadline_ms so the owning
+        # group inherits the deadline (plus a small skew allowance on
+        # its side) — the reference forwards its context on every
+        # worker RPC (worker/task.go ProcessTaskOverNetwork)
+        self.req_ctx = ctx
         if schema_text:
             self.schema.apply_text(schema_text)
         self.tablets = _RemoteTablets(self, tmap)
@@ -267,9 +273,25 @@ class FederatedDB(GraphDB):
         # the serving node pays the quorum read barrier on every task
         # (a cached client-side barrier would go stale on a mid-query
         # leader change), so there is nothing to track here
+        deadline_s = None
+        if self.req_ctx is not None:
+            self.req_ctx.check(f"task on group {gid}")
+            rem = self.req_ctx.remaining_ms()
+            if rem is not None:
+                req = dict(req, deadline_ms=rem,
+                           trace_id=self.req_ctx.trace_id)
+                # the budget also bounds the CLIENT-side wait: an
+                # election on the owning group must not hold an
+                # expired coordinator for the full default timeout
+                deadline_s = rem / 1000.0
         cl = self._groups[gid]
-        resp = cl.request(req)
+        resp = cl.request(req, deadline_s=deadline_s)
         if not resp.get("ok"):
+            if self.req_ctx is not None:
+                # a budget that ran out DURING the RPC must surface as
+                # DeadlineExceeded (-> 408, retryable), not as a
+                # generic task failure (-> 500)
+                self.req_ctx.check(f"task on group {gid}")
             raise RuntimeError(
                 f"task {req.get('kind')} on group {gid} failed: "
                 f"{resp.get('error')}")
@@ -277,4 +299,5 @@ class FederatedDB(GraphDB):
 
     def query(self, q: str, variables: dict | None = None, **kw):
         kw.setdefault("read_ts", self.read_ts)
+        kw.setdefault("ctx", self.req_ctx)
         return super().query(q, variables, **kw)

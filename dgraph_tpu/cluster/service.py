@@ -30,6 +30,7 @@ from dgraph_tpu.cluster.raft import (
 )
 from dgraph_tpu.cluster.transport import TcpTransport
 from dgraph_tpu.utils.logger import log
+from dgraph_tpu.utils.reqctx import PROPAGATION_SKEW_S, RequestContext
 
 import socket
 
@@ -1017,6 +1018,20 @@ class AlphaServer(RaftServer):
                 self._rebuild_from_events()
             raise RuntimeError("record not replicated (no quorum)")
 
+    @staticmethod
+    def _req_ctx(req: dict):
+        """RequestContext a coordinator propagated on the wire
+        (deadline_ms = its remaining budget): this worker inherits the
+        budget widened by a small skew allowance, so the coordinator
+        times out first and the worker's abort is the backstop (ref
+        worker RPCs inheriting the query context)."""
+        ms = req.get("deadline_ms")
+        if ms is None:
+            return None
+        return RequestContext.from_deadline_ms(
+            ms, trace_id=req.get("trace_id", ""),
+            skew_s=PROPAGATION_SKEW_S)
+
     def _run_task(self, req: dict, read_ts: int):
         """Dispatch one federated task kind against the local tablet.
         Caller holds _write_lock + lock with leadership verified."""
@@ -1083,6 +1098,7 @@ class AlphaServer(RaftServer):
             # since the leader applies its commits synchronously so a
             # read at T sees exactly the commits with ts <= T.
             read_ts = int(req.get("read_ts", 0)) or None
+            ctx = self._req_ctx(req)
             if read_ts is not None:
                 # pinned read: pay the quorum barrier FIRST — a deposed
                 # leader cannot commit the no-op, so it can never serve
@@ -1113,10 +1129,11 @@ class AlphaServer(RaftServer):
                             raise NotLeader(self.node.leader_id)
                         out = self.db.query(
                             req["q"], variables=req.get("vars"),
-                            read_ts=read_ts)
+                            read_ts=read_ts, ctx=ctx)
                 return {"ok": True, "result": out}
             with self.lock:
-                out = self.db.query(req["q"], variables=req.get("vars"))
+                out = self.db.query(req["q"], variables=req.get("vars"),
+                                    ctx=ctx)
             return {"ok": True, "result": out}
         if op == "mutate":
             kw = dict(req["kw"])
@@ -1288,6 +1305,13 @@ class AlphaServer(RaftServer):
             # barrier; every task reconciles decided cross-group
             # commits <= read_ts first.
             read_ts = int(req.get("read_ts", 0))
+            # the coordinator's propagated budget: give up BEFORE the
+            # quorum barrier (its round-trip is the expensive part)
+            # and again before reading — a coordinator that already
+            # timed out must not keep consuming this group's leader
+            ctx = self._req_ctx(req)
+            if ctx is not None:
+                ctx.check("task")
             # EVERY task pays the quorum barrier: the client's leader
             # can change mid-query, and a once-per-query (or cached
             # per-term) barrier would let a fresh or partitioned
@@ -1302,6 +1326,8 @@ class AlphaServer(RaftServer):
                 with self.lock:
                     if self.node.role != LEADER:
                         raise NotLeader(self.node.leader_id)
+                    if ctx is not None:
+                        ctx.check("task read")
                     return {"ok": True,
                             "result": self._run_task(req, read_ts)}
         if op == "xstage":

@@ -258,16 +258,23 @@ class RoutedCluster:
             except Exception:  # noqa: BLE001 — reconciliation covers it
                 pass
 
-    def query(self, q: str, variables: Optional[dict] = None) -> dict:
+    def query(self, q: str, variables: Optional[dict] = None,
+              deadline_ms: Optional[int] = None) -> dict:
         """Route to the owning group; when a document's top-level
         blocks touch DIFFERENT groups, scatter block-wise and gather
         (the reference fans per-attr tasks to group leaders,
         worker/task.go:131; block-level is the coarser granularity the
         predicate-sharded store supports without cross-group joins —
-        blocks connected by variables must stay within one group)."""
+        blocks connected by variables must stay within one group).
+        `deadline_ms` bounds the whole routed query: the remaining
+        budget rides every downstream RPC (groups/tasks inherit it)."""
         from dgraph_tpu.gql import parse
         from dgraph_tpu.server.acl import query_predicates
 
+        ctx = None
+        if deadline_ms is not None:
+            from dgraph_tpu.utils.reqctx import RequestContext
+            ctx = RequestContext.from_deadline_ms(deadline_ms)
         parsed = parse(q, variables)
         preds = {p.lstrip("~") for p in query_predicates(parsed)}
         tmap = self.tablet_map()
@@ -278,21 +285,23 @@ class RoutedCluster:
             # assignment — no second fetch, no TOCTOU between them
             try:
                 return self._scatter_query(q, variables, parsed,
-                                           tmap["tablets"])
+                                           tmap["tablets"], ctx)
             except _NeedsFederation:
                 # a single block spans groups / a var crosses groups:
                 # run the full executor here with per-attr task RPCs
                 # to each owning group (ref worker/task.go:131)
                 return self._federated_query(q, variables,
-                                             tmap["tablets"])
-        return self.groups[gid].query(q, variables)
+                                             tmap["tablets"], ctx)
+        return self.groups[gid].query(
+            q, variables,
+            deadline_ms=ctx.remaining_ms() if ctx else None)
 
     def _federated_query(self, q: str, variables: Optional[dict],
-                         tmap: dict) -> dict:
+                         tmap: dict, ctx=None) -> dict:
         from dgraph_tpu.cluster.federated import FederatedDB
 
         read_ts = self.zero.assign_ts(1)
-        fdb = FederatedDB(self.groups, tmap, "", read_ts)
+        fdb = FederatedDB(self.groups, tmap, "", read_ts, ctx=ctx)
         # schema from every group: on-the-fly predicates exist only on
         # their owning group, so no single group has the whole picture
         for gid in sorted(self.groups):
@@ -310,7 +319,7 @@ class RoutedCluster:
         return out
 
     def _scatter_query(self, q: str, variables: Optional[dict],
-                       parsed, tmap: dict) -> dict:
+                       parsed, tmap: dict, ctx=None) -> dict:
         from dgraph_tpu.server.acl import block_predicates
 
         # assign each top-level block to its owning group; blocks
@@ -343,7 +352,11 @@ class RoutedCluster:
                         "extensions": {"scatter": [],
                                        "read_ts": read_ts}}
         for gid in sorted({g for g, _ in assign}):
-            out = self.groups[gid].query(q, variables, read_ts=read_ts)
+            if ctx is not None:
+                ctx.check(f"scatter to group {gid}")
+            out = self.groups[gid].query(
+                q, variables, read_ts=read_ts,
+                deadline_ms=ctx.remaining_ms() if ctx else None)
             data = out.get("data", {})
             # response shape must not depend on tablet placement:
             # carry extensions like the single-group path does

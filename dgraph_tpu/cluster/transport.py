@@ -20,6 +20,7 @@ from typing import Callable, Optional
 
 from dgraph_tpu import wire
 from dgraph_tpu.cluster.raft import Msg
+from dgraph_tpu.utils import failpoint
 from dgraph_tpu.utils.metrics import inc_counter
 
 _HELLO = b"DGTRAFT1"
@@ -83,6 +84,14 @@ class TcpTransport:
         Raft's own retry logic (heartbeats, append retries) recovers
         from drops, like the reference's conn.Pool send failures."""
         if self._closed.is_set():
+            return False
+        try:
+            # chaos seam: an armed `transport.send` failpoint delays
+            # (sleep) or drops (error) outbound Raft frames — the
+            # deterministic in-process flaky-network nemesis
+            failpoint.fire("transport.send")
+        except failpoint.FailpointError:
+            inc_counter("raft_send_drops")
             return False
         for attempt in (0, 1):
             sock = self._conn_to(msg.to, force_new=attempt == 1)

@@ -171,7 +171,9 @@ class GraphDB:
     # ------------------------------------------------------------------
 
     def alter(self, schema_text: str = "", drop_all: bool = False,
-              drop_attr: str = ""):
+              drop_attr: str = "", ctx=None):
+        if ctx is not None:
+            ctx.check("alter")
         if drop_all:
             for tab in self.tablets.values():
                 self.device_cache.drop_tablet(tab)
@@ -224,7 +226,7 @@ class GraphDB:
                query: str = "", variables: dict | None = None,
                mutations: Optional[list[Mutation]] = None,
                cond: str = "",
-               commit_now: bool = False) -> dict:
+               commit_now: bool = False, ctx=None) -> dict:
         """Stage (and optionally commit) a mutation — optionally an upsert
         block: `query` runs first at the txn's startTs and its uid/value
         variables substitute into uid(v)/val(v) references in the
@@ -256,11 +258,13 @@ class GraphDB:
                 from dgraph_tpu.query.executor import Executor
 
                 parsed = gql_parse(query, variables)
-                ex = Executor(self, txn.start_ts)
+                ex = Executor(self, txn.start_ts, ctx=ctx)
                 queries_json = ex.run(parsed)
 
             applied = False
             for mut in muts:
+                if ctx is not None:
+                    ctx.check("mutate")
                 if not self._cond_holds(mut.cond, ex):
                     continue
                 nqs: list[tuple[NQuad, bool]] = []
@@ -278,6 +282,10 @@ class GraphDB:
                     nqs = self._substitute_vars(nqs, ex)
                 self._stage(txn, nqs)
                 applied = True
+            if ctx is not None:
+                # last pre-commit boundary: an expired/cancelled
+                # request must not commit work its client abandoned
+                ctx.check("commit")
         except Exception:
             if own:
                 self.discard(txn)  # don't leak the ts in the oracle
@@ -744,12 +752,14 @@ class GraphDB:
 
     def query(self, q: str, variables: dict | None = None,
               txn: Optional[Txn] = None, best_effort: bool = True,
-              read_ts: Optional[int] = None) -> dict:
+              read_ts: Optional[int] = None, ctx=None) -> dict:
         """`read_ts` pins the MVCC snapshot to an externally issued
         timestamp (a zero-global ts for cross-group reads); otherwise
-        best_effort reads at max_assigned and strict reads allocate."""
+        best_effort reads at max_assigned and strict reads allocate.
+        `ctx` (utils/reqctx.RequestContext) carries the request's
+        deadline/cancellation into the executor."""
         ex, done, lat, read_ts = self._query_run(
-            q, variables, txn, best_effort, read_ts)
+            q, variables, txn, best_effort, read_ts, ctx)
         try:
             with _span("encode") as sp:
                 t0 = time.perf_counter_ns()
@@ -800,7 +810,8 @@ class GraphDB:
             rows.append(row)
         return rows
 
-    def _query_run(self, q, variables, txn, best_effort, read_ts):
+    def _query_run(self, q, variables, txn, best_effort, read_ts,
+                   ctx=None):
         """Shared query front half: parse, read-ts resolution,
         execution — everything up to (but excluding) emission, which
         query() and query_json() do differently."""
@@ -811,6 +822,8 @@ class GraphDB:
             t0 = time.perf_counter_ns()
             parsed = gql_parse(q, variables)
             lat.parsing_ns = time.perf_counter_ns() - t0
+            if ctx is not None:
+                ctx.check("parse")
 
             t0 = time.perf_counter_ns()
             if read_ts is not None:
@@ -829,7 +842,7 @@ class GraphDB:
             self.coordinator.pin_read(read_ts)
             t0 = time.perf_counter_ns()
             try:
-                ex = Executor(self, read_ts)
+                ex = Executor(self, read_ts, ctx=ctx)
                 done = ex.execute(parsed)
             except BaseException:
                 self.coordinator.unpin_read(read_ts)
@@ -849,7 +862,7 @@ class GraphDB:
 
     def query_json(self, q: str, variables: dict | None = None,
                    txn: Optional[Txn] = None, best_effort: bool = True,
-                   read_ts: Optional[int] = None) -> str:
+                   read_ts: Optional[int] = None, ctx=None) -> str:
         """query() with the serialized-response fast path: the full
         {"data": ..., "extensions": ...} body as ONE JSON string, with
         flat uid+scalar blocks encoded by the native columnar row
@@ -860,7 +873,7 @@ class GraphDB:
         import json as _json
 
         ex, done, lat, read_ts = self._query_run(
-            q, variables, txn, best_effort, read_ts)
+            q, variables, txn, best_effort, read_ts, ctx)
         try:
             with _span("encode") as sp:
                 t0 = time.perf_counter_ns()

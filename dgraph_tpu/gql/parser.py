@@ -121,8 +121,19 @@ def _check_duplicates(res: ParsedResult):
 def _resolve_vars(decl: dict, provided: dict | None) -> dict[str, str]:
     out = {}
     # clients pass keys with the dollar sign ("$a": "2" — the
-    # reference's api.Request.Vars convention); decls store bare names
-    provided = {k.lstrip("$"): v for k, v in (provided or {}).items()}
+    # reference's api.Request.Vars convention); decls store bare
+    # names. Strip ONE leading "$" ("$$a" must stay "$a", not collapse
+    # to "a"), and reject a bare/"$"-prefixed duplicate pair — which
+    # key wins would otherwise be dict-order roulette (ADVICE round 5)
+    norm: dict[str, str] = {}
+    for k, v in (provided or {}).items():
+        key = k[1:] if k.startswith("$") else k
+        if key in norm:
+            raise GQLError(
+                f"duplicate GraphQL variable {key!r} "
+                "(supplied both bare and $-prefixed)")
+        norm[key] = v
+    provided = norm
     for name, default in decl.items():
         if name in provided:
             out[name] = str(provided[name])
@@ -392,6 +403,12 @@ def _parse_function(cur: Cursor, gvars: dict) -> Function:
                         f"regexp variable ${name} must carry "
                         f"/pattern/flags, got {val!r}")
                 body, _, flags = val[1:].rpartition("/")
+                if not body:
+                    # "//i" would otherwise compile to an empty
+                    # match-everything pattern (ADVICE round 5)
+                    raise GQLError(
+                        f"regexp variable ${name} has an empty "
+                        f"pattern body, got {val!r}")
                 fn.args.append(Arg(body))
                 if flags:
                     fn.args.append(Arg(flags))

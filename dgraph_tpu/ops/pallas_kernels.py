@@ -24,6 +24,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax >= 0.6 wants an InterpretParams object to simulate TPU kernels;
+# jax <= 0.4 takes interpret=True directly
+_INTERPRET_ON = (pltpu.InterpretParams()
+                 if hasattr(pltpu, "InterpretParams") else True)
+
 # Max int32 scalar-prefetch elements one kernel instance can hold in
 # SMEM (v5e: 2^17 passes, 2^18 fails the Mosaic compile). Buckets whose
 # flattened in-neighbor table exceeds this are split across calls.
@@ -80,9 +85,9 @@ def bucket_or_pallas(f: jax.Array, in_nb: jax.Array,
         out = pl.pallas_call(
             kernel, grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((cm, 1, w), jnp.uint32),
-            # CPU CI simulates the TPU kernel (pltpu.InterpretParams);
-            # on real TPU this compiles through Mosaic
-            interpret=pltpu.InterpretParams() if interpret else False,
+            # CPU CI simulates the TPU kernel; on real TPU this
+            # compiles through Mosaic
+            interpret=_INTERPRET_ON if interpret else False,
         )(flat_idx, f3)
         return out[:, 0, :]
 

@@ -1,0 +1,21 @@
+"""jax version compatibility for the parallel tier.
+
+One home for the shard_map import dance so the next jax API rename is
+a one-file fix: jax >= 0.5 exports `jax.shard_map` with a `check_vma`
+kwarg; jax <= 0.4 keeps it in `jax.experimental.shard_map` where the
+same knob is called `check_rep`.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5
+    from jax import shard_map
+except ImportError:  # jax <= 0.4
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(*args, check_vma=None, **kw):
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map(*args, **kw)
+
+__all__ = ["shard_map"]

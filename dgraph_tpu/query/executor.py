@@ -40,6 +40,7 @@ from dgraph_tpu.cluster.coordinator import StaleSnapshot
 from dgraph_tpu.query.colvar import ColVar, make_colvar
 from dgraph_tpu.query.retrigram import compile_trigram_query
 from dgraph_tpu.storage.tablet import Tablet
+from dgraph_tpu.utils import failpoint
 from dgraph_tpu.utils.keys import token_bytes
 from dgraph_tpu.utils.metrics import inc_counter
 from dgraph_tpu.utils.tracing import span as _span
@@ -309,13 +310,25 @@ class ExecNode:
 
 
 class Executor:
-    def __init__(self, db, read_ts: int):
+    def __init__(self, db, read_ts: int, ctx=None):
         self.db = db
         self.read_ts = read_ts
+        # RequestContext (utils/reqctx.py): deadline + cancellation,
+        # consulted at block/level boundaries so deep traversals abort
+        # mid-flight (the reference checks ctx.Err() in ProcessGraph)
+        self.ctx = ctx
         self.parsed: Optional[ParsedResult] = None
         self.uid_vars: dict[str, np.ndarray] = {}
         self.value_vars: dict[str, dict[int, Val]] = {}
         self._path_var_order: dict[str, list[int]] = {}
+
+    def _checkpoint(self, where: str):
+        """Block/level boundary: the `executor.level` failpoint (chaos
+        tests slow traversals down here) and the request context's
+        deadline/cancellation check."""
+        failpoint.fire("executor.level")
+        if self.ctx is not None:
+            self.ctx.check(where)
 
     # ------------------------------------------------------------------
     # block scheduling (ref query.go:2596 dependency loop)
@@ -340,6 +353,7 @@ class Executor:
             still = []
             for gq in pending:
                 if self._vars_ready(gq):
+                    self._checkpoint(f"block {gq.alias or gq.attr}")
                     done.append((gq, self._run_block(gq)))
                 else:
                     still.append(gq)
@@ -909,15 +923,6 @@ class Executor:
             raise GQLError(
                 f"{fn.name}() expects a single value, "
                 f"got {len(fn.args)}")
-        if candidates is None and not _has_sortable_index(tab.schema):
-            # root inequalities walk an ordered index; hash/term/
-            # trigram and unindexed predicates can't serve one (ref
-            # query1:TestHashTokGeqErr, worker/tokens.go
-            # getInequalityTokens' IsSortable requirement)
-            raise GQLError(
-                f"attribute {fn.attr!r} needs a sortable index "
-                f"(exact/int/float/datetime) to serve {fn.name} "
-                "at the query root")
         try:
             if fn.name == "between":
                 lo = sort_key(convert(Val(TypeID.DEFAULT, fn.args[0].value), tid))
@@ -1559,6 +1564,8 @@ class Executor:
 
     def _expand_children(self, parent: ExecNode, children: list[GraphQuery],
                          src: np.ndarray):
+        # one traversal level (incl. @cascade recursion into subtrees)
+        self._checkpoint(f"level {parent.gq.alias or parent.gq.attr}")
         children = self._expand_expand(children, src)
         # dependency-ordered processing: a child consuming a var that a
         # SIBLING subtree binds (facet var, deeper value var) must run
@@ -2834,6 +2841,7 @@ class Executor:
         for _ in range(depth):
             if not len(frontier):
                 break
+            self._checkpoint(f"recurse {gq.alias or gq.attr}")
             # expand(_all_)/expand(Type) re-resolves per level against
             # the CURRENT frontier's types (ref TestRecurseExpand)
             preds = [c for c in
@@ -3010,6 +3018,7 @@ class Executor:
             return out
 
         def dijkstra(banned_edges, banned_nodes, start, depth_budget):
+            self._checkpoint("shortest")
             # labels are (node, hops): a cheap-but-deep route must not
             # shadow a shallower one that still has hop budget left
             dist = {(start, 0): 0.0}
@@ -3017,6 +3026,8 @@ class Executor:
             pq = [(0.0, 0, start)]
             best_dst = None
             while pq:
+                if self.ctx is not None and (len(dist) & 0xFF) == 0:
+                    self.ctx.check("shortest")
                 d, hops, u = heapq.heappop(pq)
                 if u == dst:
                     best_dst = (u, hops)

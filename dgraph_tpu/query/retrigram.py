@@ -34,8 +34,19 @@ from dataclasses import dataclass, field
 from itertools import product
 from typing import Optional
 
-from re import _constants as _sc
-from re import _parser as _sre
+try:  # Python 3.11+ moved the sre internals under re.*
+    from re import _constants as _sc
+    from re import _parser as _sre
+except ImportError:  # Python <= 3.10: the public top-level names
+    import sre_constants as _sc
+    import sre_parse as _sre
+
+# opcodes added in 3.11 (atomic groups / possessive quantifiers): a
+# 3.10 parser never emits them, so distinct sentinels keep the `is`
+# dispatch below falsy instead of AttributeError-ing into the
+# degrade-to-ALL path on every pattern
+_OPC_ATOMIC_GROUP = getattr(_sc, "ATOMIC_GROUP", object())
+_OPC_POSSESSIVE_REPEAT = getattr(_sc, "POSSESSIVE_REPEAT", object())
 
 # Bounds on the exact-set tracking: past these we degrade to trigram
 # queries (still correct, just a weaker prefilter).  codesearch uses
@@ -124,8 +135,14 @@ _EMPTY_STR = _Info(exact=frozenset({""}))
 
 try:  # sre's own table of extra case equivalents (ſ↔s, ı↔i, µ↔μ…)
     from re._casefix import _EXTRA_CASES
-except ImportError:  # pragma: no cover
-    _EXTRA_CASES = {}
+except ImportError:  # Python <= 3.10 keeps the same table as
+    # codepoint tuples in sre_compile._equivalences
+    try:
+        from sre_compile import _equivalences
+        _EXTRA_CASES = {i: [j for j in t if i != j]
+                        for t in _equivalences for i in t}
+    except ImportError:  # pragma: no cover
+        _EXTRA_CASES = {}
 
 # chr → every codepoint that sre's LITERAL_UNI_IGNORE accepts for it.
 # sre matches X against literal c iff lower(X) == lower(c) or lower(X)
@@ -260,9 +277,9 @@ def _an_node(node, ic: bool) -> _Info:
         ic2 = (ic or bool(add_flags & re.IGNORECASE)) \
             and not bool(del_flags & re.IGNORECASE)
         return _an_seq(seq, ic2)
-    if op is _sc.ATOMIC_GROUP:
+    if op is _OPC_ATOMIC_GROUP:
         return _an_seq(av, ic)
-    if op in (_sc.MAX_REPEAT, _sc.MIN_REPEAT, _sc.POSSESSIVE_REPEAT):
+    if op in (_sc.MAX_REPEAT, _sc.MIN_REPEAT, _OPC_POSSESSIVE_REPEAT):
         lo, hi, seq = av
         sub = _an_seq(seq, ic)
         if lo == 0:

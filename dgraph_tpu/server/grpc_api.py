@@ -25,6 +25,9 @@ from dgraph_tpu import wire
 from dgraph_tpu.cluster.coordinator import TxnAborted
 from dgraph_tpu.server.acl import AclError
 from dgraph_tpu.server.http import AlphaServer
+from dgraph_tpu.utils.reqctx import (
+    Cancelled, DeadlineExceeded, Overloaded, RequestContext,
+)
 
 _SERVICE = "dgraph.tpu.Alpha"
 
@@ -32,10 +35,20 @@ _SERVICE = "dgraph.tpu.Alpha"
 def _abort_for(context, e):
     """One exception -> gRPC status table for BOTH services (status
     codes as the reference maps them: ABORTED for txn conflicts,
-    PERMISSION_DENIED for ACL, INVALID_ARGUMENT for bad requests)."""
+    PERMISSION_DENIED for ACL, INVALID_ARGUMENT for bad requests,
+    DEADLINE_EXCEEDED / CANCELLED / RESOURCE_EXHAUSTED for the
+    request-context + admission-control layer)."""
     if isinstance(e, TxnAborted):
         context.abort(grpc.StatusCode.ABORTED,
                       f"Transaction has been aborted. Please retry: {e}")
+    if isinstance(e, DeadlineExceeded):
+        context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+    if isinstance(e, Cancelled):
+        context.abort(grpc.StatusCode.CANCELLED, str(e))
+    if isinstance(e, Overloaded):
+        # retryable by contract (the reference's rate limiter answers
+        # the same status; clients back off with jitter)
+        context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
     if isinstance(e, AclError):
         context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
     if isinstance(e, (ValueError, KeyError)):
@@ -44,10 +57,20 @@ def _abort_for(context, e):
                   f"{type(e).__name__}: {e}")
 
 
+def _ctx_of(context) -> Optional[RequestContext]:
+    """RequestContext from the gRPC deadline: time_remaining() carries
+    the client's timeout field through every hop (the reference's
+    context.Context); None when the client set no deadline."""
+    tr = context.time_remaining() if context is not None else None
+    if tr is None:
+        return None
+    return RequestContext.with_timeout(tr)
+
+
 def _wrap(fn):
     def method(request, context):
         try:
-            return fn(request or {})
+            return fn(request or {}, _ctx_of(context))
         except Exception as e:  # noqa: BLE001
             _abort_for(context, e)
 
@@ -55,30 +78,30 @@ def _wrap(fn):
 
 
 def _handlers(alpha: AlphaServer) -> dict:
-    def login(req):
+    def login(req, ctx):
         return alpha.handle_login(req.get("body", {}))
 
-    def query(req):
+    def query(req, ctx):
         return alpha.handle_query(req.get("q", ""),
                                   req.get("params", {}),
-                                  req.get("token", ""))
+                                  req.get("token", ""), ctx=ctx)
 
-    def mutate(req):
+    def mutate(req, ctx):
         return alpha.handle_mutate(req.get("body", b""),
                                    req.get("content_type",
                                            "application/rdf"),
                                    req.get("params", {}),
-                                   req.get("token", ""))
+                                   req.get("token", ""), ctx=ctx)
 
-    def alter(req):
+    def alter(req, ctx):
         return alpha.handle_alter(req.get("body", b""),
-                                  req.get("token", ""))
+                                  req.get("token", ""), ctx=ctx)
 
-    def commit(req):
+    def commit(req, ctx):
         return alpha.handle_commit(req.get("params", {}),
-                                   req.get("token", ""))
+                                   req.get("token", ""), ctx=ctx)
 
-    def check_version(req):
+    def check_version(req, ctx):
         from dgraph_tpu.cli import __version__
         return {"tag": f"dgraph-tpu-{__version__}"}
 
@@ -268,6 +291,7 @@ def _pb_handlers(alpha: AlphaServer) -> dict:
 
     def query(req, context):
         token = token_of(req, context)
+        ctx = _ctx_of(context)
         params = {}
         if req.start_ts:
             params["startTs"] = str(req.start_ts)
@@ -319,7 +343,7 @@ def _pb_handlers(alpha: AlphaServer) -> dict:
             params["commitNow"] = "true" if commit_now else "false"
             out = alpha.handle_mutate(
                 json.dumps(env).encode(), "application/json",
-                params, token)
+                params, token, ctx=ctx)
             ext = out.get("extensions", {})
             data = out.get("data", out)
             return pb.Response(
@@ -332,7 +356,7 @@ def _pb_handlers(alpha: AlphaServer) -> dict:
         payload = {"query": req.query,
                    "variables": _strip_dollar(req.vars)} \
             if req.vars else req.query
-        out = alpha.handle_query(payload, params, token)
+        out = alpha.handle_query(payload, params, token, ctx=ctx)
         ext = out.get("extensions", {})
         return pb.Response(
             json=json.dumps(out.get("data", {}),
@@ -353,7 +377,7 @@ def _pb_handlers(alpha: AlphaServer) -> dict:
                 "drop_attr or drop_all")
         else:
             body = req.schema.encode()
-        alpha.handle_alter(body, token)
+        alpha.handle_alter(body, token, ctx=_ctx_of(context))
         return pb.Payload(Data=b"Success")
 
     def commit_or_abort(req, context):
@@ -363,7 +387,8 @@ def _pb_handlers(alpha: AlphaServer) -> dict:
         token = token_of(req, context)
         out = alpha.handle_commit(
             {"startTs": str(req.start_ts),
-             "abort": "true" if req.aborted else "false"}, token)
+             "abort": "true" if req.aborted else "false"}, token,
+            ctx=_ctx_of(context))
         return _txn_ctx(out.get("extensions", {}))
 
     def check_version(req, context):
@@ -453,7 +478,8 @@ class GrpcClient:
         return out
 
     def query(self, q: str, variables: Optional[dict] = None,
-              start_ts: int = 0, best_effort: bool = False) -> dict:
+              start_ts: int = 0, best_effort: bool = False,
+              timeout: Optional[float] = None) -> dict:
         params = {}
         if start_ts:
             params["startTs"] = str(start_ts)
@@ -461,8 +487,11 @@ class GrpcClient:
             params["be"] = "true"
         # handle_query accepts either DQL text or the JSON envelope
         payload = {"query": q, "variables": variables} if variables else q
+        # `timeout` becomes the gRPC deadline; the server reads it via
+        # context.time_remaining() and aborts work past it
         return self._stubs["Query"](
-            {"q": payload, "params": params, "token": self.token})
+            {"q": payload, "params": params, "token": self.token},
+            timeout=timeout)
 
     def mutate(self, body: bytes | str,
                content_type: str = "application/rdf",

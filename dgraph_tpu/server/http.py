@@ -40,6 +40,7 @@ import json
 import threading
 import time
 import traceback
+from contextlib import contextmanager
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
@@ -47,7 +48,11 @@ from urllib.parse import parse_qs, urlparse
 from dgraph_tpu.cluster.coordinator import TxnAborted
 from dgraph_tpu.engine.db import GraphDB, Mutation, Txn
 from dgraph_tpu.server.acl import AclError
+from dgraph_tpu.utils import metrics
 from dgraph_tpu.utils.logger import log
+from dgraph_tpu.utils.reqctx import (
+    Cancelled, DeadlineExceeded, Overloaded, RequestContext,
+)
 
 # startTs -> open server-side txn (the reference keeps this state in the
 # client + oracle; our engine txns are server objects, so the server maps)
@@ -60,7 +65,8 @@ class AlphaServer:
     def __init__(self, db: Optional[GraphDB] = None,
                  txn_ttl_s: float = 300.0,
                  acl_secret: Optional[bytes] = None,
-                 mutations_mode: str = "allow"):
+                 mutations_mode: str = "allow",
+                 max_pending: int = 0):
         if mutations_mode not in ("allow", "disallow", "strict"):
             raise ValueError(
                 "--mutations argument must be one of allow, disallow, "
@@ -80,6 +86,19 @@ class AlphaServer:
         # draining: reject writes, keep serving reads (ref x/health.go
         # drainingMode + /admin/draining handler, alpha/admin.go)
         self.draining = False
+        # admission control (ref edgraph/server.go pending-query
+        # throttle answering RESOURCE_EXHAUSTED): a bounded in-flight
+        # gauge over every work-bearing endpoint. 0 = unbounded.
+        # Excess load sheds with HTTP 429 (retryable) instead of
+        # queuing unboundedly in the thread-per-request front end.
+        self.max_pending = max_pending
+        self._admission = threading.Lock()
+        self._inflight = 0
+        # trace id -> live RequestContexts, for /admin/cancel. A LIST:
+        # trace ids are client-chosen, so an impatient retry can put
+        # two live requests under one id — cancel hits them all, and
+        # each request removes only its own handle on exit
+        self._live_ctx: dict[str, list[RequestContext]] = {}
         self.txns: dict[int, Txn] = {}
         self._touched: dict[int, float] = {}
         # startTs -> userid that opened the txn (ACL mode only): /commit
@@ -138,6 +157,74 @@ class AlphaServer:
             self._commits_since_rollup = 0
             self.db.rollup_all()
 
+    @contextmanager
+    def _admit(self, ctx: Optional[RequestContext] = None):
+        """One admission slot for the duration of a request. Sheds
+        with Overloaded (-> 429, retryable) when max_pending slots are
+        taken; a request that dies mid-flight (deadline, cancellation,
+        any error) releases its slot in the finally. An already-dead
+        context is rejected before it takes a slot."""
+        if ctx is not None:
+            ctx.check("admission")
+        with self._admission:
+            if self.max_pending and self._inflight >= self.max_pending:
+                metrics.inc_counter("dgraph_queries_shed_total")
+                raise Overloaded(
+                    f"server is overloaded: {self._inflight} requests "
+                    f"in flight (max_pending={self.max_pending}); "
+                    "retry with jittered backoff")
+            self._inflight += 1
+            metrics.set_gauge("dgraph_pending_queries", self._inflight)
+            if ctx is not None:
+                self._live_ctx.setdefault(ctx.trace_id, []).append(ctx)
+        try:
+            yield
+        finally:
+            with self._admission:
+                self._inflight -= 1
+                metrics.set_gauge("dgraph_pending_queries",
+                                  self._inflight)
+                if ctx is not None:
+                    live = self._live_ctx.get(ctx.trace_id)
+                    if live is not None:
+                        if ctx in live:
+                            live.remove(ctx)
+                        if not live:
+                            del self._live_ctx[ctx.trace_id]
+
+    def pending(self) -> int:
+        with self._admission:
+            return self._inflight
+
+    def wait_idle(self, timeout_s: float = 30.0) -> bool:
+        """Graceful-drain helper: True once every admitted request has
+        finished. Callers enable draining mode first so no new writes
+        arrive, then wait here before shutting the engine down."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if self.pending() == 0:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+
+    def handle_cancel(self, params: dict, token: str = "") -> dict:
+        """Cancel an in-flight request by trace id (guardians only
+        under ACL). The cooperative flag fires at the executor's next
+        block/level boundary and the request dies with 499, freeing
+        its admission slot."""
+        self._require_guardian(token, "/admin/cancel")
+        tid = params.get("traceId", "")
+        with self._admission:
+            ctxs = list(self._live_ctx.get(tid, ()))
+        if not ctxs:
+            raise KeyError(f"no in-flight request with traceId={tid!r}")
+        for ctx in ctxs:
+            ctx.cancel()
+        return {"code": "Success",
+                "message": f"cancelled {len(ctxs)} request(s) "
+                           f"with traceId {tid}"}
+
     # -- request handlers (transport-independent) --
 
     def _query_prologue(self, body: dict | str, params: dict,
@@ -177,34 +264,44 @@ class AlphaServer:
             (be if ro_txn is None else False), pin_ts
 
     def handle_query(self, body: dict | str, params: dict,
-                     token: str = "") -> dict:
-        q, variables, ro_txn, be, pin_ts = self._query_prologue(
-            body, params, token)
-        with self.rw.read:
-            return self.db.query(q, variables, txn=ro_txn,
-                                 best_effort=be, read_ts=pin_ts)
+                     token: str = "", ctx=None) -> dict:
+        with self._admit(ctx):
+            q, variables, ro_txn, be, pin_ts = self._query_prologue(
+                body, params, token)
+            with self.rw.read:
+                return self.db.query(q, variables, txn=ro_txn,
+                                     best_effort=be, read_ts=pin_ts,
+                                     ctx=ctx)
 
     def handle_query_json(self, body: dict | str, params: dict,
-                          token: str = "") -> str:
+                          token: str = "", ctx=None) -> str:
         """handle_query returning the serialized response body — flat
         blocks take the native columnar emitter (db.query_json), so
         the HTTP layer never re-serializes what the engine already
         encoded (ref query/outputnode.go fastJsonNode feeding the
         response writer directly)."""
-        q, variables, ro_txn, be, pin_ts = self._query_prologue(
-            body, params, token)
-        with self.rw.read:
-            return self.db.query_json(q, variables, txn=ro_txn,
-                                      best_effort=be, read_ts=pin_ts)
+        with self._admit(ctx):
+            q, variables, ro_txn, be, pin_ts = self._query_prologue(
+                body, params, token)
+            with self.rw.read:
+                return self.db.query_json(q, variables, txn=ro_txn,
+                                          best_effort=be,
+                                          read_ts=pin_ts, ctx=ctx)
 
     def handle_mutate(self, body: bytes, content_type: str,
-                      params: dict, token: str = "") -> dict:
+                      params: dict, token: str = "", ctx=None) -> dict:
         if self.draining:
             raise RuntimeError(
                 "the server is in draining mode; write operations are "
                 "rejected")
         if self.mutations_mode == "disallow":
             raise ValueError("no mutations allowed")
+        with self._admit(ctx):
+            return self._mutate_admitted(body, content_type, params,
+                                         token, ctx)
+
+    def _mutate_admitted(self, body: bytes, content_type: str,
+                         params: dict, token: str, ctx) -> dict:
         commit_now = params.get("commitNow", "false") == "true"
         start_ts = int(params.get("startTs", 0))
         muts, query, variables = _parse_mutation_body(body, content_type)
@@ -260,7 +357,7 @@ class AlphaServer:
             try:
                 out = self.db.mutate(txn, mutations=muts, query=query,
                                      variables=variables,
-                                     commit_now=commit_now)
+                                     commit_now=commit_now, ctx=ctx)
             except Exception:
                 # a failed mutation aborts the whole txn (fail fast; the
                 # reference marks the txn context aborted)
@@ -291,10 +388,11 @@ class AlphaServer:
             out.setdefault("extensions", {})["txn"] = ext_txn
             return out
 
-    def handle_commit(self, params: dict, token: str = "") -> dict:
+    def handle_commit(self, params: dict, token: str = "",
+                      ctx=None) -> dict:
         start_ts = int(params.get("startTs", 0))
         abort = params.get("abort", "false") == "true"
-        with self.rw.write:
+        with self._admit(ctx), self.rw.write:
             with self.meta:
                 if self.acl is not None:
                     self._check_txn_owner(start_ts,
@@ -315,7 +413,8 @@ class AlphaServer:
                     "extensions": {"txn": {"start_ts": start_ts,
                                            "commit_ts": commit_ts}}}
 
-    def handle_alter(self, body: bytes, token: str = "") -> dict:
+    def handle_alter(self, body: bytes, token: str = "",
+                     ctx=None) -> dict:
         if self.draining:
             raise RuntimeError(
                 "the server is in draining mode; write operations are "
@@ -343,9 +442,9 @@ class AlphaServer:
             with self.meta:
                 self.acl.authorize_alter(token, preds,
                                          drop=drop_all or bool(drop_attr))
-        with self.rw.write:
+        with self._admit(ctx), self.rw.write:
             self.db.alter(schema_text=schema, drop_all=drop_all,
-                          drop_attr=drop_attr)
+                          drop_attr=drop_attr, ctx=ctx)
         return {"code": "Success", "message": "Done"}
 
     def handle_state(self, token: str = "") -> dict:
@@ -440,7 +539,9 @@ class AlphaServer:
     def handle_health(self) -> dict:
         return {"status": "draining" if self.draining else "healthy",
                 "uptime_s": round(time.time() - self.started_at, 3),
-                "openTxns": len(self.txns)}
+                "openTxns": len(self.txns),
+                "pendingQueries": self.pending(),
+                "maxPending": self.max_pending}
 
     def handle_draining(self, enable: bool, token: str = "") -> dict:
         """Toggle draining (guardians only under ACL) — ref
@@ -600,13 +701,37 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def _error(self, msg: str, code: int = 400):
+    def _error(self, msg: str, code: int = 400, ecode: str = "Error",
+               retryable: bool = False):
+        ext: dict[str, Any] = {"code": ecode}
+        if retryable:
+            ext["retryable"] = True
         self._send(code, {"errors": [{"message": msg,
-                                      "extensions": {"code": "Error"}}]})
+                                      "extensions": ext}]})
 
     def _body(self) -> bytes:
         n = int(self.headers.get("Content-Length", 0))
         return self.rfile.read(n) if n else b""
+
+    def _ctx(self) -> Optional[RequestContext]:
+        """RequestContext from the request headers: the remaining
+        budget in X-Dgraph-Deadline-Ms (the HTTP analogue of the gRPC
+        timeout field) and an optional caller-chosen X-Dgraph-Trace-Id
+        (echoed in errors; the /admin/cancel handle). No headers, no
+        context — zero overhead for plain requests."""
+        dl = self.headers.get("X-Dgraph-Deadline-Ms", "")
+        tid = self.headers.get("X-Dgraph-Trace-Id", "")
+        if dl:
+            try:
+                return RequestContext.from_deadline_ms(int(dl),
+                                                       trace_id=tid)
+            except ValueError:
+                raise ValueError(
+                    f"X-Dgraph-Deadline-Ms must be an integer ms "
+                    f"budget, got {dl!r}") from None
+        if tid:
+            return RequestContext.background(trace_id=tid)
+        return None
 
     def do_GET(self):
         path = urlparse(self.path).path
@@ -646,6 +771,7 @@ class _Handler(BaseHTTPRequestHandler):
         ctype = self.headers.get("Content-Type", "")
         token = self.headers.get("X-Dgraph-AccessToken", "")
         try:
+            ctx = self._ctx()
             body = self._body()
             if path == "/query":
                 if "json" in ctype:
@@ -653,14 +779,18 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     payload = body.decode()
                 self._send_raw(200, self.alpha.handle_query_json(
-                    payload, params, token).encode())
+                    payload, params, token, ctx=ctx).encode())
             elif path == "/mutate":
-                self._send(200, self.alpha.handle_mutate(body, ctype,
-                                                         params, token))
+                self._send(200, self.alpha.handle_mutate(
+                    body, ctype, params, token, ctx=ctx))
             elif path == "/commit":
-                self._send(200, self.alpha.handle_commit(params, token))
+                self._send(200, self.alpha.handle_commit(params, token,
+                                                         ctx=ctx))
             elif path in ("/alter", "/admin/schema"):
-                self._send(200, self.alpha.handle_alter(body, token))
+                self._send(200, self.alpha.handle_alter(body, token,
+                                                        ctx=ctx))
+            elif path == "/admin/cancel":
+                self._send(200, self.alpha.handle_cancel(params, token))
             elif path == "/assign":
                 self._send(200, self.alpha.handle_assign(params, token))
             elif path == "/admin/export":
@@ -678,6 +808,14 @@ class _Handler(BaseHTTPRequestHandler):
         except TxnAborted as e:
             self._error(f"Transaction has been aborted. Please retry: {e}",
                         409)
+        except Overloaded as e:
+            self._error(str(e), 429, ecode="ResourceExhausted",
+                        retryable=True)
+        except DeadlineExceeded as e:
+            self._error(str(e), 408, ecode="DeadlineExceeded",
+                        retryable=True)
+        except Cancelled as e:
+            self._error(str(e), 499, ecode="Cancelled")
         except AclError as e:
             self._error(str(e), 401)
         except (ValueError, KeyError) as e:
@@ -691,14 +829,18 @@ class _Handler(BaseHTTPRequestHandler):
 def serve(db: Optional[GraphDB] = None, host: str = "127.0.0.1",
           port: int = 8080, block: bool = True,
           acl_secret: Optional[bytes] = None,
-          tls_context=None, mutations_mode: str = "allow"
+          tls_context=None, mutations_mode: str = "allow",
+          max_pending: int = 0
           ) -> tuple[ThreadingHTTPServer, AlphaServer]:
     """Start the Alpha HTTP server. With block=False, runs in a daemon
     thread and returns (httpd, alpha) for tests/embedding. Pass an
     ssl.SSLContext (server/tls.py server_context) to serve HTTPS/mTLS
-    like the reference's --tls options (x/tls_helper.go)."""
+    like the reference's --tls options (x/tls_helper.go).
+    `max_pending` bounds concurrently admitted requests (0 = off);
+    excess load sheds with 429."""
     alpha = AlphaServer(db, acl_secret=acl_secret,
-                        mutations_mode=mutations_mode)
+                        mutations_mode=mutations_mode,
+                        max_pending=max_pending)
     handler = type("BoundHandler", (_Handler,), {"alpha": alpha})
     httpd = ThreadingHTTPServer((host, port), handler)
     if tls_context is not None:

@@ -30,6 +30,7 @@ from dgraph_tpu.models.tokenizer import get_tokenizer, tokens_for
 from dgraph_tpu.models.types import (
     TypeID, Val, convert, sort_key, value_fingerprint,
 )
+from dgraph_tpu.utils import failpoint
 from dgraph_tpu.utils.keys import token_bytes
 
 _EMPTY = np.empty(0, dtype=np.uint64)
@@ -247,6 +248,10 @@ class Tablet:
         here must surface as a hard error, never a silent mis-ordered
         append (a stripped assert once let a racing finalize lose a
         committed bank credit)."""
+        # chaos seam: an armed `tablet.apply` failpoint delays or
+        # fails a commit delta landing (the reference's Jepsen runs
+        # surface the same window by killing alphas mid-apply)
+        failpoint.fire("tablet.apply")
         if self.deltas and commit_ts <= self.max_commit_ts:
             raise RuntimeError(
                 f"out-of-order commit apply: ts {commit_ts} after "

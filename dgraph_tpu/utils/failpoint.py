@@ -1,0 +1,138 @@
+"""Deterministic failpoints: named injection sites for chaos tests.
+
+The reference builds its Jepsen nemeses from the outside (SIGKILL,
+partitions, clock skew — contrib/jepsen/main.go); failpoints complement
+that with *surgical*, deterministic faults inside the process, the
+x/debug.go / gofail style: a named site in production code evaluates to
+a no-op unless a test (or the DGRAPH_TPU_FAILPOINTS env var, for
+subprocess clusters) armed an action for it.
+
+Injection sites (grep `failpoint.fire`):
+    transport.send      cluster/transport.py — before a Raft frame send
+    tablet.apply        storage/tablet.py    — before a commit delta lands
+    executor.level      query/executor.py    — every block/level boundary
+
+Actions (spec grammar, `;`-separated in the env var):
+    sleep(S)      delay S seconds (float) at the site
+    error(MSG)    raise FailpointError(MSG) from the site
+    off           registered but inert (hit counting only)
+    N*ACTION      only the first N hits run ACTION, then the point
+                  goes inert (still counted) — deterministic "fail
+                  twice then recover" schedules
+
+Example: DGRAPH_TPU_FAILPOINTS='executor.level=sleep(0.2);tablet.apply=2*error(boom)'
+
+Production cost: `fire()` is one falsy-dict check when nothing is
+armed. Tests arm programmatically and MUST clear: tests/conftest.py
+fails any test that leaks an armed failpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+
+ENV_VAR = "DGRAPH_TPU_FAILPOINTS"
+
+
+class FailpointError(RuntimeError):
+    """Raised by an armed error(...) action at its injection site."""
+
+
+class _Point:
+    __slots__ = ("action", "arg", "limit", "hits")
+
+    def __init__(self, action: str, arg, limit):
+        self.action = action  # "sleep" | "error" | "off"
+        self.arg = arg
+        self.limit = limit    # None = every hit, N = first N hits
+        self.hits = 0
+
+
+_LOCK = threading.Lock()
+_ARMED: dict[str, _Point] = {}
+
+_SPEC = re.compile(
+    r"^(?:(?P<n>\d+)\*)?(?P<action>sleep|error|off)"
+    r"(?:\((?P<arg>[^)]*)\))?$")
+
+
+def _parse(spec: str) -> _Point:
+    m = _SPEC.match(spec.strip())
+    if m is None:
+        raise ValueError(f"bad failpoint spec {spec!r} "
+                         "(want [N*]sleep(S)|error(MSG)|off)")
+    action = m.group("action")
+    limit = int(m.group("n")) if m.group("n") else None
+    arg = m.group("arg")
+    if action == "sleep":
+        arg = float(arg if arg else 0)
+    return _Point(action, arg, limit)
+
+
+def arm(name: str, spec: str):
+    """Arm `name` with an action spec (parsed eagerly so a typo fails
+    the arming test, not the production code path)."""
+    pt = _parse(spec)
+    with _LOCK:
+        _ARMED[name] = pt
+
+
+def disarm(name: str):
+    with _LOCK:
+        _ARMED.pop(name, None)
+
+
+def clear():
+    with _LOCK:
+        _ARMED.clear()
+
+
+def armed() -> list[str]:
+    with _LOCK:
+        return sorted(_ARMED)
+
+
+def hits(name: str) -> int:
+    with _LOCK:
+        pt = _ARMED.get(name)
+        return pt.hits if pt is not None else 0
+
+
+def fire(name: str):
+    """Evaluate the failpoint `name`. No-op (one dict check) unless a
+    test armed it."""
+    if not _ARMED:
+        return
+    with _LOCK:
+        pt = _ARMED.get(name)
+        if pt is None:
+            return
+        pt.hits += 1
+        if pt.limit is not None and pt.hits > pt.limit:
+            return
+        action, arg = pt.action, pt.arg
+    # act OUTSIDE the lock: a sleep must not serialize other sites
+    if action == "sleep":
+        time.sleep(arg)
+    elif action == "error":
+        raise FailpointError(
+            arg if arg else f"failpoint {name} fired")
+
+
+def arm_from_env(env: str | None = None):
+    """Arm from DGRAPH_TPU_FAILPOINTS ('name=spec;name=spec') — how
+    subprocess cluster nodes under chaos tests inherit failpoints.
+    Unset/empty leaves everything inert (the production default)."""
+    raw = os.environ.get(ENV_VAR, "") if env is None else env
+    for part in raw.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, spec = part.partition("=")
+        arm(name.strip(), spec)
+
+
+arm_from_env()

@@ -48,3 +48,31 @@ jax.config.update("jax_compilation_cache_dir",
                   os.path.join(_REPO, ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from the "
+        "default `-m 'not slow'` tier-1 run")
+    config.addinivalue_line(
+        "markers", "failpoint: arms utils/failpoint injection points "
+        "(must clear them; the leak guard below enforces it)")
+
+
+@pytest.fixture(autouse=True)
+def _failpoint_leak_guard():
+    """A failpoint armed in one test and leaked into the next makes
+    failures order-dependent and un-bisectable: fail the leaking test
+    itself, then clear so the rest of the run stays healthy."""
+    from dgraph_tpu.utils import failpoint
+
+    yield
+    leaked = failpoint.armed()
+    if leaked:
+        failpoint.clear()
+        pytest.fail(
+            f"test leaked armed failpoints: {leaked} — arm() must be "
+            "paired with disarm()/clear() (use the `failpoint` marker "
+            "and a try/finally)")

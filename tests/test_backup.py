@@ -61,6 +61,7 @@ def test_incremental_overrides_older_state(tmp_path):
 
 
 def test_encrypted_backup_requires_key(tmp_path):
+    pytest.importorskip("cryptography")
     dest = str(tmp_path / "bk")
     db = _db()
     backup(db, dest, key=KEY)
@@ -87,6 +88,7 @@ def test_uri_handlers(tmp_path):
 
 
 def test_encrypted_wal_roundtrip(tmp_path):
+    pytest.importorskip("cryptography")
     wal = str(tmp_path / "wal")
     db = GraphDB(wal_path=wal, prefer_device=False, enc_key=KEY)
     db.alter("name: string @index(exact) .")
@@ -179,6 +181,7 @@ def test_minio_backup_restore_roundtrip(fake_s3, monkeypatch):
 
 
 def test_minio_encrypted_chain(fake_s3):
+    pytest.importorskip("cryptography")
     dest = f"minio://127.0.0.1:{fake_s3.port}/bk/enc"
     db = _db()
     backup(db, dest, key=KEY)

@@ -8,7 +8,9 @@ bank group's leader is SIGKILLed. The invariant — total balance
 conserved at every snapshot — must hold through all of it.
 """
 
+import http.client
 import itertools
+import json
 import os
 import signal
 import socket
@@ -21,6 +23,7 @@ import pytest
 
 from dgraph_tpu.cluster.client import ClusterClient
 from dgraph_tpu.cluster.topology import RoutedCluster
+from dgraph_tpu.utils import failpoint, metrics
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -1091,3 +1094,175 @@ def test_linearizable_register_under_pause_partition():
                 p.kill()
         for p in procs.values():
             p.wait()
+
+
+# ---------------------------------------------------------------------
+# Deadline + admission-control chaos: in-process alpha over a
+# failpoint-delayed traversal. Deliberately FAST (seconds, no
+# subprocesses) so these run in the default `not slow` tier.
+# ---------------------------------------------------------------------
+
+def _inproc_alpha(max_pending=0):
+    from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu.server.http import serve
+
+    db = GraphDB(prefer_device=False)
+    db.alter(schema_text="cname: string @index(exact) .")
+    db.mutate(set_nquads="\n".join(
+        f'<{i:#x}> <cname> "v{i}" .' for i in range(1, 9)))
+    httpd, alpha = serve(db, host="127.0.0.1", port=0, block=False,
+                         max_pending=max_pending)
+    return httpd, alpha, httpd.server_address[1]
+
+
+def _http_post(port, path, body, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("POST", path, body=body.encode(),
+                     headers=headers or {})
+        r = conn.getresponse()
+        return r.status, json.loads(r.read().decode())
+    finally:
+        conn.close()
+
+
+_SLOW_Q = '{ q(func: has(cname)) { cname } }'
+
+
+@pytest.mark.failpoint
+def test_deadline_aborts_slow_query_and_frees_admission_slot():
+    """A 100ms-deadline query against a failpoint-delayed traversal
+    must answer 408 DeadlineExceeded (retryable) well under 500ms,
+    release its admission slot, and leave the server healthy."""
+    httpd, alpha, port = _inproc_alpha(max_pending=4)
+    try:
+        failpoint.arm("executor.level", "sleep(0.2)")
+        t0 = time.monotonic()
+        status, out = _http_post(port, "/query", _SLOW_Q,
+                                 {"X-Dgraph-Deadline-Ms": "100",
+                                  "X-Dgraph-Trace-Id": "dl-1"})
+        dt = time.monotonic() - t0
+        assert status == 408, out
+        err = out["errors"][0]
+        assert err["extensions"]["code"] == "DeadlineExceeded"
+        assert err["extensions"]["retryable"] is True
+        assert "dl-1" in err["message"]
+        assert dt < 0.5, f"deadline fired too late ({dt:.2f}s)"
+        assert failpoint.hits("executor.level") >= 1
+        # the slot came back: the gauge is zero and the server serves
+        assert alpha.pending() == 0
+        failpoint.clear()
+        status, out = _http_post(port, "/query", _SLOW_Q)
+        assert status == 200 and len(out["data"]["q"]) == 8
+    finally:
+        failpoint.clear()
+        httpd.shutdown()
+
+
+@pytest.mark.failpoint
+def test_cancellation_aborts_query_and_frees_admission_slot():
+    """/admin/cancel?traceId=... flips the cooperative flag; the
+    in-flight query dies 499 at its next level boundary and its
+    admission slot frees."""
+    httpd, alpha, port = _inproc_alpha(max_pending=4)
+    try:
+        failpoint.arm("executor.level", "sleep(0.15)")
+        results = []
+
+        def victim():
+            results.append(_http_post(
+                port, "/query", _SLOW_Q,
+                {"X-Dgraph-Trace-Id": "kill-me"}))
+
+        t = threading.Thread(target=victim)
+        t.start()
+        end = time.monotonic() + 5
+        while alpha.pending() == 0 and time.monotonic() < end:
+            time.sleep(0.005)
+        status, out = _http_post(port, "/admin/cancel?traceId=kill-me",
+                                 "")
+        assert status == 200, out
+        t.join(timeout=10)
+        status, out = results[0]
+        assert status == 499, out
+        assert out["errors"][0]["extensions"]["code"] == "Cancelled"
+        assert alpha.pending() == 0
+    finally:
+        failpoint.clear()
+        httpd.shutdown()
+
+
+@pytest.mark.failpoint
+def test_admission_control_sheds_exact_excess_with_429():
+    """With --max-pending N and N slots held by slow queries, N+k
+    concurrent queries yield exactly k shed responses (429, counted in
+    Prometheus); the held queries complete and the load recovers."""
+    n_slots, k_excess = 2, 3
+    httpd, alpha, port = _inproc_alpha(max_pending=n_slots)
+    try:
+        shed0 = metrics.snapshot()["counters"].get(
+            "dgraph_queries_shed_total", 0)
+        failpoint.arm("executor.level", "sleep(1.0)")
+        results = []
+
+        def slow():
+            results.append(_http_post(port, "/query", _SLOW_Q))
+
+        holders = [threading.Thread(target=slow)
+                   for _ in range(n_slots)]
+        for t in holders:
+            t.start()
+        end = time.monotonic() + 5
+        while alpha.pending() < n_slots and time.monotonic() < end:
+            time.sleep(0.005)
+        assert alpha.pending() == n_slots
+        # the excess sheds immediately (admission happens before any
+        # engine work, so these don't wait on the sleeping holders)
+        shed = [_http_post(port, "/query", _SLOW_Q)
+                for _ in range(k_excess)]
+        for status, out in shed:
+            assert status == 429, out
+            ext = out["errors"][0]["extensions"]
+            assert ext["code"] == "ResourceExhausted"
+            assert ext["retryable"] is True
+        shed_total = metrics.snapshot()["counters"].get(
+            "dgraph_queries_shed_total", 0)
+        assert shed_total - shed0 == k_excess
+        # counter + gauge are exported in Prometheus text format
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/debug/prometheus_metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        assert "dgraph_queries_shed_total" in text
+        assert "dgraph_pending_queries" in text
+        # shed load recovers: the holders finish fine, slots free up
+        for t in holders:
+            t.join(timeout=15)
+        assert [s for s, _ in results] == [200, 200]
+        assert alpha.pending() == 0
+        failpoint.clear()
+        status, _ = _http_post(port, "/query", _SLOW_Q)
+        assert status == 200
+    finally:
+        failpoint.clear()
+        httpd.shutdown()
+
+
+def test_draining_rejects_writes_then_drains_idle():
+    """Graceful drain: draining mode rejects writes, keeps serving
+    reads, and wait_idle() reports quiescence for shutdown."""
+    httpd, alpha, port = _inproc_alpha()
+    try:
+        alpha.draining = True
+        status, out = _http_post(port, "/mutate?commitNow=true",
+                                 '_:x <cname> "nope" .')
+        assert status == 500 and "draining" in out["errors"][0]["message"]
+        status, out = _http_post(port, "/query", _SLOW_Q)
+        assert status == 200
+        assert alpha.wait_idle(timeout_s=2.0)
+        health = json.loads(__import__("urllib.request", fromlist=["r"])
+                            .urlopen(f"http://127.0.0.1:{port}/health")
+                            .read())
+        assert health["pendingQueries"] == 0
+    finally:
+        httpd.shutdown()

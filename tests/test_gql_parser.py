@@ -200,3 +200,31 @@ def test_regex_literal_preserves_whitespace():
     assert p.queries[0].func.args[0].value == "Frozen King"
     p = parse('{ q(func: regexp(name, / King/)) { name } }')
     assert p.queries[0].func.args[0].value == " King"
+
+
+def test_regexp_graphql_var_rejects_empty_body():
+    """A regexp supplied via GraphQL variable as "//i" must error, not
+    silently become a match-everything pattern (ADVICE round 5)."""
+    q = ('query q($re: string) '
+         '{ q(func: regexp(name, $re)) { name } }')
+    r = parse(q, variables={"re": "/King/i"})
+    assert r.queries[0].func.args[0].value == "King"
+    with pytest.raises(GQLError, match="empty"):
+        parse(q, variables={"re": "//i"})
+    with pytest.raises(GQLError, match="empty"):
+        parse(q, variables={"re": "//"})
+
+
+def test_graphql_var_keys_strip_one_dollar_and_reject_dupes():
+    """Variable keys strip exactly ONE leading "$" ("$$a" stays "$a");
+    supplying both bare and $-prefixed forms of one name errors
+    instead of winning by dict order (ADVICE round 5)."""
+    q = 'query q($a: string) { q(func: eq(name, $a)) { name } }'
+    r = parse(q, variables={"$a": "Bob"})
+    assert r.queries[0].func.args[0].value == "Bob"
+    with pytest.raises(GQLError, match="duplicate"):
+        parse(q, variables={"$a": "x", "a": "y"})
+    # "$$a" normalizes to the (undeclared) name "$a", NOT to "a": the
+    # declared $a keeps its own supplied value
+    r = parse(q, variables={"$a": "Bob", "$$a": "Evil"})
+    assert r.queries[0].func.args[0].value == "Bob"

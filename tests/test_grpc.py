@@ -66,6 +66,7 @@ def test_check_version(client):
 
 
 def test_grpc_over_tls(tmp_path):
+    pytest.importorskip("cryptography")
     """--tls-dir must cover the gRPC listener too — no cleartext side
     door (review finding)."""
     from dgraph_tpu.server.tls import create_ca, create_pair
@@ -505,3 +506,75 @@ def test_pb_structured_nquads_with_go_binary_values(pbc):
     assert row["pwhen"].startswith("2020-01-02T03:04:05")
     assert row["pbal"] == 11
     assert row["pbal|weight"] == 40
+
+
+@pytest.mark.failpoint
+def test_grpc_deadline_aborts_server_side_and_frees_slot():
+    """A gRPC call timeout rides context.time_remaining() into the
+    executor: the traversal aborts at a level boundary, the status is
+    DEADLINE_EXCEEDED, and the admission slot frees."""
+    import time
+
+    from dgraph_tpu.utils import failpoint
+
+    alpha = AlphaServer(max_pending=2)
+    server, port = serve_grpc(alpha, port=0)
+    c = GrpcClient(f"127.0.0.1:{port}")
+    try:
+        c.alter("gdl_name: string @index(exact) .")
+        c.mutate('_:a <gdl_name> "x" .')
+        failpoint.arm("executor.level", "sleep(0.2)")
+        t0 = time.monotonic()
+        with pytest.raises(grpc.RpcError) as e:
+            c.query('{ q(func: has(gdl_name)) { gdl_name } }',
+                    timeout=0.1)
+        assert e.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        assert time.monotonic() - t0 < 0.5
+        # the server-side cooperative abort released the slot
+        end = time.monotonic() + 2
+        while alpha.pending() and time.monotonic() < end:
+            time.sleep(0.02)
+        assert alpha.pending() == 0
+        failpoint.clear()
+        got = c.query('{ q(func: has(gdl_name)) { gdl_name } }')
+        assert got["data"]["q"] == [{"gdl_name": "x"}]
+    finally:
+        failpoint.clear()
+        c.close()
+        server.stop(0)
+
+
+@pytest.mark.failpoint
+def test_grpc_overload_maps_to_resource_exhausted():
+    import threading
+    import time
+
+    from dgraph_tpu.utils import failpoint
+
+    alpha = AlphaServer(max_pending=1)
+    server, port = serve_grpc(alpha, port=0)
+    c = GrpcClient(f"127.0.0.1:{port}")
+    try:
+        c.alter("gsh_name: string @index(exact) .")
+        c.mutate('_:a <gsh_name> "x" .')
+        failpoint.arm("executor.level", "sleep(0.6)")
+        holder_done = []
+
+        def hold():
+            holder_done.append(
+                c.query('{ q(func: has(gsh_name)) { gsh_name } }'))
+
+        t = threading.Thread(target=hold)
+        t.start()
+        end = time.monotonic() + 5
+        while alpha.pending() < 1 and time.monotonic() < end:
+            time.sleep(0.005)
+        with pytest.raises(grpc.RpcError) as e:
+            c.query('{ q(func: has(gsh_name)) { gsh_name } }')
+        assert e.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        t.join(timeout=10)
+        assert holder_done and holder_done[0]["data"]["q"]
+    finally:
+        failpoint.clear()
+        c.close()
+        server.stop(0)

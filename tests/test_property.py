@@ -8,6 +8,9 @@ change.
 """
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis", reason="hypothesis not in this image")
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

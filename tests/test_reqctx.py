@@ -1,0 +1,277 @@
+"""Request-context plumbing: deadlines, cancellation, failpoints,
+client-side deadline bounds, and federated budget propagation."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from dgraph_tpu import wire
+from dgraph_tpu.cluster.client import ClusterClient
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.utils import failpoint
+from dgraph_tpu.utils.reqctx import (
+    Cancelled, DeadlineExceeded, PROPAGATION_SKEW_S, RequestContext,
+)
+
+
+# ---------------------------------------------------------------- reqctx
+
+def test_reqctx_deadline_expiry_and_remaining():
+    ctx = RequestContext.with_timeout(0.05)
+    assert not ctx.expired
+    rem = ctx.remaining()
+    assert rem is not None and 0 < rem <= 0.05
+    assert ctx.remaining_ms() <= 50
+    time.sleep(0.06)
+    assert ctx.expired
+    assert ctx.remaining() == 0.0
+    with pytest.raises(DeadlineExceeded):
+        ctx.check("test")
+
+
+def test_reqctx_no_deadline_and_cancel():
+    ctx = RequestContext.background(trace_id="t-1")
+    assert ctx.trace_id == "t-1"
+    assert not ctx.expired and ctx.remaining() is None
+    ctx.check()  # no-op
+    ctx.cancel()
+    with pytest.raises(Cancelled):
+        ctx.check("here")
+
+
+def test_reqctx_from_deadline_ms_skew():
+    ctx = RequestContext.from_deadline_ms(100, skew_s=0.5)
+    rem = ctx.remaining()
+    assert 0.5 < rem <= 0.6  # 100ms budget + 500ms skew allowance
+
+
+# ------------------------------------------------------------ failpoints
+
+@pytest.mark.failpoint
+def test_failpoint_sleep_error_and_count_limit():
+    try:
+        failpoint.arm("t.sleep", "sleep(0.05)")
+        t0 = time.monotonic()
+        failpoint.fire("t.sleep")
+        assert time.monotonic() - t0 >= 0.05
+        assert failpoint.hits("t.sleep") == 1
+
+        failpoint.arm("t.err", "2*error(boom)")
+        for _ in range(2):
+            with pytest.raises(failpoint.FailpointError, match="boom"):
+                failpoint.fire("t.err")
+        failpoint.fire("t.err")  # 3rd hit: limit passed, inert
+        assert failpoint.hits("t.err") == 3
+
+        failpoint.arm("t.off", "off")
+        failpoint.fire("t.off")
+        assert failpoint.hits("t.off") == 1
+
+        failpoint.fire("t.unarmed")  # never armed: no-op
+    finally:
+        failpoint.clear()
+    assert failpoint.armed() == []
+
+
+@pytest.mark.failpoint
+def test_failpoint_env_arming_and_bad_spec():
+    try:
+        failpoint.arm_from_env("a.b=sleep(0); c.d=3*error(x)")
+        assert failpoint.armed() == ["a.b", "c.d"]
+    finally:
+        failpoint.clear()
+    with pytest.raises(ValueError):
+        failpoint.arm("bad", "explode(now)")
+
+
+# --------------------------------------------- executor deadline checks
+
+def _chain_db(n=6):
+    db = GraphDB(prefer_device=False)
+    db.alter(schema_text="edge: [uid] .\nname: string @index(exact) .")
+    lines = [f'<{i:#x}> <edge> <{i + 1:#x}> .' for i in range(1, n)]
+    lines += [f'<{i:#x}> <name> "n{i}" .' for i in range(1, n + 1)]
+    db.mutate(set_nquads="\n".join(lines))
+    return db
+
+
+@pytest.mark.failpoint
+def test_executor_deadline_aborts_recurse_mid_flight():
+    db = _chain_db()
+    q = '{ q(func: uid(0x1)) @recurse(depth: 6) { name edge } }'
+    assert db.query(q)["data"]["q"]  # sanity: runs to completion
+    try:
+        # each recurse level stalls 50ms; a 60ms budget dies at the
+        # second level boundary instead of walking all six
+        failpoint.arm("executor.level", "sleep(0.05)")
+        ctx = RequestContext.with_timeout(0.06)
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            db.query(q, ctx=ctx)
+        assert time.monotonic() - t0 < 0.5
+    finally:
+        failpoint.clear()
+
+
+def test_executor_cancellation_aborts_query():
+    db = _chain_db()
+    ctx = RequestContext.background()
+    ctx.cancel()
+    with pytest.raises(Cancelled):
+        db.query('{ q(func: has(name)) { name } }', ctx=ctx)
+
+
+def test_mutation_deadline_refuses_commit():
+    db = _chain_db()
+    expired = RequestContext.with_timeout(0.0)
+    time.sleep(0.001)
+    with pytest.raises(DeadlineExceeded):
+        db.mutate(set_nquads='<0x1> <name> "late" .', ctx=expired)
+    # the abandoned write staged nothing
+    got = db.query('{ q(func: uid(0x1)) { name } }')
+    assert got["data"]["q"] == [{"name": "n1"}]
+
+
+# ---------------------------------------- federated budget propagation
+
+class _StubGroup:
+    """Duck-typed group client recording task RPCs."""
+
+    def __init__(self):
+        self.reqs = []
+        self.deadlines = []
+
+    def request(self, req, deadline_s=None):
+        self.reqs.append(dict(req))
+        self.deadlines.append(deadline_s)
+        if req.get("kind") == "src_uids":
+            return {"ok": True, "result": [1, 2]}
+        return {"ok": True, "result": None}
+
+
+def test_federated_tasks_carry_remaining_budget():
+    from dgraph_tpu.cluster.federated import FederatedDB
+
+    stub = _StubGroup()
+    ctx = RequestContext.with_timeout(2.0, trace_id="fed-1")
+    fdb = FederatedDB({1: stub}, {"name": 1}, "name: string .",
+                      read_ts=1, ctx=ctx)
+    out = fdb.query('{ q(func: has(name)) { uid } }')
+    assert out["data"]["q"] == [{"uid": "0x1"}, {"uid": "0x2"}]
+    assert stub.reqs, "no task RPC issued"
+    for req in stub.reqs:
+        assert 0 < req["deadline_ms"] <= 2000
+        assert req["trace_id"] == "fed-1"
+    # the budget also bounds the coordinator's client-side wait
+    for dl in stub.deadlines:
+        assert dl is not None and 0 < dl <= 2.0
+
+
+def test_federated_task_refused_after_deadline():
+    from dgraph_tpu.cluster.federated import FederatedDB
+
+    stub = _StubGroup()
+    ctx = RequestContext.with_timeout(0.0)
+    time.sleep(0.001)
+    fdb = FederatedDB({1: stub}, {"name": 1}, "name: string .",
+                      read_ts=1, ctx=ctx)
+    with pytest.raises(DeadlineExceeded):
+        fdb.query('{ q(func: has(name)) { uid } }')
+    assert stub.reqs == []  # died before any RPC left the process
+
+
+def test_worker_inherits_budget_with_skew_allowance():
+    from dgraph_tpu.cluster.service import AlphaServer
+
+    ctx = AlphaServer._req_ctx({"deadline_ms": 100, "trace_id": "w-1"})
+    assert ctx.trace_id == "w-1"
+    rem = ctx.remaining()
+    # 100ms budget widened by the skew allowance: the coordinator
+    # times out first, the worker's own abort is the backstop
+    assert 0.1 < rem <= 0.1 + PROPAGATION_SKEW_S
+    assert AlphaServer._req_ctx({"kind": "edges"}) is None
+
+
+# ------------------------------------- client-side deadline (satellite)
+
+def test_client_routed_retry_stops_at_deadline_during_election():
+    """cluster/client.py request(deadline_s=...): with every node
+    answering 'not leader' and no hint (a stuck election), the routed
+    retry loop must give up AT the deadline with a retryable error —
+    not hang, not spin forever."""
+    lst = socket.socket()
+    lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(8)
+    stop = threading.Event()
+
+    def peer(conn):
+        try:
+            while not stop.is_set():
+                wire.read_frame(conn)
+                wire.write_frame(conn, wire.dumps(
+                    {"ok": False, "error": "not leader",
+                     "leader": None}))
+        except (EOFError, OSError, wire.WireError):
+            pass
+        finally:
+            conn.close()
+
+    def accept_loop():
+        while not stop.is_set():
+            try:
+                conn, _ = lst.accept()
+            except OSError:
+                return
+            threading.Thread(target=peer, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    cl = ClusterClient({1: lst.getsockname()}, timeout=30.0)
+    try:
+        t0 = time.monotonic()
+        resp = cl.request({"op": "status"}, deadline_s=0.6)
+        dt = time.monotonic() - t0
+        assert not resp.get("ok")
+        assert resp.get("error") == "no leader reachable"
+        assert 0.5 <= dt < 3.0, f"deadline not honored ({dt:.2f}s)"
+    finally:
+        stop.set()
+        cl.close()
+        lst.close()
+
+
+def test_client_deadline_bounds_stalled_socket_read():
+    """A peer that ACCEPTS the connection then stalls mid-response
+    (SIGSTOP/partition) must not hold a bounded request for the pooled
+    default timeout: the socket wait itself is capped by deadline_s."""
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    stop = threading.Event()
+    held: list = []
+
+    def accept_loop():
+        while not stop.is_set():
+            try:
+                conn, _ = lst.accept()
+            except OSError:
+                return
+            held.append(conn)  # read nothing, answer nothing
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    cl = ClusterClient({1: lst.getsockname()}, timeout=30.0)
+    try:
+        t0 = time.monotonic()
+        resp = cl.request({"op": "status"}, deadline_s=0.5)
+        dt = time.monotonic() - t0
+        assert not resp.get("ok")
+        assert dt < 3.0, f"stalled peer held the client {dt:.2f}s"
+    finally:
+        stop.set()
+        cl.close()
+        for c in held:
+            c.close()
+        lst.close()

@@ -19,6 +19,7 @@ from dgraph_tpu.cli import main as cli_main
 
 
 def test_cert_create_and_ls(tmp_path):
+    pytest.importorskip("cryptography")
     tls_dir = str(tmp_path / "tls")
     assert cli_main(["cert", "create", "--dir", tls_dir,
                      "--client", "admin"]) == 0
@@ -36,6 +37,7 @@ def test_cert_create_and_ls(tmp_path):
 
 
 def test_https_serving(tmp_path):
+    pytest.importorskip("cryptography")
     from dgraph_tpu.server.http import serve
     from dgraph_tpu.server.tls import (
         client_context, create_ca, create_pair, server_context,
@@ -204,6 +206,7 @@ def test_conv_sanitizes_property_names(tmp_path):
 
 
 def test_cert_ls_missing_dir(tmp_path):
+    pytest.importorskip("cryptography")
     out = io.StringIO()
     import contextlib
     with contextlib.redirect_stdout(out):
