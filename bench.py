@@ -44,6 +44,7 @@ round-trip (~120ms measured) per sync; with BENCH_PIPE batches in
 flight that cost amortizes like a serving system's request pipeline.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -140,7 +141,21 @@ def init_backend():
         return jax.devices(), "cpu_fallback"
 
 
+def parse_args():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--pallas", action="store_true",
+        help="route the per-bucket gather-OR through the scalar-"
+             "prefetch Pallas kernel (ops/pallas_kernels."
+             "bucket_or_pallas) instead of the XLA gather path; "
+             "requires the query batch to be a multiple of 4096 so "
+             "the bitmap word axis is 128-lane aligned. Falls back "
+             "to XLA (with a warning) if the pallas build fails.")
+    return ap.parse_args()
+
+
 def main():
+    args = parse_args()
     devs, platform = init_backend()
     on_accel = platform not in ("cpu", "cpu_fallback")
     sys.stderr.write(f"jax devices: {devs} (platform={platform})\n")
@@ -151,7 +166,10 @@ def main():
     sys.stderr.write(f"graph: {len(uniq_src)} srcs, {n_edges} edges "
                      f"({time.time()-t0:.1f}s)\n")
 
-    batch = BATCH if on_accel else 256
+    # CPU runs shrink the batch — except under --pallas, where the
+    # word axis must stay 128-lane aligned (4096 queries) for the
+    # kernel to engage at all (interpret mode, like test_pallas.py)
+    batch = BATCH if on_accel else (4096 if args.pallas else 256)
     pipe = PIPE if on_accel else 1
     runs = RUNS if on_accel else 2
 
@@ -224,11 +242,34 @@ def main():
                      f"({time.time()-t0:.1f}s, "
                      f"{slot_mats[0].nbytes>>10} KiB each)\n")
 
-    digest = make_bfs_digest_batched(badj, core, DEPTH, batch, SEEDS)
+    pallas_on = bool(args.pallas)
+    if pallas_on and ((batch + 31) // 32) % 128 != 0:
+        sys.stderr.write(
+            f"--pallas: batch {batch} gives W={(batch+31)//32} words, "
+            "not 128-lane aligned; pallas kernel will not engage\n")
+        pallas_on = False  # the run measures XLA gathers: it must
+        #                    land in the _pallas_fallback series
+    digest = make_bfs_digest_batched(
+        badj, core, DEPTH, batch, SEEDS, use_pallas=pallas_on,
+        pallas_interpret=None if on_accel else True)
     t0 = time.time()
-    sums0, col0 = digest(slot_mats[0])
-    sums0_np = np.asarray(sums0)
-    sys.stderr.write(f"compile+first batch {time.time()-t0:.1f}s; "
+    try:
+        sums0, col0 = digest(slot_mats[0])
+        sums0_np = np.asarray(sums0)
+    except Exception as e:
+        if not pallas_on:
+            raise
+        # the pallas path is the newer compile path: fall back to the
+        # proven XLA gathers rather than losing the whole run
+        sys.stderr.write(f"pallas digest failed ({e!r}); "
+                         "falling back to XLA gathers\n")
+        pallas_on = False
+        digest = make_bfs_digest_batched(badj, core, DEPTH, batch, SEEDS)
+        t0 = time.time()
+        sums0, col0 = digest(slot_mats[0])
+        sums0_np = np.asarray(sums0)
+    sys.stderr.write(f"compile+first batch {time.time()-t0:.1f}s"
+                     f"{' [pallas]' if pallas_on else ''}; "
                      f"level sums {sums0_np.tolist()}\n")
 
     # parity: per-query final-level counts of queries 0..31, computed
@@ -259,6 +300,13 @@ def main():
                      f"{qps:.0f} QPS\n")
 
     suffix = "" if platform not in ("cpu_fallback",) else "_cpufallback"
+    if pallas_on:
+        suffix += "_pallas"
+    elif args.pallas:
+        # --pallas was requested but the kernel fell back to XLA; the
+        # run also kept the pallas batch sizing, so it must NOT share
+        # a metric name with either the plain or the pallas series
+        suffix += "_pallas_fallback"
     print(json.dumps({
         "metric": f"bfs{DEPTH}_batched_qps_{n_edges//1_000_000}Medges"
                   f"{suffix}",

@@ -1,0 +1,136 @@
+"""Benchmark: brute-force vector similarity top-k (the similar_to()
+data plane, ops/knn.py).
+
+Measures the device tier at serving shape — a query batch scored
+against one resident (n, d) float32 block — for both the exact
+lax.top_k reduction and the TPU-KNN/two-stage approximate path
+(PAPERS.md 2206.14286, 2506.04165), plus the recall@k of the
+approximate stage against exact on the same corpus. The baseline is
+single-query exact numpy (float64 accumulate), the host tier the
+executor falls back to.
+
+Resilience-first like bench.py: probe the backend before the expensive
+corpus build, fall back to CPU, emit ONE structured JSON line (and
+write BENCH_VECTORS.json) even on failure.
+
+Env knobs: BENCH_VEC_N (corpus rows; default 1M on an accelerator,
+100k on CPU), BENCH_VEC_D (dim, default 128), BENCH_VEC_K (default 10),
+BENCH_VEC_BATCH (queries per dispatch, default 256), BENCH_VEC_METRIC.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+DIM = int(os.environ.get("BENCH_VEC_D", 128))
+K = int(os.environ.get("BENCH_VEC_K", 10))
+BATCH = int(os.environ.get("BENCH_VEC_BATCH", 256))
+METRIC = os.environ.get("BENCH_VEC_METRIC", "cosine")
+RUNS = 5
+BASE_RUNS = 8
+
+
+def main():
+    from bench import init_backend
+
+    devs, platform = init_backend()
+    on_accel = platform not in ("cpu", "cpu_fallback")
+    sys.stderr.write(f"jax devices: {devs} (platform={platform})\n")
+    n = int(os.environ.get("BENCH_VEC_N",
+                           1_000_000 if on_accel else 100_000))
+
+    import jax.numpy as jnp
+
+    from dgraph_tpu.ops import knn
+
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    corpus = rng.standard_normal((n, DIM), dtype=np.float32)
+    # queries near real rows so the top-1 is meaningful, not noise
+    rows = rng.integers(0, n, BATCH)
+    queries = corpus[rows] + 0.1 * rng.standard_normal(
+        (BATCH, DIM), dtype=np.float32)
+    sys.stderr.write(f"corpus {n}x{DIM} ({time.time()-t0:.1f}s)\n")
+
+    # host baseline: one query at a time, exact
+    tms = []
+    for i in range(BASE_RUNS):
+        t = time.perf_counter()
+        knn.topk_host(corpus, queries[i:i + 1], K, METRIC)
+        tms.append(time.perf_counter() - t)
+    base_ms = float(np.median(tms)) * 1e3
+    base_qps = 1e3 / base_ms
+    sys.stderr.write(f"host exact p50 {base_ms:.2f} ms/query = "
+                     f"{base_qps:.0f} QPS\n")
+
+    corpus_dev = jnp.asarray(corpus)
+
+    def timed(two_stage):
+        # warm (compile) outside the timing, distinct inputs per timed
+        # run (the remote runtime memoizes identical executions)
+        knn.topk_device(corpus_dev, queries, K, METRIC,
+                        two_stage=two_stage)
+        times = []
+        for r in range(RUNS):
+            qs = queries + np.float32(1e-6 * (r + 1))
+            t = time.perf_counter()
+            knn.topk_device(corpus_dev, qs, K, METRIC,
+                            two_stage=two_stage)
+            times.append(time.perf_counter() - t)
+        ms = float(np.median(times)) * 1e3
+        return BATCH / ms * 1e3
+
+    exact_qps = timed(False)
+    two_stage_ok = knn.can_two_stage(n, K)
+    approx_qps = timed(True) if two_stage_ok else None
+
+    # recall@k of the two-stage path vs exact, same corpus+queries
+    recall = None
+    if two_stage_ok:
+        ei, _ = knn.topk_device(corpus_dev, queries, K, METRIC,
+                                two_stage=False)
+        ai, _ = knn.topk_device(corpus_dev, queries, K, METRIC,
+                                two_stage=True)
+        hits = sum(len(set(ei[b].tolist()) & set(ai[b].tolist()))
+                   for b in range(BATCH))
+        recall = hits / float(BATCH * K)
+    sys.stderr.write(
+        f"device exact {exact_qps:.0f} QPS; two-stage "
+        f"{'%.0f QPS' % approx_qps if approx_qps else 'n/a'}; "
+        f"recall@{K} {recall}\n")
+
+    suffix = "_cpufallback" if platform == "cpu_fallback" else ""
+    out = {
+        "metric": f"similar_to_qps_{n//1000}kx{DIM}{suffix}",
+        "value": round(approx_qps if approx_qps else exact_qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(
+            (approx_qps if approx_qps else exact_qps) / base_qps, 3),
+        "device_exact_qps": round(exact_qps, 1),
+        "device_two_stage_qps": round(approx_qps, 1)
+        if approx_qps else None,
+        "recall_at_k": round(recall, 4) if recall is not None else None,
+        "k": K, "n": n, "dim": DIM, "metric_fn": METRIC,
+        "host_exact_qps": round(base_qps, 1),
+        "platform": platform,
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_VECTORS.json"), "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as exc:
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({"metric": "similar_to_qps", "value": None,
+                          "unit": "qps", "vs_baseline": None,
+                          "error": f"{type(exc).__name__}: {exc}"}))
+        sys.exit(0)

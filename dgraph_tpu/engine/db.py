@@ -471,6 +471,7 @@ class GraphDB:
                            TypeID.BOOL: TypeID.BOOL,
                            TypeID.DATETIME: TypeID.DATETIME,
                            TypeID.GEO: TypeID.GEO,
+                           TypeID.FLOAT32VECTOR: TypeID.FLOAT32VECTOR,
                            }.get(tid, TypeID.DEFAULT)
                 # implicit uid predicates default to LIST (the
                 # reference's schemaless edges are [uid]; only an

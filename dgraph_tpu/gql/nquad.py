@@ -41,6 +41,9 @@ _XS_TYPES = {
     "geo:geojson": TypeID.GEO,
     "xs:password": TypeID.PASSWORD,
     "xs:base64Binary": TypeID.BINARY,
+    # modern Dgraph's vfloat literal: "[0.1, 0.2]"^^<xs:float32vector>
+    "xs:float32vector": TypeID.FLOAT32VECTOR,
+    "float32vector": TypeID.FLOAT32VECTOR,
 }
 
 
@@ -61,6 +64,10 @@ def _coerce(raw: str, tid: TypeID) -> Val:
         import base64
 
         return Val(tid, base64.b64decode(raw))
+    if tid == TypeID.FLOAT32VECTOR:
+        from dgraph_tpu.models.types import parse_vector
+
+        return Val(tid, parse_vector(raw))
     return Val(tid, raw)
 
 

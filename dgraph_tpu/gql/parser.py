@@ -24,7 +24,7 @@ _ROOT_FUNCS = {
     "eq", "le", "lt", "ge", "gt", "between", "has", "uid", "uid_in",
     "anyofterms", "allofterms", "anyoftext", "alloftext", "regexp",
     "match", "near", "within", "contains", "intersects", "type",
-    "anyof", "allof",
+    "anyof", "allof", "similar_to",
 }
 _AGG_FUNCS = {"min", "max", "sum", "avg"}
 # every name _parse_function accepts (root funcs + the filter-capable
@@ -368,9 +368,12 @@ def _parse_function(cur: Cursor, gvars: dict) -> Function:
     while not cur.accept("rparen"):
         t = cur.next()
         if t.kind == "lbracket" and fname in (
-                "near", "within", "contains", "intersects"):
-            # geo coordinate literal: keep the (possibly nested) list
-            # structure as one argument (ref gql/parser.go parseGeoArgs)
+                "near", "within", "contains", "intersects",
+                "similar_to"):
+            # geo coordinate / vector literal: keep the (possibly
+            # nested) list structure as one argument (ref
+            # gql/parser.go parseGeoArgs; similar_to's query vector
+            # may be a bare [0.1, 0.2, ...] literal like Dgraph's)
             fn.args.append(Arg(_parse_coord_list(cur)))
         elif t.kind == "lbracket":
             while not cur.accept("rbracket"):

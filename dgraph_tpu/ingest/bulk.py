@@ -400,7 +400,8 @@ def _tablet_for_bulk(db: GraphDB, pred: str, srcs, vals) -> Tablet:
         elif vals:
             tid = vals[0][1].value.tid
             if tid not in (TypeID.INT, TypeID.FLOAT, TypeID.BOOL,
-                           TypeID.DATETIME, TypeID.GEO):
+                           TypeID.DATETIME, TypeID.GEO,
+                           TypeID.FLOAT32VECTOR):
                 tid = TypeID.DEFAULT
         else:
             tid = TypeID.DEFAULT

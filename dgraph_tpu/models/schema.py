@@ -178,6 +178,13 @@ def _parse_predicate(cur: _Cursor) -> PredicateSchema:
         ps.value_type = type_from_name(v)
     else:
         raise ValueError(f"schema: expected type for {pred}, got {v!r}")
+    if ps.value_type == TypeID.FLOAT32VECTOR and ps.list_:
+        # one embedding per (uid, predicate): the columnar vector store
+        # is a dense (n, d) block, a list would make rows ragged (the
+        # reference's vfloat is likewise non-list)
+        raise ValueError(
+            f"[float32vector] is not supported for {pred!r}; vector "
+            "predicates hold one embedding per uid")
     while cur.peek()[0] == "at":
         cur.next()
         directive = cur.expect("word")

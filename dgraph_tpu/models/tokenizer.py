@@ -153,6 +153,13 @@ _register("month", 0xA, TypeID.DATETIME, True, True, month_tokens)
 _register("day", 0xB, TypeID.DATETIME, True, True, day_tokens)
 _register("hour", 0xC, TypeID.DATETIME, True, True, hour_tokens)
 _register("geo", 0xD, TypeID.GEO, False, True, geo_tokens)
+# `@index(vector)` marks a float32vector predicate as similarity-
+# searchable. Unlike every other tokenizer it emits NO index tokens:
+# the "index" is the per-predicate columnar vector block
+# (storage/vecstore.py) scored by brute-force MIPS (ops/knn.py), the
+# TPU-KNN formulation — token posting lists have no role.
+_register("vector", 0xE, TypeID.FLOAT32VECTOR, False, True,
+          lambda v: [])
 
 
 # Identifier bytes >= 0x80 are reserved for custom tokenizers (ref
@@ -238,6 +245,8 @@ def default_tokenizer_for(tid: TypeID) -> TokenizerSpec | None:
         TypeID.GEO: _REGISTRY["geo"],
         TypeID.STRING: None,  # string requires an explicit tokenizer choice
         TypeID.DEFAULT: None,
+        # `@index` on a vector predicate must spell @index(vector)
+        TypeID.FLOAT32VECTOR: None,
     }.get(tid)
 
 

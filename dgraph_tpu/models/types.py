@@ -31,6 +31,12 @@ class TypeID(enum.IntEnum):
     UID = 7
     PASSWORD = 8
     STRING = 9
+    # Forward-port of modern Dgraph's vfloat (pb.Posting_VFLOAT = 10):
+    # a dense float32 embedding; the payload is a numpy float32 array.
+    # Vectors are the one value type whose *data* plane lives on device
+    # (storage/vecstore.py packs per-predicate (n, d) blocks; ops/knn.py
+    # scores them) — host-side they only parse, convert, and emit.
+    FLOAT32VECTOR = 10
 
 
 _NAME_TO_TYPE = {
@@ -44,6 +50,7 @@ _NAME_TO_TYPE = {
     "uid": TypeID.UID,
     "password": TypeID.PASSWORD,
     "string": TypeID.STRING,
+    "float32vector": TypeID.FLOAT32VECTOR,
 }
 _TYPE_TO_NAME = {v: k for k, v in _NAME_TO_TYPE.items()}
 # parse-only alias: the reference's schemas spell it `dateTime`
@@ -132,6 +139,42 @@ def parse_datetime(s: str) -> _dt.datetime:
     raise ValueError(f"cannot parse {s!r} as datetime")
 
 
+def parse_vector(raw) -> "np.ndarray":
+    """`"[0.1, 0.2, ...]"` literal (or a list/array) -> float32 array.
+    Mirrors modern Dgraph's vfloat literal form (types/conversion.go
+    ParseVFloat): square brackets, comma or whitespace separated."""
+    import numpy as np
+
+    if isinstance(raw, np.ndarray):
+        arr = np.asarray(raw, dtype=np.float32)
+    elif isinstance(raw, (list, tuple)):
+        arr = np.asarray([float(x) for x in raw], dtype=np.float32)
+    else:
+        s = str(raw).strip()
+        if s.startswith("[") and s.endswith("]"):
+            s = s[1:-1]
+        parts = s.replace(",", " ").split()
+        if not parts:
+            raise ValueError(f"empty float32vector literal {raw!r}")
+        arr = np.asarray([float(p) for p in parts], dtype=np.float32)
+    if arr.ndim != 1 or not len(arr):
+        raise ValueError(f"float32vector must be a non-empty 1-D list, "
+                         f"got {raw!r}")
+    if not np.isfinite(arr).all():
+        raise ValueError("float32vector must be finite")
+    return arr
+
+
+def vector_value(v: Val) -> "np.ndarray":
+    """The float32 array behind a FLOAT32VECTOR Val (parses lazily if a
+    string literal slipped through unconverted)."""
+    import numpy as np
+
+    if isinstance(v.value, np.ndarray):
+        return v.value
+    return parse_vector(v.value)
+
+
 def convert(v: Val, to: TypeID) -> Val:
     """Type conversion matrix. Ref: types.Convert (types/conversion.go:36).
 
@@ -186,6 +229,9 @@ def convert(v: Val, to: TypeID) -> Val:
             return Val(to, _to_string(v).encode())
         if to == TypeID.GEO and v.tid in (TypeID.STRING, TypeID.DEFAULT):
             return Val(to, json.loads(str(val)))
+        if to == TypeID.FLOAT32VECTOR \
+                and v.tid in (TypeID.STRING, TypeID.DEFAULT):
+            return Val(to, parse_vector(val))
     except (ValueError, TypeError) as e:
         raise ValueError(
             f"cannot convert {type_name(v.tid)} {val!r} to {type_name(to)}"
@@ -194,6 +240,11 @@ def convert(v: Val, to: TypeID) -> Val:
 
 
 def _to_string(v: Val) -> str:
+    if v.tid == TypeID.FLOAT32VECTOR:
+        # repr(float32-upcast) round-trips exactly, so the string is a
+        # stable identity for fingerprints/conflict keys
+        return "[%s]" % ", ".join(
+            repr(float(x)) for x in vector_value(v))
     if v.tid == TypeID.DATETIME:
         return v.value.strftime(_RFC3339)
     if v.tid == TypeID.BOOL:
@@ -220,6 +271,8 @@ def to_json_value(v: Val) -> Any:
     query/outputnode.go fastJsonNode valToBytes)."""
     if v.tid == TypeID.DATETIME:
         return iso8601(v.value)
+    if v.tid == TypeID.FLOAT32VECTOR:
+        return [float(x) for x in vector_value(v)]
     if v.tid in (TypeID.INT, TypeID.FLOAT, TypeID.BOOL, TypeID.GEO):
         return v.value
     if v.tid == TypeID.BINARY:

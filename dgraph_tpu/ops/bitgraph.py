@@ -530,7 +530,10 @@ def uid_lists_to_seed_slots(badj: BitAdjacency,
 
 def make_bfs_digest_batched(badj: BitAdjacency, core: CoreAdjacency,
                             depth: int, n_queries: int,
-                            n_seeds: int) -> Callable:
+                            n_seeds: int,
+                            use_pallas: bool | None = None,
+                            pallas_interpret: bool | None = None
+                            ) -> Callable:
     """Compile the serving-shape BFS: int32[B, S] seed slots ->
     (uint32[depth] per-level popcount checksums,
      uint32[n_core+1, 1] final level's first word column).
@@ -547,6 +550,18 @@ def make_bfs_digest_batched(badj: BitAdjacency, core: CoreAdjacency,
     without pulling a full bitmap."""
     N, ncov = badj.n_slots, badj.n_covered
     W = (n_queries + 31) // 32
+    # same opt-in convention as make_bfs_bits_batched: None -> XLA;
+    # callers that enable pallas own warmup + fallback (bench.py
+    # --pallas does). The pallas kernel needs lane-aligned W.
+    if use_pallas is None:
+        use_pallas = False
+
+    def gather_or(f, b):
+        if use_pallas and f.shape[1] % 128 == 0:
+            from dgraph_tpu.ops.pallas_kernels import bucket_or_pallas
+            return bucket_or_pallas(f, b.in_nb,
+                                    interpret=pallas_interpret)
+        return _gather_or(f, b.in_nb, b.degree)
 
     def digest(seed_slots: jax.Array):
         q = jnp.arange(n_queries, dtype=jnp.uint32)
@@ -558,8 +573,7 @@ def make_bfs_digest_batched(badj: BitAdjacency, core: CoreAdjacency,
         f = f.at[N].set(jnp.uint32(0))   # dummy slot absorbs padding
         zrow = jnp.zeros((1, W), jnp.uint32)
         if badj.buckets:
-            parts = [_gather_or(f, b.in_nb, b.degree)
-                     for b in badj.buckets]
+            parts = [gather_or(f, b) for b in badj.buckets]
             reach1 = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
         else:
             reach1 = jnp.zeros((ncov, W), jnp.uint32)
@@ -572,8 +586,7 @@ def make_bfs_digest_batched(badj: BitAdjacency, core: CoreAdjacency,
         frontier = jnp.concatenate([new[core.row_slots], zrow])
         visited = jnp.concatenate([vis_s[core.row_slots], zrow])
         for _ in range(depth - 1):
-            parts = [_gather_or(frontier, b.in_nb, b.degree)
-                     for b in core.buckets]
+            parts = [gather_or(frontier, b) for b in core.buckets]
             reach = jnp.concatenate(parts + [zrow])
             frontier = reach & ~visited
             visited = visited | frontier

@@ -112,4 +112,47 @@ def bucket_or_pallas(f: jax.Array, in_nb: jax.Array,
     return dispatch(in_nb)
 
 
+# -- MIPS scoring tile kernel (ops/knn.py similar_to data plane) -------------
+
+# corpus rows per MXU tile: (SCORE_TILE_N, d) corpus block + (b, d)
+# queries + (b, SCORE_TILE_N) out must fit VMEM; at d = 1024 f32 this
+# is ~2.5 MiB, comfortably inside the ~16 MiB/core budget
+SCORE_TILE_N = 512
+
+
+def score_dot_pallas(corpus: jax.Array, queries: jax.Array,
+                     interpret: bool | None = None) -> jax.Array:
+    """Tiled (b, d) x (d, n) -> (b, n) float32 dot scores on the MXU:
+    grid over n-axis tiles, each step DMAs one (TILE, d) corpus block
+    HBM->VMEM, the queries stay resident, one jnp.dot per tile. This is
+    the TPU-KNN scoring matmul written as an explicit Pallas pipeline
+    (pallas_guide: Grid and Block Specifications); the XLA path in
+    ops/knn._score_device emits the same contraction — callers opt in
+    via use_pallas (same convention as bucket_or_pallas)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, d = corpus.shape
+    b = queries.shape[0]
+    if n % SCORE_TILE_N != 0:
+        raise ValueError(
+            f"corpus rows {n} must be a multiple of {SCORE_TILE_N} "
+            "(ops/knn pads)")
+
+    def kernel(c_ref, q_ref, out_ref):
+        out_ref[...] = jnp.dot(q_ref[...], c_ref[...].T,
+                               preferred_element_type=jnp.float32)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n // SCORE_TILE_N,),
+        in_specs=[
+            pl.BlockSpec((SCORE_TILE_N, d), lambda i: (i, 0)),
+            pl.BlockSpec((b, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((b, SCORE_TILE_N), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((b, n), jnp.float32),
+        interpret=_INTERPRET_ON if interpret else False,
+    )(corpus, queries)
+
+
 

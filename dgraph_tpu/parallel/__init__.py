@@ -21,3 +21,4 @@ from dgraph_tpu.parallel.dist_graph import (
     RingAdjacency, ShardedAdjacency, build_ring_adjacency,
     build_sharded_adjacency, make_ring_bfs, make_sharded_bfs,
 )
+from dgraph_tpu.parallel.dist_knn import shard_corpus, sharded_topk

@@ -1,0 +1,86 @@
+"""Mesh-sharded brute-force top-k (similar_to at multi-chip scale).
+
+The vector analogue of parallel/dist_graph.py: a predicate's (n, d)
+embedding block is row-sharded over the mesh's `uid` axis (the same
+axis that shards one predicate's adjacency), one shard_map step does
+
+    local:  scores = q @ local_rows.T  ->  lax.top_k(k) per shard
+    ICI:    all_gather the per-shard (vals, global row idx) candidates
+    local:  exact lax.top_k over the S*k candidates (replicated)
+
+which is the TPU-KNN multi-chip layout (PAPERS.md 2206.14286 §4:
+shard the database, per-shard partial top-k, tree-merge) mapped onto
+the repo's mesh conventions. The final merge with MVCC overlay rows
+happens on host via ops/knn.merge_topk.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dgraph_tpu.parallel.compat import shard_map
+from dgraph_tpu.ops import knn
+
+
+def _axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def shard_corpus(mesh: Mesh, corpus: np.ndarray, axis: str = "uid"):
+    """Pad the row axis to the shard count and place the block over
+    `axis`. Returns (device array, n_real)."""
+    s = _axis_size(mesh, axis)
+    n, d = corpus.shape
+    per = max(knn.BUCKET_SIZE, -(-n // s))
+    n_pad = per * s
+    if n_pad != n:
+        corpus = np.concatenate(
+            [corpus, np.zeros((n_pad - n, d), np.float32)])
+    arr = jnp.asarray(corpus, jnp.float32)
+    spec = NamedSharding(mesh, P(axis, None))
+    return jax.device_put(arr, spec), n
+
+def sharded_topk(mesh: Mesh, corpus_dev, queries: np.ndarray, k: int,
+                 metric: str = "cosine",
+                 mask: np.ndarray | None = None,
+                 n_real: int | None = None,
+                 axis: str = "uid") -> tuple[np.ndarray, np.ndarray]:
+    """Per-shard top-k + on-device merge. corpus_dev is the padded,
+    sharded block from shard_corpus; returns host (idx (q, k'), scores
+    (q, k')) with idx into the UNPADDED row axis (entries whose score
+    is -inf are padding and must be dropped by the caller)."""
+    n_pad, d = corpus_dev.shape
+    s = _axis_size(mesh, axis)
+    per = n_pad // s
+    if n_real is None:
+        n_real = n_pad
+    q = jnp.atleast_2d(jnp.asarray(queries, jnp.float32))
+    m = np.zeros(n_pad, bool)
+    m[:n_real] = True if mask is None else np.asarray(mask, bool)
+    mask_dev = jax.device_put(jnp.asarray(m),
+                              NamedSharding(mesh, P(axis)))
+    k_eff = min(k, per)
+
+    def step(rows, qm, keep):
+        scores = knn._score_device(rows, qm, metric, False, None)
+        scores = jnp.where(keep[None, :], scores, -jnp.inf)
+        vals, idx = jax.lax.top_k(scores, k_eff)       # (q, k) local
+        shard = jax.lax.axis_index(axis)
+        gidx = idx + shard * per
+        av = jax.lax.all_gather(vals, axis, axis=1, tiled=True)
+        ai = jax.lax.all_gather(gidx, axis, axis=1, tiled=True)
+        fvals, fpos = jax.lax.top_k(av, min(k, av.shape[1]))
+        fidx = jnp.take_along_axis(ai, fpos, axis=1)
+        return fvals, fidx
+
+    smapped = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(axis, None), P(None, None), P(axis)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False)
+    vals, idx = jax.jit(smapped)(corpus_dev, q, mask_dev)
+    return np.asarray(idx, np.int64), np.asarray(vals)

@@ -52,6 +52,16 @@ _EMPTY = np.empty(0, dtype=np.uint64)
 _FLAT_TYPES = {TypeID.INT, TypeID.FLOAT, TypeID.BOOL, TypeID.STRING,
                TypeID.DEFAULT, TypeID.DATETIME}
 
+# value variable a similar_to() root/filter binds its per-uid scores
+# to, readable as val(similar_to_score) (see _eval_similar_to)
+SIMILAR_SCORE_VAR = "similar_to_score"
+
+
+def _member_of(uids: np.ndarray, sorted_set: np.ndarray) -> np.ndarray:
+    """Bool mask: which of `uids` appear in the sorted-unique set
+    (the hit-mask half of _col_positions)."""
+    return _col_positions(sorted_set, uids)[1]
+
 
 def _col_positions(srcs: np.ndarray, uids: np.ndarray):
     """Membership of `uids` in a sorted column: (pos, hit mask)."""
@@ -321,6 +331,9 @@ class Executor:
         self.uid_vars: dict[str, np.ndarray] = {}
         self.value_vars: dict[str, dict[int, Val]] = {}
         self._path_var_order: dict[str, list[int]] = {}
+        # score-descending uid order of the current block's similar_to
+        # root, set by _eval_similar_to and consumed at pagination
+        self._similar_order: Optional[list[int]] = None
 
     def _checkpoint(self, where: str):
         """Block/level boundary: the `executor.level` failpoint (chaos
@@ -344,6 +357,7 @@ class Executor:
         — the reference ranks ToJson a top-5 hot loop) and pick the
         columnar fast path."""
         self.parsed = parsed
+        self._check_similar_score_ambiguity(parsed)
         blocks = list(parsed.queries)
         done: list[tuple[GraphQuery, ExecNode]] = []
         pending = blocks
@@ -365,6 +379,54 @@ class Executor:
                     f"circular or undefined variable dependency: {missing}")
             pending = still
         return done
+
+    def _check_similar_score_ambiguity(self, parsed: ParsedResult):
+        """`similar_to_score` is ONE binding per request; with several
+        similar_to calls the last evaluation would clobber the others
+        and any val(similar_to_score) reader would silently get the
+        wrong call's scores. Reject the combination up front."""
+        count = 0
+        reads = False
+
+        def walk_filter(ft):
+            nonlocal count, reads
+            if ft is None:
+                return
+            if ft.func is not None:
+                if ft.func.name == "similar_to":
+                    count += 1
+                if any(vc.name == SIMILAR_SCORE_VAR
+                       for vc in ft.func.needs_var):
+                    reads = True
+            for c in ft.children:
+                walk_filter(c)
+
+        def walk(gq):
+            nonlocal count, reads
+            if gq.func is not None:
+                if gq.func.name == "similar_to":
+                    count += 1
+                if any(vc.name == SIMILAR_SCORE_VAR
+                       for vc in gq.func.needs_var):
+                    reads = True
+            if any(vc.name == SIMILAR_SCORE_VAR
+                   for vc in gq.needs_var):
+                reads = True
+            if any(o.attr == f"val({SIMILAR_SCORE_VAR})"
+                   for o in gq.order):
+                reads = True
+            walk_filter(gq.filter)
+            for c in gq.children:
+                walk(c)
+
+        for q in parsed.queries:
+            walk(q)
+        if count > 1 and reads:
+            raise GQLError(
+                f"val({SIMILAR_SCORE_VAR}) is ambiguous with "
+                f"{count} similar_to calls in one request; split the "
+                "query so each score reader has exactly one "
+                "similar_to")
 
     def emit(self, done) -> dict[str, Any]:
         out: dict[str, Any] = {}
@@ -420,7 +482,10 @@ class Executor:
 
         gq = node.gq
         if (gq.recurse is not None or gq.is_groupby or gq.normalize
-                or gq.cascade or gq.ignore_reflex or not node.children):
+                or gq.cascade or gq.ignore_reflex or not node.children
+                or node.emit_order is not None):
+            # emit_order (path vars, similar_to score order) reorders
+            # rows; the columnar emitter walks dest uid-ascending
             return None
         uids = node.dest
         n = len(uids)
@@ -492,10 +557,21 @@ class Executor:
         as weight) sumw: sum(val(L1))` in one block)."""
         if gq.var:
             yield gq.var
+        if (gq.func is not None and gq.func.name == "similar_to") \
+                or (gq.filter is not None
+                    and self._filter_has_similar(gq.filter)):
+            # running the block binds the score var — consumers inside
+            # the block (or later blocks, via the retry rounds) see it
+            yield SIMILAR_SCORE_VAR
         for varname in gq.facet_var.values():
             yield varname
         for c in gq.children:
             yield from self._provides(c)
+
+    def _filter_has_similar(self, ft: FilterTree) -> bool:
+        if ft.func is not None and ft.func.name == "similar_to":
+            return True
+        return any(self._filter_has_similar(c) for c in ft.children)
 
     def _vars_ready(self, gq: GraphQuery) -> bool:
         own = set(self._provides(gq))
@@ -520,12 +596,16 @@ class Executor:
         if gq.attr == "shortest":
             self._run_shortest(node)
             return node
+        self._similar_order = None
         root = self._device_root_count_page(gq)
         if root is None:
             root = self._root_uids(gq)
             if gq.filter is not None:
                 root = self._eval_filter(gq.filter, root)
-            root = self._order_paginate(gq, root)
+            if self._similar_order is not None and not gq.order:
+                root = self._similar_paginate(gq, root, node)
+            else:
+                root = self._order_paginate(gq, root)
         if not gq.order and gq.func is not None \
                 and gq.func.name == "uid" and len(gq.func.needs_var) == 1:
             ordered = self._path_var_order.get(
@@ -555,6 +635,29 @@ class Executor:
                 # cascade.
                 self._cascade_rebind_vars(node)
         return node
+
+    def _similar_paginate(self, gq: GraphQuery, root: np.ndarray,
+                          node: ExecNode) -> np.ndarray:
+        """similar_to roots emit nearest-first (score-descending, ties
+        by uid — the order Dgraph's similar_to returns); pagination
+        windows therefore cut in SCORE space. Only the emission
+        reorders — node.dest stays uid-sorted, the searchsorted
+        invariant of every columnar consumer (same split as path
+        vars)."""
+        inset = set(root.tolist())
+        ordered = [u for u in self._similar_order if u in inset]
+        if gq.after:
+            try:
+                ordered = ordered[ordered.index(gq.after) + 1:]
+            except ValueError:
+                pass
+        if gq.offset:
+            ordered = ordered[gq.offset:]
+        if gq.first is not None:
+            ordered = ordered[:gq.first] if gq.first >= 0 \
+                else ordered[gq.first:]
+        node.emit_order = ordered
+        return _np_sorted(ordered)
 
     def _root_uids(self, gq: GraphQuery) -> np.ndarray:
         uids = _EMPTY
@@ -676,7 +779,160 @@ class Executor:
             return self._eval_checkpwd(fn, candidates)
         if name in ("near", "within", "contains", "intersects"):
             return self._eval_geo(fn, candidates)
+        if name == "similar_to":
+            return self._eval_similar_to(fn, candidates)
         raise GQLError(f"function {name!r} not supported")
+
+    def _eval_similar_to(self, fn: Function, candidates) -> np.ndarray:
+        """similar_to(embedding, k, $vec[, metric]): the k uids whose
+        stored float32vector scores closest to the query vector
+        (forward-port of modern Dgraph's similar_to onto the v1.1.x
+        surface). Scoring is brute-force MIPS over the predicate's
+        columnar vector block (ops/knn.py, TPU-KNN formulation):
+        device tier with the two-stage approximate top-k when the
+        block is resident-sized, mesh-sharded per-shard top-k + k-way
+        merge above shard_min_edges, exact numpy otherwise. MVCC
+        overlay rows are scored host-side and merged, so reads at any
+        ts see exactly their snapshot. Scores land in the
+        `similar_to_score` value variable (val(similar_to_score))."""
+        from dgraph_tpu.models.types import parse_vector
+        from dgraph_tpu.ops import knn as _knn
+
+        tab = self._tablet(fn.attr)
+        schema = tab.schema if tab is not None \
+            else self.db.schema.get(fn.attr)
+        if schema is None:
+            raise GQLError(
+                f"predicate {fn.attr!r} is not in the schema")
+        if schema.value_type != TypeID.FLOAT32VECTOR:
+            raise GQLError(
+                f"similar_to requires a float32vector predicate; "
+                f"{fn.attr!r} is {type_name(schema.value_type)}")
+        if candidates is None and not (
+                schema.indexed and "vector" in schema.tokenizers):
+            # root similar_to needs @index(vector), a schema property
+            # whether or not data exists (same contract as root eq)
+            raise GQLError(
+                f"predicate {fn.attr!r} needs @index(vector) for "
+                "similar_to at the query root")
+        if len(fn.args) < 2:
+            raise GQLError(
+                "similar_to(pred, k, vector) needs a k and a query "
+                "vector")
+        try:
+            k = int(str(fn.args[0].value), 0)
+        except ValueError:
+            raise GQLError(
+                f"similar_to k must be an integer, got "
+                f"{fn.args[0].value!r}")
+        if k < 1:
+            raise GQLError("similar_to k must be >= 1")
+        try:
+            qvec = parse_vector(fn.args[1].value)
+        except (ValueError, TypeError) as e:
+            raise GQLError(f"bad similar_to query vector: {e}")
+        metric = "cosine"
+        if len(fn.args) > 2:
+            metric = str(fn.args[2].value).lower()
+            if metric not in _knn.METRICS:
+                raise GQLError(
+                    f"similar_to metric must be one of "
+                    f"{'/'.join(_knn.METRICS)}, got {metric!r}")
+        if tab is None:
+            return _EMPTY
+        if not hasattr(tab, "vector_view"):
+            # federated RemoteTablet proxy: the embedding block lives
+            # on another group and brute-force scoring must run where
+            # the data is — keep the vector predicate co-located with
+            # the querying group (clean error, not an AttributeError)
+            raise GQLError(
+                f"similar_to on {fn.attr!r} requires the vector "
+                "predicate to be served by this group (cross-group "
+                "vector search is not supported)")
+        try:
+            view = tab.vector_view(self.read_ts)
+        except ValueError as e:
+            raise GQLError(str(e))
+        if view.dim and len(qvec) != view.dim:
+            raise GQLError(
+                f"similar_to query vector has dimension {len(qvec)}; "
+                f"predicate {fn.attr!r} stores dimension {view.dim}")
+
+        base_mask = view.base_keep
+        ex_uids, ex_vecs = view.extra_uids, view.extra_vecs
+        if candidates is not None:
+            base_mask = base_mask & _member_of(view.base_uids,
+                                               candidates)
+            exm = _member_of(ex_uids, candidates)
+            ex_uids, ex_vecs = ex_uids[exm], ex_vecs[exm]
+        parts: list = []
+        n = len(view.base_uids)
+        if n and base_mask.any():
+            qm = qvec[None, :]
+            if self.db.mesh is not None \
+                    and n >= self.db.shard_min_edges:
+                idx, sc = self._sharded_vec_topk(tab, view, qm, k,
+                                                 metric, base_mask)
+            elif self.db.prefer_device \
+                    and n >= self.db.device_min_edges:
+                idx, sc = _knn.topk_device(
+                    self._device_vec_block(tab, view), qm, k, metric,
+                    mask=base_mask, n_real=n)
+                inc_counter("query_similar_device_total")
+            else:
+                idx, sc = _knn.topk_host(view.base_vecs, qm, k,
+                                         metric, mask=base_mask)
+            row, s = idx[0], sc[0]
+            ok = np.isfinite(s) & (row < n)
+            parts.append((view.base_uids[row[ok]], s[ok]))
+        if len(ex_uids):
+            idx, sc = _knn.topk_host(ex_vecs, qvec[None, :], k, metric)
+            row, s = idx[0], sc[0]
+            ok = np.isfinite(s)
+            parts.append((ex_uids[row[ok]], s[ok]))
+        uids, scores = _knn.merge_topk(parts, k)
+        self.value_vars[SIMILAR_SCORE_VAR] = {
+            int(u): Val(TypeID.FLOAT, float(s))
+            for u, s in zip(uids.tolist(), scores.tolist())}
+        if candidates is None:
+            # root: the block emits nearest-first (_similar_paginate)
+            self._similar_order = [int(u) for u in uids.tolist()]
+        return np.sort(uids.astype(np.uint64))
+
+    def _device_vec_block(self, tab, view):
+        """The base vector block as a device array, cached per base_ts
+        exactly like the adjacency tiles (_device_adj). Pre-padded to
+        the bucket unit HOST-SIDE so topk_device never re-copies the
+        block per query."""
+        from dgraph_tpu.ops import knn as _knn
+
+        cached = getattr(tab, "_device_vecs", None)
+        if cached is not None and cached[0] == tab.base_ts:
+            return cached[1]
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(_knn.pad_rows(view.base_vecs))
+        tab._device_vecs = (tab.base_ts, arr)
+        return arr
+
+    def _sharded_vec_topk(self, tab, view, qm, k, metric, base_mask):
+        """Mesh-sharded scoring: the block rides the `uid` axis, each
+        shard computes a local top-k, one all_gather merges
+        (parallel/dist_knn.py)."""
+        from dgraph_tpu.parallel.dist_knn import (
+            shard_corpus, sharded_topk,
+        )
+
+        mesh = self.db.mesh
+        cached = getattr(tab, "_device_vecs_sharded", None)
+        if cached is not None and cached[0] == tab.base_ts:
+            block, n_real = cached[1], cached[2]
+        else:
+            block, n_real = shard_corpus(mesh, view.base_vecs)
+            tab._device_vecs_sharded = (tab.base_ts, block, n_real)
+        inc_counter("query_similar_sharded_total")
+        return sharded_topk(mesh, block, qm, k, metric,
+                            mask=base_mask, n_real=n_real)
 
     def _eval_geo(self, fn: Function, candidates) -> np.ndarray:
         """near/within/contains/intersects: geo-cell index prefilter +
@@ -3207,6 +3463,30 @@ class Executor:
             self._cascade_edge_cache[key] = got
         return got
 
+    def _cascade_table(self, c: ExecNode):
+        """Flat (parent_keys sorted, child_uids) columnar edge table in
+        the child's direction for a CLEAN tablet — the same
+        searchsorted join surface _join_codes consumes — or None
+        (dirty tablets keep the exact per-uid MVCC loop). Reverse
+        children pay one lexsort to flip the forward table; cached for
+        the cascade pass like the per-parent edge lists."""
+        key = ("table", id(c))
+        got = self._cascade_edge_cache.get(key, False)
+        if got is not False:
+            return got
+        et = c.tablet.edge_table(self.read_ts) \
+            if hasattr(c.tablet, "edge_table") else None
+        out = None
+        if et is not None:
+            srcs, dsts = et
+            if c.reverse:
+                order = np.argsort(dsts, kind="stable")
+                out = (dsts[order], srcs[order])
+            else:
+                out = (srcs, dsts)
+        self._cascade_edge_cache[key] = out
+        return out
+
     def _cascade_descend(self, node: ExecNode, alive: np.ndarray,
                          memo: dict):
         for c in node.children:
@@ -3217,11 +3497,20 @@ class Executor:
             if c.tablet is None or c.gq.is_count:
                 continue
             if c.tablet.schema.value_type == TypeID.UID or c.reverse:
-                parts = [self._cascade_edges(c, int(p))
-                         for p in alive.tolist()]
-                parts = [p for p in parts if len(p)]
-                reach = np.unique(np.concatenate(parts)) if parts \
-                    else _EMPTY
+                table = self._cascade_table(c)
+                if table is not None and len(alive):
+                    # columnar: gather every edge of the surviving
+                    # parents with ONE searchsorted join (_join_codes)
+                    # instead of a per-parent edge-fetch loop
+                    got = _join_codes(table[0], table[1], alive)
+                    reach = np.unique(got[1]) if got is not None \
+                        else _EMPTY
+                else:
+                    parts = [self._cascade_edges(c, int(p))
+                             for p in alive.tolist()]
+                    parts = [p for p in parts if len(p)]
+                    reach = np.unique(np.concatenate(parts)) if parts \
+                        else _EMPTY
                 alive_c = _intersect(
                     _intersect(reach, c.dest),
                     self._cascade_keep(c, memo))
@@ -3253,11 +3542,25 @@ class Executor:
             if c.tablet.schema.value_type == TypeID.UID or c.reverse:
                 sub = self._cascade_keep(c, memo) if c.children \
                     else c.dest
-                keep = np.asarray(
-                    [u for u in keep.tolist()
-                     if len(_intersect(
-                         self._cascade_edges(c, int(u)), sub))],
-                    dtype=np.uint64)
+                table = self._cascade_table(c)
+                if table is not None:
+                    # columnar: one searchsorted join gathers every
+                    # parent's edges, one membership test against
+                    # `sub` keeps parents with >= 1 surviving edge —
+                    # no per-(child, parent) Python loop
+                    got = _join_codes(table[0], table[1], keep)
+                    ok = np.zeros(len(keep), bool)
+                    if got is not None and len(sub):
+                        rep, gathered = got
+                        hit = _member_of(gathered, sub)
+                        ok[rep[hit]] = True
+                    keep = keep[ok]
+                else:
+                    keep = np.asarray(
+                        [u for u in keep.tolist()
+                         if len(_intersect(
+                             self._cascade_edges(c, int(u)), sub))],
+                        dtype=np.uint64)
             else:
                 keep = np.asarray(
                     [u for u in keep.tolist()

@@ -970,6 +970,16 @@ class Tablet:
                 int(u): np.unique(srcs[bounds[i]:bounds[i + 1]])
                 for i, u in enumerate(uniq)}
 
+    # -- columnar vector block (float32vector predicates) --
+
+    def vector_view(self, read_ts: int):
+        """Dense (n, d) float32 view of this predicate's embeddings at
+        read_ts: packed base block (cached per base_ts, device-
+        cacheable) + MVCC overlay side rows. See storage/vecstore.py;
+        ops/knn.py consumes it for similar_to()."""
+        from dgraph_tpu.storage.vecstore import vector_view
+        return vector_view(self, read_ts)
+
     # -- sortable keys for device values --
 
     def sort_key_arrays(self, lang: str = ""):

@@ -1,0 +1,153 @@
+"""Per-predicate columnar vector store.
+
+The vector analogue of Tablet.value_columns: a float32vector
+predicate's embeddings packed into one dense (n, d) float32 block
+aligned to a sorted uid row map, built from the tablet's BASE state and
+cached per (base_ts, schema) — exactly the contract the device tiles
+and columnar views follow (storage/tablet.py value_columns,
+engine/device_cache.py).
+
+MVCC overlay semantics match the posting-list reads: the base block
+answers every row the overlay does NOT touch at read_ts; overlay-
+touched uids (Tablet.overlay_srcs) are masked out of the base block and
+re-read through the exact MVCC path (get_postings at read_ts) into a
+small side block. ops/knn.py scores base and overlay rows and merges
+their top-k, so a mutation is visible at its commit_ts and invisible
+below it without ever rebuilding the big block.
+
+Ref: modern Dgraph's vector index attaches to the posting list the same
+way (posting/index.go vector index entries); here the "index" IS the
+brute-force block, per TPU-KNN (PAPERS.md 2206.14286) — at peak matmul
+throughput brute-force beats pointer-chasing structures on this
+hardware.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from dgraph_tpu.models.types import TypeID, vector_value
+
+_EMPTY_U64 = np.empty(0, dtype=np.uint64)
+
+
+@dataclass
+class VecView:
+    """One read-timestamp's view of a vector tablet.
+
+    base_uids/base_vecs are the packed BASE block (stable per base_ts —
+    safe to keep device-resident); base_keep masks off rows the overlay
+    touches at this read_ts. extra_uids/extra_vecs are the overlay-
+    visible rows, read through MVCC at read_ts.
+    """
+
+    dim: int
+    base_uids: np.ndarray       # [n] uint64 sorted
+    base_vecs: np.ndarray       # [n, d] float32, C-contiguous
+    base_keep: np.ndarray       # [n] bool
+    extra_uids: np.ndarray      # [m] uint64 sorted
+    extra_vecs: np.ndarray      # [m, d] float32
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.base_keep.sum()) + len(self.extra_uids)
+
+
+def _posting_vec(tab, ps) -> np.ndarray | None:
+    """First untagged posting's embedding, or None."""
+    for p in ps:
+        if p.lang:
+            continue
+        v = p.value
+        if v.tid != TypeID.FLOAT32VECTOR:
+            v = None
+            try:
+                from dgraph_tpu.models.types import convert
+                v = convert(p.value, TypeID.FLOAT32VECTOR)
+            except ValueError:
+                return None
+        return np.asarray(vector_value(v), np.float32)
+    return None
+
+
+def _base_block(tab) -> tuple[np.ndarray, np.ndarray]:
+    """Packed (uids, (n, d) float32) of the tablet's base state, cached
+    per (base_ts, schema object) like value_columns. Raises ValueError
+    on mixed dimensions — a brute-force block has no meaningful score
+    between differently-sized embeddings."""
+    cached = getattr(tab, "_vec_base", None)
+    if cached is not None and cached[0] == tab.base_ts \
+            and cached[1] is tab.schema:
+        return cached[2], cached[3]
+    uids: list[int] = []
+    rows: list[np.ndarray] = []
+    dim = None
+    for u, ps in tab.values.items():
+        vec = _posting_vec(tab, ps)
+        if vec is None:
+            continue
+        if dim is None:
+            dim = len(vec)
+        elif len(vec) != dim:
+            raise ValueError(
+                f"predicate {tab.pred!r} holds vectors of differing "
+                f"dimension ({dim} vs {len(vec)})")
+        uids.append(u)
+        rows.append(vec)
+    if dim is None:
+        uarr = _EMPTY_U64.copy()
+        varr = np.empty((0, 0), np.float32)
+    else:
+        uarr = np.asarray(uids, np.uint64)
+        order = np.argsort(uarr, kind="stable")
+        uarr = uarr[order]
+        varr = np.ascontiguousarray(
+            np.stack(rows, axis=0)[order], dtype=np.float32)
+    tab._vec_base = (tab.base_ts, tab.schema, uarr, varr)
+    return uarr, varr
+
+
+def vector_view(tab, read_ts: int) -> VecView:
+    """The tablet's vectors visible at read_ts. The base block is
+    shared across calls; only the (usually tiny) overlay side block is
+    built per read timestamp."""
+    base_uids, base_vecs = _base_block(tab)
+    dim = base_vecs.shape[1] if base_vecs.size else 0
+    keep = np.ones(len(base_uids), bool)
+    ex_uids: list[int] = []
+    ex_rows: list[np.ndarray] = []
+    if tab.dirty():
+        touched = sorted(tab.overlay_srcs(read_ts))
+        if touched:
+            tarr = np.asarray(touched, np.uint64)
+            pos = np.searchsorted(base_uids, tarr)
+            pos = np.clip(pos, 0, max(len(base_uids) - 1, 0))
+            hit = (base_uids[pos] == tarr) if len(base_uids) \
+                else np.zeros(len(tarr), bool)
+            keep[pos[hit]] = False
+            for u in touched:
+                vec = _posting_vec(tab, tab.get_postings(int(u), read_ts))
+                if vec is None:
+                    continue
+                if dim == 0:
+                    dim = len(vec)
+                elif len(vec) != dim:
+                    raise ValueError(
+                        f"predicate {tab.pred!r} holds vectors of "
+                        f"differing dimension ({dim} vs {len(vec)})")
+                ex_uids.append(int(u))
+                ex_rows.append(vec)
+    if ex_uids:
+        earr = np.asarray(ex_uids, np.uint64)
+        order = np.argsort(earr, kind="stable")
+        ex_u = earr[order]
+        ex_v = np.ascontiguousarray(
+            np.stack(ex_rows, axis=0)[order], dtype=np.float32)
+    else:
+        ex_u = _EMPTY_U64.copy()
+        ex_v = np.empty((0, dim), np.float32)
+    if not base_vecs.size and dim:
+        base_vecs = np.empty((0, dim), np.float32)
+    return VecView(dim, base_uids, base_vecs, keep, ex_u, ex_v)

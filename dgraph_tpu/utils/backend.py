@@ -54,6 +54,18 @@ def force_cpu_backend(n_devices: int | None = None,
                 f"{n_devices}").strip()
 
     import jax
+
+    # Pallas (via checkify) registers per-platform lowerings at import
+    # time against the CURRENT platform registry; import it while
+    # "tpu" is still a known platform, or interpret-mode kernels fail
+    # to even import after the factories are popped below (same
+    # ordering trap tests/conftest.py documents).
+    try:
+        from jax.experimental import pallas as _pl  # noqa: F401
+        from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
+    except Exception:
+        pass  # pallas unavailable: kernels fall back to XLA anyway
+
     from jax._src import xla_bridge as _xb
     _xb._backend_factories.pop("axon", None)
     _xb._backend_factories.pop("tpu", None)

@@ -215,6 +215,348 @@ def test_regexp_graphql_var_rejects_empty_body():
         parse(q, variables={"re": "//"})
 
 
+# ---------------------------------------------------------------------------
+# similar_to + vector literals (vector search subsystem)
+# ---------------------------------------------------------------------------
+
+
+def test_similar_to_root_parses():
+    r = parse('{ q(func: similar_to(embedding, 5, "[0.1, 0.2]")) '
+              '{ uid } }')
+    fn = r.queries[0].func
+    assert fn.name == "similar_to" and fn.attr == "embedding"
+    assert fn.args[0].value == "5"
+    assert fn.args[1].value == "[0.1, 0.2]"
+
+
+def test_similar_to_list_literal_and_metric():
+    r = parse('{ q(func: similar_to(embedding, 3, '
+              '[0.5, -1.5, 2e-1], "euclidean")) { uid } }')
+    fn = r.queries[0].func
+    assert fn.args[1].value == [0.5, -1.5, 0.2]
+    assert fn.args[2].value == "euclidean"
+
+
+def test_similar_to_graphql_var():
+    r = parse('query nn($v: string) '
+              '{ q(func: similar_to(embedding, 2, $v)) { uid } }',
+              variables={"v": "[1.0, 2.0]"})
+    assert r.queries[0].func.args[1].value == "[1.0, 2.0]"
+
+
+def test_similar_to_in_filter_and_score_val():
+    r = parse("""{
+      q(func: has(name)) @filter(similar_to(embedding, 4, [1, 2])) {
+        score: val(similar_to_score)
+      }
+    }""")
+    assert r.queries[0].filter.func.name == "similar_to"
+    ch = r.queries[0].children[0]
+    assert ch.alias == "score"
+    assert ch.needs_var[0].name == "similar_to_score"
+
+
+@pytest.mark.parametrize("q", [
+    '{ q(func: similar_to(embedding, 5, [0.1,)) { uid } }',
+    '{ q(func: similar_to(embedding, 5, [0.1, "x"])) { uid } }',
+    '{ q(func: similar_to(embedding, 5, [[0.1], )) { uid } }',
+])
+def test_similar_to_bad_vector_literals(q):
+    with pytest.raises(GQLError):
+        parse(q)
+
+
+def test_similar_to_vector_roundtrip_fuzz():
+    """Round-trip: any float list rendered into a similar_to literal
+    parses back to the same floats (both quoted and bare forms)."""
+    import random
+
+    from dgraph_tpu.models.types import parse_vector
+
+    rnd = random.Random(7)
+    for _ in range(25):
+        vec = [round(rnd.uniform(-100, 100), 4)
+               for _ in range(rnd.randint(1, 16))]
+        lit = "[" + ", ".join(repr(x) for x in vec) + "]"
+        for q in (
+                f'{{ q(func: similar_to(e, 3, "{lit}")) {{ uid }} }}',
+                f'{{ q(func: similar_to(e, 3, {lit})) {{ uid }} }}'):
+            fn = parse(q).queries[0].func
+            got = parse_vector(fn.args[1].value)
+            assert [round(float(x), 4) for x in got] == vec
+
+
+# ---------------------------------------------------------------------------
+# conformance batch ported from the reference's gql/parser_test.go
+# (round-6 batch: ~30 cases — naming follows the reference's TestXxx)
+# ---------------------------------------------------------------------------
+
+
+def test_ref_parse_count_valid():
+    # TestParseCountValParse
+    r = parse('{ me(func: uid(1)) { count(friends) } }')
+    ch = r.queries[0].children[0]
+    assert ch.is_count and ch.attr == "friends"
+
+
+def test_ref_parse_count_error_no_parens():
+    # TestCountError1: count without a target
+    with pytest.raises(GQLError):
+        parse('{ me(func: uid(1)) { count(), name } }')
+
+
+def test_ref_order_multiple_keys():
+    # TestParseOrderbyMultipleKeys
+    r = parse('{ me(func: uid(0x1), orderasc: name, orderdesc: age) '
+              '{ name } }')
+    q = r.queries[0]
+    assert [(o.attr, o.desc) for o in q.order] == \
+        [("name", False), ("age", True)]
+
+
+def test_ref_lang_support_bug():
+    # TestLangsInvalid: language tag on the filter function attr
+    r = parse('{ me(func: eq(name@en, "Alice")) { name@en } }')
+    assert r.queries[0].func.lang == "en"
+    assert r.queries[0].children[0].langs == ["en"]
+
+
+def test_ref_parse_var_error_multiple_define():
+    # TestParseVarError: duplicate var definition rejected
+    with pytest.raises(GQLError, match="multiple"):
+        parse("""{
+          var(func: uid(0x1)) { a as name }
+          var(func: uid(0x2)) { a as age }
+        }""")
+
+
+def test_ref_duplicate_alias_error():
+    # "Duplicate aliases not allowed"
+    with pytest.raises(GQLError, match="[Dd]uplicate"):
+        parse('{ me(func: uid(1)) { name } me(func: uid(2)) { age } }')
+
+
+def test_ref_parse_schema_block():
+    # TestParseSchema
+    r = parse('schema (pred: [name, friend]) { type tokenizer }')
+    assert r.schema_request == {"preds": ["name", "friend"],
+                                "fields": ["type", "tokenizer"]}
+
+
+def test_ref_parse_schema_all():
+    # TestParseSchemaAll: bare schema {}
+    r = parse('schema {}')
+    assert r.schema_request == {"preds": [], "fields": []}
+
+
+def test_ref_parse_schema_error_multiple():
+    # TestParseSchemaError: only one schema block
+    with pytest.raises(GQLError, match="schema"):
+        parse('schema {} schema {}')
+
+
+def test_ref_facets_multiple_keys():
+    # TestFacetsMultiple
+    r = parse('{ me(func: uid(1)) { friend @facets(key1, key2, key3) '
+              '{ name } } }')
+    fr = r.queries[0].children[0]
+    assert [k for k, _ in fr.facets.keys] == ["key1", "key2", "key3"]
+
+
+def test_ref_facets_alias():
+    # TestFacetsAlias
+    r = parse('{ me(func: uid(1)) { friend @facets(a1: key1, key2) '
+              '{ name } } }')
+    fr = r.queries[0].children[0]
+    assert fr.facets.keys == [("key1", "a1"), ("key2", None)]
+
+
+def test_ref_parse_facets_order_var():
+    # TestParseFacetsOrderVar: v as facet var
+    r = parse('{ me(func: uid(1)) { friend @facets(v as weight) '
+              '{ name } } }')
+    fr = r.queries[0].children[0]
+    assert fr.facet_var == {"weight": "v"}
+
+
+def test_ref_groupby_alias_and_lang():
+    # TestParseGroupbyWithAlias / groupby lang handling
+    r = parse('{ me(func: uid(1)) { friend @groupby(Age: age, '
+              'name@en) { count(uid) } } }')
+    fr = r.queries[0].children[0]
+    assert fr.groupby[0].alias == "Age" and fr.groupby[0].attr == "age"
+    assert fr.groupby[1].attr == "name" and fr.groupby[1].lang == "en"
+
+
+def test_ref_between_function():
+    # between(pred, lo, hi)
+    r = parse('{ me(func: between(age, 18, 30)) { name } }')
+    fn = r.queries[0].func
+    assert fn.name == "between"
+    assert [a.value for a in fn.args] == ["18", "30"]
+
+
+def test_ref_eq_multiple_args():
+    # TestParseFunctionWithMultipleArgs: eq over a value list
+    r = parse('{ me(func: eq(name, "a", "b", "c")) { name } }')
+    assert [a.value for a in r.queries[0].func.args] == ["a", "b", "c"]
+
+
+def test_ref_eq_bracket_list_args():
+    # eq(name, ["a", "b"]) — list form of the same
+    r = parse('{ me(func: eq(name, ["a", "b"])) { name } }')
+    assert [a.value for a in r.queries[0].func.args] == ["a", "b"]
+
+
+def test_ref_uid_in_function():
+    # TestParseFuncUidIn
+    r = parse('{ me(func: uid_in(school, 0x100)) { name } }')
+    fn = r.queries[0].func
+    assert fn.name == "uid_in" and fn.attr == "school"
+    assert fn.uids == [0x100]
+
+
+def test_ref_has_at_child_filter():
+    # has() inside a child @filter
+    r = parse('{ me(func: uid(1)) { friend @filter(has(alias)) '
+              '{ name } } }')
+    fr = r.queries[0].children[0]
+    assert fr.filter.func.name == "has" and fr.filter.func.attr == "alias"
+
+
+def test_ref_reverse_predicate():
+    # ~pred traversal and has(~pred)
+    r = parse('{ me(func: has(~friend)) { ~friend { name } } }')
+    assert r.queries[0].func.attr == "~friend"
+    assert r.queries[0].children[0].attr == "~friend"
+
+
+def test_ref_expand_forward_type():
+    # TestTypeInDeepFilter-ish: expand(Person)
+    r = parse('{ me(func: uid(1)) { expand(Person) } }')
+    assert r.queries[0].children[0].expand == "Person"
+
+
+def test_ref_recurse_without_args():
+    # TestRecurse: bare @recurse
+    r = parse('{ me(func: uid(0x1)) @recurse { friend } }')
+    q = r.queries[0]
+    assert q.recurse is not None and q.recurse.depth == 0
+
+
+def test_ref_recurse_error_bad_arg():
+    # TestRecurseError: unknown recurse arg
+    with pytest.raises(GQLError, match="recurse"):
+        parse('{ me(func: uid(1)) @recurse(foo: 3) { friend } }')
+
+
+def test_ref_shortest_with_weights():
+    # shortest(..., minweight/maxweight)
+    r = parse('{ path as shortest(from: 0x1, to: 0x2, minweight: 1, '
+              'maxweight: 5) { friend } }')
+    sa = r.queries[0].shortest
+    assert sa.minweight == 1.0 and sa.maxweight == 5.0
+
+
+def test_ref_math_nested_funcs():
+    # TestMathWithoutVarAlias-ish shapes
+    r = parse('{ me(func: uid(1)) { a as age '
+              'x: math(cond(a < 18, 0, sqrt(2 * a))) } }')
+    m = r.queries[0].children[1].math
+    assert m.fn == "cond"
+    assert m.children[0].fn == "<"
+    assert m.children[2].fn == "sqrt"
+
+
+def test_ref_filter_geo_function():
+    # TestParseGeoJson-ish: near with coordinate + distance
+    r = parse('{ me(func: near(loc, [-122.0, 37.0], 1000)) { name } }')
+    fn = r.queries[0].func
+    assert fn.args[0].value == [-122.0, 37.0]
+    assert fn.args[1].value == "1000"
+
+
+def test_ref_within_polygon():
+    r = parse('{ me(func: within(loc, [[0.0, 0.0], [1.0, 0.0], '
+              '[1.0, 1.0], [0.0, 0.0]])) { name } }')
+    fn = r.queries[0].func
+    assert fn.args[0].value[0] == [0.0, 0.0]
+    assert len(fn.args[0].value) == 4
+
+
+def test_ref_pagination_val_order():
+    # order by val() with pagination on a child
+    r = parse('{ me(func: uid(1)) { friend(orderasc: val(x), '
+              'first: 3, offset: 1) { name } } }')
+    fr = r.queries[0].children[0]
+    assert fr.order[0].attr == "val(x)"
+    assert fr.first == 3 and fr.offset == 1
+
+
+def test_ref_filter_error_missing_operand():
+    # TestParseFilter_error: dangling boolean operator
+    with pytest.raises(GQLError):
+        parse('{ me(func: uid(1)) @filter(eq(a, 1) AND) { name } }')
+
+
+def test_ref_filter_error_unbalanced_parens():
+    with pytest.raises(GQLError):
+        parse('{ me(func: uid(1)) @filter((eq(a, 1) OR eq(b, 2)) '
+              '{ name } }')
+
+
+def test_ref_error_missing_closing_brace():
+    # TestParseIncompleteQuery
+    with pytest.raises(GQLError):
+        parse('{ me(func: uid(1)) { name }')
+
+
+def test_ref_error_bad_root_arg():
+    # "unknown root argument"
+    with pytest.raises(GQLError, match="root argument"):
+        parse('{ me(func: uid(1), badarg: 3) { name } }')
+
+
+def test_ref_error_aggregation_at_root():
+    # TestVarInAggError: min() is not a query function
+    with pytest.raises(GQLError, match="not valid"):
+        parse('{ me(func: min(val(a))) { name } }')
+
+
+def test_ref_checkpwd_function():
+    # TestCheckpwd
+    r = parse('{ me(func: uid(1)) { checkpwd(password, "secret") } }')
+    ch = r.queries[0].children[0]
+    assert ch.attr == "password" and ch.checkpwd_pwd == "secret"
+
+
+def test_ref_empty_block_aggregation():
+    # TestAggregateRoot: empty block `me()` with aggregations
+    r = parse("""{
+      var(func: has(age)) { a as age }
+      me() { s: sum(val(a)) }
+    }""")
+    me = r.queries[1]
+    assert me.is_empty
+    assert me.children[0].agg_func == "sum"
+
+
+def test_ref_comments_everywhere():
+    # TestParseWithComments
+    r = parse("""
+      # leading comment
+      { me(func: uid(1)) { # trailing
+        name  # after field
+      } }
+    """)
+    assert r.queries[0].children[0].attr == "name"
+
+
+def test_ref_hex_and_decimal_uids_mix():
+    r = parse('{ me(func: uid(0x0f, 15, 16)) { uid } }')
+    assert sorted(r.queries[0].uids) == [15, 15, 16]
+
+
 def test_graphql_var_keys_strip_one_dollar_and_reject_dupes():
     """Variable keys strip exactly ONE leading "$" ("$$a" stays "$a");
     supplying both bare and $-prefixed forms of one name errors

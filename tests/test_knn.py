@@ -1,0 +1,419 @@
+"""Vector similarity search subsystem: float32vector type + schema,
+ops/knn kernels (host/device/two-stage/pallas/sharded parity), the
+columnar vector store's MVCC overlay semantics, and the similar_to()
+query surface end-to-end."""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.gql.lexer import GQLError
+from dgraph_tpu.models.types import (
+    TypeID, Val, convert, parse_vector, to_json_value,
+)
+from dgraph_tpu.ops import knn
+
+
+def _corpus(n, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, d), dtype=np.float32)
+
+
+# ---------------------------------------------------------------------------
+# type system
+# ---------------------------------------------------------------------------
+
+
+def test_float32vector_type_roundtrip():
+    v = convert(Val(TypeID.DEFAULT, "[0.5, -1.25, 3]"),
+                TypeID.FLOAT32VECTOR)
+    assert v.value.dtype == np.float32
+    assert to_json_value(v) == [0.5, -1.25, 3.0]
+    # -> string -> back is lossless
+    s = convert(v, TypeID.STRING)
+    v2 = convert(s, TypeID.FLOAT32VECTOR)
+    assert np.array_equal(v.value, v2.value)
+
+
+def test_parse_vector_rejects_junk():
+    for bad in ("[]", "", "[1, two]", "[nan]", [[1.0, 2.0]]):
+        with pytest.raises((ValueError, TypeError)):
+            parse_vector(bad)
+
+
+def test_schema_vector_forms():
+    from dgraph_tpu.models.schema import parse_schema
+
+    preds, _ = parse_schema("embedding: float32vector @index(vector) .")
+    ps = preds[0]
+    assert ps.value_type == TypeID.FLOAT32VECTOR
+    assert ps.indexed and ps.tokenizers == ["vector"]
+    assert ps.describe() == "embedding: float32vector @index(vector) ."
+    with pytest.raises(ValueError):
+        parse_schema("e: [float32vector] .")  # no ragged vector lists
+    with pytest.raises(ValueError):
+        parse_schema("name: string @index(vector) .")  # wrong type
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("metric", list(knn.METRICS))
+def test_host_vs_device_exact_parity_100k(metric):
+    """Acceptance: exact top-k parity between the host (numpy f64) and
+    device (XLA f32) tiers on a >= 100k x 128 corpus."""
+    corpus = _corpus(100_000, 128, seed=1)
+    rng = np.random.default_rng(2)
+    rows = rng.integers(0, len(corpus), 4)
+    queries = corpus[rows] + 0.05 * rng.standard_normal(
+        (4, 128), dtype=np.float32)
+    hi, hs = knn.topk_host(corpus, queries, 10, metric)
+    di, ds = knn.topk_device(corpus, queries, 10, metric,
+                             two_stage=False)
+    assert np.array_equal(hi, di)
+    np.testing.assert_allclose(hs, ds, rtol=2e-4, atol=2e-3)
+
+
+def test_two_stage_recall_100k():
+    """Acceptance: the two-stage approximate path keeps recall@k >=
+    0.99 against exact on a 100k corpus (and actually engages)."""
+    corpus = _corpus(100_000, 128, seed=3)
+    queries = _corpus(32, 128, seed=4)
+    k = 10
+    assert knn.plan_two_stage(len(corpus), k) > 0
+    ei, _ = knn.topk_device(corpus, queries, k, "cosine",
+                            two_stage=False)
+    ai, _ = knn.topk_device(corpus, queries, k, "cosine",
+                            two_stage=True)
+    hits = sum(len(set(ei[b].tolist()) & set(ai[b].tolist()))
+               for b in range(len(queries)))
+    recall = hits / float(len(queries) * k)
+    assert recall >= 0.99, recall
+
+
+def test_two_stage_recall_uid_clustered():
+    """Adversarial layout: the true top-k are CONSECUTIVE rows (near-
+    duplicate embeddings committed under consecutive uids). The
+    dispersal permutation must keep them out of one bucket, or recall
+    collapses to L/k."""
+    corpus = _corpus(50_000, 32, seed=20)
+    rng = np.random.default_rng(21)
+    q = rng.standard_normal(32).astype(np.float32) * 4
+    k = 10
+    # rows 30000..30009 are the near-exact neighbors, contiguous
+    corpus[30_000:30_000 + k] = q + 0.001 * rng.standard_normal(
+        (k, 32)).astype(np.float32)
+    assert knn.plan_two_stage(len(corpus), k) > 0
+    ai, _ = knn.topk_device(corpus, q[None], k, "cosine",
+                            two_stage=True)
+    got = set(ai[0].tolist())
+    want = set(range(30_000, 30_000 + k))
+    assert len(got & want) >= k - 1, sorted(got)
+
+
+def test_two_stage_falls_back_to_exact():
+    """Contract: when the corpus can't sustain the recall target the
+    two-stage request silently downgrades to exact."""
+    corpus = _corpus(1000, 16)  # below TWO_STAGE_MIN_ROWS
+    q = _corpus(2, 16, seed=9)
+    assert knn.plan_two_stage(len(corpus), 5) == 0
+    i1, _ = knn.topk_device(corpus, q, 5, "dot", two_stage=True)
+    i2, _ = knn.topk_device(corpus, q, 5, "dot", two_stage=False)
+    assert np.array_equal(i1, i2)
+    # huge k relative to bucket count also falls back
+    assert knn.plan_two_stage(8192, 5000) == 0
+
+
+def test_topk_mask_and_merge():
+    corpus = _corpus(300, 8, seed=5)
+    q = corpus[7][None]
+    mask = np.ones(300, bool)
+    mask[7] = False
+    i, s = knn.topk_host(corpus, q, 3, "cosine", mask=mask)
+    assert 7 not in i[0]
+    uids, scores = knn.merge_topk(
+        [(np.array([3, 9], np.uint64), np.array([0.5, 0.9])),
+         (np.array([11], np.uint64), np.array([0.7]))], 2)
+    assert uids.tolist() == [9, 11]
+    assert scores.tolist() == [0.9, 0.7]
+
+
+def test_pallas_scoring_parity():
+    """The Pallas MXU tile kernel (interpret mode on the CPU mesh)
+    matches the XLA contraction bit-for-bit semantics-wise."""
+    corpus = _corpus(2048, 64, seed=6)
+    q = _corpus(4, 64, seed=7)
+    ix, sx = knn.topk_device(corpus, q, 8, "cosine", two_stage=False)
+    ip, sp = knn.topk_device(corpus, q, 8, "cosine", two_stage=False,
+                             use_pallas=True, pallas_interpret=True)
+    assert np.array_equal(ix, ip)
+    np.testing.assert_allclose(sx, sp, rtol=1e-5)
+
+
+def test_sharded_mesh_merge_parity():
+    """Acceptance: per-shard top-k + merge over the 8-device CPU mesh
+    returns exactly the single-device exact top-k."""
+    from dgraph_tpu.parallel import make_mesh, shard_corpus, sharded_topk
+
+    mesh = make_mesh()
+    corpus = _corpus(4096, 32, seed=8)
+    q = _corpus(3, 32, seed=9)
+    block, n_real = shard_corpus(mesh, corpus)
+    si, ss = sharded_topk(mesh, block, q, 6, "cosine", n_real=n_real)
+    hi, hs = knn.topk_host(corpus, q, 6, "cosine")
+    assert np.array_equal(si, hi)
+    np.testing.assert_allclose(ss, hs, rtol=2e-4, atol=2e-3)
+
+
+@pytest.mark.slow
+def test_two_stage_recall_1m():
+    """>= 1M-row corpora stay out of tier-1 (timeout budget)."""
+    corpus = _corpus(1_000_000, 64, seed=10)
+    queries = _corpus(16, 64, seed=11)
+    ei, _ = knn.topk_device(corpus, queries, 10, "dot",
+                            two_stage=False)
+    ai, _ = knn.topk_device(corpus, queries, 10, "dot", two_stage=True)
+    hits = sum(len(set(ei[b].tolist()) & set(ai[b].tolist()))
+               for b in range(len(queries)))
+    assert hits / 160.0 >= 0.99
+
+
+# ---------------------------------------------------------------------------
+# vector store MVCC
+# ---------------------------------------------------------------------------
+
+
+def _vec_db(n=8, d=2, **kw):
+    db = GraphDB(prefer_device=False, **kw)
+    db.alter("embedding: float32vector @index(vector) .\n"
+             "name: string @index(exact) .")
+    rdf = "\n".join(
+        f'<0x{i:x}> <embedding> "[{i}.0, {i * 2}.0]"'
+        f'^^<xs:float32vector> .\n<0x{i:x}> <name> "n{i}" .'
+        for i in range(1, n + 1))
+    db.mutate(set_nquads=rdf, commit_now=True)
+    return db
+
+
+def test_vector_view_overlay_mvcc():
+    """Mutating a vector is visible at the new ts and invisible at the
+    old one — the overlay side block, not a base rebuild."""
+    db = _vec_db()
+    tab = db.tablets["embedding"]
+    db.rollup_all()
+    old_ts = db.coordinator.max_assigned()
+    v_old = tab.vector_view(old_ts)
+    assert v_old.base_keep.all() and not len(v_old.extra_uids)
+
+    db.mutate(set_nquads='<0x3> <embedding> "[99.0, 99.0]"'
+                         '^^<xs:float32vector> .', commit_now=True)
+    new_ts = db.coordinator.max_assigned()
+    v_new = tab.vector_view(new_ts)
+    assert not v_new.base_keep[v_new.base_uids.tolist().index(3)]
+    assert v_new.extra_uids.tolist() == [3]
+    assert v_new.extra_vecs[0].tolist() == [99.0, 99.0]
+    # the old snapshot still reads the old vector
+    v_old2 = tab.vector_view(old_ts)
+    assert v_old2.base_keep.all() and not len(v_old2.extra_uids)
+
+    q = ('{ q(func: similar_to(embedding, 1, "[99.0, 99.0]", '
+         '"euclidean")) { uid } }')
+    assert db.query(q, read_ts=old_ts)["data"]["q"] != \
+        db.query(q, read_ts=new_ts)["data"]["q"]
+    assert db.query(q, read_ts=new_ts)["data"]["q"] == [{"uid": "0x3"}]
+
+    # deleting the vector drops the row at the new ts
+    db.mutate(del_nquads='<0x3> <embedding> * .', commit_now=True)
+    v3 = tab.vector_view(db.coordinator.max_assigned())
+    assert not len(v3.extra_uids)
+    assert not v3.base_keep[v3.base_uids.tolist().index(3)]
+
+    # rollup folds the overlay into a fresh base
+    db.rollup_all()
+    v4 = tab.vector_view(db.coordinator.max_assigned())
+    assert 3 not in v4.base_uids.tolist() and v4.base_keep.all()
+
+
+def test_vector_mixed_dim_rejected():
+    db = _vec_db(n=3)
+    db.mutate(set_nquads='<0x9> <embedding> "[1.0, 2.0, 3.0]"'
+                         '^^<xs:float32vector> .', commit_now=True)
+    with pytest.raises(GQLError, match="dimension"):
+        db.query('{ q(func: similar_to(embedding, 2, "[1.0, 2.0]")) '
+                 '{ uid } }')
+
+
+# ---------------------------------------------------------------------------
+# similar_to end-to-end
+# ---------------------------------------------------------------------------
+
+
+def test_similar_to_root_order_and_score_var():
+    db = _vec_db()
+    res = db.query(
+        '{ q(func: similar_to(embedding, 3, "[3.1, 6.1]", '
+        '"euclidean")) { uid name score: val(similar_to_score) } }')
+    rows = res["data"]["q"]
+    assert [r["uid"] for r in rows] == ["0x3", "0x4", "0x2"]
+    assert rows[0]["score"] > rows[1]["score"] > rows[2]["score"]
+    # nearest-first also via the serialized JSON emitter
+    js = db.query_json(
+        '{ q(func: similar_to(embedding, 2, "[3.1, 6.1]", '
+        '"euclidean")) { name } }')
+    assert '"q":[{"name":"n3"},{"name":"n4"}]' in js
+
+
+def test_similar_to_graphql_var_and_list_literal():
+    db = _vec_db()
+    res = db.query(
+        'query nn($v: string) { q(func: similar_to(embedding, 2, $v, '
+        '"euclidean")) { uid } }', variables={"v": "[1.0, 2.0]"})
+    assert res["data"]["q"][0]["uid"] == "0x1"
+    res2 = db.query('{ q(func: similar_to(embedding, 2, '
+                    '[1.0, 2.0], "euclidean")) { uid } }')
+    assert res2["data"]["q"] == res["data"]["q"]
+
+
+def test_similar_to_filter_and_pagination():
+    db = _vec_db()
+    # filter context: k nearest among the filtered candidates only
+    res = db.query(
+        '{ q(func: eq(name, "n5", "n6", "n7")) '
+        '@filter(similar_to(embedding, 2, "[1.0, 2.0]", "euclidean"))'
+        ' { uid } }')
+    assert [r["uid"] for r in res["data"]["q"]] == ["0x5", "0x6"]
+    # pagination pages in SCORE space on a similar_to root
+    res2 = db.query(
+        '{ q(func: similar_to(embedding, 4, "[1.0, 2.0]", '
+        '"euclidean"), first: 2, offset: 1) { uid } }')
+    assert [r["uid"] for r in res2["data"]["q"]] == ["0x2", "0x3"]
+
+
+def test_similar_to_score_var_in_later_block():
+    db = _vec_db()
+    res = db.query("""{
+      var(func: similar_to(embedding, 3, "[1.0, 2.0]", "euclidean"))
+      q(func: uid(1, 2, 3), orderdesc: val(similar_to_score)) {
+        uid score: val(similar_to_score)
+      }
+    }""")
+    rows = res["data"]["q"]
+    assert [r["uid"] for r in rows] == ["0x1", "0x2", "0x3"]
+
+
+def test_similar_to_errors():
+    db = _vec_db()
+    db.alter("vecnoidx: float32vector .")
+    with pytest.raises(GQLError, match="@index\\(vector\\)"):
+        db.query('{ q(func: similar_to(vecnoidx, 2, "[1.0]")) '
+                 '{ uid } }')
+    with pytest.raises(GQLError, match="float32vector"):
+        db.query('{ q(func: has(name)) '
+                 '@filter(similar_to(name, 2, "[1.0]")) { uid } }')
+    with pytest.raises(GQLError, match="k must be"):
+        db.query('{ q(func: similar_to(embedding, 0, "[1.0, 2.0]")) '
+                 '{ uid } }')
+    with pytest.raises(GQLError, match="metric"):
+        db.query('{ q(func: similar_to(embedding, 2, "[1.0, 2.0]", '
+                 '"manhattan")) { uid } }')
+    with pytest.raises(GQLError, match="query vector"):
+        db.query('{ q(func: similar_to(embedding, 2, "nope")) '
+                 '{ uid } }')
+    with pytest.raises(GQLError, match="not in the schema"):
+        db.query('{ q(func: similar_to(nosuch, 2, "[1.0]")) { uid } }')
+    # several similar_to calls + a score reader is ambiguous
+    with pytest.raises(GQLError, match="ambiguous"):
+        db.query("""{
+          a(func: similar_to(embedding, 2, "[1.0, 2.0]")) {
+            score: val(similar_to_score)
+          }
+          b(func: similar_to(embedding, 2, "[2.0, 1.0]")) { uid }
+        }""")
+    # ...but several similar_to calls with NO reader are fine
+    res = db.query("""{
+      a(func: similar_to(embedding, 1, "[1.0, 2.0]", "euclidean")) { uid }
+      b(func: similar_to(embedding, 1, "[8.0, 16.0]", "euclidean")) { uid }
+    }""")
+    assert res["data"]["a"] == [{"uid": "0x1"}]
+    assert res["data"]["b"] == [{"uid": "0x8"}]
+
+
+def test_similar_to_host_vs_device_tier_parity():
+    """The executor's host and device tiers return identical rows for
+    the same query (device engages via device_min_edges=1)."""
+    rng = np.random.default_rng(12)
+    vecs = rng.standard_normal((64, 8)).astype(np.float32)
+    rdf = "\n".join(
+        f'<0x{i + 1:x}> <embedding> "{list(map(float, vecs[i]))}"'
+        '^^<xs:float32vector> .'
+        for i in range(len(vecs)))
+    q = ('{ q(func: similar_to(embedding, 5, "%s")) '
+         '{ uid score: val(similar_to_score) } }'
+         % list(map(float, vecs[17] + 0.01)))
+    outs = []
+    for prefer in (False, True):
+        db = GraphDB(prefer_device=prefer, device_min_edges=1)
+        db.alter("embedding: float32vector @index(vector) .")
+        db.mutate(set_nquads=rdf, commit_now=True)
+        db.rollup_all()
+        outs.append(db.query(q)["data"]["q"])
+    assert [r["uid"] for r in outs[0]] == [r["uid"] for r in outs[1]]
+    for a, b in zip(outs[0], outs[1]):
+        assert abs(a["score"] - b["score"]) < 1e-4
+
+
+def test_similar_to_sharded_tier_parity():
+    """With a mesh attached and shard_min_edges low, the executor
+    routes scoring through the sharded tier — same rows as host."""
+    from dgraph_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(13)
+    vecs = rng.standard_normal((96, 4)).astype(np.float32)
+    rdf = "\n".join(
+        f'<0x{i + 1:x}> <embedding> "{list(map(float, vecs[i]))}"'
+        '^^<xs:float32vector> .'
+        for i in range(len(vecs)))
+    q = ('{ q(func: similar_to(embedding, 4, "[0.5, 0.5, 0.5, 0.5]"))'
+         ' { uid } }')
+    host = GraphDB(prefer_device=False)
+    host.alter("embedding: float32vector @index(vector) .")
+    host.mutate(set_nquads=rdf, commit_now=True)
+    want = host.query(q)["data"]["q"]
+
+    db = GraphDB(mesh=make_mesh(), shard_min_edges=8,
+                 prefer_device=False)
+    db.alter("embedding: float32vector @index(vector) .")
+    db.mutate(set_nquads=rdf, commit_now=True)
+    db.rollup_all()
+    got = db.query(q)["data"]["q"]
+    assert got == want
+    from dgraph_tpu.utils.metrics import snapshot
+    assert snapshot()["counters"].get(
+        "query_similar_sharded_total", 0) >= 1
+
+
+def test_similar_to_json_mutation_and_bulk():
+    """Vector values arrive as strings in JSON mutations (schema
+    converts at commit) and through the bulk loader."""
+    db = GraphDB(prefer_device=False)
+    db.alter("embedding: float32vector @index(vector) .")
+    db.mutate(set_json=[{"uid": "0x1", "embedding": "[1.0, 0.0]"},
+                        {"uid": "0x2", "embedding": "[0.0, 1.0]"}],
+              commit_now=True)
+    res = db.query('{ q(func: similar_to(embedding, 1, "[0.9, 0.1]"))'
+                   ' { uid embedding } }')
+    assert res["data"]["q"] == [{"uid": "0x1",
+                                 "embedding": [1.0, 0.0]}]
+
+    from dgraph_tpu.ingest.bulk import bulk_load
+    from dgraph_tpu.gql.nquad import parse_rdf
+    nqs = parse_rdf(
+        '<0x1> <embedding> "[1.0, 0.0]"^^<xs:float32vector> .\n'
+        '<0x2> <embedding> "[-1.0, 0.0]"^^<xs:float32vector> .')
+    bdb = bulk_load(nquads=iter([nqs]),
+                    schema="embedding: float32vector @index(vector) .")
+    out = bdb.query('{ q(func: similar_to(embedding, 1, '
+                    '"[1.0, 0.1]")) { uid } }')
+    assert out["data"]["q"] == [{"uid": "0x1"}]
