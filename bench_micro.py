@@ -35,8 +35,62 @@ def make_pair(n_a: int, ratio: int, overlap: float, seed: int = 0):
     return a, b
 
 
+def kway_bench():
+    """k-way vs pairwise host set algebra (ops/setops): the executor's
+    old fold was k-1 union1d accumulator re-sorts / a size-blind
+    intersect fold; union_many is concat + ONE sort, intersect_many is
+    smallest-first galloping. Sweeps k = 8 / 64 / 512 sets so the
+    setops win is tracked independently of the query suite."""
+    from functools import reduce
+
+    from dgraph_tpu.ops import setops
+
+    rng = np.random.default_rng(7)
+    out = []
+    for k, n in [(8, 65_536), (64, 8_192), (512, 1_024)]:
+        space = 4 * k * n
+        sets = [np.unique(rng.integers(0, space, n).astype(np.uint64))
+                for _ in range(k)]
+        # one shared run so intersections are non-empty
+        shared = np.unique(
+            rng.integers(0, space, n // 4).astype(np.uint64))
+        isets = [np.unique(np.concatenate([s[: n // 2], shared]))
+                 for s in sets]
+
+        def timed(fn, runs=5):
+            best = float("inf")
+            for _ in range(runs):
+                t = time.perf_counter()
+                got = fn()
+                best = min(best, time.perf_counter() - t)
+            return best, got
+
+        pu_t, pu = timed(lambda: reduce(np.union1d, sets))
+        ku_t, ku = timed(lambda: setops.union_many(sets))
+        assert np.array_equal(pu, ku)
+        pi_t, pi = timed(lambda: reduce(
+            lambda a, b: np.intersect1d(a, b, assume_unique=True),
+            isets))
+        ki_t, ki = timed(lambda: setops.intersect_many(isets))
+        assert np.array_equal(pi, ki)
+        rec = {"metric": "setops_kway", "sets": k, "set_size": n,
+               "union_pairwise_ms": round(pu_t * 1e3, 2),
+               "union_kway_ms": round(ku_t * 1e3, 2),
+               "union_speedup": round(pu_t / max(ku_t, 1e-9), 2),
+               "intersect_pairwise_ms": round(pi_t * 1e3, 2),
+               "intersect_kway_ms": round(ki_t * 1e3, 2),
+               "intersect_speedup": round(pi_t / max(ki_t, 1e-9), 2)}
+        out.append(rec)
+        print(json.dumps(rec))
+    best = max(r["union_speedup"] for r in out)
+    print(json.dumps({"metric": "setops_kway_union_speedup",
+                      "value": best, "unit": "x"}))
+
+
 def main():
     from dgraph_tpu.utils.backend import force_cpu_backend, probe_backend
+
+    kway_bench()
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         force_cpu_backend()

@@ -192,23 +192,38 @@ def main():
         db.query(q)
     sys.stderr.write(f"device warmup pass {time.time()-t0:.0f}s\n")
 
+    # snapshot the counter registry AROUND the device run so
+    # device_counters reports exactly the measured workload's tier
+    # routing (the whole-process snapshot it replaced was drowned by
+    # warmup/load counters and filtered down to nothing)
+    from dgraph_tpu.utils.metrics import snapshot
+    before = snapshot()["counters"]
     dev = run_workload(db, workload, REPEATS)
     dev_out = dev.pop("__outputs__")
+    after = snapshot()["counters"]
+    dev_counters = {
+        k: after[k] - before.get(k, 0) for k in sorted(after)
+        if k.startswith("query_") and after[k] != before.get(k, 0)}
 
     db.prefer_device = False  # same store, host-only executor path
     host = run_workload(db, workload, REPEATS)
     host_out = host.pop("__outputs__")
 
-    mismatched = sorted(n for n in dev_out if dev_out[n] != host_out[n])
+    # the columnar tier must be byte-identical to the per-posting
+    # path, clean-store case (the differential test covers dirty)
+    db.prefer_columnar = False
+    postings = run_workload(db, workload, 1)
+    postings_out = postings.pop("__outputs__")
+    db.prefer_columnar = True
+
+    mismatched = sorted(
+        n for n in dev_out
+        if dev_out[n] != host_out[n] or dev_out[n] != postings_out[n])
 
     # encode ms/op at ~100k rows (VERDICT r2 item 6): the columnar
     # native emitter (query_json) vs the dict+json.dumps loop, on a
     # six-figure flat result from the loaded graph
     enc = _measure_encode_100k(db, scale)
-
-    from dgraph_tpu.utils.metrics import snapshot
-    dev_counters = {k: v for k, v in snapshot()["counters"].items()
-                    if "device" in k or "sharded" in k}
 
     detail = {}
     for name, _ in workload:

@@ -42,6 +42,8 @@ from dgraph_tpu.utils.tracing import span as _span
 
 # process-wide measured device dispatch RTT (device_dispatch_seconds)
 _DISPATCH_SECONDS: float | None = None
+# process-wide backend probe (device_is_accelerator)
+_IS_ACCELERATOR: bool | None = None
 
 
 def _fp(*parts) -> int:
@@ -105,7 +107,8 @@ class GraphDB:
                  enc_key: bytes | None = None,
                  store_dir: str | None = None,
                  tablet_budget: int = 256 << 20,
-                 rollup_window: int = 0):
+                 rollup_window: int = 0,
+                 prefer_columnar: bool = True):
         from dgraph_tpu.engine.tile_cache import DeviceCacheLRU
 
         self.schema = SchemaState()
@@ -135,6 +138,10 @@ class GraphDB:
             self.tablets = {}
         self.prefer_device = prefer_device
         self.device_min_edges = device_min_edges
+        # columnar scan tier switch: False pins every read to the
+        # exact per-posting path (the differential parity suite's
+        # oracle; also an operator escape hatch)
+        self.prefer_columnar = prefer_columnar
         # uid-range sharding across a jax.sharding.Mesh (`uid` axis):
         # predicates above shard_min_edges expand via shard_map over the
         # mesh instead of a single chip (ref posting/list.go:1149
@@ -971,6 +978,23 @@ class GraphDB:
             "max_ts": self.coordinator.max_assigned(),
             "max_uid": self.coordinator._next_uid - 1,
         }
+
+    def device_is_accelerator(self) -> bool:
+        """Whether the jax 'device' tier is real accelerator silicon.
+        On a CPU backend the device plane shares the host's cores —
+        dispatching set algebra or sorts to XLA-CPU can only lose to
+        numpy, and the RTT-based cost model can't see that (its
+        device-compute ratios were measured on TPU). Lazy, cached per
+        process; device_min_edges <= 1 still force-overrides."""
+        global _IS_ACCELERATOR
+        if _IS_ACCELERATOR is None:
+            try:
+                import jax
+                _IS_ACCELERATOR = \
+                    jax.devices()[0].platform != "cpu"
+            except Exception:
+                _IS_ACCELERATOR = False
+        return _IS_ACCELERATOR
 
     def device_dispatch_seconds(self) -> float:
         """Measured round-trip of ONE trivial jitted dispatch (lazy,

@@ -209,6 +209,18 @@ def device_sharded_adjacency(db, tab, read_ts: int,
     return sadj
 
 
+def host_column_tile(db, tab, attr: str, obj) -> None:
+    """Account a host-side columnar export (value-column view, token
+    CSR) against the tile budget under the same LRU + eviction policy
+    as the device tiles: the payload copies are NOT free host memory,
+    and eviction clears the tablet attribute (`attr`/`attr`+"_ts") so
+    the next consumer rebuilds. Put only on first sight — a put per
+    query would re-scan the LRU under its lock for nothing."""
+    cache = db.device_cache
+    if not cache.touch(tab, attr):
+        cache.put(tab, attr, obj)
+
+
 def device_values(db, tab, read_ts: int, lang: str = ""):
     """Sortable value view for order-by / inequality offload (scalar
     tablets; same rollup-then-check policy as the adjacency tiles).

@@ -1,0 +1,210 @@
+"""k-way sorted-UID set algebra — the host half of the reference's
+hottest loop (algo/uidlist.go:137 IntersectWith, :287 IntersectSorted,
+:354 MergeSorted) plus device variants over the uidvec co-sort kernels.
+
+Every input is a sorted-unique uint64 uid vector (the repo-wide
+invariant).  The pairwise folds the executor used to run — k-1
+``np.union1d`` calls re-sorting the accumulator each step, or a left
+fold of intersections ignoring set sizes — are replaced by:
+
+  * union_many:     one concat + ONE sort (np.unique) over all k sets,
+                    O(N log N) total instead of O(k N log N);
+  * intersect_many: smallest-first fold (the reference's
+                    IntersectSorted sorts lists by length for exactly
+                    this reason) where each step is a galloping
+                    ``searchsorted`` probe of the larger side when the
+                    sizes are lopsided — the lin/jump/bin strategy pick
+                    of algo/uidlist.go:151 collapsed to the two numpy
+                    regimes that matter;
+  * difference:     setdiff1d with the uniqueness invariant asserted.
+
+The *_device variants stack the sets into one padded uint32 matrix and
+run the ops/uidvec co-sort kernels (merge_many / intersect_many) in a
+single dispatch — used by the executor when the estimated host cost
+clears the measured dispatch round-trip (`Executor._device_worth`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+_EMPTY = np.empty(0, dtype=np.uint64)
+
+# a searchsorted probe of the big side beats the full merge once the
+# sizes diverge by this much (same ratio the pairwise fold used; ref
+# algo/uidlist.go:151 picks its strategy by the same ratio)
+_GALLOP_RATIO = 16
+
+# device sets are uint32 with 0xFFFFFFFF reserved as padding
+_MAX_U32 = 0xFFFFFFFE
+
+
+def intersect_pair(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Intersection of two sorted-unique uid vectors."""
+    la, lb = len(a), len(b)
+    if la == 0 or lb == 0:
+        return _EMPTY
+    if la > lb:
+        a, b = b, a
+        la, lb = lb, la
+    if lb >= _GALLOP_RATIO * la:
+        idx = np.searchsorted(b, a)
+        np.minimum(idx, lb - 1, out=idx)
+        return a[b[idx] == a]
+    return np.intersect1d(a, b, assume_unique=True)
+
+
+def union_pair(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Union of two sorted-unique uid vectors."""
+    if not len(a):
+        return np.asarray(b)
+    if not len(b):
+        return np.asarray(a)
+    return np.union1d(a, b)
+
+
+def difference(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """a \\ b over sorted-unique uid vectors."""
+    return np.setdiff1d(a, b, assume_unique=True)
+
+
+def union_many(parts: Sequence[np.ndarray]) -> np.ndarray:
+    """k-way union: one concat + one sort + adjacent-unique — the k-1
+    ``union1d`` accumulator re-sorts become a single O(N log N) pass
+    (ref algo.MergeSorted's uint64Heap loop, algo/uidlist.go:354)."""
+    live = [p for p in parts if len(p)]
+    if not live:
+        return _EMPTY
+    if len(live) == 1:
+        return np.asarray(live[0])
+    return np.unique(np.concatenate(live))
+
+
+def intersect_many(parts: Sequence[np.ndarray]) -> np.ndarray:
+    """k-way intersection, smallest set first so every galloping probe
+    runs over the narrowest possible accumulator (ref
+    algo.IntersectSorted sorts by length, algo/uidlist.go:287)."""
+    if not len(parts):
+        return _EMPTY
+    ordered = sorted(parts, key=len)
+    acc = np.asarray(ordered[0])
+    for p in ordered[1:]:
+        if not len(acc):
+            return _EMPTY
+        acc = intersect_pair(acc, p)
+    return acc
+
+
+def count_filter(parts: Sequence[np.ndarray], need: int) -> np.ndarray:
+    """Uids appearing in at least `need` of the sorted-unique sets —
+    the q-gram count filter of fuzzy match (ref worker/match.go
+    uidsForMatch + the T-3d counting bound). Pigeonhole: a uid with
+    >= need hits must appear in one of the smallest k-need+1 sets, so
+    only THOSE union; counts then come from one vectorized
+    searchsorted probe per set over that (much smaller) candidate
+    vector — no k-set concat + full sort (which at the 21M regime
+    re-sorted ~10M uids per match() call)."""
+    k = len(parts)
+    if need > k:
+        return _EMPTY
+    if need <= 1:
+        return union_many(parts)
+    ordered = sorted(parts, key=len)
+    m = k - need + 1
+    small = [p for p in ordered[:m] if len(p)]
+    if not small:
+        return _EMPTY
+    # the candidate union's own sort yields the counts WITHIN the
+    # small sets for free — only the k-m large sets need probing
+    cand, counts = np.unique(np.concatenate(small),
+                             return_counts=True) \
+        if len(small) > 1 else (small[0], np.ones(len(small[0]),
+                                                  np.int64))
+    rest = ordered[m:]
+    total = sum(len(p) for p in parts)
+    # adaptive: k-m membership probes over |cand| (~25ns each) vs one
+    # flat sort over every element (~40ns each) — dense-overlap sets
+    # (|cand| near the whole uid space) lose the probe race
+    if len(cand) * len(rest) * 25 >= total * 40:
+        uids, counts = np.unique(np.concatenate(
+            [p for p in parts if len(p)]), return_counts=True)
+        return uids[counts >= need]
+    # probe smallest-first with incremental pruning: after j of the
+    # remaining sets a candidate still needs
+    # counts >= need - (len(rest) - j), so the LARGEST (most
+    # expensive) probes run over an already-thinned vector
+    for j, p in enumerate(rest):
+        lp = len(p)
+        if lp:
+            idx = np.searchsorted(p, cand)
+            np.minimum(idx, lp - 1, out=idx)
+            counts += p[idx] == cand
+        floor = need - (len(rest) - j - 1)
+        if floor > 0:
+            keep = counts >= floor
+            if not keep.all():
+                cand, counts = cand[keep], counts[keep]
+                if not len(cand):
+                    return _EMPTY
+    return cand[counts >= need]
+
+
+# -- device variants (ops/uidvec co-sort kernels, one dispatch) --------
+
+
+def _device_matrix(parts: Sequence[np.ndarray]) -> Optional[np.ndarray]:
+    """Stack k sorted uid vectors into one padded uint32 matrix, or
+    None when any uid exceeds the 32-bit device plane (callers fall
+    back to the host fold, same contract as the adjacency tiles)."""
+    from dgraph_tpu.ops.uidvec import SENTINEL, pad_to
+
+    width = pad_to(max((len(p) for p in parts), default=0))
+    mat = np.full((max(len(parts), 1), width), SENTINEL, np.uint32)
+    for i, p in enumerate(parts):
+        if len(p) and int(p[-1]) > _MAX_U32:
+            return None
+        mat[i, : len(p)] = np.asarray(p, np.uint64).astype(np.uint32)
+    return mat
+
+
+def union_many_device(parts: Sequence[np.ndarray]
+                      ) -> Optional[np.ndarray]:
+    """k-way union in ONE device dispatch (uidvec.merge_many: concat +
+    single co-sort + adjacent-unique). None -> caller uses the host
+    fold (empty input, >32-bit uids)."""
+    live = [p for p in parts if len(p)]
+    if len(live) < 2:
+        return union_many(live)
+    mat = _device_matrix(live)
+    if mat is None:
+        return None
+    import jax.numpy as jnp
+
+    from dgraph_tpu.ops.uidvec import merge_many, to_numpy
+
+    return to_numpy(merge_many(jnp.asarray(mat))).astype(np.uint64)
+
+
+def intersect_many_device(parts: Sequence[np.ndarray]
+                          ) -> Optional[np.ndarray]:
+    """k-way intersection in one dispatch (uidvec.intersect_many's
+    fused co-sort fold). None -> host fold."""
+    if not len(parts):
+        return _EMPTY
+    if any(not len(p) for p in parts):
+        return _EMPTY
+    if len(parts) == 1:
+        return np.asarray(parts[0])
+    # smallest-first keeps the accumulator (row 0's static length) tight
+    ordered = sorted(parts, key=len)
+    mat = _device_matrix(ordered)
+    if mat is None:
+        return None
+    import jax.numpy as jnp
+
+    from dgraph_tpu.ops.uidvec import intersect_many as _dev_isect
+    from dgraph_tpu.ops.uidvec import to_numpy
+
+    return to_numpy(_dev_isect(jnp.asarray(mat))).astype(np.uint64)

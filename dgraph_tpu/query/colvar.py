@@ -94,9 +94,17 @@ class ColVar(Mapping):
 
     def gather(self, uids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(present_uids, their values) for a sorted uid array — one
-        searchsorted instead of per-uid dict probes."""
+        searchsorted instead of per-uid dict probes; a gather over the
+        var's own domain (filters/aggregates on the binding block's
+        uids, the q020 shape) short-circuits to the arrays as-is."""
         if not len(uids) or not len(self.uids):
             return uids[:0], self.vals[:0]
+        if len(uids) == len(self.uids) and (uids is self.uids or (
+                uids[0] == self.uids[0] and uids[-1] == self.uids[-1]
+                and np.array_equal(uids, self.uids))):
+            # endpoint probes reject length-equal misses before the
+            # full O(n) compare (array_equal does not short-circuit)
+            return self.uids, self.vals
         pos = np.searchsorted(self.uids, uids)
         pos = np.minimum(pos, len(self.uids) - 1)
         hit = self.uids[pos] == uids
@@ -140,6 +148,10 @@ class ColVar(Mapping):
             return ColVar(uids[:0], self.vals[:0], self.tid, self.frac,
                           self.isbool,
                           None if self.objs is None else self.objs[:0])
+        if len(uids) == len(self.uids) and (uids is self.uids or (
+                uids[0] == self.uids[0] and uids[-1] == self.uids[-1]
+                and np.array_equal(uids, self.uids))):
+            return self
         pos = np.searchsorted(self.uids, uids)
         pos = np.minimum(pos, len(self.uids) - 1)
         hit = self.uids[pos] == uids

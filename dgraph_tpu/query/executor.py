@@ -37,6 +37,7 @@ from dgraph_tpu.models.types import (
     TypeID, Val, convert, sort_key, to_json_value, type_name,
 )
 from dgraph_tpu.cluster.coordinator import StaleSnapshot
+from dgraph_tpu.ops import setops
 from dgraph_tpu.query.colvar import ColVar, make_colvar
 from dgraph_tpu.query.retrigram import compile_trigram_query
 from dgraph_tpu.storage.tablet import Tablet
@@ -65,9 +66,19 @@ def _member_of(uids: np.ndarray, sorted_set: np.ndarray) -> np.ndarray:
 
 def _col_positions(srcs: np.ndarray, uids: np.ndarray):
     """Membership of `uids` in a sorted column: (pos, hit mask)."""
+    n = len(srcs)
+    if n and n == len(uids) and (srcs is uids or (
+            srcs[0] == uids[0] and srcs[-1] == uids[-1]
+            and np.array_equal(srcs, uids))):
+        # a has()-root scan over the column's own domain (the q020
+        # shape): identity gather, no O(n log n) searchsorted. The
+        # endpoint probes reject almost every length-equal miss
+        # before the full O(n) compare (array_equal does NOT
+        # short-circuit)
+        return np.arange(n), np.ones(n, bool)
     pos = np.searchsorted(srcs, uids)
-    pos = np.clip(pos, 0, max(len(srcs) - 1, 0))
-    hit = (srcs[pos] == uids) if len(srcs) else \
+    pos = np.clip(pos, 0, max(n - 1, 0))
+    hit = (srcs[pos] == uids) if n else \
         np.zeros(len(uids), bool)
     return pos, hit
 
@@ -117,10 +128,8 @@ def _flat_column(ex, ch, name: str, ulist: list, n: int):
     JSON-scalar type (mixed DEFAULT columns bail to the dict path)."""
     from dgraph_tpu import native as _native
 
-    colview = ch.tablet.value_columns(ex.read_ts) \
-        if hasattr(ch.tablet, "value_columns") else None
+    colview = ex._colview(ch.tablet)
     if colview is not None:
-        ex._budget_colview(ch.tablet, colview)
         col = _flat_column_vectorized(ex, ch, name, colview, n)
         if col is not None:
             return col
@@ -254,8 +263,13 @@ _TERM_FUNCS = {"anyofterms", "allofterms", "anyoftext", "alloftext"}
 
 
 def _np_sorted(uids) -> np.ndarray:
-    a = np.asarray(sorted(set(int(u) for u in uids)), dtype=np.uint64)
-    return a
+    # np.unique = one C sort + adjacent-dedup; the python
+    # sorted(set(...)) this replaces sat on every uid() root and var
+    # union
+    if isinstance(uids, np.ndarray):
+        return np.unique(uids.astype(np.uint64, copy=False))
+    arr = np.fromiter((int(u) for u in uids), dtype=np.uint64)
+    return np.unique(arr)
 
 
 def _var_domain(vmap) -> np.ndarray:
@@ -266,32 +280,12 @@ def _var_domain(vmap) -> np.ndarray:
     return _np_sorted(vmap.keys())
 
 
-def _intersect(a, b):
-    # inputs are sorted unique uid vectors (the repo-wide invariant).
-    # Emit loops intersect a tiny per-uid dst list against a large
-    # DestUIDs thousands of times per query; intersect1d re-sorts the
-    # concatenation every call, so use searchsorted membership when
-    # the sizes are lopsided (the reference picks lin/jump/bin search
-    # by the same ratio heuristic, algo/uidlist.go:151)
-    la, lb = len(a), len(b)
-    if la == 0 or lb == 0:
-        return _EMPTY
-    if la > lb:
-        a, b = b, a
-        la, lb = lb, la
-    if lb >= 16 * la:
-        idx = np.searchsorted(b, a)
-        np.minimum(idx, lb - 1, out=idx)
-        return a[b[idx] == a]
-    return np.intersect1d(a, b, assume_unique=True)
-
-
-def _union(a, b):
-    return np.union1d(a, b)
-
-
-def _difference(a, b):
-    return np.setdiff1d(a, b, assume_unique=True)
+# pairwise set algebra now lives in ops/setops (one implementation for
+# the executor, the k-way folds, and the microbench); inputs are sorted
+# unique uid vectors (the repo-wide invariant)
+_intersect = setops.intersect_pair
+_union = setops.union_pair
+_difference = setops.difference
 
 
 @dataclass
@@ -660,23 +654,23 @@ class Executor:
         return _np_sorted(ordered)
 
     def _root_uids(self, gq: GraphQuery) -> np.ndarray:
-        uids = _EMPTY
+        parts: list[np.ndarray] = []
         if gq.uids:
-            uids = _union(uids, _np_sorted(gq.uids))
+            parts.append(_np_sorted(gq.uids))
         func_args = {vc.name for vc in gq.func.needs_var} \
             if gq.func is not None else set()
         for vc in gq.needs_var:
             if vc.typ != VALUE_VAR and vc.name in self.uid_vars:
-                uids = _union(uids, self.uid_vars[vc.name])
+                parts.append(self.uid_vars[vc.name])
             elif vc.name in func_args and gq.func.name == "uid" \
                     and vc.name in self.value_vars \
                     and vc.name not in self.uid_vars:
                 # uid(valueVar) roots at the uids the var is defined on
                 # (ref query/query.go UidsFromVar)
-                uids = _union(uids, _var_domain(self.value_vars[vc.name]))
+                parts.append(_var_domain(self.value_vars[vc.name]))
         if gq.func is not None and gq.func.name != "uid":
-            uids = _union(uids, self._eval_func(gq.func, None))
-        return uids
+            parts.append(self._eval_func(gq.func, None))
+        return self._union_many(parts)
 
     # ------------------------------------------------------------------
     # root/filter functions (ref worker/task.go:1558 parseSrcFn +
@@ -698,6 +692,80 @@ class Executor:
                 f"retry at a fresh timestamp")
         return tab
 
+    # -- columnar scan tier plumbing -----------------------------------
+
+    def _columnar_on(self) -> bool:
+        """db.prefer_columnar=False pins reads to the exact posting
+        path — the differential parity suite's oracle."""
+        return getattr(self.db, "prefer_columnar", True)
+
+    def _colview(self, tab, lang: str | None = None):
+        """THE chokepoint every columnar value read goes through: the
+        tablet's cached column view (None on dirty/historical/mixed
+        tablets or with the tier disabled), budgeted against the tile
+        LRU and counted so BENCH_QUERIES can report tier routing."""
+        if not self._columnar_on() \
+                or not hasattr(tab, "value_columns"):
+            return None
+        cv = tab.lang_value_columns(self.read_ts, lang) if lang \
+            else tab.value_columns(self.read_ts)
+        if cv is None:
+            inc_counter("query_postings_fallback_total")
+            return None
+        from dgraph_tpu.engine.device_cache import host_column_tile
+        host_column_tile(
+            self.db, tab,
+            f"_val_cols_lang@{lang}" if lang else "_val_cols", cv)
+        inc_counter("query_colvar_hits_total")
+        return cv
+
+    def _index_sets(self, tab, toks: list[bytes]) -> list[np.ndarray]:
+        """Posting sets for a token batch: one CSR probe per token on
+        clean tablets (contiguous slices of one cached buffer, no
+        per-token overlay generator), the exact index_uids walk
+        otherwise."""
+        csr = tab.token_index_csr(self.read_ts) \
+            if self._columnar_on() and hasattr(tab, "token_index_csr") \
+            else None
+        if csr is None:
+            return [tab.index_uids(t, self.read_ts) for t in toks]
+        from dgraph_tpu.engine.device_cache import host_column_tile
+        host_column_tile(self.db, tab, "_tok_csr", csr)
+        inc_counter("query_index_csr_probe_total")
+        return [csr.probe(t) for t in toks]
+
+    # np.unique cost per element of a k-way union — the fixed side of
+    # the device-tier choice is the measured dispatch RTT
+    _HOST_PER_SETOP_EL = 2e-8
+    _DEVICE_RATIO_SETOP = 0.9  # device sort ≈ host sort at these sizes
+
+    def _union_many(self, parts: list[np.ndarray]) -> np.ndarray:
+        """k-way union; one device co-sort dispatch when the host cost
+        clears the RTT (uidvec.merge_many), else concat + one sort."""
+        if len(parts) >= 4 and self.db.prefer_device:
+            total = sum(len(p) for p in parts)
+            if total >= (1 << 17) and self._device_worth(
+                    total * self._HOST_PER_SETOP_EL,
+                    device_ratio=self._DEVICE_RATIO_SETOP):
+                got = setops.union_many_device(parts)
+                if got is not None:
+                    inc_counter("query_device_setops_total")
+                    return got
+        return setops.union_many(parts)
+
+    def _intersect_many(self, parts: list[np.ndarray]) -> np.ndarray:
+        """k-way intersection, smallest set first."""
+        if len(parts) >= 4 and self.db.prefer_device:
+            total = sum(len(p) for p in parts)
+            if total >= (1 << 17) and self._device_worth(
+                    total * self._HOST_PER_SETOP_EL,
+                    device_ratio=self._DEVICE_RATIO_SETOP):
+                got = setops.intersect_many_device(parts)
+                if got is not None:
+                    inc_counter("query_device_setops_total")
+                    return got
+        return setops.intersect_many(parts)
+
     def _eval_func(self, fn: Function, candidates: Optional[np.ndarray]
                    ) -> np.ndarray:
         name = fn.name
@@ -706,15 +774,16 @@ class Executor:
             # (ref query1:TestUidAttr: 'Argument cannot be "uid"')
             raise GQLError('Argument cannot be "uid"')
         if name == "uid":
-            uids = _np_sorted(fn.uids)
+            parts = [_np_sorted(fn.uids)]
             for vc in fn.needs_var:
                 if vc.name in self.uid_vars:
-                    uids = _union(uids, self.uid_vars[vc.name])
+                    parts.append(self.uid_vars[vc.name])
                 elif vc.name in self.value_vars:
                     # uid(valueVar): the uids the var is defined on
                     # (ref query/query.go UidsFromVar / outputnode uses)
-                    uids = _union(
-                        uids, _var_domain(self.value_vars[vc.name]))
+                    parts.append(
+                        _var_domain(self.value_vars[vc.name]))
+            uids = self._union_many(parts)
             return uids if candidates is None \
                 else _intersect(candidates, uids)
         if name == "type":
@@ -961,10 +1030,9 @@ class Executor:
         spec = get_tokenizer("geo")
         indexed = tab.schema.indexed and "geo" in tab.schema.tokenizers
         if indexed:
-            scan = _EMPTY
-            for t in G.query_tokens(bbox):
-                scan = _union(scan, tab.index_uids(
-                    token_bytes(spec.ident, t), self.read_ts))
+            scan = self._union_many(self._index_sets(
+                tab, [token_bytes(spec.ident, t)
+                      for t in G.query_tokens(bbox)]))
             if candidates is not None:
                 scan = _intersect(candidates, scan)
         elif candidates is not None:
@@ -1058,9 +1126,12 @@ class Executor:
         if spec is not None:
             # the query value must be analyzed the same way the indexed
             # values were: `eq(pred@de, ...)` uses the German analyzer;
-            # `@.` (any language) probes every analyzer's buckets
+            # `@.` (any language) probes every analyzer's buckets.
+            # Token probes batch into ONE index probe + ONE k-way
+            # union instead of per-token incremental union re-sorts
             langs = _probe_langs(spec, lang)
             no_tok_vals: list[Val] = []
+            all_toks: list[bytes] = []
             for v in vals:
                 v_toks = 0
                 for lg in langs:
@@ -1069,10 +1140,8 @@ class Executor:
                     except (ValueError, TypeError):
                         continue
                     v_toks += len(toks)
-                    for t in toks:
-                        got = tab.index_uids(token_bytes(spec.ident, t),
-                                             self.read_ts)
-                        out = _union(out, got)
+                    all_toks.extend(token_bytes(spec.ident, t)
+                                    for t in toks)
                 if not v_toks:
                     # a value no tokenizer emits tokens for (e.g. "")
                     # is absent from the index — PER VALUE, scan it
@@ -1080,6 +1149,8 @@ class Executor:
                     # TestQueryEmptyRoomsWithTermIndex; eq(room,
                     # ["", "green"]) must match both)
                     no_tok_vals.append(v)
+            if all_toks:
+                out = self._union_many(self._index_sets(tab, all_toks))
             if len(no_tok_vals) < len(vals):
                 if spec.lossy or tab.schema.lang:
                     # @lang predicates share index buckets across
@@ -1093,10 +1164,7 @@ class Executor:
                 if no_tok_vals:
                     scan = candidates if candidates is not None \
                         else tab.src_uids(self.read_ts)
-                    extra = np.asarray(
-                        [u for u in scan.tolist()
-                         if self._value_matches_eq(
-                             tab, u, no_tok_vals, lang)], np.uint64)
+                    extra = self._eq_scan(tab, scan, no_tok_vals, lang)
                     out = _union(out, extra)
                 return out if candidates is None \
                     else _intersect(candidates, out)
@@ -1104,9 +1172,82 @@ class Executor:
         # unindexed: value scan over candidates (filter context) or all
         scan = candidates if candidates is not None \
             else tab.src_uids(self.read_ts)
-        keep = [u for u in scan.tolist()
-                if self._value_matches_eq(tab, u, vals, lang)]
-        return np.asarray(keep, dtype=np.uint64)
+        return self._eq_scan(tab, scan, vals, lang)
+
+    def _eq_scan(self, tab, scan: np.ndarray, vals: list[Val],
+                 lang: str = "") -> np.ndarray:
+        """Equality scan over a sorted candidate vector: one vectorized
+        column compare on clean tablets, per-uid postings otherwise."""
+        got = self._eq_batch(tab, scan, vals, lang)
+        if got is not None:
+            return got
+        return np.asarray(
+            [u for u in scan.tolist()
+             if self._value_matches_eq(tab, u, vals, lang)], np.uint64)
+
+    def _eq_batch(self, tab, scan: np.ndarray, vals: list[Val],
+                  lang: str = "") -> Optional[np.ndarray]:
+        """Vectorized _value_matches_eq over the cached column view —
+        the per-uid get_postings verify loop collapsed to one gather +
+        one compare per query value. None keeps the exact path: dirty
+        tablets, specific language tags (the untagged column can't
+        answer them), datetime/geo columns, NUL-bearing payloads."""
+        if lang not in ("", "."):
+            return None
+        colview = self._colview(tab)
+        if colview is None:
+            return None
+        t = tab.schema.value_type
+        if t == TypeID.DEFAULT:
+            t = colview.tid if colview.tid != TypeID.DEFAULT \
+                else TypeID.STRING
+        if t not in (TypeID.STRING, TypeID.INT, TypeID.FLOAT,
+                     TypeID.BOOL):
+            return None
+        if lang == ".":
+            # '.' compares ANY posting: only string views track the
+            # lang-tagged side (extra_*); a numeric tablet could carry
+            # tagged postings the view never captured
+            if t != TypeID.STRING or not colview.extra_ok:
+                return None
+        wants = []
+        for v in vals:
+            try:
+                wants.append(convert(v, t).value)
+            except ValueError:
+                continue  # same skip as the per-posting loop
+        pos, hit = _col_positions(colview.srcs, scan)
+        sel = pos[hit]
+        if t == TypeID.STRING:
+            bc = colview.bytes_column()
+            if bc is None:
+                return None  # NUL-bearing payloads: exact path
+            main_b, extra_b = bc
+            col = main_b[sel]
+            m = np.zeros(len(sel), bool)
+            for w in wants:
+                wb = str(w).encode("utf-8")
+                if b"\x00" not in wb:  # a NUL-free column can't match
+                    m |= col == wb
+            parts = [scan[hit][m]]
+            if lang == "." and len(colview.extra_srcs):
+                em = np.isin(colview.extra_srcs, scan)
+                ecol = extra_b[em]
+                m2 = np.zeros(len(ecol), bool)
+                for w in wants:
+                    wb = str(w).encode("utf-8")
+                    if b"\x00" not in wb:
+                        m2 |= ecol == wb
+                parts.append(np.unique(colview.extra_srcs[em][m2]))
+            return setops.union_many(parts)
+        col = colview.data[sel]
+        m = np.zeros(len(sel), bool)
+        for w in wants:
+            try:
+                m |= col == (int(w) if t == TypeID.BOOL else w)
+            except (TypeError, OverflowError):
+                continue
+        return scan[hit][m]
 
     def _eval_eq_own_val(self, tab, fn: Function, candidates) -> np.ndarray:
         if tab is None:
@@ -1121,9 +1262,7 @@ class Executor:
         return np.asarray(keep, dtype=np.uint64)
 
     def _verify_eq(self, tab, uids, vals, lang: str = "") -> np.ndarray:
-        keep = [u for u in uids.tolist()
-                if self._value_matches_eq(tab, u, vals, lang)]
-        return np.asarray(keep, dtype=np.uint64)
+        return self._eq_scan(tab, uids, vals, lang)
 
     def _value_matches_eq(self, tab: Tablet, uid: int,
                           vals: list[Val], lang: str = "") -> bool:
@@ -1210,19 +1349,72 @@ class Executor:
             if dev is not None:
                 return dev if candidates is None \
                     else _intersect(candidates, dev)
-        if not hasattr(tab, "sort_key_arrays") or tab.dirty() \
-                or self.read_ts < tab.base_ts:
+        if not hasattr(tab, "sort_key_arrays") \
+                or self.read_ts < tab.base_ts \
+                or not self._columnar_on():
             pairs = self._sortkeys_for(tab)
             uids = np.fromiter(pairs.keys(), np.uint64, len(pairs))
             keys = np.fromiter(pairs.values(), np.int64, len(pairs))
+            order = np.argsort(uids, kind="stable")
+            uids, keys = uids[order], keys[order]
+        elif tab.dirty():
+            uids, keys = self._sortkeys_dirty(tab)
         else:
             uids, keys = tab.sort_key_arrays()
         if not len(uids):
             return _EMPTY
-        m = (keys > lo if lo_open else keys >= lo) & \
-            (keys < hi if hi_open else keys <= hi)
-        out = np.sort(uids[m])
+
+        def in_range(kk):
+            return (kk > lo if lo_open else kk >= lo) & \
+                (kk < hi if hi_open else kk <= hi)
+
+        if candidates is not None \
+                and len(uids) >= 2 * len(candidates):
+            # filter context with a narrower candidate set: gather the
+            # candidates' keys instead of masking the whole tablet
+            # column and re-intersecting (the q003-at-21M shape)
+            pos, hit = _col_positions(uids, candidates)
+            kk = keys[pos[hit]]
+            return candidates[hit][in_range(kk)]
+        out = np.sort(uids[in_range(keys)])
         return out if candidates is None else _intersect(candidates, out)
+
+    def _sortkeys_dirty(self, tab) -> tuple[np.ndarray, np.ndarray]:
+        """(uids, int64 sort keys) of a DIRTY tablet at read_ts: the
+        cached base arrays answer every overlay-untouched row; touched
+        rows re-read through the exact MVCC posting path and merge —
+        the same immutable/mutable split the device tiles use (ref
+        posting/mvcc.go). Replaces a full per-uid dict rebuild per
+        query on bulk-mutated stores."""
+        buids, bkeys = tab.sort_key_arrays()
+        touched = tab.overlay_srcs(self.read_ts)
+        if touched:
+            tarr = np.fromiter(touched, np.uint64, len(touched))
+            keep = ~np.isin(buids, tarr)
+            buids, bkeys = buids[keep], bkeys[keep]
+            ou: list[int] = []
+            ok: list[int] = []
+            for u in sorted(touched):
+                for p in tab.get_postings(int(u), self.read_ts):
+                    if p.lang:
+                        continue
+                    try:
+                        ok.append(sort_key(convert(
+                            p.value, tab.schema.value_type
+                            if tab.schema.value_type != TypeID.DEFAULT
+                            else p.value.tid)))
+                        ou.append(int(u))
+                    except ValueError:
+                        pass
+                    break
+            if ou:
+                buids = np.concatenate(
+                    [buids, np.asarray(ou, np.uint64)])
+                bkeys = np.concatenate(
+                    [bkeys, np.asarray(ok, np.int64)])
+                order = np.argsort(buids, kind="stable")
+                buids, bkeys = buids[order], bkeys[order]
+        return buids, bkeys
 
     def _device_range(self, tab, lo, hi, lo_open, hi_open
                       ) -> Optional[np.ndarray]:
@@ -1246,6 +1438,9 @@ class Executor:
         keep = []
         scan = candidates if candidates is not None \
             else tab.src_uids(self.read_ts)
+        batched = self._ineq_strings_batch(tab, scan, fn, want, hi2)
+        if batched is not None:
+            return batched
         for u in scan.tolist():
             for p in tab.get_postings(u, self.read_ts):
                 if not _lang_matches(p.lang, fn.lang or ""):
@@ -1262,6 +1457,44 @@ class Executor:
                     keep.append(u)
                     break
         return np.asarray(keep, dtype=np.uint64)
+
+    _INEQ_VEC = {
+        "le": lambda col, lo, hi: col <= lo,
+        "lt": lambda col, lo, hi: col < lo,
+        "ge": lambda col, lo, hi: col >= lo,
+        "gt": lambda col, lo, hi: col > lo,
+        "between": lambda col, lo, hi: (col >= lo) & (col <= hi),
+    }
+
+    def _ineq_strings_batch(self, tab, scan, fn, want: str,
+                            hi2) -> Optional[np.ndarray]:
+        """String inequality over the cached byte columns: UTF-8 byte
+        order IS codepoint order, so fixed-width byte compares equal
+        the host loop's str compares. Exact path stays for dirty
+        tablets, specific language tags and NUL-bearing payloads."""
+        lang = fn.lang or ""
+        if lang not in ("", "."):
+            return None
+        colview = self._colview(tab)
+        if colview is None \
+                or colview.tid not in (TypeID.STRING, TypeID.DEFAULT):
+            return None
+        if lang == "." and not colview.extra_ok:
+            return None
+        bc = colview.bytes_column()
+        if bc is None:
+            return None
+        wb = want.encode("utf-8")
+        hb = hi2.encode("utf-8") if hi2 is not None else None
+        cmp = self._INEQ_VEC[fn.name]
+        main_b, extra_b = bc
+        pos, hit = _col_positions(colview.srcs, scan)
+        parts = [scan[hit][cmp(main_b[pos[hit]], wb, hb)]]
+        if lang == "." and len(colview.extra_srcs):
+            em = np.isin(colview.extra_srcs, scan)
+            m2 = cmp(extra_b[em], wb, hb)
+            parts.append(np.unique(colview.extra_srcs[em][m2]))
+        return setops.union_many(parts)
 
     def _sortkeys_for(self, tab: Tablet) -> dict[int, int]:
         out = {}
@@ -1301,23 +1534,21 @@ class Executor:
         text = " ".join(a.value for a in fn.args)
         # `pred@.` (any language): a value matches if it satisfies the
         # all/any condition under at least one language's analyzer —
-        # per-analyzer evaluation, then union
-        out = _EMPTY
+        # per-analyzer evaluation, then union. Each analyzer's token
+        # probe is one batched CSR slice + one k-way set op
+        # (ops/setops) instead of a pairwise union/intersect fold
+        parts: list[np.ndarray] = []
         for lg in _probe_langs(spec, fn.lang or ""):
             toks = tokens_for(Val(TypeID.STRING, text), spec, lg)
             if not toks:
                 continue
-            sets = [tab.index_uids(token_bytes(spec.ident, t), self.read_ts)
-                    for t in toks]
+            sets = self._index_sets(
+                tab, [token_bytes(spec.ident, t) for t in toks])
             if fn.name.startswith("all"):
-                got = sets[0]
-                for s in sets[1:]:
-                    got = _intersect(got, s)
+                parts.append(self._intersect_many(sets))
             else:
-                got = _EMPTY
-                for s in sets:
-                    got = _union(got, s)
-            out = _union(out, got)
+                parts.append(setops.union_many(sets))
+        out = self._union_many(parts)
         return out if candidates is None else _intersect(candidates, out)
 
     def _eval_anyof(self, fn: Function, candidates) -> np.ndarray:
@@ -1343,16 +1574,12 @@ class Executor:
                 Val(TypeID.STRING, str(a.value)), spec))
         if not toks:
             return _EMPTY
-        sets = [tab.index_uids(token_bytes(spec.ident, t), self.read_ts)
-                for t in toks]
+        sets = self._index_sets(
+            tab, [token_bytes(spec.ident, t) for t in toks])
         if fn.name == "allof":
-            got = sets[0]
-            for s in sets[1:]:
-                got = _intersect(got, s)
+            got = self._intersect_many(sets)
         else:
-            got = _EMPTY
-            for s in sets:
-                got = _union(got, s)
+            got = self._union_many(sets)
         return got if candidates is None else _intersect(candidates, got)
 
     def _eval_regexp(self, fn: Function, candidates) -> np.ndarray:
@@ -1395,8 +1622,9 @@ class Executor:
         OR, as in the reference's trigram query algebra."""
         spec = get_tokenizer("trigram")
 
-        def lookup(t: str) -> np.ndarray:
-            return tab.index_uids(token_bytes(spec.ident, t), self.read_ts)
+        def lookup_all(trigrams) -> list[np.ndarray]:
+            return self._index_sets(
+                tab, [token_bytes(spec.ident, t) for t in trigrams])
 
         def ev(node) -> Optional[np.ndarray]:
             if node.op == "all":
@@ -1404,30 +1632,28 @@ class Executor:
             if node.op == "none":
                 return _EMPTY
             if node.op == "and":
-                cur = None
-                for t in node.trigrams:
-                    got = lookup(t)
-                    cur = got if cur is None else _intersect(cur, got)
-                    if cur.size == 0:
-                        return cur
+                parts = lookup_all(node.trigrams) if node.trigrams \
+                    else []
+                if parts:
+                    first = self._intersect_many(parts)
+                    if first.size == 0:
+                        return first  # dead branch: skip the subs
+                    parts = [first]
                 for s in node.subs:
                     got = ev(s)
-                    if got is None:
-                        continue
-                    cur = got if cur is None else _intersect(cur, got)
-                    if cur is not None and cur.size == 0:
-                        return cur
-                return cur
+                    if got is not None:
+                        parts.append(got)
+                if not parts:
+                    return None  # every child unconstrained
+                return self._intersect_many(parts)
             # OR
-            cur = _EMPTY
-            for t in node.trigrams:
-                cur = _union(cur, lookup(t))
+            parts = lookup_all(node.trigrams) if node.trigrams else []
             for s in node.subs:
                 got = ev(s)
                 if got is None:
                     return None
-                cur = _union(cur, got)
-            return cur
+                parts.append(got)
+            return self._union_many(parts)
 
         return ev(q)
 
@@ -1438,8 +1664,7 @@ class Executor:
         semantics, no get_postings walk per uid). Lang-tagged extras
         verify in the same pass, so mixed uids match like the host
         loop."""
-        colview = tab.value_columns(self.read_ts) \
-            if hasattr(tab, "value_columns") else None
+        colview = self._colview(tab)
         if colview is None or colview.enc is None \
                 or colview.tid not in (TypeID.STRING, TypeID.DEFAULT) \
                 or not colview.extra_ok or not colview.ascii_only \
@@ -1449,7 +1674,6 @@ class Executor:
             rxb = _re.compile(pattern.encode("ascii"), flags)
         except _re.error:
             return None
-        self._budget_colview(tab, colview)
         srcs, _tid, _data, enc = colview
         pos, hit = _col_positions(srcs, scan)
         search = rxb.search
@@ -1495,9 +1719,8 @@ class Executor:
                     # "shares any trigram" union from ~2M candidates
                     # to thousands. One concat + unique-with-counts
                     # also replaces T incremental unions.
-                    buckets = [tab.index_uids(
-                        token_bytes(spec.ident, t), self.read_ts)
-                        for t in toks]
+                    buckets = self._index_sets(
+                        tab, [token_bytes(spec.ident, t) for t in toks])
                     buckets = [b for b in buckets if len(b)]
                     if buckets:
                         need = max(1, len(toks) - 3 * maxd)
@@ -1505,10 +1728,7 @@ class Executor:
                         scan = _nat.merge_count(buckets, need) \
                             if _nat.available() else None
                         if scan is None:
-                            uids, counts = np.unique(
-                                np.concatenate(buckets),
-                                return_counts=True)
-                            scan = uids[counts >= need]
+                            scan = setops.count_filter(buckets, need)
                     else:
                         scan = _EMPTY
         if scan is None:
@@ -1517,14 +1737,6 @@ class Executor:
         if batched is not None:
             return batched
         return self._match_scan(tab, scan, want, maxd)
-
-    def _budget_colview(self, tab, colview) -> None:
-        """Account the host-side column copy against the tile budget —
-        put only on first sight (a put per query would re-scan the LRU
-        under its lock for nothing), touch afterwards."""
-        cache = self.db.device_cache
-        if not cache.touch(tab, "_val_cols"):
-            cache.put(tab, "_val_cols", colview)
 
     def _match_scan(self, tab, scan, want: str, maxd: int) -> np.ndarray:
         # case-sensitive over code points, like the reference's
@@ -1548,13 +1760,13 @@ class Executor:
         mixed uids match identically to _match_scan."""
         from dgraph_tpu import native as _native
 
-        colview = tab.value_columns(self.read_ts) \
-            if hasattr(tab, "value_columns") else None
+        colview = self._colview(tab)
         if colview is None or colview.enc is None \
                 or colview.tid not in (TypeID.STRING, TypeID.DEFAULT) \
-                or not colview.extra_ok or not _native.available():
+                or not colview.extra_ok:
             return None
-        self._budget_colview(tab, colview)
+        if not _native.available():
+            return self._match_batch_np(colview, scan, want, maxd)
         srcs, _tid, _data, enc = colview
 
         def masked(cand_srcs, payloads):
@@ -1587,6 +1799,77 @@ class Executor:
         inc_counter("query_match_batch_total")
         out = np.unique(np.concatenate(keep))
         return out
+
+    def _match_batch_np(self, colview, scan, want: str,
+                        maxd: int) -> Optional[np.ndarray]:
+        """match() verify without the native extension: Myers
+        bit-parallel edit distance (ops/editdist) over the cached byte
+        matrix — every candidate in ~15 numpy ops per payload column
+        instead of a per-uid python DP (the whole q015 budget when the
+        C++ kernel isn't built). Byte scores equal codepoint distances
+        only for ASCII rows; the kernel flags the rest (-1) and they
+        re-verify on the exact path."""
+        from dgraph_tpu.ops.editdist import levenshtein_scores
+
+        if not want or not want.isascii() or len(want) > 63:
+            return None  # outside the bit-parallel kernel's domain
+        bc = colview.bytes_column()
+        if bc is None:
+            return None
+        main_b, extra_b = bc
+
+        m = len(want)
+
+        def verify(cand_uids, barr, enc_list, idx):
+            if not len(cand_uids):
+                return cand_uids
+            sub = np.ascontiguousarray(barr)
+            mat = sub.view(np.uint8).reshape(
+                len(sub), sub.dtype.itemsize)
+            lens = np.char.str_len(sub)
+            # length band: |len(b) - len(a)| > maxd means distance >
+            # maxd. Byte length >= codepoint count, so the LOW side is
+            # exact for every row; the high side is exact only for
+            # ASCII rows — longer non-ASCII rows re-verify exactly
+            low = lens < m - maxd
+            up = lens > m + maxd
+            run = ~(low | up)
+            keep = np.zeros(len(cand_uids), bool)
+            if run.any():
+                ridx = np.nonzero(run)[0]
+                scores = levenshtein_scores(want, mat[ridx],
+                                            lens[ridx])
+                if scores is None:
+                    return None
+                keep[ridx[(scores >= 0) & (scores <= maxd)]] = True
+                for i in ridx[scores == -1].tolist():
+                    s = enc_list[int(idx[i])].decode("utf-8")
+                    if _levenshtein(s, want, maxd) <= maxd:
+                        keep[i] = True
+            if up.any():
+                uidx = np.nonzero(up)[0]
+                for i in uidx[(mat[uidx] >= 0x80).any(axis=1)].tolist():
+                    s = enc_list[int(idx[i])].decode("utf-8")
+                    if _levenshtein(s, want, maxd) <= maxd:
+                        keep[i] = True
+            return cand_uids[keep]
+
+        pos, hit = _col_positions(colview.srcs, scan)
+        sel = pos[hit]
+        got = verify(scan[hit], main_b[sel], colview.enc, sel)
+        if got is None:
+            return None
+        parts = [got]
+        if len(colview.extra_srcs):
+            em = np.isin(colview.extra_srcs, scan)
+            eidx = np.nonzero(em)[0]
+            egot = verify(colview.extra_srcs[em], extra_b[em],
+                          colview.extra_enc, eidx)
+            if egot is None:
+                return None
+            parts.append(np.unique(egot))
+        inc_counter("query_match_batch_total")
+        return setops.union_many(parts)
 
     def _eval_uid_in(self, fn: Function, candidates) -> np.ndarray:
         """uid_in(pred, uids) — also over reverse edges: uid_in(~pred, X)
@@ -1805,10 +2088,11 @@ class Executor:
                 out = self._eval_filter(c, out)
             return out
         if ft.op == "or":
-            out = _EMPTY
-            for c in ft.children:
-                out = _union(out, self._eval_filter(c, candidates))
-            return out
+            # k-way: one merge over every branch instead of a pairwise
+            # accumulator re-sort per child (ref algo.MergeSorted)
+            return self._union_many(
+                [self._eval_filter(c, candidates)
+                 for c in ft.children])
         if ft.op == "not":
             sub = self._eval_filter(ft.children[0], candidates)
             return _difference(candidates, sub)
@@ -2143,11 +2427,9 @@ class Executor:
                 or gq.facets is not None or gq.facets_filter is not None \
                 or gq.children or tab.schema.list_:
             return None
-        colview = tab.value_columns(self.read_ts) \
-            if hasattr(tab, "value_columns") else None
+        colview = self._colview(tab)
         if colview is None:
             return None
-        self._budget_colview(tab, colview)
         srcs, tid, data, enc = colview
         pos, hit = _col_positions(srcs, src)
         sel = pos[hit]
@@ -2160,7 +2442,8 @@ class Executor:
         else:
             # STRING/DEFAULT/DATETIME columns carry the exact
             # to_json_value payload (isoformat for datetimes)
-            vals = [enc[j].decode("utf-8") for j in sel.tolist()]
+            dec = colview.decoded()
+            vals = [dec[j] for j in sel.tolist()]
         return dict(zip(uids, vals))
 
     def _bind_var_columnar(self, node: ExecNode, gq, tab,
@@ -2175,15 +2458,13 @@ class Executor:
                 or gq.children or gq.facets is not None \
                 or getattr(self, "_block_emits", True):
             return False
-        colview = tab.value_columns(self.read_ts) \
-            if hasattr(tab, "value_columns") else None
+        colview = self._colview(tab)
         if colview is None or len(colview.extra_srcs) \
                 or colview.tid == TypeID.DATETIME:
             # lang-tagged postings need _select_posting semantics; a
             # DATETIME column caches ISO strings but the var needs the
             # datetime value — both keep the per-posting walk
             return False
-        self._budget_colview(tab, colview)
         srcs, tid, data, enc = colview
         pos, hit = _col_positions(srcs, src)
         sel = pos[hit]
@@ -2196,8 +2477,9 @@ class Executor:
             self.value_vars[gq.var] = make_colvar(src[hit], data[sel],
                                                   tid)
         else:
+            dec = colview.decoded()
             self.value_vars[gq.var] = {
-                u: Val(tid, enc[j].decode("utf-8"))
+                u: Val(tid, dec[j])
                 for u, j in zip(src[hit].tolist(), sel.tolist())}
         return True
 
@@ -2213,11 +2495,9 @@ class Executor:
                 or gq.children or gq.facets is not None \
                 or tab.schema.list_:
             return False
-        colview = tab.value_columns(self.read_ts) \
-            if hasattr(tab, "value_columns") else None
+        colview = self._colview(tab)
         if colview is None or len(colview.extra_srcs):
             return False
-        self._budget_colview(tab, colview)
         srcs, tid, data, enc = colview
         pos, hit = _col_positions(srcs, src)
         sel = pos[hit]
@@ -2233,9 +2513,11 @@ class Executor:
         elif tid == TypeID.DATETIME and colview.dt_secs is not None:
             vmap = ColVar(bound, colview.dt_secs[sel], TypeID.DATETIME,
                           objs=colview.dt_objs[sel])
-            vals = [enc[j].decode("utf-8") for j in sel.tolist()]
+            dec = colview.decoded()
+            vals = [dec[j] for j in sel.tolist()]
         elif tid in (TypeID.STRING, TypeID.DEFAULT):
-            vals = [enc[j].decode("utf-8") for j in sel.tolist()]
+            dec = colview.decoded()
+            vals = [dec[j] for j in sel.tolist()]
             vmap = {u: Val(tid, v)
                     for u, v in zip(bound.tolist(), vals)}
         else:
@@ -2409,6 +2691,10 @@ class Executor:
         override."""
         if self.db.device_min_edges <= 1:
             return True
+        if not self.db.device_is_accelerator():
+            # a CPU 'device' backend shares the host's silicon: XLA-CPU
+            # dispatches can only lose to the numpy columnar tier
+            return False
         margin = est_host_seconds * (1.0 - device_ratio)
         return margin > self.db.device_dispatch_seconds() * 1.25
 
@@ -2710,6 +2996,13 @@ class Executor:
     def _apply_order(self, orders, uids: np.ndarray) -> np.ndarray:
         """Multi-key value sort; stable, missing-value uids last
         (ref types/sort.go:118 + worker/sort.go)."""
+        # device_min_edges <= 1 is the explicit force-device override
+        # (tests, operators): it outranks the presorted host shortcut
+        forced = self.db.prefer_device and self.db.device_min_edges <= 1
+        if not forced:
+            fast = self._apply_order_presorted(orders, uids)
+            if fast is not None:
+                return fast
         if self.db.prefer_device and len(uids) >= 8 \
                 and self._device_worth(
                     len(uids) * len(orders) * self._HOST_PER_ORDER_KEY,
@@ -2717,6 +3010,10 @@ class Executor:
             dev = self._device_apply_order(orders, uids)
             if dev is not None:
                 return dev
+        if forced:
+            fast = self._apply_order_presorted(orders, uids)
+            if fast is not None:
+                return fast
         keyrows = [self._order_key_cols(o, uids) for o in orders]
         # lexsort: last key is primary
         cols = []
@@ -2726,6 +3023,45 @@ class Executor:
         cols.insert(0, uids)  # final tiebreak: uid asc
         order = np.lexsort(tuple(cols))
         return uids[order]
+
+    def _apply_order_presorted(self, orders, uids: np.ndarray
+                               ) -> Optional[np.ndarray]:
+        """Single-key order-by through the tablet's CACHED
+        (key, uid)-sorted permutation: one membership gather over the
+        pre-sorted column replaces the per-query key gather + lexsort
+        — worker/sort.go walks the value-ordered index the same way.
+        Only when the candidate set is a sizable fraction of the
+        column (streaming a 1M-row permutation to order 50 uids would
+        lose); missing-key uids append uid-ascending, identical to the
+        lexsort's missing-flag column."""
+        if len(orders) != 1 or not self._columnar_on():
+            return None
+        o = orders[0]
+        if o.attr == "uid" or o.attr.startswith(("val(", "facet:")) \
+                or o.lang in (".", "*"):
+            return None
+        tab = self._tablet(o.attr)
+        if tab is None or not hasattr(tab, "sorted_by_key_uids") \
+                or tab.dirty() or self.read_ts < tab.base_ts:
+            return None
+        suids, _skeys = tab.sort_key_arrays(o.lang or "")
+        if len(uids) * 8 < len(suids) or not len(suids):
+            return None
+        op, attr = tab.sorted_by_key_uids(o.lang or "", bool(o.desc))
+        from dgraph_tpu.engine.device_cache import host_column_tile
+        host_column_tile(self.db, tab, attr, op)
+        full, perm = op.uids, op.perm
+        inc_counter("query_order_presorted_total")
+        # probe in the SMALLER direction (candidates into the sorted
+        # column), then re-order the hit mask through the permutation
+        pos, hit = _col_positions(suids, uids)
+        mask = np.zeros(len(suids), bool)
+        mask[pos[hit]] = True
+        ordered = full[mask[perm]]
+        if len(ordered) == len(uids):
+            return ordered
+        rest = uids[~hit]  # no sort key: appended uid-ascending
+        return np.concatenate([ordered, rest])
 
     def _order_device_views(self, orders) -> Optional[list]:
         """DeviceValues views for every order key, or None when any
@@ -2969,7 +3305,7 @@ class Executor:
             col = np.zeros(len(arr), np.int64)
             return col, (-sub if o.desc else sub)
         if not attr.startswith(("val(", "facet:")) \
-                and o.lang not in (".", "*"):
+                and o.lang not in (".", "*") and self._columnar_on():
             # '.' / '*' tags resolve "any language" via
             # _select_posting; sort_key_pairs matches tags exactly, so
             # those keep the per-uid path
@@ -3475,7 +3811,8 @@ class Executor:
         if got is not False:
             return got
         et = c.tablet.edge_table(self.read_ts) \
-            if hasattr(c.tablet, "edge_table") else None
+            if self._columnar_on() and hasattr(c.tablet, "edge_table") \
+            else None
         out = None
         if et is not None:
             srcs, dsts = et
@@ -3614,6 +3951,9 @@ class Executor:
             # empty selection: rows emit nothing (ref query0:
             # TestMultiEmptyBlocks -> "you": [])
             return []
+        fast = self._emit_block_flat(node)
+        if fast is not None:
+            return fast
         out = []
         # count(uid) at block level: one summed object
         # (ref outputnode.go uid count emission)
@@ -3662,6 +4002,40 @@ class Executor:
             out = [row for o in out if o
                    for row in self._normalize(o)]
             out = [o for o in out if o]
+        return out
+
+    def _emit_block_flat(self, node: ExecNode) -> Optional[list]:
+        """Dict-output twin of _emit_block_flat_json: a uid block whose
+        children are all `uid` fields or columnar scalars (col_vals
+        built) emits via one tight gather loop — the general _emit_uid
+        walk re-decides langs/facets/cascade per row and dominated
+        flat-block profiles (q003). None keeps the exact emitter."""
+        gq = node.gq
+        if gq.normalize or gq.cascade or gq.ignore_reflex:
+            return None
+        specs = []
+        for ch in node.children:
+            cgq = ch.gq
+            if cgq.attr == "uid" and not cgq.is_count:
+                specs.append((cgq.alias or "uid", None))
+            elif ch.col_vals is not None and not cgq.is_count:
+                specs.append((cgq.alias or cgq.attr, ch.col_vals))
+            else:
+                return None
+        order = node.emit_order if node.emit_order is not None \
+            else node.dest.tolist()
+        out = []
+        for u in order:
+            obj = {}
+            for name, cv in specs:
+                if cv is None:
+                    obj[name] = hex(u)
+                else:
+                    v = cv.get(u)
+                    if v is not None:
+                        obj[name] = v
+            if obj:  # empty objects drop (ref outputnode.go)
+                out.append(obj)
         return out
 
     def _emit_uid(self, node: ExecNode, uid: int,
@@ -4025,7 +4399,7 @@ class Executor:
         predicates contribute one (uid, code) per valued member.
         Returns None -> caller keeps the exact per-uid path."""
         tab = self._tablet(ga.attr)
-        if tab is None:
+        if tab is None or not self._columnar_on():
             return None
         if tab.schema.value_type == TypeID.UID:
             if ga.lang or not hasattr(tab, "edge_table"):
@@ -4037,15 +4411,9 @@ class Executor:
             # dst uids ARE the codes — kept uint64 (an int64 cast
             # would render uids >= 2^63 as negative hex)
             return srcs, dsts, lambda c: hex(int(c))
-        if ga.lang:
-            col = tab.lang_value_columns(self.read_ts, ga.lang) \
-                if hasattr(tab, "lang_value_columns") else None
-        else:
-            col = tab.value_columns(self.read_ts) \
-                if hasattr(tab, "value_columns") else None
+        col = self._colview(tab, lang=ga.lang or None)
         if col is None:
             return None
-        self._budget_colview(tab, col)
         srcs, tid, data, enc = col
         if data is not None:
             if tid == TypeID.BOOL:
@@ -4154,6 +4522,34 @@ class Executor:
         tab = self._tablet(cgq.agg_pred)
         if tab is None:
             return None
+        if not cgq.langs:
+            colview = self._colview(tab)
+            if colview is not None and colview.data is not None \
+                    and colview.tid in (TypeID.INT, TypeID.FLOAT):
+                # max(name)-style predicate aggregation over a group:
+                # one gather in MEMBER order (float-sum rounding equals
+                # the posting walk's left fold) instead of a
+                # get_postings round per member. Untagged selection ==
+                # the column's own selection; tagged postings are never
+                # picked by an empty lang list, so extras don't matter
+                marr = np.asarray(members, np.uint64)
+                pos, hit = _col_positions(colview.srcs, marr)
+                arr = colview.data[pos[hit]]
+                if not len(arr):
+                    return None
+                tid = colview.tid
+                fn = cgq.agg_func
+                if fn == "min":
+                    return Val(tid, arr[int(np.argmin(arr))].item())
+                if fn == "max":
+                    return Val(tid, arr[int(np.argmax(arr))].item())
+                if fn in ("sum", "avg"):
+                    s = sum(arr.tolist())
+                    if fn == "avg":
+                        return Val(TypeID.FLOAT, s / len(arr))
+                    return Val(TypeID.INT if isinstance(s, int)
+                               else TypeID.FLOAT, s)
+                return None
         vals = []
         for u in members:
             ps = tab.get_postings(int(u), self.read_ts)

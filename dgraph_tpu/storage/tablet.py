@@ -51,7 +51,7 @@ class ValueColumns:
     __slots__ = ("srcs", "tid", "data", "enc", "nbytes",
                  "extra_srcs", "extra_enc", "extra_ok", "_ascii",
                  "_codes", "dt_secs", "dt_objs", "_blob",
-                 "_sort_safe")
+                 "_sort_safe", "_bytes", "_dec")
 
     def __init__(self, srcs, tid, data, enc,
                  extra_srcs=None, extra_enc=None, extra_ok=True):
@@ -62,6 +62,8 @@ class ValueColumns:
         self._codes = None
         self._blob = None
         self._sort_safe = None
+        self._bytes = None
+        self._dec = None
         # DATETIME tablets also carry the numeric column (float epoch
         # seconds, the dict math path's float() domain) plus the exact
         # datetime objects for var materialization
@@ -131,6 +133,47 @@ class ValueColumns:
         self._codes = (codes.astype(np.int64), table)
         return self._codes
 
+    def decoded(self) -> list:
+        """Payloads decoded back to str, ONCE per view lifetime — the
+        emission paths gather from this instead of re-decoding the
+        same bytes on every query (enc came from str.encode, so the
+        round-trip cannot fail)."""
+        if self._dec is None:
+            self._dec = [e.decode("utf-8") for e in self.enc or ()]
+        return self._dec
+
+    # fixed-width byte matrices are rows x WIDEST payload: bound the
+    # footprint so one multi-KB outlier payload can't inflate a
+    # million-row column into gigabytes on the first string compare
+    _BYTES_COL_CAP = 64 << 20
+
+    def bytes_column(self):
+        """(untagged 'S' array aligned to srcs, extra 'S' array aligned
+        to extra_srcs) for vectorized string compares: UTF-8 byte order
+        equals codepoint order, so fixed-width byte comparisons ARE the
+        host loop's str comparisons. None when any payload embeds a NUL
+        byte — the 'S' dtype strips trailing NULs, which would conflate
+        distinct values — or when the rows x max-width matrix would
+        exceed the footprint cap. Cached for the view's lifetime."""
+        if self._bytes is not None:
+            return self._bytes or None
+        wid = max((len(e) for e in self.enc or ()), default=1)
+        ewid = max((len(e) for e in self.extra_enc), default=1)
+        if len(self.enc or ()) * wid > self._BYTES_COL_CAP \
+                or len(self.extra_enc) * ewid > self._BYTES_COL_CAP:
+            self._bytes = False
+            return None
+        if any(b"\x00" in e for e in self.enc or ()) \
+                or any(b"\x00" in e for e in self.extra_enc):
+            self._bytes = False
+            return None
+        main = np.asarray(self.enc, np.bytes_) if self.enc \
+            else np.empty(0, "S1")
+        extra = np.asarray(self.extra_enc, np.bytes_) \
+            if self.extra_enc else np.empty(0, "S1")
+        self._bytes = (main, extra)
+        return self._bytes
+
     def enc_sort_safe(self) -> bool:
         """True when sorting the DECODED payload strings by
         str((v,)) — the groupby output-ordering contract — equals
@@ -150,6 +193,54 @@ class ValueColumns:
                 self._sort_safe = bool(
                     ((b > 0x27) & (b < 127) & (b != 0x5C)).all())
         return self._sort_safe
+
+
+class TokenIndexCSR:
+    """CSR export of a clean tablet's token index: every posting list
+    concatenated into ONE sorted-run uid buffer with per-token offsets,
+    so a k-token probe is k dict hits + k contiguous slices feeding one
+    k-way merge (ops/setops) — no per-token overlay generators, no
+    k-1 incremental union re-sorts.  The reference's UidPack blocks
+    play the same role for its posting iterator (codec/codec.go:43).
+
+    Exposes .nbytes so DeviceCacheLRU budgets it like a device tile."""
+
+    __slots__ = ("rows", "offsets", "uids", "nbytes")
+
+    def __init__(self, index: dict[bytes, np.ndarray]):
+        toks = list(index.keys())
+        self.rows = {t: i for i, t in enumerate(toks)}
+        self.offsets = np.zeros(len(toks) + 1, np.int64)
+        if toks:
+            np.cumsum([len(index[t]) for t in toks],
+                      out=self.offsets[1:])
+            self.uids = np.concatenate(
+                [np.asarray(index[t], np.uint64) for t in toks]) \
+                if int(self.offsets[-1]) else _EMPTY.copy()
+        else:
+            self.uids = _EMPTY.copy()
+        self.nbytes = int(self.uids.nbytes) + int(self.offsets.nbytes) \
+            + sum(len(t) + 49 for t in toks)
+
+    def probe(self, token: bytes) -> np.ndarray:
+        """The token's sorted posting slice (empty when absent)."""
+        i = self.rows.get(token)
+        if i is None:
+            return _EMPTY
+        return self.uids[int(self.offsets[i]): int(self.offsets[i + 1])]
+
+
+class OrderPermutation:
+    """One cached (key, uid)-sorted view of a sort-key column:
+    `uids` in emission order, `perm` the permutation back into
+    sort_key_arrays. Exposes .nbytes for the tile LRU."""
+
+    __slots__ = ("uids", "perm", "nbytes")
+
+    def __init__(self, uids: np.ndarray, perm: np.ndarray):
+        self.uids = uids
+        self.perm = perm
+        self.nbytes = int(uids.nbytes) + int(perm.nbytes)
 
 
 @dataclass
@@ -447,9 +538,48 @@ class Tablet:
     def get_postings_at_base(self, src: int) -> list[Posting]:
         return list(self.values.get(src, ()))
 
+    def token_index_csr(self, read_ts: int):
+        """CSR export of the token index for batched probes (clean
+        tablets only — overlay-carrying reads keep the exact per-token
+        index_uids path). Cached per (base_ts, schema object), like
+        value_columns: alter() rebinds the schema and rebuild_index
+        replaces the dict, so both invalidators are covered."""
+        if self.dirty() or read_ts < self.base_ts \
+                or not self.schema.indexed:
+            return None
+        if len(self.index) > (1 << 18):
+            # mostly-exact-token indexes (one tiny posting list per
+            # distinct value): the python-loop concat of a million
+            # arrays costs seconds per rollup while contiguous slices
+            # buy nothing over dict gets — keep the direct path
+            return None
+        cached = getattr(self, "_tok_csr", None)
+        if cached is not None \
+                and getattr(self, "_tok_csr_ts", -1) == self.base_ts \
+                and getattr(self, "_tok_csr_schema", None) \
+                is self.schema:
+            return cached
+        csr = TokenIndexCSR(self.index)
+        self._tok_csr = csr
+        self._tok_csr_ts = self.base_ts
+        self._tok_csr_schema = self.schema
+        return csr
+
     def src_uids(self, read_ts: int) -> np.ndarray:
         """All uids with >=1 posting — has() root. Ref
-        worker/task.go:2075."""
+        worker/task.go:2075. Clean tablets answer from one sorted
+        array cached per base_ts: dict keys are unique already, so the
+        python-set pass the overlay path needs is pure overhead here
+        (a 1M-row has() root rebuilt a 1M-entry set every query)."""
+        if not self.deltas:
+            cached = getattr(self, "_src_uids_cache", None)
+            if cached is not None and cached[0] == self.base_ts:
+                return cached[1]
+            store = self.edges if self.is_uid else self.values
+            out = np.fromiter(store.keys(), np.uint64, len(store))
+            out.sort()
+            self._src_uids_cache = (self.base_ts, out)
+            return out
         base = set(self.edges) if self.is_uid else set(self.values)
         for op in self._overlay(read_ts):
             if op.op == "set":
@@ -460,28 +590,33 @@ class Tablet:
                 pass  # conservative: cheap check below
         out = np.fromiter(base, dtype=np.uint64, count=len(base))
         out.sort()
-        if self.deltas:
-            # exact: drop uids whose postings are now empty
-            keep = [u for u in out.tolist()
-                    if (len(self.get_dst_uids(u, read_ts)) if self.is_uid
-                        else len(self.get_postings(u, read_ts)))]
-            out = np.asarray(keep, dtype=np.uint64)
-        return out
+        # exact: drop uids whose postings are now empty
+        keep = [u for u in out.tolist()
+                if (len(self.get_dst_uids(u, read_ts)) if self.is_uid
+                    else len(self.get_postings(u, read_ts)))]
+        return np.asarray(keep, dtype=np.uint64)
 
     def dst_uids(self, read_ts: int) -> np.ndarray:
         """All uids appearing as an edge destination — the reverse-side
         analogue of src_uids (root scans over `~pred`)."""
+        if not self.deltas:
+            cached = getattr(self, "_dst_uids_cache", None)
+            if cached is not None and cached[0] == self.base_ts:
+                return cached[1]
+            out = np.fromiter(self.reverse.keys(), np.uint64,
+                              len(self.reverse))
+            out.sort()
+            self._dst_uids_cache = (self.base_ts, out)
+            return out
         base = set(self.reverse)
         for op in self._overlay(read_ts):
             if op.op == "set" and self.is_uid:
                 base.add(op.dst)
         out = np.fromiter(base, dtype=np.uint64, count=len(base))
         out.sort()
-        if self.deltas:
-            keep = [u for u in out.tolist()
-                    if len(self.get_reverse_uids(u, read_ts))]
-            out = np.asarray(keep, dtype=np.uint64)
-        return out
+        keep = [u for u in out.tolist()
+                if len(self.get_reverse_uids(u, read_ts))]
+        return np.asarray(keep, dtype=np.uint64)
 
     def expand_frontier(self, frontier: np.ndarray, read_ts: int,
                         reverse: bool = False) -> np.ndarray:
@@ -574,18 +709,18 @@ class Tablet:
         """Columnar view of ONE language's postings (first posting per
         uid tagged `lang`) — the lang-tagged groupby/gather analogue of
         value_columns. Same clean-tablet contract; cached per
-        (base_ts, lang)."""
+        (base_ts, lang) under a per-lang attribute so each language's
+        column copy is individually budgeted/evictable by the tile
+        LRU (one shared key would account only the first language)."""
         if self.dirty() or read_ts < self.base_ts or self.schema.list_:
             return None
-        cache = getattr(self, "_val_cols_lang", None)
-        if cache is None or self._val_cols_lang_ts != self.base_ts \
-                or self._val_cols_lang_schema is not self.schema:
-            cache = {}
-            self._val_cols_lang = cache
-            self._val_cols_lang_ts = self.base_ts
-            self._val_cols_lang_schema = self.schema
-        if lang in cache:
-            return cache[lang] or None
+        attr = f"_val_cols_lang@{lang}"
+        cached = getattr(self, attr, None)
+        if cached is not None \
+                and getattr(self, attr + "_ts", -1) == self.base_ts \
+                and getattr(self, attr + "_schema", None) \
+                is self.schema:
+            return cached or None
         from dgraph_tpu.models.types import TypeID
         srcs: list[int] = []
         vals: list = []
@@ -602,8 +737,8 @@ class Tablet:
             if tid is None:
                 tid = v.tid
             elif v.tid is not tid:
-                cache[lang] = False
-                return None
+                tid = False  # mixed types: exact path only
+                break
             srcs.append(u)
             vals.append(v.value)
         out = None
@@ -615,7 +750,9 @@ class Tablet:
                     np.asarray(srcs, np.uint64)[order], tid, None, enc)
             except (AttributeError, ValueError):
                 out = None
-        cache[lang] = out if out is not None else False
+        setattr(self, attr, out if out is not None else False)
+        setattr(self, attr + "_ts", self.base_ts)
+        setattr(self, attr + "_schema", self.schema)
         return out
 
     def edge_table(self, read_ts: int):
@@ -1004,6 +1141,36 @@ class Tablet:
         uids, keys = uids[order], keys[order]
         self._sk_arrays = (tag, uids, keys)
         return uids, keys
+
+    def sorted_by_key_uids(self, lang: str = "", desc: bool = False):
+        """(OrderPermutation, cache attr) — uids ordered by
+        (key, uid asc), asc or desc on the key, ties always
+        uid-ascending (the executor's lexsort contract), plus the
+        permutation into sort_key_arrays. A single-key order-by over a
+        large candidate set then reduces to ONE membership gather
+        through this cached permutation instead of a per-query lexsort
+        (ref worker/sort.go walks the value-ordered index the same
+        way); the permutation lets the caller probe in the SMALLER
+        direction (candidates into the uid-sorted column) and re-order
+        the hit mask. Cached per (base_ts, schema) under a per-
+        (lang, desc) attribute so DeviceCacheLRU can budget and evict
+        each entry (the attr is the caller's budget key)."""
+        attr = f"_ordperm@{lang}@{'d' if desc else 'a'}"
+        cached = getattr(self, attr, None)
+        if cached is not None \
+                and getattr(self, attr + "_ts", -1) == self.base_ts \
+                and getattr(self, attr + "_schema", None) \
+                is self.schema:
+            return cached, attr
+        uids, keys = self.sort_key_arrays(lang)
+        # desc via bitwise-not: monotone-decreasing int64 map with no
+        # INT64_MIN negation overflow
+        order = np.lexsort((uids, ~keys if desc else keys))
+        out = OrderPermutation(uids[order], order)
+        setattr(self, attr, out)
+        setattr(self, attr + "_ts", self.base_ts)
+        setattr(self, attr + "_schema", self.schema)
+        return out, attr
 
     def sort_key_pairs(self, lang: str = "") -> dict[int, int]:
         """uid -> int64 sort key for ORDERING in `lang`. Unlike

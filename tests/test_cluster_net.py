@@ -133,9 +133,17 @@ def test_follower_serves_reads_and_redirects_writes(alpha):
                 break
             time.sleep(0.2)
         assert got["result"]["data"]["q"] == [{"name": "carol"}]
-        # a write through the follower client still lands (redirect)
+        # a write through the follower client still lands (redirect).
+        # Reads serve from ANY replica, so allow the same replication
+        # lag the carol read above waits out — the queried node may be
+        # a follower that hasn't applied the commit yet.
         follower_client.mutate(set_nquads='_:b <name> "dave" .')
-        got = client.query('{ q(func: eq(name, "dave")) { name } }')
+        end = time.monotonic() + 15
+        while time.monotonic() < end:
+            got = client.query('{ q(func: eq(name, "dave")) { name } }')
+            if got["data"]["q"]:
+                break
+            time.sleep(0.2)
         assert got["data"]["q"] == [{"name": "dave"}]
     finally:
         follower_client.close()

@@ -1,0 +1,108 @@
+"""ops/setops: k-way sorted-set algebra — host folds and their device
+(uidvec co-sort) variants must agree with the naive numpy oracles on
+randomized inputs, including empty/singleton/degenerate shapes."""
+
+import os
+from functools import reduce
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.ops import setops
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu"),
+    reason="needs a jax backend for the device variants")
+
+
+def _rand_sets(rng, k, lo=0, hi=1 << 20, maxlen=4000):
+    out = []
+    for _ in range(k):
+        n = int(rng.integers(0, maxlen))
+        out.append(np.unique(
+            rng.integers(lo, hi, n).astype(np.uint64)))
+    return out
+
+
+def _oracle_union(parts):
+    if not parts:
+        return np.empty(0, np.uint64)
+    return reduce(np.union1d, parts).astype(np.uint64)
+
+
+def _oracle_intersect(parts):
+    if not parts:
+        return np.empty(0, np.uint64)
+    return reduce(
+        lambda a, b: np.intersect1d(a, b, assume_unique=True),
+        parts).astype(np.uint64)
+
+
+@pytest.mark.parametrize("k", [1, 2, 3, 8, 33])
+def test_union_many_host(k):
+    rng = np.random.default_rng(k)
+    for trial in range(4):
+        parts = _rand_sets(rng, k)
+        got = setops.union_many(parts)
+        assert np.array_equal(got, _oracle_union(parts))
+
+
+@pytest.mark.parametrize("k", [1, 2, 3, 8, 33])
+def test_intersect_many_host(k):
+    rng = np.random.default_rng(100 + k)
+    for trial in range(4):
+        # overlap-heavy sets so intersections are non-trivial
+        parts = _rand_sets(rng, k, hi=3000)
+        got = setops.intersect_many(parts)
+        assert np.array_equal(got, _oracle_intersect(parts))
+
+
+def test_edge_cases():
+    e = np.empty(0, np.uint64)
+    a = np.array([1, 5, 9], np.uint64)
+    assert len(setops.union_many([])) == 0
+    assert len(setops.intersect_many([])) == 0
+    assert np.array_equal(setops.union_many([e, a, e]), a)
+    assert len(setops.intersect_many([a, e])) == 0
+    assert np.array_equal(setops.union_many([a]), a)
+    assert np.array_equal(setops.intersect_many([a]), a)
+    # lopsided pair takes the galloping branch
+    big = np.arange(0, 100000, 3, dtype=np.uint64)
+    assert np.array_equal(setops.intersect_pair(a, big),
+                          np.intersect1d(a, big))
+    assert np.array_equal(setops.difference(big[:50], big[20:]),
+                          big[:20])
+
+
+@pytest.mark.parametrize("need", [1, 2, 5, 8, 17, 18])
+def test_count_filter(need):
+    rng = np.random.default_rng(need)
+    parts = _rand_sets(rng, 17, hi=4000, maxlen=900)
+    got = setops.count_filter(parts, need)
+    cat = np.concatenate([p for p in parts if len(p)]) \
+        if any(len(p) for p in parts) else np.empty(0, np.uint64)
+    uids, counts = np.unique(cat, return_counts=True)
+    want = uids[counts >= need] if need <= 17 else uids[:0]
+    assert np.array_equal(got, want)
+
+
+def test_device_variants_parity():
+    rng = np.random.default_rng(7)
+    for k in (2, 5, 9):
+        parts = _rand_sets(rng, k, hi=5000, maxlen=800)
+        du = setops.union_many_device(parts)
+        assert du is not None
+        assert np.array_equal(du, _oracle_union(parts))
+        di = setops.intersect_many_device(parts)
+        assert di is not None
+        assert np.array_equal(di, _oracle_intersect(parts))
+
+
+def test_device_variants_reject_wide_uids():
+    wide = np.array([1, 2, 0xFFFFFFFF00], np.uint64)
+    other = np.array([1, 2, 3], np.uint64)
+    assert setops.union_many_device([wide, other]) is None
+    assert setops.intersect_many_device([wide, other]) is None
+    # host folds still answer them
+    assert np.array_equal(setops.union_many([wide, other]),
+                          _oracle_union([wide, other]))
