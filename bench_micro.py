@@ -87,8 +87,38 @@ def kway_bench():
                       "value": best, "unit": "x"}))
 
 
+def lint_timing_bench(runs: int = 3):
+    """`--lint-timing`: dglint wall time over the full tree (parse +
+    all 8 rules, dgraph_tpu/ + tests/). The budget is < 5 s so the
+    linter stays viable as a pre-commit / tier-1 CI gate; one JSON
+    line in the same shape as the other microbench metrics."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.dglint.core import build_project, lint_project
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    times = []
+    n_files = n_findings = 0
+    for _ in range(runs):
+        t0 = time.monotonic()
+        proj = build_project(["dgraph_tpu", "tests"], root)
+        findings = lint_project(proj)
+        times.append(time.monotonic() - t0)
+        n_files, n_findings = len(proj.files), len(findings)
+    med = float(np.median(times))
+    print(json.dumps({
+        "metric": "dglint_full_tree_s", "value": round(med, 3),
+        "unit": "s", "best_s": round(min(times), 3),
+        "files": n_files, "findings": n_findings,
+        "budget_s": 5.0, "within_budget": med < 5.0}))
+    return med
+
+
 def main():
     from dgraph_tpu.utils.backend import force_cpu_backend, probe_backend
+
+    if "--lint-timing" in sys.argv:
+        lint_timing_bench()
+        return
 
     kway_bench()
 

@@ -15,6 +15,14 @@ import time
 from typing import Any, Optional
 
 from dgraph_tpu import wire
+from dgraph_tpu.utils.reqctx import Cancelled, DeadlineExceeded, Overloaded
+
+# wire `aborted` field -> the typed error the serving node raised, so
+# a coordinator's retry loop (or the HTTP edge's 408/499/429 mapping)
+# sees cancellation as cancellation, not a generic RuntimeError
+_ABORT_TYPES = {"DeadlineExceeded": DeadlineExceeded,
+                "Cancelled": Cancelled,
+                "Overloaded": Overloaded}
 
 
 class ClusterClient:
@@ -153,7 +161,13 @@ class ClusterClient:
                 # never sleep past the deadline the caller set
                 time.sleep(min(0.1, max(0.0,
                                         deadline - time.monotonic())))
-            return {"ok": False, "error": last_err}
+            # with a caller-supplied budget this is EXPIRY, not a
+            # generic routing failure: the marker lets _unwrap raise
+            # DeadlineExceeded so the HTTP edge answers 408 retryable
+            # instead of 500 (elections in progress eat exactly this
+            # path)
+            return {"ok": False, "error": last_err,
+                    "deadline_expired": bounded}
 
     def close(self):
         with self._lock:
@@ -260,16 +274,31 @@ class ClusterClient:
         return self.request(req, deadline_s=None if deadline_s is None
                             else max(0.0, overall - time.monotonic()))
 
-    def mutate(self, **kw) -> dict:
-        return self._unwrap(self.request({"op": "mutate", "kw": kw}))
+    def _call(self, op: str, kw: dict,
+              deadline_ms: Optional[int]) -> Any:
+        """One deadline-bounded op RPC: `deadline_ms` rides the wire
+        (the serving leader inherits the remaining budget, reqctx
+        PROPAGATION_SKEW_S wide) AND bounds the routed-retry loop
+        here to the same clock — an expired client must not keep a
+        leader working on its behalf."""
+        req = {"op": op, "kw": kw}
+        deadline_s = None
+        if deadline_ms is not None:
+            req["deadline_ms"] = int(deadline_ms)
+            deadline_s = deadline_ms / 1000.0
+        return self._unwrap(self.request(req, deadline_s=deadline_s))
+
+    def mutate(self, deadline_ms: Optional[int] = None, **kw) -> dict:
+        return self._call("mutate", kw, deadline_ms)
 
     # dgo-style interactive txns: the group leader stages; commit
     # replicates (a leader change aborts open txns — retry)
-    def txn_mutate(self, start_ts: int = 0, **kw) -> dict:
+    def txn_mutate(self, start_ts: int = 0,
+                   deadline_ms: Optional[int] = None, **kw) -> dict:
         kw["commit_now"] = False
         if start_ts:
             kw["start_ts"] = start_ts
-        return self._unwrap(self.request({"op": "mutate", "kw": kw}))
+        return self._call("mutate", kw, deadline_ms)
 
     def txn_commit(self, start_ts: int, abort: bool = False) -> dict:
         return self._unwrap(self.request(
@@ -277,9 +306,10 @@ class ClusterClient:
              "params": {"startTs": str(start_ts),
                         "abort": "true" if abort else "false"}}))
 
-    def alter(self, schema_text: str = "", **kw) -> dict:
+    def alter(self, schema_text: str = "",
+              deadline_ms: Optional[int] = None, **kw) -> dict:
         kw["schema_text"] = schema_text
-        return self._unwrap(self.request({"op": "alter", "kw": kw}))
+        return self._call("alter", kw, deadline_ms)
 
     def members(self) -> dict:
         return self._unwrap(self.request({"op": "members"}))
@@ -335,5 +365,18 @@ class ClusterClient:
     @staticmethod
     def _unwrap(resp: dict) -> Any:
         if not resp.get("ok"):
+            # a serving node's typed cancellation/deadline marker
+            # (service.py _client_loop) re-raises TYPED here, so the
+            # HTTP/gRPC edges map it to 408/499/429 instead of 500
+            cls = _ABORT_TYPES.get(resp.get("aborted", ""))
+            if cls is not None:
+                raise cls(resp.get("error", resp["aborted"]))
+            if resp.get("deadline_expired"):
+                # the caller's budget died in the routing loop (e.g.
+                # an election outlasted it) — same typed outcome as a
+                # server-side expiry
+                raise DeadlineExceeded(
+                    "deadline exceeded while routing: "
+                    + resp.get("error", "rpc failed"))
             raise RuntimeError(resp.get("error", "rpc failed"))
         return resp["result"]

@@ -30,7 +30,10 @@ from dgraph_tpu.cluster.raft import (
 )
 from dgraph_tpu.cluster.transport import TcpTransport
 from dgraph_tpu.utils.logger import log
-from dgraph_tpu.utils.reqctx import PROPAGATION_SKEW_S, RequestContext
+from dgraph_tpu.utils.reqctx import (
+    PROPAGATION_SKEW_S, DeadlineExceeded, Overloaded, RequestAborted,
+    RequestContext,
+)
 
 import socket
 
@@ -78,7 +81,9 @@ class RaftServer:
         self._applied_since_snap = 0
         self._mark_seq = itertools.count(1)
         self._acked: dict[tuple, Any] = {}
-        self.epoch = int(time.time() * 1000) % (1 << 40)
+        # wall clock on purpose: the epoch must differ across process
+        # RESTARTS (monotonic restarts near zero every boot)
+        self.epoch = int(time.time() * 1000) % (1 << 40)  # dglint: disable=DG06
         self._stop = threading.Event()
         transport_peers = dict(self.members)
         if node_id in raft_peers:  # own listen addr always from CLI
@@ -372,6 +377,17 @@ class RaftServer:
                 except NotLeader as e:
                     resp = {"ok": False, "error": "not leader",
                             "leader": e.leader}
+                except RequestAborted as e:
+                    # cancellation/deadline crosses the wire TYPED:
+                    # ClusterClient._unwrap maps `aborted` back to the
+                    # reqctx exception (so the HTTP/gRPC edges answer
+                    # 408/499/429, not 500) and `retryable` marks
+                    # deadline/overload for jittered-backoff loops
+                    resp = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}",
+                            "aborted": type(e).__name__,
+                            "retryable": isinstance(
+                                e, (DeadlineExceeded, Overloaded))}
                 except Exception as e:  # surface, don't kill the conn
                     resp = {"ok": False,
                             "error": f"{type(e).__name__}: {e}"}
@@ -626,7 +642,7 @@ class AlphaServer(RaftServer):
                                 owner=got.get("result"),
                                 group=self.group)
             return True
-        except Exception as e:  # noqa: BLE001 — zero unreachable:
+        except Exception as e:  # noqa: BLE001 — zero unreachable:  # dglint: disable=DG07 (boot-time registration loop; no request context exists yet)
             # retry from the registration loop
             log.warning("boot_claim_retry", error=str(e))
             return False
@@ -658,7 +674,7 @@ class AlphaServer(RaftServer):
                 # ONE batched request, not one RPC per tablet
                 self.zero.request({"op": "tablet_sizes",
                                    "args": (sizes,)})
-            except Exception:  # noqa: BLE001 — best-effort report
+            except Exception:  # noqa: BLE001 — best-effort report  # dglint: disable=DG07 (daemon loop; no request context flows here)
                 pass
 
     # -------------------------------------------------------- state machine
@@ -726,7 +742,7 @@ class AlphaServer(RaftServer):
     def _evict_idle_txns(self, ttl_s: float = 300.0):
         """Abort open txns idle past the TTL (ref --abort_older_than).
         Caller holds self.lock."""
-        now = time.time()
+        now = time.monotonic()
         for ts, t in list(self._txn_touched.items()):
             if now - t > ttl_s:
                 txn = self._txns.pop(ts, None)
@@ -754,7 +770,7 @@ class AlphaServer(RaftServer):
         read-your-writes)."""
         if self.zero is None:
             return True
-        now = time.time()
+        now = time.monotonic()
         with self.lock:
             pend = [ts for ts in self.db.pending_txns
                     if upto_ts is None or ts < upto_ts]
@@ -802,6 +818,10 @@ class AlphaServer(RaftServer):
                         continue
                     status = {"commit_ts": final["result"]}
                 decided.append((int(status["commit_ts"]), st))
+            except RequestAborted:
+                # a cancelled/expired caller must not be absorbed
+                # into "retry next pass"
+                raise
             except Exception:  # noqa: BLE001 — next pass retries
                 ok = False
                 continue
@@ -852,6 +872,8 @@ class AlphaServer(RaftServer):
                 try:
                     got = self.zero.request({"op": "txn_status",
                                              "args": (st,)})
+                except RequestAborted:
+                    raise
                 except Exception:  # noqa: BLE001
                     return False
                 if not got.get("ok"):
@@ -862,6 +884,8 @@ class AlphaServer(RaftServer):
             for c, st in sorted(decided):
                 try:
                     self._replicate_record_locked(("xfinalize", st, c))
+                except RequestAborted:
+                    raise
                 except Exception:  # noqa: BLE001 — retried next pass
                     return False
                 with self.lock:
@@ -1139,6 +1163,10 @@ class AlphaServer(RaftServer):
             kw = dict(req["kw"])
             commit_now = kw.pop("commit_now", True)
             start_ts = kw.pop("start_ts", 0)
+            # a coordinator-propagated deadline bounds the stage too,
+            # not just reads — an expired client must not keep this
+            # group's leader staging on its behalf
+            ctx = self._req_ctx(req)
             preds = self._mutation_preds(kw) if self.zero else ()
             # commit-now mutations take the SAME stage-then-commit flow
             # as interactive txns: the commit handler drains decided
@@ -1198,7 +1226,7 @@ class AlphaServer(RaftServer):
                         raise NotLeader(self.node.leader_id)
                     try:
                         out = self.db.mutate(txn, commit_now=False,
-                                             **kw)
+                                             ctx=ctx, **kw)
                     except Exception:
                         # a failed stage aborts the whole txn (fail
                         # fast, like the reference's aborted TxnContext)
@@ -1207,7 +1235,7 @@ class AlphaServer(RaftServer):
                         self.db.discard(txn)
                         raise
                     self._txns[txn.start_ts] = txn
-                    self._txn_touched[txn.start_ts] = time.time()
+                    self._txn_touched[txn.start_ts] = time.monotonic()
                     out.setdefault("extensions", {})["txn"] = {
                         "start_ts": txn.start_ts}
             if commit_now:
@@ -1293,7 +1321,7 @@ class AlphaServer(RaftServer):
                         ("xstage", txn.start_ts, list(txn.staged),
                          schemas,
                          sorted(int(k) for k in txn.conflict_keys)))
-                    self._xstage_touched[txn.start_ts] = time.time()
+                    self._xstage_touched[txn.start_ts] = time.monotonic()
             return {"ok": True, "result": {
                 "extensions": {"txn": {"start_ts": start_ts,
                                        "commit_ts": commit_ts}}}}
@@ -1348,7 +1376,7 @@ class AlphaServer(RaftServer):
             self._replicate_record(
                 ("xstage", start_ts, staged, schemas,
                  sorted(int(k) for k in keys)))
-            self._xstage_touched[start_ts] = time.time()
+            self._xstage_touched[start_ts] = time.monotonic()
             # stale stages (coordinator died) reconcile via zero's
             # decision registry on the same TTL as idle txns
             self._reconcile_pending(evict_older_s=300.0)
@@ -1363,7 +1391,9 @@ class AlphaServer(RaftServer):
                 self._drain_finalizes(hint=(commit_ts, start_ts))
             return {"ok": True, "result": {"applied": known}}
         if op == "alter":
-            self._replicate_write(lambda db: db.alter(**req["kw"]))
+            ctx = self._req_ctx(req)
+            self._replicate_write(
+                lambda db: db.alter(ctx=ctx, **req["kw"]))
             return {"ok": True, "result": {}}
         if op == "status":
             with self.lock:
@@ -1470,9 +1500,9 @@ class ZeroServer(RaftServer):
                     if n > 20 and mv["phase"] == "start":
                         try:
                             self._abort_move(pred, mv)
-                        except Exception:  # noqa: BLE001 — an abort
+                        except Exception:  # noqa: BLE001 — an abort  # dglint: disable=DG07 (zero's move driver is a daemon; no request context)
                             pass  # hiccup must never kill the driver
-                except Exception as e:  # noqa: BLE001 — retry next tick
+                except Exception as e:  # noqa: BLE001 — retry next tick  # dglint: disable=DG07 (zero's move driver is a daemon; no request context)
                     log.warning("move_drive_retry", pred=pred,
                                 error=str(e)[:200])
                     # post-flip we NEVER abort: the destination owns
@@ -1486,7 +1516,7 @@ class ZeroServer(RaftServer):
         if dst_cl is not None:
             try:
                 dst_cl.request({"op": "drop_tablet", "pred": pred})
-            except Exception:  # noqa: BLE001 — best-effort cleanup
+            except Exception:  # noqa: BLE001 — best-effort cleanup  # dglint: disable=DG07 (move-abort cleanup; no request context)
                 pass
             finally:
                 dst_cl.close()

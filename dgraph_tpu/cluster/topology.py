@@ -227,7 +227,7 @@ class RoutedCluster:
             try:
                 self.zero.request({"op": "abort_txn",
                                    "args": (start_ts,)})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001  # dglint: disable=DG07 (best-effort abort record inside a handler that re-raises)
                 pass
             self._xabort(staged, start_ts)
             raise
@@ -242,7 +242,7 @@ class RoutedCluster:
                 self.groups[gid].request(
                     {"op": "xfinalize", "start_ts": start_ts,
                      "commit_ts": commit_ts})
-            except Exception:  # noqa: BLE001 — the decision is
+            except Exception:  # noqa: BLE001 — the decision is  # dglint: disable=DG07 (finalize delivery is best-effort BY CONTRACT; reconcile covers it)
                 pass  # recorded; the group reconciles from zero
         return {"uids": {k[2:]: hex(v) for k, v in blanks.items()},
                 "extensions": {"txn": {"start_ts": start_ts,
@@ -255,7 +255,7 @@ class RoutedCluster:
                 self.groups[gid].request(
                     {"op": "xfinalize", "start_ts": start_ts,
                      "commit_ts": 0})
-            except Exception:  # noqa: BLE001 — reconciliation covers it
+            except Exception:  # noqa: BLE001 — reconciliation covers it  # dglint: disable=DG07 (abort fan-out is best-effort BY CONTRACT)
                 pass
 
     def query(self, q: str, variables: Optional[dict] = None,
@@ -558,7 +558,7 @@ class Rebalancer:
             while not self._stop.wait(self.interval_s):
                 try:
                     self.tick()
-                except Exception:  # noqa: BLE001 — keep rebalancing
+                except Exception:  # noqa: BLE001 — keep rebalancing  # dglint: disable=DG07 (rebalancer daemon; no request context flows here)
                     pass
 
         self._thread = threading.Thread(target=loop, daemon=True)

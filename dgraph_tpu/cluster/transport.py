@@ -116,14 +116,25 @@ class TcpTransport:
                 sock.close()
                 del self._out[peer]
             addr = self.peers.get(peer)
-            if addr is None:
+        if addr is None:
+            return None
+        # dial OUTSIDE the lock (dglint DG04): a 1s connect timeout to
+        # one dead peer must not serialize sends to every healthy peer
+        try:
+            sock = socket.create_connection(addr, timeout=1.0)
+            sock.settimeout(5.0)
+            wire.write_frame(sock, _HELLO)
+        except OSError:
+            return None
+        with self._out_lock:
+            if self._closed.is_set():
+                sock.close()
                 return None
-            try:
-                sock = socket.create_connection(addr, timeout=1.0)
-                sock.settimeout(5.0)
-                wire.write_frame(sock, _HELLO)
-            except OSError:
-                return None
+            cur = self._out.get(peer)
+            if cur is not None:
+                # a racing dialer won; keep ONE pooled conn per peer
+                sock.close()
+                return cur
             self._out[peer] = sock
             return sock
 

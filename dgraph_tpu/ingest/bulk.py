@@ -217,8 +217,7 @@ def _bulk_load_locked(paths, nquads, db, tmpdir) -> GraphDB:
                 posting = Posting(
                     convert(posting.value, tab.schema.value_type),
                     posting.lang, posting.facets)
-            tab.values[src] = tab._merge_posting(
-                tab.values.get(src, []), posting)
+            tab.merge_base_value(src, posting)
         tab.base_ts = write_ts
         tab.rebuild_index()
         tab.rebuild_reverse()

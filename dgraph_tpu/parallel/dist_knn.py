@@ -16,6 +16,8 @@ happens on host via ops/knn.merge_topk.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -64,6 +66,19 @@ def sharded_topk(mesh: Mesh, corpus_dev, queries: np.ndarray, k: int,
     mask_dev = jax.device_put(jnp.asarray(m),
                               NamedSharding(mesh, P(axis)))
     k_eff = min(k, per)
+    fn = _sharded_step(mesh, axis, per, k, k_eff, metric)
+    vals, idx = fn(corpus_dev, q, mask_dev)
+    return np.asarray(idx, np.int64), np.asarray(vals)
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_step(mesh: Mesh, axis: str, per: int, k: int, k_eff: int,
+                  metric: str):
+    """The jitted shard_map step, cached per (mesh, layout, k, metric)
+    — rebuilding `jax.jit(shard_map(...))` inside sharded_topk gave
+    every call a fresh, empty trace cache, so EVERY query paid a full
+    retrace+recompile (dglint DG02). Distinct query-batch shapes still
+    retrace, as jit always does; repeated shapes now hit the cache."""
 
     def step(rows, qm, keep):
         scores = knn._score_device(rows, qm, metric, False, None)
@@ -82,5 +97,4 @@ def sharded_topk(mesh: Mesh, corpus_dev, queries: np.ndarray, k: int,
         in_specs=(P(axis, None), P(None, None), P(axis)),
         out_specs=(P(None, None), P(None, None)),
         check_vma=False)
-    vals, idx = jax.jit(smapped)(corpus_dev, q, mask_dev)
-    return np.asarray(idx, np.int64), np.asarray(vals)
+    return jax.jit(smapped)

@@ -5144,7 +5144,9 @@ def _eval_math_vec(tree, value_vars):
             return map1(lambda x: 1.0 / (1.0 + _m.exp(-x)),
                         uids, asarr[0])
         if fn == "since":
-            now = _time.time()
+            # wall clock by SEMANTICS: since() measures from an
+            # epoch-seconds datetime value (ref applySince)
+            now = _time.time()  # dglint: disable=DG06
             return uids, now - asarr[0], False
         if fn in ("pow", "logbase"):
             xs, ys = asarr[0].tolist(), asarr[1].tolist()
@@ -5408,7 +5410,8 @@ def _apply_math(fn: str, v: list, _m):
         # ref query/aggregator.go:353 applySince: seconds elapsed since
         # the datetime (datetimes reach math as epoch-seconds floats)
         import time as _time
-        return _time.time() - v[0]
+        # wall clock by SEMANTICS (epoch-seconds argument)
+        return _time.time() - v[0]  # dglint: disable=DG06
     raise GQLError(f"math op {fn!r} not supported")
 
 

@@ -93,7 +93,8 @@ def jwt_decode(token: str, secret: bytes) -> dict:
     if not hmac.compare_digest(want, sig):
         raise AclError("jwt signature mismatch")
     claims = json.loads(_unb64(body))
-    if claims.get("exp", 0) < time.time():
+    # JWT `exp` is wall-clock by spec (RFC 7519 NumericDate)
+    if claims.get("exp", 0) < time.time():  # dglint: disable=DG06
         raise AclError("jwt expired")
     return claims
 
@@ -181,7 +182,10 @@ class AclManager:
         self.refresh_ttl = refresh_ttl
         self.cache_ttl = cache_ttl
         self._cache: dict[str, dict[str, int]] = {}
-        self._cache_at = 0.0
+        # -inf forces the first refresh under ANY clock origin (the
+        # TTL clock is time.monotonic(), whose epoch is arbitrary —
+        # 0.0 would skip the refresh on a freshly booted host)
+        self._cache_at = float("-inf")
         self._ensure_bootstrap()
 
     # ----------------------------------------------------------- bootstrap
@@ -221,7 +225,8 @@ _:u <dgraph.user.group> _:g .
             if not res["data"]["q"]:
                 raise AclError("invalid login credentials")
         groups = self._groups_of(userid)
-        now = time.time()
+        # wall clock: `exp` claims are absolute wall-clock instants
+        now = time.time()  # dglint: disable=DG06
         access = jwt_encode({"userid": userid, "groups": groups,
                              "typ": "access",
                              "exp": now + self.access_ttl}, self.secret)
@@ -246,7 +251,7 @@ _:u <dgraph.user.group> _:g .
     def _perms(self) -> dict[str, dict[str, int]]:
         """group -> predicate -> perm bits, cached with TTL
         (ref acl_cache.go:113 update / RefreshAcls)."""
-        now = time.time()
+        now = time.monotonic()
         if now - self._cache_at > self.cache_ttl:
             table: dict[str, dict[str, int]] = {}
             res = self.db.query(
@@ -369,7 +374,7 @@ _:u <dgraph.user.group> _:g .
             acl.append({"predicate": predicate, "perm": perm})
         self.db.mutate(set_nquads=(
             f"<{gid}> <dgraph.group.acl> {json.dumps(json.dumps(acl))} ."))
-        self._cache_at = 0.0  # force refresh
+        self._cache_at = float("-inf")  # force refresh
 
     def info(self) -> dict:
         res = self.db.query(

@@ -71,7 +71,7 @@ def _wrap(fn):
     def method(request, context):
         try:
             return fn(request or {}, _ctx_of(context))
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001  # dglint: disable=DG07 (_abort_for maps RequestAborted to typed gRPC status then raises via context.abort)
             _abort_for(context, e)
 
     return method
@@ -118,7 +118,7 @@ def _pb_wrap(fn):
     def method(request, context):
         try:
             return fn(request, context)
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001  # dglint: disable=DG07 (_abort_for maps RequestAborted to typed gRPC status then raises via context.abort)
             _abort_for(context, e)
 
     return method

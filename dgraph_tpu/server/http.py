@@ -105,7 +105,9 @@ class AlphaServer:
         # must not let one login commit/abort another login's txn
         self._txn_owner: dict[int, str] = {}
         self.txn_ttl_s = txn_ttl_s
-        self.started_at = time.time()
+        # monotonic: /health uptime is a DURATION — an NTP step must
+        # not make it jump (same for the txn idle clocks below)
+        self.started_at = time.monotonic()
         # ACL enforcement turns on when a secret is configured
         # (ref --acl_secret_file, dgraph/cmd/alpha/run.go flags)
         self.acl = None
@@ -125,7 +127,7 @@ class AlphaServer:
     def _evict_idle(self):
         """Abort txns idle past the TTL (ref --abort_older_than,
         worker/draft.go:1166 abortOldTransactions)."""
-        now = time.time()
+        now = time.monotonic()
         for ts, t in list(self._touched.items()):
             if now - t > self.txn_ttl_s:
                 txn = self.txns.pop(ts, None)
@@ -380,7 +382,7 @@ class AlphaServer:
                         self.db.discard(txn)
                         raise RuntimeError("too many open transactions")
                     self.txns[txn.start_ts] = txn
-                    self._touched[txn.start_ts] = time.time()
+                    self._touched[txn.start_ts] = time.monotonic()
                     if self.acl is not None and owner is not None:
                         self._txn_owner.setdefault(txn.start_ts, owner)
             if commit_now:
@@ -538,7 +540,7 @@ class AlphaServer:
 
     def handle_health(self) -> dict:
         return {"status": "draining" if self.draining else "healthy",
-                "uptime_s": round(time.time() - self.started_at, 3),
+                "uptime_s": round(time.monotonic() - self.started_at, 3),
                 "openTxns": len(self.txns),
                 "pendingQueries": self.pending(),
                 "maxPending": self.max_pending}
@@ -759,6 +761,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(f"no handler for GET {path}", 404)
         except AclError as e:
             self._error(str(e), 401)
+        except DeadlineExceeded as e:
+            # GET handlers take no RequestContext today, but the same
+            # typed mapping as do_POST keeps cancellation from ever
+            # collapsing into a 500 if one grows a deadline
+            self._error(str(e), 408, ecode="DeadlineExceeded",
+                        retryable=True)
+        except Cancelled as e:
+            self._error(str(e), 499, ecode="Cancelled")
         except Exception as e:  # noqa: BLE001 — surface as API error
             log.error("http_internal_error", path=path, error=str(e),
                       trace=traceback.format_exc()[-800:])

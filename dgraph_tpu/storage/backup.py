@@ -82,7 +82,8 @@ def backup(db, dest: str, force_full: bool = False,
     entry = {"type": "full" if since == 0 else "incremental",
              "since_ts": since, "read_ts": read_ts, "file": name,
              "encrypted": key is not None,
-             "unix_ts": int(time.time()),
+             # wall clock: manifest stamps are user-visible instants
+             "unix_ts": int(time.time()),  # dglint: disable=DG06
              "predicates": sorted(tablets),
              "dropped": dropped}
     chain.append(entry)

@@ -504,6 +504,15 @@ class Tablet:
         rest = [q for q in cur if q.lang != p.lang]
         return rest + [p]
 
+    def merge_base_value(self, src: int, p: Posting):
+        """Bulk-load seam: merge `p` into the BASE value list for
+        `src` with the same list/lang replacement semantics as the
+        MVCC apply path. Only loaders building base state below the
+        tablet's base_ts (ingest/bulk.py) may call this — it bypasses
+        the overlay entirely (dglint DG03 guards the private helper)."""
+        self.values[src] = self._merge_posting(
+            self.values.get(src, []), p)
+
     def index_uids(self, token: bytes, read_ts: int) -> np.ndarray:
         out = self.index.get(token, _EMPTY)
         dirty = False

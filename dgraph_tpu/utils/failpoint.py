@@ -36,6 +36,17 @@ import time
 
 ENV_VAR = "DGRAPH_TPU_FAILPOINTS"
 
+# Registry of every production injection site (the names `fire()` is
+# called with outside tests). dglint DG08 checks each literal
+# `failpoint.fire("...")` in dgraph_tpu/ against this tuple, so a
+# renamed or removed site cannot silently turn chaos tests into
+# no-ops. Tests may arm ad-hoc fixture names freely.
+SITES = (
+    "transport.send",   # cluster/transport.py — before a Raft frame
+    "tablet.apply",     # storage/tablet.py    — before a commit delta
+    "executor.level",   # query/executor.py    — block/level boundary
+)
+
 
 class FailpointError(RuntimeError):
     """Raised by an armed error(...) action at its injection site."""

@@ -30,7 +30,8 @@ class _Logger:
     def _emit(self, level: str, event: str, fields: dict):
         if _LEVELS[level] < self.min_level:
             return
-        rec = {"ts": round(time.time(), 3), "level": level,
+        # wall clock: log timestamps are user-visible instants
+        rec = {"ts": round(time.time(), 3), "level": level,  # dglint: disable=DG06
                "event": event}
         for k, v in fields.items():
             if k not in rec:

@@ -22,6 +22,55 @@ _HISTO_SUM: dict[tuple[str, tuple], float] = {}
 BUCKETS = [0.1, 0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
            5000, 10000]
 
+# Registry of every metric name the tree emits. Metric names are API
+# (dashboards and alerts key on them), so dglint DG08 checks each
+# literal inc_counter/set_gauge/observe name against this tuple — a
+# typo'd name forks a series nobody reads, a duplicate entry here is
+# a copy-paste smell. Keep sorted within each group.
+REGISTERED = (
+    # engine (engine/db.py, engine/lazy_tablets.py, engine/tile_cache.py)
+    "device_cache_bytes",
+    "device_cache_evictions",
+    "device_cache_tiles",
+    "dgraph_num_edges_total",
+    "dgraph_num_mutations_total",
+    "dgraph_num_queries_total",
+    "dgraph_query_latency_ms",
+    "dgraph_txn_aborts_total",
+    "tablet_store_evictions",
+    "tablet_store_loads",
+    # serving edge (server/http.py)
+    "dgraph_pending_queries",
+    "dgraph_queries_shed_total",
+    # query executor tier counters (query/executor.py)
+    "query_columnar_var_bind_total",
+    "query_colvar_hits_total",
+    "query_device_count_page_total",
+    "query_device_expand_total",
+    "query_device_multisort_total",
+    "query_device_orderkeys_total",
+    "query_device_overlay_expand_total",
+    "query_device_range_total",
+    "query_device_setops_total",
+    "query_device_sort_page_total",
+    "query_device_sssp_total",
+    "query_flat_json_total",
+    "query_groupby_fast_total",
+    "query_index_csr_probe_total",
+    "query_match_batch_total",
+    "query_order_presorted_total",
+    "query_postings_fallback_total",
+    "query_regexp_batch_total",
+    "query_sharded_expand_total",
+    "query_similar_device_total",
+    "query_similar_sharded_total",
+    # cluster (cluster/transport.py)
+    "raft_send_drops",
+    # process gauges (utils/metrics.py collect_memory_gauges)
+    "memory_inuse_bytes",
+    "memory_proc_bytes",
+)
+
 
 def _key(name: str, labels: dict | None) -> tuple[str, tuple]:
     return name, tuple(sorted((labels or {}).items()))

@@ -32,7 +32,8 @@ _lock = threading.Lock()
 def span(name: str, **attrs: Any) -> Iterator[dict]:
     """Record one wall-time span; yields the attr dict so callers can
     attach results (e.g. result counts) before the span closes."""
-    rec = {"name": name, "ts_us": time.time() * 1e6,
+    # wall clock: chrome://tracing renders these as absolute instants
+    rec = {"name": name, "ts_us": time.time() * 1e6,  # dglint: disable=DG06
            "tid": threading.get_ident(), "args": dict(attrs)}
     t0 = time.perf_counter_ns()
     try:

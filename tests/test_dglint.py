@@ -1,0 +1,653 @@
+"""dglint: per-rule fixture tests + the tier-1 gate over the tree.
+
+Each rule gets at least one caught-violation fixture, one suppressed
+fixture, and one clean/fixed fixture (`lint_source` lints a string as
+if it lived at a chosen repo-relative path, so rule path scopes are
+exercised too). The gate test at the bottom runs the real linter over
+dgraph_tpu/ and tests/ against the committed baseline — a new
+violation anywhere in the tree fails tier-1.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:  # `python -m pytest` from elsewhere
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.dglint.core import (  # noqa: E402
+    ProjectContext, apply_baseline, build_project, lint_project,
+    lint_source, load_baseline, render_baseline,
+)
+from tools.dglint.rules_registry import parse_registry  # noqa: E402
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def run_fixture(src: str, rel: str = "dgraph_tpu/ops/_fixture.py",
+                **proj_kw):
+    proj = ProjectContext(root=".", **proj_kw)
+    return lint_source(textwrap.dedent(src), rel=rel, project=proj)
+
+
+# ------------------------------------------------------------------ DG01
+
+
+class TestJitPurity:
+    BAD = """
+        import time
+        import jax
+
+        def kernel(x):
+            t = time.time()
+            return x + t
+
+        f = jax.jit(kernel)
+    """
+
+    def test_catches_wall_clock_in_jitted(self):
+        found = run_fixture(self.BAD)
+        assert "DG01" in codes(found)
+
+    def test_suppressed(self):
+        src = self.BAD.replace(
+            "t = time.time()",
+            "t = time.time()  # dglint: disable=DG01,DG06")
+        assert "DG01" not in codes(run_fixture(src))
+
+    def test_clean_pure_kernel(self):
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            def kernel(x):
+                return jnp.sum(x * 2)
+
+            f = jax.jit(kernel)
+        """
+        assert "DG01" not in codes(run_fixture(src))
+
+    def test_reaches_through_helpers(self):
+        # the helper is not itself jitted, but the jitted root calls
+        # it — same-module reachability must find the .item()
+        src = """
+            import jax
+
+            def helper(x):
+                return x.item()
+
+            @jax.jit
+            def root(x):
+                return helper(x)
+        """
+        found = run_fixture(src)
+        assert "DG01" in codes(found)
+        assert ".item()" in [f for f in found
+                             if f.code == "DG01"][0].message
+
+    def test_host_function_not_flagged(self):
+        # a host-side driver may use numpy/time freely
+        src = """
+            import time
+            import numpy as np
+
+            def host_driver(x):
+                t = time.monotonic()
+                return np.asarray(x), t
+        """
+        assert "DG01" not in codes(run_fixture(src))
+
+    def test_numpy_pull_in_pallas_kernel(self):
+        src = """
+            import numpy as np
+            from jax.experimental import pallas as pl
+
+            def kern(x_ref, o_ref):
+                o_ref[...] = np.asarray(x_ref[...])
+
+            out = pl.pallas_call(kern, out_shape=None)
+        """
+        assert "DG01" in codes(run_fixture(src))
+
+
+# ------------------------------------------------------------------ DG02
+
+
+class TestRecompileHazard:
+    def test_static_argnames_typo(self):
+        src = """
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, static_argnames=("kk",))
+            def f(x, k):
+                return x
+        """
+        found = run_fixture(src)
+        assert "DG02" in codes(found)
+
+    def test_static_argnums_out_of_range(self):
+        src = """
+            import jax
+
+            def f(x):
+                return x
+
+            g = jax.jit(f, static_argnums=(3,))
+        """
+        assert "DG02" in codes(run_fixture(src))
+
+    def test_immediate_invocation(self):
+        src = """
+            import jax
+
+            def f(x):
+                return x
+
+            y = jax.jit(f)(1)
+        """
+        assert "DG02" in codes(run_fixture(src))
+
+    def test_jit_in_loop(self):
+        src = """
+            import jax
+
+            def g(x):
+                return x
+
+            fs = []
+            for i in range(4):
+                fs.append(jax.jit(g))
+        """
+        assert "DG02" in codes(run_fixture(src))
+
+    def test_suppressed(self):
+        src = """
+            import jax
+
+            def f(x):
+                return x
+
+            y = jax.jit(f)(1)  # dglint: disable=DG02
+        """
+        assert "DG02" not in codes(run_fixture(src))
+
+    def test_clean_valid_static_args(self):
+        src = """
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, static_argnums=(1,),
+                     static_argnames=("k",))
+            def f(x, k):
+                return x
+
+            g = jax.jit(f, static_argnums=(1,))
+            y = g(1, 2)
+        """
+        assert "DG02" not in codes(run_fixture(src))
+
+
+# ------------------------------------------------------------------ DG03
+
+
+class TestSnapshotDiscipline:
+    def test_private_overlay_access(self):
+        src = """
+            def peek(tab):
+                return list(tab._overlay(5))
+        """
+        found = run_fixture(src, rel="dgraph_tpu/query/_fixture.py")
+        assert "DG03" in codes(found)
+
+    def test_hardcoded_read_ts(self):
+        src = """
+            def read(tab, u):
+                return tab.get_postings(u, 2**63)
+        """
+        found = run_fixture(src, rel="dgraph_tpu/query/_fixture.py")
+        assert "DG03" in codes(found)
+
+    def test_hardcoded_read_ts_keyword(self):
+        src = """
+            def read(tab):
+                return tab.value_columns(read_ts=999)
+        """
+        assert "DG03" in codes(
+            run_fixture(src, rel="dgraph_tpu/query/_fixture.py"))
+
+    def test_storage_package_exempt(self):
+        src = """
+            def fold(tab):
+                return list(tab._overlay(5))
+        """
+        assert "DG03" not in codes(
+            run_fixture(src, rel="dgraph_tpu/storage/_fixture.py"))
+
+    def test_suppressed(self):
+        src = """
+            def peek(tab):
+                return list(tab._overlay(5))  # dglint: disable=DG03
+        """
+        assert "DG03" not in codes(
+            run_fixture(src, rel="dgraph_tpu/query/_fixture.py"))
+
+    def test_clean_threaded_read_ts(self):
+        src = """
+            def read(tab, u, read_ts):
+                return tab.get_postings(u, read_ts)
+        """
+        assert "DG03" not in codes(
+            run_fixture(src, rel="dgraph_tpu/query/_fixture.py"))
+
+
+# ------------------------------------------------------------------ DG04
+
+
+class TestLockHygiene:
+    def test_sleep_under_lock(self):
+        src = """
+            import time
+
+            def f(self):
+                with self.lock:
+                    time.sleep(1)
+        """
+        found = run_fixture(src, rel="dgraph_tpu/cluster/_fixture.py")
+        assert "DG04" in codes(found)
+
+    def test_transport_send_under_rw_write(self):
+        src = """
+            def f(self, msg):
+                with self.rw.write:
+                    self.transport.send(msg)
+        """
+        assert "DG04" in codes(
+            run_fixture(src, rel="dgraph_tpu/cluster/_fixture.py"))
+
+    def test_lock_order_inversion(self):
+        src = """
+            def a(self):
+                with self.lock:
+                    with self.meta:
+                        pass
+
+            def b(self):
+                with self.meta:
+                    with self.lock:
+                        pass
+        """
+        found = run_fixture(src, rel="dgraph_tpu/cluster/_fixture.py")
+        msgs = [f.message for f in found if f.code == "DG04"]
+        assert any("both orders" in m for m in msgs)
+
+    def test_suppressed(self):
+        src = """
+            import time
+
+            def f(self):
+                with self.lock:
+                    time.sleep(1)  # dglint: disable=DG04
+        """
+        assert "DG04" not in codes(
+            run_fixture(src, rel="dgraph_tpu/cluster/_fixture.py"))
+
+    def test_clean_sleep_outside_lock(self):
+        src = """
+            import time
+
+            def f(self):
+                with self.lock:
+                    x = 1
+                time.sleep(1)
+        """
+        assert "DG04" not in codes(
+            run_fixture(src, rel="dgraph_tpu/cluster/_fixture.py"))
+
+    def test_nested_def_resets_held_locks(self):
+        # the nested def's body does not RUN under the with
+        src = """
+            import time
+
+            def f(self):
+                with self.lock:
+                    def cb():
+                        time.sleep(1)
+                    return cb
+        """
+        assert "DG04" not in codes(
+            run_fixture(src, rel="dgraph_tpu/cluster/_fixture.py"))
+
+
+# ------------------------------------------------------------------ DG05
+
+
+class TestDeadlineDiscipline:
+    def test_handler_drops_bound_ctx(self):
+        src = """
+            def handle(self, q, ctx=None):
+                return self.db.query(q)
+        """
+        found = run_fixture(src, rel="dgraph_tpu/server/_fixture.py")
+        assert "DG05" in codes(found)
+
+    def test_serving_file_requires_ctx(self):
+        src = """
+            def handle(self, q):
+                return self.db.query(q)
+        """
+        assert "DG05" in codes(
+            run_fixture(src, rel="dgraph_tpu/cluster/service.py"))
+
+    def test_suppressed(self):
+        src = """
+            def handle(self, q, ctx=None):
+                return self.db.query(q)  # dglint: disable=DG05
+        """
+        assert "DG05" not in codes(
+            run_fixture(src, rel="dgraph_tpu/server/_fixture.py"))
+
+    def test_clean_forwards_ctx(self):
+        src = """
+            def handle(self, q, ctx=None):
+                return self.db.query(q, ctx=ctx)
+        """
+        assert "DG05" not in codes(
+            run_fixture(src, rel="dgraph_tpu/server/_fixture.py"))
+
+    def test_out_of_scope_package_ignored(self):
+        src = """
+            def handle(self, q, ctx=None):
+                return self.db.query(q)
+        """
+        assert "DG05" not in codes(
+            run_fixture(src, rel="dgraph_tpu/ingest/_fixture.py"))
+
+
+# ------------------------------------------------------------------ DG06
+
+
+class TestMonotonicTime:
+    def test_catches_wall_clock(self):
+        src = """
+            import time
+
+            def age(self, t0):
+                return time.time() - t0
+        """
+        assert "DG06" in codes(
+            run_fixture(src, rel="dgraph_tpu/utils/_fixture.py"))
+
+    def test_suppressed_user_visible(self):
+        src = """
+            import time
+
+            def stamp(self):
+                return time.time()  # dglint: disable=DG06
+        """
+        assert "DG06" not in codes(
+            run_fixture(src, rel="dgraph_tpu/utils/_fixture.py"))
+
+    def test_clean_monotonic(self):
+        src = """
+            import time
+
+            def age(self, t0):
+                return time.monotonic() - t0
+        """
+        assert "DG06" not in codes(
+            run_fixture(src, rel="dgraph_tpu/utils/_fixture.py"))
+
+    def test_tests_out_of_scope(self):
+        src = """
+            import time
+
+            def helper():
+                return time.time()
+        """
+        assert "DG06" not in codes(
+            run_fixture(src, rel="tests/_fixture.py"))
+
+
+# ------------------------------------------------------------------ DG07
+
+
+class TestSwallowedCancellation:
+    def test_broad_except_swallows(self):
+        src = """
+            def f(self):
+                try:
+                    self.work()
+                except Exception:
+                    return None
+        """
+        assert "DG07" in codes(
+            run_fixture(src, rel="dgraph_tpu/server/_fixture.py"))
+
+    def test_earlier_abort_handler_ok(self):
+        src = """
+            from dgraph_tpu.utils.reqctx import RequestAborted
+
+            def f(self):
+                try:
+                    self.work()
+                except RequestAborted:
+                    raise
+                except Exception:
+                    return None
+        """
+        assert "DG07" not in codes(
+            run_fixture(src, rel="dgraph_tpu/server/_fixture.py"))
+
+    def test_reraise_body_ok(self):
+        src = """
+            def f(self):
+                try:
+                    self.work()
+                except Exception:
+                    self.cleanup()
+                    raise
+        """
+        assert "DG07" not in codes(
+            run_fixture(src, rel="dgraph_tpu/server/_fixture.py"))
+
+    def test_suppressed(self):
+        src = """
+            def f(self):
+                try:
+                    self.work()
+                except Exception:  # dglint: disable=DG07
+                    return None
+        """
+        assert "DG07" not in codes(
+            run_fixture(src, rel="dgraph_tpu/server/_fixture.py"))
+
+    def test_out_of_scope_package(self):
+        src = """
+            def f(self):
+                try:
+                    self.work()
+                except Exception:
+                    return None
+        """
+        assert "DG07" not in codes(
+            run_fixture(src, rel="dgraph_tpu/ops/_fixture.py"))
+
+
+# ------------------------------------------------------------------ DG08
+
+
+def _registry_proj(**kw):
+    kw.setdefault("failpoint_sites", frozenset({"transport.send"}))
+    kw.setdefault("metric_names", frozenset({"known_metric_total"}))
+    kw.setdefault("registries_found", True)
+    return dict(kw)
+
+
+class TestRegistryDiscipline:
+    def test_unregistered_failpoint_site(self):
+        src = """
+            from dgraph_tpu.utils import failpoint
+
+            def f():
+                failpoint.fire("transport.sned")
+        """
+        found = run_fixture(src, rel="dgraph_tpu/cluster/_fixture.py",
+                            **_registry_proj())
+        assert "DG08" in codes(found)
+
+    def test_unregistered_metric(self):
+        src = """
+            from dgraph_tpu.utils.metrics import inc_counter
+
+            def f():
+                inc_counter("typo_metric_total")
+        """
+        assert "DG08" in codes(
+            run_fixture(src, rel="dgraph_tpu/query/_fixture.py",
+                        **_registry_proj()))
+
+    def test_registered_names_clean(self):
+        src = """
+            from dgraph_tpu.utils import failpoint
+            from dgraph_tpu.utils.metrics import inc_counter
+
+            def f():
+                failpoint.fire("transport.send")
+                inc_counter("known_metric_total")
+        """
+        assert "DG08" not in codes(
+            run_fixture(src, rel="dgraph_tpu/cluster/_fixture.py",
+                        **_registry_proj()))
+
+    def test_dynamic_names_skipped(self):
+        src = """
+            from dgraph_tpu.utils.metrics import inc_counter
+
+            def f(name):
+                inc_counter(name)
+        """
+        assert "DG08" not in codes(
+            run_fixture(src, rel="dgraph_tpu/query/_fixture.py",
+                        **_registry_proj()))
+
+    def test_suppressed(self):
+        src = """
+            from dgraph_tpu.utils import failpoint
+
+            def f():
+                failpoint.fire("adhoc.site")  # dglint: disable=DG08
+        """
+        assert "DG08" not in codes(
+            run_fixture(src, rel="dgraph_tpu/cluster/_fixture.py",
+                        **_registry_proj()))
+
+    def test_duplicate_registration(self):
+        import ast
+        tree = ast.parse("SITES = ('a.b', 'c.d', 'a.b')")
+        names, dupes = parse_registry(tree, "SITES")
+        assert names == ["a.b", "c.d", "a.b"]
+        assert dupes == [("a.b", 1)]
+
+    def test_duplicate_reported_in_home_module(self):
+        src = "SITES = ('a.b', 'a.b')\n"
+        found = run_fixture(
+            src, rel="dgraph_tpu/utils/failpoint.py",
+            **_registry_proj(failpoint_dupes=[("a.b", 1)]))
+        assert "DG08" in codes(found)
+
+
+# ------------------------------------------------- framework machinery
+
+
+class TestFramework:
+    def test_file_wide_suppression(self):
+        src = """
+            # dglint: file-disable=DG06
+            import time
+
+            def a():
+                return time.time()
+
+            def b():
+                return time.time()
+        """
+        assert "DG06" not in codes(
+            run_fixture(src, rel="dgraph_tpu/utils/_fixture.py"))
+
+    def test_baseline_roundtrip(self, tmp_path):
+        src = """
+            import time
+
+            def age(self, t0):
+                return time.time() - t0
+        """
+        found = run_fixture(src, rel="dgraph_tpu/utils/_fixture.py")
+        dg06 = [f for f in found if f.code == "DG06"]
+        assert dg06
+        p = tmp_path / "baseline.txt"
+        p.write_text(render_baseline(dg06))
+        allowed = load_baseline(str(p))
+        new, old = apply_baseline(dg06, allowed)
+        assert new == [] and len(old) == len(dg06)
+
+    def test_baseline_does_not_mask_new_findings(self, tmp_path):
+        f1 = run_fixture(
+            "import time\n\n\ndef a(t0):\n    return time.time() - t0\n",
+            rel="dgraph_tpu/utils/_fixture.py")
+        p = tmp_path / "baseline.txt"
+        p.write_text(render_baseline(f1))
+        f2 = run_fixture(
+            "import time\n\n\ndef a(t0):\n    return time.time() - t0\n"
+            "\n\ndef b():\n    return time.time() * 2\n",
+            rel="dgraph_tpu/utils/_fixture.py")
+        new, old = apply_baseline(f2, load_baseline(str(p)))
+        assert len(old) == 1
+        assert len(new) == 1 and "time.time() * 2" in new[0].context
+
+    def test_list_rules_cli(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.dglint", "--list-rules"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0
+        for code in ("DG01", "DG02", "DG03", "DG04",
+                     "DG05", "DG06", "DG07", "DG08"):
+            assert code in out.stdout
+
+
+# --------------------------------------------------------- tier-1 gate
+
+
+class TestTreeGate:
+    """The linter over the real tree: new violations fail tier-1."""
+
+    @pytest.fixture(scope="class")
+    def tree_findings(self):
+        proj = build_project(["dgraph_tpu", "tests"], REPO_ROOT)
+        assert proj.registries_found, \
+            "SITES/REGISTERED registries missing from utils modules"
+        return lint_project(proj)
+
+    def test_no_new_findings(self, tree_findings):
+        allowed = load_baseline(
+            os.path.join(REPO_ROOT, "tools", "dglint_baseline.txt"))
+        new, _old = apply_baseline(tree_findings, allowed)
+        assert not new, (
+            "new dglint findings (fix, suppress with a reason, or — "
+            "last resort — regenerate the baseline):\n"
+            + "\n".join(f.render() for f in new))
+
+    def test_baseline_budget(self):
+        allowed = load_baseline(
+            os.path.join(REPO_ROOT, "tools", "dglint_baseline.txt"))
+        assert sum(allowed.values()) <= 10, \
+            "the grandfather budget is 10 findings — fix some before " \
+            "adding more"

@@ -1,0 +1,269 @@
+"""utils/rwlock.py: writer preference, fairness, reentrancy behavior.
+
+The RWLock is the server front end's one concurrency primitive (http
+queries share the read side, mutations take the write side), and
+dglint DG04's lock-hygiene rule is built on its documented contract:
+
+  - readers share; writers are exclusive
+  - WRITER PREFERENCE: once a writer waits, new readers queue behind
+    it (a steady query stream cannot starve a mutation burst)
+  - consequence: read acquisition is NOT reentrant under writer
+    pressure — a thread that re-enters acquire_read while a writer
+    waits deadlocks, which is exactly why DG04 forbids blocking calls
+    (which extend hold times) inside the critical sections
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from dgraph_tpu.utils.rwlock import RWLock
+
+HOLD = 0.05
+WAIT = 5.0
+
+
+def spawn(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+
+def wait_writer_parked(lock: RWLock, timeout: float = WAIT):
+    """Poll until a writer is inside acquire_write (deterministic
+    alternative to 'sleep and hope the scheduler ran it')."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with lock._cond:
+            if lock._writers_waiting > 0:
+                return True
+        time.sleep(0.002)
+    return False
+
+
+class TestSharing:
+    def test_readers_share(self):
+        lock = RWLock()
+        inside = threading.Barrier(2, timeout=WAIT)
+
+        def reader():
+            with lock.read:
+                inside.wait()  # both readers in simultaneously
+
+        ts = [spawn(reader), spawn(reader)]
+        for t in ts:
+            t.join(WAIT)
+            assert not t.is_alive(), "readers failed to share the lock"
+
+    def test_writer_excludes_readers_and_writers(self):
+        lock = RWLock()
+        order: list[str] = []
+        in_write = threading.Event()
+        release = threading.Event()
+
+        def writer():
+            with lock.write:
+                in_write.set()
+                assert release.wait(WAIT)
+                order.append("w")
+
+        def reader():
+            assert in_write.wait(WAIT)
+            with lock.read:
+                order.append("r")
+
+        tw, tr = spawn(writer), spawn(reader)
+        assert in_write.wait(WAIT)
+        time.sleep(HOLD)  # give the reader time to block (it must)
+        assert order == []
+        release.set()
+        tw.join(WAIT)
+        tr.join(WAIT)
+        assert order == ["w", "r"]
+
+
+class TestWriterPreference:
+    def test_new_reader_queues_behind_waiting_writer(self):
+        """Reader holds; writer waits; a SECOND reader must not slip
+        in ahead of the waiting writer (the starvation defense)."""
+        lock = RWLock()
+        events: list[str] = []
+        r1_in = threading.Event()
+        r1_release = threading.Event()
+        w_waiting = threading.Event()
+
+        def r1():
+            with lock.read:
+                r1_in.set()
+                assert r1_release.wait(WAIT)
+
+        def w():
+            assert r1_in.wait(WAIT)
+            w_waiting.set()
+            with lock.write:
+                events.append("w")
+
+        def r2():
+            assert w_waiting.wait(WAIT)
+            assert wait_writer_parked(lock)
+            with lock.read:
+                events.append("r2")
+
+        ts = [spawn(r1), spawn(w), spawn(r2)]
+        assert w_waiting.wait(WAIT)
+        time.sleep(2 * HOLD)
+        # r2 must be BLOCKED while the writer waits, even though only
+        # a reader holds the lock
+        assert events == []
+        r1_release.set()
+        for t in ts:
+            t.join(WAIT)
+            assert not t.is_alive()
+        assert events == ["w", "r2"], \
+            "writer must run before the late reader"
+
+    def test_reader_blocked_behind_writer_wakes_up(self):
+        """Regression: release_write must wake BLOCKED READERS, not
+        just other writers — a notify() (instead of notify_all())
+        would leave readers sleeping forever."""
+        lock = RWLock()
+        woke = threading.Event()
+        in_write = threading.Event()
+        release = threading.Event()
+
+        def w():
+            with lock.write:
+                in_write.set()
+                assert release.wait(WAIT)
+
+        def r():
+            assert in_write.wait(WAIT)
+            with lock.read:
+                woke.set()
+
+        tw, tr = spawn(w), spawn(r)
+        assert in_write.wait(WAIT)
+        time.sleep(HOLD)  # reader parks in acquire_read
+        assert not woke.is_set()
+        release.set()
+        assert woke.wait(WAIT), \
+            "reader blocked behind a writer never woke up"
+        tw.join(WAIT)
+        tr.join(WAIT)
+
+    def test_writer_burst_then_readers_proceed(self):
+        """Fairness: a burst of writers all complete, then the parked
+        readers all get in — nobody is left behind."""
+        lock = RWLock()
+        done: list[str] = []
+        done_lock = threading.Lock()
+
+        def w(i):
+            def run():
+                with lock.write:
+                    time.sleep(0.002)
+                    with done_lock:
+                        done.append(f"w{i}")
+            return run
+
+        def r(i):
+            def run():
+                with lock.read:
+                    with done_lock:
+                        done.append(f"r{i}")
+            return run
+
+        ts = [spawn(w(i)) for i in range(4)]
+        ts += [spawn(r(i)) for i in range(8)]
+        for t in ts:
+            t.join(WAIT)
+            assert not t.is_alive(), "lock burst did not drain"
+        assert len(done) == 12
+
+
+class TestReentrancy:
+    def test_read_reentry_without_writer_is_shared(self):
+        """Same-thread read re-entry succeeds while no writer waits
+        (reads just share)."""
+        lock = RWLock()
+        with lock.read:
+            with lock.read:
+                assert lock._readers == 2
+        assert lock._readers == 0
+
+    def test_read_reentry_under_writer_pressure_deadlocks(self):
+        """DOCUMENTED HAZARD (the reason for DG04): re-entering
+        acquire_read while a writer waits deadlocks — the inner read
+        queues behind the writer, the writer waits for the outer
+        read. Verified via a sacrificial daemon thread."""
+        lock = RWLock()
+        outer_in = threading.Event()
+        w_parked = threading.Event()
+        inner_got_in = threading.Event()
+
+        def victim():
+            with lock.read:
+                outer_in.set()
+                assert w_parked.wait(WAIT)
+                assert wait_writer_parked(lock)
+                with lock.read:   # deadlock: queued behind the writer
+                    inner_got_in.set()
+
+        def writer():
+            assert outer_in.wait(WAIT)
+            w_parked.set()
+            lock.acquire_write()
+            lock.release_write()
+
+        spawn(victim)
+        spawn(writer)
+        assert not inner_got_in.wait(4 * HOLD), \
+            "read re-entry under writer pressure unexpectedly " \
+            "succeeded — writer preference is broken"
+
+    def test_write_is_not_reentrant(self):
+        lock = RWLock()
+        acquired_twice = threading.Event()
+
+        def f():
+            with lock.write:
+                lock.acquire_write()  # deadlocks by contract
+                acquired_twice.set()
+
+        spawn(f)
+        assert not acquired_twice.wait(4 * HOLD), \
+            "write re-entry unexpectedly succeeded"
+
+
+class TestGuards:
+    def test_guard_releases_on_exception(self):
+        lock = RWLock()
+        with pytest.raises(RuntimeError):
+            with lock.write:
+                raise RuntimeError("boom")
+        # fully released: a reader can get in immediately
+        got = threading.Event()
+
+        def r():
+            with lock.read:
+                got.set()
+
+        spawn(r)
+        assert got.wait(WAIT), "write guard leaked on exception"
+
+    def test_read_guard_releases_on_exception(self):
+        lock = RWLock()
+        with pytest.raises(RuntimeError):
+            with lock.read:
+                raise RuntimeError("boom")
+        got = threading.Event()
+
+        def w():
+            with lock.write:
+                got.set()
+
+        spawn(w)
+        assert got.wait(WAIT), "read guard leaked on exception"

@@ -1,0 +1,1 @@
+"""Repo tooling (not shipped with the dgraph_tpu package)."""

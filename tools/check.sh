@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Repo static-check gate: run before pushing (tier-1 also enforces the
+# dglint gate via tests/test_dglint.py).
+#
+#   1. dglint        — project invariant linter (tools/dglint), vs the
+#                      committed baseline
+#   2. compileall    — every file byte-compiles (syntax gate; dglint
+#                      skips unparseable files, so this owns them)
+#   3. import sweep  — `import dgraph_tpu` under -W error for
+#                      DeprecationWarning: dependency API drift
+#                      (jax/numpy renames) surfaces here first, not as
+#                      a tier-1 collection error three releases later
+#
+# Usage: tools/check.sh          (from the repo root)
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== dglint =="
+python -m tools.dglint dgraph_tpu tests
+
+echo "== compileall =="
+python -m compileall -q dgraph_tpu tests tools bench.py bench_micro.py \
+    bench_queries.py bench_vectors.py
+
+echo "== import-warnings sweep =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -W error::DeprecationWarning -c "import dgraph_tpu"
+
+echo "ok"

@@ -1,0 +1,15 @@
+"""dglint — AST-based invariant linter for dgraph_tpu.
+
+See tools/dglint/core.py for the architecture and
+docs/development.md ("Static analysis (dglint)") for the rule catalog:
+
+    DG01 jit-purity              DG05 deadline-discipline
+    DG02 recompile-hazard        DG06 monotonic-time
+    DG03 snapshot-discipline     DG07 swallowed-cancellation
+    DG04 lock-hygiene            DG08 registry-discipline
+"""
+
+from tools.dglint.core import (  # noqa: F401
+    Finding, all_rules, apply_baseline, build_project, lint_project,
+    lint_source, load_baseline, render_baseline,
+)

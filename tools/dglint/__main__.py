@@ -1,0 +1,5 @@
+import sys
+
+from tools.dglint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,126 @@
+"""Small AST helpers shared by dglint rules. stdlib `ast` only."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Dotted name of a call's callee (None for subscripts/lambdas)."""
+    return dotted(call.func)
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def num_const(node: ast.AST) -> Optional[object]:
+    """The value of an int/float literal, unwrapping unary +/- and
+    simple power expressions like 2**63 (a common 'max ts' literal)."""
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float)) and not isinstance(
+            node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.UAdd, ast.USub)):
+        inner = num_const(node.operand)
+        if inner is not None:
+            return -inner if isinstance(node.op, ast.USub) else inner
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Pow):
+        left, right = num_const(node.left), num_const(node.right)
+        if left is not None and right is not None:
+            try:
+                return left ** right
+            except (OverflowError, ValueError):
+                return None
+    return None
+
+
+def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def iter_funcdefs(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    """Every (possibly nested) function definition in the module."""
+    for node in ast.walk(tree):
+        if isinstance(node, FuncDef):
+            yield node
+
+
+def numpy_aliases(tree: ast.AST) -> set[str]:
+    """Local names bound to the numpy module by imports."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+def kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def has_kwarg(call: ast.Call, name: str) -> bool:
+    return kwarg(call, name) is not None
+
+
+def posonly_params(fn: ast.FunctionDef) -> list[str]:
+    """All positional parameter names (posonly + regular), in order."""
+    args = fn.args
+    return [a.arg for a in (*args.posonlyargs, *args.args)]
+
+
+def int_elements(node: ast.AST) -> Optional[list[int]]:
+    """[1, 2] / (1, 2) / 1 -> list of ints, else None."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            v = num_const(el)
+            if not isinstance(v, int):
+                return None
+            out.append(v)
+        return out
+    v = num_const(node)
+    if isinstance(v, int):
+        return [v]
+    return None
+
+
+def str_elements(node: ast.AST) -> Optional[list[str]]:
+    """("a", "b") / ["a"] / "a" -> list of strings, else None."""
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for el in node.elts:
+            s = str_const(el)
+            if s is None:
+                return None
+            out.append(s)
+        return out
+    s = str_const(node)
+    if s is not None:
+        return [s]
+    return None

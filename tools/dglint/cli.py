@@ -1,0 +1,103 @@
+"""dglint command line.
+
+    python -m tools.dglint dgraph_tpu tests            # lint vs baseline
+    python -m tools.dglint --write-baseline dgraph_tpu tests
+    python -m tools.dglint --no-baseline dgraph_tpu    # every finding
+    python -m tools.dglint --list-rules
+    python -m tools.dglint --timing dgraph_tpu tests   # wall-time report
+
+Exit status: 0 when every finding is suppressed or grandfathered in
+tools/dglint_baseline.txt, 1 when new findings exist, 2 on usage
+errors. Stale baseline entries are reported but never fail the run
+(fixing a finding must not break CI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from tools.dglint.core import (
+    all_rules, apply_baseline, build_project, lint_project,
+    load_baseline, render_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools",
+                                "dglint_baseline.txt")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dglint",
+        description="AST-based invariant linter for the dgraph_tpu "
+                    "JAX data plane and MVCC/concurrency control "
+                    "plane.")
+    ap.add_argument("paths", nargs="*",
+                    default=["dgraph_tpu", "tests"],
+                    help="files/directories to lint (default: "
+                         "dgraph_tpu tests)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file of grandfathered findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding; exit 1 if any")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--timing", action="store_true",
+                    help="report lint wall time (the CI-gate budget "
+                         "is < 5 s on the full tree)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code, rule in sorted(all_rules().items()):
+            scopes = ", ".join(rule.scopes)
+            print(f"{code} {rule.name}  [{scopes}]")
+            doc = rule.doc or ""
+            for line in doc.splitlines():
+                print(f"     {line.strip()}")
+        return 0
+
+    t0 = time.monotonic()
+    proj = build_project(list(args.paths), REPO_ROOT)
+    findings = lint_project(proj)
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline:
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            f.write(render_baseline(findings))
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        new, old = findings, []
+        allowed = {}
+    else:
+        allowed = load_baseline(args.baseline)
+        new, old = apply_baseline(findings, allowed)
+
+    for f in new:
+        print(f.render())
+    if old:
+        print(f"[dglint] {len(old)} grandfathered finding(s) "
+              "matched the baseline", file=sys.stderr)
+    stale = sum(allowed.values()) - len(old)
+    if stale > 0:
+        print(f"[dglint] {stale} stale baseline entr"
+              f"{'y' if stale == 1 else 'ies'} no longer fire — "
+              "prune tools/dglint_baseline.txt", file=sys.stderr)
+    if args.timing:
+        nfiles = len(proj.files)
+        print(f"[dglint] linted {nfiles} files, "
+              f"{len(all_rules())} rules in {elapsed:.2f}s "
+              f"({1000 * elapsed / max(1, nfiles):.1f} ms/file)",
+              file=sys.stderr)
+    if new:
+        print(f"[dglint] {len(new)} new finding(s); fix them, add "
+              "`# dglint: disable=CODE` with a reason, or (last "
+              "resort) regenerate the baseline", file=sys.stderr)
+        return 1
+    return 0

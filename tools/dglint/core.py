@@ -1,0 +1,329 @@
+"""dglint core: findings, rule registry, suppressions, baseline.
+
+dglint is an AST-based invariant linter for this codebase's two hard-
+to-test planes: the JAX data plane (trace purity, recompilation
+hazards) and the MVCC/concurrency control plane (snapshot discipline,
+lock hygiene, deadline threading, cancellation flow). Python's type
+checkers and generic linters cannot see these invariants — they are
+project contracts, not language rules — so regressions only surface as
+flaky tests or silent perf cliffs. dglint makes them build errors.
+
+Architecture:
+
+    ProjectContext  one pass over every file: parsed ASTs plus the
+                    cross-file facts rules need (registered metric
+                    names, failpoint sites)
+    Rule            a function (FileContext) -> Iterable[Finding],
+                    registered under a stable DGnn code with a path
+                    scope (which tree prefixes it applies to)
+    suppressions    `# dglint: disable=DG01[,DG02]` on the flagged
+                    line silences it; `# dglint: file-disable=DG01`
+                    anywhere in a file silences the code file-wide
+    baseline        grandfathered findings committed to
+                    tools/dglint_baseline.txt; a finding matching a
+                    baseline entry does not fail the run. Entries are
+                    keyed by (code, path, stripped source line) so
+                    unrelated edits do not invalidate them.
+
+stdlib only (`ast`, `tokenize`-free line scanning) — no new deps.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator
+
+__all__ = [
+    "Finding", "Rule", "FileContext", "ProjectContext", "register",
+    "all_rules", "lint_project", "lint_source", "load_baseline",
+    "apply_baseline", "render_baseline",
+]
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    code: str       # "DG01" .. "DG08"
+    path: str       # repo-relative, forward slashes
+    line: int       # 1-based
+    message: str
+    context: str = ""   # stripped source text of the flagged line
+
+    def key(self) -> tuple[str, str, str]:
+        """Baseline identity: stable across unrelated edits (no line
+        number), specific enough to not mask new violations."""
+        return (self.code, self.path, self.context)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+@dataclass
+class Rule:
+    code: str
+    name: str
+    doc: str
+    scopes: tuple[str, ...]     # path prefixes this rule applies to
+    fn: Callable[["FileContext"], Iterable[Finding]]
+
+    def applies(self, rel: str) -> bool:
+        return any(rel.startswith(s) for s in self.scopes)
+
+
+_RULES: dict[str, Rule] = {}
+
+
+def register(code: str, name: str, scopes: tuple[str, ...]):
+    """Decorator registering a rule function under `code`, scoped to
+    files whose repo-relative path starts with one of `scopes`."""
+
+    def deco(fn):
+        if code in _RULES:
+            raise ValueError(f"duplicate rule code {code}")
+        _RULES[code] = Rule(code, name, (fn.__doc__ or "").strip(),
+                            tuple(scopes), fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> dict[str, Rule]:
+    _load_rules()
+    return dict(_RULES)
+
+
+def _load_rules():
+    # import for side effect: each module registers its rules
+    from tools.dglint import (  # noqa: F401
+        rules_concurrency, rules_jax, rules_mvcc, rules_registry,
+    )
+
+
+# --------------------------------------------------------------- contexts
+
+
+@dataclass
+class ProjectContext:
+    """Cross-file facts collected before any rule runs."""
+
+    root: str
+    files: dict[str, ast.AST] = field(default_factory=dict)
+    sources: dict[str, list[str]] = field(default_factory=dict)
+    # DG08 registries, parsed from their home modules' ASTs
+    failpoint_sites: frozenset[str] = frozenset()
+    failpoint_dupes: list[tuple[str, int]] = field(default_factory=list)
+    metric_names: frozenset[str] = frozenset()
+    metric_dupes: list[tuple[str, int]] = field(default_factory=list)
+    registries_found: bool = False
+
+
+@dataclass
+class FileContext:
+    rel: str                    # repo-relative path
+    tree: ast.AST
+    lines: list[str]            # raw source lines (1-based via [i-1])
+    project: ProjectContext
+
+    def finding(self, code: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        ctx = self.lines[line - 1].strip() if \
+            0 < line <= len(self.lines) else ""
+        return Finding(code, self.rel, line, message, ctx)
+
+
+# ------------------------------------------------------------ suppressions
+
+_DISABLE = "# dglint: disable="
+_FILE_DISABLE = "# dglint: file-disable="
+
+
+def _suppressed_codes(line_text: str, marker: str) -> set[str]:
+    i = line_text.find(marker)
+    if i < 0:
+        return set()
+    tail = line_text[i + len(marker):]
+    # codes run until whitespace or a comment-continuation dash
+    head = tail.split()[0] if tail.split() else ""
+    return {c.strip() for c in head.split(",") if c.strip()}
+
+
+def suppressions(lines: list[str]) -> tuple[dict[int, set[str]], set[str]]:
+    """(per-line {lineno: codes}, file-wide codes)."""
+    per_line: dict[int, set[str]] = {}
+    file_wide: set[str] = set()
+    for i, text in enumerate(lines, start=1):
+        codes = _suppressed_codes(text, _DISABLE)
+        if codes:
+            per_line[i] = codes
+        file_wide |= _suppressed_codes(text, _FILE_DISABLE)
+    return per_line, file_wide
+
+
+# ---------------------------------------------------------------- walking
+
+
+def _iter_py(paths: list[str], root: str) -> Iterator[tuple[str, str]]:
+    """Yield (abs_path, rel_path) for every .py under `paths`."""
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            yield ap, os.path.relpath(ap, root).replace(os.sep, "/")
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", ".venv"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    fp = os.path.join(dirpath, fn)
+                    yield fp, os.path.relpath(fp, root).replace(
+                        os.sep, "/")
+
+
+def build_project(paths: list[str], root: str) -> ProjectContext:
+    proj = ProjectContext(root=root)
+    for ap, rel in _iter_py(paths, root):
+        try:
+            with open(ap, encoding="utf-8") as f:
+                src = f.read()
+            proj.files[rel] = ast.parse(src, filename=rel)
+            proj.sources[rel] = src.splitlines()
+        except (OSError, SyntaxError):
+            # compileall in tools/check.sh owns syntax errors
+            continue
+    _collect_registries(proj, root)
+    return proj
+
+
+def _collect_registries(proj: ProjectContext, root: str):
+    """Parse the failpoint-site and metric-name registries from their
+    home modules, whether or not those modules are in the lint set."""
+    from tools.dglint.rules_registry import parse_registry
+
+    fp_rel = "dgraph_tpu/utils/failpoint.py"
+    mt_rel = "dgraph_tpu/utils/metrics.py"
+    found = 0
+    for rel, target, attr in ((fp_rel, "SITES", "failpoint"),
+                              (mt_rel, "REGISTERED", "metric")):
+        tree = proj.files.get(rel)
+        if tree is None:
+            ap = os.path.join(root, rel)
+            try:
+                with open(ap, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=rel)
+            except (OSError, SyntaxError):
+                continue
+        names, dupes = parse_registry(tree, target)
+        if names is None:
+            continue
+        found += 1
+        if attr == "failpoint":
+            proj.failpoint_sites = frozenset(names)
+            proj.failpoint_dupes = dupes
+        else:
+            proj.metric_names = frozenset(names)
+            proj.metric_dupes = dupes
+    proj.registries_found = found == 2
+
+
+# ----------------------------------------------------------------- lint
+
+
+def lint_project(proj: ProjectContext) -> list[Finding]:
+    rules = all_rules()
+    findings: list[Finding] = []
+    for rel in sorted(proj.files):
+        tree = proj.files[rel]
+        lines = proj.sources[rel]
+        per_line, file_wide = suppressions(lines)
+        fctx = FileContext(rel=rel, tree=tree, lines=lines, project=proj)
+        for rule in rules.values():
+            if not rule.applies(rel):
+                continue
+            for f in rule.fn(fctx):
+                if f.code in file_wide:
+                    continue
+                if f.code in per_line.get(f.line, ()):
+                    continue
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+def lint_source(src: str, rel: str = "dgraph_tpu/_fixture.py",
+                project: ProjectContext | None = None) -> list[Finding]:
+    """Lint one source string as if it lived at `rel` — the unit-test
+    entry point for rule fixtures."""
+    proj = project or ProjectContext(root=".")
+    tree = ast.parse(src, filename=rel)
+    lines = src.splitlines()
+    proj.files[rel] = tree
+    proj.sources[rel] = lines
+    per_line, file_wide = suppressions(lines)
+    fctx = FileContext(rel=rel, tree=tree, lines=lines, project=proj)
+    out: list[Finding] = []
+    for rule in all_rules().values():
+        if not rule.applies(rel):
+            continue
+        for f in rule.fn(fctx):
+            if f.code in file_wide or f.code in per_line.get(f.line, ()):
+                continue
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.code))
+    return out
+
+
+# --------------------------------------------------------------- baseline
+
+
+def load_baseline(path: str) -> dict[tuple[str, str, str], int]:
+    """Baseline file -> {finding key: allowed count}. Format, one per
+    line: CODE<TAB>path<TAB>stripped source line. Blank lines and
+    `#` comments ignored."""
+    allowed: dict[tuple[str, str, str], int] = {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            for raw in f:
+                line = raw.rstrip("\n")
+                if not line.strip() or line.lstrip().startswith("#"):
+                    continue
+                parts = line.split("\t", 2)
+                if len(parts) != 3:
+                    continue
+                key = (parts[0], parts[1], parts[2])
+                allowed[key] = allowed.get(key, 0) + 1
+    except OSError:
+        pass
+    return allowed
+
+
+def apply_baseline(findings: list[Finding],
+                   allowed: dict[tuple[str, str, str], int]
+                   ) -> tuple[list[Finding], list[Finding]]:
+    """Split findings into (new, grandfathered)."""
+    budget = dict(allowed)
+    new: list[Finding] = []
+    old: list[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def render_baseline(findings: list[Finding]) -> str:
+    lines = [
+        "# dglint baseline: grandfathered findings. Each line is",
+        "# CODE<TAB>path<TAB>stripped source text of the flagged line.",
+        "# Regenerate with: python -m tools.dglint --write-baseline "
+        "dgraph_tpu tests",
+    ]
+    for f in findings:
+        lines.append(f"{f.code}\t{f.path}\t{f.context}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,296 @@
+"""DG04-DG07 — control-plane concurrency rules.
+
+The control plane's liveness contracts are all conventions:
+
+  DG04  nothing blocking runs while a server lock is held (the rwlock
+        serializes every reader behind a writer that stalls), and lock
+        pairs are always taken in one order (the documented global
+        orders: rw -> meta in server/http.py, _write_lock ->
+        _finalize_lock -> lock in cluster/service.py)
+  DG05  a request's deadline/cancellation context must reach every
+        engine entry point a handler calls — a dropped `ctx` silently
+        turns a bounded request into an unbounded one
+  DG06  durations and deadlines are computed from `time.monotonic()`;
+        `time.time()` is reserved for user-visible wall-clock stamps
+        (NTP steps must never expire a deadline early or pin a txn
+        TTL forever)
+  DG07  `except Exception` in the serving paths must not swallow
+        cancellation: RequestAborted either re-raises or is mapped by
+        an earlier, more specific handler
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dglint.astutil import call_name, dotted, walk_calls
+from tools.dglint.core import FileContext, register
+
+# ------------------------------------------------------------------ DG04
+
+# attribute names that are locks without "lock" in the name (the
+# server's txn-table mutex and admission gate, condition variables)
+_EXTRA_LOCK_ATTRS = frozenset({"meta", "_admission", "_cond"})
+
+_BLOCKING_SUFFIXES = (".block_until_ready",)
+
+
+def _lock_expr(item: ast.withitem) -> str | None:
+    """Normalized lock name if this with-item acquires a lock."""
+    d = dotted(item.context_expr)
+    if d is None and isinstance(item.context_expr, ast.Call):
+        d = call_name(item.context_expr)
+    if d is None:
+        return None
+    parts = d.split(".")
+    last = parts[-1]
+    if last in ("read", "write") and len(parts) >= 2 \
+            and ("rw" in parts[-2] or "lock" in parts[-2].lower()):
+        return d[5:] if d.startswith("self.") else d
+    if "lock" in last.lower() or last in _EXTRA_LOCK_ATTRS:
+        return d[5:] if d.startswith("self.") else d
+    return None
+
+
+def _is_blocking_call(call: ast.Call) -> str | None:
+    name = call_name(call)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if len(parts) >= 2 and parts[-1] == "sleep" \
+            and parts[-2] in ("time", "_time"):
+        return name
+    if len(parts) >= 2 and parts[-1] == "fire" \
+            and parts[-2] == "failpoint":
+        return name
+    if len(parts) >= 2 and parts[-1] == "send" \
+            and "transport" in parts[-2]:
+        return name
+    if name == "jax.device_get" or name == "socket.create_connection":
+        return name
+    if any(name.endswith(s) for s in _BLOCKING_SUFFIXES):
+        return name
+    return None
+
+
+@register("DG04", "lock-hygiene", scopes=("dgraph_tpu/",))
+def check_lock_hygiene(ctx: FileContext):
+    """No blocking calls (`time.sleep`, `transport.send`, failpoint
+    evaluation, device syncs, socket dials) while lexically holding a
+    lock, and no two locks acquired in both orders in one module."""
+    pair_sites: dict[tuple[str, str], ast.AST] = {}
+
+    def visit(node: ast.AST, held: tuple[str, ...]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a nested def's body does not run under the enclosing
+            # with; it starts with no locks held
+            for sub in ast.iter_child_nodes(node):
+                visit(sub, ())
+            return
+        if isinstance(node, ast.With):
+            new_held = held
+            for item in node.items:
+                lock = _lock_expr(item)
+                if lock is not None:
+                    for outer in new_held:
+                        if outer != lock:
+                            pair_sites.setdefault((outer, lock), item)
+                    new_held = new_held + (lock,)
+            for sub in node.body:
+                visit(sub, new_held)
+            return
+        if held and isinstance(node, ast.Call):
+            blocking = _is_blocking_call(node)
+            if blocking is not None:
+                yield_to.append(ctx.finding(
+                    "DG04", node,
+                    f"blocking call `{blocking}` while holding "
+                    f"lock(s) {', '.join(held)} — move it outside "
+                    "the critical section"))
+        for sub in ast.iter_child_nodes(node):
+            visit(sub, held)
+
+    yield_to: list = []
+    visit(ctx.tree, ())
+    yield from yield_to
+    # acquisition-order inversions: (a taken before b) and (b before a)
+    reported: set[frozenset] = set()
+    for (a, b), item in sorted(
+            pair_sites.items(),
+            key=lambda kv: getattr(kv[1], "lineno", 0)):
+        if (b, a) in pair_sites and frozenset((a, b)) not in reported:
+            reported.add(frozenset((a, b)))
+            other = pair_sites[(b, a)]
+            first, second = sorted(
+                (item, other), key=lambda n: getattr(n, "lineno", 0))
+            yield ctx.finding(
+                "DG04", second,
+                f"locks `{a}` and `{b}` are acquired in both orders "
+                f"in this module (other site at line "
+                f"{getattr(first, 'lineno', '?')}) — pick one global "
+                "order or this deadlocks under contention")
+
+
+# ------------------------------------------------------------------ DG05
+
+# engine entry points that accept (and must receive) the request
+# context, checked on receivers that look like the engine handle
+_ENGINE_ENTRY_ATTRS = frozenset({"query", "query_json", "mutate",
+                                 "alter"})
+_HANDLER_ATTRS = frozenset({"handle_query", "handle_query_json",
+                            "handle_mutate", "handle_commit",
+                            "handle_alter"})
+# internal metadata readers exempt from the receiver arm: the ACL
+# manager's user/group lookups are trusted, bounded engine reads
+_DB_RECEIVER_FILES = ("dgraph_tpu/cluster/service.py",
+                      "dgraph_tpu/cluster/federated.py",
+                      "dgraph_tpu/server/http.py",
+                      "dgraph_tpu/server/grpc_api.py")
+
+
+def _passes_ctx(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "ctx":
+            return True
+    return any(isinstance(a, ast.Name) and a.id in ("ctx", "reqctx")
+               for a in call.args)
+
+
+def _binds_ctx(fn: ast.AST) -> bool:
+    args = fn.args
+    names = {a.arg for a in (*args.posonlyargs, *args.args,
+                             *args.kwonlyargs)}
+    if "ctx" in names or "reqctx" in names:
+        return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "ctx":
+                    return True
+    return False
+
+
+@register("DG05", "deadline-discipline",
+          scopes=("dgraph_tpu/cluster/", "dgraph_tpu/server/"))
+def check_deadline_discipline(ctx: FileContext):
+    """RPC entry points must thread the RequestContext: a handler
+    that binds a `ctx` forwards it to every engine entry point and
+    transport-independent handler it calls, and the cluster serving
+    files never call `db.query/mutate/alter` without one."""
+    flagged: set[int] = set()
+    for fn in [n for n in ast.walk(ctx.tree)
+               if isinstance(n, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef))]:
+        if not _binds_ctx(fn):
+            continue
+        for call in walk_calls(fn):
+            if not isinstance(call.func, ast.Attribute):
+                continue
+            attr = call.func.attr
+            base = dotted(call.func.value) or ""
+            is_engine = attr in _ENGINE_ENTRY_ATTRS and (
+                base == "db" or base.endswith(".db"))
+            is_handler = attr in _HANDLER_ATTRS
+            if (is_engine or is_handler) and not _passes_ctx(call):
+                flagged.add(id(call))
+                yield ctx.finding(
+                    "DG05", call,
+                    f"`{base + '.' if base else ''}{attr}(...)` "
+                    "drops the request context this function binds — "
+                    "pass ctx= so the deadline/cancellation reaches "
+                    "the engine")
+    if ctx.rel in _DB_RECEIVER_FILES:
+        for call in walk_calls(ctx.tree):
+            if not isinstance(call.func, ast.Attribute):
+                continue
+            attr = call.func.attr
+            base = dotted(call.func.value) or ""
+            if attr in _ENGINE_ENTRY_ATTRS \
+                    and (base == "db" or base.endswith(".db")) \
+                    and not _passes_ctx(call) \
+                    and id(call) not in flagged:
+                yield ctx.finding(
+                    "DG05", call,
+                    f"`{base}.{attr}(...)` in a cluster serving path "
+                    "without a request context — thread the caller's "
+                    "deadline (RequestContext) through")
+
+
+# ------------------------------------------------------------------ DG06
+
+
+@register("DG06", "monotonic-time", scopes=("dgraph_tpu/",))
+def check_monotonic_time(ctx: FileContext):
+    """`time.time()` is wall clock: NTP steps make durations computed
+    from it negative or hours long. Deadlines, TTLs, and intervals use
+    `time.monotonic()`; keep `time.time()` only for user-visible
+    timestamps (and mark those sites `# dglint: disable=DG06`)."""
+    for call in walk_calls(ctx.tree):
+        name = call_name(call)
+        if name is None:
+            continue
+        parts = name.split(".")
+        if len(parts) == 2 and parts[1] == "time" \
+                and parts[0] in ("time", "_time"):
+            yield ctx.finding(
+                "DG06", call,
+                "wall-clock time.time() — use time.monotonic() for "
+                "durations/deadlines, or suppress if this timestamp "
+                "is user-visible")
+
+
+# ------------------------------------------------------------------ DG07
+
+_ABORT_NAMES = frozenset({"RequestAborted", "Cancelled",
+                          "DeadlineExceeded", "CancelledError",
+                          "KeyboardInterrupt", "BaseException"})
+
+
+def _catches_abort(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for el in types:
+        d = dotted(el) if el is not None else None
+        if d is not None and d.split(".")[-1] in _ABORT_NAMES:
+            return True
+    return False
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    d = dotted(handler.type)
+    return d is not None and d.split(".")[-1] in ("Exception",
+                                                  "BaseException")
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body contains a top-level bare `raise`
+    (cleanup-then-reraise) — cancellation flows through."""
+    return any(isinstance(stmt, ast.Raise) and stmt.exc is None
+               for stmt in handler.body)
+
+
+@register("DG07", "swallowed-cancellation",
+          scopes=("dgraph_tpu/cluster/", "dgraph_tpu/server/"))
+def check_swallowed_cancellation(ctx: FileContext):
+    """A broad `except Exception` in the serving paths must let
+    cancellation/deadline errors (RequestAborted) out: re-raise them
+    in the body, or catch them in an earlier, more specific handler."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        abort_handled = False
+        for handler in node.handlers:
+            if _catches_abort(handler):
+                abort_handled = True
+                continue
+            if _is_broad(handler) and not abort_handled \
+                    and not _reraises(handler):
+                yield ctx.finding(
+                    "DG07", handler,
+                    "broad except can swallow RequestAborted "
+                    "(cancellation/deadline) — add `except "
+                    "RequestAborted: raise` above it or re-raise in "
+                    "the body")

@@ -1,0 +1,233 @@
+"""DG01/DG02 — JAX data-plane rules.
+
+The data plane only hits the peak-FLOP/s regime TPU-KNN (arxiv
+2206.14286) measures when traced code stays trace-pure: a single
+`.item()` / host `np.asarray` / wall-clock read inside a jitted or
+Pallas-reachable function inserts a device->host sync per dispatch,
+and a Python scalar flowing into a jitted function without
+`static_argnums` retraces the kernel per distinct value. Both
+regressions are invisible to tests (results stay correct) — they only
+show up as a perf cliff, so they are linted instead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dglint.astutil import (
+    FuncDef, call_name, dotted, has_kwarg, int_elements, iter_funcdefs,
+    kwarg, numpy_aliases, posonly_params, str_elements, walk_calls,
+)
+from tools.dglint.core import FileContext, register
+
+# dotted callee names that force a host sync or a side effect inside
+# traced code
+_TIME_MODULES = ("time", "_time")
+_TIME_FNS = ("time", "monotonic", "sleep", "perf_counter",
+             "process_time")
+_HOST_BUILTINS = ("print", "input", "breakpoint")
+_JIT_NAMES = ("jax.jit", "jit")
+_TRACE_WRAPPERS = ("shard_map", "pl.pallas_call", "pallas_call",
+                   "jax.vmap", "vmap", "jax.grad", "jax.lax.scan",
+                   "lax.scan")
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    """@jax.jit, @jit, @partial(jax.jit, ...), @functools.partial(...)."""
+    name = dotted(dec)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        cname = call_name(dec)
+        if cname in _JIT_NAMES:
+            return True
+        if cname in ("partial", "functools.partial") and dec.args:
+            return dotted(dec.args[0]) in _JIT_NAMES
+    return False
+
+
+def _trace_roots(tree: ast.AST) -> tuple[set[str], list[ast.Lambda]]:
+    """Function NAMES that enter tracing (jit/shard_map/pallas_call
+    targets or jit-decorated defs) plus lambdas passed to them."""
+    names: set[str] = set()
+    lambdas: list[ast.Lambda] = []
+    for fn in iter_funcdefs(tree):
+        if any(_is_jit_decorator(d) for d in fn.decorator_list):
+            names.add(fn.name)
+    for call in walk_calls(tree):
+        cname = call_name(call)
+        if cname in _JIT_NAMES or cname in _TRACE_WRAPPERS:
+            if call.args:
+                target = call.args[0]
+                if isinstance(target, ast.Name):
+                    names.add(target.id)
+                elif isinstance(target, ast.Lambda):
+                    lambdas.append(target)
+    return names, lambdas
+
+
+def _reachable(tree: ast.AST, roots: set[str]) -> dict[str, ast.AST]:
+    """Same-module call-graph closure from the root function names.
+    Conservative: calls through attributes (other modules, methods)
+    are not followed."""
+    defs: dict[str, list] = {}
+    for fn in iter_funcdefs(tree):
+        defs.setdefault(fn.name, []).append(fn)
+    seen: dict[str, ast.AST] = {}
+    work = [n for n in roots if n in defs]
+    while work:
+        name = work.pop()
+        if name in seen:
+            continue
+        for fn in defs[name]:
+            seen[name] = fn
+            for call in walk_calls(fn):
+                if isinstance(call.func, ast.Name) \
+                        and call.func.id in defs \
+                        and call.func.id not in seen:
+                    work.append(call.func.id)
+    return seen
+
+
+def _purity_violations(ctx: FileContext, body: ast.AST, where: str,
+                       np_names: set[str]):
+    for call in walk_calls(body):
+        name = call_name(call)
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "item" and not call.args:
+            yield ctx.finding(
+                "DG01", call,
+                f"`.item()` in jit-reachable `{where}` forces a "
+                "device->host sync per dispatch")
+            continue
+        if name is None:
+            continue
+        if name in _HOST_BUILTINS:
+            yield ctx.finding(
+                "DG01", call,
+                f"host side effect `{name}()` in jit-reachable "
+                f"`{where}` (use jax.debug.print for traced values)")
+            continue
+        parts = name.split(".")
+        if len(parts) == 2 and parts[0] in _TIME_MODULES \
+                and parts[1] in _TIME_FNS:
+            yield ctx.finding(
+                "DG01", call,
+                f"wall-clock call `{name}()` in jit-reachable "
+                f"`{where}` is a tracer-time constant (and a host "
+                "sync under pallas interpret)")
+            continue
+        if name in ("jax.device_get",) or name.endswith(
+                ".block_until_ready"):
+            yield ctx.finding(
+                "DG01", call,
+                f"`{name}` in jit-reachable `{where}` blocks on the "
+                "device inside the traced region")
+            continue
+        if len(parts) == 2 and parts[0] in np_names \
+                and parts[1] in ("asarray", "array", "copy"):
+            yield ctx.finding(
+                "DG01", call,
+                f"`{name}` in jit-reachable `{where}` pulls a tracer "
+                "to host numpy (TracerArrayConversionError at best, "
+                "a silent per-call sync at worst)")
+
+
+@register("DG01", "jit-purity",
+          scopes=("dgraph_tpu/ops/", "dgraph_tpu/parallel/"))
+def check_jit_purity(ctx: FileContext):
+    """No host syncs or side effects (`.item()`, `np.asarray`, time
+    reads, print, device_get) inside functions reachable from
+    `jax.jit` / `shard_map` / `pallas_call` in the kernel packages."""
+    roots, lambdas = _trace_roots(ctx.tree)
+    np_names = numpy_aliases(ctx.tree)
+    for name, fn in _reachable(ctx.tree, roots).items():
+        yield from _purity_violations(ctx, fn, name, np_names)
+    for lam in lambdas:
+        yield from _purity_violations(ctx, lam, "<lambda>", np_names)
+
+
+# ------------------------------------------------------------------ DG02
+
+
+def _module_defs(tree: ast.AST) -> dict[str, ast.FunctionDef]:
+    out = {}
+    for fn in iter_funcdefs(tree):
+        out.setdefault(fn.name, fn)
+    return out
+
+
+def _validate_static_args(ctx: FileContext, call_or_dec: ast.Call,
+                          fn: ast.FunctionDef):
+    params = posonly_params(fn)
+    nums = kwarg(call_or_dec, "static_argnums")
+    names = kwarg(call_or_dec, "static_argnames")
+    donate = kwarg(call_or_dec, "donate_argnums")
+    nums_v = int_elements(nums) if nums is not None else None
+    names_v = str_elements(names) if names is not None else None
+    donate_v = int_elements(donate) if donate is not None else None
+    if nums_v is not None:
+        for i in nums_v:
+            if i >= len(params) or i < -len(params):
+                yield ctx.finding(
+                    "DG02", call_or_dec,
+                    f"static_argnums index {i} out of range for "
+                    f"`{fn.name}` ({len(params)} positional params)")
+    if names_v is not None:
+        for n in names_v:
+            kwonly = [a.arg for a in fn.args.kwonlyargs]
+            if n not in params and n not in kwonly:
+                yield ctx.finding(
+                    "DG02", call_or_dec,
+                    f"static_argnames {n!r} is not a parameter of "
+                    f"`{fn.name}`")
+    if nums_v is not None and donate_v is not None:
+        both = sorted(set(nums_v) & set(donate_v))
+        if both:
+            yield ctx.finding(
+                "DG02", call_or_dec,
+                f"params {both} of `{fn.name}` are both static and "
+                "donated — a static arg has no buffer to donate")
+
+
+@register("DG02", "recompile-hazard", scopes=("dgraph_tpu/",))
+def check_recompile_hazard(ctx: FileContext):
+    """`static_argnums`/`static_argnames` must match the wrapped
+    signature, and a jit wrapper must not be rebuilt per call
+    (`jax.jit(f)(x)` immediately invoked, or `jax.jit` inside a loop)
+    — every rebuild retraces and recompiles."""
+    defs = _module_defs(ctx.tree)
+    for fn in iter_funcdefs(ctx.tree):
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call) and _is_jit_decorator(dec):
+                yield from _validate_static_args(ctx, dec, fn)
+    for call in walk_calls(ctx.tree):
+        if call_name(call) not in _JIT_NAMES:
+            continue
+        if call.args and isinstance(call.args[0], ast.Name) \
+                and call.args[0].id in defs:
+            yield from _validate_static_args(ctx, call,
+                                             defs[call.args[0].id])
+    # jax.jit(...)(...) — wrapper built and invoked in one expression:
+    # a fresh wrapper has an empty trace cache, so this retraces and
+    # recompiles on EVERY call
+    for call in walk_calls(ctx.tree):
+        if isinstance(call.func, ast.Call) \
+                and call_name(call.func) in _JIT_NAMES:
+            yield ctx.finding(
+                "DG02", call,
+                "jit wrapper constructed and invoked in one "
+                "expression — cache the jitted callable (module "
+                "level or keyed cache) or every call retraces")
+    # jax.jit(...) lexically inside a loop body: same hazard unless
+    # the result is cached, which a loop body almost never does
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for call in walk_calls(loop):
+            if call_name(call) in _JIT_NAMES and not isinstance(
+                    call.func, ast.Call):
+                yield ctx.finding(
+                    "DG02", call,
+                    "jax.jit called inside a loop — hoist and cache "
+                    "the wrapper, or each iteration recompiles")

@@ -1,0 +1,92 @@
+"""DG03 — MVCC snapshot discipline.
+
+Every Tablet/VecView read must happen *at a read timestamp*: the
+engine's isolation story (storage/tablet.py: base block at base_ts +
+commit-ts-stamped overlay, reads see deltas in (base_ts, read_ts]) is
+only as strong as its least-disciplined caller. Two failure shapes
+recur in review:
+
+  1. reaching into the overlay/base internals directly (`_overlay`,
+     `_src_overlay`, `_vec_base`, ...) from outside `storage/`, which
+     bypasses visibility filtering entirely, and
+  2. calling a snapshot API with a *hardcoded* numeric read_ts
+     ("read latest" hacks like `2**63`), which silently breaks
+     repeatable reads and pinned-snapshot queries.
+
+Both are flagged outside `storage/` (the implementation package owns
+its internals) — callers must accept a `read_ts` and forward it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dglint.astutil import num_const, walk_calls
+from tools.dglint.core import FileContext, register
+
+# Tablet/VecView internals that bypass MVCC visibility filtering.
+# Device-cache stash attributes (_device_*) are deliberately absent:
+# they are keyed by base_ts and re-validated on read.
+_PRIVATE_MVCC_ATTRS = frozenset({
+    "_base", "_overlay", "_ov_ts", "_ov_ops", "_ov_idx", "_ov_index",
+    "_ov_extend", "_ov_drop", "_src_overlay", "_overlay_ts",
+    "_postings_before", "_dsts_before", "_vec_base", "_fold",
+    "_merge_posting",
+})
+
+# snapshot-read API -> 0-based position of its read_ts parameter at
+# the CALL site (i.e. after `self` is bound)
+_SNAPSHOT_APIS = {
+    "get_dst_uids": 1, "get_reverse_uids": 1, "get_postings": 1,
+    "index_uids": 1, "src_uids": 0, "dst_uids": 0,
+    "expand_frontier": 1, "count_of": 1, "get_facets": 2,
+    "value_columns": 0, "lang_value_columns": 0, "edge_table": 0,
+    "token_index_csr": 0, "overlay_srcs": 0, "vector_view": 0,
+}
+
+_EXEMPT_PREFIXES = ("dgraph_tpu/storage/",)
+
+
+@register("DG03", "snapshot-discipline", scopes=("dgraph_tpu/",))
+def check_snapshot_discipline(ctx: FileContext):
+    """Outside `storage/`, no direct access to Tablet/VecView overlay
+    internals, and no hardcoded numeric `read_ts` at snapshot-read
+    call sites — reads must thread the caller's read timestamp."""
+    if ctx.rel.startswith(_EXEMPT_PREFIXES):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Attribute) \
+                and node.attr in _PRIVATE_MVCC_ATTRS:
+            # self._x inside a class that owns the attr is the
+            # implementation itself (only relevant for fixtures; real
+            # owners live in storage/ and are exempt above)
+            if isinstance(node.value, ast.Name) \
+                    and node.value.id == "self":
+                continue
+            yield ctx.finding(
+                "DG03", node,
+                f"direct `{node.attr}` access outside storage/ "
+                "bypasses MVCC visibility — use the read_ts snapshot "
+                "APIs")
+    for call in walk_calls(ctx.tree):
+        if not isinstance(call.func, ast.Attribute):
+            continue
+        pos = _SNAPSHOT_APIS.get(call.func.attr)
+        if pos is None or pos >= len(call.args):
+            # read_ts passed by keyword or omitted: keyword literals
+            # are caught below, omission is a TypeError at runtime
+            for kw in call.keywords:
+                if kw.arg == "read_ts" \
+                        and num_const(kw.value) is not None:
+                    yield ctx.finding(
+                        "DG03", call,
+                        f"hardcoded read_ts={num_const(kw.value)} at "
+                        f"`{call.func.attr}` — thread the request's "
+                        "read timestamp instead")
+            continue
+        v = num_const(call.args[pos])
+        if v is not None:
+            yield ctx.finding(
+                "DG03", call,
+                f"hardcoded read_ts={v} at `{call.func.attr}` — "
+                "thread the request's read timestamp instead")

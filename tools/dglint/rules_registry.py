@@ -1,0 +1,109 @@
+"""DG08 — metric and failpoint site registries.
+
+Observability names are API: a typo'd metric name silently forks a
+time series nobody's dashboard reads, and a failpoint site that
+production code never fires turns a chaos test into a no-op. Both
+registries are declarative tuples in their home modules —
+
+    dgraph_tpu/utils/failpoint.py   SITES = ("transport.send", ...)
+    dgraph_tpu/utils/metrics.py     REGISTERED = ("dgraph_num_...",)
+
+— and DG08 checks, across the whole tree, that every literal name
+passed to `failpoint.fire(...)` / `inc_counter` / `set_gauge` /
+`observe` is registered, and that neither registry lists a name twice.
+Dynamically computed names are skipped (the linter only reads
+literals). Tests may arm ad-hoc fixture sites via `failpoint.arm`;
+only production `fire(...)` sites are checked.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dglint.astutil import call_name, str_const, walk_calls
+from tools.dglint.core import FileContext, register
+
+_METRIC_FNS = frozenset({"inc_counter", "set_gauge", "observe"})
+
+_FAILPOINT_HOME = "dgraph_tpu/utils/failpoint.py"
+_METRICS_HOME = "dgraph_tpu/utils/metrics.py"
+
+
+def parse_registry(tree: ast.AST, target: str):
+    """Module-level `target = (...)` tuple/list/set/frozenset of str
+    literals -> (names, [(dupe, lineno)]); (None, []) if absent."""
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == target
+                   for t in node.targets):
+            continue
+        value = node.value
+        if isinstance(value, ast.Call) \
+                and call_name(value) in ("frozenset", "set", "tuple") \
+                and value.args:
+            value = value.args[0]
+        if not isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+            return None, []
+        names: list[str] = []
+        dupes: list[tuple[str, int]] = []
+        for el in value.elts:
+            s = str_const(el)
+            if s is None:
+                continue
+            if s in names:
+                dupes.append((s, getattr(el, "lineno", node.lineno)))
+            names.append(s)
+        return names, dupes
+    return None, []
+
+
+@register("DG08", "registry-discipline",
+          scopes=("dgraph_tpu/",))
+def check_registries(ctx: FileContext):
+    """Every literal failpoint site fired and metric name emitted must
+    appear in its registry tuple exactly once."""
+    proj = ctx.project
+    if not proj.registries_found:
+        return
+    if ctx.rel == _FAILPOINT_HOME:
+        for name, line in proj.failpoint_dupes:
+            yield ctx.finding(
+                "DG08",
+                _FakeNode(line),
+                f"failpoint site {name!r} registered twice in SITES")
+    if ctx.rel == _METRICS_HOME:
+        for name, line in proj.metric_dupes:
+            yield ctx.finding(
+                "DG08",
+                _FakeNode(line),
+                f"metric {name!r} registered twice in REGISTERED")
+    for call in walk_calls(ctx.tree):
+        name = call_name(call)
+        if name is None or not call.args:
+            continue
+        parts = name.split(".")
+        if parts[-1] == "fire" and len(parts) >= 2 \
+                and parts[-2] == "failpoint":
+            site = str_const(call.args[0])
+            if site is not None \
+                    and site not in proj.failpoint_sites:
+                yield ctx.finding(
+                    "DG08", call,
+                    f"failpoint site {site!r} fired but not listed "
+                    "in utils/failpoint.py SITES")
+        elif parts[-1] in _METRIC_FNS:
+            metric = str_const(call.args[0])
+            if metric is not None \
+                    and metric not in proj.metric_names:
+                yield ctx.finding(
+                    "DG08", call,
+                    f"metric {metric!r} emitted but not listed in "
+                    "utils/metrics.py REGISTERED")
+
+
+class _FakeNode:
+    """Line-only anchor for registry-home findings."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno
