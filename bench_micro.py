@@ -113,11 +113,51 @@ def lint_timing_bench(runs: int = 3):
     return med
 
 
+def span_overhead_bench(n: int = 20_000, runs: int = 5,
+                        budget_us: float = 5.0) -> dict:
+    """`--span-overhead`: per-span cost of utils/tracing with
+    recording ON vs OFF. The budget is < 5 µs/span — spans sit on the
+    executor's per-stage paths, so regressions here show up as a perf
+    cliff before any flamegraph would find them. One JSON line in the
+    microbench shape; tests/test_tracing.py enforces the budget with
+    generous CI slack (shared 1-core runners jitter)."""
+    from dgraph_tpu.utils import tracing
+
+    def per_span_us(enabled: bool) -> float:
+        tracing.set_enabled(enabled)
+        best = float("inf")
+        try:
+            for _ in range(runs):
+                tracing.clear()
+                t0 = time.perf_counter_ns()
+                for _ in range(n):
+                    with tracing.span("bench.span"):
+                        pass
+                best = min(best,
+                           (time.perf_counter_ns() - t0) / n / 1e3)
+        finally:
+            tracing.set_enabled(True)
+        return best
+
+    off = per_span_us(False)
+    on = per_span_us(True)
+    tracing.clear()
+    rec = {"metric": "span_overhead_us",
+           "on_us": round(on, 3), "off_us": round(off, 3),
+           "recording_cost_us": round(on - off, 3),
+           "budget_us": budget_us, "within_budget": on < budget_us}
+    print(json.dumps(rec))
+    return rec
+
+
 def main():
     from dgraph_tpu.utils.backend import force_cpu_backend, probe_backend
 
     if "--lint-timing" in sys.argv:
         lint_timing_bench()
+        return
+    if "--span-overhead" in sys.argv:
+        span_overhead_bench()
         return
 
     kway_bench()

@@ -15,6 +15,7 @@ import time
 from typing import Any, Optional
 
 from dgraph_tpu import wire
+from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.reqctx import Cancelled, DeadlineExceeded, Overloaded
 
 # wire `aborted` field -> the typed error the serving node raised, so
@@ -105,7 +106,31 @@ class ClusterClient:
 
     def request(self, req: dict, deadline_s: Optional[float] = None) -> dict:
         """Route to the leader, following hints and retrying through
-        elections until the deadline."""
+        elections until the deadline. When the calling context is
+        inside a trace (tracing.bind / an open span), the RPC records
+        an `rpc.send` span and ships `trace_id`/`parent_span` on the
+        wire so the serving node's spans join the originating trace
+        (ref worker/task.go forwarding the request context)."""
+        if tracing.current() is None:
+            return self._request(req, deadline_s)
+        with tracing.span("rpc.send", op=str(req.get("op", ""))):
+            return self._request(self._traced(req), deadline_s)
+
+    @staticmethod
+    def _traced(req: dict) -> dict:
+        """Copy of `req` carrying the active trace context: the remote
+        `rpc.recv` span parents under OUR innermost span (here: the
+        rpc.send span the caller just opened)."""
+        cur = tracing.current()
+        if cur is None:
+            return req
+        req = dict(req)
+        req.setdefault("trace_id", cur[0])
+        req["parent_span"] = cur[1]
+        return req
+
+    def _request(self, req: dict,
+                 deadline_s: Optional[float] = None) -> dict:
         # an EXHAUSTED budget (0.0) must fail fast, not silently widen
         # to the default timeout — 0.0 is falsy but meaningful
         deadline = time.monotonic() + (
@@ -211,6 +236,8 @@ class ClusterClient:
         stay owned by the main path). First non-error response wins.
         `deadline_s` bounds the WHOLE hedged wait (else self.timeout)."""
         import queue
+
+        req = self._traced(req)
 
         budget = self.timeout if deadline_s is None else deadline_s
         overall = time.monotonic() + budget

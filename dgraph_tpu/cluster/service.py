@@ -29,6 +29,7 @@ from dgraph_tpu.cluster.raft import (
     FOLLOWER, GOODBYE, LEADER, Msg, RaftNode, VOTE_REQ,
 )
 from dgraph_tpu.cluster.transport import TcpTransport
+from dgraph_tpu.utils import tracing
 from dgraph_tpu.utils.logger import log
 from dgraph_tpu.utils.reqctx import (
     PROPAGATION_SKEW_S, DeadlineExceeded, Overloaded, RequestAborted,
@@ -99,6 +100,14 @@ class RaftServer:
         self._client_listener.listen(64)
         self.client_addr = self._client_listener.getsockname()
 
+        # trace identity: one pid lane per node in the merged Perfetto
+        # view. Subclasses set a descriptive name (alpha-g1-n2) before
+        # calling super().__init__; the process-global default covers
+        # one-node-per-process deployments, the per-thread binding in
+        # the serving loops covers in-process multi-node harnesses.
+        self.node_name = getattr(self, "node_name", f"node-{node_id}")
+        tracing.set_node(self.node_name)
+
         self._threads = [
             threading.Thread(target=self._tick_loop, daemon=True,
                              name=f"raft-tick-{node_id}"),
@@ -152,6 +161,7 @@ class RaftServer:
         self._send_all(out)
 
     def _tick_loop(self):
+        tracing.set_thread_node(self.node_name)
         while not self._stop.wait(self.tick_s):
             with self.lock:
                 self.node.tick()
@@ -181,18 +191,24 @@ class RaftServer:
                 data = data["app"]
             self.sm_restore(data)
             self._acked.clear()
-        for e in r.committed:
-            if e.data is None:
-                continue
-            mark, origin, payload = e.data
-            if isinstance(payload, tuple) and payload \
-                    and payload[0] == "__conf__":
-                result = self._apply_conf(*payload[1:])
-            else:
-                result = self.sm_apply(origin, payload)
-            self._acked[mark] = result
-            self._applied_since_snap += 1
-            self.applied_cv.notify_all()
+        if r.committed:
+            # one span per committed batch (not per entry): the
+            # request thread's propose_and_wait drains here, so a
+            # traced write's apply cost shows inside its trace; tick-
+            # thread applies self-root under this node's lane
+            with tracing.span("raft.apply", n=len(r.committed)):
+                for e in r.committed:
+                    if e.data is None:
+                        continue
+                    mark, origin, payload = e.data
+                    if isinstance(payload, tuple) and payload \
+                            and payload[0] == "__conf__":
+                        result = self._apply_conf(*payload[1:])
+                    else:
+                        result = self.sm_apply(origin, payload)
+                    self._acked[mark] = result
+                    self._applied_since_snap += 1
+                    self.applied_cv.notify_all()
         if self._applied_since_snap >= self.snapshot_every:
             self._applied_since_snap = 0
             self.node.take_snapshot(
@@ -284,6 +300,19 @@ class RaftServer:
                     "members": {str(k): list(v)
                                 for k, v in self.members.items()},
                     "removed": self.node.removed}}
+        if op == "traces":
+            # node-local trace slice (the wire analogue of HTTP
+            # /debug/traces?trace_id=): tools/trace_merge.py stitches
+            # slices from every node into one Perfetto timeline. The
+            # node filter matters for in-process multi-node harnesses,
+            # where several logical nodes share one span ring.
+            want = req.get("trace")
+            spans = tracing.spans_for(want) if want \
+                else tracing.recent_spans(int(req.get("limit", 512)))
+            spans = [s for s in spans
+                     if s.get("node") == self.node_name]
+            return {"ok": True, "result": {"node": self.node_name,
+                                           "spans": spans}}
         if op == "conf_change":
             action = req.get("action")
             nid = int(req.get("node", 0))
@@ -368,12 +397,28 @@ class RaftServer:
             threading.Thread(target=self._client_loop, args=(conn,),
                              daemon=True).start()
 
+    def _serve_traced(self, req: dict) -> dict:
+        """handle_request under the caller's trace: a request carrying
+        `trace_id` (attached by ClusterClient from its bound context)
+        gets an `rpc.recv` span on THIS node, parented to the caller's
+        rpc.send span across the wire — the hop every federated task,
+        follower redirect and 2PC fan-out shows up as in the merged
+        timeline."""
+        tid = req.get("trace_id", "")
+        if not tid or not tracing.enabled():
+            return self.handle_request(req)
+        with tracing.bind(tid, req.get("parent_span", ""),
+                          node=self.node_name), \
+                tracing.span("rpc.recv", op=str(req.get("op", ""))):
+            return self.handle_request(req)
+
     def _client_loop(self, conn: socket.socket):
+        tracing.set_thread_node(self.node_name)
         try:
             while not self._stop.is_set():
                 req = wire.loads(wire.read_frame(conn))
                 try:
-                    resp = self.handle_request(req)
+                    resp = self._serve_traced(req)
                 except NotLeader as e:
                     resp = {"ok": False, "error": "not leader",
                             "leader": e.leader}
@@ -555,6 +600,7 @@ class AlphaServer(RaftServer):
         # two concurrent drains could otherwise interleave commits out
         # of ts order (see _drain_finalizes)
         self._finalize_lock = threading.Lock()
+        self.node_name = f"alpha-g{self.group}-n{node_id}"
         super().__init__(node_id, raft_peers, client_addr,
                          storage=storage, **kw)
         if self._join_members:
@@ -1051,10 +1097,17 @@ class AlphaServer(RaftServer):
         worker RPCs inheriting the query context)."""
         ms = req.get("deadline_ms")
         if ms is None:
+            if req.get("trace_id"):
+                # no deadline, but the caller IS tracing: keep the
+                # trace joined through the engine's bind_request
+                return RequestContext.background(
+                    trace_id=req["trace_id"],
+                    parent_span=req.get("parent_span", ""))
             return None
         return RequestContext.from_deadline_ms(
             ms, trace_id=req.get("trace_id", ""),
-            skew_s=PROPAGATION_SKEW_S)
+            skew_s=PROPAGATION_SKEW_S,
+            parent_span=req.get("parent_span", ""))
 
     def _run_task(self, req: dict, read_ts: int):
         """Dispatch one federated task kind against the local tablet.
@@ -1453,6 +1506,7 @@ class ZeroServer(RaftServer):
                  storage=None, **kw):
         from dgraph_tpu.cluster.zero import ZeroState
         self.state = ZeroState()
+        self.node_name = f"zero-n{node_id}"
         super().__init__(node_id, raft_peers, client_addr,
                          storage=storage, **kw)
         # leader-only tablet-move driver: executes the ledger's moves

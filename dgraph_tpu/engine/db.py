@@ -97,6 +97,19 @@ class Latency:
                 "encoding_ns": self.encoding_ns,
                 "assign_timestamp_ns": self.assign_ts_ns}
 
+    def total_ns(self) -> int:
+        return (self.parsing_ns + self.processing_ns
+                + self.encoding_ns + self.assign_ts_ns)
+
+    def server_latency(self):
+        """Dgraph v1.1 `extensions.server_latency` response schema
+        (ref protos/api Latency as serialized by edgraph/server.go:717:
+        parsing/processing/encoding plus the total)."""
+        return {"parsing_ns": self.parsing_ns,
+                "processing_ns": self.processing_ns,
+                "encoding_ns": self.encoding_ns,
+                "total_ns": self.total_ns()}
+
 
 class GraphDB:
     def __init__(self, wal_path: str | None = None,
@@ -228,12 +241,33 @@ class GraphDB:
         return Txn(start_ts=st.start_ts, _state=st)
 
     def mutate(self, txn: Optional[Txn] = None, *,
-               set_nquads: str = "", del_nquads: str = "",
-               set_json: Any = None, delete_json: Any = None,
-               query: str = "", variables: dict | None = None,
-               mutations: Optional[list[Mutation]] = None,
-               cond: str = "",
-               commit_now: bool = False, ctx=None) -> dict:
+               ctx=None, **kw) -> dict:
+        """See _mutate_inner; this wrapper binds the request trace,
+        records the `mutate` span, and returns the Dgraph-compatible
+        `extensions.server_latency` on every mutation response (for a
+        staged-only mutation the whole stage counts as processing)."""
+        from dgraph_tpu.utils import reqlog
+        from dgraph_tpu.utils.tracing import bind_request
+
+        t_start = time.perf_counter_ns()
+        with bind_request(ctx), _span("mutate"):
+            out = self._mutate_inner(txn, ctx=ctx, **kw)
+        total = time.perf_counter_ns() - t_start
+        sl = {"parsing_ns": 0, "processing_ns": total,
+              "encoding_ns": 0, "total_ns": total}
+        out.setdefault("extensions", {})["server_latency"] = sl
+        reqlog.record("mutate",
+                      trace_id=ctx.trace_id if ctx is not None else "",
+                      latency_ms=total / 1e6, breakdown=sl)
+        return out
+
+    def _mutate_inner(self, txn: Optional[Txn] = None, *,
+                      set_nquads: str = "", del_nquads: str = "",
+                      set_json: Any = None, delete_json: Any = None,
+                      query: str = "", variables: dict | None = None,
+                      mutations: Optional[list[Mutation]] = None,
+                      cond: str = "",
+                      commit_now: bool = False, ctx=None) -> dict:
         """Stage (and optionally commit) a mutation — optionally an upsert
         block: `query` runs first at the txn's startTs and its uid/value
         variables substitute into uid(v)/val(v) references in the
@@ -765,24 +799,29 @@ class GraphDB:
         timestamp (a zero-global ts for cross-group reads); otherwise
         best_effort reads at max_assigned and strict reads allocate.
         `ctx` (utils/reqctx.RequestContext) carries the request's
-        deadline/cancellation into the executor."""
-        ex, done, lat, read_ts = self._query_run(
-            q, variables, txn, best_effort, read_ts, ctx)
-        try:
-            with _span("encode") as sp:
-                t0 = time.perf_counter_ns()
-                data = ex.emit(done)
-                if ex.parsed is not None \
-                        and ex.parsed.schema_request is not None:
-                    data["schema"] = self._schema_rows(
-                        ex.parsed.schema_request)
-                lat.encoding_ns = time.perf_counter_ns() - t0
-                sp["encode_us"] = lat.encoding_ns // 1000
-        finally:
-            self.coordinator.unpin_read(read_ts)
-        self._query_metrics(lat)
+        deadline/cancellation into the executor AND its trace ids:
+        spans opened anywhere below join the request's trace."""
+        from dgraph_tpu.utils.tracing import bind_request
+
+        with bind_request(ctx), _span("query") as sp:
+            ex, done, lat, read_ts = self._query_run(
+                q, variables, txn, best_effort, read_ts, ctx, sp)
+            try:
+                with _span("encode") as esp:
+                    t0 = time.perf_counter_ns()
+                    data = ex.emit(done)
+                    if ex.parsed is not None \
+                            and ex.parsed.schema_request is not None:
+                        data["schema"] = self._schema_rows(
+                            ex.parsed.schema_request)
+                    lat.encoding_ns = time.perf_counter_ns() - t0
+                    esp["encode_us"] = lat.encoding_ns // 1000
+            finally:
+                self.coordinator.unpin_read(read_ts)
+        self._query_metrics(lat, ctx)
         return {"data": data,
                 "extensions": {"latency": lat.as_dict(),
+                               "server_latency": lat.server_latency(),
                                "txn": {"start_ts": read_ts}}}
 
     def _schema_rows(self, req: dict) -> list[dict]:
@@ -819,35 +858,38 @@ class GraphDB:
         return rows
 
     def _query_run(self, q, variables, txn, best_effort, read_ts,
-                   ctx=None):
+                   ctx=None, sp=None):
         """Shared query front half: parse, read-ts resolution,
         execution — everything up to (but excluding) emission, which
-        query() and query_json() do differently."""
+        query() and query_json() do differently. `sp` is the
+        enclosing "query" span's attr dict (phase timings land there
+        so the trace view shows the breakdown inline)."""
         from dgraph_tpu.query.executor import Executor
 
         lat = Latency()
-        with _span("query") as sp:
+        with _span("parse"):
             t0 = time.perf_counter_ns()
             parsed = gql_parse(q, variables)
             lat.parsing_ns = time.perf_counter_ns() - t0
-            if ctx is not None:
-                ctx.check("parse")
+        if ctx is not None:
+            ctx.check("parse")
 
-            t0 = time.perf_counter_ns()
-            if read_ts is not None:
-                pass  # pinned snapshot
-            elif txn is not None:
-                read_ts = txn.start_ts
-            elif best_effort:
-                read_ts = self.coordinator.max_assigned()
-            else:
-                read_ts = self.coordinator.next_ts()
-            lat.assign_ts_ns = time.perf_counter_ns() - t0
+        t0 = time.perf_counter_ns()
+        if read_ts is not None:
+            pass  # pinned snapshot
+        elif txn is not None:
+            read_ts = txn.start_ts
+        elif best_effort:
+            read_ts = self.coordinator.max_assigned()
+        else:
+            read_ts = self.coordinator.next_ts()
+        lat.assign_ts_ns = time.perf_counter_ns() - t0
 
-            # hold the rollup watermark for the query's duration
-            # (execution AND emission — both read tablets at read_ts);
-            # callers unpin in their finally blocks
-            self.coordinator.pin_read(read_ts)
+        # hold the rollup watermark for the query's duration
+        # (execution AND emission — both read tablets at read_ts);
+        # callers unpin in their finally blocks
+        self.coordinator.pin_read(read_ts)
+        with _span("execute"):
             t0 = time.perf_counter_ns()
             try:
                 ex = Executor(self, read_ts, ctx=ctx)
@@ -856,17 +898,24 @@ class GraphDB:
                 self.coordinator.unpin_read(read_ts)
                 raise
             lat.processing_ns = time.perf_counter_ns() - t0
+        if sp is not None:
             sp["read_ts"] = read_ts
             sp["blocks"] = len(parsed.queries)
             sp["parse_us"] = lat.parsing_ns // 1000
             sp["process_us"] = lat.processing_ns // 1000
         return ex, done, lat, read_ts
 
-    def _query_metrics(self, lat: Latency):
+    def _query_metrics(self, lat: Latency, ctx=None):
+        from dgraph_tpu.utils import reqlog
+
         metrics.inc_counter("dgraph_num_queries_total")
         metrics.observe("dgraph_query_latency_ms",
                         (lat.parsing_ns + lat.processing_ns
                          + lat.encoding_ns) / 1e6)
+        sl = lat.server_latency()
+        reqlog.record("query",
+                      trace_id=ctx.trace_id if ctx is not None else "",
+                      latency_ms=sl["total_ns"] / 1e6, breakdown=sl)
 
     def query_json(self, q: str, variables: dict | None = None,
                    txn: Optional[Txn] = None, best_effort: bool = True,
@@ -880,27 +929,31 @@ class GraphDB:
         users who want Python objects keep query()."""
         import json as _json
 
-        ex, done, lat, read_ts = self._query_run(
-            q, variables, txn, best_effort, read_ts, ctx)
-        try:
-            with _span("encode") as sp:
-                t0 = time.perf_counter_ns()
-                data_json = ex.emit_json(done)
-                if ex.parsed is not None \
-                        and ex.parsed.schema_request is not None:
-                    rows = _json.dumps(
-                        self._schema_rows(ex.parsed.schema_request),
-                        separators=(",", ":"))
-                    data_json = ('{"schema":' + rows + "}"
-                                 if data_json == "{}" else
-                                 data_json[:-1] + ',"schema":'
-                                 + rows + "}")
-                lat.encoding_ns = time.perf_counter_ns() - t0
-                sp["encode_us"] = lat.encoding_ns // 1000
-        finally:
-            self.coordinator.unpin_read(read_ts)
-        self._query_metrics(lat)
+        from dgraph_tpu.utils.tracing import bind_request
+
+        with bind_request(ctx), _span("query") as sp:
+            ex, done, lat, read_ts = self._query_run(
+                q, variables, txn, best_effort, read_ts, ctx, sp)
+            try:
+                with _span("encode") as esp:
+                    t0 = time.perf_counter_ns()
+                    data_json = ex.emit_json(done)
+                    if ex.parsed is not None \
+                            and ex.parsed.schema_request is not None:
+                        rows = _json.dumps(
+                            self._schema_rows(ex.parsed.schema_request),
+                            separators=(",", ":"))
+                        data_json = ('{"schema":' + rows + "}"
+                                     if data_json == "{}" else
+                                     data_json[:-1] + ',"schema":'
+                                     + rows + "}")
+                    lat.encoding_ns = time.perf_counter_ns() - t0
+                    esp["encode_us"] = lat.encoding_ns // 1000
+            finally:
+                self.coordinator.unpin_read(read_ts)
+        self._query_metrics(lat, ctx)
         ext = _json.dumps({"latency": lat.as_dict(),
+                           "server_latency": lat.server_latency(),
                            "txn": {"start_ts": read_ts}})
         return '{"data":' + data_json + ',"extensions":' + ext + "}"
 

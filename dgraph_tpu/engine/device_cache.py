@@ -26,6 +26,7 @@ from dgraph_tpu.ops.graph import (
 )
 from dgraph_tpu.engine.tile_cache import DeviceCacheLRU  # noqa: F401
 from dgraph_tpu.ops.uidvec import SENTINEL, pad_to, to_numpy
+from dgraph_tpu.utils.tracing import span as _span
 
 _MAX_U32 = 0xFFFFFFFE  # SENTINEL reserved
 
@@ -50,7 +51,9 @@ def device_adjacency(db, tab, read_ts: int,
     edges32 = _edges32(tab.edges)
     if edges32 is None:
         return None
-    adj = build_adjacency(edges32)
+    with _span("device.tile_load", pred=tab.pred, kind="adj",
+               edges=n_edges):
+        adj = build_adjacency(edges32)
     tab._device_adj = adj
     tab._device_adj_ts = tab.base_ts
     db.device_cache.put(tab, "_device_adj", adj)
@@ -131,7 +134,9 @@ def device_radjacency(db, tab, read_ts: int,
     edges32 = _edges32(tab.reverse)
     if edges32 is None:
         return None
-    adj = build_adjacency(edges32)
+    with _span("device.tile_load", pred=tab.pred, kind="radj",
+               edges=n_edges):
+        adj = build_adjacency(edges32)
     tab._device_radj = adj
     tab._device_radj_ts = tab.base_ts
     db.device_cache.put(tab, "_device_radj", adj)
@@ -157,7 +162,9 @@ def device_bitadjacency(db, tab, read_ts: int, transpose: bool = False):
     if edges32 is None:
         return None
     from dgraph_tpu.ops.bitgraph import build_bitadjacency
-    badj = build_bitadjacency(edges32)
+    with _span("device.tile_load", pred=tab.pred, kind="bitadj",
+               edges=n_edges):
+        badj = build_bitadjacency(edges32)
     setattr(tab, attr, badj)
     setattr(tab, attr + "_ts", tab.base_ts)
     db.device_cache.put(tab, attr, badj)
@@ -201,8 +208,10 @@ def device_sharded_adjacency(db, tab, read_ts: int,
     if edges32 is None:
         return None
     from dgraph_tpu.parallel.dist_graph import build_sharded_adjacency
-    sadj = build_sharded_adjacency(
-        edges32, n_shards=mesh.shape["uid"]).put(mesh)
+    with _span("device.tile_load", pred=tab.pred, kind="sharded",
+               edges=n_edges):
+        sadj = build_sharded_adjacency(
+            edges32, n_shards=mesh.shape["uid"]).put(mesh)
     setattr(tab, attr, sadj)
     setattr(tab, attr + "_ts", tab.base_ts)
     db.device_cache.put(tab, attr, sadj)
@@ -238,7 +247,9 @@ def device_values(db, tab, read_ts: int, lang: str = ""):
         return None
     if pairs and max(pairs) > _MAX_U32:
         return None
-    dv = build_values(pairs)
+    with _span("device.tile_load", pred=tab.pred, kind="values",
+               rows=len(pairs)):
+        dv = build_values(pairs)
     setattr(tab, attr, dv)
     setattr(tab, attr + "_ts", tab.base_ts)
     db.device_cache.put(tab, attr, dv)

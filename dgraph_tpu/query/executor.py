@@ -853,6 +853,11 @@ class Executor:
         raise GQLError(f"function {name!r} not supported")
 
     def _eval_similar_to(self, fn: Function, candidates) -> np.ndarray:
+        with _span("similar_to", pred=fn.attr):
+            return self._eval_similar_to_inner(fn, candidates)
+
+    def _eval_similar_to_inner(self, fn: Function,
+                               candidates) -> np.ndarray:
         """similar_to(embedding, k, $vec[, metric]): the k uids whose
         stored float32vector scores closest to the query vector
         (forward-port of modern Dgraph's similar_to onto the v1.1.x
@@ -1112,6 +1117,12 @@ class Executor:
                         candidates, lang: str = "") -> np.ndarray:
         if tab is None:
             return _EMPTY
+        with _span("eq", pred=tab.pred):
+            return self._eval_eq_tokens_inner(tab, vals, candidates,
+                                              lang)
+
+    def _eval_eq_tokens_inner(self, tab: Tablet, vals: list[Val],
+                              candidates, lang: str = "") -> np.ndarray:
         out = _EMPTY
         # pick a non-lossy tokenizer if indexed (ref worker/task.go
         # pickTokenizer); else scan candidates' values
@@ -1287,6 +1298,10 @@ class Executor:
         return t
 
     def _eval_ineq(self, fn: Function, candidates) -> np.ndarray:
+        with _span("ineq", fn=fn.name, pred=fn.attr):
+            return self._eval_ineq_inner(fn, candidates)
+
+    def _eval_ineq_inner(self, fn: Function, candidates) -> np.ndarray:
         tab = self._tablet(fn.attr)
         ips = tab.schema if tab is not None \
             else self.db.schema.get(fn.attr)
@@ -1515,6 +1530,10 @@ class Executor:
         return tab.sort_key_pairs()
 
     def _eval_terms(self, fn: Function, candidates) -> np.ndarray:
+        with _span("setops", fn=fn.name, pred=fn.attr):
+            return self._eval_terms_inner(fn, candidates)
+
+    def _eval_terms_inner(self, fn: Function, candidates) -> np.ndarray:
         tab = self._tablet(fn.attr)
         toker = "fulltext" if fn.name in ("anyoftext", "alloftext") else "term"
         ps = tab.schema if tab is not None \
@@ -1552,6 +1571,10 @@ class Executor:
         return out if candidates is None else _intersect(candidates, out)
 
     def _eval_anyof(self, fn: Function, candidates) -> np.ndarray:
+        with _span("setops", fn=fn.name, pred=fn.attr):
+            return self._eval_anyof_inner(fn, candidates)
+
+    def _eval_anyof_inner(self, fn: Function, candidates) -> np.ndarray:
         """anyof/allof(pred, tokenizer, v...): generic token match with
         an explicitly named (usually custom plugin) tokenizer — the
         custom-tokenizer query surface (ref worker/task.go:260 anyof/
@@ -1752,6 +1775,11 @@ class Executor:
 
     def _match_batch(self, tab, scan, want: str,
                      maxd: int) -> Optional[np.ndarray]:
+        with _span("match", pred=tab.pred, n=len(scan)):
+            return self._match_batch_inner(tab, scan, want, maxd)
+
+    def _match_batch_inner(self, tab, scan, want: str,
+                           maxd: int) -> Optional[np.ndarray]:
         """Verify all candidates in ONE native call over the columnar
         string view (C loop + banded Levenshtein) instead of a per-uid
         get_postings round — 21M-regime q015 spends ~45s in the Python
@@ -2102,8 +2130,15 @@ class Executor:
     # traversal (ref query.go:1902 ProcessGraph)
     # ------------------------------------------------------------------
 
-    def _expand_children(self, parent: ExecNode, children: list[GraphQuery],
-                         src: np.ndarray):
+    def _expand_children(self, parent: ExecNode,
+                         children: list[GraphQuery], src: np.ndarray):
+        with _span("expand", level=parent.gq.alias or parent.gq.attr,
+                   n=len(src)):
+            self._expand_children_inner(parent, children, src)
+
+    def _expand_children_inner(self, parent: ExecNode,
+                               children: list[GraphQuery],
+                               src: np.ndarray):
         # one traversal level (incl. @cascade recursion into subtrees)
         self._checkpoint(f"level {parent.gq.alias or parent.gq.attr}")
         children = self._expand_expand(children, src)
@@ -2994,6 +3029,10 @@ class Executor:
         return uids
 
     def _apply_order(self, orders, uids: np.ndarray) -> np.ndarray:
+        with _span("sort", n=len(uids), keys=len(orders)):
+            return self._apply_order_inner(orders, uids)
+
+    def _apply_order_inner(self, orders, uids: np.ndarray) -> np.ndarray:
         """Multi-key value sort; stable, missing-value uids last
         (ref types/sort.go:118 + worker/sort.go)."""
         # device_min_edges <= 1 is the explicit force-device override

@@ -58,13 +58,30 @@ def _abort_for(context, e):
 
 
 def _ctx_of(context) -> Optional[RequestContext]:
-    """RequestContext from the gRPC deadline: time_remaining() carries
-    the client's timeout field through every hop (the reference's
-    context.Context); None when the client set no deadline."""
-    tr = context.time_remaining() if context is not None else None
-    if tr is None:
+    """RequestContext from the gRPC deadline + trace metadata:
+    time_remaining() carries the client's timeout field through every
+    hop (the reference's context.Context), and a W3C `traceparent`
+    metadata entry (or x-dgraph-trace-id) joins this request's spans
+    — on every node it touches — to the caller's trace; None when the
+    client sent neither."""
+    from dgraph_tpu.utils import tracing
+
+    if context is None:
         return None
-    return RequestContext.with_timeout(tr)
+    tr = context.time_remaining()
+    tid = parent = ""
+    md = dict(context.invocation_metadata() or ())
+    got = tracing.parse_traceparent(md.get("traceparent", ""))
+    if got is not None:
+        tid, parent = got
+    tid = md.get("x-dgraph-trace-id", "") or tid
+    if tr is None:
+        if tid:
+            return RequestContext.background(trace_id=tid,
+                                             parent_span=parent)
+        return None
+    return RequestContext.with_timeout(tr, trace_id=tid,
+                                       parent_span=parent)
 
 
 def _wrap(fn):

@@ -48,7 +48,7 @@ from urllib.parse import parse_qs, urlparse
 from dgraph_tpu.cluster.coordinator import TxnAborted
 from dgraph_tpu.engine.db import GraphDB, Mutation, Txn
 from dgraph_tpu.server.acl import AclError
-from dgraph_tpu.utils import metrics
+from dgraph_tpu.utils import metrics, reqlog, tracing
 from dgraph_tpu.utils.logger import log
 from dgraph_tpu.utils.reqctx import (
     Cancelled, DeadlineExceeded, Overloaded, RequestContext,
@@ -194,6 +194,29 @@ class AlphaServer:
                         if not live:
                             del self._live_ctx[ctx.trace_id]
 
+    @contextmanager
+    def _logged(self, op: str, ctx: Optional[RequestContext]):
+        """Feed the /debug/requests ring: the ENGINE records
+        successful query/mutate completions (it owns the per-phase
+        breakdown), so this edge wrapper records successes only for
+        ops the engine never sees (commit/alter) — and EVERY failure,
+        with its outcome: a shed request (429) dies right here in
+        admission and would otherwise be invisible."""
+        t0 = time.perf_counter()
+        tid = ctx.trace_id if ctx is not None else ""
+        try:
+            yield
+        except Exception as e:
+            reqlog.record(op, trace_id=tid,
+                          latency_ms=(time.perf_counter() - t0) * 1e3,
+                          outcome=reqlog.outcome_of(e))
+            raise
+        else:
+            if op in ("commit", "alter"):
+                reqlog.record(
+                    op, trace_id=tid,
+                    latency_ms=(time.perf_counter() - t0) * 1e3)
+
     def pending(self) -> int:
         with self._admission:
             return self._inflight
@@ -267,7 +290,7 @@ class AlphaServer:
 
     def handle_query(self, body: dict | str, params: dict,
                      token: str = "", ctx=None) -> dict:
-        with self._admit(ctx):
+        with self._logged("query", ctx), self._admit(ctx):
             q, variables, ro_txn, be, pin_ts = self._query_prologue(
                 body, params, token)
             with self.rw.read:
@@ -282,7 +305,7 @@ class AlphaServer:
         the HTTP layer never re-serializes what the engine already
         encoded (ref query/outputnode.go fastJsonNode feeding the
         response writer directly)."""
-        with self._admit(ctx):
+        with self._logged("query", ctx), self._admit(ctx):
             q, variables, ro_txn, be, pin_ts = self._query_prologue(
                 body, params, token)
             with self.rw.read:
@@ -298,7 +321,7 @@ class AlphaServer:
                 "rejected")
         if self.mutations_mode == "disallow":
             raise ValueError("no mutations allowed")
-        with self._admit(ctx):
+        with self._logged("mutate", ctx), self._admit(ctx):
             return self._mutate_admitted(body, content_type, params,
                                          token, ctx)
 
@@ -394,7 +417,8 @@ class AlphaServer:
                       ctx=None) -> dict:
         start_ts = int(params.get("startTs", 0))
         abort = params.get("abort", "false") == "true"
-        with self._admit(ctx), self.rw.write:
+        with self._logged("commit", ctx), self._admit(ctx), \
+                self.rw.write:
             with self.meta:
                 if self.acl is not None:
                     self._check_txn_owner(start_ts,
@@ -444,7 +468,8 @@ class AlphaServer:
             with self.meta:
                 self.acl.authorize_alter(token, preds,
                                          drop=drop_all or bool(drop_attr))
-        with self._admit(ctx), self.rw.write:
+        with self._logged("alter", ctx), self._admit(ctx), \
+                self.rw.write:
             self.db.alter(schema_text=schema, drop_all=drop_all,
                           drop_attr=drop_attr, ctx=ctx)
         return {"code": "Success", "message": "Done"}
@@ -456,14 +481,28 @@ class AlphaServer:
         with self.rw.read:
             return self.db.state()
 
-    def handle_traces(self, token: str = "") -> dict:
-        """Recent spans as a Chrome trace (load in chrome://tracing).
+    def handle_traces(self, token: str = "",
+                      params: Optional[dict] = None) -> dict:
+        """Recent spans as a Chrome trace (load in chrome://tracing /
+        Perfetto). `?trace_id=` narrows to one trace's node-local
+        slice — collect the same id from every node and stitch with
+        tools/trace_merge.py for the cluster-wide timeline.
         ACL-gated like /state: span args carry query shapes."""
         if self.acl is not None:
             with self.meta:
                 self.acl.authorize(token)
         from dgraph_tpu.utils.tracing import export_chrome_trace
-        return {"traceEvents": export_chrome_trace()}
+        tid = (params or {}).get("trace_id") or None
+        return {"traceEvents": export_chrome_trace(trace_id=tid)}
+
+    def handle_requests(self, token: str = "") -> dict:
+        """/debug/requests: the bounded recent + slowest request log
+        (trace_id, latency breakdown, shed/abort outcome). ACL-gated
+        like /state."""
+        if self.acl is not None:
+            with self.meta:
+                self.acl.authorize(token)
+        return reqlog.snapshot()
 
     def handle_assign(self, params: dict, token: str = "") -> dict:
         """Lease a uid block (ref zero.go /assign?what=uids): clients
@@ -700,6 +739,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        ctx = getattr(self, "_trace_ctx", None)
+        if ctx is not None:
+            # traceparent OUT: the caller (or its collector) learns
+            # which trace id to pull from /debug/traces on every node
+            self.send_header("X-Dgraph-Trace-Id", ctx.trace_id)
+            self.send_header("traceparent", tracing.format_traceparent(
+                ctx.trace_id, ctx.parent_span))
         self.end_headers()
         self.wfile.write(data)
 
@@ -718,26 +764,38 @@ class _Handler(BaseHTTPRequestHandler):
     def _ctx(self) -> Optional[RequestContext]:
         """RequestContext from the request headers: the remaining
         budget in X-Dgraph-Deadline-Ms (the HTTP analogue of the gRPC
-        timeout field) and an optional caller-chosen X-Dgraph-Trace-Id
+        timeout field), a W3C `traceparent` (trace id + the caller's
+        span id — this request's spans, on every node it touches,
+        join that trace), and/or a caller-chosen X-Dgraph-Trace-Id
         (echoed in errors; the /admin/cancel handle). No headers, no
         context — zero overhead for plain requests."""
         dl = self.headers.get("X-Dgraph-Deadline-Ms", "")
         tid = self.headers.get("X-Dgraph-Trace-Id", "")
+        parent = ""
+        got = tracing.parse_traceparent(
+            self.headers.get("traceparent", ""))
+        if got is not None:
+            tid = tid or got[0]
+            parent = got[1]
         if dl:
             try:
-                return RequestContext.from_deadline_ms(int(dl),
-                                                       trace_id=tid)
+                return RequestContext.from_deadline_ms(
+                    int(dl), trace_id=tid, parent_span=parent)
             except ValueError:
                 raise ValueError(
                     f"X-Dgraph-Deadline-Ms must be an integer ms "
                     f"budget, got {dl!r}") from None
         if tid:
-            return RequestContext.background(trace_id=tid)
+            return RequestContext.background(trace_id=tid,
+                                             parent_span=parent)
         return None
 
     def do_GET(self):
-        path = urlparse(self.path).path
+        u = urlparse(self.path)
+        path = u.path
+        params = {k: v[-1] for k, v in parse_qs(u.query).items()}
         token = self.headers.get("X-Dgraph-AccessToken", "")
+        self._trace_ctx = None  # keep-alive: don't echo a stale trace
         try:
             if path == "/health":
                 self._send(200, self.alpha.handle_health())
@@ -747,7 +805,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200,
                            {"data": self.alpha.handle_get_schema(token)})
             elif path == "/debug/traces":
-                self._send(200, self.alpha.handle_traces(token))
+                self._send(200, self.alpha.handle_traces(token, params))
+            elif path == "/debug/requests":
+                self._send(200, self.alpha.handle_requests(token))
             elif path == "/debug/prometheus_metrics":
                 from dgraph_tpu.utils.metrics import render_prometheus
 
@@ -780,16 +840,39 @@ class _Handler(BaseHTTPRequestHandler):
         params = {k: v[-1] for k, v in parse_qs(u.query).items()}
         ctype = self.headers.get("Content-Type", "")
         token = self.headers.get("X-Dgraph-AccessToken", "")
+        # reset BEFORE _ctx() can raise: a malformed deadline header's
+        # 400 must not echo a previous request's trace on a reused
+        # connection
+        self._trace_ctx = None
         try:
             ctx = self._ctx()
+            self._trace_ctx = ctx
             body = self._body()
             if path == "/query":
                 if "json" in ctype:
                     payload: Any = json.loads(body.decode())
                 else:
                     payload = body.decode()
-                self._send_raw(200, self.alpha.handle_query_json(
-                    payload, params, token, ctx=ctx).encode())
+                debug = params.get("debug", "false") == "true" \
+                    or self.headers.get("X-Dgraph-Debug", ""
+                                        ).lower() not in ("", "false",
+                                                          "0")
+                if debug:
+                    # per-request tier-routing profile: a metrics
+                    # counter diff around the (dict-path) query shows
+                    # where it routed — columnar hits, device ops,
+                    # postings fallbacks, cache evictions. Counters
+                    # are process-global, so concurrent traffic
+                    # bleeds in; use on a quiet node or repeat.
+                    before = metrics.counters_snapshot()
+                    out = self.alpha.handle_query(payload, params,
+                                                  token, ctx=ctx)
+                    out.setdefault("extensions", {})["profile"] = {
+                        "counters": metrics.counters_delta(before)}
+                    self._send(200, out)
+                else:
+                    self._send_raw(200, self.alpha.handle_query_json(
+                        payload, params, token, ctx=ctx).encode())
             elif path == "/mutate":
                 self._send(200, self.alpha.handle_mutate(
                     body, ctype, params, token, ctx=ctx))

@@ -916,20 +916,27 @@ class Tablet:
 
     def rollup(self, watermark: int):
         """Fold deltas with ts <= watermark into base state."""
-        keep: list[tuple[int, list[EdgeOp]]] = []
-        folded = False
-        for ts, ops in self.deltas:
-            if ts > watermark:
-                keep.append((ts, ops))
-                continue
-            folded = True
-            for op in ops:
-                self._fold(op)
-            self.base_ts = max(self.base_ts, ts)
-        self.deltas = keep
-        if folded:
-            self._device_adj_ts = -1  # invalidate device snapshot
-            self._ov_drop()           # overlay index keys shifted
+        if not self.deltas:
+            return  # nothing to fold — skip the (traced) fold path
+        from dgraph_tpu.utils.tracing import span as _span
+
+        with _span("tablet.rollup", pred=self.pred,
+                   deltas=len(self.deltas)) as sp:
+            keep: list[tuple[int, list[EdgeOp]]] = []
+            folded = False
+            for ts, ops in self.deltas:
+                if ts > watermark:
+                    keep.append((ts, ops))
+                    continue
+                folded = True
+                for op in ops:
+                    self._fold(op)
+                self.base_ts = max(self.base_ts, ts)
+            self.deltas = keep
+            sp["folded"] = folded
+            if folded:
+                self._device_adj_ts = -1  # invalidate device snapshot
+                self._ov_drop()           # overlay index keys shifted
 
     def _fold(self, op: EdgeOp):
         src = op.src

@@ -136,8 +136,12 @@ class Wal:
 
     def append(self, record: Any):
         from dgraph_tpu.storage.enc import encrypt_blob
+        from dgraph_tpu.utils.tracing import span as _span
         from dgraph_tpu.wire import dumps
-        self._w.append(encrypt_blob(dumps(record), self.key))
+        with _span("wal.append") as sp:
+            blob = encrypt_blob(dumps(record), self.key)
+            sp["bytes"] = len(blob)
+            self._w.append(blob)
 
     def replay(self) -> Iterator[Any]:
         from dgraph_tpu.storage.enc import decrypt_blob

@@ -114,12 +114,40 @@ def snapshot() -> dict:
         }
 
 
+def _escape_label(v) -> str:
+    """Prometheus text-format 0.0.4 label-value escaping: backslash,
+    double-quote and newline must be escaped or the emitted series is
+    malformed (a bare quote in a value ends the label early)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_key(k: tuple[str, tuple]) -> str:
     name, labels = k
     if not labels:
         return name
-    inner = ",".join(f'{lk}="{lv}"' for lk, lv in labels)
+    inner = ",".join(f'{lk}="{_escape_label(lv)}"' for lk, lv in labels)
     return f"{name}{{{inner}}}"
+
+
+def counters_snapshot() -> dict[str, float]:
+    """Counter state keyed by formatted series name — the 'before'
+    half of a per-request profile diff (server/http.py debug=true)."""
+    with _LOCK:
+        return {_fmt_key(k): v for k, v in _COUNTERS.items()}
+
+
+def counters_delta(before: dict[str, float]) -> dict[str, float]:
+    """Non-zero counter movement since `before` (a counters_snapshot):
+    the per-request tier-routing profile — columnar hits, device ops,
+    postings fallbacks, cache evictions — as a metrics diff instead of
+    bespoke plumbing through the executor."""
+    out: dict[str, float] = {}
+    for k, v in counters_snapshot().items():
+        d = v - before.get(k, 0)
+        if d:
+            out[k] = d
+    return out
 
 
 def collect_memory_gauges():

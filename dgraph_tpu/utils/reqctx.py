@@ -62,38 +62,49 @@ class RequestContext:
     thread.
     """
 
-    __slots__ = ("deadline", "trace_id", "_cancel")
+    __slots__ = ("deadline", "trace_id", "parent_span", "_cancel")
 
     def __init__(self, deadline: Optional[float] = None,
-                 trace_id: str = ""):
+                 trace_id: str = "", parent_span: str = ""):
         self.deadline = deadline  # absolute time.monotonic(), or None
         self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        # span id of the CALLER's span on the other side of the wire
+        # (W3C traceparent parent-id / the `parent_span` RPC field):
+        # the serving edge binds it so this node's spans link into the
+        # originating trace (utils/tracing.bind_request)
+        self.parent_span = parent_span or ""
         self._cancel = threading.Event()
 
     # -------------------------------------------------- constructors
 
     @classmethod
     def with_timeout(cls, seconds: Optional[float],
-                     trace_id: str = "") -> "RequestContext":
+                     trace_id: str = "",
+                     parent_span: str = "") -> "RequestContext":
         """Context expiring `seconds` from now (None = no deadline)."""
         dl = None if seconds is None else time.monotonic() + max(
             0.0, float(seconds))
-        return cls(deadline=dl, trace_id=trace_id)
+        return cls(deadline=dl, trace_id=trace_id,
+                   parent_span=parent_span)
 
     @classmethod
     def from_deadline_ms(cls, ms, trace_id: str = "",
-                         skew_s: float = 0.0) -> "RequestContext":
+                         skew_s: float = 0.0,
+                         parent_span: str = "") -> "RequestContext":
         """Context from a wire-propagated remaining budget in ms (the
         `deadline_ms` RPC field / `X-Dgraph-Deadline-Ms` header).
         `skew_s` widens the budget for workers inheriting it over the
         network (PROPAGATION_SKEW_S)."""
         return cls.with_timeout(int(ms) / 1000.0 + skew_s,
-                                trace_id=trace_id)
+                                trace_id=trace_id,
+                                parent_span=parent_span)
 
     @classmethod
-    def background(cls, trace_id: str = "") -> "RequestContext":
+    def background(cls, trace_id: str = "",
+                   parent_span: str = "") -> "RequestContext":
         """No deadline, cancellable — internal/maintenance work."""
-        return cls(deadline=None, trace_id=trace_id)
+        return cls(deadline=None, trace_id=trace_id,
+                   parent_span=parent_span)
 
     # ------------------------------------------------------- queries
 

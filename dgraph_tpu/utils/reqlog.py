@@ -1,0 +1,88 @@
+"""Bounded slow-query/request log backing /debug/requests.
+
+The reference keeps /debug surfaces for "what has this node been
+doing" (x/metrics.go pprof + expvar). This module is the request-level
+equivalent: two bounded views of completed requests —
+
+  recent   the last _RECENT_MAX requests in arrival order
+  slowest  the _SLOW_MAX highest-latency requests seen since reset
+
+Each entry carries the op, trace_id (the handle into /debug/traces and
+the merged Perfetto view), total latency, the per-phase breakdown when
+the engine measured one (extensions.server_latency), and the outcome —
+"ok", or how the request died ("shed", "deadline", "cancelled",
+"aborted", "error") so overload/abort behavior is inspectable after
+the fact.
+
+The engine records successful query/mutate completions (it owns the
+phase breakdown); the serving edges record every failure outcome
+(sheds never reach the engine). Recording is a deque append + a
+bounded heap push under one lock — cheap enough for every request.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from dgraph_tpu.utils import tracing
+
+_RECENT_MAX = 256
+_SLOW_MAX = 32
+
+_lock = threading.Lock()
+_recent: deque = deque(maxlen=_RECENT_MAX)
+_slow_heap: list[tuple[float, int, dict]] = []  # min-heap of (ms, seq, rec)
+_seq = itertools.count()
+
+
+def record(op: str, trace_id: str = "", latency_ms: float = 0.0,
+           outcome: str = "ok",
+           breakdown: Optional[dict] = None) -> None:
+    rec = {"op": str(op), "trace_id": str(trace_id),
+           "latency_ms": round(float(latency_ms), 3),
+           "outcome": str(outcome), "node": tracing.node(),
+           # wall clock: operators correlate these with external logs
+           "ts": time.time()}  # dglint: disable=DG06
+    if breakdown:
+        rec["breakdown"] = dict(breakdown)
+    with _lock:
+        _recent.append(rec)
+        heapq.heappush(_slow_heap,
+                       (rec["latency_ms"], next(_seq), rec))
+        if len(_slow_heap) > _SLOW_MAX:
+            heapq.heappop(_slow_heap)  # drop the fastest
+
+
+def outcome_of(exc: BaseException) -> str:
+    """Classify a request-killing exception for the log (the serving
+    edges share this so HTTP and gRPC report identical outcomes)."""
+    from dgraph_tpu.utils.reqctx import (
+        Cancelled, DeadlineExceeded, Overloaded,
+    )
+    if isinstance(exc, Overloaded):
+        return "shed"
+    if isinstance(exc, DeadlineExceeded):
+        return "deadline"
+    if isinstance(exc, Cancelled):
+        return "cancelled"
+    if type(exc).__name__ == "TxnAborted":
+        return "aborted"
+    return "error"
+
+
+def snapshot() -> dict:
+    with _lock:
+        slow = sorted(_slow_heap, key=lambda t: (-t[0], t[1]))
+        return {"recent": list(_recent),
+                "slowest": [rec for _, _, rec in slow]}
+
+
+def reset() -> None:
+    with _lock:
+        _recent.clear()
+        _slow_heap.clear()

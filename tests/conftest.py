@@ -62,6 +62,23 @@ def pytest_configure(config):
 
 
 @pytest.fixture(autouse=True)
+def _metrics_and_span_leak_guard():
+    """Counters, the span ring and the request log are process-global:
+    a test that asserts on them while inheriting another test's
+    increments is order-dependent and un-bisectable. Reset them AFTER
+    every test (resetting before would hide in-test accumulation the
+    test itself arranged), and restore tracing to its enabled
+    default in case a test toggled it."""
+    yield
+    from dgraph_tpu.utils import metrics, reqlog, tracing
+
+    metrics.reset()
+    tracing.clear()
+    tracing.set_enabled(True)
+    reqlog.reset()
+
+
+@pytest.fixture(autouse=True)
 def _failpoint_leak_guard():
     """A failpoint armed in one test and leaked into the next makes
     failures order-dependent and un-bisectable: fail the leaking test

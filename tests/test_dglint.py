@@ -557,6 +557,52 @@ class TestRegistryDiscipline:
         assert names == ["a.b", "c.d", "a.b"]
         assert dupes == [("a.b", 1)]
 
+    def test_unregistered_span_name(self):
+        src = """
+            from dgraph_tpu.utils.tracing import span as _span
+
+            def f():
+                with _span("qurey"):
+                    pass
+        """
+        assert "DG08" in codes(run_fixture(
+            src, rel="dgraph_tpu/query/_fixture.py",
+            **_registry_proj(span_names=frozenset({"query"}),
+                             span_registry_found=True)))
+
+    def test_registered_span_name_clean(self):
+        src = """
+            from dgraph_tpu.utils import tracing
+
+            def f():
+                with tracing.span("query", blocks=1):
+                    pass
+        """
+        assert "DG08" not in codes(run_fixture(
+            src, rel="dgraph_tpu/query/_fixture.py",
+            **_registry_proj(span_names=frozenset({"query"}),
+                             span_registry_found=True)))
+
+    def test_span_check_skipped_without_registry(self):
+        # fixture projects predating SPAN_NAMES must not flag every
+        # span call (span_registry_found gates the check)
+        src = """
+            from dgraph_tpu.utils.tracing import span
+
+            def f():
+                with span("anything"):
+                    pass
+        """
+        assert "DG08" not in codes(run_fixture(
+            src, rel="dgraph_tpu/query/_fixture.py",
+            **_registry_proj()))
+
+    def test_real_span_registry_parsed(self):
+        proj = build_project(["dgraph_tpu/utils"], REPO_ROOT)
+        assert proj.span_registry_found
+        assert "query" in proj.span_names
+        assert not proj.span_dupes
+
     def test_duplicate_reported_in_home_module(self):
         src = "SITES = ('a.b', 'a.b')\n"
         found = run_fixture(

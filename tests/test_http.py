@@ -133,6 +133,9 @@ def test_health_state_metrics(server):
     assert h["status"] == "healthy"
     st = _get(base, "/state")
     assert "maxAssigned" in st
+    # counters reset between tests (conftest leak guard): generate the
+    # query this test asserts on instead of relying on predecessors
+    _post(base, "/query", "{ q(func: uid(0x1)) { uid } }")
     m = _get(base, "/debug/prometheus_metrics")
     assert "dgraph_num_queries_total" in m
 

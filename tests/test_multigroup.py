@@ -604,3 +604,61 @@ def test_federated_count_facet_batched_rpcs(cluster, monkeypatch):
     # RPC for the whole level, regardless of uid/edge counts
     assert calls.count("counts") == 0, calls
     assert calls.count("facets") == 1, calls
+
+
+def test_federated_query_single_distributed_trace(cluster):
+    """One federated query -> ONE trace_id on every involved node
+    (coordinator + both alpha groups + zero), parent links intact
+    across the wire, and tools/trace_merge.py stitches the per-node
+    slices into one Perfetto-loadable timeline with pid = node."""
+    from dgraph_tpu.utils import tracing
+    from tools.trace_merge import merge_slices
+
+    rc = cluster
+    rc.alter("t1: string @index(exact) .\nt2: string @index(exact) .")
+    # pin ownership explicitly so the block below genuinely spans
+    # groups no matter what earlier tests claimed or moved
+    assert rc.zero.tablet("t1", 1) == 1
+    assert rc.zero.tablet("t2", 2) == 2
+    # a cross-group mutation (2PC through zero) gives one entity both
+    # predicates so the federated filter has something to return
+    rc.mutate(set_nquads='_:a <t1> "x" .\n_:a <t2> "y" .')
+
+    tracing.clear()
+    tid = "deadbeef" * 2
+    with tracing.bind(tid, node="coordinator"):
+        out = rc.query(
+            '{ a(func: has(t1)) @filter(has(t2)) { t1 t2 } }')
+    assert out["extensions"].get("federated")
+    assert out["data"]["a"] == [{"t1": "x", "t2": "y"}]
+    assert out["extensions"]["server_latency"]["total_ns"] > 0
+
+    slices = [("coordinator", tracing.spans_for(tid))]
+    for cl in (rc.groups[1], rc.groups[2], rc.zero):
+        got = cl.request({"op": "traces", "trace": tid})
+        assert got["ok"]
+        node, spans = got["result"]["node"], got["result"]["spans"]
+        assert spans, f"no spans for the trace on {node}"
+        assert all(s["trace_id"] == tid for s in spans), node
+        slices.append((node, spans))
+
+    # parent links: every wire hop's rpc.recv parents to a span id
+    # recorded on SOME node of the same trace (the caller's rpc.send)
+    all_ids = {s["span_id"] for _, sp in slices for s in sp}
+    for node, spans in slices[1:]:
+        recvs = [s for s in spans if s["name"] == "rpc.recv"]
+        assert recvs, f"no rpc.recv spans on {node}"
+        for s in recvs:
+            assert s["parent_id"] in all_ids, (node, s)
+
+    merged = merge_slices(slices, trace_id=tid)
+    import json as _json
+    _json.dumps(merged)  # Perfetto-loadable as-is
+    names = {e["name"] for e in merged if e["ph"] == "X"}
+    # parse + execute on the coordinator, transport spans on both
+    # sides of the wire, raft apply (the tasks' read barriers) on the
+    # serving groups
+    assert {"parse", "execute", "rpc.send", "rpc.recv",
+            "raft.apply"} <= names, names
+    lanes = {e["args"]["name"] for e in merged if e["ph"] == "M"}
+    assert "coordinator" in lanes and len(lanes) >= 4, lanes

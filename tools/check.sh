@@ -27,4 +27,9 @@ echo "== import-warnings sweep =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -W error::DeprecationWarning -c "import dgraph_tpu"
 
+echo "== span overhead =="
+# per-span tracing cost vs the 5 µs budget (spans sit on executor hot
+# paths; tests/test_tracing.py enforces the same budget with CI slack)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --span-overhead
+
 echo "ok"

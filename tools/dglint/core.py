@@ -118,6 +118,11 @@ class ProjectContext:
     metric_names: frozenset[str] = frozenset()
     metric_dupes: list[tuple[str, int]] = field(default_factory=list)
     registries_found: bool = False
+    # span-name registry (utils/tracing.py SPAN_NAMES) — tracked by
+    # its own flag so fixture projects without it skip the span check
+    span_names: frozenset[str] = frozenset()
+    span_dupes: list[tuple[str, int]] = field(default_factory=list)
+    span_registry_found: bool = False
 
 
 @dataclass
@@ -205,9 +210,11 @@ def _collect_registries(proj: ProjectContext, root: str):
 
     fp_rel = "dgraph_tpu/utils/failpoint.py"
     mt_rel = "dgraph_tpu/utils/metrics.py"
+    tr_rel = "dgraph_tpu/utils/tracing.py"
     found = 0
     for rel, target, attr in ((fp_rel, "SITES", "failpoint"),
-                              (mt_rel, "REGISTERED", "metric")):
+                              (mt_rel, "REGISTERED", "metric"),
+                              (tr_rel, "SPAN_NAMES", "span")):
         tree = proj.files.get(rel)
         if tree is None:
             ap = os.path.join(root, rel)
@@ -219,13 +226,18 @@ def _collect_registries(proj: ProjectContext, root: str):
         names, dupes = parse_registry(tree, target)
         if names is None:
             continue
-        found += 1
         if attr == "failpoint":
+            found += 1
             proj.failpoint_sites = frozenset(names)
             proj.failpoint_dupes = dupes
-        else:
+        elif attr == "metric":
+            found += 1
             proj.metric_names = frozenset(names)
             proj.metric_dupes = dupes
+        else:
+            proj.span_names = frozenset(names)
+            proj.span_dupes = dupes
+            proj.span_registry_found = True
     proj.registries_found = found == 2
 
 

@@ -1,19 +1,23 @@
-"""DG08 — metric and failpoint site registries.
+"""DG08 — metric, failpoint-site and span-name registries.
 
 Observability names are API: a typo'd metric name silently forks a
-time series nobody's dashboard reads, and a failpoint site that
-production code never fires turns a chaos test into a no-op. Both
-registries are declarative tuples in their home modules —
+time series nobody's dashboard reads, a failpoint site that production
+code never fires turns a chaos test into a no-op, and a typo'd span
+name forks a trace nobody queries. The registries are declarative
+tuples in their home modules —
 
     dgraph_tpu/utils/failpoint.py   SITES = ("transport.send", ...)
     dgraph_tpu/utils/metrics.py     REGISTERED = ("dgraph_num_...",)
+    dgraph_tpu/utils/tracing.py     SPAN_NAMES = ("query", ...)
 
 — and DG08 checks, across the whole tree, that every literal name
 passed to `failpoint.fire(...)` / `inc_counter` / `set_gauge` /
-`observe` is registered, and that neither registry lists a name twice.
-Dynamically computed names are skipped (the linter only reads
-literals). Tests may arm ad-hoc fixture sites via `failpoint.arm`;
-only production `fire(...)` sites are checked.
+`observe` / `span(...)` is registered, and that no registry lists a
+name twice. Dynamically computed names are skipped (the linter only
+reads literals). Tests may arm ad-hoc fixture sites via
+`failpoint.arm` and open ad-hoc spans; only the dgraph_tpu/ tree is
+checked, and only when the span registry exists (fixture projects
+without it skip the span check).
 """
 
 from __future__ import annotations
@@ -24,9 +28,12 @@ from tools.dglint.astutil import call_name, str_const, walk_calls
 from tools.dglint.core import FileContext, register
 
 _METRIC_FNS = frozenset({"inc_counter", "set_gauge", "observe"})
+# span() and the conventional `from ...tracing import span as _span`
+_SPAN_FNS = frozenset({"span", "_span"})
 
 _FAILPOINT_HOME = "dgraph_tpu/utils/failpoint.py"
 _METRICS_HOME = "dgraph_tpu/utils/metrics.py"
+_TRACING_HOME = "dgraph_tpu/utils/tracing.py"
 
 
 def parse_registry(tree: ast.AST, target: str):
@@ -78,6 +85,12 @@ def check_registries(ctx: FileContext):
                 "DG08",
                 _FakeNode(line),
                 f"metric {name!r} registered twice in REGISTERED")
+    if ctx.rel == _TRACING_HOME:
+        for name, line in proj.span_dupes:
+            yield ctx.finding(
+                "DG08",
+                _FakeNode(line),
+                f"span name {name!r} registered twice in SPAN_NAMES")
     for call in walk_calls(ctx.tree):
         name = call_name(call)
         if name is None or not call.args:
@@ -100,6 +113,14 @@ def check_registries(ctx: FileContext):
                     "DG08", call,
                     f"metric {metric!r} emitted but not listed in "
                     "utils/metrics.py REGISTERED")
+        elif parts[-1] in _SPAN_FNS and proj.span_registry_found \
+                and ctx.rel != _TRACING_HOME:
+            sname = str_const(call.args[0])
+            if sname is not None and sname not in proj.span_names:
+                yield ctx.finding(
+                    "DG08", call,
+                    f"span name {sname!r} opened but not listed in "
+                    "utils/tracing.py SPAN_NAMES")
 
 
 class _FakeNode:

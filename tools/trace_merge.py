@@ -1,0 +1,119 @@
+"""Stitch per-node trace slices into one Perfetto-loadable timeline.
+
+Every node of a cluster keeps its own span ring; a distributed request
+leaves one slice per node, all sharing a trace_id (utils/tracing). This
+tool collects the slices and emits ONE Chrome trace-event JSON file
+with `pid` = node (Perfetto renders one process lane per node), so
+"where did this query's 40 ms go, and on which node?" is a single
+timeline.
+
+Inputs, any mix of:
+  - a file containing a JSON list of span records, or an object with
+    a "spans" list (the cluster `traces` op result), or an object with
+    "traceEvents" (an HTTP /debug/traces dump — already-rendered
+    events pass through with their pids re-assigned by node name);
+  - an http(s) URL, fetched as `<url>/debug/traces?trace_id=<id>`.
+
+Usage:
+    python -m tools.trace_merge --out merged.json [--trace-id ID] \
+        slice_g1.json slice_g2.json http://127.0.0.1:8080
+
+Load merged.json in https://ui.perfetto.dev or chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Iterable, Optional
+
+
+def _slice_spans(obj, fallback_node: str) -> list[dict]:
+    """Normalize one input document to a list of span records."""
+    if isinstance(obj, dict) and "spans" in obj:
+        node = obj.get("node", fallback_node)
+        return [dict(s, node=s.get("node") or node)
+                for s in obj["spans"]]
+    if isinstance(obj, dict) and "traceEvents" in obj:
+        # an HTTP /debug/traces dump: recover span records from the
+        # rendered events (metadata rows name the pids)
+        names = {e["pid"]: e["args"]["name"]
+                 for e in obj["traceEvents"] if e.get("ph") == "M"}
+        out = []
+        for e in obj["traceEvents"]:
+            if e.get("ph") != "X":
+                continue
+            args = dict(e.get("args", ()))
+            out.append({
+                "name": e["name"], "ts_us": e["ts"],
+                "dur_us": e.get("dur", 0.0), "tid": e.get("tid", 0),
+                "node": names.get(e.get("pid"), fallback_node),
+                "trace_id": args.pop("trace_id", ""),
+                "span_id": args.pop("span_id", ""),
+                "parent_id": args.pop("parent_id", ""),
+                "args": args})
+        return out
+    if isinstance(obj, list):
+        return [dict(s, node=s.get("node") or fallback_node)
+                for s in obj]
+    raise ValueError("unrecognized trace slice shape")
+
+
+def merge_slices(slices: Iterable[tuple[str, list[dict]]],
+                 trace_id: Optional[str] = None) -> list[dict]:
+    """[(node_name, span_records)] -> Chrome trace events, one pid
+    lane per node. Span records missing a node get the slice's name;
+    with trace_id, other traces' spans are dropped."""
+    from dgraph_tpu.utils.tracing import chrome_events
+
+    spans: list[dict] = []
+    for node_name, recs in slices:
+        for s in recs:
+            if trace_id is not None and \
+                    s.get("trace_id") != trace_id:
+                continue
+            spans.append(dict(s, node=s.get("node") or node_name))
+    spans.sort(key=lambda s: s.get("ts_us", 0.0))
+    return chrome_events(spans)
+
+
+def _fetch_url(url: str, trace_id: Optional[str]) -> dict:
+    import urllib.request
+    q = f"?trace_id={trace_id}" if trace_id else ""
+    with urllib.request.urlopen(
+            url.rstrip("/") + "/debug/traces" + q, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-node trace slices into one Perfetto "
+                    "timeline")
+    ap.add_argument("inputs", nargs="+",
+                    help="slice files or node base URLs")
+    ap.add_argument("--out", default="merged_trace.json")
+    ap.add_argument("--trace-id", default=None,
+                    help="keep only this trace's spans")
+    args = ap.parse_args(argv)
+
+    slices: list[tuple[str, list[dict]]] = []
+    for i, src in enumerate(args.inputs):
+        if src.startswith(("http://", "https://")):
+            doc = _fetch_url(src, args.trace_id)
+        else:
+            with open(src, encoding="utf-8") as f:
+                doc = json.load(f)
+        fallback = f"node-{i}"
+        slices.append((fallback, _slice_spans(doc, fallback)))
+    events = merge_slices(slices, trace_id=args.trace_id)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump({"traceEvents": events}, f)
+    n_spans = sum(1 for e in events if e.get("ph") == "X")
+    nodes = sum(1 for e in events if e.get("ph") == "M")
+    print(f"wrote {args.out}: {n_spans} spans across {nodes} node(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
