@@ -43,6 +43,10 @@ import time
 SCALE = int(os.environ.get("QBENCH_SCALE", 800))
 REPEATS = int(os.environ.get("QBENCH_REPEATS", 3))
 
+# --concurrency mode: open-loop arrival counts
+CONC_REQUESTS = int(os.environ.get("QBENCH_CONC_REQUESTS", 2000))
+CONC_WINDOW_US = int(os.environ.get("QBENCH_BATCH_WINDOW_US", 500))
+
 _UID_BASES = (0x80000, 0x70000, 0x60000, 0x50000, 0x40000,
               0x20000, 0x10000)
 
@@ -171,6 +175,279 @@ def _measure_encode_100k(db, scale: int) -> dict:
             "speedup": round(old_ms / max(new_ms, 1e-9), 1)}
 
 
+def _conc_workload(db, scale: int) -> tuple[list, list]:
+    """(repeated-skeleton, mixed) workloads for --concurrency mode.
+
+    repeated-skeleton = app-style parameterized families — point
+    lookups, term search with a range filter, uid fetches — many
+    literal bindings per skeleton, exactly what the plan cache keys
+    on. mixed = a golden-suite slice (one-off structures)."""
+    rep = []
+    for i in range(48):
+        rep.append('{ q(func: eq(name, "Movie %d")) '
+                   '{ uid name initial_release_date } }' % (i * 7))
+    for i in range(24):
+        rep.append('{ q(func: eq(runtime, %d)) @filter(ge(rating, 2.0)) '
+                   '{ uid runtime rating } }' % (60 + i))
+    for i in range(24):
+        rep.append('{ q(func: anyofterms(name, "movie %d")) '
+                   '@filter(le(initial_release_date, "1999-01-01")) '
+                   '{ uid name } }' % i)
+    for i in range(16):
+        rep.append('{ q(func: uid(%s)) { uid name rating runtime } }'
+                   % hex(0x20000 * scale + i))
+    mixed = [q for _, q in load_workload(scale)[:24]]
+    return rep, mixed
+
+
+def _run_open_loop(submit, reqs: list, concurrency: int,
+                   rate_qps: float,
+                   burst_of: "list[int] | None" = None
+                   ) -> "list[float]":
+    """Open-loop arrivals: one global schedule at `rate_qps` offered
+    load, `concurrency` workers pull the next request as they free
+    up; latency = finish - SCHEDULED arrival (queueing counts, the
+    open-loop property). `burst_of[i]` assigns request i to an
+    arrival slot — requests sharing a slot arrive at the same
+    instant (fan-out bursts)."""
+    import threading
+
+    t0 = time.perf_counter() + 0.05
+    if burst_of is None:
+        arrivals = [t0 + i / rate_qps for i in range(len(reqs))]
+    else:
+        slots = burst_of[-1] + 1
+        slot_rate = rate_qps * slots / len(reqs)
+        arrivals = [t0 + s / slot_rate for s in burst_of]
+    lat = [0.0] * len(reqs)
+    nxt = [0]
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                i = nxt[0]
+                if i >= len(reqs):
+                    return
+                nxt[0] += 1
+            wait = arrivals[i] - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            submit(reqs[i])
+            lat[i] = time.perf_counter() - arrivals[i]
+
+    threads = [threading.Thread(target=worker)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return lat
+
+
+def _pcts(lat) -> dict:
+    import numpy as np
+
+    a = np.asarray(lat) * 1e3
+    return {"p50_ms": round(float(np.percentile(a, 50)), 3),
+            "p99_ms": round(float(np.percentile(a, 99)), 3),
+            "mean_ms": round(float(a.mean()), 3)}
+
+
+def main_concurrency(concurrency: int) -> int:
+    """--concurrency N: cold-compile vs warm-cache vs batched columns
+    at the bench regime -> BENCH_BATCH.json.
+
+    Sequential columns measure the serving path (query_json) with the
+    plan cache off (interpreted) and on (warm); concurrent columns
+    drive an open-loop arrival schedule through N workers with
+    sequential dispatch (shared reader lock, no batcher) vs the
+    micro-batcher. Parity: batched responses must be byte-identical
+    (data payload) to unbatched ones."""
+    from bench import init_backend
+    from dgraph_tpu.engine.batcher import MicroBatcher
+    from dgraph_tpu.query.plan import PlanCache
+    from dgraph_tpu.utils import metrics
+    from dgraph_tpu.utils.rwlock import RWLock
+
+    devs, platform = init_backend()
+    sys.stderr.write(f"jax devices: {devs} (platform={platform})\n")
+    scale = SCALE if platform not in ("cpu", "cpu_fallback") \
+        else min(SCALE, int(os.environ.get("QBENCH_CPU_SCALE", 4)))
+    db, n_rdf = build_db(scale, prefer_device=True)
+    rep, mixed = _conc_workload(db, scale)
+
+    def data_of(body: str) -> str:
+        return json.dumps(json.loads(body)["data"], sort_keys=True)
+
+    # -- sequential: interpreted vs cold-compile vs warm-cache --------
+    def seq(qs, repeats=3):
+        ts = []
+        for _ in range(repeats):
+            for q in qs:
+                t = time.perf_counter()
+                db.query_json(q)
+                ts.append(time.perf_counter() - t)
+        return ts
+
+    db.plan_cache = None
+    seq(rep, 1)  # warm tablets/tiles outside timing
+    seq(mixed, 1)
+    pc = PlanCache(256)
+    db.plan_cache = pc  # empty: the first pass IS the cold run
+    before = metrics.counters_snapshot()
+    cold = seq(rep, 1)
+    # interleave the interpreted and warm arms pass by pass so
+    # box-level noise (CPU steal on shared hosts) hits both equally
+    interp, warm, interp_mixed, warm_mixed = [], [], [], []
+    for _ in range(4):
+        db.plan_cache = None
+        interp += seq(rep, 1)
+        interp_mixed += seq(mixed, 1)
+        db.plan_cache = pc
+        warm += seq(rep, 1)
+        warm_mixed += seq(mixed, 1)
+    delta = metrics.counters_delta(before)
+    hits = delta.get("plan_cache_hits", 0)
+    misses = delta.get("plan_cache_misses", 0)
+
+    # -- concurrent: sequential dispatch vs micro-batched -------------
+    # offered load = QBENCH_CONC_LOAD (default 0.85) of MEASURED
+    # concurrent capacity (closed-loop probe): threads on one GIL
+    # lose real capacity to contention, so sizing off single-thread
+    # latency would saturate the open loop and measure nothing but
+    # queue growth
+    import threading as _threading
+    probe_reqs = (rep * 3)[:300]
+    probe_next = [0]
+    probe_lock = _threading.Lock()
+    rw_probe = RWLock()
+
+    def probe_worker():
+        while True:
+            with probe_lock:
+                i = probe_next[0]
+                if i >= len(probe_reqs):
+                    return
+                probe_next[0] += 1
+            with rw_probe.read:
+                db.query_json(probe_reqs[i])
+
+    t0 = time.perf_counter()
+    pthreads = [_threading.Thread(target=probe_worker)
+                for _ in range(concurrency)]
+    for t in pthreads:
+        t.start()
+    for t in pthreads:
+        t.join()
+    capacity = len(probe_reqs) / (time.perf_counter() - t0)
+    rate = float(os.environ.get("QBENCH_CONC_LOAD", 0.85)) * capacity
+    # production-shaped arrival process, deterministic so both columns
+    # replay the identical stream: half the traffic arrives as
+    # fan-out BURSTS — 8 copies of one hot query at the same instant
+    # (dashboard fan-out / cache stampede, the canonical micro-batch
+    # scenario and the ISSUE's "concurrent same-skeleton" workload) —
+    # the other half as independent singles over the full repeated +
+    # mixed families
+    import random as _random
+    rng = _random.Random(20260803)
+    hot = rep[:8]
+    reqs = []       # query per arrival
+    burst_of = []   # arrival-slot index each request shares
+    slot = 0
+    while len(reqs) < CONC_REQUESTS:
+        if rng.random() < 0.125:  # 1 burst in 8 slots = 50% of traffic
+            q = hot[rng.randrange(len(hot))]
+            for _ in range(min(8, CONC_REQUESTS - len(reqs))):
+                reqs.append(q)
+                burst_of.append(slot)
+        else:
+            r = rng.random()
+            fam = rep if r < 0.7 else mixed
+            reqs.append(fam[rng.randrange(len(fam))])
+            burst_of.append(slot)
+        slot += 1
+    rw = RWLock()
+
+    expected = {q: data_of(db.query_json(q)) for q in set(reqs)}
+
+    def seq_submit(q):
+        with rw.read:
+            db.query_json(q)
+
+    seq_lat = _run_open_loop(seq_submit, reqs, concurrency, rate,
+                             burst_of)
+
+    mb = MicroBatcher(db, window_us=CONC_WINDOW_US,
+                      read_lock=lambda: rw.read)
+    before = metrics.counters_snapshot()
+    mismatch = [0]
+
+    def batch_submit(q):
+        out = mb.query_json(q)
+        if data_of(out) != expected[q]:
+            mismatch[0] += 1
+
+    bat_lat = _run_open_loop(batch_submit, reqs, concurrency, rate,
+                             burst_of)
+    bdelta = metrics.counters_delta(before)
+    dispatches = bdelta.get("batch_dispatches", 0)
+
+    out = {
+        "summary": {
+            "metric": f"query_batched_p99_ms_{n_rdf//1_000_000}M",
+            "value": _pcts(bat_lat)["p99_ms"],
+            "unit": "ms",
+            "vs_baseline": round(
+                _pcts(seq_lat)["p99_ms"]
+                / max(_pcts(bat_lat)["p99_ms"], 1e-9), 3),
+            "concurrency": concurrency,
+            "requests": CONC_REQUESTS,
+            "offered_qps": round(rate, 1),
+            "batch_window_us": CONC_WINDOW_US,
+            "parity_ok": mismatch[0] == 0,
+            "platform": platform,
+            "scale": scale,
+            "rdf": n_rdf,
+        },
+        "columns": {
+            "interpreted_seq": {**_pcts(interp), "workload": "repeated"},
+            "interpreted_seq_mixed": {**_pcts(interp_mixed),
+                                      "workload": "mixed"},
+            "cold_compile": {**_pcts(cold),
+                             "note": "first run per skeleton: parse + "
+                                     "plan compile + jit warm"},
+            "warm_cache": {**_pcts(warm), "workload": "repeated",
+                           "hit_rate": round(
+                               hits / max(hits + misses, 1), 4)},
+            "warm_cache_mixed": {**_pcts(warm_mixed),
+                                 "workload": "mixed"},
+            "sequential_dispatch": {**_pcts(seq_lat),
+                                    "concurrency": concurrency},
+            "batched": {**_pcts(bat_lat), "concurrency": concurrency,
+                        "dispatches": dispatches,
+                        "mean_occupancy": round(
+                            CONC_REQUESTS / max(dispatches, 1), 2)},
+        },
+        "speedups": {
+            "warm_vs_interpreted_p50": round(
+                _pcts(interp)["p50_ms"]
+                / max(_pcts(warm)["p50_ms"], 1e-9), 2),
+            "warm_vs_cold_p50": round(
+                _pcts(cold)["p50_ms"]
+                / max(_pcts(warm)["p50_ms"], 1e-9), 2),
+            "batched_vs_sequential_p99": round(
+                _pcts(seq_lat)["p99_ms"]
+                / max(_pcts(bat_lat)["p99_ms"], 1e-9), 2),
+        },
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_BATCH.json"), "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps(out["summary"]))
+    return 1 if mismatch[0] else 0
+
+
 def main():
     import numpy as np
 
@@ -266,6 +543,9 @@ def main():
 
 if __name__ == "__main__":
     try:
+        if "--concurrency" in sys.argv:
+            n = int(sys.argv[sys.argv.index("--concurrency") + 1])
+            sys.exit(main_concurrency(n))
         sys.exit(main())
     except Exception as exc:  # one structured line, never a traceback
         import traceback

@@ -113,10 +113,12 @@ def cmd_alpha(args) -> int:
         db = load_snapshot(args.snapshot,
                            GraphDB(wal_path=args.wal or None,
                                    prefer_device=not args.no_device,
-                                   enc_key=enc_key))
+                                   enc_key=enc_key,
+                                   plan_cache_size=args.plan_cache_size))
     else:
         db = GraphDB(wal_path=args.wal or None,
-                     prefer_device=not args.no_device, enc_key=enc_key)
+                     prefer_device=not args.no_device, enc_key=enc_key,
+                     plan_cache_size=args.plan_cache_size)
     secret = None
     if args.acl_secret_file:
         with open(args.acl_secret_file, "rb") as f:
@@ -131,7 +133,8 @@ def cmd_alpha(args) -> int:
     httpd, alpha = serve(db, host=args.host, port=args.port, block=False,
                          acl_secret=secret, tls_context=tls_ctx,
                          mutations_mode=args.mutations,
-                         max_pending=args.max_pending)
+                         max_pending=args.max_pending,
+                         batch_window_us=args.batch_window_us)
     grpc_srv = None
     if args.grpc_port:
         from dgraph_tpu.server.grpc_api import serve_grpc
@@ -731,6 +734,14 @@ def main(argv=None) -> int:
                    choices=["allow", "disallow", "strict"],
                    help="mutation mode (ref --mutations, "
                         "alpha/run.go:502)")
+    a.add_argument("--plan-cache-size", type=int, default=128,
+                   help="compiled query plan cache entries "
+                        "(query/plan.py); 0 disables and every "
+                        "request takes the interpreted path")
+    a.add_argument("--batch-window-us", type=int, default=0,
+                   help="micro-batching window in microseconds: "
+                        "concurrent queries sharing a plan-cache key "
+                        "coalesce into one dispatch. 0 = off")
     a.add_argument("--acl_secret_file",
                    default="",
                    help="enables ACL; file holds the HMAC jwt secret")

@@ -20,6 +20,7 @@ Mutation semantics ported from behavior (not structure):
 from __future__ import annotations
 
 import hashlib
+import json as _json
 import time
 from dataclasses import dataclass, field, replace as _dc_replace
 from typing import Any, Optional
@@ -37,8 +38,8 @@ from dgraph_tpu.models.schema import (
 from dgraph_tpu.models.types import TypeID, Val, convert
 from dgraph_tpu.storage.tablet import EdgeOp, Posting, Tablet
 from dgraph_tpu.storage.wal import Wal
-from dgraph_tpu.utils import metrics
-from dgraph_tpu.utils.tracing import span as _span
+from dgraph_tpu.utils import metrics, reqlog
+from dgraph_tpu.utils.tracing import bind_request, span as _span
 
 # process-wide measured device dispatch RTT (device_dispatch_seconds)
 _DISPATCH_SECONDS: float | None = None
@@ -121,10 +122,19 @@ class GraphDB:
                  store_dir: str | None = None,
                  tablet_budget: int = 256 << 20,
                  rollup_window: int = 0,
-                 prefer_columnar: bool = True):
+                 prefer_columnar: bool = True,
+                 plan_cache_size: int = 128):
         from dgraph_tpu.engine.tile_cache import DeviceCacheLRU
+        from dgraph_tpu.query.plan import PlanCache
 
         self.schema = SchemaState()
+        # compiled plan cache (query/plan.py): parse + skeleton-keyed
+        # executables. schema_epoch is a plan-cache key component —
+        # every schema change bumps it, making stale plans unreachable.
+        # 0 disables (every request takes the interpreted path).
+        self.schema_epoch = 0
+        self.plan_cache = PlanCache(plan_cache_size) \
+            if plan_cache_size else None
         self.coordinator = Coordinator()
         self.tablet_store = None
         if store_dir is not None:
@@ -194,6 +204,7 @@ class GraphDB:
               drop_attr: str = "", ctx=None):
         if ctx is not None:
             ctx.check("alter")
+        self._bump_schema_epoch()
         if drop_all:
             for tab in self.tablets.values():
                 self.device_cache.drop_tablet(tab)
@@ -226,6 +237,15 @@ class GraphDB:
                     t.rebuild_reverse()
         self._log_record(("alter", schema_text))
 
+    def _bump_schema_epoch(self):
+        """Invalidate compiled plans: tokenizer/index/type decisions
+        baked into a plan's stage constants are schema-derived, so any
+        schema change must fence them. Predicates created on the fly
+        by mutations do NOT bump — a new tablet only ADDS state a
+        cached plan re-reads per request (tablets are looked up at
+        execution, never baked in)."""
+        self.schema_epoch += 1
+
     # ------------------------------------------------------------------
     # Transactions
     # ------------------------------------------------------------------
@@ -246,9 +266,6 @@ class GraphDB:
         records the `mutate` span, and returns the Dgraph-compatible
         `extensions.server_latency` on every mutation response (for a
         staged-only mutation the whole stage counts as processing)."""
-        from dgraph_tpu.utils import reqlog
-        from dgraph_tpu.utils.tracing import bind_request
-
         t_start = time.perf_counter_ns()
         with bind_request(ctx), _span("mutate"):
             out = self._mutate_inner(txn, ctx=ctx, **kw)
@@ -652,6 +669,8 @@ class GraphDB:
         processApplyCh/applyCommitted). Returns the commit ts the record
         carried, 0 for schema ops."""
         kind = rec[0]
+        if kind in ("alter", "drop_all", "drop_attr", "import_tablet"):
+            self._bump_schema_epoch()
         if kind == "alter":
             preds, types = self.schema.apply_text(rec[1])
             for ps in preds:
@@ -801,8 +820,6 @@ class GraphDB:
         `ctx` (utils/reqctx.RequestContext) carries the request's
         deadline/cancellation into the executor AND its trace ids:
         spans opened anywhere below join the request's trace."""
-        from dgraph_tpu.utils.tracing import bind_request
-
         with bind_request(ctx), _span("query") as sp:
             ex, done, lat, read_ts = self._query_run(
                 q, variables, txn, best_effort, read_ts, ctx, sp)
@@ -867,9 +884,16 @@ class GraphDB:
         from dgraph_tpu.query.executor import Executor
 
         lat = Latency()
+        plan = None
         with _span("parse"):
             t0 = time.perf_counter_ns()
-            parsed = gql_parse(q, variables)
+            if self.plan_cache is not None:
+                # cached parse + compiled plan: a warm same-skeleton
+                # request binds its literals and skips the parser and
+                # the per-stage re-derivation entirely
+                parsed, plan = self.plan_cache.lookup(self, q, variables)
+            else:
+                parsed = gql_parse(q, variables)
             lat.parsing_ns = time.perf_counter_ns() - t0
         if ctx is not None:
             ctx.check("parse")
@@ -892,7 +916,7 @@ class GraphDB:
         with _span("execute"):
             t0 = time.perf_counter_ns()
             try:
-                ex = Executor(self, read_ts, ctx=ctx)
+                ex = Executor(self, read_ts, ctx=ctx, plan=plan)
                 done = ex.execute(parsed)
             except BaseException:
                 self.coordinator.unpin_read(read_ts)
@@ -906,8 +930,6 @@ class GraphDB:
         return ex, done, lat, read_ts
 
     def _query_metrics(self, lat: Latency, ctx=None):
-        from dgraph_tpu.utils import reqlog
-
         metrics.inc_counter("dgraph_num_queries_total")
         metrics.observe("dgraph_query_latency_ms",
                         (lat.parsing_ns + lat.processing_ns
@@ -927,10 +949,6 @@ class GraphDB:
         (ref query/outputnode.go fastJsonNode — a documented reference
         hot loop). The serving layers (HTTP/gRPC) call this; library
         users who want Python objects keep query()."""
-        import json as _json
-
-        from dgraph_tpu.utils.tracing import bind_request
-
         with bind_request(ctx), _span("query") as sp:
             ex, done, lat, read_ts = self._query_run(
                 q, variables, txn, best_effort, read_ts, ctx, sp)
@@ -1065,7 +1083,10 @@ class GraphDB:
 
                 import jax
                 import jax.numpy as jnp
-                f = jax.jit(lambda x: x + 1)
+
+                from dgraph_tpu.query.plan import jit_stage
+                f = jit_stage("db.dispatch_probe",
+                              lambda: jax.jit(lambda x: x + 1))
                 xs = [jnp.asarray(np.asarray([i], np.int32))
                       for i in range(4)]
                 np.asarray(f(xs[0]))  # compile outside the timing
@@ -1120,4 +1141,7 @@ class GraphDB:
                 for g in self.coordinator.groups},
             "schema": self.schema.describe_all(),
             "deviceCache": self.device_cache.stats(),
+            "planCache": self.plan_cache.stats()
+            if self.plan_cache is not None else None,
+            "schemaEpoch": self.schema_epoch,
         }

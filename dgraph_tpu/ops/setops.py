@@ -157,15 +157,26 @@ def count_filter(parts: Sequence[np.ndarray], need: int) -> np.ndarray:
 def _device_matrix(parts: Sequence[np.ndarray]) -> Optional[np.ndarray]:
     """Stack k sorted uid vectors into one padded uint32 matrix, or
     None when any uid exceeds the 32-bit device plane (callers fall
-    back to the host fold, same contract as the adjacency tiles)."""
+    back to the host fold, same contract as the adjacency tiles).
+
+    BOTH dimensions bucket to powers of two so the jitted set-algebra
+    executables compile once per (row bucket, width bucket) instead
+    of once per distinct set count: surplus rows REPLICATE the last
+    row, which is exact for union (dedup absorbs it) and for
+    intersection (idempotent), unlike sentinel rows which would empty
+    an intersection."""
     from dgraph_tpu.ops.uidvec import SENTINEL, pad_to
 
     width = pad_to(max((len(p) for p in parts), default=0))
-    mat = np.full((max(len(parts), 1), width), SENTINEL, np.uint32)
+    k = max(len(parts), 1)
+    kp = pad_to(k, minimum=2)
+    mat = np.full((kp, width), SENTINEL, np.uint32)
     for i, p in enumerate(parts):
         if len(p) and int(p[-1]) > _MAX_U32:
             return None
         mat[i, : len(p)] = np.asarray(p, np.uint64).astype(np.uint32)
+    for i in range(k, kp):
+        mat[i] = mat[k - 1]
     return mat
 
 
@@ -180,11 +191,18 @@ def union_many_device(parts: Sequence[np.ndarray]
     mat = _device_matrix(live)
     if mat is None:
         return None
+    import jax
     import jax.numpy as jnp
 
     from dgraph_tpu.ops.uidvec import merge_many, to_numpy
+    from dgraph_tpu.query.plan import jit_stage
 
-    return to_numpy(merge_many(jnp.asarray(mat))).astype(np.uint64)
+    # ONE compiled executable for the whole co-sort+unique chain
+    # instead of an eager op-by-op dispatch; _device_matrix buckets
+    # BOTH matrix dimensions to pow-2, so jax's shape-keyed trace
+    # cache under this wrapper stays small (log k x log width shapes)
+    fn = jit_stage("setops.union_many", lambda: jax.jit(merge_many))
+    return to_numpy(fn(jnp.asarray(mat))).astype(np.uint64)
 
 
 def intersect_many_device(parts: Sequence[np.ndarray]
@@ -202,9 +220,13 @@ def intersect_many_device(parts: Sequence[np.ndarray]
     mat = _device_matrix(ordered)
     if mat is None:
         return None
+    import jax
     import jax.numpy as jnp
 
     from dgraph_tpu.ops.uidvec import intersect_many as _dev_isect
     from dgraph_tpu.ops.uidvec import to_numpy
+    from dgraph_tpu.query.plan import jit_stage
 
-    return to_numpy(_dev_isect(jnp.asarray(mat))).astype(np.uint64)
+    fn = jit_stage("setops.intersect_many",
+                   lambda: jax.jit(_dev_isect))
+    return to_numpy(fn(jnp.asarray(mat))).astype(np.uint64)

@@ -47,6 +47,7 @@ from dgraph_tpu.utils.metrics import inc_counter
 from dgraph_tpu.utils.tracing import span as _span
 
 _EMPTY = np.empty(0, dtype=np.uint64)
+_MISS_CV = object()  # _colview memo sentinel (None is a valid verdict)
 
 # value types the columnar JSON fast path serializes (DATETIME via its
 # isoformat string); GEO/BINARY/PASSWORD keep the general emitter
@@ -133,6 +134,7 @@ def _flat_column(ex, ch, name: str, ulist: list, n: int):
         col = _flat_column_vectorized(ex, ch, name, colview, n)
         if col is not None:
             return col
+    ex._ensure_child_values(ch)
     vmap = ch.values
     present = np.zeros(n, np.uint8)
     idxs: list[int] = []
@@ -308,15 +310,28 @@ class ExecNode:
     emit_order: Optional[list[int]] = None  # path-var traversal order
     path_nodes: list[list[int]] = field(default_factory=list)  # shortest
     path_weights: list[float] = field(default_factory=list)
+    block_idx: int = -1  # position in parsed.queries (plan memo key)
+    # compiled flat blocks defer scalar-child value gathering to the
+    # emitter (the columnar JSON emitter reads the column view
+    # directly); _ensure_child_values materializes on demand for
+    # every other consumer
+    lazy_cols: bool = False
     # columnar emission fast path: uid -> ready json value for flat
     # scalar children (populated instead of `values` when eligible)
     col_vals: Optional[dict] = None
 
 
 class Executor:
-    def __init__(self, db, read_ts: int, ctx=None):
+    def __init__(self, db, read_ts: int, ctx=None, plan=None):
         self.db = db
         self.read_ts = read_ts
+        # compiled plan (query/plan.py) for this request's skeleton,
+        # or None on the interpreted path (plan cache disabled, upsert
+        # queries). Carries parameter-memoized stage artifacts and the
+        # skeleton identity; the AST stays the source of truth for
+        # parameters, so a shared plan can never leak one request's
+        # literals into another's
+        self.plan = plan
         # RequestContext (utils/reqctx.py): deadline + cancellation,
         # consulted at block/level boundaries so deep traversals abort
         # mid-flight (the reference checks ctx.Err() in ProcessGraph)
@@ -328,6 +343,8 @@ class Executor:
         # score-descending uid order of the current block's similar_to
         # root, set by _eval_similar_to and consumed at pagination
         self._similar_order: Optional[list[int]] = None
+        # per-request column-view memo (one snapshot, one verdict)
+        self._cv_memo: dict = {}
 
     def _checkpoint(self, where: str):
         """Block/level boundary: the `executor.level` failpoint (chaos
@@ -351,28 +368,48 @@ class Executor:
         — the reference ranks ToJson a top-5 hot loop) and pick the
         columnar fast path."""
         self.parsed = parsed
-        self._check_similar_score_ambiguity(parsed)
+        if self.plan is None:
+            self._check_similar_score_ambiguity(parsed)
+        else:
+            # structure-only validation: ran once at plan compile (a
+            # rejected combination never produces a cached plan)
+            self.plan.memo(("similar_check",),
+                           lambda: self._check_similar_score_ambiguity(
+                               parsed))
         blocks = list(parsed.queries)
         done: list[tuple[GraphQuery, ExecNode]] = []
-        pending = blocks
+        pending = list(enumerate(blocks))
         for _ in range(len(blocks) + 1):
             if not pending:
                 break
             still = []
-            for gq in pending:
-                if self._vars_ready(gq):
+            for i, gq in pending:
+                needs, own = self._block_vars_of(i, gq)
+                if all(self._var_defined(n) or n in own for n in needs):
                     self._checkpoint(f"block {gq.alias or gq.attr}")
-                    done.append((gq, self._run_block(gq)))
+                    done.append((gq, self._run_block(gq, i)))
                 else:
-                    still.append(gq)
+                    still.append((i, gq))
             if len(still) == len(pending):
-                missing = sorted({vc.name for gq in still
-                                  for vc in self._all_needs(gq)
-                                  if not self._var_defined(vc.name)})
+                missing = sorted({n for i, gq in still
+                                  for n in self._block_vars_of(i, gq)[0]
+                                  if not self._var_defined(n)})
                 raise GQLError(
                     f"circular or undefined variable dependency: {missing}")
             pending = still
         return done
+
+    def _block_vars_of(self, i: int, gq: GraphQuery
+                       ) -> tuple[tuple, frozenset]:
+        """(consumed var names, provided var names) for block `i` —
+        pure structure, so a warm plan binds it once per skeleton
+        instead of re-walking the AST per request."""
+        def build():
+            return (tuple(vc.name for vc in self._all_needs(gq)),
+                    frozenset(self._provides(gq)))
+        if self.plan is not None:
+            return self.plan.memo(("blockvars", i), build)
+        return build()
 
     def _check_similar_score_ambiguity(self, parsed: ParsedResult):
         """`similar_to_score` is ONE binding per request; with several
@@ -475,38 +512,62 @@ class Executor:
         from dgraph_tpu import native as _native
 
         gq = node.gq
-        if (gq.recurse is not None or gq.is_groupby or gq.normalize
-                or gq.cascade or gq.ignore_reflex or not node.children
-                or node.emit_order is not None):
+        if not node.children or node.emit_order is not None:
             # emit_order (path vars, similar_to score order) reorders
             # rows; the columnar emitter walks dest uid-ascending
             return None
+
+        def eligible() -> Optional[list]:
+            """Spec derivation is structure+schema-pure, so a warm
+            plan binds it once per (skeleton, schema epoch): either
+            None (this block shape keeps the general emitter — a
+            predicate created on the fly after compile re-decides at
+            the next epoch-keyed plan, costing only the fast path) or
+            the (child index | uid marker, name) column list."""
+            if (gq.recurse is not None or gq.is_groupby or gq.normalize
+                    or gq.cascade or gq.ignore_reflex):
+                return None
+            sp = []  # (child idx, name); idx None marks the uid col
+            for ci, ch in enumerate(node.children):
+                cgq = ch.gq
+                name = cgq.alias or cgq.attr
+                if not all(32 <= ord(c) < 127 and c not in '"\\'
+                           for c in name):
+                    # the native emitter writes keys verbatim; names
+                    # that need escaping (quotes, non-ASCII — legal in
+                    # <iri> attrs and unicode identifiers) keep the
+                    # dict path
+                    return None
+                if cgq.attr == "uid" and not cgq.is_count:
+                    sp.append((None, "uid"))
+                    continue
+                tab = ch.tablet
+                if (tab is None or cgq.is_count or cgq.agg_func
+                        or cgq.attr == "math"
+                        or cgq.attr.startswith("val(")
+                        or cgq.langs or cgq.facets is not None
+                        or cgq.facet_var or cgq.cascade or cgq.children
+                        or ch.reverse or tab.schema.list_
+                        or tab.schema.value_type not in _FLAT_TYPES):
+                    return None
+                sp.append((ci, name))
+            return sp or None
+
+        if self.plan is not None and node.block_idx >= 0 \
+                and not any(c.expand for c in gq.children):
+            # expand() resolves children from DATA (the src uids'
+            # types), so its child list is not skeleton-stable: those
+            # blocks re-derive per request
+            idx_specs = self.plan.memo(
+                ("flatspec", node.block_idx), eligible)
+        else:
+            idx_specs = eligible()
+        if idx_specs is None:
+            return None
         uids = node.dest
         n = len(uids)
-        specs = []  # (child, name) for scalar cols; None marks uid col
-        for ch in node.children:
-            cgq = ch.gq
-            name = cgq.alias or cgq.attr
-            if not all(32 <= ord(c) < 127 and c not in '"\\'
-                       for c in name):
-                # the native emitter writes keys verbatim; names that
-                # need escaping (quotes, non-ASCII — legal in <iri>
-                # attrs and unicode identifiers) keep the dict path
-                return None
-            if cgq.attr == "uid" and not cgq.is_count:
-                specs.append((None, "uid"))
-                continue
-            tab = ch.tablet
-            if (tab is None or cgq.is_count or cgq.agg_func
-                    or cgq.attr == "math" or cgq.attr.startswith("val(")
-                    or cgq.langs or cgq.facets is not None
-                    or cgq.facet_var or cgq.cascade or cgq.children
-                    or ch.reverse or tab.schema.list_
-                    or tab.schema.value_type not in _FLAT_TYPES):
-                return None
-            specs.append((ch, name))
-        if not specs:
-            return None
+        specs = [(None if ci is None else node.children[ci], name)
+                 for ci, name in idx_specs]
         cols = []
         self._flat_uids = uids.astype(np.uint64)
         ulist = uids.tolist()
@@ -567,26 +628,23 @@ class Executor:
             return True
         return any(self._filter_has_similar(c) for c in ft.children)
 
-    def _vars_ready(self, gq: GraphQuery) -> bool:
-        own = set(self._provides(gq))
-        return all(self._var_defined(vc.name) or vc.name in own
-                   for vc in self._all_needs(gq))
-
     # ------------------------------------------------------------------
     # one block
     # ------------------------------------------------------------------
 
-    def _run_block(self, gq: GraphQuery) -> ExecNode:
+    def _run_block(self, gq: GraphQuery, i: int = -1) -> ExecNode:
         with _span("block", alias=gq.alias or gq.attr):
-            return self._run_block_inner(gq)
+            return self._run_block_inner(gq, i)
 
-    def _run_block_inner(self, gq: GraphQuery) -> ExecNode:
+    def _run_block_inner(self, gq: GraphQuery, i: int = -1) -> ExecNode:
         self._block_root = gq
-        self._block_vars = set(self._provides(gq))
+        self._block_vars = self._block_vars_of(i, gq)[1] \
+            if self.plan is not None and i >= 0 \
+            else set(self._provides(gq))
         # var-only blocks never reach emission, so their scalar
         # children may bind vars columnar-fast and skip posting walks
         self._block_emits = gq.alias != "var"
-        node = ExecNode(gq)
+        node = ExecNode(gq, block_idx=i)
         if gq.attr == "shortest":
             self._run_shortest(node)
             return node
@@ -619,7 +677,17 @@ class Executor:
         elif gq.is_groupby:
             self._bind_groupby_vars(gq, root)
         else:
-            self._expand_children(node, gq.children, root)
+            if self.plan is not None and i >= 0 and self.plan.memo(
+                    ("flatblock", i),
+                    lambda: self._flat_block_eligible(i, gq)):
+                # compiled dispatch: the plan proved (per skeleton +
+                # schema epoch) this block is a var-free flat scalar
+                # shape, so the per-child interpreter — dependency
+                # scheduling, internal/uid-edge/facet branching — is
+                # skipped wholesale
+                self._expand_children_flat(node, gq.children, root)
+            else:
+                self._expand_children(node, gq.children, root)
             if gq.cascade and self._block_vars:
                 # @cascade constrains the VARS the block binds, not
                 # just its output rows (ref query3:TestUseVarsCascade:
@@ -703,7 +771,19 @@ class Executor:
         """THE chokepoint every columnar value read goes through: the
         tablet's cached column view (None on dirty/historical/mixed
         tablets or with the tier disabled), budgeted against the tile
-        LRU and counted so BENCH_QUERIES can report tier routing."""
+        LRU and counted so BENCH_QUERIES can report tier routing.
+        Memoized per request — one snapshot, one verdict — so a block
+        that reads a column at eval AND emit time resolves, budgets
+        and counts it once."""
+        key = (id(tab), lang)
+        got = self._cv_memo.get(key, _MISS_CV)
+        if got is not _MISS_CV:
+            return got
+        cv = self._colview_inner(tab, lang)
+        self._cv_memo[key] = cv
+        return cv
+
+    def _colview_inner(self, tab, lang: str | None = None):
         if not self._columnar_on() \
                 or not hasattr(tab, "value_columns"):
             return None
@@ -1140,26 +1220,41 @@ class Executor:
             # `@.` (any language) probes every analyzer's buckets.
             # Token probes batch into ONE index probe + ONE k-way
             # union instead of per-token incremental union re-sorts
-            langs = _probe_langs(spec, lang)
-            no_tok_vals: list[Val] = []
-            all_toks: list[bytes] = []
-            for v in vals:
-                v_toks = 0
-                for lg in langs:
-                    try:
-                        toks = tokens_for(v, spec, lg)
-                    except (ValueError, TypeError):
-                        continue
-                    v_toks += len(toks)
-                    all_toks.extend(token_bytes(spec.ident, t)
-                                    for t in toks)
-                if not v_toks:
-                    # a value no tokenizer emits tokens for (e.g. "")
-                    # is absent from the index — PER VALUE, scan it
-                    # below and union (ref
-                    # TestQueryEmptyRoomsWithTermIndex; eq(room,
-                    # ["", "green"]) must match both)
-                    no_tok_vals.append(v)
+
+            def _analyze() -> tuple[list[bytes], list[Val]]:
+                langs = _probe_langs(spec, lang)
+                ntv: list[Val] = []
+                toks_all: list[bytes] = []
+                for v in vals:
+                    v_toks = 0
+                    for lg in langs:
+                        try:
+                            toks = tokens_for(v, spec, lg)
+                        except (ValueError, TypeError):
+                            continue
+                        v_toks += len(toks)
+                        toks_all.extend(token_bytes(spec.ident, t)
+                                        for t in toks)
+                    if not v_toks:
+                        # a value no tokenizer emits tokens for (e.g.
+                        # "") is absent from the index — PER VALUE,
+                        # scan it below and union (ref
+                        # TestQueryEmptyRoomsWithTermIndex; eq(room,
+                        # ["", "green"]) must match both)
+                        ntv.append(v)
+                return toks_all, ntv
+
+            if self.plan is not None:
+                # token analysis is (schema, lang, literal)-derived —
+                # exactly what a compiled plan binds once per
+                # parameter vector (keyed by the VALUES: a shared
+                # skeleton never serves another request's tokens)
+                all_toks, no_tok_vals = self.plan.memo(
+                    ("eqtok", tab.pred, lang, spec.ident,
+                     tuple((v.tid, v.value) for v in vals)),
+                    _analyze)
+            else:
+                all_toks, no_tok_vals = _analyze()
             if all_toks:
                 out = self._union_many(self._index_sets(tab, all_toks))
             if len(no_tok_vals) < len(vals):
@@ -1333,24 +1428,37 @@ class Executor:
             raise GQLError(
                 f"{fn.name}() expects a single value, "
                 f"got {len(fn.args)}")
-        try:
+        def _bounds() -> tuple[int, int, bool, bool]:
             if fn.name == "between":
-                lo = sort_key(convert(Val(TypeID.DEFAULT, fn.args[0].value), tid))
-                hi = sort_key(convert(Val(TypeID.DEFAULT, fn.args[1].value), tid))
-                lo_open = hi_open = False
+                return (sort_key(convert(
+                            Val(TypeID.DEFAULT, fn.args[0].value), tid)),
+                        sort_key(convert(
+                            Val(TypeID.DEFAULT, fn.args[1].value), tid)),
+                        False, False)
+            bound = sort_key(
+                convert(Val(TypeID.DEFAULT, fn.args[0].value), tid))
+            b_lo, b_hi = -(1 << 63), (1 << 63) - 1
+            b_lo_open = b_hi_open = False
+            if fn.name == "le":
+                b_hi = bound
+            elif fn.name == "lt":
+                b_hi, b_hi_open = bound, True
+            elif fn.name == "ge":
+                b_lo = bound
             else:
-                bound = sort_key(
-                    convert(Val(TypeID.DEFAULT, fn.args[0].value), tid))
-                lo, hi = -(1 << 63), (1 << 63) - 1
-                lo_open = hi_open = False
-                if fn.name == "le":
-                    hi = bound
-                elif fn.name == "lt":
-                    hi, hi_open = bound, True
-                elif fn.name == "ge":
-                    lo = bound
-                else:
-                    lo, lo_open = bound, True
+                b_lo, b_lo_open = bound, True
+            return b_lo, b_hi, b_lo_open, b_hi_open
+
+        try:
+            if self.plan is not None:
+                # bound parsing (datetime/float literal -> int64 sort
+                # key) is (literal, type)-pure: bind once per params
+                lo, hi, lo_open, hi_open = self.plan.memo(
+                    ("ineq", fn.name, fn.attr, int(tid),
+                     tuple(a.value for a in fn.args)),
+                    _bounds)
+            else:
+                lo, hi, lo_open, hi_open = _bounds()
         except ValueError as e:
             raise GQLError(f"bad {fn.name} argument for {fn.attr}: {e}")
         # strings compare beyond the 8-byte key prefix: exact host compare
@@ -1558,7 +1666,15 @@ class Executor:
         # (ops/setops) instead of a pairwise union/intersect fold
         parts: list[np.ndarray] = []
         for lg in _probe_langs(spec, fn.lang or ""):
-            toks = tokens_for(Val(TypeID.STRING, text), spec, lg)
+            if self.plan is not None:
+                # term analysis is (analyzer, literal)-pure — a warm
+                # plan binds the token batch once per parameter vector
+                toks = self.plan.memo(
+                    ("terms", toker, lg, text),
+                    lambda: tokens_for(Val(TypeID.STRING, text),
+                                       spec, lg))
+            else:
+                toks = tokens_for(Val(TypeID.STRING, text), spec, lg)
             if not toks:
                 continue
             sets = self._index_sets(
@@ -1614,7 +1730,16 @@ class Executor:
         pattern = fn.args[0].value
         flags = _re.IGNORECASE if (len(fn.args) > 1
                                    and "i" in fn.args[1].value) else 0
-        rx = _re.compile(pattern, flags)
+        if self.plan is not None:
+            # regex + trigram-query compilation is pure in (pattern,
+            # flags): a compiled plan binds it once per literal
+            rx, triq = self.plan.memo(
+                ("regexp", pattern, flags),
+                lambda: (_re.compile(pattern, flags),
+                         compile_trigram_query(pattern, flags)))
+        else:
+            rx = _re.compile(pattern, flags)
+            triq = None
         indexed = tab.schema.indexed and "trigram" in tab.schema.tokenizers
         if indexed and candidates is None:
             # Compile the regex AST into an AND/OR trigram query — a
@@ -1622,7 +1747,8 @@ class Executor:
             # index with it (ref worker/trigram.go:35 uidsForRegex via
             # cindex.RegexpQuery).  ALL ⇒ no index help ⇒ full scan.
             cand = self._trigram_query_uids(
-                tab, compile_trigram_query(pattern, flags))
+                tab, triq if triq is not None
+                else compile_trigram_query(pattern, flags))
             scan = cand if cand is not None else tab.src_uids(self.read_ts)
         else:
             scan = candidates if candidates is not None \
@@ -2129,6 +2255,87 @@ class Executor:
     # ------------------------------------------------------------------
     # traversal (ref query.go:1902 ProcessGraph)
     # ------------------------------------------------------------------
+
+    def _flat_block_eligible(self, i: int, gq: GraphQuery) -> bool:
+        """Whether block `i` may take the compiled flat child
+        expansion: no variables in or out, no block-level modifiers,
+        and every child a plain scalar leaf (or bare `uid`). Pure
+        structure + schema, so the plan binds the verdict once per
+        (skeleton, epoch); anything this misses (a predicate created
+        after compile stays on the interpreter until the next epoch)
+        costs only the fast path, never correctness."""
+        if (gq.alias == "var" or gq.cascade or gq.normalize
+                or gq.ignore_reflex or gq.is_count or gq.is_empty
+                or gq.var or gq.facet_var or gq.facets is not None
+                or gq.facets_filter is not None):
+            return False
+        needs, provides = self._block_vars_of(i, gq)
+        if needs or provides:
+            return False
+        if any(o.attr.startswith(("val(", "facet:")) for o in gq.order):
+            return False
+        if not gq.children:
+            return False
+        for c in gq.children:
+            if (c.expand or c.children or c.var or c.facet_var
+                    or c.facets is not None or c.facets_filter is not None
+                    or c.filter is not None or c.order or c.is_count
+                    or c.math is not None or c.agg_func or c.agg_pred
+                    or c.is_internal or c.cascade or c.normalize
+                    or c.langs or c.recurse is not None
+                    or c.shortest is not None or c.is_groupby
+                    or c.checkpwd_pwd is not None or c.is_empty):
+                return False
+            if c.attr == "uid":
+                continue
+            if c.attr.startswith(("~", "val(", "fragment/")) \
+                    or c.attr == "math":
+                return False
+            ps = self.db.schema.get(c.attr)
+            if ps is None or ps.list_ or ps.value_type == TypeID.UID:
+                return False
+        return True
+
+    def _expand_children_flat(self, parent: ExecNode,
+                              children: list[GraphQuery],
+                              src: np.ndarray):
+        """Straight-line child expansion for plan-proven flat blocks:
+        semantically the scalar tail of _process_child (columnar
+        gather, exact posting-walk fallback) with the generic
+        dispatch, sibling scheduling and per-child span bookkeeping
+        compiled away. The level checkpoint stays — deadlines and the
+        chaos failpoint fire exactly like the interpreted path."""
+        self._checkpoint(
+            f"level {parent.gq.alias or parent.gq.attr}")
+        for cgq in children:
+            cn = ExecNode(cgq, src=src)
+            if cgq.attr != "uid":
+                cn.tablet = self._tablet(cgq.attr)
+                if cn.tablet is not None:
+                    cn.lazy_cols = True
+            parent.children.append(cn)
+
+    def _ensure_child_values(self, ch: ExecNode):
+        """Materialize a lazily-deferred scalar child for consumers
+        that need per-uid values (the dict emitters); the columnar
+        JSON emitter never calls this on clean tablets. Reads the same
+        read_ts snapshot the eager path would have — MVCC makes the
+        deferral invisible."""
+        if not ch.lazy_cols:
+            return
+        ch.lazy_cols = False
+        tab, src = ch.tablet, ch.src
+        cv = self._colvals_for_emit(tab, ch.gq, src)
+        if cv is not None:
+            ch.col_vals = cv
+            return
+        if hasattr(tab, "prefetch_postings"):
+            tab.prefetch_postings(src)
+        get = tab.get_postings
+        for u in src.tolist():
+            ps = get(u, self.read_ts)
+            if ps:
+                ch.values[u] = ps
 
     def _expand_children(self, parent: ExecNode,
                          children: list[GraphQuery], src: np.ndarray):
@@ -3990,6 +4197,8 @@ class Executor:
             # empty selection: rows emit nothing (ref query0:
             # TestMultiEmptyBlocks -> "you": [])
             return []
+        for ch in node.children:
+            self._ensure_child_values(ch)
         fast = self._emit_block_flat(node)
         if fast is not None:
             return fast

@@ -66,7 +66,8 @@ class AlphaServer:
                  txn_ttl_s: float = 300.0,
                  acl_secret: Optional[bytes] = None,
                  mutations_mode: str = "allow",
-                 max_pending: int = 0):
+                 max_pending: int = 0,
+                 batch_window_us: int = 0):
         if mutations_mode not in ("allow", "disallow", "strict"):
             raise ValueError(
                 "--mutations argument must be one of allow, disallow, "
@@ -108,6 +109,15 @@ class AlphaServer:
         # monotonic: /health uptime is a DURATION — an NTP step must
         # not make it jump (same for the txn idle clocks below)
         self.started_at = time.monotonic()
+        # server-side micro-batching (engine/batcher.py): concurrent
+        # best-effort queries sharing a plan-cache key coalesce into
+        # one dispatch under ONE read-lock hold. 0 = off.
+        self.batcher = None
+        if batch_window_us > 0:
+            from dgraph_tpu.engine.batcher import MicroBatcher
+            self.batcher = MicroBatcher(
+                self.db, window_us=batch_window_us,
+                read_lock=lambda: self.rw.read)
         # ACL enforcement turns on when a secret is configured
         # (ref --acl_secret_file, dgraph/cmd/alpha/run.go flags)
         self.acl = None
@@ -308,6 +318,19 @@ class AlphaServer:
         with self._logged("query", ctx), self._admit(ctx):
             q, variables, ro_txn, be, pin_ts = self._query_prologue(
                 body, params, token)
+            if self.batcher is not None and ro_txn is None \
+                    and pin_ts is None:
+                # snapshot-unpinned, txn-free reads coalesce with
+                # concurrent same-plan requests; the batcher takes the
+                # read lock itself, once per batch, and serves every
+                # member at one shared read_ts drawn from the SAME
+                # source an unbatched dispatch would use now (strict:
+                # one fresh coordinator ts; best-effort: the
+                # watermark) — dispatch follows arrival, so each
+                # member still observes every commit that completed
+                # before it arrived
+                return self.batcher.query_json(q, variables, ctx=ctx,
+                                               best_effort=be)
             with self.rw.read:
                 return self.db.query_json(q, variables, txn=ro_txn,
                                           best_effort=be,
@@ -923,17 +946,19 @@ def serve(db: Optional[GraphDB] = None, host: str = "127.0.0.1",
           port: int = 8080, block: bool = True,
           acl_secret: Optional[bytes] = None,
           tls_context=None, mutations_mode: str = "allow",
-          max_pending: int = 0
+          max_pending: int = 0, batch_window_us: int = 0
           ) -> tuple[ThreadingHTTPServer, AlphaServer]:
     """Start the Alpha HTTP server. With block=False, runs in a daemon
     thread and returns (httpd, alpha) for tests/embedding. Pass an
     ssl.SSLContext (server/tls.py server_context) to serve HTTPS/mTLS
     like the reference's --tls options (x/tls_helper.go).
     `max_pending` bounds concurrently admitted requests (0 = off);
-    excess load sheds with 429."""
+    excess load sheds with 429. `batch_window_us` coalesces concurrent
+    same-plan queries into one dispatch (0 = off)."""
     alpha = AlphaServer(db, acl_secret=acl_secret,
                         mutations_mode=mutations_mode,
-                        max_pending=max_pending)
+                        max_pending=max_pending,
+                        batch_window_us=batch_window_us)
     handler = type("BoundHandler", (_Handler,), {"alpha": alpha})
     httpd = ThreadingHTTPServer((host, port), handler)
     if tls_context is not None:

@@ -42,6 +42,13 @@ REGISTERED = (
     # serving edge (server/http.py)
     "dgraph_pending_queries",
     "dgraph_queries_shed_total",
+    # compiled plan cache + micro-batcher (query/plan.py,
+    # engine/batcher.py)
+    "batch_dispatches",
+    "batch_occupancy",
+    "plan_cache_evictions",
+    "plan_cache_hits",
+    "plan_cache_misses",
     # query executor tier counters (query/executor.py)
     "query_columnar_var_bind_total",
     "query_colvar_hits_total",

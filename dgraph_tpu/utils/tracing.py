@@ -51,6 +51,7 @@ _enabled = True
 # on them), so dglint DG08 checks each literal span(...) name against
 # this tuple — a typo'd name forks a trace nobody queries. Keep sorted.
 SPAN_NAMES = (
+    "batch.wait",
     "block",
     "commit",
     "device.tile_load",
@@ -62,6 +63,7 @@ SPAN_NAMES = (
     "match",
     "mutate",
     "parse",
+    "plan.compile",
     "query",
     "raft.apply",
     "rpc.recv",

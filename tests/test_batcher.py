@@ -1,0 +1,266 @@
+"""Server-side micro-batching (engine/batcher.py): coalescing,
+single-flight demux, per-request deadline isolation, error scoping,
+and the serving-layer wiring."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from dgraph_tpu.engine.batcher import MicroBatcher
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.utils import metrics
+from dgraph_tpu.utils.reqctx import DeadlineExceeded, RequestContext
+
+SCHEMA = """
+name: string @index(exact, term) .
+age: int @index(int) .
+"""
+
+
+@pytest.fixture()
+def db():
+    db = GraphDB(prefer_device=False)
+    db.alter(schema_text=SCHEMA)
+    db.mutate(set_nquads="""
+        _:a <name> "alice" .
+        _:a <age> "30" .
+        _:b <name> "bob" .
+        _:b <age> "40" .
+    """, commit_now=True)
+    return db
+
+
+def _fanout(mb, jobs):
+    """Run jobs concurrently; returns list of (result | exception)."""
+    out = [None] * len(jobs)
+
+    def run(i, fn):
+        try:
+            out[i] = fn()
+        except BaseException as e:  # noqa: BLE001 — captured for asserts
+            out[i] = e
+
+    ts = [threading.Thread(target=run, args=(i, fn))
+          for i, fn in enumerate(jobs)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return out
+
+
+def _counter(name):
+    return metrics.counters_snapshot().get(name, 0)
+
+
+class TestCoalescing:
+    def test_identical_queries_single_flight(self, db):
+        q = '{ q(func: eq(name, "alice")) { uid name } }'
+        calls = []
+        inner = db.query_json
+
+        def counted(*a, **k):
+            calls.append(1)
+            return inner(*a, **k)
+
+        db.query_json = counted
+        mb = MicroBatcher(db, window_us=300_000, max_batch=4)
+        d0 = _counter("batch_dispatches")
+        outs = _fanout(mb, [lambda: mb.query_json(q)] * 4)
+        assert len(calls) == 1  # one execution for four requests
+        assert len({o for o in outs}) == 1  # byte-identical fan-out
+        assert json.loads(outs[0])["data"]["q"][0]["name"] == "alice"
+        assert _counter("batch_dispatches") - d0 == 1
+
+    def test_same_skeleton_distinct_params_one_batch(self, db):
+        qa = '{ q(func: eq(name, "alice")) { uid name } }'
+        qb = '{ q(func: eq(name, "bob")) { uid name } }'
+        mb = MicroBatcher(db, window_us=300_000, max_batch=2)
+        d0 = _counter("batch_dispatches")
+        outs = _fanout(mb, [lambda: mb.query_json(qa),
+                            lambda: mb.query_json(qb)])
+        names = sorted(json.loads(o)["data"]["q"][0]["name"]
+                       for o in outs)
+        assert names == ["alice", "bob"]  # demuxed per request
+        assert _counter("batch_dispatches") - d0 == 1
+
+    def test_batched_equals_unbatched_bytes(self, db):
+        queries = [
+            '{ q(func: eq(name, "alice")) { uid name age } }',
+            '{ q(func: eq(name, "bob")) { uid name age } }',
+            '{ q(func: ge(age, 0), orderasc: age) { name age } }',
+        ]
+        solo = {q: json.dumps(json.loads(db.query_json(q))["data"],
+                              sort_keys=True) for q in queries}
+        mb = MicroBatcher(db, window_us=200_000, max_batch=3)
+        outs = _fanout(mb, [lambda q=q: mb.query_json(q)
+                            for q in queries])
+        for q, o in zip(queries, outs):
+            got = json.dumps(json.loads(o)["data"], sort_keys=True)
+            assert got == solo[q], q
+
+    def test_occupancy_histogram_recorded(self, db):
+        q = '{ q(func: eq(name, "alice")) { uid } }'
+        mb = MicroBatcher(db, window_us=200_000, max_batch=3)
+        _fanout(mb, [lambda: mb.query_json(q)] * 3)
+        prom = metrics.render_prometheus()
+        assert "batch_occupancy" in prom
+
+    def test_window_zero_passthrough(self, db):
+        mb = MicroBatcher(db, window_us=0)
+        d0 = _counter("batch_dispatches")
+        out = mb.query_json('{ q(func: eq(name, "alice")) { name } }')
+        assert json.loads(out)["data"]["q"] == [{"name": "alice"}]
+        assert _counter("batch_dispatches") == d0
+
+    def test_strict_reads_batch_separately_with_fresh_ts(self, db):
+        """Strict (best_effort=False) members batch apart from
+        best-effort ones and read at ONE freshly allocated coordinator
+        ts — batching must not downgrade a linearizable read to the
+        local watermark."""
+        q = '{ q(func: eq(name, "alice")) { uid } }'
+        mb = MicroBatcher(db, window_us=200_000, max_batch=2)
+        watermark = db.coordinator.max_assigned()
+        outs = _fanout(mb, [
+            lambda: mb.query_json(q, best_effort=False)] * 2)
+        ts = {json.loads(o)["extensions"]["txn"]["start_ts"]
+              for o in outs}
+        assert len(ts) == 1  # single-flighted at one shared ts
+        assert ts.pop() > watermark  # freshly allocated, not watermark
+
+    def test_shared_snapshot_single_ts(self, db):
+        q = '{ q(func: eq(name, "alice")) { uid } }'
+        mb = MicroBatcher(db, window_us=200_000, max_batch=2)
+        outs = _fanout(mb, [lambda: mb.query_json(q),
+                            lambda: mb.query_json(
+                                '{ q(func: eq(name, "bob")) { uid } }')])
+        ts = {json.loads(o)["extensions"]["txn"]["start_ts"]
+              for o in outs}
+        assert len(ts) == 1  # one MVCC snapshot for the batch
+
+
+class TestDeadlines:
+    def test_deadline_expires_queued_returns_408_without_poisoning(
+            self, db):
+        """A member whose deadline lapses while its batch is stalled
+        behind the read lock (a long write ahead of it) gets its
+        DeadlineExceeded; the other member still answers once the
+        lock frees — the batch is not poisoned."""
+        from contextlib import contextmanager
+
+        q = '{ q(func: eq(name, "alice")) { uid name } }'
+        stall = threading.Lock()
+
+        @contextmanager
+        def stalled_lock():
+            with stall:
+                yield
+
+        mb = MicroBatcher(db, window_us=10_000, max_batch=8,
+                          read_lock=stalled_lock)
+        stall.acquire()  # a "writer" holds the lock
+        try:
+            results = [None, None]
+
+            def submit(i, ctx):
+                try:
+                    results[i] = mb.query_json(q, ctx=ctx)
+                except BaseException as e:  # noqa: BLE001
+                    results[i] = e
+
+            t1 = threading.Thread(
+                target=submit, args=(0, None))
+            t2 = threading.Thread(
+                target=submit,
+                args=(1, RequestContext.with_timeout(0.08)))
+            t1.start()
+            t2.start()
+            time.sleep(0.25)  # member 1's deadline lapses while queued
+        finally:
+            stall.release()
+        t1.join()
+        t2.join()
+        assert isinstance(results[1], DeadlineExceeded)
+        assert isinstance(results[0], str)
+        assert json.loads(results[0])["data"]["q"][0]["name"] == "alice"
+
+    def test_tight_deadline_cuts_window_short(self, db):
+        """A follower with less headroom than the window forces the
+        dispatch early instead of dying queued."""
+        q = '{ q(func: eq(name, "alice")) { uid } }'
+        mb = MicroBatcher(db, window_us=5_000_000, max_batch=8)
+        ctx = RequestContext.with_timeout(1.0)
+        t0 = time.monotonic()
+        outs = _fanout(mb, [lambda: mb.query_json(q),
+                            lambda: mb.query_json(q, ctx=ctx)])
+        assert time.monotonic() - t0 < 4.0
+        assert all(isinstance(o, str) for o in outs)
+
+    def test_already_dead_ctx(self, db):
+        q = '{ q(func: eq(name, "alice")) { uid } }'
+        mb = MicroBatcher(db, window_us=50_000, max_batch=8)
+        ctx = RequestContext.with_timeout(0.0)
+        time.sleep(0.002)
+        with pytest.raises(DeadlineExceeded):
+            mb.query_json(q, ctx=ctx)
+
+
+class TestErrors:
+    def test_bad_query_scoped_to_its_group(self, db):
+        good = '{ q(func: eq(name, "alice")) { uid name } }'
+        # executes but fails the schema check (age has no term index)
+        bad = '{ q(func: anyofterms(age, "x")) { uid } }'
+        mb = MicroBatcher(db, window_us=200_000, max_batch=2)
+        # bad query groups separately (different skeleton), so use
+        # two batches: the failure must not leak anywhere
+        outs = _fanout(mb, [lambda: mb.query_json(good),
+                            lambda: mb.query_json(bad)])
+        ok = [o for o in outs if isinstance(o, str)]
+        err = [o for o in outs if isinstance(o, Exception)]
+        assert len(ok) == 1 and len(err) == 1
+        assert json.loads(ok[0])["data"]["q"]
+
+    def test_identical_bad_queries_share_error(self, db):
+        bad = '{ q(func: anyofterms(age, "x")) { uid } }'
+        mb = MicroBatcher(db, window_us=200_000, max_batch=2)
+        outs = _fanout(mb, [lambda: mb.query_json(bad)] * 2)
+        assert all(isinstance(o, Exception) for o in outs)
+
+    def test_unparseable_query_raises_solo(self, db):
+        from dgraph_tpu.gql.parser import GQLError
+        mb = MicroBatcher(db, window_us=200_000, max_batch=2)
+        with pytest.raises(GQLError):
+            mb.query_json("{ q(func: eq(name", None)
+
+
+class TestServerWiring:
+    def test_alpha_batches_best_effort_reads(self, db):
+        from dgraph_tpu.server.http import AlphaServer
+        alpha = AlphaServer(db, batch_window_us=100_000)
+        assert alpha.batcher is not None
+        d0 = _counter("batch_dispatches")
+        outs = _fanout(alpha.batcher, [
+            lambda: alpha.handle_query_json(
+                '{ q(func: eq(name, "alice")) { name } }', {}),
+            lambda: alpha.handle_query_json(
+                '{ q(func: eq(name, "bob")) { name } }', {}),
+        ])
+        assert all(isinstance(o, str) for o in outs)
+        assert _counter("batch_dispatches") - d0 == 1
+
+    def test_pinned_reads_bypass_batcher(self, db):
+        from dgraph_tpu.server.http import AlphaServer
+        alpha = AlphaServer(db, batch_window_us=100_000)
+        d0 = _counter("batch_dispatches")
+        ts = db.coordinator.max_assigned()
+        out = alpha.handle_query_json(
+            '{ q(func: eq(name, "alice")) { name } }',
+            {"startTs": str(ts)})
+        assert json.loads(out)["data"]["q"] == [{"name": "alice"}]
+        assert _counter("batch_dispatches") == d0  # solo path
+
+    def test_default_off(self, db):
+        from dgraph_tpu.server.http import AlphaServer
+        assert AlphaServer(db).batcher is None

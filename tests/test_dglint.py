@@ -195,6 +195,74 @@ class TestRecompileHazard:
         """
         assert "DG02" not in codes(run_fixture(src))
 
+    # -- the plan-cache seam: per-call wrap-and-invoke ----------------
+
+    def test_wrap_and_invoke_in_function(self):
+        """`g = jax.jit(...)` invoked in the same function body is a
+        fresh wrapper per call — must route through query/plan.py's
+        jit_stage or cache the wrapper."""
+        src = """
+            import jax
+
+            def hot(x):
+                g = jax.jit(lambda v: v + 1)
+                return g(x)
+        """
+        found = run_fixture(src)
+        assert "DG02" in codes(found)
+        assert any("jit_stage" in f.message for f in found)
+
+    def test_wrap_and_invoke_suppressed(self):
+        src = """
+            import jax
+
+            def hot(x):
+                g = jax.jit(lambda v: v + 1)  # dglint: disable=DG02
+                return g(x)
+        """
+        assert "DG02" not in codes(run_fixture(src))
+
+    def test_wrap_with_cache_insert_clean(self):
+        """The hoist-and-cache pattern (wrapper stored into a caller-
+        owned cache) is exactly what the rule asks for — exempt."""
+        src = """
+            import jax
+
+            CACHE = {}
+
+            def hot(x, k):
+                fn = CACHE.get(k)
+                if fn is None:
+                    fn = jax.jit(lambda v: v + 1)
+                    CACHE[k] = fn
+                return fn(x)
+        """
+        assert "DG02" not in codes(run_fixture(src))
+
+    def test_wrap_factory_return_clean(self):
+        """Factories that BUILD and return a jitted callable (caller
+        caches) do not invoke it — clean."""
+        src = """
+            import jax
+
+            def make(depth):
+                def step(x):
+                    return x + depth
+                return jax.jit(step)
+        """
+        assert "DG02" not in codes(run_fixture(src))
+
+    def test_wrap_and_invoke_sanctioned_in_plan_module(self):
+        src = """
+            import jax
+
+            def jit_stage_build(x):
+                g = jax.jit(lambda v: v + 1)
+                return g(x)
+        """
+        assert "DG02" not in codes(run_fixture(
+            src, rel="dgraph_tpu/query/plan.py"))
+
 
 # ------------------------------------------------------------------ DG03
 

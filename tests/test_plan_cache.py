@@ -1,0 +1,299 @@
+"""Compiled plan cache (query/plan.py): skeleton canonicalization,
+cache keying (schema epoch, mesh), parameter memo isolation, LRU
+accounting, the sanctioned jit seam, and end-to-end equivalence of
+the compiled dispatch vs the interpreted path."""
+
+import json
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.gql import parse
+from dgraph_tpu.query.plan import (
+    PlanCache, jit_stage, shape_bucket, skeleton,
+)
+from dgraph_tpu.utils import metrics
+
+SCHEMA = """
+name: string @index(exact, term) @lang .
+age: int @index(int) .
+score: float @index(float) .
+follows: [uid] @reverse .
+"""
+
+
+def _db(**kw):
+    db = GraphDB(prefer_device=False, **kw)
+    db.alter(schema_text=SCHEMA)
+    db.mutate(set_nquads="""
+        _:a <name> "alice" .
+        _:a <age> "30" .
+        _:a <score> "1.5" .
+        _:b <name> "bob" .
+        _:b <age> "40" .
+        _:a <follows> _:b .
+    """, commit_now=True)
+    return db
+
+
+def _counter(name):
+    return metrics.counters_snapshot().get(name, 0)
+
+
+# ------------------------------------------------------------ skeleton
+
+
+class TestSkeleton:
+    def test_literals_hoist_to_params(self):
+        s1, p1 = skeleton(parse('{ q(func: eq(name, "alice")) { uid } }'))
+        s2, p2 = skeleton(parse('{ q(func: eq(name, "bob")) { uid } }'))
+        assert s1 == s2
+        assert p1 != p2
+
+    def test_uid_literals_hoist(self):
+        s1, _ = skeleton(parse('{ q(func: uid(0x1)) { uid } }'))
+        s2, _ = skeleton(parse('{ q(func: uid(0x2, 0x3)) { uid } }'))
+        assert s1 == s2
+
+    def test_pagination_values_hoist(self):
+        s1, _ = skeleton(parse('{ q(func: has(name), first: 5) { uid } }'))
+        s2, _ = skeleton(parse('{ q(func: has(name), first: 9) { uid } }'))
+        assert s1 == s2
+        # first PRESENT vs ABSENT is structure, not a parameter
+        s3, _ = skeleton(parse('{ q(func: has(name)) { uid } }'))
+        assert s1 != s3
+
+    def test_structure_differs(self):
+        base = parse('{ q(func: eq(name, "x")) { uid } }')
+        for other in (
+                '{ q(func: eq(age, "x")) { uid } }',      # attr
+                '{ q(func: le(name, "x")) { uid } }',     # function
+                '{ q(func: eq(name, "x")) { uid name } }',  # selection
+                '{ r(func: eq(name, "x")) { uid } }',     # alias
+                '{ q(func: eq(name, "x")) @filter(has(age)) { uid } }',
+                '{ q(func: eq(name, "x"), orderasc: age) { uid } }',
+        ):
+            assert skeleton(base)[0] != skeleton(parse(other))[0], other
+
+    def test_filter_literals_hoist(self):
+        q = '{ q(func: has(name)) @filter(ge(age, %d)) { uid } }'
+        assert skeleton(parse(q % 10))[0] == skeleton(parse(q % 99))[0]
+
+    def test_variables_bind_as_params(self):
+        q = 'query me($a: string) { q(func: eq(name, $a)) { uid } }'
+        s1, p1 = skeleton(parse(q, {"$a": "alice"}))
+        s2, p2 = skeleton(parse(q, {"$a": "bob"}))
+        assert s1 == s2 and p1 != p2
+
+    def test_structure_hashable(self):
+        s, _ = skeleton(parse(
+            '{ s as shortest(from: 0x1, to: 0x2) { follows } '
+            '  q(func: uid(s)) { name } }'))
+        hash(s)
+        s2, _ = skeleton(parse("schema {}"))
+        hash(s2)
+
+
+# ------------------------------------------------------------ caching
+
+
+class TestPlanCache:
+    def test_same_skeleton_hits(self):
+        db = _db()
+        h0, m0 = _counter("plan_cache_hits"), _counter("plan_cache_misses")
+        db.query('{ q(func: eq(name, "alice")) { uid name } }')
+        db.query('{ q(func: eq(name, "bob")) { uid name } }')
+        db.query('{ q(func: eq(name, "alice")) { uid name } }')
+        assert _counter("plan_cache_misses") - m0 == 1
+        assert _counter("plan_cache_hits") - h0 == 2
+
+    def test_alter_invalidates(self):
+        db = _db()
+        q = '{ q(func: eq(name, "alice")) { uid name } }'
+        db.query(q)
+        m0 = _counter("plan_cache_misses")
+        epoch = db.schema_epoch
+        db.alter(schema_text="city: string @index(exact) .")
+        assert db.schema_epoch == epoch + 1
+        out = db.query(q)
+        assert _counter("plan_cache_misses") - m0 == 1
+        assert out["data"]["q"][0]["name"] == "alice"
+
+    def test_drop_attr_and_drop_all_bump_epoch(self):
+        db = _db()
+        e0 = db.schema_epoch
+        db.alter(drop_attr="score")
+        assert db.schema_epoch == e0 + 1
+        db.alter(drop_all=True)
+        assert db.schema_epoch == e0 + 2
+
+    def test_schema_change_reflected_after_invalidation(self):
+        """A tokenizer change must re-derive cached token analysis:
+        results after alter match a cold engine, not the old plan."""
+        db = _db()
+        q = '{ q(func: eq(name, "alice")) { uid } }'
+        assert db.query(q)["data"]["q"]
+        db.alter(schema_text="name: string @index(term) @lang .")
+        assert db.query(q)["data"]["q"]  # re-derived, still correct
+
+    def test_lru_evicts_and_counts(self):
+        db = _db(plan_cache_size=2)
+        e0 = _counter("plan_cache_evictions")
+        db.query('{ a(func: eq(name, "x")) { uid } }')
+        db.query('{ b(func: eq(age, 1)) { uid } }')
+        db.query('{ c(func: eq(score, 1.0)) { uid } }')
+        assert _counter("plan_cache_evictions") - e0 == 1
+        assert db.plan_cache.stats()["plans"] == 2
+
+    def test_disabled_cache(self):
+        db = GraphDB(prefer_device=False, plan_cache_size=0)
+        db.alter(schema_text=SCHEMA)
+        m0 = _counter("plan_cache_misses")
+        db.mutate(set_nquads='_:a <name> "zed" .', commit_now=True)
+        out = db.query('{ q(func: eq(name, "zed")) { name } }')
+        assert out["data"]["q"] == [{"name": "zed"}]
+        assert db.plan_cache is None
+        assert _counter("plan_cache_misses") == m0
+
+    def test_memo_keys_isolate_params(self):
+        """Two literal bindings of one skeleton must never read each
+        other's memoized artifacts (tokens, ineq bounds)."""
+        db = _db()
+        q = '{ q(func: eq(name, "%s")) { uid name } }'
+        a = db.query(q % "alice")["data"]["q"]
+        b = db.query(q % "bob")["data"]["q"]
+        a2 = db.query(q % "alice")["data"]["q"]
+        assert a == a2
+        assert a[0]["name"] == "alice" and b[0]["name"] == "bob"
+        r = '{ q(func: has(age)) @filter(ge(age, %d)) { uid age } }'
+        assert len(db.query(r % 35)["data"]["q"]) == 1
+        assert len(db.query(r % 10)["data"]["q"]) == 2
+        assert len(db.query(r % 35)["data"]["q"]) == 1
+
+    def test_state_reports_plan_cache(self):
+        db = _db()
+        db.query('{ q(func: has(name)) { uid } }')
+        st = db.state()
+        assert st["planCache"]["plans"] >= 1
+        assert st["schemaEpoch"] == db.schema_epoch
+
+
+# ----------------------------------------------------- compiled = exact
+
+
+PARITY_QUERIES = [
+    '{ q(func: eq(name, "alice")) { uid name age score } }',
+    '{ q(func: has(name), orderasc: age) { name age } }',
+    '{ q(func: anyofterms(name, "alice bob")) '
+    '@filter(ge(age, 35)) { uid name } }',
+    '{ q(func: has(follows)) { name follows { name } } }',
+    '{ q(func: ge(age, 0), first: 1, offset: 1) { name } }',
+    '{ q(func: has(name)) @filter(regexp(name, /ali.*/)) { name } }',
+    '{ q(func: uid(0x1, 0x2)) { count(uid) } }',
+]
+
+
+class TestCompiledParity:
+    def test_compiled_vs_interpreted_byte_identical(self):
+        db = _db()
+        for q in PARITY_QUERIES:
+            pc = db.plan_cache
+            db.plan_cache = None
+            interp = json.dumps(db.query(q)["data"], sort_keys=True)
+            interp_json = json.loads(db.query_json(q))["data"]
+            db.plan_cache = pc
+            cold = json.dumps(db.query(q)["data"], sort_keys=True)
+            warm = json.dumps(db.query(q)["data"], sort_keys=True)
+            warm_json = json.loads(db.query_json(q))["data"]
+            assert interp == cold == warm, q
+            assert interp_json == warm_json, q
+
+    def test_dirty_overlay_falls_back_exact(self):
+        """MVCC overlay reads through a warm plan stay exact: the plan
+        caches structure, never data."""
+        db = _db()
+        q = '{ q(func: eq(name, "carol")) { uid name age } }'
+        assert db.query(q)["data"]["q"] == []  # warm the plan
+        db.mutate(set_nquads='_:c <name> "carol" .\n_:c <age> "7" .',
+                  commit_now=True)
+        got = db.query(q)["data"]["q"]  # dirty tablet, same plan
+        assert got[0]["name"] == "carol" and got[0]["age"] == 7
+
+    def test_snapshot_reads_unaffected(self):
+        db = _db()
+        q = '{ q(func: has(name)) { count(uid) } }'
+        before = db.coordinator.max_assigned()
+        assert db.query(q)["data"]["q"] == [{"count": 2}]
+        db.mutate(set_nquads='_:d <name> "dave" .', commit_now=True)
+        assert db.query(q)["data"]["q"] == [{"count": 3}]
+        old = db.query(q, read_ts=before)["data"]["q"]
+        assert old == [{"count": 2}]  # pinned snapshot through warm plan
+
+
+# ------------------------------------------------------------ jit seam
+
+
+class TestJitSeam:
+    def test_jit_stage_builds_once(self):
+        calls = []
+
+        def build():
+            calls.append(1)
+            return lambda x: x + 1
+
+        f1 = jit_stage("test.stage_once", build)
+        f2 = jit_stage("test.stage_once", build)
+        assert f1 is f2 and len(calls) == 1
+        assert jit_stage("test.stage_once", build, static=(4,))(1) == 2
+        assert len(calls) == 2  # distinct static key compiles anew
+
+    def test_shape_bucket_pow2(self):
+        assert shape_bucket(0) == 8  # floor
+        assert shape_bucket(1) == 8
+        assert shape_bucket(8) == 8
+        assert shape_bucket(9) == 16
+        assert shape_bucket(1000) == 1024
+        assert shape_bucket(1024) == 1024
+        assert shape_bucket(1025) == 2048
+
+    def test_setops_device_matches_host(self):
+        """The jitted device set-algebra chain stays byte-exact vs the
+        host fold across bucket boundaries (len 0/1/edge)."""
+        from dgraph_tpu.ops import setops
+        rng = np.random.default_rng(7)
+        for sizes in ([0, 1], [1, 7, 8], [9, 16, 17], [5, 1000, 3]):
+            parts = [np.unique(rng.integers(0, 5000, s).astype(np.uint64))
+                     for s in sizes]
+            host = setops.union_many(parts)
+            dev = setops.union_many_device(parts)
+            if dev is not None:
+                np.testing.assert_array_equal(host, dev)
+            live = [p for p in parts if len(p)]
+            if len(live) >= 2:
+                hosti = setops.intersect_many(parts)
+                devi = setops.intersect_many_device(parts)
+                if devi is not None:
+                    np.testing.assert_array_equal(hosti, devi)
+
+
+# ------------------------------------------------------------ parse LRU
+
+
+class TestParseCache:
+    def test_parse_cached_by_text_and_vars(self):
+        pc = PlanCache(8)
+        q = 'query me($a: string) { q(func: eq(name, $a)) { uid } }'
+        p1, s1, h1 = pc.parse(q, {"$a": "x"})
+        p2, s2, h2 = pc.parse(q, {"$a": "x"})
+        assert p1 is p2
+        p3, _s3, h3 = pc.parse(q, {"$a": "y"})
+        assert p3 is not p1 and h3 == h1  # same skeleton, new binding
+
+    def test_parse_errors_not_cached(self):
+        pc = PlanCache(8)
+        from dgraph_tpu.gql.parser import GQLError
+        for _ in range(2):
+            with pytest.raises(GQLError):
+                pc.parse("{ q(func: eq(name", None)
+        assert pc.stats()["parses"] == 0

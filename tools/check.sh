@@ -27,6 +27,11 @@ echo "== import-warnings sweep =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -W error::DeprecationWarning -c "import dgraph_tpu"
 
+echo "== plan-cache smoke =="
+# compile one skeleton, assert the second run hits with zero retrace
+# (silent cache-key regressions surface as p99 cliffs, not failures)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.plan_smoke
+
 echo "== span overhead =="
 # per-span tracing cost vs the 5 µs budget (spans sit on executor hot
 # paths; tests/test_tracing.py enforces the same budget with CI slack)

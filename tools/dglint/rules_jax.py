@@ -190,12 +190,55 @@ def _validate_static_args(ctx: FileContext, call_or_dec: ast.Call,
                 "donated — a static arg has no buffer to donate")
 
 
+# the one sanctioned home for dynamic (in-function) jit wrapping: the
+# compiled-plan cache's process-global executable registry
+# (query/plan.py jit_stage). Anything else that wraps-and-invokes in
+# one function body rebuilds the wrapper per call.
+_JIT_SEAM = "dgraph_tpu/query/plan.py"
+
+
+def _wrap_and_invoke(ctx: FileContext, fn: FuncDef):
+    """`g = jax.jit(...)` then `g(...)` inside ONE function body: a
+    fresh wrapper per call, the exact recompile hazard the plan-cache
+    seam exists to absorb. A name that is also stored into a subscript
+    or attribute (a caller-owned cache insert) is exempt — that is the
+    hoist-and-cache pattern the rule asks for."""
+    jit_names: dict[str, ast.Call] = {}
+    cached: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            if call_name(node.value) in _JIT_NAMES:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jit_names[t.id] = node.value
+                continue
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Name):
+            for t in node.targets:
+                if isinstance(t, (ast.Subscript, ast.Attribute)):
+                    cached.add(node.value.id)
+    for call in walk_calls(fn):
+        name = call.func.id if isinstance(call.func, ast.Name) else None
+        if name in jit_names and name not in cached:
+            yield ctx.finding(
+                "DG02", jit_names[name],
+                f"`{name} = jax.jit(...)` is invoked in the same "
+                f"function — a fresh wrapper retraces per call; route "
+                f"dynamic jit through the plan cache's jit_stage "
+                f"({_JIT_SEAM}) or cache the wrapper")
+
+
 @register("DG02", "recompile-hazard", scopes=("dgraph_tpu/",))
 def check_recompile_hazard(ctx: FileContext):
     """`static_argnums`/`static_argnames` must match the wrapped
     signature, and a jit wrapper must not be rebuilt per call
-    (`jax.jit(f)(x)` immediately invoked, or `jax.jit` inside a loop)
-    — every rebuild retraces and recompiles."""
+    (`jax.jit(f)(x)` immediately invoked, `jax.jit` inside a loop, or
+    wrap-and-invoke inside one function body) — every rebuild
+    retraces and recompiles. Dynamic jit belongs behind the plan
+    cache's `jit_stage` seam (query/plan.py) — exempt from the
+    wrap-and-invoke sub-check ONLY; its static-arg validation and
+    loop hazards stay linted like everywhere else."""
     defs = _module_defs(ctx.tree)
     for fn in iter_funcdefs(ctx.tree):
         for dec in fn.decorator_list:
@@ -231,3 +274,16 @@ def check_recompile_hazard(ctx: FileContext):
                     "DG02", call,
                     "jax.jit called inside a loop — hoist and cache "
                     "the wrapper, or each iteration recompiles")
+    # wrap-and-invoke inside one function body (the plan-cache seam
+    # rule): dedupe across nested defs — ast.walk sees a nested def's
+    # body from the enclosing def too. The seam module itself is the
+    # sanctioned home for this pattern.
+    if ctx.rel.replace("\\", "/").endswith(_JIT_SEAM):
+        return
+    seen_lines: set[tuple] = set()
+    for fn in iter_funcdefs(ctx.tree):
+        for f in _wrap_and_invoke(ctx, fn):
+            key = (f.line, f.message)
+            if key not in seen_lines:
+                seen_lines.add(key)
+                yield f

@@ -1,0 +1,72 @@
+"""Plan-cache smoke gate (tools/check.sh): compile one skeleton,
+assert the second run is a cache hit with zero retrace.
+
+Catches silent cache-key regressions — a skeleton that stops hashing
+stably (every request a miss), an epoch key that churns without
+schema changes, or a jit seam that rebuilds executables per call —
+before they show up as a p99 cliff in production.
+"""
+
+import sys
+
+
+def main() -> int:
+    import numpy as np
+
+    from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu.ops import setops
+    from dgraph_tpu.query.plan import jit_stage_stats
+    from dgraph_tpu.utils import metrics
+
+    db = GraphDB(prefer_device=False)
+    db.alter(schema_text="name: string @index(exact) .")
+    db.mutate(set_nquads='_:a <name> "smoke" .', commit_now=True)
+
+    def counters():
+        c = metrics.counters_snapshot()
+        return (c.get("plan_cache_hits", 0),
+                c.get("plan_cache_misses", 0))
+
+    q = '{ q(func: eq(name, "%s")) { uid name } }'
+    h0, m0 = counters()
+    db.query(q % "smoke")  # cold: parse + plan compile
+    h1, m1 = counters()
+    assert m1 == m0 + 1 and h1 == h0, \
+        f"cold run should be exactly one miss (hits {h1-h0}, " \
+        f"misses {m1-m0})"
+    out = db.query(q % "other")  # same skeleton, new literal
+    h2, m2 = counters()
+    assert h2 == h1 + 1 and m2 == m1, \
+        f"warm run must hit (hits {h2-h1}, misses {m2-m1})"
+    assert out["data"]["q"] == []  # bound the NEW literal, not the memo
+    assert db.query(q % "smoke")["data"]["q"][0]["name"] == "smoke"
+
+    # the jit seam compiles once per (stage, bucket): a second
+    # identical device dispatch must not grow the executable registry
+    parts = [np.asarray([1, 5, 9], np.uint64),
+             np.asarray([2, 5], np.uint64)]
+    first = setops.union_many_device(parts)
+    n_exec = jit_stage_stats()["executables"]
+    second = setops.union_many_device(parts)
+    assert jit_stage_stats()["executables"] == n_exec, \
+        "repeated dispatch grew the jit registry: retrace per call"
+    if first is not None:
+        np.testing.assert_array_equal(first, second)
+
+    # schema alter bumps the epoch: exactly one recompile, then warm
+    db.alter(schema_text="age: int @index(int) .")
+    db.query(q % "smoke")
+    h3, m3 = counters()
+    assert m3 == m2 + 1, "alter must invalidate (one new miss)"
+    db.query(q % "smoke")
+    h4, m4 = counters()
+    assert h4 == h3 + 1 and m4 == m3, "post-alter plan must re-warm"
+
+    print("plan-cache smoke: ok "
+          f"(hits {h4-h0}, misses {m4-m0}, "
+          f"jit executables {jit_stage_stats()['executables']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
