@@ -145,9 +145,15 @@ class ClusterClient:
             return max(0.001, deadline - time.monotonic()) \
                 if bounded else None
 
-        with self._lock:
-            last_err = "unreachable"
-            while time.monotonic() < deadline:
+        last_err = "unreachable"
+        while time.monotonic() < deadline:
+            # one full routed pass per lock hold; the between-pass
+            # backoff sleeps OUTSIDE the lock (DG04 — a concurrent
+            # caller must be able to route while this one backs off),
+            # and each reacquisition recomputes the candidate order
+            # from the CURRENT _preferred/_down/addrs state, which may
+            # have moved while we slept
+            with self._lock:
                 order = [n for n in
                          ([self._preferred] + sorted(self.addrs))
                          if n is not None]
@@ -182,17 +188,17 @@ class ClusterClient:
                                 return hinted
                         continue
                     return resp  # real application error: surface it
-                last_err = "no leader reachable"
-                # never sleep past the deadline the caller set
-                time.sleep(min(0.1, max(0.0,
-                                        deadline - time.monotonic())))
-            # with a caller-supplied budget this is EXPIRY, not a
-            # generic routing failure: the marker lets _unwrap raise
-            # DeadlineExceeded so the HTTP edge answers 408 retryable
-            # instead of 500 (elections in progress eat exactly this
-            # path)
-            return {"ok": False, "error": last_err,
-                    "deadline_expired": bounded}
+            last_err = "no leader reachable"
+            # never sleep past the deadline the caller set
+            time.sleep(min(0.1, max(0.0,
+                                    deadline - time.monotonic())))
+        # with a caller-supplied budget this is EXPIRY, not a
+        # generic routing failure: the marker lets _unwrap raise
+        # DeadlineExceeded so the HTTP edge answers 408 retryable
+        # instead of 500 (elections in progress eat exactly this
+        # path)
+        return {"ok": False, "error": last_err,
+                "deadline_expired": bounded}
 
     def close(self):
         with self._lock:

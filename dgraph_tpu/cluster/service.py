@@ -1459,6 +1459,29 @@ class AlphaServer(RaftServer):
                     "tablets": sorted(self.db.tablets),
                     "pending": sorted(self.db.pending_txns),
                     "max_ts": self.db.coordinator.max_assigned()}}
+        if op == "stats":
+            # the wire analogue of HTTP /debug/stats (same payload,
+            # histograms included), bundled with the request log and
+            # counter snapshot so one poll carries a node's whole
+            # observability surface over the cluster wire alone
+            # (tools/dgtop.py itself polls the HTTP endpoints)
+            from dgraph_tpu.utils import metrics, reqlog
+            # self.lock only pins the db BINDING (restore rebinds it);
+            # the stats walk itself runs unlocked — a cold cache
+            # recomputes O(postings) aggregates, and holding the Raft
+            # state lock for that would stall apply/commit into
+            # election timeouts. debug_stats retries/degrades on
+            # concurrent-apply races: a skewed count is fine, a
+            # stalled quorum is not.
+            with self.lock:
+                db = self.db
+            stats = db.debug_stats()
+            stats["node"] = self.node_name
+            stats["group"] = self.group
+            stats["requests"] = reqlog.snapshot()
+            stats["counters"] = metrics.counters_snapshot()
+            stats["histograms"] = metrics.histograms_snapshot()
+            return {"ok": True, "result": stats}
         if op == "export_tablet":
             # tablet move, source side (worker/predicate_move.go:81).
             # _write_lock serializes against in-flight writes: anything

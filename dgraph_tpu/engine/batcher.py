@@ -36,14 +36,20 @@ snapshot source.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from contextlib import nullcontext
 from typing import Any, Callable, Optional
 
-from dgraph_tpu.utils import metrics
+from dgraph_tpu.utils import metrics, reqlog
 from dgraph_tpu.utils.reqctx import DeadlineExceeded, RequestAborted
 from dgraph_tpu.utils.tracing import span as _span
+
+# process-wide batch-dispatch ids: reqlog records made inside a
+# dispatch carry `batch_id` so /debug/requests joins against the
+# micro-batcher (which members shared a dispatch, what it cost each)
+_BATCH_SEQ = itertools.count(1)
 
 
 class _Member:
@@ -167,6 +173,7 @@ class MicroBatcher:
     def _dispatch(self, members: list[_Member]):
         metrics.inc_counter("batch_dispatches")
         metrics.observe("batch_occupancy", float(len(members)))
+        batch_id = f"b{next(_BATCH_SEQ):06x}"
         # members that died while queued answer 408/499 immediately
         # and drop out; the batch itself is unaffected
         live: dict[tuple, list[_Member]] = {}
@@ -182,7 +189,7 @@ class MicroBatcher:
         lock_cm = self.read_lock() if self.read_lock is not None \
             else nullcontext()
         try:
-            with lock_cm:
+            with lock_cm, reqlog.bind_batch(batch_id):
                 # one snapshot for the whole batch, from the same
                 # source an unbatched dispatch would use NOW: strict
                 # batches allocate ONE fresh ts at the coordinator

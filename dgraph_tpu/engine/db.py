@@ -38,13 +38,20 @@ from dgraph_tpu.models.schema import (
 from dgraph_tpu.models.types import TypeID, Val, convert
 from dgraph_tpu.storage.tablet import EdgeOp, Posting, Tablet
 from dgraph_tpu.storage.wal import Wal
-from dgraph_tpu.utils import metrics, reqlog
+from dgraph_tpu.utils import coststore, metrics, reqlog
 from dgraph_tpu.utils.tracing import bind_request, span as _span
 
 # process-wide measured device dispatch RTT (device_dispatch_seconds)
 _DISPATCH_SECONDS: float | None = None
 # process-wide backend probe (device_is_accelerator)
 _IS_ACCELERATOR: bool | None = None
+
+
+def _skel_of(plan) -> str:
+    """A plan's 16-hex skeleton hash ("" on the interpreted path) —
+    the shared join key across the coststore, the request log and
+    EXPLAIN output."""
+    return f"{plan.skeleton_hash:016x}" if plan is not None else ""
 
 
 def _fp(*parts) -> int:
@@ -193,6 +200,18 @@ class GraphDB:
         # optional record sink: Raft replication taps the same durable
         # record stream the WAL gets (cluster/replica.py)
         self.on_record = None
+        # observed-cost persistence: a store-backed engine reloads the
+        # coststore's stage-duration table at boot (merge, never
+        # truncate) and saves at checkpoint/close, so the planner's
+        # observations survive restarts. The table is process-global
+        # (spans carry no engine identity): at most one store-backed
+        # engine per process, or their files cross-pollinate
+        self._coststore_path = None
+        if store_dir is not None:
+            import os as _os
+            self._coststore_path = _os.path.join(store_dir,
+                                                 "coststore.json")
+            coststore.load(self._coststore_path)
         if self.wal:
             self._replay()
 
@@ -774,6 +793,12 @@ class GraphDB:
         """Flush and close the WAL (the reference's alpha shutdown
         closes its Badger stores); the engine object stays queryable
         in memory but stops persisting."""
+        if self._coststore_path is not None:
+            try:
+                coststore.save(self._coststore_path)
+            except OSError:
+                pass  # stats are advisory; shutdown must not fail
+            self._coststore_path = None
         if self.tablet_store is not None:
             self.tablets.flush_all()
             self.tablet_store.close()
@@ -795,6 +820,11 @@ class GraphDB:
             raise RuntimeError("checkpoint() needs store_dir")
         self.tablets.flush_all()
         self.tablet_store.compact()
+        if self._coststore_path is not None:
+            try:
+                coststore.save(self._coststore_path)
+            except OSError:
+                pass
 
     def fast_forward_ts(self, max_ts: int):
         """Advance the ts counter past replayed/replicated commits."""
@@ -813,18 +843,27 @@ class GraphDB:
 
     def query(self, q: str, variables: dict | None = None,
               txn: Optional[Txn] = None, best_effort: bool = True,
-              read_ts: Optional[int] = None, ctx=None) -> dict:
+              read_ts: Optional[int] = None, ctx=None,
+              explain: Optional[str] = None) -> dict:
         """`read_ts` pins the MVCC snapshot to an externally issued
         timestamp (a zero-global ts for cross-group reads); otherwise
         best_effort reads at max_assigned and strict reads allocate.
         `ctx` (utils/reqctx.RequestContext) carries the request's
         deadline/cancellation into the executor AND its trace ids:
-        spans opened anywhere below join the request's trace."""
+        spans opened anywhere below join the request's trace.
+        `explain` ("plan" | "analyze", or the in-query `@explain`
+        flag) attaches the compiled plan tree — with stats-estimated
+        rows, and for analyze the observed rows/durations/tier
+        counters — under `extensions.explain`. The DATA payload is
+        byte-identical with or without it: explain annotates a normal
+        execution, it never changes one."""
         with bind_request(ctx), _span("query") as sp:
-            ex, done, lat, read_ts = self._query_run(
-                q, variables, txn, best_effort, read_ts, ctx, sp)
+            ex, done, lat, read_ts, expinfo = self._query_run(
+                q, variables, txn, best_effort, read_ts, ctx, sp,
+                explain=explain)
             try:
-                with _span("encode") as esp:
+                with coststore.bind_plan(_skel_of(ex.plan)), \
+                        _span("encode") as esp:
                     t0 = time.perf_counter_ns()
                     data = ex.emit(done)
                     if ex.parsed is not None \
@@ -835,11 +874,17 @@ class GraphDB:
                     esp["encode_us"] = lat.encoding_ns // 1000
             finally:
                 self.coordinator.unpin_read(read_ts)
-        self._query_metrics(lat, ctx)
-        return {"data": data,
-                "extensions": {"latency": lat.as_dict(),
-                               "server_latency": lat.server_latency(),
-                               "txn": {"start_ts": read_ts}}}
+            expl = None
+            if expinfo is not None:
+                from dgraph_tpu.query.explain import build_explain
+                expl = build_explain(self, ex, done, expinfo)
+        self._query_metrics(lat, ctx, ex.plan)
+        ext = {"latency": lat.as_dict(),
+               "server_latency": lat.server_latency(),
+               "txn": {"start_ts": read_ts}}
+        if expl is not None:
+            ext["explain"] = expl
+        return {"data": data, "extensions": ext}
 
     def _schema_rows(self, req: dict) -> list[dict]:
         """`schema {}` introspection rows, the reference's response
@@ -875,28 +920,52 @@ class GraphDB:
         return rows
 
     def _query_run(self, q, variables, txn, best_effort, read_ts,
-                   ctx=None, sp=None):
+                   ctx=None, sp=None, explain=None):
         """Shared query front half: parse, read-ts resolution,
         execution — everything up to (but excluding) emission, which
         query() and query_json() do differently. `sp` is the
         enclosing "query" span's attr dict (phase timings land there
-        so the trace view shows the breakdown inline)."""
+        so the trace view shows the breakdown inline). Returns an
+        extra `expinfo` dict (None unless this request asked for
+        EXPLAIN via the `explain` kwarg or the parsed `@explain`
+        flag): the trace id, the pre-execution counter snapshot and
+        the plan-cache outcome query/explain.py assembles from."""
         from dgraph_tpu.query.executor import Executor
+        from dgraph_tpu.utils import tracing as _tracing
 
         lat = Latency()
         plan = None
+        cache_info: dict = {}
         with _span("parse"):
             t0 = time.perf_counter_ns()
             if self.plan_cache is not None:
                 # cached parse + compiled plan: a warm same-skeleton
                 # request binds its literals and skips the parser and
                 # the per-stage re-derivation entirely
-                parsed, plan = self.plan_cache.lookup(self, q, variables)
+                parsed, plan = self.plan_cache.lookup(
+                    self, q, variables, info=cache_info)
             else:
                 parsed = gql_parse(q, variables)
             lat.parsing_ns = time.perf_counter_ns() - t0
         if ctx is not None:
             ctx.check("parse")
+
+        if explain not in (None, "plan", "analyze"):
+            raise ValueError(
+                f"explain must be 'plan' or 'analyze', got {explain!r}")
+        # transport flag and in-query directive combine by taking the
+        # STRONGER mode: ?explain=true must never silently downgrade a
+        # body that asked for @explain(analyze: true)
+        doc_mode = getattr(parsed, "explain", "") or None
+        rank = {None: 0, "plan": 1, "analyze": 2}
+        mode = explain if rank[explain] >= rank[doc_mode] else doc_mode
+        expinfo = None
+        if mode is not None:
+            cur = _tracing.current()
+            expinfo = {"mode": mode,
+                       "trace_id": cur[0] if cur is not None else "",
+                       "counters_before": metrics.counters_snapshot(),
+                       "cache": dict(cache_info)}
 
         t0 = time.perf_counter_ns()
         if read_ts is not None:
@@ -913,7 +982,9 @@ class GraphDB:
         # (execution AND emission — both read tablets at read_ts);
         # callers unpin in their finally blocks
         self.coordinator.pin_read(read_ts)
-        with _span("execute"):
+        # the coststore attributes every stage span inside to this
+        # request's plan skeleton ("" on the interpreted path)
+        with coststore.bind_plan(_skel_of(plan)), _span("execute"):
             t0 = time.perf_counter_ns()
             try:
                 ex = Executor(self, read_ts, ctx=ctx, plan=plan)
@@ -927,9 +998,9 @@ class GraphDB:
             sp["blocks"] = len(parsed.queries)
             sp["parse_us"] = lat.parsing_ns // 1000
             sp["process_us"] = lat.processing_ns // 1000
-        return ex, done, lat, read_ts
+        return ex, done, lat, read_ts, expinfo
 
-    def _query_metrics(self, lat: Latency, ctx=None):
+    def _query_metrics(self, lat: Latency, ctx=None, plan=None):
         metrics.inc_counter("dgraph_num_queries_total")
         metrics.observe("dgraph_query_latency_ms",
                         (lat.parsing_ns + lat.processing_ns
@@ -937,23 +1008,29 @@ class GraphDB:
         sl = lat.server_latency()
         reqlog.record("query",
                       trace_id=ctx.trace_id if ctx is not None else "",
-                      latency_ms=sl["total_ns"] / 1e6, breakdown=sl)
+                      latency_ms=sl["total_ns"] / 1e6, breakdown=sl,
+                      plan_key=_skel_of(plan))
 
     def query_json(self, q: str, variables: dict | None = None,
                    txn: Optional[Txn] = None, best_effort: bool = True,
-                   read_ts: Optional[int] = None, ctx=None) -> str:
+                   read_ts: Optional[int] = None, ctx=None,
+                   explain: Optional[str] = None) -> str:
         """query() with the serialized-response fast path: the full
         {"data": ..., "extensions": ...} body as ONE JSON string, with
         flat uid+scalar blocks encoded by the native columnar row
         serializer instead of per-uid dict building + json.dumps
         (ref query/outputnode.go fastJsonNode — a documented reference
         hot loop). The serving layers (HTTP/gRPC) call this; library
-        users who want Python objects keep query()."""
+        users who want Python objects keep query(). `explain` as in
+        query(): the `data` bytes are identical either way, the plan
+        tree rides in `extensions.explain`."""
         with bind_request(ctx), _span("query") as sp:
-            ex, done, lat, read_ts = self._query_run(
-                q, variables, txn, best_effort, read_ts, ctx, sp)
+            ex, done, lat, read_ts, expinfo = self._query_run(
+                q, variables, txn, best_effort, read_ts, ctx, sp,
+                explain=explain)
             try:
-                with _span("encode") as esp:
+                with coststore.bind_plan(_skel_of(ex.plan)), \
+                        _span("encode") as esp:
                     t0 = time.perf_counter_ns()
                     data_json = ex.emit_json(done)
                     if ex.parsed is not None \
@@ -969,10 +1046,17 @@ class GraphDB:
                     esp["encode_us"] = lat.encoding_ns // 1000
             finally:
                 self.coordinator.unpin_read(read_ts)
-        self._query_metrics(lat, ctx)
-        ext = _json.dumps({"latency": lat.as_dict(),
-                           "server_latency": lat.server_latency(),
-                           "txn": {"start_ts": read_ts}})
+            expl = None
+            if expinfo is not None:
+                from dgraph_tpu.query.explain import build_explain
+                expl = build_explain(self, ex, done, expinfo)
+        self._query_metrics(lat, ctx, ex.plan)
+        ext_obj: dict = {"latency": lat.as_dict(),
+                         "server_latency": lat.server_latency(),
+                         "txn": {"start_ts": read_ts}}
+        if expl is not None:
+            ext_obj["explain"] = expl
+        ext = _json.dumps(ext_obj)
         return '{"data":' + data_json + ',"extensions":' + ext + "}"
 
     # ------------------------------------------------------------------
@@ -1128,14 +1212,16 @@ class GraphDB:
 
     def state(self) -> dict:
         """Cluster/engine introspection (ref /state handler,
-        edgraph/server.go:602)."""
+        edgraph/server.go:602). Tablet entries carry the cheap
+        always-on stat summary (edges, srcs, bytes, dirty overlay
+        ops, query touches) — the reference's zero reports tablet
+        sizes the same way (zero/tablet.go:180); the full histograms
+        live at /debug/stats."""
+        from dgraph_tpu.storage.tabstats import tablet_summary
         return {
             "maxAssigned": self.coordinator.max_assigned(),
             "groups": {str(g): {
-                "tablets": {p: {"predicate": p,
-                                "edges": self.tablets[p].count_edges()
-                                if hasattr(self.tablets[p], 'count_edges')
-                                else None}
+                "tablets": {p: tablet_summary(self.tablets[p])
                             for p, gg in self.coordinator.tablets.items()
                             if gg == g and p in self.tablets}}
                 for g in self.coordinator.groups},
@@ -1144,4 +1230,46 @@ class GraphDB:
             "planCache": self.plan_cache.stats()
             if self.plan_cache is not None else None,
             "schemaEpoch": self.schema_epoch,
+        }
+
+    def debug_stats(self) -> dict:
+        """The full stats-plane payload backing /debug/stats: every
+        resident tablet's statistics (storage/tabstats.py), the
+        observed-cost summaries, and the engine cache states. Runs
+        WITHOUT any serving/Raft lock: a cold stats cache recomputes
+        O(postings) aggregates, and holding the read lock for that
+        would (via the rwlock's writer preference) stall every query
+        behind one poll. Stats are advisory — concurrent apply/rollup
+        racing a tablet's dict iteration is retried, and a tablet
+        that stays contended degrades to its cheap summary with
+        `"partial": true` rather than an error."""
+        from dgraph_tpu.storage.tabstats import (tablet_stats,
+                                                 tablet_summary)
+        # snapshot the map first: concurrent queries lazily fault
+        # tablets in (and the budget evicts), so iterating the live
+        # dict could die with "changed size during iteration"
+        tablets: dict[str, dict] = {}
+        for p, t in list(dict.items(self.tablets)):
+            for _ in range(3):
+                try:
+                    tablets[p] = tablet_stats(t)
+                    break
+                except (RuntimeError, ValueError):
+                    continue  # dict mutated mid-iteration; retry
+            else:
+                try:
+                    st = tablet_summary(t)
+                except (RuntimeError, ValueError):
+                    st = {"predicate": p}
+                st["partial"] = True
+                tablets[p] = st
+        return {
+            "maxAssigned": self.coordinator.max_assigned(),
+            "schemaEpoch": self.schema_epoch,
+            "tablets": tablets,
+            "cost": coststore.summary(),
+            "costStore": coststore.stats(),
+            "deviceCache": self.device_cache.stats(),
+            "planCache": self.plan_cache.stats()
+            if self.plan_cache is not None else None,
         }

@@ -161,3 +161,7 @@ class ParsedResult:
     # Result.Schema): None = not requested; {"preds": [...], "fields":
     # [...]} with empty lists meaning "all"
     schema_request: Optional[dict] = None
+    # document-level `@explain` flag: "" (off), "plan" (EXPLAIN) or
+    # "analyze" (EXPLAIN ANALYZE). A request annotation — it rides in
+    # extensions.explain and never changes execution or the data bytes
+    explain: str = ""

@@ -55,7 +55,38 @@ def parse(text: str, variables: dict | None = None) -> ParsedResult:
 
     while cur.peek().kind != "eof":
         t = cur.peek()
-        if t.kind == "name" and t.val == "query":
+        if t.kind == "at":
+            # document-level `@explain` / `@explain(analyze: true)`:
+            # the request asks for its compiled plan tree (EXPLAIN) or
+            # the executed-and-measured version (EXPLAIN ANALYZE) in
+            # extensions.explain. A flag on the request, not a query
+            # block — execution itself is unchanged.
+            cur.next()
+            d = cur.expect("name", "directive").val.lower()
+            if d != "explain":
+                raise GQLError(
+                    f"line {t.line}: unknown document directive @{d}")
+            mode = "plan"
+            if cur.accept("lparen"):
+                key = cur.expect("name", "explain option").val.lower()
+                cur.expect("colon")
+                val = cur.next().val.lower()
+                cur.expect("rparen")
+                if key != "analyze":
+                    raise GQLError(
+                        f"line {t.line}: unknown @explain option "
+                        f"{key!r} (only 'analyze')")
+                if val == "true":
+                    mode = "analyze"
+                elif val != "false":
+                    raise GQLError(
+                        f"line {t.line}: @explain(analyze:) must be "
+                        f"true or false, got {val!r}")
+            # repeated directives keep the STRONGER mode — same rule
+            # the transport-flag/document-directive combiner applies
+            if res.explain != "analyze":
+                res.explain = mode
+        elif t.kind == "name" and t.val == "query":
             cur.next()
             if cur.peek().kind == "name":  # optional op name
                 cur.next()

@@ -319,6 +319,10 @@ class ExecNode:
     # columnar emission fast path: uid -> ready json value for flat
     # scalar children (populated instead of `values` when eligible)
     col_vals: Optional[dict] = None
+    # EXPLAIN ANALYZE observability: resolved root-set size BEFORE
+    # filter/pagination (-1 = not measured, e.g. the device
+    # count-at-root fast path never materializes the set)
+    root_rows: int = -1
 
 
 class Executor:
@@ -652,6 +656,7 @@ class Executor:
         root = self._device_root_count_page(gq)
         if root is None:
             root = self._root_uids(gq)
+            node.root_rows = int(len(root))
             if gq.filter is not None:
                 root = self._eval_filter(gq.filter, root)
             if self._similar_order is not None and not gq.order:
@@ -747,6 +752,10 @@ class Executor:
 
     def _tablet(self, attr: str) -> Optional[Tablet]:
         tab = self.db.tablets.get(attr)
+        if tab is not None:
+            # stats plane: hottest-tablet signal (getattr: federated
+            # RemoteTablet proxies have no stats fields)
+            tab.touches = getattr(tab, "touches", 0) + 1
         if tab is not None \
                 and getattr(tab, "base_ts", 0) > self.read_ts:
             # commits newer than this read's ts were already folded

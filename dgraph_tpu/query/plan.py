@@ -343,10 +343,13 @@ class PlanCache:
 
     # -- plan tier -----------------------------------------------------
 
-    def lookup(self, db, q: str, variables: Optional[dict]
+    def lookup(self, db, q: str, variables: Optional[dict],
+               info: Optional[dict] = None
                ) -> tuple[ParsedResult, Plan]:
         """The engine's per-request entry: cached parse, then the
-        compiled plan for (skeleton, db.schema_epoch, mesh layout)."""
+        compiled plan for (skeleton, db.schema_epoch, mesh layout).
+        `info`, when given, reports the cache outcome
+        ({"hit": bool}) — EXPLAIN surfaces it per request."""
         parsed, struct, skel_hash = self.parse(q, variables)
         epoch = getattr(db, "schema_epoch", 0)
         key = (skel_hash, struct, epoch, _mesh_key(db))
@@ -355,8 +358,12 @@ class PlanCache:
             if plan is not None:
                 self._plans.move_to_end(key)
                 metrics.inc_counter("plan_cache_hits")
+                if info is not None:
+                    info["hit"] = True
                 return parsed, plan
         metrics.inc_counter("plan_cache_misses")
+        if info is not None:
+            info["hit"] = False
         plan = self._compile(parsed, struct, skel_hash, epoch, key[3])
         with self._lock:
             plan = self._plans.setdefault(key, plan)

@@ -310,6 +310,16 @@ def _pb_handlers(alpha: AlphaServer) -> dict:
         token = token_of(req, context)
         ctx = _ctx_of(context)
         params = {}
+        # pb.Request carries no explain field; an `x-dgraph-explain:
+        # plan|analyze` metadata entry (or the in-query `@explain`
+        # directive, which needs no transport support) requests the
+        # plan tree — pb.Response has no extensions slot either, so
+        # the tree comes back as `x-dgraph-explain-json` trailing
+        # metadata; the data payload stays byte-identical
+        md = dict(context.invocation_metadata() or ()) \
+            if context is not None else {}
+        if md.get("x-dgraph-explain"):
+            params["explain"] = md["x-dgraph-explain"]
         if req.start_ts:
             params["startTs"] = str(req.start_ts)
         if req.best_effort:
@@ -375,6 +385,10 @@ def _pb_handlers(alpha: AlphaServer) -> dict:
             if req.vars else req.query
         out = alpha.handle_query(payload, params, token, ctx=ctx)
         ext = out.get("extensions", {})
+        if ext.get("explain") is not None and context is not None:
+            context.set_trailing_metadata((
+                ("x-dgraph-explain-json",
+                 json.dumps(ext["explain"], separators=(",", ":"))),))
         return pb.Response(
             json=json.dumps(out.get("data", {}),
                             separators=(",", ":")).encode(),

@@ -4,6 +4,10 @@ Endpoint map mirrors the reference (dgraph/cmd/alpha/run.go:415-436):
 
     POST /query     GraphQL± query; body is DQL text or JSON
                     {"query": ..., "variables": {...}}
+                    ?explain=true|plan|analyze attaches the compiled
+                    plan tree (+ measured actuals for analyze) under
+                    extensions.explain — same as the in-query
+                    `@explain` directive
                     (ref dgraph/cmd/alpha/http.go:162 queryHandler)
     POST /mutate    RDF or JSON mutation; ?commitNow=true commits
                     immediately, otherwise the response's
@@ -18,6 +22,10 @@ Endpoint map mirrors the reference (dgraph/cmd/alpha/run.go:415-436):
     GET  /admin/schema        current schema text
     POST /admin/schema        same as /alter with schema text
     GET  /debug/prometheus_metrics   metrics text format (x/metrics.go)
+    GET  /debug/stats         the always-on statistics plane: full
+                              per-predicate tablet statistics, the
+                              observed-cost store, engine cache states
+                              (tools/dgtop.py polls this)
 
 Transactions over HTTP are keyed by startTs exactly like the reference's
 stateless protocol: /mutate without commitNow returns start_ts, the
@@ -298,6 +306,21 @@ class AlphaServer:
         return q, variables, ro_txn, \
             (be if ro_txn is None else False), pin_ts
 
+    @staticmethod
+    def _explain_param(params: dict) -> Optional[str]:
+        """`?explain=true|plan` -> "plan", `?explain=analyze` ->
+        "analyze", absent/false -> None (the in-query `@explain`
+        directive still applies either way)."""
+        raw = str(params.get("explain", "")).lower()
+        if raw in ("", "false", "0"):
+            return None
+        if raw in ("true", "plan"):
+            return "plan"
+        if raw == "analyze":
+            return "analyze"
+        raise ValueError(
+            f"explain must be true/plan/analyze, got {raw!r}")
+
     def handle_query(self, body: dict | str, params: dict,
                      token: str = "", ctx=None) -> dict:
         with self._logged("query", ctx), self._admit(ctx):
@@ -306,7 +329,8 @@ class AlphaServer:
             with self.rw.read:
                 return self.db.query(q, variables, txn=ro_txn,
                                      best_effort=be, read_ts=pin_ts,
-                                     ctx=ctx)
+                                     ctx=ctx,
+                                     explain=self._explain_param(params))
 
     def handle_query_json(self, body: dict | str, params: dict,
                           token: str = "", ctx=None) -> str:
@@ -318,8 +342,9 @@ class AlphaServer:
         with self._logged("query", ctx), self._admit(ctx):
             q, variables, ro_txn, be, pin_ts = self._query_prologue(
                 body, params, token)
+            explain = self._explain_param(params)
             if self.batcher is not None and ro_txn is None \
-                    and pin_ts is None:
+                    and pin_ts is None and explain is None:
                 # snapshot-unpinned, txn-free reads coalesce with
                 # concurrent same-plan requests; the batcher takes the
                 # read lock itself, once per batch, and serves every
@@ -334,7 +359,8 @@ class AlphaServer:
             with self.rw.read:
                 return self.db.query_json(q, variables, txn=ro_txn,
                                           best_effort=be,
-                                          read_ts=pin_ts, ctx=ctx)
+                                          read_ts=pin_ts, ctx=ctx,
+                                          explain=explain)
 
     def handle_mutate(self, body: bytes, content_type: str,
                       params: dict, token: str = "", ctx=None) -> dict:
@@ -517,6 +543,24 @@ class AlphaServer:
         from dgraph_tpu.utils.tracing import export_chrome_trace
         tid = (params or {}).get("trace_id") or None
         return {"traceEvents": export_chrome_trace(trace_id=tid)}
+
+    def handle_debug_stats(self, token: str = "") -> dict:
+        """/debug/stats: the always-on statistics plane — every
+        resident tablet's full statistics (storage/tabstats.py), the
+        observed-cost summaries (utils/coststore.py), metrics
+        histogram state, and the engine cache states. ACL-gated like
+        /state: predicate names and fan-out shapes are data-shaped."""
+        if self.acl is not None:
+            with self.meta:
+                self.acl.authorize(token)
+        # no rw.read hold: a cold stats cache recomputes O(postings)
+        # aggregates, and the rwlock's writer preference would park
+        # every query arriving after one mutate behind the walk.
+        # debug_stats retries/degrades on concurrent-mutation races.
+        out = self.db.debug_stats()
+        out["histograms"] = metrics.histograms_snapshot()
+        out["counters"] = metrics.counters_snapshot()
+        return out
 
     def handle_requests(self, token: str = "") -> dict:
         """/debug/requests: the bounded recent + slowest request log
@@ -831,6 +875,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, self.alpha.handle_traces(token, params))
             elif path == "/debug/requests":
                 self._send(200, self.alpha.handle_requests(token))
+            elif path == "/debug/stats":
+                self._send(200, self.alpha.handle_debug_stats(token))
             elif path == "/debug/prometheus_metrics":
                 from dgraph_tpu.utils.metrics import render_prometheus
 

@@ -304,6 +304,10 @@ class Tablet:
         self._device_adj = None
         self._device_values = None
         self._device_adj_ts = -1
+        # query-path lookups since boot (executor._tablet bumps it):
+        # the stats plane's "hottest tablets" signal. A plain int —
+        # GIL-atomic enough for a statistic, never for correctness.
+        self.touches = 0
 
     # -- schema helpers --
     @property

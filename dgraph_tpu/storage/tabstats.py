@@ -1,0 +1,220 @@
+"""Per-predicate tablet statistics: the planner-facing stats plane.
+
+The reference exposes per-predicate tablet sizes through /state (zero
+tablet reports, zero/tablet.go:180) and little else; a cost-based
+planner needs more — cardinalities, fan-out shape, index selectivity,
+bytes. This module computes that per tablet, the repo way: everything
+derivable from BASE state is computed lazily and cached per
+`(base_ts, schema object)` (the same invalidation contract as
+value_columns / token_index_csr — a rollup moves base_ts, an alter
+rebinds the schema, and the next read recomputes), while the cheap
+always-on fields (dirty overlay op count, query-path touches) read
+live. That is the "incremental on clean tablets, refreshed at rollup"
+discipline: mutations only grow the delta overlay (reported exactly as
+`dirtyOps`), and the expensive aggregates recompute once per fold,
+never per query.
+
+`tablet_stats(tab)` returns one JSON-ready dict:
+
+  predicate/type/baseTs       identity
+  nSrc/nDst/edges/reverseEdges/nPostings   cardinalities
+  fanout                      log2 histogram of per-src posting-list
+                              sizes (bucket b = sizes with bit_length
+                              b), plus max/avg — the expansion-size
+                              estimator
+  tokenIndex                  tokens, avg/max posting length — the
+                              eq/terms selectivity estimator
+  valueTypes                  posting count per stored TypeID
+  bytesAtRest                 approx resident bytes (base + overlay)
+  bytesDecoded / residency    bytes of each materialized columnar /
+                              device export currently cached on the
+                              tablet (the tile LRU's view of it)
+  dirtyOps                    overlay ops not yet folded (live)
+  touches                     query-path tablet lookups since boot
+                              (live; the "hottest tablets" signal)
+
+Consumed by `/debug/stats`, the enriched `/state`, `EXPLAIN`'s row
+estimators (query/explain.py) and tools/dgtop.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from dgraph_tpu.models.types import type_name
+
+# fan-out histogram covers bit_length 0..20 (sizes up to ~1M); the
+# last bucket absorbs everything larger
+FANOUT_BUCKETS = 21
+
+
+def _fanout_hist(counts: np.ndarray) -> dict:
+    if not len(counts):
+        return {"hist": [0] * FANOUT_BUCKETS, "max": 0, "avg": 0.0}
+    bl = np.minimum(
+        np.ceil(np.log2(np.maximum(counts, 1) + 1)).astype(np.int64),
+        FANOUT_BUCKETS - 1)
+    hist = np.bincount(bl, minlength=FANOUT_BUCKETS)
+    return {"hist": hist.tolist()[:FANOUT_BUCKETS],
+            "max": int(counts.max()),
+            "avg": round(float(counts.mean()), 3)}
+
+
+def _resident_nbytes(obj: Any) -> int:
+    """Best-effort byte size of a cached export: honors an explicit
+    .nbytes (TokenIndexCSR/OrderPermutation), else sums ndarray attrs."""
+    nb = getattr(obj, "nbytes", None)
+    if isinstance(nb, (int, np.integer)):
+        return int(nb)
+    total = 0
+    names = getattr(obj, "__slots__", None)
+    if names is None:
+        names = list(getattr(obj, "__dict__", {}))
+    for name in names:
+        v = getattr(obj, name, None)
+        if isinstance(v, np.ndarray):
+            total += v.nbytes
+        elif isinstance(v, (list, tuple)) and v \
+                and isinstance(v[0], (bytes, bytearray)):
+            total += sum(len(b) for b in v)
+    return total
+
+
+def residency(tab) -> dict:
+    """Which columnar/device exports are materialized on this tablet
+    right now, and their decoded byte sizes (0 = not resident). These
+    are exactly the caches the tile LRU budgets; dgtop shows them as
+    the tablet's decoded footprint."""
+    out: dict[str, int] = {}
+
+    def add(label: str, attr: str, ts_attr: Optional[str] = None):
+        obj = getattr(tab, attr, None)
+        if obj is None or obj is False:
+            out[label] = 0
+            return
+        if ts_attr is not None \
+                and getattr(tab, ts_attr, -1) != tab.base_ts:
+            out[label] = 0
+            return
+        out[label] = _resident_nbytes(obj)
+
+    add("valueColumns", "_val_cols", "_val_cols_ts")
+    add("tokenCSR", "_tok_csr", "_tok_csr_ts")
+    add("edgeTable", "_edge_table", "_edge_table_ts")
+    add("deviceAdj", "_device_adj", "_device_adj_ts")
+    dv = 0
+    for attr in list(vars(tab)):
+        # "_device_values" plus per-language "_device_values@<lang>"
+        # tiles (device_cache.device_values); companions append "_ts"
+        # (suffix check, same caveat as the ordperm loop below)
+        if (attr == "_device_values"
+                or attr.startswith("_device_values@")) \
+                and not attr.endswith("_ts"):
+            if getattr(tab, attr + "_ts", -1) == tab.base_ts:
+                obj = getattr(tab, attr)
+                if obj is not None:
+                    dv += _resident_nbytes(obj)
+    out["deviceValues"] = dv
+    sk = getattr(tab, "_sk_arrays", None)
+    out["sortKeys"] = (sk[1].nbytes + sk[2].nbytes) \
+        if sk is not None and sk[0][0] == tab.base_ts else 0
+    perms = 0
+    for attr in list(vars(tab)):
+        # base attrs end "@a"/"@d"; their companions append "_ts" /
+        # "_schema" (suffix check: a lang tag may contain either)
+        if attr.startswith("_ordperm@") and not attr.endswith("_ts") \
+                and not attr.endswith("_schema"):
+            if getattr(tab, attr + "_ts", -1) == tab.base_ts:
+                perms += _resident_nbytes(getattr(tab, attr))
+    out["orderPerms"] = perms
+    return out
+
+
+def _base_stats(tab) -> dict:
+    """The per-base_ts aggregate (cached by tablet_stats)."""
+    is_uid = tab.is_uid
+    if is_uid:
+        _srcs, counts = tab.count_table()  # cached per base_ts itself
+        n_postings = int(counts.sum()) if len(counts) else 0
+        if tab.reverse:
+            n_dst = len(tab.reverse)
+        elif 0 < n_postings <= (1 << 22):
+            n_dst = int(len(np.unique(np.concatenate(
+                [v for v in tab.edges.values() if len(v)]))))
+        else:
+            # no reverse index and too many edges for an exact pass:
+            # unknown (a stat endpoint must not allocate an E-sized
+            # scratch buffer per rollup)
+            n_dst = -1 if n_postings else 0
+        vtypes = {"uid": n_postings}
+    else:
+        counts = np.fromiter((len(v) for v in tab.values.values()),
+                             np.int64, len(tab.values))
+        n_postings = int(counts.sum()) if len(counts) else 0
+        n_dst = 0
+        vtypes: dict[str, int] = {}
+        for plist in tab.values.values():
+            for p in plist:
+                nm = type_name(p.value.tid)
+                vtypes[nm] = vtypes.get(nm, 0) + 1
+    idx_lens = np.fromiter((len(v) for v in tab.index.values()),
+                           np.int64, len(tab.index)) \
+        if tab.index else np.empty(0, np.int64)
+    token_index = {
+        "tokens": int(len(tab.index)),
+        "avgPostings": round(float(idx_lens.mean()), 3)
+        if len(idx_lens) else 0.0,
+        "maxPostings": int(idx_lens.max()) if len(idx_lens) else 0,
+    }
+    return {
+        "predicate": tab.pred,
+        "type": type_name(tab.schema.value_type),
+        "baseTs": tab.base_ts,
+        "nSrc": int(len(tab.edges) if is_uid else len(tab.values)),
+        "nDst": int(n_dst),
+        "edges": int(tab.edge_count()),
+        "reverseEdges": int(tab.edge_count(reverse=True)),
+        "nPostings": n_postings,
+        "fanout": _fanout_hist(counts),
+        "tokenIndex": token_index,
+        "valueTypes": vtypes,
+        "indexed": bool(tab.schema.indexed),
+        "tokenizers": list(tab.schema.tokenizers or ()),
+        "bytesAtRest": int(tab.approx_bytes()),
+    }
+
+
+def tablet_stats(tab) -> dict:
+    """Full stats dict for one tablet: the per-base_ts aggregate
+    (cached on the tablet, same contract as its other exports) plus
+    the live overlay/residency fields recomputed every call."""
+    cached = getattr(tab, "_stats_cache", None)
+    if cached is not None and cached[0] == tab.base_ts \
+            and cached[1] is tab.schema:
+        base = cached[2]
+    else:
+        base = _base_stats(tab)
+        tab._stats_cache = (tab.base_ts, tab.schema, base)
+    res = residency(tab)
+    out = dict(base)
+    out["dirtyOps"] = sum(len(ops) for _, ops in tab.deltas)
+    out["touches"] = int(getattr(tab, "touches", 0))
+    out["residency"] = res
+    out["bytesDecoded"] = int(sum(res.values()))
+    return out
+
+
+def tablet_summary(tab) -> dict:
+    """The cheap always-on subset for /state: no O(postings) work
+    beyond what edge_count/approx caches already paid."""
+    return {
+        "predicate": tab.pred,
+        "edges": int(tab.edge_count()),
+        "srcs": int(len(tab.edges) if tab.is_uid else len(tab.values)),
+        "bytes": int(tab.approx_bytes()),
+        "dirtyOps": sum(len(ops) for _, ops in tab.deltas),
+        "touches": int(getattr(tab, "touches", 0)),
+        "baseTs": tab.base_ts,
+    }

@@ -121,6 +121,19 @@ def snapshot() -> dict:
         }
 
 
+def histograms_snapshot() -> dict:
+    """Histogram state keyed by formatted series name: bucket counts
+    (aligned to BUCKETS + one +Inf tail) and the running sum. The
+    machine-readable side of render_prometheus — /debug/stats carries
+    it so dgtop computes rate/percentile deltas without scraping and
+    re-parsing the text exposition."""
+    with _LOCK:
+        return {_fmt_key(k): {"buckets": list(h),
+                              "sum": _HISTO_SUM.get(k, 0.0),
+                              "le": list(BUCKETS)}
+                for k, h in _HISTOGRAMS.items()}
+
+
 def _escape_label(v) -> str:
     """Prometheus text-format 0.0.4 label-value escaping: backslash,
     double-quote and newline must be escaped or the emitted series is
@@ -172,6 +185,19 @@ def collect_memory_gauges():
         pass
 
 
+# extra exposition renderers: other always-on stat planes (the
+# observed-cost store, utils/coststore.py) register a zero-arg
+# callable returning pre-formatted exposition text ("" when empty);
+# render_prometheus appends each so every registered plane rides the
+# one /debug/prometheus_metrics endpoint
+_RENDERERS: list = []
+
+
+def register_renderer(fn) -> None:
+    if fn not in _RENDERERS:
+        _RENDERERS.append(fn)
+
+
 def render_prometheus() -> str:
     """Prometheus text exposition format 0.0.4."""
     collect_memory_gauges()
@@ -206,4 +232,11 @@ def render_prometheus() -> str:
             lines.append(f"{_fmt_key((name + '_count', labels))} {cum}")
             lines.append(f"{_fmt_key((name + '_sum', labels))} "
                          f"{_HISTO_SUM.get(k, 0)}")
+    for fn in list(_RENDERERS):
+        try:
+            extra = fn()
+        except Exception:
+            continue
+        if extra:
+            lines.append(extra.rstrip("\n"))
     return "\n".join(lines) + "\n"

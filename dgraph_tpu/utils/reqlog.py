@@ -22,12 +22,14 @@ bounded heap push under one lock — cheap enough for every request.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import heapq
 import itertools
 import threading
 import time
 from collections import deque
-from typing import Optional
+from typing import Iterator, Optional
 
 from dgraph_tpu.utils import tracing
 
@@ -39,13 +41,37 @@ _recent: deque = deque(maxlen=_RECENT_MAX)
 _slow_heap: list[tuple[float, int, dict]] = []  # min-heap of (ms, seq, rec)
 _seq = itertools.count()
 
+# the micro-batcher (engine/batcher.py) binds its dispatch id around
+# the drive so the engine's query records join against the batch
+# without threading an argument through db.query_json
+_BATCH_CV: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "dgraph_tpu_reqlog_batch", default="")
+
+
+@contextlib.contextmanager
+def bind_batch(batch_id: str) -> Iterator[None]:
+    """Stamp `batch_id` on every record() inside the block (the
+    micro-batcher wraps each batch dispatch)."""
+    tok = _BATCH_CV.set(str(batch_id))
+    try:
+        yield
+    finally:
+        _BATCH_CV.reset(tok)
+
 
 def record(op: str, trace_id: str = "", latency_ms: float = 0.0,
            outcome: str = "ok",
-           breakdown: Optional[dict] = None) -> None:
+           breakdown: Optional[dict] = None,
+           plan_key: str = "", batch_id: str = "") -> None:
+    """`plan_key` is the compiled plan's 16-hex skeleton hash ("" for
+    interpreted requests) — the join key into the plan cache and the
+    coststore's per-plan summaries; `batch_id` joins against the
+    micro-batcher's dispatch (defaults to the bound batch context)."""
     rec = {"op": str(op), "trace_id": str(trace_id),
            "latency_ms": round(float(latency_ms), 3),
            "outcome": str(outcome), "node": tracing.node(),
+           "plan_key": str(plan_key),
+           "batch_id": str(batch_id) or _BATCH_CV.get(),
            # wall clock: operators correlate these with external logs
            "ts": time.time()}  # dglint: disable=DG06
     if breakdown:

@@ -46,6 +46,28 @@ _spans: deque = deque(maxlen=_MAX_SPANS)
 _lock = threading.Lock()
 _enabled = True
 
+# span-close observers: the always-on stats plane (utils/coststore)
+# subscribes here and aggregates per-stage durations without the
+# tracing module knowing about it. Observers run OUTSIDE _lock, on the
+# recording thread, with the finished span record; they MUST be cheap
+# (the per-span budget includes them) and MUST NOT raise — a raising
+# observer is dropped from the list rather than poisoning every span.
+_observers: list = []
+
+
+def add_span_observer(fn) -> None:
+    """Register `fn(record)` to run at every span close. The record is
+    the live ring entry — observers read, never mutate."""
+    if fn not in _observers:
+        _observers.append(fn)
+
+
+def remove_span_observer(fn) -> None:
+    try:
+        _observers.remove(fn)
+    except ValueError:
+        pass
+
 # Registry of every span name the tree emits. Span names are API the
 # same way metric names are (trace queries and the Perfetto merge key
 # on them), so dglint DG08 checks each literal span(...) name against
@@ -87,6 +109,10 @@ _CUR: contextvars.ContextVar[Optional[tuple[str, str]]] = \
 
 
 def set_enabled(on: bool) -> None:
+    """Gate span RETENTION (the ring + /debug/traces). Registered span
+    observers — notably the coststore's always-on aggregation — keep
+    firing while disabled; silence those at their own switch (e.g.
+    coststore.set_enabled)."""
     global _enabled
     _enabled = bool(on)
 
@@ -167,7 +193,11 @@ def bind_request(ctx) -> Iterator[None]:
 def span(name: str, **attrs: Any) -> Iterator[dict]:
     """Record one wall-time span; yields the attr dict so callers can
     attach results (e.g. result counts) before the span closes."""
-    if not _enabled:
+    # observers (the coststore's always-on aggregation) outlive the
+    # ring's enabled flag: set_enabled(False) stops RETAINING spans,
+    # not MEASURING them. Sheds to a true no-op only when nobody is
+    # listening at all.
+    if not _enabled and not _observers:
         yield attrs
         return
     cur = _CUR.get()
@@ -189,8 +219,15 @@ def span(name: str, **attrs: Any) -> Iterator[dict]:
     finally:
         rec["dur_us"] = (time.perf_counter_ns() - t0) / 1e3
         _CUR.reset(tok)
-        with _lock:
-            _spans.append(rec)
+        if _enabled:
+            with _lock:
+                _spans.append(rec)
+        if _observers:
+            for fn in list(_observers):
+                try:
+                    fn(rec)
+                except Exception:
+                    remove_span_observer(fn)
 
 
 # ------------------------------------------------------- W3C traceparent
@@ -264,12 +301,21 @@ def clear() -> None:
         _spans.clear()
 
 
+def node_pids(spans: list[dict]) -> dict[str, int]:
+    """Node name -> Chrome trace pid lane (sorted node names,
+    1-based). THE pid assignment for every event kind derived from a
+    span set — chrome_events 'X' spans and trace_merge counter tracks
+    must agree or counters land in the wrong process lane."""
+    return {n: i + 1 for i, n in
+            enumerate(sorted({s.get("node", "local") for s in spans}))}
+
+
 def chrome_events(spans: list[dict]) -> list[dict]:
     """Span records -> Chrome trace-event JSON: one metadata
     process_name per node (pid = node lane) plus 'X' complete events
     carrying the span ids in args for parent-link inspection."""
-    nodes = sorted({s.get("node", "local") for s in spans})
-    pid = {n: i + 1 for i, n in enumerate(nodes)}
+    pid = node_pids(spans)
+    nodes = sorted(pid)
     events: list[dict] = [
         {"name": "process_name", "ph": "M", "pid": pid[n], "tid": 0,
          "args": {"name": n}} for n in nodes]

@@ -70,12 +70,17 @@ def _metrics_and_span_leak_guard():
     test itself arranged), and restore tracing to its enabled
     default in case a test toggled it."""
     yield
-    from dgraph_tpu.utils import metrics, reqlog, tracing
+    from dgraph_tpu.utils import coststore, metrics, reqlog, tracing
 
     metrics.reset()
     tracing.clear()
     tracing.set_enabled(True)
     reqlog.reset()
+    # the observed-cost store aggregates from the always-on span
+    # observer: reset it with the rest of the observability plane so
+    # its Prometheus renderer output stays test-local too
+    coststore.reset()
+    coststore.set_enabled(True)
 
 
 @pytest.fixture(autouse=True)

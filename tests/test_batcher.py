@@ -101,6 +101,26 @@ class TestCoalescing:
             got = json.dumps(json.loads(o)["data"], sort_keys=True)
             assert got == solo[q], q
 
+    def test_reqlog_records_share_batch_id(self, db):
+        """Members of one dispatch log the same batch_id, joining
+        /debug/requests against the micro-batcher."""
+        from dgraph_tpu.utils import reqlog
+
+        reqlog.reset()
+        qa = '{ q(func: eq(name, "alice")) { uid name } }'
+        qb = '{ q(func: eq(name, "bob")) { uid name } }'
+        mb = MicroBatcher(db, window_us=300_000, max_batch=2)
+        _fanout(mb, [lambda: mb.query_json(qa),
+                     lambda: mb.query_json(qb)])
+        ids = [r["batch_id"] for r in reqlog.snapshot()["recent"]
+               if r["op"] == "query"]
+        assert len(ids) == 2
+        assert ids[0] == ids[1] and ids[0].startswith("b")
+        # and the records carry the shared plan skeleton too
+        keys = {r["plan_key"] for r in reqlog.snapshot()["recent"]
+                if r["op"] == "query"}
+        assert len(keys) == 1 and len(keys.pop()) == 16
+
     def test_occupancy_histogram_recorded(self, db):
         q = '{ q(func: eq(name, "alice")) { uid } }'
         mb = MicroBatcher(db, window_us=200_000, max_batch=3)

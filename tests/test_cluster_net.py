@@ -113,6 +113,25 @@ def test_alpha_write_read_over_wire(alpha):
     assert got["data"]["q"] == [{"name": "carol"}]
 
 
+def test_stats_op_over_wire(alpha):
+    """The wire analogue of /debug/stats: one `stats` op returns a
+    node's whole observability surface (tools/dgtop.py polls this on
+    clusters without per-node HTTP)."""
+    c, client = alpha
+    node = c.alive()[0]
+    got = client._rpc_once(node, {"op": "stats"})
+    assert got and got.get("ok"), got
+    st = got["result"]
+    for key in ("tablets", "cost", "costStore", "maxAssigned",
+                "requests", "counters", "node", "group"):
+        assert key in st, key
+    assert st["node"]
+    # the carol write from the earlier test left a name tablet with
+    # real per-predicate statistics on at least one node
+    assert any("name" in client._rpc_once(
+        i, {"op": "stats"})["result"]["tablets"] for i in c.alive())
+
+
 def test_follower_serves_reads_and_redirects_writes(alpha):
     c, client = alpha
     leader = _wait_leader(client)

@@ -1,0 +1,270 @@
+"""Observed-cost store (utils/coststore.py): span-observer
+aggregation, per-plan attribution, bounded growth, persistence, and
+the Prometheus histogram export with trace exemplars.
+"""
+
+import json
+
+import pytest
+
+from dgraph_tpu.utils import coststore, metrics, tracing
+from dgraph_tpu.utils.coststore import (
+    BUCKETS_US, EWMA_ALPHA, N_BUCKETS, CostStore,
+)
+
+
+def test_record_and_summary_fields():
+    cs = CostStore()
+    cs.record("eq", "host", "abcd", 3, 1.5, "t1")
+    cs.record("eq", "host", "abcd", 3, 3.5, "t2")
+    (ent,) = cs.summary()
+    assert ent["stage"] == "eq" and ent["tier"] == "host"
+    assert ent["skeleton"] == "abcd" and ent["size_bucket"] == 3
+    assert ent["count"] == 2
+    assert ent["sum_us"] == 5.0
+    # EWMA seeds at the first value then blends
+    assert ent["ewma_us"] == round(1.5 + EWMA_ALPHA * (3.5 - 1.5), 3)
+    assert ent["max_us"] == 3.5 and ent["max_trace"] == "t2"
+    # 1.5 -> le=2 bucket (index 1); 3.5 -> le=4 (index 2)
+    assert ent["hist"][1] == 1 and ent["hist"][2] == 1
+    assert len(ent["hist"]) == N_BUCKETS + 1
+
+
+def test_summary_filters_and_order():
+    cs = CostStore()
+    cs.record("eq", "host", "p1", 0, 10.0)
+    cs.record("sort", "host", "p1", 0, 500.0)
+    cs.record("eq", "host", "p2", 0, 2.0)
+    assert [e["stage"] for e in cs.summary()] == ["sort", "eq", "eq"]
+    assert len(cs.summary(stage="eq")) == 2
+    assert len(cs.summary(skeleton="p2")) == 1
+    assert cs.stats() == {"keys": 3, "observations": 3}
+
+
+def test_observer_aggregates_stage_spans_only():
+    cs = CostStore()
+    tracing.add_span_observer(cs.observe_span)
+    try:
+        with tracing.span("eq", pred="name", n=100):
+            pass
+        with tracing.span("device.tile_load", edges=5000):
+            pass
+        with tracing.span("rrandom.nonstage"):
+            pass
+    finally:
+        tracing.remove_span_observer(cs.observe_span)
+    ents = {e["stage"]: e for e in cs.summary()}
+    assert set(ents) == {"eq", "device.tile_load"}
+    # 100 -> bucket 7 (2^6 < 100 <= 2^7); tile_load defaults to device
+    assert ents["eq"]["size_bucket"] == 7
+    assert ents["device.tile_load"]["tier"] == "device"
+    assert ents["device.tile_load"]["size_bucket"] == 13
+    # spans record their trace ids for the exemplar
+    assert ents["eq"]["max_trace"] != ""
+
+
+def test_bind_plan_attributes_skeleton():
+    cs = CostStore()
+    tracing.add_span_observer(cs.observe_span)
+    try:
+        with coststore.bind_plan("cafe0123"):
+            with tracing.span("sort"):
+                pass
+        with tracing.span("sort"):
+            pass
+    finally:
+        tracing.remove_span_observer(cs.observe_span)
+    skels = {e["skeleton"] for e in cs.summary(stage="sort")}
+    assert skels == {"cafe0123", ""}
+
+
+def test_disabled_store_ignores_spans():
+    cs = CostStore()
+    cs.set_enabled(False)
+    cs.observe_span({"name": "eq", "dur_us": 1.0, "args": {},
+                     "trace_id": "t"})
+    assert cs.stats()["observations"] == 0
+    cs.set_enabled(True)
+
+
+def test_overflow_folds_into_aggregate_key():
+    cs = CostStore()
+    cs.MAX_KEYS = 4
+    for i in range(4):
+        cs.record("eq", "host", f"skel{i}", 0, 1.0)
+    for i in range(10):
+        cs.record("eq", "host", f"hot{i}", 0, 1.0)
+    assert cs.stats() == {"keys": 5, "observations": 14}
+    (agg,) = [e for e in cs.summary() if e["skeleton"] == "~"]
+    assert agg["count"] == 10
+
+
+def test_save_load_merge(tmp_path):
+    a = CostStore()
+    a.record("eq", "host", "p", 2, 4.0, "tA")
+    a.save(str(tmp_path / "cs.json"))
+    b = CostStore()
+    b.record("eq", "host", "p", 2, 16.0, "tB")
+    b.record("sort", "host", "", 0, 1.0)
+    assert b.load(str(tmp_path / "cs.json")) == 1
+    ents = {(e["stage"], e["skeleton"]): e for e in b.summary()}
+    merged = ents[("eq", "p")]
+    assert merged["count"] == 2
+    assert merged["sum_us"] == 20.0
+    assert merged["max_us"] == 16.0 and merged["max_trace"] == "tB"
+    assert sum(merged["hist"]) == 2
+    # blended EWMA stays between the two sides
+    assert 4.0 <= merged["ewma_us"] <= 16.0
+    assert ents[("sort", "")]["count"] == 1
+
+
+def test_load_tolerates_missing_and_corrupt(tmp_path):
+    cs = CostStore()
+    assert cs.load(str(tmp_path / "absent.json")) == 0
+    p = tmp_path / "bad.json"
+    p.write_text("{not json")
+    assert cs.load(str(p)) == 0
+    p.write_text(json.dumps({"version": 1, "entries": [
+        {"stage": "eq"},  # missing fields: skipped
+        {"stage": "ok", "tier": "host", "skeleton": "", "bucket": 0,
+         "hist": [0] * (N_BUCKETS + 1), "count": 1, "sum_us": 1.0,
+         "ewma_us": 1.0, "max_us": 1.0}]}))
+    assert cs.load(str(p)) == 1
+
+
+def test_engine_persists_coststore_across_restart(tmp_path):
+    from dgraph_tpu.engine.db import GraphDB
+
+    coststore.reset()
+    db = GraphDB(store_dir=str(tmp_path), prefer_device=False)
+    db.alter(schema_text="name: string @index(exact) .")
+    db.mutate(set_nquads='<0x1> <name> "a" .')
+    db.query('{ q(func: has(name)) { name } }')
+    assert coststore.stats()["observations"] > 0
+    db.close()
+    assert (tmp_path / "coststore.json").exists()
+    coststore.reset()
+    assert coststore.stats()["observations"] == 0
+    db2 = GraphDB(store_dir=str(tmp_path), prefer_device=False)
+    try:
+        assert coststore.stats()["observations"] > 0
+    finally:
+        db2.close()
+
+
+def test_save_then_load_same_path_does_not_double(tmp_path):
+    """An in-process close-then-reopen on the same store_dir must not
+    fold the file's observations back into the still-live table."""
+    cs = CostStore()
+    cs.record("eq", "host", "s", 3, 2.0, "t1")
+    p = str(tmp_path / "coststore.json")
+    cs.save(p)
+    assert cs.load(p) == 0  # already synced: merge nothing
+    assert cs.stats()["observations"] == 1
+    # a fresh store (new process) still loads the file normally
+    cs2 = CostStore()
+    assert cs2.load(p) == 1
+    assert cs2.stats()["observations"] == 1
+    # ...and loading the same path twice into it merges once
+    assert cs2.load(p) == 0
+    assert cs2.stats()["observations"] == 1
+
+
+def test_engine_reopen_same_dir_does_not_double(tmp_path):
+    from dgraph_tpu.engine.db import GraphDB
+
+    coststore.reset()
+    db = GraphDB(store_dir=str(tmp_path), prefer_device=False)
+    db.alter(schema_text="name: string @index(exact) .")
+    db.mutate(set_nquads='<0x1> <name> "a" .')
+    db.query('{ q(func: has(name)) { name } }')
+    db.close()
+    before = coststore.stats()["observations"]
+    assert before > 0
+    # NO reset: the global table still holds everything it saved
+    db2 = GraphDB(store_dir=str(tmp_path), prefer_device=False)
+    try:
+        assert coststore.stats()["observations"] == before
+    finally:
+        db2.close()
+
+
+def test_render_prometheus_golden_with_exemplar():
+    cs = CostStore()
+    cs.record("eq", "host", "skel-a", 3, 1.5, "trace-max")
+    cs.record("eq", "host", "skel-b", 5, 1.0, "trace-small")
+    cs.record("sort", "device", "", 0, float(1 << 19) + 1, "t-inf")
+    text = cs.render_prometheus()
+    lines = text.splitlines()
+    assert lines[0] == "# TYPE dgraph_stage_duration_us histogram"
+    # per-(stage, tier) aggregation across skeleton/bucket keys
+    want_eq = []
+    cum = 0
+    for i in range(N_BUCKETS):
+        if i == 0:
+            cum += 1  # 1.0 -> le=1
+        if i == 1:
+            cum += 1  # 1.5 -> le=2
+        want_eq.append(f'dgraph_stage_duration_us_bucket'
+                       f'{{stage="eq",tier="host",'
+                       f'le="{BUCKETS_US[i]:g}"}} {cum}')
+        if i == 1:
+            # the exemplar rides the max observation's bucket, on its
+            # OWN comment line: text format 0.0.4 has no inline
+            # exemplar grammar, and a trailing token on the sample
+            # line would abort a real Prometheus scrape
+            want_eq.append('# exemplar: {trace_id="trace-max"} 1.5')
+    want_eq.append('dgraph_stage_duration_us_bucket'
+                   '{stage="eq",tier="host",le="+Inf"} 2')
+    want_eq.append('dgraph_stage_duration_us_count'
+                   '{stage="eq",tier="host"} 2')
+    want_eq.append('dgraph_stage_duration_us_sum'
+                   '{stage="eq",tier="host"} 2.5')
+    assert lines[1:1 + len(want_eq)] == want_eq
+    # the over-range observation exemplars on the +Inf bucket
+    i_inf = next(i for i, ln in enumerate(lines)
+                 if 'stage="sort"' in ln and 'le="+Inf"' in ln)
+    assert lines[i_inf + 1] == '# exemplar: {trace_id="t-inf"} 524289'
+    # every sample line stays a clean 0.0.4 `series value` pair — a
+    # trailing exemplar token would break standard scrapers
+    for ln in lines:
+        if not ln.startswith("#"):
+            assert len(ln.split(" ")) == 2, ln
+    assert CostStore().render_prometheus() == ""
+
+
+def test_registered_renderer_rides_metrics_exposition():
+    metrics.reset()
+    coststore.reset()
+    coststore.record("eq", "host", "", 0, 2.0, "tx")
+    text = metrics.render_prometheus()
+    assert "# TYPE dgraph_stage_duration_us histogram" in text
+    assert 'trace_id="tx"' in text
+    coststore.reset()
+    assert "dgraph_stage_duration_us" not in metrics.render_prometheus()
+
+
+def test_global_store_is_always_on():
+    coststore.reset()
+    with tracing.span("encode"):
+        pass
+    assert coststore.stats()["observations"] == 1
+    coststore.reset()
+
+
+def test_collects_while_trace_ring_disabled():
+    """tracing.set_enabled(False) gates span RETENTION only: the
+    coststore observer keeps firing (always-on contract), while the
+    ring stays empty."""
+    coststore.reset()
+    tracing.set_enabled(False)
+    try:
+        with tracing.span("encode") as args:
+            args["trace_probe"] = True
+        assert coststore.stats()["observations"] == 1
+        with tracing._lock:
+            assert not any(s.get("args", {}).get("trace_probe")
+                           for s in tracing._spans)
+    finally:
+        tracing.set_enabled(True)
+        coststore.reset()

@@ -1,0 +1,141 @@
+"""tools/dgtop.py: the cluster statistics view — pure fold/render
+functions on canned payloads, plus one live poll against a real
+server's /debug/stats + /debug/requests."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tools.dgtop import (
+    _histo_mean, hottest, node_row, poll, render, slowest_stages)
+
+
+def _snap(t=100.0, queries=50.0, shed=2.0, hits=40.0, misses=10.0,
+          recent=None, tablets=None, cost=None):
+    return {
+        "stats": {
+            "counters": {"dgraph_num_queries_total": queries,
+                         "dgraph_queries_shed_total": shed,
+                         "plan_cache_hits": hits,
+                         "plan_cache_misses": misses},
+            "histograms": {"batch_occupancy": {
+                "buckets": [2, 2, 0], "sum": 12.0}},
+            "tablets": tablets or {},
+            "cost": cost or [],
+            "planCache": {"plans": 7},
+            "costStore": {"keys": 3},
+            "maxAssigned": 42,
+        },
+        "requests": {"recent": recent or []},
+        "t": t,
+    }
+
+
+def test_node_row_first_frame_absolute_counts():
+    row = node_row(_snap(), None)
+    assert row["qps"] == 50.0 and row["shed"] == 2.0
+    assert row["hit_rate"] == 0.8
+    assert row["plans"] == 7 and row["cost_keys"] == 3
+    assert row["batch_occ"] == 3.0  # 12.0 / 4 samples
+    assert row["max_assigned"] == 42
+
+
+def test_node_row_rates_are_deltas_between_polls():
+    prev = _snap(t=100.0, queries=50.0, shed=2.0)
+    cur = _snap(t=110.0, queries=150.0, shed=7.0)
+    row = node_row(cur, prev)
+    assert row["qps"] == pytest.approx(10.0)
+    assert row["shed"] == pytest.approx(0.5)
+
+
+def test_node_row_latency_percentiles_from_reqlog():
+    recent = [{"op": "query", "latency_ms": float(i)}
+              for i in range(1, 101)]
+    recent.append({"op": "mutate", "latency_ms": 9999.0})  # excluded
+    row = node_row(_snap(recent=recent), None)
+    assert row["p50"] == 51.0
+    assert row["p99"] == 100.0
+
+
+def test_node_row_empty_edges():
+    snap = _snap(hits=0.0, misses=0.0)
+    snap["stats"]["histograms"] = {}
+    row = node_row(snap, None)
+    assert row["hit_rate"] is None
+    assert row["batch_occ"] is None
+    assert row["p50"] == 0.0
+
+
+def test_histo_mean():
+    assert _histo_mean(None) is None
+    assert _histo_mean({"buckets": [], "sum": 0.0}) is None
+    assert _histo_mean({"buckets": [1, 3], "sum": 8.0}) == 2.0
+
+
+def test_hottest_tablets_cluster_wide_order():
+    a = _snap(tablets={"name": {"touches": 5, "edges": 10,
+                                "bytesAtRest": 100, "dirtyOps": 1},
+                       "age": {"touches": 50, "edges": 3,
+                               "bytesAtRest": 30, "dirtyOps": 0}})
+    b = _snap(tablets={"name": {"touches": 20, "edges": 10,
+                                "bytesAtRest": 100, "dirtyOps": 0}})
+    rows = hottest({"n1": a, "n2": b, "down": None}, top=2)
+    assert [(r["predicate"], r["node"], r["touches"])
+            for r in rows] == [("age", "n1", 50), ("name", "n2", 20)]
+
+
+def test_slowest_stages_by_ewma():
+    a = _snap(cost=[{"stage": "sort", "tier": "host",
+                     "ewma_us": 900.0, "count": 4},
+                    {"stage": "eq", "tier": "host",
+                     "ewma_us": 10.0, "count": 90}])
+    b = _snap(cost=[{"stage": "expand", "tier": "device",
+                     "ewma_us": 5000.0, "count": 2}])
+    rows = slowest_stages({"n1": a, "n2": b}, top=2)
+    assert [(r["stage"], r["node"]) for r in rows] == \
+        [("expand", "n2"), ("sort", "n1")]
+
+
+def test_render_frame_rows_and_down_nodes():
+    frame = render({"alive": _snap(
+        tablets={"name": {"touches": 9, "edges": 1,
+                          "bytesAtRest": 10, "dirtyOps": 0}},
+        cost=[{"stage": "eq", "tier": "host", "ewma_us": 3.5,
+               "count": 2}]),
+        "dead": None})
+    assert "NODE" in frame and "QPS" in frame
+    assert "DOWN" in frame
+    assert "HOTTEST TABLETS" in frame and "name @ alive" in frame
+    assert "SLOWEST STAGES" in frame and "eq @ alive" in frame
+
+
+def test_live_poll_against_http_server():
+    from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu.server.http import serve
+
+    db = GraphDB(prefer_device=False)
+    db.alter(schema_text="name: string @index(exact) .")
+    db.mutate(set_nquads='_:a <name> "top" .')
+    httpd, _alpha = serve(db, host="127.0.0.1", port=0, block=False)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        body = json.dumps({"query":
+                           '{ q(func: eq(name, "top")) { name } }'})
+        req = urllib.request.Request(
+            base + "/query", body.encode(),
+            {"Content-Type": "application/json"})
+        urllib.request.urlopen(req).read()
+        snap = poll(base)
+        assert snap is not None
+        row = node_row(snap, None)
+        assert row["qps"] >= 1.0
+        assert row["tablets"] >= 1
+        frame = render({base: snap})
+        assert "name @ " in frame
+    finally:
+        httpd.shutdown()
+
+
+def test_poll_dead_node_is_none():
+    assert poll("http://127.0.0.1:9") is None  # discard port: refused

@@ -1,0 +1,181 @@
+"""Per-predicate tablet statistics (storage/tabstats.py) and the
+engine surfaces that expose them: db.debug_stats(), the enriched
+/state tablet summaries, and the query-path touch counter.
+
+The caching contract under test is the tablet-export discipline: the
+expensive base aggregate recomputes once per (base_ts, schema) — a
+rollup or alter invalidates it — while dirtyOps / touches / residency
+read live on every call.
+"""
+
+import numpy as np
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.storage.tabstats import (
+    FANOUT_BUCKETS, _fanout_hist, residency, tablet_stats,
+    tablet_summary,
+)
+
+SCHEMA = """
+name: string @index(term, exact) @lang .
+age: int @index(int) .
+follows: [uid] @reverse @count .
+"""
+
+
+def _db():
+    db = GraphDB(prefer_device=False)
+    db.alter(schema_text=SCHEMA)
+    quads = []
+    for i in range(1, 21):
+        quads.append(f'<0x{i:x}> <name> "person {i % 5}" .')
+    for i in range(1, 15):
+        quads.append(f'<0x{i:x}> <age> "{20 + i}" .')
+    for i in range(1, 11):
+        for j in range(i % 3 + 1):  # fan-out 1..3
+            quads.append(f'<0x{i:x}> <follows> <0x{(i + j) % 20 + 1:x}> .')
+    db.mutate(set_nquads="\n".join(quads))
+    # fold the overlay so base statistics see everything
+    wm = db.coordinator.max_assigned()
+    for tab in db.tablets.values():
+        tab.rollup(wm)
+    return db
+
+
+def test_uid_tablet_cardinalities():
+    db = _db()
+    st = tablet_stats(db.tablets["follows"])
+    assert st["predicate"] == "follows"
+    assert st["type"] == "uid"
+    assert st["nSrc"] == 10
+    edges = sum(i % 3 + 1 for i in range(1, 11))
+    assert st["nPostings"] == edges
+    assert st["edges"] == edges
+    assert st["valueTypes"] == {"uid": edges}
+    assert st["reverseEdges"] > 0
+    assert 0 < st["nDst"] <= 20
+    assert st["dirtyOps"] == 0
+    assert st["bytesAtRest"] > 0
+    # fan-out histogram: sizes 1..3, all within the first buckets
+    f = st["fanout"]
+    assert len(f["hist"]) == FANOUT_BUCKETS
+    assert sum(f["hist"]) == 10
+    assert f["max"] == 3
+    assert abs(f["avg"] - edges / 10) < 1e-9
+
+
+def test_value_tablet_types_and_token_index():
+    db = _db()
+    st = tablet_stats(db.tablets["name"])
+    assert st["type"] == "string"
+    assert st["nSrc"] == 20
+    assert st["valueTypes"] == {"string": 20}
+    assert st["indexed"] is True
+    assert set(st["tokenizers"]) == {"term", "exact"}
+    ti = st["tokenIndex"]
+    # term + exact tokens over "person {0..4}": person, 0..4, exact
+    assert ti["tokens"] > 0
+    assert ti["maxPostings"] >= ti["avgPostings"] > 0
+    age = tablet_stats(db.tablets["age"])
+    assert age["valueTypes"] == {"int": 14}
+
+
+def test_base_cache_invalidates_at_rollup():
+    db = _db()
+    tab = db.tablets["name"]
+    st1 = tablet_stats(tab)
+    assert tablet_stats(tab) == st1  # cached, stable
+    db.mutate(set_nquads='<0x30> <name> "newcomer" .')
+    st2 = tablet_stats(tab)
+    # base aggregate unchanged (same base_ts), overlay reported live
+    assert st2["nSrc"] == st1["nSrc"]
+    assert st2["dirtyOps"] == 1
+    tab.rollup(db.coordinator.max_assigned())
+    st3 = tablet_stats(tab)
+    assert st3["nSrc"] == st1["nSrc"] + 1
+    assert st3["dirtyOps"] == 0
+    assert st3["baseTs"] > st1["baseTs"]
+
+
+def test_residency_tracks_columnar_exports():
+    db = _db()
+    tab = db.tablets["name"]
+    before = residency(tab)
+    assert before["valueColumns"] == 0
+    # a columnar read materializes the value columns
+    db.query('{ q(func: eq(name, "person 1")) { name } }')
+    after = residency(tab)
+    assert after["valueColumns"] > 0
+    st = tablet_stats(tab)
+    assert st["bytesDecoded"] >= after["valueColumns"]
+    assert st["residency"]["valueColumns"] == after["valueColumns"]
+
+
+def test_residency_device_values_staleness_and_lang():
+    """deviceValues honors the _device_values_ts guard (a stale tile
+    whose companion ts lags base_ts reports 0) and sums the
+    per-language _device_values@<lang> tiles."""
+    db = _db()
+    tab = db.tablets["name"]
+    assert residency(tab)["deviceValues"] == 0
+    tile = np.arange(8, dtype=np.uint32)
+    tab._device_values = tile
+    tab._device_values_ts = tab.base_ts
+    setattr(tab, "_device_values@en", tile)
+    setattr(tab, "_device_values@en_ts", tab.base_ts)
+    assert residency(tab)["deviceValues"] == 2 * tile.nbytes
+    # invalidation resets only the ts, leaving the object attached —
+    # a stale tile must not count toward the decoded footprint
+    tab._device_values_ts = -1
+    setattr(tab, "_device_values@en_ts", -1)
+    assert residency(tab)["deviceValues"] == 0
+
+
+def test_touches_count_query_lookups():
+    db = _db()
+    t0 = db.tablets["name"].touches
+    db.query('{ q(func: has(name)) { name } }')
+    assert db.tablets["name"].touches > t0
+    assert db.tablets["follows"].touches == 0
+
+
+def test_tablet_summary_is_cheap_subset():
+    db = _db()
+    s = tablet_summary(db.tablets["follows"])
+    assert set(s) == {"predicate", "edges", "srcs", "bytes",
+                      "dirtyOps", "touches", "baseTs"}
+    assert s["srcs"] == 10
+
+
+def test_state_carries_tablet_summaries():
+    db = _db()
+    st = db.state()
+    (_, grp), = st["groups"].items()
+    assert grp["tablets"]["name"]["srcs"] == 20
+    assert grp["tablets"]["follows"]["edges"] > 0
+    assert "dirtyOps" in grp["tablets"]["name"]
+
+
+def test_debug_stats_payload():
+    db = _db()
+    db.query('{ q(func: has(name)) { name } }')
+    ds = db.debug_stats()
+    assert set(ds["tablets"]) == {"name", "age", "follows"}
+    assert ds["tablets"]["name"]["nSrc"] == 20
+    assert ds["schemaEpoch"] == db.schema_epoch
+    assert ds["planCache"]["plans"] >= 1
+    assert "deviceCache" in ds
+    # the query's stage spans landed in the observed-cost store
+    assert ds["costStore"]["observations"] > 0
+    stages = {c["stage"] for c in ds["cost"]}
+    assert "parse" in stages and "encode" in stages
+
+
+def test_fanout_hist_buckets():
+    h = _fanout_hist(np.array([1, 1, 2, 3, 1000, 2 ** 30], np.int64))
+    assert sum(h["hist"]) == 6
+    assert h["max"] == 2 ** 30
+    # the last bucket absorbs anything beyond the covered range
+    assert h["hist"][FANOUT_BUCKETS - 1] == 1
+    empty = _fanout_hist(np.empty(0, np.int64))
+    assert sum(empty["hist"]) == 0 and empty["max"] == 0

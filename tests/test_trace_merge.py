@@ -1,0 +1,163 @@
+"""tools/trace_merge.py: stitching per-node trace slices into one
+Perfetto timeline — multi-node lane assignment, orphaned parent links,
+and counter-track emission (the size-attr 'C' samples)."""
+
+import json
+
+import pytest
+
+from dgraph_tpu.utils import tracing
+from tools.trace_merge import (
+    _slice_spans, counter_events, mark_orphan_parents, merge_slices)
+
+
+def _span(name, *, sid="s1", parent=None, node=None, trace="aa" * 8,
+          ts=1.0, dur=2.0, **args):
+    rec = {"name": name, "trace_id": trace, "span_id": sid,
+           "parent_id": parent, "ts_us": ts, "dur_us": dur,
+           "tid": 1, "args": args}
+    if node is not None:
+        rec["node"] = node
+    return rec
+
+
+# ---------------------------------------------------- multi-node stitch
+
+
+def test_multi_node_slices_stitch_into_pid_lanes():
+    """Slices from three nodes land in three pid lanes; spans missing
+    a node inherit their slice's name; parent links across slices
+    resolve (no orphan flags)."""
+    root = _span("query", sid="r1", node="alpha-g1-n1")
+    child_a = _span("rpc.send", sid="c1", parent="r1",
+                    node="alpha-g1-n1", ts=1.5, dur=1.0)
+    # the receiving group's slice: node comes from the slice name
+    child_b = _span("rpc.recv", sid="c2", parent="c1", ts=1.6, dur=0.8)
+    zero = _span("rpc.recv", sid="c3", parent="r1", node="zero-n1",
+                 ts=1.7, dur=0.2)
+    events = merge_slices([("alpha-g1-n1", [root, child_a]),
+                           ("alpha-g2-n1", [child_b]),
+                           ("zero-n1", [zero])])
+    meta = {e["args"]["name"]: e["pid"] for e in events
+            if e["ph"] == "M"}
+    assert set(meta) == {"alpha-g1-n1", "alpha-g2-n1", "zero-n1"}
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == 4
+    assert {e["pid"] for e in xs} == set(meta.values())
+    # the node-less span got the slice's lane
+    recv = next(e for e in xs if e["args"].get("span_id") == "c2")
+    assert recv["pid"] == meta["alpha-g2-n1"]
+    # every parent resolved: nothing flagged
+    assert not any(e["args"].get("parent_orphan") for e in xs)
+    json.dumps(events)  # must be trace-event JSON serializable
+
+
+def test_merge_filters_foreign_traces():
+    keep = _span("query", sid="k1", trace="bb" * 8)
+    drop = _span("query", sid="d1", trace="cc" * 8)
+    events = merge_slices([("n1", [keep, drop])], trace_id="bb" * 8)
+    xs = [e for e in events if e["ph"] == "X"]
+    assert [e["args"]["span_id"] for e in xs] == ["k1"]
+
+
+def test_merge_orders_spans_by_start_time():
+    late = _span("encode", sid="l1", ts=9.0)
+    early = _span("parse", sid="e1", ts=1.0)
+    events = merge_slices([("n1", [late]), ("n2", [early])])
+    xs = [e for e in events if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["parse", "encode"]
+
+
+def test_merge_live_ring_slices():
+    """End to end against the real tracing ring: two bound nodes, one
+    trace id, merged into two lanes."""
+    tracing.clear()
+    with tracing.bind("dd" * 8, node="nodeA"):
+        with tracing.span("query", rows=3):
+            pass
+    a = tracing.spans_for("dd" * 8)
+    b = [dict(s, node="nodeB", name="rpc.recv") for s in a]
+    events = merge_slices([("nodeA", a), ("nodeB", b)],
+                          trace_id="dd" * 8)
+    assert len({e["pid"] for e in events if e["ph"] == "X"}) == 2
+
+
+# ------------------------------------------------- orphan parent links
+
+
+def test_orphan_parent_flagged():
+    """A parent_id pointing at a span the merge never saw (node not
+    polled / ring rotated) flags the child, and ONLY the child."""
+    root = _span("query", sid="r1")
+    orphan = _span("rpc.recv", sid="o1", parent="gone", ts=2.0)
+    child = _span("parse", sid="p1", parent="r1", ts=3.0)
+    spans = [root, orphan, child]
+    n = mark_orphan_parents(spans)
+    assert n == 1
+    assert orphan["args"]["parent_orphan"] is True
+    assert "parent_orphan" not in root["args"]
+    assert "parent_orphan" not in child["args"]
+
+
+def test_orphan_flag_reaches_emitted_events():
+    root = _span("query", sid="r1")
+    orphan = _span("rpc.recv", sid="o1", parent="gone", ts=2.0)
+    events = merge_slices([("n1", [root, orphan])])
+    by_sid = {e["args"].get("span_id"): e for e in events
+              if e["ph"] == "X"}
+    assert by_sid["o1"]["args"]["parent_orphan"] is True
+    assert "parent_orphan" not in by_sid["r1"]["args"]
+
+
+def test_rootless_spans_are_not_orphans():
+    """parent_id=None is a legitimate root, never an orphan."""
+    assert mark_orphan_parents([_span("query", sid="r1")]) == 0
+
+
+# ---------------------------------------------- counter-track emission
+
+
+def test_counter_events_from_size_attrs():
+    """Spans carrying numeric rows/n/edges args contribute ONE 'C'
+    sample each (priority rows > n > edges), at the span's start, on
+    the span's node lane."""
+    spans = [
+        _span("eq", sid="s1", node="n1", ts=1.0, rows=40, n=7),
+        _span("expand", sid="s2", node="n2", ts=2.0, edges=9000),
+        _span("parse", sid="s3", node="n1", ts=3.0),        # no size
+        _span("sort", sid="s4", node="n1", ts=4.0, rows="x"),  # non-num
+        _span("eq", sid="s5", node="n1", ts=5.0, rows=True),   # bool
+    ]
+    out = counter_events(spans)
+    assert [(e["name"], e["ts"], e["args"]) for e in out] == [
+        ("eq.rows", 1.0, {"rows": 40.0}),
+        ("expand.edges", 2.0, {"edges": 9000.0}),
+    ]
+    assert all(e["ph"] == "C" for e in out)
+    # pid lanes match chrome_events' assignment (sorted nodes, 1-based)
+    assert out[0]["pid"] == 1 and out[1]["pid"] == 2
+
+
+def test_merge_emits_counters_alongside_spans():
+    spans = [_span("eq", sid="s1", node="n1", rows=12)]
+    events = merge_slices([("n1", spans)])
+    phs = {e["ph"] for e in events}
+    assert phs == {"M", "X", "C"}
+    c = next(e for e in events if e["ph"] == "C")
+    x = next(e for e in events if e["ph"] == "X")
+    assert c["name"] == "eq.rows" and c["pid"] == x["pid"]
+
+
+# ----------------------------------------------------- slice adapters
+
+
+def test_slice_spans_accepts_all_shapes():
+    rec = _span("query", sid="s1")
+    assert _slice_spans([rec], "n")[0]["span_id"] == "s1"
+    assert _slice_spans({"spans": [rec]}, "n")[0]["span_id"] == "s1"
+    assert _slice_spans({"traceEvents": [
+        {"ph": "X", "name": "query", "ts": 1.0, "dur": 2.0, "pid": 1,
+         "tid": 1, "args": {"span_id": "s1", "trace_id": "aa" * 8}},
+    ], "node": "n"}, "n")[0]["span_id"] == "s1"
+    with pytest.raises(ValueError):
+        _slice_spans({"nope": 1}, "n")

@@ -37,4 +37,10 @@ echo "== span overhead =="
 # paths; tests/test_tracing.py enforces the same budget with CI slack)
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --span-overhead
 
+echo "== stats overhead =="
+# the always-on statistics plane (coststore span observer + tablet
+# touch counters) must cost < 1% on the golden summary workload;
+# non-zero exit = over budget (DGRAPH_TPU_STATS_BUDGET overrides)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --stats-overhead
+
 echo "ok"

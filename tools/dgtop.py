@@ -1,0 +1,229 @@
+"""dgtop: the cluster's statistics plane as one live terminal table.
+
+Polls every node's observability endpoints —
+
+    /debug/stats       tablet statistics, observed-cost summaries,
+                       plan/device cache states, metrics counters
+    /debug/requests    the bounded recent/slowest request ring
+
+— and folds them into a refreshing cluster view: per-node QPS,
+latency percentiles, shed rate, plan-cache hit rate, batch occupancy,
+and the cluster's hottest predicates/tablets by query-path touches.
+The reference ships /state and debug latency per query; this is the
+"self-driving" counterpart — the SAME numbers the planned cost-based
+router consumes, read by a human.
+
+Usage:
+
+    python -m tools.dgtop http://localhost:8080 [http://host:port ...]
+    python -m tools.dgtop --once --interval 2 http://localhost:8080
+
+`--once` prints a single snapshot (CI / scripting); otherwise the
+table redraws every `--interval` seconds until interrupted. Rates
+(QPS, shed) are deltas between consecutive polls; the first frame
+shows absolute counts. Stdlib-only on purpose: this runs where the
+operator is, not where the wheels are.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Any, Optional
+
+
+def fetch(base: str, path: str, token: str = "",
+          timeout_s: float = 3.0) -> Optional[dict]:
+    """GET one endpoint; None on any failure (a dead node renders as
+    a dash-filled row, it never kills the loop)."""
+    req = urllib.request.Request(base.rstrip("/") + path)
+    if token:
+        req.add_header("X-Dgraph-AccessToken", token)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode())
+    except Exception:  # noqa: BLE001 — any transport failure = down
+        return None
+
+
+def poll(base: str, token: str = "") -> Optional[dict]:
+    """One node's combined observability snapshot."""
+    stats = fetch(base, "/debug/stats", token)
+    if stats is None:
+        return None
+    reqs = fetch(base, "/debug/requests", token) or {}
+    return {"stats": stats, "requests": reqs, "t": time.monotonic()}
+
+
+def _pct(lat: list[float], q: float) -> float:
+    if not lat:
+        return 0.0
+    s = sorted(lat)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def node_row(snap: dict, prev: Optional[dict]) -> dict:
+    """Fold one node's snapshot (+ previous poll for rates) into the
+    table row. Pure — the tests drive it with canned payloads."""
+    stats = snap["stats"]
+    counters = stats.get("counters", {})
+    recent = snap["requests"].get("recent", [])
+    dt = None
+    if prev is not None:
+        dt = max(1e-6, snap["t"] - prev["t"])
+
+    def rate(name: str) -> float:
+        cur = counters.get(name, 0.0)
+        if dt is None:
+            return float(cur)
+        return (cur - prev["stats"].get("counters", {})
+                .get(name, 0.0)) / dt
+
+    qps = rate("dgraph_num_queries_total")
+    shed = rate("dgraph_queries_shed_total")
+    hits = counters.get("plan_cache_hits", 0.0)
+    misses = counters.get("plan_cache_misses", 0.0)
+    lat = [r.get("latency_ms", 0.0) for r in recent
+           if r.get("op") == "query"]
+    occ = _histo_mean(stats.get("histograms", {})
+                      .get("batch_occupancy", None))
+    return {
+        "qps": qps,
+        "shed": shed,
+        "p50": _pct(lat, 0.50),
+        "p99": _pct(lat, 0.99),
+        "hit_rate": hits / (hits + misses) if hits + misses else None,
+        "batch_occ": occ,
+        "plans": (stats.get("planCache") or {}).get("plans", 0),
+        "tablets": len(stats.get("tablets", {})),
+        "cost_keys": (stats.get("costStore") or {}).get("keys", 0),
+        "max_assigned": stats.get("maxAssigned", 0),
+    }
+
+
+def _histo_mean(h: Optional[dict]) -> Optional[float]:
+    if not h:
+        return None
+    n = sum(h.get("buckets", []))
+    return (h.get("sum", 0.0) / n) if n else None
+
+
+def hottest(snaps: dict[str, dict], top: int = 5) -> list[dict]:
+    """Cluster-wide hottest tablets by query-path touches, with their
+    cheap size facts. Pure — tests drive it with canned payloads."""
+    rows = []
+    for node, snap in snaps.items():
+        if snap is None:
+            continue
+        for pred, st in snap["stats"].get("tablets", {}).items():
+            rows.append({
+                "node": node, "predicate": pred,
+                "touches": st.get("touches", 0),
+                "edges": st.get("edges", 0),
+                "bytes": st.get("bytesAtRest", st.get("bytes", 0)),
+                "decoded": st.get("bytesDecoded", 0),
+                "dirty": st.get("dirtyOps", 0),
+            })
+    rows.sort(key=lambda r: (-r["touches"], r["predicate"], r["node"]))
+    return rows[:top]
+
+
+def slowest_stages(snaps: dict[str, dict], top: int = 5) -> list[dict]:
+    """Cluster-wide slowest stage costs by EWMA from the coststore."""
+    rows = []
+    for node, snap in snaps.items():
+        if snap is None:
+            continue
+        for ent in snap["stats"].get("cost", []):
+            rows.append({"node": node, "stage": ent["stage"],
+                         "tier": ent["tier"],
+                         "ewma_us": ent["ewma_us"],
+                         "count": ent["count"]})
+    rows.sort(key=lambda r: -r["ewma_us"])
+    return rows[:top]
+
+
+def _fmt(v, unit: str = "", nd: int = 1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}{unit}"
+    return f"{v}{unit}"
+
+
+def render(snaps: dict[str, dict],
+           prev: Optional[dict[str, dict]] = None) -> str:
+    """The full frame: one row per node + hottest tablets + slowest
+    stages. Pure string building (tests golden-match pieces of it)."""
+    hdr = (f"{'NODE':<28} {'QPS':>7} {'P50MS':>7} {'P99MS':>7} "
+           f"{'SHED/S':>7} {'HIT%':>6} {'OCC':>5} {'PLANS':>6} "
+           f"{'TABLETS':>8} {'COSTK':>6}")
+    lines = [hdr, "-" * len(hdr)]
+    for node in sorted(snaps):
+        snap = snaps[node]
+        if snap is None:
+            lines.append(f"{node:<28} {'DOWN':>7}")
+            continue
+        row = node_row(snap, (prev or {}).get(node))
+        hit = "-" if row["hit_rate"] is None \
+            else f"{100 * row['hit_rate']:.0f}"
+        lines.append(
+            f"{node:<28} {row['qps']:>7.1f} {row['p50']:>7.1f} "
+            f"{row['p99']:>7.1f} {row['shed']:>7.1f} {hit:>6} "
+            f"{_fmt(row['batch_occ']):>5} {row['plans']:>6} "
+            f"{row['tablets']:>8} {row['cost_keys']:>6}")
+    hot = hottest(snaps)
+    if hot:
+        lines.append("")
+        lines.append(f"{'HOTTEST TABLETS':<40} {'TOUCHES':>9} "
+                     f"{'EDGES':>9} {'BYTES':>10} {'DIRTY':>6}")
+        for r in hot:
+            lines.append(
+                f"{r['predicate'] + ' @ ' + r['node']:<40} "
+                f"{r['touches']:>9} {r['edges']:>9} "
+                f"{r['bytes']:>10} {r['dirty']:>6}")
+    slow = slowest_stages(snaps)
+    if slow:
+        lines.append("")
+        lines.append(f"{'SLOWEST STAGES (EWMA)':<40} {'TIER':>7} "
+                     f"{'EWMA_US':>9} {'COUNT':>7}")
+        for r in slow:
+            lines.append(f"{r['stage'] + ' @ ' + r['node']:<40} "
+                         f"{r['tier']:>7} {r['ewma_us']:>9.1f} "
+                         f"{r['count']:>7}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dgtop", description=__doc__.split("\n\n")[0])
+    ap.add_argument("nodes", nargs="+",
+                    help="node base URLs (http://host:port)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    ap.add_argument("--token", default="",
+                    help="X-Dgraph-AccessToken for ACL clusters")
+    args = ap.parse_args(argv)
+
+    prev: Optional[dict[str, Any]] = None
+    while True:
+        snaps = {n: poll(n, args.token) for n in args.nodes}
+        frame = render(snaps, prev)
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        prev = snaps
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

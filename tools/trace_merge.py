@@ -60,11 +60,59 @@ def _slice_spans(obj, fallback_node: str) -> list[dict]:
     raise ValueError("unrecognized trace slice shape")
 
 
+# span args emitted as Perfetto counter tracks ('C' events): one
+# sample per carrying span, so row/edge volumes render as a graph
+# under the node's lane alongside its spans
+_COUNTER_KEYS = ("rows", "n", "edges")
+
+
+def mark_orphan_parents(spans: list[dict]) -> int:
+    """Flag spans whose parent_id resolves to no span in the merged
+    set (the parent's node was not polled, or its ring rotated the
+    span out): `args.parent_orphan = true` in the emitted event, so a
+    dangling link reads as a COLLECTION gap in Perfetto, not as a
+    mysterious self-rooted stage. Returns the orphan count. Mutates
+    copies only — callers pass the already-copied merge set."""
+    ids = {s.get("span_id") for s in spans}
+    n = 0
+    for s in spans:
+        p = s.get("parent_id")
+        if p and p not in ids:
+            s["args"] = dict(s.get("args") or (), parent_orphan=True)
+            n += 1
+    return n
+
+
+def counter_events(spans: list[dict]) -> list[dict]:
+    """Perfetto counter tracks from span size attrs: every span
+    carrying a numeric rows/n/edges arg contributes one 'C' sample at
+    its start timestamp on its node's pid lane. Pid assignment matches
+    chrome_events (the shared tracing.node_pids map) so counters land
+    in the same process lanes as the spans they annotate."""
+    from dgraph_tpu.utils.tracing import node_pids
+
+    pid = node_pids(spans)
+    out = []
+    for s in spans:
+        args = s.get("args") or {}
+        for k in _COUNTER_KEYS:
+            v = args.get(k)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out.append({"name": f"{s['name']}.{k}", "ph": "C",
+                            "ts": s.get("ts_us", 0.0),
+                            "pid": pid[s.get("node", "local")],
+                            "args": {k: float(v)}})
+                break  # one sample per span: the primary size attr
+    return out
+
+
 def merge_slices(slices: Iterable[tuple[str, list[dict]]],
                  trace_id: Optional[str] = None) -> list[dict]:
     """[(node_name, span_records)] -> Chrome trace events, one pid
-    lane per node. Span records missing a node get the slice's name;
-    with trace_id, other traces' spans are dropped."""
+    lane per node: 'X' spans (+ metadata lanes) from chrome_events,
+    'C' counter samples for size-carrying spans, and orphaned parent
+    links flagged in args. Span records missing a node get the
+    slice's name; with trace_id, other traces' spans are dropped."""
     from dgraph_tpu.utils.tracing import chrome_events
 
     spans: list[dict] = []
@@ -75,7 +123,8 @@ def merge_slices(slices: Iterable[tuple[str, list[dict]]],
                 continue
             spans.append(dict(s, node=s.get("node") or node_name))
     spans.sort(key=lambda s: s.get("ts_us", 0.0))
-    return chrome_events(spans)
+    mark_orphan_parents(spans)
+    return chrome_events(spans) + counter_events(spans)
 
 
 def _fetch_url(url: str, trace_id: Optional[str]) -> dict:
