@@ -200,58 +200,16 @@ def _conc_workload(db, scale: int) -> tuple[list, list]:
     return rep, mixed
 
 
-def _run_open_loop(submit, reqs: list, concurrency: int,
-                   rate_qps: float,
-                   burst_of: "list[int] | None" = None
-                   ) -> "list[float]":
-    """Open-loop arrivals: one global schedule at `rate_qps` offered
-    load, `concurrency` workers pull the next request as they free
-    up; latency = finish - SCHEDULED arrival (queueing counts, the
-    open-loop property). `burst_of[i]` assigns request i to an
-    arrival slot — requests sharing a slot arrive at the same
-    instant (fan-out bursts)."""
-    import threading
-
-    t0 = time.perf_counter() + 0.05
-    if burst_of is None:
-        arrivals = [t0 + i / rate_qps for i in range(len(reqs))]
-    else:
-        slots = burst_of[-1] + 1
-        slot_rate = rate_qps * slots / len(reqs)
-        arrivals = [t0 + s / slot_rate for s in burst_of]
-    lat = [0.0] * len(reqs)
-    nxt = [0]
-    lock = threading.Lock()
-
-    def worker():
-        while True:
-            with lock:
-                i = nxt[0]
-                if i >= len(reqs):
-                    return
-                nxt[0] += 1
-            wait = arrivals[i] - time.perf_counter()
-            if wait > 0:
-                time.sleep(wait)
-            submit(reqs[i])
-            lat[i] = time.perf_counter() - arrivals[i]
-
-    threads = [threading.Thread(target=worker)
-               for _ in range(concurrency)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    return lat
-
-
-def _pcts(lat) -> dict:
-    import numpy as np
-
-    a = np.asarray(lat) * 1e3
-    return {"p50_ms": round(float(np.percentile(a, 50)), 3),
-            "p99_ms": round(float(np.percentile(a, 99)), 3),
-            "mean_ms": round(float(a.mean()), 3)}
+# the open-loop arrival scheduler + percentile summarizers moved to
+# the shared bench module (dgraph_tpu/bench/openloop.py) so this
+# gate, tools/dgbench.py and the CI load smoke agree on what
+# "offered load" and "p99" mean; the local names stay as aliases
+# (BENCH_BATCH.json schema unchanged)
+from dgraph_tpu.bench.openloop import (  # noqa: E402
+    occupancy as _occupancy,
+    percentiles as _pcts,
+    run_open_loop as _run_open_loop,
+)
 
 
 def main_concurrency(concurrency: int) -> int:
@@ -426,8 +384,8 @@ def main_concurrency(concurrency: int) -> int:
                                     "concurrency": concurrency},
             "batched": {**_pcts(bat_lat), "concurrency": concurrency,
                         "dispatches": dispatches,
-                        "mean_occupancy": round(
-                            CONC_REQUESTS / max(dispatches, 1), 2)},
+                        "mean_occupancy": _occupancy(CONC_REQUESTS,
+                                                     dispatches)},
         },
         "speedups": {
             "warm_vs_interpreted_p50": round(

@@ -1,0 +1,17 @@
+"""Shared benchmark plumbing.
+
+The repo's benchmark entry points (bench_queries.py --concurrency,
+tools/dgbench.py, the tools/check.sh load smoke) all drive the same
+two primitives:
+
+  openloop   the open-loop arrival scheduler + latency/percentile
+             summarizers (latency = finish - SCHEDULED arrival, so
+             queueing counts — the property closed-loop harnesses
+             can't measure)
+  workload   the seeded LDBC-SNB-style social-graph generator and
+             deterministic mixed read/write op stream
+
+Keeping them here (inside the package, importable from any entry
+point) is what lets a regression gate and a capacity probe agree on
+what "offered load" and "p99" mean.
+"""

@@ -1,0 +1,203 @@
+"""Spawn/teardown of a real multi-group, multi-process cluster.
+
+The reference load-tests against docker-compose topologies (compose/
+compose.go emits N zeros x G groups x R replicas); this module is that
+topology as subprocesses of the EXISTING CLI — every node is a real
+`python -m dgraph_tpu node` process on real sockets, nothing shares a
+GIL with the load generator. Used by tools/dgbench.py and the
+tools/check.sh load smoke; tests spawn the same shape ad hoc
+(tests/test_multigroup.py) and can migrate here.
+
+Each node gets a --debug-port (the read-only observability listener,
+server/debug_http.py) so collectors scrape HTTP; data traffic flows
+over the cluster wire via the returned RoutedCluster.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Optional
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def free_ports(n: int) -> list[int]:
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class ProcessCluster:
+    """`zeros` zero nodes (one Raft quorum) + `groups` alpha groups of
+    `replicas` each, spawned via the CLI. `log_dir` captures each
+    node's stderr (the run report's per-node logs); `max_pending`
+    turns on wire-surface admission control on every alpha."""
+
+    def __init__(self, groups: int = 2, replicas: int = 1,
+                 zeros: int = 1, max_pending: int = 0,
+                 log_dir: Optional[str] = None,
+                 tick_ms: int = 30, election_ticks: int = 8,
+                 env_extra: Optional[dict] = None):
+        self.groups_n = groups
+        self.replicas = replicas
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.debug_urls: dict[str, str] = {}
+        self.zero_addrs: dict[int, tuple[str, int]] = {}
+        self.group_addrs: dict[int, dict[int, tuple[str, int]]] = {}
+        self._logs: list = []
+        self._env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+            "JAX_PLATFORMS", "cpu"), PYTHONPATH=_REPO)
+        if env_extra:
+            self._env.update(env_extra)
+        self._tick = ["--tick-ms", str(tick_ms),
+                      "--election-ticks", str(election_ticks)]
+        self.log_dir = log_dir
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+
+        # zero quorum
+        zports = free_ports(3 * zeros)
+        zraft = {i + 1: ("127.0.0.1", zports[3 * i])
+                 for i in range(zeros)}
+        zpeers = ",".join(f"{i}={h}:{p}" for i, (h, p) in zraft.items())
+        for i in range(1, zeros + 1):
+            cport, dport = zports[3 * (i - 1) + 1], zports[3 * (i - 1) + 2]
+            self.zero_addrs[i] = ("127.0.0.1", cport)
+            self._spawn(f"zero-n{i}", [
+                "--kind", "zero", "--id", str(i),
+                "--raft-peers", zpeers,
+                "--client-addr", f"127.0.0.1:{cport}",
+                "--debug-port", str(dport)])
+        zero_spec = ",".join(f"{i}={h}:{p}"
+                             for i, (h, p) in self.zero_addrs.items())
+
+        # alpha groups
+        for g in range(1, groups + 1):
+            ports = free_ports(3 * replicas)
+            graft = {i + 1: ("127.0.0.1", ports[3 * i])
+                     for i in range(replicas)}
+            gpeers = ",".join(f"{i}={h}:{p}"
+                              for i, (h, p) in graft.items())
+            self.group_addrs[g] = {}
+            for i in range(1, replicas + 1):
+                cport = ports[3 * (i - 1) + 1]
+                dport = ports[3 * (i - 1) + 2]
+                self.group_addrs[g][i] = ("127.0.0.1", cport)
+                args = ["--kind", "alpha", "--id", str(i),
+                        "--group", str(g),
+                        "--raft-peers", gpeers,
+                        "--client-addr", f"127.0.0.1:{cport}",
+                        "--zero", zero_spec,
+                        "--debug-port", str(dport)]
+                if max_pending:
+                    args += ["--max-pending", str(max_pending)]
+                self._spawn(f"alpha-g{g}-n{i}", args)
+
+    def _spawn(self, name: str, args: list[str]):
+        if self.log_dir:
+            log = open(os.path.join(self.log_dir, name + ".log"), "w")
+            self._logs.append(log)
+        else:
+            log = subprocess.DEVNULL
+        dport = args[args.index("--debug-port") + 1]
+        self.debug_urls[name] = f"http://127.0.0.1:{dport}"
+        self.procs[name] = subprocess.Popen(
+            [sys.executable, "-m", "dgraph_tpu", "node"]
+            + args + self._tick,
+            env=self._env, cwd=_REPO,
+            stdout=subprocess.DEVNULL, stderr=log)
+
+    # ------------------------------------------------------------ clients
+
+    def routed(self, timeout: float = 30.0):
+        """A fresh RoutedCluster over this topology (caller closes)."""
+        from dgraph_tpu.cluster.client import ClusterClient
+        from dgraph_tpu.cluster.topology import RoutedCluster
+        zero = ClusterClient(self.zero_addrs, timeout=timeout)
+        groups = {g: ClusterClient(addrs, timeout=timeout)
+                  for g, addrs in self.group_addrs.items()}
+        return RoutedCluster(zero, groups)
+
+    def node_clients(self, timeout: float = 30.0) -> dict:
+        """One single-address ClusterClient per NODE (not per group):
+        the collector path — stats/traces/pprof ops hit a specific
+        process, not whoever the leader is."""
+        from dgraph_tpu.cluster.client import ClusterClient
+        out = {}
+        for i, addr in self.zero_addrs.items():
+            out[f"zero-n{i}"] = ClusterClient({1: addr},
+                                              timeout=timeout)
+        for g, members in self.group_addrs.items():
+            for i, addr in members.items():
+                out[f"alpha-g{g}-n{i}"] = ClusterClient(
+                    {1: addr}, timeout=timeout)
+        return out
+
+    # ------------------------------------------------------------- health
+
+    def wait_ready(self, timeout_s: float = 60.0):
+        """Every raft quorum (zero + each group) has a leader."""
+        from dgraph_tpu.cluster.client import ClusterClient
+        pending = {"zero": ClusterClient(self.zero_addrs, timeout=5.0)}
+        for g, addrs in self.group_addrs.items():
+            pending[f"g{g}"] = ClusterClient(addrs, timeout=5.0)
+        try:
+            end = time.monotonic() + timeout_s
+            ready: set[str] = set()
+            while time.monotonic() < end and len(ready) < len(pending):
+                for name, cl in pending.items():
+                    if name in ready:
+                        continue
+                    for node in list(cl.addrs):
+                        try:
+                            if cl.status(node).get("role") == "leader":
+                                ready.add(name)
+                                break
+                        except (ConnectionError, RuntimeError, KeyError):
+                            continue
+                if len(ready) < len(pending):
+                    time.sleep(0.2)
+            if len(ready) < len(pending):
+                raise TimeoutError(
+                    f"cluster not ready after {timeout_s}s: "
+                    f"missing {sorted(set(pending) - ready)}")
+        finally:
+            for cl in pending.values():
+                cl.close()
+
+    def alive(self) -> list[str]:
+        return [n for n, p in self.procs.items() if p.poll() is None]
+
+    def teardown(self):
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 5.0
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        for log in self._logs:
+            try:
+                log.close()
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.teardown()

@@ -194,17 +194,21 @@ def cmd_node(args) -> int:
         from dgraph_tpu.cluster.raft import DiskStorage
         storage = DiskStorage(args.wal, sync=args.sync)
     kw = dict(storage=storage, tick_s=args.tick_ms / 1000.0,
-              election_ticks=args.election_ticks)
+              election_ticks=args.election_ticks,
+              debug_port=args.debug_port, debug_host=args.debug_host)
     if args.kind == "alpha":
         zero_addrs = _parse_peers(args.zero) if args.zero else None
         srv = AlphaServer(args.id, peers, (chost, int(cport)),
                           group=args.group, replicas=args.replicas,
                           zero_addrs=zero_addrs,
+                          max_pending=args.max_pending,
                           snapshot=getattr(args, "snapshot", ""), **kw)
     else:
         srv = ZeroServer(args.id, peers, (chost, int(cport)), **kw)
     print(f"dgraph-tpu {args.kind} node {args.id}: raft "
-          f"{peers[args.id]}, client {srv.client_addr}", file=sys.stderr,
+          f"{peers[args.id]}, client {srv.client_addr}"
+          + (f", debug http {args.debug_host}:{args.debug_port}"
+             if args.debug_port else ""), file=sys.stderr,
           flush=True)
     srv.serve_forever()
     return 0
@@ -880,6 +884,22 @@ def main(argv=None) -> int:
     n.add_argument("--sync", action="store_true")
     n.add_argument("--tick-ms", type=int, default=50)
     n.add_argument("--election-ticks", type=int, default=10)
+    n.add_argument("--debug-port", type=int, default=0,
+                   help="serve the read-only debug/observability "
+                        "HTTP surface (/debug/stats, /debug/requests, "
+                        "/debug/prometheus_metrics, /debug/traces, "
+                        "/debug/pprof) on this port — the reference's "
+                        "per-node pprof/expvar mux. 0 = off")
+    n.add_argument("--debug-host", default="127.0.0.1",
+                   help="bind address for --debug-port (keep it "
+                        "localhost/scrape-net: the surface is "
+                        "unauthenticated by design)")
+    n.add_argument("--max-pending", type=int, default=0,
+                   help="alpha only: admission control on the wire "
+                        "surface — max concurrently served "
+                        "query/mutate/task ops; excess sheds typed "
+                        "(retryable) like the HTTP edge's 429. "
+                        "0 = unbounded")
     n.set_defaults(fn=cmd_node)
 
     ct = sub.add_parser("cert", help="TLS certificate management")

@@ -53,7 +53,9 @@ class RaftServer:
                  client_addr: tuple[str, int],
                  storage=None, tick_s: float = 0.05,
                  election_ticks: int = 10,
-                 snapshot_every: int = 2048):
+                 snapshot_every: int = 2048,
+                 debug_port: int = 0,
+                 debug_host: str = "127.0.0.1"):
         self.id = node_id
         # conf-changed membership persisted in raft storage wins over
         # the CLI's --raft-peers on restart (ref zero/raft.go member
@@ -114,6 +116,22 @@ class RaftServer:
             threading.Thread(target=self._client_accept_loop, daemon=True,
                              name=f"client-accept-{node_id}"),
         ]
+
+        # read-only debug/observability HTTP listener (stats, request
+        # ring, Prometheus text, trace slices, sampling profiler) —
+        # the reference wires its pprof/expvar mux onto every node
+        # (x/metrics.go); collectors (tools/dgtop.py, tools/dgbench.py)
+        # scrape it without speaking the framed wire protocol. 0 = off.
+        self.debug_httpd = None
+        if debug_port:
+            from dgraph_tpu.server.debug_http import serve_debug
+            self.debug_httpd, dport = serve_debug(
+                stats_fn=self.debug_stats_payload,
+                health_fn=self.health_payload,
+                node_name=self.node_name,
+                host=debug_host, port=debug_port)
+            log.info("debug_http_listening", node=self.node_name,
+                     port=dport)
 
         # restore-from-disk snapshot surfaces on the first ready();
         # only then open the floodgates (transport.start) so no inbound
@@ -313,6 +331,25 @@ class RaftServer:
                      if s.get("node") == self.node_name]
             return {"ok": True, "result": {"node": self.node_name,
                                            "spans": spans}}
+        if op == "pprof":
+            # on-demand wall-clock sampling profile of THIS process
+            # (the wire analogue of HTTP /debug/pprof, same payload):
+            # seconds=/hz=/format= ride the request dict. Blocks the
+            # serving connection for the window — by contract — but
+            # never the raft lock: sampling is lock-free.
+            from dgraph_tpu.utils import pprof
+            return {"ok": True, "result": pprof.handle_params(
+                {k: req[k] for k in ("seconds", "hz", "format")
+                 if k in req},
+                node=self.node_name)}
+        if op == "metrics_text":
+            # Prometheus text exposition over the cluster wire, for
+            # collectors (tools/dgbench.py) scraping nodes that run
+            # without the HTTP debug listener
+            from dgraph_tpu.utils import metrics
+            return {"ok": True,
+                    "result": {"node": self.node_name,
+                               "text": metrics.render_prometheus()}}
         if op == "conf_change":
             action = req.get("action")
             nid = int(req.get("node", 0))
@@ -444,9 +481,26 @@ class RaftServer:
 
     # ----------------------------------------------------------- lifecycle
 
+    def debug_stats_payload(self) -> dict:
+        """What this node kind contributes to /debug/stats on the
+        debug HTTP listener (counters/gauges/histograms are appended
+        by the listener itself). Subclasses override."""
+        return {"node": self.node_name}
+
+    def health_payload(self) -> dict:
+        with self.lock:
+            return {"id": self.id, "role": self.node.role,
+                    "leader": self.node.leader_id,
+                    "term": self.node.term}
+
     def close(self):
         self._stop.set()
         self.transport.close()
+        if self.debug_httpd is not None:
+            self.debug_httpd.shutdown()
+            self.debug_httpd.server_close()  # shutdown() only stops
+            # the loop; close the bound socket too or every closed
+            # node leaks one fd + one port
         try:
             self._client_listener.close()
         except OSError:
@@ -497,8 +551,18 @@ class AlphaServer(RaftServer):
                  storage=None, db_kw: Optional[dict] = None,
                  group: int = 1, replicas: int = 1,
                  zero_addrs: Optional[dict] = None,
-                 snapshot: str = "", **kw):
+                 snapshot: str = "", max_pending: int = 0, **kw):
         from dgraph_tpu.engine.db import GraphDB
+
+        # admission control on the wire surface (the cluster analogue
+        # of the HTTP edge's --max-pending): a bounded in-flight count
+        # over the work-bearing ops; excess load sheds TYPED
+        # (Overloaded -> `aborted` on the wire -> the caller's 429
+        # class) instead of queueing unboundedly on the serving locks.
+        # 0 = unbounded.
+        self.max_pending = max_pending
+        self._admission = threading.Lock()
+        self._inflight = 0
 
         # group=0 + a zero quorum = elastic join (ref zero/zero.go:410
         # Connect): zero assigns this node to the least-replicated
@@ -1161,7 +1225,38 @@ class AlphaServer(RaftServer):
 
     # ----------------------------------------------------------------- RPC
 
+    # work-bearing ops that consume engine/leader time — including
+    # cross-group 2PC STAGING (a shed xstage is safe: the coordinator
+    # aborts at zero and clears staged fragments, topology.py
+    # _mutate_multigroup). admin, stats and xfinalize are never shed:
+    # finalize carries an already-DECIDED transaction, and shedding it
+    # would stall that decision behind the very overload it relieves
+    _ADMITTED_OPS = ("query", "mutate", "task", "xstage")
+
     def handle_request(self, req: dict) -> dict:
+        if not self.max_pending \
+                or req.get("op") not in self._ADMITTED_OPS:
+            return self._handle_admitted(req)
+        from dgraph_tpu.utils import metrics
+        with self._admission:
+            if self._inflight >= self.max_pending:
+                metrics.inc_counter("dgraph_queries_shed_total")
+                raise Overloaded(
+                    f"node {self.node_name} is overloaded: "
+                    f"{self._inflight} requests in flight "
+                    f"(max_pending={self.max_pending}); retry with "
+                    "jittered backoff")
+            self._inflight += 1
+            metrics.set_gauge("dgraph_pending_queries", self._inflight)
+        try:
+            return self._handle_admitted(req)
+        finally:
+            with self._admission:
+                self._inflight -= 1
+                metrics.set_gauge("dgraph_pending_queries",
+                                  self._inflight)
+
+    def _handle_admitted(self, req: dict) -> dict:
         conf = self.handle_conf_request(req)
         if conf is not None:
             return conf
@@ -1479,7 +1574,9 @@ class AlphaServer(RaftServer):
             stats["node"] = self.node_name
             stats["group"] = self.group
             stats["requests"] = reqlog.snapshot()
+            metrics.collect_process_gauges()
             stats["counters"] = metrics.counters_snapshot()
+            stats["gauges"] = metrics.gauges_snapshot()
             stats["histograms"] = metrics.histograms_snapshot()
             return {"ok": True, "result": stats}
         if op == "export_tablet":
@@ -1508,6 +1605,29 @@ class AlphaServer(RaftServer):
             self._replicate_record(("drop_attr", req["pred"]))
             return {"ok": True, "result": {}}
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def debug_stats_payload(self) -> dict:
+        """The debug HTTP listener's /debug/stats body: the engine's
+        statistics plane + this node's identity and the request ring.
+        Same locking posture as the wire `stats` op — self.lock only
+        pins the db binding, the walk runs unlocked (debug_stats
+        degrades on concurrent-apply races rather than stalling raft)."""
+        from dgraph_tpu.utils import reqlog
+        with self.lock:
+            db = self.db
+        stats = db.debug_stats()
+        stats["node"] = self.node_name
+        stats["group"] = self.group
+        stats["requests"] = reqlog.snapshot()
+        return stats
+
+    def health_payload(self) -> dict:
+        out = super().health_payload()
+        out["group"] = self.group
+        with self._admission:
+            out["pending"] = self._inflight
+        out["maxPending"] = self.max_pending
+        return out
 
 
 class _MoveDataError(RuntimeError):

@@ -558,9 +558,25 @@ class AlphaServer:
         # every query arriving after one mutate behind the walk.
         # debug_stats retries/degrades on concurrent-mutation races.
         out = self.db.debug_stats()
+        metrics.collect_process_gauges()
         out["histograms"] = metrics.histograms_snapshot()
         out["counters"] = metrics.counters_snapshot()
+        out["gauges"] = metrics.gauges_snapshot()
         return out
+
+    def handle_pprof(self, params: Optional[dict] = None,
+                     token: str = "") -> dict:
+        """/debug/pprof?seconds=N&hz=H&format=collapsed|speedscope|
+        both — the on-demand wall-clock sampling profiler
+        (utils/pprof.py). The request thread blocks for the sampling
+        window (the Go pprof ?seconds= contract) and the response
+        carries collapsed-stack text and/or speedscope JSON.
+        ACL-gated like /state: stacks name code paths and predicates."""
+        if self.acl is not None:
+            with self.meta:
+                self.acl.authorize(token)
+        from dgraph_tpu.utils import pprof, tracing
+        return pprof.handle_params(params or {}, node=tracing.node())
 
     def handle_requests(self, token: str = "") -> dict:
         """/debug/requests: the bounded recent + slowest request log
@@ -877,6 +893,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, self.alpha.handle_requests(token))
             elif path == "/debug/stats":
                 self._send(200, self.alpha.handle_debug_stats(token))
+            elif path == "/debug/pprof":
+                self._send(200, self.alpha.handle_pprof(params, token))
             elif path == "/debug/prometheus_metrics":
                 from dgraph_tpu.utils.metrics import render_prometheus
 
@@ -898,6 +916,9 @@ class _Handler(BaseHTTPRequestHandler):
                         retryable=True)
         except Cancelled as e:
             self._error(str(e), 499, ecode="Cancelled")
+        except (ValueError, KeyError) as e:
+            # bad debug params (pprof format=, malformed seconds=)
+            self._error(str(e), 400)
         except Exception as e:  # noqa: BLE001 — surface as API error
             log.error("http_internal_error", path=path, error=str(e),
                       trace=traceback.format_exc()[-800:])

@@ -73,9 +73,15 @@ REGISTERED = (
     "query_similar_sharded_total",
     # cluster (cluster/transport.py)
     "raft_send_drops",
-    # process gauges (utils/metrics.py collect_memory_gauges)
+    # process gauges (utils/metrics.py collect_memory_gauges /
+    # collect_runtime_gauges)
     "memory_inuse_bytes",
     "memory_proc_bytes",
+    "process_gc_collections",
+    "process_gc_objects",
+    "process_open_fds",
+    "process_threads",
+    "process_uptime_seconds",
 )
 
 
@@ -150,6 +156,15 @@ def _fmt_key(k: tuple[str, tuple]) -> str:
     return f"{name}{{{inner}}}"
 
 
+def gauges_snapshot() -> dict[str, float]:
+    """Gauge state keyed by formatted series name — /debug/stats
+    carries it so dgtop's per-node RSS/thread columns (and any other
+    collector) read the process gauges without scraping and re-parsing
+    the text exposition."""
+    with _LOCK:
+        return {_fmt_key(k): v for k, v in _GAUGES.items()}
+
+
 def counters_snapshot() -> dict[str, float]:
     """Counter state keyed by formatted series name — the 'before'
     half of a per-request profile diff (server/http.py debug=true)."""
@@ -185,6 +200,44 @@ def collect_memory_gauges():
         pass
 
 
+# process start, for the uptime gauge: monotonic on purpose — an NTP
+# step must not make a node's uptime jump in a scrape series
+import time as _time_mod  # noqa: E402
+
+_STARTED_AT_MONO = _time_mod.monotonic()
+
+
+def collect_runtime_gauges():
+    """Process runtime gauges next to the memory ones (ref
+    x/metrics.go sampling Go runtime stats: goroutines, GC cycles):
+    open fds (a leaking transport shows here first), live threads, GC
+    generation object counts + cumulative collections, and uptime.
+    Cheap enough to run on every scrape/stats poll."""
+    import gc
+
+    set_gauge("process_threads", threading.active_count())
+    set_gauge("process_uptime_seconds",
+              round(_time_mod.monotonic() - _STARTED_AT_MONO, 3))
+    for gen, count in enumerate(gc.get_count()):
+        set_gauge("process_gc_objects", count,
+                  labels={"gen": str(gen)})
+    for gen, st in enumerate(gc.get_stats()):
+        set_gauge("process_gc_collections", st.get("collections", 0),
+                  labels={"gen": str(gen)})
+    try:
+        import os
+        set_gauge("process_open_fds", len(os.listdir("/proc/self/fd")))
+    except OSError:
+        pass  # non-Linux: no cheap fd count
+
+
+def collect_process_gauges():
+    """Memory + runtime gauges in one call — what the /debug/stats
+    handlers refresh so a poll always reads current values."""
+    collect_memory_gauges()
+    collect_runtime_gauges()
+
+
 # extra exposition renderers: other always-on stat planes (the
 # observed-cost store, utils/coststore.py) register a zero-arg
 # callable returning pre-formatted exposition text ("" when empty);
@@ -201,6 +254,7 @@ def register_renderer(fn) -> None:
 def render_prometheus() -> str:
     """Prometheus text exposition format 0.0.4."""
     collect_memory_gauges()
+    collect_runtime_gauges()
     lines: list[str] = []
     typed: set[str] = set()  # one TYPE line per metric name
 

@@ -130,6 +130,69 @@ def test_stats_op_over_wire(alpha):
     # real per-predicate statistics on at least one node
     assert any("name" in client._rpc_once(
         i, {"op": "stats"})["result"]["tablets"] for i in c.alive())
+    # process runtime gauges ride the same payload (dgtop's RSS/THR
+    # columns read them)
+    assert st["gauges"].get("process_threads", 0) >= 1
+    assert "memory_inuse_bytes" in st["gauges"]
+
+
+def test_pprof_and_metrics_ops_over_wire(alpha):
+    """The wire analogues of /debug/pprof and /debug/prometheus_metrics
+    (every RaftServer kind answers them — tools/dgbench.py's collector
+    scrapes nodes that run without the HTTP debug listener)."""
+    c, client = alpha
+    node = c.alive()[0]
+    got = client._rpc_once(node, {"op": "pprof", "seconds": "0.3",
+                                  "format": "both"})
+    assert got and got.get("ok"), got
+    prof = got["result"]
+    assert prof["samples"] > 0
+    assert prof["node"].startswith("alpha-")
+    # a node process always has its tick/accept threads running
+    assert prof["threads"] >= 1
+    assert prof["speedscope"]["profiles"]
+    assert any(p["type"] == "sampled"
+               for p in prof["speedscope"]["profiles"])
+    assert isinstance(prof["collapsed"], str)
+    got = client._rpc_once(node, {"op": "metrics_text"})
+    assert got and got.get("ok"), got
+    text = got["result"]["text"]
+    assert "# TYPE" in text and "process_threads" in text
+
+
+def test_wire_admission_control_sheds_typed():
+    """The wire-surface --max-pending gate: work-bearing ops
+    (query/mutate/task and 2PC *staging*) shed Overloaded once the
+    in-flight bound is hit; xfinalize and admin/stats ops are NEVER
+    shed (a decided transaction must land). Unit-level — the admission
+    gate sits in front of _handle_admitted, so no raft quorum needed."""
+    import threading
+
+    from dgraph_tpu.cluster.service import AlphaServer
+    from dgraph_tpu.utils.reqctx import Overloaded
+
+    srv = object.__new__(AlphaServer)
+    srv.max_pending = 1
+    srv._admission = threading.Lock()
+    srv._inflight = 0
+    srv.node_name = "alpha-test"
+    handled = []
+    srv._handle_admitted = lambda req: handled.append(req["op"]) or \
+        {"ok": True, "result": {}}
+
+    # under the bound: admitted, and in-flight returns to zero
+    assert srv.handle_request({"op": "query"})["ok"]
+    assert srv._inflight == 0
+
+    # at the bound: every admitted class sheds typed
+    srv._inflight = 1
+    for op in ("query", "mutate", "task", "xstage"):
+        with pytest.raises(Overloaded):
+            srv.handle_request({"op": op})
+    # ...but finalize plumbing and observability ops pass through
+    for op in ("xfinalize", "stats", "status"):
+        assert srv.handle_request({"op": op})["ok"]
+    assert handled == ["query", "xfinalize", "stats", "status"]
 
 
 def test_follower_serves_reads_and_redirects_writes(alpha):

@@ -139,3 +139,20 @@ def test_live_poll_against_http_server():
 
 def test_poll_dead_node_is_none():
     assert poll("http://127.0.0.1:9") is None  # discard port: refused
+
+
+def test_node_row_runtime_gauge_columns():
+    """RSS + thread count ride /debug/stats gauges into the table."""
+    snap = _snap()
+    snap["stats"]["gauges"] = {"memory_inuse_bytes": 256e6,
+                               "process_threads": 17.0}
+    row = node_row(snap, None)
+    assert row["rss_mb"] == pytest.approx(256.0)
+    assert row["threads"] == 17
+    frame = render({"n1": snap})
+    assert "RSSMB" in frame and "THR" in frame
+    assert " 256 " in frame and " 17" in frame
+    # payloads without gauges (older nodes) render dashes, not crashes
+    row = node_row(_snap(), None)
+    assert row["rss_mb"] is None and row["threads"] is None
+    assert "RSSMB" in render({"n1": _snap()})

@@ -9,10 +9,11 @@ from dgraph_tpu.utils import metrics
 
 
 def _render_without_memory() -> str:
-    """render_prometheus minus the environment-dependent memory
-    gauges (collect_memory_gauges reads /proc): the rest is exact."""
+    """render_prometheus minus the environment-dependent process
+    gauges (collect_memory_gauges reads /proc; collect_runtime_gauges
+    samples threads/GC/fds/uptime): the rest is exact."""
     lines = [ln for ln in metrics.render_prometheus().splitlines()
-             if "memory_" not in ln]
+             if "memory_" not in ln and "process_" not in ln]
     return "\n".join(lines) + "\n"
 
 
@@ -80,4 +81,22 @@ def test_counters_snapshot_diff():
                      "query_colvar_hits_total": 4}
     # zero-movement counters are omitted from the profile diff
     assert metrics.counters_delta(metrics.counters_snapshot()) == {}
+    metrics.reset()
+
+
+def test_runtime_gauges_in_exposition():
+    """collect_runtime_gauges: fds, threads, GC gen counts/collections
+    and uptime ride the same exposition as the memory gauges."""
+    metrics.reset()
+    text = metrics.render_prometheus()
+    assert "# TYPE process_threads gauge" in text
+    assert "process_uptime_seconds" in text
+    for gen in ("0", "1", "2"):
+        assert f'process_gc_collections{{gen="{gen}"}}' in text
+        assert f'process_gc_objects{{gen="{gen}"}}' in text
+    # Linux container: /proc fd count is available
+    assert "process_open_fds" in text
+    snap = metrics.gauges_snapshot()
+    assert snap["process_threads"] >= 1
+    assert snap["process_uptime_seconds"] >= 0
     metrics.reset()

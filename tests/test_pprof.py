@@ -1,0 +1,154 @@
+"""Sampling profiler (utils/pprof.py) + its /debug surfaces.
+
+Correctness contract: a synthetic busy thread spinning in a known
+function must dominate its thread's samples, identical stacks must
+AGGREGATE (one collapsed line / one speedscope sample row per distinct
+stack, with counts), and the speedscope JSON must round-trip: every
+sample indexes into shared.frames and weights align 1:1 with samples.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from dgraph_tpu.utils import pprof
+
+
+def _busy_marker_fn(stop: threading.Event):
+    # the frame name the assertions grep for
+    while not stop.is_set():
+        sum(i * i for i in range(2000))
+
+
+@pytest.fixture
+def busy_thread():
+    stop = threading.Event()
+    t = threading.Thread(target=_busy_marker_fn, args=(stop,),
+                         name="busy-marker", daemon=True)
+    t.start()
+    try:
+        yield t
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_collect_finds_busy_thread(busy_thread):
+    prof = pprof.collect(0.4, hz=200, node="t1")
+    assert prof.samples > 10
+    busy = {stack: n for (tname, stack), n in prof.stacks.items()
+            if tname == "busy-marker"}
+    assert busy, "busy thread never sampled"
+    # every sampled stack of that thread bottoms out in the marker fn
+    assert any(any("_busy_marker_fn" in f for f in stack)
+               for stack in busy)
+
+
+def test_collapsed_aggregates_identical_stacks(busy_thread):
+    prof = pprof.collect(0.3, hz=200)
+    text = prof.collapsed()
+    lines = [ln for ln in text.splitlines() if ln]
+    # one line per DISTINCT (thread, stack): no duplicates
+    keys = [ln.rsplit(" ", 1)[0] for ln in lines]
+    assert len(keys) == len(set(keys))
+    # counts sum to the number of (thread, sample) observations
+    total = sum(int(ln.rsplit(" ", 1)[1]) for ln in lines)
+    assert total == sum(prof.stacks.values())
+    assert any("busy-marker;" in ln and "_busy_marker_fn" in ln
+               for ln in lines)
+
+
+def test_speedscope_roundtrip(busy_thread):
+    prof = pprof.collect(0.3, hz=200, node="alpha-g1-n1")
+    doc = prof.speedscope()
+    # the document is plain JSON (it rides HTTP and the wire)
+    doc = json.loads(json.dumps(doc))
+    frames = doc["shared"]["frames"]
+    assert frames and all("name" in f for f in frames)
+    assert doc["profiles"], "no per-thread profiles"
+    seen_busy = False
+    for p in doc["profiles"]:
+        assert p["type"] == "sampled"
+        assert p["unit"] == "seconds"
+        assert len(p["samples"]) == len(p["weights"])
+        for sample, w in zip(p["samples"], p["weights"]):
+            assert w > 0
+            for ix in sample:
+                assert 0 <= ix < len(frames)
+        if p["name"] == "busy-marker":
+            seen_busy = True
+            names = {frames[ix]["name"]
+                     for s in p["samples"] for ix in s}
+            assert "_busy_marker_fn" in names
+            # weights are seconds: the busy thread was sampled for
+            # roughly the collection window (wall-clock sampling)
+            assert 0.05 < p["endValue"] <= 1.0
+    assert seen_busy
+
+
+def test_frame_aggregation_is_per_function_not_per_line(busy_thread):
+    """Samples landing on different bytecode lines of one function
+    must collapse to ONE frame id (function + firstlineno)."""
+    prof = pprof.collect(0.3, hz=300)
+    frames = {f for (tname, stack) in prof.stacks
+              for f in stack if "_busy_marker_fn" in f}
+    assert len(frames) == 1, frames
+
+
+def test_clamps_and_format_validation():
+    payload = pprof.handle_params({"seconds": "0.2", "hz": "100000",
+                                   "format": "both"}, node="n")
+    assert payload["hz"] == pprof.MAX_HZ
+    assert "collapsed" in payload and "speedscope" in payload
+    with pytest.raises(ValueError):
+        pprof.handle_params({"format": "pdf"})
+
+
+def test_profile_lock_serializes():
+    """Two concurrent collections serialize (the second waits) —
+    overlapping samplers would double overhead and taint each other."""
+    t0 = time.monotonic()
+    results = []
+
+    def run():
+        results.append(pprof.collect(0.2, hz=50))
+
+    a = threading.Thread(target=run)
+    b = threading.Thread(target=run)
+    a.start()
+    b.start()
+    a.join()
+    b.join()
+    assert time.monotonic() - t0 >= 0.4  # ran back to back
+    assert all(r.samples > 0 for r in results)
+
+
+def test_http_endpoint_and_wire_op(busy_thread):
+    """/debug/pprof over HTTP and the `pprof` wire op answer the same
+    payload shape."""
+    import urllib.request
+
+    from dgraph_tpu.server.http import serve
+
+    httpd, alpha = serve(None, host="127.0.0.1", port=0, block=False)
+    try:
+        port = httpd.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/pprof?seconds=0.2"
+                f"&format=both", timeout=30) as r:
+            got = json.loads(r.read())
+        assert got["samples"] > 0
+        assert "collapsed" in got
+        assert got["speedscope"]["profiles"]
+        # malformed format => 400, not 500
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/pprof?format=pdf",
+                timeout=10)
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        httpd.shutdown()

@@ -43,4 +43,23 @@ echo "== stats overhead =="
 # non-zero exit = over budget (DGRAPH_TPU_STATS_BUDGET overrides)
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --stats-overhead
 
+echo "== pprof overhead =="
+# the on-demand sampling profiler at its default 100 Hz must cost
+# < 2% of throughput while active (decomposed per-sample x rate gate;
+# DGRAPH_TPU_PPROF_BUDGET overrides)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --pprof-overhead
+
+echo "== cluster load smoke =="
+# ~30 s mini-cluster open-loop run (1 zero + 2 single-replica groups,
+# tiny seeded graph, gentle fixed rate) through tools/dgbench.py:
+# asserts ZERO non-shed errors, p99 under a generous budget, and
+# byte-parity of under-load reads vs a sequential replay. The run
+# report (per-node logs, /debug scrapes, a dgtop --once snapshot) is
+# the archived cluster-state artifact.
+SMOKE_DIR="${TMPDIR:-/tmp}/dgbench-smoke"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.dgbench --smoke \
+    --report-dir "$SMOKE_DIR" --out "$SMOKE_DIR/BENCH_SMOKE.json"
+test -s "$SMOKE_DIR/dgtop.txt"   # the archived cluster-state artifact
+echo "smoke report: $SMOKE_DIR"
+
 echo "ok"

@@ -90,6 +90,9 @@ def node_row(snap: dict, prev: Optional[dict]) -> dict:
            if r.get("op") == "query"]
     occ = _histo_mean(stats.get("histograms", {})
                       .get("batch_occupancy", None))
+    gauges = stats.get("gauges", {})
+    rss = gauges.get("memory_inuse_bytes")
+    threads = gauges.get("process_threads")
     return {
         "qps": qps,
         "shed": shed,
@@ -101,6 +104,11 @@ def node_row(snap: dict, prev: Optional[dict]) -> dict:
         "tablets": len(stats.get("tablets", {})),
         "cost_keys": (stats.get("costStore") or {}).get("keys", 0),
         "max_assigned": stats.get("maxAssigned", 0),
+        # process runtime gauges (utils/metrics collect_runtime_gauges
+        # via /debug/stats): RSS + live thread count per node — the
+        # "is this node about to fall over" columns
+        "rss_mb": (rss / 1e6) if rss is not None else None,
+        "threads": int(threads) if threads is not None else None,
     }
 
 
@@ -160,7 +168,7 @@ def render(snaps: dict[str, dict],
     stages. Pure string building (tests golden-match pieces of it)."""
     hdr = (f"{'NODE':<28} {'QPS':>7} {'P50MS':>7} {'P99MS':>7} "
            f"{'SHED/S':>7} {'HIT%':>6} {'OCC':>5} {'PLANS':>6} "
-           f"{'TABLETS':>8} {'COSTK':>6}")
+           f"{'TABLETS':>8} {'COSTK':>6} {'RSSMB':>7} {'THR':>4}")
     lines = [hdr, "-" * len(hdr)]
     for node in sorted(snaps):
         snap = snaps[node]
@@ -174,7 +182,9 @@ def render(snaps: dict[str, dict],
             f"{node:<28} {row['qps']:>7.1f} {row['p50']:>7.1f} "
             f"{row['p99']:>7.1f} {row['shed']:>7.1f} {hit:>6} "
             f"{_fmt(row['batch_occ']):>5} {row['plans']:>6} "
-            f"{row['tablets']:>8} {row['cost_keys']:>6}")
+            f"{row['tablets']:>8} {row['cost_keys']:>6} "
+            f"{_fmt(row['rss_mb'], nd=0):>7} "
+            f"{_fmt(row['threads']):>4}")
     hot = hottest(snaps)
     if hot:
         lines.append("")
