@@ -87,6 +87,125 @@ def kway_bench():
                       "value": best, "unit": "x"}))
 
 
+def setops_compressed_bench(runs: int = 5) -> dict:
+    """`--setops-compressed`: compressed-vs-dense set algebra sweep
+    (ops/codec.CompressedPack + ops/setops pack kernels).
+
+    Axes: block-form mix (array/packed, bitmap, run) x three densities
+    x selectivity (how many posting blocks actually overlap). For each
+    config three arms are timed:
+
+      dense       intersect_many over the already-dense uid vectors
+                  (the old tier's steady state: dense CSR resident)
+      decode+i    densify every pack, then intersect_many — what a
+                  compressed-at-rest store WITHOUT compressed set
+                  algebra would pay per query
+      compressed  intersect_packs: descriptor skipping + bitmap word
+                  AND + mixed-form probes, decoding survivors only
+
+    The GATE (tools/check.sh): on the selective-intersection config,
+    `compressed` must beat `decode+i` — block skipping is the whole
+    point; losing it means the kernels regressed into decode-always.
+    Also prints the resident-bytes ratio per mix (the >= 3x at-rest
+    claim's microscale witness) and a compressed-vs-dense crossover
+    table. Budget override: DGRAPH_TPU_SETOPS_BUDGET (ratio,
+    default 1.0 = must simply win)."""
+    from dgraph_tpu.ops import codec, setops
+
+    budget = float(os.environ.get("DGRAPH_TPU_SETOPS_BUDGET", "1.0"))
+    rng = np.random.default_rng(20260803)
+    scratch = codec.DecodeScratch()
+
+    def mk(mix: str, n: int, span: int, base: int = 0):
+        if mix == "run":
+            starts = np.unique(rng.integers(
+                0, span, max(n // 64, 1), dtype=np.uint64))
+            s = np.unique(np.concatenate(
+                [np.arange(st, st + 64, dtype=np.uint64)
+                 for st in starts]))[:n]
+        elif mix == "bitmap":
+            # dense inside few blocks
+            s = np.unique(rng.integers(
+                0, max(n * 3 // 2, 1), n, dtype=np.uint64))
+        else:  # array/packed: sparse over the whole span
+            s = np.unique(rng.integers(0, span, n, dtype=np.uint64))
+        return s + np.uint64(base)
+
+    def timed(fn):
+        best = float("inf")
+        got = None
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            got = fn()
+            best = min(best, time.perf_counter() - t0)
+        return best, got
+
+    out = []
+    # (mix, n per set, uid span) — three densities per form family
+    configs = [
+        ("array", 20_000, 1 << 34),   # sparse: packed blocks
+        ("array", 200_000, 1 << 26),  # mid density
+        ("bitmap", 200_000, 1 << 19),  # dense: bitmap blocks
+        ("run", 100_000, 1 << 24),    # runny
+    ]
+    for mix, n, span in configs:
+        shared = mk(mix, n // 4, span)
+        sets = [np.unique(np.concatenate([mk(mix, n, span), shared]))
+                for _ in range(4)]
+        packs = [codec.compress(s) for s in sets]
+        d_t, want = timed(lambda: setops.intersect_many(sets))
+        dd_t, got_d = timed(lambda: setops.intersect_many(
+            [p.densify() for p in packs]))
+        c_t, got = timed(lambda: setops.intersect_packs(
+            packs, scratch=scratch))
+        assert np.array_equal(want, got) \
+            and np.array_equal(want, got_d), mix
+        u_t, uw = timed(lambda: setops.union_many(sets))
+        cu_t, ug = timed(lambda: setops.union_packs(
+            packs, scratch=scratch))
+        assert np.array_equal(uw, ug), mix
+        dense_b = sum(s.nbytes for s in sets)
+        comp_b = sum(p.nbytes for p in packs)
+        rec = {"metric": "setops_compressed", "mix": mix,
+               "set_size": n, "span_bits": span.bit_length() - 1,
+               "dense_intersect_ms": round(d_t * 1e3, 3),
+               "decode_then_intersect_ms": round(dd_t * 1e3, 3),
+               "compressed_intersect_ms": round(c_t * 1e3, 3),
+               "dense_union_ms": round(u_t * 1e3, 3),
+               "compressed_union_ms": round(cu_t * 1e3, 3),
+               "bytes_dense": dense_b, "bytes_compressed": comp_b,
+               "bytes_ratio": round(dense_b / max(comp_b, 1), 2),
+               "vs_dense": round(d_t / max(c_t, 1e-9), 2),
+               "vs_decode": round(dd_t / max(c_t, 1e-9), 2)}
+        out.append(rec)
+        print(json.dumps(rec))
+
+    # the GATE config: selective intersection — a small probe set
+    # against a huge posting list, almost no block overlap (the
+    # reference's IntersectWith lin/bin regime; block skipping must
+    # beat decoding the 2M-uid list)
+    big = mk("array", 2_000_000, 1 << 36)
+    probe = np.unique(np.concatenate(
+        [mk("array", 2_000, 1 << 36), big[:: len(big) // 500]]))
+    bigp, probep = codec.compress(big), codec.compress(probe)
+    want = setops.intersect_many([probe, big])
+    dd_t, _ = timed(lambda: setops.intersect_many(
+        [probep.densify(), bigp.densify()]))
+    c_t, got = timed(lambda: setops.intersect_packs(
+        [probep, bigp], scratch=scratch))
+    assert np.array_equal(want, got)
+    ratio = dd_t / max(c_t, 1e-9)
+    gate = {"metric": "setops_compressed_selective",
+            "probe": len(probe), "list": len(big),
+            "decode_then_intersect_ms": round(dd_t * 1e3, 3),
+            "compressed_intersect_ms": round(c_t * 1e3, 3),
+            "block_skip_speedup": round(ratio, 2),
+            "budget_ratio": budget,
+            "within_budget": ratio > budget}
+    print(json.dumps(gate))
+    return gate
+
+
 def lint_timing_bench(runs: int = 3):
     """`--lint-timing`: dglint wall time over the full tree (parse +
     all 8 rules, dgraph_tpu/ + tests/). The budget is < 5 s so the
@@ -301,6 +420,10 @@ def main():
         return
     if "--pprof-overhead" in sys.argv:
         if not pprof_overhead_bench()["within_budget"]:
+            sys.exit(1)
+        return
+    if "--setops-compressed" in sys.argv:
+        if not setops_compressed_bench()["within_budget"]:
             sys.exit(1)
         return
 

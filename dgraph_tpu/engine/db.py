@@ -130,8 +130,11 @@ class GraphDB:
                  tablet_budget: int = 256 << 20,
                  rollup_window: int = 0,
                  prefer_columnar: bool = True,
+                 prefer_compressed: bool = True,
+                 host_tile_budget: int = 512 << 20,
                  plan_cache_size: int = 128):
         from dgraph_tpu.engine.tile_cache import DeviceCacheLRU
+        from dgraph_tpu.ops.codec import DecodeScratch
         from dgraph_tpu.query.plan import PlanCache
 
         self.schema = SchemaState()
@@ -172,6 +175,14 @@ class GraphDB:
         # exact per-posting path (the differential parity suite's
         # oracle; also an operator escape hatch)
         self.prefer_columnar = prefer_columnar
+        # compressed posting tier: token-index set algebra runs on
+        # ops/codec CompressedPack blocks (resident footprint =
+        # compressed bytes, decode only surviving blocks). Requires
+        # the columnar tier; False keeps the dense CSR exports.
+        self.prefer_compressed = prefer_compressed
+        # bounded per-thread scratch arena the compressed kernels
+        # decode into (results are always fresh; see DecodeScratch)
+        self.decode_scratch = DecodeScratch()
         # uid-range sharding across a jax.sharding.Mesh (`uid` axis):
         # predicates above shard_min_edges expand via shard_map over the
         # mesh instead of a single chip (ref posting/list.go:1149
@@ -187,8 +198,10 @@ class GraphDB:
         # only there do remotely issued read timestamps roam
         self.rollup_window = rollup_window
         # HBM residency budget for device tiles (ref posting/lists.go
-        # LRU bound on cached posting lists)
-        self.device_cache = DeviceCacheLRU(device_hbm_budget)
+        # LRU bound on cached posting lists) + host budget for the
+        # columnar/compressed exports riding the same LRU
+        self.device_cache = DeviceCacheLRU(device_hbm_budget,
+                                           host_tile_budget)
         self.enc_key = enc_key
         # cross-group 2PC participants: start_ts -> (staged ops, keys).
         # Replicated via ("xstage", ...) records so the stage survives

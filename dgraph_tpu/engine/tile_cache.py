@@ -1,14 +1,30 @@
-"""HBM residency budget for per-tablet device tiles (LRU).
+"""Residency budget for per-tablet tiles (LRU): HBM device tiles AND
+host-side columnar/compressed exports, accounted separately.
 
 Separated from engine/device_cache.py so the engine can be constructed
 without importing jax/XLA at all — node-server processes that run with
 prefer_device=False (cluster replicas, CLI tools) must not pay the XLA
-startup cost. Device byte accounting therefore duck-types on `.nbytes`
-instead of isinstance(jax.Array).
+startup cost. Byte accounting therefore duck-types instead of
+isinstance(jax.Array):
+
+  * np.ndarray                          -> HOST bytes
+  * obj with class attr host_resident   -> HOST bytes (ValueColumns,
+    TokenIndexCSR, CompressedTokenIndex, OrderPermutation,
+    ops/codec.CompressedPack — explicit marker, no jax import)
+  * any other obj exposing .nbytes      -> DEVICE bytes (jax.Array)
+  * dataclasses / lists / tuples        -> recurse over fields, so a
+    DeviceAdjacency's numpy side-tables land in the HOST column and
+    its jax buffers in the DEVICE column — CONSISTENTLY.  (The old
+    single-number accounting counted any non-dataclass .nbytes as
+    device bytes and dataclass-held numpy as zero: a compressed host
+    block would have been charged against the HBM budget it never
+    touches.)
 
 Ref: posting/lists.go:156 — the reference bounds posting-list memory
-with an LRU; here the unit of residency is a whole tile and the budget
-is HBM bytes.
+with an LRU; here the unit of residency is a whole tile, the device
+budget is HBM bytes and the host budget bounds decoded/columnar
+exports (compressed-at-rest exports are small, which is the point:
+budgeting by COMPRESSED size is what lets more tablets stay resident).
 """
 
 from __future__ import annotations
@@ -23,27 +39,41 @@ import numpy as np
 from dgraph_tpu.utils.metrics import inc_counter, set_gauge
 
 
-def _hbm_bytes(obj) -> int:
-    """Device bytes held by a tile structure: every device array
-    reachable through dataclass fields. Host numpy side-tables don't
-    count against the HBM budget; anything else exposing .nbytes is a
-    device buffer (jax.Array, without importing jax here)."""
+def _tile_bytes(obj) -> tuple[int, int]:
+    """(device_bytes, host_bytes) reachable through a tile structure."""
     if isinstance(obj, np.ndarray):
-        return 0
+        return 0, int(obj.nbytes)
+    if getattr(obj, "host_resident", False):
+        return 0, int(getattr(obj, "nbytes", 0))
     if hasattr(obj, "nbytes") and not dataclasses.is_dataclass(obj):
-        return int(obj.nbytes)
+        return int(obj.nbytes), 0
     if isinstance(obj, (list, tuple)):
-        return sum(_hbm_bytes(x) for x in obj)
+        dev = host = 0
+        for x in obj:
+            d, h = _tile_bytes(x)
+            dev += d
+            host += h
+        return dev, host
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-        return sum(_hbm_bytes(getattr(obj, f.name))
-                   for f in dataclasses.fields(obj))
-    return 0
+        dev = host = 0
+        for f in dataclasses.fields(obj):
+            d, h = _tile_bytes(getattr(obj, f.name))
+            dev += d
+            host += h
+        return dev, host
+    return 0, 0
+
+
+def _hbm_bytes(obj) -> int:
+    """Device-byte view of _tile_bytes (kept for callers that only
+    care about HBM)."""
+    return _tile_bytes(obj)[0]
 
 
 class DeviceCacheLRU:
-    """HBM residency budget for per-tablet device tiles.
+    """Residency budget for per-tablet tiles (device + host).
 
-    Inserting past the budget evicts the least-recently-used tiles —
+    Inserting past either budget evicts the least-recently-used tiles —
     eviction drops the tablet's attribute refs so XLA frees the buffers
     once in-flight work releases them (no hard .delete(): a kernel may
     still hold the tile this step).
@@ -53,16 +83,21 @@ class DeviceCacheLRU:
     as anything else is admitted.
     """
 
-    def __init__(self, budget_bytes: int):
-        self.budget = int(budget_bytes)
-        # (tablet id, attr) -> (weakref(tablet), attr, nbytes);
+    def __init__(self, budget_bytes: int,
+                 host_budget_bytes: int = 512 << 20):
+        self.budget = int(budget_bytes)          # HBM device bytes
+        self.host_budget = int(host_budget_bytes)
+        # (tablet id, attr) -> (weakref(tablet), attr, dev, host);
         # insertion order is recency order (move_to_end on touch).
         # Weak refs: tablets can also disappear through WAL replay,
         # restore, snapshot install or bulk merge (paths that never call
         # drop_tablet) — dead entries are pruned lazily so their bytes
         # never pin the budget.
         self._entries: OrderedDict[tuple, tuple] = OrderedDict()
-        self.bytes = 0
+        self.bytes = 0        # device bytes resident
+        self.host_bytes = 0   # host export bytes resident
+        self.peak_bytes = 0
+        self.peak_host_bytes = 0
         self.evictions = 0
         # concurrent readers build/touch tiles (server read path runs
         # queries in parallel under an RW lock)
@@ -85,22 +120,32 @@ class DeviceCacheLRU:
             old = self._entries.pop(key, None)
             if old is not None:
                 self.bytes -= old[2]
-            nbytes = _hbm_bytes(obj)
-            self._entries[key] = (_weakref.ref(tab), attr, nbytes)
-            self.bytes += nbytes
-            while self.bytes > self.budget and len(self._entries) > 1:
+                self.host_bytes -= old[3]
+            dev, host = _tile_bytes(obj)
+            self._entries[key] = (_weakref.ref(tab), attr, dev, host)
+            self.bytes += dev
+            self.host_bytes += host
+            self.peak_bytes = max(self.peak_bytes, self.bytes)
+            self.peak_host_bytes = max(self.peak_host_bytes,
+                                       self.host_bytes)
+            while (self.bytes > self.budget
+                   or self.host_bytes > self.host_budget) \
+                    and len(self._entries) > 1:
                 self._evict_lru()
         self._set_gauges()
 
     def _prune_dead(self):
-        dead = [k for k, (ref, _, _) in self._entries.items()
+        dead = [k for k, (ref, _, _, _) in self._entries.items()
                 if ref() is None]
         for k in dead:
-            self.bytes -= self._entries.pop(k)[2]
+            _, _, dev, host = self._entries.pop(k)
+            self.bytes -= dev
+            self.host_bytes -= host
 
     def _evict_lru(self):
-        _, (ref, attr, nbytes) = self._entries.popitem(last=False)
-        self.bytes -= nbytes
+        _, (ref, attr, dev, host) = self._entries.popitem(last=False)
+        self.bytes -= dev
+        self.host_bytes -= host
         self.evictions += 1
         inc_counter("device_cache_evictions")
         tab = ref()
@@ -122,16 +167,22 @@ class DeviceCacheLRU:
         removals are covered by the weak refs)."""
         with self._lock:
             for key in [k for k in self._entries if k[0] == id(tab)]:
-                _, _, nbytes = self._entries.pop(key)
-                self.bytes -= nbytes
+                _, _, dev, host = self._entries.pop(key)
+                self.bytes -= dev
+                self.host_bytes -= host
         self._set_gauges()
 
     def _set_gauges(self):
         set_gauge("device_cache_bytes", self.bytes)
         set_gauge("device_cache_tiles", len(self._entries))
+        set_gauge("host_tile_bytes", self.host_bytes)
 
     def stats(self) -> dict:
         with self._lock:
             self._prune_dead()
             return {"bytes": self.bytes, "tiles": len(self._entries),
-                    "budget": self.budget, "evictions": self.evictions}
+                    "budget": self.budget, "evictions": self.evictions,
+                    "hostBytes": self.host_bytes,
+                    "hostBudget": self.host_budget,
+                    "peakBytes": self.peak_bytes,
+                    "peakHostBytes": self.peak_host_bytes}

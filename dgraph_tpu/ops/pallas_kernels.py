@@ -156,3 +156,53 @@ def score_dot_pallas(corpus: jax.Array, queries: jax.Array,
 
 
 
+
+# -- bitmap word-AND kernel (ops/setops compressed block plane) --------------
+
+# bitmap blocks per grid step: each step ANDs one (TILE_B, W) slab of
+# uint32 words in VMEM; W = 2048 uint32 lanes per 2^16-uid block (the
+# uint64 bitmap split into two 32-bit lanes — TPUs have no 64-bit
+# integer ALU), a multiple of the 128-lane VPU width
+BITMAP_TILE_B = 8
+
+
+def bitmap_and_pallas(a: jax.Array, b: jax.Array,
+                      interpret: bool | None = None) -> jax.Array:
+    """Elementwise AND of two stacked bitmap word matrices
+    (uint32[B, W], W % 128 == 0): the compressed intersection's dense
+    inner loop as an explicit VPU pipeline — each grid step DMAs one
+    block row pair HBM->VMEM and ANDs it in one vector op (the SIMD
+    bitmap-intersection kernel of "SIMD Compression and the
+    Intersection of Sorted Integers", PAPERS.md).  Callers opt in via
+    use_pallas (setops.bitmap_and_device), same convention as
+    score_dot_pallas."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bsz, w = a.shape
+    if w % 128 != 0:
+        raise ValueError(f"W={w} must be a multiple of 128 lanes")
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
+    tile = BITMAP_TILE_B
+    pad = (-bsz) % tile
+    if pad:
+        a = jnp.concatenate(
+            [a, jnp.zeros((pad, w), jnp.uint32)])
+        b = jnp.concatenate(
+            [b, jnp.zeros((pad, w), jnp.uint32)])
+
+    def kernel(a_ref, b_ref, out_ref):
+        out_ref[...] = a_ref[...] & b_ref[...]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=((bsz + pad) // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, w), lambda i: (i, 0)),
+            pl.BlockSpec((tile, w), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, w), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bsz + pad, w), jnp.uint32),
+        interpret=_INTERPRET_ON if interpret else False,
+    )(a, b)
+    return out[:bsz]

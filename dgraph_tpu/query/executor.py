@@ -43,7 +43,7 @@ from dgraph_tpu.query.retrigram import compile_trigram_query
 from dgraph_tpu.storage.tablet import Tablet
 from dgraph_tpu.utils import failpoint
 from dgraph_tpu.utils.keys import token_bytes
-from dgraph_tpu.utils.metrics import inc_counter
+from dgraph_tpu.utils.metrics import inc_counter, set_gauge
 from dgraph_tpu.utils.tracing import span as _span
 
 _EMPTY = np.empty(0, dtype=np.uint64)
@@ -823,6 +823,101 @@ class Executor:
         inc_counter("query_index_csr_probe_total")
         return [csr.probe(t) for t in toks]
 
+    # -- compressed posting tier ---------------------------------------
+
+    def _compressed_on(self) -> bool:
+        """The compressed tier rides the columnar tier's invalidation
+        contract, so prefer_columnar=False (the parity oracle) pins
+        BOTH off."""
+        return self._columnar_on() \
+            and getattr(self.db, "prefer_compressed", True)
+
+    def _index_packs(self, tab):
+        """The tablet's compressed token-index export, budgeted in the
+        tile LRU by COMPRESSED size — None on dirty/historical
+        tablets, unindexed predicates, or with the tier off (callers
+        fall through to the dense CSR / exact index_uids chain)."""
+        if not self._compressed_on() \
+                or not hasattr(tab, "token_index_packs"):
+            return None
+        tix = tab.token_index_packs(self.read_ts)
+        if tix is None:
+            inc_counter("query_compressed_fallback_total")
+            return None
+        from dgraph_tpu.engine.device_cache import host_column_tile
+        host_column_tile(self.db, tab, "_tok_packs", tix)
+        return tix
+
+    def _pack_scratch(self):
+        sc = getattr(self.db, "decode_scratch", None)
+        if sc is not None:
+            set_gauge("codec_scratch_bytes", sc.high_water)
+        return sc
+
+    def _pack_device(self) -> bool:
+        """Whether pack algebra may batch all-bitmap blocks into one
+        device word-AND dispatch (setops.bitmap_and_device)."""
+        return self.db.prefer_device and (
+            self.db.device_min_edges <= 1
+            or self.db.device_is_accelerator())
+
+    def _index_union(self, tab, toks: list[bytes]) -> np.ndarray:
+        """k-token index union, staying on compressed blocks where
+        they exist: the hybrid index hands back zero-copy dense
+        slices for its small-list tail and packs for the long lists
+        (setops.union_mixed merges the compressed side first)."""
+        tix = self._index_packs(tab)
+        if tix is not None:
+            ops = [o for o in (tix.probe_operand(t) for t in toks)
+                   if o is not None]
+            inc_counter("query_compressed_setops_total")
+            return setops.union_mixed(ops,
+                                      scratch=self._pack_scratch())
+        return self._union_many(self._index_sets(tab, toks))
+
+    def _index_intersect(self, tab, toks: list[bytes]) -> np.ndarray:
+        """k-token index intersection with block-descriptor skipping:
+        dense operands intersect smallest-first, the survivor vector
+        probes each pack in compressed form — blocks with no key
+        overlap are NEVER decoded (all-pack inputs additionally batch
+        bitmap blocks into one word-AND, device-routed when worth
+        it)."""
+        tix = self._index_packs(tab)
+        if tix is not None:
+            ops = []
+            for t in toks:
+                o = tix.probe_operand(t)
+                if o is None:
+                    return _EMPTY  # a missing token empties the AND
+                ops.append(o)
+            inc_counter("query_compressed_setops_total")
+            return setops.intersect_mixed(
+                ops, scratch=self._pack_scratch(),
+                device=self._pack_device())
+        return self._intersect_many(self._index_sets(tab, toks))
+
+    def _index_count_filter(self, tab, toks: list[bytes],
+                            need: int) -> np.ndarray:
+        """Uids in >= need of the tokens' posting lists (the match()
+        q-gram bound): candidates come from the smallest operands
+        (pigeonhole), the long packed lists answer by block-skipping
+        membership probes without decoding."""
+        tix = self._index_packs(tab)
+        if tix is not None:
+            ops = [o for o in (tix.probe_operand(t) for t in toks)
+                   if o is not None]
+            inc_counter("query_compressed_setops_total")
+            return setops.count_filter_mixed(
+                ops, need, scratch=self._pack_scratch())
+        buckets = [b for b in self._index_sets(tab, toks) if len(b)]
+        if not buckets:
+            return _EMPTY
+        from dgraph_tpu import native as _nat
+        got = _nat.merge_count(buckets, need) if _nat.available() \
+            else None
+        return got if got is not None \
+            else setops.count_filter(buckets, need)
+
     # np.unique cost per element of a k-way union — the fixed side of
     # the device-tier choice is the measured dispatch RTT
     _HOST_PER_SETOP_EL = 2e-8
@@ -1124,9 +1219,9 @@ class Executor:
         spec = get_tokenizer("geo")
         indexed = tab.schema.indexed and "geo" in tab.schema.tokenizers
         if indexed:
-            scan = self._union_many(self._index_sets(
+            scan = self._index_union(
                 tab, [token_bytes(spec.ident, t)
-                      for t in G.query_tokens(bbox)]))
+                      for t in G.query_tokens(bbox)])
             if candidates is not None:
                 scan = _intersect(candidates, scan)
         elif candidates is not None:
@@ -1265,7 +1360,7 @@ class Executor:
             else:
                 all_toks, no_tok_vals = _analyze()
             if all_toks:
-                out = self._union_many(self._index_sets(tab, all_toks))
+                out = self._index_union(tab, all_toks)
             if len(no_tok_vals) < len(vals):
                 if spec.lossy or tab.schema.lang:
                     # @lang predicates share index buckets across
@@ -1686,12 +1781,11 @@ class Executor:
                 toks = tokens_for(Val(TypeID.STRING, text), spec, lg)
             if not toks:
                 continue
-            sets = self._index_sets(
-                tab, [token_bytes(spec.ident, t) for t in toks])
+            tbs = [token_bytes(spec.ident, t) for t in toks]
             if fn.name.startswith("all"):
-                parts.append(self._intersect_many(sets))
+                parts.append(self._index_intersect(tab, tbs))
             else:
-                parts.append(setops.union_many(sets))
+                parts.append(self._index_union(tab, tbs))
         out = self._union_many(parts)
         return out if candidates is None else _intersect(candidates, out)
 
@@ -1722,12 +1816,11 @@ class Executor:
                 Val(TypeID.STRING, str(a.value)), spec))
         if not toks:
             return _EMPTY
-        sets = self._index_sets(
-            tab, [token_bytes(spec.ident, t) for t in toks])
+        tbs = [token_bytes(spec.ident, t) for t in toks]
         if fn.name == "allof":
-            got = self._intersect_many(sets)
+            got = self._index_intersect(tab, tbs)
         else:
-            got = self._union_many(sets)
+            got = self._index_union(tab, tbs)
         return got if candidates is None else _intersect(candidates, got)
 
     def _eval_regexp(self, fn: Function, candidates) -> np.ndarray:
@@ -1780,20 +1873,20 @@ class Executor:
         OR, as in the reference's trigram query algebra."""
         spec = get_tokenizer("trigram")
 
-        def lookup_all(trigrams) -> list[np.ndarray]:
-            return self._index_sets(
-                tab, [token_bytes(spec.ident, t) for t in trigrams])
-
         def ev(node) -> Optional[np.ndarray]:
             if node.op == "all":
                 return None
             if node.op == "none":
                 return _EMPTY
             if node.op == "and":
-                parts = lookup_all(node.trigrams) if node.trigrams \
-                    else []
-                if parts:
-                    first = self._intersect_many(parts)
+                parts = []
+                if node.trigrams:
+                    # one compressed/batched k-token AND: block-
+                    # descriptor skipping prunes non-overlapping
+                    # posting blocks before any decode
+                    first = self._index_intersect(
+                        tab, [token_bytes(spec.ident, t)
+                              for t in node.trigrams])
                     if first.size == 0:
                         return first  # dead branch: skip the subs
                     parts = [first]
@@ -1805,7 +1898,9 @@ class Executor:
                     return None  # every child unconstrained
                 return self._intersect_many(parts)
             # OR
-            parts = lookup_all(node.trigrams) if node.trigrams else []
+            parts = [self._index_union(
+                tab, [token_bytes(spec.ident, t)
+                      for t in node.trigrams])] if node.trigrams else []
             for s in node.subs:
                 got = ev(s)
                 if got is None:
@@ -1875,20 +1970,12 @@ class Executor:
                     # T - 3d of its T distinct trigrams (each edit
                     # destroys <= 3 windows) — at 21M this prunes the
                     # "shares any trigram" union from ~2M candidates
-                    # to thousands. One concat + unique-with-counts
-                    # also replaces T incremental unions.
-                    buckets = self._index_sets(
-                        tab, [token_bytes(spec.ident, t) for t in toks])
-                    buckets = [b for b in buckets if len(b)]
-                    if buckets:
-                        need = max(1, len(toks) - 3 * maxd)
-                        from dgraph_tpu import native as _nat
-                        scan = _nat.merge_count(buckets, need) \
-                            if _nat.available() else None
-                        if scan is None:
-                            scan = setops.count_filter(buckets, need)
-                    else:
-                        scan = _EMPTY
+                    # to thousands. Compressed tier: posting blocks
+                    # held by < need trigrams skip without decode.
+                    need = max(1, len(toks) - 3 * maxd)
+                    scan = self._index_count_filter(
+                        tab, [token_bytes(spec.ident, t)
+                              for t in toks], need)
         if scan is None:
             scan = tab.src_uids(self.read_ts)
         batched = self._match_batch(tab, scan, want, maxd)

@@ -309,6 +309,8 @@ def build_explain(db, ex, done, expinfo: dict) -> dict:
         "planner": planner,
         "tiers": {
             "columnar": bool(getattr(db, "prefer_columnar", True)),
+            "compressed": bool(getattr(db, "prefer_columnar", True))
+            and bool(getattr(db, "prefer_compressed", True)),
             "device": bool(getattr(db, "prefer_device", False)),
             "deviceMinEdges": int(getattr(db, "device_min_edges", 0)),
         },

@@ -54,9 +54,12 @@ def backup(db, dest: str, force_full: bool = False,
         moved = max(tab.max_commit_ts, tab.base_ts)
         if since and moved <= since:
             continue
+        from dgraph_tpu.storage.snapshot import _gv_dict
         tablets[pred] = {
-            "edges": tab.edges, "reverse": tab.reverse,
-            "values": tab.values, "index": tab.index,
+            "edges_gv": _gv_dict(tab.edges),
+            "reverse_gv": _gv_dict(tab.reverse),
+            "values": tab.values,
+            "index_gv": _gv_dict(tab.index),
             "edge_facets": tab.edge_facets, "base_ts": tab.base_ts,
         }
     payload = {
@@ -112,13 +115,19 @@ def restore(dest: str, db=None, key: Optional[bytes] = None):
         from dgraph_tpu.storage.snapshot import _load_payload
         payload = _load_payload(gzip.decompress(decrypt_blob(raw, key)))
         db.alter(payload["schema"])
+        from dgraph_tpu.storage.snapshot import _ungv_dict
         for pred, st in payload["tablets"].items():
             ps = db.schema.get_or_default(pred)
             tab = Tablet(pred, ps)
-            tab.edges = st["edges"]
-            tab.reverse = st["reverse"]
+            # group-varint at-rest form, dense in pre-compression
+            # chains (same migration seam as restore_tablet)
+            tab.edges = _ungv_dict(st["edges_gv"]) \
+                if "edges_gv" in st else st["edges"]
+            tab.reverse = _ungv_dict(st["reverse_gv"]) \
+                if "reverse_gv" in st else st["reverse"]
             tab.values = st["values"]
-            tab.index = st["index"]
+            tab.index = _ungv_dict(st["index_gv"]) \
+                if "index_gv" in st else st["index"]
             tab.edge_facets = st["edge_facets"]
             tab.base_ts = st["base_ts"]
             db.tablets[pred] = tab

@@ -25,19 +25,44 @@ def _load_payload(blob: bytes):
 SNAPSHOT_MAGIC = b"DGTPU-SNAP-1"
 
 
+def _gv_dict(d: dict) -> dict:
+    """{key -> sorted uint64 uids} -> {key -> group-varint stream}:
+    the at-rest form of every posting surface (ref codec/codec.go —
+    the reference never persists a dense uid list either). Native
+    dgt_gv_* when the toolchain built, byte-identical numpy fallback
+    otherwise (ops/codec.gv_encode)."""
+    from dgraph_tpu.ops.codec import gv_encode
+    return {k: gv_encode(v) for k, v in d.items()}
+
+
+def _ungv_dict(d: dict) -> dict:
+    import numpy as np
+
+    from dgraph_tpu.ops.codec import gv_decode
+    return {k: np.asarray(gv_decode(v), np.uint64)
+            for k, v in d.items()}
+
+
 def dump_tablet(tab) -> dict:
     """One tablet's state — the single wire shape shared by snapshots,
-    backups and tablet moves. Add new Tablet fields HERE.
+    backups, tablet moves and the cold-tablet store
+    (engine/lazy_tablets). Add new Tablet fields HERE.
+
+    The uid-array planes (edges / reverse / token index) persist
+    group-varint delta-compressed — cold tablets stay compressed at
+    rest in the KV store at ~2 B/uid instead of dense 8 B/uid, the
+    same split the reference keeps in codec/ — and decode on
+    materialization (restore_tablet).
 
     Unfolded overlay deltas ARE included: the rollup watermark can be
     pinned below the newest commits (active txns, pinned snapshot
     readers), and a payload of base arrays alone would silently drop
     those committed writes from snapshots/backups."""
     return {
-        "edges": tab.edges,
-        "reverse": tab.reverse,
+        "edges_gv": _gv_dict(tab.edges),
+        "reverse_gv": _gv_dict(tab.reverse),
         "values": tab.values,
-        "index": tab.index,
+        "index_gv": _gv_dict(tab.index),
         "edge_facets": tab.edge_facets,
         "base_ts": tab.base_ts,
         "deltas": tab.deltas,
@@ -46,13 +71,18 @@ def dump_tablet(tab) -> dict:
 
 
 def restore_tablet(pred: str, schema, st: dict):
-    """Inverse of dump_tablet -> a fresh Tablet."""
+    """Inverse of dump_tablet -> a fresh Tablet. Pre-compression
+    payloads (dense "edges"/"reverse"/"index" keys) still restore —
+    the one migration seam, same policy as loads_compat."""
     from dgraph_tpu.storage.tablet import Tablet
     tab = Tablet(pred, schema)
-    tab.edges = st["edges"]
-    tab.reverse = st["reverse"]
+    tab.edges = _ungv_dict(st["edges_gv"]) if "edges_gv" in st \
+        else st["edges"]
+    tab.reverse = _ungv_dict(st["reverse_gv"]) if "reverse_gv" in st \
+        else st["reverse"]
     tab.values = st["values"]
-    tab.index = st["index"]
+    tab.index = _ungv_dict(st["index_gv"]) if "index_gv" in st \
+        else st["index"]
     tab.edge_facets = st["edge_facets"]
     tab.base_ts = st["base_ts"]
     tab.deltas = list(st.get("deltas", ()))  # absent in old payloads

@@ -48,6 +48,8 @@ class ValueColumns:
     per-uid host pass; extra_ok=False marks a tablet whose tagged
     values defied encoding — batch consumers must fall back."""
 
+    host_resident = True  # tile LRU: host bytes, never HBM
+
     __slots__ = ("srcs", "tid", "data", "enc", "nbytes",
                  "extra_srcs", "extra_enc", "extra_ok", "_ascii",
                  "_codes", "dt_secs", "dt_objs", "_blob",
@@ -205,7 +207,10 @@ class TokenIndexCSR:
 
     Exposes .nbytes so DeviceCacheLRU budgets it like a device tile."""
 
-    __slots__ = ("rows", "offsets", "uids", "nbytes")
+    host_resident = True
+
+    __slots__ = ("rows", "offsets", "uids", "nbytes",
+                 "posting_nbytes")
 
     def __init__(self, index: dict[bytes, np.ndarray]):
         toks = list(index.keys())
@@ -219,7 +224,12 @@ class TokenIndexCSR:
                 if int(self.offsets[-1]) else _EMPTY.copy()
         else:
             self.uids = _EMPTY.copy()
-        self.nbytes = int(self.uids.nbytes) + int(self.offsets.nbytes) \
+        # posting bytes (the uid plane) apart from the token-key map,
+        # which every index export carries identically — the
+        # compressed-vs-dense comparison the bench gates on
+        self.posting_nbytes = int(self.uids.nbytes) \
+            + int(self.offsets.nbytes)
+        self.nbytes = self.posting_nbytes \
             + sum(len(t) + 49 for t in toks)
 
     def probe(self, token: bytes) -> np.ndarray:
@@ -230,10 +240,95 @@ class TokenIndexCSR:
         return self.uids[int(self.offsets[i]): int(self.offsets[i + 1])]
 
 
+class CompressedTokenIndex:
+    """Hybrid compressed export of a clean tablet's token index —
+    sized by WHERE the bytes are, not by token count: real token
+    indexes are zipfian (at the bench regime ~74% of tokens are
+    singletons while ~80% of the uids live in the few hundred long
+    posting lists), so
+
+      * posting lists >= PACK_MIN uids become
+        ops/codec.CompressedPack operands (adaptive array / bitmap /
+        run blocks, ~2 B/uid and far below on runny lists) — set
+        algebra runs on the compressed forms with block-descriptor
+        skipping (ops/setops pack + mixed kernels);
+      * the long tail of tiny lists stays one shared dense CSR
+        buffer: per-token roaring descriptors would cost MORE than
+        the 8 B/uid they replace, and a zero-copy slice keeps the
+        many-token probes (trigram OR-trees, geo cell covers) at
+        dense-tier speed.
+
+    The tile LRU budgets this object by the resulting (mostly
+    compressed) byte size.  The reference keeps the same split:
+    group-varint UidPacks at rest (codec/codec.go), algo/uidlist.go
+    intersecting block by block."""
+
+    host_resident = True
+
+    # below this posting-list length the roaring descriptor overhead
+    # exceeds the dense bytes it saves; measured crossover on the
+    # bench index shapes (bench_micro --setops-compressed)
+    PACK_MIN = 128
+
+    __slots__ = ("packs", "rows", "offsets", "uids", "nbytes",
+                 "posting_nbytes")
+
+    def __init__(self, index: dict[bytes, np.ndarray]):
+        from dgraph_tpu.ops import codec as _codec
+        self.packs = {}
+        small: dict[bytes, np.ndarray] = {}
+        for t, uids in index.items():
+            if len(uids) >= self.PACK_MIN:
+                self.packs[t] = _codec.compress(uids)
+            else:
+                small[t] = uids
+        toks = list(small.keys())
+        self.rows = {t: i for i, t in enumerate(toks)}
+        self.offsets = np.zeros(len(toks) + 1, np.int64)
+        if toks:
+            np.cumsum([len(small[t]) for t in toks],
+                      out=self.offsets[1:])
+            self.uids = np.concatenate(
+                [np.asarray(small[t], np.uint64) for t in toks]) \
+                if int(self.offsets[-1]) else _EMPTY.copy()
+        else:
+            self.uids = _EMPTY.copy()
+        self.posting_nbytes = \
+            sum(p.nbytes for p in self.packs.values()) \
+            + int(self.uids.nbytes) + int(self.offsets.nbytes)
+        self.nbytes = self.posting_nbytes \
+            + sum(len(t) + 49 for t in index)
+
+    def probe_operand(self, token: bytes):
+        """The token's set-algebra operand: a CompressedPack for long
+        lists, a zero-copy dense slice for the small-list tail, None
+        when absent — ops/setops' mixed kernels take either form."""
+        p = self.packs.get(token)
+        if p is not None:
+            return p
+        i = self.rows.get(token)
+        if i is None:
+            return None
+        return self.uids[int(self.offsets[i]): int(self.offsets[i + 1])]
+
+    def probe(self, token: bytes) -> np.ndarray:
+        """Densified posting list (small tokens: the shared-buffer
+        slice; packed tokens: a fresh decode).  A sanctioned DG09
+        decode site: consumers that can, should use probe_operand."""
+        op = self.probe_operand(token)
+        if op is None:
+            return _EMPTY
+        if isinstance(op, np.ndarray):
+            return op
+        return op.densify()
+
+
 class OrderPermutation:
     """One cached (key, uid)-sorted view of a sort-key column:
     `uids` in emission order, `perm` the permutation back into
     sort_key_arrays. Exposes .nbytes for the tile LRU."""
+
+    host_resident = True
 
     __slots__ = ("uids", "perm", "nbytes")
 
@@ -577,6 +672,31 @@ class Tablet:
         self._tok_csr_ts = self.base_ts
         self._tok_csr_schema = self.schema
         return csr
+
+    def token_index_packs(self, read_ts: int):
+        """Compressed token-index export (CompressedTokenIndex) — the
+        compressed tier's operand plane. Same contract as
+        token_index_csr: clean tablets only, cached per (base_ts,
+        schema object), the same 2^18-token cap (mostly-exact-token
+        indexes gain nothing over dict gets), rebuilt after rollup or
+        alter. Build cost is encode-at-export (rollup-path), like the
+        dense CSR and the device tiles."""
+        if self.dirty() or read_ts < self.base_ts \
+                or not self.schema.indexed:
+            return None
+        if len(self.index) > (1 << 18):
+            return None
+        cached = getattr(self, "_tok_packs", None)
+        if cached is not None \
+                and getattr(self, "_tok_packs_ts", -1) == self.base_ts \
+                and getattr(self, "_tok_packs_schema", None) \
+                is self.schema:
+            return cached
+        packs = CompressedTokenIndex(self.index)
+        self._tok_packs = packs
+        self._tok_packs_ts = self.base_ts
+        self._tok_packs_schema = self.schema
+        return packs
 
     def src_uids(self, read_ts: int) -> np.ndarray:
         """All uids with >=1 posting — has() root. Ref

@@ -104,6 +104,9 @@ def residency(tab) -> dict:
     add("tokenCSR", "_tok_csr", "_tok_csr_ts")
     add("edgeTable", "_edge_table", "_edge_table_ts")
     add("deviceAdj", "_device_adj", "_device_adj_ts")
+    # the compressed token-index export is NOT a decoded structure —
+    # it lands in compressed_residency()/bytesCompressed, never in
+    # bytesDecoded (the whole point is the at-rest/decoded split)
     dv = 0
     for attr in list(vars(tab)):
         # "_device_values" plus per-language "_device_values@<lang>"
@@ -129,6 +132,20 @@ def residency(tab) -> dict:
             if getattr(tab, attr + "_ts", -1) == tab.base_ts:
                 perms += _resident_nbytes(getattr(tab, attr))
     out["orderPerms"] = perms
+    return out
+
+
+def compressed_residency(tab) -> dict:
+    """Compressed-at-rest exports currently materialized (the
+    compressed tier's operand plane): bytes of structures that hold
+    COMPRESSED blocks, reported apart from residency() so
+    bytesDecoded keeps meaning 'dense decoded bytes' — the
+    bytesAtRest/bytesDecoded split the bench regime gates on."""
+    out: dict[str, int] = {"tokenPacks": 0}
+    obj = getattr(tab, "_tok_packs", None)
+    if obj is not None \
+            and getattr(tab, "_tok_packs_ts", -1) == tab.base_ts:
+        out["tokenPacks"] = _resident_nbytes(obj)
     return out
 
 
@@ -198,11 +215,14 @@ def tablet_stats(tab) -> dict:
         base = _base_stats(tab)
         tab._stats_cache = (tab.base_ts, tab.schema, base)
     res = residency(tab)
+    comp = compressed_residency(tab)
     out = dict(base)
     out["dirtyOps"] = sum(len(ops) for _, ops in tab.deltas)
     out["touches"] = int(getattr(tab, "touches", 0))
     out["residency"] = res
+    out["compressedResidency"] = comp
     out["bytesDecoded"] = int(sum(res.values()))
+    out["bytesCompressed"] = int(sum(comp.values()))
     return out
 
 

@@ -29,6 +29,7 @@ BUCKETS = [0.1, 0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
 # a copy-paste smell. Keep sorted within each group.
 REGISTERED = (
     # engine (engine/db.py, engine/lazy_tablets.py, engine/tile_cache.py)
+    "codec_scratch_bytes",
     "device_cache_bytes",
     "device_cache_evictions",
     "device_cache_tiles",
@@ -37,6 +38,7 @@ REGISTERED = (
     "dgraph_num_queries_total",
     "dgraph_query_latency_ms",
     "dgraph_txn_aborts_total",
+    "host_tile_bytes",
     "tablet_store_evictions",
     "tablet_store_loads",
     # serving edge (server/http.py)
@@ -52,6 +54,8 @@ REGISTERED = (
     # query executor tier counters (query/executor.py)
     "query_columnar_var_bind_total",
     "query_colvar_hits_total",
+    "query_compressed_fallback_total",
+    "query_compressed_setops_total",
     "query_device_count_page_total",
     "query_device_expand_total",
     "query_device_multisort_total",

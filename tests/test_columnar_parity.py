@@ -1,17 +1,21 @@
-"""Differential parity: the columnar scan tier vs the exact posting
-path.
+"""Differential parity: the columnar scan tier AND the compressed
+posting tier vs the exact posting path.
 
 `GraphDB(prefer_columnar=False)` pins every read to the per-posting
-MVCC path (the tier's oracle). A seeded randomized workload — string /
-int / float / datetime predicates, language tags, list values, NUL-ish
-and unicode payloads, uid edges — must produce BYTE-IDENTICAL JSON on
-both settings:
+MVCC path (the tiers' oracle); `prefer_compressed=False` keeps the
+columnar tier but pins token-index set algebra to the dense CSR
+exports — so three engines answer the seeded randomized workload —
+string / int / float / datetime predicates, language tags, list
+values, NUL-ish and unicode payloads, uid edges — and must produce
+BYTE-IDENTICAL JSON across all three:
 
-  * on a clean (rolled-up) store, where the columnar tier serves;
-  * on a dirty store (live delta overlay), where the tier must fall
+  * on a clean (rolled-up) store, where the tiers serve (the
+    compressed tier runs eq/terms/trigram/match set algebra on
+    CompressedPack blocks, decoding only surviving blocks);
+  * on a dirty store (live delta overlay), where the tiers must fall
     back row-exactly and merge;
   * across snapshots: a read pinned below a tablet's rollup watermark
-    raises StaleSnapshot on BOTH paths (never silently-newer data).
+    raises StaleSnapshot on EVERY path (never silently-newer data).
 """
 
 import json
@@ -113,67 +117,89 @@ def _run_all(db, read_ts=None):
     return out
 
 
-def _build(prefer_columnar: bool):
+def _build(prefer_columnar: bool, prefer_compressed: bool = False):
     rng = random.Random(SEED)
-    db = GraphDB(prefer_device=False, prefer_columnar=prefer_columnar)
+    db = GraphDB(prefer_device=False, prefer_columnar=prefer_columnar,
+                 prefer_compressed=prefer_compressed)
     db.alter(schema_text=SCHEMA)
     db.mutate(set_nquads="\n".join(_dataset(rng)))
+    db.rollup_all()  # the "clean store" premise: tiers may serve
     return db
 
 
 @pytest.fixture(scope="module")
 def dbs():
-    return _build(True), _build(False)
+    """(compressed tier on, columnar-only, postings oracle) over the
+    identical dataset."""
+    return (_build(True, prefer_compressed=True),
+            _build(True, prefer_compressed=False),
+            _build(False))
+
+
+def _assert_threeway(runs: dict[str, dict], where: str):
+    names = list(runs)
+    base = runs[names[0]]
+    for other in names[1:]:
+        got = runs[other]
+        for i in base:
+            assert base[i] == got[i], \
+                f"{where} drift on query {i} ({names[0]} vs {other}):" \
+                f"\n{QUERIES[i]}\n{names[0]}: {base[i][:800]}" \
+                f"\n{other}: {got[i][:800]}"
 
 
 def test_parity_clean(dbs):
-    col, post = dbs
-    a, b = _run_all(col), _run_all(post)
-    for i in a:
-        assert a[i] == b[i], f"columnar drift on query {i}:" \
-            f"\n{QUERIES[i]}\ncol:  {a[i][:800]}\npost: {b[i][:800]}"
+    comp, col, post = dbs
+    # the compressed tier actually served (not silently disabled)
+    from dgraph_tpu.utils import metrics
+    before = metrics.counters_snapshot()
+    runs = {"compressed": _run_all(comp), "columnar": _run_all(col),
+            "postings": _run_all(post)}
+    delta = metrics.counters_delta(before)
+    assert delta.get("query_compressed_setops_total", 0) > 0
+    _assert_threeway(runs, "clean")
 
 
 def test_parity_dirty_overlay(dbs):
-    """Mutate both stores WITHOUT rollup: the delta overlay is live, the
-    columnar tier must fall back / merge row-exactly."""
-    col, post = dbs
+    """Mutate all stores WITHOUT rollup: the delta overlay is live,
+    the columnar AND compressed tiers must fall back / merge
+    row-exactly."""
+    comp, col, post = dbs
     edits = []
     rng = random.Random(SEED + 1)
     for i in rng.sample(range(1, 400), 60):
         edits.append(f'<0x{i:x}> <name> "Edited {i}" .')
         edits.append(f'<0x{i:x}> <score> "{rng.randint(0, 99) / 10}" .')
-    for db in (col, post):
+    for db in (comp, col, post):
         db.rollup_in_read = False  # keep the overlay live during reads
         db.mutate(set_nquads="\n".join(edits))
         assert any(t.dirty() for t in db.tablets.values())
-    a, b = _run_all(col), _run_all(post)
-    for i in a:
-        assert a[i] == b[i], f"dirty-overlay drift on query {i}:" \
-            f"\n{QUERIES[i]}\ncol:  {a[i][:800]}\npost: {b[i][:800]}"
+    _assert_threeway({"compressed": _run_all(comp),
+                      "columnar": _run_all(col),
+                      "postings": _run_all(post)}, "dirty-overlay")
 
 
 def test_parity_snapshot_and_rollup_boundary(dbs):
     """Reads below a tablet's rollup watermark raise StaleSnapshot on
-    both tiers; reads at the post-rollup snapshot agree."""
-    col, post = dbs
+    every tier; reads at the post-rollup snapshot agree."""
+    comp, col, post = dbs
     old_ts = {}
-    for name, db in (("col", col), ("post", post)):
+    for name, db in (("comp", comp), ("col", col), ("post", post)):
         old_ts[name] = db.coordinator.max_assigned()
         db.mutate(set_nquads='<0x1> <name> "Rolled Forward" .')
         wm = db.coordinator.max_assigned()
         for tab in db.tablets.values():
             tab.rollup(wm)
-    # the pre-rollup snapshot no longer exists: both tiers refuse
-    for name, db in (("col", col), ("post", post)):
+    # the pre-rollup snapshot no longer exists: every tier refuses
+    for name, db in (("comp", comp), ("col", col), ("post", post)):
         with pytest.raises(StaleSnapshot):
             db.query('{ q(func: has(name)) { count(uid) } }',
                      read_ts=old_ts[name])
-    a, b = _run_all(col), _run_all(post)
-    for i in a:
-        assert a[i] == b[i], f"post-rollup drift on query {i}"
+    _assert_threeway({"compressed": _run_all(comp),
+                      "columnar": _run_all(col),
+                      "postings": _run_all(post)}, "post-rollup")
     # the folded write is visible through the rebuilt column caches
-    for db in (col, post):
+    for db in (comp, col, post):
         got = db.query(
             '{ q(func: eq(name, "Rolled Forward")) { uid } }')["data"]
         assert got["q"] == [{"uid": "0x1"}]
@@ -188,7 +214,7 @@ def test_parity_batched_vs_sequential(dbs):
 
     from dgraph_tpu.engine.batcher import MicroBatcher
 
-    col, _post = dbs
+    _comp, col, _post = dbs
     sequential = {q: json.dumps(json.loads(col.query_json(q))["data"],
                                 sort_keys=True) for q in QUERIES}
     mb = MicroBatcher(col, window_us=2000, max_batch=8)
@@ -221,7 +247,7 @@ def test_parity_batched_after_schema_alter(dbs):
     plans, so batched answers re-derive against the new schema."""
     from dgraph_tpu.engine.batcher import MicroBatcher
 
-    col, _post = dbs
+    _comp, col, _post = dbs
     mb = MicroBatcher(col, window_us=1000)
     q = '{ q(func: eq(tag, "t2"), first: 3) { uid tag } }'
     before = mb.query_json(q)

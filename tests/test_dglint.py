@@ -732,9 +732,111 @@ class TestFramework:
             [sys.executable, "-m", "tools.dglint", "--list-rules"],
             cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
         assert out.returncode == 0
-        for code in ("DG01", "DG02", "DG03", "DG04",
-                     "DG05", "DG06", "DG07", "DG08"):
+        for code in ("DG01", "DG02", "DG03", "DG04", "DG05",
+                     "DG06", "DG07", "DG08", "DG09"):
             assert code in out.stdout
+
+
+# ------------------------------------------------------------------ DG09
+
+
+def _codec_proj(**kw):
+    kw.setdefault("decode_sites",
+                  frozenset({"dgraph_tpu/ops/codec.py",
+                             "dgraph_tpu/query/executor.py"}))
+    kw.setdefault("codec_registry_found", True)
+    return kw
+
+
+class TestCompressedDecodeDiscipline:
+    def test_catches_densify_outside_sites(self):
+        found = run_fixture("""
+            def expand(pack):
+                return pack.densify()
+        """, rel="dgraph_tpu/engine/_fixture.py", **_codec_proj())
+        assert "DG09" in codes(found)
+
+    def test_catches_module_decompress(self):
+        found = run_fixture("""
+            from dgraph_tpu.ops import codec
+
+            def expand(pack):
+                return codec.decompress(pack)
+        """, rel="dgraph_tpu/engine/_fixture.py", **_codec_proj())
+        assert "DG09" in codes(found)
+
+    def test_catches_compressed_index_probe(self):
+        found = run_fixture("""
+            def lookup(tix, token):
+                return tix.probe(token)
+        """, rel="dgraph_tpu/engine/_fixture.py", **_codec_proj())
+        assert "DG09" in codes(found)
+
+    def test_gzip_decompress_not_flagged(self):
+        found = run_fixture("""
+            import gzip
+
+            def unwrap(blob):
+                return gzip.decompress(blob)
+        """, rel="dgraph_tpu/engine/_fixture.py", **_codec_proj())
+        assert "DG09" not in codes(found)
+
+    def test_sanctioned_site_clean(self):
+        found = run_fixture("""
+            def expand(pack):
+                return pack.densify()
+        """, rel="dgraph_tpu/query/executor.py", **_codec_proj())
+        assert "DG09" not in codes(found)
+
+    def test_probe_operand_and_setops_clean(self):
+        found = run_fixture("""
+            from dgraph_tpu.ops import setops
+
+            def lookup(tix, tokens):
+                ops = [tix.probe_operand(t) for t in tokens]
+                return setops.intersect_mixed(ops)
+        """, rel="dgraph_tpu/engine/_fixture.py", **_codec_proj())
+        assert "DG09" not in codes(found)
+
+    def test_bare_codec_decompress_flagged(self):
+        found = run_fixture("""
+            from dgraph_tpu.ops.codec import decompress
+
+            def expand(pack):
+                return decompress(pack)
+        """, rel="dgraph_tpu/engine/_fixture.py", **_codec_proj())
+        assert "DG09" in codes(found)
+
+    def test_bare_gzip_decompress_not_flagged(self):
+        found = run_fixture("""
+            from gzip import decompress
+
+            def unwrap(blob):
+                return decompress(blob)
+        """, rel="dgraph_tpu/engine/_fixture.py", **_codec_proj())
+        assert "DG09" not in codes(found)
+
+    def test_suppressed(self):
+        found = run_fixture("""
+            def expand(pack):
+                return pack.densify()  # dglint: disable=DG09
+        """, rel="dgraph_tpu/engine/_fixture.py", **_codec_proj())
+        assert "DG09" not in codes(found)
+
+    def test_skipped_without_registry(self):
+        # fixture projects without DECODE_SITES skip the check (same
+        # gating as DG08's span registry)
+        found = run_fixture("""
+            def expand(pack):
+                return pack.densify()
+        """, rel="dgraph_tpu/engine/_fixture.py")
+        assert "DG09" not in codes(found)
+
+    def test_registry_parses_from_tree(self):
+        proj = build_project(["dgraph_tpu/ops/codec.py"], REPO_ROOT)
+        assert proj.codec_registry_found
+        assert "dgraph_tpu/ops/codec.py" in proj.decode_sites
+        assert "dgraph_tpu/query/executor.py" in proj.decode_sites
 
 
 # --------------------------------------------------------- tier-1 gate

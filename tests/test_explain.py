@@ -126,7 +126,8 @@ def test_explain_plan_payload(db):
     assert len(p["skeleton"]) == 16
     int(p["skeleton"], 16)
     assert p["blocks"] and isinstance(p["blocks"][0], str)
-    assert set(e["tiers"]) == {"columnar", "device", "deviceMinEdges"}
+    assert set(e["tiers"]) == {"columnar", "compressed", "device",
+                               "deviceMinEdges"}
     blk = e["blocks"][0]
     for k in ("name", "attr", "estRows", "estRowsMax", "basis",
               "source"):

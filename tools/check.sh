@@ -49,6 +49,12 @@ echo "== pprof overhead =="
 # DGRAPH_TPU_PPROF_BUDGET overrides)
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --pprof-overhead
 
+echo "== compressed setops =="
+# compressed-vs-dense set algebra sweep: block-descriptor skipping
+# must beat decode-then-intersect on the selective-intersection
+# config, with full result parity (DGRAPH_TPU_SETOPS_BUDGET overrides)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --setops-compressed
+
 echo "== cluster load smoke =="
 # ~30 s mini-cluster open-loop run (1 zero + 2 single-replica groups,
 # tiny seeded graph, gentle fixed rate) through tools/dgbench.py:

@@ -98,7 +98,8 @@ def all_rules() -> dict[str, Rule]:
 def _load_rules():
     # import for side effect: each module registers its rules
     from tools.dglint import (  # noqa: F401
-        rules_concurrency, rules_jax, rules_mvcc, rules_registry,
+        rules_codec, rules_concurrency, rules_jax, rules_mvcc,
+        rules_registry,
     )
 
 
@@ -123,6 +124,9 @@ class ProjectContext:
     span_names: frozenset[str] = frozenset()
     span_dupes: list[tuple[str, int]] = field(default_factory=list)
     span_registry_found: bool = False
+    # DG09 sanctioned decode-site registry (ops/codec.py DECODE_SITES)
+    decode_sites: frozenset[str] = frozenset()
+    codec_registry_found: bool = False
 
 
 @dataclass
@@ -211,10 +215,12 @@ def _collect_registries(proj: ProjectContext, root: str):
     fp_rel = "dgraph_tpu/utils/failpoint.py"
     mt_rel = "dgraph_tpu/utils/metrics.py"
     tr_rel = "dgraph_tpu/utils/tracing.py"
+    cd_rel = "dgraph_tpu/ops/codec.py"
     found = 0
     for rel, target, attr in ((fp_rel, "SITES", "failpoint"),
                               (mt_rel, "REGISTERED", "metric"),
-                              (tr_rel, "SPAN_NAMES", "span")):
+                              (tr_rel, "SPAN_NAMES", "span"),
+                              (cd_rel, "DECODE_SITES", "decode")):
         tree = proj.files.get(rel)
         if tree is None:
             ap = os.path.join(root, rel)
@@ -234,10 +240,13 @@ def _collect_registries(proj: ProjectContext, root: str):
             found += 1
             proj.metric_names = frozenset(names)
             proj.metric_dupes = dupes
-        else:
+        elif attr == "span":
             proj.span_names = frozenset(names)
             proj.span_dupes = dupes
             proj.span_registry_found = True
+        else:
+            proj.decode_sites = frozenset(names)
+            proj.codec_registry_found = True
     proj.registries_found = found == 2
 
 

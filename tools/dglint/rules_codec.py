@@ -1,0 +1,93 @@
+"""DG09 — compressed-decode discipline.
+
+The compressed posting plane (ops/codec.CompressedPack + the pack
+set-algebra in ops/setops) only keeps its memory win if nothing
+densifies packs eagerly: one convenient `.densify()` in a hot path
+re-materializes the 8 B/uid vectors the plane exists to avoid, and the
+regression is invisible — results stay byte-identical, only resident
+bytes creep back up. So the decode seams are registered, like DG08's
+metric names:
+
+    dgraph_tpu/ops/codec.py    DECODE_SITES = ("dgraph_tpu/ops/...",)
+
+and DG09 flags, across dgraph_tpu/, any call of the densify surface —
+`<pack>.densify(...)`, `codec.decompress(...)` (or a bare
+`decompress(...)` when the file imports it from ops.codec), or a
+compressed token index's `.probe(...)` — in a file not listed in
+DECODE_SITES. Dynamically computed access is invisible to the linter
+(same literal-only contract as DG08); the registry tuple is the
+reviewable record of every sanctioned decode site. `probe` is only
+flagged when the receiver names suggest the compressed plane
+(`*pack*`/`*tix*` receivers), so unrelated probe() APIs (e.g. the
+dense TokenIndexCSR served through the same executor seam) stay out
+of scope; the compressed-form alternative is
+`probe_operand` + the ops/setops mixed kernels.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dglint.astutil import call_name, walk_calls
+from tools.dglint.core import FileContext, register
+
+_DENSIFY_ATTRS = frozenset({"densify"})
+_DENSIFY_FNS = frozenset({"decompress"})
+_PROBE_RECEIVER_HINTS = ("pack", "tix")
+_CODEC_MODULE = "dgraph_tpu.ops.codec"
+
+
+def _imports_codec_decompress(tree: ast.AST) -> bool:
+    """Whether the module binds a bare `decompress` name to the codec
+    plane (`from dgraph_tpu.ops.codec import decompress [as ...]`) —
+    gzip/zlib/lzma's decompress must not trip the rule."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == _CODEC_MODULE:
+            for alias in node.names:
+                if alias.name == "decompress":
+                    return True
+    return False
+
+
+@register("DG09", "compressed-decode-discipline",
+          scopes=("dgraph_tpu/",))
+def check_compressed_decode(ctx: FileContext):
+    """Eager densify of compressed packs (.densify() / decompress() /
+    compressed-index .probe()) outside the DECODE_SITES registry."""
+    proj = ctx.project
+    if not getattr(proj, "codec_registry_found", False):
+        return
+    if ctx.rel in proj.decode_sites:
+        return
+    bare_decompress_is_codec = None  # computed lazily, once per file
+    for call in walk_calls(ctx.tree):
+        name = call_name(call)
+        if name is None:
+            continue
+        parts = name.split(".")
+        tail = parts[-1]
+        if tail in _DENSIFY_FNS:
+            if len(parts) > 1:
+                if parts[-2] not in ("codec", "_codec"):
+                    continue  # gzip.decompress & friends
+            else:
+                if bare_decompress_is_codec is None:
+                    bare_decompress_is_codec = \
+                        _imports_codec_decompress(ctx.tree)
+                if not bare_decompress_is_codec:
+                    continue  # `from gzip import decompress` etc.
+        if tail in _DENSIFY_ATTRS or tail in _DENSIFY_FNS:
+            yield ctx.finding(
+                "DG09", call,
+                f"eager compressed-pack decode {tail!r} outside the "
+                "sanctioned sites (ops/codec.py DECODE_SITES) — keep "
+                "set algebra on compressed forms via ops/setops")
+        elif tail == "probe" and len(parts) >= 2 and any(
+                h in parts[-2].lower() for h in _PROBE_RECEIVER_HINTS):
+            yield ctx.finding(
+                "DG09", call,
+                "compressed token-index .probe() densifies a posting "
+                "list outside the sanctioned sites (ops/codec.py "
+                "DECODE_SITES) — use probe_operand + the ops/setops "
+                "mixed kernels")
