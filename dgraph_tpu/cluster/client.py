@@ -42,33 +42,48 @@ class ClusterClient:
         self._conns: dict[int, socket.socket] = {}
         self._preferred: Optional[int] = None
         self._down: dict[int, float] = {}  # node -> demoted-until
+        # `_lock` guards ONLY the routing state (addrs/_preferred/
+        # _down/_conns/_mus dict shape) and is never held across
+        # socket I/O: one caller stuck on a sick peer must not
+        # serialize every other caller's routing. Per-node `_mus`
+        # mutexes serialize the frame write/read pair on the ONE
+        # pooled request/response connection per peer.
         self._lock = threading.Lock()
+        self._mus: dict[int, threading.Lock] = {}
+        self._closed = False
 
     # ------------------------------------------------------------ plumbing
 
-    def _conn(self, node: int,
-              timeout: Optional[float] = None) -> Optional[socket.socket]:
-        sock = self._conns.get(node)
-        if sock is not None:
-            return sock
-        try:
-            # connect budget never exceeds the client's deadline: a
-            # SYN-blackholed peer must not eat a 2s connect timeout on
-            # a 150ms-budget timestamp client (raft lock is held)
-            budget = self.timeout if timeout is None \
-                else min(self.timeout, timeout)
-            sock = socket.create_connection(
-                self.addrs[node], timeout=min(2.0, budget))
-            sock.settimeout(self.timeout)
-        except OSError:
-            return None
-        self._conns[node] = sock
-        return sock
+    def _node_mu(self, node: int) -> threading.Lock:
+        with self._lock:
+            mu = self._mus.get(node)
+            if mu is None:
+                mu = self._mus[node] = threading.Lock()
+            return mu
 
-    def _drop(self, node: int):
-        sock = self._conns.pop(node, None)
-        if sock is not None:
-            sock.close()
+    def _drop(self, node: int,
+              sock: Optional[socket.socket] = None) -> bool:
+        """Drop a failed pooled conn. With `sock` given, un-pool only
+        if THAT socket is still the pooled one — an error surfacing on
+        a stale handle must not destroy a healthy replacement another
+        thread just dialed. Returns whether `sock` was still current
+        (a stale failure says nothing about the node's health)."""
+        with self._lock:
+            cur = self._conns.get(node)
+            current = cur is not None and (sock is None or cur is sock)
+            if current:
+                del self._conns[node]
+            else:
+                cur = None
+        if cur is not None:
+            cur.close()
+        if sock is not None and sock is not cur:
+            sock.close()  # already un-pooled; close our failed handle
+        return current
+
+    def _mark_down(self, node: int):
+        with self._lock:
+            self._down[node] = time.monotonic() + self.UNHEALTHY_S
 
     def _rpc_once(self, node: int, req: dict,
                   timeout: Optional[float] = None) -> Optional[dict]:
@@ -76,32 +91,70 @@ class ClusterClient:
         (a caller deadline must bound blocking reads, not just the
         between-attempts loop check); the pooled socket's default
         timeout is restored on success, and a timed-out socket is
-        dropped by the except path anyway."""
-        sock = self._conn(node, timeout=timeout)
-        if sock is None:
-            self._down[node] = time.monotonic() + self.UNHEALTHY_S
+        dropped by the except path anyway.
+
+        Locking: the pooled conn is dialed OUTSIDE any lock and
+        inserted race-checked (transport.py's DG04 pattern — a 2s
+        connect timeout to one dead peer must not block routing to
+        healthy ones), then the per-node mutex serializes exactly the
+        write+read pair so concurrent requests to one peer cannot
+        interleave frames."""
+        with self._lock:
+            sock = self._conns.get(node)
+            addr = self.addrs.get(node)
+        if addr is None:
             return None
+        if sock is None:
+            # connect budget never exceeds the client's deadline: a
+            # SYN-blackholed peer must not eat a 2s connect timeout
+            # on a 150ms-budget timestamp client
+            budget = self.timeout if timeout is None \
+                else min(self.timeout, timeout)
+            try:
+                fresh = socket.create_connection(
+                    addr, timeout=min(2.0, budget))
+                fresh.settimeout(self.timeout)
+            except OSError:
+                self._mark_down(node)
+                return None
+            with self._lock:
+                if self._closed:
+                    # a racing close() already swept the pool; do not
+                    # leak a fresh conn into a dead client
+                    cur = None
+                elif (cur := self._conns.get(node)) is None:
+                    self._conns[node] = fresh
+                    cur = fresh
+                sock = cur
+            if sock is not fresh:
+                fresh.close()
+            if sock is None:
+                return None
         try:
-            if timeout is not None:
-                sock.settimeout(max(0.001, min(self.timeout, timeout)))
-            wire.write_frame(sock, wire.dumps(req))
-            resp = wire.loads(wire.read_frame(sock))
-            if timeout is not None:
-                sock.settimeout(self.timeout)
-            self._down.pop(node, None)
+            with self._node_mu(node):
+                if timeout is not None:
+                    sock.settimeout(
+                        max(0.001, min(self.timeout, timeout)))
+                wire.write_frame(sock, wire.dumps(req))
+                resp = wire.loads(wire.read_frame(sock))
+                if timeout is not None:
+                    sock.settimeout(self.timeout)
+            with self._lock:
+                self._down.pop(node, None)
             return resp
         except socket.timeout:
-            self._drop(node)
-            if timeout is None or timeout >= self.timeout:
+            current = self._drop(node, sock)
+            if current and (timeout is None
+                            or timeout >= self.timeout):
                 # a FULL-budget timeout says the node is sick; one cut
                 # short by the caller's nearly-spent deadline says
                 # nothing — demoting on it would poison the health
                 # cache for every other user of this client
-                self._down[node] = time.monotonic() + self.UNHEALTHY_S
+                self._mark_down(node)
             return None
         except (OSError, EOFError, wire.WireError):
-            self._drop(node)
-            self._down[node] = time.monotonic() + self.UNHEALTHY_S
+            if self._drop(node, sock):
+                self._mark_down(node)
             return None
 
     def request(self, req: dict, deadline_s: Optional[float] = None) -> dict:
@@ -147,12 +200,12 @@ class ClusterClient:
 
         last_err = "unreachable"
         while time.monotonic() < deadline:
-            # one full routed pass per lock hold; the between-pass
-            # backoff sleeps OUTSIDE the lock (DG04 — a concurrent
-            # caller must be able to route while this one backs off),
-            # and each reacquisition recomputes the candidate order
-            # from the CURRENT _preferred/_down/addrs state, which may
-            # have moved while we slept
+            # snapshot the routing state under the lock, then do every
+            # RPC with NO lock held (the dial-outside-lock pattern: a
+            # caller routing through a sick peer, or backing off, must
+            # never serialize concurrent callers). Each pass
+            # recomputes the candidate order from the CURRENT
+            # _preferred/_down/addrs state, which may have moved.
             with self._lock:
                 order = [n for n in
                          ([self._preferred] + sorted(self.addrs))
@@ -162,32 +215,36 @@ class ClusterClient:
                 now = time.monotonic()
                 order = sorted(order,
                                key=lambda n: self._down.get(n, 0) > now)
-                seen = set()
-                for node in order:
-                    if node in seen or node not in self.addrs:
-                        continue
-                    if time.monotonic() >= deadline:
-                        break
-                    seen.add(node)
-                    resp = self._rpc_once(node, req,
-                                          timeout=attempt_timeout())
-                    if resp is None:
-                        continue
-                    if resp.get("ok"):
+                known = set(self.addrs)
+            seen: set[int] = set()
+            for node in order:
+                if node in seen or node not in known:
+                    continue
+                if time.monotonic() >= deadline:
+                    break
+                seen.add(node)
+                resp = self._rpc_once(node, req,
+                                      timeout=attempt_timeout())
+                if resp is None:
+                    continue
+                if resp.get("ok"):
+                    with self._lock:
                         self._preferred = node
-                        return resp
-                    if resp.get("error") == "not leader":
-                        hint = resp.get("leader")
-                        if hint is not None and hint != node \
-                                and hint in self.addrs \
-                                and time.monotonic() < deadline:
+                    return resp
+                if resp.get("error") == "not leader":
+                    hint = resp.get("leader")
+                    with self._lock:
+                        follow = (hint is not None and hint != node
+                                  and hint in self.addrs)
+                        if follow:
                             self._preferred = hint
-                            hinted = self._rpc_once(
-                                hint, req, timeout=attempt_timeout())
-                            if hinted is not None and hinted.get("ok"):
-                                return hinted
-                        continue
-                    return resp  # real application error: surface it
+                    if follow and time.monotonic() < deadline:
+                        hinted = self._rpc_once(
+                            hint, req, timeout=attempt_timeout())
+                        if hinted is not None and hinted.get("ok"):
+                            return hinted
+                    continue
+                return resp  # real application error: surface it
             last_err = "no leader reachable"
             # never sleep past the deadline the caller set
             time.sleep(min(0.1, max(0.0,
@@ -202,9 +259,11 @@ class ClusterClient:
 
     def close(self):
         with self._lock:
-            for sock in self._conns.values():
-                sock.close()
+            self._closed = True
+            socks = list(self._conns.values())
             self._conns.clear()
+        for sock in socks:
+            sock.close()
 
     # ------------------------------------------------------- alpha surface
 
@@ -364,14 +423,15 @@ class ClusterClient:
     def remove_node(self, node: int):
         with self._lock:
             self.addrs.pop(node, None)
-            self._drop(node)
+            sock = self._conns.pop(node, None)
             if self._preferred == node:
                 self._preferred = None
+        if sock is not None:
+            sock.close()
 
     def status(self, node: Optional[int] = None) -> dict:
         if node is not None:
-            with self._lock:
-                resp = self._rpc_once(node, {"op": "status"})
+            resp = self._rpc_once(node, {"op": "status"})
             if resp is None:
                 raise ConnectionError(f"node {node} unreachable")
             return resp["result"]

@@ -676,7 +676,10 @@ class GraphDB:
                         old = []
                     else:
                         old = [p for p in
-                               tab.get_postings(op.src, commit_ts - 1)
+                               # pre-image read: the overwrite
+                               # expansion must see state strictly
+                               # below the commit it is applying
+                               tab.get_postings(op.src, commit_ts - 1)  # dglint: disable=DG11 (pre-image read)
                                if p.lang == op.posting.lang]
                     for p in old:
                         expanded.append(EdgeOp("del", op.src, posting=p))

@@ -59,6 +59,34 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "failpoint: arms utils/failpoint injection points "
         "(must clear them; the leak guard below enforces it)")
+    config.addinivalue_line(
+        "markers", "lockcheck: arms the utils/lockcheck runtime "
+        "lock-order witness for the test (module-wide via "
+        "pytestmark in the tier-1 concurrency files); a witnessed "
+        "inversion fails the test with both stacks")
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck_witness(request):
+    """Opt-in runtime lock-order witness (dglint DG12's dynamic
+    complement): tests/modules marked `lockcheck` run with every
+    project-created lock instrumented; any inversion witnessed during
+    the test fails it with the first-seen and current stacks."""
+    marker = request.node.get_closest_marker("lockcheck")
+    if marker is None:
+        yield
+        return
+    from dgraph_tpu.utils import lockcheck
+
+    lockcheck.enable(strict=bool(marker.kwargs.get("strict", False)))
+    try:
+        yield
+    finally:
+        found = lockcheck.disable()
+    if found:
+        pytest.fail(
+            "lock-order inversion(s) witnessed by utils/lockcheck:\n"
+            + "\n".join(str(v) for v in found))
 
 
 @pytest.fixture(autouse=True)

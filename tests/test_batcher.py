@@ -8,6 +8,10 @@ import time
 
 import pytest
 
+# tier-1 concurrency file: every test runs under the runtime
+# lock-order witness (utils/lockcheck; see the conftest marker)
+pytestmark = pytest.mark.lockcheck
+
 from dgraph_tpu.engine.batcher import MicroBatcher
 from dgraph_tpu.engine.db import GraphDB
 from dgraph_tpu.utils import metrics

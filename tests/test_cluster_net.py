@@ -19,6 +19,10 @@ import time
 
 import pytest
 
+# tier-1 concurrency file: every test runs under the runtime
+# lock-order witness (utils/lockcheck; see the conftest marker)
+pytestmark = pytest.mark.lockcheck
+
 from dgraph_tpu.cluster.client import ClusterClient
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -482,3 +486,38 @@ def test_client_demotes_failed_nodes(alpha):
         assert cl.status()["role"] in ("leader", "follower")
     finally:
         cl.close()
+
+
+def test_drop_only_unpools_the_failed_socket():
+    """The lock-free _rpc_once races: an error surfacing on a STALE
+    handle must not destroy a healthy replacement another thread just
+    dialed, and a stale failure must not demote the node."""
+    import socket as _socket
+
+    client = ClusterClient({1: ("127.0.0.1", 1)})
+    stale, healthy = _socket.socket(), _socket.socket()
+    client._conns[1] = healthy
+    assert client._drop(1, stale) is False      # stale: not un-pooled
+    assert client._conns[1] is healthy          # replacement survives
+    assert client._drop(1, healthy) is True     # current: un-pooled
+    assert 1 not in client._conns
+    client.close()
+
+
+def test_close_wins_over_racing_dial():
+    """A dial that completes after close() must not leak a pooled
+    conn into the dead client (the race-checked insert honors
+    _closed, like transport.py's)."""
+    import socket as _socket
+
+    srv = _socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        client = ClusterClient({1: srv.getsockname()}, timeout=1.0)
+        client.close()
+        # post-close RPC: the dial succeeds, the insert must refuse
+        assert client._rpc_once(1, {"op": "status"}) is None
+        assert client._conns == {}
+    finally:
+        srv.close()

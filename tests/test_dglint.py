@@ -22,8 +22,9 @@ if REPO_ROOT not in sys.path:  # `python -m pytest` from elsewhere
     sys.path.insert(0, REPO_ROOT)
 
 from tools.dglint.core import (  # noqa: E402
-    ProjectContext, apply_baseline, build_project, lint_project,
-    lint_source, load_baseline, render_baseline,
+    ProjectContext, apply_baseline, build_project, lint_incremental,
+    lint_project, lint_source, lint_sources, load_baseline,
+    render_baseline,
 )
 from tools.dglint.rules_registry import parse_registry  # noqa: E402
 
@@ -732,9 +733,10 @@ class TestFramework:
             [sys.executable, "-m", "tools.dglint", "--list-rules"],
             cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
         assert out.returncode == 0
-        for code in ("DG01", "DG02", "DG03", "DG04", "DG05",
-                     "DG06", "DG07", "DG08", "DG09"):
+        for code in ("DG01", "DG02", "DG03", "DG04", "DG05", "DG06",
+                     "DG07", "DG08", "DG09", "DG10", "DG11", "DG12"):
             assert code in out.stdout
+        assert "whole-program" in out.stdout
 
 
 # ------------------------------------------------------------------ DG09
@@ -839,6 +841,539 @@ class TestCompressedDecodeDiscipline:
         assert "dgraph_tpu/query/executor.py" in proj.decode_sites
 
 
+# ------------------------------------------------------------------ DG10
+
+
+class TestCrossModulePurity:
+    """The paired fixture the whole-program layer exists for: a jitted
+    root in ops/ calling a helper in engine/ that does a host sync.
+    DG01's same-module closure cannot see it; DG10 must."""
+
+    HELPER = """
+        def helper(x):
+            return x.item()
+    """
+    ROOT = """
+        import jax
+        from dgraph_tpu.engine._helpers import helper
+
+        @jax.jit
+        def kernel(x):
+            return helper(x)
+    """
+    HELPER_REL = "dgraph_tpu/engine/_helpers.py"
+    ROOT_REL = "dgraph_tpu/ops/_fixture.py"
+
+    def _pair(self, helper_src=None, root_src=None):
+        return lint_sources({
+            self.HELPER_REL: textwrap.dedent(
+                helper_src or self.HELPER),
+            self.ROOT_REL: textwrap.dedent(root_src or self.ROOT),
+        })
+
+    def test_dg01_misses_the_cross_module_sync(self):
+        # the root file alone is DG01-clean: the helper lives in
+        # another module, outside the same-module closure
+        found = lint_source(textwrap.dedent(self.ROOT),
+                            rel=self.ROOT_REL)
+        assert "DG01" not in codes(found)
+
+    def test_dg10_catches_it(self):
+        found = self._pair()
+        dg10 = [f for f in found if f.code == "DG10"]
+        assert len(dg10) == 1
+        f = dg10[0]
+        assert f.path == self.HELPER_REL  # flagged AT the sync site
+        assert ".item()" in f.message
+        assert "kernel" in f.message      # names the jit root
+        assert "call chain" in f.message
+
+    def test_suppressed_at_site(self):
+        found = self._pair(helper_src="""
+            def helper(x):
+                return x.item()  # dglint: disable=DG10
+        """)
+        assert "DG10" not in codes(found)
+
+    def test_clean_pure_helper(self):
+        found = self._pair(helper_src="""
+            import jax.numpy as jnp
+
+            def helper(x):
+                return jnp.sum(x)
+        """)
+        assert "DG10" not in codes(found)
+
+    def test_same_module_stays_dg01s(self):
+        # inside ops/, a same-module bare-name closure is DG01's:
+        # DG10 must not double-report it
+        src = """
+            import jax
+
+            def helper(x):
+                return x.item()
+
+            @jax.jit
+            def kernel(x):
+                return helper(x)
+        """
+        found = lint_source(textwrap.dedent(src), rel=self.ROOT_REL)
+        assert "DG01" in codes(found)
+        assert "DG10" not in codes(found)
+
+    def test_method_resolution_through_class_attr(self):
+        # self.ops.pull() resolves through `self.ops = Ops()` — the
+        # class-attribute typing the resolver promises
+        found = lint_sources({
+            "dgraph_tpu/engine/_ops.py": textwrap.dedent("""
+                class Ops:
+                    def pull(self, x):
+                        return x.item()
+            """),
+            "dgraph_tpu/ops/_fixture.py": textwrap.dedent("""
+                import jax
+                from dgraph_tpu.engine._ops import Ops
+
+                class Runner:
+                    def __init__(self):
+                        self.ops = Ops()
+
+                    def kernel(self, x):
+                        return self.ops.pull(x)
+
+                    def launch(self, x):
+                        return jax.jit(self.kernel)(x)
+            """),
+        })
+        # jit(self.kernel) is dynamic dispatch the root-finder does
+        # not see — but an annotated call edge must work end to end
+        found2 = lint_sources({
+            "dgraph_tpu/engine/_ops.py": textwrap.dedent("""
+                class Ops:
+                    def pull(self, x):
+                        return x.item()
+            """),
+            "dgraph_tpu/ops/_fixture.py": textwrap.dedent("""
+                import jax
+                from dgraph_tpu.engine._ops import Ops
+
+                class Runner:
+                    def __init__(self):
+                        self.ops = Ops()
+
+                    @jax.jit
+                    def kernel(self, x):
+                        return self.ops.pull(x)
+            """),
+        })
+        assert "DG10" in codes(found2)
+        assert "DG10" not in codes(found)  # unannotated dynamic miss
+
+
+# ------------------------------------------------------------------ DG11
+
+
+class TestSnapshotTsProvenance:
+    REL = "dgraph_tpu/query/_fixture.py"
+
+    def run(self, src):
+        return run_fixture(src, rel=self.REL)
+
+    # -- violations ---------------------------------------------------
+
+    def test_laundered_literal_positional(self):
+        # DG03 misses this (the literal is not AT the call site)
+        src = """
+            def read(tab, u):
+                ts = 999
+                return tab.get_postings(u, ts)
+        """
+        found = self.run(src)
+        assert "DG03" not in codes(found)
+        assert "DG11" in codes(found)
+        assert "literal 999" in [f for f in found
+                                 if f.code == "DG11"][0].message
+
+    def test_arithmetic_kwarg(self):
+        src = """
+            def read(db, q, read_ts):
+                return db.query(q, read_ts=read_ts - 1)
+        """
+        found = self.run(src)
+        assert "DG11" in codes(found)
+        assert "arithmetic" in [f for f in found
+                                if f.code == "DG11"][0].message
+
+    def test_conditional_laundering(self):
+        src = """
+            def read(tab, u, ctx, pin):
+                ts = 2**63 if pin else ctx.read_ts
+                return tab.get_postings(u, ts)
+        """
+        assert "DG11" in codes(self.run(src))
+
+    def test_augmented_arithmetic(self):
+        src = """
+            def read(tab, u, ctx):
+                ts = ctx.read_ts
+                ts += 1
+                return tab.get_postings(u, ts)
+        """
+        assert "DG11" in codes(self.run(src))
+
+    # -- clean / suppressed -------------------------------------------
+
+    def test_threaded_param_clean(self):
+        src = """
+            def read(tab, u, read_ts):
+                return tab.get_postings(u, read_ts)
+        """
+        assert "DG11" not in codes(self.run(src))
+
+    def test_sanctioned_coordinator_clean(self):
+        src = """
+            def read(db, q):
+                ts = db.coordinator.max_assigned()
+                return db.query(q, read_ts=ts)
+        """
+        assert "DG11" not in codes(self.run(src))
+
+    def test_wire_field_clean(self):
+        src = """
+            def read(db, q, req):
+                return db.query(q, read_ts=req.get("read_ts"))
+        """
+        assert "DG11" not in codes(self.run(src))
+
+    def test_min_of_sanctioned_clean(self):
+        src = """
+            def read(tab, u, ctx, db):
+                ts = min(ctx.read_ts, db.coordinator.max_assigned())
+                return tab.get_postings(u, ts)
+        """
+        assert "DG11" not in codes(self.run(src))
+
+    def test_suppressed(self):
+        src = """
+            def read(tab, u):
+                ts = 999
+                return tab.get_postings(u, ts)  # dglint: disable=DG11
+        """
+        assert "DG11" not in codes(self.run(src))
+
+    def test_storage_exempt(self):
+        src = """
+            def fold(tab, u):
+                ts = 2**63
+                return tab.get_postings(u, ts)
+        """
+        assert "DG11" not in codes(
+            run_fixture(src, rel="dgraph_tpu/storage/_fixture.py"))
+
+
+# ------------------------------------------------------------------ DG12
+
+
+class TestGlobalLockOrder:
+    A_REL = "dgraph_tpu/cluster/_fix_a.py"
+    B_REL = "dgraph_tpu/engine/_fix_b.py"
+    C_REL = "dgraph_tpu/server/_fix_c.py"
+
+    # -- violations ---------------------------------------------------
+
+    def test_cross_module_two_cycle_via_methods(self):
+        found = lint_sources({
+            self.A_REL: textwrap.dedent("""
+                import threading
+                from dgraph_tpu.engine._fix_b import Beta
+
+                class Alpha:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.beta = Beta()
+
+                    def forward(self):
+                        with self._lock:
+                            self.beta.poke()
+
+                    def grab_alpha(self):
+                        with self._lock:
+                            pass
+            """),
+            self.B_REL: textwrap.dedent("""
+                import threading
+
+                class Beta:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.alpha = None
+
+                    def poke(self):
+                        with self._lock:
+                            pass
+
+                    def backward(self):
+                        with self._lock:
+                            self.alpha.grab_alpha()
+            """),
+        })
+        dg12 = [f for f in found if f.code == "DG12"]
+        assert len(dg12) == 1
+        msg = dg12[0].message
+        assert "Alpha._lock" in msg and "Beta._lock" in msg
+        # both witness paths rendered
+        assert "forward" in msg and "backward" in msg
+
+    def test_module_global_lock_cycle(self):
+        found = lint_sources({
+            self.A_REL: textwrap.dedent("""
+                import threading
+                from dgraph_tpu.engine._fix_b import _B_LOCK
+
+                _A_LOCK = threading.Lock()
+
+                def one():
+                    with _A_LOCK:
+                        with _B_LOCK:
+                            pass
+            """),
+            self.B_REL: textwrap.dedent("""
+                import threading
+
+                _B_LOCK = threading.Lock()
+
+                def other():
+                    from dgraph_tpu.cluster._fix_a import _A_LOCK
+                    with _B_LOCK:
+                        with _A_LOCK:
+                            pass
+            """),
+        })
+        assert "DG12" in codes(found)
+
+    def test_three_cycle_reported(self):
+        def mod(rel_import, own, their):
+            return textwrap.dedent(f"""
+                import threading
+                {rel_import}
+
+                {own} = threading.Lock()
+
+                def step():
+                    with {own}:
+                        with {their}:
+                            pass
+            """)
+        found = lint_sources({
+            self.A_REL: mod(
+                "from dgraph_tpu.engine._fix_b import _B_LOCK",
+                "_A_LOCK", "_B_LOCK"),
+            self.B_REL: mod(
+                "from dgraph_tpu.server._fix_c import _C_LOCK",
+                "_B_LOCK", "_C_LOCK"),
+            self.C_REL: mod(
+                "from dgraph_tpu.cluster._fix_a import _A_LOCK",
+                "_C_LOCK", "_A_LOCK"),
+        })
+        dg12 = [f for f in found if f.code == "DG12"]
+        assert len(dg12) == 1
+        assert "length 3" in dg12[0].message
+
+    # -- clean / suppressed -------------------------------------------
+
+    def test_consistent_global_order_clean(self):
+        found = lint_sources({
+            self.A_REL: textwrap.dedent("""
+                import threading
+                from dgraph_tpu.engine._fix_b import _B_LOCK
+
+                _A_LOCK = threading.Lock()
+
+                def one():
+                    with _A_LOCK:
+                        with _B_LOCK:
+                            pass
+            """),
+            self.B_REL: textwrap.dedent("""
+                import threading
+
+                _B_LOCK = threading.Lock()
+
+                def leaf():
+                    with _B_LOCK:
+                        pass
+            """),
+        })
+        assert "DG12" not in codes(found)
+
+    def test_same_file_lexical_inversion_is_dg04s(self):
+        src = """
+            import threading
+
+            _A_LOCK = threading.Lock()
+            _B_LOCK = threading.Lock()
+
+            def one(self):
+                with _A_LOCK:
+                    with _B_LOCK:
+                        pass
+
+            def other(self):
+                with _B_LOCK:
+                    with _A_LOCK:
+                        pass
+        """
+        found = run_fixture(src, rel=self.A_REL)
+        assert "DG04" in codes(found)
+        assert "DG12" not in codes(found)
+
+    def test_suppressed_at_witness_site(self):
+        found = lint_sources({
+            self.A_REL: textwrap.dedent("""
+                import threading
+                from dgraph_tpu.engine._fix_b import _B_LOCK
+
+                _A_LOCK = threading.Lock()
+
+                def one():
+                    with _A_LOCK:
+                        with _B_LOCK:  # dglint: disable=DG12
+                            pass
+            """),
+            self.B_REL: textwrap.dedent("""
+                import threading
+
+                _B_LOCK = threading.Lock()
+
+                def other():
+                    from dgraph_tpu.cluster._fix_a import _A_LOCK
+                    with _B_LOCK:
+                        with _A_LOCK:
+                            pass
+            """),
+        })
+        assert "DG12" not in codes(found)
+
+    def test_forced_call_annotation_adds_edge(self):
+        # `# dglint: calls=` teaches the resolver a dynamic dispatch
+        found = lint_sources({
+            self.A_REL: textwrap.dedent("""
+                import threading
+
+                _A_LOCK = threading.Lock()
+
+                def holder(cb):
+                    with _A_LOCK:
+                        cb()  # dglint: calls=dgraph_tpu.engine._fix_b:takes_b
+
+                def grab_a():
+                    with _A_LOCK:
+                        pass
+            """),
+            self.B_REL: textwrap.dedent("""
+                import threading
+
+                _B_LOCK = threading.Lock()
+
+                def takes_b():
+                    with _B_LOCK:
+                        pass
+
+                def inverse():
+                    from dgraph_tpu.cluster._fix_a import grab_a
+                    with _B_LOCK:
+                        grab_a()
+            """),
+        })
+        assert "DG12" in codes(found)
+
+
+# ------------------------------------------- exit codes & incremental
+
+
+class TestExitCodeContract:
+    """Findings exit 1; an internal rule crash exits 2 naming the
+    rule and file — a rule bug must never read as a clean run."""
+
+    def test_rule_crash_exits_2_and_names_the_rule(self, monkeypatch,
+                                                   capsys):
+        from tools.dglint import cli, core
+
+        rules = core.all_rules()  # force registration
+        broken = core.Rule(
+            "DG06", rules["DG06"].name, "", ("dgraph_tpu/",),
+            lambda ctx: (_ for _ in ()).throw(
+                ValueError("synthetic rule bug")))
+        monkeypatch.setitem(core._RULES, "DG06", broken)
+        rc = cli.main(["dgraph_tpu/utils/rwlock.py"])
+        err = capsys.readouterr().err
+        assert rc == 2
+        assert "DG06" in err
+        assert "rwlock.py" in err
+        assert "synthetic rule bug" in err
+
+    def test_findings_exit_1(self, tmp_path, capsys):
+        from tools.dglint import cli
+
+        # a fresh finding vs an empty baseline: exit 1, not 2
+        empty = tmp_path / "baseline.txt"
+        empty.write_text("")
+        rc = cli.main(["--baseline", str(empty),
+                       "dgraph_tpu/utils/rwlock.py"])
+        assert rc in (0, 1)  # rwlock is clean today -> 0; the
+        # contract under test is that crashes are the ONLY exit-2
+
+    def test_assert_empty_baseline(self, tmp_path, capsys):
+        from tools.dglint import cli
+
+        bl = tmp_path / "baseline.txt"
+        bl.write_text("DG06\tdgraph_tpu/x.py\tt = time.time()\n")
+        rc = cli.main(["--baseline", str(bl),
+                       "--assert-empty-baseline",
+                       "dgraph_tpu/utils/rwlock.py"])
+        assert rc == 1
+        assert "EMPTY baseline" in capsys.readouterr().err
+
+
+class TestChangedOnly:
+    def test_incremental_matches_full_and_caches(self, tmp_path):
+        cache = str(tmp_path / "cache.json")
+        full = lint_project(build_project(
+            ["dgraph_tpu/utils"], REPO_ROOT))
+        f1, _proj1, s1 = lint_incremental(
+            ["dgraph_tpu/utils"], REPO_ROOT, cache)
+        assert s1["changed"] > 0 and s1["cached"] == 0
+        f2, _proj2, s2 = lint_incremental(
+            ["dgraph_tpu/utils"], REPO_ROOT, cache)
+        assert s2["changed"] == 0 and s2["cached"] == s1["changed"]
+        key = lambda fs: [(f.path, f.line, f.code) for f in fs]  # noqa: E731
+        assert key(f1) == key(full)
+        assert key(f2) == key(full)
+
+    def test_change_is_picked_up(self, tmp_path):
+        # lint a COPY of a real module tree so the edit is hermetic
+        import shutil
+
+        root = tmp_path
+        pkg = root / "dgraph_tpu" / "utils"
+        pkg.mkdir(parents=True)
+        src_rw = os.path.join(REPO_ROOT, "dgraph_tpu", "utils",
+                              "rwlock.py")
+        shutil.copy(src_rw, pkg / "rwlock.py")
+        cache = str(root / "cache.json")
+        f1, _p, s1 = lint_incremental(
+            ["dgraph_tpu/utils"], str(root), cache)
+        assert not [f for f in f1 if f.code == "DG06"]
+        bad = (pkg / "rwlock.py").read_text() + (
+            "\n\ndef stamp():\n    import time\n"
+            "    return time.time()\n")
+        (pkg / "rwlock.py").write_text(bad)
+        f2, _p, s2 = lint_incremental(
+            ["dgraph_tpu/utils"], str(root), cache)
+        assert s2["changed"] == 1
+        assert [f for f in f2 if f.code == "DG06"]
+
+
 # --------------------------------------------------------- tier-1 gate
 
 
@@ -850,7 +1385,11 @@ class TestTreeGate:
         proj = build_project(["dgraph_tpu", "tests"], REPO_ROOT)
         assert proj.registries_found, \
             "SITES/REGISTERED registries missing from utils modules"
-        return lint_project(proj)
+        findings = lint_project(proj)
+        assert not proj.crashes, \
+            "internal rule crash over the real tree:\n" + "\n".join(
+                c.render() for c in proj.crashes)
+        return findings
 
     def test_no_new_findings(self, tree_findings):
         allowed = load_baseline(

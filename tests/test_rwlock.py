@@ -20,6 +20,10 @@ import time
 
 import pytest
 
+# tier-1 concurrency file: every test runs under the runtime
+# lock-order witness (utils/lockcheck; see the conftest marker)
+pytestmark = pytest.mark.lockcheck
+
 from dgraph_tpu.utils.rwlock import RWLock
 
 HOLD = 0.05

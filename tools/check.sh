@@ -2,8 +2,14 @@
 # Repo static-check gate: run before pushing (tier-1 also enforces the
 # dglint gate via tests/test_dglint.py).
 #
-#   1. dglint        — project invariant linter (tools/dglint), vs the
-#                      committed baseline
+#   1. dglint        — project invariant linter (tools/dglint) in
+#                      whole-program mode (call-graph rules DG10-12),
+#                      vs the committed baseline, which must be EMPTY
+#                      (--assert-empty-baseline: no grandfathered tech
+#                      debt). --changed-only re-lints only files whose
+#                      content hash moved (manifest:
+#                      tools/.dglint_cache.json); the whole-program
+#                      rules still analyze every file's summary
 #   2. compileall    — every file byte-compiles (syntax gate; dglint
 #                      skips unparseable files, so this owns them)
 #   3. import sweep  — `import dgraph_tpu` under -W error for
@@ -16,8 +22,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== dglint =="
-python -m tools.dglint dgraph_tpu tests
+echo "== dglint (whole-program, incremental) =="
+python -m tools.dglint --changed-only --assert-empty-baseline \
+    dgraph_tpu tests
 
 echo "== compileall =="
 python -m compileall -q dgraph_tpu tests tools bench.py bench_micro.py \

@@ -1,0 +1,649 @@
+"""Whole-program layer: per-file summaries + project call graph.
+
+Per-file rules see one AST at a time; the cross-module invariants
+(DG10 trace purity through helpers in other modules, DG12 global lock
+order) need a project-wide view. Rather than hand every rule every
+AST — which would sink the --changed-only budget, since re-parsing the
+tree alone costs ~0.7 s on this box — each file is distilled ONCE into
+a small JSON-serializable **summary**:
+
+    defs        every function/method, with its raw call sites (and
+                which locks are lexically held at each), its lock
+                acquisitions, and its DG01-style host-sync sites
+    imports     local name -> dotted target, for call resolution
+    classes     methods + `self.attr = SomeClass(...)` attribute types
+    trace_roots functions that enter tracing (jit decorators,
+                jit/shard_map/pallas_call targets)
+    suppress    the file's dglint suppression lines (whole-program
+                findings land in files the current lint pass may not
+                have re-parsed)
+
+Summaries are pure data: the incremental mode caches them per content
+hash and re-extracts only changed files, then runs the whole-program
+rules over ALL summaries — the analysis is always project-wide even
+when the parse is not.
+
+Call resolution is best-effort and conservative, in order:
+
+    1. bare name        -> def in the same module
+    2. self.meth        -> method of the enclosing class
+    3. self.attr.meth   -> via the class's `self.attr = Cls(...)`
+                           attribute types (the transport/db seams)
+    4. alias.func       -> through the file's import map
+    5. Cls(...)         -> Cls.__init__
+    6. anything.meth    -> the ONE method of that name project-wide
+                           (unique-name fallback; ambiguous names stay
+                           unresolved rather than guessed)
+
+`# dglint: calls=pkg.mod:Qual.name` on a call line adds an edge the
+resolver cannot see (dynamic dispatch, callbacks); docs/development.md
+documents the annotation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Iterable, Optional
+
+from tools.dglint.astutil import call_name, dotted
+from tools.dglint.core import suppressions
+
+FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# trace-entry spellings, shared with rules_jax (kept literal here so a
+# summary never depends on rule-module import order)
+_JIT_NAMES = ("jax.jit", "jit")
+_TRACE_WRAPPERS = ("shard_map", "pl.pallas_call", "pallas_call",
+                   "jax.vmap", "vmap", "jax.grad", "jax.lax.scan",
+                   "lax.scan")
+
+# lock-ish attribute names without "lock" in them (mirrors DG04)
+_EXTRA_LOCK_ATTRS = frozenset({"meta", "_admission", "_cond"})
+
+_CALLS_MARK = "# dglint: calls="
+
+# method names the unique-name fallback must never resolve: builtin
+# container/str methods and the socket/threading/executor vocabulary
+# (`_ARMED.pop(...)` must not resolve to some project class's `pop`)
+_COMMON_METHODS = frozenset(
+    {m for t in (dict, list, set, str, bytes, tuple, frozenset)
+     for m in dir(t) if not m.startswith("__")}
+    | {"send", "recv", "sendall", "connect", "accept", "listen",
+       "bind", "close", "settimeout", "setsockopt", "acquire",
+       "release", "wait", "notify", "notify_all", "set", "is_set",
+       "put", "get", "join", "start", "run", "cancel", "result",
+       "submit", "shutdown", "fileno", "flush", "readline", "write",
+       "read", "open", "next", "update", "remove", "stop"})
+
+
+def module_name(rel: str) -> str:
+    """Repo-relative path -> dotted module name."""
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+# ---------------------------------------------------------------- locks
+
+
+def lock_base(expr: ast.AST) -> Optional[str]:
+    """Dotted path of a lock acquisition expression, `.read`/`.write`
+    guard accessors stripped to the underlying RW lock. None if the
+    expression does not look like a lock."""
+    d = dotted(expr)
+    if d is None and isinstance(expr, ast.Call):
+        d = call_name(expr)
+    if d is None:
+        return None
+    parts = d.split(".")
+    last = parts[-1]
+    if last in ("read", "write") and len(parts) >= 2 \
+            and ("rw" in parts[-2] or "lock" in parts[-2].lower()):
+        return ".".join(parts[:-1])
+    if "lock" in last.lower() or last in _EXTRA_LOCK_ATTRS:
+        return d
+    return None
+
+
+# ---------------------------------------------------------- purity sites
+
+_TIME_MODULES = ("time", "_time")
+_TIME_FNS = ("time", "monotonic", "sleep", "perf_counter",
+             "process_time")
+_HOST_BUILTINS = ("print", "input", "breakpoint")
+
+
+def _purity_site(call: ast.Call, np_names: set[str]) -> Optional[str]:
+    """DG01's host-sync taxonomy, as a message or None. Kept in sync
+    with rules_jax._purity_violations (which owns the same-module
+    closure; this feeds the cross-module one)."""
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr == "item" and not call.args:
+        return "`.item()` forces a device->host sync per dispatch"
+    name = call_name(call)
+    if name is None:
+        return None
+    if name in _HOST_BUILTINS:
+        return (f"host side effect `{name}()` (use jax.debug.print "
+                "for traced values)")
+    parts = name.split(".")
+    if len(parts) == 2 and parts[0] in _TIME_MODULES \
+            and parts[1] in _TIME_FNS:
+        return (f"wall-clock call `{name}()` is a tracer-time "
+                "constant (and a host sync under pallas interpret)")
+    if name in ("jax.device_get",) or name.endswith(
+            ".block_until_ready"):
+        return f"`{name}` blocks on the device inside the traced region"
+    if len(parts) == 2 and parts[0] in np_names \
+            and parts[1] in ("asarray", "array", "copy"):
+        return (f"`{name}` pulls a tracer to host numpy "
+                "(TracerArrayConversionError at best, a silent "
+                "per-call sync at worst)")
+    return None
+
+
+# ------------------------------------------------------------ extraction
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    name = dotted(dec)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        cname = call_name(dec)
+        if cname in _JIT_NAMES:
+            return True
+        if cname in ("partial", "functools.partial") and dec.args:
+            return dotted(dec.args[0]) in _JIT_NAMES
+    return False
+
+
+class _FnExtractor:
+    """One scope body -> calls (with held locks), acquisitions,
+    lexical lock pairs, purity sites — plus, piggybacked on the same
+    single visit: imports, `self.attr = Cls(...)` attribute types and
+    jit/wrapper target names (extract_summary used to take three more
+    full-tree walks for those; on 174 files that was ~0.5 s)."""
+
+    def __init__(self, shared: "_Shared", lines: list[str]):
+        self.sh = shared
+        self.np = shared.np_names
+        self.lines = lines
+        self.calls: list[dict] = []
+        self.acq: list[dict] = []
+        self.pairs: list[dict] = []
+        self.purity: list[dict] = []
+        self.self_attrs: dict[str, str] = {}
+
+    def _ctx(self, line: int) -> str:
+        return self.lines[line - 1].strip() \
+            if 0 < line <= len(self.lines) else ""
+
+    def run(self, fn: ast.AST):
+        body = fn.body if isinstance(fn, FuncDef) else [fn.body]
+        for stmt in body:
+            self._visit(stmt, ())
+
+    def _visit(self, node: ast.AST, held: tuple[str, ...]):
+        if isinstance(node, (*FuncDef, ast.Lambda, ast.ClassDef)):
+            return  # nested defs/classes extracted as their own scopes
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            self.sh.handle_import(node)
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            ctor = call_name(node.value)
+            if ctor is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        self.self_attrs.setdefault(t.attr, ctor)
+        if isinstance(node, ast.With):
+            new_held = held
+            for item in node.items:
+                lock = lock_base(item.context_expr)
+                if lock is not None:
+                    line = item.context_expr.lineno
+                    self.acq.append({"lock": lock, "line": line,
+                                     "text": self._ctx(line)})
+                    for outer in new_held:
+                        if outer != lock:
+                            self.pairs.append(
+                                {"a": outer, "b": lock, "line": line})
+                    new_held = new_held + (lock,)
+            for sub in node.body:
+                self._visit(sub, new_held)
+            return
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            # X.acquire() outside a with-statement: an acquisition
+            # event (edges from held locks), scope unknown lexically
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire":
+                lock = lock_base(node.func.value)
+                if lock is None:
+                    lock = dotted(node.func.value)
+                if lock is not None:
+                    self.acq.append({"lock": lock, "line": node.lineno,
+                                     "text": self._ctx(node.lineno)})
+                    for outer in held:
+                        if outer != lock:
+                            self.pairs.append({"a": outer, "b": lock,
+                                               "line": node.lineno})
+            if name is not None:
+                self.calls.append({"name": name, "line": node.lineno,
+                                   "held": list(held)})
+                if (name in _JIT_NAMES or name in _TRACE_WRAPPERS) \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Name):
+                    self.sh.jit_targets.add(node.args[0].id)
+            msg = _purity_site(node, self.np)
+            if msg is not None:
+                self.purity.append({"line": node.lineno, "msg": msg,
+                                    "text": self._ctx(node.lineno)})
+        for sub in ast.iter_child_nodes(node):
+            self._visit(sub, held)
+
+
+def _forced_edges(lines: list[str]) -> dict[int, list[str]]:
+    """`# dglint: calls=a.b:Cls.m[,x.y:f]` per line -> forced callee
+    ids, for dynamic dispatch the resolver cannot see."""
+    out: dict[int, list[str]] = {}
+    for i, text in enumerate(lines, start=1):
+        j = text.find(_CALLS_MARK)
+        if j < 0:
+            continue
+        tail = text[j + len(_CALLS_MARK):].split()[0] \
+            if text[j + len(_CALLS_MARK):].split() else ""
+        ids = [c for c in tail.split(",") if c]
+        if ids:
+            out[i] = ids
+    return out
+
+
+class _Shared:
+    """Cross-scope facts accumulated during the single extraction
+    visit: the import map, numpy aliases, and jit-target names."""
+
+    def __init__(self, rel: str, mod: str):
+        self.rel = rel
+        self.pkg_parts = mod.split(".")
+        self.imports: dict[str, str] = {}
+        self.np_names: set[str] = set()
+        self.jit_targets: set[str] = set()
+
+    def handle_import(self, node: ast.AST):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    self.np_names.add(a.asname or "numpy")
+                if a.asname is not None:
+                    self.imports[a.asname] = a.name
+                else:
+                    # `import a.b.c` binds `a`; dotted resolution
+                    # extends the prefix through _resolve_dotted
+                    head = a.name.split(".")[0]
+                    self.imports.setdefault(head, head)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # `from .x import f` in pkg/mod.py resolves against
+                # pkg; in pkg/__init__.py, against pkg itself
+                drop = node.level \
+                    if not self.rel.endswith("__init__.py") \
+                    else node.level - 1
+                base = self.pkg_parts[:len(self.pkg_parts) - drop]
+                src = ".".join(base + ([node.module]
+                                       if node.module else []))
+            else:
+                src = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                self.imports[a.asname or a.name] = \
+                    f"{src}.{a.name}" if src else a.name
+
+
+def extract_summary(rel: str, tree: ast.AST,
+                    lines: list[str]) -> dict[str, Any]:
+    """Distill one parsed file into the whole-program summary dict
+    (JSON-serializable; cached by content hash in --changed-only).
+    One visit per node: function bodies through _FnExtractor, the
+    module-level remainder through the same extractor."""
+    mod = module_name(rel)
+    shared = _Shared(rel, mod)
+    defs: dict[str, dict] = {}
+    classes: dict[str, dict] = {}
+    trace_roots: list[str] = []
+    globals_: list[str] = []
+
+    def walk_scope(body: Iterable[ast.AST], prefix: str,
+                   cls: Optional[str]):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                bases = [dotted(b) for b in node.bases]
+                classes.setdefault(node.name, {
+                    "bases": [b for b in bases if b], "attrs": {}})
+                walk_scope(node.body, node.name, node.name)
+            elif isinstance(node, FuncDef):
+                qual = f"{prefix}.{node.name}" if prefix else node.name
+                ex = _FnExtractor(shared, lines)
+                ex.run(node)
+                defs[qual] = {
+                    "line": node.lineno, "cls": cls,
+                    "calls": ex.calls, "acq": ex.acq,
+                    "pairs": ex.pairs, "purity": ex.purity,
+                }
+                if cls is not None and ex.self_attrs:
+                    for attr, ctor in ex.self_attrs.items():
+                        classes[cls]["attrs"].setdefault(attr, ctor)
+                if any(_is_jit_decorator(d) for d in
+                       node.decorator_list):
+                    trace_roots.append(qual)
+                # nested defs: extracted flat, resolvable by bare name
+                walk_scope(ast.iter_child_nodes(node), qual, cls)
+            elif isinstance(node, (ast.If, ast.Try)):
+                walk_scope(ast.iter_child_nodes(node), prefix, cls)
+
+    walk_scope(tree.body, "", None)
+
+    # module-level remainder: imports, jit(f) targets, and global
+    # bindings (lock identity: `with _lock:` on a module global is
+    # `<module>:_lock`, not a function local)
+    mod_ex = _FnExtractor(shared, lines)
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, (*FuncDef, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    globals_.append(t.id)
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            globals_.append(node.target.id)
+        mod_ex._visit(node, ())
+
+    # jit/shard_map/pallas_call target NAMES become trace roots
+    for nm in shared.jit_targets:
+        for qual in defs:
+            if qual == nm or qual.endswith("." + nm):
+                trace_roots.append(qual)
+
+    imports = shared.imports
+    per_line, file_wide = suppressions(lines)
+    return {
+        "module": mod,
+        "defs": defs,
+        "classes": classes,
+        "imports": imports,
+        "globals": sorted(set(globals_)),
+        "trace_roots": sorted(set(trace_roots)),
+        "forced": _forced_edges(lines),
+        "suppress": {
+            "file": sorted(file_wide),
+            "lines": {str(k): sorted(v) for k, v in per_line.items()},
+        },
+    }
+
+
+# ------------------------------------------------------------ call graph
+
+
+class CallGraph:
+    """Project-wide resolved call graph over summaries.
+
+    Function ids are `"<rel>::<qual>"` (e.g.
+    `dgraph_tpu/cluster/client.py::ClusterClient._request`).
+    """
+
+    def __init__(self, summaries: dict[str, dict]):
+        self.summaries = summaries
+        # dotted module name -> rel
+        self.mod_to_rel = {s["module"]: rel
+                           for rel, s in summaries.items()}
+        # (rel, qual) existence + per-file simple-name index
+        self.local_index: dict[str, dict[str, list[str]]] = {}
+        # method name -> [(rel, qual)] across the project
+        self.method_index: dict[str, list[str]] = {}
+        # class name -> [(rel, classinfo)]
+        self.class_index: dict[str, list[tuple[str, dict]]] = {}
+        for rel, s in summaries.items():
+            idx: dict[str, list[str]] = {}
+            for qual, d in s["defs"].items():
+                simple = qual.rsplit(".", 1)[-1]
+                idx.setdefault(simple, []).append(qual)
+                if d.get("cls"):
+                    self.method_index.setdefault(simple, []).append(
+                        f"{rel}::{qual}")
+            self.local_index[rel] = idx
+            for cname, cinfo in s["classes"].items():
+                self.class_index.setdefault(cname, []).append(
+                    (rel, cinfo))
+        # resolved edges: id -> [(callee_id, line, held_locks)]
+        self.edges: dict[str, list[tuple[str, int, tuple]]] = {}
+        self._build()
+
+    # -- resolution helpers -------------------------------------------
+
+    def _lookup_local(self, rel: str, name: str) -> Optional[str]:
+        """Bare name -> unique qual in `rel` (top-level preferred)."""
+        cands = self.local_index.get(rel, {}).get(name, [])
+        if not cands:
+            return None
+        top = [q for q in cands if "." not in q]
+        if len(top) == 1:
+            return top[0]
+        return cands[0] if len(cands) == 1 else None
+
+    def _lookup_method(self, cls: str, meth: str,
+                       seen: Optional[set] = None) -> Optional[str]:
+        """Cls.meth -> id, following base classes by name."""
+        seen = seen or set()
+        if cls in seen:
+            return None
+        seen.add(cls)
+        for rel, cinfo in self.class_index.get(cls, []):
+            qual = f"{cls}.{meth}"
+            if qual in self.summaries[rel]["defs"]:
+                return f"{rel}::{qual}"
+            for base in cinfo.get("bases", []):
+                got = self._lookup_method(base.split(".")[-1], meth,
+                                          seen)
+                if got is not None:
+                    return got
+        return None
+
+    def _resolve_module_attr(self, mod: str,
+                             attr: str) -> Optional[str]:
+        rel = self.mod_to_rel.get(mod)
+        if rel is None:
+            return None
+        if attr in self.summaries[rel]["defs"]:
+            return f"{rel}::{attr}"
+        return None
+
+    def resolve(self, rel: str, caller_qual: str,
+                raw: str) -> Optional[str]:
+        """Best-effort: raw dotted callee -> function id or None."""
+        s = self.summaries[rel]
+        parts = raw.split(".")
+        cls = s["defs"].get(caller_qual, {}).get("cls")
+        # self.meth() / self.attr.meth()
+        if parts[0] == "self" and cls is not None:
+            if len(parts) == 2:
+                got = self._lookup_method(cls, parts[1])
+                if got is not None:
+                    return got
+            elif len(parts) == 3:
+                for crel, cinfo in self.class_index.get(cls, []):
+                    ctor = cinfo["attrs"].get(parts[1])
+                    if ctor is None:
+                        continue
+                    tcls = self._resolve_class(crel, ctor)
+                    if tcls is not None:
+                        got = self._lookup_method(tcls, parts[2])
+                        if got is not None:
+                            return got
+            # fall through to the unique-method heuristic
+        elif len(parts) == 1:
+            qual = self._lookup_local(rel, parts[0])
+            if qual is not None:
+                return f"{rel}::{qual}"
+            target = s["imports"].get(parts[0])
+            if target is not None:
+                # from mod import f  |  from mod import Cls
+                if "." in target:
+                    mod, attr = target.rsplit(".", 1)
+                    got = self._resolve_module_attr(mod, attr)
+                    if got is not None:
+                        return got
+                    got = self._resolve_ctor(mod, attr)
+                    if got is not None:
+                        return got
+        else:
+            # alias-prefixed: find the longest alias prefix
+            for cut in range(len(parts) - 1, 0, -1):
+                alias = ".".join(parts[:cut])
+                target = s["imports"].get(alias)
+                if target is None:
+                    continue
+                full = target + "." + ".".join(parts[cut:])
+                got = self._resolve_dotted(full)
+                if got is not None:
+                    return got
+                break
+            # Cls.method with a local/imported class
+            if len(parts) == 2 and parts[0] in self.class_index:
+                got = self._lookup_method(parts[0], parts[1])
+                if got is not None:
+                    return got
+            # local class constructor: Cls(...) handled in len==1 via
+            # local defs; local attr chains fall to unique-method
+        # Cls(...) -> __init__ for a project class referenced bare
+        if len(parts) == 1 and parts[0] in self.class_index:
+            got = self._lookup_method(parts[0], "__init__")
+            if got is not None:
+                return got
+        # unique-method fallback: exactly one def of that name
+        # project-wide (ambiguity stays unresolved, not guessed; the
+        # builtin-type vocabulary is never guessed at all)
+        meth = parts[-1]
+        if meth in _COMMON_METHODS:
+            return None
+        cands = self.method_index.get(meth, [])
+        if len(cands) == 1 and (len(parts) > 1 or parts[0] != meth):
+            return cands[0]
+        return None
+
+    def _resolve_class(self, rel: str, ctor: str) -> Optional[str]:
+        """Constructor dotted name at `rel` -> class name, if it names
+        a project class (directly or through imports)."""
+        last = ctor.split(".")[-1]
+        if last in self.class_index:
+            return last
+        target = self.summaries[rel]["imports"].get(ctor)
+        if target is not None and target.split(".")[-1] \
+                in self.class_index:
+            return target.split(".")[-1]
+        return None
+
+    def _resolve_ctor(self, mod: str, cls: str) -> Optional[str]:
+        rel = self.mod_to_rel.get(mod)
+        if rel is not None and cls in self.summaries[rel]["classes"]:
+            got = self._lookup_method(cls, "__init__")
+            if got is not None:
+                return got
+        return None
+
+    def _resolve_dotted(self, full: str) -> Optional[str]:
+        """`pkg.mod.func` / `pkg.mod.Cls.meth` -> id."""
+        parts = full.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:cut])
+            rel = self.mod_to_rel.get(mod)
+            if rel is None:
+                continue
+            qual = ".".join(parts[cut:])
+            if qual in self.summaries[rel]["defs"]:
+                return f"{rel}::{qual}"
+            if qual in self.summaries[rel]["classes"]:
+                init = f"{qual}.__init__"
+                if init in self.summaries[rel]["defs"]:
+                    return f"{rel}::{init}"
+            return None
+        return None
+
+    # -- graph build ---------------------------------------------------
+
+    def _build(self):
+        for rel, s in self.summaries.items():
+            forced = {int(k): v for k, v in s.get("forced", {}).items()}
+            for qual, d in s["defs"].items():
+                fid = f"{rel}::{qual}"
+                out: list[tuple[str, int, tuple]] = []
+                for c in d["calls"]:
+                    callee = self.resolve(rel, qual, c["name"])
+                    if callee is not None and callee != fid:
+                        out.append((callee, c["line"],
+                                    tuple(c.get("held", ()))))
+                    for extra in forced.get(c["line"], ()):
+                        eid = self._forced_id(extra)
+                        if eid is not None and eid != fid:
+                            out.append((eid, c["line"],
+                                        tuple(c.get("held", ()))))
+                self.edges[fid] = out
+
+    def _forced_id(self, spec: str) -> Optional[str]:
+        """`pkg.mod:Qual.name` annotation -> id."""
+        if ":" in spec:
+            mod, qual = spec.split(":", 1)
+            rel = self.mod_to_rel.get(mod)
+            if rel is not None and qual in \
+                    self.summaries[rel]["defs"]:
+                return f"{rel}::{qual}"
+            return None
+        return self._resolve_dotted(spec)
+
+    # -- queries -------------------------------------------------------
+
+    def reachable_from(self, roots: Iterable[str]
+                       ) -> dict[str, tuple[str, int] | None]:
+        """BFS closure: reachable id -> (parent id, call line) or None
+        for a root — enough to reconstruct one witness path."""
+        parent: dict[str, tuple[str, int] | None] = {}
+        work = []
+        for r in roots:
+            if r not in parent:
+                parent[r] = None
+                work.append(r)
+        while work:
+            cur = work.pop()
+            for callee, line, _held in self.edges.get(cur, ()):
+                if callee not in parent:
+                    parent[callee] = (cur, line)
+                    work.append(callee)
+        return parent
+
+    @staticmethod
+    def path(parent: dict, fid: str) -> list[str]:
+        """Root -> fid chain of function ids."""
+        chain = [fid]
+        seen = {fid}
+        cur = parent.get(fid)
+        while cur is not None:
+            pid, _line = cur
+            if pid in seen:
+                break
+            chain.append(pid)
+            seen.add(pid)
+            cur = parent.get(pid)
+        return list(reversed(chain))
+
+
+def short_id(fid: str) -> str:
+    """`dgraph_tpu/cluster/client.py::Cls.meth` -> `client.Cls.meth`
+    for findings messages."""
+    rel, qual = fid.split("::", 1)
+    stem = rel.rsplit("/", 1)[-1]
+    stem = stem[:-3] if stem.endswith(".py") else stem
+    return f"{stem}.{qual}"

@@ -1,15 +1,26 @@
 """dglint command line.
 
     python -m tools.dglint dgraph_tpu tests            # lint vs baseline
+    python -m tools.dglint --changed-only dgraph_tpu tests
     python -m tools.dglint --write-baseline dgraph_tpu tests
     python -m tools.dglint --no-baseline dgraph_tpu    # every finding
     python -m tools.dglint --list-rules
     python -m tools.dglint --timing dgraph_tpu tests   # wall-time report
 
-Exit status: 0 when every finding is suppressed or grandfathered in
-tools/dglint_baseline.txt, 1 when new findings exist, 2 on usage
-errors. Stale baseline entries are reported but never fail the run
-(fixing a finding must not break CI).
+Exit status contract (tools/check.sh and CI key off it):
+
+    0   clean — every finding suppressed or grandfathered
+    1   new findings exist (fix, suppress with a reason, or — last
+        resort — regenerate the baseline)
+    2   INTERNAL: a rule crashed (the offending rule and file are
+        named) or the arguments were unusable. A rule bug must never
+        be mistaken for a clean run.
+
+`--changed-only` re-lints only files whose content hash moved since
+the last run (manifest: tools/.dglint_cache.json); the whole-program
+rules (DG10/DG12) still run over every file's cached summary, so the
+analysis stays project-wide. Stale baseline entries are reported but
+never fail the run (fixing a finding must not break CI).
 """
 
 from __future__ import annotations
@@ -20,14 +31,16 @@ import sys
 import time
 
 from tools.dglint.core import (
-    all_rules, apply_baseline, build_project, lint_project,
-    load_baseline, render_baseline,
+    all_project_rules, all_rules, apply_baseline, build_project,
+    lint_incremental, lint_project, load_baseline, render_baseline,
 )
 
 REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools",
                                 "dglint_baseline.txt")
+DEFAULT_CACHE = os.path.join(REPO_ROOT, "tools",
+                             ".dglint_cache.json")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -35,7 +48,8 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m tools.dglint",
         description="AST-based invariant linter for the dgraph_tpu "
                     "JAX data plane and MVCC/concurrency control "
-                    "plane.")
+                    "plane (per-file rules + whole-program call-graph "
+                    "rules).")
     ap.add_argument("paths", nargs="*",
                     default=["dgraph_tpu", "tests"],
                     help="files/directories to lint (default: "
@@ -46,25 +60,55 @@ def main(argv: list[str] | None = None) -> int:
                     help="report every finding; exit 1 if any")
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline from current findings")
+    ap.add_argument("--assert-empty-baseline", action="store_true",
+                    help="fail (exit 1) if the baseline grandfathers "
+                         "anything — the no-tech-debt CI gate")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="re-lint only files whose content hash moved "
+                         "since the manifest was written "
+                         "(whole-program rules still see every file)")
+    ap.add_argument("--cache", default=DEFAULT_CACHE,
+                    help="content-hash manifest for --changed-only")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--timing", action="store_true",
-                    help="report lint wall time (the CI-gate budget "
-                         "is < 5 s on the full tree)")
+                    help="report lint wall time (CI budgets: < 5 s "
+                         "full tree, < 1 s --changed-only)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for code, rule in sorted(all_rules().items()):
             scopes = ", ".join(rule.scopes)
             print(f"{code} {rule.name}  [{scopes}]")
-            doc = rule.doc or ""
-            for line in doc.splitlines():
+            for line in (rule.doc or "").splitlines():
+                print(f"     {line.strip()}")
+        for code, prule in sorted(all_project_rules().items()):
+            print(f"{code} {prule.name}  [whole-program]")
+            for line in (prule.doc or "").splitlines():
                 print(f"     {line.strip()}")
         return 0
 
+    if args.write_baseline and args.changed_only:
+        print("--write-baseline needs a full pass; drop "
+              "--changed-only", file=sys.stderr)
+        return 2
+
     t0 = time.monotonic()
-    proj = build_project(list(args.paths), REPO_ROOT)
-    findings = lint_project(proj)
+    stats = None
+    if args.changed_only:
+        findings, proj, stats = lint_incremental(
+            list(args.paths), REPO_ROOT, args.cache)
+    else:
+        proj = build_project(list(args.paths), REPO_ROOT)
+        findings = lint_project(proj)
     elapsed = time.monotonic() - t0
+
+    if proj.crashes:
+        for crash in proj.crashes:
+            print(crash.render(), file=sys.stderr)
+        print(f"[dglint] {len(proj.crashes)} rule crash(es) — this "
+              "run proves NOTHING about the tree; fix the rule",
+              file=sys.stderr)
+        return 2
 
     if args.write_baseline:
         with open(args.baseline, "w", encoding="utf-8") as f:
@@ -90,14 +134,26 @@ def main(argv: list[str] | None = None) -> int:
               f"{'y' if stale == 1 else 'ies'} no longer fire — "
               "prune tools/dglint_baseline.txt", file=sys.stderr)
     if args.timing:
-        nfiles = len(proj.files)
+        nfiles = len(proj.summaries) or len(proj.files)
+        mode = ""
+        if stats is not None:
+            mode = (f", {stats['changed']} re-linted / "
+                    f"{stats.get('cached', 0)} cached")
         print(f"[dglint] linted {nfiles} files, "
-              f"{len(all_rules())} rules in {elapsed:.2f}s "
-              f"({1000 * elapsed / max(1, nfiles):.1f} ms/file)",
-              file=sys.stderr)
+              f"{len(all_rules()) + len(all_project_rules())} rules "
+              f"in {elapsed:.2f}s"
+              f" ({1000 * elapsed / max(1, nfiles):.1f} ms/file"
+              f"{mode})", file=sys.stderr)
+    rc = 0
     if new:
         print(f"[dglint] {len(new)} new finding(s); fix them, add "
               "`# dglint: disable=CODE` with a reason, or (last "
               "resort) regenerate the baseline", file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    if args.assert_empty_baseline and sum(allowed.values()) > 0:
+        print(f"[dglint] baseline grandfathers "
+              f"{sum(allowed.values())} finding(s) — the gate "
+              "requires an EMPTY baseline (fix them or carry an "
+              "explicit suppression with a reason)", file=sys.stderr)
+        rc = max(rc, 1)
+    return rc

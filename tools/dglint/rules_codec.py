@@ -61,7 +61,7 @@ def check_compressed_decode(ctx: FileContext):
     if ctx.rel in proj.decode_sites:
         return
     bare_decompress_is_codec = None  # computed lazily, once per file
-    for call in walk_calls(ctx.tree):
+    for call in ctx.calls:
         name = call_name(call)
         if name is None:
             continue

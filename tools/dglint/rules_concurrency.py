@@ -226,7 +226,7 @@ def check_monotonic_time(ctx: FileContext):
     from it negative or hours long. Deadlines, TTLs, and intervals use
     `time.monotonic()`; keep `time.time()` only for user-visible
     timestamps (and mark those sites `# dglint: disable=DG06`)."""
-    for call in walk_calls(ctx.tree):
+    for call in ctx.calls:
         name = call_name(call)
         if name is None:
             continue

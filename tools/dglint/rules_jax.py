@@ -244,7 +244,7 @@ def check_recompile_hazard(ctx: FileContext):
         for dec in fn.decorator_list:
             if isinstance(dec, ast.Call) and _is_jit_decorator(dec):
                 yield from _validate_static_args(ctx, dec, fn)
-    for call in walk_calls(ctx.tree):
+    for call in ctx.calls:
         if call_name(call) not in _JIT_NAMES:
             continue
         if call.args and isinstance(call.args[0], ast.Name) \
@@ -254,7 +254,7 @@ def check_recompile_hazard(ctx: FileContext):
     # jax.jit(...)(...) — wrapper built and invoked in one expression:
     # a fresh wrapper has an empty trace cache, so this retraces and
     # recompiles on EVERY call
-    for call in walk_calls(ctx.tree):
+    for call in ctx.calls:
         if isinstance(call.func, ast.Call) \
                 and call_name(call.func) in _JIT_NAMES:
             yield ctx.finding(

@@ -68,7 +68,7 @@ def check_snapshot_discipline(ctx: FileContext):
                 f"direct `{node.attr}` access outside storage/ "
                 "bypasses MVCC visibility — use the read_ts snapshot "
                 "APIs")
-    for call in walk_calls(ctx.tree):
+    for call in ctx.calls:
         if not isinstance(call.func, ast.Attribute):
             continue
         pos = _SNAPSHOT_APIS.get(call.func.attr)

@@ -91,7 +91,7 @@ def check_registries(ctx: FileContext):
                 "DG08",
                 _FakeNode(line),
                 f"span name {name!r} registered twice in SPAN_NAMES")
-    for call in walk_calls(ctx.tree):
+    for call in ctx.calls:
         name = call_name(call)
         if name is None or not call.args:
             continue

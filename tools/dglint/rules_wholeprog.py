@@ -1,0 +1,580 @@
+"""DG10-DG12 — whole-program rules over the resolved call graph.
+
+Nine PRs of concurrency machinery (micro-batcher, 2PC staging,
+compressed-tier decode, span observers) outgrew per-file linting:
+DG01's purity closure stops at the module boundary, DG04's inversion
+check only sees both lock orders when they share a file, and DG03 only
+catches a literal read_ts at the call site itself. These rules run
+over the project summaries (tools/dglint/callgraph.py):
+
+  DG10  cross-module jit purity — host syncs/side effects reachable
+        from ANY `jax.jit`/`shard_map`/`pallas_call` entry point
+        through helpers in other modules (supersedes DG01's
+        same-module closure; DG01 keeps ownership of what it already
+        sees so nothing double-reports)
+  DG11  snapshot-timestamp provenance — taint dataflow: a value
+        flowing into a `read_ts=`/`base_ts=` parameter must originate
+        from a sanctioned snapshot source (coordinator/tablet APIs,
+        a threaded parameter, a wire field), never from arithmetic or
+        a laundered literal (the static generalization of DG03)
+  DG12  global lock-order cycles — the acquisition graph across ALL
+        modules, edges attributed through the call graph (f holds A
+        and calls g, g takes B => A -> B), every cycle reported with
+        both witness paths. utils/lockcheck.py is the runtime
+        complement for paths static resolution cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tools.dglint.astutil import call_name, num_const, str_const, \
+    walk_calls
+from tools.dglint.callgraph import CallGraph, short_id
+from tools.dglint.core import (
+    FileContext, Finding, ProjectContext, register, register_project,
+)
+
+_DG01_SCOPES = ("dgraph_tpu/ops/", "dgraph_tpu/parallel/")
+_PROJECT_PREFIXES = ("dgraph_tpu/",)
+
+
+def _graph(proj: ProjectContext) -> CallGraph:
+    cg = proj.cache.get("callgraph")
+    if cg is None:
+        cg = CallGraph(proj.summaries)
+        proj.cache["callgraph"] = cg
+    return cg
+
+
+def _in_project(rel: str) -> bool:
+    return rel.startswith(_PROJECT_PREFIXES)
+
+
+# ------------------------------------------------------------------ DG10
+
+
+def _dg01_covered(summary: dict) -> set[str]:
+    """Function quals DG01's same-module closure already reaches:
+    bare-name calls from this file's trace roots. DG10 skips these to
+    avoid double-reporting inside ops/ and parallel/."""
+    by_name: dict[str, list[str]] = {}
+    for qual in summary["defs"]:
+        by_name.setdefault(qual.rsplit(".", 1)[-1], []).append(qual)
+    seen: set[str] = set()
+    work = list(summary["trace_roots"])
+    while work:
+        qual = work.pop()
+        if qual in seen or qual not in summary["defs"]:
+            continue
+        seen.add(qual)
+        for c in summary["defs"][qual]["calls"]:
+            if "." in c["name"]:
+                continue
+            for cand in by_name.get(c["name"], ()):
+                if cand not in seen:
+                    work.append(cand)
+    return seen
+
+
+@register_project("DG10", "cross-module-jit-purity")
+def check_cross_module_purity(proj: ProjectContext):
+    """No host syncs or side effects (`.item()`, numpy pulls, time
+    reads, print, device_get) in ANY function reachable from a
+    jit/shard_map/pallas_call entry point, across module boundaries —
+    the cross-module closure DG01 cannot see. The finding names the
+    jit root and the call chain."""
+    cg = _graph(proj)
+    roots = []
+    for rel, s in proj.summaries.items():
+        if not _in_project(rel):
+            continue
+        for qual in s["trace_roots"]:
+            roots.append(f"{rel}::{qual}")
+    parent = cg.reachable_from(roots)
+    covered: dict[str, set[str]] = {}
+    for fid in sorted(parent):
+        rel, qual = fid.split("::", 1)
+        if not _in_project(rel):
+            continue
+        s = proj.summaries.get(rel)
+        if s is None or qual not in s["defs"]:
+            continue
+        sites = s["defs"][qual]["purity"]
+        if not sites:
+            continue
+        if rel.startswith(_DG01_SCOPES):
+            if rel not in covered:
+                covered[rel] = _dg01_covered(s)
+            if qual in covered[rel]:
+                continue  # DG01 owns this one
+        chain = cg.path(parent, fid)
+        root = chain[0]
+        via = " -> ".join(short_id(f) for f in chain)
+        for site in sites:
+            yield Finding(
+                "DG10", rel, site["line"],
+                f"{site['msg']} — `{short_id(fid)}` is traced: "
+                f"reachable from jit root `{short_id(root)}` "
+                f"(call chain: {via})",
+                site["text"])
+
+
+# ------------------------------------------------------------------ DG11
+
+# sanctioned provenance for a timestamp: the coordinator/snapshot
+# surface in storage/ and engine/, a field read off a context/request
+# object, or a wire/dict field by its well-known key
+_TS_CALLS = frozenset({
+    "next_ts", "max_assigned", "assign_ts", "snapshot_ts",
+    "current_read_ts", "read_ts", "watermark", "pinned_ts",
+})
+_TS_ATTRS = frozenset({
+    "read_ts", "base_ts", "start_ts", "commit_ts", "max_ts",
+    "watermark", "ts", "ov_ts",
+})
+_TS_KEYS = frozenset({
+    "read_ts", "base_ts", "start_ts", "startTs", "commit_ts",
+    "max_ts", "ts",
+})
+_TS_PARAMS = ("read_ts", "base_ts")
+
+# positional read_ts slots, shared with DG03 (which owns direct
+# literals at these sites; DG11 owns laundered ones)
+from tools.dglint.rules_mvcc import _SNAPSHOT_APIS  # noqa: E402
+
+_DG11_EXEMPT = ("dgraph_tpu/storage/",)
+_DG11_HINT = re.compile(
+    "read_ts|base_ts|" + "|".join(sorted(_SNAPSHOT_APIS)))
+
+
+def _fn_params(fn: ast.AST) -> set[str]:
+    a = fn.args
+    return {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+
+
+class _Taint:
+    """Intraprocedural origin classifier for timestamp expressions.
+
+    Verdicts: ("taint", why) — provably a literal or arithmetic;
+    ("ok", _) — sanctioned provenance; ("unknown", _) — unresolvable,
+    never reported (best-effort, no false positives from opacity)."""
+
+    def __init__(self, fn: ast.AST):
+        self.params = _fn_params(fn)
+        self.assigns: dict[str, list[ast.expr]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.assigns.setdefault(t.id, []).append(
+                            node.value)
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Name):
+                # x += 1 is timestamp arithmetic on whatever x was
+                self.assigns.setdefault(node.target.id, []).append(
+                    ast.BinOp(left=ast.Name(id=node.target.id),
+                              op=node.op, right=node.value))
+
+    def classify(self, expr: ast.expr,
+                 seen: frozenset = frozenset()) -> tuple[str, str]:
+        if num_const(expr) is not None:
+            return "taint", f"literal {num_const(expr)}"
+        if isinstance(expr, ast.BinOp):
+            return "taint", "timestamp arithmetic"
+        if isinstance(expr, ast.IfExp):
+            a = self.classify(expr.body, seen)
+            b = self.classify(expr.orelse, seen)
+            for v in (a, b):
+                if v[0] == "taint":
+                    return v
+            if a[0] == b[0] == "ok":
+                return "ok", ""
+            return "unknown", ""
+        if isinstance(expr, ast.Name):
+            if expr.id in seen:
+                return "unknown", ""
+            bindings = self.assigns.get(expr.id)
+            if bindings is None:
+                # a parameter (threaded from the caller — their
+                # responsibility) or a free variable
+                return ("ok", "") if expr.id in self.params \
+                    else ("unknown", "")
+            verdicts = [self.classify(b, seen | {expr.id})
+                        for b in bindings]
+            for v in verdicts:
+                if v[0] == "taint":
+                    return "taint", (f"`{expr.id}` bound to "
+                                     f"{v[1]}")
+            if all(v[0] == "ok" for v in verdicts):
+                return "ok", ""
+            return "unknown", ""
+        if isinstance(expr, ast.Call):
+            name = call_name(expr)
+            last = name.rsplit(".", 1)[-1] if name else ""
+            if last in _TS_CALLS:
+                return "ok", ""
+            if last in ("int", "min", "max"):
+                args = [a for a in expr.args
+                        if not isinstance(a, ast.Starred)]
+                if not args:
+                    return "unknown", ""
+                verdicts = [self.classify(a, seen) for a in args]
+                for v in verdicts:
+                    if v[0] == "taint":
+                        return v
+                if all(v[0] == "ok" for v in verdicts):
+                    return "ok", ""
+                return "unknown", ""
+            if last == "get" and expr.args:
+                key = str_const(expr.args[0])
+                if key in _TS_KEYS:
+                    return "ok", ""
+            return "unknown", ""
+        if isinstance(expr, ast.Attribute):
+            return ("ok", "") if expr.attr in _TS_ATTRS \
+                else ("unknown", "")
+        if isinstance(expr, ast.Subscript):
+            key = str_const(expr.slice)
+            return ("ok", "") if key in _TS_KEYS else ("unknown", "")
+        return "unknown", ""
+
+
+@register("DG11", "snapshot-ts-provenance", scopes=("dgraph_tpu/",))
+def check_ts_provenance(ctx: FileContext):
+    """Dataflow taint on snapshot timestamps: any value flowing into
+    a `read_ts=`/`base_ts=` argument must originate from a sanctioned
+    snapshot source (coordinator `next_ts`/`max_assigned`, a tablet/
+    context `.read_ts` field, a threaded parameter, a wire field) —
+    never from arithmetic or a laundered literal. DG03 catches the
+    literal AT the call site; DG11 follows it through assignments,
+    `min`/`max`/`int`, and conditionals."""
+    if ctx.rel.startswith(_DG11_EXEMPT):
+        return
+    # cheap text prefilter: most files never mention a ts parameter
+    # or a snapshot API — skip the per-function dataflow for them
+    if not any(_DG11_HINT.search(l) for l in ctx.lines):
+        return
+    for fn in [n for n in ast.walk(ctx.tree)
+               if isinstance(n, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef))]:
+        taint = None  # built lazily: most functions have no ts sites
+        for call in walk_calls(fn):
+            sites: list[tuple[str, ast.expr]] = []
+            for kw in call.keywords:
+                if kw.arg in _TS_PARAMS:
+                    sites.append((kw.arg, kw.value))
+            if isinstance(call.func, ast.Attribute):
+                pos = _SNAPSHOT_APIS.get(call.func.attr)
+                if pos is not None and pos < len(call.args):
+                    sites.append(("read_ts", call.args[pos]))
+            for pname, value in sites:
+                if num_const(value) is not None:
+                    continue  # DG03 owns direct literals
+                if taint is None:
+                    taint = _Taint(fn)
+                verdict, why = taint.classify(value)
+                if verdict == "taint":
+                    yield ctx.finding(
+                        "DG11", call,
+                        f"`{pname}` receives {why} — snapshot "
+                        "timestamps must come from a sanctioned "
+                        "source (coordinator next_ts/max_assigned, "
+                        "a threaded read_ts, a context field), not "
+                        "arithmetic or literals")
+
+
+# ------------------------------------------------------------------ DG12
+
+
+def _norm_lock(proj: ProjectContext, rel: str, qual: str,
+               raw: str) -> str | None:
+    """Raw acquisition expression -> a project-wide lock identity.
+
+    `self._lock` in class C -> `C._lock`; `self.db.lock` resolves the
+    attribute type (`C.attrs`) -> `Db.lock`; a module global ->
+    `mod:_lock`; an unresolvable local stays None (never guessed —
+    a wrong merge would fabricate cycles)."""
+    s = proj.summaries[rel]
+    parts = raw.split(".")
+    cls = s["defs"].get(qual, {}).get("cls")
+    if parts[0] == "self":
+        rest = parts[1:]
+        if not rest:
+            return None
+        if cls is None:
+            return None
+        if len(rest) >= 2:
+            for crel, cinfo in _graph(proj).class_index.get(cls, ()):
+                ctor = cinfo["attrs"].get(rest[0])
+                if ctor is not None:
+                    tcls = _graph(proj)._resolve_class(crel, ctor)
+                    if tcls is not None:
+                        return f"{tcls}.{'.'.join(rest[1:])}"
+            return f"{cls}.{'.'.join(rest)}"
+        return f"{cls}.{rest[0]}"
+    if len(parts) == 1:
+        if parts[0] in s.get("globals", ()):
+            return f"{s['module']}:{parts[0]}"
+        target = s["imports"].get(parts[0])
+        if target is not None and "." in target:
+            # `from modb import _lb` names modb's module global
+            m, n = target.rsplit(".", 1)
+            return f"{m}:{n}"
+        return None  # function-local: identity unknowable
+    target = s["imports"].get(parts[0])
+    if target is not None:
+        return f"{target}:{'.'.join(parts[1:])}"
+    return None
+
+
+def _build_lock_graph(proj: ProjectContext, cg: CallGraph):
+    """-> (edges, trans) where edges maps (A, B) -> witness frames
+    [(fid, line), ...] (the A-holder's chain down to B's acquisition)
+    and trans maps fid -> {lock: witness} for every lock a call into
+    fid may take."""
+    # per-function direct acquisitions and transitive closure
+    direct: dict[str, dict[str, tuple]] = {}
+    for rel, s in proj.summaries.items():
+        if not _in_project(rel):
+            continue
+        for qual, d in s["defs"].items():
+            fid = f"{rel}::{qual}"
+            locks: dict[str, tuple] = {}
+            for a in d["acq"]:
+                ident = _norm_lock(proj, rel, qual, a["lock"])
+                if ident is not None and ident not in locks:
+                    locks[ident] = ("site", a["line"])
+            direct[fid] = locks
+
+    trans: dict[str, dict[str, tuple]] = {
+        fid: dict(locks) for fid, locks in direct.items()}
+    callers: dict[str, list[tuple[str, int]]] = {}
+    for fid in direct:
+        for callee, line, _held in cg.edges.get(fid, ()):
+            if callee in direct:
+                callers.setdefault(callee, []).append((fid, line))
+    work = [fid for fid in trans if trans[fid]]
+    while work:
+        g = work.pop()
+        for f, line in callers.get(g, ()):
+            changed = False
+            for lock in trans[g]:
+                if lock not in trans[f]:
+                    trans[f][lock] = ("call", line, g)
+                    changed = True
+            if changed:
+                work.append(f)
+
+    def witness(fid: str, lock: str, limit: int = 12) -> list:
+        frames: list[tuple[str, int]] = []
+        cur = fid
+        while limit > 0:
+            limit -= 1
+            w = trans.get(cur, {}).get(lock)
+            if w is None:
+                break
+            if w[0] == "site":
+                frames.append((cur, w[1]))
+                break
+            frames.append((cur, w[1]))
+            cur = w[2]
+        return frames
+
+    edges: dict[tuple[str, str], list] = {}
+    lexical: set[tuple[str, str]] = set()
+    for rel, s in proj.summaries.items():
+        if not _in_project(rel):
+            continue
+        for qual, d in s["defs"].items():
+            fid = f"{rel}::{qual}"
+            for p in d["pairs"]:
+                a = _norm_lock(proj, rel, qual, p["a"])
+                b = _norm_lock(proj, rel, qual, p["b"])
+                if a is None or b is None or a == b:
+                    continue
+                edges.setdefault((a, b), [(fid, p["line"])])
+                lexical.add((a, b))
+            for c in d["calls"]:
+                if not c.get("held"):
+                    continue
+                held = [_norm_lock(proj, rel, qual, h)
+                        for h in c["held"]]
+                held = [h for h in held if h is not None]
+                if not held:
+                    continue
+                callee = None
+                for cal, line, _h in cg.edges.get(fid, ()):
+                    if line == c["line"]:
+                        callee = cal
+                        break
+                if callee is None:
+                    continue
+                for lock in trans.get(callee, ()):
+                    chain = [(fid, c["line"])] + witness(callee, lock)
+                    for h in held:
+                        if h != lock and (h, lock) not in edges:
+                            edges[(h, lock)] = chain
+    return edges, lexical
+
+
+@register_project("DG12", "global-lock-order")
+def check_global_lock_order(proj: ProjectContext):
+    """Global lock-order cycles: acquisition edges collected across
+    ALL modules and attributed through the call graph (holding A while
+    calling into code that takes B is an A -> B edge even when the two
+    acquisitions live in different files). Any cycle is a deadlock
+    under contention; the finding carries both witness paths. Purely
+    lexical same-file inversions stay DG04's."""
+    cg = _graph(proj)
+    edges, lexical = _build_lock_graph(proj, cg)
+
+    def render(frames: list) -> str:
+        return " -> ".join(
+            f"{short_id(fid)}:{line}" for fid, line in frames)
+
+    def anchor(frames: list) -> tuple[str, int]:
+        fid, line = frames[0]
+        return fid.split("::", 1)[0], line
+
+    reported: set[frozenset] = set()
+    for (a, b), w_ab in sorted(edges.items()):
+        if (b, a) not in edges:
+            continue
+        key = frozenset((a, b))
+        if key in reported:
+            continue
+        reported.add(key)
+        w_ba = edges[(b, a)]
+        if (a, b) in lexical and (b, a) in lexical \
+                and anchor(w_ab)[0] == anchor(w_ba)[0]:
+            continue  # same-file lexical inversion: DG04 owns it
+        rel, line = anchor(w_ab)
+        yield Finding(
+            "DG12", rel, line,
+            f"lock-order cycle: `{a}` -> `{b}` "
+            f"(via {render(w_ab)}) but `{b}` -> `{a}` "
+            f"(via {render(w_ba)}) — deadlock under contention; "
+            "pick one global order",
+            _line_text(proj, rel, line))
+
+    # longer cycles (A -> B -> C -> A) with no 2-cycle inside: walk
+    # the digraph's SCCs
+    adj: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set())
+    for cyc in _sccs(adj):
+        if len(cyc) < 2:
+            continue
+        if any(frozenset((a, b)) in reported
+               for a in cyc for b in cyc if a != b):
+            continue
+        loop = _find_cycle(adj, cyc)
+        if not loop:
+            continue
+        reported.add(frozenset(loop))
+        pairs = list(zip(loop, loop[1:] + loop[:1]))
+        rel, line = anchor(edges[pairs[0]])
+        detail = "; ".join(
+            f"`{a}` -> `{b}` via {render(edges[(a, b)])}"
+            for a, b in pairs)
+        yield Finding(
+            "DG12", rel, line,
+            f"lock-order cycle of length {len(loop)}: {detail} — "
+            "deadlock under contention; pick one global order",
+            _line_text(proj, rel, line))
+
+
+def _line_text(proj: ProjectContext, rel: str, line: int) -> str:
+    lines = proj.sources.get(rel)
+    if lines is None:
+        # a --changed-only pass served this file from the summary
+        # cache: read the line off disk so the finding's context (the
+        # baseline identity) matches what a full pass would emit
+        try:
+            with open(os.path.join(proj.root, rel),
+                      encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            lines = []
+        proj.sources[rel] = lines
+    if lines and 0 < line <= len(lines):
+        return lines[line - 1].strip()
+    return ""
+
+
+def _sccs(adj: dict[str, set[str]]) -> list[list[str]]:
+    """Tarjan, iterative."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on: set[str] = set()
+    stack: list[str] = []
+    out: list[list[str]] = []
+    counter = [0]
+
+    for start in adj:
+        if start in index:
+            continue
+        work = [(start, iter(sorted(adj[start])))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on.add(start)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                out.append(comp)
+    return out
+
+
+def _find_cycle(adj: dict[str, set[str]],
+                scc: list[str]) -> list[str]:
+    """One simple cycle inside an SCC (DFS from its smallest node)."""
+    nodes = set(scc)
+    start = min(scc)
+    path = [start]
+    seen = {start}
+    while True:
+        cur = path[-1]
+        nxt = None
+        for w in sorted(adj.get(cur, ())):
+            if w == start and len(path) > 1:
+                return path
+            if w in nodes and w not in seen:
+                nxt = w
+                break
+        if nxt is None:
+            if len(path) == 1:
+                return []
+            path.pop()
+            continue
+        seen.add(nxt)
+        path.append(nxt)
