@@ -25,7 +25,8 @@ from typing import Callable, Optional, Sequence
 def run_open_loop(submit: Callable, reqs: Sequence,
                   concurrency: int, rate_qps: float,
                   burst_of: Optional[Sequence[int]] = None,
-                  results: Optional[list] = None) -> list[float]:
+                  results: Optional[list] = None,
+                  arrivals_out: Optional[list] = None) -> list[float]:
     """Drive `submit(req)` over one global open-loop schedule.
 
     One arrival schedule at `rate_qps` offered load; `concurrency`
@@ -36,6 +37,10 @@ def run_open_loop(submit: Callable, reqs: Sequence,
     bursts). With `results` (a caller list), submit's return value is
     appended as results[i] = (index, value) — dgbench uses it to
     classify outcomes without wrapping submit in another closure.
+    With `arrivals_out` (a caller list), the absolute scheduled
+    arrival times (time.perf_counter clock) are appended before
+    driving starts — tools/dgchaos.py aligns them against its
+    nemesis timeline instead of re-deriving the schedule.
     """
     t0 = time.perf_counter() + 0.05
     if burst_of is None:
@@ -44,6 +49,8 @@ def run_open_loop(submit: Callable, reqs: Sequence,
         slots = burst_of[-1] + 1
         slot_rate = rate_qps * slots / len(reqs)
         arrivals = [t0 + s / slot_rate for s in burst_of]
+    if arrivals_out is not None:
+        arrivals_out.extend(arrivals)
     lat = [0.0] * len(reqs)
     nxt = [0]
     lock = threading.Lock()

@@ -16,6 +16,7 @@ over the cluster wire via the returned RoutedCluster.
 from __future__ import annotations
 
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -47,6 +48,7 @@ class ProcessCluster:
     def __init__(self, groups: int = 2, replicas: int = 1,
                  zeros: int = 1, max_pending: int = 0,
                  log_dir: Optional[str] = None,
+                 data_dir: Optional[str] = None,
                  tick_ms: int = 30, election_ticks: int = 8,
                  env_extra: Optional[dict] = None):
         self.groups_n = groups
@@ -55,7 +57,13 @@ class ProcessCluster:
         self.debug_urls: dict[str, str] = {}
         self.zero_addrs: dict[int, tuple[str, int]] = {}
         self.group_addrs: dict[int, dict[int, tuple[str, int]]] = {}
-        self._logs: list = []
+        # per-node address book for the chaos plane: a nemesis that
+        # partitions node A from node B needs EVERY listener B owns
+        # (raft + client; the debug port stays reachable on purpose —
+        # it's the out-of-band control/observation channel)
+        self.node_addrs: dict[str, dict[str, tuple[str, int]]] = {}
+        self._node_args: dict[str, list[str]] = {}
+        self._logs: dict[str, object] = {}
         self._env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
             "JAX_PLATFORMS", "cpu"), PYTHONPATH=_REPO)
         if env_extra:
@@ -65,6 +73,13 @@ class ProcessCluster:
         self.log_dir = log_dir
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
+        # data_dir gives every node a persistent raft WAL/snapshot dir
+        # (--wal -> cluster/raft.DiskStorage): the restart() nemesis
+        # reboots a SIGKILLed node onto its existing state, so
+        # acknowledged writes must survive the crash
+        self.data_dir = data_dir
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
 
         # zero quorum
         zports = free_ports(3 * zeros)
@@ -74,6 +89,9 @@ class ProcessCluster:
         for i in range(1, zeros + 1):
             cport, dport = zports[3 * (i - 1) + 1], zports[3 * (i - 1) + 2]
             self.zero_addrs[i] = ("127.0.0.1", cport)
+            self.node_addrs[f"zero-n{i}"] = {
+                "raft": zraft[i], "client": ("127.0.0.1", cport),
+                "debug": ("127.0.0.1", dport)}
             self._spawn(f"zero-n{i}", [
                 "--kind", "zero", "--id", str(i),
                 "--raft-peers", zpeers,
@@ -94,6 +112,9 @@ class ProcessCluster:
                 cport = ports[3 * (i - 1) + 1]
                 dport = ports[3 * (i - 1) + 2]
                 self.group_addrs[g][i] = ("127.0.0.1", cport)
+                self.node_addrs[f"alpha-g{g}-n{i}"] = {
+                    "raft": graft[i], "client": ("127.0.0.1", cport),
+                    "debug": ("127.0.0.1", dport)}
                 args = ["--kind", "alpha", "--id", str(i),
                         "--group", str(g),
                         "--raft-peers", gpeers,
@@ -105,16 +126,28 @@ class ProcessCluster:
                 self._spawn(f"alpha-g{g}-n{i}", args)
 
     def _spawn(self, name: str, args: list[str]):
+        if name not in self._node_args:
+            if self.data_dir:
+                args = args + ["--wal",
+                               os.path.join(self.data_dir, name)]
+            self._node_args[name] = list(args)
         if self.log_dir:
-            log = open(os.path.join(self.log_dir, name + ".log"), "w")
-            self._logs.append(log)
+            # append mode: a restarted node's pre-crash log survives
+            log = open(os.path.join(self.log_dir, name + ".log"), "a")
+            old = self._logs.get(name)
+            if old is not None:
+                try:
+                    old.close()
+                except OSError:
+                    pass
+            self._logs[name] = log
         else:
             log = subprocess.DEVNULL
         dport = args[args.index("--debug-port") + 1]
         self.debug_urls[name] = f"http://127.0.0.1:{dport}"
         self.procs[name] = subprocess.Popen(
             [sys.executable, "-m", "dgraph_tpu", "node"]
-            + args + self._tick,
+            + self._node_args[name] + self._tick,
             env=self._env, cwd=_REPO,
             stdout=subprocess.DEVNULL, stderr=log)
 
@@ -179,6 +212,121 @@ class ProcessCluster:
     def alive(self) -> list[str]:
         return [n for n, p in self.procs.items() if p.poll() is None]
 
+    # ------------------------------------------------------- chaos plane
+    # Per-node crash/restart controls for the nemesis harness
+    # (tools/dgchaos.py): a node can be SIGKILLed under load and
+    # rebooted onto its existing WAL/snapshot dirs (data_dir=).
+
+    def kill(self, name: str, sig: int = signal.SIGKILL):
+        """Send `sig` to one node. SIGKILL/SIGTERM reap the process
+        (so restart() can re-bind its ports); SIGSTOP/SIGCONT pause
+        and resume in place — the network-indistinguishable-partition
+        nemesis."""
+        p = self.procs[name]
+        if p.poll() is not None:
+            return
+        p.send_signal(sig)
+        if sig in (signal.SIGKILL, signal.SIGTERM):
+            # never hang the harness on a wedged shutdown path (an
+            # armed failpoint holding a lock, a stuck flush): escalate
+            # to SIGKILL like teardown() does
+            try:
+                p.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    def restart(self, name: str):
+        """Reboot a dead node with its ORIGINAL args — same ports,
+        same --wal dir. Without data_dir the node comes back empty and
+        relies on the raft snapshot transfer from its peers; with it,
+        DiskStorage replays the persisted log + snapshot first."""
+        p = self.procs.get(name)
+        if p is not None and p.poll() is None:
+            raise RuntimeError(f"{name} is still running; kill() first")
+        self._spawn(name, self._node_args[name])
+
+    def _quorum_of(self, name: str) -> dict[int, tuple[str, int]]:
+        """The client addrs of the raft quorum `name` belongs to."""
+        if name.startswith("zero"):
+            return dict(self.zero_addrs)
+        g = int(name.split("-")[1][1:])
+        return dict(self.group_addrs[g])
+
+    def leader_of(self, quorum: str,
+                  timeout_s: float = 30.0) -> str:
+        """Current leader of a quorum ('zero' or 'g<N>') as a node
+        name — the kill-leader nemesis target."""
+        from dgraph_tpu.cluster.client import ClusterClient
+        addrs = dict(self.zero_addrs) if quorum == "zero" \
+            else dict(self.group_addrs[int(quorum[1:])])
+        cl = ClusterClient(addrs, timeout=5.0)
+        try:
+            end = time.monotonic() + timeout_s
+            while time.monotonic() < end:
+                for node in list(addrs):
+                    try:
+                        if cl.status(node).get("role") == "leader":
+                            return f"zero-n{node}" \
+                                if quorum == "zero" \
+                                else f"alpha-{quorum}-n{node}"
+                    except (ConnectionError, RuntimeError, KeyError):
+                        continue
+                time.sleep(0.2)
+            raise TimeoutError(f"no {quorum} leader in {timeout_s}s")
+        finally:
+            cl.close()
+
+    def wait_caught_up(self, name: str, timeout_s: float = 60.0):
+        """Block until a (re)started node rejoined its quorum AND
+        applied at least everything its peers had applied when this
+        call began — the 'recovery is complete' edge the chaos
+        report's restart nemeses measure against. Returns the node's
+        final status dict."""
+        from dgraph_tpu.cluster.client import ClusterClient
+        addrs = self._quorum_of(name)
+        nid = int(name.rsplit("n", 1)[1])
+        cl = ClusterClient(addrs, timeout=5.0)
+        try:
+            end = time.monotonic() + timeout_s
+            # the catch-up goal: the max applied index any PEER holds
+            # now (a single-replica quorum has no peers — the node
+            # only has to come back up and re-elect itself)
+            goal = 0
+            peers = [n for n in addrs if n != nid]
+            while peers and time.monotonic() < end:
+                seen = []
+                for node in peers:
+                    try:
+                        seen.append(int(
+                            cl.status(node).get("applied", 0)))
+                    except (ConnectionError, RuntimeError, KeyError):
+                        continue
+                if seen:
+                    goal = max(seen)
+                    break
+                time.sleep(0.2)
+            while time.monotonic() < end:
+                try:
+                    st = cl.status(nid)
+                except (ConnectionError, RuntimeError, KeyError):
+                    time.sleep(0.2)
+                    continue
+                # `leader is not None` matters: a freshly rebooted
+                # node reports follower/applied=0 BEFORE any election
+                # — only once a leader exists has the new term's noop
+                # committed and the persisted log replayed (§5.4.2)
+                if st.get("leader") is not None \
+                        and st.get("role") in ("leader", "follower") \
+                        and int(st.get("applied", 0)) >= goal:
+                    return st
+                time.sleep(0.2)
+            raise TimeoutError(
+                f"{name} not caught up to applied>={goal} "
+                f"within {timeout_s}s")
+        finally:
+            cl.close()
+
     def teardown(self):
         for p in self.procs.values():
             if p.poll() is None:
@@ -190,7 +338,7 @@ class ProcessCluster:
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait()
-        for log in self._logs:
+        for log in self._logs.values():
             try:
                 log.close()
             except OSError:

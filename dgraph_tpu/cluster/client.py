@@ -14,8 +14,10 @@ import threading
 import time
 from typing import Any, Optional
 
+import random
+
 from dgraph_tpu import wire
-from dgraph_tpu.utils import tracing
+from dgraph_tpu.utils import netfault, tracing
 from dgraph_tpu.utils.reqctx import Cancelled, DeadlineExceeded, Overloaded
 
 # wire `aborted` field -> the typed error the serving node raised, so
@@ -34,6 +36,18 @@ class ClusterClient:
     # gating (conn/pool.go:227 MonitorHealth marks pools unhealthy;
     # processWithBackupRequest avoids sick replicas)
     UNHEALTHY_S = 1.0
+
+    # bounded-jitter backoff between full routing passes when no node
+    # answered (partition, election in progress): starts near-instant
+    # so a quick election costs one cheap retry, doubles toward the
+    # cap so a PARTITIONED client stops hammering dead links, and the
+    # jitter de-synchronizes the reconnect stampede when the partition
+    # heals (every waiting client would otherwise redial in lockstep).
+    # The chaos harness surfaced the fixed 0.1s sleep this replaces:
+    # under a 30s-timeout client it burned a full routing pass — dials
+    # included — every 100ms for the whole partition.
+    BACKOFF_BASE_S = 0.02
+    BACKOFF_CAP_S = 0.5
 
     def __init__(self, addrs: dict[int, tuple[str, int]],
                  timeout: float = 10.0):
@@ -103,6 +117,18 @@ class ClusterClient:
             sock = self._conns.get(node)
             addr = self.addrs.get(node)
         if addr is None:
+            return None
+        if netfault.armed() \
+                and netfault.act(addr, can_dup=False) == netfault.DROP:
+            # the fault plane cut this link (utils/netfault.py): behave
+            # exactly like a refused dial / reset connection — drop the
+            # pooled socket, demote the node, let the routing loop try
+            # the other replicas. Client->server partitions and every
+            # server-side outbound RPC (alpha->zero ts, federated
+            # tasks, 2PC stage/finalize) flow through here.
+            if sock is not None:
+                self._drop(node, sock)
+            self._mark_down(node)
             return None
         if sock is None:
             # connect budget never exceeds the client's deadline: a
@@ -199,6 +225,7 @@ class ClusterClient:
                 if bounded else None
 
         last_err = "unreachable"
+        passes = 0
         while time.monotonic() < deadline:
             # snapshot the routing state under the lock, then do every
             # RPC with NO lock held (the dial-outside-lock pattern: a
@@ -246,9 +273,12 @@ class ClusterClient:
                     continue
                 return resp  # real application error: surface it
             last_err = "no leader reachable"
-            # never sleep past the deadline the caller set
-            time.sleep(min(0.1, max(0.0,
-                                    deadline - time.monotonic())))
+            # bounded-jitter exponential backoff, never past the
+            # caller's deadline (an expired budget exits the loop and
+            # surfaces TYPED as DeadlineExceeded via deadline_expired)
+            time.sleep(min(self._backoff_s(passes),
+                           max(0.0, deadline - time.monotonic())))
+            passes += 1
         # with a caller-supplied budget this is EXPIRY, not a
         # generic routing failure: the marker lets _unwrap raise
         # DeadlineExceeded so the HTTP edge answers 408 retryable
@@ -256,6 +286,17 @@ class ClusterClient:
         # path)
         return {"ok": False, "error": last_err,
                 "deadline_expired": bounded}
+
+    @classmethod
+    def _backoff_s(cls, passes: int,
+                   rng: random.Random = random) -> float:
+        """Sleep before routing pass `passes+1`: BASE * 2^passes
+        capped at CAP, scaled by uniform[0.5, 1.0) jitter. Pure (given
+        an rng) so the bound is testable: always > 0, never above
+        CAP."""
+        step = min(cls.BACKOFF_CAP_S,
+                   cls.BACKOFF_BASE_S * (1 << min(passes, 16)))
+        return step * (0.5 + rng.random() * 0.5)
 
     def close(self):
         with self._lock:
@@ -320,6 +361,10 @@ class ClusterClient:
         results: queue.Queue = queue.Queue()
 
         def attempt(node):
+            if netfault.armed() and netfault.act(
+                    self.addrs[node], can_dup=False) == netfault.DROP:
+                results.put(None)
+                return
             try:
                 sock = socket.create_connection(
                     self.addrs[node], timeout=min(2.0, budget))

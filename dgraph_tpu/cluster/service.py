@@ -29,7 +29,7 @@ from dgraph_tpu.cluster.raft import (
     FOLLOWER, GOODBYE, LEADER, Msg, RaftNode, VOTE_REQ,
 )
 from dgraph_tpu.cluster.transport import TcpTransport
-from dgraph_tpu.utils import tracing
+from dgraph_tpu.utils import failpoint, netfault, tracing
 from dgraph_tpu.utils.logger import log
 from dgraph_tpu.utils.reqctx import (
     PROPAGATION_SKEW_S, DeadlineExceeded, Overloaded, RequestAborted,
@@ -88,6 +88,12 @@ class RaftServer:
         # RESTARTS (monotonic restarts near zero every boot)
         self.epoch = int(time.time() * 1000) % (1 << 40)  # dglint: disable=DG06
         self._stop = threading.Event()
+        # peer -> monotonic time a Raft message last arrived from it:
+        # the operator-visible "is this peer partitioned from me" age
+        # (surfaced in status/health/debug stats and tools/dgtop.py —
+        # a partition is otherwise invisible from the outside until
+        # something times out)
+        self._last_heard: dict[int, float] = {}
         transport_peers = dict(self.members)
         if node_id in raft_peers:  # own listen addr always from CLI
             transport_peers[node_id] = raft_peers[node_id]
@@ -150,6 +156,7 @@ class RaftServer:
         with self.lock:
             if self._stop.is_set():
                 return
+            self._last_heard[msg.frm] = time.monotonic()
             if msg.type == GOODBYE:
                 # a member told us we were conf-removed (backstop for
                 # a lost farewell append): go quiet
@@ -198,6 +205,10 @@ class RaftServer:
                      role=r.soft_state[0], leader=r.soft_state[1],
                      term=self.node.term)
         if r.snapshot is not None:
+            # chaos seam: an armed `snapshot.install` failpoint delays
+            # or fails the install — an error action models the apply
+            # path dying mid-install (the node wedges, like a crash)
+            failpoint.fire("snapshot.install")
             log.info("raft_snapshot_restore", node=self.id,
                      index=r.snapshot[0])
             data = r.snapshot[2]
@@ -318,6 +329,18 @@ class RaftServer:
                     "members": {str(k): list(v)
                                 for k, v in self.members.items()},
                     "removed": self.node.removed}}
+        if op == "fault":
+            # live control of THIS node's outbound fault table
+            # (utils/netfault.py) — the wire half of the chaos plane's
+            # control surface (POST /debug/fault is the HTTP half).
+            # tools/dgchaos.py arms partitions/delay storms with it
+            # and heals them with {"action": "clear"}.
+            try:
+                return {"ok": True,
+                        "result": netfault.handle_control(req)}
+            except (ValueError, KeyError, TypeError) as e:
+                return {"ok": False,
+                        "error": f"bad fault control: {e}"}
         if op == "traces":
             # node-local trace slice (the wire analogue of HTTP
             # /debug/traces?trace_id=): tools/trace_merge.py stitches
@@ -481,17 +504,33 @@ class RaftServer:
 
     # ----------------------------------------------------------- lifecycle
 
+    def peer_ages(self) -> dict:
+        """Seconds since a Raft message last arrived from each peer
+        (None = never heard since boot). A healthy link ticks at the
+        heartbeat cadence, so an age of several election timeouts IS a
+        partition, visible from the outside — the judge dgtop and the
+        chaos report read."""
+        with self.lock:
+            now = time.monotonic()
+            return {str(p): (round(now - self._last_heard[p], 3)
+                             if p in self._last_heard else None)
+                    for p in self.members if p != self.id}
+
     def debug_stats_payload(self) -> dict:
         """What this node kind contributes to /debug/stats on the
         debug HTTP listener (counters/gauges/histograms are appended
         by the listener itself). Subclasses override."""
-        return {"node": self.node_name}
+        return {"node": self.node_name,
+                "netfault": netfault.rules(),
+                "lastHeard": self.peer_ages()}
 
     def health_payload(self) -> dict:
         with self.lock:
-            return {"id": self.id, "role": self.node.role,
-                    "leader": self.node.leader_id,
-                    "term": self.node.term}
+            out = {"id": self.id, "role": self.node.role,
+                   "leader": self.node.leader_id,
+                   "term": self.node.term}
+        out["lastHeard"] = self.peer_ages()
+        return out
 
     def close(self):
         self._stop.set()
@@ -993,6 +1032,15 @@ class AlphaServer(RaftServer):
                         (int(got["result"]["commit_ts"]), st))
             for c, st in sorted(decided):
                 try:
+                    # chaos seam: delay/fail a decided fragment's
+                    # finalize apply — a FailpointError is swallowed
+                    # below like any transient failure (the reconcile
+                    # machinery retries next pass, which is exactly
+                    # the recovery path under test). An armed sleep
+                    # stalling the drain under _finalize_lock is the
+                    # POINT of the seam: finalize ordering pressure is
+                    # what the nemesis schedules exist to create.
+                    failpoint.fire("txn.xfinalize")  # dglint: disable=DG04 (chaos seam: the armed delay must stall this drain; inert cost is one dict check)
                     self._replicate_record_locked(("xfinalize", st, c))
                 except RequestAborted:
                     raise
@@ -1512,6 +1560,11 @@ class AlphaServer(RaftServer):
             # leader changes (ref worker/mutation.go:432 proposeOrSend)
             from dgraph_tpu.gql.nquad import nquad_from_wire
             start_ts = int(req["start_ts"])
+            # chaos seam: delay/fail a group's 2PC stage — the
+            # coordinator-dies-mid-stage and slow-participant nemeses
+            # (an armed error surfaces to the coordinator, which
+            # aborts at zero and clears staged fragments)
+            failpoint.fire("txn.xstage")
             nqs = [(nquad_from_wire(t), bool(d)) for t, d in req["nqs"]]
             preds = {nq.predicate for nq, _ in nqs}
             with self._write_lock:
@@ -1574,6 +1627,8 @@ class AlphaServer(RaftServer):
             stats["node"] = self.node_name
             stats["group"] = self.group
             stats["requests"] = reqlog.snapshot()
+            stats["netfault"] = netfault.rules()
+            stats["lastHeard"] = self.peer_ages()
             metrics.collect_process_gauges()
             stats["counters"] = metrics.counters_snapshot()
             stats["gauges"] = metrics.gauges_snapshot()
@@ -1619,6 +1674,8 @@ class AlphaServer(RaftServer):
         stats["node"] = self.node_name
         stats["group"] = self.group
         stats["requests"] = reqlog.snapshot()
+        stats["netfault"] = netfault.rules()
+        stats["lastHeard"] = self.peer_ages()
         return stats
 
     def health_payload(self) -> dict:
@@ -1790,6 +1847,7 @@ class ZeroServer(RaftServer):
                 return {"ok": True, "result": {
                     "id": self.id, "role": self.node.role,
                     "leader": self.node.leader_id,
+                    "applied": self.node.applied_index,
                     "max_ts": self.state.max_ts,
                     "next_uid": self.state.next_uid}}
         if op == "tablet_map":

@@ -20,7 +20,7 @@ from typing import Callable, Optional
 
 from dgraph_tpu import wire
 from dgraph_tpu.cluster.raft import Msg
-from dgraph_tpu.utils import failpoint
+from dgraph_tpu.utils import failpoint, netfault
 from dgraph_tpu.utils.metrics import inc_counter
 
 _HELLO = b"DGTRAFT1"
@@ -93,6 +93,18 @@ class TcpTransport:
         except failpoint.FailpointError:
             inc_counter("raft_send_drops")
             return False
+        dup = False
+        if netfault.armed():
+            # network fault plane (utils/netfault.py): the armed rule
+            # table models this link — drop eats the frame (Raft's own
+            # retries recover, exactly like a lossy wire), delay slept
+            # inside act(), DUP sends the idempotent frame twice
+            addr = self.peers.get(msg.to)
+            verdict = netfault.act(addr) if addr is not None else None
+            if verdict == netfault.DROP:
+                inc_counter("raft_send_drops")
+                return False
+            dup = verdict == netfault.DUP
         for attempt in (0, 1):
             sock = self._conn_to(msg.to, force_new=attempt == 1)
             if sock is None:
@@ -100,6 +112,8 @@ class TcpTransport:
                 return False
             try:
                 wire.write_frame(sock, wire.dumps(msg))
+                if dup:
+                    wire.write_frame(sock, wire.dumps(msg))
                 return True
             except OSError:
                 self._drop_conn(msg.to)

@@ -15,12 +15,18 @@ Alpha surface exposes —
     GET /debug/prometheus_metrics   text exposition 0.0.4
     GET /debug/traces[?trace_id=]   node-local span slice
     GET /debug/pprof?seconds=N      wall-clock sampling profile
+    GET /debug/fault                active network-fault rules
+    POST /debug/fault               fault control (utils/netfault.py):
+                                    {"action": "set|add|remove|clear",
+                                     "rules": [...]} — curl-able chaos
+                                    arming/healing for an operator
 
-It is deliberately NOT the query surface: no POST handlers, no txn
-state, no ACL store — bind it to localhost (the default) or scrape-net
-interfaces only. `serve_debug` takes callables so AlphaServer and
-ZeroServer plug in whatever stats they have without this module
-importing engine internals.
+It is deliberately NOT the query surface: no txn state, no ACL store,
+and the single POST handler touches only the process-local fault
+table — bind it to localhost (the default) or scrape-net interfaces
+only. `serve_debug` takes callables so AlphaServer and ZeroServer plug
+in whatever stats they have without this module importing engine
+internals.
 """
 
 from __future__ import annotations
@@ -84,6 +90,10 @@ class _DebugHandler(BaseHTTPRequestHandler):
                 from dgraph_tpu.utils import pprof
                 self._send(200, pprof.handle_params(
                     params, node=self.node_name))
+            elif u.path == "/debug/fault":
+                from dgraph_tpu.utils import netfault
+                self._send(200, {"node": self.node_name,
+                                 "rules": netfault.rules()})
             else:
                 self._send(404, {"errors": [
                     {"message": f"no handler for GET {u.path}"}]})
@@ -91,6 +101,25 @@ class _DebugHandler(BaseHTTPRequestHandler):
             self._send(400, {"errors": [{"message": str(e)}]})
         except Exception as e:  # noqa: BLE001 — debug surface: report  # dglint: disable=DG07 (read-only debug listener; no request ctx flows here)
             self._send(500, {"errors": [{"message": str(e)}]})
+
+    def do_POST(self):
+        """The one control surface on this listener: the network fault
+        table (chaos arming/healing with nothing but curl). Everything
+        else stays read-only GET."""
+        u = urlparse(self.path)
+        if u.path != "/debug/fault":
+            self._send(404, {"errors": [
+                {"message": f"no handler for POST {u.path}"}]})
+            return
+        from dgraph_tpu.utils import netfault
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            out = netfault.handle_control(body)
+            out["node"] = self.node_name
+            self._send(200, out)
+        except (ValueError, KeyError, TypeError) as e:
+            self._send(400, {"errors": [{"message": str(e)}]})
 
 
 def serve_debug(stats_fn: Optional[Callable[[], dict]] = None,

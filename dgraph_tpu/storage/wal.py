@@ -136,9 +136,13 @@ class Wal:
 
     def append(self, record: Any):
         from dgraph_tpu.storage.enc import encrypt_blob
+        from dgraph_tpu.utils import failpoint
         from dgraph_tpu.utils.tracing import span as _span
         from dgraph_tpu.wire import dumps
         with _span("wal.append") as sp:
+            # chaos seam: delay/fail durability — an armed error here
+            # models a full disk / dying volume before the frame lands
+            failpoint.fire("wal.append")
             blob = encrypt_blob(dumps(record), self.key)
             sp["bytes"] = len(blob)
             self._w.append(blob)

@@ -7,10 +7,16 @@ x/debug.go / gofail style: a named site in production code evaluates to
 a no-op unless a test (or the DGRAPH_TPU_FAILPOINTS env var, for
 subprocess clusters) armed an action for it.
 
-Injection sites (grep `failpoint.fire`):
+Injection sites (grep `failpoint.fire`; the SITES registry below is
+the authoritative list, dglint DG08-checked):
     transport.send      cluster/transport.py — before a Raft frame send
     tablet.apply        storage/tablet.py    — before a commit delta lands
     executor.level      query/executor.py    — every block/level boundary
+    wal.append          storage/wal.py       — before a record frames
+    snapshot.install    cluster/service.py   — before a raft snapshot restores
+    txn.xstage          cluster/service.py   — before a 2PC fragment stages
+    txn.xfinalize       cluster/service.py   — before a decided 2PC
+                                               fragment's finalize applies
 
 Actions (spec grammar, `;`-separated in the env var):
     sleep(S)      delay S seconds (float) at the site
@@ -42,9 +48,17 @@ ENV_VAR = "DGRAPH_TPU_FAILPOINTS"
 # renamed or removed site cannot silently turn chaos tests into
 # no-ops. Tests may arm ad-hoc fixture names freely.
 SITES = (
-    "transport.send",   # cluster/transport.py — before a Raft frame
-    "tablet.apply",     # storage/tablet.py    — before a commit delta
-    "executor.level",   # query/executor.py    — block/level boundary
+    "transport.send",    # cluster/transport.py — before a Raft frame
+    "tablet.apply",      # storage/tablet.py    — before a commit delta
+    "executor.level",    # query/executor.py    — block/level boundary
+    "wal.append",        # storage/wal.py       — before a record frames
+    "snapshot.install",  # cluster/service.py   — before a raft snapshot
+    #                      restores (error = apply path dies mid-install)
+    "txn.xstage",        # cluster/service.py   — before a 2PC fragment
+    #                      stages on a participant group
+    "txn.xfinalize",     # cluster/service.py   — before a DECIDED 2PC
+    #                      fragment's finalize applies (error = one
+    #                      transient failed delivery; reconcile retries)
 )
 
 

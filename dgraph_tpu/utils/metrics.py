@@ -77,6 +77,11 @@ REGISTERED = (
     "query_similar_sharded_total",
     # cluster (cluster/transport.py)
     "raft_send_drops",
+    # network fault plane (utils/netfault.py)
+    "dgraph_net_fault_delays_total",
+    "dgraph_net_fault_drops_total",
+    "dgraph_net_fault_dups_total",
+    "dgraph_net_fault_rules",
     # process gauges (utils/metrics.py collect_memory_gauges /
     # collect_runtime_gauges)
     "memory_inuse_bytes",

@@ -126,3 +126,20 @@ def _failpoint_leak_guard():
             f"test leaked armed failpoints: {leaked} — arm() must be "
             "paired with disarm()/clear() (use the `failpoint` marker "
             "and a try/finally)")
+
+
+@pytest.fixture(autouse=True)
+def _netfault_leak_guard():
+    """Same contract for the network fault plane (utils/netfault): a
+    leaked drop rule would silently partition every later test's
+    cluster traffic — fail the leaking test, then heal."""
+    from dgraph_tpu.utils import netfault
+
+    yield
+    leaked = netfault.rules()
+    if leaked:
+        netfault.clear()
+        pytest.fail(
+            f"test leaked armed network-fault rules: {leaked} — "
+            "pair add_rule()/set_rules() with clear() in a "
+            "try/finally")

@@ -1,0 +1,196 @@
+"""tools/dgchaos — the history checker and recovery-window math, unit
+level: synthetic histories with planted violations must be caught,
+clean ones must pass. (The live harness itself runs as the
+`dgchaos --smoke` gate in tools/check.sh.)"""
+
+import pytest
+
+from tools.dgchaos import (
+    OPENING, NEMESES, check_history, classify, phase_windows,
+)
+from dgraph_tpu.utils.reqctx import DeadlineExceeded, Overloaded
+
+
+def _xfer(opid, ts, session=1, seq=0, outcome="ok", **kw):
+    a, b, amt, _ = opid.rsplit(":", 3)
+    rec = {"kind": "transfer", "opid": opid, "a": a, "b": b,
+           "amt": int(amt), "start_ts": ts, "outcome": outcome,
+           "session": session, "seq": seq, "t": float(ts)}
+    if outcome == "ok":
+        rec["commit_ts"] = ts + 1
+    rec.update(kw)
+    return rec
+
+
+def _read(ts, balances, session=2, seq=0, outcome="ok"):
+    return {"kind": "read", "read_ts": ts, "balances": sorted(balances),
+            "outcome": outcome, "session": session, "seq": seq,
+            "t": float(ts)}
+
+
+U = ["0x1", "0x2"]  # two accounts
+
+
+def _clean_history():
+    # 0x1 -> 0x2 for 10, then 0x2 -> 0x1 for 3
+    return [
+        _xfer("0x1:0x2:10:1", 10, session=1, seq=0),
+        _read(12, [OPENING - 10, OPENING + 10], session=2, seq=0),
+        _xfer("0x2:0x1:3:2", 14, session=1, seq=1),
+        _read(16, [OPENING - 7, OPENING + 7], session=2, seq=1),
+    ]
+
+
+def _final_for(ledger):
+    bals = {u: OPENING for u in U}
+    for opid in ledger:
+        a, b, amt, _ = opid.rsplit(":", 3)
+        bals[a] -= int(amt)
+        bals[b] += int(amt)
+    return bals
+
+
+def test_clean_history_passes():
+    ledger = ["0x1:0x2:10:1", "0x2:0x1:3:2"]
+    v = check_history(_clean_history(), _final_for(ledger), ledger, 2)
+    assert v["ok"], v["violations"]
+    assert v["stats"]["acked_transfers"] == 2
+    assert v["stats"]["full_reads"] == 2
+
+
+def test_conservation_violation_caught():
+    hist = _clean_history()
+    hist.insert(2, _read(13, [OPENING - 10, OPENING], session=3))
+    ledger = ["0x1:0x2:10:1", "0x2:0x1:3:2"]
+    v = check_history(hist, _final_for(ledger), ledger, 2)
+    assert not v["ok"]
+    assert any("conservation" in s for s in v["violations"])
+
+
+def test_short_read_is_a_violation():
+    # every read happens after setup seeded all accounts: a
+    # successful full scan that saw FEWER rows is a torn/short
+    # snapshot, not a skippable partial
+    hist = [_read(5, [OPENING - 10])]
+    v = check_history(hist, {}, [], 2)
+    assert not v["ok"]
+    assert any("short-read" in s for s in v["violations"])
+    assert v["stats"]["full_reads"] == 0
+    # failed reads carry no balance vector and are never checked
+    v = check_history([_read(6, [], outcome="deadline")], {}, [], 2)
+    assert v["ok"], v["violations"]
+
+
+def test_session_monotonic_ts_violation_caught():
+    hist = [
+        _xfer("0x1:0x2:5:1", 20, session=9, seq=0),
+        _read(15, [OPENING, OPENING], session=9, seq=1),  # ts went back
+    ]
+    ledger = ["0x1:0x2:5:1"]
+    v = check_history(hist, None, ledger, 2)
+    assert any("session-monotonic" in s for s in v["violations"])
+
+
+def test_acked_write_lost_after_heal_caught():
+    hist = _clean_history()
+    ledger = ["0x1:0x2:10:1"]  # the second ACKED transfer vanished
+    v = check_history(hist, _final_for(ledger), ledger, 2)
+    assert any("acked-durability" in s and "0x2:0x1:3:2" in s
+               for s in v["violations"])
+
+
+def test_indeterminate_transfer_may_or_may_not_land():
+    base = _clean_history()
+    maybe = _xfer("0x1:0x2:4:3", 18, session=1, seq=2,
+                  outcome="deadline", indeterminate=True)
+    # absent from the ledger: fine
+    ledger = ["0x1:0x2:10:1", "0x2:0x1:3:2"]
+    v = check_history(base + [maybe], _final_for(ledger), ledger, 2)
+    assert v["ok"], v["violations"]
+    # present in the ledger: also fine (the ack was lost, not the txn)
+    ledger2 = ledger + ["0x1:0x2:4:3"]
+    v = check_history(base + [maybe], _final_for(ledger2), ledger2, 2)
+    assert v["ok"], v["violations"]
+
+
+def test_lost_update_diverges_replay_from_balances():
+    hist = _clean_history()
+    ledger = ["0x1:0x2:10:1", "0x2:0x1:3:2"]
+    # the store lost the first transfer's debit (stale RMW overwrote
+    # it) but the ledger entry exists: replay != final balances
+    bad_final = {"0x1": OPENING - 7 + 10, "0x2": OPENING + 7}
+    v = check_history(hist, bad_final, ledger, 2)
+    assert any("no-lost-update" in s for s in v["violations"])
+
+
+def test_phantom_and_duplicate_ledger_entries_caught():
+    hist = _clean_history()
+    ledger = ["0x1:0x2:10:1", "0x2:0x1:3:2", "0x9:0x1:2:99"]
+    v = check_history(hist, None, ledger, 2)
+    assert any("phantom" in s for s in v["violations"])
+    dup = ["0x1:0x2:10:1", "0x1:0x2:10:1", "0x2:0x1:3:2"]
+    v = check_history(hist, None, dup, 2)
+    assert any("duplicate opids" in s for s in v["violations"])
+
+
+def test_classify_error_taxonomy():
+    assert classify(Overloaded("x")) == "shed"
+    assert classify(DeadlineExceeded("x")) == "deadline"
+    assert classify(RuntimeError(
+        "transaction aborted: write-write conflict")) == "conflict"
+    assert classify(RuntimeError("not leader")) == "unavailable"
+    assert classify(RuntimeError(
+        "zero unreachable; cannot verify")) == "unavailable"
+    assert classify(ValueError("boom")) == "error"
+
+
+# -------------------------------------------------- recovery windowing
+
+
+def _phase(lat_fault_ms=2000.0, heal_back_to=5.0):
+    """60 ops at 10/s: faults bite [2s, 4s), recovery after heal."""
+    recs, lat, arr = [], [], []
+    for i in range(60):
+        t = i / 10.0
+        arr.append(t)
+        if 2.0 <= t < 4.0:
+            recs.append({"outcome": "unavailable"})
+            lat.append(lat_fault_ms / 1e3)
+        else:
+            recs.append({"outcome": "ok"})
+            lat.append(0.005 if t >= heal_back_to or t < 2.0
+                       else 0.8)
+    return recs, lat, arr
+
+
+def test_phase_windows_shapes_and_recovery():
+    recs, lat, arr = _phase()
+    win = phase_windows(recs, lat, arr, t_inject=2.0, t_heal=4.0,
+                        slo_ms=100.0)
+    assert win["pre"]["classes"] == {"ok": 20}
+    assert win["fault"]["classes"] == {"unavailable": 20}
+    assert win["post"]["classes"] == {"ok": 20}
+    # no successful completion between 2.0 and ~4.8 (the healed ops
+    # at [4, 5) take 0.8s): the unavailability window sees it
+    assert 1.5 <= win["unavailability_s"] <= 3.5
+    # ttr lands when the sliding window clears the 100ms SLO (ops
+    # arriving >= 5.0s), measured from heal at 4.0
+    assert win["time_to_recover_s"] is not None
+    assert 0.5 <= win["time_to_recover_s"] <= 2.5
+
+
+def test_phase_windows_never_recovered_is_none():
+    recs = [{"outcome": "unavailable"}] * 40
+    lat = [1.0] * 40
+    arr = [i / 10.0 for i in range(40)]
+    win = phase_windows(recs, lat, arr, t_inject=1.0, t_heal=2.0,
+                        slo_ms=100.0)
+    assert win["time_to_recover_s"] is None
+    # the whole post-inject span is one unavailability window
+    assert win["unavailability_s"] >= 3.0
+
+
+def test_nemesis_catalog_complete():
+    assert {"partition-ring", "partition-majority", "delay-storm",
+            "kill-leader", "kill-random", "rolling-restart",
+            "partition-kill"} <= set(NEMESES)

@@ -1,0 +1,354 @@
+"""utils/netfault — the network fault plane: rule table semantics,
+the transport/client enforcement seams, fault control surfaces, and
+the ClusterClient partition hardening (bounded-jitter backoff,
+fail-fast typed deadline errors)."""
+
+import json
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+from dgraph_tpu import wire
+from dgraph_tpu.cluster.client import ClusterClient
+from dgraph_tpu.cluster.raft import APPEND_REQ, Msg
+from dgraph_tpu.cluster.transport import TcpTransport
+from dgraph_tpu.utils import metrics, netfault
+from dgraph_tpu.utils.reqctx import DeadlineExceeded
+
+
+@pytest.fixture(autouse=True)
+def _clean_rules():
+    netfault.clear()
+    yield
+    netfault.clear()
+
+
+# ------------------------------------------------------------ rule table
+
+
+def test_inert_by_default_and_clear():
+    assert not netfault.armed()
+    assert netfault.rules() == []
+    netfault.add_rule({"dst": "*", "drop": 1.0})
+    assert netfault.armed()
+    netfault.clear()
+    assert not netfault.armed()
+    # act() on an empty table (callers gate on armed(), but direct
+    # calls must be safe too)
+    assert netfault.act("h:1") is None
+
+
+def test_exact_dst_beats_wildcard_and_lists_match():
+    netfault.add_rule({"dst": "*", "delay_ms": 0.1})
+    netfault.add_rule({"dst": ["h:1", "h:2"], "drop": 1.0})
+    assert netfault.act("h:1") == netfault.DROP
+    assert netfault.act(("h", 2)) == netfault.DROP
+    assert netfault.act("h:3") is None  # wildcard delay, no verdict
+
+
+def test_validation_rejects_inert_and_bad_rules():
+    with pytest.raises(ValueError):
+        netfault.add_rule({"dst": "h:1"})  # no effect configured
+    with pytest.raises(ValueError):
+        netfault.set_rules([{"dst": "h:1", "drop": 1.0}, {"dst": "x"}])
+    # atomic set: the failed batch armed nothing
+    assert not netfault.armed()
+    # probabilities clamp instead of arming nonsense
+    netfault.add_rule({"dst": "h:1", "drop": 7.5})
+    assert netfault.rules()[0]["drop"] == 1.0
+
+
+def test_set_rules_replaces_and_remove_targets_one():
+    a = netfault.add_rule({"dst": "h:1", "drop": 1.0})
+    netfault.set_rules([{"id": "keep", "dst": "h:2", "drop": 1.0}])
+    assert [r["id"] for r in netfault.rules()] == ["keep"]
+    assert not netfault.remove(a)  # replaced away
+    assert netfault.remove("keep")
+    assert not netfault.armed()
+
+
+def test_seeded_rolls_replay_and_count_metrics():
+    shed0 = metrics.snapshot()["counters"].get(
+        "dgraph_net_fault_drops_total", 0)
+    netfault.seed(7)
+    netfault.add_rule({"dst": "*", "drop": 0.5})
+    seq1 = [netfault.act("x:1") for _ in range(32)]
+    netfault.seed(7)
+    seq2 = [netfault.act("x:1") for _ in range(32)]
+    assert seq1 == seq2
+    drops = seq1.count(netfault.DROP)
+    assert 0 < drops < 32
+    got = metrics.snapshot()["counters"]["dgraph_net_fault_drops_total"]
+    assert got - shed0 == 2 * drops
+    assert metrics.snapshot()["gauges"][
+        "dgraph_net_fault_rules"] == 1.0
+
+
+def test_delay_sleeps_and_dup_verdict():
+    netfault.add_rule({"dst": "d:1", "delay_ms": 20})
+    t0 = time.monotonic()
+    assert netfault.act("d:1") is None
+    assert time.monotonic() - t0 >= 0.018
+    netfault.clear()
+    netfault.add_rule({"dst": "d:1", "dup": 1.0})
+    assert netfault.act("d:1") == netfault.DUP
+
+
+def test_env_arming_and_control_dispatch():
+    netfault.arm_from_env('[{"dst": "e:1", "drop": 1.0}]')
+    assert netfault.act("e:1") == netfault.DROP
+    netfault.arm_from_env("")  # empty leaves the table alone
+    assert netfault.armed()
+    out = netfault.handle_control({"action": "clear"})
+    assert out["rules"] == []
+    out = netfault.handle_control(
+        {"action": "add", "rule": {"dst": "e:2", "drop": 1.0}})
+    assert out["rules"][0]["dst"] == ["e:2"]
+    out = netfault.handle_control(
+        {"action": "remove", "id": out["rules"][0]["id"]})
+    assert out["rules"] == []
+    with pytest.raises(ValueError):
+        netfault.handle_control({"action": "explode"})
+
+
+# ------------------------------------------------- transport enforcement
+
+
+def _pair():
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    peers = {1: ("127.0.0.1", ports[0]), 2: ("127.0.0.1", ports[1])}
+    got: list[Msg] = []
+    t1 = TcpTransport(1, peers, lambda m: None)
+    t2 = TcpTransport(2, peers, got.append)
+    t1.start()
+    t2.start()
+    return t1, t2, got, peers
+
+
+def _wait(pred, timeout_s=5.0):
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_transport_drop_cut_and_heal():
+    t1, t2, got, peers = _pair()
+    try:
+        msg = Msg(APPEND_REQ, 1, 2, 1)
+        assert t1.send(msg) is True
+        assert _wait(lambda: len(got) == 1)
+        drops0 = metrics.snapshot()["counters"].get(
+            "raft_send_drops", 0)
+        netfault.add_rule(
+            {"dst": f"127.0.0.1:{peers[2][1]}", "drop": 1.0})
+        assert t1.send(msg) is False  # cut at the seam, no socket IO
+        assert metrics.snapshot()["counters"]["raft_send_drops"] \
+            == drops0 + 1
+        netfault.clear()  # heal
+        assert t1.send(msg) is True
+        assert _wait(lambda: len(got) == 2)
+    finally:
+        t1.close()
+        t2.close()
+
+
+def test_transport_duplicate_delivers_twice():
+    t1, t2, got, peers = _pair()
+    try:
+        netfault.add_rule(
+            {"dst": f"127.0.0.1:{peers[2][1]}", "dup": 1.0})
+        assert t1.send(Msg(APPEND_REQ, 1, 2, 1)) is True
+        assert _wait(lambda: len(got) == 2), got
+    finally:
+        t1.close()
+        t2.close()
+
+
+# ---------------------------------------------- client seam + hardening
+
+
+def _echo_server():
+    """Minimal wire server: answers every framed request with ok."""
+    lst = socket.socket()
+    lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(8)
+
+    def serve(conn):
+        try:
+            while True:
+                req = wire.loads(wire.read_frame(conn))
+                wire.write_frame(conn, wire.dumps(
+                    {"ok": True, "result": {"echo": req.get("op")}}))
+        except (EOFError, OSError, wire.WireError):
+            conn.close()
+
+    def accept():
+        while True:
+            try:
+                conn, _ = lst.accept()
+            except OSError:
+                return
+            threading.Thread(target=serve, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=accept, daemon=True).start()
+    return lst, lst.getsockname()
+
+
+def test_client_partition_fails_fast_typed_and_heals():
+    lst, addr = _echo_server()
+    cl = ClusterClient({1: addr}, timeout=30.0)
+    try:
+        assert cl.request({"op": "ping"})["ok"]
+        # cut the link CLIENT-side: even the pooled conn must not be
+        # used; a deadline-bounded request fails TYPED well before the
+        # client's 30s default timeout could hang the caller
+        netfault.add_rule(
+            {"dst": f"{addr[0]}:{addr[1]}", "drop": 1.0})
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            cl._unwrap(cl.request({"op": "ping"}, deadline_s=0.4))
+        dt = time.monotonic() - t0
+        assert 0.3 <= dt < 2.0, dt
+        netfault.clear()  # heal: the next request redials and works
+        assert cl.request({"op": "ping"})["ok"]
+    finally:
+        cl.close()
+        lst.close()
+
+
+def test_backoff_is_bounded_jittered_and_grows():
+    rng = random.Random(1)
+    b0 = [ClusterClient._backoff_s(0, rng) for _ in range(50)]
+    # pass 0: half to one BASE — near-instant first retry
+    assert all(ClusterClient.BACKOFF_BASE_S * 0.5 <= b
+               <= ClusterClient.BACKOFF_BASE_S for b in b0)
+    assert len(set(b0)) > 1  # jittered, not a lockstep stampede
+    grown = [ClusterClient._backoff_s(p, rng) for p in range(20)]
+    assert max(grown) <= ClusterClient.BACKOFF_CAP_S
+    # by pass 10 the cap dominates: every roll is at least CAP/2
+    assert all(ClusterClient._backoff_s(10, rng)
+               >= ClusterClient.BACKOFF_CAP_S * 0.5
+               for _ in range(20))
+    # huge pass counts must not overflow into absurd sleeps
+    assert ClusterClient._backoff_s(10_000, rng) \
+        <= ClusterClient.BACKOFF_CAP_S
+
+
+# ----------------------------------------------- control + observability
+
+
+def test_debug_http_fault_control_roundtrip():
+    from dgraph_tpu.server.debug_http import serve_debug
+    import http.client
+
+    httpd, port = serve_debug(node_name="testnode")
+    try:
+        def call(method, body=None):
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10)
+            try:
+                conn.request(method, "/debug/fault",
+                             body=json.dumps(body) if body else None)
+                r = conn.getresponse()
+                return r.status, json.loads(r.read())
+            finally:
+                conn.close()
+
+        status, out = call("GET")
+        assert status == 200 and out["rules"] == []
+        status, out = call("POST", {"action": "add", "rule": {
+            "dst": "h:9", "drop": 1.0}})
+        assert status == 200 and out["rules"][0]["dst"] == ["h:9"]
+        assert out["node"] == "testnode"
+        status, out = call("GET")
+        assert len(out["rules"]) == 1
+        status, out = call("POST", {"action": "explode"})
+        assert status == 400
+        status, out = call("POST", {"action": "clear"})
+        assert status == 200 and out["rules"] == []
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def _stub_server():
+    """A RaftServer stub with just enough attrs for the pure
+    payload/dispatch methods under test — no sockets, no raft."""
+    from dgraph_tpu.cluster.service import RaftServer
+
+    srv = object.__new__(RaftServer)
+    srv.lock = threading.RLock()
+    srv.id = 1
+    srv.members = {1: ("h", 1), 2: ("h", 2), 3: ("h", 3)}
+    srv._last_heard = {}
+    return srv
+
+
+def test_peer_ages_and_fault_wire_op():
+    from dgraph_tpu.cluster.service import RaftServer
+
+    srv = _stub_server()
+    ages = RaftServer.peer_ages(srv)
+    assert ages == {"2": None, "3": None}  # never heard, self absent
+    srv._last_heard[2] = time.monotonic() - 1.0
+    ages = RaftServer.peer_ages(srv)
+    assert ages["3"] is None and 0.5 < ages["2"] < 10.0
+
+    resp = RaftServer.handle_conf_request(srv, {
+        "op": "fault", "action": "add",
+        "rule": {"dst": "h:2", "drop": 1.0}})
+    assert resp["ok"] and len(resp["result"]["rules"]) == 1
+    resp = RaftServer.handle_conf_request(srv, {
+        "op": "fault", "action": "explode"})
+    assert not resp["ok"] and "bad fault control" in resp["error"]
+    resp = RaftServer.handle_conf_request(srv, {
+        "op": "fault", "action": "clear"})
+    assert resp["ok"] and resp["result"]["rules"] == []
+
+
+def test_debug_stats_payload_carries_fault_plane():
+    from dgraph_tpu.cluster.service import RaftServer
+
+    srv = _stub_server()
+    srv.node_name = "stub-n1"
+    netfault.add_rule({"dst": "h:2", "drop": 1.0})
+    out = RaftServer.debug_stats_payload(srv)
+    assert out["netfault"][0]["dst"] == ["h:2"]
+    assert set(out["lastHeard"]) == {"2", "3"}
+
+
+def test_dgtop_renders_fault_columns():
+    import sys as _sys
+    _sys.path.insert(0, "tools") if "tools" not in _sys.path else None
+    from tools import dgtop
+
+    snap = {"stats": {
+        "netfault": [{"id": "r1", "dst": ["a:1"], "drop": 1.0,
+                      "delay_ms": 0, "jitter_ms": 0, "dup": 0}],
+        "lastHeard": {"2": 3.5, "3": None},
+        "counters": {}, "gauges": {}, "tablets": {}},
+        "requests": {}, "t": 1.0}
+    row = dgtop.node_row(snap, None)
+    assert row["faults"] == 1 and row["heard_max"] == 3.5
+    frame = dgtop.render({"n1": snap})
+    assert "FLT" in frame and "HEARD" in frame
+    assert "ACTIVE FAULT RULES" in frame and "r1 @ n1" in frame
+    # no faults, no section; missing keys render dashes not crashes
+    bare = {"stats": {"counters": {}, "gauges": {}, "tablets": {}},
+            "requests": {}, "t": 1.0}
+    frame = dgtop.render({"n1": bare})
+    assert "ACTIVE FAULT RULES" not in frame
+    assert dgtop.node_row(bare, None)["heard_max"] is None

@@ -1,0 +1,223 @@
+"""Storage crash paths: WAL torn-tail truncation, mid-record CRC
+corruption recovery, and deterministic restart-with-existing-dirs
+replica catch-up at the in-process harness level (SimCluster +
+DiskStorage — the non-subprocess half of what tools/dgchaos.py's
+kill/restart nemeses exercise against real processes)."""
+
+import os
+import struct
+
+import pytest
+
+from dgraph_tpu.cluster.harness import SimCluster
+from dgraph_tpu.cluster.raft import LEADER, DiskStorage
+from dgraph_tpu.storage.wal import _MAGIC, Wal
+from dgraph_tpu.utils import failpoint
+
+# ------------------------------------------------------------ WAL frames
+
+
+def _frames(path):
+    """Parse (offset, length, payload) per framed record — format
+    shared by both WAL backends (u32 len | u32 crc | payload)."""
+    out = []
+    with open(path, "rb") as f:
+        data = f.read()
+    at = len(_MAGIC)
+    while at + 8 <= len(data):
+        n, _crc = struct.unpack_from("<II", data, at)
+        out.append((at, 8 + n, data[at + 8:at + 8 + n]))
+        at += 8 + n
+    return out
+
+
+def _wal_with(path, records):
+    w = Wal(path)
+    for r in records:
+        w.append(r)
+    w.close()
+
+
+def test_torn_tail_truncates_and_reopens(tmp_path):
+    path = str(tmp_path / "wal")
+    _wal_with(path, [("rec", 1), ("rec", 2), ("rec", 3)])
+    frames = _frames(path)
+    assert len(frames) == 3
+    # crash mid-write of record 3: half its frame is on disk
+    torn_at = frames[2][0] + frames[2][1] // 2
+    with open(path, "rb+") as f:
+        f.truncate(torn_at)
+
+    w = Wal(path)
+    assert list(w.replay()) == [("rec", 1), ("rec", 2)]
+    # the torn tail was TRUNCATED, not just skipped: the file ends at
+    # the last good frame, so a post-recovery append can never leave
+    # garbage between records
+    assert os.path.getsize(path) == frames[2][0]
+    w.append(("rec", "post-crash"))
+    w.close()
+    w = Wal(path)
+    assert list(w.replay()) == [("rec", 1), ("rec", 2),
+                                ("rec", "post-crash")]
+    w.close()
+
+
+def test_torn_header_only_tail(tmp_path):
+    path = str(tmp_path / "wal")
+    _wal_with(path, [("a",), ("b",)])
+    frames = _frames(path)
+    # crash after 3 bytes of the next frame HEADER
+    with open(path, "ab") as f:
+        f.write(b"\x99\x00\x00")
+    w = Wal(path)
+    assert list(w.replay()) == [("a",), ("b",)]
+    assert os.path.getsize(path) == frames[1][0] + frames[1][1]
+    w.close()
+
+
+def test_mid_record_crc_corruption_recovers_prefix(tmp_path):
+    path = str(tmp_path / "wal")
+    _wal_with(path, [("rec", 1), ("rec", 2), ("rec", 3)])
+    frames = _frames(path)
+    # a bit-rotted byte INSIDE record 2's payload: length is intact,
+    # the CRC is not — replay must stop at the corruption (records
+    # past it are unrecoverable: framing is only trustworthy up to
+    # the last valid CRC) and truncate so the store heals
+    off = frames[1][0] + 8 + frames[1][1] // 3
+    with open(path, "rb+") as f:
+        f.seek(off)
+        byte = f.read(1)
+        f.seek(off)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+    w = Wal(path)
+    assert list(w.replay()) == [("rec", 1)]
+    assert os.path.getsize(path) == frames[1][0]
+    w.append(("rec", "healed"))
+    w.close()
+    w = Wal(path)
+    assert list(w.replay()) == [("rec", 1), ("rec", "healed")]
+    w.close()
+
+
+def test_corrupt_length_field_cannot_overread(tmp_path):
+    path = str(tmp_path / "wal")
+    _wal_with(path, [("rec", 1), ("rec", 2)])
+    frames = _frames(path)
+    # the length field itself rots to a huge value: replay must treat
+    # it as a torn tail (short read), never allocate/scan past EOF
+    with open(path, "rb+") as f:
+        f.seek(frames[1][0])
+        f.write(struct.pack("<I", 1 << 30))
+    w = Wal(path)
+    assert list(w.replay()) == [("rec", 1)]
+    assert os.path.getsize(path) == frames[1][0]
+    w.close()
+
+
+@pytest.mark.failpoint
+def test_wal_append_failpoint_models_dying_disk(tmp_path):
+    """The new `wal.append` chaos seam: an armed error fails
+    durability BEFORE any bytes frame (the record never half-lands),
+    and recovery after disarm appends cleanly."""
+    path = str(tmp_path / "wal")
+    w = Wal(path)
+    try:
+        w.append(("ok", 1))
+        failpoint.arm("wal.append", "error(disk died)")
+        with pytest.raises(failpoint.FailpointError):
+            w.append(("lost", 2))
+        failpoint.clear()
+        w.append(("ok", 3))
+        w.close()
+        w = Wal(path)
+        assert list(w.replay()) == [("ok", 1), ("ok", 3)]
+        w.close()
+    finally:
+        failpoint.clear()
+
+
+def test_new_chaos_sites_registered():
+    """The expanded failpoint registry (dglint DG08's source of
+    truth) carries the storage/2PC seams, no dupes."""
+    for site in ("wal.append", "snapshot.install", "txn.xstage",
+                 "txn.xfinalize", "transport.send", "tablet.apply",
+                 "executor.level"):
+        assert site in failpoint.SITES
+    assert len(set(failpoint.SITES)) == len(failpoint.SITES)
+
+
+# ----------------------------------- restart-with-dirs replica catch-up
+
+
+def test_replica_restart_existing_dirs_catches_up(tmp_path):
+    """The kill/restart nemesis contract, deterministically: a
+    DiskStorage-backed replica is killed, the survivors commit more
+    AND compact below its log tail, then the replica reboots onto its
+    EXISTING dirs — it must re-load its persisted hardstate, take the
+    leader's snapshot for the compacted range, replay the rest, and
+    serve new traffic. Acked writes never disappear."""
+    mk = lambda i: DiskStorage(str(tmp_path / f"n{i}"))
+    restored = {}
+    c = SimCluster(3, storage_factory=mk)
+    c.on_restore = lambda i, data: restored.__setitem__(i, data)
+    c.wait_leader()
+    for i in range(6):
+        assert c.propose(f"pre-{i}")
+    c.pump(3)
+    victim = next(i for i in c.ids if c.nodes[i].role != LEADER)
+    pre_term = c.nodes[victim].term
+    c.kill(victim)
+
+    # progress + compaction while the victim is down
+    for i in range(6):
+        assert c.propose(f"down-{i}")
+    lead = c.leader()
+    c.nodes[lead].take_snapshot({"acked": 12})
+    assert c.nodes[lead].snap_index > 0
+
+    # reboot onto the SAME dirs: a fresh DiskStorage over them
+    c.restart(victim)
+    assert c.nodes[victim].term >= pre_term  # hardstate survived
+    assert c.nodes[victim].last_index() >= 6  # log survived
+    c.pump(40)
+    assert restored.get(victim) == {"acked": 12}
+    assert c.nodes[victim].snap_index == c.nodes[lead].snap_index
+
+    # and the recovered replica keeps replicating
+    assert c.propose("post-restart")
+    c.pump(5)
+    assert c.applied[victim][-1] == "post-restart"
+
+    # the persisted store converged too: ANOTHER restart from the
+    # same dirs must come back at the post-snapshot state, not replay
+    # pre-compaction garbage
+    c.kill(victim)
+    c.restart(victim)
+    c.pump(20)
+    assert c.nodes[victim].snap_index >= c.nodes[lead].snap_index \
+        or c.applied[victim][-1] == "post-restart"
+
+
+def test_restart_all_nodes_from_dirs_preserves_quorum_state(tmp_path):
+    """Full-cluster power loss: every node restarts from its dirs;
+    the quorum re-forms with all acked entries intact (term never
+    regresses, committed entries re-apply)."""
+    mk = lambda i: DiskStorage(str(tmp_path / f"n{i}"))
+    c = SimCluster(3, storage_factory=mk)
+    c.wait_leader()
+    for i in range(5):
+        assert c.propose(f"v{i}")
+    c.pump(3)
+    terms = {i: c.nodes[i].term for i in c.ids}
+    for i in c.ids:
+        c.kill(i)
+    for i in c.ids:
+        c.restart(i)
+    c.wait_leader(400)
+    for i in c.ids:
+        assert c.nodes[i].term >= terms[i]
+    assert c.propose("after-blackout")
+    c.pump(10)
+    for i in c.ids:
+        assert c.applied[i][-1] == "after-blackout"

@@ -56,6 +56,12 @@ echo "== pprof overhead =="
 # DGRAPH_TPU_PPROF_BUDGET overrides)
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --pprof-overhead
 
+echo "== netfault overhead =="
+# the DISARMED network-fault seam on the wire hot paths (one
+# falsy-dict check per send) must cost < 1% of the summary mix
+# (decomposed gate; DGRAPH_TPU_NETFAULT_BUDGET overrides)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --netfault-overhead
+
 echo "== compressed setops =="
 # compressed-vs-dense set algebra sweep: block-descriptor skipping
 # must beat decode-then-intersect on the selective-intersection
@@ -74,5 +80,18 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.dgbench --smoke \
     --report-dir "$SMOKE_DIR" --out "$SMOKE_DIR/BENCH_SMOKE.json"
 test -s "$SMOKE_DIR/dgtop.txt"   # the archived cluster-state artifact
 echo "smoke report: $SMOKE_DIR"
+
+echo "== chaos smoke =="
+# ~45 s nemesis cycle on a 2-group mini cluster with durable dirs
+# (tools/dgchaos.py --smoke): one partition-heal + one SIGKILL-restart
+# under open-loop bank load; exits non-zero on ANY history-checker
+# violation (conservation / monotonic ts / acked-write loss / lost
+# update) or a non-finite time-to-recover after heal.
+CHAOS_DIR="${TMPDIR:-/tmp}/dgchaos-smoke"
+rm -rf "$CHAOS_DIR"   # durable dirs + history are per-run state
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.dgchaos --smoke \
+    --report-dir "$CHAOS_DIR" --out "$CHAOS_DIR/BENCH_CHAOS.json"
+test -s "$CHAOS_DIR/history.jsonl"   # the checked per-op history
+echo "chaos report: $CHAOS_DIR"
 
 echo "ok"

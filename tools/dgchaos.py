@@ -1,0 +1,887 @@
+"""dgchaos: nemesis-driven chaos harness with a history-checked bank.
+
+The reference proves fault tolerance with a Jepsen driver (workloads x
+nemeses: bank + partition-ring / kill-alpha / move-tablet,
+contrib/jepsen/main.go); this is that matrix as a first-class in-tree
+harness against a REAL ProcessCluster (dgraph_tpu/bench/spawn.py) —
+with what Jepsen has and a balance-sum test lacks: a PER-OPERATION
+HISTORY and a checker over it.
+
+The workload is a cross-group bank: `chaos.bal` (accounts) is pinned
+to group 1, `chaos.op` (a write-once transfer ledger) to group 2, so
+EVERY transfer is a cross-group 2PC commit (xstage on both groups ->
+zero's oracle decision -> xfinalize) carrying a unique opid. Readers
+take globally pinned snapshots of all balances. Optional LDBC-style
+noise ops (bench/workload.py) ride the same open-loop schedule.
+
+Every client-observed operation lands in history.jsonl: kind, invoke/
+complete times, the ts it acquired (start_ts/read_ts), commit_ts,
+outcome class. The checker then verifies snapshot-isolation
+invariants a coarse balance sum cannot:
+
+  conservation     every pinned read's balance vector sums to the
+                   opening total (partial 2PC application, stale
+                   snapshots and torn reads all break this)
+  session-monotonic each session's acquired timestamps never go
+                   backwards (a zero that forgot max_ts breaks this)
+  acked-durability every ACKNOWLEDGED transfer's opid is present in
+                   the final ledger (a write acknowledged before a
+                   crash/partition may never disappear after heal)
+  no-lost-update   final balances == opening + the ledger's replayed
+                   deltas, ledger opids unique, and no phantom
+                   entries (an RMW that overwrote a concurrent commit
+                   diverges balances from the ledger)
+
+Nemeses (composable by name on --nemeses): partition-ring,
+partition-majority, partition-client, delay-storm (network faults via
+the {"op":"fault"} wire control -> utils/netfault.py on each node),
+kill-leader, kill-random, rolling-restart (SIGKILL + reboot onto the
+node's existing WAL dirs via ProcessCluster.kill/restart), and
+partition-kill (composite). Each nemesis phase runs pre -> inject ->
+heal -> recovery under one open-loop arrival schedule, and the report
+(BENCH_CHAOS.json) records per-phase unavailability window,
+error-class counts, p99 before/during/after the fault, and
+time-to-recover-to-SLO after heal.
+
+Usage:
+  python -m tools.dgchaos                   # full gate (3 nemeses)
+  python -m tools.dgchaos --smoke           # CI: partition + kill, ~45s
+  python -m tools.dgchaos --nemeses delay-storm,kill-leader --rate 40
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from dgraph_tpu.bench.openloop import (  # noqa: E402
+    latency_summary, run_open_loop,
+)
+from dgraph_tpu.bench.spawn import ProcessCluster  # noqa: E402
+from dgraph_tpu.utils.reqctx import (  # noqa: E402
+    Cancelled, DeadlineExceeded, Overloaded,
+)
+
+OPENING = 100
+
+
+def log(msg: str):
+    sys.stderr.write(f"[dgchaos] {msg}\n")
+    sys.stderr.flush()
+
+
+def classify(exc: Exception) -> str:
+    """Fold an op failure into its error class for the report — the
+    distinction matters: `conflict` and `shed` are the system working
+    as designed, `unavailable`/`deadline` are the fault's shadow, and
+    `error` is a bug candidate."""
+    if isinstance(exc, Overloaded):
+        return "shed"
+    if isinstance(exc, DeadlineExceeded):
+        return "deadline"
+    if isinstance(exc, Cancelled):
+        return "cancelled"
+    msg = str(exc)
+    if "conflict" in msg or "aborted" in msg:
+        return "conflict"
+    if "leader" in msg or "unreachable" in msg or "quorum" in msg \
+            or "retry" in msg or "moved" in msg:
+        return "unavailable"
+    return "error"
+
+
+# ------------------------------------------------------------- the bank
+
+
+class Bank:
+    """Cross-group bank driver recording a per-operation history."""
+
+    def __init__(self, rc, zero_cl, g1, g2, accounts: int,
+                 deadline_ms: int):
+        self.rc = rc
+        self.zero = zero_cl
+        self.g1 = g1
+        self.g2 = g2
+        self.deadline_ms = deadline_ms
+        self.n = accounts
+        self.uids: list[str] = []
+        self.history: list[dict] = []
+        self._hlock = threading.Lock()
+        self._opseq = [0]
+        self._session_seq: dict[int, int] = {}
+        self.t0 = time.monotonic()
+
+    def setup(self):
+        self.rc.alter("chaos.bal: int .\nchaos.op: string .")
+        # the split that makes every transfer cross-group 2PC
+        self.rc.zero.tablet("chaos.bal", 1)
+        self.rc.zero.tablet("chaos.op", 2)
+        for i in range(self.n):
+            out = self.g1.mutate(
+                set_nquads=f'_:a <chaos.bal> "{OPENING}" .')
+            self.uids.append(list(out["uids"].values())[0])
+        # ledger tablet exists before the first transfer stages on it
+        self.g2.mutate(set_nquads='_:z <chaos.op> "seed" .')
+
+    # ------------------------------------------------------ recording
+
+    def _record(self, rec: dict) -> dict:
+        rec["t"] = round(time.monotonic() - self.t0, 4)
+        sid = threading.get_ident()
+        with self._hlock:
+            seq = self._session_seq.get(sid, 0)
+            self._session_seq[sid] = seq + 1
+            rec["session"] = sid
+            rec["seq"] = seq
+            self.history.append(rec)
+        return rec
+
+    def _next_opid(self, a: str, b: str, amt: int) -> str:
+        with self._hlock:
+            self._opseq[0] += 1
+            return f"{a}:{b}:{amt}:{self._opseq[0]}"
+
+    # ----------------------------------------------------------- ops
+
+    def _read_bal(self, cl, uid: str, ts: int):
+        got = cl.query('{ q(func: uid(%s)) { chaos.bal } }' % uid,
+                       read_ts=ts, deadline_ms=self.deadline_ms)
+        rows = got["data"]["q"]
+        return rows[0]["chaos.bal"] if rows else None
+
+    def transfer(self, rng: random.Random) -> dict:
+        a, b = rng.sample(self.uids, 2)
+        amt = rng.randrange(1, 10)
+        t0 = time.monotonic()
+        rec = {"kind": "transfer", "a": a, "b": b, "amt": amt}
+        opid = None
+        try:
+            start_ts = self.zero.assign_ts(1)
+            rec["start_ts"] = start_ts
+            x = self._read_bal(self.g1, a, start_ts)
+            y = self._read_bal(self.g1, b, start_ts)
+            if x is None or y is None:
+                rec["outcome"] = "skip"
+                return self._record(rec)
+            opid = self._next_opid(a, b, amt)
+            rec["opid"] = opid
+            out = self.rc.mutate(start_ts=start_ts, set_nquads=(
+                f'<{a}> <chaos.bal> "{x - amt}" .\n'
+                f'<{b}> <chaos.bal> "{y + amt}" .\n'
+                f'_:op <chaos.op> "{opid}" .'))
+            rec["commit_ts"] = int(
+                out["extensions"]["txn"]["commit_ts"])
+            rec["outcome"] = "ok"
+        except Exception as e:  # noqa: BLE001 — classified history
+            rec["outcome"] = classify(e)
+            rec["error"] = f"{type(e).__name__}: {e}"[:200]
+            if opid is not None and rec["outcome"] != "conflict":
+                # the mutate MAY have committed (ack lost to the
+                # nemesis): Jepsen's :info — the checker must accept
+                # the ledger with or without it
+                rec["indeterminate"] = True
+        finally:
+            rec["lat_s"] = round(time.monotonic() - t0, 4)
+        return self._record(rec)
+
+    def read(self) -> dict:
+        t0 = time.monotonic()
+        rec = {"kind": "read"}
+        try:
+            ts = self.zero.assign_ts(1)
+            rec["read_ts"] = ts
+            got = self.g1.query(
+                '{ q(func: has(chaos.bal)) { chaos.bal } }',
+                read_ts=ts, deadline_ms=self.deadline_ms)
+            rows = got["data"]["q"]
+            rec["balances"] = sorted(r["chaos.bal"] for r in rows)
+            rec["outcome"] = "ok"
+        except Exception as e:  # noqa: BLE001 — classified history
+            rec["outcome"] = classify(e)
+            rec["error"] = f"{type(e).__name__}: {e}"[:200]
+        finally:
+            rec["lat_s"] = round(time.monotonic() - t0, 4)
+        return self._record(rec)
+
+    def final_state(self, retries: int = 60) -> tuple[dict, list]:
+        """Post-heal ground truth: per-account balances and the full
+        ledger at one pinned ts, retried until the cluster serves it
+        (recovery may still be reconciling pendings)."""
+        last: Exception | None = None
+        for _ in range(retries):
+            try:
+                ts = self.zero.assign_ts(1)
+                bals = {}
+                for uid in self.uids:
+                    got = self.g1.query(
+                        '{ q(func: uid(%s)) { chaos.bal } }' % uid,
+                        read_ts=ts, deadline_ms=10_000)
+                    bals[uid] = got["data"]["q"][0]["chaos.bal"]
+                got = self.g2.query(
+                    '{ q(func: has(chaos.op)) { chaos.op } }',
+                    read_ts=ts, deadline_ms=10_000)
+                ledger = [r["chaos.op"] for r in got["data"]["q"]
+                          if r["chaos.op"] != "seed"]
+                return bals, ledger
+            except Exception as e:  # noqa: BLE001 — retry recovery
+                last = e
+                time.sleep(0.5)
+        raise RuntimeError(
+            f"cluster never served the final state: {last}")
+
+
+# ------------------------------------------------------------ checker
+
+
+def check_history(history: list[dict], final_bals: dict,
+                  ledger: list[str], accounts: int) -> dict:
+    """Verify the snapshot-isolation invariants over one run's
+    history + post-heal ground truth. Pure — unit tests feed it
+    synthetic histories. Returns {ok, violations: [...], stats}."""
+    violations: list[str] = []
+    opening_total = accounts * OPENING
+
+    # 1. conservation at every pinned read. Every read happens after
+    # setup seeded all accounts, so a successful full-scan returning
+    # FEWER rows is itself a violation (a torn/short snapshot), not a
+    # skippable partial — and extra rows mean stale state leaked in
+    # (e.g. a durable dir reused across runs).
+    full_reads = 0
+    for rec in history:
+        if rec.get("kind") != "read" or rec.get("outcome") != "ok":
+            continue
+        bals = rec.get("balances", ())
+        if len(bals) != accounts:
+            violations.append(
+                f"short-read: read at ts {rec.get('read_ts')} saw "
+                f"{len(bals)} accounts, expected {accounts}")
+            continue
+        full_reads += 1
+        if sum(bals) != opening_total:
+            violations.append(
+                f"conservation: read at ts {rec.get('read_ts')} "
+                f"totals {sum(bals)} != {opening_total}")
+
+    # 2. per-session monotonic timestamps (acquisition order)
+    by_session: dict[int, list[tuple[int, int]]] = {}
+    for rec in history:
+        ts = rec.get("start_ts", rec.get("read_ts"))
+        if ts is None:
+            continue
+        by_session.setdefault(rec["session"], []).append(
+            (rec["seq"], ts))
+    for sid, seqs in by_session.items():
+        seqs.sort()
+        for (s1, t1), (s2, t2) in zip(seqs, seqs[1:]):
+            if t2 < t1:
+                violations.append(
+                    f"session-monotonic: session {sid} got ts {t2} "
+                    f"(seq {s2}) after {t1} (seq {s1})")
+
+    # 3. acked transfers never disappear; 4. ledger replay matches
+    ledger_set = set(ledger)
+    if len(ledger_set) != len(ledger):
+        violations.append("ledger: duplicate opids "
+                          f"({len(ledger)} entries, "
+                          f"{len(ledger_set)} unique)")
+    acked, maybe = set(), set()
+    for rec in history:
+        if rec.get("kind") != "transfer" or "opid" not in rec:
+            continue
+        if rec["outcome"] == "ok":
+            acked.add(rec["opid"])
+        elif rec.get("indeterminate"):
+            maybe.add(rec["opid"])
+    lost = acked - ledger_set
+    for opid in sorted(lost):
+        violations.append(f"acked-durability: transfer {opid} was "
+                          "acknowledged but is missing from the "
+                          "final ledger")
+    phantom = ledger_set - acked - maybe
+    for opid in sorted(phantom):
+        violations.append(f"ledger: phantom entry {opid} (never "
+                          "submitted or already-aborted)")
+
+    if final_bals:
+        replay = {uid: OPENING for uid in final_bals}
+        bad_entry = False
+        for opid in ledger_set:
+            try:
+                a, b, amt, _ = opid.rsplit(":", 3)
+                replay[a] -= int(amt)
+                replay[b] += int(amt)
+            except (ValueError, KeyError):
+                violations.append(f"ledger: unparseable opid {opid!r}")
+                bad_entry = True
+        if not bad_entry and replay != final_bals:
+            diff = {u: (replay[u], final_bals[u])
+                    for u in final_bals if replay[u] != final_bals[u]}
+            violations.append(
+                "no-lost-update: ledger replay diverges from final "
+                f"balances (replayed, actual) by uid: {diff}")
+
+    counts: dict[str, int] = {}
+    for rec in history:
+        counts[rec.get("outcome", "?")] = \
+            counts.get(rec.get("outcome", "?"), 0) + 1
+    return {"ok": not violations, "violations": violations,
+            "stats": {"ops": len(history), "full_reads": full_reads,
+                      "acked_transfers": len(acked),
+                      "indeterminate": len(maybe),
+                      "ledger_entries": len(ledger),
+                      "outcomes": counts}}
+
+
+# ---------------------------------------------------- recovery metrics
+
+
+def phase_windows(recs: list[dict], lat: list[float],
+                  arrivals: list[float], t_inject: float,
+                  t_heal: float, slo_ms: float,
+                  window_s: float = 2.0, success_frac: float = 0.9
+                  ) -> dict:
+    """Fold one nemesis phase's aligned (history rec, latency,
+    scheduled arrival) triples into the report row: per-window latency
+    summaries, error classes, the unavailability window, and
+    time-to-recover-to-SLO after heal. Pure — unit-tested."""
+    def summarize(sel):
+        ok = [lat[i] for i in sel if recs[i].get("outcome") == "ok"]
+        classes: dict[str, int] = {}
+        for i in sel:
+            o = recs[i].get("outcome", "?")
+            classes[o] = classes.get(o, 0) + 1
+        return {"ok": latency_summary(ok), "classes": classes}
+
+    idx = range(len(recs))
+    pre = [i for i in idx if arrivals[i] < t_inject]
+    fault = [i for i in idx if t_inject <= arrivals[i] < t_heal]
+    post = [i for i in idx if arrivals[i] >= t_heal]
+
+    # unavailability: the longest gap between successful COMPLETIONS
+    # inside [t_inject, end] (edges count: a fault that kills every
+    # op until heal scores the whole window)
+    done = sorted(arrivals[i] + lat[i] for i in idx
+                  if recs[i].get("outcome") == "ok"
+                  and arrivals[i] + lat[i] >= t_inject)
+    end_t = max((arrivals[i] + lat[i] for i in idx), default=t_heal)
+    marks = [t_inject] + done + [end_t]
+    unavail = max((b - a for a, b in zip(marks, marks[1:])),
+                  default=0.0)
+
+    # time-to-recover: first post-heal sliding window where p99 <= SLO
+    # and the success fraction holds, measured from t_heal. Tail
+    # windows may be partial but must hold enough ops that one lucky
+    # request can't declare victory.
+    ttr = None
+    t = t_heal
+    while t < end_t:
+        win = [i for i in idx if t <= arrivals[i] < t + window_s]
+        if len(win) >= 3:
+            ok = [lat[i] for i in win
+                  if recs[i].get("outcome") == "ok"]
+            frac = len(ok) / len(win)
+            p99 = latency_summary(ok).get("p99_ms") if ok else None
+            if ok and frac >= success_frac and p99 <= slo_ms:
+                ttr = round(t - t_heal, 3)
+                break
+        t += 0.5
+    return {
+        "pre": summarize(pre), "fault": summarize(fault),
+        "post": summarize(post),
+        "unavailability_s": round(unavail, 3),
+        "time_to_recover_s": ttr,
+        "slo_ms": slo_ms,
+    }
+
+
+# ------------------------------------------------------------- nemeses
+
+
+class Nemesis:
+    """One fault schedule: inject(), then heal(). The harness drives
+    the timing; subclasses only know how to break and fix things."""
+
+    name = "?"
+
+    def __init__(self, ctx: dict):
+        self.ctx = ctx
+
+    def inject(self):
+        raise NotImplementedError
+
+    def heal(self):
+        raise NotImplementedError
+
+    # ---- fault-table plumbing -------------------------------------
+
+    def _fault(self, node: str, req: dict):
+        cl = self.ctx["node_clients"][node]
+        got = cl._rpc_once(1, dict(req, op="fault"))
+        if not got or not got.get("ok"):
+            raise RuntimeError(f"fault control on {node}: {got}")
+        return got["result"]
+
+    def _addrs_of(self, node: str) -> list[str]:
+        info = self.ctx["cluster"].node_addrs[node]
+        return [f"{h}:{p}" for h, p in (info["raft"], info["client"])]
+
+    def _cut(self, a: str, b: str):
+        """Symmetric partition between nodes a and b: each drops all
+        fresh outbound traffic to the other's listeners."""
+        self._fault(a, {"action": "add", "rule": {
+            "dst": self._addrs_of(b), "drop": 1.0}})
+        self._fault(b, {"action": "add", "rule": {
+            "dst": self._addrs_of(a), "drop": 1.0}})
+
+    def _clear_all(self):
+        for node in self.ctx["node_clients"]:
+            try:
+                self._fault(node, {"action": "clear"})
+            except RuntimeError as e:
+                log(f"heal: clear on {node} failed: {e}")
+
+
+class PartitionRing(Nemesis):
+    """Every node cut from its ring neighbor (the reference's
+    partition-ring nemesis): no majority component loses quorum, but
+    every quorum loses SOME link — the leader-routing/retry stress."""
+
+    name = "partition-ring"
+
+    def inject(self):
+        nodes = sorted(self.ctx["cluster"].node_addrs)
+        for i, node in enumerate(nodes):
+            self._cut(node, nodes[(i + 1) % len(nodes)])
+
+    def heal(self):
+        self._clear_all()
+
+
+class PartitionMajority(Nemesis):
+    """Isolate a minority of the largest alpha group from EVERY other
+    node (one-sided rules on both sides): the majority keeps serving,
+    the minority's ex-leader must fail pinned reads rather than serve
+    stale snapshots."""
+
+    name = "partition-majority"
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        cluster = ctx["cluster"]
+        groups = {}
+        for name in cluster.node_addrs:
+            if name.startswith("alpha-"):
+                groups.setdefault(name.split("-")[1], []).append(name)
+        gid, members = max(groups.items(),
+                           key=lambda kv: (len(kv[1]), kv[0]))
+        self.victims = sorted(members)[:max(1, (len(members) - 1) // 2)]
+
+    def inject(self):
+        others = [n for n in self.ctx["cluster"].node_addrs
+                  if n not in self.victims]
+        for v in self.victims:
+            for o in others:
+                self._cut(v, o)
+
+    def heal(self):
+        self._clear_all()
+
+
+class DelayStorm(Nemesis):
+    """Every inter-node link slowed by a fixed+jitter delay: nothing
+    is down, everything is late — the SLO-degradation nemesis."""
+
+    name = "delay-storm"
+
+    def inject(self):
+        for node in self.ctx["node_clients"]:
+            self._fault(node, {"action": "add", "rule": {
+                "dst": "*", "delay_ms": 25.0, "jitter_ms": 25.0}})
+
+    def heal(self):
+        self._clear_all()
+
+
+class KillLeader(Nemesis):
+    """SIGKILL group 1's leader under load; heal restarts it onto its
+    existing WAL dirs and waits for catch-up."""
+
+    name = "kill-leader"
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self.victim = None
+
+    def inject(self):
+        cluster = self.ctx["cluster"]
+        self.victim = cluster.leader_of("g1")
+        log(f"{self.name}: SIGKILL {self.victim}")
+        cluster.kill(self.victim)
+
+    def heal(self):
+        cluster = self.ctx["cluster"]
+        cluster.restart(self.victim)
+        st = cluster.wait_caught_up(self.victim)
+        log(f"{self.name}: {self.victim} caught up "
+            f"(applied={st.get('applied')})")
+
+
+class KillRandom(KillLeader):
+    """SIGKILL a seeded-random alpha (leader or follower)."""
+
+    name = "kill-random"
+
+    def inject(self):
+        cluster = self.ctx["cluster"]
+        alphas = sorted(n for n in cluster.node_addrs
+                        if n.startswith("alpha-"))
+        self.victim = self.ctx["rng"].choice(alphas)
+        log(f"{self.name}: SIGKILL {self.victim}")
+        cluster.kill(self.victim)
+
+
+class RollingRestart(Nemesis):
+    """SIGKILL + restart every alpha in turn, waiting for catch-up
+    between victims — the rolling-upgrade shape; the fault IS the
+    heal, so heal() is a no-op."""
+
+    name = "rolling-restart"
+
+    def inject(self):
+        cluster = self.ctx["cluster"]
+        for name in sorted(n for n in cluster.node_addrs
+                           if n.startswith("alpha-")):
+            log(f"{self.name}: cycling {name}")
+            cluster.kill(name)
+            time.sleep(0.5)
+            cluster.restart(name)
+            cluster.wait_caught_up(name)
+
+    def heal(self):
+        pass
+
+
+class PartitionKill(Nemesis):
+    """Composite: partition-ring, then kill group 1's leader inside
+    the partition — recovery must untangle both at heal."""
+
+    name = "partition-kill"
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self.ring = PartitionRing(ctx)
+        self.kill = KillLeader(ctx)
+
+    def inject(self):
+        self.ring.inject()
+        time.sleep(1.0)
+        self.kill.inject()
+
+    def heal(self):
+        self.ring.heal()
+        self.kill.heal()
+
+
+NEMESES = {cls.name: cls for cls in (
+    PartitionRing, PartitionMajority, DelayStorm, KillLeader,
+    KillRandom, RollingRestart, PartitionKill)}
+
+
+# ---------------------------------------------------------------- main
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="dgchaos", description=__doc__.split("\n\n")[0])
+    ap.add_argument("--groups", type=int, default=2)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--zeros", type=int, default=1)
+    ap.add_argument("--accounts", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=20260803)
+    ap.add_argument("--rate", type=float, default=30.0,
+                    help="offered ops/s over the whole schedule")
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--deadline-ms", type=int, default=3000)
+    ap.add_argument("--slo-ms", type=float, default=1500.0,
+                    help="the p99 recovery target TTR is measured to")
+    ap.add_argument("--pre-s", type=float, default=5.0)
+    ap.add_argument("--fault-s", type=float, default=8.0)
+    ap.add_argument("--recover-s", type=float, default=15.0)
+    ap.add_argument("--nemeses", default=(
+        "partition-majority,kill-leader,rolling-restart"),
+        help=f"comma list from: {','.join(sorted(NEMESES))}")
+    ap.add_argument("--ldbc-persons", type=int, default=60,
+                    help="seeded LDBC-style noise graph size; 0 = "
+                         "bank only")
+    ap.add_argument("--read-frac", type=float, default=0.3)
+    ap.add_argument("--report-dir", default="bench_chaos_report")
+    ap.add_argument("--out", default=os.path.join(
+        _REPO, "BENCH_CHAOS.json"))
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI mini run: 2 groups x 1 replica, one "
+                         "partition-heal + one kill-restart cycle, "
+                         "~45 s wall, non-zero exit on any checker "
+                         "violation or non-finite recovery")
+    return ap
+
+
+def _noise_ops(args, rc):
+    """Seeded LDBC-style read noise against the same cluster (write
+    churn stays off: the checker owns all writes)."""
+    if not args.ldbc_persons:
+        return None
+    from dgraph_tpu.bench.workload import Workload, WorkloadConfig
+    w = Workload(WorkloadConfig(seed=args.seed,
+                                persons=args.ldbc_persons))
+    rc.alter(w.schema())
+    from tools.dgbench import claim_tablets, load_graph
+    # colocate traversal bundles like dgbench: without the pre-claim,
+    # per-predicate load batches scatter the bundles across groups and
+    # multi-hop noise reads degrade into span-groups errors
+    claim_tablets(rc, len(rc.groups), w)
+    n = load_graph(rc, w)
+    log(f"noise graph: {n} quads")
+    reads = [op for op in w.ops(4000, stream_seed=7) if not op.write]
+    return reads
+
+
+def run_nemesis_phase(args, bank: Bank, nem: Nemesis, rng,
+                      noise_reads, phase_ix: int) -> dict:
+    """One open-loop phase: pre -> inject -> fault -> heal ->
+    recovery, with the nemesis driven from a side thread while the
+    arrival schedule never stops."""
+    # rolling-restart's fault window IS its work (kill + reboot +
+    # catch-up per replica): size the schedule so recovery ops exist
+    # after the last replica is back
+    fault_s = args.fault_s
+    if nem.name == "rolling-restart":
+        n_alphas = sum(1 for n in nem.ctx["cluster"].node_addrs
+                       if n.startswith("alpha-"))
+        fault_s = max(args.fault_s, 10.0 * n_alphas)
+    duration = args.pre_s + fault_s + args.recover_s
+    n_ops = max(10, int(args.rate * duration))
+    kinds = []
+    for i in range(n_ops):
+        roll = rng.random()
+        if noise_reads is not None and roll < 0.15:
+            kinds.append("noise")
+        elif roll < 0.15 + args.read_frac:
+            kinds.append("read")
+        else:
+            kinds.append("transfer")
+
+    # time.perf_counter throughout: the open-loop scheduler's arrival
+    # clock — marks and arrivals must share one clock domain
+    marks = {}
+    nem_errors: list[str] = []
+
+    def nemesis_thread():
+        # inject/heal failures must FAIL THE RUN, not die silently in
+        # a daemon thread — a phase whose fault never armed (or whose
+        # heal left a node dead) would otherwise gate green having
+        # tested nothing. heal() is still attempted after a failed
+        # inject: a partially-armed fault must not leak into the next
+        # phase.
+        time.sleep(args.pre_s)
+        marks["inject"] = time.perf_counter()
+        try:
+            nem.inject()
+        except Exception as e:  # noqa: BLE001 — re-raised in main
+            nem_errors.append(f"inject: {type(e).__name__}: {e}")
+        finally:
+            marks["injected"] = time.perf_counter()
+        time.sleep(max(
+            0.0, fault_s - (marks["injected"] - marks["inject"])))
+        try:
+            nem.heal()
+        except Exception as e:  # noqa: BLE001 — re-raised in main
+            nem_errors.append(f"heal: {type(e).__name__}: {e}")
+        finally:
+            marks["heal"] = time.perf_counter()
+
+    recs: list[dict | None] = [None] * n_ops
+    op_rngs = [random.Random(f"{args.seed}:{phase_ix}:{i}")
+               for i in range(n_ops)]
+
+    def submit(req):
+        i, kind = req
+        if kind == "transfer":
+            rec = bank.transfer(op_rngs[i])
+        elif kind == "read":
+            rec = bank.read()
+        else:
+            t0 = time.monotonic()
+            rec = {"kind": "noise"}
+            op = noise_reads[i % len(noise_reads)]
+            try:
+                bank.rc.query(op.query, deadline_ms=args.deadline_ms)
+                rec["outcome"] = "ok"
+            except Exception as e:  # noqa: BLE001 — classified
+                rec["outcome"] = classify(e)
+                rec["error"] = f"{type(e).__name__}: {e}"[:200]
+            rec["lat_s"] = round(time.monotonic() - t0, 4)
+            bank._record(rec)
+        recs[i] = rec
+        return rec
+
+    t_start = time.perf_counter()
+    nt = threading.Thread(target=nemesis_thread, daemon=True)
+    nt.start()
+    arrivals: list[float] = []
+    lat = run_open_loop(submit, list(enumerate(kinds)),
+                        args.concurrency, args.rate,
+                        arrivals_out=arrivals)
+    nt.join(timeout=180)
+    if nt.is_alive():
+        raise RuntimeError(f"nemesis {nem.name} wedged mid-schedule")
+    if nem_errors:
+        raise RuntimeError(
+            f"nemesis {nem.name} failed: " + "; ".join(nem_errors))
+
+    win = phase_windows(
+        [r or {"outcome": "?"} for r in recs], lat, arrivals,
+        marks.get("inject", t_start + args.pre_s),
+        marks.get("heal", t_start + args.pre_s + fault_s),
+        args.slo_ms)
+    win["nemesis"] = nem.name
+    win["ops"] = n_ops
+    win["rate_qps"] = args.rate
+    log(f"{nem.name}: unavailability {win['unavailability_s']}s, "
+        f"ttr {win['time_to_recover_s']}s, fault classes "
+        f"{win['fault']['classes']}")
+    return win
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.smoke:
+        args.replicas = 1
+        args.accounts = min(args.accounts, 5)
+        args.rate = min(args.rate, 25.0)
+        args.pre_s, args.fault_s, args.recover_s = 3.0, 4.0, 10.0
+        args.ldbc_persons = 0
+        args.nemeses = "partition-majority,kill-leader"
+        args.slo_ms = max(args.slo_ms, 2000.0)
+    # the bank is cross-group BY CONSTRUCTION (bal on g1, ledger on
+    # g2): fewer than two groups would silently drop the 2PC coverage
+    args.groups = max(2, args.groups)
+    os.makedirs(args.report_dir, exist_ok=True)
+    rng = random.Random(args.seed)
+    names = [n.strip() for n in args.nemeses.split(",") if n.strip()]
+    for n in names:
+        if n not in NEMESES:
+            log(f"unknown nemesis {n!r}; have {sorted(NEMESES)}")
+            return 2
+
+    t_run = time.monotonic()
+    # the durable dirs are PER-RUN scratch: a reused data dir would
+    # boot the cluster on the previous run's WAL and every stale
+    # ledger entry/balance becomes a phantom checker violation
+    data_dir = os.path.join(args.report_dir, "data")
+    if os.path.isdir(data_dir):
+        import shutil
+        shutil.rmtree(data_dir)
+    log(f"spawning {args.zeros} zero(s) + {args.groups} group(s) x "
+        f"{args.replicas} replica(s), durable dirs on")
+    with ProcessCluster(
+            groups=args.groups, replicas=args.replicas,
+            zeros=args.zeros,
+            log_dir=os.path.join(args.report_dir, "logs"),
+            data_dir=data_dir) as cluster:
+        cluster.wait_ready(90)
+        rc = cluster.routed()
+        node_clients = cluster.node_clients()
+        from dgraph_tpu.cluster.client import ClusterClient
+        zero_cl = ClusterClient(cluster.zero_addrs, timeout=10.0)
+        try:
+            bank = Bank(rc, zero_cl, rc.groups[1], rc.groups[2],
+                        args.accounts, args.deadline_ms)
+            bank.setup()
+            noise_reads = _noise_ops(args, rc)
+            ctx = {"cluster": cluster, "node_clients": node_clients,
+                   "rng": rng}
+
+            phases = []
+            for ix, name in enumerate(names):
+                nem = NEMESES[name](ctx)
+                phases.append(run_nemesis_phase(
+                    args, bank, nem, rng, noise_reads, ix))
+                # faults visible from the outside is part of the
+                # contract — but only while armed; between phases
+                # EVERY node's table must be CLEAN or the next
+                # phase's baseline is polluted
+                for node in sorted(node_clients):
+                    st = node_clients[node]._rpc_once(
+                        1, {"op": "fault", "action": "list"})
+                    if st and st.get("ok") and st["result"]["rules"]:
+                        raise RuntimeError(
+                            f"fault table on {node} not healed after "
+                            f"{name}: {st['result']['rules']}")
+
+            log("collecting final state + running the checker")
+            final_bals, ledger = bank.final_state()
+            verdict = check_history(bank.history, final_bals, ledger,
+                                    args.accounts)
+        finally:
+            zero_cl.close()
+            for cl in node_clients.values():
+                cl.close()
+            rc.close()
+
+    hist_path = os.path.join(args.report_dir, "history.jsonl")
+    with open(hist_path, "w") as f:
+        for rec in bank.history:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+    summary = {
+        "metric": "chaos_time_to_recover_s",
+        "value": max((p["time_to_recover_s"] for p in phases
+                      if p["time_to_recover_s"] is not None),
+                     default=None),
+        "unit": "s",
+        "checker_ok": verdict["ok"],
+        "violations": len(verdict["violations"]),
+        "nemeses": names,
+        "groups": args.groups, "replicas": args.replicas,
+        "zeros": args.zeros, "accounts": args.accounts,
+        "rate_qps": args.rate, "slo_ms": args.slo_ms,
+        "deadline_ms": args.deadline_ms,
+        "seed": args.seed, "smoke": bool(args.smoke),
+        "history_ops": len(bank.history),
+        "wall_s": round(time.monotonic() - t_run, 1),
+    }
+    out = {"summary": summary, "phases": phases, "checker": verdict,
+           "history_file": os.path.abspath(hist_path),
+           "report_dir": os.path.abspath(args.report_dir)}
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps(summary))
+
+    bad = []
+    if not verdict["ok"]:
+        bad.append(f"checker: {verdict['violations'][:3]}")
+    if verdict["stats"]["acked_transfers"] < 5 \
+            or verdict["stats"]["full_reads"] < 5:
+        bad.append(f"workload starved: {verdict['stats']}")
+    for p in phases:
+        if p["time_to_recover_s"] is None:
+            bad.append(f"{p['nemesis']}: never recovered to "
+                       f"p99<={args.slo_ms}ms")
+    if bad:
+        log("CHAOS FAILED: " + "; ".join(bad))
+        return 1
+    log("chaos ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

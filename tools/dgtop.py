@@ -93,7 +93,15 @@ def node_row(snap: dict, prev: Optional[dict]) -> dict:
     gauges = stats.get("gauges", {})
     rss = gauges.get("memory_inuse_bytes")
     threads = gauges.get("process_threads")
+    # chaos-plane visibility: armed outbound fault rules on this node
+    # and the max seconds since ANY raft peer was heard from — a
+    # partition shows up here from the outside (utils/netfault.py;
+    # service.py peer_ages)
+    heard = [v for v in (stats.get("lastHeard") or {}).values()
+             if v is not None]
     return {
+        "faults": len(stats.get("netfault") or ()),
+        "heard_max": max(heard) if heard else None,
         "qps": qps,
         "shed": shed,
         "p50": _pct(lat, 0.50),
@@ -168,8 +176,10 @@ def render(snaps: dict[str, dict],
     stages. Pure string building (tests golden-match pieces of it)."""
     hdr = (f"{'NODE':<28} {'QPS':>7} {'P50MS':>7} {'P99MS':>7} "
            f"{'SHED/S':>7} {'HIT%':>6} {'OCC':>5} {'PLANS':>6} "
-           f"{'TABLETS':>8} {'COSTK':>6} {'RSSMB':>7} {'THR':>4}")
+           f"{'TABLETS':>8} {'COSTK':>6} {'RSSMB':>7} {'THR':>4} "
+           f"{'FLT':>4} {'HEARD':>6}")
     lines = [hdr, "-" * len(hdr)]
+    fault_rows = []
     for node in sorted(snaps):
         snap = snaps[node]
         if snap is None:
@@ -184,7 +194,23 @@ def render(snaps: dict[str, dict],
             f"{_fmt(row['batch_occ']):>5} {row['plans']:>6} "
             f"{row['tablets']:>8} {row['cost_keys']:>6} "
             f"{_fmt(row['rss_mb'], nd=0):>7} "
-            f"{_fmt(row['threads']):>4}")
+            f"{_fmt(row['threads']):>4} {row['faults']:>4} "
+            f"{_fmt(row['heard_max']):>6}")
+        for r in snap["stats"].get("netfault") or ():
+            fault_rows.append((node, r))
+    if fault_rows:
+        lines.append("")
+        lines.append(f"{'ACTIVE FAULT RULES':<34} {'DST':<28} "
+                     f"{'DROP':>5} {'DELAY':>7} {'DUP':>5}")
+        for node, r in fault_rows:
+            dst = ",".join(r.get("dst", ()))
+            delay = f"{r.get('delay_ms', 0):g}" \
+                + (f"+{r.get('jitter_ms', 0):g}"
+                   if r.get("jitter_ms") else "")
+            lines.append(
+                f"{r.get('id', '?') + ' @ ' + node:<34} {dst:<28.28} "
+                f"{r.get('drop', 0):>5.2f} {delay:>7} "
+                f"{r.get('dup', 0):>5.2f}")
     hot = hottest(snaps)
     if hot:
         lines.append("")
