@@ -50,7 +50,12 @@ class ProcessCluster:
                  log_dir: Optional[str] = None,
                  data_dir: Optional[str] = None,
                  tick_ms: int = 30, election_ticks: int = 8,
-                 env_extra: Optional[dict] = None):
+                 env_extra: Optional[dict] = None,
+                 snapshots: Optional[dict] = None):
+        # snapshots: {group -> p.snap path} boots each group's alphas
+        # from a bulk/distributed-ingest output (`node --snapshot`);
+        # every replica of a group must boot the same file
+        self.snapshots = dict(snapshots or {})
         self.groups_n = groups
         self.replicas = replicas
         self.procs: dict[str, subprocess.Popen] = {}
@@ -123,6 +128,8 @@ class ProcessCluster:
                         "--debug-port", str(dport)]
                 if max_pending:
                     args += ["--max-pending", str(max_pending)]
+                if g in self.snapshots:
+                    args += ["--snapshot", self.snapshots[g]]
                 self._spawn(f"alpha-g{g}-n{i}", args)
 
     def _spawn(self, name: str, args: list[str]):

@@ -1,0 +1,275 @@
+"""Per-predicate bounded change logs with resumable offsets.
+
+Offset semantics (the whole design hangs on these):
+
+  offset(entry) = commit_ts << 16 | idx
+
+where `idx` is the entry's position among the ops its transaction
+applied to that predicate (saturating at 0xFFFF). Per tablet, commits
+apply in strictly increasing ts order (the finalize-ordering machinery
+in cluster/service.py exists to guarantee exactly this), so offsets are
+strictly monotonic per predicate — and because every replica applies
+the SAME expanded records in the SAME log order, the offset of a change
+is identical on every replica of the group. A subscriber that loses its
+serving node resumes on any other replica with the offset it already
+holds; re-delivery of entries it has seen is possible (at-least-once),
+silent gaps are not.
+
+Logs are bounded (`cap` entries per predicate). Evicted history raises
+the predicate's `floor`; a subscriber resuming below the floor gets a
+typed OffsetTruncated carrying `resync_ts` — the documented re-sync
+path is: read a full snapshot of the predicate at `resync_ts` (a pinned
+query), then resubscribe from offset_for_ts(resync_ts). Snapshot- and
+bulk-booted stores start with floor = offset_for_ts(base_ts) for the
+same reason: CDC covers commits, not base state.
+
+Backpressure is pull-side by construction: the server never buffers
+per subscriber — each poll returns at most `limit` entries (clamped to
+MAX_LIMIT) from the shared bounded log, and a slow subscriber's only
+cost is its own lag (visible in /debug/stats and tools/dgtop.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from dgraph_tpu.utils import failpoint, metrics
+
+# ops a single transaction applies to one predicate beyond this index
+# share the last offset (order preserved, duplicates indistinguishable)
+_IDX_BITS = 16
+_IDX_MASK = (1 << _IDX_BITS) - 1
+
+DEFAULT_CAP = 8192        # entries retained per predicate
+MAX_LIMIT = 4096          # hard per-poll batch ceiling
+DEFAULT_LIMIT = 256
+MAX_WAIT_S = 60.0         # long-poll ceiling (heartbeat cadence bound)
+_MAX_SUBSCRIBERS = 1024   # lag-registry bound
+
+
+def offset_for_ts(ts: int) -> int:
+    """The resume offset that means "everything committed AFTER ts":
+    reading `after=offset_for_ts(T)` yields exactly the entries with
+    commit_ts > T — the resubscribe point after a snapshot read at T."""
+    return ((int(ts) + 1) << _IDX_BITS) - 1
+
+
+class OffsetTruncated(Exception):
+    """The requested resume offset predates the log's floor (bounded
+    eviction, WAL compaction, or a snapshot-booted store). Re-sync:
+    read the predicate at `resync_ts`, resubscribe from
+    offset_for_ts(resync_ts)."""
+
+    def __init__(self, pred: str, offset: int, floor: int):
+        self.pred = pred
+        self.offset = offset
+        self.floor = floor
+        self.resync_ts = floor >> _IDX_BITS
+        super().__init__(
+            f"offset {offset} for {pred!r} predates the change log "
+            f"floor {floor}; re-sync: snapshot-read at ts "
+            f"{self.resync_ts}, resubscribe from "
+            f"offset_for_ts({self.resync_ts})")
+
+
+def _jsonable(v: Any) -> Any:
+    """A change entry must serialize on BOTH surfaces (HTTP JSON and
+    the cluster wire), so values flatten to plain JSON types at append
+    time: scalars pass through, vectors become float lists, everything
+    else (datetime, geo) its canonical string form."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    try:
+        import numpy as np
+        if isinstance(v, np.ndarray):
+            return [float(x) for x in v.tolist()]
+        if isinstance(v, np.generic):
+            return v.item()
+    except ImportError:  # pragma: no cover
+        pass
+    if hasattr(v, "isoformat"):
+        return v.isoformat()
+    return str(v)
+
+
+class _Log:
+    """One predicate's bounded change list. Guarded by CdcPlane's
+    lock — no locking of its own."""
+
+    __slots__ = ("entries", "floor", "head")
+
+    def __init__(self):
+        self.entries: list[dict] = []
+        self.floor = 0   # offsets <= floor are unavailable history
+        self.head = 0    # highest appended offset
+
+    def evict_to_cap(self, cap: int):
+        if len(self.entries) > cap:
+            drop = len(self.entries) - cap
+            self.floor = max(self.floor, self.entries[drop - 1]["offset"])
+            del self.entries[:drop]
+
+
+class CdcPlane:
+    """Every engine owns one (engine/db.py GraphDB.cdc): the apply
+    path appends, the /subscribe surfaces read."""
+
+    def __init__(self, cap: int = DEFAULT_CAP):
+        self.cap = cap
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._logs: dict[str, _Log] = {}
+        # sub_id -> {"pred", "offset", "seen_mono"}: the lag registry
+        # dgtop's CDC panel reads; bounded, idle entries evicted first
+        self._subs: dict[str, dict] = {}
+
+    # ------------------------------------------------------------ append
+
+    def append(self, commit_ts: int, by_pred: dict[str, list]) -> None:
+        """Tail one committed transaction's expanded ops. Called from
+        the engine's apply path AFTER the tablet apply — the entries
+        mirror exactly what the WAL framed / Raft replicated, so every
+        replica derives identical offsets. An armed `cdc.append`
+        failpoint error here behaves like a WAL append failure (the
+        commit surfaces an error after the tablet apply)."""
+        failpoint.fire("cdc.append")
+        n = 0
+        with self._lock:
+            for pred, ops in by_pred.items():
+                log = self._logs.get(pred)
+                if log is None:
+                    log = self._logs[pred] = _Log()
+                for i, op in enumerate(ops):
+                    ent: dict[str, Any] = {
+                        "offset": (commit_ts << _IDX_BITS)
+                        | min(i, _IDX_MASK),
+                        "commitTs": commit_ts,
+                        "op": op.op,
+                        "uid": int(op.src),
+                    }
+                    if op.dst:
+                        ent["dst"] = int(op.dst)
+                    if op.posting is not None:
+                        ent["value"] = _jsonable(op.posting.value.value)
+                        if op.posting.lang:
+                            ent["lang"] = op.posting.lang
+                    log.entries.append(ent)
+                    log.head = ent["offset"]
+                    n += 1
+                log.evict_to_cap(self.cap)
+            if n:
+                self._wake.notify_all()
+        if n:
+            metrics.inc_counter("dgraph_cdc_appended_total", n)
+            with self._lock:
+                total = sum(len(l.entries) for l in self._logs.values())
+            metrics.set_gauge("dgraph_cdc_tail_entries", total)
+
+    def reset_floor(self, pred: str, base_ts: int) -> None:
+        """Snapshot/bulk-booted predicate: history at or below base_ts
+        lives in the base state, not the log — a subscriber from an
+        older offset must re-sync, never silently skip."""
+        off = offset_for_ts(base_ts)
+        with self._lock:
+            log = self._logs.get(pred)
+            if log is None:
+                log = self._logs[pred] = _Log()
+            if not log.entries and log.head < off:
+                log.floor = max(log.floor, off)
+                log.head = max(log.head, off)
+
+    def drop(self, pred: str) -> None:
+        with self._lock:
+            self._logs.pop(pred, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._logs.clear()
+            self._subs.clear()
+
+    # -------------------------------------------------------------- read
+
+    def read(self, pred: str, after: int, limit: int = DEFAULT_LIMIT,
+             wait_s: float = 0.0, sub_id: str = "") -> dict:
+        """Entries with offset > `after`, up to `limit`. Blocks up to
+        `wait_s` for new data (long-poll); an empty result after the
+        wait is a HEARTBEAT — the subscriber knows the stream is alive
+        and its offset current. Raises OffsetTruncated when `after`
+        predates the floor."""
+        limit = max(1, min(int(limit), MAX_LIMIT))
+        wait_s = max(0.0, min(float(wait_s), MAX_WAIT_S))
+        failpoint.fire("cdc.deliver")
+        deadline = time.monotonic() + wait_s
+        with self._lock:
+            while True:
+                log = self._logs.get(pred)
+                if log is not None and after < log.floor:
+                    metrics.inc_counter("dgraph_cdc_truncated_total")
+                    raise OffsetTruncated(pred, after, log.floor)
+                out = []
+                if log is not None and log.entries \
+                        and log.head > after:
+                    out = self._after(log, after, limit)
+                if out or wait_s <= 0.0:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._wake.wait(remaining)
+            floor = log.floor if log is not None else 0
+            head = log.head if log is not None else 0
+            next_off = out[-1]["offset"] if out else max(after, 0)
+            if sub_id:
+                self._note_subscriber(sub_id, pred, next_off)
+        if out:
+            metrics.inc_counter("dgraph_cdc_delivered_total", len(out))
+        else:
+            metrics.inc_counter("dgraph_cdc_heartbeats_total")
+        return {"pred": pred, "changes": out, "nextOffset": next_off,
+                "floor": floor, "head": head,
+                "heartbeat": not out}
+
+    @staticmethod
+    def _after(log: _Log, after: int, limit: int) -> list[dict]:
+        """Bisect to the first entry past `after` (entries are offset-
+        sorted by construction). Returns copies — the caller serializes
+        outside the lock."""
+        from bisect import bisect_right
+        offs = [e["offset"] for e in log.entries]
+        i = bisect_right(offs, after)
+        return [dict(e) for e in log.entries[i:i + limit]]
+
+    def _note_subscriber(self, sub_id: str, pred: str, offset: int):
+        """Caller holds the lock."""
+        now = time.monotonic()
+        if sub_id not in self._subs \
+                and len(self._subs) >= _MAX_SUBSCRIBERS:
+            oldest = min(self._subs, key=lambda s:
+                         self._subs[s]["seen_mono"])
+            del self._subs[oldest]
+        self._subs[sub_id] = {"pred": pred, "offset": offset,
+                              "seen_mono": now}
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """/debug/stats "cdc" payload: per-predicate head/floor/depth
+        and per-subscriber offset + lag (entries still unread) — what
+        tools/dgtop.py's CDC panel renders."""
+        with self._lock:
+            preds = {p: {"head": l.head, "floor": l.floor,
+                         "entries": len(l.entries)}
+                     for p, l in self._logs.items()}
+            subs = {}
+            for sid, rec in self._subs.items():
+                log = self._logs.get(rec["pred"])
+                lag = 0
+                if log is not None and log.entries:
+                    from bisect import bisect_right
+                    offs = [e["offset"] for e in log.entries]
+                    lag = len(offs) - bisect_right(offs, rec["offset"])
+                subs[sid] = {"pred": rec["pred"],
+                             "offset": rec["offset"], "lag": lag}
+        return {"preds": preds, "subscribers": subs}

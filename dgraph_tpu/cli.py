@@ -346,13 +346,40 @@ def cmd_increment(args) -> int:
 
 
 def cmd_bulk(args) -> int:
-    """Offline bulk loader (ref dgraph/cmd/bulk/run.go:106)."""
+    """Offline bulk loader (ref dgraph/cmd/bulk/run.go:106). With
+    --workers N the load runs cluster-parallel (map workers + one
+    reduce process per --reduce-shards group, ingest/distributed.py)
+    writing bootable group snapshots directly."""
     import time
 
     from dgraph_tpu.ingest.bulk import bulk_load
 
     _load_custom_toks(args)
     schema = open(args.schema).read() if args.schema else ""
+    if args.workers > 0:
+        if not args.out:
+            print("error: --workers needs --out (a directory of "
+                  "group snapshots)", file=sys.stderr)
+            return 2
+        from dgraph_tpu.ingest.distributed import distributed_load
+        toks = tuple(p for p in getattr(
+            args, "custom_tokenizers", "").split(",") if p)
+        manifest = distributed_load(
+            args.files, schema=schema,
+            groups=max(1, args.reduce_shards),
+            workers=args.workers, outdir=args.out,
+            custom_tokenizers=toks)
+        st = manifest["stats"]
+        print(f"mapped {st['mapped']} nquads in {st['map_s']}s, "
+              f"reduced {st['reduced']} in {st['reduce_s']}s "
+              f"({st['mapped'] / max(st['total_s'], 1e-9):.0f} "
+              f"RDF/s end to end)")
+        for g, ps in sorted(manifest["groups"].items(),
+                            key=lambda kv: int(kv[0])):
+            print(f"group {g}: {len(ps)} tablets -> "
+                  f"{args.out}/g{g}/p.snap")
+        print(f"manifest written to {args.out}/manifest.json")
+        return 0
     t0 = time.monotonic()
     db = bulk_load(args.files, schema=schema)
     dt = time.monotonic() - t0
@@ -817,6 +844,12 @@ def main(argv=None) -> int:
                    help="shard the output across N future alpha "
                         "groups (ref dgraph bulk --reduce_shards: "
                         "one out/<i>/p per group)")
+    b.add_argument("--workers", type=int, default=0,
+                   help="distributed load: N map-worker processes + "
+                        "one reduce process per --reduce-shards "
+                        "group, streaming the shuffle over the wire "
+                        "and writing bootable group snapshots "
+                        "directly (0 = single-core loader)")
     b.add_argument("--custom_tokenizers", default="",
                    help="comma-separated Python plugin files, each "
                         "exporting tokenizer()")

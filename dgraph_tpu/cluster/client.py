@@ -474,6 +474,30 @@ class ClusterClient:
         if sock is not None:
             sock.close()
 
+    def subscribe(self, pred: str, offset: int = 0,
+                  wait_ms: int = 0, limit: int = 256,
+                  sub_id: str = "") -> dict:
+        """One CDC poll: entries with offset > `offset` from whichever
+        node answers (any replica serves the same stream — offsets are
+        deterministic across the group). Raises cdc.OffsetTruncated
+        when the resume offset predates the serving node's log floor;
+        the caller re-syncs (snapshot read at resync_ts, resubscribe
+        from offset_for_ts(resync_ts)).
+
+        Use a DEDICATED ClusterClient per subscriber: a long-poll
+        parks the pooled per-node connection for up to wait_ms, and
+        the per-node mutex would stall other requests sharing it."""
+        resp = self.request(
+            {"op": "subscribe", "pred": pred, "offset": int(offset),
+             "wait_ms": int(wait_ms), "limit": int(limit),
+             "id": sub_id},
+            deadline_s=wait_ms / 1000.0 + max(5.0, self.timeout))
+        if not resp.get("ok") and resp.get("truncated"):
+            from dgraph_tpu.cdc.changelog import OffsetTruncated
+            t = resp["truncated"]
+            raise OffsetTruncated(t["pred"], int(offset), t["floor"])
+        return self._unwrap(resp)
+
     def status(self, node: Optional[int] = None) -> dict:
         if node is not None:
             resp = self._rpc_once(node, {"op": "status"})

@@ -1659,6 +1659,34 @@ class AlphaServer(RaftServer):
         if op == "drop_tablet":
             self._replicate_record(("drop_attr", req["pred"]))
             return {"ok": True, "result": {}}
+        if op == "subscribe":
+            # CDC long-poll against THIS node's change logs
+            # (cdc/changelog.py). Deliberately NOT leader-gated:
+            # offsets are deterministic functions of the replicated
+            # record stream, so any replica serves the same stream and
+            # a subscriber fails over freely — the whole point of the
+            # dgchaos CDC nemesis. Also deliberately outside admission
+            # (_ADMITTED_OPS): a long-poll parks its serving thread on
+            # a condition, not the engine, and must not starve writes.
+            from dgraph_tpu.cdc.changelog import OffsetTruncated
+            with self.lock:
+                db = self.db
+            try:
+                out = db.cdc.read(
+                    str(req.get("pred", "")),
+                    after=int(req.get("offset", 0)),
+                    limit=int(req.get("limit", 256)),
+                    wait_s=float(req.get("wait_ms", 0)) / 1000.0,
+                    sub_id=str(req.get("id", "")))
+            except OffsetTruncated as e:
+                # typed on the wire so ClusterClient.subscribe can
+                # re-raise it (not a generic RuntimeError): the
+                # re-sync path is client logic
+                return {"ok": False, "error": str(e),
+                        "truncated": {"pred": e.pred,
+                                      "floor": e.floor,
+                                      "resync_ts": e.resync_ts}}
+            return {"ok": True, "result": out}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
     def debug_stats_payload(self) -> dict:

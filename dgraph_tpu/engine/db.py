@@ -209,6 +209,14 @@ class GraphDB:
         # once Zero's oracle decides (ref worker/mutation.go:432
         # proposeOrSend + zero/oracle.go commit decisions)
         self.pending_txns: dict[int, tuple[list, list]] = {}
+        # change streams (cdc/): bounded per-predicate change logs
+        # tailing the committed apply path — the same expanded records
+        # the WAL frames and Raft replicates, so a WAL replay below
+        # rebuilds the tail and every replica derives identical
+        # offsets. Served by /subscribe (server/http.py) and the
+        # {"op": "subscribe"} wire op (cluster/service.py).
+        from dgraph_tpu.cdc.changelog import CdcPlane
+        self.cdc = CdcPlane()
         self.wal = Wal(wal_path, key=enc_key) if wal_path else None
         # optional record sink: Raft replication taps the same durable
         # record stream the WAL gets (cluster/replica.py)
@@ -242,6 +250,7 @@ class GraphDB:
                 self.device_cache.drop_tablet(tab)
             self.tablets.clear()
             self.schema = SchemaState()
+            self.cdc.clear()
             if self.wal:
                 self.wal.truncate()
             self._log_record(("drop_all",))
@@ -251,6 +260,7 @@ class GraphDB:
             if dropped is not None:
                 self.device_cache.drop_tablet(dropped)
             self.schema.delete_predicate(drop_attr)
+            self.cdc.drop(drop_attr)
             self._log_record(("drop_attr", drop_attr))
             return
         preds, types = self.schema.apply_text(schema_text)
@@ -643,6 +653,10 @@ class GraphDB:
             self._log_record(("commit", commit_ts,
                               [(p, op) for p, ops in expanded.items()
                                for op in ops], schemas))
+        # CDC tail AFTER the applies, from the same expanded ops the
+        # record carries: followers tap the identical dict shape in
+        # apply_record, so offsets agree across replicas
+        self.cdc.append(commit_ts, expanded)
         return commit_ts
 
     def discard(self, txn: Txn):
@@ -718,12 +732,14 @@ class GraphDB:
         if kind == "drop_all":
             self.tablets.clear()
             self.schema = SchemaState()
+            self.cdc.clear()
             return 0
         if kind == "drop_attr":
             dropped = self.tablets.pop(rec[1], None)
             if dropped is not None:
                 self.device_cache.drop_tablet(dropped)
             self.schema.delete_predicate(rec[1])
+            self.cdc.drop(rec[1])
             return 0
         if kind == "import_tablet":
             # predicate move landing on the destination group
@@ -762,6 +778,7 @@ class GraphDB:
             # time, so replay must not)
             self._apply_decided(commit_ts, by_pred, conflict_keys,
                                 staged, count_metrics=False)
+            self.cdc.append(commit_ts, by_pred)
             return commit_ts
         if kind == "xstage":
             # one group's fragment of a cross-group txn: hold it
@@ -779,9 +796,10 @@ class GraphDB:
             if pend is None or not commit_ts:
                 return int(commit_ts) if commit_ts else 0
             staged, keys = pend
-            self._apply_decided(commit_ts,
-                                self._expand_ops(commit_ts, staged),
+            expanded = self._expand_ops(commit_ts, staged)
+            self._apply_decided(commit_ts, expanded,
                                 {int(k) for k in keys}, staged)
+            self.cdc.append(commit_ts, expanded)
             return int(commit_ts)
         raise ValueError(f"unknown record kind {kind!r}")
 
@@ -1283,6 +1301,7 @@ class GraphDB:
             "maxAssigned": self.coordinator.max_assigned(),
             "schemaEpoch": self.schema_epoch,
             "tablets": tablets,
+            "cdc": self.cdc.stats(),
             "cost": coststore.summary(),
             "costStore": coststore.stats(),
             "deviceCache": self.device_cache.stats(),

@@ -37,7 +37,32 @@ from dgraph_tpu.storage.tablet import Posting, Tablet
 from dgraph_tpu.wire import dumps as wire_dumps
 from dgraph_tpu.wire import loads as wire_loads
 
-_SPILL_EDGES = 2_000_000  # mapper buffer flush threshold
+_SPILL_BYTES = 256 << 20  # mapper buffer flush threshold: approx
+# RESIDENT bytes pending across all shards. Byte-based, not
+# edge-count: a float32vector posting costs its payload's real size
+# (dim * 4 + object overhead), not "one edge" — counting rows
+# undercounted vector-heavy inputs by two orders of magnitude and
+# blew past the intended memory ceiling. Costs approximate RESIDENT
+# python-object sizes (boxed ints, Posting/Val shells), because that
+# is what actually fills the mapper's RAM between spills (review
+# finding: packed-byte costs undercounted object buffers ~6x).
+
+# python-path edge buffers are LISTS OF INT OBJECTS, not packed
+# arrays: two list slots + two boxed ints resident per edge
+_EDGE_COST = 72
+
+
+def _posting_cost(p: Posting) -> int:
+    """Approximate RESIDENT bytes of one buffered value posting — the
+    spill accountant's unit. The Posting+Val shells cost ~112 B of
+    headers/slots; vectors add their exact payload nbytes, strings
+    their length; scalars are boxed small objects."""
+    v = p.value.value
+    if isinstance(v, np.ndarray):
+        return 112 + int(v.nbytes)
+    if isinstance(v, (str, bytes)):
+        return 112 + len(v)
+    return 120
 
 
 class _MapShard:
@@ -118,7 +143,7 @@ def _bulk_load_locked(paths, nquads, db, tmpdir) -> GraphDB:
     tmpdir = tmpdir or tempfile.mkdtemp(prefix="dg-bulk-")
     xidmap = XidMap(db.coordinator)
     shards: dict[str, _MapShard] = {}
-    pending_edges = 0
+    pending_bytes = 0
 
     def shard(pred: str) -> _MapShard:
         s = shards.get(pred)
@@ -147,7 +172,10 @@ def _bulk_load_locked(paths, nquads, db, tmpdir) -> GraphDB:
             bumped = uid
 
     def add_nq(nq: NQuad):
-        nonlocal pending_edges
+        # the HOT path: accumulate an approximate packed-byte cost
+        # only — the spill threshold check is hoisted to the per-chunk
+        # maybe_spill so adds stay one append + one integer bump
+        nonlocal pending_bytes
         src = resolve(nq.subject)
         s = shard(nq.predicate)
         if nq.object_id:
@@ -156,17 +184,20 @@ def _bulk_load_locked(paths, nquads, db, tmpdir) -> GraphDB:
             s.dst.append(dst)
             if nq.facets:
                 s.facets.append((src, dst, nq.facets))
+            pending_bytes += _EDGE_COST
         elif nq.object_value is not None:
-            s.vals.append((src, Posting(nq.object_value, nq.lang,
-                                        nq.facets)))
-        pending_edges += 1
+            p = Posting(nq.object_value, nq.lang, nq.facets)
+            s.vals.append((src, p))
+            pending_bytes += _posting_cost(p)
 
     def maybe_spill():
-        nonlocal pending_edges
-        if pending_edges >= _SPILL_EDGES:
+        # batched per map chunk (never per nquad): one threshold
+        # check per chunk against the byte-accurate pending total
+        nonlocal pending_bytes
+        if pending_bytes >= _SPILL_BYTES:
             for s in shards.values():
                 s.spill()
-            pending_edges = 0
+            pending_bytes = 0
 
     from dgraph_tpu import native as _native
     for p in paths:
@@ -177,7 +208,7 @@ def _bulk_load_locked(paths, nquads, db, tmpdir) -> GraphDB:
             # grammar go through the python parser (bit-identical —
             # tested against parse_rdf on the same input)
             for text in _raw_text_chunks(p):
-                pending_edges += _map_native_chunk(
+                pending_bytes += _map_native_chunk(
                     text, shard, add_nq, bump_to)
                 maybe_spill()
         else:
@@ -195,33 +226,9 @@ def _bulk_load_locked(paths, nquads, db, tmpdir) -> GraphDB:
     write_ts = db.coordinator.next_ts()
     for pred, s in shards.items():
         srcs, dsts, vals, facets = s.load_all()
-        tab = _tablet_for_bulk(db, pred, srcs, vals)
-        if len(srcs):
-            # segmented sort + unique: one lexsort replaces the k-way heap
-            order = np.lexsort((dsts, srcs))
-            srcs, dsts = srcs[order], dsts[order]
-            keep = np.ones(len(srcs), bool)
-            keep[1:] = (srcs[1:] != srcs[:-1]) | (dsts[1:] != dsts[:-1])
-            srcs, dsts = srcs[keep], dsts[keep]
-            bounds = np.nonzero(np.r_[True, srcs[1:] != srcs[:-1]])[0]
-            ends = np.r_[bounds[1:], len(srcs)]
-            for b, e in zip(bounds.tolist(), ends.tolist()):
-                src = int(srcs[b])
-                old = tab.edges.get(src)
-                tab.edges[src] = dsts[b:e].copy() if old is None \
-                    else np.union1d(old, dsts[b:e])
-            for fsrc, fdst, fc in facets:
-                tab.edge_facets[(fsrc, fdst)] = fc
-        for src, posting in vals:
-            if tab.schema.value_type not in (TypeID.DEFAULT,):
-                posting = Posting(
-                    convert(posting.value, tab.schema.value_type),
-                    posting.lang, posting.facets)
-            tab.merge_base_value(src, posting)
-        tab.base_ts = write_ts
-        tab.rebuild_index()
-        tab.rebuild_reverse()
-        db.coordinator.should_serve(pred)
+        reduce_predicate(db, pred, srcs, dsts, vals,
+                         [(fs, fd, fc) for fs, fd, fc in facets],
+                         write_ts)
         if db.tablet_store is not None:
             # disk-backed load: each predicate offloads to the LSM
             # store as its reduce finishes, so the dataset never has
@@ -237,6 +244,49 @@ def _bulk_load_locked(paths, nquads, db, tmpdir) -> GraphDB:
         except OSError:
             pass
     return db
+
+
+def reduce_predicate(db: GraphDB, pred: str, srcs, dsts,
+                     vals, facets, write_ts: int):
+    """One predicate's reduce into base tablet state — the single
+    reduce kernel shared by the single-core loader above and the
+    per-group distributed reducers (ingest/distributed.py), so the two
+    paths produce identical tablets from identical inputs by
+    construction. `vals`/`facets` must arrive in FILE ORDER (the
+    distributed shuffle tags them with (chunk, idx) and sorts before
+    calling here): value-list merge semantics are order-dependent."""
+    tab = _tablet_for_bulk(db, pred, srcs, vals)
+    if len(srcs):
+        # segmented sort + unique: one lexsort replaces the k-way heap
+        order = np.lexsort((dsts, srcs))
+        srcs, dsts = srcs[order], dsts[order]
+        keep = np.ones(len(srcs), bool)
+        keep[1:] = (srcs[1:] != srcs[:-1]) | (dsts[1:] != dsts[:-1])
+        srcs, dsts = srcs[keep], dsts[keep]
+        bounds = np.nonzero(np.r_[True, srcs[1:] != srcs[:-1]])[0]
+        ends = np.r_[bounds[1:], len(srcs)]
+        for b, e in zip(bounds.tolist(), ends.tolist()):
+            src = int(srcs[b])
+            old = tab.edges.get(src)
+            tab.edges[src] = dsts[b:e].copy() if old is None \
+                else np.union1d(old, dsts[b:e])
+        for fsrc, fdst, fc in facets:
+            tab.edge_facets[(fsrc, fdst)] = fc
+    for src, posting in vals:
+        if tab.schema.value_type not in (TypeID.DEFAULT,):
+            posting = Posting(
+                convert(posting.value, tab.schema.value_type),
+                posting.lang, posting.facets)
+        tab.merge_base_value(src, posting)
+    tab.base_ts = write_ts
+    tab.rebuild_index()
+    tab.rebuild_reverse()
+    db.coordinator.should_serve(pred)
+    # CDC floor at the bulk write_ts: base state is not change
+    # history — a subscriber from offset 0 must re-sync via a
+    # snapshot read, never silently skip the bulk data
+    db.cdc.reset_floor(pred, write_ts)
+    return tab
 
 
 def bulk_shard_outputs(db: GraphDB, n_groups: int, outdir: str) -> dict:
@@ -305,7 +355,9 @@ def _map_native_chunk(text: str, shard, add_nq, bump_to) -> int:
     """One text chunk through native.rdf_parse: edge rows land as
     arrays grouped by predicate, literal rows build Postings directly,
     fallback lines replay through the exact python grammar (ref
-    bulk/mapper.go:207 processNQuad, chunker/rdf_parser.go:58)."""
+    bulk/mapper.go:207 processNQuad, chunker/rdf_parser.go:58).
+    Returns the approximate PACKED BYTES buffered (the spill
+    accountant's unit; fallback lines self-count through add_nq)."""
     from dgraph_tpu import native
 
     data = text.encode("utf-8")
@@ -343,7 +395,7 @@ def _map_native_chunk(text: str, shard, add_nq, bump_to) -> int:
             if fc:  # `( )` parses empty; python's `if nq.facets` skips
                 shard(preds[int(e_pred[i])]).facets.append(
                     (int(e_subj[i]), int(e_dst[i]), fc))
-        n += len(e_subj)
+        n += int(e_subj.nbytes) + int(e_dst.nbytes)
     if len(v_subj):
         langs, dtypes = parsed.langs, parsed.dtypes
         for subj, pid, ls, ll, fl, lg, dt, fs, flen in zip(
@@ -363,10 +415,9 @@ def _map_native_chunk(text: str, shard, add_nq, bump_to) -> int:
                 val = Val(TypeID.DEFAULT, sval)
             facets = parse_facet_text(
                 data[fs:fs + flen].decode("utf-8")) if flen else {}
-            shard(preds[pid]).vals.append(
-                (subj, Posting(val, langs[lg] if lg != _NOID else "",
-                               facets)))
-        n += len(v_subj)
+            p = Posting(val, langs[lg] if lg != _NOID else "", facets)
+            shard(preds[pid]).vals.append((subj, p))
+            n += _posting_cost(p)
     fb_s, fb_l = parsed.fallback
     if len(fb_s):
         txt = "\n".join(

@@ -53,6 +53,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
 
+from dgraph_tpu.cdc.changelog import OffsetTruncated
 from dgraph_tpu.cluster.coordinator import TxnAborted
 from dgraph_tpu.engine.db import GraphDB, Mutation, Txn
 from dgraph_tpu.server.acl import AclError
@@ -544,6 +545,28 @@ class AlphaServer:
         tid = (params or {}).get("trace_id") or None
         return {"traceEvents": export_chrome_trace(trace_id=tid)}
 
+    def handle_subscribe(self, params: dict, token: str = "") -> dict:
+        """GET /subscribe?pred=&offset=&waitMs=&limit=&id= — the CDC
+        long-poll surface (cdc/changelog.py). Returns entries with
+        offset > `offset` (at-least-once, resumable); an empty batch
+        after waitMs is a heartbeat. A stale offset (below the log
+        floor) raises OffsetTruncated — the HTTP edge maps it to 410
+        with the re-sync coordinates. ACL: subscribing to a predicate
+        is reading it. No admission slot: a long-poll parks a thread,
+        not the engine — it must not starve query admission."""
+        pred = params.get("pred", "")
+        if not pred:
+            raise ValueError("subscribe needs ?pred=")
+        if self.acl is not None:
+            with self.meta:
+                self.acl.authorize_query(token, [pred])
+        return self.db.cdc.read(
+            pred,
+            after=int(params.get("offset", 0)),
+            limit=int(params.get("limit", 256)),
+            wait_s=int(params.get("waitMs", 0)) / 1000.0,
+            sub_id=str(params.get("id", "")))
+
     def handle_debug_stats(self, token: str = "") -> dict:
         """/debug/stats: the always-on statistics plane — every
         resident tablet's full statistics (storage/tabstats.py), the
@@ -882,6 +905,9 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if path == "/health":
                 self._send(200, self.alpha.handle_health())
+            elif path == "/subscribe":
+                self._send(200, self.alpha.handle_subscribe(params,
+                                                            token))
             elif path == "/state":
                 self._send(200, self.alpha.handle_state(token))
             elif path == "/admin/schema":
@@ -908,6 +934,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(f"no handler for GET {path}", 404)
         except AclError as e:
             self._error(str(e), 401)
+        except OffsetTruncated as e:
+            # 410 Gone carries the re-sync coordinates: snapshot-read
+            # the predicate at resyncTs, resubscribe from
+            # offset_for_ts(resyncTs) (docs/deployment.md runbook)
+            self._send(410, {"errors": [{
+                "message": str(e),
+                "extensions": {"code": "OffsetTruncated",
+                               "pred": e.pred, "floor": e.floor,
+                               "resyncTs": e.resync_ts}}]})
         except DeadlineExceeded as e:
             # GET handlers take no RequestContext today, but the same
             # typed mapping as do_POST keeps cancellation from ever

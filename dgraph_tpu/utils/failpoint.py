@@ -59,6 +59,19 @@ SITES = (
     "txn.xfinalize",     # cluster/service.py   — before a DECIDED 2PC
     #                      fragment's finalize applies (error = one
     #                      transient failed delivery; reconcile retries)
+    "ingest.shuffle",    # ingest/distributed.py — before a map worker
+    #                      streams one shuffle part to a reduce group
+    #                      (sleep = slow link; error = worker dies and
+    #                      its chunk is reassigned)
+    "ingest.reduce",     # ingest/distributed.py — before a reduce
+    #                      group reduces one predicate's spill runs
+    "cdc.append",        # cdc/changelog.py     — before a committed
+    #                      txn's ops tail into the change logs (error
+    #                      behaves like a WAL append failure)
+    "cdc.deliver",       # cdc/changelog.py     — on every subscriber
+    #                      poll before entries are served (sleep =
+    #                      slow delivery; error = failed poll, the
+    #                      subscriber retries/resumes by offset)
 )
 
 

@@ -75,6 +75,16 @@ REGISTERED = (
     "query_sharded_expand_total",
     "query_similar_device_total",
     "query_similar_sharded_total",
+    # change streams (cdc/changelog.py)
+    "dgraph_cdc_appended_total",
+    "dgraph_cdc_delivered_total",
+    "dgraph_cdc_heartbeats_total",
+    "dgraph_cdc_tail_entries",
+    "dgraph_cdc_truncated_total",
+    # distributed ingest (ingest/distributed.py)
+    "dgraph_ingest_mapped_total",
+    "dgraph_ingest_reduced_total",
+    "dgraph_ingest_shuffled_bytes_total",
     # cluster (cluster/transport.py)
     "raft_send_drops",
     # network fault plane (utils/netfault.py)

@@ -1,0 +1,331 @@
+"""Change streams (cdc/): offset semantics, engine taps, WAL-backed
+replay, truncation + re-sync, the /subscribe surfaces (HTTP long-poll
++ cluster wire), and replica-consistent offsets — the non-subprocess
+half of what tools/dgchaos.py's `cdc` nemesis proves against real
+processes."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from dgraph_tpu.cdc.changelog import (
+    CdcPlane, OffsetTruncated, offset_for_ts,
+)
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.storage.tablet import EdgeOp, Posting
+from dgraph_tpu.models.types import TypeID, Val
+from dgraph_tpu.utils import failpoint
+
+
+def _db():
+    db = GraphDB(prefer_device=False)
+    db.alter("name: string .\nfollows: [uid] .")
+    return db
+
+
+def _set(src, text):
+    return EdgeOp("set", src, posting=Posting(Val(TypeID.STRING,
+                                                 text)))
+
+
+# --------------------------------------------------------- offset core
+
+
+def test_offsets_monotonic_and_ts_anchored():
+    plane = CdcPlane()
+    plane.append(7, {"name": [_set(1, "a"), _set(2, "b")]})
+    plane.append(9, {"name": [_set(3, "c")]})
+    r = plane.read("name", after=0)
+    offs = [e["offset"] for e in r["changes"]]
+    assert offs == sorted(offs) and len(set(offs)) == 3
+    # ts-anchored: resuming "after ts 7" yields exactly the ts-9 entry
+    r2 = plane.read("name", after=offset_for_ts(7))
+    assert [e["commitTs"] for e in r2["changes"]] == [9]
+    # within one commit, idx orders ops
+    assert offs[0] < offs[1] and offs[0] >> 16 == offs[1] >> 16 == 7
+
+
+def test_read_after_head_is_heartbeat():
+    plane = CdcPlane()
+    plane.append(3, {"name": [_set(1, "x")]})
+    head = plane.read("name", after=0)["nextOffset"]
+    r = plane.read("name", after=head)
+    assert r["heartbeat"] and not r["changes"]
+    assert r["nextOffset"] == head  # resume token never regresses
+
+
+def test_bounded_eviction_raises_floor_and_truncates():
+    plane = CdcPlane(cap=4)
+    for ts in range(2, 12, 2):
+        plane.append(ts, {"name": [_set(ts, f"v{ts}")]})
+    r = plane.read("name", after=offset_for_ts(2))
+    assert len(r["changes"]) == 4  # ts 4..10 retained, ts 2 evicted
+    with pytest.raises(OffsetTruncated) as ei:
+        plane.read("name", after=0)
+    # the documented re-sync path: snapshot-read at resync_ts, then
+    # resubscribe from offset_for_ts(resync_ts) — which must succeed
+    assert ei.value.floor == r["floor"]
+    again = plane.read("name",
+                       after=offset_for_ts(ei.value.resync_ts))
+    assert [e["value"] for e in again["changes"]] == \
+        ["v4", "v6", "v8", "v10"]
+
+
+def test_limit_clamps_and_pages():
+    plane = CdcPlane()
+    plane.append(5, {"name": [_set(i, f"v{i}") for i in range(10)]})
+    out, off = [], 0
+    while True:
+        r = plane.read("name", after=off, limit=3)
+        if not r["changes"]:
+            break
+        out.extend(e["value"] for e in r["changes"])
+        off = r["nextOffset"]
+    assert out == [f"v{i}" for i in range(10)]
+
+
+def test_long_poll_wakes_on_append():
+    plane = CdcPlane()
+    got = []
+
+    def poll():
+        got.append(plane.read("name", after=0, wait_s=5.0))
+
+    t = threading.Thread(target=poll)
+    t.start()
+    time.sleep(0.1)
+    plane.append(4, {"name": [_set(1, "woke")]})
+    t.join(5)
+    assert got and got[0]["changes"][0]["value"] == "woke"
+
+
+def test_subscriber_lag_registry():
+    plane = CdcPlane()
+    plane.append(2, {"name": [_set(1, "a"), _set(2, "b")]})
+    first = plane.read("name", after=0, limit=1, sub_id="s1")
+    st = plane.stats()
+    assert st["subscribers"]["s1"]["lag"] == 1
+    plane.read("name", after=first["nextOffset"], sub_id="s1")
+    assert plane.stats()["subscribers"]["s1"]["lag"] == 0
+
+
+def test_failpoint_seams():
+    plane = CdcPlane()
+    failpoint.arm("cdc.append", "error(boom)")
+    try:
+        with pytest.raises(failpoint.FailpointError):
+            plane.append(2, {"name": [_set(1, "x")]})
+    finally:
+        failpoint.disarm("cdc.append")
+    failpoint.arm("cdc.deliver", "error(down)")
+    try:
+        with pytest.raises(failpoint.FailpointError):
+            plane.read("name", after=0)
+    finally:
+        failpoint.disarm("cdc.deliver")
+
+
+# ------------------------------------------------------- engine taps
+
+
+def test_engine_commit_tap_and_value_jsonable():
+    db = _db()
+    db.alter("score: int .\nembedding: float32vector .")
+    db.mutate(set_nquads='\n'.join([
+        '_:a <name> "alice" .',
+        '_:a <score> "41"^^<xs:int> .',
+        '_:a <embedding> "[0.5, 1.0]"^^<xs:float32vector> .',
+        '_:a <follows> _:b .']), commit_now=True)
+    name = db.cdc.read("name", after=0)["changes"]
+    assert name[0]["op"] == "set" and name[0]["value"] == "alice"
+    score = db.cdc.read("score", after=0)["changes"]
+    assert score[0]["value"] == 41
+    emb = db.cdc.read("embedding", after=0)["changes"]
+    assert emb[0]["value"] == [0.5, 1.0]  # vectors flatten to JSON
+    fol = db.cdc.read("follows", after=0)["changes"]
+    assert fol[0]["dst"] and "value" not in fol[0]
+    # every entry JSON-serializes (the HTTP surface's contract)
+    json.dumps([name, score, emb, fol])
+
+
+def test_overwrite_expansion_visible_as_del_then_set():
+    db = _db()
+    db.mutate(set_nquads='<0x1> <name> "old" .', commit_now=True)
+    db.mutate(set_nquads='<0x1> <name> "new" .', commit_now=True)
+    ops = [(e["op"], e.get("value"))
+           for e in db.cdc.read("name", after=0)["changes"]]
+    # the tap sees the EXPANDED records (same as the WAL): the
+    # single-value overwrite carries its synthesized delete
+    assert ops == [("set", "old"), ("del", "old"), ("set", "new")]
+
+
+def test_wal_replay_rebuilds_change_log(tmp_path):
+    wal = str(tmp_path / "wal")
+    db = GraphDB(wal_path=wal, prefer_device=False)
+    db.alter("name: string .")
+    db.mutate(set_nquads='_:a <name> "durable" .', commit_now=True)
+    before = db.cdc.read("name", after=0)
+    db.close()
+    db2 = GraphDB(wal_path=wal, prefer_device=False)
+    after = db2.cdc.read("name", after=0)
+    assert json.dumps(before["changes"]) == \
+        json.dumps(after["changes"])  # WAL-backed: byte-identical
+    db2.close()
+
+
+def test_drop_attr_and_drop_all_clear_logs():
+    db = _db()
+    db.mutate(set_nquads='_:a <name> "x" .', commit_now=True)
+    db.alter(drop_attr="name")
+    assert db.cdc.read("name", after=0)["heartbeat"]
+    db.mutate(set_nquads='_:a <follows> _:b .', commit_now=True)
+    db.alter(drop_all=True)
+    assert db.cdc.stats()["preds"] == {}
+
+
+def test_snapshot_restore_sets_floor(tmp_path):
+    from dgraph_tpu.storage.snapshot import load_snapshot, \
+        save_snapshot
+    db = _db()
+    db.mutate(set_nquads='_:a <name> "pre" .', commit_now=True)
+    snap = str(tmp_path / "p.snap")
+    save_snapshot(db, snap)
+    db2 = load_snapshot(snap)
+    # pre-snapshot history lives in base state, not the log: an
+    # offset-0 subscriber must be told to re-sync, never silently skip
+    with pytest.raises(OffsetTruncated) as ei:
+        db2.cdc.read("name", after=0)
+    db2.mutate(set_nquads='_:c <name> "post" .', commit_now=True)
+    r = db2.cdc.read("name",
+                     after=offset_for_ts(ei.value.resync_ts))
+    assert [e["value"] for e in r["changes"]] == ["post"]
+
+
+def test_bulk_load_sets_floor():
+    from dgraph_tpu.ingest.bulk import bulk_load
+    db = bulk_load(nquads=iter([[
+        nq for nq in __import__("dgraph_tpu.gql.nquad",
+                                fromlist=["parse_rdf"])
+        .parse_rdf('_:a <name> "bulk" .')]]),
+        schema="name: string .")
+    with pytest.raises(OffsetTruncated):
+        db.cdc.read("name", after=0)
+
+
+# --------------------------------------------------- HTTP long-poll
+
+
+@pytest.fixture()
+def http_alpha():
+    from dgraph_tpu.server.http import serve
+    httpd, alpha = serve(port=0, block=False)
+    alpha.db.alter("name: string .")
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", alpha
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _http_get(base, path, **params):
+    qs = urllib.parse.urlencode(params)
+    with urllib.request.urlopen(f"{base}{path}?{qs}",
+                                timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_http_subscribe_roundtrip(http_alpha):
+    base, alpha = http_alpha
+    alpha.db.mutate(set_nquads='_:a <name> "one" .', commit_now=True)
+    r = _http_get(base, "/subscribe", pred="name", offset=0,
+                  id="t")
+    assert [e["value"] for e in r["changes"]] == ["one"]
+    r2 = _http_get(base, "/subscribe", pred="name",
+                   offset=r["nextOffset"], waitMs=50)
+    assert r2["heartbeat"]
+    assert _http_get(base, "/debug/stats")["cdc"]["subscribers"][
+        "t"]["pred"] == "name"
+
+
+def test_http_subscribe_truncated_410(http_alpha):
+    base, alpha = http_alpha
+    alpha.db.cdc.cap = 1
+    alpha.db.mutate(set_nquads='_:a <name> "a" .', commit_now=True)
+    alpha.db.mutate(set_nquads='_:b <name> "b" .', commit_now=True)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _http_get(base, "/subscribe", pred="name", offset=0)
+    assert ei.value.code == 410
+    body = json.loads(ei.value.read().decode())
+    ext = body["errors"][0]["extensions"]
+    assert ext["code"] == "OffsetTruncated"
+    assert ext["resyncTs"] >= 1 and ext["floor"] > 0
+    # the advertised re-sync path works
+    r = _http_get(base, "/subscribe", pred="name",
+                  offset=offset_for_ts(ext["resyncTs"]))
+    assert [e["value"] for e in r["changes"]] == ["b"]
+
+
+def test_http_subscribe_requires_pred(http_alpha):
+    base, _ = http_alpha
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _http_get(base, "/subscribe")
+    assert ei.value.code == 400
+
+
+# ----------------------------------------------- cluster wire + replicas
+
+
+def test_wire_subscribe_any_replica_same_offsets():
+    """Leader and follower serve IDENTICAL streams (offsets are
+    deterministic functions of the replicated records) — the failover
+    contract the dgchaos cdc nemesis leans on."""
+    from dgraph_tpu.bench.spawn import free_ports
+    from dgraph_tpu.cluster.client import ClusterClient
+    from dgraph_tpu.cluster.service import AlphaServer
+
+    ports = free_ports(4)
+    raft = {1: ("127.0.0.1", ports[0]), 2: ("127.0.0.1", ports[1])}
+    srvs = [AlphaServer(i, raft, ("127.0.0.1", ports[1 + i]),
+                        tick_s=0.02, election_ticks=5)
+            for i in (1, 2)]
+    cl = ClusterClient({i: s.client_addr
+                        for i, s in enumerate(srvs, 1)}, timeout=10.0)
+    try:
+        deadline = time.monotonic() + 10
+        while not any(s.is_leader() for s in srvs):
+            if time.monotonic() > deadline:
+                pytest.fail("no leader")
+            time.sleep(0.05)
+        cl.alter("name: string .")
+        for i in range(3):
+            cl.mutate(set_nquads=f'_:a <name> "v{i}" .')
+        # replication to the follower is async: wait for parity
+        deadline = time.monotonic() + 10
+        streams = []
+        while time.monotonic() < deadline:
+            streams = [
+                cl._rpc_once(i, {"op": "subscribe", "pred": "name",
+                                 "offset": 0, "limit": 64})
+                for i in (1, 2)]
+            if all(s and s.get("ok") for s in streams) and \
+                    len({json.dumps(s["result"]["changes"])
+                         for s in streams}) == 1 \
+                    and len(streams[0]["result"]["changes"]) >= 3:
+                break
+            time.sleep(0.1)
+        assert len(streams[0]["result"]["changes"]) >= 3
+        assert json.dumps(streams[0]["result"]["changes"]) == \
+            json.dumps(streams[1]["result"]["changes"])
+        # typed truncation crosses the wire
+        srvs[0].db.cdc.cap = 1
+        srvs[0].db.cdc._logs["name"].evict_to_cap(1)
+        with pytest.raises(OffsetTruncated):
+            ClusterClient({1: srvs[0].client_addr},
+                          timeout=5.0).subscribe("name", offset=0)
+    finally:
+        cl.close()
+        for s in srvs:
+            s.close()

@@ -207,6 +207,30 @@ def test_gv_native_numpy_byte_parity(i, uids):
     np.testing.assert_array_equal(codec.gv_decode_np(nat), uids)
 
 
+def test_gv_small_scalar_byte_parity():
+    """The short-list scalar encoder (the bulk-ingest snapshot fast
+    path) must be byte-identical to gv_encode_np at EVERY length
+    through the crossover, including all width codes and group-of-4
+    boundary shapes."""
+    rng = RNG(23)
+    cases = [np.empty(0, np.uint64),
+             np.array([0], np.uint64),
+             np.array([2**64 - 1], np.uint64),
+             np.array([0, 255, 256, 65_535, 65_536, 2**32 - 1,
+                       2**32, 2**64 - 1], np.uint64)]
+    for n in range(1, 64):
+        cases.append(np.unique(
+            rng.integers(0, 2**48, n, dtype=np.uint64)))
+    for uids in cases:
+        small = codec._gv_encode_py_small(uids)
+        assert small == codec.gv_encode_np(uids), uids
+        np.testing.assert_array_equal(codec.gv_decode_np(small),
+                                      uids)
+    # the dispatcher picks the scalar path below the crossover and
+    # both paths stay on one byte format
+    assert codec.gv_encode(cases[3]) == codec.gv_encode_np(cases[3])
+
+
 def test_gv_decode_rejects_truncation():
     buf = codec.gv_encode_np(np.arange(100, dtype=np.uint64))
     with pytest.raises(ValueError):

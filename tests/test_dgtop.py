@@ -8,7 +8,8 @@ import urllib.request
 import pytest
 
 from tools.dgtop import (
-    _histo_mean, hottest, node_row, poll, render, slowest_stages)
+    _histo_mean, hottest, ingest_cdc_rows, node_row, poll, render,
+    slowest_stages)
 
 
 def _snap(t=100.0, queries=50.0, shed=2.0, hits=40.0, misses=10.0,
@@ -71,6 +72,40 @@ def test_histo_mean():
     assert _histo_mean(None) is None
     assert _histo_mean({"buckets": [], "sum": 0.0}) is None
     assert _histo_mean({"buckets": [1, 3], "sum": 8.0}) == 2.0
+
+
+def test_ingest_cdc_rows_rates_and_lag():
+    a = _snap(t=100.0)
+    a["stats"]["counters"].update({
+        "dgraph_ingest_mapped_total": 1000.0,
+        "dgraph_cdc_appended_total": 40.0,
+        "dgraph_cdc_delivered_total": 30.0})
+    a["stats"]["gauges"] = {"dgraph_cdc_tail_entries": 12.0}
+    a["stats"]["cdc"] = {"preds": {"name": {"head": 99, "floor": 0,
+                                            "entries": 12}},
+                         "subscribers": {"s1": {"pred": "name",
+                                                "offset": 64,
+                                                "lag": 3}}}
+    b = _snap(t=110.0)
+    b["stats"]["counters"].update({
+        "dgraph_ingest_mapped_total": 2000.0,
+        "dgraph_cdc_appended_total": 90.0,
+        "dgraph_cdc_delivered_total": 80.0})
+    b["stats"]["gauges"] = {"dgraph_cdc_tail_entries": 20.0}
+    b["stats"]["cdc"] = a["stats"]["cdc"]
+    nodes, subs = ingest_cdc_rows({"n1": b}, {"n1": a})
+    assert nodes[0]["map_rate"] == pytest.approx(100.0)
+    assert nodes[0]["append_rate"] == pytest.approx(5.0)
+    assert nodes[0]["deliver_rate"] == pytest.approx(5.0)
+    assert nodes[0]["tail"] == 20.0
+    assert subs == [{"node": "n1", "id": "s1", "pred": "name",
+                     "offset": 64, "lag": 3}]
+    # the panel renders (and disappears on idle nodes)
+    frame = render({"n1": b}, {"n1": a})
+    assert "INGEST/CDC" in frame and "CDC SUBSCRIBERS" in frame
+    assert "s1 @ n1" in frame
+    idle_nodes, idle_subs = ingest_cdc_rows({"n1": _snap()}, None)
+    assert idle_nodes == [] and idle_subs == []
 
 
 def test_hottest_tablets_cluster_wide_order():

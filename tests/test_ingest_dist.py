@@ -1,0 +1,242 @@
+"""Distributed ingest (ingest/distributed.py): oracle parity,
+shuffle idempotence, crash-retry determinism, size rebalance, and the
+byte-accurate spill accounting fix in ingest/bulk.py."""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.engine.db import GraphDB
+from dgraph_tpu.ingest.bulk import _posting_cost, bulk_load
+from dgraph_tpu.ingest.distributed import (
+    IngestDriver, _ShuffleSink, distributed_load, pred_group,
+)
+from dgraph_tpu.models.types import TypeID, Val
+from dgraph_tpu.storage.snapshot import load_snapshot
+from dgraph_tpu.storage.tablet import Posting
+from dgraph_tpu.utils import failpoint
+from dgraph_tpu import wire
+
+SCHEMA = """\
+name: string @index(exact) .
+age: int @index(int) .
+knows: [uid] @reverse .
+note: string .
+"""
+
+
+def _rdf(tmp_path, n=120, name="seed.rdf"):
+    lines = []
+    for i in range(n):
+        lines.append(f'_:p{i} <name> "person {i}" .')
+        lines.append(f'_:p{i} <age> "{20 + i % 50}"^^<xs:int> .')
+        lines.append(f"_:p{i} <knows> _:p{(i + 1) % n} .")
+        if i % 3 == 0:
+            lines.append(f'_:p{i} <note> "n{i}"@en .')
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path, lines
+
+
+def _merged(outdir, manifest):
+    db = GraphDB(prefer_device=False)
+    for g in sorted(manifest["groups"]):
+        load_snapshot(os.path.join(outdir, f"g{g}", "p.snap"), db)
+    return db
+
+
+def _assert_tablets_equal(a: GraphDB, b: GraphDB):
+    assert sorted(a.tablets) == sorted(b.tablets)
+    for pred in a.tablets:
+        ta, tb = a.tablets[pred], b.tablets[pred]
+        assert sorted(ta.edges) == sorted(tb.edges), pred
+        for s in ta.edges:
+            assert np.array_equal(ta.edges[s], tb.edges[s]), (pred, s)
+        assert sorted(ta.values) == sorted(tb.values), pred
+        for s in ta.values:
+            assert repr(ta.values[s]) == repr(tb.values[s]), (pred, s)
+        assert sorted(ta.index) == sorted(tb.index), pred
+
+
+def test_in_process_parity_with_single_core_oracle(tmp_path):
+    """Same file through both loaders -> identical tablets AND
+    identical uids (the driver pre-assigns blank nodes in file
+    order), so query JSON is byte-identical."""
+    rdf, _ = _rdf(tmp_path)
+    oracle = bulk_load([rdf], schema=SCHEMA)
+    out = str(tmp_path / "out")
+    m = distributed_load([rdf], schema=SCHEMA, groups=2, workers=2,
+                         outdir=out, in_process=True,
+                         chunk_bytes=2048, timeout_s=120)
+    merged = _merged(out, m)
+    _assert_tablets_equal(oracle, merged)
+    for q in ('{ q(func: eq(name, "person 7")) { name age '
+              'knows { name } } }',
+              '{ q(func: ge(age, 60)) { name } }'):
+        a = json.loads(oracle.query_json(q))["data"]
+        b = json.loads(merged.query_json(q))["data"]
+        assert json.dumps(a, sort_keys=True) == \
+            json.dumps(b, sort_keys=True)
+    # manifest watermarks cover the leased uid space
+    assert m["max_ts"] >= 1
+    assert m["next_uid"] > 120
+
+
+def test_runs_are_byte_deterministic(tmp_path):
+    """Two independent loads of the same input produce IDENTICAL
+    snapshot FILES — the contract that makes a retried shard
+    verifiable."""
+    rdf, _ = _rdf(tmp_path)
+    outs = []
+    for run in ("a", "b"):
+        out = str(tmp_path / run)
+        distributed_load([rdf], schema=SCHEMA, groups=2, workers=2,
+                         outdir=out, in_process=True,
+                         chunk_bytes=2048, timeout_s=120)
+        outs.append(out)
+    for g in (1, 2):
+        pa = open(os.path.join(outs[0], f"g{g}", "p.snap"),
+                  "rb").read()
+        pb = open(os.path.join(outs[1], f"g{g}", "p.snap"),
+                  "rb").read()
+        assert pa == pb, f"group {g} snapshot bytes diverged"
+
+
+def test_size_rebalance_spreads_skewed_predicates(tmp_path):
+    """Predicates all hashing to ONE group still land balanced: the
+    driver reassigns by spilled bytes and the assignee streams the
+    spill run from its hash home (fetch_spill)."""
+    rdf, _ = _rdf(tmp_path)
+    out = str(tmp_path / "out")
+    m = distributed_load([rdf], schema=SCHEMA, groups=2, workers=1,
+                         outdir=out, in_process=True,
+                         chunk_bytes=4096, timeout_s=120)
+    sizes = {g: len(ps) for g, ps in m["groups"].items()}
+    assert all(n >= 1 for n in sizes.values()), m["groups"]
+    # at least one predicate moved off its hash home
+    moved = [p for p, g in m["tablets"].items()
+             if pred_group(p, 2) != g]
+    hash_homes = {pred_group(p, 2) for p in m["tablets"]}
+    if len(hash_homes) == 1:
+        assert moved, "skewed input was not rebalanced"
+    # and the moved data is actually THERE
+    merged = _merged(out, m)
+    oracle = bulk_load([rdf], schema=SCHEMA)
+    _assert_tablets_equal(oracle, merged)
+
+
+def test_worker_sigkill_mid_shuffle_retries_byte_identical(tmp_path):
+    """A map worker SIGKILLed mid-shuffle: its chunks requeue onto a
+    healthy worker, partially-streamed (uncommitted) parts are
+    discarded, and the final snapshots are byte-identical to an
+    unkilled run's (the determinism contract under crash-retry)."""
+    rdf, _ = _rdf(tmp_path, n=400)
+    clean = str(tmp_path / "clean")
+    distributed_load([rdf], schema=SCHEMA, groups=2, workers=2,
+                     outdir=clean, chunk_bytes=4096, timeout_s=180)
+    # armed via the env channel: under pytest the driver exec-spawns
+    # (jax is loaded), and exec children inherit failpoints from
+    # DGRAPH_TPU_FAILPOINTS at import — every part send then stalls
+    # 60 ms, guaranteeing the SIGKILL a mid-shuffle window
+    os.environ[failpoint.ENV_VAR] = "ingest.shuffle=sleep(0.06)"
+    try:
+        out = str(tmp_path / "killed")
+        d = IngestDriver([rdf], SCHEMA, groups=2, workers=2,
+                         outdir=out, chunk_bytes=4096,
+                         timeout_s=180)
+        import threading
+        killed = []
+
+        def killer():
+            # wait until the victim has actually mapped something
+            # (chunk traffic observed), then SIGKILL it mid-protocol
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                with d._lock:
+                    started = d.stats["chunks"] > 2 and d._assigned
+                if started:
+                    break
+                time.sleep(0.05)
+            time.sleep(0.3)  # land inside a slowed part-send window
+            victim = d.worker_procs[0]
+            if victim.is_alive():
+                os.kill(victim.pid, signal.SIGKILL)
+                killed.append(victim.pid)
+
+        t = threading.Thread(target=killer, daemon=True)
+        t.start()
+        m = d.run()
+        t.join(5)
+        assert killed, "nemesis never fired"
+    finally:
+        del os.environ[failpoint.ENV_VAR]
+    assert m["stats"]["mapped"] >= 1200
+    for g in (1, 2):
+        a = open(os.path.join(clean, f"g{g}", "p.snap"),
+                 "rb").read()
+        b = open(os.path.join(out, f"g{g}", "p.snap"), "rb").read()
+        assert a == b, f"group {g} diverged after crash-retry"
+
+
+# ------------------------------------------------ shuffle sink unit
+
+
+def _part_blob(chunk, pred, srcs):
+    return wire.dumps({"op": "part", "chunk": chunk, "pred": pred,
+                       "srcs": np.asarray(srcs, np.uint64),
+                       "dsts": np.asarray(srcs, np.uint64),
+                       "facets": [], "vsrc": np.empty(0, np.uint64),
+                       "vval": [], "vlang": [], "vfacets": []})
+
+
+def test_shuffle_sink_commit_is_idempotent(tmp_path):
+    sink = _ShuffleSink(str(tmp_path))
+    sink.handle(wire.dumps({"op": "chunk_begin", "chunk": 1}))
+    sink.handle(_part_blob(1, "name", [1, 2]))
+    sink.handle(wire.dumps({"op": "chunk_commit", "chunk": 1}))
+    size1 = sink.sizes()["name"]
+    # full re-delivery of the committed chunk (crash-retry): dropped
+    sink.handle(wire.dumps({"op": "chunk_begin", "chunk": 1}))
+    sink.handle(_part_blob(1, "name", [1, 2]))
+    got = wire.loads(wire.dumps(
+        sink.handle(wire.dumps({"op": "chunk_commit", "chunk": 1}))))
+    assert got.get("dup")
+    assert sink.sizes()["name"] == size1
+    sink.close()
+
+
+def test_shuffle_sink_discards_uncommitted_staging(tmp_path):
+    sink = _ShuffleSink(str(tmp_path))
+    sink.handle(wire.dumps({"op": "chunk_begin", "chunk": 7}))
+    sink.handle(_part_blob(7, "name", [5]))
+    # the worker dies here; the retry re-begins the SAME chunk with
+    # different interleaving — staging resets, nothing double-lands
+    sink.handle(wire.dumps({"op": "chunk_begin", "chunk": 7}))
+    sink.handle(_part_blob(7, "name", [5]))
+    sink.handle(wire.dumps({"op": "chunk_commit", "chunk": 7}))
+    from dgraph_tpu.ingest.distributed import _read_runs
+    parts = _read_runs(sink.runs()["name"])
+    assert len(parts) == 1 and parts[0]["srcs"].tolist() == [5]
+    sink.close()
+
+
+# ------------------------------------------- spill accounting fix
+
+
+def test_posting_cost_is_byte_accurate_for_vectors():
+    vec = Posting(Val(TypeID.FLOAT32VECTOR,
+                      np.zeros(256, np.float32)))
+    s = Posting(Val(TypeID.STRING, "x" * 100))
+    i = Posting(Val(TypeID.INT, 7))
+    # a 1 KiB vector payload must cost ~its real size, not "one
+    # edge" — the undercount the satellite fix closes; scalar costs
+    # approximate RESIDENT object size (Posting/Val shells included)
+    assert _posting_cost(vec) >= 1024
+    assert 180 <= _posting_cost(s) <= 280
+    assert _posting_cost(i) <= 160
+    assert _posting_cost(vec) > 6 * _posting_cost(i)

@@ -81,6 +81,23 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.dgbench --smoke \
 test -s "$SMOKE_DIR/dgtop.txt"   # the archived cluster-state artifact
 echo "smoke report: $SMOKE_DIR"
 
+echo "== ingest smoke =="
+# ~30 s distributed-ingest gate (tools/dgingest.py --smoke): a small
+# seeded workload through the map→shuffle→reduce pipeline at 2 groups
+# x 2 workers, reduced shards BOOTED as a real ProcessCluster via
+# `node --snapshot`, and every golden read byte-compared against the
+# single-core bulk_load oracle. Exit non-zero on any parity mismatch.
+INGEST_DIR="${TMPDIR:-/tmp}/dgingest-smoke"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.dgingest --smoke \
+    --report-dir "$INGEST_DIR" --out "$INGEST_DIR/BENCH_INGEST.json"
+test -s "$INGEST_DIR/BENCH_INGEST.json"
+
+echo "== cdc smoke =="
+# ~5 s change-stream gate (tools/cdc_smoke.py): subscribe -> mutate ->
+# replay-from-offset x2 byte check, long-poll heartbeat + wakeup,
+# mid-stream resume, and subscriber lag on /debug/stats
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.cdc_smoke
+
 echo "== chaos smoke =="
 # ~45 s nemesis cycle on a 2-group mini cluster with durable dirs
 # (tools/dgchaos.py --smoke): one partition-heal + one SIGKILL-restart

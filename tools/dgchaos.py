@@ -596,6 +596,197 @@ NEMESES = {cls.name: cls for cls in (
     KillRandom, RollingRestart, PartitionKill)}
 
 
+# ---------------------------------------------------------- CDC nemesis
+
+
+def run_cdc_phase(args, cluster, rc, rng) -> dict:
+    """The change-stream fault-tolerance phase (`--nemeses ...,cdc`):
+    a subscriber tails `chaos.cdc` (group 1) by long-poll while a
+    writer commits numbered opids; mid-stream the SERVING node is
+    partitioned off (raft-isolated: it stops applying commits, so the
+    subscriber sees dead-air heartbeats and must fail over to another
+    replica WITH ITS OFFSET — at-least-once across replicas is the
+    whole design: offsets are deterministic functions of the
+    replicated record stream), then the group leader is SIGKILLed and
+    restarted. Checker: every ACKED opid observed at least once; the
+    first-seen offset sequence never goes backwards (re-delivery of
+    already-seen offsets is allowed, silent reordering is not); one
+    offset never maps to two different values across replicas; each
+    opid's observed commitTs matches the commit ack."""
+    from dgraph_tpu.cdc.changelog import OffsetTruncated
+    from dgraph_tpu.cluster.client import ClusterClient
+
+    pred = "chaos.cdc"
+    rc.alter(f"{pred}: string .")
+    rc.zero.tablet(pred, 1)
+    g1 = sorted(n for n in cluster.node_addrs
+                if n.startswith("alpha-g1-"))
+    subs = {n: ClusterClient(
+        {1: cluster.node_addrs[n]["client"]}, timeout=2.0)
+        for n in g1}
+
+    stop_writer = threading.Event()
+    stop_sub = threading.Event()
+    acked: dict[str, int] = {}
+    alock = threading.Lock()
+    observed: list[dict] = []   # first-seen entries, arrival order
+    seen: dict[int, str] = {}   # offset -> value
+    state = {"node": g1[0], "resumes": 0, "order_violations": 0,
+             "offset_conflicts": 0, "truncated": 0, "polls": 0,
+             "heartbeats": 0, "redelivered": 0}
+
+    def writer():
+        i = 0
+        while not stop_writer.is_set():
+            opid = f"cdc-{i}"
+            try:
+                out = rc.mutate(
+                    set_nquads=f'_:c <{pred}> "{opid}" .',
+                    deadline_ms=args.deadline_ms)
+                cts = out.get("extensions", {}).get("txn", {}) \
+                    .get("commit_ts")
+                if cts:
+                    with alock:
+                        acked[opid] = int(cts)
+            except Exception:  # noqa: BLE001 — unacked: not owed  # dglint: disable=DG07 (load generator; failures are the point)
+                pass
+            i += 1
+            time.sleep(0.05)
+
+    def subscriber():
+        offset = 0
+        max_off = 0
+        idle = 0
+        while not stop_sub.is_set():
+            node = state["node"]
+            try:
+                r = subs[node].subscribe(pred, offset=offset,
+                                         wait_ms=400, limit=64,
+                                         sub_id="chaos-cdc")
+            except OffsetTruncated:
+                state["truncated"] += 1  # checker: must never happen
+                return                   # (cap >> phase volume)
+            except Exception:  # noqa: BLE001 — fail over, resume  # dglint: disable=DG07 (the failover path under test)
+                state["resumes"] += 1
+                state["node"] = g1[(g1.index(node) + 1) % len(g1)]
+                time.sleep(0.1)
+                continue
+            state["polls"] += 1
+            if r["heartbeat"]:
+                state["heartbeats"] += 1
+                idle += 1
+                with alock:
+                    owed = len(acked) > len(
+                        {e["value"] for e in observed})
+                if idle >= 3 and owed and len(g1) > 1:
+                    # the stream is silent but commits are acking:
+                    # this replica is cut off — fail over, SAME offset
+                    state["resumes"] += 1
+                    state["node"] = g1[(g1.index(node) + 1)
+                                       % len(g1)]
+                    idle = 0
+                continue
+            idle = 0
+            for e in r["changes"]:
+                off = e["offset"]
+                if off in seen:
+                    state["redelivered"] += 1
+                    if seen[off] != e.get("value"):
+                        state["offset_conflicts"] += 1
+                    continue
+                if off < max_off:
+                    state["order_violations"] += 1
+                seen[off] = e.get("value")
+                max_off = max(max_off, off)
+                observed.append({"offset": off,
+                                 "commitTs": e["commitTs"],
+                                 "value": e.get("value"),
+                                 "node": node})
+            offset = max(offset, r["nextOffset"])
+
+    wt = threading.Thread(target=writer, daemon=True)
+    st = threading.Thread(target=subscriber, daemon=True)
+    wt.start()
+    st.start()
+    try:
+        time.sleep(args.pre_s)
+        # fault 1: raft-partition the node the subscriber is on (its
+        # client listener stays reachable — the node serves a FROZEN
+        # stream, the worst case for a tailing consumer)
+        victim = state["node"]
+        others = [n for n in cluster.node_addrs if n != victim]
+        nem = Nemesis({"cluster": cluster,
+                       "node_clients": subs, "rng": rng})
+        log(f"cdc: partitioning serving node {victim}")
+        for o in others:
+            # one-sided is enough to freeze raft; rules live on the
+            # victim so _clear_all on the sub clients heals them
+            nem._fault(victim, {"action": "add", "rule": {
+                "dst": nem._addrs_of(o), "drop": 1.0}})
+        time.sleep(args.fault_s)
+        nem._fault(victim, {"action": "clear"})
+        log("cdc: partition healed; SIGKILL g1 leader")
+        # fault 2: kill the serving group's leader mid-stream
+        leader = cluster.leader_of("g1")
+        cluster.kill(leader)
+        time.sleep(max(2.0, args.fault_s / 2))
+        cluster.restart(leader)
+        cluster.wait_caught_up(leader)
+        t_heal = time.monotonic()
+        stop_writer.set()
+        wt.join(10)
+        # drain: the subscriber must observe every acked opid
+        deadline = time.monotonic() + max(15.0, args.recover_s)
+        while time.monotonic() < deadline:
+            with alock:
+                missing = set(acked) - {e["value"] for e in observed}
+            if not missing:
+                break
+            time.sleep(0.2)
+        ttr = round(time.monotonic() - t_heal, 3)
+    finally:
+        stop_writer.set()
+        stop_sub.set()
+        st.join(5)
+        for cl in subs.values():
+            cl.close()
+
+    with alock:
+        missing = sorted(set(acked) - {e["value"] for e in observed})
+        violations = []
+        if missing:
+            violations.append({"type": "lost-change",
+                               "opids": missing[:10],
+                               "count": len(missing)})
+        if state["order_violations"]:
+            violations.append({"type": "out-of-order",
+                               "count": state["order_violations"]})
+        if state["offset_conflicts"]:
+            violations.append({"type": "offset-conflict",
+                               "count": state["offset_conflicts"]})
+        if state["truncated"]:
+            violations.append({"type": "unexpected-truncation",
+                               "count": state["truncated"]})
+        by_val = {e["value"]: e["commitTs"] for e in observed}
+        ts_mismatch = [o for o, cts in acked.items()
+                       if o in by_val and by_val[o] != cts]
+        if ts_mismatch:
+            violations.append({"type": "commit-ts-mismatch",
+                               "opids": ts_mismatch[:10],
+                               "count": len(ts_mismatch)})
+        stats = {"acked": len(acked), "observed": len(observed),
+                 "redelivered": state["redelivered"],
+                 "resumes": state["resumes"],
+                 "heartbeats": state["heartbeats"],
+                 "polls": state["polls"]}
+    log(f"cdc: {stats}, violations {len(violations)}")
+    return {"nemesis": "cdc", "cdc": stats,
+            "cdc_violations": violations,
+            "ops": stats["acked"], "rate_qps": 20.0,
+            "unavailability_s": None,
+            "time_to_recover_s": ttr if not missing else None}
+
+
 # ---------------------------------------------------------------- main
 
 
@@ -769,7 +960,7 @@ def main(argv=None) -> int:
         args.rate = min(args.rate, 25.0)
         args.pre_s, args.fault_s, args.recover_s = 3.0, 4.0, 10.0
         args.ldbc_persons = 0
-        args.nemeses = "partition-majority,kill-leader"
+        args.nemeses = "partition-majority,kill-leader,cdc"
         args.slo_ms = max(args.slo_ms, 2000.0)
     # the bank is cross-group BY CONSTRUCTION (bal on g1, ledger on
     # g2): fewer than two groups would silently drop the 2PC coverage
@@ -778,8 +969,9 @@ def main(argv=None) -> int:
     rng = random.Random(args.seed)
     names = [n.strip() for n in args.nemeses.split(",") if n.strip()]
     for n in names:
-        if n not in NEMESES:
-            log(f"unknown nemesis {n!r}; have {sorted(NEMESES)}")
+        if n not in NEMESES and n != "cdc":
+            log(f"unknown nemesis {n!r}; have "
+                f"{sorted(NEMESES) + ['cdc']}")
             return 2
 
     t_run = time.monotonic()
@@ -812,6 +1004,12 @@ def main(argv=None) -> int:
 
             phases = []
             for ix, name in enumerate(names):
+                if name == "cdc":
+                    # change-stream fault tolerance: its own driver +
+                    # checker (subscriber/writer, not the bank)
+                    phases.append(run_cdc_phase(args, cluster, rc,
+                                                rng))
+                    continue
                 nem = NEMESES[name](ctx)
                 phases.append(run_nemesis_phase(
                     args, bank, nem, rng, noise_reads, ix))
@@ -850,6 +1048,10 @@ def main(argv=None) -> int:
         "unit": "s",
         "checker_ok": verdict["ok"],
         "violations": len(verdict["violations"]),
+        "cdc_ok": all(not p.get("cdc_violations")
+                      for p in phases if p["nemesis"] == "cdc"),
+        "cdc_violations": sum(len(p.get("cdc_violations", ()))
+                              for p in phases),
         "nemeses": names,
         "groups": args.groups, "replicas": args.replicas,
         "zeros": args.zeros, "accounts": args.accounts,
@@ -873,9 +1075,13 @@ def main(argv=None) -> int:
             or verdict["stats"]["full_reads"] < 5:
         bad.append(f"workload starved: {verdict['stats']}")
     for p in phases:
+        if p.get("cdc_violations"):
+            bad.append(f"cdc checker: {p['cdc_violations'][:3]}")
         if p["time_to_recover_s"] is None:
             bad.append(f"{p['nemesis']}: never recovered to "
-                       f"p99<={args.slo_ms}ms")
+                       f"p99<={args.slo_ms}ms"
+                       if p["nemesis"] != "cdc" else
+                       "cdc: subscriber never caught up after heal")
     if bad:
         log("CHAOS FAILED: " + "; ".join(bad))
         return 1

@@ -127,6 +127,56 @@ def _histo_mean(h: Optional[dict]) -> Optional[float]:
     return (h.get("sum", 0.0) / n) if n else None
 
 
+def ingest_cdc_rows(snaps: dict[str, dict],
+                    prev: Optional[dict[str, dict]] = None
+                    ) -> tuple[list[dict], list[dict]]:
+    """The INGEST/CDC panel's rows: per-node ingest/CDC counter rates
+    (RDF/s through map/reduce, change-log append/deliver rates, tail
+    depth) and per-subscriber lag from /debug/stats `cdc`. Pure —
+    tests drive it with canned payloads. Nodes with zero ingest/CDC
+    activity produce no row (the panel disappears when idle)."""
+    nodes = []
+    subs = []
+    for node in sorted(snaps):
+        snap = snaps[node]
+        if snap is None:
+            continue
+        counters = snap["stats"].get("counters", {})
+        gauges = snap["stats"].get("gauges", {})
+        p = (prev or {}).get(node)
+        dt = None
+        if p is not None:
+            dt = max(1e-6, snap["t"] - p["t"])
+
+        def rate(name: str) -> float:
+            cur = counters.get(name, 0.0)
+            if dt is None:
+                return float(cur)
+            return (cur - p["stats"].get("counters", {})
+                    .get(name, 0.0)) / dt
+
+        row = {
+            "node": node,
+            "map_rate": rate("dgraph_ingest_mapped_total"),
+            "reduce_rate": rate("dgraph_ingest_reduced_total"),
+            "append_rate": rate("dgraph_cdc_appended_total"),
+            "deliver_rate": rate("dgraph_cdc_delivered_total"),
+            "tail": gauges.get("dgraph_cdc_tail_entries", 0),
+        }
+        if any(row[k] for k in ("map_rate", "reduce_rate",
+                                "append_rate", "deliver_rate",
+                                "tail")):
+            nodes.append(row)
+        cdc = snap["stats"].get("cdc") or {}
+        for sid, rec in sorted((cdc.get("subscribers")
+                                or {}).items()):
+            subs.append({"node": node, "id": sid,
+                         "pred": rec.get("pred", "?"),
+                         "offset": rec.get("offset", 0),
+                         "lag": rec.get("lag", 0)})
+    return nodes, subs
+
+
 def hottest(snaps: dict[str, dict], top: int = 5) -> list[dict]:
     """Cluster-wide hottest tablets by query-path touches, with their
     cheap size facts. Pure — tests drive it with canned payloads."""
@@ -211,6 +261,24 @@ def render(snaps: dict[str, dict],
                 f"{r.get('id', '?') + ' @ ' + node:<34} {dst:<28.28} "
                 f"{r.get('drop', 0):>5.2f} {delay:>7} "
                 f"{r.get('dup', 0):>5.2f}")
+    ing, subs = ingest_cdc_rows(snaps, prev)
+    if ing:
+        lines.append("")
+        lines.append(f"{'INGEST/CDC':<28} {'MAP/S':>9} {'RED/S':>9} "
+                     f"{'APP/S':>8} {'DEL/S':>8} {'TAIL':>7}")
+        for r in ing:
+            lines.append(
+                f"{r['node']:<28} {r['map_rate']:>9.0f} "
+                f"{r['reduce_rate']:>9.0f} {r['append_rate']:>8.1f} "
+                f"{r['deliver_rate']:>8.1f} {r['tail']:>7.0f}")
+    if subs:
+        lines.append("")
+        lines.append(f"{'CDC SUBSCRIBERS':<40} {'PRED':<20} "
+                     f"{'OFFSET':>12} {'LAG':>6}")
+        for s in subs:
+            lines.append(
+                f"{s['id'] + ' @ ' + s['node']:<40} "
+                f"{s['pred']:<20.20} {s['offset']:>12} {s['lag']:>6}")
     hot = hottest(snaps)
     if hot:
         lines.append("")
