@@ -395,6 +395,113 @@ def stats_overhead_bench(runs: int = 5,
     return rec
 
 
+def planner_overhead_bench(runs: int = 5,
+                           budget_frac: float = None) -> dict:
+    """`--planner-overhead`: cost of the adaptive planner's per-stage
+    tier decisions on the golden summary workload, decomposed like the
+    stats/pprof/netfault gates (a sub-1% A/B cannot resolve through
+    shared-runner scheduler noise):
+
+      (1) per-CONSULT cost (a choose() that hits the plan's decision
+          cache — the rebuild/cold path) and per-SERVE cost (the
+          executor's warm _routed plan-layer probe — the steady
+          state), each best-of-N on a real compiled plan;
+      (2) consults AND warm serves per pass, counted by the planner
+          on the real workload (warm passes consult zero times; the
+          serves term is what keeps this gate meaningful then);
+      (3) pass time, best-of-N.
+
+    overhead fraction = (consults x per-consult + serves x per-serve)
+    / pass time, budget < 1% (DGRAPH_TPU_PLANNER_BUDGET overrides).
+
+    Doubles as the PLANNER SMOKE: after warm-up the workload must
+    reach a pass that BUILDS zero new decisions — every stage served
+    its tier from the plan cache (re-optimization may fire while
+    estimates settle, so convergence is the assertion, not
+    first-pass silence). Non-zero exit on either failure."""
+    if budget_frac is None:
+        budget_frac = float(os.environ.get(
+            "DGRAPH_TPU_PLANNER_BUDGET", "0.01"))
+    db, queries = _summary_mix()
+    pl = getattr(db, "planner_impl", None)
+    assert pl is not None, \
+        "summary-mix engine must run the adaptive planner"
+
+    # (1) per-consult (choose with a cached decision) and per-serve
+    # (the executor's warm _routed probe, incl. the per-request memo
+    # reset a fresh request implies) on a real compiled plan
+    from dgraph_tpu.query.executor import Executor
+
+    parsed, plan = db.plan_cache.lookup(
+        db, '{ q(func: eq(name, "Movie 1")) { uid name } }', None)
+    est = {"estRows": 64, "estRowsMax": 1024, "basis": "stats"}
+    avail = ("postings", "columnar", "compressed")
+    pl.choose(plan, "eq", "name", est, avail)  # build outside timing
+    n_syn = 20_000
+    per_consult_us = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter_ns()
+        for _ in range(n_syn):
+            pl.choose(plan, "eq", "name", est, avail)
+        per_consult_us = min(per_consult_us,
+                             (time.perf_counter_ns() - t0) / n_syn
+                             / 1e3)
+    ex = Executor(db, db.coordinator.max_assigned(), plan=plan)
+    builder = (lambda: pl.choose(plan, "eq", "name", est, avail))
+    ex._routed(("eq", "name", 1), builder)  # seed the routing layer
+    per_serve_us = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter_ns()
+        for _ in range(n_syn):
+            ex._dec_memo.clear()  # a fresh request's plan-layer hit
+            ex._routed(("eq", "name", 1), builder)
+        per_serve_us = min(per_serve_us,
+                           (time.perf_counter_ns() - t0) / n_syn
+                           / 1e3)
+
+    # (2)+(3) real consult volume, pass time, and the convergence
+    # smoke: a pass that serves every decision from the plan cache
+    def one_pass() -> float:
+        return _mix_pass_us(db, queries)
+
+    for _ in range(2):
+        one_pass()  # warm plans, column caches, cost cells
+    converged_pass = None
+    builds_last = -1
+    for i in range(10):
+        before = pl.stats()
+        one_pass()
+        after = pl.stats()
+        builds_last = after["decisions"] - before["decisions"]
+        if builds_last == 0:
+            converged_pass = i + 3  # incl. the 2 warm passes
+            break
+    before = pl.stats()
+    pass_us = one_pass()
+    after = pl.stats()
+    consults = after["consults"] - before["consults"]
+    serves = after["warmServes"] - before["warmServes"]
+    for _ in range(runs - 1):
+        pass_us = min(pass_us, one_pass())
+    frac = (consults * per_consult_us + serves * per_serve_us) \
+        / pass_us if pass_us else 0.0
+    rec = {"metric": "planner_overhead",
+           "queries": len(queries),
+           "pass_ms": round(pass_us / 1e3, 3),
+           "consults_per_pass": int(consults),
+           "warm_serves_per_pass": int(serves),
+           "per_consult_us": round(per_consult_us, 4),
+           "per_serve_us": round(per_serve_us, 4),
+           "overhead_frac": round(frac, 5),
+           "budget_frac": budget_frac,
+           "cache_converged_after_pass": converged_pass,
+           "builds_in_last_checked_pass": builds_last,
+           "within_budget": frac < budget_frac
+           and converged_pass is not None}
+    print(json.dumps(rec))
+    return rec
+
+
 def pprof_overhead_bench(runs: int = 5, threads: int = 12,
                          stack_depth: int = 24,
                          budget_frac: float = None) -> dict:
@@ -545,6 +652,10 @@ def main():
         return
     if "--stats-overhead" in sys.argv:
         if not stats_overhead_bench()["within_budget"]:
+            sys.exit(1)
+        return
+    if "--planner-overhead" in sys.argv:
+        if not planner_overhead_bench()["within_budget"]:
             sys.exit(1)
         return
     if "--pprof-overhead" in sys.argv:

@@ -464,6 +464,220 @@ def main_concurrency(concurrency: int) -> int:
     return 1 if mismatch[0] else 0
 
 
+def _set_planner(db, mode: str) -> None:
+    """Flip one loaded engine between planner modes (the arm sweep
+    mutates flags on a single 21M store exactly like the tier-oracle
+    passes below)."""
+    from dgraph_tpu.query.planner import AdaptivePlanner
+    db.planner = mode
+    db.planner_impl = AdaptivePlanner(db) if mode == "adaptive" \
+        else None
+
+
+def main_planner() -> int:
+    """--planner: adaptive planner vs every statically pinned tier
+    configuration on the identical workload + store.
+
+    Arms (all host-path; the device arm is the main run's business):
+      adaptive          cost-based per-stage tier choice, decisions
+                        cached on plans, self-corrected
+      static            the pre-PR-13 flag heuristics, all tiers on
+                        (the incumbent default)
+      static-columnar   compressed pinned off (dense CSR tier)
+      static-postings   columnar pinned off (the exact-postings
+                        oracle pin)
+
+    Each arm gets its own warm-up passes (the adaptive arm's warm-up
+    is also its training traffic — that is the design, the planner
+    learns from exactly the traffic it serves). Parity: every arm's
+    data payload must be byte-identical. The acceptance read-out:
+    adaptive mixed-workload p50 >= best static pin, and the queries
+    where adaptive beats EVERY pin. Results land under "planner" in
+    BENCH_QUERIES.json (the main summary stays the device-vs-host
+    run's)."""
+    import numpy as np
+
+    from bench import init_backend
+    from dgraph_tpu.utils import coststore
+
+    devs, platform = init_backend()
+    sys.stderr.write(f"jax devices: {devs} (platform={platform})\n")
+    scale = SCALE if platform not in ("cpu", "cpu_fallback") \
+        else min(SCALE, int(os.environ.get("QBENCH_CPU_SCALE", 4)))
+    repeats = max(REPEATS, 5)  # arm deltas are small: steadier p50s
+    workload = load_workload(scale)
+    db, n_rdf = build_db(scale, prefer_device=False)
+
+    arms = [
+        ("adaptive", "adaptive", True, True),
+        ("static", "static", True, True),
+        ("static-columnar", "static", True, False),
+        ("static-postings", "static", False, False),
+    ]
+
+    adaptive_planner = None
+
+    def _set_arm(name):
+        nonlocal adaptive_planner
+        _, mode, columnar, compressed = next(
+            a for a in arms if a[0] == name)
+        db.prefer_columnar = columnar
+        db.prefer_compressed = compressed
+        if mode == "adaptive":
+            # ONE planner instance across the whole sweep: its
+            # learned estimates / re-optimized decisions are the
+            # adaptive arm's state
+            if adaptive_planner is None:
+                _set_planner(db, "adaptive")
+                adaptive_planner = db.planner_impl
+            else:
+                db.planner = "adaptive"
+                db.planner_impl = adaptive_planner
+        else:
+            db.planner = "static"
+            db.planner_impl = None
+
+    # global warm-up (JIT, column caches, tile LRU) outside any arm.
+    # The static pins run FIRST: their stage spans land in the
+    # process-global coststore stamped with each pin's tier, so by
+    # the time the adaptive arm trains, every tier has observed cells
+    # — the production shape (a planner deployed on an engine with
+    # traffic history adapts immediately; a greenfield one converges
+    # via its own fallback observations and rival checks). Then the
+    # adaptive arm's training traffic (the planner learns from
+    # exactly the traffic it serves — that IS the design).
+    coststore.reset()
+    for name, _m, _c, _x in arms[1:]:
+        _set_arm(name)
+        for _ in range(4):
+            for _n, q in workload:
+                db.query(q)
+    _set_arm("adaptive")
+    for _ in range(5):
+        for _n, q in workload:
+            db.query(q)
+    # timing: per QUERY, arms interleaved, min-of-K floors. At this
+    # regime per-request times are fractions of a millisecond and
+    # box noise (GC pauses, CPU steal) is ±10% per shot — medians of
+    # widely spaced single shots measure the noise, not the routing.
+    # The min over K back-to-back runs per (query, arm, round) is
+    # each arm's steady-state floor on that query — exactly what tier
+    # routing controls — and interleaving arms inside each query
+    # keeps any drift fair.
+    K = 3
+    times = {name: {n: [] for n, _ in workload} for name, *_ in arms}
+    outputs: dict[str, dict] = {}
+    for n, q in workload:
+        for r in range(repeats):
+            # rotate the arm order per round: whichever arm runs
+            # first after a query switch pays its cold costs — no arm
+            # gets to always be second
+            order = arms[r % len(arms):] + arms[:r % len(arms)]
+            for name, *_rest in order:
+                _set_arm(name)
+                for _k in range(K):
+                    t = time.perf_counter()
+                    got = db.query(q)
+                    times[name][n].append(time.perf_counter() - t)
+                if r == 0:
+                    outputs.setdefault(name, {})[n] = json.dumps(
+                        got["data"], sort_keys=True)
+    _set_arm("adaptive")
+    planner_stats = dict(db.planner_impl.stats())
+
+    # parity across every arm, all 77 shapes
+    base = outputs["adaptive"]
+    mismatched = sorted(
+        {n for n in base
+         for arm in outputs if outputs[arm][n] != base[n]})
+    # per-query floor (min over all interleaved shots), then the
+    # mixed-workload summary = median of per-query floors
+    p50 = {
+        arm: {n: float(np.min(ts)) * 1e3
+              for n, ts in times[arm].items()} for arm in times}
+    mix50 = {arm: round(float(np.median(
+        list(p50[arm].values()))), 4) for arm in times}
+    static_arms = [a for a in p50 if a != "adaptive"]
+    best_static = min(mix50[a] for a in static_arms)
+    # wins: shapes where adaptive's floor strictly beats EVERY pin's
+    # (the per-shape spread between tiers at this regime is a few
+    # percent, so a wide noise margin would define wins away;
+    # wins_margin_5pct is the conservative count, and the full
+    # per-query table is committed for recomputation)
+    wins = []
+    wins_5pct = 0
+    for n, _q in workload:
+        ours = p50["adaptive"][n]
+        best_pin = min(p50[a][n] for a in static_arms)
+        if ours < best_pin:
+            wins.append({"query": n, "adaptive_ms": round(ours, 3),
+                         "best_static_ms": round(best_pin, 3),
+                         "speedup": round(best_pin / max(ours, 1e-9),
+                                          3)})
+            if ours < 0.95 * best_pin:
+                wins_5pct += 1
+    wins.sort(key=lambda w: -w["speedup"])
+    # the practically-felt wins: vs the DEFAULT static configuration
+    # (what the engine would otherwise do), 10% margin
+    wins_vs_default = sorted(
+        ({"query": n, "adaptive_ms": round(p50["adaptive"][n], 3),
+          "static_ms": round(p50["static"][n], 3),
+          "speedup": round(p50["static"][n]
+                           / max(p50["adaptive"][n], 1e-9), 2)}
+         for n, _q in workload
+         if p50["adaptive"][n] < 0.9 * p50["static"][n]),
+        key=lambda w: -w["speedup"])
+    regressions = []
+    for n, _q in workload:
+        ours = p50["adaptive"][n]
+        best_pin = min(p50[a][n] for a in static_arms)
+        if ours > 1.05 * best_pin:
+            regressions.append(
+                {"query": n, "adaptive_ms": round(ours, 3),
+                 "best_static_ms": round(best_pin, 3),
+                 "slowdown": round(ours / max(best_pin, 1e-9), 2)})
+    regressions.sort(key=lambda w: (w["best_static_ms"]
+                                    - w["adaptive_ms"]))
+    for r in regressions[:8]:
+        sys.stderr.write(f"regression: {r}\n")
+    out = {
+        "metric": f"planner_mix_p50_ms_{n_rdf//1_000_000}M",
+        "value": mix50["adaptive"],
+        "unit": "ms",
+        "vs_baseline": round(best_static
+                             / max(mix50["adaptive"], 1e-9), 3),
+        "platform": platform, "scale": scale, "rdf": n_rdf,
+        "repeats": repeats,
+        "parity_ok": not mismatched,
+        "mismatched": mismatched[:10],
+        "mix_p50_ms": mix50,
+        "at_least_parity": mix50["adaptive"] <= best_static * 1.02,
+        "adaptive_wins_all_pins": len(wins),
+        "wins_margin_5pct": wins_5pct,
+        "wins": wins[:10],
+        "wins_vs_default": wins_vs_default[:10],
+        "regressions": regressions[:10],
+        "planner": planner_stats,
+        "per_query_p50_ms": {
+            arm: {n: round(v, 4) for n, v in p50[arm].items()}
+            for arm in p50},
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_QUERIES.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    doc["planner"] = out
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    print(json.dumps({k: out[k] for k in (
+        "metric", "value", "unit", "vs_baseline", "parity_ok",
+        "at_least_parity", "adaptive_wins_all_pins", "mix_p50_ms")}))
+    return 1 if mismatched else 0
+
+
 def main():
     import numpy as np
 
@@ -575,6 +789,8 @@ if __name__ == "__main__":
         if "--concurrency" in sys.argv:
             n = int(sys.argv[sys.argv.index("--concurrency") + 1])
             sys.exit(main_concurrency(n))
+        if "--planner" in sys.argv:
+            sys.exit(main_planner())
         sys.exit(main())
     except Exception as exc:  # one structured line, never a traceback
         import traceback

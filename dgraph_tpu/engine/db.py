@@ -51,7 +51,7 @@ def _skel_of(plan) -> str:
     """A plan's 16-hex skeleton hash ("" on the interpreted path) —
     the shared join key across the coststore, the request log and
     EXPLAIN output."""
-    return f"{plan.skeleton_hash:016x}" if plan is not None else ""
+    return plan.skeleton_hex if plan is not None else ""
 
 
 def _fp(*parts) -> int:
@@ -132,7 +132,8 @@ class GraphDB:
                  prefer_columnar: bool = True,
                  prefer_compressed: bool = True,
                  host_tile_budget: int = 512 << 20,
-                 plan_cache_size: int = 128):
+                 plan_cache_size: int = 128,
+                 planner: str = "auto"):
         from dgraph_tpu.engine.tile_cache import DeviceCacheLRU
         from dgraph_tpu.ops.codec import DecodeScratch
         from dgraph_tpu.query.plan import PlanCache
@@ -180,6 +181,34 @@ class GraphDB:
         # compressed bytes, decode only surviving blocks). Requires
         # the columnar tier; False keeps the dense CSR exports.
         self.prefer_compressed = prefer_compressed
+        # cost-based adaptive planner (query/planner.py): per-stage
+        # tier choice from tabstats row estimates x coststore observed
+        # cost, decisions cached on the compiled plan, invalidated on
+        # estimate violation / cost drift. "static" pins the pre-PR-13
+        # flag heuristics (the parity oracle for planner testing). The
+        # prefer_* flags above DEMOTE to overrides: they bound which
+        # tiers the planner may pick, they no longer decide per stage.
+        # Adaptive needs the plan cache (decisions live on plans):
+        # "auto" (the default) resolves to adaptive when the cache is
+        # on and static otherwise; an EXPLICIT "adaptive" on a
+        # cache-less engine raises rather than silently demoting.
+        if planner not in ("auto", "adaptive", "static"):
+            raise ValueError(
+                f"planner must be 'auto', 'adaptive' or 'static', "
+                f"got {planner!r}")
+        if planner == "adaptive" and self.plan_cache is None:
+            raise ValueError(
+                "planner='adaptive' needs the plan cache "
+                "(plan_cache_size > 0): decisions are cached on "
+                "compiled plans")
+        if planner in ("auto", "adaptive") \
+                and self.plan_cache is not None:
+            from dgraph_tpu.query.planner import AdaptivePlanner
+            self.planner = "adaptive"
+            self.planner_impl: Any = AdaptivePlanner(self)
+        else:
+            self.planner = "static"
+            self.planner_impl = None
         # bounded per-thread scratch arena the compressed kernels
         # decode into (results are always fresh; see DecodeScratch)
         self.decode_scratch = DecodeScratch()
@@ -1307,4 +1336,6 @@ class GraphDB:
             "deviceCache": self.device_cache.stats(),
             "planCache": self.plan_cache.stats()
             if self.plan_cache is not None else None,
+            "planner": self.planner_impl.stats()
+            if self.planner_impl is not None else {"mode": "static"},
         }

@@ -43,15 +43,22 @@ _GALLOP_RATIO = 16
 _MAX_U32 = 0xFFFFFFFE
 
 
-def intersect_pair(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    """Intersection of two sorted-unique uid vectors."""
+def intersect_pair(a: np.ndarray, b: np.ndarray,
+                   gallop_ratio: int = _GALLOP_RATIO) -> np.ndarray:
+    """Intersection of two sorted-unique uid vectors. `gallop_ratio`
+    is the size-skew threshold past which the searchsorted probe of
+    the big side replaces the full merge — the adaptive planner
+    passes a density-derived value (query/planner.py gallop_ratio:
+    sparse expected intersections gallop from 4x skew, dense ones
+    merge until 48x — the SIMD-intersection paper's pivot) where the
+    static default stays 16."""
     la, lb = len(a), len(b)
     if la == 0 or lb == 0:
         return _EMPTY
     if la > lb:
         a, b = b, a
         la, lb = lb, la
-    if lb >= _GALLOP_RATIO * la:
+    if lb >= gallop_ratio * la:
         idx = np.searchsorted(b, a)
         np.minimum(idx, lb - 1, out=idx)
         return a[b[idx] == a]
@@ -84,10 +91,13 @@ def union_many(parts: Sequence[np.ndarray]) -> np.ndarray:
     return np.unique(np.concatenate(live))
 
 
-def intersect_many(parts: Sequence[np.ndarray]) -> np.ndarray:
+def intersect_many(parts: Sequence[np.ndarray],
+                   gallop_ratio: int = _GALLOP_RATIO) -> np.ndarray:
     """k-way intersection, smallest set first so every galloping probe
     runs over the narrowest possible accumulator (ref
-    algo.IntersectSorted sorts by length, algo/uidlist.go:287)."""
+    algo.IntersectSorted sorts by length, algo/uidlist.go:287).
+    `gallop_ratio` tunes the per-pair gallop-vs-merge pivot (see
+    intersect_pair)."""
     if not len(parts):
         return _EMPTY
     ordered = sorted(parts, key=len)
@@ -95,7 +105,7 @@ def intersect_many(parts: Sequence[np.ndarray]) -> np.ndarray:
     for p in ordered[1:]:
         if not len(acc):
             return _EMPTY
-        acc = intersect_pair(acc, p)
+        acc = intersect_pair(acc, p, gallop_ratio)
     return acc
 
 

@@ -349,6 +349,20 @@ class Executor:
         self._similar_order: Optional[list[int]] = None
         # per-request column-view memo (one snapshot, one verdict)
         self._cv_memo: dict = {}
+        # adaptive-planner plumbing (query/planner.py): the tier
+        # decisions this request consulted (EXPLAIN surfaces them) and
+        # the tier the index machinery ACTUALLY served from (a decided
+        # tier can still fall back — dirty tablet, missing export —
+        # and cost attribution must follow the serving tier).
+        # _adaptive gates every planner touch: static engines and the
+        # interpreted path pay literally nothing; _dec_memo keeps a
+        # request's REPEATED stage evaluations (a filter tree probing
+        # one predicate dozens of times) at one est-build + consult
+        self._adaptive = plan is not None \
+            and getattr(db, "planner_impl", None) is not None
+        self.tier_decisions: list = []
+        self._dec_memo: dict = {}
+        self._served_tier: Optional[str] = None
 
     def _checkpoint(self, where: str):
         """Block/level boundary: the `executor.level` failpoint (chaos
@@ -808,19 +822,24 @@ class Executor:
         inc_counter("query_colvar_hits_total")
         return cv
 
-    def _index_sets(self, tab, toks: list[bytes]) -> list[np.ndarray]:
+    def _index_sets(self, tab, toks: list[bytes],
+                    tier: Optional[str] = None) -> list[np.ndarray]:
         """Posting sets for a token batch: one CSR probe per token on
         clean tablets (contiguous slices of one cached buffer, no
         per-token overlay generator), the exact index_uids walk
-        otherwise."""
+        otherwise. `tier` is the planner's pick: "postings" pins the
+        exact walk; None/"columnar"/"compressed" keep the CSR."""
         csr = tab.token_index_csr(self.read_ts) \
-            if self._columnar_on() and hasattr(tab, "token_index_csr") \
+            if tier != "postings" and self._columnar_on() \
+            and hasattr(tab, "token_index_csr") \
             else None
         if csr is None:
+            self._served_tier = "postings"
             return [tab.index_uids(t, self.read_ts) for t in toks]
         from dgraph_tpu.engine.device_cache import host_column_tile
         host_column_tile(self.db, tab, "_tok_csr", csr)
         inc_counter("query_index_csr_probe_total")
+        self._served_tier = "columnar"
         return [csr.probe(t) for t in toks]
 
     # -- compressed posting tier ---------------------------------------
@@ -861,28 +880,135 @@ class Executor:
             self.db.device_min_edges <= 1
             or self.db.device_is_accelerator())
 
-    def _index_union(self, tab, toks: list[bytes]) -> np.ndarray:
+    # -- adaptive tier routing (query/planner.py) ----------------------
+
+    def _tier_decision(self, stage: str, pred: str, est: dict,
+                       avail: tuple, rows_by_tier=None):
+        """Consult the adaptive planner for this stage's tier (None on
+        the static/interpreted path — callers keep the flag
+        heuristics). The decision is cached on the compiled plan;
+        every consult lands in tier_decisions for EXPLAIN."""
+        pl = getattr(self.db, "planner_impl", None)
+        if pl is None or self.plan is None or not avail:
+            return None
+        dec = pl.choose(self.plan, stage, pred, est, avail,
+                        rows_by_tier)
+        if dec is not None:
+            self.tier_decisions.append(dec)
+        return dec
+
+    def _record_outcome(self, dec, actual_rows: int) -> None:
+        pl = getattr(self.db, "planner_impl", None)
+        if pl is not None and dec is not None:
+            pl.record_outcome(dec, actual_rows)
+
+    def _routed(self, mkey: tuple, build):
+        """Three-layer decision lookup: request memo -> the plan's
+        routing cache (validated against the planner's
+        re-optimization generation with one dict probe) -> full
+        estimate + consult. The warm steady state — the plan cache
+        serving every stage's decision — costs two dict reads per
+        request per stage, which is what keeps the whole planner
+        under the 1%% overhead gate on real (multi-stage) queries."""
+        dec = self._dec_memo.get(mkey, _MISS_CV)
+        if dec is not _MISS_CV:
+            return dec
+        pl = self.db.planner_impl
+        dec = self.plan._routing.get(mkey)
+        if dec is not None and pl.version(
+                dec.skeleton, dec.stage, dec.pred) == dec.version:
+            pl._warm_serves += 1
+            self.tier_decisions.append(dec)
+        else:
+            dec = build()
+            if dec is not None:
+                routing = self.plan._routing
+                if len(routing) >= self.plan.MEMO_MAX:
+                    routing.clear()  # rare: stage-key churn
+                routing[mkey] = dec
+        self._dec_memo[mkey] = dec
+        return dec
+
+    def _index_tiers(self, tab) -> tuple:
+        """Tiers the prefer_* overrides allow for a token-index stage
+        on this tablet (availability, not choice — the planner picks
+        within these)."""
+        avail = ["postings"]
+        if self._columnar_on() and hasattr(tab, "token_index_csr"):
+            avail.append("columnar")
+        if self._compressed_on() and hasattr(tab, "token_index_packs"):
+            avail.append("compressed")
+        return tuple(avail)
+
+    def _tabstats(self, tab) -> Optional[dict]:
+        """Cached BASE tablet statistics, or None for stat-less
+        proxies (same guard as explain's estimator). The per-base_ts
+        aggregate is computed once per rollup and shared with
+        /debug/stats; the steady-state read on this query hot path is
+        one tuple compare (tabstats.tablet_base_stats) — NOT the full
+        tablet_stats(), whose live residency walk costs ~10 µs per
+        call."""
+        if tab is None or not hasattr(tab, "base_ts"):
+            return None
+        from dgraph_tpu.storage.tabstats import tablet_base_stats
+        return tablet_base_stats(tab)
+
+    def _dirty_slack(self, tab) -> int:
+        from dgraph_tpu.storage.tabstats import dirty_ops
+        return dirty_ops(tab)
+
+    def _token_est(self, tab, n_tokens: int) -> dict:
+        """EXPLAIN-shaped row estimate for an n-token index probe:
+        per-token quantile from the tabstats posting-length histogram
+        (the satellite basis), capped at keys + dirty slack. The
+        quantile is cached on the tablet per base_ts — this sits on
+        the eq/terms hot path."""
+        st = self._tabstats(tab)
+        if st is None:
+            return {"estRows": -1, "estRowsMax": -1,
+                    "basis": "unknown"}
+        cached = getattr(tab, "_tokq_cache", None)
+        if cached is not None and cached[0] == tab.base_ts:
+            per = cached[1]
+        else:
+            from dgraph_tpu.query.planner import token_quantile
+            per = token_quantile(st["tokenIndex"])
+            tab._tokq_cache = (tab.base_ts, per)
+        cap = st["nSrc"] + self._dirty_slack(tab)
+        return {"estRows": min(int(round(n_tokens * per)), cap),
+                "estRowsMax": cap, "basis": "stats",
+                "source": "token-length histogram"}
+
+    def _index_union(self, tab, toks: list[bytes],
+                     tier: Optional[str] = None) -> np.ndarray:
         """k-token index union, staying on compressed blocks where
         they exist: the hybrid index hands back zero-copy dense
         slices for its small-list tail and packs for the long lists
-        (setops.union_mixed merges the compressed side first)."""
-        tix = self._index_packs(tab)
+        (setops.union_mixed merges the compressed side first).
+        `tier` (the planner's pick) caps the ladder: "columnar" skips
+        the packs, "postings" pins the exact walk; fallbacks on
+        missing exports still cascade."""
+        tix = self._index_packs(tab) \
+            if tier in (None, "compressed") else None
         if tix is not None:
             ops = [o for o in (tix.probe_operand(t) for t in toks)
                    if o is not None]
             inc_counter("query_compressed_setops_total")
+            self._served_tier = "compressed"
             return setops.union_mixed(ops,
                                       scratch=self._pack_scratch())
-        return self._union_many(self._index_sets(tab, toks))
+        return self._union_many(self._index_sets(tab, toks, tier))
 
-    def _index_intersect(self, tab, toks: list[bytes]) -> np.ndarray:
+    def _index_intersect(self, tab, toks: list[bytes],
+                         tier: Optional[str] = None) -> np.ndarray:
         """k-token index intersection with block-descriptor skipping:
         dense operands intersect smallest-first, the survivor vector
         probes each pack in compressed form — blocks with no key
         overlap are NEVER decoded (all-pack inputs additionally batch
         bitmap blocks into one word-AND, device-routed when worth
-        it)."""
-        tix = self._index_packs(tab)
+        it). `tier` as in _index_union."""
+        tix = self._index_packs(tab) \
+            if tier in (None, "compressed") else None
         if tix is not None:
             ops = []
             for t in toks:
@@ -891,25 +1017,42 @@ class Executor:
                     return _EMPTY  # a missing token empties the AND
                 ops.append(o)
             inc_counter("query_compressed_setops_total")
+            self._served_tier = "compressed"
             return setops.intersect_mixed(
                 ops, scratch=self._pack_scratch(),
                 device=self._pack_device())
-        return self._intersect_many(self._index_sets(tab, toks))
+        return self._intersect_many(self._index_sets(tab, toks, tier))
 
-    def _index_count_filter(self, tab, toks: list[bytes],
-                            need: int) -> np.ndarray:
+    def _trigram_tier(self, tab, kind: str, n_tokens: int):
+        """Tier decision for a trigram-index probe batch (regexp /
+        match) — stage "setops" like the other token set ops,
+        memoized per request."""
+        if not self._adaptive:
+            return None
+        return self._routed(
+            ("setops", tab.pred, kind, n_tokens),
+            lambda: self._tier_decision(
+                "setops", tab.pred, self._token_est(tab, n_tokens),
+                self._index_tiers(tab)))
+
+    def _index_count_filter(self, tab, toks: list[bytes], need: int,
+                            tier: Optional[str] = None) -> np.ndarray:
         """Uids in >= need of the tokens' posting lists (the match()
         q-gram bound): candidates come from the smallest operands
         (pigeonhole), the long packed lists answer by block-skipping
-        membership probes without decoding."""
-        tix = self._index_packs(tab)
+        membership probes without decoding. `tier` as in
+        _index_union."""
+        tix = self._index_packs(tab) \
+            if tier in (None, "compressed") else None
         if tix is not None:
             ops = [o for o in (tix.probe_operand(t) for t in toks)
                    if o is not None]
             inc_counter("query_compressed_setops_total")
+            self._served_tier = "compressed"
             return setops.count_filter_mixed(
                 ops, need, scratch=self._pack_scratch())
-        buckets = [b for b in self._index_sets(tab, toks) if len(b)]
+        buckets = [b for b in self._index_sets(tab, toks, tier)
+                   if len(b)]
         if not buckets:
             return _EMPTY
         from dgraph_tpu import native as _nat
@@ -938,7 +1081,9 @@ class Executor:
         return setops.union_many(parts)
 
     def _intersect_many(self, parts: list[np.ndarray]) -> np.ndarray:
-        """k-way intersection, smallest set first."""
+        """k-way intersection, smallest set first. Under the adaptive
+        planner the per-pair gallop-vs-merge pivot is density-derived
+        (planner.gallop_ratio) instead of the fixed 16x skew."""
         if len(parts) >= 4 and self.db.prefer_device:
             total = sum(len(p) for p in parts)
             if total >= (1 << 17) and self._device_worth(
@@ -948,6 +1093,12 @@ class Executor:
                 if got is not None:
                     inc_counter("query_device_setops_total")
                     return got
+        pl = getattr(self.db, "planner_impl", None)
+        if pl is not None and len(parts) >= 2:
+            lens = [len(p) for p in parts]
+            return setops.intersect_many(
+                parts, gallop_ratio=pl.gallop_ratio(min(lens),
+                                                    max(lens)))
         return setops.intersect_many(parts)
 
     def _eval_func(self, fn: Function, candidates: Optional[np.ndarray]
@@ -1037,11 +1188,11 @@ class Executor:
         raise GQLError(f"function {name!r} not supported")
 
     def _eval_similar_to(self, fn: Function, candidates) -> np.ndarray:
-        with _span("similar_to", pred=fn.attr):
-            return self._eval_similar_to_inner(fn, candidates)
+        with _span("similar_to", pred=fn.attr) as sp:
+            return self._eval_similar_to_inner(fn, candidates, sp)
 
-    def _eval_similar_to_inner(self, fn: Function,
-                               candidates) -> np.ndarray:
+    def _eval_similar_to_inner(self, fn: Function, candidates,
+                               sp: Optional[dict] = None) -> np.ndarray:
         """similar_to(embedding, k, $vec[, metric]): the k uids whose
         stored float32vector scores closest to the query vector
         (forward-port of modern Dgraph's similar_to onto the v1.1.x
@@ -1127,19 +1278,44 @@ class Executor:
         n = len(view.base_uids)
         if n and base_mask.any():
             qm = qvec[None, :]
+            # device-vs-host tier: the planner weighs the measured
+            # dispatch RTT against the observed per-row scoring cost;
+            # static mode and the force override keep the
+            # device_min_edges threshold. The mesh-sharded tier stays
+            # first — capacity, not latency.
+            dec = None
+            if self._adaptive and self.db.device_min_edges > 1 \
+                    and self.db.prefer_device \
+                    and self.db.mesh is None:
+                dec = self._tier_decision(
+                    "similar_to", fn.attr,
+                    {"estRows": n, "estRowsMax": n, "basis": "exact",
+                     "source": "vector block rows"},
+                    ("postings", "device"))
+            use_device = (dec.tier == "device") if dec is not None \
+                else (self.db.prefer_device
+                      and n >= self.db.device_min_edges)
             if self.db.mesh is not None \
                     and n >= self.db.shard_min_edges:
                 idx, sc = self._sharded_vec_topk(tab, view, qm, k,
                                                  metric, base_mask)
-            elif self.db.prefer_device \
-                    and n >= self.db.device_min_edges:
+                if sp is not None:
+                    sp["tier"] = "device"
+            elif use_device:
                 idx, sc = _knn.topk_device(
                     self._device_vec_block(tab, view), qm, k, metric,
                     mask=base_mask, n_real=n)
                 inc_counter("query_similar_device_total")
+                if sp is not None:
+                    sp["tier"] = "device"
+                    sp["n"] = int(n)
             else:
                 idx, sc = _knn.topk_host(view.base_vecs, qm, k,
                                          metric, mask=base_mask)
+                if sp is not None:
+                    sp["tier"] = "postings"
+                    sp["n"] = int(n)
+            self._record_outcome(dec, n)
             row, s = idx[0], sc[0]
             ok = np.isfinite(s) & (row < n)
             parts.append((view.base_uids[row[ok]], s[ok]))
@@ -1301,12 +1477,13 @@ class Executor:
                         candidates, lang: str = "") -> np.ndarray:
         if tab is None:
             return _EMPTY
-        with _span("eq", pred=tab.pred):
+        with _span("eq", pred=tab.pred) as sp:
             return self._eval_eq_tokens_inner(tab, vals, candidates,
-                                              lang)
+                                              lang, sp)
 
     def _eval_eq_tokens_inner(self, tab: Tablet, vals: list[Val],
-                              candidates, lang: str = "") -> np.ndarray:
+                              candidates, lang: str = "",
+                              sp: Optional[dict] = None) -> np.ndarray:
         out = _EMPTY
         # pick a non-lossy tokenizer if indexed (ref worker/task.go
         # pickTokenizer); else scan candidates' values
@@ -1359,8 +1536,37 @@ class Executor:
                     _analyze)
             else:
                 all_toks, no_tok_vals = _analyze()
+            dec = None
+            if all_toks and self._adaptive:
+                dec = self._routed(
+                    ("eq", tab.pred, len(all_toks)),
+                    lambda: self._tier_decision(
+                        "eq", tab.pred,
+                        self._token_est(tab, len(all_toks)),
+                        self._index_tiers(tab)))
+                if dec is not None and candidates is not None \
+                        and not no_tok_vals \
+                        and self.db.planner_impl.probe_or_scan(
+                            "eq", dec.est_rows, len(candidates),
+                            probe_tier=dec.tier) == "scan":
+                    # index-probe vs candidate-scan pivot: the
+                    # estimated token postings dwarf the candidate
+                    # set, so verify the candidates' values directly
+                    # (the exact filter semantics — the unindexed
+                    # branch below — chosen on cost, not necessity)
+                    if sp is not None:
+                        sp["tier"] = "postings"
+                        sp["n"] = int(len(candidates))
+                    return self._eq_scan(tab, candidates, vals, lang)
             if all_toks:
-                out = self._index_union(tab, all_toks)
+                self._served_tier = None
+                out = self._index_union(tab, all_toks,
+                                        tier=dec.tier
+                                        if dec is not None else None)
+                self._record_outcome(dec, len(out))
+                if sp is not None:
+                    sp["tier"] = self._served_tier or "postings"
+                    sp["n"] = int(len(out))
             if len(no_tok_vals) < len(vals):
                 if spec.lossy or tab.schema.lang:
                     # @lang predicates share index buckets across
@@ -1497,10 +1703,24 @@ class Executor:
         return t
 
     def _eval_ineq(self, fn: Function, candidates) -> np.ndarray:
-        with _span("ineq", fn=fn.name, pred=fn.attr):
-            return self._eval_ineq_inner(fn, candidates)
+        with _span("ineq", fn=fn.name, pred=fn.attr) as sp:
+            return self._eval_ineq_inner(fn, candidates, sp)
 
-    def _eval_ineq_inner(self, fn: Function, candidates) -> np.ndarray:
+    def _ineq_est(self, tab, fname: str) -> dict:
+        """EXPLAIN's range-fraction heuristic as the planner input
+        (half the keys; a third for between), capped at keys + dirty
+        slack."""
+        st = self._tabstats(tab)
+        if st is None:
+            return {"estRows": -1, "estRowsMax": -1,
+                    "basis": "unknown"}
+        cap = st["nSrc"] + self._dirty_slack(tab)
+        est = st["nSrc"] // (3 if fname == "between" else 2)
+        return {"estRows": min(est, cap), "estRowsMax": cap,
+                "basis": "stats", "source": "range-fraction heuristic"}
+
+    def _eval_ineq_inner(self, fn: Function, candidates,
+                         sp: Optional[dict] = None) -> np.ndarray:
         tab = self._tablet(fn.attr)
         ips = tab.schema if tab is not None \
             else self.db.schema.get(fn.attr)
@@ -1568,27 +1788,56 @@ class Executor:
         # strings compare beyond the 8-byte key prefix: exact host compare
         if tid in (TypeID.STRING, TypeID.DEFAULT):
             return self._ineq_scan_strings(tab, fn, candidates)
-        if self.db.prefer_device and self._device_worth(
-                len(getattr(tab, "values", ()))
-                * self._HOST_PER_RANGE_VAL,
-                device_ratio=self._DEVICE_RATIO_RANGE):
+        # tier choice: device range kernel / cached sort-key arrays /
+        # exact per-uid walk. The planner decides from estimated rows
+        # x observed cost; device_min_edges <= 1 (the force override)
+        # and the static mode keep the measured-RTT gate.
+        dec = tier = None
+        if self._adaptive and self.db.device_min_edges > 1:
+            def _build_ineq():
+                avail = ["postings"]
+                if self._columnar_on() \
+                        and hasattr(tab, "sort_key_arrays"):
+                    avail.append("columnar")
+                if self.db.prefer_device \
+                        and self.db.device_is_accelerator():
+                    avail.append("device")
+                return self._tier_decision(
+                    "ineq", fn.attr, self._ineq_est(tab, fn.name),
+                    tuple(avail))
+            dec = self._routed(("ineq", fn.attr, fn.name), _build_ineq)
+            tier = dec.tier if dec is not None else None
+        if (tier == "device") if dec is not None else (
+                self.db.prefer_device and self._device_worth(
+                    len(getattr(tab, "values", ()))
+                    * self._HOST_PER_RANGE_VAL,
+                    device_ratio=self._DEVICE_RATIO_RANGE)):
             dev = self._device_range(tab, lo, hi, lo_open, hi_open)
             if dev is not None:
+                self._record_outcome(dec, len(dev))
+                if sp is not None:
+                    sp["tier"] = "device"
+                    sp["n"] = int(len(dev))
                 return dev if candidates is None \
                     else _intersect(candidates, dev)
-        if not hasattr(tab, "sort_key_arrays") \
+        if tier == "postings" \
+                or not hasattr(tab, "sort_key_arrays") \
                 or self.read_ts < tab.base_ts \
                 or not self._columnar_on():
+            served = "postings"
             pairs = self._sortkeys_for(tab)
             uids = np.fromiter(pairs.keys(), np.uint64, len(pairs))
             keys = np.fromiter(pairs.values(), np.int64, len(pairs))
             order = np.argsort(uids, kind="stable")
             uids, keys = uids[order], keys[order]
         elif tab.dirty():
+            served = "columnar"
             uids, keys = self._sortkeys_dirty(tab)
         else:
+            served = "columnar"
             uids, keys = tab.sort_key_arrays()
         if not len(uids):
+            self._record_outcome(dec, 0)
             return _EMPTY
 
         def in_range(kk):
@@ -1602,8 +1851,17 @@ class Executor:
             # column and re-intersecting (the q003-at-21M shape)
             pos, hit = _col_positions(uids, candidates)
             kk = keys[pos[hit]]
-            return candidates[hit][in_range(kk)]
+            out = candidates[hit][in_range(kk)]
+            self._record_outcome(dec, len(out))
+            if sp is not None:
+                sp["tier"] = served
+                sp["n"] = int(len(out))
+            return out
         out = np.sort(uids[in_range(keys)])
+        self._record_outcome(dec, len(out))
+        if sp is not None:
+            sp["tier"] = served
+            sp["n"] = int(len(out))
         return out if candidates is None else _intersect(candidates, out)
 
     def _sortkeys_dirty(self, tab) -> tuple[np.ndarray, np.ndarray]:
@@ -1742,10 +2000,11 @@ class Executor:
         return tab.sort_key_pairs()
 
     def _eval_terms(self, fn: Function, candidates) -> np.ndarray:
-        with _span("setops", fn=fn.name, pred=fn.attr):
-            return self._eval_terms_inner(fn, candidates)
+        with _span("setops", fn=fn.name, pred=fn.attr) as sp:
+            return self._eval_terms_inner(fn, candidates, sp)
 
-    def _eval_terms_inner(self, fn: Function, candidates) -> np.ndarray:
+    def _eval_terms_inner(self, fn: Function, candidates,
+                          sp: Optional[dict] = None) -> np.ndarray:
         tab = self._tablet(fn.attr)
         toker = "fulltext" if fn.name in ("anyoftext", "alloftext") else "term"
         ps = tab.schema if tab is not None \
@@ -1768,6 +2027,18 @@ class Executor:
         # per-analyzer evaluation, then union. Each analyzer's token
         # probe is one batched CSR slice + one k-way set op
         # (ops/setops) instead of a pairwise union/intersect fold
+        dec = None
+        if self._adaptive:
+            n_terms = len(text.split()) or 1
+            dec = self._routed(
+                ("setops", fn.attr, fn.name, n_terms),
+                lambda: self._tier_decision(
+                    "setops", fn.attr,
+                    self._token_est(tab, 1 if fn.name.startswith("all")
+                                    else n_terms),
+                    self._index_tiers(tab)))
+        tier = dec.tier if dec is not None else None
+        self._served_tier = None
         parts: list[np.ndarray] = []
         for lg in _probe_langs(spec, fn.lang or ""):
             if self.plan is not None:
@@ -1783,17 +2054,22 @@ class Executor:
                 continue
             tbs = [token_bytes(spec.ident, t) for t in toks]
             if fn.name.startswith("all"):
-                parts.append(self._index_intersect(tab, tbs))
+                parts.append(self._index_intersect(tab, tbs, tier))
             else:
-                parts.append(self._index_union(tab, tbs))
+                parts.append(self._index_union(tab, tbs, tier))
         out = self._union_many(parts)
+        self._record_outcome(dec, len(out))
+        if sp is not None:
+            sp["tier"] = self._served_tier or "postings"
+            sp["n"] = int(len(out))
         return out if candidates is None else _intersect(candidates, out)
 
     def _eval_anyof(self, fn: Function, candidates) -> np.ndarray:
-        with _span("setops", fn=fn.name, pred=fn.attr):
-            return self._eval_anyof_inner(fn, candidates)
+        with _span("setops", fn=fn.name, pred=fn.attr) as sp:
+            return self._eval_anyof_inner(fn, candidates, sp)
 
-    def _eval_anyof_inner(self, fn: Function, candidates) -> np.ndarray:
+    def _eval_anyof_inner(self, fn: Function, candidates,
+                          sp: Optional[dict] = None) -> np.ndarray:
         """anyof/allof(pred, tokenizer, v...): generic token match with
         an explicitly named (usually custom plugin) tokenizer — the
         custom-tokenizer query surface (ref worker/task.go:260 anyof/
@@ -1817,10 +2093,25 @@ class Executor:
         if not toks:
             return _EMPTY
         tbs = [token_bytes(spec.ident, t) for t in toks]
+        dec = None
+        if self._adaptive:
+            dec = self._routed(
+                ("setops", fn.attr, fn.name, len(tbs)),
+                lambda: self._tier_decision(
+                    "setops", fn.attr,
+                    self._token_est(tab, 1 if fn.name == "allof"
+                                    else len(tbs)),
+                    self._index_tiers(tab)))
+        tier = dec.tier if dec is not None else None
+        self._served_tier = None
         if fn.name == "allof":
-            got = self._index_intersect(tab, tbs)
+            got = self._index_intersect(tab, tbs, tier)
         else:
-            got = self._index_union(tab, tbs)
+            got = self._index_union(tab, tbs, tier)
+        self._record_outcome(dec, len(got))
+        if sp is not None:
+            sp["tier"] = self._served_tier or "postings"
+            sp["n"] = int(len(got))
         return got if candidates is None else _intersect(candidates, got)
 
     def _eval_regexp(self, fn: Function, candidates) -> np.ndarray:
@@ -1848,9 +2139,21 @@ class Executor:
             # necessary condition per alternation branch — and walk the
             # index with it (ref worker/trigram.go:35 uidsForRegex via
             # cindex.RegexpQuery).  ALL ⇒ no index help ⇒ full scan.
-            cand = self._trigram_query_uids(
-                tab, triq if triq is not None
-                else compile_trigram_query(pattern, flags))
+            q = triq if triq is not None \
+                else compile_trigram_query(pattern, flags)
+            dec = self._trigram_tier(tab, "regexp", 3)
+            # the trigram walk opens a setops span so every tier's
+            # cost lands in the coststore — without cells the
+            # planner's rival check has no evidence to correct a
+            # cold-prior pick with
+            with _span("setops", fn="regexp", pred=tab.pred) as tsp:
+                self._served_tier = None
+                cand = self._trigram_query_uids(
+                    tab, q, dec.tier if dec is not None else None)
+                if cand is not None:
+                    self._record_outcome(dec, len(cand))
+                    tsp["n"] = int(len(cand))
+                tsp["tier"] = self._served_tier or "postings"
             scan = cand if cand is not None else tab.src_uids(self.read_ts)
         else:
             scan = candidates if candidates is not None \
@@ -1866,11 +2169,14 @@ class Executor:
                     break
         return np.asarray(keep, dtype=np.uint64)
 
-    def _trigram_query_uids(self, tab, q) -> Optional[np.ndarray]:
+    def _trigram_query_uids(self, tab, q,
+                            tier: Optional[str] = None
+                            ) -> Optional[np.ndarray]:
         """Evaluate a compiled TriQuery against `tab`'s trigram index.
         Returns None for an unconstrained (ALL) query — caller scans —
         so an ALL branch inside an OR correctly un-constrains the whole
-        OR, as in the reference's trigram query algebra."""
+        OR, as in the reference's trigram query algebra. `tier` (the
+        planner's pick) routes every probe batch."""
         spec = get_tokenizer("trigram")
 
         def ev(node) -> Optional[np.ndarray]:
@@ -1886,7 +2192,7 @@ class Executor:
                     # posting blocks before any decode
                     first = self._index_intersect(
                         tab, [token_bytes(spec.ident, t)
-                              for t in node.trigrams])
+                              for t in node.trigrams], tier)
                     if first.size == 0:
                         return first  # dead branch: skip the subs
                     parts = [first]
@@ -1900,7 +2206,8 @@ class Executor:
             # OR
             parts = [self._index_union(
                 tab, [token_bytes(spec.ident, t)
-                      for t in node.trigrams])] if node.trigrams else []
+                      for t in node.trigrams], tier)] \
+                if node.trigrams else []
             for s in node.subs:
                 got = ev(s)
                 if got is None:
@@ -1973,9 +2280,17 @@ class Executor:
                     # to thousands. Compressed tier: posting blocks
                     # held by < need trigrams skip without decode.
                     need = max(1, len(toks) - 3 * maxd)
-                    scan = self._index_count_filter(
-                        tab, [token_bytes(spec.ident, t)
-                              for t in toks], need)
+                    dec = self._trigram_tier(tab, "match", len(toks))
+                    with _span("setops", fn="match",
+                               pred=tab.pred) as tsp:
+                        self._served_tier = None
+                        scan = self._index_count_filter(
+                            tab, [token_bytes(spec.ident, t)
+                                  for t in toks], need,
+                            dec.tier if dec is not None else None)
+                        tsp["tier"] = self._served_tier or "postings"
+                        tsp["n"] = int(len(scan))
+                    self._record_outcome(dec, len(scan))
         if scan is None:
             scan = tab.src_uids(self.read_ts)
         batched = self._match_batch(tab, scan, want, maxd)
@@ -3332,30 +3647,88 @@ class Executor:
         return uids
 
     def _apply_order(self, orders, uids: np.ndarray) -> np.ndarray:
-        with _span("sort", n=len(uids), keys=len(orders)):
-            return self._apply_order_inner(orders, uids)
+        with _span("sort", n=len(uids), keys=len(orders)) as sp:
+            return self._apply_order_inner(orders, uids, sp)
 
-    def _apply_order_inner(self, orders, uids: np.ndarray) -> np.ndarray:
+    def _apply_order_inner(self, orders, uids: np.ndarray,
+                           sp: Optional[dict] = None) -> np.ndarray:
         """Multi-key value sort; stable, missing-value uids last
         (ref types/sort.go:118 + worker/sort.go)."""
         # device_min_edges <= 1 is the explicit force-device override
         # (tests, operators): it outranks the presorted host shortcut
         forced = self.db.prefer_device and self.db.device_min_edges <= 1
+        # tier choice: presorted-permutation walk ("columnar") /
+        # device multisort / host key-gather + lexsort ("postings").
+        # rows_by_tier carries each tier's REAL cost driver — the
+        # permutation walk streams the whole column, the lexsort
+        # scales with candidates x keys — replacing the static 8x
+        # candidate-fraction rule with the cost model.
+        dec = tier = None
+        info = None
+        if not forced and len(uids) and self._adaptive:
+            info = self._presorted_info(orders)
+
+            def _build_sort():
+                avail = ["postings"]
+                rows = {"postings": len(uids) * max(1, len(orders))}
+                if info is not None:
+                    avail.append("columnar")
+                    rows["columnar"] = len(info[1])
+                if self.db.prefer_device and len(uids) >= 8 \
+                        and self.db.device_is_accelerator():
+                    avail.append("device")
+                    rows["device"] = len(uids)
+                return self._tier_decision(
+                    "sort", orders[0].attr,
+                    {"estRows": len(uids), "estRowsMax": len(uids),
+                     "basis": "exact", "source": "candidate set"},
+                    tuple(avail), rows_by_tier=rows)
+            dec = self._routed(
+                ("sort", orders[0].attr, len(orders),
+                 len(uids).bit_length(), info is not None),
+                _build_sort)
+            tier = dec.tier if dec is not None else None
         if not forced:
-            fast = self._apply_order_presorted(orders, uids)
-            if fast is not None:
-                return fast
-        if self.db.prefer_device and len(uids) >= 8 \
+            if dec is None:
+                fast = self._apply_order_presorted(orders, uids, info)
+                if fast is not None:
+                    # static path serves the permutation tier too:
+                    # stamp it so its cost cells land under "columnar"
+                    # (the tier name the planner reads), not the
+                    # observer's default "host"
+                    if sp is not None:
+                        sp["tier"] = "columnar"
+                    return fast
+            elif tier == "columnar":
+                # the decision already weighed candidate-vs-column
+                # size: skip the static 8x fraction rule
+                fast = self._apply_order_presorted(
+                    orders, uids, info, ignore_size_rule=True)
+                if fast is not None:
+                    self._record_outcome(dec, len(uids))
+                    if sp is not None:
+                        sp["tier"] = "columnar"
+                    return fast
+        if (tier == "device") if dec is not None else (
+                self.db.prefer_device and len(uids) >= 8
                 and self._device_worth(
                     len(uids) * len(orders) * self._HOST_PER_ORDER_KEY,
-                    device_ratio=self._DEVICE_RATIO_ORDER):
+                    device_ratio=self._DEVICE_RATIO_ORDER)):
             dev = self._device_apply_order(orders, uids)
             if dev is not None:
+                self._record_outcome(dec, len(uids))
+                if sp is not None:
+                    sp["tier"] = "device"
                 return dev
         if forced:
             fast = self._apply_order_presorted(orders, uids)
             if fast is not None:
+                if sp is not None:
+                    sp["tier"] = "columnar"
                 return fast
+        self._record_outcome(dec, len(uids))
+        if sp is not None:
+            sp["tier"] = "postings"
         keyrows = [self._order_key_cols(o, uids) for o in orders]
         # lexsort: last key is primary
         cols = []
@@ -3366,16 +3739,13 @@ class Executor:
         order = np.lexsort(tuple(cols))
         return uids[order]
 
-    def _apply_order_presorted(self, orders, uids: np.ndarray
-                               ) -> Optional[np.ndarray]:
-        """Single-key order-by through the tablet's CACHED
-        (key, uid)-sorted permutation: one membership gather over the
-        pre-sorted column replaces the per-query key gather + lexsort
-        — worker/sort.go walks the value-ordered index the same way.
-        Only when the candidate set is a sizable fraction of the
-        column (streaming a 1M-row permutation to order 50 uids would
-        lose); missing-key uids append uid-ascending, identical to the
-        lexsort's missing-flag column."""
+    def _presorted_info(self, orders):
+        """(tablet, sorted-column uids) when the presorted-permutation
+        sort tier is structurally available for this order spec —
+        single key, columnar on, clean tablet with a cached
+        permutation — else None. Shared by the static fast path and
+        the planner's availability probe so the two can never
+        diverge."""
         if len(orders) != 1 or not self._columnar_on():
             return None
         o = orders[0]
@@ -3387,7 +3757,29 @@ class Executor:
                 or tab.dirty() or self.read_ts < tab.base_ts:
             return None
         suids, _skeys = tab.sort_key_arrays(o.lang or "")
-        if len(uids) * 8 < len(suids) or not len(suids):
+        if not len(suids):
+            return None
+        return tab, suids
+
+    def _apply_order_presorted(self, orders, uids: np.ndarray,
+                               info=None, ignore_size_rule: bool = False
+                               ) -> Optional[np.ndarray]:
+        """Single-key order-by through the tablet's CACHED
+        (key, uid)-sorted permutation: one membership gather over the
+        pre-sorted column replaces the per-query key gather + lexsort
+        — worker/sort.go walks the value-ordered index the same way.
+        Only when the candidate set is a sizable fraction of the
+        column (streaming a 1M-row permutation to order 50 uids would
+        lose) unless the planner's cost model already decided
+        (ignore_size_rule); missing-key uids append uid-ascending,
+        identical to the lexsort's missing-flag column."""
+        if info is None:
+            info = self._presorted_info(orders)
+        if info is None:
+            return None
+        tab, suids = info
+        o = orders[0]
+        if not ignore_size_rule and len(uids) * 8 < len(suids):
             return None
         op, attr = tab.sorted_by_key_uids(o.lang or "", bool(o.desc))
         from dgraph_tpu.engine.device_cache import host_column_tile

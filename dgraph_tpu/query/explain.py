@@ -308,12 +308,23 @@ def build_explain(db, ex, done, expinfo: dict) -> dict:
         "mode": mode,
         "planner": planner,
         "tiers": {
+            # adaptive: the prefer_* flags are OVERRIDES bounding
+            # which tiers the cost-based planner may pick per stage;
+            # static: they decide outright (pre-PR-13 heuristics)
+            "planner": getattr(db, "planner", "static"),
             "columnar": bool(getattr(db, "prefer_columnar", True)),
             "compressed": bool(getattr(db, "prefer_columnar", True))
             and bool(getattr(db, "prefer_compressed", True)),
             "device": bool(getattr(db, "prefer_device", False)),
             "deviceMinEdges": int(getattr(db, "device_min_edges", 0)),
         },
+        # per-stage chosen tier + estimate basis + decision inputs
+        # (query/planner.py Decision.describe): every tier decision
+        # this request consulted, in consult order — `reoptimized`
+        # marks a decision rebuilt after an estimate violation or
+        # cost-drift invalidation (version = its generation)
+        "tierDecisions": [d.describe()
+                          for d in getattr(ex, "tier_decisions", ())],
         "blocks": [_explain_node(db, gq, node, mode, -1)
                    for gq, node in done],
     }

@@ -188,14 +188,18 @@ class Plan:
     sharing this skeleton reuse. Immutable after compile except for
     the bounded memo/jit dicts (value-keyed, write-once entries)."""
 
-    __slots__ = ("skeleton_hash", "structure", "stages", "epoch",
-                 "mesh_key", "_memo", "_memo_lock", "compiled_ns")
+    __slots__ = ("skeleton_hash", "skeleton_hex", "structure",
+                 "stages", "epoch", "mesh_key", "_memo", "_memo_lock",
+                 "compiled_ns", "_decisions", "_routing")
 
     MEMO_MAX = 256  # per-plan bound on param-derived artifacts
 
     def __init__(self, structure: tuple, skeleton_hash: int,
                  epoch: int, mesh_key: Any):
         self.skeleton_hash = skeleton_hash
+        # pre-formatted: the planner/coststore join key, read per
+        # stage consult on the query hot path
+        self.skeleton_hex = f"{skeleton_hash:016x}"
         self.structure = structure
         self.epoch = epoch
         self.mesh_key = mesh_key
@@ -203,6 +207,17 @@ class Plan:
         self._memo: dict = {}
         self._memo_lock = threading.Lock()
         self.compiled_ns = 0
+        # planner tier decisions (query/planner.py), keyed per stage
+        # with a re-optimization generation: kept APART from _memo so
+        # param-churn memo clears never wipe tier choices, and so
+        # EXPLAIN / /debug can enumerate the plan's current routing
+        self._decisions: dict = {}
+        # the executor's warm-request routing layer: its stage memo
+        # key -> the live Decision, validated per request against the
+        # planner's re-optimization generation with one dict probe —
+        # so a warm request skips the estimate build AND the consult
+        # (the adaptive planner's whole steady-state cost)
+        self._routing: dict = {}
 
     def memo(self, key: tuple, build: Callable[[], Any]) -> Any:
         """Parameter-derived stage artifact cache (index token batches,
@@ -221,6 +236,32 @@ class Plan:
                 self._memo.clear()  # rare: param-churn heavy skeleton
             self._memo.setdefault(key, val)
         return val
+
+    def decide(self, key: tuple, version: int,
+               build: Callable[[], Any]) -> Any:
+        """Planner decision cache (same discipline as memo: bounded,
+        write-racy-but-idempotent): ONE current decision per stage
+        key. `version` is the planner's re-optimization generation —
+        a bumped version makes the cached decision stale, so the next
+        request rebuilds against fresh evidence; everything in
+        between is served from the plan, which is what makes the
+        adaptive planner's steady-state cost one dict probe."""
+        got = self._decisions.get(key)
+        if got is not None and got[0] == version:
+            return got[1]
+        val = build()
+        with self._memo_lock:
+            if len(self._decisions) >= self.MEMO_MAX:
+                self._decisions.clear()  # rare: stage-key churn
+            self._decisions[key] = (version, val)
+        return val
+
+    def decisions_snapshot(self) -> list:
+        """Current tier decisions (EXPLAIN / /debug surface)."""
+        with self._memo_lock:
+            vals = [v for _ver, v in self._decisions.values()]
+        return [v.describe() for v in vals
+                if hasattr(v, "describe")]
 
     def describe(self) -> dict:
         return {"skeleton": f"{self.skeleton_hash:016x}",

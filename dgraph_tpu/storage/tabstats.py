@@ -184,6 +184,14 @@ def _base_stats(tab) -> dict:
         "avgPostings": round(float(idx_lens.mean()), 3)
         if len(idx_lens) else 0.0,
         "maxPostings": int(idx_lens.max()) if len(idx_lens) else 0,
+        # log2 histogram of per-token posting-list lengths (bucket b =
+        # lengths with bit_length b, same convention as fanout) — the
+        # token-selectivity DISTRIBUTION, so per-token row estimates
+        # (query/planner.py token_quantile) have a real basis instead
+        # of the tablet-wide mean: a Zipfian index whose avg is 3 but
+        # whose hot token holds 100k postings stops estimating every
+        # probe at 3
+        "hist": _fanout_hist(idx_lens)["hist"],
     }
     return {
         "predicate": tab.pred,
@@ -203,21 +211,35 @@ def _base_stats(tab) -> dict:
     }
 
 
+def tablet_base_stats(tab) -> dict:
+    """JUST the per-base_ts cached aggregate — the planner-hot subset
+    (cardinalities, token histogram), without the live residency walk
+    tablet_stats() pays per call. The adaptive planner consults this
+    on query hot paths: steady-state cost is one tuple compare + dict
+    return. Callers needing overlay slack add `dirty_ops(tab)`."""
+    cached = getattr(tab, "_stats_cache", None)
+    if cached is not None and cached[0] == tab.base_ts \
+            and cached[1] is tab.schema:
+        return cached[2]
+    base = _base_stats(tab)
+    tab._stats_cache = (tab.base_ts, tab.schema, base)
+    return base
+
+
+def dirty_ops(tab) -> int:
+    """Un-folded overlay op count (live, cheap)."""
+    return sum(len(ops) for _, ops in tab.deltas)
+
+
 def tablet_stats(tab) -> dict:
     """Full stats dict for one tablet: the per-base_ts aggregate
     (cached on the tablet, same contract as its other exports) plus
     the live overlay/residency fields recomputed every call."""
-    cached = getattr(tab, "_stats_cache", None)
-    if cached is not None and cached[0] == tab.base_ts \
-            and cached[1] is tab.schema:
-        base = cached[2]
-    else:
-        base = _base_stats(tab)
-        tab._stats_cache = (tab.base_ts, tab.schema, base)
+    base = tablet_base_stats(tab)
     res = residency(tab)
     comp = compressed_residency(tab)
     out = dict(base)
-    out["dirtyOps"] = sum(len(ops) for _, ops in tab.deltas)
+    out["dirtyOps"] = dirty_ops(tab)
     out["touches"] = int(getattr(tab, "touches", 0))
     out["residency"] = res
     out["compressedResidency"] = comp

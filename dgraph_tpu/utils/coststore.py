@@ -52,6 +52,7 @@ import contextvars
 import json
 import os
 import threading
+import time as _time
 from bisect import bisect_left
 from typing import Any, Iterator, Optional
 
@@ -64,6 +65,18 @@ from dgraph_tpu.utils import metrics, tracing
 N_BUCKETS = 20
 BUCKETS_US = [float(1 << i) for i in range(N_BUCKETS)]
 EWMA_ALPHA = 0.05
+# fast companion EWMA: reacts in ~3 observations where the slow one
+# takes ~20 — their RATIO is the drift signal the adaptive planner's
+# re-optimization reads (a tier whose recent cost runs 2x its
+# long-term average has drifted; see query/planner.py)
+EWMA_FAST_ALPHA = 0.30
+# below this many observations a cell's EWMAs are noise: estimate()
+# reports the cell but flags it cold, and drift() stays neutral.
+# 4 is deliberately low — each observation is a full stage execution,
+# and the planner's margin rules (2x vs priors, 1.3x rival
+# hysteresis) absorb the residual noise; a higher floor just delays
+# adaptation by whole workload passes
+MIN_WARM_COUNT = 4
 
 # span names the observer aggregates — the executor's stage spans plus
 # the engine/cluster envelopes. Everything else stays trace-only
@@ -113,7 +126,13 @@ def _size_bucket(args: dict) -> int:
 class CostStore:
     """Bounded aggregation table. Entry value layout (list, mutated in
     place under the lock): [hist, count, sum_us, ewma_us, max_us,
-    max_trace] where hist has N_BUCKETS+1 slots (last = +Inf)."""
+    max_trace, last_mono, fast_ewma_us] where hist has N_BUCKETS+1
+    slots (last = +Inf). `last_mono` is the monotonic stamp of the
+    newest observation — /debug/stats reports each cell's age from it,
+    so a cold/dead cell (a tier the planner stopped routing to, a
+    skeleton that aged out) is distinguishable from a fresh one;
+    `fast_ewma_us` is the quick-reacting EWMA whose ratio to the slow
+    one is the drift signal."""
 
     MAX_KEYS = 4096
 
@@ -143,6 +162,7 @@ class CostStore:
         level record() wrapper normalizes for external callers."""
         key = (stage, tier, skeleton, size_bucket)
         idx = bisect_left(BUCKETS_US, dur_us)
+        now = _time.monotonic()
         with self._lock:
             e = self._data.get(key)
             if e is None:
@@ -153,7 +173,8 @@ class CostStore:
                     key = (key[0], key[1], "~", key[3])
                     e = self._data.get(key)
                 if e is None:
-                    e = [[0] * (N_BUCKETS + 1), 0, 0.0, dur_us, 0.0, ""]
+                    e = [[0] * (N_BUCKETS + 1), 0, 0.0, dur_us, 0.0,
+                         "", now, dur_us]
                     self._data[key] = e
             e[0][idx] += 1
             e[1] += 1
@@ -162,6 +183,8 @@ class CostStore:
             if dur_us >= e[4]:
                 e[4] = dur_us
                 e[5] = trace_id
+            e[6] = now
+            e[7] += EWMA_FAST_ALPHA * (dur_us - e[7])
     # (record stays under ~1 µs: one bisect over 20 floats + in-place
     # list updates under an uncontended lock)
 
@@ -188,6 +211,7 @@ class CostStore:
         surface (`skeleton=` answers "what has THIS plan's stage mix
         been costing?")."""
         out = []
+        now = _time.monotonic()
         with self._lock:
             items = list(self._data.items())
         for (st, tier, skel, bucket), e in items:
@@ -200,16 +224,123 @@ class CostStore:
                 "size_bucket": bucket, "count": e[1],
                 "sum_us": round(e[2], 3), "ewma_us": round(e[3], 3),
                 "max_us": round(e[4], 3), "max_trace": e[5],
+                # seconds since the newest observation landed in this
+                # cell — the cold/dead-vs-fresh discriminator the
+                # drift-invalidation signal needs (-1 = never stamped:
+                # a pre-age persisted cell)
+                "ageS": round(now - e[6], 3) if e[6] > 0 else -1,
+                "fastEwmaUs": round(e[7], 3),
+                "drift": round(e[7] / e[3], 3)
+                if e[1] >= MIN_WARM_COUNT and e[3] > 0 else 1.0,
                 "hist": list(e[0]),
             })
         out.sort(key=lambda r: -r["ewma_us"])
         return out
 
     def stats(self) -> dict:
+        now = _time.monotonic()
         with self._lock:
+            ages = [now - e[6] for e in self._data.values()
+                    if e[6] > 0]
             return {"keys": len(self._data),
                     "observations": sum(e[1]
-                                        for e in self._data.values())}
+                                        for e in self._data.values()),
+                    "freshestAgeS": round(min(ages), 3) if ages else -1,
+                    "stalestAgeS": round(max(ages), 3) if ages else -1}
+
+    # -- planner-facing estimate surface -------------------------------
+
+    def estimate(self, stage: str, tier: str, size_bucket: int,
+                 skeleton: str = "", exact_only: bool = False
+                 ) -> Optional[dict]:
+        """Observed-cost estimate for one (stage, tier) at an input
+        size bucket — what the adaptive planner asks instead of
+        trusting static priors. Fallback chain, most-specific first:
+
+          exact     this plan's own (stage, tier, skeleton, bucket)
+          overflow  the "~" aggregate the bounded table folds into
+          scaled    the NEAREST populated bucket of the same
+                    (stage, tier) under any skeleton, EWMA scaled
+                    linearly in rows (2^Δbucket, clamped) — stage
+                    costs are row-linear to first order
+
+        Returns {ewma_us, fast_ewma_us, count, age_s, cell, warm} or
+        None when the (stage, tier) has never been observed at all
+        (the caller falls back to its documented static priors)."""
+        now = _time.monotonic()
+
+        def _p50(e: list) -> float:
+            # histogram median, INTERPOLATED inside the bucket:
+            # robust to the one-off spikes that poison a young EWMA —
+            # a tier's FIRST observation is typically its cache build
+            # (CSR export, pack materialization), and the slow EWMA
+            # seeds on it, making the tier look expensive for ~20
+            # observations. Interpolation matters: a raw
+            # bucket-midpoint median moves in 2x steps, which no
+            # reasonable rival-margin hysteresis can damp — two
+            # near-equal tiers would flap on quantization noise. The
+            # planner compares p50s; the EWMAs remain the drift
+            # signal.
+            half = e[1] / 2.0
+            seen = 0
+            for b, c in enumerate(e[0]):
+                if not c:
+                    continue
+                if seen + c >= half:
+                    if b >= N_BUCKETS:
+                        return float(1 << N_BUCKETS)
+                    lo = float(1 << (b - 1)) if b else 0.0
+                    hi = float(1 << b)
+                    return lo + (hi - lo) * (half - seen) / c
+                seen += c
+            return e[3]
+
+        def _fmt(e: list, cell: str, scale: float = 1.0) -> dict:
+            return {"ewma_us": e[3] * scale,
+                    "fast_ewma_us": e[7] * scale,
+                    "p50_us": _p50(e) * scale,
+                    "count": e[1],
+                    "age_s": (now - e[6]) if e[6] > 0 else -1.0,
+                    "cell": cell,
+                    "warm": e[1] >= MIN_WARM_COUNT}
+
+        with self._lock:
+            for skel, cell in ((skeleton, "exact"), ("~", "overflow")):
+                e = self._data.get((stage, tier, skel, size_bucket))
+                if e is not None and e[1]:
+                    return _fmt(e, cell)
+            if exact_only:
+                # hot-path callers (the planner's per-outcome rival
+                # check): two dict probes, NEVER the table scan below
+                return None
+            best = None  # (bucket distance, -count, bucket, entry)
+            for (st, t, _sk, b), e in self._data.items():
+                if st != stage or t != tier or not e[1]:
+                    continue
+                cand = (abs(b - size_bucket), -e[1], b, e)
+                if best is None or cand[:2] < best[:2]:
+                    best = cand
+            if best is None:
+                return None
+            _d, _negc, b, e = best
+            scale = min(64.0, max(1.0 / 64.0,
+                                  2.0 ** (size_bucket - b)))
+            return _fmt(e, "scaled", scale)
+
+    def drift(self, stage: str, tier: str, size_bucket: int,
+              skeleton: str = "") -> float:
+        """fast-EWMA / slow-EWMA ratio of the most specific populated
+        cell (1.0 = no drift / too cold to tell). > 1 means the tier
+        got slower recently; < 1 faster — either way past the
+        planner's threshold, a cached tier decision made against the
+        old cost is stale."""
+        with self._lock:
+            for skel in (skeleton, "~"):
+                e = self._data.get((stage, tier, skel, size_bucket))
+                if e is not None and e[1] >= MIN_WARM_COUNT \
+                        and e[3] > 0:
+                    return e[7] / e[3]
+        return 1.0
 
     def reset(self) -> None:
         with self._lock:
@@ -221,16 +352,22 @@ class CostStore:
     def save(self, path: str) -> None:
         """Atomic JSON dump (tmp + rename): a crash mid-save must not
         leave a truncated store for the next boot's load()."""
+        now = _time.monotonic()
         with self._lock:
             entries = [
                 {"stage": k[0], "tier": k[1], "skeleton": k[2],
                  "bucket": k[3], "hist": list(e[0]), "count": e[1],
                  "sum_us": e[2], "ewma_us": e[3], "max_us": e[4],
-                 "max_trace": e[5]}
+                 "max_trace": e[5],
+                 # age is persisted RELATIVE (monotonic clocks do not
+                 # survive restarts); load() re-anchors it to the new
+                 # process's clock
+                 "age_s": round(now - e[6], 3) if e[6] > 0 else -1,
+                 "fast_ewma_us": e[7]}
                 for k, e in self._data.items()]
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"version": 1, "entries": entries}, f)
+            json.dump({"version": 2, "entries": entries}, f)
         os.replace(tmp, path)
         with self._lock:
             # the file is now a subset of the live table; loading it
@@ -256,6 +393,7 @@ class CostStore:
         except (OSError, ValueError, KeyError):
             return 0
         n = 0
+        now = _time.monotonic()
         for ent in entries:
             try:
                 key = (str(ent["stage"]), str(ent["tier"]),
@@ -266,6 +404,13 @@ class CostStore:
                 cnt, s = int(ent["count"]), float(ent["sum_us"])
                 ewma, mx = float(ent["ewma_us"]), float(ent["max_us"])
                 trace = str(ent.get("max_trace", ""))
+                # v1 files carry neither age nor the fast EWMA: an
+                # unknown age re-anchors as "never stamped" (reported
+                # -1 / maximally stale — exactly right for data of
+                # unknown vintage), the fast EWMA seeds from the slow
+                age = float(ent.get("age_s", -1))
+                mono = (now - age) if age >= 0 else 0.0
+                fast = float(ent.get("fast_ewma_us", ewma))
             except (KeyError, TypeError, ValueError):
                 continue
             with self._lock:
@@ -273,16 +418,19 @@ class CostStore:
                 if e is None:
                     if len(self._data) >= self.MAX_KEYS:
                         continue
-                    self._data[key] = [hist, cnt, s, ewma, mx, trace]
+                    self._data[key] = [hist, cnt, s, ewma, mx, trace,
+                                       mono, fast]
                 else:
                     e[0] = [a + b for a, b in zip(e[0], hist)]
                     total = e[1] + cnt
                     if total:
                         e[3] = (e[3] * e[1] + ewma * cnt) / total
+                        e[7] = (e[7] * e[1] + fast * cnt) / total
                     e[1] = total
                     e[2] += s
                     if mx > e[4]:
                         e[4], e[5] = mx, trace
+                    e[6] = max(e[6], mono)
             n += 1
         with self._lock:
             self._synced_paths.add(apath)
@@ -360,6 +508,18 @@ def summary(stage: Optional[str] = None,
 
 def stats() -> dict:
     return _GLOBAL.stats()
+
+
+def estimate(stage: str, tier: str, size_bucket: int,
+             skeleton: str = "",
+             exact_only: bool = False) -> Optional[dict]:
+    return _GLOBAL.estimate(stage, tier, size_bucket, skeleton,
+                            exact_only)
+
+
+def drift(stage: str, tier: str, size_bucket: int,
+          skeleton: str = "") -> float:
+    return _GLOBAL.drift(stage, tier, size_bucket, skeleton)
 
 
 def reset() -> None:

@@ -51,6 +51,11 @@ REGISTERED = (
     "plan_cache_evictions",
     "plan_cache_hits",
     "plan_cache_misses",
+    # adaptive planner (query/planner.py)
+    "planner_decisions_total",
+    "planner_estimate_violations_total",
+    "planner_reoptimized_total",
+    "planner_replans_suppressed_total",
     # query executor tier counters (query/executor.py)
     "query_columnar_var_bind_total",
     "query_colvar_hits_total",

@@ -8,8 +8,8 @@ import urllib.request
 import pytest
 
 from tools.dgtop import (
-    _histo_mean, hottest, ingest_cdc_rows, node_row, poll, render,
-    slowest_stages)
+    _histo_mean, hottest, ingest_cdc_rows, node_row, planner_rows,
+    poll, render, slowest_stages)
 
 
 def _snap(t=100.0, queries=50.0, shed=2.0, hits=40.0, misses=10.0,
@@ -106,6 +106,50 @@ def test_ingest_cdc_rows_rates_and_lag():
     assert "s1 @ n1" in frame
     idle_nodes, idle_subs = ingest_cdc_rows({"n1": _snap()}, None)
     assert idle_nodes == [] and idle_subs == []
+
+
+def _planner_snap(t=100.0, queries=200.0, reopt=4.0, viol=6.0,
+                  decided=12):
+    s = _snap(t=t, queries=queries)
+    s["stats"]["planner"] = {
+        "mode": "adaptive", "decisions": decided,
+        "mix": {"eq": {"compressed": 5, "postings": 2},
+                "sort": {"columnar": 5}},
+        "replansSuppressed": 1}
+    s["stats"]["counters"].update({
+        'planner_reoptimized_total{reason="violation"}': reopt,
+        'planner_reoptimized_total{reason="drift"}': 1.0,
+        "planner_estimate_violations_total": viol})
+    return s
+
+
+def test_planner_rows_mix_and_rates():
+    a = _planner_snap(t=100.0, reopt=4.0)
+    b = _planner_snap(t=102.0, reopt=10.0)
+    # first frame: absolute counts
+    (row,) = planner_rows({"n1": a}, None)
+    assert row["decisions"] == 12
+    assert row["mix"] == {"compressed": 5, "postings": 2,
+                          "columnar": 5}
+    assert row["reopt_rate"] == 5.0  # violation 4 + drift 1
+    assert row["viol_rate"] == pytest.approx(6.0 / 200.0)
+    assert row["suppressed"] == 1
+    # second frame: labeled-counter deltas over dt
+    (row,) = planner_rows({"n1": b}, {"n1": a})
+    assert row["reopt_rate"] == pytest.approx(3.0)  # (10-4)/2s
+    # violations did not move between polls: a converged node reads
+    # 0, not a decaying lifetime average
+    assert row["viol_rate"] == 0.0
+    # static nodes / down nodes render no row
+    assert planner_rows({"s": _snap(), "down": None}, None) == []
+
+
+def test_planner_panel_renders():
+    frame = render({"n1": _planner_snap()})
+    assert "PLANNER" in frame
+    assert "compressed=5" in frame and "columnar=5" in frame
+    static = render({"n1": _snap()})
+    assert "PLANNER" not in static
 
 
 def test_hottest_tablets_cluster_wide_order():

@@ -126,8 +126,11 @@ def test_explain_plan_payload(db):
     assert len(p["skeleton"]) == 16
     int(p["skeleton"], 16)
     assert p["blocks"] and isinstance(p["blocks"][0], str)
-    assert set(e["tiers"]) == {"columnar", "compressed", "device",
-                               "deviceMinEdges"}
+    assert set(e["tiers"]) == {"planner", "columnar", "compressed",
+                               "device", "deviceMinEdges"}
+    assert e["tiers"]["planner"] in ("adaptive", "static")
+    # per-stage tier decisions ride every explain payload
+    assert isinstance(e["tierDecisions"], list)
     blk = e["blocks"][0]
     for k in ("name", "attr", "estRows", "estRowsMax", "basis",
               "source"):

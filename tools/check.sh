@@ -50,6 +50,13 @@ echo "== stats overhead =="
 # non-zero exit = over budget (DGRAPH_TPU_STATS_BUDGET overrides)
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --stats-overhead
 
+echo "== planner overhead + smoke =="
+# adaptive-planner decision cost (consults x warm per-consult cost)
+# must stay < 1% of the summary mix, AND a warm pass must serve every
+# tier decision from the plan cache (zero rebuilds after convergence)
+# — non-zero exit on either (DGRAPH_TPU_PLANNER_BUDGET overrides)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --planner-overhead
+
 echo "== pprof overhead =="
 # the on-demand sampling profiler at its default 100 Hz must cost
 # < 2% of throughput while active (decomposed per-sample x rate gate;

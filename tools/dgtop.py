@@ -177,6 +177,65 @@ def ingest_cdc_rows(snaps: dict[str, dict],
     return nodes, subs
 
 
+def planner_rows(snaps: dict[str, dict],
+                 prev: Optional[dict[str, dict]] = None) -> list[dict]:
+    """The PLANNER panel's rows: per-node tier-decision mix (from
+    /debug/stats `planner`, the adaptive planner's per-stage choices),
+    re-optimization events/s and estimate-violation rate (counter
+    deltas). Pure — tests drive it with canned payloads. Static-mode
+    nodes produce no row (the panel disappears when nobody adapts)."""
+    rows = []
+    for node in sorted(snaps):
+        snap = snaps[node]
+        if snap is None:
+            continue
+        pl = snap["stats"].get("planner") or {}
+        if pl.get("mode") != "adaptive":
+            continue
+        counters = snap["stats"].get("counters", {})
+        p = (prev or {}).get(node)
+        dt = None
+        if p is not None:
+            dt = max(1e-6, snap["t"] - p["t"])
+
+        def csum(prefix: str, cs: dict) -> float:
+            # labeled planner counters render as `name{reason="..."}`:
+            # sum every series of the family
+            return sum(v for k, v in cs.items()
+                       if k == prefix or k.startswith(prefix + "{"))
+
+        def rate(prefix: str) -> float:
+            cur = csum(prefix, counters)
+            if dt is None:
+                return cur
+            return (cur - csum(prefix, p["stats"]
+                               .get("counters", {}))) / dt
+
+        mix: dict[str, int] = {}
+        for tiers in (pl.get("mix") or {}).values():
+            for tier, nn in tiers.items():
+                mix[tier] = mix.get(tier, 0) + int(nn)
+        # violation rate per query, as a DELTA between polls like the
+        # other rates — a node that mis-estimated heavily at warm-up
+        # and then converged must read 0, not a slowly decaying
+        # lifetime average
+        viol = csum("planner_estimate_violations_total", counters)
+        queries = counters.get("dgraph_num_queries_total", 0.0)
+        if p is not None:
+            pc = p["stats"].get("counters", {})
+            viol -= csum("planner_estimate_violations_total", pc)
+            queries -= pc.get("dgraph_num_queries_total", 0.0)
+        rows.append({
+            "node": node,
+            "decisions": pl.get("decisions", 0),
+            "mix": mix,
+            "reopt_rate": rate("planner_reoptimized_total"),
+            "viol_rate": viol / queries if queries else 0.0,
+            "suppressed": pl.get("replansSuppressed", 0),
+        })
+    return rows
+
+
 def hottest(snaps: dict[str, dict], top: int = 5) -> list[dict]:
     """Cluster-wide hottest tablets by query-path touches, with their
     cheap size facts. Pure — tests drive it with canned payloads."""
@@ -279,6 +338,19 @@ def render(snaps: dict[str, dict],
             lines.append(
                 f"{s['id'] + ' @ ' + s['node']:<40} "
                 f"{s['pred']:<20.20} {s['offset']:>12} {s['lag']:>6}")
+    plan = planner_rows(snaps, prev)
+    if plan:
+        lines.append("")
+        lines.append(f"{'PLANNER':<28} {'DECIDED':>8} "
+                     f"{'MIX (tier=decisions)':<34} {'REOPT/S':>8} "
+                     f"{'VIOL%':>6} {'SUPPR':>6}")
+        for r in plan:
+            mix = ",".join(f"{t}={n}" for t, n in
+                           sorted(r["mix"].items())) or "-"
+            lines.append(
+                f"{r['node']:<28} {r['decisions']:>8} {mix:<34.34} "
+                f"{r['reopt_rate']:>8.2f} "
+                f"{100 * r['viol_rate']:>6.2f} {r['suppressed']:>6}")
     hot = hottest(snaps)
     if hot:
         lines.append("")
