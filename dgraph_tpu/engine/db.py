@@ -133,7 +133,13 @@ class GraphDB:
                  prefer_compressed: bool = True,
                  host_tile_budget: int = 512 << 20,
                  plan_cache_size: int = 128,
-                 planner: str = "auto"):
+                 planner: str = "auto",
+                 vec_quantized: bool = True,
+                 vec_index_min_rows: int = 1 << 17,
+                 vec_target_recall: float = 0.98,
+                 vec_nprobe: int | None = None,
+                 vec_rerank: int | None = None,
+                 vec_max_k: int = 128):
         from dgraph_tpu.engine.tile_cache import DeviceCacheLRU
         from dgraph_tpu.ops.codec import DecodeScratch
         from dgraph_tpu.query.plan import PlanCache
@@ -218,6 +224,23 @@ class GraphDB:
         # multi-part posting lists; SURVEY §5.7)
         self.mesh = mesh
         self.shard_min_edges = shard_min_edges
+        # quantized ANN tier for similar_to (ops/ivf.py via
+        # storage/vecstore.py): IVF k-means + int8 residual codes,
+        # trained at rollup on clean base blocks once a vector
+        # predicate crosses vec_index_min_rows (below it the exact
+        # tiers are already fast), recall budgeted by
+        # vec_target_recall at build. vec_quantized=False removes the
+        # tier everywhere (the exact-path parity oracle, same policy
+        # as prefer_columnar); vec_nprobe / vec_rerank override the
+        # calibrated probe count and re-rank depth; k > vec_max_k
+        # falls back to the exact tiers (calibration holds at
+        # k_ref=10, not at arbitrary depth)
+        self.vec_quantized = vec_quantized
+        self.vec_index_min_rows = vec_index_min_rows
+        self.vec_target_recall = vec_target_recall
+        self.vec_nprobe = vec_nprobe
+        self.vec_rerank = vec_rerank
+        self.vec_max_k = vec_max_k
         # background rollups lag this many LOGICAL ts behind the
         # newest commit, so pinned snapshot readers (zero-issued
         # global ts) rarely find their snapshot already folded; a
@@ -1272,6 +1295,44 @@ class GraphDB:
         for tab in self.tablets.values():
             if tab.dirty():
                 tab.rollup(wm)
+        self._train_vector_indexes()
+
+    def _train_vector_indexes(self):
+        """Rollup hook: (re)train the quantized ANN index of every
+        vector tablet whose clean base crossed vec_index_min_rows.
+        A tablet whose base_ts did not move keeps its index (the
+        cache validates the version); training failures degrade to
+        the exact tiers, never to an error."""
+        if not self.vec_quantized:
+            return
+        from dgraph_tpu.models.types import TypeID
+        for tab in self.tablets.values():
+            if tab.schema.value_type != TypeID.FLOAT32VECTOR:
+                continue
+            if len(tab.values) < self.vec_index_min_rows:
+                continue
+            try:
+                tab.build_vector_ivf(
+                    min_rows=self.vec_index_min_rows,
+                    target_recall=self.vec_target_recall)
+            except Exception as e:
+                from dgraph_tpu.utils.logger import log
+                log.error("vector_index_build_failed", pred=tab.pred,
+                          error=f"{type(e).__name__}: {e}")
+
+    def build_vector_index(self, pred: str, *, nlist: int | None = None,
+                           force: bool = True):
+        """Explicitly train the quantized ANN index for one vector
+        predicate (operators / tests; rollup trains automatically
+        above vec_index_min_rows). Returns the index description or
+        None when the tablet is empty."""
+        tab = self.tablets.get(pred)
+        if tab is None:
+            raise ValueError(f"no tablet for predicate {pred!r}")
+        ix = tab.build_vector_ivf(
+            nlist=nlist, force=force,
+            target_recall=self.vec_target_recall)
+        return ix.describe() if ix is not None else None
 
     def state(self) -> dict:
         """Cluster/engine introspection (ref /state handler,

@@ -155,6 +155,54 @@ def score_dot_pallas(corpus: jax.Array, queries: jax.Array,
     )(corpus, queries)
 
 
+def score_int8_pallas(codes: jax.Array, queries: jax.Array,
+                      interpret: bool | None = None) -> jax.Array:
+    """Dequant-and-dot tile kernel for the quantized ANN tier
+    (ops/ivf.py): int8 residual codes (n, d) x float32 queries (b, d)
+    -> (b, n) float32 approximate dots. Same pipeline shape as
+    score_dot_pallas — one (TILE, d) codes block DMAd HBM->VMEM per
+    grid step, queries resident — with the int8 -> f32 convert fused
+    into the tile so the MXU contraction reads the narrow form
+    straight out of VMEM (TPU-KNN's peak-FLOP/s recipe at a quarter
+    of the HBM traffic). Per-row dequant scales and the centroid dot
+    term are rank-1 postprocessing the caller applies. XLA parity
+    fallback: score_int8_xla."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, d = codes.shape
+    b = queries.shape[0]
+    if n % SCORE_TILE_N != 0:
+        raise ValueError(
+            f"code rows {n} must be a multiple of {SCORE_TILE_N} "
+            "(ops/ivf pads)")
+
+    def kernel(c_ref, q_ref, out_ref):
+        tile = c_ref[...].astype(jnp.float32)
+        out_ref[...] = jnp.dot(q_ref[...], tile.T,
+                               preferred_element_type=jnp.float32)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n // SCORE_TILE_N,),
+        in_specs=[
+            pl.BlockSpec((SCORE_TILE_N, d), lambda i: (i, 0)),
+            pl.BlockSpec((b, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((b, SCORE_TILE_N), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((b, n), jnp.float32),
+        interpret=_INTERPRET_ON if interpret else False,
+    )(codes, queries)
+
+
+@jax.jit
+def score_int8_xla(codes: jax.Array, queries: jax.Array) -> jax.Array:
+    """The jitted XLA contraction score_int8_pallas must match
+    bit-for-bit semantics-wise — CPU-parity fallback and the
+    differential oracle for the tile kernel."""
+    return jnp.dot(queries, codes.astype(jnp.float32).T,
+                   preferred_element_type=jnp.float32)
+
+
 
 
 # -- bitmap word-AND kernel (ops/setops compressed block plane) --------------

@@ -21,4 +21,6 @@ from dgraph_tpu.parallel.dist_graph import (
     RingAdjacency, ShardedAdjacency, build_ring_adjacency,
     build_sharded_adjacency, make_ring_bfs, make_sharded_bfs,
 )
-from dgraph_tpu.parallel.dist_knn import shard_corpus, sharded_topk
+from dgraph_tpu.parallel.dist_knn import (
+    shard_corpus, sharded_ivf_topk, sharded_topk,
+)

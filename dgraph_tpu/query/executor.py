@@ -363,6 +363,11 @@ class Executor:
         self.tier_decisions: list = []
         self._dec_memo: dict = {}
         self._served_tier: Optional[str] = None
+        # per-request vector-tier decisions (one per similar_to eval):
+        # which tier actually scored (host/device exact, two_stage,
+        # quantized, sharded) plus the quantized budget (nprobe,
+        # rerank) — EXPLAIN surfaces them as tiers.vector
+        self.vector_decisions: list[dict] = []
 
     def _checkpoint(self, where: str):
         """Block/level boundary: the `executor.level` failpoint (chaos
@@ -1278,46 +1283,110 @@ class Executor:
         n = len(view.base_uids)
         if n and base_mask.any():
             qm = qvec[None, :]
-            # device-vs-host tier: the planner weighs the measured
-            # dispatch RTT against the observed per-row scoring cost;
-            # static mode and the force override keep the
-            # device_min_edges threshold. The mesh-sharded tier stays
+            # quantized eligibility: a trained index for the CURRENT
+            # base state, root context (a filter's candidate subset
+            # can defeat the probe's recall budget — candidates keep
+            # the exact tiers), and k within the calibrated regime.
+            # vec_quantized=False is the exact-path parity oracle.
+            ivf = tab.vector_ivf() \
+                if hasattr(tab, "vector_ivf") else None
+            quant_ok = (ivf is not None and self.db.vec_quantized
+                        and candidates is None
+                        and k <= self.db.vec_max_k)
+            # tier arbitration: the planner weighs the measured
+            # dispatch RTT / observed per-stage cost against the
+            # per-tier scanned-row counts (the quantized tier scores
+            # ~n*nprobe/nlist rows + the re-rank, not n); static mode
+            # keeps the flag ladder. The mesh-sharded tier stays
             # first — capacity, not latency.
             dec = None
-            if self._adaptive and self.db.device_min_edges > 1 \
-                    and self.db.prefer_device \
-                    and self.db.mesh is None:
+            force_device = self.db.prefer_device \
+                and self.db.device_min_edges <= 1
+            avail = ["postings"]
+            if self.db.prefer_device and self.db.device_min_edges > 1:
+                avail.append("device")
+            if quant_ok:
+                avail.append("quantized")
+            if self._adaptive and len(avail) > 1 \
+                    and not force_device and self.db.mesh is None:
+                rows_by_tier = None
+                if quant_ok:
+                    rows_by_tier = {"quantized": ivf.scanned_rows(
+                        self.db.vec_nprobe)}
                 dec = self._tier_decision(
                     "similar_to", fn.attr,
                     {"estRows": n, "estRowsMax": n, "basis": "exact",
                      "source": "vector block rows"},
-                    ("postings", "device"))
-            use_device = (dec.tier == "device") if dec is not None \
-                else (self.db.prefer_device
-                      and n >= self.db.device_min_edges)
+                    tuple(avail), rows_by_tier)
+            if dec is not None:
+                use_quant = dec.tier == "quantized"
+                use_device = dec.tier == "device"
+            else:
+                # device_min_edges <= 1 force-routes device (the
+                # pinned-tier debugging convention) ahead of the tier
+                use_quant = quant_ok and not force_device
+                use_device = not use_quant \
+                    and self.db.prefer_device \
+                    and n >= self.db.device_min_edges
+            vdec = {"pred": fn.attr, "k": int(k), "n": int(n),
+                    "metric": metric}
             if self.db.mesh is not None \
                     and n >= self.db.shard_min_edges:
-                idx, sc = self._sharded_vec_topk(tab, view, qm, k,
-                                                 metric, base_mask)
+                if quant_ok:
+                    idx, sc = self._sharded_ivf_topk(
+                        tab, ivf, view, qm, k, metric, base_mask)
+                    vdec.update(tier="sharded_quantized",
+                                **self._vec_budget(ivf, k))
+                else:
+                    idx, sc = self._sharded_vec_topk(
+                        tab, view, qm, k, metric, base_mask)
+                    vdec["tier"] = "sharded"
                 if sp is not None:
-                    sp["tier"] = "device"
+                    # cost attribution follows the SERVING tier: the
+                    # mesh-quantized span must not pollute the exact
+                    # device tier's cost cells
+                    sp["tier"] = vdec["tier"] \
+                        if vdec["tier"] == "sharded_quantized" \
+                        else "device"
+            elif use_quant:
+                from dgraph_tpu.ops import ivf as _ivf
+                idx, sc = _ivf.search(
+                    ivf, view.base_vecs, qm, k, metric,
+                    keep=base_mask, nprobe=self.db.vec_nprobe,
+                    rerank=self.db.vec_rerank)
+                inc_counter("query_similar_quantized_total")
+                budget = self._vec_budget(ivf, k)
+                scanned = budget["scannedRows"]
+                vdec.update(tier="quantized", **budget)
+                if sp is not None:
+                    sp["tier"] = "quantized"
+                    # the span's size drives the coststore cell's
+                    # bucket: record the SCANNED rows, the same size
+                    # axis rows_by_tier gave the decision probe — a
+                    # full-n bucket would park quantized observations
+                    # where the planner never looks
+                    sp["n"] = int(scanned)
             elif use_device:
                 idx, sc = _knn.topk_device(
                     self._device_vec_block(tab, view), qm, k, metric,
                     mask=base_mask, n_real=n)
                 inc_counter("query_similar_device_total")
+                vdec["tier"] = "two_stage" \
+                    if _knn.plan_two_stage(n, k) > 0 else "exact"
                 if sp is not None:
                     sp["tier"] = "device"
                     sp["n"] = int(n)
             else:
                 idx, sc = _knn.topk_host(view.base_vecs, qm, k,
                                          metric, mask=base_mask)
+                vdec["tier"] = "exact"
                 if sp is not None:
                     sp["tier"] = "postings"
                     sp["n"] = int(n)
+            self.vector_decisions.append(vdec)
             self._record_outcome(dec, n)
             row, s = idx[0], sc[0]
-            ok = np.isfinite(s) & (row < n)
+            ok = np.isfinite(s) & (row < n) & (row >= 0)
             parts.append((view.base_uids[row[ok]], s[ok]))
         if len(ex_uids):
             idx, sc = _knn.topk_host(ex_vecs, qvec[None, :], k, metric)
@@ -1348,6 +1417,38 @@ class Executor:
         arr = jnp.asarray(_knn.pad_rows(view.base_vecs))
         tab._device_vecs = (tab.base_ts, arr)
         return arr
+
+    def _vec_rerank(self, k: int) -> int:
+        """Effective exact re-rank depth for the quantized tier."""
+        from dgraph_tpu.ops import ivf as _ivf
+        return int(self.db.vec_rerank or _ivf.rerank_depth(k))
+
+    def _vec_budget(self, ivf, k: int) -> dict:
+        """The quantized tier's live budget as EXPLAIN reports it —
+        ONE builder so the sharded and single-device tiers.vector
+        entries can't drift apart. nprobe clamps to nlist exactly
+        like ops/ivf.search does."""
+        return {
+            "nprobe": min(ivf.nlist,
+                          int(self.db.vec_nprobe or ivf.nprobe)),
+            "rerank": self._vec_rerank(k),
+            "nlist": ivf.nlist,
+            "scannedRows": ivf.scanned_rows(self.db.vec_nprobe),
+            "sampleRecall": round(float(ivf.sample_recall), 4),
+        }
+
+    def _sharded_ivf_topk(self, tab, ivf, view, qm, k, metric,
+                          base_mask):
+        """Quantized scoring over a sharded corpus: per-shard
+        candidate top-R + k-way merge + exact re-rank
+        (parallel/dist_knn.sharded_ivf_topk)."""
+        from dgraph_tpu.parallel.dist_knn import sharded_ivf_topk
+
+        inc_counter("query_similar_sharded_total")
+        return sharded_ivf_topk(
+            self.db.mesh, ivf, view.base_vecs, qm, k, metric,
+            keep=base_mask, nprobe=self.db.vec_nprobe,
+            rerank=self.db.vec_rerank)
 
     def _sharded_vec_topk(self, tab, view, qm, k, metric, base_mask):
         """Mesh-sharded scoring: the block rides the `uid` axis, each

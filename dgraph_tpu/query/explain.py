@@ -317,6 +317,13 @@ def build_explain(db, ex, done, expinfo: dict) -> dict:
             and bool(getattr(db, "prefer_compressed", True)),
             "device": bool(getattr(db, "prefer_device", False)),
             "deviceMinEdges": int(getattr(db, "device_min_edges", 0)),
+            "quantized": bool(getattr(db, "vec_quantized", False)),
+            # per-stage vector-tier decisions, one per similar_to
+            # evaluation this request ran: the tier that actually
+            # scored (exact / two_stage / quantized / sharded*) and,
+            # for the quantized tier, its recall budget (nprobe,
+            # rerank depth, calibrated sample recall)
+            "vector": list(getattr(ex, "vector_decisions", ())),
         },
         # per-stage chosen tier + estimate basis + decision inputs
         # (query/planner.py Decision.describe): every tier decision

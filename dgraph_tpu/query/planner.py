@@ -111,9 +111,18 @@ STATIC_PRIORS: dict[tuple[str, str], tuple[float, float]] = {
     ("sort", "device"): (5.0, 0.002),
     ("sort", "columnar"): (6.0, 0.010),
     ("sort", "postings"): (8.0, 0.050),
-    # similar_to: MXU top-k vs host brute-force MIPS
+    # similar_to: quantized IVF probe (per SCANNED row — the caller
+    # passes rows_by_tier with n*nprobe/nlist, so the row count
+    # carries the probe's selectivity; per-row covers the int8
+    # convert+gemm) vs MXU exact top-k vs host brute-force MIPS.
+    # postings per-row is the MEASURED float64 host constant
+    # (~180 ms / 100k x 128 single query, BENCH_VECTORS
+    # host_exact_qps) — an optimistic figure here makes observed
+    # quantized/device evidence "lose" to a fantasy host tier and
+    # mis-routes similar_to onto a path that is orders slower
+    ("similar_to", "quantized"): (6.0, 0.010),
     ("similar_to", "device"): (5.0, 0.002),
-    ("similar_to", "postings"): (8.0, 0.030),
+    ("similar_to", "postings"): (8.0, 1.800),
 }
 
 # estimate-violation threshold: actual rows >= this many size buckets
@@ -180,12 +189,13 @@ class Decision:
 
     __slots__ = ("stage", "pred", "tier", "basis", "est_rows",
                  "est_basis", "bucket", "costs", "version", "why",
-                 "skeleton", "outcomes")
+                 "skeleton", "outcomes", "rows_buckets")
 
     def __init__(self, stage: str, pred: str, tier: str, basis: str,
                  est_rows: int, est_basis: str, bucket: int,
                  costs: dict[str, float], version: int, why: str,
-                 skeleton: str):
+                 skeleton: str,
+                 rows_buckets: Optional[dict[str, int]] = None):
         self.stage = stage
         self.pred = pred
         self.tier = tier
@@ -198,6 +208,11 @@ class Decision:
         self.why = why
         self.skeleton = skeleton
         self.outcomes = 0           # outcomes recorded against this
+        # per-tier row-bucket overrides the decision was costed with
+        # (the similar_to seam: the quantized tier scans
+        # ~n*nprobe/nlist rows and its cost cells key on THAT bucket;
+        # outcome-time drift/rival probes must look there too)
+        self.rows_buckets = rows_buckets
 
     def describe(self) -> dict:
         return {"stage": self.stage, "pred": self.pred,
@@ -351,7 +366,8 @@ class AdaptivePlanner:
             why = "static priors (cold cells)" if not warm \
                 else "observed EWMA"
         dec = Decision(stage, pred, tier, basis, est_rows, est_basis,
-                       bucket, costs, version, why, skeleton)
+                       bucket, costs, version, why, skeleton,
+                       rows_buckets=rows_buckets)
         metrics.inc_counter("planner_decisions_total",
                             labels={"tier": tier})
         with self._lock:
@@ -392,8 +408,13 @@ class AdaptivePlanner:
             # bucket: cost cells are recorded under the span's real
             # result size, and a sub-violation estimate error (1-2
             # buckets) would otherwise make every probe miss — both
-            # self-correction paths would silently never fire
-            ratio = coststore.drift(dec.stage, dec.tier, ab,
+            # self-correction paths would silently never fire. A
+            # tier costed with a rows_buckets override records its
+            # spans under THAT bucket (the quantized tier's scanned
+            # rows), so its probes follow the override, not `ab`.
+            rb = dec.rows_buckets or {}
+            ratio = coststore.drift(dec.stage, dec.tier,
+                                    rb.get(dec.tier, ab),
                                     dec.skeleton)
             if ratio >= DRIFT or ratio <= 1.0 / DRIFT:
                 self._invalidate(key, "drift")
@@ -406,7 +427,8 @@ class AdaptivePlanner:
             # exact_only: this runs per sampled OUTCOME — two dict
             # probes per tier, never the estimate() table scan (that
             # is decision-build territory).
-            cur = coststore.estimate(dec.stage, dec.tier, ab,
+            cur = coststore.estimate(dec.stage, dec.tier,
+                                     rb.get(dec.tier, ab),
                                      dec.skeleton, exact_only=True)
             if cur is None or not cur["warm"]:
                 return
@@ -415,7 +437,8 @@ class AdaptivePlanner:
                     # device rivalry needs the RTT added in; only a
                     # full rebuild models it — skip (conservative)
                     continue
-                alt = coststore.estimate(dec.stage, tier, ab,
+                alt = coststore.estimate(dec.stage, tier,
+                                         rb.get(tier, ab),
                                          dec.skeleton,
                                          exact_only=True)
                 if alt is not None and alt["warm"] \

@@ -102,7 +102,7 @@ def dump_tablet(tab) -> dict:
     pinned below the newest commits (active txns, pinned snapshot
     readers), and a payload of base arrays alone would silently drop
     those committed writes from snapshots/backups."""
-    return {
+    out = {
         "edges_gv": _gv_dict(tab.edges),
         "reverse_gv": _gv_dict(tab.reverse),
         "values_pk": _pack_values(tab.values),
@@ -112,6 +112,14 @@ def dump_tablet(tab) -> dict:
         "deltas": tab.deltas,
         "max_commit_ts": tab.max_commit_ts,
     }
+    # trained quantized ANN index (storage/vecstore.py): ships with
+    # the tablet so bulk-loaded / moved / restored tablets boot with
+    # their codebooks instead of retraining k-means at first query
+    ivf = getattr(tab, "vector_ivf", lambda: None)()
+    if ivf is not None:
+        from dgraph_tpu.storage.vecstore import ivf_to_payload
+        out["vec_ivf"] = ivf_to_payload(ivf)
+    return out
 
 
 def restore_tablet(pred: str, schema, st: dict):
@@ -134,6 +142,10 @@ def restore_tablet(pred: str, schema, st: dict):
     tab.max_commit_ts = int(st.get("max_commit_ts", tab.base_ts))
     for ts, _ops in tab.deltas:
         tab.max_commit_ts = max(tab.max_commit_ts, ts)
+    if "vec_ivf" in st:
+        from dgraph_tpu.storage.vecstore import ivf_from_payload
+        tab._vec_ivf = (tab.base_ts, tab.schema,
+                        ivf_from_payload(st["vec_ivf"]))
     return tab
 
 

@@ -1257,6 +1257,19 @@ class Tablet:
         from dgraph_tpu.storage.vecstore import vector_view
         return vector_view(self, read_ts)
 
+    def vector_ivf(self):
+        """The trained quantized ANN index for the CURRENT base state,
+        or None (stale after a rollup that folded vector ops — the
+        exact tiers keep serving until retrain)."""
+        from dgraph_tpu.storage.vecstore import vector_ivf
+        return vector_ivf(self)
+
+    def build_vector_ivf(self, **kw):
+        """Train (or reuse) the quantized index over the base block
+        (storage/vecstore.build_ivf)."""
+        from dgraph_tpu.storage.vecstore import build_ivf
+        return build_ivf(self, **kw)
+
     # -- sortable keys for device values --
 
     def sort_key_arrays(self, lang: str = ""):

@@ -104,6 +104,10 @@ def residency(tab) -> dict:
     add("tokenCSR", "_tok_csr", "_tok_csr_ts")
     add("edgeTable", "_edge_table", "_edge_table_ts")
     add("deviceAdj", "_device_adj", "_device_adj_ts")
+    # vector plane: packed base block + quantized IVF index bytes
+    # (storage/vecstore.ivf_residency; 0 when stale or absent)
+    from dgraph_tpu.storage.vecstore import ivf_residency
+    out.update(ivf_residency(tab))
     # the compressed token-index export is NOT a decoded structure —
     # it lands in compressed_residency()/bytesCompressed, never in
     # bytesDecoded (the whole point is the at-rest/decoded split)
@@ -245,6 +249,13 @@ def tablet_stats(tab) -> dict:
     out["compressedResidency"] = comp
     out["bytesDecoded"] = int(sum(res.values()))
     out["bytesCompressed"] = int(sum(comp.values()))
+    ivf = getattr(tab, "vector_ivf", None)
+    if ivf is not None:
+        ix = ivf()
+        if ix is not None:
+            # trained quantized ANN index: the budget EXPLAIN costs
+            # against and dgtop's vector-tier view
+            out["vectorIndex"] = ix.describe()
     return out
 
 

@@ -29,6 +29,8 @@ from dataclasses import dataclass
 import numpy as np
 
 from dgraph_tpu.models.types import TypeID, vector_value
+from dgraph_tpu.utils import failpoint
+from dgraph_tpu.utils.metrics import set_gauge
 
 _EMPTY_U64 = np.empty(0, dtype=np.uint64)
 
@@ -151,3 +153,105 @@ def vector_view(tab, read_ts: int) -> VecView:
     if not base_vecs.size and dim:
         base_vecs = np.empty((0, dim), np.float32)
     return VecView(dim, base_uids, base_vecs, keep, ex_u, ex_v)
+
+
+# ---------------------------------------------------------------------------
+# quantized IVF index (ops/ivf.py) — trained on clean base blocks,
+# versioned per (base_ts, schema) exactly like the columnar exports
+# ---------------------------------------------------------------------------
+
+
+def vector_ivf(tab):
+    """The tablet's trained quantized index, or None. Valid only for
+    the CURRENT (base_ts, schema): a rollup that folds vector ops
+    moves base_ts and the stale index silently disappears — overlay
+    rows between rollups ride the exact path (vector_view), so
+    snapshot semantics never depend on index freshness."""
+    cached = getattr(tab, "_vec_ivf", None)
+    if cached is not None and cached[0] == tab.base_ts \
+            and cached[1] is tab.schema:
+        return cached[2]
+    return None
+
+
+def build_ivf(tab, *, nlist=None, seed: int = 0,
+              target_recall: float | None = None, min_rows: int = 0,
+              force: bool = False):
+    """Train (or reuse) the quantized index over the tablet's base
+    block. Returns the index, or None when the block is empty /
+    below min_rows. The build is deterministic per (block, seed):
+    two replicas training over the same base state produce
+    byte-identical codebooks — the property snapshot determinism
+    (ingest/distributed.py) leans on."""
+    from dgraph_tpu.ops import ivf as _ivf
+    from dgraph_tpu.utils.tracing import span as _span
+
+    cur = vector_ivf(tab)
+    if cur is not None and not force:
+        return cur
+    _uids, vecs = _base_block(tab)
+    n = len(vecs)
+    if n == 0 or (not force and n < min_rows):
+        return None
+    failpoint.fire("vecstore.build")
+    with _span("vector.build", pred=tab.pred, rows=n):
+        kw = {}
+        if target_recall is not None:
+            kw["target_recall"] = float(target_recall)
+        ix = _ivf.build(vecs, nlist=nlist, seed=seed, **kw)
+    tab._vec_ivf = (tab.base_ts, tab.schema, ix)
+    set_gauge("vector_index_bytes", float(ix.nbytes),
+              labels={"predicate": tab.pred})
+    return ix
+
+
+def ivf_residency(tab) -> dict:
+    """Vector-plane residency for tabstats: decoded base block bytes
+    plus the quantized index's footprint (0 when stale/absent)."""
+    out = {"vecBase": 0, "vecIndex": 0}
+    vb = getattr(tab, "_vec_base", None)
+    if vb is not None and vb[0] == tab.base_ts and vb[1] is tab.schema:
+        out["vecBase"] = int(vb[3].nbytes + vb[2].nbytes)
+    ix = vector_ivf(tab)
+    if ix is not None:
+        out["vecIndex"] = int(ix.nbytes)
+    return out
+
+
+def ivf_to_payload(ix) -> dict:
+    """Index -> wire-shape dict for the snapshot plane. Arrays ship
+    as raw little-endian bytes + shape so the payload is
+    byte-deterministic (the group-varint planes' contract; float
+    blocks don't delta-compress, they stay dense)."""
+    return {
+        "v": 1, "dim": ix.dim, "nlist": ix.nlist,
+        "nprobe": ix.nprobe,
+        "sample_recall": float(ix.sample_recall),
+        "target_recall": float(ix.target_recall),
+        "seed": int(ix.seed),
+        "centroids": ix.centroids.tobytes(),
+        "order": ix.order.tobytes(),
+        "starts": ix.starts.tobytes(),
+        "codes": ix.codes.tobytes(),
+        "scales": ix.scales.tobytes(),
+        "norms2": ix.norms2.tobytes(),
+    }
+
+
+def ivf_from_payload(st: dict):
+    from dgraph_tpu.ops.ivf import IVFIndex
+    d, nc = int(st["dim"]), int(st["nlist"])
+    n = len(st["order"]) // 4
+    return IVFIndex(
+        dim=d, nlist=nc,
+        centroids=np.frombuffer(st["centroids"], "<f4")
+        .reshape(nc, d).copy(),
+        order=np.frombuffer(st["order"], "<i4").copy(),
+        starts=np.frombuffer(st["starts"], "<i8").copy(),
+        codes=np.frombuffer(st["codes"], "i1").reshape(n, d).copy(),
+        scales=np.frombuffer(st["scales"], "<f4").copy(),
+        norms2=np.frombuffer(st["norms2"], "<f4").copy(),
+        nprobe=int(st["nprobe"]),
+        sample_recall=float(st["sample_recall"]),
+        target_recall=float(st["target_recall"]),
+        seed=int(st.get("seed", 0)))

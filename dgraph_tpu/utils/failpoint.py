@@ -72,6 +72,10 @@ SITES = (
     #                      poll before entries are served (sleep =
     #                      slow delivery; error = failed poll, the
     #                      subscriber retries/resumes by offset)
+    "vecstore.build",    # storage/vecstore.py  — before a quantized
+    #                      ANN index trains over a clean base block
+    #                      (error = build dies, exact tiers keep
+    #                      serving; sleep = slow k-means)
 )
 
 

@@ -79,7 +79,12 @@ REGISTERED = (
     "query_regexp_batch_total",
     "query_sharded_expand_total",
     "query_similar_device_total",
+    "query_similar_quantized_total",
     "query_similar_sharded_total",
+    # quantized vector index (ops/ivf.py, storage/vecstore.py)
+    "vector_index_builds_total",
+    "vector_index_bytes",
+    "vector_quantized_searches_total",
     # change streams (cdc/changelog.py)
     "dgraph_cdc_appended_total",
     "dgraph_cdc_delivered_total",

@@ -94,6 +94,7 @@ SPAN_NAMES = (
     "similar_to",
     "sort",
     "tablet.rollup",
+    "vector.build",
     "wal.append",
 )
 

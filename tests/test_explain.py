@@ -127,7 +127,9 @@ def test_explain_plan_payload(db):
     int(p["skeleton"], 16)
     assert p["blocks"] and isinstance(p["blocks"][0], str)
     assert set(e["tiers"]) == {"planner", "columnar", "compressed",
-                               "device", "deviceMinEdges"}
+                               "device", "deviceMinEdges", "quantized",
+                               "vector"}
+    assert e["tiers"]["vector"] == []  # no similar_to in this request
     assert e["tiers"]["planner"] in ("adaptive", "static")
     # per-stage tier decisions ride every explain payload
     assert isinstance(e["tierDecisions"], list)
@@ -145,6 +147,48 @@ def test_explain_plan_payload(db):
     # children annotated with expansion estimates
     kids = {c["attr"]: c for c in blk["children"]}
     assert "friend" in kids and kids["friend"]["basis"] == "stats"
+
+
+def test_explain_vector_tier_decisions():
+    """A similar_to request's explain carries tiers.vector: one entry
+    per evaluation with the serving tier and, when quantized, its
+    recall budget (nprobe / rerank / calibrated sample recall) —
+    alongside the planner's generic tierDecisions entry."""
+    import numpy as np
+
+    rng = np.random.default_rng(50)
+    C = rng.standard_normal((16, 4)).astype(np.float32)
+    vecs = C[rng.integers(0, 16, 400)] + np.float32(0.3) \
+        * rng.standard_normal((400, 4)).astype(np.float32)
+    d = GraphDB(prefer_device=False, vec_index_min_rows=100)
+    d.alter("embedding: float32vector @index(vector) .")
+    d.mutate(set_nquads="\n".join(
+        f'<0x{i + 1:x}> <embedding> "{list(map(float, vecs[i]))}"'
+        '^^<xs:float32vector> .' for i in range(len(vecs))),
+        commit_now=True)
+    d.rollup_all()
+    q = ('{ q(func: similar_to(embedding, 3, "[1.0, 0.0, -1.0, '
+         '0.5]")) { uid } }')
+    e = d.query(q, explain="analyze")["extensions"]["explain"]
+    vd = e["tiers"]["vector"]
+    assert len(vd) == 1
+    ent = vd[0]
+    assert ent["pred"] == "embedding" and ent["tier"] == "quantized"
+    for key in ("nprobe", "rerank", "nlist", "scannedRows",
+                "sampleRecall", "k", "n", "metric"):
+        assert key in ent, key
+    assert ent["scannedRows"] <= ent["n"]
+    sim = [x for x in e["tierDecisions"] if x["stage"] == "similar_to"]
+    assert sim and sim[0]["tier"] == "quantized"
+    assert "quantized" in sim[0]["costUs"]
+    # tabstats surfaces the trained index for EXPLAIN's costing
+    from dgraph_tpu.storage.tabstats import tablet_stats
+    st = tablet_stats(d.tablets["embedding"])
+    assert st["vectorIndex"]["nlist"] == ent["nlist"]
+    assert st["residency"]["vecIndex"] > 0
+    # the stage span carries the tier for the coststore's cells
+    spans = [s for s in e["stages"] if s["stage"] == "similar_to"]
+    assert spans and spans[0]["tier"] == "quantized"
 
 
 def test_explain_directive_matches_kwarg(db):

@@ -181,6 +181,232 @@ def test_two_stage_recall_1m():
 
 
 # ---------------------------------------------------------------------------
+# quantized IVF tier (ops/ivf.py)
+# ---------------------------------------------------------------------------
+
+
+def _quant_db(n=500, d=4, seed=40, **kw):
+    """A GraphDB whose vector tablet is big enough (past the lowered
+    vec_index_min_rows) that rollup trains the quantized index."""
+    vecs = _clustered(n, d, centers=16, seed=seed)
+    rdf = "\n".join(
+        f'<0x{i + 1:x}> <embedding> "{list(map(float, vecs[i]))}"'
+        '^^<xs:float32vector> .'
+        for i in range(n))
+    kw.setdefault("prefer_device", False)
+    kw.setdefault("vec_index_min_rows", 100)
+    db = GraphDB(**kw)
+    db.alter("embedding: float32vector @index(vector) .")
+    db.mutate(set_nquads=rdf, commit_now=True)
+    db.rollup_all()
+    return db
+
+
+def _clustered(n, d, centers=64, sigma=0.3, seed=0):
+    """Seeded mixture-of-Gaussians corpus — the embedding-shaped
+    workload the IVF coarse quantizer is built for (iid noise has no
+    cluster structure and calibration degrades to a full scan)."""
+    rng = np.random.default_rng(seed)
+    C = rng.standard_normal((centers, d)).astype(np.float32)
+    return C[rng.integers(0, centers, n)] + np.float32(sigma) \
+        * rng.standard_normal((n, d)).astype(np.float32)
+
+
+def test_ivf_recall_at_budgeted_config():
+    """Acceptance: the quantized tier holds recall@10 >= 0.95 at its
+    CALIBRATED budget (nprobe picked at build from the conservative
+    0.98 target) on a seeded corpus, while scanning a fraction of the
+    rows."""
+    from dgraph_tpu.ops import ivf
+
+    corpus = _clustered(60_000, 64, centers=512, seed=30)
+    ix = ivf.build(corpus, seed=0)
+    rng = np.random.default_rng(31)
+    q = corpus[rng.integers(0, len(corpus), 32)] + 0.05 * \
+        rng.standard_normal((32, 64), dtype=np.float32)
+    hi, hs = knn.topk_host(corpus, q, 10, "cosine")
+    qi, qs = ivf.search(ix, corpus, q, 10, "cosine")
+    hits = sum(len(set(hi[b].tolist()) & set(qi[b].tolist()))
+               for b in range(32))
+    assert hits / 320.0 >= 0.95, (hits / 320.0, ix.describe())
+    assert ix.scanned_rows() < len(corpus)
+    # surviving rows carry the exact float64 score (re-rank runs the
+    # host-exact formula)
+    for b in range(32):
+        common = set(hi[b].tolist()) & set(qi[b].tolist())
+        for r in common:
+            a = hs[b][hi[b].tolist().index(r)]
+            bq = qs[b][qi[b].tolist().index(r)]
+            assert abs(a - bq) <= 1e-9 * max(1.0, abs(a))
+
+
+@pytest.mark.parametrize("metric", list(knn.METRICS))
+def test_ivf_metrics_and_keep_mask(metric):
+    from dgraph_tpu.ops import ivf
+
+    corpus = _clustered(8_000, 16, centers=64, seed=32)
+    ix = ivf.build(corpus, seed=0, calibrate=False)
+    q = corpus[123][None] + 0.01
+    qi, qs = ivf.search(ix, corpus, q, 5, metric, nprobe=ix.nlist)
+    hi, _ = knn.topk_host(corpus, q, 5, metric)
+    # full probe + exact re-rank == exact
+    assert np.array_equal(qi, hi)
+    keep = np.ones(len(corpus), bool)
+    keep[qi[0][0]] = False
+    qi2, _ = ivf.search(ix, corpus, q, 5, metric, nprobe=ix.nlist,
+                        keep=keep)
+    assert qi[0][0] not in qi2[0]
+
+
+def test_ivf_cosine_probe_scale_invariant():
+    """Cosine is scale-invariant, so the probe must be too: the SAME
+    query directions at 1e-3 and 1e3 magnitude must return the same
+    rows (the euclidean list ranking depends on ||q|| and silently
+    collapsed recall on rescaled queries)."""
+    from dgraph_tpu.ops import ivf
+
+    corpus = _clustered(20_000, 16, centers=64, seed=20)
+    ix = ivf.build(corpus, seed=0)
+    rng = np.random.default_rng(21)
+    q = corpus[rng.integers(0, len(corpus), 8)] + np.float32(0.05) \
+        * rng.standard_normal((8, 16), dtype=np.float32)
+    base, _ = ivf.search(ix, corpus, q, 10, "cosine")
+    for scale in (1e-3, 1e3):
+        got, _ = ivf.search(ix, corpus, q * np.float32(scale), 10,
+                            "cosine")
+        assert np.array_equal(base, got), scale
+    hi, _ = knn.topk_host(corpus, q, 10, "cosine")
+    hits = sum(len(set(hi[b].tolist()) & set(base[b].tolist()))
+               for b in range(8))
+    assert hits / 80.0 >= 0.95
+
+
+def test_ivf_pallas_scoring_parity():
+    """The int8 dequant-and-dot MXU tile kernel (interpret mode)
+    returns the same candidates as the host convert-once engine."""
+    from dgraph_tpu.ops import ivf
+    from dgraph_tpu.ops.pallas_kernels import (
+        score_int8_pallas, score_int8_xla,
+    )
+
+    corpus = _clustered(4_096, 64, centers=32, seed=33)
+    ix = ivf.build(corpus, seed=0, calibrate=False)
+    q = corpus[:3] + 0.01
+    a = ivf.search(ix, corpus, q, 6, "euclidean", nprobe=8)
+    b = ivf.search(ix, corpus, q, 6, "euclidean", nprobe=8,
+                   use_pallas=True, pallas_interpret=True)
+    assert np.array_equal(a[0], b[0])
+    np.testing.assert_allclose(a[1], b[1], rtol=1e-6)
+    # kernel vs jitted XLA contraction, bit-for-bit semantics
+    import jax.numpy as jnp
+    codes = np.asarray(ix.codes[:512], np.int8)
+    dots_p = np.asarray(score_int8_pallas(
+        jnp.asarray(codes), jnp.asarray(q), interpret=True))
+    dots_x = np.asarray(score_int8_xla(jnp.asarray(codes),
+                                       jnp.asarray(q)))
+    np.testing.assert_allclose(dots_p, dots_x, rtol=1e-6)
+
+
+def test_ivf_build_deterministic():
+    """Two builds over the same block byte-match — the property the
+    snapshot/ingest determinism contract leans on."""
+    from dgraph_tpu.ops import ivf
+
+    corpus = _clustered(10_000, 16, centers=64, seed=34)
+    a = ivf.build(corpus, seed=0)
+    b = ivf.build(corpus, seed=0)
+    for f in ("centroids", "order", "starts", "codes", "scales",
+              "norms2"):
+        assert np.array_equal(getattr(a, f), getattr(b, f)), f
+    assert (a.nprobe, a.sample_recall) == (b.nprobe, b.sample_recall)
+
+
+def test_ivf_sharded_mesh_merge_parity():
+    """Acceptance: per-shard quantized candidates + k-way merge over
+    the mesh shard count returns exactly the single-device quantized
+    result (the shard ranges partition the clustered slots)."""
+    from dgraph_tpu.ops import ivf
+    from dgraph_tpu.parallel import make_mesh, sharded_ivf_topk
+
+    mesh = make_mesh()
+    corpus = _clustered(6_000, 16, centers=64, seed=35)
+    # duplicate vectors tie at the re-rank cut: the deterministic
+    # (-approx, slot) truncation must keep the SAME tied subset on
+    # both paths (real embedding corpora are full of duplicates)
+    corpus[100:120] = corpus[99]
+    ix = ivf.build(corpus, seed=0)
+    q = corpus[:4] + 0.01
+    si, ss = sharded_ivf_topk(mesh, ix, corpus, q, 6, "cosine")
+    di, ds = ivf.search(ix, corpus, q, 6, "cosine")
+    assert np.array_equal(si, di)
+    np.testing.assert_allclose(ss, ds, rtol=1e-12)
+    # keep-mask flows through the sharded path too
+    keep = np.ones(len(corpus), bool)
+    keep[di[0][0]] = False
+    si2, _ = sharded_ivf_topk(mesh, ix, corpus, q, 6, "cosine",
+                              keep=keep)
+    di2, _ = ivf.search(ix, corpus, q, 6, "cosine", keep=keep)
+    assert np.array_equal(si2, di2)
+
+
+def test_ivf_snapshot_roundtrip_byte_deterministic():
+    """Codebooks persist through the snapshot plane: save -> load ->
+    save produces byte-identical FILES, and the restored engine
+    serves the quantized tier without retraining."""
+    import os
+    import tempfile
+
+    from dgraph_tpu.storage.snapshot import load_snapshot, save_snapshot
+    from dgraph_tpu.storage.vecstore import (
+        ivf_from_payload, ivf_to_payload,
+    )
+
+    db = _quant_db(n=500)
+    tab = db.tablets["embedding"]
+    ix = tab.vector_ivf()
+    assert ix is not None
+    # payload round-trip is lossless
+    ix2 = ivf_from_payload(ivf_to_payload(ix))
+    for f in ("centroids", "order", "starts", "codes", "scales",
+              "norms2"):
+        assert np.array_equal(getattr(ix, f), getattr(ix2, f)), f
+    with tempfile.TemporaryDirectory() as td:
+        p1, p2 = os.path.join(td, "a.snap"), os.path.join(td, "b.snap")
+        save_snapshot(db, p1)
+        db2 = load_snapshot(p1)
+        rx = db2.tablets["embedding"].vector_ivf()
+        assert rx is not None and np.array_equal(rx.codes, ix.codes)
+        save_snapshot(db2, p2)
+        with open(p1, "rb") as f1, open(p2, "rb") as f2:
+            assert f1.read() == f2.read()
+        # the restored engine serves quantized with identical rows
+        q = ('{ q(func: similar_to(embedding, 4, "[1.0, 0.5, -0.5, '
+             '0.25]")) { uid } }')
+        assert db2.query(q)["data"]["q"] == db.query(q)["data"]["q"]
+        from dgraph_tpu.utils.metrics import snapshot as msnap
+        assert msnap()["counters"].get(
+            "query_similar_quantized_total", 0) >= 1
+
+
+def test_ivf_build_failpoint():
+    """The index-build seam is a registered failpoint site: an armed
+    error kills the build, the exact tiers keep serving."""
+    from dgraph_tpu.utils import failpoint
+
+    assert "vecstore.build" in failpoint.SITES
+    failpoint.arm("vecstore.build", "error(boom)")
+    try:
+        db = _quant_db(n=300)
+        tab = db.tablets["embedding"]
+        assert tab.vector_ivf() is None  # build died at the seam
+        q = ('{ q(func: similar_to(embedding, 2, "[1.0, 0.0, 0.0, '
+             '0.0]")) { uid } }')
+        assert db.query(q)["data"]["q"]  # exact path serves
+    finally:
+        failpoint.clear()
+
+
+# ---------------------------------------------------------------------------
 # vector store MVCC
 # ---------------------------------------------------------------------------
 
@@ -338,6 +564,82 @@ def test_similar_to_errors():
     }""")
     assert res["data"]["a"] == [{"uid": "0x1"}]
     assert res["data"]["b"] == [{"uid": "0x8"}]
+
+
+def test_similar_to_quantized_e2e_planner():
+    """similar_to end-to-end with a trained index: the adaptive
+    planner's cold ladder picks the quantized tier (EXPLAIN shows
+    it), rows match the exact-path oracle, and vec_quantized=False
+    removes the tier."""
+    q = ('{ q(func: similar_to(embedding, 5, "[0.5, -0.25, 1.0, '
+         '0.0]")) { uid score: val(similar_to_score) } }')
+    db = _quant_db()
+    res = db.query(q, explain="analyze")
+    vd = res["extensions"]["explain"]["tiers"]["vector"]
+    assert len(vd) == 1 and vd[0]["tier"] == "quantized"
+    assert vd[0]["nprobe"] >= 1 and vd[0]["rerank"] >= 20
+    decs = [d for d in res["extensions"]["explain"]["tierDecisions"]
+            if d["stage"] == "similar_to"]
+    assert decs and decs[0]["tier"] == "quantized"
+    oracle = _quant_db(vec_quantized=False)
+    res2 = oracle.query(q, explain="analyze")
+    assert res2["extensions"]["explain"]["tiers"]["vector"][0]["tier"] \
+        == "exact"
+    assert res["data"]["q"] == res2["data"]["q"]
+
+
+def test_similar_to_quantized_overlay_mvcc_parity():
+    """MVCC overlay parity with the tier enabled: a mutated vector is
+    visible at the new read_ts and invisible at the old one, and both
+    snapshots return exactly what the exact-path oracle returns —
+    overlay rows ride the exact path and merge after re-rank."""
+    dbs = [_quant_db(), _quant_db(vec_quantized=False)]
+    assert dbs[0].tablets["embedding"].vector_ivf() is not None
+    outs = []
+    for db in dbs:
+        old_ts = db.coordinator.max_assigned()
+        db.mutate(set_nquads='<0x3> <embedding> "[9.0, 9.0, 9.0, 9.0]"'
+                             '^^<xs:float32vector> .', commit_now=True)
+        new_ts = db.coordinator.max_assigned()
+        q = ('{ q(func: similar_to(embedding, 3, "[9.0, 9.0, 9.0, '
+             '9.0]")) { uid score: val(similar_to_score) } }')
+        outs.append((db.query(q, read_ts=old_ts)["data"]["q"],
+                     db.query(q, read_ts=new_ts)["data"]["q"]))
+    # quantized == exact oracle at BOTH snapshots, byte-for-byte
+    assert outs[0] == outs[1]
+    # and the overlay row is the top hit only at the new ts
+    assert outs[0][1][0]["uid"] == "0x3"
+    assert outs[0][0][0]["uid"] != "0x3" \
+        or outs[0][0][0]["score"] != outs[0][1][0]["score"]
+
+
+def test_similar_to_quantized_filter_context_stays_exact():
+    """A filter-context similar_to (candidate subset) never routes
+    through the probe — the recall budget doesn't survive arbitrary
+    candidate masks."""
+    db = _quant_db()
+    db.alter("name: string @index(exact) .")
+    db.mutate(set_nquads='<0x5> <name> "five" .', commit_now=True)
+    res = db.query(
+        '{ q(func: eq(name, "five")) @filter(similar_to(embedding, 2,'
+        ' "[1.0, 0.0, 0.0, 0.0]")) { uid } }', explain="analyze")
+    vd = res["extensions"]["explain"]["tiers"]["vector"]
+    assert vd and vd[0]["tier"] == "exact"
+
+
+def test_similar_to_quantized_sharded_tier():
+    """Mesh + trained index routes through the sharded quantized
+    path with rows equal to the unsharded engine's."""
+    from dgraph_tpu.parallel import make_mesh
+
+    q = ('{ q(func: similar_to(embedding, 4, "[0.5, -0.25, 1.0, '
+         '0.0]")) { uid } }')
+    want = _quant_db().query(q)["data"]["q"]
+    db = _quant_db(mesh=make_mesh(), shard_min_edges=8)
+    res = db.query(q, explain="analyze")
+    vd = res["extensions"]["explain"]["tiers"]["vector"]
+    assert vd and vd[0]["tier"] == "sharded_quantized"
+    assert res["data"]["q"] == want
 
 
 def test_similar_to_host_vs_device_tier_parity():

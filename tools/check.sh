@@ -57,6 +57,16 @@ echo "== planner overhead + smoke =="
 # — non-zero exit on either (DGRAPH_TPU_PLANNER_BUDGET overrides)
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --planner-overhead
 
+echo "== ann smoke =="
+# ~5 s quantized vector tier gate (tools/ann_smoke.py): train + query
+# on a small seeded corpus — index trains at rollup, similar_to routes
+# quantized, recall floor vs the exact oracle, MVCC overlay parity at
+# old/new read_ts, codebook snapshot round-trip byte-deterministic.
+# The vector_* metrics and the vecstore.build failpoint site are
+# DG08-registered (utils/metrics.py REGISTERED, utils/failpoint.py
+# SITES), so the dglint step above already gates their names.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.ann_smoke
+
 echo "== pprof overhead =="
 # the on-demand sampling profiler at its default 100 Hz must cost
 # < 2% of throughput while active (decomposed per-sample x rate gate;
