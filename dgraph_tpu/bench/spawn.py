@@ -51,7 +51,35 @@ class ProcessCluster:
                  data_dir: Optional[str] = None,
                  tick_ms: int = 30, election_ticks: int = 8,
                  env_extra: Optional[dict] = None,
-                 snapshots: Optional[dict] = None):
+                 snapshots: Optional[dict] = None,
+                 zero_args: Optional[list] = None,
+                 cpus_per_group: int = 0):
+        # zero_args: extra CLI flags for every zero node — how the
+        # rebalance smoke / benches arm the heat-driven rebalancer
+        # (--rebalance-interval, --split-heat, --move-throttle-mb-s)
+        #
+        # cpus_per_group > 0 pins each alpha GROUP's processes to its
+        # own disjoint CPU set (Linux sched_setaffinity). On one box
+        # every "group" otherwise shares the same cores, so tablet
+        # placement cannot change capacity and a placement bench
+        # measures only federation overhead; disjoint sets emulate
+        # the real deployment where each group owns its machines.
+        self.cpus_per_group = int(cpus_per_group)
+        if self.cpus_per_group > 0:
+            try:
+                avail = len(os.sched_getaffinity(0))
+            except AttributeError:
+                avail = 0
+            if avail < groups * self.cpus_per_group:
+                # a short final slice would hand higher-numbered
+                # groups less silicon BY CONSTRUCTION and the bench
+                # would attribute that to tablet placement — refuse
+                # to pin asymmetrically, loudly
+                print(f"[spawn] cpus_per_group={self.cpus_per_group} x "
+                      f"{groups} groups exceeds {avail} available "
+                      "CPUs; affinity pinning DISABLED",
+                      file=sys.stderr)
+                self.cpus_per_group = 0
         # snapshots: {group -> p.snap path} boots each group's alphas
         # from a bulk/distributed-ingest output (`node --snapshot`);
         # every replica of a group must boot the same file
@@ -101,7 +129,8 @@ class ProcessCluster:
                 "--kind", "zero", "--id", str(i),
                 "--raft-peers", zpeers,
                 "--client-addr", f"127.0.0.1:{cport}",
-                "--debug-port", str(dport)])
+                "--debug-port", str(dport)]
+                + [str(a) for a in (zero_args or ())])
         zero_spec = ",".join(f"{i}={h}:{p}"
                              for i, (h, p) in self.zero_addrs.items())
 
@@ -152,10 +181,20 @@ class ProcessCluster:
             log = subprocess.DEVNULL
         dport = args[args.index("--debug-port") + 1]
         self.debug_urls[name] = f"http://127.0.0.1:{dport}"
+        preexec = None
+        if self.cpus_per_group > 0 and name.startswith("alpha-g") \
+                and hasattr(os, "sched_setaffinity"):
+            g = int(name.split("-")[1][1:])
+            avail = sorted(os.sched_getaffinity(0))
+            lo = (g - 1) * self.cpus_per_group
+            cpuset = set(avail[lo:lo + self.cpus_per_group])
+            if cpuset:
+                def preexec(cs=cpuset):  # noqa: E731
+                    os.sched_setaffinity(0, cs)
         self.procs[name] = subprocess.Popen(
             [sys.executable, "-m", "dgraph_tpu", "node"]
             + self._node_args[name] + self._tick,
-            env=self._env, cwd=_REPO,
+            env=self._env, cwd=_REPO, preexec_fn=preexec,
             stdout=subprocess.DEVNULL, stderr=log)
 
     # ------------------------------------------------------------ clients

@@ -43,6 +43,14 @@ _IDX_BITS = 16
 _IDX_MASK = (1 << _IDX_BITS) - 1
 
 DEFAULT_CAP = 8192        # entries retained per predicate
+# raw-EdgeOp retention for the tablet-move catch-up path: shorter
+# than the JSON cap on purpose — raw ops pin original Posting values
+# (e.g. float-vector embeddings the JSON entries flatten), so an
+# always-on full-cap raw ring would roughly double CDC memory for
+# every workload to serve the rare move. A mover that falls further
+# behind than this restarts from a fresh snapshot (OffsetTruncated),
+# the same contract as full log eviction.
+DEFAULT_RAW_CAP = 2048
 MAX_LIMIT = 4096          # hard per-poll batch ceiling
 DEFAULT_LIMIT = 256
 MAX_WAIT_S = 60.0         # long-poll ceiling (heartbeat cadence bound)
@@ -96,28 +104,48 @@ def _jsonable(v: Any) -> Any:
 
 class _Log:
     """One predicate's bounded change list. Guarded by CdcPlane's
-    lock — no locking of its own."""
+    lock — no locking of its own.
 
-    __slots__ = ("entries", "floor", "head")
+    `raw` holds (offset, ORIGINAL EdgeOp) pairs — not the
+    JSON-flattened form: the tablet-move catch-up path replays these
+    on the destination, and the JSON flattening (datetime ->
+    isoformat, vectors -> float lists) is lossy — a moved tablet
+    rebuilt from it would not be byte-identical. It is its own
+    shorter ring (raw_floor) so its memory cost stays bounded
+    independently of the JSON cap."""
+
+    __slots__ = ("entries", "raw", "floor", "raw_floor", "head")
 
     def __init__(self):
         self.entries: list[dict] = []
+        self.raw: list[tuple[int, Any]] = []   # (offset, EdgeOp)
         self.floor = 0   # offsets <= floor are unavailable history
+        self.raw_floor = 0  # offsets <= this have no raw op anymore
         self.head = 0    # highest appended offset
 
-    def evict_to_cap(self, cap: int):
+    def evict_to_cap(self, cap: int, raw_cap: Optional[int] = None):
+        if raw_cap is None:
+            raw_cap = cap
         if len(self.entries) > cap:
             drop = len(self.entries) - cap
             self.floor = max(self.floor, self.entries[drop - 1]["offset"])
             del self.entries[:drop]
+        if len(self.raw) > raw_cap:
+            drop = len(self.raw) - raw_cap
+            self.raw_floor = max(self.raw_floor,
+                                 self.raw[drop - 1][0])
+            del self.raw[:drop]
+        self.raw_floor = max(self.raw_floor, self.floor)
 
 
 class CdcPlane:
     """Every engine owns one (engine/db.py GraphDB.cdc): the apply
     path appends, the /subscribe surfaces read."""
 
-    def __init__(self, cap: int = DEFAULT_CAP):
+    def __init__(self, cap: int = DEFAULT_CAP,
+                 raw_cap: int = DEFAULT_RAW_CAP):
         self.cap = cap
+        self.raw_cap = min(raw_cap, cap)
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._logs: dict[str, _Log] = {}
@@ -156,9 +184,10 @@ class CdcPlane:
                         if op.posting.lang:
                             ent["lang"] = op.posting.lang
                     log.entries.append(ent)
+                    log.raw.append((ent["offset"], op))
                     log.head = ent["offset"]
                     n += 1
-                log.evict_to_cap(self.cap)
+                log.evict_to_cap(self.cap, self.raw_cap)
             if n:
                 self._wake.notify_all()
         if n:
@@ -178,6 +207,12 @@ class CdcPlane:
                 log = self._logs[pred] = _Log()
             if not log.entries and log.head < off:
                 log.floor = max(log.floor, off)
+                # the raw move-catchup ring is bounded separately but
+                # obeys the same truncation contract: without this a
+                # snapshot-booted source would answer read_raw below
+                # the base with an empty "caught up" instead of
+                # OffsetTruncated — the mover must re-snapshot
+                log.raw_floor = max(log.raw_floor, off)
                 log.head = max(log.head, off)
 
     def drop(self, pred: str) -> None:
@@ -230,6 +265,52 @@ class CdcPlane:
         return {"pred": pred, "changes": out, "nextOffset": next_off,
                 "floor": floor, "head": head,
                 "heartbeat": not out}
+
+    def head(self, pred: str) -> int:
+        """The predicate's highest appended offset (0 = no log). The
+        fence drain compares the destination's applied watermark
+        against THIS, read under the source's write lock, to prove
+        nothing committed-but-unstreamed remains."""
+        with self._lock:
+            log = self._logs.get(pred)
+            return log.head if log is not None else 0
+
+    def read_raw(self, pred: str, after: int,
+                 limit: int = DEFAULT_LIMIT) -> dict:
+        """Raw EdgeOp tail for the tablet-move catch-up path: entries
+        with offset > `after`, grouped [(commit_ts, [EdgeOp, ...]),
+        ...] and extended past `limit` to the end of the last included
+        commit — a resume point is always a commit boundary, so the
+        destination's tab.max_commit_ts IS the durable progress marker
+        (offset_for_ts(max_commit_ts) resumes exactly). `behind` =
+        entries still unserved after this batch (the catch-up lag
+        gauge). Raises OffsetTruncated when `after` predates the
+        floor — the mover must re-snapshot from a newer base."""
+        from bisect import bisect_right
+        limit = max(1, min(int(limit), MAX_LIMIT))
+        with self._lock:
+            log = self._logs.get(pred)
+            if log is None:
+                return {"batches": [], "head": 0, "floor": 0,
+                        "behind": 0}
+            if after < log.raw_floor:
+                metrics.inc_counter("dgraph_cdc_truncated_total")
+                raise OffsetTruncated(pred, after, log.raw_floor)
+            offs = [o for o, _ in log.raw]
+            i = bisect_right(offs, after)
+            j = min(i + limit, len(offs))
+            while j < len(offs) and \
+                    (offs[j] >> _IDX_BITS) == (offs[j - 1] >> _IDX_BITS):
+                j += 1  # never split one commit across batches
+            batches: list[tuple[int, list]] = []
+            for k in range(i, j):
+                ts = offs[k] >> _IDX_BITS
+                if batches and batches[-1][0] == ts:
+                    batches[-1][1].append(log.raw[k][1])
+                else:
+                    batches.append((ts, [log.raw[k][1]]))
+            return {"batches": batches, "head": log.head,
+                    "floor": log.raw_floor, "behind": len(offs) - j}
 
     @staticmethod
     def _after(log: _Log, after: int, limit: int) -> list[dict]:

@@ -204,7 +204,16 @@ def cmd_node(args) -> int:
                           max_pending=args.max_pending,
                           snapshot=getattr(args, "snapshot", ""), **kw)
     else:
-        srv = ZeroServer(args.id, peers, (chost, int(cport)), **kw)
+        srv = ZeroServer(
+            args.id, peers, (chost, int(cport)),
+            move_throttle_mb_s=args.move_throttle_mb_s,
+            move_fence_lag=args.move_fence_lag,
+            move_fence_timeout_s=args.move_fence_timeout_s,
+            rebalance_interval_s=args.rebalance_interval,
+            rebalance_band=args.rebalance_band,
+            split_heat=args.split_heat,
+            rebalance_pin=args.rebalance_pin,
+            rebalance_cooldown_s=args.rebalance_cooldown_s, **kw)
     print(f"dgraph-tpu {args.kind} node {args.id}: raft "
           f"{peers[args.id]}, client {srv.client_addr}"
           + (f", debug http {args.debug_host}:{args.debug_port}"
@@ -933,6 +942,43 @@ def main(argv=None) -> int:
                         "query/mutate/task ops; excess sheds typed "
                         "(retryable) like the HTTP edge's 429. "
                         "0 = unbounded")
+    n.add_argument("--move-throttle-mb-s", type=float, default=64.0,
+                   help="zero only: tablet-move snapshot streaming "
+                        "budget in MB/s (the source keeps serving; "
+                        "the throttle bounds the move's bandwidth "
+                        "tax). 0 = unthrottled")
+    n.add_argument("--move-fence-lag", type=int, default=16,
+                   help="zero only: fence the moving tablet's writes "
+                        "once CDC catch-up is within this many "
+                        "change-log entries of the source head")
+    n.add_argument("--move-fence-timeout-s", type=float, default=5.0,
+                   help="zero only: unfence (writes resume, catch-up "
+                        "continues) if the fence drain hasn't "
+                        "converged by then")
+    n.add_argument("--rebalance-interval", type=float, default=0.0,
+                   help="zero only: heat-driven rebalancer tick "
+                        "seconds (ref zero --rebalance_interval 8m); "
+                        "0 = disabled")
+    n.add_argument("--rebalance-band", type=float, default=1.4,
+                   help="zero only: hysteresis — rebalance only when "
+                        "the heaviest group's load exceeds BAND x the "
+                        "lightest's")
+    n.add_argument("--rebalance-pin", default="",
+                   help="zero only: comma list of predicates the "
+                        "rebalancer must never auto-move — the "
+                        "colocation knob for constraints it cannot "
+                        "see (e.g. a vector predicate plus the "
+                        "attributes its similar_to queries select: "
+                        "cross-group vector search is unsupported)")
+    n.add_argument("--rebalance-cooldown-s", type=float, default=120.0,
+                   help="zero only: a just-moved tablet is frozen "
+                        "this long so the heat EWMA re-equilibrates "
+                        "instead of thrashing it back")
+    n.add_argument("--split-heat", type=float, default=0.0,
+                   help="zero only: heat EWMA past which a group-"
+                        "dominating predicate splits into hash-range "
+                        "sub-tablets instead of moving whole; "
+                        "0 = splitting disabled")
     n.set_defaults(fn=cmd_node)
 
     ct = sub.add_parser("cert", help="TLS certificate management")

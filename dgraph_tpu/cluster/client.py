@@ -533,6 +533,15 @@ class ClusterClient:
             cls = _ABORT_TYPES.get(resp.get("aborted", ""))
             if cls is not None:
                 raise cls(resp.get("error", resp["aborted"]))
+            if resp.get("misrouted"):
+                # the tablet moved after this client's map was
+                # fetched: typed + retryable — RoutedCluster refreshes
+                # the map and re-routes instead of surfacing a 500
+                from dgraph_tpu.cluster.errors import TabletMisrouted
+                m = resp["misrouted"]
+                raise TabletMisrouted(m.get("pred", "?"),
+                                      m.get("group"),
+                                      resp.get("error", ""))
             if resp.get("deadline_expired"):
                 # the caller's budget died in the routing loop (e.g.
                 # an election outlasted it) — same typed outcome as a

@@ -1,0 +1,29 @@
+"""Typed cluster routing errors shared by server and client sides.
+
+Lives in its own module so cluster/service.py (raises) and
+cluster/client.py (re-raises from the wire) can both import it without
+a cycle.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class TabletMisrouted(RuntimeError):
+    """The serving group no longer serves this tablet (it moved, or
+    split, after the caller fetched its routing map). RETRYABLE by
+    contract: the router refreshes the tablet map and re-routes
+    (bounded retries) — a user must never see this as a 500.
+
+    Crosses the wire as {"ok": False, "misrouted": {"pred", "group"}}
+    (cluster/service.py _client_loop -> cluster/client.py _unwrap)."""
+
+    def __init__(self, pred: str, group: Optional[int] = None,
+                 msg: str = ""):
+        self.pred = pred
+        self.group = group  # new owner if known, else None
+        super().__init__(
+            msg or f"tablet {pred!r} is not served here"
+            + (f" (moved to group {group})" if group else "")
+            + "; refresh the tablet map and re-route")

@@ -28,8 +28,9 @@ from dgraph_tpu import wire
 from dgraph_tpu.cluster.raft import (
     FOLLOWER, GOODBYE, LEADER, Msg, RaftNode, VOTE_REQ,
 )
+from dgraph_tpu.cluster.errors import TabletMisrouted
 from dgraph_tpu.cluster.transport import TcpTransport
-from dgraph_tpu.utils import failpoint, netfault, tracing
+from dgraph_tpu.utils import failpoint, metrics, netfault, tracing
 from dgraph_tpu.utils.logger import log
 from dgraph_tpu.utils.reqctx import (
     PROPAGATION_SKEW_S, DeadlineExceeded, Overloaded, RequestAborted,
@@ -482,6 +483,13 @@ class RaftServer:
                 except NotLeader as e:
                     resp = {"ok": False, "error": "not leader",
                             "leader": e.leader}
+                except TabletMisrouted as e:
+                    # typed on the wire: the router refreshes its
+                    # tablet map and re-routes (bounded retries) —
+                    # a post-flip stale route is never a bare 500
+                    resp = {"ok": False, "error": str(e),
+                            "misrouted": {"pred": e.pred,
+                                          "group": e.group}}
                 except RequestAborted as e:
                     # cancellation/deadline crosses the wire TYPED:
                     # ClusterClient._unwrap maps `aborted` back to the
@@ -667,6 +675,17 @@ class AlphaServer(RaftServer):
         # any read_ts obtained before it stays clean — pinned reads and
         # federated tasks skip the zero RPC below that watermark.
         self._xstatus_clean: dict[int, int] = {}
+        # live tablet-move plumbing (leader-local, deliberately NOT
+        # replicated — both sides are rebuilt idempotently when a
+        # leader dies): source-side cached export blobs served in
+        # re-deliverable chunks, destination-side chunk staging
+        # buffers assembled by move_install. pred -> dict.
+        self._move_exports: dict[str, dict] = {}
+        self._move_staging: dict[str, dict] = {}
+        # last touches count reported to zero per tablet (the heat
+        # report ships DELTAS); baseline-initialized on first sight so
+        # a fresh leader's lifetime counter doesn't land as one spike
+        self._heat_sent: dict[str, int] = {}
         # multi-group mode: a Zero quorum owns the tablet map and the
         # uid space; this alpha claims tablets, checks ownership before
         # every write, and leases uid blocks (ref worker/groups.go
@@ -796,10 +815,23 @@ class AlphaServer(RaftServer):
             log.warning("boot_claim_retry", error=str(e))
             return False
 
-    def _report_sizes_loop(self, interval_s: float = 30.0):
-        """Leader-only periodic tablet-size reports to zero — the
-        rebalancer's byte weights (ref zero/tablet.go:180 sizes from
-        membership updates)."""
+    def _report_sizes_loop(self, interval_s: float = 0.0):
+        """Leader-only periodic tablet size + HEAT reports to zero —
+        the rebalancer's weights (ref zero/tablet.go:180 sizes from
+        membership updates). Heat = query-path touch DELTA since this
+        node's last report (storage/tabstats.py `touches`); zero folds
+        the deltas into a per-tablet EWMA. The first sighting of a
+        tablet reports delta 0 (baseline), so a fresh leader's
+        lifetime counter never lands as one giant spike."""
+        if interval_s <= 0:
+            # default 30s like the reference's membership updates;
+            # DGRAPH_TPU_HEAT_INTERVAL_S speeds smokes/benches up
+            import os as _os
+            try:
+                interval_s = float(_os.environ.get(
+                    "DGRAPH_TPU_HEAT_INTERVAL_S", "") or 30.0)
+            except ValueError:
+                interval_s = 30.0
         while not self._stop.wait(interval_s):
             with self.lock:
                 if self.node.role != LEADER:
@@ -811,18 +843,41 @@ class AlphaServer(RaftServer):
                 tabs = [(pred, tab)
                         for pred, tab in self.db.tablets.items()
                         if not pred.startswith("dgraph.")]
-            sizes = {}
+            live = {pred for pred, _ in tabs}
+            for pred in list(self._heat_sent):
+                if pred not in live:
+                    # dropped/moved-away tablet: clear the baseline —
+                    # a tablet moving BACK restarts touches at 0, and
+                    # a stale high baseline would report delta 0
+                    # through an entire query storm
+                    del self._heat_sent[pred]
+            batch = {}
+            seen = {}
             for pred, tab in tabs:
                 try:
-                    sizes[pred] = tab.approx_bytes()
+                    nbytes = tab.approx_bytes()
                 except RuntimeError:
                     continue  # mutated mid-scan; next cycle gets it
-            if not sizes:
+                t = int(getattr(tab, "touches", 0))
+                last = self._heat_sent.get(pred)
+                if last is None or t < last:
+                    delta = 0  # first sight / counter restarted
+                else:
+                    delta = t - last
+                batch[pred] = (nbytes, delta)
+                seen[pred] = t
+            if not batch:
                 continue
             try:
                 # ONE batched request, not one RPC per tablet
-                self.zero.request({"op": "tablet_sizes",
-                                   "args": (sizes,)})
+                got = self.zero.request({"op": "tablet_heat",
+                                         "args": (batch,)})
+                if got.get("ok"):
+                    # advance baselines only on a DELIVERED report: a
+                    # report lost to a zero election must not eat its
+                    # window's touch deltas (the EWMA would cool the
+                    # hottest tablet exactly when it matters)
+                    self._heat_sent.update(seen)
             except Exception:  # noqa: BLE001 — best-effort report  # dglint: disable=DG07 (daemon loop; no request context flows here)
                 pass
 
@@ -1107,12 +1162,22 @@ class AlphaServer(RaftServer):
 
     # --------------------------------------------------------------- writes
 
-    def _check_ownership(self, preds):
+    def _check_ownership(self, preds, subjects=None):
         """Multi-group mode: every touched predicate must be served by
         THIS group per Zero's map; unclaimed predicates are claimed,
-        mid-move tablets reject writes (ref zero.go ShouldServe +
-        oracle's tablet checks at commit). Caller holds _write_lock, so
-        a concurrent export (which also takes it) serializes against
+        FENCED tablets (the move's short `fenced` phase — reads never
+        fence) reject writes retryably (ref zero.go ShouldServe +
+        oracle's tablet checks at commit). A predicate owned elsewhere
+        raises the TYPED TabletMisrouted so a router holding a
+        pre-flip map refreshes and re-routes instead of surfacing 500.
+
+        `subjects` — (pred, subject_uid) pairs of the write — lets a
+        hash-range SPLIT predicate verify per-row ownership: each
+        subject must hash into a shard this group serves
+        (cluster/shard.py). Without subjects a split predicate rejects
+        the write outright: only the router's per-shard 2PC path
+        carries resolved uids. Caller holds _write_lock, so a
+        concurrent export (which also takes it) serializes against
         in-flight writes."""
         if self.zero is None:
             return
@@ -1122,21 +1187,46 @@ class AlphaServer(RaftServer):
                                "tablet ownership")
         tablets = tmap["result"]["tablets"]
         moving = tmap["result"]["moving"]
+        splits = tmap["result"].get("splits", {})
+        subs_by_pred: dict[str, list[int]] = {}
+        for p, u in subjects or ():
+            subs_by_pred.setdefault(p, []).append(int(u))
         for p in preds:
             if p == "*" or p.startswith("dgraph."):
                 continue
             if p in moving:
                 raise RuntimeError(
                     f"tablet {p!r} is being moved; retry shortly")
+            if p in splits:
+                from dgraph_tpu.cluster.shard import owner_for_uid
+                subs = subs_by_pred.get(p)
+                if subs is None:
+                    raise TabletMisrouted(
+                        p, None,
+                        f"tablet {p!r} is split across groups; route "
+                        "writes per subject through the cluster router")
+                for u in subs:
+                    owner = owner_for_uid(splits[p], u)
+                    if owner != self.group:
+                        raise TabletMisrouted(
+                            p, owner,
+                            f"subject {u:#x} of split tablet {p!r} "
+                            f"belongs to group {owner}; refresh the "
+                            "tablet map and re-route")
+                continue
             owner = tablets.get(p)
             if owner is None:
                 got = self.zero.tablet(p, self.group)
                 if got != self.group:
-                    raise RuntimeError(
-                        f"tablet {p!r} belongs to group {got}")
+                    raise TabletMisrouted(
+                        p, got if got > 0 else None,
+                        f"tablet {p!r} belongs to group {got}; "
+                        "refresh the tablet map and re-route")
             elif owner != self.group:
-                raise RuntimeError(
-                    f"tablet {p!r} belongs to group {owner}")
+                raise TabletMisrouted(
+                    p, owner,
+                    f"tablet {p!r} belongs to group {owner}; "
+                    "refresh the tablet map and re-route")
 
     def _capture_and_replicate(self, fn) -> Any:
         """Run `fn(db)` on the leader with the record sink attached,
@@ -1304,12 +1394,56 @@ class AlphaServer(RaftServer):
                 metrics.set_gauge("dgraph_pending_queries",
                                   self._inflight)
 
+    def _misroute_guard_query(self, q: str, variables) -> None:
+        """A query naming a tablet this group MOVED AWAY must fail
+        TYPED (TabletMisrouted), never silently return empty rows —
+        the read-parity hazard of a client racing a cutover with a
+        stale routing map. Zero-cost until this node has actually
+        moved a tablet out (moved_out empty); a malformed query falls
+        through to the engine's own parser error.
+
+        Known limitation: predicates reached only via expand() never
+        appear in the query text or in query_predicates, so a
+        stale-routed expand can under-report a moved predicate's
+        edges for the one in-flight query racing the cutover (the
+        router's next map fetch routes correctly). Closing that would
+        need an executor-level ownership hook at expansion time."""
+        if self.zero is None or (not self.db.moved_out
+                                 and not self.db.split_partial):
+            return
+        suspects = set(self.db.moved_out) | self.db.split_partial
+        if not any(p in q for p in suspects):
+            # a referenced predicate appears literally in the query
+            # text, so the substring screen keeps the guard O(names)
+            # on the hot path instead of re-parsing every query
+            # forever once any tablet has ever moved away
+            return
+        try:
+            from dgraph_tpu.gql import parse
+            from dgraph_tpu.server.acl import query_predicates
+            preds = {p.lstrip("~")
+                     for p in query_predicates(parse(q, variables))}
+        except Exception:  # noqa: BLE001 — the engine owns the error  # dglint: disable=DG07 (parse errors surface identically from db.query below)
+            return
+        for p in preds:
+            if p in self.db.moved_out and p not in self.db.tablets:
+                raise TabletMisrouted(p, self.db.moved_out[p])
+            if p in self.db.split_partial:
+                # this member holds only a hash range: a whole-
+                # predicate read here would be silently partial —
+                # the router re-fetches the map and federates
+                raise TabletMisrouted(
+                    p, None,
+                    f"tablet {p!r} is split across groups; refresh "
+                    "the tablet map and fan out per sub-tablet")
+
     def _handle_admitted(self, req: dict) -> dict:
         conf = self.handle_conf_request(req)
         if conf is not None:
             return conf
         op = req.get("op")
         if op == "query":
+            self._misroute_guard_query(req["q"], req.get("vars"))
             # any replica serves best-effort snapshot reads
             # (edgraph/server.go:760); under the lock because the
             # apply/restore threads mutate and rebind self.db.
@@ -1482,7 +1616,8 @@ class AlphaServer(RaftServer):
                 # txn stays open (and its oracle entry alive) so the
                 # advertised retry actually works
                 self._check_ownership(
-                    {pred for pred, _ in txn.staged})
+                    {pred for pred, _ in txn.staged},
+                    subjects=[(p, op.src) for p, op in txn.staged])
                 with self.lock:
                     self._txns.pop(start_ts, None)
                     self._txn_touched.pop(start_ts, None)
@@ -1529,6 +1664,23 @@ class AlphaServer(RaftServer):
             # barrier; every task reconciles decided cross-group
             # commits <= read_ts first.
             read_ts = int(req.get("read_ts", 0))
+            pred = req.get("pred")
+            if pred and pred in self.db.moved_out \
+                    and pred not in self.db.tablets:
+                # stale-routed federated task after a cutover: typed,
+                # so the coordinator re-fetches the map and re-fans
+                raise TabletMisrouted(pred, self.db.moved_out[pred])
+            if pred and req.get("whole") \
+                    and pred in self.db.split_partial:
+                # a coordinator whose map predates a split flip asks
+                # for the WHOLE predicate here, but this group holds
+                # only a hash range — answering would be silently
+                # partial. (SplitRemoteTablet's per-shard fan-out
+                # sends whole=False and is served normally.)
+                raise TabletMisrouted(
+                    pred, None,
+                    f"tablet {pred!r} is split across groups; refresh "
+                    "the tablet map and fan out per sub-tablet")
             # the coordinator's propagated budget: give up BEFORE the
             # quorum barrier (its round-trip is the expensive part)
             # and again before reading — a coordinator that already
@@ -1567,8 +1719,15 @@ class AlphaServer(RaftServer):
             failpoint.fire("txn.xstage")
             nqs = [(nquad_from_wire(t), bool(d)) for t, d in req["nqs"]]
             preds = {nq.predicate for nq, _ in nqs}
+            subjects = []
+            for nq, _ in nqs:
+                try:  # split-tablet row routing needs resolved uids;
+                    # blanks fail xstage_ops with its own error below
+                    subjects.append((nq.predicate, int(nq.subject, 0)))
+                except ValueError:
+                    pass
             with self._write_lock:
-                self._check_ownership(preds)
+                self._check_ownership(preds, subjects=subjects)
                 with self.lock:
                     if self.node.role != LEADER:
                         raise NotLeader(self.node.leader_id)
@@ -1657,8 +1816,267 @@ class AlphaServer(RaftServer):
                 ("import_tablet", req["pred"], payload))
             return {"ok": True, "result": {}}
         if op == "drop_tablet":
-            self._replicate_record(("drop_attr", req["pred"]))
+            with self.lock:
+                self._move_exports.pop(req["pred"], None)
+                self._move_staging.pop(req["pred"], None)
+            if req.get("move_dst") is not None:
+                # post-flip source cleanup: drop AND tombstone, so a
+                # stale-routed request gets a typed misroute
+                self._replicate_record(
+                    ("move_drop", req["pred"], int(req["move_dst"])))
+            else:
+                self._replicate_record(("drop_attr", req["pred"]))
             return {"ok": True, "result": {}}
+        if op == "split_prune":
+            # post-flip SPLIT source cleanup: keep only the rows
+            # outside the moved hash range (idempotent — pruning an
+            # already-pruned tablet removes nothing)
+            with self.lock:
+                self._move_exports.pop(req["pred"], None)
+            self._replicate_record(
+                ("split_prune", req["pred"], int(req["nshards"]),
+                 int(req["shard"])))
+            return {"ok": True, "result": {}}
+        if op == "move_export_end":
+            # release the cached export blob (aborted/finished move —
+            # a multi-GB zlib blob must not sit pinned until the next
+            # move of the same predicate)
+            with self.lock:
+                self._move_exports.pop(req["pred"], None)
+                self._move_staging.pop(req["pred"], None)
+            return {"ok": True, "result": {}}
+        if op == "move_export_begin":
+            # streaming move, source side (ref worker/predicate_move
+            # .go:81 movePredicateHelper — but with writes LIVE): dump
+            # once under the write lock (a consistent cut at snap_ts =
+            # max_commit_ts), cache the compressed blob leader-locally,
+            # serve it in re-deliverable chunks. Writes resume the
+            # moment the dump finishes; everything committed after
+            # snap_ts reaches the destination via move_deltas.
+            import zlib
+            pred = req["pred"]
+            chunk = max(1, int(req.get("chunk_bytes", 1 << 20)))
+            prefer = int(req.get("prefer_snap_ts", 0) or 0)
+            with self.lock:
+                exp = self._move_exports.get(pred)
+            if exp is not None and prefer \
+                    and exp["snap_ts"] == prefer:
+                # the driver resumes an interrupted stream: the
+                # destination's staged chunks match this cached
+                # export, so serve THAT instead of re-dumping (a
+                # fresh snap_ts would invalidate every staged chunk
+                # and re-pay the dump's write stall)
+                return {"ok": True, "result": {
+                    "snap_ts": exp["snap_ts"],
+                    "bytes": len(exp["blob"]),
+                    "chunks": (len(exp["blob"]) + exp["chunk"] - 1)
+                    // exp["chunk"]}}
+            with self._write_lock:
+                with self.lock:
+                    if self.node.role != LEADER:
+                        raise NotLeader(self.node.leader_id)
+                    if pred not in self.db.tablets:
+                        raise TabletMisrouted(
+                            pred, self.db.moved_out.get(pred))
+                    payload = self.db.export_tablet_move(
+                        pred, int(req.get("nshards", 1) or 1),
+                        req.get("shard"))
+                    # serialize INSIDE the write lock: for
+                    # whole-tablet moves the payload aliases the LIVE
+                    # tab.deltas/edge_facets (dump_tablet does not
+                    # copy them) — a commit racing the encode would
+                    # mutate them mid-iteration
+                    raw = wire.dumps(payload)
+            blob = zlib.compress(raw, 1)
+            with self.lock:
+                self._move_exports[pred] = {
+                    "snap_ts": payload["snap_ts"], "blob": blob,
+                    "chunk": chunk}
+            return {"ok": True, "result": {
+                "snap_ts": payload["snap_ts"], "bytes": len(blob),
+                "chunks": (len(blob) + chunk - 1) // chunk}}
+        if op == "move_chunk":
+            # one re-deliverable snapshot chunk (offset-keyed by seq);
+            # a new source leader has no cache -> the driver re-begins
+            failpoint.fire("move.snapshot_chunk")
+            pred = req["pred"]
+            with self.lock:
+                exp = self._move_exports.get(pred)
+            if exp is None or exp["snap_ts"] != int(req["snap_ts"]):
+                return {"ok": False, "restage": True, "error":
+                        f"no active export for {pred!r} at snap_ts "
+                        f"{req['snap_ts']} (source leader changed?); "
+                        "re-begin"}
+            cs = exp["chunk"]
+            seq = int(req["seq"])
+            return {"ok": True, "result":
+                    {"seq": seq,
+                     "data": exp["blob"][seq * cs:(seq + 1) * cs]}}
+        if op == "move_deltas":
+            # catch-up tail, source side: raw EdgeOp batches (whole
+            # commits, ascending) from the predicate's change log
+            # after the destination's progress offset. LEADER-only:
+            # the fence-drain decision needs the head that covers
+            # every committed write, and a follower's log may lag.
+            from dgraph_tpu.cdc.changelog import OffsetTruncated
+            with self.lock:
+                if self.node.role != LEADER:
+                    raise NotLeader(self.node.leader_id)
+                db = self.db
+            try:
+                out = db.cdc.read_raw(req["pred"],
+                                      after=int(req["after"]),
+                                      limit=int(req.get("limit", 512)))
+            except OffsetTruncated as e:
+                # the bounded log evicted past the destination's
+                # base: the driver must re-snapshot from a newer one
+                return {"ok": False, "error": str(e),
+                        "truncated": {"pred": e.pred, "floor": e.floor,
+                                      "resync_ts": e.resync_ts}}
+            if req.get("shard") is not None:
+                from dgraph_tpu.cluster.shard import filter_ops
+                n = int(req.get("nshards", 1) or 1)
+                out["batches"] = [
+                    (ts, filter_ops(ops, n, int(req["shard"])))
+                    for ts, ops in out["batches"]]
+            return {"ok": True, "result": out}
+        if op == "move_status":
+            # source-side fence-drain facts — and the drain's
+            # LINEARIZATION BARRIER: every commit on this group runs
+            # its ownership check AND its apply under ONE _write_lock
+            # hold, so by acquiring _write_lock here (after the fence
+            # committed at zero) we know any write that passed its
+            # pre-fence ownership check has fully applied (its CDC
+            # entry is covered by the `cdc_head` we return), and any
+            # write still waiting for the lock will re-check
+            # ownership, see the fence, and be rejected. Without this
+            # barrier a commit in flight across the fence could land
+            # AFTER the drain's last delta read — an acked write
+            # silently lost at the flip (review finding). Also
+            # reports: any replicated 2PC stage still pending on this
+            # predicate (its finalize would land here post-flip).
+            pred = req["pred"]
+            with self._write_lock:
+                with self.lock:
+                    if self.node.role != LEADER:
+                        raise NotLeader(self.node.leader_id)
+                    pending = any(
+                        any(p == pred for p, _ in staged)
+                        for staged, _k
+                        in self.db.pending_txns.values())
+                    tab = self.db.tablets.get(pred)
+                    mct = tab.max_commit_ts if tab is not None else 0
+                    head = self.db.cdc.head(pred)
+            return {"ok": True, "result": {"pending_stage": pending,
+                                           "max_commit_ts": mct,
+                                           "cdc_head": head}}
+        if op == "move_stage_chunk":
+            # destination side: chunks land in a leader-local staging
+            # buffer (NOT replicated — a died leader's staging is
+            # simply re-streamed, chunks are re-deliverable)
+            pred = req["pred"]
+            snap_ts = int(req["snap_ts"])
+            with self.lock:
+                if self.node.role != LEADER:
+                    raise NotLeader(self.node.leader_id)
+                st = self._move_staging.get(pred)
+                if st is None or st["snap_ts"] != snap_ts:
+                    st = self._move_staging[pred] = {
+                        "snap_ts": snap_ts,
+                        "total": int(req["total"]), "chunks": {}}
+                st["chunks"][int(req["seq"])] = req["data"]
+                have = len(st["chunks"])
+            return {"ok": True, "result": {"have": have}}
+        if op == "move_install":
+            # all chunks staged: assemble and replicate the whole
+            # tablet as ONE import_tablet record so every group
+            # replica installs identical state, then clear staging
+            import zlib
+            pred = req["pred"]
+            snap_ts = int(req["snap_ts"])
+            with self.lock:
+                st = self._move_staging.get(pred)
+                whole = st is not None and st["snap_ts"] == snap_ts \
+                    and len(st["chunks"]) >= st["total"]
+                blob = b"".join(st["chunks"][i]
+                                for i in range(st["total"])) \
+                    if whole else b""
+            if not whole:
+                return {"ok": False, "restage": True, "error":
+                        f"staging for {pred!r}@{snap_ts} incomplete "
+                        "(destination leader changed?); re-stream"}
+            payload = wire.loads(zlib.decompress(blob))
+
+            def move_in_ledger() -> bool:
+                if self.zero is None:
+                    return True
+                got = self.zero.request({"op": "tablet_map"})
+                return not got.get("ok") or pred in \
+                    got["result"].get("moves", {})
+            # an operator abort can race the driver's in-flight
+            # stream: its cleanup drop lands, then THIS install would
+            # re-create the orphan — and nothing would ever remove
+            # it. Check the ledger immediately BEFORE replicating
+            # (after the slow decompress, shrinking the TOCTOU) and
+            # again AFTER: an abort that slipped between the check
+            # and the install gets its orphan dropped right here.
+            if not move_in_ledger():
+                with self.lock:
+                    self._move_staging.pop(pred, None)
+                return {"ok": False, "error":
+                        f"move of {pred!r} is no longer in zero's "
+                        "ledger (aborted?); install refused"}
+            self._replicate_record(("import_tablet", pred, payload))
+            with self.lock:
+                self._move_staging.pop(pred, None)
+            if not move_in_ledger():
+                self._replicate_record(("drop_attr", pred))
+                return {"ok": False, "error":
+                        f"move of {pred!r} aborted during install; "
+                        "installed copy dropped"}
+            return {"ok": True, "result": {
+                "max_commit_ts": int(payload["tablet"]
+                                     .get("max_commit_ts", 0))}}
+        if op == "move_apply":
+            # catch-up batches landing on the destination, replicated
+            # as ONE move_delta record (idempotent: the replicated
+            # max_commit_ts guard skips re-delivered commits)
+            failpoint.fire("move.catchup")
+            pred = req["pred"]
+            with self.lock:
+                installed = pred in self.db.tablets
+            if not installed:
+                return {"ok": False, "restage": True, "error":
+                        f"tablet {pred!r} not installed here "
+                        "(destination leader changed?); re-stream"}
+            batches = [(int(ts), list(ops))
+                       for ts, ops in req["batches"]]
+            if batches:
+                self._replicate_record(("move_delta", pred, batches))
+            with self.lock:
+                tab = self.db.tablets.get(pred)
+                mct = tab.max_commit_ts if tab is not None else 0
+            return {"ok": True, "result": {"max_commit_ts": mct}}
+        if op == "move_dst_status":
+            # the driver's resume point after ANY crash: what the
+            # destination durably holds (installed tablet + its commit
+            # watermark + whether it is a hash-range shard copy — the
+            # provenance bit that keeps a stale shard orphan from
+            # being adopted as a whole-tablet move's base) and what is
+            # merely staged
+            with self.lock:
+                if self.node.role != LEADER:
+                    raise NotLeader(self.node.leader_id)
+                tab = self.db.tablets.get(req["pred"])
+                st = self._move_staging.get(req["pred"])
+                return {"ok": True, "result": {
+                    "installed": tab is not None,
+                    "split_partial": req["pred"]
+                    in self.db.split_partial,
+                    "max_commit_ts": tab.max_commit_ts
+                    if tab is not None else 0,
+                    "staged_snap_ts": st["snap_ts"] if st else 0,
+                    "have_chunks": len(st["chunks"]) if st else 0}}
         if op == "subscribe":
             # CDC long-poll against THIS node's change logs
             # (cdc/changelog.py). Deliberately NOT leader-gated:
@@ -1731,19 +2149,52 @@ class ZeroServer(RaftServer):
     """
 
     def __init__(self, node_id: int, raft_peers, client_addr,
-                 storage=None, **kw):
+                 storage=None, move_throttle_mb_s: float = 64.0,
+                 move_chunk_bytes: int = 1 << 20,
+                 move_fence_lag: int = 16,
+                 move_fence_timeout_s: float = 5.0,
+                 rebalance_interval_s: float = 0.0,
+                 rebalance_band: float = 1.4,
+                 split_heat: float = 0.0,
+                 rebalance_pin: str = "",
+                 rebalance_cooldown_s: float = 120.0, **kw):
         from dgraph_tpu.cluster.zero import ZeroState
         self.state = ZeroState()
         self.node_name = f"zero-n{node_id}"
+        # live-move knobs (docs/deployment.md "Tablet rebalancing"):
+        #   move_throttle_mb_s   snapshot streaming budget (bytes/s)
+        #   move_fence_lag       fence once catch-up is <= this many
+        #                        change-log entries behind
+        #   move_fence_timeout_s unfence (writes resume) if the drain
+        #                        hasn't converged by then
+        self.move_throttle_mb_s = float(move_throttle_mb_s)
+        self.move_chunk_bytes = int(move_chunk_bytes)
+        self.move_fence_lag = int(move_fence_lag)
+        self.move_fence_timeout_s = float(move_fence_timeout_s)
+        self.rebalance_interval_s = float(rebalance_interval_s)
+        self.rebalance_band = float(rebalance_band)
+        self.split_heat = float(split_heat)
+        self.rebalance_pin = frozenset(
+            p.strip() for p in str(rebalance_pin).split(",")
+            if p.strip())
+        self.rebalance_cooldown_s = float(rebalance_cooldown_s)
         super().__init__(node_id, raft_peers, client_addr,
                          storage=storage, **kw)
         # leader-only tablet-move driver: executes the ledger's moves
-        # (export -> import -> flip -> drop), each phase transition
-        # raft-persisted so a NEW leader resumes mid-flight moves
-        # (ref zero/tablet.go:62 movetablet run by zero's leader)
+        # (snapshot stream -> CDC catch-up -> bounded-lag fence ->
+        # flip -> source drop/prune), each phase transition
+        # raft-persisted so a NEW leader resumes mid-flight moves from
+        # the exact phase (ref zero/tablet.go:62 movetablet run by
+        # zero's leader). _move_progress is leader-local observability
+        # (bytes streamed, lag, fence clock) — recomputed after a
+        # leader change, never authoritative.
         self._move_attempts: dict[str, int] = {}
+        self._move_progress: dict[str, dict] = {}
         threading.Thread(target=self._move_driver_loop, daemon=True,
                          name=f"zero-moves-{node_id}").start()
+        if self.rebalance_interval_s > 0:
+            threading.Thread(target=self._rebalance_loop, daemon=True,
+                             name=f"zero-rebalance-{node_id}").start()
 
     def _group_client(self, gid: int):
         """ClusterClient to an alpha group from the membership
@@ -1779,7 +2230,9 @@ class ZeroServer(RaftServer):
                                 error=str(e)[:200])
                     n = self._move_attempts.get(pred, 0) + 1
                     self._move_attempts[pred] = n
-                    if n > 20 and mv["phase"] == "start":
+                    if n > 20 and mv["phase"] in (
+                            "start", "snapshotting", "catching_up",
+                            "fenced"):  # any PRE-FLIP phase may abort
                         try:
                             self._abort_move(pred, mv)
                         except Exception:  # noqa: BLE001 — an abort  # dglint: disable=DG07 (zero's move driver is a daemon; no request context)
@@ -1791,9 +2244,10 @@ class ZeroServer(RaftServer):
                     # the data; keep retrying the source drop forever
 
     def _abort_move(self, pred: str, mv: dict):
-        """Pre-flip abort: route stays with the source; the imported
-        copy on the destination (replicated by import_tablet) must be
-        dropped or it lives on as a stale orphan."""
+        """Pre-flip abort: route stays with the source (which never
+        stopped serving); the copy staged/installed on the destination
+        must be dropped or it lives on as a stale orphan. Post-flip
+        moves NEVER come here — the destination owns the data."""
         dst_cl = self._group_client(mv["dst"])
         if dst_cl is not None:
             try:
@@ -1802,58 +2256,385 @@ class ZeroServer(RaftServer):
                 pass
             finally:
                 dst_cl.close()
-        self.propose_and_wait(("tablet_move_abort", (pred, mv["dst"])))
-        self._move_attempts.pop(pred, None)
-
-    def _drive_move(self, pred: str, mv: dict):
-        dst = mv["dst"]
-        src = mv.get("src")
-        if mv["phase"] == "start":
-            if src is None or src == dst:
-                self._abort_move(pred, mv)
-                return
-            src_cl = self._group_client(src)
-            dst_cl = self._group_client(dst)
-            if src_cl is None or dst_cl is None:
-                raise RuntimeError(
-                    f"groups {src}->{dst} not in the registry yet")
+        src_cl = self._group_client(mv.get("src", -1))
+        if src_cl is not None:
             try:
-                blob = src_cl._unwrap(src_cl.request(
-                    {"op": "export_tablet", "pred": pred}))
-                dst_cl._unwrap(dst_cl.request(
-                    {"op": "import_tablet", "pred": pred,
-                     "blob": blob}))
-            except RuntimeError as e:
-                raise _MoveDataError(str(e)) from e
+                # release the source's cached export blob too — an
+                # aborted multi-GB move must not pin it until the
+                # next move of the same predicate
+                src_cl.request({"op": "move_export_end",
+                                "pred": pred})
+            except Exception:  # noqa: BLE001 — best-effort cleanup  # dglint: disable=DG07 (move-abort cleanup; no request context)
+                pass
             finally:
                 src_cl.close()
+        self.propose_and_wait(("tablet_move_abort", (pred, mv["dst"])))
+        self._move_attempts.pop(pred, None)
+        self._move_progress.pop(pred, None)
+        metrics.inc_counter("dgraph_tablet_moves_total",
+                            labels={"phase": "aborted"})
+
+    def _advance(self, pred: str, mv: dict, phase: str,
+                 snap_ts: int = 0):
+        """Commit one phase transition through the quorum; the local
+        ledger copy follows only on success, so a deposed leader can
+        never act on a phase the quorum rejected."""
+        ok, res = self.propose_and_wait(
+            ("move_phase", (pred, mv["dst"], phase, int(snap_ts))))
+        if not ok or not res:
+            raise RuntimeError(f"move phase {phase!r} not committed")
+        mv["phase"] = phase
+        if snap_ts:
+            mv["snap_ts"] = int(snap_ts)
+        metrics.inc_counter("dgraph_tablet_moves_total",
+                            labels={"phase": phase})
+        log.info("move_phase", pred=pred, phase=phase,
+                 snap_ts=snap_ts or mv.get("snap_ts", 0))
+
+    def _move_pair(self, mv: dict):
+        src_cl = self._group_client(mv["src"])
+        dst_cl = self._group_client(mv["dst"])
+        if src_cl is None or dst_cl is None:
+            if src_cl is not None:
+                src_cl.close()
+            if dst_cl is not None:
                 dst_cl.close()
+            raise RuntimeError(
+                f"groups {mv['src']}->{mv['dst']} not registered yet")
+        return src_cl, dst_cl
+
+    def _drive_move(self, pred: str, mv: dict):
+        """One driver pass over a ledger entry — the phase machine
+        snapshotting -> catching_up -> fenced -> flipped(-> dropped).
+        Every transition is raft-persisted (move_phase /
+        tablet_move_done), so a NEW zero leader picks up exactly
+        here; the data steps are offset-keyed and re-deliverable, so
+        re-driving any phase is idempotent."""
+        dst = mv["dst"]
+        src = mv.get("src")
+        if src is None or src == dst:
+            self._abort_move(pred, mv)
+            return
+        prog = self._move_progress.setdefault(
+            pred, {"bytes": 0, "lag": None, "started": time.monotonic(),
+                   "fence_started": None, "fence_ms": None})
+        if mv["phase"] in ("start", "snapshotting"):
+            # ("start" = a legacy pre-phase-machine ledger entry:
+            # drive it through the streaming path too)
+            if mv["phase"] == "start":
+                mv["phase"] = "snapshotting"
+            self._phase_snapshot(pred, mv, prog)
+        if mv["phase"] == "catching_up":
+            self._phase_catchup(pred, mv, prog)
+        if mv["phase"] == "fenced":
+            self._phase_fenced(pred, mv, prog)
+        if mv["phase"] == "flipped":
+            self._phase_drop(pred, mv, prog)
+
+    def _phase_snapshot(self, pred: str, mv: dict, prog: dict):
+        """Stream the compressed base snapshot source -> destination
+        in throttled, re-deliverable chunks. The source serves reads
+        AND writes throughout (only the in-memory dump itself briefly
+        holds the source's write lock)."""
+        src_cl, dst_cl = self._move_pair(mv)
+        try:
+            st = dst_cl._unwrap(dst_cl.request(
+                {"op": "move_dst_status", "pred": pred}))
+            if st["installed"]:
+                # Resume from the installed copy ONLY when its
+                # provenance matches this move: a WHOLE-tablet move
+                # must never adopt a shard-only orphan (left by a
+                # failed abort cleanup) as its base — post-flip the
+                # other shards' rows would be silently gone; and a
+                # SPLIT move re-streams rather than trusting an
+                # unattributable copy. Mismatches are dropped (the
+                # destination is unrouted pre-flip) and re-streamed.
+                if mv.get("shard") is None \
+                        and not st.get("split_partial"):
+                    self._advance(pred, mv, "catching_up",
+                                  snap_ts=int(st["max_commit_ts"]))
+                    return
+                dst_cl.request({"op": "drop_tablet", "pred": pred})
+            try:
+                begin = src_cl._unwrap(src_cl.request(
+                    {"op": "move_export_begin", "pred": pred,
+                     "shard": mv.get("shard"),
+                     "nshards": mv.get("nshards", 1),
+                     # resume an interrupted stream when the source
+                     # still caches the export the destination's
+                     # staged chunks belong to (chunks are staged
+                     # sequentially, so have_chunks IS the resume seq)
+                     "prefer_snap_ts": st.get("staged_snap_ts", 0),
+                     "chunk_bytes": self.move_chunk_bytes}))
+            except RuntimeError as e:
+                raise _MoveDataError(str(e)) from e
+            snap_ts = int(begin["snap_ts"])
+            nchunks = int(begin["chunks"])
+            first_seq = 0
+            if snap_ts and snap_ts == int(st.get("staged_snap_ts", 0)):
+                first_seq = min(int(st.get("have_chunks", 0)), nchunks)
+            budget = self.move_throttle_mb_s * 1e6  # bytes/s
+            for seq in range(first_seq, nchunks):
+                if self._stop.is_set() or not self.is_leader():
+                    return
+                got = src_cl.request(
+                    {"op": "move_chunk", "pred": pred,
+                     "snap_ts": snap_ts, "seq": seq})
+                if not got.get("ok"):
+                    # a new source leader has no export cache: next
+                    # driver tick re-begins from a fresh snapshot
+                    raise _MoveDataError(
+                        f"chunk {seq}: {got.get('error')}")
+                data = got["result"]["data"]
+                dst_cl._unwrap(dst_cl.request(
+                    {"op": "move_stage_chunk", "pred": pred,
+                     "snap_ts": snap_ts, "seq": seq,
+                     "total": nchunks, "data": data}))
+                prog["bytes"] += len(data)
+                metrics.inc_counter("dgraph_move_streamed_bytes_total",
+                                    len(data))
+                if budget > 0 and data:
+                    time.sleep(len(data) / budget)  # --move-throttle
+            inst = dst_cl.request({"op": "move_install", "pred": pred,
+                                   "snap_ts": snap_ts})
+            if not inst.get("ok"):
+                if inst.get("restage"):
+                    return  # dst leader changed mid-stream: re-stream
+                raise _MoveDataError(f"install: {inst.get('error')}")
+            self._advance(pred, mv, "catching_up", snap_ts=snap_ts)
+        finally:
+            src_cl.close()
+            dst_cl.close()
+
+    def _catchup_once(self, pred: str, mv: dict, prog: dict,
+                      src_cl, dst_cl) -> Optional[int]:
+        """One catch-up round: read the destination's watermark, pull
+        the next raw batch from the source's change log, apply it.
+        Returns the lag (entries still behind) or None when the move
+        must restart from a fresh snapshot (log truncated / the
+        destination lost its copy)."""
+        from dgraph_tpu.cdc.changelog import offset_for_ts
+        st = dst_cl._unwrap(dst_cl.request(
+            {"op": "move_dst_status", "pred": pred}))
+        if not st["installed"]:
+            self._advance(pred, mv, "snapshotting")
+            return None
+        after = offset_for_ts(max(int(st["max_commit_ts"]),
+                                  int(mv.get("snap_ts", 0))))
+        got = src_cl.request(
+            {"op": "move_deltas", "pred": pred, "after": after,
+             "limit": 512, "shard": mv.get("shard"),
+             "nshards": mv.get("nshards", 1)})
+        if not got.get("ok"):
+            if got.get("truncated"):
+                # the bounded change log evicted past our base while
+                # we streamed: DROP the destination's stale copy
+                # first (it is unrouted pre-flip), then restart from
+                # a newer snapshot — leaving it installed would make
+                # _phase_snapshot short-circuit straight back to
+                # catching_up with the same too-old watermark, a
+                # silent snapshotting<->truncated livelock
+                dst_cl.request({"op": "drop_tablet", "pred": pred})
+                self._advance(pred, mv, "snapshotting")
+                return None
+            raise _MoveDataError(f"deltas: {got.get('error')}")
+        res = got["result"]
+        if res["batches"]:
+            ap = dst_cl.request({"op": "move_apply", "pred": pred,
+                                 "batches": res["batches"]})
+            if not ap.get("ok"):
+                if ap.get("restage"):
+                    self._advance(pred, mv, "snapshotting")
+                    return None
+                raise _MoveDataError(f"apply: {ap.get('error')}")
+        lag = int(res["behind"]) + sum(len(ops) for _, ops
+                                       in res["batches"])
+        prog["lag"] = int(res["behind"])
+        metrics.set_gauge("dgraph_move_catchup_lag", prog["lag"],
+                          labels={"pred": pred})
+        return 0 if not res["batches"] and not res["behind"] else lag
+
+    def _phase_catchup(self, pred: str, mv: dict, prog: dict):
+        """Tail the source's change log until lag falls under the
+        fence bound, then fence (a SHORT single-predicate write fence
+        — reads never fence)."""
+        src_cl, dst_cl = self._move_pair(mv)
+        try:
+            for _ in range(64):  # bounded per driver tick
+                if self._stop.is_set() or not self.is_leader():
+                    return
+                lag = self._catchup_once(pred, mv, prog, src_cl, dst_cl)
+                if lag is None:
+                    return  # restarting from snapshot
+                if lag <= self.move_fence_lag:
+                    failpoint.fire("move.fence")
+                    self._advance(pred, mv, "fenced")
+                    prog["fence_started"] = time.monotonic()
+                    return
+            # still far behind: next driver tick continues from the
+            # destination's durable watermark
+        finally:
+            src_cl.close()
+            dst_cl.close()
+
+    def _phase_fenced(self, pred: str, mv: dict, prog: dict):
+        """Writes to this one predicate are fenced (zero's moving
+        mark): drain the last deltas to lag ZERO, verify no 2PC stage
+        still pends on the source, then commit the ownership flip. If
+        the drain doesn't converge inside the fence budget, UNFENCE —
+        writes resume, catch-up continues, nothing is lost."""
+        src_cl, dst_cl = self._move_pair(mv)
+        try:
+            if prog.get("fence_started") is None:
+                prog["fence_started"] = time.monotonic()  # resumed
+            deadline = prog["fence_started"] + self.move_fence_timeout_s
+            while True:
+                if self._stop.is_set() or not self.is_leader():
+                    return
+                lag = self._catchup_once(pred, mv, prog, src_cl,
+                                         dst_cl)
+                if lag is None:
+                    prog["fence_started"] = None
+                    return  # restarting from snapshot (unfenced)
+                if lag == 0:
+                    # the barrier read: move_status acquires the
+                    # source's WRITE lock before reading the CDC head,
+                    # so any commit that slipped past its pre-fence
+                    # ownership check has fully applied and is covered
+                    # by cdc_head — the drain is complete only once
+                    # the destination's watermark covers that head
+                    sst = src_cl._unwrap(src_cl.request(
+                        {"op": "move_status", "pred": pred}))
+                    st = dst_cl._unwrap(dst_cl.request(
+                        {"op": "move_dst_status", "pred": pred}))
+                    from dgraph_tpu.cdc.changelog import offset_for_ts
+                    covered = offset_for_ts(
+                        max(int(st["max_commit_ts"]),
+                            int(mv.get("snap_ts", 0))))
+                    if not sst["pending_stage"] \
+                            and covered >= int(sst["cdc_head"]):
+                        break  # fully drained: flip
+                if time.monotonic() > deadline:
+                    # drain did not converge (pending 2PC stage, write
+                    # storm): unfence so the source serves writes
+                    # again; catch-up resumes and re-fences later
+                    self._advance(pred, mv, "catching_up")
+                    prog["fence_started"] = None
+                    return
+                time.sleep(0.02)
+            prog["fence_ms"] = round(
+                (time.monotonic() - prog["fence_started"]) * 1000, 1)
+            failpoint.fire("move.flip")
             ok, flipped = self.propose_and_wait(
-                ("tablet_move_done", (pred, dst)))
+                ("tablet_move_done", (pred, mv["dst"])))
             if not ok or not flipped:
                 raise RuntimeError("ownership flip not committed")
             mv["phase"] = "flipped"
-        if mv["phase"] == "flipped":
-            # the new owner serves; drop the SOURCE copy — the group
-            # recorded in the ledger, NOT the tablet map (which
-            # already points at dst post-flip). Idempotent: a
-            # re-elected leader may re-issue it.
-            if src is not None and src != dst:
-                src_cl = self._group_client(src)
-                if src_cl is None:
-                    raise RuntimeError(f"group {src} unreachable")
-                try:
+            metrics.inc_counter("dgraph_tablet_moves_total",
+                                labels={"phase": "flipped"})
+            log.info("move_flipped", pred=pred, dst=mv["dst"],
+                     fence_ms=prog["fence_ms"])
+        finally:
+            src_cl.close()
+            dst_cl.close()
+
+    def _phase_drop(self, pred: str, mv: dict, prog: dict):
+        """Post-flip: the destination owns and serves; retire the
+        source copy — whole-tablet moves drop + tombstone (typed
+        misroutes for stale clients), split moves prune the moved hash
+        range. Idempotent; a resumed leader re-issues freely. NEVER
+        aborts — post-flip the destination's copy is the only one
+        routed to."""
+        src = mv.get("src")
+        if src is not None and src != mv["dst"]:
+            src_cl = self._group_client(src)
+            if src_cl is None:
+                raise RuntimeError(f"group {src} unreachable")
+            try:
+                if mv.get("shard") is not None:
                     resp = src_cl.request(
-                        {"op": "drop_tablet", "pred": pred})
-                    if not resp.get("ok") and "not served" not in str(
-                            resp.get("error", "")):
-                        raise RuntimeError(
-                            f"source drop failed: {resp.get('error')}")
-                finally:
-                    src_cl.close()
-            self.propose_and_wait(("move_finish", (pred,)))
-            self._move_attempts.pop(pred, None)
-            log.info("move_complete", pred=pred, dst=dst)
+                        {"op": "split_prune", "pred": pred,
+                         "nshards": mv.get("nshards", 2),
+                         "shard": mv["shard"]})
+                else:
+                    resp = src_cl.request(
+                        {"op": "drop_tablet", "pred": pred,
+                         "move_dst": mv["dst"]})
+                if not resp.get("ok") and "not served" not in str(
+                        resp.get("error", "")):
+                    raise RuntimeError(
+                        f"source drop failed: {resp.get('error')}")
+            finally:
+                src_cl.close()
+        self.propose_and_wait(("move_finish", (pred,)))
+        self._move_attempts.pop(pred, None)
+        done = self._move_progress.pop(pred, None)
+        if done is not None:
+            metrics.observe(
+                "dgraph_move_duration_ms",
+                (time.monotonic() - done["started"]) * 1000)
+        metrics.set_gauge("dgraph_move_catchup_lag", 0,
+                          labels={"pred": pred})
+        metrics.inc_counter("dgraph_tablet_moves_total",
+                            labels={"phase": "dropped"})
+        log.info("move_complete", pred=pred, dst=mv["dst"],
+                 shard=mv.get("shard"))
+
+    # ------------------------------------------------------ rebalancer
+
+    def _rebalance_loop(self):
+        """Leader-only heat-driven rebalancing (ref zero/tablet.go:62
+        rebalanceTablets, every --rebalance_interval): each tick feeds
+        the replicated stats (heat EWMAs, sizes, tablet map) to the
+        pure planner (cluster/rebalance.py) and files at most ONE
+        move/split request — the ledger serializes execution, and
+        one-step-at-a-time keeps a bad heuristic from thrashing the
+        keyspace."""
+        from dgraph_tpu.cluster.rebalance import RebalanceConfig, \
+            plan_rebalance
+        cfg = RebalanceConfig(band=self.rebalance_band,
+                              split_heat=self.split_heat,
+                              pinned=self.rebalance_pin)
+        # leader-local move cooldown: a tablet moved recently is
+        # frozen for rebalance_cooldown_s so a heat EWMA still
+        # re-equilibrating after the move cannot thrash it straight
+        # back (the first bench run moved `knows` 1->2 then 2->1)
+        recent: dict[str, float] = {}
+        while not self._stop.wait(self.rebalance_interval_s):
+            with self.lock:
+                if self.node.role != LEADER:
+                    continue
+                if self.state.move_queue:
+                    continue  # one move at a time
+                view = {
+                    "tablets": dict(self.state.tablets),
+                    "splits": {p: dict(s) for p, s
+                               in self.state.splits.items()},
+                    "moving": dict(self.state.moving),
+                    "sizes": dict(self.state.sizes),
+                    "heat": dict(self.state.heat),
+                    "groups": sorted({rec["group"] for rec
+                                      in self.state.alphas.values()}),
+                }
+            now = time.monotonic()
+            for p in list(recent):
+                if now - recent[p] > self.rebalance_cooldown_s:
+                    del recent[p]
+            view["frozen"] = sorted(recent)
+            plan = plan_rebalance(view, cfg)
+            if plan is None:
+                continue
+            try:
+                ok, accepted = self.propose_and_wait(
+                    ("move_request", plan.args()))
+                if ok and accepted:
+                    recent[plan.pred] = now
+                log.info("rebalance_proposed", kind=plan.kind,
+                         pred=plan.pred, dst=plan.dst,
+                         shard=plan.shard, accepted=bool(ok and
+                                                         accepted))
+            except Exception as e:  # noqa: BLE001 — keep rebalancing  # dglint: disable=DG07 (rebalancer daemon; no request context)
+                log.warning("rebalance_retry", error=str(e)[:200])
 
     def sm_apply(self, origin, cmd) -> Any:
         return self.state.apply(cmd)
@@ -1881,26 +2662,39 @@ class ZeroServer(RaftServer):
         if op == "tablet_map":
             # routing table read (ref zero.go:410 /state) — leader-only
             # so a lagging follower can never serve a stale map that
-            # routes writes to a tablet's old owner after a move
+            # routes writes to a tablet's old owner after a move.
+            # `moving` fences WRITES only (the short fenced phase);
+            # `moves` is the live ledger (clients wait on it);
+            # `splits` routes hash-range sub-tablets.
             with self.lock:
                 if self.node.role != LEADER:
                     raise NotLeader(self.node.leader_id)
                 return {"ok": True, "result": {
                     "tablets": dict(self.state.tablets),
                     "moving": dict(self.state.moving),
+                    "splits": {p: dict(s) for p, s
+                               in self.state.splits.items()},
+                    "moves": {p: dict(m) for p, m
+                              in self.state.move_queue.items()},
                     "sizes": dict(self.state.sizes)}}
         if op == "cluster_state":
-            # membership introspection (ref zero /state)
+            # membership introspection (ref zero /state) — exposes the
+            # split sub-tablet routing and per-tablet heat too
             with self.lock:
                 return {"ok": True, "result": {
                     "alphas": {k: dict(v)
                                for k, v in self.state.alphas.items()},
-                    "tablets": dict(self.state.tablets)}}
+                    "tablets": dict(self.state.tablets),
+                    "splits": {p: dict(s) for p, s
+                               in self.state.splits.items()},
+                    "moves": {p: dict(m) for p, m
+                              in self.state.move_queue.items()},
+                    "heat": dict(self.state.heat)}}
         if op in ("assign_ts", "assign_uids", "commit", "txn_status",
                   "abort_txn", "tablet", "bump_maxes",
                   "tablet_move_start", "tablet_move_done",
-                  "tablet_move_abort", "move_request",
-                  "tablet_size", "tablet_sizes",
+                  "tablet_move_abort", "move_request", "move_phase",
+                  "tablet_size", "tablet_sizes", "tablet_heat",
                   "connect"):
             with self.lock:
                 if self.node.role != LEADER:
@@ -1911,3 +2705,30 @@ class ZeroServer(RaftServer):
                 return {"ok": False, "error": "no quorum"}
             return {"ok": True, "result": result}
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def debug_stats_payload(self) -> dict:
+        """Zero's /debug/stats: base payload + the live move ledger
+        enriched with the leader's driver progress (bytes streamed,
+        catch-up lag, fence clock) and the heat table — what the dgtop
+        MOVES panel renders."""
+        out = super().debug_stats_payload()
+        with self.lock:
+            moves = {p: dict(m) for p, m
+                     in self.state.move_queue.items()}
+            out["splits"] = {p: dict(s) for p, s
+                             in self.state.splits.items()}
+            out["heat"] = dict(self.state.heat)
+            out["tablets_map"] = dict(self.state.tablets)
+            role = self.node.role
+        for pred, mv in moves.items():
+            prog = self._move_progress.get(pred) or {}
+            mv["bytes"] = prog.get("bytes", 0)
+            mv["lag"] = prog.get("lag")
+            mv["fence_ms"] = prog.get("fence_ms")
+            if prog.get("fence_started") is not None \
+                    and mv["fence_ms"] is None:
+                mv["fence_ms"] = round(
+                    (time.monotonic() - prog["fence_started"]) * 1e3, 1)
+        out["moves"] = moves
+        out["role"] = role
+        return out

@@ -26,9 +26,11 @@ oracle (2PC; ref worker/mutation.go:472, zero/oracle.go:326).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Optional
 
 from dgraph_tpu.cluster.client import ClusterClient
+from dgraph_tpu.cluster.errors import TabletMisrouted
 
 
 class SpanGroupsError(RuntimeError):
@@ -82,22 +84,36 @@ class RoutedCluster:
         return {p.lstrip("~") for p in preds if p != "*"}
 
     def _group_for(self, preds: set[str], claim: bool,
-                   tmap: Optional[dict] = None) -> int:
+                   tmap: Optional[dict] = None,
+                   for_write: bool = False) -> int:
         """Resolve the single group serving `preds`; with claim=True,
         unowned predicates are claimed for the chosen group (ref
-        zero.go ShouldServe: first writer claims the tablet)."""
+        zero.go ShouldServe: first writer claims the tablet).
+
+        Only WRITES respect the moving fence (the move machine's
+        short `fenced` phase) — reads never fence: the source keeps
+        serving snapshot-consistent reads through every move phase
+        until the flip, and post-flip routing points at the
+        destination. A hash-range split predicate always has multiple
+        owners, so it routes through the cross-group paths."""
         if tmap is None:
             tmap = self.tablet_map()
-        moving = tmap["moving"]
-        for p in preds:
-            if p in moving:
-                raise RuntimeError(
-                    f"tablet {p!r} is being moved; retry shortly")
+        if for_write:
+            moving = tmap["moving"]
+            for p in preds:
+                if p in moving:
+                    raise RuntimeError(
+                        f"tablet {p!r} is being moved; retry shortly")
+        splits = tmap.get("splits", {})
         owners = {tmap["tablets"][p] for p in preds
                   if p in tmap["tablets"]}
+        for p in preds:
+            if p in splits:
+                owners.update(int(g) for g in splits[p]["owners"])
         if len(owners) > 1:
             raise SpanGroupsError(preds, owners)
-        unowned = [p for p in preds if p not in tmap["tablets"]]
+        unowned = [p for p in preds if p not in tmap["tablets"]
+                   and p not in splits]
         if owners:
             gid = owners.pop()
         elif not unowned:
@@ -129,13 +145,46 @@ class RoutedCluster:
         for gid in sorted(self.groups):
             self.groups[gid].alter(schema_text, **kw)
 
+    # bounded re-route budget for requests racing a tablet move: a
+    # typed TabletMisrouted (the owner flipped after our map fetch)
+    # re-fetches the map and re-routes immediately; a write-fence
+    # rejection ("is being moved") backs off and retries — the fence
+    # is bounded by zero's --move-fence-timeout, so the whole budget
+    # comfortably outlasts one fence window. Neither ever surfaces to
+    # the user inside the budget.
+    MISROUTE_RETRIES = 4
+    FENCE_RETRY_S = 8.0
+
+    def _retry_routed(self, fn):
+        """Run `fn()` (which fetches a FRESH tablet map each attempt)
+        under the misroute/fence retry contract above."""
+        deadline = time.monotonic() + self.FENCE_RETRY_S
+        misroutes = 0
+        delay = 0.05
+        while True:
+            try:
+                return fn()
+            except TabletMisrouted:
+                misroutes += 1
+                if misroutes > self.MISROUTE_RETRIES:
+                    raise
+                continue  # next attempt re-fetches the map: re-route
+            except RuntimeError as e:
+                if "is being moved" not in str(e) \
+                        or time.monotonic() >= deadline:
+                    raise
+                time.sleep(delay)  # fenced: short bounded backoff
+                delay = min(0.4, delay * 2)
+
     def mutate(self, **kw) -> dict:
-        try:
-            gid = self._group_for(self._preds_of_mutation(kw),
-                                  claim=True)
-        except SpanGroupsError:
-            return self._mutate_multigroup(kw)
-        return self.groups[gid].mutate(**kw)
+        def attempt():
+            try:
+                gid = self._group_for(self._preds_of_mutation(kw),
+                                      claim=True, for_write=True)
+            except SpanGroupsError:
+                return self._mutate_multigroup(kw)
+            return self.groups[gid].mutate(**kw)
+        return self._retry_routed(attempt)
 
     def _mutate_multigroup(self, kw: dict) -> dict:
         """One mutation split across groups, committed atomically
@@ -193,6 +242,7 @@ class RoutedCluster:
                 blanks[k] = first + i
 
         tmap = self.tablet_map()
+        splits = tmap.get("splits", {})
         by_group: dict[int, list] = {}
         for nq, is_del in nqs:
             if nq.subject in blanks or nq.object_id in blanks:
@@ -202,11 +252,26 @@ class RoutedCluster:
                          if nq.subject in blanks else nq.subject,
                          object_id=hex(blanks[nq.object_id])
                          if nq.object_id in blanks else nq.object_id)
-            gid = tmap["tablets"].get(nq.predicate)
-            if gid is None:
-                gid = self._group_for({nq.predicate}, claim=True,
-                                      tmap=tmap)
-                tmap["tablets"][nq.predicate] = gid
+            if nq.predicate in splits:
+                # hash-range split: route per resolved SUBJECT uid
+                # (blanks were substituted above, so every row has
+                # one) — the 2PC stage below makes the cross-shard
+                # write atomic exactly like any cross-group write
+                from dgraph_tpu.cluster.shard import owner_for_uid
+                try:
+                    uid = int(nq.subject, 0)
+                except ValueError:
+                    raise RuntimeError(
+                        f"cannot route a write to split tablet "
+                        f"{nq.predicate!r}: subject {nq.subject!r} "
+                        "is not a resolved uid") from None
+                gid = owner_for_uid(splits[nq.predicate], uid)
+            else:
+                gid = tmap["tablets"].get(nq.predicate)
+                if gid is None:
+                    gid = self._group_for({nq.predicate}, claim=True,
+                                          tmap=tmap)
+                    tmap["tablets"][nq.predicate] = gid
             by_group.setdefault(gid, []).append(
                 (nquad_to_wire(nq), is_del))
 
@@ -277,31 +342,43 @@ class RoutedCluster:
             ctx = RequestContext.from_deadline_ms(deadline_ms)
         parsed = parse(q, variables)
         preds = {p.lstrip("~") for p in query_predicates(parsed)}
-        tmap = self.tablet_map()
-        try:
-            gid = self._group_for(preds, claim=False, tmap=tmap)
-        except SpanGroupsError:
-            # one map drives both the span decision and the per-block
-            # assignment — no second fetch, no TOCTOU between them
+
+        def attempt():
+            tmap = self.tablet_map()
             try:
-                return self._scatter_query(q, variables, parsed,
-                                           tmap["tablets"], ctx)
-            except _NeedsFederation:
-                # a single block spans groups / a var crosses groups:
-                # run the full executor here with per-attr task RPCs
-                # to each owning group (ref worker/task.go:131)
-                return self._federated_query(q, variables,
-                                             tmap["tablets"], ctx)
-        return self.groups[gid].query(
-            q, variables,
-            deadline_ms=ctx.remaining_ms() if ctx else None)
+                gid = self._group_for(preds, claim=False, tmap=tmap)
+            except SpanGroupsError:
+                # one map drives both the span decision and the
+                # per-block assignment — no second fetch, no TOCTOU
+                # between them
+                try:
+                    return self._scatter_query(q, variables, parsed,
+                                               tmap, ctx)
+                except _NeedsFederation:
+                    # a single block spans groups / a var crosses
+                    # groups / a split sub-tablet fan-out: run the
+                    # full executor here with per-attr task RPCs to
+                    # each owning group (ref worker/task.go:131)
+                    return self._federated_query(q, variables,
+                                                 tmap, ctx)
+            return self.groups[gid].query(
+                q, variables,
+                deadline_ms=ctx.remaining_ms() if ctx else None)
+        # a move's flip between our map fetch and the read lands a
+        # TYPED TabletMisrouted (never silent empties): re-fetch the
+        # map and re-route, bounded — queries never fence, so "is
+        # being moved" cannot surface here
+        return self._retry_routed(attempt)
 
     def _federated_query(self, q: str, variables: Optional[dict],
-                         tmap: dict, ctx=None) -> dict:
+                         full_tmap: dict, ctx=None) -> dict:
         from dgraph_tpu.cluster.federated import FederatedDB
 
+        tmap = full_tmap["tablets"]
+        splits = full_tmap.get("splits", {})
         read_ts = self.zero.assign_ts(1)
-        fdb = FederatedDB(self.groups, tmap, "", read_ts, ctx=ctx)
+        fdb = FederatedDB(self.groups, tmap, "", read_ts, ctx=ctx,
+                          splits=splits)
         # schema from every group: on-the-fly predicates exist only on
         # their owning group, so no single group has the whole picture
         for gid in sorted(self.groups):
@@ -316,12 +393,22 @@ class RoutedCluster:
         out = fdb.query(q, variables)
         out.setdefault("extensions", {})["federated"] = True
         out["extensions"]["read_ts"] = read_ts
+        touched = {p: {"owners": [int(g) for g in
+                                  splits[p]["owners"]]}
+                   for p in splits
+                   if p in fdb.tablets.keys()}  # instantiated only
+        if touched:
+            # EXPLAIN-adjacent visibility: which sub-tablet fan-outs
+            # served this query (mirrors zero /state `splits`)
+            out["extensions"]["splitRouting"] = touched
         return out
 
     def _scatter_query(self, q: str, variables: Optional[dict],
-                       parsed, tmap: dict, ctx=None) -> dict:
+                       parsed, full_tmap: dict, ctx=None) -> dict:
         from dgraph_tpu.server.acl import block_predicates
 
+        tmap = full_tmap["tablets"]
+        splits = full_tmap.get("splits", {})
         # assign each top-level block to its owning group; blocks
         # sharing variables must land on ONE group (a var defined in
         # group A cannot feed a block served by group B)
@@ -329,6 +416,10 @@ class RoutedCluster:
         assign: list[tuple[int, Any]] = []
         for gq in parsed.queries:
             bpreds = {p.lstrip("~") for p in block_predicates(gq)}
+            if any(p in splits for p in bpreds):
+                # a split predicate's rows span groups within ONE
+                # block: only the federated fan-out can union them
+                raise _NeedsFederation(gq.alias)
             owners = {tmap[p] for p in bpreds if p in tmap}
             if len(owners) > 1:
                 raise _NeedsFederation(gq.alias)
@@ -429,14 +520,16 @@ class RoutedCluster:
                     timeout_s: float = 60.0) -> None:
         """Live predicate move, OWNED by the Zero quorum (ref
         zero/tablet.go:62 movetablet + worker/predicate_move.go): this
-        client only files the request and waits. Zero's leader drives
-        export -> import -> ownership flip -> source drop, persisting
-        each phase through its Raft group, so the move completes (or
-        aborts cleanly, pre-flip) even if THIS process — or the Zero
-        leader itself — dies mid-move. Concurrent movers serialize at
-        the ledger: the second request returns 'already moving'."""
-        import time as _time
-
+        client only files the request and waits on the replicated move
+        LEDGER. Zero's leader drives snapshot stream -> CDC catch-up
+        -> bounded-lag fence -> ownership flip -> source drop,
+        persisting each phase through its Raft group, so the move
+        completes (or aborts cleanly, pre-flip) even if THIS process —
+        or the Zero leader itself — dies mid-move. The source serves
+        reads AND writes throughout; only the short `fenced` phase
+        rejects writes to this one predicate. Concurrent movers
+        serialize at the ledger: the second request returns 'already
+        moving'."""
         tmap = self.tablet_map()
         src = tmap["tablets"].get(pred)
         if src is None:
@@ -449,30 +542,69 @@ class RoutedCluster:
             raise RuntimeError(
                 f"tablet {pred!r} move refused: "
                 f"{resp.get('error', 'already moving?')}")
-        deadline = _time.monotonic() + timeout_s
-        while _time.monotonic() < deadline:
+        self._await_move(pred, dst_group, timeout_s)
+
+    def split_tablet(self, pred: str, dst_group: int,
+                     nshards: int = 2, shard: Optional[int] = None,
+                     timeout_s: float = 60.0) -> None:
+        """Split a hot predicate into `nshards` hash-range sub-tablets
+        by moving `shard` (default: the last one) onto `dst_group` —
+        same crash-safe phase machine as move_tablet; after the flip
+        the routing map carries a `splits` entry and reads fan out
+        (cluster/federated.py), writes route per subject uid."""
+        shard = nshards - 1 if shard is None else int(shard)
+        resp = self.zero.request(
+            {"op": "move_request",
+             "args": (pred, dst_group, int(nshards), shard)})
+        if not resp.get("ok") or not resp.get("result"):
+            raise RuntimeError(
+                f"tablet {pred!r} split refused: "
+                f"{resp.get('error', 'already moving/split?')}")
+        self._await_move(pred, dst_group, timeout_s, split=True)
+
+    def _await_move(self, pred: str, dst_group: int, timeout_s: float,
+                    split: bool = False) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
             try:
                 tmap = self.tablet_map()
             except RuntimeError:
-                _time.sleep(0.3)  # zero election in progress
+                time.sleep(0.3)  # zero election in progress
                 continue
-            if pred not in tmap["moving"]:
-                if tmap["tablets"].get(pred) == dst_group:
+            if pred not in tmap.get("moves", {}):
+                if split:
+                    ent = tmap.get("splits", {}).get(pred)
+                    if ent and int(dst_group) in \
+                            {int(g) for g in ent["owners"]}:
+                        return
+                elif tmap["tablets"].get(pred) == dst_group:
                     return
                 raise RuntimeError(
                     f"tablet {pred!r} move aborted by zero "
                     f"(owner is group {tmap['tablets'].get(pred)})")
-            _time.sleep(0.2)
+            time.sleep(0.2)
         raise TimeoutError(
             f"tablet {pred!r} move still in flight after {timeout_s}s "
             "(zero keeps driving it; check tablet_map later)")
 
     def abort_move(self, pred: str, dst_group: int) -> bool:
-        """Clear a stuck moving mark without flipping ownership — the
-        operator escape hatch when a move crashed mid-flight."""
+        """Abort an in-flight move without flipping ownership — the
+        operator escape hatch. Refused (False) once the move has
+        flipped: the destination then owns the only routed copy. On a
+        successful pre-flip abort the destination's staged/installed
+        copy is dropped too — the streaming path installs the copy
+        long before the flip, and leaving it would strand a stale
+        orphan whose size/heat reports skew the rebalancer."""
         resp = self.zero.request({"op": "tablet_move_abort",
                                   "args": (pred, dst_group)})
-        return bool(resp.get("ok") and resp.get("result"))
+        ok = bool(resp.get("ok") and resp.get("result"))
+        if ok and dst_group in self.groups:
+            try:
+                self.groups[dst_group].request(
+                    {"op": "drop_tablet", "pred": pred})
+            except Exception:  # noqa: BLE001 — best-effort cleanup  # dglint: disable=DG07 (abort cleanup is best-effort BY CONTRACT)
+                pass
+        return ok
 
     def close(self):
         self.zero.close()

@@ -26,11 +26,27 @@ Commands (payload = (op, args)):
   ("tablet",     (pred, group))       -> owning group id (first claim wins)
   ("tablet_move_start", (pred, dst))  -> True once the tablet is marked
                                          read-only for the move
+                                         (legacy one-shot path)
+  ("move_request", (pred, dst[, nshards, shard]))
+                                      -> enqueue a live move (or a
+                                         hash-range split of `shard`)
+                                         for the leader's driver; NO
+                                         write fence yet
+  ("move_phase", (pred, dst, phase[, snap_ts]))
+                                      -> persist one phase transition
+                                         (snapshotting -> catching_up
+                                         -> fenced -> flipped); the
+                                         fence entry/exit sets/clears
+                                         the moving mark
   ("tablet_move_done", (pred, dst))   -> flips ownership + clears the
                                          moving mark (zero/tablet.go:62)
   ("tablet_size", (pred, bytes))      -> records a size report (the
                                          rebalancer's input,
                                          zero/tablet.go:180)
+  ("tablet_heat", ({pred: (bytes, touches_delta)},))
+                                      -> size + heat-EWMA report (the
+                                         heat-driven rebalancer's load
+                                         signal)
   ("connect", (key, want_group, raft_addr, client_addr, replicas))
                                       -> group assignment for a
                                          (re)connecting alpha: joins
@@ -64,12 +80,27 @@ class ZeroState:
         self.tablets: dict[str, int] = {}
         self.moving: dict[str, int] = {}   # pred -> destination group
         # zero-owned move ledger (ref zero/tablet.go:62 movetablet —
-        # the LEADER drives moves; the replicated phase lets a new
-        # leader resume or roll back an in-flight move):
+        # the LEADER drives moves; the replicated phase machine lets a
+        # new leader resume or roll back an in-flight move from the
+        # exact phase it died in):
         #   pred -> {"dst": group, "src": group,
-        #            "phase": "start" | "flipped"}
+        #            "phase": "snapshotting" | "catching_up" |
+        #                     "fenced" | "flipped",
+        #            "snap_ts": int,                # catch-up base
+        #            "nshards": int, "shard": int|None}  # split moves
+        # Writes fence ONLY in "fenced" (self.moving set on entry,
+        # cleared on flip/unfence/abort); reads never fence.
         self.move_queue: dict[str, dict] = {}
+        # hash-range split registry: pred -> {"owners": [group per
+        # shard]} — shard i of an n-way split (n = len(owners)) serves
+        # subjects with shard_of(uid, n) == i (cluster/shard.py)
+        self.splits: dict[str, dict] = {}
         self.sizes: dict[str, int] = {}    # pred -> reported bytes
+        # per-tablet heat: EWMA of the alphas' reported query-path
+        # touch DELTAS (storage/tabstats.py `touches`) — the
+        # rebalancer's load signal (applied through raft, so every
+        # quorum member computes the identical value)
+        self.heat: dict[str, float] = {}
         # alpha registry: key (raft "host:port") -> member record
         # (zero/zero.go membership state)
         self.alphas: dict[str, dict] = {}
@@ -126,6 +157,11 @@ class ZeroState:
             return self.decided.setdefault(int(start_ts), 0)
         if op == "tablet":
             pred, group = args
+            if pred in self.splits:
+                # a split predicate has no single owner: claiming it
+                # whole would shadow the range routing. -1 = "routed
+                # per shard" (no group passes an ownership check).
+                return -1
             return self.tablets.setdefault(pred, int(group))
         if op == "bump_maxes":
             # bulk-booted alphas push their snapshot watermarks so
@@ -143,37 +179,107 @@ class ZeroState:
             self.moving[pred] = int(dst)
             return True
         if op == "move_request":
-            # zero-owned move: marks read-only AND enqueues the move
-            # for the leader's driver thread (serialized: one ledger
-            # entry per pred; concurrent movers get False back)
-            pred, dst = args
-            if pred not in self.tablets or \
-                    self.tablets[pred] == int(dst) or pred in self.moving:
+            # zero-owned move: enqueues for the leader's driver thread
+            # (serialized: one ledger entry per pred; concurrent movers
+            # get False back). Writes are NOT fenced here — the source
+            # keeps serving reads AND writes through snapshotting and
+            # catch-up; the fence is the short "fenced" phase only.
+            # args = (pred, dst) for a whole-tablet move, or
+            # (pred, dst, nshards, shard) to split `shard` of an n-way
+            # hash-range split onto dst.
+            pred, dst = args[0], int(args[1])
+            nshards = int(args[2]) if len(args) > 2 else 1
+            shard = int(args[3]) if len(args) > 3 and \
+                args[3] is not None else None
+            if pred not in self.tablets or pred in self.moving \
+                    or pred in self.move_queue or pred in self.splits:
                 return False
-            self.moving[pred] = int(dst)
+            if self.tablets[pred] == dst:
+                return False  # no-op move; a split NEEDS another group
+            if shard is not None and not (0 <= shard < nshards
+                                          and nshards > 1):
+                return False
             # src is captured HERE: after the flip the tablet map
-            # points at dst, and the driver still owes the drop on the
-            # ORIGINAL owner (a resumed leader must not lose it)
-            self.move_queue[pred] = {"dst": int(dst), "phase": "start",
-                                     "src": self.tablets[pred]}
+            # points at dst, and the driver still owes the drop/prune
+            # on the ORIGINAL owner (a resumed leader must not lose it)
+            self.move_queue[pred] = {
+                "dst": dst, "src": self.tablets[pred],
+                "phase": "snapshotting", "snap_ts": 0,
+                "nshards": nshards, "shard": shard}
+            return True
+        if op == "move_phase":
+            # one phase transition of the ledger's machine, persisted
+            # through raft so a new zero leader resumes exactly here:
+            #   snapshotting -> catching_up   (snapshot installed)
+            #   catching_up  -> fenced        (lag under bound; SETS
+            #                                  the single-predicate
+            #                                  write fence)
+            #   fenced       -> catching_up   (fence drain timed out:
+            #                                  UNFENCE, writes resume)
+            #   catching_up  -> snapshotting  (CDC floor overtook the
+            #                                  base: re-snapshot)
+            pred, dst, phase = args[0], int(args[1]), args[2]
+            snap_ts = int(args[3]) if len(args) > 3 else 0
+            mv = self.move_queue.get(pred)
+            if mv is None or mv["dst"] != dst:
+                return False
+            legal = {("snapshotting", "catching_up"),
+                     ("catching_up", "fenced"),
+                     ("fenced", "catching_up"),
+                     ("catching_up", "snapshotting"),
+                     # a fence-drain discovering the destination lost
+                     # its copy / the log truncated restarts from a
+                     # fresh snapshot (and UNFENCES via the phase
+                     # exit below) — without this edge the driver
+                     # would wedge fenced forever
+                     ("fenced", "snapshotting"),
+                     # legacy pre-phase-machine ledger entries drive
+                     # through the streaming path too
+                     ("start", "catching_up"),
+                     ("start", "snapshotting")}
+            if (mv["phase"], phase) not in legal:
+                return False
+            mv["phase"] = phase
+            if snap_ts:
+                mv["snap_ts"] = snap_ts
+            if phase == "fenced":
+                self.moving[pred] = dst
+            else:
+                self.moving.pop(pred, None)
             return True
         if op == "tablet_move_done":
             pred, dst = args
             if self.moving.get(pred) != int(dst):
                 return False
-            self.tablets[pred] = int(dst)
+            mv = self.move_queue.get(pred)
+            if mv is not None and mv.get("shard") is not None:
+                # split flip: the predicate becomes an n-way hash-range
+                # split — the moved shard serves from dst, every other
+                # shard stays with the source (cluster/shard.py routing)
+                owners = [mv["src"]] * int(mv["nshards"])
+                owners[int(mv["shard"])] = int(dst)
+                self.splits[pred] = {"owners": owners}
+                self.tablets.pop(pred, None)
+            else:
+                self.tablets[pred] = int(dst)
             del self.moving[pred]
-            if pred in self.move_queue:
+            if mv is not None:
                 # ownership flipped; the driver still owes the source
-                # drop — keep the ledger entry so a NEW leader redoes
-                # it after a crash (drop is idempotent)
-                self.move_queue[pred]["phase"] = "flipped"
+                # drop/prune — keep the ledger entry so a NEW leader
+                # redoes it after a crash (both are idempotent)
+                mv["phase"] = "flipped"
             return True
         if op == "tablet_move_abort":
             pred, dst = args
-            if self.moving.get(pred) != int(dst):
+            if pred not in self.move_queue \
+                    or self.move_queue[pred]["dst"] != int(dst):
                 return False
-            del self.moving[pred]  # ownership unchanged, writes resume
+            if self.move_queue[pred]["phase"] == "flipped":
+                # post-flip the DESTINATION owns the only routed copy:
+                # aborting now could only orphan or delete owned data
+                # — the driver finishes the source drop instead
+                return False
+            self.moving.pop(pred, None)  # unfence if fenced
             self.move_queue.pop(pred, None)
             return True
         if op == "move_finish":
@@ -188,6 +294,28 @@ class ZeroState:
             (batch,) = args
             for pred, nbytes in batch.items():
                 self.sizes[pred] = int(nbytes)
+            return True
+        if op == "tablet_heat":
+            # one leader's periodic report: {pred: (bytes,
+            # touches_delta)} — touch deltas since ITS last report.
+            # Heat folds as an EWMA (identical on every quorum member:
+            # the fold runs at raft apply); decay-on-report keeps a
+            # cooled tablet's heat falling even when its group reports
+            # zero deltas.
+            (batch,) = args
+            for pred, (nbytes, dt) in batch.items():
+                # a SPLIT predicate's owners each report only their
+                # shard's bytes/touches: scale to a whole-predicate
+                # estimate before folding, or the shared EWMA would
+                # converge to a per-shard value and the planner would
+                # undercount split load ~owners-fold (piling more
+                # tablets onto the groups the split was relieving)
+                scale = len(self.splits[pred]["owners"]) \
+                    if pred in self.splits else 1
+                self.sizes[pred] = int(nbytes) * scale
+                self.heat[pred] = round(
+                    0.5 * self.heat.get(pred, 0.0)
+                    + 0.5 * float(dt) * scale, 3)
             return True
         if op == "connect":
             key, want_group, want_id, raft_addr, client_addr, \
@@ -286,7 +414,9 @@ class ZeroState:
                 "moving": dict(self.moving),
                 "move_queue": {k: dict(v)
                                for k, v in self.move_queue.items()},
+                "splits": {k: dict(v) for k, v in self.splits.items()},
                 "sizes": dict(self.sizes),
+                "heat": dict(self.heat),
                 "alphas": {k: dict(v) for k, v in self.alphas.items()}}
 
     @classmethod
@@ -302,7 +432,10 @@ class ZeroState:
         st.moving = dict(snap.get("moving", {}))
         st.move_queue = {k: dict(v) for k, v
                          in snap.get("move_queue", {}).items()}
+        st.splits = {k: dict(v)
+                     for k, v in snap.get("splits", {}).items()}
         st.sizes = dict(snap.get("sizes", {}))
+        st.heat = dict(snap.get("heat", {}))
         st.alphas = {k: dict(v)
                      for k, v in snap.get("alphas", {}).items()}
         return st

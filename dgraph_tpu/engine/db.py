@@ -261,6 +261,21 @@ class GraphDB:
         # once Zero's oracle decides (ref worker/mutation.go:432
         # proposeOrSend + zero/oracle.go commit decisions)
         self.pending_txns: dict[int, tuple[list, list]] = {}
+        # tablets this engine SERVED and then moved away (the
+        # ("move_drop", pred, dst) record): pred -> destination group.
+        # The serving layer answers requests that still name one of
+        # these with a TYPED misroute (cluster/errors.TabletMisrouted)
+        # so a client holding a pre-flip routing map re-fetches and
+        # re-routes instead of reading silently-empty state. Bounded;
+        # replicated (the record applies on every group member).
+        self.moved_out: dict[str, int] = {}
+        # predicates this engine serves only a HASH RANGE of (the
+        # source after split_prune, the destination after a shard
+        # import): a single-group query naming one must fail typed —
+        # serving it locally would silently return partial rows to a
+        # client whose routing map predates the split flip. Replicated
+        # (both records apply on every member) and snapshot-carried.
+        self.split_partial: set[str] = set()
         # change streams (cdc/): bounded per-predicate change logs
         # tailing the committed apply path — the same expanded records
         # the WAL frames and Raft replicates, so a WAL replay below
@@ -770,7 +785,8 @@ class GraphDB:
         processApplyCh/applyCommitted). Returns the commit ts the record
         carried, 0 for schema ops."""
         kind = rec[0]
-        if kind in ("alter", "drop_all", "drop_attr", "import_tablet"):
+        if kind in ("alter", "drop_all", "drop_attr", "import_tablet",
+                    "move_drop", "split_prune"):
             self._bump_schema_epoch()
         if kind == "alter":
             preds, types = self.schema.apply_text(rec[1])
@@ -785,6 +801,8 @@ class GraphDB:
             self.tablets.clear()
             self.schema = SchemaState()
             self.cdc.clear()
+            self.moved_out.clear()
+            self.split_partial.clear()
             return 0
         if kind == "drop_attr":
             dropped = self.tablets.pop(rec[1], None)
@@ -792,6 +810,7 @@ class GraphDB:
                 self.device_cache.drop_tablet(dropped)
             self.schema.delete_predicate(rec[1])
             self.cdc.drop(rec[1])
+            self.split_partial.discard(rec[1])
             return 0
         if kind == "import_tablet":
             # predicate move landing on the destination group
@@ -808,9 +827,77 @@ class GraphDB:
             if old is not None:
                 self.device_cache.drop_tablet(old)
             self.tablets[pred] = tab
+            self.moved_out.pop(pred, None)  # serving again (moved back)
+            if payload.get("shard") is not None:
+                # a shard import: this member now holds a RANGE of the
+                # predicate, not the whole — single-group queries must
+                # misroute typed (split tombstone)
+                self.split_partial.add(pred)
+            else:
+                self.split_partial.discard(pred)
             self.coordinator.should_serve(pred)
             self.coordinator.bump_uids(payload.get("max_uid", 0))
+            # CDC floor at the shipped base: commits <= max_commit_ts
+            # live in the installed state, commits after it arrive as
+            # ("move_delta", ...) records which append to the log with
+            # the SAME zero-global offsets the source derived — a
+            # subscriber's offset survives the move
+            self.cdc.reset_floor(pred, tab.max_commit_ts)
             return payload.get("max_ts", 0)
+        if kind == "move_delta":
+            # catch-up batches of a live tablet move landing on the
+            # destination (whole commits, ascending ts — the
+            # cdc/changelog.read_raw contract). Re-delivered batches
+            # (driver retry after a crash) are skipped by the
+            # max_commit_ts guard, which is replicated state, so every
+            # group member skips identically.
+            _, pred, batches = rec
+            tab = self._tablet_for(pred)
+            top = 0
+            for ts, ops in batches:
+                ts = int(ts)
+                if ts <= tab.max_commit_ts:
+                    continue
+                ops = list(ops)
+                tab.apply(ts, ops)
+                self.cdc.append(ts, {pred: ops})
+                uids = [op.src for op in ops] + \
+                       [op.dst for op in ops if op.dst]
+                if uids:
+                    self.coordinator.bump_uids(max(uids))
+                top = ts
+            return top
+        if kind == "move_drop":
+            # source-side cleanup after the ownership flip: drop the
+            # moved copy AND tombstone the predicate so a stale-routed
+            # request gets a typed misroute, never empty results
+            _, pred, dst = rec
+            dropped = self.tablets.pop(pred, None)
+            if dropped is not None:
+                self.device_cache.drop_tablet(dropped)
+            self.schema.delete_predicate(pred)
+            self.cdc.drop(pred)
+            self.split_partial.discard(pred)
+            self.moved_out[pred] = int(dst)
+            while len(self.moved_out) > 256:  # bounded, oldest-first
+                self.moved_out.pop(next(iter(self.moved_out)))
+            return 0
+        if kind == "split_prune":
+            # source-side cleanup after a SPLIT flip: keep only the
+            # rows outside the moved hash range (pure function of
+            # replicated tablet state — every member prunes identically)
+            _, pred, nshards, shard = rec
+            tab = self.tablets.get(pred)
+            if tab is None:
+                return 0
+            from dgraph_tpu.cluster.shard import shard_view
+            pruned = shard_view(tab, int(nshards), int(shard),
+                                invert=True)
+            pruned.touches = tab.touches
+            self.device_cache.drop_tablet(tab)
+            self.tablets[pred] = pruned
+            self.split_partial.add(pred)
+            return 0
         if kind == "commit":
             _, commit_ts, staged, schemas = rec
             # restore on-the-fly schema before creating tablets
@@ -1218,6 +1305,37 @@ class GraphDB:
             "tablet": dump_tablet(tab),
             "max_ts": self.coordinator.max_assigned(),
             "max_uid": self.coordinator._next_uid - 1,
+        }
+
+    def export_tablet_move(self, pred: str, nshards: int = 1,
+                           shard: Optional[int] = None) -> dict:
+        """Move/split snapshot at a catch-up base (the streaming move
+        path, ref worker/predicate_move.go streaming batches while the
+        source serves). Unlike export_tablet this does NOT require a
+        quiesced tablet: the payload carries base + any still-unfolded
+        deltas as of `snap_ts` = tab.max_commit_ts, and every commit
+        AFTER snap_ts reaches the destination through the CDC raw tail
+        (cdc/changelog.read_raw -> ("move_delta", ...) records). With
+        `shard` set, only the rows of that hash range ship
+        (cluster/shard.shard_view) — the split move's unit."""
+        from dgraph_tpu.storage.snapshot import dump_tablet
+        tab = self.tablets[pred]
+        if tab.dirty():
+            tab.rollup(self.fold_watermark())
+        view = tab
+        if shard is not None:
+            from dgraph_tpu.cluster.shard import shard_view
+            view = shard_view(tab, nshards, shard)
+        return {
+            "schema": tab.schema.describe(),
+            "tablet": dump_tablet(view),
+            "max_ts": self.coordinator.max_assigned(),
+            "max_uid": self.coordinator._next_uid - 1,
+            "snap_ts": tab.max_commit_ts,
+            # shard moves mark the destination split-partial on
+            # import: it holds a RANGE, not the whole predicate
+            "shard": None if shard is None else int(shard),
+            "nshards": int(nshards),
         }
 
     def device_is_accelerator(self) -> bool:

@@ -168,6 +168,11 @@ def dump_state(db) -> dict:
         "pending_txns": {ts: (list(ops), list(keys))
                          for ts, (ops, keys)
                          in db.pending_txns.items()},
+        # moved-away / split-partial tombstones: a member restoring
+        # this snapshot must keep answering stale-routed requests
+        # with a typed misroute, never silently-partial rows
+        "moved_out": dict(getattr(db, "moved_out", {})),
+        "split_partial": sorted(getattr(db, "split_partial", ())),
     }
 
 
@@ -192,6 +197,9 @@ def restore_state(payload: dict, db=None):
     db.pending_txns = {int(ts): (list(ops), list(keys))
                        for ts, (ops, keys)
                        in payload.get("pending_txns", {}).items()}
+    db.moved_out = {p: int(g) for p, g
+                    in payload.get("moved_out", {}).items()}
+    db.split_partial = set(payload.get("split_partial", ()))
     return db
 
 

@@ -76,6 +76,18 @@ SITES = (
     #                      ANN index trains over a clean base block
     #                      (error = build dies, exact tiers keep
     #                      serving; sleep = slow k-means)
+    "move.snapshot_chunk",  # cluster/service.py — source side, before
+    #                      one snapshot chunk of a live tablet move is
+    #                      served (sleep = slow stream; error = chunk
+    #                      delivery fails, the driver retries/re-begins)
+    "move.catchup",      # cluster/service.py   — destination side,
+    #                      before a CDC catch-up batch replicates
+    #                      (sleep = lag stays high, the fence defers)
+    "move.fence",        # cluster/service.py   — zero's driver, before
+    #                      the single-predicate write fence is proposed
+    "move.flip",         # cluster/service.py   — zero's driver, before
+    #                      the ownership flip commits (error/SIGKILL
+    #                      here = the crash-safety acceptance seam)
 )
 
 

@@ -97,6 +97,11 @@ REGISTERED = (
     "dgraph_ingest_shuffled_bytes_total",
     # cluster (cluster/transport.py)
     "raft_send_drops",
+    # live tablet moves / rebalancer (cluster/service.py ZeroServer)
+    "dgraph_move_catchup_lag",
+    "dgraph_move_duration_ms",
+    "dgraph_move_streamed_bytes_total",
+    "dgraph_tablet_moves_total",
     # network fault plane (utils/netfault.py)
     "dgraph_net_fault_delays_total",
     "dgraph_net_fault_drops_total",

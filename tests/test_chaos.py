@@ -421,7 +421,11 @@ def test_zero_leader_killed_mid_move_completes_on_new_leader():
             except RuntimeError:
                 time.sleep(0.3)
                 continue
-            if "mv_pred" not in tmap["moving"]:
+            # the replicated move LEDGER (not just the write-fence
+            # mark — the streaming path only fences during the short
+            # `fenced` phase) must drain before judging the outcome
+            if "mv_pred" not in tmap.get("moves", {}) \
+                    and "mv_pred" not in tmap["moving"]:
                 final = tmap["tablets"].get("mv_pred")
                 break
             time.sleep(0.3)

@@ -115,6 +115,15 @@ echo "== cdc smoke =="
 # mid-stream resume, and subscriber lag on /debug/stats
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.cdc_smoke
 
+echo "== rebalance smoke =="
+# ~30 s heat-driven rebalancing gate (tools/rebalance_smoke.py): a
+# deliberately skewed 2-group cluster under live open load; the
+# zero-side rebalancer must propose AND complete >=1 automatic tablet
+# move with ZERO load errors across the cutover and byte-parity of
+# final reads vs a quiesced single-process oracle replaying exactly
+# the acknowledged mutations.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.rebalance_smoke
+
 echo "== chaos smoke =="
 # ~45 s nemesis cycle on a 2-group mini cluster with durable dirs
 # (tools/dgchaos.py --smoke): one partition-heal + one SIGKILL-restart

@@ -591,9 +591,105 @@ class PartitionKill(Nemesis):
         self.kill.heal()
 
 
+class MoveUnderFire(Nemesis):
+    """Live-move the bank's balance tablet g1 -> g2 UNDER the
+    cross-group 2PC load, with both acceptance kills landed inside
+    the move: SIGKILL the DESTINATION group leader mid-snapshot
+    stream, then SIGKILL the ZERO leader mid-catch-up (delay rules on
+    zero's outbound hold each window open). The raft-persisted phase
+    ledger must resume the move to completion; heal waits for the
+    flip + source drop and then moves the tablet BACK (a second full
+    live move), restoring the cross-group shape for later phases.
+    The history checker proves conservation, acked-write durability
+    and no lost updates across BOTH cutovers; reads racing a flip
+    either see conserved balances or fail typed (misroute) — never
+    silently-empty parity mismatches."""
+
+    name = "move-under-fire"
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        from dgraph_tpu.cluster.client import ClusterClient
+        self._zc = ClusterClient(
+            dict(ctx["cluster"].zero_addrs), timeout=20.0)
+
+    def _ledger(self):
+        try:
+            got = self._zc.request({"op": "tablet_map"})
+        except Exception:  # noqa: BLE001 — zero mid-election  # dglint: disable=DG07 (nemesis poll; no request context)
+            return None
+        return got.get("result") if got.get("ok") else None
+
+    def _await_owner(self, dst: int, timeout_s: float = 60.0):
+        end = time.monotonic() + timeout_s
+        while time.monotonic() < end:
+            r = self._ledger()
+            if r is not None and "chaos.bal" not in r.get("moves", {}) \
+                    and r["tablets"].get("chaos.bal") == dst:
+                return
+            time.sleep(0.3)
+        raise RuntimeError(
+            f"chaos.bal move to g{dst} never completed")
+
+    def inject(self):
+        cluster = self.ctx["cluster"]
+        # hold the snapshot/catch-up windows open: delay every move
+        # RPC zero sends to the alpha groups (zero dials alphas ONLY
+        # to drive moves, so nothing else slows down)
+        zname = cluster.leader_of("zero")
+        dsts = [f"{h}:{p}" for g in sorted(cluster.group_addrs)
+                for (h, p) in cluster.group_addrs[g].values()]
+        self._fault(zname, {"action": "add", "rule": {
+            "dst": dsts, "delay_ms": 250.0, "jitter_ms": 100.0}})
+        resp = self._zc.request({"op": "move_request",
+                                 "args": ("chaos.bal", 2)})
+        if not (resp.get("ok") and resp.get("result")):
+            raise RuntimeError(f"move request refused: {resp}")
+        time.sleep(0.6)  # the delayed snapshot stream is in flight
+        victim = cluster.leader_of("g2")
+        log(f"{self.name}: SIGKILL {victim} mid-snapshot")
+        cluster.kill(victim)
+        time.sleep(0.5)
+        cluster.restart(victim)
+        cluster.wait_caught_up(victim)
+        # wait until the ledger shows the move past the snapshot,
+        # then take the zero leader down mid-catch-up
+        end = time.monotonic() + 30
+        while time.monotonic() < end:
+            r = self._ledger()
+            mv = (r or {}).get("moves", {}).get("chaos.bal")
+            if mv is None or mv["phase"] in ("catching_up", "fenced",
+                                             "flipped"):
+                break
+            time.sleep(0.1)
+        zname = cluster.leader_of("zero")
+        log(f"{self.name}: SIGKILL {zname} mid-catch-up")
+        cluster.kill(zname)
+        time.sleep(0.5)
+        cluster.restart(zname)
+        cluster.wait_caught_up(zname)
+
+    def heal(self):
+        self._clear_all()
+        try:
+            # the resumed driver must finish the interrupted move...
+            self._await_owner(2)
+            log(f"{self.name}: interrupted move completed on g2")
+            # ...and survive a SECOND full live move straight back,
+            # restoring the cross-group bank for later phases
+            resp = self._zc.request({"op": "move_request",
+                                     "args": ("chaos.bal", 1)})
+            if not (resp.get("ok") and resp.get("result")):
+                raise RuntimeError(f"move-back refused: {resp}")
+            self._await_owner(1)
+            log(f"{self.name}: moved back to g1")
+        finally:
+            self._zc.close()
+
+
 NEMESES = {cls.name: cls for cls in (
     PartitionRing, PartitionMajority, DelayStorm, KillLeader,
-    KillRandom, RollingRestart, PartitionKill)}
+    KillRandom, RollingRestart, PartitionKill, MoveUnderFire)}
 
 
 # ---------------------------------------------------------- CDC nemesis
@@ -808,7 +904,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--fault-s", type=float, default=8.0)
     ap.add_argument("--recover-s", type=float, default=15.0)
     ap.add_argument("--nemeses", default=(
-        "partition-majority,kill-leader,rolling-restart"),
+        "partition-majority,kill-leader,rolling-restart,"
+        "move-under-fire"),
         help=f"comma list from: {','.join(sorted(NEMESES))}")
     ap.add_argument("--ldbc-persons", type=int, default=60,
                     help="seeded LDBC-style noise graph size; 0 = "
@@ -858,6 +955,10 @@ def run_nemesis_phase(args, bank: Bank, nem: Nemesis, rng,
         n_alphas = sum(1 for n in nem.ctx["cluster"].node_addrs
                        if n.startswith("alpha-"))
         fault_s = max(args.fault_s, 10.0 * n_alphas)
+    elif nem.name == "move-under-fire":
+        # the fault window IS the interrupted move: two SIGKILL +
+        # restart + catch-up cycles inside one throttled move
+        fault_s = max(args.fault_s, 22.0)
     duration = args.pre_s + fault_s + args.recover_s
     n_ops = max(10, int(args.rate * duration))
     kinds = []
@@ -960,7 +1061,8 @@ def main(argv=None) -> int:
         args.rate = min(args.rate, 25.0)
         args.pre_s, args.fault_s, args.recover_s = 3.0, 4.0, 10.0
         args.ldbc_persons = 0
-        args.nemeses = "partition-majority,kill-leader,cdc"
+        args.nemeses = \
+            "partition-majority,kill-leader,move-under-fire,cdc"
         args.slo_ms = max(args.slo_ms, 2000.0)
     # the bank is cross-group BY CONSTRUCTION (bal on g1, ledger on
     # g2): fewer than two groups would silently drop the 2PC coverage

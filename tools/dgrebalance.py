@@ -1,0 +1,334 @@
+"""dgrebalance: throughput recovery after automatic heat rebalancing.
+
+The judge for ROADMAP item 4 / the million-user failure mode: a
+deliberately SKEWED placement (every tablet pinned to group 1, group
+2 idle) tanks throughput-at-p99-SLO; the zero-side heat-driven
+rebalancer must move tablets — automatically, under live load —
+until throughput recovers to >= 80% of the hand-balanced baseline.
+
+Three scenarios on identical 2-group clusters + seeded LDBC workload
+(tools/dgbench.py machinery: same open-loop driver, same
+binary-searched throughput-at-p99-SLO metric):
+
+  balanced   bundles claimed round-robin (dgbench's placement), no
+             rebalancer — the baseline every run is judged against
+  skewed     EVERYTHING claimed to group 1, no rebalancer — the
+             pinned-group failure mode, measured
+  recovered  the same skew, rebalancer armed: a live load heats the
+             tablets, the rebalancer moves them one by one (each a
+             full snapshot+catch-up+fence+flip), and ONLY after the
+             ledger settles is throughput searched again. Load
+             running THROUGH the moves must see zero non-shed errors
+             and byte-identical sampled reads vs a quiesced replay
+             (the during-moves parity gate).
+
+Writes BENCH_REBALANCE.json; exit non-zero if recovery < 80% of the
+balanced baseline, any during-move error, or any parity mismatch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from dgraph_tpu.bench.spawn import ProcessCluster          # noqa: E402
+from dgraph_tpu.bench.workload import (                    # noqa: E402
+    Workload, WorkloadConfig,
+)
+from tools.dgbench import (                                # noqa: E402
+    Driver, claim_tablets, load_graph, log, phase_report, run_phase,
+)
+
+
+def claim_skewed(rc, w: Workload):
+    """The failure mode: every tablet on group 1 (the viral-predicate
+    pin, taken to its worst case — group 2 completely idle)."""
+    placement = {}
+    for pred in sorted({p.split(":")[0].strip()
+                        for p in w.schema().splitlines() if p.strip()}):
+        placement[pred] = rc.zero.tablet(pred, 1)
+    return placement
+
+
+def search_qps(rc, w, args, label: str, phase_base: int) -> dict:
+    """Binary-search offered load for throughput-at-p99-SLO (the
+    dgbench metric, compacted)."""
+    driver = Driver(rc, args.deadline_ms, os.urandom(5).hex())
+    for op in w.ops(30, stream_seed=997):
+        if not op.write:
+            driver.submit(phase_base + 0x70, 0, op)  # warm
+    probe = [op for op in w.ops(300, stream_seed=998)
+             if not op.write][:90]
+    nxt, plock = [0], threading.Lock()
+
+    def worker():
+        while True:
+            with plock:
+                i = nxt[0]
+                if i >= len(probe):
+                    return
+                nxt[0] += 1
+            driver.submit(phase_base + 0x71, i, probe[i])
+
+    t0 = time.monotonic()
+    ts = [threading.Thread(target=worker)
+          for _ in range(args.concurrency)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    capacity = len(probe) / (time.monotonic() - t0)
+    lo, hi, best, phases = 0.0, capacity * 1.5, None, []
+    for ix in range(args.max_phases):
+        rate = capacity * 0.7 if ix == 0 else (lo + hi) / 2
+        ops = w.ops(args.ops_per_phase, stream_seed=ix + 1)
+        ph = run_phase(driver, ops, phase_base + ix, rate,
+                       args.concurrency)
+        rep = phase_report(ph, args.slo_ms, args.error_budget)
+        phases.append(rep)
+        log(f"  [{label}] {rate:.0f} qps offered -> p99="
+            f"{rep['p99_ms']}ms ok_qps={rep['ok_qps']} "
+            f"passed={rep['passed']}")
+        if rep["passed"] and (best is None
+                              or rep["offered_qps"]
+                              > best["offered_qps"]):
+            best = rep
+        if rep["passed"]:
+            lo = rate
+        else:
+            hi = rate
+    return {"best": best, "phases": phases,
+            "capacity_qps": round(capacity, 1)}
+
+
+def run_scenario(args, w, label: str, skewed: bool,
+                 rebalance: bool) -> dict:
+    zero_args, env = [], {}
+    if rebalance:
+        zero_args = ["--rebalance-interval", "2.0",
+                     "--rebalance-band", "1.25",
+                     "--move-fence-timeout-s", "5.0",
+                     # cross-group vector search is unsupported: the
+                     # vector predicate and the attribute its
+                     # similar_to queries select stay welded (the
+                     # documented --rebalance-pin colocation knob)
+                     "--rebalance-pin",
+                     "person.embedding,person.name"]
+        env = {"DGRAPH_TPU_HEAT_INTERVAL_S": "1.0"}
+    log(f"=== scenario {label}: skewed={skewed} "
+        f"rebalancer={'on' if rebalance else 'off'}")
+    with ProcessCluster(groups=2, replicas=1, zeros=1,
+                        max_pending=args.max_pending,
+                        zero_args=zero_args, env_extra=env,
+                        cpus_per_group=args.cpus_per_group) as cluster:
+        cluster.wait_ready(90)
+        rc = cluster.routed()
+        try:
+            rc.alter(w.schema())
+            placement = claim_skewed(rc, w) if skewed \
+                else claim_tablets(rc, 2, w)
+            n_quads = load_graph(rc, w)
+            log(f"  [{label}] loaded {n_quads} quads; placement "
+                f"groups: { {g: sum(1 for v in placement.values() if v == g) for g in (1, 2)} }")
+
+            move_window = None
+            if rebalance:
+                move_window = _heat_until_settled(args, rc, w)
+
+            res = search_qps(rc, w, args, label, 0x10)
+            res["label"] = label
+            res["placement_initial"] = placement
+            res["tablet_map_final"] = rc.tablet_map()["tablets"]
+            res["moves_window"] = move_window
+            return res
+        finally:
+            rc.close()
+
+
+def _heat_until_settled(args, rc, w) -> dict:
+    """Drive a fixed-rate load while the rebalancer works; return the
+    during-moves scoreboard (errors, sampled-read parity, moves
+    observed). Settled = the ledger has been empty and the placement
+    unchanged for `quiet_s`."""
+    driver = Driver(rc, args.deadline_ms, os.urandom(5).hex(),
+                    sample_every=5)
+    reads = [op for op in w.ops(4000, stream_seed=555)
+             if not op.write]
+    stop = threading.Event()
+    recs: list[tuple] = []
+    rlock = threading.Lock()
+
+    def loader(worker_ix: int):
+        i = worker_ix
+        while not stop.is_set():
+            op = reads[i % len(reads)]
+            rec = driver.submit(0x60, i, op)
+            with rlock:
+                recs.append((i, op, rec))
+            i += args.heat_concurrency
+            time.sleep(max(0.0, args.heat_concurrency
+                           / max(args.heat_rate, 1.0)))
+
+    threads = [threading.Thread(target=loader, args=(k,), daemon=True)
+               for k in range(args.heat_concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    moves_seen: set = set()
+    last_change = time.monotonic()
+    last_map: dict = {}
+    while time.monotonic() - t0 < args.settle_timeout_s:
+        try:
+            m = rc.tablet_map()
+        except RuntimeError:
+            time.sleep(0.5)
+            continue
+        for pred, mv in m.get("moves", {}).items():
+            moves_seen.add((pred, mv["src"], mv["dst"]))
+        if m["tablets"] != last_map or m.get("moves"):
+            last_map = dict(m["tablets"])
+            last_change = time.monotonic()
+        elif moves_seen and \
+                time.monotonic() - last_change > args.quiet_s:
+            break
+        time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+
+    outcomes: dict[str, int] = {}
+    errors = []
+    for _, _, rec in recs:
+        outcomes[rec["outcome"]] = outcomes.get(rec["outcome"], 0) + 1
+        if rec["outcome"] == "error" and len(errors) < 5:
+            errors.append(rec.get("error", "?"))
+    # parity: every sampled under-load read byte-compared against a
+    # quiesced replay (the seeded read set is immutable, so replay is
+    # the oracle; a mid-move read serving a half-moved tablet would
+    # have sampled wrong/empty bytes)
+    time.sleep(0.5)
+    checked = mismatched = 0
+    for i, op, rec in recs:
+        if "data" not in rec:
+            continue
+        try:
+            oracle = json.dumps(rc.query(op.query).get("data"),
+                                sort_keys=True)
+        except Exception as e:  # noqa: BLE001
+            oracle = f"<replay failed: {e}>"
+        checked += 1
+        if oracle != rec["data"]:
+            mismatched += 1
+    return {"moves": sorted(moves_seen), "outcomes": outcomes,
+            "errors_sample": errors,
+            "parity_checked": checked,
+            "parity_mismatched": mismatched,
+            "wall_s": round(time.monotonic() - t0, 1)}
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="dgrebalance", description=__doc__.split("\n\n")[0])
+    ap.add_argument("--persons", type=int, default=240)
+    ap.add_argument("--seed", type=int, default=20260804)
+    ap.add_argument("--concurrency", type=int, default=24)
+    ap.add_argument("--ops-per-phase", type=int, default=420)
+    ap.add_argument("--max-phases", type=int, default=5)
+    ap.add_argument("--slo-ms", type=float, default=400.0)
+    ap.add_argument("--deadline-ms", type=int, default=2000)
+    ap.add_argument("--error-budget", type=float, default=0.01)
+    ap.add_argument("--max-pending", type=int, default=48)
+    ap.add_argument("--heat-rate", type=float, default=120.0,
+                    help="fixed read rate while the rebalancer works")
+    ap.add_argument("--heat-concurrency", type=int, default=8)
+    ap.add_argument("--settle-timeout-s", type=float, default=90.0)
+    ap.add_argument("--quiet-s", type=float, default=6.0,
+                    help="ledger empty + placement stable this long "
+                         "= rebalancing settled")
+    ap.add_argument("--recovery-target", type=float, default=0.8)
+    ap.add_argument("--cpus-per-group", type=int, default=0,
+                    help="pin each alpha group to its own disjoint "
+                         "CPU set (0 = auto: a third of the host's "
+                         "cores per group, so the two groups + the "
+                         "driver don't share silicon). One shared box "
+                         "otherwise makes placement capacity-neutral "
+                         "and the bench meaningless.")
+    ap.add_argument("--out", default=os.path.join(
+        _REPO, "BENCH_REBALANCE.json"))
+    args = ap.parse_args(argv)
+    if not args.cpus_per_group:
+        try:
+            args.cpus_per_group = max(
+                1, len(os.sched_getaffinity(0)) // 3)
+        except AttributeError:  # non-Linux: no affinity emulation
+            args.cpus_per_group = 0
+    log(f"cpus_per_group={args.cpus_per_group}")
+
+    w = Workload(WorkloadConfig(seed=args.seed, persons=args.persons))
+    t0 = time.monotonic()
+    balanced = run_scenario(args, w, "balanced", skewed=False,
+                            rebalance=False)
+    skewed = run_scenario(args, w, "skewed", skewed=True,
+                          rebalance=False)
+    recovered = run_scenario(args, w, "recovered", skewed=True,
+                             rebalance=True)
+
+    def qps(res):
+        return res["best"]["ok_qps"] if res["best"] else 0.0
+
+    mw = recovered["moves_window"] or {}
+    ratio = qps(recovered) / max(qps(balanced), 1e-9)
+    summary = {
+        "metric": "rebalance_recovered_frac_of_balanced",
+        "value": round(ratio, 3),
+        "unit": "frac",
+        "balanced_qps": qps(balanced),
+        "skewed_qps": qps(skewed),
+        "recovered_qps": qps(recovered),
+        "slo_ms": args.slo_ms,
+        "automatic_moves": mw.get("moves", []),
+        "during_moves_outcomes": mw.get("outcomes", {}),
+        "during_moves_parity_checked": mw.get("parity_checked", 0),
+        "during_moves_parity_mismatched": mw.get(
+            "parity_mismatched", -1),
+        "persons": args.persons, "seed": args.seed,
+        "recovery_target": args.recovery_target,
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+    with open(args.out, "w") as f:
+        json.dump({"summary": summary,
+                   "balanced": balanced, "skewed": skewed,
+                   "recovered": recovered}, f, indent=1,
+                  sort_keys=True)
+    print(json.dumps(summary))
+
+    bad = []
+    if not mw.get("moves"):
+        bad.append("rebalancer made no automatic move")
+    if ratio < args.recovery_target:
+        bad.append(f"recovered {ratio:.2f} < "
+                   f"{args.recovery_target} of balanced")
+    oc = mw.get("outcomes", {})
+    if oc.get("error") or oc.get("deadline"):
+        bad.append(f"during-move errors: {oc} "
+                   f"{mw.get('errors_sample')}")
+    if mw.get("parity_mismatched", -1) != 0 \
+            or not mw.get("parity_checked"):
+        bad.append(f"parity: {mw.get('parity_mismatched')}/"
+                   f"{mw.get('parity_checked')}")
+    if bad:
+        log("REBALANCE BENCH FAILED: " + "; ".join(bad))
+        return 1
+    log("rebalance bench ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

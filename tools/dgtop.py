@@ -177,6 +177,47 @@ def ingest_cdc_rows(snaps: dict[str, dict],
     return nodes, subs
 
 
+def moves_rows(snaps: dict[str, dict]) -> list[dict]:
+    """The MOVES panel's rows: active tablet moves/splits from zero's
+    /debug/stats `moves` ledger payload (pred, src -> dst, phase,
+    bytes streamed, catch-up lag, fence ms), plus settled split
+    routing from `splits`. Pure — tests drive it with canned
+    payloads. Non-zero nodes (no `moves` key) contribute nothing;
+    the panel disappears when no move is in flight."""
+    rows = []
+    for node in sorted(snaps):
+        snap = snaps[node]
+        if snap is None:
+            continue
+        for pred, mv in sorted((snap["stats"].get("moves")
+                                or {}).items()):
+            rows.append({
+                "node": node, "pred": pred,
+                "src": mv.get("src"), "dst": mv.get("dst"),
+                "phase": mv.get("phase", "?"),
+                "shard": mv.get("shard"),
+                "bytes": mv.get("bytes", 0),
+                "lag": mv.get("lag"),
+                "fence_ms": mv.get("fence_ms"),
+            })
+    return rows
+
+
+def split_rows(snaps: dict[str, dict]) -> list[dict]:
+    """Settled hash-range splits (zero /debug/stats `splits`): the
+    sub-tablet routing a read fans out over."""
+    rows = []
+    for node in sorted(snaps):
+        snap = snaps[node]
+        if snap is None:
+            continue
+        for pred, ent in sorted((snap["stats"].get("splits")
+                                 or {}).items()):
+            rows.append({"node": node, "pred": pred,
+                         "owners": [int(g) for g in ent["owners"]]})
+    return rows
+
+
 def planner_rows(snaps: dict[str, dict],
                  prev: Optional[dict[str, dict]] = None) -> list[dict]:
     """The PLANNER panel's rows: per-node tier-decision mix (from
@@ -338,6 +379,27 @@ def render(snaps: dict[str, dict],
             lines.append(
                 f"{s['id'] + ' @ ' + s['node']:<40} "
                 f"{s['pred']:<20.20} {s['offset']:>12} {s['lag']:>6}")
+    mrows = moves_rows(snaps)
+    if mrows:
+        lines.append("")
+        lines.append(f"{'MOVES':<28} {'SRC>DST':>8} {'PHASE':<13} "
+                     f"{'SHARD':>5} {'BYTES':>10} {'LAG':>6} "
+                     f"{'FENCEMS':>8}")
+        for r in mrows:
+            arrow = f"{r['src']}>{r['dst']}"
+            lines.append(
+                f"{r['pred'] + ' @ ' + r['node']:<28.28} {arrow:>8} "
+                f"{r['phase']:<13.13} {_fmt(r['shard']):>5} "
+                f"{r['bytes']:>10} {_fmt(r['lag']):>6} "
+                f"{_fmt(r['fence_ms']):>8}")
+    srows = split_rows(snaps)
+    if srows:
+        lines.append("")
+        lines.append(f"{'SPLIT TABLETS':<28} {'OWNERS (shard i -> group)':<40}")
+        for r in srows:
+            owners = ",".join(str(g) for g in r["owners"])
+            lines.append(f"{r['pred'] + ' @ ' + r['node']:<28.28} "
+                         f"{owners:<40.40}")
     plan = planner_rows(snaps, prev)
     if plan:
         lines.append("")

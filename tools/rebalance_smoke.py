@@ -1,0 +1,167 @@
+"""Rebalance smoke (~30 s): heat-driven tablet moves on a live cluster.
+
+Boots a deliberately SKEWED 2-group ProcessCluster — every tablet
+claimed to group 1, group 2 empty — with the zero-side heat-driven
+rebalancer armed at a fast tick, then runs an open write/read load
+while the rebalancer works. The gate asserts, non-negotiably:
+
+  1. the rebalancer PROPOSES AND COMPLETES at least one automatic
+     tablet move under live load (ledger drains, ownership on g2);
+  2. zero load errors across every cutover — the typed-misroute
+     re-route and the bounded fence retry make moves invisible;
+  3. BYTE-PARITY of the final reads vs a quiesced single-process
+     oracle (an embedded GraphDB replaying exactly the acknowledged
+     mutations): no acknowledged write may be lost or duplicated by
+     snapshot+catch-up+flip.
+
+Exit 0 = pass. Wired into tools/check.sh.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+PREDS = [f"rb.p{i}" for i in range(4)]
+SCHEMA = "\n".join(f"{p}: string @index(exact) ." for p in PREDS)
+
+
+def _canon(out: dict) -> str:
+    return json.dumps(out.get("data", {}), sort_keys=True)
+
+
+def golden_queries(q):
+    """q(query_text) -> canonical JSON per golden query."""
+    outs = []
+    for p in PREDS:
+        outs.append(_canon(q('{ q(func: has(%s)) { %s } }' % (p, p))))
+        outs.append(_canon(q('{ q(func: eq(%s, "v3")) { uid %s } }'
+                            % (p, p))))
+    return outs
+
+
+def main() -> int:
+    from dgraph_tpu.bench.spawn import ProcessCluster
+
+    t0 = time.monotonic()
+    with ProcessCluster(
+            groups=2, replicas=1, zeros=1,
+            zero_args=["--rebalance-interval", "1.5",
+                       "--rebalance-band", "1.2",
+                       "--move-fence-timeout-s", "5.0"],
+            env_extra={"DGRAPH_TPU_HEAT_INTERVAL_S": "1.0"}) as pc:
+        pc.wait_ready()
+        rc = pc.routed()
+        try:
+            rc.alter(SCHEMA)
+            for p in PREDS:  # the deliberate skew: everything on g1
+                got = rc.zero.tablet(p, 1)
+                assert got == 1, f"{p} claimed by {got}"
+            acked: list[tuple[str, int, str]] = []
+            for i in range(20):
+                for p in PREDS:
+                    uid = 0x1000 + len(acked)
+                    rc.mutate(set_nquads=f'<{hex(uid)}> <{p}> '
+                              f'"v{i}" .')
+                    acked.append((p, uid, f"v{i}"))
+
+            stop = threading.Event()
+            errors: list[str] = []
+            lock = threading.Lock()
+
+            def writer():
+                i = 20
+                while not stop.is_set():
+                    i += 1
+                    p = PREDS[i % len(PREDS)]
+                    uid = 0x8000 + i
+                    try:
+                        rc.mutate(set_nquads=f'<{hex(uid)}> <{p}> '
+                                  f'"w{i}" .')
+                        with lock:
+                            acked.append((p, uid, f"w{i}"))
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(f"write {p}: {e}")
+                    time.sleep(0.02)
+
+            def reader():
+                j = 0
+                while not stop.is_set():
+                    j += 1
+                    p = PREDS[j % 2]  # heat concentrates on p0/p1
+                    try:
+                        rc.query('{ q(func: has(%s)) { uid } }' % p)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(f"read {p}: {e}")
+
+            threads = [threading.Thread(target=writer, daemon=True)] \
+                + [threading.Thread(target=reader, daemon=True)
+                   for _ in range(2)]
+            for t in threads:
+                t.start()
+
+            moved = []
+            deadline = time.monotonic() + 45.0
+            while time.monotonic() < deadline:
+                try:
+                    m = rc.tablet_map()
+                except RuntimeError:
+                    time.sleep(0.3)
+                    continue
+                moved = [p for p in PREDS
+                         if m["tablets"].get(p) == 2]
+                if moved and not m.get("moves"):
+                    break
+                time.sleep(0.5)
+            stop.set()
+            for t in threads:
+                # the writer's worst case is a full misroute/fence
+                # retry budget plus one RPC timeout — joining short
+                # of that would snapshot `acked` while a straggler's
+                # mutate can still commit and append, a false parity
+                # failure in CI
+                t.join(timeout=60)
+            if any(t.is_alive() for t in threads):
+                print("FAIL: load thread wedged past the retry budget")
+                return 1
+
+            if errors:
+                print(f"FAIL: {len(errors)} load errors through the "
+                      f"cutover; first: {errors[0]}")
+                return 1
+            if not moved:
+                print("FAIL: rebalancer completed no automatic move "
+                      "in 45s")
+                return 1
+
+            # quiesced oracle: an embedded engine replaying exactly
+            # the acknowledged mutations — byte parity or bust
+            from dgraph_tpu.engine.db import GraphDB
+            oracle = GraphDB(prefer_device=False)
+            oracle.alter(SCHEMA)
+            with lock:
+                final = list(acked)
+            for p, uid, val in final:
+                oracle.mutate(set_nquads=f'<{hex(uid)}> <{p}> '
+                              f'"{val}" .')
+            got = golden_queries(lambda q: rc.query(q))
+            want = golden_queries(lambda q: oracle.query(q))
+            if got != want:
+                for g, w in zip(got, want):
+                    if g != w:
+                        print(f"FAIL parity:\n  cluster {g[:300]}\n"
+                              f"  oracle  {w[:300]}")
+                return 1
+            print(f"ok: {len(moved)} automatic move(s) {moved} under "
+                  f"{len(final)} acked writes, 0 load errors, "
+                  f"byte-parity vs quiesced oracle "
+                  f"({time.monotonic() - t0:.1f}s)")
+            return 0
+        finally:
+            rc.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
