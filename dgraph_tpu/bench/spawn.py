@@ -96,6 +96,7 @@ class ProcessCluster:
         # it's the out-of-band control/observation channel)
         self.node_addrs: dict[str, dict[str, tuple[str, int]]] = {}
         self._node_args: dict[str, list[str]] = {}
+        self._node_env: dict[str, dict] = {}
         self._logs: dict[str, object] = {}
         self._env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
             "JAX_PLATFORMS", "cpu"), PYTHONPATH=_REPO)
@@ -167,6 +168,9 @@ class ProcessCluster:
                 args = args + ["--wal",
                                os.path.join(self.data_dir, name)]
             self._node_args[name] = list(args)
+        env = self._env
+        if name in self._node_env:
+            env = dict(env, **self._node_env[name])
         if self.log_dir:
             # append mode: a restarted node's pre-crash log survives
             log = open(os.path.join(self.log_dir, name + ".log"), "a")
@@ -194,7 +198,7 @@ class ProcessCluster:
         self.procs[name] = subprocess.Popen(
             [sys.executable, "-m", "dgraph_tpu", "node"]
             + self._node_args[name] + self._tick,
-            env=self._env, cwd=_REPO, preexec_fn=preexec,
+            env=env, cwd=_REPO, preexec_fn=preexec,
             stdout=subprocess.DEVNULL, stderr=log)
 
     # ------------------------------------------------------------ clients
@@ -282,14 +286,23 @@ class ProcessCluster:
                 p.kill()
                 p.wait()
 
-    def restart(self, name: str):
+    def restart(self, name: str,
+                extra_env: Optional[dict] = None):
         """Reboot a dead node with its ORIGINAL args — same ports,
         same --wal dir. Without data_dir the node comes back empty and
         relies on the raft snapshot transfer from its peers; with it,
-        DiskStorage replays the persisted log + snapshot first."""
+        DiskStorage replays the persisted log + snapshot first.
+
+        `extra_env` overlays the node's environment for THIS and
+        every later restart — the rolling-upgrade nemesis reboots
+        each node with a bumped DGRAPH_TPU_BUILD_VERSION to simulate
+        a new binary (the version surfaces on hello/debug stats;
+        format and protocol stay min()-negotiated)."""
         p = self.procs.get(name)
         if p is not None and p.poll() is None:
             raise RuntimeError(f"{name} is still running; kill() first")
+        if extra_env:
+            self._node_env.setdefault(name, {}).update(extra_env)
         self._spawn(name, self._node_args[name])
 
     def _quorum_of(self, name: str) -> dict[int, tuple[str, int]]:

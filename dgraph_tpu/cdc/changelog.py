@@ -70,11 +70,18 @@ class OffsetTruncated(Exception):
     read the predicate at `resync_ts`, resubscribe from
     offset_for_ts(resync_ts)."""
 
-    def __init__(self, pred: str, offset: int, floor: int):
+    def __init__(self, pred: str, offset: int, floor: int,
+                 resync_ts: Optional[int] = None):
         self.pred = pred
         self.offset = offset
         self.floor = floor
-        self.resync_ts = floor >> _IDX_BITS
+        # carried EXPLICITLY end to end: both error surfaces (HTTP 410
+        # `resyncTs` and the wire `truncated` payload) ship it, and a
+        # client re-raising from the wire passes it through rather
+        # than re-deriving from the floor — the server's derivation is
+        # the single source of truth
+        self.resync_ts = (floor >> _IDX_BITS) if resync_ts is None \
+            else int(resync_ts)
         super().__init__(
             f"offset {offset} for {pred!r} predates the change log "
             f"floor {floor}; re-sync: snapshot-read at ts "

@@ -213,7 +213,9 @@ def cmd_node(args) -> int:
             rebalance_band=args.rebalance_band,
             split_heat=args.split_heat,
             rebalance_pin=args.rebalance_pin,
-            rebalance_cooldown_s=args.rebalance_cooldown_s, **kw)
+            rebalance_cooldown_s=args.rebalance_cooldown_s,
+            standby_of=_parse_peers(args.standby_of)
+            if getattr(args, "standby_of", "") else None, **kw)
     print(f"dgraph-tpu {args.kind} node {args.id}: raft "
           f"{peers[args.id]}, client {srv.client_addr}"
           + (f", debug http {args.debug_host}:{args.debug_port}"
@@ -256,13 +258,20 @@ def cmd_backup(args) -> int:
 
 def cmd_restore(args) -> int:
     """Restore a backup chain into a fresh store
-    (ref `dgraph restore` -> ee/backup/restore.go)."""
+    (ref `dgraph restore` -> ee/backup/restore.go). With --to-ts,
+    point-in-time restore: the chain base plus the captured change
+    tail replayed up to that exact commit_ts (storage/backup.py
+    restore_to_ts; docs/deployment.md "Disaster recovery")."""
     from dgraph_tpu.engine.db import GraphDB
-    from dgraph_tpu.storage.backup import restore
+    from dgraph_tpu.storage.backup import restore, restore_to_ts
 
     db = GraphDB(wal_path=args.wal or None, prefer_device=False,
                  enc_key=_enc_key(args))
-    restore(args.location, db=db, key=_enc_key(args))
+    if args.to_ts:
+        restore_to_ts(args.location, args.to_ts, db=db,
+                      key=_enc_key(args))
+    else:
+        restore(args.location, db=db, key=_enc_key(args))
     if args.snapshot_out:
         from dgraph_tpu.storage.snapshot import save_snapshot
         save_snapshot(db, args.snapshot_out)
@@ -697,6 +706,37 @@ def cmd_compose(args) -> int:
     return 0
 
 
+def cmd_standby(args) -> int:
+    """Standby-cluster admin against the STANDBY's zero quorum
+    (cluster/replication.py): `status` prints per-predicate
+    replication lag; `promote` fails the standby over to a writable
+    primary — fencing the old primary, draining to its post-fence CDC
+    heads, and reporting measured RPO/RTO (docs/deployment.md
+    "Disaster recovery & upgrades")."""
+    from dgraph_tpu.cluster.client import ClusterClient
+
+    zero = ClusterClient(_parse_peers(args.zero), timeout=60.0)
+    try:
+        if args.standby_op == "status":
+            out = zero._unwrap(zero.request({"op": "repl_status"}))
+            print(json.dumps(out, indent=2))
+            return 0
+        out = zero.request({"op": "standby_promote",
+                            "force": args.force})
+        if not out.get("ok"):
+            print(f"promote failed: {out.get('error')}",
+                  file=sys.stderr)
+            return 1
+        res = out["result"]
+        print(json.dumps(res, indent=2))
+        print(f"promoted: rpo_clean={res['rpo_clean']} "
+              f"drained={res['rpo_commits_drained']} commits, "
+              f"rto={res['rto_ms']}ms", file=sys.stderr)
+        return 0
+    finally:
+        zero.close()
+
+
 def cmd_rebalance(args) -> int:
     """Tablet rebalancing (ref zero/tablet.go:62 rebalanceTablets; the
     reference runs it inside zero every --rebalance_interval 8m). Takes
@@ -831,6 +871,10 @@ def main(argv=None) -> int:
                     help="WAL path for the restored store")
     rs.add_argument("--snapshot_out", default="",
                     help="also write a snapshot file")
+    rs.add_argument("--to-ts", dest="to_ts", type=int, default=0,
+                    help="point-in-time restore: materialize the "
+                         "state at this commit_ts (any covered "
+                         "instant, not just backup boundaries)")
     rs.add_argument("--encryption_key_file", default="")
     rs.set_defaults(fn=cmd_restore)
 
@@ -974,6 +1018,15 @@ def main(argv=None) -> int:
                    help="zero only: a just-moved tablet is frozen "
                         "this long so the heat EWMA re-equilibrates "
                         "instead of thrashing it back")
+    n.add_argument("--standby-of", default="",
+                   help="zero only: run this cluster as an async-"
+                        "replication STANDBY tailing the primary "
+                        "whose zero quorum listens at these client "
+                        "addrs (id=host:port,...). The standby boots "
+                        "write-fenced (client writes refused, typed); "
+                        "`dgraph-tpu standby promote` fails over with "
+                        "measured RPO/RTO (docs/deployment.md "
+                        "\"Disaster recovery & upgrades\")")
     n.add_argument("--split-heat", type=float, default=0.0,
                    help="zero only: heat EWMA past which a group-"
                         "dominating predicate splits into hash-range "
@@ -1017,6 +1070,22 @@ def main(argv=None) -> int:
     co.add_argument("--base-port", type=int, default=7000)
     co.add_argument("--out", default="cluster.sh")
     co.set_defaults(fn=cmd_compose)
+
+    sb = sub.add_parser("standby",
+                        help="async-replication standby admin "
+                             "(status / promote)")
+    sb.add_argument("standby_op", choices=["status", "promote"],
+                    help="status: per-predicate replication lag; "
+                         "promote: fail over to a writable primary "
+                         "with measured RPO/RTO")
+    sb.add_argument("--zero", required=True,
+                    help="the STANDBY cluster's zero client addrs "
+                         "(id=host:port,...)")
+    sb.add_argument("--force", action="store_true",
+                    help="promote even if the primary is unreachable "
+                         "(accepts losing the unreplicated tail; "
+                         "RPO reported as unclean)")
+    sb.set_defaults(fn=cmd_standby)
 
     rb = sub.add_parser("rebalance",
                         help="tablet rebalancer (zero/tablet.go:62)")

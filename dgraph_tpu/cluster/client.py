@@ -495,8 +495,23 @@ class ClusterClient:
         if not resp.get("ok") and resp.get("truncated"):
             from dgraph_tpu.cdc.changelog import OffsetTruncated
             t = resp["truncated"]
-            raise OffsetTruncated(t["pred"], int(offset), t["floor"])
+            # the wire payload carries the server-derived resync ts
+            # explicitly (same camelCase key as the HTTP 410 surface);
+            # legacy servers sent only the floor — derive as before
+            raise OffsetTruncated(
+                t["pred"], int(offset), t["floor"],
+                resync_ts=t.get("resyncTs", t.get("resync_ts")))
         return self._unwrap(resp)
+
+    def hello(self, protocol_version: Optional[int] = None) -> dict:
+        """Version negotiation (storage/versions.py): returns the
+        serving node's {protocol, format, build, negotiated} where
+        `negotiated` = min(server's protocol, ours)."""
+        from dgraph_tpu.storage.versions import PROTOCOL_VERSION
+        pv = PROTOCOL_VERSION if protocol_version is None \
+            else int(protocol_version)
+        return self._unwrap(self.request(
+            {"op": "hello", "protocol_version": pv}))
 
     def status(self, node: Optional[int] = None) -> dict:
         if node is not None:
@@ -542,6 +557,14 @@ class ClusterClient:
                 raise TabletMisrouted(m.get("pred", "?"),
                                       m.get("group"),
                                       resp.get("error", ""))
+            if resp.get("fenced"):
+                # the whole cluster refuses client writes (replication
+                # standby / fenced old primary) — typed and NOT
+                # retryable here: the client must re-point at the
+                # active primary
+                from dgraph_tpu.cluster.errors import WriteFenced
+                raise WriteFenced(resp["fenced"].get("phase", ""),
+                                  resp.get("error", ""))
             if resp.get("deadline_expired"):
                 # the caller's budget died in the routing loop (e.g.
                 # an election outlasted it) — same typed outcome as a

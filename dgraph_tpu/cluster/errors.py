@@ -27,3 +27,22 @@ class TabletMisrouted(RuntimeError):
             msg or f"tablet {pred!r} is not served here"
             + (f" (moved to group {group})" if group else "")
             + "; refresh the tablet map and re-route")
+
+
+class WriteFenced(RuntimeError):
+    """The WHOLE cluster refuses client writes: it is a replication
+    standby (state arrives only through the replication stream,
+    cluster/replication.py) or a fenced old primary after a standby
+    promotion. Reads keep serving. NOT retryable against this
+    cluster — the client must re-point at the promoted primary.
+
+    Crosses the wire as {"ok": False, "fenced": {"phase"}}
+    (cluster/service.py _client_loop -> cluster/client.py _unwrap)."""
+
+    def __init__(self, phase: str = "", msg: str = ""):
+        self.phase = phase
+        super().__init__(
+            msg or "cluster is write-fenced"
+            + (f" (replication phase {phase!r})" if phase else "")
+            + ": client writes are refused; "
+            "direct writes at the active primary")

@@ -28,7 +28,7 @@ from dgraph_tpu import wire
 from dgraph_tpu.cluster.raft import (
     FOLLOWER, GOODBYE, LEADER, Msg, RaftNode, VOTE_REQ,
 )
-from dgraph_tpu.cluster.errors import TabletMisrouted
+from dgraph_tpu.cluster.errors import TabletMisrouted, WriteFenced
 from dgraph_tpu.cluster.transport import TcpTransport
 from dgraph_tpu.utils import failpoint, metrics, netfault, tracing
 from dgraph_tpu.utils.logger import log
@@ -490,6 +490,13 @@ class RaftServer:
                     resp = {"ok": False, "error": str(e),
                             "misrouted": {"pred": e.pred,
                                           "group": e.group}}
+                except WriteFenced as e:
+                    # typed: the client must re-point at the active
+                    # primary, not retry here (async replication —
+                    # standbys and fenced old primaries refuse ALL
+                    # client writes)
+                    resp = {"ok": False, "error": str(e),
+                            "fenced": {"phase": e.phase}}
                 except RequestAborted as e:
                     # cancellation/deadline crosses the wire TYPED:
                     # ClusterClient._unwrap maps `aborted` back to the
@@ -1185,6 +1192,12 @@ class AlphaServer(RaftServer):
         if not tmap.get("ok"):
             raise RuntimeError("zero unreachable; cannot verify "
                                "tablet ownership")
+        if tmap["result"].get("fence"):
+            # cluster-wide client-write fence (async replication):
+            # this cluster is a standby — or the fenced old primary
+            # after a promotion. Replication applies never come here
+            # (move_apply/repl_install replicate records directly).
+            raise WriteFenced(tmap["result"].get("repl_phase", ""))
         tablets = tmap["result"]["tablets"]
         moving = tmap["result"]["moving"]
         splits = tmap["result"].get("splits", {})
@@ -1929,9 +1942,12 @@ class AlphaServer(RaftServer):
                                       limit=int(req.get("limit", 512)))
             except OffsetTruncated as e:
                 # the bounded log evicted past the destination's
-                # base: the driver must re-snapshot from a newer one
+                # base: the driver must re-snapshot from a newer one.
+                # `resyncTs` matches the HTTP 410 spelling; the
+                # snake_case twin stays for older clients
                 return {"ok": False, "error": str(e),
                         "truncated": {"pred": e.pred, "floor": e.floor,
+                                      "resyncTs": e.resync_ts,
                                       "resync_ts": e.resync_ts}}
             if req.get("shard") is not None:
                 from dgraph_tpu.cluster.shard import filter_ops
@@ -2037,6 +2053,35 @@ class AlphaServer(RaftServer):
             return {"ok": True, "result": {
                 "max_commit_ts": int(payload["tablet"]
                                      .get("max_commit_ts", 0))}}
+        if op == "repl_install":
+            # cross-cluster replication install (cluster/replication
+            # .py): same staged-chunk assembly as move_install but
+            # WITHOUT the zero move-ledger check — the STANDBY's zero
+            # has no move entry for a replicated tablet; its cluster-
+            # wide write fence is what keeps client writes out, and
+            # replication applies land through the replicated-record
+            # path below, never the ownership check
+            import zlib
+            pred = req["pred"]
+            snap_ts = int(req["snap_ts"])
+            with self.lock:
+                st = self._move_staging.get(pred)
+                whole = st is not None and st["snap_ts"] == snap_ts \
+                    and len(st["chunks"]) >= st["total"]
+                blob = b"".join(st["chunks"][i]
+                                for i in range(st["total"])) \
+                    if whole else b""
+            if not whole:
+                return {"ok": False, "restage": True, "error":
+                        f"staging for {pred!r}@{snap_ts} incomplete "
+                        "(standby leader changed?); re-stream"}
+            payload = wire.loads(zlib.decompress(blob))
+            self._replicate_record(("import_tablet", pred, payload))
+            with self.lock:
+                self._move_staging.pop(pred, None)
+            return {"ok": True, "result": {
+                "max_commit_ts": int(payload["tablet"]
+                                     .get("max_commit_ts", 0))}}
         if op == "move_apply":
             # catch-up batches landing on the destination, replicated
             # as ONE move_delta record (idempotent: the replicated
@@ -2099,11 +2144,24 @@ class AlphaServer(RaftServer):
             except OffsetTruncated as e:
                 # typed on the wire so ClusterClient.subscribe can
                 # re-raise it (not a generic RuntimeError): the
-                # re-sync path is client logic
+                # re-sync path is client logic. `resyncTs` matches the
+                # HTTP 410 spelling (one documented key on BOTH
+                # surfaces); the snake_case twin stays for old clients
                 return {"ok": False, "error": str(e),
                         "truncated": {"pred": e.pred,
                                       "floor": e.floor,
+                                      "resyncTs": e.resync_ts,
                                       "resync_ts": e.resync_ts}}
+            return {"ok": True, "result": out}
+        if op == "hello":
+            # connection-time version negotiation (storage/versions):
+            # both sides speak min(protocol)s; the format + build
+            # stamps let a rolling upgrade observe the fleet's spread
+            from dgraph_tpu.storage.versions import negotiate, \
+                versions_payload
+            out = versions_payload()
+            out["negotiated"] = negotiate(
+                int(req.get("protocol_version", 0)))
             return {"ok": True, "result": out}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
@@ -2116,12 +2174,14 @@ class AlphaServer(RaftServer):
         from dgraph_tpu.utils import reqlog
         with self.lock:
             db = self.db
+        from dgraph_tpu.storage.versions import versions_payload
         stats = db.debug_stats()
         stats["node"] = self.node_name
         stats["group"] = self.group
         stats["requests"] = reqlog.snapshot()
         stats["netfault"] = netfault.rules()
         stats["lastHeard"] = self.peer_ages()
+        stats["versions"] = versions_payload()
         return stats
 
     def health_payload(self) -> dict:
@@ -2157,7 +2217,8 @@ class ZeroServer(RaftServer):
                  rebalance_band: float = 1.4,
                  split_heat: float = 0.0,
                  rebalance_pin: str = "",
-                 rebalance_cooldown_s: float = 120.0, **kw):
+                 rebalance_cooldown_s: float = 120.0,
+                 standby_of=None, **kw):
         from dgraph_tpu.cluster.zero import ZeroState
         self.state = ZeroState()
         self.node_name = f"zero-n{node_id}"
@@ -2195,6 +2256,17 @@ class ZeroServer(RaftServer):
         if self.rebalance_interval_s > 0:
             threading.Thread(target=self._rebalance_loop, daemon=True,
                              name=f"zero-rebalance-{node_id}").start()
+        # cross-cluster async replication: this zero quorum fronts a
+        # STANDBY cluster tailing the primary at `standby_of` (the
+        # primary zero's client addrs). Leader-only, like the move
+        # driver; the replicated repl_phase/write_fence let a new
+        # leader resume (cluster/replication.py)
+        self.repl = None
+        if standby_of:
+            from dgraph_tpu.cluster.replication import ReplicationDriver
+            self.repl = ReplicationDriver(self, dict(standby_of))
+            threading.Thread(target=self.repl.run, daemon=True,
+                             name=f"zero-repl-{node_id}").start()
 
     def _group_client(self, gid: int):
         """ClusterClient to an alpha group from the membership
@@ -2676,7 +2748,12 @@ class ZeroServer(RaftServer):
                                in self.state.splits.items()},
                     "moves": {p: dict(m) for p, m
                               in self.state.move_queue.items()},
-                    "sizes": dict(self.state.sizes)}}
+                    "sizes": dict(self.state.sizes),
+                    # cluster-wide client-write fence + replication
+                    # role — every alpha write consults this map, so
+                    # the fence takes effect on the NEXT write
+                    "fence": self.state.write_fence,
+                    "repl_phase": self.state.repl_phase}}
         if op == "cluster_state":
             # membership introspection (ref zero /state) — exposes the
             # split sub-tablet routing and per-tablet heat too
@@ -2695,7 +2772,7 @@ class ZeroServer(RaftServer):
                   "tablet_move_start", "tablet_move_done",
                   "tablet_move_abort", "move_request", "move_phase",
                   "tablet_size", "tablet_sizes", "tablet_heat",
-                  "connect"):
+                  "connect", "set_write_fence", "repl_phase"):
             with self.lock:
                 if self.node.role != LEADER:
                     raise NotLeader(self.node.leader_id)
@@ -2704,6 +2781,43 @@ class ZeroServer(RaftServer):
             if not ok:
                 return {"ok": False, "error": "no quorum"}
             return {"ok": True, "result": result}
+        if op == "repl_status":
+            # per-predicate replication lag (standby zero leader —
+            # the driver's progress is leader-local observability)
+            if self.repl is None:
+                with self.lock:
+                    return {"ok": True, "result": {
+                        "phase": self.state.repl_phase,
+                        "fence": self.state.write_fence,
+                        "preds": {}}}
+            out = self.repl.lag_payload()
+            with self.lock:
+                out["fence"] = self.state.write_fence
+            return {"ok": True, "result": out}
+        if op == "standby_promote":
+            # measured-RPO/RTO failover: fence the primary, drain to
+            # its post-fence CDC heads, flip this cluster writable
+            if self.repl is None:
+                return {"ok": False, "error":
+                        "this zero is not a standby (--standby-of)"}
+            with self.lock:
+                if self.node.role != LEADER:
+                    raise NotLeader(self.node.leader_id)
+            from dgraph_tpu.cluster.replication import PromoteError
+            try:
+                out = self.repl.promote(
+                    force=bool(req.get("force", False)))
+            except PromoteError as e:
+                return {"ok": False, "error": str(e)}
+            return {"ok": True, "result": out}
+        if op == "hello":
+            # same negotiation surface as alphas (storage/versions)
+            from dgraph_tpu.storage.versions import negotiate, \
+                versions_payload
+            out = versions_payload()
+            out["negotiated"] = negotiate(
+                int(req.get("protocol_version", 0)))
+            return {"ok": True, "result": out}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
     def debug_stats_payload(self) -> dict:
@@ -2711,7 +2825,9 @@ class ZeroServer(RaftServer):
         enriched with the leader's driver progress (bytes streamed,
         catch-up lag, fence clock) and the heat table — what the dgtop
         MOVES panel renders."""
+        from dgraph_tpu.storage.versions import versions_payload
         out = super().debug_stats_payload()
+        out["versions"] = versions_payload()
         with self.lock:
             moves = {p: dict(m) for p, m
                      in self.state.move_queue.items()}
@@ -2731,4 +2847,15 @@ class ZeroServer(RaftServer):
                     (time.monotonic() - prog["fence_started"]) * 1e3, 1)
         out["moves"] = moves
         out["role"] = role
+        with self.lock:
+            phase = self.state.repl_phase
+            fence = self.state.write_fence
+        if self.repl is not None:
+            out["replication"] = self.repl.lag_payload()
+            out["replication"]["fence"] = fence
+        elif phase or fence:
+            # a fenced/promoted cluster without a driver (an old
+            # primary after failover) still surfaces its role
+            out["replication"] = {"phase": phase, "fence": fence,
+                                  "preds": {}}
         return out

@@ -54,6 +54,18 @@ Commands (payload = (op, args)):
                                          under the replica target, or
                                          founds a new one
                                          (zero/zero.go:410 Connect)
+  ("set_write_fence", (on,))          -> the CLUSTER-WIDE client-write
+                                         fence (async replication:
+                                         standbys boot fenced; a
+                                         promotion fences the old
+                                         primary). Replication applies
+                                         bypass it — they land through
+                                         the replicated-record path,
+                                         not the ownership check.
+  ("repl_phase", (phase,))            -> replication role transition:
+                                         "" (normal primary) ->
+                                         "standby" -> "promoting" ->
+                                         "promoted" (now primary)
 """
 
 from __future__ import annotations
@@ -104,6 +116,14 @@ class ZeroState:
         # alpha registry: key (raft "host:port") -> member record
         # (zero/zero.go membership state)
         self.alphas: dict[str, dict] = {}
+        # cross-cluster async replication (cluster/replication.py):
+        # write_fence refuses ALL client writes cluster-wide (standby
+        # clusters; a fenced old primary after promotion); repl_phase
+        # is the replicated role so a new zero leader resumes the
+        # standby loop — or stays promoted — exactly where the old
+        # one died
+        self.write_fence = False
+        self.repl_phase = ""
 
     # ------------------------------------------------------------- apply
 
@@ -317,6 +337,16 @@ class ZeroState:
                     0.5 * self.heat.get(pred, 0.0)
                     + 0.5 * float(dt) * scale, 3)
             return True
+        if op == "set_write_fence":
+            (on,) = args
+            self.write_fence = bool(on)
+            return self.write_fence
+        if op == "repl_phase":
+            (phase,) = args
+            if phase not in ("", "standby", "promoting", "promoted"):
+                return False
+            self.repl_phase = str(phase)
+            return True
         if op == "connect":
             key, want_group, want_id, raft_addr, client_addr, \
                 replicas = args
@@ -417,6 +447,8 @@ class ZeroState:
                 "splits": {k: dict(v) for k, v in self.splits.items()},
                 "sizes": dict(self.sizes),
                 "heat": dict(self.heat),
+                "write_fence": self.write_fence,
+                "repl_phase": self.repl_phase,
                 "alphas": {k: dict(v) for k, v in self.alphas.items()}}
 
     @classmethod
@@ -436,6 +468,8 @@ class ZeroState:
                      for k, v in snap.get("splits", {}).items()}
         st.sizes = dict(snap.get("sizes", {}))
         st.heat = dict(snap.get("heat", {}))
+        st.write_fence = bool(snap.get("write_fence", False))
+        st.repl_phase = str(snap.get("repl_phase", ""))
         st.alphas = {k: dict(v)
                      for k, v in snap.get("alphas", {}).items()}
         return st

@@ -154,10 +154,15 @@ def dump_state(db) -> dict:
     fold first where the watermark allows; whatever must stay unfolded
     (active txns / pinned readers hold the watermark) ships inside
     dump_tablet's deltas, so the payload is complete either way."""
+    from dgraph_tpu.storage.versions import FORMAT_VERSION
     db.rollup_all(window=0)
     tablets = {pred: dump_tablet(tab)
                for pred, tab in db.tablets.items()}
     return {
+        # at-rest format stamp (storage/versions.py): payloads written
+        # before the stamp existed carry no key and load as version 0
+        # — the pinned legacy contract (tests/test_format_version.py)
+        "format_version": FORMAT_VERSION,
         "schema": db.schema.describe_all(),
         "tablets": tablets,
         "max_ts": db.coordinator.max_assigned(),
@@ -177,9 +182,14 @@ def dump_state(db) -> dict:
 
 
 def restore_state(payload: dict, db=None):
-    """State payload -> GraphDB (fresh one by default)."""
+    """State payload -> GraphDB (fresh one by default). Refuses
+    payloads stamped NEWER than this build understands (typed
+    UnsupportedFormat); unstamped legacy payloads are version 0 and
+    restore identically."""
     from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu.storage.versions import check_format
 
+    check_format(payload.get("format_version", 0), "snapshot payload")
     db = db or GraphDB()
     db.alter(payload["schema"])
     for pred, st in payload["tablets"].items():

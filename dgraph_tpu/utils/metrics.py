@@ -102,6 +102,10 @@ REGISTERED = (
     "dgraph_move_duration_ms",
     "dgraph_move_streamed_bytes_total",
     "dgraph_tablet_moves_total",
+    # cross-cluster async replication (cluster/replication.py)
+    "dgraph_repl_lag_entries",
+    "dgraph_repl_promote_rto_ms",
+    "dgraph_repl_streamed_bytes_total",
     # network fault plane (utils/netfault.py)
     "dgraph_net_fault_delays_total",
     "dgraph_net_fault_drops_total",

@@ -235,3 +235,43 @@ def test_node_row_runtime_gauge_columns():
     row = node_row(_snap(), None)
     assert row["rss_mb"] is None and row["threads"] is None
     assert "RSSMB" in render({"n1": _snap()})
+
+
+def test_replication_rows_phases_and_lag():
+    """The REPLICATION panel: standby lag rows, role-only rows for a
+    fenced old primary, and unsupported (split) predicates flagged."""
+    from tools.dgtop import replication_rows
+    standby = _snap()
+    standby["stats"]["replication"] = {
+        "phase": "standby", "fence": True, "primary_reachable": True,
+        "preds": {"rp.name": {"lag": 3, "applied_ts": 40,
+                              "lag_s": 0.5},
+                  "split.p": {"unsupported": "split predicate "
+                              "(replicate before splitting)"}}}
+    old_primary = _snap()
+    old_primary["stats"]["replication"] = {
+        "phase": "", "fence": True, "preds": {}}
+    rows = replication_rows({"zero-s": standby, "zero-p": old_primary,
+                             "plain": _snap(), "down": None})
+    assert [r["node"] for r in rows] == ["zero-p", "zero-s", "zero-s"]
+    assert rows[0] == {"node": "zero-p", "phase": "fenced",
+                       "fence": True, "primary_ok": None,
+                       "pred": None, "lag": None, "applied_ts": None,
+                       "lag_s": None}
+    assert rows[1]["pred"] == "rp.name" and rows[1]["lag"] == 3
+    assert rows[1]["phase"] == "standby" and rows[1]["fence"] is True
+    assert rows[1]["primary_ok"] is True
+    assert "unsupported" in rows[2] and rows[2]["pred"] == "split.p"
+
+
+def test_replication_panel_renders():
+    snap = _snap()
+    snap["stats"]["replication"] = {
+        "phase": "standby", "fence": True, "primary_reachable": True,
+        "preds": {"rp.name": {"lag": 0, "applied_ts": 40,
+                              "lag_s": 0.28}}}
+    frame = render({"zero-n1": snap})
+    assert "REPLICATION" in frame and "rp.name @ zero-n1" in frame
+    assert "standby" in frame and "up" in frame
+    # an ordinary primary has no panel at all
+    assert "REPLICATION" not in render({"zero-n1": _snap()})

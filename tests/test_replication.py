@@ -1,0 +1,187 @@
+"""Cross-cluster async replication: standby tailing + promotion.
+
+Unit tier: the replicated fence/role commands on ZeroState (the bits
+a new standby zero leader resumes from) and the typed WriteFenced
+contract. Process tier: a real standby ProcessCluster tailing a real
+primary through the move surface (cluster/replication.py), the
+whole-cluster write fence, and a clean measured-RPO/RTO promotion.
+"""
+
+import json
+import time
+
+import pytest
+
+from dgraph_tpu.cluster.errors import WriteFenced
+from dgraph_tpu.cluster.zero import ZeroState
+
+# ------------------------------------------------------------- unit
+
+
+def test_write_fence_command_round_trips():
+    z = ZeroState()
+    assert z.write_fence is False and z.repl_phase == ""
+    assert z.apply(("set_write_fence", (True,))) is True
+    assert z.write_fence is True
+    assert z.apply(("set_write_fence", (False,))) is False
+    assert z.write_fence is False
+
+
+def test_repl_phase_walk_and_invalid_refused():
+    z = ZeroState()
+    for phase in ("standby", "promoting", "promoted", ""):
+        assert z.apply(("repl_phase", (phase,))) is True
+        assert z.repl_phase == phase
+    # an unknown role must not replicate garbage into the state
+    # machine every follower applies
+    assert z.apply(("repl_phase", ("primary-ish",))) is False
+    assert z.repl_phase == ""
+
+
+def test_fence_and_phase_survive_snapshot():
+    z = ZeroState()
+    z.apply(("set_write_fence", (True,)))
+    z.apply(("repl_phase", ("standby",)))
+    z2 = ZeroState.from_snapshot(z.snapshot())
+    assert z2.write_fence is True and z2.repl_phase == "standby"
+    # pre-replication snapshots (no keys) default to unfenced primary
+    snap = z.snapshot()
+    del snap["write_fence"], snap["repl_phase"]
+    z3 = ZeroState.from_snapshot(snap)
+    assert z3.write_fence is False and z3.repl_phase == ""
+
+
+def test_write_fenced_is_typed():
+    e = WriteFenced("standby")
+    assert e.phase == "standby"
+    assert isinstance(e, RuntimeError)
+    assert "standby" in str(e) and "write-fenced" in str(e)
+
+
+# ------------------------------------------------------------ process
+
+
+@pytest.fixture(scope="module")
+def dr_pair(tmp_path_factory):
+    """A 1-group primary with data, plus a standby cluster booted
+    with --standby-of pointing at the primary's zero quorum."""
+    from dgraph_tpu.bench.spawn import ProcessCluster
+    logs = tmp_path_factory.mktemp("dr-logs")
+    with ProcessCluster(groups=1, replicas=1, zeros=1,
+                        log_dir=str(logs / "primary")) as primary:
+        primary.wait_ready()
+        prc = primary.routed()
+        prc.alter("rp.name: string @index(exact) .")
+        for i in range(20):
+            prc.mutate(
+                set_nquads=f'<{hex(0x10 + i)}> <rp.name> "v{i}" .')
+        spec = ",".join(f"{i}={h}:{p}" for i, (h, p)
+                        in primary.zero_addrs.items())
+        with ProcessCluster(groups=1, replicas=1, zeros=1,
+                            zero_args=["--standby-of", spec],
+                            log_dir=str(logs / "standby")) as standby:
+            standby.wait_ready()
+            src = standby.routed()
+            try:
+                yield primary, prc, standby, src
+            finally:
+                src.close()
+                prc.close()
+
+
+def _repl_status(standby):
+    from dgraph_tpu.cluster.client import ClusterClient
+    sz = ClusterClient(standby.zero_addrs, timeout=30.0)
+    try:
+        return sz._unwrap(sz.request({"op": "repl_status"}))
+    finally:
+        sz.close()
+
+
+def _wait_caught_up(standby, pred, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    st = {}
+    while time.monotonic() < deadline:
+        st = _repl_status(standby)
+        prog = st.get("preds", {}).get(pred, {})
+        if st.get("phase") == "standby" and prog.get("lag") == 0:
+            return st
+        time.sleep(0.3)
+    raise AssertionError(f"standby never caught up: {st}")
+
+
+def test_standby_tails_primary_and_reports_lag(dr_pair):
+    primary, prc, standby, src = dr_pair
+    st = _wait_caught_up(standby, "rp.name")
+    prog = st["preds"]["rp.name"]
+    # the resume point is the standby tablet's own commit watermark
+    assert prog["applied_ts"] > 0
+    assert prog["lag_s"] is not None and prog["lag_s"] >= 0
+    assert st["primary_reachable"] is True and st["fence"] is True
+    # new primary commits stream over without a re-snapshot
+    prc.mutate(set_nquads='<0x40> <rp.name> "tail-1" .')
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        got = src.query('{ q(func: has(rp.name)) { rp.name } }')
+        vals = {r["rp.name"] for r in got["data"]["q"]}
+        if "tail-1" in vals:
+            break
+        time.sleep(0.2)
+    assert "tail-1" in vals, sorted(vals)
+    # full read parity at lag 0
+    _wait_caught_up(standby, "rp.name")
+    got = src.query('{ q(func: has(rp.name)) { rp.name } }')
+    vals = {r["rp.name"] for r in got["data"]["q"]}
+    assert vals == {f"v{i}" for i in range(20)} | {"tail-1"}
+
+
+def test_standby_refuses_client_writes_typed(dr_pair):
+    primary, prc, standby, src = dr_pair
+    _wait_caught_up(standby, "rp.name")
+    with pytest.raises(WriteFenced) as ei:
+        src.mutate(set_nquads='<0x99> <rp.name> "nope" .')
+    assert ei.value.phase == "standby"
+    # ...and the lag surfaces on the zero's /debug/stats for dgtop
+    import urllib.request
+    url = standby.debug_urls["zero-n1"] + "/debug/stats"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        payload = json.loads(r.read())
+    repl = payload.get("replication")
+    assert repl and repl["phase"] == "standby" and repl["fence"]
+    assert "rp.name" in repl["preds"]
+
+
+def test_promote_measures_rpo_rto_and_flips_roles(dr_pair):
+    """The failover: fence the primary, drain to its post-fence CDC
+    head, flip. A clean promote loses ZERO acked commits."""
+    from dgraph_tpu.cluster.client import ClusterClient
+    primary, prc, standby, src = dr_pair
+    _wait_caught_up(standby, "rp.name")
+    # a burst the drain must pick up after the fence lands
+    for i in range(5):
+        prc.mutate(set_nquads=f'<{hex(0x50 + i)}> <rp.name> "b{i}" .')
+    sz = ClusterClient(standby.zero_addrs, timeout=60.0)
+    try:
+        res = sz._unwrap(sz.request({"op": "standby_promote"}))
+    finally:
+        sz.close()
+    assert res["promoted"] is True and res["rpo_clean"] is True
+    assert res["rto_ms"] > 0
+    assert res["preds"]["rp.name"]["drained_to_head"] > 0
+    # every acked commit made it: byte-for-byte set parity
+    got = src.query('{ q(func: has(rp.name)) { rp.name } }')
+    vals = {r["rp.name"] for r in got["data"]["q"]}
+    assert {f"b{i}" for i in range(5)} <= vals
+    # the promoted cluster accepts writes...
+    src.mutate(set_nquads='<0x999> <rp.name> "post-promote" .')
+    got = src.query('{ q(func: eq(rp.name, "post-promote")) { uid } }')
+    assert got["data"]["q"], got
+    # ...the old primary refuses them (split-brain guard), typed
+    with pytest.raises(WriteFenced):
+        prc.mutate(set_nquads='<0x998> <rp.name> "stale" .')
+    # and the old primary's map shows the fence for operators
+    m = prc.tablet_map()
+    assert m["fence"] is True
+    # promotion is visible in repl_status on the new primary
+    st = _repl_status(standby)
+    assert st["phase"] == "promoted" and st["fence"] is False

@@ -124,6 +124,18 @@ echo "== rebalance smoke =="
 # the acknowledged mutations.
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.rebalance_smoke
 
+echo "== dr smoke =="
+# ~30 s disaster-recovery gate (tools/dr_smoke.py): point-in-time
+# restore byte-parity vs the full-log oracle at >= 3 non-boundary
+# commit_ts, a REAL standby cluster tailing a live primary to lag 0,
+# and a measured-RPO/RTO promotion (clean: zero acked commits lost,
+# old primary fenced). Exit non-zero on any parity/RPO/fence failure.
+DR_DIR="${TMPDIR:-/tmp}/dr-smoke"
+rm -rf "$DR_DIR"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.dr_smoke \
+    --report-dir "$DR_DIR" --out "$DR_DIR/BENCH_DR.json"
+test -s "$DR_DIR/BENCH_DR.json"
+
 echo "== chaos smoke =="
 # ~45 s nemesis cycle on a 2-group mini cluster with durable dirs
 # (tools/dgchaos.py --smoke): one partition-heal + one SIGKILL-restart

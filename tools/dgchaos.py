@@ -36,7 +36,9 @@ Nemeses (composable by name on --nemeses): partition-ring,
 partition-majority, partition-client, delay-storm (network faults via
 the {"op":"fault"} wire control -> utils/netfault.py on each node),
 kill-leader, kill-random, rolling-restart (SIGKILL + reboot onto the
-node's existing WAL dirs via ProcessCluster.kill/restart), and
+node's existing WAL dirs via ProcessCluster.kill/restart),
+rolling-upgrade (the roll with a bumped DGRAPH_TPU_BUILD_VERSION per
+reboot — the mixed-version fleet drill, storage/versions.py), and
 partition-kill (composite). Each nemesis phase runs pre -> inject ->
 heal -> recovery under one open-loop arrival schedule, and the report
 (BENCH_CHAOS.json) records per-phase unavailability window,
@@ -570,6 +572,57 @@ class RollingRestart(Nemesis):
         pass
 
 
+class RollingUpgrade(Nemesis):
+    """The rolling-upgrade drill (docs/deployment.md runbook order):
+    every node — zeros FIRST, then alphas — is SIGKILLed and rebooted
+    onto its WAL dirs with a BUMPED build version
+    (DGRAPH_TPU_BUILD_VERSION via ProcessCluster.restart extra_env),
+    waiting for raft catch-up between victims. The bank load keeps
+    running through the whole roll, so the cluster serves a
+    MIXED-VERSION fleet for most of the window; each rebooted node's
+    `hello` must advertise the new build (the upgrade actually landed,
+    storage/versions.py) and the history checker proves no acked
+    write was lost to any handoff. The fault IS the heal."""
+
+    name = "rolling-upgrade"
+    NEW_BUILD = "vnext-chaos-upgrade"
+
+    def inject(self):
+        cluster = self.ctx["cluster"]
+        # zeros first: the oracle/placement plane upgrades before the
+        # data plane, so new-build alphas never talk DOWN to an older
+        # zero (min() negotiation makes either order safe; the
+        # runbook picks one so drills match production)
+        names = sorted(cluster.node_addrs,
+                       key=lambda n: (not n.startswith("zero-"), n))
+        for name in names:
+            log(f"{self.name}: upgrading {name}")
+            cluster.kill(name)
+            time.sleep(0.5)
+            cluster.restart(name, extra_env={
+                "DGRAPH_TPU_BUILD_VERSION": self.NEW_BUILD})
+            cluster.wait_caught_up(name)
+            # _rpc_once is single-shot: the first attempt after a
+            # reboot may burn on the client's stale pooled socket from
+            # the PRE-kill process (dropped on failure), so retry until
+            # a fresh dial answers the hello
+            build, end = None, time.monotonic() + 30.0
+            while time.monotonic() < end:
+                got = self.ctx["node_clients"][name]._rpc_once(
+                    1, {"op": "hello"})
+                build = ((got or {}).get("result") or {}).get("build")
+                if build == self.NEW_BUILD:
+                    break
+                time.sleep(0.5)
+            if build != self.NEW_BUILD:
+                raise RuntimeError(
+                    f"{name} rebooted on build {build!r}, expected "
+                    f"{self.NEW_BUILD!r}")
+
+    def heal(self):
+        pass
+
+
 class PartitionKill(Nemesis):
     """Composite: partition-ring, then kill group 1's leader inside
     the partition — recovery must untangle both at heal."""
@@ -689,7 +742,8 @@ class MoveUnderFire(Nemesis):
 
 NEMESES = {cls.name: cls for cls in (
     PartitionRing, PartitionMajority, DelayStorm, KillLeader,
-    KillRandom, RollingRestart, PartitionKill, MoveUnderFire)}
+    KillRandom, RollingRestart, RollingUpgrade, PartitionKill,
+    MoveUnderFire)}
 
 
 # ---------------------------------------------------------- CDC nemesis
@@ -904,7 +958,7 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--fault-s", type=float, default=8.0)
     ap.add_argument("--recover-s", type=float, default=15.0)
     ap.add_argument("--nemeses", default=(
-        "partition-majority,kill-leader,rolling-restart,"
+        "partition-majority,kill-leader,rolling-upgrade,"
         "move-under-fire"),
         help=f"comma list from: {','.join(sorted(NEMESES))}")
     ap.add_argument("--ldbc-persons", type=int, default=60,
@@ -955,6 +1009,10 @@ def run_nemesis_phase(args, bank: Bank, nem: Nemesis, rng,
         n_alphas = sum(1 for n in nem.ctx["cluster"].node_addrs
                        if n.startswith("alpha-"))
         fault_s = max(args.fault_s, 10.0 * n_alphas)
+    elif nem.name == "rolling-upgrade":
+        # cycles EVERY node (zeros too)
+        n_nodes = len(nem.ctx["cluster"].node_addrs)
+        fault_s = max(args.fault_s, 10.0 * n_nodes)
     elif nem.name == "move-under-fire":
         # the fault window IS the interrupted move: two SIGKILL +
         # restart + catch-up cycles inside one throttled move
@@ -1061,8 +1119,8 @@ def main(argv=None) -> int:
         args.rate = min(args.rate, 25.0)
         args.pre_s, args.fault_s, args.recover_s = 3.0, 4.0, 10.0
         args.ldbc_persons = 0
-        args.nemeses = \
-            "partition-majority,kill-leader,move-under-fire,cdc"
+        args.nemeses = ("partition-majority,kill-leader,"
+                        "move-under-fire,rolling-upgrade,cdc")
         args.slo_ms = max(args.slo_ms, 2000.0)
     # the bank is cross-group BY CONSTRUCTION (bal on g1, ledger on
     # g2): fewer than two groups would silently drop the 2PC coverage

@@ -203,6 +203,43 @@ def moves_rows(snaps: dict[str, dict]) -> list[dict]:
     return rows
 
 
+def replication_rows(snaps: dict[str, dict]) -> list[dict]:
+    """The REPLICATION panel's rows: the cluster's standby/failover
+    posture from zero's /debug/stats `replication` payload — phase
+    (standby/promoting/promoted, or a fenced old primary), the
+    client-write fence, primary reachability, and per-predicate lag
+    (change-log entries behind + seconds since last fully caught up,
+    the operator's live RPO estimate). Pure — tests drive it with
+    canned payloads. Nodes with no replication role contribute
+    nothing; the panel disappears on an ordinary primary."""
+    rows = []
+    for node in sorted(snaps):
+        snap = snaps[node]
+        if snap is None:
+            continue
+        repl = snap["stats"].get("replication")
+        if not repl:
+            continue
+        base = {"node": node, "phase": repl.get("phase") or "fenced",
+                "fence": bool(repl.get("fence")),
+                "primary_ok": repl.get("primary_reachable")}
+        preds = repl.get("preds") or {}
+        if not preds:
+            # role row with no per-pred progress yet (a standby that
+            # has not seen a tablet, or a fenced old primary)
+            rows.append(dict(base, pred=None, lag=None,
+                             applied_ts=None, lag_s=None))
+            continue
+        for pred, ent in sorted(preds.items()):
+            row = dict(base, pred=pred, lag=ent.get("lag"),
+                       applied_ts=ent.get("applied_ts"),
+                       lag_s=ent.get("lag_s"))
+            if "unsupported" in ent:
+                row["unsupported"] = ent["unsupported"]
+            rows.append(row)
+    return rows
+
+
 def split_rows(snaps: dict[str, dict]) -> list[dict]:
     """Settled hash-range splits (zero /debug/stats `splits`): the
     sub-tablet routing a read fans out over."""
@@ -392,6 +429,24 @@ def render(snaps: dict[str, dict],
                 f"{r['phase']:<13.13} {_fmt(r['shard']):>5} "
                 f"{r['bytes']:>10} {_fmt(r['lag']):>6} "
                 f"{_fmt(r['fence_ms']):>8}")
+    rrows = replication_rows(snaps)
+    if rrows:
+        lines.append("")
+        lines.append(f"{'REPLICATION':<34} {'PHASE':<10} {'FENCE':>5} "
+                     f"{'PRIMARY':>7} {'LAG':>7} {'LAG_S':>7} "
+                     f"{'APPLIED':>9}")
+        for r in rrows:
+            who = (f"{r['pred']} @ {r['node']}" if r["pred"]
+                   else r["node"])
+            primary = {True: "up", False: "down",
+                       None: "-"}[r["primary_ok"]]
+            lag = ("UNSUP" if "unsupported" in r
+                   else _fmt(r["lag"], nd=0))
+            lines.append(
+                f"{who:<34.34} {r['phase']:<10.10} "
+                f"{'on' if r['fence'] else 'off':>5} {primary:>7} "
+                f"{lag:>7} {_fmt(r['lag_s'], nd=2):>7} "
+                f"{_fmt(r['applied_ts'], nd=0):>9}")
     srows = split_rows(snaps)
     if srows:
         lines.append("")

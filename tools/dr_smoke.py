@@ -1,0 +1,343 @@
+"""dr_smoke: the disaster-recovery gate — PITR parity + failover drill.
+
+Three phases, each producing measured numbers for BENCH_DR.json
+(docs/deployment.md "Disaster recovery & upgrades" runbook):
+
+  restore      point-in-time restore byte-parity: a full+incremental
+               backup chain taken MID-INGEST, then restore_to_ts at
+               >= 3 non-boundary commit_ts, each byte-compared
+               (wire.dumps(dump_tablet) + CDC heads) against an
+               oracle that replays the full raw change log through
+               the replicated move_delta apply path
+               (storage/backup.py; tests/test_pitr.py is the unit
+               twin of this live gate).
+
+  replication  a REAL standby ProcessCluster boots with --standby-of
+               the primary's zero quorum, snapshots + tails every
+               tablet through the move surface
+               (cluster/replication.py), and converges to lag 0;
+               then a write burst lands and `standby_promote` runs —
+               the drill records time-to-catch-up, steady lag, and
+               the promotion's measured RPO (commits drained after
+               the primary fence; MUST be clean) and RTO (fence ->
+               writable). Post-promote, every acked primary write
+               must be readable on the promoted cluster and the old
+               primary must refuse writes typed (WriteFenced).
+
+  upgrade      (--full only) the checker-gated rolling-upgrade drill:
+               tools/dgchaos.py --nemeses rolling-upgrade under the
+               cross-group bank — every node rebooted one at a time
+               onto a bumped DGRAPH_TPU_BUILD_VERSION with zero
+               history-checker violations. Its summary is embedded in
+               BENCH_DR.json; the chaos --smoke gate runs the same
+               nemesis in CI.
+
+Usage:
+  python -m tools.dr_smoke                  # CI gate, ~30 s
+  python -m tools.dr_smoke --full           # + rolling-upgrade phase
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def log(msg: str):
+    sys.stderr.write(f"[dr_smoke] {msg}\n")
+    sys.stderr.flush()
+
+
+# ----------------------------------------------------------- phase: restore
+
+
+def run_restore_phase(tmp: str) -> dict:
+    """Mid-ingest backup chain; restore to >= 3 non-boundary
+    commit_ts; byte-parity vs the full-log oracle."""
+    from dgraph_tpu import wire
+    from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu.storage.backup import backup, restore_to_ts
+    from dgraph_tpu.storage.snapshot import dump_tablet
+
+    def fresh():
+        db = GraphDB(prefer_device=False)
+        db.alter("dr.name: string @index(exact) .\n"
+                 "dr.friend: [uid] @reverse .")
+        return db
+
+    def tablet_bytes(db):
+        db.rollup_all(window=0)
+        return {p: wire.dumps(dump_tablet(t))
+                for p, t in sorted(db.tablets.items())}
+
+    dest = os.path.join(tmp, "backup")
+    db = fresh()
+    for i in range(10):
+        db.mutate(set_nquads=(f'_:u <dr.name> "user-{i}" .\n'
+                              f'_:u <dr.friend> _:v .\n'
+                              f'_:v <dr.name> "peer-{i}" .'))
+    e1 = backup(db, dest)
+    for i in range(10, 20):
+        db.mutate(set_nquads=f'_:u <dr.name> "user-{i}" .')
+    e2 = backup(db, dest)
+
+    raw = {p: [(int(ts), list(ops)) for ts, ops
+               in db.cdc.read_raw(p, after=0,
+                                  limit=100000)["batches"]]
+           for p in db.tablets}
+    tss = sorted({ts for b in raw.values() for ts, _ in b})
+    in_w1 = [t for t in tss if t < e1["read_ts"]]
+    in_w2 = [t for t in tss if e1["read_ts"] < t < e2["read_ts"]]
+    targets = [in_w1[len(in_w1) // 3], in_w1[-1], in_w2[0],
+               in_w2[len(in_w2) // 2]]
+
+    from dgraph_tpu.cdc.changelog import offset_for_ts
+
+    points = []
+    for to_ts in targets:
+        t0 = time.monotonic()
+        got = restore_to_ts(dest, to_ts,
+                            db=GraphDB(prefer_device=False))
+        ms = round((time.monotonic() - t0) * 1000, 1)
+        oracle = fresh()
+        for pred, batches in raw.items():
+            sel = [(ts, ops) for ts, ops in batches if ts <= to_ts]
+            if sel:
+                oracle.apply_record(("move_delta", pred, sel))
+        oracle.fast_forward_ts(to_ts)
+        # CDC-head contract: exact oracle parity for any predicate
+        # that changed after the restore's base backup; a predicate
+        # whose last change predates the base has NO replayed entries
+        # — its head is the base's floor (pre-base history is base
+        # state, not log: the snapshot-restore floor semantics)
+        base_ts = max((e["read_ts"] for e in (e1, e2)
+                       if e["read_ts"] <= to_ts), default=0)
+        heads_ok = all(
+            got.cdc.head(p) == (
+                oracle.cdc.head(p)
+                if any(ts > base_ts for ts, _ in raw[p]
+                       if ts <= to_ts)
+                else offset_for_ts(base_ts))
+            for p in oracle.tablets)
+        parity = tablet_bytes(got) == tablet_bytes(oracle) \
+            and heads_ok
+        points.append({"to_ts": to_ts, "parity": parity,
+                       "restore_ms": ms,
+                       "boundary": to_ts in (e1["read_ts"],
+                                             e2["read_ts"])})
+        log(f"restore --to-ts {to_ts}: parity={parity} ({ms}ms)")
+    return {"targets": points,
+            "non_boundary_targets": sum(1 for p in points
+                                        if not p["boundary"]),
+            "parity_ok": all(p["parity"] for p in points),
+            "chain": [e1["read_ts"], e2["read_ts"]]}
+
+
+# ------------------------------------------------------- phase: replication
+
+
+def run_replication_phase(tmp: str) -> dict:
+    """Standby tails a live primary to lag 0; promote with a write
+    burst in flight; measure RPO/RTO; verify the flip both ways."""
+    from dgraph_tpu.bench.spawn import ProcessCluster
+    from dgraph_tpu.cluster.client import ClusterClient
+    from dgraph_tpu.cluster.errors import WriteFenced
+
+    acked = {"dr.name": set(), "dr.ref": set()}
+
+    def ingest(rc, pred, lo, hi):
+        for i in range(lo, hi):
+            rc.mutate(set_nquads=f'<{hex(0x100 + i)}> <{pred}> '
+                      f'"{pred}-{i}" .')
+            acked[pred].add(f"{pred}-{i}")
+
+    def poll_lag(sz, preds):
+        st = sz._unwrap(sz.request({"op": "repl_status"}))
+        prog = st.get("preds", {})
+        return st, {p: (prog.get(p) or {}).get("lag") for p in preds}
+
+    out: dict = {}
+    with ProcessCluster(groups=2, replicas=1, zeros=1,
+                        log_dir=os.path.join(tmp, "primary-logs")
+                        ) as primary:
+        primary.wait_ready()
+        prc = primary.routed()
+        prc.alter("dr.name: string @index(exact) .\n"
+                  "dr.ref: string .")
+        # two predicates on two groups: replication must tail both
+        prc.zero.tablet("dr.name", 1)
+        prc.zero.tablet("dr.ref", 2)
+        ingest(prc, "dr.name", 0, 20)
+        ingest(prc, "dr.ref", 0, 20)
+        spec = ",".join(f"{i}={h}:{p}" for i, (h, p)
+                        in primary.zero_addrs.items())
+        log(f"primary up ({spec}); booting standby")
+        t0 = time.monotonic()  # catchup clock includes standby boot
+        with ProcessCluster(groups=2, replicas=1, zeros=1,
+                            zero_args=["--standby-of", spec],
+                            log_dir=os.path.join(tmp, "standby-logs")
+                            ) as standby:
+            standby.wait_ready()
+            sz = ClusterClient(standby.zero_addrs, timeout=60.0)
+            src = standby.routed()
+            try:
+                deadline = time.monotonic() + 90
+                while True:
+                    st, lags = poll_lag(sz, list(acked))
+                    if st["phase"] == "standby" and \
+                            all(v == 0 for v in lags.values()):
+                        break
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"standby never caught up: {st}")
+                    time.sleep(0.3)
+                out["catchup_s"] = round(time.monotonic() - t0, 2)
+                out["steady_lag"] = lags
+                log(f"standby caught up in {out['catchup_s']}s "
+                    f"(lags {lags})")
+
+                # fence holds: standby write refused TYPED
+                try:
+                    src.mutate(
+                        set_nquads='<0x9> <dr.name> "no" .')
+                    raise RuntimeError(
+                        "standby accepted a client write")
+                except WriteFenced as e:
+                    out["standby_fence_phase"] = e.phase
+
+                # burst the drain must pick up, then fail over
+                ingest(prc, "dr.name", 20, 30)
+                res = sz._unwrap(sz.request(
+                    {"op": "standby_promote"}))
+                out["promote"] = res
+                log(f"promoted: rpo_clean={res['rpo_clean']} "
+                    f"drained={res['rpo_commits_drained']} "
+                    f"rto_ms={res['rto_ms']}")
+
+                # every acked write is on the promoted cluster
+                missing = {}
+                for pred, want in acked.items():
+                    got = src.query(
+                        '{ q(func: has(%s)) { %s } }' % (pred, pred))
+                    have = {r[pred] for r in got["data"]["q"]}
+                    if want - have:
+                        missing[pred] = sorted(want - have)[:5]
+                out["missing_after_promote"] = missing
+                # the promoted cluster takes writes; the old primary
+                # refuses them (split-brain guard)
+                src.mutate(
+                    set_nquads='<0x9> <dr.name> "post-promote" .')
+                try:
+                    prc.mutate(set_nquads='<0x8> <dr.name> "x" .')
+                    out["old_primary_fenced"] = False
+                except WriteFenced:
+                    out["old_primary_fenced"] = True
+            finally:
+                sz.close()
+                src.close()
+                prc.close()
+    return out
+
+
+# ---------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dr_smoke", description=__doc__.split("\n\n")[0])
+    ap.add_argument("--full", action="store_true",
+                    help="also run the rolling-upgrade chaos phase")
+    ap.add_argument("--report-dir",
+                    default=os.path.join(
+                        os.environ.get("TMPDIR", "/tmp"), "dr-smoke"))
+    ap.add_argument("--out", default=os.path.join(
+        _REPO, "BENCH_DR.json"))
+    args = ap.parse_args(argv)
+    os.makedirs(args.report_dir, exist_ok=True)
+
+    t_run = time.monotonic()
+    log("phase 1/3: point-in-time restore parity")
+    restore_res = run_restore_phase(args.report_dir)
+    log("phase 2/3: standby replication + promotion")
+    repl_res = run_replication_phase(args.report_dir)
+
+    upgrade_res = None
+    if args.full:
+        log("phase 3/3: rolling-upgrade drill (dgchaos)")
+        from tools import dgchaos
+        chaos_out = os.path.join(args.report_dir, "chaos_upgrade.json")
+        rc = dgchaos.main([
+            "--nemeses", "rolling-upgrade", "--replicas", "1",
+            "--accounts", "5", "--rate", "25", "--pre-s", "3",
+            "--fault-s", "4", "--recover-s", "10",
+            "--ldbc-persons", "0", "--slo-ms", "2000",
+            "--report-dir", os.path.join(args.report_dir, "chaos"),
+            "--out", chaos_out])
+        with open(chaos_out) as f:
+            chaos = json.load(f)
+        upgrade_res = {
+            "exit": rc,
+            "checker_ok": chaos["summary"]["checker_ok"],
+            "violations": chaos["summary"]["violations"],
+            "history_ops": chaos["summary"]["history_ops"],
+            "unavailability_s": max(
+                p["unavailability_s"] for p in chaos["phases"]),
+            "time_to_recover_s": chaos["summary"]["value"]}
+
+    promote = repl_res.get("promote", {})
+    summary = {
+        "metric": "dr_promote_rto_ms",
+        "value": promote.get("rto_ms"),
+        "unit": "ms",
+        "restore_parity_ok": restore_res["parity_ok"],
+        "restore_targets": len(restore_res["targets"]),
+        "restore_non_boundary": restore_res["non_boundary_targets"],
+        "standby_catchup_s": repl_res.get("catchup_s"),
+        "rpo_clean": promote.get("rpo_clean"),
+        "rpo_commits_drained": promote.get("rpo_commits_drained"),
+        "old_primary_fenced": repl_res.get("old_primary_fenced"),
+        "wall_s": round(time.monotonic() - t_run, 1),
+    }
+    if upgrade_res is not None:
+        summary["upgrade_checker_ok"] = upgrade_res["checker_ok"]
+    out = {"summary": summary, "restore": restore_res,
+           "replication": repl_res}
+    if upgrade_res is not None:
+        out["rolling_upgrade"] = upgrade_res
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps(summary))
+
+    bad = []
+    if not restore_res["parity_ok"]:
+        bad.append("restore parity")
+    if restore_res["non_boundary_targets"] < 3:
+        bad.append("fewer than 3 non-boundary restore targets")
+    if repl_res.get("standby_fence_phase") != "standby":
+        bad.append("standby fence did not hold")
+    if not promote.get("rpo_clean"):
+        bad.append(f"promotion not clean: {promote}")
+    if repl_res.get("missing_after_promote"):
+        bad.append(
+            f"acked writes lost: {repl_res['missing_after_promote']}")
+    if not repl_res.get("old_primary_fenced"):
+        bad.append("old primary still accepts writes")
+    if upgrade_res is not None and (
+            upgrade_res["exit"] != 0 or not upgrade_res["checker_ok"]):
+        bad.append(f"rolling upgrade: {upgrade_res}")
+    if bad:
+        log("DR SMOKE FAILED: " + "; ".join(bad))
+        return 1
+    log("dr ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
