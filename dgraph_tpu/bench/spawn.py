@@ -53,6 +53,8 @@ class ProcessCluster:
                  env_extra: Optional[dict] = None,
                  snapshots: Optional[dict] = None,
                  zero_args: Optional[list] = None,
+                 alpha_args: Optional[list] = None,
+                 learners: int = 0,
                  cpus_per_group: int = 0):
         # zero_args: extra CLI flags for every zero node — how the
         # rebalance smoke / benches arm the heat-driven rebalancer
@@ -84,6 +86,16 @@ class ProcessCluster:
         # from a bulk/distributed-ingest output (`node --snapshot`);
         # every replica of a group must boot the same file
         self.snapshots = dict(snapshots or {})
+        # alpha_args: extra CLI flags for every alpha node — how the
+        # read scale-out smoke/bench arm the result cache and tenant
+        # QoS (--result-cache, --tenant-rate, --tenant-burst)
+        self.alpha_args = [str(a) for a in (alpha_args or ())]
+        # learners: non-voting read replicas per group, spawned AFTER
+        # the voters with ids above the voter range. Their raft peer
+        # map holds only themselves (the voters' --raft-peers must
+        # never list a learner as a voter); the learner discovers the
+        # group's voters through zero and conf-joins as add_learner.
+        self.learners = int(learners)
         self.groups_n = groups
         self.replicas = replicas
         self.procs: dict[str, subprocess.Popen] = {}
@@ -160,7 +172,32 @@ class ProcessCluster:
                     args += ["--max-pending", str(max_pending)]
                 if g in self.snapshots:
                     args += ["--snapshot", self.snapshots[g]]
-                self._spawn(f"alpha-g{g}-n{i}", args)
+                self._spawn(f"alpha-g{g}-n{i}", args + self.alpha_args)
+
+        # learner read replicas (ids above the voter range; voters'
+        # peer maps stay voters-only — the learner conf-joins live)
+        self.learner_addrs: dict[int, dict[int, tuple[str, int]]] = {}
+        for g in range(1, groups + 1):
+            self.learner_addrs[g] = {}
+            for k in range(self.learners):
+                i = replicas + 1 + k
+                rport, cport, dport = free_ports(3)
+                self.learner_addrs[g][i] = ("127.0.0.1", cport)
+                self.node_addrs[f"alpha-g{g}-n{i}"] = {
+                    "raft": ("127.0.0.1", rport),
+                    "client": ("127.0.0.1", cport),
+                    "debug": ("127.0.0.1", dport)}
+                args = ["--kind", "alpha", "--id", str(i),
+                        "--group", str(g), "--learner",
+                        "--raft-peers", f"{i}=127.0.0.1:{rport}",
+                        "--client-addr", f"127.0.0.1:{cport}",
+                        "--zero", zero_spec,
+                        "--debug-port", str(dport)]
+                if max_pending:
+                    args += ["--max-pending", str(max_pending)]
+                if g in self.snapshots:
+                    args += ["--snapshot", self.snapshots[g]]
+                self._spawn(f"alpha-g{g}-n{i}", args + self.alpha_args)
 
     def _spawn(self, name: str, args: list[str]):
         if name not in self._node_args:
@@ -258,6 +295,33 @@ class ProcessCluster:
         finally:
             for cl in pending.values():
                 cl.close()
+
+    def wait_learners(self, timeout_s: float = 60.0):
+        """Every learner has conf-joined its group (it sees a leader
+        and applied the joining snapshot/log) — the edge after which
+        follower reads stop returning wholesale StaleRead."""
+        from dgraph_tpu.cluster.client import ClusterClient
+        end = time.monotonic() + timeout_s
+        for g, members in getattr(self, "learner_addrs", {}).items():
+            for i, addr in members.items():
+                cl = ClusterClient({1: addr}, timeout=5.0)
+                try:
+                    while True:
+                        try:
+                            st = cl.status(1)
+                            if st.get("leader") is not None \
+                                    and st.get("learner"):
+                                break
+                        except (ConnectionError, RuntimeError,
+                                KeyError):
+                            pass
+                        if time.monotonic() > end:
+                            raise TimeoutError(
+                                f"learner alpha-g{g}-n{i} did not "
+                                f"join within {timeout_s}s")
+                        time.sleep(0.2)
+                finally:
+                    cl.close()
 
     def alive(self) -> list[str]:
         return [n for n, p in self.procs.items() if p.poll() is None]

@@ -71,6 +71,22 @@ DEFAULT_MIX = (
     ("mut_fanout", 0.08),
 )
 
+# read-only zipfian mix for the read scale-out bench (learner
+# replicas + result cache): person popularity follows a zipf(s)
+# distribution — a hot head the cache can serve, a long tail that
+# keeps missing — so the measured QPS curve reflects what a cache +
+# read replicas actually buy under skewed real-world traffic
+ZIPF_READ_MIX = (
+    ("zipf_short", 0.55),
+    ("zipf_traverse", 0.25),
+    ("zipf_agg", 0.20),
+)
+
+# --mix name -> weights table (tools/dgbench.py, scale-out bench)
+MIXES = {"default": DEFAULT_MIX, "zipf-read": ZIPF_READ_MIX}
+
+ZIPF_S = 1.1  # the exponent: ~YCSB's scrambled-zipfian skew
+
 
 @dataclass(frozen=True)
 class WorkloadConfig:
@@ -105,6 +121,22 @@ class Op:
 def _person_name(i: int) -> str:
     return (f"{FIRST[i % len(FIRST)]} "
             f"{LAST[(i // len(FIRST)) % len(LAST)]} {i}")
+
+
+def _zipf_cdf(n: int, s: float = ZIPF_S) -> list[float]:
+    """Normalized cumulative weights of zipf(s) over ranks 1..n."""
+    acc, out = 0.0, []
+    for rank in range(1, n + 1):
+        acc += 1.0 / rank ** s
+        out.append(acc)
+    return [c / acc for c in out]
+
+
+def _zipf_draw(cdf: list[float], rng: random.Random) -> int:
+    """Inverse-CDF zipfian index draw (0-based, 0 = hottest)."""
+    import bisect
+
+    return min(bisect.bisect_left(cdf, rng.random()), len(cdf) - 1)
 
 
 def _vec_literal(vals: list[float]) -> str:
@@ -143,6 +175,12 @@ class Workload:
                 self._posts.append(
                     (i, TOPICS[rng.randrange(len(TOPICS))],
                      rng.randrange(101)))
+        # zipfian popularity CDFs for the zipf-read mix: person i has
+        # rank i+1 (person 0 is the head), weight 1/rank^ZIPF_S;
+        # sampling is inverse-CDF over rng.random() so the stream
+        # stays a pure function of the seed (bisect, no rejection)
+        self._zipf_cdf = _zipf_cdf(n)
+        self._zipf_topic_cdf = _zipf_cdf(len(TOPICS))
 
     # ------------------------------------------------------------ graph
 
@@ -221,6 +259,21 @@ class Workload:
                 '{ person.name } }' % _vec_literal(probe)))
         if kind == "agg_count":
             topic = TOPICS[rng.randrange(len(TOPICS))]
+            return Op(kind, False, query=(
+                '{ q(func: eq(post.topic, "%s")) { count(uid) } }'
+                % topic))
+        if kind == "zipf_short":
+            hot = self._names[_zipf_draw(self._zipf_cdf, rng)]
+            return Op(kind, False, query=(
+                '{ q(func: eq(person.name, "%s")) '
+                '{ person.name person.age person.city } }' % hot))
+        if kind == "zipf_traverse":
+            hot = self._names[_zipf_draw(self._zipf_cdf, rng)]
+            return Op(kind, False, query=(
+                '{ q(func: eq(person.name, "%s")) { person.name '
+                'knows { person.name } } }' % hot))
+        if kind == "zipf_agg":
+            topic = TOPICS[_zipf_draw(self._zipf_topic_cdf, rng)]
             return Op(kind, False, query=(
                 '{ q(func: eq(post.topic, "%s")) { count(uid) } }'
                 % topic))

@@ -159,6 +159,21 @@ class CdcPlane:
         # sub_id -> {"pred", "offset", "seen_mono"}: the lag registry
         # dgtop's CDC panel reads; bounded, idle entries evicted first
         self._subs: dict[str, dict] = {}
+        # local invalidation observer (engine/result_cache.py): called
+        # OUTSIDE the lock with the set of predicates whose derived
+        # state (cached query results) must drop, or None meaning
+        # "everything" (drop_all). Offsets are replica-consistent by
+        # construction, so every replica's observer fires on the same
+        # stream — the result cache invalidates identically everywhere.
+        self.on_invalidate = None
+
+    def _fire_invalidate(self, preds) -> None:
+        """`preds` = iterable of predicate names, or None for ALL.
+        Never called with the plane lock held (the observer may take
+        its own lock; lock order cache->cdc must not deadlock)."""
+        cb = self.on_invalidate
+        if cb is not None:
+            cb(preds)
 
     # ------------------------------------------------------------ append
 
@@ -198,6 +213,7 @@ class CdcPlane:
             if n:
                 self._wake.notify_all()
         if n:
+            self._fire_invalidate(set(by_pred))
             metrics.inc_counter("dgraph_cdc_appended_total", n)
             with self._lock:
                 total = sum(len(l.entries) for l in self._logs.values())
@@ -221,15 +237,21 @@ class CdcPlane:
                 # OffsetTruncated — the mover must re-snapshot
                 log.raw_floor = max(log.raw_floor, off)
                 log.head = max(log.head, off)
+        # a floor jump IS a truncation from the cache's view: base
+        # state replaced history, so results derived from the old
+        # history drop wholesale — no entry below the floor may serve
+        self._fire_invalidate({pred})
 
     def drop(self, pred: str) -> None:
         with self._lock:
             self._logs.pop(pred, None)
+        self._fire_invalidate({pred})
 
     def clear(self) -> None:
         with self._lock:
             self._logs.clear()
             self._subs.clear()
+        self._fire_invalidate(None)
 
     # -------------------------------------------------------------- read
 

@@ -114,11 +114,13 @@ def cmd_alpha(args) -> int:
                            GraphDB(wal_path=args.wal or None,
                                    prefer_device=not args.no_device,
                                    enc_key=enc_key,
-                                   plan_cache_size=args.plan_cache_size))
+                                   plan_cache_size=args.plan_cache_size,
+                                   result_cache_entries=args.result_cache))
     else:
         db = GraphDB(wal_path=args.wal or None,
                      prefer_device=not args.no_device, enc_key=enc_key,
-                     plan_cache_size=args.plan_cache_size)
+                     plan_cache_size=args.plan_cache_size,
+                     result_cache_entries=args.result_cache)
     secret = None
     if args.acl_secret_file:
         with open(args.acl_secret_file, "rb") as f:
@@ -134,7 +136,9 @@ def cmd_alpha(args) -> int:
                          acl_secret=secret, tls_context=tls_ctx,
                          mutations_mode=args.mutations,
                          max_pending=args.max_pending,
-                         batch_window_us=args.batch_window_us)
+                         batch_window_us=args.batch_window_us,
+                         tenant_rate=args.tenant_rate,
+                         tenant_burst=args.tenant_burst)
     grpc_srv = None
     if args.grpc_port:
         from dgraph_tpu.server.grpc_api import serve_grpc
@@ -198,10 +202,18 @@ def cmd_node(args) -> int:
               debug_port=args.debug_port, debug_host=args.debug_host)
     if args.kind == "alpha":
         zero_addrs = _parse_peers(args.zero) if args.zero else None
+        db_kw = {}
+        if getattr(args, "result_cache", 0):
+            db_kw["result_cache_entries"] = args.result_cache
         srv = AlphaServer(args.id, peers, (chost, int(cport)),
                           group=args.group, replicas=args.replicas,
                           zero_addrs=zero_addrs,
                           max_pending=args.max_pending,
+                          learner=getattr(args, "learner", False),
+                          tenant_rate=getattr(args, "tenant_rate", 0.0),
+                          tenant_burst=getattr(args, "tenant_burst",
+                                               0.0),
+                          db_kw=db_kw or None,
                           snapshot=getattr(args, "snapshot", ""), **kw)
     else:
         srv = ZeroServer(
@@ -822,6 +834,20 @@ def main(argv=None) -> int:
                    help="micro-batching window in microseconds: "
                         "concurrent queries sharing a plan-cache key "
                         "coalesce into one dispatch. 0 = off")
+    a.add_argument("--result-cache", type=int, default=0,
+                   help="CDC-invalidated query result cache entries "
+                        "(engine/result_cache.py): best-effort reads "
+                        "serve byte-identical cached responses until "
+                        "a write touches their predicate footprint. "
+                        "0 = off")
+    a.add_argument("--tenant-rate", type=float, default=0.0,
+                   help="per-tenant QoS: admission tokens/second per "
+                        "X-Dgraph-Tenant namespace; a tenant over its "
+                        "rate sheds 429 without starving the rest. "
+                        "0 = off")
+    a.add_argument("--tenant-burst", type=float, default=0.0,
+                   help="per-tenant QoS bucket depth (defaults to "
+                        "--tenant-rate when 0)")
     a.add_argument("--acl_secret_file",
                    default="",
                    help="enables ACL; file holds the HMAC jwt secret")
@@ -986,6 +1012,26 @@ def main(argv=None) -> int:
                         "query/mutate/task ops; excess sheds typed "
                         "(retryable) like the HTTP edge's 429. "
                         "0 = unbounded")
+    n.add_argument("--learner", action="store_true",
+                   help="alpha only: join the group as a NON-VOTING "
+                        "read replica (raft learner): receives the "
+                        "replicated log, never campaigns or serves "
+                        "writes, answers watermark-bounded follower "
+                        "reads (with --group 0, zero places it on the "
+                        "least-loaded existing group)")
+    n.add_argument("--tenant-rate", type=float, default=0.0,
+                   help="alpha only: per-tenant QoS admission "
+                        "tokens/second per tenant namespace; a tenant "
+                        "over its rate sheds typed (retryable) "
+                        "without starving the rest. 0 = off")
+    n.add_argument("--tenant-burst", type=float, default=0.0,
+                   help="alpha only: per-tenant QoS bucket depth "
+                        "(defaults to --tenant-rate when 0)")
+    n.add_argument("--result-cache", type=int, default=0,
+                   help="alpha only: CDC-invalidated query result "
+                        "cache entries; replica-consistent change-log "
+                        "offsets keep every replica's cache honest. "
+                        "0 = off")
     n.add_argument("--move-throttle-mb-s", type=float, default=64.0,
                    help="zero only: tablet-move snapshot streaming "
                         "budget in MB/s (the source keeps serving; "

@@ -311,20 +311,31 @@ class ClusterClient:
     def query(self, q: str, variables: Optional[dict] = None,
               hedge_s: Optional[float] = None,
               read_ts: Optional[int] = None,
-              deadline_ms: Optional[int] = None) -> dict:
+              deadline_ms: Optional[int] = None,
+              best_effort: bool = False,
+              tenant: str = "") -> dict:
         """Snapshot read from any replica. With hedge_s set, a backup
         request fires at a second replica if the first hasn't answered
         within the delay and the first response wins — the reference's
         processWithBackupRequest (worker/task.go:66) tail-latency
         defense. `deadline_ms` rides the wire so the serving node
         inherits the remaining budget, AND bounds the client-side
-        routed-retry loop to the same clock."""
+        routed-retry loop to the same clock.
+
+        `best_effort` + `read_ts` is the watermark-bounded follower
+        read: ANY replica (learners included) serves it once its
+        applied watermark covers read_ts, failing typed (StaleRead)
+        instead of blocking past the staleness bound."""
         req = {"op": "query", "q": q, "vars": variables}
+        if tenant:
+            req["tenant"] = tenant
         if deadline_ms is not None:
             req["deadline_ms"] = int(deadline_ms)
+        if best_effort:
+            req["be"] = True
         if read_ts is not None:
             req["read_ts"] = read_ts
-            if hedge_s is not None:
+            if hedge_s is not None and not best_effort:
                 # pinned reads are leader-only; the hedge path fires at
                 # arbitrary replicas with no leader rerouting
                 raise ValueError(
@@ -334,6 +345,30 @@ class ClusterClient:
         if hedge_s is not None and len(self.addrs) > 1:
             return self._unwrap(self._hedged(req, hedge_s, deadline_s))
         return self._unwrap(self.request(req, deadline_s=deadline_s))
+
+    def query_at(self, node: int, q: str,
+                 variables: Optional[dict] = None,
+                 read_ts: Optional[int] = None,
+                 deadline_ms: Optional[int] = None,
+                 tenant: str = "") -> dict:
+        """Best-effort snapshot read at ONE specific replica — the
+        read-pool path: RoutedCluster spreads reads across
+        voters+learners and retries StaleRead/unreachable elsewhere.
+        No leader-following (a follower read is served wherever it
+        lands or fails typed); ConnectionError = try another replica."""
+        req = {"op": "query", "q": q, "vars": variables, "be": True}
+        if tenant:
+            req["tenant"] = tenant
+        if read_ts is not None:
+            req["read_ts"] = int(read_ts)
+        if deadline_ms is not None:
+            req["deadline_ms"] = int(deadline_ms)
+        timeout = deadline_ms / 1000.0 \
+            if deadline_ms is not None else None
+        resp = self._rpc_once(node, self._traced(req), timeout=timeout)
+        if resp is None:
+            raise ConnectionError(f"replica {node} unreachable")
+        return self._unwrap(resp)
 
     def _hedged(self, req: dict, hedge_s: float,
                 deadline_s: Optional[float] = None) -> dict:
@@ -523,6 +558,12 @@ class ClusterClient:
 
     # -------------------------------------------------------- zero surface
 
+    def read_ts(self) -> int:
+        """Zero's current max timestamp WITHOUT bumping it — the
+        grant for watermark-bounded follower reads (the snapshot at
+        this ts is final: every future commit_ts exceeds it)."""
+        return self._unwrap(self.request({"op": "read_ts"}))
+
     def assign_ts(self, n: int = 1) -> int:
         return self._unwrap(self.request(
             {"op": "assign_ts", "args": (n,)}))
@@ -557,6 +598,16 @@ class ClusterClient:
                 raise TabletMisrouted(m.get("pred", "?"),
                                       m.get("group"),
                                       resp.get("error", ""))
+            if resp.get("stale"):
+                # a follower read outran this replica's applied
+                # watermark: typed + retryable — the router re-issues
+                # the read at another replica (the leader always
+                # qualifies) instead of surfacing an error
+                from dgraph_tpu.cluster.errors import StaleRead
+                s = resp["stale"]
+                raise StaleRead(int(s.get("readTs", 0)),
+                                int(s.get("watermark", -1)),
+                                resp.get("error", ""))
             if resp.get("fenced"):
                 # the whole cluster refuses client writes (replication
                 # standby / fenced old primary) — typed and NOT

@@ -29,6 +29,26 @@ class TabletMisrouted(RuntimeError):
             + "; refresh the tablet map and re-route")
 
 
+class StaleRead(RuntimeError):
+    """A watermark-bounded follower read could not be served: this
+    replica's applied watermark has not yet covered the read's granted
+    `read_ts` within the staleness bound. RETRYABLE by contract — the
+    router retries the read on another replica of the same group (a
+    voter, or ultimately the leader, always qualifies) instead of
+    surfacing an error or, worse, serving a snapshot older than the
+    granted timestamp.
+
+    Crosses the wire as {"ok": False, "stale": {"readTs", "watermark"}}
+    (cluster/service.py _client_loop -> cluster/client.py _unwrap)."""
+
+    def __init__(self, read_ts: int, watermark: int, msg: str = ""):
+        self.read_ts = read_ts
+        self.watermark = watermark
+        super().__init__(
+            msg or f"replica watermark {watermark} has not reached "
+            f"read_ts {read_ts}; retry the read on another replica")
+
+
 class WriteFenced(RuntimeError):
     """The WHOLE cluster refuses client writes: it is a replication
     standby (state arrives only through the replication stream,

@@ -206,9 +206,14 @@ class RaftNode:
                  storage: Optional[MemoryStorage] = None,
                  election_ticks: int = 10, heartbeat_ticks: int = 2,
                  rng: Optional[random.Random] = None,
-                 max_batch: int = 64):
+                 max_batch: int = 64, learner: bool = False):
         self.id = node_id
         self.peers = [p for p in peers if p != node_id]
+        # Non-voting members (etcd raft "learners", ref raft.go
+        # ProgressTracker.Learners): they receive the replicated log but
+        # never campaign, vote, or count toward the commit quorum.
+        self.learners: set[int] = set()
+        self.learner = learner
         self.storage = storage or MemoryStorage()
         self.rng = rng or random.Random(node_id * 7919)
         self.election_ticks = election_ticks
@@ -274,7 +279,12 @@ class RaftNode:
                 self.elapsed = 0
                 self._broadcast_append()
         elif self.elapsed >= self.timeout:
-            self._campaign()
+            if self.learner:
+                # learners never campaign; a silent leader just means
+                # we wait for the next append
+                self.elapsed = 0
+            else:
+                self._campaign()
 
     def propose(self, data: Any) -> bool:
         """Leader-only append; returns False when not leader (caller
@@ -285,9 +295,9 @@ class RaftNode:
         self.log.append(e)
         self.storage.append([e])
         self.match_index[self.id] = e.index
-        if not self.peers:  # single-node group commits immediately
+        if not self.peers:  # single-voter group commits immediately
             self._advance_commit()
-        else:
+        if self.peers or self.learners:
             self._broadcast_append()
         return True
 
@@ -336,9 +346,29 @@ class RaftNode:
     # model; ref conn.Node conf changes + zero/raft.go member proposals).
 
     def add_peer(self, p: int):
-        if p == self.id or p in self.peers:
+        if p == self.id:
+            self.learner = False  # promotion to voter
             return
+        if p in self.peers:
+            return
+        promoted = p in self.learners
+        self.learners.discard(p)
         self.peers.append(p)
+        if self.role == LEADER:
+            if not promoted:  # a promoted learner keeps its progress
+                self.next_index[p] = self.last_index() + 1
+                self.match_index[p] = 0
+                self._send_append(p)
+            self._advance_commit()  # the quorum just grew
+
+    def add_learner(self, p: int):
+        """Add a non-voting member: replicated to, never counted."""
+        if p == self.id:
+            self.learner = True
+            return
+        if p in self.peers or p in self.learners:
+            return
+        self.learners.add(p)
         if self.role == LEADER:
             self.next_index[p] = self.last_index() + 1
             self.match_index[p] = 0
@@ -355,6 +385,7 @@ class RaftNode:
             return
         if p in self.peers:
             self.peers.remove(p)
+        self.learners.discard(p)
         self.next_index.pop(p, None)
         self.match_index.pop(p, None)
         self.votes.discard(p)
@@ -396,8 +427,9 @@ class RaftNode:
     def _become_leader(self):
         self.role = LEADER
         self.leader_id = self.id
-        self.next_index = {p: self.last_index() + 1 for p in self.peers}
-        self.match_index = {p: 0 for p in self.peers}
+        reps = self._replicas()
+        self.next_index = {p: self.last_index() + 1 for p in reps}
+        self.match_index = {p: 0 for p in reps}
         self.match_index[self.id] = self.last_index()
         # noop entry to commit entries from prior terms (§5.4.2)
         e = Entry(self.term, self.last_index() + 1, None)
@@ -406,7 +438,7 @@ class RaftNode:
         self.match_index[self.id] = e.index
         if not self.peers:
             self._advance_commit()
-        else:
+        if reps:
             self._broadcast_append()
 
     def _on_vote_req(self, m: Msg):
@@ -414,7 +446,8 @@ class RaftNode:
             (self.last_term(), self.last_index())
         grant = (m.term >= self.term and up_to_date
                  and self.voted_for in (None, m.frm)
-                 and self.role != LEADER)
+                 and self.role != LEADER
+                 and not self.learner)  # learners never vote
         if grant:
             self.voted_for = m.frm
             self.elapsed = 0
@@ -433,8 +466,12 @@ class RaftNode:
             if len(self.votes) * 2 > len(self.peers) + 1:
                 self._become_leader()
 
+    def _replicas(self) -> list[int]:
+        """Everyone the leader replicates to: voters + learners."""
+        return list(self.peers) + sorted(self.learners)
+
     def _broadcast_append(self):
-        for p in self.peers:
+        for p in self._replicas():
             self._send_append(p)
 
     def _send_append(self, p: int):
@@ -521,7 +558,9 @@ class RaftNode:
         for idx in range(self.last_index(), self.commit_index, -1):
             if self._term_at(idx) != self.term:
                 break
-            count = sum(1 for p in self.match_index.values() if p >= idx)
+            # learners' progress must never inflate the quorum count
+            count = sum(1 for p, mi in self.match_index.items()
+                        if mi >= idx and (p == self.id or p in self.peers))
             if count * 2 > n_members:
                 self.commit_index = idx
                 break

@@ -28,7 +28,9 @@ from dgraph_tpu import wire
 from dgraph_tpu.cluster.raft import (
     FOLLOWER, GOODBYE, LEADER, Msg, RaftNode, VOTE_REQ,
 )
-from dgraph_tpu.cluster.errors import TabletMisrouted, WriteFenced
+from dgraph_tpu.cluster.errors import (
+    StaleRead, TabletMisrouted, WriteFenced,
+)
 from dgraph_tpu.cluster.transport import TcpTransport
 from dgraph_tpu.utils import failpoint, metrics, netfault, tracing
 from dgraph_tpu.utils.logger import log
@@ -56,28 +58,48 @@ class RaftServer:
                  election_ticks: int = 10,
                  snapshot_every: int = 2048,
                  debug_port: int = 0,
-                 debug_host: str = "127.0.0.1"):
+                 debug_host: str = "127.0.0.1",
+                 learner: bool = False,
+                 learner_ids=()):
         self.id = node_id
         # conf-changed membership persisted in raft storage wins over
         # the CLI's --raft-peers on restart (ref zero/raft.go member
         # state living in Zero's raft group)
         saved = storage.load_members() if storage is not None else None
         self._removed_ids: set[int] = set()
+        # non-voting members (raft learners): replicated to, never
+        # counted toward any quorum, never campaigning — the read
+        # scale-out tier (ref etcd learner members / the reference's
+        # StreamMembership non-voting replicas)
+        self.learner_ids: set[int] = set()
         if saved and isinstance(saved, dict) and "members" in saved:
             self.members = {int(k): tuple(v)
                             for k, v in saved["members"].items()}
             self._removed_ids = {int(x)
                                  for x in saved.get("removed", ())}
+            self.learner_ids = {int(x)
+                                for x in saved.get("learners", ())}
         elif saved:
             self.members = {int(k): tuple(v) for k, v in saved.items()}
         else:
             self.members = dict(raft_peers)
+        if learner:
+            self.learner_ids.add(node_id)
+        # membership learned at join time (zero's connect reply marks
+        # learner members) — persisted membership still wins above
+        if not saved:
+            self.learner_ids |= {int(x) for x in learner_ids}
         if node_id not in self.members and node_id in raft_peers \
                 and node_id not in self._removed_ids:
             self.members[node_id] = raft_peers[node_id]
-        self.node = RaftNode(node_id, list(self.members),
+        voters = [m for m in self.members
+                  if m not in self.learner_ids]
+        self.node = RaftNode(node_id, voters,
                              storage=storage,
-                             election_ticks=election_ticks)
+                             election_ticks=election_ticks,
+                             learner=node_id in self.learner_ids)
+        for lid in sorted(self.learner_ids):
+            self.node.add_learner(lid)
         self.lock = threading.RLock()
         self.applied_cv = threading.Condition(self.lock)
         self.tick_s = tick_s
@@ -217,7 +239,8 @@ class RaftServer:
                 # snapshots carry membership so a late joiner that
                 # never saw the conf entries still learns the cluster
                 self._install_members(data["__members__"],
-                                      data.get("__removed__", ()))
+                                      data.get("__removed__", ()),
+                                      data.get("__learners__", ()))
                 data = data["app"]
             self.sm_restore(data)
             self._acked.clear()
@@ -244,6 +267,7 @@ class RaftServer:
             self.node.take_snapshot(
                 {"__members__": dict(self.members),
                  "__removed__": sorted(self._removed_ids),
+                 "__learners__": sorted(self.learner_ids),
                  "app": self.sm_snapshot()})
         return r.msgs
 
@@ -251,21 +275,29 @@ class RaftServer:
     # Single-change-at-a-time conf changes applied at commit (the etcd
     # model; ref conn/raft_server.go JoinCluster + zero /removeNode).
 
-    def _install_members(self, members: dict, removed=()):
+    def _install_members(self, members: dict, removed=(), learners=()):
         members = {int(k): tuple(v) for k, v in members.items()}
         for nid, addr in members.items():
             if nid != self.id:
                 self.transport.peers[nid] = addr
         self.members = members
         self._removed_ids = {int(x) for x in removed}
-        for nid in list(self.node.peers):
+        self.learner_ids = {int(x) for x in learners
+                            if int(x) in members}
+        for nid in list(self.node.peers) + sorted(self.node.learners):
             if nid not in members:
                 self.node.remove_peer(nid)
         for nid in members:
-            if nid != self.id:
+            if nid == self.id:
+                continue
+            if nid in self.learner_ids:
+                self.node.add_learner(nid)
+            else:
                 self.node.add_peer(nid)
         if self.id not in members:
             self.node.remove_peer(self.id)
+        elif self.id in self.learner_ids:
+            self.node.add_learner(self.id)
         self._save_members()
 
     def _apply_conf(self, action: str, nid: int, addr=None) -> bool:
@@ -274,9 +306,20 @@ class RaftServer:
             if addr is None:
                 return False
             self.members[nid] = tuple(addr)
+            self.learner_ids.discard(nid)  # promotion keeps progress
             if nid != self.id:
                 self.transport.peers[nid] = tuple(addr)
-                self.node.add_peer(nid)
+            self.node.add_peer(nid)
+        elif action == "add_learner":
+            # non-voting join: the learner receives the replicated log
+            # (and this very conf entry) but never joins any quorum
+            if addr is None:
+                return False
+            self.members[nid] = tuple(addr)
+            self.learner_ids.add(nid)
+            if nid != self.id:
+                self.transport.peers[nid] = tuple(addr)
+            self.node.add_learner(nid)
         elif action == "remove":
             self.members.pop(nid, None)
             if nid != self.id and self.node.role == LEADER \
@@ -291,9 +334,10 @@ class RaftServer:
                 # backstopped by GOODBYE notices.
                 self.node._send_append(nid)
             self.node.remove_peer(nid)
+            self.learner_ids.discard(nid)
         else:
             return False
-        if action == "add":
+        if action in ("add", "add_learner"):
             self._removed_ids.discard(nid)
         else:
             self._removed_ids.add(nid)
@@ -306,7 +350,8 @@ class RaftServer:
         if self.node.storage is not None:
             self.node.storage.save_members(
                 {"members": dict(self.members),
-                 "removed": sorted(self._removed_ids)})
+                 "removed": sorted(self._removed_ids),
+                 "learners": sorted(self.learner_ids)})
 
     def _conf_in_flight(self) -> bool:
         """One membership change at a time (raft §4.1 single-server
@@ -329,6 +374,7 @@ class RaftServer:
                 return {"ok": True, "result": {
                     "members": {str(k): list(v)
                                 for k, v in self.members.items()},
+                    "learners": sorted(self.learner_ids),
                     "removed": self.node.removed}}
         if op == "fault":
             # live control of THIS node's outbound fault table
@@ -378,10 +424,11 @@ class RaftServer:
             action = req.get("action")
             nid = int(req.get("node", 0))
             addr = req.get("addr")
-            if action not in ("add", "remove") or not nid:
+            if action not in ("add", "add_learner", "remove") \
+                    or not nid:
                 return {"ok": False, "error": "bad conf_change"}
-            if action == "add" and not addr:
-                return {"ok": False, "error": "add needs addr"}
+            if action in ("add", "add_learner") and not addr:
+                return {"ok": False, "error": f"{action} needs addr"}
             def gate():
                 # checked under the SAME lock as the propose: two
                 # racing conf_change RPCs must not both slip past the
@@ -490,6 +537,15 @@ class RaftServer:
                     resp = {"ok": False, "error": str(e),
                             "misrouted": {"pred": e.pred,
                                           "group": e.group}}
+                except StaleRead as e:
+                    # typed + retryable: the router re-runs the read
+                    # on another replica of the group (the leader
+                    # always qualifies) — bounded staleness must
+                    # degrade to a retry, never to an old snapshot
+                    resp = {"ok": False, "error": str(e),
+                            "stale": {"readTs": e.read_ts,
+                                      "watermark": e.watermark},
+                            "retryable": True}
                 except WriteFenced as e:
                     # typed: the client must re-point at the active
                     # primary, not retry here (async replication —
@@ -543,7 +599,8 @@ class RaftServer:
         with self.lock:
             out = {"id": self.id, "role": self.node.role,
                    "leader": self.node.leader_id,
-                   "term": self.node.term}
+                   "term": self.node.term,
+                   "learner": self.node.learner}
         out["lastHeard"] = self.peer_ages()
         return out
 
@@ -605,7 +662,10 @@ class AlphaServer(RaftServer):
                  storage=None, db_kw: Optional[dict] = None,
                  group: int = 1, replicas: int = 1,
                  zero_addrs: Optional[dict] = None,
-                 snapshot: str = "", max_pending: int = 0, **kw):
+                 snapshot: str = "", max_pending: int = 0,
+                 learner: bool = False,
+                 tenant_rate: float = 0.0,
+                 tenant_burst: float = 0.0, **kw):
         from dgraph_tpu.engine.db import GraphDB
 
         # admission control on the wire surface (the cluster analogue
@@ -617,6 +677,18 @@ class AlphaServer(RaftServer):
         self.max_pending = max_pending
         self._admission = threading.Lock()
         self._inflight = 0
+        # per-tenant QoS layered UNDER max_pending: one hot tenant
+        # exhausts its own token bucket and degrades to typed 429s
+        # while the shared in-flight budget stays available to the
+        # rest (server/qos.py)
+        self.qos = None
+        if tenant_rate > 0:
+            from dgraph_tpu.server.qos import TenantQos
+            self.qos = TenantQos(rate=tenant_rate, burst=tenant_burst)
+        # non-voting read replica (raft learner): never campaigns or
+        # serves writes; joins its group via the add_learner conf
+        # change and serves watermark-bounded follower reads
+        self.learner = learner
 
         # group=0 + a zero quorum = elastic join (ref zero/zero.go:410
         # Connect): zero assigns this node to the least-replicated
@@ -634,7 +706,7 @@ class AlphaServer(RaftServer):
                     "op": "connect",
                     "args": (f"{my_raft[0]}:{my_raft[1]}", 0, 0,
                              my_raft, tuple(client_addr),
-                             int(replicas))},
+                             int(replicas), int(bool(learner)))},
                     deadline_s=60.0)
                 if not got.get("ok"):
                     raise RuntimeError(
@@ -647,9 +719,17 @@ class AlphaServer(RaftServer):
             raft_peers = {int(i): tuple(m["raft"])
                           for i, m in asg["members"].items()}
             raft_peers[node_id] = my_raft
+            # existing learners must not be mistaken for voters (a
+            # candidate counting them in its quorum could never win)
+            kw.setdefault("learner_ids", tuple(
+                int(i) for i, m in asg["members"].items()
+                if m.get("learner") and int(i) != node_id))
+            # conf changes land on the group LEADER: learners never
+            # lead, so they are not join targets
             self._join_members = {
                 int(i): tuple(m["client"])
-                for i, m in asg["members"].items() if int(i) != node_id}
+                for i, m in asg["members"].items()
+                if int(i) != node_id and not m.get("learner")}
 
         self.group = group
         self._db_kw = dict(db_kw or {})
@@ -731,8 +811,20 @@ class AlphaServer(RaftServer):
         self._finalize_lock = threading.Lock()
         self.node_name = f"alpha-g{self.group}-n{node_id}"
         super().__init__(node_id, raft_peers, client_addr,
-                         storage=storage, **kw)
-        if self._join_members:
+                         storage=storage, learner=learner, **kw)
+        if self.learner and not self._join_members:
+            if self.zero is None:
+                raise ValueError(
+                    "--learner needs --zero to discover the group's "
+                    "voters for the add_learner conf change")
+            # stay quiet until the group leader conf-adds us as a
+            # learner and its first append arrives
+            with self.lock:
+                self.node.removed = True
+            threading.Thread(target=self._join_as_learner, daemon=True,
+                             name=f"learn-g{self.group}-{self.id}"
+                             ).start()
+        elif self._join_members:
             # stay quiet (no campaigning) until the group leader adds
             # us via conf change and its first append arrives — an
             # eager candidate here would inflate terms it can't win
@@ -750,6 +842,13 @@ class AlphaServer(RaftServer):
             threading.Thread(target=self._register_with_zero,
                              daemon=True,
                              name=f"register-{self.id}").start()
+        if self.zero is not None and not self.learner:
+            # watermark beacon: leaders relay zero's global max_ts
+            # through the log so idle groups' replicas can still
+            # cover fresh read grants (see _watermark_loop)
+            threading.Thread(target=self._watermark_loop, daemon=True,
+                             name=f"wm-g{self.group}-{self.id}"
+                             ).start()
 
     def _join_group(self):
         """Ask the group's current members to conf-change us in (ref
@@ -765,7 +864,9 @@ class AlphaServer(RaftServer):
                     if not self.node.removed:
                         return  # the leader reached us: we're in
                 try:
-                    cl.conf_change("add", self.id, tuple(my_raft))
+                    cl.conf_change(
+                        "add_learner" if self.learner else "add",
+                        self.id, tuple(my_raft))
                     return
                 except RuntimeError as e:
                     if "in flight" not in str(e):
@@ -783,10 +884,67 @@ class AlphaServer(RaftServer):
                 "op": "connect",
                 "args": (f"{my_raft[0]}:{my_raft[1]}", self.group,
                          self.id, tuple(my_raft),
-                         tuple(self.client_addr), 1)})
+                         tuple(self.client_addr), 1,
+                         int(bool(self.learner)))})
             if got.get("ok") and self._claim_boot_tablets():
                 break
             time.sleep(1.0)
+        self._report_sizes_loop()
+
+    def _join_as_learner(self):
+        """Explicit-group learner boot: register with zero (so routers
+        see this replica in cluster_state), discover the group's
+        voters, and ask them to conf-add us as a NON-VOTING member —
+        retrying through elections until the leader's first append
+        proves we are in (ref etcd AddLearnerNode)."""
+        from dgraph_tpu.cluster.client import ClusterClient
+        my_raft = self.transport.peers.get(self.id) or \
+            self.transport.addr
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not self._stop.is_set():
+            with self.lock:
+                if not self.node.removed:
+                    break  # the leader reached us: we're in
+            try:
+                self.zero.request({
+                    "op": "connect",
+                    "args": (f"{my_raft[0]}:{my_raft[1]}", self.group,
+                             self.id, tuple(my_raft),
+                             tuple(self.client_addr), 1, 1)})
+                got = self.zero.request({"op": "cluster_state"})
+                voters: dict[int, tuple] = {}
+                if got.get("ok"):
+                    for rec in got["result"]["alphas"].values():
+                        if rec.get("group") == self.group \
+                                and not rec.get("learner") \
+                                and int(rec["id"]) != self.id:
+                            nid = int(rec["id"])
+                            voters[nid] = tuple(rec["client"])
+                            # the learner boots knowing only its OWN
+                            # raft addr: without the voters' addrs its
+                            # APPEND_RESPs have nowhere to go, the
+                            # leader never learns its progress, and
+                            # catch-up deadlocks on the first rejected
+                            # heartbeat
+                            with self.lock:
+                                raddr = tuple(rec["raft"])
+                                self.members[nid] = raddr
+                                self.transport.peers[nid] = raddr
+                if voters:
+                    cl = ClusterClient(voters, timeout=10.0)
+                    try:
+                        cl.conf_change("add_learner", self.id,
+                                       tuple(my_raft))
+                    except RuntimeError as e:
+                        if "in flight" not in str(e):
+                            log.warning("learner_join_retry",
+                                        node=self.id, error=str(e))
+                    finally:
+                        cl.close()
+            except Exception as e:  # noqa: BLE001 — keep retrying  # dglint: disable=DG07 (boot-time join loop; no request context exists yet)
+                log.warning("learner_join_retry", node=self.id,
+                            error=str(e))
+            time.sleep(0.5)
         self._report_sizes_loop()
 
     def _claim_boot_tablets(self) -> bool:
@@ -893,6 +1051,17 @@ class AlphaServer(RaftServer):
     def sm_apply(self, origin, rec) -> int:
         if rec == ("noop",):
             return 0  # read-barrier marker, no state change
+        if isinstance(rec, tuple) and rec and rec[0] == "wm":
+            # watermark beacon (leader relays zero's max_ts through
+            # the log): fast-forward on EVERY replica including the
+            # proposing leader — soft state only, so it's not an
+            # _events record and a rebuild simply waits for the next
+            # beacon. Log order makes this safe: every local commit
+            # with ts <= beacon was proposed before it (the beacon is
+            # proposed under _write_lock), so by the time a follower
+            # applies the beacon those commits have applied here too.
+            self.db.fast_forward_ts(int(rec[1]))
+            return 0
         self._events.append(("rec", rec))
         if origin == (self.id, self.epoch):
             return 0  # leader pre-applied while executing the txn
@@ -1149,6 +1318,94 @@ class AlphaServer(RaftServer):
             time.sleep(0.05)
         return True
 
+    def _applied_watermark(self) -> int:
+        """Highest commit timestamp this replica has applied (the
+        coordinator's max_assigned is fast-forwarded by every applied
+        record, so on a follower/learner it IS the applied watermark).
+        Caller holds self.lock."""
+        return self.db.coordinator.max_assigned()
+
+    def _await_watermark(self, read_ts: int, ctx=None,
+                         wait_s: float = 2.0):
+        """Watermark-bounded follower read, the wait half: block until
+        this replica's applied watermark covers `read_ts`, bounded by
+        `wait_s` (and half the caller's remaining deadline, so the
+        typed retry still reaches it). On timeout raise the typed
+        StaleRead — the router retries on another replica rather than
+        ever serving a snapshot older than the granted timestamp."""
+        if ctx is not None:
+            rem = ctx.remaining_ms()
+            if rem is not None:
+                wait_s = min(wait_s, max(0.0, rem / 1000.0) / 2)
+        with self.lock:
+            deadline = time.monotonic() + wait_s
+            while True:
+                wm = self._applied_watermark()
+                if wm >= read_ts:
+                    return
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stop.is_set():
+                    metrics.inc_counter("dgraph_stale_reads_total")
+                    raise StaleRead(read_ts, wm)
+                # capped wait: the watermark can advance without an
+                # applied_cv notify (leader-local allocations)
+                self.applied_cv.wait(min(remaining, 0.05))
+
+    def _watermark_loop(self, interval_s: float = 0.0):
+        """Leader-only watermark beacon (ref zero's MaxAssigned in the
+        oracle delta stream): zero's max_ts is GLOBAL, so a group
+        whose last local commit predates another group's can never
+        cover a fresh read grant on its own — every watermark-bounded
+        read there would burn its full wait and fail over. The leader
+        periodically reads zero's current max_ts (the non-bumping
+        read_ts op) and, when it is ahead of the local watermark,
+        replicates it as a ("wm", ts) record so every replica —
+        learners included — fast-forwards.
+
+        Safety: proposed under _write_lock, so any LOCAL commit with
+        ts <= beacon is already in the log ahead of it; cross-group
+        stages decided-but-unfinalized are skipped here (pending_txns
+        gate) and independently blocked at read time by the
+        pending-txn check in the follower-read path."""
+        if interval_s <= 0:
+            import os as _os
+            try:
+                interval_s = float(_os.environ.get(
+                    "DGRAPH_TPU_WM_INTERVAL_S", "") or 0.2)
+            except ValueError:
+                interval_s = 0.2
+        while not self._stop.wait(interval_s):
+            with self.lock:
+                if self.node.role != LEADER:
+                    continue
+                wm = self._applied_watermark()
+            try:
+                got = self.zero.request({"op": "read_ts"})
+                if not got.get("ok"):
+                    continue
+                t = int(got["result"])
+            except Exception:  # noqa: BLE001 — zero blip: next tick  # dglint: disable=DG07 (daemon loop; no request context flows here)
+                continue
+            if t <= wm:
+                continue  # idle or already covered: no log traffic
+            with self._write_lock:
+                with self.lock:
+                    # _write_lock freezes pending_txns (stages and
+                    # local commits both mutate it under that lock),
+                    # so these checks stay true through the propose
+                    skip = (self.node.role != LEADER
+                            or self._stop.is_set()
+                            or bool(self.db.pending_txns)
+                            or t <= self._applied_watermark())
+                if skip:
+                    continue
+                try:
+                    # outside self.lock like _replicate_record_locked:
+                    # propose_and_wait sends + waits on applied_cv
+                    self.propose_and_wait(("wm", t))
+                except Exception:  # noqa: BLE001 — quorum blip  # dglint: disable=DG07 (daemon loop; no request context flows here)
+                    continue
+
     def _read_barrier(self):
         """Linearizable-read barrier for pinned reads (raft §8): a
         freshly elected leader may hold committed-but-unapplied entries
@@ -1311,18 +1568,22 @@ class AlphaServer(RaftServer):
         times out first and the worker's abort is the backstop (ref
         worker RPCs inheriting the query context)."""
         ms = req.get("deadline_ms")
+        tenant = str(req.get("tenant") or "")
         if ms is None:
-            if req.get("trace_id"):
-                # no deadline, but the caller IS tracing: keep the
-                # trace joined through the engine's bind_request
+            if req.get("trace_id") or tenant:
+                # no deadline, but the caller IS tracing (or carries a
+                # tenant tag for reqlog/QoS attribution): keep the
+                # context joined through the engine's bind_request
                 return RequestContext.background(
-                    trace_id=req["trace_id"],
-                    parent_span=req.get("parent_span", ""))
+                    trace_id=req.get("trace_id", ""),
+                    parent_span=req.get("parent_span", ""),
+                    tenant=tenant)
             return None
         return RequestContext.from_deadline_ms(
             ms, trace_id=req.get("trace_id", ""),
             skew_s=PROPAGATION_SKEW_S,
-            parent_span=req.get("parent_span", ""))
+            parent_span=req.get("parent_span", ""),
+            tenant=tenant)
 
     def _run_task(self, req: dict, read_ts: int):
         """Dispatch one federated task kind against the local tablet.
@@ -1385,6 +1646,8 @@ class AlphaServer(RaftServer):
     _ADMITTED_OPS = ("query", "mutate", "task", "xstage")
 
     def handle_request(self, req: dict) -> dict:
+        if req.get("op") in self._ADMITTED_OPS:
+            self._admit_tenant(req)
         if not self.max_pending \
                 or req.get("op") not in self._ADMITTED_OPS:
             return self._handle_admitted(req)
@@ -1406,6 +1669,25 @@ class AlphaServer(RaftServer):
                 self._inflight -= 1
                 metrics.set_gauge("dgraph_pending_queries",
                                   self._inflight)
+
+    def _admit_tenant(self, req: dict) -> None:
+        """Per-tenant token-bucket admission, layered UNDER the shared
+        max_pending plane: a tenant that exhausts its own budget sheds
+        TYPED (Overloaded -> the caller's 429 class) while other
+        tenants keep their full rate. Commits/finalizes are never
+        shed here — they ride ops outside _ADMITTED_OPS."""
+        qos = getattr(self, "qos", None)  # absent on bare test shells
+        if qos is None:
+            return
+        tenant = str(req.get("tenant") or "default")
+        if qos.admit(tenant):
+            return
+        from dgraph_tpu.utils import metrics
+        metrics.inc_counter("dgraph_tenant_shed_total",
+                            labels={"tenant": tenant})
+        raise Overloaded(
+            f"tenant {tenant!r} exceeded its admission rate on "
+            f"{self.node_name}; retry with jittered backoff")
 
     def _misroute_guard_query(self, q: str, variables) -> None:
         """A query naming a tablet this group MOVED AWAY must fail
@@ -1466,6 +1748,30 @@ class AlphaServer(RaftServer):
             # read at T sees exactly the commits with ts <= T.
             read_ts = int(req.get("read_ts", 0)) or None
             ctx = self._req_ctx(req)
+            if read_ts is not None and req.get("be"):
+                # watermark-bounded follower read (ANY replica,
+                # learners included): pinned at a zero-granted
+                # read_ts, served only once the local applied
+                # watermark covers it — a lagging replica degrades to
+                # a typed retry-elsewhere, never to a snapshot older
+                # than the granted timestamp. No quorum barrier: the
+                # watermark wait plays its role for a ts that was
+                # granted BEFORE the read (raft applies records in
+                # commit-ts order, so watermark >= read_ts means every
+                # commit <= read_ts has applied here).
+                self._await_watermark(read_ts, ctx)
+                with self.lock:
+                    if any(ts < read_ts
+                           for ts in self.db.pending_txns):
+                        # a decided-but-unfinalized 2PC fragment could
+                        # hold a commit <= read_ts; only the leader's
+                        # reconcile path can verify — fail over
+                        raise StaleRead(read_ts,
+                                        self._applied_watermark())
+                    out = self.db.query(
+                        req["q"], variables=req.get("vars"),
+                        read_ts=read_ts, ctx=ctx)
+                return {"ok": True, "result": out}
             if read_ts is not None:
                 # pinned read: pay the quorum barrier FIRST — a deposed
                 # leader cannot commit the no-op, so it can never serve
@@ -1769,13 +2075,21 @@ class AlphaServer(RaftServer):
                 lambda db: db.alter(ctx=ctx, **req["kw"]))
             return {"ok": True, "result": {}}
         if op == "status":
+            from dgraph_tpu.utils import metrics
             with self.lock:
+                lag = max(0, self.node.commit_index
+                          - self.node.applied_index)
+                if self.node.learner:
+                    metrics.set_gauge("dgraph_learner_lag", lag)
                 return {"ok": True, "result": {
                     "id": self.id, "group": self.group,
                     "role": self.node.role,
                     "leader": self.node.leader_id,
                     "term": self.node.term,
                     "applied": self.node.applied_index,
+                    "learner": self.node.learner,
+                    "lag": lag,
+                    "watermark": self._applied_watermark(),
                     "tablets": sorted(self.db.tablets),
                     "pending": sorted(self.db.pending_txns),
                     "max_ts": self.db.coordinator.max_assigned()}}
@@ -1801,6 +2115,14 @@ class AlphaServer(RaftServer):
             stats["requests"] = reqlog.snapshot()
             stats["netfault"] = netfault.rules()
             stats["lastHeard"] = self.peer_ages()
+            with self.lock:
+                stats["learner"] = self.node.learner
+                stats["learnerLag"] = max(
+                    0, self.node.commit_index
+                    - self.node.applied_index)
+                if self.node.learner:
+                    metrics.set_gauge("dgraph_learner_lag",
+                                      stats["learnerLag"])
             metrics.collect_process_gauges()
             stats["counters"] = metrics.counters_snapshot()
             stats["gauges"] = metrics.gauges_snapshot()
@@ -2182,6 +2504,13 @@ class AlphaServer(RaftServer):
         stats["netfault"] = netfault.rules()
         stats["lastHeard"] = self.peer_ages()
         stats["versions"] = versions_payload()
+        with self.lock:
+            stats["learner"] = self.node.learner
+            stats["learnerLag"] = max(0, self.node.commit_index
+                                      - self.node.applied_index)
+            if self.node.learner:
+                metrics.set_gauge("dgraph_learner_lag",
+                                  stats["learnerLag"])
         return stats
 
     def health_payload(self) -> dict:
@@ -2273,9 +2602,11 @@ class ZeroServer(RaftServer):
         registry (alphas register their client addrs on connect)."""
         from dgraph_tpu.cluster.client import ClusterClient
         with self.lock:
+            # learners never lead and never serve writes: the move/
+            # replication drivers talk to voters only
             addrs = {rec["id"]: tuple(rec["client"])
                      for rec in self.state.alphas.values()
-                     if rec["group"] == gid}
+                     if rec["group"] == gid and not rec.get("learner")}
         return ClusterClient(addrs, timeout=30.0) if addrs else None
 
     def _move_driver_loop(self, tick_s: float = 0.5):
@@ -2767,8 +3098,8 @@ class ZeroServer(RaftServer):
                     "moves": {p: dict(m) for p, m
                               in self.state.move_queue.items()},
                     "heat": dict(self.state.heat)}}
-        if op in ("assign_ts", "assign_uids", "commit", "txn_status",
-                  "abort_txn", "tablet", "bump_maxes",
+        if op in ("assign_ts", "read_ts", "assign_uids", "commit",
+                  "txn_status", "abort_txn", "tablet", "bump_maxes",
                   "tablet_move_start", "tablet_move_done",
                   "tablet_move_abort", "move_request", "move_phase",
                   "tablet_size", "tablet_sizes", "tablet_heat",

@@ -26,11 +26,12 @@ oracle (2PC; ref worker/mutation.go:472, zero/oracle.go:326).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Optional
 
 from dgraph_tpu.cluster.client import ClusterClient
-from dgraph_tpu.cluster.errors import TabletMisrouted
+from dgraph_tpu.cluster.errors import StaleRead, TabletMisrouted
 
 
 class SpanGroupsError(RuntimeError):
@@ -55,6 +56,16 @@ class RoutedCluster:
                  groups: dict[int, ClusterClient]):
         self.zero = zero
         self.groups = dict(groups)
+        # read scale-out state: per-group read pools spanning voters
+        # AND learners, refreshed from zero's membership so a learner
+        # joining mid-run starts taking reads without a client restart
+        # (the reference's StreamMembership push, realized as a
+        # bounded-staleness pull); `groups` itself stays voters-only —
+        # writes and pinned reads never land on a learner
+        self._read_pools: dict[int, dict] = {}
+        self._read_lock = threading.Lock()
+        self._rr = 0
+        self._read_ts_grant: tuple[int, float] = (0, -1.0)
 
     # ------------------------------------------------------------- routing
 
@@ -324,7 +335,8 @@ class RoutedCluster:
                 pass
 
     def query(self, q: str, variables: Optional[dict] = None,
-              deadline_ms: Optional[int] = None) -> dict:
+              deadline_ms: Optional[int] = None,
+              best_effort: bool = False, tenant: str = "") -> dict:
         """Route to the owning group; when a document's top-level
         blocks touch DIFFERENT groups, scatter block-wise and gather
         (the reference fans per-attr tasks to group leaders,
@@ -332,7 +344,12 @@ class RoutedCluster:
         predicate-sharded store supports without cross-group joins —
         blocks connected by variables must stay within one group).
         `deadline_ms` bounds the whole routed query: the remaining
-        budget rides every downstream RPC (groups/tasks inherit it)."""
+        budget rides every downstream RPC (groups/tasks inherit it).
+
+        `best_effort` reads spread across the group's READ POOL
+        (voters + learners) as watermark-bounded follower reads at a
+        shared zero-granted read_ts; cross-group documents fall back
+        to the leader-routed paths unchanged."""
         from dgraph_tpu.gql import parse
         from dgraph_tpu.server.acl import query_predicates
 
@@ -361,6 +378,8 @@ class RoutedCluster:
                     # each owning group (ref worker/task.go:131)
                     return self._federated_query(q, variables,
                                                  tmap, ctx)
+            if best_effort:
+                return self._be_query(gid, q, variables, ctx, tenant)
             return self.groups[gid].query(
                 q, variables,
                 deadline_ms=ctx.remaining_ms() if ctx else None)
@@ -369,6 +388,103 @@ class RoutedCluster:
         # map and re-route, bounded — queries never fence, so "is
         # being moved" cannot surface here
         return self._retry_routed(attempt)
+
+    # ------------------------------------------------- follower reads
+
+    # membership refresh cadence for the per-group read pools: a new
+    # learner starts taking reads within this bound; a dead one costs
+    # at most one failed dial per pass until the next refresh
+    READ_POOL_REFRESH_S = 2.0
+    # best-effort reads within one window share a single zero-granted
+    # read_ts (the "read_ts-class"): zero grants one ts per window
+    # instead of one per read — the grant RPC drops off the read hot
+    # path — and every replica's result cache keys the window's reads
+    # identically, so concurrent hot queries hit across requests
+    READ_TS_WINDOW_S = 0.05
+
+    def _granted_read_ts(self) -> int:
+        """The current read window's timestamp (cached ~50 ms)."""
+        now = time.monotonic()
+        with self._read_lock:
+            ts, at = self._read_ts_grant
+            if ts and now - at < self.READ_TS_WINDOW_S:
+                return ts
+        # non-bumping grant: zero's CURRENT max ts. A fresh assign_ts
+        # would stall idle clusters — no commit ever lands on a
+        # read-only allocation, so no replica's applied watermark
+        # could ever cover it
+        fresh = self.zero.read_ts()
+        with self._read_lock:
+            # two racers both fetch: keep the NEWER grant (read_ts
+            # never goes backwards within a client)
+            if fresh > self._read_ts_grant[0]:
+                self._read_ts_grant = (fresh, now)
+            return self._read_ts_grant[0]
+
+    def _read_pool(self, gid: int) -> tuple[ClusterClient, list[int]]:
+        """The read-serving client for `gid`: every replica (voters +
+        learners) from zero's membership, falling back to the write
+        client's voter addrs when zero has no record (e.g. a
+        statically-configured group that never registered)."""
+        now = time.monotonic()
+        with self._read_lock:
+            st = self._read_pools.get(gid)
+            if st is not None \
+                    and now - st["at"] < self.READ_POOL_REFRESH_S:
+                return st["client"], st["order"]
+        addrs: dict[int, tuple] = {}
+        resp = self.zero.request({"op": "cluster_state"})
+        if resp.get("ok"):
+            for rec in resp["result"].get("alphas", {}).values():
+                if int(rec.get("group", 0)) == int(gid):
+                    addrs[int(rec["id"])] = tuple(rec["client"])
+        if not addrs:
+            addrs = {n: tuple(a)
+                     for n, a in self.groups[gid].addrs.items()}
+        old = None
+        with self._read_lock:
+            st = self._read_pools.get(gid)
+            if st is not None and st["addrs"] == addrs:
+                st["at"] = now  # membership unchanged: keep the conns
+                return st["client"], st["order"]
+            client = ClusterClient(addrs)
+            if st is not None:
+                old = st["client"]
+            self._read_pools[gid] = {
+                "addrs": addrs, "client": client,
+                "order": sorted(addrs), "at": now}
+        if old is not None:
+            old.close()
+        return client, sorted(addrs)
+
+    def _be_query(self, gid: int, q: str, variables,
+                  ctx, tenant: str) -> dict:
+        """Watermark-bounded follower read: one shared read_ts, tried
+        round-robin across the group's replicas; StaleRead (replica's
+        applied watermark behind the grant) or an unreachable replica
+        rotates to the next one, and when EVERY replica fails the read
+        falls back to the leader-routed pinned read at the same
+        read_ts — which always qualifies (barrier + reconcile), so a
+        best-effort read degrades in latency, never in consistency."""
+        read_ts = self._granted_read_ts()
+        client, order = self._read_pool(gid)
+        with self._read_lock:
+            start = self._rr
+            self._rr += 1
+        for i in range(len(order)):
+            node = order[(start + i) % len(order)]
+            if ctx is not None:
+                ctx.check(f"follower read at node {node}")
+            try:
+                return client.query_at(
+                    node, q, variables, read_ts=read_ts,
+                    deadline_ms=ctx.remaining_ms() if ctx else None,
+                    tenant=tenant)
+            except (StaleRead, ConnectionError):
+                continue
+        return self.groups[gid].query(
+            q, variables, read_ts=read_ts,
+            deadline_ms=ctx.remaining_ms() if ctx else None)
 
     def _federated_query(self, q: str, variables: Optional[dict],
                          full_tmap: dict, ctx=None) -> dict:
@@ -609,6 +725,11 @@ class RoutedCluster:
     def close(self):
         self.zero.close()
         for c in self.groups.values():
+            c.close()
+        with self._read_lock:
+            pools = [st["client"] for st in self._read_pools.values()]
+            self._read_pools.clear()
+        for c in pools:
             c.close()
 
 

@@ -134,6 +134,15 @@ class ZeroState:
             first = self.max_ts + 1
             self.max_ts += int(n)
             return first
+        if op == "read_ts":
+            # non-bumping read grant for watermark-bounded follower
+            # reads: every FUTURE commit_ts is > max_ts by
+            # construction, so the snapshot at max_ts is final — a
+            # replica whose applied watermark reaches it can serve the
+            # read without waiting for a commit that will never come
+            # (a fresh assign_ts here would stall idle clusters: no
+            # commit ever lands ON a read-only allocation)
+            return self.max_ts
         if op == "assign_uids":
             (n,) = args
             first = self.next_uid
@@ -349,7 +358,12 @@ class ZeroState:
             return True
         if op == "connect":
             key, want_group, want_id, raft_addr, client_addr, \
-                replicas = args
+                replicas = args[:6]
+            # 7th arg (optional, newer alphas): non-voting learner —
+            # registered for routing/membership but excluded from
+            # replica-count placement, so a read replica never
+            # satisfies a group's WRITE-quorum replica target
+            learner = bool(args[6]) if len(args) > 6 else False
             prev = self.alphas.get(key)
             if prev is not None:
                 # idempotent reconnect (restart at the same addr):
@@ -357,18 +371,31 @@ class ZeroState:
                 gid = prev["group"]
                 prev["raft"] = tuple(raft_addr)
                 prev["client"] = tuple(client_addr)
+                if learner:
+                    prev["learner"] = True
             else:
                 counts: dict[int, int] = {}
                 for rec in self.alphas.values():
+                    if rec.get("learner"):
+                        continue  # learners don't count as replicas
                     counts[rec["group"]] = counts.get(rec["group"], 0) + 1
                 gid = int(want_group)
                 if gid <= 0:
                     # least-replicated group under the target, else a
-                    # fresh group (zero.go:410-560 replica-count join)
-                    under = [(n, g) for g, n in sorted(counts.items())
-                             if n < int(replicas)]
-                    gid = min(under)[1] if under else \
-                        (max(counts) + 1 if counts else 1)
+                    # fresh group (zero.go:410-560 replica-count join).
+                    # A learner joins the least-LOADED existing group
+                    # instead of founding one: a group of only
+                    # learners could never elect a leader.
+                    if learner and counts:
+                        under = sorted((n, g)
+                                       for g, n in counts.items())
+                        gid = under[0][1]
+                    else:
+                        under = [(n, g)
+                                 for g, n in sorted(counts.items())
+                                 if n < int(replicas)]
+                        gid = min(under)[1] if under else \
+                            (max(counts) + 1 if counts else 1)
                 if int(want_id) > 0:
                     # explicit-group member registering its REAL raft
                     # id: a record in this group with the same id but
@@ -387,8 +414,11 @@ class ZeroState:
                     "group": gid, "id": nid,
                     "raft": tuple(raft_addr),
                     "client": tuple(client_addr)}
+                if learner:
+                    self.alphas[key]["learner"] = True
             members = {rec["id"]: {"raft": rec["raft"],
-                                   "client": rec["client"]}
+                                   "client": rec["client"],
+                                   "learner": bool(rec.get("learner"))}
                        for rec in self.alphas.values()
                        if rec["group"] == gid}
             return {"group": gid, "id": self.alphas[key]["id"],

@@ -139,7 +139,8 @@ class GraphDB:
                  vec_target_recall: float = 0.98,
                  vec_nprobe: int | None = None,
                  vec_rerank: int | None = None,
-                 vec_max_k: int = 128):
+                 vec_max_k: int = 128,
+                 result_cache_entries: int = 0):
         from dgraph_tpu.engine.tile_cache import DeviceCacheLRU
         from dgraph_tpu.ops.codec import DecodeScratch
         from dgraph_tpu.query.plan import PlanCache
@@ -284,6 +285,17 @@ class GraphDB:
         # {"op": "subscribe"} wire op (cluster/service.py).
         from dgraph_tpu.cdc.changelog import CdcPlane
         self.cdc = CdcPlane()
+        # CDC-invalidated result cache (engine/result_cache.py): full
+        # serialized responses keyed on the plan skeleton, invalidated
+        # per predicate by the local change log's observer — the PR 12
+        # offsets are replica-consistent, so every replica of a group
+        # invalidates identically. 0 (the default) disables: the
+        # query path takes zero new branches.
+        self.result_cache = None
+        if result_cache_entries:
+            from dgraph_tpu.engine.result_cache import ResultCache
+            self.result_cache = ResultCache(result_cache_entries)
+            self.cdc.on_invalidate = self.result_cache.invalidate
         self.wal = Wal(wal_path, key=enc_key) if wal_path else None
         # optional record sink: Raft replication taps the same durable
         # record stream the WAL gets (cluster/replica.py)
@@ -384,7 +396,8 @@ class GraphDB:
         out.setdefault("extensions", {})["server_latency"] = sl
         reqlog.record("mutate",
                       trace_id=ctx.trace_id if ctx is not None else "",
-                      latency_ms=total / 1e6, breakdown=sl)
+                      latency_ms=total / 1e6, breakdown=sl,
+                      tenant=getattr(ctx, "tenant", ""))
         return out
 
     def _mutate_inner(self, txn: Optional[Txn] = None, *,
@@ -1014,6 +1027,58 @@ class GraphDB:
     # Query (ref edgraph/server.go:634 Query -> query.Process)
     # ------------------------------------------------------------------
 
+    def _result_cache_probe(self, q, variables, txn, best_effort,
+                            read_ts, explain, mode):
+        """(cache key, predicate footprint) when this request may
+        serve from / fill the result cache, else (None, None).
+
+        Eligible: best-effort reads (watermark reads, and the
+        follower-read path's explicitly pinned `read_ts` — the shared
+        per-window grant makes those keys collide across requests,
+        which is the point). Bypassed: txn reads (their snapshot is
+        the txn's, not a shared class), strict reads (they allocate a
+        fresh ts), explain (annotations vary per execution), schema
+        introspection, expand() blocks (the predicate footprint is
+        unknowable from the skeleton) and unhashable params."""
+        rc = self.result_cache
+        if rc is None or txn is not None or explain is not None \
+                or not best_effort or self.plan_cache is None:
+            return None, None
+        from dgraph_tpu.query.plan import skeleton
+        from dgraph_tpu.server.acl import query_predicates
+
+        parsed, _struct, skel = self.plan_cache.parse(q, variables)
+        if parsed.schema_request is not None \
+                or getattr(parsed, "explain", ""):
+            return None, None
+
+        def has_expand(g) -> bool:
+            return bool(getattr(g, "expand", "")) \
+                or any(has_expand(c) for c in g.children)
+
+        if any(has_expand(gq) for gq in parsed.queries):
+            return None, None
+        preds = {p.lstrip("~") for p in query_predicates(parsed)}
+        if not preds:
+            return None, None  # uid-only: nothing to invalidate on
+        struct, params = skeleton(parsed)
+        try:
+            hash(params)
+        except TypeError:
+            return None, None
+        kind = ("ts", int(read_ts)) if read_ts is not None else ("be",)
+        return (mode, skel, struct, params, kind,
+                self.schema_epoch), preds
+
+    def _result_cache_gen(self, key):
+        """Fill-race guard generation for a ("be",) keyed entry: a
+        result computed BEFORE a concurrent commit must not be stored
+        AFTER that commit's invalidation swept the cache — put()
+        discards the fill when the generation moved. ("ts", T) entries
+        are immutable by MVCC; no guard needed."""
+        return self.result_cache.generation \
+            if key is not None and key[4][0] == "be" else None
+
     def query(self, q: str, variables: dict | None = None,
               txn: Optional[Txn] = None, best_effort: bool = True,
               read_ts: Optional[int] = None, ctx=None,
@@ -1030,6 +1095,17 @@ class GraphDB:
         counters — under `extensions.explain`. The DATA payload is
         byte-identical with or without it: explain annotates a normal
         execution, it never changes one."""
+        import copy as _copy
+        t_in = time.perf_counter_ns()
+        rc_key, rc_fp = self._result_cache_probe(
+            q, variables, txn, best_effort, read_ts, explain, "py")
+        if rc_key is not None:
+            hit = self.result_cache.get(rc_key)
+            if hit is not None:
+                self._result_cache_hit_metrics(
+                    ctx, rc_key[1], time.perf_counter_ns() - t_in)
+                return _copy.deepcopy(hit)  # callers may mutate
+        rc_gen = self._result_cache_gen(rc_key)
         with bind_request(ctx), _span("query") as sp:
             ex, done, lat, read_ts, expinfo = self._query_run(
                 q, variables, txn, best_effort, read_ts, ctx, sp,
@@ -1057,7 +1133,13 @@ class GraphDB:
                "txn": {"start_ts": read_ts}}
         if expl is not None:
             ext["explain"] = expl
-        return {"data": data, "extensions": ext}
+        out = {"data": data, "extensions": ext}
+        if rc_key is not None:
+            # stored verbatim (deep-copied): a later hit serves the
+            # exact response this execution produced
+            self.result_cache.put(rc_key, rc_fp, _copy.deepcopy(out),
+                                  gen=rc_gen)
+        return out
 
     def _schema_rows(self, req: dict) -> list[dict]:
         """`schema {}` introspection rows, the reference's response
@@ -1182,7 +1264,24 @@ class GraphDB:
         reqlog.record("query",
                       trace_id=ctx.trace_id if ctx is not None else "",
                       latency_ms=sl["total_ns"] / 1e6, breakdown=sl,
-                      plan_key=_skel_of(plan))
+                      plan_key=_skel_of(plan),
+                      tenant=getattr(ctx, "tenant", ""))
+
+    def _result_cache_hit_metrics(self, ctx, skel: str,
+                                  total_ns: int):
+        """A cache hit is still a served query: it must land in the
+        query counters and the request log (tenant included), or the
+        hottest queries vanish from observability exactly when the
+        cache starts working."""
+        metrics.inc_counter("dgraph_num_queries_total")
+        metrics.observe("dgraph_query_latency_ms", total_ns / 1e6)
+        sl = {"parsing_ns": 0, "processing_ns": 0,
+              "encoding_ns": 0, "total_ns": int(total_ns)}
+        reqlog.record("query",
+                      trace_id=ctx.trace_id if ctx is not None else "",
+                      latency_ms=total_ns / 1e6, breakdown=sl,
+                      plan_key=skel,
+                      tenant=getattr(ctx, "tenant", ""))
 
     def query_json(self, q: str, variables: dict | None = None,
                    txn: Optional[Txn] = None, best_effort: bool = True,
@@ -1197,6 +1296,16 @@ class GraphDB:
         users who want Python objects keep query(). `explain` as in
         query(): the `data` bytes are identical either way, the plan
         tree rides in `extensions.explain`."""
+        t_in = time.perf_counter_ns()
+        rc_key, rc_fp = self._result_cache_probe(
+            q, variables, txn, best_effort, read_ts, explain, "json")
+        if rc_key is not None:
+            hit = self.result_cache.get(rc_key)
+            if hit is not None:
+                self._result_cache_hit_metrics(
+                    ctx, rc_key[1], time.perf_counter_ns() - t_in)
+                return hit  # the stored string: byte-identical
+        rc_gen = self._result_cache_gen(rc_key)
         with bind_request(ctx), _span("query") as sp:
             ex, done, lat, read_ts, expinfo = self._query_run(
                 q, variables, txn, best_effort, read_ts, ctx, sp,
@@ -1230,7 +1339,10 @@ class GraphDB:
         if expl is not None:
             ext_obj["explain"] = expl
         ext = _json.dumps(ext_obj)
-        return '{"data":' + data_json + ',"extensions":' + ext + "}"
+        body = '{"data":' + data_json + ',"extensions":' + ext + "}"
+        if rc_key is not None:
+            self.result_cache.put(rc_key, rc_fp, body, gen=rc_gen)
+        return body
 
     # ------------------------------------------------------------------
     # Bulk traversal API: the device-first equivalent of @recurse for
@@ -1510,6 +1622,8 @@ class GraphDB:
             "schemaEpoch": self.schema_epoch,
             "tablets": tablets,
             "cdc": self.cdc.stats(),
+            "resultCache": self.result_cache.stats()
+            if self.result_cache is not None else None,
             "cost": coststore.summary(),
             "costStore": coststore.stats(),
             "deviceCache": self.device_cache.stats(),

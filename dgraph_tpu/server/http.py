@@ -76,7 +76,9 @@ class AlphaServer:
                  acl_secret: Optional[bytes] = None,
                  mutations_mode: str = "allow",
                  max_pending: int = 0,
-                 batch_window_us: int = 0):
+                 batch_window_us: int = 0,
+                 tenant_rate: float = 0.0,
+                 tenant_burst: float = 0.0):
         if mutations_mode not in ("allow", "disallow", "strict"):
             raise ValueError(
                 "--mutations argument must be one of allow, disallow, "
@@ -104,6 +106,14 @@ class AlphaServer:
         self.max_pending = max_pending
         self._admission = threading.Lock()
         self._inflight = 0
+        # per-tenant QoS layered UNDER max_pending (server/qos.py):
+        # one hot tenant exhausts its own token bucket and degrades
+        # to 429s while the shared in-flight budget stays available
+        # to every other tenant. 0 = off.
+        self.qos = None
+        if tenant_rate > 0:
+            from dgraph_tpu.server.qos import TenantQos
+            self.qos = TenantQos(rate=tenant_rate, burst=tenant_burst)
         # trace id -> live RequestContexts, for /admin/cancel. A LIST:
         # trace ids are client-chosen, so an impatient retry can put
         # two live requests under one id — cancel hits them all, and
@@ -184,9 +194,22 @@ class AlphaServer:
         with Overloaded (-> 429, retryable) when max_pending slots are
         taken; a request that dies mid-flight (deadline, cancellation,
         any error) releases its slot in the finally. An already-dead
-        context is rejected before it takes a slot."""
+        context is rejected before it takes a slot.
+
+        Tenant QoS runs before the shared gate: a tenant over its own
+        rate sheds on its bucket without consuming an in-flight slot
+        (untagged requests bill to "default"), so one hot tenant
+        degrades to 429s while the rest keep their budget."""
         if ctx is not None:
             ctx.check("admission")
+        if self.qos is not None:
+            tenant = getattr(ctx, "tenant", "") or "default"
+            if not self.qos.admit(tenant):
+                metrics.inc_counter("dgraph_tenant_shed_total",
+                                    labels={"tenant": tenant})
+                raise Overloaded(
+                    f"tenant {tenant!r} exceeded its admission rate; "
+                    "retry with jittered backoff")
         with self._admission:
             if self.max_pending and self._inflight >= self.max_pending:
                 metrics.inc_counter("dgraph_queries_shed_total")
@@ -223,18 +246,21 @@ class AlphaServer:
         admission and would otherwise be invisible."""
         t0 = time.perf_counter()
         tid = ctx.trace_id if ctx is not None else ""
+        tenant = getattr(ctx, "tenant", "")
         try:
             yield
         except Exception as e:
             reqlog.record(op, trace_id=tid,
                           latency_ms=(time.perf_counter() - t0) * 1e3,
-                          outcome=reqlog.outcome_of(e))
+                          outcome=reqlog.outcome_of(e),
+                          tenant=tenant)
             raise
         else:
             if op in ("commit", "alter"):
                 reqlog.record(
                     op, trace_id=tid,
-                    latency_ms=(time.perf_counter() - t0) * 1e3)
+                    latency_ms=(time.perf_counter() - t0) * 1e3,
+                    tenant=tenant)
 
     def pending(self) -> int:
         with self._admission:
@@ -877,6 +903,9 @@ class _Handler(BaseHTTPRequestHandler):
         context — zero overhead for plain requests."""
         dl = self.headers.get("X-Dgraph-Deadline-Ms", "")
         tid = self.headers.get("X-Dgraph-Trace-Id", "")
+        # QoS accounting namespace: the tenant rides the context into
+        # admission (token buckets), reqlog and metrics
+        tenant = self.headers.get("X-Dgraph-Tenant", "").strip()
         parent = ""
         got = tracing.parse_traceparent(
             self.headers.get("traceparent", ""))
@@ -886,14 +915,16 @@ class _Handler(BaseHTTPRequestHandler):
         if dl:
             try:
                 return RequestContext.from_deadline_ms(
-                    int(dl), trace_id=tid, parent_span=parent)
+                    int(dl), trace_id=tid, parent_span=parent,
+                    tenant=tenant)
             except ValueError:
                 raise ValueError(
                     f"X-Dgraph-Deadline-Ms must be an integer ms "
                     f"budget, got {dl!r}") from None
-        if tid:
+        if tid or tenant:
             return RequestContext.background(trace_id=tid,
-                                             parent_span=parent)
+                                             parent_span=parent,
+                                             tenant=tenant)
         return None
 
     def do_GET(self):
@@ -1048,7 +1079,8 @@ def serve(db: Optional[GraphDB] = None, host: str = "127.0.0.1",
           port: int = 8080, block: bool = True,
           acl_secret: Optional[bytes] = None,
           tls_context=None, mutations_mode: str = "allow",
-          max_pending: int = 0, batch_window_us: int = 0
+          max_pending: int = 0, batch_window_us: int = 0,
+          tenant_rate: float = 0.0, tenant_burst: float = 0.0
           ) -> tuple[ThreadingHTTPServer, AlphaServer]:
     """Start the Alpha HTTP server. With block=False, runs in a daemon
     thread and returns (httpd, alpha) for tests/embedding. Pass an
@@ -1056,11 +1088,15 @@ def serve(db: Optional[GraphDB] = None, host: str = "127.0.0.1",
     like the reference's --tls options (x/tls_helper.go).
     `max_pending` bounds concurrently admitted requests (0 = off);
     excess load sheds with 429. `batch_window_us` coalesces concurrent
-    same-plan queries into one dispatch (0 = off)."""
+    same-plan queries into one dispatch (0 = off). `tenant_rate`/
+    `tenant_burst` enable per-tenant QoS token buckets keyed on the
+    X-Dgraph-Tenant header (0 = off)."""
     alpha = AlphaServer(db, acl_secret=acl_secret,
                         mutations_mode=mutations_mode,
                         max_pending=max_pending,
-                        batch_window_us=batch_window_us)
+                        batch_window_us=batch_window_us,
+                        tenant_rate=tenant_rate,
+                        tenant_burst=tenant_burst)
     handler = type("BoundHandler", (_Handler,), {"alpha": alpha})
     httpd = ThreadingHTTPServer((host, port), handler)
     if tls_context is not None:

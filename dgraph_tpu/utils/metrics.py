@@ -106,6 +106,15 @@ REGISTERED = (
     "dgraph_repl_lag_entries",
     "dgraph_repl_promote_rto_ms",
     "dgraph_repl_streamed_bytes_total",
+    # read scale-out serving tier (engine/result_cache.py,
+    # cluster/service.py learner/follower reads, server/qos.py)
+    "dgraph_learner_lag",
+    "dgraph_result_cache_entries",
+    "dgraph_result_cache_hits_total",
+    "dgraph_result_cache_invalidations_total",
+    "dgraph_result_cache_misses_total",
+    "dgraph_stale_reads_total",
+    "dgraph_tenant_shed_total",
     # network fault plane (utils/netfault.py)
     "dgraph_net_fault_delays_total",
     "dgraph_net_fault_drops_total",
@@ -136,6 +145,13 @@ def inc_counter(name: str, value: float = 1, labels: dict | None = None):
 def set_gauge(name: str, value: float, labels: dict | None = None):
     with _LOCK:
         _GAUGES[_key(name, labels)] = value
+
+
+def get_counter(name: str, labels: dict | None = None) -> float:
+    """One counter's current value (0 when never incremented) — for
+    derived stats like the result cache's hit rate."""
+    with _LOCK:
+        return _COUNTERS.get(_key(name, labels), 0.0)
 
 
 def observe(name: str, value_ms: float, labels: dict | None = None):

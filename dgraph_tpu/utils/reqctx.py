@@ -62,10 +62,12 @@ class RequestContext:
     thread.
     """
 
-    __slots__ = ("deadline", "trace_id", "parent_span", "_cancel")
+    __slots__ = ("deadline", "trace_id", "parent_span", "tenant",
+                 "_cancel")
 
     def __init__(self, deadline: Optional[float] = None,
-                 trace_id: str = "", parent_span: str = ""):
+                 trace_id: str = "", parent_span: str = "",
+                 tenant: str = ""):
         self.deadline = deadline  # absolute time.monotonic(), or None
         self.trace_id = trace_id or uuid.uuid4().hex[:16]
         # span id of the CALLER's span on the other side of the wire
@@ -73,6 +75,10 @@ class RequestContext:
         # the serving edge binds it so this node's spans link into the
         # originating trace (utils/tracing.bind_request)
         self.parent_span = parent_span or ""
+        # ACL namespace / tenant the request bills against (the QoS
+        # plane's token-bucket key and the reqlog `tenant` field);
+        # empty = untagged, accounted as "default" at admission
+        self.tenant = tenant or ""
         self._cancel = threading.Event()
 
     # -------------------------------------------------- constructors
@@ -80,31 +86,35 @@ class RequestContext:
     @classmethod
     def with_timeout(cls, seconds: Optional[float],
                      trace_id: str = "",
-                     parent_span: str = "") -> "RequestContext":
+                     parent_span: str = "",
+                     tenant: str = "") -> "RequestContext":
         """Context expiring `seconds` from now (None = no deadline)."""
         dl = None if seconds is None else time.monotonic() + max(
             0.0, float(seconds))
         return cls(deadline=dl, trace_id=trace_id,
-                   parent_span=parent_span)
+                   parent_span=parent_span, tenant=tenant)
 
     @classmethod
     def from_deadline_ms(cls, ms, trace_id: str = "",
                          skew_s: float = 0.0,
-                         parent_span: str = "") -> "RequestContext":
+                         parent_span: str = "",
+                         tenant: str = "") -> "RequestContext":
         """Context from a wire-propagated remaining budget in ms (the
         `deadline_ms` RPC field / `X-Dgraph-Deadline-Ms` header).
         `skew_s` widens the budget for workers inheriting it over the
         network (PROPAGATION_SKEW_S)."""
         return cls.with_timeout(int(ms) / 1000.0 + skew_s,
                                 trace_id=trace_id,
-                                parent_span=parent_span)
+                                parent_span=parent_span,
+                                tenant=tenant)
 
     @classmethod
     def background(cls, trace_id: str = "",
-                   parent_span: str = "") -> "RequestContext":
+                   parent_span: str = "",
+                   tenant: str = "") -> "RequestContext":
         """No deadline, cancellable — internal/maintenance work."""
         return cls(deadline=None, trace_id=trace_id,
-                   parent_span=parent_span)
+                   parent_span=parent_span, tenant=tenant)
 
     # ------------------------------------------------------- queries
 

@@ -62,16 +62,20 @@ def bind_batch(batch_id: str) -> Iterator[None]:
 def record(op: str, trace_id: str = "", latency_ms: float = 0.0,
            outcome: str = "ok",
            breakdown: Optional[dict] = None,
-           plan_key: str = "", batch_id: str = "") -> None:
+           plan_key: str = "", batch_id: str = "",
+           tenant: str = "") -> None:
     """`plan_key` is the compiled plan's 16-hex skeleton hash ("" for
     interpreted requests) — the join key into the plan cache and the
     coststore's per-plan summaries; `batch_id` joins against the
-    micro-batcher's dispatch (defaults to the bound batch context)."""
+    micro-batcher's dispatch (defaults to the bound batch context);
+    `tenant` is the QoS plane's accounting namespace ("" = untagged),
+    so /debug/requests answers "whose requests were shed"."""
     rec = {"op": str(op), "trace_id": str(trace_id),
            "latency_ms": round(float(latency_ms), 3),
            "outcome": str(outcome), "node": tracing.node(),
            "plan_key": str(plan_key),
            "batch_id": str(batch_id) or _BATCH_CV.get(),
+           "tenant": str(tenant),
            # wall clock: operators correlate these with external logs
            "ts": time.time()}  # dglint: disable=DG06
     if breakdown:

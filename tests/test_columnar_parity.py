@@ -118,10 +118,11 @@ def _run_all(db, read_ts=None):
 
 
 def _build(prefer_columnar: bool, prefer_compressed: bool = False,
-           planner: str = "static"):
+           planner: str = "static", result_cache_entries: int = 0):
     rng = random.Random(SEED)
     db = GraphDB(prefer_device=False, prefer_columnar=prefer_columnar,
-                 prefer_compressed=prefer_compressed, planner=planner)
+                 prefer_compressed=prefer_compressed, planner=planner,
+                 result_cache_entries=result_cache_entries)
     db.alter(schema_text=SCHEMA)
     db.mutate(set_nquads="\n".join(_dataset(rng)))
     db.rollup_all()  # the "clean store" premise: tiers may serve
@@ -147,6 +148,16 @@ def adaptive_db():
     return _build(True, prefer_compressed=True, planner="adaptive")
 
 
+@pytest.fixture(scope="module")
+def cached_db():
+    """The CDC-invalidated result cache armed over the full tier
+    stack: cache hits AND post-invalidation re-executions must stay
+    byte-identical to the postings oracle — _run_all's best-effort
+    reads are exactly the cacheable class."""
+    return _build(True, prefer_compressed=True,
+                  result_cache_entries=256)
+
+
 def _assert_threeway(runs: dict[str, dict], where: str):
     names = list(runs)
     base = runs[names[0]]
@@ -159,16 +170,22 @@ def _assert_threeway(runs: dict[str, dict], where: str):
                 f"\n{other}: {got[i][:800]}"
 
 
-def test_parity_clean(dbs, adaptive_db):
+def test_parity_clean(dbs, adaptive_db, cached_db):
     comp, col, post = dbs
     # the compressed tier actually served (not silently disabled)
     from dgraph_tpu.utils import metrics
     before = metrics.counters_snapshot()
     runs = {"compressed": _run_all(comp), "columnar": _run_all(col),
             "postings": _run_all(post),
-            "adaptive": _run_all(adaptive_db)}
+            "adaptive": _run_all(adaptive_db),
+            "cache-fill": _run_all(cached_db),
+            # second pass serves from the result cache: hits must be
+            # the fill's exact bytes (asserted against EVERY arm)
+            "cache-hit": _run_all(cached_db)}
     delta = metrics.counters_delta(before)
     assert delta.get("query_compressed_setops_total", 0) > 0
+    # the cached arm actually served hits (not silently bypassed)
+    assert delta.get("dgraph_result_cache_hits_total", 0) > 0
     # the adaptive arm made real decisions (not silently static)
     assert adaptive_db.planner_impl.stats()["decisions"] > 0
     _assert_threeway(runs, "clean")
@@ -182,24 +199,28 @@ def test_parity_clean(dbs, adaptive_db):
                      "clean-settled")
 
 
-def test_parity_dirty_overlay(dbs, adaptive_db):
+def test_parity_dirty_overlay(dbs, adaptive_db, cached_db):
     """Mutate all stores WITHOUT rollup: the delta overlay is live,
     the columnar AND compressed tiers must fall back / merge
-    row-exactly."""
+    row-exactly. The cached arm enters this test warm from
+    test_parity_clean — the CDC invalidation hook must drop every
+    entry the edits touch, or its reads would serve the PRE-EDIT
+    bytes and diverge from the oracle here."""
     comp, col, post = dbs
     edits = []
     rng = random.Random(SEED + 1)
     for i in rng.sample(range(1, 400), 60):
         edits.append(f'<0x{i:x}> <name> "Edited {i}" .')
         edits.append(f'<0x{i:x}> <score> "{rng.randint(0, 99) / 10}" .')
-    for db in (comp, col, post, adaptive_db):
+    for db in (comp, col, post, adaptive_db, cached_db):
         db.rollup_in_read = False  # keep the overlay live during reads
         db.mutate(set_nquads="\n".join(edits))
         assert any(t.dirty() for t in db.tablets.values())
     _assert_threeway({"compressed": _run_all(comp),
                       "columnar": _run_all(col),
                       "postings": _run_all(post),
-                      "adaptive": _run_all(adaptive_db)},
+                      "adaptive": _run_all(adaptive_db),
+                      "cached": _run_all(cached_db)},
                      "dirty-overlay")
 
 

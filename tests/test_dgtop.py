@@ -275,3 +275,57 @@ def test_replication_panel_renders():
     assert "standby" in frame and "up" in frame
     # an ordinary primary has no panel at all
     assert "REPLICATION" not in render({"zero-n1": _snap()})
+
+def _serving_snap(t=100.0, hits=30.0, misses=10.0, inval=5.0,
+                  stale=0.0, learner=False, lag=0, sheds=None):
+    s = _snap(t=t)
+    s["stats"]["resultCache"] = {
+        "entries": 12, "capacity": 512, "preds": 3,
+        "hits": hits, "misses": misses,
+        "hitRate": hits / (hits + misses) if hits + misses else 0.0,
+        "invalidations": inval}
+    s["stats"]["learner"] = learner
+    s["stats"]["learnerLag"] = lag
+    s["stats"]["counters"].update({
+        "dgraph_result_cache_invalidations_total": inval,
+        "dgraph_stale_reads_total": stale})
+    for tenant, n in (sheds or {}).items():
+        s["stats"]["counters"][
+            f'dgraph_tenant_shed_total{{tenant="{tenant}"}}'] = n
+    return s
+
+
+def test_serving_rows_cache_learner_and_tenants():
+    from tools.dgtop import serving_rows
+    a = _serving_snap(t=100.0, inval=5.0, stale=1.0,
+                      sheds={"hog": 10.0, "quiet": 0.0})
+    b = _serving_snap(t=102.0, inval=9.0, stale=3.0, learner=True,
+                      lag=4, sheds={"hog": 30.0, "quiet": 0.0})
+    # first frame: absolute counts
+    (row,), tens = serving_rows({"n1": a}, None)
+    assert row["hit_rate"] == pytest.approx(0.75)
+    assert row["entries"] == 12 and row["capacity"] == 512
+    assert row["learner"] is False and row["lag"] == 0
+    assert row["watermark"] == 42
+    assert row["inval_rate"] == 5.0 and row["stale_rate"] == 1.0
+    assert tens == [{"node": "n1", "tenant": "hog",
+                     "shed_rate": 10.0}]  # zero-rate tenants omitted
+    # second frame: deltas over dt; learner role + lag surface
+    (row,), tens = serving_rows({"n1": b}, {"n1": a})
+    assert row["learner"] is True and row["lag"] == 4
+    assert row["inval_rate"] == pytest.approx(2.0)  # (9-5)/2s
+    assert row["stale_rate"] == pytest.approx(1.0)
+    assert tens[0]["shed_rate"] == pytest.approx(10.0)  # (30-10)/2
+    # a plain node (no cache, no learner, no sheds) renders no row
+    nodes, tens = serving_rows({"plain": _snap(), "down": None}, None)
+    assert nodes == [] and tens == []
+
+
+def test_serving_panel_renders():
+    frame = render({"n1": _serving_snap(learner=True, lag=2,
+                                        sheds={"hog": 7.0})})
+    assert "SERVING" in frame and "learner" in frame
+    assert "TENANT SHEDS" in frame and "hog" in frame
+    assert "CACHE%" in frame and "75" in frame
+    # the panel disappears on a plain write-path cluster
+    assert "SERVING" not in render({"n1": _snap()})

@@ -1,0 +1,321 @@
+"""The read scale-out serving tier, unit level: learner replicas at
+the Raft layer (non-voting, quorum-excluded, promotable), the
+CDC-invalidated result cache (LRU bound, footprint invalidation,
+truncation wholesale, the generation fill-race guard), per-tenant QoS
+token buckets, and the engine-level wiring of cache invalidation to
+the change log — including truncation events (floor reset / tablet
+drop / clear), which must drop derived results wholesale.
+
+The live end-to-end counterpart (ProcessCluster with a real learner,
+routed reads, tenant shed isolation) is tools/scaleout_smoke.py.
+"""
+
+import json
+
+import pytest
+
+from dgraph_tpu.cluster.raft import (
+    FOLLOWER, LEADER, VOTE_REQ, Msg, RaftNode)
+from dgraph_tpu.engine.result_cache import ResultCache
+from dgraph_tpu.server.qos import TenantQos
+
+# --------------------------------------------------------------- raft
+
+
+def _pump(nodes: dict[int, RaftNode],
+          blocked: set[int] = frozenset()) -> dict[int, list]:
+    """Deterministically drain every node's outbox until quiet;
+    messages to/from `blocked` ids are dropped. Returns the entries
+    each node applied during the drain."""
+    applied: dict[int, list] = {i: [] for i in nodes}
+    for _ in range(50):
+        moved = False
+        for i, n in nodes.items():
+            r = n.ready()
+            for e in r.committed:
+                if e.data is not None:
+                    applied[i].append(e.data)
+            for m in r.msgs:
+                if m.to in nodes and m.to not in blocked \
+                        and m.frm not in blocked:
+                    nodes[m.to].step(m)
+                    moved = True
+        if not moved:
+            break
+    return applied
+
+
+def _two_voters_one_learner():
+    """Voters {1, 2} with 1 elected leader, plus learner 3 attached
+    and caught up (the AlphaServer add_learner conf-change shape)."""
+    n1 = RaftNode(1, [1, 2], election_ticks=4)
+    n2 = RaftNode(2, [1, 2], election_ticks=50)
+    n3 = RaftNode(3, [3], learner=True)  # knows only itself, like
+    #                                      `node --learner` at boot
+    nodes = {1: n1, 2: n2, 3: n3}
+    for _ in range(10):
+        n1.tick()
+        _pump(nodes)
+        if n1.role == LEADER:
+            break
+    assert n1.role == LEADER
+    n1.add_learner(3)
+    n1.tick()  # heartbeat reaches the learner; wakes + catches up
+    _pump(nodes)
+    return nodes
+
+
+def test_learner_never_campaigns_or_votes():
+    n = RaftNode(3, [3], learner=True, election_ticks=2)
+    for _ in range(100):
+        n.tick()
+    r = n.ready()
+    assert n.role == FOLLOWER and not r.msgs, \
+        "a learner campaigned (it must wait for appends forever)"
+    # an explicit vote request is refused even with a stale local log
+    n.step(Msg(VOTE_REQ, frm=9, to=3, term=99,
+               last_log_index=50, last_log_term=9))
+    (resp,) = n.ready().msgs
+    assert resp.granted is False, "a learner granted a vote"
+
+
+def test_learner_replicates_but_never_counts_toward_quorum():
+    nodes = _two_voters_one_learner()
+    n1, n3 = nodes[1], nodes[3]
+    base = n1.commit_index
+    # voter 2 dark: the learner still acks, but its progress must
+    # never advance the leader's commit index
+    assert n1.propose("only-learner-acked")
+    _pump(nodes, blocked={2})
+    for _ in range(4):
+        n1.tick()
+        _pump(nodes, blocked={2})
+    assert n1.commit_index == base, \
+        "learner ack advanced the voter quorum"
+    assert n3.last_index() > base  # ...yet the learner HAS the entry
+    # voter 2 back: the entry commits, and the next heartbeats carry
+    # the advanced commit index to the learner, which applies it
+    got3: list = []
+    for _ in range(8):
+        n1.tick()
+        got3 += _pump(nodes)[3]
+        if got3:
+            break
+    assert n1.commit_index > base
+    assert "only-learner-acked" in got3, \
+        "learner never applied the committed entry"
+
+
+def test_learner_promotion_joins_the_quorum():
+    nodes = _two_voters_one_learner()
+    n1, n3 = nodes[1], nodes[3]
+    # promote: leader counts 3 as a voter, 3 stops being a learner
+    n1.add_peer(3)
+    n3.add_peer(3)  # self-add flips the learner flag off
+    assert not n3.learner and 3 in n1.peers \
+        and 3 not in n1.learners
+    # with voter 2 dark, quorum of {1, 2, 3} is 2: leader + promoted
+    # learner commit on their own — exactly what a learner cannot do
+    base = n1.commit_index
+    assert n1.propose("promoted-acks")
+    _pump(nodes, blocked={2})
+    for _ in range(4):
+        n1.tick()
+        _pump(nodes, blocked={2})
+    assert n1.commit_index > base, \
+        "promoted learner still excluded from the quorum"
+
+
+# ------------------------------------------------------- result cache
+
+
+def test_result_cache_lru_bound_and_reverse_index():
+    rc = ResultCache(entries=2)
+    rc.put(("a",), ["p1"], "va")
+    rc.put(("b",), ["p1", "p2"], "vb")
+    assert rc.get(("a",)) == "va"  # refreshes a's LRU slot
+    rc.put(("c",), ["p3"], "vc")  # evicts b (least recent)
+    assert rc.get(("b",)) is None
+    assert rc.get(("a",)) == "va" and rc.get(("c",)) == "vc"
+    # b's eviction unindexed it: invalidating p2 drops nothing
+    assert rc.invalidate(["p2"]) == 0
+    st = rc.stats()
+    assert st["entries"] == 2 and st["capacity"] == 2
+
+
+def test_result_cache_footprint_invalidation():
+    rc = ResultCache(entries=16)
+    rc.put(("a",), ["name", "age"], "va")
+    rc.put(("b",), ["age"], "vb")
+    rc.put(("c",), ["city"], "vc")
+    assert rc.invalidate(["age"]) == 2  # a and b touch age
+    assert rc.get(("a",)) is None and rc.get(("b",)) is None
+    assert rc.get(("c",)) == "vc", "untouched footprint evicted"
+
+
+def test_result_cache_truncation_drops_wholesale():
+    rc = ResultCache(entries=16)
+    rc.put(("a",), ["p1"], "va")
+    rc.put(("b",), ["p2"], "vb")
+    assert rc.invalidate(None) == 2  # clear(): everything goes
+    assert rc.get(("a",)) is None and rc.get(("b",)) is None
+    assert rc.stats()["entries"] == 0
+
+
+def test_result_cache_generation_guards_fill_races():
+    rc = ResultCache(entries=16)
+    gen = rc.generation
+    # an invalidation lands between the result's computation and its
+    # store: the stale fill MUST be discarded (it reflects a snapshot
+    # the sweep could never reach)
+    rc.invalidate(["name"])
+    rc.put(("a",), ["name"], "stale", gen=gen)
+    assert rc.get(("a",)) is None, "stale fill survived the sweep"
+    # a fill whose generation is current stores normally
+    rc.put(("a",), ["name"], "fresh", gen=rc.generation)
+    assert rc.get(("a",)) == "fresh"
+
+
+# --------------------------------------------------------- tenant qos
+
+
+def test_qos_burst_then_shed_then_refill():
+    clock = [0.0]
+    qos = TenantQos(rate=10.0, burst=3.0, clock=lambda: clock[0])
+    assert [qos.admit("t") for _ in range(4)] == \
+        [True, True, True, False]
+    clock[0] += 0.1  # one token refilled at rate 10/s
+    assert qos.admit("t") is True
+    assert qos.admit("t") is False
+
+
+def test_qos_shed_spends_nothing():
+    clock = [0.0]
+    qos = TenantQos(rate=1.0, burst=1.0, clock=lambda: clock[0])
+    assert qos.admit("t")
+    # a storm of rejected requests must not push the bucket into
+    # debt: exactly one refill interval later the tenant recovers
+    for _ in range(100):
+        assert not qos.admit("t")
+    clock[0] += 1.0
+    assert qos.admit("t") is True
+
+
+def test_qos_tenants_are_isolated():
+    clock = [0.0]
+    qos = TenantQos(rate=5.0, burst=2.0, clock=lambda: clock[0])
+    while qos.admit("hog"):
+        pass
+    assert qos.admit("quiet") is True, "hog drained quiet's bucket"
+    assert qos.level("quiet") == pytest.approx(1.0)
+
+
+def test_qos_defaults_and_validation():
+    qos = TenantQos(rate=7.0)  # burst <= 0 -> one second of slack
+    assert qos.burst == 7.0
+    with pytest.raises(ValueError):
+        TenantQos(rate=0.0)
+
+
+def test_qos_tenant_map_is_bounded(monkeypatch):
+    from dgraph_tpu.server import qos as qos_mod
+    monkeypatch.setattr(qos_mod, "_MAX_TENANTS", 3)
+    clock = [0.0]
+    qos = TenantQos(rate=100.0, burst=1.0, clock=lambda: clock[0])
+    for t in ("a", "b", "c", "d"):  # d evicts a (least recent)
+        qos.admit(t)
+    assert qos.tenants() == ["b", "c", "d"]
+    # the evicted tenant's bucket is re-created FULL: the bound only
+    # ever errs toward admitting
+    assert qos.admit("a") is True
+
+
+# ------------------------------------- engine wiring: CDC vs the cache
+
+
+def _db(**kw):
+    from dgraph_tpu.engine.db import GraphDB
+    db = GraphDB(prefer_device=False, result_cache_entries=32, **kw)
+    db.alter(schema_text="sc.name: string @index(exact) .\n"
+                         "sc.other: string .")
+    db.mutate(set_nquads='<0x1> <sc.name> "one" .\n'
+                         '<0x2> <sc.other> "noise" .')
+    return db
+
+
+def _q(db, q):
+    return json.dumps(json.loads(db.query_json(q, best_effort=True))
+                      .get("data"), sort_keys=True)
+
+
+def test_cdc_commit_invalidates_only_the_footprint():
+    db = _db()
+    q = '{ q(func: has(sc.name)) { sc.name } }'
+    _q(db, q)  # fill
+    h0 = db.result_cache.stats()["hits"]
+    assert _q(db, q) and db.result_cache.stats()["hits"] == h0 + 1
+    # a commit on the footprint invalidates; the re-read sees it
+    db.mutate(set_nquads='<0x3> <sc.name> "three" .')
+    got = _q(db, q)
+    assert "three" in got, "cached read served pre-commit bytes"
+    # a commit OUTSIDE the footprint leaves the entry hot
+    h1 = db.result_cache.stats()["hits"]
+    db.mutate(set_nquads='<0x4> <sc.other> "more noise" .')
+    assert _q(db, q) == got
+    assert db.result_cache.stats()["hits"] == h1 + 1
+
+
+def test_cache_hit_is_still_a_served_query():
+    """A result-cache hit must land in dgraph_num_queries_total and
+    the request log with its plan key — otherwise the hottest
+    queries vanish from observability exactly when the cache starts
+    working."""
+    from dgraph_tpu.utils import metrics, reqlog
+    db = _db()
+    q = '{ q(func: has(sc.name)) { sc.name } }'
+    _q(db, q)  # fill
+    c0 = metrics.get_counter("dgraph_num_queries_total")
+    h0 = db.result_cache.stats()["hits"]
+    _q(db, q)
+    assert db.result_cache.stats()["hits"] == h0 + 1  # really a hit
+    assert metrics.get_counter("dgraph_num_queries_total") == c0 + 1
+    last = reqlog.snapshot()["recent"][-1]
+    assert last["op"] == "query" and last["plan_key"], last
+    assert last["breakdown"]["processing_ns"] == 0  # hit, not a run
+
+
+def test_cdc_truncation_vs_invalidation():
+    """Truncation events are NOT per-commit invalidations: a floor
+    reset / drop / clear replaces history itself, so every cached
+    result derived from the predicate (or everything, for clear)
+    drops wholesale even though no mutation was appended."""
+    db = _db()
+    q_name = '{ q(func: has(sc.name)) { sc.name } }'
+    q_other = '{ q(func: has(sc.other)) { sc.other } }'
+
+    def _fills():
+        _q(db, q_name)
+        _q(db, q_other)
+
+    def _hits(q):
+        h0 = db.result_cache.stats()["hits"]
+        _q(db, q)
+        return db.result_cache.stats()["hits"] - h0
+
+    # floor reset (snapshot/bulk boot): only sc.name's entry drops
+    _fills()
+    db.cdc.reset_floor("sc.name",
+                       db.coordinator.max_assigned() + 1)
+    assert _hits(q_name) == 0, "floor jump left a stale entry"
+    assert _hits(q_other) == 1, "floor jump over-invalidated"
+
+    # tablet drop: same wholesale contract
+    _fills()
+    db.cdc.drop("sc.name")
+    assert _hits(q_name) == 0
+
+    # clear: the whole cache empties (preds=None)
+    _fills()
+    assert db.result_cache.stats()["entries"] > 0
+    db.cdc.clear()
+    assert db.result_cache.stats()["entries"] == 0

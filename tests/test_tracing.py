@@ -292,6 +292,62 @@ def test_request_log_records_shed_outcome():
                for r in snap["recent"])
 
 
+def test_request_log_carries_tenant_over_http():
+    """The QoS accounting namespace rides X-Dgraph-Tenant ->
+    RequestContext -> the reqlog `tenant` field at /debug/requests."""
+    import urllib.request
+    from dgraph_tpu.server.http import serve
+    from dgraph_tpu.utils import reqlog
+
+    reqlog.reset()
+    httpd, _alpha = serve(block=False, port=0)
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        _post(base + "/query", "{ q(func: uid(0x1)) { uid } }",
+              {"X-Dgraph-Tenant": "acme"})
+        _post(base + "/query", "{ q(func: uid(0x1)) { uid } }")
+        reqs = json.loads(urllib.request.urlopen(
+            base + "/debug/requests").read())
+        by_tenant = {r["tenant"] for r in reqs["recent"]
+                     if r["op"] == "query"}
+        assert "acme" in by_tenant, reqs["recent"]
+        assert "" in by_tenant  # untagged stays untagged in the log
+    finally:
+        httpd.shutdown()
+
+
+def test_tenant_qos_sheds_hot_tenant_only():
+    """Per-tenant admission under the shared gate: the tenant over
+    its bucket sheds (typed Overloaded -> the 429 class, labeled
+    shed counter, reqlog tenant) while another tenant's request on
+    the SAME server is admitted."""
+    import pytest
+    from dgraph_tpu.server.http import AlphaServer
+    from dgraph_tpu.utils import metrics, reqlog
+    from dgraph_tpu.utils.reqctx import Overloaded, RequestContext
+
+    reqlog.reset()
+    srv = AlphaServer(tenant_rate=1000.0, tenant_burst=2.0)
+    q = "{ q(func: uid(0x1)) { uid } }"
+    shed0 = metrics.get_counter("dgraph_tenant_shed_total",
+                                labels={"tenant": "hog"})
+    srv.qos._clock = lambda: 0.0  # freeze refill: burst only
+    for _ in range(2):
+        srv.handle_query(q, {}, ctx=RequestContext.background(
+            tenant="hog"))
+    with pytest.raises(Overloaded):
+        srv.handle_query(q, {}, ctx=RequestContext.background(
+            trace_id="hog-shed", tenant="hog"))
+    # the quiet tenant is untouched by the hog's exhaustion
+    srv.handle_query(q, {}, ctx=RequestContext.background(
+        tenant="quiet"))
+    assert metrics.get_counter("dgraph_tenant_shed_total",
+                               labels={"tenant": "hog"}) == shed0 + 1
+    assert any(r["outcome"] == "shed" and r["tenant"] == "hog"
+               and r["trace_id"] == "hog-shed"
+               for r in reqlog.snapshot()["recent"])
+
+
 def test_server_latency_over_grpc():
     import pytest
     grpc = pytest.importorskip("grpc")  # noqa: F841

@@ -1,0 +1,446 @@
+"""Read scale-out bench -> BENCH_SCALEOUT.json.
+
+Three proofs over real ProcessCluster topologies (every node a
+`python -m dgraph_tpu node` subprocess on real sockets):
+
+1. **Replica scaling**: one closed-loop zipf-read phase at FIXED
+   fleet-wide concurrency against 1 voter + 0 learners, then
+   1 voter + 1 learner. The wire client pools ONE request/response
+   connection per peer (cluster/client.py), so per-replica in-flight
+   is bounded at one and the fleet's serving concurrency equals its
+   replica count: the learner-backed fleet must deliver
+   >= `--min-ratio` (1.7x) the ok-QPS with BOTH arms under the same
+   p99 SLO.
+
+2. **Cache parity**: with `--result-cache` armed on every replica,
+   repeated best-effort reads (cache fills AND hits, spread across
+   voter + learner by the router) must answer the SAME data bytes as
+   a strict leader read of the same query.
+
+3. **Bounded staleness nemesis**: SIGSTOP the learner (a network-
+   indistinguishable partition) while acked writes keep advancing a
+   monotonic counter, then SIGCONT and hammer the learner directly
+   with watermark-bounded reads at fresh zero grants. Every served
+   read must observe a counter >= the last write acked BEFORE its
+   grant; StaleRead / unreachable are acceptable refusals, an older
+   counter is a violation. Zero violations required.
+
+1-CPU harness note (measured: raw CPU-bound capacity moves only
+~1.2x from 1 -> 2 read replicas because every process timeshares one
+core): the scaling arms arm the `executor.level` failpoint with a
+per-level sleep to emulate device-bound execution — the paper's
+setting, where the host thread parks (GIL released) while the
+accelerator does the work. Per-request host CPU then stays far below
+service time and throughput is governed by replica count x
+per-replica in-flight, which is exactly the property the serving
+tier sells. The knobs land in the artifact so the run is
+reproducible on any box; the nemesis writer is likewise throttled
+(`--write-interval`) so a 1-core learner can out-apply the stream
+after the partition heals.
+
+Usage:
+  python -m tools.bench_scaleout [--quick] [--out BENCH_SCALEOUT.json]
+
+Exit 0 iff every gate passed. ~3-5 min on a CI box (--quick: ~2 min).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # `python tools/bench_scaleout.py` mode
+    sys.path.insert(0, _REPO)
+
+from dgraph_tpu.bench.spawn import ProcessCluster  # noqa: E402
+from dgraph_tpu.bench.workload import (  # noqa: E402
+    MIXES, Workload, WorkloadConfig)
+from tools.dgbench import (  # noqa: E402
+    Driver, claim_tablets, load_graph, phase_report)
+
+
+def log(msg: str):
+    sys.stderr.write(f"[bench-scaleout] {msg}\n")
+    sys.stderr.flush()
+
+
+def _jd(resp: dict) -> str:
+    """Canonical data payload (extensions carry per-run timings)."""
+    return json.dumps(resp.get("data"), sort_keys=True)
+
+
+# ------------------------------------------------------- QPS arms
+
+
+def _closed_loop(driver: Driver, ops, threads: int) -> dict:
+    """Closed loop with `threads` in flight: per-op latencies +
+    outcome records in tools/dgbench.py's phase shape, so
+    phase_report folds it like any open-loop phase."""
+    nxt, lock = [0], threading.Lock()
+    lat = [0.0] * len(ops)
+    recs: list = [None] * len(ops)
+
+    def worker():
+        while True:
+            with lock:
+                i = nxt[0]
+                if i >= len(ops):
+                    return
+                nxt[0] += 1
+            t0 = time.monotonic()
+            recs[i] = driver.submit(0xFE, i, ops[i])
+            lat[i] = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.monotonic() - t0
+    return {"lat": lat, "recs": recs, "wall_s": wall,
+            "rate": len(ops) / wall}
+
+
+def qps_arm(args, w: Workload, learners: int,
+            report_dir: str) -> dict:
+    """One fleet shape -> phase report of a fixed-concurrency
+    closed-loop zipf-read phase."""
+    env = {"DGRAPH_TPU_FAILPOINTS":
+           f"executor.level=sleep({args.svc_sleep})"}
+    deadline_ms = int(args.slo_ms * 5)
+    with ProcessCluster(groups=1, replicas=1, learners=learners,
+                        zeros=1, env_extra=env,
+                        log_dir=os.path.join(report_dir, "logs")) as pc:
+        pc.wait_ready(90)
+        if learners:
+            pc.wait_learners(90)
+        rc = pc.routed()
+        try:
+            rc.alter(w.schema())
+            claim_tablets(rc, 1, w)
+            n_quads = load_graph(rc, w)
+            driver = Driver(rc, deadline_ms, os.urandom(5).hex(),
+                            best_effort=True)
+            warm = [op for op in w.ops(24, stream_seed=999)
+                    if not op.write]
+            for i, op in enumerate(warm):
+                driver.submit(0xFF, i, op)
+
+            ops = [op for op in w.ops(args.ops, stream_seed=1)]
+            ph = _closed_loop(driver, ops, args.concurrency)
+            rep = phase_report(ph, args.slo_ms, args.error_budget)
+            rep["learners"] = learners
+            rep["rdf"] = n_quads
+            return rep
+        finally:
+            rc.close()
+
+
+# --------------------------------------------- parity + nemesis
+
+
+def _stats_counter(debug_urls: dict, name: str) -> int:
+    import urllib.request
+    total = 0
+    for url in debug_urls.values():
+        try:
+            with urllib.request.urlopen(url + "/debug/stats",
+                                        timeout=5.0) as r:
+                total += int(json.load(r).get("counters", {})
+                             .get(name, 0))
+        except OSError:
+            continue
+    return total
+
+
+def parity_and_nemesis(args, w: Workload, report_dir: str) -> dict:
+    from dgraph_tpu.cluster.client import ClusterClient
+    from dgraph_tpu.cluster.errors import StaleRead
+
+    with ProcessCluster(groups=1, replicas=1, learners=1, zeros=1,
+                        alpha_args=["--result-cache", "512"],
+                        log_dir=os.path.join(report_dir, "logs")) as pc:
+        pc.wait_ready(90)
+        pc.wait_learners(90)
+        rc = pc.routed()
+        try:
+            rc.alter(w.schema() + "\nctr.val: int .")
+            claim_tablets(rc, 1, w)
+            load_graph(rc, w)
+
+            # ---- cache parity: fills + hits across the read pool
+            # vs a strict leader read of the same query
+            qs, seen = [], set()
+            for op in w.ops(400, stream_seed=7):
+                if op.query and op.query not in seen:
+                    seen.add(op.query)
+                    qs.append(op.query)
+                if len(qs) >= args.parity_n:
+                    break
+            h0 = _stats_counter(pc.debug_urls,
+                                "dgraph_result_cache_hits_total")
+            checked = mismatched = 0
+            mismatches = []
+            for q in qs:
+                # 4 reads round-robin voter/learner: each replica
+                # fills once then HITS; all four must agree with the
+                # strict oracle byte-for-byte on data
+                reads = [_jd(rc.query(q, best_effort=True,
+                                      tenant="parity"))
+                         for _ in range(4)]
+                oracle = _jd(rc.query(q))
+                checked += 1
+                if any(r != oracle for r in reads):
+                    mismatched += 1
+                    if len(mismatches) < 3:
+                        mismatches.append({"query": q[:120],
+                                           "got": reads[0][:160],
+                                           "oracle": oracle[:160]})
+            hits = _stats_counter(
+                pc.debug_urls,
+                "dgraph_result_cache_hits_total") - h0
+            parity = {"checked": checked, "mismatched": mismatched,
+                      "cache_hits": hits,
+                      "mismatches": mismatches,
+                      "ok": mismatched == 0 and checked > 0
+                      and hits >= checked}
+            log(f"parity: {checked} queries, {mismatched} mismatches, "
+                f"{hits} cache hits")
+
+            # ---- bounded-staleness nemesis on the learner
+            lname = f"alpha-g1-n{1 + 1 + 0}"  # replicas + 1 + k
+            laddr = pc.learner_addrs[1][2]
+            lcl = ClusterClient({1: laddr}, timeout=3.0)
+            state = {"acked": 0, "stop": False}
+            wlock = threading.Lock()
+
+            def writer():
+                # throttled (--write-interval): a 1-core learner must
+                # be able to out-apply the stream or recovery never
+                # converges — the bound under test is staleness, not
+                # apply bandwidth
+                i = 0
+                while not state["stop"]:
+                    i += 1
+                    try:
+                        rc.mutate(
+                            set_nquads=f'<0x77> <ctr.val> "{i}" .')
+                    except Exception:  # noqa: BLE001 — keep writing  # dglint: disable=DG07 (nemesis load loop: a refused write just retries next tick)
+                        continue
+                    with wlock:
+                        state["acked"] = i
+                    time.sleep(args.write_interval)
+
+            tallies = {"ok": 0, "stale": 0, "unreachable": 0,
+                       "error": 0, "violation": 0}
+            violations = []
+            cq = '{ q(func: uid(0x77)) { ctr.val } }'
+
+            def read_learner():
+                """One direct learner read at a fresh grant; the
+                acked floor is captured BEFORE the grant, so every
+                served value must be >= it."""
+                with wlock:
+                    floor = state["acked"]
+                ts = rc.zero.read_ts()
+                try:
+                    out = lcl.query_at(1, cq, read_ts=ts,
+                                       deadline_ms=2500)
+                except StaleRead:
+                    tallies["stale"] += 1
+                    return
+                except (ConnectionError, OSError):
+                    tallies["unreachable"] += 1
+                    return
+                except Exception:  # noqa: BLE001 — tallied  # dglint: disable=DG07 (nemesis read probe: any other refusal is recorded, not fatal)
+                    tallies["error"] += 1
+                    return
+                rows = (out.get("data") or {}).get("q") or []
+                v = int(rows[0].get("ctr.val", 0)) if rows else 0
+                if v < floor:
+                    tallies["violation"] += 1
+                    if len(violations) < 3:
+                        violations.append({"served": v, "floor": floor,
+                                           "read_ts": ts})
+                else:
+                    tallies["ok"] += 1
+
+            wt = threading.Thread(target=writer, daemon=True)
+            wt.start()
+            # healthy phase: the learner serves bounded reads
+            end = time.monotonic() + 2.0
+            while time.monotonic() < end:
+                read_learner()
+                time.sleep(0.05)
+            healthy_ok = tallies["ok"]
+            log(f"nemesis healthy phase: {tallies}")
+
+            # partition: SIGSTOP freezes the learner mid-flight while
+            # acked writes keep advancing the counter
+            pc.kill(lname, signal.SIGSTOP)
+            t_stop = time.monotonic()
+            end = t_stop + args.stop_s
+            while time.monotonic() < end:
+                read_learner()  # bounded: refuses, never serves old
+            pc.kill(lname, signal.SIGCONT)
+            log(f"nemesis after {args.stop_s}s partition: {tallies}")
+
+            # recovery: hammer fresh grants until the learner serves
+            # again — catch-up must finish BEFORE it answers
+            resumed_ok = 0
+            end = time.monotonic() + 30.0
+            while time.monotonic() < end and resumed_ok < 8:
+                before = tallies["ok"]
+                read_learner()
+                if tallies["ok"] > before:
+                    resumed_ok += 1
+                time.sleep(0.02)
+            state["stop"] = True
+            wt.join(timeout=5.0)
+            lcl.close()
+            nemesis = {**tallies, "healthy_ok": healthy_ok,
+                       "resumed_ok": resumed_ok,
+                       "acked_writes": state["acked"],
+                       "stop_s": args.stop_s,
+                       "violations_sample": violations,
+                       "ok": (tallies["violation"] == 0
+                              and healthy_ok >= 3
+                              and resumed_ok >= 8)}
+            log(f"nemesis final: {tallies} "
+                f"(resumed_ok={resumed_ok})")
+            return {"parity": parity, "nemesis": nemesis}
+        finally:
+            rc.close()
+
+
+# ------------------------------------------------------------ main
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="bench_scaleout", description=__doc__.split("\n\n")[0])
+    ap.add_argument("--persons", type=int, default=160)
+    ap.add_argument("--seed", type=int, default=20260803)
+    ap.add_argument("--svc-sleep", type=float, default=0.05,
+                    help="per-level executor sleep emulating device-"
+                         "bound execution (see module docstring)")
+    ap.add_argument("--ops", type=int, default=480,
+                    help="ops in each arm's measured phase")
+    ap.add_argument("--concurrency", type=int, default=4,
+                    help="fixed fleet-wide in-flight reads (same in "
+                         "both arms)")
+    ap.add_argument("--slo-ms", type=float, default=600.0,
+                    help="p99 gate over SERVED reads in both arms")
+    ap.add_argument("--error-budget", type=float, default=0.02,
+                    help="max bad fraction per arm")
+    ap.add_argument("--write-interval", type=float, default=0.1,
+                    help="nemesis writer pacing (seconds between "
+                         "acked counter writes)")
+    ap.add_argument("--min-ratio", type=float, default=1.7)
+    ap.add_argument("--parity-n", type=int, default=24)
+    ap.add_argument("--stop-s", type=float, default=2.0,
+                    help="learner SIGSTOP duration")
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller graph + shorter phases (~2 min)")
+    ap.add_argument("--report-dir", default="bench_scaleout_report")
+    ap.add_argument("--out", default=os.path.join(
+        _REPO, "BENCH_SCALEOUT.json"))
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.quick:
+        args.persons = min(args.persons, 80)
+        args.ops = min(args.ops, 240)
+        args.parity_n = min(args.parity_n, 12)
+    os.makedirs(args.report_dir, exist_ok=True)
+    t0 = time.monotonic()
+    w = Workload(WorkloadConfig(seed=args.seed, persons=args.persons,
+                                mix=MIXES["zipf-read"]))
+
+    log(f"arm 1/2: 1 voter + 0 learners (closed loop, "
+        f"{args.concurrency} in flight)")
+    arm1 = qps_arm(args, w, learners=0, report_dir=args.report_dir)
+    log(f"arm 1: ok_qps={arm1['ok_qps']} p99={arm1['p99_ms']}ms "
+        f"outcomes={arm1['outcomes']}")
+
+    log("arm 2/2: 1 voter + 1 learner at the same concurrency")
+    arm2 = qps_arm(args, w, learners=1, report_dir=args.report_dir)
+    log(f"arm 2: ok_qps={arm2['ok_qps']} p99={arm2['p99_ms']}ms "
+        f"outcomes={arm2['outcomes']}")
+
+    extra = parity_and_nemesis(args, w, args.report_dir)
+
+    ratio = (arm2["ok_qps"] / arm1["ok_qps"]) if arm1["ok_qps"] else 0
+    gates = {
+        "scaling_ratio": round(ratio, 2),
+        "scaling_ok": ratio >= args.min_ratio,
+        "arm1_p99_ok": (arm1["p99_ms"] is not None
+                        and arm1["p99_ms"] <= args.slo_ms),
+        "arm2_p99_ok": (arm2["p99_ms"] is not None
+                        and arm2["p99_ms"] <= args.slo_ms),
+        "arm1_clean": arm1["bad_frac"] <= args.error_budget,
+        "arm2_clean": arm2["bad_frac"] <= args.error_budget,
+        "parity_ok": extra["parity"]["ok"],
+        "nemesis_ok": extra["nemesis"]["ok"],
+    }
+    passed = all(v for k, v in gates.items()
+                 if k != "scaling_ratio")
+    try:
+        host_cpus = len(os.sched_getaffinity(0))
+    except AttributeError:
+        host_cpus = os.cpu_count() or 0
+    summary = {
+        "metric": "read_qps_scaling_1v0l_to_1v1l_at_p99_slo",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "passed": passed,
+        "min_ratio": args.min_ratio,
+        "slo_ms": args.slo_ms,
+        "concurrency": args.concurrency,
+        "arm1_ok_qps": arm1["ok_qps"], "arm2_ok_qps": arm2["ok_qps"],
+        "arm1_p99_ms": arm1["p99_ms"], "arm2_p99_ms": arm2["p99_ms"],
+        "mix": "zipf-read",
+        "persons": args.persons, "seed": args.seed,
+        "violations": extra["nemesis"]["violation"],
+        "parity_checked": extra["parity"]["checked"],
+        "parity_mismatched": extra["parity"]["mismatched"],
+        "cache_hits": extra["parity"]["cache_hits"],
+        "method": {
+            "host_cpus": host_cpus,
+            "svc_sleep_s": args.svc_sleep,
+            "write_interval_s": args.write_interval,
+            "note": "executor.level sleep emulates device-bound "
+                    "execution on a 1-CPU harness host; per-replica "
+                    "in-flight bounded at 1 by the wire client's "
+                    "pooled connection per peer; both arms run one "
+                    "closed loop at fixed fleet-wide concurrency",
+        },
+        "quick": bool(args.quick),
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+    out = {"summary": summary, "gates": gates,
+           "arms": {"one_replica": arm1, "two_replicas": arm2},
+           **extra}
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({**summary, "gates": gates}))
+    if not passed:
+        log(f"FAILED gates: "
+            f"{[k for k, v in gates.items() if v is False]}")
+        return 1
+    log(f"all gates passed (ratio {ratio:.2f}x) in "
+        f"{summary['wall_s']}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

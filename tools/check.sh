@@ -115,6 +115,15 @@ echo "== cdc smoke =="
 # mid-stream resume, and subscriber lag on /debug/stats
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.cdc_smoke
 
+echo "== scaleout smoke =="
+# ~30 s read scale-out gate (tools/scaleout_smoke.py): embedded
+# result-cache byte parity under churn (cached hit == uncached oracle,
+# footprint isolation), then a live 1 voter + 1 learner cluster —
+# learner conf-joins non-voting, serves the voter's exact bytes at one
+# zero-granted read_ts, best-effort reads observe fresh commits, and
+# per-tenant QoS sheds a hot tenant without touching a quiet one.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.scaleout_smoke
+
 echo "== rebalance smoke =="
 # ~30 s heat-driven rebalancing gate (tools/rebalance_smoke.py): a
 # deliberately skewed 2-group cluster under live open load; the

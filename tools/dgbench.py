@@ -55,7 +55,7 @@ from dgraph_tpu.bench.openloop import (  # noqa: E402
 )
 from dgraph_tpu.bench.spawn import ProcessCluster  # noqa: E402
 from dgraph_tpu.bench.workload import (  # noqa: E402
-    Workload, WorkloadConfig,
+    MIXES, Workload, WorkloadConfig,
 )
 from dgraph_tpu.utils import tracing  # noqa: E402
 from dgraph_tpu.utils.reqctx import (  # noqa: E402
@@ -130,11 +130,14 @@ class Driver:
     and recording trace ids + sampled response bytes."""
 
     def __init__(self, rc, deadline_ms: int, nonce: str,
-                 sample_every: int = 7):
+                 sample_every: int = 7, best_effort: bool = False):
         self.rc = rc
         self.deadline_ms = deadline_ms
         self.nonce = nonce  # 10-hex run prefix for trace ids
         self.sample_every = sample_every
+        # best_effort reads fan across voters + learners through the
+        # router's read pools (watermark-bounded follower reads)
+        self.best_effort = best_effort
 
     def tid(self, phase: int, i: int) -> str:
         return f"{self.nonce}{phase & 0xFF:02x}{i & (1 << 80) - 1:020x}"
@@ -153,7 +156,8 @@ class Driver:
                                    deadline_ms=self.deadline_ms)
                 else:
                     out = self.rc.query(op.query,
-                                        deadline_ms=self.deadline_ms)
+                                        deadline_ms=self.deadline_ms,
+                                        best_effort=self.best_effort)
                     if i % self.sample_every == 0:
                         rec["data"] = json.dumps(out.get("data"),
                                                  sort_keys=True)
@@ -387,8 +391,25 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--groups", type=int, default=2)
     ap.add_argument("--replicas", type=int, default=1)
     ap.add_argument("--zeros", type=int, default=1)
+    ap.add_argument("--learners", type=int, default=0,
+                    help="non-voting read replicas per group (the "
+                         "read scale-out tier); pair with "
+                         "--best-effort so reads fan across them")
     ap.add_argument("--persons", type=int, default=240)
     ap.add_argument("--seed", type=int, default=20260803)
+    ap.add_argument("--mix", default="default",
+                    choices=sorted(MIXES),
+                    help="op mix: 'default' (LDBC-style mixed "
+                         "read/write) or 'zipf-read' (read-only "
+                         "zipfian — the read scale-out shape)")
+    ap.add_argument("--best-effort", action="store_true",
+                    help="serve reads as watermark-bounded follower "
+                         "reads across voters AND learners (writes "
+                         "still route to voters)")
+    ap.add_argument("--result-cache", type=int, default=0,
+                    help="arm the CDC-invalidated result cache on "
+                         "every alpha with this many entries (0 = "
+                         "off)")
     ap.add_argument("--concurrency", type=int, default=24,
                     help="client worker threads (the open loop's "
                          "drain capacity, not the offered rate)")
@@ -437,19 +458,28 @@ def main(argv=None) -> int:
     os.makedirs(args.report_dir, exist_ok=True)
     tracing.set_node("dgbench")
 
-    cfg = WorkloadConfig(seed=args.seed, persons=args.persons)
+    cfg = WorkloadConfig(seed=args.seed, persons=args.persons,
+                         mix=MIXES[args.mix])
     w = Workload(cfg)
     nonce = os.urandom(5).hex()
     t_start = time.monotonic()
 
+    alpha_args = []
+    if args.result_cache:
+        alpha_args += ["--result-cache", str(args.result_cache)]
     log(f"spawning {args.zeros} zero(s) + {args.groups} group(s) "
-        f"x {args.replicas} replica(s)")
+        f"x {args.replicas} replica(s)"
+        + (f" + {args.learners} learner(s)/group"
+           if args.learners else ""))
     with ProcessCluster(groups=args.groups, replicas=args.replicas,
-                        zeros=args.zeros,
+                        zeros=args.zeros, learners=args.learners,
+                        alpha_args=alpha_args,
                         max_pending=args.max_pending,
                         log_dir=os.path.join(args.report_dir,
                                              "logs")) as cluster:
         cluster.wait_ready(90)
+        if args.learners:
+            cluster.wait_learners(90)
         rc = cluster.routed()
         node_clients = cluster.node_clients()
         collector = Collector(cluster.debug_urls, args.report_dir)
@@ -461,7 +491,8 @@ def main(argv=None) -> int:
             log(f"loaded {n_quads} quads "
                 f"({time.monotonic() - t_start:.0f}s)")
 
-            driver = Driver(rc, deadline_ms, nonce)
+            driver = Driver(rc, deadline_ms, nonce,
+                            best_effort=args.best_effort)
             # warmup: one of each read kind (tile/plan/index warm)
             for op in w.ops(40, stream_seed=999):
                 if not op.write:
@@ -646,7 +677,9 @@ def main(argv=None) -> int:
         "offered_qps": best["offered_qps"] if best else None,
         "outcomes": best["outcomes"] if best else None,
         "groups": args.groups, "replicas": args.replicas,
-        "zeros": args.zeros,
+        "zeros": args.zeros, "learners": args.learners,
+        "mix": args.mix, "best_effort": bool(args.best_effort),
+        "result_cache": args.result_cache,
         "persons": args.persons, "rdf": n_quads,
         "seed": args.seed,
         "concurrency": args.concurrency,

@@ -314,6 +314,70 @@ def planner_rows(snaps: dict[str, dict],
     return rows
 
 
+def serving_rows(snaps: dict[str, dict],
+                 prev: Optional[dict[str, dict]] = None
+                 ) -> tuple[list[dict], list[dict]]:
+    """The SERVING panel's rows: the read scale-out tier per node —
+    result-cache occupancy + hit rate (/debug/stats `resultCache`),
+    learner role + apply lag behind the leader's commit index
+    (`learner`/`learnerLag`), the applied MVCC watermark
+    (`maxAssigned`), and invalidation / stale-read failover rates
+    (counter deltas). Second list: per-tenant QoS shed rates parsed
+    from the labeled `dgraph_tenant_shed_total{tenant="..."}` series.
+    Pure — tests drive it with canned payloads. Nodes with no cache,
+    no learner role and no shed/stale activity produce no row (the
+    panel disappears on a plain write-path cluster)."""
+    nodes = []
+    tenants = []
+    for node in sorted(snaps):
+        snap = snaps[node]
+        if snap is None:
+            continue
+        stats = snap["stats"]
+        counters = stats.get("counters", {})
+        p = (prev or {}).get(node)
+        dt = None
+        if p is not None:
+            dt = max(1e-6, snap["t"] - p["t"])
+
+        def rate(name: str) -> float:
+            cur = counters.get(name, 0.0)
+            if dt is None:
+                return float(cur)
+            return (cur - p["stats"].get("counters", {})
+                    .get(name, 0.0)) / dt
+
+        rc = stats.get("resultCache")
+        stale = rate("dgraph_stale_reads_total")
+        row = {
+            "node": node,
+            "learner": bool(stats.get("learner")),
+            "lag": stats.get("learnerLag"),
+            "watermark": stats.get("maxAssigned", 0),
+            "hit_rate": rc.get("hitRate") if rc else None,
+            "entries": rc.get("entries") if rc else None,
+            "capacity": rc.get("capacity") if rc else None,
+            "inval_rate": rate(
+                "dgraph_result_cache_invalidations_total"),
+            "stale_rate": stale,
+        }
+        shed_prefix = 'dgraph_tenant_shed_total{tenant="'
+        node_sheds = 0.0
+        for key in sorted(counters):
+            if not key.startswith(shed_prefix):
+                continue
+            tenant = key[len(shed_prefix):].rstrip('"}')
+            r = rate(key)
+            node_sheds += r
+            if r:
+                tenants.append({"node": node, "tenant": tenant,
+                                "shed_rate": r})
+        if (rc is not None or row["learner"] or node_sheds
+                or row["stale_rate"]):
+            nodes.append(row)
+    return nodes, tenants
+
+
 def hottest(snaps: dict[str, dict], top: int = 5) -> list[dict]:
     """Cluster-wide hottest tablets by query-path touches, with their
     cheap size facts. Pure — tests drive it with canned payloads."""
@@ -468,6 +532,29 @@ def render(snaps: dict[str, dict],
                 f"{r['node']:<28} {r['decisions']:>8} {mix:<34.34} "
                 f"{r['reopt_rate']:>8.2f} "
                 f"{100 * r['viol_rate']:>6.2f} {r['suppressed']:>6}")
+    srv, tens = serving_rows(snaps, prev)
+    if srv:
+        lines.append("")
+        lines.append(f"{'SERVING':<28} {'ROLE':>7} {'LAG':>6} "
+                     f"{'WMARK':>9} {'CACHE%':>7} {'ENTRIES':>9} "
+                     f"{'INVAL/S':>8} {'STALE/S':>8}")
+        for r in srv:
+            role = "learner" if r["learner"] else "voter"
+            hit = "-" if r["hit_rate"] is None \
+                else f"{100 * r['hit_rate']:.0f}"
+            ent = "-" if r["entries"] is None \
+                else f"{r['entries']}/{r['capacity']}"
+            lines.append(
+                f"{r['node']:<28} {role:>7} {_fmt(r['lag']):>6} "
+                f"{r['watermark']:>9} {hit:>7} {ent:>9} "
+                f"{r['inval_rate']:>8.1f} {r['stale_rate']:>8.1f}")
+    if tens:
+        lines.append("")
+        lines.append(f"{'TENANT SHEDS':<28} {'TENANT':<20} "
+                     f"{'SHED/S':>8}")
+        for t in tens:
+            lines.append(f"{t['node']:<28} {t['tenant']:<20.20} "
+                         f"{t['shed_rate']:>8.1f}")
     hot = hottest(snaps)
     if hot:
         lines.append("")
