@@ -140,7 +140,11 @@ class GraphDB:
                  vec_nprobe: int | None = None,
                  vec_rerank: int | None = None,
                  vec_max_k: int = 128,
-                 result_cache_entries: int = 0):
+                 result_cache_entries: int = 0,
+                 prefer_fused: bool = True,
+                 fused_min_rows: int = 1024,
+                 prefetch_workers: int = 0,
+                 planner_explore: bool = True):
         from dgraph_tpu.engine.tile_cache import DeviceCacheLRU
         from dgraph_tpu.ops.codec import DecodeScratch
         from dgraph_tpu.query.plan import PlanCache
@@ -216,6 +220,32 @@ class GraphDB:
         else:
             self.planner = "static"
             self.planner_impl = None
+        # budgeted cold-tier exploration (query/planner.py
+        # _maybe_explore): False pins decisions to evidence + the
+        # static ladder only — deterministic tier choice for parity
+        # suites and per-shape benchmark tables
+        self.planner_explore = planner_explore
+        # whole-plan device fusion (query/fusion.py): an eligible
+        # block's filter+order+page chain runs as ONE jitted
+        # executable per (skeleton, shape-bucket, mesh). False pins
+        # every block to the staged per-stage pipeline — the fusion
+        # parity suite's oracle and the operator escape hatch;
+        # fused_min_rows keeps tiny roots (where one dispatch costs
+        # more than the host pipeline) staged
+        self.prefer_fused = prefer_fused
+        self.fused_min_rows = fused_min_rows
+        # async cold-store prefetch (engine/prefetch.py): a bounded
+        # worker pool decodes stored tablet blobs announced by the
+        # executor before block execution reaches them. 0 (the
+        # default) disables — every store load stays synchronous and
+        # the query path takes zero new branches. Opt-in because it
+        # only pays on store-backed engines whose working set exceeds
+        # tablet_budget (the BENCH_500M regime)
+        self.prefetcher = None
+        if prefetch_workers and self.tablet_store is not None:
+            from dgraph_tpu.engine.prefetch import PrefetchPool
+            self.prefetcher = PrefetchPool(self.tablet_store,
+                                           workers=prefetch_workers)
         # bounded per-thread scratch arena the compressed kernels
         # decode into (results are always fresh; see DecodeScratch)
         self.decode_scratch = DecodeScratch()
@@ -985,6 +1015,11 @@ class GraphDB:
             except OSError:
                 pass  # stats are advisory; shutdown must not fail
             self._coststore_path = None
+        if self.prefetcher is not None:
+            # stop the decode workers BEFORE the store closes: an
+            # in-flight worker reading a closed native handle is fatal
+            self.prefetcher.close()
+            self.prefetcher = None
         if self.tablet_store is not None:
             self.tablets.flush_all()
             self.tablet_store.close()
@@ -1631,4 +1666,6 @@ class GraphDB:
             if self.plan_cache is not None else None,
             "planner": self.planner_impl.stats()
             if self.planner_impl is not None else {"mode": "static"},
+            "prefetch": self.prefetcher.stats()
+            if self.prefetcher is not None else None,
         }

@@ -123,7 +123,18 @@ class TabletMap(dict):
     def get(self, pred, default=None):
         tab = dict.get(self, pred)
         if tab is None and pred in self.stored:
-            tab = self.store.load(pred, self.db.schema)
+            pf = getattr(self.db, "prefetcher", None)
+            if pf is not None:
+                # async prefetch (engine/prefetch.py): consume the
+                # worker's decode if one landed — fully done (hit) or
+                # mid-flight (the overlap already banked is kept);
+                # stale decodes (blob re-saved since scheduling) are
+                # discarded inside take() via the saved-ts check
+                tab = pf.take(pred, self._saved_ts.get(pred))
+                if tab is None:
+                    pf.miss()
+            if tab is None:
+                tab = self.store.load(pred, self.db.schema)
             if tab is not None:
                 inc_counter("tablet_store_loads")
                 dict.__setitem__(self, pred, tab)

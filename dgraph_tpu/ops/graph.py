@@ -218,6 +218,19 @@ class DeviceValues:
     uids_by_key: jax.Array   # [N] uint32 aligned to ranks_sorted
     host_keys: np.ndarray    # [U] int64 sorted unique raw keys (host)
     n: int = 0               # real (unpadded) uid count
+    # Dense uid -> rank table when the tablet's uid range is compact
+    # (span <= max(2^20, 4n)): rank_lut[uid - lut_base] == rank, holes
+    # hold RANK_MISSING. Turns the per-candidate rank gather into ONE
+    # indexed load instead of a log2(N)-round binary search — the
+    # difference between the fused page kernel winning and losing on
+    # backends where searchsorted lowers to a sequential scan.
+    rank_lut: jax.Array | None = None
+    lut_base: jax.Array | None = None  # scalar uint32
+
+
+# uid-span budget multiplier and floor for materializing rank_lut
+_LUT_SPAN_FLOOR = 1 << 20
+_LUT_SPAN_MULT = 4
 
 
 def build_values(pairs: dict[int, int]) -> DeviceValues:
@@ -227,6 +240,7 @@ def build_values(pairs: dict[int, int]) -> DeviceValues:
     uids = np.full(n_pad, SENTINEL, np.uint32)
     ranks = np.full(n_pad, RANK_MISSING, np.int32)
     host_keys = np.empty(0, np.int64)
+    lut = base = None
     if n:
         u = np.fromiter(pairs.keys(), dtype=np.uint32, count=n)
         k = np.fromiter(pairs.values(), dtype=np.int64, count=n)
@@ -234,10 +248,45 @@ def build_values(pairs: dict[int, int]) -> DeviceValues:
         host_keys, inv = np.unique(k, return_inverse=True)
         uids[:n] = u[order]
         ranks[:n] = inv[order].astype(np.int32)
+        umin = int(u.min())
+        span = int(u.max()) - umin + 1
+        if span <= max(_LUT_SPAN_FLOOR, _LUT_SPAN_MULT * n):
+            table = np.full(pad_to(span), RANK_MISSING, np.int32)
+            table[u - np.uint32(umin)] = inv.astype(np.int32)
+            lut = jnp.asarray(table)
+            base = jnp.asarray(np.uint32(umin))
     by_key = np.lexsort((uids, ranks))
     return DeviceValues(jnp.asarray(uids), jnp.asarray(ranks),
                         jnp.asarray(ranks[by_key]),
-                        jnp.asarray(uids[by_key]), host_keys, n)
+                        jnp.asarray(uids[by_key]), host_keys, n,
+                        lut, base)
+
+
+def dv_view(dv: DeviceValues) -> tuple[tuple[jax.Array, jax.Array], bool]:
+    """(payload, is_lut) pair for view_ranks: the dense-LUT form when the
+    tablet carries one, else the binary-search form. The bool is a
+    STATIC trace parameter — callers must thread it into their jit_stage
+    statics so LUT and search executables never alias."""
+    if dv.rank_lut is not None:
+        return (dv.rank_lut, dv.lut_base), True
+    return (dv.uids, dv.ranks), False
+
+
+def view_ranks(cand: jax.Array, view: tuple[jax.Array, jax.Array],
+               is_lut: bool, valid: jax.Array) -> jax.Array:
+    """Ranks aligned to candidate uids from a dv_view payload; absent or
+    invalid candidates get RANK_MISSING. LUT form is one gather; search
+    form binary-searches the sorted uid plane (cand must be sorted)."""
+    if is_lut:
+        lut, lbase = view
+        off = cand - lbase  # uint32: wraps huge for cand < base
+        in_range = valid & (off < jnp.uint32(lut.shape[0]))
+        idx = jnp.clip(off, 0, jnp.uint32(lut.shape[0] - 1)).astype(jnp.int32)
+        return jnp.where(in_range, lut[idx], RANK_MISSING)
+    du, dr = view
+    idx = jnp.clip(lookup_idx(du, cand), 0, du.shape[0] - 1)
+    hit = (du[idx] == cand) & valid
+    return jnp.where(hit, dr[idx], RANK_MISSING)
 
 
 def key_gather(dv: DeviceValues, uids: jax.Array,
@@ -367,6 +416,156 @@ def count_filter_sort_page(cand: jax.Array, degrees: jax.Array,
                               limit=n_kept)
     return jnp.concatenate(
         [page, start[None].astype(jnp.uint32),
+         n_kept[None].astype(jnp.uint32)])
+
+
+# Selection geometry of the fused whole-block kernel: candidates
+# histogram into FUSED_SEL_BUCKETS primary-rank buckets and at most
+# FUSED_SEL_CAP survivors reach the (small, cheap) exact multi-key
+# sort. Both are STATIC — the cap bounds the sort operand so the
+# executable's cost never scales with the candidate set, only the
+# linear passes do. A page that cannot be proven inside the cap
+# (boundary-bucket tie mass > cap) makes the kernel report
+# sel_count > cap and the executor re-runs the staged chain.
+FUSED_SEL_BUCKETS = 4096
+FUSED_SEL_CAP = 4096
+
+
+def fused_rank_page(cand: jax.Array,
+                    rank_views: tuple, rank_luts: tuple,
+                    rank_los: tuple, rank_his: tuple, rank_negs: tuple,
+                    fparts: tuple, set_negs: tuple, set_aligned: bool,
+                    fop: str,
+                    ord_views: tuple, ord_luts: tuple, descs: tuple,
+                    base0: jax.Array, shift: int, window: int,
+                    offset: jax.Array):
+    """Whole-block chain — filter algebra + multi-key order + offset/
+    first page — as ONE traceable program: the fused tier's kernel
+    (query/fusion.py jits it through the `jit_stage` seam, which also
+    owns the mesh sharding constraints — this function stays pure and
+    un-jitted so the seam is the only compile site, dglint DG02).
+
+    Filter leaves come in two forms and fold under `fop` ("none" |
+    "and" | "or") with per-leaf negation flags:
+
+      rank leaves — dv_view payloads of the leaf predicate (dense
+        rank LUT when the tablet's uid span is compact, else the
+        sorted uid/rank planes; `rank_luts` carries the STATIC form
+        flags) plus TRACED [lo, hi) rank bounds: eq/ineq on predicates
+        whose sort key is injective (int/float/bool/datetime) evaluate
+        as a gather + range test, no host index probe and no per-query
+        upload; a threshold change re-binds two scalars, ZERO
+        recompiles.
+      set leaves — host-evaluated leaf sets (string eq, has,
+        lang/list predicates), the general fallback form. When
+        `set_aligned` (candidates host-known: the common eq-root
+        shape) each fpart arrives as a bool mask ALIGNED to cand —
+        the membership test already happened in one host searchsorted
+        and the device sees a pure vector operand; otherwise (device-
+        resident roots) fparts are sorted padded uid vectors and
+        membership runs on device.
+
+    Ordering avoids the full-width device sort (O(n log n) comparator
+    sorts dwarf every linear pass at 500M-regime candidate counts)
+    AND full-width scatters (XLA lowers scatter serially on sub-TPU
+    backends; measured 12ms of a 23ms kernel at 2^17 candidates):
+    kept candidates bucket by the desc-adjusted PRIMARY order rank
+    (missing ranks bucket just past the real ones — the host path's
+    missing-sinks-last rule), an unrolled binary search of masked
+    REDUCTIONS finds the bucket threshold covering offset+window
+    rows, and survivors compact through cumsum + searchsorted +
+    gather — every full-width pass is a map or a reduce. Only the
+    <= FUSED_SEL_CAP survivors take the exact multi-key lax.sort, and
+    secondary order keys gather on the survivor vector alone. Buckets
+    are monotone in the primary rank, so the sorted survivors are a
+    byte-exact prefix of the staged full ordering — the page slice is
+    identical. `base0` recenters desc-negated ranks (traced: domain
+    growth re-binds, only a `shift` change recompiles).
+
+    Returns one packed uint32 array [page..., sel_count, n_kept]; a
+    sel_count > FUSED_SEL_CAP means the boundary tie mass overflowed
+    the cap and the caller must use the staged chain."""
+    valid = cand != SENTINEL
+    masks = []
+    for view, is_lut, lo, hi in zip(rank_views, rank_luts, rank_los,
+                                    rank_his):
+        r = view_ranks(cand, view, is_lut, valid)
+        masks.append((r != RANK_MISSING) & (r >= lo) & (r < hi))
+    for fp in fparts:
+        masks.append((fp & valid) if set_aligned
+                     else member_mask(cand, fp))
+    if fop == "and":
+        keep = valid
+        for m, neg in zip(masks, rank_negs + set_negs):
+            keep = keep & (~m if neg else m)
+    elif fop == "or":
+        hit = jnp.zeros(cand.shape[0], bool)
+        for m, neg in zip(masks, rank_negs + set_negs):
+            hit = hit | (~m if neg else m)
+        keep = valid & hit
+    else:
+        keep = valid
+    keep = keep & valid  # a negated leaf must never resurrect padding
+    n_kept = jnp.sum(keep)
+
+    nb = jnp.int32(FUSED_SEL_BUCKETS)
+    c0 = view_ranks(cand, ord_views[0], ord_luts[0], valid)
+    if descs[0]:
+        c0 = jnp.where(c0 == RANK_MISSING, c0, -c0)
+    miss0 = c0 == RANK_MISSING
+    # miss0 rows shift from base0 (not RANK_MISSING - base0, which
+    # overflows int32 under a desc recenter) and rebucket to nb after
+    b = jnp.clip((jnp.where(miss0, base0, c0) - base0) >> shift,
+                 0, nb - 1)
+    b = jnp.where(miss0, nb, b)
+    b = jnp.where(keep, b, nb + 1)
+    # smallest bucket threshold covering offset+window kept rows
+    # (= searchsorted-left of the bucket cumulative), found by an
+    # UNROLLED binary search of masked reductions — no histogram
+    # scatter. Dropped rows sit in bucket nb+1, outside every probe.
+    target = offset + jnp.int32(window)
+    lo_t = jnp.int32(0)
+    hi_t = nb
+    for _ in range(FUSED_SEL_BUCKETS.bit_length()):
+        open_ = lo_t < hi_t
+        mid = (lo_t + hi_t) >> 1
+        cnt = jnp.sum(b <= mid, dtype=jnp.int32)
+        pred = cnt >= target
+        hi_t = jnp.where(open_ & pred, mid, hi_t)
+        lo_t = jnp.where(open_ & ~pred, mid + 1, lo_t)
+    thresh = lo_t
+    sel = keep & (b <= thresh)
+    # scatter-free compaction: survivor o (1-based) lives at the first
+    # index whose selection prefix sum reaches o; one sorted-query
+    # searchsorted + gather replaces the serial scatter
+    pos = jnp.cumsum(sel.astype(jnp.int32))
+    sel_count = pos[-1]
+    sidx = jnp.clip(
+        jnp.searchsorted(pos, jnp.arange(1, FUSED_SEL_CAP + 1,
+                                         dtype=jnp.int32),
+                         side="left"),
+        0, cand.shape[0] - 1)
+    got = jnp.arange(1, FUSED_SEL_CAP + 1, dtype=jnp.int32) <= sel_count
+    # compaction preserves cand's ascending order, so the survivor
+    # vector satisfies the sorted-query precondition of the search-
+    # form gathers below; unfilled slots carry RANK_MISSING keys +
+    # SENTINEL uid and the uid operand sinks them last
+    out_u = jnp.where(got, cand[sidx], SENTINEL)
+    svalid = out_u != SENTINEL
+    outs = []
+    for view, is_lut, desc in zip(ord_views, ord_luts, descs):
+        r = view_ranks(out_u, view, is_lut, svalid)
+        if desc:
+            r = jnp.where(r == RANK_MISSING, r, -r)
+        outs.append(r)
+    suids = jax.lax.sort(tuple(outs) + (out_u,),
+                         num_keys=len(outs) + 1)[-1]
+    ext = jnp.concatenate(
+        [suids, jnp.full((window,), SENTINEL, suids.dtype)])
+    page = jax.lax.dynamic_slice(ext, (offset.astype(jnp.int32),),
+                                 (window,))
+    return jnp.concatenate(
+        [page, sel_count[None].astype(jnp.uint32),
          n_kept[None].astype(jnp.uint32)])
 
 

@@ -92,20 +92,30 @@ def union_many(parts: Sequence[np.ndarray]) -> np.ndarray:
 
 
 def intersect_many(parts: Sequence[np.ndarray],
-                   gallop_ratio: int = _GALLOP_RATIO) -> np.ndarray:
+                   gallop_ratio=_GALLOP_RATIO) -> np.ndarray:
     """k-way intersection, smallest set first so every galloping probe
     runs over the narrowest possible accumulator (ref
     algo.IntersectSorted sorts by length, algo/uidlist.go:287).
     `gallop_ratio` tunes the per-pair gallop-vs-merge pivot (see
-    intersect_pair)."""
+    intersect_pair): one int for every fold, or a sequence of
+    per-FOLD ratios aligned with the ascending fold order (the
+    planner's intersect_schedule — the accumulator gets sparser as
+    folds proceed, so late folds gallop earlier). A ratio only picks
+    the strategy; results are byte-identical either way."""
     if not len(parts):
         return _EMPTY
     ordered = sorted(parts, key=len)
+    per_fold = None
+    if not isinstance(gallop_ratio, int):
+        per_fold = tuple(gallop_ratio)
+        gallop_ratio = _GALLOP_RATIO
     acc = np.asarray(ordered[0])
-    for p in ordered[1:]:
+    for i, p in enumerate(ordered[1:]):
         if not len(acc):
             return _EMPTY
-        acc = intersect_pair(acc, p, gallop_ratio)
+        r = per_fold[i] if per_fold is not None \
+            and i < len(per_fold) else gallop_ratio
+        acc = intersect_pair(acc, p, r)
     return acc
 
 

@@ -1,11 +1,21 @@
-"""Device mesh construction."""
+"""Device mesh construction + per-plan partition rules.
+
+`match_partition_rules` is the pjit idiom (see SNIPPETS.md): a plan
+declares ONE ordered table of (regex, PartitionSpec) rules; every
+named operand of a compiled executable matches the first rule that
+hits its name. The fused whole-plan executables (query/fusion.py)
+declare their sharding this way instead of hand-placing constraints
+per call site, so a mesh-layout change edits a table, not kernels.
+"""
 
 from __future__ import annotations
+
+import re
 
 import numpy as np
 
 import jax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
 def make_mesh(n_devices: int | None = None,
@@ -31,3 +41,33 @@ def make_mesh(n_devices: int | None = None,
     sizes[-1] *= n  # odd remainder onto the uid axis
     arr = np.asarray(devs).reshape(sizes)
     return Mesh(arr, axes)
+
+
+def match_partition_rules(rules, name: str) -> PartitionSpec:
+    """First-match lookup of an operand name against an ordered
+    (regex, PartitionSpec) table — the pjit partition-rule pattern.
+    Scalars and unmatched names replicate (PartitionSpec())."""
+    for pat, spec in rules:
+        if re.search(pat, name):
+            return spec
+    return PartitionSpec()
+
+
+def shard_by_rules(mesh: Mesh | None, rules, named: dict):
+    """Apply rule-derived sharding constraints to a dict of named
+    arrays inside a traced computation. On a None mesh (single chip /
+    CPU) this is the identity — the rules stay declared and testable,
+    the lowering just has nowhere to place anything. Axes a rule names
+    that the mesh lacks degrade to replication rather than error (a
+    plan compiled for a `uid`-sharded mesh stays valid on one chip)."""
+    if mesh is None:
+        return named
+    out = {}
+    for name, arr in named.items():
+        spec = match_partition_rules(rules, name)
+        if any(ax is not None and ax not in mesh.axis_names
+               for ax in spec):
+            spec = PartitionSpec()
+        out[name] = jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, spec))
+    return out

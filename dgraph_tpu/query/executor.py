@@ -323,6 +323,11 @@ class ExecNode:
     # filter/pagination (-1 = not measured, e.g. the device
     # count-at-root fast path never materializes the set)
     root_rows: int = -1
+    # whole-plan fusion attribution (query/fusion.py): "fused" when
+    # the block's filter+order+page chain ran as ONE device
+    # executable, "staged:<reason>" when a structurally-eligible
+    # block fell back at runtime, "" when fusion never applied
+    fused: str = ""
 
 
 class Executor:
@@ -391,6 +396,15 @@ class Executor:
         — the reference ranks ToJson a top-5 hot loop) and pick the
         columnar fast path."""
         self.parsed = parsed
+        pf = getattr(self.db, "prefetcher", None)
+        if pf is not None:
+            # announce the request's predicate working set before the
+            # first block runs: cold-store blobs decode on the
+            # prefetch pool while earlier blocks compute, and
+            # TabletMap.get consumes them on arrival (the decode-stall
+            # overlap BENCH_500M measures)
+            from dgraph_tpu.query.fusion import collect_preds
+            pf.schedule(self.db, collect_preds(parsed))
         if self.plan is None:
             self._check_similar_score_ambiguity(parsed)
         else:
@@ -674,14 +688,20 @@ class Executor:
         self._similar_order = None
         root = self._device_root_count_page(gq)
         if root is None:
+            fspec = self._fused_spec(gq, i)
             root = self._root_uids(gq)
             node.root_rows = int(len(root))
-            if gq.filter is not None:
-                root = self._eval_filter(gq.filter, root)
-            if self._similar_order is not None and not gq.order:
-                root = self._similar_paginate(gq, root, node)
+            paged = self._fused_block_page(gq, fspec, root, node) \
+                if fspec is not None else None
+            if paged is not None:
+                root = paged
             else:
-                root = self._order_paginate(gq, root)
+                if gq.filter is not None:
+                    root = self._eval_filter(gq.filter, root)
+                if self._similar_order is not None and not gq.order:
+                    root = self._similar_paginate(gq, root, node)
+                else:
+                    root = self._order_paginate(gq, root)
         if not gq.order and gq.func is not None \
                 and gq.func.name == "uid" and len(gq.func.needs_var) == 1:
             ordered = self._path_var_order.get(
@@ -1101,6 +1121,13 @@ class Executor:
         pl = getattr(self.db, "planner_impl", None)
         if pl is not None and len(parts) >= 2:
             lens = [len(p) for p in parts]
+            # per-fold schedule (>=3 parts: the accumulator-density
+            # model has something to decay over), else the flat
+            # density-derived ratio; both only pick strategies, the
+            # intersection bytes are identical
+            sched = pl.intersect_schedule(lens)
+            if sched is not None:
+                return setops.intersect_many(parts, gallop_ratio=sched)
             return setops.intersect_many(
                 parts, gallop_ratio=pl.gallop_ratio(min(lens),
                                                     max(lens)))
@@ -3951,18 +3978,24 @@ class Executor:
             w <<= 1
         return w
 
-    def _device_resident_root(self, gq: GraphQuery, uids: np.ndarray):
+    def _device_resident_root(self, gq: GraphQuery, uids: np.ndarray,
+                              allow_filter: bool = False):
         """The device-resident uid vector of an unfiltered clean
         has(attr) root, or None. When the root candidate set IS the
         tablet's own device view, the sort page kernel reads it in
-        place — no 4MB-per-query upload over the tunnel."""
+        place — no 4MB-per-query upload over the tunnel.
+        `allow_filter` is the fused-path relaxation: fusion calls this
+        with the PRE-filter root (its kernel applies the filter as
+        membership masks), so a filter's presence no longer disproves
+        uids == the tablet's key set."""
         from dgraph_tpu.engine.device_cache import (
             device_adjacency, device_values,
         )
 
         fn = gq.func
         if fn is None or fn.name != "has" or fn.attr.startswith("~") \
-                or gq.filter is not None or gq.uids or gq.needs_var:
+                or (gq.filter is not None and not allow_filter) \
+                or gq.uids or gq.needs_var:
             return None
         tab = self.db.tablets.get(fn.attr)
         if tab is None or not hasattr(tab, "schema"):
@@ -4025,6 +4058,247 @@ class Executor:
         start = int(np.int32(res[-1]))
         valid = max(0, min(first, len(uids) - start))
         return res[:valid].astype(np.uint64)
+
+    def _fused_spec(self, gq: GraphQuery, i: int):
+        """Structural whole-plan-fusion verdict for block `i`,
+        recomputed per request — deliberately NOT memoized on the
+        plan: the verdict carries this request's filter Function
+        objects, and the plan is shared across requests whose literals
+        differ (a cached leaf would replay the FIRST request's
+        literals into every later mask — wrong bytes, not just wrong
+        speed). The walk is a handful of attribute checks and schema
+        probes, noise next to one device dispatch. None on the
+        interpreted path — fusion is a compiled-plan tier."""
+        if self.plan is None or i < 0:
+            return None
+        from dgraph_tpu.query import fusion
+        return fusion.block_eligible(gq, self.db.schema)
+
+    def _fused_block_page(self, gq: GraphQuery, fspec, root: np.ndarray,
+                          node: ExecNode) -> Optional[np.ndarray]:
+        """Whole-block chain — filter set algebra + multi-key order +
+        after/offset/first — as ONE fused device dispatch
+        (query/fusion.py), or None to run the staged pipeline.
+        `root` is the staged `_root_uids` result: the index probes
+        stay on host (planner/tier machinery intact) and fusion
+        collapses everything downstream of them. Every fallback stamps
+        its reason on the node ("staged:<why>") so EXPLAIN attributes
+        the block either way; byte-parity with the staged path is the
+        structural contract (tests/test_columnar_parity.py)."""
+        why, fs = fspec
+        if why != "ok":
+            node.fused = "staged:" + why
+            return None
+
+        def _stage(reason: str) -> None:
+            node.fused = "staged:" + reason
+            return None
+
+        if not getattr(self.db, "prefer_fused", True):
+            return _stage("disabled")
+        first = gq.first
+        if first is None or first <= 0 or first > self._PAGE_MAX_FIRST:
+            return _stage("first-range")
+        if gq.after:
+            # the selection kernel can't bound how deep an arbitrary
+            # cursor uid sits in the ordering
+            return _stage("after-cursor")
+        window = self._page_window(first)
+        offset = gq.offset or 0
+        from dgraph_tpu.ops.graph import FUSED_SEL_CAP
+        if not 0 <= offset or offset + window > FUSED_SEL_CAP:
+            # the page must fit inside the kernel's static survivor cap
+            return _stage("deep-offset")
+        if len(root) < max(8, getattr(self.db, "fused_min_rows", 1024)):
+            # tiny roots: one dispatch still costs a round-trip the
+            # host pipeline finishes first
+            return _stage("small-root")
+        if np.any(root > 0xFFFFFFFE):
+            return _stage("uids-64bit")
+        dvs = self._order_device_views(gq.order)
+        if dvs is None:
+            # dirty/small/unexported order tablets: the same MVCC rule
+            # as every device tier
+            return _stage("no-device-views")
+
+        from dgraph_tpu.engine.device_cache import device_values
+        from dgraph_tpu.ops.uidvec import SENTINEL, pad_to, to_numpy
+        from dgraph_tpu.query import fusion
+        import jax.numpy as jnp
+
+        from dgraph_tpu.ops.graph import dv_view
+
+        # root fingerprint: the snapshot ts plus cheap positional
+        # invariants of the root set. Memo keys below pair it with the
+        # full leaf/func signature, so a hit requires the same literals
+        # against the same snapshot — the conditions under which the
+        # staged chain would reproduce the same bytes.
+        rfp = (self.read_ts, len(root),
+               int(root[0]) if len(root) else 0,
+               int(root[-1]) if len(root) else 0,
+               int(root[::257].sum()) if len(root) else 0)
+        cand = self._device_resident_root(gq, root, allow_filter=True)
+        host_root = None
+        if cand is None:
+            def _root_upload():
+                hr = np.sort(root).astype(np.uint32)
+                buf = np.full(pad_to(len(root)), SENTINEL, np.uint32)
+                buf[: len(hr)] = hr
+                return hr, jnp.asarray(buf)
+
+            host_root, cand = self.plan.memo(
+                ("fused-root", self._fn_sig(gq.func), rfp),
+                _root_upload)
+
+        fop, leaves = fs
+        rank_views, rank_luts, rank_los, rank_his, rank_negs = \
+            [], [], [], [], []
+        fparts, set_negs = [], []
+        for fn, neg, kind in leaves:
+            bounds = None
+            if kind == "rank":
+                tab = self._tablet(fn.attr)
+                dv = device_values(self.db, tab, self.read_ts) \
+                    if tab is not None else None
+                if dv is not None:
+                    bounds = self._rank_leaf_bounds(dv, tab.schema, fn)
+            if bounds is not None:
+                view, is_lut = dv_view(dv)
+                rank_views.append(view)
+                rank_luts.append(is_lut)
+                rank_los.append(jnp.int32(bounds[0]))
+                rank_his.append(jnp.int32(bounds[1]))
+                rank_negs.append(bool(neg))
+                continue
+            # set form — host root-context probe (pointwise-equal to
+            # the staged candidate-context eval, the parity
+            # precondition block_eligible enforces), and the demotion
+            # target when a rank leaf's view is missing (dirty/small
+            # tablet) or its literal doesn't convert (the staged eval
+            # then raises the identical GQLError)
+            sig = self._fn_sig(fn)
+
+            def _leaf(fn=fn):
+                return self._eval_func(fn, None)
+
+            if host_root is not None:
+                # host-known candidates: fold the membership test into
+                # ONE host searchsorted and ship a cand-ALIGNED bool
+                # mask — the kernel sees a pure vector operand instead
+                # of a device-side binary search per candidate
+                def _mask(fn=fn, sig=sig, cand=cand, hr=host_root):
+                    part = self.plan.memo(
+                        ("fused-leaf", sig, self.read_ts), _leaf) \
+                        if sig is not None else _leaf()
+                    mask = np.zeros(int(cand.shape[0]), bool)
+                    if len(part) and len(hr):
+                        pi = np.minimum(np.searchsorted(part, hr),
+                                        len(part) - 1)
+                        mask[: len(hr)] = part[pi] == hr
+                    return jnp.asarray(mask)
+
+                fparts.append(
+                    self.plan.memo(("fused-mask", sig, rfp), _mask)
+                    if sig is not None else _mask())
+            else:
+                part = self.plan.memo(
+                    ("fused-leaf", sig, self.read_ts), _leaf) \
+                    if sig is not None else _leaf()
+                if np.any(part > 0xFFFFFFFE):
+                    return _stage("filter-64bit")
+
+                def _part_upload(part=part):
+                    buf = np.full(pad_to(len(part)), SENTINEL,
+                                  np.uint32)
+                    buf[: len(part)] = part.astype(np.uint32)
+                    return jnp.asarray(buf)
+
+                fparts.append(
+                    self.plan.memo(("fused-part", sig, self.read_ts),
+                                   _part_upload)
+                    if sig is not None else _part_upload())
+            set_negs.append(bool(neg))
+        # primary-rank bucket geometry: static shift (recompiles only
+        # when the key domain crosses a power of two), traced recenter
+        domain = max(1, len(dvs[0].host_keys))
+        shift = max(0, (domain - 1).bit_length() - 12)
+        base0 = -(domain - 1) if gq.order[0].desc else 0
+        ord_pairs = [dv_view(dv) for dv in dvs]
+        run = fusion.fused_executable(
+            self.db.mesh, self.plan.mesh_key, fop,
+            tuple(rank_negs), tuple(set_negs), host_root is not None,
+            tuple(bool(o.desc) for o in gq.order), window, shift,
+            tuple(rank_luts), tuple(is_lut for _, is_lut in ord_pairs))
+        inc_counter("query_fused_dispatch_total")
+        out = run(cand, tuple(rank_views),
+                  tuple(rank_los), tuple(rank_his), tuple(fparts),
+                  tuple(view for view, _ in ord_pairs),
+                  jnp.int32(base0), jnp.int32(offset))
+        res = to_numpy(out)
+        sel_count = int(res[-2])
+        n_kept = int(res[-1])
+        if sel_count > FUSED_SEL_CAP:
+            # boundary tie mass overflowed the survivor cap (e.g. a
+            # few-distinct-values primary order): page unprovable on
+            # device, the staged chain is the answer
+            return _stage("tie-overflow")
+        valid = max(0, min(first, n_kept - offset))
+        node.fused = "fused"
+        return res[:valid].astype(np.uint64)
+
+    @staticmethod
+    def _fn_sig(fn) -> Optional[tuple]:
+        """Hashable full-literal signature of a root/filter function,
+        or None when the call depends on request-scoped state (value
+        variables) that a cross-request memo key cannot capture."""
+        if fn is None or fn.needs_var or fn.is_value_var \
+                or fn.is_len_var:
+            return None
+        return (fn.name, fn.attr, fn.lang, fn.is_count,
+                tuple((a.value, a.is_value_var, a.is_graphql_var)
+                      for a in fn.args),
+                tuple(fn.uids))
+
+    @staticmethod
+    def _rank_leaf_bounds(dv, ps, fn: Function
+                          ) -> Optional[tuple[int, int]]:
+        """[lo, hi) rank bounds over dv.host_keys for a rank-form
+        filter leaf, or None to demote it to set form. Conversion
+        mirrors the staged eq/ineq literal handling (Val DEFAULT ->
+        predicate type); sort-key injectivity on the rank-exact types
+        makes the range byte-equal to the staged leaf set."""
+        from dgraph_tpu.models.types import Val, convert, sort_key
+
+        def key(raw) -> int:
+            return sort_key(convert(Val(TypeID.DEFAULT, raw),
+                                    ps.value_type))
+
+        hk = dv.host_keys
+        try:
+            if fn.name == "between":
+                return (int(np.searchsorted(hk, key(fn.args[0].value),
+                                            "left")),
+                        int(np.searchsorted(hk, key(fn.args[1].value),
+                                            "right")))
+            k = key(fn.args[0].value)
+        except (ValueError, TypeError, OverflowError,
+                AttributeError):
+            return None
+        lo, hi = 0, len(hk)
+        if fn.name == "eq":
+            lo = int(np.searchsorted(hk, k, "left"))
+            hi = int(np.searchsorted(hk, k, "right"))
+        elif fn.name == "ge":
+            lo = int(np.searchsorted(hk, k, "left"))
+        elif fn.name == "gt":
+            lo = int(np.searchsorted(hk, k, "right"))
+        elif fn.name == "le":
+            hi = int(np.searchsorted(hk, k, "right"))
+        elif fn.name == "lt":
+            hi = int(np.searchsorted(hk, k, "left"))
+        else:
+            return None
+        return lo, hi
 
     @staticmethod
     def _count_cmp_bounds(fn: Function) -> Optional[tuple[int, int]]:

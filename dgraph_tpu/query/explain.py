@@ -255,6 +255,11 @@ def _explain_node(db, gq: GraphQuery, node, mode: str,
         "attr": gq.attr,
         **est,
     }
+    if depth == 0 and getattr(node, "fused", ""):
+        # per-block fusion attribution: "fused" when the whole
+        # filter+order+page chain ran as one device executable,
+        # "staged:<reason>" when it fell back (query/fusion.py)
+        out["fusion"] = node.fused
     if mode == "analyze":
         out["actualRows"] = _node_rows(node)
         if depth == 0:
@@ -317,6 +322,11 @@ def build_explain(db, ex, done, expinfo: dict) -> dict:
             and bool(getattr(db, "prefer_compressed", True)),
             "device": bool(getattr(db, "prefer_device", False)),
             "deviceMinEdges": int(getattr(db, "device_min_edges", 0)),
+            # whole-plan fusion (query/fusion.py): a compiled-plan
+            # tier — per-block served/fell-back attribution rides on
+            # each block node as `fusion`
+            "fused": bool(getattr(db, "prefer_fused", True)),
+            "fusedMinRows": int(getattr(db, "fused_min_rows", 0)),
             "quantized": bool(getattr(db, "vec_quantized", False)),
             # per-stage vector-tier decisions, one per similar_to
             # evaluation this request ran: the tier that actually

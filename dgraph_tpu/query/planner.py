@@ -147,6 +147,22 @@ RIVAL_MARGIN = 1.5
 # re-plan token bucket per stage key: burst + refill
 REPLAN_BURST = 4
 REPLAN_REFILL_S = 10.0
+# -- exploration: budgeted probing of UNOBSERVED tiers ----------------
+# The self-correction loop above only re-judges tiers that have
+# evidence; a tier nobody ever served stays cold forever (the rival
+# check needs a warm cell to rival with). Exploration closes that gap:
+# once a stage key has real evidence for SOME tier, a cold tier whose
+# modeled cost is within EXPLORE_MARGIN of the chosen tier's may be
+# probed — served once, its stage span lands a cost cell, and the
+# decision immediately re-judges with the new evidence
+# (record_outcome invalidates an explored decision after its first
+# outcome). Budgeted by its own token bucket per stage key so a hot
+# path never pays more than EXPLORE_BURST probes per refill window,
+# and NEVER fires cold-cold: with no evidence at all the static
+# ladder stays authoritative (the documented cold contract).
+EXPLORE_MARGIN = 4.0
+EXPLORE_BURST = 2
+EXPLORE_REFILL_S = 30.0
 # learned-actual EWMA weight (fast: a violation should dominate the
 # stale estimate within a couple of observations)
 LEARN_ALPHA = 0.5
@@ -240,6 +256,11 @@ class AdaptivePlanner:
         self._learned: dict[tuple, float] = {}
         # (skeleton, stage, pred) -> (tokens, last_refill_mono)
         self._replan_tokens: dict[tuple, list] = {}
+        # (skeleton, stage, pred) -> (tokens, last_refill_mono) for
+        # cold-tier exploration (separate budget: a replan storm must
+        # not eat the exploration allowance and vice versa)
+        self._explore_tokens: dict[tuple, list] = {}
+        self._explored = 0
         # decision mix for /debug/stats + the dgtop PLANNER panel
         self._mix: dict[tuple[str, str], int] = {}
         self._built = 0
@@ -365,6 +386,14 @@ class AdaptivePlanner:
             basis = "prior" if not warm else "observed"
             why = "static priors (cold cells)" if not warm \
                 else "observed EWMA"
+        probe = self._maybe_explore(skeleton, stage, pred, avail,
+                                    warm, costs, tier)
+        if probe is not None:
+            basis = "explored"
+            why = (f"probing cold tier {probe} "
+                   f"({costs[probe]:.0f}us model) vs chosen {tier} "
+                   f"({costs[tier]:.0f}us)")
+            tier = probe
         dec = Decision(stage, pred, tier, basis, est_rows, est_basis,
                        bucket, costs, version, why, skeleton,
                        rows_buckets=rows_buckets)
@@ -375,6 +404,43 @@ class AdaptivePlanner:
             k = (stage, tier)
             self._mix[k] = self._mix.get(k, 0) + 1
         return dec
+
+    def _maybe_explore(self, skeleton: str, stage: str, pred: str,
+                       avail: tuple[str, ...], warm: list,
+                       costs: dict[str, float],
+                       chosen: str) -> Optional[str]:
+        """The cheapest UNOBSERVED tier worth one budgeted probe, or
+        None. Fires only with real evidence present (never cold-cold —
+        the static ladder stays the cold contract), only within
+        EXPLORE_MARGIN of the chosen tier's modeled cost, and only
+        while the stage key's exploration token bucket has budget."""
+        if not getattr(self.db, "planner_explore", True) or not warm:
+            return None
+        cold = [t for t in avail if t not in warm and t != chosen]
+        if not cold:
+            return None
+        best = min(cold, key=lambda t: costs[t])
+        if costs[best] > EXPLORE_MARGIN * costs[chosen]:
+            return None
+        now = _time.monotonic()
+        k = (skeleton, stage, pred)
+        with self._lock:
+            tb = self._explore_tokens.get(k)
+            if tb is None:
+                if len(self._explore_tokens) >= MAX_KEYS:
+                    self._explore_tokens.clear()
+                tb = [float(EXPLORE_BURST), now]
+                self._explore_tokens[k] = tb
+            tb[0] = min(float(EXPLORE_BURST),
+                        tb[0] + (now - tb[1]) / EXPLORE_REFILL_S)
+            tb[1] = now
+            if tb[0] < 1.0:
+                return None
+            tb[0] -= 1.0
+            self._explored += 1
+        metrics.inc_counter("planner_explored_total",
+                            labels={"tier": best})
+        return best
 
     # -- outcome / re-optimization -------------------------------------
 
@@ -391,6 +457,13 @@ class AdaptivePlanner:
         actual_rows = max(0, int(actual_rows))
         ab = _bucket(actual_rows)
         key = (dec.skeleton, dec.stage, dec.pred)
+        if dec.basis == "explored":
+            # the probe served: its stage span just landed the cold
+            # tier's first cost cell. Re-judge immediately instead of
+            # serving the probe tier until drift/rival notices — one
+            # exploration buys exactly one observation
+            self._invalidate(key, "explored")
+            return
         if abs(ab - dec.bucket) >= VIOLATION_BUCKETS:
             with self._lock:
                 self._violations += 1
@@ -518,6 +591,34 @@ class AdaptivePlanner:
             return 4
         return 16
 
+    @classmethod
+    def intersect_schedule(cls, lens) -> Optional[tuple[int, ...]]:
+        """Per-FOLD gallop ratios for a k-way intersection over parts
+        of the given lengths — the intersection-ORDER decision beyond
+        the single smallest-vs-largest pivot. The fold order is
+        ascending length (commutative: parity-free); what changes per
+        fold is the accumulator DENSITY: under the independent-draw
+        model |A∩B| ≈ |A|·|B|/U (universe proxied by the largest
+        part), the accumulator shrinks as folds proceed, so late
+        folds against large parts are far sparser than the global
+        smallest/largest ratio suggests and should gallop earlier.
+        Returns len(lens)-1 ratios aligned with setops.intersect_many's
+        ascending fold order, or None for trivial inputs (callers keep
+        the flat-ratio path)."""
+        lens = sorted(int(n) for n in lens)
+        if len(lens) < 3:
+            return None  # single fold: the flat ratio IS the schedule
+        universe = float(max(lens[-1], 1))
+        acc = float(lens[0])
+        ratios = []
+        for n in lens[1:]:
+            # max(.,1): an expected-empty accumulator should gallop
+            # (sparse), not trip gallop_ratio's degenerate-input guard
+            ratios.append(cls.gallop_ratio(max(int(acc), 1), n))
+            # expected accumulator after this fold (never grows)
+            acc = max(0.0, min(acc, acc * n / universe))
+        return tuple(ratios)
+
     # -- introspection -------------------------------------------------
 
     def stats(self) -> dict:
@@ -531,6 +632,7 @@ class AdaptivePlanner:
                     "warmServes": self._warm_serves,
                     "mix": mix,
                     "estimateViolations": self._violations,
+                    "explored": self._explored,
                     "reoptimized": self._reoptimized,
                     "replansSuppressed": self._suppressed,
                     "learnedKeys": len(self._learned),

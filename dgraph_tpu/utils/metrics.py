@@ -54,8 +54,16 @@ REGISTERED = (
     # adaptive planner (query/planner.py)
     "planner_decisions_total",
     "planner_estimate_violations_total",
+    "planner_explored_total",
     "planner_reoptimized_total",
     "planner_replans_suppressed_total",
+    # whole-plan fusion + cold-store prefetch (query/fusion.py,
+    # engine/prefetch.py)
+    "prefetch_bytes_total",
+    "prefetch_hits_total",
+    "prefetch_misses_total",
+    "prefetch_queue_depth",
+    "query_fused_dispatch_total",
     # query executor tier counters (query/executor.py)
     "query_columnar_var_bind_total",
     "query_colvar_hits_total",

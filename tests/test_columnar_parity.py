@@ -118,11 +118,13 @@ def _run_all(db, read_ts=None):
 
 
 def _build(prefer_columnar: bool, prefer_compressed: bool = False,
-           planner: str = "static", result_cache_entries: int = 0):
+           planner: str = "static", result_cache_entries: int = 0,
+           **kw):
     rng = random.Random(SEED)
-    db = GraphDB(prefer_device=False, prefer_columnar=prefer_columnar,
+    db = GraphDB(prefer_device=kw.pop("prefer_device", False),
+                 prefer_columnar=prefer_columnar,
                  prefer_compressed=prefer_compressed, planner=planner,
-                 result_cache_entries=result_cache_entries)
+                 result_cache_entries=result_cache_entries, **kw)
     db.alter(schema_text=SCHEMA)
     db.mutate(set_nquads="\n".join(_dataset(rng)))
     db.rollup_all()  # the "clean store" premise: tiers may serve
@@ -149,6 +151,17 @@ def adaptive_db():
 
 
 @pytest.fixture(scope="module")
+def fused_db():
+    """The whole-plan fused tier armed over the full stack, thresholds
+    dropped so it actually engages at this dataset size: every block
+    it serves in one device dispatch — and every block it declines
+    with a staged:<reason> attribution — must stay byte-identical to
+    the postings oracle."""
+    return _build(True, prefer_compressed=True, prefer_device=True,
+                  device_min_edges=8, fused_min_rows=8)
+
+
+@pytest.fixture(scope="module")
 def cached_db():
     """The CDC-invalidated result cache armed over the full tier
     stack: cache hits AND post-invalidation re-executions must stay
@@ -170,7 +183,7 @@ def _assert_threeway(runs: dict[str, dict], where: str):
                 f"\n{other}: {got[i][:800]}"
 
 
-def test_parity_clean(dbs, adaptive_db, cached_db):
+def test_parity_clean(dbs, adaptive_db, cached_db, fused_db):
     comp, col, post = dbs
     # the compressed tier actually served (not silently disabled)
     from dgraph_tpu.utils import metrics
@@ -178,12 +191,16 @@ def test_parity_clean(dbs, adaptive_db, cached_db):
     runs = {"compressed": _run_all(comp), "columnar": _run_all(col),
             "postings": _run_all(post),
             "adaptive": _run_all(adaptive_db),
+            "fused": _run_all(fused_db),
             "cache-fill": _run_all(cached_db),
             # second pass serves from the result cache: hits must be
             # the fill's exact bytes (asserted against EVERY arm)
             "cache-hit": _run_all(cached_db)}
     delta = metrics.counters_delta(before)
     assert delta.get("query_compressed_setops_total", 0) > 0
+    # the fused arm actually dispatched fused blocks (not silently
+    # staged throughout)
+    assert delta.get("query_fused_dispatch_total", 0) > 0
     # the cached arm actually served hits (not silently bypassed)
     assert delta.get("dgraph_result_cache_hits_total", 0) > 0
     # the adaptive arm made real decisions (not silently static)
@@ -199,7 +216,7 @@ def test_parity_clean(dbs, adaptive_db, cached_db):
                      "clean-settled")
 
 
-def test_parity_dirty_overlay(dbs, adaptive_db, cached_db):
+def test_parity_dirty_overlay(dbs, adaptive_db, cached_db, fused_db):
     """Mutate all stores WITHOUT rollup: the delta overlay is live,
     the columnar AND compressed tiers must fall back / merge
     row-exactly. The cached arm enters this test warm from
@@ -212,7 +229,7 @@ def test_parity_dirty_overlay(dbs, adaptive_db, cached_db):
     for i in rng.sample(range(1, 400), 60):
         edits.append(f'<0x{i:x}> <name> "Edited {i}" .')
         edits.append(f'<0x{i:x}> <score> "{rng.randint(0, 99) / 10}" .')
-    for db in (comp, col, post, adaptive_db, cached_db):
+    for db in (comp, col, post, adaptive_db, cached_db, fused_db):
         db.rollup_in_read = False  # keep the overlay live during reads
         db.mutate(set_nquads="\n".join(edits))
         assert any(t.dirty() for t in db.tablets.values())
@@ -220,16 +237,18 @@ def test_parity_dirty_overlay(dbs, adaptive_db, cached_db):
                       "columnar": _run_all(col),
                       "postings": _run_all(post),
                       "adaptive": _run_all(adaptive_db),
-                      "cached": _run_all(cached_db)},
+                      "cached": _run_all(cached_db),
+                      "fused": _run_all(fused_db)},
                      "dirty-overlay")
 
 
-def test_parity_snapshot_and_rollup_boundary(dbs, adaptive_db):
+def test_parity_snapshot_and_rollup_boundary(dbs, adaptive_db,
+                                             fused_db):
     """Reads below a tablet's rollup watermark raise StaleSnapshot on
     every tier; reads at the post-rollup snapshot agree."""
     comp, col, post = dbs
     arms = (("comp", comp), ("col", col), ("post", post),
-            ("adaptive", adaptive_db))
+            ("adaptive", adaptive_db), ("fused", fused_db))
     old_ts = {}
     for name, db in arms:
         old_ts[name] = db.coordinator.max_assigned()
@@ -245,7 +264,8 @@ def test_parity_snapshot_and_rollup_boundary(dbs, adaptive_db):
     _assert_threeway({"compressed": _run_all(comp),
                       "columnar": _run_all(col),
                       "postings": _run_all(post),
-                      "adaptive": _run_all(adaptive_db)},
+                      "adaptive": _run_all(adaptive_db),
+                      "fused": _run_all(fused_db)},
                      "post-rollup")
     # the folded write is visible through the rebuilt column caches
     for name, db in arms:

@@ -264,6 +264,35 @@ class TestRecompileHazard:
         assert "DG02" not in codes(run_fixture(
             src, rel="dgraph_tpu/query/plan.py"))
 
+    def test_fusion_module_jit_outside_seam(self):
+        """In query/fusion.py every jax.jit must live inside a build
+        thunk handed to jit_stage — a stray one forks the executable
+        registry out from under the retrace-bound contract."""
+        src = """
+            import jax
+
+            def rogue(x):
+                return jax.jit(lambda v: v + 1)
+        """
+        assert "DG02" in codes(run_fixture(
+            src, rel="dgraph_tpu/query/fusion.py"))
+
+    def test_fusion_module_jit_through_seam_clean(self):
+        src = """
+            import jax
+            from dgraph_tpu.query.plan import jit_stage
+
+            def executable(window):
+                def build():
+                    def run(x):
+                        return x[:window]
+                    return jax.jit(run)
+                return jit_stage("fusion.page", build,
+                                 static=(window,))
+        """
+        assert "DG02" not in codes(run_fixture(
+            src, rel="dgraph_tpu/query/fusion.py"))
+
 
 # ------------------------------------------------------------------ DG03
 

@@ -329,3 +329,50 @@ def test_serving_panel_renders():
     assert "CACHE%" in frame and "75" in frame
     # the panel disappears on a plain write-path cluster
     assert "SERVING" not in render({"n1": _snap()})
+
+
+def _fusion_snap(t=100.0, fused=20.0, hits=4.0, misses=1.0,
+                 nbytes=2e6, pool=True):
+    s = _snap(t=t)
+    s["stats"]["counters"].update({
+        "query_fused_dispatch_total": fused,
+        "prefetch_hits_total": hits,
+        "prefetch_misses_total": misses,
+        "prefetch_bytes_total": nbytes,
+    })
+    if pool:
+        s["stats"]["prefetch"] = {"workers": 2, "inflight": 1,
+                                  "scheduled": 9, "hits": int(hits),
+                                  "misses": int(misses), "waits": 2,
+                                  "bytes": int(nbytes)}
+    return s
+
+
+def test_fusion_rows_rates_and_pool():
+    from tools.dgtop import fusion_rows
+    a = _fusion_snap(t=100.0)
+    b = _fusion_snap(t=102.0, fused=30.0, hits=8.0, misses=1.0,
+                     nbytes=6e6)
+    # first frame: absolute counts
+    (row,) = fusion_rows({"n1": a}, None)
+    assert row["fused_rate"] == 20.0
+    assert row["workers"] == 2 and row["inflight"] == 1
+    assert row["hit_rate"] == 4.0
+    # second frame: deltas over dt
+    (row,) = fusion_rows({"n1": b}, {"n1": a})
+    assert row["fused_rate"] == pytest.approx(5.0)   # (30-20)/2s
+    assert row["hit_rate"] == pytest.approx(2.0)     # (8-4)/2s
+    assert row["miss_rate"] == 0.0
+    assert row["byte_rate"] == pytest.approx(2e6)    # (6-2)MB/2s
+    # a fused-only node (no prefetch pool) still rows, pool cols dash
+    (row,) = fusion_rows({"n1": _fusion_snap(pool=False)}, None)
+    assert row["workers"] is None
+    # staged-only all-resident nodes / down nodes render no row
+    assert fusion_rows({"plain": _snap(), "down": None}, None) == []
+
+
+def test_fusion_panel_renders():
+    frame = render({"n1": _fusion_snap()})
+    assert "FUSION/PREFETCH" in frame and "FUSED/S" in frame
+    # the panel disappears on a staged-only engine
+    assert "FUSION/PREFETCH" not in render({"n1": _snap()})

@@ -128,7 +128,7 @@ def test_explain_plan_payload(db):
     assert p["blocks"] and isinstance(p["blocks"][0], str)
     assert set(e["tiers"]) == {"planner", "columnar", "compressed",
                                "device", "deviceMinEdges", "quantized",
-                               "vector"}
+                               "vector", "fused", "fusedMinRows"}
     assert e["tiers"]["vector"] == []  # no similar_to in this request
     assert e["tiers"]["planner"] in ("adaptive", "static")
     # per-stage tier decisions ride every explain payload

@@ -8,13 +8,18 @@ import pytest
 from dgraph_tpu.engine.db import GraphDB
 from dgraph_tpu.query.plan import Plan
 from dgraph_tpu.query.planner import (
-    REPLAN_BURST, STATIC_PRIORS, AdaptivePlanner, token_quantile,
+    EXPLORE_BURST, REPLAN_BURST, STATIC_PRIORS, AdaptivePlanner,
+    token_quantile,
 )
 from dgraph_tpu.utils import coststore, metrics
 
 
 class _StubDB:
     """The only engine surface the planner touches."""
+
+    # off by default so the ladder/margin/rival tests below exercise
+    # the DECISION model in isolation; the exploration tests flip it
+    planner_explore = False
 
     def device_dispatch_seconds(self) -> float:
         return 0.01  # 10 ms: a tunneled remote TPU
@@ -190,6 +195,58 @@ def test_drift_invalidates_sampled(pl):
     assert pl.stats()["reoptimized"] >= 1
     d2 = pl.choose(plan, "eq", "name", EST, IDX)
     assert d2.version >= 1
+
+
+# ------------------------------------------------------ exploration
+
+
+def test_exploration_never_fires_cold_cold(pl):
+    """With NO evidence at all the static ladder stays authoritative:
+    exploration needs a warm cell to compare against."""
+    pl.db.planner_explore = True
+    dec = pl.choose(_plan(0x5252), "eq", "name", EST, IDX)
+    assert dec.basis == "prior" and dec.tier == "compressed"
+    assert pl.stats()["explored"] == 0
+
+
+def test_exploration_probes_cold_tier_then_rejudges(pl):
+    """One warm tier + one cold tier within margin: the cold tier gets
+    ONE budgeted probe (basis 'explored'); its outcome lands the first
+    cost cell and the next choose re-judges on two-sided evidence."""
+    pl.db.planner_explore = True
+    plan = _plan(0x5151)
+    skel = f"{plan.skeleton_hash:016x}"
+    bucket = 64 .bit_length()
+    avail = ("columnar", "compressed")
+    _warm("eq", "compressed", skel, bucket, 8.0)
+    dec = pl.choose(plan, "eq", "name", EST, avail)
+    assert dec.basis == "explored" and dec.tier == "columnar"
+    assert pl.stats()["explored"] == 1
+    # the probe served: its stage span lands columnar's first cell,
+    # and record_outcome invalidates the explored decision outright
+    _warm("eq", "columnar", skel, bucket, 4.0)
+    pl.record_outcome(dec, 64)
+    d2 = pl.choose(plan, "eq", "name", EST, avail)
+    assert d2.basis == "observed" and d2.tier == "columnar"
+
+
+def test_exploration_budget_bounds_probes(pl):
+    """A probe that never lands evidence (the explored tier's spans go
+    unrecorded) retries only while the per-key token bucket has
+    budget, then the normal decision takes over."""
+    pl.db.planner_explore = True
+    plan = _plan(0x5353)
+    skel = f"{plan.skeleton_hash:016x}"
+    _warm("eq", "compressed", skel, 64 .bit_length(), 8.0)
+    bases = []
+    for _ in range(4):
+        dec = pl.choose(plan, "eq", "name", EST,
+                        ("columnar", "compressed"))
+        bases.append(dec.basis)
+        pl.record_outcome(dec, 64)
+    assert bases.count("explored") == EXPLORE_BURST
+    assert bases[-1] == "observed"
+    assert pl.stats()["explored"] == EXPLORE_BURST
 
 
 # --------------------------------------------- plan-level decisions

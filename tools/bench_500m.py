@@ -1,0 +1,358 @@
+"""BENCH_500M: the standing 500M-edge regime.
+
+Seeds a >= 500M-edge graph STRAIGHT into the cold store
+(storage/bulkseed — group-varint blobs, no per-edge apply), then
+serves it through engine/lazy_tablets under a tablet budget smaller
+than the working set, with the async prefetch pipeline
+(engine/prefetch) hiding blob decode behind query compute. Three arms
+answer the same sampled workload and must agree byte-for-byte:
+
+  fused    — whole-plan device executables (query/fusion.py)
+  staged   — the same engine, fused tier disabled
+  postings — a reopen with every tier pinned off: the exact oracle
+
+The report (BENCH_500M.json, committed at the repo root) carries:
+  * per-shape p50/p95 for fused and staged + the summary-mix speedup
+    (the PR gate: fused >= 1.5x staged on the mix aggregate);
+  * the decode-stall split: cold-pass wall time vs warm-pass wall
+    time over identical queries, plus prefetch hit/miss/bytes;
+  * the per-shape tier ladder re-judged at this scale: which cold
+    tier (compressed vs columnar vs postings) the adaptive planner
+    picked per stage, with its modeled costs (EXPLAIN tierDecisions).
+
+Topology (defaults): 64 groups x 8,126,464 edges = 520,093,696.
+Per group g (uids dense in [g*U+1, (g+1)*U], U = 262144):
+  score_g  : int    @index(int)   — U postings, 4096 distinct values
+  tier_g   : string @index(exact) — U postings, 4 labels
+  region_g : string @index(exact) — U postings, 8 labels
+  follow_g : [uid]                — 16384 srcs x 448 dsts
+
+Usage:
+  python -m tools.bench_500m --dir /tmp/bench500m --out BENCH_500M.json
+  python -m tools.bench_500m --groups 2 --uids 65536 ...   (mini run)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+U_DEFAULT = 262144
+GROUPS_DEFAULT = 64
+FOLLOW_SRCS = 16384
+FOLLOW_DEG = 448
+SCORE_DOMAIN = 4096
+TIERS = ["gold", "silver", "bronze", "iron"]
+REGIONS = [f"r{i}" for i in range(8)]
+
+
+def schema_text(groups: int) -> str:
+    lines = []
+    for g in range(groups):
+        lines.append(f"score_{g}: int @index(int) .")
+        lines.append(f"tier_{g}: string @index(exact) .")
+        lines.append(f"region_{g}: string @index(exact) .")
+        lines.append(f"follow_{g}: [uid] .")
+    return "\n".join(lines) + "\n"
+
+
+def group_edges(uids: int, follow_srcs: int, follow_deg: int) -> int:
+    return 3 * uids + follow_srcs * follow_deg
+
+
+def seed(store_dir: str, groups: int, uids: int,
+         follow_srcs: int, follow_deg: int, base_ts: int = 1,
+         log=print) -> dict:
+    """Synthesize + install the whole regime; returns seed stats."""
+    from dgraph_tpu.engine.lazy_tablets import TabletStore
+    from dgraph_tpu.storage import bulkseed
+
+    follow_srcs = min(follow_srcs, uids)
+    schema = schema_text(groups)
+    # raw TabletStore, NOT a GraphDB: an engine would re-save its own
+    # (zero) high-water ts over the seeded one at close
+    store = TabletStore(store_dir)
+    t0 = time.time()
+    total_bytes = 0
+    total_edges = 0
+    for g in range(groups):
+        rng = np.random.default_rng(1000 + g)
+        base = np.uint64(g) * np.uint64(uids)
+        u = base + np.arange(1, uids + 1, dtype=np.uint64)
+        scores = rng.integers(0, SCORE_DOMAIN, uids).astype(np.int64)
+        tcodes = rng.integers(0, len(TIERS), uids).astype(np.int64)
+        rcodes = rng.integers(0, len(REGIONS), uids).astype(np.int64)
+        srcs = u[:follow_srcs]
+        indptr = np.arange(follow_srcs + 1, dtype=np.int64) * follow_deg
+        # each row: sorted sample of in-group uids
+        dsts = (base + 1 +
+                rng.integers(0, uids, follow_srcs * follow_deg)
+                .astype(np.uint64))
+        dsts = dsts.reshape(follow_srcs, follow_deg)
+        dsts.sort(axis=1)
+        # group-varint rows must be strictly ascending: dedup by bump
+        dsts = (dsts + np.arange(follow_deg, dtype=np.uint64)
+                * np.uint64(uids))
+        blobs = [
+            (f"score_{g}", bulkseed.int_tablet_blob(
+                schema, u, scores, base_ts)),
+            (f"tier_{g}", bulkseed.str_tablet_blob(
+                schema, u, TIERS, tcodes, base_ts)),
+            (f"region_{g}", bulkseed.str_tablet_blob(
+                schema, u, REGIONS, rcodes, base_ts)),
+            (f"follow_{g}", bulkseed.uid_tablet_blob(
+                schema, srcs, indptr, dsts.reshape(-1), base_ts)),
+        ]
+        total_bytes += bulkseed.seed_store(store, schema, blobs,
+                                           max_ts=base_ts)
+        total_edges += group_edges(uids, follow_srcs, follow_deg)
+        if g % 8 == 7 or g == groups - 1:
+            log(f"  seeded group {g + 1}/{groups} "
+                f"({total_edges:,} edges, {total_bytes >> 20} MB, "
+                f"{time.time() - t0:.0f}s)")
+    store.compact()  # fold the WAL before the bench reopens
+    store.close()
+    return {"groups": groups, "uids_per_group": uids,
+            "edges": total_edges, "bytes": total_bytes,
+            "seed_seconds": round(time.time() - t0, 1)}
+
+
+# ---------------------------------------------------------------- workload
+
+def shapes(g: int) -> dict[str, str]:
+    """The summary mix, instantiated for group g. Every shape is an
+    order+page block the fused tier covers; filters span rank leaves
+    (int ineq/eq/between) and set leaves (string eq)."""
+    return {
+        "S1-desc-ge": (
+            f'{{ q(func: eq(tier_{g}, "gold"), orderdesc: score_{g},'
+            f' first: 10) @filter(ge(score_{g}, 2048)) {{ uid }} }}'),
+        "S2-asc-offset": (
+            f'{{ q(func: eq(tier_{g}, "silver"), orderasc: score_{g},'
+            f' first: 20, offset: 40)'
+            f' @filter(lt(score_{g}, 3000)) {{ uid }} }}'),
+        "S3-setleaf-and": (
+            f'{{ q(func: eq(tier_{g}, "silver"), orderdesc: score_{g},'
+            f' first: 10) @filter(eq(region_{g}, "r1")'
+            f' AND le(score_{g}, 3500)) {{ uid }} }}'),
+        "S4-plain-order": (
+            f'{{ q(func: eq(tier_{g}, "bronze"), orderasc: score_{g},'
+            f' first: 50) {{ uid }} }}'),
+        "S5-between-or": (
+            f'{{ q(func: eq(tier_{g}, "iron"), orderdesc: score_{g},'
+            f' first: 25) @filter(between(score_{g}, 256, 3840)'
+            f' OR eq(region_{g}, "r3")) {{ uid }} }}'),
+    }
+
+
+def _uids(db, q):
+    return [r["uid"] for r in db.query(q)["data"]["q"]]
+
+
+def _p(ts, q):
+    ts = sorted(ts)
+    return ts[min(len(ts) - 1, int(q * len(ts)))]
+
+
+def run_bench(store_dir: str, groups: int, uids: int, out_path: str,
+              tablet_budget: int, reps: int, sample_groups: int,
+              seed_stats: dict, log=print) -> dict:
+    from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu.utils import metrics
+
+    rng = np.random.default_rng(7)
+    gsel = sorted(rng.choice(groups, min(sample_groups, groups),
+                             replace=False).tolist())
+    report: dict = {"seed": seed_stats,
+                    "config": {"groups": groups,
+                               "uids_per_group": uids,
+                               "tablet_budget": tablet_budget,
+                               "sampled_groups": gsel, "reps": reps}}
+
+    db = GraphDB(store_dir=store_dir, tablet_budget=tablet_budget,
+                 prefetch_workers=2, planner="adaptive")
+    try:
+        # ---- cold pass: first touch of every sampled group decodes
+        # from the store; the prefetch pipeline overlaps what it can
+        before = metrics.counters_snapshot()
+        t_cold = time.time()
+        cold_answers = {}
+        for g in gsel:
+            for name, q in shapes(g).items():
+                cold_answers[(g, name)] = _uids(db, q)
+        cold_wall = time.time() - t_cold
+        # ---- warm pass: identical queries, everything resident
+        t_warm = time.time()
+        for g in gsel:
+            for q in shapes(g).values():
+                _uids(db, q)
+        warm_wall = time.time() - t_warm
+        delta = metrics.counters_delta(before)
+        pf = db.prefetcher.stats() if db.prefetcher else {}
+        report["decode_stall"] = {
+            "cold_wall_s": round(cold_wall, 3),
+            "warm_wall_s": round(warm_wall, 3),
+            "stall_fraction": round(
+                max(0.0, cold_wall - warm_wall) / cold_wall, 4)
+            if cold_wall else 0.0,
+            "prefetch": pf,
+            "tablet_store_loads": delta.get("tablet_store_loads", 0),
+            "tablet_store_evictions": delta.get(
+                "tablet_store_evictions", 0),
+        }
+        log(f"  cold pass {cold_wall:.1f}s, warm pass {warm_wall:.1f}s,"
+            f" prefetch {pf}")
+
+        # ---- timed arms on warm residency: fused vs staged
+        shape_names = list(shapes(0))
+        times = {"fused": {s: [] for s in shape_names},
+                 "staged": {s: [] for s in shape_names}}
+        answers = {"fused": {}, "staged": {}}
+        for arm in ("fused", "staged"):
+            db.prefer_fused = arm == "fused"
+            for g in gsel:
+                for name, q in shapes(g).items():
+                    _uids(db, q)  # arm-local warmup (compiles, memos)
+            for _ in range(reps):
+                for g in gsel:
+                    for name, q in shapes(g).items():
+                        t0 = time.perf_counter()
+                        got = _uids(db, q)
+                        times[arm][name].append(
+                            time.perf_counter() - t0)
+                        answers[arm][(g, name)] = got
+        db.prefer_fused = True
+
+        # ---- fused attribution + per-shape tier ladder at scale
+        tiers = {}
+        fused_tags = {}
+        for name, q in shapes(gsel[0]).items():
+            ex = db.query(q, explain="plan")["extensions"]["explain"]
+            fused_tags[name] = ex["blocks"][0].get("fusion")
+            tiers[name] = ex.get("tierDecisions", [])
+        report["tier_ladder"] = tiers
+        report["fused_attribution"] = fused_tags
+
+        per_shape = {}
+        mix_f = mix_s = 0.0
+        for name in shape_names:
+            f50 = _p(times["fused"][name], 0.5)
+            s50 = _p(times["staged"][name], 0.5)
+            mix_f += f50
+            mix_s += s50
+            per_shape[name] = {
+                "fused_p50_ms": round(f50 * 1e3, 3),
+                "fused_p95_ms": round(
+                    _p(times["fused"][name], 0.95) * 1e3, 3),
+                "staged_p50_ms": round(s50 * 1e3, 3),
+                "staged_p95_ms": round(
+                    _p(times["staged"][name], 0.95) * 1e3, 3),
+                "speedup_p50": round(s50 / f50, 3) if f50 else None,
+            }
+            log(f"  {name}: fused {f50 * 1e3:.1f}ms "
+                f"staged {s50 * 1e3:.1f}ms x{s50 / f50:.2f} "
+                f"[{fused_tags.get(name)}]")
+        report["shapes"] = per_shape
+        report["summary_mix_speedup"] = round(mix_s / mix_f, 3)
+        report["fused_dispatches"] = metrics.counters_snapshot().get(
+            "query_fused_dispatch_total", 0)
+
+        parity_fs = all(
+            answers["fused"][k] == answers["staged"][k]
+            for k in answers["fused"])
+        parity_cold = all(
+            cold_answers[k] == answers["fused"][k]
+            for k in answers["fused"])
+    finally:
+        db.close()
+
+    # ---- postings oracle: reopen with every tier pinned off
+    log("  oracle arm (all tiers off) ...")
+    oracle = GraphDB(store_dir=store_dir, tablet_budget=tablet_budget,
+                     prefer_device=False, prefer_columnar=False,
+                     prefer_compressed=False, prefer_fused=False)
+    try:
+        parity_oracle = True
+        for g in gsel:
+            for name, q in shapes(g).items():
+                if _uids(oracle, q) != answers["fused"][(g, name)]:
+                    parity_oracle = False
+                    log(f"  ORACLE DRIFT at group {g} shape {name}")
+    finally:
+        oracle.close()
+
+    report["parity"] = {"fused_vs_staged": parity_fs,
+                        "fused_vs_cold_pass": parity_cold,
+                        "fused_vs_postings_oracle": parity_oracle}
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default="/tmp/bench500m")
+    ap.add_argument("--out", default="BENCH_500M.json")
+    ap.add_argument("--groups", type=int, default=GROUPS_DEFAULT)
+    ap.add_argument("--uids", type=int, default=U_DEFAULT)
+    ap.add_argument("--follow-srcs", type=int, default=FOLLOW_SRCS)
+    ap.add_argument("--follow-deg", type=int, default=FOLLOW_DEG)
+    ap.add_argument("--tablet-budget", type=int, default=768 << 20)
+    ap.add_argument("--reps", type=int, default=7)
+    ap.add_argument("--sample-groups", type=int, default=12)
+    ap.add_argument("--reseed", action="store_true")
+    ap.add_argument("--min-speedup", type=float, default=1.5)
+    args = ap.parse_args(argv)
+
+    planned = args.groups * group_edges(
+        args.uids, min(args.follow_srcs, args.uids), args.follow_deg)
+    print(f"regime: {args.groups} groups x "
+          f"{group_edges(args.uids, min(args.follow_srcs, args.uids), args.follow_deg):,}"
+          f" = {planned:,} edges")
+
+    marker = os.path.join(args.dir, ".bench500m_seeded")
+    want = f"{args.groups}:{args.uids}:{args.follow_srcs}:{args.follow_deg}"
+    have = None
+    if os.path.exists(marker):
+        with open(marker) as f:
+            have = f.read().strip()
+    if args.reseed or have != want:
+        print("seeding cold store ...")
+        if os.path.isdir(args.dir):
+            import shutil
+            shutil.rmtree(args.dir)
+        stats = seed(args.dir, args.groups, args.uids,
+                     args.follow_srcs, args.follow_deg)
+        with open(marker, "w") as f:
+            f.write(want)
+        with open(marker + ".stats", "w") as f:
+            json.dump(stats, f)
+    else:
+        print("store already seeded (marker matches); reusing")
+        with open(marker + ".stats") as f:
+            stats = json.load(f)
+
+    print("benchmarking ...")
+    report = run_bench(args.dir, args.groups, args.uids, args.out,
+                       args.tablet_budget, args.reps,
+                       args.sample_groups, stats)
+    ok = (report["parity"]["fused_vs_staged"]
+          and report["parity"]["fused_vs_cold_pass"]
+          and report["parity"]["fused_vs_postings_oracle"]
+          and report["summary_mix_speedup"] >= args.min_speedup
+          and stats["edges"] >= min(planned, 500_000_000)
+          or args.groups < GROUPS_DEFAULT)  # mini runs: report only
+    print(f"edges={stats['edges']:,} "
+          f"mix speedup x{report['summary_mix_speedup']} "
+          f"parity={report['parity']} -> {'ok' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,16 @@ echo "== plan-cache smoke =="
 # (silent cache-key regressions surface as p99 cliffs, not failures)
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.plan_smoke
 
+echo "== fusion smoke =="
+# whole-plan fused tier: engages, byte-matches the staged chain,
+# stamps honest fallback attributions, zero-recompile on param replay
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.fusion_smoke
+
+echo "== cold-store smoke =="
+# miniature BENCH_500M: bulk-seeded store reopened under tablet-budget
+# pressure with async prefetch on; fused == staged == postings oracle
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.coldstore_smoke
+
 echo "== span overhead =="
 # per-span tracing cost vs the 5 µs budget (spans sit on executor hot
 # paths; tests/test_tracing.py enforces the same budget with CI slack)

@@ -1,0 +1,58 @@
+"""Cold-store smoke gate (tools/check.sh, ~30s): a miniature
+BENCH_500M — bulk-seed a multi-group store through storage/bulkseed,
+reopen it under a tablet budget smaller than the working set with the
+async prefetch pipeline on, and hold the three-arm parity bar
+(fused == staged == postings oracle) while decodes happen cold.
+
+Catches bulk-seed blob drift (a synthesized tablet restore_tablet
+decodes differently than a rolled-up one), prefetch handover bugs
+(stale/duplicate tablets served), and budget-eviction regressions —
+without paying the real 500M seed.
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+
+
+def main() -> int:
+    from tools import bench_500m
+
+    groups, uids = 2, 12288
+    d = tempfile.mkdtemp(prefix="coldstore_smoke_")
+    try:
+        stats = bench_500m.seed(d, groups, uids, follow_srcs=1024,
+                                follow_deg=16, log=lambda *_: None)
+        assert stats["edges"] == groups * bench_500m.group_edges(
+            uids, 1024, 16), stats
+        out = os.path.join(d, "report.json")
+        report = bench_500m.run_bench(
+            d, groups, uids, out, tablet_budget=2 << 20, reps=2,
+            sample_groups=groups, seed_stats=stats,
+            log=lambda *_: None)
+        par = report["parity"]
+        assert par["fused_vs_staged"], par
+        assert par["fused_vs_cold_pass"], par
+        assert par["fused_vs_postings_oracle"], par
+        ds = report["decode_stall"]
+        assert ds["tablet_store_loads"] > 0, \
+            f"budget never forced a cold load: {ds}"
+        pf = ds["prefetch"]
+        assert pf.get("scheduled", 0) > 0 and \
+            pf.get("hits", 0) + pf.get("waits", 0) > 0, \
+            f"prefetch pipeline never engaged: {pf}"
+        shapes = report["shapes"]
+        assert all(v["fused_p50_ms"] > 0 for v in shapes.values())
+        print(f"coldstore smoke: {stats['edges']:,} seeded edges, "
+              f"{groups} groups under {2}MB budget, "
+              f"{ds['tablet_store_loads']} cold loads, "
+              f"prefetch {pf.get('hits', 0)} hits — "
+              f"three-arm parity ok")
+        return 0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

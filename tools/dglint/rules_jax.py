@@ -196,6 +196,41 @@ def _validate_static_args(ctx: FileContext, call_or_dec: ast.Call,
 # one function body rebuilds the wrapper per call.
 _JIT_SEAM = "dgraph_tpu/query/plan.py"
 
+# the whole-plan fusion module builds ONE executable per static block
+# shape, and every one of them must be registered through jit_stage —
+# a stray jax.jit here silently forks the executable registry, so the
+# retrace-bound contract (tools/fusion_smoke.py, jit_stage_stats flat
+# on param-only replay) stops covering it
+_FUSION_SEAM = "dgraph_tpu/query/fusion.py"
+
+
+def _fusion_seam_violations(ctx: FileContext):
+    """Inside query/fusion.py, every `jax.jit` call must sit inside a
+    function whose NAME is handed to a `jit_stage(...)` call (the
+    build thunk the registry caches). Anything else mints executables
+    the plan cache can't see or bound."""
+    staged: set[str] = set()
+    for call in ctx.calls:
+        if call_name(call) == "jit_stage":
+            for a in list(call.args) + [k.value for k in call.keywords]:
+                if isinstance(a, ast.Name):
+                    staged.add(a.id)
+    spans = []
+    for fn in iter_funcdefs(ctx.tree):
+        if fn.name in staged:
+            spans.append((fn.lineno, fn.end_lineno or fn.lineno))
+    for call in ctx.calls:
+        if call_name(call) not in _JIT_NAMES:
+            continue
+        line = call.lineno
+        if not any(lo <= line <= hi for lo, hi in spans):
+            yield ctx.finding(
+                "DG02", call,
+                "jax.jit in the fusion module outside a jit_stage "
+                "build thunk — register the executable through "
+                f"jit_stage ({_JIT_SEAM}) so the retrace-bound "
+                "contract covers it")
+
 
 def _wrap_and_invoke(ctx: FileContext, fn: FuncDef):
     """`g = jax.jit(...)` then `g(...)` inside ONE function body: a
@@ -274,6 +309,10 @@ def check_recompile_hazard(ctx: FileContext):
                     "DG02", call,
                     "jax.jit called inside a loop — hoist and cache "
                     "the wrapper, or each iteration recompiles")
+    # the fusion module: every jax.jit must route through a jit_stage
+    # build thunk (see _fusion_seam_violations)
+    if ctx.rel.replace("\\", "/").endswith(_FUSION_SEAM):
+        yield from _fusion_seam_violations(ctx)
     # wrap-and-invoke inside one function body (the plan-cache seam
     # rule): dedupe across nested defs — ast.walk sees a nested def's
     # body from the enclosing def too. The seam module itself is the

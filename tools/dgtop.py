@@ -314,6 +314,49 @@ def planner_rows(snaps: dict[str, dict],
     return rows
 
 
+def fusion_rows(snaps: dict[str, dict],
+                prev: Optional[dict[str, dict]] = None) -> list[dict]:
+    """The FUSION/PREFETCH panel's rows: per-node whole-plan fused
+    dispatch rate (counter delta), and the async cold-store prefetch
+    pipeline from /debug/stats `prefetch` — worker/in-flight
+    occupancy plus hit/miss/byte rates. Pure — tests drive it with
+    canned payloads. Nodes with no fused dispatches and no prefetch
+    pool produce no row (the panel disappears on a staged-only,
+    all-resident engine)."""
+    rows = []
+    for node in sorted(snaps):
+        snap = snaps[node]
+        if snap is None:
+            continue
+        counters = snap["stats"].get("counters", {})
+        pf = snap["stats"].get("prefetch")
+        p = (prev or {}).get(node)
+        dt = None
+        if p is not None:
+            dt = max(1e-6, snap["t"] - p["t"])
+
+        def rate(name: str) -> float:
+            cur = counters.get(name, 0.0)
+            if dt is None:
+                return float(cur)
+            return (cur - p["stats"].get("counters", {})
+                    .get(name, 0.0)) / dt
+
+        fused = rate("query_fused_dispatch_total")
+        if not fused and pf is None:
+            continue
+        rows.append({
+            "node": node,
+            "fused_rate": fused,
+            "workers": pf.get("workers") if pf else None,
+            "inflight": pf.get("inflight") if pf else None,
+            "hit_rate": rate("prefetch_hits_total"),
+            "miss_rate": rate("prefetch_misses_total"),
+            "byte_rate": rate("prefetch_bytes_total"),
+        })
+    return rows
+
+
 def serving_rows(snaps: dict[str, dict],
                  prev: Optional[dict[str, dict]] = None
                  ) -> tuple[list[dict], list[dict]]:
@@ -532,6 +575,19 @@ def render(snaps: dict[str, dict],
                 f"{r['node']:<28} {r['decisions']:>8} {mix:<34.34} "
                 f"{r['reopt_rate']:>8.2f} "
                 f"{100 * r['viol_rate']:>6.2f} {r['suppressed']:>6}")
+    frows = fusion_rows(snaps, prev)
+    if frows:
+        lines.append("")
+        lines.append(f"{'FUSION/PREFETCH':<28} {'FUSED/S':>8} "
+                     f"{'WORKERS':>8} {'INFLT':>6} {'HIT/S':>7} "
+                     f"{'MISS/S':>7} {'MB/S':>7}")
+        for r in frows:
+            lines.append(
+                f"{r['node']:<28} {r['fused_rate']:>8.1f} "
+                f"{_fmt(r['workers'], nd=0):>8} "
+                f"{_fmt(r['inflight'], nd=0):>6} "
+                f"{r['hit_rate']:>7.1f} {r['miss_rate']:>7.1f} "
+                f"{r['byte_rate'] / 1e6:>7.2f}")
     srv, tens = serving_rows(snaps, prev)
     if srv:
         lines.append("")

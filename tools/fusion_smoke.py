@@ -1,0 +1,90 @@
+"""Whole-plan fusion smoke gate (tools/check.sh): the fused tier must
+engage, agree with the staged chain byte-for-byte, stamp honest
+attributions, and stay retrace-bound on parameter-only replays.
+
+Catches the three ways the fusion seam rots silently: an eligibility
+guard that quietly widens (wrong fused answers), a guard that quietly
+narrows (everything falls back — the tier becomes dead code while
+tests still pass on staged answers), and a static-arg leak that mints
+a fresh executable per literal (compile-per-query p99 cliff).
+"""
+
+import random
+import sys
+
+
+def main() -> int:
+    from dgraph_tpu.engine.db import GraphDB
+    from dgraph_tpu.query.plan import jit_stage_stats
+    from dgraph_tpu.utils import metrics
+
+    db = GraphDB(device_min_edges=8, fused_min_rows=8)
+    db.alter(schema_text="""
+        score: int @index(int) .
+        tier: string @index(exact) .
+        name: string @index(exact) .
+    """)
+    rng = random.Random(7)
+    quads = []
+    for i in range(1, 1201):
+        if i % 11:
+            quads.append(f'<0x{i:x}> <score> "{rng.randint(0, 299)}" .')
+        quads.append(f'<0x{i:x}> <tier> "{"hot" if i % 3 else "cold"}" .')
+        quads.append(f'<0x{i:x}> <name> "n{i % 5}" .')
+    db.mutate(set_nquads="\n".join(quads))
+    db.rollup_all()
+
+    shape = ('{ q(func: eq(tier, "%s"), orderdesc: score, first: %d,'
+             ' offset: %d) @filter(ge(score, %d) AND eq(name, "%s"))'
+             ' { uid } }')
+
+    def run(q, fused):
+        db.prefer_fused = fused
+        try:
+            return [r["uid"] for r in db.query(q)["data"]["q"]]
+        finally:
+            db.prefer_fused = True
+
+    def tag(q):
+        ex = db.query(q, explain="plan")
+        return ex["extensions"]["explain"]["blocks"][0].get("fusion")
+
+    # 1. engagement + byte parity, counter moves
+    before = metrics.counters_snapshot()
+    cases = [("hot", 10, 0, 50, "n1"), ("cold", 7, 3, 0, "n2"),
+             ("hot", 25, 12, 120, "n4")]
+    for c in cases:
+        q = shape % c
+        fused, staged = run(q, True), run(q, False)
+        assert fused == staged, f"fused/staged drift on {c}: " \
+            f"{fused[:5]}... vs {staged[:5]}..."
+        assert tag(q) == "fused", f"tier did not engage on {c}: {tag(q)}"
+    delta = metrics.counters_delta(before)
+    assert delta.get("query_fused_dispatch_total", 0) >= len(cases), \
+        f"fused dispatch counter stuck: {delta}"
+
+    # 2. honest fallback attribution on an ineligible shape
+    cur = ('{ q(func: eq(tier, "hot"), orderdesc: score, first: 5,'
+           ' after: 0x10) { uid } }')
+    t = tag(cur)
+    assert t is not None and t.startswith("staged:"), \
+        f"ineligible shape must stamp staged:<reason>, got {t!r}"
+    assert run(cur, True) == run(cur, False)
+
+    # 3. retrace bound: parameter-only replay mints zero executables
+    db.query(shape % cases[0])
+    db.query(shape % cases[1])
+    execs = jit_stage_stats()["executables"]
+    for c in [("cold", 9, 1, 77, "n0"), ("hot", 3, 0, 299, "n3")]:
+        q = shape % c
+        assert run(q, True) == run(q, False)
+    assert jit_stage_stats()["executables"] == execs, \
+        "parameter-only replay recompiled the fused executable"
+
+    print("fusion smoke: parity x%d, fallback attribution, "
+          "zero-recompile replay — ok" % len(cases))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
