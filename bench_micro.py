@@ -640,6 +640,83 @@ def netfault_overhead_bench(runs: int = 5,
     return rec
 
 
+def racecheck_overhead_bench(runs: int = 5,
+                             accesses_per_op: int = 32,
+                             budget_frac: float = None) -> dict:
+    """`--racecheck-overhead`: cost of the ARMED attribute-access race
+    witness (utils/racecheck) on the query hot path, against the < 5%
+    acceptance budget the marked tier-1 concurrency suites run under.
+
+    Decomposed like the stats/netfault gates (an A/B at this effect
+    size cannot resolve through scheduler noise): (1) the per-sampled-
+    access cost — armed minus unarmed tight loop over a registered
+    probe class, best-of-N; (2) a conservative nominal sampled-access
+    count per served operation — a MicroBatcher leader touches a few
+    dozen witnessed attributes per query_json, rounded UP to
+    `accesses_per_op` and max'd with the REAL sample count an armed
+    batcher pass records; (3) the per-query time of the golden summary
+    mix (the fastest ops served, so the fraction is an upper bound).
+    Budget override: DGRAPH_TPU_RACECHECK_BUDGET."""
+    from dgraph_tpu.engine.batcher import MicroBatcher
+    from dgraph_tpu.utils import racecheck
+
+    if budget_frac is None:
+        budget_frac = float(os.environ.get(
+            "DGRAPH_TPU_RACECHECK_BUDGET", "0.05"))
+
+    class _Probe:
+        def __init__(self):
+            self.x = 0
+
+    def spin(p, n):
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            p.x = p.x + 1  # one witnessed read + one witnessed write
+        return (time.perf_counter_ns() - t0) / n / 1e3
+
+    # (1) per-sampled-access delta: unarmed baseline vs armed probe
+    n_syn = 50_000
+    base_us = min(spin(_Probe(), n_syn) for _ in range(runs))
+    racecheck.register(_Probe)
+    racecheck.enable()
+    try:
+        armed_us = min(spin(_Probe(), n_syn) for _ in range(runs))
+    finally:
+        racecheck.disable()
+    per_access_us = max(0.0, (armed_us - base_us) / 2)
+
+    # (3) per-query time, unarmed (shared golden-mix definition)
+    db, queries = _summary_mix()
+    for _ in range(2):
+        _mix_pass_us(db, queries)  # warm plans and caches
+    pass_us = min(_mix_pass_us(db, queries) for _ in range(runs))
+    per_query_us = pass_us / max(1, len(queries))
+
+    # (2) real sampled-access volume of an armed batcher pass
+    racecheck.enable()
+    try:
+        batcher = MicroBatcher(db, window_us=0)
+        for q in queries:
+            batcher.query_json(q)
+        measured = racecheck.stats()["samples"] / max(1, len(queries))
+    finally:
+        racecheck.disable()
+    per_op = max(accesses_per_op, int(measured) + 1)
+
+    frac = per_op * per_access_us / per_query_us
+    rec = {"metric": "racecheck_overhead",
+           "queries": len(queries),
+           "per_access_us": round(per_access_us, 5),
+           "accesses_per_op": per_op,
+           "measured_samples_per_op": round(measured, 2),
+           "per_query_us": round(per_query_us, 2),
+           "overhead_frac": round(frac, 6),
+           "budget_frac": budget_frac,
+           "within_budget": frac < budget_frac}
+    print(json.dumps(rec))
+    return rec
+
+
 def main():
     from dgraph_tpu.utils.backend import force_cpu_backend, probe_backend
 
@@ -664,6 +741,10 @@ def main():
         return
     if "--netfault-overhead" in sys.argv:
         if not netfault_overhead_bench()["within_budget"]:
+            sys.exit(1)
+        return
+    if "--racecheck-overhead" in sys.argv:
+        if not racecheck_overhead_bench()["within_budget"]:
             sys.exit(1)
         return
     if "--setops-compressed" in sys.argv:

@@ -385,24 +385,27 @@ class ClusterClient:
 
         with self._lock:
             now = time.monotonic()
-            healthy = [n for n in sorted(self.addrs)
+            # snapshot the address map: attempt() runs on hedge
+            # threads while add_node/remove_node may mutate it
+            addrs = dict(self.addrs)
+            healthy = [n for n in sorted(addrs)
                        if self._down.get(n, 0) <= now]
-            pool = healthy or sorted(self.addrs)
+            pool = healthy or sorted(addrs)
             first = self._preferred if self._preferred in pool \
                 else pool[0]
-        others = [n for n in sorted(self.addrs) if n != first]
-        others = sorted(others,
-                        key=lambda n: self._down.get(n, 0) > now)
+            down = dict(self._down)
+        others = [n for n in sorted(addrs) if n != first]
+        others = sorted(others, key=lambda n: down.get(n, 0) > now)
         results: queue.Queue = queue.Queue()
 
         def attempt(node):
             if netfault.armed() and netfault.act(
-                    self.addrs[node], can_dup=False) == netfault.DROP:
+                    addrs[node], can_dup=False) == netfault.DROP:
                 results.put(None)
                 return
             try:
                 sock = socket.create_connection(
-                    self.addrs[node], timeout=min(2.0, budget))
+                    addrs[node], timeout=min(2.0, budget))
                 sock.settimeout(budget)
                 try:
                     wire.write_frame(sock, wire.dumps(req))

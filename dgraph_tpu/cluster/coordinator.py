@@ -85,7 +85,8 @@ class Coordinator:
             return self._alloc_ts()
 
     def max_assigned(self) -> int:
-        return self._ts
+        with self._lock:
+            return self._ts
 
     def observe_ts(self, ts: int):
         """Advance the local high-water mark past a ts somebody else

@@ -66,3 +66,18 @@ class WriteFenced(RuntimeError):
             + (f" (replication phase {phase!r})" if phase else "")
             + ": client writes are refused; "
             "direct writes at the active primary")
+
+
+# Typed-wire-error registry (dglint DG14): every typed error this
+# module defines MUST have a wire serialization arm in
+# cluster/service.py _client_loop (an `except Cls` producing the
+# listed response key) AND a client re-raise in cluster/client.py
+# ClusterClient._unwrap (a `resp.get(key)` branch raising Cls) — a
+# typed error missing either half silently degrades to a bare
+# RuntimeError 500 at the far edge, which is exactly the
+# read-parity/retry-contract bug the types exist to prevent.
+WIRE_ERRORS = (
+    ("TabletMisrouted", "misrouted"),
+    ("StaleRead", "stale"),
+    ("WriteFenced", "fenced"),
+)

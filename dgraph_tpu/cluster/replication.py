@@ -31,6 +31,7 @@ wholesale across the cluster boundary.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional
 
@@ -63,6 +64,10 @@ class ReplicationDriver:
         # behind the primary head, "applied_ts": standby watermark,
         # "src_head": primary cdc head offset, "caught_up_at":
         # monotonic instant lag last hit 0 (None = never)}
+        # guards progress/_primary_ok/_promoting: run() mutates them
+        # on the driver thread while lag_payload()/promote() read and
+        # flip them from ZeroServer request handlers
+        self._lock = threading.Lock()
         self.progress: dict[str, dict] = {}
         self._primary_ok = False
         self._promoting = False
@@ -105,7 +110,9 @@ class ReplicationDriver:
     def run(self) -> None:
         """The standby loop: tick until promoted or shut down."""
         while not self.zero._stop.wait(self.tick_s):
-            if not self.zero.is_leader() or self._promoting:
+            with self._lock:
+                promoting = self._promoting
+            if not self.zero.is_leader() or promoting:
                 continue
             try:
                 if self.tick() == "promoted":
@@ -124,11 +131,13 @@ class ReplicationDriver:
         try:
             got = pz.request({"op": "cluster_state"})
             if not got.get("ok"):
-                self._primary_ok = False
+                with self._lock:
+                    self._primary_ok = False
                 return phase
             cstate = got["result"]
             st = pz.request({"op": "status"})
-            self._primary_ok = True
+            with self._lock:
+                self._primary_ok = True
             if st.get("ok"):
                 # keep the standby's ts/uid leases at or past the
                 # primary's: post-promotion timestamps must never
@@ -147,8 +156,11 @@ class ReplicationDriver:
             # replicating them would need per-shard tails — out of
             # scope, surfaced rather than silently skipped
             for pred in cstate.get("splits", {}):
-                self.progress.setdefault(pred, {})["unsupported"] = \
-                    "split predicate (replicate before splitting)"
+                with self._lock:
+                    self.progress.setdefault(pred, {})[
+                        "unsupported"] = ("split predicate "
+                                          "(replicate before "
+                                          "splitting)")
         finally:
             pz.close()
         return self.phase()
@@ -240,10 +252,11 @@ class ReplicationDriver:
         caught up (or `rounds` batches). Returns the remaining lag in
         change-log entries; records per-pred progress."""
         from dgraph_tpu.cdc.changelog import offset_for_ts
-        prog = self.progress.setdefault(
-            pred, {"lag": None, "applied_ts": 0, "src_head": 0,
-                   "caught_up_at": None, "commits_applied": 0})
-        prog.pop("unsupported", None)
+        with self._lock:
+            prog = self.progress.setdefault(
+                pred, {"lag": None, "applied_ts": 0, "src_head": 0,
+                       "caught_up_at": None, "commits_applied": 0})
+            prog.pop("unsupported", None)
         for _ in range(rounds):
             if self.zero._stop.is_set():
                 return prog["lag"] or 0
@@ -292,7 +305,10 @@ class ReplicationDriver:
         runbook's RPO estimate reads)."""
         now = time.monotonic()
         preds = {}
-        for pred, prog in sorted(self.progress.items()):
+        with self._lock:
+            snapshot = sorted(self.progress.items())
+            primary_ok = self._primary_ok
+        for pred, prog in snapshot:
             if "unsupported" in prog:
                 preds[pred] = {"unsupported": prog["unsupported"]}
                 continue
@@ -303,7 +319,7 @@ class ReplicationDriver:
                 "lag_s": round(now - at, 3) if at is not None
                 else None}
         return {"phase": self.phase(),
-                "primary_reachable": self._primary_ok,
+                "primary_reachable": primary_ok,
                 "preds": preds}
 
     def promote(self, force: bool = False) -> dict:
@@ -316,9 +332,10 @@ class ReplicationDriver:
         writable wall time. With the primary unreachable, `force`
         promotes on the standby's last applied state — RPO is then the
         unreplicated tail, surfaced as rpo_clean=False."""
-        if self._promoting:
-            raise PromoteError("promotion already in progress")
-        self._promoting = True
+        with self._lock:
+            if self._promoting:
+                raise PromoteError("promotion already in progress")
+            self._promoting = True
         t0 = time.monotonic()
         try:
             clean = True
@@ -361,16 +378,18 @@ class ReplicationDriver:
                 out["rpo_note"] = ("primary unreachable: commits past "
                                    "each predicate's applied_ts are "
                                    "lost")
-                out["preds"] = {
-                    p: {"applied_ts": prog.get("applied_ts", 0),
-                        "last_known_lag": prog.get("lag")}
-                    for p, prog in sorted(self.progress.items())}
+                with self._lock:
+                    out["preds"] = {
+                        p: {"applied_ts": prog.get("applied_ts", 0),
+                            "last_known_lag": prog.get("lag")}
+                        for p, prog in sorted(self.progress.items())}
             metrics.observe("dgraph_repl_promote_rto_ms", rto_ms)
             log.info("standby_promoted", clean=clean,
                      drained=drained, rto_ms=rto_ms)
             return out
         finally:
-            self._promoting = False
+            with self._lock:
+                self._promoting = False
 
     def _drain(self, cstate: dict) -> tuple[int, dict]:
         """Drain every predicate to the fenced primary's cdc head.
@@ -389,7 +408,9 @@ class ReplicationDriver:
             dst_cl = self.zero._group_client(dst_gid)
             if src_cl is None or dst_cl is None:
                 raise PromoteError(f"groups unreachable for {pred!r}")
-            c0 = self.progress.get(pred, {}).get("commits_applied", 0)
+            with self._lock:
+                c0 = self.progress.get(pred, {}) \
+                    .get("commits_applied", 0)
             try:
                 # the barrier read: after the fence, move_status's
                 # write-lock acquisition proves every in-flight commit
@@ -414,8 +435,9 @@ class ReplicationDriver:
                             f"drain of {pred!r} did not converge "
                             f"within {self.drain_timeout_s}s "
                             f"(covered {covered} < head {head})")
-                drained += self.progress.get(pred, {}) \
-                    .get("commits_applied", 0) - c0
+                with self._lock:
+                    drained += self.progress.get(pred, {}) \
+                        .get("commits_applied", 0) - c0
             finally:
                 src_cl.close()
                 dst_cl.close()

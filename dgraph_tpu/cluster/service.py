@@ -449,9 +449,10 @@ class RaftServer:
             if not result:
                 return {"ok": False,
                         "error": "conf change not committed"}
-            return {"ok": True, "result": {
-                "members": {str(k): list(v)
-                            for k, v in self.members.items()}}}
+            with self.lock:
+                members = {str(k): list(v)
+                           for k, v in self.members.items()}
+            return {"ok": True, "result": {"members": members}}
         return None
 
     def _send_all(self, msgs: list):
@@ -657,6 +658,12 @@ class AlphaServer(RaftServer):
     mid-write the leader rebuilds its engine from the committed event
     stream so it never serves un-replicated state.
     """
+    # dglint: guarded-by=db:atomic (the binding is REBOUND only by
+    # the raft-apply path — sm_restore/_rebuild_from_events, under
+    # RaftServer.lock — and the swap of the reference itself is
+    # GIL-atomic; readers grab the binding once and tolerate serving
+    # from either the pre- or post-restore engine, the same contract
+    # a snapshot install gives the reference's workers)
 
     def __init__(self, node_id: int, raft_peers, client_addr,
                  storage=None, db_kw: Optional[dict] = None,
@@ -771,7 +778,10 @@ class AlphaServer(RaftServer):
         self._move_staging: dict[str, dict] = {}
         # last touches count reported to zero per tablet (the heat
         # report ships DELTAS); baseline-initialized on first sight so
-        # a fresh leader's lifetime counter doesn't land as one spike
+        # a fresh leader's lifetime counter doesn't land as one spike.
+        # dglint: guarded-by=_heat_sent:single-thread (only touched by
+        # the one _report_sizes_loop daemon; the boot paths that could
+        # each spawn it are mutually exclusive)
         self._heat_sent: dict[str, int] = {}
         # multi-group mode: a Zero quorum owns the tablet map and the
         # uid space; this alpha claims tablets, checks ownership before
@@ -1166,9 +1176,12 @@ class AlphaServer(RaftServer):
             if upto_ts is None and evict_older_s is not None \
                     and ages[st] <= evict_older_s:
                 continue  # young and nobody is waiting: no zero RPC
-            if upto_ts is not None \
-                    and self._xstatus_clean.get(st, 0) >= upto_ts:
-                continue  # verified undecided for this snapshot already
+            if upto_ts is not None:
+                with self.lock:
+                    clean = self._xstatus_clean.get(st, 0)
+                if clean >= upto_ts:
+                    continue  # verified undecided for this snapshot
+
             try:
                 got = self.zero.request({"op": "txn_status",
                                          "args": (st,)})
@@ -1178,8 +1191,10 @@ class AlphaServer(RaftServer):
                 status = got["result"]
                 if not status["decided"]:
                     if upto_ts is not None:
-                        self._xstatus_clean[st] = max(
-                            self._xstatus_clean.get(st, 0), upto_ts)
+                        with self.lock:
+                            self._xstatus_clean[st] = max(
+                                self._xstatus_clean.get(st, 0),
+                                upto_ts)
                     if evict_older_s is None or \
                             ages[st] <= evict_older_s:
                         continue
@@ -1697,12 +1712,13 @@ class AlphaServer(RaftServer):
         moved a tablet out (moved_out empty); a malformed query falls
         through to the engine's own parser error.
 
-        Known limitation: predicates reached only via expand() never
-        appear in the query text or in query_predicates, so a
-        stale-routed expand can under-report a moved predicate's
-        edges for the one in-flight query racing the cutover (the
-        router's next map fetch routes correctly). Closing that would
-        need an executor-level ownership hook at expansion time."""
+        Predicates reached only via expand() never appear in the
+        query text or in query_predicates, so this screen cannot see
+        them; that half of the window is closed by the executor-level
+        ownership hook at expansion time
+        (query/executor.py Executor._expand_ownership_guard), which
+        raises the same typed TabletMisrouted when expand()
+        materializes a moved or split predicate."""
         if self.zero is None or (not self.db.moved_out
                                  and not self.db.split_partial):
             return
@@ -1971,7 +1987,9 @@ class AlphaServer(RaftServer):
                         ("xstage", txn.start_ts, list(txn.staged),
                          schemas,
                          sorted(int(k) for k in txn.conflict_keys)))
-                    self._xstage_touched[txn.start_ts] = time.monotonic()
+                    with self.lock:
+                        self._xstage_touched[txn.start_ts] = \
+                            time.monotonic()
             return {"ok": True, "result": {
                 "extensions": {"txn": {"start_ts": start_ts,
                                        "commit_ts": commit_ts}}}}
@@ -2055,7 +2073,8 @@ class AlphaServer(RaftServer):
             self._replicate_record(
                 ("xstage", start_ts, staged, schemas,
                  sorted(int(k) for k in keys)))
-            self._xstage_touched[start_ts] = time.monotonic()
+            with self.lock:
+                self._xstage_touched[start_ts] = time.monotonic()
             # stale stages (coordinator died) reconcile via zero's
             # decision registry on the same TTL as idle txns
             self._reconcile_pending(evict_older_s=300.0)
@@ -2672,8 +2691,9 @@ class ZeroServer(RaftServer):
             finally:
                 src_cl.close()
         self.propose_and_wait(("tablet_move_abort", (pred, mv["dst"])))
-        self._move_attempts.pop(pred, None)
-        self._move_progress.pop(pred, None)
+        with self.lock:
+            self._move_attempts.pop(pred, None)
+            self._move_progress.pop(pred, None)
         metrics.inc_counter("dgraph_tablet_moves_total",
                             labels={"phase": "aborted"})
 
@@ -2718,9 +2738,11 @@ class ZeroServer(RaftServer):
         if src is None or src == dst:
             self._abort_move(pred, mv)
             return
-        prog = self._move_progress.setdefault(
-            pred, {"bytes": 0, "lag": None, "started": time.monotonic(),
-                   "fence_started": None, "fence_ms": None})
+        with self.lock:
+            prog = self._move_progress.setdefault(
+                pred, {"bytes": 0, "lag": None,
+                       "started": time.monotonic(),
+                       "fence_started": None, "fence_ms": None})
         if mv["phase"] in ("start", "snapshotting"):
             # ("start" = a legacy pre-phase-machine ledger entry:
             # drive it through the streaming path too)
@@ -2970,8 +2992,9 @@ class ZeroServer(RaftServer):
             finally:
                 src_cl.close()
         self.propose_and_wait(("move_finish", (pred,)))
-        self._move_attempts.pop(pred, None)
-        done = self._move_progress.pop(pred, None)
+        with self.lock:
+            self._move_attempts.pop(pred, None)
+            done = self._move_progress.pop(pred, None)
         if done is not None:
             metrics.observe(
                 "dgraph_move_duration_ms",
@@ -3167,8 +3190,10 @@ class ZeroServer(RaftServer):
             out["heat"] = dict(self.state.heat)
             out["tablets_map"] = dict(self.state.tablets)
             role = self.node.role
+            prog_snap = {p: dict(m)
+                         for p, m in self._move_progress.items()}
         for pred, mv in moves.items():
-            prog = self._move_progress.get(pred) or {}
+            prog = prog_snap.get(pred) or {}
             mv["bytes"] = prog.get("bytes", 0)
             mv["lag"] = prog.get("lag")
             mv["fence_ms"] = prog.get("fence_ms")

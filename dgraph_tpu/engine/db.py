@@ -120,6 +120,12 @@ class Latency:
 
 
 class GraphDB:
+    # dglint: guarded-by=*:external (the engine data plane carries no
+    # internal locks by design: mutations run on the single raft-apply
+    # thread or under AlphaServer._write_lock, queries under the
+    # server's rw read lock — the synchronization contract lives in
+    # cluster/service.py; utils/racecheck.py witnesses violations of
+    # it at runtime)
     def __init__(self, wal_path: str | None = None,
                  prefer_device: bool = True,
                  device_min_edges: int = 1024,

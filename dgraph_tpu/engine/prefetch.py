@@ -83,8 +83,9 @@ class PrefetchPool:
         Bounded by max_inflight; excess predicates simply load
         synchronously later (no queue growth under fan-out). Returns
         the number newly scheduled."""
-        if self._closed:
-            return 0
+        with self._lock:
+            if self._closed:
+                return 0
         tablets = db.tablets
         stored = getattr(tablets, "stored", None)
         if not stored:
@@ -121,7 +122,8 @@ class PrefetchPool:
         if fut is None:
             return None
         if not fut.done():
-            self.waits += 1
+            with self._lock:
+                self.waits += 1
         try:
             tab, nbytes = fut.result()
         except Exception:
@@ -130,8 +132,9 @@ class PrefetchPool:
             return None
         if saved_ts is not None and tab.base_ts != saved_ts:
             return None  # blob re-saved after scheduling: stale decode
-        self.hits += 1
-        self.bytes += nbytes
+        with self._lock:
+            self.hits += 1
+            self.bytes += nbytes
         inc_counter("prefetch_hits_total")
         inc_counter("prefetch_bytes_total", nbytes)
         return tab
@@ -139,20 +142,21 @@ class PrefetchPool:
     def miss(self) -> None:
         """A synchronous store load happened with no prefetched result
         (TabletMap.get calls this when the pool is attached)."""
-        self.misses += 1
+        with self._lock:
+            self.misses += 1
         inc_counter("prefetch_misses_total")
 
     def stats(self) -> dict:
         with self._lock:
-            depth = len(self._inflight)
-        return {"workers": self._pool._max_workers,
-                "inflight": depth, "scheduled": self.scheduled,
-                "hits": self.hits, "misses": self.misses,
-                "waits": self.waits, "bytes": self.bytes}
+            return {"workers": self._pool._max_workers,
+                    "inflight": len(self._inflight),
+                    "scheduled": self.scheduled,
+                    "hits": self.hits, "misses": self.misses,
+                    "waits": self.waits, "bytes": self.bytes}
 
     def close(self) -> None:
-        self._closed = True
         with self._lock:
+            self._closed = True
             self._inflight.clear()
             set_gauge("prefetch_queue_depth", 0)
         self._pool.shutdown(wait=False, cancel_futures=True)

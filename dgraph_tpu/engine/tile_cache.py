@@ -173,9 +173,12 @@ class DeviceCacheLRU:
         self._set_gauges()
 
     def _set_gauges(self):
-        set_gauge("device_cache_bytes", self.bytes)
-        set_gauge("device_cache_tiles", len(self._entries))
-        set_gauge("host_tile_bytes", self.host_bytes)
+        with self._lock:
+            dev, tiles, host = (self.bytes, len(self._entries),
+                                self.host_bytes)
+        set_gauge("device_cache_bytes", dev)
+        set_gauge("device_cache_tiles", tiles)
+        set_gauge("host_tile_bytes", host)
 
     def stats(self) -> dict:
         with self._lock:

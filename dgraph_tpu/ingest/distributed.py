@@ -233,9 +233,10 @@ class IngestDriver:
             v = int(m.group(1), 0)
             if v > hi:
                 hi = v
-        if hi > self._bumped:
-            self._coord.bump_uids(hi)
-            self._bumped = hi
+        with self._lock:
+            if hi > self._bumped:
+                self._coord.bump_uids(hi)
+                self._bumped = hi
         out: dict[str, int] = {}
         cache = self._xid_cache
         for m in _BLANK_RE.finditer(text):
@@ -258,7 +259,8 @@ class IngestDriver:
             t = threading.Thread(target=self._conn_loop, args=(conn,),
                                  daemon=True)
             t.start()
-            self._threads.append(t)
+            with self._lock:
+                self._threads.append(t)
 
     def _conn_loop(self, conn: socket.socket):
         cid = id(conn)
@@ -336,8 +338,8 @@ class IngestDriver:
                 self.stats["shuffled_bytes"] += int(
                     st.get("shuffled_bytes", 0))
                 hi = int(st.get("max_uid", 0))
-            if hi > self._bumped:
-                with self._lock:
+            with self._lock:
+                if hi > self._bumped:
                     self._coord.bump_uids(hi)
                     self._bumped = max(self._bumped, hi)
             metrics.inc_counter("dgraph_ingest_mapped_total",
@@ -439,12 +441,14 @@ class IngestDriver:
             t = threading.Thread(target=run_reducer, args=(addr, g),
                                  daemon=True)
             t.start()
-            self._threads.append(t)
+            with self._lock:
+                self._threads.append(t)
         for _ in range(self.workers):
             t = threading.Thread(target=run_worker, args=(addr,),
                                  daemon=True)
             t.start()
-            self._threads.append(t)
+            with self._lock:
+                self._threads.append(t)
 
     # --------------------------------------------------------------- run
 
@@ -457,10 +461,12 @@ class IngestDriver:
             self._spawn_procs()
         accept = threading.Thread(target=self._serve, daemon=True)
         accept.start()
-        self._threads.append(accept)
+        with self._lock:
+            self._threads.append(accept)
         producer = threading.Thread(target=self._producer, daemon=True)
         producer.start()
-        self._threads.append(producer)
+        with self._lock:
+            self._threads.append(producer)
         if self.in_process:
             self._spawn_threads()
         try:
@@ -517,7 +523,10 @@ class IngestDriver:
             time.sleep(0.02)
         sizes: dict[str, int] = {}
         home: dict[str, int] = {}
-        for g, ss in sorted(self._spill_sizes.items()):
+        with self._lock:
+            spill_sizes = {g: dict(ss)
+                           for g, ss in self._spill_sizes.items()}
+        for g, ss in sorted(spill_sizes.items()):
             for p, b in ss.items():
                 sizes[p] = sizes.get(p, 0) + b
                 home[p] = g
@@ -576,7 +585,10 @@ class IngestDriver:
         tmap: dict[str, int] = {}
         groups: dict[str, list] = {}
         reduced = 0
-        for g, st in sorted(self._reduce_done.items()):
+        with self._lock:
+            reduce_done = {g: dict(st)
+                           for g, st in self._reduce_done.items()}
+        for g, st in sorted(reduce_done.items()):
             preds = sorted(st.get("preds", ()))
             groups[str(g)] = preds
             reduced += int(st.get("reduced", 0))
@@ -593,18 +605,19 @@ class IngestDriver:
                   "w") as f:
             json.dump(manifest, f, indent=1, sort_keys=True)
             f.write("\n")
-        self.stats.update({
-            "group_stats": {str(g): {k: v for k, v in st.items()
-                                     if k != "preds"}
-                            for g, st in
-                            sorted(self._reduce_done.items())},
-            "reduced": reduced,
-            "map_s": round(t_map - t0, 3),
-            "reduce_s": round(t_reduce - t_map, 3),
-            "total_s": round(t_reduce - t0, 3),
-            "write_ts": write_ts,
-        })
-        manifest["stats"] = dict(self.stats)
+        with self._lock:
+            self.stats.update({
+                "group_stats": {str(g): {k: v for k, v in st.items()
+                                         if k != "preds"}
+                                for g, st in
+                                sorted(reduce_done.items())},
+                "reduced": reduced,
+                "map_s": round(t_map - t0, 3),
+                "reduce_s": round(t_reduce - t_map, 3),
+                "total_s": round(t_reduce - t0, 3),
+                "write_ts": write_ts,
+            })
+            manifest["stats"] = dict(self.stats)
         return manifest
 
     def close(self):
@@ -857,11 +870,12 @@ class _ShuffleSink:
             return {p: f.name for p, f in self.files.items()}
 
     def close(self):
-        for f in self.files.values():
-            try:
-                f.close()
-            except OSError:
-                pass
+        with self.lock:
+            for f in self.files.values():
+                try:
+                    f.close()
+                except OSError:
+                    pass
 
 
 def _parse_runs(data: bytes) -> list[dict]:

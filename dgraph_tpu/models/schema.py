@@ -258,6 +258,9 @@ class SchemaState:
     """Mutable predicate->schema map guarding the engine.
     Ref: schema.state (schema/schema.go:48-57) minus the mutex — the engine
     serializes schema changes through its apply loop."""
+    # dglint: guarded-by=*:external (see the docstring: schema changes
+    # serialize through the engine's apply loop, reads run under the
+    # server's rw read lock)
 
     def __init__(self, with_initial: bool = True):
         self._preds: dict[str, PredicateSchema] = {}

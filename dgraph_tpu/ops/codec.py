@@ -533,6 +533,10 @@ class DecodeScratch:
                  cache_budget: int = 8 << 20):
         self.budget = int(budget_bytes)
         self.cache_budget = int(cache_budget)
+        # dglint: guarded-by=_tls:contextvar,high_water:atomic,overflows:atomic
+        # (the arena is threading.local — every thread sees only its
+        # own cells; the gauges are stats-grade max-folds/counters
+        # where a lost update is acceptable)
         self._tls = threading.local()
         self.high_water = 0
         self.overflows = 0

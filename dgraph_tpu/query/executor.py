@@ -331,6 +331,9 @@ class ExecNode:
 
 
 class Executor:
+    # dglint: guarded-by=*:single-thread (one Executor per request,
+    # confined to the thread running that query; cross-request state
+    # lives in GraphDB / Plan / AdaptivePlanner, never here)
     def __init__(self, db, read_ts: int, ctx=None, plan=None):
         self.db = db
         self.read_ts = read_ts
@@ -2918,6 +2921,31 @@ class Executor:
         for i in range(len(children)):
             parent.children.append(nodes[i])
 
+    def _expand_ownership_guard(self, pname: str) -> None:
+        """Ownership check at expansion time: a predicate reached only
+        via expand() never appears in the query text, so the server's
+        _misroute_guard_query screen cannot see it — without this
+        hook, a stale-routed expand racing a tablet cutover silently
+        under-reports the moved predicate's edges for the one
+        in-flight query (the router's next map fetch routes
+        correctly). Same typed failure as the server guard:
+        TabletMisrouted carries the forwarding hint. Zero-cost until
+        this engine has actually moved a tablet out or holds a split
+        hash range."""
+        moved = self.db.moved_out
+        split = self.db.split_partial
+        if not moved and not split:
+            return
+        if pname in moved and pname not in self.db.tablets:
+            from dgraph_tpu.cluster.errors import TabletMisrouted
+            raise TabletMisrouted(pname, moved[pname])
+        if pname in split:
+            from dgraph_tpu.cluster.errors import TabletMisrouted
+            raise TabletMisrouted(
+                pname, None,
+                f"tablet {pname!r} is split across groups; refresh "
+                "the tablet map and fan out per sub-tablet")
+
     def _expand_expand(self, children: list[GraphQuery],
                        src: np.ndarray,
                        keep_uid_leaves: bool = False
@@ -2956,6 +2984,7 @@ class Executor:
                 if pname in seen:
                     continue
                 seen.add(pname)
+                self._expand_ownership_guard(pname)
                 sub = GraphQuery(attr=pname, children=list(c.children),
                                  filter=c.filter)
                 tab = self.db.tablets.get(pname)

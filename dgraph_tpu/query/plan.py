@@ -204,6 +204,9 @@ class Plan:
         self.epoch = epoch
         self.mesh_key = mesh_key
         self.stages: list[list[str]] = []
+        # dglint: guarded-by=_memo:atomic,_decisions:atomic
+        # (the hot read is a bare GIL-atomic dict probe by design;
+        # writes are idempotent and serialize under _memo_lock)
         self._memo: dict = {}
         self._memo_lock = threading.Lock()
         self.compiled_ns = 0

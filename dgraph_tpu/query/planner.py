@@ -250,6 +250,10 @@ class AdaptivePlanner:
     def __init__(self, db):
         self.db = db
         self._lock = threading.Lock()
+        # dglint: guarded-by=_versions:atomic,_consults:atomic
+        # (the warm-path version() probe is a bare GIL-atomic dict
+        # read on purpose — writes serialize under _lock; _consults
+        # is a stats-grade counter, a lost increment is acceptable)
         # (skeleton, stage, pred) -> re-optimization generation
         self._versions: dict[tuple, int] = {}
         # (skeleton, stage, pred) -> learned actual-rows EWMA

@@ -37,6 +37,8 @@ _EMPTY = np.empty(0, dtype=np.uint64)
 
 
 class ValueColumns:
+    # dglint: guarded-by=*:external (owned by a Tablet; shares its
+    # externally-synchronized discipline)
     """Columnar view of a scalar tablet's untagged values (the JSON
     fast path's input). Iterable as (srcs, tid, data, enc) and exposes
     .nbytes so DeviceCacheLRU can budget/evict it like a device tile —
@@ -374,6 +376,10 @@ def _rm(arr: np.ndarray, uid: int) -> np.ndarray:
 
 
 class Tablet:
+    # dglint: guarded-by=*:external (tablets are engine data-plane
+    # state: mutated only by the raft-apply/write path, read under
+    # the server's rw read lock — synchronization lives a layer up,
+    # see GraphDB; racecheck witnesses contract violations)
     def __init__(self, pred: str, schema: PredicateSchema):
         self.pred = pred
         self.schema = schema

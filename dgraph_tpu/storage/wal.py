@@ -44,6 +44,9 @@ def raise_if_legacy_wal(path: str) -> None:
 
 class _PyWal:
     """Fallback framer, wire-compatible with dgt_wal_*."""
+    # dglint: guarded-by=*:external (appends happen only on the
+    # engine's serialized write path; replay/close are lifecycle-edge
+    # calls — synchronization is the caller's contract)
 
     def __init__(self, path: str, sync: bool = False):
         self.path = path

@@ -48,7 +48,7 @@ from typing import Optional
 
 __all__ = [
     "LockOrderViolation", "enable", "disable", "reset", "enabled",
-    "violations", "stats", "wrap_lock",
+    "violations", "stats", "wrap_lock", "held_locks",
 ]
 
 
@@ -349,6 +349,14 @@ def reset():
 
 def enabled() -> bool:
     return _enabled
+
+
+def held_locks() -> tuple:
+    """The calling thread's currently-held witnessed locks, outermost
+    first (lock identity = construction site, same as the order
+    table). utils/racecheck consumes this as the lockset of each
+    attribute access it samples."""
+    return tuple(_held())
 
 
 def violations() -> list[LockOrderViolation]:

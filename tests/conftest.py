@@ -64,6 +64,12 @@ def pytest_configure(config):
         "lock-order witness for the test (module-wide via "
         "pytestmark in the tier-1 concurrency files); a witnessed "
         "inversion fails the test with both stacks")
+    config.addinivalue_line(
+        "markers", "racecheck: arms the utils/racecheck attribute-"
+        "level data-race witness (registered concurrency-plane "
+        "classes get sampled access instrumentation; kwargs "
+        "strict=/sample= pass through); a witnessed race fails the "
+        "test with both access stacks")
 
 
 @pytest.fixture(autouse=True)
@@ -86,6 +92,32 @@ def _lockcheck_witness(request):
     if found:
         pytest.fail(
             "lock-order inversion(s) witnessed by utils/lockcheck:\n"
+            + "\n".join(str(v) for v in found))
+
+
+@pytest.fixture(autouse=True)
+def _racecheck_witness(request):
+    """Opt-in attribute-level data-race witness (dglint DG13's dynamic
+    complement): tests/modules marked `racecheck` run with the
+    registered concurrency-plane classes' attribute accesses sampled;
+    any write/write or read/write pair from different threads with no
+    common lock fails the test with both access stacks."""
+    marker = request.node.get_closest_marker("racecheck")
+    if marker is None:
+        yield
+        return
+    from dgraph_tpu.utils import racecheck
+
+    racecheck.enable(
+        strict=bool(marker.kwargs.get("strict", False)),
+        sample=int(marker.kwargs.get("sample", 1)))
+    try:
+        yield
+    finally:
+        found = racecheck.disable()
+    if found:
+        pytest.fail(
+            "data race(s) witnessed by utils/racecheck:\n"
             + "\n".join(str(v) for v in found))
 
 

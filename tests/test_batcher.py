@@ -10,7 +10,7 @@ import pytest
 
 # tier-1 concurrency file: every test runs under the runtime
 # lock-order witness (utils/lockcheck; see the conftest marker)
-pytestmark = pytest.mark.lockcheck
+pytestmark = [pytest.mark.lockcheck, pytest.mark.racecheck]
 
 from dgraph_tpu.engine.batcher import MicroBatcher
 from dgraph_tpu.engine.db import GraphDB

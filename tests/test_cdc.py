@@ -16,6 +16,8 @@ import pytest
 from dgraph_tpu.cdc.changelog import (
     CdcPlane, OffsetTruncated, offset_for_ts,
 )
+
+pytestmark = pytest.mark.racecheck
 from dgraph_tpu.engine.db import GraphDB
 from dgraph_tpu.storage.tablet import EdgeOp, Posting
 from dgraph_tpu.models.types import TypeID, Val

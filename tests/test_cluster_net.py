@@ -21,7 +21,7 @@ import pytest
 
 # tier-1 concurrency file: every test runs under the runtime
 # lock-order witness (utils/lockcheck; see the conftest marker)
-pytestmark = pytest.mark.lockcheck
+pytestmark = [pytest.mark.lockcheck, pytest.mark.racecheck]
 
 from dgraph_tpu.cluster.client import ClusterClient
 

@@ -763,7 +763,8 @@ class TestFramework:
             cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
         assert out.returncode == 0
         for code in ("DG01", "DG02", "DG03", "DG04", "DG05", "DG06",
-                     "DG07", "DG08", "DG09", "DG10", "DG11", "DG12"):
+                     "DG07", "DG08", "DG09", "DG10", "DG11", "DG12",
+                     "DG13", "DG14"):
             assert code in out.stdout
         assert "whole-program" in out.stdout
 
@@ -1315,6 +1316,292 @@ class TestGlobalLockOrder:
             """),
         })
         assert "DG12" in codes(found)
+
+
+# ------------------------------------------------------------------ DG13
+
+
+class TestGuardedBy:
+    REL = "dgraph_tpu/engine/_fix_race.py"
+
+    def _racy(self, annotation="", guard_write=False):
+        lock_ctx = "with self._lock:\n                        " \
+            if guard_write else ""
+        return textwrap.dedent(f"""
+            import threading
+
+            class Pump:
+                {annotation}
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self.count = self.count + 1
+
+                def bump(self):
+                    {lock_ctx}self.count = self.count + 1
+        """)
+
+    # -- violations ---------------------------------------------------
+
+    def test_unguarded_write_across_threads(self):
+        found = lint_sources({self.REL: self._racy(guard_write=True)})
+        dg13 = [f for f in found if f.code == "DG13"]
+        assert dg13, codes(found)
+        msg = dg13[0].message
+        # both witness paths named: the spawned loop and the main path
+        assert "Pump.count" in msg
+        assert "_loop" in msg and "spawned at" in msg
+        assert "bump" in msg or "main thread" in msg
+
+    def test_no_lock_anywhere_still_flagged(self):
+        found = lint_sources({self.REL: self._racy()})
+        dg13 = [f for f in found if f.code == "DG13"]
+        assert dg13
+        assert "no lock held at any site" in dg13[0].message
+
+    # -- suppressed ---------------------------------------------------
+
+    def test_discipline_annotation_silences(self):
+        found = lint_sources({self.REL: self._racy(
+            annotation="# dglint: guarded-by=count:atomic "
+                       "(int bump, torn reads acceptable here)",
+            guard_write=True)})
+        assert "DG13" not in codes(found)
+
+    def test_class_wide_external_silences(self):
+        found = lint_sources({self.REL: self._racy(
+            annotation="# dglint: guarded-by=*:external "
+                       "(fixture: synchronized a layer up)",
+            guard_write=True)})
+        assert "DG13" not in codes(found)
+
+    def test_per_line_disable(self):
+        src = self._racy(guard_write=True).replace(
+            "self.count = self.count + 1\n\n",
+            "self.count = self.count + 1  "
+            "# dglint: disable=DG13 (fixture reason)\n\n", 1)
+        found = lint_sources({self.REL: src})
+        assert "DG13" not in codes(found)
+
+    # -- clean --------------------------------------------------------
+
+    def test_consistent_guard_clean(self):
+        found = lint_sources({self.REL: textwrap.dedent("""
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    with self._lock:
+                        self.count = self.count + 1
+
+                def bump(self):
+                    with self._lock:
+                        self.count = self.count + 1
+        """)})
+        assert "DG13" not in codes(found)
+
+    def test_single_thread_class_clean(self):
+        # no spawn: every site runs on the main root only
+        found = lint_sources({self.REL: textwrap.dedent("""
+            class Tally:
+                def __init__(self):
+                    self.count = 0
+
+                def bump(self):
+                    self.count = self.count + 1
+        """)})
+        assert "DG13" not in codes(found)
+
+    def test_caller_held_lock_covers_helper(self):
+        # the helper writes lock-free, but EVERY caller holds the
+        # lock: the intersection-meet fixpoint credits the helper
+        found = lint_sources({self.REL: textwrap.dedent("""
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _bump_locked(self):
+                    self.count = self.count + 1
+
+                def _loop(self):
+                    with self._lock:
+                        self._bump_locked()
+
+                def bump(self):
+                    with self._lock:
+                        self._bump_locked()
+        """)})
+        assert "DG13" not in codes(found)
+
+
+# ------------------------------------------------------------------ DG14
+
+
+class TestWireErrorDiscipline:
+    E_REL = "dgraph_tpu/cluster/errors.py"
+    S_REL = "dgraph_tpu/cluster/service.py"
+    C_REL = "dgraph_tpu/cluster/client.py"
+
+    ERRORS = textwrap.dedent("""
+        class TabletMisrouted(RuntimeError):
+            pass
+
+        WIRE_ERRORS = (
+            ("TabletMisrouted", "misrouted"),
+        )
+    """)
+    SERVICE = textwrap.dedent("""
+        from dgraph_tpu.cluster.errors import TabletMisrouted
+
+        def _client_loop(conn):
+            while True:
+                try:
+                    resp = serve(conn)
+                except TabletMisrouted as e:
+                    resp = {"ok": False, "error": str(e),
+                            "misrouted": {"pred": e.pred}}
+                except Exception as e:
+                    resp = {"ok": False, "error": str(e)}
+                send(conn, resp)
+    """)
+    CLIENT = textwrap.dedent("""
+        class ClusterClient:
+            @staticmethod
+            def _unwrap(resp):
+                if not resp.get("ok"):
+                    if resp.get("misrouted"):
+                        from dgraph_tpu.cluster.errors import (
+                            TabletMisrouted,
+                        )
+                        raise TabletMisrouted(
+                            resp["misrouted"].get("pred", "?"))
+                    raise RuntimeError(resp.get("error", "rpc failed"))
+                return resp["result"]
+    """)
+
+    def _lint(self, errors=None, service=None, client=None):
+        return lint_sources({
+            self.E_REL: errors or self.ERRORS,
+            self.S_REL: service or self.SERVICE,
+            self.C_REL: client or self.CLIENT,
+        })
+
+    # -- clean --------------------------------------------------------
+
+    def test_full_contract_clean(self):
+        assert "DG14" not in codes(self._lint())
+
+    # -- violations ---------------------------------------------------
+
+    def test_unregistered_error_class(self):
+        errors = self.ERRORS.replace(
+            "class TabletMisrouted(RuntimeError):\n    pass",
+            "class TabletMisrouted(RuntimeError):\n    pass\n\n\n"
+            "class StaleRead(RuntimeError):\n    pass")
+        found = [f for f in self._lint(errors=errors)
+                 if f.code == "DG14"]
+        assert found and "StaleRead" in found[0].message
+        assert "no WIRE_ERRORS entry" in found[0].message
+
+    def test_registered_class_missing_from_module(self):
+        errors = self.ERRORS.replace(
+            '("TabletMisrouted", "misrouted"),',
+            '("TabletMisrouted", "misrouted"),\n'
+            '    ("Ghost", "ghost"),')
+        msgs = [f.message for f in self._lint(errors=errors)
+                if f.code == "DG14"]
+        assert any("Ghost" in m and "no such class" in m
+                   for m in msgs)
+
+    def test_duplicate_key_flagged(self):
+        errors = self.ERRORS.replace(
+            '("TabletMisrouted", "misrouted"),',
+            '("TabletMisrouted", "misrouted"),\n'
+            '    ("TabletMisrouted", "misrouted"),')
+        msgs = [f.message for f in self._lint(errors=errors)
+                if f.code == "DG14"]
+        assert any("listed twice" in m for m in msgs)
+
+    def test_missing_service_arm(self):
+        service = textwrap.dedent("""
+            def _client_loop(conn):
+                while True:
+                    try:
+                        resp = serve(conn)
+                    except Exception as e:
+                        resp = {"ok": False, "error": str(e)}
+                    send(conn, resp)
+        """)
+        msgs = [f.message for f in self._lint(service=service)
+                if f.code == "DG14"]
+        assert any("except TabletMisrouted" in m for m in msgs)
+
+    def test_arm_without_wire_key(self):
+        service = self.SERVICE.replace(
+            '"misrouted": {"pred": e.pred}}', '}')
+        msgs = [f.message for f in self._lint(service=service)
+                if f.code == "DG14"]
+        assert any("does not set wire key 'misrouted'" in m
+                   for m in msgs)
+
+    def test_unregistered_wire_key_on_service(self):
+        service = self.SERVICE.replace(
+            '"misrouted": {"pred": e.pred}}',
+            '"misrouted": {"pred": e.pred}, "bogus": 1}')
+        msgs = [f.message for f in self._lint(service=service)
+                if f.code == "DG14"]
+        assert any("unregistered wire key 'bogus'" in m for m in msgs)
+
+    def test_missing_client_probe(self):
+        client = textwrap.dedent("""
+            class ClusterClient:
+                @staticmethod
+                def _unwrap(resp):
+                    if not resp.get("ok"):
+                        raise RuntimeError(resp.get("error", "x"))
+                    return resp["result"]
+        """)
+        msgs = [f.message for f in self._lint(client=client)
+                if f.code == "DG14"]
+        assert any("never probes resp.get('misrouted')" in m
+                   for m in msgs)
+
+    def test_probe_without_reraise(self):
+        client = self.CLIENT.replace(
+            "raise TabletMisrouted(", "raise RuntimeError(  # was: (")
+        assert "raise TabletMisrouted" not in client
+        msgs = [f.message for f in self._lint(client=client)
+                if f.code == "DG14"]
+        assert any("never raises TabletMisrouted" in m for m in msgs)
+
+    # -- suppressed ---------------------------------------------------
+
+    def test_per_line_disable(self):
+        errors = self.ERRORS.replace(
+            "class TabletMisrouted(RuntimeError):\n    pass",
+            "class TabletMisrouted(RuntimeError):\n    pass\n\n\n"
+            "class StaleRead(RuntimeError):  "
+            "# dglint: disable=DG14 (fixture: wire arm lands in the "
+            "next commit)\n    pass")
+        assert "DG14" not in codes(self._lint(errors=errors))
 
 
 # ------------------------------------------- exit codes & incremental

@@ -23,6 +23,8 @@ import numpy as np
 import pytest
 
 from dgraph_tpu.cluster import zero as zmod
+
+pytestmark = pytest.mark.racecheck
 from dgraph_tpu.cluster.rebalance import (
     RebalanceConfig, plan_rebalance,
 )

@@ -11,6 +11,8 @@ from dgraph_tpu.engine.db import GraphDB
 from dgraph_tpu.engine.prefetch import PrefetchPool
 from dgraph_tpu.utils import metrics
 
+pytestmark = pytest.mark.racecheck
+
 SCHEMA = """
 score: int @index(int) .
 tier: string @index(exact) .

@@ -1,0 +1,283 @@
+"""utils/racecheck — the sampled attribute-level data-race witness
+(DG13's dynamic complement).
+
+Planted races here are DETERMINISTIC: the lockset algorithm flags two
+unsynchronized accesses even when they do not physically overlap (an
+Event handoff is a real happens-before edge the coarse lifecycle model
+deliberately does not witness), so a write-then-event-then-write plant
+fires on every run, no timing luck required.
+
+Fixture locks are created in tests/, which is OUTSIDE lockcheck's
+project root — a bare `threading.Lock()` here would come back
+unwrapped (empty locksets, witness blind). Every guarded fixture lock
+therefore goes through `lockcheck.wrap_lock(name=...)`, same as the
+lockcheck suite does.
+"""
+
+import threading
+
+import pytest
+
+from dgraph_tpu.utils import lockcheck, racecheck
+
+
+class _Shared:
+    """Minimal concurrency-plane stand-in: one guarded-or-not int."""
+
+    def __init__(self, lock=None):
+        self._lock = lock
+        self.x = 0
+
+
+class _IgnoredAttr:
+    def __init__(self):
+        self.x = 0
+
+
+# takes effect at every subsequent enable(); _patch_class dedupes, so
+# other racecheck-marked suites patching these too is inert
+racecheck.register(_Shared)
+racecheck.register(_IgnoredAttr, ignore=("x",))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    if racecheck.enabled():
+        racecheck.disable()
+    racecheck.reset()
+
+
+def _spawn(fn):
+    t = threading.Thread(target=fn, name="rc-fixture")
+    t.start()
+    return t
+
+
+# ------------------------------------------------------- planted races
+
+
+class TestPlantedRaces:
+    def test_write_write_race_carries_both_stacks(self):
+        racecheck.enable()
+        obj = _Shared()
+        done = threading.Event()
+
+        def loop():
+            obj.x = obj.x + 1
+            done.set()
+
+        t = _spawn(loop)
+        done.wait(5)
+        obj.x = obj.x + 1       # main-thread write, t not yet joined
+        t.join()
+        found = racecheck.disable()
+        assert len(found) == 1
+        msg = str(found[0])
+        assert "data race on `_Shared.x`" in msg
+        assert "no common lock" in msg
+        # both witness stacks attached, pointing back into this file
+        assert "--- first access" in msg
+        assert "--- second access" in msg
+        assert msg.count("test_racecheck.py") >= 2
+
+    def test_read_write_race_caught(self):
+        racecheck.enable()
+        obj = _Shared()
+        done = threading.Event()
+
+        def loop():
+            obj.x = 7
+            done.set()
+
+        t = _spawn(loop)
+        done.wait(5)
+        _ = obj.x               # unsynchronized main-thread read
+        t.join()
+        found = racecheck.disable()
+        assert len(found) == 1
+        assert {found[0].first[0], found[0].second[0]} == {"r", "w"}
+
+    def test_two_spawned_threads_race(self):
+        # neither access is on the main thread; no lifecycle edge
+        # connects the two children, so their records stay live
+        racecheck.enable()
+        obj = _Shared()
+        # keep both children alive until both have written: two
+        # non-overlapping short threads could reuse one OS ident,
+        # which the witness (correctly, conservatively) merges
+        gate = threading.Barrier(3)
+
+        def writer(v):
+            obj.x = v
+            gate.wait(timeout=5)
+
+        ta = _spawn(lambda: writer(2))
+        tb = _spawn(lambda: writer(3))
+        gate.wait(timeout=5)
+        ta.join()
+        tb.join()
+        found = racecheck.disable()
+        assert found and found[0].cls_name == "_Shared"
+
+    def test_strict_raises_in_accessing_thread(self):
+        racecheck.enable(strict=True)
+        obj = _Shared()
+        done = threading.Event()
+
+        def loop():
+            obj.x = 1
+            done.set()
+
+        t = _spawn(loop)
+        done.wait(5)
+        with pytest.raises(racecheck.RaceViolation):
+            obj.x = 2
+        t.join()
+        assert racecheck.disable()
+
+    def test_dedup_one_report_per_class_attr(self):
+        racecheck.enable()
+        obj = _Shared()
+        done = threading.Event()
+
+        def loop():
+            for _ in range(50):
+                obj.x = obj.x + 1
+            done.set()
+
+        t = _spawn(loop)
+        done.wait(5)
+        for _ in range(50):
+            obj.x = obj.x + 1   # races every iteration
+        t.join()
+        assert len(racecheck.disable()) == 1
+
+
+# ---------------------------------------------------------- negatives
+
+
+class TestCleanPatterns:
+    def test_common_lock_is_clean(self):
+        racecheck.enable()
+        lock = lockcheck.wrap_lock(name="test_racecheck.py:fixture")
+        obj = _Shared(lock)
+        done = threading.Event()
+
+        def loop():
+            with obj._lock:
+                obj.x = obj.x + 1
+            done.set()
+
+        t = _spawn(loop)
+        done.wait(5)
+        with obj._lock:
+            obj.x = obj.x + 1
+        t.join()
+        assert racecheck.disable() == []
+
+    def test_construct_then_spawn_is_not_a_race(self):
+        # Thread.start retires the parent's records: everything the
+        # parent wrote happens-before the child's first step
+        racecheck.enable()
+        obj = _Shared()
+        obj.x = 41              # main-thread post-init write
+        t = _spawn(lambda: setattr(obj, "x", obj.x + 1))
+        t.join()
+        assert racecheck.disable() == []
+
+    def test_join_then_read_is_not_a_race(self):
+        # Thread.join retires the joined thread's records
+        racecheck.enable()
+        obj = _Shared()
+        t = _spawn(lambda: setattr(obj, "x", 7))
+        t.join()
+        obj.x = obj.x + 1       # after the join edge: ordered
+        assert racecheck.disable() == []
+
+    def test_objects_born_before_arming_invisible(self):
+        # pre-armed objects carry unwrapped locks — witnessing them
+        # could only false-positive, so they are skipped by design
+        obj = _Shared()
+        racecheck.enable()
+        done = threading.Event()
+
+        def loop():
+            obj.x = 1
+            done.set()
+
+        t = _spawn(loop)
+        done.wait(5)
+        obj.x = 2
+        t.join()
+        assert racecheck.disable() == []
+        assert racecheck.stats()["tracked_keys"] == 0
+
+    def test_per_class_ignore_set(self):
+        racecheck.enable()
+        obj = _IgnoredAttr()
+        done = threading.Event()
+
+        def loop():
+            obj.x = 1
+            done.set()
+
+        t = _spawn(loop)
+        done.wait(5)
+        obj.x = 2
+        t.join()
+        assert racecheck.disable() == []
+
+
+# ---------------------------------------------------------- lifecycle
+
+
+class TestLifecycle:
+    def test_disable_restores_class_and_thread_hooks(self):
+        orig_set = _Shared.__setattr__
+        orig_start = threading.Thread.start
+        racecheck.enable()
+        assert _Shared.__setattr__ is not orig_set
+        assert threading.Thread.start is not orig_start
+        racecheck.disable()
+        assert _Shared.__setattr__ is orig_set
+        assert threading.Thread.start is orig_start
+
+    def test_enable_arms_lockcheck_and_disable_disarms_it(self):
+        assert not lockcheck.enabled()
+        racecheck.enable()
+        assert lockcheck.enabled()
+        racecheck.disable()
+        assert not lockcheck.enabled()
+
+    def test_stats_count_probes_and_samples(self):
+        racecheck.enable()
+        obj = _Shared()
+        for _ in range(10):
+            obj.x = obj.x + 1
+        s = racecheck.stats()
+        assert s["probes"] >= 20          # 10 writes + 10 reads
+        assert s["samples"] >= 20
+        assert s["violations"] == 0
+        racecheck.disable()
+
+    def test_sampling_thins_reads_but_not_writes(self):
+        racecheck.enable(sample=1000)
+        obj = _Shared()
+        for _ in range(10):
+            obj.x = obj.x + 1
+        s = racecheck.stats()
+        # every write sampled; at most one read in 1000 ticks
+        assert 10 <= s["samples"] <= 11
+        racecheck.disable()
+
+    def test_marker_runs_green_on_clean_product_code(self):
+        # the exact path the marked tier-1 suites exercise: a real
+        # TARGETS class born and driven under the armed witness
+        from dgraph_tpu.engine.result_cache import ResultCache
+
+        racecheck.enable()
+        rc = ResultCache(entries=16)
+        rc.put(("k",), ["p"], b"v")
+        assert rc.get(("k",)) == b"v"
+        assert racecheck.disable() == []

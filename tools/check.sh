@@ -89,6 +89,13 @@ echo "== netfault overhead =="
 # (decomposed gate; DGRAPH_TPU_NETFAULT_BUDGET overrides)
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --netfault-overhead
 
+echo "== racecheck overhead =="
+# the ARMED attribute-access race witness (utils/racecheck, the
+# `racecheck` marker the tier-1 concurrency suites run under) must
+# cost < 5% of the summary mix (decomposed: per-sampled-access cost
+# x nominal accesses/op; DGRAPH_TPU_RACECHECK_BUDGET overrides)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --racecheck-overhead
+
 echo "== compressed setops =="
 # compressed-vs-dense set algebra sweep: block-descriptor skipping
 # must beat decode-then-intersect on the selective-intersection

@@ -1125,6 +1125,15 @@ def main(argv=None) -> int:
     # the bank is cross-group BY CONSTRUCTION (bal on g1, ledger on
     # g2): fewer than two groups would silently drop the 2PC coverage
     args.groups = max(2, args.groups)
+    race_witness = None
+    if args.smoke:
+        # the DRIVER's own concurrency plane (ClusterClient routing
+        # state under the bank/noise worker threads) runs under the
+        # attribute-level race witness: a data race in the harness
+        # invalidates the history the checker judges. Armed before
+        # any client is constructed so their locks are witnessed.
+        from dgraph_tpu.utils import racecheck as race_witness
+        race_witness.enable()
     os.makedirs(args.report_dir, exist_ok=True)
     rng = random.Random(args.seed)
     names = [n.strip() for n in args.nemeses.split(",") if n.strip()]
@@ -1195,6 +1204,8 @@ def main(argv=None) -> int:
                 cl.close()
             rc.close()
 
+    races = race_witness.disable() if race_witness is not None else []
+
     hist_path = os.path.join(args.report_dir, "history.jsonl")
     with open(hist_path, "w") as f:
         for rec in bank.history:
@@ -1218,10 +1229,12 @@ def main(argv=None) -> int:
         "rate_qps": args.rate, "slo_ms": args.slo_ms,
         "deadline_ms": args.deadline_ms,
         "seed": args.seed, "smoke": bool(args.smoke),
+        "race_violations": len(races),
         "history_ops": len(bank.history),
         "wall_s": round(time.monotonic() - t_run, 1),
     }
     out = {"summary": summary, "phases": phases, "checker": verdict,
+           "races": [str(v) for v in races],
            "history_file": os.path.abspath(hist_path),
            "report_dir": os.path.abspath(args.report_dir)}
     with open(args.out, "w") as f:
@@ -1231,6 +1244,9 @@ def main(argv=None) -> int:
     bad = []
     if not verdict["ok"]:
         bad.append(f"checker: {verdict['violations'][:3]}")
+    if races:
+        bad.append("racecheck: "
+                   + "; ".join(str(v).splitlines()[0] for v in races))
     if verdict["stats"]["acked_transfers"] < 5 \
             or verdict["stats"]["full_reads"] < 5:
         bad.append(f"workload starved: {verdict['stats']}")

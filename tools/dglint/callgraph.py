@@ -9,7 +9,11 @@ a small JSON-serializable **summary**:
 
     defs        every function/method, with its raw call sites (and
                 which locks are lexically held at each), its lock
-                acquisitions, and its DG01-style host-sync sites
+                acquisitions, its DG01-style host-sync sites, its
+                `self.X` attribute access sites (read/write + held
+                locks, for DG13), and its thread spawns
+                (`Thread(target=...)` / `pool.submit(f)`)
+    guards      `# dglint: guarded-by=attr:spec` declarations per class
     imports     local name -> dotted target, for call resolution
     classes     methods + `self.attr = SomeClass(...)` attribute types
     trace_roots functions that enter tracing (jit decorators,
@@ -61,6 +65,20 @@ _TRACE_WRAPPERS = ("shard_map", "pl.pallas_call", "pallas_call",
 _EXTRA_LOCK_ATTRS = frozenset({"meta", "_admission", "_cond"})
 
 _CALLS_MARK = "# dglint: calls="
+_GUARD_MARK = "# dglint: guarded-by="
+
+# method names that mutate their receiver in place: `self.X.append(v)`
+# is a WRITE access to attribute X for DG13's purposes (the dict/list
+# the attribute names is the shared state, not the binding)
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "add", "insert", "remove",
+    "discard", "pop", "popitem", "popleft", "clear", "update",
+    "setdefault", "sort", "reverse",
+})
+
+# thread-spawn call spellings whose `target=` (or first submit arg)
+# is a thread entry point for DG13's reachability
+_THREAD_CTORS = frozenset({"threading.Thread", "Thread"})
 
 # method names the unique-name fallback must never resolve: builtin
 # container/str methods and the socket/threading/executor vocabulary
@@ -175,6 +193,11 @@ class _FnExtractor:
         self.pairs: list[dict] = []
         self.purity: list[dict] = []
         self.self_attrs: dict[str, str] = {}
+        # DG13 surface: deduped `self.X` access sites + thread spawns
+        self.attrs: list[dict] = []
+        self.spawns: list[dict] = []
+        self._seen_acc: set[tuple] = set()
+        self._claimed: set[int] = set()
 
     def _ctx(self, line: int) -> str:
         return self.lines[line - 1].strip() \
@@ -184,6 +207,51 @@ class _FnExtractor:
         body = fn.body if isinstance(fn, FuncDef) else [fn.body]
         for stmt in body:
             self._visit(stmt, ())
+
+    # -- DG13 surface: self.X access sites + thread spawns ------------
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> Optional[str]:
+        """`self.X` (innermost level only) -> X, else None. Lock-ish
+        attributes are synchronization, not shared data — skipped."""
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            a = node.attr
+            if "lock" in a.lower() or a in _EXTRA_LOCK_ATTRS:
+                return None
+            return a
+        return None
+
+    def _access(self, attr: str, kind: str, line: int,
+                held: tuple[str, ...], meth: Optional[str] = None):
+        key = (attr, kind, held, meth)
+        if key not in self._seen_acc:
+            self._seen_acc.add(key)
+            acc = {"a": attr, "k": kind, "line": line,
+                   "held": list(held)}
+            if meth is not None:
+                # container-mutator spelling: DG13 demotes to a read
+                # when the attribute's type is a project class that
+                # defines `meth` (a method call, not a set/dict op)
+                acc["m"] = meth
+            self.attrs.append(acc)
+
+    def _store_target(self, t: ast.AST, held: tuple[str, ...]):
+        a = self._self_attr(t)
+        if a is not None:
+            self._access(a, "w", t.lineno, held)
+            self._claimed.add(id(t))
+            return
+        if isinstance(t, (ast.Subscript, ast.Attribute)):
+            # self.X[k] = v / self.X.y = v: mutates the object X names
+            a = self._self_attr(t.value)
+            if a is not None:
+                self._access(a, "w", t.lineno, held)
+                self._claimed.add(id(t.value))
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._store_target(el, held)
 
     def _visit(self, node: ast.AST, held: tuple[str, ...]):
         if isinstance(node, (*FuncDef, ast.Lambda, ast.ClassDef)):
@@ -199,6 +267,14 @@ class _FnExtractor:
                             and isinstance(t.value, ast.Name) \
                             and t.value.id == "self":
                         self.self_attrs.setdefault(t.attr, ctor)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                self._store_target(t, held)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            self._store_target(node.target, held)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._store_target(t, held)
         if isinstance(node, ast.With):
             new_held = held
             for item in node.items:
@@ -217,6 +293,30 @@ class _FnExtractor:
             return
         if isinstance(node, ast.Call):
             name = call_name(node)
+            if isinstance(node.func, ast.Attribute):
+                # self.meth(...) is dispatch, not a data access
+                if self._self_attr(node.func) is not None:
+                    self._claimed.add(id(node.func))
+                # self.X.append(v) mutates the object X names
+                if node.func.attr in _MUTATOR_METHODS:
+                    recv = self._self_attr(node.func.value)
+                    if recv is not None:
+                        self._access(recv, "w", node.lineno, held,
+                                     meth=node.func.attr)
+                        self._claimed.add(id(node.func.value))
+            # thread spawns: Thread(target=f) / pool.submit(f, ...)
+            if name in _THREAD_CTORS:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        d = dotted(kw.value)
+                        if d is not None:
+                            self.spawns.append(
+                                {"t": d, "line": node.lineno})
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "submit" and node.args:
+                d = dotted(node.args[0])
+                if d is not None:
+                    self.spawns.append({"t": d, "line": node.lineno})
             # X.acquire() outside a with-statement: an acquisition
             # event (edges from held locks), scope unknown lexically
             if isinstance(node.func, ast.Attribute) \
@@ -242,8 +342,52 @@ class _FnExtractor:
             if msg is not None:
                 self.purity.append({"line": node.lineno, "msg": msg,
                                     "text": self._ctx(node.lineno)})
+        elif isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load) \
+                and id(node) not in self._claimed:
+            a = self._self_attr(node)
+            if a is not None:
+                self._access(a, "r", node.lineno, held)
         for sub in ast.iter_child_nodes(node):
             self._visit(sub, held)
+
+
+def _guard_annotations(tree: ast.AST,
+                       lines: list[str]) -> dict[str, dict[str, str]]:
+    """`# dglint: guarded-by=attr:spec[,attr:spec]` lines, attributed
+    to the innermost enclosing class -> {class: {attr: spec}}. The
+    spec is either a lock name (bare -> `Cls.name`; `mod:_g` /
+    `Cls.attr` taken verbatim) or a lock-free discipline token
+    (write-once | handoff | contextvar | atomic | single-thread |
+    external) that declares the attribute intentionally unguarded;
+    attr `*` covers every attribute of the class (an externally
+    synchronized data-plane class declares its contract once)."""
+    marked = [(i, t) for i, t in enumerate(lines, start=1)
+              if _GUARD_MARK in t]
+    if not marked:  # the common case: skip the ClassDef-span walk
+        return {}
+    spans: list[tuple[int, int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            spans.append((node.lineno,
+                          node.end_lineno or node.lineno, node.name))
+    out: dict[str, dict[str, str]] = {}
+    for i, text in marked:
+        j = text.find(_GUARD_MARK)
+        rest = text[j + len(_GUARD_MARK):].split()
+        tail = rest[0] if rest else ""
+        best = None
+        for (s, e, nm) in spans:
+            if s <= i <= e and (best is None or s >= best[0]):
+                best = (s, e, nm)
+        cls = best[2] if best else ""
+        for part in tail.split(","):
+            if ":" not in part:
+                continue
+            attr, spec = part.split(":", 1)
+            if attr.strip() and spec.strip():
+                out.setdefault(cls, {})[attr.strip()] = spec.strip()
+    return out
 
 
 def _forced_edges(lines: list[str]) -> dict[int, list[str]]:
@@ -334,6 +478,10 @@ def extract_summary(rel: str, tree: ast.AST,
                     "calls": ex.calls, "acq": ex.acq,
                     "pairs": ex.pairs, "purity": ex.purity,
                 }
+                if ex.attrs:
+                    defs[qual]["attrs"] = ex.attrs
+                if ex.spawns:
+                    defs[qual]["spawns"] = ex.spawns
                 if cls is not None and ex.self_attrs:
                     for attr, ctor in ex.self_attrs.items():
                         classes[cls]["attrs"].setdefault(attr, ctor)
@@ -379,6 +527,7 @@ def extract_summary(rel: str, tree: ast.AST,
         "globals": sorted(set(globals_)),
         "trace_roots": sorted(set(trace_roots)),
         "forced": _forced_edges(lines),
+        "guards": _guard_annotations(tree, lines),
         "suppress": {
             "file": sorted(file_wide),
             "lines": {str(k): sorted(v) for k, v in per_line.items()},
@@ -419,8 +568,20 @@ class CallGraph:
             for cname, cinfo in s["classes"].items():
                 self.class_index.setdefault(cname, []).append(
                     (rel, cinfo))
+        # class name -> direct subclasses (by base name)
+        self.subclasses: dict[str, list[str]] = {}
+        for cname, entries in self.class_index.items():
+            for _rel, cinfo in entries:
+                for b in cinfo.get("bases", ()):
+                    self.subclasses.setdefault(
+                        b.split(".")[-1], []).append(cname)
         # resolved edges: id -> [(callee_id, line, held_locks)]
         self.edges: dict[str, list[tuple[str, int, tuple]]] = {}
+        # virtual-dispatch edges: a `self.meth()` call resolved to a
+        # base-class method may land on any subclass override at
+        # runtime. Kept separate so DG10/DG12 keep their precise
+        # graph; DG13's reachability/caller-held analyses merge them.
+        self.vedges: dict[str, list[tuple[str, int, tuple]]] = {}
         self._build()
 
     # -- resolution helpers -------------------------------------------
@@ -581,6 +742,19 @@ class CallGraph:
             for qual, d in s["defs"].items():
                 fid = f"{rel}::{qual}"
                 out: list[tuple[str, int, tuple]] = []
+                # a bound-method REFERENCE (`self._on_x` in a dispatch
+                # table, a callback arg) is a potential call: without
+                # the edge, dispatch handlers look like dead code to
+                # reachability and caller-held analyses
+                cls = d.get("cls")
+                if cls is not None:
+                    for acc in d.get("attrs", ()):
+                        if acc["k"] != "r":
+                            continue
+                        mid = self._lookup_method(cls, acc["a"])
+                        if mid is not None and mid != fid:
+                            out.append((mid, acc["line"],
+                                        tuple(acc.get("held", ()))))
                 for c in d["calls"]:
                     callee = self.resolve(rel, qual, c["name"])
                     if callee is not None and callee != fid:
@@ -592,6 +766,43 @@ class CallGraph:
                             out.append((eid, c["line"],
                                         tuple(c.get("held", ()))))
                 self.edges[fid] = out
+        ov_cache: dict[str, list[str]] = {}
+        for fid, out in self.edges.items():
+            direct = {c for c, _l, _h in out}
+            vout: list[tuple[str, int, tuple]] = []
+            for callee, line, held in out:
+                if callee not in ov_cache:
+                    ov_cache[callee] = self._overrides(callee)
+                for ov in ov_cache[callee]:
+                    if ov != fid and ov not in direct:
+                        vout.append((ov, line, held))
+            if vout:
+                self.vedges[fid] = vout
+
+    def _overrides(self, callee: str) -> list[str]:
+        """Subclass overrides of a method id: `self.meth()` statically
+        binds to the base def, but dynamic dispatch may land on any
+        override (RaftServer._drain_ready -> AlphaServer.sm_apply)."""
+        rel, qual = callee.split("::", 1)
+        cls = self.summaries[rel]["defs"][qual].get("cls")
+        if cls is None or not qual.startswith(f"{cls}."):
+            return []
+        meth = qual[len(cls) + 1:]
+        if "." in meth or meth.startswith("__"):
+            return []
+        out: set[str] = set()
+        work = list(self.subclasses.get(cls, ()))
+        seen: set[str] = set()
+        while work:
+            sub = work.pop()
+            if sub in seen:
+                continue
+            seen.add(sub)
+            for srel, _ci in self.class_index.get(sub, ()):
+                if f"{sub}.{meth}" in self.summaries[srel]["defs"]:
+                    out.add(f"{srel}::{sub}.{meth}")
+            work.extend(self.subclasses.get(sub, ()))
+        return sorted(out)
 
     def _forced_id(self, spec: str) -> Optional[str]:
         """`pkg.mod:Qual.name` annotation -> id."""
@@ -606,10 +817,12 @@ class CallGraph:
 
     # -- queries -------------------------------------------------------
 
-    def reachable_from(self, roots: Iterable[str]
+    def reachable_from(self, roots: Iterable[str], *,
+                       virtual: bool = False
                        ) -> dict[str, tuple[str, int] | None]:
         """BFS closure: reachable id -> (parent id, call line) or None
-        for a root — enough to reconstruct one witness path."""
+        for a root — enough to reconstruct one witness path. With
+        `virtual`, dynamic-dispatch override edges are followed too."""
         parent: dict[str, tuple[str, int] | None] = {}
         work = []
         for r in roots:
@@ -618,7 +831,10 @@ class CallGraph:
                 work.append(r)
         while work:
             cur = work.pop()
-            for callee, line, _held in self.edges.get(cur, ()):
+            nbrs = self.edges.get(cur, ())
+            if virtual and cur in self.vedges:
+                nbrs = list(nbrs) + self.vedges[cur]
+            for callee, line, _held in nbrs:
                 if callee not in parent:
                     parent[callee] = (cur, line)
                     work.append(callee)

@@ -150,7 +150,7 @@ def _load_rules():
     # import for side effect: each module registers its rules
     from tools.dglint import (  # noqa: F401
         rules_codec, rules_concurrency, rules_jax, rules_mvcc,
-        rules_registry, rules_wholeprog,
+        rules_races, rules_registry, rules_wholeprog,
     )
 
 

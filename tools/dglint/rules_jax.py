@@ -274,6 +274,11 @@ def check_recompile_hazard(ctx: FileContext):
     cache's `jit_stage` seam (query/plan.py) — exempt from the
     wrap-and-invoke sub-check ONLY; its static-arg validation and
     loop hazards stay linted like everywhere else."""
+    # every spelling this rule can flag contains the substring (jit
+    # calls, @jit decorators, partial(jax.jit)): most files skip the
+    # per-funcdef subtree walks below entirely
+    if not any("jit" in ln for ln in ctx.lines):
+        return
     defs = _module_defs(ctx.tree)
     for fn in iter_funcdefs(ctx.tree):
         for dec in fn.decorator_list:

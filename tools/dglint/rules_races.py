@@ -1,0 +1,279 @@
+"""DG13 — guarded-by inference: attribute-level data races, statically.
+
+The reference Dgraph leans on `go test -race`; this port's substitute
+is a guarded-by discipline inferred from the whole-program summaries:
+
+  1. every `threading.Thread(target=...)` / `pool.submit(f)` site is a
+     thread ROOT; the call graph's BFS closure from each root tells us
+     which functions can run on which threads
+  2. every `self.X` access site carries the locks lexically held at
+     it (callgraph extraction), widened by the locks held at EVERY
+     call edge into the enclosing function (the "caller holds the
+     lock" helper pattern, computed as an intersection-meet fixpoint)
+  3. an attribute written outside `__init__` and reachable from ≥2
+     thread roots must have a consistent guard: the lock held at the
+     majority of its access sites (or the one declared via
+     `# dglint: guarded-by=attr:lock`); any site not holding the
+     guard that can pair with a second-thread site — at least one of
+     the pair a write, no common lock — is a finding, with both
+     witness paths named
+
+`# dglint: guarded-by=attr:<discipline>` with a discipline token
+(write-once | handoff | contextvar | atomic | single-thread |
+external) declares the attribute intentionally lock-free and silences
+it wholesale; `guarded-by=*:external` declares a whole class
+externally synchronized (the engine data plane: Tablet/GraphDB run
+under AlphaServer's rw lock or the single raft-apply thread — the
+synchronization contract lives a layer up). A per-line
+`# dglint: disable=DG13 (reason)` suppresses one site.
+utils/racecheck.py is the runtime complement: it witnesses the same
+pairs dynamically with real stacks.
+"""
+
+from __future__ import annotations
+
+from tools.dglint.callgraph import CallGraph, short_id
+from tools.dglint.core import Finding, ProjectContext, register_project
+from tools.dglint.rules_wholeprog import (
+    _graph, _in_project, _line_text, _norm_lock,
+)
+
+_DISCIPLINES = frozenset({
+    "write-once", "handoff", "contextvar", "atomic", "single-thread",
+    "external",
+})
+_MAIN = "<main>"
+
+# methods whose accesses never pair: construction precedes
+# publication, finalization follows the last share
+_LIFECYCLE = frozenset({"__init__", "__del__", "__post_init__"})
+
+
+def _spawn_entries(proj: ProjectContext,
+                   cg: CallGraph) -> dict[str, tuple[str, int]]:
+    """Resolved thread entry fid -> (spawning fid, spawn line)."""
+    entries: dict[str, tuple[str, int]] = {}
+    for rel, s in sorted(proj.summaries.items()):
+        if not _in_project(rel):
+            continue
+        for qual, d in s["defs"].items():
+            for sp in d.get("spawns", ()):
+                callee = cg.resolve(rel, qual, sp["t"])
+                if callee is not None:
+                    entries.setdefault(
+                        callee, (f"{rel}::{qual}", sp["line"]))
+    return entries
+
+
+def _caller_held(proj: ProjectContext, cg: CallGraph,
+                 entries: dict) -> dict[str, frozenset]:
+    """fid -> locks held at EVERY in-graph call edge into it (plus
+    whatever those callers themselves were entered under): Kleene
+    iteration with intersection meet. Thread entries start empty —
+    a spawned function begins with nothing held. Functions with no
+    in-graph callers are public surface: empty (conservative)."""
+    callers: dict[str, list[tuple[str, frozenset]]] = {}
+    fids: list[str] = []
+    for rel, s in proj.summaries.items():
+        if not _in_project(rel):
+            continue
+        for qual, d in s["defs"].items():
+            fid = f"{rel}::{qual}"
+            fids.append(fid)
+            if qual.rsplit(".", 1)[-1] in _LIFECYCLE:
+                # pre-publication: a constructor driving a helper
+                # lock-free cannot race, and would poison the meet
+                continue
+            fedges = list(cg.edges.get(fid, ())) \
+                + list(cg.vedges.get(fid, ()))
+            for callee, _line, held in fedges:
+                hn = frozenset(
+                    n for h in held
+                    if (n := _norm_lock(proj, rel, qual, h))
+                    is not None)
+                callers.setdefault(callee, []).append((fid, hn))
+    TOP = None
+    H: dict[str, frozenset | None] = {}
+    for fid in fids:
+        if fid in entries or fid not in callers:
+            H[fid] = frozenset()
+        else:
+            H[fid] = TOP
+    for _round in range(30):
+        changed = False
+        for fid in fids:
+            if fid in entries or fid not in callers:
+                continue
+            acc: frozenset | None = TOP
+            for (c, hn) in callers[fid]:
+                hc = H.get(c, frozenset())
+                if hc is TOP:
+                    continue
+                v = hn | hc
+                acc = v if acc is TOP else (acc & v)
+            if acc is not TOP and acc != H[fid]:
+                H[fid] = acc
+                changed = True
+        if not changed:
+            break
+    return {fid: (h if h is not None else frozenset())
+            for fid, h in H.items()}
+
+
+def _method_call(cg: CallGraph, cls: str, attr: str,
+                 meth: str) -> bool:
+    """Is `self.<attr>.<meth>(...)` a method call on a project class
+    (via the `self.attr = Cls(...)` attribute types) rather than a
+    container mutation? `self.db.discard(txn)` is GraphDB.discard,
+    not set.discard."""
+    for crel, cinfo in cg.class_index.get(cls, ()):
+        ctor = cinfo["attrs"].get(attr)
+        if ctor is None:
+            continue
+        tcls = cg._resolve_class(crel, ctor)
+        if tcls is not None \
+                and cg._lookup_method(tcls, meth) is not None:
+            return True
+    return False
+
+
+def _racy_pair(s: dict, o: dict) -> bool:
+    """Can `s` and `o` execute on different threads, at least one
+    writing, with no common lock?"""
+    if s["k"] == "r" and o["k"] == "r":
+        return False
+    if len(s["roots"] | o["roots"]) < 2:
+        return False
+    return not (s["locks"] & o["locks"])
+
+
+@register_project("DG13", "guarded-by-inference")
+def check_guarded_by(proj: ProjectContext):
+    """Every shared mutable attribute (written outside `__init__`,
+    reachable from ≥2 thread roots) must be consistently guarded by
+    one lock — inferred by majority witness over its access sites, or
+    declared with `# dglint: guarded-by=attr:lock`. Sites that break
+    the guard and can pair with a second-thread access are findings
+    carrying both witness paths. Lock-free publishes declare a
+    discipline token instead (`guarded-by=attr:write-once` etc.)."""
+    cg = _graph(proj)
+    entries = _spawn_entries(proj, cg)
+    parents = {e: cg.reachable_from([e], virtual=True)
+               for e in entries}
+    roots_of: dict[str, set[str]] = {}
+    for e, pm in parents.items():
+        for fid in pm:
+            roots_of.setdefault(fid, set()).add(e)
+    held_in = _caller_held(proj, cg, entries)
+
+    guards: dict[tuple[str, str], str] = {}
+    for rel, s in proj.summaries.items():
+        for cls, m in (s.get("guards") or {}).items():
+            for attr, spec in m.items():
+                guards.setdefault((cls, attr), spec)
+
+    groups: dict[tuple[str, str], list[dict]] = {}
+    for rel, s in sorted(proj.summaries.items()):
+        if not _in_project(rel):
+            continue
+        for qual, d in s["defs"].items():
+            cls = d.get("cls")
+            if cls is None:
+                continue
+            if qual.rsplit(".", 1)[-1] in _LIFECYCLE:
+                continue
+            fid = f"{rel}::{qual}"
+            eff = held_in.get(fid) or frozenset()
+            roots = frozenset(roots_of.get(fid, ())) or \
+                frozenset((_MAIN,))
+            for acc in d.get("attrs", ()):
+                if cg._lookup_method(cls, acc["a"]) is not None:
+                    continue  # bound-method reference, not data
+                kind = acc["k"]
+                if kind == "w" and "m" in acc \
+                        and _method_call(cg, cls, acc["a"], acc["m"]):
+                    kind = "r"  # method call on the binding
+                locks = set(eff)
+                for h in acc.get("held", ()):
+                    n = _norm_lock(proj, rel, qual, h)
+                    # an unresolvable held lock still synchronizes
+                    # sites within the class that spell it the same
+                    locks.add(n if n is not None else f"{cls}?{h}")
+                groups.setdefault((cls, acc["a"]), []).append({
+                    "rel": rel, "fid": fid, "line": acc["line"],
+                    "k": kind, "locks": frozenset(locks),
+                    "roots": roots,
+                })
+
+    def chain(site: dict, root: str) -> str:
+        fid = site["fid"]
+        if root == _MAIN or root not in parents:
+            return f"{short_id(fid)}:{site['line']} (main thread)"
+        hops = cg.path(parents[root], fid)
+        spawner, sline = entries[root]
+        return (f"[spawned at {short_id(spawner)}:{sline}] "
+                + " -> ".join(short_id(h) for h in hops)
+                + f":{site['line']}")
+
+    for (cls, attr), sites in sorted(groups.items()):
+        spec = guards.get((cls, attr))
+        if spec is None:
+            spec = guards.get((cls, "*"))  # class-wide declaration
+        if spec is not None and spec in _DISCIPLINES:
+            continue
+        if not any(s["k"] == "w" for s in sites):
+            continue
+        all_roots = set()
+        for s in sites:
+            all_roots |= s["roots"]
+        if len(all_roots) < 2:
+            continue
+        if spec is not None:
+            guard = spec if (":" in spec or "." in spec) \
+                else f"{cls}.{spec}"
+            how = f"declared guard `{guard}`"
+        else:
+            count: dict[str, int] = {}
+            for s in sites:
+                for lk in s["locks"]:
+                    count[lk] = count.get(lk, 0) + 1
+            if count:
+                guard = max(sorted(count), key=lambda lk: count[lk])
+                how = (f"inferred guard `{guard}` (held at "
+                       f"{count[guard]}/{len(sites)} sites)")
+            else:
+                guard = None
+                how = "no lock held at any site"
+        if guard is not None \
+                and all(guard in s["locks"] for s in sites):
+            continue
+        minority = [s for s in sites
+                    if guard is None or guard not in s["locks"]]
+        for s in sorted(minority,
+                        key=lambda x: (x["rel"], x["line"], x["k"])):
+            partner = None
+            for o in sites:
+                if o is s or not _racy_pair(s, o):
+                    continue
+                if partner is None or (
+                        guard is not None
+                        and guard in o["locks"]
+                        and guard not in partner["locks"]):
+                    partner = o
+            if partner is None:
+                continue
+            r1 = sorted(s["roots"])[0]
+            r2 = next((r for r in sorted(partner["roots"])
+                       if r != r1), sorted(partner["roots"])[0])
+            kind = "write" if s["k"] == "w" else "read"
+            yield Finding(
+                "DG13", s["rel"], s["line"],
+                f"`{cls}.{attr}` is shared across "
+                f"{len(all_roots)} thread roots but this {kind} "
+                f"holds no consistent guard ({how}): "
+                f"this thread {chain(s, r1)}; "
+                f"other thread {chain(partner, r2)} — guard it, or "
+                f"annotate `# dglint: guarded-by={attr}:"
+                "<lock|write-once|handoff|contextvar|atomic|"
+                "single-thread|external>` on the class",
+                _line_text(proj, s["rel"], s["line"]))

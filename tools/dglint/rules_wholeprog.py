@@ -289,12 +289,40 @@ def check_ts_provenance(ctx: FileContext):
 # ------------------------------------------------------------------ DG12
 
 
+def _attr_owner(proj: ProjectContext, cls: str, attr: str) -> str:
+    """The most ancestral class in `cls`'s base chain whose ctor
+    assigns `attr` — `self.lock` acquired in a ZeroServer method is
+    RaftServer's lock if RaftServer.__init__ created it."""
+    cg = _graph(proj)
+    owner = cls
+    order: list[str] = []
+    seen: set[str] = set()
+    work = [cls]
+    while work:
+        c = work.pop(0)
+        if c in seen:
+            continue
+        seen.add(c)
+        order.append(c)
+        for _crel, cinfo in cg.class_index.get(c, ()):
+            for b in cinfo.get("bases", ()):
+                work.append(b.split(".")[-1])
+    for c in order:  # BFS order: later == more ancestral
+        for _crel, cinfo in cg.class_index.get(c, ()):
+            if attr in cinfo.get("attrs", {}):
+                owner = c
+    return owner
+
+
 def _norm_lock(proj: ProjectContext, rel: str, qual: str,
                raw: str) -> str | None:
     """Raw acquisition expression -> a project-wide lock identity.
 
-    `self._lock` in class C -> `C._lock`; `self.db.lock` resolves the
-    attribute type (`C.attrs`) -> `Db.lock`; a module global ->
+    `self._lock` in class C -> `C._lock`, where C is the MOST
+    ANCESTRAL class whose `__init__` assigns `_lock` (a subclass
+    method acquiring an inherited `self.lock` must merge with the
+    base's identity — it is the same object); `self.db.lock` resolves
+    the attribute type (`C.attrs`) -> `Db.lock`; a module global ->
     `mod:_lock`; an unresolvable local stays None (never guessed —
     a wrong merge would fabricate cycles)."""
     s = proj.summaries[rel]
@@ -313,8 +341,9 @@ def _norm_lock(proj: ProjectContext, rel: str, qual: str,
                     tcls = _graph(proj)._resolve_class(crel, ctor)
                     if tcls is not None:
                         return f"{tcls}.{'.'.join(rest[1:])}"
-            return f"{cls}.{'.'.join(rest)}"
-        return f"{cls}.{rest[0]}"
+            owner = _attr_owner(proj, cls, rest[0])
+            return f"{owner}.{'.'.join(rest)}"
+        return f"{_attr_owner(proj, cls, rest[0])}.{rest[0]}"
     if len(parts) == 1:
         if parts[0] in s.get("globals", ()):
             return f"{s['module']}:{parts[0]}"
