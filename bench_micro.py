@@ -717,6 +717,86 @@ def racecheck_overhead_bench(runs: int = 5,
     return rec
 
 
+def watchdog_overhead_bench(runs: int = 5,
+                            budget_frac: float = None) -> dict:
+    """`--watchdog-overhead`: cost of the always-on alerting plane
+    (utils/watchdog's evaluator tick + the per-request reqlog observer
+    utils/alerts feeds its SLO windows with) against the < 1%
+    acceptance budget.
+
+    Decomposed like the stats/netfault gates (a sub-1% A/B cannot
+    resolve through scheduler noise): (1) the per-tick cost of
+    Watchdog.tick() on a WARM manager — every default rule loaded,
+    SLO windows populated with op+tenant series, signal providers
+    registered, healthy signal values so no rule fires — best-of-N;
+    the evaluator runs once per tick_s, so its duty cycle is
+    per_tick / tick_s; (2) the per-request cost of
+    AlertManager.observe_request on a realistic reqlog record,
+    best-of-N; (3) the per-query time of the golden summary mix (the
+    fastest ops served, so the observer fraction is an upper bound).
+    overhead = per_tick/(tick_s) + per_obs/per_query. Budget
+    override: DGRAPH_TPU_WATCHDOG_BUDGET."""
+    from dgraph_tpu.utils import alerts, watchdog
+
+    if budget_frac is None:
+        budget_frac = float(os.environ.get(
+            "DGRAPH_TPU_WATCHDOG_BUDGET", "0.01"))
+    tick_s = 1.0
+    wd = watchdog.Watchdog(tick_s=tick_s,
+                           manager=alerts.AlertManager())
+    wd.register_signals("bench", lambda: {
+        "raft_apply_lag": 3.0, "raft_peer_silent_s": 0.2,
+        "cdc_max_lag": 1.0})
+    rec_ok = {"op": "query", "outcome": "ok", "tenant": "t0"}
+    for _ in range(2_000):
+        wd.manager.observe_request(rec_ok)
+    wd.tick()  # baseline tick: rate rules need a prev snapshot
+
+    # (1) per-tick cost, warm manager, nothing firing
+    n_ticks = 2_000
+    per_tick_us = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter_ns()
+        for _ in range(n_ticks):
+            wd.tick()
+        per_tick_us = min(
+            per_tick_us, (time.perf_counter_ns() - t0) / n_ticks / 1e3)
+
+    # (2) per-observation cost of the reqlog observer
+    n_syn = 50_000
+    per_obs_us = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter_ns()
+        for _ in range(n_syn):
+            wd.manager.observe_request(rec_ok)
+        per_obs_us = min(
+            per_obs_us, (time.perf_counter_ns() - t0) / n_syn / 1e3)
+
+    # (3) per-query time on the summary mix (shared definition)
+    db, queries = _summary_mix()
+    for _ in range(2):
+        _mix_pass_us(db, queries)  # warm plans and caches
+    pass_us = min(_mix_pass_us(db, queries) for _ in range(runs))
+    per_query_us = pass_us / max(1, len(queries))
+
+    tick_frac = per_tick_us / (tick_s * 1e6)
+    obs_frac = per_obs_us / per_query_us
+    frac = tick_frac + obs_frac
+    rec = {"metric": "watchdog_overhead",
+           "queries": len(queries),
+           "per_tick_us": round(per_tick_us, 3),
+           "tick_s": tick_s,
+           "tick_frac": round(tick_frac, 6),
+           "per_observation_us": round(per_obs_us, 5),
+           "per_query_us": round(per_query_us, 2),
+           "observer_frac": round(obs_frac, 6),
+           "overhead_frac": round(frac, 6),
+           "budget_frac": budget_frac,
+           "within_budget": frac < budget_frac}
+    print(json.dumps(rec))
+    return rec
+
+
 def main():
     from dgraph_tpu.utils.backend import force_cpu_backend, probe_backend
 
@@ -745,6 +825,10 @@ def main():
         return
     if "--racecheck-overhead" in sys.argv:
         if not racecheck_overhead_bench()["within_budget"]:
+            sys.exit(1)
+        return
+    if "--watchdog-overhead" in sys.argv:
+        if not watchdog_overhead_bench()["within_budget"]:
             sys.exit(1)
         return
     if "--setops-compressed" in sys.argv:

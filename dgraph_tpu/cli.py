@@ -139,6 +139,7 @@ def cmd_alpha(args) -> int:
                          batch_window_us=args.batch_window_us,
                          tenant_rate=args.tenant_rate,
                          tenant_burst=args.tenant_burst)
+    _start_watchdog(alpha, "alpha", wal_path=args.wal or "")
     grpc_srv = None
     if args.grpc_port:
         from dgraph_tpu.server.grpc_api import serve_grpc
@@ -233,8 +234,35 @@ def cmd_node(args) -> int:
           + (f", debug http {args.debug_host}:{args.debug_port}"
              if args.debug_port else ""), file=sys.stderr,
           flush=True)
+    _start_watchdog(srv, getattr(srv, "node_name",
+                                 f"{args.kind}-{args.id}"),
+                    wal_path=args.wal)
     srv.serve_forever()
     return 0
+
+
+def _start_watchdog(srv, node_name: str, wal_path: str = ""):
+    """Start the per-process alert watchdog (utils/watchdog.py) for a
+    long-lived server process. DGRAPH_TPU_WATCHDOG=0 disables; bare
+    library embeddings never pass through here so they pay nothing.
+    Incident bundles land under $DGRAPH_TPU_INCIDENT_DIR/<node> when
+    set, else beside the WAL, else stay in-memory-only (no recorder)."""
+    if os.environ.get("DGRAPH_TPU_WATCHDOG", "1") == "0":
+        return None
+    from dgraph_tpu.utils import watchdog
+    base = os.environ.get("DGRAPH_TPU_INCIDENT_DIR", "")
+    if base:
+        inc_dir = os.path.join(base, node_name)
+    elif wal_path:
+        root = wal_path if os.path.isdir(wal_path) \
+            else os.path.dirname(os.path.abspath(wal_path))
+        inc_dir = os.path.join(root, "incidents")
+    else:
+        inc_dir = None
+    wd = watchdog.ensure_started(incident_dir=inc_dir, node=node_name)
+    if hasattr(srv, "attach_watchdog"):
+        srv.attach_watchdog(wd)
+    return wd
 
 
 def _enc_key(args):

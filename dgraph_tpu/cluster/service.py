@@ -262,6 +262,16 @@ class RaftServer:
                     self._acked[mark] = result
                     self._applied_since_snap += 1
                     self.applied_cv.notify_all()
+            # committed-applied distance AFTER an apply batch (not on
+            # every message: the gauge write is off the heartbeat hot
+            # path this way) — the watchdog's raft_apply_lag rule and
+            # Prometheus both read it
+            metrics.set_gauge(
+                "dgraph_raft_apply_lag",
+                max(0, self.node.commit_index
+                    - self.node.applied_index),
+                labels={"node": getattr(self, "node_name",
+                                        f"node-{self.id}")})
         if self._applied_since_snap >= self.snapshot_every:
             self._applied_since_snap = 0
             self.node.take_snapshot(
@@ -420,6 +430,37 @@ class RaftServer:
             return {"ok": True,
                     "result": {"node": self.node_name,
                                "text": metrics.render_prometheus()}}
+        if op == "alerts":
+            # the alerting plane over the cluster wire (the analogue
+            # of HTTP /debug/alerts): rule catalog + firing set +
+            # recent transitions, with operator controls riding the
+            # request dict (ack=<series>, silence=<series> +
+            # silence_s=<ttl>). Zero's override adds the cluster-wide
+            # aggregation of piggybacked alpha alerts.
+            from dgraph_tpu.utils import watchdog
+            if req.get("ack"):
+                return {"ok": True, "result": {
+                    "acked": watchdog.ack(str(req["ack"]))}}
+            if req.get("silence"):
+                watchdog.silence(str(req["silence"]),
+                                 float(req.get("silence_s", 3600.0)))
+                return {"ok": True, "result": {"silenced": True}}
+            out = watchdog.alerts_payload()
+            out["node"] = self.node_name
+            out.update(self._alerts_extra())
+            return {"ok": True, "result": out}
+        if op == "incidents":
+            # the flight recorder's bundle ring (the analogue of HTTP
+            # /debug/incidents): manifests, or one full bundle by id
+            from dgraph_tpu.utils import watchdog
+            try:
+                out = watchdog.incidents_payload(
+                    limit=int(req.get("limit", 16)),
+                    bundle=req.get("id"))
+            except KeyError as e:
+                return {"ok": False, "error": str(e)}
+            out["node"] = self.node_name
+            return {"ok": True, "result": out}
         if op == "conf_change":
             action = req.get("action")
             nid = int(req.get("node", 0))
@@ -521,11 +562,35 @@ class RaftServer:
                 tracing.span("rpc.recv", op=str(req.get("op", ""))):
             return self.handle_request(req)
 
+    # client-facing ops whose FAILURES the wire edge records into the
+    # request log. Only ops whose SUCCESSES the engine also records
+    # (db.py _query_metrics / mutate) belong here: an op with
+    # failure-only recording would build an all-bad SLO series that
+    # fires during a fault and then starves below min_volume, holding
+    # the alert forever. Inner 2PC/task failures surface as query/
+    # mutate failures at the coordinator anyway. Routing signals —
+    # NotLeader/misroute/stale/fenced — are retries, not failures,
+    # and must not burn SLO budget.
+    _SLO_OPS = frozenset({"query", "mutate"})
+
+    def _log_wire_failure(self, req: dict, exc: BaseException,
+                          t0: float) -> None:
+        op = str(req.get("op", ""))
+        if op not in self._SLO_OPS:
+            return
+        from dgraph_tpu.utils import reqlog
+        reqlog.record(
+            op, trace_id=str(req.get("trace_id", "")),
+            latency_ms=(time.perf_counter() - t0) * 1e3,
+            outcome=reqlog.outcome_of(exc),
+            tenant=str(req.get("tenant") or ""))
+
     def _client_loop(self, conn: socket.socket):
         tracing.set_thread_node(self.node_name)
         try:
             while not self._stop.is_set():
                 req = wire.loads(wire.read_frame(conn))
+                t0 = time.perf_counter()
                 try:
                     resp = self._serve_traced(req)
                 except NotLeader as e:
@@ -560,12 +625,14 @@ class RaftServer:
                     # reqctx exception (so the HTTP/gRPC edges answer
                     # 408/499/429, not 500) and `retryable` marks
                     # deadline/overload for jittered-backoff loops
+                    self._log_wire_failure(req, e, t0)
                     resp = {"ok": False,
                             "error": f"{type(e).__name__}: {e}",
                             "aborted": type(e).__name__,
                             "retryable": isinstance(
                                 e, (DeadlineExceeded, Overloaded))}
                 except Exception as e:  # surface, don't kill the conn
+                    self._log_wire_failure(req, e, t0)
                     resp = {"ok": False,
                             "error": f"{type(e).__name__}: {e}"}
                 wire.write_frame(conn, wire.dumps(resp))
@@ -588,13 +655,40 @@ class RaftServer:
                              if p in self._last_heard else None)
                     for p in self.members if p != self.id}
 
+    def _alerts_extra(self) -> dict:
+        """Extra fields the `alerts` wire op carries for this node
+        kind (zero adds the cluster-wide aggregation)."""
+        return {}
+
+    def watchdog_signals(self) -> dict:
+        """Stall-watchdog signals this node kind contributes to each
+        evaluator tick (utils/watchdog.py register_signals): raft
+        apply lag and the quietest peer's silence age. Subclasses
+        extend."""
+        with self.lock:
+            lag = max(0, self.node.commit_index
+                      - self.node.applied_index)
+        out = {"raft_apply_lag": float(lag)}
+        ages = [a for a in self.peer_ages().values()
+                if a is not None]
+        if ages:
+            out["raft_peer_silent_s"] = max(ages)
+        return out
+
+    def attach_watchdog(self, wd) -> None:
+        """Register this node's signal/context providers on the
+        process watchdog (cli.py `node` calls it after boot)."""
+        wd.register_signals(self.node_name, self.watchdog_signals)
+
     def debug_stats_payload(self) -> dict:
         """What this node kind contributes to /debug/stats on the
         debug HTTP listener (counters/gauges/histograms are appended
         by the listener itself). Subclasses override."""
+        from dgraph_tpu.utils import watchdog
         return {"node": self.node_name,
                 "netfault": netfault.rules(),
-                "lastHeard": self.peer_ages()}
+                "lastHeard": self.peer_ages(),
+                "alerts": watchdog.firing_summary()}
 
     def health_payload(self) -> dict:
         with self.lock:
@@ -1041,12 +1135,27 @@ class AlphaServer(RaftServer):
                     delta = t - last
                 batch[pred] = (nbytes, delta)
                 seen[pred] = t
-            if not batch:
-                continue
+            # piggyback this node's FIRING alerts on the existing
+            # report (zero's leader keeps a cluster-wide aggregation
+            # for {"op":"alerts"} / dgalert --cluster): rides the
+            # request dict, stripped zero-side before the propose —
+            # alert state is observability, never replicated state
+            from dgraph_tpu.utils import watchdog
+            firing = watchdog.firing_summary()
+            # ALWAYS send, even with an empty batch and no alerts:
+            # the report doubles as this node's status heartbeat —
+            # zero's report_silent watchdog times the gap, which is
+            # the only node-down signal that still works at
+            # replicas=1 (no raft peers to go silent). Zero skips
+            # the raft propose for empty batches, so an idle node
+            # costs one tiny RPC per interval, not log growth.
             try:
                 # ONE batched request, not one RPC per tablet
                 got = self.zero.request({"op": "tablet_heat",
-                                         "args": (batch,)})
+                                         "args": (batch,),
+                                         "alerts": firing,
+                                         "alerts_node":
+                                         self.node_name})
                 if got.get("ok"):
                     # advance baselines only on a DELIVERED report: a
                     # report lost to a zero election must not eat its
@@ -2118,7 +2227,7 @@ class AlphaServer(RaftServer):
             # counter snapshot so one poll carries a node's whole
             # observability surface over the cluster wire alone
             # (tools/dgtop.py itself polls the HTTP endpoints)
-            from dgraph_tpu.utils import metrics, reqlog
+            from dgraph_tpu.utils import metrics, reqlog, watchdog
             # self.lock only pins the db BINDING (restore rebinds it);
             # the stats walk itself runs unlocked — a cold cache
             # recomputes O(postings) aggregates, and holding the Raft
@@ -2134,6 +2243,7 @@ class AlphaServer(RaftServer):
             stats["requests"] = reqlog.snapshot()
             stats["netfault"] = netfault.rules()
             stats["lastHeard"] = self.peer_ages()
+            stats["alerts"] = watchdog.firing_summary()
             with self.lock:
                 stats["learner"] = self.node.learner
                 stats["learnerLag"] = max(
@@ -2506,13 +2616,48 @@ class AlphaServer(RaftServer):
             return {"ok": True, "result": out}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
+    def watchdog_signals(self) -> dict:
+        """Alpha signals: base raft lag/peer silence + the slowest
+        CDC subscriber's unread-entry lag."""
+        out = super().watchdog_signals()
+        with self.lock:
+            db = self.db
+        try:
+            subs = db.cdc.stats().get("subscribers", {})
+            lags = [s.get("lag", 0) for s in subs.values()]
+            if lags:
+                out["cdc_max_lag"] = float(max(lags))
+        except Exception:  # noqa: BLE001 — a stats race must not  # dglint: disable=DG07 (watchdog tick provider; no request context)
+            pass  # kill the tick
+        return out
+
+    def watchdog_context(self) -> dict:
+        """Planner/plan-cache state for the incident bundle (NOT the
+        full debug_stats: the O(store) tablet walk has no place on a
+        capture path that fires mid-incident)."""
+        with self.lock:
+            db = self.db
+        return {
+            "planCache": db.plan_cache.stats()
+            if db.plan_cache is not None else None,
+            "planner": db.planner_impl.stats()
+            if db.planner_impl is not None else {"mode": "static"},
+            "deviceCache": db.device_cache.stats(),
+            "resultCache": db.result_cache.stats()
+            if db.result_cache is not None else None,
+        }
+
+    def attach_watchdog(self, wd) -> None:
+        super().attach_watchdog(wd)
+        wd.register_context("engine", self.watchdog_context)
+
     def debug_stats_payload(self) -> dict:
         """The debug HTTP listener's /debug/stats body: the engine's
         statistics plane + this node's identity and the request ring.
         Same locking posture as the wire `stats` op — self.lock only
         pins the db binding, the walk runs unlocked (debug_stats
         degrades on concurrent-apply races rather than stalling raft)."""
-        from dgraph_tpu.utils import reqlog
+        from dgraph_tpu.utils import reqlog, watchdog
         with self.lock:
             db = self.db
         from dgraph_tpu.storage.versions import versions_payload
@@ -2522,6 +2667,7 @@ class AlphaServer(RaftServer):
         stats["requests"] = reqlog.snapshot()
         stats["netfault"] = netfault.rules()
         stats["lastHeard"] = self.peer_ages()
+        stats["alerts"] = watchdog.firing_summary()
         stats["versions"] = versions_payload()
         with self.lock:
             stats["learner"] = self.node.learner
@@ -2599,6 +2745,14 @@ class ZeroServer(RaftServer):
         # leader change, never authoritative.
         self._move_attempts: dict[str, int] = {}
         self._move_progress: dict[str, dict] = {}
+        # leader-local cluster alert aggregation (same posture as
+        # _move_progress: observability, never replicated): node name
+        # -> {"alerts": [...], "age_mono": float} from the firing
+        # summaries alphas piggyback on their heat reports
+        self._node_alerts: dict[str, dict] = {}
+        # node name -> monotonic ts of its last heat/status report:
+        # the report_silent watchdog's clock (leader-local too)
+        self._node_report_mono: dict[str, float] = {}
         threading.Thread(target=self._move_driver_loop, daemon=True,
                          name=f"zero-moves-{node_id}").start()
         if self.rebalance_interval_s > 0:
@@ -2743,6 +2897,13 @@ class ZeroServer(RaftServer):
                 pred, {"bytes": 0, "lag": None,
                        "started": time.monotonic(),
                        "fence_started": None, "fence_ms": None})
+            if prog.get("phase") != mv["phase"]:
+                # stuck-in-phase age for the move_stuck watchdog:
+                # reset on every phase TRANSITION, so a healthy move
+                # marching through phases never looks stuck while a
+                # wedged catch-up does
+                prog["phase"] = mv["phase"]
+                prog["phase_mono"] = time.monotonic()
         if mv["phase"] in ("start", "snapshotting"):
             # ("start" = a legacy pre-phase-machine ledger entry:
             # drive it through the streaming path too)
@@ -3121,6 +3282,26 @@ class ZeroServer(RaftServer):
                     "moves": {p: dict(m) for p, m
                               in self.state.move_queue.items()},
                     "heat": dict(self.state.heat)}}
+        if op == "tablet_heat" and "alerts" in req:
+            # strip the piggybacked firing-alert summary BEFORE the
+            # propose: alert state is leader-local observability
+            # (recomputed within one report interval after a leader
+            # change), never replicated zero state
+            node = str(req.get("alerts_node") or "?")
+            with self.lock:
+                self._node_report_mono[node] = time.monotonic()
+                if req["alerts"]:
+                    self._node_alerts[node] = {
+                        "alerts": list(req["alerts"]),
+                        "seen_mono": time.monotonic()}
+                else:
+                    self._node_alerts.pop(node, None)
+            args = req.get("args", ())
+            if not (args and args[0]):
+                # pure status heartbeat (no tablets yet / no heat):
+                # nothing to fold into the replicated heat EWMA —
+                # record the report time, skip the raft propose
+                return {"ok": True, "result": {}}
         if op in ("assign_ts", "read_ts", "assign_uids", "commit",
                   "txn_status", "abort_txn", "tablet", "bump_maxes",
                   "tablet_move_start", "tablet_move_done",
@@ -3173,6 +3354,55 @@ class ZeroServer(RaftServer):
                 int(req.get("protocol_version", 0)))
             return {"ok": True, "result": out}
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _cluster_alerts(self) -> dict:
+        """The leader-local aggregation of piggybacked alpha alerts
+        (stale entries age out at 3 heat intervals: a dead node's
+        last report must not look firing forever)."""
+        ttl = 3 * 30.0
+        try:
+            import os as _os
+            ttl = 3 * float(_os.environ.get(
+                "DGRAPH_TPU_HEAT_INTERVAL_S", "") or 30.0)
+        except ValueError:
+            pass
+        now = time.monotonic()
+        with self.lock:
+            for n in [n for n, rec in self._node_alerts.items()
+                      if now - rec["seen_mono"] > ttl]:
+                del self._node_alerts[n]
+            return {n: {"alerts": list(rec["alerts"]),
+                        "age_s": round(now - rec["seen_mono"], 1)}
+                    for n, rec in sorted(self._node_alerts.items())}
+
+    def _alerts_extra(self) -> dict:
+        return {"cluster": self._cluster_alerts()}
+
+    def watchdog_signals(self) -> dict:
+        """Zero signals: base + the oldest move/split phase age (the
+        move_stuck watchdog; ages come from the replicated ledger's
+        phase_mono the leader's driver refreshes)."""
+        out = super().watchdog_signals()
+        now = time.monotonic()
+        with self.lock:
+            ages = [now - p["phase_mono"]
+                    for p in self._move_progress.values()
+                    if p.get("phase_mono") is not None]
+            if self.node.role != LEADER:
+                # alphas report to the LEADER only: a demoted zero's
+                # stale report clock would age into a false fire —
+                # drop it so a re-election starts a fresh one
+                self._node_report_mono.clear()
+            reports = [now - t
+                       for t in self._node_report_mono.values()]
+        if ages:
+            out["move_stuck_age_s"] = max(ages)
+        if reports:
+            # the quietest alpha's report gap — the node-down /
+            # partitioned-from-zero signal (works at replicas=1,
+            # where raft_peer_silent has no peers to time)
+            out["report_silent_s"] = max(reports)
+        return out
 
     def debug_stats_payload(self) -> dict:
         """Zero's /debug/stats: base payload + the live move ledger

@@ -94,6 +94,26 @@ class _DebugHandler(BaseHTTPRequestHandler):
                 from dgraph_tpu.utils import netfault
                 self._send(200, {"node": self.node_name,
                                  "rules": netfault.rules()})
+            elif u.path == "/debug/alerts":
+                from dgraph_tpu.utils import watchdog
+                if params.get("ack"):
+                    out: dict = {"acked":
+                                 watchdog.ack(params["ack"])}
+                elif params.get("silence"):
+                    watchdog.silence(params["silence"],
+                                     float(params.get("ttlS", 3600)))
+                    out = {"silenced": True}
+                else:
+                    out = watchdog.alerts_payload()
+                out["node"] = self.node_name
+                self._send(200, out)
+            elif u.path == "/debug/incidents":
+                from dgraph_tpu.utils import watchdog
+                out = watchdog.incidents_payload(
+                    limit=int(params.get("limit", 16)),
+                    bundle=params.get("id"))
+                out["node"] = self.node_name
+                self._send(200, out)
             else:
                 self._send(404, {"errors": [
                     {"message": f"no handler for GET {u.path}"}]})

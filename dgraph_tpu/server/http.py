@@ -636,6 +636,39 @@ class AlphaServer:
                 self.acl.authorize(token)
         return reqlog.snapshot()
 
+    def handle_alerts(self, params: Optional[dict] = None,
+                      token: str = "") -> dict:
+        """/debug/alerts: the watchdog's rule catalog, firing set and
+        recent transition events (utils/watchdog.py). `?ack=<series>`
+        acknowledges a firing alert; `?silence=<series>&ttlS=<s>`
+        suppresses new firings. ACL-gated like /state: rule series
+        carry tenant and op names."""
+        if self.acl is not None:
+            with self.meta:
+                self.acl.authorize(token)
+        from dgraph_tpu.utils import watchdog
+        p = params or {}
+        if p.get("ack"):
+            return {"acked": watchdog.ack(p["ack"])}
+        if p.get("silence"):
+            watchdog.silence(p["silence"],
+                             float(p.get("ttlS", 3600)))
+            return {"silenced": True}
+        return watchdog.alerts_payload()
+
+    def handle_incidents(self, params: Optional[dict] = None,
+                         token: str = "") -> dict:
+        """/debug/incidents: the flight recorder's bundle ring —
+        manifests by default, one full bundle with `?id=<bundle>`.
+        ACL-gated like /state: bundles embed queries and stacks."""
+        if self.acl is not None:
+            with self.meta:
+                self.acl.authorize(token)
+        from dgraph_tpu.utils import watchdog
+        p = params or {}
+        return watchdog.incidents_payload(
+            limit=int(p.get("limit", 16)), bundle=p.get("id"))
+
     def handle_assign(self, params: dict, token: str = "") -> dict:
         """Lease a uid block (ref zero.go /assign?what=uids): clients
         like the live loader pre-allocate so blank nodes render as
@@ -950,6 +983,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, self.alpha.handle_requests(token))
             elif path == "/debug/stats":
                 self._send(200, self.alpha.handle_debug_stats(token))
+            elif path == "/debug/alerts":
+                self._send(200, self.alpha.handle_alerts(params,
+                                                         token))
+            elif path == "/debug/incidents":
+                self._send(200, self.alpha.handle_incidents(params,
+                                                            token))
             elif path == "/debug/pprof":
                 self._send(200, self.alpha.handle_pprof(params, token))
             elif path == "/debug/prometheus_metrics":

@@ -26,6 +26,21 @@ _MAGIC = b"DGTWAL2\x00"
 _LEGACY_MAGIC = b"DGTWAL1\x00"
 
 
+def _timed_fsync(fd: int) -> None:
+    """fsync + dgraph_wal_fsync_seconds observation: the watchdog's
+    wal_fsync_stall rule reads this histogram's tick-window p99 — a
+    dying durability volume shows here long before the engine
+    visibly stalls. Seconds (own bucket table in metrics.py
+    BUCKETS_BY_NAME), not the default ms buckets."""
+    import time
+
+    from dgraph_tpu.utils import metrics
+    t0 = time.perf_counter()
+    os.fsync(fd)
+    metrics.observe("dgraph_wal_fsync_seconds",
+                    time.perf_counter() - t0)
+
+
 def raise_if_legacy_wal(path: str) -> None:
     """Pre-CRC DGTWAL1 files must fail with a recovery path, not a bare
     'bad magic' / bricked store (advisor finding). Shared by both WAL
@@ -64,7 +79,7 @@ class _PyWal:
         self._f.write(blob)
         self._f.flush()
         if self.sync:
-            os.fsync(self._f.fileno())
+            _timed_fsync(self._f.fileno())
 
     def replay(self):
         import zlib
@@ -100,12 +115,12 @@ class _PyWal:
         self._f = open(self.path, "wb")
         self._f.write(_MAGIC)
         self._f.flush()
-        os.fsync(self._f.fileno())
+        _timed_fsync(self._f.fileno())
         self._f = open(self.path, "ab+")
 
     def flush(self):
         self._f.flush()
-        os.fsync(self._f.fileno())
+        _timed_fsync(self._f.fileno())
 
     def close(self):
         self._f.close()
@@ -148,7 +163,19 @@ class Wal:
             failpoint.fire("wal.append")
             blob = encrypt_blob(dumps(record), self.key)
             sp["bytes"] = len(blob)
-            self._w.append(blob)
+            if self.native and self.sync:
+                # the native backend fsyncs inside dgt_wal_append —
+                # time the whole durable append (fsync dominates it)
+                # so the stall histogram covers both backends
+                import time
+
+                from dgraph_tpu.utils import metrics
+                t0 = time.perf_counter()
+                self._w.append(blob)
+                metrics.observe("dgraph_wal_fsync_seconds",
+                                time.perf_counter() - t0)
+            else:
+                self._w.append(blob)
 
     def replay(self) -> Iterator[Any]:
         from dgraph_tpu.storage.enc import decrypt_blob

@@ -88,6 +88,9 @@ SITES = (
     "move.flip",         # cluster/service.py   — zero's driver, before
     #                      the ownership flip commits (error/SIGKILL
     #                      here = the crash-safety acceptance seam)
+    "watchdog.capture",  # utils/watchdog.py    — before an incident
+    #                      bundle writes (error = full disk at the
+    #                      worst moment; the evaluator must survive)
 )
 
 

@@ -22,6 +22,24 @@ _HISTO_SUM: dict[tuple[str, tuple], float] = {}
 BUCKETS = [0.1, 0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
            5000, 10000]
 
+# Histograms whose unit is NOT milliseconds get their own bucket
+# table (the global one spans 0.1ms..10s and would collapse a
+# sub-millisecond fsync into one bucket). Keyed by metric name; every
+# snapshot/render path consults this so the exposition's `le` edges
+# always match the counts.
+BUCKETS_BY_NAME: dict[str, list[float]] = {
+    # seconds: fsync on a healthy NVMe is ~50-500us, a dying volume
+    # is 0.1-2.5s — the watchdog's p99 stall rule needs resolution at
+    # both ends
+    "dgraph_wal_fsync_seconds": [
+        0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+        0.05, 0.1, 0.25, 0.5, 1.0, 2.5],
+}
+
+
+def buckets_for(name: str) -> list[float]:
+    return BUCKETS_BY_NAME.get(name, BUCKETS)
+
 # Registry of every metric name the tree emits. Metric names are API
 # (dashboards and alerts key on them), so dglint DG08 checks each
 # literal inc_counter/set_gauge/observe name against this tuple — a
@@ -103,8 +121,16 @@ REGISTERED = (
     "dgraph_ingest_mapped_total",
     "dgraph_ingest_reduced_total",
     "dgraph_ingest_shuffled_bytes_total",
-    # cluster (cluster/transport.py)
+    # cluster (cluster/transport.py, cluster/service.py apply path)
+    "dgraph_raft_apply_lag",
     "raft_send_drops",
+    # WAL durability (storage/wal.py fsync sites)
+    "dgraph_wal_fsync_seconds",
+    # alerting / incident flight recorder (utils/watchdog.py,
+    # utils/alerts.py)
+    "dgraph_alerts_firing",
+    "dgraph_incidents_total",
+    "dgraph_watchdog_ticks_total",
     # live tablet moves / rebalancer (cluster/service.py ZeroServer)
     "dgraph_move_catchup_lag",
     "dgraph_move_duration_ms",
@@ -163,13 +189,17 @@ def get_counter(name: str, labels: dict | None = None) -> float:
 
 
 def observe(name: str, value_ms: float, labels: dict | None = None):
+    """One histogram observation. The value's unit is milliseconds
+    for default-bucket metrics; BUCKETS_BY_NAME entries define their
+    own unit (the name says which, e.g. *_seconds)."""
     k = _key(name, labels)
+    edges = buckets_for(name)
     with _LOCK:
         h = _HISTOGRAMS.get(k)
         if h is None:
-            h = [0] * (len(BUCKETS) + 1)
+            h = [0] * (len(edges) + 1)
             _HISTOGRAMS[k] = h
-        h[bisect_right(BUCKETS, value_ms)] += 1
+        h[bisect_right(edges, value_ms)] += 1
         _HISTO_SUM[k] = _HISTO_SUM.get(k, 0) + value_ms
 
 
@@ -198,7 +228,7 @@ def histograms_snapshot() -> dict:
     with _LOCK:
         return {_fmt_key(k): {"buckets": list(h),
                               "sum": _HISTO_SUM.get(k, 0.0),
-                              "le": list(BUCKETS)}
+                              "le": list(buckets_for(k[0]))}
                 for k, h in _HISTOGRAMS.items()}
 
 
@@ -247,15 +277,26 @@ def counters_delta(before: dict[str, float]) -> dict[str, float]:
     return out
 
 
+# Linux procfs probe, evaluated once: the /proc/self sources below
+# are Linux-only, and a gauge plane must DEGRADE on macOS / locked-
+# down sandboxes (gauges simply absent) — never raise out of a
+# scrape. The per-call try/excepts stay as a second belt: a probe
+# that passed at import can still fail later (fd limits, seccomp).
+import os as _os_mod  # noqa: E402
+
+_PROC_SELF_OK = _os_mod.path.isdir("/proc/self")
+
+
 def collect_memory_gauges():
     """Process memory gauges (ref x/metrics.go MemoryInUse/MemoryProc:
     the reference samples Go runtime + proc stats into gauges). Reads
     /proc/self/statm — free on Linux; silently skipped elsewhere."""
+    if not _PROC_SELF_OK:
+        return
     try:
         with open("/proc/self/statm") as f:
             parts = f.read().split()
-        import os
-        page = os.sysconf("SC_PAGE_SIZE")
+        page = _os_mod.sysconf("SC_PAGE_SIZE")
         set_gauge("memory_proc_bytes", int(parts[0]) * page)   # vsize
         set_gauge("memory_inuse_bytes", int(parts[1]) * page)  # rss
     except (OSError, ValueError, IndexError):
@@ -286,11 +327,13 @@ def collect_runtime_gauges():
     for gen, st in enumerate(gc.get_stats()):
         set_gauge("process_gc_collections", st.get("collections", 0),
                   labels={"gen": str(gen)})
+    if not _PROC_SELF_OK:
+        return  # non-Linux: no cheap fd count — gauge stays absent
     try:
-        import os
-        set_gauge("process_open_fds", len(os.listdir("/proc/self/fd")))
+        set_gauge("process_open_fds",
+                  len(_os_mod.listdir("/proc/self/fd")))
     except OSError:
-        pass  # non-Linux: no cheap fd count
+        pass  # probe raced a sandbox tightening; degrade, don't raise
 
 
 def collect_process_gauges():
@@ -336,7 +379,7 @@ def render_prometheus() -> str:
             name, labels = k
             _type_line(name, "histogram")
             cum = 0
-            for i, b in enumerate(BUCKETS):
+            for i, b in enumerate(buckets_for(name)):
                 cum += h[i]
                 lb = dict(labels)
                 lb["le"] = str(b)

@@ -47,6 +47,26 @@ _seq = itertools.count()
 _BATCH_CV: contextvars.ContextVar[str] = contextvars.ContextVar(
     "dgraph_tpu_reqlog_batch", default="")
 
+# Completion observers (mirrors tracing.add_span_observer): each
+# registered callable sees every record() dict AFTER it lands in the
+# rings — the SLO burn-rate evaluator (utils/alerts.py) feeds its
+# per-second outcome windows from here without the serving edges
+# growing a second reporting path. Observers must be cheap and never
+# raise; they run outside _lock.
+_observers: list = []
+
+
+def add_observer(fn) -> None:
+    if fn not in _observers:
+        _observers.append(fn)
+
+
+def remove_observer(fn) -> None:
+    try:
+        _observers.remove(fn)
+    except ValueError:
+        pass
+
 
 @contextlib.contextmanager
 def bind_batch(batch_id: str) -> Iterator[None]:
@@ -86,6 +106,11 @@ def record(op: str, trace_id: str = "", latency_ms: float = 0.0,
                        (rec["latency_ms"], next(_seq), rec))
         if len(_slow_heap) > _SLOW_MAX:
             heapq.heappop(_slow_heap)  # drop the fastest
+    for fn in list(_observers):
+        try:
+            fn(rec)
+        except Exception:  # noqa: BLE001 — an alerting-plane bug  # dglint: disable=DG07 (observer runs on serving threads; no ctx owned here)
+            pass  # must never kill the request that fed it
 
 
 def outcome_of(exc: BaseException) -> str:

@@ -130,8 +130,15 @@ def _metrics_and_span_leak_guard():
     test itself arranged), and restore tracing to its enabled
     default in case a test toggled it."""
     yield
-    from dgraph_tpu.utils import coststore, metrics, reqlog, tracing
+    from dgraph_tpu.utils import (
+        coststore, metrics, reqlog, tracing, watchdog,
+    )
 
+    # the alerting plane first: a leaked watchdog thread holds a
+    # reqlog observer and keeps mutating counters while the resets
+    # below run (stop() also forgets the shared AlertManager, so
+    # firing/hysteresis state never crosses tests)
+    watchdog.stop()
     metrics.reset()
     tracing.clear()
     tracing.set_enabled(True)

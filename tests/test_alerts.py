@@ -1,0 +1,250 @@
+"""utils/alerts — the SLO burn-rate + stall-watchdog rule engine:
+multi-window burn semantics (fires on fast AND slow only), min-volume
+data gating, for/clear hysteresis (no flapping on a boundary
+oscillator), resolved events, idle-series resolve, silence/ack
+control, and the env-tunable default catalog."""
+
+import pytest
+
+from dgraph_tpu.utils import alerts
+from dgraph_tpu.utils.alerts import (
+    AlertManager, BurnRateRule, SloWindow, ThresholdRule,
+)
+
+
+def burn_rule(**kw):
+    base = dict(target=0.99, burn=10.0, fast_s=5, slow_s=60,
+                min_volume=10, for_ticks=1, clear_ticks=2)
+    base.update(kw)
+    return BurnRateRule("slo_error_burn", **base)
+
+
+# ------------------------------------------------------------ SloWindow
+
+
+def test_window_counts_and_expiry():
+    w = SloWindow(10)
+    for s in range(100, 105):
+        w.add(s, bad=(s % 2 == 0))
+    assert w.rates(104, 5) == (5, 3)
+    # a lapped slot (same ring index, older second) never reads back
+    w.add(114, bad=False)  # laps second 104's slot
+    total, bad = w.rates(114, 10)
+    assert (total, bad) == (1, 0)
+
+
+def test_window_clamps_to_horizon():
+    w = SloWindow(5)
+    for s in range(50, 55):
+        w.add(s, bad=True)
+    assert w.rates(54, 500) == (5, 5)
+
+
+# ------------------------------------------------- multi-window burn
+
+
+def test_fast_burn_alone_does_not_fire():
+    """The SRE recipe's point: a short spike burns the fast window
+    but not the slow one — no page."""
+    r = burn_rule()
+    w = SloWindow(120)
+    now = 1000
+    # 55 s of healthy traffic, then a 5 s 100%-error spike
+    for s in range(now - 59, now - 4):
+        for _ in range(4):
+            w.add(s, bad=False)
+    for s in range(now - 4, now + 1):
+        for _ in range(4):
+            w.add(s, bad=True)
+    breached, _ = r.breached_window(w, now)
+    # fast burn = (1.0/0.01) = 100 >= 10, slow burn =
+    # (20/240)/0.01 = 8.3 < 10 -> held back by the slow window
+    assert breached is False
+
+
+def test_sustained_burn_fires_both_windows():
+    r = burn_rule()
+    w = SloWindow(120)
+    now = 1000
+    for s in range(now - 59, now + 1):
+        for _ in range(4):
+            w.add(s, bad=True)
+    breached, value = r.breached_window(w, now)
+    assert breached is True
+    assert value >= r.burn
+
+
+def test_min_volume_returns_no_data():
+    r = burn_rule(min_volume=10)
+    w = SloWindow(120)
+    now = 1000
+    for s in range(now - 2, now + 1):
+        w.add(s, bad=True)  # 3 requests, all bad — but volume < 10
+    assert r.breached_window(w, now) == (None, None)
+
+
+def test_burn_series_fan_out_and_fire_via_manager():
+    r = burn_rule(for_ticks=2)
+    m = AlertManager([r], horizon_s=120)
+    now = 5000.0
+    win = m._window("op:query")
+    for s in range(int(now) - 59, int(now) + 1):
+        for _ in range(4):
+            win.add(s, bad=True)
+    assert m.evaluate({}, now_mono=now) == []  # tick 1 of for_ticks=2
+    evs = m.evaluate({}, now_mono=now + 1)
+    assert [e["state"] for e in evs] == ["firing"]
+    assert evs[0]["series"] == "slo_error_burn[op:query]"
+    assert m.firing()[0]["rule"] == "slo_error_burn"
+
+
+def test_bad_outcomes_exclude_backpressure():
+    # shed/abort/cancelled are the system working as designed
+    assert alerts.BAD_OUTCOMES == frozenset({"error", "deadline"})
+    m = AlertManager([burn_rule()], horizon_s=120)
+    for outcome in ("ok", "shed", "abort", "cancelled"):
+        m.observe_request({"op": "query", "outcome": outcome})
+    win = m._windows["op:query"]
+    total, bad = win.rates(int(__import__("time").monotonic()), 5)
+    assert (total, bad) == (4, 0)
+
+
+def test_series_bound_keeps_aggregate():
+    m = AlertManager([burn_rule()], horizon_s=60)
+    m.observe_request({"op": "query", "outcome": "ok"})  # op:_all too
+    for i in range(AlertManager.MAX_SERIES + 8):
+        m.observe_request({"op": "query", "outcome": "ok",
+                           "tenant": f"t{i}"})
+    assert len(m._windows) <= AlertManager.MAX_SERIES + 1
+    assert "op:_all" in m._windows
+
+
+# ---------------------------------------------------------- hysteresis
+
+
+def mgr(for_ticks=3, clear_ticks=2):
+    r = ThresholdRule("lag", "lag", 10.0, for_ticks=for_ticks,
+                      clear_ticks=clear_ticks)
+    return AlertManager([r])
+
+
+def test_threshold_fires_after_for_ticks():
+    m = mgr(for_ticks=3)
+    now = 100.0
+    assert m.evaluate({"lag": 50}, now_mono=now) == []
+    assert m.evaluate({"lag": 50}, now_mono=now + 1) == []
+    evs = m.evaluate({"lag": 50}, now_mono=now + 2)
+    assert [e["state"] for e in evs] == ["firing"]
+    assert evs[0]["value"] == 50
+
+
+def test_boundary_oscillator_never_flaps():
+    """Alternating breach/heal must hold the current state: neither
+    for_ticks nor clear_ticks ever accumulates."""
+    m = mgr(for_ticks=2, clear_ticks=2)
+    now = 100.0
+    for i in range(20):
+        lag = 50 if i % 2 == 0 else 0
+        assert m.evaluate({"lag": lag}, now_mono=now + i) == []
+    assert m.firing() == []
+
+
+def test_resolved_event_after_clear_ticks():
+    m = mgr(for_ticks=1, clear_ticks=3)
+    now = 100.0
+    assert m.evaluate({"lag": 99}, now_mono=now)[0]["state"] == \
+        "firing"
+    assert m.evaluate({"lag": 0}, now_mono=now + 1) == []
+    assert m.evaluate({"lag": 0}, now_mono=now + 2) == []
+    evs = m.evaluate({"lag": 0}, now_mono=now + 3)
+    assert [e["state"] for e in evs] == ["resolved"]
+    assert m.firing() == []
+    states = [e["state"] for e in m.events]
+    assert states == ["firing", "resolved"]
+
+
+def test_missing_signal_holds_state():
+    m = mgr(for_ticks=1, clear_ticks=2)
+    now = 100.0
+    m.evaluate({"lag": 99}, now_mono=now)
+    # signal gone (subsystem not running here): firing holds...
+    for i in range(3):
+        assert m.evaluate({}, now_mono=now + 1 + i) == []
+    assert [f["series"] for f in m.firing()] == ["lag"]
+
+
+def test_idle_series_resolves_instead_of_paging_forever():
+    """A firing series whose data source evaporates (traffic stopped,
+    subsystem shut down) resolves after 4x clear_ticks no-data
+    evaluations — ghost pages are the alternative."""
+    m = mgr(for_ticks=1, clear_ticks=2)
+    now = 100.0
+    m.evaluate({"lag": 99}, now_mono=now)
+    evs = []
+    for i in range(4 * 2):
+        evs += m.evaluate({}, now_mono=now + 1 + i)
+    assert [e["state"] for e in evs] == ["resolved"]
+    assert m.firing() == []
+
+
+def test_silence_suppresses_new_firing_only():
+    m = mgr(for_ticks=1, clear_ticks=1)
+    m.silence("lag", ttl_s=3600)
+    import time as _t
+    now = _t.monotonic()
+    assert m.evaluate({"lag": 99}, now_mono=now) == []
+    assert m.firing() == []
+    # expired silence: fires again
+    m.silence("lag", ttl_s=-1)
+    assert m.evaluate({"lag": 99},
+                      now_mono=now + 1)[0]["state"] == "firing"
+
+
+def test_ack_requires_firing():
+    m = mgr(for_ticks=1)
+    assert m.ack("lag") is False
+    m.evaluate({"lag": 99}, now_mono=100.0)
+    assert m.ack("lag") is True
+    assert m.firing()[0]["acked"] is True
+
+
+def test_payload_shape():
+    m = mgr(for_ticks=1)
+    m.evaluate({"lag": 99}, now_mono=100.0)
+    p = m.payload()
+    assert {"rules", "firing", "events", "uptime_s"} <= set(p)
+    assert p["rules"][0]["rule"] == "lag"
+    assert p["firing"][0]["series"] == "lag"
+
+
+# ------------------------------------------------------ default catalog
+
+
+def test_default_rules_catalog_and_env_overrides(monkeypatch):
+    names = [r.name for r in alerts.default_rules()]
+    assert len(names) == len(set(names))
+    for want in ("slo_error_burn", "raft_apply_lag",
+                 "raft_peer_silent", "report_silent",
+                 "wal_fsync_stall", "cdc_lag", "dr_standby_lag",
+                 "move_stuck", "result_cache_collapse",
+                 "tile_cache_thrash", "shed_rate"):
+        assert want in names
+    monkeypatch.setenv("DGRAPH_TPU_ALERT_APPLY_LAG", "42")
+    monkeypatch.setenv("DGRAPH_TPU_ALERT_FOR_TICKS", "7")
+    rules = {r.name: r for r in alerts.default_rules()}
+    assert rules["raft_apply_lag"].threshold == 42.0
+    assert rules["raft_apply_lag"].for_ticks == 7
+
+
+def test_threshold_rule_less_than_op():
+    r = ThresholdRule("collapse", "frac", 0.5, op="<", for_ticks=1)
+    assert r.breached({"frac": 0.1}) == (True, 0.1)
+    assert r.breached({"frac": 0.9}) == (False, 0.9)
+    assert r.breached({}) == (None, None)
+
+
+def test_signal_doc_covers_every_threshold_signal():
+    # every shipped threshold rule's signal documents its source
+    for r in alerts.default_rules():
+        if isinstance(r, ThresholdRule):
+            assert r.signal in alerts._SIGNAL_DOC, r.signal

@@ -1,0 +1,253 @@
+"""utils/watchdog — the per-node evaluator + incident flight
+recorder: bounded on-disk bundle ring (oldest-first eviction, seq
+survives restarts), ok->firing capture with a real pprof/trace/metrics
+payload, signal assembly (baseline-tick rate guard, fsync p99 tick
+delta), the firing gauge, module singleton lifecycle, and the
+Linux-only /proc guards in the runtime gauges."""
+
+import json
+import os
+import time
+
+import pytest
+
+from dgraph_tpu.utils import alerts, metrics, watchdog
+from dgraph_tpu.utils.alerts import AlertManager, ThresholdRule
+from dgraph_tpu.utils.watchdog import IncidentRecorder, Watchdog
+
+
+@pytest.fixture(autouse=True)
+def _stop_singleton():
+    yield
+    watchdog.stop()
+
+
+def fast_capture(rec, rule="lag", seq_hint=""):
+    return rec.capture({"rule": rule, "series": rule, "value": 1,
+                        "severity": "page", "ts": time.time()},
+                       node="n0", context_providers={}, pprof_s=0.1)
+
+
+# -------------------------------------------------------- bundle ring
+
+
+def test_ring_evicts_oldest_first(tmp_path):
+    rec = IncidentRecorder(str(tmp_path), max_bundles=2)
+    ids = [fast_capture(rec) for _ in range(4)]
+    kept = [m["id"] for m in rec.list()]
+    assert kept == ids[-2:]  # newest 2 survive, oldest evicted
+    assert sorted(os.listdir(tmp_path)) == sorted(kept)
+
+
+def test_seq_and_ring_survive_restart(tmp_path):
+    rec = IncidentRecorder(str(tmp_path), max_bundles=4)
+    first = [fast_capture(rec) for _ in range(2)]
+    # process restart: a fresh recorder over the same dir resumes the
+    # seq counter past what's on disk — eviction order is preserved
+    rec2 = IncidentRecorder(str(tmp_path), max_bundles=4)
+    third = fast_capture(rec2)
+    assert IncidentRecorder._seq_of(third) \
+        > IncidentRecorder._seq_of(first[-1])
+    assert [m["id"] for m in rec2.list()] == first + [third]
+
+
+def test_bundle_contents_readable(tmp_path):
+    metrics.inc_counter("dgraph_num_queries_total")
+    rec = IncidentRecorder(str(tmp_path), max_bundles=2)
+    bid = fast_capture(rec, rule="slo_error_burn")
+    assert "slo_error_burn" in bid
+    b = rec.read(bid)
+    assert b["manifest"]["rule"] == "slo_error_burn"
+    assert b["manifest"]["node"] == "n0"
+    assert b["metrics"]["counters"]
+    # the profile is a real JSON payload, not a stringified object
+    assert b["pprof"]["samples"] >= 1
+    assert isinstance(b["pprof"]["collapsed"], str)
+    assert {"requests", "traces", "netfault", "context"} <= set(b)
+    with pytest.raises(KeyError):
+        rec.read("inc-999999-nope")
+
+
+def test_capture_failpoint_registered():
+    from dgraph_tpu.utils import failpoint
+    assert "watchdog.capture" in failpoint.SITES
+
+
+# --------------------------------------------------------------- tick
+
+
+def lag_watchdog(tmp_path=None, threshold=10.0, for_ticks=1):
+    m = AlertManager([ThresholdRule("lag", "lag", threshold,
+                                    for_ticks=for_ticks,
+                                    clear_ticks=1)])
+    wd = Watchdog(tick_s=0.05, manager=m,
+                  incident_dir=str(tmp_path) if tmp_path else None)
+    wd._pprof_s = 0.1
+    wd._capture_cooldown_s = 0.0
+    return wd
+
+
+def test_tick_fires_gauge_and_counter(tmp_path):
+    wd = lag_watchdog()
+    wd.register_signals("t", lambda: {"lag": 99.0})
+    before = metrics.get_counter("dgraph_watchdog_ticks_total")
+    evs = wd.tick()
+    assert [e["state"] for e in evs] == ["firing"]
+    assert metrics.get_counter("dgraph_watchdog_ticks_total") \
+        == before + 1
+    assert metrics.gauges_snapshot()[
+        'dgraph_alerts_firing{rule="lag"}'] == 1
+    wd.register_signals("t", lambda: {"lag": 0.0})
+    wd.tick()
+    assert metrics.gauges_snapshot()[
+        'dgraph_alerts_firing{rule="lag"}'] == 0
+
+
+def test_firing_transition_writes_bundle(tmp_path):
+    wd = lag_watchdog(tmp_path)
+    wd.node = "alpha-test"
+    wd.register_signals("t", lambda: {"lag": 99.0})
+    wd.tick()
+    # capture runs on its own thread (the pprof window must never
+    # block the tick) — poll for the bundle to land
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not wd.recorder.list():
+        time.sleep(0.05)
+    bundles = wd.recorder.list()
+    assert len(bundles) == 1
+    assert bundles[0]["rule"] == "lag"
+    assert bundles[0]["node"] == "alpha-test"
+
+
+def test_capture_cooldown_suppresses_flap_churn(tmp_path):
+    wd = lag_watchdog(tmp_path)
+    wd._capture_cooldown_s = 3600.0
+    wd._last_capture["lag"] = time.monotonic()
+    wd.register_signals("t", lambda: {"lag": 99.0})
+    wd.tick()
+    time.sleep(0.3)
+    assert wd.recorder.list() == []
+
+
+def test_baseline_tick_reads_zero_rates():
+    """First tick: lifetime counters must not read as one tick's
+    delta (that would false-fire every rate rule at boot)."""
+    metrics.inc_counter("dgraph_queries_shed_total", 1_000_000)
+    wd = lag_watchdog()
+    s1 = wd.collect_signals()
+    assert s1["sheds_per_s"] == 0.0
+    metrics.inc_counter("dgraph_queries_shed_total", 5)
+    s2 = wd.collect_signals()
+    assert 0 < s2["sheds_per_s"]
+
+
+def test_fsync_p99_needs_baseline_and_volume():
+    wd = lag_watchdog()
+    assert "wal_fsync_p99_s" not in wd.collect_signals()
+    for _ in range(10):
+        metrics.observe("dgraph_wal_fsync_seconds", 0.004)
+    assert "wal_fsync_p99_s" not in wd.collect_signals()  # baseline
+    for _ in range(10):
+        metrics.observe("dgraph_wal_fsync_seconds", 0.004)
+    p99 = wd.collect_signals().get("wal_fsync_p99_s")
+    assert p99 is not None and p99 < 0.5
+
+
+def test_cache_frac_needs_lookup_volume(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_ALERT_CACHE_MIN_LOOKUPS", "100")
+    wd = lag_watchdog()
+    wd.collect_signals()  # baseline
+    metrics.inc_counter("dgraph_result_cache_misses_total", 5)
+    assert "result_cache_hit_frac" not in wd.collect_signals()
+    metrics.inc_counter("dgraph_result_cache_misses_total", 200)
+    s = wd.collect_signals()
+    assert s["result_cache_hit_frac"] == 0.0
+
+
+def test_bad_signal_provider_cannot_kill_tick():
+    wd = lag_watchdog()
+
+    def boom():
+        raise RuntimeError("provider bug")
+
+    wd.register_signals("bad", boom)
+    wd.register_signals("good", lambda: {"lag": 99.0})
+    assert [e["state"] for e in wd.tick()] == ["firing"]
+
+
+# ---------------------------------------------------- process surface
+
+
+def test_ensure_started_idempotent_and_payloads(tmp_path,
+                                                monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_WATCHDOG_TICK_S", "5")
+    wd = watchdog.ensure_started(incident_dir=str(tmp_path),
+                                 node="n1")
+    assert watchdog.ensure_started() is wd
+    assert wd.tick_s == 5.0
+    p = watchdog.alerts_payload()
+    assert {"rules", "firing", "events", "uptime_s", "watchdog"} \
+        <= set(p)
+    inc = watchdog.incidents_payload()
+    assert inc["enabled"] is True and inc["incidents"] == []
+    watchdog.stop()
+    # stopped: a fresh ensure_started builds a new evaluator
+    assert watchdog.ensure_started(node="n2") is not wd
+
+
+def test_incidents_payload_disabled_without_recorder():
+    watchdog.ensure_started(node="n3")  # no incident dir
+    inc = watchdog.incidents_payload()
+    assert inc == {"incidents": [], "enabled": False}
+
+
+def test_firing_summary_and_controls():
+    wd = watchdog.ensure_started(node="n4")
+    wd.manager.rules = [ThresholdRule("lag", "lag", 1.0,
+                                      for_ticks=1, clear_ticks=1)]
+    wd.register_signals("t", lambda: {"lag": 9.0})
+    wd.tick()
+    assert watchdog.firing_summary()[0]["series"] == "lag"
+    assert watchdog.ack("lag") is True
+    watchdog.silence("lag", 60.0)  # must not raise
+
+
+# ------------------------------------------- /proc guards (metrics)
+
+
+def test_runtime_gauges_survive_without_procfs(monkeypatch):
+    """metrics.collect_runtime_gauges / collect_memory_gauges must
+    DEGRADE off-Linux (macOS, locked-down containers): portable
+    gauges still land, /proc-sourced ones stay absent, nothing
+    raises."""
+    monkeypatch.setattr(metrics, "_PROC_SELF_OK", False)
+    metrics.reset()
+    metrics.collect_runtime_gauges()
+    metrics.collect_memory_gauges()
+    g = metrics.gauges_snapshot()
+    assert "process_threads" in g
+    assert "process_uptime_seconds" in g
+    assert "process_open_fds" not in g
+    assert "memory_proc_bytes" not in g
+
+
+@pytest.mark.skipif(not os.path.isdir("/proc/self"),
+                    reason="procfs-only assertion")
+def test_runtime_gauges_with_procfs():
+    metrics.reset()
+    metrics.collect_runtime_gauges()
+    metrics.collect_memory_gauges()
+    g = metrics.gauges_snapshot()
+    assert g["process_open_fds"] >= 1
+    assert g["memory_proc_bytes"] > 0
+
+
+# ------------------------------------------------------- json hygiene
+
+
+def test_bundle_files_are_valid_json(tmp_path):
+    rec = IncidentRecorder(str(tmp_path), max_bundles=1)
+    bid = fast_capture(rec)
+    for fn in os.listdir(tmp_path / bid):
+        with open(tmp_path / bid / fn) as f:
+            json.load(f)  # every artifact parses

@@ -96,6 +96,13 @@ echo "== racecheck overhead =="
 # x nominal accesses/op; DGRAPH_TPU_RACECHECK_BUDGET overrides)
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --racecheck-overhead
 
+echo "== watchdog overhead =="
+# the always-on alerting plane (watchdog evaluator tick + the reqlog
+# observer feeding the SLO burn windows) must cost < 1% of the
+# summary mix (decomposed: tick duty cycle + per-observation cost;
+# DGRAPH_TPU_WATCHDOG_BUDGET overrides)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_micro.py --watchdog-overhead
+
 echo "== compressed setops =="
 # compressed-vs-dense set algebra sweep: block-descriptor skipping
 # must beat decode-then-intersect on the selective-intersection

@@ -1,0 +1,159 @@
+"""dgalert: operator CLI for the alert + incident plane.
+
+Talks to one node over either observability surface —
+
+  HTTP   a base URL (http://host:port): GET /debug/alerts and
+         /debug/incidents on the main Alpha surface or any node's
+         debug listener (server/http.py, server/debug_http.py)
+  wire   a bare host:port: the framed cluster protocol's
+         {"op": "alerts"} / {"op": "incidents"} ops — works on every
+         alpha/zero replica even when no debug HTTP port was bound
+
+Subcommands:
+
+  rules       the node's rule catalog (thresholds, windows, hysteresis)
+  firing      currently firing alert series (exit 1 when any fire —
+              scriptable as a health probe)
+  events      recent firing/resolved transitions
+  incidents   the flight recorder's bundle ring (manifests)
+  dump ID     one full incident bundle as JSON (metrics snapshot,
+              slowest requests, traces, pprof, context)
+  ack SERIES  acknowledge a firing series (bookkeeping, not a mute)
+  silence SERIES --ttl S   suppress NEW firings of a series for S
+              seconds (a firing alert still resolves normally)
+
+Examples:
+  python -m tools.dgalert firing http://localhost:8080
+  python -m tools.dgalert incidents 127.0.0.1:7201
+  python -m tools.dgalert dump inc-000003-slo_error_burn 127.0.0.1:7201
+  python -m tools.dgalert ack 'slo_error_burn[op:query]' http://localhost:8080
+
+Stdlib-only on purpose: this runs where the operator is.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+class Target:
+    """One node, addressed over HTTP or the cluster wire."""
+
+    def __init__(self, spec: str, token: str = "",
+                 timeout_s: float = 5.0):
+        self.spec = spec
+        self.token = token
+        self.timeout_s = timeout_s
+        self.http = spec.startswith("http://") \
+            or spec.startswith("https://")
+        self._cl = None
+
+    def alerts(self, params: Optional[dict] = None) -> dict:
+        return self._get("/debug/alerts", "alerts", params)
+
+    def incidents(self, params: Optional[dict] = None) -> dict:
+        return self._get("/debug/incidents", "incidents", params)
+
+    def _get(self, path: str, op: str,
+             params: Optional[dict]) -> dict:
+        params = {k: v for k, v in (params or {}).items()
+                  if v not in (None, "")}
+        if self.http:
+            url = self.spec.rstrip("/") + path
+            if params:
+                url += "?" + urllib.parse.urlencode(params)
+            req = urllib.request.Request(url)
+            if self.token:
+                req.add_header("X-Dgraph-AccessToken", self.token)
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read().decode())
+        # cluster wire: single-shot RPC to this exact node
+        from dgraph_tpu.cluster.client import ClusterClient
+        if self._cl is None:
+            host, port = self.spec.rsplit(":", 1)
+            self._cl = ClusterClient({1: (host, int(port))},
+                                     timeout=self.timeout_s)
+        got = self._cl._rpc_once(1, dict(params, op=op))
+        if not got or not got.get("ok"):
+            raise RuntimeError(
+                f"{op} on {self.spec}: {got and got.get('error')}")
+        return got["result"]
+
+    def close(self):
+        if self._cl is not None:
+            self._cl.close()
+
+
+def _print(obj) -> None:
+    print(json.dumps(obj, indent=1, sort_keys=True, default=str))
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dgalert", description=__doc__.split("\n\n")[0])
+    ap.add_argument("cmd", choices=("rules", "firing", "events",
+                                    "incidents", "dump", "ack",
+                                    "silence"))
+    ap.add_argument("args", nargs="+",
+                    help="[SERIES|BUNDLE_ID] node "
+                         "(http://host:port or host:port)")
+    ap.add_argument("--token", default="",
+                    help="X-Dgraph-AccessToken for ACL clusters")
+    ap.add_argument("--ttl", type=float, default=3600.0,
+                    help="silence duration, seconds")
+    ap.add_argument("--limit", type=int, default=16)
+    args = ap.parse_args(argv)
+
+    needs_operand = args.cmd in ("dump", "ack", "silence")
+    if needs_operand and len(args.args) < 2:
+        ap.error(f"{args.cmd} needs: {args.cmd.upper()}_ARG node")
+    operand = args.args[0] if needs_operand else None
+    node = args.args[1] if needs_operand else args.args[0]
+
+    t = Target(node, token=args.token)
+    try:
+        if args.cmd == "rules":
+            _print(t.alerts().get("rules", []))
+        elif args.cmd == "firing":
+            firing = t.alerts().get("firing", [])
+            _print(firing)
+            return 1 if firing else 0
+        elif args.cmd == "events":
+            _print(t.alerts().get("events", []))
+        elif args.cmd == "incidents":
+            out = t.incidents({"limit": args.limit})
+            if not out.get("enabled", True):
+                print("incident recorder disabled on this node "
+                      "(no incident dir configured)", file=sys.stderr)
+            _print(out.get("incidents", []))
+        elif args.cmd == "dump":
+            _print(t.incidents({"id": operand}).get("bundle", {}))
+        elif args.cmd == "ack":
+            out = t.alerts({"ack": operand})
+            _print(out)
+            return 0 if out.get("acked") else 1
+        elif args.cmd == "silence":
+            _print(t.alerts({"silence": operand,
+                             "ttlS": args.ttl,
+                             "silence_s": args.ttl}))
+    except Exception as e:  # noqa: BLE001 — CLI edge: report, exit
+        print(f"dgalert: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    finally:
+        t.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

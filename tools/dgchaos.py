@@ -405,6 +405,236 @@ def phase_windows(recs: list[dict], lat: list[float],
     }
 
 
+# ----------------------------------------------------------- alert gate
+#
+# The observability acceptance criterion rides the chaos gate: every
+# injected fault phase must light up the watchdog plane (>=1 RELEVANT
+# alert firing inside the fault window), the plane must go quiet again
+# after heal, NOTHING may fire in a phase's pre window (zero false
+# positives is the bar — a pager that cries wolf is worse than none),
+# and every firing must have produced a readable incident bundle.
+# AlertCollector polls each node's {"op": "alerts"} wire endpoint from
+# a side thread; check_phase_alerts is pure so unit tests feed it
+# synthetic samples.
+
+
+def chaos_alert_env(report_dir: str) -> dict:
+    """Watchdog/alert tuning for chaos timescales, shipped to every
+    node via ProcessCluster(env_extra=...). Production defaults think
+    in minutes (utils/alerts.py default_rules); a chaos phase is
+    seconds — shrink the burn windows, hysteresis and silence
+    thresholds so fire-and-clear both fit inside one phase, and give
+    every node an incident dir under the run's report dir (the ring
+    must survive the restarts the nemeses inflict)."""
+    return {
+        "DGRAPH_TPU_WATCHDOG_TICK_S": "0.25",
+        "DGRAPH_TPU_HEAT_INTERVAL_S": "0.5",
+        "DGRAPH_TPU_ALERT_FOR_TICKS": "2",
+        "DGRAPH_TPU_ALERT_CLEAR_TICKS": "4",
+        "DGRAPH_TPU_ALERT_SLO_FAST_S": "3",
+        "DGRAPH_TPU_ALERT_SLO_SLOW_S": "6",
+        "DGRAPH_TPU_ALERT_SLO_MIN_VOLUME": "5",
+        "DGRAPH_TPU_ALERT_SLO_BURN": "5.0",
+        "DGRAPH_TPU_ALERT_PEER_SILENT_S": "3.0",
+        "DGRAPH_TPU_ALERT_REPORT_SILENT_S": "2.0",
+        "DGRAPH_TPU_ALERT_MOVE_STUCK_S": "6.0",
+        "DGRAPH_TPU_ALERT_CDC_LAG": "32",
+        "DGRAPH_TPU_INCIDENT_DIR": os.path.join(
+            report_dir, "incidents"),
+        "DGRAPH_TPU_INCIDENT_COOLDOWN_S": "3.0",
+        "DGRAPH_TPU_INCIDENT_PPROF_S": "0.5",
+        "DGRAPH_TPU_INCIDENT_MAX": "16",
+    }
+
+
+# which rules COUNT as detection per nemesis. report_silent is the
+# one signal that works at every replication factor (the victim's
+# heat-report heartbeat goes dark at zero); peer_silent needs raft
+# peers, slo burn needs server-side failures (at replicas=1 a dead
+# group fails ops CLIENT-side — the client drives cross-group 2PC).
+_ALWAYS_RELEVANT = frozenset({
+    "slo_error_burn", "report_silent", "raft_peer_silent",
+    "raft_apply_lag"})
+RELEVANT_ALERTS = {
+    "move-under-fire": _ALWAYS_RELEVANT | {"move_stuck"},
+    "cdc": _ALWAYS_RELEVANT | {"cdc_lag"},
+    "delay-storm": _ALWAYS_RELEVANT | {"wal_fsync_stall"},
+}
+
+
+def relevant_alerts(name: str) -> frozenset:
+    return RELEVANT_ALERTS.get(name, _ALWAYS_RELEVANT)
+
+
+class AlertCollector:
+    """Side-thread poller of every node's {"op": "alerts"} endpoint.
+
+    Owns its own single-shot clients — never shared with the nemeses
+    (a SIGKILL mid-RPC must not poison a socket the collector is
+    blocked on; _rpc_once drops a failed socket, so a restarted node
+    is re-dialed on the next round). Samples live in the
+    time.perf_counter domain — the same clock as the phase marks. A
+    partitioned victim stays pollable (netfault rules only drop
+    node->node traffic, never the driver's); a killed one simply
+    yields no samples until reboot."""
+
+    def __init__(self, cluster, poll_s: float = 0.4):
+        self._clients = cluster.node_clients(timeout=2.0)
+        self.poll_s = poll_s
+        self.samples: list[dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="alert-collector")
+
+    def start(self) -> "AlertCollector":
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            for node, cl in self._clients.items():
+                got = cl._rpc_once(1, {"op": "alerts"})
+                if not got or not got.get("ok"):
+                    continue  # down/rebooting: no sample, not a lie
+                firing = [{"rule": f.get("rule"),
+                           "series": f.get("series")}
+                          for f in got["result"].get("firing", ())]
+                with self._lock:
+                    self.samples.append({"t": time.perf_counter(),
+                                         "node": node,
+                                         "firing": firing})
+            self._stop.wait(self.poll_s)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self.samples)
+
+    def firing_now(self) -> list:
+        """(node, rule) pairs from each node's most recent sample."""
+        latest: dict[str, dict] = {}
+        for s in self.snapshot():
+            latest[s["node"]] = s
+        return sorted({(s["node"], f["rule"])
+                       for s in latest.values() for f in s["firing"]})
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(10)
+        for cl in self._clients.values():
+            cl.close()
+
+
+def wait_alerts_clear(collector: AlertCollector,
+                      timeout_s: float = 15.0) -> float:
+    """Block until every node's latest poll shows nothing firing (or
+    timeout — the phase check then fails on `cleared`). Returns the
+    quiesce mark (perf_counter). Progress needs no traffic: the
+    manager's idle-series resolve clears a firing series whose signal
+    went quiet."""
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        if not collector.firing_now():
+            break
+        time.sleep(0.3)
+    return time.perf_counter()
+
+
+def check_phase_alerts(samples: list[dict], marks: dict,
+                       relevant: frozenset) -> dict:
+    """Judge one phase's alert trace against its marks: nothing fires
+    in [start, inject), >=1 relevant rule fires in [inject, quiesced],
+    and every node's last sample in that window is quiet. Pure —
+    unit tests feed it synthetic samples."""
+    t0, ti = marks["start"], marks["inject"]
+    th, tq = marks["heal"], marks["quiesced"]
+    false_pos = sorted({(s["node"], f["rule"]) for s in samples
+                        if t0 <= s["t"] < ti for f in s["firing"]})
+    window = [s for s in samples if ti <= s["t"] <= tq]
+    detect_s = None
+    fired: set = set()
+    last: dict[str, dict] = {}
+    last_firing_t = None
+    for s in window:
+        for f in s["firing"]:
+            fired.add((s["node"], f["rule"]))
+            if detect_s is None and f["rule"] in relevant:
+                detect_s = round(s["t"] - ti, 3)
+        last[s["node"]] = s
+        if s["firing"]:
+            last_firing_t = s["t"]
+    cleared = bool(last) and all(not s["firing"]
+                                 for s in last.values())
+    clear_s = None
+    if cleared and last_firing_t is not None:
+        clear_s = round(max(0.0, last_firing_t - th), 3)
+    return {
+        "ok": detect_s is not None and cleared and not false_pos,
+        "detected": detect_s is not None,
+        "detect_s": detect_s,
+        "fired": sorted([n, r] for n, r in fired),
+        "relevant": sorted(relevant),
+        "false_positives": sorted([n, r] for n, r in false_pos),
+        "cleared": cleared,
+        "clear_s": clear_s,
+        "samples": len(window),
+    }
+
+
+def _node_rpc(cl, req: dict, tries: int = 3):
+    """Single-shot RPC with redial retries: the first attempt after a
+    node rebooted burns on the stale pooled socket."""
+    for _ in range(tries):
+        got = cl._rpc_once(1, req)
+        if got is not None:
+            return got
+        time.sleep(0.2)
+    return None
+
+
+def check_bundles(node_clients: dict, fired: set) -> list[str]:
+    """Every (node, rule) that fired must have produced a READABLE
+    incident bundle on that node: a manifest whose rule matches, whose
+    full bundle carries a real pprof profile (samples), at least one
+    trace, and a metrics snapshot. Read over the wire — the same path
+    an operator's dgalert would take — so this also proves the ring
+    survived every restart the phases inflicted."""
+    problems = []
+    for node, rule in sorted(fired):
+        cl = node_clients.get(node)
+        got = _node_rpc(cl, {"op": "incidents", "limit": 32}) \
+            if cl else None
+        if not got or not got.get("ok"):
+            problems.append(f"{node}: incidents op failed: {got}")
+            continue
+        res = got["result"]
+        if not res.get("enabled"):
+            problems.append(f"{node}: incident recorder disabled")
+            continue
+        ids = [m["id"] for m in res.get("incidents", ())
+               if m.get("rule") == rule]
+        if not ids:
+            problems.append(
+                f"{node}: no incident bundle for fired rule {rule}")
+            continue
+        got = _node_rpc(cl, {"op": "incidents", "id": ids[-1]})
+        bundle = (got or {}).get("result", {}).get("bundle") \
+            if got and got.get("ok") else None
+        if not bundle:
+            problems.append(f"{node}: bundle {ids[-1]} unreadable")
+            continue
+        prof = bundle.get("pprof") or {}
+        if not prof.get("samples"):
+            problems.append(f"{node}:{ids[-1]}: pprof empty "
+                            f"({prof.get('error', 'no samples')})")
+        tr = bundle.get("traces") or {}
+        if not (tr.get("spans") or tr.get("trace_ids")):
+            problems.append(f"{node}:{ids[-1]}: no traces captured")
+        if not (bundle.get("metrics") or {}).get("counters"):
+            problems.append(f"{node}:{ids[-1]}: no metrics snapshot")
+    return problems
+
+
 # ------------------------------------------------------------- nemeses
 
 
@@ -858,11 +1088,13 @@ def run_cdc_phase(args, cluster, rc, rng) -> dict:
     st = threading.Thread(target=subscriber, daemon=True)
     wt.start()
     st.start()
+    alert_marks = {"start": time.perf_counter()}
     try:
         time.sleep(args.pre_s)
         # fault 1: raft-partition the node the subscriber is on (its
         # client listener stays reachable — the node serves a FROZEN
         # stream, the worst case for a tailing consumer)
+        alert_marks["inject"] = time.perf_counter()
         victim = state["node"]
         others = [n for n in cluster.node_addrs if n != victim]
         nem = Nemesis({"cluster": cluster,
@@ -882,6 +1114,7 @@ def run_cdc_phase(args, cluster, rc, rng) -> dict:
         time.sleep(max(2.0, args.fault_s / 2))
         cluster.restart(leader)
         cluster.wait_caught_up(leader)
+        alert_marks["heal"] = time.perf_counter()
         t_heal = time.monotonic()
         stop_writer.set()
         wt.join(10)
@@ -930,11 +1163,14 @@ def run_cdc_phase(args, cluster, rc, rng) -> dict:
                  "heartbeats": state["heartbeats"],
                  "polls": state["polls"]}
     log(f"cdc: {stats}, violations {len(violations)}")
+    alert_marks.setdefault("inject", alert_marks["start"])
+    alert_marks.setdefault("heal", time.perf_counter())
     return {"nemesis": "cdc", "cdc": stats,
             "cdc_violations": violations,
             "ops": stats["acked"], "rate_qps": 20.0,
             "unavailability_s": None,
-            "time_to_recover_s": ttr if not missing else None}
+            "time_to_recover_s": ttr if not missing else None,
+            "_alert_marks": alert_marks}
 
 
 # ---------------------------------------------------------------- main
@@ -1105,6 +1341,13 @@ def run_nemesis_phase(args, bank: Bank, nem: Nemesis, rng,
     win["nemesis"] = nem.name
     win["ops"] = n_ops
     win["rate_qps"] = args.rate
+    # the alert checker's clock marks (perf_counter domain, same as
+    # the collector's samples); popped from the report row in main
+    win["_alert_marks"] = {
+        "start": t_start,
+        "inject": marks.get("inject", t_start + args.pre_s),
+        "heal": marks.get("heal", t_start + args.pre_s + fault_s),
+    }
     log(f"{nem.name}: unavailability {win['unavailability_s']}s, "
         f"ttr {win['time_to_recover_s']}s, fault classes "
         f"{win['fault']['classes']}")
@@ -1157,12 +1400,14 @@ def main(argv=None) -> int:
             groups=args.groups, replicas=args.replicas,
             zeros=args.zeros,
             log_dir=os.path.join(args.report_dir, "logs"),
-            data_dir=data_dir) as cluster:
+            data_dir=data_dir,
+            env_extra=chaos_alert_env(args.report_dir)) as cluster:
         cluster.wait_ready(90)
         rc = cluster.routed()
         node_clients = cluster.node_clients()
         from dgraph_tpu.cluster.client import ClusterClient
         zero_cl = ClusterClient(cluster.zero_addrs, timeout=10.0)
+        collector = AlertCollector(cluster).start()
         try:
             bank = Bank(rc, zero_cl, rc.groups[1], rc.groups[2],
                         args.accounts, args.deadline_ms)
@@ -1172,33 +1417,53 @@ def main(argv=None) -> int:
                    "rng": rng}
 
             phases = []
+            alert_checks: list[dict] = []
+            all_fired: set = set()
             for ix, name in enumerate(names):
                 if name == "cdc":
                     # change-stream fault tolerance: its own driver +
                     # checker (subscriber/writer, not the bank)
-                    phases.append(run_cdc_phase(args, cluster, rc,
-                                                rng))
-                    continue
-                nem = NEMESES[name](ctx)
-                phases.append(run_nemesis_phase(
-                    args, bank, nem, rng, noise_reads, ix))
-                # faults visible from the outside is part of the
-                # contract — but only while armed; between phases
-                # EVERY node's table must be CLEAN or the next
-                # phase's baseline is polluted
-                for node in sorted(node_clients):
-                    st = node_clients[node]._rpc_once(
-                        1, {"op": "fault", "action": "list"})
-                    if st and st.get("ok") and st["result"]["rules"]:
-                        raise RuntimeError(
-                            f"fault table on {node} not healed after "
-                            f"{name}: {st['result']['rules']}")
+                    phase = run_cdc_phase(args, cluster, rc, rng)
+                else:
+                    nem = NEMESES[name](ctx)
+                    phase = run_nemesis_phase(
+                        args, bank, nem, rng, noise_reads, ix)
+                    # faults visible from the outside is part of the
+                    # contract — but only while armed; between phases
+                    # EVERY node's table must be CLEAN or the next
+                    # phase's baseline is polluted
+                    for node in sorted(node_clients):
+                        st = node_clients[node]._rpc_once(
+                            1, {"op": "fault", "action": "list"})
+                        if st and st.get("ok") \
+                                and st["result"]["rules"]:
+                            raise RuntimeError(
+                                f"fault table on {node} not healed "
+                                f"after {name}: "
+                                f"{st['result']['rules']}")
+                # the alert plane must quiesce before the next phase
+                # (a leftover firing would poison its pre window)
+                marks = phase.pop("_alert_marks")
+                marks["quiesced"] = wait_alerts_clear(collector)
+                chk = check_phase_alerts(collector.snapshot(), marks,
+                                         relevant_alerts(name))
+                chk["nemesis"] = name
+                log(f"{name}: alerts detect={chk['detect_s']}s "
+                    f"fired={chk['fired']} cleared={chk['cleared']} "
+                    f"false_pos={chk['false_positives']}")
+                alert_checks.append(chk)
+                all_fired.update((n, r) for n, r in chk["fired"])
+                phases.append(phase)
+
+            log("verifying incident bundles for every fired alert")
+            bundle_problems = check_bundles(node_clients, all_fired)
 
             log("collecting final state + running the checker")
             final_bals, ledger = bank.final_state()
             verdict = check_history(bank.history, final_bals, ledger,
                                     args.accounts)
         finally:
+            collector.stop()
             zero_cl.close()
             for cl in node_clients.values():
                 cl.close()
@@ -1231,10 +1496,22 @@ def main(argv=None) -> int:
         "seed": args.seed, "smoke": bool(args.smoke),
         "race_violations": len(races),
         "history_ops": len(bank.history),
+        "alerts_ok": (all(c["ok"] for c in alert_checks)
+                      and not bundle_problems),
+        "alert_false_positives": sum(len(c["false_positives"])
+                                     for c in alert_checks),
+        "alert_detect_s_max": max(
+            (c["detect_s"] for c in alert_checks
+             if c["detect_s"] is not None), default=None),
         "wall_s": round(time.monotonic() - t_run, 1),
     }
     out = {"summary": summary, "phases": phases, "checker": verdict,
            "races": [str(v) for v in races],
+           "alerts": {"checks": alert_checks,
+                      "fired": sorted([n, r] for n, r in all_fired),
+                      "bundle_problems": bundle_problems,
+                      "env": chaos_alert_env(args.report_dir),
+                      "ok": summary["alerts_ok"]},
            "history_file": os.path.abspath(hist_path),
            "report_dir": os.path.abspath(args.report_dir)}
     with open(args.out, "w") as f:
@@ -1258,6 +1535,20 @@ def main(argv=None) -> int:
                        f"p99<={args.slo_ms}ms"
                        if p["nemesis"] != "cdc" else
                        "cdc: subscriber never caught up after heal")
+    for c in alert_checks:
+        if not c["detected"]:
+            bad.append(f"alerts: {c['nemesis']}: no relevant alert "
+                       f"fired in the fault window "
+                       f"(relevant={c['relevant']})")
+        if c["false_positives"]:
+            bad.append(f"alerts: {c['nemesis']}: firing BEFORE "
+                       f"inject: {c['false_positives']}")
+        if not c["cleared"]:
+            bad.append(f"alerts: {c['nemesis']}: still firing after "
+                       "heal + quiesce window")
+    if bundle_problems:
+        bad.append("incident bundles: "
+                   + "; ".join(bundle_problems[:3]))
     if bad:
         log("CHAOS FAILED: " + "; ".join(bad))
         return 1

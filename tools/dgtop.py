@@ -421,6 +421,27 @@ def serving_rows(snaps: dict[str, dict],
     return nodes, tenants
 
 
+def alerts_rows(snaps: dict[str, dict]) -> list[dict]:
+    """The ALERTS panel's rows: every node's firing alert series from
+    /debug/stats `alerts` (utils/watchdog.firing_summary — series,
+    last value, ack state, seconds firing). Pure — tests drive it
+    with canned payloads. The panel disappears on a healthy cluster
+    (zero firing series is the normal frame)."""
+    rows = []
+    for node in sorted(snaps):
+        snap = snaps[node]
+        if snap is None:
+            continue
+        for f in snap["stats"].get("alerts") or ():
+            rows.append({"node": node,
+                         "series": f.get("series", "?"),
+                         "value": f.get("value"),
+                         "acked": bool(f.get("acked")),
+                         "since_s": f.get("since_s")})
+    rows.sort(key=lambda r: (-(r["since_s"] or 0.0), r["series"]))
+    return rows
+
+
 def hottest(snaps: dict[str, dict], top: int = 5) -> list[dict]:
     """Cluster-wide hottest tablets by query-path touches, with their
     cheap size facts. Pure — tests drive it with canned payloads."""
@@ -492,6 +513,17 @@ def render(snaps: dict[str, dict],
             f"{_fmt(row['heard_max']):>6}")
         for r in snap["stats"].get("netfault") or ():
             fault_rows.append((node, r))
+    arows = alerts_rows(snaps)
+    if arows:
+        lines.append("")
+        lines.append(f"{'ALERTS FIRING':<52} {'VALUE':>10} "
+                     f"{'ACK':>4} {'FOR_S':>7}")
+        for r in arows:
+            lines.append(
+                f"{r['series'] + ' @ ' + r['node']:<52.52} "
+                f"{_fmt(r['value'], nd=2):>10} "
+                f"{'yes' if r['acked'] else '-':>4} "
+                f"{_fmt(r['since_s']):>7}")
     if fault_rows:
         lines.append("")
         lines.append(f"{'ACTIVE FAULT RULES':<34} {'DST':<28} "
